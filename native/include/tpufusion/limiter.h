@@ -1,0 +1,124 @@
+/*
+ * tpu-fusion soft-limiter library interface (libtpf_limiter.so).
+ *
+ * Two call surfaces over the shared-memory protocol defined in
+ * tpufusion/shm_layout.h — the TPU-native re-design of the reference's
+ * provider/limiter.h (NexusGPU/tensor-fusion limiter.h:71-106):
+ *
+ * 1. Worker-facing (hot path, called from the client hook inside the pod —
+ *    the JAX/PJRT interception layer charges each program launch and buffer
+ *    allocation):
+ *      tfl_attach, tfl_charge_compute, tfl_charge_hbm, tfl_worker_frozen,
+ *      tfl_wait_hint_us, tfl_self_register_pid
+ *
+ * 2. Hypervisor-facing (control path, called by the node agent via ctypes):
+ *      tfl_init, tfl_shutdown, tfl_create_worker, tfl_remove_worker,
+ *      tfl_register_pid, tfl_update_quota, tfl_heartbeat,
+ *      tfl_set_pod_hbm_used, tfl_set_frozen
+ *
+ * Compute tokens are MFLOPs (1e6 FLOPs); the client estimates a program's
+ * cost once at compile time (XLA cost analysis) and charges it per launch.
+ */
+
+#ifndef TPUFUSION_LIMITER_H
+#define TPUFUSION_LIMITER_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#include "provider.h" /* tpf_status_t */
+#include "shm_layout.h"
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Per-device worker quota, passed at worker creation. */
+typedef struct {
+  uint32_t device_index;         /* slot index inside the segment            */
+  char chip_id[64];
+  uint32_t duty_limit_bp;        /* MXU duty share, basis points (0-10000)   */
+  uint64_t hbm_limit_bytes;
+  uint64_t capacity_mflop;       /* token bucket capacity (burst budget)     */
+  uint64_t refill_mflop_per_s;   /* initial refill rate                      */
+} tfl_device_quota_t;
+
+/* Result of a charge attempt. */
+typedef struct {
+  uint8_t allowed;               /* 1 if the op may proceed                  */
+  uint8_t frozen;                /* 1 if denial was due to a freeze          */
+  uint64_t available;            /* tokens (MFLOP) or HBM bytes remaining    */
+  uint64_t wait_hint_us;         /* suggested sleep before retrying          */
+} tfl_charge_result_t;
+
+/* ------------------------------------------------------------------ */
+/* Worker-facing (client hook)                                         */
+/* ------------------------------------------------------------------ */
+
+/* Map an existing worker segment (path = <shm_base>/<ns>/<pod>). */
+TPF_API tpf_status_t tfl_attach(const char* shm_path);
+TPF_API tpf_status_t tfl_detach(void);
+
+/* Charge `mflops` compute tokens against device slot `device_index`.
+ * Lazily refills the bucket from refill_mflop_per_s, then attempts an
+ * atomic subtract.  Never blocks — the caller sleeps wait_hint_us and
+ * retries (keeps the hook signal-safe and starvation-visible). */
+TPF_API tpf_status_t tfl_charge_compute(uint32_t device_index, uint64_t mflops,
+                                        tfl_charge_result_t* result);
+
+/* Charge (delta>0) or release (delta<0) HBM bytes. */
+TPF_API tpf_status_t tfl_charge_hbm(uint32_t device_index, int64_t delta_bytes,
+                                    tfl_charge_result_t* result);
+
+TPF_API uint8_t tfl_worker_frozen(void);
+
+/* Register the calling process in the segment's PID table. */
+TPF_API tpf_status_t tfl_self_register_pid(void);
+
+/* ------------------------------------------------------------------ */
+/* Hypervisor-facing (control path)                                    */
+/* ------------------------------------------------------------------ */
+
+TPF_API tpf_status_t tfl_init(const char* shm_base_path);
+TPF_API tpf_status_t tfl_shutdown(void);
+
+TPF_API tpf_status_t tfl_create_worker(const char* ns, const char* pod,
+                                       const tfl_device_quota_t* quotas,
+                                       size_t quota_count);
+TPF_API tpf_status_t tfl_remove_worker(const char* ns, const char* pod);
+
+TPF_API tpf_status_t tfl_register_pid(const char* ns, const char* pod,
+                                      uint64_t host_pid);
+
+/* Push an ERL update: new duty share + refill rate (+ optionally a new
+ * bucket capacity; pass 0 to keep the current capacity). */
+TPF_API tpf_status_t tfl_update_quota(const char* ns, const char* pod,
+                                      uint32_t device_index,
+                                      uint32_t duty_limit_bp,
+                                      uint64_t refill_mflop_per_s,
+                                      uint64_t capacity_mflop);
+
+TPF_API tpf_status_t tfl_heartbeat(const char* ns, const char* pod,
+                                   uint64_t ts_seconds);
+
+TPF_API tpf_status_t tfl_set_pod_hbm_used(const char* ns, const char* pod,
+                                          uint32_t device_index,
+                                          uint64_t bytes);
+
+/* Freeze / thaw a worker (auto_freeze=1 marks an idle-driven freeze). */
+TPF_API tpf_status_t tfl_set_frozen(const char* ns, const char* pod,
+                                    uint8_t frozen, uint8_t auto_freeze);
+
+/* ------------------------------------------------------------------ */
+/* Introspection                                                       */
+/* ------------------------------------------------------------------ */
+
+/* Write a JSON description of the shm layout (sizes + field offsets) into
+ * buf; used by the Python mirror to verify byte-compatibility in tests. */
+TPF_API tpf_status_t tfl_layout_json(char* buf, size_t buf_len);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPUFUSION_LIMITER_H */

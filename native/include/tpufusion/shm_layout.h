@@ -1,0 +1,97 @@
+/*
+ * tpu-fusion soft-limiter shared-memory layout.
+ *
+ * One memory-mapped segment per worker pod at
+ *   <shm_base>/<namespace>/<pod_name>
+ * shared by three parties:
+ *   - the node hypervisor (creates the segment, pushes quota/ERL updates,
+ *     records pod HBM usage observed via the provider);
+ *   - the C++ limiter library (libtpf_limiter.so) linked/dlopened by client
+ *     processes, which charges HBM bytes and compute tokens on the hot path;
+ *   - Python tooling (hypervisor state mirror + tests) which reads the same
+ *     offsets via the layout description exported by tfl_layout_json().
+ *
+ * Role analog of the reference's versioned SharedDeviceState segments
+ * (NexusGPU/tensor-fusion pkg/hypervisor/worker/state/soft_limiter_shm.go:141-364)
+ * re-designed for TPU metering:
+ *   - compute is accounted in MFLOP tokens (1 token = 1e6 FLOPs) charged per
+ *     XLA *program launch* (TPU programs are large fused executables, so
+ *     launch-granularity is the natural metering point — not per-kernel);
+ *   - the bucket refill rate is duty_share * peak MXU FLOP rate, pushed by
+ *     the hypervisor's ERL PID controller;
+ *   - memory is an HBM byte budget.
+ *
+ * All mutable fields are 8-byte aligned and accessed with C11 atomics
+ * (lock-free CAS; no cross-process mutexes, so a crashed process can never
+ * wedge the segment).
+ */
+
+#ifndef TPUFUSION_SHM_LAYOUT_H
+#define TPUFUSION_SHM_LAYOUT_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define TPF_SHM_MAGIC 0x314D48535F465054ull /* little-endian "TPF_SHM1" */
+#define TPF_SHM_VERSION 1u
+#define TPF_SHM_MAX_DEVICES 8
+#define TPF_SHM_MAX_PIDS 64
+#define TPF_SHM_NS_LEN 64
+#define TPF_SHM_POD_LEN 128
+
+/* Worker flag bits (tpf_shm_header_t.flags). */
+#define TPF_SHM_FLAG_FROZEN (1ull << 0)      /* all compute charges blocked  */
+#define TPF_SHM_FLAG_AUTO_FROZEN (1ull << 1) /* frozen by idle auto-freeze   */
+
+typedef struct {
+  char chip_id[64];              /* provider chip id                         */
+  uint64_t active;               /* 1 if this slot is live                   */
+  uint64_t duty_limit_bp;        /* MXU duty share limit, basis points 0-1e4 */
+  uint64_t hbm_limit_bytes;      /* HBM budget                               */
+  uint64_t hbm_used_bytes;       /* client-charged HBM (atomic)              */
+  uint64_t pod_hbm_used_bytes;   /* hypervisor-observed HBM (provider stats) */
+  uint64_t tokens_mflop;         /* token bucket level (atomic)              */
+  uint64_t capacity_mflop;       /* bucket capacity                          */
+  uint64_t refill_mflop_per_s;   /* ERL-controlled refill rate               */
+  uint64_t last_refill_us;       /* lazy-refill clock (atomic CAS)           */
+  uint64_t total_charged_mflop;  /* lifetime charged tokens                  */
+  uint64_t launches;             /* program launches charged                 */
+  uint64_t blocked_events;       /* times a charge was denied                */
+  uint64_t hbm_denied_events;    /* times an HBM charge was denied           */
+  uint64_t reserved[4];
+} tpf_shm_device_t; /* 64 + 14*8 + 32 = 208 -> padded by layout to 256 */
+
+typedef struct {
+  uint64_t magic;
+  uint32_t version;
+  uint32_t device_count;
+  char ns[TPF_SHM_NS_LEN];
+  char pod[TPF_SHM_POD_LEN];
+  uint64_t heartbeat_ts_s;       /* hypervisor heartbeat (atomic)            */
+  uint64_t flags;                /* TPF_SHM_FLAG_* (atomic)                  */
+  uint64_t freeze_ts_us;         /* when the worker was last frozen          */
+  uint64_t pid_count;            /* registered client host PIDs (atomic)     */
+  /* A slot may transiently read 0 while a registrant between its CAS-reserve
+   * of pid_count and the pid store; readers must skip zero entries. */
+  uint64_t pids[TPF_SHM_MAX_PIDS];
+  uint64_t reserved[8];
+} tpf_shm_header_t;
+
+/* Fixed layout: header padded to 1024 bytes, then TPF_SHM_MAX_DEVICES
+ * device records of 256 bytes each.  Total segment = 3072 -> one 4 KiB page. */
+#define TPF_SHM_HEADER_BYTES 1024
+#define TPF_SHM_DEVICE_BYTES 256
+#define TPF_SHM_SEGMENT_BYTES \
+  (TPF_SHM_HEADER_BYTES + TPF_SHM_MAX_DEVICES * TPF_SHM_DEVICE_BYTES)
+
+#define TPF_SHM_DEVICE_OFFSET(i) \
+  (TPF_SHM_HEADER_BYTES + (i) * TPF_SHM_DEVICE_BYTES)
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPUFUSION_SHM_LAYOUT_H */

@@ -1,0 +1,497 @@
+// tpu-fusion soft-limiter (libtpf_limiter.so).
+//
+// Implements tpufusion/limiter.h over the shared-memory protocol in
+// tpufusion/shm_layout.h.  The TPU-native analog of the reference's
+// closed-source libcuda_limiter.so (interface: provider/limiter.h in
+// NexusGPU/tensor-fusion): the hypervisor creates one segment per worker pod
+// and pushes ERL quota updates into it; client hooks charge compute tokens
+// per XLA program launch and HBM bytes per buffer allocation with lock-free
+// atomics, so a crashed client can never wedge the segment.
+
+#include <errno.h>
+#include <fcntl.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <map>
+#include <mutex>
+#include <string>
+
+#include "tpufusion/limiter.h"
+
+static_assert(sizeof(tpf_shm_header_t) <= TPF_SHM_HEADER_BYTES,
+              "shm header exceeds reserved space");
+static_assert(sizeof(tpf_shm_device_t) <= TPF_SHM_DEVICE_BYTES,
+              "shm device record exceeds reserved space");
+
+namespace {
+
+// ---- atomic helpers over the mmap'd segment -------------------------
+
+inline uint64_t aload(const uint64_t* p) {
+  return __atomic_load_n(p, __ATOMIC_ACQUIRE);
+}
+inline void astore(uint64_t* p, uint64_t v) {
+  __atomic_store_n(p, v, __ATOMIC_RELEASE);
+}
+inline bool acas(uint64_t* p, uint64_t* expected, uint64_t desired) {
+  return __atomic_compare_exchange_n(p, expected, desired, false,
+                                     __ATOMIC_ACQ_REL, __ATOMIC_ACQUIRE);
+}
+inline void aadd(uint64_t* p, uint64_t v) {
+  __atomic_fetch_add(p, v, __ATOMIC_ACQ_REL);
+}
+
+uint64_t now_us() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000000ull + (uint64_t)ts.tv_nsec / 1000ull;
+}
+
+struct Segment {
+  void* base = nullptr;
+  int fd = -1;
+  std::string path;
+
+  tpf_shm_header_t* header() { return (tpf_shm_header_t*)base; }
+  tpf_shm_device_t* device(uint32_t i) {
+    return (tpf_shm_device_t*)((char*)base + TPF_SHM_DEVICE_OFFSET(i));
+  }
+};
+
+std::mutex g_mu;
+std::string g_base_path;                 // hypervisor side
+std::map<std::string, Segment> g_open;   // hypervisor-side cache
+Segment g_worker;                        // worker-side attached segment
+bool g_host_inited = false;
+
+tpf_status_t map_segment(const std::string& path, bool create, Segment* out) {
+  int flags = create ? (O_RDWR | O_CREAT) : O_RDWR;
+  int fd = open(path.c_str(), flags, 0666);
+  if (fd < 0) return create ? TPF_ERR_FAILED : TPF_ERR_NOT_FOUND;
+  if (create && ftruncate(fd, TPF_SHM_SEGMENT_BYTES) != 0) {
+    close(fd);
+    return TPF_ERR_FAILED;
+  }
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < (off_t)TPF_SHM_SEGMENT_BYTES) {
+    close(fd);
+    return TPF_ERR_FAILED;
+  }
+  void* base = mmap(nullptr, TPF_SHM_SEGMENT_BYTES, PROT_READ | PROT_WRITE,
+                    MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    return TPF_ERR_FAILED;
+  }
+  out->base = base;
+  out->fd = fd;
+  out->path = path;
+  return TPF_OK;
+}
+
+void unmap_segment(Segment* seg) {
+  if (seg->base) munmap(seg->base, TPF_SHM_SEGMENT_BYTES);
+  if (seg->fd >= 0) close(seg->fd);
+  seg->base = nullptr;
+  seg->fd = -1;
+}
+
+std::string worker_path(const char* ns, const char* pod) {
+  return g_base_path + "/" + ns + "/" + pod;
+}
+
+// Hypervisor-side lookup (caller holds g_mu).
+tpf_status_t get_worker_locked(const char* ns, const char* pod,
+                               Segment** out) {
+  if (!g_host_inited) return TPF_ERR_NOT_INITIALIZED;
+  if (!ns || !pod) return TPF_ERR_INVALID_ARG;
+  std::string path = worker_path(ns, pod);
+  auto it = g_open.find(path);
+  if (it == g_open.end()) {
+    Segment seg;
+    tpf_status_t st = map_segment(path, false, &seg);
+    if (st != TPF_OK) return st;
+    if (seg.header()->magic != TPF_SHM_MAGIC) {
+      unmap_segment(&seg);
+      return TPF_ERR_FAILED;
+    }
+    it = g_open.emplace(path, seg).first;
+  }
+  *out = &it->second;
+  return TPF_OK;
+}
+
+// Lazily refill a device's token bucket from its refill rate.  Lock-free:
+// one caller wins the CAS on last_refill_us and credits the elapsed tokens.
+void refill(tpf_shm_device_t* d) {
+  uint64_t rate = aload(&d->refill_mflop_per_s);
+  if (rate == 0) return;
+  uint64_t last = aload(&d->last_refill_us);
+  uint64_t now = now_us();
+  if (now <= last) return;
+  uint64_t credit = (now - last) * rate / 1000000ull;
+  if (credit == 0) return;  // keep `last` so sub-token intervals accumulate
+  if (!acas(&d->last_refill_us, &last, now)) return;  // someone else refilled
+  uint64_t cap = aload(&d->capacity_mflop);
+  uint64_t cur = aload(&d->tokens_mflop);
+  for (;;) {
+    uint64_t next = cur + credit;
+    if (next > cap) next = cap;
+    if (next == cur) return;
+    if (acas(&d->tokens_mflop, &cur, next)) return;
+  }
+}
+
+tpf_status_t check_device(Segment* seg, uint32_t idx, tpf_shm_device_t** out) {
+  if (!seg->base) return TPF_ERR_NOT_INITIALIZED;
+  tpf_shm_header_t* h = seg->header();
+  if (h->magic != TPF_SHM_MAGIC) return TPF_ERR_FAILED;
+  if (idx >= h->device_count) return TPF_ERR_INVALID_ARG;
+  tpf_shm_device_t* d = seg->device(idx);
+  if (!aload(&d->active)) return TPF_ERR_NOT_FOUND;
+  *out = d;
+  return TPF_OK;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------------
+// Worker-facing
+// ---------------------------------------------------------------------
+
+TPF_API tpf_status_t tfl_attach(const char* shm_path) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!shm_path) return TPF_ERR_INVALID_ARG;
+  if (g_worker.base) unmap_segment(&g_worker);
+  tpf_status_t st = map_segment(shm_path, false, &g_worker);
+  if (st != TPF_OK) return st;
+  if (g_worker.header()->magic != TPF_SHM_MAGIC) {
+    unmap_segment(&g_worker);
+    return TPF_ERR_FAILED;
+  }
+  return TPF_OK;
+}
+
+TPF_API tpf_status_t tfl_detach(void) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  unmap_segment(&g_worker);
+  return TPF_OK;
+}
+
+TPF_API tpf_status_t tfl_charge_compute(uint32_t device_index, uint64_t mflops,
+                                        tfl_charge_result_t* result) {
+  if (!result) return TPF_ERR_INVALID_ARG;
+  memset(result, 0, sizeof(*result));
+  // g_mu guards the g_worker *mapping* lifecycle against a concurrent
+  // tfl_attach/tfl_detach munmap (fields inside the segment stay lock-free).
+  std::lock_guard<std::mutex> lk(g_mu);
+  tpf_shm_device_t* d = nullptr;
+  tpf_status_t st = check_device(&g_worker, device_index, &d);
+  if (st != TPF_OK) return st;
+
+  tpf_shm_header_t* h = g_worker.header();
+  if (aload(&h->flags) & (TPF_SHM_FLAG_FROZEN | TPF_SHM_FLAG_AUTO_FROZEN)) {
+    result->frozen = 1;
+    result->wait_hint_us = 10000;
+    aadd(&d->blocked_events, 1);
+    return TPF_OK;
+  }
+
+  refill(d);
+  uint64_t cur = aload(&d->tokens_mflop);
+  for (;;) {
+    if (cur < mflops) {
+      result->available = cur;
+      uint64_t rate = aload(&d->refill_mflop_per_s);
+      uint64_t wait = rate ? (mflops - cur) * 1000000ull / rate : 10000;
+      if (wait < 100) wait = 100;
+      if (wait > 1000000) wait = 1000000;
+      result->wait_hint_us = wait;
+      aadd(&d->blocked_events, 1);
+      return TPF_OK;
+    }
+    if (acas(&d->tokens_mflop, &cur, cur - mflops)) break;
+  }
+  result->allowed = 1;
+  result->available = cur - mflops;
+  aadd(&d->total_charged_mflop, mflops);
+  aadd(&d->launches, 1);
+  return TPF_OK;
+}
+
+TPF_API tpf_status_t tfl_charge_hbm(uint32_t device_index, int64_t delta_bytes,
+                                    tfl_charge_result_t* result) {
+  if (!result) return TPF_ERR_INVALID_ARG;
+  memset(result, 0, sizeof(*result));
+  std::lock_guard<std::mutex> lk(g_mu);
+  tpf_shm_device_t* d = nullptr;
+  tpf_status_t st = check_device(&g_worker, device_index, &d);
+  if (st != TPF_OK) return st;
+
+  uint64_t limit = aload(&d->hbm_limit_bytes);
+  uint64_t cur = aload(&d->hbm_used_bytes);
+  for (;;) {
+    uint64_t next;
+    if (delta_bytes >= 0) {
+      next = cur + (uint64_t)delta_bytes;
+      if (limit > 0 && next > limit) {
+        result->available = limit > cur ? limit - cur : 0;
+        aadd(&d->hbm_denied_events, 1);
+        return TPF_OK;
+      }
+    } else {
+      uint64_t dec = (uint64_t)(-delta_bytes);
+      next = cur > dec ? cur - dec : 0;
+    }
+    if (acas(&d->hbm_used_bytes, &cur, next)) {
+      result->allowed = 1;
+      result->available = limit > next ? limit - next : 0;
+      return TPF_OK;
+    }
+  }
+}
+
+TPF_API uint8_t tfl_worker_frozen(void) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_worker.base) return 0;
+  return (aload(&g_worker.header()->flags) &
+          (TPF_SHM_FLAG_FROZEN | TPF_SHM_FLAG_AUTO_FROZEN)) != 0;
+}
+
+TPF_API tpf_status_t tfl_self_register_pid(void) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_worker.base) return TPF_ERR_NOT_INITIALIZED;
+  tpf_shm_header_t* h = g_worker.header();
+  uint64_t pid = (uint64_t)getpid();
+  uint64_t n = aload(&h->pid_count);
+  for (uint64_t i = 0; i < n && i < TPF_SHM_MAX_PIDS; ++i) {
+    if (aload(&h->pids[i]) == pid) return TPF_OK;
+  }
+  // CAS-reserve a slot, then publish the pid into it.  Cross-process readers
+  // can observe the reserved-but-unwritten slot as 0 and must skip zero
+  // entries (documented in shm_layout.h).
+  for (;;) {
+    if (n >= TPF_SHM_MAX_PIDS) return TPF_ERR_EXHAUSTED;
+    if (acas(&h->pid_count, &n, n + 1)) {
+      astore(&h->pids[n], pid);
+      return TPF_OK;
+    }
+  }
+}
+
+// ---------------------------------------------------------------------
+// Hypervisor-facing
+// ---------------------------------------------------------------------
+
+TPF_API tpf_status_t tfl_init(const char* shm_base_path) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!shm_base_path) return TPF_ERR_INVALID_ARG;
+  g_base_path = shm_base_path;
+  if (mkdir(shm_base_path, 0777) != 0 && errno != EEXIST)
+    return TPF_ERR_FAILED;
+  g_host_inited = true;
+  return TPF_OK;
+}
+
+TPF_API tpf_status_t tfl_shutdown(void) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  for (auto& kv : g_open) unmap_segment(&kv.second);
+  g_open.clear();
+  g_host_inited = false;
+  return TPF_OK;
+}
+
+TPF_API tpf_status_t tfl_create_worker(const char* ns, const char* pod,
+                                       const tfl_device_quota_t* quotas,
+                                       size_t quota_count) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_host_inited) return TPF_ERR_NOT_INITIALIZED;
+  if (!ns || !pod || (!quotas && quota_count > 0) ||
+      quota_count > TPF_SHM_MAX_DEVICES)
+    return TPF_ERR_INVALID_ARG;
+
+  std::string dir = g_base_path + "/" + ns;
+  if (mkdir(dir.c_str(), 0777) != 0 && errno != EEXIST) return TPF_ERR_FAILED;
+  std::string path = worker_path(ns, pod);
+
+  Segment seg;
+  tpf_status_t st = map_segment(path, true, &seg);
+  if (st != TPF_OK) return st;
+  memset(seg.base, 0, TPF_SHM_SEGMENT_BYTES);
+
+  tpf_shm_header_t* h = seg.header();
+  h->version = TPF_SHM_VERSION;
+  snprintf(h->ns, sizeof(h->ns), "%s", ns);
+  snprintf(h->pod, sizeof(h->pod), "%s", pod);
+  h->device_count = 0;
+  uint64_t now = now_us();
+  uint32_t max_idx = 0;
+  for (size_t i = 0; i < quota_count; ++i) {
+    const tfl_device_quota_t& q = quotas[i];
+    if (q.device_index >= TPF_SHM_MAX_DEVICES) {
+      unmap_segment(&seg);
+      unlink(path.c_str());
+      return TPF_ERR_INVALID_ARG;
+    }
+    tpf_shm_device_t* d = seg.device(q.device_index);
+    snprintf(d->chip_id, sizeof(d->chip_id), "%s", q.chip_id);
+    d->duty_limit_bp = q.duty_limit_bp;
+    d->hbm_limit_bytes = q.hbm_limit_bytes;
+    d->capacity_mflop = q.capacity_mflop;
+    d->tokens_mflop = q.capacity_mflop;  // start with a full burst budget
+    d->refill_mflop_per_s = q.refill_mflop_per_s;
+    d->last_refill_us = now;
+    astore(&d->active, 1);
+    if (q.device_index + 1 > max_idx) max_idx = q.device_index + 1;
+  }
+  h->device_count = max_idx;
+  // Publish magic last so readers never see a half-initialized segment.
+  astore(&h->magic, TPF_SHM_MAGIC);
+
+  auto it = g_open.find(path);
+  if (it != g_open.end()) unmap_segment(&it->second);
+  g_open[path] = seg;
+  return TPF_OK;
+}
+
+TPF_API tpf_status_t tfl_remove_worker(const char* ns, const char* pod) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_host_inited) return TPF_ERR_NOT_INITIALIZED;
+  if (!ns || !pod) return TPF_ERR_INVALID_ARG;
+  std::string path = worker_path(ns, pod);
+  auto it = g_open.find(path);
+  if (it != g_open.end()) {
+    unmap_segment(&it->second);
+    g_open.erase(it);
+  }
+  return unlink(path.c_str()) == 0 ? TPF_OK : TPF_ERR_NOT_FOUND;
+}
+
+TPF_API tpf_status_t tfl_register_pid(const char* ns, const char* pod,
+                                      uint64_t host_pid) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  Segment* seg = nullptr;
+  tpf_status_t st = get_worker_locked(ns, pod, &seg);
+  if (st != TPF_OK) return st;
+  tpf_shm_header_t* h = seg->header();
+  uint64_t n = aload(&h->pid_count);
+  for (uint64_t i = 0; i < n && i < TPF_SHM_MAX_PIDS; ++i) {
+    if (h->pids[i] == host_pid) return TPF_OK;
+  }
+  if (n >= TPF_SHM_MAX_PIDS) return TPF_ERR_EXHAUSTED;
+  astore(&h->pids[n], host_pid);
+  astore(&h->pid_count, n + 1);
+  return TPF_OK;
+}
+
+TPF_API tpf_status_t tfl_update_quota(const char* ns, const char* pod,
+                                      uint32_t device_index,
+                                      uint32_t duty_limit_bp,
+                                      uint64_t refill_mflop_per_s,
+                                      uint64_t capacity_mflop) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  Segment* seg = nullptr;
+  tpf_status_t st = get_worker_locked(ns, pod, &seg);
+  if (st != TPF_OK) return st;
+  tpf_shm_device_t* d = nullptr;
+  st = check_device(seg, device_index, &d);
+  if (st != TPF_OK) return st;
+  astore(&d->duty_limit_bp, duty_limit_bp);
+  astore(&d->refill_mflop_per_s, refill_mflop_per_s);
+  if (capacity_mflop > 0) astore(&d->capacity_mflop, capacity_mflop);
+  return TPF_OK;
+}
+
+TPF_API tpf_status_t tfl_heartbeat(const char* ns, const char* pod,
+                                   uint64_t ts_seconds) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  Segment* seg = nullptr;
+  tpf_status_t st = get_worker_locked(ns, pod, &seg);
+  if (st != TPF_OK) return st;
+  astore(&seg->header()->heartbeat_ts_s, ts_seconds);
+  return TPF_OK;
+}
+
+TPF_API tpf_status_t tfl_set_pod_hbm_used(const char* ns, const char* pod,
+                                          uint32_t device_index,
+                                          uint64_t bytes) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  Segment* seg = nullptr;
+  tpf_status_t st = get_worker_locked(ns, pod, &seg);
+  if (st != TPF_OK) return st;
+  tpf_shm_device_t* d = nullptr;
+  st = check_device(seg, device_index, &d);
+  if (st != TPF_OK) return st;
+  astore(&d->pod_hbm_used_bytes, bytes);
+  return TPF_OK;
+}
+
+TPF_API tpf_status_t tfl_set_frozen(const char* ns, const char* pod,
+                                    uint8_t frozen, uint8_t auto_freeze) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  Segment* seg = nullptr;
+  tpf_status_t st = get_worker_locked(ns, pod, &seg);
+  if (st != TPF_OK) return st;
+  tpf_shm_header_t* h = seg->header();
+  uint64_t bit = auto_freeze ? TPF_SHM_FLAG_AUTO_FROZEN : TPF_SHM_FLAG_FROZEN;
+  uint64_t cur = aload(&h->flags);
+  for (;;) {
+    uint64_t next = frozen ? (cur | bit) : (cur & ~bit);
+    if (acas(&h->flags, &cur, next)) break;
+  }
+  if (frozen) astore(&h->freeze_ts_us, now_us());
+  return TPF_OK;
+}
+
+// ---------------------------------------------------------------------
+// Introspection
+// ---------------------------------------------------------------------
+
+TPF_API tpf_status_t tfl_layout_json(char* buf, size_t buf_len) {
+  if (!buf) return TPF_ERR_INVALID_ARG;
+  int n = snprintf(
+      buf, buf_len,
+      "{\"segment_bytes\":%d,\"header_bytes\":%d,\"device_bytes\":%d,"
+      "\"max_devices\":%d,\"max_pids\":%d,"
+      "\"header\":{\"magic\":%zu,\"version\":%zu,\"device_count\":%zu,"
+      "\"ns\":%zu,\"pod\":%zu,\"heartbeat_ts_s\":%zu,\"flags\":%zu,"
+      "\"freeze_ts_us\":%zu,\"pid_count\":%zu,\"pids\":%zu},"
+      "\"device\":{\"chip_id\":%zu,\"active\":%zu,\"duty_limit_bp\":%zu,"
+      "\"hbm_limit_bytes\":%zu,\"hbm_used_bytes\":%zu,"
+      "\"pod_hbm_used_bytes\":%zu,\"tokens_mflop\":%zu,"
+      "\"capacity_mflop\":%zu,\"refill_mflop_per_s\":%zu,"
+      "\"last_refill_us\":%zu,\"total_charged_mflop\":%zu,\"launches\":%zu,"
+      "\"blocked_events\":%zu,\"hbm_denied_events\":%zu}}",
+      TPF_SHM_SEGMENT_BYTES, TPF_SHM_HEADER_BYTES, TPF_SHM_DEVICE_BYTES,
+      TPF_SHM_MAX_DEVICES, TPF_SHM_MAX_PIDS,
+      offsetof(tpf_shm_header_t, magic), offsetof(tpf_shm_header_t, version),
+      offsetof(tpf_shm_header_t, device_count), offsetof(tpf_shm_header_t, ns),
+      offsetof(tpf_shm_header_t, pod),
+      offsetof(tpf_shm_header_t, heartbeat_ts_s),
+      offsetof(tpf_shm_header_t, flags),
+      offsetof(tpf_shm_header_t, freeze_ts_us),
+      offsetof(tpf_shm_header_t, pid_count), offsetof(tpf_shm_header_t, pids),
+      offsetof(tpf_shm_device_t, chip_id), offsetof(tpf_shm_device_t, active),
+      offsetof(tpf_shm_device_t, duty_limit_bp),
+      offsetof(tpf_shm_device_t, hbm_limit_bytes),
+      offsetof(tpf_shm_device_t, hbm_used_bytes),
+      offsetof(tpf_shm_device_t, pod_hbm_used_bytes),
+      offsetof(tpf_shm_device_t, tokens_mflop),
+      offsetof(tpf_shm_device_t, capacity_mflop),
+      offsetof(tpf_shm_device_t, refill_mflop_per_s),
+      offsetof(tpf_shm_device_t, last_refill_us),
+      offsetof(tpf_shm_device_t, total_charged_mflop),
+      offsetof(tpf_shm_device_t, launches),
+      offsetof(tpf_shm_device_t, blocked_events),
+      offsetof(tpf_shm_device_t, hbm_denied_events));
+  return (n > 0 && (size_t)n < buf_len) ? TPF_OK : TPF_ERR_EXHAUSTED;
+}
+
+}  // extern "C"

@@ -1,0 +1,62 @@
+/*
+ * Mock TPU driver: an in-process simulation of a single TPU host used to
+ * test the whole tpu-fusion stack (hypervisor, allocator, scheduler, e2e)
+ * on machines with no TPU hardware.
+ *
+ * Role analog of the reference's device_mock/driver_mock.c (fake 4-GPU
+ * driver), re-imagined as a TPU slice: by default a v5e-8 host — 8 chips in
+ * a 2x4 ICI mesh with wrap-around links — with a process table and synthetic
+ * per-process MXU duty / HBM usage.
+ *
+ * Configuration via environment (read once at tpf_mock_reset/driver init):
+ *   TPF_MOCK_GEN    "v5e" (default) | "v5p" | "v6e" | "v4"
+ *   TPF_MOCK_CHIPS  chip count (default 8)
+ *   TPF_MOCK_MESH   "XxY" mesh shape (default "2x4"; product must equal chips)
+ *
+ * The tpf_mock_* control surface below is exported from the provider .so so
+ * tests (C or Python/ctypes) can inject processes and utilization.
+ */
+
+#ifndef TPUFUSION_MOCK_DRIVER_H
+#define TPUFUSION_MOCK_DRIVER_H
+
+#include <stdint.h>
+
+#include "tpufusion/provider.h"
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define TPF_MOCK_MAX_CHIPS 64
+#define TPF_MOCK_MAX_PROCS 256
+
+/* (Re-)initialize the simulated host from environment configuration.
+ * Clears the process table and partition bookkeeping. */
+TPF_API void tpf_mock_reset(void);
+
+/* Register / update a simulated client process on a chip.  `duty_pct` is the
+ * MXU duty share the process *wants*; the driver clamps aggregate chip duty
+ * at 100 and scales contenders proportionally.  Returns TPF_ERR_NOT_FOUND
+ * for an unknown chip, TPF_ERR_EXHAUSTED when the process table is full. */
+TPF_API tpf_status_t tpf_mock_proc_set(int64_t pid, const char* chip_id,
+                                       double duty_pct, uint64_t hbm_bytes);
+
+/* Remove a simulated process (all chips). */
+TPF_API tpf_status_t tpf_mock_proc_remove(int64_t pid);
+
+/* Advance the simulation clock (launch counters, utilization smoothing). */
+TPF_API void tpf_mock_tick(double seconds);
+
+/* Number of live partitions on a chip (test introspection). */
+TPF_API int32_t tpf_mock_partition_count(const char* chip_id);
+
+/* Sum of hard limits applied via tpf_set_*_hard_limit (test introspection). */
+TPF_API uint64_t tpf_mock_hbm_hard_limit(const char* chip_id);
+TPF_API uint32_t tpf_mock_duty_hard_limit(const char* chip_id);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPUFUSION_MOCK_DRIVER_H */

@@ -1,0 +1,602 @@
+// Mock TPU provider: implements the tpu-fusion provider ABI
+// (tpufusion/provider.h) over the simulated host declared in mock_driver.h.
+//
+// Role analog of the reference's provider/example/accelerator.c +
+// device_mock/driver_mock.c pair, redesigned for TPU semantics: chips on an
+// ICI mesh, MXU duty-cycle contention, HBM accounting, core-granular
+// partitions.  Built as libtpf_provider_mock.so.
+
+#include <cstdarg>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "mock_driver.h"
+#include "tpufusion/provider.h"
+
+namespace {
+
+struct GenSpec {
+  const char* name;
+  int cores;
+  uint64_t hbm_bytes;
+  double bf16_tflops;
+  double int8_tops;
+  double hbm_gbps;
+  double ici_gbps;  // per-link, per-direction
+};
+
+// Public per-generation specs (approximate; used for synthetic capacity).
+const GenSpec kGenSpecs[] = {
+    {"v4", 2, 32ull << 30, 275.0, 275.0, 1228.0, 50.0},
+    {"v5e", 1, 16ull << 30, 197.0, 394.0, 819.0, 50.0},
+    {"v5p", 2, 95ull << 30, 459.0, 918.0, 2765.0, 100.0},
+    {"v6e", 1, 32ull << 30, 918.0, 1836.0, 1640.0, 100.0},
+};
+
+struct MockProc {
+  int64_t pid = 0;
+  int chip = -1;
+  double want_duty = 0.0;  // requested duty share, 0-100
+  uint64_t hbm_bytes = 0;
+  uint64_t launches = 0;
+};
+
+struct MockPartition {
+  std::string template_id;
+  std::string partition_id;
+  int core = 0;       // first core of the granted range
+  int core_count = 1;
+};
+
+struct MockChip {
+  tpf_chip_info_t info{};
+  std::vector<MockPartition> partitions;
+  uint64_t hbm_hard_limit = 0;
+  uint32_t duty_hard_limit = 100;
+  uint64_t ici_tx = 0, ici_rx = 0;
+  bool frozen = false;  // set by device-level snapshot
+};
+
+struct MockState {
+  bool initialized = false;
+  GenSpec gen{};
+  int mesh_x = 1, mesh_y = 1;
+  std::vector<MockChip> chips;
+  std::vector<MockProc> procs;
+  double clock_s = 0.0;
+  tpf_log_fn log_sink = nullptr;
+};
+
+std::mutex g_mu;
+MockState g_state;
+
+void logf(const char* level, const char* fmt, ...) {
+  if (!g_state.log_sink) return;
+  char buf[512];
+  va_list ap;
+  va_start(ap, fmt);
+  vsnprintf(buf, sizeof(buf), fmt, ap);
+  va_end(ap);
+  g_state.log_sink(level, buf);
+}
+
+const GenSpec& lookup_gen(const char* name) {
+  for (const auto& g : kGenSpecs) {
+    if (strcmp(g.name, name) == 0) return g;
+  }
+  return kGenSpecs[1];  // v5e default
+}
+
+void build_host_locked() {
+  const char* gen_env = getenv("TPF_MOCK_GEN");
+  g_state.gen = lookup_gen(gen_env ? gen_env : "v5e");
+
+  int chip_count = 8;
+  if (const char* c = getenv("TPF_MOCK_CHIPS")) chip_count = atoi(c);
+  if (chip_count < 1) chip_count = 1;
+  if (chip_count > TPF_MOCK_MAX_CHIPS) chip_count = TPF_MOCK_MAX_CHIPS;
+
+  g_state.mesh_x = 2;
+  g_state.mesh_y = (chip_count + 1) / 2;
+  if (const char* m = getenv("TPF_MOCK_MESH")) {
+    int mx = 0, my = 0;
+    if (sscanf(m, "%dx%d", &mx, &my) == 2 && mx > 0 && my > 0 &&
+        mx * my == chip_count) {
+      g_state.mesh_x = mx;
+      g_state.mesh_y = my;
+    }
+  } else if (g_state.mesh_x * g_state.mesh_y != chip_count) {
+    g_state.mesh_x = 1;
+    g_state.mesh_y = chip_count;
+  }
+
+  g_state.chips.assign(chip_count, MockChip{});
+  for (int i = 0; i < chip_count; ++i) {
+    tpf_chip_info_t& ci = g_state.chips[i].info;
+    snprintf(ci.chip_id, sizeof(ci.chip_id), "mock-%s-h0-c%d",
+             g_state.gen.name, i);
+    snprintf(ci.platform, sizeof(ci.platform), "tpu");
+    snprintf(ci.generation, sizeof(ci.generation), "%s", g_state.gen.name);
+    snprintf(ci.slice_id, sizeof(ci.slice_id), "mock-%s-%dx%d-slice0",
+             g_state.gen.name, g_state.mesh_x, g_state.mesh_y);
+    snprintf(ci.device_path, sizeof(ci.device_path), "/dev/accel%d", i);
+    snprintf(ci.driver_version, sizeof(ci.driver_version), "mock-1.0");
+    ci.global_index = i;
+    ci.host_index = i;
+    ci.numa_node = (i < chip_count / 2) ? 0 : 1;
+    ci.core_count = g_state.gen.cores;
+    ci.hbm_bytes = g_state.gen.hbm_bytes;
+    ci.peak_bf16_tflops = g_state.gen.bf16_tflops;
+    ci.peak_int8_tops = g_state.gen.int8_tops;
+    ci.hbm_gbps = g_state.gen.hbm_gbps;
+    ci.mesh_x = i % g_state.mesh_x;
+    ci.mesh_y = i / g_state.mesh_x;
+    ci.mesh_z = 0;
+    ci.caps.core_partitioning = g_state.gen.cores > 1;
+    ci.caps.soft_isolation = 1;
+    ci.caps.hard_isolation = 1;
+    ci.caps.snapshot = 1;
+    ci.caps.metrics = 1;
+    ci.caps.remoting = 1;
+    ci.caps.max_partitions = (uint32_t)g_state.gen.cores;
+    ci.caps.max_workers = 16;
+  }
+  g_state.procs.clear();
+  g_state.clock_s = 0.0;
+}
+
+int find_chip_locked(const char* chip_id) {
+  for (size_t i = 0; i < g_state.chips.size(); ++i) {
+    if (strcmp(g_state.chips[i].info.chip_id, chip_id) == 0) return (int)i;
+  }
+  return -1;
+}
+
+// Total requested duty on a chip (pre-clamp).
+double chip_want_locked(int chip) {
+  double total = 0.0;
+  for (const auto& p : g_state.procs) {
+    if (p.chip == chip) total += p.want_duty;
+  }
+  return total;
+}
+
+// Effective duty share of one process after proportional contention scaling.
+double proc_duty_locked(const MockProc& p) {
+  double total = chip_want_locked(p.chip);
+  if (total <= 0.0) return 0.0;
+  double cap = (double)g_state.chips[p.chip].duty_hard_limit;
+  double scale = total > cap ? cap / total : 1.0;
+  return p.want_duty * scale;
+}
+
+// Torus hop distance along one axis.
+int torus_hops(int a, int b, int extent) {
+  int d = abs(a - b);
+  return d < extent - d ? d : extent - d;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------------
+// Mock control surface
+// ---------------------------------------------------------------------
+
+TPF_API void tpf_mock_reset(void) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  build_host_locked();
+}
+
+TPF_API tpf_status_t tpf_mock_proc_set(int64_t pid, const char* chip_id,
+                                       double duty_pct, uint64_t hbm_bytes) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_state.initialized) return TPF_ERR_NOT_INITIALIZED;
+  int chip = find_chip_locked(chip_id);
+  if (chip < 0) return TPF_ERR_NOT_FOUND;
+  for (auto& p : g_state.procs) {
+    if (p.pid == pid && p.chip == chip) {
+      p.want_duty = duty_pct;
+      p.hbm_bytes = hbm_bytes;
+      return TPF_OK;
+    }
+  }
+  if (g_state.procs.size() >= TPF_MOCK_MAX_PROCS) return TPF_ERR_EXHAUSTED;
+  MockProc p;
+  p.pid = pid;
+  p.chip = chip;
+  p.want_duty = duty_pct;
+  p.hbm_bytes = hbm_bytes;
+  g_state.procs.push_back(p);
+  return TPF_OK;
+}
+
+TPF_API tpf_status_t tpf_mock_proc_remove(int64_t pid) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  size_t before = g_state.procs.size();
+  for (size_t i = g_state.procs.size(); i-- > 0;) {
+    if (g_state.procs[i].pid == pid)
+      g_state.procs.erase(g_state.procs.begin() + i);
+  }
+  return g_state.procs.size() < before ? TPF_OK : TPF_ERR_NOT_FOUND;
+}
+
+TPF_API void tpf_mock_tick(double seconds) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_state.clock_s += seconds;
+  for (auto& p : g_state.procs) {
+    // ~25 program launches per second of busy time.
+    p.launches += (uint64_t)(seconds * 25.0 * proc_duty_locked(p) / 100.0);
+  }
+  for (size_t i = 0; i < g_state.chips.size(); ++i) {
+    MockChip& c = g_state.chips[i];
+    double duty = 0;
+    for (const auto& p : g_state.procs)
+      if (p.chip == (int)i) duty += proc_duty_locked(p);
+    c.ici_tx += (uint64_t)(seconds * duty * 1.0e7);
+    c.ici_rx += (uint64_t)(seconds * duty * 1.0e7);
+  }
+}
+
+TPF_API int32_t tpf_mock_partition_count(const char* chip_id) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  int chip = find_chip_locked(chip_id);
+  if (chip < 0) return -1;
+  return (int32_t)g_state.chips[chip].partitions.size();
+}
+
+TPF_API uint64_t tpf_mock_hbm_hard_limit(const char* chip_id) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  int chip = find_chip_locked(chip_id);
+  return chip < 0 ? 0 : g_state.chips[chip].hbm_hard_limit;
+}
+
+TPF_API uint32_t tpf_mock_duty_hard_limit(const char* chip_id) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  int chip = find_chip_locked(chip_id);
+  return chip < 0 ? 0 : g_state.chips[chip].duty_hard_limit;
+}
+
+// ---------------------------------------------------------------------
+// Provider ABI
+// ---------------------------------------------------------------------
+
+TPF_API uint32_t tpf_abi_version(void) { return TPF_PROVIDER_ABI_VERSION; }
+
+TPF_API tpf_status_t tpf_init(void) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_state.initialized) {
+    build_host_locked();
+    g_state.initialized = true;
+  }
+  logf("info", "mock provider initialized: %zu %s chips (%dx%d mesh)",
+       g_state.chips.size(), g_state.gen.name, g_state.mesh_x, g_state.mesh_y);
+  return TPF_OK;
+}
+
+TPF_API tpf_status_t tpf_shutdown(void) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_state.initialized = false;
+  g_state.chips.clear();
+  g_state.procs.clear();
+  return TPF_OK;
+}
+
+TPF_API tpf_status_t tpf_chip_count(size_t* count) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_state.initialized) return TPF_ERR_NOT_INITIALIZED;
+  if (!count) return TPF_ERR_INVALID_ARG;
+  *count = g_state.chips.size();
+  return TPF_OK;
+}
+
+TPF_API tpf_status_t tpf_enumerate(tpf_chip_info_t* chips, size_t max_count,
+                                   size_t* count) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_state.initialized) return TPF_ERR_NOT_INITIALIZED;
+  if (!chips || !count) return TPF_ERR_INVALID_ARG;
+  size_t n = g_state.chips.size() < max_count ? g_state.chips.size()
+                                              : max_count;
+  for (size_t i = 0; i < n; ++i) chips[i] = g_state.chips[i].info;
+  *count = n;
+  return TPF_OK;
+}
+
+TPF_API tpf_status_t tpf_topology(tpf_topology_t* topology) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_state.initialized) return TPF_ERR_NOT_INITIALIZED;
+  if (!topology) return TPF_ERR_INVALID_ARG;
+  memset(topology, 0, sizeof(*topology));
+  topology->mesh_shape[0] = g_state.mesh_x;
+  topology->mesh_shape[1] = g_state.mesh_y;
+  topology->mesh_shape[2] = 1;
+  topology->wraparound[0] = g_state.mesh_x > 2;
+  topology->wraparound[1] = g_state.mesh_y > 2;
+  topology->wraparound[2] = 0;
+  size_t n = g_state.chips.size();
+  topology->row_count = n;
+  for (size_t i = 0; i < n; ++i) {
+    const tpf_chip_info_t& a = g_state.chips[i].info;
+    tpf_topo_row_t& row = topology->rows[i];
+    snprintf(row.chip_id, sizeof(row.chip_id), "%s", a.chip_id);
+    row.index = a.host_index;
+    row.mesh_x = a.mesh_x;
+    row.mesh_y = a.mesh_y;
+    row.mesh_z = a.mesh_z;
+    row.link_count = n;
+    for (size_t j = 0; j < n; ++j) {
+      const tpf_chip_info_t& b = g_state.chips[j].info;
+      tpf_link_t& l = row.links[j];
+      snprintf(l.peer_chip_id, sizeof(l.peer_chip_id), "%s", b.chip_id);
+      l.peer_index = b.host_index;
+      if (i == j) {
+        l.kind = TPF_LINK_SELF;
+        l.hops = 0;
+        l.gbps = 0;
+        continue;
+      }
+      int hx = topology->wraparound[0]
+                   ? torus_hops(a.mesh_x, b.mesh_x, g_state.mesh_x)
+                   : abs(a.mesh_x - b.mesh_x);
+      int hy = topology->wraparound[1]
+                   ? torus_hops(a.mesh_y, b.mesh_y, g_state.mesh_y)
+                   : abs(a.mesh_y - b.mesh_y);
+      l.hops = hx + hy;
+      l.kind = l.hops <= 1 ? TPF_LINK_ICI : TPF_LINK_ICI_ROUTED;
+      l.gbps = g_state.gen.ici_gbps / (l.hops > 0 ? l.hops : 1);
+    }
+  }
+  return TPF_OK;
+}
+
+TPF_API tpf_status_t tpf_partition_templates(const char* chip_id,
+                                             tpf_partition_template_t* out,
+                                             size_t max_count, size_t* count) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_state.initialized) return TPF_ERR_NOT_INITIALIZED;
+  if (!chip_id || !out || !count) return TPF_ERR_INVALID_ARG;
+  int chip = find_chip_locked(chip_id);
+  if (chip < 0) return TPF_ERR_NOT_FOUND;
+  const tpf_chip_info_t& ci = g_state.chips[chip].info;
+  size_t n = 0;
+  // One template per power-of-two core count up to the full chip.
+  for (int cores = 1; cores <= ci.core_count && n < max_count; cores *= 2) {
+    tpf_partition_template_t& t = out[n++];
+    memset(&t, 0, sizeof(t));
+    snprintf(t.template_id, sizeof(t.template_id), "%s-%dc", ci.generation,
+             cores);
+    snprintf(t.name, sizeof(t.name), "%s %d-core partition", ci.generation,
+             cores);
+    t.core_count = cores;
+    t.hbm_bytes = ci.hbm_bytes * (uint64_t)cores / (uint64_t)ci.core_count;
+    t.bf16_tflops = ci.peak_bf16_tflops * cores / ci.core_count;
+    t.slots = (uint32_t)(ci.core_count / cores);
+    t.is_default = cores == ci.core_count;
+  }
+  *count = n;
+  return TPF_OK;
+}
+
+TPF_API tpf_status_t tpf_partition_create(const char* template_id,
+                                          const char* chip_id,
+                                          tpf_partition_grant_t* grant) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_state.initialized) return TPF_ERR_NOT_INITIALIZED;
+  if (!template_id || !chip_id || !grant) return TPF_ERR_INVALID_ARG;
+  int chip = find_chip_locked(chip_id);
+  if (chip < 0) return TPF_ERR_NOT_FOUND;
+  MockChip& c = g_state.chips[chip];
+  int cores = 1;
+  const char* dash = strrchr(template_id, '-');
+  if (dash && dash[1] >= '1' && dash[1] <= '9') cores = atoi(dash + 1);
+  // Find the first free contiguous core range (destroy can leave holes).
+  uint64_t used_mask = 0;
+  for (const auto& p : c.partitions)
+    for (int k = 0; k < p.core_count; ++k) used_mask |= 1ull << (p.core + k);
+  int start = -1;
+  for (int s = 0; s + cores <= c.info.core_count; ++s) {
+    uint64_t range = ((1ull << cores) - 1) << s;
+    if ((used_mask & range) == 0) {
+      start = s;
+      break;
+    }
+  }
+  if (start < 0) return TPF_ERR_EXHAUSTED;
+
+  MockPartition part;
+  part.template_id = template_id;
+  part.core = start;
+  part.core_count = cores;
+  char pid_buf[TPF_ID_LEN];
+  snprintf(pid_buf, sizeof(pid_buf), "%s-p%zu", chip_id, c.partitions.size());
+  part.partition_id = pid_buf;
+  c.partitions.push_back(part);
+
+  memset(grant, 0, sizeof(*grant));
+  grant->kind = TPF_GRANT_ENV;
+  snprintf(grant->chip_id, sizeof(grant->chip_id), "%s", chip_id);
+  snprintf(grant->partition_id, sizeof(grant->partition_id), "%s",
+           pid_buf);
+  snprintf(grant->env[0], TPF_ENV_LEN, "TPU_VISIBLE_CHIPS=%d",
+           c.info.host_index);
+  snprintf(grant->env[1], TPF_ENV_LEN, "TPF_VISIBLE_CORES=%d-%d", part.core,
+           part.core + cores - 1);
+  snprintf(grant->env[2], TPF_ENV_LEN, "TPF_PARTITION_ID=%s", pid_buf);
+  grant->env_count = 3;
+  snprintf(grant->device_nodes[0], sizeof(grant->device_nodes[0]),
+           "%s=/dev/accel0", c.info.device_path);
+  grant->device_node_count = 1;
+  return TPF_OK;
+}
+
+TPF_API tpf_status_t tpf_partition_destroy(const char* template_id,
+                                           const char* chip_id) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_state.initialized) return TPF_ERR_NOT_INITIALIZED;
+  if (!template_id || !chip_id) return TPF_ERR_INVALID_ARG;
+  int chip = find_chip_locked(chip_id);
+  if (chip < 0) return TPF_ERR_NOT_FOUND;
+  auto& parts = g_state.chips[chip].partitions;
+  for (size_t i = 0; i < parts.size(); ++i) {
+    if (parts[i].template_id == template_id ||
+        parts[i].partition_id == template_id) {
+      parts.erase(parts.begin() + i);
+      return TPF_OK;
+    }
+  }
+  return TPF_ERR_NOT_FOUND;
+}
+
+TPF_API tpf_status_t tpf_set_hbm_hard_limit(const char* chip_id,
+                                            uint64_t limit_bytes) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_state.initialized) return TPF_ERR_NOT_INITIALIZED;
+  int chip = find_chip_locked(chip_id);
+  if (chip < 0) return TPF_ERR_NOT_FOUND;
+  g_state.chips[chip].hbm_hard_limit = limit_bytes;
+  return TPF_OK;
+}
+
+TPF_API tpf_status_t tpf_set_duty_hard_limit(const char* chip_id,
+                                             uint32_t duty_pct) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_state.initialized) return TPF_ERR_NOT_INITIALIZED;
+  if (duty_pct > 100) return TPF_ERR_INVALID_ARG;
+  int chip = find_chip_locked(chip_id);
+  if (chip < 0) return TPF_ERR_NOT_FOUND;
+  g_state.chips[chip].duty_hard_limit = duty_pct;
+  return TPF_OK;
+}
+
+TPF_API tpf_status_t tpf_snapshot(const tpf_snapshot_ctx_t* ctx) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_state.initialized) return TPF_ERR_NOT_INITIALIZED;
+  if (!ctx || !ctx->state_dir) return TPF_ERR_INVALID_ARG;
+  if (!ctx->chip_id && ctx->pid_count == 0) return TPF_ERR_INVALID_ARG;
+  char path[TPF_PATH_LEN];
+  snprintf(path, sizeof(path), "%s/%s.tpfsnap", ctx->state_dir,
+           ctx->chip_id ? ctx->chip_id : "procs");
+  FILE* f = fopen(path, "w");
+  if (!f) return TPF_ERR_FAILED;
+  if (ctx->chip_id) {
+    int chip = find_chip_locked(ctx->chip_id);
+    if (chip < 0) {
+      fclose(f);
+      return TPF_ERR_NOT_FOUND;
+    }
+    g_state.chips[chip].frozen = true;
+    fprintf(f, "chip %s\n", ctx->chip_id);
+    for (const auto& p : g_state.procs) {
+      if (p.chip == chip)
+        fprintf(f, "proc %lld %f %llu\n", (long long)p.pid, p.want_duty,
+                (unsigned long long)p.hbm_bytes);
+    }
+  } else {
+    for (size_t i = 0; i < ctx->pid_count; ++i)
+      fprintf(f, "pid %lld\n", (long long)ctx->pids[i]);
+  }
+  fclose(f);
+  return TPF_OK;
+}
+
+TPF_API tpf_status_t tpf_restore(const tpf_snapshot_ctx_t* ctx) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_state.initialized) return TPF_ERR_NOT_INITIALIZED;
+  if (!ctx || !ctx->state_dir) return TPF_ERR_INVALID_ARG;
+  char path[TPF_PATH_LEN];
+  snprintf(path, sizeof(path), "%s/%s.tpfsnap", ctx->state_dir,
+           ctx->chip_id ? ctx->chip_id : "procs");
+  FILE* f = fopen(path, "r");
+  if (!f) return TPF_ERR_NOT_FOUND;
+  fclose(f);
+  if (ctx->chip_id) {
+    int chip = find_chip_locked(ctx->chip_id);
+    if (chip < 0) return TPF_ERR_NOT_FOUND;
+    g_state.chips[chip].frozen = false;
+  }
+  return TPF_OK;
+}
+
+TPF_API tpf_status_t tpf_proc_stats(tpf_proc_stats_t* out, size_t max_count,
+                                    size_t* count) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_state.initialized) return TPF_ERR_NOT_INITIALIZED;
+  if (!out || !count) return TPF_ERR_INVALID_ARG;
+  size_t n = 0;
+  for (const auto& p : g_state.procs) {
+    if (n >= max_count) break;
+    tpf_proc_stats_t& s = out[n++];
+    memset(&s, 0, sizeof(s));
+    s.pid = p.pid;
+    snprintf(s.chip_id, sizeof(s.chip_id), "%s",
+             g_state.chips[p.chip].info.chip_id);
+    s.duty_cycle_pct = proc_duty_locked(p);
+    s.hbm_used_bytes = p.hbm_bytes;
+    s.hbm_reserved_bytes = p.hbm_bytes;
+    s.programs_launched = p.launches;
+  }
+  *count = n;
+  return TPF_OK;
+}
+
+TPF_API tpf_status_t tpf_chip_metrics(const char** chip_ids, size_t chip_count,
+                                      tpf_chip_metrics_t* out) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_state.initialized) return TPF_ERR_NOT_INITIALIZED;
+  if (!chip_ids || !out) return TPF_ERR_INVALID_ARG;
+  for (size_t i = 0; i < chip_count; ++i) {
+    int chip = find_chip_locked(chip_ids[i]);
+    if (chip < 0) return TPF_ERR_NOT_FOUND;
+    const MockChip& c = g_state.chips[chip];
+    tpf_chip_metrics_t& m = out[i];
+    memset(&m, 0, sizeof(m));
+    snprintf(m.chip_id, sizeof(m.chip_id), "%s", c.info.chip_id);
+    double duty = 0;
+    uint64_t hbm = 0;
+    for (const auto& p : g_state.procs) {
+      if (p.chip == chip) {
+        duty += proc_duty_locked(p);
+        hbm += p.hbm_bytes;
+      }
+    }
+    if (duty > 100.0) duty = 100.0;
+    m.duty_cycle_pct = duty;
+    m.hbm_used_bytes = hbm;
+    m.hbm_bw_util_pct = duty * 0.8;
+    m.power_watts = 60.0 + 2.0 * duty;
+    m.temp_celsius = 35.0 + 0.4 * duty;
+    m.ici_tx_bytes = c.ici_tx;
+    m.ici_rx_bytes = c.ici_rx;
+    snprintf(m.extra[0].key, sizeof(m.extra[0].key), "mock_clock_s");
+    m.extra[0].value = g_state.clock_s;
+    m.extra_count = 1;
+  }
+  return TPF_OK;
+}
+
+TPF_API tpf_status_t tpf_mounts(tpf_mount_t* out, size_t max_count,
+                                size_t* count) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_state.initialized) return TPF_ERR_NOT_INITIALIZED;
+  if (!out || !count || max_count < 1) return TPF_ERR_INVALID_ARG;
+  snprintf(out[0].host_path, sizeof(out[0].host_path),
+           "/usr/lib/tpufusion/libtpf_mock_rt.so");
+  snprintf(out[0].guest_path, sizeof(out[0].guest_path),
+           "/usr/lib/libtpf_rt.so");
+  *count = 1;
+  return TPF_OK;
+}
+
+TPF_API tpf_status_t tpf_set_log_sink(tpf_log_fn sink) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_state.log_sink = sink;
+  return TPF_OK;
+}
+
+}  // extern "C"

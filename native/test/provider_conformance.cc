@@ -1,0 +1,179 @@
+// Provider ABI conformance test: dlopen()s any tpu-fusion provider .so and
+// exercises every entry point (role analog of the reference's
+// provider/test/test_accelerator.c, rebuilt for the TPU ABI).
+//
+//   usage: provider_conformance <path-to-provider.so>
+
+#include <dlfcn.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <vector>
+
+#include "tpufusion/provider.h"
+
+#define CHECK(cond)                                                      \
+  do {                                                                   \
+    if (!(cond)) {                                                       \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond);    \
+      exit(1);                                                           \
+    }                                                                    \
+  } while (0)
+
+#define RESOLVE(name)                                             \
+  name##_fn name = (name##_fn)dlsym(lib, #name);                  \
+  CHECK(name != nullptr)
+
+typedef uint32_t (*tpf_abi_version_fn)(void);
+typedef tpf_status_t (*tpf_init_fn)(void);
+typedef tpf_status_t (*tpf_shutdown_fn)(void);
+typedef tpf_status_t (*tpf_chip_count_fn)(size_t*);
+typedef tpf_status_t (*tpf_enumerate_fn)(tpf_chip_info_t*, size_t, size_t*);
+typedef tpf_status_t (*tpf_topology_fn)(tpf_topology_t*);
+typedef tpf_status_t (*tpf_partition_templates_fn)(const char*,
+                                                   tpf_partition_template_t*,
+                                                   size_t, size_t*);
+typedef tpf_status_t (*tpf_partition_create_fn)(const char*, const char*,
+                                                tpf_partition_grant_t*);
+typedef tpf_status_t (*tpf_partition_destroy_fn)(const char*, const char*);
+typedef tpf_status_t (*tpf_set_hbm_hard_limit_fn)(const char*, uint64_t);
+typedef tpf_status_t (*tpf_set_duty_hard_limit_fn)(const char*, uint32_t);
+typedef tpf_status_t (*tpf_snapshot_fn)(const tpf_snapshot_ctx_t*);
+typedef tpf_status_t (*tpf_restore_fn)(const tpf_snapshot_ctx_t*);
+typedef tpf_status_t (*tpf_proc_stats_fn)(tpf_proc_stats_t*, size_t, size_t*);
+typedef tpf_status_t (*tpf_chip_metrics_fn)(const char**, size_t,
+                                            tpf_chip_metrics_t*);
+typedef tpf_status_t (*tpf_mounts_fn)(tpf_mount_t*, size_t, size_t*);
+typedef tpf_status_t (*tpf_set_log_sink_fn)(tpf_log_fn);
+
+static int g_log_calls = 0;
+static void log_sink(const char* level, const char* msg) {
+  ++g_log_calls;
+  fprintf(stderr, "[provider %s] %s\n", level, msg);
+}
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <provider.so>\n", argv[0]);
+    return 2;
+  }
+  void* lib = dlopen(argv[1], RTLD_NOW | RTLD_LOCAL);
+  if (!lib) {
+    fprintf(stderr, "dlopen: %s\n", dlerror());
+    return 2;
+  }
+
+  RESOLVE(tpf_abi_version);
+  RESOLVE(tpf_init);
+  RESOLVE(tpf_shutdown);
+  RESOLVE(tpf_chip_count);
+  RESOLVE(tpf_enumerate);
+  RESOLVE(tpf_topology);
+  RESOLVE(tpf_partition_templates);
+  RESOLVE(tpf_partition_create);
+  RESOLVE(tpf_partition_destroy);
+  RESOLVE(tpf_set_hbm_hard_limit);
+  RESOLVE(tpf_set_duty_hard_limit);
+  RESOLVE(tpf_snapshot);
+  RESOLVE(tpf_restore);
+  RESOLVE(tpf_proc_stats);
+  RESOLVE(tpf_chip_metrics);
+  RESOLVE(tpf_mounts);
+  RESOLVE(tpf_set_log_sink);
+
+  CHECK(tpf_abi_version() == TPF_PROVIDER_ABI_VERSION);
+
+  // Calls before init must fail cleanly.
+  size_t count = 0;
+  CHECK(tpf_chip_count(&count) == TPF_ERR_NOT_INITIALIZED);
+
+  CHECK(tpf_set_log_sink(log_sink) == TPF_OK);
+  CHECK(tpf_init() == TPF_OK);
+  CHECK(tpf_init() == TPF_OK);  // idempotent
+
+  CHECK(tpf_chip_count(&count) == TPF_OK);
+  CHECK(count >= 1);
+
+  std::vector<tpf_chip_info_t> chips(count);
+  size_t got = 0;
+  CHECK(tpf_enumerate(chips.data(), count, &got) == TPF_OK);
+  CHECK(got == count);
+  for (size_t i = 0; i < got; ++i) {
+    CHECK(chips[i].chip_id[0] != '\0');
+    CHECK(chips[i].hbm_bytes > 0);
+    CHECK(chips[i].peak_bf16_tflops > 0);
+    CHECK(chips[i].core_count >= 1);
+  }
+
+  auto* topo = new tpf_topology_t;
+  CHECK(tpf_topology(topo) == TPF_OK);
+  CHECK(topo->row_count == count);
+  CHECK((size_t)(topo->mesh_shape[0] * topo->mesh_shape[1] *
+                 topo->mesh_shape[2]) >= count);
+  // Self link must be SELF with 0 hops; peers must be classified.
+  for (size_t i = 0; i < topo->row_count; ++i) {
+    CHECK(topo->rows[i].link_count == count);
+    for (size_t j = 0; j < count; ++j) {
+      const tpf_link_t& l = topo->rows[i].links[j];
+      if (i == j) {
+        CHECK(l.kind == TPF_LINK_SELF && l.hops == 0);
+      } else {
+        CHECK(l.kind != TPF_LINK_SELF);
+      }
+    }
+  }
+
+  const char* chip0 = chips[0].chip_id;
+
+  tpf_partition_template_t templates[TPF_MAX_TEMPLATES];
+  size_t tmpl_count = 0;
+  CHECK(tpf_partition_templates(chip0, templates, TPF_MAX_TEMPLATES,
+                                &tmpl_count) == TPF_OK);
+  CHECK(tmpl_count >= 1);
+
+  tpf_partition_grant_t grant;
+  CHECK(tpf_partition_create(templates[0].template_id, chip0, &grant) ==
+        TPF_OK);
+  CHECK(grant.env_count > 0 || grant.device_node_count > 0);
+  CHECK(tpf_partition_destroy(grant.partition_id, chip0) == TPF_OK);
+  CHECK(tpf_partition_destroy(grant.partition_id, chip0) ==
+        TPF_ERR_NOT_FOUND);
+
+  CHECK(tpf_set_hbm_hard_limit(chip0, 1ull << 30) == TPF_OK);
+  CHECK(tpf_set_duty_hard_limit(chip0, 50) == TPF_OK);
+  CHECK(tpf_set_duty_hard_limit(chip0, 100) == TPF_OK);
+  CHECK(tpf_set_duty_hard_limit("no-such-chip", 50) == TPF_ERR_NOT_FOUND);
+
+  char state_dir[] = "/tmp/tpf_conformance_XXXXXX";
+  CHECK(mkdtemp(state_dir) != nullptr);
+  tpf_snapshot_ctx_t snap{};
+  snap.chip_id = chip0;
+  snap.state_dir = state_dir;
+  CHECK(tpf_snapshot(&snap) == TPF_OK);
+  CHECK(tpf_restore(&snap) == TPF_OK);
+
+  tpf_proc_stats_t procs[64];
+  size_t proc_count = 0;
+  CHECK(tpf_proc_stats(procs, 64, &proc_count) == TPF_OK);
+
+  std::vector<const char*> ids;
+  for (auto& c : chips) ids.push_back(c.chip_id);
+  std::vector<tpf_chip_metrics_t> metrics(count);
+  CHECK(tpf_chip_metrics(ids.data(), count, metrics.data()) == TPF_OK);
+  for (size_t i = 0; i < count; ++i) {
+    CHECK(strcmp(metrics[i].chip_id, ids[i]) == 0);
+    CHECK(metrics[i].duty_cycle_pct >= 0 && metrics[i].duty_cycle_pct <= 100);
+  }
+
+  tpf_mount_t mounts[8];
+  size_t mount_count = 0;
+  CHECK(tpf_mounts(mounts, 8, &mount_count) == TPF_OK);
+
+  CHECK(tpf_shutdown() == TPF_OK);
+  CHECK(tpf_chip_count(&count) == TPF_ERR_NOT_INITIALIZED);
+
+  printf("PASS: %zu chips, %zu templates, log_calls=%d\n", got, tmpl_count,
+         g_log_calls);
+  return 0;
+}

@@ -1,0 +1,25 @@
+"""tpu-fusion: a TPU-native accelerator virtualization and pooling platform.
+
+A from-scratch rebuild of the capabilities of NexusGPU/tensor-fusion
+(reference at /root/reference) designed TPU-first:
+
+- fractional vTPU allocation: HBM byte budgets + MXU duty-cycle shares,
+  metered at XLA *program launch* granularity (not per CUDA kernel);
+- a vendor-neutral C provider ABI over libtpu/PJRT semantics
+  (``native/include/tpufusion/provider.h``) with a mock v5e-8 provider for
+  hardware-free testing;
+- a C++ soft-limiter (``libtpf_limiter.so``) driving lock-free shared-memory
+  token buckets, steered by an elastic-rate-limit (ERL) PID controller in the
+  node hypervisor;
+- an accelerator-first scheduler with ICI-mesh topology awareness (contiguous
+  sub-slice search) and gang scheduling for whole pod-slices;
+- remote-vTPU sharing over Ethernet/DCN (StableHLO-level remoting);
+- pooling, oversubscription, quotas, autoscaling, defragmentation,
+  snapshot/resume live migration.
+
+The control plane is Python (the reference's is Go); the device-touching
+runtime (provider, limiter) is C++; the compute path of hosted workloads is
+JAX/XLA.
+"""
+
+__version__ = "0.1.0"

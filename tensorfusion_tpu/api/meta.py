@@ -1,0 +1,132 @@
+"""Object metadata and the resource base class.
+
+The tpu-fusion control plane keeps its state in typed Python resources
+modeled after the reference's CRD layer (NexusGPU/tensor-fusion ``api/v1/``):
+every object has metadata (name/namespace/labels/annotations/uid/
+resourceVersion), a spec, and a status with phase + conditions.  A generic
+dataclass serde (``to_dict``/``from_dict``) replaces Go's generated deepcopy.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import time
+import typing
+import uuid as uuid_mod
+from dataclasses import dataclass, field
+
+
+def _from_value(tp, value):
+    """Recursively build a value of (possibly generic) type ``tp``."""
+    if value is None:
+        return None
+    origin = typing.get_origin(tp)
+    if origin is typing.Union:  # Optional[X]
+        args = [a for a in typing.get_args(tp) if a is not type(None)]
+        return _from_value(args[0], value) if args else value
+    if origin in (list, tuple):
+        (item_tp,) = typing.get_args(tp) or (typing.Any,)
+        seq = [_from_value(item_tp, v) for v in value]
+        return tuple(seq) if origin is tuple else seq
+    if origin is dict:
+        args = typing.get_args(tp)
+        val_tp = args[1] if len(args) == 2 else typing.Any
+        return {k: _from_value(val_tp, v) for k, v in value.items()}
+    if dataclasses.is_dataclass(tp) and isinstance(value, dict):
+        return from_dict(tp, value)
+    return value
+
+
+def from_dict(cls, data: dict):
+    """Construct dataclass ``cls`` from a plain dict, ignoring unknown keys."""
+    if data is None:
+        return None
+    hints = typing.get_type_hints(cls)
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name in data:
+            kwargs[f.name] = _from_value(hints.get(f.name, typing.Any),
+                                         data[f.name])
+    return cls(**kwargs)
+
+
+def to_dict(obj) -> dict:
+    return dataclasses.asdict(obj)
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = ""
+    uid: str = ""
+    resource_version: int = 0
+    generation: int = 0
+    creation_timestamp: float = 0.0
+    deletion_timestamp: float = 0.0
+    labels: typing.Dict[str, str] = field(default_factory=dict)
+    annotations: typing.Dict[str, str] = field(default_factory=dict)
+    finalizers: typing.List[str] = field(default_factory=list)
+    owner_references: typing.List[str] = field(default_factory=list)  # "Kind/ns/name"
+
+
+@dataclass
+class Condition:
+    type: str = ""
+    status: str = "Unknown"  # "True" | "False" | "Unknown"
+    reason: str = ""
+    message: str = ""
+    last_transition_time: float = 0.0
+
+
+def set_condition(conditions: typing.List[Condition], ctype: str, status: str,
+                  reason: str = "", message: str = "") -> None:
+    for c in conditions:
+        if c.type == ctype:
+            if c.status != status:
+                c.last_transition_time = time.time()
+            c.status, c.reason, c.message = status, reason, message
+            return
+    conditions.append(Condition(type=ctype, status=status, reason=reason,
+                                message=message,
+                                last_transition_time=time.time()))
+
+
+@dataclass
+class Resource:
+    """Base for all tpu-fusion API objects."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+
+    KIND: typing.ClassVar[str] = "Resource"
+    NAMESPACED: typing.ClassVar[bool] = False
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    def key(self) -> str:
+        if self.NAMESPACED:
+            return f"{self.metadata.namespace}/{self.metadata.name}"
+        return self.metadata.name
+
+    def deepcopy(self):
+        return copy.deepcopy(self)
+
+    def to_dict(self) -> dict:
+        d = to_dict(self)
+        d["kind"] = self.KIND
+        return d
+
+    @classmethod
+    def new(cls, name: str, namespace: str = "", **kwargs):
+        obj = cls(**kwargs)
+        obj.metadata.name = name
+        obj.metadata.namespace = namespace if cls.NAMESPACED else ""
+        obj.metadata.uid = uuid_mod.uuid4().hex
+        obj.metadata.creation_timestamp = time.time()
+        return obj

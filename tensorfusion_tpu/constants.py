@@ -1,0 +1,223 @@
+"""The tpu-fusion annotation / label / env contract.
+
+TPU-native analog of the reference's ``pkg/constants`` package
+(NexusGPU/tensor-fusion ``pkg/constants/constants.go:26-294``,
+``env.go``, ``vendors.go:46-140``): one domain prefix owns every
+annotation, label, finalizer and env var the platform reads or stamps.
+Names are re-based on TPU resources — HBM bytes instead of VRAM, MXU
+duty share instead of SM compute percent, chips instead of GPUs, ICI
+topology instead of NVLink.
+"""
+
+import os
+
+# --------------------------------------------------------------------------
+# Domain
+# --------------------------------------------------------------------------
+
+DOMAIN_PREFIX = os.environ.get("TPF_DOMAIN_PREFIX", "tpu-fusion")
+DOMAIN_SUFFIX = os.environ.get("TPF_DOMAIN_SUFFIX", "ai")
+DOMAIN = f"{DOMAIN_PREFIX}.{DOMAIN_SUFFIX}"
+
+FINALIZER = f"{DOMAIN}/finalizer"
+SCHEDULER_NAME = f"{DOMAIN_PREFIX}-scheduler"
+
+# --------------------------------------------------------------------------
+# Ownership / component labels
+# --------------------------------------------------------------------------
+
+LABEL_MANAGED_BY = f"{DOMAIN}/managed-by"
+LABEL_CLUSTER_OWNER = f"{DOMAIN}/cluster"
+LABEL_NODE_CLASS = f"{DOMAIN}/node-class"
+LABEL_POD_TEMPLATE_HASH = f"{DOMAIN}/pod-template-hash"
+LABEL_NODE_SELECTOR_HASH = f"{DOMAIN}/node-selector-hash"
+LABEL_COMPONENT = f"{DOMAIN}/component"
+LABEL_WORKER_NAME = f"{DOMAIN}/worker-name"
+LABEL_ENABLED = f"{DOMAIN}/enabled"
+LABEL_NODE_POOL_PREFIX = f"{DOMAIN}/pool-"
+LABEL_NODE_SHOULD_DELETE = f"{DOMAIN}/should-delete"
+LABEL_USED_BY_TAINT = f"{DOMAIN}/used-by"
+LABEL_HOST_PORT = f"{DOMAIN}/host-port"          # value "auto" requests one
+LABEL_HOST_PORT_AUTO = "auto"
+LABEL_PORT_NAME = f"{DOMAIN}/port-name"
+LABEL_DO_NOT_DISRUPT = f"{DOMAIN}/do-not-disrupt"
+LABEL_EXPANSION_SOURCE = f"{DOMAIN}/expansion-source"
+
+COMPONENT_CLIENT = "client"
+COMPONENT_WORKER = "worker"
+COMPONENT_HYPERVISOR = "hypervisor"
+COMPONENT_NODE_DISCOVERY = "node-discovery"
+
+# --------------------------------------------------------------------------
+# Workload request annotations (user-facing contract, parsed by admission)
+# --------------------------------------------------------------------------
+
+ANN_POOL = f"{DOMAIN}/pool"
+ANN_WORKLOAD = f"{DOMAIN}/workload"
+ANN_WORKLOAD_PROFILE = f"{DOMAIN}/workload-profile"
+ANN_WORKLOAD_MODE = f"{DOMAIN}/workload-mode"    # dynamic | fixed
+ANN_ENABLED_REPLICAS = f"{DOMAIN}/enabled-replicas"
+ANN_IS_DEFAULT_POOL = f"{DOMAIN}/is-default-pool"
+
+ANN_TFLOPS_REQUEST = f"{DOMAIN}/tflops-request"
+ANN_TFLOPS_LIMIT = f"{DOMAIN}/tflops-limit"
+ANN_HBM_REQUEST = f"{DOMAIN}/hbm-request"
+ANN_HBM_LIMIT = f"{DOMAIN}/hbm-limit"
+ANN_DUTY_REQUEST = f"{DOMAIN}/duty-percent-request"   # MXU duty share 0-100
+ANN_DUTY_LIMIT = f"{DOMAIN}/duty-percent-limit"
+
+ANN_CHIP_COUNT = f"{DOMAIN}/chip-count"
+ANN_CHIP_INDICES = f"{DOMAIN}/chip-indices"
+ANN_CHIP_GENERATION = f"{DOMAIN}/generation"     # e.g. "v5e", "v5p"
+ANN_VENDOR = f"{DOMAIN}/vendor"
+ANN_QOS = f"{DOMAIN}/qos"
+ANN_ISOLATION = f"{DOMAIN}/isolation"
+ANN_IS_LOCAL_TPU = f"{DOMAIN}/is-local-tpu"
+ANN_DEDICATED_CHIP = f"{DOMAIN}/dedicated-chip"
+ANN_DEDICATED_WORKER = f"{DOMAIN}/dedicated-worker"
+ANN_EMBEDDED_WORKER = f"{DOMAIN}/embedded-worker"
+ANN_SIDECAR_WORKER = f"{DOMAIN}/sidecar-worker"
+ANN_INJECT_CONTAINER = f"{DOMAIN}/inject-container"
+ANN_DISABLE_FEATURES = f"{DOMAIN}/disable-features"
+ANN_EVICTION_PROTECTION = f"{DOMAIN}/eviction-protection"
+ANN_AUTOSCALE = f"{DOMAIN}/autoscale"
+ANN_AUTOSCALE_TARGET = f"{DOMAIN}/autoscale-target"
+ANN_PRICING = f"{DOMAIN}/hourly-pricing"
+ANN_PORT_NUMBER = f"{DOMAIN}/port-number"
+
+# --------------------------------------------------------------------------
+# Scheduler / allocator bookkeeping annotations (stamped by the platform)
+# --------------------------------------------------------------------------
+
+ANN_CHIP_IDS = f"{DOMAIN}/chip-ids"              # comma-joined allocated ids
+ANN_CONTAINER_CHIP_COUNT = f"{DOMAIN}/container-chip-count"
+ANN_CONTAINER_CHIPS = f"{DOMAIN}/container-chips"  # json: container -> ids
+ANN_POD_INDEX = f"{DOMAIN}/index"
+ANN_PARTITION_NAME = f"{DOMAIN}/partition"       # template id, partitioned mode
+ANN_PARTITION_ID = f"{DOMAIN}/partition-id"      # provider-assigned instance
+ANN_PARTITION_IDS = f"{DOMAIN}/partition-ids"    # json: chip id -> instance id
+ANN_CHIP_RELEASED = f"{DOMAIN}/chip-released"
+ANN_LAST_SYNC = f"{DOMAIN}/last-sync"
+ANN_SELECTED_WORKLOAD = f"{DOMAIN}/selected-workload"
+ANN_PENDING_OWNED_WORKLOAD = f"{DOMAIN}/pending-owned-workload"
+ANN_WORKER_POD_TEMPLATE = f"{DOMAIN}/worker-pod-template"
+ANN_POD_COUNTER_KEY = f"{DOMAIN}/pod-counter-key"
+ANN_POD_COUNT = f"{DOMAIN}/tpf-pod-count"
+ANN_VIRT_CAPABILITIES = f"{DOMAIN}/virtualization-capabilities"
+ANN_PROVIDER_CONFIG_HASH = f"{DOMAIN}/provider-config-hash"
+
+# Gang scheduling (see scheduler/gang.py)
+ANN_GANG_ENABLED = f"{DOMAIN}/gang-enabled"
+ANN_GANG_MIN_MEMBERS = f"{DOMAIN}/gang-min-members"
+ANN_GANG_TIMEOUT = f"{DOMAIN}/gang-timeout"
+ANN_GANG_DESIRED_MEMBERS = f"{DOMAIN}/gang-desired-members"
+ANN_GANG_REQUIRED_MEMBERS = f"{DOMAIN}/gang-required-members"
+ANN_GANG_GROUP_KEY = f"{DOMAIN}/gang-group-key"
+
+# Defragmentation bookkeeping
+LABEL_DEFRAG_EVICTED = f"{DOMAIN}/defrag-evicted"
+ANN_DEFRAG_EVICTED_SINCE = f"{DOMAIN}/defrag-evicted-since"
+ANN_DEFRAG_EVICTED_POOL = f"{DOMAIN}/defrag-evicted-pool"
+LABEL_DEFRAG_SOURCE = f"{DOMAIN}/defrag-source"
+ANN_DEFRAG_SOURCE_SINCE = f"{DOMAIN}/defrag-source-since"
+ANN_DEFRAG_SOURCE_POOL = f"{DOMAIN}/defrag-source-pool"
+LABEL_DEFRAG_SKIP = f"{DOMAIN}/defrag-evict-skip"
+ANN_DEFRAG_SKIP_SINCE = f"{DOMAIN}/defrag-evict-skip-since"
+ANN_DEFRAG_SKIP_POOL = f"{DOMAIN}/defrag-evict-skip-pool"
+ANN_DEFRAG_SKIP_REASON = f"{DOMAIN}/defrag-evict-skip-reason"
+
+# --------------------------------------------------------------------------
+# QoS / isolation / phases
+# --------------------------------------------------------------------------
+
+QOS_LOW = "low"
+QOS_MEDIUM = "medium"
+QOS_HIGH = "high"
+QOS_CRITICAL = "critical"
+QOS_LEVELS = (QOS_LOW, QOS_MEDIUM, QOS_HIGH, QOS_CRITICAL)
+DEFAULT_QOS = QOS_MEDIUM
+
+ISOLATION_SHARED = "shared"            # no enforcement, best effort
+ISOLATION_SOFT = "soft"                # shm token buckets + ERL (~1% overhead)
+ISOLATION_HARD = "hard"                # one-shot provider hard caps
+ISOLATION_PARTITIONED = "partitioned"  # whole TensorCores via provider grants
+ISOLATION_MODES = (
+    ISOLATION_SHARED,
+    ISOLATION_SOFT,
+    ISOLATION_HARD,
+    ISOLATION_PARTITIONED,
+)
+DEFAULT_ISOLATION = ISOLATION_SOFT
+
+PHASE_PENDING = "Pending"
+PHASE_PROVISIONING = "Provisioning"
+PHASE_RUNNING = "Running"
+PHASE_SUCCEEDED = "Succeeded"
+PHASE_FAILED = "Failed"
+PHASE_UNKNOWN = "Unknown"
+PHASE_DESTROYING = "Destroying"
+PHASE_MIGRATING = "Migrating"
+
+CHIP_USED_BY_TPU_FUSION = "tpu-fusion"
+CHIP_USED_BY_EXTERNAL_PLUGIN = "external-device-plugin"
+
+# --------------------------------------------------------------------------
+# Vendor capability tiers (analog of vendors.go L1/L2/L3)
+# --------------------------------------------------------------------------
+
+# Tier 1: core partitioning (grant whole TensorCores).
+PARTITIONING_VENDORS = ("google-tpu", "mock-tpu")
+# Tier 2: soft isolation (program-launch metering via the shm limiter).
+SOFT_ISOLATION_VENDORS = ("google-tpu", "mock-tpu")
+# Tier 3: API remoting (remote-vTPU over Ethernet/DCN).
+REMOTING_VENDORS = ("google-tpu", "mock-tpu")
+
+LIMITER_LIB_NAMES = {
+    "google-tpu": "libtpf_limiter.so",
+    "mock-tpu": "libtpf_limiter.so",
+}
+PROVIDER_LIB_NAMES = {
+    "google-tpu": "libtpf_provider_tpu.so",
+    "mock-tpu": "libtpf_provider_mock.so",
+}
+
+# --------------------------------------------------------------------------
+# Env var contract (analog of pkg/constants/env.go)
+# --------------------------------------------------------------------------
+
+ENV_SHM_PATH = "TPF_SHM_PATH"                  # worker segment path
+ENV_HYPERVISOR_URL = "TPF_HYPERVISOR_URL"      # node-local bootstrap endpoint
+ENV_OPERATOR_URL = "TPF_OPERATOR_URL"          # control-plane client API
+ENV_CONNECTION_NAME = "TPF_CONNECTION_NAME"
+ENV_CONNECTION_NAMESPACE = "TPF_CONNECTION_NAMESPACE"
+ENV_WORKER_URL = "TPF_WORKER_URL"              # remote-vTPU endpoint
+ENV_POD_NAME = "TPF_POD_NAME"
+ENV_POD_NAMESPACE = "TPF_POD_NAMESPACE"
+ENV_NODE_NAME = "TPF_NODE_NAME"
+ENV_VISIBLE_CHIPS = "TPU_VISIBLE_CHIPS"
+ENV_VISIBLE_CORES = "TPF_VISIBLE_CORES"
+ENV_PARTITION_ID = "TPF_PARTITION_ID"
+ENV_CHIP_IDS = "TPF_CHIP_IDS"
+ENV_ISOLATION = "TPF_ISOLATION"
+ENV_VTPU_ENABLED = "TPF_VTPU"                  # "1" auto-activates metering
+ENV_PROVIDER_LIB = "TPF_PROVIDER_LIB"
+ENV_LIMITER_LIB = "TPF_LIMITER_LIB"
+ENV_SHM_BASE = "TPF_SHM_BASE"
+ENV_GO_TESTING = "TPF_TESTING"                 # test-mode toggles
+
+DEFAULT_SHM_BASE = "/run/tpu-fusion/shm"
+DEFAULT_HYPERVISOR_PORT = 8000
+DEFAULT_OPERATOR_PORT = 8080
+DEFAULT_METRICS_PATH = "/logs/metrics.log"
+
+# Host-port ranges (analog of internal/portallocator defaults).
+NODE_PORT_RANGE = (40000, 42000)
+CLUSTER_PORT_RANGE = (42000, 62000)
+
+# --------------------------------------------------------------------------
+# Pool defaults (analog of api/v1/gpupool_types.go:64-85)
+# --------------------------------------------------------------------------
+
+DEFAULT_TFLOPS_OVERSELL_PERCENT = 500     # 5x MXU-time oversubscription
+DEFAULT_HBM_EXPAND_HOST_MEM_PERCENT = 50  # spill 50% of host RAM
+DEFAULT_HBM_EXPAND_HOST_DISK_PERCENT = 70 # spill 70% of host disk

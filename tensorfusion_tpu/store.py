@@ -1,0 +1,239 @@
+"""Versioned in-memory object store with watch streams.
+
+The tpu-fusion control plane's state backbone — the role the Kubernetes
+apiserver + controller-runtime informer cache plays for the reference
+(NexusGPU/tensor-fusion runs controllers against CRDs; here the platform is
+self-hosted, so a thread-safe store with optimistic concurrency and watch
+queues provides the same contract: create/get/update/delete/list + ADDED/
+MODIFIED/DELETED events that drive reconcile loops).
+
+Optionally persists every kind to a JSON-lines snapshot directory so a
+restarted control plane can rebuild (restart recovery is then exercised the
+same way the reference rebuilds allocator state from annotations,
+gpuallocator.go:2592).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterable, List, Optional, Type
+
+from .api.meta import Resource, from_dict
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+class ConflictError(Exception):
+    """Optimistic-concurrency failure: resource_version mismatch."""
+
+
+class NotFoundError(KeyError):
+    pass
+
+
+class AlreadyExistsError(Exception):
+    pass
+
+
+@dataclass
+class Event:
+    type: str
+    obj: Resource
+
+
+class Watch:
+    """One subscriber's event stream (closeable iterator)."""
+
+    def __init__(self, store: "ObjectStore", kinds: Iterable[str]):
+        self._store = store
+        self.kinds = set(kinds)
+        self.queue: "queue.Queue[Optional[Event]]" = queue.Queue()
+        self._closed = False
+
+    def stop(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._store._remove_watch(self)
+            self.queue.put(None)
+
+    def __iter__(self):
+        while True:
+            ev = self.queue.get()
+            if ev is None:
+                return
+            yield ev
+
+    def get(self, timeout: Optional[float] = None) -> Optional[Event]:
+        try:
+            return self.queue.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+
+class ObjectStore:
+    def __init__(self, persist_dir: Optional[str] = None):
+        self._lock = threading.RLock()
+        self._objects: Dict[str, Dict[str, Resource]] = {}   # kind -> key -> obj
+        self._watches: List[Watch] = []
+        self._rv = 0
+        self._persist_dir = persist_dir
+        if persist_dir:
+            os.makedirs(persist_dir, exist_ok=True)
+
+    # -- internal ---------------------------------------------------------
+
+    def _bucket(self, kind: str) -> Dict[str, Resource]:
+        return self._objects.setdefault(kind, {})
+
+    def _emit(self, etype: str, obj: Resource) -> None:
+        for w in list(self._watches):
+            if not w.kinds or obj.KIND in w.kinds:
+                w.queue.put(Event(etype, obj.deepcopy()))
+
+    def _remove_watch(self, w: Watch) -> None:
+        with self._lock:
+            if w in self._watches:
+                self._watches.remove(w)
+
+    def _persist(self, kind: str) -> None:
+        if not self._persist_dir:
+            return
+        path = os.path.join(self._persist_dir, f"{kind}.jsonl")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            for obj in self._objects.get(kind, {}).values():
+                f.write(json.dumps(obj.to_dict()) + "\n")
+        os.replace(tmp, path)
+
+    # -- CRUD -------------------------------------------------------------
+
+    def create(self, obj: Resource) -> Resource:
+        with self._lock:
+            bucket = self._bucket(obj.KIND)
+            key = obj.key()
+            if key in bucket:
+                raise AlreadyExistsError(f"{obj.KIND} {key} already exists")
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
+            obj.metadata.generation = 1
+            stored = obj.deepcopy()
+            bucket[key] = stored
+            self._emit(ADDED, stored)
+            self._persist(obj.KIND)
+            return stored.deepcopy()
+
+    def get(self, cls: Type[Resource], name: str,
+            namespace: str = "") -> Resource:
+        key = f"{namespace}/{name}" if cls.NAMESPACED else name
+        with self._lock:
+            bucket = self._bucket(cls.KIND)
+            if key not in bucket:
+                raise NotFoundError(f"{cls.KIND} {key} not found")
+            return bucket[key].deepcopy()
+
+    def try_get(self, cls: Type[Resource], name: str,
+                namespace: str = "") -> Optional[Resource]:
+        try:
+            return self.get(cls, name, namespace)
+        except NotFoundError:
+            return None
+
+    def update(self, obj: Resource, check_version: bool = False) -> Resource:
+        with self._lock:
+            bucket = self._bucket(obj.KIND)
+            key = obj.key()
+            if key not in bucket:
+                raise NotFoundError(f"{obj.KIND} {key} not found")
+            current = bucket[key]
+            if check_version and \
+                    obj.metadata.resource_version != current.metadata.resource_version:
+                raise ConflictError(
+                    f"{obj.KIND} {key}: version {obj.metadata.resource_version}"
+                    f" != {current.metadata.resource_version}")
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
+            obj.metadata.generation = current.metadata.generation + 1
+            stored = obj.deepcopy()
+            bucket[key] = stored
+            self._emit(MODIFIED, stored)
+            self._persist(obj.KIND)
+            return stored.deepcopy()
+
+    def update_or_create(self, obj: Resource) -> Resource:
+        with self._lock:
+            if obj.key() in self._bucket(obj.KIND):
+                return self.update(obj)
+            return self.create(obj)
+
+    def delete(self, cls: Type[Resource], name: str,
+               namespace: str = "") -> None:
+        key = f"{namespace}/{name}" if cls.NAMESPACED else name
+        with self._lock:
+            bucket = self._bucket(cls.KIND)
+            if key not in bucket:
+                raise NotFoundError(f"{cls.KIND} {key} not found")
+            obj = bucket.pop(key)
+            self._emit(DELETED, obj)
+            self._persist(cls.KIND)
+
+    def list(self, cls: Type[Resource], namespace: Optional[str] = None,
+             selector: Optional[Callable[[Resource], bool]] = None
+             ) -> List[Resource]:
+        with self._lock:
+            out = []
+            for obj in self._bucket(cls.KIND).values():
+                if namespace is not None and obj.metadata.namespace != namespace:
+                    continue
+                if selector is not None and not selector(obj):
+                    continue
+                out.append(obj.deepcopy())
+            return out
+
+    # -- watch ------------------------------------------------------------
+
+    def watch(self, *kinds: str, replay: bool = True) -> Watch:
+        """Subscribe to events for the given kinds (all kinds if empty).
+        With replay=True, current objects are delivered first as ADDED."""
+        with self._lock:
+            w = Watch(self, kinds)
+            if replay:
+                for kind, bucket in self._objects.items():
+                    if kinds and kind not in kinds:
+                        continue
+                    for obj in bucket.values():
+                        w.queue.put(Event(ADDED, obj.deepcopy()))
+            self._watches.append(w)
+            return w
+
+    # -- persistence ------------------------------------------------------
+
+    def load(self, kind_classes: Iterable[Type[Resource]]) -> int:
+        """Reload persisted objects (restart recovery). Returns count."""
+        if not self._persist_dir:
+            return 0
+        n = 0
+        with self._lock:
+            for cls in kind_classes:
+                path = os.path.join(self._persist_dir, f"{cls.KIND}.jsonl")
+                if not os.path.exists(path):
+                    continue
+                bucket = self._bucket(cls.KIND)
+                with open(path) as f:
+                    for line in f:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        data = json.loads(line)
+                        data.pop("kind", None)
+                        obj = from_dict(cls, data)
+                        bucket[obj.key()] = obj
+                        self._rv = max(self._rv,
+                                       obj.metadata.resource_version)
+                        n += 1
+        return n

@@ -1,0 +1,43 @@
+"""Test scaffolding: CPU-only JAX with a virtual 8-device mesh, and a
+session-scoped build of the native layer (mock provider + limiter).
+
+Mirrors the reference's test strategy (SURVEY.md §4): everything runs on
+hardware-free machines against the mock provider .so.
+"""
+
+import os
+import pathlib
+import subprocess
+import sys
+
+# Must be set before jax is imported anywhere in the test session.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+os.environ.setdefault("TPF_TESTING", "1")
+
+import pytest
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+NATIVE_BUILD = REPO_ROOT / "native" / "build"
+
+sys.path.insert(0, str(REPO_ROOT))
+
+
+@pytest.fixture(scope="session")
+def native_build() -> pathlib.Path:
+    """Build the native layer once per session; returns the build dir."""
+    subprocess.run(["make", "-C", str(REPO_ROOT / "native"), "all"],
+                   check=True, capture_output=True)
+    return NATIVE_BUILD
+
+
+@pytest.fixture(scope="session")
+def mock_provider_lib(native_build) -> str:
+    return str(native_build / "libtpf_provider_mock.so")
+
+
+@pytest.fixture(scope="session")
+def limiter_lib(native_build) -> str:
+    return str(native_build / "libtpf_limiter.so")

@@ -1,0 +1,109 @@
+"""API object model + object store tests (serde roundtrip, CRUD, watch,
+optimistic concurrency, persistence/restart recovery)."""
+
+import pytest
+
+from tensorfusion_tpu import constants
+from tensorfusion_tpu.api import (AllocRequest, ResourceAmount, TPUChip,
+                                  TPUPool, TPUWorkload, WorkloadProfile,
+                                  from_dict, parse_quantity)
+from tensorfusion_tpu.api.types import ICILink, MeshCoords
+from tensorfusion_tpu.store import (ADDED, DELETED, MODIFIED,
+                                    AlreadyExistsError, ConflictError,
+                                    NotFoundError, ObjectStore)
+
+
+def test_parse_quantity():
+    assert parse_quantity("16Gi") == 16 * 2**30
+    assert parse_quantity("1.5T") == 1.5e12
+    assert parse_quantity("100") == 100.0
+    assert parse_quantity(42) == 42.0
+    with pytest.raises(ValueError):
+        parse_quantity("12xyz")
+
+
+def test_resource_roundtrip():
+    chip = TPUChip.new("v5e-c0")
+    chip.status.capacity = ResourceAmount(tflops=197.0, hbm_bytes=16 * 2**30)
+    chip.status.mesh = MeshCoords(x=1, y=0)
+    chip.status.ici_links.append(ICILink(peer_chip_id="v5e-c1", hops=1))
+    d = chip.to_dict()
+    assert d["kind"] == "TPUChip"
+    back = from_dict(TPUChip, {k: v for k, v in d.items() if k != "kind"})
+    assert back.status.capacity.tflops == 197.0
+    assert back.status.mesh.x == 1
+    assert back.status.ici_links[0].peer_chip_id == "v5e-c1"
+
+
+def test_store_crud_and_conflict():
+    store = ObjectStore()
+    pool = TPUPool.new("pool-a")
+    created = store.create(pool)
+    assert created.metadata.resource_version > 0
+    with pytest.raises(AlreadyExistsError):
+        store.create(TPUPool.new("pool-a"))
+
+    got = store.get(TPUPool, "pool-a")
+    got.status.total_chips = 8
+    store.update(got, check_version=True)
+
+    stale = created  # old resource_version
+    stale.status.total_chips = 99
+    with pytest.raises(ConflictError):
+        store.update(stale, check_version=True)
+
+    assert store.get(TPUPool, "pool-a").status.total_chips == 8
+    store.delete(TPUPool, "pool-a")
+    with pytest.raises(NotFoundError):
+        store.get(TPUPool, "pool-a")
+
+
+def test_store_namespaced_list_and_watch():
+    store = ObjectStore()
+    w = store.watch("TPUWorkload")
+    wl = TPUWorkload.new("wl1", namespace="team-a")
+    store.create(wl)
+    wl2 = TPUWorkload.new("wl1", namespace="team-b")
+    store.create(wl2)  # same name, different namespace
+
+    assert len(store.list(TPUWorkload)) == 2
+    assert len(store.list(TPUWorkload, namespace="team-a")) == 1
+
+    ev = w.get(timeout=1)
+    assert ev.type == ADDED and ev.obj.metadata.namespace == "team-a"
+    ev = w.get(timeout=1)
+    assert ev.type == ADDED and ev.obj.metadata.namespace == "team-b"
+
+    got = store.get(TPUWorkload, "wl1", "team-a")
+    got.spec.replicas = 3
+    store.update(got)
+    ev = w.get(timeout=1)
+    assert ev.type == MODIFIED and ev.obj.spec.replicas == 3
+
+    store.delete(TPUWorkload, "wl1", "team-b")
+    ev = w.get(timeout=1)
+    assert ev.type == DELETED
+    w.stop()
+
+
+def test_store_persistence_roundtrip(tmp_path):
+    store = ObjectStore(persist_dir=str(tmp_path))
+    profile = WorkloadProfile.new("prof", namespace="default")
+    profile.spec.resources.requests = ResourceAmount(tflops=50, hbm_bytes=2**30)
+    profile.spec.isolation = constants.ISOLATION_SOFT
+    store.create(profile)
+
+    store2 = ObjectStore(persist_dir=str(tmp_path))
+    n = store2.load([WorkloadProfile])
+    assert n == 1
+    back = store2.get(WorkloadProfile, "prof", "default")
+    assert back.spec.resources.requests.tflops == 50
+    assert back.spec.isolation == constants.ISOLATION_SOFT
+
+
+def test_alloc_request_defaults():
+    req = AllocRequest(pool="pool-a", namespace="default", pod_name="p1",
+                      request=ResourceAmount(tflops=10, hbm_bytes=2**30))
+    assert req.chip_count == 1
+    assert req.isolation == "soft"
+    assert req.key() == "default/p1"

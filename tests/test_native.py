@@ -1,0 +1,22 @@
+"""Native-layer tests: ABI conformance + limiter rate limiting.
+
+Runs the compiled C++ test binaries (the analog of the reference's
+provider/test/test_accelerator.c + device_mock/test_rate_limit.c chain).
+"""
+
+import subprocess
+
+
+def test_provider_conformance(native_build, mock_provider_lib):
+    out = subprocess.run(
+        [str(native_build / "provider_conformance"), mock_provider_lib],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "PASS" in out.stdout
+
+
+def test_limiter_selftest(native_build):
+    out = subprocess.run([str(native_build / "limiter_selftest")],
+                         capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "PASS" in out.stdout
