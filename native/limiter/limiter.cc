@@ -383,12 +383,18 @@ TPF_API tpf_status_t tfl_register_pid(const char* ns, const char* pod,
   tpf_shm_header_t* h = seg->header();
   uint64_t n = aload(&h->pid_count);
   for (uint64_t i = 0; i < n && i < TPF_SHM_MAX_PIDS; ++i) {
-    if (h->pids[i] == host_pid) return TPF_OK;
+    if (aload(&h->pids[i]) == host_pid) return TPF_OK;
   }
-  if (n >= TPF_SHM_MAX_PIDS) return TPF_ERR_EXHAUSTED;
-  astore(&h->pids[n], host_pid);
-  astore(&h->pid_count, n + 1);
-  return TPF_OK;
+  // Same CAS-reserve protocol as tfl_self_register_pid: this races
+  // cross-process with clients registering themselves, and per-process
+  // mutexes cannot serialize that.
+  for (;;) {
+    if (n >= TPF_SHM_MAX_PIDS) return TPF_ERR_EXHAUSTED;
+    if (acas(&h->pid_count, &n, n + 1)) {
+      astore(&h->pids[n], host_pid);
+      return TPF_OK;
+    }
+  }
 }
 
 TPF_API tpf_status_t tfl_update_quota(const char* ns, const char* pod,

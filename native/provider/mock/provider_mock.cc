@@ -110,7 +110,10 @@ void build_host_locked() {
       g_state.mesh_x = mx;
       g_state.mesh_y = my;
     }
-  } else if (g_state.mesh_x * g_state.mesh_y != chip_count) {
+  }
+  // The topology contract requires product(mesh_shape) == chip_count;
+  // fall back to a 1xN line for odd counts or inconsistent env config.
+  if (g_state.mesh_x * g_state.mesh_y != chip_count) {
     g_state.mesh_x = 1;
     g_state.mesh_y = chip_count;
   }

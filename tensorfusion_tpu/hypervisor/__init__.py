@@ -1,0 +1,21 @@
+"""tpu-fusion node agent ("hypervisor").
+
+Python re-design of the reference's pure-Go node daemon
+(``cmd/hypervisor/``, ``pkg/hypervisor/`` — SURVEY.md §2.4): device
+controller over a dlopened vendor provider .so, worker allocation +
+lifecycle, shm soft-limiter state, the ERL PID metering hot loop, a
+single-node process-spawner backend, and an HTTP API for client bootstraps
+and live-migration hooks.
+"""
+
+from .allocation import AllocationController, AllocationError, WorkerAllocation
+from .device import DeviceController, DeviceEntry, NodeInfo
+from .erl import ERLQuotaController, Observation, QuotaUpdate
+from .framework import (Backend, ProcessMapping, WorkerDeviceRequest,
+                        WorkerSpec, WorkerStatus)
+from .limiter_binding import (ChargeResult, DeviceQuota, Limiter,
+                              LimiterError, ShmView, list_worker_segments)
+from .provider_binding import Provider, ProviderError
+from .server import HypervisorServer
+from .single_node import SingleNodeBackend
+from .worker import TrackedWorker, WorkerController

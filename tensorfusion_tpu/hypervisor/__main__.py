@@ -1,0 +1,102 @@
+"""Hypervisor daemon entrypoint.
+
+Analog of the reference's ``cmd/hypervisor/main.go:46``: load the provider,
+start device + worker controllers and the HTTP server, serve until killed.
+
+    python -m tensorfusion_tpu.hypervisor \
+        --provider native/build/libtpf_provider_mock.so \
+        --limiter  native/build/libtpf_limiter.so \
+        --shm-base /tmp/tpf-shm --state-dir /tmp/tpf-state --port 8000
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+import time
+
+from .. import constants
+from .allocation import AllocationController
+from .device import DeviceController
+from .limiter_binding import Limiter
+from .provider_binding import Provider
+from .server import HypervisorServer
+from .single_node import SingleNodeBackend
+from .worker import WorkerController
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tpf-hypervisor")
+    ap.add_argument("--provider",
+                    default=os.environ.get(constants.ENV_PROVIDER_LIB,
+                                           "native/build/libtpf_provider_mock.so"))
+    ap.add_argument("--limiter",
+                    default=os.environ.get(constants.ENV_LIMITER_LIB,
+                                           "native/build/libtpf_limiter.so"))
+    ap.add_argument("--shm-base",
+                    default=os.environ.get(constants.ENV_SHM_BASE,
+                                           "/tmp/tpu-fusion/shm"))
+    ap.add_argument("--state-dir", default="/tmp/tpu-fusion/state")
+    ap.add_argument("--snapshot-dir", default="/tmp/tpu-fusion/snapshots")
+    ap.add_argument("--port", type=int,
+                    default=constants.DEFAULT_HYPERVISOR_PORT)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--tick-ms", type=int, default=100)
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s %(message)s")
+    log = logging.getLogger("tpf.hypervisor")
+
+    os.makedirs(args.snapshot_dir, exist_ok=True)
+    provider = Provider(args.provider,
+                        log_fn=lambda lvl, msg: log.info("[provider] %s", msg))
+    devices = DeviceController(provider)
+    devices.start()
+
+    limiter = Limiter(args.limiter)
+    allocator = AllocationController(devices)
+    workers = WorkerController(devices, allocator, limiter, args.shm_base,
+                               tick_interval_s=args.tick_ms / 1000.0)
+    backend = SingleNodeBackend(args.state_dir)
+
+    def on_added(spec):
+        tracked = workers.add_worker(spec)
+        backend.set_worker_env(spec.key, tracked.status.env)
+
+    backend.start(on_added, workers.remove_worker)
+    workers.start()
+
+    server = HypervisorServer(devices, workers, backend=backend,
+                              snapshot_dir=args.snapshot_dir,
+                              host=args.host, port=args.port)
+    server.start()
+    log.info("hypervisor serving on %s (%d chips)", server.url,
+             len(devices.devices()))
+
+    stop = False
+
+    def _sig(*_):
+        nonlocal stop
+        stop = True
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    try:
+        while not stop:
+            time.sleep(0.5)
+    finally:
+        server.stop()
+        workers.stop()
+        backend.stop()
+        devices.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

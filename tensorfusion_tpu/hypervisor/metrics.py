@@ -1,0 +1,83 @@
+"""Hypervisor metrics recorder.
+
+Analog of the reference's ``pkg/hypervisor/metrics/metrics.go:111-236``:
+periodic influx-line metrics for devices / workers / processes appended to a
+metrics file (shipped by a forwarder into the TSDB).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Optional
+
+from ..metrics.encoder import encode_line
+
+log = logging.getLogger("tpf.hypervisor.metrics")
+
+
+class HypervisorMetricsRecorder:
+    def __init__(self, devices, workers, path: str,
+                 interval_s: float = 5.0, node_name: str = "local"):
+        self.devices = devices
+        self.workers = workers
+        self.path = path
+        self.interval_s = interval_s
+        self.node_name = node_name
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop,
+                                        name="tpf-hv-metrics", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.record_once()
+            except Exception:
+                log.exception("metrics pass failed")
+
+    def record_once(self) -> None:
+        lines = []
+        ts = time.time_ns()
+        self.devices.refresh_metrics()
+        for e in self.devices.devices():
+            m = e.metrics
+            if m is None:
+                continue
+            lines.append(encode_line(
+                "tpf_chip",
+                {"node": self.node_name, "chip": e.info.chip_id,
+                 "generation": e.info.generation},
+                {"duty_cycle_pct": m.duty_cycle_pct,
+                 "hbm_used_bytes": int(m.hbm_used_bytes),
+                 "hbm_bw_util_pct": m.hbm_bw_util_pct,
+                 "power_watts": m.power_watts,
+                 "temp_celsius": m.temp_celsius,
+                 "ici_tx_bytes": int(m.ici_tx_bytes),
+                 "ici_rx_bytes": int(m.ici_rx_bytes),
+                 "partitions": len(e.partitions)}, ts))
+        for w in self.workers.list():
+            lines.append(encode_line(
+                "tpf_worker",
+                {"node": self.node_name, "namespace": w.spec.namespace,
+                 "worker": w.spec.name, "qos": w.spec.qos,
+                 "isolation": w.spec.isolation},
+                {"duty_cycle_pct": w.status.duty_cycle_pct,
+                 "hbm_used_bytes": int(w.status.hbm_used_bytes),
+                 "frozen": w.status.frozen,
+                 "pids": len(w.status.pids)}, ts))
+        if not lines:
+            return
+        with open(self.path, "a") as f:
+            f.write("\n".join(lines) + "\n")

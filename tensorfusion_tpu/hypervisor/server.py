@@ -1,0 +1,233 @@
+"""Hypervisor HTTP API.
+
+Analog of the reference's gin server (``pkg/hypervisor/server/``, port 8000):
+
+- ``GET  /api/v1/devices``            device inventory + metrics
+- ``GET  /api/v1/workers``            tracked workers + status
+- ``POST /api/v1/workers``            submit a worker (single-node backend)
+- ``DELETE /api/v1/workers/<ns>/<name>``
+- ``POST /api/v1/workers/<ns>/<name>/snapshot|resume|freeze``  live-migration hooks
+- legacy client-bootstrap endpoints (``handlers/legacy.go:81-663`` analog):
+  ``GET /limiter`` (shm path + quota for the calling worker),
+  ``GET /pod`` (worker identity), ``POST /process`` (register a client PID)
+
+Implemented on the stdlib ThreadingHTTPServer — the hypervisor must not
+depend on web frameworks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from ..api.meta import from_dict
+from .framework import WorkerSpec
+
+log = logging.getLogger("tpf.hypervisor.server")
+
+
+def _to_jsonable(obj):
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return dataclasses.asdict(obj)
+    if isinstance(obj, (list, tuple)):
+        return [_to_jsonable(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _to_jsonable(v) for k, v in obj.items()}
+    return obj
+
+
+class HypervisorServer:
+    def __init__(self, devices, workers, backend=None, snapshot_dir="/tmp",
+                 provider=None, host: str = "127.0.0.1", port: int = 0):
+        self.devices = devices
+        self.workers = workers
+        self.backend = backend
+        self.snapshot_dir = snapshot_dir
+        self.provider = provider
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                log.debug("%s " + fmt, self.client_address[0], *args)
+
+            def _send(self, code: int, payload) -> None:
+                body = json.dumps(_to_jsonable(payload)).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self) -> dict:
+                length = int(self.headers.get("Content-Length", 0))
+                if length == 0:
+                    return {}
+                return json.loads(self.rfile.read(length))
+
+            def do_GET(self):
+                try:
+                    outer._get(self)
+                except Exception as e:  # noqa: BLE001
+                    log.exception("GET %s failed", self.path)
+                    self._send(500, {"error": str(e)})
+
+            def do_POST(self):
+                try:
+                    outer._post(self)
+                except Exception as e:  # noqa: BLE001
+                    log.exception("POST %s failed", self.path)
+                    self._send(500, {"error": str(e)})
+
+            def do_DELETE(self):
+                try:
+                    outer._delete(self)
+                except Exception as e:  # noqa: BLE001
+                    log.exception("DELETE %s failed", self.path)
+                    self._send(500, {"error": str(e)})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="tpf-hypervisor-http",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # -- routing ----------------------------------------------------------
+
+    _WORKER_RE = re.compile(
+        r"^/api/v1/workers/([^/]+)/([^/]+)(?:/(snapshot|resume|freeze))?$")
+
+    def _get(self, h) -> None:
+        url = urlparse(h.path)
+        if url.path == "/healthz":
+            h._send(200, {"ok": True})
+        elif url.path == "/api/v1/devices":
+            self.devices.refresh_metrics()
+            out = []
+            for e in self.devices.devices():
+                out.append({"info": _to_jsonable(e.info),
+                            "metrics": _to_jsonable(e.metrics),
+                            "partitions": list(e.partitions)})
+            h._send(200, out)
+        elif url.path == "/api/v1/topology":
+            topo = self.devices.topology()
+            h._send(200, _to_jsonable(topo) if topo else {})
+        elif url.path == "/api/v1/node":
+            h._send(200, _to_jsonable(self.devices.node_info()))
+        elif url.path == "/api/v1/workers":
+            out = [{"spec": _to_jsonable(w.spec),
+                    "status": _to_jsonable(w.status)}
+                   for w in self.workers.list()]
+            h._send(200, out)
+        elif url.path == "/limiter":
+            # Legacy client bootstrap: worker identity -> shm path + env.
+            qs = parse_qs(url.query)
+            ns = qs.get("namespace", ["default"])[0]
+            name = qs.get("pod", [""])[0]
+            w = self.workers.get(f"{ns}/{name}")
+            if w is None:
+                h._send(404, {"error": "unknown worker"})
+                return
+            h._send(200, {"shm_path": w.shm_path,
+                          "isolation": w.spec.isolation,
+                          "env": w.status.env})
+        elif url.path == "/pod":
+            qs = parse_qs(url.query)
+            pid = int(qs.get("pid", ["0"])[0])
+            mapping = (self.backend.resolve_process(pid)
+                       if self.backend else None)
+            if mapping is None:
+                h._send(404, {"error": f"pid {pid} not mapped to a worker"})
+                return
+            h._send(200, _to_jsonable(mapping))
+        else:
+            h._send(404, {"error": "not found"})
+
+    def _post(self, h) -> None:
+        url = urlparse(h.path)
+        m = self._WORKER_RE.match(url.path)
+        if url.path == "/api/v1/workers":
+            body = h._body()
+            spec = from_dict(WorkerSpec, body)
+            if self.backend is not None and hasattr(self.backend,
+                                                   "submit_worker"):
+                self.backend.submit_worker(spec)
+            else:
+                self.workers.add_worker(spec)
+            w = self.workers.get(spec.key)
+            h._send(201, {"key": spec.key,
+                          "status": _to_jsonable(w.status) if w else None})
+        elif url.path == "/process":
+            # Client hook registers its host PID for metering.
+            body = h._body()
+            ns = body.get("namespace", "default")
+            name = body.get("pod", "")
+            pid = int(body.get("pid", 0))
+            self.workers.register_pid(f"{ns}/{name}", pid)
+            h._send(200, {"registered": pid})
+        elif m and m.group(3) == "snapshot":
+            key = f"{m.group(1)}/{m.group(2)}"
+            self._snapshot(key, h)
+        elif m and m.group(3) == "resume":
+            key = f"{m.group(1)}/{m.group(2)}"
+            self._resume(key, h)
+        elif m and m.group(3) == "freeze":
+            key = f"{m.group(1)}/{m.group(2)}"
+            self.workers.freeze_worker(key)
+            h._send(200, {"frozen": key})
+        else:
+            h._send(404, {"error": "not found"})
+
+    def _delete(self, h) -> None:
+        m = self._WORKER_RE.match(urlparse(h.path).path)
+        if m and m.group(3) is None:
+            key = f"{m.group(1)}/{m.group(2)}"
+            if self.backend is not None and hasattr(self.backend,
+                                                    "delete_worker"):
+                self.backend.delete_worker(key)
+            else:
+                self.workers.remove_worker(key)
+            h._send(200, {"deleted": key})
+        else:
+            h._send(404, {"error": "not found"})
+
+    # -- snapshot / resume (live-migration hooks, server.go:114-115) ------
+
+    def _snapshot(self, key: str, h) -> None:
+        w = self.workers.get(key)
+        if w is None:
+            h._send(404, {"error": "unknown worker"})
+            return
+        self.workers.freeze_worker(key)
+        prov = self.provider or self.devices.provider
+        for chip_id in w.status.chip_ids:
+            prov.snapshot(self.snapshot_dir, chip_id=chip_id)
+        h._send(200, {"snapshotted": key, "state_dir": self.snapshot_dir})
+
+    def _resume(self, key: str, h) -> None:
+        w = self.workers.get(key)
+        if w is None:
+            h._send(404, {"error": "unknown worker"})
+            return
+        prov = self.provider or self.devices.provider
+        for chip_id in w.status.chip_ids:
+            prov.restore(self.snapshot_dir, chip_id=chip_id)
+        self.workers.resume_worker(key)
+        h._send(200, {"resumed": key})

@@ -1,0 +1,3 @@
+"""Metrics pipeline: influx-line encoding, recording, in-process TSDB."""
+
+from .encoder import encode_line, parse_line

@@ -1,0 +1,362 @@
+"""Hypervisor tests against the mock provider .so.
+
+Python analog of the reference's hypervisor suite
+(pkg/hypervisor/hypervisor_suite_test.go over driver_mock.c): device
+controller, allocation (incl. partition rollback), worker lifecycle + shm,
+ERL convergence, shm layout byte-compat, single-node backend recovery, and
+the HTTP API.
+"""
+
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from tensorfusion_tpu import constants
+from tensorfusion_tpu.api.types import AutoFreezeRule, ERLParameters
+from tensorfusion_tpu.hypervisor import (AllocationController,
+                                         AllocationError, DeviceController,
+                                         ERLQuotaController, HypervisorServer,
+                                         Limiter, Observation, Provider,
+                                         ShmView, SingleNodeBackend,
+                                         WorkerController, WorkerDeviceRequest,
+                                         WorkerSpec)
+from tensorfusion_tpu.testing import MockProviderControl, fresh_library
+
+
+@pytest.fixture()
+def provider(mock_provider_lib):
+    p = Provider(fresh_library(mock_provider_lib))
+    yield p
+
+
+@pytest.fixture()
+def devices(provider):
+    ctrl = DeviceController(provider)
+    ctrl.start()
+    yield ctrl
+    ctrl.stop()
+
+
+@pytest.fixture()
+def stack(devices, limiter_lib, tmp_path):
+    """Device controller + allocation + worker controller, not started
+    (ticks are driven manually)."""
+    limiter = Limiter(fresh_library(limiter_lib))
+    alloc = AllocationController(devices)
+    workers = WorkerController(devices, alloc, limiter,
+                               str(tmp_path / "shm"))
+    yield devices, alloc, workers, limiter
+
+
+def test_device_discovery_and_topology(devices):
+    entries = devices.devices()
+    assert len(entries) == 8
+    assert all(e.info.generation == "v5e" for e in entries)
+    topo = devices.topology()
+    assert topo.mesh_shape == (2, 4, 1)
+    # every chip has 8 links incl. self
+    some = entries[0].info.chip_id
+    assert len(topo.links[some]) == 8
+    kinds = {l.kind for l in topo.links[some]}
+    assert "self" in kinds and ("ici" in kinds or "ici-routed" in kinds)
+    ni = devices.node_info()
+    assert ni.chip_count == 8
+    assert ni.total_hbm_bytes == 8 * 16 * 2**30
+
+
+def test_shm_layout_matches_python_mirror(limiter_lib):
+    """Byte-layout compatibility between the C++ limiter and the Python
+    ShmView (analog of soft_limiter_shm_test.go layout tests)."""
+    from tensorfusion_tpu.hypervisor import limiter_binding as lb
+    limiter = Limiter(fresh_library(limiter_lib))
+    layout = limiter.layout()
+    assert layout["segment_bytes"] == lb.SEGMENT_BYTES
+    assert layout["header_bytes"] == lb.HEADER_BYTES
+    assert layout["device_bytes"] == lb.DEVICE_BYTES
+    assert layout["max_devices"] == lb.MAX_DEVICES
+    assert layout["max_pids"] == lb.MAX_PIDS
+    assert layout["header"]["pids"] == lb._HEADER_PIDS_OFF
+    # Python header unpack covers fields up to pid_count
+    import struct
+    assert struct.calcsize(lb._HEADER_FMT) == layout["header"]["pid_count"] + 8
+    assert struct.calcsize(lb._DEVICE_FMT) == \
+        layout["device"]["hbm_denied_events"] + 8
+
+
+def test_soft_worker_lifecycle_and_metering(stack):
+    devices, alloc, workers, limiter = stack
+    chip = devices.devices()[0].info.chip_id
+    spec = WorkerSpec(namespace="ns1", name="w1",
+                      isolation=constants.ISOLATION_SOFT,
+                      devices=[WorkerDeviceRequest(chip_id=chip,
+                                                   duty_percent=50,
+                                                   hbm_bytes=2 * 2**30)])
+    tracked = workers.add_worker(spec)
+    assert os.path.exists(tracked.shm_path)
+    assert tracked.status.env[constants.ENV_SHM_PATH] == tracked.shm_path
+
+    # client face: attach + charge against the 50% bucket
+    limiter.attach(tracked.shm_path)
+    r = limiter.charge_compute(0, 100)
+    assert r.allowed
+    limiter.self_register_pid()
+
+    state = ShmView(tracked.shm_path).read()
+    assert state.ns == "ns1" and state.pod == "w1"
+    assert state.devices[0].chip_id == chip
+    assert state.devices[0].duty_limit_bp == 5000
+    assert os.getpid() in state.pids
+
+    workers.remove_worker("ns1/w1")
+    assert not os.path.exists(tracked.shm_path)
+
+
+def test_partitioned_worker_rollback(mock_provider_lib, limiter_lib,
+                                     tmp_path, monkeypatch):
+    """v5p chips (2 cores): second 2c partition on same chip must fail and
+    roll back earlier splits of the same worker."""
+    monkeypatch.setenv("TPF_MOCK_GEN", "v5p")
+    monkeypatch.setenv("TPF_MOCK_CHIPS", "4")
+    monkeypatch.setenv("TPF_MOCK_MESH", "2x2")
+    provider = Provider(fresh_library(mock_provider_lib, "v5p"))
+    devices = DeviceController(provider)
+    devices.start()
+    try:
+        ctl = MockProviderControl(provider)
+        chip = devices.devices()[0].info.chip_id
+        alloc = AllocationController(devices)
+        ok = WorkerSpec(namespace="ns1", name="p1",
+                        isolation=constants.ISOLATION_PARTITIONED,
+                        devices=[WorkerDeviceRequest(
+                            chip_id=chip, partition_template="v5p-1c",
+                            hbm_bytes=2**30)])
+        a = alloc.allocate(ok)
+        assert a.bindings[0].grant is not None
+        assert ctl.partition_count(chip) == 1
+        assert constants.ENV_VISIBLE_CORES in a.env
+
+        # worker wanting two full-chip partitions on the same chip: the
+        # second split must fail (only 1 core left) and the first must be
+        # rolled back.
+        bad = WorkerSpec(namespace="ns1", name="p2",
+                         isolation=constants.ISOLATION_PARTITIONED,
+                         devices=[WorkerDeviceRequest(
+                             chip_id=chip, partition_template="v5p-1c",
+                             hbm_bytes=2**30),
+                                  WorkerDeviceRequest(
+                             chip_id=chip, partition_template="v5p-2c",
+                             hbm_bytes=2**30)])
+        with pytest.raises(Exception):
+            alloc.allocate(bad)
+        assert ctl.partition_count(chip) == 1  # only p1's partition remains
+
+        alloc.release("ns1/p1")
+        assert ctl.partition_count(chip) == 0
+    finally:
+        devices.stop()
+
+
+def test_hard_isolation_sets_provider_limits(stack):
+    devices, alloc, workers, limiter = stack
+    ctl = MockProviderControl(devices.provider)
+    chip = devices.devices()[2].info.chip_id
+    spec = WorkerSpec(namespace="ns1", name="h1",
+                      isolation=constants.ISOLATION_HARD,
+                      devices=[WorkerDeviceRequest(chip_id=chip,
+                                                   duty_percent=30,
+                                                   hbm_bytes=4 * 2**30)])
+    workers.add_worker(spec)
+    assert ctl.hbm_hard_limit(chip) == 4 * 2**30
+    assert ctl.duty_hard_limit(chip) == 30
+
+
+def test_erl_convergence_idle_redistribution():
+    """Two workers with 50% quota each; A hungry, B idle -> A's share should
+    climb above its quota (elastic), then fall back when B wakes up."""
+    erl = ERLQuotaController(ERLParameters())
+    peak = 197e6  # v5e MFLOP/s
+
+    def obs(a_util, b_util, a_blocked, b_blocked):
+        return [
+            Observation("ns/a", 0, "c0", 5000, peak, a_util, a_blocked,
+                        qos=constants.QOS_HIGH),
+            Observation("ns/b", 0, "c0", 5000, peak, b_util, b_blocked,
+                        qos=constants.QOS_LOW),
+        ]
+
+    # Phase 1: A saturates its bucket (blocked), B idle.
+    for _ in range(100):
+        updates = erl.step(obs(50.0, 0.0, 3, 0), dt=0.1)
+    a_up = [u for u in updates if u.worker_key == "ns/a"][0]
+    assert a_up.refill_mflop_per_s > 0.55 * peak  # grew past its 50% quota
+
+    # Phase 2: B wakes up and saturates too -> shares re-converge to ~quota.
+    for _ in range(200):
+        updates = erl.step(obs(60.0, 40.0, 2, 2), dt=0.1)
+    a_up = [u for u in updates if u.worker_key == "ns/a"][0]
+    b_up = [u for u in updates if u.worker_key == "ns/b"][0]
+    total = a_up.refill_mflop_per_s + b_up.refill_mflop_per_s
+    assert total <= 1.15 * peak          # chip not oversold at steady state
+    assert b_up.refill_mflop_per_s > 0.3 * peak  # B got back near its quota
+
+
+def test_worker_tick_pushes_erl_updates(stack):
+    devices, alloc, workers, limiter = stack
+    ctl = MockProviderControl(devices.provider)
+    chip = devices.devices()[1].info.chip_id
+    spec = WorkerSpec(namespace="ns2", name="m1",
+                      isolation=constants.ISOLATION_SOFT,
+                      devices=[WorkerDeviceRequest(chip_id=chip,
+                                                   duty_percent=25,
+                                                   hbm_bytes=2**30)])
+    tracked = workers.add_worker(spec)
+    # register a fake client process using 20% duty / 1 GiB
+    pid = 4242
+    workers.register_pid("ns2/m1", pid)
+    ctl.proc_set(pid, chip, 20.0, 2**29)
+
+    for _ in range(5):
+        workers.tick()
+        time.sleep(0.01)
+
+    state = ShmView(tracked.shm_path).read()
+    dev = state.devices[0]
+    assert dev.refill_mflop_per_s > 0
+    assert dev.pod_hbm_used_bytes == 2**29
+    assert state.heartbeat_ts_s > 0
+    assert tracked.status.duty_cycle_pct == pytest.approx(20.0, abs=1.0)
+
+
+def test_auto_freeze_idle_worker(stack):
+    devices, alloc, workers, limiter = stack
+    workers.auto_freeze_rules = {
+        constants.QOS_LOW: AutoFreezeRule(qos=constants.QOS_LOW,
+                                          freeze_to_mem_ttl_seconds=0.05)}
+    chip = devices.devices()[3].info.chip_id
+    spec = WorkerSpec(namespace="ns3", name="f1", qos=constants.QOS_LOW,
+                      isolation=constants.ISOLATION_SOFT,
+                      devices=[WorkerDeviceRequest(chip_id=chip,
+                                                   duty_percent=10,
+                                                   hbm_bytes=2**28)])
+    tracked = workers.add_worker(spec)
+    time.sleep(0.08)
+    workers.tick()
+    assert tracked.status.frozen
+    state = ShmView(tracked.shm_path).read()
+    assert state.auto_frozen
+
+    workers.resume_worker("ns3/f1")
+    assert not ShmView(tracked.shm_path).read().auto_frozen
+
+
+def test_orphan_shm_cleanup(stack, tmp_path):
+    devices, alloc, workers, limiter = stack
+    # create a stray segment by hand
+    stray_dir = tmp_path / "shm" / "ghost"
+    stray_dir.mkdir(parents=True, exist_ok=True)
+    stray = stray_dir / "pod-x"
+    stray.write_bytes(b"\0" * 3072)
+    workers.tick()
+    assert not stray.exists()
+
+
+def test_single_node_backend_recovery(tmp_path):
+    state = str(tmp_path / "state")
+    b1 = SingleNodeBackend(state, spawn=False)
+    added, removed = [], []
+    b1.start(lambda s: added.append(s.key), removed.append)
+    spec = WorkerSpec(namespace="d", name="w", command=[])
+    b1.submit_worker(spec)
+    assert added == ["d/w"]
+    b1.stop()
+
+    # restart: persisted worker is re-adopted
+    b2 = SingleNodeBackend(state, spawn=False)
+    added2 = []
+    b2.start(lambda s: added2.append(s.key), lambda k: None)
+    assert added2 == ["d/w"]
+    b2.delete_worker("d/w")
+    b2.stop()
+    b3 = SingleNodeBackend(state, spawn=False)
+    added3 = []
+    b3.start(lambda s: added3.append(s.key), lambda k: None)
+    assert added3 == []
+    b3.stop()
+
+
+def test_single_node_backend_restarts_dead_process(tmp_path):
+    b = SingleNodeBackend(str(tmp_path / "st"), reconcile_interval_s=0.05)
+    b.start(lambda s: None, lambda k: None)
+    spec = WorkerSpec(namespace="d", name="sleepy",
+                      command=["sleep", "30"])
+    b.submit_worker(spec)
+    pid1 = b.worker_pid("d/sleepy")
+    assert pid1 is not None
+    os.kill(pid1, 9)
+    deadline = time.time() + 3
+    while time.time() < deadline:
+        pid2 = b.worker_pid("d/sleepy")
+        if pid2 is not None and pid2 != pid1:
+            break
+        time.sleep(0.05)
+    assert b.worker_pid("d/sleepy") != pid1
+    b.delete_worker("d/sleepy")
+    b.stop()
+
+
+def test_http_api_end_to_end(stack, tmp_path):
+    devices, alloc, workers, limiter = stack
+    snapdir = str(tmp_path / "snaps")
+    os.makedirs(snapdir, exist_ok=True)
+    server = HypervisorServer(devices, workers, snapshot_dir=snapdir, port=0)
+    server.start()
+    try:
+        def get(path):
+            with urllib.request.urlopen(server.url + path) as r:
+                return json.loads(r.read())
+
+        def post(path, body=None):
+            req = urllib.request.Request(
+                server.url + path, method="POST",
+                data=json.dumps(body or {}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req) as r:
+                return json.loads(r.read())
+
+        assert get("/healthz")["ok"]
+        devs = get("/api/v1/devices")
+        assert len(devs) == 8
+        assert devs[0]["info"]["generation"] == "v5e"
+        topo = get("/api/v1/topology")
+        assert topo["mesh_shape"] == [2, 4, 1]
+
+        chip = devs[0]["info"]["chip_id"]
+        post("/api/v1/workers", {
+            "namespace": "api", "name": "w9", "isolation": "soft",
+            "devices": [{"chip_id": chip, "duty_percent": 40,
+                         "hbm_bytes": 2**30}]})
+        ws = get("/api/v1/workers")
+        assert ws[0]["spec"]["name"] == "w9"
+
+        lim = get("/limiter?namespace=api&pod=w9")
+        assert lim["shm_path"].endswith("api/w9")
+        post("/process", {"namespace": "api", "pod": "w9", "pid": 777})
+        state = ShmView(lim["shm_path"]).read()
+        assert 777 in state.pids
+
+        post("/api/v1/workers/api/w9/snapshot")
+        assert workers.get("api/w9").status.frozen
+        assert os.path.exists(os.path.join(snapdir, chip + ".tpfsnap"))
+        post("/api/v1/workers/api/w9/resume")
+        assert not workers.get("api/w9").status.frozen
+
+        req = urllib.request.Request(server.url + "/api/v1/workers/api/w9",
+                                     method="DELETE")
+        with urllib.request.urlopen(req) as r:
+            assert json.loads(r.read())["deleted"] == "api/w9"
+        assert workers.get("api/w9") is None
+    finally:
+        server.stop()

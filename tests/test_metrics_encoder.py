@@ -1,0 +1,50 @@
+"""Influx line-protocol encoder/parser tests."""
+
+from tensorfusion_tpu.metrics.encoder import encode_line, parse_line
+
+
+def test_roundtrip():
+    line = encode_line("tpf_chip",
+                       {"node": "n1", "chip": "c 0", "gen": "v5e"},
+                       {"duty": 42.5, "hbm": 1024, "ok": True,
+                        "msg": 'say "hi"'}, ts_ns=123456789)
+    m, tags, fields, ts = parse_line(line)
+    assert m == "tpf_chip"
+    assert tags == {"node": "n1", "chip": "c 0", "gen": "v5e"}
+    assert fields == {"duty": 42.5, "hbm": 1024, "ok": True,
+                      "msg": 'say "hi"'}
+    assert ts == 123456789
+
+
+def test_escaping():
+    line = encode_line("m,1", {"a=b": "c,d e"}, {"f": 1})
+    m, tags, fields, _ = parse_line(line)
+    assert m == "m,1"
+    assert tags == {"a=b": "c,d e"}
+    assert fields == {"f": 1}
+
+
+def test_recorder_writes_lines(tmp_path, mock_provider_lib, limiter_lib):
+    from tensorfusion_tpu.hypervisor import (AllocationController,
+                                             DeviceController, Limiter,
+                                             Provider, WorkerController)
+    from tensorfusion_tpu.hypervisor.metrics import HypervisorMetricsRecorder
+    from tensorfusion_tpu.testing import fresh_library
+
+    provider = Provider(fresh_library(mock_provider_lib))
+    devices = DeviceController(provider)
+    devices.start()
+    try:
+        limiter = Limiter(fresh_library(limiter_lib))
+        workers = WorkerController(devices, AllocationController(devices),
+                                   limiter, str(tmp_path / "shm"))
+        path = str(tmp_path / "metrics.log")
+        rec = HypervisorMetricsRecorder(devices, workers, path)
+        rec.record_once()
+        lines = open(path).read().strip().splitlines()
+        assert len(lines) == 8  # one per chip
+        m, tags, fields, _ = parse_line(lines[0])
+        assert m == "tpf_chip" and tags["generation"] == "v5e"
+        assert "duty_cycle_pct" in fields
+    finally:
+        devices.stop()
