@@ -1,0 +1,10 @@
+"""Allocator: in-memory TPU device store + allocation state machine."""
+
+from .core import (AllocationConflictError, AllocRecord, ChipState,
+                   InsufficientResourcesError, TPUAllocator)
+from .filters import (Filter, FilterResult, default_chain, run_filters)
+from .indexalloc import IndexAllocator, IndexExhaustedError
+from .portalloc import PortAllocator, PortExhaustedError
+from .quota import QuotaExceededError, QuotaStore
+from .strategy import (COMPACT_FIRST, LOW_LOAD_FIRST,
+                       NODE_COMPACT_CHIP_LOW_LOAD, Strategy, new_strategy)

@@ -1,0 +1,628 @@
+"""TPU allocator: the in-memory device store + allocation state machine.
+
+Analog of the reference's ``internal/gpuallocator/gpuallocator.go`` (3.1k
+LoC Go), the heart of the control plane.  Same state machine, TPU resources:
+
+- stores: chip store, node->chips, pool->chips, pod->allocation
+  (``gpuStore``/``nodeGpuStore``/``poolGpuStore``/``uniqueAllocation``,
+  gpuallocator.go:276-328);
+- two-phase allocation: ``assume`` holds capacity+quota during the
+  scheduler's Reserve->Bind window (TTL-swept, :1078, :1348), ``commit``
+  finalizes on bind (:1137);
+- ``check_quota_and_filter`` (:1426) runs the quota check + filter chain and
+  returns per-node candidates with rejection reasons (simulate-schedule);
+- ``adjust_allocation`` (:1600) performs live vertical resize with capacity
+  and quota dry-run;
+- ``reconcile`` (:2592) rebuilds all allocation state from pod annotations
+  after an operator restart;
+- ``sync_to_store`` (:2309) batch-flushes dirty chip status to the object
+  store.
+
+Capacity model: virtual TFLOPs = peak x pool oversell ratio (MXU time is
+time-sliced by the ERL limiter, so overselling compute is safe); HBM stays
+physical per chip.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .. import constants
+from ..api.resources import AdjustRequest, AllocRequest, ResourceAmount
+from ..api.types import Pod, TPUChip
+from ..store import NotFoundError, ObjectStore
+from .filters import (Filter, FilterResult, NodeAffinityFilter,
+                      PartitionFitFilter, default_chain, run_filters)
+from .quota import QuotaExceededError, QuotaStore
+from .strategy import Strategy, new_strategy
+from .vecview import CandidateMap, PoolVectorView
+
+#: below this chip count the plain Python filter chain is used (it is fast
+#: enough and produces rejection reasons for free)
+VECTORIZE_THRESHOLD = 64
+
+log = logging.getLogger("tpf.allocator")
+
+DEFAULT_ASSUME_TTL_S = 120.0
+
+
+class AllocationConflictError(Exception):
+    pass
+
+
+class InsufficientResourcesError(Exception):
+    pass
+
+
+@dataclass
+class AllocRecord:
+    request: AllocRequest
+    chip_ids: List[str]
+    assumed: bool = True
+    assumed_at: float = field(default_factory=time.time)
+    partitions: Dict[str, str] = field(default_factory=dict)  # chip -> part id
+
+    @property
+    def key(self) -> str:
+        return self.request.key()
+
+
+class ChipState:
+    """Mutable allocator-side state for one TPUChip."""
+
+    def __init__(self, chip: TPUChip, oversell_ratio: float = 1.0,
+                 template_cores: Optional[Dict[str, int]] = None):
+        self.chip = chip
+        self.oversell_ratio = oversell_ratio
+        self._template_cores = template_cores or {}
+        self.allocated = ResourceAmount()
+        self.holders: Dict[str, ResourceAmount] = {}   # pod key -> per-chip amt
+        self.partition_cores_used = 0
+        self._avail_cache: Optional[ResourceAmount] = None
+
+    # -- capacity ---------------------------------------------------------
+
+    def virtual_capacity(self) -> ResourceAmount:
+        cap = self.chip.status.capacity
+        return ResourceAmount(tflops=cap.tflops * self.oversell_ratio,
+                              duty_percent=100.0 * self.oversell_ratio,
+                              hbm_bytes=cap.hbm_bytes)
+
+    def available(self) -> ResourceAmount:
+        if self._avail_cache is None:
+            self._avail_cache = self.virtual_capacity().sub(self.allocated)
+        return self._avail_cache
+
+    def invalidate(self) -> None:
+        self._avail_cache = None
+
+    # -- partition helpers ------------------------------------------------
+
+    def template_core_count(self, template_id: str) -> Optional[int]:
+        if template_id in self._template_cores:
+            return self._template_cores[template_id]
+        # conventional template ids end in "-<n>c"
+        tail = template_id.rsplit("-", 1)[-1]
+        if tail.endswith("c") and tail[:-1].isdigit():
+            return int(tail[:-1])
+        return None
+
+    def free_partition_cores(self) -> int:
+        return max(0, self.chip.status.core_count
+                   - self.partition_cores_used)
+
+    # -- mutation ---------------------------------------------------------
+
+    def hold(self, key: str, amount: ResourceAmount,
+             partition_template: str = "") -> None:
+        if key in self.holders:
+            raise AllocationConflictError(
+                f"{key} already holds chip {self.chip.name}")
+        self.holders[key] = amount
+        self.allocated = self.allocated.add(amount)
+        self._avail_cache = None
+        if partition_template:
+            cores = self.template_core_count(partition_template) or 0
+            self.partition_cores_used += cores
+
+    def drop(self, key: str, partition_template: str = "") -> None:
+        amount = self.holders.pop(key, None)
+        if amount is None:
+            return
+        self.allocated = self.allocated.sub(amount)
+        self._avail_cache = None
+        if partition_template:
+            cores = self.template_core_count(partition_template) or 0
+            self.partition_cores_used = max(
+                0, self.partition_cores_used - cores)
+
+
+class TPUAllocator:
+    def __init__(self, store: Optional[ObjectStore] = None,
+                 quota_store: Optional[QuotaStore] = None,
+                 node_labels: Optional[Callable[[str], Dict[str, str]]] = None,
+                 assume_ttl_s: float = DEFAULT_ASSUME_TTL_S):
+        self.store = store
+        self.quota = quota_store or QuotaStore(store)
+        self.assume_ttl_s = assume_ttl_s
+        self._lock = threading.RLock()
+        self._chips: Dict[str, ChipState] = {}
+        self._node_chips: Dict[str, set] = {}
+        self._pool_chips: Dict[str, set] = {}
+        self._allocations: Dict[str, AllocRecord] = {}
+        self._dirty: set = set()
+        self._pool_oversell: Dict[str, float] = {}
+        self._template_cores: Dict[str, int] = {}
+        self._node_labels = node_labels or (lambda node: {})
+        self._filters: List[Filter] = default_chain(
+            lambda n: self._node_labels(n))
+        self._strategies: Dict[str, Strategy] = {}
+        self._gang_waiting_probe: Callable[[str], bool] = lambda key: False
+        self._views: Dict[str, PoolVectorView] = {}
+
+    # -- configuration ----------------------------------------------------
+
+    def set_pool_oversell(self, pool: str, percent: float) -> None:
+        with self._lock:
+            self._pool_oversell[pool] = max(percent, 100.0) / 100.0
+            for name in self._pool_chips.get(pool, ()):  # re-rate chips
+                state = self._chips[name]
+                state.oversell_ratio = self._pool_oversell[pool]
+                state.invalidate()
+            self._views.clear()
+
+    def set_pool_strategy(self, pool: str, placement_mode: str) -> None:
+        with self._lock:
+            self._strategies[pool] = new_strategy(placement_mode)
+
+    def set_template_cores(self, mapping: Dict[str, int]) -> None:
+        with self._lock:
+            self._template_cores.update(mapping)
+
+    def set_gang_waiting_probe(self, probe: Callable[[str], bool]) -> None:
+        """Probe asked before TTL-sweeping an assumed allocation — gang
+        members legitimately wait in Permit (gpuallocator.go:389-395)."""
+        self._gang_waiting_probe = probe
+
+    # -- chip inventory ---------------------------------------------------
+
+    def upsert_chip(self, chip: TPUChip) -> None:
+        with self._lock:
+            state = self._chips.get(chip.name)
+            pool = chip.status.pool
+            ratio = self._pool_oversell.get(pool, 1.0)
+            if state is None:
+                state = ChipState(chip, ratio, self._template_cores)
+                self._chips[chip.name] = state
+            else:
+                state.chip = chip
+                state.oversell_ratio = ratio
+            state.invalidate()
+            self._node_chips.setdefault(chip.status.node_name,
+                                        set()).add(chip.name)
+            self._pool_chips.setdefault(pool, set()).add(chip.name)
+            self._views.clear()
+
+    def remove_chip(self, name: str) -> None:
+        with self._lock:
+            state = self._chips.pop(name, None)
+            if state is None:
+                return
+            self._node_chips.get(state.chip.status.node_name,
+                                 set()).discard(name)
+            self._pool_chips.get(state.chip.status.pool, set()).discard(name)
+            self._views.clear()
+
+    def chips(self, pool: Optional[str] = None) -> List[ChipState]:
+        with self._lock:
+            if pool is None:
+                return list(self._chips.values())
+            return [self._chips[n] for n in self._pool_chips.get(pool, ())]
+
+    def get_chip(self, name: str) -> Optional[ChipState]:
+        with self._lock:
+            return self._chips.get(name)
+
+    def allocation(self, key: str) -> Optional[AllocRecord]:
+        with self._lock:
+            return self._allocations.get(key)
+
+    def allocations(self) -> List[AllocRecord]:
+        with self._lock:
+            return list(self._allocations.values())
+
+    # -- filtering / scoring (PreFilter path) ------------------------------
+
+    def check_quota_and_filter(self, req: AllocRequest, explain: bool = False
+                               ) -> Tuple[Dict[str, List[ChipState]],
+                                          Dict[str, str]]:
+        """Quota gate + filter chain.  Returns ({node: [chips]}, rejections).
+        Raises QuotaExceededError when the namespace quota cannot admit the
+        request (gpuallocator.go:1426 analog).
+
+        Large pools go through the vectorized mask path (rejection reasons
+        then require explain=True, which forces the Python chain — used by
+        the simulate-schedule API)."""
+        self.quota.check(req)
+        with self._lock:
+            candidates = self.chips(req.pool or None)
+            if not explain and len(candidates) > VECTORIZE_THRESHOLD:
+                return self._vector_filter(req), {}
+            result = run_filters(self._filters, req, candidates)
+            by_node: Dict[str, List[ChipState]] = {}
+            for chip in result.chips:
+                by_node.setdefault(chip.chip.status.node_name,
+                                   []).append(chip)
+            if req.same_node and req.chip_count > 1:
+                for node in [n for n, chips in by_node.items()
+                             if len(chips) < req.chip_count]:
+                    for c in by_node[node]:
+                        result.rejections[c.chip.name] = (
+                            f"[same-node] node {node} has only "
+                            f"{len(by_node[node])} eligible chips, "
+                            f"need {req.chip_count}")
+                    del by_node[node]
+            return by_node, result.rejections
+
+    def _vector_filter(self, req: AllocRequest) -> CandidateMap:
+        """Masked filtering over the pool's vector view (caller holds the
+        lock)."""
+        pool_key = req.pool or "*"
+        view = self._views.get(pool_key)
+        if view is None:
+            view = PoolVectorView(self.chips(req.pool or None))
+            self._views[pool_key] = view
+        mask = view.survivors(req)
+        # Rare constraint kinds fall back to per-chip Python checks on the
+        # survivors only.
+        if req.node_affinity or req.isolation == constants.ISOLATION_PARTITIONED:
+            import numpy as np
+            extra = [f for f in (NodeAffinityFilter(self._node_labels),
+                                 PartitionFitFilter())
+                     if req.node_affinity or isinstance(f, PartitionFitFilter)]
+            for i in np.nonzero(mask)[0]:
+                chip = view.states[i]
+                for f in extra:
+                    if f.check(req, chip) is not None:
+                        mask[i] = False
+                        break
+        min_count = req.chip_count if (req.same_node and req.chip_count > 1) \
+            else 1
+        return CandidateMap(view, mask, min_count=min_count)
+
+    def _refresh_views(self, chip_names: List[str]) -> None:
+        for view in self._views.values():
+            view.refresh(chip_names)
+
+    def score_nodes(self, req: AllocRequest,
+                    by_node: Dict[str, List[ChipState]]) -> Dict[str, float]:
+        strategy = self._strategy_for(req.pool)
+        if isinstance(by_node, CandidateMap):
+            return by_node.node_scores(strategy.name)
+        scores = {}
+        for node, chips in by_node.items():
+            if not chips:
+                continue
+            scores[node] = sum(strategy.score(c, for_node=True)
+                               for c in chips) / len(chips)
+        return scores
+
+    def select(self, req: AllocRequest, chips: List[ChipState]
+               ) -> List[ChipState]:
+        """Pick req.chip_count chips by the pool strategy
+        (gpuallocator.go:909 Select analog)."""
+        strategy = self._strategy_for(req.pool)
+        chosen = strategy.select(chips, req.chip_count)
+        if len(chosen) < req.chip_count:
+            raise InsufficientResourcesError(
+                f"need {req.chip_count} chips, only {len(chosen)} eligible")
+        return chosen
+
+    def _strategy_for(self, pool: str) -> Strategy:
+        with self._lock:
+            return self._strategies.get(pool) or new_strategy("CompactFirst")
+
+    # -- two-phase allocation ---------------------------------------------
+
+    def assume(self, req: AllocRequest, chips: List[ChipState]) -> AllocRecord:
+        """Hold capacity + quota for the Reserve->Bind window
+        (gpuallocator.go:1078 Assume analog)."""
+        key = req.key()
+        with self._lock:
+            if key in self._allocations:
+                raise AllocationConflictError(f"{key} already allocated")
+            self.quota.assume(req)
+            record = AllocRecord(request=req,
+                                 chip_ids=[c.chip.name for c in chips])
+            per_chip = ResourceAmount(tflops=req.request.tflops,
+                                      duty_percent=req.request.duty_percent,
+                                      hbm_bytes=req.request.hbm_bytes)
+            held = []
+            try:
+                for c in chips:
+                    c.hold(key, per_chip, req.partition_template)
+                    held.append(c)
+            except AllocationConflictError:
+                for c in held:
+                    c.drop(key, req.partition_template)
+                self.quota.unassume(req)
+                raise
+            self._allocations[key] = record
+            self._mark_dirty(record.chip_ids)
+            self._refresh_views(record.chip_ids)
+            return record
+
+    def unassume(self, key: str) -> None:
+        """Release an assumed-but-not-committed allocation (Unreserve)."""
+        with self._lock:
+            record = self._allocations.get(key)
+            if record is None or not record.assumed:
+                return
+            self._drop_record(record)
+
+    def commit(self, key: str) -> AllocRecord:
+        """Finalize an assumed allocation on bind (gpuallocator.go:1137)."""
+        with self._lock:
+            record = self._allocations.get(key)
+            if record is None:
+                raise NotFoundError(f"no allocation for {key}")
+            if record.assumed:
+                record.assumed = False
+                self.quota.commit(record.request)
+            self._mark_dirty(record.chip_ids)
+            return record
+
+    def alloc(self, req: AllocRequest) -> AllocRecord:
+        """One-shot allocate (filter+select+assume+commit) for callers
+        outside the scheduler (gpuallocator.go:1405 Alloc analog)."""
+        by_node, rejections = self.check_quota_and_filter(req)
+        pool_chips = [c for chips in by_node.values() for c in chips]
+        if not pool_chips:
+            raise InsufficientResourcesError(
+                f"no eligible chips: {json.dumps(rejections)[:400]}")
+        if req.same_node and req.chip_count > 1:
+            scores = self.score_nodes(req, by_node)
+            node = max(scores, key=scores.get)
+            pool_chips = by_node[node]
+        chosen = self.select(req, pool_chips)
+        self.assume(req, chosen)
+        return self.commit(req.key())
+
+    def dealloc(self, key: str) -> None:
+        """Release a committed allocation (gpuallocator.go:1503)."""
+        with self._lock:
+            record = self._allocations.get(key)
+            if record is None:
+                return
+            self._drop_record(record)
+
+    def _drop_record(self, record: AllocRecord) -> None:
+        for chip_name in record.chip_ids:
+            state = self._chips.get(chip_name)
+            if state is not None:
+                state.drop(record.key, record.request.partition_template)
+        if record.assumed:
+            self.quota.unassume(record.request)
+        else:
+            self.quota.release(record.request)
+        del self._allocations[record.key]
+        self._mark_dirty(record.chip_ids)
+        self._refresh_views(record.chip_ids)
+
+    # -- live vertical resize (gpuallocator.go:1600 AdjustAllocation) -----
+
+    def adjust_allocation(self, adjust: AdjustRequest,
+                          dry_run: bool = False) -> ResourceAmount:
+        key = f"{adjust.namespace}/{adjust.pod_name}"
+        with self._lock:
+            record = self._allocations.get(key)
+            if record is None:
+                raise NotFoundError(f"no allocation for {key}")
+            old = record.request.request
+            new = adjust.new_request
+            delta = new.sub(old)
+            # capacity check on every chip the pod holds
+            for chip_name in record.chip_ids:
+                state = self._chips.get(chip_name)
+                if state is None:
+                    continue
+                avail = state.available()
+                if delta.tflops > avail.tflops + 1e-9 or \
+                        delta.hbm_bytes > avail.hbm_bytes + 1e-9:
+                    raise InsufficientResourcesError(
+                        f"chip {chip_name} cannot absorb resize "
+                        f"(+{delta.tflops:.1f} tflops, "
+                        f"+{delta.hbm_bytes:.0f} B)")
+            # quota check: single cap against the NEW size, total cap
+            # against current usage plus the delta
+            if delta.tflops > 0 or delta.hbm_bytes > 0:
+                self.quota.check_adjust(adjust.namespace, old, new,
+                                        len(record.chip_ids))
+            if dry_run:
+                return delta
+            n = len(record.chip_ids)
+            for chip_name in record.chip_ids:
+                state = self._chips.get(chip_name)
+                if state is None:
+                    continue
+                state.allocated = state.allocated.add(delta)
+                state.holders[key] = state.holders[key].add(delta)
+                state.invalidate()
+            self.quota.adjust(adjust.namespace, delta.scale(n),
+                              adjust.new_limit.sub(
+                                  record.request.limit).scale(n))
+            record.request.request = new
+            record.request.limit = adjust.new_limit
+            self._mark_dirty(record.chip_ids)
+            self._refresh_views(record.chip_ids)
+            return delta
+
+    # -- partitions -------------------------------------------------------
+
+    def bind_partition(self, key: str, chip_name: str,
+                       partition_id: str) -> None:
+        with self._lock:
+            record = self._allocations.get(key)
+            if record is None:
+                raise NotFoundError(f"no allocation for {key}")
+            record.partitions[chip_name] = partition_id
+            self._mark_dirty([chip_name])
+
+    # -- assumed-allocation TTL sweep (gpuallocator.go:1348) ---------------
+
+    def sweep_assumed(self, now: Optional[float] = None) -> List[str]:
+        now = now or time.time()
+        swept = []
+        with self._lock:
+            for record in list(self._allocations.values()):
+                if not record.assumed:
+                    continue
+                if now - record.assumed_at < self.assume_ttl_s:
+                    continue
+                if self._gang_waiting_probe(record.key):
+                    continue  # gang member parked in Permit — keep holding
+                log.warning("sweeping stale assumed allocation %s",
+                            record.key)
+                self._drop_record(record)
+                swept.append(record.key)
+        return swept
+
+    # -- pod annotation contract ------------------------------------------
+
+    def stamp_pod(self, pod: Pod, record: AllocRecord) -> None:
+        """Persist the allocation onto the pod (PreBind analog,
+        gpuresources.go:859-1014) so state survives restarts."""
+        ann = pod.metadata.annotations
+        req = record.request
+        ann[constants.ANN_CHIP_IDS] = ",".join(record.chip_ids)
+        ann[constants.ANN_POOL] = req.pool
+        ann[constants.ANN_TFLOPS_REQUEST] = str(req.request.tflops)
+        ann[constants.ANN_HBM_REQUEST] = str(int(req.request.hbm_bytes))
+        ann[constants.ANN_TFLOPS_LIMIT] = str(req.limit.tflops)
+        ann[constants.ANN_HBM_LIMIT] = str(int(req.limit.hbm_bytes))
+        ann[constants.ANN_CHIP_COUNT] = str(req.chip_count)
+        ann[constants.ANN_QOS] = req.qos
+        ann[constants.ANN_ISOLATION] = req.isolation
+        if req.request.duty_percent:
+            ann[constants.ANN_DUTY_REQUEST] = str(req.request.duty_percent)
+        if req.limit.duty_percent:
+            ann[constants.ANN_DUTY_LIMIT] = str(req.limit.duty_percent)
+        if req.generation:
+            ann[constants.ANN_CHIP_GENERATION] = req.generation
+        if req.vendor:
+            ann[constants.ANN_VENDOR] = req.vendor
+        if req.chip_indices:
+            ann[constants.ANN_CHIP_INDICES] = ",".join(
+                str(i) for i in req.chip_indices)
+        if req.partition_template:
+            ann[constants.ANN_PARTITION_NAME] = req.partition_template
+        if record.partitions:
+            ann[constants.ANN_PARTITION_IDS] = json.dumps(record.partitions)
+        ann[constants.ANN_WORKLOAD] = req.workload_name
+
+    @staticmethod
+    def parse_pod(pod: Pod) -> Optional[AllocRecord]:
+        ann = pod.metadata.annotations
+        chip_ids = ann.get(constants.ANN_CHIP_IDS, "")
+        if not chip_ids:
+            return None
+        req = AllocRequest(
+            pool=ann.get(constants.ANN_POOL, ""),
+            namespace=pod.metadata.namespace,
+            workload_name=ann.get(constants.ANN_WORKLOAD, ""),
+            pod_name=pod.metadata.name,
+            request=ResourceAmount(
+                tflops=float(ann.get(constants.ANN_TFLOPS_REQUEST, 0) or 0),
+                duty_percent=float(ann.get(constants.ANN_DUTY_REQUEST, 0)
+                                   or 0),
+                hbm_bytes=float(ann.get(constants.ANN_HBM_REQUEST, 0) or 0)),
+            limit=ResourceAmount(
+                tflops=float(ann.get(constants.ANN_TFLOPS_LIMIT, 0) or 0),
+                duty_percent=float(ann.get(constants.ANN_DUTY_LIMIT, 0) or 0),
+                hbm_bytes=float(ann.get(constants.ANN_HBM_LIMIT, 0) or 0)),
+            chip_count=int(ann.get(constants.ANN_CHIP_COUNT, 1) or 1),
+            generation=ann.get(constants.ANN_CHIP_GENERATION, ""),
+            vendor=ann.get(constants.ANN_VENDOR, ""),
+            chip_indices=[int(x) for x in
+                          ann.get(constants.ANN_CHIP_INDICES, "").split(",")
+                          if x],
+            qos=ann.get(constants.ANN_QOS, constants.DEFAULT_QOS),
+            isolation=ann.get(constants.ANN_ISOLATION,
+                              constants.DEFAULT_ISOLATION),
+            partition_template=ann.get(constants.ANN_PARTITION_NAME, ""))
+        record = AllocRecord(request=req, chip_ids=chip_ids.split(","),
+                             assumed=False)
+        parts = ann.get(constants.ANN_PARTITION_IDS, "")
+        if parts:
+            record.partitions = json.loads(parts)
+        return record
+
+    def reconcile(self, pods: List[Pod]) -> int:
+        """Rebuild allocation state from pod annotations after a restart
+        (gpuallocator.go:2592 reconcileAllocationState analog)."""
+        with self._lock:
+            for state in self._chips.values():
+                state.allocated = ResourceAmount()
+                state.holders.clear()
+                state.partition_cores_used = 0
+            self._allocations.clear()
+            restored = 0
+            committed_reqs = []
+            for pod in pods:
+                if pod.status.phase in (constants.PHASE_SUCCEEDED,
+                                        constants.PHASE_FAILED):
+                    continue
+                record = self.parse_pod(pod)
+                if record is None:
+                    continue
+                per_chip = record.request.request
+                for chip_name in record.chip_ids:
+                    state = self._chips.get(chip_name)
+                    if state is None:
+                        log.warning("reconcile: pod %s references unknown "
+                                    "chip %s", record.key, chip_name)
+                        continue
+                    state.hold(record.key, per_chip,
+                               record.request.partition_template)
+                self._allocations[record.key] = record
+                committed_reqs.append(record.request)
+                restored += 1
+            self.quota.reconcile(committed_reqs)
+            self._dirty.update(self._chips.keys())
+            self._views.clear()
+            return restored
+
+    # -- store sync (gpuallocator.go:2309 SyncGPUsToK8s) -------------------
+
+    def _mark_dirty(self, chip_names: List[str]) -> None:
+        self._dirty.update(chip_names)
+
+    def sync_to_store(self) -> int:
+        if self.store is None:
+            return 0
+        with self._lock:
+            dirty = list(self._dirty)
+            self._dirty.clear()
+            snapshot = []
+            for name in dirty:
+                state = self._chips.get(name)
+                if state is None:
+                    continue
+                holders = [k for k in state.holders]
+                snapshot.append((name, state.available(), holders))
+        n = 0
+        for name, avail, holders in snapshot:
+            obj = self.store.try_get(TPUChip, name)
+            if obj is None:
+                continue
+            obj.status.available = avail
+            obj.status.running_apps = holders
+            self.store.update(obj)
+            n += 1
+        self.quota.sync_to_store()
+        return n

@@ -1,0 +1,170 @@
+"""Chip filter chain.
+
+Analog of the reference's chain-of-responsibility GPU filters
+(``internal/gpuallocator/filter/filter.go:19-58`` registry): each filter
+prunes the candidate chip list for one AllocRequest and reports a reason
+for every chip it rejects (surfaced by the simulate-schedule API).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, TYPE_CHECKING
+
+from .. import constants
+from ..api.resources import AllocRequest
+
+if TYPE_CHECKING:
+    from .core import ChipState
+
+
+@dataclass
+class FilterResult:
+    chips: List["ChipState"]
+    rejections: Dict[str, str] = field(default_factory=dict)  # chip -> reason
+
+
+class Filter:
+    name = "filter"
+
+    def check(self, req: AllocRequest, chip: "ChipState") -> Optional[str]:
+        """Return None if the chip passes, else a rejection reason."""
+        raise NotImplementedError
+
+
+class PhaseFilter(Filter):
+    name = "phase"
+
+    def check(self, req, chip):
+        phase = chip.chip.status.phase
+        if phase != constants.PHASE_RUNNING:
+            return f"chip phase {phase} is not Running"
+        if chip.chip.status.used_by != constants.CHIP_USED_BY_TPU_FUSION:
+            return f"chip used by {chip.chip.status.used_by}"
+        return None
+
+
+class IsolationCapabilityFilter(Filter):
+    """Vendor capability tiers (constants.PARTITIONING_VENDORS etc.)."""
+
+    name = "isolation"
+
+    def check(self, req, chip):
+        caps = chip.chip.status.capabilities
+        if req.isolation == constants.ISOLATION_PARTITIONED and \
+                not caps.get("core_partitioning", False):
+            return "chip does not support core partitioning"
+        if req.isolation == constants.ISOLATION_SOFT and \
+                not caps.get("soft_isolation", True):
+            return "chip does not support soft isolation"
+        if req.isolation == constants.ISOLATION_HARD and \
+                not caps.get("hard_isolation", False):
+            return "chip does not support hard isolation"
+        return None
+
+
+class GenerationFilter(Filter):
+    name = "generation"
+
+    def check(self, req, chip):
+        if req.generation and chip.chip.status.generation != req.generation:
+            return (f"generation {chip.chip.status.generation} != "
+                    f"requested {req.generation}")
+        return None
+
+
+class VendorFilter(Filter):
+    name = "vendor"
+
+    def check(self, req, chip):
+        if req.vendor and chip.chip.status.vendor != req.vendor:
+            return f"vendor {chip.chip.status.vendor} != {req.vendor}"
+        return None
+
+
+class IndexFilter(Filter):
+    name = "index"
+
+    def check(self, req, chip):
+        if req.chip_indices and \
+                chip.chip.status.host_index not in req.chip_indices:
+            return f"host index {chip.chip.status.host_index} not in " \
+                   f"{req.chip_indices}"
+        return None
+
+
+class NodeAffinityFilter(Filter):
+    name = "node-affinity"
+
+    def __init__(self, node_labels: Callable[[str], Dict[str, str]]):
+        self._node_labels = node_labels
+
+    def check(self, req, chip):
+        if not req.node_affinity:
+            return None
+        labels = self._node_labels(chip.chip.status.node_name) or {}
+        for k, v in req.node_affinity.items():
+            if labels.get(k) != v:
+                return f"node {chip.chip.status.node_name} lacks {k}={v}"
+        return None
+
+
+class ResourceFitFilter(Filter):
+    """Capacity check: request must fit the chip's remaining virtual
+    TFLOPs (oversold) and physical HBM."""
+
+    name = "resource-fit"
+
+    def check(self, req, chip):
+        avail = chip.available()
+        if req.request.tflops > avail.tflops + 1e-9:
+            return (f"insufficient tflops: want {req.request.tflops:.1f}, "
+                    f"have {avail.tflops:.1f}")
+        if req.request.hbm_bytes > avail.hbm_bytes + 1e-9:
+            return (f"insufficient HBM: want {req.request.hbm_bytes:.0f}, "
+                    f"have {avail.hbm_bytes:.0f}")
+        return None
+
+
+class PartitionFitFilter(Filter):
+    """Partitioned isolation: the chip must have a free slot for the
+    requested partition template."""
+
+    name = "partition-fit"
+
+    def check(self, req, chip):
+        if req.isolation != constants.ISOLATION_PARTITIONED:
+            return None
+        if not req.partition_template:
+            return "partitioned request without a template"
+        free = chip.free_partition_cores()
+        want = chip.template_core_count(req.partition_template)
+        if want is None:
+            return f"unknown partition template {req.partition_template}"
+        if want > free:
+            return f"no free cores for template {req.partition_template} " \
+                   f"(want {want}, free {free})"
+        return None
+
+
+def default_chain(node_labels: Callable[[str], Dict[str, str]]
+                  ) -> List[Filter]:
+    return [PhaseFilter(), IsolationCapabilityFilter(), GenerationFilter(),
+            VendorFilter(), IndexFilter(), NodeAffinityFilter(node_labels),
+            PartitionFitFilter(), ResourceFitFilter()]
+
+
+def run_filters(filters: List[Filter], req: AllocRequest,
+                chips: List["ChipState"]) -> FilterResult:
+    passed = []
+    rejections: Dict[str, str] = {}
+    for chip in chips:
+        reason = None
+        for f in filters:
+            reason = f.check(req, chip)
+            if reason is not None:
+                rejections[chip.chip.name] = f"[{f.name}] {reason}"
+                break
+        if reason is None:
+            passed.append(chip)
+    return FilterResult(chips=passed, rejections=rejections)

@@ -1,0 +1,46 @@
+"""Small-int device-allocation index per pod.
+
+Analog of the reference's ``internal/indexallocator/indexallocator.go:29-345``:
+every vTPU pod gets a small integer index (annotation ``tpu-fusion.ai/index``)
+used to correlate the pod with its device-plugin allocation slot.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+
+class IndexExhaustedError(Exception):
+    pass
+
+
+class IndexAllocator:
+    def __init__(self, max_index: int = 1024):
+        self.max_index = max_index
+        self._lock = threading.RLock()
+        self._by_owner: Dict[str, int] = {}
+        self._used = set()
+
+    def assign(self, owner: str) -> int:
+        with self._lock:
+            if owner in self._by_owner:
+                return self._by_owner[owner]
+            for i in range(self.max_index):
+                if i not in self._used:
+                    self._used.add(i)
+                    self._by_owner[owner] = i
+                    return i
+            raise IndexExhaustedError(f"all {self.max_index} indices in use")
+
+    def release(self, owner: str) -> Optional[int]:
+        with self._lock:
+            idx = self._by_owner.pop(owner, None)
+            if idx is not None:
+                self._used.discard(idx)
+            return idx
+
+    def reconcile(self, assignments: Dict[str, int]) -> None:
+        with self._lock:
+            self._by_owner = dict(assignments)
+            self._used = set(assignments.values())

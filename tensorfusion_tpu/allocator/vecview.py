@@ -1,0 +1,193 @@
+"""Vectorized chip-store view: numpy-masked filtering and scoring.
+
+The reference's allocator iterates every GPU per scheduling cycle in Go
+(``gpuallocator.go:610`` Filter) and still clears 400-500 pods/s at 4,000
+GPUs.  A Python per-chip filter chain cannot match that, so the hot path is
+vectorized: each pool keeps parallel numpy arrays (availability, capacity,
+phase, generation/vendor codes, isolation capabilities, node index) and a
+scheduling cycle evaluates the common filters as boolean masks in C.  The
+Python filter chain remains the source of truth for rejection *reasons*
+(the simulate-schedule API) and for rare constraint kinds (explicit chip
+indices, node affinity, partition templates), applied only to mask
+survivors.
+
+``CandidateMap`` is the lazy `{node: [ChipState]}` mapping returned to the
+scheduler: membership and counts come from bincounts; per-node chip lists
+materialize only for nodes the cycle actually touches (Reserve, topology
+planning).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Mapping, Optional, TYPE_CHECKING
+
+import numpy as np
+
+from .. import constants
+
+if TYPE_CHECKING:
+    from ..api.resources import AllocRequest
+    from .core import ChipState
+
+
+class PoolVectorView:
+    def __init__(self, chips: List["ChipState"]):
+        self.states = list(chips)
+        self.names = [c.chip.name for c in self.states]
+        self.index = {n: i for i, n in enumerate(self.names)}
+        n = len(self.states)
+        self.avail_tflops = np.zeros(n)
+        self.avail_hbm = np.zeros(n)
+        self.cap_tflops = np.zeros(n)
+        self.cap_hbm = np.zeros(n)
+        self.phase_ok = np.zeros(n, dtype=bool)
+        self.soft_ok = np.zeros(n, dtype=bool)
+        self.hard_ok = np.zeros(n, dtype=bool)
+        self.part_ok = np.zeros(n, dtype=bool)
+        self.free_cores = np.zeros(n, dtype=np.int32)
+
+        self.node_names: List[str] = []
+        node_idx_map: Dict[str, int] = {}
+        self.node_idx = np.zeros(n, dtype=np.int64)
+        self.gen_names: List[str] = []
+        gen_map: Dict[str, int] = {}
+        self.gen_code = np.zeros(n, dtype=np.int32)
+        self.vendor_names: List[str] = []
+        vendor_map: Dict[str, int] = {}
+        self.vendor_code = np.zeros(n, dtype=np.int32)
+        self.host_index = np.zeros(n, dtype=np.int32)
+
+        for i, c in enumerate(self.states):
+            st = c.chip.status
+            node = st.node_name
+            if node not in node_idx_map:
+                node_idx_map[node] = len(self.node_names)
+                self.node_names.append(node)
+            self.node_idx[i] = node_idx_map[node]
+            if st.generation not in gen_map:
+                gen_map[st.generation] = len(self.gen_names)
+                self.gen_names.append(st.generation)
+            self.gen_code[i] = gen_map[st.generation]
+            if st.vendor not in vendor_map:
+                vendor_map[st.vendor] = len(self.vendor_names)
+                self.vendor_names.append(st.vendor)
+            self.vendor_code[i] = vendor_map[st.vendor]
+            self.host_index[i] = st.host_index
+            self.refresh_row(i)
+        self.gen_map = gen_map
+        self.vendor_map = vendor_map
+
+    def refresh_row(self, i: int) -> None:
+        c = self.states[i]
+        st = c.chip.status
+        avail = c.available()
+        cap = c.virtual_capacity()
+        self.avail_tflops[i] = avail.tflops
+        self.avail_hbm[i] = avail.hbm_bytes
+        self.cap_tflops[i] = cap.tflops
+        self.cap_hbm[i] = cap.hbm_bytes
+        self.phase_ok[i] = (st.phase == constants.PHASE_RUNNING
+                            and st.used_by == constants.CHIP_USED_BY_TPU_FUSION)
+        caps = st.capabilities
+        self.soft_ok[i] = caps.get("soft_isolation", True)
+        self.hard_ok[i] = caps.get("hard_isolation", False)
+        self.part_ok[i] = caps.get("core_partitioning", False)
+        self.free_cores[i] = c.free_partition_cores()
+
+    def refresh(self, chip_names) -> None:
+        for name in chip_names:
+            i = self.index.get(name)
+            if i is not None:
+                self.refresh_row(i)
+
+    # -- masked filtering -------------------------------------------------
+
+    def survivors(self, req: "AllocRequest") -> np.ndarray:
+        mask = self.phase_ok.copy()
+        np.logical_and(mask, self.avail_tflops >= req.request.tflops - 1e-9,
+                       out=mask)
+        np.logical_and(mask, self.avail_hbm >= req.request.hbm_bytes - 1e-9,
+                       out=mask)
+        if req.generation:
+            code = self.gen_map.get(req.generation, -1)
+            np.logical_and(mask, self.gen_code == code, out=mask)
+        if req.vendor:
+            code = self.vendor_map.get(req.vendor, -1)
+            np.logical_and(mask, self.vendor_code == code, out=mask)
+        if req.isolation == constants.ISOLATION_SOFT:
+            np.logical_and(mask, self.soft_ok, out=mask)
+        elif req.isolation == constants.ISOLATION_HARD:
+            np.logical_and(mask, self.hard_ok, out=mask)
+        elif req.isolation == constants.ISOLATION_PARTITIONED:
+            np.logical_and(mask, self.part_ok, out=mask)
+        if req.chip_indices:
+            np.logical_and(mask, np.isin(self.host_index,
+                                         np.array(req.chip_indices)),
+                           out=mask)
+        return mask
+
+    def util(self) -> np.ndarray:
+        with np.errstate(divide="ignore", invalid="ignore"):
+            ut = np.where(self.cap_tflops > 0,
+                          1.0 - self.avail_tflops / self.cap_tflops, 0.0)
+            uh = np.where(self.cap_hbm > 0,
+                          1.0 - self.avail_hbm / self.cap_hbm, 0.0)
+        return np.clip(0.5 * ut + 0.5 * uh, 0.0, 1.0)
+
+
+class CandidateMap(Mapping):
+    """Lazy {node_name: [ChipState]} over a survivor mask."""
+
+    def __init__(self, view: PoolVectorView, mask: np.ndarray,
+                 min_count: int = 1):
+        self.view = view
+        self.mask = mask
+        self.survivor_idx = np.nonzero(mask)[0]
+        counts = np.bincount(view.node_idx[self.survivor_idx],
+                             minlength=len(view.node_names)) \
+            if len(self.survivor_idx) else np.zeros(len(view.node_names),
+                                                    dtype=np.int64)
+        self.counts = counts
+        self._eligible = {view.node_names[i] for i in np.nonzero(
+            counts >= min_count)[0]}
+        self._cache: Dict[str, List["ChipState"]] = {}
+        self._node_id = {n: i for i, n in enumerate(view.node_names)}
+
+    def __contains__(self, node) -> bool:
+        return node in self._eligible
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._eligible)
+
+    def __len__(self) -> int:
+        return len(self._eligible)
+
+    def __getitem__(self, node: str) -> List["ChipState"]:
+        if node not in self._eligible:
+            raise KeyError(node)
+        if node not in self._cache:
+            nid = self._node_id[node]
+            idxs = self.survivor_idx[
+                self.view.node_idx[self.survivor_idx] == nid]
+            self._cache[node] = [self.view.states[i] for i in idxs]
+        return self._cache[node]
+
+    # -- vectorized node scores ------------------------------------------
+
+    def node_scores(self, placement_mode: str) -> Dict[str, float]:
+        if not len(self.survivor_idx):
+            return {}
+        util = self.view.util()[self.survivor_idx]
+        if placement_mode == "LowLoadFirst":
+            score = 100.0 * (1.0 - util)
+        else:  # CompactFirst / NodeCompactChipLowLoad rank nodes by packing
+            score = 100.0 * util
+        nodes = self.view.node_idx[self.survivor_idx]
+        sums = np.bincount(nodes, weights=score,
+                           minlength=len(self.view.node_names))
+        counts = np.bincount(nodes, minlength=len(self.view.node_names))
+        out = {}
+        for name in self._eligible:
+            i = self._node_id[name]
+            out[name] = float(sums[i] / counts[i]) if counts[i] else 0.0
+        return out

@@ -1,0 +1,7 @@
+"""Accelerator-first scheduler: framework, fit plugin, ICI topology, gangs."""
+
+from .framework import (Code, CycleState, OK, Plugin, Scheduler, Status,
+                        WaitingPod)
+from .gang import GangGroup, GangManager, gang_info_from_pod
+from .topo import ICITopologyPlugin, NodeTopologyPlan, plan_for_node
+from .tpuresources import TPUResourcesFit, compose_alloc_request

@@ -1,0 +1,445 @@
+"""Scheduling framework: plugin API + scheduling cycle.
+
+The reference embeds a patched kube-scheduler and registers out-of-tree
+plugins (``cmd/sched/setup.go:62-183``); tpu-fusion has no Kubernetes, so
+this module *is* the scheduler — a from-scratch implementation of the same
+extension-point contract (PreEnqueue, PreFilter, Filter, PostFilter, Score,
+Reserve, Permit, PreBind, Bind, PostBind, Unreserve) with an active queue,
+an unschedulable set with event-driven requeue, and asynchronous Permit
+waiting (gang members park without blocking the scheduling loop).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .. import constants
+from ..api.types import Pod
+
+log = logging.getLogger("tpf.scheduler")
+
+
+class Code(Enum):
+    SUCCESS = "Success"
+    UNSCHEDULABLE = "Unschedulable"
+    WAIT = "Wait"
+    ERROR = "Error"
+    SKIP = "Skip"
+
+
+@dataclass
+class Status:
+    code: Code = Code.SUCCESS
+    reason: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return self.code in (Code.SUCCESS, Code.SKIP)
+
+
+OK = Status()
+
+
+class CycleState(dict):
+    """Per-pod scheduling-cycle scratch space (CycleState analog)."""
+
+
+#: a PreFilter plugin may narrow the node search space by storing a set of
+#: node names here (kube-scheduler PreFilterResult analog)
+STATE_PREFILTER_NODES = "prefilter/node_names"
+
+
+class Plugin:
+    name = "plugin"
+
+
+class PreEnqueuePlugin(Plugin):
+    def pre_enqueue(self, pod: Pod) -> Status: return OK
+
+
+class PreFilterPlugin(Plugin):
+    def pre_filter(self, state: CycleState, pod: Pod) -> Status: return OK
+
+
+class FilterPlugin(Plugin):
+    def filter(self, state: CycleState, pod: Pod, node: str) -> Status:
+        return OK
+
+
+class PostFilterPlugin(Plugin):
+    def post_filter(self, state: CycleState, pod: Pod,
+                    statuses: Dict[str, Status]) -> Tuple[Optional[str], Status]:
+        """May nominate a node (after preemption).  Returns (node, status)."""
+        return None, Status(Code.UNSCHEDULABLE)
+
+
+class ScorePlugin(Plugin):
+    def score(self, state: CycleState, pod: Pod, node: str) -> float:
+        return 0.0
+
+
+class ReservePlugin(Plugin):
+    def reserve(self, state: CycleState, pod: Pod, node: str) -> Status:
+        return OK
+
+    def unreserve(self, state: CycleState, pod: Pod, node: str) -> None:
+        pass
+
+
+class PermitPlugin(Plugin):
+    def permit(self, state: CycleState, pod: Pod,
+               node: str) -> Tuple[Status, float]:
+        """Returns (status, wait_timeout_seconds)."""
+        return OK, 0.0
+
+
+class PreBindPlugin(Plugin):
+    def pre_bind(self, state: CycleState, pod: Pod, node: str) -> Status:
+        return OK
+
+
+class PostBindPlugin(Plugin):
+    def post_bind(self, state: CycleState, pod: Pod, node: str) -> None:
+        pass
+
+
+@dataclass
+class _QueuedPod:
+    priority: int
+    ts: float
+    pod: Pod = field(compare=False)
+
+    def __lt__(self, other):
+        return (-self.priority, self.ts) < (-other.priority, other.ts)
+
+
+@dataclass
+class WaitingPod:
+    pod: Pod
+    state: CycleState
+    node: str
+    deadline: float
+    allowed: Optional[bool] = None
+    reason: str = ""
+
+
+class Scheduler:
+    """One scheduling loop over our Pod objects.
+
+    ``nodes_fn`` lists schedulable node names; ``bind_fn(pod, node)``
+    persists the binding (sets pod.spec.node_name in the object store).
+    """
+
+    def __init__(self, nodes_fn: Callable[[], List[str]],
+                 bind_fn: Callable[[Pod, str], None],
+                 failure_handler: Optional[Callable[[Pod, str], None]] = None):
+        self.nodes_fn = nodes_fn
+        self.bind_fn = bind_fn
+        self.failure_handler = failure_handler
+        self.plugins: List[Plugin] = []
+        self._of_cache: Dict[type, List[Plugin]] = {}
+        self._active: "queue.PriorityQueue[_QueuedPod]" = queue.PriorityQueue()
+        self._unschedulable: Dict[str, Pod] = {}
+        self._gated: Dict[str, Pod] = {}
+        self._waiting: Dict[str, WaitingPod] = {}
+        self._in_queue: set = set()
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._timer: Optional[threading.Thread] = None
+        # counters for benchmarks / metrics
+        self.scheduled_count = 0
+        self.failed_count = 0
+        #: called with (pod_key, reason) whenever a parked pod is rejected
+        self.permit_reject_listeners: List[Callable[[str, str], None]] = []
+
+    # -- plugin registry --------------------------------------------------
+
+    def register(self, plugin: Plugin) -> None:
+        self.plugins.append(plugin)
+        self._of_cache = {}
+
+    def _of(self, cls) -> List[Plugin]:
+        got = self._of_cache.get(cls)
+        if got is None:
+            got = [p for p in self.plugins if isinstance(p, cls)]
+            self._of_cache[cls] = got
+        return got
+
+    # -- queue ------------------------------------------------------------
+
+    def enqueue(self, pod: Pod) -> None:
+        key = pod.key()
+        for p in self._of(PreEnqueuePlugin):
+            st = p.pre_enqueue(pod)
+            if not st.ok:
+                log.debug("pod %s gated by %s: %s", key, p.name, st.reason)
+                with self._lock:
+                    self._gated[key] = pod
+                return
+        with self._lock:
+            if key in self._in_queue or key in self._waiting:
+                return
+            self._in_queue.add(key)
+            self._unschedulable.pop(key, None)
+            self._gated.pop(key, None)
+        self._active.put(_QueuedPod(pod.spec.priority, time.monotonic(), pod))
+
+    def activate(self) -> None:
+        """Requeue unschedulable + gated pods (event-driven wakeup — the
+        simplified analog of the reference's queueing hints,
+        gpuresources.go:1042-1286)."""
+        with self._lock:
+            pods = list(self._unschedulable.values()) + \
+                list(self._gated.values())
+            self._unschedulable.clear()
+            self._gated.clear()
+        for pod in pods:
+            self.enqueue(pod)
+
+    def forget(self, pod_key: str) -> None:
+        with self._lock:
+            self._unschedulable.pop(pod_key, None)
+            self._gated.pop(pod_key, None)
+            w = self._waiting.pop(pod_key, None)
+        if w is not None:
+            self._finish_waiting(w, allowed=False, reason="pod deleted")
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop,
+                                        name="tpf-sched", daemon=True)
+        self._thread.start()
+        self._timer = threading.Thread(target=self._permit_timeout_loop,
+                                       name="tpf-sched-permit", daemon=True)
+        self._timer.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+        if self._timer:
+            self._timer.join(timeout=2)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                item = self._active.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            with self._lock:
+                self._in_queue.discard(item.pod.key())
+            try:
+                self.schedule_one(item.pod)
+            except Exception:
+                log.exception("scheduling cycle for %s crashed",
+                              item.pod.key())
+
+    # -- the scheduling cycle (SURVEY.md §3.3) ----------------------------
+
+    def schedule_one(self, pod: Pod) -> Status:
+        state = CycleState()
+        key = pod.key()
+
+        # PreFilter (an Unschedulable result still gets a PostFilter /
+        # preemption attempt, matching kube-scheduler semantics)
+        for p in self._of(PreFilterPlugin):
+            st = p.pre_filter(state, pod)
+            if st.code == Code.ERROR:
+                return self._fail(pod, state, st)
+            if not st.ok:
+                return self._post_filter_or_unsched(pod, state, st, {})
+
+        # Filter over all nodes (narrowed by PreFilterResult when provided).
+        # Like kube-scheduler's numFeasibleNodesToFind, stop once enough
+        # feasible nodes are found on large clusters.
+        narrowed = state.get(STATE_PREFILTER_NODES)
+        nodes = list(narrowed) if narrowed is not None else self.nodes_fn()
+        enough = self._num_feasible_to_find(len(nodes))
+        statuses: Dict[str, Status] = {}
+        feasible: List[str] = []
+        filter_plugins = self._of(FilterPlugin)
+        for node in nodes:
+            node_st = OK
+            for p in filter_plugins:
+                node_st = p.filter(state, pod, node)
+                if not node_st.ok:
+                    break
+            statuses[node] = node_st
+            if node_st.ok:
+                feasible.append(node)
+                if len(feasible) >= enough:
+                    break
+
+        # PostFilter (preemption) when nothing fits
+        if not feasible:
+            return self._post_filter_or_unsched(
+                pod, state,
+                Status(Code.UNSCHEDULABLE, f"0/{len(nodes)} nodes feasible"),
+                statuses)
+
+        # Score
+        best, best_score = feasible[0], float("-inf")
+        for node in feasible:
+            total = sum(p.score(state, pod, node)
+                        for p in self._of(ScorePlugin))
+            if total > best_score:
+                best, best_score = node, total
+
+        # Reserve
+        reserved: List[ReservePlugin] = []
+        for p in self._of(ReservePlugin):
+            st = p.reserve(state, pod, best)
+            if not st.ok:
+                for r in reversed(reserved):
+                    r.unreserve(state, pod, best)
+                return self._unsched(pod, state, st)
+            reserved.append(p)
+
+        # Permit
+        max_wait = 0.0
+        wait = False
+        for p in self._of(PermitPlugin):
+            st, timeout = p.permit(state, pod, best)
+            if st.code == Code.WAIT:
+                wait = True
+                max_wait = max(max_wait, timeout)
+            elif not st.ok:
+                self._unreserve_all(state, pod, best)
+                return self._unsched(pod, state, st)
+        if wait:
+            deadline = time.monotonic() + (max_wait if max_wait > 0
+                                           else 3600.0)
+            with self._lock:
+                self._waiting[key] = WaitingPod(pod, state, best, deadline)
+            log.debug("pod %s waiting in Permit (%.0fs)", key, max_wait)
+            return Status(Code.WAIT)
+
+        return self._bind(pod, state, best)
+
+    # -- permit resolution ------------------------------------------------
+
+    def allow_waiting(self, pod_key: str) -> bool:
+        with self._lock:
+            w = self._waiting.pop(pod_key, None)
+        if w is None:
+            return False
+        self._finish_waiting(w, allowed=True)
+        return True
+
+    def reject_waiting(self, pod_key: str, reason: str = "") -> bool:
+        with self._lock:
+            w = self._waiting.pop(pod_key, None)
+        if w is None:
+            return False
+        self._finish_waiting(w, allowed=False, reason=reason)
+        return True
+
+    def waiting_pods(self) -> List[str]:
+        with self._lock:
+            return list(self._waiting)
+
+    def is_waiting(self, pod_key: str) -> bool:
+        with self._lock:
+            return pod_key in self._waiting
+
+    def _finish_waiting(self, w: WaitingPod, allowed: bool,
+                        reason: str = "") -> None:
+        if allowed:
+            self._bind(w.pod, w.state, w.node)
+        else:
+            for listener in self.permit_reject_listeners:
+                try:
+                    listener(w.pod.key(), reason)
+                except Exception:
+                    log.exception("permit-reject listener failed")
+            self._unreserve_all(w.state, w.pod, w.node)
+            self._unsched(w.pod, w.state,
+                          Status(Code.UNSCHEDULABLE,
+                                 reason or "rejected in Permit"))
+
+    def _permit_timeout_loop(self) -> None:
+        while not self._stop.wait(0.1):
+            now = time.monotonic()
+            expired = []
+            with self._lock:
+                for key, w in list(self._waiting.items()):
+                    if now >= w.deadline:
+                        expired.append(key)
+            for key in expired:
+                log.warning("pod %s timed out in Permit", key)
+                self.reject_waiting(key, "permit timeout")
+
+    # -- bind -------------------------------------------------------------
+
+    def _bind(self, pod: Pod, state: CycleState, node: str) -> Status:
+        for p in self._of(PreBindPlugin):
+            st = p.pre_bind(state, pod, node)
+            if not st.ok:
+                self._unreserve_all(state, pod, node)
+                return self._unsched(pod, state, st)
+        try:
+            self.bind_fn(pod, node)
+        except Exception as e:  # noqa: BLE001
+            self._unreserve_all(state, pod, node)
+            return self._fail(pod, state, Status(Code.ERROR, str(e)))
+        pod.spec.node_name = node
+        pod.status.phase = constants.PHASE_RUNNING
+        for p in self._of(PostBindPlugin):
+            p.post_bind(state, pod, node)
+        self.scheduled_count += 1
+        log.debug("bound %s -> %s", pod.key(), node)
+        return OK
+
+    @staticmethod
+    def _num_feasible_to_find(num_nodes: int) -> int:
+        """Adaptive feasible-node cap (kube-scheduler's
+        numFeasibleNodesToFind semantics: all nodes below 100, then a
+        shrinking percentage with a floor of 100)."""
+        if num_nodes <= 100:
+            return num_nodes
+        pct = max(5, 50 - num_nodes // 125)
+        return max(100, num_nodes * pct // 100)
+
+    def _post_filter_or_unsched(self, pod: Pod, state: CycleState,
+                                st: Status,
+                                statuses: Dict[str, Status]) -> Status:
+        for p in self._of(PostFilterPlugin):
+            nominated, pf_st = p.post_filter(state, pod, statuses)
+            if pf_st.ok and nominated:
+                pod.status.nominated_node_name = nominated
+                return self._unsched(pod, state, Status(
+                    Code.UNSCHEDULABLE,
+                    f"nominated {nominated} after preemption"))
+        return self._unsched(pod, state, st)
+
+    def _unreserve_all(self, state: CycleState, pod: Pod, node: str) -> None:
+        for p in reversed(self._of(ReservePlugin)):
+            p.unreserve(state, pod, node)
+
+    def _unsched(self, pod: Pod, state: CycleState, st: Status) -> Status:
+        key = pod.key()
+        log.debug("pod %s unschedulable: %s", key, st.reason)
+        with self._lock:
+            self._unschedulable[key] = pod
+        self.failed_count += 1
+        if self.failure_handler is not None:
+            try:
+                self.failure_handler(pod, st.reason)
+            except Exception:
+                log.exception("failure handler for %s crashed", key)
+        return st
+
+    def _fail(self, pod: Pod, state: CycleState, st: Status) -> Status:
+        log.error("pod %s scheduling error: %s", pod.key(), st.reason)
+        with self._lock:
+            self._unschedulable[pod.key()] = pod
+        self.failed_count += 1
+        return st

@@ -1,0 +1,210 @@
+"""Gang scheduling manager.
+
+Analog of the reference's annotation-driven PodGroups
+(``internal/gang/manager.go``): PreEnqueue quorum gate (:509), Permit
+wait-or-allow with a per-group waiting map (:746-882), group reject +
+backoff on an unschedulable member (:262, :1099), timeout handling (:977).
+
+A gang is declared with the ``tpu-fusion.ai/gang-*`` annotations stamped by
+the admission webhook: group key, desired members, required members
+(quorum), timeout, and strict mode.  On TPU pools gangs are the norm — an
+SPMD job over a pod slice needs every host of the slice or none.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from .. import constants
+from ..api.types import Pod
+from .framework import Code, OK, Status
+
+log = logging.getLogger("tpf.scheduler.gang")
+
+DEFAULT_GANG_TIMEOUT_S = 600.0
+
+
+@dataclass
+class GangGroup:
+    key: str
+    desired: int = 0
+    required: int = 0
+    strict: bool = False
+    timeout_s: float = DEFAULT_GANG_TIMEOUT_S
+    members: Set[str] = field(default_factory=set)       # observed pod keys
+    waiting: Set[str] = field(default_factory=set)       # parked in Permit
+    scheduled: Set[str] = field(default_factory=set)     # bound
+    rejected_until: float = 0.0                          # group backoff
+    created_at: float = field(default_factory=time.time)
+
+
+def gang_info_from_pod(pod: Pod) -> Optional[Tuple[str, int, int, float, bool]]:
+    ann = pod.metadata.annotations
+    if ann.get(constants.ANN_GANG_ENABLED, "").lower() not in ("true", "1"):
+        return None
+    group_key = ann.get(constants.ANN_GANG_GROUP_KEY) or \
+        f"{pod.metadata.namespace}/{ann.get(constants.ANN_WORKLOAD, pod.metadata.name)}"
+    desired = int(ann.get(constants.ANN_GANG_DESIRED_MEMBERS, 0) or 0)
+    required = int(ann.get(constants.ANN_GANG_REQUIRED_MEMBERS, 0) or
+                   ann.get(constants.ANN_GANG_MIN_MEMBERS, 0) or desired)
+    timeout = float(ann.get(constants.ANN_GANG_TIMEOUT,
+                            DEFAULT_GANG_TIMEOUT_S) or DEFAULT_GANG_TIMEOUT_S)
+    strict = ann.get(constants.ANN_GANG_MIN_MEMBERS, "") != "" and \
+        required >= desired > 0
+    return group_key, desired, required, timeout, strict
+
+
+class GangManager:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._groups: Dict[str, GangGroup] = {}
+        self._pod_group: Dict[str, str] = {}
+        # wired to the scheduler after construction
+        self.allow_fn: Callable[[str], bool] = lambda key: False
+        self.reject_fn: Callable[[str, str], bool] = lambda key, r: False
+        self.status_sink: Optional[Callable[[GangGroup], None]] = None
+
+    def bind_scheduler(self, scheduler) -> None:
+        self.allow_fn = scheduler.allow_waiting
+        self.reject_fn = scheduler.reject_waiting
+        # Keep gang waiting-sets honest when the scheduler rejects or times
+        # out a parked pod for any reason.
+        scheduler.permit_reject_listeners.append(self.on_permit_rejected)
+
+    # -- membership -------------------------------------------------------
+
+    def observe(self, pod: Pod) -> Optional[GangGroup]:
+        info = gang_info_from_pod(pod)
+        if info is None:
+            return None
+        group_key, desired, required, timeout, strict = info
+        with self._lock:
+            g = self._groups.get(group_key)
+            if g is None:
+                g = GangGroup(key=group_key, desired=desired,
+                              required=required, timeout_s=timeout,
+                              strict=strict)
+                self._groups[group_key] = g
+            else:
+                g.desired = max(g.desired, desired)
+                g.required = max(g.required, required)
+            g.members.add(pod.key())
+            self._pod_group[pod.key()] = group_key
+            return g
+
+    def group_of(self, pod_key: str) -> Optional[GangGroup]:
+        with self._lock:
+            gk = self._pod_group.get(pod_key)
+            return self._groups.get(gk) if gk else None
+
+    # -- scheduler extension points ---------------------------------------
+
+    def pre_enqueue(self, pod: Pod) -> Status:
+        """Quorum gate: don't let gang members enter the scheduling queue
+        until enough members exist (gang/manager.go:509)."""
+        g = self.observe(pod)
+        if g is None:
+            return OK
+        now = time.time()
+        if now < g.rejected_until:
+            return Status(Code.UNSCHEDULABLE,
+                          f"gang {g.key} backing off after reject")
+        if g.required > 0 and len(g.members) < g.required:
+            return Status(
+                Code.UNSCHEDULABLE,
+                f"gang {g.key} quorum {len(g.members)}/{g.required}")
+        return OK
+
+    def permit(self, pod: Pod) -> Tuple[Status, float]:
+        """Wait-or-allow (gang/manager.go:746-882): the pod that completes
+        the quorum releases every waiting member."""
+        key = pod.key()
+        with self._lock:
+            g = self.group_of(key)
+            if g is None:
+                return OK, 0.0
+            ready = len(g.waiting | {key}) + len(g.scheduled)
+            if g.required > 0 and ready < g.required:
+                g.waiting.add(key)
+                return Status(Code.WAIT,
+                              f"gang {g.key} waiting {ready}/{g.required}"), \
+                    g.timeout_s
+            # quorum complete: release everyone parked in Permit
+            to_allow = list(g.waiting)
+            g.waiting.clear()
+        for waiting_key in to_allow:
+            self.allow_fn(waiting_key)
+        return OK, 0.0
+
+    def on_bound(self, pod: Pod) -> None:
+        with self._lock:
+            g = self.group_of(pod.key())
+            if g is None:
+                return
+            g.waiting.discard(pod.key())
+            g.scheduled.add(pod.key())
+            self._emit(g)
+
+    def on_unschedulable(self, pod: Pod, reason: str) -> None:
+        """Strict gangs: one member failing rejects the whole group
+        (checkAndRejectGangIfNeeded, gang/manager.go:1099)."""
+        with self._lock:
+            g = self.group_of(pod.key())
+            if g is None or not g.strict:
+                return
+            if pod.key() in g.scheduled:
+                return
+            waiting = list(g.waiting)
+            g.waiting.clear()
+            g.rejected_until = time.time() + 5.0
+        for key in waiting:
+            self.reject_fn(key, f"strict gang rejected: {reason}")
+        log.info("strict gang %s rejected (%s): bounced %d waiting members",
+                 g.key, reason, len(waiting))
+        self._emit(g)
+
+    def on_permit_rejected(self, pod_key: str, reason: str) -> None:
+        """Scheduler rejected/timed out a parked pod: drop it from the
+        group's waiting set so quorum math stays truthful."""
+        with self._lock:
+            g = self.group_of(pod_key)
+            if g is not None:
+                g.waiting.discard(pod_key)
+
+    def on_pod_deleted(self, pod_key: str) -> None:
+        with self._lock:
+            g = self.group_of(pod_key)
+            if g is None:
+                return
+            g.members.discard(pod_key)
+            g.waiting.discard(pod_key)
+            g.scheduled.discard(pod_key)
+            self._pod_group.pop(pod_key, None)
+            if not g.members:
+                self._groups.pop(g.key, None)
+            else:
+                self._emit(g)
+
+    # -- probes / status --------------------------------------------------
+
+    def is_waiting(self, pod_key: str) -> bool:
+        """Probe for the allocator's assumed-TTL sweep
+        (gangWaitingProbe, gpuallocator.go:389-395)."""
+        with self._lock:
+            g = self.group_of(pod_key)
+            return g is not None and pod_key in g.waiting
+
+    def groups(self) -> List[GangGroup]:
+        with self._lock:
+            return list(self._groups.values())
+
+    def _emit(self, g: GangGroup) -> None:
+        if self.status_sink is not None:
+            try:
+                self.status_sink(g)
+            except Exception:
+                log.exception("gang status sink failed")

@@ -1,0 +1,206 @@
+"""ICI-mesh topology-aware scheduling plugin.
+
+TPU re-design of the reference's GPUNetworkTopologyAware plugin
+(``internal/scheduler/gputopo/`` — NUMAEvaluator's same-NUMA combination
+search and PeerTopologyEvaluator's tier-matrix clustering).  On TPUs the
+fabric is a 2D/3D ICI mesh, so the right objective is not "same NUMA node"
+or "NVLink clique" but **contiguous sub-meshes**: a k-chip job should get a
+rectangle of the mesh (XLA collectives ride nearest-neighbor ICI links;
+a ragged chip set forces multi-hop routing on every all-reduce step).
+
+Per node, PreFilter computes a NodeTopologyPlan — the best chip combination
+for the request:
+
+1. enumerate combinations when the search space is small (the reference
+   caps combination-search complexity the same way,
+   design/gputopo_scheduler_design_cn.md:657-778); otherwise greedy-grow
+   candidate regions from each chip;
+2. rank by (is_contiguous_rectangle, -max_pairwise_hops, -sum_hops,
+   least-damage): an exact rectangle wins, then tighter diameters, then
+   plans that fragment the remaining mesh least;
+3. Score = plan quality; Reserve consumes the planned chips (the "topology
+   override" consumed by TPUResourcesFit, gpuresources.go:645-648 analog).
+
+Hop distances come from the chip's published ICI links when present (the
+provider measured them), falling back to Manhattan distance on mesh
+coordinates.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple, TYPE_CHECKING
+
+from ..api.types import Pod, TopologyConfig
+from .framework import (Code, CycleState, OK, PreFilterPlugin, ScorePlugin,
+                        Status)
+
+if TYPE_CHECKING:
+    from ..allocator.core import ChipState, TPUAllocator
+
+log = logging.getLogger("tpf.scheduler.topo")
+
+STATE_TOPO_PLANS = "topo/plans"
+STATE_ALLOC_REQUEST = "fit/alloc_request"
+STATE_CANDIDATES = "fit/candidates"
+
+MAX_ENUMERATION = 5000  # combination cap before falling back to greedy
+
+
+@dataclass
+class NodeTopologyPlan:
+    chip_names: List[str]
+    contiguous: bool = False
+    max_hops: int = 0
+    sum_hops: int = 0
+    score: float = 0.0
+
+
+def _hop_matrix(chips: List["ChipState"]) -> List[List[int]]:
+    """Pairwise hop distances: published ICI links first, Manhattan
+    fallback."""
+    n = len(chips)
+    by_id = {c.chip.name: i for i, c in enumerate(chips)}
+    mat = [[0] * n for _ in range(n)]
+    for i, c in enumerate(chips):
+        links = {l.peer_chip_id: l.hops for l in c.chip.status.ici_links
+                 if l.hops >= 0}
+        for j, d in enumerate(chips):
+            if i == j:
+                continue
+            if d.chip.name in links:
+                mat[i][j] = links[d.chip.name]
+            else:
+                a, b = c.chip.status.mesh, d.chip.status.mesh
+                mat[i][j] = (abs(a.x - b.x) + abs(a.y - b.y)
+                             + abs(a.z - b.z))
+    return mat
+
+
+def _is_rectangle(chips: List["ChipState"]) -> bool:
+    """Does this chip set form an axis-aligned dense rectangle (a valid
+    XLA sub-mesh shape)?"""
+    coords = {(c.chip.status.mesh.x, c.chip.status.mesh.y,
+               c.chip.status.mesh.z) for c in chips}
+    if len(coords) != len(chips):
+        return False
+    xs = sorted({c[0] for c in coords})
+    ys = sorted({c[1] for c in coords})
+    zs = sorted({c[2] for c in coords})
+    for vals in (xs, ys, zs):
+        if vals[-1] - vals[0] + 1 != len(vals):
+            return False  # gap along an axis
+    return len(xs) * len(ys) * len(zs) == len(coords)
+
+
+def _evaluate(chips: List["ChipState"], idxs: Tuple[int, ...],
+              mat: List[List[int]]) -> Tuple[bool, int, int]:
+    max_h = sum_h = 0
+    for a, b in itertools.combinations(idxs, 2):
+        h = mat[a][b]
+        sum_h += h
+        if h > max_h:
+            max_h = h
+    subset = [chips[i] for i in idxs]
+    return _is_rectangle(subset), max_h, sum_h
+
+
+def plan_for_node(chips: List["ChipState"], count: int,
+                  config: Optional[TopologyConfig] = None
+                  ) -> Optional[NodeTopologyPlan]:
+    """Find the best `count`-chip combination on one node."""
+    if count <= 0 or len(chips) < count:
+        return None
+    config = config or TopologyConfig()
+    if count == len(chips):
+        candidates = [tuple(range(len(chips)))]
+        mat = _hop_matrix(chips)
+    else:
+        mat = _hop_matrix(chips)
+        n = len(chips)
+        # Exhaustive when affordable, else greedy region growing
+        import math
+        if math.comb(n, count) <= MAX_ENUMERATION:
+            candidates = list(itertools.combinations(range(n), count))
+        else:
+            candidates = []
+            for seed in range(n):
+                region = [seed]
+                while len(region) < count:
+                    best_j, best_d = None, None
+                    for j in range(n):
+                        if j in region:
+                            continue
+                        d = max(mat[i][j] for i in region)
+                        if best_d is None or d < best_d:
+                            best_j, best_d = j, d
+                    region.append(best_j)
+                candidates.append(tuple(sorted(region)))
+            candidates = list(set(candidates))
+
+    best: Optional[NodeTopologyPlan] = None
+    best_key = None
+    for idxs in candidates:
+        rect, max_h, sum_h = _evaluate(chips, idxs, mat)
+        if config.max_allowed_hops >= 0 and max_h > config.max_allowed_hops:
+            continue
+        if config.prefer_contiguous_submesh:
+            key = (not rect, max_h, sum_h)
+        else:
+            key = (False, max_h, sum_h)
+        if best_key is None or key < best_key:
+            best_key = key
+            best = NodeTopologyPlan(
+                chip_names=[chips[i].chip.name for i in idxs],
+                contiguous=rect, max_hops=max_h, sum_hops=sum_h)
+    if best is not None:
+        # score in [0, 100]: rectangle >> tight diameter >> loose
+        best.score = (60.0 if best.contiguous else 0.0) + \
+            max(0.0, 40.0 - 10.0 * best.max_hops)
+    return best
+
+
+class ICITopologyPlugin(PreFilterPlugin, ScorePlugin):
+    """PreFilter computes per-node plans from the Fit plugin's candidate
+    map; Score rewards contiguous low-diameter plans."""
+
+    name = "ICITopologyAware"
+
+    def __init__(self, config: Optional[TopologyConfig] = None):
+        self.config = config or TopologyConfig()
+
+    def pre_filter(self, state: CycleState, pod: Pod) -> Status:
+        if not self.config.enabled:
+            return Status(Code.SKIP)
+        req = state.get(STATE_ALLOC_REQUEST)
+        by_node: Dict[str, List["ChipState"]] = state.get(STATE_CANDIDATES,
+                                                          {})
+        if req is None or not by_node:
+            return Status(Code.SKIP)
+        if req.chip_count <= 1:
+            return Status(Code.SKIP)  # single-chip: topology is moot
+        plans: Dict[str, NodeTopologyPlan] = {}
+        for node, chips in by_node.items():
+            has_coords = any(c.chip.status.mesh.x or c.chip.status.mesh.y
+                             or c.chip.status.ici_links for c in chips)
+            if not has_coords:
+                if self.config.unknown_topology_policy == "reject":
+                    continue
+                plans[node] = NodeTopologyPlan(
+                    chip_names=[c.chip.name for c in chips[:req.chip_count]])
+                continue
+            plan = plan_for_node(chips, req.chip_count, self.config)
+            if plan is not None:
+                plans[node] = plan
+        state[STATE_TOPO_PLANS] = plans
+        if not plans:
+            return Status(Code.UNSCHEDULABLE,
+                          "no node satisfies the ICI topology constraints")
+        return OK
+
+    def score(self, state: CycleState, pod: Pod, node: str) -> float:
+        plans = state.get(STATE_TOPO_PLANS) or {}
+        plan = plans.get(node)
+        return plan.score if plan is not None else 0.0

@@ -1,0 +1,310 @@
+"""TPUResourcesFit — the main scheduling plugin.
+
+Analog of the reference's GPUResourcesFit
+(``internal/scheduler/gpuresources/gpuresources.go:43-1286``), implementing
+every extension point of the framework:
+
+- PreEnqueue: gang quorum gate (delegated);
+- PreFilter: compose the AllocRequest from pod annotations, run
+  quota + filter chain over the in-memory chip store, compute per-node
+  scores, write CycleState (:161-322);
+- Filter: node must hold eligible chips (:377-575);
+- PostFilter: preemption honoring eviction-protection, then strict-gang
+  group reject (:711-757);
+- Score: node score from the PreFilter result (:576-617);
+- Reserve: pick the final chips (topology-plan override > strategy top-N)
+  and ``assume`` them (:619-683); Unreserve rolls back;
+- Permit: delegate to the gang manager (:758);
+- PreBind: stamp allocation annotations + host port + pod index (:859-1014);
+- PostBind: commit the allocation, notify the gang (:1016).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .. import constants
+from ..allocator.core import (AllocationConflictError, ChipState,
+                              InsufficientResourcesError, TPUAllocator)
+from ..allocator.indexalloc import IndexAllocator
+from ..allocator.portalloc import PortAllocator, PortExhaustedError
+from ..allocator.quota import QuotaExceededError
+from ..api.resources import AllocRequest, GangConfig, ResourceAmount
+from ..api.types import Pod
+from .framework import (Code, CycleState, FilterPlugin, OK, PermitPlugin, STATE_PREFILTER_NODES,
+                        PostBindPlugin, PostFilterPlugin, PreBindPlugin,
+                        PreEnqueuePlugin, PreFilterPlugin, ReservePlugin,
+                        ScorePlugin, Status)
+from .gang import GangManager, gang_info_from_pod
+from .topo import STATE_ALLOC_REQUEST, STATE_CANDIDATES, STATE_TOPO_PLANS
+
+log = logging.getLogger("tpf.scheduler.fit")
+
+STATE_NODE_SCORES = "fit/node_scores"
+STATE_ASSUMED = "fit/assumed"
+
+
+def compose_alloc_request(pod: Pod) -> Optional[AllocRequest]:
+    """Build an AllocRequest from the pod's annotation contract
+    (ComposeAllocationRequest analog, gpuresources.go:161)."""
+    ann = pod.metadata.annotations
+    if constants.ANN_TFLOPS_REQUEST not in ann and \
+            constants.ANN_HBM_REQUEST not in ann:
+        return None
+    gang = GangConfig()
+    info = gang_info_from_pod(pod)
+    if info is not None:
+        _, desired, required, timeout, strict = info
+        gang = GangConfig(enabled=True, min_members=required,
+                          timeout_seconds=timeout, strict=strict)
+    indices = [int(x) for x in
+               ann.get(constants.ANN_CHIP_INDICES, "").split(",") if x]
+    return AllocRequest(
+        pool=ann.get(constants.ANN_POOL, ""),
+        namespace=pod.metadata.namespace,
+        workload_name=ann.get(constants.ANN_WORKLOAD, ""),
+        pod_name=pod.metadata.name,
+        request=ResourceAmount(
+            tflops=float(ann.get(constants.ANN_TFLOPS_REQUEST, 0) or 0),
+            duty_percent=float(ann.get(constants.ANN_DUTY_REQUEST, 0) or 0),
+            hbm_bytes=float(ann.get(constants.ANN_HBM_REQUEST, 0) or 0)),
+        limit=ResourceAmount(
+            tflops=float(ann.get(constants.ANN_TFLOPS_LIMIT, 0) or 0),
+            duty_percent=float(ann.get(constants.ANN_DUTY_LIMIT, 0) or 0),
+            hbm_bytes=float(ann.get(constants.ANN_HBM_LIMIT, 0) or 0)),
+        chip_count=int(ann.get(constants.ANN_CHIP_COUNT, 1) or 1),
+        generation=ann.get(constants.ANN_CHIP_GENERATION, ""),
+        vendor=ann.get(constants.ANN_VENDOR, ""),
+        chip_indices=indices,
+        isolation=ann.get(constants.ANN_ISOLATION,
+                          constants.DEFAULT_ISOLATION),
+        qos=ann.get(constants.ANN_QOS, constants.DEFAULT_QOS),
+        partition_template=ann.get(constants.ANN_PARTITION_NAME, ""),
+        gang=gang)
+
+
+class TPUResourcesFit(PreEnqueuePlugin, PreFilterPlugin, FilterPlugin,
+                      PostFilterPlugin, ScorePlugin, ReservePlugin,
+                      PermitPlugin, PreBindPlugin, PostBindPlugin):
+    name = "TPUResourcesFit"
+
+    def __init__(self, allocator: TPUAllocator,
+                 gang: Optional[GangManager] = None,
+                 ports: Optional[PortAllocator] = None,
+                 indices: Optional[IndexAllocator] = None,
+                 pods_on_node: Optional[Callable[[str], List[Pod]]] = None,
+                 evict: Optional[Callable[[Pod], None]] = None):
+        self.allocator = allocator
+        self.gang = gang
+        self.ports = ports
+        self.indices = indices
+        self.pods_on_node = pods_on_node or (lambda node: [])
+        self.evict = evict or (lambda pod: None)
+
+    # -- PreEnqueue -------------------------------------------------------
+
+    def pre_enqueue(self, pod: Pod) -> Status:
+        if self.gang is not None:
+            return self.gang.pre_enqueue(pod)
+        return OK
+
+    # -- PreFilter --------------------------------------------------------
+
+    def pre_filter(self, state: CycleState, pod: Pod) -> Status:
+        req = compose_alloc_request(pod)
+        if req is None:
+            return Status(Code.SKIP)
+        state[STATE_ALLOC_REQUEST] = req
+        try:
+            by_node, rejections = self.allocator.check_quota_and_filter(req)
+        except QuotaExceededError as e:
+            return Status(Code.UNSCHEDULABLE, str(e))
+        state[STATE_CANDIDATES] = by_node
+        state[STATE_NODE_SCORES] = self.allocator.score_nodes(req, by_node)
+        state[STATE_PREFILTER_NODES] = set(by_node)
+        if not by_node:
+            if not rejections:
+                # vectorized path carries no reasons; re-run explained
+                _, rejections = self.allocator.check_quota_and_filter(
+                    req, explain=True)
+            sample = "; ".join(list(rejections.values())[:3])
+            return Status(Code.UNSCHEDULABLE,
+                          f"no eligible chips on any node ({sample})")
+        return OK
+
+    # -- Filter -----------------------------------------------------------
+
+    def filter(self, state: CycleState, pod: Pod, node: str) -> Status:
+        req = state.get(STATE_ALLOC_REQUEST)
+        if req is None:
+            return OK
+        by_node = state.get(STATE_CANDIDATES, {})
+        # membership only — materializing the chip list here would defeat
+        # the lazy CandidateMap on large pools
+        if node not in by_node:
+            return Status(Code.UNSCHEDULABLE, f"no eligible chips on {node}")
+        plans = state.get(STATE_TOPO_PLANS)
+        if plans is not None and req.chip_count > 1 and node not in plans:
+            return Status(Code.UNSCHEDULABLE,
+                          f"no topology plan for {node}")
+        return OK
+
+    # -- PostFilter: preemption (:711-757 + patched DefaultPreemption) ----
+
+    def post_filter(self, state, pod, statuses):
+        req = state.get(STATE_ALLOC_REQUEST)
+        if req is None:
+            return None, Status(Code.UNSCHEDULABLE)
+        nominated = self._try_preempt(req, pod)
+        if nominated is not None:
+            return nominated, OK
+        if self.gang is not None:
+            self.gang.on_unschedulable(pod, "unschedulable after PostFilter")
+        return None, Status(Code.UNSCHEDULABLE, "preemption found no victims")
+
+    def _try_preempt(self, req: AllocRequest, pod: Pod) -> Optional[str]:
+        """Pick a node where evicting lower-priority, unprotected pods
+        frees enough capacity; evict them and nominate the node."""
+        if pod.spec.preemption_policy == "Never":
+            return None
+        nodes = {c.chip.status.node_name
+                 for c in self.allocator.chips(req.pool or None)}
+        best_node, best_victims = None, None
+        for node in nodes:
+            victims = self._victims_on_node(req, pod, node)
+            if victims is None:
+                continue
+            if best_victims is None or len(victims) < len(best_victims):
+                best_node, best_victims = node, victims
+        if best_node is None:
+            return None
+        for v in best_victims:
+            log.info("preempting %s on %s for %s", v.key(), best_node,
+                     pod.key())
+            self.evict(v)
+        return best_node
+
+    def _victims_on_node(self, req: AllocRequest, pod: Pod,
+                         node: str) -> Optional[List[Pod]]:
+        candidates = []
+        for p in self.pods_on_node(node):
+            if p.spec.priority >= pod.spec.priority:
+                continue
+            if p.metadata.annotations.get(
+                    constants.ANN_EVICTION_PROTECTION, "").lower() in (
+                        "true", "1"):
+                continue  # patched-preemption eviction-protection analog
+            rec = self.allocator.allocation(p.key())
+            if rec is None:
+                continue
+            candidates.append((p, rec))
+        if not candidates:
+            return None
+        # lowest priority first
+        candidates.sort(key=lambda pr: pr[0].spec.priority)
+        # Victims only need to cover the *shortfall* beyond what the node
+        # already has free.
+        node_chips = [c for c in self.allocator.chips(req.pool or None)
+                      if c.chip.status.node_name == node]
+        if req.chip_count == 1:
+            free_t = max((c.available().tflops for c in node_chips),
+                         default=0.0)
+            free_h = max((c.available().hbm_bytes for c in node_chips),
+                         default=0.0)
+        else:
+            free_t = sum(c.available().tflops for c in node_chips)
+            free_h = sum(c.available().hbm_bytes for c in node_chips)
+        need = req.request.scale(req.chip_count)
+        shortfall_t = max(0.0, need.tflops - free_t)
+        shortfall_h = max(0.0, need.hbm_bytes - free_h)
+        if shortfall_t <= 0 and shortfall_h <= 0:
+            # Capacity is not the problem (generation/vendor/quota mismatch)
+            # — evicting anyone cannot make the pod schedulable.
+            return None
+        freed = ResourceAmount()
+        victims = []
+        for p, rec in candidates:
+            victims.append(p)
+            freed = freed.add(rec.request.request.scale(len(rec.chip_ids)))
+            if shortfall_t <= freed.tflops and shortfall_h <= freed.hbm_bytes:
+                return victims
+        return None
+
+    # -- Score ------------------------------------------------------------
+
+    def score(self, state: CycleState, pod: Pod, node: str) -> float:
+        scores = state.get(STATE_NODE_SCORES) or {}
+        return scores.get(node, 0.0)
+
+    # -- Reserve ----------------------------------------------------------
+
+    def reserve(self, state: CycleState, pod: Pod, node: str) -> Status:
+        req = state.get(STATE_ALLOC_REQUEST)
+        if req is None:
+            return OK
+        by_node = state.get(STATE_CANDIDATES, {})
+        chips: List[ChipState] = by_node.get(node, [])
+        plans = state.get(STATE_TOPO_PLANS)
+        if plans and node in plans:
+            wanted = set(plans[node].chip_names)
+            planned = [c for c in chips if c.chip.name in wanted]
+            if len(planned) == req.chip_count:
+                chips = planned  # topology override (:645-648)
+        try:
+            chosen = self.allocator.select(req, chips)
+            self.allocator.assume(req, chosen)
+        except (InsufficientResourcesError, AllocationConflictError,
+                QuotaExceededError) as e:
+            return Status(Code.UNSCHEDULABLE, f"reserve failed: {e}")
+        state[STATE_ASSUMED] = [c.chip.name for c in chosen]
+        return OK
+
+    def unreserve(self, state: CycleState, pod: Pod, node: str) -> None:
+        req = state.get(STATE_ALLOC_REQUEST)
+        if req is not None and state.get(STATE_ASSUMED):
+            self.allocator.unassume(req.key())
+            state.pop(STATE_ASSUMED, None)
+
+    # -- Permit -----------------------------------------------------------
+
+    def permit(self, state: CycleState, pod: Pod,
+               node: str) -> Tuple[Status, float]:
+        if self.gang is not None:
+            return self.gang.permit(pod)
+        return OK, 0.0
+
+    # -- PreBind ----------------------------------------------------------
+
+    def pre_bind(self, state: CycleState, pod: Pod, node: str) -> Status:
+        req = state.get(STATE_ALLOC_REQUEST)
+        if req is None:
+            return OK
+        record = self.allocator.allocation(req.key())
+        if record is None:
+            return Status(Code.ERROR, "no assumed allocation at PreBind")
+        self.allocator.stamp_pod(pod, record)
+        if self.indices is not None:
+            idx = self.indices.assign(pod.key())
+            pod.metadata.annotations[constants.ANN_POD_INDEX] = str(idx)
+        if pod.metadata.labels.get(constants.LABEL_HOST_PORT) == \
+                constants.LABEL_HOST_PORT_AUTO and self.ports is not None:
+            try:
+                port = self.ports.assign_node_port(node, pod.key())
+            except PortExhaustedError as e:
+                return Status(Code.UNSCHEDULABLE, str(e))
+            pod.metadata.annotations[constants.ANN_PORT_NUMBER] = str(port)
+        return OK
+
+    # -- PostBind ---------------------------------------------------------
+
+    def post_bind(self, state: CycleState, pod: Pod, node: str) -> None:
+        req = state.get(STATE_ALLOC_REQUEST)
+        if req is None:
+            return
+        try:
+            self.allocator.commit(req.key())
+        except KeyError:
+            log.error("PostBind: allocation for %s vanished", req.key())
+        if self.gang is not None:
+            self.gang.on_bound(pod)

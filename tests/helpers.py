@@ -1,0 +1,27 @@
+"""Shared test fixtures/builders."""
+
+from tensorfusion_tpu import constants
+from tensorfusion_tpu.api import ResourceAmount, TPUChip
+
+V5E_TFLOPS = 197.0
+V5E_HBM = 16 * 2**30
+
+
+def make_chip(name, node="node-a", pool="pool-a", generation="v5e",
+              cores=1, caps=None):
+    chip = TPUChip.new(name)
+    st = chip.status
+    st.phase = constants.PHASE_RUNNING
+    st.capacity = ResourceAmount(tflops=V5E_TFLOPS, duty_percent=100,
+                                 hbm_bytes=V5E_HBM)
+    st.available = st.capacity
+    st.generation = generation
+    st.vendor = "mock-tpu"
+    st.node_name = node
+    st.pool = pool
+    st.core_count = cores
+    st.host_index = int(name[-1]) if name[-1].isdigit() else 0
+    st.capabilities = caps or {"core_partitioning": cores > 1,
+                               "soft_isolation": True,
+                               "hard_isolation": True}
+    return chip

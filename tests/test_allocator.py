@@ -1,0 +1,292 @@
+"""Allocator tests: filters, strategies, two-phase allocation, oversell,
+quota accounting, live resize, TTL sweep, restart reconcile, store sync,
+port/index allocators.
+
+Mirrors the reference's allocator suites (internal/gpuallocator/*_test.go,
+internal/quota/quota_consolidated_test.go, internal/portallocator,
+internal/indexallocator — SURVEY.md §2.2).
+"""
+
+import pytest
+
+from tensorfusion_tpu import constants
+from tensorfusion_tpu.allocator import (IndexAllocator, PortAllocator,
+                                        QuotaExceededError, QuotaStore,
+                                        TPUAllocator)
+from tensorfusion_tpu.allocator.core import (AllocationConflictError,
+                                             InsufficientResourcesError)
+from tensorfusion_tpu.api import (AllocRequest, ResourceAmount, TPUChip,
+                                  TPUResourceQuota)
+from tensorfusion_tpu.api.types import Pod
+from tensorfusion_tpu.store import ObjectStore
+
+from helpers import V5E_HBM, V5E_TFLOPS, make_chip
+
+
+def make_allocator(n_chips=4, nodes=2, oversell=100.0, store=None):
+    alloc = TPUAllocator(store=store)
+    alloc.set_pool_oversell("pool-a", oversell)
+    for i in range(n_chips):
+        node = f"node-{chr(ord('a') + i * nodes // n_chips)}"
+        alloc.upsert_chip(make_chip(f"chip-{i}", node=node))
+    return alloc
+
+
+def req(pod="p1", tflops=50.0, hbm=2 * 2**30, count=1, ns="default", **kw):
+    return AllocRequest(pool="pool-a", namespace=ns, pod_name=pod,
+                        request=ResourceAmount(tflops=tflops, hbm_bytes=hbm),
+                        limit=ResourceAmount(tflops=tflops * 2,
+                                             hbm_bytes=hbm),
+                        chip_count=count, **kw)
+
+
+def test_filter_and_alloc_basic():
+    alloc = make_allocator()
+    record = alloc.alloc(req())
+    assert len(record.chip_ids) == 1
+    assert not record.assumed
+    state = alloc.get_chip(record.chip_ids[0])
+    assert state.allocated.tflops == 50.0
+    alloc.dealloc(record.key)
+    assert alloc.get_chip(record.chip_ids[0]).allocated.tflops == 0
+
+
+def test_filter_rejections_reported():
+    alloc = make_allocator()
+    by_node, rejections = alloc.check_quota_and_filter(
+        req(tflops=1000.0))  # exceeds capacity of every chip
+    assert not by_node
+    assert len(rejections) == 4
+    assert "insufficient tflops" in next(iter(rejections.values()))
+
+    by_node, rejections = alloc.check_quota_and_filter(
+        req(generation="v9x"))
+    assert not by_node
+    assert all("generation" in r for r in rejections.values())
+
+
+def test_same_node_multi_chip():
+    alloc = make_allocator(n_chips=4, nodes=2)  # 2 chips per node
+    by_node, rejections = alloc.check_quota_and_filter(req(count=3))
+    assert not by_node  # no node has 3 chips
+    assert any("same-node" in r for r in rejections.values())
+
+    record = alloc.alloc(req(count=2))
+    nodes = {alloc.get_chip(c).chip.status.node_name
+             for c in record.chip_ids}
+    assert len(nodes) == 1
+
+
+def test_oversell_allows_overcommit_of_tflops_not_hbm():
+    alloc = make_allocator(n_chips=1, nodes=1, oversell=500.0)
+    # 5x oversell: 5 workers at 150 TFLOPs each on a 197-TFLOP chip
+    for i in range(5):
+        alloc.alloc(req(pod=f"p{i}", tflops=150.0, hbm=2 * 2**30))
+    with pytest.raises(InsufficientResourcesError):
+        alloc.alloc(req(pod="p9", tflops=150.0, hbm=8 * 2**30))
+    # HBM is physical: 16 GiB total, 10 GiB used -> 8 GiB request fails
+    with pytest.raises(InsufficientResourcesError):
+        alloc.alloc(req(pod="p10", tflops=1.0, hbm=8 * 2**30))
+
+
+def test_assume_commit_unassume():
+    alloc = make_allocator()
+    r = req()
+    by_node, _ = alloc.check_quota_and_filter(r)
+    chips = next(iter(by_node.values()))
+    record = alloc.assume(r, alloc.select(r, chips))
+    assert record.assumed
+    with pytest.raises(AllocationConflictError):
+        alloc.assume(r, chips)
+    alloc.unassume(record.key)
+    assert alloc.allocation(record.key) is None
+
+    record = alloc.assume(r, alloc.select(r, chips))
+    alloc.commit(record.key)
+    assert not alloc.allocation(record.key).assumed
+
+
+def test_assumed_ttl_sweep_with_gang_probe():
+    alloc = make_allocator()
+    alloc.assume_ttl_s = 0.0
+    r = req()
+    by_node, _ = alloc.check_quota_and_filter(r)
+    record = alloc.assume(r, alloc.select(r, next(iter(by_node.values()))))
+
+    alloc.set_gang_waiting_probe(lambda key: True)
+    assert alloc.sweep_assumed() == []          # gang member: kept
+    alloc.set_gang_waiting_probe(lambda key: False)
+    assert alloc.sweep_assumed() == [record.key]
+    assert alloc.allocation(record.key) is None
+
+
+def test_quota_enforcement_and_two_phase():
+    store = ObjectStore()
+    quota = TPUResourceQuota.new("q", namespace="team-a")
+    quota.spec.total.requests = ResourceAmount(tflops=100.0,
+                                               hbm_bytes=8 * 2**30)
+    quota.spec.single.requests = ResourceAmount(tflops=60.0)
+    quota.spec.total.max_workers = 2
+    store.create(quota)
+
+    alloc = make_allocator(store=store)
+    alloc.quota.set_quota(quota)
+
+    with pytest.raises(QuotaExceededError) as ei:
+        alloc.alloc(req(ns="team-a", tflops=80.0))     # single cap 60
+    assert ei.value.unresolvable
+
+    alloc.alloc(req(ns="team-a", pod="a", tflops=60.0))
+    with pytest.raises(QuotaExceededError) as ei:
+        alloc.alloc(req(ns="team-a", pod="b", tflops=50.0))  # total cap 100
+    assert not ei.value.unresolvable
+    alloc.alloc(req(ns="team-a", pod="c", tflops=40.0))
+    with pytest.raises(QuotaExceededError):     # worker cap 2
+        alloc.alloc(req(ns="team-a", pod="d", tflops=1.0, hbm=1))
+
+    alloc.dealloc("team-a/a")
+    alloc.alloc(req(ns="team-a", pod="d", tflops=1.0, hbm=1))
+
+    alloc.quota.sync_to_store()
+    synced = store.get(TPUResourceQuota, "q", "team-a")
+    assert synced.status.used_workers == 2
+    assert synced.status.used_requests.tflops == pytest.approx(41.0)
+
+
+def test_adjust_allocation_live_resize():
+    alloc = make_allocator(n_chips=1, nodes=1)
+    record = alloc.alloc(req(tflops=50.0, hbm=2 * 2**30))
+    from tensorfusion_tpu.api import AdjustRequest
+    delta = alloc.adjust_allocation(AdjustRequest(
+        namespace="default", pod_name="p1",
+        new_request=ResourceAmount(tflops=80.0, hbm_bytes=3 * 2**30),
+        new_limit=ResourceAmount(tflops=160.0, hbm_bytes=3 * 2**30)),
+        dry_run=True)
+    assert delta.tflops == pytest.approx(30.0)
+    state = alloc.get_chip(record.chip_ids[0])
+    assert state.allocated.tflops == 50.0  # dry run did not mutate
+
+    alloc.adjust_allocation(AdjustRequest(
+        namespace="default", pod_name="p1",
+        new_request=ResourceAmount(tflops=80.0, hbm_bytes=3 * 2**30),
+        new_limit=ResourceAmount(tflops=160.0, hbm_bytes=3 * 2**30)))
+    assert state.allocated.tflops == pytest.approx(80.0)
+
+    with pytest.raises(InsufficientResourcesError):
+        alloc.adjust_allocation(AdjustRequest(
+            namespace="default", pod_name="p1",
+            new_request=ResourceAmount(tflops=500.0, hbm_bytes=3 * 2**30)))
+
+
+def test_partitioned_fit_filter():
+    alloc = TPUAllocator()
+    alloc.upsert_chip(make_chip("pchip-0", cores=2))
+    r = req(isolation=constants.ISOLATION_PARTITIONED)
+    r.partition_template = "v5p-1c"
+    rec1 = alloc.alloc(r)
+    assert rec1.chip_ids == ["pchip-0"]
+
+    r2 = req(pod="p2", isolation=constants.ISOLATION_PARTITIONED)
+    r2.partition_template = "v5p-2c"  # needs 2 cores, only 1 free
+    with pytest.raises(InsufficientResourcesError):
+        alloc.alloc(r2)
+
+    r3 = req(pod="p3", isolation=constants.ISOLATION_PARTITIONED)
+    r3.partition_template = "v5p-1c"
+    alloc.alloc(r3)
+    alloc.bind_partition("default/p3", "pchip-0", "pchip-0-p1")
+    assert alloc.allocation("default/p3").partitions["pchip-0"] == \
+        "pchip-0-p1"
+
+
+def test_reconcile_from_pod_annotations():
+    alloc = make_allocator()
+    record = alloc.alloc(req(count=2, tflops=40.0))
+    pod = Pod.new("p1", namespace="default")
+    alloc.stamp_pod(pod, record)
+    assert pod.metadata.annotations[constants.ANN_CHIP_IDS]
+
+    # fresh allocator (restart): rebuild from the pod
+    alloc2 = make_allocator()
+    restored = alloc2.reconcile([pod])
+    assert restored == 1
+    rec2 = alloc2.allocation("default/p1")
+    assert rec2.chip_ids == record.chip_ids
+    assert not rec2.assumed
+    for c in rec2.chip_ids:
+        assert alloc2.get_chip(c).allocated.tflops == pytest.approx(40.0)
+
+    # completed pods are skipped
+    pod_done = Pod.new("p2", namespace="default")
+    alloc.stamp_pod(pod_done, record)
+    pod_done.status.phase = constants.PHASE_SUCCEEDED
+    alloc3 = make_allocator()
+    assert alloc3.reconcile([pod_done]) == 0
+
+
+def test_sync_to_store():
+    store = ObjectStore()
+    alloc = TPUAllocator(store=store)
+    alloc.set_pool_oversell("pool-a", 100.0)
+    chip = make_chip("sync-chip")
+    store.create(chip)
+    alloc.upsert_chip(chip)
+    alloc.alloc(req(tflops=97.0))
+    n = alloc.sync_to_store()
+    assert n == 1
+    synced = store.get(TPUChip, "sync-chip")
+    assert synced.status.available.tflops == pytest.approx(100.0)
+    assert synced.status.running_apps == ["default/p1"]
+
+
+def test_strategies_pack_vs_spread():
+    alloc = make_allocator(n_chips=2, nodes=1)
+    alloc.set_pool_strategy("pool-a", "CompactFirst")
+    a = alloc.alloc(req(pod="p1", tflops=50.0))
+    b = alloc.alloc(req(pod="p2", tflops=50.0))
+    assert a.chip_ids == b.chip_ids  # packed onto the same chip
+
+    alloc2 = make_allocator(n_chips=2, nodes=1)
+    alloc2.set_pool_strategy("pool-a", "LowLoadFirst")
+    a = alloc2.alloc(req(pod="p1", tflops=50.0))
+    b = alloc2.alloc(req(pod="p2", tflops=50.0))
+    assert a.chip_ids != b.chip_ids  # spread across chips
+
+
+def test_port_allocator():
+    from tensorfusion_tpu.allocator import PortExhaustedError
+    pa = PortAllocator(node_range=(100, 103), cluster_range=(200, 202))
+    p1 = pa.assign_node_port("n1", "default/p1")
+    p2 = pa.assign_node_port("n1", "default/p2")
+    assert {p1, p2} == {100, 101}
+    assert pa.assign_node_port("n2", "default/p3") == 100  # per-node ranges
+    pa.assign_node_port("n1", "default/p4")
+    with pytest.raises(PortExhaustedError):
+        pa.assign_node_port("n1", "default/p5")
+    assert pa.release_owner("default/p1") == 1
+    assert pa.assign_node_port("n1", "default/p6") == p1
+
+    c = pa.assign_cluster_port("default/p7")
+    assert c == 200
+    assert pa.release_cluster_port(c)
+    assert not pa.release_cluster_port(c)  # double release
+
+    pa2 = PortAllocator(node_range=(100, 103), cluster_range=(200, 202))
+    pa2.reconcile([("n1", 100, "default/p1"), (None, 201, "default/p8")])
+    assert pa2.assign_node_port("n1", "x") == 101
+    assert pa2.assign_cluster_port("y") == 200
+
+
+def test_index_allocator():
+    ia = IndexAllocator(max_index=3)
+    assert ia.assign("a") == 0
+    assert ia.assign("b") == 1
+    assert ia.assign("a") == 0  # idempotent
+    assert ia.release("a") == 0
+    assert ia.assign("c") == 0
+    ia.assign("d")
+    from tensorfusion_tpu.allocator import IndexExhaustedError
+    with pytest.raises(IndexExhaustedError):
+        ia.assign("e")
+    ia.reconcile({"x": 2})
+    assert ia.assign("y") == 0

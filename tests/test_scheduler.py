@@ -1,0 +1,247 @@
+"""Scheduler tests: cycle, fit plugin, ICI topology planning, gang
+scheduling, preemption with eviction protection, permit timeout.
+
+Mirrors the reference's scheduler test flavors (test/sched/*,
+internal/scheduler/gpuresources/gpuresources_test.go,
+internal/gang/manager_test.go — SURVEY.md §4).
+"""
+
+import time
+
+import pytest
+
+from tensorfusion_tpu import constants
+from tensorfusion_tpu.allocator import IndexAllocator, PortAllocator, TPUAllocator
+from tensorfusion_tpu.api import ResourceAmount, TPUChip
+from tensorfusion_tpu.api.types import MeshCoords, Pod
+from tensorfusion_tpu.scheduler import (Code, GangManager, ICITopologyPlugin,
+                                        Scheduler, TPUResourcesFit,
+                                        plan_for_node)
+from tensorfusion_tpu.scheduler.topo import NodeTopologyPlan
+
+from helpers import make_chip
+
+
+class Harness:
+    def __init__(self, chips_per_node=4, nodes=2, oversell=100.0):
+        self.allocator = TPUAllocator()
+        self.allocator.set_pool_oversell("pool-a", oversell)
+        self.pods = {}
+        self.bound = {}
+        self.evicted = []
+        idx = 0
+        for n in range(nodes):
+            for c in range(chips_per_node):
+                chip = make_chip(f"chip-{idx}", node=f"node-{n}")
+                chip.status.mesh = MeshCoords(x=c % 2, y=c // 2)
+                self.allocator.upsert_chip(chip)
+                idx += 1
+        self.gang = GangManager()
+        self.fit = TPUResourcesFit(
+            self.allocator, gang=self.gang, ports=PortAllocator(),
+            indices=IndexAllocator(),
+            pods_on_node=self.pods_on_node, evict=self.evict)
+        self.scheduler = Scheduler(
+            nodes_fn=lambda: [f"node-{n}" for n in range(nodes)],
+            bind_fn=self.bind)
+        self.gang.bind_scheduler(self.scheduler)
+        self.scheduler.register(self.fit)
+        self.scheduler.register(ICITopologyPlugin())
+
+    def bind(self, pod, node):
+        self.bound[pod.key()] = node
+
+    def pods_on_node(self, node):
+        return [p for p in self.pods.values()
+                if p.spec.node_name == node]
+
+    def evict(self, pod):
+        self.evicted.append(pod.key())
+        self.allocator.dealloc(pod.key())
+        pod.spec.node_name = ""
+        pod.status.phase = constants.PHASE_PENDING
+
+    def make_pod(self, name, tflops=50.0, hbm=2 * 2**30, count=1,
+                 ns="default", priority=0, **ann_extra):
+        pod = Pod.new(name, namespace=ns)
+        pod.spec.priority = priority
+        ann = pod.metadata.annotations
+        ann[constants.ANN_POOL] = "pool-a"
+        ann[constants.ANN_TFLOPS_REQUEST] = str(tflops)
+        ann[constants.ANN_HBM_REQUEST] = str(hbm)
+        ann[constants.ANN_CHIP_COUNT] = str(count)
+        ann.update(ann_extra)
+        self.pods[pod.key()] = pod
+        return pod
+
+
+def test_schedule_one_basic():
+    h = Harness()
+    pod = h.make_pod("p1")
+    st = h.scheduler.schedule_one(pod)
+    assert st.ok
+    assert pod.key() in h.bound
+    assert pod.spec.node_name in ("node-0", "node-1")
+    ann = pod.metadata.annotations
+    assert ann[constants.ANN_CHIP_IDS]
+    assert ann[constants.ANN_POD_INDEX] == "0"
+    rec = h.allocator.allocation(pod.key())
+    assert rec is not None and not rec.assumed
+
+
+def test_unschedulable_reports_reasons():
+    h = Harness()
+    pod = h.make_pod("big", tflops=5000.0)
+    st = h.scheduler.schedule_one(pod)
+    assert st.code == Code.UNSCHEDULABLE
+    assert "insufficient tflops" in st.reason or "no eligible" in st.reason
+    assert h.allocator.allocation(pod.key()) is None
+
+
+def test_host_port_assignment():
+    h = Harness()
+    pod = h.make_pod("svc")
+    pod.metadata.labels[constants.LABEL_HOST_PORT] = \
+        constants.LABEL_HOST_PORT_AUTO
+    st = h.scheduler.schedule_one(pod)
+    assert st.ok
+    port = int(pod.metadata.annotations[constants.ANN_PORT_NUMBER])
+    assert constants.NODE_PORT_RANGE[0] <= port < constants.NODE_PORT_RANGE[1]
+
+
+def test_topology_prefers_contiguous_submesh():
+    """4 chips per node in a 2x2 mesh: a 2-chip request must get two
+    adjacent chips (hop distance 1), never a diagonal pair."""
+    h = Harness(chips_per_node=4, nodes=1)
+    pod = h.make_pod("pair", count=2, tflops=10.0, hbm=2**30)
+    st = h.scheduler.schedule_one(pod)
+    assert st.ok
+    rec = h.allocator.allocation(pod.key())
+    coords = [h.allocator.get_chip(c).chip.status.mesh
+              for c in rec.chip_ids]
+    dist = abs(coords[0].x - coords[1].x) + abs(coords[0].y - coords[1].y)
+    assert dist == 1
+
+
+def test_plan_for_node_rectangle_detection():
+    chips = []
+    for i in range(8):  # 2x4 mesh
+        chip = make_chip(f"m-{i}", node="n")
+        chip.status.mesh = MeshCoords(x=i % 2, y=i // 2)
+        from tensorfusion_tpu.allocator.core import ChipState
+        chips.append(ChipState(chip))
+    plan = plan_for_node(chips, 4)
+    assert plan is not None
+    assert plan.contiguous          # 2x2 square exists
+    assert plan.max_hops == 2       # corners of the 2x2 square
+
+    plan8 = plan_for_node(chips, 8)
+    assert plan8.contiguous and len(plan8.chip_names) == 8
+
+
+def test_gang_all_or_nothing():
+    h = Harness(chips_per_node=4, nodes=2)
+    h.scheduler.start()
+    try:
+        gang_ann = {
+            constants.ANN_WORKLOAD: "trainer",
+            constants.ANN_GANG_ENABLED: "true",
+            constants.ANN_GANG_DESIRED_MEMBERS: "3",
+            constants.ANN_GANG_REQUIRED_MEMBERS: "3",
+            constants.ANN_GANG_TIMEOUT: "30",
+        }
+        pods = [h.make_pod(f"g{i}", tflops=20.0, hbm=2**30, **gang_ann)
+                for i in range(2)]
+        for p in pods:
+            h.scheduler.enqueue(p)
+        time.sleep(0.3)
+        # quorum 3 not met: nothing bound, pods gated
+        assert not h.bound
+
+        third = h.make_pod("g2", tflops=20.0, hbm=2**30, **gang_ann)
+        h.scheduler.enqueue(third)
+        h.scheduler.activate()  # requeue the gated members
+        deadline = time.time() + 5
+        while len(h.bound) < 3 and time.time() < deadline:
+            time.sleep(0.05)
+        assert len(h.bound) == 3
+        for p in pods + [third]:
+            rec = h.allocator.allocation(p.key())
+            assert rec is not None and not rec.assumed
+    finally:
+        h.scheduler.stop()
+
+
+def test_gang_permit_timeout_rejects():
+    """A gang member parked in Permit must be unreserved when its partner
+    can never schedule and the gang timeout lapses."""
+    h = Harness(chips_per_node=2, nodes=1)
+    h.scheduler.start()
+    try:
+        gang_ann = {
+            constants.ANN_WORKLOAD: "timeout-gang",
+            constants.ANN_GANG_ENABLED: "true",
+            constants.ANN_GANG_DESIRED_MEMBERS: "2",
+            constants.ANN_GANG_REQUIRED_MEMBERS: "2",
+            constants.ANN_GANG_TIMEOUT: "0.3",
+        }
+        p1 = h.make_pod("t1", tflops=20.0, hbm=2**30, **gang_ann)
+        # partner can never fit -> p1 stays parked in Permit until timeout
+        p2 = h.make_pod("t2", tflops=5000.0, hbm=2**30, **gang_ann)
+        h.scheduler.enqueue(p1)
+        h.scheduler.enqueue(p2)
+        h.scheduler.activate()
+        deadline = time.time() + 2
+        while not h.scheduler.waiting_pods() and time.time() < deadline:
+            time.sleep(0.02)
+        assert h.scheduler.waiting_pods() == [p1.key()]
+        rec = h.allocator.allocation(p1.key())
+        assert rec is not None and rec.assumed  # held during the wait
+
+        deadline = time.time() + 3
+        while h.scheduler.waiting_pods() and time.time() < deadline:
+            time.sleep(0.05)
+        assert not h.scheduler.waiting_pods()   # permit timeout fired
+        assert h.allocator.allocation(p1.key()) is None  # unreserved
+        assert not h.bound
+    finally:
+        h.scheduler.stop()
+
+
+def test_preemption_with_eviction_protection():
+    h = Harness(chips_per_node=1, nodes=1)
+    low1 = h.make_pod("low1", tflops=100.0, hbm=4 * 2**30, priority=1)
+    low2 = h.make_pod("low2", tflops=90.0, hbm=4 * 2**30, priority=2)
+    assert h.scheduler.schedule_one(low1).ok
+    assert h.scheduler.schedule_one(low2).ok
+    for p in (low1, low2):
+        p.spec.node_name = h.bound[p.key()]
+
+    # protected low-priority pod must not be chosen as a victim
+    low1.metadata.annotations[constants.ANN_EVICTION_PROTECTION] = "true"
+
+    high = h.make_pod("high", tflops=95.0, hbm=4 * 2**30, priority=100)
+    st = h.scheduler.schedule_one(high)
+    # first cycle: preemption evicts low2 (unprotected) and nominates
+    assert h.evicted == ["default/low2"]
+    assert high.status.nominated_node_name == "node-0"
+    # retry now fits
+    st = h.scheduler.schedule_one(high)
+    assert st.ok
+    assert h.allocator.allocation("default/high") is not None
+
+
+def test_scheduler_loop_end_to_end():
+    h = Harness()
+    h.scheduler.start()
+    try:
+        pods = [h.make_pod(f"loop{i}", tflops=20.0, hbm=2**30)
+                for i in range(8)]
+        for p in pods:
+            h.scheduler.enqueue(p)
+        deadline = time.time() + 5
+        while len(h.bound) < 8 and time.time() < deadline:
+            time.sleep(0.05)
+        assert len(h.bound) == 8
+    finally:
+        h.scheduler.stop()
