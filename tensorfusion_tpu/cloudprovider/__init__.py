@@ -1,0 +1,12 @@
+"""Cloud provisioning providers (mock + pricing DB).
+
+Analog of the reference's ``internal/cloudprovider/`` (Karpenter/EC2/ECS
+integrations + mock provider + static pricing).  With zero egress, the mock
+provider is the functional one: it materializes a TPU host (Node + TPUNode
++ TPUChip objects) directly into the object store, simulating a TPU VM
+joining the pool — which is exactly what the node expander and
+autoscale-from-zero paths need to be testable.
+"""
+
+from .mock import MockCloudProvider, TPU_INSTANCE_TYPES
+from .pricing import PRICING, hourly_cost

@@ -1,0 +1,122 @@
+"""Mock cloud provider: materializes simulated TPU hosts into the store.
+
+Analog of the reference's ``internal/cloudprovider/mock/ecs.go`` — the
+test/e2e provisioning backend.  ``provision`` creates the Node, TPUNode and
+per-chip TPUChip objects for the requested instance type, with ICI mesh
+coordinates matching the generation's host topology.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+from dataclasses import dataclass
+from typing import Dict, Tuple
+
+from .. import constants
+from ..api.resources import ResourceAmount
+from ..api.types import (MeshCoords, Node, TPUChip, TPUNode, TPUNodeClaim)
+from ..store import AlreadyExistsError, ObjectStore
+
+log = logging.getLogger("tpf.cloudprovider.mock")
+
+
+@dataclass
+class InstanceType:
+    name: str
+    generation: str
+    chips: int
+    mesh: Tuple[int, int]
+    cores_per_chip: int
+    hbm_bytes: int
+    bf16_tflops: float
+
+
+TPU_INSTANCE_TYPES: Dict[str, InstanceType] = {
+    "ct5lp-hightpu-1t": InstanceType("ct5lp-hightpu-1t", "v5e", 1, (1, 1), 1,
+                                     16 << 30, 197.0),
+    "ct5lp-hightpu-4t": InstanceType("ct5lp-hightpu-4t", "v5e", 4, (2, 2), 1,
+                                     16 << 30, 197.0),
+    "ct5lp-hightpu-8t": InstanceType("ct5lp-hightpu-8t", "v5e", 8, (2, 4), 1,
+                                     16 << 30, 197.0),
+    "ct5p-hightpu-4t": InstanceType("ct5p-hightpu-4t", "v5p", 4, (2, 2), 2,
+                                    95 << 30, 459.0),
+    "ct6e-standard-8t": InstanceType("ct6e-standard-8t", "v6e", 8, (2, 4), 1,
+                                     32 << 30, 918.0),
+}
+
+_GEN_DEFAULT_INSTANCE = {
+    "v5e": "ct5lp-hightpu-8t",
+    "v5p": "ct5p-hightpu-4t",
+    "v6e": "ct6e-standard-8t",
+}
+
+
+class MockCloudProvider:
+    def __init__(self, store: ObjectStore):
+        self.store = store
+        self._seq = itertools.count()
+        self.provisioned = []
+
+    def instance_for(self, generation: str, chip_count: int) -> InstanceType:
+        """Smallest instance of the generation covering chip_count."""
+        candidates = sorted(
+            (it for it in TPU_INSTANCE_TYPES.values()
+             if it.generation == generation and it.chips >= chip_count),
+            key=lambda it: it.chips)
+        if candidates:
+            return candidates[0]
+        return TPU_INSTANCE_TYPES[_GEN_DEFAULT_INSTANCE.get(
+            generation, "ct5lp-hightpu-8t")]
+
+    def provision(self, claim: TPUNodeClaim) -> Tuple[str, str]:
+        it = TPU_INSTANCE_TYPES.get(claim.spec.instance_type) or \
+            self.instance_for(claim.spec.generation, claim.spec.chip_count)
+        n = next(self._seq)
+        node_name = claim.status.node_name or f"{claim.name}-node"
+        instance_id = f"mock-{it.name}-{n}"
+
+        node = Node.new(node_name)
+        node.status.phase = constants.PHASE_RUNNING
+        node.status.allocatable_cpu = 64.0
+        node.status.allocatable_memory_bytes = 256 << 30
+        self._create_quiet(node)
+
+        tnode = TPUNode.new(node_name)
+        tnode.spec.pool = claim.spec.pool
+        tnode.spec.manage_mode = "Provisioned"
+        tnode.status.phase = constants.PHASE_RUNNING
+        self._create_quiet(tnode)
+
+        mx, my = it.mesh
+        for i in range(it.chips):
+            chip = TPUChip.new(f"{node_name}-chip-{i}")
+            st = chip.status
+            st.phase = constants.PHASE_RUNNING
+            st.capacity = ResourceAmount(tflops=it.bf16_tflops,
+                                         duty_percent=100.0,
+                                         hbm_bytes=it.hbm_bytes)
+            st.available = st.capacity
+            st.generation = it.generation
+            st.vendor = "mock-tpu"
+            st.node_name = node_name
+            st.pool = claim.spec.pool
+            st.slice_id = f"{node_name}-slice"
+            st.host_index = i
+            st.core_count = it.cores_per_chip
+            st.mesh = MeshCoords(x=i % mx, y=i // mx)
+            st.capabilities = {"soft_isolation": True,
+                               "hard_isolation": True,
+                               "core_partitioning": it.cores_per_chip > 1}
+            self._create_quiet(chip)
+
+        self.provisioned.append((claim.name, instance_id))
+        log.info("provisioned %s (%s: %d x %s chips) for claim %s",
+                 node_name, it.name, it.chips, it.generation, claim.name)
+        return node_name, instance_id
+
+    def _create_quiet(self, obj) -> None:
+        try:
+            self.store.create(obj)
+        except AlreadyExistsError:
+            pass

@@ -1,0 +1,20 @@
+"""Static TPU pricing DB (analog of internal/cloudprovider/pricing).
+
+Approximate public on-demand us-central prices per chip-hour; used by the
+billing recorder and the node expander's instance-type choice.
+"""
+
+PRICING = {
+    # generation: (on_demand_per_chip_hour, spot_per_chip_hour)
+    "v4": (3.22, 1.93),
+    "v5e": (1.20, 0.72),
+    "v5p": (4.20, 2.52),
+    "v6e": (2.70, 1.62),
+}
+
+
+def hourly_cost(generation: str, chips: float = 1.0,
+                capacity_type: str = "on-demand") -> float:
+    on_demand, spot = PRICING.get(generation, (0.0, 0.0))
+    rate = spot if capacity_type == "spot" else on_demand
+    return rate * chips

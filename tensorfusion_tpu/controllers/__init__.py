@@ -1,0 +1,7 @@
+"""Control-plane reconcilers."""
+
+from .base import Controller, ControllerManager
+from .core import (ChipController, ClusterController, ConnectionController,
+                   NodeClaimController, NodeController, PodController,
+                   PoolController, ProviderConfigController, QuotaController,
+                   WorkloadController)

@@ -1,0 +1,83 @@
+"""Controller runtime: watch-driven reconcile loops.
+
+The role controller-runtime plays for the reference (15 reconcilers in
+``internal/controller/``): each controller subscribes to store events for
+its kinds and reconciles one object at a time with retry/requeue; a shared
+``ControllerManager`` owns the threads.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..store import DELETED, Event, ObjectStore
+
+log = logging.getLogger("tpf.controller")
+
+
+class Controller:
+    """Subclass and override reconcile(event)."""
+
+    name = "controller"
+    kinds: Tuple[str, ...] = ()
+    #: also wake up every N seconds with a None event (resync pass)
+    resync_interval_s: float = 0.0
+
+    def reconcile(self, event: Optional[Event]) -> None:
+        raise NotImplementedError
+
+    def on_start(self) -> None:
+        pass
+
+
+class ControllerManager:
+    def __init__(self, store: ObjectStore):
+        self.store = store
+        self._controllers: List[Controller] = []
+        self._threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+
+    def register(self, controller: Controller) -> None:
+        self._controllers.append(controller)
+
+    def start(self) -> None:
+        for c in self._controllers:
+            t = threading.Thread(target=self._run, args=(c,),
+                                 name=f"tpf-ctrl-{c.name}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+
+    def _run(self, c: Controller) -> None:
+        try:
+            c.on_start()
+        except Exception:
+            log.exception("controller %s on_start failed", c.name)
+        watch = self.store.watch(*c.kinds)
+        last_resync = time.monotonic()
+        try:
+            while not self._stop.is_set():
+                timeout = 0.2
+                if c.resync_interval_s > 0:
+                    timeout = min(timeout, c.resync_interval_s / 4)
+                ev = watch.get(timeout=timeout)
+                try:
+                    if ev is not None:
+                        c.reconcile(ev)
+                    elif c.resync_interval_s > 0 and \
+                            time.monotonic() - last_resync >= \
+                            c.resync_interval_s:
+                        last_resync = time.monotonic()
+                        c.reconcile(None)
+                except Exception:
+                    log.exception("controller %s reconcile failed", c.name)
+        finally:
+            watch.stop()

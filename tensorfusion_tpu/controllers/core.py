@@ -1,0 +1,458 @@
+"""The control-plane reconcilers.
+
+Python analogs of the reference's 15 controllers (``internal/controller/``,
+SURVEY.md §2.2 row "Controllers"):
+
+- ClusterController    — TPUCluster -> fan out TPUPool objects
+- PoolController       — capacity rollup from chips, phase management
+- NodeController       — TPUNode lifecycle + hypervisor readiness rollup
+- ChipController       — TPUChip objects -> allocator inventory
+- QuotaController      — TPUResourceQuota -> quota store
+- ProviderConfigController — ProviderConfig -> chip model DB + templates
+- WorkloadController   — TPUWorkload replicas -> worker Pods, gang status
+- ConnectionController — TPUConnection -> select a worker, publish URL
+- PodController        — pod lifecycle: scheduling queue feed, dealloc +
+                         port/index release on delete, connection creation
+- NodeClaimController  — TPUNodeClaim -> (mock) cloud provisioning
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Dict, List, Optional
+
+from .. import constants
+from ..allocator.core import TPUAllocator
+from ..api import set_condition
+from ..api.types import (Container, Node, Pod, TPUChip, TPUCluster,
+                         TPUConnection, TPUNode, TPUNodeClaim, TPUPool,
+                         TPUResourceQuota, TPUWorkload)
+from ..store import ADDED, DELETED, MODIFIED, Event, NotFoundError, ObjectStore
+from ..webhook.parser import _truthy
+from .base import Controller
+
+log = logging.getLogger("tpf.controller")
+
+
+class ClusterController(Controller):
+    """TPUCluster -> ensure its pools exist (tensorfusioncluster_controller)."""
+
+    name = "cluster"
+    kinds = ("TPUCluster",)
+
+    def __init__(self, store: ObjectStore):
+        self.store = store
+
+    def reconcile(self, event):
+        if event is None or event.type == DELETED:
+            return
+        cluster: TPUCluster = event.obj
+        ready = 0
+        for pool_spec in cluster.spec.pools:
+            name = pool_spec.name or f"{cluster.name}-pool"
+            pool = self.store.try_get(TPUPool, name)
+            if pool is None:
+                pool = TPUPool.new(name)
+                pool.spec = pool_spec
+                pool.metadata.labels[constants.LABEL_CLUSTER_OWNER] = \
+                    cluster.name
+                self.store.create(pool)
+            if pool.status.phase == constants.PHASE_RUNNING:
+                ready += 1
+        cluster.status.total_pools = len(cluster.spec.pools)
+        cluster.status.ready_pools = ready
+        cluster.status.phase = (constants.PHASE_RUNNING
+                                if ready == len(cluster.spec.pools)
+                                else constants.PHASE_PENDING)
+        self.store.update(cluster)
+
+
+class PoolController(Controller):
+    """Capacity rollup + allocator pool config (gpupool_controller)."""
+
+    name = "pool"
+    kinds = ("TPUPool", "TPUChip")
+    resync_interval_s = 5.0
+
+    def __init__(self, store: ObjectStore, allocator: TPUAllocator):
+        self.store = store
+        self.allocator = allocator
+
+    def reconcile(self, event):
+        pools = self.store.list(TPUPool)
+        chips = self.store.list(TPUChip)
+        by_pool: Dict[str, List[TPUChip]] = {}
+        for chip in chips:
+            by_pool.setdefault(chip.status.pool, []).append(chip)
+        for pool in pools:
+            self.allocator.set_pool_oversell(
+                pool.name, pool.spec.capacity_config.tflops_oversell_percent)
+            self.allocator.set_pool_strategy(pool.name, "CompactFirst")
+            members = by_pool.get(pool.name, [])
+            cap = pool.status.capacity
+            cap.total.tflops = sum(c.status.capacity.tflops for c in members)
+            cap.total.hbm_bytes = sum(c.status.capacity.hbm_bytes
+                                      for c in members)
+            ratio = pool.spec.capacity_config.tflops_oversell_percent / 100.0
+            cap.virtual.tflops = cap.total.tflops * max(ratio, 1.0)
+            cap.virtual.hbm_bytes = cap.total.hbm_bytes
+            cap.available.tflops = sum(c.status.available.tflops
+                                       for c in members)
+            cap.available.hbm_bytes = sum(c.status.available.hbm_bytes
+                                          for c in members)
+            pool.status.total_chips = len(members)
+            nodes = {c.status.node_name for c in members}
+            pool.status.total_nodes = len(nodes)
+            pool.status.phase = (constants.PHASE_RUNNING if members
+                                 else constants.PHASE_PENDING)
+            try:
+                self.store.update(pool)
+            except NotFoundError:
+                pass
+
+
+class ChipController(Controller):
+    """TPUChip objects feed the allocator's in-memory inventory."""
+
+    name = "chip"
+    kinds = ("TPUChip",)
+
+    def __init__(self, allocator: TPUAllocator,
+                 on_change: Optional[Callable[[], None]] = None):
+        self.allocator = allocator
+        self.on_change = on_change or (lambda: None)
+
+    def reconcile(self, event):
+        if event is None:
+            return
+        if event.type == DELETED:
+            self.allocator.remove_chip(event.obj.name)
+        else:
+            self.allocator.upsert_chip(event.obj)
+        self.on_change()
+
+
+class NodeController(Controller):
+    """TPUNode rollup from its chips (gpunode_controller)."""
+
+    name = "node"
+    kinds = ("TPUNode", "TPUChip")
+    resync_interval_s = 10.0
+
+    def __init__(self, store: ObjectStore):
+        self.store = store
+
+    def reconcile(self, event):
+        chips = self.store.list(TPUChip)
+        by_node: Dict[str, List[TPUChip]] = {}
+        for c in chips:
+            by_node.setdefault(c.status.node_name, []).append(c)
+        for tnode in self.store.list(TPUNode):
+            members = by_node.get(tnode.name, [])
+            st = tnode.status
+            st.total_chips = len(members)
+            st.available_chips = sum(
+                1 for c in members
+                if c.status.phase == constants.PHASE_RUNNING)
+            st.total_tflops = sum(c.status.capacity.tflops for c in members)
+            st.total_hbm_bytes = sum(c.status.capacity.hbm_bytes
+                                     for c in members)
+            st.allocated_tflops = st.total_tflops - sum(
+                c.status.available.tflops for c in members)
+            st.allocated_hbm_bytes = st.total_hbm_bytes - sum(
+                c.status.available.hbm_bytes for c in members)
+            st.phase = (constants.PHASE_RUNNING
+                        if st.hypervisor_ready or members
+                        else constants.PHASE_PENDING)
+            try:
+                self.store.update(tnode)
+            except NotFoundError:
+                pass
+
+
+class QuotaController(Controller):
+    """TPUResourceQuota objects <-> quota store (gpuresourcequota_controller)."""
+
+    name = "quota"
+    kinds = ("TPUResourceQuota",)
+
+    def __init__(self, allocator: TPUAllocator):
+        self.allocator = allocator
+
+    def reconcile(self, event):
+        if event is None:
+            return
+        if event.type == DELETED:
+            self.allocator.quota.remove_quota(event.obj.metadata.namespace)
+        else:
+            self.allocator.quota.set_quota(event.obj)
+
+
+class ProviderConfigController(Controller):
+    """ProviderConfig -> chip model DB + partition template catalog
+    (providerconfig_controller + internal/provider/manager.go)."""
+
+    name = "providerconfig"
+    kinds = ("ProviderConfig",)
+
+    def __init__(self, allocator: TPUAllocator, parser=None):
+        self.allocator = allocator
+        self.parser = parser
+        self.chip_models = {}
+
+    def reconcile(self, event):
+        if event is None or event.type == DELETED:
+            return
+        cfg = event.obj
+        for m in cfg.spec.chip_models:
+            self.chip_models[m.generation] = m
+        templates = {t.template_id: t.core_count
+                     for t in cfg.spec.partition_templates}
+        if templates:
+            self.allocator.set_template_cores(templates)
+        if self.parser is not None:
+            self.parser.set_chip_models(self.chip_models)
+
+
+class WorkloadController(Controller):
+    """TPUWorkload -> desired worker pods + gang status rollup
+    (tensorfusionworkload_controller.go:180-338, :468-589)."""
+
+    name = "workload"
+    kinds = ("TPUWorkload", "Pod")
+    resync_interval_s = 5.0
+
+    def __init__(self, store: ObjectStore,
+                 worker_image: str = "tpufusion/worker:latest"):
+        self.store = store
+        self.worker_image = worker_image
+
+    def reconcile(self, event):
+        for wl in self.store.list(TPUWorkload):
+            if wl.spec.is_local_tpu or wl.spec.embedded_worker:
+                continue  # client pod runs on the TPU node itself
+            pods = self.store.list(
+                Pod, namespace=wl.metadata.namespace,
+                selector=lambda p: p.metadata.labels.get(
+                    constants.LABEL_WORKER_NAME, "").startswith(
+                        wl.metadata.name + "-worker"))
+            desired = max(wl.spec.replicas, 0)
+            # scale up
+            existing = {p.metadata.name for p in pods}
+            for i in range(desired):
+                name = f"{wl.metadata.name}-worker-{i}"
+                if name in existing:
+                    continue
+                self.store.create(self._worker_pod(wl, name))
+            # scale down extras (numeric replica order, not lexicographic)
+            def replica_index(p):
+                tail = p.metadata.name.rsplit("-", 1)[-1]
+                return int(tail) if tail.isdigit() else 1 << 30
+
+            for p in sorted(pods, key=replica_index)[desired:]:
+                self.store.delete(Pod, p.metadata.name, p.metadata.namespace)
+
+            # status rollup
+            running = sum(1 for p in pods
+                          if p.status.phase == constants.PHASE_RUNNING)
+            wl.status.replicas = desired
+            wl.status.ready_replicas = running
+            wl.status.worker_count = len(pods)
+            wl.status.phase = (constants.PHASE_RUNNING
+                               if desired and running >= desired
+                               else constants.PHASE_PENDING)
+            if wl.spec.gang.enabled:
+                g = wl.status.gang
+                g.group_key = f"{wl.metadata.namespace}/{wl.metadata.name}"
+                g.desired_members = desired
+                g.required_members = wl.spec.gang.min_members or desired
+                g.scheduled_members = running
+                g.phase = "Scheduled" if running >= g.required_members \
+                    else "Pending"
+            try:
+                self.store.update(wl)
+            except NotFoundError:
+                pass
+
+    def _worker_pod(self, wl: TPUWorkload, name: str) -> Pod:
+        pod = Pod.new(name, namespace=wl.metadata.namespace)
+        pod.metadata.labels[constants.LABEL_WORKER_NAME] = name
+        pod.metadata.labels[constants.LABEL_COMPONENT] = \
+            constants.COMPONENT_WORKER
+        pod.metadata.labels[constants.LABEL_MANAGED_BY] = "tpu-fusion"
+        pod.metadata.owner_references.append(
+            f"TPUWorkload/{wl.metadata.namespace}/{wl.metadata.name}")
+        ann = pod.metadata.annotations
+        ann[constants.ANN_WORKLOAD] = wl.metadata.name
+        ann[constants.ANN_POOL] = wl.spec.pool
+        req, lim = wl.spec.resources.requests, wl.spec.resources.limits
+        ann[constants.ANN_TFLOPS_REQUEST] = str(req.tflops)
+        ann[constants.ANN_HBM_REQUEST] = str(int(req.hbm_bytes))
+        ann[constants.ANN_TFLOPS_LIMIT] = str(lim.tflops)
+        ann[constants.ANN_HBM_LIMIT] = str(int(lim.hbm_bytes))
+        ann[constants.ANN_CHIP_COUNT] = str(wl.spec.chip_count)
+        ann[constants.ANN_QOS] = wl.spec.qos
+        ann[constants.ANN_ISOLATION] = wl.spec.isolation
+        if wl.spec.generation:
+            ann[constants.ANN_CHIP_GENERATION] = wl.spec.generation
+        if wl.spec.partition_template:
+            ann[constants.ANN_PARTITION_NAME] = wl.spec.partition_template
+        if wl.spec.gang.enabled:
+            ann[constants.ANN_GANG_ENABLED] = "true"
+            ann[constants.ANN_GANG_GROUP_KEY] = \
+                f"{wl.metadata.namespace}/{wl.metadata.name}"
+            ann[constants.ANN_GANG_DESIRED_MEMBERS] = str(wl.spec.replicas)
+            ann[constants.ANN_GANG_REQUIRED_MEMBERS] = \
+                str(wl.spec.gang.min_members or wl.spec.replicas)
+            if wl.spec.gang.timeout_seconds:
+                ann[constants.ANN_GANG_TIMEOUT] = \
+                    str(wl.spec.gang.timeout_seconds)
+        pod.spec.scheduler_name = constants.SCHEDULER_NAME
+        pod.spec.containers = [Container(name="worker",
+                                         image=self.worker_image)]
+        pod.metadata.labels[constants.LABEL_HOST_PORT] = \
+            constants.LABEL_HOST_PORT_AUTO
+        return pod
+
+
+class ConnectionController(Controller):
+    """TPUConnection -> pick a running worker of the workload, publish its
+    URL (tensorfusionconnection_controller.go:140-260)."""
+
+    name = "connection"
+    kinds = ("TPUConnection", "Pod")
+    resync_interval_s = 2.0
+
+    def __init__(self, store: ObjectStore):
+        self.store = store
+
+    def reconcile(self, event):
+        for conn in self.store.list(TPUConnection):
+            if conn.status.phase == constants.PHASE_RUNNING and \
+                    conn.status.worker_url:
+                # verify the worker still exists
+                worker = self.store.try_get(Pod, conn.status.worker_name,
+                                            conn.metadata.namespace)
+                if worker is not None and \
+                        worker.status.phase == constants.PHASE_RUNNING:
+                    continue
+                conn.status.phase = constants.PHASE_PENDING
+                conn.status.worker_name = ""
+                conn.status.worker_url = ""
+            workers = self.store.list(
+                Pod, namespace=conn.metadata.namespace,
+                selector=lambda p: (
+                    p.metadata.annotations.get(constants.ANN_WORKLOAD)
+                    == conn.spec.workload
+                    and p.metadata.labels.get(constants.LABEL_COMPONENT)
+                    == constants.COMPONENT_WORKER
+                    and p.status.phase == constants.PHASE_RUNNING))
+            if not workers:
+                self.store.update(conn)
+                continue
+            # least-loaded worker: fewest existing connections
+            counts: Dict[str, int] = {}
+            for other in self.store.list(TPUConnection,
+                                         namespace=conn.metadata.namespace):
+                if other.status.worker_name:
+                    counts[other.status.worker_name] = \
+                        counts.get(other.status.worker_name, 0) + 1
+            workers.sort(key=lambda p: counts.get(p.metadata.name, 0))
+            chosen = workers[0]
+            port = chosen.metadata.annotations.get(
+                constants.ANN_PORT_NUMBER, "0")
+            host = chosen.status.host_ip or chosen.spec.node_name or "0.0.0.0"
+            conn.status.worker_name = chosen.metadata.name
+            conn.status.worker_url = f"tcp://{host}:{port}"
+            conn.status.phase = constants.PHASE_RUNNING
+            self.store.update(conn)
+
+
+class PodController(Controller):
+    """Pod lifecycle: feed the scheduler queue, create connections for
+    client pods, release allocations/ports/indices on delete
+    (pod_controller.go:262 + finalizer paths)."""
+
+    name = "pod"
+    kinds = ("Pod",)
+
+    def __init__(self, store: ObjectStore, allocator: TPUAllocator,
+                 scheduler=None, ports=None, indices=None, gang=None):
+        self.store = store
+        self.allocator = allocator
+        self.scheduler = scheduler
+        self.ports = ports
+        self.indices = indices
+        self.gang = gang
+
+    def reconcile(self, event):
+        if event is None:
+            return
+        pod: Pod = event.obj
+        key = pod.key()
+        if event.type == DELETED:
+            self.allocator.dealloc(key)
+            if self.ports is not None:
+                self.ports.release_owner(key)
+            if self.indices is not None:
+                self.indices.release(key)
+            if self.gang is not None:
+                self.gang.on_pod_deleted(key)
+            if self.scheduler is not None:
+                self.scheduler.forget(key)
+                self.scheduler.activate()  # freed capacity may unblock others
+            return
+        if event.type == ADDED and \
+                pod.spec.scheduler_name == constants.SCHEDULER_NAME and \
+                not pod.spec.node_name and self.scheduler is not None:
+            self.scheduler.enqueue(pod)
+        # client pods that want a remote worker get a TPUConnection
+        if event.type == ADDED and pod.metadata.annotations.get(
+                constants.ANN_WORKLOAD) and \
+                pod.metadata.labels.get(constants.LABEL_COMPONENT) not in (
+                    constants.COMPONENT_WORKER,) and \
+                not _truthy(pod.metadata.annotations.get(
+                    constants.ANN_IS_LOCAL_TPU, "")):
+            conn_name = f"{pod.metadata.name}-conn"
+            if self.store.try_get(TPUConnection, conn_name,
+                                  pod.metadata.namespace) is None:
+                conn = TPUConnection.new(conn_name,
+                                         namespace=pod.metadata.namespace)
+                conn.spec.workload = pod.metadata.annotations[
+                    constants.ANN_WORKLOAD]
+                conn.spec.client_pod = pod.metadata.name
+                self.store.create(conn)
+
+
+class NodeClaimController(Controller):
+    """TPUNodeClaim -> provision a node via the cloud provider
+    (gpunodeclaim controller + internal/cloudprovider)."""
+
+    name = "nodeclaim"
+    kinds = ("TPUNodeClaim",)
+
+    def __init__(self, store: ObjectStore, provider=None):
+        self.store = store
+        self.provider = provider  # cloudprovider instance (mock by default)
+
+    def reconcile(self, event):
+        if event is None or event.type == DELETED:
+            return
+        claim: TPUNodeClaim = event.obj
+        if claim.status.phase in (constants.PHASE_RUNNING,
+                                  constants.PHASE_FAILED):
+            return
+        if self.provider is None:
+            return
+        try:
+            node_name, instance_id = self.provider.provision(claim)
+        except Exception as e:  # noqa: BLE001
+            claim.status.phase = constants.PHASE_FAILED
+            claim.status.message = str(e)
+            self.store.update(claim)
+            return
+        claim.status.phase = constants.PHASE_RUNNING
+        claim.status.node_name = node_name
+        claim.status.instance_id = instance_id
+        self.store.update(claim)

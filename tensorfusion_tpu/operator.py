@@ -1,0 +1,272 @@
+"""The tpu-fusion operator: one process hosting the whole control plane.
+
+Analog of the reference's single operator binary (``cmd/main.go:128-812``):
+object store + allocator + quota + webhook + embedded scheduler + gang
+manager + node expander + controllers + client HTTP API + metrics, wired
+exactly like SURVEY.md §3.1's startup call stack.
+
+Usage (library):
+    op = Operator()
+    op.start()
+    pod = op.submit_pod(pod)        # admission -> schedule -> bind
+    ...
+    op.stop()
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+from . import constants
+from .allocator import IndexAllocator, PortAllocator, TPUAllocator
+from .api.types import Node, Pod, TPUChip
+from .cloudprovider import MockCloudProvider
+from .controllers.base import ControllerManager
+from .controllers.core import (ChipController, ClusterController,
+                               ConnectionController, NodeClaimController,
+                               NodeController, PodController, PoolController,
+                               ProviderConfigController, QuotaController,
+                               WorkloadController)
+from .scheduler import GangManager, ICITopologyPlugin, Scheduler, TPUResourcesFit
+from .scheduler.expander import NodeExpander
+from .store import NotFoundError, ObjectStore
+from .webhook.mutator import PodMutator
+from .webhook.parser import WorkloadParser
+
+log = logging.getLogger("tpf.operator")
+
+
+class Operator:
+    def __init__(self, store: Optional[ObjectStore] = None,
+                 enable_expander: bool = True,
+                 sync_interval_s: float = 2.0):
+        self.store = store or ObjectStore()
+        self.allocator = TPUAllocator(store=self.store)
+        self.ports = PortAllocator()
+        self.indices = IndexAllocator()
+        self.parser = WorkloadParser(self.store)
+        self.mutator = PodMutator(self.store, self.parser)
+        self.gang = GangManager()
+        self.cloud = MockCloudProvider(self.store)
+        self.expander = NodeExpander(self.store, enabled=enable_expander)
+        self.sync_interval_s = sync_interval_s
+
+        self.fit = TPUResourcesFit(
+            self.allocator, gang=self.gang, ports=self.ports,
+            indices=self.indices, pods_on_node=self._pods_on_node,
+            evict=self._evict_pod)
+        self.scheduler = Scheduler(nodes_fn=self._node_names,
+                                   bind_fn=self._bind_pod,
+                                   failure_handler=self._on_sched_failure)
+        self.gang.bind_scheduler(self.scheduler)
+        self.scheduler.register(self.fit)
+        self.scheduler.register(ICITopologyPlugin())
+        self.allocator.set_gang_waiting_probe(self.gang.is_waiting)
+
+        self.manager = ControllerManager(self.store)
+        self.providerconfig_ctrl = ProviderConfigController(
+            self.allocator, self.parser)
+        for ctrl in (
+                ClusterController(self.store),
+                PoolController(self.store, self.allocator),
+                ChipController(self.allocator,
+                               on_change=self.scheduler.activate),
+                NodeController(self.store),
+                QuotaController(self.allocator),
+                self.providerconfig_ctrl,
+                WorkloadController(self.store),
+                ConnectionController(self.store),
+                PodController(self.store, self.allocator, self.scheduler,
+                              self.ports, self.indices, self.gang),
+                NodeClaimController(self.store, self.cloud)):
+            self.manager.register(ctrl)
+
+        self._stop = threading.Event()
+        self._sync_thread: Optional[threading.Thread] = None
+        self._started = False
+
+    # -- lifecycle (cmd/main.go startup order analog) ----------------------
+
+    def start(self) -> None:
+        if self._started:
+            return
+        # restart recovery before serving: chips first (the watch replay is
+        # async), then rebuild allocator + quota state from persisted pods
+        # (reconcileAllocationState analog)
+        for chip in self.store.list(TPUChip):
+            self.allocator.upsert_chip(chip)
+        pods = self.store.list(Pod)
+        if pods:
+            restored = self.allocator.reconcile(
+                [p for p in pods if p.spec.node_name])
+            if restored:
+                log.info("restored %d allocations from pod annotations",
+                         restored)
+        self.manager.start()
+        self.scheduler.start()
+        self._sync_thread = threading.Thread(target=self._sync_loop,
+                                             name="tpf-operator-sync",
+                                             daemon=True)
+        self._sync_thread.start()
+        self._started = True
+        log.info("operator started")
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.scheduler.stop()
+        self.manager.stop()
+        if self._sync_thread:
+            self._sync_thread.join(timeout=2)
+        self._started = False
+
+    def _sync_loop(self) -> None:
+        """Background maintenance: dirty chip flush + assumed-TTL sweep
+        (gpuallocator syncToK8s / TTL sweep loops)."""
+        while not self._stop.wait(self.sync_interval_s):
+            try:
+                self.allocator.sync_to_store()
+                self.allocator.sweep_assumed()
+            except Exception:
+                log.exception("operator sync pass failed")
+
+    # -- pod entry points ---------------------------------------------------
+
+    def submit_pod(self, pod: Pod) -> Pod:
+        """Admission path: mutate + persist.  The PodController enqueues it
+        for scheduling; callers can wait_for_binding()."""
+        pod = self.mutator.handle(pod)
+        return self.store.create(pod)
+
+    def delete_pod(self, name: str, namespace: str = "default") -> None:
+        self.store.delete(Pod, name, namespace)
+
+    def wait_for_binding(self, name: str, namespace: str = "default",
+                         timeout: float = 10.0) -> Optional[Pod]:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            pod = self.store.try_get(Pod, name, namespace)
+            if pod is not None and pod.spec.node_name:
+                return pod
+            time.sleep(0.02)
+        return None
+
+    # -- scheduler wiring ---------------------------------------------------
+
+    def _node_names(self) -> List[str]:
+        return [n.name for n in self.store.list(Node)
+                if n.status.phase == constants.PHASE_RUNNING]
+
+    def _bind_pod(self, pod: Pod, node: str) -> None:
+        current = self.store.get(Pod, pod.metadata.name,
+                                 pod.metadata.namespace)
+        current.spec.node_name = node
+        current.metadata.annotations.update(pod.metadata.annotations)
+        current.status.phase = constants.PHASE_RUNNING
+        current.status.host_ip = node
+        self.store.update(current)
+
+    def _pods_on_node(self, node: str) -> List[Pod]:
+        return self.store.list(Pod,
+                               selector=lambda p: p.spec.node_name == node)
+
+    def _evict_pod(self, pod: Pod) -> None:
+        log.info("evicting %s (preemption)", pod.key())
+        try:
+            self.store.delete(Pod, pod.metadata.name, pod.metadata.namespace)
+        except NotFoundError:
+            pass
+
+    def _on_sched_failure(self, pod: Pod, reason: str) -> None:
+        self.expander.handle_failure(pod, reason)
+
+    # -- convenience --------------------------------------------------------
+
+    def register_host(self, node_name: str, chips: List[TPUChip]) -> None:
+        """Register a TPU host and its chips (what the hypervisor's
+        control-plane backend does from device discovery)."""
+        node = Node.new(node_name)
+        node.status.phase = constants.PHASE_RUNNING
+        try:
+            self.store.create(node)
+        except Exception:
+            pass
+        for chip in chips:
+            chip.status.node_name = node_name
+            self.store.update_or_create(chip)
+        self.scheduler.activate()
+
+
+def main(argv=None) -> int:
+    """Operator daemon entrypoint (cmd/main.go analog):
+
+        python -m tensorfusion_tpu.operator --port 8080 \
+            [--persist-dir DIR] [--bootstrap-host v5e:8]
+    """
+    import argparse
+    import signal
+
+    from .api.types import TPUNodeClaim, TPUPool
+    from .server import OperatorServer
+
+    ap = argparse.ArgumentParser(prog="tpf-operator")
+    ap.add_argument("--port", type=int, default=constants.DEFAULT_OPERATOR_PORT)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--persist-dir", default="",
+                    help="JSONL persistence dir (enables restart recovery)")
+    ap.add_argument("--pool", default="pool-a")
+    ap.add_argument("--bootstrap-host", default="",
+                    help="GEN:CHIPS — provision one simulated host at boot "
+                         "(e.g. v5e:8)")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s %(message)s")
+
+    store = ObjectStore(persist_dir=args.persist_dir or None)
+    if args.persist_dir:
+        from .api.types import ALL_KINDS
+        n = store.load(ALL_KINDS)
+        if n:
+            log.info("loaded %d persisted objects", n)
+
+    op = Operator(store=store)
+    if store.try_get(TPUPool, args.pool) is None:
+        pool = TPUPool.new(args.pool)
+        pool.spec.name = args.pool
+        store.create(pool)
+    if args.bootstrap_host:
+        gen, _, chips = args.bootstrap_host.partition(":")
+        claim = TPUNodeClaim.new(f"bootstrap-{gen}")
+        claim.spec.pool = args.pool
+        claim.spec.generation = gen or "v5e"
+        claim.spec.chip_count = int(chips or 8)
+        try:
+            store.create(claim)
+        except Exception:
+            pass
+    op.start()
+    server = OperatorServer(op, host=args.host, port=args.port)
+    server.start()
+    log.info("operator API serving on %s", server.url)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    try:
+        while not stop.wait(0.5):
+            pass
+    finally:
+        server.stop()
+        op.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,86 @@
+"""Node expander: scheduler failure handler -> node provisioning.
+
+Analog of the reference's ``internal/scheduler/expander/handler.go``
+(hooked as the scheduler FailureHandler, ``cmd/sched/setup.go:160-180``):
+when a pod is rejected for TPU capacity, pick an instance type that would
+fit it and create a ``TPUNodeClaim``; track in-flight claims so one
+capacity crunch produces one node, not one per retry.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, Optional
+
+from .. import constants
+from ..api.types import Pod, TPUNodeClaim
+from ..cloudprovider.mock import TPU_INSTANCE_TYPES
+from ..store import AlreadyExistsError, ObjectStore
+from .tpuresources import compose_alloc_request
+
+log = logging.getLogger("tpf.scheduler.expander")
+
+_CAPACITY_MARKERS = ("insufficient", "no eligible chips",
+                     "0/", "nodes feasible", "same-node")
+
+
+class NodeExpander:
+    def __init__(self, store: ObjectStore, enabled: bool = True,
+                 inflight_ttl_s: float = 120.0):
+        self.store = store
+        self.enabled = enabled
+        self.inflight_ttl_s = inflight_ttl_s
+        self._lock = threading.Lock()
+        self._inflight: Dict[str, float] = {}   # pool/generation -> ts
+
+    def handle_failure(self, pod: Pod, reason: str) -> Optional[str]:
+        """Scheduler failure-handler hook.  Returns the claim name when an
+        expansion was requested."""
+        if not self.enabled:
+            return None
+        if not any(m in reason for m in _CAPACITY_MARKERS):
+            return None  # not a capacity problem; a node won't help
+        req = compose_alloc_request(pod)
+        if req is None:
+            return None
+        generation = req.generation or "v5e"
+        key = f"{req.pool}/{generation}"
+        now = time.time()
+        with self._lock:
+            ts = self._inflight.get(key, 0.0)
+            if now - ts < self.inflight_ttl_s:
+                return None  # an expansion for this shape is in flight
+            self._inflight[key] = now
+
+        # choose the smallest instance type that fits the request shape
+        candidates = sorted(
+            (it for it in TPU_INSTANCE_TYPES.values()
+             if it.generation == generation and it.chips >= req.chip_count
+             and it.hbm_bytes >= req.request.hbm_bytes),
+            key=lambda it: it.chips)
+        if not candidates:
+            log.warning("no instance type fits %s (%d chips, %.0f B HBM)",
+                        pod.key(), req.chip_count, req.request.hbm_bytes)
+            return None
+        it = candidates[0]
+        claim_name = f"expand-{req.pool or 'default'}-{generation}-" \
+                     f"{int(now) % 100000}"
+        claim = TPUNodeClaim.new(claim_name)
+        claim.spec.pool = req.pool
+        claim.spec.generation = generation
+        claim.spec.chip_count = it.chips
+        claim.spec.instance_type = it.name
+        claim.metadata.labels[constants.LABEL_EXPANSION_SOURCE] = pod.key()
+        try:
+            self.store.create(claim)
+        except AlreadyExistsError:
+            return None
+        log.info("capacity expansion: claim %s (%s) for pod %s",
+                 claim_name, it.name, pod.key())
+        return claim_name
+
+    def clear_inflight(self, pool: str, generation: str) -> None:
+        with self._lock:
+            self._inflight.pop(f"{pool}/{generation}", None)

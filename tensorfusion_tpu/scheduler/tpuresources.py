@@ -30,7 +30,8 @@ from ..allocator.core import (AllocationConflictError, ChipState,
 from ..allocator.indexalloc import IndexAllocator
 from ..allocator.portalloc import PortAllocator, PortExhaustedError
 from ..allocator.quota import QuotaExceededError
-from ..api.resources import AllocRequest, GangConfig, ResourceAmount
+from ..api.resources import (AllocRequest, GangConfig, ResourceAmount,
+                             parse_quantity)
 from ..api.types import Pod
 from .framework import (Code, CycleState, FilterPlugin, OK, PermitPlugin, STATE_PREFILTER_NODES,
                         PostBindPlugin, PostFilterPlugin, PreBindPlugin,
@@ -66,13 +67,17 @@ def compose_alloc_request(pod: Pod) -> Optional[AllocRequest]:
         workload_name=ann.get(constants.ANN_WORKLOAD, ""),
         pod_name=pod.metadata.name,
         request=ResourceAmount(
-            tflops=float(ann.get(constants.ANN_TFLOPS_REQUEST, 0) or 0),
+            tflops=parse_quantity(ann.get(constants.ANN_TFLOPS_REQUEST, 0)
+                                  or 0),
             duty_percent=float(ann.get(constants.ANN_DUTY_REQUEST, 0) or 0),
-            hbm_bytes=float(ann.get(constants.ANN_HBM_REQUEST, 0) or 0)),
+            hbm_bytes=parse_quantity(ann.get(constants.ANN_HBM_REQUEST, 0)
+                                     or 0)),
         limit=ResourceAmount(
-            tflops=float(ann.get(constants.ANN_TFLOPS_LIMIT, 0) or 0),
+            tflops=parse_quantity(ann.get(constants.ANN_TFLOPS_LIMIT, 0)
+                                  or 0),
             duty_percent=float(ann.get(constants.ANN_DUTY_LIMIT, 0) or 0),
-            hbm_bytes=float(ann.get(constants.ANN_HBM_LIMIT, 0) or 0)),
+            hbm_bytes=parse_quantity(ann.get(constants.ANN_HBM_LIMIT, 0)
+                                     or 0)),
         chip_count=int(ann.get(constants.ANN_CHIP_COUNT, 1) or 1),
         generation=ann.get(constants.ANN_CHIP_GENERATION, ""),
         vendor=ann.get(constants.ANN_VENDOR, ""),

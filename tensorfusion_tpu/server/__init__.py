@@ -1,0 +1,3 @@
+"""Operator HTTP API for clients."""
+
+from .api import OperatorServer

@@ -1,0 +1,196 @@
+"""Operator HTTP API (client-facing control-plane endpoints).
+
+Analog of the reference's gin server (``internal/server/``,
+``cmd/main.go:322-373``, port 8080):
+
+- ``GET  /connection?name=&namespace=[&wait_s=]`` — worker URL for a client
+  connection (long-polls until the connection controller publishes one);
+- ``POST /assign-host-port``  — leader port assignment;
+- ``POST /assign-index``      — pod device-allocation index;
+- ``GET  /allocator-info``    — chip inventory + allocations snapshot;
+- ``POST /api/submit-pod``    — admission entry (webhook analog over HTTP);
+- ``POST /api/simulate-schedule`` — dry-run with per-chip filter details
+  (gpuallocator.go:255-262 simulate path, explain=True).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from ..api.meta import from_dict
+from ..api.types import Pod, TPUConnection
+from ..scheduler.tpuresources import compose_alloc_request
+from ..webhook.parser import ParseError
+
+log = logging.getLogger("tpf.server")
+
+
+def _jsonable(obj):
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return dataclasses.asdict(obj)
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _jsonable(v) for k, v in obj.items()}
+    return obj
+
+
+class OperatorServer:
+    def __init__(self, operator, host: str = "127.0.0.1", port: int = 0):
+        self.operator = operator
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                log.debug(fmt, *args)
+
+            def _send(self, code, payload):
+                body = json.dumps(_jsonable(payload)).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self):
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n)) if n else {}
+
+            def do_GET(self):
+                try:
+                    outer._get(self)
+                except Exception as e:  # noqa: BLE001
+                    log.exception("GET %s", self.path)
+                    self._send(500, {"error": str(e)})
+
+            def do_POST(self):
+                try:
+                    outer._post(self)
+                except ParseError as e:
+                    self._send(400, {"error": str(e)})
+                except Exception as e:  # noqa: BLE001
+                    log.exception("POST %s", self.path)
+                    self._send(500, {"error": str(e)})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="tpf-operator-http", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # ------------------------------------------------------------------
+
+    def _get(self, h) -> None:
+        url = urlparse(h.path)
+        qs = parse_qs(url.query)
+        op = self.operator
+        if url.path == "/healthz":
+            h._send(200, {"ok": True})
+        elif url.path == "/connection":
+            name = qs.get("name", [""])[0]
+            ns = qs.get("namespace", ["default"])[0]
+            wait_s = float(qs.get("wait_s", ["0"])[0])
+            deadline = time.time() + wait_s
+            while True:
+                conn = op.store.try_get(TPUConnection, name, ns)
+                if conn is not None and conn.status.worker_url:
+                    h._send(200, {"phase": conn.status.phase,
+                                  "worker_name": conn.status.worker_name,
+                                  "worker_url": conn.status.worker_url})
+                    return
+                if time.time() >= deadline:
+                    break
+                time.sleep(0.05)
+            if conn is None:
+                h._send(404, {"error": f"connection {ns}/{name} not found"})
+            else:
+                h._send(200, {"phase": conn.status.phase, "worker_url": ""})
+        elif url.path == "/allocator-info":
+            chips = [{
+                "name": c.chip.name,
+                "node": c.chip.status.node_name,
+                "pool": c.chip.status.pool,
+                "generation": c.chip.status.generation,
+                "available_tflops": c.available().tflops,
+                "available_hbm": c.available().hbm_bytes,
+                "holders": list(c.holders),
+            } for c in op.allocator.chips()]
+            allocs = [{
+                "key": r.key, "chips": r.chip_ids, "assumed": r.assumed,
+                "tflops": r.request.request.tflops,
+                "hbm": r.request.request.hbm_bytes,
+            } for r in op.allocator.allocations()]
+            h._send(200, {"chips": chips, "allocations": allocs})
+        elif url.path == "/node-scaler-info":
+            from ..api.types import TPUNodeClaim
+            out = [{"name": c.name, "phase": c.status.phase,
+                    "instance_type": c.spec.instance_type,
+                    "node": c.status.node_name}
+                   for c in op.store.list(TPUNodeClaim)]
+            h._send(200, out)
+        else:
+            h._send(404, {"error": "not found"})
+
+    def _post(self, h) -> None:
+        url = urlparse(h.path)
+        op = self.operator
+        if url.path == "/assign-host-port":
+            body = h._body()
+            port = op.ports.assign_node_port(body.get("node", "unknown"),
+                                             body.get("owner", "unknown"))
+            h._send(200, {"port": port})
+        elif url.path == "/assign-index":
+            body = h._body()
+            idx = op.indices.assign(body.get("owner", "unknown"))
+            h._send(200, {"index": idx})
+        elif url.path == "/api/submit-pod":
+            body = h._body()
+            pod = from_dict(Pod, body)
+            if not pod.metadata.uid:
+                import uuid
+                pod.metadata.uid = uuid.uuid4().hex
+                pod.metadata.creation_timestamp = time.time()
+            created = op.submit_pod(pod)
+            h._send(201, created.to_dict())
+        elif url.path == "/api/simulate-schedule":
+            body = h._body()
+            pod = from_dict(Pod, body)
+            req = compose_alloc_request(pod)
+            if req is None:
+                h._send(400, {"error": "pod carries no TPU request "
+                                       "annotations"})
+                return
+            try:
+                by_node, rejections = op.allocator.check_quota_and_filter(
+                    req, explain=True)
+            except Exception as e:  # QuotaExceededError etc.
+                h._send(200, {"schedulable": False, "error": str(e),
+                              "rejections": {}})
+                return
+            h._send(200, {
+                "schedulable": bool(by_node),
+                "eligible_nodes": {node: [c.chip.name for c in chips]
+                                   for node, chips in by_node.items()},
+                "rejections": rejections,
+                "node_scores": op.allocator.score_nodes(req, by_node),
+            })
+        else:
+            h._send(404, {"error": "not found"})

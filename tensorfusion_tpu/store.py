@@ -101,6 +101,15 @@ class ObjectStore:
             if w in self._watches:
                 self._watches.remove(w)
 
+    @staticmethod
+    def _content_equal(a: Resource, b: Resource) -> bool:
+        da, db = a.to_dict(), b.to_dict()
+        for d in (da, db):
+            meta = d.get("metadata", {})
+            meta.pop("resource_version", None)
+            meta.pop("generation", None)
+        return da == db
+
     def _persist(self, kind: str) -> None:
         if not self._persist_dir:
             return
@@ -156,6 +165,11 @@ class ObjectStore:
                 raise ConflictError(
                     f"{obj.KIND} {key}: version {obj.metadata.resource_version}"
                     f" != {current.metadata.resource_version}")
+            # No-op updates neither bump the version nor emit MODIFIED —
+            # otherwise controllers that update the kinds they watch would
+            # feed themselves a self-sustaining event loop.
+            if self._content_equal(obj, current):
+                return current.deepcopy()
             self._rv += 1
             obj.metadata.resource_version = self._rv
             obj.metadata.generation = current.metadata.generation + 1

@@ -1,0 +1,4 @@
+"""Admission webhook: annotation parsing + pod mutation."""
+
+from .mutator import PodMutator
+from .parser import ParseError, WorkloadParser

@@ -1,0 +1,133 @@
+"""Admission mutator: the pod-creation entry point of the platform.
+
+Analog of the reference's mutating webhook
+(``internal/webhook/v1/pod_webhook.go:84-265`` + the pod-composition library
+``internal/utils/compose.go``): on pod submission it
+
+1. parses annotations into an effective workload spec (parser.py);
+2. creates/updates the server-side ``TPUWorkload`` object;
+3. stamps the canonical annotation contract back onto the pod (resources,
+   gang group/desired/required members, workload name);
+4. routes the pod to the tpu-fusion scheduler and maps QoS -> priority;
+5. injects the client runtime env (operator URL, vTPU activation) — the
+   TPU analog of injecting the CUDA-intercept client container.
+
+With no real kubelet, "containers" are env recipes consumed by whichever
+backend runs the pod (single-node spawner or the cluster simulator).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from .. import constants
+from ..api.types import Pod, TPUWorkload
+from ..store import ObjectStore
+from .parser import ParseError, WorkloadParser
+
+log = logging.getLogger("tpf.webhook")
+
+
+class PodMutator:
+    def __init__(self, store: ObjectStore, parser: WorkloadParser,
+                 operator_url: str = ""):
+        self.store = store
+        self.parser = parser
+        self.operator_url = operator_url
+        self.mutated_count = 0
+        self._counters: dict = {}
+        self._counter_lock = threading.Lock()
+
+    def handle(self, pod: Pod) -> Pod:
+        """Mutate a pod on admission; raises ParseError on bad requests."""
+        if not self.parser.is_tpu_fusion_pod(pod):
+            return pod
+        spec = self.parser.parse(pod)
+        ann = pod.metadata.annotations
+
+        # grey release: only mutate the first N replicas of a counter key
+        # (pod_webhook.go:148-163 analog)
+        counter_key = ann.get(constants.ANN_POD_COUNTER_KEY)
+        enabled = ann.get(constants.ANN_ENABLED_REPLICAS)
+        if counter_key and enabled is not None:
+            count = self._bump_counter(counter_key)
+            if count > int(enabled):
+                log.info("grey release: pod %s beyond enabled replicas (%s)",
+                         pod.key(), enabled)
+                return pod
+
+        workload = self._ensure_workload(pod, spec)
+
+        # canonical annotation contract (scheduler reads these)
+        ann[constants.ANN_WORKLOAD] = workload.metadata.name
+        ann[constants.ANN_POOL] = spec.pool
+        ann[constants.ANN_TFLOPS_REQUEST] = str(spec.resources.requests.tflops)
+        ann[constants.ANN_HBM_REQUEST] = \
+            str(int(spec.resources.requests.hbm_bytes))
+        ann[constants.ANN_TFLOPS_LIMIT] = str(spec.resources.limits.tflops)
+        ann[constants.ANN_HBM_LIMIT] = \
+            str(int(spec.resources.limits.hbm_bytes))
+        if spec.resources.requests.duty_percent:
+            ann[constants.ANN_DUTY_REQUEST] = \
+                str(spec.resources.requests.duty_percent)
+        ann[constants.ANN_CHIP_COUNT] = str(spec.chip_count)
+        ann[constants.ANN_QOS] = spec.qos
+        ann[constants.ANN_ISOLATION] = spec.isolation
+        if spec.generation:
+            ann[constants.ANN_CHIP_GENERATION] = spec.generation
+        if spec.partition_template:
+            ann[constants.ANN_PARTITION_NAME] = spec.partition_template
+
+        # gang stamping (pod_webhook -> gang-desired/required members)
+        if spec.gang.enabled:
+            ann[constants.ANN_GANG_ENABLED] = "true"
+            desired = int(ann.get(constants.ANN_GANG_DESIRED_MEMBERS, 0) or
+                          spec.gang.min_members or 1)
+            required = spec.gang.min_members or desired
+            ann[constants.ANN_GANG_DESIRED_MEMBERS] = str(desired)
+            ann[constants.ANN_GANG_REQUIRED_MEMBERS] = str(required)
+            ann[constants.ANN_GANG_GROUP_KEY] = \
+                f"{pod.metadata.namespace}/{workload.metadata.name}"
+            if spec.gang.timeout_seconds:
+                ann[constants.ANN_GANG_TIMEOUT] = \
+                    str(spec.gang.timeout_seconds)
+
+        # scheduling
+        pod.spec.scheduler_name = constants.SCHEDULER_NAME
+        pod.spec.priority = self.parser.qos_priority(spec.qos)
+
+        # client runtime injection (compose.go AddTFDefaultClientConf analog)
+        for container in pod.spec.containers or []:
+            env = container.env
+            env.setdefault(constants.ENV_VTPU_ENABLED, "1")
+            env.setdefault(constants.ENV_POD_NAME, pod.metadata.name)
+            env.setdefault(constants.ENV_POD_NAMESPACE,
+                           pod.metadata.namespace)
+            if self.operator_url:
+                env.setdefault(constants.ENV_OPERATOR_URL, self.operator_url)
+            env.setdefault(constants.ENV_ISOLATION, spec.isolation)
+
+        self.mutated_count += 1
+        return pod
+
+    # ------------------------------------------------------------------
+
+    def _ensure_workload(self, pod: Pod, spec) -> TPUWorkload:
+        name = pod.metadata.annotations.get(constants.ANN_WORKLOAD) or \
+            pod.metadata.name
+        existing = self.store.try_get(TPUWorkload, name,
+                                      pod.metadata.namespace)
+        if existing is not None:
+            existing.spec = spec
+            return self.store.update(existing)
+        wl = TPUWorkload.new(name, namespace=pod.metadata.namespace)
+        wl.spec = spec
+        wl.metadata.labels[constants.LABEL_MANAGED_BY] = "tpu-fusion"
+        return self.store.create(wl)
+
+    def _bump_counter(self, key: str) -> int:
+        with self._counter_lock:
+            self._counters[key] = self._counters.get(key, 0) + 1
+            return self._counters[key]

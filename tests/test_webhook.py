@@ -1,0 +1,120 @@
+"""Webhook parser/mutator tests (tf_parser_test + pod_webhook_test analog)."""
+
+import pytest
+
+from tensorfusion_tpu import constants
+from tensorfusion_tpu.api import ResourceAmount
+from tensorfusion_tpu.api.types import ChipModelInfo, Container, Pod, WorkloadProfile
+from tensorfusion_tpu.store import ObjectStore
+from tensorfusion_tpu.webhook import ParseError, PodMutator, WorkloadParser
+
+V5E = ChipModelInfo(generation="v5e", bf16_tflops=197.0,
+                    hbm_bytes=16 * 2**30)
+
+
+def make_parser(store=None):
+    return WorkloadParser(store, chip_models={"v5e": V5E},
+                          default_pool="pool-a")
+
+
+def pod_with(ann, name="p1"):
+    pod = Pod.new(name, namespace="default")
+    pod.metadata.annotations.update(ann)
+    pod.spec.containers = [Container(name="main")]
+    return pod
+
+
+def test_parse_inline_annotations():
+    p = make_parser()
+    pod = pod_with({constants.ANN_TFLOPS_REQUEST: "50",
+                    constants.ANN_HBM_REQUEST: "4Gi",
+                    constants.ANN_QOS: "high",
+                    constants.ANN_ISOLATION: "hard",
+                    constants.ANN_CHIP_COUNT: "2"})
+    spec = p.parse(pod)
+    assert spec.resources.requests.tflops == 50.0
+    assert spec.resources.requests.hbm_bytes == 4 * 2**30
+    assert spec.qos == "high"
+    assert spec.isolation == "hard"
+    assert spec.chip_count == 2
+    assert spec.pool == "pool-a"          # default pool
+    assert spec.resources.limits.tflops == 50.0  # limit defaults to request
+
+
+def test_parse_duty_normalization():
+    p = make_parser()
+    pod = pod_with({constants.ANN_DUTY_REQUEST: "25",
+                    constants.ANN_HBM_REQUEST: "1Gi",
+                    constants.ANN_CHIP_GENERATION: "v5e"})
+    spec = p.parse(pod)
+    assert spec.resources.requests.tflops == pytest.approx(49.25)
+
+    pod2 = pod_with({constants.ANN_TFLOPS_REQUEST: "98.5",
+                     constants.ANN_HBM_REQUEST: "1Gi",
+                     constants.ANN_CHIP_GENERATION: "v5e"})
+    spec2 = p.parse(pod2)
+    assert spec2.resources.requests.duty_percent == pytest.approx(50.0)
+
+
+def test_parse_errors():
+    p = make_parser()
+    with pytest.raises(ParseError):
+        p.parse(pod_with({constants.ANN_QOS: "platinum",
+                          constants.ANN_TFLOPS_REQUEST: "1"}))
+    with pytest.raises(ParseError):
+        p.parse(pod_with({constants.ANN_ISOLATION: "bulletproof",
+                          constants.ANN_TFLOPS_REQUEST: "1"}))
+    with pytest.raises(ParseError):
+        p.parse(pod_with({constants.ANN_CHIP_COUNT: "500",
+                          constants.ANN_TFLOPS_REQUEST: "1"}))
+    with pytest.raises(ParseError):  # no resources at all
+        p.parse(pod_with({constants.ANN_QOS: "high"}))
+
+
+def test_parse_profile_reference_with_overrides():
+    store = ObjectStore()
+    profile = WorkloadProfile.new("base", namespace="default")
+    profile.spec.pool = "pool-b"
+    profile.spec.resources.requests = ResourceAmount(tflops=10.0,
+                                                     hbm_bytes=2**30)
+    profile.spec.qos = "low"
+    store.create(profile)
+    p = make_parser(store)
+    pod = pod_with({constants.ANN_WORKLOAD_PROFILE: "base",
+                    constants.ANN_QOS: "critical"})  # override
+    spec = p.parse(pod)
+    assert spec.pool == "pool-b"
+    assert spec.resources.requests.tflops == 10.0
+    assert spec.qos == "critical"
+
+    with pytest.raises(ParseError):
+        p.parse(pod_with({constants.ANN_WORKLOAD_PROFILE: "missing"}))
+
+
+def test_mutator_stamps_contract_and_workload():
+    store = ObjectStore()
+    p = make_parser(store)
+    m = PodMutator(store, p, operator_url="http://op:8080")
+    pod = pod_with({constants.ANN_TFLOPS_REQUEST: "30",
+                    constants.ANN_HBM_REQUEST: "1Gi"})
+    out = m.handle(pod)
+    ann = out.metadata.annotations
+    assert out.spec.scheduler_name == constants.SCHEDULER_NAME
+    assert out.spec.priority == 100       # medium QoS
+    assert ann[constants.ANN_WORKLOAD] == "p1"
+    from tensorfusion_tpu.api.types import TPUWorkload
+    wl = store.get(TPUWorkload, "p1", "default")
+    assert wl.spec.resources.requests.tflops == 30.0
+    env = out.spec.containers[0].env
+    assert env[constants.ENV_VTPU_ENABLED] == "1"
+    assert env[constants.ENV_OPERATOR_URL] == "http://op:8080"
+
+
+def test_mutator_ignores_non_tpu_pods():
+    store = ObjectStore()
+    m = PodMutator(store, make_parser(store))
+    pod = pod_with({})
+    out = m.handle(pod)
+    assert out.spec.scheduler_name == "default"
+    from tensorfusion_tpu.api.types import TPUWorkload
+    assert not store.list(TPUWorkload)
