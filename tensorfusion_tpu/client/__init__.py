@@ -1,0 +1,3 @@
+"""vTPU client runtime: program-launch metering for JAX workloads."""
+
+from .runtime import VTPUClient, activate, current_client, meter

@@ -1,0 +1,279 @@
+"""vTPU client runtime: the TPU-native analog of the LD_PRELOAD CUDA hook.
+
+The reference meters clients by interposing on CUDA calls
+(closed-source ``libcuda_limiter.so`` behind ``provider/limiter.h``: each
+kernel launch calls CheckAndRecordComputeOps, each cudaMalloc calls
+CheckAndRecordMemoryOps).  A TPU client runs XLA *programs* — large fused
+executables launched a few times per training step — so the idiomatic
+interception point is the **program launch**, and the right cost unit is
+the program's compiled FLOP estimate:
+
+- at first call per (function, shapes), the runtime lowers/compiles the
+  function and reads XLA's ``cost_analysis`` (flops + bytes accessed);
+- every launch then charges that many MFLOP tokens against the worker's
+  shm bucket via ``libtpf_limiter.so`` (tfl_charge_compute); when the
+  bucket is dry the launch sleeps the limiter's wait hint and retries —
+  which is exactly how the ERL controller shapes this tenant's MXU duty;
+- compiled output/temp HBM is charged once per executable
+  (tfl_charge_hbm) and released when the metered function is dropped;
+- a frozen worker (auto-freeze or live migration) blocks at the next
+  launch until thawed.
+
+Activation: explicitly (``client.meter(fn)`` / ``VTPUClient.wrap``) or
+globally (``activate()`` patches ``jax.jit`` so every subsequently jitted
+function is metered — the moral equivalent of LD_PRELOAD for JAX).
+Bootstrap mirrors the reference client flow (legacy.go): read
+``TPF_SHM_PATH`` directly or ask the node hypervisor's ``/limiter``
+endpoint, then register our PID via ``/process``.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import logging
+import os
+import threading
+import time
+import urllib.request
+import weakref
+from typing import Any, Callable, Dict, Optional
+
+from .. import constants
+from ..hypervisor.limiter_binding import Limiter
+
+log = logging.getLogger("tpf.client")
+
+_current: Optional["VTPUClient"] = None
+_jit_patched = False
+_orig_jit = None
+
+
+def current_client() -> Optional["VTPUClient"]:
+    return _current
+
+
+class VTPUClient:
+    def __init__(self, limiter_lib: Optional[str] = None,
+                 shm_path: Optional[str] = None,
+                 hypervisor_url: Optional[str] = None,
+                 device_index: int = 0,
+                 register_pid: bool = True):
+        self.limiter_lib = limiter_lib or os.environ.get(
+            constants.ENV_LIMITER_LIB, "native/build/libtpf_limiter.so")
+        self.shm_path = shm_path or os.environ.get(constants.ENV_SHM_PATH)
+        self.hypervisor_url = hypervisor_url or os.environ.get(
+            constants.ENV_HYPERVISOR_URL)
+        self.device_index = device_index
+        self.limiter: Optional[Limiter] = None
+        self.attached = False
+        self._lock = threading.Lock()
+        # telemetry
+        self.launches = 0
+        self.blocked_time_s = 0.0
+        self.charged_mflops = 0
+        self._bootstrap(register_pid)
+
+    # -- bootstrap (legacy client endpoints analog) ------------------------
+
+    def _bootstrap(self, register_pid: bool) -> None:
+        if not self.shm_path and self.hypervisor_url:
+            ns = os.environ.get(constants.ENV_POD_NAMESPACE, "default")
+            pod = os.environ.get(constants.ENV_POD_NAME, "")
+            try:
+                with urllib.request.urlopen(
+                        f"{self.hypervisor_url}/limiter?namespace={ns}"
+                        f"&pod={pod}", timeout=5) as r:
+                    info = json.loads(r.read())
+                self.shm_path = info.get("shm_path") or None
+                if register_pid:
+                    req = urllib.request.Request(
+                        f"{self.hypervisor_url}/process", method="POST",
+                        data=json.dumps({"namespace": ns, "pod": pod,
+                                         "pid": os.getpid()}).encode())
+                    urllib.request.urlopen(req, timeout=5)
+            except Exception:
+                log.warning("hypervisor bootstrap failed; running unmetered",
+                            exc_info=True)
+        if not self.shm_path:
+            log.info("no shm segment configured; vTPU metering disabled")
+            return
+        try:
+            self.limiter = Limiter(self.limiter_lib)
+            self.limiter.attach(self.shm_path)
+            if register_pid:
+                self.limiter.self_register_pid()
+            self.attached = True
+            log.info("vTPU metering active (segment %s)", self.shm_path)
+        except Exception:
+            log.exception("limiter attach failed; running unmetered")
+            self.limiter = None
+
+    def close(self) -> None:
+        if self.limiter is not None and self.attached:
+            try:
+                self.limiter.detach()
+            except Exception:
+                pass
+            self.attached = False
+
+    # -- charging ----------------------------------------------------------
+
+    def charge_launch(self, mflops: int) -> None:
+        """Charge one program launch; blocks (sleeping the limiter's wait
+        hints) until admitted.  No-op when unmetered."""
+        if not self.attached or mflops <= 0:
+            return
+        while True:
+            r = self.limiter.charge_compute(self.device_index, mflops)
+            if r.allowed:
+                self.launches += 1
+                self.charged_mflops += mflops
+                return
+            wait = max(r.wait_hint_us, 100) / 1e6
+            self.blocked_time_s += wait
+            time.sleep(wait)
+
+    def charge_hbm(self, delta_bytes: int) -> bool:
+        if not self.attached or delta_bytes == 0:
+            return True
+        r = self.limiter.charge_hbm(self.device_index, delta_bytes)
+        if not r.allowed:
+            log.warning("HBM budget denied: wanted %+d, available %d",
+                        delta_bytes, r.available)
+        return r.allowed
+
+    def frozen(self) -> bool:
+        return bool(self.attached and self.limiter.worker_frozen())
+
+    # -- metering wrapper ----------------------------------------------------
+
+    def meter(self, fn: Callable, static_argnums=(),
+              jit_kwargs: Optional[dict] = None) -> Callable:
+        """Wrap ``fn`` so each launch of its jitted executable is charged.
+
+        Cost is estimated once per argument-shape signature from XLA's
+        compiled cost analysis and cached.
+        """
+        import jax
+
+        jitted = jax.jit(fn, static_argnums=static_argnums,
+                         **(jit_kwargs or {}))
+        costs: Dict[Any, int] = {}
+        hbm_charged: Dict[Any, int] = {}
+        client = self
+
+        def signature(args, kwargs):
+            import numpy as np
+
+            def leaf_sig(x):
+                if hasattr(x, "shape") and hasattr(x, "dtype"):
+                    return (tuple(x.shape), str(x.dtype))
+                return ("py", repr(x)[:32])
+
+            leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+            return (tuple(leaf_sig(l) for l in leaves), treedef)
+
+        def estimate(sig, args, kwargs) -> int:
+            try:
+                lowered = jitted.lower(*args, **kwargs)
+                compiled = lowered.compile()
+                analysis = compiled.cost_analysis()
+                if isinstance(analysis, (list, tuple)):
+                    analysis = analysis[0] if analysis else {}
+                flops = float((analysis or {}).get("flops", 0.0))
+                mflops = max(int(flops / 1e6), 1)
+                # one-time HBM charge for this executable's footprint
+                try:
+                    mem = compiled.memory_analysis()
+                    hbm = int(getattr(mem, "output_size_in_bytes", 0)
+                              + getattr(mem, "temp_size_in_bytes", 0))
+                except Exception:
+                    hbm = 0
+                if hbm > 0 and sig not in hbm_charged:
+                    client.charge_hbm(hbm)
+                    hbm_charged[sig] = hbm
+                return mflops
+            except Exception:
+                log.debug("cost analysis failed; flat-rate charge",
+                          exc_info=True)
+                return 1
+
+        @functools.wraps(fn)
+        def metered(*args, **kwargs):
+            sig = signature(args, kwargs)
+            mflops = costs.get(sig)
+            if mflops is None:
+                mflops = estimate(sig, args, kwargs)
+                costs[sig] = mflops
+            client.charge_launch(mflops)
+            return jitted(*args, **kwargs)
+
+        metered._tpf_metered = True  # noqa: SLF001
+        metered._tpf_jitted = jitted
+
+        def _release(_):
+            total = sum(hbm_charged.values())
+            if total:
+                try:
+                    client.charge_hbm(-total)
+                except Exception:
+                    pass
+
+        weakref.finalize(metered, _release, None)
+        return metered
+
+
+def meter(fn: Callable, **kwargs) -> Callable:
+    """Meter ``fn`` with the process-global client (creating it from env
+    on first use)."""
+    global _current
+    if _current is None:
+        _current = VTPUClient()
+    return _current.meter(fn, **kwargs)
+
+
+def activate(client: Optional[VTPUClient] = None) -> Optional[VTPUClient]:
+    """Globally activate metering: patch ``jax.jit`` so every function
+    jitted afterwards is metered.  Controlled by TPF_VTPU=1 for implicit
+    activation in workers."""
+    global _current, _jit_patched, _orig_jit
+    import jax
+
+    if client is not None:
+        _current = client
+    elif _current is None:
+        _current = VTPUClient()
+    if not _current.attached:
+        return _current
+    if not _jit_patched:
+        _orig_jit = jax.jit
+
+        def patched_jit(fn=None, **jit_kwargs):
+            if fn is None:
+                return lambda f: patched_jit(f, **jit_kwargs)
+            static = jit_kwargs.pop("static_argnums", ())
+            return _current.meter(fn, static_argnums=static,
+                                  jit_kwargs=jit_kwargs)
+
+        jax.jit = patched_jit
+        _jit_patched = True
+        log.info("jax.jit patched for vTPU metering")
+    return _current
+
+
+def deactivate() -> None:
+    global _jit_patched
+    import jax
+
+    if _jit_patched and _orig_jit is not None:
+        jax.jit = _orig_jit
+        _jit_patched = False
+
+
+if os.environ.get(constants.ENV_VTPU_ENABLED) == "1" and \
+        os.environ.get(constants.ENV_SHM_PATH):
+    try:
+        activate()
+    except Exception:  # pragma: no cover - best effort auto-activation
+        log.exception("vTPU auto-activation failed")

@@ -49,8 +49,8 @@ class ClusterController(Controller):
             return
         cluster: TPUCluster = event.obj
         ready = 0
-        for pool_spec in cluster.spec.pools:
-            name = pool_spec.name or f"{cluster.name}-pool"
+        for i, pool_spec in enumerate(cluster.spec.pools):
+            name = pool_spec.name or f"{cluster.name}-pool-{i}"
             pool = self.store.try_get(TPUPool, name)
             if pool is None:
                 pool = TPUPool.new(name)
@@ -88,7 +88,14 @@ class PoolController(Controller):
         for pool in pools:
             self.allocator.set_pool_oversell(
                 pool.name, pool.spec.capacity_config.tflops_oversell_percent)
-            self.allocator.set_pool_strategy(pool.name, "CompactFirst")
+            placement = "CompactFirst"
+            if pool.spec.scheduling_config_template:
+                from ..api.types import SchedulingConfigTemplate
+                tmpl = self.store.try_get(SchedulingConfigTemplate,
+                                          pool.spec.scheduling_config_template)
+                if tmpl is not None:
+                    placement = tmpl.spec.placement_mode
+            self.allocator.set_pool_strategy(pool.name, placement)
             members = by_pool.get(pool.name, [])
             cap = pool.status.capacity
             cap.total.tflops = sum(c.status.capacity.tflops for c in members)
@@ -234,9 +241,11 @@ class WorkloadController(Controller):
                 continue  # client pod runs on the TPU node itself
             pods = self.store.list(
                 Pod, namespace=wl.metadata.namespace,
-                selector=lambda p: p.metadata.labels.get(
-                    constants.LABEL_WORKER_NAME, "").startswith(
-                        wl.metadata.name + "-worker"))
+                selector=lambda p: (
+                    p.metadata.annotations.get(constants.ANN_WORKLOAD)
+                    == wl.metadata.name
+                    and p.metadata.labels.get(constants.LABEL_COMPONENT)
+                    == constants.COMPONENT_WORKER))
             desired = max(wl.spec.replicas, 0)
             # scale up
             existing = {p.metadata.name for p in pods}
@@ -432,9 +441,13 @@ class NodeClaimController(Controller):
     name = "nodeclaim"
     kinds = ("TPUNodeClaim",)
 
-    def __init__(self, store: ObjectStore, provider=None):
+    def __init__(self, store: ObjectStore, provider=None,
+                 on_provisioned=None):
         self.store = store
         self.provider = provider  # cloudprovider instance (mock by default)
+        #: called with (pool, generation) when a claim reaches Running, so
+        #: the node expander can clear its in-flight dedup entry
+        self.on_provisioned = on_provisioned or (lambda pool, gen: None)
 
     def reconcile(self, event):
         if event is None or event.type == DELETED:
@@ -456,3 +469,4 @@ class NodeClaimController(Controller):
         claim.status.node_name = node_name
         claim.status.instance_id = instance_id
         self.store.update(claim)
+        self.on_provisioned(claim.spec.pool, claim.spec.generation)

@@ -1,0 +1,4 @@
+"""Reference hosted workloads (flagship: Llama-style decoder)."""
+
+from .llama import (LlamaConfig, forward, init_params, loss_fn,
+                    make_train_step, param_specs)

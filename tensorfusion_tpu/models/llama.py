@@ -1,0 +1,238 @@
+"""Llama-style decoder-only transformer — the flagship hosted workload.
+
+Pure-JAX (param pytrees + functional transforms), designed for the
+platform's benchmark configs (BASELINE config #4: gang-scheduled JAX Llama
+FSDP over a v5e-8 slice):
+
+- bf16 matmuls sized for the MXU; RMSNorm/RoPE/SwiGLU fused by XLA;
+- grouped-query attention with either plain causal attention or ring
+  attention (sequence parallelism over the ICI ring) selected by config;
+- shardings declared as PartitionSpecs (``param_specs``) over the
+  dp/fsdp/sp/tp mesh of parallel/mesh.py: FSDP shards every weight's
+  first (largest) dim, TP shards attention heads and FFN hidden;
+- ``make_train_step`` builds a jittable AdamW step with optional
+  rematerialization (jax.checkpoint) per layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.ring_attention import ring_attention_sharded
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "full"          # "full" | "ring"
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig(vocab_size=128256, dim=4096, n_layers=32,
+                           n_heads=32, n_kv_heads=8, ffn_dim=14336,
+                           rope_theta=500000.0)
+
+    @staticmethod
+    def tiny(attn_impl: str = "full") -> "LlamaConfig":
+        return LlamaConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                           n_kv_heads=2, ffn_dim=128, max_seq_len=128,
+                           dtype=jnp.float32, attn_impl=attn_impl)
+
+
+# -- parameters -------------------------------------------------------------
+
+
+def init_params(config: LlamaConfig, key: jax.Array) -> Dict:
+    def dense(key, shape, scale=None):
+        scale = scale or (shape[0] ** -0.5)
+        return (jax.random.normal(key, shape, jnp.float32)
+                * scale).astype(config.dtype)
+
+    keys = jax.random.split(key, config.n_layers + 3)
+    hd = config.head_dim
+    layers = []
+    for i in range(config.n_layers):
+        k = jax.random.split(keys[i], 7)
+        layers.append({
+            "attn": {
+                "wq": dense(k[0], (config.dim, config.n_heads * hd)),
+                "wk": dense(k[1], (config.dim, config.n_kv_heads * hd)),
+                "wv": dense(k[2], (config.dim, config.n_kv_heads * hd)),
+                "wo": dense(k[3], (config.n_heads * hd, config.dim)),
+            },
+            "mlp": {
+                "w_gate": dense(k[4], (config.dim, config.ffn_dim)),
+                "w_up": dense(k[5], (config.dim, config.ffn_dim)),
+                "w_down": dense(k[6], (config.ffn_dim, config.dim)),
+            },
+            "attn_norm": jnp.ones((config.dim,), config.dtype),
+            "mlp_norm": jnp.ones((config.dim,), config.dtype),
+        })
+    return {
+        "tok_emb": dense(keys[-3], (config.vocab_size, config.dim), 0.02),
+        "layers": layers,
+        "final_norm": jnp.ones((config.dim,), config.dtype),
+        "lm_head": dense(keys[-2], (config.dim, config.vocab_size)),
+    }
+
+
+def param_specs(config: LlamaConfig) -> Dict:
+    """PartitionSpecs matching init_params' tree: FSDP on dim 0, TP on the
+    head/hidden dim."""
+    layer = {
+        "attn": {"wq": P("fsdp", "tp"), "wk": P("fsdp", "tp"),
+                 "wv": P("fsdp", "tp"), "wo": P("tp", "fsdp")},
+        "mlp": {"w_gate": P("fsdp", "tp"), "w_up": P("fsdp", "tp"),
+                "w_down": P("tp", "fsdp")},
+        "attn_norm": P(None),
+        "mlp_norm": P(None),
+    }
+    return {
+        "tok_emb": P("fsdp", "tp"),
+        "layers": [layer] * config.n_layers,
+        "final_norm": P(None),
+        "lm_head": P("fsdp", "tp"),
+    }
+
+
+# -- model ------------------------------------------------------------------
+
+
+def _rms_norm(x, weight, eps):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * lax.rsqrt(var + eps).astype(x.dtype)) * weight
+
+
+def _rope(x, theta):
+    """x: [B, T, H, D]; rotate pairs along D."""
+    b, t, h, d = x.shape
+    pos = jnp.arange(t, dtype=jnp.float32)
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = pos[:, None] * freqs[None, :]          # [T, D/2]
+    cos = jnp.cos(angles)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[None, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    rx1 = x1 * cos - x2 * sin
+    rx2 = x1 * sin + x2 * cos
+    return jnp.stack([rx1, rx2], axis=-1).reshape(x.shape)
+
+
+def _attention(config: LlamaConfig, p, x,
+               mesh: Optional[Mesh] = None):
+    b, t, _ = x.shape
+    hd = config.head_dim
+    q = (x @ p["wq"]).reshape(b, t, config.n_heads, hd)
+    k = (x @ p["wk"]).reshape(b, t, config.n_kv_heads, hd)
+    v = (x @ p["wv"]).reshape(b, t, config.n_kv_heads, hd)
+    q = _rope(q, config.rope_theta)
+    k = _rope(k, config.rope_theta)
+    # GQA: repeat kv heads
+    rep = config.n_heads // config.n_kv_heads
+    k = jnp.repeat(k, rep, axis=2)
+    v = jnp.repeat(v, rep, axis=2)
+    q, k, v = (z.transpose(0, 2, 1, 3) for z in (q, k, v))  # [B, H, T, D]
+
+    if config.attn_impl == "ring" and mesh is not None:
+        out = ring_attention_sharded(q, k, v, mesh)
+    else:
+        scale = hd ** -0.5
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        scores = jnp.where(mask[None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+    out = out.transpose(0, 2, 1, 3).reshape(b, t, config.n_heads * hd)
+    return out @ p["wo"]
+
+
+def _mlp(p, x):
+    return (jax.nn.silu(x @ p["w_gate"]) * (x @ p["w_up"])) @ p["w_down"]
+
+
+def _layer(config: LlamaConfig, layer, x, mesh=None):
+    x = x + _attention(config, layer["attn"],
+                       _rms_norm(x, layer["attn_norm"], config.norm_eps),
+                       mesh)
+    x = x + _mlp(layer["mlp"],
+                 _rms_norm(x, layer["mlp_norm"], config.norm_eps))
+    return x
+
+
+def forward(params: Dict, tokens: jax.Array, config: LlamaConfig,
+            mesh: Optional[Mesh] = None) -> jax.Array:
+    """tokens [B, T] int32 -> logits [B, T, vocab]."""
+    x = params["tok_emb"][tokens]
+    layer_fn = functools.partial(_layer, config, mesh=mesh)
+    if config.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+    for layer in params["layers"]:
+        x = layer_fn(layer, x)
+    x = _rms_norm(x, params["final_norm"], config.norm_eps)
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+def loss_fn(params: Dict, batch: Dict, config: LlamaConfig,
+            mesh: Optional[Mesh] = None) -> jax.Array:
+    logits = forward(params, batch["tokens"], config, mesh)
+    targets = batch["targets"]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = batch.get("mask")
+    if mask is not None:
+        return (nll * mask).sum() / jnp.maximum(mask.sum(), 1)
+    return nll.mean()
+
+
+# -- training ---------------------------------------------------------------
+
+
+def make_train_step(config: LlamaConfig, mesh: Optional[Mesh] = None,
+                    learning_rate: float = 3e-4):
+    """Returns (train_step, init_opt_state): a jittable AdamW step."""
+    import optax
+
+    tx = optax.adamw(learning_rate, b1=0.9, b2=0.95, weight_decay=0.1)
+
+    def init_opt_state(params):
+        return tx.init(params)
+
+    def train_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, config,
+                                                  mesh)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step, init_opt_state
+
+
+def shard_params(params: Dict, mesh: Mesh, config: LlamaConfig) -> Dict:
+    specs = param_specs(config)
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    spec_leaves = jax.tree_util.tree_flatten(
+        specs, is_leaf=lambda x: isinstance(x, P))[0]
+    assert len(leaves) == len(spec_leaves), "param/spec tree mismatch"
+    sharded = [jax.device_put(x, NamedSharding(mesh, s))
+               for x, s in zip(leaves, spec_leaves)]
+    return jax.tree_util.tree_unflatten(treedef, sharded)

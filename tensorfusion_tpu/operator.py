@@ -81,7 +81,8 @@ class Operator:
                 ConnectionController(self.store),
                 PodController(self.store, self.allocator, self.scheduler,
                               self.ports, self.indices, self.gang),
-                NodeClaimController(self.store, self.cloud)):
+                NodeClaimController(self.store, self.cloud,
+                                    on_provisioned=self.expander.clear_inflight)):
             self.manager.register(ctrl)
 
         self._stop = threading.Event()
@@ -105,6 +106,21 @@ class Operator:
             if restored:
                 log.info("restored %d allocations from pod annotations",
                          restored)
+            # port / index allocators rebuild from the same annotations
+            port_assignments = []
+            index_assignments = {}
+            for p in pods:
+                port = p.metadata.annotations.get(constants.ANN_PORT_NUMBER)
+                if port and p.spec.node_name:
+                    port_assignments.append(
+                        (p.spec.node_name, int(port), p.key()))
+                idx = p.metadata.annotations.get(constants.ANN_POD_INDEX)
+                if idx:
+                    index_assignments[p.key()] = int(idx)
+            if port_assignments:
+                self.ports.reconcile(port_assignments)
+            if index_assignments:
+                self.indices.reconcile(index_assignments)
         self.manager.start()
         self.scheduler.start()
         self._sync_thread = threading.Thread(target=self._sync_loop,

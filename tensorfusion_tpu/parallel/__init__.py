@@ -1,0 +1,13 @@
+"""Mesh parallelism for hosted workloads: sharding recipes + ring attention.
+
+The platform itself schedules/meters devices (SURVEY.md §2.6: the reference
+has no parallelism code — it virtualizes GPUs under frameworks that do).
+tpu-fusion additionally ships this reference workload layer so the platform
+can be exercised and benchmarked end-to-end with realistic SPMD jobs:
+DP/FSDP/TP shardings over a ``jax.sharding.Mesh`` and ring attention for
+sequence/context parallelism over the ICI torus.
+"""
+
+from .mesh import (batch_spec, logical_mesh, make_mesh, mesh_shape_for,
+                   named_sharding)
+from .ring_attention import ring_attention, ring_attention_sharded

@@ -1,0 +1,67 @@
+"""Device-mesh construction + sharding helpers.
+
+Axes convention for hosted workloads:
+
+- ``dp``   — pure data parallelism (gradient all-reduce over DCN/ICI);
+- ``fsdp`` — fully-sharded data parallelism (params sharded, all-gathered
+  per layer; rides ICI);
+- ``tp``   — tensor parallelism (attention heads / FFN hidden sharded;
+  wants the innermost, fastest ICI axis);
+- ``sp``   — sequence/context parallelism (ring attention neighbors; wants
+  a wraparound ICI ring).
+
+``make_mesh`` lays axes out so the innermost axis maps to physically
+adjacent devices — on real TPU slices jax's device order already follows
+the ICI mesh, so reshaping in order preserves locality.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_ORDER = ("dp", "fsdp", "sp", "tp")
+
+
+def mesh_shape_for(n_devices: int,
+                   want: Optional[Dict[str, int]] = None) -> Dict[str, int]:
+    """Choose a mesh shape: honor explicit axis sizes, spread the rest
+    over fsdp."""
+    shape = {a: 1 for a in AXIS_ORDER}
+    if want:
+        for a, s in want.items():
+            if a not in shape:
+                raise ValueError(f"unknown mesh axis {a!r}")
+            shape[a] = s
+    used = math.prod(shape.values())
+    if n_devices % used != 0:
+        raise ValueError(f"{n_devices} devices not divisible by "
+                         f"requested axes {want}")
+    shape["fsdp"] *= n_devices // used
+    return shape
+
+
+def make_mesh(axes: Optional[Dict[str, int]] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    shape = mesh_shape_for(len(devices), axes)
+    dims = [shape[a] for a in AXIS_ORDER]
+    arr = np.array(devices).reshape(dims)
+    return Mesh(arr, AXIS_ORDER)
+
+
+def logical_mesh(mesh: Mesh) -> Dict[str, int]:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def batch_spec() -> P:
+    """Batch dims shard over both data axes."""
+    return P(("dp", "fsdp"))

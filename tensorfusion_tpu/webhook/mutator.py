@@ -120,6 +120,10 @@ class PodMutator:
         existing = self.store.try_get(TPUWorkload, name,
                                       pod.metadata.namespace)
         if existing is not None:
+            # admission must not clobber replica management: keep the
+            # workload's scaling fields, refresh the resource profile
+            spec.replicas = existing.spec.replicas
+            spec.dynamic_replicas = existing.spec.dynamic_replicas
             existing.spec = spec
             return self.store.update(existing)
         wl = TPUWorkload.new(name, namespace=pod.metadata.namespace)

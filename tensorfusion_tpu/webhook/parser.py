@@ -133,8 +133,8 @@ class WorkloadParser:
                                 or 0),
                 timeout_seconds=float(ann.get(constants.ANN_GANG_TIMEOUT, 0)
                                       or 0),
-                strict=_truthy(ann.get(constants.ANN_GANG_MIN_MEMBERS, "")
-                               and "true"))
+                # min-members present => strict all-or-nothing gang
+                strict=bool(ann.get(constants.ANN_GANG_MIN_MEMBERS)))
 
         # 3. defaults + normalization
         if not spec.qos:

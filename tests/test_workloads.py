@@ -1,0 +1,126 @@
+"""Workload-layer tests on the virtual 8-device CPU mesh: llama forward/
+train step under DP/FSDP/TP shardings, and ring attention numerics vs
+full attention."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tensorfusion_tpu.models import (LlamaConfig, forward, init_params,
+                                     loss_fn, make_train_step, param_specs)
+from tensorfusion_tpu.models.llama import shard_params
+from tensorfusion_tpu.parallel import make_mesh, ring_attention_sharded
+
+
+def test_mesh_construction():
+    mesh = make_mesh({"tp": 2, "dp": 2})
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+        "dp": 2, "fsdp": 2, "sp": 1, "tp": 2}
+    with pytest.raises(ValueError):
+        make_mesh({"tp": 3})
+    with pytest.raises(ValueError):
+        make_mesh({"bogus": 2})
+
+
+def test_llama_forward_shapes_and_loss():
+    config = LlamaConfig.tiny()
+    params = init_params(config, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                config.vocab_size)
+    logits = forward(params, tokens, config)
+    assert logits.shape == (2, 16, config.vocab_size)
+    assert logits.dtype == jnp.float32
+    batch = {"tokens": tokens, "targets": tokens}
+    loss = loss_fn(params, batch, config)
+    assert np.isfinite(float(loss))
+    assert float(loss) > 3.0  # ~uniform at init: ln(256) ~ 5.5
+
+
+def test_llama_train_step_learns():
+    config = LlamaConfig.tiny()
+    params = init_params(config, jax.random.PRNGKey(0))
+    step, init_opt = make_train_step(config, learning_rate=1e-2)
+    step = jax.jit(step)
+    opt_state = init_opt(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0,
+                                config.vocab_size)
+    batch = {"tokens": tokens, "targets": tokens}
+    losses = []
+    for _ in range(10):
+        params, opt_state, loss = step(params, opt_state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] - 0.5, f"no learning: {losses}"
+
+
+def test_llama_sharded_train_step_dp_fsdp_tp():
+    mesh = make_mesh({"dp": 2, "fsdp": 2, "tp": 2})
+    config = LlamaConfig.tiny()
+    params = init_params(config, jax.random.PRNGKey(0))
+    sharded = shard_params(params, mesh, config)
+    # spot-check a sharding landed
+    wq = sharded["layers"][0]["attn"]["wq"]
+    assert wq.sharding.spec == P("fsdp", "tp")
+
+    step, init_opt = make_train_step(config)
+    step = jax.jit(step)
+    opt_state = init_opt(sharded)
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0,
+                           config.vocab_size),
+        NamedSharding(mesh, P(("dp", "fsdp"))))
+    batch = {"tokens": tokens, "targets": tokens}
+    with mesh:
+        params2, _, loss = step(sharded, opt_state, batch)
+    assert np.isfinite(float(loss))
+    # params keep their shardings through the step
+    assert params2["layers"][0]["attn"]["wq"].sharding.spec == \
+        P("fsdp", "tp")
+
+
+def test_ring_attention_matches_full():
+    mesh = make_mesh({"sp": 4})
+    b, h, t, d = 2, 4, 64, 16
+    key = jax.random.PRNGKey(0)
+    q, k, v = (jax.random.normal(kk, (b, h, t, d), jnp.float32)
+               for kk in jax.random.split(key, 3))
+
+    # reference full causal attention
+    scale = d ** -0.5
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    mask = jnp.tril(jnp.ones((t, t), bool))
+    scores = jnp.where(mask[None, None], scores, -1e30)
+    ref = jnp.einsum("bhqk,bhkd->bhqd",
+                     jax.nn.softmax(scores, axis=-1), v)
+
+    out = ring_attention_sharded(q, k, v, mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_non_causal():
+    mesh = make_mesh({"sp": 8})
+    b, h, t, d = 1, 2, 64, 8
+    key = jax.random.PRNGKey(7)
+    q, k, v = (jax.random.normal(kk, (b, h, t, d), jnp.float32)
+               for kk in jax.random.split(key, 3))
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * d ** -0.5
+    ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, -1), v)
+    out = ring_attention_sharded(q, k, v, mesh, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_llama_with_ring_attention_matches_full():
+    mesh = make_mesh({"sp": 4})
+    config_full = LlamaConfig.tiny(attn_impl="full")
+    config_ring = LlamaConfig.tiny(attn_impl="ring")
+    params = init_params(config_full, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0,
+                                config_full.vocab_size)
+    ref = forward(params, tokens, config_full)
+    with mesh:
+        out = forward(params, tokens, config_ring, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=5e-4, atol=5e-4)
