@@ -1,0 +1,139 @@
+"""Alert evaluator.
+
+Analog of the reference's ``internal/alert/`` AlertEvaluator (rules from a
+ConfigMap evaluated against GreptimeDB, firing to Alertmanager,
+``cmd/main.go:151-161``): declarative threshold rules over TSDB
+aggregations with firing/resolved state tracking and webhook delivery.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from ..metrics.tsdb import TSDB
+
+log = logging.getLogger("tpf.alert")
+
+
+@dataclass
+class AlertRule:
+    name: str
+    measurement: str
+    metric_field: str
+    agg: str = "mean"                 # mean|max|min|sum|count|pNN|last
+    op: str = ">"                     # > | >= | < | <= | ==
+    threshold: float = 0.0
+    window_s: float = 300.0
+    tags: Dict[str, str] = field(default_factory=dict)
+    severity: str = "warning"
+    for_s: float = 0.0                # must hold this long before firing
+    summary: str = ""
+
+
+@dataclass
+class Alert:
+    rule: str
+    severity: str
+    value: float
+    threshold: float
+    state: str = "firing"             # firing | resolved
+    since: float = 0.0
+    summary: str = ""
+
+
+_OPS: Dict[str, Callable[[float, float], bool]] = {
+    ">": lambda a, b: a > b, ">=": lambda a, b: a >= b,
+    "<": lambda a, b: a < b, "<=": lambda a, b: a <= b,
+    "==": lambda a, b: a == b,
+}
+
+
+class AlertEvaluator:
+    def __init__(self, tsdb: TSDB, rules: Optional[List[AlertRule]] = None,
+                 webhook_url: str = "", interval_s: float = 15.0):
+        self.tsdb = tsdb
+        self.rules = rules or []
+        self.webhook_url = webhook_url
+        self.interval_s = interval_s
+        self._pending_since: Dict[str, float] = {}
+        self.active: Dict[str, Alert] = {}
+        self.history: List[Alert] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def set_rules(self, rules: List[AlertRule]) -> None:
+        self.rules = rules
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="tpf-alerts", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.evaluate_once()
+            except Exception:
+                log.exception("alert evaluation failed")
+
+    # ------------------------------------------------------------------
+
+    def evaluate_once(self, now: Optional[float] = None) -> List[Alert]:
+        now = now if now is not None else time.time()
+        changed: List[Alert] = []
+        for rule in self.rules:
+            value = self.tsdb.aggregate(rule.measurement, rule.metric_field,
+                                        agg=rule.agg, tags=rule.tags or None,
+                                        window_s=rule.window_s)
+            breached = value is not None and \
+                _OPS.get(rule.op, _OPS[">"])(value, rule.threshold)
+            if breached:
+                since = self._pending_since.setdefault(rule.name, now)
+                if now - since >= rule.for_s and rule.name not in self.active:
+                    alert = Alert(rule=rule.name, severity=rule.severity,
+                                  value=value, threshold=rule.threshold,
+                                  state="firing", since=since,
+                                  summary=rule.summary or rule.name)
+                    self.active[rule.name] = alert
+                    self.history.append(alert)
+                    changed.append(alert)
+                    log.warning("ALERT firing: %s (%.3f %s %.3f)",
+                                rule.name, value, rule.op, rule.threshold)
+            else:
+                self._pending_since.pop(rule.name, None)
+                if rule.name in self.active:
+                    alert = self.active.pop(rule.name)
+                    resolved = Alert(rule=alert.rule, severity=alert.severity,
+                                     value=value if value is not None
+                                     else alert.value,
+                                     threshold=alert.threshold,
+                                     state="resolved", since=alert.since,
+                                     summary=alert.summary)
+                    self.history.append(resolved)
+                    changed.append(resolved)
+                    log.info("alert resolved: %s", rule.name)
+        if changed and self.webhook_url:
+            self._post(changed)
+        return changed
+
+    def _post(self, alerts: List[Alert]) -> None:
+        body = json.dumps([alert.__dict__ for alert in alerts]).encode()
+        try:
+            req = urllib.request.Request(
+                self.webhook_url, data=body, method="POST",
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=5)
+        except Exception as e:  # noqa: BLE001
+            log.warning("alert webhook delivery failed: %s", e)

@@ -1,0 +1,6 @@
+"""In-process VPA autoscaler for vTPU resources."""
+
+from .autoscaler import AutoScaler
+from .recommender import (CronRecommender, DecayingHistogram,
+                          ExternalRecommender, PercentileRecommender,
+                          Recommendation, cron_matches)

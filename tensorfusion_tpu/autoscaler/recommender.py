@@ -1,0 +1,213 @@
+"""Autoscaler recommenders.
+
+Analogs of the reference's ``internal/autoscaler/recommender/``:
+
+- :class:`PercentileRecommender` — the VPA-style default
+  (``percentile_recommender.go``, 505 LoC): per-workload exponentially
+  decaying histograms of observed usage; the recommendation is a chosen
+  percentile plus a safety margin.
+- :class:`CronRecommender` — fixed resources inside scheduled windows
+  ("m h dom mon dow" 5-field specs with */lists/ranges).
+- :class:`ExternalRecommender` — POST the workload context to a user
+  webhook and trust its reply (``schedulingconfigtemplate_types.go:190-219``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import time
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..api.resources import ResourceAmount
+
+log = logging.getLogger("tpf.autoscaler.recommender")
+
+
+@dataclass
+class Recommendation:
+    target: ResourceAmount
+    reason: str = ""
+
+
+class DecayingHistogram:
+    """Exponential-decay histogram with geometric buckets (the shape of
+    the reference's percentile estimator): weights halve every
+    ``half_life_s``; buckets grow by ``growth`` from ``first_bucket``."""
+
+    def __init__(self, first_bucket: float = 0.01, growth: float = 1.05,
+                 n_buckets: int = 400, half_life_s: float = 1800.0):
+        self.first = first_bucket
+        self.growth = growth
+        self.weights = [0.0] * n_buckets
+        self.half_life_s = half_life_s
+        self._ref_ts = time.time()
+        self.total = 0.0
+
+    def _bucket(self, value: float) -> int:
+        if value <= self.first:
+            return 0
+        idx = int(math.log(value / self.first) / math.log(self.growth)) + 1
+        return min(idx, len(self.weights) - 1)
+
+    def _bucket_value(self, idx: int) -> float:
+        return self.first * (self.growth ** idx)
+
+    def add(self, value: float, ts: Optional[float] = None,
+            weight: float = 1.0) -> None:
+        ts = ts if ts is not None else time.time()
+        # decay is implemented by up-weighting newer samples relative to
+        # the reference timestamp (equivalent, numerically stabler)
+        w = weight * (2.0 ** ((ts - self._ref_ts) / self.half_life_s))
+        if w > 1e12:  # renormalize to keep weights bounded
+            scale = 1.0 / w
+            self.weights = [x * scale for x in self.weights]
+            self.total *= scale
+            self._ref_ts = ts
+            w = weight
+        self.weights[self._bucket(value)] += w
+        self.total += w
+
+    def percentile(self, q: float) -> float:
+        if self.total <= 0:
+            return 0.0
+        target = q / 100.0 * self.total
+        run = 0.0
+        for i, w in enumerate(self.weights):
+            run += w
+            if run >= target:
+                return self._bucket_value(i)
+        return self._bucket_value(len(self.weights) - 1)
+
+    def empty(self) -> bool:
+        return self.total <= 0
+
+
+class PercentileRecommender:
+    name = "percentile"
+
+    def __init__(self, percentile: float = 90.0,
+                 margin_fraction: float = 0.15,
+                 half_life_s: float = 1800.0):
+        self.percentile = percentile
+        self.margin = margin_fraction
+        self.half_life_s = half_life_s
+        self._hists: Dict[str, Dict[str, DecayingHistogram]] = {}
+
+    def observe(self, workload_key: str, tflops: float,
+                hbm_bytes: float, ts: Optional[float] = None) -> None:
+        hists = self._hists.setdefault(workload_key, {
+            "tflops": DecayingHistogram(first_bucket=0.1,
+                                        half_life_s=self.half_life_s),
+            "hbm": DecayingHistogram(first_bucket=1e6,
+                                     half_life_s=self.half_life_s),
+        })
+        if tflops > 0:
+            hists["tflops"].add(tflops, ts)
+        if hbm_bytes > 0:
+            hists["hbm"].add(hbm_bytes, ts)
+
+    def recommend(self, workload_key: str, current: ResourceAmount,
+                  spec=None) -> Optional[Recommendation]:
+        hists = self._hists.get(workload_key)
+        if not hists or hists["tflops"].empty():
+            return None
+        pct = spec.percentile if spec is not None and spec.percentile \
+            else self.percentile
+        margin = spec.margin_fraction if spec is not None else self.margin
+        t = hists["tflops"].percentile(pct) * (1 + margin)
+        h = hists["hbm"].percentile(pct) * (1 + margin)
+        return Recommendation(
+            target=ResourceAmount(tflops=t, hbm_bytes=max(h,
+                                                          current.hbm_bytes
+                                                          and 0.0)),
+            reason=f"p{pct:.0f} x (1+{margin:.2f})")
+
+
+@dataclass
+class CronRule:
+    schedule: str          # "m h dom mon dow" (supports * , - /)
+    tflops: float = 0.0
+    hbm_bytes: float = 0.0
+    duration_s: float = 3600.0
+
+
+def _cron_field_matches(expr: str, value: int, lo: int, hi: int) -> bool:
+    for part in expr.split(","):
+        part = part.strip()
+        step = 1
+        if "/" in part:
+            part, step_s = part.split("/", 1)
+            step = int(step_s)
+        if part in ("*", ""):
+            lo_v, hi_v = lo, hi
+        elif "-" in part:
+            a, b = part.split("-", 1)
+            lo_v, hi_v = int(a), int(b)
+        else:
+            lo_v = hi_v = int(part)
+        if lo_v <= value <= hi_v and (value - lo_v) % step == 0:
+            return True
+    return False
+
+
+def cron_matches(schedule: str, when: Optional[float] = None) -> bool:
+    t = time.localtime(when if when is not None else time.time())
+    parts = schedule.split()
+    if len(parts) != 5:
+        raise ValueError(f"bad cron spec {schedule!r}")
+    checks = [(parts[0], t.tm_min, 0, 59), (parts[1], t.tm_hour, 0, 23),
+              (parts[2], t.tm_mday, 1, 31), (parts[3], t.tm_mon, 1, 12),
+              (parts[4], t.tm_wday == 6 and 0 or t.tm_wday + 1, 0, 7)]
+    return all(_cron_field_matches(e, v, lo, hi) for e, v, lo, hi in checks)
+
+
+class CronRecommender:
+    name = "cron"
+
+    def recommend_from_rules(self, rules: List[Dict],
+                             when: Optional[float] = None
+                             ) -> Optional[Recommendation]:
+        for rule in rules:
+            schedule = rule.get("schedule", "")
+            if schedule and cron_matches(schedule, when):
+                return Recommendation(
+                    target=ResourceAmount(
+                        tflops=float(rule.get("tflops", 0)),
+                        hbm_bytes=float(rule.get("hbm_bytes", 0))),
+                    reason=f"cron window {schedule!r}")
+        return None
+
+
+class ExternalRecommender:
+    name = "external"
+
+    def __init__(self, timeout_s: float = 5.0):
+        self.timeout_s = timeout_s
+
+    def recommend(self, url: str, workload_key: str,
+                  current: ResourceAmount) -> Optional[Recommendation]:
+        payload = json.dumps({
+            "workload": workload_key,
+            "current": {"tflops": current.tflops,
+                        "hbm_bytes": current.hbm_bytes},
+        }).encode()
+        try:
+            req = urllib.request.Request(
+                url, data=payload, method="POST",
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                body = json.loads(r.read())
+        except Exception as e:  # noqa: BLE001
+            log.warning("external recommender %s failed: %s", url, e)
+            return None
+        if "tflops" not in body and "hbm_bytes" not in body:
+            return None
+        return Recommendation(
+            target=ResourceAmount(
+                tflops=float(body.get("tflops", current.tflops)),
+                hbm_bytes=float(body.get("hbm_bytes", current.hbm_bytes))),
+            reason=f"external {url}")

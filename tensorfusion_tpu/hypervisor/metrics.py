@@ -31,6 +31,7 @@ class HypervisorMetricsRecorder:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
 
     def start(self) -> None:
+        self._stop.clear()
         self._thread = threading.Thread(target=self._loop,
                                         name="tpf-hv-metrics", daemon=True)
         self._thread.start()

@@ -1,0 +1,157 @@
+"""Operator metrics recorder + billing.
+
+Analog of the reference's ``internal/metrics/recorder.go`` (919 LoC): a
+periodic pass over the allocator/store producing per-chip, per-pool,
+per-workload utilization metrics and **per-QoS billing** (hourly cost from
+the pool's QoS pricing, ``recorder.go:852``), written as influx lines to a
+metrics file and into the in-process TSDB that backs the autoscaler and
+alert evaluator.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+from .. import constants
+from ..api.types import Pod, TPUPool, TPUWorkload
+from ..cloudprovider.pricing import hourly_cost
+from .encoder import encode_line
+from .tsdb import TSDB
+
+log = logging.getLogger("tpf.metrics.recorder")
+
+
+class MetricsRecorder:
+    def __init__(self, operator, tsdb: Optional[TSDB] = None,
+                 path: str = "", interval_s: float = 5.0):
+        self.operator = operator
+        self.tsdb = tsdb or TSDB()
+        self.path = path
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if path:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="tpf-metrics", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.record_once()
+            except Exception:
+                log.exception("metrics pass failed")
+
+    # ------------------------------------------------------------------
+
+    def record_once(self) -> int:
+        op = self.operator
+        lines = []
+        ts = time.time_ns()
+        now = time.time()
+
+        pool_totals: Dict[str, Dict[str, float]] = {}
+        for state in op.allocator.chips():
+            st = state.chip.status
+            cap = state.virtual_capacity()
+            avail = state.available()
+            used_t = cap.tflops - avail.tflops
+            used_h = cap.hbm_bytes - avail.hbm_bytes
+            tags = {"chip": state.chip.name, "node": st.node_name,
+                    "pool": st.pool, "generation": st.generation}
+            fields = {"allocated_tflops": used_t,
+                      "allocated_hbm_bytes": used_h,
+                      "capacity_tflops": cap.tflops,
+                      "capacity_hbm_bytes": cap.hbm_bytes,
+                      "workers": len(state.holders)}
+            lines.append(encode_line("tpf_chip_alloc", tags, fields, ts))
+            self.tsdb.insert("tpf_chip_alloc", tags, fields, now)
+            pt = pool_totals.setdefault(st.pool, {
+                "allocated_tflops": 0.0, "capacity_tflops": 0.0,
+                "allocated_hbm_bytes": 0.0, "capacity_hbm_bytes": 0.0,
+                "workers": 0.0})
+            pt["allocated_tflops"] += used_t
+            pt["capacity_tflops"] += cap.tflops
+            pt["allocated_hbm_bytes"] += used_h
+            pt["capacity_hbm_bytes"] += cap.hbm_bytes
+            pt["workers"] += len(state.holders)
+
+        for pool, fields in pool_totals.items():
+            util = (fields["allocated_tflops"] / fields["capacity_tflops"]
+                    if fields["capacity_tflops"] else 0.0)
+            fields = dict(fields, utilization=util)
+            lines.append(encode_line("tpf_pool", {"pool": pool}, fields, ts))
+            self.tsdb.insert("tpf_pool", {"pool": pool}, fields, now)
+
+        # per-allocation billing (QoS pricing analog)
+        pools = {p.name: p for p in op.store.list(TPUPool)}
+        for record in op.allocator.allocations():
+            req = record.request
+            generation = req.generation
+            if not generation:
+                state = op.allocator.get_chip(record.chip_ids[0]) \
+                    if record.chip_ids else None
+                generation = state.chip.status.generation if state else "v5e"
+            pool = pools.get(req.pool)
+            rate = 0.0
+            if pool is not None:
+                for pricing in pool.spec.qos_pricing:
+                    if pricing.qos == req.qos:
+                        rate = (pricing.requests_per_tflops_hour
+                                * req.request.tflops * req.chip_count
+                                + pricing.requests_per_gib_hour
+                                * req.request.hbm_bytes / 2**30
+                                * req.chip_count)
+                        break
+            if rate == 0.0:
+                # fall back to the cloud price of the chip fraction used
+                state = op.allocator.get_chip(record.chip_ids[0]) \
+                    if record.chip_ids else None
+                peak = (state.chip.status.capacity.tflops
+                        if state else 197.0)
+                frac = min(req.request.tflops / peak, 1.0) if peak else 0
+                rate = hourly_cost(generation, frac * req.chip_count)
+            tags = {"namespace": req.namespace, "workload": req.workload_name
+                    or req.pod_name, "qos": req.qos, "pool": req.pool}
+            fields = {"hourly_cost": rate,
+                      "tflops_requested": req.request.tflops
+                      * req.chip_count,
+                      "hbm_requested": req.request.hbm_bytes
+                      * req.chip_count}
+            lines.append(encode_line("tpf_billing", tags, fields, ts))
+            self.tsdb.insert("tpf_billing", tags, fields, now)
+
+        # workload utilization proxy: allocation request vs pool pressure
+        for wl in op.store.list(TPUWorkload):
+            tags = {"namespace": wl.metadata.namespace,
+                    "workload": wl.metadata.name}
+            fields = {"replicas": wl.status.replicas,
+                      "ready_replicas": wl.status.ready_replicas}
+            lines.append(encode_line("tpf_workload", tags, fields, ts))
+            self.tsdb.insert("tpf_workload", tags, fields, now)
+
+        # scheduler counters
+        sched_fields = {"scheduled_total": op.scheduler.scheduled_count,
+                        "failed_total": op.scheduler.failed_count,
+                        "waiting_pods": len(op.scheduler.waiting_pods())}
+        lines.append(encode_line("tpf_scheduler", {}, sched_fields, ts))
+        self.tsdb.insert("tpf_scheduler", {}, sched_fields, now)
+
+        if self.path and lines:
+            with open(self.path, "a") as f:
+                f.write("\n".join(lines) + "\n")
+        self.tsdb.gc()
+        return len(lines)

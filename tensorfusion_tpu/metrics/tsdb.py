@@ -1,0 +1,157 @@
+"""In-process time-series store.
+
+The role GreptimeDB plays for the reference (metrics land there via a
+vector sidecar and back the autoscaler + alert evaluator,
+``cmd/main.go:751-767``): tpu-fusion is self-contained, so a small TSDB
+lives in the operator process — influx-line ingestion, tag-filtered range
+queries, and window aggregation (mean/max/min/sum/percentile/rate) with a
+bounded retention ring per series.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .encoder import parse_line
+
+
+@dataclass(frozen=True)
+class SeriesKey:
+    measurement: str
+    tags: Tuple[Tuple[str, str], ...]
+    field: str
+
+
+@dataclass
+class Point:
+    ts: float
+    value: float
+
+
+class TSDB:
+    def __init__(self, retention_s: float = 3600.0,
+                 max_points_per_series: int = 10000):
+        self.retention_s = retention_s
+        self.max_points = max_points_per_series
+        self._lock = threading.RLock()
+        self._series: Dict[SeriesKey, deque] = {}
+
+    # -- ingestion --------------------------------------------------------
+
+    def insert(self, measurement: str, tags: Dict[str, str],
+               fields: Dict[str, float], ts: Optional[float] = None) -> None:
+        ts = ts if ts is not None else time.time()
+        tag_key = tuple(sorted(tags.items()))
+        with self._lock:
+            for field, value in fields.items():
+                if isinstance(value, bool):
+                    value = 1.0 if value else 0.0
+                if not isinstance(value, (int, float)):
+                    continue
+                key = SeriesKey(measurement, tag_key, field)
+                dq = self._series.get(key)
+                if dq is None:
+                    dq = deque(maxlen=self.max_points)
+                    self._series[key] = dq
+                dq.append(Point(ts, float(value)))
+
+    def ingest_line(self, line: str) -> None:
+        measurement, tags, fields, ts_ns = parse_line(line)
+        self.insert(measurement, tags,
+                    {k: v for k, v in fields.items()
+                     if isinstance(v, (int, float, bool))}, ts_ns / 1e9)
+
+    def ingest_file(self, path: str, offset: int = 0) -> int:
+        """Tail a metrics file from byte offset; returns the new offset
+        (the vector-sidecar shipping analog)."""
+        try:
+            with open(path) as f:
+                f.seek(offset)
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        try:
+                            self.ingest_line(line)
+                        except ValueError:
+                            pass
+                return f.tell()
+        except FileNotFoundError:
+            return offset
+
+    # -- queries ----------------------------------------------------------
+
+    def _matching(self, measurement: str, field: str,
+                  tags: Optional[Dict[str, str]]) -> List[SeriesKey]:
+        out = []
+        for key in self._series:
+            if key.measurement != measurement or key.field != field:
+                continue
+            if tags:
+                kt = dict(key.tags)
+                if any(kt.get(k) != v for k, v in tags.items()):
+                    continue
+            out.append(key)
+        return out
+
+    def query(self, measurement: str, field: str,
+              tags: Optional[Dict[str, str]] = None,
+              since: Optional[float] = None,
+              until: Optional[float] = None) -> List[Tuple[dict, List[Point]]]:
+        """Returns [(tags, points)] for every matching series."""
+        now = time.time()
+        since = since if since is not None else now - self.retention_s
+        until = until if until is not None else now
+        with self._lock:
+            out = []
+            for key in self._matching(measurement, field, tags):
+                pts = [p for p in self._series[key]
+                       if since <= p.ts <= until]
+                if pts:
+                    out.append((dict(key.tags), pts))
+            return out
+
+    def aggregate(self, measurement: str, field: str,
+                  agg: str = "mean",
+                  tags: Optional[Dict[str, str]] = None,
+                  window_s: float = 300.0) -> Optional[float]:
+        """Aggregate over all matching points in the trailing window.
+        agg: mean | max | min | sum | count | p50 | p90 | p95 | p99 | last"""
+        series = self.query(measurement, field, tags,
+                            since=time.time() - window_s)
+        values = [p.value for _, pts in series for p in pts]
+        if not values:
+            return None
+        if agg == "mean":
+            return sum(values) / len(values)
+        if agg == "max":
+            return max(values)
+        if agg == "min":
+            return min(values)
+        if agg == "sum":
+            return sum(values)
+        if agg == "count":
+            return float(len(values))
+        if agg == "last":
+            latest = max(((pts[-1].ts, pts[-1].value)
+                          for _, pts in series), default=None)
+            return latest[1] if latest else None
+        if agg.startswith("p"):
+            q = float(agg[1:]) / 100.0
+            values.sort()
+            idx = min(int(q * len(values)), len(values) - 1)
+            return values[idx]
+        raise ValueError(f"unknown aggregation {agg!r}")
+
+    def gc(self) -> None:
+        cutoff = time.time() - self.retention_s
+        with self._lock:
+            for key, dq in list(self._series.items()):
+                while dq and dq[0].ts < cutoff:
+                    dq.popleft()
+                if not dq:
+                    del self._series[key]

@@ -42,6 +42,10 @@ log = logging.getLogger("tpf.operator")
 class Operator:
     def __init__(self, store: Optional[ObjectStore] = None,
                  enable_expander: bool = True,
+                 enable_metrics: bool = False,
+                 enable_autoscaler: bool = False,
+                 metrics_path: str = "",
+                 alert_rules=None, alert_webhook: str = "",
                  sync_interval_s: float = 2.0):
         self.store = store or ObjectStore()
         self.allocator = TPUAllocator(store=self.store)
@@ -85,6 +89,28 @@ class Operator:
                                     on_provisioned=self.expander.clear_inflight)):
             self.manager.register(ctrl)
 
+        # observability stack (recorder feeds the TSDB that backs the
+        # autoscaler + alert evaluator, cmd/main.go:614-767 analog)
+        from .alert import AlertEvaluator
+        from .autoscaler import AutoScaler
+        from .metrics.recorder import MetricsRecorder
+        from .metrics.tsdb import TSDB
+
+        self.tsdb = TSDB()
+        self.metrics = MetricsRecorder(self, tsdb=self.tsdb,
+                                       path=metrics_path) \
+            if enable_metrics or metrics_path else None
+        self.autoscaler = AutoScaler(self, self.tsdb) \
+            if enable_autoscaler else None
+        self.alerts = AlertEvaluator(self.tsdb, rules=alert_rules,
+                                     webhook_url=alert_webhook) \
+            if alert_rules is not None or alert_webhook else None
+        #: hypervisor metrics files to tail into the TSDB (gives the
+        #: autoscaler its tpf_worker usage series — the vector-sidecar
+        #: shipping analog)
+        self.worker_metrics_paths: List[str] = []
+        self._metrics_offsets: Dict[str, int] = {}
+
         self._stop = threading.Event()
         self._sync_thread: Optional[threading.Thread] = None
         self._started = False
@@ -94,6 +120,7 @@ class Operator:
     def start(self) -> None:
         if self._started:
             return
+        self._stop.clear()  # support stop() -> start() restart cycles
         # restart recovery before serving: chips first (the watch replay is
         # async), then rebuild allocator + quota state from persisted pods
         # (reconcileAllocationState analog)
@@ -127,11 +154,20 @@ class Operator:
                                              name="tpf-operator-sync",
                                              daemon=True)
         self._sync_thread.start()
+        if self.metrics is not None:
+            self.metrics.start()
+        if self.autoscaler is not None:
+            self.autoscaler.start()
+        if self.alerts is not None:
+            self.alerts.start()
         self._started = True
         log.info("operator started")
 
     def stop(self) -> None:
         self._stop.set()
+        for component in (self.alerts, self.autoscaler, self.metrics):
+            if component is not None:
+                component.stop()
         self.scheduler.stop()
         self.manager.stop()
         if self._sync_thread:
@@ -145,6 +181,9 @@ class Operator:
             try:
                 self.allocator.sync_to_store()
                 self.allocator.sweep_assumed()
+                for path in self.worker_metrics_paths:
+                    self._metrics_offsets[path] = self.tsdb.ingest_file(
+                        path, self._metrics_offsets.get(path, 0))
             except Exception:
                 log.exception("operator sync pass failed")
 
@@ -233,6 +272,8 @@ def main(argv=None) -> int:
     ap.add_argument("--persist-dir", default="",
                     help="JSONL persistence dir (enables restart recovery)")
     ap.add_argument("--pool", default="pool-a")
+    ap.add_argument("--metrics-path", default="",
+                    help="write influx-line metrics to this file")
     ap.add_argument("--bootstrap-host", default="",
                     help="GEN:CHIPS — provision one simulated host at boot "
                          "(e.g. v5e:8)")
@@ -250,7 +291,7 @@ def main(argv=None) -> int:
         if n:
             log.info("loaded %d persisted objects", n)
 
-    op = Operator(store=store)
+    op = Operator(store=store, metrics_path=args.metrics_path)
     if store.try_get(TPUPool, args.pool) is None:
         pool = TPUPool.new(args.pool)
         pool.spec.name = args.pool

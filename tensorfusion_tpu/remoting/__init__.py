@@ -1,0 +1,4 @@
+"""Remote-vTPU: StableHLO-level remoting over Ethernet/DCN."""
+
+from .client import RemoteBuffer, RemoteDevice, RemoteExecutionError
+from .worker import RemoteVTPUWorker

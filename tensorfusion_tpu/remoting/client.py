@@ -1,0 +1,176 @@
+"""Remote-vTPU client: run JAX computations on a remote worker.
+
+The role of the reference's closed-source remoting client (the CPU-node
+side of GPU-over-IP): ``remote_jit(fn)`` lowers/exports the function
+locally (tracing only — no accelerator needed), ships the StableHLO to
+the worker once per argument signature, and thereafter sends only
+argument buffers per call.  ``RemoteDevice.from_connection`` resolves the
+worker URL through the operator's ``/connection`` endpoint, the same
+plumbing the reference drives through TensorFusionConnection
+(tensorfusionconnection_controller.go:140).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import logging
+import socket
+import threading
+import urllib.request
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from .protocol import recv_message, send_message
+
+log = logging.getLogger("tpf.remoting.client")
+
+
+class RemoteExecutionError(RuntimeError):
+    pass
+
+
+class RemoteBuffer:
+    """Handle to a device-resident array on the worker (upload once with
+    RemoteDevice.put, reference in remote_jit calls)."""
+
+    def __init__(self, device: "RemoteDevice", buf_id: str, shape, dtype):
+        self.device = device
+        self.buf_id = buf_id
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype) if dtype != "bfloat16" else dtype
+
+    def fetch(self) -> np.ndarray:
+        _, _, bufs = self.device._rpc("FETCH", {"buf_id": self.buf_id}, [])
+        return bufs[0]
+
+    def free(self) -> None:
+        self.device._rpc("FREE", {"buf_ids": [self.buf_id]}, [])
+
+
+class RemoteDevice:
+    def __init__(self, url: str):
+        # url: "tcp://host:port"
+        if url.startswith("tcp://"):
+            url = url[len("tcp://"):]
+        host, _, port = url.rpartition(":")
+        self.host, self.port = host or "127.0.0.1", int(port)
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def from_connection(operator_url: str, name: str,
+                        namespace: str = "default",
+                        wait_s: float = 10.0) -> "RemoteDevice":
+        with urllib.request.urlopen(
+                f"{operator_url}/connection?name={name}"
+                f"&namespace={namespace}&wait_s={wait_s}") as r:
+            info = json.loads(r.read())
+        if not info.get("worker_url"):
+            raise RemoteExecutionError(
+                f"connection {namespace}/{name} has no worker yet")
+        return RemoteDevice(info["worker_url"])
+
+    # ------------------------------------------------------------------
+
+    def _conn(self) -> socket.socket:
+        if self._sock is None:
+            self._sock = socket.create_connection((self.host, self.port),
+                                                  timeout=60)
+        return self._sock
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                self._sock.close()
+                self._sock = None
+
+    def _rpc(self, kind: str, meta: Dict[str, Any], buffers) -> Tuple:
+        with self._lock:
+            sock = self._conn()
+            try:
+                send_message(sock, kind, meta, buffers)
+                rkind, rmeta, rbufs = recv_message(sock)
+            except (ConnectionError, OSError):
+                # one reconnect attempt (worker restarts, idle timeouts)
+                self.close()
+                sock = self._conn()
+                send_message(sock, kind, meta, buffers)
+                rkind, rmeta, rbufs = recv_message(sock)
+        if rkind == "ERROR":
+            raise RemoteExecutionError(rmeta.get("error", "remote error"))
+        return rkind, rmeta, rbufs
+
+    def info(self) -> Dict[str, Any]:
+        _, meta, _ = self._rpc("INFO", {}, [])
+        return meta
+
+    def put(self, array) -> RemoteBuffer:
+        arr = np.asarray(array)
+        _, meta, _ = self._rpc("PUT", {}, [arr])
+        return RemoteBuffer(self, meta["buf_id"], arr.shape,
+                            arr.dtype.name)
+
+    # ------------------------------------------------------------------
+
+    def remote_jit(self, fn: Callable) -> Callable:
+        """Wrap ``fn`` so calls execute on the remote worker.  Functions
+        must take/return array pytrees; tracing happens locally."""
+        import jax
+
+        exe_ids: Dict[Any, Tuple[str, Any]] = {}
+        device = self
+
+        def leaf_sig(l):
+            if isinstance(l, RemoteBuffer):
+                return (l.shape, str(l.dtype))
+            return (tuple(np.shape(l)), np.asarray(l).dtype.name)
+
+        def spec_of(l):
+            if isinstance(l, RemoteBuffer):
+                dt = l.dtype
+                if dt == "bfloat16":
+                    import ml_dtypes
+                    dt = ml_dtypes.bfloat16
+                return jax.ShapeDtypeStruct(l.shape, dt)
+            arr = np.asarray(l)
+            return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+
+        @functools.wraps(fn)
+        def remote(*args):
+            leaves, treedef = jax.tree_util.tree_flatten(
+                args, is_leaf=lambda x: isinstance(x, RemoteBuffer))
+            sig = (tuple(leaf_sig(l) for l in leaves), treedef)
+            entry = exe_ids.get(sig)
+            if entry is None:
+                specs = jax.tree_util.tree_unflatten(
+                    treedef, [spec_of(l) for l in leaves])
+                jitted = jax.jit(fn)
+                exported = jax.export.export(jitted)(*specs)
+                blob = exported.serialize()
+                try:
+                    analysis = jitted.lower(*specs).compile() \
+                        .cost_analysis() or {}
+                    mflops = max(int(analysis.get("flops", 0) / 1e6), 1)
+                except Exception:  # noqa: BLE001
+                    mflops = 1
+                _, meta, _ = device._rpc(
+                    "COMPILE", {"mflops_hint": mflops},
+                    [np.frombuffer(blob, dtype=np.uint8)])
+                out_tree = jax.tree_util.tree_structure(
+                    jax.eval_shape(fn, *specs))
+                entry = (meta["exe_id"], out_tree)
+                exe_ids[sig] = entry
+            exe_id, out_tree = entry
+            arg_refs = [l.buf_id if isinstance(l, RemoteBuffer) else None
+                        for l in leaves]
+            buffers = [np.asarray(l) for l in leaves
+                       if not isinstance(l, RemoteBuffer)]
+            _, rmeta, results = device._rpc(
+                "EXECUTE", {"exe_id": exe_id, "arg_refs": arg_refs},
+                buffers)
+            return jax.tree_util.tree_unflatten(out_tree, results)
+
+        remote._tpf_remote = True  # noqa: SLF001
+        return remote

@@ -1,0 +1,175 @@
+"""Remote-vTPU worker: serves a TPU chip over TCP.
+
+The role of the reference's closed-source remote worker image
+(``ProviderImages.remoteWorker``): runs on the TPU host (optionally
+*under* the vTPU client runtime so remote tenants are metered like local
+ones), accepts COMPILE/EXECUTE/INFO messages, and keeps an executable
+cache keyed by content hash so repeated clients share compilations.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import socketserver
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from .protocol import recv_message, send_message
+
+log = logging.getLogger("tpf.remoting.worker")
+
+
+class RemoteVTPUWorker:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 meter_client=None):
+        self.meter_client = meter_client    # optional VTPUClient
+        self._exe_cache: Dict[str, object] = {}
+        self._exe_costs: Dict[str, int] = {}
+        self._buffers: Dict[str, object] = {}   # device-resident arrays
+        self._buf_seq = 0
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        kind, meta, buffers = recv_message(self.request)
+                        try:
+                            outer._dispatch(self.request, kind, meta,
+                                            buffers)
+                        except Exception as e:  # noqa: BLE001
+                            log.exception("remote %s failed", kind)
+                            send_message(self.request, "ERROR",
+                                         {"error": str(e)}, [])
+                except (ConnectionError, OSError):
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+        self.executions = 0
+
+    @property
+    def url(self) -> str:
+        return f"tcp://127.0.0.1:{self.port}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="tpf-remote-worker",
+                                        daemon=True)
+        self._thread.start()
+        log.info("remote-vTPU worker serving on %s", self.url)
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    # ------------------------------------------------------------------
+
+    def _dispatch(self, sock, kind, meta, buffers) -> None:
+        import jax
+
+        if kind == "INFO":
+            dev = jax.devices()[0]
+            send_message(sock, "INFO_OK", {
+                "platform": dev.platform,
+                "device_kind": getattr(dev, "device_kind", ""),
+                "n_devices": len(jax.devices()),
+                "cached_executables": len(self._exe_cache)}, [])
+        elif kind == "COMPILE":
+            blob = buffers[0].tobytes() if buffers else b""
+            exe_id = hashlib.sha256(blob).hexdigest()[:32]
+            with self._lock:
+                if exe_id not in self._exe_cache:
+                    exported = jax.export.deserialize(bytearray(blob))
+                    self._exe_cache[exe_id] = exported
+                    # charge-model: flops of the exported computation
+                    self._exe_costs[exe_id] = int(
+                        meta.get("mflops_hint", 1))
+            send_message(sock, "COMPILE_OK", {"exe_id": exe_id}, [])
+        elif kind == "PUT":
+            # device-resident buffer: upload once, reference many times
+            arr = jax.device_put(np.asarray(buffers[0]))
+            with self._lock:
+                self._buf_seq += 1
+                buf_id = f"buf-{self._buf_seq}"
+                self._buffers[buf_id] = arr
+            send_message(sock, "PUT_OK", {"buf_id": buf_id}, [])
+        elif kind == "FREE":
+            with self._lock:
+                for buf_id in meta.get("buf_ids", []):
+                    self._buffers.pop(buf_id, None)
+            send_message(sock, "FREE_OK", {}, [])
+        elif kind == "EXECUTE":
+            exe_id = meta["exe_id"]
+            with self._lock:
+                exported = self._exe_cache.get(exe_id)
+                mflops = self._exe_costs.get(exe_id, 1)
+            if exported is None:
+                send_message(sock, "ERROR",
+                             {"error": f"unknown executable {exe_id}",
+                              "code": "needs_compile"}, [])
+                return
+            if self.meter_client is not None:
+                self.meter_client.charge_launch(mflops)
+            # arg_refs: per-argument, a buf_id string for resident buffers
+            # or null meaning "next inline wire buffer"
+            arg_refs = meta.get("arg_refs")
+            if arg_refs is None:
+                args = [np.asarray(b) for b in buffers]
+            else:
+                args = []
+                it = iter(buffers)
+                with self._lock:
+                    for ref in arg_refs:
+                        if ref is None:
+                            args.append(np.asarray(next(it)))
+                        else:
+                            arr = self._buffers.get(ref)
+                            if arr is None:
+                                send_message(
+                                    sock, "ERROR",
+                                    {"error": f"unknown buffer {ref}"}, [])
+                                return
+                            args.append(arr)
+            out = exported.call(*args)
+            leaves = jax.tree_util.tree_leaves(out)
+            self.executions += 1
+            if meta.get("keep_results"):
+                # park results device-side, hand back references
+                with self._lock:
+                    ids, shapes, dtypes = [], [], []
+                    for leaf in leaves:
+                        self._buf_seq += 1
+                        buf_id = f"buf-{self._buf_seq}"
+                        self._buffers[buf_id] = leaf
+                        ids.append(buf_id)
+                        shapes.append(list(leaf.shape))
+                        dtypes.append(str(leaf.dtype))
+                send_message(sock, "EXECUTE_OK",
+                             {"result_refs": ids, "shapes": shapes,
+                              "dtypes": dtypes}, [])
+            else:
+                results = [np.asarray(leaf) for leaf in leaves]
+                send_message(sock, "EXECUTE_OK",
+                             {"n_results": len(results)}, results)
+        elif kind == "FETCH":
+            with self._lock:
+                arr = self._buffers.get(meta["buf_id"])
+            if arr is None:
+                send_message(sock, "ERROR",
+                             {"error": f"unknown buffer {meta['buf_id']}"},
+                             [])
+                return
+            send_message(sock, "FETCH_OK", {}, [np.asarray(arr)])
+        else:
+            send_message(sock, "ERROR", {"error": f"unknown kind {kind}"},
+                         [])

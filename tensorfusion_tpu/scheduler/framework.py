@@ -214,6 +214,7 @@ class Scheduler:
     # -- lifecycle --------------------------------------------------------
 
     def start(self) -> None:
+        self._stop.clear()
         self._thread = threading.Thread(target=self._loop,
                                         name="tpf-sched", daemon=True)
         self._thread.start()

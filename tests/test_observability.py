@@ -1,0 +1,213 @@
+"""TSDB, operator metrics recorder + billing, autoscaler recommenders +
+apply loop, alert evaluator (SURVEY §2.2 metrics/autoscaler/alert rows)."""
+
+import http.server
+import json
+import threading
+import time
+
+import pytest
+
+from tensorfusion_tpu import constants
+from tensorfusion_tpu.alert import AlertEvaluator, AlertRule
+from tensorfusion_tpu.api import ResourceAmount
+from tensorfusion_tpu.api.types import QosPricing, TPUNodeClaim, TPUPool
+from tensorfusion_tpu.autoscaler import (AutoScaler, DecayingHistogram,
+                                         PercentileRecommender, cron_matches)
+from tensorfusion_tpu.metrics.recorder import MetricsRecorder
+from tensorfusion_tpu.metrics.tsdb import TSDB
+
+
+def test_tsdb_insert_query_aggregate():
+    db = TSDB()
+    now = time.time()
+    for i in range(10):
+        db.insert("m", {"chip": "c0"}, {"duty": float(i * 10)},
+                  ts=now - 100 + i * 10)
+    db.insert("m", {"chip": "c1"}, {"duty": 500.0}, ts=now)
+
+    series = db.query("m", "duty", tags={"chip": "c0"})
+    assert len(series) == 1 and len(series[0][1]) == 10
+    assert db.aggregate("m", "duty", tags={"chip": "c0"},
+                        agg="max", window_s=1000) == 90.0
+    assert db.aggregate("m", "duty", tags={"chip": "c0"},
+                        agg="mean", window_s=1000) == pytest.approx(45.0)
+    assert db.aggregate("m", "duty", agg="p90", window_s=1000) in (90.0,
+                                                                   500.0)
+    assert db.aggregate("m", "duty", tags={"chip": "zz"}) is None
+
+
+def test_tsdb_ingest_file_tail(tmp_path):
+    from tensorfusion_tpu.metrics.encoder import encode_line
+    db = TSDB()
+    path = tmp_path / "metrics.log"
+    path.write_text(encode_line("tpf_worker", {"worker": "w1"},
+                                {"duty_cycle_pct": 42.0}) + "\n")
+    off = db.ingest_file(str(path))
+    assert db.aggregate("tpf_worker", "duty_cycle_pct", agg="last") == 42.0
+    with open(path, "a") as f:
+        f.write(encode_line("tpf_worker", {"worker": "w1"},
+                            {"duty_cycle_pct": 77.0}) + "\n")
+    off = db.ingest_file(str(path), off)
+    assert db.aggregate("tpf_worker", "duty_cycle_pct", agg="last") == 77.0
+
+
+def test_decaying_histogram_percentile_shifts():
+    h = DecayingHistogram(first_bucket=1.0, half_life_s=60.0)
+    now = time.time()
+    for _ in range(100):
+        h.add(10.0, ts=now - 120)     # old usage: 10 (2 half-lives ago)
+    for _ in range(20):
+        h.add(100.0, ts=now)          # recent spike: 100
+    # decay: old mass 100*0.25=25 vs recent 20 -> spike owns the top
+    assert h.percentile(90) >= 90.0
+    # but the bottom still reflects the old usage level
+    assert h.percentile(20) <= 12.0
+
+
+def test_cron_matching():
+    # Tuesday 2026-07-28 14:30 local
+    when = time.mktime((2026, 7, 28, 14, 30, 0, 0, 0, -1))
+    assert cron_matches("* * * * *", when)
+    assert cron_matches("30 14 * * *", when)
+    assert cron_matches("*/15 9-17 * * *", when)
+    assert not cron_matches("0 3 * * *", when)
+    with pytest.raises(ValueError):
+        cron_matches("* * *", when)
+
+
+def _operator_with_host():
+    from tensorfusion_tpu.operator import Operator
+    op = Operator()
+    pool = TPUPool.new("pool-a")
+    pool.spec.name = "pool-a"
+    pool.spec.qos_pricing = [QosPricing(qos="medium",
+                                        requests_per_tflops_hour=0.01,
+                                        requests_per_gib_hour=0.005)]
+    op.store.create(pool)
+    claim = TPUNodeClaim.new("m-host")
+    claim.spec.pool = "pool-a"
+    claim.spec.generation = "v5e"
+    claim.spec.chip_count = 8
+    op.store.create(claim)
+    op.start()
+    deadline = time.time() + 5
+    while len(op.allocator.chips()) < 8 and time.time() < deadline:
+        time.sleep(0.02)
+    return op
+
+
+def _submit(op, name, tflops, hbm, autoscale=False):
+    from tensorfusion_tpu.api.types import Container, Pod
+    pod = Pod.new(name, namespace="default")
+    ann = pod.metadata.annotations
+    ann[constants.ANN_POOL] = "pool-a"
+    ann[constants.ANN_TFLOPS_REQUEST] = str(tflops)
+    ann[constants.ANN_HBM_REQUEST] = str(hbm)
+    ann[constants.ANN_IS_LOCAL_TPU] = "true"
+    if autoscale:
+        ann[constants.ANN_AUTOSCALE] = "true"
+    pod.spec.containers = [Container(name="main")]
+    op.submit_pod(pod)
+    assert op.wait_for_binding(name) is not None
+    return pod
+
+
+def test_metrics_recorder_and_billing():
+    op = _operator_with_host()
+    try:
+        _submit(op, "bill-1", 98.5, 4 * 2**30)
+        tsdb = TSDB()
+        rec = MetricsRecorder(op, tsdb=tsdb)
+        n = rec.record_once()
+        assert n > 8
+        util = tsdb.aggregate("tpf_pool", "utilization",
+                              tags={"pool": "pool-a"}, agg="last")
+        assert util is not None and util > 0
+        cost = tsdb.aggregate("tpf_billing", "hourly_cost",
+                              tags={"namespace": "default"}, agg="last")
+        # 98.5 tflops * 0.01 + 4 GiB * 0.005 = 1.005/h
+        assert cost == pytest.approx(1.005, rel=0.01)
+    finally:
+        op.stop()
+
+
+def test_autoscaler_percentile_resize():
+    op = _operator_with_host()
+    try:
+        _submit(op, "auto-1", 20.0, 2 * 2**30, autoscale=True)
+        tsdb = TSDB()
+        scaler = AutoScaler(op, tsdb)
+        wl_key = "default/auto-1"
+        # feed observed usage well above the current 20-tflops request
+        now = time.time()
+        for i in range(50):
+            scaler.observe(wl_key, tflops=35.0, hbm_bytes=2 * 2**30,
+                           ts=now - 50 + i)
+        adjusted = scaler.run_once()
+        assert adjusted == 1
+        rec = op.allocator.allocation("default/auto-1")
+        # p90(35) * 1.15 margin ~ 40, clamped to <= 2x current
+        assert 30.0 <= rec.request.request.tflops <= 40.5
+    finally:
+        op.stop()
+
+
+def test_autoscaler_rejects_on_capacity():
+    op = _operator_with_host()
+    try:
+        _submit(op, "auto-2", 150.0, 14 * 2**30, autoscale=True)
+        tsdb = TSDB()
+        scaler = AutoScaler(op, tsdb)
+        now = time.time()
+        for i in range(50):
+            # usage implies > chip HBM; resize must be rejected gracefully
+            scaler.observe("default/auto-2", tflops=180.0,
+                           hbm_bytes=30 * 2**30, ts=now - 50 + i)
+        scaler.run_once()
+        rec = op.allocator.allocation("default/auto-2")
+        assert rec.request.request.hbm_bytes == 14 * 2**30  # unchanged
+    finally:
+        op.stop()
+
+
+def test_alert_evaluator_fire_and_resolve_with_webhook():
+    received = []
+
+    class Hook(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            received.append(json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    server = http.server.HTTPServer(("127.0.0.1", 0), Hook)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        db = TSDB()
+        ev = AlertEvaluator(
+            db, rules=[AlertRule(name="pool-hot", measurement="tpf_pool",
+                                 metric_field="utilization", agg="last",
+                                 op=">", threshold=0.9,
+                                 severity="critical")],
+            webhook_url=f"http://127.0.0.1:{server.server_address[1]}/")
+        db.insert("tpf_pool", {"pool": "p"}, {"utilization": 0.95})
+        changed = ev.evaluate_once()
+        assert len(changed) == 1 and changed[0].state == "firing"
+        assert "pool-hot" in ev.active
+        # duplicate evaluation: no re-fire
+        assert ev.evaluate_once() == []
+
+        db.insert("tpf_pool", {"pool": "p"}, {"utilization": 0.2})
+        changed = ev.evaluate_once()
+        assert changed and changed[0].state == "resolved"
+        assert not ev.active
+        time.sleep(0.1)
+        assert len(received) == 2
+        assert received[0][0]["state"] == "firing"
+        assert received[1][0]["state"] == "resolved"
+    finally:
+        server.shutdown()

@@ -1,0 +1,151 @@
+"""Remote-vTPU tests: protocol framing, compile/execute round trips,
+executable caching, metering of remote tenants, error paths, and the
+operator-connection resolution flow (BASELINE config #3 shape)."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorfusion_tpu.remoting import (RemoteDevice, RemoteExecutionError,
+                                       RemoteVTPUWorker)
+from tensorfusion_tpu.remoting.protocol import encode_message, recv_message
+
+
+@pytest.fixture()
+def worker():
+    w = RemoteVTPUWorker()
+    w.start()
+    yield w
+    w.stop()
+
+
+def test_protocol_roundtrip_via_socket(worker):
+    dev = RemoteDevice(worker.url)
+    info = dev.info()
+    assert info["platform"] == "cpu"
+    assert info["n_devices"] >= 1
+    dev.close()
+
+
+def test_remote_jit_matches_local(worker):
+    dev = RemoteDevice(worker.url)
+
+    def fn(a, b):
+        return jnp.tanh(a @ b) + 1.0
+
+    remote = dev.remote_jit(fn)
+    a = np.random.default_rng(0).standard_normal((64, 64)).astype(np.float32)
+    b = np.random.default_rng(1).standard_normal((64, 64)).astype(np.float32)
+    got = remote(a, b)
+    want = fn(jnp.asarray(a), jnp.asarray(b))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    assert worker.executions == 1
+
+    # second call with the same shapes: no recompile, just execute
+    got2 = remote(a, b)
+    assert worker.executions == 2
+    # different shapes -> second executable cached separately
+    a2 = np.ones((32, 64), np.float32)
+    remote(a2, b)
+    dev2 = RemoteDevice(worker.url)
+    assert dev2.info()["cached_executables"] == 2
+    dev.close()
+    dev2.close()
+
+
+def test_remote_pytree_outputs(worker):
+    dev = RemoteDevice(worker.url)
+
+    def fn(x):
+        return {"double": x * 2, "stats": (x.sum(), x.max())}
+
+    remote = dev.remote_jit(fn)
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    out = remote(x)
+    np.testing.assert_allclose(np.asarray(out["double"]), x * 2)
+    assert out["stats"][0].item() == x.sum()
+    dev.close()
+
+
+def test_remote_unknown_executable_error(worker):
+    dev = RemoteDevice(worker.url)
+    with pytest.raises(RemoteExecutionError, match="unknown executable"):
+        dev._rpc("EXECUTE", {"exe_id": "deadbeef"}, [])
+    dev.close()
+
+
+def test_remote_metering(worker, limiter_lib, tmp_path):
+    """Remote tenants get charged on the worker side like local ones."""
+    from tensorfusion_tpu.client import VTPUClient
+    from tensorfusion_tpu.hypervisor import DeviceQuota, Limiter
+    from tensorfusion_tpu.testing import fresh_library
+
+    host = Limiter(fresh_library(limiter_lib, "rhost"))
+    base = str(tmp_path / "shm")
+    host.init(base)
+    host.create_worker("r", "w", [DeviceQuota(0, "chip", 10000, 0,
+                                              10**9, 10**9)])
+    meter = VTPUClient(limiter_lib=fresh_library(limiter_lib, "rcli"),
+                       shm_path=f"{base}/r/w")
+    worker.meter_client = meter
+
+    dev = RemoteDevice(worker.url)
+    remote = dev.remote_jit(lambda a, b: a @ b)
+    n = 128
+    a = np.ones((n, n), np.float32)
+    remote(a, a)
+    # 2*128^3 = 4.2 MFLOP charged on the worker side
+    assert meter.charged_mflops == pytest.approx(2 * n**3 / 1e6, rel=0.5)
+    dev.close()
+
+
+def test_connection_resolution_via_operator(worker):
+    """Client resolves the worker URL through the operator /connection
+    endpoint (TensorFusionConnection flow)."""
+    from tensorfusion_tpu.api.types import TPUConnection
+    from tensorfusion_tpu.operator import Operator
+    from tensorfusion_tpu.server import OperatorServer
+
+    op = Operator()
+    conn = TPUConnection.new("c1", namespace="default")
+    conn.spec.workload = "serve"
+    conn.status.worker_name = "serve-worker-0"
+    conn.status.worker_url = worker.url
+    conn.status.phase = "Running"
+    op.store.create(conn)
+    server = OperatorServer(op)
+    server.start()
+    try:
+        dev = RemoteDevice.from_connection(server.url, "c1")
+        remote = dev.remote_jit(lambda x: x + 1)
+        out = remote(np.zeros(4, np.float32))
+        np.testing.assert_array_equal(np.asarray(out), [1, 1, 1, 1])
+        dev.close()
+    finally:
+        server.stop()
+
+
+def test_remote_resident_buffers(worker):
+    """Weights uploaded once via put(); per-call wire traffic is only the
+    activations (the <4%-overhead serving pattern)."""
+    dev = RemoteDevice(worker.url)
+    w = np.random.default_rng(0).standard_normal((256, 256)) \
+        .astype(np.float32)
+    w_ref = dev.put(w)
+    remote = dev.remote_jit(lambda w, x: jnp.tanh(x @ w))
+    x = np.ones((8, 256), np.float32)
+    out = remote(w_ref, x)
+    want = jnp.tanh(jnp.asarray(x) @ jnp.asarray(w))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    # fetch round-trips the resident buffer intact
+    np.testing.assert_allclose(w_ref.fetch(), w)
+    w_ref.free()
+    with pytest.raises(RemoteExecutionError, match="unknown buffer"):
+        remote(w_ref, x)
+    dev.close()
