@@ -9,6 +9,7 @@
 #include <stdlib.h>
 #include <string.h>
 
+#include <memory>
 #include <vector>
 
 #include "tpufusion/provider.h"
@@ -106,8 +107,9 @@ int main(int argc, char** argv) {
     CHECK(chips[i].core_count >= 1);
   }
 
-  auto* topo = new tpf_topology_t;
-  CHECK(tpf_topology(topo) == TPF_OK);
+  // heap-allocated: tpf_topology_t is several MB, too big for the stack
+  std::unique_ptr<tpf_topology_t> topo(new tpf_topology_t);
+  CHECK(tpf_topology(topo.get()) == TPF_OK);
   CHECK(topo->row_count == count);
   CHECK((size_t)(topo->mesh_shape[0] * topo->mesh_shape[1] *
                  topo->mesh_shape[2]) >= count);

@@ -37,7 +37,8 @@ from ..api.resources import AdjustRequest, AllocRequest, ResourceAmount
 from ..api.types import Pod, TPUChip
 from ..store import NotFoundError, ObjectStore
 from .filters import (Filter, FilterResult, NodeAffinityFilter,
-                      PartitionFitFilter, default_chain, run_filters)
+                      NodeExclusionFilter, PartitionFitFilter, default_chain,
+                      run_filters)
 from .quota import QuotaExceededError, QuotaStore
 from .strategy import Strategy, new_strategy
 from .vecview import CandidateMap, PoolVectorView
@@ -238,17 +239,23 @@ class TPUAllocator:
 
     # -- filtering / scoring (PreFilter path) ------------------------------
 
-    def check_quota_and_filter(self, req: AllocRequest, explain: bool = False
+    def check_quota_and_filter(self, req: AllocRequest, explain: bool = False,
+                               skip_quota: bool = False
                                ) -> Tuple[Dict[str, List[ChipState]],
                                           Dict[str, str]]:
         """Quota gate + filter chain.  Returns ({node: [chips]}, rejections).
         Raises QuotaExceededError when the namespace quota cannot admit the
         request (gpuallocator.go:1426 analog).
 
+        skip_quota=True runs a capacity-only dry-run (defrag probes: the
+        evicted pod's own quota is still committed, so re-checking quota
+        would double-count it).
+
         Large pools go through the vectorized mask path (rejection reasons
         then require explain=True, which forces the Python chain — used by
         the simulate-schedule API)."""
-        self.quota.check(req)
+        if not skip_quota:
+            self.quota.check(req)
         with self._lock:
             candidates = self.chips(req.pool or None)
             if not explain and len(candidates) > VECTORIZE_THRESHOLD:
@@ -280,11 +287,16 @@ class TPUAllocator:
         mask = view.survivors(req)
         # Rare constraint kinds fall back to per-chip Python checks on the
         # survivors only.
-        if req.node_affinity or req.isolation == constants.ISOLATION_PARTITIONED:
+        if req.node_affinity or req.excluded_nodes or \
+                req.isolation == constants.ISOLATION_PARTITIONED:
             import numpy as np
-            extra = [f for f in (NodeAffinityFilter(self._node_labels),
-                                 PartitionFitFilter())
-                     if req.node_affinity or isinstance(f, PartitionFitFilter)]
+            extra = []
+            if req.node_affinity:
+                extra.append(NodeAffinityFilter(self._node_labels))
+            if req.excluded_nodes:
+                extra.append(NodeExclusionFilter())
+            if req.isolation == constants.ISOLATION_PARTITIONED:
+                extra.append(PartitionFitFilter())
             for i in np.nonzero(mask)[0]:
                 chip = view.states[i]
                 for f in extra:

@@ -109,6 +109,18 @@ class NodeAffinityFilter(Filter):
         return None
 
 
+class NodeExclusionFilter(Filter):
+    """Defrag/migration: never place back onto an excluded node."""
+
+    name = "node-exclusion"
+
+    def check(self, req, chip):
+        if req.excluded_nodes and \
+                chip.chip.status.node_name in req.excluded_nodes:
+            return f"node {chip.chip.status.node_name} excluded"
+        return None
+
+
 class ResourceFitFilter(Filter):
     """Capacity check: request must fit the chip's remaining virtual
     TFLOPs (oversold) and physical HBM."""
@@ -151,7 +163,7 @@ def default_chain(node_labels: Callable[[str], Dict[str, str]]
                   ) -> List[Filter]:
     return [PhaseFilter(), IsolationCapabilityFilter(), GenerationFilter(),
             VendorFilter(), IndexFilter(), NodeAffinityFilter(node_labels),
-            PartitionFitFilter(), ResourceFitFilter()]
+            NodeExclusionFilter(), PartitionFitFilter(), ResourceFitFilter()]
 
 
 def run_filters(filters: List[Filter], req: AllocRequest,

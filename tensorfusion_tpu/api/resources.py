@@ -127,6 +127,7 @@ class AllocRequest:
     qos: str = "medium"
     partition_template: str = ""
     node_affinity: Dict[str, str] = field(default_factory=dict)
+    excluded_nodes: List[str] = field(default_factory=list)  # defrag/migration
     same_node: bool = True      # multi-chip must land on one node
     gang: GangConfig = field(default_factory=GangConfig)
 

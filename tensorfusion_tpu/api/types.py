@@ -407,6 +407,9 @@ class WorkloadProfileSpec:
     partition_template: str = ""
     auto_scaling: AutoScalingConfig = field(default_factory=AutoScalingConfig)
     node_affinity: Dict[str, str] = field(default_factory=dict)
+    #: nodes the workload's workers must avoid (stamped by defrag while a
+    #: node is being drained; cleared after the eviction TTL)
+    excluded_nodes: List[str] = field(default_factory=list)
     gang: GangConfig = field(default_factory=GangConfig)
 
 

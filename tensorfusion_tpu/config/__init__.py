@@ -1,0 +1,4 @@
+"""Layered configuration system."""
+
+from .chip_info import CHIP_INFO_DB, chip_info, mock_chip_info
+from .global_config import GlobalConfig, GlobalConfigWatcher

@@ -80,6 +80,7 @@ ANN_SIDECAR_WORKER = f"{DOMAIN}/sidecar-worker"
 ANN_INJECT_CONTAINER = f"{DOMAIN}/inject-container"
 ANN_DISABLE_FEATURES = f"{DOMAIN}/disable-features"
 ANN_EVICTION_PROTECTION = f"{DOMAIN}/eviction-protection"
+ANN_EXCLUDED_NODES = f"{DOMAIN}/excluded-nodes"  # defrag/migration rebinds
 ANN_AUTOSCALE = f"{DOMAIN}/autoscale"
 ANN_AUTOSCALE_TARGET = f"{DOMAIN}/autoscale-target"
 ANN_PRICING = f"{DOMAIN}/hourly-pricing"

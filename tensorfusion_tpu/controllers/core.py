@@ -285,7 +285,14 @@ class WorkloadController(Controller):
                 pass
 
     def _worker_pod(self, wl: TPUWorkload, name: str) -> Pod:
+        from .rollout import component_hash
+
         pod = Pod.new(name, namespace=wl.metadata.namespace)
+        pool = self.store.try_get(TPUPool, wl.spec.pool) \
+            if wl.spec.pool else None
+        if pool is not None:
+            pod.metadata.labels[constants.LABEL_POD_TEMPLATE_HASH] = \
+                component_hash(pool.spec.components)
         pod.metadata.labels[constants.LABEL_WORKER_NAME] = name
         pod.metadata.labels[constants.LABEL_COMPONENT] = \
             constants.COMPONENT_WORKER
@@ -307,6 +314,9 @@ class WorkloadController(Controller):
             ann[constants.ANN_CHIP_GENERATION] = wl.spec.generation
         if wl.spec.partition_template:
             ann[constants.ANN_PARTITION_NAME] = wl.spec.partition_template
+        if wl.spec.excluded_nodes:
+            ann[constants.ANN_EXCLUDED_NODES] = ",".join(
+                wl.spec.excluded_nodes)
         if wl.spec.gang.enabled:
             ann[constants.ANN_GANG_ENABLED] = "true"
             ann[constants.ANN_GANG_GROUP_KEY] = \
@@ -318,8 +328,9 @@ class WorkloadController(Controller):
                 ann[constants.ANN_GANG_TIMEOUT] = \
                     str(wl.spec.gang.timeout_seconds)
         pod.spec.scheduler_name = constants.SCHEDULER_NAME
-        pod.spec.containers = [Container(name="worker",
-                                         image=self.worker_image)]
+        image = (pool.spec.components.worker_image if pool is not None
+                 else self.worker_image)
+        pod.spec.containers = [Container(name="worker", image=image)]
         pod.metadata.labels[constants.LABEL_HOST_PORT] = \
             constants.LABEL_HOST_PORT_AUTO
         return pod

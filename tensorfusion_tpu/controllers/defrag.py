@@ -1,0 +1,379 @@
+"""Pool defragmentation, node compaction, and live migration.
+
+Analogs of the reference's heaviest lifecycle machinery:
+
+- **Defrag** (``internal/controller/gpupool_defrag.go``, 1954 LoC):
+  cron-scheduled migration of workers *off* under-utilized nodes so those
+  nodes can be reclaimed.  Nodes below the utilization threshold become
+  defrag sources (labeled, with skip bookkeeping when a workload cannot be
+  placed elsewhere); their pods are evicted with a defrag label + TTL and
+  an excluded-nodes constraint so the scheduler rebinds them elsewhere.
+- **Compaction** (``gpupool_types.go:218-284`` + GPUPoolCompaction
+  controller): nodes that stay empty longer than the grace period are
+  released back to the cloud provider (claim + node + chips deleted).
+- **Live migration** (``AccelSnapshot/Resume`` surface,
+  ``server.go:114-115``, GPU phase ``Migrating``): freeze + snapshot via
+  the node hypervisor, rebind the pod off the node, restore + thaw on the
+  target — the controlled-counterpart of defrag's evict-and-reschedule.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import urllib.request
+from typing import Dict, List, Optional
+
+from .. import constants
+from ..api.types import (Node, Pod, TPUChip, TPUNode, TPUNodeClaim,
+                         TPUWorkload)
+from ..autoscaler.recommender import cron_matches
+from ..scheduler.tpuresources import compose_alloc_request
+from ..store import NotFoundError
+from .base import Controller
+
+
+def _merge_exclusions(existing: str, node: str) -> str:
+    nodes = [n for n in existing.split(",") if n]
+    if node not in nodes:
+        nodes.append(node)
+    return ",".join(nodes)
+
+log = logging.getLogger("tpf.controller.defrag")
+
+
+class CompactionController(Controller):
+    name = "compaction"
+    kinds = ("TPUPool",)
+    resync_interval_s = 2.0
+
+    def __init__(self, store, allocator, scheduler=None,
+                 empty_grace_s: Optional[float] = None):
+        self.store = store
+        self.allocator = allocator
+        self.scheduler = scheduler
+        self.empty_grace_override = empty_grace_s
+        self._empty_since: Dict[str, float] = {}
+        self._last_defrag: Dict[str, float] = {}
+        self.evicted_for_defrag: List[str] = []
+        self.compacted_nodes: List[str] = []
+
+    def reconcile(self, event):
+        from ..api.types import TPUPool
+
+        for pool in self.store.list(TPUPool):
+            cfg = pool.spec.compaction
+            if not cfg.enabled:
+                continue
+            self._compact_pool(pool, cfg)
+            self._expire_drain_marks(cfg)
+            if self._defrag_due(pool.name, cfg):
+                self._defrag_pool(pool, cfg)
+
+    def _expire_drain_marks(self, cfg) -> None:
+        """Clear workload exclusions + defrag-source labels once the
+        eviction TTL lapses (gpupool_defrag TTL bookkeeping analog)."""
+        now = time.time()
+        ttl = cfg.defrag_eviction_ttl_seconds
+        for wl in self.store.list(TPUWorkload):
+            since = wl.metadata.annotations.get(
+                constants.ANN_DEFRAG_EVICTED_SINCE)
+            if not since or not wl.spec.excluded_nodes:
+                continue
+            if now - float(since) >= ttl:
+                wl.spec.excluded_nodes = []
+                del wl.metadata.annotations[
+                    constants.ANN_DEFRAG_EVICTED_SINCE]
+                self.store.update(wl)
+        for tnode in self.store.list(TPUNode):
+            since = tnode.metadata.annotations.get(
+                constants.ANN_DEFRAG_SOURCE_SINCE)
+            if since and now - float(since) >= ttl:
+                tnode.metadata.labels.pop(constants.LABEL_DEFRAG_SOURCE,
+                                          None)
+                del tnode.metadata.annotations[
+                    constants.ANN_DEFRAG_SOURCE_SINCE]
+                self.store.update(tnode)
+
+    # -- defrag ------------------------------------------------------------
+
+    def _defrag_due(self, pool: str, cfg) -> bool:
+        if not cfg.defrag_cron:
+            return False
+        last = self._last_defrag.get(pool, 0.0)
+        if time.time() - last < 60.0:
+            return False  # one shot per cron minute
+        return cron_matches(cfg.defrag_cron)
+
+    def _defrag_pool(self, pool, cfg) -> None:
+        self._last_defrag[pool.name] = time.time()
+        nodes = self._node_utilization(pool.name)
+        for node, util in nodes.items():
+            if util >= cfg.defrag_util_threshold_percent / 100.0 or \
+                    util == 0.0:
+                continue
+            self.defrag_node(pool.name, node, cfg)
+
+    def defrag_node(self, pool_name: str, node: str, cfg=None) -> int:
+        """Migrate every workload off `node` if each fits elsewhere
+        (gpupool_defrag.go evict path).  Returns #evicted."""
+        pods = self.store.list(
+            Pod, selector=lambda p: p.spec.node_name == node)
+        evicted = 0
+        now = str(time.time())
+        for pod in pods:
+            req = compose_alloc_request(pod)
+            if req is None:
+                continue
+            if pod.metadata.annotations.get(
+                    constants.ANN_EVICTION_PROTECTION, "").lower() in (
+                        "true", "1"):
+                continue
+            # capacity-only dry-run (the pod's own quota is still
+            # committed, so a quota check would double-count it)
+            probe = compose_alloc_request(pod)
+            probe.pod_name += "-defrag-probe"
+            probe.excluded_nodes = list(set(probe.excluded_nodes) | {node})
+            try:
+                by_node, _ = self.allocator.check_quota_and_filter(
+                    probe, skip_quota=True)
+            except Exception:  # noqa: BLE001
+                by_node = {}
+            if not by_node:
+                # mark the skip (defrag-evict-skip bookkeeping)
+                tnode = self.store.try_get(TPUNode, node)
+                if tnode is not None:
+                    tnode.metadata.labels[constants.LABEL_DEFRAG_SKIP] = \
+                        "true"
+                    tnode.metadata.annotations[
+                        constants.ANN_DEFRAG_SKIP_REASON] = \
+                        f"{pod.key()} has no alternative placement"
+                    tnode.metadata.annotations[
+                        constants.ANN_DEFRAG_SKIP_SINCE] = now
+                    self.store.update(tnode)
+                continue
+            self._evict_for_defrag(pod, node, now)
+            evicted += 1
+        if evicted:
+            tnode = self.store.try_get(TPUNode, node)
+            if tnode is not None:
+                tnode.metadata.labels[constants.LABEL_DEFRAG_SOURCE] = "true"
+                tnode.metadata.annotations[
+                    constants.ANN_DEFRAG_SOURCE_SINCE] = now
+                tnode.metadata.annotations[
+                    constants.ANN_DEFRAG_SOURCE_POOL] = pool_name
+                self.store.update(tnode)
+        return evicted
+
+    def _evict_for_defrag(self, pod: Pod, node: str, now: str) -> None:
+        log.info("defrag: evicting %s from %s", pod.key(), node)
+        self.evicted_for_defrag.append(pod.key())
+        is_worker = pod.metadata.labels.get(constants.LABEL_COMPONENT) == \
+            constants.COMPONENT_WORKER
+        replacement = None
+        if is_worker:
+            # workers are recreated by their workload controller; stamp the
+            # drain exclusion on the workload so the replacement cannot
+            # rebind onto the node being drained (cleared after the TTL)
+            wl_name = pod.metadata.annotations.get(constants.ANN_WORKLOAD)
+            if wl_name:
+                wl = self.store.try_get(TPUWorkload, wl_name,
+                                        pod.metadata.namespace)
+                if wl is not None and node not in wl.spec.excluded_nodes:
+                    wl.spec.excluded_nodes.append(node)
+                    wl.metadata.annotations[
+                        constants.ANN_DEFRAG_EVICTED_SINCE] = now
+                    self.store.update(wl)
+        else:
+            # standalone pod: clone it with the node excluded so the
+            # scheduler rebinds elsewhere (workers are recreated by their
+            # workload controller)
+            replacement = Pod.new(pod.metadata.name,
+                                  namespace=pod.metadata.namespace)
+            replacement.metadata.labels = dict(pod.metadata.labels)
+            replacement.metadata.labels[constants.LABEL_DEFRAG_EVICTED] = \
+                "true"
+            ann = dict(pod.metadata.annotations)
+            for k in (constants.ANN_CHIP_IDS, constants.ANN_PARTITION_IDS,
+                      constants.ANN_POD_INDEX, constants.ANN_PORT_NUMBER):
+                ann.pop(k, None)
+            ann[constants.ANN_DEFRAG_EVICTED_SINCE] = now
+            ann[constants.ANN_EXCLUDED_NODES] = _merge_exclusions(
+                ann.get(constants.ANN_EXCLUDED_NODES, ""), node)
+            replacement.metadata.annotations = ann
+            replacement.spec = pod.spec.__class__(
+                containers=pod.spec.containers,
+                scheduler_name=pod.spec.scheduler_name,
+                priority=pod.spec.priority)
+        self.store.delete(Pod, pod.metadata.name, pod.metadata.namespace)
+        if replacement is not None:
+            self.store.create(replacement)
+
+    # -- compaction ---------------------------------------------------------
+
+    def _compact_pool(self, pool, cfg) -> None:
+        grace = self.empty_grace_override \
+            if self.empty_grace_override is not None \
+            else cfg.period_seconds
+        now = time.time()
+        for node, util in self._node_utilization(pool.name).items():
+            if util > 0.0:
+                self._empty_since.pop(node, None)
+                continue
+            since = self._empty_since.setdefault(node, now)
+            if now - since < grace:
+                continue
+            # keep at least one node in the pool
+            chips_by_node = {
+                c.chip.status.node_name
+                for c in self.allocator.chips(pool.name)}
+            if len(chips_by_node) <= 1:
+                continue
+            self._release_node(pool.name, node)
+
+    def _release_node(self, pool_name: str, node: str) -> None:
+        log.info("compaction: releasing empty node %s from pool %s",
+                 node, pool_name)
+        self.compacted_nodes.append(node)
+        for chip in self.store.list(
+                TPUChip, selector=lambda c: c.status.node_name == node):
+            try:
+                self.store.delete(TPUChip, chip.name)
+            except NotFoundError:
+                pass
+            self.allocator.remove_chip(chip.name)
+        for cls in (TPUNode, Node):
+            try:
+                self.store.delete(cls, node)
+            except NotFoundError:
+                pass
+        for claim in self.store.list(
+                TPUNodeClaim,
+                selector=lambda c: c.status.node_name == node):
+            try:
+                self.store.delete(TPUNodeClaim, claim.name)
+            except NotFoundError:
+                pass
+        self._empty_since.pop(node, None)
+
+    # -- shared -------------------------------------------------------------
+
+    def _node_utilization(self, pool: str) -> Dict[str, float]:
+        """node -> allocated/virtual-capacity fraction (tflops basis)."""
+        out: Dict[str, Dict[str, float]] = {}
+        for state in self.allocator.chips(pool):
+            node = state.chip.status.node_name
+            cap = state.virtual_capacity().tflops
+            used = cap - state.available().tflops
+            agg = out.setdefault(node, {"cap": 0.0, "used": 0.0})
+            agg["cap"] += cap
+            agg["used"] += used
+        return {node: (v["used"] / v["cap"] if v["cap"] else 0.0)
+                for node, v in out.items()}
+
+
+class LiveMigrator:
+    """Hot vTPU migration: snapshot on the source hypervisor, rebind the
+    pod elsewhere, restore on the target (SURVEY §5 checkpoint/resume)."""
+
+    def __init__(self, store, allocator):
+        self.store = store
+        self.allocator = allocator
+
+    def _hypervisor_url(self, node: str) -> str:
+        tnode = self.store.try_get(TPUNode, node)
+        return tnode.status.hypervisor_url if tnode is not None else ""
+
+    def _post(self, url: str) -> bool:
+        try:
+            req = urllib.request.Request(url, method="POST", data=b"{}")
+            urllib.request.urlopen(req, timeout=10)
+            return True
+        except Exception as e:  # noqa: BLE001
+            log.warning("migration hook %s failed: %s", url, e)
+            return False
+
+    def migrate(self, namespace: str, pod_name: str,
+                wait_rebind_s: float = 10.0) -> Optional[str]:
+        """Returns the new node name, or None on failure."""
+        pod = self.store.try_get(Pod, pod_name, namespace)
+        if pod is None or not pod.spec.node_name:
+            return None
+        source = pod.spec.node_name
+        key = f"{namespace}/{pod_name}"
+
+        # 0. placement dry-run: never kill a workload that has nowhere
+        #    else to go (capacity-only; eviction frees this pod's quota)
+        probe = compose_alloc_request(pod)
+        if probe is not None:
+            probe.pod_name += "-migrate-probe"
+            probe.excluded_nodes = list(set(probe.excluded_nodes)
+                                        | {source})
+            try:
+                by_node, _ = self.allocator.check_quota_and_filter(
+                    probe, skip_quota=True)
+            except Exception:  # noqa: BLE001
+                by_node = {}
+            if not by_node:
+                log.warning("migration of %s aborted: no alternative "
+                            "placement", key)
+                return None
+
+        # 1. freeze + snapshot on the source node (best effort when the
+        #    node has no live hypervisor, e.g. in the cluster sim)
+        hv = self._hypervisor_url(source)
+        record = self.allocator.allocation(key)
+        if hv:
+            self._post(f"{hv}/api/v1/workers/{namespace}/{pod_name}"
+                       f"/snapshot")
+        # mark chips as migrating
+        if record is not None:
+            for chip_name in record.chip_ids:
+                chip = self.store.try_get(TPUChip, chip_name)
+                if chip is not None:
+                    chip.status.phase = constants.PHASE_MIGRATING
+                    self.store.update(chip)
+
+        # 2. evict + recreate with the source node excluded
+        replacement = Pod.new(pod_name, namespace=namespace)
+        replacement.metadata.labels = dict(pod.metadata.labels)
+        ann = dict(pod.metadata.annotations)
+        for k in (constants.ANN_CHIP_IDS, constants.ANN_PARTITION_IDS,
+                  constants.ANN_POD_INDEX, constants.ANN_PORT_NUMBER):
+            ann.pop(k, None)
+        ann[constants.ANN_EXCLUDED_NODES] = _merge_exclusions(
+            ann.get(constants.ANN_EXCLUDED_NODES, ""), source)
+        replacement.metadata.annotations = ann
+        replacement.spec = pod.spec.__class__(
+            containers=pod.spec.containers,
+            scheduler_name=pod.spec.scheduler_name,
+            priority=pod.spec.priority)
+        self.store.delete(Pod, pod_name, namespace)
+        self.store.create(replacement)
+
+        # 3. wait for the rebind (chips restored to Running either way)
+        deadline = time.time() + wait_rebind_s
+        new_node = None
+        while time.time() < deadline:
+            cur = self.store.try_get(Pod, pod_name, namespace)
+            if cur is not None and cur.spec.node_name and \
+                    cur.spec.node_name != source:
+                new_node = cur.spec.node_name
+                break
+            time.sleep(0.05)
+        if record is not None:
+            for chip_name in record.chip_ids:
+                chip = self.store.try_get(TPUChip, chip_name)
+                if chip is not None and \
+                        chip.status.phase == constants.PHASE_MIGRATING:
+                    chip.status.phase = constants.PHASE_RUNNING
+                    self.store.update(chip)
+
+        # 4. restore + thaw on the target
+        if new_node:
+            target_hv = self._hypervisor_url(new_node)
+            if target_hv:
+                self._post(f"{target_hv}/api/v1/workers/{namespace}/"
+                           f"{pod_name}/resume")
+            log.info("migrated %s: %s -> %s", key, source, new_node)
+        return new_node

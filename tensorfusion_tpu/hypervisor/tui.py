@@ -1,0 +1,176 @@
+"""Hypervisor terminal UI.
+
+Analog of the reference's bubbletea TUI (``pkg/hypervisor/tui/``, 1850 LoC:
+device/worker/metrics views + shm inspector dialog).  Two layers:
+
+- a pure-text renderer (``render_*``) that produces the screens from a
+  hypervisor HTTP endpoint or live controllers — unit-testable and usable
+  for one-shot ``--once`` dumps;
+- a curses wrapper cycling the views (d=devices, w=workers, s=shm
+  inspector, q=quit) with periodic refresh.
+
+    python -m tensorfusion_tpu.hypervisor.tui --url http://127.0.0.1:8000
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+from typing import Dict, List, Optional
+
+from .. import constants
+from .limiter_binding import ShmView, list_worker_segments
+
+
+def _bar(frac: float, width: int = 20) -> str:
+    frac = max(0.0, min(1.0, frac))
+    fill = int(frac * width)
+    return "[" + "#" * fill + "-" * (width - fill) + f"] {frac*100:5.1f}%"
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit, mult in (("GiB", 2**30), ("MiB", 2**20), ("KiB", 2**10)):
+        if n >= mult:
+            return f"{n/mult:.1f}{unit}"
+    return f"{n:.0f}B"
+
+
+def render_devices(devices: List[dict]) -> str:
+    lines = ["CHIP                GEN   DUTY                        "
+             "HBM USED       POWER  TEMP  PARTS"]
+    for d in devices:
+        info, m = d.get("info", {}), d.get("metrics") or {}
+        duty = m.get("duty_cycle_pct", 0.0)
+        lines.append(
+            f"{info.get('chip_id',''):<19} {info.get('generation',''):<5} "
+            f"{_bar(duty/100.0)}  "
+            f"{_fmt_bytes(m.get('hbm_used_bytes', 0)):<13} "
+            f"{m.get('power_watts', 0):5.0f}W "
+            f"{m.get('temp_celsius', 0):4.0f}C  "
+            f"{len(d.get('partitions', []))}")
+    return "\n".join(lines)
+
+
+def render_workers(workers: List[dict]) -> str:
+    lines = ["WORKER                     ISO     QOS      DUTY   "
+             "HBM         PIDS  FROZEN"]
+    for w in workers:
+        spec, st = w.get("spec", {}), w.get("status", {})
+        key = f"{spec.get('namespace','')}/{spec.get('name','')}"
+        lines.append(
+            f"{key:<26} {spec.get('isolation',''):<7} "
+            f"{spec.get('qos',''):<8} "
+            f"{st.get('duty_cycle_pct', 0.0):5.1f}% "
+            f"{_fmt_bytes(st.get('hbm_used_bytes', 0)):<11} "
+            f"{len(st.get('pids', [])):<5} "
+            f"{'yes' if st.get('frozen') else 'no'}")
+    return "\n".join(lines)
+
+
+def render_shm(shm_base: str) -> str:
+    """The shm inspector dialog (shm_dialog.go analog): raw token-bucket
+    state of every worker segment."""
+    lines = []
+    for ns, pod, path in list_worker_segments(shm_base):
+        try:
+            state = ShmView(path).read()
+        except (ValueError, OSError) as e:
+            lines.append(f"{ns}/{pod}: unreadable ({e})")
+            continue
+        flags = "FROZEN" if state.frozen else (
+            "AUTO-FROZEN" if state.auto_frozen else "active")
+        lines.append(f"segment {ns}/{pod}  [{flags}]  "
+                     f"heartbeat={state.heartbeat_ts_s}  "
+                     f"pids={state.pids}")
+        for i, dev in enumerate(state.devices):
+            if not dev.active:
+                continue
+            cap = max(dev.capacity_mflop, 1)
+            lines.append(
+                f"  dev{i} {dev.chip_id:<18} duty={dev.duty_limit_bp/100:5.1f}% "
+                f"tokens={_bar(dev.tokens_mflop / cap, 12)} "
+                f"refill={dev.refill_mflop_per_s/1e3:.0f}GF/s "
+                f"launches={dev.launches} blocked={dev.blocked_events}")
+            lines.append(
+                f"       hbm {_fmt_bytes(dev.hbm_used_bytes)}/"
+                f"{_fmt_bytes(dev.hbm_limit_bytes) if dev.hbm_limit_bytes else 'inf'}"
+                f"  charged={dev.total_charged_mflop/1e3:.1f}GFLOP")
+    return "\n".join(lines) if lines else f"(no segments under {shm_base})"
+
+
+def _fetch(url: str, path: str):
+    with urllib.request.urlopen(url + path, timeout=5) as r:
+        return json.loads(r.read())
+
+
+def snapshot(url: str, shm_base: str = "") -> str:
+    """One-shot full dump (the --once mode)."""
+    out = ["== tpu-fusion hypervisor ==", ""]
+    try:
+        out.append(render_devices(_fetch(url, "/api/v1/devices")))
+        out.append("")
+        out.append(render_workers(_fetch(url, "/api/v1/workers")))
+    except Exception as e:  # noqa: BLE001
+        out.append(f"(hypervisor unreachable at {url}: {e})")
+    if shm_base:
+        out += ["", "-- shm inspector --", render_shm(shm_base)]
+    return "\n".join(out)
+
+
+def run_curses(url: str, shm_base: str, refresh_s: float = 1.0) -> None:
+    import curses
+
+    def main(scr):
+        curses.curs_set(0)
+        scr.nodelay(True)
+        view = "d"
+        while True:
+            ch = scr.getch()
+            if ch in (ord("q"), 27):
+                return
+            if ch in (ord("d"), ord("w"), ord("s")):
+                view = chr(ch)
+            try:
+                if view == "d":
+                    body = render_devices(_fetch(url, "/api/v1/devices"))
+                elif view == "w":
+                    body = render_workers(_fetch(url, "/api/v1/workers"))
+                else:
+                    body = render_shm(shm_base)
+            except Exception as e:  # noqa: BLE001
+                body = f"(error: {e})"
+            scr.erase()
+            header = ("tpu-fusion hypervisor  [d]evices [w]orkers "
+                      "[s]hm [q]uit")
+            try:
+                scr.addstr(0, 0, header, curses.A_REVERSE)
+                for i, line in enumerate(body.splitlines()):
+                    scr.addstr(i + 2, 0, line[:curses.COLS - 1])
+            except curses.error:
+                pass
+            scr.refresh()
+            time.sleep(refresh_s)
+
+    curses.wrapper(main)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tpf-hypervisor-tui")
+    ap.add_argument("--url", default="http://127.0.0.1:8000")
+    ap.add_argument("--shm-base",
+                    default=constants.DEFAULT_SHM_BASE)
+    ap.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit (no curses)")
+    args = ap.parse_args(argv)
+    if args.once or not sys.stdout.isatty():
+        print(snapshot(args.url, args.shm_base))
+        return 0
+    run_curses(args.url, args.shm_base)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

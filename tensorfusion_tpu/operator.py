@@ -30,6 +30,8 @@ from .controllers.core import (ChipController, ClusterController,
                                NodeController, PodController, PoolController,
                                ProviderConfigController, QuotaController,
                                WorkloadController)
+from .controllers.defrag import CompactionController, LiveMigrator
+from .controllers.rollout import RolloutController
 from .scheduler import GangManager, ICITopologyPlugin, Scheduler, TPUResourcesFit
 from .scheduler.expander import NodeExpander
 from .store import NotFoundError, ObjectStore
@@ -73,7 +75,13 @@ class Operator:
         self.manager = ControllerManager(self.store)
         self.providerconfig_ctrl = ProviderConfigController(
             self.allocator, self.parser)
+        self.compaction = CompactionController(self.store, self.allocator,
+                                               self.scheduler)
+        self.migrator = LiveMigrator(self.store, self.allocator)
+        self.rollout = RolloutController(self.store)
         for ctrl in (
+                self.compaction,
+                self.rollout,
                 ClusterController(self.store),
                 PoolController(self.store, self.allocator),
                 ChipController(self.allocator,

@@ -82,6 +82,9 @@ def compose_alloc_request(pod: Pod) -> Optional[AllocRequest]:
         generation=ann.get(constants.ANN_CHIP_GENERATION, ""),
         vendor=ann.get(constants.ANN_VENDOR, ""),
         chip_indices=indices,
+        excluded_nodes=[n for n in
+                        ann.get(constants.ANN_EXCLUDED_NODES, "").split(",")
+                        if n],
         isolation=ann.get(constants.ANN_ISOLATION,
                           constants.DEFAULT_ISOLATION),
         qos=ann.get(constants.ANN_QOS, constants.DEFAULT_QOS),

@@ -1,0 +1,157 @@
+"""Defragmentation, node compaction, and live migration tests
+(gpupool_defrag + compaction + snapshot/resume flows, SURVEY §2.2/§5)."""
+
+import time
+
+import pytest
+
+from tensorfusion_tpu import constants
+from tensorfusion_tpu.api.types import (Container, Pod, TPUChip, TPUNode,
+                                        TPUNodeClaim, TPUPool)
+from tensorfusion_tpu.operator import Operator
+
+
+def make_operator(hosts=2, compaction=False, grace_s=0.3):
+    op = Operator()
+    pool = TPUPool.new("pool-a")
+    pool.spec.name = "pool-a"
+    if compaction:
+        pool.spec.compaction.enabled = True
+        pool.spec.compaction.period_seconds = grace_s
+        pool.spec.compaction.defrag_util_threshold_percent = 30.0
+    op.store.create(pool)
+    for i in range(hosts):
+        claim = TPUNodeClaim.new(f"host-{i}")
+        claim.spec.pool = "pool-a"
+        claim.spec.generation = "v5e"
+        claim.spec.chip_count = 4
+        op.store.create(claim)
+    op.start()
+    deadline = time.time() + 5
+    while len(op.allocator.chips()) < hosts * 4 and time.time() < deadline:
+        time.sleep(0.02)
+    return op
+
+
+def submit(op, name, tflops=50.0, hbm=2 * 2**30, node=None, protect=False):
+    pod = Pod.new(name, namespace="default")
+    ann = pod.metadata.annotations
+    ann[constants.ANN_POOL] = "pool-a"
+    ann[constants.ANN_TFLOPS_REQUEST] = str(tflops)
+    ann[constants.ANN_HBM_REQUEST] = str(hbm)
+    ann[constants.ANN_IS_LOCAL_TPU] = "true"
+    if node:
+        ann[constants.ANN_CHIP_INDICES] = ""  # unused; placement via indices
+    if protect:
+        ann[constants.ANN_EVICTION_PROTECTION] = "true"
+    pod.spec.containers = [Container(name="main")]
+    op.submit_pod(pod)
+    bound = op.wait_for_binding(name)
+    assert bound is not None
+    return bound
+
+
+def test_defrag_migrates_pods_off_low_util_node():
+    op = make_operator(hosts=2)
+    try:
+        # two pods; force them onto different nodes via exclusion
+        p1 = submit(op, "busy")
+        node1 = p1.spec.node_name
+        pod = Pod.new("lonely", namespace="default")
+        ann = pod.metadata.annotations
+        ann[constants.ANN_POOL] = "pool-a"
+        ann[constants.ANN_TFLOPS_REQUEST] = "10"
+        ann[constants.ANN_HBM_REQUEST] = str(2**30)
+        ann[constants.ANN_IS_LOCAL_TPU] = "true"
+        ann[constants.ANN_EXCLUDED_NODES] = node1
+        pod.spec.containers = [Container(name="main")]
+        op.submit_pod(pod)
+        bound = op.wait_for_binding("lonely")
+        node2 = bound.spec.node_name
+        assert node2 != node1
+
+        # drop the placement-forcing exclusion so node1 is a legal target
+        lonely = op.store.get(Pod, "lonely", "default")
+        del lonely.metadata.annotations[constants.ANN_EXCLUDED_NODES]
+        op.store.update(lonely)
+
+        # node2 runs only the tiny pod -> low utilization -> defrag it
+        evicted = op.compaction.defrag_node("pool-a", node2)
+        assert evicted == 1
+        deadline = time.time() + 5
+        moved = None
+        while time.time() < deadline:
+            moved = op.store.try_get(Pod, "lonely", "default")
+            if moved is not None and moved.spec.node_name == node1:
+                break
+            time.sleep(0.05)
+        assert moved is not None and moved.spec.node_name == node1
+        assert moved.metadata.labels[constants.LABEL_DEFRAG_EVICTED] == \
+            "true"
+        tnode = op.store.get(TPUNode, node2)
+        assert tnode.metadata.labels.get(constants.LABEL_DEFRAG_SOURCE) == \
+            "true"
+    finally:
+        op.stop()
+
+
+def test_defrag_respects_eviction_protection_and_no_alternative():
+    op = make_operator(hosts=1)  # single node: nothing can move anywhere
+    try:
+        p = submit(op, "pinned", tflops=20.0)
+        node = p.spec.node_name
+        evicted = op.compaction.defrag_node("pool-a", node)
+        assert evicted == 0
+        assert op.store.try_get(Pod, "pinned", "default") is not None
+        tnode = op.store.get(TPUNode, node)
+        assert tnode.metadata.labels.get(constants.LABEL_DEFRAG_SKIP) == \
+            "true"
+    finally:
+        op.stop()
+
+
+def test_compaction_releases_empty_node():
+    op = make_operator(hosts=2, compaction=True, grace_s=0.2)
+    try:
+        p = submit(op, "anchor")  # keeps one node busy
+        busy = p.spec.node_name
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            nodes = {c.chip.status.node_name
+                     for c in op.allocator.chips("pool-a")}
+            if len(nodes) == 1:
+                break
+            time.sleep(0.1)
+        nodes = {c.chip.status.node_name
+                 for c in op.allocator.chips("pool-a")}
+        assert nodes == {busy}
+        assert len(op.allocator.chips("pool-a")) == 4
+        assert op.compaction.compacted_nodes
+        # the busy node must never be compacted
+        assert busy not in op.compaction.compacted_nodes
+    finally:
+        op.stop()
+
+
+def test_live_migration_moves_pod_and_cycles_chip_phase():
+    op = make_operator(hosts=2)
+    try:
+        p = submit(op, "hot", tflops=30.0)
+        source = p.spec.node_name
+        rec = op.allocator.allocation("default/hot")
+        chips_before = list(rec.chip_ids)
+
+        new_node = op.migrator.migrate("default", "hot")
+        assert new_node is not None and new_node != source
+        moved = op.store.get(Pod, "hot", "default")
+        assert moved.spec.node_name == new_node
+        rec2 = op.allocator.allocation("default/hot")
+        assert rec2 is not None
+        assert all(op.allocator.get_chip(c).chip.status.node_name
+                   == new_node for c in rec2.chip_ids)
+        # old chips restored to Running phase
+        for name in chips_before:
+            chip = op.store.get(TPUChip, name)
+            assert chip.status.phase == constants.PHASE_RUNNING
+    finally:
+        op.stop()

@@ -1,0 +1,151 @@
+"""Rolling updates, global-config hot reload, chip info DB, TUI renderers
+(internal/component, internal/config, pkg/hypervisor/tui analogs)."""
+
+import json
+import time
+
+import pytest
+
+from tensorfusion_tpu import constants
+from tensorfusion_tpu.api import ResourceAmount
+from tensorfusion_tpu.api.types import TPUNodeClaim, TPUPool, TPUWorkload
+from tensorfusion_tpu.config import (GlobalConfigWatcher, chip_info,
+                                     mock_chip_info)
+from tensorfusion_tpu.controllers.rollout import component_hash
+from tensorfusion_tpu.hypervisor.tui import (render_devices, render_shm,
+                                             render_workers, snapshot)
+from tensorfusion_tpu.operator import Operator
+
+
+def test_chip_info_db():
+    v5e = chip_info("v5e")
+    assert v5e.bf16_tflops == 197.0 and v5e.hbm_bytes == 16 << 30
+    assert chip_info("v99") is None
+    assert "v5p" in mock_chip_info()
+
+
+def test_global_config_hot_reload(tmp_path):
+    path = tmp_path / "config.json"
+    path.write_text(json.dumps({"metrics_interval_s": 9.0,
+                                "default_pool": "pool-z"}))
+    w = GlobalConfigWatcher(str(path), poll_interval_s=0.05)
+    assert w.config.metrics_interval_s == 9.0
+    assert w.config.default_pool == "pool-z"
+
+    seen = []
+    w.on_change(lambda cfg: seen.append(cfg.metrics_interval_s))
+    w.start()
+    try:
+        time.sleep(0.1)
+        path.write_text(json.dumps({"metrics_interval_s": 3.0}))
+        deadline = time.time() + 3
+        while not seen and time.time() < deadline:
+            time.sleep(0.05)
+        assert seen and seen[-1] == 3.0
+        # corrupt file: previous config kept
+        path.write_text("{not json")
+        time.sleep(0.3)
+        assert w.config.metrics_interval_s == 3.0
+    finally:
+        w.stop()
+
+
+def test_rollout_recycles_outdated_workers():
+    op = Operator()
+    pool = TPUPool.new("pool-a")
+    pool.spec.name = "pool-a"
+    pool.spec.components.batch_percent = 50
+    pool.spec.components.batch_interval_seconds = 0.0
+    op.store.create(pool)
+    claim = TPUNodeClaim.new("h0")
+    claim.spec.pool = "pool-a"
+    claim.spec.generation = "v5e"
+    claim.spec.chip_count = 8
+    op.store.create(claim)
+
+    op.start()
+    rollout = op.rollout
+    try:
+        wl = TPUWorkload.new("svc", namespace="default")
+        wl.spec.pool = "pool-a"
+        wl.spec.replicas = 2
+        wl.spec.resources.requests = ResourceAmount(tflops=10.0,
+                                                    hbm_bytes=2**30)
+        wl.spec.resources.limits = wl.spec.resources.requests
+        op.store.create(wl)
+
+        from tensorfusion_tpu.api.types import Pod
+
+        def running_workers():
+            return [p for p in op.store.list(Pod, namespace="default")
+                    if p.metadata.labels.get(constants.LABEL_COMPONENT)
+                    == constants.COMPONENT_WORKER and p.spec.node_name]
+
+        deadline = time.time() + 8
+        while len(running_workers()) < 2 and time.time() < deadline:
+            time.sleep(0.05)
+        workers = running_workers()
+        assert len(workers) == 2
+        old_hash = component_hash(pool.spec.components)
+        assert all(p.metadata.labels[constants.LABEL_POD_TEMPLATE_HASH]
+                   == old_hash for p in workers)
+        old_uids = {p.metadata.uid for p in workers}
+
+        # bump the worker image -> new hash -> batch recycle
+        pool2 = op.store.get(TPUPool, "pool-a")
+        pool2.spec.components.worker_image = "tpufusion/worker:v2"
+        op.store.update(pool2)
+        new_hash = component_hash(pool2.spec.components)
+        assert new_hash != old_hash
+
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            workers = running_workers()
+            if len(workers) == 2 and all(
+                    p.metadata.labels[constants.LABEL_POD_TEMPLATE_HASH]
+                    == new_hash for p in workers):
+                break
+            time.sleep(0.1)
+        workers = running_workers()
+        assert all(p.metadata.labels[constants.LABEL_POD_TEMPLATE_HASH]
+                   == new_hash for p in workers), \
+            [p.metadata.labels for p in workers]
+        assert {p.metadata.uid for p in workers}.isdisjoint(old_uids)
+        assert len(rollout.recycled) >= 2
+    finally:
+        op.stop()
+
+
+def test_tui_renderers(tmp_path):
+    devices = [{"info": {"chip_id": "v5e-c0", "generation": "v5e"},
+                "metrics": {"duty_cycle_pct": 62.5,
+                            "hbm_used_bytes": 8 * 2**30,
+                            "power_watts": 180.0, "temp_celsius": 55.0},
+                "partitions": []}]
+    out = render_devices(devices)
+    assert "v5e-c0" in out and "62.5%" in out and "8.0GiB" in out
+
+    workers = [{"spec": {"namespace": "ml", "name": "w0",
+                         "isolation": "soft", "qos": "high"},
+                "status": {"duty_cycle_pct": 41.0,
+                           "hbm_used_bytes": 2**30, "pids": [1, 2],
+                           "frozen": False}}]
+    out = render_workers(workers)
+    assert "ml/w0" in out and "41.0%" in out and "no" in out
+
+    # shm inspector against a real segment
+    from tensorfusion_tpu.hypervisor import DeviceQuota, Limiter
+    from tensorfusion_tpu.testing import fresh_library
+    import pathlib
+    lib = str(pathlib.Path("native/build/libtpf_limiter.so").resolve())
+    host = Limiter(fresh_library(lib, "tui"))
+    base = str(tmp_path / "shm")
+    host.init(base)
+    host.create_worker("ns", "w", [DeviceQuota(0, "chipX", 2500, 2**30,
+                                               1000, 500)])
+    out = render_shm(base)
+    assert "ns/w" in out and "chipX" in out and "25.0%" in out
+
+    # unreachable hypervisor: snapshot degrades gracefully
+    out = snapshot("http://127.0.0.1:1", base)
+    assert "unreachable" in out and "ns/w" in out
