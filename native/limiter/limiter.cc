@@ -294,8 +294,16 @@ TPF_API tpf_status_t tfl_init(const char* shm_base_path) {
   std::lock_guard<std::mutex> lk(g_mu);
   if (!shm_base_path) return TPF_ERR_INVALID_ARG;
   g_base_path = shm_base_path;
-  if (mkdir(shm_base_path, 0777) != 0 && errno != EEXIST)
-    return TPF_ERR_FAILED;
+  // recursive mkdir -p: the base may be nested (/run/tpu-fusion/shm)
+  std::string partial;
+  for (size_t i = 0; i <= g_base_path.size(); ++i) {
+    if (i == g_base_path.size() || g_base_path[i] == '/') {
+      if (!partial.empty() &&
+          mkdir(partial.c_str(), 0777) != 0 && errno != EEXIST)
+        return TPF_ERR_FAILED;
+    }
+    if (i < g_base_path.size()) partial += g_base_path[i];
+  }
   g_host_inited = true;
   return TPF_OK;
 }

@@ -20,6 +20,7 @@ Analogs of the reference's heaviest lifecycle machinery:
 from __future__ import annotations
 
 import logging
+import threading
 import time
 import urllib.request
 from typing import Dict, List, Optional
@@ -38,6 +39,18 @@ def _merge_exclusions(existing: str, node: str) -> str:
     if node not in nodes:
         nodes.append(node)
     return ",".join(nodes)
+
+
+def _clone_pod_spec(spec):
+    """Replacement pods must keep every scheduling-relevant field of the
+    original spec except the binding itself."""
+    return spec.__class__(
+        containers=spec.containers,
+        init_containers=spec.init_containers,
+        node_selector=dict(spec.node_selector),
+        scheduler_name=spec.scheduler_name,
+        priority=spec.priority,
+        preemption_policy=spec.preemption_policy)
 
 log = logging.getLogger("tpf.controller.defrag")
 
@@ -58,41 +71,70 @@ class CompactionController(Controller):
         self.evicted_for_defrag: List[str] = []
         self.compacted_nodes: List[str] = []
 
+    DEFAULT_EVICTION_TTL_S = 600.0
+
     def reconcile(self, event):
         from ..api.types import TPUPool
 
-        for pool in self.store.list(TPUPool):
+        pools = self.store.list(TPUPool)
+        for pool in pools:
             cfg = pool.spec.compaction
             if not cfg.enabled:
                 continue
             self._compact_pool(pool, cfg)
-            self._expire_drain_marks(cfg)
             if self._defrag_due(pool.name, cfg):
                 self._defrag_pool(pool, cfg)
+        # one cluster-wide expiry pass, each object judged by ITS pool's TTL
+        ttls = {p.name: p.spec.compaction.defrag_eviction_ttl_seconds
+                for p in pools if p.spec.compaction.enabled}
+        if ttls:
+            self._expire_drain_marks(ttls)
 
-    def _expire_drain_marks(self, cfg) -> None:
-        """Clear workload exclusions + defrag-source labels once the
-        eviction TTL lapses (gpupool_defrag TTL bookkeeping analog)."""
+    def _expire_drain_marks(self, ttls: Dict[str, float]) -> None:
+        """Clear drain bookkeeping (workload/pod exclusions, defrag-source
+        and defrag-skip node marks) once the owning pool's eviction TTL
+        lapses (gpupool_defrag TTL bookkeeping analog)."""
         now = time.time()
-        ttl = cfg.defrag_eviction_ttl_seconds
+
+        def ttl_for(pool: str) -> float:
+            return ttls.get(pool, self.DEFAULT_EVICTION_TTL_S)
+
         for wl in self.store.list(TPUWorkload):
             since = wl.metadata.annotations.get(
                 constants.ANN_DEFRAG_EVICTED_SINCE)
             if not since or not wl.spec.excluded_nodes:
                 continue
-            if now - float(since) >= ttl:
+            if now - float(since) >= ttl_for(wl.spec.pool):
                 wl.spec.excluded_nodes = []
                 del wl.metadata.annotations[
                     constants.ANN_DEFRAG_EVICTED_SINCE]
                 self.store.update(wl)
+        for pod in self.store.list(Pod):
+            ann = pod.metadata.annotations
+            since = ann.get(constants.ANN_DEFRAG_EVICTED_SINCE)
+            if not since or constants.ANN_EXCLUDED_NODES not in ann:
+                continue
+            if now - float(since) >= ttl_for(
+                    ann.get(constants.ANN_POOL, "")):
+                del ann[constants.ANN_EXCLUDED_NODES]
+                del ann[constants.ANN_DEFRAG_EVICTED_SINCE]
+                self.store.update(pod)
         for tnode in self.store.list(TPUNode):
-            since = tnode.metadata.annotations.get(
-                constants.ANN_DEFRAG_SOURCE_SINCE)
-            if since and now - float(since) >= ttl:
+            ann = tnode.metadata.annotations
+            pool = ann.get(constants.ANN_DEFRAG_SOURCE_POOL,
+                           tnode.spec.pool)
+            since = ann.get(constants.ANN_DEFRAG_SOURCE_SINCE)
+            if since and now - float(since) >= ttl_for(pool):
                 tnode.metadata.labels.pop(constants.LABEL_DEFRAG_SOURCE,
                                           None)
-                del tnode.metadata.annotations[
-                    constants.ANN_DEFRAG_SOURCE_SINCE]
+                del ann[constants.ANN_DEFRAG_SOURCE_SINCE]
+                self.store.update(tnode)
+            skip_since = ann.get(constants.ANN_DEFRAG_SKIP_SINCE)
+            if skip_since and now - float(skip_since) >= ttl_for(
+                    tnode.spec.pool):
+                tnode.metadata.labels.pop(constants.LABEL_DEFRAG_SKIP, None)
+                ann.pop(constants.ANN_DEFRAG_SKIP_REASON, None)
+                del ann[constants.ANN_DEFRAG_SKIP_SINCE]
                 self.store.update(tnode)
 
     # -- defrag ------------------------------------------------------------
@@ -122,8 +164,8 @@ class CompactionController(Controller):
         evicted = 0
         now = str(time.time())
         for pod in pods:
-            req = compose_alloc_request(pod)
-            if req is None:
+            probe = compose_alloc_request(pod)
+            if probe is None:
                 continue
             if pod.metadata.annotations.get(
                     constants.ANN_EVICTION_PROTECTION, "").lower() in (
@@ -131,7 +173,6 @@ class CompactionController(Controller):
                 continue
             # capacity-only dry-run (the pod's own quota is still
             # committed, so a quota check would double-count it)
-            probe = compose_alloc_request(pod)
             probe.pod_name += "-defrag-probe"
             probe.excluded_nodes = list(set(probe.excluded_nodes) | {node})
             try:
@@ -201,10 +242,7 @@ class CompactionController(Controller):
             ann[constants.ANN_EXCLUDED_NODES] = _merge_exclusions(
                 ann.get(constants.ANN_EXCLUDED_NODES, ""), node)
             replacement.metadata.annotations = ann
-            replacement.spec = pod.spec.__class__(
-                containers=pod.spec.containers,
-                scheduler_name=pod.spec.scheduler_name,
-                priority=pod.spec.priority)
+            replacement.spec = _clone_pod_spec(pod.spec)
         self.store.delete(Pod, pod.metadata.name, pod.metadata.namespace)
         if replacement is not None:
             self.store.create(replacement)
@@ -344,10 +382,7 @@ class LiveMigrator:
         ann[constants.ANN_EXCLUDED_NODES] = _merge_exclusions(
             ann.get(constants.ANN_EXCLUDED_NODES, ""), source)
         replacement.metadata.annotations = ann
-        replacement.spec = pod.spec.__class__(
-            containers=pod.spec.containers,
-            scheduler_name=pod.spec.scheduler_name,
-            priority=pod.spec.priority)
+        replacement.spec = _clone_pod_spec(pod.spec)
         self.store.delete(Pod, pod_name, namespace)
         self.store.create(replacement)
 
@@ -371,9 +406,39 @@ class LiveMigrator:
 
         # 4. restore + thaw on the target
         if new_node:
-            target_hv = self._hypervisor_url(new_node)
-            if target_hv:
-                self._post(f"{target_hv}/api/v1/workers/{namespace}/"
-                           f"{pod_name}/resume")
+            self._resume_on(new_node, namespace, pod_name)
             log.info("migrated %s: %s -> %s", key, source, new_node)
+        else:
+            # rebind is taking longer than the synchronous window; keep
+            # watching in the background so the snapshot is still restored
+            # once the pod lands (the caller sees None = "not yet bound")
+            log.warning("migration of %s: rebind pending past %ss; "
+                        "deferring restore", key, wait_rebind_s)
+            t = threading.Thread(
+                target=self._deferred_resume,
+                args=(namespace, pod_name, source), daemon=True,
+                name=f"tpf-migrate-{pod_name}")
+            t.start()
         return new_node
+
+    def _resume_on(self, node: str, namespace: str, pod_name: str) -> None:
+        target_hv = self._hypervisor_url(node)
+        if target_hv:
+            self._post(f"{target_hv}/api/v1/workers/{namespace}/"
+                       f"{pod_name}/resume")
+
+    def _deferred_resume(self, namespace: str, pod_name: str,
+                         source: str, deadline_s: float = 120.0) -> None:
+        deadline = time.time() + deadline_s
+        while time.time() < deadline:
+            cur = self.store.try_get(Pod, pod_name, namespace)
+            if cur is None:
+                return
+            if cur.spec.node_name and cur.spec.node_name != source:
+                self._resume_on(cur.spec.node_name, namespace, pod_name)
+                log.info("deferred migration restore of %s/%s on %s",
+                         namespace, pod_name, cur.spec.node_name)
+                return
+            time.sleep(0.5)
+        log.error("migration of %s/%s never rebound within %ss; snapshot "
+                  "left on disk", namespace, pod_name, deadline_s)

@@ -53,20 +53,12 @@ class RolloutController(Controller):
                     == pool.name
                     and p.metadata.labels.get(constants.LABEL_COMPONENT)
                     == constants.COMPONENT_WORKER))
-            # stamp current-hash pods (new workers get the live hash)
-            outdated = []
-            for pod in pods:
-                h = pod.metadata.labels.get(
-                    constants.LABEL_POD_TEMPLATE_HASH)
-                if h is None:
-                    pod.metadata.labels[
-                        constants.LABEL_POD_TEMPLATE_HASH] = target
-                    try:
-                        self.store.update(pod)
-                    except NotFoundError:
-                        pass
-                elif h != target:
-                    outdated.append(pod)
+            # a pod without a hash label has unknown provenance — treat it
+            # as outdated rather than asserting it matches the live config
+            outdated = [
+                pod for pod in pods
+                if pod.metadata.labels.get(
+                    constants.LABEL_POD_TEMPLATE_HASH) != target]
             if not outdated:
                 pool.status.component_status["worker"] = f"Ready@{target}"
                 try:

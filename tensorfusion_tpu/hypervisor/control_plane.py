@@ -1,0 +1,205 @@
+"""Control-plane hypervisor backend.
+
+Analog of the reference's kubernetes backend
+(``pkg/hypervisor/backend/kubernetes/``): where that backend watches the
+kubelet pod cache and **writes GPU CRs** (capacity, topology, capability
+annotations — kubernetes_backend.go:302-447), this backend connects the
+node agent to the tpu-fusion control plane:
+
+- on start it publishes the node (Node + TPUNode with the hypervisor URL)
+  and every discovered chip as TPUChip objects — capacity, ICI mesh
+  coordinates + links, capabilities — which is how chips enter the
+  allocator's inventory;
+- it watches Pod events and turns pods *bound to this node* with chip-id
+  annotations into worker add/remove calls (the pod-cache informer
+  analog, pod_cache.go);
+- a status loop writes live chip metrics back onto the TPUChip objects.
+
+This closes the platform loop end to end: webhook -> scheduler -> bound
+pod -> this backend -> allocation controller -> shm limiter -> client.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict, List, Optional
+
+from .. import constants
+from ..api.resources import ResourceAmount, parse_quantity
+from ..api.types import ICILink, MeshCoords, Node, Pod, TPUChip, TPUNode
+from ..store import ADDED, DELETED, MODIFIED, ObjectStore
+from .device import DeviceController
+from .framework import Backend, ProcessMapping, WorkerDeviceRequest, WorkerSpec
+
+log = logging.getLogger("tpf.hypervisor.control_plane")
+
+
+class ControlPlaneBackend(Backend):
+    def __init__(self, store: ObjectStore, devices: DeviceController,
+                 node_name: str, pool: str = "",
+                 hypervisor_url: str = "", vendor: str = "mock-tpu"):
+        self.store = store
+        self.devices = devices
+        self.node_name = node_name
+        self.pool = pool
+        self.hypervisor_url = hypervisor_url
+        self.vendor = vendor
+        self._on_added: Optional[Callable[[WorkerSpec], None]] = None
+        self._on_removed: Optional[Callable[[str], None]] = None
+        self._watch = None
+        self._thread: Optional[threading.Thread] = None
+        self._status_thread: Optional[threading.Thread] = None
+        self._known_workers: set = set()
+        self._stop = threading.Event()
+
+    # -- Backend ----------------------------------------------------------
+
+    def start(self, on_worker_added, on_worker_removed) -> None:
+        self._on_added = on_worker_added
+        self._on_removed = on_worker_removed
+        self._stop.clear()
+        self.register_node()
+        self.publish_chips()
+        self._watch = self.store.watch("Pod")
+        self._thread = threading.Thread(target=self._pod_loop,
+                                        name="tpf-cp-backend", daemon=True)
+        self._thread.start()
+        self._status_thread = threading.Thread(
+            target=self._status_loop, name="tpf-cp-status", daemon=True)
+        self._status_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._watch is not None:
+            self._watch.stop()
+        if self._thread:
+            self._thread.join(timeout=2)
+        if self._status_thread:
+            self._status_thread.join(timeout=2)
+
+    def _status_loop(self, interval_s: float = 30.0) -> None:
+        """Periodic inventory/status writeback (GPU CR update loop analog)."""
+        while not self._stop.wait(interval_s):
+            try:
+                self.publish_chips()
+            except Exception:
+                log.exception("chip status writeback failed")
+
+    def resolve_process(self, pid: int) -> Optional[ProcessMapping]:
+        return None  # PIDs are registered via POST /process in this mode
+
+    # -- node / chip publication (kubernetes_backend.go:302-447 analog) ---
+
+    def register_node(self) -> None:
+        node = Node.new(self.node_name)
+        node.status.phase = constants.PHASE_RUNNING
+        self.store.update_or_create(node)
+        tnode = self.store.try_get(TPUNode, self.node_name)
+        if tnode is None:
+            tnode = TPUNode.new(self.node_name)
+        tnode.spec.pool = self.pool
+        tnode.status.phase = constants.PHASE_RUNNING
+        tnode.status.hypervisor_ready = True
+        tnode.status.hypervisor_url = self.hypervisor_url
+        self.store.update_or_create(tnode)
+
+    def publish_chips(self) -> None:
+        topo = self.devices.topology()
+        for entry in self.devices.devices():
+            info = entry.info
+            chip = self.store.try_get(TPUChip, info.chip_id) or \
+                TPUChip.new(info.chip_id)
+            st = chip.status
+            cap = ResourceAmount(tflops=info.peak_bf16_tflops,
+                                 duty_percent=100.0,
+                                 hbm_bytes=float(info.hbm_bytes))
+            first_publish = st.capacity.tflops == 0
+            st.capacity = cap
+            if first_publish:
+                st.available = cap
+            # never stomp a live-migration phase from the status loop
+            if st.phase != constants.PHASE_MIGRATING:
+                st.phase = constants.PHASE_RUNNING
+            st.generation = info.generation
+            st.vendor = self.vendor
+            st.node_name = self.node_name
+            st.pool = self.pool
+            st.slice_id = info.slice_id
+            st.host_index = info.host_index
+            st.numa_node = info.numa_node
+            st.core_count = info.core_count
+            st.mesh = MeshCoords(*info.mesh)
+            st.capabilities = dict(info.caps)
+            if topo is not None and info.chip_id in topo.links:
+                st.ici_links = [
+                    ICILink(peer_chip_id=l.peer_chip_id,
+                            peer_index=l.peer_index, kind=l.kind,
+                            hops=l.hops, gbps=l.gbps)
+                    for l in topo.links[info.chip_id]]
+            self.store.update_or_create(chip)
+        log.info("published %d chips for node %s",
+                 len(self.devices.devices()), self.node_name)
+
+    # -- pod watch (pod_cache informer analog) ----------------------------
+
+    def _pod_loop(self) -> None:
+        for event in self._watch:
+            if self._stop.is_set():
+                return
+            try:
+                self._handle_pod(event)
+            except Exception:
+                log.exception("pod event handling failed")
+
+    def _handle_pod(self, event) -> None:
+        pod: Pod = event.obj
+        key = pod.key()
+        ann = pod.metadata.annotations
+        mine = (pod.spec.node_name == self.node_name
+                and ann.get(constants.ANN_CHIP_IDS))
+        if event.type == DELETED or not mine:
+            if key in self._known_workers:
+                self._known_workers.discard(key)
+                if self._on_removed:
+                    self._on_removed(key)
+            return
+        if key in self._known_workers:
+            return
+        self._known_workers.add(key)
+        spec = self._worker_spec(pod)
+        if self._on_added:
+            self._on_added(spec)
+
+    def _worker_spec(self, pod: Pod) -> WorkerSpec:
+        ann = pod.metadata.annotations
+        chip_ids = [c for c in
+                    ann.get(constants.ANN_CHIP_IDS, "").split(",") if c]
+        tflops = parse_quantity(ann.get(constants.ANN_TFLOPS_REQUEST, 0)
+                                or 0)
+        hbm = int(parse_quantity(ann.get(constants.ANN_HBM_REQUEST, 0) or 0))
+        duty = float(ann.get(constants.ANN_DUTY_REQUEST, 0) or 0)
+        devices = []
+        for chip_id in chip_ids:
+            entry = self.devices.get(chip_id)
+            if duty <= 0 and entry is not None and \
+                    entry.info.peak_bf16_tflops > 0:
+                duty_pct = min(100.0,
+                               tflops / entry.info.peak_bf16_tflops * 100.0)
+            else:
+                duty_pct = duty or 100.0
+            devices.append(WorkerDeviceRequest(
+                chip_id=chip_id, duty_percent=duty_pct, hbm_bytes=hbm,
+                partition_template=ann.get(constants.ANN_PARTITION_NAME,
+                                           "")))
+        return WorkerSpec(
+            namespace=pod.metadata.namespace, name=pod.metadata.name,
+            isolation=ann.get(constants.ANN_ISOLATION,
+                              constants.DEFAULT_ISOLATION),
+            qos=ann.get(constants.ANN_QOS, constants.DEFAULT_QOS),
+            devices=devices)
+
+    # -- status writeback -------------------------------------------------
+
+    def publish_device_status(self, devices: List[dict]) -> None:
+        self.publish_chips()

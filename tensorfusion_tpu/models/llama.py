@@ -40,7 +40,7 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
-    attn_impl: str = "full"          # "full" | "ring"
+    attn_impl: str = "full"          # "full" | "ring" | "flash" (pallas)
     remat: bool = False
 
     @property
@@ -155,6 +155,10 @@ def _attention(config: LlamaConfig, p, x,
 
     if config.attn_impl == "ring" and mesh is not None:
         out = ring_attention_sharded(q, k, v, mesh)
+    elif config.attn_impl == "flash":
+        from ..ops import flash_attention
+
+        out = flash_attention(q, k, v, causal=True)
     else:
         scale = hd ** -0.5
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale

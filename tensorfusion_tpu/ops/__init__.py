@@ -1,0 +1,3 @@
+"""TPU kernels (Pallas) for hosted-workload hot ops."""
+
+from .flash_attention import flash_attention

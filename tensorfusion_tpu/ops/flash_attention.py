@@ -1,0 +1,135 @@
+"""Pallas flash attention for TPU.
+
+The hosted-workload hot op: blockwise causal attention computed entirely in
+VMEM with online softmax, so the [T, T] score matrix never touches HBM —
+the kernel streams K/V blocks through the MXU against a resident Q block
+(Dao et al., FlashAttention, arXiv:2205.14135; TPU kernel structure per
+/opt/skills/guides/pallas_guide.md).
+
+Layout: inputs are [BH, T, D] (batch*heads folded), grid =
+(BH, T // BLOCK_Q); each program owns one Q block and loops over K/V
+blocks with running max/denominator accumulators in f32.
+
+``flash_attention`` dispatches:
+- real TPU           -> compiled Pallas kernel;
+- tests / CPU        -> the same kernel under ``interpret=True``;
+- fallback           -> plain jnp reference (identical semantics).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+BLOCK_Q = 128
+BLOCK_K = 128
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
+                 block_k: int):
+    """One (bh, q-block) program: online-softmax over all K/V blocks."""
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)           # [BLOCK_Q, D]
+    t_total = k_ref.shape[1]
+    q_offset = qi * q.shape[0]
+
+    def body(start, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(start * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(start * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = q_offset + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = start * block_k + lax.broadcasted_iota(jnp.int32,
+                                                           s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l * corr + p.sum(axis=1)
+        acc_new = acc * corr[:, None] + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    n_blocks = t_total // block_k
+    if causal:
+        # blocks fully in the future contribute nothing; stop at the
+        # diagonal block of this Q block
+        n_blocks = jnp.minimum(
+            n_blocks, (q_offset + q.shape[0] + block_k - 1) // block_k)
+    m0 = jnp.full((q.shape[0],), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((q.shape[0],), jnp.float32)
+    acc0 = jnp.zeros(q.shape, jnp.float32)
+    m, l, acc = lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
+    safe_l = jnp.where(l == 0, 1.0, l)
+    o_ref[0] = (acc / safe_l[:, None]).astype(o_ref.dtype)
+
+
+def _flash_pallas(q, k, v, scale: float, causal: bool,
+                  interpret: bool):
+    bh, t, d = q.shape
+    block_q = min(BLOCK_Q, t)
+    block_k = min(BLOCK_K, t)
+    assert t % block_q == 0 and t % block_k == 0, \
+        f"sequence length {t} must be a multiple of the block size"
+    grid = (bh, t // block_q)
+    kernel = functools.partial(_attn_kernel, scale=scale, causal=causal,
+                               block_k=block_k)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _flash_reference(q, k, v, scale: float, causal: bool):
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        t = q.shape[1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    scale: Optional[float] = None,
+                    backend: Optional[str] = None):
+    """q/k/v: [B, H, T, D] or [BH, T, D]; returns attention output with the
+    input layout.  backend: None (auto) | "pallas" | "interpret" | "ref"."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    squeeze = q.ndim == 4
+    if squeeze:
+        b, h, t, d = q.shape
+        q, k, v = (x.reshape(b * h, t, d) for x in (q, k, v))
+
+    if backend is None:
+        platform = jax.devices()[0].platform
+        backend = "pallas" if platform == "tpu" else "ref"
+    if backend == "pallas":
+        out = _flash_pallas(q, k, v, scale, causal, interpret=False)
+    elif backend == "interpret":
+        out = _flash_pallas(q, k, v, scale, causal, interpret=True)
+    else:
+        out = _flash_reference(q, k, v, scale, causal)
+
+    if squeeze:
+        out = out.reshape(b, h, t, d)
+    return out

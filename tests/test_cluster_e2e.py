@@ -1,0 +1,141 @@
+"""Full-stack cluster e2e: operator + hypervisor (control-plane backend)
+over one store — SURVEY §7's complete "minimum end-to-end slice" /
+BASELINE config #1:
+
+    mock provider .so -> hypervisor publishes chips -> operator schedules
+    an annotated 0.25-vTPU pod onto a chip -> hypervisor sees the bound
+    pod, allocates, creates the shm segment -> a client attaches, is
+    metered, and gets rate-limited.
+"""
+
+import os
+import time
+
+import pytest
+
+from tensorfusion_tpu import constants
+from tensorfusion_tpu.api.types import Container, Pod, TPUPool
+from tensorfusion_tpu.client import VTPUClient
+from tensorfusion_tpu.hypervisor import (AllocationController,
+                                         DeviceController, Limiter, Provider,
+                                         ShmView, WorkerController)
+from tensorfusion_tpu.hypervisor.control_plane import ControlPlaneBackend
+from tensorfusion_tpu.operator import Operator
+from tensorfusion_tpu.testing import MockProviderControl, fresh_library
+
+
+@pytest.fixture()
+def cluster(mock_provider_lib, limiter_lib, tmp_path):
+    """One operator + one hypervisor-managed node sharing the store."""
+    op = Operator()
+    pool = TPUPool.new("pool-a")
+    pool.spec.name = "pool-a"
+    op.store.create(pool)
+    op.start()
+
+    provider = Provider(fresh_library(mock_provider_lib, "e2e"))
+    devices = DeviceController(provider)
+    devices.start()
+    limiter = Limiter(fresh_library(limiter_lib, "e2e"))
+    alloc = AllocationController(devices)
+    workers = WorkerController(devices, alloc, limiter,
+                               str(tmp_path / "shm"))
+    backend = ControlPlaneBackend(op.store, devices, node_name="tpu-host-0",
+                                  pool="pool-a",
+                                  hypervisor_url="http://127.0.0.1:0")
+
+    def on_added(spec):
+        workers.add_worker(spec)
+
+    backend.start(on_added, workers.remove_worker)
+    workers.start()
+    yield op, devices, workers, backend, limiter
+    workers.stop()
+    backend.stop()
+    devices.stop()
+    op.stop()
+
+
+def test_full_slice_schedule_shm_meter_ratelimit(cluster):
+    op, devices, workers, backend, limiter = cluster
+
+    # chips published by the hypervisor reached the allocator
+    deadline = time.time() + 5
+    while len(op.allocator.chips("pool-a")) < 8 and time.time() < deadline:
+        time.sleep(0.05)
+    assert len(op.allocator.chips("pool-a")) == 8
+    some = op.allocator.chips("pool-a")[0].chip
+    assert some.status.ici_links and some.status.mesh is not None
+
+    # submit a 0.25-vTPU pod through admission
+    pod = Pod.new("frac", namespace="default")
+    ann = pod.metadata.annotations
+    ann[constants.ANN_POOL] = "pool-a"
+    ann[constants.ANN_TFLOPS_REQUEST] = "49.25"     # 25% of a v5e
+    ann[constants.ANN_HBM_REQUEST] = str(4 * 2**30)
+    ann[constants.ANN_IS_LOCAL_TPU] = "true"
+    pod.spec.containers = [Container(name="main")]
+    op.submit_pod(pod)
+    bound = op.wait_for_binding("frac")
+    assert bound is not None and bound.spec.node_name == "tpu-host-0"
+
+    # hypervisor picked the bound pod up and created the shm segment
+    deadline = time.time() + 5
+    tracked = None
+    while time.time() < deadline:
+        tracked = workers.get("default/frac")
+        if tracked is not None and tracked.shm_path:
+            break
+        time.sleep(0.05)
+    assert tracked is not None
+    assert os.path.exists(tracked.shm_path)
+    state = ShmView(tracked.shm_path).read()
+    assert state.devices[0].duty_limit_bp == pytest.approx(2500, abs=10)
+
+    # client attaches and is rate-limited at ~25% duty
+    client = VTPUClient(limiter_lib=limiter.lib_path,
+                        shm_path=tracked.shm_path)
+    assert client.attached
+    import jax.numpy as jnp
+
+    metered = client.meter(lambda a, b: a @ b)
+    a = jnp.ones((256, 256), jnp.float32)
+    metered(a, a)
+    assert client.charged_mflops > 0
+    state = ShmView(tracked.shm_path).read()
+    assert state.devices[0].launches >= 1
+
+    # teardown: pod deletion flows back to the hypervisor
+    op.delete_pod("frac")
+    deadline = time.time() + 5
+    while workers.get("default/frac") is not None and \
+            time.time() < deadline:
+        time.sleep(0.05)
+    assert workers.get("default/frac") is None
+    assert not os.path.exists(tracked.shm_path)
+
+
+def test_cluster_worker_spec_duty_derived_from_tflops(cluster):
+    op, devices, workers, backend, limiter = cluster
+    deadline = time.time() + 5
+    while len(op.allocator.chips("pool-a")) < 8 and time.time() < deadline:
+        time.sleep(0.05)
+
+    pod = Pod.new("half", namespace="default")
+    ann = pod.metadata.annotations
+    ann[constants.ANN_POOL] = "pool-a"
+    ann[constants.ANN_TFLOPS_REQUEST] = "98.5"      # 50% of a v5e
+    ann[constants.ANN_HBM_REQUEST] = str(2**30)
+    ann[constants.ANN_IS_LOCAL_TPU] = "true"
+    pod.spec.containers = [Container(name="main")]
+    op.submit_pod(pod)
+    assert op.wait_for_binding("half") is not None
+    deadline = time.time() + 5
+    tracked = None
+    while time.time() < deadline:
+        tracked = workers.get("default/half")
+        if tracked is not None:
+            break
+        time.sleep(0.05)
+    binding = tracked.allocation.bindings[0]
+    assert binding.duty_percent == pytest.approx(50.0, abs=0.5)

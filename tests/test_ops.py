@@ -1,0 +1,38 @@
+"""Pallas flash-attention kernel tests (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorfusion_tpu.ops import flash_attention
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("t,d", [(128, 64), (256, 64)])
+def test_flash_matches_reference(causal, t, d):
+    key = jax.random.PRNGKey(0)
+    q, k, v = (jax.random.normal(kk, (2, t, d), jnp.float32)
+               for kk in jax.random.split(key, 3))
+    ref = flash_attention(q, k, v, causal=causal, backend="ref")
+    out = flash_attention(q, k, v, causal=causal, backend="interpret")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_flash_4d_layout_and_bf16():
+    key = jax.random.PRNGKey(1)
+    q, k, v = (jax.random.normal(kk, (2, 4, 128, 32), jnp.bfloat16)
+               for kk in jax.random.split(key, 3))
+    ref = flash_attention(q, k, v, backend="ref")
+    out = flash_attention(q, k, v, backend="interpret")
+    assert out.shape == (2, 4, 128, 32) and out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=5e-2, atol=5e-2)
+
+
+def test_flash_rejects_ragged_sequence():
+    q = jnp.ones((1, 130, 32))  # not a multiple of the 128 block
+    with pytest.raises(AssertionError, match="multiple"):
+        flash_attention(q, q, q, backend="interpret")
