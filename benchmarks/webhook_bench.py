@@ -1,0 +1,62 @@
+"""Admission webhook throughput benchmark.
+
+Analog of the reference's BenchmarkPodWebhookQPS (scripts/benchmark.sh):
+measures mutations/second through the full admission path (parse ->
+workload object upsert -> annotation stamping -> env injection).
+
+    python benchmarks/webhook_bench.py [--pods 5000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from tensorfusion_tpu import constants
+from tensorfusion_tpu.api.types import ChipModelInfo, Container, Pod
+from tensorfusion_tpu.store import ObjectStore
+from tensorfusion_tpu.webhook import PodMutator, WorkloadParser
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pods", type=int, default=5000)
+    args = ap.parse_args()
+
+    store = ObjectStore()
+    parser = WorkloadParser(store, chip_models={
+        "v5e": ChipModelInfo(generation="v5e", bf16_tflops=197.0,
+                             hbm_bytes=16 << 30)}, default_pool="pool-a")
+    mutator = PodMutator(store, parser, operator_url="http://op:8080")
+
+    pods = []
+    for i in range(args.pods):
+        pod = Pod.new(f"bench-{i}", namespace=f"ns-{i % 16}")
+        ann = pod.metadata.annotations
+        ann[constants.ANN_TFLOPS_REQUEST] = "50"
+        ann[constants.ANN_HBM_REQUEST] = "4Gi"
+        ann[constants.ANN_QOS] = "high"
+        ann[constants.ANN_CHIP_GENERATION] = "v5e"
+        pod.spec.containers = [Container(name="main")]
+        pods.append(pod)
+
+    t0 = time.perf_counter()
+    for pod in pods:
+        mutator.handle(pod)
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "benchmark": "webhook_mutations_per_second",
+        "pods": args.pods,
+        "seconds": round(dt, 3),
+        "mutations_per_second": round(args.pods / dt, 1),
+        "reference": "BenchmarkPodWebhookQPS (tensor-fusion scripts/benchmark.sh)",
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -81,6 +81,9 @@ ANN_INJECT_CONTAINER = f"{DOMAIN}/inject-container"
 ANN_DISABLE_FEATURES = f"{DOMAIN}/disable-features"
 ANN_EVICTION_PROTECTION = f"{DOMAIN}/eviction-protection"
 ANN_EXCLUDED_NODES = f"{DOMAIN}/excluded-nodes"  # defrag/migration rebinds
+# the subset of excluded-nodes that defrag added (expired by TTL without
+# touching user-set exclusions)
+ANN_DEFRAG_EXCLUDED = f"{DOMAIN}/defrag-excluded-nodes"
 ANN_AUTOSCALE = f"{DOMAIN}/autoscale"
 ANN_AUTOSCALE_TARGET = f"{DOMAIN}/autoscale-target"
 ANN_PRICING = f"{DOMAIN}/hourly-pricing"

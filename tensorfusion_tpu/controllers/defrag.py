@@ -100,14 +100,16 @@ class CompactionController(Controller):
             return ttls.get(pool, self.DEFAULT_EVICTION_TTL_S)
 
         for wl in self.store.list(TPUWorkload):
-            since = wl.metadata.annotations.get(
-                constants.ANN_DEFRAG_EVICTED_SINCE)
+            ann = wl.metadata.annotations
+            since = ann.get(constants.ANN_DEFRAG_EVICTED_SINCE)
             if not since or not wl.spec.excluded_nodes:
                 continue
             if now - float(since) >= ttl_for(wl.spec.pool):
-                wl.spec.excluded_nodes = []
-                del wl.metadata.annotations[
-                    constants.ANN_DEFRAG_EVICTED_SINCE]
+                added = set(ann.pop(constants.ANN_DEFRAG_EXCLUDED,
+                                    "").split(","))
+                wl.spec.excluded_nodes = [
+                    n for n in wl.spec.excluded_nodes if n not in added]
+                del ann[constants.ANN_DEFRAG_EVICTED_SINCE]
                 self.store.update(wl)
         for pod in self.store.list(Pod):
             ann = pod.metadata.annotations
@@ -116,7 +118,16 @@ class CompactionController(Controller):
                 continue
             if now - float(since) >= ttl_for(
                     ann.get(constants.ANN_POOL, "")):
-                del ann[constants.ANN_EXCLUDED_NODES]
+                # drop only the defrag-added nodes; user exclusions persist
+                added = set(ann.pop(constants.ANN_DEFRAG_EXCLUDED,
+                                    "").split(","))
+                kept = [n for n in
+                        ann[constants.ANN_EXCLUDED_NODES].split(",")
+                        if n and n not in added]
+                if kept:
+                    ann[constants.ANN_EXCLUDED_NODES] = ",".join(kept)
+                else:
+                    del ann[constants.ANN_EXCLUDED_NODES]
                 del ann[constants.ANN_DEFRAG_EVICTED_SINCE]
                 self.store.update(pod)
         for tnode in self.store.list(TPUNode):
@@ -224,6 +235,9 @@ class CompactionController(Controller):
                     wl.spec.excluded_nodes.append(node)
                     wl.metadata.annotations[
                         constants.ANN_DEFRAG_EVICTED_SINCE] = now
+                    wl.metadata.annotations[constants.ANN_DEFRAG_EXCLUDED] = \
+                        _merge_exclusions(wl.metadata.annotations.get(
+                            constants.ANN_DEFRAG_EXCLUDED, ""), node)
                     self.store.update(wl)
         else:
             # standalone pod: clone it with the node excluded so the
@@ -241,6 +255,8 @@ class CompactionController(Controller):
             ann[constants.ANN_DEFRAG_EVICTED_SINCE] = now
             ann[constants.ANN_EXCLUDED_NODES] = _merge_exclusions(
                 ann.get(constants.ANN_EXCLUDED_NODES, ""), node)
+            ann[constants.ANN_DEFRAG_EXCLUDED] = _merge_exclusions(
+                ann.get(constants.ANN_DEFRAG_EXCLUDED, ""), node)
             replacement.metadata.annotations = ann
             replacement.spec = _clone_pod_spec(pod.spec)
         self.store.delete(Pod, pod.metadata.name, pod.metadata.namespace)
@@ -381,6 +397,9 @@ class LiveMigrator:
             ann.pop(k, None)
         ann[constants.ANN_EXCLUDED_NODES] = _merge_exclusions(
             ann.get(constants.ANN_EXCLUDED_NODES, ""), source)
+        ann[constants.ANN_DEFRAG_EXCLUDED] = _merge_exclusions(
+            ann.get(constants.ANN_DEFRAG_EXCLUDED, ""), source)
+        ann[constants.ANN_DEFRAG_EVICTED_SINCE] = str(time.time())
         replacement.metadata.annotations = ann
         replacement.spec = _clone_pod_spec(pod.spec)
         self.store.delete(Pod, pod_name, namespace)

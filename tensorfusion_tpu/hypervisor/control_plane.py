@@ -28,7 +28,8 @@ from typing import Callable, Dict, List, Optional
 from .. import constants
 from ..api.resources import ResourceAmount, parse_quantity
 from ..api.types import ICILink, MeshCoords, Node, Pod, TPUChip, TPUNode
-from ..store import ADDED, DELETED, MODIFIED, ObjectStore
+from ..store import (ADDED, AlreadyExistsError, ConflictError, DELETED,
+                     MODIFIED, ObjectStore)
 from .device import DeviceController
 from .framework import Backend, ProcessMapping, WorkerDeviceRequest, WorkerSpec
 
@@ -107,39 +108,55 @@ class ControlPlaneBackend(Backend):
     def publish_chips(self) -> None:
         topo = self.devices.topology()
         for entry in self.devices.devices():
-            info = entry.info
-            chip = self.store.try_get(TPUChip, info.chip_id) or \
-                TPUChip.new(info.chip_id)
-            st = chip.status
-            cap = ResourceAmount(tflops=info.peak_bf16_tflops,
-                                 duty_percent=100.0,
-                                 hbm_bytes=float(info.hbm_bytes))
-            first_publish = st.capacity.tflops == 0
-            st.capacity = cap
-            if first_publish:
-                st.available = cap
-            # never stomp a live-migration phase from the status loop
-            if st.phase != constants.PHASE_MIGRATING:
-                st.phase = constants.PHASE_RUNNING
-            st.generation = info.generation
-            st.vendor = self.vendor
-            st.node_name = self.node_name
-            st.pool = self.pool
-            st.slice_id = info.slice_id
-            st.host_index = info.host_index
-            st.numa_node = info.numa_node
-            st.core_count = info.core_count
-            st.mesh = MeshCoords(*info.mesh)
-            st.capabilities = dict(info.caps)
-            if topo is not None and info.chip_id in topo.links:
-                st.ici_links = [
-                    ICILink(peer_chip_id=l.peer_chip_id,
-                            peer_index=l.peer_index, kind=l.kind,
-                            hops=l.hops, gbps=l.gbps)
-                    for l in topo.links[info.chip_id]]
-            self.store.update_or_create(chip)
-        log.info("published %d chips for node %s",
-                 len(self.devices.devices()), self.node_name)
+            # optimistic-concurrency loop: only inventory fields are ours;
+            # available/running_apps belong to the allocator's sync and must
+            # not be reverted by a stale read-modify-write
+            for _ in range(3):
+                try:
+                    self._publish_one(entry, topo)
+                    break
+                except (ConflictError, AlreadyExistsError):
+                    continue
+        log.debug("published %d chips for node %s",
+                  len(self.devices.devices()), self.node_name)
+
+    def _publish_one(self, entry, topo) -> None:
+        info = entry.info
+        chip = self.store.try_get(TPUChip, info.chip_id)
+        created = chip is None
+        if created:
+            chip = TPUChip.new(info.chip_id)
+        st = chip.status
+        cap = ResourceAmount(tflops=info.peak_bf16_tflops,
+                             duty_percent=100.0,
+                             hbm_bytes=float(info.hbm_bytes))
+        first_publish = st.capacity.tflops == 0
+        st.capacity = cap
+        if first_publish:
+            st.available = cap
+        # never stomp a live-migration phase from the status loop
+        if st.phase != constants.PHASE_MIGRATING:
+            st.phase = constants.PHASE_RUNNING
+        st.generation = info.generation
+        st.vendor = self.vendor
+        st.node_name = self.node_name
+        st.pool = self.pool
+        st.slice_id = info.slice_id
+        st.host_index = info.host_index
+        st.numa_node = info.numa_node
+        st.core_count = info.core_count
+        st.mesh = MeshCoords(*info.mesh)
+        st.capabilities = dict(info.caps)
+        if topo is not None and info.chip_id in topo.links:
+            st.ici_links = [
+                ICILink(peer_chip_id=l.peer_chip_id,
+                        peer_index=l.peer_index, kind=l.kind,
+                        hops=l.hops, gbps=l.gbps)
+                for l in topo.links[info.chip_id]]
+        if created:
+            self.store.create(chip)
+        else:
+            self.store.update(chip, check_version=True)
 
     # -- pod watch (pod_cache informer analog) ----------------------------
 
@@ -182,12 +199,15 @@ class ControlPlaneBackend(Backend):
         devices = []
         for chip_id in chip_ids:
             entry = self.devices.get(chip_id)
-            if duty <= 0 and entry is not None and \
+            if duty > 0:
+                duty_pct = duty
+            elif tflops > 0 and entry is not None and \
                     entry.info.peak_bf16_tflops > 0:
                 duty_pct = min(100.0,
                                tflops / entry.info.peak_bf16_tflops * 100.0)
             else:
-                duty_pct = duty or 100.0
+                # HBM-only request: no compute contract -> unthrottled
+                duty_pct = 100.0
             devices.append(WorkerDeviceRequest(
                 chip_id=chip_id, duty_percent=duty_pct, hbm_bytes=hbm,
                 partition_template=ann.get(constants.ANN_PARTITION_NAME,

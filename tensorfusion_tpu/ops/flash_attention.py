@@ -7,8 +7,9 @@ the kernel streams K/V blocks through the MXU against a resident Q block
 /opt/skills/guides/pallas_guide.md).
 
 Layout: inputs are [BH, T, D] (batch*heads folded), grid =
-(BH, T // BLOCK_Q); each program owns one Q block and loops over K/V
-blocks with running max/denominator accumulators in f32.
+(BH, T // BLOCK, T // BLOCK); the innermost grid dimension streams K/V
+tiles so VMEM holds only one (BLOCK, D) tile of each at a time, with the
+running max/denominator/output accumulators in f32 VMEM scratch.
 
 ``flash_attention`` dispatches:
 - real TPU           -> compiled Pallas kernel;
@@ -25,6 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
@@ -32,66 +34,87 @@ BLOCK_Q = 128
 BLOCK_K = 128
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
-                 block_k: int):
-    """One (bh, q-block) program: online-softmax over all K/V blocks."""
-    qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)           # [BLOCK_Q, D]
-    t_total = k_ref.shape[1]
-    q_offset = qi * q.shape[0]
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                 scale: float, causal: bool):
+    """One (bh, qi, ki) program: fold K/V block ki into the running
+    online-softmax state for Q block qi.
 
-    def body(start, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(start * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(start * block_k, block_k), :].astype(jnp.float32)
+    The grid's innermost dimension streams K/V — only one (block_k, d)
+    tile of K and V is resident in VMEM at a time, so sequence length is
+    bounded by HBM, not VMEM.  Accumulators (m, l, acc) live in VMEM
+    scratch and persist across the innermost grid dimension.
+    """
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+    q_offset = qi * block_q
+    k_offset = ki * block_k
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Causal: blocks fully above the diagonal contribute nothing.  Skipping
+    # them also keeps every processed row non-fully-masked (its diagonal
+    # block always holds at least one valid key), so exp(s - m) stays sane.
+    causal_live = (k_offset <= q_offset + block_q - 1) if causal else True
+
+    @pl.when(causal_live)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
             q_pos = q_offset + lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = start * block_k + lax.broadcasted_iota(jnp.int32,
-                                                           s.shape, 1)
+            k_pos = k_offset + lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m = m_ref[:]
+        l = l_ref[:]
         m_new = jnp.maximum(m, s.max(axis=1))
         corr = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new[:, None])
-        l_new = l * corr + p.sum(axis=1)
-        acc_new = acc * corr[:, None] + jnp.dot(
+        m_ref[:] = m_new
+        l_ref[:] = l * corr + p.sum(axis=1)
+        acc_ref[:] = acc_ref[:] * corr[:, None] + jnp.dot(
             p, v, preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
 
-    n_blocks = t_total // block_k
-    if causal:
-        # blocks fully in the future contribute nothing; stop at the
-        # diagonal block of this Q block
-        n_blocks = jnp.minimum(
-            n_blocks, (q_offset + q.shape[0] + block_k - 1) // block_k)
-    m0 = jnp.full((q.shape[0],), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((q.shape[0],), jnp.float32)
-    acc0 = jnp.zeros(q.shape, jnp.float32)
-    m, l, acc = lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
-    safe_l = jnp.where(l == 0, 1.0, l)
-    o_ref[0] = (acc / safe_l[:, None]).astype(o_ref.dtype)
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_ref[:]
+        safe_l = jnp.where(l == 0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / safe_l[:, None]).astype(o_ref.dtype)
 
 
 def _flash_pallas(q, k, v, scale: float, causal: bool,
                   interpret: bool):
     bh, t, d = q.shape
-    block_q = min(BLOCK_Q, t)
-    block_k = min(BLOCK_K, t)
-    assert t % block_q == 0 and t % block_k == 0, \
-        f"sequence length {t} must be a multiple of the block size"
-    grid = (bh, t // block_q)
-    kernel = functools.partial(_attn_kernel, scale=scale, causal=causal,
-                               block_k=block_k)
+    block = min(BLOCK_Q, t)   # equal q/k blocks keep the causal skip exact
+    assert t % block == 0, \
+        f"sequence length {t} must be a multiple of the block size {block}"
+    grid = (bh, t // block, t // block)
+    kernel = functools.partial(_attn_kernel, scale=scale, causal=causal)
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, block, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block,), jnp.float32),      # running max
+            pltpu.VMEM((block,), jnp.float32),      # running denominator
+            pltpu.VMEM((block, d), jnp.float32),    # output accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
         interpret=interpret,
     )(q, k, v)
 

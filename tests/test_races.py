@@ -1,0 +1,112 @@
+"""Concurrency race tests.
+
+Analog of the reference's dedicated race suites
+(internal/gpuallocator/partition_template_race_test.go,
+autoscaler/recommender/percentile_recommender_race_test.go): hammer the
+shared structures from many threads and assert invariants hold.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tensorfusion_tpu.allocator import PortAllocator, TPUAllocator
+from tensorfusion_tpu.api import AllocRequest, ResourceAmount
+from tensorfusion_tpu.autoscaler import PercentileRecommender
+
+from helpers import make_chip
+
+
+def test_allocator_concurrent_assume_commit_dealloc():
+    alloc = TPUAllocator()
+    alloc.set_pool_oversell("pool-a", 500.0)
+    for i in range(8):
+        alloc.upsert_chip(make_chip(f"rc-{i}", node=f"n{i % 2}"))
+
+    errors = []
+    done = threading.Barrier(8)
+
+    def worker(tid):
+        try:
+            done.wait()
+            for i in range(50):
+                req = AllocRequest(
+                    pool="pool-a", namespace="race",
+                    pod_name=f"t{tid}-p{i}",
+                    request=ResourceAmount(tflops=20.0, hbm_bytes=2**28),
+                    chip_count=1)
+                record = alloc.alloc(req)
+                if i % 3 == 0:
+                    alloc.dealloc(record.key)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, errors
+
+    # invariant: per-chip allocated == sum of holder amounts
+    for state in alloc.chips("pool-a"):
+        total = sum(a.tflops for a in state.holders.values())
+        assert state.allocated.tflops == pytest.approx(total)
+    # invariant: every surviving allocation holds exactly its chips
+    for record in alloc.allocations():
+        for chip_name in record.chip_ids:
+            assert record.key in alloc.get_chip(chip_name).holders
+
+
+def test_port_allocator_concurrent_no_duplicates():
+    pa = PortAllocator(node_range=(1000, 2000))
+    seen = []
+    lock = threading.Lock()
+
+    def grab(tid):
+        for i in range(40):
+            p = pa.assign_node_port("n1", f"owner-{tid}-{i}")
+            with lock:
+                seen.append(p)
+
+    threads = [threading.Thread(target=grab, args=(t,)) for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert len(seen) == len(set(seen)) == 320
+
+
+def test_percentile_recommender_concurrent_observe():
+    rec = PercentileRecommender()
+    stop = threading.Event()
+    errors = []
+
+    def feeder(tid):
+        try:
+            while not stop.is_set():
+                rec.observe(f"wl-{tid % 2}", tflops=float(10 + tid),
+                            hbm_bytes=2**20)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def reader():
+        try:
+            while not stop.is_set():
+                rec.recommend("wl-0", ResourceAmount(tflops=10))
+                rec.recommend("wl-1", ResourceAmount(tflops=10))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=feeder, args=(t,)) for t in range(4)]
+    threads.append(threading.Thread(target=reader))
+    for t in threads:
+        t.start()
+    time.sleep(0.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert not errors, errors
+    out = rec.recommend("wl-0", ResourceAmount(tflops=10))
+    assert out is not None and out.target.tflops > 0
