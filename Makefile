@@ -1,0 +1,50 @@
+# tpu-fusion top-level targets.
+#
+# The test/bench python invocations clear PALLAS_AXON_POOL_IPS so the axon
+# sitecustomize does not dial the TPU tunnel for CPU-only work (see
+# docs/annotations.md env section); bench-tpu keeps the ambient env to run
+# on the real chip.
+
+PY := env -u PALLAS_AXON_POOL_IPS python
+
+.PHONY: all native test test-native asan tsan bench bench-tpu sched-bench \
+	webhook-bench dryrun clean
+
+all: native
+
+native:
+	$(MAKE) -C native all
+
+test: native
+	$(PY) -m pytest tests/ -x -q
+
+test-native:
+	$(MAKE) -C native test
+
+asan:
+	$(MAKE) -C native asan
+
+tsan:
+	$(MAKE) -C native tsan
+
+# Headline benchmark (vTPU overhead); runs on the real chip when the
+# tunnel is healthy, CPU otherwise.
+bench: native
+	$(PY) bench.py
+
+bench-tpu: native
+	python bench.py
+
+sched-bench:
+	$(PY) benchmarks/sched_bench.py --nodes 1000 --chips 4 --pods 10000
+
+webhook-bench:
+	$(PY) benchmarks/webhook_bench.py --pods 5000
+
+dryrun:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		python __graft_entry__.py dryrun 8
+
+clean:
+	$(MAKE) -C native clean

@@ -7,6 +7,7 @@ used to correlate the pod with its device-plugin allocation slot.
 
 from __future__ import annotations
 
+import heapq
 import threading
 from typing import Dict, Optional
 
@@ -21,26 +22,39 @@ class IndexAllocator:
         self._lock = threading.RLock()
         self._by_owner: Dict[str, int] = {}
         self._used = set()
+        # O(1) assignment: a watermark plus a free list of released indices
+        self._next = 0
+        self._free: list = []
 
     def assign(self, owner: str) -> int:
         with self._lock:
             if owner in self._by_owner:
                 return self._by_owner[owner]
-            for i in range(self.max_index):
-                if i not in self._used:
-                    self._used.add(i)
-                    self._by_owner[owner] = i
-                    return i
-            raise IndexExhaustedError(f"all {self.max_index} indices in use")
+            if self._free:
+                i = heapq.heappop(self._free)
+            elif self._next < self.max_index:
+                i = self._next
+                self._next += 1
+            else:
+                raise IndexExhaustedError(
+                    f"all {self.max_index} indices in use")
+            self._used.add(i)
+            self._by_owner[owner] = i
+            return i
 
     def release(self, owner: str) -> Optional[int]:
         with self._lock:
             idx = self._by_owner.pop(owner, None)
             if idx is not None:
                 self._used.discard(idx)
+                heapq.heappush(self._free, idx)
             return idx
 
     def reconcile(self, assignments: Dict[str, int]) -> None:
         with self._lock:
             self._by_owner = dict(assignments)
             self._used = set(assignments.values())
+            self._next = max(self._used) + 1 if self._used else 0
+            self._free = [i for i in range(self._next)
+                          if i not in self._used]
+            heapq.heapify(self._free)

@@ -1,0 +1,12 @@
+"""Shared utilities (quantities, serde, cron) re-exported for workloads.
+
+The heavy lifting lives with its owners (``api.resources`` for quantities,
+``api.meta`` for dataclass serde, ``autoscaler.recommender`` for cron,
+``metrics.encoder`` for line protocol); this package is the stable import
+surface for hosted-workload code.
+"""
+
+from ..api.meta import from_dict, to_dict
+from ..api.resources import format_bytes, parse_quantity
+from ..autoscaler.recommender import cron_matches
+from ..metrics.encoder import encode_line, parse_line
