@@ -27,8 +27,8 @@ asan:
 tsan:
 	$(MAKE) -C native tsan
 
-# Headline benchmark (vTPU overhead); runs on the real chip when the
-# tunnel is healthy, CPU otherwise.
+# Headline benchmark (vTPU overhead). `bench` runs CPU-only (tunnel
+# bypassed); `bench-tpu` keeps the ambient env to run on the real chip.
 bench: native
 	$(PY) bench.py
 

@@ -42,11 +42,9 @@ def _build_native() -> pathlib.Path:
     return build
 
 
-def _time_steps(step, args, n) -> float:
+def _time_round(step, args, n) -> float:
     import jax
 
-    out = step(*args)
-    jax.block_until_ready(out)          # warmup/compile
     t0 = time.perf_counter()
     for _ in range(n):
         out = step(*args)
@@ -54,8 +52,32 @@ def _time_steps(step, args, n) -> float:
     return (time.perf_counter() - t0) / n
 
 
+def _time_interleaved(native, metered, args, steps, rounds=5):
+    """Alternate native/metered rounds and take medians, so machine-load
+    drift hits both paths equally instead of biasing one."""
+    import jax
+
+    jax.block_until_ready(native(*args))    # warmup/compile
+    jax.block_until_ready(metered(*args))
+    n_times, m_times = [], []
+    per_round = max(steps // rounds, 1)
+    for _ in range(rounds):
+        n_times.append(_time_round(native, args, per_round))
+        m_times.append(_time_round(metered, args, per_round))
+    n_times.sort()
+    m_times.sort()
+    return n_times[len(n_times) // 2], m_times[len(m_times) // 2]
+
+
 def main() -> int:
     import jax
+
+    try:
+        jax.devices()
+    except RuntimeError:
+        # ambient JAX_PLATFORMS names a backend whose plugin didn't register
+        # (e.g. the axon tunnel guard env was cleared): auto-select instead
+        jax.config.update("jax_platforms", "")
     import jax.numpy as jnp
 
     from tensorfusion_tpu.client import VTPUClient
@@ -84,7 +106,6 @@ def main() -> int:
         return loss, grads
 
     native = jax.jit(train_fwd_bwd)
-    t_native = _time_steps(native, (params, batch_data), STEPS)
 
     # vTPU path: worker segment with an uncontended full-duty quota.
     shm_base = tempfile.mkdtemp(prefix="tpf_bench_shm_")
@@ -97,7 +118,9 @@ def main() -> int:
     client = VTPUClient(limiter_lib=str(build / "libtpf_limiter.so"),
                         shm_path=os.path.join(shm_base, "bench", "w"))
     metered = client.meter(train_fwd_bwd)
-    t_metered = _time_steps(metered, (params, batch_data), STEPS)
+
+    t_native, t_metered = _time_interleaved(native, metered,
+                                            (params, batch_data), STEPS)
 
     overhead_pct = max(0.0, (t_metered - t_native) / t_native * 100.0)
     result = {

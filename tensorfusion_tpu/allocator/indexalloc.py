@@ -21,8 +21,7 @@ class IndexAllocator:
         self.max_index = max_index
         self._lock = threading.RLock()
         self._by_owner: Dict[str, int] = {}
-        self._used = set()
-        # O(1) assignment: a watermark plus a free list of released indices
+        # O(1) assignment: a watermark plus a min-heap of released indices
         self._next = 0
         self._free: list = []
 
@@ -38,7 +37,6 @@ class IndexAllocator:
             else:
                 raise IndexExhaustedError(
                     f"all {self.max_index} indices in use")
-            self._used.add(i)
             self._by_owner[owner] = i
             return i
 
@@ -46,15 +44,18 @@ class IndexAllocator:
         with self._lock:
             idx = self._by_owner.pop(owner, None)
             if idx is not None:
-                self._used.discard(idx)
                 heapq.heappush(self._free, idx)
             return idx
 
     def reconcile(self, assignments: Dict[str, int]) -> None:
+        """Rebuild from persisted pod annotations.  Out-of-range indices
+        (corrupt or foreign annotations) are dropped so one bad value can
+        neither bypass the max_index bound nor balloon the free list."""
         with self._lock:
-            self._by_owner = dict(assignments)
-            self._used = set(assignments.values())
-            self._next = max(self._used) + 1 if self._used else 0
-            self._free = [i for i in range(self._next)
-                          if i not in self._used]
+            self._by_owner = {owner: idx for owner, idx
+                              in assignments.items()
+                              if 0 <= idx < self.max_index}
+            used = set(self._by_owner.values())
+            self._next = max(used) + 1 if used else 0
+            self._free = [i for i in range(self._next) if i not in used]
             heapq.heapify(self._free)

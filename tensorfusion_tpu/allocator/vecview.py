@@ -188,5 +188,4 @@ class CandidateMap(Mapping):
         counts = np.bincount(nodes, minlength=len(self.view.node_names))
         safe = np.maximum(counts, 1)
         means = (sums / safe).tolist()   # one vectorized pass + C-speed list
-        names = self.view.node_names
         return {name: means[self._node_id[name]] for name in self._eligible}
