@@ -16,6 +16,14 @@ Prints ONE JSON line:
      "unit": "%", "vs_baseline": ..}
 vs_baseline = value / 1.0 (the reference's ~1% soft-isolation overhead);
 < 1.0 beats the reference.
+
+Self-defence: the ambient backend in this image is an ``axon`` TPU tunnel
+whose init can hang indefinitely when its relay is dead — and a hang
+inside backend init cannot be caught in-process. So the benchmark body
+runs in a child process: the parent probes backend liveness with a short
+deadline, runs the child on the live backend if possible, and otherwise
+re-runs it on a scrubbed CPU environment. One JSON line is always printed
+well inside the driver's budget.
 """
 
 from __future__ import annotations
@@ -31,7 +39,54 @@ import time
 REPO = pathlib.Path(__file__).resolve().parent
 sys.path.insert(0, str(REPO))
 
+from driver_guard import backend_alive, run_with_deadline, scrubbed_cpu_env
+
 STEPS = 20
+
+_CHILD_TIMEOUT = 420       # one benchmark attempt (incl. ~40s compile)
+
+
+# -- parent: environment selection + deadlines ------------------------------
+
+
+def _extract_json_line(out: str):
+    for line in reversed(out.splitlines()):
+        line = line.strip()
+        if line.startswith("{") and '"metric"' in line:
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def main() -> int:
+    attempts = []
+    ambient = os.environ.get("JAX_PLATFORMS", "")
+    if ambient.lower() not in ("", "cpu") and backend_alive():
+        attempts.append(dict(os.environ))
+    attempts.append(scrubbed_cpu_env())
+
+    for env in attempts:
+        rc, out = run_with_deadline(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            env, _CHILD_TIMEOUT, cwd=str(REPO))
+        result = _extract_json_line(out)
+        if rc == 0 and result is not None:
+            print(json.dumps(result))
+            return 0
+        sys.stderr.write(
+            f"bench child rc={rc} on JAX_PLATFORMS="
+            f"{env.get('JAX_PLATFORMS', '')!r}; tail:\n{out[-1500:]}\n")
+
+    # Never leave the driver without a parseable line.
+    print(json.dumps({"metric": "vtpu_soft_isolation_overhead_pct",
+                      "value": None, "unit": "%", "vs_baseline": None,
+                      "error": "all benchmark attempts failed"}))
+    return 1
+
+
+# -- child: the actual benchmark --------------------------------------------
 
 
 def _build_native() -> pathlib.Path:
@@ -69,7 +124,7 @@ def _time_interleaved(native, metered, args, steps, rounds=5):
     return n_times[len(n_times) // 2], m_times[len(m_times) // 2]
 
 
-def main() -> int:
+def child_main() -> int:
     import jax
 
     try:
@@ -140,4 +195,6 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        sys.exit(child_main())
     sys.exit(main())

@@ -1,0 +1,90 @@
+"""Shared backend-guard helpers for the driver entry points.
+
+``bench.py`` and ``__graft_entry__.py`` both have to defend themselves
+against the ambient JAX backend (an ``axon`` TPU tunnel in this image)
+hanging indefinitely inside backend init when its relay is dead — a hang
+that cannot be caught in-process.  The common machinery lives here so a
+tunnel-related fix lands in exactly one place:
+
+- ``scrubbed_cpu_env``    — deterministic CPU-only child environment
+  (tunnel dial disabled, platform pinned, optional virtual device count);
+- ``run_with_deadline``   — subprocess runner that kills the whole
+  process group on timeout (rc 124), since a hung backend init ignores a
+  plain SIGTERM to the child;
+- ``backend_alive``       — ambient-backend liveness probe in a child
+  process, result cached per-process.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+from typing import Dict, List, Optional, Tuple
+
+PROBE_TIMEOUT = 90
+
+_probe_cache: Optional[bool] = None
+
+
+def scrubbed_cpu_env(n_devices: Optional[int] = None) -> Dict[str, str]:
+    """Environment for a deterministic CPU child: no TPU-tunnel dial at
+    interpreter start, no ambient platform/XLA flags; with ``n_devices``,
+    a virtual CPU mesh of that size."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # sitecustomize tunnel guard
+    env.pop("JAX_PLATFORM_NAME", None)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        env["XLA_FLAGS"] = \
+            f"--xla_force_host_platform_device_count={n_devices}"
+    return env
+
+
+def run_with_deadline(argv: List[str], env: Dict[str, str],
+                      timeout: float, cwd: Optional[str] = None
+                      ) -> Tuple[int, str]:
+    """Run argv with a hard deadline.  Returns (rc, combined output);
+    rc 124 on timeout after SIGKILLing the child's process group."""
+    proc = subprocess.Popen(argv, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True,
+                            start_new_session=True, cwd=cwd)
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        out, _ = proc.communicate()
+        return 124, out
+    return proc.returncode, out
+
+
+def backend_alive(timeout: float = PROBE_TIMEOUT) -> bool:
+    """Can the ambient JAX backend initialise?  Probed in a child process
+    so a hang inside backend init cannot leak into the caller; the result
+    is cached for this process."""
+    global _probe_cache
+    if _probe_cache is None:
+        rc, out = run_with_deadline(
+            [sys.executable, "-c",
+             "import jax; print('PLATFORM=' + jax.devices()[0].platform)"],
+            dict(os.environ), timeout)
+        _probe_cache = rc == 0 and "PLATFORM=" in out
+    return _probe_cache
+
+
+def ensure_live_backend() -> None:
+    """Before first in-process JAX use: if the ambient backend is dead,
+    fall back to CPU so the caller never hangs."""
+    if os.environ.get("JAX_PLATFORMS", "").lower() in ("", "cpu"):
+        return
+    if not backend_alive():
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")

@@ -30,7 +30,7 @@ import logging
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from .. import constants
 from ..api.resources import AdjustRequest, AllocRequest, ResourceAmount
@@ -338,6 +338,57 @@ class TPUAllocator:
     def _strategy_for(self, pool: str) -> Strategy:
         with self._lock:
             return self._strategies.get(pool) or new_strategy("CompactFirst")
+
+    # -- hypothetical fit (preemption / nominated-node dry-runs) ----------
+
+    def _clone_chip_state(self, state: ChipState) -> ChipState:
+        clone = ChipState(state.chip, state.oversell_ratio,
+                          state._template_cores)
+        clone.allocated = state.allocated
+        clone.holders = dict(state.holders)
+        clone.partition_cores_used = state.partition_cores_used
+        return clone
+
+    def dry_run_fit(self, req: AllocRequest, node: str,
+                    release_keys: Iterable[str] = (),
+                    virtual_holds: Iterable[AllocRequest] = ()) -> bool:
+        """Would the full filter chain admit ``req`` on ``node`` in a
+        hypothetical state where ``release_keys``' holds are released and
+        each ``virtual_holds`` request (a nominated-but-unbound preemptor)
+        is greedily placed first?
+
+        This is the per-chip answer the aggregate shortfall math cannot
+        give: eviction must free capacity *in a shape the request can use*
+        (chip_count chips each satisfying tflops AND hbm AND partition
+        slots).  FilterWithPreempt + nominated-pod double-booking analog
+        (gpuallocator.go:666, gpuresources.go:377-575).
+        """
+        with self._lock:
+            # pool-scoped like every other allocator path: chips of other
+            # pools on the same node must not satisfy (or fake-satisfy)
+            # the fit, since the request can never use them
+            pool_names = self._pool_chips.get(req.pool) if req.pool else None
+            clones = [self._clone_chip_state(self._chips[n])
+                      for n in self._node_chips.get(node, ())
+                      if n in self._chips
+                      and (pool_names is None or n in pool_names)]
+            if not clones:
+                return False
+            for key in release_keys:
+                rec = self._allocations.get(key)
+                template = rec.request.partition_template if rec else ""
+                for clone in clones:
+                    clone.drop(key, partition_template=template)
+            strategy = self._strategy_for(req.pool)
+            for i, nreq in enumerate(virtual_holds):
+                res = run_filters(self._filters, nreq, clones)
+                if len(res.chips) < nreq.chip_count:
+                    continue  # nominee no longer fits; it can't block
+                for c in strategy.select(res.chips, nreq.chip_count):
+                    c.hold(f"__nominated_{i}__", nreq.request,
+                           nreq.partition_template)
+            res = run_filters(self._filters, req, clones)
+            return len(res.chips) >= req.chip_count
 
     # -- two-phase allocation ---------------------------------------------
 

@@ -73,29 +73,27 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
             q_pos = q_offset + lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_pos = k_offset + lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m = m_ref[:]
-        l = l_ref[:]
-        m_new = jnp.maximum(m, s.max(axis=1))
+        m = m_ref[:]                      # [block, 1]
+        l = l_ref[:]                      # [block, 1]
+        m_new = jnp.maximum(m, s.max(axis=1, keepdims=True))
         corr = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[:, None])
+        p = jnp.exp(s - m_new)
         m_ref[:] = m_new
-        l_ref[:] = l * corr + p.sum(axis=1)
-        acc_ref[:] = acc_ref[:] * corr[:, None] + jnp.dot(
+        l_ref[:] = l * corr + p.sum(axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + jnp.dot(
             p, v, preferred_element_type=jnp.float32)
 
     @pl.when(ki == nk - 1)
     def _finish():
         l = l_ref[:]
         safe_l = jnp.where(l == 0, 1.0, l)
-        o_ref[0] = (acc_ref[:] / safe_l[:, None]).astype(o_ref.dtype)
+        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
 
 
 def _flash_pallas(q, k, v, scale: float, causal: bool,
                   interpret: bool):
     bh, t, d = q.shape
     block = min(BLOCK_Q, t)   # equal q/k blocks keep the causal skip exact
-    assert t % block == 0, \
-        f"sequence length {t} must be a multiple of the block size {block}"
     grid = (bh, t // block, t // block)
     kernel = functools.partial(_attn_kernel, scale=scale, causal=causal)
     return pl.pallas_call(
@@ -109,8 +107,10 @@ def _flash_pallas(q, k, v, scale: float, causal: bool,
         out_specs=pl.BlockSpec((1, block, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[
-            pltpu.VMEM((block,), jnp.float32),      # running max
-            pltpu.VMEM((block,), jnp.float32),      # running denominator
+            # 2-D (block, 1) shapes: rank-1 VMEM scratch is a Mosaic
+            # lowering risk on real hardware (lane-dim layout)
+            pltpu.VMEM((block, 1), jnp.float32),    # running max
+            pltpu.VMEM((block, 1), jnp.float32),    # running denominator
             pltpu.VMEM((block, d), jnp.float32),    # output accumulator
         ],
         compiler_params=pltpu.CompilerParams(
@@ -146,6 +146,12 @@ def flash_attention(q, k, v, causal: bool = True,
     if backend is None:
         platform = jax.devices()[0].platform
         backend = "pallas" if platform == "tpu" else "ref"
+    # The kernel needs t to tile evenly into equal q/k blocks; for other
+    # lengths use the jnp reference (identical semantics) instead of
+    # failing — documented fallback behavior.
+    t = q.shape[1]
+    if backend in ("pallas", "interpret") and t % min(BLOCK_Q, t) != 0:
+        backend = "ref"
     if backend == "pallas":
         out = _flash_pallas(q, k, v, scale, causal, interpret=False)
     elif backend == "interpret":

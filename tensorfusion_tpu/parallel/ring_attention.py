@@ -35,9 +35,15 @@ NEG_INF = -1e30
 def _block(q, k, v, m, l, o, q_off, k_off, causal, scale):
     """One online-softmax accumulation step for a K/V block.
 
-    q: [B, H, Tq, D]; k/v: [B, H, Tk, D]; m,l: [B, H, Tq]; o like q.
+    q: [B, H, Tq, D]; k/v: [B, H, Tk, D]; m,l: [B, H, Tq]; o like q but
+    f32.  Scores and the running max/denominator/output all accumulate in
+    float32 regardless of the input dtype — with bf16 inputs the running
+    state would otherwise degrade across ring steps, exactly in the
+    long-context regime ring attention targets (matches the f32-scratch
+    discipline of ops/flash_attention.py).
     """
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
     if causal:
         q_pos = q_off + jnp.arange(q.shape[2])
         k_pos = k_off + jnp.arange(k.shape[2])
@@ -48,7 +54,8 @@ def _block(q, k, v, m, l, o, q_off, k_off, causal, scale):
     corr = jnp.exp(m - m_new)
     p = jnp.exp(s - m_new[..., None])
     l_new = l * corr + p.sum(axis=-1)
-    o_new = o * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    o_new = o * corr[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v, preferred_element_type=jnp.float32)
     return m_new, l_new, o_new
 
 
@@ -61,10 +68,11 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
     q_off = idx * t_local
 
     # derive the accumulators from q so they carry its varying manual axes
-    # (required by shard_map's vma check for scan carries)
-    m0 = jnp.full_like(q[..., 0], NEG_INF)
-    l0 = jnp.zeros_like(q[..., 0])
-    o0 = jnp.zeros_like(q)
+    # (required by shard_map's vma check for scan carries); f32 regardless
+    # of input dtype — see _block
+    m0 = jnp.full_like(q[..., 0], NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros_like(q[..., 0], dtype=jnp.float32)
+    o0 = jnp.zeros_like(q, dtype=jnp.float32)
 
     def step(carry, s):
         (k_blk, v_blk), (m, l, o) = carry
@@ -83,7 +91,7 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
     (_, _), (m, l, o) = carry
     # fully-masked rows have l == 0; emit zeros there
     safe_l = jnp.where(l == 0, 1.0, l)
-    return o / safe_l[..., None]
+    return (o / safe_l[..., None]).astype(q.dtype)
 
 
 def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,

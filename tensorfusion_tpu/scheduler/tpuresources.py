@@ -22,6 +22,7 @@ every extension point of the framework:
 from __future__ import annotations
 
 import logging
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import constants
@@ -44,6 +45,12 @@ log = logging.getLogger("tpf.scheduler.fit")
 
 STATE_NODE_SCORES = "fit/node_scores"
 STATE_ASSUMED = "fit/assumed"
+STATE_NOMINATION = "fit/nomination"
+
+#: how long a preemption nomination reserves its node against other pods
+#: before it is considered stale (the preemptor normally re-schedules onto
+#: the node well within this)
+NOMINATION_TTL_S = 120.0
 
 
 def compose_alloc_request(pod: Pod) -> Optional[AllocRequest]:
@@ -109,6 +116,11 @@ class TPUResourcesFit(PreEnqueuePlugin, PreFilterPlugin, FilterPlugin,
         self.indices = indices
         self.pods_on_node = pods_on_node or (lambda node: [])
         self.evict = evict or (lambda pod: None)
+        # preemptor pod key -> (node, priority, request, expiry); consulted
+        # by Filter so another pod can't steal a freshly-preempted node
+        # (nominated-pod double-booking check, gpuresources.go:377-575)
+        self._nominations: Dict[str, Tuple[str, int, AllocRequest,
+                                           float]] = {}
 
     # -- PreEnqueue -------------------------------------------------------
 
@@ -156,7 +168,28 @@ class TPUResourcesFit(PreEnqueuePlugin, PreFilterPlugin, FilterPlugin,
         if plans is not None and req.chip_count > 1 and node not in plans:
             return Status(Code.UNSCHEDULABLE,
                           f"no topology plan for {node}")
-        return OK
+        return self._check_nominations(pod, req, node)
+
+    def _check_nominations(self, pod: Pod, req: AllocRequest,
+                           node: str) -> Status:
+        """A node freshly freed by preemption is reserved for its
+        preemptor: other pods may only pass Filter here if the node still
+        fits them *with every equal-or-higher-priority nominee virtually
+        placed first*."""
+        now = time.monotonic()
+        if self._nominations:
+            self._nominations = {k: v for k, v in self._nominations.items()
+                                 if v[3] > now}
+        blockers = [v[2] for k, v in self._nominations.items()
+                    if v[0] == node and k != pod.key()
+                    and v[1] >= pod.spec.priority]
+        if not blockers:
+            return OK
+        if self.allocator.dry_run_fit(req, node, virtual_holds=blockers):
+            return OK
+        return Status(Code.UNSCHEDULABLE,
+                      f"node {node} reserved for {len(blockers)} "
+                      f"nominated preemptor(s)")
 
     # -- PostFilter: preemption (:711-757 + patched DefaultPreemption) ----
 
@@ -173,7 +206,10 @@ class TPUResourcesFit(PreEnqueuePlugin, PreFilterPlugin, FilterPlugin,
 
     def _try_preempt(self, req: AllocRequest, pod: Pod) -> Optional[str]:
         """Pick a node where evicting lower-priority, unprotected pods
-        frees enough capacity; evict them and nominate the node."""
+        makes the request actually fit (verified by a per-chip dry run of
+        the full filter chain against the post-eviction state); evict them
+        and nominate the node — recording the nomination so Filter
+        reserves the node for this pod."""
         if pod.spec.preemption_policy == "Never":
             return None
         nodes = {c.chip.status.node_name
@@ -191,10 +227,23 @@ class TPUResourcesFit(PreEnqueuePlugin, PreFilterPlugin, FilterPlugin,
             log.info("preempting %s on %s for %s", v.key(), best_node,
                      pod.key())
             self.evict(v)
+        self._nominations[pod.key()] = (
+            best_node, pod.spec.priority, req,
+            time.monotonic() + NOMINATION_TTL_S)
         return best_node
 
     def _victims_on_node(self, req: AllocRequest, pod: Pod,
                          node: str) -> Optional[List[Pod]]:
+        """Smallest prefix of the node's evictable pods (lowest priority
+        first) whose release makes the request fit the node per the full
+        filter chain — per-chip shapes included, unlike aggregate
+        shortfall math which can evict victims whose freed capacity the
+        pod still cannot use."""
+        node_chip_names = {c.chip.name for c in
+                           self.allocator.chips(req.pool or None)
+                           if c.chip.status.node_name == node}
+        if not node_chip_names:
+            return None
         candidates = []
         for p in self.pods_on_node(node):
             if p.spec.priority >= pod.spec.priority:
@@ -204,38 +253,24 @@ class TPUResourcesFit(PreEnqueuePlugin, PreFilterPlugin, FilterPlugin,
                         "true", "1"):
                 continue  # patched-preemption eviction-protection analog
             rec = self.allocator.allocation(p.key())
-            if rec is None:
+            if rec is None or not (set(rec.chip_ids) & node_chip_names):
                 continue
             candidates.append((p, rec))
         if not candidates:
             return None
+        if self.allocator.dry_run_fit(req, node):
+            # Capacity is not the problem (the pod failed for quota /
+            # gang / other reasons) — evicting anyone cannot help.
+            return None
         # lowest priority first
         candidates.sort(key=lambda pr: pr[0].spec.priority)
-        # Victims only need to cover the *shortfall* beyond what the node
-        # already has free.
-        node_chips = [c for c in self.allocator.chips(req.pool or None)
-                      if c.chip.status.node_name == node]
-        if req.chip_count == 1:
-            free_t = max((c.available().tflops for c in node_chips),
-                         default=0.0)
-            free_h = max((c.available().hbm_bytes for c in node_chips),
-                         default=0.0)
-        else:
-            free_t = sum(c.available().tflops for c in node_chips)
-            free_h = sum(c.available().hbm_bytes for c in node_chips)
-        need = req.request.scale(req.chip_count)
-        shortfall_t = max(0.0, need.tflops - free_t)
-        shortfall_h = max(0.0, need.hbm_bytes - free_h)
-        if shortfall_t <= 0 and shortfall_h <= 0:
-            # Capacity is not the problem (generation/vendor/quota mismatch)
-            # — evicting anyone cannot make the pod schedulable.
-            return None
-        freed = ResourceAmount()
-        victims = []
+        victims: List[Pod] = []
+        released: set = set()
         for p, rec in candidates:
             victims.append(p)
-            freed = freed.add(rec.request.request.scale(len(rec.chip_ids)))
-            if shortfall_t <= freed.tflops and shortfall_h <= freed.hbm_bytes:
+            released.add(p.key())
+            if self.allocator.dry_run_fit(req, node,
+                                          release_keys=released):
                 return victims
         return None
 
@@ -266,6 +301,14 @@ class TPUResourcesFit(PreEnqueuePlugin, PreFilterPlugin, FilterPlugin,
                 QuotaExceededError) as e:
             return Status(Code.UNSCHEDULABLE, f"reserve failed: {e}")
         state[STATE_ASSUMED] = [c.chip.name for c in chosen]
+        # The preemptor holds real (assumed) chips now; suspend its node
+        # reservation so other pods' nomination checks don't double-count
+        # it on top of the assumed hold.  Unreserve restores it — a
+        # Permit timeout or PreBind failure must not leave the freshly
+        # freed node up for grabs.
+        nom = self._nominations.pop(pod.key(), None)
+        if nom is not None:
+            state[STATE_NOMINATION] = nom
         return OK
 
     def unreserve(self, state: CycleState, pod: Pod, node: str) -> None:
@@ -273,6 +316,9 @@ class TPUResourcesFit(PreEnqueuePlugin, PreFilterPlugin, FilterPlugin,
         if req is not None and state.get(STATE_ASSUMED):
             self.allocator.unassume(req.key())
             state.pop(STATE_ASSUMED, None)
+        nom = state.pop(STATE_NOMINATION, None)
+        if nom is not None and nom[3] > time.monotonic():
+            self._nominations[pod.key()] = nom
 
     # -- Permit -----------------------------------------------------------
 

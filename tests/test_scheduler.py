@@ -231,6 +231,105 @@ def test_preemption_with_eviction_protection():
     assert h.allocator.allocation("default/high") is not None
 
 
+def test_preemption_per_chip_fit():
+    """Aggregate shortfall math would see max-free-tflops on one chip and
+    max-free-HBM on another, conclude "capacity is not the problem" and
+    skip preemption; the per-chip dry run must preempt anyway because no
+    single chip satisfies both dimensions."""
+    h = Harness(chips_per_node=2, nodes=1)
+    # chip-0: leaves 147 TF / 1 GiB free; chip-1: leaves 10 TF / 10 GiB
+    v1 = h.make_pod("v1", tflops=50.0, hbm=15 * 2**30, priority=1,
+                    **{constants.ANN_CHIP_INDICES: "0"})
+    v2 = h.make_pod("v2", tflops=187.0, hbm=6 * 2**30, priority=2,
+                    **{constants.ANN_CHIP_INDICES: "1"})
+    assert h.scheduler.schedule_one(v1).ok
+    assert h.scheduler.schedule_one(v2).ok
+    for p in (v1, v2):
+        p.spec.node_name = h.bound[p.key()]
+
+    # needs 100 TF AND 5 GiB on ONE chip — no chip has both
+    high = h.make_pod("high", tflops=100.0, hbm=5 * 2**30, priority=100)
+    h.scheduler.schedule_one(high)
+    assert h.evicted == ["default/v1"]      # lowest priority, frees chip-0
+    assert high.status.nominated_node_name == "node-0"
+    assert h.scheduler.schedule_one(high).ok
+
+
+def test_nominated_node_reserved_against_lower_priority():
+    """After preemption, the freed node is reserved: a lower-priority pod
+    that conflicts with the preemptor must not steal it, while one that
+    fits alongside may still bind."""
+    h = Harness(chips_per_node=1, nodes=1)
+    low = h.make_pod("low", tflops=150.0, hbm=4 * 2**30, priority=1)
+    assert h.scheduler.schedule_one(low).ok
+    low.spec.node_name = h.bound[low.key()]
+
+    high = h.make_pod("high", tflops=150.0, hbm=4 * 2**30, priority=100)
+    h.scheduler.schedule_one(high)
+    assert h.evicted == ["default/low"]
+    assert high.status.nominated_node_name == "node-0"
+
+    # conflicting lower-priority pod: 150 TF don't fit next to the
+    # nominated 150 TF -> must NOT take the node the victims just freed
+    thief = h.make_pod("thief", tflops=150.0, hbm=2 * 2**30, priority=5)
+    st = h.scheduler.schedule_one(thief)
+    assert not st.ok
+    assert thief.key() not in h.bound
+
+    # non-conflicting small pod still passes the reservation check
+    small = h.make_pod("small", tflops=30.0, hbm=2 * 2**30, priority=5)
+    assert h.scheduler.schedule_one(small).ok
+
+    # and the preemptor lands on its nominated node
+    assert h.scheduler.schedule_one(high).ok
+    assert h.bound[high.key()] == "node-0"
+
+
+def test_dry_run_fit_is_pool_scoped():
+    """Free chips of *another* pool on the same node must not satisfy the
+    preemption dry run — the request can never use them."""
+    h = Harness(chips_per_node=1, nodes=1)
+    # second chip on node-0 in a different pool, fully free
+    other = make_chip("dev-9", node="node-0", pool="pool-dev")
+    h.allocator.upsert_chip(other)
+
+    victim = h.make_pod("victim", tflops=150.0, hbm=4 * 2**30, priority=1)
+    assert h.scheduler.schedule_one(victim).ok
+    victim.spec.node_name = h.bound[victim.key()]
+
+    high = h.make_pod("high", tflops=150.0, hbm=4 * 2**30, priority=100)
+    h.scheduler.schedule_one(high)
+    # without pool scoping the free pool-dev chip makes dry_run_fit pass,
+    # "capacity is not the problem" short-circuits, and nothing is evicted
+    assert h.evicted == ["default/victim"]
+    assert high.status.nominated_node_name == "node-0"
+
+
+def test_unreserve_restores_nomination():
+    """A preemptor that reserves but then fails (permit timeout, prebind
+    error) must get its node reservation back, not leave the freed node
+    up for grabs."""
+    h = Harness(chips_per_node=1, nodes=1)
+    low = h.make_pod("low", tflops=150.0, hbm=4 * 2**30, priority=1)
+    assert h.scheduler.schedule_one(low).ok
+    low.spec.node_name = h.bound[low.key()]
+
+    high = h.make_pod("high", tflops=150.0, hbm=4 * 2**30, priority=100)
+    h.scheduler.schedule_one(high)
+    assert high.key() in h.fit._nominations
+
+    from tensorfusion_tpu.scheduler.framework import CycleState
+    from tensorfusion_tpu.scheduler.tpuresources import (
+        STATE_ALLOC_REQUEST, compose_alloc_request)
+    state = CycleState()
+    state[STATE_ALLOC_REQUEST] = compose_alloc_request(high)
+    assert h.fit.pre_filter(state, high).ok
+    assert h.fit.reserve(state, high, "node-0").ok
+    assert high.key() not in h.fit._nominations   # suspended while assumed
+    h.fit.unreserve(state, high, "node-0")
+    assert high.key() in h.fit._nominations       # restored on failure
+
+
 def test_scheduler_loop_end_to_end():
     h = Harness()
     h.scheduler.start()
