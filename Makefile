@@ -7,8 +7,8 @@
 
 PY := env -u PALLAS_AXON_POOL_IPS python
 
-.PHONY: all native test test-native asan tsan bench bench-tpu sched-bench \
-	webhook-bench dryrun clean
+.PHONY: all native test test-native check-coverage asan tsan bench \
+	bench-tpu sched-bench webhook-bench dryrun clean
 
 all: native
 
@@ -20,6 +20,11 @@ test: native
 
 test-native:
 	$(MAKE) -C native test
+
+# Coverage gate (>=45%, matching the reference's Makefile:81-90) via the
+# dependency-free sys.monitoring tracker in tools/pycov.py.
+check-coverage: native
+	$(PY) tools/pycov.py --min 45
 
 asan:
 	$(MAKE) -C native asan

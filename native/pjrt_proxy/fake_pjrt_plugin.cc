@@ -1,0 +1,109 @@
+/*
+ * libtpf_fake_pjrt.so — a minimal stand-in "vendor" PJRT plugin.
+ *
+ * Implements just enough of the PJRT C API table for the proxy selftest
+ * to exercise libtpf_pjrt_proxy.so end-to-end without TPU hardware:
+ * Execute / GetExecutable / GetCostAnalysis / BufferFromHostBuffer /
+ * OnDeviceSizeInBytes / Buffer_Destroy, each counting its calls
+ * (tpf_fake_calls) so the test can assert the proxy forwards faithfully.
+ * The analog of the reference's mock driver chain
+ * (provider/example/device_mock) applied to the interception layer.
+ */
+
+#include <stdint.h>
+#include <string.h>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+namespace {
+
+struct FakeCalls {
+  uint64_t execute = 0;
+  uint64_t buffer_from_host = 0;
+  uint64_t buffer_destroy = 0;
+  uint64_t cost_analysis = 0;
+};
+FakeCalls g_calls;
+
+/* Every executable "costs" this many FLOPs (100 MFLOP). */
+constexpr float kFakeFlops = 100e6f;
+/* Every buffer "occupies" this many device bytes. */
+constexpr uint64_t kFakeBufferBytes = 1 << 20;
+
+uintptr_t g_next_buffer = 0x1000;
+
+PJRT_Error* fake_execute(PJRT_LoadedExecutable_Execute_Args*) {
+  ++g_calls.execute;
+  return nullptr;
+}
+
+PJRT_Error* fake_get_executable(
+    PJRT_LoadedExecutable_GetExecutable_Args* args) {
+  args->executable =
+      reinterpret_cast<PJRT_Executable*>(args->loaded_executable);
+  return nullptr;
+}
+
+PJRT_Error* fake_cost_analysis(PJRT_Executable_GetCostAnalysis_Args* args) {
+  ++g_calls.cost_analysis;
+  static PJRT_NamedValue props[1];
+  memset(props, 0, sizeof(props));
+  props[0].struct_size = PJRT_NamedValue_STRUCT_SIZE;
+  props[0].name = "flops";
+  props[0].name_size = 5;
+  props[0].type = PJRT_NamedValue_kFloat;
+  props[0].float_value = kFakeFlops;
+  props[0].value_size = 1;
+  args->num_properties = 1;
+  args->properties = props;
+  return nullptr;
+}
+
+PJRT_Error* fake_buffer_from_host(
+    PJRT_Client_BufferFromHostBuffer_Args* args) {
+  ++g_calls.buffer_from_host;
+  args->buffer = reinterpret_cast<PJRT_Buffer*>(g_next_buffer);
+  g_next_buffer += 0x10;
+  args->done_with_host_buffer = nullptr;
+  return nullptr;
+}
+
+PJRT_Error* fake_on_device_size(PJRT_Buffer_OnDeviceSizeInBytes_Args* args) {
+  args->on_device_size_in_bytes = kFakeBufferBytes;
+  return nullptr;
+}
+
+PJRT_Error* fake_buffer_destroy(PJRT_Buffer_Destroy_Args*) {
+  ++g_calls.buffer_destroy;
+  return nullptr;
+}
+
+PJRT_Api g_api;
+
+}  // namespace
+
+extern "C" {
+
+const PJRT_Api* GetPjrtApi(void) {
+  memset(&g_api, 0, sizeof(g_api));
+  g_api.struct_size = PJRT_Api_STRUCT_SIZE;
+  g_api.pjrt_api_version.major_version = PJRT_API_MAJOR;
+  g_api.pjrt_api_version.minor_version = PJRT_API_MINOR;
+  g_api.PJRT_LoadedExecutable_Execute = fake_execute;
+  g_api.PJRT_LoadedExecutable_GetExecutable = fake_get_executable;
+  g_api.PJRT_Executable_GetCostAnalysis = fake_cost_analysis;
+  g_api.PJRT_Client_BufferFromHostBuffer = fake_buffer_from_host;
+  g_api.PJRT_Buffer_OnDeviceSizeInBytes = fake_on_device_size;
+  g_api.PJRT_Buffer_Destroy = fake_buffer_destroy;
+  return &g_api;
+}
+
+void tpf_fake_calls(uint64_t* execute, uint64_t* buffer_from_host,
+                    uint64_t* buffer_destroy, uint64_t* cost_analysis) {
+  if (execute) *execute = g_calls.execute;
+  if (buffer_from_host) *buffer_from_host = g_calls.buffer_from_host;
+  if (buffer_destroy) *buffer_destroy = g_calls.buffer_destroy;
+  if (cost_analysis) *cost_analysis = g_calls.cost_analysis;
+}
+
+}  // extern "C"

@@ -1,0 +1,344 @@
+/*
+ * libtpf_pjrt_proxy.so — mandatory (non-cooperative) vTPU metering.
+ *
+ * The reference enforces its limiter with an LD_PRELOAD CUDA intercept the
+ * client cannot opt out of (provider/limiter.h:71-106, consumed by the
+ * closed-source libcuda_limiter.so).  The TPU-native equivalent is a
+ * *wrapper PJRT plugin*: point the client's plugin discovery at this .so
+ * (TPU_LIBRARY_PATH / PJRT_NAMES_AND_LIBRARY_PATHS / axon register
+ * so_path) and set TPF_REAL_PJRT_PLUGIN to the vendor plugin.  GetPjrtApi
+ * returns the vendor's full API table with three entries interposed:
+ *
+ *   PJRT_LoadedExecutable_Execute      -> charge the program's MFLOP cost
+ *        (from PJRT_Executable_GetCostAnalysis, cached per executable)
+ *        against the worker's shm token bucket; sleep the limiter's wait
+ *        hints while the bucket is dry — this is how the hypervisor's ERL
+ *        controller shapes an *unmodified* JAX / PyTorch-XLA process.
+ *   PJRT_Client_BufferFromHostBuffer   -> charge device HBM on success
+ *        (size from PJRT_Buffer_OnDeviceSizeInBytes).
+ *   PJRT_Buffer_Destroy                -> release the buffer's HBM charge.
+ *
+ * HBM charges are *accounted* (surfaced to the hypervisor through the shm
+ * segment; over-budget attempts are counted in the stats and logged) but
+ * not failed inline: PJRT_Error objects can only be minted by the vendor
+ * plugin, and hard HBM enforcement belongs to the provider's device-level
+ * cap (tpf_set_hbm_hard_limit).  Compute IS enforced, by blocking.
+ *
+ * The limiter is reached through dlopen(TPF_LIMITER_LIB) so this .so has
+ * no link-time dependencies beyond libdl; with no TPF_SHM_PATH the proxy
+ * degrades to a transparent pass-through (fail-open, like the reference's
+ * hook when the hypervisor is absent).
+ */
+
+#include <dlfcn.h>
+#include <pthread.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+
+#include <unordered_map>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+/* tfl_* ABI mirror (tpufusion/limiter.h) — redeclared locally so the
+ * proxy compiles against only the PJRT headers. */
+extern "C" {
+typedef int32_t tpf_status_t;
+typedef struct {
+  uint8_t allowed;
+  uint8_t frozen;
+  uint64_t available;
+  uint64_t wait_hint_us;
+} tfl_charge_result_t;
+typedef tpf_status_t (*tfl_attach_fn)(const char*);
+typedef tpf_status_t (*tfl_charge_compute_fn)(uint32_t, uint64_t,
+                                              tfl_charge_result_t*);
+typedef tpf_status_t (*tfl_charge_hbm_fn)(uint32_t, int64_t,
+                                          tfl_charge_result_t*);
+typedef tpf_status_t (*tfl_self_register_pid_fn)(void);
+}
+
+namespace {
+
+struct ProxyState {
+  const PJRT_Api* real = nullptr;   /* vendor plugin's table            */
+  PJRT_Api api;                     /* our copy with interposed entries */
+  void* real_handle = nullptr;
+  void* limiter_handle = nullptr;
+  tfl_charge_compute_fn charge_compute = nullptr;
+  tfl_charge_hbm_fn charge_hbm = nullptr;
+  uint32_t device_index = 0;
+  bool metered = false;
+
+  /* stats (tpf_proxy_stats) */
+  uint64_t launches = 0;
+  uint64_t charged_mflops = 0;
+  uint64_t blocked_us = 0;
+  int64_t hbm_charged_bytes = 0;
+  uint64_t hbm_denied = 0;
+
+  pthread_mutex_t mu = PTHREAD_MUTEX_INITIALIZER;
+  std::unordered_map<PJRT_LoadedExecutable*, uint64_t> exec_cost;
+  std::unordered_map<PJRT_Buffer*, uint64_t> buffer_bytes;
+};
+
+ProxyState g_state;
+
+void logmsg(const char* msg) {
+  if (getenv("TPF_PJRT_PROXY_VERBOSE"))
+    fprintf(stderr, "[tpf_pjrt_proxy] %s\n", msg);
+}
+
+/* ------------------------------------------------------------------ */
+/* cost estimation                                                     */
+/* ------------------------------------------------------------------ */
+
+uint64_t cost_mflops_locked(PJRT_LoadedExecutable* loaded) {
+  auto it = g_state.exec_cost.find(loaded);
+  if (it != g_state.exec_cost.end()) return it->second;
+
+  uint64_t mflops = 1; /* flat-rate fallback, like the python runtime */
+  const PJRT_Api* api = g_state.real;
+  if (api->PJRT_LoadedExecutable_GetExecutable &&
+      api->PJRT_Executable_GetCostAnalysis) {
+    PJRT_LoadedExecutable_GetExecutable_Args ga;
+    memset(&ga, 0, sizeof(ga));
+    ga.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+    ga.loaded_executable = loaded;
+    PJRT_Error* err = api->PJRT_LoadedExecutable_GetExecutable(&ga);
+    if (err == nullptr && ga.executable != nullptr) {
+      PJRT_Executable_GetCostAnalysis_Args ca;
+      memset(&ca, 0, sizeof(ca));
+      ca.struct_size = PJRT_Executable_GetCostAnalysis_Args_STRUCT_SIZE;
+      ca.executable = ga.executable;
+      err = api->PJRT_Executable_GetCostAnalysis(&ca);
+      if (err == nullptr) {
+        for (size_t i = 0; i < ca.num_properties; ++i) {
+          const PJRT_NamedValue& p = ca.properties[i];
+          if (p.name_size == 5 && strncmp(p.name, "flops", 5) == 0) {
+            double flops = 0.0;
+            if (p.type == PJRT_NamedValue_kFloat) flops = p.float_value;
+            else if (p.type == PJRT_NamedValue_kInt64) {
+              flops = (double)p.int64_value;
+            }
+            if (flops > 0) {
+              mflops = (uint64_t)(flops / 1e6);
+              if (mflops == 0) mflops = 1;
+            }
+          }
+        }
+      } else if (api->PJRT_Error_Destroy) {
+        PJRT_Error_Destroy_Args da;
+        memset(&da, 0, sizeof(da));
+        da.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+        da.error = err;
+        api->PJRT_Error_Destroy(&da);
+      }
+    } else if (err != nullptr && api->PJRT_Error_Destroy) {
+      PJRT_Error_Destroy_Args da;
+      memset(&da, 0, sizeof(da));
+      da.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+      da.error = err;
+      api->PJRT_Error_Destroy(&da);
+    }
+  }
+  g_state.exec_cost.emplace(loaded, mflops);
+  return mflops;
+}
+
+/* ------------------------------------------------------------------ */
+/* interceptors                                                        */
+/* ------------------------------------------------------------------ */
+
+PJRT_Error* proxy_execute(PJRT_LoadedExecutable_Execute_Args* args) {
+  if (g_state.metered) {
+    pthread_mutex_lock(&g_state.mu);
+    uint64_t mflops = cost_mflops_locked(args->executable);
+    pthread_mutex_unlock(&g_state.mu);
+    uint64_t total = mflops * (args->num_devices ? args->num_devices : 1);
+
+    tfl_charge_result_t r;
+    while (true) {
+      if (g_state.charge_compute(g_state.device_index, total, &r) != 0)
+        break; /* limiter error: fail open */
+      if (r.allowed) break;
+      uint64_t us = r.wait_hint_us ? r.wait_hint_us : 100;
+      struct timespec ts = {(time_t)(us / 1000000),
+                            (long)((us % 1000000) * 1000)};
+      nanosleep(&ts, nullptr);
+      __atomic_add_fetch(&g_state.blocked_us, us, __ATOMIC_RELAXED);
+    }
+    __atomic_add_fetch(&g_state.launches, 1, __ATOMIC_RELAXED);
+    __atomic_add_fetch(&g_state.charged_mflops, total, __ATOMIC_RELAXED);
+  }
+  return g_state.real->PJRT_LoadedExecutable_Execute(args);
+}
+
+PJRT_Error* proxy_buffer_from_host(
+    PJRT_Client_BufferFromHostBuffer_Args* args) {
+  PJRT_Error* err = g_state.real->PJRT_Client_BufferFromHostBuffer(args);
+  if (err == nullptr && g_state.metered && args->buffer != nullptr &&
+      g_state.real->PJRT_Buffer_OnDeviceSizeInBytes) {
+    PJRT_Buffer_OnDeviceSizeInBytes_Args sa;
+    memset(&sa, 0, sizeof(sa));
+    sa.struct_size = PJRT_Buffer_OnDeviceSizeInBytes_Args_STRUCT_SIZE;
+    sa.buffer = args->buffer;
+    PJRT_Error* serr = g_state.real->PJRT_Buffer_OnDeviceSizeInBytes(&sa);
+    if (serr == nullptr && sa.on_device_size_in_bytes > 0) {
+      uint64_t size = sa.on_device_size_in_bytes;
+      tfl_charge_result_t r;
+      if (g_state.charge_hbm(g_state.device_index, (int64_t)size, &r) == 0) {
+        if (!r.allowed) {
+          /* over the HBM budget: account + surface, enforcement is the
+           * provider's device-level hard cap (see header comment) */
+          __atomic_add_fetch(&g_state.hbm_denied, 1, __ATOMIC_RELAXED);
+          logmsg("HBM budget exceeded (accounted)");
+        }
+        __atomic_add_fetch(&g_state.hbm_charged_bytes, (int64_t)size,
+                           __ATOMIC_RELAXED);
+        pthread_mutex_lock(&g_state.mu);
+        g_state.buffer_bytes[args->buffer] = size;
+        pthread_mutex_unlock(&g_state.mu);
+      }
+    } else if (serr != nullptr && g_state.real->PJRT_Error_Destroy) {
+      PJRT_Error_Destroy_Args da;
+      memset(&da, 0, sizeof(da));
+      da.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+      da.error = serr;
+      g_state.real->PJRT_Error_Destroy(&da);
+    }
+  }
+  return err;
+}
+
+PJRT_Error* proxy_buffer_destroy(PJRT_Buffer_Destroy_Args* args) {
+  if (g_state.metered && args->buffer != nullptr) {
+    uint64_t size = 0;
+    pthread_mutex_lock(&g_state.mu);
+    auto it = g_state.buffer_bytes.find(args->buffer);
+    if (it != g_state.buffer_bytes.end()) {
+      size = it->second;
+      g_state.buffer_bytes.erase(it);
+    }
+    pthread_mutex_unlock(&g_state.mu);
+    if (size > 0) {
+      tfl_charge_result_t r;
+      g_state.charge_hbm(g_state.device_index, -(int64_t)size, &r);
+      __atomic_sub_fetch(&g_state.hbm_charged_bytes, (int64_t)size,
+                         __ATOMIC_RELAXED);
+    }
+  }
+  return g_state.real->PJRT_Buffer_Destroy(args);
+}
+
+/* ------------------------------------------------------------------ */
+/* init                                                                */
+/* ------------------------------------------------------------------ */
+
+bool attach_limiter() {
+  const char* shm_path = getenv("TPF_SHM_PATH");
+  if (shm_path == nullptr || shm_path[0] == '\0') {
+    logmsg("no TPF_SHM_PATH: pass-through (unmetered)");
+    return false;
+  }
+  const char* lib = getenv("TPF_LIMITER_LIB");
+  if (lib == nullptr) lib = "libtpf_limiter.so";
+  g_state.limiter_handle = dlopen(lib, RTLD_NOW | RTLD_LOCAL);
+  if (g_state.limiter_handle == nullptr) {
+    fprintf(stderr, "[tpf_pjrt_proxy] cannot dlopen limiter %s: %s "
+            "(running unmetered)\n", lib, dlerror());
+    return false;
+  }
+  auto attach = (tfl_attach_fn)dlsym(g_state.limiter_handle, "tfl_attach");
+  auto self_pid = (tfl_self_register_pid_fn)dlsym(g_state.limiter_handle,
+                                                  "tfl_self_register_pid");
+  g_state.charge_compute = (tfl_charge_compute_fn)dlsym(
+      g_state.limiter_handle, "tfl_charge_compute");
+  g_state.charge_hbm = (tfl_charge_hbm_fn)dlsym(g_state.limiter_handle,
+                                                "tfl_charge_hbm");
+  if (attach == nullptr || g_state.charge_compute == nullptr ||
+      g_state.charge_hbm == nullptr) {
+    fprintf(stderr, "[tpf_pjrt_proxy] limiter ABI incomplete; unmetered\n");
+    return false;
+  }
+  if (attach(shm_path) != 0) {
+    fprintf(stderr, "[tpf_pjrt_proxy] tfl_attach(%s) failed; unmetered\n",
+            shm_path);
+    return false;
+  }
+  if (self_pid != nullptr) self_pid();
+  const char* idx = getenv("TPF_DEVICE_INDEX");
+  if (idx != nullptr) g_state.device_index = (uint32_t)atoi(idx);
+  logmsg("metering active");
+  return true;
+}
+
+const PJRT_Api* load_real() {
+  const char* path = getenv("TPF_REAL_PJRT_PLUGIN");
+  if (path == nullptr || path[0] == '\0') {
+    fprintf(stderr, "[tpf_pjrt_proxy] TPF_REAL_PJRT_PLUGIN is not set\n");
+    return nullptr;
+  }
+  g_state.real_handle = dlopen(path, RTLD_NOW | RTLD_GLOBAL);
+  if (g_state.real_handle == nullptr) {
+    fprintf(stderr, "[tpf_pjrt_proxy] dlopen(%s): %s\n", path, dlerror());
+    return nullptr;
+  }
+  typedef const PJRT_Api* (*GetPjrtApiFn)(void);
+  auto get_api = (GetPjrtApiFn)dlsym(g_state.real_handle, "GetPjrtApi");
+  if (get_api == nullptr) {
+    fprintf(stderr, "[tpf_pjrt_proxy] %s exports no GetPjrtApi\n", path);
+    return nullptr;
+  }
+  return get_api();
+}
+
+}  // namespace
+
+extern "C" {
+
+const PJRT_Api* GetPjrtApi(void) {
+  static pthread_mutex_t init_mu = PTHREAD_MUTEX_INITIALIZER;
+  pthread_mutex_lock(&init_mu);
+  if (g_state.real == nullptr) {
+    const PJRT_Api* real = load_real();
+    if (real == nullptr) {
+      pthread_mutex_unlock(&init_mu);
+      return nullptr;
+    }
+    g_state.real = real;
+    /* copy the vendor table (bounded by both struct sizes), then patch
+     * in the interceptors; callers only ever see our copy */
+    memset(&g_state.api, 0, sizeof(g_state.api));
+    size_t n = real->struct_size < sizeof(g_state.api)
+                   ? real->struct_size
+                   : sizeof(g_state.api);
+    memcpy(&g_state.api, real, n);
+    g_state.metered = attach_limiter();
+    if (real->PJRT_LoadedExecutable_Execute)
+      g_state.api.PJRT_LoadedExecutable_Execute = proxy_execute;
+    if (real->PJRT_Client_BufferFromHostBuffer)
+      g_state.api.PJRT_Client_BufferFromHostBuffer = proxy_buffer_from_host;
+    if (real->PJRT_Buffer_Destroy)
+      g_state.api.PJRT_Buffer_Destroy = proxy_buffer_destroy;
+  }
+  pthread_mutex_unlock(&init_mu);
+  return &g_state.api;
+}
+
+/* Introspection for tests / the bench harness. */
+void tpf_proxy_stats(uint64_t* launches, uint64_t* charged_mflops,
+                     uint64_t* blocked_us, int64_t* hbm_charged_bytes,
+                     uint64_t* hbm_denied) {
+  if (launches) *launches = g_state.launches;
+  if (charged_mflops) *charged_mflops = g_state.charged_mflops;
+  if (blocked_us) *blocked_us = g_state.blocked_us;
+  if (hbm_charged_bytes) *hbm_charged_bytes = g_state.hbm_charged_bytes;
+  if (hbm_denied) *hbm_denied = g_state.hbm_denied;
+}
+
+uint8_t tpf_proxy_metered(void) { return g_state.metered ? 1 : 0; }
+
+}  // extern "C"

@@ -1,0 +1,172 @@
+/*
+ * Selftest for libtpf_pjrt_proxy.so — mandatory metering of an unmodified
+ * PJRT client.
+ *
+ * Drives the proxy exactly the way JAX would (GetPjrtApi, then calls
+ * through the returned table) against the fake vendor plugin, with a real
+ * worker shm segment created through the limiter's hypervisor face:
+ *
+ *   1. compute enforcement: a rate-limited quota makes a burst of
+ *      Execute calls measurably block (wall clock + blocked_us stats);
+ *   2. cost caching: GetCostAnalysis is consulted once per executable;
+ *   3. HBM accounting: BufferFromHostBuffer charges device bytes,
+ *      Buffer_Destroy releases them, an over-budget create is counted;
+ *   4. pass-through: every intercepted call reaches the vendor table.
+ *
+ * Usage: pjrt_proxy_selftest <proxy.so> <fake.so> <limiter.so> <shm_base>
+ */
+
+#include <dlfcn.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+extern "C" {
+typedef int32_t tpf_status_t;
+typedef struct {
+  uint32_t device_index;
+  char chip_id[64];
+  uint32_t duty_limit_bp;
+  uint64_t hbm_limit_bytes;
+  uint64_t capacity_mflop;
+  uint64_t refill_mflop_per_s;
+} tfl_device_quota_t;
+}
+
+#define CHECK(cond)                                                     \
+  do {                                                                  \
+    if (!(cond)) {                                                      \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond);   \
+      return 1;                                                         \
+    }                                                                   \
+  } while (0)
+
+static double now_s() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec + ts.tv_nsec / 1e9;
+}
+
+int main(int argc, char** argv) {
+  if (argc != 5) {
+    fprintf(stderr,
+            "usage: %s <proxy.so> <fake.so> <limiter.so> <shm_base>\n",
+            argv[0]);
+    return 2;
+  }
+  const char* proxy_path = argv[1];
+  const char* fake_path = argv[2];
+  const char* limiter_path = argv[3];
+  const char* shm_base = argv[4];
+
+  /* -- hypervisor face: create the worker segment ------------------- */
+  void* lim = dlopen(limiter_path, RTLD_NOW);
+  CHECK(lim != nullptr);
+  auto tfl_init = (tpf_status_t(*)(const char*))dlsym(lim, "tfl_init");
+  auto tfl_create_worker = (tpf_status_t(*)(
+      const char*, const char*, const tfl_device_quota_t*, size_t))
+      dlsym(lim, "tfl_create_worker");
+  CHECK(tfl_init && tfl_create_worker);
+  CHECK(tfl_init(shm_base) == 0);
+
+  tfl_device_quota_t quota;
+  memset(&quota, 0, sizeof(quota));
+  quota.device_index = 0;
+  snprintf(quota.chip_id, sizeof(quota.chip_id), "fake-chip");
+  quota.duty_limit_bp = 10000;
+  quota.hbm_limit_bytes = (uint64_t)(2.5 * (1 << 20)); /* 2.5 MiB */
+  quota.capacity_mflop = 200;          /* one 100-MFLOP launch buffered */
+  quota.refill_mflop_per_s = 1000;     /* ~10 launches/second          */
+  CHECK(tfl_create_worker("t", "w", &quota, 1) == 0);
+
+  /* -- worker face: load the proxy like JAX would ------------------- */
+  char shm_path[512];
+  snprintf(shm_path, sizeof(shm_path), "%s/t/w", shm_base);
+  setenv("TPF_SHM_PATH", shm_path, 1);
+  setenv("TPF_REAL_PJRT_PLUGIN", fake_path, 1);
+  setenv("TPF_LIMITER_LIB", limiter_path, 1);
+
+  void* proxy = dlopen(proxy_path, RTLD_NOW);
+  CHECK(proxy != nullptr);
+  typedef const PJRT_Api* (*GetPjrtApiFn)(void);
+  auto get_api = (GetPjrtApiFn)dlsym(proxy, "GetPjrtApi");
+  auto proxy_stats = (void (*)(uint64_t*, uint64_t*, uint64_t*, int64_t*,
+                               uint64_t*))dlsym(proxy, "tpf_proxy_stats");
+  auto proxy_metered = (uint8_t(*)(void))dlsym(proxy, "tpf_proxy_metered");
+  CHECK(get_api && proxy_stats && proxy_metered);
+
+  const PJRT_Api* api = get_api();
+  CHECK(api != nullptr);
+  CHECK(proxy_metered() == 1);
+  CHECK(api->PJRT_LoadedExecutable_Execute != nullptr);
+
+  void* fake = dlopen(fake_path, RTLD_NOW); /* same handle the proxy got */
+  CHECK(fake != nullptr);
+  auto fake_calls = (void (*)(uint64_t*, uint64_t*, uint64_t*, uint64_t*))
+      dlsym(fake, "tpf_fake_calls");
+  CHECK(fake_calls != nullptr);
+
+  /* -- 1+2: compute enforcement + cost caching ---------------------- */
+  PJRT_LoadedExecutable_Execute_Args ex;
+  memset(&ex, 0, sizeof(ex));
+  ex.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+  ex.executable = reinterpret_cast<PJRT_LoadedExecutable*>(0xBEEF);
+  ex.num_devices = 1;
+
+  const int kLaunches = 10; /* 10 x 100 MFLOP at 1000 MFLOP/s refill */
+  double t0 = now_s();
+  for (int i = 0; i < kLaunches; ++i)
+    CHECK(api->PJRT_LoadedExecutable_Execute(&ex) == nullptr);
+  double elapsed = now_s() - t0;
+
+  uint64_t launches, charged, blocked_us, hbm_denied;
+  int64_t hbm_charged;
+  proxy_stats(&launches, &charged, &blocked_us, &hbm_charged, &hbm_denied);
+  CHECK(launches == kLaunches);
+  CHECK(charged == (uint64_t)kLaunches * 100);
+  CHECK(blocked_us > 0);
+  CHECK(elapsed > 0.5); /* 1000 MFLOP - 200 burst at 1000/s => >= ~0.8s */
+
+  uint64_t f_exec, f_bfh, f_bd, f_cost;
+  fake_calls(&f_exec, &f_bfh, &f_bd, &f_cost);
+  CHECK(f_exec == kLaunches);
+  CHECK(f_cost == 1); /* cached after the first launch */
+
+  /* -- 3: HBM accounting -------------------------------------------- */
+  PJRT_Buffer* buffers[3];
+  for (int i = 0; i < 3; ++i) {
+    PJRT_Client_BufferFromHostBuffer_Args ba;
+    memset(&ba, 0, sizeof(ba));
+    ba.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+    CHECK(api->PJRT_Client_BufferFromHostBuffer(&ba) == nullptr);
+    CHECK(ba.buffer != nullptr);
+    buffers[i] = ba.buffer;
+  }
+  proxy_stats(nullptr, nullptr, nullptr, &hbm_charged, &hbm_denied);
+  CHECK(hbm_charged == 3 * (1 << 20));  /* 3 x 1 MiB tracked */
+  CHECK(hbm_denied >= 1);               /* third exceeded 2.5 MiB budget */
+
+  for (int i = 0; i < 3; ++i) {
+    PJRT_Buffer_Destroy_Args da;
+    memset(&da, 0, sizeof(da));
+    da.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+    da.buffer = buffers[i];
+    CHECK(api->PJRT_Buffer_Destroy(&da) == nullptr);
+  }
+  proxy_stats(nullptr, nullptr, nullptr, &hbm_charged, nullptr);
+  CHECK(hbm_charged == 0);
+
+  fake_calls(&f_exec, &f_bfh, &f_bd, &f_cost);
+  CHECK(f_bfh == 3);
+  CHECK(f_bd == 3);
+
+  printf("PASS pjrt_proxy_selftest: %d launches metered "
+         "(%.2fs wall, %lums blocked), hbm tracked+released, "
+         "cost cached\n",
+         kLaunches, elapsed, (unsigned long)(blocked_us / 1000));
+  return 0;
+}

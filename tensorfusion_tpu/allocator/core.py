@@ -77,9 +77,16 @@ class ChipState:
     """Mutable allocator-side state for one TPUChip."""
 
     def __init__(self, chip: TPUChip, oversell_ratio: float = 1.0,
-                 template_cores: Optional[Dict[str, int]] = None):
+                 template_cores: Optional[Dict[str, int]] = None,
+                 hbm_expand_ratio: float = 1.0):
         self.chip = chip
         self.oversell_ratio = oversell_ratio
+        #: schedulable-HBM multiplier from the pool's host-expansion config
+        #: (gpupool_types.go:64-77 vramExpandToHostMem/Disk analog): the
+        #: slack beyond 1.0 is host-RAM/disk-backed — workers placed into
+        #: it must spill (client runtime host offload), surfaced per chip
+        #: as the hbm_spill_bytes metric
+        self.hbm_expand_ratio = hbm_expand_ratio
         self._template_cores = template_cores or {}
         self.allocated = ResourceAmount()
         self.holders: Dict[str, ResourceAmount] = {}   # pod key -> per-chip amt
@@ -92,7 +99,14 @@ class ChipState:
         cap = self.chip.status.capacity
         return ResourceAmount(tflops=cap.tflops * self.oversell_ratio,
                               duty_percent=100.0 * self.oversell_ratio,
-                              hbm_bytes=cap.hbm_bytes)
+                              hbm_bytes=cap.hbm_bytes
+                              * self.hbm_expand_ratio)
+
+    def hbm_spill_bytes(self) -> float:
+        """Allocated HBM beyond the chip's physical capacity — the
+        host-backed (spill) portion of the expansion budget in use."""
+        return max(0.0, self.allocated.hbm_bytes
+                   - self.chip.status.capacity.hbm_bytes)
 
     def available(self) -> ResourceAmount:
         if self._avail_cache is None:
@@ -158,6 +172,7 @@ class TPUAllocator:
         self._allocations: Dict[str, AllocRecord] = {}
         self._dirty: set = set()
         self._pool_oversell: Dict[str, float] = {}
+        self._pool_hbm_expand: Dict[str, float] = {}
         self._template_cores: Dict[str, int] = {}
         self._node_labels = node_labels or (lambda node: {})
         self._filters: List[Filter] = default_chain(
@@ -174,6 +189,22 @@ class TPUAllocator:
             for name in self._pool_chips.get(pool, ()):  # re-rate chips
                 state = self._chips[name]
                 state.oversell_ratio = self._pool_oversell[pool]
+                state.invalidate()
+            self._views.clear()
+
+    def set_pool_hbm_expansion(self, pool: str, host_mem_percent: float,
+                               host_disk_percent: float) -> None:
+        """Schedulable HBM = physical * (1 + mem% + disk%): the expansion
+        slack is host-backed, consumed by workers whose budget exceeds
+        their physical share (gpupool_types.go:64-77 analog)."""
+        from ..api.types import hbm_expansion_ratio
+
+        with self._lock:
+            ratio = hbm_expansion_ratio(host_mem_percent, host_disk_percent)
+            self._pool_hbm_expand[pool] = ratio
+            for name in self._pool_chips.get(pool, ()):
+                state = self._chips[name]
+                state.hbm_expand_ratio = ratio
                 state.invalidate()
             self._views.clear()
 
@@ -197,12 +228,15 @@ class TPUAllocator:
             state = self._chips.get(chip.name)
             pool = chip.status.pool
             ratio = self._pool_oversell.get(pool, 1.0)
+            hbm_ratio = self._pool_hbm_expand.get(pool, 1.0)
             if state is None:
-                state = ChipState(chip, ratio, self._template_cores)
+                state = ChipState(chip, ratio, self._template_cores,
+                                  hbm_expand_ratio=hbm_ratio)
                 self._chips[chip.name] = state
             else:
                 state.chip = chip
                 state.oversell_ratio = ratio
+                state.hbm_expand_ratio = hbm_ratio
             state.invalidate()
             self._node_chips.setdefault(chip.status.node_name,
                                         set()).add(chip.name)
@@ -343,7 +377,8 @@ class TPUAllocator:
 
     def _clone_chip_state(self, state: ChipState) -> ChipState:
         clone = ChipState(state.chip, state.oversell_ratio,
-                          state._template_cores)
+                          state._template_cores,
+                          hbm_expand_ratio=state.hbm_expand_ratio)
         clone.allocated = state.allocated
         clone.holders = dict(state.holders)
         clone.partition_cores_used = state.partition_cores_used
@@ -389,6 +424,65 @@ class TPUAllocator:
                            nreq.partition_template)
             res = run_filters(self._filters, req, clones)
             return len(res.chips) >= req.chip_count
+
+    def simulate_placement(self, reqs: List[AllocRequest],
+                           skip_quota: bool = True
+                           ) -> Optional[Dict[str, str]]:
+        """All-or-nothing placement dry run: can every request in ``reqs``
+        be placed *simultaneously*?  Capacity is held incrementally as each
+        request is placed, so later members see earlier members' holds;
+        every hold is rolled back before returning — pure simulation.
+
+        Returns ``{req.key(): node}`` on success, None if any member has
+        no placement.  Conservative by design: the callers' own current
+        allocations (e.g. gang members about to be drained) still count as
+        used, so a True answer under-promises.  Backs gang-atomic defrag
+        drains and the simulate-schedule API (gpupool_defrag.go drain +
+        gang/manager.go all-or-nothing semantics).
+        """
+        with self._lock:
+            held: List[Tuple[ChipState, str, str]] = []
+            touched: List[str] = []
+            placements: Dict[str, str] = {}
+            try:
+                for req in reqs:
+                    try:
+                        by_node, _ = self.check_quota_and_filter(
+                            req, skip_quota=skip_quota)
+                    except QuotaExceededError:
+                        return None
+                    if not by_node:
+                        return None
+                    scores = self.score_nodes(req, by_node)
+                    per_chip = ResourceAmount(
+                        tflops=req.request.tflops,
+                        duty_percent=req.request.duty_percent,
+                        hbm_bytes=req.request.hbm_bytes)
+                    placed_node = None
+                    for node in sorted(
+                            by_node, key=lambda n: -scores.get(n, 0.0)):
+                        try:
+                            chosen = self.select(req, list(by_node[node]))
+                        except InsufficientResourcesError:
+                            continue
+                        for c in chosen:
+                            c.hold(req.key(), per_chip,
+                                   req.partition_template)
+                            held.append((c, req.key(),
+                                         req.partition_template))
+                            touched.append(c.chip.name)
+                        self._refresh_views([c.chip.name for c in chosen])
+                        placed_node = node
+                        break
+                    if placed_node is None:
+                        return None
+                    placements[req.key()] = placed_node
+                return placements
+            finally:
+                for c, key, tmpl in held:
+                    c.drop(key, tmpl)
+                if touched:
+                    self._refresh_views(touched)
 
     # -- two-phase allocation ---------------------------------------------
 

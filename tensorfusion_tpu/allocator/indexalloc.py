@@ -50,12 +50,32 @@ class IndexAllocator:
     def reconcile(self, assignments: Dict[str, int]) -> None:
         """Rebuild from persisted pod annotations.  Out-of-range indices
         (corrupt or foreign annotations) are dropped so one bad value can
-        neither bypass the max_index bound nor balloon the free list."""
+        neither bypass the max_index bound nor balloon the free list.
+        Duplicate indices (corrupt or copy-pasted annotations) would break
+        the index's device-slot-correlation contract, so only the first
+        owner (deterministic: lexicographic order) keeps the index and
+        every later claimant is reassigned a fresh one."""
         with self._lock:
-            self._by_owner = {owner: idx for owner, idx
-                              in assignments.items()
-                              if 0 <= idx < self.max_index}
+            self._by_owner = {}
+            displaced = []
+            claimed: Dict[int, str] = {}
+            for owner in sorted(assignments):
+                idx = assignments[owner]
+                if not 0 <= idx < self.max_index:
+                    continue
+                if idx in claimed:
+                    displaced.append(owner)
+                    continue
+                claimed[idx] = owner
+                self._by_owner[owner] = idx
             used = set(self._by_owner.values())
             self._next = max(used) + 1 if used else 0
             self._free = [i for i in range(self._next) if i not in used]
             heapq.heapify(self._free)
+            for owner in displaced:
+                try:
+                    self.assign(owner)
+                except IndexExhaustedError:
+                    # restart recovery must never throw: the displaced
+                    # owner simply loses its index (re-assigned on demand)
+                    pass

@@ -144,6 +144,15 @@ class TPUCluster(Resource):
 # --------------------------------------------------------------------------
 
 
+def hbm_expansion_ratio(host_mem_percent: float,
+                        host_disk_percent: float) -> float:
+    """Schedulable-HBM multiplier from the host-expansion percents — the
+    single definition shared by the allocator's chip rating and the pool
+    status rollup (gpupool_types.go:64-77 analog)."""
+    return 1.0 + max(host_mem_percent, 0.0) / 100.0 \
+        + max(host_disk_percent, 0.0) / 100.0
+
+
 @dataclass
 class OversubscriptionConfig:
     """(ref: gpupool_types.go:64-85)"""
@@ -153,6 +162,10 @@ class OversubscriptionConfig:
         constants.DEFAULT_HBM_EXPAND_HOST_MEM_PERCENT
     hbm_expand_to_host_disk_percent: int = \
         constants.DEFAULT_HBM_EXPAND_HOST_DISK_PERCENT
+
+    def hbm_expand_ratio(self) -> float:
+        return hbm_expansion_ratio(self.hbm_expand_to_host_mem_percent,
+                                   self.hbm_expand_to_host_disk_percent)
 
 
 @dataclass

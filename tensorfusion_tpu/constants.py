@@ -203,6 +203,9 @@ ENV_VISIBLE_CORES = "TPF_VISIBLE_CORES"
 ENV_PARTITION_ID = "TPF_PARTITION_ID"
 ENV_CHIP_IDS = "TPF_CHIP_IDS"
 ENV_ISOLATION = "TPF_ISOLATION"
+ENV_DEVICE_MOUNTS = "TPF_DEVICE_MOUNTS"        # mount-policy host paths
+ENV_HBM_HOST_SPILL = "TPF_HBM_HOST_SPILL"      # bytes the client must offload
+ENV_REAL_PJRT_PLUGIN = "TPF_REAL_PJRT_PLUGIN"  # vendor plugin behind the proxy
 ENV_VTPU_ENABLED = "TPF_VTPU"                  # "1" auto-activates metering
 ENV_PROVIDER_LIB = "TPF_PROVIDER_LIB"
 ENV_LIMITER_LIB = "TPF_LIMITER_LIB"

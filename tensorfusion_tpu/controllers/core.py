@@ -88,6 +88,10 @@ class PoolController(Controller):
         for pool in pools:
             self.allocator.set_pool_oversell(
                 pool.name, pool.spec.capacity_config.tflops_oversell_percent)
+            self.allocator.set_pool_hbm_expansion(
+                pool.name,
+                pool.spec.capacity_config.hbm_expand_to_host_mem_percent,
+                pool.spec.capacity_config.hbm_expand_to_host_disk_percent)
             placement = "CompactFirst"
             if pool.spec.scheduling_config_template:
                 from ..api.types import SchedulingConfigTemplate
@@ -103,7 +107,8 @@ class PoolController(Controller):
                                       for c in members)
             ratio = pool.spec.capacity_config.tflops_oversell_percent / 100.0
             cap.virtual.tflops = cap.total.tflops * max(ratio, 1.0)
-            cap.virtual.hbm_bytes = cap.total.hbm_bytes
+            cap.virtual.hbm_bytes = cap.total.hbm_bytes * \
+                pool.spec.capacity_config.hbm_expand_ratio()
             cap.available.tflops = sum(c.status.available.tflops
                                        for c in members)
             cap.available.hbm_bytes = sum(c.status.available.hbm_bytes

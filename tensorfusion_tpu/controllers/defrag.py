@@ -29,6 +29,7 @@ from .. import constants
 from ..api.types import (Node, Pod, TPUChip, TPUNode, TPUNodeClaim,
                          TPUWorkload)
 from ..autoscaler.recommender import cron_matches
+from ..scheduler.gang import gang_info_from_pod
 from ..scheduler.tpuresources import compose_alloc_request
 from ..store import NotFoundError
 from .base import Controller
@@ -169,18 +170,31 @@ class CompactionController(Controller):
 
     def defrag_node(self, pool_name: str, node: str, cfg=None) -> int:
         """Migrate every workload off `node` if each fits elsewhere
-        (gpupool_defrag.go evict path).  Returns #evicted."""
+        (gpupool_defrag.go evict path).  Returns #evicted.
+
+        Gang members are drained *atomically*: the whole gang (including
+        members on other nodes — a partial replacement set could never
+        meet a strict gang's quorum and would live-lock) is re-placement-
+        probed with ``simulate_placement`` and either every member is
+        evicted or none is (gang/manager.go all-or-nothing semantics).
+        """
         pods = self.store.list(
             Pod, selector=lambda p: p.spec.node_name == node)
         evicted = 0
         now = str(time.time())
+        gangs_seen: set = set()
         for pod in pods:
             probe = compose_alloc_request(pod)
             if probe is None:
                 continue
-            if pod.metadata.annotations.get(
-                    constants.ANN_EVICTION_PROTECTION, "").lower() in (
-                        "true", "1"):
+            info = gang_info_from_pod(pod)
+            if info is not None:
+                group_key = info[0]
+                if group_key not in gangs_seen:
+                    gangs_seen.add(group_key)
+                    evicted += self._drain_gang(group_key, node, now)
+                continue
+            if self._protected(pod):
                 continue
             # capacity-only dry-run (the pod's own quota is still
             # committed, so a quota check would double-count it)
@@ -192,17 +206,8 @@ class CompactionController(Controller):
             except Exception:  # noqa: BLE001
                 by_node = {}
             if not by_node:
-                # mark the skip (defrag-evict-skip bookkeeping)
-                tnode = self.store.try_get(TPUNode, node)
-                if tnode is not None:
-                    tnode.metadata.labels[constants.LABEL_DEFRAG_SKIP] = \
-                        "true"
-                    tnode.metadata.annotations[
-                        constants.ANN_DEFRAG_SKIP_REASON] = \
-                        f"{pod.key()} has no alternative placement"
-                    tnode.metadata.annotations[
-                        constants.ANN_DEFRAG_SKIP_SINCE] = now
-                    self.store.update(tnode)
+                self._mark_skip(node, f"{pod.key()} has no alternative "
+                                      f"placement", now)
                 continue
             self._evict_for_defrag(pod, node, now)
             evicted += 1
@@ -216,6 +221,51 @@ class CompactionController(Controller):
                     constants.ANN_DEFRAG_SOURCE_POOL] = pool_name
                 self.store.update(tnode)
         return evicted
+
+    @staticmethod
+    def _protected(pod: Pod) -> bool:
+        return pod.metadata.annotations.get(
+            constants.ANN_EVICTION_PROTECTION, "").lower() in ("true", "1")
+
+    def _mark_skip(self, node: str, reason: str, now: str) -> None:
+        """Defrag-evict-skip bookkeeping on the node object."""
+        tnode = self.store.try_get(TPUNode, node)
+        if tnode is None:
+            return
+        tnode.metadata.labels[constants.LABEL_DEFRAG_SKIP] = "true"
+        tnode.metadata.annotations[constants.ANN_DEFRAG_SKIP_REASON] = reason
+        tnode.metadata.annotations[constants.ANN_DEFRAG_SKIP_SINCE] = now
+        self.store.update(tnode)
+
+    def _drain_gang(self, group_key: str, node: str, now: str) -> int:
+        """Atomically drain one gang off `node`: all members cluster-wide
+        are probed for simultaneous re-placement (drained node excluded);
+        on success every member is evicted, otherwise none.  Returns
+        #evicted."""
+        members = [p for p in self.store.list(Pod)
+                   if p.spec.node_name
+                   and (gang_info_from_pod(p) or (None,))[0] == group_key]
+        if not members:
+            return 0
+        if any(self._protected(p) for p in members):
+            self._mark_skip(node, f"gang {group_key} has an "
+                                  f"eviction-protected member", now)
+            return 0
+        probes = []
+        for p in members:
+            probe = compose_alloc_request(p)
+            if probe is None:
+                return 0
+            probe.pod_name += "-defrag-probe"
+            probe.excluded_nodes = list(set(probe.excluded_nodes) | {node})
+            probes.append(probe)
+        if self.allocator.simulate_placement(probes) is None:
+            self._mark_skip(node, f"gang {group_key} has no atomic "
+                                  f"alternative placement", now)
+            return 0
+        for p in members:
+            self._evict_for_defrag(p, node, now)
+        return len(members)
 
     def _evict_for_defrag(self, pod: Pod, node: str, now: str) -> None:
         log.info("defrag: evicting %s from %s", pod.key(), node)

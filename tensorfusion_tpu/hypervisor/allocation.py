@@ -34,12 +34,16 @@ class DeviceBinding:
     hbm_bytes: int
     host_index: int = -1           # chip's index on this host
     grant: Optional[PartitionGrant] = None
+    #: budget beyond the chip's physical HBM (pool host-expansion): the
+    #: client runtime must host-offload at least this much
+    host_spill_bytes: int = 0
 
 
 @dataclass
 class WorkerAllocation:
     spec: WorkerSpec
     bindings: List[DeviceBinding] = field(default_factory=list)
+    mounts: List[str] = field(default_factory=list)   # mount-policy result
 
     @property
     def env(self) -> Dict[str, str]:
@@ -56,12 +60,23 @@ class WorkerAllocation:
         # grants may override with a narrower value).
         env.setdefault(constants.ENV_VISIBLE_CHIPS, ",".join(host_indices))
         env[constants.ENV_ISOLATION] = self.spec.isolation
+        if self.mounts:
+            env[constants.ENV_DEVICE_MOUNTS] = ",".join(self.mounts)
+        spill = sum(b.host_spill_bytes for b in self.bindings)
+        if spill > 0:
+            # host-expansion in play: the client runtime must offload at
+            # least this much of its budget to host RAM/disk
+            env[constants.ENV_HBM_HOST_SPILL] = str(spill)
         return env
 
 
 class AllocationController:
-    def __init__(self, devices: DeviceController):
+    def __init__(self, devices: DeviceController, mount_policy=None):
+        from .mounts import DeviceMountPolicy
+
         self.devices = devices
+        self.mount_policy = mount_policy or DeviceMountPolicy(
+            DeviceMountPolicy.default_rules())
         self._lock = threading.RLock()
         self._allocations: Dict[str, WorkerAllocation] = {}
 
@@ -86,7 +101,9 @@ class AllocationController:
                         chip_id=chip_id, device_index=idx,
                         duty_percent=req.duty_percent,
                         hbm_bytes=req.hbm_bytes,
-                        host_index=entry.info.host_index)
+                        host_index=entry.info.host_index,
+                        host_spill_bytes=max(
+                            0, req.hbm_bytes - entry.info.hbm_bytes))
                     if spec.isolation == constants.ISOLATION_PARTITIONED:
                         if not req.partition_template:
                             raise AllocationError(
@@ -102,6 +119,7 @@ class AllocationController:
                             chip_id, int(req.duty_percent))
                     created.append(binding)
                 alloc.bindings = created
+                alloc.mounts = self.mount_policy.mounts_for(spec, created)
                 self._allocations[spec.key] = alloc
                 return alloc
             except Exception:
@@ -157,11 +175,17 @@ class AllocationController:
             alloc = WorkerAllocation(spec=spec)
             for idx, req in enumerate(spec.devices):
                 chip_id = req.chip_id
-                binding = DeviceBinding(chip_id=chip_id, device_index=idx,
-                                        duty_percent=req.duty_percent,
-                                        hbm_bytes=req.hbm_bytes)
-                part_id = partition_ids.get(chip_id)
                 entry = self.devices.get(chip_id)
+                binding = DeviceBinding(
+                    chip_id=chip_id, device_index=idx,
+                    duty_percent=req.duty_percent,
+                    hbm_bytes=req.hbm_bytes,
+                    host_index=(entry.info.host_index if entry is not None
+                                else -1),
+                    host_spill_bytes=max(
+                        0, req.hbm_bytes - entry.info.hbm_bytes)
+                    if entry is not None else 0)
+                part_id = partition_ids.get(chip_id)
                 if part_id and entry is not None:
                     grant = entry.partitions.get(part_id)
                     if grant is None:
@@ -171,6 +195,7 @@ class AllocationController:
                             chip_id, req.partition_template)
                     binding.grant = grant
                 alloc.bindings.append(binding)
+            alloc.mounts = self.mount_policy.mounts_for(spec, alloc.bindings)
             self._allocations[spec.key] = alloc
             return alloc
 

@@ -39,13 +39,24 @@ log = logging.getLogger("tpf.hypervisor.control_plane")
 class ControlPlaneBackend(Backend):
     def __init__(self, store: ObjectStore, devices: DeviceController,
                  node_name: str, pool: str = "",
-                 hypervisor_url: str = "", vendor: str = "mock-tpu"):
+                 hypervisor_url: str = "", vendor: str = "mock-tpu",
+                 known_pids: Optional[Callable[[], set]] = None,
+                 external_probe: Optional[Callable[[], set]] = None):
         self.store = store
         self.devices = devices
         self.node_name = node_name
         self.pool = pool
         self.hypervisor_url = hypervisor_url
         self.vendor = vendor
+        #: PIDs belonging to tpu-fusion workers (worker controller's shm
+        #: registrations); any other process seen on a chip marks it as
+        #: externally used.  When no source is wired, the default probe
+        #: marks nothing — otherwise our own workers would read as foreign
+        self.known_pids = known_pids
+        #: overridable probe returning externally-used chip ids; default
+        #: derives them from provider proc stats minus known worker PIDs
+        #: (kubelet_checkpoint.go:82-537 external-device-plugin analog)
+        self.external_probe = external_probe or self._probe_external_chips
         self._on_added: Optional[Callable[[WorkerSpec], None]] = None
         self._on_removed: Optional[Callable[[str], None]] = None
         self._watch = None
@@ -105,22 +116,38 @@ class ControlPlaneBackend(Backend):
         tnode.status.hypervisor_url = self.hypervisor_url
         self.store.update_or_create(tnode)
 
+    def _probe_external_chips(self) -> set:
+        """Chips with device processes not registered to any tpu-fusion
+        worker — a foreign runtime (raw libtpu job, another device
+        plugin) is using them and the scheduler must not place on them."""
+        if self.known_pids is None:
+            return set()   # no ours/theirs oracle: never mark (see ctor)
+        try:
+            stats = self.devices.proc_stats()
+        except Exception:  # noqa: BLE001 - provider probe must not kill
+            return set()
+        known = self.known_pids()
+        return {s.chip_id for s in stats
+                if s.pid not in known and s.pid != 0}
+
     def publish_chips(self) -> None:
         topo = self.devices.topology()
+        external = self.external_probe()
         for entry in self.devices.devices():
             # optimistic-concurrency loop: only inventory fields are ours;
             # available/running_apps belong to the allocator's sync and must
             # not be reverted by a stale read-modify-write
             for _ in range(3):
                 try:
-                    self._publish_one(entry, topo)
+                    self._publish_one(entry, topo,
+                                      entry.info.chip_id in external)
                     break
                 except (ConflictError, AlreadyExistsError):
                     continue
         log.debug("published %d chips for node %s",
                   len(self.devices.devices()), self.node_name)
 
-    def _publish_one(self, entry, topo) -> None:
+    def _publish_one(self, entry, topo, externally_used: bool = False) -> None:
         info = entry.info
         chip = self.store.try_get(TPUChip, info.chip_id)
         created = chip is None
@@ -137,6 +164,9 @@ class ControlPlaneBackend(Backend):
         # never stomp a live-migration phase from the status loop
         if st.phase != constants.PHASE_MIGRATING:
             st.phase = constants.PHASE_RUNNING
+        st.used_by = (constants.CHIP_USED_BY_EXTERNAL_PLUGIN
+                      if externally_used
+                      else constants.CHIP_USED_BY_TPU_FUSION)
         st.generation = info.generation
         st.vendor = self.vendor
         st.node_name = self.node_name

@@ -145,6 +145,13 @@ class WorkerController:
         with self._lock:
             return list(self._workers.values())
 
+    def all_pids(self) -> set:
+        """Host PIDs of every tracked worker — the 'ours' set the backend
+        subtracts when detecting chips used by a foreign runtime."""
+        with self._lock:
+            return {pid for w in self._workers.values()
+                    for pid in w.status.pids}
+
     def register_pid(self, worker_key: str, host_pid: int) -> None:
         with self._lock:
             w = self._workers.get(worker_key)
@@ -177,6 +184,24 @@ class WorkerController:
                                         spec.name)
         tracked.view = ShmView(tracked.shm_path)
         tracked.status.env[constants.ENV_SHM_PATH] = tracked.shm_path
+        self._inject_mandatory_metering(tracked.status.env)
+
+    def _inject_mandatory_metering(self, env: Dict[str, str]) -> None:
+        """Point the worker's PJRT plugin discovery at the interception
+        proxy so an *unmodified* JAX / PyTorch-XLA process is metered
+        (the LD_PRELOAD-equivalent; cooperative metering via
+        tensorfusion_tpu.client remains as the fallback)."""
+        # absolute paths: the worker process may run with any cwd
+        limiter_lib = os.path.abspath(self.limiter.lib_path)
+        env[constants.ENV_LIMITER_LIB] = limiter_lib
+        proxy = os.path.join(os.path.dirname(limiter_lib),
+                             "libtpf_pjrt_proxy.so")
+        real = os.environ.get(constants.ENV_REAL_PJRT_PLUGIN, "")
+        if not os.path.exists(proxy) or not real:
+            return
+        env[constants.ENV_REAL_PJRT_PLUGIN] = real
+        env["TPU_LIBRARY_PATH"] = proxy
+        env["PJRT_NAMES_AND_LIBRARY_PATHS"] = f"tpu:{proxy}"
 
     # -- hot loop ---------------------------------------------------------
 

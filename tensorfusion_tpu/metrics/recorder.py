@@ -76,6 +76,8 @@ class MetricsRecorder:
                       "allocated_hbm_bytes": used_h,
                       "capacity_tflops": cap.tflops,
                       "capacity_hbm_bytes": cap.hbm_bytes,
+                      # host-backed portion of the expansion budget in use
+                      "hbm_spill_bytes": state.hbm_spill_bytes(),
                       "workers": len(state.holders)}
             lines.append(encode_line("tpf_chip_alloc", tags, fields, ts))
             self.tsdb.insert("tpf_chip_alloc", tags, fields, now)

@@ -48,7 +48,8 @@ class Operator:
                  enable_autoscaler: bool = False,
                  metrics_path: str = "",
                  alert_rules=None, alert_webhook: str = "",
-                 sync_interval_s: float = 2.0):
+                 sync_interval_s: float = 2.0,
+                 config_path: str = ""):
         self.store = store or ObjectStore()
         self.allocator = TPUAllocator(store=self.store)
         self.ports = PortAllocator()
@@ -119,9 +120,32 @@ class Operator:
         self.worker_metrics_paths: List[str] = []
         self._metrics_offsets: Dict[str, int] = {}
 
+        # hot-reloaded GlobalConfig (cmd/main.go:614-712 analog): live
+        # components pick up changes without a restart
+        self.config_watcher = None
+        if config_path:
+            from .config.global_config import GlobalConfigWatcher
+
+            self.config_watcher = GlobalConfigWatcher(config_path)
+            self.config_watcher.on_change(self._apply_global_config)
+
         self._stop = threading.Event()
         self._sync_thread: Optional[threading.Thread] = None
         self._started = False
+
+    def _apply_global_config(self, cfg) -> None:
+        """Push a (re)loaded GlobalConfig into the live components."""
+        if self.metrics is not None and cfg.metrics_interval_s > 0:
+            self.metrics.interval_s = cfg.metrics_interval_s
+        if self.alerts is not None and cfg.alert_rules:
+            from .alert.evaluator import AlertRule
+
+            self.alerts.set_rules([
+                r if isinstance(r, AlertRule) else AlertRule(**r)
+                for r in cfg.alert_rules])
+        if cfg.default_pool and cfg.scheduler_placement_mode:
+            self.allocator.set_pool_strategy(cfg.default_pool,
+                                             cfg.scheduler_placement_mode)
 
     # -- lifecycle (cmd/main.go startup order analog) ----------------------
 
@@ -168,11 +192,16 @@ class Operator:
             self.autoscaler.start()
         if self.alerts is not None:
             self.alerts.start()
+        if self.config_watcher is not None:
+            self._apply_global_config(self.config_watcher.config)
+            self.config_watcher.start()
         self._started = True
         log.info("operator started")
 
     def stop(self) -> None:
         self._stop.set()
+        if self.config_watcher is not None:
+            self.config_watcher.stop()
         for component in (self.alerts, self.autoscaler, self.metrics):
             if component is not None:
                 component.stop()

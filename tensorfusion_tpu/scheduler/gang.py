@@ -27,6 +27,11 @@ log = logging.getLogger("tpf.scheduler.gang")
 
 DEFAULT_GANG_TIMEOUT_S = 600.0
 
+#: group reject backoff: exponential from BASE doubling per consecutive
+#: reject up to MAX, reset when the gang schedules or gains a member
+GANG_BACKOFF_BASE_S = 2.0
+GANG_BACKOFF_MAX_S = 60.0
+
 
 @dataclass
 class GangGroup:
@@ -39,6 +44,7 @@ class GangGroup:
     waiting: Set[str] = field(default_factory=set)       # parked in Permit
     scheduled: Set[str] = field(default_factory=set)     # bound
     rejected_until: float = 0.0                          # group backoff
+    reject_count: int = 0                                # consecutive rejects
     created_at: float = field(default_factory=time.time)
 
 
@@ -92,7 +98,12 @@ class GangManager:
             else:
                 g.desired = max(g.desired, desired)
                 g.required = max(g.required, required)
-            g.members.add(pod.key())
+            if pod.key() not in g.members:
+                g.members.add(pod.key())
+                # membership changed — what was unschedulable may fit now;
+                # restart the backoff escalation from its base too
+                g.rejected_until = 0.0
+                g.reject_count = 0
             self._pod_group[pod.key()] = group_key
             return g
 
@@ -147,7 +158,19 @@ class GangManager:
                 return
             g.waiting.discard(pod.key())
             g.scheduled.add(pod.key())
+            if len(g.scheduled) >= g.required:
+                g.reject_count = 0      # gang formed; forget the backoff
             self._emit(g)
+
+    @staticmethod
+    def _backoff(g: GangGroup) -> None:
+        """Exponential group backoff (caller holds the lock): repeated
+        rejects of the same gang wait longer each time instead of
+        hammering the queue every fixed interval."""
+        g.reject_count += 1
+        delay = min(GANG_BACKOFF_BASE_S * (2 ** (g.reject_count - 1)),
+                    GANG_BACKOFF_MAX_S)
+        g.rejected_until = time.time() + delay
 
     def on_unschedulable(self, pod: Pod, reason: str) -> None:
         """Strict gangs: one member failing rejects the whole group
@@ -160,7 +183,7 @@ class GangManager:
                 return
             waiting = list(g.waiting)
             g.waiting.clear()
-            g.rejected_until = time.time() + 5.0
+            self._backoff(g)
         for key in waiting:
             self.reject_fn(key, f"strict gang rejected: {reason}")
         log.info("strict gang %s rejected (%s): bounced %d waiting members",
@@ -169,11 +192,28 @@ class GangManager:
 
     def on_permit_rejected(self, pod_key: str, reason: str) -> None:
         """Scheduler rejected/timed out a parked pod: drop it from the
-        group's waiting set so quorum math stays truthful."""
+        group's waiting set so quorum math stays truthful.  For a strict
+        gang with nothing bound yet this is group-level cleanup: one
+        bounced member means the gang cannot form this cycle, so every
+        other parked member is bounced too (releasing its assumed chips)
+        and the group backs off — instead of members timing out one by
+        one, each holding capacity for the full permit window
+        (gang/manager.go:977 timeout handling)."""
+        to_bounce: List[str] = []
         with self._lock:
             g = self.group_of(pod_key)
-            if g is not None:
-                g.waiting.discard(pod_key)
+            if g is None:
+                return
+            g.waiting.discard(pod_key)
+            if g.strict and not g.scheduled and g.waiting:
+                to_bounce = list(g.waiting)
+                g.waiting.clear()
+                self._backoff(g)
+        # reject_fn re-enters this listener per pod; the waiting set is
+        # already empty so each re-entry is a no-op discard
+        for key in to_bounce:
+            self.reject_fn(key, f"strict gang cleanup after {pod_key}: "
+                                f"{reason}")
 
     def on_pod_deleted(self, pod_key: str) -> None:
         with self._lock:

@@ -89,6 +89,27 @@ def test_oversell_allows_overcommit_of_tflops_not_hbm():
         alloc.alloc(req(pod="p10", tflops=1.0, hbm=8 * 2**30))
 
 
+def test_hbm_host_expansion_extends_schedulable_hbm():
+    """Pool host-expansion (gpupool vramExpandToHostMem/Disk analog): the
+    schedulable HBM grows by the host fractions, and the allocated excess
+    over physical is reported as spill."""
+    alloc = make_allocator()
+    big = int(V5E_HBM * 1.25)           # > physical 16 GiB
+    with pytest.raises(InsufficientResourcesError):
+        alloc.alloc(req(pod="nope", hbm=big))
+
+    alloc.set_pool_hbm_expansion("pool-a", 50, 70)    # x2.2 schedulable
+    record = alloc.alloc(req(pod="spill", hbm=big))
+    state = alloc.get_chip(record.chip_ids[0])
+    assert state.virtual_capacity().hbm_bytes == pytest.approx(
+        V5E_HBM * 2.2)
+    assert state.hbm_spill_bytes() == pytest.approx(big - V5E_HBM)
+    # a second physical-sized request still fits inside the expansion
+    alloc.alloc(req(pod="second", hbm=int(V5E_HBM * 0.9),
+                    chip_indices=[state.chip.status.host_index]))
+    assert state.hbm_spill_bytes() > big - V5E_HBM
+
+
 def test_assume_commit_unassume():
     alloc = make_allocator()
     r = req()
@@ -290,3 +311,16 @@ def test_index_allocator():
         ia.assign("e")
     ia.reconcile({"x": 2})
     assert ia.assign("y") == 0
+
+
+def test_index_allocator_reconcile_deduplicates():
+    """Two pods whose annotations carry the same index (corruption or
+    copy-paste) must not both keep it after restart recovery — the later
+    owner gets a fresh index so each index maps to exactly one owner."""
+    ia = IndexAllocator(max_index=10)
+    ia.reconcile({"a": 2, "b": 2, "c": 5})
+    by_owner = {o: ia.assign(o) for o in ("a", "b", "c")}
+    assert by_owner["a"] == 2          # first (lexicographic) keeps it
+    assert by_owner["c"] == 5
+    assert by_owner["b"] not in (2, 5)
+    assert len(set(by_owner.values())) == 3

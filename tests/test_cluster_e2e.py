@@ -41,6 +41,7 @@ def cluster(mock_provider_lib, limiter_lib, tmp_path):
     workers = WorkerController(devices, alloc, limiter,
                                str(tmp_path / "shm"))
     backend = ControlPlaneBackend(op.store, devices, node_name="tpu-host-0",
+                                  known_pids=lambda: workers.all_pids(),
                                   pool="pool-a",
                                   hypervisor_url="http://127.0.0.1:0")
 

@@ -110,6 +110,83 @@ def test_defrag_respects_eviction_protection_and_no_alternative():
         op.stop()
 
 
+def _submit_gang(op, names, tflops=30.0, timeout="30"):
+    """Create a strict gang (min == desired) and wait for all to bind."""
+    pods = []
+    for name in names:
+        pod = Pod.new(name, namespace="default")
+        ann = pod.metadata.annotations
+        ann[constants.ANN_POOL] = "pool-a"
+        ann[constants.ANN_TFLOPS_REQUEST] = str(tflops)
+        ann[constants.ANN_HBM_REQUEST] = str(2**30)
+        ann[constants.ANN_IS_LOCAL_TPU] = "true"
+        ann[constants.ANN_WORKLOAD] = "gangwl"
+        ann[constants.ANN_GANG_ENABLED] = "true"
+        ann[constants.ANN_GANG_DESIRED_MEMBERS] = str(len(names))
+        ann[constants.ANN_GANG_MIN_MEMBERS] = str(len(names))
+        ann[constants.ANN_GANG_REQUIRED_MEMBERS] = str(len(names))
+        ann[constants.ANN_GANG_TIMEOUT] = timeout
+        pod.spec.containers = [Container(name="main")]
+        op.submit_pod(pod)
+        pods.append(pod)
+    out = []
+    for name in names:
+        bound = op.wait_for_binding(name)
+        assert bound is not None, f"gang member {name} never bound"
+        out.append(bound)
+    return out
+
+
+def test_defrag_drains_strict_gang_atomically():
+    """A strict gang on the drained node must be re-placed as a unit:
+    every member (cluster-wide) evicted together and the whole gang
+    re-bound — a partial drain could never meet quorum again."""
+    op = make_operator(hosts=2)
+    try:
+        members = _submit_gang(op, ["g0", "g1"])
+        drained = members[0].spec.node_name
+
+        evicted = op.compaction.defrag_node("pool-a", drained)
+        assert evicted == 2                       # whole gang, not a subset
+
+        deadline = time.time() + 10
+        rebound = {}
+        while time.time() < deadline:
+            rebound = {n: op.store.try_get(Pod, n, "default")
+                       for n in ("g0", "g1")}
+            if all(p is not None and p.spec.node_name and
+                   p.spec.node_name != drained for p in rebound.values()):
+                break
+            time.sleep(0.05)
+        for name, p in rebound.items():
+            assert p is not None and p.spec.node_name, \
+                f"{name} stuck after gang drain"
+            assert p.spec.node_name != drained
+            assert op.allocator.allocation(f"default/{name}") is not None
+    finally:
+        op.stop()
+
+
+def test_defrag_skips_gang_with_no_atomic_placement():
+    """When the gang cannot be simultaneously re-placed elsewhere, no
+    member may be evicted (evicting a subset live-locks a strict gang)."""
+    op = make_operator(hosts=1)   # nowhere else to go
+    try:
+        members = _submit_gang(op, ["s0", "s1"])
+        node = members[0].spec.node_name
+        evicted = op.compaction.defrag_node("pool-a", node)
+        assert evicted == 0
+        for name in ("s0", "s1"):
+            assert op.store.try_get(Pod, name, "default") is not None
+        tnode = op.store.get(TPUNode, node)
+        assert tnode.metadata.labels.get(constants.LABEL_DEFRAG_SKIP) == \
+            "true"
+        assert "atomic" in tnode.metadata.annotations.get(
+            constants.ANN_DEFRAG_SKIP_REASON, "")
+    finally:
+        op.stop()
+
+
 def test_compaction_releases_empty_node():
     op = make_operator(hosts=2, compaction=True, grace_s=0.2)
     try:

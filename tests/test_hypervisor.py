@@ -159,6 +159,88 @@ def test_partitioned_worker_rollback(mock_provider_lib, limiter_lib,
         devices.stop()
 
 
+def test_device_mount_policy_rules():
+    """Mount rules gate host paths by worker context: whole-chip device
+    nodes for non-partitioned workers, the grant's narrower nodes for
+    partitioned ones, and arbitrary predicate-gated extras
+    (device_mount_policy.go analog)."""
+    from tensorfusion_tpu.api.types import DeviceMountRule
+    from tensorfusion_tpu.hypervisor.allocation import DeviceBinding
+    from tensorfusion_tpu.hypervisor.mounts import DeviceMountPolicy
+    from tensorfusion_tpu.hypervisor.provider_binding import PartitionGrant
+
+    policy = DeviceMountPolicy(DeviceMountPolicy.default_rules())
+    soft = WorkerSpec(name="w", isolation=constants.ISOLATION_SOFT)
+    b = DeviceBinding(chip_id="c0", device_index=0, duty_percent=50,
+                      hbm_bytes=1, host_index=3)
+    assert policy.mounts_for(soft, [b]) == ["/dev/accel3"]
+
+    grant = PartitionGrant(kind="device-node", chip_id="c0",
+                           partition_id="p1", env={},
+                           device_nodes=["/dev/accel3_core0"])
+    pb = DeviceBinding(chip_id="c0", device_index=0, duty_percent=50,
+                       hbm_bytes=1, host_index=3, grant=grant)
+    part = WorkerSpec(name="w2",
+                      isolation=constants.ISOLATION_PARTITIONED)
+    assert policy.mounts_for(part, [pb]) == ["/dev/accel3_core0"]
+
+    qos_rule = DeviceMountRule(expression="qos == 'high'",
+                               host_paths=["/lib/libtpu_debug.so"])
+    policy2 = DeviceMountPolicy([qos_rule])
+    assert policy2.mounts_for(soft, [b]) == []
+    high = WorkerSpec(name="w3", qos="high")
+    assert policy2.mounts_for(high, [b]) == ["/lib/libtpu_debug.so"]
+    # a broken expression must not blow up allocation
+    policy3 = DeviceMountPolicy([DeviceMountRule(
+        expression="import os", host_paths=["/x"])])
+    assert policy3.mounts_for(soft, [b]) == []
+
+
+def test_allocation_env_carries_mounts_and_spill(stack):
+    devices_ctrl, alloc, workers, limiter = stack
+    entry = devices_ctrl.devices()[0]
+    physical = entry.info.hbm_bytes
+    spec = WorkerSpec(
+        namespace="d", name="spiller",
+        isolation=constants.ISOLATION_SOFT,
+        devices=[WorkerDeviceRequest(chip_id=entry.info.chip_id,
+                                     duty_percent=50.0,
+                                     hbm_bytes=physical + 2**30)])
+    a = alloc.allocate(spec)
+    env = a.env
+    assert env[constants.ENV_DEVICE_MOUNTS] == \
+        f"/dev/accel{entry.info.host_index}"
+    assert int(env[constants.ENV_HBM_HOST_SPILL]) == 2**30
+
+
+def test_external_usage_marks_chips(devices):
+    """Chips used by a foreign runtime must be published with an external
+    used_by so the scheduler's PhaseFilter excludes them — and revert once
+    the foreign process goes away (kubelet_checkpoint external-DP
+    detection analog)."""
+    from tensorfusion_tpu.api.types import TPUChip
+    from tensorfusion_tpu.hypervisor.control_plane import ControlPlaneBackend
+    from tensorfusion_tpu.store import ObjectStore
+
+    store = ObjectStore()
+    chip_ids = [e.info.chip_id for e in devices.devices()]
+    foreign = {chip_ids[0]}
+    backend = ControlPlaneBackend(store, devices, node_name="n0",
+                                  pool="pool-a",
+                                  external_probe=lambda: foreign)
+    backend.register_node()
+    backend.publish_chips()
+    used = {c.name: c.status.used_by for c in store.list(TPUChip)}
+    assert used[chip_ids[0]] == constants.CHIP_USED_BY_EXTERNAL_PLUGIN
+    assert all(v == constants.CHIP_USED_BY_TPU_FUSION
+               for k, v in used.items() if k != chip_ids[0])
+
+    foreign.clear()
+    backend.publish_chips()
+    assert store.get(TPUChip, chip_ids[0]).status.used_by == \
+        constants.CHIP_USED_BY_TPU_FUSION
+
+
 def test_hard_isolation_sets_provider_limits(stack):
     devices, alloc, workers, limiter = stack
     ctl = MockProviderControl(devices.provider)

@@ -24,6 +24,31 @@ def test_chip_info_db():
     assert "v5p" in mock_chip_info()
 
 
+def test_operator_wires_global_config(tmp_path):
+    """The operator must consume a GlobalConfig file: initial values are
+    applied at start and live reloads reach the running components
+    (cmd/main.go:614-712 wiring, previously unwired)."""
+    import json as _json
+    import time as _time
+
+    from tensorfusion_tpu.operator import Operator
+
+    path = tmp_path / "config.json"
+    path.write_text(_json.dumps({"metrics_interval_s": 0.7}))
+    op = Operator(enable_metrics=True, config_path=str(path))
+    op.config_watcher.poll_interval_s = 0.05
+    op.start()
+    try:
+        assert op.metrics.interval_s == 0.7
+        path.write_text(_json.dumps({"metrics_interval_s": 1.3}))
+        deadline = _time.time() + 3
+        while op.metrics.interval_s != 1.3 and _time.time() < deadline:
+            _time.sleep(0.05)
+        assert op.metrics.interval_s == 1.3
+    finally:
+        op.stop()
+
+
 def test_global_config_hot_reload(tmp_path):
     path = tmp_path / "config.json"
     path.write_text(json.dumps({"metrics_interval_s": 9.0,

@@ -20,3 +20,21 @@ def test_limiter_selftest(native_build):
                          capture_output=True, text=True)
     assert out.returncode == 0, out.stdout + out.stderr
     assert "PASS" in out.stdout
+
+
+def test_pjrt_proxy_selftest(native_build, tmp_path):
+    """Mandatory metering: an unmodified PJRT client (driven exactly like
+    JAX drives a plugin) is rate-limited through the interception proxy
+    with only env vars set — no python import in the workload."""
+    selftest = native_build / "pjrt_proxy_selftest"
+    if not selftest.exists():
+        import pytest
+
+        pytest.skip("PJRT headers unavailable; proxy not built")
+    out = subprocess.run(
+        [str(selftest), str(native_build / "libtpf_pjrt_proxy.so"),
+         str(native_build / "libtpf_fake_pjrt.so"),
+         str(native_build / "libtpf_limiter.so"), str(tmp_path / "shm")],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "PASS" in out.stdout

@@ -161,9 +161,11 @@ def test_autoscaler_rejects_on_capacity():
         scaler = AutoScaler(op, tsdb)
         now = time.time()
         for i in range(50):
-            # usage implies > chip HBM; resize must be rejected gracefully
+            # usage implies > the chip's host-EXPANDED HBM budget
+            # (16 GiB * 2.2 with the default pool expansion); the resize
+            # must be rejected gracefully
             scaler.observe("default/auto-2", tflops=180.0,
-                           hbm_bytes=30 * 2**30, ts=now - 50 + i)
+                           hbm_bytes=40 * 2**30, ts=now - 50 + i)
         scaler.run_once()
         rec = op.allocator.allocation("default/auto-2")
         assert rec.request.request.hbm_bytes == 14 * 2**30  # unchanged

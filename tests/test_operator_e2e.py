@@ -166,8 +166,9 @@ def test_e2e_expander_scales_from_capacity_miss(op):
                           extra={constants.ANN_CHIP_COUNT: "8",
                                  constants.ANN_CHIP_GENERATION: "v5e"})
     # 8 chips x 14 GiB: fits on an 8-chip host only when mostly empty;
-    # first fill the current host so it can't fit
-    filler = make_client_pod("filler", tflops="100", hbm="10Gi")
+    # first fill the current host past even its host-EXPANDED HBM budget
+    # (16 GiB * 2.2 default expansion = 35.2 GiB/chip) so it can't fit
+    filler = make_client_pod("filler", tflops="100", hbm="25Gi")
     op.submit_pod(filler)
     assert op.wait_for_binding("filler")
 

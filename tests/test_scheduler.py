@@ -208,6 +208,56 @@ def test_gang_permit_timeout_rejects():
         h.scheduler.stop()
 
 
+def test_gang_group_cleanup_and_exponential_backoff():
+    """One parked member rejected (e.g. permit timeout) bounces the whole
+    strict gang immediately — members must not time out one by one while
+    holding assumed chips — and repeated rejects back off exponentially."""
+    gm = GangManager()
+    bounced = []
+
+    def reject(key, reason):
+        bounced.append(key)
+        gm.on_permit_rejected(key, reason)
+        return True
+
+    gm.reject_fn = reject
+
+    def gpod(name):
+        pod = Pod.new(name, namespace="d")
+        ann = pod.metadata.annotations
+        ann[constants.ANN_WORKLOAD] = "wl"
+        ann[constants.ANN_GANG_ENABLED] = "true"
+        ann[constants.ANN_GANG_DESIRED_MEMBERS] = "3"
+        ann[constants.ANN_GANG_MIN_MEMBERS] = "3"
+        return pod
+
+    p1, p2, p3 = gpod("a"), gpod("b"), gpod("c")
+    for p in (p1, p2, p3):
+        gm.observe(p)
+    g = gm.group_of(p1.key())
+    assert g.strict
+
+    st, _ = gm.permit(p1)
+    assert st.code == Code.WAIT
+    st, _ = gm.permit(p2)
+    assert st.code == Code.WAIT
+
+    gm.on_permit_rejected(p1.key(), "permit timeout")
+    assert bounced == [p2.key()]          # group-level cleanup, no waiting
+    assert not g.waiting
+    assert g.reject_count == 1
+    assert g.rejected_until > time.time()
+
+    gm._backoff(g)
+    gm._backoff(g)
+    assert g.reject_count == 3
+    assert g.rejected_until - time.time() > 6.0   # 2*2^2 = 8s, capped at 60
+
+    # a new member arriving clears the backoff gate
+    gm.observe(gpod("d"))
+    assert g.rejected_until == 0.0
+
+
 def test_preemption_with_eviction_protection():
     h = Harness(chips_per_node=1, nodes=1)
     low1 = h.make_pod("low1", tflops=100.0, hbm=4 * 2**30, priority=1)
