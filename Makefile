@@ -46,6 +46,11 @@ sched-bench:
 webhook-bench:
 	$(PY) benchmarks/webhook_bench.py --pods 5000
 
+# Remote-vTPU serving overhead vs the reference's <4% GPU-over-IP claim.
+remoting-bench:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		python benchmarks/remoting_bench.py
+
 dryrun:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 		XLA_FLAGS=--xla_force_host_platform_device_count=8 \

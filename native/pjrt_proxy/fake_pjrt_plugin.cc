@@ -78,6 +78,83 @@ PJRT_Error* fake_buffer_destroy(PJRT_Buffer_Destroy_Args*) {
   return nullptr;
 }
 
+/* -- minimal client surface: lets libtpf_provider_tpu.so initialise and
+ * run its full conformance suite against this plugin without hardware -- */
+
+constexpr int kFakeDevices = 2;
+PJRT_Device* g_devices[kFakeDevices] = {
+    reinterpret_cast<PJRT_Device*>(0xD0),
+    reinterpret_cast<PJRT_Device*>(0xD1)};
+int64_t g_coords[kFakeDevices][3] = {{0, 0, 0}, {1, 0, 0}};
+
+int device_slot(const void* p) {
+  for (int i = 0; i < kFakeDevices; ++i)
+    if (g_devices[i] == p) return i;
+  return 0;
+}
+
+PJRT_Error* fake_plugin_initialize(PJRT_Plugin_Initialize_Args*) {
+  return nullptr;
+}
+
+PJRT_Error* fake_client_create(PJRT_Client_Create_Args* args) {
+  args->client = reinterpret_cast<PJRT_Client*>(0xC1);
+  return nullptr;
+}
+
+PJRT_Error* fake_client_destroy(PJRT_Client_Destroy_Args*) {
+  return nullptr;
+}
+
+PJRT_Error* fake_addressable_devices(
+    PJRT_Client_AddressableDevices_Args* args) {
+  args->addressable_devices = g_devices;
+  args->num_addressable_devices = kFakeDevices;
+  return nullptr;
+}
+
+PJRT_Error* fake_get_description(PJRT_Device_GetDescription_Args* args) {
+  args->device_description =
+      reinterpret_cast<PJRT_DeviceDescription*>(args->device);
+  return nullptr;
+}
+
+PJRT_Error* fake_desc_id(PJRT_DeviceDescription_Id_Args* args) {
+  args->id = device_slot(args->device_description);
+  return nullptr;
+}
+
+PJRT_Error* fake_desc_kind(PJRT_DeviceDescription_Kind_Args* args) {
+  static const char kKind[] = "TPU v5 lite (fake)";
+  args->device_kind = kKind;
+  args->device_kind_size = sizeof(kKind) - 1;
+  return nullptr;
+}
+
+PJRT_Error* fake_desc_attributes(
+    PJRT_DeviceDescription_Attributes_Args* args) {
+  int slot = device_slot(args->device_description);
+  static PJRT_NamedValue attrs[kFakeDevices][1];
+  PJRT_NamedValue& nv = attrs[slot][0];
+  memset(&nv, 0, sizeof(nv));
+  nv.struct_size = PJRT_NamedValue_STRUCT_SIZE;
+  nv.name = "coords";
+  nv.name_size = 6;
+  nv.type = PJRT_NamedValue_kInt64List;
+  nv.int64_array_value = g_coords[slot];
+  nv.value_size = 3;
+  args->attributes = attrs[slot];
+  args->num_attributes = 1;
+  return nullptr;
+}
+
+PJRT_Error* fake_memory_stats(PJRT_Device_MemoryStats_Args* args) {
+  args->bytes_in_use = 1ll << 30;
+  args->bytes_limit = 16ll << 30;
+  args->bytes_limit_is_set = true;
+  return nullptr;
+}
+
 PJRT_Api g_api;
 
 }  // namespace
@@ -95,6 +172,15 @@ const PJRT_Api* GetPjrtApi(void) {
   g_api.PJRT_Client_BufferFromHostBuffer = fake_buffer_from_host;
   g_api.PJRT_Buffer_OnDeviceSizeInBytes = fake_on_device_size;
   g_api.PJRT_Buffer_Destroy = fake_buffer_destroy;
+  g_api.PJRT_Plugin_Initialize = fake_plugin_initialize;
+  g_api.PJRT_Client_Create = fake_client_create;
+  g_api.PJRT_Client_Destroy = fake_client_destroy;
+  g_api.PJRT_Client_AddressableDevices = fake_addressable_devices;
+  g_api.PJRT_Device_GetDescription = fake_get_description;
+  g_api.PJRT_DeviceDescription_Id = fake_desc_id;
+  g_api.PJRT_DeviceDescription_Kind = fake_desc_kind;
+  g_api.PJRT_DeviceDescription_Attributes = fake_desc_attributes;
+  g_api.PJRT_Device_MemoryStats = fake_memory_stats;
   return &g_api;
 }
 

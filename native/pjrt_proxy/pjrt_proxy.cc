@@ -213,6 +213,18 @@ PJRT_Error* proxy_buffer_from_host(
   return err;
 }
 
+PJRT_Error* proxy_executable_destroy(
+    PJRT_LoadedExecutable_Destroy_Args* args) {
+  if (args->executable != nullptr) {
+    // evict the cost cache entry: the allocator may reuse this address
+    // for a different executable, and the map must not grow unboundedly
+    pthread_mutex_lock(&g_state.mu);
+    g_state.exec_cost.erase(args->executable);
+    pthread_mutex_unlock(&g_state.mu);
+  }
+  return g_state.real->PJRT_LoadedExecutable_Destroy(args);
+}
+
 PJRT_Error* proxy_buffer_destroy(PJRT_Buffer_Destroy_Args* args) {
   if (g_state.metered && args->buffer != nullptr) {
     uint64_t size = 0;
@@ -323,6 +335,8 @@ const PJRT_Api* GetPjrtApi(void) {
       g_state.api.PJRT_Client_BufferFromHostBuffer = proxy_buffer_from_host;
     if (real->PJRT_Buffer_Destroy)
       g_state.api.PJRT_Buffer_Destroy = proxy_buffer_destroy;
+    if (real->PJRT_LoadedExecutable_Destroy)
+      g_state.api.PJRT_LoadedExecutable_Destroy = proxy_executable_destroy;
   }
   pthread_mutex_unlock(&init_mu);
   return &g_state.api;

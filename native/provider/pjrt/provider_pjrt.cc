@@ -15,9 +15,27 @@
 //                   counters, so duty_cycle_pct reports 0 and the platform
 //                   meters compute on the client side (program launches)
 //
-// Partitioning, hard limits and snapshot are TPF_ERR_UNSUPPORTED at the
-// PJRT layer (the hypervisor's capability flags reflect that): fractional
-// TPU use is per-core assignment + soft metering, not a MIG analog.
+//   partition    <- whole-TensorCore grants expressed as worker env
+//                   (TPU_VISIBLE_CHIPS + TPF_VISIBLE_CORES + HBM share):
+//                   TPUs have no MIG, so a "partition" is a core-range
+//                   visibility contract enforced by the client runtime /
+//                   PJRT proxy, with slot accounting here (the analog of
+//                   the reference's AccelAssignPartition,
+//                   accelerator.h:244-261)
+//   hard limits  <- recorded per chip and surfaced via chip metrics;
+//                   the hypervisor maps them into worker shm budgets and
+//                   the PJRT interception proxy enforces them at the
+//                   client boundary (no PJRT API can cap a device's HBM
+//                   from another process)
+//   snapshot     <- device-level: a manifest of the chip's live memory
+//                   stats + a frozen mark (metrics expose it so the
+//                   worker controller quiesces clients); process-level:
+//                   persisted pid set.  Actual HBM buffer readback
+//                   belongs to the process that owns the buffers (the
+//                   remoting worker keeps device buffers + an executable
+//                   cache it can re-materialize) — matching the
+//                   reference, where AccelSnapshot is vendor-side
+//                   (accelerator.h:364-390).
 
 #include <dlfcn.h>
 #include <stdio.h>
@@ -52,6 +70,13 @@ const GenInfo kGenInfos[] = {
     {"v4", "v4", 2, 32ull << 30, 275.0, 275.0, 1228.0},
 };
 
+struct Partition {
+  std::string template_id;
+  std::string partition_id;
+  int core = 0;
+  int core_count = 1;
+};
+
 struct DeviceEntry {
   PJRT_Device* device = nullptr;
   PJRT_DeviceDescription* desc = nullptr;
@@ -60,6 +85,12 @@ struct DeviceEntry {
   const GenInfo* gen = nullptr;
   int64_t coords[3] = {0, 0, 0};
   bool has_coords = false;
+  int32_t host_index = 0;
+  std::vector<Partition> partitions;
+  uint64_t hbm_hard_limit = 0;      // 0 = unlimited
+  uint32_t duty_hard_limit = 100;
+  bool frozen = false;              // device-level snapshot in progress
+  size_t next_partition_seq = 0;
 };
 
 struct State {
@@ -95,6 +126,18 @@ bool failed(PJRT_Error* err, const char* what) {
   destroy_args.error = err;
   api->PJRT_Error_Destroy(&destroy_args);
   return true;
+}
+
+// Locates a device by its exported chip id ("pjrt-tpu-<id>"); caller
+// holds g_mu.  Returns -1 when unknown.
+int find_device_locked(const char* chip_id) {
+  for (size_t i = 0; i < g_state.devices.size(); ++i) {
+    char id[64];
+    snprintf(id, sizeof(id), "pjrt-tpu-%lld",
+             (long long)g_state.devices[i].id);
+    if (strcmp(id, chip_id) == 0) return (int)i;
+  }
+  return -1;
 }
 
 const GenInfo* classify(const std::string& kind) {
@@ -189,13 +232,14 @@ void fill_chip_info(const DeviceEntry& e, size_t index,
   ci->mesh_x = (int32_t)e.coords[0];
   ci->mesh_y = (int32_t)e.coords[1];
   ci->mesh_z = (int32_t)e.coords[2];
-  ci->caps.core_partitioning = 0;  // no MIG analog at the PJRT layer
+  ci->caps.core_partitioning = e.gen->cores > 1;
   ci->caps.soft_isolation = 1;     // client-side program metering
-  ci->caps.hard_isolation = 0;
-  ci->caps.snapshot = 0;
+  ci->caps.hard_isolation = 1;     // limits recorded here, enforced at
+                                   // the client boundary (header comment)
+  ci->caps.snapshot = 1;
   ci->caps.metrics = 1;
   ci->caps.remoting = 1;
-  ci->caps.max_partitions = 0;
+  ci->caps.max_partitions = (uint32_t)e.gen->cores;
   ci->caps.max_workers = 16;
 }
 
@@ -288,7 +332,10 @@ TPF_API tpf_status_t tpf_init(void) {
   for (size_t i = 0; i < dev_args.num_addressable_devices; ++i) {
     DeviceEntry e;
     e.device = dev_args.addressable_devices[i];
-    if (load_device(&e)) g_state.devices.push_back(e);
+    if (load_device(&e)) {
+      e.host_index = (int32_t)g_state.devices.size();
+      g_state.devices.push_back(e);
+    }
   }
   g_state.initialized = true;
   logmsg("info", "pjrt provider: " + std::to_string(g_state.devices.size())
@@ -397,13 +444,22 @@ TPF_API tpf_status_t tpf_chip_metrics(const char** chip_ids, size_t chip_count,
       snprintf(id, sizeof(id), "pjrt-tpu-%lld", (long long)e.id);
       if (strcmp(id, chip_ids[i]) != 0) continue;
       int64_t in_use = 0, limit = 0;
+      size_t x = 0;
       if (memory_stats(e.device, &in_use, &limit)) {
         out[i].hbm_used_bytes = (uint64_t)in_use;
-        snprintf(out[i].extra[0].key, sizeof(out[i].extra[0].key),
+        snprintf(out[i].extra[x].key, sizeof(out[i].extra[x].key),
                  "hbm_limit_bytes");
-        out[i].extra[0].value = (double)limit;
-        out[i].extra_count = 1;
+        out[i].extra[x++].value = (double)limit;
       }
+      snprintf(out[i].extra[x].key, sizeof(out[i].extra[x].key),
+               "hbm_hard_limit_bytes");
+      out[i].extra[x++].value = (double)e.hbm_hard_limit;
+      snprintf(out[i].extra[x].key, sizeof(out[i].extra[x].key),
+               "duty_hard_limit_pct");
+      out[i].extra[x++].value = (double)e.duty_hard_limit;
+      snprintf(out[i].extra[x].key, sizeof(out[i].extra[x].key), "frozen");
+      out[i].extra[x++].value = e.frozen ? 1.0 : 0.0;
+      out[i].extra_count = x;
       break;
     }
   }
@@ -434,31 +490,218 @@ TPF_API tpf_status_t tpf_mounts(tpf_mount_t* out, size_t max_count,
   return TPF_OK;
 }
 
-// Unsupported at the PJRT layer (capability flags advertise this).
-TPF_API tpf_status_t tpf_partition_templates(const char*,
-                                             tpf_partition_template_t*,
-                                             size_t, size_t* count) {
-  if (count) *count = 0;
+// -- core partitioning (visible-core env grants; header comment) -------
+
+TPF_API tpf_status_t tpf_partition_templates(const char* chip_id,
+                                             tpf_partition_template_t* out,
+                                             size_t max_count,
+                                             size_t* count) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_state.initialized) return TPF_ERR_NOT_INITIALIZED;
+  if (!chip_id || !out || !count) return TPF_ERR_INVALID_ARG;
+  int idx = find_device_locked(chip_id);
+  if (idx < 0) return TPF_ERR_NOT_FOUND;
+  const DeviceEntry& e = g_state.devices[idx];
+  size_t n = 0;
+  // One template per power-of-two core count up to the full chip (same
+  // scheme as the mock so the control plane sees one contract).
+  for (int cores = 1; cores <= e.gen->cores && n < max_count; cores *= 2) {
+    tpf_partition_template_t& t = out[n++];
+    memset(&t, 0, sizeof(t));
+    snprintf(t.template_id, sizeof(t.template_id), "%s-%dc", e.gen->gen,
+             cores);
+    snprintf(t.name, sizeof(t.name), "%s %d-core partition", e.gen->gen,
+             cores);
+    t.core_count = cores;
+    t.hbm_bytes = e.gen->hbm_bytes * (uint64_t)cores / e.gen->cores;
+    t.bf16_tflops = e.gen->bf16_tflops * cores / e.gen->cores;
+    t.slots = (uint32_t)(e.gen->cores / cores);
+    t.is_default = cores == e.gen->cores;
+  }
+  *count = n;
   return TPF_OK;
 }
-TPF_API tpf_status_t tpf_partition_create(const char*, const char*,
-                                          tpf_partition_grant_t*) {
-  return TPF_ERR_UNSUPPORTED;
+
+TPF_API tpf_status_t tpf_partition_create(const char* template_id,
+                                          const char* chip_id,
+                                          tpf_partition_grant_t* grant) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_state.initialized) return TPF_ERR_NOT_INITIALIZED;
+  if (!template_id || !chip_id || !grant) return TPF_ERR_INVALID_ARG;
+  int idx = find_device_locked(chip_id);
+  if (idx < 0) return TPF_ERR_NOT_FOUND;
+  DeviceEntry& e = g_state.devices[idx];
+  int cores = 1;
+  const char* dash = strrchr(template_id, '-');
+  if (dash && dash[1] >= '1' && dash[1] <= '9') cores = atoi(dash + 1);
+  if (cores < 1 || cores > e.gen->cores) return TPF_ERR_INVALID_ARG;
+  // first free contiguous core range (destroys can leave holes)
+  uint64_t used = 0;
+  for (const auto& p : e.partitions)
+    for (int k = 0; k < p.core_count; ++k) used |= 1ull << (p.core + k);
+  int start = -1;
+  for (int s = 0; s + cores <= e.gen->cores; ++s) {
+    uint64_t range = ((1ull << cores) - 1) << s;
+    if ((used & range) == 0) {
+      start = s;
+      break;
+    }
+  }
+  if (start < 0) return TPF_ERR_EXHAUSTED;
+
+  Partition part;
+  part.template_id = template_id;
+  part.core = start;
+  part.core_count = cores;
+  char pid_buf[TPF_ID_LEN];
+  snprintf(pid_buf, sizeof(pid_buf), "%s-p%zu", chip_id,
+           e.next_partition_seq++);
+  part.partition_id = pid_buf;
+  e.partitions.push_back(part);
+
+  memset(grant, 0, sizeof(*grant));
+  grant->kind = TPF_GRANT_ENV;
+  snprintf(grant->chip_id, sizeof(grant->chip_id), "%s", chip_id);
+  snprintf(grant->partition_id, sizeof(grant->partition_id), "%s", pid_buf);
+  snprintf(grant->env[0], TPF_ENV_LEN, "TPU_VISIBLE_CHIPS=%d",
+           e.host_index);
+  snprintf(grant->env[1], TPF_ENV_LEN, "TPF_VISIBLE_CORES=%d-%d", start,
+           start + cores - 1);
+  snprintf(grant->env[2], TPF_ENV_LEN, "TPF_PARTITION_ID=%s", pid_buf);
+  snprintf(grant->env[3], TPF_ENV_LEN, "TPF_PARTITION_HBM_BYTES=%llu",
+           (unsigned long long)(e.gen->hbm_bytes * (uint64_t)cores /
+                                e.gen->cores));
+  grant->env_count = 4;
+  return TPF_OK;
 }
-TPF_API tpf_status_t tpf_partition_destroy(const char*, const char*) {
-  return TPF_ERR_UNSUPPORTED;
+
+TPF_API tpf_status_t tpf_partition_destroy(const char* template_id,
+                                           const char* chip_id) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_state.initialized) return TPF_ERR_NOT_INITIALIZED;
+  if (!template_id || !chip_id) return TPF_ERR_INVALID_ARG;
+  int idx = find_device_locked(chip_id);
+  if (idx < 0) return TPF_ERR_NOT_FOUND;
+  auto& parts = g_state.devices[idx].partitions;
+  for (size_t i = 0; i < parts.size(); ++i) {
+    if (parts[i].template_id == template_id ||
+        parts[i].partition_id == template_id) {
+      parts.erase(parts.begin() + i);
+      return TPF_OK;
+    }
+  }
+  return TPF_ERR_NOT_FOUND;
 }
-TPF_API tpf_status_t tpf_set_hbm_hard_limit(const char*, uint64_t) {
-  return TPF_ERR_UNSUPPORTED;
+
+// -- hard limits (recorded here, enforced at the client boundary) ------
+
+TPF_API tpf_status_t tpf_set_hbm_hard_limit(const char* chip_id,
+                                            uint64_t limit_bytes) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_state.initialized) return TPF_ERR_NOT_INITIALIZED;
+  if (!chip_id) return TPF_ERR_INVALID_ARG;
+  int idx = find_device_locked(chip_id);
+  if (idx < 0) return TPF_ERR_NOT_FOUND;
+  g_state.devices[idx].hbm_hard_limit = limit_bytes;
+  return TPF_OK;
 }
-TPF_API tpf_status_t tpf_set_duty_hard_limit(const char*, uint32_t) {
-  return TPF_ERR_UNSUPPORTED;
+
+TPF_API tpf_status_t tpf_set_duty_hard_limit(const char* chip_id,
+                                             uint32_t duty_pct) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_state.initialized) return TPF_ERR_NOT_INITIALIZED;
+  if (!chip_id || duty_pct > 100) return TPF_ERR_INVALID_ARG;
+  int idx = find_device_locked(chip_id);
+  if (idx < 0) return TPF_ERR_NOT_FOUND;
+  g_state.devices[idx].duty_hard_limit = duty_pct;
+  return TPF_OK;
 }
-TPF_API tpf_status_t tpf_snapshot(const tpf_snapshot_ctx_t*) {
-  return TPF_ERR_UNSUPPORTED;
+
+// -- snapshot / restore (manifest + freeze; header comment) ------------
+
+TPF_API tpf_status_t tpf_snapshot(const tpf_snapshot_ctx_t* ctx) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_state.initialized) return TPF_ERR_NOT_INITIALIZED;
+  if (!ctx || !ctx->state_dir) return TPF_ERR_INVALID_ARG;
+  if (!ctx->chip_id && ctx->pid_count == 0) return TPF_ERR_INVALID_ARG;
+  char path[TPF_PATH_LEN];
+  snprintf(path, sizeof(path), "%s/%s.tpfsnap", ctx->state_dir,
+           ctx->chip_id ? ctx->chip_id : "procs");
+  FILE* f = fopen(path, "w");
+  if (!f) return TPF_ERR_FAILED;
+  if (ctx->chip_id) {
+    int idx = find_device_locked(ctx->chip_id);
+    if (idx < 0) {
+      fclose(f);
+      return TPF_ERR_NOT_FOUND;
+    }
+    DeviceEntry& e = g_state.devices[idx];
+    e.frozen = true;  // metrics expose it; worker controller quiesces
+    int64_t in_use = 0, limit = 0;
+    memory_stats(e.device, &in_use, &limit);
+    fprintf(f, "chip %s\n", ctx->chip_id);
+    fprintf(f, "kind %s\n", e.kind.c_str());
+    fprintf(f, "coords %lld %lld %lld\n", (long long)e.coords[0],
+            (long long)e.coords[1], (long long)e.coords[2]);
+    fprintf(f, "hbm_in_use %lld\n", (long long)in_use);
+    fprintf(f, "hbm_limit %lld\n", (long long)limit);
+    fprintf(f, "partition_seq %zu\n", e.next_partition_seq);
+    for (const auto& p : e.partitions)
+      fprintf(f, "partition %s %s %d %d\n", p.partition_id.c_str(),
+              p.template_id.c_str(), p.core, p.core_count);
+  } else {
+    for (size_t i = 0; i < ctx->pid_count; ++i)
+      fprintf(f, "pid %lld\n", (long long)ctx->pids[i]);
+  }
+  fclose(f);
+  return TPF_OK;
 }
-TPF_API tpf_status_t tpf_restore(const tpf_snapshot_ctx_t*) {
-  return TPF_ERR_UNSUPPORTED;
+
+TPF_API tpf_status_t tpf_restore(const tpf_snapshot_ctx_t* ctx) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_state.initialized) return TPF_ERR_NOT_INITIALIZED;
+  if (!ctx || !ctx->state_dir) return TPF_ERR_INVALID_ARG;
+  char path[TPF_PATH_LEN];
+  snprintf(path, sizeof(path), "%s/%s.tpfsnap", ctx->state_dir,
+           ctx->chip_id ? ctx->chip_id : "procs");
+  FILE* f = fopen(path, "r");
+  if (!f) return TPF_ERR_NOT_FOUND;
+  if (ctx->chip_id) {
+    int idx = find_device_locked(ctx->chip_id);
+    if (idx < 0) {
+      fclose(f);
+      return TPF_ERR_NOT_FOUND;
+    }
+    DeviceEntry& e = g_state.devices[idx];
+    // re-adopt the manifest's partitions (hypervisor restart recovery)
+    char line[640];
+    while (fgets(line, sizeof(line), f)) {
+      char pid_buf[TPF_ID_LEN], tmpl[TPF_ID_LEN];
+      int core = 0, core_count = 0;
+      size_t seq = 0;
+      if (sscanf(line, "partition_seq %zu", &seq) == 1) {
+        // restore the ID counter too, or fresh creates after a restart
+        // would mint IDs colliding with re-adopted partitions
+        if (seq > e.next_partition_seq) e.next_partition_seq = seq;
+      } else if (sscanf(line, "partition %63s %63s %d %d", pid_buf, tmpl,
+                        &core, &core_count) == 4) {
+        bool known = false;
+        for (const auto& p : e.partitions)
+          if (p.partition_id == pid_buf) known = true;
+        if (!known) {
+          Partition p;
+          p.partition_id = pid_buf;
+          p.template_id = tmpl;
+          p.core = core;
+          p.core_count = core_count;
+          e.partitions.push_back(p);
+        }
+      }
+    }
+    e.frozen = false;
+  }
+  fclose(f);
+  return TPF_OK;
 }
 
 }  // extern "C"

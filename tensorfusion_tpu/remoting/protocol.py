@@ -6,16 +6,23 @@ plumbing via TensorFusionConnection).  CUDA remoting forwards individual
 driver calls; the XLA-native unit is the *executable*, so the protocol
 ships StableHLO once and then only argument/result buffers:
 
+- HELLO:   per-connection auth handshake (shared token, constant-time
+           compare on the worker).
 - COMPILE: client exports its jitted function (``jax.export``) and sends
   the serialized StableHLO; the worker deserializes, compiles for its
   chip, caches under an executable id (content hash).
 - EXECUTE: executable id + flat arg arrays -> flat result arrays.
 - INFO:    worker platform/device inventory for placement decisions.
 
-Framing: one JSON header line (length-prefixed) + concatenated raw
-little-endian buffers described by the header — no pickle anywhere on the
-wire (workers must not execute attacker-controlled bytecode; StableHLO is
-data, not code-with-authority).
+Framing (version 2): one JSON header line (length-prefixed) +
+concatenated buffers described by the header.  Each buffer is raw
+little-endian or zlib-compressed (``enc`` per buffer — large buffers are
+compressed when it actually shrinks them, which is what makes the
+protocol usable across DCN latencies/bandwidth).  Requests carry a
+``seq`` the responder echoes, so a client may pipeline many requests on
+one connection.  No pickle anywhere on the wire (workers must not
+execute attacker-controlled bytecode; StableHLO is data, not
+code-with-authority).
 """
 
 from __future__ import annotations
@@ -23,12 +30,23 @@ from __future__ import annotations
 import json
 import socket
 import struct
+import zlib
 from typing import Any, Dict, List, Tuple
 
 import numpy as np
 
 MAGIC = b"TPFR"
-VERSION = 1
+VERSION = 2
+
+#: buffers at or above this size are candidates for compression
+COMPRESS_MIN_BYTES = 16 << 10
+#: compression must shrink the buffer to below this fraction to be used
+COMPRESS_GAIN = 0.9
+#: cheap compressibility probe: compress only this prefix first, and only
+#: compress the whole buffer when the probe already shows gain (dense
+#: float data is usually incompressible — don't burn CPU proving it on
+#: every call)
+COMPRESS_PROBE_BYTES = 4 << 10
 
 # dtype wire names
 _DTYPES = {"float32", "float64", "float16", "bfloat16", "int8", "int16",
@@ -51,15 +69,25 @@ def _np_dtype(name: str):
 
 
 def encode_message(kind: str, meta: Dict[str, Any],
-                   buffers: List[np.ndarray]) -> bytes:
+                   buffers: List[np.ndarray],
+                   compress: bool = False) -> bytes:
     descs = []
     payload = bytearray()
     for arr in buffers:
         arr = np.ascontiguousarray(arr)
         raw = arr.tobytes()
+        enc = "raw"
+        wire = raw
+        if compress and len(raw) >= COMPRESS_MIN_BYTES:
+            probe = zlib.compress(raw[:COMPRESS_PROBE_BYTES], 1)
+            if len(probe) < COMPRESS_PROBE_BYTES * COMPRESS_GAIN:
+                z = zlib.compress(raw, 1)
+                if len(z) < len(raw) * COMPRESS_GAIN:
+                    enc, wire = "zlib", z
         descs.append({"shape": list(arr.shape), "dtype": _dtype_of(arr),
-                      "nbytes": len(raw)})
-        payload.extend(raw)
+                      "nbytes": len(wire), "raw_nbytes": len(raw),
+                      "enc": enc})
+        payload.extend(wire)
     header = json.dumps({"kind": kind, "meta": meta,
                          "buffers": descs}).encode()
     return MAGIC + struct.pack("<II", VERSION, len(header)) + header + \
@@ -78,8 +106,8 @@ def _read_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def send_message(sock: socket.socket, kind: str, meta: Dict[str, Any],
-                 buffers: List[np.ndarray]) -> None:
-    sock.sendall(encode_message(kind, meta, buffers))
+                 buffers: List[np.ndarray], compress: bool = False) -> None:
+    sock.sendall(encode_message(kind, meta, buffers, compress=compress))
 
 
 def recv_message(sock: socket.socket
@@ -94,6 +122,10 @@ def recv_message(sock: socket.socket
     buffers = []
     for desc in header["buffers"]:
         raw = _read_exact(sock, desc["nbytes"])
+        if desc.get("enc") == "zlib":
+            raw = zlib.decompress(raw)
+            if len(raw) != desc.get("raw_nbytes", len(raw)):
+                raise ValueError("decompressed size mismatch")
         arr = np.frombuffer(raw, dtype=_np_dtype(desc["dtype"]))
         buffers.append(arr.reshape(desc["shape"]))
     return header["kind"], header["meta"], buffers

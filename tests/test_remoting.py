@@ -10,7 +10,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from tensorfusion_tpu.remoting import (RemoteDevice, RemoteExecutionError,
+from tensorfusion_tpu.remoting import (RemoteBuffer, RemoteDevice,
+                                       RemoteExecutionError,
                                        RemoteVTPUWorker)
 from tensorfusion_tpu.remoting.protocol import encode_message, recv_message
 
@@ -128,6 +129,86 @@ def test_connection_resolution_via_operator(worker):
         dev.close()
     finally:
         server.stop()
+
+
+def test_remote_auth_token_required():
+    """A worker with a token must reject bad/missing tokens and accept
+    the right one — this socket compiles attacker-supplied StableHLO."""
+    w = RemoteVTPUWorker(token="s3cret")
+    w.start()
+    try:
+        bad = RemoteDevice(w.url, token="wrong")
+        with pytest.raises(RemoteExecutionError, match="bad token"):
+            bad.info()
+        bad.close()
+
+        good = RemoteDevice(w.url, token="s3cret")
+        assert good.info()["platform"] == "cpu"
+        good.close()
+    finally:
+        w.stop()
+
+
+def test_remote_pipelined_submit(worker):
+    """Many EXECUTEs in flight on one connection; results arrive in
+    order via futures without per-call round-trip blocking."""
+    dev = RemoteDevice(worker.url)
+    remote = dev.remote_jit(lambda x: x * 2.0)
+    x = np.ones((8,), np.float32)
+    remote(x)   # compile once
+    futures = [remote.submit(np.full((8,), float(i), np.float32))
+               for i in range(16)]
+    for i, fut in enumerate(futures):
+        np.testing.assert_allclose(np.asarray(fut.result(timeout=30)),
+                                   np.full((8,), 2.0 * i))
+    assert worker.executions == 17
+    dev.close()
+
+
+def test_remote_resident_hbm_budget(worker):
+    """Kept buffers count against the worker's resident budget; uploads
+    past it are rejected and frees release it."""
+    worker.max_resident_bytes = 3000
+    dev = RemoteDevice(worker.url)
+    ref = dev.put(np.zeros(500, np.float32))        # 2000 bytes
+    with pytest.raises(RemoteExecutionError, match="budget exceeded"):
+        dev.put(np.zeros(500, np.float32))          # 4000 > 3000
+    assert dev.info()["resident_bytes"] == 2000
+    ref.free()
+    assert dev.info()["resident_bytes"] == 0
+    dev.put(np.zeros(500, np.float32))              # fits again
+    dev.close()
+    worker.max_resident_bytes = 0
+
+
+def test_remote_snapshot_restore(worker, tmp_path):
+    """Live-migration buffer half: resident buffers + executable cache
+    persist and re-materialize on a different worker."""
+    dev = RemoteDevice(worker.url)
+    w = np.random.default_rng(3).standard_normal((32, 32)) \
+        .astype(np.float32)
+    ref = dev.put(w)
+    remote = dev.remote_jit(lambda w, x: x @ w)
+    x = np.ones((4, 32), np.float32)
+    want = np.asarray(remote(ref, x))
+    stats = dev.snapshot(str(tmp_path / "snap"))
+    assert stats["buffers"] == 1 and stats["executables"] == 1
+    dev.close()
+
+    target = RemoteVTPUWorker()
+    target.start()
+    try:
+        dev2 = RemoteDevice(target.url)
+        got = dev2.restore(str(tmp_path / "snap"))
+        assert got["buffers"] == 1 and got["executables"] == 1
+        # the same buffer reference works against the restored worker
+        remote2 = dev2.remote_jit(lambda w, x: x @ w)
+        ref2 = RemoteBuffer(dev2, ref.buf_id, ref.shape, "float32")
+        np.testing.assert_allclose(np.asarray(remote2(ref2, x)), want,
+                                   rtol=1e-5)
+        dev2.close()
+    finally:
+        target.stop()
 
 
 def test_remote_resident_buffers(worker):
