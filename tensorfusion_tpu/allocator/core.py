@@ -36,6 +36,8 @@ from .. import constants
 from ..api.resources import AdjustRequest, AllocRequest, ResourceAmount
 from ..api.types import Pod, TPUChip
 from ..store import NotFoundError, ObjectStore
+from .partition_planner import (PartitionPlanRegistry, Placement,
+                                TemplateSpec)
 from .filters import (Filter, FilterResult, NodeAffinityFilter,
                       NodeExclusionFilter, PartitionFitFilter, default_chain,
                       run_filters)
@@ -78,7 +80,9 @@ class ChipState:
 
     def __init__(self, chip: TPUChip, oversell_ratio: float = 1.0,
                  template_cores: Optional[Dict[str, int]] = None,
-                 hbm_expand_ratio: float = 1.0):
+                 hbm_expand_ratio: float = 1.0,
+                 partition_registry: Optional[PartitionPlanRegistry]
+                 = None):
         self.chip = chip
         self.oversell_ratio = oversell_ratio
         #: schedulable-HBM multiplier from the pool's host-expansion config
@@ -88,9 +92,15 @@ class ChipState:
         #: as the hbm_spill_bytes metric
         self.hbm_expand_ratio = hbm_expand_ratio
         self._template_cores = template_cores or {}
+        self.partition_registry = partition_registry or \
+            PartitionPlanRegistry()
         self.allocated = ResourceAmount()
         self.holders: Dict[str, ResourceAmount] = {}   # pod key -> per-chip amt
         self.partition_cores_used = 0
+        #: pod key -> concrete core placement (planner bitmask arithmetic)
+        self.partition_placements: Dict[str, Placement] = {}
+        #: pod key -> template isolation group (must not mix on one chip)
+        self.partition_groups: Dict[str, str] = {}
         self._avail_cache: Optional[ResourceAmount] = None
 
     # -- capacity ---------------------------------------------------------
@@ -121,15 +131,22 @@ class ChipState:
     def template_core_count(self, template_id: str) -> Optional[int]:
         if template_id in self._template_cores:
             return self._template_cores[template_id]
-        # conventional template ids end in "-<n>c"
-        tail = template_id.rsplit("-", 1)[-1]
-        if tail.endswith("c") and tail[:-1].isdigit():
-            return int(tail[:-1])
-        return None
+        # "-<n>c" convention parsing lives in the planner registry
+        spec = self.partition_registry.spec(template_id)
+        return spec.core_count if spec is not None else None
 
     def free_partition_cores(self) -> int:
         return max(0, self.chip.status.core_count
                    - self.partition_cores_used)
+
+    def plan_partition(self, template_id: str) -> Optional[Placement]:
+        """Planner verdict: concrete core placement for the template on
+        this chip's current occupancy, or None (fragmentation and
+        isolation-group conflicts included — stricter than free-count
+        math, partition_strategy.go analog)."""
+        return self.partition_registry.plan(
+            template_id, self.chip.status.core_count,
+            self.partition_placements, self.partition_groups)
 
     # -- mutation ---------------------------------------------------------
 
@@ -138,12 +155,22 @@ class ChipState:
         if key in self.holders:
             raise AllocationConflictError(
                 f"{key} already holds chip {self.chip.name}")
+        placement = None
+        if partition_template:
+            placement = self.plan_partition(partition_template)
+            if placement is None:
+                raise InsufficientResourcesError(
+                    f"no placement for template {partition_template} on "
+                    f"chip {self.chip.name}")
         self.holders[key] = amount
         self.allocated = self.allocated.add(amount)
         self._avail_cache = None
-        if partition_template:
-            cores = self.template_core_count(partition_template) or 0
-            self.partition_cores_used += cores
+        if placement is not None:
+            self.partition_placements[key] = placement
+            spec = self.partition_registry.spec(partition_template)
+            self.partition_groups[key] = spec.isolation_group if spec \
+                else ""
+            self.partition_cores_used += placement.core_count
 
     def drop(self, key: str, partition_template: str = "") -> None:
         amount = self.holders.pop(key, None)
@@ -151,7 +178,12 @@ class ChipState:
             return
         self.allocated = self.allocated.sub(amount)
         self._avail_cache = None
-        if partition_template:
+        placement = self.partition_placements.pop(key, None)
+        self.partition_groups.pop(key, None)
+        if placement is not None:
+            self.partition_cores_used = max(
+                0, self.partition_cores_used - placement.core_count)
+        elif partition_template:
             cores = self.template_core_count(partition_template) or 0
             self.partition_cores_used = max(
                 0, self.partition_cores_used - cores)
@@ -173,6 +205,7 @@ class TPUAllocator:
         self._dirty: set = set()
         self._pool_oversell: Dict[str, float] = {}
         self._pool_hbm_expand: Dict[str, float] = {}
+        self._partition_registry = PartitionPlanRegistry()
         self._template_cores: Dict[str, int] = {}
         self._node_labels = node_labels or (lambda node: {})
         self._filters: List[Filter] = default_chain(
@@ -215,6 +248,28 @@ class TPUAllocator:
     def set_template_cores(self, mapping: Dict[str, int]) -> None:
         with self._lock:
             self._template_cores.update(mapping)
+            for template_id, cores in mapping.items():
+                # never stomp a full spec (isolation group) already
+                # registered via set_partition_templates
+                existing = self._partition_registry.spec(template_id)
+                group = existing.isolation_group if existing else ""
+                self._partition_registry.register(
+                    TemplateSpec(template_id, core_count=cores,
+                                 isolation_group=group))
+
+    def set_partition_templates(self, specs) -> None:
+        """Register full template specs (incl. isolation groups) with the
+        placement planner (ProviderConfig partition templates)."""
+        with self._lock:
+            for spec in specs:
+                if not isinstance(spec, TemplateSpec):
+                    spec = TemplateSpec(
+                        template_id=spec.template_id,
+                        core_count=getattr(spec, "core_count", 1),
+                        isolation_group=getattr(spec, "isolation_group",
+                                                ""))
+                self._partition_registry.register(spec)
+                self._template_cores[spec.template_id] = spec.core_count
 
     def set_gang_waiting_probe(self, probe: Callable[[str], bool]) -> None:
         """Probe asked before TTL-sweeping an assumed allocation — gang
@@ -231,7 +286,9 @@ class TPUAllocator:
             hbm_ratio = self._pool_hbm_expand.get(pool, 1.0)
             if state is None:
                 state = ChipState(chip, ratio, self._template_cores,
-                                  hbm_expand_ratio=hbm_ratio)
+                                  hbm_expand_ratio=hbm_ratio,
+                                  partition_registry=
+                                  self._partition_registry)
                 self._chips[chip.name] = state
             else:
                 state.chip = chip
@@ -378,10 +435,13 @@ class TPUAllocator:
     def _clone_chip_state(self, state: ChipState) -> ChipState:
         clone = ChipState(state.chip, state.oversell_ratio,
                           state._template_cores,
-                          hbm_expand_ratio=state.hbm_expand_ratio)
+                          hbm_expand_ratio=state.hbm_expand_ratio,
+                          partition_registry=state.partition_registry)
         clone.allocated = state.allocated
         clone.holders = dict(state.holders)
         clone.partition_cores_used = state.partition_cores_used
+        clone.partition_placements = dict(state.partition_placements)
+        clone.partition_groups = dict(state.partition_groups)
         return clone
 
     def dry_run_fit(self, req: AllocRequest, node: str,
@@ -504,7 +564,10 @@ class TPUAllocator:
                 for c in chips:
                     c.hold(key, per_chip, req.partition_template)
                     held.append(c)
-            except AllocationConflictError:
+            except (AllocationConflictError, InsufficientResourcesError):
+                # conflict or no partition placement (a concurrent
+                # allocation can take the last contiguous gap between
+                # Filter and here): unwind everything
                 for c in held:
                     c.drop(key, req.partition_template)
                 self.quota.unassume(req)
@@ -727,6 +790,8 @@ class TPUAllocator:
                 state.allocated = ResourceAmount()
                 state.holders.clear()
                 state.partition_cores_used = 0
+                state.partition_placements.clear()
+                state.partition_groups.clear()
             self._allocations.clear()
             restored = 0
             committed_reqs = []
@@ -744,8 +809,14 @@ class TPUAllocator:
                         log.warning("reconcile: pod %s references unknown "
                                     "chip %s", record.key, chip_name)
                         continue
-                    state.hold(record.key, per_chip,
-                               record.request.partition_template)
+                    try:
+                        state.hold(record.key, per_chip,
+                                   record.request.partition_template)
+                    except InsufficientResourcesError:
+                        # corrupt annotations must not kill restart
+                        # recovery; the pod keeps its record, unplaced
+                        log.error("reconcile: no partition placement for "
+                                  "%s on %s", record.key, chip_name)
                 self._allocations[record.key] = record
                 committed_reqs.append(record.request)
                 restored += 1

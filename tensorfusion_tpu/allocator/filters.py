@@ -139,8 +139,10 @@ class ResourceFitFilter(Filter):
 
 
 class PartitionFitFilter(Filter):
-    """Partitioned isolation: the chip must have a free slot for the
-    requested partition template."""
+    """Partitioned isolation: the chip must have a concrete *placement*
+    for the requested template — contiguous-core best-fit with
+    isolation-group rules, not just a free-core count (the planner is
+    the partition_strategy.go slot/placement-bitmask analog)."""
 
     name = "partition-fit"
 
@@ -149,13 +151,13 @@ class PartitionFitFilter(Filter):
             return None
         if not req.partition_template:
             return "partitioned request without a template"
-        free = chip.free_partition_cores()
-        want = chip.template_core_count(req.partition_template)
-        if want is None:
+        if chip.template_core_count(req.partition_template) is None:
             return f"unknown partition template {req.partition_template}"
-        if want > free:
-            return f"no free cores for template {req.partition_template} " \
-                   f"(want {want}, free {free})"
+        if chip.plan_partition(req.partition_template) is None:
+            return (f"no placement for template {req.partition_template} "
+                    f"(free {chip.free_partition_cores()} of "
+                    f"{chip.chip.status.core_count} cores, fragmentation/"
+                    f"isolation-group rules applied)")
         return None
 
 

@@ -75,48 +75,56 @@ class MockCloudProvider:
         n = next(self._seq)
         node_name = claim.status.node_name or f"{claim.name}-node"
         instance_id = f"mock-{it.name}-{n}"
-
-        node = Node.new(node_name)
-        node.status.phase = constants.PHASE_RUNNING
-        node.status.allocatable_cpu = 64.0
-        node.status.allocatable_memory_bytes = 256 << 30
-        self._create_quiet(node)
-
-        tnode = TPUNode.new(node_name)
-        tnode.spec.pool = claim.spec.pool
-        tnode.spec.manage_mode = "Provisioned"
-        tnode.status.phase = constants.PHASE_RUNNING
-        self._create_quiet(tnode)
-
-        mx, my = it.mesh
-        for i in range(it.chips):
-            chip = TPUChip.new(f"{node_name}-chip-{i}")
-            st = chip.status
-            st.phase = constants.PHASE_RUNNING
-            st.capacity = ResourceAmount(tflops=it.bf16_tflops,
-                                         duty_percent=100.0,
-                                         hbm_bytes=it.hbm_bytes)
-            st.available = st.capacity
-            st.generation = it.generation
-            st.vendor = "mock-tpu"
-            st.node_name = node_name
-            st.pool = claim.spec.pool
-            st.slice_id = f"{node_name}-slice"
-            st.host_index = i
-            st.core_count = it.cores_per_chip
-            st.mesh = MeshCoords(x=i % mx, y=i // mx)
-            st.capabilities = {"soft_isolation": True,
-                               "hard_isolation": True,
-                               "core_partitioning": it.cores_per_chip > 1}
-            self._create_quiet(chip)
-
+        materialize_tpu_host(self.store, claim.spec.pool, node_name, it,
+                             vendor="mock-tpu")
         self.provisioned.append((claim.name, instance_id))
         log.info("provisioned %s (%s: %d x %s chips) for claim %s",
                  node_name, it.name, it.chips, it.generation, claim.name)
         return node_name, instance_id
 
-    def _create_quiet(self, obj) -> None:
-        try:
-            self.store.create(obj)
-        except AlreadyExistsError:
-            pass
+
+def _create_quiet(store: ObjectStore, obj) -> None:
+    try:
+        store.create(obj)
+    except AlreadyExistsError:
+        pass
+
+
+def materialize_tpu_host(store: ObjectStore, pool: str, node_name: str,
+                         it: InstanceType, vendor: str = "mock-tpu") -> None:
+    """Register a freshly provisioned host's inventory (Node + TPUNode +
+    per-chip TPUChip objects with ICI mesh coordinates) into the store —
+    shared by every cloud provider backend."""
+    node = Node.new(node_name)
+    node.status.phase = constants.PHASE_RUNNING
+    node.status.allocatable_cpu = 64.0
+    node.status.allocatable_memory_bytes = 256 << 30
+    _create_quiet(store, node)
+
+    tnode = TPUNode.new(node_name)
+    tnode.spec.pool = pool
+    tnode.spec.manage_mode = "Provisioned"
+    tnode.status.phase = constants.PHASE_RUNNING
+    _create_quiet(store, tnode)
+
+    mx, _my = it.mesh
+    for i in range(it.chips):
+        chip = TPUChip.new(f"{node_name}-chip-{i}")
+        st = chip.status
+        st.phase = constants.PHASE_RUNNING
+        st.capacity = ResourceAmount(tflops=it.bf16_tflops,
+                                     duty_percent=100.0,
+                                     hbm_bytes=it.hbm_bytes)
+        st.available = st.capacity
+        st.generation = it.generation
+        st.vendor = vendor
+        st.node_name = node_name
+        st.pool = pool
+        st.slice_id = f"{node_name}-slice"
+        st.host_index = i
+        st.core_count = it.cores_per_chip
+        st.mesh = MeshCoords(x=i % mx, y=i // mx)
+        st.capabilities = {"soft_isolation": True,
+                           "hard_isolation": True,
+                           "core_partitioning": it.cores_per_chip > 1}
+        _create_quiet(store, chip)

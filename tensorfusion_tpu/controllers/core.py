@@ -219,10 +219,11 @@ class ProviderConfigController(Controller):
         cfg = event.obj
         for m in cfg.spec.chip_models:
             self.chip_models[m.generation] = m
-        templates = {t.template_id: t.core_count
-                     for t in cfg.spec.partition_templates}
-        if templates:
-            self.allocator.set_template_cores(templates)
+        if cfg.spec.partition_templates:
+            # full specs: isolation groups must reach the placement
+            # planner, not just core counts
+            self.allocator.set_partition_templates(
+                cfg.spec.partition_templates)
         if self.parser is not None:
             self.parser.set_chip_models(self.chip_models)
 

@@ -136,6 +136,29 @@ class HypervisorServer:
                     "status": _to_jsonable(w.status)}
                    for w in self.workers.list()]
             h._send(200, out)
+        elif url.path == "/api/v1/allocations":
+            # Pod-resources-proxy analog (pod_resources_proxy.go:87-318):
+            # the per-pod device-assignment view monitoring agents
+            # (DCGM-exporter-style) read to correlate metrics with pods.
+            out = []
+            for w in self.workers.list():
+                out.append({
+                    "namespace": w.spec.namespace,
+                    "pod": w.spec.name,
+                    "isolation": w.spec.isolation,
+                    "devices": [{
+                        "chip_id": b.chip_id,
+                        "host_index": b.host_index,
+                        "device_index": b.device_index,
+                        "duty_percent": b.duty_percent,
+                        "hbm_bytes": b.hbm_bytes,
+                        "host_spill_bytes": b.host_spill_bytes,
+                        "partition_id": b.grant.partition_id
+                        if b.grant is not None else "",
+                    } for b in w.allocation.bindings],
+                    "mounts": list(w.allocation.mounts),
+                })
+            h._send(200, out)
         elif url.path == "/limiter":
             # Legacy client bootstrap: worker identity -> shm path + env.
             qs = parse_qs(url.query)

@@ -49,7 +49,8 @@ class Operator:
                  metrics_path: str = "",
                  alert_rules=None, alert_webhook: str = "",
                  sync_interval_s: float = 2.0,
-                 config_path: str = ""):
+                 config_path: str = "",
+                 leader_lock: str = ""):
         self.store = store or ObjectStore()
         self.allocator = TPUAllocator(store=self.store)
         self.ports = PortAllocator()
@@ -129,9 +130,25 @@ class Operator:
             self.config_watcher = GlobalConfigWatcher(config_path)
             self.config_watcher.on_change(self._apply_global_config)
 
+        # HA: with a leader lock configured, replicas race for an fcntl
+        # lock and only the winner runs controllers + scheduler
+        # (controller-runtime leader election + leader-info analog,
+        # cmd/main.go:785-812)
+        self.elector = None
+        if leader_lock:
+            import os as _os
+
+            from .utils.leader import LeaderElector
+
+            self.elector = LeaderElector(
+                leader_lock,
+                identity=f"{_os.uname().nodename}-{_os.getpid()}",
+                on_started_leading=self._start_components)
+
         self._stop = threading.Event()
         self._sync_thread: Optional[threading.Thread] = None
         self._started = False
+        self._components_started = False
 
     def _apply_global_config(self, cfg) -> None:
         """Push a (re)loaded GlobalConfig into the live components."""
@@ -153,6 +170,15 @@ class Operator:
         if self._started:
             return
         self._stop.clear()  # support stop() -> start() restart cycles
+        if self.elector is not None:
+            # leadership decides when components actually run
+            self.elector.start()
+            self._started = True
+            return
+        self._start_components()
+        self._started = True
+
+    def _start_components(self) -> None:
         # restart recovery before serving: chips first (the watch replay is
         # async), then rebuild allocator + quota state from persisted pods
         # (reconcileAllocationState analog)
@@ -195,20 +221,24 @@ class Operator:
         if self.config_watcher is not None:
             self._apply_global_config(self.config_watcher.config)
             self.config_watcher.start()
-        self._started = True
-        log.info("operator started")
+        self._components_started = True
+        log.info("operator components started")
 
     def stop(self) -> None:
         self._stop.set()
-        if self.config_watcher is not None:
-            self.config_watcher.stop()
-        for component in (self.alerts, self.autoscaler, self.metrics):
-            if component is not None:
-                component.stop()
-        self.scheduler.stop()
-        self.manager.stop()
-        if self._sync_thread:
-            self._sync_thread.join(timeout=2)
+        if self.elector is not None:
+            self.elector.stop()
+        if self._components_started:
+            if self.config_watcher is not None:
+                self.config_watcher.stop()
+            for component in (self.alerts, self.autoscaler, self.metrics):
+                if component is not None:
+                    component.stop()
+            self.scheduler.stop()
+            self.manager.stop()
+            if self._sync_thread:
+                self._sync_thread.join(timeout=2)
+            self._components_started = False
         self._started = False
 
     def _sync_loop(self) -> None:

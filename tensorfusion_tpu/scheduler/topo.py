@@ -107,6 +107,32 @@ def _evaluate(chips: List["ChipState"], idxs: Tuple[int, ...],
     return _is_rectangle(subset), max_h, sum_h
 
 
+def _fragmentation_damage(n: int, idxs: Tuple[int, ...],
+                          mat: List[List[int]]) -> int:
+    """Least-damage term: number of 1-hop-connected components the
+    *remaining* chips are shattered into by taking `idxs` (0 when nothing
+    remains).  Fewer components = the leftover mesh stays usable for the
+    next multi-chip job (peer_evaluator.go least-damage analog)."""
+    remaining = [i for i in range(n) if i not in idxs]
+    if not remaining:
+        return 0
+    seen = set()
+    components = 0
+    for root in remaining:
+        if root in seen:
+            continue
+        components += 1
+        stack = [root]
+        seen.add(root)
+        while stack:
+            i = stack.pop()
+            for j in remaining:
+                if j not in seen and mat[i][j] <= 1:
+                    seen.add(j)
+                    stack.append(j)
+    return components
+
+
 def plan_for_node(chips: List["ChipState"], count: int,
                   config: Optional[TopologyConfig] = None
                   ) -> Optional[NodeTopologyPlan]:
@@ -142,14 +168,16 @@ def plan_for_node(chips: List["ChipState"], count: int,
 
     best: Optional[NodeTopologyPlan] = None
     best_key = None
+    n = len(chips)
     for idxs in candidates:
         rect, max_h, sum_h = _evaluate(chips, idxs, mat)
         if config.max_allowed_hops >= 0 and max_h > config.max_allowed_hops:
             continue
+        damage = _fragmentation_damage(n, idxs, mat)
         if config.prefer_contiguous_submesh:
-            key = (not rect, max_h, sum_h)
+            key = (not rect, max_h, sum_h, damage)
         else:
-            key = (False, max_h, sum_h)
+            key = (False, max_h, sum_h, damage)
         if best_key is None or key < best_key:
             best_key = key
             best = NodeTopologyPlan(
@@ -168,8 +196,39 @@ class ICITopologyPlugin(PreFilterPlugin, ScorePlugin):
 
     name = "ICITopologyAware"
 
+    #: bound on the memoized plan cache (plans depend only on the eligible
+    #: chip set + count — coordinates and links are static — so identical
+    #: requests across scheduling cycles hit the cache instead of
+    #: re-running the combination search per pod)
+    PLAN_CACHE_MAX = 4096
+
     def __init__(self, config: Optional[TopologyConfig] = None):
         self.config = config or TopologyConfig()
+        self._plan_cache: Dict[tuple, Optional[NodeTopologyPlan]] = {}
+
+    @staticmethod
+    def _topo_fingerprint(chips: List["ChipState"]) -> tuple:
+        """Cheap digest of what the plan depends on: coordinates + link
+        hop structure.  Both can change at runtime (link degradation,
+        node re-provisioning under the same names), and a stale plan
+        could violate the current hop limit."""
+        return tuple(
+            (c.chip.name, c.chip.status.mesh.x, c.chip.status.mesh.y,
+             c.chip.status.mesh.z,
+             len(c.chip.status.ici_links),
+             sum(l.hops for l in c.chip.status.ici_links if l.hops > 0))
+            for c in sorted(chips, key=lambda s: s.chip.name))
+
+    def _plan_cached(self, chips: List["ChipState"],
+                     count: int) -> Optional[NodeTopologyPlan]:
+        key = (self._topo_fingerprint(chips), count)
+        if key in self._plan_cache:
+            return self._plan_cache[key]
+        plan = plan_for_node(chips, count, self.config)
+        if len(self._plan_cache) >= self.PLAN_CACHE_MAX:
+            self._plan_cache.clear()
+        self._plan_cache[key] = plan
+        return plan
 
     def pre_filter(self, state: CycleState, pod: Pod) -> Status:
         if not self.config.enabled:
@@ -191,7 +250,7 @@ class ICITopologyPlugin(PreFilterPlugin, ScorePlugin):
                 plans[node] = NodeTopologyPlan(
                     chip_names=[c.chip.name for c in chips[:req.chip_count]])
                 continue
-            plan = plan_for_node(chips, req.chip_count, self.config)
+            plan = self._plan_cached(chips, req.chip_count)
             if plan is not None:
                 plans[node] = plan
         state[STATE_TOPO_PLANS] = plans

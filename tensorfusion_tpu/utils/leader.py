@@ -1,0 +1,143 @@
+"""Leader election for HA operator deployments.
+
+Analog of the reference's controller-runtime leader election + the
+leader-info ConfigMap carrying the leader's IP (``cmd/main.go:785-812``,
+consumed by webhook host-port forwarding): several operator replicas
+share one state directory (or PVC); an ``fcntl`` exclusive lock on the
+lock file elects exactly one leader, which publishes its identity +
+endpoint in ``leader-info.json`` next to it.  Followers poll for the
+lock and read the info file to forward leader-only requests
+(assign-host-port / assign-index in the reference).
+
+File locks release automatically when the holder dies — crash failover
+needs no TTL bookkeeping.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import json
+import logging
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+log = logging.getLogger("tpf.leader")
+
+
+class LeaderElector:
+    def __init__(self, lock_path: str, identity: str,
+                 endpoint: str = "",
+                 on_started_leading: Optional[Callable[[], None]] = None,
+                 on_stopped_leading: Optional[Callable[[], None]] = None,
+                 retry_interval_s: float = 1.0):
+        self.lock_path = lock_path
+        self.identity = identity
+        self.endpoint = endpoint
+        self.on_started_leading = on_started_leading or (lambda: None)
+        self.on_stopped_leading = on_stopped_leading or (lambda: None)
+        self.retry_interval_s = retry_interval_s
+        self.is_leader = False
+        self._fd: Optional[int] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def info_path(self) -> str:
+        return os.path.join(os.path.dirname(self.lock_path) or ".",
+                            "leader-info.json")
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._campaign,
+                                        name="tpf-leader", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+        self._resign()
+
+    def wait_for_leadership(self, timeout_s: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.is_leader:
+                return True
+            if self._stop.is_set():
+                return False
+            time.sleep(0.02)
+        return self.is_leader
+
+    # -- internals ------------------------------------------------------
+
+    def _campaign(self) -> None:
+        while not self._stop.is_set():
+            if self._try_acquire():
+                self.is_leader = True
+                log.info("%s acquired leadership (%s)", self.identity,
+                         self.lock_path)
+                try:
+                    self.on_started_leading()
+                except Exception:
+                    log.exception("on_started_leading failed")
+                # hold until stopped; the OS releases the lock if we die
+                self._stop.wait()
+                return
+            self._stop.wait(self.retry_interval_s)
+
+    def _try_acquire(self) -> bool:
+        os.makedirs(os.path.dirname(self.lock_path) or ".", exist_ok=True)
+        fd = os.open(self.lock_path, os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(fd)
+            return False
+        self._fd = fd
+        os.ftruncate(fd, 0)
+        os.write(fd, self.identity.encode())
+        with open(self.info_path, "w") as f:
+            json.dump({"identity": self.identity, "pid": os.getpid(),
+                       "endpoint": self.endpoint,
+                       "acquired_at": time.time()}, f)
+        return True
+
+    def _resign(self) -> None:
+        if self._fd is not None:
+            was_leader = self.is_leader
+            self.is_leader = False
+            # retract our leader-info so followers don't forward to a
+            # resigned leader (a successor overwrites it on acquire)
+            try:
+                info = self.read_leader_info(self.lock_path)
+                if info and info.get("identity") == self.identity:
+                    os.unlink(self.info_path)
+            except OSError:
+                pass
+            try:
+                fcntl.flock(self._fd, fcntl.LOCK_UN)
+                os.close(self._fd)
+            except OSError:
+                pass
+            self._fd = None
+            if was_leader:
+                try:
+                    self.on_stopped_leading()
+                except Exception:
+                    log.exception("on_stopped_leading failed")
+
+    # -- follower side --------------------------------------------------
+
+    @staticmethod
+    def read_leader_info(lock_path: str) -> Optional[dict]:
+        info_path = os.path.join(os.path.dirname(lock_path) or ".",
+                                 "leader-info.json")
+        try:
+            with open(info_path) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None

@@ -89,6 +89,50 @@ def test_oversell_allows_overcommit_of_tflops_not_hbm():
         alloc.alloc(req(pod="p10", tflops=1.0, hbm=8 * 2**30))
 
 
+def test_partition_planner_best_fit_and_fragmentation():
+    """Placement is bitmask arithmetic, not count math: best-fit picks the
+    smallest adequate gap, and a fragmented chip with enough total free
+    cores still rejects a template needing a contiguous run."""
+    from tensorfusion_tpu.allocator.core import ChipState
+    from tensorfusion_tpu.allocator.partition_planner import TPUCorePlanner
+
+    used = 0b00001100                     # cores 2,3 busy of 8
+    p = TPUCorePlanner.place(8, used, 2)
+    assert (p.start_core, p.core_count) == (0, 2)   # smallest gap first
+    assert TPUCorePlanner.place(8, used, 4).start_core == 4
+    assert TPUCorePlanner.place(8, used, 5) is None
+
+    state = ChipState(make_chip("c4", cores=4))
+    amt = ResourceAmount(tflops=1.0)
+    state.hold("a", amt, "t-1c")
+    state.hold("b", amt, "t-2c")
+    assert state.partition_placements["b"].start_core == 2   # aligned
+    state.hold("c", amt, "t-1c")                             # takes core 1
+    with pytest.raises(InsufficientResourcesError):
+        state.hold("d", amt, "t-1c")                         # chip full
+    # free total == 2 cores after drops, but only contiguous {0,1} works
+    state.drop("a", "t-1c")
+    assert state.plan_partition("t-2c") is None              # {0} alone
+    state.drop("c", "t-1c")
+    assert state.plan_partition("t-2c").start_core == 0
+
+
+def test_partition_isolation_groups_do_not_mix():
+    """Templates of different isolation groups must not share a chip
+    (ProviderConfig partition-template contract)."""
+    from tensorfusion_tpu.allocator.core import ChipState
+    from tensorfusion_tpu.allocator.partition_planner import (
+        PartitionPlanRegistry, TemplateSpec)
+
+    reg = PartitionPlanRegistry()
+    reg.register(TemplateSpec("secure-1c", 1, isolation_group="secure"))
+    reg.register(TemplateSpec("shared-1c", 1, isolation_group="shared"))
+    state = ChipState(make_chip("c4", cores=4), partition_registry=reg)
+    state.hold("a", ResourceAmount(tflops=1.0), "secure-1c")
+    assert state.plan_partition("secure-1c") is not None
+    assert state.plan_partition("shared-1c") is None
+
+
 def test_hbm_host_expansion_extends_schedulable_hbm():
     """Pool host-expansion (gpupool vramExpandToHostMem/Disk analog): the
     schedulable HBM grows by the host fractions, and the allocated excess

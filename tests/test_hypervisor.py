@@ -241,6 +241,34 @@ def test_external_usage_marks_chips(devices):
         constants.CHIP_USED_BY_TPU_FUSION
 
 
+def test_allocations_api_lists_pod_device_assignments(stack, tmp_path):
+    """GET /api/v1/allocations: per-pod device/partition/mount view for
+    monitoring agents (pod-resources proxy analog)."""
+    devices_ctrl, alloc, workers, limiter = stack
+    entry = devices_ctrl.devices()[0]
+    workers.add_worker(WorkerSpec(
+        namespace="mon", name="w", isolation=constants.ISOLATION_SOFT,
+        devices=[WorkerDeviceRequest(chip_id=entry.info.chip_id,
+                                     duty_percent=40.0,
+                                     hbm_bytes=2**30)]))
+    server = HypervisorServer(devices_ctrl, workers,
+                              snapshot_dir=str(tmp_path), port=0)
+    server.start()
+    try:
+        with urllib.request.urlopen(
+                f"{server.url}/api/v1/allocations", timeout=5) as r:
+            allocs = json.loads(r.read())
+        assert len(allocs) == 1
+        a = allocs[0]
+        assert (a["namespace"], a["pod"]) == ("mon", "w")
+        assert a["devices"][0]["chip_id"] == entry.info.chip_id
+        assert a["devices"][0]["duty_percent"] == 40.0
+        assert a["mounts"] == [f"/dev/accel{entry.info.host_index}"]
+    finally:
+        server.stop()
+        workers.remove_worker("mon/w")
+
+
 def test_hard_isolation_sets_provider_limits(stack):
     devices, alloc, workers, limiter = stack
     ctl = MockProviderControl(devices.provider)

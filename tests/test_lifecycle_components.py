@@ -24,6 +24,87 @@ def test_chip_info_db():
     assert "v5p" in mock_chip_info()
 
 
+def test_tpu_vm_provider_queued_resource_flow(tmp_path):
+    """The GCP TPU-VM backend provisions through queued resources
+    (CREATING -> ACTIVE), registers the host inventory, and maps node
+    states / pricing like the reference's GPUNodeProvider interface."""
+    from tensorfusion_tpu.api.types import TPUChip, TPUNodeClaim
+    from tensorfusion_tpu.cloudprovider.tpu_vm import (TPUVMError,
+                                                       TPUVMProvider)
+    from tensorfusion_tpu.store import ObjectStore
+
+    calls = []
+    state = {"polls": 0}
+
+    def fake_api(method, path, body):
+        calls.append((method, path))
+        if method == "POST" and "queuedResources" in path:
+            return {"name": path}
+        if method == "GET" and "queuedResources/" in path:
+            state["polls"] += 1
+            return {"state": {"state": "ACTIVE" if state["polls"] >= 2
+                              else "CREATING"}}
+        if method == "GET" and "/nodes/" in path:
+            return {"state": "READY"}
+        if method == "DELETE":
+            return {}
+        return {}
+
+    store = ObjectStore()
+    prov = TPUVMProvider(store, project="proj", zone="us-central2-b",
+                         transport=fake_api, poll_interval_s=0.01)
+    claim = TPUNodeClaim.new("claim-1")
+    claim.spec.pool = "pool-a"
+    claim.spec.generation = "v5e"
+    claim.spec.chip_count = 8
+    node_name, instance_id = prov.provision(claim)
+    assert node_name == "claim-1-node"
+    assert "projects/proj" in instance_id
+    assert state["polls"] >= 2                      # went through CREATING
+    chips = store.list(TPUChip)
+    assert len(chips) == 8
+    assert all(c.status.vendor == "gcp-tpu" for c in chips)
+    assert prov.node_status(node_name) == "Running"
+    assert prov.instance_pricing("ct5lp-hightpu-8t") > 0
+    prov.terminate(node_name)
+    assert ("DELETE", f"projects/proj/locations/us-central2-b/nodes/"
+            f"{node_name}") == calls[-1]
+
+    # no transport -> loud failure, not silent pretend-provisioning
+    bare = TPUVMProvider(ObjectStore())
+    import pytest as _pytest
+    with _pytest.raises(TPUVMError, match="transport"):
+        bare.test_connection()
+
+
+def test_leader_election_single_leader_and_failover(tmp_path):
+    """Two operator replicas sharing a lock: exactly one runs components;
+    when the leader resigns, the follower takes over (leader-election +
+    leader-info analog, cmd/main.go:785-812)."""
+    from tensorfusion_tpu.operator import Operator
+    from tensorfusion_tpu.store import ObjectStore
+    from tensorfusion_tpu.utils.leader import LeaderElector
+
+    lock = str(tmp_path / "ha" / "leader.lock")
+    store = ObjectStore()
+    a = Operator(store=store, leader_lock=lock)
+    b = Operator(store=store, leader_lock=lock)
+    a.start()
+    assert a.elector.wait_for_leadership(5)
+    b.start()
+    time.sleep(0.3)
+    assert a._components_started and not b._components_started
+    info = LeaderElector.read_leader_info(lock)
+    assert info and info["identity"] == a.elector.identity
+
+    a.stop()                            # resign -> follower takes over
+    deadline = time.time() + 10
+    while not b._components_started and time.time() < deadline:
+        time.sleep(0.05)
+    assert b.elector.is_leader and b._components_started
+    b.stop()
+
+
 def test_operator_wires_global_config(tmp_path):
     """The operator must consume a GlobalConfig file: initial values are
     applied at start and live reloads reach the running components

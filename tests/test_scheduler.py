@@ -123,6 +123,23 @@ def test_topology_prefers_contiguous_submesh():
     assert dist == 1
 
 
+def test_plan_least_damage_avoids_shattering_the_mesh():
+    """On a 1x4 ICI line, a 2-chip plan must take an end pair: the middle
+    pair would shatter the remaining chips into two unusable islands
+    (the least-damage ranking term)."""
+    from tensorfusion_tpu.allocator.core import ChipState
+
+    chips = []
+    for i in range(4):
+        chip = make_chip(f"line-{i}", node="n")
+        chip.status.mesh = MeshCoords(x=i, y=0)
+        chips.append(ChipState(chip))
+    plan = plan_for_node(chips, 2)
+    assert plan is not None and plan.contiguous and plan.max_hops == 1
+    taken = {int(name.split("-")[1]) for name in plan.chip_names}
+    assert taken != {1, 2}, "middle pair shatters the remaining mesh"
+
+
 def test_plan_for_node_rectangle_detection():
     chips = []
     for i in range(8):  # 2x4 mesh
