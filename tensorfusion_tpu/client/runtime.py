@@ -58,7 +58,8 @@ class VTPUClient:
                  shm_path: Optional[str] = None,
                  hypervisor_url: Optional[str] = None,
                  device_index: int = 0,
-                 register_pid: bool = True):
+                 register_pid: bool = True,
+                 live_hbm_interval_s: Optional[float] = None):
         self.limiter_lib = limiter_lib or os.environ.get(
             constants.ENV_LIMITER_LIB, "native/build/libtpf_limiter.so")
         self.shm_path = shm_path or os.environ.get(constants.ENV_SHM_PATH)
@@ -72,7 +73,71 @@ class VTPUClient:
         self.launches = 0
         self.blocked_time_s = 0.0
         self.charged_mflops = 0
+        self.live_hbm_bytes = 0
+        self._stop_reporter = threading.Event()
+        self._reporter: Optional[threading.Thread] = None
         self._bootstrap(register_pid)
+        # Live HBM accounting: compile-time charges miss buffer churn
+        # (donation, device_puts outside metered fns), so a sampler walks
+        # jax.live_arrays() and reconciles the worker's shm HBM meter to
+        # the *actual* device footprint (CheckAndRecordMemoryOps parity
+        # for a runtime with no per-malloc hook).  While it runs, the
+        # metered-function path skips its compile-time HBM charge —
+        # the same output buffers are live arrays and would double-count.
+        # Enable via the constructor or TPF_LIVE_HBM_S (read by the
+        # TPF_VTPU=1 auto-activation path in hosted workers).
+        if live_hbm_interval_s is None:
+            try:
+                live_hbm_interval_s = float(os.environ.get(
+                    constants.ENV_LIVE_HBM_INTERVAL, "0") or 0)
+            except ValueError:
+                live_hbm_interval_s = 0.0
+        self.live_sampling = live_hbm_interval_s > 0 and self.attached
+        if self.live_sampling:
+            self._reporter = threading.Thread(
+                target=self._live_hbm_loop, args=(live_hbm_interval_s,),
+                name="tpf-live-hbm", daemon=True)
+            self._reporter.start()
+
+    # -- live HBM sampling -------------------------------------------------
+
+    def sample_live_hbm(self) -> int:
+        """One reconciliation pass: total bytes of live jax arrays on the
+        default backend, pushed into the shm segment as this pod's HBM
+        usage.  The process total is charged to this client's device slot
+        (the single-slot client contract); host-committed arrays are
+        excluded when an accelerator backend is active."""
+        import jax
+
+        platform = jax.default_backend()
+        total = 0
+        try:
+            for arr in jax.live_arrays():
+                try:
+                    devs = getattr(arr, "sharding", None)
+                    devs = devs.device_set if devs is not None else set()
+                except Exception:  # noqa: BLE001
+                    devs = set()
+                if platform != "cpu" and devs and \
+                        all(d.platform == "cpu" for d in devs):
+                    continue    # host staging buffer, not HBM
+                total += int(getattr(arr, "nbytes", 0) or 0)
+        except Exception:  # noqa: BLE001 - sampling must never kill
+            log.debug("live-array walk failed", exc_info=True)
+            return self.live_hbm_bytes
+        with self._lock:
+            delta = total - self.live_hbm_bytes
+            if delta != 0 and self.attached:
+                r = self.limiter.charge_hbm(self.device_index, delta)
+                if r.allowed or delta < 0:
+                    self.live_hbm_bytes = total
+                # denied growth: keep the baseline so the next pass
+                # retries (the hypervisor sees the shortfall meanwhile)
+        return total
+
+    def _live_hbm_loop(self, interval_s: float) -> None:
+        while not self._stop_reporter.wait(interval_s):
+            self.sample_live_hbm()
 
     # -- bootstrap (legacy client endpoints analog) ------------------------
 
@@ -110,6 +175,9 @@ class VTPUClient:
             self.limiter = None
 
     def close(self) -> None:
+        self._stop_reporter.set()
+        if self._reporter is not None:
+            self._reporter.join(timeout=2)
         if self.limiter is not None and self.attached:
             try:
                 self.limiter.detach()
@@ -190,7 +258,10 @@ class VTPUClient:
                               + getattr(mem, "temp_size_in_bytes", 0))
                 except Exception:
                     hbm = 0
-                if hbm > 0 and sig not in hbm_charged:
+                # live sampling supersedes the compile-time estimate —
+                # the outputs are live arrays it will count itself
+                if hbm > 0 and sig not in hbm_charged and \
+                        not client.live_sampling:
                     client.charge_hbm(hbm)
                     hbm_charged[sig] = hbm
                 return mflops

@@ -206,6 +206,7 @@ ENV_ISOLATION = "TPF_ISOLATION"
 ENV_DEVICE_MOUNTS = "TPF_DEVICE_MOUNTS"        # mount-policy host paths
 ENV_HBM_HOST_SPILL = "TPF_HBM_HOST_SPILL"      # bytes the client must offload
 ENV_REAL_PJRT_PLUGIN = "TPF_REAL_PJRT_PLUGIN"  # vendor plugin behind the proxy
+ENV_LIVE_HBM_INTERVAL = "TPF_LIVE_HBM_S"       # live-array HBM sampling period
 ENV_VTPU_ENABLED = "TPF_VTPU"                  # "1" auto-activates metering
 ENV_PROVIDER_LIB = "TPF_PROVIDER_LIB"
 ENV_LIMITER_LIB = "TPF_LIMITER_LIB"

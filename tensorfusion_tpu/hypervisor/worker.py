@@ -202,6 +202,8 @@ class WorkerController:
         env[constants.ENV_REAL_PJRT_PLUGIN] = real
         env["TPU_LIBRARY_PATH"] = proxy
         env["PJRT_NAMES_AND_LIBRARY_PATHS"] = f"tpu:{proxy}"
+        # cooperative clients reconcile actual buffer churn periodically
+        env.setdefault(constants.ENV_LIVE_HBM_INTERVAL, "10")
 
     # -- hot loop ---------------------------------------------------------
 

@@ -2,3 +2,6 @@
 
 from .llama import (LlamaConfig, forward, init_params, loss_fn,
                     make_train_step, param_specs)
+from .moe import (MoEConfig, init_moe_params, make_moe_train_step,
+                  moe_forward, moe_loss_fn, moe_param_specs,
+                  shard_moe_params)

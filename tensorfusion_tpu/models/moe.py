@@ -1,0 +1,254 @@
+"""Mixture-of-Experts decoder — the expert-parallel hosted workload.
+
+Mixtral-style sparse MoE built the TPU-compiler-friendly way (GShard /
+Mesh-TensorFlow dispatch): top-k routing with a *static* per-expert
+capacity, dispatch/combine expressed as dense one-hot einsums so every
+shape is known at trace time and XLA lowers the token exchange to
+all-to-all collectives over the ``ep`` mesh axis — no data-dependent
+gather/scatter, no dynamic shapes, nothing the MXU can't tile.
+
+Sharding (``moe_param_specs``): expert weights carry ``P("ep", ...)`` on
+the expert dimension; attention reuses the llama blocks with their
+fsdp/tp specs.  Tokens dropped past an expert's capacity fall through
+the residual connection (standard capacity-factor semantics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .llama import _attention, _rms_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    vocab_size: int = 32000
+    dim: int = 1024
+    n_layers: int = 8
+    n_heads: int = 8
+    n_kv_heads: int = 8
+    ffn_dim: int = 2048
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "full"
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def capacity(self, tokens: int) -> int:
+        """Static per-expert token capacity for a batch of `tokens`."""
+        cap = int(self.capacity_factor * tokens * self.top_k
+                  / self.n_experts)
+        return max(cap, 1)
+
+    @staticmethod
+    def tiny(n_experts: int = 4) -> "MoEConfig":
+        return MoEConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                         n_kv_heads=2, ffn_dim=128, n_experts=n_experts,
+                         top_k=2, max_seq_len=128, dtype=jnp.float32)
+
+
+# -- parameters -------------------------------------------------------------
+
+
+def init_moe_params(config: MoEConfig, key: jax.Array) -> Dict:
+    def dense(key, shape, scale=None):
+        scale = scale or (shape[-2] ** -0.5)
+        return (jax.random.normal(key, shape, jnp.float32)
+                * scale).astype(config.dtype)
+
+    keys = jax.random.split(key, config.n_layers + 3)
+    hd = config.head_dim
+    layers = []
+    for i in range(config.n_layers):
+        k = jax.random.split(keys[i], 8)
+        layers.append({
+            "attn": {
+                "wq": dense(k[0], (config.dim, config.n_heads * hd)),
+                "wk": dense(k[1], (config.dim, config.n_kv_heads * hd)),
+                "wv": dense(k[2], (config.dim, config.n_kv_heads * hd)),
+                "wo": dense(k[3], (config.n_heads * hd, config.dim)),
+            },
+            "moe": {
+                # router stays replicated + f32: tiny, and routing
+                # decisions must agree across shards
+                "router": jax.random.normal(
+                    k[4], (config.dim, config.n_experts),
+                    jnp.float32) * config.dim ** -0.5,
+                "w_gate": dense(k[5], (config.n_experts, config.dim,
+                                       config.ffn_dim)),
+                "w_up": dense(k[6], (config.n_experts, config.dim,
+                                     config.ffn_dim)),
+                "w_down": dense(k[7], (config.n_experts, config.ffn_dim,
+                                       config.dim)),
+            },
+            "attn_norm": jnp.ones((config.dim,), config.dtype),
+            "moe_norm": jnp.ones((config.dim,), config.dtype),
+        })
+    return {
+        "tok_emb": dense(keys[-3], (config.vocab_size, config.dim), 0.02),
+        "layers": layers,
+        "final_norm": jnp.ones((config.dim,), config.dtype),
+        "lm_head": dense(keys[-2], (config.dim, config.vocab_size)),
+    }
+
+
+def moe_param_specs(config: MoEConfig) -> Dict:
+    """Experts sharded over ep; attention over fsdp/tp like llama."""
+    layer = {
+        "attn": {"wq": P("fsdp", "tp"), "wk": P("fsdp", "tp"),
+                 "wv": P("fsdp", "tp"), "wo": P("tp", "fsdp")},
+        "moe": {
+            "router": P(None, None),
+            "w_gate": P("ep", None, None),
+            "w_up": P("ep", None, None),
+            "w_down": P("ep", None, None),
+        },
+        "attn_norm": P(None),
+        "moe_norm": P(None),
+    }
+    return {
+        "tok_emb": P("fsdp", None),
+        "layers": [layer] * config.n_layers,
+        "final_norm": P(None),
+        "lm_head": P("fsdp", None),
+    }
+
+
+# -- the MoE block ----------------------------------------------------------
+
+
+def _moe_block(config: MoEConfig, p: Dict, x: jax.Array) -> jax.Array:
+    """x: [B, S, D] -> [B, S, D] via top-k experts with static capacity.
+
+    Dense GShard dispatch: one-hot [T, E, C] dispatch/combine tensors keep
+    every shape static; the `ecd`-indexed einsums against P("ep",...)
+    weights become expert-parallel all-to-alls under jit.
+    """
+    b, s, d = x.shape
+    t = b * s
+    e = config.n_experts
+    cap = config.capacity(t)
+    xf = x.reshape(t, d)
+
+    logits = xf.astype(jnp.float32) @ p["router"]          # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_w, top_i = jax.lax.top_k(probs, config.top_k)      # [T, k]
+    top_w = top_w / jnp.maximum(top_w.sum(-1, keepdims=True), 1e-9)
+
+    # position of each (token, k-slot) inside its expert's capacity
+    onehot = jax.nn.one_hot(top_i, e, dtype=jnp.float32)   # [T, k, E]
+    pos = jnp.cumsum(onehot.reshape(t * config.top_k, e), axis=0) \
+        .reshape(t, config.top_k, e) - onehot               # rank in expert
+    pos = jnp.einsum("tke,tke->tk", pos, onehot)            # [T, k]
+    keep = pos < cap                                        # capacity gate
+    pos = jnp.clip(pos, 0, cap - 1).astype(jnp.int32)
+
+    cap_onehot = jax.nn.one_hot(pos, cap, dtype=jnp.float32)   # [T, k, C]
+    # dispatch[t, e, c] = 1 when token t occupies slot c of expert e
+    dispatch = jnp.einsum("tke,tkc->tec", onehot,
+                          cap_onehot * keep[..., None])
+    combine = jnp.einsum("tk,tke,tkc->tec", top_w.astype(jnp.float32),
+                         onehot, cap_onehot * keep[..., None])
+
+    expert_in = jnp.einsum("tec,td->ecd", dispatch,
+                           xf.astype(jnp.float32)).astype(config.dtype)
+    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, p["w_gate"])) \
+        * jnp.einsum("ecd,edf->ecf", expert_in, p["w_up"])
+    out_e = jnp.einsum("ecf,efd->ecd", h, p["w_down"])
+    y = jnp.einsum("tec,ecd->td", combine,
+                   out_e.astype(jnp.float32)).astype(x.dtype)
+    return y.reshape(b, s, d)
+
+
+def _layer(config: MoEConfig, layer: Dict, x: jax.Array,
+           mesh: Optional[Mesh] = None) -> jax.Array:
+    attn_cfg = _AttnView(config)
+    x = x + _attention(attn_cfg, layer["attn"],
+                       _rms_norm(x, layer["attn_norm"], config.norm_eps),
+                       mesh)
+    x = x + _moe_block(config, layer["moe"],
+                       _rms_norm(x, layer["moe_norm"], config.norm_eps))
+    return x
+
+
+class _AttnView:
+    """Adapter exposing the llama-attention config surface of MoEConfig."""
+
+    def __init__(self, config: MoEConfig):
+        self.n_heads = config.n_heads
+        self.n_kv_heads = config.n_kv_heads
+        self.head_dim = config.head_dim
+        self.rope_theta = config.rope_theta
+        self.attn_impl = config.attn_impl
+
+
+def moe_forward(params: Dict, tokens: jax.Array, config: MoEConfig,
+                mesh: Optional[Mesh] = None) -> jax.Array:
+    x = params["tok_emb"][tokens]
+    layer_fn = functools.partial(_layer, config, mesh=mesh)
+    if config.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+    for layer in params["layers"]:
+        x = layer_fn(layer, x)
+    x = _rms_norm(x, params["final_norm"], config.norm_eps)
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+def moe_loss_fn(params: Dict, batch: Dict, config: MoEConfig,
+                mesh: Optional[Mesh] = None) -> jax.Array:
+    logits = moe_forward(params, batch["tokens"], config, mesh)
+    targets = batch["targets"]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def make_moe_train_step(config: MoEConfig, mesh: Optional[Mesh] = None,
+                        learning_rate: float = 3e-4):
+    import optax
+
+    tx = optax.adamw(learning_rate, b1=0.9, b2=0.95, weight_decay=0.1)
+
+    def init_opt_state(params):
+        return tx.init(params)
+
+    def train_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(moe_loss_fn)(params, batch,
+                                                      config, mesh)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step, init_opt_state
+
+
+def shard_moe_params(params: Dict, mesh: Mesh, config: MoEConfig) -> Dict:
+    specs = moe_param_specs(config)
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    spec_leaves = jax.tree_util.tree_flatten(
+        specs, is_leaf=lambda x: isinstance(x, P))[0]
+    assert len(leaves) == len(spec_leaves), "param/spec tree mismatch"
+    # drop spec axes the mesh doesn't have (e.g. fsdp on a dp/ep mesh)
+    names = set(mesh.axis_names)
+
+    def prune(spec):
+        return P(*(a if (a is not None and a in names) else None
+                   for a in spec))
+
+    sharded = [jax.device_put(x, NamedSharding(mesh, prune(s)))
+               for x, s in zip(leaves, spec_leaves)]
+    return jax.tree_util.tree_unflatten(treedef, sharded)

@@ -10,4 +10,5 @@ sequence/context parallelism over the ICI torus.
 
 from .mesh import (batch_spec, logical_mesh, make_mesh, mesh_shape_for,
                    named_sharding)
+from .pipeline import pipeline_apply, pipeline_stages
 from .ring_attention import ring_attention, ring_attention_sharded

@@ -8,11 +8,18 @@ Axes convention for hosted workloads:
 - ``tp``   — tensor parallelism (attention heads / FFN hidden sharded;
   wants the innermost, fastest ICI axis);
 - ``sp``   — sequence/context parallelism (ring attention neighbors; wants
-  a wraparound ICI ring).
+  a wraparound ICI ring);
+- ``ep``   — expert parallelism (MoE experts sharded; all-to-all token
+  dispatch rides ICI);
+- ``pp``   — pipeline parallelism (one decoder stage per rank; activations
+  ppermute to the next stage each microbatch tick).
 
 ``make_mesh`` lays axes out so the innermost axis maps to physically
 adjacent devices — on real TPU slices jax's device order already follows
-the ICI mesh, so reshaping in order preserves locality.
+the ICI mesh, so reshaping in order preserves locality.  Passing any
+axis outside the default dp/fsdp/sp/tp order (ep, pp, or custom names)
+switches to an explicit layout: the axes dict, in insertion order, IS
+the mesh shape.
 """
 
 from __future__ import annotations
@@ -48,6 +55,14 @@ def mesh_shape_for(n_devices: int,
 def make_mesh(axes: Optional[Dict[str, int]] = None,
               devices: Optional[Sequence] = None) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())
+    if axes and any(a not in AXIS_ORDER for a in axes):
+        # explicit layout: axes in insertion order are the mesh shape
+        order = tuple(axes)
+        dims = [axes[a] for a in order]
+        if math.prod(dims) != len(devices):
+            raise ValueError(f"axes {axes} need {math.prod(dims)} devices,"
+                             f" have {len(devices)}")
+        return Mesh(np.array(devices).reshape(dims), order)
     shape = mesh_shape_for(len(devices), axes)
     dims = [shape[a] for a in AXIS_ORDER]
     arr = np.array(devices).reshape(dims)

@@ -59,6 +59,36 @@ def test_metered_function_charges_real_flops(worker_shm, limiter_lib):
     assert state.devices[0].total_charged_mflop == client.charged_mflops
 
 
+def test_live_hbm_sampler_reconciles_buffer_churn(worker_shm, limiter_lib):
+    """Compile-time charges miss donation / raw device_puts; the live
+    sampler walks jax.live_arrays() and reconciles the shm HBM meter to
+    the actual device footprint, releasing on buffer death."""
+    import gc
+
+    import jax
+
+    host, shm_path = worker_shm
+    host.update_quota("ns", "w", 0, 10000, 10**9, 10**9)
+    client = VTPUClient(limiter_lib=fresh_library(limiter_lib, "live"),
+                        shm_path=shm_path)
+    assert client.attached
+    baseline = client.sample_live_hbm()
+
+    big = jax.device_put(np.ones((1024, 1024), np.float32))   # 4 MiB
+    total = client.sample_live_hbm()
+    assert total - baseline >= 4 * 2**20
+    used = ShmView(shm_path).read().devices[0].hbm_used_bytes
+    assert used >= 4 * 2**20
+
+    del big
+    gc.collect()
+    total2 = client.sample_live_hbm()
+    assert total2 <= total - 4 * 2**20
+    used2 = ShmView(shm_path).read().devices[0].hbm_used_bytes
+    assert used2 <= used - 4 * 2**20
+    client.close()
+
+
 def test_rate_limit_blocks_and_recovers(worker_shm, limiter_lib):
     host, shm_path = worker_shm
     client = VTPUClient(limiter_lib=fresh_library(limiter_lib, "cli2"),
