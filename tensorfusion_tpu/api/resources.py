@@ -106,6 +106,12 @@ class AutoScalingConfig:
     margin_fraction: float = 0.15
     cron_rules: List[Dict] = field(default_factory=list)
     external_url: str = ""
+    #: dynamic-replica workloads: how long the connection count must stay
+    #: at zero before the last worker is released (autoscale-to-zero)
+    scale_to_zero_grace_seconds: float = 60.0
+    #: serving fan-in: connections one worker absorbs before another is
+    #: added (dynamic replicas = ceil(connections / this))
+    connections_per_worker: int = 1
 
 
 @dataclass

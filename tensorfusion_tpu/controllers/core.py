@@ -233,15 +233,48 @@ class WorkloadController(Controller):
     (tensorfusionworkload_controller.go:180-338, :468-589)."""
 
     name = "workload"
-    kinds = ("TPUWorkload", "Pod")
+    # TPUConnection events drive dynamic replicas (wake-from-zero must be
+    # event-latency, not resync-latency)
+    kinds = ("TPUWorkload", "Pod", "TPUConnection")
     resync_interval_s = 5.0
 
     def __init__(self, store: ObjectStore,
                  worker_image: str = "tpufusion/worker:latest"):
         self.store = store
         self.worker_image = worker_image
+        #: workload key -> when its connection count last went to zero
+        self._zero_since: Dict[str, float] = {}
+
+    def _dynamic_replicas(self, wl: TPUWorkload, n_connections: int,
+                          has_workers: bool) -> int:
+        """Connection-driven replica count with autoscale-to-zero
+        (dynamic_replicas contract: replicas follow connection count;
+        BASELINE config #5).  New connections wake the workload from
+        zero; a *draining* workload keeps one worker warm through the
+        grace period (a never-used workload stays at zero — no churn)."""
+        key = f"{wl.metadata.namespace}/{wl.metadata.name}"
+        per_worker = max(wl.spec.auto_scaling.connections_per_worker, 1)
+        want = -(-n_connections // per_worker)  # ceil division
+        cap = max(wl.spec.replicas, 1)          # spec.replicas = max scale
+        if want > 0:
+            self._zero_since.pop(key, None)
+            return min(want, cap)
+        if not has_workers and key not in self._zero_since:
+            return 0      # never active: don't spawn a warm worker
+        grace = wl.spec.auto_scaling.scale_to_zero_grace_seconds
+        since = self._zero_since.setdefault(key, time.monotonic())
+        if time.monotonic() - since >= grace:
+            return 0                            # autoscale-to-zero
+        return min(1, cap)                      # keep one warm in grace
 
     def reconcile(self, event):
+        # one pass over connections, bucketed by workload (O(W x C) per
+        # event otherwise — every TPUConnection event reconciles here)
+        conn_counts: Dict[tuple, int] = {}
+        for c in self.store.list(TPUConnection):
+            k = (c.metadata.namespace, c.spec.workload)
+            conn_counts[k] = conn_counts.get(k, 0) + 1
+        dynamic_keys = set()
         for wl in self.store.list(TPUWorkload):
             if wl.spec.is_local_tpu or wl.spec.embedded_worker:
                 continue  # client pod runs on the TPU node itself
@@ -252,7 +285,15 @@ class WorkloadController(Controller):
                     == wl.metadata.name
                     and p.metadata.labels.get(constants.LABEL_COMPONENT)
                     == constants.COMPONENT_WORKER))
-            desired = max(wl.spec.replicas, 0)
+            if wl.spec.dynamic_replicas:
+                key = f"{wl.metadata.namespace}/{wl.metadata.name}"
+                dynamic_keys.add(key)
+                desired = self._dynamic_replicas(
+                    wl, conn_counts.get(
+                        (wl.metadata.namespace, wl.metadata.name), 0),
+                    has_workers=bool(pods))
+            else:
+                desired = max(wl.spec.replicas, 0)
             # scale up
             existing = {p.metadata.name for p in pods}
             for i in range(desired):
@@ -274,8 +315,11 @@ class WorkloadController(Controller):
             wl.status.replicas = desired
             wl.status.ready_replicas = running
             wl.status.worker_count = len(pods)
+            # a dynamic workload at zero is healthy-dormant, not pending
+            dormant = desired == 0 and wl.spec.dynamic_replicas
             wl.status.phase = (constants.PHASE_RUNNING
-                               if desired and running >= desired
+                               if dormant or (desired
+                                              and running >= desired)
                                else constants.PHASE_PENDING)
             if wl.spec.gang.enabled:
                 g = wl.status.gang
@@ -289,6 +333,10 @@ class WorkloadController(Controller):
                 self.store.update(wl)
             except NotFoundError:
                 pass
+        # drop grace bookkeeping for deleted/no-longer-dynamic workloads
+        # (a recreated workload must not inherit a stale zero-timestamp)
+        self._zero_since = {k: v for k, v in self._zero_since.items()
+                            if k in dynamic_keys}
 
     def _worker_pod(self, wl: TPUWorkload, name: str) -> Pod:
         from .rollout import component_hash

@@ -159,6 +159,71 @@ def test_e2e_remote_workload_and_connection(op):
     assert conn is not None and conn.status.worker_url.startswith("tcp://")
 
 
+def test_e2e_dynamic_replicas_scale_to_zero_and_burst(op):
+    """BASELINE config #5 shape: a dynamic-replica serving workload
+    scales with its connection count — burst wakes workers from zero,
+    and the grace period after the last connection releases everything."""
+    wl = TPUWorkload.new("burst", namespace="default")
+    wl.spec.pool = "pool-a"
+    wl.spec.replicas = 3                      # max scale
+    wl.spec.dynamic_replicas = True
+    wl.spec.auto_scaling.scale_to_zero_grace_seconds = 0.5
+    wl.spec.auto_scaling.connections_per_worker = 1
+    wl.spec.resources.requests = ResourceAmount(tflops=20.0,
+                                                hbm_bytes=2**30)
+    wl.spec.resources.limits = ResourceAmount(tflops=40.0,
+                                              hbm_bytes=2**30)
+    op.store.create(wl)
+
+    def worker_count():
+        return len([p for p in op.store.list(Pod, namespace="default")
+                    if p.metadata.annotations.get(constants.ANN_WORKLOAD)
+                    == "burst"
+                    and p.metadata.labels.get(constants.LABEL_COMPONENT)
+                    == constants.COMPONENT_WORKER])
+
+    # never-active workload: stays at zero (no warm-worker churn) and
+    # reports healthy-dormant, not Pending
+    deadline = time.time() + 8
+    while time.time() < deadline and worker_count() != 0:
+        time.sleep(0.1)
+    assert worker_count() == 0, "did not scale to zero"
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        got = op.store.get(TPUWorkload, "burst", "default")
+        if got.status.phase == constants.PHASE_RUNNING:
+            break
+        time.sleep(0.1)
+    assert got.status.phase == constants.PHASE_RUNNING
+
+    # burst: two connections wake two workers
+    for i in range(2):
+        conn = TPUConnection.new(f"burst-c{i}", namespace="default")
+        conn.spec.workload = "burst"
+        op.store.create(conn)
+    deadline = time.time() + 8
+    while time.time() < deadline and worker_count() != 2:
+        time.sleep(0.1)
+    assert worker_count() == 2, "burst did not wake workers"
+    # connections get served by the spawned workers
+    deadline = time.time() + 8
+    served = None
+    while time.time() < deadline:
+        served = op.store.get(TPUConnection, "burst-c0", "default")
+        if served.status.worker_url:
+            break
+        time.sleep(0.1)
+    assert served is not None and served.status.worker_url
+
+    # burst over: connections go away, workload drains back to zero
+    for i in range(2):
+        op.store.delete(TPUConnection, f"burst-c{i}", "default")
+    deadline = time.time() + 10
+    while time.time() < deadline and worker_count() != 0:
+        time.sleep(0.1)
+    assert worker_count() == 0, "did not drain back to zero after burst"
+
+
 def test_e2e_expander_scales_from_capacity_miss(op):
     """A pod that cannot fit triggers a TPUNodeClaim; the mock provider
     provisions a host; the pod then schedules (expander/handler.go flow)."""
