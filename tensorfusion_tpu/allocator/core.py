@@ -213,6 +213,8 @@ class TPUAllocator:
         self._strategies: Dict[str, Strategy] = {}
         self._gang_waiting_probe: Callable[[str], bool] = lambda key: False
         self._views: Dict[str, PoolVectorView] = {}
+        #: pool -> cached chips() snapshot (invalidated with _views)
+        self._chips_list_cache: Dict[str, List[ChipState]] = {}
 
     # -- configuration ----------------------------------------------------
 
@@ -224,6 +226,7 @@ class TPUAllocator:
                 state.oversell_ratio = self._pool_oversell[pool]
                 state.invalidate()
             self._views.clear()
+            self._chips_list_cache.clear()
 
     def set_pool_hbm_expansion(self, pool: str, host_mem_percent: float,
                                host_disk_percent: float) -> None:
@@ -240,6 +243,7 @@ class TPUAllocator:
                 state.hbm_expand_ratio = ratio
                 state.invalidate()
             self._views.clear()
+            self._chips_list_cache.clear()
 
     def set_pool_strategy(self, pool: str, placement_mode: str) -> None:
         with self._lock:
@@ -291,6 +295,16 @@ class TPUAllocator:
                                   self._partition_registry)
                 self._chips[chip.name] = state
             else:
+                # migrate index entries when the chip moved pool/node —
+                # stale membership would leak it into the old pool's
+                # candidate lists (and KeyError after removal)
+                old = state.chip.status
+                if old.pool != pool:
+                    self._pool_chips.get(old.pool, set()).discard(
+                        chip.name)
+                if old.node_name != chip.status.node_name:
+                    self._node_chips.get(old.node_name, set()).discard(
+                        chip.name)
                 state.chip = chip
                 state.oversell_ratio = ratio
                 state.hbm_expand_ratio = hbm_ratio
@@ -299,6 +313,7 @@ class TPUAllocator:
                                         set()).add(chip.name)
             self._pool_chips.setdefault(pool, set()).add(chip.name)
             self._views.clear()
+            self._chips_list_cache.clear()
 
     def remove_chip(self, name: str) -> None:
         with self._lock:
@@ -309,12 +324,25 @@ class TPUAllocator:
                                  set()).discard(name)
             self._pool_chips.get(state.chip.status.pool, set()).discard(name)
             self._views.clear()
+            self._chips_list_cache.clear()
 
     def chips(self, pool: Optional[str] = None) -> List[ChipState]:
+        """Chip states of a pool (all when pool is None).  The returned
+        list is a cached snapshot rebuilt on inventory change — callers
+        must not mutate it (it is rebuilt, not copied, on the PreFilter
+        hot path once per scheduling cycle)."""
+        key = pool   # None (all chips) is a valid dict key of its own —
+        # `pool or "*"` would conflate pool="" with the all-chips entry
         with self._lock:
-            if pool is None:
-                return list(self._chips.values())
-            return [self._chips[n] for n in self._pool_chips.get(pool, ())]
+            got = self._chips_list_cache.get(key)
+            if got is None:
+                if pool is None:
+                    got = list(self._chips.values())
+                else:
+                    got = [self._chips[n]
+                           for n in self._pool_chips.get(pool, ())]
+                self._chips_list_cache[key] = got
+            return got
 
     def get_chip(self, name: str) -> Optional[ChipState]:
         with self._lock:
@@ -823,6 +851,7 @@ class TPUAllocator:
             self.quota.reconcile(committed_reqs)
             self._dirty.update(self._chips.keys())
             self._views.clear()
+            self._chips_list_cache.clear()
             return restored
 
     # -- store sync (gpuallocator.go:2309 SyncGPUsToK8s) -------------------

@@ -288,9 +288,11 @@ class Scheduler:
 
         # Score
         best, best_score = feasible[0], float("-inf")
+        score_plugins = self._of(ScorePlugin)
         for node in feasible:
-            total = sum(p.score(state, pod, node)
-                        for p in self._of(ScorePlugin))
+            total = 0.0
+            for p in score_plugins:
+                total += p.score(state, pod, node)
             if total > best_score:
                 best, best_score = node, total
 

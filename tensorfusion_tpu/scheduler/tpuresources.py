@@ -176,10 +176,11 @@ class TPUResourcesFit(PreEnqueuePlugin, PreFilterPlugin, FilterPlugin,
         preemptor: other pods may only pass Filter here if the node still
         fits them *with every equal-or-higher-priority nominee virtually
         placed first*."""
+        if not self._nominations:
+            return OK   # hot path: preemption is rare, Filter is not
         now = time.monotonic()
-        if self._nominations:
-            self._nominations = {k: v for k, v in self._nominations.items()
-                                 if v[3] > now}
+        self._nominations = {k: v for k, v in self._nominations.items()
+                             if v[3] > now}
         blockers = [v[2] for k, v in self._nominations.items()
                     if v[0] == node and k != pod.key()
                     and v[1] >= pod.spec.priority]

@@ -89,6 +89,23 @@ def test_oversell_allows_overcommit_of_tflops_not_hbm():
         alloc.alloc(req(pod="p10", tflops=1.0, hbm=8 * 2**30))
 
 
+def test_upsert_chip_pool_and_node_migration():
+    """Re-upserting a chip under a new pool/node must migrate the index
+    entries — stale membership leaks the chip into the old pool's
+    candidates and KeyErrors after removal."""
+    alloc = make_allocator()
+    alloc.upsert_chip(make_chip("mover", node="node-a", pool="pool-a"))
+    assert any(c.chip.name == "mover" for c in alloc.chips("pool-a"))
+
+    alloc.upsert_chip(make_chip("mover", node="node-b", pool="pool-b"))
+    assert not any(c.chip.name == "mover" for c in alloc.chips("pool-a"))
+    assert any(c.chip.name == "mover" for c in alloc.chips("pool-b"))
+
+    alloc.remove_chip("mover")
+    assert not any(c.chip.name == "mover" for c in alloc.chips("pool-a"))
+    assert not any(c.chip.name == "mover" for c in alloc.chips("pool-b"))
+
+
 def test_partition_planner_best_fit_and_fragmentation():
     """Placement is bitmask arithmetic, not count math: best-fit picks the
     smallest adequate gap, and a fragmented chip with enough total free
