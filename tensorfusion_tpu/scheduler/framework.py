@@ -263,6 +263,12 @@ class Scheduler:
         # feasible nodes are found on large clusters.
         narrowed = state.get(STATE_PREFILTER_NODES)
         nodes = list(narrowed) if narrowed is not None else self.nodes_fn()
+        # evaluate a preemptor's nominated node before everything else so
+        # the adaptive feasible cap can never skip it (kube semantics)
+        nominated = pod.status.nominated_node_name
+        if nominated and nominated in nodes:
+            nodes.remove(nominated)
+            nodes.insert(0, nominated)
         enough = self._num_feasible_to_find(len(nodes))
         statuses: Dict[str, Status] = {}
         feasible: List[str] = []
@@ -286,15 +292,23 @@ class Scheduler:
                 Status(Code.UNSCHEDULABLE, f"0/{len(nodes)} nodes feasible"),
                 statuses)
 
-        # Score
-        best, best_score = feasible[0], float("-inf")
-        score_plugins = self._of(ScorePlugin)
-        for node in feasible:
-            total = 0.0
-            for p in score_plugins:
-                total += p.score(state, pod, node)
-            if total > best_score:
-                best, best_score = node, total
+        # A preemptor returns to the node its victims vacated: when the
+        # nominated node is feasible, take it without re-scoring (kube
+        # scheduler nominated-node preference).  The nomination is only
+        # cleared on a successful bind — a Permit/Bind failure must not
+        # destroy the preference the eviction paid for.
+        if nominated and nominated in feasible:
+            best = nominated
+        else:
+            # Score
+            best, best_score = feasible[0], float("-inf")
+            score_plugins = self._of(ScorePlugin)
+            for node in feasible:
+                total = 0.0
+                for p in score_plugins:
+                    total += p.score(state, pod, node)
+                if total > best_score:
+                    best, best_score = node, total
 
         # Reserve
         reserved: List[ReservePlugin] = []
@@ -395,6 +409,7 @@ class Scheduler:
             return self._fail(pod, state, Status(Code.ERROR, str(e)))
         pod.spec.node_name = node
         pod.status.phase = constants.PHASE_RUNNING
+        pod.status.nominated_node_name = ""   # preference consumed
         for p in self._of(PostBindPlugin):
             p.post_bind(state, pod, node)
         self.scheduled_count += 1

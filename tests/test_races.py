@@ -110,3 +110,99 @@ def test_percentile_recommender_concurrent_observe():
     assert not errors, errors
     out = rec.recommend("wl-0", ResourceAmount(tflops=10))
     assert out is not None and out.target.tflops > 0
+
+
+def test_chips_cache_concurrent_upsert_and_read():
+    """The chips() snapshot cache must never serve a stale or torn list
+    while inventory churns from another thread."""
+    alloc = TPUAllocator()
+    alloc.set_pool_oversell("pool-a", 500.0)
+    for i in range(4):
+        alloc.upsert_chip(make_chip(f"cc-{i}", node="n0"))
+
+    stop = threading.Event()
+    errors = []
+
+    def churner():
+        i = 4
+        try:
+            while not stop.is_set():
+                alloc.upsert_chip(make_chip(f"cc-{i % 8}", node="n0"))
+                if i % 5 == 0:
+                    alloc.remove_chip(f"cc-{(i + 3) % 8}")
+                i += 1
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def reader():
+        try:
+            while not stop.is_set():
+                chips = alloc.chips("pool-a")
+                # iterate fully: a torn list would raise / contain None
+                assert all(c.chip.name.startswith("cc-") for c in chips)
+                alloc.chips()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=churner)] + \
+        [threading.Thread(target=reader) for _ in range(3)]
+    for t in threads:
+        t.start()
+    time.sleep(1.0)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert not errors, errors
+
+
+def test_simulate_placement_is_side_effect_free_under_concurrency():
+    """simulate_placement holds+rolls back capacity internally; racing it
+    against real allocations must never leak holds or corrupt totals."""
+    alloc = TPUAllocator()
+    alloc.set_pool_oversell("pool-a", 500.0)
+    for i in range(4):
+        alloc.upsert_chip(make_chip(f"sp-{i}", node="n0"))
+
+    errors = []
+    barrier = threading.Barrier(4)
+
+    def simulator(tid):
+        try:
+            barrier.wait()
+            for i in range(40):
+                probes = [AllocRequest(
+                    pool="pool-a", namespace="sim",
+                    pod_name=f"probe-{tid}-{i}-{j}",
+                    request=ResourceAmount(tflops=30.0, hbm_bytes=2**28),
+                    chip_count=1) for j in range(3)]
+                alloc.simulate_placement(probes)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def allocator_worker(tid):
+        try:
+            barrier.wait()
+            for i in range(40):
+                req = AllocRequest(
+                    pool="pool-a", namespace="real",
+                    pod_name=f"r{tid}-{i}",
+                    request=ResourceAmount(tflops=10.0, hbm_bytes=2**27),
+                    chip_count=1)
+                record = alloc.alloc(req)
+                alloc.dealloc(record.key)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=simulator, args=(t,))
+               for t in range(2)] + \
+        [threading.Thread(target=allocator_worker, args=(t,))
+         for t in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, errors
+    # everything released: zero allocated, no phantom holders
+    for state in alloc.chips("pool-a"):
+        assert state.allocated.tflops == 0, state.allocated
+        assert not state.holders, state.holders
