@@ -53,6 +53,30 @@ def _clone_pod_spec(spec):
         priority=spec.priority,
         preemption_policy=spec.preemption_policy)
 
+
+def _make_replacement(pod: Pod, exclude_node: str,
+                      mark_defrag_label: bool = False) -> Pod:
+    """The eviction contract in one place: a rebindable clone of ``pod``
+    with binding artifacts stripped and ``exclude_node`` stamped into the
+    drain exclusions (TTL-cleared later)."""
+    replacement = Pod.new(pod.metadata.name,
+                          namespace=pod.metadata.namespace)
+    replacement.metadata.labels = dict(pod.metadata.labels)
+    if mark_defrag_label:
+        replacement.metadata.labels[constants.LABEL_DEFRAG_EVICTED] = "true"
+    ann = dict(pod.metadata.annotations)
+    for k in (constants.ANN_CHIP_IDS, constants.ANN_PARTITION_IDS,
+              constants.ANN_POD_INDEX, constants.ANN_PORT_NUMBER):
+        ann.pop(k, None)
+    ann[constants.ANN_EXCLUDED_NODES] = _merge_exclusions(
+        ann.get(constants.ANN_EXCLUDED_NODES, ""), exclude_node)
+    ann[constants.ANN_DEFRAG_EXCLUDED] = _merge_exclusions(
+        ann.get(constants.ANN_DEFRAG_EXCLUDED, ""), exclude_node)
+    ann[constants.ANN_DEFRAG_EVICTED_SINCE] = str(time.time())
+    replacement.metadata.annotations = ann
+    replacement.spec = _clone_pod_spec(pod.spec)
+    return replacement
+
 log = logging.getLogger("tpf.controller.defrag")
 
 
@@ -293,23 +317,13 @@ class CompactionController(Controller):
             # standalone pod: clone it with the node excluded so the
             # scheduler rebinds elsewhere (workers are recreated by their
             # workload controller)
-            replacement = Pod.new(pod.metadata.name,
-                                  namespace=pod.metadata.namespace)
-            replacement.metadata.labels = dict(pod.metadata.labels)
-            replacement.metadata.labels[constants.LABEL_DEFRAG_EVICTED] = \
-                "true"
-            ann = dict(pod.metadata.annotations)
-            for k in (constants.ANN_CHIP_IDS, constants.ANN_PARTITION_IDS,
-                      constants.ANN_POD_INDEX, constants.ANN_PORT_NUMBER):
-                ann.pop(k, None)
-            ann[constants.ANN_DEFRAG_EVICTED_SINCE] = now
-            ann[constants.ANN_EXCLUDED_NODES] = _merge_exclusions(
-                ann.get(constants.ANN_EXCLUDED_NODES, ""), node)
-            ann[constants.ANN_DEFRAG_EXCLUDED] = _merge_exclusions(
-                ann.get(constants.ANN_DEFRAG_EXCLUDED, ""), node)
-            replacement.metadata.annotations = ann
-            replacement.spec = _clone_pod_spec(pod.spec)
-        self.store.delete(Pod, pod.metadata.name, pod.metadata.namespace)
+            replacement = _make_replacement(pod, node,
+                                            mark_defrag_label=True)
+        try:
+            self.store.delete(Pod, pod.metadata.name,
+                              pod.metadata.namespace)
+        except NotFoundError:
+            return   # pod vanished mid-drain (owner deleted it): done
         if replacement is not None:
             self.store.create(replacement)
 
@@ -399,9 +413,22 @@ class LiveMigrator:
 
     def migrate(self, namespace: str, pod_name: str,
                 wait_rebind_s: float = 10.0) -> Optional[str]:
-        """Returns the new node name, or None on failure."""
+        """Returns the new node name, or None on failure.
+
+        Gang members are refused: migrating one member of a strict gang
+        evicts capacity its quorum depends on and live-locks the group —
+        use ``migrate_gang`` (all members, atomically probed) instead
+        (same all-or-nothing argument as CompactionController._drain_gang).
+        """
         pod = self.store.try_get(Pod, pod_name, namespace)
         if pod is None or not pod.spec.node_name:
+            return None
+        info = gang_info_from_pod(pod)
+        if info is not None and info[4]:
+            # strict gangs only: losing one member breaks the quorum; a
+            # non-strict gang tolerates member churn by definition
+            log.warning("refusing per-pod migration of strict-gang member "
+                        "%s/%s; use migrate_gang", namespace, pod_name)
             return None
         source = pod.spec.node_name
         key = f"{namespace}/{pod_name}"
@@ -439,20 +466,19 @@ class LiveMigrator:
                     self.store.update(chip)
 
         # 2. evict + recreate with the source node excluded
-        replacement = Pod.new(pod_name, namespace=namespace)
-        replacement.metadata.labels = dict(pod.metadata.labels)
-        ann = dict(pod.metadata.annotations)
-        for k in (constants.ANN_CHIP_IDS, constants.ANN_PARTITION_IDS,
-                  constants.ANN_POD_INDEX, constants.ANN_PORT_NUMBER):
-            ann.pop(k, None)
-        ann[constants.ANN_EXCLUDED_NODES] = _merge_exclusions(
-            ann.get(constants.ANN_EXCLUDED_NODES, ""), source)
-        ann[constants.ANN_DEFRAG_EXCLUDED] = _merge_exclusions(
-            ann.get(constants.ANN_DEFRAG_EXCLUDED, ""), source)
-        ann[constants.ANN_DEFRAG_EVICTED_SINCE] = str(time.time())
-        replacement.metadata.annotations = ann
-        replacement.spec = _clone_pod_spec(pod.spec)
-        self.store.delete(Pod, pod_name, namespace)
+        replacement = _make_replacement(pod, source)
+        try:
+            self.store.delete(Pod, pod_name, namespace)
+        except NotFoundError:
+            # pod vanished mid-migration: restore chip phases and abort
+            if record is not None:
+                for chip_name in record.chip_ids:
+                    chip = self.store.try_get(TPUChip, chip_name)
+                    if chip is not None and \
+                            chip.status.phase == constants.PHASE_MIGRATING:
+                        chip.status.phase = constants.PHASE_RUNNING
+                        self.store.update(chip)
+            return None
         self.store.create(replacement)
 
         # 3. wait for the rebind (chips restored to Running either way)
@@ -489,6 +515,115 @@ class LiveMigrator:
                 name=f"tpf-migrate-{pod_name}")
             t.start()
         return new_node
+
+    def migrate_gang(self, namespace: str, pod_name: str,
+                     wait_rebind_s: float = 10.0) -> Optional[Dict[str, str]]:
+        """Atomically migrate the whole gang of ``pod_name`` off the node
+        it occupies: every member cluster-wide is re-placement-probed
+        together (simulate_placement) and either all are snapshotted,
+        evicted and rebound, or none is.  Returns {pod_key: new_node}, or
+        None when the gang cannot be moved as a unit."""
+        pod = self.store.try_get(Pod, pod_name, namespace)
+        if pod is None or not pod.spec.node_name:
+            return None
+        info = gang_info_from_pod(pod)
+        if info is None:
+            node = self.migrate(namespace, pod_name, wait_rebind_s)
+            return {f"{namespace}/{pod_name}": node} if node else None
+        group_key = info[0]
+        source = pod.spec.node_name
+        members = [p for p in self.store.list(Pod)
+                   if p.spec.node_name
+                   and (gang_info_from_pod(p) or (None,))[0] == group_key]
+        if not members:
+            return None
+
+        # 0. all-or-nothing placement probe with the drained node excluded
+        probes = []
+        for p in members:
+            probe = compose_alloc_request(p)
+            if probe is None:
+                return None
+            probe.pod_name += "-migrate-probe"
+            probe.excluded_nodes = list(set(probe.excluded_nodes)
+                                        | {source})
+            probes.append(probe)
+        if self.allocator.simulate_placement(probes) is None:
+            log.warning("gang migration of %s aborted: no atomic "
+                        "alternative placement", group_key)
+            return None
+
+        # 1. snapshot every member on its node, mark chips migrating
+        marked: List[str] = []
+        for p in members:
+            hv = self._hypervisor_url(p.spec.node_name)
+            if hv:
+                self._post(f"{hv}/api/v1/workers/{p.metadata.namespace}/"
+                           f"{p.metadata.name}/snapshot")
+            rec = self.allocator.allocation(p.key())
+            if rec is not None:
+                for chip_name in rec.chip_ids:
+                    chip = self.store.try_get(TPUChip, chip_name)
+                    if chip is not None:
+                        chip.status.phase = constants.PHASE_MIGRATING
+                        self.store.update(chip)
+                        marked.append(chip_name)
+
+        # 2. evict + recreate all members together (quorum re-forms from
+        #    the full replacement set — a partial set would live-lock).
+        #    Members deleted by their owner mid-drain drop out of the
+        #    migration (nothing left to move for them).
+        evicted: List[Pod] = []
+        for p in members:
+            replacement = _make_replacement(p, source)
+            try:
+                self.store.delete(Pod, p.metadata.name,
+                                  p.metadata.namespace)
+            except NotFoundError:
+                continue   # member vanished mid-drain; others proceed
+            self.store.create(replacement)
+            evicted.append(p)
+        if not evicted:
+            return None
+
+        # 3. wait for every evicted member to rebind off the drained node
+        deadline = time.time() + wait_rebind_s
+        placed: Dict[str, str] = {}
+        while time.time() < deadline and len(placed) < len(evicted):
+            for p in evicted:
+                if p.key() in placed:
+                    continue
+                cur = self.store.try_get(Pod, p.metadata.name,
+                                         p.metadata.namespace)
+                if cur is not None and cur.spec.node_name and \
+                        cur.spec.node_name != source:
+                    placed[p.key()] = cur.spec.node_name
+            time.sleep(0.05)
+        for chip_name in marked:
+            chip = self.store.try_get(TPUChip, chip_name)
+            if chip is not None and \
+                    chip.status.phase == constants.PHASE_MIGRATING:
+                chip.status.phase = constants.PHASE_RUNNING
+                self.store.update(chip)
+
+        # 4. restore on targets (deferred for stragglers; the criterion
+        #    matches step 3: anywhere off the *drained* node counts)
+        for p in evicted:
+            new_node = placed.get(p.key())
+            if new_node:
+                self._resume_on(new_node, p.metadata.namespace,
+                                p.metadata.name)
+            else:
+                threading.Thread(
+                    target=self._deferred_resume,
+                    args=(p.metadata.namespace, p.metadata.name, source),
+                    daemon=True,
+                    name=f"tpf-migrate-{p.metadata.name}").start()
+        if len(placed) == len(evicted):
+            log.info("migrated gang %s off %s: %s", group_key, source,
+                     placed)
+            return placed
+        return None
 
     def _resume_on(self, node: str, namespace: str, pod_name: str) -> None:
         target_hv = self._hypervisor_url(node)

@@ -114,6 +114,7 @@ class _QueuedPod:
     priority: int
     ts: float
     pod: Pod = field(compare=False)
+    gen: int = field(default=0, compare=False)
 
     def __lt__(self, other):
         return (-self.priority, self.ts) < (-other.priority, other.ts)
@@ -148,7 +149,18 @@ class Scheduler:
         self._unschedulable: Dict[str, Pod] = {}
         self._gated: Dict[str, Pod] = {}
         self._waiting: Dict[str, WaitingPod] = {}
-        self._in_queue: set = set()
+        #: key -> generation of its newest queued entry.  A PriorityQueue
+        #: can't remove or replace entries, so stale entries (older
+        #: generation, or deleted pods — see _forgotten) are dropped at
+        #: dequeue time by comparing generations.
+        self._in_queue: Dict[str, int] = {}
+        self._enqueue_gen = 0
+        #: keys of deleted pods that were queued or mid-cycle when
+        #: forget() ran — tombstoned and dropped at dequeue / park time
+        #: (without this, a pod deleted while pending becomes a ghost
+        #: that fails at bind and re-parks forever)
+        self._forgotten: set = set()
+        self._inflight: set = set()
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -184,12 +196,23 @@ class Scheduler:
                     self._gated[key] = pod
                 return
         with self._lock:
-            if key in self._in_queue or key in self._waiting:
+            if key in self._waiting:
                 return
-            self._in_queue.add(key)
+            if key in self._forgotten:
+                # re-created under the same key: clear the tombstone and
+                # supersede any stale queued entry with a new generation
+                # (returning here would let dequeue consume the tombstone
+                # and silently drop the recreated pod)
+                self._forgotten.discard(key)
+            elif key in self._in_queue:
+                return
+            self._enqueue_gen += 1
+            gen = self._enqueue_gen
+            self._in_queue[key] = gen
             self._unschedulable.pop(key, None)
             self._gated.pop(key, None)
-        self._active.put(_QueuedPod(pod.spec.priority, time.monotonic(), pod))
+        self._active.put(_QueuedPod(pod.spec.priority, time.monotonic(),
+                                    pod, gen))
 
     def activate(self) -> None:
         """Requeue unschedulable + gated pods (event-driven wakeup — the
@@ -205,6 +228,10 @@ class Scheduler:
 
     def forget(self, pod_key: str) -> None:
         with self._lock:
+            if pod_key in self._in_queue or pod_key in self._inflight:
+                # can't pull it out of the PriorityQueue / running cycle:
+                # tombstone it so dequeue/park drops it instead
+                self._forgotten.add(pod_key)
             self._unschedulable.pop(pod_key, None)
             self._gated.pop(pod_key, None)
             w = self._waiting.pop(pod_key, None)
@@ -235,13 +262,22 @@ class Scheduler:
                 item = self._active.get(timeout=0.2)
             except queue.Empty:
                 continue
+            key = item.pod.key()
             with self._lock:
-                self._in_queue.discard(item.pod.key())
+                if self._in_queue.get(key) != item.gen:
+                    continue   # superseded by a newer entry for this key
+                del self._in_queue[key]
+                if key in self._forgotten:
+                    self._forgotten.discard(key)   # deleted while queued
+                    continue
+                self._inflight.add(key)
             try:
                 self.schedule_one(item.pod)
             except Exception:
-                log.exception("scheduling cycle for %s crashed",
-                              item.pod.key())
+                log.exception("scheduling cycle for %s crashed", key)
+            finally:
+                with self._lock:
+                    self._inflight.discard(key)
 
     # -- the scheduling cycle (SURVEY.md §3.3) ----------------------------
 
@@ -335,7 +371,18 @@ class Scheduler:
             deadline = time.monotonic() + (max_wait if max_wait > 0
                                            else 3600.0)
             with self._lock:
-                self._waiting[key] = WaitingPod(pod, state, best, deadline)
+                if key in self._forgotten:
+                    # deleted mid-cycle: don't park a ghost holding its
+                    # Reserve capacity until the permit deadline
+                    self._forgotten.discard(key)
+                    forgotten = True
+                else:
+                    forgotten = False
+                    self._waiting[key] = WaitingPod(pod, state, best,
+                                                    deadline)
+            if forgotten:
+                self._unreserve_all(state, pod, best)
+                return Status(Code.UNSCHEDULABLE, "pod deleted")
             log.debug("pod %s waiting in Permit (%.0fs)", key, max_wait)
             return Status(Code.WAIT)
 
@@ -446,6 +493,9 @@ class Scheduler:
         key = pod.key()
         log.debug("pod %s unschedulable: %s", key, st.reason)
         with self._lock:
+            if key in self._forgotten:      # deleted mid-cycle: drop it
+                self._forgotten.discard(key)
+                return st
             self._unschedulable[key] = pod
         self.failed_count += 1
         if self.failure_handler is not None:
@@ -456,8 +506,12 @@ class Scheduler:
         return st
 
     def _fail(self, pod: Pod, state: CycleState, st: Status) -> Status:
-        log.error("pod %s scheduling error: %s", pod.key(), st.reason)
+        key = pod.key()
+        log.error("pod %s scheduling error: %s", key, st.reason)
         with self._lock:
-            self._unschedulable[pod.key()] = pod
+            if key in self._forgotten:      # deleted mid-cycle: drop it
+                self._forgotten.discard(key)
+                return st
+            self._unschedulable[key] = pod
         self.failed_count += 1
         return st

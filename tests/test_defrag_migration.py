@@ -187,6 +187,44 @@ def test_defrag_skips_gang_with_no_atomic_placement():
         op.stop()
 
 
+def test_gang_live_migration_moves_all_members_atomically():
+    """migrate() must refuse individual gang members (partial migration
+    live-locks a strict gang); migrate_gang moves the whole gang off the
+    drained node as a unit."""
+    op = make_operator(hosts=2)
+    try:
+        members = _submit_gang(op, ["m0", "m1"])
+        drained = members[0].spec.node_name
+
+        # per-pod migration of a gang member is refused
+        assert op.migrator.migrate("default", "m0") is None
+        assert op.store.try_get(Pod, "m0", "default") is not None
+
+        placed = op.migrator.migrate_gang("default", "m0")
+        assert placed is not None and len(placed) == 2
+        assert all(node != drained for node in placed.values())
+        for name in ("m0", "m1"):
+            cur = op.store.get(Pod, name, "default")
+            assert cur.spec.node_name and cur.spec.node_name != drained
+            assert op.allocator.allocation(f"default/{name}") is not None
+    finally:
+        op.stop()
+
+
+def test_gang_live_migration_refuses_without_atomic_placement():
+    """A gang with nowhere to go as a unit must not be touched."""
+    op = make_operator(hosts=1)
+    try:
+        members = _submit_gang(op, ["s0", "s1"])
+        node = members[0].spec.node_name
+        assert op.migrator.migrate_gang("default", "s0") is None
+        for name in ("s0", "s1"):
+            cur = op.store.get(Pod, name, "default")
+            assert cur.spec.node_name == node     # untouched
+    finally:
+        op.stop()
+
+
 def test_compaction_releases_empty_node():
     op = make_operator(hosts=2, compaction=True, grace_s=0.2)
     try:
