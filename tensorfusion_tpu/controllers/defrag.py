@@ -402,6 +402,27 @@ class LiveMigrator:
         tnode = self.store.try_get(TPUNode, node)
         return tnode.status.hypervisor_url if tnode is not None else ""
 
+    # one definition of the chip-phase bookkeeping: every abort/finish
+    # path must restore Migrating -> Running or the status loop reports
+    # the chip as migrating forever (control_plane never stomps it)
+    def _mark_migrating(self, chip_ids) -> List[str]:
+        marked = []
+        for chip_name in chip_ids:
+            chip = self.store.try_get(TPUChip, chip_name)
+            if chip is not None:
+                chip.status.phase = constants.PHASE_MIGRATING
+                self.store.update(chip)
+                marked.append(chip_name)
+        return marked
+
+    def _restore_running(self, chip_names) -> None:
+        for chip_name in chip_names:
+            chip = self.store.try_get(TPUChip, chip_name)
+            if chip is not None and \
+                    chip.status.phase == constants.PHASE_MIGRATING:
+                chip.status.phase = constants.PHASE_RUNNING
+                self.store.update(chip)
+
     def _post(self, url: str) -> bool:
         try:
             req = urllib.request.Request(url, method="POST", data=b"{}")
@@ -458,12 +479,8 @@ class LiveMigrator:
             self._post(f"{hv}/api/v1/workers/{namespace}/{pod_name}"
                        f"/snapshot")
         # mark chips as migrating
-        if record is not None:
-            for chip_name in record.chip_ids:
-                chip = self.store.try_get(TPUChip, chip_name)
-                if chip is not None:
-                    chip.status.phase = constants.PHASE_MIGRATING
-                    self.store.update(chip)
+        marked = self._mark_migrating(record.chip_ids) \
+            if record is not None else []
 
         # 2. evict + recreate with the source node excluded
         replacement = _make_replacement(pod, source)
@@ -471,13 +488,7 @@ class LiveMigrator:
             self.store.delete(Pod, pod_name, namespace)
         except NotFoundError:
             # pod vanished mid-migration: restore chip phases and abort
-            if record is not None:
-                for chip_name in record.chip_ids:
-                    chip = self.store.try_get(TPUChip, chip_name)
-                    if chip is not None and \
-                            chip.status.phase == constants.PHASE_MIGRATING:
-                        chip.status.phase = constants.PHASE_RUNNING
-                        self.store.update(chip)
+            self._restore_running(marked)
             return None
         self.store.create(replacement)
 
@@ -491,13 +502,7 @@ class LiveMigrator:
                 new_node = cur.spec.node_name
                 break
             time.sleep(0.05)
-        if record is not None:
-            for chip_name in record.chip_ids:
-                chip = self.store.try_get(TPUChip, chip_name)
-                if chip is not None and \
-                        chip.status.phase == constants.PHASE_MIGRATING:
-                    chip.status.phase = constants.PHASE_RUNNING
-                    self.store.update(chip)
+        self._restore_running(marked)
 
         # 4. restore + thaw on the target
         if new_node:
@@ -562,12 +567,7 @@ class LiveMigrator:
                            f"{p.metadata.name}/snapshot")
             rec = self.allocator.allocation(p.key())
             if rec is not None:
-                for chip_name in rec.chip_ids:
-                    chip = self.store.try_get(TPUChip, chip_name)
-                    if chip is not None:
-                        chip.status.phase = constants.PHASE_MIGRATING
-                        self.store.update(chip)
-                        marked.append(chip_name)
+                marked.extend(self._mark_migrating(rec.chip_ids))
 
         # 2. evict + recreate all members together (quorum re-forms from
         #    the full replacement set — a partial set would live-lock).
@@ -584,6 +584,9 @@ class LiveMigrator:
             self.store.create(replacement)
             evicted.append(p)
         if not evicted:
+            # every member vanished before eviction: nothing migrated,
+            # but the phase marks from step 1 must not stick
+            self._restore_running(marked)
             return None
 
         # 3. wait for every evicted member to rebind off the drained node
@@ -599,12 +602,7 @@ class LiveMigrator:
                         cur.spec.node_name != source:
                     placed[p.key()] = cur.spec.node_name
             time.sleep(0.05)
-        for chip_name in marked:
-            chip = self.store.try_get(TPUChip, chip_name)
-            if chip is not None and \
-                    chip.status.phase == constants.PHASE_MIGRATING:
-                chip.status.phase = constants.PHASE_RUNNING
-                self.store.update(chip)
+        self._restore_running(marked)
 
         # 4. restore on targets (deferred for stragglers; the criterion
         #    matches step 3: anywhere off the *drained* node counts)

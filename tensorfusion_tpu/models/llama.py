@@ -231,6 +231,116 @@ def make_train_step(config: LlamaConfig, mesh: Optional[Mesh] = None,
     return train_step, init_opt_state
 
 
+# -- serving: KV-cache decode + generation ----------------------------------
+
+
+def init_kv_cache(config: LlamaConfig, batch: int,
+                  max_len: Optional[int] = None) -> Dict:
+    """Preallocated static-shape KV cache: [layer][B, n_kv_heads, T, D].
+    Static shapes keep the decode step compilable once — the position is
+    data, not shape (XLA semantics: no dynamic shapes under jit)."""
+    t = max_len or config.max_seq_len
+    hd = config.head_dim
+    shape = (batch, config.n_kv_heads, t, hd)
+    return {
+        "k": [jnp.zeros(shape, config.dtype)
+              for _ in range(config.n_layers)],
+        "v": [jnp.zeros(shape, config.dtype)
+              for _ in range(config.n_layers)],
+    }
+
+
+def _rope_at(x, pos, theta):
+    """Rotary embedding for a single position: x [B, 1, H, D]."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = pos.astype(jnp.float32) * freqs            # [D/2]
+    cos = jnp.cos(angles)[None, None, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[None, None, None, :].astype(x.dtype)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    return jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                     axis=-1).reshape(x.shape)
+
+
+def _attention_decode(config: LlamaConfig, p, x, k_cache, v_cache, pos):
+    """One-token attention against the cache.  x: [B, 1, dim]; caches
+    [B, n_kv, T, D]; pos: scalar int32.  Returns (out, k_cache, v_cache)."""
+    b = x.shape[0]
+    hd = config.head_dim
+    q = (x @ p["wq"]).reshape(b, 1, config.n_heads, hd)
+    k = (x @ p["wk"]).reshape(b, 1, config.n_kv_heads, hd)
+    v = (x @ p["wv"]).reshape(b, 1, config.n_kv_heads, hd)
+    q = _rope_at(q, pos, config.rope_theta)
+    k = _rope_at(k, pos, config.rope_theta)
+    k_cache = lax.dynamic_update_slice(
+        k_cache, k.transpose(0, 2, 1, 3), (0, 0, pos, 0))
+    v_cache = lax.dynamic_update_slice(
+        v_cache, v.transpose(0, 2, 1, 3), (0, 0, pos, 0))
+    rep = config.n_heads // config.n_kv_heads
+    keys = jnp.repeat(k_cache, rep, axis=1)      # [B, H, T, D]
+    vals = jnp.repeat(v_cache, rep, axis=1)
+    qh = q.transpose(0, 2, 1, 3)                 # [B, H, 1, D]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qh, keys) * hd ** -0.5
+    t = keys.shape[2]
+    mask = jnp.arange(t) <= pos                  # positions written so far
+    scores = jnp.where(mask[None, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(vals.dtype), vals)
+    out = out.transpose(0, 2, 1, 3).reshape(b, 1, config.n_heads * hd)
+    return out @ p["wo"], k_cache, v_cache
+
+
+def decode_step(params: Dict, token: jax.Array, cache: Dict,
+                pos: jax.Array, config: LlamaConfig
+                ) -> Tuple[jax.Array, Dict]:
+    """token [B] int32 + cache + scalar position -> (logits [B, vocab],
+    updated cache).  Jit once; loop outside or via lax.scan."""
+    x = params["tok_emb"][token][:, None, :]     # [B, 1, dim]
+    new_k, new_v = [], []
+    for i, layer in enumerate(params["layers"]):
+        h = _rms_norm(x, layer["attn_norm"], config.norm_eps)
+        attn, k_c, v_c = _attention_decode(config, layer["attn"], h,
+                                           cache["k"][i], cache["v"][i],
+                                           pos)
+        new_k.append(k_c)
+        new_v.append(v_c)
+        x = x + attn
+        x = x + _mlp(layer["mlp"],
+                     _rms_norm(x, layer["mlp_norm"], config.norm_eps))
+    x = _rms_norm(x, params["final_norm"], config.norm_eps)
+    logits = (x[:, 0, :] @ params["lm_head"]).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v}
+
+
+def generate(params: Dict, prompt: jax.Array, steps: int,
+             config: LlamaConfig) -> jax.Array:
+    """Greedy generation: prefill the cache by scanning the prompt, then
+    decode `steps` new tokens.  One compiled program (lax.scan both
+    phases, static shapes throughout).  prompt: [B, T] -> [B, steps]."""
+    batch, prompt_len = prompt.shape
+    cache = init_kv_cache(config, batch,
+                          max_len=prompt_len + steps)
+
+    def prefill(carry, tok):
+        cache, pos = carry
+        logits, cache = decode_step(params, tok, cache, pos, config)
+        return (cache, pos + 1), logits
+
+    (cache, pos), logits = lax.scan(prefill, (cache, jnp.int32(0)),
+                                    prompt.T)
+    next_tok = jnp.argmax(logits[-1], axis=-1).astype(prompt.dtype)
+
+    def decode(carry, _):
+        cache, pos, tok = carry
+        logits, cache = decode_step(params, tok, cache, pos, config)
+        nxt = jnp.argmax(logits, axis=-1).astype(tok.dtype)
+        return (cache, pos + 1, nxt), tok
+
+    (_, _, last), toks = lax.scan(decode, (cache, pos, next_tok), None,
+                                  length=steps)
+    return toks.T                                 # [B, steps]
+
+
 def shard_params(params: Dict, mesh: Mesh, config: LlamaConfig) -> Dict:
     specs = param_specs(config)
     leaves, treedef = jax.tree_util.tree_flatten(params)

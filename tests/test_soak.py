@@ -110,61 +110,65 @@ def test_churn_soak_accounting_invariants():
     threads = [threading.Thread(target=submitter),
                threading.Thread(target=deleter),
                threading.Thread(target=disruptor)]
-    for t in threads:
-        t.start()
-    time.sleep(12.0)
-    stop.set()
-    for t in threads:
-        t.join(timeout=10)
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(12.0)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
     assert not errors, errors
 
-    # settle: let in-flight cycles finish and the TTL sweep run
-    op.allocator.sweep_assumed()
-    time.sleep(2.0)
+    try:
+        # settle: let in-flight cycles finish and the TTL sweep run
+        op.allocator.sweep_assumed()
+        time.sleep(2.0)
 
-    live = {p.metadata.name: p for p in op.store.list(Pod,
-                                                      namespace="soak")}
-    # 1. every committed allocation belongs to a live pod, and its chips
-    #    agree with the pod's binding
-    for rec in op.allocator.allocations():
-        if rec.assumed:
-            continue   # in-flight cycle; TTL sweep owns these
-        ns, name = rec.request.key().split("/", 1)
-        assert ns == "soak"
-        pod = live.get(name)
-        assert pod is not None, f"allocation {rec.request.key()} " \
-                                f"outlived its pod"
-        if pod.spec.node_name:
-            for chip_name in rec.chip_ids:
-                state = op.allocator.get_chip(chip_name)
-                assert state is not None
-                assert state.chip.status.node_name == pod.spec.node_name
+        live = {p.metadata.name: p for p in op.store.list(Pod,
+                                                          namespace="soak")}
+        # 1. every committed allocation belongs to a live pod, and its chips
+        #    agree with the pod's binding
+        for rec in op.allocator.allocations():
+            if rec.assumed:
+                continue   # in-flight cycle; TTL sweep owns these
+            ns, name = rec.request.key().split("/", 1)
+            assert ns == "soak"
+            pod = live.get(name)
+            assert pod is not None, f"allocation {rec.request.key()} " \
+                                    f"outlived its pod"
+            if pod.spec.node_name:
+                for chip_name in rec.chip_ids:
+                    state = op.allocator.get_chip(chip_name)
+                    assert state is not None
+                    assert state.chip.status.node_name == pod.spec.node_name
 
-    # 2. chip accounting self-consistency: holders sum to allocated,
-    #    nothing negative, within virtual capacity
-    for state in op.allocator.chips("pool-a"):
-        total_t = sum(a.tflops for a in state.holders.values())
-        assert state.allocated.tflops == pytest.approx(total_t, abs=1e-6)
-        assert state.allocated.tflops >= -1e-6
-        assert state.allocated.tflops <= \
-            state.virtual_capacity().tflops + 1e-6
-        # every holder is a live pod or an assumed in-flight record
-        for key in state.holders:
-            rec = op.allocator.allocation(key)
-            assert rec is not None, f"orphan hold {key} on " \
-                                    f"{state.chip.name}"
+        # 2. chip accounting self-consistency: holders sum to allocated,
+        #    nothing negative, within virtual capacity
+        for state in op.allocator.chips("pool-a"):
+            total_t = sum(a.tflops for a in state.holders.values())
+            assert state.allocated.tflops == pytest.approx(total_t, abs=1e-6)
+            assert state.allocated.tflops >= -1e-6
+            assert state.allocated.tflops <= \
+                state.virtual_capacity().tflops + 1e-6
+            # every holder is a live pod or an assumed in-flight record
+            for key in state.holders:
+                rec = op.allocator.allocation(key)
+                assert rec is not None, f"orphan hold {key} on " \
+                                        f"{state.chip.name}"
 
-    # 3. no duplicate pod indices among live pods
-    indices = [p.metadata.annotations.get(constants.ANN_POD_INDEX)
-               for p in live.values()
-               if p.metadata.annotations.get(constants.ANN_POD_INDEX)]
-    assert len(indices) == len(set(indices)), "duplicate pod indices"
+        # 3. no duplicate pod indices among live pods
+        indices = [p.metadata.annotations.get(constants.ANN_POD_INDEX)
+                   for p in live.values()
+                   if p.metadata.annotations.get(constants.ANN_POD_INDEX)]
+        assert len(indices) == len(set(indices)), "duplicate pod indices"
 
-    # 4. the cluster still schedules after the churn, and ghosts of
-    #    deleted-while-pending pods never re-enter the cycle
-    op.submit_pod(_pod("final-check", 10, 2**28))
-    bound = op.wait_for_binding("final-check", namespace="soak")
-    assert bound is not None and bound.spec.node_name
-    assert not op.scheduler._forgotten or \
-        len(op.scheduler._forgotten) < 5   # tombstones get consumed
-    op.stop()
+        # 4. the cluster still schedules after the churn, and ghosts of
+        #    deleted-while-pending pods never re-enter the cycle
+        op.submit_pod(_pod("final-check", 10, 2**28))
+        bound = op.wait_for_binding("final-check", namespace="soak")
+        assert bound is not None and bound.spec.node_name
+        assert not op.scheduler._forgotten or \
+            len(op.scheduler._forgotten) < 5   # tombstones get consumed
+    finally:
+        op.stop()

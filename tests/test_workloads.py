@@ -205,3 +205,45 @@ def test_pipeline_matches_sequential_composition():
     got = pipeline_apply(stage, ws, xs, mesh)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_kv_cache_decode_matches_full_forward():
+    """Serving path: step-by-step KV-cache decode must produce exactly
+    the teacher-forced logits of the full forward."""
+    from tensorfusion_tpu.models import LlamaConfig, forward, init_params
+    from tensorfusion_tpu.models.llama import decode_step, init_kv_cache
+
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0,
+                              cfg.vocab_size)
+    full = forward(params, toks, cfg)
+
+    cache = init_kv_cache(cfg, 2, max_len=12)
+    step = jax.jit(lambda p, t, c, pos: decode_step(p, t, c, pos, cfg))
+    outs, pos = [], jnp.int32(0)
+    for t in range(12):
+        logits, cache = step(params, toks[:, t], cache, pos)
+        outs.append(logits)
+        pos = pos + 1
+    dec = jnp.stack(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(dec), np.asarray(full),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_generate_single_program_greedy():
+    """generate() compiles prefill + decode into one program (scan both
+    phases, static shapes) and its first token agrees with the full
+    forward's argmax at the prompt boundary."""
+    from tensorfusion_tpu.models import LlamaConfig, forward, init_params
+    from tensorfusion_tpu.models.llama import generate
+
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 5), 0,
+                                cfg.vocab_size)
+    gen = jax.jit(lambda p, t: generate(p, t, 6, cfg))(params, prompt)
+    assert gen.shape == (2, 6)
+    want0 = jnp.argmax(forward(params, prompt, cfg)[:, -1], axis=-1)
+    np.testing.assert_array_equal(np.asarray(gen[:, 0]),
+                                  np.asarray(want0))
