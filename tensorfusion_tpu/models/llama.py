@@ -124,10 +124,14 @@ def _rms_norm(x, weight, eps):
     return (x * lax.rsqrt(var + eps).astype(x.dtype)) * weight
 
 
-def _rope(x, theta):
-    """x: [B, T, H, D]; rotate pairs along D."""
+def _rope(x, theta, pos=None):
+    """x: [B, T, H, D]; rotate pairs along D.  ``pos`` overrides the
+    per-token positions (shape [T] or scalar — the decode path passes the
+    single cache position); defaults to arange(T)."""
     b, t, h, d = x.shape
-    pos = jnp.arange(t, dtype=jnp.float32)
+    if pos is None:
+        pos = jnp.arange(t, dtype=jnp.float32)
+    pos = jnp.asarray(pos, jnp.float32).reshape(-1)
     freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
     angles = pos[:, None] * freqs[None, :]          # [T, D/2]
     cos = jnp.cos(angles)[None, :, None, :].astype(x.dtype)
@@ -250,43 +254,37 @@ def init_kv_cache(config: LlamaConfig, batch: int,
     }
 
 
-def _rope_at(x, pos, theta):
-    """Rotary embedding for a single position: x [B, 1, H, D]."""
-    d = x.shape[-1]
-    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
-    angles = pos.astype(jnp.float32) * freqs            # [D/2]
-    cos = jnp.cos(angles)[None, None, None, :].astype(x.dtype)
-    sin = jnp.sin(angles)[None, None, None, :].astype(x.dtype)
-    x1, x2 = x[..., 0::2], x[..., 1::2]
-    return jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
-                     axis=-1).reshape(x.shape)
-
-
 def _attention_decode(config: LlamaConfig, p, x, k_cache, v_cache, pos):
     """One-token attention against the cache.  x: [B, 1, dim]; caches
-    [B, n_kv, T, D]; pos: scalar int32.  Returns (out, k_cache, v_cache)."""
+    [B, n_kv, T, D]; pos: scalar int32.  Returns (out, k_cache, v_cache).
+
+    GQA stays grouped: the query reshapes to [B, n_kv, rep, D] and
+    attends against the n_kv-head caches directly — decode is HBM-bound
+    and a materialized rep-times cache copy would multiply its dominant
+    cost.
+    """
     b = x.shape[0]
     hd = config.head_dim
     q = (x @ p["wq"]).reshape(b, 1, config.n_heads, hd)
     k = (x @ p["wk"]).reshape(b, 1, config.n_kv_heads, hd)
     v = (x @ p["wv"]).reshape(b, 1, config.n_kv_heads, hd)
-    q = _rope_at(q, pos, config.rope_theta)
-    k = _rope_at(k, pos, config.rope_theta)
+    q = _rope(q, config.rope_theta, pos=pos)
+    k = _rope(k, config.rope_theta, pos=pos)
     k_cache = lax.dynamic_update_slice(
         k_cache, k.transpose(0, 2, 1, 3), (0, 0, pos, 0))
     v_cache = lax.dynamic_update_slice(
         v_cache, v.transpose(0, 2, 1, 3), (0, 0, pos, 0))
     rep = config.n_heads // config.n_kv_heads
-    keys = jnp.repeat(k_cache, rep, axis=1)      # [B, H, T, D]
-    vals = jnp.repeat(v_cache, rep, axis=1)
-    qh = q.transpose(0, 2, 1, 3)                 # [B, H, 1, D]
-    scores = jnp.einsum("bhqd,bhkd->bhqk", qh, keys) * hd ** -0.5
-    t = keys.shape[2]
+    # [B, 1, (n_kv, rep), D] -> [B, n_kv, rep, D]
+    qg = q[:, 0].reshape(b, config.n_kv_heads, rep, hd)
+    scores = jnp.einsum("bgrd,bgkd->bgrk", qg, k_cache) * hd ** -0.5
+    t = k_cache.shape[2]
     mask = jnp.arange(t) <= pos                  # positions written so far
     scores = jnp.where(mask[None, None, None, :], scores, -1e30)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
-    out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(vals.dtype), vals)
-    out = out.transpose(0, 2, 1, 3).reshape(b, 1, config.n_heads * hd)
+    out = jnp.einsum("bgrk,bgkd->bgrd", probs.astype(v_cache.dtype),
+                     v_cache)
+    out = out.reshape(b, 1, config.n_heads * hd)
     return out @ p["wo"], k_cache, v_cache
 
 
@@ -329,16 +327,19 @@ def generate(params: Dict, prompt: jax.Array, steps: int,
     (cache, pos), logits = lax.scan(prefill, (cache, jnp.int32(0)),
                                     prompt.T)
     next_tok = jnp.argmax(logits[-1], axis=-1).astype(prompt.dtype)
+    if steps == 1:
+        return next_tok[:, None]
 
     def decode(carry, _):
         cache, pos, tok = carry
         logits, cache = decode_step(params, tok, cache, pos, config)
         nxt = jnp.argmax(logits, axis=-1).astype(tok.dtype)
-        return (cache, pos + 1, nxt), tok
+        return (cache, pos + 1, nxt), nxt
 
-    (_, _, last), toks = lax.scan(decode, (cache, pos, next_tok), None,
-                                  length=steps)
-    return toks.T                                 # [B, steps]
+    # steps-1 decode passes: the first generated token came from prefill
+    _, rest = lax.scan(decode, (cache, pos, next_tok), None,
+                       length=steps - 1)
+    return jnp.concatenate([next_tok[:, None], rest.T], axis=1)
 
 
 def shard_params(params: Dict, mesh: Mesh, config: LlamaConfig) -> Dict:

@@ -118,9 +118,9 @@ def test_churn_soak_accounting_invariants():
         stop.set()
         for t in threads:
             t.join(timeout=10)
-    assert not errors, errors
 
     try:
+        assert not errors, errors
         # settle: let in-flight cycles finish and the TTL sweep run
         op.allocator.sweep_assumed()
         time.sleep(2.0)
