@@ -327,8 +327,8 @@ def generate(params: Dict, prompt: jax.Array, steps: int,
     (cache, pos), logits = lax.scan(prefill, (cache, jnp.int32(0)),
                                     prompt.T)
     next_tok = jnp.argmax(logits[-1], axis=-1).astype(prompt.dtype)
-    if steps == 1:
-        return next_tok[:, None]
+    if steps <= 1:
+        return next_tok[:, None][:, :steps]   # [B, 0] or [B, 1]
 
     def decode(carry, _):
         cache, pos, tok = carry

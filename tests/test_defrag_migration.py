@@ -225,6 +225,68 @@ def test_gang_live_migration_refuses_without_atomic_placement():
         op.stop()
 
 
+def test_drain_marks_expire_after_ttl():
+    """Defrag bookkeeping (node defrag-source label, pod exclusions) must
+    clear after the pool's eviction TTL so drained nodes become schedule
+    targets again."""
+    op = make_operator(hosts=2)
+    try:
+        pool = op.store.get(TPUPool, "pool-a")
+        pool.spec.compaction.enabled = True
+        pool.spec.compaction.defrag_eviction_ttl_seconds = 0.5
+        op.store.update(pool)
+
+        p1 = submit(op, "busy2")
+        node1 = p1.spec.node_name
+        pod = Pod.new("roamer", namespace="default")
+        ann = pod.metadata.annotations
+        ann[constants.ANN_POOL] = "pool-a"
+        ann[constants.ANN_TFLOPS_REQUEST] = "10"
+        ann[constants.ANN_HBM_REQUEST] = str(2**30)
+        ann[constants.ANN_IS_LOCAL_TPU] = "true"
+        ann[constants.ANN_EXCLUDED_NODES] = node1
+        pod.spec.containers = [Container(name="main")]
+        op.submit_pod(pod)
+        bound = op.wait_for_binding("roamer")
+        node2 = bound.spec.node_name
+        roamer = op.store.get(Pod, "roamer", "default")
+        del roamer.metadata.annotations[constants.ANN_EXCLUDED_NODES]
+        op.store.update(roamer)
+
+        assert op.compaction.defrag_node("pool-a", node2) == 1
+        moved = None
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            moved = op.store.try_get(Pod, "roamer", "default")
+            if moved is not None and moved.spec.node_name == node1:
+                break
+            time.sleep(0.05)
+        assert moved is not None and moved.spec.node_name == node1, \
+            "defrag never rebound the pod onto the other node"
+        assert moved.metadata.annotations.get(
+            constants.ANN_EXCLUDED_NODES), "drain exclusion not stamped"
+        tnode = op.store.get(TPUNode, node2)
+        assert tnode.metadata.labels.get(constants.LABEL_DEFRAG_SOURCE)
+
+        # TTL (0.5s) lapses -> exclusions + source label cleared by the
+        # compaction controller's expiry pass
+        deadline = time.time() + 10
+        cleared = False
+        while time.time() < deadline:
+            cur = op.store.get(Pod, "roamer", "default")
+            tnode = op.store.get(TPUNode, node2)
+            if not cur.metadata.annotations.get(
+                    constants.ANN_EXCLUDED_NODES) and \
+                    not tnode.metadata.labels.get(
+                        constants.LABEL_DEFRAG_SOURCE):
+                cleared = True
+                break
+            time.sleep(0.2)
+        assert cleared, "drain marks never expired"
+    finally:
+        op.stop()
+
+
 def test_compaction_releases_empty_node():
     op = make_operator(hosts=2, compaction=True, grace_s=0.2)
     try:
