@@ -115,6 +115,29 @@ class CompactionController(Controller):
         if ttls:
             self._expire_drain_marks(ttls)
 
+    def _update_fresh(self, kind, name: str, namespace, mutate) -> None:
+        """Version-checked read-modify-write with retries: the expiry pass
+        races with the scheduler/controllers writing the same Pod/TPUNode
+        objects, and an unchecked stale write could resurrect the very
+        marks this pass just cleared."""
+        from ..store import ConflictError
+
+        for _ in range(4):
+            obj = self.store.try_get(kind, name, namespace or "")
+            if obj is None:
+                return
+            if not mutate(obj):
+                return      # nothing to change on the fresh copy
+            try:
+                self.store.update(obj, check_version=True)
+                return
+            except ConflictError:
+                continue
+            except NotFoundError:
+                return      # deleted between read and write: nothing left
+        log.warning("expiry pass: gave up updating %s %s after conflicts",
+                    getattr(kind, "KIND", kind), name)
+
     def _expire_drain_marks(self, ttls: Dict[str, float]) -> None:
         """Clear drain bookkeeping (workload/pod exclusions, defrag-source
         and defrag-skip node marks) once the owning pool's eviction TTL
@@ -124,39 +147,44 @@ class CompactionController(Controller):
         def ttl_for(pool: str) -> float:
             return ttls.get(pool, self.DEFAULT_EVICTION_TTL_S)
 
-        for wl in self.store.list(TPUWorkload):
+        def clear_workload(wl) -> bool:
             ann = wl.metadata.annotations
             since = ann.get(constants.ANN_DEFRAG_EVICTED_SINCE)
             if not since or not wl.spec.excluded_nodes:
-                continue
-            if now - float(since) >= ttl_for(wl.spec.pool):
-                added = set(ann.pop(constants.ANN_DEFRAG_EXCLUDED,
-                                    "").split(","))
-                wl.spec.excluded_nodes = [
-                    n for n in wl.spec.excluded_nodes if n not in added]
-                del ann[constants.ANN_DEFRAG_EVICTED_SINCE]
-                self.store.update(wl)
-        for pod in self.store.list(Pod):
+                return False
+            if now - float(since) < ttl_for(wl.spec.pool):
+                return False
+            added = set(ann.pop(constants.ANN_DEFRAG_EXCLUDED,
+                                "").split(","))
+            wl.spec.excluded_nodes = [
+                n for n in wl.spec.excluded_nodes if n not in added]
+            del ann[constants.ANN_DEFRAG_EVICTED_SINCE]
+            return True
+
+        def clear_pod(pod) -> bool:
             ann = pod.metadata.annotations
             since = ann.get(constants.ANN_DEFRAG_EVICTED_SINCE)
             if not since or constants.ANN_EXCLUDED_NODES not in ann:
-                continue
-            if now - float(since) >= ttl_for(
-                    ann.get(constants.ANN_POOL, "")):
-                # drop only the defrag-added nodes; user exclusions persist
-                added = set(ann.pop(constants.ANN_DEFRAG_EXCLUDED,
-                                    "").split(","))
-                kept = [n for n in
-                        ann[constants.ANN_EXCLUDED_NODES].split(",")
-                        if n and n not in added]
-                if kept:
-                    ann[constants.ANN_EXCLUDED_NODES] = ",".join(kept)
-                else:
-                    del ann[constants.ANN_EXCLUDED_NODES]
-                del ann[constants.ANN_DEFRAG_EVICTED_SINCE]
-                self.store.update(pod)
-        for tnode in self.store.list(TPUNode):
+                return False
+            if now - float(since) < ttl_for(ann.get(constants.ANN_POOL,
+                                                    "")):
+                return False
+            # drop only the defrag-added nodes; user exclusions persist
+            added = set(ann.pop(constants.ANN_DEFRAG_EXCLUDED,
+                                "").split(","))
+            kept = [n for n in
+                    ann[constants.ANN_EXCLUDED_NODES].split(",")
+                    if n and n not in added]
+            if kept:
+                ann[constants.ANN_EXCLUDED_NODES] = ",".join(kept)
+            else:
+                del ann[constants.ANN_EXCLUDED_NODES]
+            del ann[constants.ANN_DEFRAG_EVICTED_SINCE]
+            return True
+
+        def clear_node(tnode) -> bool:
             ann = tnode.metadata.annotations
+            changed = False
             pool = ann.get(constants.ANN_DEFRAG_SOURCE_POOL,
                            tnode.spec.pool)
             since = ann.get(constants.ANN_DEFRAG_SOURCE_SINCE)
@@ -164,14 +192,29 @@ class CompactionController(Controller):
                 tnode.metadata.labels.pop(constants.LABEL_DEFRAG_SOURCE,
                                           None)
                 del ann[constants.ANN_DEFRAG_SOURCE_SINCE]
-                self.store.update(tnode)
+                changed = True
             skip_since = ann.get(constants.ANN_DEFRAG_SKIP_SINCE)
             if skip_since and now - float(skip_since) >= ttl_for(
                     tnode.spec.pool):
-                tnode.metadata.labels.pop(constants.LABEL_DEFRAG_SKIP, None)
+                tnode.metadata.labels.pop(constants.LABEL_DEFRAG_SKIP,
+                                          None)
                 ann.pop(constants.ANN_DEFRAG_SKIP_REASON, None)
                 del ann[constants.ANN_DEFRAG_SKIP_SINCE]
-                self.store.update(tnode)
+                changed = True
+            return changed
+
+        for wl in self.store.list(TPUWorkload):
+            if clear_workload(wl):
+                self._update_fresh(TPUWorkload, wl.metadata.name,
+                                   wl.metadata.namespace, clear_workload)
+        for pod in self.store.list(Pod):
+            if clear_pod(pod):
+                self._update_fresh(Pod, pod.metadata.name,
+                                   pod.metadata.namespace, clear_pod)
+        for tnode in self.store.list(TPUNode):
+            if clear_node(tnode):
+                self._update_fresh(TPUNode, tnode.metadata.name,
+                                   tnode.metadata.namespace, clear_node)
 
     # -- defrag ------------------------------------------------------------
 

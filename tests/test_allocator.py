@@ -385,3 +385,66 @@ def test_index_allocator_reconcile_deduplicates():
     assert by_owner["c"] == 5
     assert by_owner["b"] not in (2, 5)
     assert len(set(by_owner.values())) == 3
+
+
+def test_vectorized_filter_path_matches_python_chain():
+    """Pools above VECTORIZE_THRESHOLD take the numpy mask path; it must
+    agree with the explained Python chain on candidates, scores, and the
+    whole allocate flow (the load-bearing perf path the big benchmark
+    exercises but small unit pools never hit)."""
+    from tensorfusion_tpu.allocator.core import VECTORIZE_THRESHOLD
+    from tensorfusion_tpu.allocator.vecview import CandidateMap
+
+    n = VECTORIZE_THRESHOLD + 36           # 100 chips over 25 nodes
+    alloc = TPUAllocator()
+    alloc.set_pool_oversell("pool-a", 200.0)
+    for i in range(n):
+        chip = make_chip(f"v-{i}", node=f"vn-{i // 4}")
+        if i % 7 == 0:
+            chip.status.generation = "v5p"
+        if i % 11 == 0:
+            chip.status.phase = "Pending"      # filtered out
+        alloc.upsert_chip(chip)
+    # occupy some chips so capacity filtering has teeth
+    for i in range(0, 30, 3):
+        alloc.alloc(req(pod=f"occ{i}", tflops=300.0, hbm=10 * 2**30,
+                        chip_indices=[alloc.chips("pool-a")[i]
+                                      .chip.status.host_index],
+                        same_node=False))
+
+    r = req(pod="probe", tflops=150.0, hbm=8 * 2**30)
+    by_node_vec, _ = alloc.check_quota_and_filter(r)
+    assert isinstance(by_node_vec, CandidateMap)
+    by_node_py, _ = alloc.check_quota_and_filter(r, explain=True)
+
+    vec_chips = {c.chip.name for node in by_node_vec
+                 for c in by_node_vec[node]}
+    py_chips = {c.chip.name for chips in by_node_py.values()
+                for c in chips}
+    assert vec_chips == py_chips
+    assert set(by_node_vec) == set(by_node_py)
+
+    # generation + isolation narrowing agree too
+    r2 = req(pod="gen", tflops=10.0, hbm=2**30, generation="v5p",
+             isolation=constants.ISOLATION_SOFT)
+    v2, _ = alloc.check_quota_and_filter(r2)
+    p2, _ = alloc.check_quota_and_filter(r2, explain=True)
+    assert {c.chip.name for nd in v2 for c in v2[nd]} == \
+        {c.chip.name for chips in p2.values() for c in chips}
+
+    # vectorized node scores cover every eligible node and allocate works
+    scores = alloc.score_nodes(r, by_node_vec)
+    assert set(scores) == set(by_node_vec)
+    record = alloc.alloc(req(pod="vec-alloc", tflops=50.0, hbm=2**30))
+    assert record.chip_ids
+    # the view refreshes: the allocated chip's capacity drop is visible —
+    # a request pinned to that chip asking for more than its remainder
+    # must now be rejected by the vectorized path
+    chip_state = alloc.get_chip(record.chip_ids[0])
+    remaining = chip_state.available().tflops
+    v3, _ = alloc.check_quota_and_filter(
+        req(pod="probe2", tflops=remaining + 1.0, hbm=2**30,
+            chip_indices=[chip_state.chip.status.host_index]))
+    assert record.chip_ids[0] not in {c.chip.name for nd in v3
+                                      for c in v3[nd]}, \
+        "vectorized view served stale capacity"

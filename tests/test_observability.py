@@ -213,3 +213,51 @@ def test_alert_evaluator_fire_and_resolve_with_webhook():
         assert received[1][0]["state"] == "resolved"
     finally:
         server.shutdown()
+
+
+def test_cron_and_external_recommenders():
+    """Cron windows fire by schedule; the external recommender round-trips
+    a webhook and tolerates failure (autoscaler.go recommender trio)."""
+    import http.server
+    import json as _json
+    import threading
+
+    from tensorfusion_tpu.api.resources import ResourceAmount
+    from tensorfusion_tpu.autoscaler.recommender import (
+        CronRecommender, ExternalRecommender)
+
+    cron = CronRecommender()
+    # schedule matching every minute -> fires; impossible minute -> None
+    hit = cron.recommend_from_rules(
+        [{"schedule": "* * * * *", "tflops": 99.0}])
+    assert hit is not None and hit.target.tflops == 99.0
+    # no matching rule
+    assert cron.recommend_from_rules([]) is None
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers["Content-Length"])
+            body = _json.loads(self.rfile.read(n))
+            assert body["workload"] == "ns/wl"
+            out = _json.dumps({"tflops": body["current"]["tflops"] * 2})
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out.encode())
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        ext = ExternalRecommender()
+        rec = ext.recommend(f"http://127.0.0.1:{srv.server_port}",
+                            "ns/wl", ResourceAmount(tflops=40.0))
+        assert rec is not None and rec.target.tflops == 80.0
+        # unreachable endpoint: graceful None, not an exception
+        assert ExternalRecommender(timeout_s=0.3).recommend(
+            "http://127.0.0.1:1/none", "ns/wl",
+            ResourceAmount(tflops=40.0)) is None
+    finally:
+        srv.shutdown()
