@@ -41,7 +41,7 @@ sys.path.insert(0, str(REPO))
 
 from driver_guard import backend_alive, run_with_deadline, scrubbed_cpu_env
 
-STEPS = 20
+STEPS = 28   # 7 interleaved rounds of 4: medians shrug off load spikes
 
 _CHILD_TIMEOUT = 420       # one benchmark attempt (incl. ~40s compile)
 
@@ -107,7 +107,7 @@ def _time_round(step, args, n) -> float:
     return (time.perf_counter() - t0) / n
 
 
-def _time_interleaved(native, metered, args, steps, rounds=5):
+def _time_interleaved(native, metered, args, steps, rounds=7):
     """Alternate native/metered rounds and take medians, so machine-load
     drift hits both paths equally instead of biasing one."""
     import jax

@@ -1,5 +1,6 @@
 """Reference hosted workloads (flagship: Llama-style decoder)."""
 
+from .checkpoint import Checkpointer
 from .llama import (LlamaConfig, forward, init_params, loss_fn,
                     make_train_step, param_specs)
 from .moe import (MoEConfig, init_moe_params, make_moe_train_step,

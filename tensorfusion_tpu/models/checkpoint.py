@@ -1,0 +1,70 @@
+"""Training checkpoint save/restore for hosted workloads.
+
+Thin orbax wrapper shaped for the platform: checkpoints carry the param
++ optimizer pytrees and the step counter, restore works onto a *sharded*
+target (each host reads only its shards — orbax handles the
+single-controller/multi-host split), and `latest_step` supports the
+failure-recovery loop (a gang member rescheduled by the platform rejoins
+from the last complete step).  This is workload-level state; vTPU-level
+state (shm, partitions, remoting buffers) is the provider/hypervisor
+snapshot path.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+log = logging.getLogger("tpf.models.checkpoint")
+
+
+class Checkpointer:
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import os
+
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        # orbax/tensorstore hard-requires absolute paths, and only fails
+        # at save() time with a confusing tmp-dir message — normalize now
+        self.directory = os.path.abspath(directory)
+        self.manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep))
+
+    def save(self, step: int, params: Dict, opt_state: Any = None,
+             extra: Optional[Dict] = None) -> None:
+        state = {"params": params}
+        if opt_state is not None:
+            state["opt_state"] = opt_state
+        if extra:
+            state["extra"] = extra
+        self.manager.save(step, args=self._ocp.args.StandardSave(state))
+        self.manager.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self.manager.latest_step()
+
+    def restore(self, step: Optional[int] = None,
+                target: Optional[Dict] = None) -> Dict:
+        """Restore `step` (default: latest).  With `target` (a pytree of
+        like-sharded arrays in {"params": ..., "opt_state": ...} form),
+        arrays come back with the target's shardings — each host reads
+        only its shards.  Build the target from trees that went through
+        one jitted step (jit commits the optimizer's scalar leaves onto
+        the mesh; freshly-init'd optax scalars are single-device and
+        would restore committed to one device, clashing with the sharded
+        params in the next step)."""
+        if step is None:
+            step = self.manager.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoint under {self.directory}")
+        if target is not None:
+            args = self._ocp.args.StandardRestore(target)
+        else:
+            args = self._ocp.args.StandardRestore()
+        return self.manager.restore(step, args=args)
+
+    def close(self) -> None:
+        self.manager.close()
