@@ -550,3 +550,32 @@ def test_http_api_end_to_end(stack, tmp_path):
         assert workers.get("api/w9") is None
     finally:
         server.stop()
+
+
+def test_hypervisor_metrics_file_emission(stack, tmp_path):
+    """The node agent's influx-line metrics (chips + workers) land in the
+    vector-shipped file and parse back through the TSDB ingester."""
+    from tensorfusion_tpu.hypervisor.metrics import HypervisorMetricsRecorder
+    from tensorfusion_tpu.metrics.tsdb import TSDB
+
+    devices_ctrl, alloc, workers, limiter = stack
+    entry = devices_ctrl.devices()[0]
+    workers.add_worker(WorkerSpec(
+        namespace="m", name="w", isolation=constants.ISOLATION_SOFT,
+        devices=[WorkerDeviceRequest(chip_id=entry.info.chip_id,
+                                     duty_percent=50.0,
+                                     hbm_bytes=2**30)]))
+    path = str(tmp_path / "hv-metrics.log")
+    rec = HypervisorMetricsRecorder(devices_ctrl, workers, path,
+                                    node_name="n0")
+    rec.record_once()
+
+    db = TSDB()
+    db.ingest_file(path)
+    duty = db.aggregate("tpf_chip", "duty_cycle_pct",
+                        tags={"chip": entry.info.chip_id}, agg="last")
+    assert duty is not None and 0 <= duty <= 100
+    pids = db.aggregate("tpf_worker", "pids",
+                        tags={"worker": "w"}, agg="last")
+    assert pids is not None
+    workers.remove_worker("m/w")
