@@ -32,8 +32,25 @@ constexpr uint64_t kFakeBufferBytes = 1 << 20;
 
 uintptr_t g_next_buffer = 0x1000;
 
-PJRT_Error* fake_execute(PJRT_LoadedExecutable_Execute_Args*) {
+constexpr size_t kFakeNumOutputs = 2;
+
+PJRT_Error* fake_execute(PJRT_LoadedExecutable_Execute_Args* args) {
   ++g_calls.execute;
+  if (args->output_lists != nullptr) {
+    for (size_t d = 0; d < args->num_devices; ++d) {
+      if (args->output_lists[d] == nullptr) continue;
+      for (size_t o = 0; o < kFakeNumOutputs; ++o) {
+        args->output_lists[d][o] =
+            reinterpret_cast<PJRT_Buffer*>(g_next_buffer);
+        g_next_buffer += 0x10;
+      }
+    }
+  }
+  return nullptr;
+}
+
+PJRT_Error* fake_num_outputs(PJRT_Executable_NumOutputs_Args* args) {
+  args->num_outputs = kFakeNumOutputs;
   return nullptr;
 }
 
@@ -169,6 +186,7 @@ const PJRT_Api* GetPjrtApi(void) {
   g_api.PJRT_LoadedExecutable_Execute = fake_execute;
   g_api.PJRT_LoadedExecutable_GetExecutable = fake_get_executable;
   g_api.PJRT_Executable_GetCostAnalysis = fake_cost_analysis;
+  g_api.PJRT_Executable_NumOutputs = fake_num_outputs;
   g_api.PJRT_Client_BufferFromHostBuffer = fake_buffer_from_host;
   g_api.PJRT_Buffer_OnDeviceSizeInBytes = fake_on_device_size;
   g_api.PJRT_Buffer_Destroy = fake_buffer_destroy;

@@ -79,8 +79,13 @@ struct ProxyState {
   int64_t hbm_charged_bytes = 0;
   uint64_t hbm_denied = 0;
 
+  struct ExecInfo {
+    uint64_t mflops = 1;
+    size_t num_outputs = 0;
+  };
+
   pthread_mutex_t mu = PTHREAD_MUTEX_INITIALIZER;
-  std::unordered_map<PJRT_LoadedExecutable*, uint64_t> exec_cost;
+  std::unordered_map<PJRT_LoadedExecutable*, ExecInfo> exec_cost;
   std::unordered_map<PJRT_Buffer*, uint64_t> buffer_bytes;
 };
 
@@ -91,73 +96,127 @@ void logmsg(const char* msg) {
     fprintf(stderr, "[tpf_pjrt_proxy] %s\n", msg);
 }
 
+void destroy_error(PJRT_Error* err) {
+  if (err == nullptr || g_state.real->PJRT_Error_Destroy == nullptr)
+    return;
+  PJRT_Error_Destroy_Args da;
+  memset(&da, 0, sizeof(da));
+  da.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+  da.error = err;
+  g_state.real->PJRT_Error_Destroy(&da);
+}
+
 /* ------------------------------------------------------------------ */
 /* cost estimation                                                     */
 /* ------------------------------------------------------------------ */
 
-uint64_t cost_mflops_locked(PJRT_LoadedExecutable* loaded) {
+ProxyState::ExecInfo exec_info_locked(PJRT_LoadedExecutable* loaded) {
+  /* One vendor round-trip per executable: cost + output count are static
+   * properties, cached until proxy_executable_destroy evicts them. */
   auto it = g_state.exec_cost.find(loaded);
   if (it != g_state.exec_cost.end()) return it->second;
 
-  uint64_t mflops = 1; /* flat-rate fallback, like the python runtime */
+  ProxyState::ExecInfo info;   /* flat-rate fallback, like the runtime */
   const PJRT_Api* api = g_state.real;
-  if (api->PJRT_LoadedExecutable_GetExecutable &&
-      api->PJRT_Executable_GetCostAnalysis) {
+  if (api->PJRT_LoadedExecutable_GetExecutable) {
     PJRT_LoadedExecutable_GetExecutable_Args ga;
     memset(&ga, 0, sizeof(ga));
     ga.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
     ga.loaded_executable = loaded;
     PJRT_Error* err = api->PJRT_LoadedExecutable_GetExecutable(&ga);
     if (err == nullptr && ga.executable != nullptr) {
-      PJRT_Executable_GetCostAnalysis_Args ca;
-      memset(&ca, 0, sizeof(ca));
-      ca.struct_size = PJRT_Executable_GetCostAnalysis_Args_STRUCT_SIZE;
-      ca.executable = ga.executable;
-      err = api->PJRT_Executable_GetCostAnalysis(&ca);
-      if (err == nullptr) {
-        for (size_t i = 0; i < ca.num_properties; ++i) {
-          const PJRT_NamedValue& p = ca.properties[i];
-          if (p.name_size == 5 && strncmp(p.name, "flops", 5) == 0) {
-            double flops = 0.0;
-            if (p.type == PJRT_NamedValue_kFloat) flops = p.float_value;
-            else if (p.type == PJRT_NamedValue_kInt64) {
-              flops = (double)p.int64_value;
-            }
-            if (flops > 0) {
-              mflops = (uint64_t)(flops / 1e6);
-              if (mflops == 0) mflops = 1;
+      if (api->PJRT_Executable_GetCostAnalysis) {
+        PJRT_Executable_GetCostAnalysis_Args ca;
+        memset(&ca, 0, sizeof(ca));
+        ca.struct_size = PJRT_Executable_GetCostAnalysis_Args_STRUCT_SIZE;
+        ca.executable = ga.executable;
+        PJRT_Error* cerr = api->PJRT_Executable_GetCostAnalysis(&ca);
+        if (cerr == nullptr) {
+          for (size_t i = 0; i < ca.num_properties; ++i) {
+            const PJRT_NamedValue& p = ca.properties[i];
+            if (p.name_size == 5 && strncmp(p.name, "flops", 5) == 0) {
+              double flops = 0.0;
+              if (p.type == PJRT_NamedValue_kFloat) flops = p.float_value;
+              else if (p.type == PJRT_NamedValue_kInt64) {
+                flops = (double)p.int64_value;
+              }
+              if (flops > 0) {
+                info.mflops = (uint64_t)(flops / 1e6);
+                if (info.mflops == 0) info.mflops = 1;
+              }
             }
           }
+        } else {
+          destroy_error(cerr);
         }
-      } else if (api->PJRT_Error_Destroy) {
-        PJRT_Error_Destroy_Args da;
-        memset(&da, 0, sizeof(da));
-        da.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
-        da.error = err;
-        api->PJRT_Error_Destroy(&da);
       }
-    } else if (err != nullptr && api->PJRT_Error_Destroy) {
-      PJRT_Error_Destroy_Args da;
-      memset(&da, 0, sizeof(da));
-      da.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
-      da.error = err;
-      api->PJRT_Error_Destroy(&da);
+      if (api->PJRT_Executable_NumOutputs) {
+        PJRT_Executable_NumOutputs_Args na;
+        memset(&na, 0, sizeof(na));
+        na.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
+        na.executable = ga.executable;
+        PJRT_Error* nerr = api->PJRT_Executable_NumOutputs(&na);
+        if (nerr == nullptr) info.num_outputs = na.num_outputs;
+        else destroy_error(nerr);
+      }
+    } else {
+      destroy_error(err);
     }
   }
-  g_state.exec_cost.emplace(loaded, mflops);
-  return mflops;
+  g_state.exec_cost.emplace(loaded, info);
+  return info;
 }
 
 /* ------------------------------------------------------------------ */
 /* interceptors                                                        */
 /* ------------------------------------------------------------------ */
 
+void charge_buffer(PJRT_Buffer* buffer) {
+  /* Charge a device buffer's HBM and remember it so proxy_buffer_destroy
+   * releases the charge (shared by host-upload and execute-output
+   * paths). */
+  if (buffer == nullptr ||
+      g_state.real->PJRT_Buffer_OnDeviceSizeInBytes == nullptr)
+    return;
+  PJRT_Buffer_OnDeviceSizeInBytes_Args sa;
+  memset(&sa, 0, sizeof(sa));
+  sa.struct_size = PJRT_Buffer_OnDeviceSizeInBytes_Args_STRUCT_SIZE;
+  sa.buffer = buffer;
+  PJRT_Error* serr = g_state.real->PJRT_Buffer_OnDeviceSizeInBytes(&sa);
+  if (serr != nullptr) {
+    if (g_state.real->PJRT_Error_Destroy) {
+      PJRT_Error_Destroy_Args da;
+      memset(&da, 0, sizeof(da));
+      da.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+      da.error = serr;
+      g_state.real->PJRT_Error_Destroy(&da);
+    }
+    return;
+  }
+  if (sa.on_device_size_in_bytes == 0) return;
+  uint64_t size = sa.on_device_size_in_bytes;
+  tfl_charge_result_t r;
+  if (g_state.charge_hbm(g_state.device_index, (int64_t)size, &r) != 0)
+    return;
+  if (!r.allowed) {
+    __atomic_add_fetch(&g_state.hbm_denied, 1, __ATOMIC_RELAXED);
+    logmsg("HBM budget exceeded (accounted)");
+  }
+  __atomic_add_fetch(&g_state.hbm_charged_bytes, (int64_t)size,
+                     __ATOMIC_RELAXED);
+  pthread_mutex_lock(&g_state.mu);
+  g_state.buffer_bytes[buffer] = size;
+  pthread_mutex_unlock(&g_state.mu);
+}
+
 PJRT_Error* proxy_execute(PJRT_LoadedExecutable_Execute_Args* args) {
+  ProxyState::ExecInfo info;
   if (g_state.metered) {
     pthread_mutex_lock(&g_state.mu);
-    uint64_t mflops = cost_mflops_locked(args->executable);
+    info = exec_info_locked(args->executable);
     pthread_mutex_unlock(&g_state.mu);
-    uint64_t total = mflops * (args->num_devices ? args->num_devices : 1);
+    uint64_t total = info.mflops *
+                     (args->num_devices ? args->num_devices : 1);
 
     tfl_charge_result_t r;
     while (true) {
@@ -173,43 +232,27 @@ PJRT_Error* proxy_execute(PJRT_LoadedExecutable_Execute_Args* args) {
     __atomic_add_fetch(&g_state.launches, 1, __ATOMIC_RELAXED);
     __atomic_add_fetch(&g_state.charged_mflops, total, __ATOMIC_RELAXED);
   }
-  return g_state.real->PJRT_LoadedExecutable_Execute(args);
+  PJRT_Error* err = g_state.real->PJRT_LoadedExecutable_Execute(args);
+  if (err == nullptr && g_state.metered && args->output_lists != nullptr) {
+    /* Execute OUTPUTS occupy HBM too; charge them on creation so the
+     * buffer_destroy release keeps the meter an honest live total.
+     * (Donated inputs alias outputs: those bytes read double until the
+     * caller destroys its donated handle — a short transient, noted in
+     * the docs.) */
+    for (size_t d = 0; d < args->num_devices; ++d) {
+      if (args->output_lists[d] == nullptr) continue;
+      for (size_t o = 0; o < info.num_outputs; ++o)
+        charge_buffer(args->output_lists[d][o]);
+    }
+  }
+  return err;
 }
 
 PJRT_Error* proxy_buffer_from_host(
     PJRT_Client_BufferFromHostBuffer_Args* args) {
   PJRT_Error* err = g_state.real->PJRT_Client_BufferFromHostBuffer(args);
-  if (err == nullptr && g_state.metered && args->buffer != nullptr &&
-      g_state.real->PJRT_Buffer_OnDeviceSizeInBytes) {
-    PJRT_Buffer_OnDeviceSizeInBytes_Args sa;
-    memset(&sa, 0, sizeof(sa));
-    sa.struct_size = PJRT_Buffer_OnDeviceSizeInBytes_Args_STRUCT_SIZE;
-    sa.buffer = args->buffer;
-    PJRT_Error* serr = g_state.real->PJRT_Buffer_OnDeviceSizeInBytes(&sa);
-    if (serr == nullptr && sa.on_device_size_in_bytes > 0) {
-      uint64_t size = sa.on_device_size_in_bytes;
-      tfl_charge_result_t r;
-      if (g_state.charge_hbm(g_state.device_index, (int64_t)size, &r) == 0) {
-        if (!r.allowed) {
-          /* over the HBM budget: account + surface, enforcement is the
-           * provider's device-level hard cap (see header comment) */
-          __atomic_add_fetch(&g_state.hbm_denied, 1, __ATOMIC_RELAXED);
-          logmsg("HBM budget exceeded (accounted)");
-        }
-        __atomic_add_fetch(&g_state.hbm_charged_bytes, (int64_t)size,
-                           __ATOMIC_RELAXED);
-        pthread_mutex_lock(&g_state.mu);
-        g_state.buffer_bytes[args->buffer] = size;
-        pthread_mutex_unlock(&g_state.mu);
-      }
-    } else if (serr != nullptr && g_state.real->PJRT_Error_Destroy) {
-      PJRT_Error_Destroy_Args da;
-      memset(&da, 0, sizeof(da));
-      da.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
-      da.error = serr;
-      g_state.real->PJRT_Error_Destroy(&da);
-    }
-  }
+  if (err == nullptr && g_state.metered)
+    charge_buffer(args->buffer);
   return err;
 }
 

@@ -164,9 +164,32 @@ int main(int argc, char** argv) {
   CHECK(f_bfh == 3);
   CHECK(f_bd == 3);
 
+  /* -- 4: execute OUTPUT buffers are charged + released -------------- */
+  PJRT_Buffer* out_row[2] = {nullptr, nullptr};
+  PJRT_Buffer** out_lists[1] = {out_row};
+  PJRT_LoadedExecutable_Execute_Args ex2;
+  memset(&ex2, 0, sizeof(ex2));
+  ex2.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+  ex2.executable = reinterpret_cast<PJRT_LoadedExecutable*>(0xBEEF);
+  ex2.num_devices = 1;
+  ex2.output_lists = out_lists;
+  CHECK(api->PJRT_LoadedExecutable_Execute(&ex2) == nullptr);
+  CHECK(out_row[0] != nullptr && out_row[1] != nullptr);
+  proxy_stats(nullptr, nullptr, nullptr, &hbm_charged, nullptr);
+  CHECK(hbm_charged == 2 * (1 << 20));   /* 2 outputs x 1 MiB tracked */
+  for (int i = 0; i < 2; ++i) {
+    PJRT_Buffer_Destroy_Args da;
+    memset(&da, 0, sizeof(da));
+    da.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+    da.buffer = out_row[i];
+    CHECK(api->PJRT_Buffer_Destroy(&da) == nullptr);
+  }
+  proxy_stats(nullptr, nullptr, nullptr, &hbm_charged, nullptr);
+  CHECK(hbm_charged == 0);
+
   printf("PASS pjrt_proxy_selftest: %d launches metered "
-         "(%.2fs wall, %lums blocked), hbm tracked+released, "
-         "cost cached\n",
+         "(%.2fs wall, %lums blocked), hbm tracked+released "
+         "(uploads + execute outputs), cost cached\n",
          kLaunches, elapsed, (unsigned long)(blocked_us / 1000));
   return 0;
 }
