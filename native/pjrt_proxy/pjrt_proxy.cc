@@ -159,8 +159,19 @@ ProxyState::ExecInfo exec_info_locked(PJRT_LoadedExecutable* loaded) {
         if (nerr == nullptr) info.num_outputs = na.num_outputs;
         else destroy_error(nerr);
       }
+      /* the header says the caller frees the GetExecutable result */
+      if (api->PJRT_Executable_Destroy) {
+        PJRT_Executable_Destroy_Args xa;
+        memset(&xa, 0, sizeof(xa));
+        xa.struct_size = PJRT_Executable_Destroy_Args_STRUCT_SIZE;
+        xa.executable = ga.executable;
+        destroy_error(api->PJRT_Executable_Destroy(&xa));
+      }
     } else {
       destroy_error(err);
+      /* transient vendor failure: DON'T cache the fallback, or this
+       * executable's outputs would go un-charged forever */
+      return info;
     }
   }
   g_state.exec_cost.emplace(loaded, info);
@@ -184,13 +195,7 @@ void charge_buffer(PJRT_Buffer* buffer) {
   sa.buffer = buffer;
   PJRT_Error* serr = g_state.real->PJRT_Buffer_OnDeviceSizeInBytes(&sa);
   if (serr != nullptr) {
-    if (g_state.real->PJRT_Error_Destroy) {
-      PJRT_Error_Destroy_Args da;
-      memset(&da, 0, sizeof(da));
-      da.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
-      da.error = serr;
-      g_state.real->PJRT_Error_Destroy(&da);
-    }
+    destroy_error(serr);
     return;
   }
   if (sa.on_device_size_in_bytes == 0) return;

@@ -579,3 +579,77 @@ def test_hypervisor_metrics_file_emission(stack, tmp_path):
                         tags={"worker": "w"}, agg="last")
     assert pids is not None
     workers.remove_worker("m/w")
+
+
+def test_hypervisor_daemon_boot_smoke(native_build, tmp_path):
+    """End-to-end daemon boot: `python -m tensorfusion_tpu.hypervisor`
+    over the mock provider serves the devices API, adopts a pre-seeded
+    single-node worker, and stamps the metering/mount env (the __main__
+    wiring no unit test touches)."""
+    import subprocess
+    import sys
+
+    state = tmp_path / "state"
+    state.mkdir()
+    spec = {"namespace": "d", "name": "w1", "isolation": "soft",
+            "qos": "medium",
+            "devices": [{"chip_id": "", "duty_percent": 50.0,
+                         "hbm_bytes": 1 << 30}],
+            "command": [sys.executable, "-c",
+                        "import time; time.sleep(30)"]}
+    (state / "d__w1.worker.json").write_text(json.dumps(spec))
+
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    from conftest import REPO_ROOT
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    for k in list(env):
+        if k.startswith("TPF_MOCK_"):   # the 8-chip assert needs defaults
+            env.pop(k)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tensorfusion_tpu.hypervisor",
+         "--provider", str(native_build / "libtpf_provider_mock.so"),
+         "--limiter", str(native_build / "libtpf_limiter.so"),
+         "--shm-base", str(tmp_path / "shm"),
+         "--state-dir", str(state),
+         "--snapshot-dir", str(tmp_path / "snap"),
+         "--port", str(port)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+        cwd=str(REPO_ROOT))
+    try:
+        deadline = time.time() + 30
+        worker = devices = None
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/api/v1/devices",
+                        timeout=2) as r:
+                    devices = json.loads(r.read())
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/api/v1/workers",
+                        timeout=2) as r:
+                    ws = json.loads(r.read())
+                if ws:
+                    worker = ws[0]
+                    break
+            except Exception:  # noqa: BLE001 - booting
+                pass
+            time.sleep(0.3)
+        assert devices is not None and len(devices) == 8
+        assert worker is not None, "daemon never adopted the worker"
+        wenv = worker["status"]["env"]
+        assert constants.ENV_SHM_PATH in wenv
+        assert wenv.get(constants.ENV_DEVICE_MOUNTS, "").startswith(
+            "/dev/accel")
+        assert constants.ENV_LIMITER_LIB in wenv
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            proc.kill()
