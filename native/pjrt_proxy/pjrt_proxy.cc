@@ -86,6 +86,7 @@ struct ProxyState {
 
   pthread_mutex_t mu = PTHREAD_MUTEX_INITIALIZER;
   std::unordered_map<PJRT_LoadedExecutable*, ExecInfo> exec_cost;
+  std::unordered_map<PJRT_LoadedExecutable*, uint32_t> exec_info_fails;
   std::unordered_map<PJRT_Buffer*, uint64_t> buffer_bytes;
 };
 
@@ -169,11 +170,19 @@ ProxyState::ExecInfo exec_info_locked(PJRT_LoadedExecutable* loaded) {
       }
     } else {
       destroy_error(err);
-      /* transient vendor failure: DON'T cache the fallback, or this
-       * executable's outputs would go un-charged forever */
-      return info;
+      /* Transient vendor failure: don't cache the fallback yet (that
+       * would leave this executable's outputs un-charged forever) —
+       * but a *persistently* failing query must not cost a vendor
+       * round-trip under the mutex on every launch, so cache the
+       * fallback after a few consecutive failures. */
+      uint32_t fails = ++g_state.exec_info_fails[loaded];
+      if (fails < 3) return info;
+      logmsg("executable metadata query failing persistently; "
+             "caching flat-rate fallback");
+      g_state.exec_info_fails.erase(loaded);
     }
   }
+  g_state.exec_info_fails.erase(loaded);
   g_state.exec_cost.emplace(loaded, info);
   return info;
 }
@@ -268,6 +277,7 @@ PJRT_Error* proxy_executable_destroy(
     // for a different executable, and the map must not grow unboundedly
     pthread_mutex_lock(&g_state.mu);
     g_state.exec_cost.erase(args->executable);
+    g_state.exec_info_fails.erase(args->executable);
     pthread_mutex_unlock(&g_state.mu);
   }
   return g_state.real->PJRT_LoadedExecutable_Destroy(args);

@@ -268,11 +268,19 @@ def test_drain_marks_expire_after_ttl():
         tnode = op.store.get(TPUNode, node2)
         assert tnode.metadata.labels.get(constants.LABEL_DEFRAG_SOURCE)
 
-        # TTL (0.5s) lapses -> exclusions + source label cleared by the
-        # compaction controller's expiry pass.  Drive reconcile() directly
-        # in the poll so the check depends on the TTL, not on how the
-        # background resync cadence interleaves with machine load.
-        time.sleep(0.6)
+        # TTL lapses -> exclusions + source label cleared by the
+        # compaction controller's expiry pass.  Backdate the SINCE stamps
+        # (instead of sleeping past a real TTL) and drive reconcile()
+        # directly, so the check is independent of wall-clock timing,
+        # tracing overhead, and resync cadence.
+        cur = op.store.get(Pod, "roamer", "default")
+        cur.metadata.annotations[constants.ANN_DEFRAG_EVICTED_SINCE] = \
+            str(time.time() - 3600)
+        op.store.update(cur)
+        tnode = op.store.get(TPUNode, node2)
+        tnode.metadata.annotations[constants.ANN_DEFRAG_SOURCE_SINCE] = \
+            str(time.time() - 3600)
+        op.store.update(tnode)
         deadline = time.time() + 20
         cleared = False
         while time.time() < deadline:

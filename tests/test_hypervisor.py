@@ -611,6 +611,7 @@ def test_hypervisor_daemon_boot_smoke(native_build, tmp_path):
     for k in list(env):
         if k.startswith("TPF_MOCK_"):   # the 8-chip assert needs defaults
             env.pop(k)
+    daemon_log = tmp_path / "daemon.log"
     proc = subprocess.Popen(
         [sys.executable, "-m", "tensorfusion_tpu.hypervisor",
          "--provider", str(native_build / "libtpf_provider_mock.so"),
@@ -619,7 +620,7 @@ def test_hypervisor_daemon_boot_smoke(native_build, tmp_path):
          "--state-dir", str(state),
          "--snapshot-dir", str(tmp_path / "snap"),
          "--port", str(port)],
-        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+        env=env, stdout=open(daemon_log, "w"), stderr=subprocess.STDOUT,
         cwd=str(REPO_ROOT))
     try:
         deadline = time.time() + 30
@@ -640,8 +641,12 @@ def test_hypervisor_daemon_boot_smoke(native_build, tmp_path):
             except Exception:  # noqa: BLE001 - booting
                 pass
             time.sleep(0.3)
-        assert devices is not None and len(devices) == 8
-        assert worker is not None, "daemon never adopted the worker"
+        tail = daemon_log.read_text()[-2000:] if daemon_log.exists() \
+            else "<no log>"
+        assert devices is not None and len(devices) == 8, \
+            f"daemon never served devices; log tail:\n{tail}"
+        assert worker is not None, \
+            f"daemon never adopted the worker; log tail:\n{tail}"
         wenv = worker["status"]["env"]
         assert constants.ENV_SHM_PATH in wenv
         assert wenv.get(constants.ENV_DEVICE_MOUNTS, "").startswith(

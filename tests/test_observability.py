@@ -261,3 +261,29 @@ def test_cron_and_external_recommenders():
             ResourceAmount(tflops=40.0)) is None
     finally:
         srv.shutdown()
+
+
+def test_autoscaler_feeds_from_tsdb_series():
+    """The production metrics path: worker duty/hbm series land in the
+    TSDB (as the vector-shipping analog tails them in) and the autoscaler
+    pass converts them into percentile observations and a resize —
+    covering _feed_observations, which the direct-observe tests skip."""
+    op = _operator_with_host()
+    try:
+        _submit(op, "tsdb-wl", 20.0, 2 * 2**30, autoscale=True)
+        tsdb = TSDB()
+        now = time.time()
+        for i in range(50):
+            # worker tag starts with the workload name (worker pod naming)
+            tsdb.insert("tpf_worker",
+                        {"namespace": "default", "worker": "tsdb-wl"},
+                        {"duty_cycle_pct": 20.0},   # 20% of 197 ~ 39.4TF
+                        ts=now - 50 + i)
+        scaler = AutoScaler(op, tsdb)
+        adjusted = scaler.run_once()
+        assert adjusted == 1
+        rec = op.allocator.allocation("default/tsdb-wl")
+        # p90(39.4) * 1.15 margin ~ 45, clamped to <= 2x current (40)
+        assert 30.0 <= rec.request.request.tflops <= 41.0
+    finally:
+        op.stop()
