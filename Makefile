@@ -7,8 +7,9 @@
 
 PY := env -u PALLAS_AXON_POOL_IPS python
 
-.PHONY: all native test test-native check-coverage asan tsan bench \
-	bench-tpu sched-bench webhook-bench dryrun clean
+.PHONY: all native test test-native verify-all check-coverage asan \
+	tsan bench bench-tpu sched-bench webhook-bench remoting-bench \
+	dryrun clean
 
 all: native
 
@@ -17,6 +18,14 @@ native:
 
 test: native
 	$(PY) -m pytest tests/ -x -q
+
+# Everything CI cares about, one entry point: native selftests +
+# conformance (mock AND real provider over the fake PJRT plugin) plus
+# the python suite under the coverage gate (check-coverage already runs
+# the full suite — listing `test` too would run it twice, concurrently
+# under -j, colliding on TCP ports).
+verify-all: test-native check-coverage
+	@echo "verify-all: OK"
 
 test-native:
 	$(MAKE) -C native test

@@ -179,7 +179,6 @@ ProxyState::ExecInfo exec_info_locked(PJRT_LoadedExecutable* loaded) {
       if (fails < 3) return info;
       logmsg("executable metadata query failing persistently; "
              "caching flat-rate fallback");
-      g_state.exec_info_fails.erase(loaded);
     }
   }
   g_state.exec_info_fails.erase(loaded);

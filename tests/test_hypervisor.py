@@ -612,6 +612,7 @@ def test_hypervisor_daemon_boot_smoke(native_build, tmp_path):
         if k.startswith("TPF_MOCK_"):   # the 8-chip assert needs defaults
             env.pop(k)
     daemon_log = tmp_path / "daemon.log"
+    log_f = open(daemon_log, "w")
     proc = subprocess.Popen(
         [sys.executable, "-m", "tensorfusion_tpu.hypervisor",
          "--provider", str(native_build / "libtpf_provider_mock.so"),
@@ -620,7 +621,7 @@ def test_hypervisor_daemon_boot_smoke(native_build, tmp_path):
          "--state-dir", str(state),
          "--snapshot-dir", str(tmp_path / "snap"),
          "--port", str(port)],
-        env=env, stdout=open(daemon_log, "w"), stderr=subprocess.STDOUT,
+        env=env, stdout=log_f, stderr=subprocess.STDOUT,
         cwd=str(REPO_ROOT))
     try:
         deadline = time.time() + 30
@@ -658,3 +659,4 @@ def test_hypervisor_daemon_boot_smoke(native_build, tmp_path):
             proc.wait(timeout=5)
         except subprocess.TimeoutExpired:
             proc.kill()
+        log_f.close()
