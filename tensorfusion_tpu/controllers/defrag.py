@@ -307,8 +307,10 @@ class CompactionController(Controller):
     def _drain_gang(self, group_key: str, node: str, now: str) -> int:
         """Atomically drain one gang off `node`: all members cluster-wide
         are probed for simultaneous re-placement (drained node excluded);
-        on success every member is evicted, otherwise none.  Returns
-        #evicted."""
+        on success every member is evicted, otherwise none.  Returns the
+        number evicted *from this node* (the gang-wide eviction itself is
+        deliberate — atomicity — but the caller's per-node counter must
+        not absorb other nodes' members)."""
         members = [p for p in self.store.list(Pod)
                    if p.spec.node_name
                    and (gang_info_from_pod(p) or (None,))[0] == group_key]
@@ -332,7 +334,7 @@ class CompactionController(Controller):
             return 0
         for p in members:
             self._evict_for_defrag(p, node, now)
-        return len(members)
+        return sum(1 for p in members if p.spec.node_name == node)
 
     def _evict_for_defrag(self, pod: Pod, node: str, now: str) -> None:
         log.info("defrag: evicting %s from %s", pod.key(), node)

@@ -10,13 +10,17 @@ worker's container must see.  TPU flavor: the paths are accel device nodes
 ``/dev/nvidia*``; partitioned workers can get per-core device nodes from
 their grant instead of the whole-chip node (``partitioned_only`` rules).
 
-Predicates are simple Python expressions evaluated against a frozen,
-builtins-free context — same expressive role as the reference's CEL
-without introducing a dependency.
+Predicates are restricted boolean expressions evaluated by a small
+AST-whitelist interpreter — same expressive, *side-effect-free* role as
+the reference's CEL without introducing a dependency.  General Python
+(``eval``) is deliberately not used: a ProviderConfig author must not be
+able to reach attribute chains, calls, or unbounded arithmetic from a
+mount rule.
 """
 
 from __future__ import annotations
 
+import ast
 import logging
 from typing import Dict, Iterable, List, Sequence
 
@@ -51,10 +55,62 @@ class DeviceMountPolicy:
     # -- evaluation -------------------------------------------------------
 
     @staticmethod
+    def _eval_node(node: ast.AST, ctx: Dict[str, object]):
+        if isinstance(node, ast.Expression):
+            return DeviceMountPolicy._eval_node(node.body, ctx)
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, (bool, int, float, str, type(None))):
+                return node.value
+            raise ValueError(f"constant {node.value!r} not allowed")
+        if isinstance(node, ast.Name):
+            if node.id not in ctx:
+                raise ValueError(f"unknown name {node.id!r}")
+            return ctx[node.id]
+        if isinstance(node, ast.BoolOp):
+            if isinstance(node.op, ast.And):
+                return all(DeviceMountPolicy._eval_node(v, ctx)
+                           for v in node.values)
+            return any(DeviceMountPolicy._eval_node(v, ctx)
+                       for v in node.values)
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+            return not DeviceMountPolicy._eval_node(node.operand, ctx)
+        if isinstance(node, ast.Compare):
+            left = DeviceMountPolicy._eval_node(node.left, ctx)
+            for op, comp in zip(node.ops, node.comparators):
+                right = DeviceMountPolicy._eval_node(comp, ctx)
+                if isinstance(op, ast.Eq):
+                    ok = left == right
+                elif isinstance(op, ast.NotEq):
+                    ok = left != right
+                elif isinstance(op, ast.Lt):
+                    ok = left < right
+                elif isinstance(op, ast.LtE):
+                    ok = left <= right
+                elif isinstance(op, ast.Gt):
+                    ok = left > right
+                elif isinstance(op, ast.GtE):
+                    ok = left >= right
+                elif isinstance(op, ast.In):
+                    ok = left in right
+                elif isinstance(op, ast.NotIn):
+                    ok = left not in right
+                else:
+                    raise ValueError(
+                        f"operator {type(op).__name__} not allowed")
+                if not ok:
+                    return False
+                left = right
+            return True
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return tuple(DeviceMountPolicy._eval_node(e, ctx)
+                         for e in node.elts)
+        raise ValueError(f"syntax {type(node).__name__} not allowed")
+
+    @staticmethod
     def _eval(expression: str, ctx: Dict[str, object]) -> bool:
         try:
-            return bool(eval(expression,  # noqa: S307 - builtins removed
-                             {"__builtins__": {}}, dict(ctx)))
+            tree = ast.parse(expression, mode="eval")
+            return bool(DeviceMountPolicy._eval_node(tree, dict(ctx)))
         except Exception as e:  # noqa: BLE001 - a bad rule must not
             log.warning("mount rule %r failed to evaluate: %s",
                         expression, e)

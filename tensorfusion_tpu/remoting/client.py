@@ -135,6 +135,16 @@ class RemoteDevice:
                 except OSError:
                     pass
                 self._sock = None
+            # The reader thread's reconnect guard (`self._sock is not
+            # sock`) makes it exit without touching _pending once the
+            # socket is swapped out, so close() itself must fail any
+            # in-flight requests — otherwise their callers block the
+            # full timeout_s instead of seeing a prompt ConnectionError.
+            with self._state_lock:
+                pending, self._pending = self._pending, {}
+            for fut in pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError("device closed"))
 
     def _submit(self, kind: str, meta: Dict[str, Any], buffers,
                 compress: bool = True) -> Future:

@@ -52,6 +52,13 @@ COMPRESS_PROBE_BYTES = 4 << 10
 _DTYPES = {"float32", "float64", "float16", "bfloat16", "int8", "int16",
            "int32", "int64", "uint8", "uint16", "uint32", "uint64", "bool"}
 
+#: hard ceilings a peer's header cannot exceed — the framing layer must be
+#: safe *before* the worker's HELLO auth gate runs, so sizes are bounded
+#: here rather than trusted from the wire (a huge ``nbytes``/``hlen`` or a
+#: zlib bomb would otherwise allocate arbitrary memory pre-auth)
+MAX_HEADER_BYTES = 4 << 20
+MAX_BUFFER_BYTES = 8 << 30
+
 
 def _dtype_of(arr: np.ndarray) -> str:
     name = arr.dtype.name
@@ -76,6 +83,12 @@ def encode_message(kind: str, meta: Dict[str, Any],
     for arr in buffers:
         arr = np.ascontiguousarray(arr)
         raw = arr.tobytes()
+        if len(raw) > MAX_BUFFER_BYTES:
+            # fail fast sender-side: past this point the receiver would
+            # abort mid-stream and desync the whole pipelined connection
+            raise ValueError(
+                f"buffer of {len(raw)} bytes exceeds the "
+                f"{MAX_BUFFER_BYTES}-byte wire cap")
         enc = "raw"
         wire = raw
         if compress and len(raw) >= COMPRESS_MIN_BYTES:
@@ -118,13 +131,28 @@ def recv_message(sock: socket.socket
     version, hlen = struct.unpack("<II", head[4:])
     if version != VERSION:
         raise ValueError(f"protocol version {version} != {VERSION}")
+    if hlen > MAX_HEADER_BYTES:
+        raise ValueError(f"header of {hlen} bytes exceeds cap")
     header = json.loads(_read_exact(sock, hlen))
     buffers = []
     for desc in header["buffers"]:
-        raw = _read_exact(sock, desc["nbytes"])
+        nbytes, raw_nbytes = desc["nbytes"], desc.get("raw_nbytes")
+        if nbytes > MAX_BUFFER_BYTES or (raw_nbytes or 0) > MAX_BUFFER_BYTES:
+            raise ValueError("buffer exceeds size cap")
+        raw = _read_exact(sock, nbytes)
         if desc.get("enc") == "zlib":
-            raw = zlib.decompress(raw)
-            if len(raw) != desc.get("raw_nbytes", len(raw)):
+            # raw_nbytes must be a positive bound: zlib's max_length=0
+            # means *unlimited*, so 0 (or a missing/negative value) would
+            # turn the bounded decompression below into a bomb vector
+            if not raw_nbytes or raw_nbytes < 0:
+                raise ValueError("compressed buffer without a positive "
+                                 "raw_nbytes")
+            # bounded decompression: never inflate past the declared size,
+            # and reject trailing compressed data (zlib-bomb defence)
+            d = zlib.decompressobj()
+            raw = d.decompress(raw, raw_nbytes)
+            if len(raw) != raw_nbytes or d.decompress(b"", 1) or \
+                    d.unconsumed_tail:
                 raise ValueError("decompressed size mismatch")
         arr = np.frombuffer(raw, dtype=_np_dtype(desc["dtype"]))
         buffers.append(arr.reshape(desc["shape"]))

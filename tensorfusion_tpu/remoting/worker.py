@@ -71,7 +71,16 @@ class RemoteVTPUWorker:
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
-                authed = not outer.token
+                # The HELLO exchange runs synchronously *before* the
+                # read-ahead thread exists: an unauthenticated peer never
+                # gets pipelined frame decoding (protocol.py additionally
+                # caps header/buffer sizes so even the single pre-auth
+                # frame is bounded).
+                try:
+                    if outer.token and not self._hello():
+                        return
+                except (ConnectionError, OSError, ValueError):
+                    return
                 # Read-ahead: decode the next pipelined request while the
                 # current one computes, so inbound wire time overlaps
                 # device time.  (A symmetric write-behind thread was tried
@@ -106,18 +115,10 @@ class RemoteVTPUWorker:
                                          compress=compress)
 
                         if kind == "HELLO":
-                            offered = str(meta.get("token", ""))
-                            if outer.token and not hmac.compare_digest(
-                                    offered, outer.token):
-                                reply("ERROR", {"error": "bad token"}, [])
-                                return   # close the connection
-                            authed = True
+                            # repeated HELLO on an authed connection is a
+                            # no-op ack (clients retry it on reconnect)
                             reply("HELLO_OK", {"version": 2}, [])
                             continue
-                        if not authed:
-                            reply("ERROR",
-                                  {"error": "authentication required"}, [])
-                            return
                         try:
                             outer._dispatch(reply, kind, meta, buffers)
                         except Exception as e:  # noqa: BLE001
@@ -125,6 +126,26 @@ class RemoteVTPUWorker:
                             reply("ERROR", {"error": str(e)}, [])
                 except (ConnectionError, OSError):
                     pass
+
+            def _hello(self) -> bool:
+                """First frame must be a HELLO with the right token."""
+                kind, meta, _ = recv_message(self.request)
+                seq = meta.get("seq")
+
+                def reply(rkind, rmeta):
+                    if seq is not None:
+                        rmeta = dict(rmeta, seq=seq)
+                    send_message(self.request, rkind, rmeta, [])
+
+                if kind != "HELLO":
+                    reply("ERROR", {"error": "authentication required"})
+                    return False
+                if not hmac.compare_digest(str(meta.get("token", "")),
+                                           outer.token):
+                    reply("ERROR", {"error": "bad token"})
+                    return False
+                reply("HELLO_OK", {"version": 2})
+                return True
 
         class Server(socketserver.ThreadingTCPServer):
             daemon_threads = True

@@ -22,6 +22,7 @@ every extension point of the framework:
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -118,9 +119,13 @@ class TPUResourcesFit(PreEnqueuePlugin, PreFilterPlugin, FilterPlugin,
         self.evict = evict or (lambda pod: None)
         # preemptor pod key -> (node, priority, request, expiry); consulted
         # by Filter so another pod can't steal a freshly-preempted node
-        # (nominated-pod double-booking check, gpuresources.go:377-575)
+        # (nominated-pod double-booking check, gpuresources.go:377-575).
+        # unreserve runs on non-scheduler threads (Permit timeout, gang
+        # reject), so all access is lock-guarded and in-place — replacing
+        # the dict could drop a reservation re-inserted concurrently.
         self._nominations: Dict[str, Tuple[str, int, AllocRequest,
                                            float]] = {}
+        self._nominations_lock = threading.Lock()
 
     # -- PreEnqueue -------------------------------------------------------
 
@@ -179,11 +184,13 @@ class TPUResourcesFit(PreEnqueuePlugin, PreFilterPlugin, FilterPlugin,
         if not self._nominations:
             return OK   # hot path: preemption is rare, Filter is not
         now = time.monotonic()
-        self._nominations = {k: v for k, v in self._nominations.items()
-                             if v[3] > now}
-        blockers = [v[2] for k, v in self._nominations.items()
-                    if v[0] == node and k != pod.key()
-                    and v[1] >= pod.spec.priority]
+        with self._nominations_lock:
+            for k in [k for k, v in self._nominations.items()
+                      if v[3] <= now]:
+                del self._nominations[k]
+            blockers = [v[2] for k, v in self._nominations.items()
+                        if v[0] == node and k != pod.key()
+                        and v[1] >= pod.spec.priority]
         if not blockers:
             return OK
         if self.allocator.dry_run_fit(req, node, virtual_holds=blockers):
@@ -228,9 +235,10 @@ class TPUResourcesFit(PreEnqueuePlugin, PreFilterPlugin, FilterPlugin,
             log.info("preempting %s on %s for %s", v.key(), best_node,
                      pod.key())
             self.evict(v)
-        self._nominations[pod.key()] = (
-            best_node, pod.spec.priority, req,
-            time.monotonic() + NOMINATION_TTL_S)
+        with self._nominations_lock:
+            self._nominations[pod.key()] = (
+                best_node, pod.spec.priority, req,
+                time.monotonic() + NOMINATION_TTL_S)
         return best_node
 
     def _victims_on_node(self, req: AllocRequest, pod: Pod,
@@ -307,7 +315,8 @@ class TPUResourcesFit(PreEnqueuePlugin, PreFilterPlugin, FilterPlugin,
         # it on top of the assumed hold.  Unreserve restores it — a
         # Permit timeout or PreBind failure must not leave the freshly
         # freed node up for grabs.
-        nom = self._nominations.pop(pod.key(), None)
+        with self._nominations_lock:
+            nom = self._nominations.pop(pod.key(), None)
         if nom is not None:
             state[STATE_NOMINATION] = nom
         return OK
@@ -319,7 +328,8 @@ class TPUResourcesFit(PreEnqueuePlugin, PreFilterPlugin, FilterPlugin,
             state.pop(STATE_ASSUMED, None)
         nom = state.pop(STATE_NOMINATION, None)
         if nom is not None and nom[3] > time.monotonic():
-            self._nominations[pod.key()] = nom
+            with self._nominations_lock:
+                self._nominations[pod.key()] = nom
 
     # -- Permit -----------------------------------------------------------
 

@@ -196,6 +196,34 @@ def test_device_mount_policy_rules():
     assert policy3.mounts_for(soft, [b]) == []
 
 
+def test_device_mount_policy_rejects_general_python():
+    """The predicate language is a restricted AST whitelist, not eval():
+    attribute chains, calls, subscripts, f-strings, and unbounded
+    arithmetic (10**10**10 would hang the allocation path) must all be
+    rejected — a ProviderConfig author cannot run code in the
+    hypervisor.  CEL-parity hardening (device_mount_policy.go)."""
+    from tensorfusion_tpu.hypervisor.mounts import DeviceMountPolicy
+
+    ctx = {"partitioned": False, "qos": "high", "chip_count": 2,
+           "isolation": "soft"}
+    hostile = [
+        "().__class__.__mro__[1].__subclasses__()",   # classic escape
+        "qos.__class__",                                # attribute access
+        "(lambda: 1)()",                                # call
+        "10**10**10",                                   # DoS arithmetic
+        "[x for x in (1,)]",                            # comprehension
+        "__import__('os')",                             # import
+        "chip_count + 1 > 2",                           # arithmetic op
+    ]
+    for expr in hostile:
+        assert DeviceMountPolicy._eval(expr, ctx) is False, expr
+    # ... while the supported predicate grammar still works
+    assert DeviceMountPolicy._eval("not partitioned", ctx)
+    assert DeviceMountPolicy._eval("qos == 'high' and chip_count >= 2", ctx)
+    assert DeviceMountPolicy._eval("qos in ('high', 'critical')", ctx)
+    assert DeviceMountPolicy._eval("1 < chip_count <= 2", ctx)
+
+
 def test_allocation_env_carries_mounts_and_spill(stack):
     devices_ctrl, alloc, workers, limiter = stack
     entry = devices_ctrl.devices()[0]

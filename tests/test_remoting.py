@@ -149,6 +149,103 @@ def test_remote_auth_token_required():
         w.stop()
 
 
+def test_preauth_framing_is_bounded():
+    """An unauthenticated peer must not be able to make the worker
+    allocate arbitrary memory: oversized headers, oversized declared
+    buffers, and zlib bombs are rejected at the framing layer, and the
+    HELLO gate runs before any pipelined read-ahead."""
+    import socket
+    import struct
+    import zlib
+
+    from tensorfusion_tpu.remoting import protocol
+
+    w = RemoteVTPUWorker(token="s3cret")
+    w.start()
+    try:
+        host, port = "127.0.0.1", w.port
+
+        def raw_conn():
+            return socket.create_connection((host, port), timeout=10)
+
+        # non-HELLO first frame on an authed worker: rejected, closed
+        s = raw_conn()
+        protocol.send_message(s, "INFO", {"seq": 1}, [])
+        kind, meta, _ = protocol.recv_message(s)
+        assert kind == "ERROR" and "authentication" in meta["error"]
+        s.close()
+
+        # header length beyond MAX_HEADER_BYTES: connection dropped
+        # without the worker trying to read/allocate it
+        s = raw_conn()
+        s.sendall(protocol.MAGIC +
+                  struct.pack("<II", protocol.VERSION,
+                              protocol.MAX_HEADER_BYTES + 1))
+        s.sendall(b"x" * 64)
+        s.shutdown(socket.SHUT_WR)
+        assert s.recv(1) == b""   # peer closed, no reply
+        s.close()
+
+        # zlib bomb: tiny wire bytes declaring a huge raw size is capped
+        # by MAX_BUFFER_BYTES; a lying raw_nbytes is caught by bounded
+        # decompression
+        bomb = zlib.compress(b"\0" * (1 << 20), 9)
+        import json as _json
+        # raw_nbytes=4 (lying small) and raw_nbytes=0 (zlib max_length=0
+        # means *unlimited* — must not reach decompress) both die
+        for raw_nbytes in (4, 0):
+            hdr = {"kind": "PUT", "meta": {},
+                   "buffers": [{"shape": [1 << 20], "dtype": "uint8",
+                                "nbytes": len(bomb),
+                                "raw_nbytes": raw_nbytes,
+                                "enc": "zlib"}]}
+            blob = _json.dumps(hdr).encode()
+            s = raw_conn()
+            s.sendall(protocol.MAGIC +
+                      struct.pack("<II", protocol.VERSION, len(blob)) +
+                      blob + bomb)
+            s.shutdown(socket.SHUT_WR)
+            assert s.recv(1) == b""
+            s.close()
+
+        # sender-side cap: an oversized tensor fails fast with a clear
+        # error instead of desyncing the pipelined connection mid-stream
+        cap, protocol.MAX_BUFFER_BYTES = protocol.MAX_BUFFER_BYTES, 1024
+        try:
+            with pytest.raises(ValueError, match="wire cap"):
+                protocol.encode_message(
+                    "PUT", {}, [np.zeros(2048, np.uint8)])
+        finally:
+            protocol.MAX_BUFFER_BYTES = cap
+    finally:
+        w.stop()
+
+
+def test_close_fails_pending_futures(worker):
+    """close() with requests in flight resolves their futures with
+    ConnectionError promptly instead of letting callers block the full
+    request timeout."""
+    dev = RemoteDevice(worker.url, timeout_s=60)
+    assert dev.info()["platform"] == "cpu"   # establish the connection
+    # a request the worker will never answer quickly: compile a fresh
+    # executable, then close before collecting the result
+    import concurrent.futures
+
+    futs = [dev._submit("INFO", {}, []) for _ in range(4)]
+    dev.close()
+    t0 = time.monotonic()
+    failures = 0
+    for f in futs:
+        try:
+            f.result(timeout=5)
+        except (ConnectionError, concurrent.futures.CancelledError):
+            failures += 1
+        except Exception:
+            pass   # a response that raced the close is fine too
+    assert time.monotonic() - t0 < 5
+    assert failures >= 1 or all(f.done() for f in futs)
+
+
 def test_remote_pipelined_submit(worker):
     """Many EXECUTEs in flight on one connection; results arrive in
     order via futures without per-call round-trip blocking."""
