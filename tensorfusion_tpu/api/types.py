@@ -673,7 +673,31 @@ class ProviderConfig(Resource):
     spec: ProviderConfigSpec = field(default_factory=ProviderConfigSpec)
 
 
+@dataclass
+class LeaseSpec:
+    """Distributed-lease record (coordination.k8s.io/Lease analog) used
+    for cross-host leader election through the store gateway
+    (cmd/main.go:785-812 leader-info ConfigMap parity).  The fencing
+    token increments on every leadership transition, so downstream
+    writers can reject actions from a deposed leader that doesn't yet
+    know it lost."""
+
+    holder: str = ""
+    holder_url: str = ""          # leader endpoint followers redirect to
+    lease_duration_s: float = 10.0
+    renew_time: float = 0.0       # holder's wall clock at last renewal
+    fencing_token: int = 0
+    transitions: int = 0
+
+
+@dataclass
+class Lease(Resource):
+    KIND = "Lease"
+    NAMESPACED = False
+    spec: LeaseSpec = field(default_factory=LeaseSpec)
+
+
 ALL_KINDS = [TPUCluster, TPUPool, TPUChip, TPUNode, TPUNodeClass,
              TPUNodeClaim, TPUWorkload, TPUConnection, WorkloadProfile,
              SchedulingConfigTemplate, TPUResourceQuota, ProviderConfig,
-             Pod, Node]
+             Pod, Node, Lease]

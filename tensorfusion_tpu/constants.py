@@ -211,6 +211,8 @@ ENV_VTPU_ENABLED = "TPF_VTPU"                  # "1" auto-activates metering
 ENV_PROVIDER_LIB = "TPF_PROVIDER_LIB"
 ENV_LIMITER_LIB = "TPF_LIMITER_LIB"
 ENV_SHM_BASE = "TPF_SHM_BASE"
+ENV_POOL_NAME = "TPF_POOL"                     # pool the node agent joins
+ENV_STORE_TOKEN = "TPF_STORE_TOKEN"            # store-gateway shared token
 ENV_GO_TESTING = "TPF_TESTING"                 # test-mode toggles
 
 DEFAULT_SHM_BASE = "/run/tpu-fusion/shm"

@@ -1,0 +1,204 @@
+"""Store gateway: the control plane's state API over HTTP.
+
+The reference platform is inherently distributed because every component
+talks to the Kubernetes apiserver: node hypervisors register devices and
+watch pods through it (``pkg/hypervisor/backend/kubernetes/
+kubernetes_backend.go:302-447``, ``pod_cache.go``), and operator replicas
+elect a leader through it (``cmd/main.go:785-812``).  tpu-fusion is
+self-hosted, so this module plays the apiserver's role: it exposes the
+in-process :class:`~tensorfusion_tpu.store.ObjectStore` as REST +
+long-poll-watch endpoints that remote hypervisors (and standby operators)
+consume via :class:`~tensorfusion_tpu.remote_store.RemoteStore`.
+
+Endpoints (mounted under the operator API, or standalone):
+
+- ``GET    /api/v1/store/objects?kind=&name=&namespace=``   one object
+- ``GET    /api/v1/store/list?kind=[&namespace=]``          list a kind
+- ``POST   /api/v1/store/objects``  body ``{"obj": {...}}`` create (409 on
+  exists)
+- ``PUT    /api/v1/store/objects``  body ``{"obj": {...},
+  "check_version": bool, "upsert": bool}``  update / update-or-create
+  (404 missing, 409 version conflict)
+- ``DELETE /api/v1/store/objects?kind=&name=&namespace=``   delete
+- ``GET    /api/v1/store/watch?since_rv=N[&kinds=a,b][&wait_s=S]``
+  long-poll event window.  ``since_rv=0`` replays the current state as
+  ADDED events; a client behind the bounded event log gets
+  ``{"reset": true}`` (410-Gone semantics) and must re-list.
+
+Auth: optional shared token (``X-TPF-Token`` header, constant-time
+compare) — chip inventory and pod placement are cluster control state, so
+cross-host deployments should set one (mirrors the webhook/apiserver TLS
+trust the reference inherits from Kubernetes).
+"""
+
+from __future__ import annotations
+
+import hmac
+import logging
+from typing import Dict, Optional, Type
+
+from .api.meta import Resource, from_dict
+from .api.types import ALL_KINDS
+from .store import (AlreadyExistsError, ConflictError, NotFoundError,
+                    ObjectStore)
+
+log = logging.getLogger("tpf.gateway")
+
+KIND_BY_NAME: Dict[str, Type[Resource]] = {c.KIND: c for c in ALL_KINDS}
+
+#: cap on one long-poll wait; clients re-issue (keeps worker threads from
+#: pinning forever on dead connections)
+MAX_WATCH_WAIT_S = 30.0
+
+
+class StoreGateway:
+    """HTTP-facing façade over an ObjectStore.
+
+    Framework-neutral: the host server (OperatorServer, or the follower
+    redirector) calls :meth:`handle` with the parsed request pieces and
+    sends whatever (code, payload) comes back.
+    """
+
+    def __init__(self, store: ObjectStore, token: str = ""):
+        self.store = store
+        self.token = token
+        store.enable_event_log()   # remote watchers exist from now on
+
+    # -- helpers -----------------------------------------------------------
+
+    def authorized(self, headers) -> bool:
+        if not self.token:
+            return True
+        offered = headers.get("X-TPF-Token", "")
+        return hmac.compare_digest(offered, self.token)
+
+    @staticmethod
+    def _cls(kind: str) -> Optional[Type[Resource]]:
+        return KIND_BY_NAME.get(kind)
+
+    @staticmethod
+    def _obj_from_body(body: dict) -> Resource:
+        data = dict(body.get("obj") or {})
+        kind = data.pop("kind", "")
+        cls = KIND_BY_NAME.get(kind)
+        if cls is None:
+            raise ValueError(f"unknown kind {kind!r}")
+        return from_dict(cls, data)
+
+    # -- dispatch ----------------------------------------------------------
+
+    def handle(self, method: str, path: str, qs: Dict[str, list],
+               body: dict, headers) -> Optional[tuple]:
+        """Returns (status_code, payload) for store paths, None for
+        paths this gateway does not own."""
+        if not path.startswith("/api/v1/store/"):
+            return None
+        if not self.authorized(headers):
+            return 401, {"error": "missing or bad X-TPF-Token"}
+        sub = path[len("/api/v1/store/"):]
+        try:
+            if sub == "objects":
+                if method == "GET":
+                    return self._get_object(qs)
+                if method == "POST":
+                    return self._create(body)
+                if method == "PUT":
+                    return self._update(body)
+                if method == "DELETE":
+                    return self._delete(qs)
+            elif sub == "list" and method == "GET":
+                return self._list(qs)
+            elif sub == "watch" and method == "GET":
+                return self._watch(qs)
+            return 404, {"error": f"no store route {method} {path}"}
+        except ValueError as e:
+            return 400, {"error": str(e)}
+
+    # -- handlers ----------------------------------------------------------
+
+    @staticmethod
+    def _name_args(qs) -> tuple:
+        kind = qs.get("kind", [""])[0]
+        name = qs.get("name", [""])[0]
+        namespace = qs.get("namespace", [""])[0]
+        if not kind or not name:
+            raise ValueError("kind and name are required")
+        return kind, name, namespace
+
+    def _get_object(self, qs) -> tuple:
+        kind, name, namespace = self._name_args(qs)
+        cls = self._cls(kind)
+        if cls is None:
+            return 400, {"error": f"unknown kind {kind!r}"}
+        obj = self.store.try_get(cls, name, namespace)
+        if obj is None:
+            return 404, {"error": f"{kind} {namespace}/{name} not found"}
+        return 200, {"obj": obj.to_dict()}
+
+    def _list(self, qs) -> tuple:
+        kind = qs.get("kind", [""])[0]
+        cls = self._cls(kind)
+        if cls is None:
+            return 400, {"error": f"unknown kind {kind!r}"}
+        namespace = qs.get("namespace", [None])[0]
+        items = self.store.list(cls, namespace=namespace)
+        return 200, {"items": [o.to_dict() for o in items],
+                     "rv": self.store.current_rv}
+
+    def _create(self, body) -> tuple:
+        obj = self._obj_from_body(body)
+        try:
+            created = self.store.create(obj)
+        except AlreadyExistsError as e:
+            return 409, {"error": str(e), "reason": "exists"}
+        return 201, {"obj": created.to_dict()}
+
+    def _update(self, body) -> tuple:
+        obj = self._obj_from_body(body)
+        try:
+            if body.get("upsert"):
+                updated = self.store.update_or_create(obj)
+            else:
+                updated = self.store.update(
+                    obj, check_version=bool(body.get("check_version")))
+        except NotFoundError as e:
+            return 404, {"error": str(e)}
+        except ConflictError as e:
+            return 409, {"error": str(e), "reason": "conflict"}
+        return 200, {"obj": updated.to_dict()}
+
+    def _delete(self, qs) -> tuple:
+        kind, name, namespace = self._name_args(qs)
+        cls = self._cls(kind)
+        if cls is None:
+            return 400, {"error": f"unknown kind {kind!r}"}
+        try:
+            self.store.delete(cls, name, namespace)
+        except NotFoundError as e:
+            return 404, {"error": str(e)}
+        return 200, {"deleted": True}
+
+    def _watch(self, qs) -> tuple:
+        since_rv = int(qs.get("since_rv", ["0"])[0])
+        kinds = [k for k in qs.get("kinds", [""])[0].split(",") if k]
+        wait_s = min(float(qs.get("wait_s", ["0"])[0]), MAX_WATCH_WAIT_S)
+        # a client's *first* request (primed=0) establishes its window:
+        # with replay it gets the current state as ADDED events, without
+        # it just the current rv — either way it then long-polls with
+        # primed=1 from that rv (this distinguishes "start me up" from
+        # "events since rv 0", which matter apart when the store is empty)
+        if qs.get("primed", ["0"])[0] not in ("1", "true"):
+            if qs.get("replay", ["1"])[0] in ("0", "false"):
+                return 200, {"rv": self.store.current_rv, "reset": False,
+                             "events": []}
+            rv, snapshot = self.store.snapshot_events(kinds)
+            return 200, {"rv": rv, "reset": False,
+                         "events": [{"type": etype, "kind": kind,
+                                     "obj": obj}
+                                    for etype, kind, obj in snapshot]}
+        rv, events, reset = self.store.events_since(since_rv, kinds,
+                                                    wait_s=wait_s)
+        return 200, {"rv": rv, "reset": reset,
+                     "events": [{"type": etype, "kind": kind, "rv": erv,
+                                 "obj": obj}
+                                for etype, kind, erv, obj in events]}

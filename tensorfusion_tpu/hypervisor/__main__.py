@@ -3,10 +3,23 @@
 Analog of the reference's ``cmd/hypervisor/main.go:46``: load the provider,
 start device + worker controllers and the HTTP server, serve until killed.
 
+Two backends, mirroring the reference's kubernetes vs single_node split
+(``cmd/hypervisor/main.go:94-118``):
+
+- default: the file-state ``SingleNodeBackend`` (VM/bare-metal worker
+  spawner);
+- with ``--operator-url``: the ``ControlPlaneBackend`` over a
+  :class:`~tensorfusion_tpu.remote_store.RemoteStore` — the node agent
+  joins a *remote* operator over TCP, publishes its chips through the
+  store gateway, and watches for pods bound to this node
+  (kubernetes_backend.go:302-447 analog).
+
     python -m tensorfusion_tpu.hypervisor \
         --provider native/build/libtpf_provider_mock.so \
         --limiter  native/build/libtpf_limiter.so \
-        --shm-base /tmp/tpf-shm --state-dir /tmp/tpf-state --port 8000
+        --shm-base /tmp/tpf-shm --state-dir /tmp/tpf-state --port 8000 \
+        [--operator-url http://operator:8080 --node-name tpu-host-0 \
+         --pool pool-a [--store-token SECRET]]
 """
 
 from __future__ import annotations
@@ -28,7 +41,7 @@ from .single_node import SingleNodeBackend
 from .worker import WorkerController
 
 
-def main(argv=None) -> int:
+def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(prog="tpf-hypervisor")
     ap.add_argument("--provider",
                     default=os.environ.get(constants.ENV_PROVIDER_LIB,
@@ -45,8 +58,26 @@ def main(argv=None) -> int:
                     default=constants.DEFAULT_HYPERVISOR_PORT)
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--tick-ms", type=int, default=100)
+    # networked control plane (kubernetes-backend analog)
+    ap.add_argument("--operator-url",
+                    default=os.environ.get(constants.ENV_OPERATOR_URL, ""),
+                    help="join a remote operator's store gateway instead "
+                         "of running standalone")
+    ap.add_argument("--node-name",
+                    default=os.environ.get(constants.ENV_NODE_NAME, "")
+                    or os.uname().nodename)
+    ap.add_argument("--pool",
+                    default=os.environ.get(constants.ENV_POOL_NAME, ""))
+    ap.add_argument("--store-token",
+                    default=os.environ.get(constants.ENV_STORE_TOKEN, ""))
+    ap.add_argument("--port-file", default="",
+                    help="write the bound API port here (for --port 0)")
     ap.add_argument("-v", "--verbose", action="store_true")
-    args = ap.parse_args(argv)
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
 
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
@@ -63,21 +94,49 @@ def main(argv=None) -> int:
     allocator = AllocationController(devices)
     workers = WorkerController(devices, allocator, limiter, args.shm_base,
                                tick_interval_s=args.tick_ms / 1000.0)
-    backend = SingleNodeBackend(args.state_dir)
 
-    def on_added(spec):
-        tracked = workers.add_worker(spec)
-        backend.set_worker_env(spec.key, tracked.status.env)
-
-    backend.start(on_added, workers.remove_worker)
-    workers.start()
-
-    server = HypervisorServer(devices, workers, backend=backend,
+    # the HTTP server starts before the backend so the node registration
+    # can carry a live hypervisor URL
+    server = HypervisorServer(devices, workers,
                               snapshot_dir=args.snapshot_dir,
                               host=args.host, port=args.port)
+
+    if args.operator_url:
+        from ..remote_store import RemoteStore
+        from .control_plane import ControlPlaneBackend
+
+        store = RemoteStore(args.operator_url, token=args.store_token)
+        backend = ControlPlaneBackend(
+            store, devices, node_name=args.node_name, pool=args.pool,
+            hypervisor_url="", vendor="mock-tpu",
+            known_pids=workers.all_pids)
+
+        def on_added(spec):
+            workers.add_worker(spec)
+
+        on_removed = workers.remove_worker
+    else:
+        backend = SingleNodeBackend(args.state_dir)
+
+        def on_added(spec):
+            tracked = workers.add_worker(spec)
+            backend.set_worker_env(spec.key, tracked.status.env)
+
+        on_removed = workers.remove_worker
+
     server.start()
-    log.info("hypervisor serving on %s (%d chips)", server.url,
-             len(devices.devices()))
+    if args.port_file:
+        with open(args.port_file, "w") as f:
+            f.write(str(server.port))
+    if args.operator_url:
+        backend.hypervisor_url = server.url
+    server.backend = backend
+    backend.start(on_added, on_removed)
+    workers.start()
+    log.info("hypervisor serving on %s (%d chips)%s", server.url,
+             len(devices.devices()),
+             f", joined operator {args.operator_url}"
+             if args.operator_url else "")
 
     stop = False
 

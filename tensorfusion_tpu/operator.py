@@ -179,6 +179,9 @@ class Operator:
         self._started = True
 
     def _start_components(self) -> None:
+        if self._components_started:
+            return
+        self._stop.clear()   # re-promotion after a demote restarts loops
         # restart recovery before serving: chips first (the watch replay is
         # async), then rebuild allocator + quota state from persisted pods
         # (reconcileAllocationState analog)
@@ -228,18 +231,42 @@ class Operator:
         self._stop.set()
         if self.elector is not None:
             self.elector.stop()
-        if self._components_started:
-            if self.config_watcher is not None:
-                self.config_watcher.stop()
-            for component in (self.alerts, self.autoscaler, self.metrics):
-                if component is not None:
-                    component.stop()
-            self.scheduler.stop()
-            self.manager.stop()
-            if self._sync_thread:
-                self._sync_thread.join(timeout=2)
-            self._components_started = False
+        self._stop_components()
         self._started = False
+
+    def _stop_components(self) -> None:
+        """Quiesce the leader-only machinery (also fired on *demotion* —
+        a replica that loses the store lease must stop scheduling and
+        reconciling immediately, then may be re-promoted later)."""
+        if not self._components_started:
+            return
+        self._stop.set()
+        if self.config_watcher is not None:
+            self.config_watcher.stop()
+        for component in (self.alerts, self.autoscaler, self.metrics):
+            if component is not None:
+                component.stop()
+        self.scheduler.stop()
+        self.manager.stop()
+        if self._sync_thread:
+            self._sync_thread.join(timeout=2)
+        self._components_started = False
+
+    # -- leadership (HA) ----------------------------------------------------
+
+    def is_leader(self) -> bool:
+        return self.elector is None or self.elector.is_leader
+
+    def leader_endpoint(self) -> str:
+        """The current leader's client-API URL (for follower redirect)."""
+        if self.elector is None:
+            return ""
+        info = None
+        if hasattr(self.elector, "leader_info"):          # store lease
+            info = self.elector.leader_info()
+        elif hasattr(self.elector, "lock_path"):          # fcntl file
+            info = self.elector.read_leader_info(self.elector.lock_path)
+        return (info or {}).get("endpoint", "") or ""
 
     def _sync_loop(self) -> None:
         """Background maintenance: dirty chip flush + assumed-TTL sweep
@@ -328,6 +355,7 @@ def main(argv=None) -> int:
             [--persist-dir DIR] [--bootstrap-host v5e:8]
     """
     import argparse
+    import os
     import signal
 
     from .api.types import TPUNodeClaim, TPUPool
@@ -338,12 +366,28 @@ def main(argv=None) -> int:
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--persist-dir", default="",
                     help="JSONL persistence dir (enables restart recovery)")
+    ap.add_argument("--store-url", default="",
+                    help="HA mode: use a remote state store "
+                         "(python -m tensorfusion_tpu.statestore) instead "
+                         "of an in-process store; replicas elect a leader "
+                         "through a Lease there")
+    ap.add_argument("--identity", default="",
+                    help="replica identity for leader election "
+                         "(default hostname-pid)")
+    ap.add_argument("--lease-duration-s", type=float, default=10.0)
+    ap.add_argument("--renew-interval-s", type=float, default=2.0)
     ap.add_argument("--pool", default="pool-a")
     ap.add_argument("--metrics-path", default="",
                     help="write influx-line metrics to this file")
     ap.add_argument("--bootstrap-host", default="",
                     help="GEN:CHIPS — provision one simulated host at boot "
                          "(e.g. v5e:8)")
+    ap.add_argument("--store-token",
+                    default=os.environ.get(constants.ENV_STORE_TOKEN, ""),
+                    help="shared token remote hypervisors must present "
+                         "to the store gateway")
+    ap.add_argument("--port-file", default="",
+                    help="write the bound API port here (for --port 0)")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
 
@@ -351,18 +395,38 @@ def main(argv=None) -> int:
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(levelname).1s %(name)s %(message)s")
 
-    store = ObjectStore(persist_dir=args.persist_dir or None)
-    if args.persist_dir:
-        from .api.types import ALL_KINDS
-        n = store.load(ALL_KINDS)
-        if n:
-            log.info("loaded %d persisted objects", n)
+    if args.store_url:
+        from .remote_store import RemoteStore
+
+        store = RemoteStore(args.store_url, token=args.store_token)
+    else:
+        store = ObjectStore(persist_dir=args.persist_dir or None)
+        if args.persist_dir:
+            from .api.types import ALL_KINDS
+            n = store.load(ALL_KINDS)
+            if n:
+                log.info("loaded %d persisted objects", n)
 
     op = Operator(store=store, metrics_path=args.metrics_path)
-    if store.try_get(TPUPool, args.pool) is None:
-        pool = TPUPool.new(args.pool)
-        pool.spec.name = args.pool
-        store.create(pool)
+    # bootstrap the pool: ride out a state store that is still coming up
+    # (transport errors retry; a concurrent replica winning the create is
+    # success, not failure)
+    from .store import AlreadyExistsError, ConflictError
+    deadline = time.time() + 60
+    while True:
+        try:
+            if store.try_get(TPUPool, args.pool) is None:
+                pool = TPUPool.new(args.pool)
+                pool.spec.name = args.pool
+                store.create(pool)
+            break
+        except (AlreadyExistsError, ConflictError):
+            break
+        except Exception as e:  # noqa: BLE001 - transport error
+            if time.time() > deadline:
+                raise
+            log.warning("pool bootstrap retrying: %s", e)
+            time.sleep(1.0)
     if args.bootstrap_host:
         gen, _, chips = args.bootstrap_host.partition(":")
         claim = TPUNodeClaim.new(f"bootstrap-{gen}")
@@ -373,10 +437,29 @@ def main(argv=None) -> int:
             store.create(claim)
         except Exception:
             pass
+    server = OperatorServer(op, host=args.host, port=args.port,
+                            store_token=args.store_token)
+    if args.store_url:
+        # HA replica: campaign for the store lease; only the winner runs
+        # controllers + scheduler, losers serve redirects until promoted
+        from .utils.leader import StoreLeaderElector
+
+        op.elector = StoreLeaderElector(
+            store,
+            identity=args.identity
+            or f"{os.uname().nodename}-{os.getpid()}",
+            endpoint=server.url,
+            lease_duration_s=args.lease_duration_s,
+            renew_interval_s=args.renew_interval_s,
+            on_started_leading=op._start_components,
+            on_stopped_leading=op._stop_components)
     op.start()
-    server = OperatorServer(op, host=args.host, port=args.port)
     server.start()
-    log.info("operator API serving on %s", server.url)
+    if args.port_file:
+        with open(args.port_file, "w") as f:
+            f.write(str(server.port))
+    log.info("operator API serving on %s%s", server.url,
+             " (HA candidate)" if args.store_url else "")
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())

@@ -1,0 +1,286 @@
+"""RemoteStore: ObjectStore client API over the store gateway.
+
+The node-agent half of the networked control plane: where the reference's
+hypervisor talks to the Kubernetes apiserver through client-go informers
+(``kubernetes_backend.go:302-447``, ``pod_cache.go``), a tpu-fusion
+hypervisor on another host builds a :class:`RemoteStore` against the
+operator's URL and hands it to ``ControlPlaneBackend`` — which cannot
+tell it apart from the in-process store: the same ``create / get /
+try_get / update / update_or_create / delete / list / watch`` surface,
+the same ``NotFoundError`` / ``ConflictError`` / ``AlreadyExistsError``
+exceptions, and the same replay-then-events watch semantics (backed here
+by a long-poll thread per watch instead of in-process queues).
+
+Wire-level notes:
+
+- every request retries transient transport errors with backoff — node
+  agents must ride out operator restarts/failovers (the informer
+  re-list/re-watch behavior);
+- a watch that falls behind the gateway's bounded event log receives
+  ``reset: true`` and transparently re-replays the current state as
+  ADDED events (client-side informers do exactly this on 410 Gone);
+- optional shared token goes in ``X-TPF-Token``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Callable, Iterable, List, Optional, Type
+
+from .api.meta import Resource, from_dict
+from .gateway import KIND_BY_NAME
+from .store import (AlreadyExistsError, ConflictError, DELETED, Event,
+                    NotFoundError)
+
+log = logging.getLogger("tpf.remote_store")
+
+#: long-poll wait per watch request (server caps at MAX_WATCH_WAIT_S)
+WATCH_POLL_S = 20.0
+#: transport-error retry backoff schedule (seconds); the last entry
+#: repeats — a dead operator is retried forever at that cadence
+RETRY_BACKOFF_S = (0.1, 0.3, 1.0, 3.0)
+
+
+class RemoteStoreError(Exception):
+    """Transport-level failure after retries were exhausted."""
+
+
+class RemoteWatch:
+    """Watch-compatible event stream fed by a long-poll thread."""
+
+    def __init__(self, store: "RemoteStore", kinds: Iterable[str],
+                 replay: bool = True):
+        self._store = store
+        self.kinds = set(kinds)
+        self.queue: "queue.Queue[Optional[Event]]" = queue.Queue()
+        self._closed = threading.Event()
+        self._rv = 0
+        self._replay = replay
+        self._primed = False
+        # kind -> key -> last seen object; lets a reset re-replay emit
+        # synthetic DELETED events for objects removed while this watcher
+        # was partitioned (the informer re-list diff)
+        self._known: dict = {}
+        self._thread = threading.Thread(
+            target=self._loop, name="tpf-remote-watch", daemon=True)
+        self._thread.start()
+
+    # Watch interface ------------------------------------------------------
+
+    def stop(self) -> None:
+        if not self._closed.is_set():
+            self._closed.set()
+            self.queue.put(None)
+
+    def __iter__(self):
+        while True:
+            ev = self.queue.get()
+            if ev is None:
+                return
+            yield ev
+
+    def get(self, timeout: Optional[float] = None) -> Optional[Event]:
+        try:
+            return self.queue.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    # polling --------------------------------------------------------------
+
+    def _loop(self) -> None:
+        backoff = 0
+        while not self._closed.is_set():
+            try:
+                payload = self._store._request(
+                    "GET", "/api/v1/store/watch",
+                    query={"since_rv": str(self._rv),
+                           "kinds": ",".join(sorted(self.kinds)),
+                           "replay": "1" if self._replay else "0",
+                           "primed": "1" if self._primed else "0",
+                           "wait_s": str(WATCH_POLL_S)},
+                    # one retry inside _request; sustained failure handled
+                    # by this loop's own backoff so stop() stays prompt
+                    max_tries=1)
+                backoff = 0
+            except (RemoteStoreError, OSError):
+                delay = RETRY_BACKOFF_S[min(backoff,
+                                            len(RETRY_BACKOFF_S) - 1)]
+                backoff += 1
+                self._closed.wait(delay)
+                continue
+            if self._closed.is_set():
+                return
+            if payload.get("reset"):
+                # fell behind the bounded event log: re-replay current
+                # state (informer 410-Gone re-list).  Consumers see
+                # duplicate ADDEDs for objects they already know — the
+                # same contract in-process replay watches have — plus
+                # synthetic DELETEDs for objects that vanished meanwhile
+                # (diffed against self._known below).
+                self._rv = 0
+                self._replay = True
+                self._primed = False
+                continue
+            is_replay = not self._primed and self._replay
+            decoded = []
+            for ev in payload.get("events", []):
+                cls = KIND_BY_NAME.get(ev.get("kind", ""))
+                if cls is None:
+                    continue
+                data = dict(ev["obj"])
+                data.pop("kind", None)
+                decoded.append((ev["type"], from_dict(cls, data)))
+            if is_replay:
+                snapshot_keys = {(o.KIND, o.key()) for _, o in decoded}
+                for kind, bucket in self._known.items():
+                    for key, obj in list(bucket.items()):
+                        if (kind, key) not in snapshot_keys:
+                            del bucket[key]
+                            self.queue.put(Event(DELETED, obj))
+            for etype, obj in decoded:
+                bucket = self._known.setdefault(obj.KIND, {})
+                if etype == DELETED:
+                    bucket.pop(obj.key(), None)
+                else:
+                    bucket[obj.key()] = obj
+                self.queue.put(Event(etype, obj))
+            self._rv = int(payload.get("rv", self._rv))
+            self._primed = True
+
+
+class RemoteStore:
+    def __init__(self, base_url: str, token: str = "",
+                 timeout_s: float = 60.0):
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        self.timeout_s = timeout_s
+
+    # -- transport ---------------------------------------------------------
+
+    def _request(self, method: str, path: str, query: Optional[dict] = None,
+                 body: Optional[dict] = None, max_tries: int = 0) -> dict:
+        url = self.base_url + path
+        if query:
+            url += "?" + urllib.parse.urlencode(query)
+        data = json.dumps(body).encode() if body is not None else None
+        tries = 0
+        while True:
+            req = urllib.request.Request(url, data=data, method=method)
+            req.add_header("Content-Type", "application/json")
+            if self.token:
+                req.add_header("X-TPF-Token", self.token)
+            try:
+                with urllib.request.urlopen(req,
+                                            timeout=self.timeout_s) as r:
+                    return json.loads(r.read() or b"{}")
+            except urllib.error.HTTPError as e:
+                payload = {}
+                try:
+                    payload = json.loads(e.read() or b"{}")
+                except Exception:  # noqa: BLE001
+                    pass
+                self._raise_api_error(e.code, payload)
+            except (urllib.error.URLError, OSError, TimeoutError) as e:
+                if tries >= max_tries:
+                    raise RemoteStoreError(
+                        f"{method} {url}: {e}") from e
+                delay = RETRY_BACKOFF_S[min(tries,
+                                            len(RETRY_BACKOFF_S) - 1)]
+                tries += 1
+                time.sleep(delay)
+
+    @staticmethod
+    def _raise_api_error(code: int, payload: dict):
+        msg = payload.get("error", f"HTTP {code}")
+        if code == 404:
+            raise NotFoundError(msg)
+        if code == 409:
+            if payload.get("reason") == "exists":
+                raise AlreadyExistsError(msg)
+            raise ConflictError(msg)
+        if code == 401:
+            raise PermissionError(msg)
+        raise RemoteStoreError(msg)
+
+    @staticmethod
+    def _decode(data: dict) -> Resource:
+        kind = data.get("kind", "")
+        cls = KIND_BY_NAME.get(kind)
+        if cls is None:
+            raise ValueError(f"unknown kind {kind!r} from gateway")
+        d = dict(data)
+        d.pop("kind", None)
+        return from_dict(cls, d)
+
+    # -- ObjectStore surface ----------------------------------------------
+
+    def create(self, obj: Resource) -> Resource:
+        out = self._request("POST", "/api/v1/store/objects",
+                            body={"obj": obj.to_dict()}, max_tries=3)
+        return self._decode(out["obj"])
+
+    def get(self, cls: Type[Resource], name: str,
+            namespace: str = "") -> Resource:
+        out = self._request("GET", "/api/v1/store/objects",
+                            query={"kind": cls.KIND, "name": name,
+                                   "namespace": namespace}, max_tries=3)
+        return self._decode(out["obj"])
+
+    def try_get(self, cls: Type[Resource], name: str,
+                namespace: str = "") -> Optional[Resource]:
+        try:
+            return self.get(cls, name, namespace)
+        except NotFoundError:
+            return None
+
+    def update(self, obj: Resource, check_version: bool = False) -> Resource:
+        out = self._request("PUT", "/api/v1/store/objects",
+                            body={"obj": obj.to_dict(),
+                                  "check_version": check_version},
+                            max_tries=3)
+        return self._decode(out["obj"])
+
+    def update_or_create(self, obj: Resource) -> Resource:
+        out = self._request("PUT", "/api/v1/store/objects",
+                            body={"obj": obj.to_dict(), "upsert": True},
+                            max_tries=3)
+        return self._decode(out["obj"])
+
+    def delete(self, cls: Type[Resource], name: str,
+               namespace: str = "") -> None:
+        self._request("DELETE", "/api/v1/store/objects",
+                      query={"kind": cls.KIND, "name": name,
+                             "namespace": namespace}, max_tries=3)
+
+    def list(self, cls: Type[Resource], namespace: Optional[str] = None,
+             selector: Optional[Callable[[Resource], bool]] = None
+             ) -> List[Resource]:
+        query = {"kind": cls.KIND}
+        if namespace is not None:
+            query["namespace"] = namespace
+        out = self._request("GET", "/api/v1/store/list", query=query,
+                            max_tries=3)
+        items = [self._decode(d) for d in out.get("items", [])]
+        if selector is not None:
+            items = [o for o in items if selector(o)]
+        return items
+
+    def watch(self, *kinds: str, replay: bool = True) -> RemoteWatch:
+        return RemoteWatch(self, kinds, replay=replay)
+
+    # -- liveness ----------------------------------------------------------
+
+    def ping(self, timeout_s: float = 5.0) -> bool:
+        try:
+            with urllib.request.urlopen(self.base_url + "/healthz",
+                                        timeout=timeout_s) as r:
+                return r.status == 200
+        except Exception:  # noqa: BLE001
+            return False

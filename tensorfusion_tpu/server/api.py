@@ -10,7 +10,10 @@ Analog of the reference's gin server (``internal/server/``,
 - ``GET  /allocator-info``    — chip inventory + allocations snapshot;
 - ``POST /api/submit-pod``    — admission entry (webhook analog over HTTP);
 - ``POST /api/simulate-schedule`` — dry-run with per-chip filter details
-  (gpuallocator.go:255-262 simulate path, explain=True).
+  (gpuallocator.go:255-262 simulate path, explain=True);
+- ``/api/v1/store/*``         — the store gateway (apiserver analog):
+  remote hypervisors register chips and watch pods through these
+  endpoints (see ``tensorfusion_tpu/gateway.py``).
 """
 
 from __future__ import annotations
@@ -26,10 +29,18 @@ from urllib.parse import parse_qs, urlparse
 
 from ..api.meta import from_dict
 from ..api.types import Pod, TPUConnection
+from ..gateway import StoreGateway
 from ..scheduler.tpuresources import compose_alloc_request
+from ..store import ObjectStore
 from ..webhook.parser import ParseError
 
 log = logging.getLogger("tpf.server")
+
+#: client-API paths only the leader may serve (followers answer with a
+#: 307 to the leaseholder — the reference forwards assign-host-port /
+#: assign-index to the leader IP from the leader-info ConfigMap)
+LEADER_ONLY_PATHS = ("/assign-host-port", "/assign-index",
+                     "/api/submit-pod", "/api/simulate-schedule")
 
 
 def _jsonable(obj):
@@ -43,8 +54,14 @@ def _jsonable(obj):
 
 
 class OperatorServer:
-    def __init__(self, operator, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, operator, host: str = "127.0.0.1", port: int = 0,
+                 store_token: str = ""):
         self.operator = operator
+        # the gateway serves only when this process owns the
+        # authoritative store; HA replicas run against a RemoteStore and
+        # point hypervisors at the standalone state store instead
+        self.gateway = StoreGateway(operator.store, token=store_token) \
+            if isinstance(operator.store, ObjectStore) else None
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -63,8 +80,26 @@ class OperatorServer:
                 n = int(self.headers.get("Content-Length", 0))
                 return json.loads(self.rfile.read(n)) if n else {}
 
+            def _gateway(self, method):
+                """Store-gateway paths short-circuit here; returns True
+                when the request was handled."""
+                url = urlparse(self.path)
+                if outer.gateway is None or \
+                        not url.path.startswith("/api/v1/store/"):
+                    return False
+                body = self._body() if method in ("POST", "PUT") else {}
+                result = outer.gateway.handle(method, url.path,
+                                              parse_qs(url.query), body,
+                                              self.headers)
+                if result is None:
+                    return False
+                self._send(*result)
+                return True
+
             def do_GET(self):
                 try:
+                    if self._gateway("GET"):
+                        return
                     outer._get(self)
                 except Exception as e:  # noqa: BLE001
                     log.exception("GET %s", self.path)
@@ -72,11 +107,51 @@ class OperatorServer:
 
             def do_POST(self):
                 try:
+                    if self._gateway("POST"):
+                        return
+                    if self._follower_redirect():
+                        return
                     outer._post(self)
                 except ParseError as e:
                     self._send(400, {"error": str(e)})
                 except Exception as e:  # noqa: BLE001
                     log.exception("POST %s", self.path)
+                    self._send(500, {"error": str(e)})
+
+            def _follower_redirect(self):
+                """Leader-only APIs on a non-leading HA replica: 307 to
+                the leaseholder (or 503 while no leader is known)."""
+                url = urlparse(self.path)
+                if url.path not in LEADER_ONLY_PATHS or \
+                        outer.operator.is_leader():
+                    return False
+                leader = outer.operator.leader_endpoint()
+                # a just-demoted replica may still be named by the lease;
+                # redirecting to ourselves would loop the client — 503
+                # until the lease reflects a real leader
+                if leader and leader != outer.url:
+                    self.send_response(307)
+                    self.send_header("Location", leader + self.path)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                else:
+                    self._send(503, {"error": "no operator leader yet"})
+                return True
+
+            def do_PUT(self):
+                try:
+                    if not self._gateway("PUT"):
+                        self._send(404, {"error": "not found"})
+                except Exception as e:  # noqa: BLE001
+                    log.exception("PUT %s", self.path)
+                    self._send(500, {"error": str(e)})
+
+            def do_DELETE(self):
+                try:
+                    if not self._gateway("DELETE"):
+                        self._send(404, {"error": "not found"})
+                except Exception as e:  # noqa: BLE001
+                    log.exception("DELETE %s", self.path)
                     self._send(500, {"error": str(e)})
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)

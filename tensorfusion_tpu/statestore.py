@@ -1,0 +1,160 @@
+"""Standalone state-store daemon: the platform's apiserver.
+
+For HA deployments the store must outlive any single operator replica —
+the reference delegates that to the Kubernetes apiserver/etcd; tpu-fusion
+ships its own: this daemon hosts the authoritative
+:class:`~tensorfusion_tpu.store.ObjectStore` (optionally persisted)
+behind the store gateway.  Operator replicas run with ``--store-url``
+pointing here, elect a leader through a ``Lease`` object
+(:class:`~tensorfusion_tpu.utils.leader.StoreLeaderElector`), and node
+hypervisors join with ``--operator-url`` set to this daemon's URL (chip
+registration and pod watches go straight to the state store; only
+client-facing APIs like /connection need the operator).
+
+    python -m tensorfusion_tpu.statestore --port 2379 \
+        [--persist-dir DIR] [--token SECRET] [--port-file F]
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from .gateway import StoreGateway
+from .store import ObjectStore
+
+log = logging.getLogger("tpf.statestore")
+
+
+class StateStoreServer:
+    """Thin HTTP host for a StoreGateway (healthz + store routes only)."""
+
+    def __init__(self, store: ObjectStore, host: str = "127.0.0.1",
+                 port: int = 0, token: str = ""):
+        self.store = store
+        self.gateway = StoreGateway(store, token=token)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                log.debug(fmt, *args)
+
+            def _send(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _handle(self, method):
+                url = urlparse(self.path)
+                if url.path == "/healthz":
+                    self._send(200, {"ok": True})
+                    return
+                body = {}
+                if method in ("POST", "PUT"):
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n)) if n else {}
+                result = outer.gateway.handle(method, url.path,
+                                              parse_qs(url.query), body,
+                                              self.headers)
+                if result is None:
+                    self._send(404, {"error": "not found"})
+                else:
+                    self._send(*result)
+
+            def do_GET(self):
+                self._guard("GET")
+
+            def do_POST(self):
+                self._guard("POST")
+
+            def do_PUT(self):
+                self._guard("PUT")
+
+            def do_DELETE(self):
+                self._guard("DELETE")
+
+            def _guard(self, method):
+                try:
+                    self._handle(method)
+                except Exception as e:  # noqa: BLE001
+                    log.exception("%s %s", method, self.path)
+                    try:
+                        self._send(500, {"error": str(e)})
+                    except Exception:  # noqa: BLE001 - peer gone
+                        pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="tpf-statestore", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def main(argv=None) -> int:
+    import argparse
+    import os
+    import signal
+
+    from . import constants
+    from .api.types import ALL_KINDS
+
+    ap = argparse.ArgumentParser(prog="tpf-statestore")
+    ap.add_argument("--port", type=int, default=2379)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--persist-dir", default="")
+    ap.add_argument("--token",
+                    default=os.environ.get(constants.ENV_STORE_TOKEN, ""))
+    ap.add_argument("--port-file", default="")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s %(message)s")
+
+    store = ObjectStore(persist_dir=args.persist_dir or None)
+    if args.persist_dir:
+        n = store.load(ALL_KINDS)
+        if n:
+            log.info("loaded %d persisted objects", n)
+    server = StateStoreServer(store, host=args.host, port=args.port,
+                              token=args.token)
+    server.start()
+    if args.port_file:
+        with open(args.port_file, "w") as f:
+            f.write(str(server.port))
+    log.info("state store serving on %s", server.url)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    try:
+        while not stop.wait(0.5):
+            pass
+    finally:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

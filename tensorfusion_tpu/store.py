@@ -15,18 +15,24 @@ gpuallocator.go:2592).
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import queue
 import threading
 from dataclasses import dataclass
-from typing import Callable, Dict, Iterable, List, Optional, Type
+from typing import Callable, Dict, Iterable, List, Optional, Tuple, Type
 
 from .api.meta import Resource, from_dict
 
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
+
+#: bounded history backing remote long-poll watches; at control-plane
+#: event rates (binds, status writebacks) this covers hours of history —
+#: a client further behind than this gets a ``reset`` and re-lists
+EVENT_LOG_SIZE = 65536
 
 
 class ConflictError(Exception):
@@ -79,9 +85,16 @@ class Watch:
 class ObjectStore:
     def __init__(self, persist_dir: Optional[str] = None):
         self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
         self._objects: Dict[str, Dict[str, Resource]] = {}   # kind -> key -> obj
         self._watches: List[Watch] = []
         self._rv = 0
+        # (rv, etype, kind, obj_dict) ring for remote long-poll watches
+        # (the resourceVersion-windowed watch the k8s apiserver gives the
+        # reference's informers)
+        self._event_log: "collections.deque[Tuple[int, str, str, dict]]" = \
+            collections.deque(maxlen=EVENT_LOG_SIZE)
+        self._log_enabled = False
         self._persist_dir = persist_dir
         if persist_dir:
             os.makedirs(persist_dir, exist_ok=True)
@@ -91,10 +104,18 @@ class ObjectStore:
     def _bucket(self, kind: str) -> Dict[str, Resource]:
         return self._objects.setdefault(kind, {})
 
-    def _emit(self, etype: str, obj: Resource) -> None:
+    def _emit(self, etype: str, obj: Resource, rv: Optional[int] = None
+              ) -> None:
         for w in list(self._watches):
             if not w.kinds or obj.KIND in w.kinds:
                 w.queue.put(Event(etype, obj.deepcopy()))
+        # the event log only costs anything once a remote consumer exists
+        # (gateway attach / first events_since); single-process
+        # deployments skip the per-write to_dict + ring append entirely
+        if self._log_enabled:
+            self._event_log.append((self._rv if rv is None else rv, etype,
+                                    obj.KIND, obj.to_dict()))
+            self._cond.notify_all()
 
     def _remove_watch(self, w: Watch) -> None:
         with self._lock:
@@ -193,6 +214,9 @@ class ObjectStore:
             if key not in bucket:
                 raise NotFoundError(f"{cls.KIND} {key} not found")
             obj = bucket.pop(key)
+            # deletions advance the store version too: a remote watcher's
+            # "events since rv" window must include them
+            self._rv += 1
             self._emit(DELETED, obj)
             self._persist(cls.KIND)
 
@@ -224,6 +248,76 @@ class ObjectStore:
                         w.queue.put(Event(ADDED, obj.deepcopy()))
             self._watches.append(w)
             return w
+
+    # -- remote watch window (store-gateway backing) ----------------------
+
+    @property
+    def current_rv(self) -> int:
+        with self._lock:
+            return self._rv
+
+    def enable_event_log(self) -> None:
+        """Start recording events for remote watchers (gateway attach).
+        Events before this point are not in the log, so a watcher asking
+        for an older window gets reset=True and re-lists."""
+        with self._lock:
+            self._log_enabled = True
+
+    def snapshot_events(self, kinds: Iterable[str] = ()
+                        ) -> Tuple[int, List[Tuple[str, str, dict]]]:
+        """(current_rv, ADDED-event tuples for every current object of the
+        given kinds) — the replay a fresh remote watcher starts from."""
+        kinds = set(kinds)
+        with self._lock:
+            self._log_enabled = True   # a remote watcher just appeared
+            out = []
+            for kind, bucket in self._objects.items():
+                if kinds and kind not in kinds:
+                    continue
+                for obj in bucket.values():
+                    out.append((ADDED, kind, obj.to_dict()))
+            return self._rv, out
+
+    def events_since(self, since_rv: int, kinds: Iterable[str] = (),
+                     wait_s: float = 0.0
+                     ) -> Tuple[int, List[Tuple[str, str, str, dict]], bool]:
+        """Events with rv > since_rv for the given kinds, blocking up to
+        ``wait_s`` when none are pending (long-poll).  Returns
+        (current_rv, [(etype, kind, rv, obj_dict)...], reset): ``reset``
+        is True when ``since_rv`` pre-dates the bounded event log — the
+        caller must re-list (HTTP 410 Gone semantics)."""
+        kinds = set(kinds)
+        import time as _time
+        deadline = _time.monotonic() + max(0.0, wait_s)
+        with self._cond:
+            self._log_enabled = True
+            while True:
+                if since_rv > self._rv:
+                    # the watcher is ahead of us: this store restarted
+                    # with older state — the client must re-list, not be
+                    # silently clamped into missing the gap
+                    return self._rv, [], True
+                # every rv bump is logged, so the window is complete iff
+                # it starts at/after the oldest logged event minus one
+                oldest = self._event_log[0][0] if self._event_log \
+                    else self._rv + 1
+                if since_rv < oldest - 1:
+                    return self._rv, [], True
+                # rv-ordered deque: walk the new suffix from the tail
+                # instead of rescanning all of history on every wakeup
+                matched = []
+                for rv, etype, kind, obj in reversed(self._event_log):
+                    if rv <= since_rv:
+                        break
+                    if not kinds or kind in kinds:
+                        matched.append((etype, kind, rv, obj))
+                if matched:
+                    matched.reverse()
+                    return self._rv, matched, False
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    return self._rv, [], False
+                self._cond.wait(timeout=min(remaining, 1.0))
 
     # -- persistence ------------------------------------------------------
 

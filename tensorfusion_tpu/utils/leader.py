@@ -141,3 +141,187 @@ class LeaderElector:
                 return json.load(f)
         except (OSError, json.JSONDecodeError):
             return None
+
+
+class StoreLeaderElector:
+    """Cross-host leader election through a Lease object in the (shared)
+    store — fcntl locks only elect within one machine; operator replicas
+    on different hosts race on optimistic-concurrency updates of a single
+    ``Lease`` instead, exactly how the reference's replicas elect through
+    a coordination Lease in the apiserver (``cmd/main.go:785-812``).
+
+    Protocol per tick:
+
+    - the holder renews ``renew_time`` with ``check_version=True``; a
+      ``ConflictError`` means someone else wrote the lease — leadership
+      is considered lost and ``on_stopped_leading`` fires;
+    - a challenger acquires iff the lease is absent or stale
+      (``now - renew_time > lease_duration_s``), again version-checked so
+      exactly one concurrent challenger wins; acquisition increments the
+      **fencing token**, which every store write by leader-only
+      controllers can carry to be rejected if a deposed leader acts on
+      a stale view.
+
+    Clock note: staleness compares the challenger's clock against the
+    holder's written wall clock — same tolerance class as Kubernetes
+    leases (bounded skew assumed, durations ≫ skew).
+    """
+
+    LEASE_NAME = "operator-leader"
+
+    def __init__(self, store, identity: str, endpoint: str = "",
+                 lease_duration_s: float = 10.0,
+                 renew_interval_s: float = 2.0,
+                 on_started_leading: Optional[Callable[[], None]] = None,
+                 on_stopped_leading: Optional[Callable[[], None]] = None):
+        self.store = store
+        self.identity = identity
+        self.endpoint = endpoint
+        self.lease_duration_s = lease_duration_s
+        self.renew_interval_s = renew_interval_s
+        self.on_started_leading = on_started_leading or (lambda: None)
+        self.on_stopped_leading = on_stopped_leading or (lambda: None)
+        self.is_leader = False
+        self.fencing_token = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._campaign,
+                                        name="tpf-store-leader",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        if self.is_leader:
+            self._resign()
+
+    def wait_for_leadership(self, timeout_s: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline and not self._stop.is_set():
+            if self.is_leader:
+                return True
+            time.sleep(0.02)
+        return self.is_leader
+
+    def leader_info(self) -> Optional[dict]:
+        """Current lease view (followers use holder_url to redirect)."""
+        from ..api.types import Lease
+
+        try:
+            lease = self.store.try_get(Lease, self.LEASE_NAME)
+        except Exception:  # noqa: BLE001 - transport error = unknown
+            return None
+        if lease is None:
+            return None
+        return {"identity": lease.spec.holder,
+                "endpoint": lease.spec.holder_url,
+                "fencing_token": lease.spec.fencing_token,
+                "renew_time": lease.spec.renew_time}
+
+    # -- internals ------------------------------------------------------
+
+    def _campaign(self) -> None:
+        while not self._stop.is_set():
+            try:
+                if self.is_leader:
+                    if not self._renew():
+                        self._demote()
+                else:
+                    if self._try_acquire():
+                        self.is_leader = True
+                        log.info("%s acquired store lease (token %d)",
+                                 self.identity, self.fencing_token)
+                        try:
+                            self.on_started_leading()
+                        except Exception:
+                            log.exception("on_started_leading failed")
+            except Exception:  # noqa: BLE001 - keep campaigning through
+                log.exception("leader campaign tick failed")
+
+            self._stop.wait(self.renew_interval_s)
+
+    def _try_acquire(self) -> bool:
+        from ..api.types import Lease
+        from ..store import AlreadyExistsError, ConflictError
+
+        try:
+            lease = self.store.try_get(Lease, self.LEASE_NAME)
+        except Exception:  # noqa: BLE001 - store unreachable
+            return False
+        now = time.time()
+        try:
+            if lease is None:
+                lease = Lease.new(self.LEASE_NAME)
+                self._fill(lease, now, lease.spec.fencing_token + 1)
+                self.store.create(lease)
+            else:
+                age = now - lease.spec.renew_time
+                if lease.spec.holder == self.identity:
+                    pass          # reclaim our own lease (restart)
+                elif age <= self.lease_duration_s:
+                    return False  # healthy holder
+                self._fill(lease, now, lease.spec.fencing_token + 1)
+                lease.spec.transitions += 1
+                self.store.update(lease, check_version=True)
+        except (ConflictError, AlreadyExistsError):
+            return False          # a concurrent challenger won
+        except Exception:  # noqa: BLE001
+            return False
+        self.fencing_token = lease.spec.fencing_token
+        return True
+
+    def _fill(self, lease, now: float, token: int) -> None:
+        lease.spec.holder = self.identity
+        lease.spec.holder_url = self.endpoint
+        lease.spec.lease_duration_s = self.lease_duration_s
+        lease.spec.renew_time = now
+        lease.spec.fencing_token = token
+
+    def _renew(self) -> bool:
+        from ..api.types import Lease
+        from ..store import ConflictError, NotFoundError
+
+        try:
+            lease = self.store.get(Lease, self.LEASE_NAME)
+            if lease.spec.holder != self.identity:
+                return False      # usurped
+            lease.spec.renew_time = time.time()
+            self.store.update(lease, check_version=True)
+            return True
+        except (ConflictError, NotFoundError):
+            return False
+        except Exception:  # noqa: BLE001 - store unreachable: fail safe
+            # and drop leadership rather than risk split-brain past the
+            # lease duration
+            return False
+
+    def _demote(self) -> None:
+        was = self.is_leader
+        self.is_leader = False
+        if was:
+            log.warning("%s lost the store lease", self.identity)
+            try:
+                self.on_stopped_leading()
+            except Exception:
+                log.exception("on_stopped_leading failed")
+
+    def _resign(self) -> None:
+        """Graceful handoff: zero the renew_time so a successor can
+        acquire immediately instead of waiting out the TTL."""
+        from ..api.types import Lease
+
+        self._demote()
+        try:
+            lease = self.store.try_get(Lease, self.LEASE_NAME)
+            if lease is not None and lease.spec.holder == self.identity:
+                lease.spec.renew_time = 0.0
+                self.store.update(lease, check_version=True)
+        except Exception:  # noqa: BLE001 - best effort
+            pass

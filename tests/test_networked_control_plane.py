@@ -1,0 +1,383 @@
+"""Networked control plane: the store gateway (apiserver analog) and the
+RemoteStore client that lets hypervisors on other hosts join the operator
+over TCP — kubernetes_backend.go:302-447 / pod_cache.go parity.
+
+The capstone test runs the operator and a mock-provider hypervisor as
+SEPARATE PROCESSES connected only by HTTP: submit an annotated pod to the
+operator, watch it get scheduled onto the remote node, the remote
+hypervisor spawn the worker + shm, and a metered client attach.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from conftest import REPO_ROOT
+from tensorfusion_tpu import constants
+from tensorfusion_tpu.api.types import Container, Pod, TPUPool
+from tensorfusion_tpu.operator import Operator
+from tensorfusion_tpu.remote_store import RemoteStore, RemoteStoreError
+from tensorfusion_tpu.server import OperatorServer
+from tensorfusion_tpu.store import (ADDED, AlreadyExistsError, ConflictError,
+                                    DELETED, MODIFIED, NotFoundError,
+                                    ObjectStore)
+
+
+@pytest.fixture()
+def op_server():
+    op = Operator(enable_expander=False)
+    pool = TPUPool.new("pool-a")
+    pool.spec.name = "pool-a"
+    op.store.create(pool)
+    op.start()
+    server = OperatorServer(op)
+    server.start()
+    yield op, server
+    server.stop()
+    op.stop()
+
+
+def test_remote_store_crud_roundtrip(op_server):
+    op, server = op_server
+    rs = RemoteStore(server.url)
+
+    pod = Pod.new("p1", namespace="ns1")
+    pod.metadata.annotations["a"] = "1"
+    created = rs.create(pod)
+    assert created.metadata.resource_version > 0
+
+    got = rs.get(Pod, "p1", "ns1")
+    assert got.metadata.annotations["a"] == "1"
+    assert rs.try_get(Pod, "missing", "ns1") is None
+    with pytest.raises(NotFoundError):
+        rs.get(Pod, "missing", "ns1")
+    with pytest.raises(AlreadyExistsError):
+        rs.create(pod)
+
+    got.metadata.annotations["a"] = "2"
+    updated = rs.update(got)
+    assert updated.metadata.generation == 2
+    # stale-version update with check_version must conflict
+    stale = got.deepcopy()
+    stale.metadata.annotations["a"] = "3"
+    stale.metadata.resource_version = 1
+    with pytest.raises(ConflictError):
+        rs.update(stale, check_version=True)
+
+    # upsert both paths
+    up = rs.update_or_create(Pod.new("p2", namespace="ns1"))
+    assert up.metadata.resource_version > 0
+    up.metadata.labels["x"] = "y"
+    rs.update_or_create(up)
+
+    names = {p.metadata.name for p in rs.list(Pod, namespace="ns1")}
+    assert names == {"p1", "p2"}
+    assert rs.list(Pod, namespace="ns1",
+                   selector=lambda p: p.metadata.name == "p2")[0] \
+        .metadata.labels["x"] == "y"
+
+    rs.delete(Pod, "p1", "ns1")
+    with pytest.raises(NotFoundError):
+        rs.delete(Pod, "p1", "ns1")
+    assert {p.metadata.name for p in rs.list(Pod)} == {"p2"}
+
+    # the in-process store sees everything the gateway wrote
+    assert op.store.try_get(Pod, "p2", "ns1") is not None
+
+
+def test_remote_store_watch_replay_then_live_events(op_server):
+    op, server = op_server
+    rs = RemoteStore(server.url)
+
+    pre = Pod.new("pre", namespace="d")
+    rs.create(pre)
+
+    w = rs.watch("Pod")
+    try:
+        ev = w.get(timeout=10)
+        assert ev is not None and ev.type == ADDED
+        assert ev.obj.metadata.name == "pre"
+        assert ev.obj.KIND == "Pod"
+
+        # live events flow through the long-poll within one poll cycle
+        live = Pod.new("live", namespace="d")
+        op.store.create(live)
+        ev = w.get(timeout=10)
+        assert ev.type == ADDED and ev.obj.metadata.name == "live"
+
+        live.metadata.annotations["touched"] = "1"
+        op.store.update(live)
+        ev = w.get(timeout=10)
+        assert ev.type == MODIFIED
+        assert ev.obj.metadata.annotations["touched"] == "1"
+
+        op.store.delete(Pod, "live", "d")
+        ev = w.get(timeout=10)
+        assert ev.type == DELETED and ev.obj.metadata.name == "live"
+
+        # kind filtering: TPUPool traffic must not leak into a Pod watch
+        pool = TPUPool.new("noise")
+        op.store.create(pool)
+        op.store.delete(TPUPool, "noise")
+        assert w.get(timeout=0.5) is None
+    finally:
+        w.stop()
+
+
+def test_watch_reset_after_log_compaction():
+    """A watcher further behind than the bounded event log gets
+    reset=True (410-Gone) and must re-list; events_since proves window
+    completeness via the log's oldest rv."""
+    import collections
+
+    store = ObjectStore()
+    store.enable_event_log()
+    store._event_log = collections.deque(maxlen=4)
+    first = store.create(Pod.new("a", namespace="d"))
+    base_rv = first.metadata.resource_version
+    for i in range(8):
+        store.create(Pod.new(f"p{i}", namespace="d"))
+    rv, events, reset = store.events_since(base_rv, ["Pod"])
+    assert reset is True and events == []
+    # a fresh window from within the log works
+    rv2, events2, reset2 = store.events_since(rv - 2, ["Pod"])
+    assert reset2 is False and len(events2) == 2
+
+
+def test_watcher_ahead_of_restarted_store_gets_reset():
+    """A watcher whose rv is *ahead* of the store (the store restarted
+    with older/empty state) must be told to re-list, not be silently
+    clamped into a window that skips events."""
+    store = ObjectStore()
+    store.enable_event_log()
+    for i in range(3):
+        store.create(Pod.new(f"p{i}", namespace="d"))
+    high_rv = store.current_rv
+    restarted = ObjectStore()          # fresh process, no persisted rv
+    restarted.enable_event_log()
+    rv, events, reset = restarted.events_since(high_rv, ["Pod"])
+    assert reset is True and events == []
+
+
+def test_remote_watch_reset_synthesizes_deletions(op_server):
+    """After falling behind the bounded event log, the re-replay must
+    diff against the watcher's cache and emit DELETED for objects that
+    vanished meanwhile — otherwise a partitioned hypervisor never
+    reclaims workers whose pods were deleted (informer re-list diff)."""
+    import collections
+
+    op, server = op_server
+    op.store._event_log = collections.deque(maxlen=4)
+    rs = RemoteStore(server.url)
+    doomed = Pod.new("doomed", namespace="d")
+    op.store.create(doomed)
+
+    w = rs.watch("Pod")
+    try:
+        ev = w.get(timeout=10)
+        assert ev.type == ADDED and ev.obj.metadata.name == "doomed"
+        # freeze the poll loop the crude way: block new requests while we
+        # age the log far past the window
+        w._closed.set()                 # stop polling (but keep state)
+        time.sleep(0.2)
+        op.store.delete(Pod, "doomed", "d")
+        for i in range(8):              # push the delete out of the log
+            op.store.create(Pod.new(f"filler{i}", namespace="d"))
+        # resume polling with the stale rv
+        w._closed.clear()
+        import threading as _t
+
+        w._thread = _t.Thread(target=w._loop, daemon=True)
+        w._thread.start()
+        got = {}
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            ev = w.get(timeout=1)
+            if ev is None:
+                continue
+            got.setdefault((ev.type, ev.obj.metadata.name), 0)
+            got[(ev.type, ev.obj.metadata.name)] += 1
+            if (DELETED, "doomed") in got and (ADDED, "filler7") in got:
+                break
+        assert (DELETED, "doomed") in got, got
+        assert (ADDED, "filler7") in got    # snapshot still replayed
+    finally:
+        w.stop()
+
+
+def test_gateway_token_auth(op_server):
+    op, _ = op_server
+    server = OperatorServer(op, store_token="sekrit")
+    server.start()
+    try:
+        with pytest.raises(PermissionError):
+            RemoteStore(server.url, token="wrong").list(Pod)
+        with pytest.raises(PermissionError):
+            RemoteStore(server.url).list(Pod)   # missing token
+        assert RemoteStore(server.url, token="sekrit").list(Pod) == []
+        # non-store endpoints stay open (clients use /connection etc.)
+        with urllib.request.urlopen(server.url + "/healthz",
+                                    timeout=5) as r:
+            assert r.status == 200
+    finally:
+        server.stop()
+
+
+def test_remote_store_errors_without_operator():
+    rs = RemoteStore("http://127.0.0.1:1", timeout_s=1)
+    assert rs.ping() is False
+    with pytest.raises(RemoteStoreError):
+        rs._request("GET", "/api/v1/store/list", query={"kind": "Pod"})
+
+
+def _wait(fn, timeout=60, interval=0.1, desc="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        out = fn()
+        if out:
+            return out
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {desc}")
+
+
+def test_two_process_cluster_e2e(native_build, limiter_lib, tmp_path):
+    """The VERDICT's done-criterion for the networked control plane:
+    operator and mock-provider hypervisor as separate OS processes over
+    TCP.  Submit annotated pod -> scheduled onto the remote node ->
+    worker spawned -> shm created -> metered client attaches."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    for k in list(env):
+        if k.startswith("TPF_MOCK_"):
+            env.pop(k)
+    env["JAX_PLATFORMS"] = "cpu"
+
+    logs = {}
+    procs = {}
+
+    def spawn(name, args):
+        logf = open(tmp_path / f"{name}.log", "w")
+        logs[name] = logf
+        procs[name] = subprocess.Popen(
+            [sys.executable, "-m"] + args, env=env, stdout=logf,
+            stderr=subprocess.STDOUT, cwd=str(REPO_ROOT))
+        return procs[name]
+
+    op_port_file = tmp_path / "op.port"
+    hv_port_file = tmp_path / "hv.port"
+    token = "cluster-secret"
+    env[constants.ENV_STORE_TOKEN] = token
+    spawn("operator", ["tensorfusion_tpu.operator", "--port", "0",
+                       "--pool", "pool-a",
+                       "--port-file", str(op_port_file)])
+    try:
+        _wait(op_port_file.exists, desc="operator port file")
+        op_url = f"http://127.0.0.1:{op_port_file.read_text().strip()}"
+        rs = RemoteStore(op_url, token=token)
+        _wait(lambda: rs.ping(), desc="operator healthz")
+
+        spawn("hypervisor",
+              ["tensorfusion_tpu.hypervisor",
+               "--provider", str(native_build / "libtpf_provider_mock.so"),
+               "--limiter", str(limiter_lib),
+               "--shm-base", str(tmp_path / "shm"),
+               "--state-dir", str(tmp_path / "state"),
+               "--snapshot-dir", str(tmp_path / "snap"),
+               "--port", "0", "--port-file", str(hv_port_file),
+               "--operator-url", op_url,
+               "--node-name", "remote-host-0", "--pool", "pool-a"])
+        _wait(hv_port_file.exists, desc="hypervisor port file")
+        hv_url = f"http://127.0.0.1:{hv_port_file.read_text().strip()}"
+
+        # the remote hypervisor's chips reached the operator's allocator
+        def chips_ready():
+            with urllib.request.urlopen(op_url + "/allocator-info",
+                                        timeout=5) as r:
+                info = json.loads(r.read())
+            chips = [c for c in info["chips"]
+                     if c["node"] == "remote-host-0"]
+            return chips if len(chips) == 8 else None
+
+        chips = _wait(chips_ready, timeout=60, desc="8 remote chips")
+        assert all(c["pool"] == "pool-a" for c in chips)
+
+        # submit a fractional pod through the operator's admission API
+        pod = Pod.new("frac", namespace="default")
+        ann = pod.metadata.annotations
+        ann[constants.ANN_POOL] = "pool-a"
+        ann[constants.ANN_TFLOPS_REQUEST] = "49.25"    # 25% of a v5e
+        ann[constants.ANN_HBM_REQUEST] = str(4 * 2**30)
+        ann[constants.ANN_IS_LOCAL_TPU] = "true"
+        pod.spec.containers = [Container(name="main")]
+        req = urllib.request.Request(
+            op_url + "/api/submit-pod",
+            data=json.dumps(pod.to_dict()).encode(), method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 201
+
+        # scheduled onto the remote node (via the RemoteStore view)
+        bound = _wait(
+            lambda: (lambda p: p if p is not None and p.spec.node_name
+                     else None)(rs.try_get(Pod, "frac", "default")),
+            timeout=30, desc="pod bound")
+        assert bound.spec.node_name == "remote-host-0"
+
+        # the hypervisor process saw the bound pod and created the shm
+        def worker_ready():
+            try:
+                with urllib.request.urlopen(hv_url + "/api/v1/workers",
+                                            timeout=5) as r:
+                    ws = json.loads(r.read())
+            except Exception:  # noqa: BLE001
+                return None
+            for w in ws:
+                shm = w["status"].get("env", {}).get(
+                    constants.ENV_SHM_PATH, "")
+                if w["spec"]["name"] == "frac" and shm and \
+                        os.path.exists(shm):
+                    return w
+            return None
+
+        worker = _wait(worker_ready, timeout=60, desc="remote worker shm")
+        shm_path = worker["status"]["env"][constants.ENV_SHM_PATH]
+
+        # a metered client attaches to the worker's segment and is
+        # rate-limited at the pod's fractional duty
+        from tensorfusion_tpu.client import VTPUClient
+        from tensorfusion_tpu.hypervisor import ShmView
+
+        state = ShmView(shm_path).read()
+        assert state.devices[0].duty_limit_bp == pytest.approx(2500,
+                                                               abs=10)
+        client = VTPUClient(limiter_lib=limiter_lib, shm_path=shm_path)
+        assert client.attached
+        import jax.numpy as jnp
+
+        metered = client.meter(lambda a, b: a @ b)
+        a = jnp.ones((128, 128), jnp.float32)
+        metered(a, a)
+        assert client.charged_mflops > 0
+
+        # deletion flows back over the wire: worker + shm are reclaimed
+        rs.delete(Pod, "frac", "default")
+        _wait(lambda: not os.path.exists(shm_path), timeout=30,
+              desc="shm cleanup")
+    finally:
+        for name, proc in procs.items():
+            proc.terminate()
+        for name, proc in procs.items():
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        for f in logs.values():
+            f.close()
+        for name in logs:
+            tail = (tmp_path / f"{name}.log").read_text()[-1500:]
+            print(f"--- {name} log tail ---\n{tail}")
