@@ -9,7 +9,7 @@ PY := env -u PALLAS_AXON_POOL_IPS python
 
 .PHONY: all native test test-native verify-all check-coverage asan \
 	tsan bench bench-tpu sched-bench webhook-bench remoting-bench \
-	dryrun clean
+	multitenant-bench dryrun clean
 
 all: native
 
@@ -51,6 +51,12 @@ bench-tpu: native
 
 sched-bench:
 	$(PY) benchmarks/sched_bench.py --nodes 1000 --chips 4 --pods 10000
+
+# BASELINE north star #2: >=90% aggregate duty with 4 oversubscribed
+# tenants (full limiter+ERL machinery; synthetic chip peak on CPU,
+# provider-observed duty on hardware).
+multitenant-bench:
+	$(PY) benchmarks/multitenant_bench.py
 
 webhook-bench:
 	$(PY) benchmarks/webhook_bench.py --pods 5000

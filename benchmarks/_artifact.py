@@ -1,0 +1,30 @@
+"""Shared perf-artifact writer: every benchmark persists its result as
+JSON under benchmarks/results/ so the numbers the docs cite are
+checked-in, reproducible records rather than claims (VERDICT r2 #6)."""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import platform
+import subprocess
+
+
+def write_artifact(name: str, result: dict) -> pathlib.Path:
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    out_dir = repo / "benchmarks" / "results"
+    out_dir.mkdir(exist_ok=True)
+    try:
+        commit = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=repo,
+            capture_output=True, text=True, timeout=10).stdout.strip()
+    except Exception:  # noqa: BLE001
+        commit = ""
+    record = dict(result, host=platform.node(), commit=commit,
+                  cpu_cores=os.cpu_count())
+    path = out_dir / f"{name}.json"
+    with open(path, "w") as f:
+        json.dump(record, f, indent=1)
+        f.write("\n")
+    return path

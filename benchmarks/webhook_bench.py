@@ -48,13 +48,19 @@ def main() -> int:
     for pod in pods:
         mutator.handle(pod)
     dt = time.perf_counter() - t0
-    print(json.dumps({
+    result = {
         "benchmark": "webhook_mutations_per_second",
         "pods": args.pods,
         "seconds": round(dt, 3),
         "mutations_per_second": round(args.pods / dt, 1),
         "reference": "BenchmarkPodWebhookQPS (tensor-fusion scripts/benchmark.sh)",
-    }))
+    }
+    try:
+        from benchmarks._artifact import write_artifact
+    except ImportError:
+        from _artifact import write_artifact
+    write_artifact("webhook", result)
+    print(json.dumps(result))
     return 0
 
 

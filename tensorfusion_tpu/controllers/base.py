@@ -45,6 +45,11 @@ class ControllerManager:
         self._controllers.append(controller)
 
     def start(self) -> None:
+        # re-startable: an HA replica demoted (stop) and re-promoted
+        # (start) must get live controller loops again, not threads that
+        # see the still-set stop event and exit immediately
+        self._stop.clear()
+        self._threads = []
         for c in self._controllers:
             t = threading.Thread(target=self._run, args=(c,),
                                  name=f"tpf-ctrl-{c.name}", daemon=True)

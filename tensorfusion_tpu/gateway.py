@@ -62,7 +62,10 @@ class StoreGateway:
     def __init__(self, store: ObjectStore, token: str = ""):
         self.store = store
         self.token = token
-        store.enable_event_log()   # remote watchers exist from now on
+        # event logging stays off until a watcher actually appears
+        # (snapshot_events/events_since self-enable) — single-process
+        # deployments with no remote watchers never pay the per-write
+        # to_dict + ring append
 
     # -- helpers -----------------------------------------------------------
 

@@ -109,7 +109,10 @@ class RemoteWatch:
                     # by this loop's own backoff so stop() stays prompt
                     max_tries=1)
                 backoff = 0
-            except (RemoteStoreError, OSError):
+            except Exception:  # noqa: BLE001 - ANY poll failure (auth
+                # rotation, proxy garbage, transport) must keep the watch
+                # thread alive and retrying, or consumers hang silently
+                log.exception("watch poll failed; retrying")
                 delay = RETRY_BACKOFF_S[min(backoff,
                                             len(RETRY_BACKOFF_S) - 1)]
                 backoff += 1
@@ -222,8 +225,13 @@ class RemoteStore:
     # -- ObjectStore surface ----------------------------------------------
 
     def create(self, obj: Resource) -> Resource:
+        # no transport retry: create is not idempotent — a retried create
+        # whose first attempt actually landed would surface a spurious
+        # AlreadyExistsError to the caller that in fact succeeded (the
+        # leader elector's acquire path turns exactly that into a stuck
+        # lease).  Callers that can re-check state retry themselves.
         out = self._request("POST", "/api/v1/store/objects",
-                            body={"obj": obj.to_dict()}, max_tries=3)
+                            body={"obj": obj.to_dict()})
         return self._decode(out["obj"])
 
     def get(self, cls: Type[Resource], name: str,

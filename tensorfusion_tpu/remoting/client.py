@@ -94,6 +94,8 @@ class RemoteDevice:
         """Dial + HELLO handshake + start the response reader (caller
         holds _send_lock)."""
         sock = socket.create_connection((self.host, self.port), timeout=60)
+        # pipelined small headers must not Nagle-stall behind buffers
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         send_message(sock, "HELLO", {"token": self.token}, [])
         kind, meta, _ = recv_message(sock)
         if kind != "HELLO_OK":

@@ -75,52 +75,76 @@ def _np_dtype(name: str):
     return np.dtype(name)
 
 
-def encode_message(kind: str, meta: Dict[str, Any],
-                   buffers: List[np.ndarray],
-                   compress: bool = False) -> bytes:
+def encode_message_parts(kind: str, meta: Dict[str, Any],
+                         buffers: List[np.ndarray],
+                         compress: bool = False) -> List:
+    """Wire pieces for one message: [head_bytes, buf_view, ...].
+
+    Buffer payloads stay as zero-copy memoryviews over the (contiguous)
+    arrays — the hot serving path moves megabytes per EXECUTE, and
+    concatenating them into one bytes object doubled its memory traffic."""
     descs = []
-    payload = bytearray()
+    views: List = []
     for arr in buffers:
         arr = np.ascontiguousarray(arr)
-        raw = arr.tobytes()
-        if len(raw) > MAX_BUFFER_BYTES:
+        raw_nbytes = arr.nbytes
+        if raw_nbytes > MAX_BUFFER_BYTES:
             # fail fast sender-side: past this point the receiver would
             # abort mid-stream and desync the whole pipelined connection
             raise ValueError(
-                f"buffer of {len(raw)} bytes exceeds the "
+                f"buffer of {raw_nbytes} bytes exceeds the "
                 f"{MAX_BUFFER_BYTES}-byte wire cap")
         enc = "raw"
-        wire = raw
-        if compress and len(raw) >= COMPRESS_MIN_BYTES:
+        wire = arr.reshape(-1).view(np.uint8).data   # zero-copy view
+        if compress and raw_nbytes >= COMPRESS_MIN_BYTES:
+            raw = arr.tobytes()
             probe = zlib.compress(raw[:COMPRESS_PROBE_BYTES], 1)
             if len(probe) < COMPRESS_PROBE_BYTES * COMPRESS_GAIN:
                 z = zlib.compress(raw, 1)
                 if len(z) < len(raw) * COMPRESS_GAIN:
                     enc, wire = "zlib", z
         descs.append({"shape": list(arr.shape), "dtype": _dtype_of(arr),
-                      "nbytes": len(wire), "raw_nbytes": len(raw),
+                      "nbytes": len(wire), "raw_nbytes": raw_nbytes,
                       "enc": enc})
-        payload.extend(wire)
+        views.append(wire)
     header = json.dumps({"kind": kind, "meta": meta,
                          "buffers": descs}).encode()
-    return MAGIC + struct.pack("<II", VERSION, len(header)) + header + \
-        bytes(payload)
+    head = MAGIC + struct.pack("<II", VERSION, len(header)) + header
+    return [head] + views
 
 
-def _read_exact(sock: socket.socket, n: int) -> bytes:
-    chunks = []
-    while n > 0:
-        chunk = sock.recv(min(n, 1 << 20))
-        if not chunk:
+def encode_message(kind: str, meta: Dict[str, Any],
+                   buffers: List[np.ndarray],
+                   compress: bool = False) -> bytes:
+    return b"".join(bytes(p) if not isinstance(p, (bytes, bytearray))
+                    else p
+                    for p in encode_message_parts(kind, meta, buffers,
+                                                  compress=compress))
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytearray:
+    """Read exactly n bytes into one preallocated buffer (recv_into, no
+    chunk-list join copy)."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
             raise ConnectionError("peer closed")
-        chunks.append(chunk)
-        n -= len(chunk)
-    return b"".join(chunks)
+        got += r
+    return buf
 
 
 def send_message(sock: socket.socket, kind: str, meta: Dict[str, Any],
                  buffers: List[np.ndarray], compress: bool = False) -> None:
-    sock.sendall(encode_message(kind, meta, buffers, compress=compress))
+    # scatter-gather: header and each (possibly multi-MB) buffer go out
+    # as separate sendalls straight from their memoryviews — no payload
+    # concatenation.  TCP_NODELAY (set at connect) keeps the small
+    # header from Nagle-stalling behind the previous buffer.
+    for part in encode_message_parts(kind, meta, buffers,
+                                     compress=compress):
+        sock.sendall(part)
 
 
 def recv_message(sock: socket.socket

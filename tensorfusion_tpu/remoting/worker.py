@@ -70,6 +70,12 @@ class RemoteVTPUWorker:
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
+            def setup(self):
+                import socket as _socket
+
+                self.request.setsockopt(_socket.IPPROTO_TCP,
+                                        _socket.TCP_NODELAY, 1)
+
             def handle(self):
                 # The HELLO exchange runs synchronously *before* the
                 # read-ahead thread exists: an unauthenticated peer never
@@ -99,11 +105,22 @@ class RemoteVTPUWorker:
 
                 threading.Thread(target=_reader, daemon=True,
                                  name="tpf-remote-readahead").start()
+                # Deferred-reply pipelining: an EXECUTE's result is
+                # materialized (np.asarray blocks on the async jax
+                # dispatch) only after the NEXT pipelined request has
+                # been launched, so XLA compute of k+1 overlaps
+                # serialization of k — one thread, no GIL handoff, and
+                # the client matches responses by seq so ordering is
+                # free to shift.
+                pending = None
                 try:
                     while True:
+                        if pending is not None and inbox.empty():
+                            pending()
+                            pending = None
                         item = inbox.get()
                         if item is None:
-                            return
+                            break
                         kind, meta, buffers = item
                         seq = meta.get("seq")
 
@@ -119,11 +136,20 @@ class RemoteVTPUWorker:
                             # no-op ack (clients retry it on reconnect)
                             reply("HELLO_OK", {"version": 2}, [])
                             continue
+                        deferred = None
                         try:
-                            outer._dispatch(reply, kind, meta, buffers)
+                            deferred = outer._dispatch(reply, kind, meta,
+                                                       buffers)
                         except Exception as e:  # noqa: BLE001
                             log.exception("remote %s failed", kind)
                             reply("ERROR", {"error": str(e)}, [])
+                        if pending is not None:
+                            pending()
+                            pending = None
+                        if deferred is not None:
+                            pending = deferred
+                    if pending is not None:
+                        pending()
                 except (ConnectionError, OSError):
                     pass
 
@@ -372,9 +398,23 @@ class RemoteVTPUWorker:
                 reply("EXECUTE_OK", {"result_refs": ids, "shapes": shapes,
                                      "dtypes": dtypes}, [])
             else:
-                results = [np.asarray(leaf) for leaf in leaves]
-                reply("EXECUTE_OK", {"n_results": len(results)}, results,
-                      compress=self.compress)
+                # defer materialization: jax dispatch is async, so the
+                # handler loop launches the next pipelined EXECUTE before
+                # this flush blocks in np.asarray (GIL released) — see
+                # the deferred-reply comment in Handler.handle
+                def flush(_leaves=leaves, _reply=reply):
+                    try:
+                        results = [np.asarray(leaf) for leaf in _leaves]
+                        _reply("EXECUTE_OK",
+                               {"n_results": len(results)}, results,
+                               compress=self.compress)
+                    except (ConnectionError, OSError):
+                        raise
+                    except Exception as e:  # noqa: BLE001 - exec error
+                        log.exception("deferred EXECUTE flush failed")
+                        _reply("ERROR", {"error": str(e)}, [])
+
+                return flush
         elif kind == "FETCH":
             with self._lock:
                 arr = self._buffers.get(meta["buf_id"])

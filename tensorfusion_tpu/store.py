@@ -7,10 +7,15 @@ self-hosted, so a thread-safe store with optimistic concurrency and watch
 queues provides the same contract: create/get/update/delete/list + ADDED/
 MODIFIED/DELETED events that drive reconcile loops).
 
-Optionally persists every kind to a JSON-lines snapshot directory so a
-restarted control plane can rebuild (restart recovery is then exercised the
-same way the reference rebuilds allocator state from annotations,
-gpuallocator.go:2592).
+Optionally persists every kind to a JSON-lines file so a restarted
+control plane can rebuild (restart recovery is then exercised the same
+way the reference rebuilds allocator state from annotations,
+gpuallocator.go:2592).  Persistence is an **append-only journal with
+periodic compaction**: each write appends one ``{"op": "put"|"del",
+"obj": ...}`` line; once the journal grows past a few times the live
+object count, it is rewritten as a plain snapshot.  A flat
+rewrite-the-kind-on-every-update scheme measured O(objects) write
+amplification per bind at the 10k-pod scheduler-bench scale.
 """
 
 from __future__ import annotations
@@ -96,6 +101,9 @@ class ObjectStore:
             collections.deque(maxlen=EVENT_LOG_SIZE)
         self._log_enabled = False
         self._persist_dir = persist_dir
+        # kind -> (open append handle, journal line count)
+        self._journals: Dict[str, object] = {}
+        self._journal_lines: Dict[str, int] = {}
         if persist_dir:
             os.makedirs(persist_dir, exist_ok=True)
 
@@ -131,15 +139,61 @@ class ObjectStore:
             meta.pop("generation", None)
         return da == db
 
-    def _persist(self, kind: str) -> None:
+    #: compaction threshold: journal may grow to this many times the live
+    #: object count (floor of JOURNAL_MIN lines) before being rewritten
+    JOURNAL_SLACK = 4
+    JOURNAL_MIN = 1024
+
+    def _journal_path(self, kind: str) -> str:
+        return os.path.join(self._persist_dir, f"{kind}.jsonl")
+
+    def _persist(self, kind: str, op: str = "put",
+                 obj: Optional[Resource] = None) -> None:
+        """Append one journal entry (caller holds the lock); compact when
+        the journal has outgrown the live set."""
         if not self._persist_dir:
             return
-        path = os.path.join(self._persist_dir, f"{kind}.jsonl")
+        live = len(self._objects.get(kind, {}))
+        lines = self._journal_lines.get(kind, 0)
+        if lines + 1 > max(self.JOURNAL_SLACK * live, self.JOURNAL_MIN):
+            # _compact snapshots the already-updated live set, so the
+            # entry that triggered it is folded in, not appended
+            self._compact(kind)
+            return
+        f = self._journals.get(kind)
+        if f is None:
+            f = open(self._journal_path(kind), "a")
+            self._journals[kind] = f
+            # resuming an existing journal: count its lines once
+            if lines == 0 and f.tell() > 0:
+                with open(self._journal_path(kind)) as rf:
+                    lines = sum(1 for _ in rf)
+        entry = {"op": op}
+        if obj is not None:
+            entry["obj"] = obj.to_dict()
+        f.write(json.dumps(entry) + "\n")
+        f.flush()   # ~3us: page-cache write, not fsync
+        self._journal_lines[kind] = lines + 1
+
+    def _compact(self, kind: str) -> None:
+        """Rewrite the kind's journal as a snapshot of live objects."""
+        f = self._journals.pop(kind, None)
+        if f is not None:
+            f.close()
+        path = self._journal_path(kind)
         tmp = path + ".tmp"
-        with open(tmp, "w") as f:
+        with open(tmp, "w") as out:
             for obj in self._objects.get(kind, {}).values():
-                f.write(json.dumps(obj.to_dict()) + "\n")
+                out.write(json.dumps({"op": "put",
+                                      "obj": obj.to_dict()}) + "\n")
         os.replace(tmp, path)
+        self._journal_lines[kind] = len(self._objects.get(kind, {}))
+
+    def close(self) -> None:
+        with self._lock:
+            for f in self._journals.values():
+                f.close()
+            self._journals.clear()
 
     # -- CRUD -------------------------------------------------------------
 
@@ -155,7 +209,7 @@ class ObjectStore:
             stored = obj.deepcopy()
             bucket[key] = stored
             self._emit(ADDED, stored)
-            self._persist(obj.KIND)
+            self._persist(obj.KIND, "put", stored)
             return stored.deepcopy()
 
     def get(self, cls: Type[Resource], name: str,
@@ -197,7 +251,7 @@ class ObjectStore:
             stored = obj.deepcopy()
             bucket[key] = stored
             self._emit(MODIFIED, stored)
-            self._persist(obj.KIND)
+            self._persist(obj.KIND, "put", stored)
             return stored.deepcopy()
 
     def update_or_create(self, obj: Resource) -> Resource:
@@ -218,7 +272,7 @@ class ObjectStore:
             # "events since rv" window must include them
             self._rv += 1
             self._emit(DELETED, obj)
-            self._persist(cls.KIND)
+            self._persist(cls.KIND, "del", obj)
 
     def list(self, cls: Type[Resource], namespace: Optional[str] = None,
              selector: Optional[Callable[[Resource], bool]] = None
@@ -322,26 +376,39 @@ class ObjectStore:
     # -- persistence ------------------------------------------------------
 
     def load(self, kind_classes: Iterable[Type[Resource]]) -> int:
-        """Reload persisted objects (restart recovery). Returns count."""
+        """Replay persisted journals (restart recovery). Returns the
+        number of live objects restored.  Accepts both journal entries
+        ({"op": .., "obj": ..}) and bare object lines (pre-journal
+        snapshot format)."""
         if not self._persist_dir:
             return 0
         n = 0
         with self._lock:
             for cls in kind_classes:
-                path = os.path.join(self._persist_dir, f"{cls.KIND}.jsonl")
+                path = self._journal_path(cls.KIND)
                 if not os.path.exists(path):
                     continue
                 bucket = self._bucket(cls.KIND)
+                lines = 0
                 with open(path) as f:
                     for line in f:
                         line = line.strip()
                         if not line:
                             continue
+                        lines += 1
                         data = json.loads(line)
+                        if "op" in data:
+                            op, data = data["op"], data.get("obj") or {}
+                        else:
+                            op = "put"
                         data.pop("kind", None)
                         obj = from_dict(cls, data)
-                        bucket[obj.key()] = obj
+                        if op == "del":
+                            bucket.pop(obj.key(), None)
+                        else:
+                            bucket[obj.key()] = obj
                         self._rv = max(self._rv,
                                        obj.metadata.resource_version)
-                        n += 1
+                self._journal_lines[cls.KIND] = lines
+                n += len(bucket)
         return n

@@ -91,6 +91,51 @@ def test_store_elector_crash_takeover_after_ttl():
     b.stop()
 
 
+def test_operator_demote_then_repromote_components_work():
+    """A replica that loses and regains the lease must come back with
+    LIVE controllers — stop()/start() of the controller manager and
+    scheduler have to be re-entrant (a set-and-never-cleared stop event
+    would leave re-promoted controller loops dead on arrival)."""
+    from tensorfusion_tpu import constants
+    from tensorfusion_tpu.api.types import (Container, Pod,
+                                            ResourceAmount, TPUChip,
+                                            TPUPool)
+    from tensorfusion_tpu.operator import Operator
+
+    op = Operator(enable_expander=False)
+    pool = TPUPool.new("pool-a")
+    pool.spec.name = "pool-a"
+    op.store.create(pool)
+    op.start()
+    chip = TPUChip.new("chip-0")
+    chip.status.phase = constants.PHASE_RUNNING
+    chip.status.capacity = ResourceAmount(tflops=197.0, duty_percent=100,
+                                          hbm_bytes=16 << 30)
+    chip.status.node_name = "n0"
+    chip.status.pool = "pool-a"
+    chip.status.generation = "v5e"
+    op.register_host("n0", [chip])
+    try:
+        # demote -> re-promote (what the store elector does on a lease
+        # blip)
+        op._stop_components()
+        op._start_components()
+
+        pod = Pod.new("after-blip", namespace="default")
+        ann = pod.metadata.annotations
+        ann[constants.ANN_POOL] = "pool-a"
+        ann[constants.ANN_TFLOPS_REQUEST] = "10"
+        ann[constants.ANN_HBM_REQUEST] = str(2**30)
+        ann[constants.ANN_IS_LOCAL_TPU] = "true"
+        pod.spec.containers = [Container(name="main")]
+        op.submit_pod(pod)
+        bound = op.wait_for_binding("after-blip", timeout=15)
+        assert bound is not None and bound.spec.node_name == "n0", \
+            "controllers dead after re-promotion"
+    finally:
+        op.stop()
+
+
 def test_ha_failover_across_processes(native_build, limiter_lib, tmp_path):
     """state store + two operator replicas + one hypervisor, all
     separate processes.  Kill -9 the leader; the follower is promoted,

@@ -210,6 +210,52 @@ def test_remote_watch_reset_synthesizes_deletions(op_server):
         w.stop()
 
 
+def test_store_journal_append_compact_and_replay(tmp_path):
+    """Persistence is an append-only journal: updates append one line
+    (no whole-kind rewrite), deletions journal as del-ops, compaction
+    folds the journal back to live size, and replay (incl. the
+    pre-journal bare-object format) reconstructs exact state."""
+    d = str(tmp_path / "persist")
+    store = ObjectStore(persist_dir=d)
+    pods = [store.create(Pod.new(f"p{i}", namespace="ns"))
+            for i in range(20)]
+    path = tmp_path / "persist" / "Pod.jsonl"
+    base_lines = len(path.read_text().splitlines())
+    assert base_lines == 20
+
+    # one update = exactly one appended line, not a 20-line rewrite
+    pods[0].metadata.labels["x"] = "1"
+    store.update(pods[0])
+    assert len(path.read_text().splitlines()) == base_lines + 1
+
+    # deletion journals a del entry
+    store.delete(Pod, "p1", "ns")
+    lines = path.read_text().splitlines()
+    assert json.loads(lines[-1])["op"] == "del"
+
+    # replay reconstructs: 19 live pods, update applied, p1 gone
+    store.close()
+    fresh = ObjectStore(persist_dir=d)
+    n = fresh.load([Pod])
+    assert n == 19
+    assert fresh.try_get(Pod, "p1", "ns") is None
+    assert fresh.get(Pod, "p0", "ns").metadata.labels["x"] == "1"
+
+    # churn past the slack triggers compaction back to ~live size
+    fresh.JOURNAL_SLACK = 2
+    fresh.JOURNAL_MIN = 8
+    for _ in range(90):
+        p = fresh.get(Pod, "p2", "ns")
+        p.metadata.labels["n"] = str(time.time())
+        fresh.update(p)
+    assert len(path.read_text().splitlines()) <= 2 * 19 + 1
+    # and state still replays exactly after compaction
+    fresh.close()
+    again = ObjectStore(persist_dir=d)
+    assert again.load([Pod]) == 19
+    assert "n" in again.get(Pod, "p2", "ns").metadata.labels
+
+
 def test_gateway_token_auth(op_server):
     op, _ = op_server
     server = OperatorServer(op, store_token="sekrit")

@@ -159,6 +159,57 @@ def test_e2e_remote_workload_and_connection(op):
     assert conn is not None and conn.status.worker_url.startswith("tcp://")
 
 
+def test_e2e_connection_fails_over_when_worker_dies(op):
+    """Worker death -> connection re-selection
+    (tensorfusionconnection_controller.go:140 re-pick semantics): when
+    the serving worker pod disappears, the connection drops back to
+    Pending and re-binds to a surviving replica's URL."""
+    wl = TPUWorkload.new("failover", namespace="default")
+    wl.spec.pool = "pool-a"
+    wl.spec.replicas = 2
+    wl.spec.resources.requests = ResourceAmount(tflops=20.0,
+                                                hbm_bytes=2**30)
+    wl.spec.resources.limits = ResourceAmount(tflops=40.0,
+                                              hbm_bytes=2**30)
+    op.store.create(wl)
+
+    client = Pod.new("fo-client", namespace="default")
+    client.metadata.annotations[constants.ANN_WORKLOAD] = "failover"
+    client.status.phase = constants.PHASE_RUNNING
+    op.store.create(client)
+
+    def connected():
+        conn = op.store.try_get(TPUConnection, "fo-client-conn", "default")
+        if conn is not None and conn.status.worker_url:
+            return conn
+        return None
+
+    deadline = time.time() + 10
+    conn = None
+    while time.time() < deadline and conn is None:
+        conn = connected()
+        time.sleep(0.05)
+    assert conn is not None
+    first_worker, first_url = conn.status.worker_name, \
+        conn.status.worker_url
+
+    # kill the serving worker out from under the connection
+    op.store.delete(Pod, first_worker, "default")
+
+    deadline = time.time() + 10
+    failed_over = None
+    while time.time() < deadline:
+        cur = connected()
+        if cur is not None and cur.status.worker_name and \
+                cur.status.worker_name != first_worker:
+            failed_over = cur
+            break
+        time.sleep(0.05)
+    assert failed_over is not None, "connection never re-selected"
+    assert failed_over.status.worker_url != first_url
+    assert failed_over.status.phase == constants.PHASE_RUNNING
+
+
 def test_e2e_dynamic_replicas_scale_to_zero_and_burst(op):
     """BASELINE config #5 shape: a dynamic-replica serving workload
     scales with its connection count — burst wakes workers from zero,
