@@ -58,6 +58,11 @@ sched-bench:
 multitenant-bench:
 	$(PY) benchmarks/multitenant_bench.py
 
+# ERL PID tuning sweep (defaults documented in api/types.py come from
+# this harness's artifact).
+erl-tune:
+	$(PY) benchmarks/erl_tuning.py --sweep
+
 webhook-bench:
 	$(PY) benchmarks/webhook_bench.py --pods 5000
 

@@ -102,6 +102,14 @@ def main() -> int:
                 time.sleep(_PROBE_GAP_S)
         if alive:
             attempts.append((dict(os.environ), None))
+            # if the live-probed TPU attempt still fails (flapping
+            # relay), the CPU re-run must carry a fallback record too —
+            # "never a silent downgrade" covers this path as well
+            fallback = {
+                "reason": "tpu attempt failed after a successful "
+                          "liveness probe (relay flapped mid-bench)",
+                "probes": i + 1,
+                "wanted_platform": "tpu"}
         else:
             fallback = {
                 "reason": f"tpu tunnel dead: {_TPU_PROBES} liveness "

@@ -1,0 +1,99 @@
+#!/usr/bin/env bash
+# Stand up the full tpu-fusion topology as local processes — the exact
+# shape deploy/docker-compose.yaml runs in containers: state store +
+# two HA operator replicas + two mock-provider hypervisors.
+#
+#   deploy/run_local_cluster.sh [workdir]
+#
+# Prints the endpoints, submits a demo 0.25-vTPU pod, shows where it
+# landed, and leaves everything running until Ctrl-C (then cleans up).
+set -euo pipefail
+
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+WORK="${1:-$(mktemp -d /tmp/tpf-cluster.XXXXXX)}"
+TOKEN="${TPF_STORE_TOKEN:-dev-token}"
+mkdir -p "$WORK"
+cd "$REPO"
+make -C native all >/dev/null
+
+PIDS=()
+cleanup() { kill "${PIDS[@]}" 2>/dev/null || true; wait 2>/dev/null || true; }
+trap cleanup EXIT INT TERM
+
+run() { # name, args...
+  local name="$1"; shift
+  python -m "$@" > "$WORK/$name.log" 2>&1 &
+  PIDS+=($!)
+}
+
+wait_file() { for _ in $(seq 100); do [ -s "$1" ] && return 0; sleep 0.2; done
+              echo "timeout waiting for $1" >&2; return 1; }
+
+run statestore tensorfusion_tpu.statestore --port 0 \
+    --port-file "$WORK/ss.port" --persist-dir "$WORK/persist" \
+    --token "$TOKEN"
+wait_file "$WORK/ss.port"
+SS_URL="http://127.0.0.1:$(cat "$WORK/ss.port")"
+
+for id in a b; do
+  run "operator-$id" tensorfusion_tpu.operator --port 0 \
+      --port-file "$WORK/op-$id.port" --store-url "$SS_URL" \
+      --identity "operator-$id" --pool pool-a --store-token "$TOKEN"
+done
+wait_file "$WORK/op-a.port"; wait_file "$WORK/op-b.port"
+OP_A="http://127.0.0.1:$(cat "$WORK/op-a.port")"
+OP_B="http://127.0.0.1:$(cat "$WORK/op-b.port")"
+
+for n in 0 1; do
+  export TPF_MOCK_HOST="h$n"   # unique mock chip ids per simulated host
+  run "hypervisor-$n" tensorfusion_tpu.hypervisor --port 0 \
+      --port-file "$WORK/hv-$n.port" \
+      --provider native/build/libtpf_provider_mock.so \
+      --limiter native/build/libtpf_limiter.so \
+      --shm-base "$WORK/shm-$n" --state-dir "$WORK/state-$n" \
+      --snapshot-dir "$WORK/snap-$n" \
+      --operator-url "$SS_URL" --store-token "$TOKEN" \
+      --node-name "tpu-host-$n" --pool pool-a
+done
+wait_file "$WORK/hv-0.port"; wait_file "$WORK/hv-1.port"
+
+echo "state store : $SS_URL"
+echo "operator a  : $OP_A"
+echo "operator b  : $OP_B"
+echo "hypervisors : http://127.0.0.1:$(cat "$WORK/hv-0.port")" \
+     "http://127.0.0.1:$(cat "$WORK/hv-1.port")"
+echo "logs        : $WORK/*.log"
+
+# wait for 16 chips (2 hosts x 8), finding the leader by probing both
+leader=""
+for _ in $(seq 150); do
+  for url in "$OP_A" "$OP_B"; do
+    n=$(curl -s "$url/allocator-info" \
+        | python -c "import sys,json; print(len(json.load(sys.stdin)['chips']))" \
+        2>/dev/null || echo 0)
+    if [ "$n" = "16" ]; then leader="$url"; break 2; fi
+  done
+  sleep 0.2
+done
+[ -n "$leader" ] || { echo "chips never registered" >&2; exit 1; }
+echo "leader      : $leader (16 chips registered)"
+
+echo "submitting demo 0.25-vTPU pod ..."
+curl -s -X POST "$leader/api/submit-pod" -d '{
+  "metadata": {"name": "demo", "namespace": "default", "annotations": {
+    "tpu-fusion.ai/pool": "pool-a",
+    "tpu-fusion.ai/tflops-request": "49.25",
+    "tpu-fusion.ai/hbm-request": "4294967296",
+    "tpu-fusion.ai/is-local-tpu": "true"}},
+  "spec": {"containers": [{"name": "main"}]}}' >/dev/null
+for _ in $(seq 50); do
+  node=$(curl -s "$leader/allocator-info" | python -c "
+import sys, json
+for a in json.load(sys.stdin)['allocations']:
+    if a['key'] == 'default/demo':
+        print(','.join(a['chips'])); break" 2>/dev/null)
+  [ -n "$node" ] && break; sleep 0.2
+done
+echo "demo pod placed on chips: ${node:-<pending>}"
+echo "cluster is up — Ctrl-C to tear down"
+wait

@@ -118,11 +118,17 @@ void build_host_locked() {
     g_state.mesh_y = chip_count;
   }
 
+  // TPF_MOCK_HOST distinguishes simulated hosts: two hypervisors with
+  // default naming would publish colliding chip ids into the control
+  // plane (cluster-scoped TPUChip objects are keyed by chip_id)
+  const char* host = getenv("TPF_MOCK_HOST");
+  if (!host || !*host) host = "h0";
+
   g_state.chips.assign(chip_count, MockChip{});
   for (int i = 0; i < chip_count; ++i) {
     tpf_chip_info_t& ci = g_state.chips[i].info;
-    snprintf(ci.chip_id, sizeof(ci.chip_id), "mock-%s-h0-c%d",
-             g_state.gen.name, i);
+    snprintf(ci.chip_id, sizeof(ci.chip_id), "mock-%s-%s-c%d",
+             g_state.gen.name, host, i);
     snprintf(ci.platform, sizeof(ci.platform), "tpu");
     snprintf(ci.generation, sizeof(ci.generation), "%s", g_state.gen.name);
     snprintf(ci.slice_id, sizeof(ci.slice_id), "mock-%s-%dx%d-slice0",

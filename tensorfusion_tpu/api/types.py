@@ -506,11 +506,20 @@ class TPUConnection(Resource):
 @dataclass
 class ERLParameters:
     """Elastic-rate-limit PID controller knobs
-    (ref: schedulingconfigtemplate_types.go:287-308)."""
+    (ref: schedulingconfigtemplate_types.go:287-308).
 
-    kp: float = 0.6
-    ki: float = 0.15
-    kd: float = 0.05
+    Defaults chosen by the tuning harness (benchmarks/erl_tuning.py,
+    artifact benchmarks/results/erl_tuning.json): across sustained/
+    burst/QoS-mix contention sweeps of (kp, ki, kd, burst_window),
+    kp=1.0 ki=0.05 kd=0.0 converges every transient in <=0.3s with
+    <5% overshoot and stays stable under +-8% measured-duty noise —
+    derivative action amplifies that noise (kd=0.05 at kp=1.0 fails to
+    settle), so it ships off; the smoothing filter already provides
+    the damping."""
+
+    kp: float = 1.0
+    ki: float = 0.05
+    kd: float = 0.0
     integral_decay: float = 0.95
     slew_max_step_percent: float = 20.0
     burst_window_seconds: float = 2.0

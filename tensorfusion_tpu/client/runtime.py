@@ -225,8 +225,12 @@ class VTPUClient:
         """
         import jax
 
-        jitted = jax.jit(fn, static_argnums=static_argnums,
-                         **(jit_kwargs or {}))
+        # the ORIGINAL jit, never the activate()-patched one — metering
+        # through the patch would recurse (patched jit -> meter -> jit)
+        jit = _orig_jit if _jit_patched and _orig_jit is not None \
+            else jax.jit
+        jitted = jit(fn, static_argnums=static_argnums,
+                     **(jit_kwargs or {}))
         costs: Dict[Any, int] = {}
         hbm_charged: Dict[Any, int] = {}
         client = self

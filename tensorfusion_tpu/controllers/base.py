@@ -45,13 +45,16 @@ class ControllerManager:
         self._controllers.append(controller)
 
     def start(self) -> None:
-        # re-startable: an HA replica demoted (stop) and re-promoted
-        # (start) must get live controller loops again, not threads that
-        # see the still-set stop event and exit immediately
-        self._stop.clear()
+        # Re-startable across HA demote/re-promote cycles: each start()
+        # is a new GENERATION with its OWN stop event (captured by its
+        # threads).  Clearing a shared event would revive any old thread
+        # that outlived stop()'s join timeout — two concurrent reconcile
+        # loops for the same controller.
+        self._stop = threading.Event()
         self._threads = []
         for c in self._controllers:
-            t = threading.Thread(target=self._run, args=(c,),
+            t = threading.Thread(target=self._run,
+                                 args=(c, self._stop),
                                  name=f"tpf-ctrl-{c.name}", daemon=True)
             t.start()
             self._threads.append(t)
@@ -61,7 +64,7 @@ class ControllerManager:
         for t in self._threads:
             t.join(timeout=2)
 
-    def _run(self, c: Controller) -> None:
+    def _run(self, c: Controller, stop: threading.Event) -> None:
         try:
             c.on_start()
         except Exception:
@@ -69,7 +72,7 @@ class ControllerManager:
         watch = self.store.watch(*c.kinds)
         last_resync = time.monotonic()
         try:
-            while not self._stop.is_set():
+            while not stop.is_set():
                 timeout = 0.2
                 if c.resync_interval_s > 0:
                     timeout = min(timeout, c.resync_interval_s / 4)

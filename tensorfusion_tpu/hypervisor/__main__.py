@@ -72,71 +72,93 @@ def build_parser() -> argparse.ArgumentParser:
                     default=os.environ.get(constants.ENV_STORE_TOKEN, ""))
     ap.add_argument("--port-file", default="",
                     help="write the bound API port here (for --port 0)")
+    ap.add_argument("--advertise-url", default="",
+                    help="externally reachable URL registered on the "
+                         "TPUNode (default: the local bind URL — set "
+                         "this in cross-host/container deployments)")
     ap.add_argument("-v", "--verbose", action="store_true")
     return ap
 
 
+class HypervisorDaemon:
+    """The daemon's component graph, separated from the process loop so
+    the wiring is testable in-process (the 0%-covered flag/env plumbing
+    was exactly where regressions hid)."""
+
+    def __init__(self, args):
+        self.args = args
+        self.log = logging.getLogger("tpf.hypervisor")
+        os.makedirs(args.snapshot_dir, exist_ok=True)
+        self.provider = Provider(
+            args.provider,
+            log_fn=lambda lvl, msg: self.log.info("[provider] %s", msg))
+        self.devices = DeviceController(self.provider)
+        self.limiter = Limiter(args.limiter)
+        self.allocator = AllocationController(self.devices)
+        self.workers = WorkerController(
+            self.devices, self.allocator, self.limiter, args.shm_base,
+            tick_interval_s=args.tick_ms / 1000.0)
+        # the HTTP server binds before the backend so node registration
+        # can carry a live hypervisor URL
+        self.server = HypervisorServer(self.devices, self.workers,
+                                       snapshot_dir=args.snapshot_dir,
+                                       host=args.host, port=args.port)
+        if args.operator_url:
+            from ..remote_store import RemoteStore
+            from .control_plane import ControlPlaneBackend
+
+            store = RemoteStore(args.operator_url,
+                                token=args.store_token)
+            self.backend = ControlPlaneBackend(
+                store, self.devices, node_name=args.node_name,
+                pool=args.pool, hypervisor_url="", vendor="mock-tpu",
+                known_pids=self.workers.all_pids)
+
+            def on_added(spec):
+                self.workers.add_worker(spec)
+        else:
+            self.backend = SingleNodeBackend(args.state_dir)
+
+            def on_added(spec):
+                tracked = self.workers.add_worker(spec)
+                self.backend.set_worker_env(spec.key,
+                                            tracked.status.env)
+        self._on_added = on_added
+
+    def start(self) -> None:
+        args = self.args
+        self.devices.start()
+        self.server.start()
+        if args.port_file:
+            with open(args.port_file, "w") as f:
+                f.write(str(self.server.port))
+        if args.operator_url:
+            self.backend.hypervisor_url = \
+                args.advertise_url or self.server.url
+        self.server.backend = self.backend
+        self.backend.start(self._on_added, self.workers.remove_worker)
+        self.workers.start()
+        self.log.info(
+            "hypervisor serving on %s (%d chips)%s", self.server.url,
+            len(self.devices.devices()),
+            f", joined operator {args.operator_url}"
+            if args.operator_url else "")
+
+    def stop(self) -> None:
+        self.server.stop()
+        self.workers.stop()
+        self.backend.stop()
+        self.devices.stop()
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(levelname).1s %(name)s %(message)s")
-    log = logging.getLogger("tpf.hypervisor")
 
-    os.makedirs(args.snapshot_dir, exist_ok=True)
-    provider = Provider(args.provider,
-                        log_fn=lambda lvl, msg: log.info("[provider] %s", msg))
-    devices = DeviceController(provider)
-    devices.start()
-
-    limiter = Limiter(args.limiter)
-    allocator = AllocationController(devices)
-    workers = WorkerController(devices, allocator, limiter, args.shm_base,
-                               tick_interval_s=args.tick_ms / 1000.0)
-
-    # the HTTP server starts before the backend so the node registration
-    # can carry a live hypervisor URL
-    server = HypervisorServer(devices, workers,
-                              snapshot_dir=args.snapshot_dir,
-                              host=args.host, port=args.port)
-
-    if args.operator_url:
-        from ..remote_store import RemoteStore
-        from .control_plane import ControlPlaneBackend
-
-        store = RemoteStore(args.operator_url, token=args.store_token)
-        backend = ControlPlaneBackend(
-            store, devices, node_name=args.node_name, pool=args.pool,
-            hypervisor_url="", vendor="mock-tpu",
-            known_pids=workers.all_pids)
-
-        def on_added(spec):
-            workers.add_worker(spec)
-
-        on_removed = workers.remove_worker
-    else:
-        backend = SingleNodeBackend(args.state_dir)
-
-        def on_added(spec):
-            tracked = workers.add_worker(spec)
-            backend.set_worker_env(spec.key, tracked.status.env)
-
-        on_removed = workers.remove_worker
-
-    server.start()
-    if args.port_file:
-        with open(args.port_file, "w") as f:
-            f.write(str(server.port))
-    if args.operator_url:
-        backend.hypervisor_url = server.url
-    server.backend = backend
-    backend.start(on_added, on_removed)
-    workers.start()
-    log.info("hypervisor serving on %s (%d chips)%s", server.url,
-             len(devices.devices()),
-             f", joined operator {args.operator_url}"
-             if args.operator_url else "")
+    daemon = HypervisorDaemon(args)
+    daemon.start()
 
     stop = False
 
@@ -150,10 +172,7 @@ def main(argv=None) -> int:
         while not stop:
             time.sleep(0.5)
     finally:
-        server.stop()
-        workers.stop()
-        backend.stop()
-        devices.stop()
+        daemon.stop()
     return 0
 
 

@@ -181,7 +181,9 @@ class Operator:
     def _start_components(self) -> None:
         if self._components_started:
             return
-        self._stop.clear()   # re-promotion after a demote restarts loops
+        # new generation event (not clear()): a sync thread that
+        # outlived a demote's join timeout must not be revived
+        self._stop = threading.Event()
         # restart recovery before serving: chips first (the watch replay is
         # async), then rebuild allocator + quota state from persisted pods
         # (reconcileAllocationState analog)
@@ -212,6 +214,7 @@ class Operator:
         self.manager.start()
         self.scheduler.start()
         self._sync_thread = threading.Thread(target=self._sync_loop,
+                                             args=(self._stop,),
                                              name="tpf-operator-sync",
                                              daemon=True)
         self._sync_thread.start()
@@ -268,10 +271,11 @@ class Operator:
             info = self.elector.read_leader_info(self.elector.lock_path)
         return (info or {}).get("endpoint", "") or ""
 
-    def _sync_loop(self) -> None:
+    def _sync_loop(self, stop: threading.Event) -> None:
         """Background maintenance: dirty chip flush + assumed-TTL sweep
-        (gpuallocator syncToK8s / TTL sweep loops)."""
-        while not self._stop.wait(self.sync_interval_s):
+        (gpuallocator syncToK8s / TTL sweep loops).  Takes its
+        generation's stop event so a stale thread can't be revived."""
+        while not stop.wait(self.sync_interval_s):
             try:
                 self.allocator.sync_to_store()
                 self.allocator.sweep_assumed()

@@ -391,24 +391,43 @@ class ObjectStore:
                 bucket = self._bucket(cls.KIND)
                 lines = 0
                 with open(path) as f:
-                    for line in f:
-                        line = line.strip()
-                        if not line:
-                            continue
-                        lines += 1
+                    raw_lines = [l.strip() for l in f if l.strip()]
+                torn = False
+                for i, line in enumerate(raw_lines):
+                    try:
                         data = json.loads(line)
-                        if "op" in data:
-                            op, data = data["op"], data.get("obj") or {}
-                        else:
-                            op = "put"
-                        data.pop("kind", None)
-                        obj = from_dict(cls, data)
-                        if op == "del":
-                            bucket.pop(obj.key(), None)
-                        else:
-                            bucket[obj.key()] = obj
-                        self._rv = max(self._rv,
-                                       obj.metadata.resource_version)
+                    except json.JSONDecodeError:
+                        if i == len(raw_lines) - 1:
+                            # a crash mid-append tears only the final
+                            # line; dropping it loses at most one entry
+                            # (re-derived from annotations) — refusing
+                            # to boot would lose everything
+                            import logging
+                            logging.getLogger("tpf.store").warning(
+                                "dropping torn trailing journal line "
+                                "in %s", path)
+                            torn = True
+                            break
+                        raise
+                    lines += 1
+                    if "op" in data:
+                        op, data = data["op"], data.get("obj") or {}
+                    else:
+                        op = "put"
+                    data.pop("kind", None)
+                    obj = from_dict(cls, data)
+                    if op == "del":
+                        bucket.pop(obj.key(), None)
+                    else:
+                        bucket[obj.key()] = obj
+                    self._rv = max(self._rv,
+                                   obj.metadata.resource_version)
                 self._journal_lines[cls.KIND] = lines
+                if torn:
+                    # rewrite the journal without the torn tail: a later
+                    # append has no trailing newline to land after and
+                    # would otherwise concatenate onto the partial line,
+                    # corrupting a then-valid entry
+                    self._compact(cls.KIND)
                 n += len(bucket)
         return n

@@ -17,7 +17,8 @@ def test_multitenant_oversubscription_fast(native_build):
     """4 tenants at 160% oversubscription on one chip: >=90% aggregate
     duty in both phases and QoS-proportional redistribution when two
     tenants go idle (compressed timeline)."""
-    env = dict(os.environ, TPF_MT_SCALE="0.5")
+    env = dict(os.environ, TPF_MT_SCALE="0.5",
+               TPF_BENCH_RESULTS_DIR="/tmp/tpf-smoke-results")
     env.pop("PALLAS_AXON_POOL_IPS", None)
     out = subprocess.run(
         [sys.executable, str(REPO_ROOT / "benchmarks" /
@@ -36,6 +37,27 @@ def test_multitenant_oversubscription_fast(native_build):
         assert share == pytest.approx(25.0, abs=3.0)
     # two idle: the hungry pair splits the freed duty ~4:8 by QoS coeff
     assert b["bonus_critical_pct"] > b["bonus_high_pct"] > 5.0
+
+
+def test_erl_tuning_gates():
+    """The shipped ERL PID defaults must pass the tuning harness's
+    acceptance gates (convergence <=3s on every scenario transient,
+    overshoot <=25%, steady-state error <=2%) — this is what pins the
+    documented defaults to evidence (quota_controller.go:321-377
+    battle-tested-defaults parity)."""
+    env = dict(os.environ,
+               TPF_BENCH_RESULTS_DIR="/tmp/tpf-smoke-results")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    out = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "benchmarks" / "erl_tuning.py")],
+        capture_output=True, text=True, env=env, cwd=str(REPO_ROOT),
+        timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    assert result["value"] is not None and result["value"] <= 3.0
+    summ = result["scenarios"]["summary"]
+    assert summ["max_overshoot_pct"] <= 25.0
+    assert summ["max_steady_state_err_pct"] <= 2.0
 
 
 def test_pjrt_proxy_launch_overhead(native_build, tmp_path):

@@ -148,3 +148,112 @@ def test_frozen_worker_blocks_until_thaw(worker_shm, limiter_lib):
     assert not done.is_set(), "launch went through while frozen"
     host.set_frozen("ns", "w", False)
     assert done.wait(timeout=2), "launch did not resume after thaw"
+
+
+def test_activate_patches_jit_globally(worker_shm, limiter_lib):
+    """activate() patches jax.jit so unmodified code is metered
+    (TPF_VTPU=1 implicit-activation path); deactivate() restores the
+    original jit."""
+    from tensorfusion_tpu.client import runtime
+
+    host, shm_path = worker_shm
+    host.update_quota("ns", "w", 0, 10000, 10**9, 10**9)
+    client = VTPUClient(limiter_lib=fresh_library(limiter_lib, "act"),
+                        shm_path=shm_path)
+    orig_jit = jax.jit
+    got = runtime.activate(client)
+    try:
+        assert got is client
+        assert jax.jit is not orig_jit
+
+        @jax.jit
+        def f(a):
+            return (a * 2).sum()
+
+        out = f(jnp.ones((64, 64), jnp.float32))
+        assert float(out) == pytest.approx(2 * 64 * 64)
+        assert client.launches == 1 and client.charged_mflops > 0
+
+        # decorator-with-kwargs form works through the patch too
+        @jax.jit
+        def g(a):
+            return a + 1
+
+        g(jnp.ones((8,), jnp.float32))
+        assert client.launches == 2
+    finally:
+        runtime.deactivate()
+        runtime._current = None
+    assert jax.jit is orig_jit
+
+
+def test_bootstrap_via_hypervisor_url(worker_shm, limiter_lib):
+    """No TPF_SHM_PATH: the client bootstraps through the hypervisor's
+    legacy endpoints — GET /limiter for its segment, POST /process to
+    register its PID (handlers/legacy.go:81-663 analog)."""
+    import json as _json
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    host, shm_path = worker_shm
+    host.update_quota("ns", "w", 0, 10000, 10**9, 10**9)
+    registered = []
+
+    class Stub(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = _json.dumps({"shm_path": shm_path}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            registered.append(_json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"{}")
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Stub)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    old_ns = os.environ.get("TPF_POD_NAMESPACE")
+    os.environ["TPF_POD_NAMESPACE"] = "ns"
+    os.environ["TPF_POD_NAME"] = "w"
+    try:
+        client = VTPUClient(
+            limiter_lib=fresh_library(limiter_lib, "boot"),
+            hypervisor_url=f"http://127.0.0.1:{httpd.server_address[1]}")
+        assert client.attached
+        assert client.shm_path == shm_path
+        assert registered and registered[0]["pid"] == os.getpid()
+        client.close()
+
+        # unreachable hypervisor: unmetered, not crashed
+        dead = VTPUClient(
+            limiter_lib=fresh_library(limiter_lib, "boot2"),
+            hypervisor_url="http://127.0.0.1:1")
+        assert not dead.attached
+        dead.charge_launch(100)   # no-op
+        assert dead.charge_hbm(100)
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        os.environ.pop("TPF_POD_NAME", None)
+        if old_ns is None:
+            os.environ.pop("TPF_POD_NAMESPACE", None)
+        else:
+            os.environ["TPF_POD_NAMESPACE"] = old_ns
+
+
+def test_charge_hbm_denied_over_budget(worker_shm, limiter_lib):
+    host, shm_path = worker_shm
+    host.update_quota("ns", "w", 0, 10000, 10**9, 10**9)
+    client = VTPUClient(limiter_lib=fresh_library(limiter_lib, "hbm"),
+                        shm_path=shm_path)
+    assert client.charge_hbm(1 << 20)                 # within 8 GiB
+    assert not client.charge_hbm(64 << 30)            # over budget
+    assert client.charge_hbm(-(1 << 20))              # release ok
