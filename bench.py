@@ -154,28 +154,45 @@ def _build_native() -> pathlib.Path:
     return build
 
 
-def _time_round(step, args, n) -> float:
-    import jax
+def _time_chain(step, params, batch, k) -> float:
+    """Wall time of ``k`` CHAINED training steps (step N's updated params
+    feed step N+1) synced by a scalar device->host fetch of the loss.
 
+    Two traps this dodges, both hit on the real TPU tunnel in round 3:
+    - independent steps get overlapped by async dispatch, collapsing the
+      measurement to dispatch cost (a 70x-impossible MFU resulted);
+    - ``jax.block_until_ready`` does NOT wait for remote execution on the
+      tunnel backend — only a host transfer truly syncs.
+    """
     t0 = time.perf_counter()
-    for _ in range(n):
-        out = step(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / n
+    loss = None
+    for _ in range(k):
+        params, loss = step(params, batch)
+    float(loss)                        # the only reliable sync barrier
+    return time.perf_counter() - t0
 
 
-def _time_interleaved(native, metered, args, steps, rounds=7):
-    """Alternate native/metered rounds and take medians, so machine-load
-    drift hits both paths equally instead of biasing one."""
-    import jax
+_K_SMALL = 2
 
-    jax.block_until_ready(native(*args))    # warmup/compile
-    jax.block_until_ready(metered(*args))
+
+def _time_interleaved(native, metered, params, batch, steps, rounds=7):
+    """Median per-step time of each path via the two-point slope
+    (T(k_big) - T(k_small)) / (k_big - k_small), which cancels the
+    constant per-sync cost — ~90 ms of relay round-trip on the TPU
+    tunnel, which would otherwise swamp the per-step signal.  Rounds
+    alternate native/metered so machine-load drift hits both paths
+    equally instead of biasing one."""
+    k_big = _K_SMALL + max(steps // rounds, 1)
+    float(native(params, batch)[1])     # warmup/compile
+    float(metered(params, batch)[1])
     n_times, m_times = [], []
-    per_round = max(steps // rounds, 1)
     for _ in range(rounds):
-        n_times.append(_time_round(native, args, per_round))
-        m_times.append(_time_round(metered, args, per_round))
+        tn = (_time_chain(native, params, batch, k_big)
+              - _time_chain(native, params, batch, _K_SMALL))
+        tm = (_time_chain(metered, params, batch, k_big)
+              - _time_chain(metered, params, batch, _K_SMALL))
+        n_times.append(tn / (k_big - _K_SMALL))
+        m_times.append(tm / (k_big - _K_SMALL))
     n_times.sort()
     m_times.sort()
     return n_times[len(n_times) // 2], m_times[len(m_times) // 2]
@@ -263,11 +280,17 @@ def child_main() -> int:
                                 config.vocab_size)
     batch_data = {"tokens": tokens, "targets": tokens}
 
-    def train_fwd_bwd(params, batch):
+    def train_step(params, batch):
+        """fwd+bwd+SGD update: returning the updated params lets the
+        timing loop chain step N's output into step N+1 (see
+        _time_chain — unchained steps get overlapped by async
+        backends and the measurement is fiction)."""
         loss, grads = jax.value_and_grad(loss_fn)(params, batch, config)
-        return loss, grads
+        new_params = jax.tree_util.tree_map(
+            lambda p, g: p - 1e-4 * g.astype(p.dtype), params, grads)
+        return new_params, loss
 
-    native = jax.jit(train_fwd_bwd)
+    native = jax.jit(train_step)
     flops_per_step = _step_flops(
         native.lower(params, batch_data).compile())
 
@@ -281,10 +304,10 @@ def child_main() -> int:
         refill_mflop_per_s=10**12)])
     client = VTPUClient(limiter_lib=str(build / "libtpf_limiter.so"),
                         shm_path=os.path.join(shm_base, "bench", "w"))
-    metered = client.meter(train_fwd_bwd)
+    metered = client.meter(train_step)
 
-    t_native, t_metered = _time_interleaved(native, metered,
-                                            (params, batch_data), STEPS)
+    t_native, t_metered = _time_interleaved(native, metered, params,
+                                            batch_data, STEPS)
 
     # SIGNED: negative = metered measured faster = noise-dominated diff.
     overhead_pct = (t_metered - t_native) / t_native * 100.0

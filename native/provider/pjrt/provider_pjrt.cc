@@ -282,10 +282,15 @@ TPF_API tpf_status_t tpf_init(void) {
     return TPF_ERR_FAILED;
 
   // Optional plugin create options from TPF_PJRT_CREATE_OPTIONS
-  // ("key=value;key2=value2", string-typed — enough for plugins that
-  // require session/endpoint parameters).
+  // ("key=value;key2=value2" → string-typed; "key:i=123" → int64 —
+  // enough for plugins that require typed session/endpoint/topology
+  // parameters at Client_Create, e.g. tunnel plugins that refuse a
+  // bare create).
   std::vector<PJRT_NamedValue> options;
   std::vector<std::string> option_storage;
+  std::vector<int64_t> int_storage;
+  struct RawOpt { size_t key_idx; size_t val_idx; bool is_int; int64_t iv; };
+  std::vector<RawOpt> raw_opts;
   if (const char* raw = getenv("TPF_PJRT_CREATE_OPTIONS")) {
     std::string s = raw;
     size_t start = 0;
@@ -295,20 +300,45 @@ TPF_API tpf_status_t tpf_init(void) {
       std::string kv = s.substr(start, end - start);
       size_t eq = kv.find('=');
       if (eq != std::string::npos) {
-        option_storage.push_back(kv.substr(0, eq));
-        option_storage.push_back(kv.substr(eq + 1));
+        std::string key = kv.substr(0, eq);
+        std::string val = kv.substr(eq + 1);
+        bool is_int = false;
+        int64_t iv = 0;
+        if (key.size() > 2 && key.compare(key.size() - 2, 2, ":i") == 0) {
+          key.resize(key.size() - 2);
+          is_int = true;
+          char* endp = nullptr;
+          iv = strtoll(val.c_str(), &endp, 10);
+          if (endp == val.c_str() || *endp != '\0') {
+            // fail loudly: a typo'd int option silently becoming 0 would
+            // misconfigure the plugin far from the root cause
+            logmsg("error", "TPF_PJRT_CREATE_OPTIONS: bad int for '" +
+                                key + "': '" + val + "'");
+            return TPF_ERR_INVALID_ARG;
+          }
+        }
+        option_storage.push_back(key);
+        option_storage.push_back(val);
+        raw_opts.push_back(
+            {option_storage.size() - 2, option_storage.size() - 1, is_int, iv});
       }
       start = end + 1;
     }
-    for (size_t i = 0; i + 1 < option_storage.size(); i += 2) {
+    for (const RawOpt& ro : raw_opts) {
       PJRT_NamedValue nv;
       memset(&nv, 0, sizeof(nv));
       nv.struct_size = PJRT_NamedValue_STRUCT_SIZE;
-      nv.name = option_storage[i].c_str();
-      nv.name_size = option_storage[i].size();
-      nv.type = PJRT_NamedValue_kString;
-      nv.string_value = option_storage[i + 1].c_str();
-      nv.value_size = option_storage[i + 1].size();
+      nv.name = option_storage[ro.key_idx].c_str();
+      nv.name_size = option_storage[ro.key_idx].size();
+      if (ro.is_int) {
+        nv.type = PJRT_NamedValue_kInt64;
+        nv.int64_value = ro.iv;
+        nv.value_size = 1;
+      } else {
+        nv.type = PJRT_NamedValue_kString;
+        nv.string_value = option_storage[ro.val_idx].c_str();
+        nv.value_size = option_storage[ro.val_idx].size();
+      }
       options.push_back(nv);
     }
   }

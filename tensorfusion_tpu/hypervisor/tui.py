@@ -1,13 +1,22 @@
 """Hypervisor terminal UI.
 
-Analog of the reference's bubbletea TUI (``pkg/hypervisor/tui/``, 1850 LoC:
-device/worker/metrics views + shm inspector dialog).  Two layers:
+Analog of the reference's bubbletea TUI (``pkg/hypervisor/tui/``: model.go,
+device_view.go, worker_view.go, metrics_view.go, chart.go, shm_dialog.go —
+list navigation, detail views with time-series charts, a cluster metrics
+view, and the raw-shm inspector dialog).  Layered the same way this repo's
+other UIs are:
 
-- a pure-text renderer (``render_*``) that produces the screens from a
-  hypervisor HTTP endpoint or live controllers — unit-testable and usable
-  for one-shot ``--once`` dumps;
-- a curses wrapper cycling the views (d=devices, w=workers, s=shm
-  inspector, q=quit) with periodic refresh.
+- pure-text renderers (``render_*``, ``TimeSeriesChart``) that produce the
+  screens from plain dicts — unit-testable, no curses;
+- a ``TuiState`` navigation model (view stack, selection, chart history) —
+  the bubbletea ``Model.Update`` analog, driven by key characters, also
+  curses-free and fully testable;
+- a thin curses wrapper that fetches from the hypervisor HTTP API each
+  tick, feeds ``TuiState`` and blits the rendered screen.
+
+Keys (reference model.go key map): d=devices w=workers m=metrics
+s=shm-inspector, j/k or arrows move the selection, enter opens the
+detail view for the selected row, esc goes back, q quits.
 
     python -m tensorfusion_tpu.hypervisor.tui --url http://127.0.0.1:8000
 """
@@ -19,7 +28,7 @@ import json
 import sys
 import time
 import urllib.request
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from .. import constants
 from .limiter_binding import ShmView, list_worker_segments
@@ -38,14 +47,105 @@ def _fmt_bytes(n: float) -> str:
     return f"{n:.0f}B"
 
 
-def render_devices(devices: List[dict]) -> str:
-    lines = ["CHIP                GEN   DUTY                        "
+# --------------------------------------------------------------------------
+# time-series charts (chart.go analog)
+# --------------------------------------------------------------------------
+
+# eighth-block characters for the partially-filled top cell of a column
+_EIGHTHS = " ▁▂▃▄▅▆▇█"
+
+
+class TimeSeriesChart:
+    """Fixed-capacity time series rendered as a block-character chart.
+
+    Mirrors chart.go: ring buffer of the last ``max_points`` samples,
+    auto-scaling max with 10% headroom, label + current/avg/max footer.
+    """
+
+    def __init__(self, label: str, width: int = 60, height: int = 5,
+                 max_points: int = 60, unit: str = "",
+                 max_value: float = 100.0):
+        self.label = label
+        self.width = width
+        self.height = height
+        self.max_points = max_points
+        self.unit = unit
+        self.auto_max = max_value
+        self.data: List[float] = []
+
+    def add(self, value: float) -> None:
+        self.data.append(float(value))
+        if len(self.data) > self.max_points:
+            del self.data[0]
+        if value > self.auto_max:
+            self.auto_max = value * 1.1
+
+    def render(self) -> str:
+        if not self.data:
+            return f"{self.label}: (no data)"
+        hi = max(self.auto_max, 1e-9)
+        cols = self.data[-self.width:]
+        rows: List[str] = []
+        # each column is a vertical bar of height*8 sub-cells
+        heights = [max(0.0, min(1.0, v / hi)) * self.height * 8
+                   for v in cols]
+        for r in range(self.height - 1, -1, -1):
+            base = r * 8
+            line = []
+            for h in heights:
+                fill = int(round(h)) - base
+                line.append(_EIGHTHS[max(0, min(8, fill))])
+            # y-axis label on the two edge rows
+            if r == self.height - 1:
+                tag = f"{hi:8.1f} ┤"
+            elif r == 0:
+                tag = f"{0.0:8.1f} ┤"
+            else:
+                tag = " " * 8 + " │"
+            rows.append(tag + "".join(line))
+        cur, avg, mx = cols[-1], sum(cols) / len(cols), max(cols)
+        rows.append(f"{self.label}: cur={cur:.1f}{self.unit} "
+                    f"avg={avg:.1f}{self.unit} max={mx:.1f}{self.unit}")
+        return "\n".join(rows)
+
+
+class _EntityHistory:
+    """Per-entity chart set (DeviceMetricsHistory / WorkerMetricsHistory)."""
+
+    def __init__(self, specs: List[Tuple[str, str, float]]):
+        self.charts = {name: TimeSeriesChart(name, unit=unit,
+                                             max_value=default_max)
+                       for name, unit, default_max in specs}
+
+    def add(self, **values: float) -> None:
+        for name, v in values.items():
+            if name in self.charts:
+                self.charts[name].add(v)
+
+    def render(self) -> str:
+        return "\n\n".join(c.render() for c in self.charts.values())
+
+
+_DEVICE_SERIES = [("duty", "%", 100.0), ("hbm_gib", "GiB", 1.0),
+                  ("power", "W", 100.0), ("temp", "C", 100.0)]
+_WORKER_SERIES = [("duty", "%", 100.0), ("hbm_gib", "GiB", 1.0)]
+
+
+# --------------------------------------------------------------------------
+# pure renderers (device_view.go / worker_view.go / metrics_view.go)
+# --------------------------------------------------------------------------
+
+
+def render_devices(devices: List[dict], selected: int = -1) -> str:
+    lines = ["  CHIP                GEN   DUTY                        "
              "HBM USED       POWER  TEMP  PARTS"]
-    for d in devices:
+    for i, d in enumerate(devices):
         info, m = d.get("info", {}), d.get("metrics") or {}
         duty = m.get("duty_cycle_pct", 0.0)
+        mark = ">" if i == selected else " "
         lines.append(
-            f"{info.get('chip_id',''):<19} {info.get('generation',''):<5} "
+            f"{mark} {info.get('chip_id',''):<19} "
+            f"{info.get('generation',''):<5} "
             f"{_bar(duty/100.0)}  "
             f"{_fmt_bytes(m.get('hbm_used_bytes', 0)):<13} "
             f"{m.get('power_watts', 0):5.0f}W "
@@ -54,14 +154,15 @@ def render_devices(devices: List[dict]) -> str:
     return "\n".join(lines)
 
 
-def render_workers(workers: List[dict]) -> str:
-    lines = ["WORKER                     ISO     QOS      DUTY   "
+def render_workers(workers: List[dict], selected: int = -1) -> str:
+    lines = ["  WORKER                     ISO     QOS      DUTY   "
              "HBM         PIDS  FROZEN"]
-    for w in workers:
+    for i, w in enumerate(workers):
         spec, st = w.get("spec", {}), w.get("status", {})
         key = f"{spec.get('namespace','')}/{spec.get('name','')}"
+        mark = ">" if i == selected else " "
         lines.append(
-            f"{key:<26} {spec.get('isolation',''):<7} "
+            f"{mark} {key:<26} {spec.get('isolation',''):<7} "
             f"{spec.get('qos',''):<8} "
             f"{st.get('duty_cycle_pct', 0.0):5.1f}% "
             f"{_fmt_bytes(st.get('hbm_used_bytes', 0)):<11} "
@@ -70,19 +171,148 @@ def render_workers(workers: List[dict]) -> str:
     return "\n".join(lines)
 
 
-def render_shm(shm_base: str) -> str:
+def render_device_detail(device: dict, history: Optional[_EntityHistory],
+                         workers: Optional[List[dict]] = None) -> str:
+    """device_view.go renderDeviceDetail analog: static info, live
+    metrics, partitions, co-resident workers, and the chart set."""
+    info, m = device.get("info", {}), device.get("metrics") or {}
+    chip = info.get("chip_id", "?")
+    lines = [f"== device {chip} ==", ""]
+    lines.append(
+        f"generation={info.get('generation','?')} "
+        f"cores={info.get('core_count','?')} "
+        f"hbm={_fmt_bytes(info.get('hbm_bytes', 0))} "
+        f"peak={info.get('peak_bf16_tflops', info.get('bf16_tflops','?'))}TF "
+        f"mesh={info.get('mesh','')} slice={info.get('slice_id','')}")
+    ici = info.get("ici_links") or []
+    if ici:
+        lines.append("ici: " + ", ".join(
+            f"{l.get('peer_chip_id','?')}({l.get('kind','')})"
+            for l in ici))
+    lines.append(
+        f"now: duty={m.get('duty_cycle_pct', 0.0):.1f}% "
+        f"hbm={_fmt_bytes(m.get('hbm_used_bytes', 0))} "
+        f"power={m.get('power_watts', 0):.0f}W "
+        f"temp={m.get('temp_celsius', 0):.0f}C")
+    parts = device.get("partitions") or []
+    if parts:
+        lines.append("")
+        lines.append("partitions:")
+        for p in parts:
+            # /api/v1/devices sends bare partition-id strings
+            # (server.py "partitions": list(e.partitions)); accept dicts
+            # too for richer feeds.
+            if isinstance(p, dict):
+                lines.append(f"  {p.get('partition_id','?'):<20} "
+                             f"cores={p.get('core_ids', '')} "
+                             f"owner={p.get('owner','')}")
+            else:
+                lines.append(f"  {p}")
+    co = [w for w in (workers or [])
+          if chip in (w.get("status", {}).get("chip_ids") or [])
+          or any(q.get("chip_id") == chip
+                 for q in w.get("spec", {}).get("devices", []))]
+    if co:
+        lines.append("")
+        lines.append("workers on this chip:")
+        for w in co:
+            spec, st = w.get("spec", {}), w.get("status", {})
+            lines.append(f"  {spec.get('namespace','')}/"
+                         f"{spec.get('name','')} "
+                         f"duty={st.get('duty_cycle_pct', 0.0):.1f}%")
+    if history is not None:
+        lines += ["", history.render()]
+    return "\n".join(lines)
+
+
+def render_worker_detail(worker: dict,
+                         history: Optional[_EntityHistory]) -> str:
+    """worker_view.go renderWorkerDetail analog."""
+    spec, st = worker.get("spec", {}), worker.get("status", {})
+    key = f"{spec.get('namespace','')}/{spec.get('name','')}"
+    lines = [f"== worker {key} ==", ""]
+    lines.append(f"isolation={spec.get('isolation','')} "
+                 f"qos={spec.get('qos','')} "
+                 f"frozen={'yes' if st.get('frozen') else 'no'} "
+                 f"pids={st.get('pids', [])}")
+    lines.append(
+        f"now: duty={st.get('duty_cycle_pct', 0.0):.1f}% "
+        f"hbm={_fmt_bytes(st.get('hbm_used_bytes', 0))} "
+        f"launches={st.get('launches', 0)} "
+        f"blocked={st.get('blocked_events', 0)}")
+    # WorkerSpec.devices: WorkerDeviceRequest dicts (framework.py)
+    reqs = spec.get("devices") or []
+    if reqs:
+        lines.append("")
+        lines.append("device requests:")
+        for q in reqs:
+            lines.append(
+                f"  {q.get('chip_id') or '(any)':<18} "
+                f"duty<={q.get('duty_percent', 0):.1f}% "
+                f"tflops={q.get('tflops', 0):.1f} "
+                f"hbm<={_fmt_bytes(q.get('hbm_bytes', 0)) if q.get('hbm_bytes') else 'inf'}"
+                + (f" template={q['partition_template']}"
+                   if q.get("partition_template") else ""))
+    chips = st.get("chip_ids") or []
+    if chips:
+        lines.append("chips: " + ", ".join(chips))
+    if history is not None:
+        lines += ["", history.render()]
+    return "\n".join(lines)
+
+
+def render_metrics(devices: List[dict], workers: List[dict]) -> str:
+    """metrics_view.go analog: cluster-level aggregates."""
+    lines = ["== cluster metrics ==", ""]
+    n = len(devices)
+    duty = sum((d.get("metrics") or {}).get("duty_cycle_pct", 0.0)
+               for d in devices)
+    hbm_used = sum((d.get("metrics") or {}).get("hbm_used_bytes", 0)
+                   for d in devices)
+    hbm_cap = sum((d.get("info") or {}).get("hbm_bytes", 0)
+                  for d in devices)
+    power = sum((d.get("metrics") or {}).get("power_watts", 0.0)
+                for d in devices)
+    lines.append(f"devices: {n}   aggregate duty: "
+                 f"{duty / max(n, 1):.1f}% avg "
+                 f"({duty:.0f}% total)")
+    lines.append(f"hbm: {_fmt_bytes(hbm_used)} / {_fmt_bytes(hbm_cap)} "
+                 f"{_bar(hbm_used / hbm_cap if hbm_cap else 0.0)}")
+    lines.append(f"power: {power:.0f}W")
+    lines.append("")
+    by_qos: Dict[str, int] = {}
+    by_iso: Dict[str, int] = {}
+    frozen = 0
+    for w in workers:
+        spec, st = w.get("spec", {}), w.get("status", {})
+        by_qos[spec.get("qos", "?")] = by_qos.get(spec.get("qos", "?"), 0) + 1
+        by_iso[spec.get("isolation", "?")] = \
+            by_iso.get(spec.get("isolation", "?"), 0) + 1
+        frozen += 1 if st.get("frozen") else 0
+    lines.append(f"workers: {len(workers)} ({frozen} frozen)")
+    if by_qos:
+        lines.append("  by qos: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(by_qos.items())))
+    if by_iso:
+        lines.append("  by isolation: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(by_iso.items())))
+    return "\n".join(lines)
+
+
+def render_shm(shm_base: str, selected: int = -1) -> str:
     """The shm inspector dialog (shm_dialog.go analog): raw token-bucket
     state of every worker segment."""
     lines = []
-    for ns, pod, path in list_worker_segments(shm_base):
+    for idx, (ns, pod, path) in enumerate(list_worker_segments(shm_base)):
+        mark = ">" if idx == selected else " "
         try:
             state = ShmView(path).read()
         except (ValueError, OSError) as e:
-            lines.append(f"{ns}/{pod}: unreadable ({e})")
+            lines.append(f"{mark} {ns}/{pod}: unreadable ({e})")
             continue
         flags = "FROZEN" if state.frozen else (
             "AUTO-FROZEN" if state.auto_frozen else "active")
-        lines.append(f"segment {ns}/{pod}  [{flags}]  "
+        lines.append(f"{mark} segment {ns}/{pod}  [{flags}]  "
                      f"heartbeat={state.heartbeat_ts_s}  "
                      f"pids={state.pids}")
         for i, dev in enumerate(state.devices):
@@ -90,15 +320,169 @@ def render_shm(shm_base: str) -> str:
                 continue
             cap = max(dev.capacity_mflop, 1)
             lines.append(
-                f"  dev{i} {dev.chip_id:<18} duty={dev.duty_limit_bp/100:5.1f}% "
+                f"   dev{i} {dev.chip_id:<18} duty={dev.duty_limit_bp/100:5.1f}% "
                 f"tokens={_bar(dev.tokens_mflop / cap, 12)} "
                 f"refill={dev.refill_mflop_per_s/1e3:.0f}GF/s "
                 f"launches={dev.launches} blocked={dev.blocked_events}")
             lines.append(
-                f"       hbm {_fmt_bytes(dev.hbm_used_bytes)}/"
+                f"        hbm {_fmt_bytes(dev.hbm_used_bytes)}/"
                 f"{_fmt_bytes(dev.hbm_limit_bytes) if dev.hbm_limit_bytes else 'inf'}"
                 f"  charged={dev.total_charged_mflop/1e3:.1f}GFLOP")
     return "\n".join(lines) if lines else f"(no segments under {shm_base})"
+
+
+# --------------------------------------------------------------------------
+# navigation model (model.go Update analog — curses-free, testable)
+# --------------------------------------------------------------------------
+
+VIEW_DEVICES = "devices"
+VIEW_WORKERS = "workers"
+VIEW_METRICS = "metrics"
+VIEW_SHM = "shm"
+VIEW_DEVICE_DETAIL = "device_detail"
+VIEW_WORKER_DETAIL = "worker_detail"
+
+
+class TuiState:
+    """View stack + selection + chart history.
+
+    ``update()`` ingests a fresh devices/workers snapshot (accumulating
+    chart history for every entity, like model.go's updateMetricsHistory);
+    ``key()`` handles one keypress and returns False when the UI should
+    exit; ``render()`` produces the current screen as text.
+    """
+
+    def __init__(self, shm_base: str = ""):
+        self.shm_base = shm_base
+        self.view = VIEW_DEVICES
+        self.sel_device = 0
+        self.sel_worker = 0
+        self.sel_shm = 0
+        self.devices: List[dict] = []
+        self.workers: List[dict] = []
+        self.device_history: Dict[str, _EntityHistory] = {}
+        self.worker_history: Dict[str, _EntityHistory] = {}
+        self.last_update = 0.0
+        self.error: Optional[str] = None
+
+    # -- data ingestion ---------------------------------------------------
+
+    def update(self, devices: List[dict], workers: List[dict]) -> None:
+        self.devices, self.workers = devices, workers
+        self.error = None
+        self.last_update = time.time()
+        self.sel_device = min(self.sel_device, max(len(devices) - 1, 0))
+        self.sel_worker = min(self.sel_worker, max(len(workers) - 1, 0))
+        for d in devices:
+            chip = (d.get("info") or {}).get("chip_id", "?")
+            h = self.device_history.setdefault(
+                chip, _EntityHistory(_DEVICE_SERIES))
+            m = d.get("metrics") or {}
+            h.add(duty=m.get("duty_cycle_pct", 0.0),
+                  hbm_gib=m.get("hbm_used_bytes", 0) / 2**30,
+                  power=m.get("power_watts", 0.0),
+                  temp=m.get("temp_celsius", 0.0))
+        for w in workers:
+            spec, st = w.get("spec", {}), w.get("status", {})
+            key = f"{spec.get('namespace','')}/{spec.get('name','')}"
+            h = self.worker_history.setdefault(
+                key, _EntityHistory(_WORKER_SERIES))
+            h.add(duty=st.get("duty_cycle_pct", 0.0),
+                  hbm_gib=st.get("hbm_used_bytes", 0) / 2**30)
+
+    # -- key handling -----------------------------------------------------
+
+    def key(self, ch: str) -> bool:
+        """Process one key; returns False to quit."""
+        if ch == "q":
+            return False
+        if ch in ("d", "w", "m", "s"):
+            self.view = {"d": VIEW_DEVICES, "w": VIEW_WORKERS,
+                         "m": VIEW_METRICS, "s": VIEW_SHM}[ch]
+            return True
+        if ch == "esc":
+            if self.view == VIEW_DEVICE_DETAIL:
+                self.view = VIEW_DEVICES
+            elif self.view == VIEW_WORKER_DETAIL:
+                self.view = VIEW_WORKERS
+            return True
+        if ch in ("j", "down", "k", "up"):
+            delta = 1 if ch in ("j", "down") else -1
+            if self.view == VIEW_DEVICES:
+                self.sel_device = _clamp(self.sel_device + delta,
+                                         len(self.devices))
+            elif self.view == VIEW_WORKERS:
+                self.sel_worker = _clamp(self.sel_worker + delta,
+                                         len(self.workers))
+            elif self.view == VIEW_SHM:
+                n = len(list_worker_segments(self.shm_base)) \
+                    if self.shm_base else 0
+                self.sel_shm = _clamp(self.sel_shm + delta, n)
+            return True
+        if ch == "enter":
+            if self.view == VIEW_DEVICES and self.devices:
+                self.view = VIEW_DEVICE_DETAIL
+            elif self.view == VIEW_WORKERS and self.workers:
+                self.view = VIEW_WORKER_DETAIL
+            return True
+        return True
+
+    # -- rendering --------------------------------------------------------
+
+    def _selected_device(self) -> Optional[dict]:
+        if 0 <= self.sel_device < len(self.devices):
+            return self.devices[self.sel_device]
+        return None
+
+    def _selected_worker(self) -> Optional[dict]:
+        if 0 <= self.sel_worker < len(self.workers):
+            return self.workers[self.sel_worker]
+        return None
+
+    def render(self) -> str:
+        if self.error:
+            return f"(error: {self.error})"
+        if self.view == VIEW_DEVICES:
+            return render_devices(self.devices, self.sel_device)
+        if self.view == VIEW_WORKERS:
+            return render_workers(self.workers, self.sel_worker)
+        if self.view == VIEW_METRICS:
+            return render_metrics(self.devices, self.workers)
+        if self.view == VIEW_SHM:
+            return render_shm(self.shm_base, self.sel_shm)
+        if self.view == VIEW_DEVICE_DETAIL:
+            d = self._selected_device()
+            if d is None:
+                return "(no device selected)"
+            chip = (d.get("info") or {}).get("chip_id", "?")
+            return render_device_detail(
+                d, self.device_history.get(chip), self.workers)
+        if self.view == VIEW_WORKER_DETAIL:
+            w = self._selected_worker()
+            if w is None:
+                return "(no worker selected)"
+            spec = w.get("spec", {})
+            key = f"{spec.get('namespace','')}/{spec.get('name','')}"
+            return render_worker_detail(w, self.worker_history.get(key))
+        return "(unknown view)"
+
+    def header(self) -> str:
+        stale = ""
+        if self.last_update and time.time() - self.last_update > 5:
+            stale = f"  (stale {time.time() - self.last_update:.0f}s)"
+        return ("tpu-fusion hypervisor  [d]evices [w]orkers [m]etrics "
+                "[s]hm  j/k+enter detail  esc back  [q]uit" + stale)
+
+
+def _clamp(idx: int, n: int) -> int:
+    if n <= 0:
+        return 0
+    return max(0, min(n - 1, idx))
+
+
+# --------------------------------------------------------------------------
+# transport + entry points
+# --------------------------------------------------------------------------
 
 
 def _fetch(url: str, path: str):
@@ -110,9 +494,13 @@ def snapshot(url: str, shm_base: str = "") -> str:
     """One-shot full dump (the --once mode)."""
     out = ["== tpu-fusion hypervisor ==", ""]
     try:
-        out.append(render_devices(_fetch(url, "/api/v1/devices")))
+        devices = _fetch(url, "/api/v1/devices")
+        workers = _fetch(url, "/api/v1/workers")
+        out.append(render_devices(devices))
         out.append("")
-        out.append(render_workers(_fetch(url, "/api/v1/workers")))
+        out.append(render_workers(workers))
+        out.append("")
+        out.append(render_metrics(devices, workers))
     except Exception as e:  # noqa: BLE001
         out.append(f"(hypervisor unreachable at {url}: {e})")
     if shm_base:
@@ -120,39 +508,56 @@ def snapshot(url: str, shm_base: str = "") -> str:
     return "\n".join(out)
 
 
+_CURSES_KEYS = {10: "enter", 13: "enter", 27: "esc"}
+
+
 def run_curses(url: str, shm_base: str, refresh_s: float = 1.0) -> None:
     import curses
 
+    state = TuiState(shm_base)
+
     def main(scr):
         curses.curs_set(0)
-        scr.nodelay(True)
-        view = "d"
+        # getch blocks at most 100ms so keys are responsive; the (slow,
+        # up-to-2x5s-timeout) HTTP fetch only runs when refresh_s has
+        # elapsed, never between keystrokes.
+        scr.timeout(100)
+        last_fetch = 0.0
         while True:
-            ch = scr.getch()
-            if ch in (ord("q"), 27):
-                return
-            if ch in (ord("d"), ord("w"), ord("s")):
-                view = chr(ch)
-            try:
-                if view == "d":
-                    body = render_devices(_fetch(url, "/api/v1/devices"))
-                elif view == "w":
-                    body = render_workers(_fetch(url, "/api/v1/workers"))
-                else:
-                    body = render_shm(shm_base)
-            except Exception as e:  # noqa: BLE001
-                body = f"(error: {e})"
+            now = time.time()
+            if now - last_fetch >= refresh_s:
+                last_fetch = now
+                try:
+                    state.update(_fetch(url, "/api/v1/devices"),
+                                 _fetch(url, "/api/v1/workers"))
+                except Exception as e:  # noqa: BLE001
+                    state.error = f"hypervisor unreachable at {url}: {e}"
             scr.erase()
-            header = ("tpu-fusion hypervisor  [d]evices [w]orkers "
-                      "[s]hm [q]uit")
             try:
-                scr.addstr(0, 0, header, curses.A_REVERSE)
-                for i, line in enumerate(body.splitlines()):
+                scr.addstr(0, 0, state.header(), curses.A_REVERSE)
+                for i, line in enumerate(state.render().splitlines()):
+                    if i + 2 >= curses.LINES - 1:
+                        break
                     scr.addstr(i + 2, 0, line[:curses.COLS - 1])
             except curses.error:
                 pass
             scr.refresh()
-            time.sleep(refresh_s)
+            while True:                 # drain every buffered key
+                ch = scr.getch()
+                if ch == -1:
+                    break
+                key = _CURSES_KEYS.get(ch)
+                if key is None:
+                    if ch == curses.KEY_DOWN:
+                        key = "down"
+                    elif ch == curses.KEY_UP:
+                        key = "up"
+                    elif 0 <= ch < 256:
+                        key = chr(ch)
+                    else:
+                        continue
+                if not state.key(key):
+                    return
 
     curses.wrapper(main)
 

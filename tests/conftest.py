@@ -19,6 +19,19 @@ if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", "
                                + " --xla_force_host_platform_device_count=8")
 os.environ.setdefault("TPF_TESTING", "1")
 
+# The axon sitecustomize may have ALREADY imported jax and pinned
+# jax_platforms to "axon,cpu" via jax.config.update (explicit config
+# beats the env var we just wrote). Force the config back so a bare
+# `pytest tests/` matches `make test` (which unsets PALLAS_AXON_POOL_IPS
+# before python starts) instead of silently running the suite over the
+# TPU tunnel.
+try:  # pragma: no cover - depends on ambient sitecustomize
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
 import pytest
 
 REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent

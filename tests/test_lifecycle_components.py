@@ -255,3 +255,84 @@ def test_tui_renderers(tmp_path):
     # unreachable hypervisor: snapshot degrades gracefully
     out = snapshot("http://127.0.0.1:1", base)
     assert "unreachable" in out and "ns/w" in out
+
+
+def test_tui_charts_and_navigation(tmp_path):
+    """TuiState (model.go Update analog): selection movement, detail
+    views with chart history, metrics aggregation, quit/back keys."""
+    from tensorfusion_tpu.hypervisor.tui import (
+        VIEW_DEVICE_DETAIL, VIEW_DEVICES, VIEW_METRICS, VIEW_WORKER_DETAIL,
+        VIEW_WORKERS, TimeSeriesChart, TuiState, render_metrics)
+
+    chart = TimeSeriesChart("duty", unit="%", max_points=4)
+    assert "(no data)" in chart.render()
+    for v in (10, 50, 90, 120, 30):     # 120 forces auto-scale re-max
+        chart.add(v)
+    assert len(chart.data) == 4         # ring buffer dropped the oldest
+    out = chart.render()
+    assert "cur=30.0%" in out and "max=120.0%" in out
+    assert "132.0" in out               # 120 * 1.1 headroom on the y-axis
+
+    def dev(chip, duty, partitions=()):
+        return {"info": {"chip_id": chip, "generation": "v5e",
+                         "hbm_bytes": 16 * 2**30, "num_cores": 1,
+                         "peak_bf16_tflops": 197},
+                "metrics": {"duty_cycle_pct": duty,
+                            "hbm_used_bytes": 4 * 2**30,
+                            "power_watts": 100.0, "temp_celsius": 50.0},
+                "partitions": list(partitions)}
+
+    def wkr(name, duty, chip):
+        # matches /api/v1/workers serialization: WorkerSpec.devices is a
+        # list of WorkerDeviceRequest dicts, partitions are id strings
+        return {"spec": {"namespace": "ml", "name": name,
+                         "isolation": "soft", "qos": "high",
+                         "devices": [{"chip_id": chip,
+                                      "duty_percent": 50.0,
+                                      "tflops": 10.0,
+                                      "hbm_bytes": 2**30}]},
+                "status": {"duty_cycle_pct": duty, "hbm_used_bytes": 2**20,
+                           "pids": [7], "frozen": False,
+                           "chip_ids": [chip]}}
+
+    st = TuiState()
+    for tick in range(3):               # history accumulates across ticks
+        st.update([dev("c0", 10.0 * tick, ["p0"]),
+                   dev("c1", 5.0)],
+                  [wkr("w0", 2.0 * tick, "c0"), wkr("w1", 1.0, "c1")])
+    assert st.device_history["c0"].charts["duty"].data == [0.0, 10.0, 20.0]
+
+    # devices -> select second row -> detail shows charts + co-workers
+    assert st.view == VIEW_DEVICES
+    st.key("j")
+    assert st.sel_device == 1
+    st.key("j")                         # clamped at the end of the list
+    assert st.sel_device == 1
+    st.key("enter")
+    assert st.view == VIEW_DEVICE_DETAIL
+    out = st.render()
+    assert "== device c1 ==" in out and "p0" not in out  # c1 has its own
+    assert "ml/w1" in out and "duty" in out
+    st.key("esc")
+    assert st.view == VIEW_DEVICES
+
+    # workers detail
+    st.key("w")
+    assert st.view == VIEW_WORKERS
+    st.key("enter")
+    assert st.view == VIEW_WORKER_DETAIL
+    out = st.render()
+    assert "== worker ml/w0 ==" in out and "duty<=50.0%" in out
+    assert "chips: c0" in out
+    st.key("esc")
+
+    # metrics view aggregates
+    st.key("m")
+    assert st.view == VIEW_METRICS
+    out = st.render()
+    assert "devices: 2" in out and "workers: 2" in out and "high=2" in out
+    assert render_metrics([], []) .startswith("== cluster metrics ==")
+
+    # q quits, anything else doesn't
+    assert st.key("x") is True
+    assert st.key("q") is False
