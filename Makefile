@@ -8,8 +8,8 @@
 PY := env -u PALLAS_AXON_POOL_IPS python
 
 .PHONY: all native test test-native verify-all check-coverage asan \
-	tsan bench bench-tpu sched-bench webhook-bench remoting-bench \
-	multitenant-bench dryrun clean
+	tsan bench bench-tpu test-tpu-live sched-bench webhook-bench remoting-bench \
+	multitenant-bench multitenant-bench-tpu dryrun clean
 
 all: native
 
@@ -49,6 +49,11 @@ bench: native
 bench-tpu: native
 	python bench.py
 
+# Live-TPU validation (needs the tunnel): real-provider conformance +
+# interception proxy metering an unmodified JAX process on the chip.
+test-tpu-live: native
+	TPF_TPU_LIVE=1 python -m pytest tests/test_tpu_live.py -x -q
+
 sched-bench:
 	$(PY) benchmarks/sched_bench.py --nodes 1000 --chips 4 --pods 10000
 
@@ -57,6 +62,11 @@ sched-bench:
 # provider-observed duty on hardware).
 multitenant-bench:
 	$(PY) benchmarks/multitenant_bench.py
+
+# Hardware variant: 4 real JAX tenant processes (own tunnel sessions)
+# shaped by the limiter+ERL on the live chip, vs a measured ceiling.
+multitenant-bench-tpu: native
+	python benchmarks/multitenant_tpu.py
 
 # ERL PID tuning sweep (defaults documented in api/types.py come from
 # this harness's artifact).

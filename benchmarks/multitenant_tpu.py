@@ -1,0 +1,361 @@
+"""4-tenant oversubscription benchmark on REAL TPU hardware.
+
+The hardware companion of ``multitenant_bench.py`` (BASELINE north star
+#2: >= 90% aggregate MXU with 4 oversubscribed vTPU tenants).  Where the
+mock variant charges synthetic tokens, here each tenant is a real JAX
+process with its own tunnel session, hammering the chip with bf16 matmul
+chains through the *cooperative metered client* (``VTPUClient.meter``:
+cost-analysis FLOP charge per launch, blocking when its shm token bucket
+runs dry), while the host runs the same ERL PID loop at 10 Hz steering
+refill rates and redistributing idle duty by QoS coefficient.
+
+Utilization is normalized against a *measured ceiling*: what a single
+unmetered tenant achieves on this chip through this tunnel (the relay
+adds ~90 ms RTT per sync; pipelining hides it, but the ceiling — not the
+datasheet peak — is the honest 100% for "did the platform waste the
+chip").  The datasheet-relative number is reported alongside.
+
+Phases (same story as the mock variant):
+- A (all four hungry, 4 x 40% contracts = 160% oversubscription):
+  ERL normalizes contracts into the chip; aggregate >= 90% of ceiling,
+  roughly equal shares.
+- B (low+medium idle): freed duty is redistributed to the hungry pair
+  in QoS proportion (critical:high coefficients 8:4), so critical's
+  bonus exceeds high's.
+
+    make multitenant-bench-tpu      # needs the live tunnel
+
+Prints one JSON line and writes benchmarks/results/multitenant_tpu.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+DIM = 4096
+NMM = 4                                  # matmuls per chunk
+CHUNK_MFLOP = NMM * 2 * DIM**3 // 10**6  # analytic cost of one chunk
+DEPTH = 32                               # dispatch-ahead bound (chunks)
+SYNC_EVERY = 16                          # consumer fetches every Nth scalar
+CONTRACT_DUTY_BP = 4000                  # 40% of ceiling per tenant
+TENANTS = [("t-low", "low"), ("t-med", "medium"),
+           ("t-high", "high"), ("t-crit", "critical")]
+
+# Timeline, seconds from the START signal (tenants are warmed and
+# waiting at t0, so no compile time pollutes the windows).
+PHASE_A = (3.0, 13.0)
+IDLE_AT = 14.0          # low+medium stop launching here
+PHASE_B = (17.0, 27.0)  # 3s ERL settle after the idle edge
+END_AT = 28.0
+
+
+# -------------------------------------------------------------------------
+# tenant child
+# -------------------------------------------------------------------------
+
+
+def tenant_main(args) -> int:
+    """One tenant process: register its own tunnel session, build the
+    chunk program, warm up, wait for the parent's START file, then run
+    depth-pipelined metered launches until its deadline."""
+    from collections import deque
+
+    import jax
+    import jax.numpy as jnp
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (DIM, DIM),
+                          dtype=jnp.bfloat16)
+
+    def chunk(x):
+        y = x
+        for _ in range(NMM):
+            # normalize so the chain is numerically stable at any depth
+            y = (y @ y) * jnp.bfloat16(1.0 / DIM)
+        return jnp.sum(y)
+
+    if args.unmetered:
+        fn = jax.jit(chunk)
+        charge = None
+    else:
+        from tensorfusion_tpu.client import VTPUClient
+
+        client = VTPUClient(limiter_lib=args.limiter_lib,
+                            shm_path=args.shm_path)
+        fn = client.meter(chunk)
+        charge = client
+
+    float(fn(x))                         # compile + first sync
+    pathlib.Path(args.ready_file).touch()
+    while not os.path.exists(args.start_file):
+        time.sleep(0.02)
+
+    # Dispatcher/consumer split: the dispatcher keeps the device queue
+    # full (bounded DEPTH chunks ahead, so charged work never leads
+    # execution unboundedly) while the consumer thread fetches result
+    # scalars — each fetch costs a full ~90 ms relay round-trip on the
+    # tunnel, and paying it inline on the dispatch path would serialize
+    # the whole tenant to one chunk per RTT (the device idles 97% —
+    # measured before this split).
+    import threading
+
+    t0 = time.monotonic()
+    deadline = t0 + args.run_s
+    pending: deque = deque()
+    done = threading.Event()
+    fetched = [0]
+
+    def consumer():
+        # Fetch only every SYNC_EVERY-th scalar: execution is in-order on
+        # the single device stream, so confirming chunk k confirms all
+        # chunks <= k; fetching each one would cost one RTT per chunk.
+        # The final future is always fetched so ``elapsed`` covers full
+        # execution of everything dispatched.
+        i = 0
+        while not (done.is_set() and not pending):
+            if pending:
+                s = pending.popleft()
+                i += 1
+                if i % SYNC_EVERY == 0 or (done.is_set()
+                                           and not pending):
+                    float(s)
+                    fetched[0] += 1
+            else:
+                time.sleep(0.001)
+
+    th = threading.Thread(target=consumer, daemon=True)
+    th.start()
+    chunks_done = 0
+    while time.monotonic() < deadline:
+        if len(pending) < DEPTH:
+            pending.append(fn(x))        # metered: may block on quota
+            chunks_done += 1
+        else:
+            time.sleep(0.001)
+    done.set()
+    th.join()                            # drain: all chunks executed
+    elapsed = time.monotonic() - t0
+
+    stats = {"chunks": chunks_done,
+             "analytic_mflop": chunks_done * CHUNK_MFLOP,
+             "elapsed_s": round(elapsed, 3),
+             "achieved_tflops": round(
+                 chunks_done * CHUNK_MFLOP / 1e6 / elapsed, 2)}
+    if charge is not None:
+        stats["charged_mflops"] = charge.charged_mflops
+        stats["launches"] = charge.launches
+        stats["blocked_time_s"] = round(charge.blocked_time_s, 3)
+    with open(args.out, "w") as f:
+        json.dump(stats, f)
+    return 0
+
+
+# -------------------------------------------------------------------------
+# parent: ceiling measurement + ERL host loop
+# -------------------------------------------------------------------------
+
+
+def _spawn_tenant(out, ready, start, run_s, shm_path="", limiter_lib="",
+                  unmetered=False):
+    cmd = [sys.executable, os.path.abspath(__file__), "--tenant",
+           "--out", out, "--ready-file", ready, "--start-file", start,
+           "--run-s", str(run_s)]
+    if unmetered:
+        cmd.append("--unmetered")
+    else:
+        cmd += ["--shm-path", shm_path, "--limiter-lib", limiter_lib]
+    # ambient env: the axon sitecustomize gives each child its own
+    # tunnel session
+    return subprocess.Popen(cmd, cwd=str(REPO))
+
+
+def _measure_ceiling(workdir: str) -> float:
+    """MFLOP/s one unmetered tenant achieves (the honest 100%)."""
+    out = os.path.join(workdir, "ceiling.json")
+    ready = os.path.join(workdir, "ceiling.ready")
+    start = os.path.join(workdir, "ceiling.start")
+    p = _spawn_tenant(out, ready, start, run_s=6.0, unmetered=True)
+    _wait_file(ready, 240, p)
+    pathlib.Path(start).touch()
+    p.wait(timeout=120)
+    stats = json.load(open(out))
+    return stats["analytic_mflop"] / stats["elapsed_s"]
+
+
+def _wait_file(path, timeout_s, proc=None):
+    t0 = time.monotonic()
+    while not os.path.exists(path):
+        if proc is not None and proc.poll() is not None:
+            raise RuntimeError(f"tenant died before ready (rc={proc.returncode})")
+        if time.monotonic() - t0 > timeout_s:
+            raise TimeoutError(f"no {path} after {timeout_s}s")
+        time.sleep(0.1)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tenant", action="store_true")
+    ap.add_argument("--unmetered", action="store_true")
+    ap.add_argument("--out")
+    ap.add_argument("--ready-file")
+    ap.add_argument("--start-file")
+    ap.add_argument("--run-s", type=float, default=30.0)
+    ap.add_argument("--shm-path", default="")
+    ap.add_argument("--limiter-lib", default="")
+    args = ap.parse_args()
+    if args.tenant:
+        return tenant_main(args)
+
+    from tensorfusion_tpu.config.chip_info import CHIP_INFO_DB
+    from tensorfusion_tpu.hypervisor import DeviceQuota, Limiter, ShmView
+    from tensorfusion_tpu.hypervisor.erl import (ERLQuotaController,
+                                                 Observation)
+
+    build = REPO / "native" / "build"
+    limiter_lib = str(build / "libtpf_limiter.so")
+    workdir = tempfile.mkdtemp(prefix="tpf_mt_tpu_")
+    shm_base = os.path.join(workdir, "shm")
+
+    print("measuring single-tenant ceiling...", file=sys.stderr)
+    ceiling_mflops_s = _measure_ceiling(workdir)
+    datasheet_mflops_s = CHIP_INFO_DB["v5e"].bf16_tflops * 1e6
+    print(f"ceiling: {ceiling_mflops_s/1e6:.1f} TF/s "
+          f"({ceiling_mflops_s/datasheet_mflops_s*100:.0f}% of datasheet)",
+          file=sys.stderr)
+
+    host = Limiter(limiter_lib)
+    host.init(shm_base)
+    contract_rate = int(CONTRACT_DUTY_BP / 10000 * ceiling_mflops_s)
+    for name, _qos in TENANTS:
+        host.create_worker("bench", name, [DeviceQuota(
+            device_index=0, chip_id="tpu-tunnel-0",
+            duty_limit_bp=CONTRACT_DUTY_BP,
+            hbm_limit_bytes=0,
+            capacity_mflop=max(contract_rate // 5, 2 * CHUNK_MFLOP),
+            refill_mflop_per_s=contract_rate)])
+
+    views = {name: ShmView(os.path.join(shm_base, "bench", name))
+             for name, _ in TENANTS}
+    start_file = os.path.join(workdir, "start")
+    procs = []
+    for name, qos in TENANTS:
+        run_s = IDLE_AT if qos in ("low", "medium") else END_AT
+        procs.append(_spawn_tenant(
+            os.path.join(workdir, f"{name}.json"),
+            os.path.join(workdir, f"{name}.ready"), start_file, run_s,
+            shm_path=os.path.join(shm_base, "bench", name),
+            limiter_lib=limiter_lib))
+    for name, _ in TENANTS:
+        _wait_file(os.path.join(workdir, f"{name}.ready"), 300,
+                   procs[[t[0] for t in TENANTS].index(name)])
+    print("tenants warm; starting phases", file=sys.stderr)
+    pathlib.Path(start_file).touch()
+
+    def read_charged():
+        return {name: v.read().devices[0].total_charged_mflop
+                for name, v in views.items()}
+
+    def read_blocked():
+        return {name: v.read().devices[0].blocked_events
+                for name, v in views.items()}
+
+    erl = ERLQuotaController()
+    t0 = time.monotonic()
+    last, last_blocked, last_t = read_charged(), read_blocked(), t0
+    marks = {}
+    boundaries = sorted({PHASE_A[0], PHASE_A[1], PHASE_B[0], PHASE_B[1]})
+    next_b = 0
+    while True:
+        time.sleep(0.1)
+        now = time.monotonic()
+        dt = now - last_t
+        cur, cur_blocked = read_charged(), read_blocked()
+        observations = []
+        for name, qos in TENANTS:
+            duty_pct = (cur[name] - last[name]) / dt / ceiling_mflops_s * 100
+            observations.append(Observation(
+                worker_key=f"bench/{name}", device_index=0,
+                chip_id="tpu-tunnel-0", quota_duty_bp=CONTRACT_DUTY_BP,
+                peak_mflops_per_s=ceiling_mflops_s,
+                measured_duty_pct=duty_pct,
+                blocked_delta=cur_blocked[name] - last_blocked[name],
+                qos=qos))
+        for up in erl.step(observations, dt):
+            name = up.worker_key.split("/", 1)[1]
+            host.update_quota("bench", name, 0,
+                              duty_limit_bp=up.duty_limit_bp,
+                              refill_mflop_per_s=up.refill_mflop_per_s,
+                              capacity_mflop=up.capacity_mflop)
+        last, last_blocked, last_t = cur, cur_blocked, now
+        elapsed = now - t0
+        while next_b < len(boundaries) and elapsed >= boundaries[next_b]:
+            marks[boundaries[next_b]] = dict(cur)
+            next_b += 1
+        if elapsed >= END_AT:
+            break
+
+    for p in procs:
+        p.wait(timeout=120)
+    tenant_stats = {}
+    for name, _ in TENANTS:
+        path = os.path.join(workdir, f"{name}.json")
+        tenant_stats[name] = json.load(open(path)) \
+            if os.path.exists(path) else {}
+
+    def window(a, b):
+        dt = b - a
+        per = {name: (marks[b][name] - marks[a][name]) / dt
+               for name, _ in TENANTS}
+        agg = sum(per.values()) / ceiling_mflops_s * 100
+        shares = {name: round(v / ceiling_mflops_s * 100, 2)
+                  for name, v in per.items()}
+        return agg, shares
+
+    agg_a, shares_a = window(*PHASE_A)
+    agg_b, shares_b = window(*PHASE_B)
+    bonus_high = shares_b["t-high"] - shares_a["t-high"]
+    bonus_crit = shares_b["t-crit"] - shares_a["t-crit"]
+
+    result = {
+        "metric": "multitenant_tpu_aggregate_duty_pct",
+        "value": round(min(agg_a, agg_b), 2),
+        "unit": "% of measured ceiling",
+        "vs_baseline": round(min(agg_a, agg_b) / 90.0, 3),
+        "platform": "tpu",
+        "tenants": len(TENANTS),
+        "oversubscription_pct": len(TENANTS) * CONTRACT_DUTY_BP / 100,
+        "ceiling_tflops": round(ceiling_mflops_s / 1e6, 2),
+        "ceiling_vs_datasheet_pct": round(
+            ceiling_mflops_s / datasheet_mflops_s * 100, 1),
+        "aggregate_vs_datasheet_pct": round(
+            min(agg_a, agg_b) * ceiling_mflops_s / datasheet_mflops_s, 2),
+        "phase_a_all_hungry": {"aggregate_duty_pct": round(agg_a, 2),
+                               "shares_pct": shares_a},
+        "phase_b_two_idle": {"aggregate_duty_pct": round(agg_b, 2),
+                             "shares_pct": shares_b,
+                             "bonus_high_pct": round(bonus_high, 2),
+                             "bonus_critical_pct": round(bonus_crit, 2)},
+        "tenant_stats": tenant_stats,
+    }
+    try:
+        from benchmarks._artifact import write_artifact
+    except ImportError:
+        from _artifact import write_artifact
+    write_artifact("multitenant_tpu", result)
+    print(json.dumps(result))
+
+    ok = agg_a >= 90.0 and agg_b >= 90.0 and bonus_crit > bonus_high
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,6 +38,7 @@
 //                   (accelerator.h:364-390).
 
 #include <dlfcn.h>
+#include <errno.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
@@ -288,7 +289,6 @@ TPF_API tpf_status_t tpf_init(void) {
   // bare create).
   std::vector<PJRT_NamedValue> options;
   std::vector<std::string> option_storage;
-  std::vector<int64_t> int_storage;
   struct RawOpt { size_t key_idx; size_t val_idx; bool is_int; int64_t iv; };
   std::vector<RawOpt> raw_opts;
   if (const char* raw = getenv("TPF_PJRT_CREATE_OPTIONS")) {
@@ -308,8 +308,9 @@ TPF_API tpf_status_t tpf_init(void) {
           key.resize(key.size() - 2);
           is_int = true;
           char* endp = nullptr;
+          errno = 0;
           iv = strtoll(val.c_str(), &endp, 10);
-          if (endp == val.c_str() || *endp != '\0') {
+          if (endp == val.c_str() || *endp != '\0' || errno == ERANGE) {
             // fail loudly: a typo'd int option silently becoming 0 would
             // misconfigure the plugin far from the root cause
             logmsg("error", "TPF_PJRT_CREATE_OPTIONS: bad int for '" +

@@ -77,13 +77,16 @@ class TimeSeriesChart:
         self.data.append(float(value))
         if len(self.data) > self.max_points:
             del self.data[0]
-        if value > self.auto_max:
-            self.auto_max = value * 1.1
 
     def render(self) -> str:
         if not self.data:
             return f"{self.label}: (no data)"
-        hi = max(self.auto_max, 1e-9)
+        # scale recomputed from the live window (+10% headroom over
+        # spikes) so a transient bad sample stops squashing the chart
+        # once it ages out of the ring buffer
+        peak = max(self.data)
+        hi = max(self.auto_max if peak <= self.auto_max else peak * 1.1,
+                 1e-9)
         cols = self.data[-self.width:]
         rows: List[str] = []
         # each column is a vertical bar of height*8 sub-cells
@@ -523,6 +526,7 @@ def run_curses(url: str, shm_base: str, refresh_s: float = 1.0) -> None:
         # elapsed, never between keystrokes.
         scr.timeout(100)
         last_fetch = 0.0
+        dirty = True
         while True:
             now = time.time()
             if now - last_fetch >= refresh_s:
@@ -532,16 +536,19 @@ def run_curses(url: str, shm_base: str, refresh_s: float = 1.0) -> None:
                                  _fetch(url, "/api/v1/workers"))
                 except Exception as e:  # noqa: BLE001
                     state.error = f"hypervisor unreachable at {url}: {e}"
-            scr.erase()
-            try:
-                scr.addstr(0, 0, state.header(), curses.A_REVERSE)
-                for i, line in enumerate(state.render().splitlines()):
-                    if i + 2 >= curses.LINES - 1:
-                        break
-                    scr.addstr(i + 2, 0, line[:curses.COLS - 1])
-            except curses.error:
-                pass
-            scr.refresh()
+                dirty = True
+            if dirty:                   # render only fresh data/keys —
+                dirty = False           # the shm view re-reads segments
+                scr.erase()             # on every render
+                try:
+                    scr.addstr(0, 0, state.header(), curses.A_REVERSE)
+                    for i, line in enumerate(state.render().splitlines()):
+                        if i + 2 >= curses.LINES - 1:
+                            break
+                        scr.addstr(i + 2, 0, line[:curses.COLS - 1])
+                except curses.error:
+                    pass
+                scr.refresh()
             while True:                 # drain every buffered key
                 ch = scr.getch()
                 if ch == -1:
@@ -558,6 +565,7 @@ def run_curses(url: str, shm_base: str, refresh_s: float = 1.0) -> None:
                         continue
                 if not state.key(key):
                     return
+                dirty = True
 
     curses.wrapper(main)
 

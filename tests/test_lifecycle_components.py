@@ -275,7 +275,7 @@ def test_tui_charts_and_navigation(tmp_path):
 
     def dev(chip, duty, partitions=()):
         return {"info": {"chip_id": chip, "generation": "v5e",
-                         "hbm_bytes": 16 * 2**30, "num_cores": 1,
+                         "hbm_bytes": 16 * 2**30, "core_count": 1,
                          "peak_bf16_tflops": 197},
                 "metrics": {"duty_cycle_pct": duty,
                             "hbm_used_bytes": 4 * 2**30,
@@ -312,6 +312,7 @@ def test_tui_charts_and_navigation(tmp_path):
     assert st.view == VIEW_DEVICE_DETAIL
     out = st.render()
     assert "== device c1 ==" in out and "p0" not in out  # c1 has its own
+    assert "cores=1" in out
     assert "ml/w1" in out and "duty" in out
     st.key("esc")
     assert st.view == VIEW_DEVICES
