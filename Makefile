@@ -9,7 +9,7 @@ PY := env -u PALLAS_AXON_POOL_IPS python
 
 .PHONY: all native test test-native verify-all check-coverage asan \
 	tsan bench bench-tpu test-tpu-live sched-bench webhook-bench remoting-bench \
-	multitenant-bench multitenant-bench-tpu dryrun clean
+	multitenant-bench multitenant-bench-tpu serving-bench-tpu dryrun clean
 
 all: native
 
@@ -67,6 +67,11 @@ multitenant-bench:
 # shaped by the limiter+ERL on the live chip, vs a measured ceiling.
 multitenant-bench-tpu: native
 	python benchmarks/multitenant_tpu.py
+
+# Serving path on the real chip: prefill + KV-decode tokens/s and the
+# achieved decode HBM bandwidth vs datasheet.
+serving-bench-tpu:
+	python benchmarks/serving_tpu.py
 
 # ERL PID tuning sweep (defaults documented in api/types.py come from
 # this harness's artifact).

@@ -118,15 +118,14 @@ def tenant_main(args) -> int:
         # Fetch only every SYNC_EVERY-th scalar: execution is in-order on
         # the single device stream, so confirming chunk k confirms all
         # chunks <= k; fetching each one would cost one RTT per chunk.
-        # The final future is always fetched so ``elapsed`` covers full
-        # execution of everything dispatched.
+        # (The dispatcher fetches the FINAL future itself after joining
+        # this thread — doing it here races the done flag.)
         i = 0
         while not (done.is_set() and not pending):
             if pending:
                 s = pending.popleft()
                 i += 1
-                if i % SYNC_EVERY == 0 or (done.is_set()
-                                           and not pending):
+                if i % SYNC_EVERY == 0:
                     float(s)
                     fetched[0] += 1
             else:
@@ -135,15 +134,19 @@ def tenant_main(args) -> int:
     th = threading.Thread(target=consumer, daemon=True)
     th.start()
     chunks_done = 0
+    last = None
     while time.monotonic() < deadline:
         if len(pending) < DEPTH:
-            pending.append(fn(x))        # metered: may block on quota
+            last = fn(x)                 # metered: may block on quota
+            pending.append(last)
             chunks_done += 1
         else:
             time.sleep(0.001)
     done.set()
-    th.join()                            # drain: all chunks executed
-    elapsed = time.monotonic() - t0
+    th.join()
+    if last is not None:
+        float(last)                      # in-order stream: confirms ALL
+    elapsed = time.monotonic() - t0      # ...so elapsed covers execution
 
     stats = {"chunks": chunks_done,
              "analytic_mflop": chunks_done * CHUNK_MFLOP,
@@ -298,7 +301,9 @@ def main() -> int:
         last, last_blocked, last_t = cur, cur_blocked, now
         elapsed = now - t0
         while next_b < len(boundaries) and elapsed >= boundaries[next_b]:
-            marks[boundaries[next_b]] = dict(cur)
+            # record the ACTUAL snapshot time: a slow host tick past the
+            # nominal boundary would otherwise inflate window rates
+            marks[boundaries[next_b]] = (elapsed, dict(cur))
             next_b += 1
         if elapsed >= END_AT:
             break
@@ -312,8 +317,9 @@ def main() -> int:
             if os.path.exists(path) else {}
 
     def window(a, b):
-        dt = b - a
-        per = {name: (marks[b][name] - marks[a][name]) / dt
+        (ta, snap_a), (tb, snap_b) = marks[a], marks[b]
+        dt = tb - ta
+        per = {name: (snap_b[name] - snap_a[name]) / dt
                for name, _ in TENANTS}
         agg = sum(per.values()) / ceiling_mflops_s * 100
         shares = {name: round(v / ceiling_mflops_s * 100, 2)

@@ -1,0 +1,141 @@
+"""Serving-path benchmark on real TPU: prefill + KV-cache decode.
+
+SURVEY §6's single-chip serving signal, measured on hardware: greedy
+generation over the Llama flagship (``models/llama.py:generate`` — one
+compiled program, prefill scan + decode scan, static shapes).  Decode is
+HBM-bandwidth-bound (every token streams the full parameter set plus the
+live KV prefix), so alongside tokens/s this reports the achieved
+HBM bandwidth implied by the decode rate against the chip's datasheet
+bandwidth — the serving analog of MFU.
+
+Timing uses the same two-point slope as bench.py: generate() is compiled
+for two different decode lengths, and (T_big - T_small)/(S_big - S_small)
+isolates per-token decode cost while the constant prefill + relay RTT
+cancels.  Prefill is isolated the same way via two prompt lengths.
+
+    make serving-bench-tpu          # needs the live tunnel
+
+Prints ONE JSON line and writes benchmarks/results/serving_tpu.json.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+BATCH = 8
+PROMPT_SMALL, PROMPT_BIG = 128, 512
+DECODE_SMALL, DECODE_BIG = 32, 160
+ROUNDS = 5
+
+
+def _param_bytes(params) -> int:
+    import jax
+
+    return sum(x.size * x.dtype.itemsize
+               for x in jax.tree_util.tree_leaves(params))
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from tensorfusion_tpu.config.chip_info import CHIP_INFO_DB
+    from tensorfusion_tpu.models import LlamaConfig, init_params
+    from tensorfusion_tpu.models.llama import generate
+
+    device = jax.devices()[0]
+    if device.platform != "tpu":
+        print(json.dumps({"metric": "serving_decode_tokens_per_s",
+                          "value": None, "unit": "tok/s",
+                          "vs_baseline": None,
+                          "error": f"needs a TPU (got {device.platform})"}))
+        return 1
+
+    config = LlamaConfig(
+        vocab_size=32000, dim=2048, n_layers=16, n_heads=16,
+        n_kv_heads=8, ffn_dim=8192, max_seq_len=PROMPT_BIG + DECODE_BIG,
+        dtype=jnp.bfloat16)
+    params = init_params(config, jax.random.PRNGKey(0))
+    pbytes = _param_bytes(params)
+
+    def prompt(n):
+        return jax.random.randint(jax.random.PRNGKey(1), (BATCH, n), 0,
+                                  config.vocab_size)
+
+    gens = {}
+    for plen, steps in ((PROMPT_BIG, DECODE_SMALL),
+                        (PROMPT_BIG, DECODE_BIG),
+                        (PROMPT_SMALL, DECODE_SMALL)):
+        fn = jax.jit(lambda p, t, s=steps: generate(p, t, s, config))
+        toks = prompt(plen)
+        out = fn(params, toks)
+        out.block_until_ready()
+        _ = jax.device_get(out)          # true sync on the tunnel
+        gens[(plen, steps)] = (fn, toks)
+
+    def timed(key):
+        fn, toks = gens[key]
+        best = float("inf")
+        for _ in range(ROUNDS):
+            t0 = time.perf_counter()
+            out = fn(params, toks)
+            _ = jax.device_get(out)      # host fetch = the only real sync
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_ps_ds = timed((PROMPT_SMALL, DECODE_SMALL))
+    t_pb_ds = timed((PROMPT_BIG, DECODE_SMALL))
+    t_pb_db = timed((PROMPT_BIG, DECODE_BIG))
+
+    # slopes: prompt-length delta isolates prefill; decode-length delta
+    # isolates decode; constant (RTT, fixed scan overhead) cancels
+    prefill_tok_s = BATCH * (PROMPT_BIG - PROMPT_SMALL) \
+        / max(t_pb_ds - t_ps_ds, 1e-9)
+    decode_tok_s = BATCH * (DECODE_BIG - DECODE_SMALL) \
+        / max(t_pb_db - t_pb_ds, 1e-9)
+
+    # decode HBM roofline: each decode step streams all params once plus
+    # the KV prefix (batch x kv_heads x seqlen x head_dim x 2 sides x 2B)
+    seq_mid = PROMPT_BIG + (DECODE_SMALL + DECODE_BIG) // 2
+    kv_bytes = (2 * BATCH * config.n_kv_heads * seq_mid
+                * config.head_dim * 2)
+    step_time = BATCH / decode_tok_s
+    hbm_gbps = (pbytes + kv_bytes) / step_time / 1e9
+    datasheet_gbps = CHIP_INFO_DB["v5e"].hbm_gbps
+
+    result = {
+        "metric": "serving_decode_tokens_per_s",
+        "value": round(decode_tok_s, 1),
+        "unit": "tok/s",
+        # serving analog of MFU: fraction of datasheet HBM bandwidth the
+        # decode loop actually streams
+        "vs_baseline": round(hbm_gbps / datasheet_gbps, 3),
+        "platform": "tpu",
+        "device_kind": getattr(device, "device_kind", ""),
+        "batch": BATCH,
+        "model": {"dim": config.dim, "n_layers": config.n_layers,
+                  "ffn_dim": config.ffn_dim,
+                  "param_bytes": pbytes},
+        "prefill_tokens_per_s": round(prefill_tok_s, 1),
+        "decode_step_ms": round(step_time * 1e3, 3),
+        "decode_hbm_gbps": round(hbm_gbps, 1),
+        "datasheet_hbm_gbps": datasheet_gbps,
+        "hbm_utilization_pct": round(hbm_gbps / datasheet_gbps * 100, 1),
+    }
+    try:
+        from benchmarks._artifact import write_artifact
+    except ImportError:
+        from _artifact import write_artifact
+    write_artifact("serving_tpu", result)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -65,9 +65,13 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
 
     @pl.when(causal_live)
     def _accumulate():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        # MXU dots take the native (bf16) operands — upcasting q/k/v to
+        # f32 before the dot quarters MXU throughput (measured 0.7x vs
+        # XLA attention on a v5e; bf16-in/f32-accumulate runs 2x+).
+        # Accumulation stays f32 via preferred_element_type.
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
             q_pos = q_offset + lax.broadcasted_iota(jnp.int32, s.shape, 0)
@@ -81,7 +85,7 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         m_ref[:] = m_new
         l_ref[:] = l * corr + p.sum(axis=1, keepdims=True)
         acc_ref[:] = acc_ref[:] * corr + jnp.dot(
-            p, v, preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
 
     @pl.when(ki == nk - 1)
     def _finish():

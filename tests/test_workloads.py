@@ -127,8 +127,9 @@ def test_llama_with_ring_attention_matches_full():
 
 
 def test_moe_ep_sharded_matches_unsharded():
-    """Expert-parallel MoE: the GShard dense-dispatch forward under an
-    ep-sharded mesh must match the single-device computation."""
+    """Expert-parallel MoE: the forward (default sorted-scatter
+    dispatch) under an ep-sharded mesh must match the single-device
+    computation."""
     from tensorfusion_tpu.models import (MoEConfig, init_moe_params,
                                          moe_forward, shard_moe_params)
 
@@ -248,6 +249,35 @@ def test_generate_single_program_greedy():
     np.testing.assert_array_equal(np.asarray(gen[:, 0]),
                                   np.asarray(want0))
 
+    # EVERY generated token must match a scanned-decode reference (the
+    # pre-batched-prefill algorithm): this validates the KV cache that
+    # prefill() builds — a wrong rope position / transpose / dtype in
+    # the cache fill only corrupts tokens 1..N, which gen[:, 0] alone
+    # would never catch.
+    from jax import lax as _lax
+
+    from tensorfusion_tpu.models.llama import decode_step, init_kv_cache
+
+    cache = init_kv_cache(cfg, prompt.shape[0],
+                          max_len=prompt.shape[1] + 6)
+
+    def scanned_prefill(carry, tok):
+        cache, pos = carry
+        logits, cache = decode_step(params, tok, cache, pos, cfg)
+        return (cache, pos + 1), logits
+
+    (cache, pos), logits = _lax.scan(
+        scanned_prefill, (cache, jnp.int32(0)), prompt.T)
+    tok = jnp.argmax(logits[-1], -1).astype(prompt.dtype)
+    want = [tok]
+    for _ in range(5):
+        logits, cache = decode_step(params, tok, cache, pos, cfg)
+        pos = pos + 1
+        tok = jnp.argmax(logits, -1).astype(prompt.dtype)
+        want.append(tok)
+    np.testing.assert_array_equal(np.asarray(gen),
+                                  np.asarray(jnp.stack(want, axis=1)))
+
 
 def test_checkpoint_save_restore_resumes_exactly(tmp_path):
     """Orbax-backed training checkpoints: save params+opt at a step,
@@ -294,3 +324,36 @@ def test_checkpoint_save_restore_resumes_exactly(tmp_path):
             .sharding.spec == P("fsdp", "tp")
     finally:
         ck.close()
+
+
+def test_moe_scatter_dispatch_matches_dense():
+    """The sorted-scatter dispatch (default) must reproduce the dense
+    GShard einsum dispatch exactly: same routing, same first-come
+    capacity slots, same combine weights — including under capacity
+    pressure and through the gradient."""
+    import dataclasses
+
+    from tensorfusion_tpu.models import MoEConfig
+    from tensorfusion_tpu.models.moe import (_moe_block, init_moe_params)
+
+    for cap_factor in (1.25, 0.5):      # roomy + overflowing
+        cfg_s = dataclasses.replace(MoEConfig.tiny(n_experts=4),
+                                    capacity_factor=cap_factor,
+                                    dispatch_impl="scatter")
+        cfg_d = dataclasses.replace(cfg_s, dispatch_impl="dense")
+        params = init_moe_params(cfg_s, jax.random.PRNGKey(0))
+        p = params["layers"][0]["moe"]
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, cfg_s.dim),
+                              jnp.float32)
+
+        y_s = _moe_block(cfg_s, p, x)
+        y_d = _moe_block(cfg_d, p, x)
+        np.testing.assert_allclose(np.asarray(y_s), np.asarray(y_d),
+                                   rtol=1e-5, atol=1e-5)
+
+        g_s = jax.grad(lambda p: _moe_block(cfg_s, p, x).sum())(p)
+        g_d = jax.grad(lambda p: _moe_block(cfg_d, p, x).sum())(p)
+        for ks in g_s:
+            np.testing.assert_allclose(
+                np.asarray(g_s[ks]), np.asarray(g_d[ks]),
+                rtol=2e-4, atol=2e-4, err_msg=f"grad mismatch: {ks}")
