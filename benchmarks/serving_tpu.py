@@ -107,7 +107,19 @@ def main() -> int:
                 * config.head_dim * 2)
     step_time = BATCH / decode_tok_s
     hbm_gbps = (pbytes + kv_bytes) / step_time / 1e9
-    datasheet_gbps = CHIP_INFO_DB["v5e"].hbm_gbps
+    # derive the roofline from the ATTACHED chip, not an assumed v5e
+    kind = (getattr(device, "device_kind", "") or "").lower()
+    info = next((i for gen, i in CHIP_INFO_DB.items()
+                 if gen in kind.replace(" ", "")), None)
+    if info is None and "tpu" in kind:
+        info = CHIP_INFO_DB["v5e"]          # tunnel reports "TPU v5 lite"
+    if info is None:
+        print(json.dumps({"metric": "serving_decode_tokens_per_s",
+                          "value": None, "unit": "tok/s",
+                          "vs_baseline": None,
+                          "error": f"unknown chip kind {kind!r}"}))
+        return 1
+    datasheet_gbps = info.hbm_gbps
 
     result = {
         "metric": "serving_decode_tokens_per_s",

@@ -185,13 +185,17 @@ def _mlp(p, x):
     return (jax.nn.silu(x @ p["w_gate"]) * (x @ p["w_up"])) @ p["w_down"]
 
 
-def _layer(config: LlamaConfig, layer, x, mesh=None):
-    x = x + _attention(config, layer["attn"],
-                       _rms_norm(x, layer["attn_norm"], config.norm_eps),
-                       mesh)
+def _layer(config: LlamaConfig, layer, x, mesh=None, return_kv=False):
+    h = _rms_norm(x, layer["attn_norm"], config.norm_eps)
+    if return_kv:
+        attn, k, v = _attention(config, layer["attn"], h, mesh,
+                                return_kv=True)
+    else:
+        attn = _attention(config, layer["attn"], h, mesh)
+    x = x + attn
     x = x + _mlp(layer["mlp"],
                  _rms_norm(x, layer["mlp_norm"], config.norm_eps))
-    return x
+    return (x, k, v) if return_kv else x
 
 
 def forward(params: Dict, tokens: jax.Array, config: LlamaConfig,
@@ -335,11 +339,9 @@ def prefill(params: Dict, prompt: jax.Array, config: LlamaConfig,
     x = params["tok_emb"][prompt]
     ks, vs = [], []
     for layer in params["layers"]:
-        h = _rms_norm(x, layer["attn_norm"], config.norm_eps)
-        # the SAME attention as forward() (honoring attn_impl), with the
-        # post-rope K/V captured for the cache
-        out, k, v = _attention(config, layer["attn"], h, mesh=None,
-                               return_kv=True)
+        # the SAME layer body as forward() (honoring attn_impl), with
+        # the post-rope K/V captured for the cache
+        x, k, v = _layer(config, layer, x, mesh=None, return_kv=True)
         kc = jnp.zeros((b, config.n_kv_heads, cache_len, hd),
                        config.dtype)
         ks.append(lax.dynamic_update_slice(
@@ -349,9 +351,6 @@ def prefill(params: Dict, prompt: jax.Array, config: LlamaConfig,
             jnp.zeros_like(kc),
             v.transpose(0, 2, 1, 3).astype(config.dtype),
             (0, 0, 0, 0)))
-        x = x + out
-        x = x + _mlp(layer["mlp"],
-                     _rms_norm(x, layer["mlp_norm"], config.norm_eps))
     x = _rms_norm(x, params["final_norm"], config.norm_eps)
     logits = (x[:, -1, :] @ params["lm_head"]).astype(jnp.float32)
     return logits, {"k": ks, "v": vs}

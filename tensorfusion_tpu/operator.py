@@ -352,11 +352,14 @@ class Operator:
         self.scheduler.activate()
 
 
-def main(argv=None) -> int:
+def main(argv=None, stop_event: Optional[threading.Event] = None) -> int:
     """Operator daemon entrypoint (cmd/main.go analog):
 
         python -m tensorfusion_tpu.operator --port 8080 \
             [--persist-dir DIR] [--bootstrap-host v5e:8]
+
+    ``stop_event`` lets tests drive the full wiring in-process (signal
+    handlers only install in the main thread).
     """
     import argparse
     import os
@@ -465,9 +468,12 @@ def main(argv=None) -> int:
     log.info("operator API serving on %s%s", server.url,
              " (HA candidate)" if args.store_url else "")
 
-    stop = threading.Event()
-    signal.signal(signal.SIGTERM, lambda *a: stop.set())
-    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop = stop_event or threading.Event()
+    try:
+        signal.signal(signal.SIGTERM, lambda *a: stop.set())
+        signal.signal(signal.SIGINT, lambda *a: stop.set())
+    except ValueError:          # not the main thread (in-process test)
+        pass
     try:
         while not stop.wait(0.5):
             pass

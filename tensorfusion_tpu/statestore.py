@@ -108,7 +108,10 @@ class StateStoreServer:
         self._httpd.server_close()
 
 
-def main(argv=None) -> int:
+def main(argv=None, stop_event: Optional[threading.Event] = None) -> int:
+    """Daemon entry point.  ``stop_event`` lets tests drive the full
+    wiring in-process (signal handlers only install in the main
+    thread)."""
     import argparse
     import os
     import signal
@@ -143,9 +146,12 @@ def main(argv=None) -> int:
             f.write(str(server.port))
     log.info("state store serving on %s", server.url)
 
-    stop = threading.Event()
-    signal.signal(signal.SIGTERM, lambda *a: stop.set())
-    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop = stop_event or threading.Event()
+    try:
+        signal.signal(signal.SIGTERM, lambda *a: stop.set())
+        signal.signal(signal.SIGINT, lambda *a: stop.set())
+    except ValueError:          # not the main thread (in-process test)
+        pass
     try:
         while not stop.wait(0.5):
             pass

@@ -535,3 +535,118 @@ def test_two_process_cluster_e2e(native_build, limiter_lib, tmp_path):
         for name in logs:
             tail = (tmp_path / f"{name}.log").read_text()[-1500:]
             print(f"--- {name} log tail ---\n{tail}")
+
+
+def test_statestore_main_in_process(tmp_path):
+    """main()'s full wiring (flags, persist load, port-file, token from
+    env, serve loop, clean stop) driven in-process so the coverage gate
+    sees it — the subprocess variant above proves the production spawn
+    path, but its lines are invisible to pycov."""
+    import threading
+
+    from tensorfusion_tpu import statestore
+
+    persist = tmp_path / "persist"
+    # pre-seed a persisted object so main()'s load branch runs
+    seed = ObjectStore(persist_dir=str(persist))
+    seed.create(Pod.new("seeded", namespace="d"))
+
+    pf = tmp_path / "port"
+    stop = threading.Event()
+    rc = []
+    th = threading.Thread(target=lambda: rc.append(statestore.main(
+        ["--port", "0", "--persist-dir", str(persist),
+         "--token", "tok", "--port-file", str(pf), "-v"],
+        stop_event=stop)))
+    th.start()
+    try:
+        _wait(pf.exists, timeout=30, desc="port file")
+        url = f"http://127.0.0.1:{pf.read_text().strip()}"
+        rs = RemoteStore(url, token="tok")
+        _wait(lambda: rs.ping(), desc="healthz")
+        assert [p.metadata.name for p in rs.list(Pod)] == ["seeded"]
+        with pytest.raises(PermissionError):
+            RemoteStore(url).list(Pod)          # token enforced
+    finally:
+        stop.set()
+        th.join(timeout=10)
+    assert rc == [0]
+
+
+def test_operator_main_in_process(tmp_path):
+    """Operator main() wiring in-process (pycov-visible): persist load,
+    pool + host bootstrap, metrics file, port-file, API serving, clean
+    stop — then the --store-url HA candidate branch against an
+    in-process state store."""
+    import threading
+
+    from tensorfusion_tpu import operator as operator_mod
+    from tensorfusion_tpu.api.types import TPUChip, TPUPool
+
+    persist = tmp_path / "persist"
+    seed = ObjectStore(persist_dir=str(persist))
+    seed.create(Pod.new("seeded", namespace="d"))
+
+    pf = tmp_path / "port"
+    stop = threading.Event()
+    rc = []
+    th = threading.Thread(target=lambda: rc.append(operator_mod.main(
+        ["--port", "0", "--persist-dir", str(persist),
+         "--pool", "pool-t", "--bootstrap-host", "v5e:4",
+         "--metrics-path", str(tmp_path / "metrics.influx"),
+         "--port-file", str(pf)],
+        stop_event=stop)))
+    th.start()
+    try:
+        _wait(pf.exists, timeout=30, desc="operator port file")
+        url = f"http://127.0.0.1:{pf.read_text().strip()}"
+
+        def chips_up():
+            try:
+                with urllib.request.urlopen(url + "/allocator-info",
+                                            timeout=5) as r:
+                    return r.status == 200
+            except OSError:
+                return False
+
+        _wait(chips_up, timeout=30, desc="operator API")
+        # bootstrap-host provisioned chips into the store behind the API
+        rs = RemoteStore(url)
+        _wait(lambda: len(rs.list(TPUChip)) == 4, timeout=30,
+              desc="bootstrap chips")
+        assert rs.get(TPUPool, "pool-t") is not None
+        assert [p.metadata.name for p in rs.list(Pod, namespace="d")] \
+            == ["seeded"]
+    finally:
+        stop.set()
+        th.join(timeout=15)
+    assert rc == [0]
+
+    # HA branch: candidate against a remote store becomes leader
+    from tensorfusion_tpu.statestore import StateStoreServer
+
+    ss = StateStoreServer(ObjectStore())
+    ss.start()
+    stop2 = threading.Event()
+    rc2 = []
+    pf2 = tmp_path / "port2"
+    th2 = threading.Thread(target=lambda: rc2.append(operator_mod.main(
+        ["--port", "0", "--store-url", ss.url, "--identity", "op-test",
+         "--lease-duration-s", "2", "--renew-interval-s", "0.5",
+         "--port-file", str(pf2)],
+        stop_event=stop2)))
+    th2.start()
+    try:
+        _wait(pf2.exists, timeout=30, desc="HA operator port file")
+        from tensorfusion_tpu.api.types import Lease
+
+        def is_leader():
+            ls = RemoteStore(ss.url).list(Lease)
+            return any(l.spec.holder == "op-test" for l in ls)
+
+        _wait(is_leader, timeout=30, desc="leadership")
+    finally:
+        stop2.set()
+        th2.join(timeout=15)
+        ss.stop()
+    assert rc2 == [0]
