@@ -96,6 +96,7 @@ class ChipState:
             PartitionPlanRegistry()
         self.allocated = ResourceAmount()
         self.holders: Dict[str, ResourceAmount] = {}   # pod key -> per-chip amt
+        self.exclusive_keys: set = set()   # holders that own the whole chip
         self.partition_cores_used = 0
         #: pod key -> concrete core placement (planner bitmask arithmetic)
         self.partition_placements: Dict[str, Placement] = {}
@@ -151,10 +152,37 @@ class ChipState:
     # -- mutation ---------------------------------------------------------
 
     def hold(self, key: str, amount: ResourceAmount,
-             partition_template: str = "") -> None:
+             partition_template: str = "", exclusive: bool = False) -> None:
         if key in self.holders:
             raise AllocationConflictError(
                 f"{key} already holds chip {self.chip.name}")
+        # exclusivity is re-checked here (not only in the filter): a
+        # concurrent allocation can take the chip between Filter and
+        # Assume, and an exclusive hold must never share silicon
+        if self.exclusive_keys:
+            raise InsufficientResourcesError(
+                f"chip {self.chip.name} exclusively held")
+        if exclusive and self.holders:
+            raise InsufficientResourcesError(
+                f"chip {self.chip.name} not empty for exclusive hold")
+        # tflops and duty% are two denominations of the same MXU time;
+        # a hold expressed in only one must deplete both, or a duty-only
+        # hold (proxied native pod, unknown-generation migration) would
+        # reserve nothing against tflops-denominated requests. Here the
+        # chip's own capacity is known, so the conversion is exact.
+        cap = self.chip.status.capacity
+        if cap.tflops > 0:
+            if amount.duty_percent > 0 and amount.tflops <= 0:
+                amount = ResourceAmount(
+                    tflops=amount.duty_percent / 100.0 * cap.tflops,
+                    duty_percent=amount.duty_percent,
+                    hbm_bytes=amount.hbm_bytes)
+            elif amount.tflops > 0 and amount.duty_percent <= 0:
+                amount = ResourceAmount(
+                    tflops=amount.tflops,
+                    duty_percent=min(100.0,
+                                     amount.tflops / cap.tflops * 100.0),
+                    hbm_bytes=amount.hbm_bytes)
         placement = None
         if partition_template:
             placement = self.plan_partition(partition_template)
@@ -163,6 +191,8 @@ class ChipState:
                     f"no placement for template {partition_template} on "
                     f"chip {self.chip.name}")
         self.holders[key] = amount
+        if exclusive:
+            self.exclusive_keys.add(key)
         self.allocated = self.allocated.add(amount)
         self._avail_cache = None
         if placement is not None:
@@ -176,6 +206,7 @@ class ChipState:
         amount = self.holders.pop(key, None)
         if amount is None:
             return
+        self.exclusive_keys.discard(key)
         self.allocated = self.allocated.sub(amount)
         self._avail_cache = None
         placement = self.partition_placements.pop(key, None)
@@ -509,7 +540,8 @@ class TPUAllocator:
                     continue  # nominee no longer fits; it can't block
                 for c in strategy.select(res.chips, nreq.chip_count):
                     c.hold(f"__nominated_{i}__", nreq.request,
-                           nreq.partition_template)
+                           nreq.partition_template,
+                           exclusive=nreq.exclusive)
             res = run_filters(self._filters, req, clones)
             return len(res.chips) >= req.chip_count
 
@@ -555,7 +587,8 @@ class TPUAllocator:
                             continue
                         for c in chosen:
                             c.hold(req.key(), per_chip,
-                                   req.partition_template)
+                                   req.partition_template,
+                                   exclusive=req.exclusive)
                             held.append((c, req.key(),
                                          req.partition_template))
                             touched.append(c.chip.name)
@@ -590,7 +623,8 @@ class TPUAllocator:
             held = []
             try:
                 for c in chips:
-                    c.hold(key, per_chip, req.partition_template)
+                    c.hold(key, per_chip, req.partition_template,
+                           exclusive=req.exclusive)
                     held.append(c)
             except (AllocationConflictError, InsufficientResourcesError):
                 # conflict or no partition placement (a concurrent
@@ -839,7 +873,8 @@ class TPUAllocator:
                         continue
                     try:
                         state.hold(record.key, per_chip,
-                                   record.request.partition_template)
+                                   record.request.partition_template,
+                                   exclusive=record.request.exclusive)
                     except InsufficientResourcesError:
                         # corrupt annotations must not kill restart
                         # recovery; the pod keeps its record, unplaced

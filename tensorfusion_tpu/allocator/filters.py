@@ -123,11 +123,19 @@ class NodeExclusionFilter(Filter):
 
 class ResourceFitFilter(Filter):
     """Capacity check: request must fit the chip's remaining virtual
-    TFLOPs (oversold) and physical HBM."""
+    TFLOPs (oversold), physical HBM, and MXU duty share — duty is its
+    own dimension so whole-chip duty-only holds (proxied native pods,
+    migrated pods of unknown generation) block tflops-denominated
+    placements and vice versa."""
 
     name = "resource-fit"
 
     def check(self, req, chip):
+        if chip.exclusive_keys and req.key() not in chip.exclusive_keys:
+            return "chip exclusively held"
+        if req.exclusive and chip.holders and \
+                set(chip.holders) != {req.key()}:
+            return "exclusive request needs an empty chip"
         avail = chip.available()
         if req.request.tflops > avail.tflops + 1e-9:
             return (f"insufficient tflops: want {req.request.tflops:.1f}, "
@@ -135,6 +143,10 @@ class ResourceFitFilter(Filter):
         if req.request.hbm_bytes > avail.hbm_bytes + 1e-9:
             return (f"insufficient HBM: want {req.request.hbm_bytes:.0f}, "
                     f"have {avail.hbm_bytes:.0f}")
+        if req.request.duty_percent > avail.duty_percent + 1e-9:
+            return (f"insufficient duty: want "
+                    f"{req.request.duty_percent:.0f}%, "
+                    f"have {avail.duty_percent:.0f}%")
         return None
 
 

@@ -37,6 +37,9 @@ class PoolVectorView:
         self.index = {n: i for i, n in enumerate(self.names)}
         n = len(self.states)
         self.avail_tflops = np.zeros(n)
+        self.avail_duty = np.zeros(n)
+        self.n_holders = np.zeros(n, dtype=np.int32)
+        self.has_exclusive = np.zeros(n, dtype=bool)
         self.avail_hbm = np.zeros(n)
         self.cap_tflops = np.zeros(n)
         self.cap_hbm = np.zeros(n)
@@ -83,6 +86,9 @@ class PoolVectorView:
         avail = c.available()
         cap = c.virtual_capacity()
         self.avail_tflops[i] = avail.tflops
+        self.avail_duty[i] = avail.duty_percent
+        self.n_holders[i] = len(c.holders)
+        self.has_exclusive[i] = bool(c.exclusive_keys)
         self.avail_hbm[i] = avail.hbm_bytes
         self.cap_tflops[i] = cap.tflops
         self.cap_hbm[i] = cap.hbm_bytes
@@ -108,6 +114,29 @@ class PoolVectorView:
                        out=mask)
         np.logical_and(mask, self.avail_hbm >= req.request.hbm_bytes - 1e-9,
                        out=mask)
+        np.logical_and(mask,
+                       self.avail_duty >= req.request.duty_percent - 1e-9,
+                       out=mask)
+        # exclusivity, with the same self-carveouts as the Python chain
+        # (ResourceFitFilter): a chip held exclusively BY this request
+        # stays eligible, and an exclusive request tolerates a chip whose
+        # only holder is itself (restart/recheck flows)
+        self_key = req.key()
+        pre_exclusivity = None
+        if self.has_exclusive.any() or \
+                (req.exclusive and self.n_holders.any()):
+            pre_exclusivity = mask.copy()
+        np.logical_and(mask, ~self.has_exclusive, out=mask)
+        if req.exclusive:
+            np.logical_and(mask, self.n_holders == 0, out=mask)
+        if pre_exclusivity is not None:
+            for i in np.nonzero(pre_exclusivity & ~mask)[0]:
+                c = self.states[i]
+                if c.exclusive_keys and c.exclusive_keys != {self_key}:
+                    continue
+                if req.exclusive and set(c.holders) != {self_key}:
+                    continue
+                mask[i] = True
         if req.generation:
             code = self.gen_map.get(req.generation, -1)
             np.logical_and(mask, self.gen_code == code, out=mask)

@@ -7,7 +7,7 @@ from .resources import (AdjustRequest, AllocRequest, AutoScalingConfig,
 from .types import (ALL_KINDS, AutoFreezeRule, ChipModelInfo, ChipPartition,
                     ComponentConfig, CompactionConfig, ComputingVendorConfig,
                     Container, DeviceMountRule, ERLParameters, GangStatus,
-                    HypervisorScheduling, ICILink, MeshCoords, Node,
+                    HypervisorScheduling, ICILink, MeshCoords, Namespace, Node,
                     NodeManagerConfig, NodeStatus, OversubscriptionConfig,
                     PartitionTemplateSpec, Pod, PodSpec,
                     PodStatus, PoolCapacity, ProviderConfig,

@@ -135,6 +135,10 @@ class AllocRequest:
     node_affinity: Dict[str, str] = field(default_factory=dict)
     excluded_nodes: List[str] = field(default_factory=list)  # defrag/migration
     same_node: bool = True      # multi-chip must land on one node
+    #: whole-chip exclusivity: nothing may colocate with this hold and it
+    #: requires an empty chip (native pods, dedicated-chip workloads) —
+    #: overrides oversubscription entirely
+    exclusive: bool = False
     gang: GangConfig = field(default_factory=GangConfig)
 
     def key(self) -> str:

@@ -98,6 +98,30 @@ class Node(Resource):
     status: NodeStatus = field(default_factory=NodeStatus)
 
 
+@dataclass
+class Namespace(Resource):
+    """Cluster namespace object — carries the labels the webhook's
+    auto-migration namespace selector matches against
+    (ref: internal/webhook/v1/auto_migration.go:94-106)."""
+
+    KIND = "Namespace"
+    NAMESPACED = False
+
+
+def native_chip_counts(pod: "Pod") -> Dict[str, int]:
+    """Per-container native whole-chip requests — the single definition
+    shared by the webhook's migration decision, the parser's conversion
+    and the scheduler's proxied-pod accounting
+    (``HasGPUResourceRequest`` analog, internal/utils/reconcile.go:200)."""
+    return {c.name: c.chip_count for c in (pod.spec.containers or [])
+            if c.chip_count > 0}
+
+
+def native_chip_request(pod: "Pod") -> int:
+    """Total native chips requested across containers."""
+    return sum(native_chip_counts(pod).values())
+
+
 # --------------------------------------------------------------------------
 # TPUCluster  (ref: api/v1/tensorfusioncluster_types.go:25-199)
 # --------------------------------------------------------------------------
@@ -709,4 +733,4 @@ class Lease(Resource):
 ALL_KINDS = [TPUCluster, TPUPool, TPUChip, TPUNode, TPUNodeClass,
              TPUNodeClaim, TPUWorkload, TPUConnection, WorkloadProfile,
              SchedulingConfigTemplate, TPUResourceQuota, ProviderConfig,
-             Pod, Node, Lease]
+             Pod, Node, Namespace, Lease]

@@ -29,6 +29,8 @@ class GlobalConfig:
     default_pool: str = ""
     scheduler_placement_mode: str = "CompactFirst"
     erl: Dict[str, float] = field(default_factory=dict)
+    #: native-pod auto-migration rules (webhook/auto_migration.py)
+    auto_migration: Dict = field(default_factory=dict)
     extra: Dict[str, str] = field(default_factory=dict)
 
 

@@ -163,6 +163,9 @@ class Operator:
         if cfg.default_pool and cfg.scheduler_placement_mode:
             self.allocator.set_pool_strategy(cfg.default_pool,
                                              cfg.scheduler_placement_mode)
+        if cfg.default_pool:
+            self.parser.default_pool = cfg.default_pool
+        self.mutator.auto_migration = cfg.auto_migration or {}
 
     # -- lifecycle (cmd/main.go startup order analog) ----------------------
 
@@ -386,6 +389,8 @@ def main(argv=None, stop_event: Optional[threading.Event] = None) -> int:
     ap.add_argument("--pool", default="pool-a")
     ap.add_argument("--metrics-path", default="",
                     help="write influx-line metrics to this file")
+    ap.add_argument("--config", default="",
+                    help="hot-reloaded GlobalConfig JSON file")
     ap.add_argument("--bootstrap-host", default="",
                     help="GEN:CHIPS — provision one simulated host at boot "
                          "(e.g. v5e:8)")
@@ -414,7 +419,8 @@ def main(argv=None, stop_event: Optional[threading.Event] = None) -> int:
             if n:
                 log.info("loaded %d persisted objects", n)
 
-    op = Operator(store=store, metrics_path=args.metrics_path)
+    op = Operator(store=store, metrics_path=args.metrics_path,
+                  config_path=args.config)
     # bootstrap the pool: ride out a state store that is still coming up
     # (transport errors retry; a concurrent replica winning the create is
     # success, not failure)

@@ -42,7 +42,7 @@ class NodeExpander:
             return None
         if not any(m in reason for m in _CAPACITY_MARKERS):
             return None  # not a capacity problem; a node won't help
-        req = compose_alloc_request(pod)
+        req = compose_alloc_request(pod, include_native=True)
         if req is None:
             return None
         generation = req.generation or "v5e"

@@ -34,7 +34,7 @@ from ..allocator.portalloc import PortAllocator, PortExhaustedError
 from ..allocator.quota import QuotaExceededError
 from ..api.resources import (AllocRequest, GangConfig, ResourceAmount,
                              parse_quantity)
-from ..api.types import Pod
+from ..api.types import Pod, native_chip_request
 from .framework import (Code, CycleState, FilterPlugin, OK, PermitPlugin, STATE_PREFILTER_NODES,
                         PostBindPlugin, PostFilterPlugin, PreBindPlugin,
                         PreEnqueuePlugin, PreFilterPlugin, ReservePlugin,
@@ -54,13 +54,46 @@ STATE_NOMINATION = "fit/nomination"
 NOMINATION_TTL_S = 120.0
 
 
-def compose_alloc_request(pod: Pod) -> Optional[AllocRequest]:
+def _compose_native_request(pod: Pod) -> Optional[AllocRequest]:
+    """Whole-chip AllocRequest for an unmanaged native TPU pod routed
+    here by progressive migration (pod_webhook.go:128-134 analog).
+
+    The pod carries no tpu-fusion annotations, but it WILL occupy whole
+    chips through the native device path — so the allocator must hold
+    them *exclusively* (no colocation, no oversubscription), or a vTPU
+    workload would be placed onto the same silicon.
+    Shared isolation: capacity bookkeeping only, no enforcement."""
+    chips = native_chip_request(pod)
+    if chips <= 0:
+        return None
+    return AllocRequest(
+        pool="",
+        namespace=pod.metadata.namespace,
+        workload_name="",
+        pod_name=pod.metadata.name,
+        request=ResourceAmount(duty_percent=100.0),
+        limit=ResourceAmount(duty_percent=100.0),
+        chip_count=chips,
+        isolation=constants.ISOLATION_SHARED,
+        exclusive=True,
+        qos=constants.DEFAULT_QOS)
+
+
+def compose_alloc_request(pod: Pod,
+                          include_native: bool = False
+                          ) -> Optional[AllocRequest]:
     """Build an AllocRequest from the pod's annotation contract
-    (ComposeAllocationRequest analog, gpuresources.go:161)."""
+    (ComposeAllocationRequest analog, gpuresources.go:161).
+
+    ``include_native=True`` additionally synthesizes whole-chip requests
+    for unannotated native TPU pods (progressive migration). Callers
+    that must only see *managed* pods — defrag, compaction, live
+    migration — keep the default: an unmanaged native pod is not ours
+    to evict or migrate."""
     ann = pod.metadata.annotations
     if constants.ANN_TFLOPS_REQUEST not in ann and \
             constants.ANN_HBM_REQUEST not in ann:
-        return None
+        return _compose_native_request(pod) if include_native else None
     gang = GangConfig()
     info = gang_info_from_pod(pod)
     if info is not None:
@@ -95,6 +128,8 @@ def compose_alloc_request(pod: Pod) -> Optional[AllocRequest]:
                         if n],
         isolation=ann.get(constants.ANN_ISOLATION,
                           constants.DEFAULT_ISOLATION),
+        exclusive=str(ann.get(constants.ANN_DEDICATED_CHIP, "")).lower()
+        in ("true", "1", "yes", "on"),
         qos=ann.get(constants.ANN_QOS, constants.DEFAULT_QOS),
         partition_template=ann.get(constants.ANN_PARTITION_NAME, ""),
         gang=gang)
@@ -137,7 +172,7 @@ class TPUResourcesFit(PreEnqueuePlugin, PreFilterPlugin, FilterPlugin,
     # -- PreFilter --------------------------------------------------------
 
     def pre_filter(self, state: CycleState, pod: Pod) -> Status:
-        req = compose_alloc_request(pod)
+        req = compose_alloc_request(pod, include_native=True)
         if req is None:
             return Status(Code.SKIP)
         state[STATE_ALLOC_REQUEST] = req

@@ -248,7 +248,7 @@ class OperatorServer:
         elif url.path == "/api/simulate-schedule":
             body = h._body()
             pod = from_dict(Pod, body)
-            req = compose_alloc_request(pod)
+            req = compose_alloc_request(pod, include_native=True)
             if req is None:
                 h._send(400, {"error": "pod carries no TPU request "
                                        "annotations"})

@@ -25,6 +25,9 @@ from typing import Optional
 from .. import constants
 from ..api.types import Pod, TPUWorkload
 from ..store import ObjectStore
+from .auto_migration import (native_chip_request,
+                             progressive_migration_enabled,
+                             should_auto_migrate)
 from .parser import ParseError, WorkloadParser
 
 log = logging.getLogger("tpf.webhook")
@@ -37,14 +40,52 @@ class PodMutator:
         self.parser = parser
         self.operator_url = operator_url
         self.mutated_count = 0
+        #: hot-reloaded GlobalConfig.auto_migration section
+        self.auto_migration: dict = {}
         self._counters: dict = {}
         self._counter_lock = threading.Lock()
 
     def handle(self, pod: Pod) -> Pod:
         """Mutate a pod on admission; raises ParseError on bad requests."""
+        auto_migrated = False
         if not self.parser.is_tpu_fusion_pod(pod):
-            return pod
-        spec = self.parser.parse(pod)
+            # native TPU pod handling (pod_webhook.go:100-134 analog):
+            # migrate it into the platform, or at least route it through
+            # our scheduler so native and vTPU pods never collide
+            if native_chip_request(pod) <= 0:
+                return pod
+            if should_auto_migrate(pod, self.auto_migration, self.store):
+                log.info("auto-migrating native TPU pod %s", pod.key())
+                pod.metadata.labels[constants.LABEL_ENABLED] = "true"
+                auto_migrated = True
+            elif progressive_migration_enabled() and \
+                    pod.metadata.labels.get(constants.LABEL_ENABLED) != \
+                    "false":
+                pod.spec.scheduler_name = constants.SCHEDULER_NAME
+                return pod
+            else:
+                return pod
+        try:
+            spec = self.parser.parse(pod)
+        except ParseError:
+            if auto_migrated:
+                # migration is best-effort: an unconvertible native pod
+                # (e.g. >128 chips) keeps running natively rather than
+                # being rejected at admission. It still gets the proxy
+                # routing when enabled, so the scheduler accounts its
+                # chips even though it stays unmanaged.
+                del pod.metadata.labels[constants.LABEL_ENABLED]
+                log.warning("auto-migration of %s failed to parse; "
+                            "leaving the pod native", pod.key(),
+                            exc_info=True)
+                if progressive_migration_enabled():
+                    pod.spec.scheduler_name = constants.SCHEDULER_NAME
+                return pod
+            # a pod that explicitly opted in (enabled label or tpu-fusion
+            # annotations) but cannot be parsed is rejected at admission,
+            # matching the reference (admission.Errored on parse failure,
+            # pod_webhook.go:144-147)
+            raise
         ann = pod.metadata.annotations
 
         # grey release: only mutate the first N replicas of a counter key

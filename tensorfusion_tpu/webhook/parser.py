@@ -11,13 +11,14 @@ settings.
 from __future__ import annotations
 
 import dataclasses
+import json
 import logging
 from typing import Dict, Optional
 
 from .. import constants
 from ..api.resources import GangConfig, ResourceAmount, Resources, parse_quantity
 from ..api.types import (ChipModelInfo, Pod, TPUWorkloadSpec, WorkloadProfile,
-                         WorkloadProfileSpec)
+                         WorkloadProfileSpec, native_chip_counts)
 from ..store import ObjectStore
 
 log = logging.getLogger("tpf.webhook.parser")
@@ -49,6 +50,10 @@ class WorkloadParser:
         labels = pod.metadata.labels
         if labels.get(constants.LABEL_ENABLED) == "false":
             return False
+        if labels.get(constants.LABEL_ENABLED) == "true":
+            # auto-migrated native pods join via the enabled label alone
+            # (IsTensorFusionPod analog, reconcile.go:214)
+            return True
         return any(k.startswith(constants.DOMAIN + "/") for k in ann)
 
     def parse(self, pod: Pod) -> TPUWorkloadSpec:
@@ -136,6 +141,38 @@ class WorkloadParser:
                 # min-members present => strict all-or-nothing gang
                 strict=bool(ann.get(constants.ANN_GANG_MIN_MEMBERS)))
 
+        # 2b. native chip-quantity conversion (tf_parser.go:444-494
+        # analog): a pod migrated from native whole-chip requests —
+        # container chip counts set, no tpu-fusion compute annotations —
+        # becomes a whole-chip workload: duty 100% per chip, full-chip
+        # HBM when the generation's model is known.
+        req_amt = spec.resources.requests
+        if constants.ANN_CHIP_COUNT not in ann and \
+                req_amt.tflops <= 0 and req_amt.hbm_bytes <= 0 and \
+                req_amt.duty_percent <= 0:
+            per_container = native_chip_counts(pod)
+            native_total = sum(per_container.values())
+            if native_total > 0:
+                if native_total > 128:
+                    raise ParseError(f"native chip request {native_total} "
+                                     f"out of 1..128")
+                spec.chip_count = native_total
+                # migrated pods join the SHARED pool at 100% duty — the
+                # whole point of seamless migration is converting hoarded
+                # whole chips into oversubscribable ones (the reference
+                # converts to computePercent 100, tf_parser.go:463-466).
+                # Workloads that need true exclusivity keep it via the
+                # dedicated-chip annotation instead.
+                req_amt.duty_percent = 100.0
+                spec.resources.limits.duty_percent = 100.0
+                model = self.chip_models.get(spec.generation)
+                if model is not None and model.hbm_bytes > 0:
+                    req_amt.hbm_bytes = model.hbm_bytes
+                ann.setdefault(constants.ANN_INJECT_CONTAINER,
+                               ",".join(per_container))
+                ann.setdefault(constants.ANN_CONTAINER_CHIP_COUNT,
+                               json.dumps(per_container))
+
         # 3. defaults + normalization
         if not spec.qos:
             spec.qos = constants.DEFAULT_QOS
@@ -146,7 +183,8 @@ class WorkloadParser:
         if not spec.resources.limits.hbm_bytes:
             spec.resources.limits.hbm_bytes = spec.resources.requests.hbm_bytes
         if spec.resources.requests.tflops <= 0 and \
-                spec.resources.requests.hbm_bytes <= 0:
+                spec.resources.requests.hbm_bytes <= 0 and \
+                spec.resources.requests.duty_percent <= 0:
             raise ParseError("pod requests no TPU resources "
                              "(set tflops-request and/or hbm-request)")
 

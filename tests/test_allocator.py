@@ -448,3 +448,119 @@ def test_vectorized_filter_path_matches_python_chain():
     assert record.chip_ids[0] not in {c.chip.name for nd in v3
                                       for c in v3[nd]}, \
         "vectorized view served stale capacity"
+
+
+def test_duty_and_tflops_are_fungible_on_hold():
+    """A duty-only whole-chip hold (proxied native pod / migration with
+    unknown generation) must block tflops-denominated requests and vice
+    versa — both are denominations of the same MXU time."""
+    alloc = make_allocator(n_chips=1, nodes=1)
+    native = AllocRequest(
+        pool="pool-a", namespace="default", pod_name="native",
+        request=ResourceAmount(duty_percent=100.0),
+        limit=ResourceAmount(duty_percent=100.0), chip_count=1)
+    rec = alloc.alloc(native)
+    st = alloc.get_chip(rec.chip_ids[0])
+    # the hold depleted BOTH dimensions
+    assert st.allocated.duty_percent == 100.0
+    assert st.allocated.tflops == pytest.approx(V5E_TFLOPS)
+    # a tflops request no longer fits
+    by_node, rej = alloc.check_quota_and_filter(req(pod="p2", tflops=10.0))
+    assert not by_node
+    alloc.dealloc(rec.key)
+
+    # reverse: tflops-only holds also deplete duty
+    rec2 = alloc.alloc(req(pod="p3", tflops=V5E_TFLOPS, hbm=0))
+    st2 = alloc.get_chip(rec2.chip_ids[0])
+    assert st2.allocated.duty_percent == pytest.approx(100.0)
+    duty_req = AllocRequest(
+        pool="pool-a", namespace="default", pod_name="p4",
+        request=ResourceAmount(duty_percent=50.0),
+        limit=ResourceAmount(duty_percent=50.0), chip_count=1)
+    by_node2, _ = alloc.check_quota_and_filter(duty_req)
+    assert not by_node2
+
+
+def test_duty_fit_in_vectorized_path():
+    """The large-pool vector filter must honor the duty dimension too."""
+    from tensorfusion_tpu.allocator.vecview import PoolVectorView
+    alloc = make_allocator(n_chips=2, nodes=1)
+    native = AllocRequest(
+        pool="pool-a", namespace="default", pod_name="native",
+        request=ResourceAmount(duty_percent=100.0),
+        limit=ResourceAmount(duty_percent=100.0), chip_count=1)
+    rec = alloc.alloc(native)
+    view = PoolVectorView([alloc.get_chip(f"chip-{i}") for i in range(2)])
+    duty_req = AllocRequest(
+        pool="pool-a", namespace="default", pod_name="p2",
+        request=ResourceAmount(duty_percent=50.0),
+        limit=ResourceAmount(duty_percent=50.0), chip_count=1)
+    mask = view.survivors(duty_req)
+    held = view.index[rec.chip_ids[0]]
+    assert not mask[held]
+    assert mask.sum() == 1
+
+
+def test_exclusive_hold_blocks_oversubscription():
+    """An exclusive whole-chip hold (native pod / dedicated-chip) refuses
+    colocation even under 5x oversell, and an exclusive request refuses a
+    non-empty chip."""
+    alloc = make_allocator(n_chips=1, nodes=1, oversell=500.0)
+    native = AllocRequest(
+        pool="pool-a", namespace="default", pod_name="native",
+        request=ResourceAmount(duty_percent=100.0),
+        limit=ResourceAmount(duty_percent=100.0),
+        chip_count=1, exclusive=True)
+    rec = alloc.alloc(native)
+    # oversold tflops capacity notwithstanding, nothing may colocate
+    by_node, rej = alloc.check_quota_and_filter(req(pod="p2", tflops=10.0))
+    assert not by_node
+    assert "exclusively held" in next(iter(rej.values()))
+    alloc.dealloc(rec.key)
+
+    # reverse: exclusive request refuses a chip that has any holder
+    small = alloc.alloc(req(pod="tiny", tflops=1.0, hbm=2**20))
+    by_node2, rej2 = alloc.check_quota_and_filter(
+        AllocRequest(pool="pool-a", namespace="default", pod_name="excl",
+                     request=ResourceAmount(duty_percent=100.0),
+                     limit=ResourceAmount(duty_percent=100.0),
+                     chip_count=1, exclusive=True))
+    assert not by_node2
+    assert "needs an empty chip" in next(iter(rej2.values()))
+    alloc.dealloc(small.key)
+
+    # chip-level race guard: hold() itself re-checks
+    st = alloc.get_chip("chip-0")
+    st.hold("a", ResourceAmount(tflops=1.0))
+    with pytest.raises(InsufficientResourcesError):
+        st.hold("b", ResourceAmount(duty_percent=10.0), exclusive=True)
+    st.drop("a")
+    st.hold("x", ResourceAmount(duty_percent=100.0), exclusive=True)
+    with pytest.raises(InsufficientResourcesError):
+        st.hold("y", ResourceAmount(tflops=1.0))
+    st.drop("x")
+    assert not st.exclusive_keys
+
+
+def test_vectorized_exclusivity_matches_python_chain():
+    """The vector filter's exclusivity masks must carry the same
+    self-carveouts as ResourceFitFilter (restart/recheck flows)."""
+    from tensorfusion_tpu.allocator.vecview import PoolVectorView
+    alloc = make_allocator(n_chips=3, nodes=1)
+    # dedicated-chip workload holding only part of the capacity: the
+    # chip keeps headroom, so only exclusivity decides eligibility
+    excl = AllocRequest(
+        pool="pool-a", namespace="default", pod_name="own",
+        request=ResourceAmount(tflops=10.0, hbm_bytes=2**20),
+        limit=ResourceAmount(tflops=10.0, hbm_bytes=2**20),
+        chip_count=1, exclusive=True)
+    rec = alloc.alloc(excl)
+    held = rec.chip_ids[0]
+    view = PoolVectorView([alloc.get_chip(f"chip-{i}") for i in range(3)])
+    # re-evaluating the exclusive holder against its own chip: eligible
+    mask = view.survivors(excl)
+    assert mask[view.index[held]]
+    # other requests are still locked out of the held chip
+    other = req(pod="other", tflops=1.0, hbm=2**20)
+    m2 = view.survivors(other)
+    assert not m2[view.index[held]] and m2.sum() == 2

@@ -366,3 +366,54 @@ def test_operator_restart_recovery(op):
         assert state.allocated.tflops >= 60.0
     finally:
         op2.stop()
+
+
+def test_e2e_native_pod_auto_migrated_and_scheduled(op):
+    """A pod requesting native whole chips (no tpu-fusion annotations)
+    is auto-migrated by the webhook and scheduled like any vTPU pod
+    (pod_webhook.go:100-134 + auto_migration.go analog)."""
+    op.mutator.auto_migration = {"enable": True}
+    try:
+        pod = Pod.new("native-1", namespace="default")
+        pod.spec.containers = [Container(name="main", chip_count=2)]
+        op.submit_pod(pod)
+        bound = op.wait_for_binding("native-1")
+        assert bound is not None, "native pod was not scheduled"
+        ann = bound.metadata.annotations
+        assert bound.metadata.labels[constants.LABEL_ENABLED] == "true"
+        assert ann[constants.ANN_CHIP_COUNT] == "2"
+        assert len(ann[constants.ANN_CHIP_IDS].split(",")) == 2
+        # whole-chip semantics: 100% duty held on each allocated chip
+        rec = op.allocator.allocation("default/native-1")
+        assert rec is not None
+        assert rec.request.request.duty_percent == 100.0
+        wl = op.store.get(TPUWorkload, "native-1", "default")
+        assert wl.spec.chip_count == 2
+        op.delete_pod("native-1")
+    finally:
+        op.mutator.auto_migration = {}
+
+
+def test_e2e_proxied_native_pod_accounted(op, monkeypatch):
+    """With progressive migration on (no auto-migration), a native pod is
+    proxy-scheduled AND its whole chips are held in the allocator so vTPU
+    workloads cannot land on the same silicon."""
+    from tensorfusion_tpu.webhook.auto_migration import ENV_PROGRESSIVE_MIGRATION
+    monkeypatch.setenv(ENV_PROGRESSIVE_MIGRATION, "1")
+    pod = Pod.new("native-proxy", namespace="default")
+    pod.spec.containers = [Container(name="main", chip_count=2)]
+    op.submit_pod(pod)
+    bound = op.wait_for_binding("native-proxy")
+    assert bound is not None, "proxied native pod was not scheduled"
+    # not converted: no workload object, no enabled label
+    assert not bound.metadata.labels.get(constants.LABEL_ENABLED)
+    assert op.store.try_get(TPUWorkload, "native-proxy", "default") is None
+    # but fully accounted: two whole chips held at 100% duty
+    rec = op.allocator.allocation("default/native-proxy")
+    assert rec is not None and len(rec.chip_ids) == 2
+    assert rec.request.request.duty_percent == 100.0
+    assert rec.request.exclusive
+    for cid in rec.chip_ids:
+        assert op.allocator.get_chip(cid).exclusive_keys == {
+            "default/native-proxy"}
+    op.delete_pod("native-proxy")

@@ -411,3 +411,27 @@ def test_scheduler_loop_end_to_end():
         assert len(h.bound) == 8
     finally:
         h.scheduler.stop()
+
+
+def test_compose_native_request_for_proxied_pod():
+    """Progressive migration routes unannotated native pods through our
+    scheduler; they must still be accounted as whole-chip holds
+    (pod_webhook.go:128-134 analog)."""
+    from tensorfusion_tpu.api.types import Container, Pod
+    from tensorfusion_tpu.scheduler.tpuresources import compose_alloc_request
+
+    pod = Pod.new("native-proxy", namespace="default")
+    pod.spec.containers = [Container(name="a", chip_count=3),
+                           Container(name="b", chip_count=1)]
+    # managed-only callers (defrag/compaction/migration) must NOT see
+    # unmanaged native pods as evictable
+    assert compose_alloc_request(pod) is None
+    req = compose_alloc_request(pod, include_native=True)
+    assert req is not None
+    assert req.chip_count == 4
+    assert req.request.duty_percent == 100.0
+    assert req.isolation == constants.ISOLATION_SHARED
+    # a pod with neither annotations nor native chips stays unmanaged
+    empty = Pod.new("plain", namespace="default")
+    empty.spec.containers = [Container(name="main")]
+    assert compose_alloc_request(empty, include_native=True) is None

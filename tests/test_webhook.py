@@ -118,3 +118,128 @@ def test_mutator_ignores_non_tpu_pods():
     assert out.spec.scheduler_name == "default"
     from tensorfusion_tpu.api.types import TPUWorkload
     assert not store.list(TPUWorkload)
+
+
+# -- native-pod auto-migration (auto_migration.go + pod_webhook.go:100-134) --
+
+
+def native_pod(chips=2, name="native", labels=None):
+    pod = Pod.new(name, namespace="default")
+    pod.spec.containers = [Container(name="main", chip_count=chips)]
+    if labels:
+        pod.metadata.labels.update(labels)
+    return pod
+
+
+def test_native_pod_untouched_by_default():
+    store = ObjectStore()
+    m = PodMutator(store, make_parser(store))
+    out = m.handle(native_pod())
+    assert out.spec.scheduler_name == "default"
+    from tensorfusion_tpu.api.types import TPUWorkload
+    assert not store.list(TPUWorkload)
+
+
+def test_native_pod_progressive_migration_proxies_scheduler(monkeypatch):
+    from tensorfusion_tpu.webhook.auto_migration import ENV_PROGRESSIVE_MIGRATION
+    monkeypatch.setenv(ENV_PROGRESSIVE_MIGRATION, "true")
+    store = ObjectStore()
+    m = PodMutator(store, make_parser(store))
+    out = m.handle(native_pod())
+    # routed through our scheduler but NOT converted to a vTPU workload
+    assert out.spec.scheduler_name == constants.SCHEDULER_NAME
+    from tensorfusion_tpu.api.types import TPUWorkload
+    assert not store.list(TPUWorkload)
+    # opt-out label beats progressive migration
+    out2 = m.handle(native_pod(name="optout",
+                               labels={constants.LABEL_ENABLED: "false"}))
+    assert out2.spec.scheduler_name == "default"
+
+
+def test_native_pod_auto_migrated_to_whole_chip_workload():
+    store = ObjectStore()
+    m = PodMutator(store, make_parser(store))
+    m.auto_migration = {"enable": True}
+    out = m.handle(native_pod(chips=2))
+    ann = out.metadata.annotations
+    assert out.metadata.labels[constants.LABEL_ENABLED] == "true"
+    assert out.spec.scheduler_name == constants.SCHEDULER_NAME
+    assert ann[constants.ANN_CHIP_COUNT] == "2"
+    assert float(ann[constants.ANN_DUTY_REQUEST]) == 100.0
+    assert ann[constants.ANN_CONTAINER_CHIP_COUNT] == '{"main": 2}'
+    from tensorfusion_tpu.api.types import TPUWorkload
+    wl = store.get(TPUWorkload, "native", "default")
+    assert wl.spec.chip_count == 2
+    assert wl.spec.resources.requests.duty_percent == 100.0
+
+
+def test_auto_migration_scope_rules():
+    from tensorfusion_tpu.api.types import Namespace
+    from tensorfusion_tpu.webhook.auto_migration import should_auto_migrate
+    store = ObjectStore()
+    ns = Namespace.new("prod")
+    ns.metadata.labels["tier"] = "gpu"
+    store.create(ns)
+
+    cfg = {"enable": True,
+           "scope": {"includes": {"namespace_names": ["default"]},
+                     "excludes": {"pod_selector": {"skip": "me"}}}}
+    assert should_auto_migrate(native_pod(), cfg, store)
+    assert not should_auto_migrate(
+        native_pod(labels={"skip": "me"}), cfg, store)
+
+    # namespace label selector via the Namespace object
+    pod = native_pod()
+    pod.metadata.namespace = "prod"
+    cfg2 = {"enable": True,
+            "scope": {"includes": {"namespace_selector": {"tier": "gpu"}}}}
+    assert should_auto_migrate(pod, cfg2, store)
+    cfg3 = {"enable": True,
+            "scope": {"includes": {"namespace_selector": {"tier": "cpu"}}}}
+    assert not should_auto_migrate(pod, cfg3, store)
+
+    # disabled label always wins; enable=false means no migration
+    assert not should_auto_migrate(
+        native_pod(labels={constants.LABEL_ENABLED: "false"}),
+        {"enable": True}, store)
+    assert not should_auto_migrate(native_pod(), {"enable": False}, store)
+    assert not should_auto_migrate(native_pod(), {}, store)
+
+
+def test_native_pod_fail_open_when_unconvertible():
+    """Auto-migration is best-effort: a native pod that cannot be
+    converted (>128 chips) is left to run natively, not rejected."""
+    store = ObjectStore()
+    m = PodMutator(store, make_parser(store))
+    m.auto_migration = {"enable": True}
+    out = m.handle(native_pod(chips=129, name="huge"))
+    assert constants.LABEL_ENABLED not in out.metadata.labels
+    assert out.spec.scheduler_name == "default"
+    from tensorfusion_tpu.api.types import TPUWorkload
+    assert not store.list(TPUWorkload)
+
+
+def test_native_pod_fail_open_still_proxies(monkeypatch):
+    """When auto-migration cannot convert the pod AND progressive
+    migration is on, the pod still gets proxy-routed so its chips are
+    accounted by the scheduler."""
+    from tensorfusion_tpu.webhook.auto_migration import ENV_PROGRESSIVE_MIGRATION
+    monkeypatch.setenv(ENV_PROGRESSIVE_MIGRATION, "1")
+    store = ObjectStore()
+    m = PodMutator(store, make_parser(store))
+    m.auto_migration = {"enable": True}
+    out = m.handle(native_pod(chips=129, name="huge2"))
+    assert constants.LABEL_ENABLED not in out.metadata.labels
+    assert out.spec.scheduler_name == constants.SCHEDULER_NAME
+
+
+def test_enabled_label_without_resources_rejected():
+    """Explicit opt-in (enabled=true label) with nothing to allocate is
+    an admission error, matching the reference's parse-failure path."""
+    store = ObjectStore()
+    m = PodMutator(store, make_parser(store))
+    pod = Pod.new("labeled", namespace="default")
+    pod.metadata.labels[constants.LABEL_ENABLED] = "true"
+    pod.spec.containers = [Container(name="main")]
+    with pytest.raises(ParseError):
+        m.handle(pod)
