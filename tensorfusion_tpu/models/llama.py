@@ -40,7 +40,8 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
-    attn_impl: str = "full"          # "full" | "ring" | "flash" (pallas)
+    attn_impl: str = "full"   # "full" | "ring" | "flash" | "chunked"
+    attn_block: int = 512     # KV block for attn_impl="chunked"
     remat: bool = False
 
     @property
@@ -167,6 +168,13 @@ def _attention(config: LlamaConfig, p, x,
         from ..ops import flash_attention
 
         out = flash_attention(q, k, v, causal=True)
+    elif config.attn_impl == "chunked":
+        # differentiable O(T x block) memory — long-seq single-chip
+        # training (the pallas flash kernel is forward/serving-only)
+        from ..ops import chunked_attention
+
+        out = chunked_attention(q, k, v, causal=True,
+                                block=config.attn_block)
     else:
         scale = hd ** -0.5
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale

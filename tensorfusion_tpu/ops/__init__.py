@@ -1,3 +1,4 @@
-"""TPU kernels (Pallas) for hosted-workload hot ops."""
+"""TPU kernels (Pallas) + memory-efficient ops for hosted-workload hot ops."""
 
+from .chunked_attention import chunked_attention
 from .flash_attention import flash_attention

@@ -80,3 +80,72 @@ def test_flash_ragged_sequence_falls_back(t):
     out = flash_attention(q, k, v, backend="interpret")
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-5)
+
+
+# -- chunked attention (ops/chunked_attention.py) ---------------------------
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("t,block", [(128, 32), (100, 32), (64, 64),
+                                     (33, 16)])
+def test_chunked_matches_dense(causal, t, block):
+    """Value equivalence with the dense softmax path, including ragged
+    T (internal padding) and block >= T."""
+    from tensorfusion_tpu.ops import chunked_attention
+    from tensorfusion_tpu.ops.flash_attention import _flash_reference
+
+    key = jax.random.PRNGKey(0)
+    b, h, d = 2, 4, 32
+    q, k, v = (jax.random.normal(kk, (b, h, t, d), jnp.float32)
+               for kk in jax.random.split(key, 3))
+    out = chunked_attention(q, k, v, causal=causal, block=block)
+    ref = _flash_reference(q.reshape(b * h, t, d), k.reshape(b * h, t, d),
+                           v.reshape(b * h, t, d), d ** -0.5,
+                           causal).reshape(b, h, t, d)
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_chunked_gradients_match_dense():
+    """The whole point vs the pallas flash kernel: this path must be
+    differentiable, with gradients matching the dense attention."""
+    from tensorfusion_tpu.ops import chunked_attention
+    from tensorfusion_tpu.ops.flash_attention import _flash_reference
+
+    key = jax.random.PRNGKey(1)
+    b, h, t, d = 1, 2, 96, 16
+    q, k, v = (jax.random.normal(kk, (b, h, t, d), jnp.float32)
+               for kk in jax.random.split(key, 3))
+
+    def loss_chunked(q, k, v):
+        return chunked_attention(q, k, v, causal=True, block=32).sum()
+
+    def loss_dense(q, k, v):
+        return _flash_reference(
+            q.reshape(b * h, t, d), k.reshape(b * h, t, d),
+            v.reshape(b * h, t, d), d ** -0.5, True).sum()
+
+    gc = jax.grad(loss_chunked, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gc, gd):
+        np.testing.assert_allclose(a, b_, rtol=1e-4, atol=1e-4)
+
+
+def test_chunked_trains_in_llama():
+    """attn_impl='chunked' plugs into the flagship training step."""
+    from tensorfusion_tpu.models import LlamaConfig, init_params, loss_fn
+
+    config = LlamaConfig(vocab_size=128, dim=64, n_layers=2, n_heads=4,
+                         n_kv_heads=2, ffn_dim=128, max_seq_len=64,
+                         attn_impl="chunked", attn_block=16)
+    params = init_params(config, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 48), 0, 128)
+    batch = {"tokens": tokens, "targets": tokens}
+    loss, grads = jax.jit(jax.value_and_grad(
+        lambda p, b: loss_fn(p, b, config)))(params, batch)
+    assert jnp.isfinite(loss)
+    # matches the dense path numerically
+    import dataclasses
+    dense = dataclasses.replace(config, attn_impl="full")
+    loss_d = loss_fn(params, batch, dense)
+    # bf16 activations: block-wise vs dense accumulation order differs
+    np.testing.assert_allclose(loss, loss_d, rtol=2e-3, atol=2e-3)
