@@ -144,6 +144,10 @@ def _attn_core_bwd(causal, scale, block, valid_len, res, dout):
     b, h, t, d = q.shape
     nb = t // block
     key_valid = _key_valid(t, valid_len, block, causal)
+    # guard hypothetical fully-masked rows ONCE before blocking (ring
+    # backward discipline): exp(s - lse) would otherwise be exp(0)=1
+    # for masked entries
+    lse = jnp.where(lse <= NEG_INF / 2, -lse, lse)
     do32 = dout.astype(jnp.float32)
     # D_i = dout_i . out_i  (rowwise) — the softmax-jacobian constant
     delta = jnp.einsum("bhtd,bhtd->bht", do32, out.astype(jnp.float32))

@@ -32,6 +32,21 @@ from jax import shard_map
 NEG_INF = -1e30
 
 
+def _masked_scores(q, k, q_off, k_off, causal, scale):
+    """f32 scaled QK^T scores with global-coordinate causal masking —
+    the single definition shared by the forward accumulation (_block)
+    and the custom-VJP backward (_ring_local_bwd): the two must never
+    desynchronize on masking semantics."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = q_off + jnp.arange(q.shape[2])
+        k_pos = k_off + jnp.arange(k.shape[2])
+        mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    return s
+
+
 def _block(q, k, v, m, l, o, q_off, k_off, causal, scale):
     """One online-softmax accumulation step for a K/V block.
 
@@ -42,13 +57,7 @@ def _block(q, k, v, m, l, o, q_off, k_off, causal, scale):
     long-context regime ring attention targets (matches the f32-scratch
     discipline of ops/flash_attention.py).
     """
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) * scale
-    if causal:
-        q_pos = q_off + jnp.arange(q.shape[2])
-        k_pos = k_off + jnp.arange(k.shape[2])
-        mask = q_pos[:, None] >= k_pos[None, :]
-        s = jnp.where(mask[None, None], s, NEG_INF)
+    s = _masked_scores(q, k, q_off, k_off, causal, scale)
     m_new = jnp.maximum(m, s.max(axis=-1))
     # guard fully-masked rows (all NEG_INF): keep them inert
     corr = jnp.exp(m - m_new)
@@ -59,9 +68,10 @@ def _block(q, k, v, m, l, o, q_off, k_off, causal, scale):
     return m_new, l_new, o_new
 
 
-def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
-                          scale: float):
-    """Per-shard body: accumulate over all K/V blocks of the ring."""
+def _ring_forward(q, k, v, axis_name: str, causal: bool, scale: float):
+    """Per-shard forward: accumulate over all K/V blocks of the ring.
+    Returns (out [B,H,Tl,D] in q's dtype, lse [B,H,Tl] f32 row
+    logsumexp — the only residual the backward needs beyond q/k/v)."""
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     t_local = q.shape[2]
@@ -91,7 +101,79 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
     (_, _), (m, l, o) = carry
     # fully-masked rows have l == 0; emit zeros there
     safe_l = jnp.where(l == 0, 1.0, l)
-    return (o / safe_l[..., None]).astype(q.dtype)
+    out = (o / safe_l[..., None]).astype(q.dtype)
+    lse = m + jnp.log(safe_l)
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
+                          scale: float):
+    """Differentiable per-shard ring attention.
+
+    The gradient is NOT autodiff through the ring scan — that would
+    store every ring step's probability block (O(n x Tl^2) per shard,
+    exactly the memory ring attention exists to avoid) or rematerialize
+    pathologically (measured ~18x the forward for the single-chip
+    blockwise scan). Instead the flash-attention backward runs as a
+    second ring pass: probabilities are recomputed from q, the rotating
+    K/V blocks and the saved row logsumexp, and each block's (dk, dv)
+    accumulator rides the ring alongside the block itself, arriving home
+    after the full rotation."""
+    return _ring_forward(q, k, v, axis_name, causal, scale)[0]
+
+
+def _ring_local_fwd(q, k, v, axis_name, causal, scale):
+    out, lse = _ring_forward(q, k, v, axis_name, causal, scale)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_local_bwd(axis_name, causal, scale, res, dout):
+    q, k, v, out, lse = res
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    t_local = q.shape[2]
+    q_off = idx * t_local
+    do32 = dout.astype(jnp.float32)
+    # rowwise softmax-jacobian constant D_i = dout_i . out_i
+    delta = jnp.einsum("bhtd,bhtd->bht", do32, out.astype(jnp.float32))
+    # guard hypothetical fully-masked rows (lse == NEG_INF): exp(s-lse)
+    # would be exp(0)=1 for masked entries instead of 0
+    lse_safe = jnp.where(lse <= NEG_INF / 2, -lse, lse)
+
+    def step(carry, s):
+        k_blk, v_blk, dk_blk, dv_blk, dq = carry
+        src = (idx - s) % n
+        k_off = src * t_local
+        sc = _masked_scores(q, k_blk, q_off, k_off, causal, scale)
+        p = jnp.exp(sc - lse_safe[..., None])        # [B,H,Tl,Tl] f32
+        dp = jnp.einsum("bhqd,bhkd->bhqk", do32, v_blk,
+                        preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[..., None])
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, k_blk,
+                             preferred_element_type=jnp.float32) * scale
+        dk_blk = dk_blk + jnp.einsum(
+            "bhqk,bhqd->bhkd", ds, q,
+            preferred_element_type=jnp.float32) * scale
+        dv_blk = dv_blk + jnp.einsum(
+            "bhqk,bhqd->bhkd", p, do32,
+            preferred_element_type=jnp.float32)
+        # rotate the K/V blocks and THEIR gradient accumulators together:
+        # after n steps both are back at the block's owner
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        dk_blk = lax.ppermute(dk_blk, axis_name, perm)
+        dv_blk = lax.ppermute(dv_blk, axis_name, perm)
+        return (k_blk, v_blk, dk_blk, dv_blk, dq), None
+
+    z = jnp.zeros_like(q, dtype=jnp.float32)
+    carry = (k, v, z, z, z)
+    (_, _, dk, dv, dq), _ = lax.scan(step, carry, jnp.arange(n))
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
+
+
+_ring_attention_local.defvjp(_ring_local_fwd, _ring_local_bwd)
 
 
 def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
@@ -113,7 +195,8 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, axis_name: str = "sp",
     if scale is None:
         scale = q.shape[-1] ** -0.5
     fn = shard_map(
-        functools.partial(_ring_attention_local, axis_name=axis_name,
-                          causal=causal, scale=scale),
+        # positional call: custom_vjp functions reject keyword args
+        lambda q_, k_, v_: _ring_attention_local(q_, k_, v_, axis_name,
+                                                 causal, scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)
