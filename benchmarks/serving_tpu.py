@@ -79,12 +79,14 @@ def main() -> int:
         _ = jax.device_get(out)          # true sync on the tunnel
         gens[(plen, steps)] = (fn, toks)
 
-    def timed(key):
+    def timed(key, p=params, warm=False):
         fn, toks = gens[key]
+        if warm:                          # compile/trace for a new tree
+            _ = jax.device_get(fn(p, toks))
         best = float("inf")
         for _ in range(ROUNDS):
             t0 = time.perf_counter()
-            out = fn(params, toks)
+            out = fn(p, toks)
             _ = jax.device_get(out)      # host fetch = the only real sync
             best = min(best, time.perf_counter() - t0)
         return best
@@ -92,6 +94,19 @@ def main() -> int:
     t_ps_ds = timed((PROMPT_SMALL, DECODE_SMALL))
     t_pb_ds = timed((PROMPT_BIG, DECODE_SMALL))
     t_pb_db = timed((PROMPT_BIG, DECODE_BIG))
+
+    # int8-quantized decode (models/quantize.py): decode streams the
+    # parameter set per token, so halving bytes-per-param converts
+    # almost directly into tokens/s on an HBM-bound loop
+    from tensorfusion_tpu.models.quantize import quantize_weights_int8
+
+    q_tok_s = {}
+    for mode in ("w8a16", "w8a8"):
+        qparams = quantize_weights_int8(params, mode=mode)
+        best_s = timed((PROMPT_BIG, DECODE_SMALL), p=qparams, warm=True)
+        best_b = timed((PROMPT_BIG, DECODE_BIG), p=qparams, warm=True)
+        q_tok_s[mode] = BATCH * (DECODE_BIG - DECODE_SMALL) \
+            / max(best_b - best_s, 1e-9)
 
     # slopes: prompt-length delta isolates prefill; decode-length delta
     # isolates decode; constant (RTT, fixed scan overhead) cancels
@@ -139,6 +154,8 @@ def main() -> int:
         "decode_hbm_gbps": round(hbm_gbps, 1),
         "datasheet_hbm_gbps": datasheet_gbps,
         "hbm_utilization_pct": round(hbm_gbps / datasheet_gbps * 100, 1),
+        "decode_tokens_per_s_int8_w8a16": round(q_tok_s["w8a16"], 1),
+        "decode_tokens_per_s_int8_w8a8": round(q_tok_s["w8a8"], 1),
     }
     try:
         from benchmarks._artifact import write_artifact

@@ -26,6 +26,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.ring_attention import ring_attention_sharded
+from .quantize import matmul as _mm
 
 
 @dataclasses.dataclass(frozen=True)
@@ -150,9 +151,9 @@ def _attention(config: LlamaConfig, p, x,
     the prefill path caches exactly these (decode_step's contract)."""
     b, t, _ = x.shape
     hd = config.head_dim
-    q = (x @ p["wq"]).reshape(b, t, config.n_heads, hd)
-    k = (x @ p["wk"]).reshape(b, t, config.n_kv_heads, hd)
-    v = (x @ p["wv"]).reshape(b, t, config.n_kv_heads, hd)
+    q = _mm(x, p["wq"]).reshape(b, t, config.n_heads, hd)
+    k = _mm(x, p["wk"]).reshape(b, t, config.n_kv_heads, hd)
+    v = _mm(x, p["wv"]).reshape(b, t, config.n_kv_heads, hd)
     q = _rope(q, config.rope_theta)
     k = _rope(k, config.rope_theta)
     k_pre, v_pre = k, v
@@ -183,14 +184,15 @@ def _attention(config: LlamaConfig, p, x,
         probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
         out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
     out = out.transpose(0, 2, 1, 3).reshape(b, t, config.n_heads * hd)
-    out = out @ p["wo"]
+    out = _mm(out, p["wo"])
     if return_kv:
         return out, k_pre, v_pre
     return out
 
 
 def _mlp(p, x):
-    return (jax.nn.silu(x @ p["w_gate"]) * (x @ p["w_up"])) @ p["w_down"]
+    return _mm(jax.nn.silu(_mm(x, p["w_gate"])) * _mm(x, p["w_up"]),
+               p["w_down"])
 
 
 def _layer(config: LlamaConfig, layer, x, mesh=None, return_kv=False):
@@ -216,7 +218,7 @@ def forward(params: Dict, tokens: jax.Array, config: LlamaConfig,
     for layer in params["layers"]:
         x = layer_fn(layer, x)
     x = _rms_norm(x, params["final_norm"], config.norm_eps)
-    return (x @ params["lm_head"]).astype(jnp.float32)
+    return _mm(x, params["lm_head"]).astype(jnp.float32)
 
 
 def loss_fn(params: Dict, batch: Dict, config: LlamaConfig,
@@ -290,9 +292,9 @@ def _attention_decode(config: LlamaConfig, p, x, k_cache, v_cache, pos):
     """
     b = x.shape[0]
     hd = config.head_dim
-    q = (x @ p["wq"]).reshape(b, 1, config.n_heads, hd)
-    k = (x @ p["wk"]).reshape(b, 1, config.n_kv_heads, hd)
-    v = (x @ p["wv"]).reshape(b, 1, config.n_kv_heads, hd)
+    q = _mm(x, p["wq"]).reshape(b, 1, config.n_heads, hd)
+    k = _mm(x, p["wk"]).reshape(b, 1, config.n_kv_heads, hd)
+    v = _mm(x, p["wv"]).reshape(b, 1, config.n_kv_heads, hd)
     q = _rope(q, config.rope_theta, pos=pos)
     k = _rope(k, config.rope_theta, pos=pos)
     k_cache = lax.dynamic_update_slice(
@@ -310,7 +312,7 @@ def _attention_decode(config: LlamaConfig, p, x, k_cache, v_cache, pos):
     out = jnp.einsum("bgrk,bgkd->bgrd", probs.astype(v_cache.dtype),
                      v_cache)
     out = out.reshape(b, 1, config.n_heads * hd)
-    return out @ p["wo"], k_cache, v_cache
+    return _mm(out, p["wo"]), k_cache, v_cache
 
 
 def decode_step(params: Dict, token: jax.Array, cache: Dict,
@@ -331,7 +333,7 @@ def decode_step(params: Dict, token: jax.Array, cache: Dict,
         x = x + _mlp(layer["mlp"],
                      _rms_norm(x, layer["mlp_norm"], config.norm_eps))
     x = _rms_norm(x, params["final_norm"], config.norm_eps)
-    logits = (x[:, 0, :] @ params["lm_head"]).astype(jnp.float32)
+    logits = _mm(x[:, 0, :], params["lm_head"]).astype(jnp.float32)
     return logits, {"k": new_k, "v": new_v}
 
 
@@ -366,7 +368,7 @@ def prefill(params: Dict, prompt: jax.Array, config: LlamaConfig,
             v.transpose(0, 2, 1, 3).astype(config.dtype),
             (0, 0, 0, 0)))
     x = _rms_norm(x, params["final_norm"], config.norm_eps)
-    logits = (x[:, -1, :] @ params["lm_head"]).astype(jnp.float32)
+    logits = _mm(x[:, -1, :], params["lm_head"]).astype(jnp.float32)
     return logits, {"k": ks, "v": vs}
 
 
@@ -396,11 +398,27 @@ def generate(params: Dict, prompt: jax.Array, steps: int,
 
 
 def shard_params(params: Dict, mesh: Mesh, config: LlamaConfig) -> Dict:
+    """Place a parameter tree (plain or int8-quantized) on the mesh.
+
+    A QuantizedWeight counts as ONE logical parameter against the spec
+    tree: its int8 matrix takes the weight's own spec, its [out] scale
+    vector the spec's output axis (quantize.py shard contract)."""
+    from .quantize import QuantizedWeight, is_quantized
+
     specs = param_specs(config)
-    leaves, treedef = jax.tree_util.tree_flatten(params)
+    leaves, treedef = jax.tree_util.tree_flatten(
+        params, is_leaf=is_quantized)
     spec_leaves = jax.tree_util.tree_flatten(
         specs, is_leaf=lambda x: isinstance(x, P))[0]
     assert len(leaves) == len(spec_leaves), "param/spec tree mismatch"
-    sharded = [jax.device_put(x, NamedSharding(mesh, s))
-               for x, s in zip(leaves, spec_leaves)]
+    sharded = []
+    for x, s in zip(leaves, spec_leaves):
+        if is_quantized(x):
+            out_axis = s[1] if len(s) > 1 else None
+            sharded.append(QuantizedWeight(
+                q=jax.device_put(x.q, NamedSharding(mesh, s)),
+                s=jax.device_put(x.s, NamedSharding(mesh, P(out_axis))),
+                mode=x.mode))
+        else:
+            sharded.append(jax.device_put(x, NamedSharding(mesh, s)))
     return jax.tree_util.tree_unflatten(treedef, sharded)

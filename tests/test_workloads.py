@@ -406,3 +406,92 @@ def test_llama_ring_attention_trains():
     flat = jax.tree_util.tree_leaves(grads)
     assert all(jnp.all(jnp.isfinite(g)) for g in flat)
     assert any(float(jnp.abs(g).max()) > 0 for g in flat)
+
+
+# -- int8 quantized serving (models/quantize.py) -----------------------------
+
+
+def test_quantized_matmul_numerics():
+    from tensorfusion_tpu.models.quantize import (is_quantized, matmul,
+                                                  quantize_weights_int8)
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32),
+                          jnp.float32) * 0.1
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 64), jnp.float32)
+    ref = x @ w
+    for mode in ("w8a16", "w8a8"):
+        qtree = quantize_weights_int8({"wq": w}, mode=mode)
+        assert is_quantized(qtree["wq"])
+        out = matmul(x, qtree["wq"])
+        # int8 weight error ~ 1/127 of column max; both modes stay close
+        err = float(jnp.abs(out - ref).max() / jnp.abs(ref).max())
+        assert err < 0.03, (mode, err)
+    # plain arrays pass through untouched
+    np.testing.assert_array_equal(np.asarray(matmul(x, w)),
+                                  np.asarray(ref))
+
+
+@pytest.mark.parametrize("mode", ["w8a16", "w8a8"])
+def test_quantized_model_tracks_bf16(mode):
+    """int8 weights must track the bf16 model closely: teacher-forced
+    logits stay within the rounding budget, and the full serving path
+    (prefill + scan decode) runs end to end on a quantized tree.
+    (Token-for-token equality is NOT asserted: a random-init tiny model
+    has near-zero argmax margins, so rounding legitimately flips them.)"""
+    from tensorfusion_tpu.models import LlamaConfig, forward, init_params
+    from tensorfusion_tpu.models.llama import generate
+    from tensorfusion_tpu.models.quantize import quantize_weights_int8
+
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    qparams = quantize_weights_int8(params, mode=mode)
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 5), 0,
+                                cfg.vocab_size)
+    ref = forward(params, prompt, cfg)
+    qref = forward(qparams, prompt, cfg)
+    scale = float(jnp.abs(ref).max())
+    err = float(jnp.abs(qref - ref).max()) / scale
+    assert err < 0.05, (mode, err)
+    qgen = jax.jit(lambda p, t: generate(p, t, 8, cfg))(qparams, prompt)
+    assert qgen.shape == (2, 8)
+    assert int(qgen.min()) >= 0 and int(qgen.max()) < cfg.vocab_size
+
+
+def test_quantized_norms_and_embeddings_untouched():
+    from tensorfusion_tpu.models import LlamaConfig, init_params
+    from tensorfusion_tpu.models.quantize import (is_quantized,
+                                                  quantize_weights_int8)
+
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    q = quantize_weights_int8(params)
+    assert not is_quantized(q["tok_emb"])
+    assert q["final_norm"].dtype == params["final_norm"].dtype
+    lyr = q["layers"][0]
+    assert is_quantized(lyr["attn"]["wq"])
+    assert is_quantized(lyr["mlp"]["w_down"])
+    assert not is_quantized(lyr["attn_norm"])
+    assert q["layers"][0]["attn"]["wq"].q.dtype == jnp.int8
+
+
+def test_quantized_params_shard_on_mesh():
+    """A quantized tree places onto the mesh like a plain one: the int8
+    matrix takes the weight's spec, the scale vector the output axis."""
+    from tensorfusion_tpu.models import LlamaConfig, init_params
+    from tensorfusion_tpu.models.llama import forward, shard_params
+    from tensorfusion_tpu.models.quantize import quantize_weights_int8
+
+    mesh = make_mesh({"fsdp": 2, "tp": 2})
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    q = shard_params(quantize_weights_int8(params), mesh, cfg)
+    wq = q["layers"][0]["attn"]["wq"]
+    assert wq.q.sharding.spec == ("fsdp", "tp")
+    assert wq.s.sharding.spec == ("tp",)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0,
+                              cfg.vocab_size)
+    ref = forward(init_params(cfg, jax.random.PRNGKey(0)), toks, cfg)
+    out = forward(q, toks, cfg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=0.05, atol=0.05 * float(
+                                   jnp.abs(ref).max()))
