@@ -379,6 +379,40 @@ class TPUAllocator:
         with self._lock:
             return self._chips.get(name)
 
+    def gang_slice_ids(self, gang_key: str) -> set:
+        """Slice ids of chips already held by members of a gang
+        (``gang_key`` = "<ns>/<workload>", the webhook's gang-group key).
+
+        TPU-first scheduling input with no reference analog: a
+        multi-host TPU slice (e.g. v5e-256 = 64 hosts) is one ICI
+        fabric, so an SPMD gang spanning hosts should stay inside ONE
+        slice — cross-slice traffic rides DCN. The topology plugin uses
+        this to give same-slice nodes a scoring bonus once the first
+        member lands."""
+        ns, _, wl = gang_key.partition("/")
+        out: set = set()
+        with self._lock:
+            for rec in self._allocations.values():
+                r = rec.request
+                if r.namespace != ns or r.workload_name != wl:
+                    continue
+                for cid in rec.chip_ids:
+                    st = self._chips.get(cid)
+                    if st is not None and st.chip.status.slice_id:
+                        out.add(st.chip.status.slice_id)
+        return out
+
+    def node_slice_ids(self, node: str) -> set:
+        """Slice ids present on one node — O(chips-per-host), i.e. <=8
+        set lookups; the topology plugin's slice-affinity scoring calls
+        this per feasible node instead of materializing candidate chip
+        lists (which the lazy CandidateMap exists to avoid)."""
+        with self._lock:
+            return {self._chips[c].chip.status.slice_id
+                    for c in self._node_chips.get(node, ())
+                    if c in self._chips
+                    and self._chips[c].chip.status.slice_id}
+
     def allocation(self, key: str) -> Optional[AllocRecord]:
         with self._lock:
             return self._allocations.get(key)

@@ -71,7 +71,9 @@ class Operator:
                                    failure_handler=self._on_sched_failure)
         self.gang.bind_scheduler(self.scheduler)
         self.scheduler.register(self.fit)
-        self.scheduler.register(ICITopologyPlugin())
+        self.scheduler.register(ICITopologyPlugin(
+            gang_slices=self.allocator.gang_slice_ids,
+            node_slices=self.allocator.node_slice_ids))
         self.allocator.set_gang_waiting_probe(self.gang.is_waiting)
 
         self.manager = ControllerManager(self.store)

@@ -33,6 +33,7 @@ import logging
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple, TYPE_CHECKING
 
+from .. import constants
 from ..api.types import Pod, TopologyConfig
 from .framework import (Code, CycleState, OK, PreFilterPlugin, ScorePlugin,
                         Status)
@@ -43,6 +44,7 @@ if TYPE_CHECKING:
 log = logging.getLogger("tpf.scheduler.topo")
 
 STATE_TOPO_PLANS = "topo/plans"
+STATE_GANG_SLICES = "topo/gang_slices"
 STATE_ALLOC_REQUEST = "fit/alloc_request"
 STATE_CANDIDATES = "fit/candidates"
 
@@ -202,8 +204,23 @@ class ICITopologyPlugin(PreFilterPlugin, ScorePlugin):
     #: re-running the combination search per pod)
     PLAN_CACHE_MAX = 4096
 
-    def __init__(self, config: Optional[TopologyConfig] = None):
+    #: score bonus for a node inside a slice that already hosts gang
+    #: members. Plan scores span [0, 100] and the fit plugin's node
+    #: score spans [0, 100] too, so the bonus must exceed their combined
+    #: range to actually dominate: staying on the ICI fabric beats ANY
+    #: intra-node layout or load nicety when the alternative is DCN
+    SLICE_AFFINITY_BONUS = 1000.0
+
+    def __init__(self, config: Optional[TopologyConfig] = None,
+                 gang_slices=None, node_slices=None):
         self.config = config or TopologyConfig()
+        #: callable gang_key -> set of slice ids already hosting the
+        #: gang (TPUAllocator.gang_slice_ids); None disables affinity
+        self.gang_slices = gang_slices
+        #: callable node -> set of slice ids on that node
+        #: (TPUAllocator.node_slice_ids) — O(chips-per-host) instead of
+        #: materializing the lazy candidate map during Score
+        self.node_slices = node_slices
         self._plan_cache: Dict[tuple, Optional[NodeTopologyPlan]] = {}
 
     @staticmethod
@@ -262,4 +279,35 @@ class ICITopologyPlugin(PreFilterPlugin, ScorePlugin):
     def score(self, state: CycleState, pod: Pod, node: str) -> float:
         plans = state.get(STATE_TOPO_PLANS) or {}
         plan = plans.get(node)
-        return plan.score if plan is not None else 0.0
+        base = plan.score if plan is not None else 0.0
+        return base + self._slice_affinity(state, pod, node)
+
+    def _slice_affinity(self, state: CycleState, pod: Pod,
+                        node: str) -> float:
+        """Multi-host gang members prefer nodes inside the ICI slice
+        that already hosts their gang (cross-slice = DCN traffic).
+        Applies to every member count — a 1-chip member of a spanning
+        gang still wants its gang's fabric.
+
+        The bonus requires the node's slices to be a SUBSET of the
+        gang's fabric, not merely to intersect it: on a (physically
+        unusual) mixed-slice host, chip selection in Reserve is
+        slice-unaware, so steering the pod there could hand it a
+        wrong-slice chip AND pollute the gang's fabric set for every
+        later member. Real TPU hosts are slice-homogeneous, where
+        subset == intersect."""
+        if self.gang_slices is None or self.node_slices is None:
+            return 0.0
+        gang_key = pod.metadata.annotations.get(
+            constants.ANN_GANG_GROUP_KEY, "")
+        if not gang_key:
+            return 0.0
+        if STATE_GANG_SLICES not in state:
+            state[STATE_GANG_SLICES] = self.gang_slices(gang_key)
+        slices = state[STATE_GANG_SLICES]
+        if not slices:
+            return 0.0
+        node_slices = self.node_slices(node)
+        if node_slices and node_slices <= slices:
+            return self.SLICE_AFFINITY_BONUS
+        return 0.0

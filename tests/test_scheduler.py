@@ -435,3 +435,47 @@ def test_compose_native_request_for_proxied_pod():
     empty = Pod.new("plain", namespace="default")
     empty.spec.containers = [Container(name="main")]
     assert compose_alloc_request(empty, include_native=True) is None
+
+
+def test_gang_slice_affinity_keeps_members_on_one_fabric():
+    """Multi-host slice awareness: once the first gang member lands in a
+    slice, later members prefer nodes of the SAME slice (ICI) over
+    equivalent nodes in another slice (DCN)."""
+    from tensorfusion_tpu.scheduler import ICITopologyPlugin
+
+    h = Harness(chips_per_node=1, nodes=4)
+    # nodes 0,1 form slice-A; nodes 2,3 form slice-B
+    for chip in h.allocator.chips():
+        node = chip.chip.status.node_name
+        chip.chip.status.slice_id = \
+            "slice-A" if node in ("node-0", "node-1") else "slice-B"
+    # re-register the topo plugin with the affinity probe wired
+    h.scheduler.plugins = [p for p in h.scheduler.plugins
+                           if not isinstance(p, ICITopologyPlugin)]
+    h.scheduler.register(ICITopologyPlugin(
+        gang_slices=h.allocator.gang_slice_ids,
+        node_slices=h.allocator.node_slice_ids))
+
+    gang_ann = {
+        constants.ANN_WORKLOAD: "spmd",
+        constants.ANN_GANG_GROUP_KEY: "default/spmd",
+        constants.ANN_GANG_ENABLED: "true",
+    }
+    first = h.make_pod("m0", tflops=150.0, hbm=2**30, **gang_ann)
+    assert h.scheduler.schedule_one(first).ok
+    first_slice = h.allocator.get_chip(
+        h.allocator.allocation(first.key()).chip_ids[0]
+    ).chip.status.slice_id
+
+    # schedule three more members: with only 1 chip per node, members
+    # MUST spread across nodes — the second lands in the same slice
+    second = h.make_pod("m1", tflops=150.0, hbm=2**30, **gang_ann)
+    assert h.scheduler.schedule_one(second).ok
+    second_slice = h.allocator.get_chip(
+        h.allocator.allocation(second.key()).chip_ids[0]
+    ).chip.status.slice_id
+    assert second_slice == first_slice
+    assert second.spec.node_name != first.spec.node_name
+
+    # and the allocator reports the gang's fabric
+    assert h.allocator.gang_slice_ids("default/spmd") == {first_slice}
