@@ -30,6 +30,7 @@ sys.path.insert(0, str(REPO))
 
 BATCH = 8
 PROMPT_SMALL, PROMPT_BIG = 128, 512
+PROMPT_LONG = 3072
 DECODE_SMALL, DECODE_BIG = 32, 160
 ROUNDS = 5
 
@@ -108,6 +109,36 @@ def main() -> int:
         q_tok_s[mode] = BATCH * (DECODE_BIG - DECODE_SMALL) \
             / max(best_b - best_s, 1e-9)
 
+    # long-context decode: at 3k+ prompt the KV prefix rivals the
+    # parameter bytes per step, so int8 weights + int8 KV cache
+    # (kv_quant) compound. Same slope method at a long prompt.
+    import dataclasses as _dc
+
+    long_cfg = _dc.replace(config,
+                           max_seq_len=PROMPT_LONG + DECODE_BIG)
+    long_qcfg = _dc.replace(long_cfg, kv_quant=True)
+    w8a8 = quantize_weights_int8(params, mode="w8a8")
+    long_tok_s = {}
+    for name, cfg, p in (("bf16", long_cfg, params),
+                         ("int8_w8a8_kvq", long_qcfg, w8a8)):
+        toks = prompt(PROMPT_LONG)
+        fns = {}
+        for steps in (DECODE_SMALL, DECODE_BIG):
+            fn = jax.jit(lambda pp, tt, s=steps, c=cfg:
+                         generate(pp, tt, s, c))
+            _ = jax.device_get(fn(p, toks))
+            fns[steps] = fn
+        bests = {}
+        for steps, fn in fns.items():
+            best = float("inf")
+            for _ in range(ROUNDS):
+                t0 = time.perf_counter()
+                _ = jax.device_get(fn(p, toks))
+                best = min(best, time.perf_counter() - t0)
+            bests[steps] = best
+        long_tok_s[name] = BATCH * (DECODE_BIG - DECODE_SMALL) \
+            / max(bests[DECODE_BIG] - bests[DECODE_SMALL], 1e-9)
+
     # slopes: prompt-length delta isolates prefill; decode-length delta
     # isolates decode; constant (RTT, fixed scan overhead) cancels
     prefill_tok_s = BATCH * (PROMPT_BIG - PROMPT_SMALL) \
@@ -156,6 +187,10 @@ def main() -> int:
         "hbm_utilization_pct": round(hbm_gbps / datasheet_gbps * 100, 1),
         "decode_tokens_per_s_int8_w8a16": round(q_tok_s["w8a16"], 1),
         "decode_tokens_per_s_int8_w8a8": round(q_tok_s["w8a8"], 1),
+        "long_prompt_len": PROMPT_LONG,
+        "decode_tokens_per_s_long_bf16": round(long_tok_s["bf16"], 1),
+        "decode_tokens_per_s_long_int8": round(
+            long_tok_s["int8_w8a8_kvq"], 1),
     }
     try:
         from benchmarks._artifact import write_artifact

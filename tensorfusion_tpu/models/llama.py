@@ -44,6 +44,13 @@ class LlamaConfig:
     attn_impl: str = "full"   # "full" | "ring" | "flash" | "chunked"
     attn_block: int = 512     # KV block for attn_impl="chunked"
     remat: bool = False
+    #: int8 KV cache for serving: halves the cache's HBM footprint and
+    #: per-step streaming cost — the long-context complement of int8
+    #: weights (models/quantize.py). Per-(token, head) scales factor out
+    #: of both attention dot-products, so the cache is read as int8
+    #: (the convert fuses into the einsum) and never materialized
+    #: dequantized.
+    kv_quant: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -269,10 +276,24 @@ def init_kv_cache(config: LlamaConfig, batch: int,
                   max_len: Optional[int] = None) -> Dict:
     """Preallocated static-shape KV cache: [layer][B, n_kv_heads, T, D].
     Static shapes keep the decode step compilable once — the position is
-    data, not shape (XLA semantics: no dynamic shapes under jit)."""
+    data, not shape (XLA semantics: no dynamic shapes under jit).
+    With ``config.kv_quant`` the cache holds int8 values plus
+    per-(token, head) f32 scales."""
     t = max_len or config.max_seq_len
     hd = config.head_dim
     shape = (batch, config.n_kv_heads, t, hd)
+    if config.kv_quant:
+        sshape = (batch, config.n_kv_heads, t)
+        return {
+            "k": [jnp.zeros(shape, jnp.int8)
+                  for _ in range(config.n_layers)],
+            "ks": [jnp.zeros(sshape, jnp.float32)
+                   for _ in range(config.n_layers)],
+            "v": [jnp.zeros(shape, jnp.int8)
+                  for _ in range(config.n_layers)],
+            "vs": [jnp.zeros(sshape, jnp.float32)
+                   for _ in range(config.n_layers)],
+        }
     return {
         "k": [jnp.zeros(shape, config.dtype)
               for _ in range(config.n_layers)],
@@ -281,38 +302,75 @@ def init_kv_cache(config: LlamaConfig, batch: int,
     }
 
 
-def _attention_decode(config: LlamaConfig, p, x, k_cache, v_cache, pos):
-    """One-token attention against the cache.  x: [B, 1, dim]; caches
-    [B, n_kv, T, D]; pos: scalar int32.  Returns (out, k_cache, v_cache).
+def _kv_quantize(x):
+    """[..., D] -> (int8 [..., D], f32 scale [...]) — symmetric per
+    (token, head) over the head dim."""
+    x32 = x.astype(jnp.float32)
+    s = jnp.maximum(jnp.max(jnp.abs(x32), axis=-1), 1e-8) / 127.0
+    q = jnp.clip(jnp.round(x32 / s[..., None]), -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def _attention_decode(config: LlamaConfig, p, x, lc: Dict, pos):
+    """One-token attention against the cache.  x: [B, 1, dim]; ``lc`` is
+    one layer's cache ({"k","v"} bf16, or {"k","ks","v","vs"} int8 with
+    per-(token, head) scales); pos: scalar int32.  Returns (out, lc).
 
     GQA stays grouped: the query reshapes to [B, n_kv, rep, D] and
     attends against the n_kv-head caches directly — decode is HBM-bound
     and a materialized rep-times cache copy would multiply its dominant
-    cost.
+    cost.  In the int8 path the per-token scales factor OUT of both
+    dot-products (scores_k *= ks_k; probs *= vs_k before the V matmul),
+    so the cache streams from HBM as int8 — the convert fuses into the
+    einsum — and is never materialized dequantized.
     """
     b = x.shape[0]
     hd = config.head_dim
+    quant = "ks" in lc
     q = _mm(x, p["wq"]).reshape(b, 1, config.n_heads, hd)
     k = _mm(x, p["wk"]).reshape(b, 1, config.n_kv_heads, hd)
     v = _mm(x, p["wv"]).reshape(b, 1, config.n_kv_heads, hd)
     q = _rope(q, config.rope_theta, pos=pos)
     k = _rope(k, config.rope_theta, pos=pos)
-    k_cache = lax.dynamic_update_slice(
-        k_cache, k.transpose(0, 2, 1, 3), (0, 0, pos, 0))
-    v_cache = lax.dynamic_update_slice(
-        v_cache, v.transpose(0, 2, 1, 3), (0, 0, pos, 0))
+    k_t = k.transpose(0, 2, 1, 3)                # [B, n_kv, 1, D]
+    v_t = v.transpose(0, 2, 1, 3)
+    if quant:
+        kq, ks = _kv_quantize(k_t)
+        vq, vs = _kv_quantize(v_t)
+        lc = {
+            "k": lax.dynamic_update_slice(lc["k"], kq, (0, 0, pos, 0)),
+            "ks": lax.dynamic_update_slice(lc["ks"], ks, (0, 0, pos)),
+            "v": lax.dynamic_update_slice(lc["v"], vq, (0, 0, pos, 0)),
+            "vs": lax.dynamic_update_slice(lc["vs"], vs, (0, 0, pos)),
+        }
+    else:
+        lc = {
+            "k": lax.dynamic_update_slice(lc["k"], k_t, (0, 0, pos, 0)),
+            "v": lax.dynamic_update_slice(lc["v"], v_t, (0, 0, pos, 0)),
+        }
     rep = config.n_heads // config.n_kv_heads
     # [B, 1, (n_kv, rep), D] -> [B, n_kv, rep, D]
     qg = q[:, 0].reshape(b, config.n_kv_heads, rep, hd)
-    scores = jnp.einsum("bgrd,bgkd->bgrk", qg, k_cache) * hd ** -0.5
-    t = k_cache.shape[2]
+    if quant:
+        scores = jnp.einsum("bgrd,bgkd->bgrk", qg,
+                            lc["k"].astype(qg.dtype)) \
+            * lc["ks"][:, :, None, :] * hd ** -0.5
+    else:
+        scores = jnp.einsum("bgrd,bgkd->bgrk", qg, lc["k"]) * hd ** -0.5
+    t = lc["k"].shape[2]
     mask = jnp.arange(t) <= pos                  # positions written so far
     scores = jnp.where(mask[None, None, None, :], scores, -1e30)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
-    out = jnp.einsum("bgrk,bgkd->bgrd", probs.astype(v_cache.dtype),
-                     v_cache)
+    if quant:
+        out = jnp.einsum(
+            "bgrk,bgkd->bgrd",
+            (probs * lc["vs"][:, :, None, :]).astype(x.dtype),
+            lc["v"].astype(x.dtype))
+    else:
+        out = jnp.einsum("bgrk,bgkd->bgrd", probs.astype(lc["v"].dtype),
+                         lc["v"])
     out = out.reshape(b, 1, config.n_heads * hd)
-    return _mm(out, p["wo"]), k_cache, v_cache
+    return _mm(out, p["wo"]), lc
 
 
 def decode_step(params: Dict, token: jax.Array, cache: Dict,
@@ -321,20 +379,19 @@ def decode_step(params: Dict, token: jax.Array, cache: Dict,
     """token [B] int32 + cache + scalar position -> (logits [B, vocab],
     updated cache).  Jit once; loop outside or via lax.scan."""
     x = params["tok_emb"][token][:, None, :]     # [B, 1, dim]
-    new_k, new_v = [], []
+    new_cache: Dict = {k: [] for k in cache}
     for i, layer in enumerate(params["layers"]):
         h = _rms_norm(x, layer["attn_norm"], config.norm_eps)
-        attn, k_c, v_c = _attention_decode(config, layer["attn"], h,
-                                           cache["k"][i], cache["v"][i],
-                                           pos)
-        new_k.append(k_c)
-        new_v.append(v_c)
+        lc = {k: cache[k][i] for k in cache}
+        attn, lc = _attention_decode(config, layer["attn"], h, lc, pos)
+        for k in lc:
+            new_cache[k].append(lc[k])
         x = x + attn
         x = x + _mlp(layer["mlp"],
                      _rms_norm(x, layer["mlp_norm"], config.norm_eps))
     x = _rms_norm(x, params["final_norm"], config.norm_eps)
     logits = _mm(x[:, 0, :], params["lm_head"]).astype(jnp.float32)
-    return logits, {"k": new_k, "v": new_v}
+    return logits, new_cache
 
 
 def prefill(params: Dict, prompt: jax.Array, config: LlamaConfig,
@@ -353,23 +410,32 @@ def prefill(params: Dict, prompt: jax.Array, config: LlamaConfig,
     b, t = prompt.shape
     hd = config.head_dim
     x = params["tok_emb"][prompt]
-    ks, vs = [], []
-    for layer in params["layers"]:
+    cache = init_kv_cache(config, b, max_len=cache_len)
+    for i, layer in enumerate(params["layers"]):
         # the SAME layer body as forward() (honoring attn_impl), with
         # the post-rope K/V captured for the cache
         x, k, v = _layer(config, layer, x, mesh=None, return_kv=True)
-        kc = jnp.zeros((b, config.n_kv_heads, cache_len, hd),
-                       config.dtype)
-        ks.append(lax.dynamic_update_slice(
-            kc, k.transpose(0, 2, 1, 3).astype(config.dtype),
-            (0, 0, 0, 0)))
-        vs.append(lax.dynamic_update_slice(
-            jnp.zeros_like(kc),
-            v.transpose(0, 2, 1, 3).astype(config.dtype),
-            (0, 0, 0, 0)))
+        k_t = k.transpose(0, 2, 1, 3)            # [B, n_kv, T, D]
+        v_t = v.transpose(0, 2, 1, 3)
+        if config.kv_quant:
+            kq, ksc = _kv_quantize(k_t)
+            vq, vsc = _kv_quantize(v_t)
+            cache["k"][i] = lax.dynamic_update_slice(
+                cache["k"][i], kq, (0, 0, 0, 0))
+            cache["ks"][i] = lax.dynamic_update_slice(
+                cache["ks"][i], ksc, (0, 0, 0))
+            cache["v"][i] = lax.dynamic_update_slice(
+                cache["v"][i], vq, (0, 0, 0, 0))
+            cache["vs"][i] = lax.dynamic_update_slice(
+                cache["vs"][i], vsc, (0, 0, 0))
+        else:
+            cache["k"][i] = lax.dynamic_update_slice(
+                cache["k"][i], k_t.astype(config.dtype), (0, 0, 0, 0))
+            cache["v"][i] = lax.dynamic_update_slice(
+                cache["v"][i], v_t.astype(config.dtype), (0, 0, 0, 0))
     x = _rms_norm(x, params["final_norm"], config.norm_eps)
     logits = _mm(x[:, -1, :], params["lm_head"]).astype(jnp.float32)
-    return logits, {"k": ks, "v": vs}
+    return logits, cache
 
 
 def generate(params: Dict, prompt: jax.Array, steps: int,

@@ -495,3 +495,36 @@ def test_quantized_params_shard_on_mesh():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=0.05, atol=0.05 * float(
                                    jnp.abs(ref).max()))
+
+
+def test_int8_kv_cache_decode_tracks_bf16():
+    """kv_quant=True: decode logits stay within the int8 rounding budget
+    of the exact-cache path, and generate() runs the full serving loop
+    (prefill quantizes the prompt K/V, decode appends quantized tokens)."""
+    import dataclasses as _dc
+
+    from tensorfusion_tpu.models import LlamaConfig, forward, init_params
+    from tensorfusion_tpu.models.llama import (decode_step, generate,
+                                               init_kv_cache)
+
+    cfg = LlamaConfig.tiny()
+    qcfg = _dc.replace(cfg, kv_quant=True)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0,
+                              cfg.vocab_size)
+    full = forward(params, toks, cfg)
+
+    cache = init_kv_cache(qcfg, 2, max_len=12)
+    assert cache["k"][0].dtype == jnp.int8 and "ks" in cache
+    step = jax.jit(lambda p, t, c, pos: decode_step(p, t, c, pos, qcfg))
+    outs, pos = [], jnp.int32(0)
+    for t in range(12):
+        logits, cache = step(params, toks[:, t], cache, pos)
+        outs.append(logits)
+        pos = pos + 1
+    dec = jnp.stack(outs, axis=1)
+    scale = float(jnp.abs(full).max())
+    assert float(jnp.abs(dec - full).max()) / scale < 0.05
+
+    gen = jax.jit(lambda p, t: generate(p, t, 6, qcfg))(params, toks[:, :5])
+    assert gen.shape == (2, 6)
