@@ -108,21 +108,64 @@ class AutoScaler:
 
     # ------------------------------------------------------------------
 
+    def _chip_peaks_by_pod(self) -> Dict[tuple, float]:
+        """(namespace, pod) → summed peak bf16 TFLOPs of its allocated
+        chips — computed once per feed pass (both maps are invariant
+        within one pass; rebuilding them per worker series was O(W×C))."""
+        from ..config.chip_info import chip_info
+
+        alloc = self.operator.allocator
+        gen_by_chip = {c.chip.name: c.chip.status.generation
+                       for c in alloc.chips()}
+        out: Dict[tuple, float] = {}
+        for r in alloc.allocations():
+            peaks = [info.bf16_tflops for info in
+                     (chip_info(gen_by_chip.get(cid, ""))
+                      for cid in r.chip_ids) if info is not None]
+            if peaks:
+                out[(r.request.namespace, r.request.pod_name)] = sum(peaks)
+        return out
+
+    def _peak_tflops_for(self, namespace: str, worker: str,
+                         generation_tag: str = "",
+                         peaks_by_pod: Optional[Dict[tuple, float]] = None
+                         ) -> float:
+        """Peak bf16 TFLOPs backing one worker: duty% × this is the
+        observed compute draw (workload_metrics_loader.go loads real
+        per-worker units; an earlier revision hardcoded the v5e's 197
+        and silently mis-sized v5p/v6e pools).
+
+        Resolution order: the chip(s) actually allocated to the worker's
+        pod (summed — a multi-chip worker's duty is a share of the whole
+        grant), then the ``generation`` tag the hypervisor stamps on the
+        series, then the v5e default."""
+        from ..config.chip_info import chip_info
+
+        if peaks_by_pod is None:
+            peaks_by_pod = self._chip_peaks_by_pod()
+        allocated = peaks_by_pod.get((namespace, worker))
+        if allocated:
+            return allocated
+        info = chip_info(generation_tag) or chip_info("v5e")
+        return info.bf16_tflops
+
     def _feed_observations(self, wl_key: str, wl: TPUWorkload) -> None:
         """Pull the workload's recent usage series from the TSDB into the
         percentile histograms (WorkloadMetricsLoader analog)."""
         ns, name = wl.metadata.namespace, wl.metadata.name
         series = self.tsdb.query("tpf_worker", "duty_cycle_pct",
                                  tags={"namespace": ns})
+        peaks_by_pod = self._chip_peaks_by_pod() if series else {}
         for tags, points in series:
-            if not tags.get("worker", "").startswith(name):
+            worker = tags.get("worker", "")
+            if not worker.startswith(name):
                 continue
+            peak = self._peak_tflops_for(ns, worker,
+                                         tags.get("generation", ""),
+                                         peaks_by_pod=peaks_by_pod)
             for p in points:
-                # duty% of a chip -> TFLOPs via the generation peak is done
-                # at observe time by the recorder; here duty is a share of
-                # a 197-TFLOP v5e unless richer data exists
                 self.percentile.observe(wl_key,
-                                        tflops=p.value / 100.0 * 197.0,
+                                        tflops=p.value / 100.0 * peak,
                                         hbm_bytes=0.0, ts=p.ts)
         hbm_series = self.tsdb.query("tpf_worker", "hbm_used_bytes",
                                      tags={"namespace": ns})

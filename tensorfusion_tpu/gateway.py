@@ -24,6 +24,18 @@ Endpoints (mounted under the operator API, or standalone):
   long-poll event window.  ``since_rv=0`` replays the current state as
   ADDED events; a client behind the bounded event log gets
   ``{"reset": true}`` (410-Gone semantics) and must re-list.
+- ``POST   /api/v1/store/metrics`` body ``{"lines": [...]}`` — influx-line
+  metrics ingestion from node hypervisors (the role the vector sidecar →
+  GreptimeDB pipeline plays in the reference,
+  ``internal/utils/compose.go:1224``, ``cmd/main.go:751-767``).  Lines
+  land in a bounded ring AND in the host process's sink (the operator's
+  TSDB) when one is attached.
+- ``GET    /api/v1/store/metrics?since_seq=N[&wait_s=S]`` — long-poll
+  drain of that ring.  The leader operator running against a standalone
+  state store drains from here to feed its TSDB (so the autoscaler and
+  alert evaluator see remote ``tpf_worker`` series without shared
+  volumes).  Metrics are lossy-tolerant: a drainer that falls behind the
+  ring gets ``dropped > 0`` and simply continues from the oldest line.
 
 Auth: optional shared token (``X-TPF-Token`` header, constant-time
 compare) — chip inventory and pod placement are cluster control state, so
@@ -35,7 +47,10 @@ from __future__ import annotations
 
 import hmac
 import logging
-from typing import Dict, Optional, Type
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Type
 
 from .api.meta import Resource, from_dict
 from .api.types import ALL_KINDS
@@ -51,6 +66,51 @@ KIND_BY_NAME: Dict[str, Type[Resource]] = {c.KIND: c for c in ALL_KINDS}
 MAX_WATCH_WAIT_S = 30.0
 
 
+class MetricsBuffer:
+    """Bounded ring of influx lines with monotone sequence numbers.
+
+    The store-side buffer between hypervisor pushes and the leader
+    operator's drain.  Unlike the object event log, metrics loss is
+    acceptable — a slow drainer is told how many lines aged out
+    (``dropped``) and continues from the oldest retained line rather
+    than resetting.
+    """
+
+    def __init__(self, maxlen: int = 65536):
+        self._cond = threading.Condition()
+        self._lines: deque = deque(maxlen=maxlen)   # (seq, line)
+        self._seq = 0
+
+    def push(self, lines: List[str]) -> int:
+        """Append lines; returns the latest sequence number."""
+        with self._cond:
+            for line in lines:
+                if not line:
+                    continue
+                self._seq += 1
+                self._lines.append((self._seq, line))
+            self._cond.notify_all()
+            return self._seq
+
+    def since(self, since_seq: int, wait_s: float = 0.0):
+        """Lines with seq > since_seq; blocks up to wait_s for news.
+
+        Returns (latest_seq, lines, dropped) where dropped counts lines
+        that aged out of the ring before this drainer saw them.
+        """
+        deadline = time.monotonic() + wait_s
+        with self._cond:
+            while self._seq <= since_seq:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return self._seq, [], 0
+                self._cond.wait(remaining)
+            oldest = self._lines[0][0] if self._lines else self._seq + 1
+            dropped = max(0, oldest - since_seq - 1)
+            lines = [line for seq, line in self._lines if seq > since_seq]
+            return self._seq, lines, dropped
+
+
 class StoreGateway:
     """HTTP-facing façade over an ObjectStore.
 
@@ -59,9 +119,15 @@ class StoreGateway:
     sends whatever (code, payload) comes back.
     """
 
-    def __init__(self, store: ObjectStore, token: str = ""):
+    def __init__(self, store: ObjectStore, token: str = "",
+                 metrics_sink: Optional[Callable[[List[str]], None]] = None):
         self.store = store
         self.token = token
+        #: hypervisor-pushed influx lines; drained by the leader operator
+        self.metrics = MetricsBuffer()
+        #: optional same-process consumer (the operator's TSDB) — called
+        #: on every push so a single-process deployment needs no drain
+        self.metrics_sink = metrics_sink
         # event logging stays off until a watcher actually appears
         # (snapshot_events/events_since self-enable) — single-process
         # deployments with no remote watchers never pay the per-write
@@ -113,6 +179,11 @@ class StoreGateway:
                 return self._list(qs)
             elif sub == "watch" and method == "GET":
                 return self._watch(qs)
+            elif sub == "metrics":
+                if method == "POST":
+                    return self._push_metrics(body)
+                if method == "GET":
+                    return self._drain_metrics(qs)
             return 404, {"error": f"no store route {method} {path}"}
         except ValueError as e:
             return 400, {"error": str(e)}
@@ -205,3 +276,25 @@ class StoreGateway:
                      "events": [{"type": etype, "kind": kind, "rv": erv,
                                  "obj": obj}
                                 for etype, kind, erv, obj in events]}
+
+    # -- metrics shipping --------------------------------------------------
+
+    def _push_metrics(self, body) -> tuple:
+        lines = body.get("lines")
+        if not isinstance(lines, list) or \
+                not all(isinstance(ln, str) for ln in lines):
+            raise ValueError('body must be {"lines": ["<influx line>"...]}')
+        seq = self.metrics.push(lines)
+        if self.metrics_sink is not None:
+            try:
+                self.metrics_sink(lines)
+            except Exception:  # noqa: BLE001 - sink trouble must not
+                # bounce the hypervisor's push (it would retry forever)
+                log.exception("metrics sink failed")
+        return 200, {"seq": seq}
+
+    def _drain_metrics(self, qs) -> tuple:
+        since_seq = int(qs.get("since_seq", ["0"])[0])
+        wait_s = min(float(qs.get("wait_s", ["0"])[0]), MAX_WATCH_WAIT_S)
+        seq, lines, dropped = self.metrics.since(since_seq, wait_s=wait_s)
+        return 200, {"seq": seq, "lines": lines, "dropped": dropped}

@@ -76,6 +76,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="externally reachable URL registered on the "
                          "TPUNode (default: the local bind URL — set "
                          "this in cross-host/container deployments)")
+    ap.add_argument("--metrics-path", default="",
+                    help="append influx-line metrics to this file "
+                         "(networked deployments additionally push them "
+                         "to the operator's store gateway)")
+    ap.add_argument("--metrics-interval-s", type=float, default=5.0)
     ap.add_argument("-v", "--verbose", action="store_true")
     return ap
 
@@ -103,6 +108,7 @@ class HypervisorDaemon:
         self.server = HypervisorServer(self.devices, self.workers,
                                        snapshot_dir=args.snapshot_dir,
                                        host=args.host, port=args.port)
+        push = None
         if args.operator_url:
             from ..remote_store import RemoteStore
             from .control_plane import ControlPlaneBackend
@@ -113,6 +119,10 @@ class HypervisorDaemon:
                 store, self.devices, node_name=args.node_name,
                 pool=args.pool, hypervisor_url="", vendor="mock-tpu",
                 known_pids=self.workers.all_pids)
+            # ship metrics into the operator TSDB over the same store
+            # connection (vector-sidecar → GreptimeDB analog) so the
+            # autoscaler/alerts see this node without shared volumes
+            push = store.push_metrics
 
             def on_added(spec):
                 self.workers.add_worker(spec)
@@ -124,6 +134,14 @@ class HypervisorDaemon:
                 self.backend.set_worker_env(spec.key,
                                             tracked.status.env)
         self._on_added = on_added
+        self.metrics = None
+        if args.metrics_path or push is not None:
+            from .metrics import HypervisorMetricsRecorder
+
+            self.metrics = HypervisorMetricsRecorder(
+                self.devices, self.workers, path=args.metrics_path,
+                interval_s=args.metrics_interval_s,
+                node_name=args.node_name, push=push)
 
     def start(self) -> None:
         args = self.args
@@ -138,6 +156,8 @@ class HypervisorDaemon:
         self.server.backend = self.backend
         self.backend.start(self._on_added, self.workers.remove_worker)
         self.workers.start()
+        if self.metrics is not None:
+            self.metrics.start()
         self.log.info(
             "hypervisor serving on %s (%d chips)%s", self.server.url,
             len(self.devices.devices()),
@@ -145,6 +165,8 @@ class HypervisorDaemon:
             if args.operator_url else "")
 
     def stop(self) -> None:
+        if self.metrics is not None:
+            self.metrics.stop()
         self.server.stop()
         self.workers.stop()
         self.backend.stop()

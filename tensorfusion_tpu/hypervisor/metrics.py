@@ -1,8 +1,20 @@
 """Hypervisor metrics recorder.
 
 Analog of the reference's ``pkg/hypervisor/metrics/metrics.go:111-236``:
-periodic influx-line metrics for devices / workers / processes appended to a
-metrics file (shipped by a forwarder into the TSDB).
+periodic influx-line metrics for devices / workers / processes.  Two
+delivery paths, matching the reference's vector-sidecar shipping
+(``internal/utils/compose.go:1224``):
+
+- appended to a local metrics file (``path``) for on-node inspection /
+  file-tail ingestion;
+- pushed over the network to the store gateway's metrics ring (``push``,
+  normally ``RemoteStore.push_metrics``) so the operator's TSDB — and
+  therefore the autoscaler and alert evaluator — see this node's
+  ``tpf_chip`` / ``tpf_worker`` series without any shared volume.
+
+Push failures buffer into a bounded backlog and retry on the next tick:
+a partitioned node agent ships a gap-free (up to the backlog bound)
+series once the operator is reachable again.
 """
 
 from __future__ import annotations
@@ -11,24 +23,33 @@ import logging
 import os
 import threading
 import time
-from typing import Optional
+from collections import deque
+from typing import Callable, List, Optional
 
 from ..metrics.encoder import encode_line
 
 log = logging.getLogger("tpf.hypervisor.metrics")
 
+#: max influx lines buffered while the operator is unreachable (at 5s
+#: intervals and ~10 lines/tick this is ~an hour of partition)
+PUSH_BACKLOG_LINES = 8192
+
 
 class HypervisorMetricsRecorder:
-    def __init__(self, devices, workers, path: str,
-                 interval_s: float = 5.0, node_name: str = "local"):
+    def __init__(self, devices, workers, path: str = "",
+                 interval_s: float = 5.0, node_name: str = "local",
+                 push: Optional[Callable[[List[str]], object]] = None):
         self.devices = devices
         self.workers = workers
         self.path = path
         self.interval_s = interval_s
         self.node_name = node_name
+        self.push = push
+        self._backlog: deque = deque(maxlen=PUSH_BACKLOG_LINES)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        if path:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
 
     def start(self) -> None:
         self._stop.clear()
@@ -47,6 +68,17 @@ class HypervisorMetricsRecorder:
                 self.record_once()
             except Exception:
                 log.exception("metrics pass failed")
+
+    def _worker_generation(self, w) -> str:
+        """Chip generation of the worker's first bound device — rides on
+        the tpf_worker line so the operator-side autoscaler converts
+        duty% to TFLOPs with the right per-generation peak
+        (workload_metrics_loader.go loads real per-worker units)."""
+        for chip_id in w.status.chip_ids:
+            entry = self.devices.get(chip_id)
+            if entry is not None:
+                return entry.info.generation
+        return ""
 
     def record_once(self) -> None:
         lines = []
@@ -69,16 +101,40 @@ class HypervisorMetricsRecorder:
                  "ici_rx_bytes": int(m.ici_rx_bytes),
                  "partitions": len(e.partitions)}, ts))
         for w in self.workers.list():
+            tags = {"node": self.node_name, "namespace": w.spec.namespace,
+                    "worker": w.spec.name, "qos": w.spec.qos,
+                    "isolation": w.spec.isolation}
+            generation = self._worker_generation(w)
+            if generation:
+                tags["generation"] = generation
             lines.append(encode_line(
-                "tpf_worker",
-                {"node": self.node_name, "namespace": w.spec.namespace,
-                 "worker": w.spec.name, "qos": w.spec.qos,
-                 "isolation": w.spec.isolation},
+                "tpf_worker", tags,
                 {"duty_cycle_pct": w.status.duty_cycle_pct,
                  "hbm_used_bytes": int(w.status.hbm_used_bytes),
                  "frozen": w.status.frozen,
                  "pids": len(w.status.pids)}, ts))
         if not lines:
             return
-        with open(self.path, "a") as f:
-            f.write("\n".join(lines) + "\n")
+        if self.path:
+            with open(self.path, "a") as f:
+                f.write("\n".join(lines) + "\n")
+        if self.push is not None:
+            self._backlog.extend(lines)
+            self.flush()
+
+    def flush(self) -> bool:
+        """Attempt to ship the backlog; returns True when drained."""
+        if self.push is None or not self._backlog:
+            return True
+        batch = list(self._backlog)
+        try:
+            self.push(batch)
+        except Exception as e:  # noqa: BLE001 - operator down/partition:
+            # keep buffering, the next tick retries
+            log.debug("metrics push failed (%d lines buffered): %s",
+                      len(self._backlog), e)
+            return False
+        # drop exactly what we shipped (lines appended meanwhile stay)
+        for _ in range(min(len(batch), len(self._backlog))):
+            self._backlog.popleft()
+        return not self._backlog

@@ -117,11 +117,13 @@ class Operator:
         self.alerts = AlertEvaluator(self.tsdb, rules=alert_rules,
                                      webhook_url=alert_webhook) \
             if alert_rules is not None or alert_webhook else None
-        #: hypervisor metrics files to tail into the TSDB (gives the
-        #: autoscaler its tpf_worker usage series — the vector-sidecar
-        #: shipping analog)
+        #: hypervisor metrics files to tail into the TSDB (single-host /
+        #: test convenience; the production path is hypervisors PUSHING
+        #: lines through the store gateway's metrics ring — see
+        #: ingest_metrics_lines and the drain in _sync_loop)
         self.worker_metrics_paths: List[str] = []
         self._metrics_offsets: Dict[str, int] = {}
+        self._metrics_drain_seq = 0
 
         # hot-reloaded GlobalConfig (cmd/main.go:614-712 analog): live
         # components pick up changes without a restart
@@ -156,12 +158,19 @@ class Operator:
         """Push a (re)loaded GlobalConfig into the live components."""
         if self.metrics is not None and cfg.metrics_interval_s > 0:
             self.metrics.interval_s = cfg.metrics_interval_s
-        if self.alerts is not None and cfg.alert_rules:
-            from .alert.evaluator import AlertRule
+        if cfg.alert_rules:
+            from .alert.evaluator import AlertEvaluator, AlertRule
 
-            self.alerts.set_rules([
-                r if isinstance(r, AlertRule) else AlertRule(**r)
-                for r in cfg.alert_rules])
+            rules = [r if isinstance(r, AlertRule) else AlertRule(**r)
+                     for r in cfg.alert_rules]
+            if self.alerts is None:
+                # rules arriving by hot config bring the evaluator up
+                # (the reference reloads alert rules from a ConfigMap)
+                self.alerts = AlertEvaluator(self.tsdb, rules=rules)
+                if self._components_started:
+                    self.alerts.start()
+            else:
+                self.alerts.set_rules(rules)
         if cfg.default_pool and cfg.scheduler_placement_mode:
             self.allocator.set_pool_strategy(cfg.default_pool,
                                              cfg.scheduler_placement_mode)
@@ -229,10 +238,14 @@ class Operator:
             self.autoscaler.start()
         if self.alerts is not None:
             self.alerts.start()
+        # mark components live BEFORE the boot-time config apply: a
+        # GlobalConfig that carries alert rules may construct the alert
+        # evaluator, and _apply_global_config only starts it when
+        # _components_started is already set
+        self._components_started = True
         if self.config_watcher is not None:
             self._apply_global_config(self.config_watcher.config)
             self.config_watcher.start()
-        self._components_started = True
         log.info("operator components started")
 
     def stop(self) -> None:
@@ -278,8 +291,8 @@ class Operator:
 
     def _sync_loop(self, stop: threading.Event) -> None:
         """Background maintenance: dirty chip flush + assumed-TTL sweep
-        (gpuallocator syncToK8s / TTL sweep loops).  Takes its
-        generation's stop event so a stale thread can't be revived."""
+        (gpuallocator syncToK8s / TTL sweep loops) + metrics feed.  Takes
+        its generation's stop event so a stale thread can't be revived."""
         while not stop.wait(self.sync_interval_s):
             try:
                 self.allocator.sync_to_store()
@@ -287,8 +300,41 @@ class Operator:
                 for path in self.worker_metrics_paths:
                     self._metrics_offsets[path] = self.tsdb.ingest_file(
                         path, self._metrics_offsets.get(path, 0))
+                self._drain_remote_metrics()
             except Exception:
                 log.exception("operator sync pass failed")
+
+    def ingest_metrics_lines(self, lines) -> None:
+        """Feed hypervisor-pushed influx lines into the TSDB (the sink
+        the OperatorServer's store gateway delivers POST /metrics to)."""
+        for line in lines:
+            try:
+                self.tsdb.ingest_line(line)
+            except ValueError:
+                pass
+
+    def _drain_remote_metrics(self) -> None:
+        """HA replica mode: the authoritative store (and its metrics
+        ring) lives in the standalone state-store daemon — the leader
+        pulls pushed hypervisor lines from there into its TSDB so the
+        autoscaler and alert evaluator run on live remote series
+        (the operator half of the GreptimeDB pipeline,
+        cmd/main.go:751-767)."""
+        drain = getattr(self.store, "drain_metrics", None)
+        if drain is None:
+            return
+        try:
+            seq, lines, dropped = drain(self._metrics_drain_seq)
+        except Exception as e:  # noqa: BLE001 - store hiccup; next pass
+            log.debug("metrics drain failed: %s", e)
+            return
+        if dropped:
+            log.warning("metrics ring overflowed: %d lines lost before "
+                        "this drain (autoscaler/alert series have a gap)",
+                        dropped)
+        self._metrics_drain_seq = seq
+        if lines:
+            self.ingest_metrics_lines(lines)
 
     # -- pod entry points ---------------------------------------------------
 
@@ -391,6 +437,12 @@ def main(argv=None, stop_event: Optional[threading.Event] = None) -> int:
     ap.add_argument("--pool", default="pool-a")
     ap.add_argument("--metrics-path", default="",
                     help="write influx-line metrics to this file")
+    ap.add_argument("--enable-autoscaler", action="store_true",
+                    help="run the VPA autoscaler (leader-only loop fed "
+                         "by hypervisor-pushed tpf_worker series)")
+    ap.add_argument("--alert-webhook", default="",
+                    help="POST firing/resolved alerts here (enables the "
+                         "alert evaluator; rules come from --config)")
     ap.add_argument("--config", default="",
                     help="hot-reloaded GlobalConfig JSON file")
     ap.add_argument("--bootstrap-host", default="",
@@ -422,7 +474,9 @@ def main(argv=None, stop_event: Optional[threading.Event] = None) -> int:
                 log.info("loaded %d persisted objects", n)
 
     op = Operator(store=store, metrics_path=args.metrics_path,
-                  config_path=args.config)
+                  config_path=args.config,
+                  enable_autoscaler=args.enable_autoscaler,
+                  alert_webhook=args.alert_webhook)
     # bootstrap the pool: ride out a state store that is still coming up
     # (transport errors retry; a concurrent replica winning the create is
     # success, not failure)

@@ -283,6 +283,28 @@ class RemoteStore:
     def watch(self, *kinds: str, replay: bool = True) -> RemoteWatch:
         return RemoteWatch(self, kinds, replay=replay)
 
+    # -- metrics shipping --------------------------------------------------
+
+    def push_metrics(self, lines: List[str]) -> int:
+        """Ship influx-line metrics to the store gateway's ring (the
+        hypervisor→TSDB network path; vector-sidecar analog).  Returns
+        the gateway's latest sequence number."""
+        out = self._request("POST", "/api/v1/store/metrics",
+                            body={"lines": list(lines)}, max_tries=1)
+        return int(out.get("seq", 0))
+
+    def drain_metrics(self, since_seq: int = 0,
+                      wait_s: float = 0.0):
+        """Drain metrics lines pushed by remote hypervisors (the leader
+        operator's feed).  Returns (latest_seq, lines, dropped) where
+        dropped counts lines that aged out of the gateway's ring before
+        this drainer saw them (lossy by design, but observable)."""
+        out = self._request("GET", "/api/v1/store/metrics",
+                            query={"since_seq": str(since_seq),
+                                   "wait_s": str(wait_s)}, max_tries=1)
+        return (int(out.get("seq", since_seq)), out.get("lines", []),
+                int(out.get("dropped", 0)))
+
     # -- liveness ----------------------------------------------------------
 
     def ping(self, timeout_s: float = 5.0) -> bool:

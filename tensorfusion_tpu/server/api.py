@@ -59,8 +59,13 @@ class OperatorServer:
         self.operator = operator
         # the gateway serves only when this process owns the
         # authoritative store; HA replicas run against a RemoteStore and
-        # point hypervisors at the standalone state store instead
-        self.gateway = StoreGateway(operator.store, token=store_token) \
+        # point hypervisors at the standalone state store instead.
+        # Hypervisor-pushed metrics land straight in the operator's TSDB
+        # (single-process topology; the HA topology drains them from the
+        # state store's ring instead — operator._drain_remote_metrics)
+        self.gateway = StoreGateway(
+            operator.store, token=store_token,
+            metrics_sink=operator.ingest_metrics_lines) \
             if isinstance(operator.store, ObjectStore) else None
         outer = self
 
