@@ -1,3 +1,3 @@
 """Alert evaluation over the in-process TSDB."""
 
-from .evaluator import Alert, AlertEvaluator, AlertRule
+from .evaluator import Alert, AlertEvaluator, AlertRule, default_rules

@@ -16,7 +16,7 @@ import urllib.request
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
-from ..metrics.tsdb import TSDB
+from ..metrics.tsdb import TSDB, aggregate_values
 
 log = logging.getLogger("tpf.alert")
 
@@ -34,6 +34,11 @@ class AlertRule:
     severity: str = "warning"
     for_s: float = 0.0                # must hold this long before firing
     summary: str = ""
+    #: evaluate per distinct combination of these tag values instead of
+    #: flattening every matching series into one aggregate — one rule
+    #: fires one alert PER group (e.g. per namespace / per chip), named
+    #: ``rule[tagval,...]`` (the reference's rules group in SQL)
+    group_by: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -52,6 +57,26 @@ _OPS: Dict[str, Callable[[float, float], bool]] = {
     "<": lambda a, b: a < b, "<=": lambda a, b: a <= b,
     "==": lambda a, b: a == b,
 }
+
+
+def default_rules() -> List[AlertRule]:
+    """Rules shipped out of the box (the reference ships a default alert
+    ConfigMap).  The quota rule keys on the pre-evaluated
+    ``over_threshold`` flag so each namespace's own configured
+    ``alertThresholdPercent`` decides, not a global constant."""
+    return [
+        AlertRule(name="quota-pressure", measurement="tpf_quota",
+                  metric_field="over_threshold", agg="last", op=">",
+                  threshold=0.5, window_s=60.0, group_by=["namespace"],
+                  severity="warning",
+                  summary="namespace quota usage crossed its configured "
+                          "alert threshold"),
+        AlertRule(name="pool-saturated", measurement="tpf_pool",
+                  metric_field="utilization", agg="last", op=">",
+                  threshold=0.95, window_s=60.0, group_by=["pool"],
+                  severity="warning",
+                  summary="pool allocation above 95% of capacity"),
+    ]
 
 
 class AlertEvaluator:
@@ -90,40 +115,87 @@ class AlertEvaluator:
 
     # ------------------------------------------------------------------
 
+    def _rule_values(self, rule: AlertRule, now: float):
+        """[(alert_name, value)] for one rule — one entry for a flat
+        rule, one per distinct group_by tag combination otherwise."""
+        if not rule.group_by:
+            value = self.tsdb.aggregate(rule.measurement, rule.metric_field,
+                                        agg=rule.agg, tags=rule.tags or None,
+                                        window_s=rule.window_s)
+            return [(rule.name, value)] if value is not None else []
+        series = self.tsdb.query(rule.measurement, rule.metric_field,
+                                 tags=rule.tags or None,
+                                 since=now - rule.window_s, until=now)
+        groups: Dict[tuple, list] = {}
+        lasts: Dict[tuple, tuple] = {}
+        for tags, pts in series:
+            key = tuple(tags.get(g, "") for g in rule.group_by)
+            groups.setdefault(key, []).extend(p.value for p in pts)
+            if pts and (key not in lasts or pts[-1].ts > lasts[key][0]):
+                lasts[key] = (pts[-1].ts, pts[-1].value)
+        out = []
+        for key, values in groups.items():
+            value = lasts[key][1] if rule.agg == "last" \
+                else aggregate_values(values, rule.agg)
+            if value is not None:
+                # escape separator chars so distinct tag combinations
+                # can never collide into one alert name
+                vals = ",".join(v.replace("\\", "\\\\").replace(",", "\\,")
+                                for v in key)
+                out.append((f"{rule.name}[{vals}]", value))
+        return out
+
     def evaluate_once(self, now: Optional[float] = None) -> List[Alert]:
         now = now if now is not None else time.time()
         changed: List[Alert] = []
         for rule in self.rules:
-            value = self.tsdb.aggregate(rule.measurement, rule.metric_field,
-                                        agg=rule.agg, tags=rule.tags or None,
-                                        window_s=rule.window_s)
-            breached = value is not None and \
-                _OPS.get(rule.op, _OPS[">"])(value, rule.threshold)
-            if breached:
-                since = self._pending_since.setdefault(rule.name, now)
-                if now - since >= rule.for_s and rule.name not in self.active:
-                    alert = Alert(rule=rule.name, severity=rule.severity,
+            named_values = self._rule_values(rule, now)
+            breached_names = set()
+            for name, value in named_values:
+                if not _OPS.get(rule.op, _OPS[">"])(value, rule.threshold):
+                    continue
+                breached_names.add(name)
+                since = self._pending_since.setdefault(name, now)
+                if now - since >= rule.for_s and name not in self.active:
+                    alert = Alert(rule=name, severity=rule.severity,
                                   value=value, threshold=rule.threshold,
                                   state="firing", since=since,
-                                  summary=rule.summary or rule.name)
-                    self.active[rule.name] = alert
+                                  summary=rule.summary or name)
+                    self.active[name] = alert
                     self.history.append(alert)
                     changed.append(alert)
                     log.warning("ALERT firing: %s (%.3f %s %.3f)",
-                                rule.name, value, rule.op, rule.threshold)
-            else:
-                self._pending_since.pop(rule.name, None)
-                if rule.name in self.active:
-                    alert = self.active.pop(rule.name)
-                    resolved = Alert(rule=alert.rule, severity=alert.severity,
-                                     value=value if value is not None
-                                     else alert.value,
-                                     threshold=alert.threshold,
-                                     state="resolved", since=alert.since,
-                                     summary=alert.summary)
-                    self.history.append(resolved)
-                    changed.append(resolved)
-                    log.info("alert resolved: %s", rule.name)
+                                name, value, rule.op, rule.threshold)
+            # resolution: previously-active alerts of this rule whose
+            # group no longer breaches (or vanished from the window)
+            values_by_name = dict(named_values)
+
+            def owned(name: str, rule=rule) -> bool:
+                return name.startswith(f"{rule.name}[") if rule.group_by \
+                    else name == rule.name
+
+            for name in list(self.active):
+                if not owned(name):
+                    continue
+                if name in breached_names:
+                    continue
+                self._pending_since.pop(name, None)
+                alert = self.active.pop(name)
+                value = values_by_name.get(name)
+                resolved = Alert(rule=alert.rule, severity=alert.severity,
+                                 value=value if value is not None
+                                 else alert.value,
+                                 threshold=alert.threshold,
+                                 state="resolved", since=alert.since,
+                                 summary=alert.summary)
+                self.history.append(resolved)
+                changed.append(resolved)
+                log.info("alert resolved: %s", name)
+            # drop pending state for groups that stopped breaching
+            # before reaching for_s
+            for name in list(self._pending_since):
+                if owned(name) and name not in breached_names:
+                    self._pending_since.pop(name, None)
         if changed and self.webhook_url:
             self._post(changed)
         return changed

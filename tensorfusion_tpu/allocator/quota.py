@@ -206,6 +206,41 @@ class QuotaStore:
             for req in committed:
                 self.commit(req, was_assumed=False)
 
+    def pressure(self) -> Dict[str, Dict[str, float]]:
+        """Per-namespace quota pressure for observability + alerting
+        (the role of ``alertThresholdPercent`` on
+        ``gpuresourcequota_types.go:26-131``, which the reference's alert
+        pipeline evaluates): per-resource used/cap percentages, the peak
+        across resources, the quota's configured threshold, and a
+        pre-evaluated ``over_threshold`` flag — so one static alert rule
+        honors each namespace's own configured percent."""
+        out: Dict[str, Dict[str, float]] = {}
+        with self._lock:
+            for ns, u in self._ns.items():
+                if u.quota is None:
+                    continue
+                total = u.quota.spec.total
+                ratios: Dict[str, float] = {}
+                for attr in ("tflops", "hbm_bytes"):
+                    cap = getattr(total.requests, attr)
+                    if cap <= 0:
+                        continue
+                    used = (getattr(u.committed_requests, attr)
+                            + getattr(u.assumed_requests, attr))
+                    ratios[f"{attr}_used_pct"] = 100.0 * used / cap
+                if total.max_workers > 0:
+                    ratios["workers_used_pct"] = 100.0 * (
+                        u.committed_workers + u.assumed_workers) \
+                        / total.max_workers
+                if not ratios:
+                    continue
+                peak = max(ratios.values())
+                threshold = total.alert_threshold_percent
+                out[ns] = dict(
+                    ratios, pressure_pct=peak, threshold_pct=threshold,
+                    over_threshold=1.0 if peak >= threshold else 0.0)
+        return out
+
     def sync_to_store(self) -> None:
         """Write usage into TPUResourceQuota.status (SyncQuotasToK8s analog)."""
         if self.store is None:

@@ -62,6 +62,7 @@ class AutoScaler:
         """One pass (autoscaler.go Run analog).  Returns #adjustments."""
         op = self.operator
         adjusted = 0
+        peaks_by_pod = None
         for wl in op.store.list(TPUWorkload):
             cfg = wl.spec.auto_scaling
             if not cfg.enabled:
@@ -73,7 +74,9 @@ class AutoScaler:
                        and (r.request.workload_name == wl.metadata.name)]
             if not records:
                 continue
-            self._feed_observations(wl_key, wl)
+            if peaks_by_pod is None:     # once per pass, not per workload
+                peaks_by_pod = self._chip_peaks_by_pod()
+            self._feed_observations(wl_key, wl, peaks_by_pod)
             for record in records:
                 current = record.request.request
                 rec = self._recommend(wl_key, wl, current)
@@ -149,13 +152,16 @@ class AutoScaler:
         info = chip_info(generation_tag) or chip_info("v5e")
         return info.bf16_tflops
 
-    def _feed_observations(self, wl_key: str, wl: TPUWorkload) -> None:
+    def _feed_observations(self, wl_key: str, wl: TPUWorkload,
+                           peaks_by_pod: Optional[Dict[tuple, float]] = None
+                           ) -> None:
         """Pull the workload's recent usage series from the TSDB into the
         percentile histograms (WorkloadMetricsLoader analog)."""
         ns, name = wl.metadata.namespace, wl.metadata.name
         series = self.tsdb.query("tpf_worker", "duty_cycle_pct",
                                  tags={"namespace": ns})
-        peaks_by_pod = self._chip_peaks_by_pod() if series else {}
+        if peaks_by_pod is None and series:
+            peaks_by_pod = self._chip_peaks_by_pod()
         for tags, points in series:
             worker = tags.get("worker", "")
             if not worker.startswith(name):

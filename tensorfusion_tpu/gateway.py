@@ -80,6 +80,12 @@ class MetricsBuffer:
         self._cond = threading.Condition()
         self._lines: deque = deque(maxlen=maxlen)   # (seq, line)
         self._seq = 0
+        #: identifies this buffer instance: sequence numbers are only
+        #: comparable within one epoch — a drainer that sees the epoch
+        #: change (store restart) must restart from seq 0 or it silently
+        #: skips the new epoch's lines
+        import uuid
+        self.epoch = uuid.uuid4().hex[:12]
 
     def push(self, lines: List[str]) -> int:
         """Append lines; returns the latest sequence number."""
@@ -297,4 +303,5 @@ class StoreGateway:
         since_seq = int(qs.get("since_seq", ["0"])[0])
         wait_s = min(float(qs.get("wait_s", ["0"])[0]), MAX_WATCH_WAIT_S)
         seq, lines, dropped = self.metrics.since(since_seq, wait_s=wait_s)
-        return 200, {"seq": seq, "lines": lines, "dropped": dropped}
+        return 200, {"seq": seq, "lines": lines, "dropped": dropped,
+                     "epoch": self.metrics.epoch}

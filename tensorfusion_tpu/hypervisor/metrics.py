@@ -115,11 +115,17 @@ class HypervisorMetricsRecorder:
                  "pids": len(w.status.pids)}, ts))
         if not lines:
             return
-        if self.path:
-            with open(self.path, "a") as f:
-                f.write("\n".join(lines) + "\n")
+        # buffer for the network path FIRST: a full disk must not cost
+        # the (healthy) push path this tick's lines
         if self.push is not None:
             self._backlog.extend(lines)
+        if self.path:
+            try:
+                with open(self.path, "a") as f:
+                    f.write("\n".join(lines) + "\n")
+            except OSError as e:
+                log.warning("metrics file append failed: %s", e)
+        if self.push is not None:
             self.flush()
 
     def flush(self) -> bool:

@@ -145,6 +145,13 @@ class MetricsRecorder:
             lines.append(encode_line("tpf_workload", tags, fields, ts))
             self.tsdb.insert("tpf_workload", tags, fields, now)
 
+        # per-namespace quota pressure (alertThresholdPercent analog —
+        # feeds the default quota-pressure alert rule)
+        for ns, fields in op.allocator.quota.pressure().items():
+            tags = {"namespace": ns}
+            lines.append(encode_line("tpf_quota", tags, fields, ts))
+            self.tsdb.insert("tpf_quota", tags, fields, now)
+
         # scheduler counters
         sched_fields = {"scheduled_total": op.scheduler.scheduled_count,
                         "failed_total": op.scheduler.failed_count,

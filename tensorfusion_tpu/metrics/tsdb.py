@@ -123,29 +123,12 @@ class TSDB:
         agg: mean | max | min | sum | count | p50 | p90 | p95 | p99 | last"""
         series = self.query(measurement, field, tags,
                             since=time.time() - window_s)
-        values = [p.value for _, pts in series for p in pts]
-        if not values:
-            return None
-        if agg == "mean":
-            return sum(values) / len(values)
-        if agg == "max":
-            return max(values)
-        if agg == "min":
-            return min(values)
-        if agg == "sum":
-            return sum(values)
-        if agg == "count":
-            return float(len(values))
         if agg == "last":
             latest = max(((pts[-1].ts, pts[-1].value)
                           for _, pts in series), default=None)
             return latest[1] if latest else None
-        if agg.startswith("p"):
-            q = float(agg[1:]) / 100.0
-            values.sort()
-            idx = min(int(q * len(values)), len(values) - 1)
-            return values[idx]
-        raise ValueError(f"unknown aggregation {agg!r}")
+        values = [p.value for _, pts in series for p in pts]
+        return aggregate_values(values, agg)
 
     def gc(self) -> None:
         cutoff = time.time() - self.retention_s
@@ -155,3 +138,27 @@ class TSDB:
                     dq.popleft()
                 if not dq:
                     del self._series[key]
+
+
+def aggregate_values(values, agg: str) -> Optional[float]:
+    """Aggregate a flat value list (shared by TSDB.aggregate and the
+    alert evaluator's group-by path).  'last' needs timestamps and is
+    handled by the callers."""
+    if not values:
+        return None
+    if agg == "mean":
+        return sum(values) / len(values)
+    if agg == "max":
+        return max(values)
+    if agg == "min":
+        return min(values)
+    if agg == "sum":
+        return sum(values)
+    if agg == "count":
+        return float(len(values))
+    if agg.startswith("p"):
+        q = float(agg[1:]) / 100.0
+        values = sorted(values)
+        idx = min(int(q * len(values)), len(values) - 1)
+        return values[idx]
+    raise ValueError(f"unknown aggregation {agg!r}")

@@ -109,14 +109,25 @@ class Operator:
         from .metrics.tsdb import TSDB
 
         self.tsdb = TSDB()
+        # alerts (and the default tpf_quota/tpf_pool rules) are fed by
+        # the recorder — enabling alerting without it would evaluate
+        # against permanent silence
+        want_alerts = alert_rules is not None or bool(alert_webhook)
         self.metrics = MetricsRecorder(self, tsdb=self.tsdb,
                                        path=metrics_path) \
-            if enable_metrics or metrics_path else None
+            if enable_metrics or metrics_path or want_alerts else None
         self.autoscaler = AutoScaler(self, self.tsdb) \
             if enable_autoscaler else None
-        self.alerts = AlertEvaluator(self.tsdb, rules=alert_rules,
-                                     webhook_url=alert_webhook) \
-            if alert_rules is not None or alert_webhook else None
+        if want_alerts:
+            from .alert.evaluator import default_rules
+
+            self.alerts = AlertEvaluator(
+                self.tsdb,
+                rules=(list(alert_rules) if alert_rules is not None
+                       else default_rules()),
+                webhook_url=alert_webhook)
+        else:
+            self.alerts = None
         #: hypervisor metrics files to tail into the TSDB (single-host /
         #: test convenience; the production path is hypervisors PUSHING
         #: lines through the store gateway's metrics ring — see
@@ -124,6 +135,7 @@ class Operator:
         self.worker_metrics_paths: List[str] = []
         self._metrics_offsets: Dict[str, int] = {}
         self._metrics_drain_seq = 0
+        self._metrics_drain_epoch = ""
 
         # hot-reloaded GlobalConfig (cmd/main.go:614-712 analog): live
         # components pick up changes without a restart
@@ -324,7 +336,16 @@ class Operator:
         if drain is None:
             return
         try:
-            seq, lines, dropped = drain(self._metrics_drain_seq)
+            seq, lines, dropped, epoch = drain(self._metrics_drain_seq)
+            if epoch and epoch != self._metrics_drain_epoch:
+                if self._metrics_drain_epoch:
+                    # store restarted: its sequence space reset, so our
+                    # cursor would silently skip the new epoch's lines —
+                    # restart from 0 and re-drain immediately
+                    log.warning("metrics ring epoch changed (store "
+                                "restart); re-draining from 0")
+                    seq, lines, dropped, epoch = drain(0)
+                self._metrics_drain_epoch = epoch
         except Exception as e:  # noqa: BLE001 - store hiccup; next pass
             log.debug("metrics drain failed: %s", e)
             return

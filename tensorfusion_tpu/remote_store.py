@@ -288,22 +288,29 @@ class RemoteStore:
     def push_metrics(self, lines: List[str]) -> int:
         """Ship influx-line metrics to the store gateway's ring (the
         hypervisor→TSDB network path; vector-sidecar analog).  Returns
-        the gateway's latest sequence number."""
+        the gateway's latest sequence number.
+
+        No transport retry (max_tries=0): a timeout whose POST actually
+        landed would double-deliver the same lines and skew count/sum
+        aggregates — the recorder's backlog is the retry mechanism."""
         out = self._request("POST", "/api/v1/store/metrics",
-                            body={"lines": list(lines)}, max_tries=1)
+                            body={"lines": list(lines)})
         return int(out.get("seq", 0))
 
     def drain_metrics(self, since_seq: int = 0,
                       wait_s: float = 0.0):
         """Drain metrics lines pushed by remote hypervisors (the leader
-        operator's feed).  Returns (latest_seq, lines, dropped) where
+        operator's feed).  Returns (latest_seq, lines, dropped, epoch):
         dropped counts lines that aged out of the gateway's ring before
-        this drainer saw them (lossy by design, but observable)."""
+        this drainer saw them (lossy by design, but observable); the
+        epoch changes when the store restarts — sequence numbers are
+        only comparable within one epoch, so the caller must reset its
+        cursor to 0 on an epoch change."""
         out = self._request("GET", "/api/v1/store/metrics",
                             query={"since_seq": str(since_seq),
                                    "wait_s": str(wait_s)}, max_tries=1)
         return (int(out.get("seq", since_seq)), out.get("lines", []),
-                int(out.get("dropped", 0)))
+                int(out.get("dropped", 0)), str(out.get("epoch", "")))
 
     # -- liveness ----------------------------------------------------------
 

@@ -287,3 +287,84 @@ def test_autoscaler_feeds_from_tsdb_series():
         assert 30.0 <= rec.request.request.tflops <= 41.0
     finally:
         op.stop()
+
+
+def test_quota_pressure_metric_and_default_alert():
+    """The configured alertThresholdPercent is actually evaluated
+    (gpuresourcequota_types.go:26-131): usage crossing the namespace's
+    threshold emits over_threshold on tpf_quota, and the shipped default
+    rule fires a per-namespace alert that resolves when usage drops."""
+    from tensorfusion_tpu.alert import default_rules
+    from tensorfusion_tpu.api.types import TPUResourceQuota
+
+    op = _operator_with_host()
+    try:
+        quota = TPUResourceQuota.new("q", namespace="default")
+        quota.spec.total.requests = ResourceAmount(tflops=100.0)
+        quota.spec.total.alert_threshold_percent = 95.0
+        op.store.create(quota)
+        deadline = time.time() + 5
+        while op.allocator.quota.get_usage("default") is None and \
+                time.time() < deadline:
+            time.sleep(0.02)
+
+        tsdb = TSDB()
+        rec = MetricsRecorder(op, tsdb=tsdb)
+        ev = AlertEvaluator(tsdb, rules=default_rules())
+
+        # 80% usage: pressure series exists but no alert
+        _submit(op, "q-a", 80.0, 2 * 2**30)
+        rec.record_once()
+        assert tsdb.aggregate("tpf_quota", "pressure_pct",
+                              tags={"namespace": "default"},
+                              agg="last") == pytest.approx(80.0)
+        assert ev.evaluate_once() == []
+
+        # crossing the 95% threshold fires a namespace-named alert
+        _submit(op, "q-b", 16.0, 2 * 2**30)
+        rec.record_once()
+        changed = ev.evaluate_once()
+        assert [a.rule for a in changed] == ["quota-pressure[default]"]
+        assert changed[0].state == "firing"
+
+        # dropping back below resolves it (agg=last sees the new point)
+        op.delete_pod("q-b")
+        deadline = time.time() + 5
+        while op.allocator.allocation("default/q-b") is not None and \
+                time.time() < deadline:
+            time.sleep(0.02)
+        rec.record_once()
+        changed = ev.evaluate_once()
+        assert [(a.rule, a.state) for a in changed] \
+            == [("quota-pressure[default]", "resolved")]
+    finally:
+        op.stop()
+
+
+def test_grouped_alert_rule_fires_per_tag_combination():
+    """group_by evaluates one rule per distinct tag value: two hot
+    namespaces fire two alerts; one cooling down resolves only its own."""
+    from tensorfusion_tpu.alert import AlertEvaluator, AlertRule
+
+    db = TSDB()
+    ev = AlertEvaluator(db, rules=[AlertRule(
+        name="hot", measurement="m", metric_field="v", agg="max", op=">",
+        threshold=50.0, window_s=60.0, group_by=["ns"])])
+    t0 = time.time() - 100
+    db.insert("m", {"ns": "a"}, {"v": 90.0}, ts=t0)
+    db.insert("m", {"ns": "b"}, {"v": 70.0}, ts=t0)
+    db.insert("m", {"ns": "c"}, {"v": 10.0}, ts=t0)
+    changed = ev.evaluate_once(now=t0 + 10)
+    assert sorted(a.rule for a in changed) == ["hot[a]", "hot[b]"]
+
+    # 'a' cools off, 'b' stays hot (fresh points; old ones age out)
+    db.insert("m", {"ns": "a"}, {"v": 5.0}, ts=t0 + 70)
+    db.insert("m", {"ns": "b"}, {"v": 95.0}, ts=t0 + 70)
+    changed = ev.evaluate_once(now=t0 + 75)
+    assert [(a.rule, a.state) for a in changed] == [("hot[a]", "resolved")]
+    assert set(ev.active) == {"hot[b]"}
+
+    # a group that vanishes from the window entirely also resolves
+    changed = ev.evaluate_once(now=t0 + 500)
+    assert [(a.rule, a.state) for a in changed] == [("hot[b]", "resolved")]
+    assert not ev.active
