@@ -296,12 +296,16 @@ def child_main() -> int:
     platform = device.platform
 
     # Workload sized to keep the MXU busy but fit one chip comfortably.
+    # On TPU the train step runs the Pallas flash kernel fwd+bwd (the
+    # custom VJP), not dense attention — the [T,T] score tensor never
+    # touches HBM in either direction.
     big = platform != "cpu"
     config = LlamaConfig(
         vocab_size=32000, dim=1024 if big else 256,
         n_layers=8 if big else 2, n_heads=8, n_kv_heads=8,
         ffn_dim=4096 if big else 512, max_seq_len=1024,
-        dtype=jnp.bfloat16 if big else jnp.float32)
+        dtype=jnp.bfloat16 if big else jnp.float32,
+        attn_impl="flash" if platform == "tpu" else "full")
     batch, seq = (8, 512) if big else (2, 128)
 
     params = init_params(config, jax.random.PRNGKey(0))

@@ -173,12 +173,15 @@ def _attention(config: LlamaConfig, p, x,
     if config.attn_impl == "ring" and mesh is not None:
         out = ring_attention_sharded(q, k, v, mesh)
     elif config.attn_impl == "flash":
+        # trains too: the Pallas kernel carries a FlashAttention-2
+        # custom VJP (dq/dkv kernels recompute p from the saved lse)
         from ..ops import flash_attention
 
         out = flash_attention(q, k, v, causal=True)
     elif config.attn_impl == "chunked":
-        # differentiable O(T x block) memory — long-seq single-chip
-        # training (the pallas flash kernel is forward/serving-only)
+        # differentiable O(T x block) memory via lax.scan — the
+        # non-Pallas long-sequence fallback (useful when T exceeds
+        # what the flash kernel's equal-block tiling accepts)
         from ..ops import chunked_attention
 
         out = chunked_attention(q, k, v, causal=True,

@@ -1,4 +1,4 @@
-"""Pallas flash attention for TPU.
+"""Pallas flash attention for TPU — forward AND backward.
 
 The hosted-workload hot op: blockwise causal attention computed entirely in
 VMEM with online softmax, so the [T, T] score matrix never touches HBM —
@@ -11,9 +11,19 @@ Layout: inputs are [BH, T, D] (batch*heads folded), grid =
 tiles so VMEM holds only one (BLOCK, D) tile of each at a time, with the
 running max/denominator/output accumulators in f32 VMEM scratch.
 
+Training: a ``jax.custom_vjp`` makes the Pallas path differentiable with
+the FlashAttention-2 backward (Dao, arXiv:2307.08691).  The forward
+additionally saves the per-row logsumexp ``L = m + log(l)`` (O(T) per
+head); the backward recomputes each block's probabilities from q, k and
+L in VMEM and runs two more blockwise kernels — dq (streaming K/V) and
+dk/dv (streaming Q/dO) — all MXU matmuls in bf16 with f32 accumulators.
+Recompute FLOPs are cheaper than round-tripping [T, T] probability
+tensors through HBM: the same TPU-first trade the chunked path makes
+(ops/chunked_attention.py), but fused in VMEM instead of lax.scan.
+
 ``flash_attention`` dispatches:
-- real TPU           -> compiled Pallas kernel;
-- tests / CPU        -> the same kernel under ``interpret=True``;
+- real TPU           -> compiled Pallas kernels (fwd + custom bwd);
+- tests / CPU        -> the same kernels under ``interpret=True``;
 - fallback           -> plain jnp reference (identical semantics).
 """
 
@@ -34,8 +44,8 @@ BLOCK_Q = 128
 BLOCK_K = 128
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                 scale: float, causal: bool):
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
+                 acc_ref, *, scale: float, causal: bool):
     """One (bh, qi, ki) program: fold K/V block ki into the running
     online-softmax state for Q block qi.
 
@@ -92,10 +102,13 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         l = l_ref[:]
         safe_l = jnp.where(l == 0, 1.0, l)
         o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        # per-row logsumexp, the only residual the backward needs
+        lse_ref[0] = (m_ref[:] + jnp.log(safe_l))[:, 0]
 
 
-def _flash_pallas(q, k, v, scale: float, causal: bool,
-                  interpret: bool):
+def _flash_fwd_pallas(q, k, v, scale: float, causal: bool,
+                      interpret: bool):
+    """Forward kernel; returns (out [BH,T,D], lse [BH,T] f32)."""
     bh, t, d = q.shape
     block = min(BLOCK_Q, t)   # equal q/k blocks keep the causal skip exact
     grid = (bh, t // block, t // block)
@@ -108,8 +121,14 @@ def _flash_pallas(q, k, v, scale: float, causal: bool,
             pl.BlockSpec((1, block, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+        ],
         scratch_shapes=[
             # 2-D (block, 1) shapes: rank-1 VMEM scratch is a Mosaic
             # lowering risk on real hardware (lane-dim layout)
@@ -121,6 +140,171 @@ def _flash_pallas(q, k, v, scale: float, causal: bool,
             dimension_semantics=("parallel", "arbitrary", "arbitrary")),
         interpret=interpret,
     )(q, k, v)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               acc_ref, *, scale: float, causal: bool):
+    """One (bh, qi, ki) program of the backward dq pass: fold key block
+    ki's contribution into dq for query block qi (FlashAttention-2
+    backward, dq = scale * sum_k ds @ k with ds = p * (dO·Vᵀ - Δ))."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+    q_offset = qi * block_q
+    k_offset = ki * block_k
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    causal_live = (k_offset <= q_offset + block_q - 1) if causal else True
+
+    @pl.when(causal_live)
+    def _accumulate():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = q_offset + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = k_offset + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        # probabilities recomputed from the saved logsumexp — masked
+        # entries give exp(NEG_INF - lse) = 0, and fully-masked rows
+        # cannot occur (the causal diagonal block is always live)
+        p = jnp.exp(s - lse_ref[0][:, None])
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None])
+        acc_ref[:] = acc_ref[:] + jnp.dot(
+            ds.astype(q.dtype), k, preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0] = (acc_ref[:] * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *,
+                scale: float, causal: bool):
+    """One (bh, ki, qi) program of the backward dk/dv pass: fold query
+    block qi's contribution into dk/dv for key block ki
+    (dv = sum_q pᵀ @ dO; dk = scale * sum_q dsᵀ @ q)."""
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+    block_k = k_ref.shape[1]
+    block_q = q_ref.shape[1]
+    q_offset = qi * block_q
+    k_offset = ki * block_k
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    causal_live = (q_offset + block_q - 1 >= k_offset) if causal else True
+
+    @pl.when(causal_live)
+    def _accumulate():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = q_offset + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = k_offset + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, None])          # [q, k] f32
+        pb = p.astype(do.dtype)
+        dv_acc[:] = dv_acc[:] + jnp.dot(
+            pb.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None])
+        dk_acc[:] = dk_acc[:] + jnp.dot(
+            ds.astype(q.dtype).T, q, preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = (dk_acc[:] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, do, lse, delta, scale: float, causal: bool,
+                      interpret: bool):
+    """Two blockwise passes; returns (dq, dk, dv) in the input dtypes."""
+    bh, t, d = q.shape
+    block = min(BLOCK_Q, t)
+    nb = t // block
+    qkv_spec_i = pl.BlockSpec((1, block, d), lambda b, i, j: (b, i, 0))
+    qkv_spec_j = pl.BlockSpec((1, block, d), lambda b, i, j: (b, j, 0))
+    row_spec_i = pl.BlockSpec((1, block), lambda b, i, j: (b, i))
+    row_spec_j = pl.BlockSpec((1, block), lambda b, i, j: (b, j))
+    params = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "arbitrary", "arbitrary"))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal),
+        grid=(bh, nb, nb),
+        in_specs=[qkv_spec_i,          # q      (resident per qi)
+                  qkv_spec_j,          # k      (streamed)
+                  qkv_spec_j,          # v      (streamed)
+                  qkv_spec_i,          # do
+                  row_spec_i,          # lse
+                  row_spec_i],         # delta
+        out_specs=qkv_spec_i,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block, d), jnp.float32)],
+        compiler_params=params,
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal),
+        grid=(bh, nb, nb),
+        in_specs=[qkv_spec_i,          # k      (resident per ki)
+                  qkv_spec_i,          # v
+                  qkv_spec_j,          # q      (streamed)
+                  qkv_spec_j,          # do     (streamed)
+                  row_spec_j,          # lse
+                  row_spec_j],         # delta
+        out_specs=[qkv_spec_i, qkv_spec_i],
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block, d), jnp.float32),
+                        pltpu.VMEM((block, d), jnp.float32)],
+        compiler_params=params,
+        interpret=interpret,
+    )(k, v, q, do, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_core(q, k, v, scale, causal, interpret):
+    out, _ = _flash_fwd_pallas(q, k, v, scale, causal, interpret)
+    return out
+
+
+def _flash_core_fwd(q, k, v, scale, causal, interpret):
+    out, lse = _flash_fwd_pallas(q, k, v, scale, causal, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_core_bwd(scale, causal, interpret, res, do):
+    q, k, v, out, lse = res
+    # Δ_i = dO_i · O_i — the softmax-jacobian row constant, cheap
+    # elementwise work XLA fuses outside the kernels
+    delta = jnp.einsum("btd,btd->bt", do.astype(jnp.float32),
+                       out.astype(jnp.float32))
+    do = do.astype(q.dtype)
+    return _flash_bwd_pallas(q, k, v, do, lse, delta, scale, causal,
+                             interpret)
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
 def _flash_reference(q, k, v, scale: float, causal: bool):
@@ -156,10 +340,9 @@ def flash_attention(q, k, v, causal: bool = True,
     t = q.shape[1]
     if backend in ("pallas", "interpret") and t % min(BLOCK_Q, t) != 0:
         backend = "ref"
-    if backend == "pallas":
-        out = _flash_pallas(q, k, v, scale, causal, interpret=False)
-    elif backend == "interpret":
-        out = _flash_pallas(q, k, v, scale, causal, interpret=True)
+    if backend in ("pallas", "interpret"):
+        # differentiable: the custom VJP runs the Pallas backward
+        out = _flash_core(q, k, v, scale, causal, backend == "interpret")
     else:
         out = _flash_reference(q, k, v, scale, causal)
 

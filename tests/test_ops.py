@@ -149,3 +149,97 @@ def test_chunked_trains_in_llama():
     loss_d = loss_fn(params, batch, dense)
     # bf16 activations: block-wise vs dense accumulation order differs
     np.testing.assert_allclose(loss, loss_d, rtol=2e-3, atol=2e-3)
+
+
+# -- Pallas backward (FlashAttention-2 custom VJP) -------------------------
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("t,d", [(128, 64), (256, 32)])
+def test_flash_gradients_match_dense_autodiff(causal, t, d):
+    """dq/dk/dv from the Pallas backward kernels must match autodiff
+    through the dense reference — the flash path trains now."""
+    key = jax.random.PRNGKey(7)
+    q, k, v = (jax.random.normal(kk, (3, t, d), jnp.float32)
+               for kk in jax.random.split(key, 3))
+
+    def loss(fn):
+        def f(q, k, v):
+            out = fn(q, k, v)
+            # non-uniform cotangent exercises delta properly
+            w = jnp.arange(out.size, dtype=jnp.float32).reshape(out.shape)
+            return jnp.sum(out * jnp.sin(w))
+        return f
+
+    ref_grads = jax.grad(loss(lambda q, k, v: flash_attention(
+        q, k, v, causal=causal, backend="ref")), argnums=(0, 1, 2))(q, k, v)
+    out_grads = jax.grad(loss(lambda q, k, v: flash_attention(
+        q, k, v, causal=causal, backend="interpret")),
+        argnums=(0, 1, 2))(q, k, v)
+    for got, want, name in zip(out_grads, ref_grads, "qkv"):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-3, atol=2e-3,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_flash_gradients_bf16_track_f32():
+    """bf16 training path: kernel grads stay within bf16 noise of the
+    f32 dense-autodiff grads (MXU dots are bf16-in/f32-accumulate)."""
+    key = jax.random.PRNGKey(11)
+    qf, kf, vf = (jax.random.normal(kk, (4, 256, 64), jnp.float32) * 0.5
+                  for kk in jax.random.split(key, 3))
+    q, k, v = (x.astype(jnp.bfloat16) for x in (qf, kf, vf))
+
+    def mean_loss(fn, *args):
+        return jax.grad(
+            lambda q, k, v: jnp.mean(fn(q, k, v).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2))(*args)
+
+    ref = mean_loss(lambda q, k, v: flash_attention(
+        q, k, v, backend="ref"), qf, kf, vf)
+    got = mean_loss(lambda q, k, v: flash_attention(
+        q, k, v, backend="interpret"), q, k, v)
+    for g, r, name in zip(got, ref, "qkv"):
+        err = np.abs(np.asarray(g, np.float32) - np.asarray(r))
+        scale = np.abs(np.asarray(r)).mean() + 1e-6
+        assert err.mean() / scale < 0.1, f"d{name} drift {err.mean()/scale}"
+
+
+def test_flash_trains_in_llama():
+    """attn_impl='flash' differentiates end-to-end through the model:
+    a train step's loss must match the dense path's loss and produce
+    finite grads of the same magnitude."""
+    from tensorfusion_tpu.models.llama import LlamaConfig, init_params
+    from tensorfusion_tpu.models.llama import forward as llama_forward
+
+    def step(cfg, params, tokens):
+        def loss_fn(p):
+            logits = llama_forward(p, tokens, cfg)
+            logits = logits.astype(jnp.float32)
+            targets = jnp.roll(tokens, -1, axis=1)
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            ll = jnp.take_along_axis(logits, targets[..., None],
+                                     axis=-1)[..., 0]
+            return jnp.mean(lse - ll)
+        return jax.value_and_grad(loss_fn)(params)
+
+    key = jax.random.PRNGKey(3)
+    tokens = jax.random.randint(key, (2, 128), 0, 256)
+    cfg_full = LlamaConfig.tiny()
+    # tiny() may use a sub-128 head_dim/seq; ensure seq = 128 works with
+    # the kernel's equal-block tiling (t=128 -> one block)
+    params = init_params(cfg_full, jax.random.PRNGKey(0))
+    loss_full, g_full = step(cfg_full, params, tokens)
+
+    import dataclasses
+    cfg_flash = dataclasses.replace(cfg_full, attn_impl="flash")
+    loss_flash, g_flash = step(cfg_flash, params, tokens)
+    np.testing.assert_allclose(float(loss_flash), float(loss_full),
+                               rtol=1e-3)
+    leaves_full = jax.tree_util.tree_leaves(g_full)
+    leaves_flash = jax.tree_util.tree_leaves(g_flash)
+    for a, b in zip(leaves_flash, leaves_full):
+        assert np.all(np.isfinite(np.asarray(a, np.float32)))
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=5e-2, atol=5e-3)
