@@ -86,14 +86,22 @@ class AlertEvaluator:
         self.rules = rules or []
         self.webhook_url = webhook_url
         self.interval_s = interval_s
-        self._pending_since: Dict[str, float] = {}
-        self.active: Dict[str, Alert] = {}
+        # both keyed structurally by (rule.name, group_tuple) — never by
+        # the rendered alert name, so a rule named "X" can never claim or
+        # resolve alerts of a different rule named "X[..." (and group tag
+        # values need no escaping to stay unambiguous)
+        self._pending_since: Dict[tuple, float] = {}
+        self.active: Dict[tuple, Alert] = {}
         self.history: List[Alert] = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def set_rules(self, rules: List[AlertRule]) -> None:
         self.rules = rules
+
+    def active_names(self) -> set:
+        """Rendered names of the currently-firing alerts."""
+        return {alert.rule for alert in self.active.values()}
 
     def start(self) -> None:
         self._stop.clear()
@@ -116,13 +124,10 @@ class AlertEvaluator:
     # ------------------------------------------------------------------
 
     def _rule_values(self, rule: AlertRule, now: float):
-        """[(alert_name, value)] for one rule — one entry for a flat
-        rule, one per distinct group_by tag combination otherwise."""
-        if not rule.group_by:
-            value = self.tsdb.aggregate(rule.measurement, rule.metric_field,
-                                        agg=rule.agg, tags=rule.tags or None,
-                                        window_s=rule.window_s)
-            return [(rule.name, value)] if value is not None else []
+        """[(state_key, alert_name, value)] for one rule — one entry for
+        a flat rule, one per distinct group_by tag combination otherwise.
+        state_key is (rule.name, group_tuple); alert_name is the
+        human-facing rendering."""
         series = self.tsdb.query(rule.measurement, rule.metric_field,
                                  tags=rule.tags or None,
                                  since=now - rule.window_s, until=now)
@@ -138,50 +143,45 @@ class AlertEvaluator:
             value = lasts[key][1] if rule.agg == "last" \
                 else aggregate_values(values, rule.agg)
             if value is not None:
-                # escape separator chars so distinct tag combinations
-                # can never collide into one alert name
+                # escape separator chars in the *rendered* name so a
+                # webhook receiver routing on it can't conflate two
+                # distinct groups (state keys are structural regardless)
                 vals = ",".join(v.replace("\\", "\\\\").replace(",", "\\,")
                                 for v in key)
-                out.append((f"{rule.name}[{vals}]", value))
+                name = rule.name if not key else f"{rule.name}[{vals}]"
+                out.append(((rule.name, key), name, value))
         return out
 
     def evaluate_once(self, now: Optional[float] = None) -> List[Alert]:
         now = now if now is not None else time.time()
         changed: List[Alert] = []
         for rule in self.rules:
-            named_values = self._rule_values(rule, now)
-            breached_names = set()
-            for name, value in named_values:
+            keyed_values = self._rule_values(rule, now)
+            breached_keys = set()
+            for key, name, value in keyed_values:
                 if not _OPS.get(rule.op, _OPS[">"])(value, rule.threshold):
                     continue
-                breached_names.add(name)
-                since = self._pending_since.setdefault(name, now)
-                if now - since >= rule.for_s and name not in self.active:
+                breached_keys.add(key)
+                since = self._pending_since.setdefault(key, now)
+                if now - since >= rule.for_s and key not in self.active:
                     alert = Alert(rule=name, severity=rule.severity,
                                   value=value, threshold=rule.threshold,
                                   state="firing", since=since,
                                   summary=rule.summary or name)
-                    self.active[name] = alert
+                    self.active[key] = alert
                     self.history.append(alert)
                     changed.append(alert)
                     log.warning("ALERT firing: %s (%.3f %s %.3f)",
                                 name, value, rule.op, rule.threshold)
             # resolution: previously-active alerts of this rule whose
             # group no longer breaches (or vanished from the window)
-            values_by_name = dict(named_values)
-
-            def owned(name: str, rule=rule) -> bool:
-                return name.startswith(f"{rule.name}[") if rule.group_by \
-                    else name == rule.name
-
-            for name in list(self.active):
-                if not owned(name):
+            values_by_key = {key: value for key, _, value in keyed_values}
+            for key in list(self.active):
+                if key[0] != rule.name or key in breached_keys:
                     continue
-                if name in breached_names:
-                    continue
-                self._pending_since.pop(name, None)
-                alert = self.active.pop(name)
-                value = values_by_name.get(name)
+                self._pending_since.pop(key, None)
+                alert = self.active.pop(key)
+                value = values_by_key.get(key)
                 resolved = Alert(rule=alert.rule, severity=alert.severity,
                                  value=value if value is not None
                                  else alert.value,
@@ -190,12 +190,12 @@ class AlertEvaluator:
                                  summary=alert.summary)
                 self.history.append(resolved)
                 changed.append(resolved)
-                log.info("alert resolved: %s", name)
+                log.info("alert resolved: %s", alert.rule)
             # drop pending state for groups that stopped breaching
             # before reaching for_s
-            for name in list(self._pending_since):
-                if owned(name) and name not in breached_names:
-                    self._pending_since.pop(name, None)
+            for key in list(self._pending_since):
+                if key[0] == rule.name and key not in breached_keys:
+                    self._pending_since.pop(key, None)
         if changed and self.webhook_url:
             self._post(changed)
         return changed

@@ -98,12 +98,21 @@ class MetricsBuffer:
             self._cond.notify_all()
             return self._seq
 
-    def since(self, since_seq: int, wait_s: float = 0.0):
+    def since(self, since_seq: int, wait_s: float = 0.0,
+              epoch: Optional[str] = None):
         """Lines with seq > since_seq; blocks up to wait_s for news.
 
         Returns (latest_seq, lines, dropped) where dropped counts lines
         that aged out of the ring before this drainer saw them.
+
+        ``epoch`` is the epoch the caller's cursor came from; when it
+        names a different buffer instance (store restart) the cursor is
+        meaningless here, so the drain restarts from seq 0 immediately
+        instead of blocking out the long-poll on a stale (possibly
+        higher-than-current) sequence number.
         """
+        if epoch is not None and epoch != self.epoch:
+            since_seq = 0
         deadline = time.monotonic() + wait_s
         with self._cond:
             while self._seq <= since_seq:
@@ -302,6 +311,8 @@ class StoreGateway:
     def _drain_metrics(self, qs) -> tuple:
         since_seq = int(qs.get("since_seq", ["0"])[0])
         wait_s = min(float(qs.get("wait_s", ["0"])[0]), MAX_WATCH_WAIT_S)
-        seq, lines, dropped = self.metrics.since(since_seq, wait_s=wait_s)
+        epoch = qs.get("epoch", [None])[0]
+        seq, lines, dropped = self.metrics.since(since_seq, wait_s=wait_s,
+                                                 epoch=epoch)
         return 200, {"seq": seq, "lines": lines, "dropped": dropped,
                      "epoch": self.metrics.epoch}

@@ -19,6 +19,7 @@ series once the operator is reachable again.
 
 from __future__ import annotations
 
+import itertools
 import logging
 import os
 import threading
@@ -33,6 +34,12 @@ log = logging.getLogger("tpf.hypervisor.metrics")
 #: max influx lines buffered while the operator is unreachable (at 5s
 #: intervals and ~10 lines/tick this is ~an hour of partition)
 PUSH_BACKLOG_LINES = 8192
+
+#: max lines per POST when draining the backlog — after a long partition
+#: the accumulated backlog must not ship as one oversized request that
+#: repeatedly trips the client timeout (push_metrics has no transport
+#: retry), which would leave the node unable to ever drain
+PUSH_CHUNK_LINES = 512
 
 
 class HypervisorMetricsRecorder:
@@ -118,7 +125,7 @@ class HypervisorMetricsRecorder:
         # buffer for the network path FIRST: a full disk must not cost
         # the (healthy) push path this tick's lines
         if self.push is not None:
-            self._backlog.extend(lines)
+            self._buffer_for_push(lines)
         if self.path:
             try:
                 with open(self.path, "a") as f:
@@ -128,19 +135,35 @@ class HypervisorMetricsRecorder:
         if self.push is not None:
             self.flush()
 
+    def _buffer_for_push(self, lines: List[str]) -> None:
+        """Append to the push backlog, warning when the bounded deque
+        evicts (a silent gap in the operator's series otherwise)."""
+        overflow = len(self._backlog) + len(lines) \
+            - (self._backlog.maxlen or 0)
+        if overflow > 0:
+            log.warning("metrics backlog full: dropping %d oldest lines "
+                        "(operator unreachable too long)",
+                        min(overflow, len(self._backlog) + len(lines)))
+        self._backlog.extend(lines)
+
     def flush(self) -> bool:
-        """Attempt to ship the backlog; returns True when drained."""
-        if self.push is None or not self._backlog:
+        """Attempt to ship the backlog; returns True when drained.
+
+        Ships in bounded chunks, popping each chunk only on success — a
+        post-partition backlog never rides one oversized request, and a
+        mid-drain failure keeps the unshipped remainder buffered."""
+        if self.push is None:
             return True
-        batch = list(self._backlog)
-        try:
-            self.push(batch)
-        except Exception as e:  # noqa: BLE001 - operator down/partition:
-            # keep buffering, the next tick retries
-            log.debug("metrics push failed (%d lines buffered): %s",
-                      len(self._backlog), e)
-            return False
-        # drop exactly what we shipped (lines appended meanwhile stay)
-        for _ in range(min(len(batch), len(self._backlog))):
-            self._backlog.popleft()
-        return not self._backlog
+        while self._backlog:
+            batch = list(itertools.islice(self._backlog, PUSH_CHUNK_LINES))
+            try:
+                self.push(batch)
+            except Exception as e:  # noqa: BLE001 - operator down/
+                # partition: keep buffering, the next tick retries
+                log.debug("metrics push failed (%d lines buffered): %s",
+                          len(self._backlog), e)
+                return False
+            # drop exactly what we shipped (lines appended meanwhile stay)
+            for _ in range(min(len(batch), len(self._backlog))):
+                self._backlog.popleft()
+        return True

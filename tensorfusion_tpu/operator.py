@@ -336,15 +336,16 @@ class Operator:
         if drain is None:
             return
         try:
-            seq, lines, dropped, epoch = drain(self._metrics_drain_seq)
+            # the gateway resets the cursor server-side when our epoch
+            # names a dead buffer instance (store restart), so the first
+            # response already carries the new epoch's lines from seq 0
+            seq, lines, dropped, epoch = drain(
+                self._metrics_drain_seq,
+                epoch=self._metrics_drain_epoch)
             if epoch and epoch != self._metrics_drain_epoch:
                 if self._metrics_drain_epoch:
-                    # store restarted: its sequence space reset, so our
-                    # cursor would silently skip the new epoch's lines —
-                    # restart from 0 and re-drain immediately
                     log.warning("metrics ring epoch changed (store "
-                                "restart); re-draining from 0")
-                    seq, lines, dropped, epoch = drain(0)
+                                "restart); cursor reset to new epoch")
                 self._metrics_drain_epoch = epoch
         except Exception as e:  # noqa: BLE001 - store hiccup; next pass
             log.debug("metrics drain failed: %s", e)

@@ -298,17 +298,22 @@ class RemoteStore:
         return int(out.get("seq", 0))
 
     def drain_metrics(self, since_seq: int = 0,
-                      wait_s: float = 0.0):
+                      wait_s: float = 0.0, epoch: str = ""):
         """Drain metrics lines pushed by remote hypervisors (the leader
         operator's feed).  Returns (latest_seq, lines, dropped, epoch):
         dropped counts lines that aged out of the gateway's ring before
         this drainer saw them (lossy by design, but observable); the
         epoch changes when the store restarts — sequence numbers are
         only comparable within one epoch, so the caller must reset its
-        cursor to 0 on an epoch change."""
+        cursor to 0 on an epoch change.  Passing the cursor's ``epoch``
+        lets the gateway detect the mismatch server-side and return the
+        new epoch's lines immediately instead of long-polling a stale
+        (possibly higher-than-current) sequence number."""
+        query = {"since_seq": str(since_seq), "wait_s": str(wait_s)}
+        if epoch:
+            query["epoch"] = epoch
         out = self._request("GET", "/api/v1/store/metrics",
-                            query={"since_seq": str(since_seq),
-                                   "wait_s": str(wait_s)}, max_tries=1)
+                            query=query, max_tries=1)
         return (int(out.get("seq", since_seq)), out.get("lines", []),
                 int(out.get("dropped", 0)), str(out.get("epoch", "")))
 

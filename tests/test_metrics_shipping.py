@@ -67,6 +67,63 @@ def test_metrics_buffer_longpoll_wakes_on_push():
     assert got["out"] == (1, ["late"], 0)
 
 
+def test_metrics_buffer_epoch_mismatch_returns_immediately():
+    """A cursor from a previous buffer epoch (store restart) must not
+    block out the long-poll on its stale — possibly higher-than-current —
+    sequence number: the drain restarts from 0 immediately."""
+    buf = MetricsBuffer()
+    buf.push(["x", "y"])
+    t0 = time.monotonic()
+    latest, lines, dropped = buf.since(900, wait_s=5.0, epoch="stale-epoch")
+    assert time.monotonic() - t0 < 1.0
+    assert (latest, lines, dropped) == (2, ["x", "y"], 0)
+    # matching epoch keeps normal cursor semantics
+    assert buf.since(1, epoch=buf.epoch) == (2, ["y"], 0)
+
+
+def test_backlog_flush_ships_in_chunks_and_warns_on_overflow(caplog):
+    """A post-partition backlog ships in bounded chunks (each popped on
+    success) instead of one oversized POST, and deque overflow logs a
+    warning instead of silently discarding."""
+    import logging as _logging
+
+    from tensorfusion_tpu.hypervisor import metrics as hvm
+
+    batches = []
+    rec = hvm.HypervisorMetricsRecorder(
+        devices=None, workers=None, push=batches.append)
+    rec._backlog.extend(f"l{i}" for i in range(hvm.PUSH_CHUNK_LINES + 40))
+    assert rec.flush()
+    assert [len(b) for b in batches] == [hvm.PUSH_CHUNK_LINES, 40]
+    assert not rec._backlog
+
+    # a chunk failing mid-drain keeps the unshipped remainder buffered
+    calls = {"n": 0}
+
+    def flaky(batch):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise OSError("operator gone")
+
+    rec2 = hvm.HypervisorMetricsRecorder(
+        devices=None, workers=None, push=flaky)
+    rec2._backlog.extend(f"l{i}" for i in range(hvm.PUSH_CHUNK_LINES + 40))
+    assert not rec2.flush()
+    assert len(rec2._backlog) == 40
+
+    # backlog eviction logs a warning instead of silently discarding
+    import collections
+    small = hvm.HypervisorMetricsRecorder(
+        devices=None, workers=None, push=lambda b: None)
+    small._backlog = collections.deque(maxlen=4)
+    with caplog.at_level(_logging.WARNING, logger="tpf.hypervisor.metrics"):
+        small._buffer_for_push(["a", "b", "c"])
+        assert not caplog.records          # fits, no warning
+        small._buffer_for_push(["d", "e", "f"])
+    assert any("backlog full" in r.message for r in caplog.records)
+    assert list(small._backlog) == ["c", "d", "e", "f"]
+
+
 # -- gateway routes -------------------------------------------------------
 
 def test_gateway_metrics_routes_and_sink():

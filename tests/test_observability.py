@@ -199,7 +199,7 @@ def test_alert_evaluator_fire_and_resolve_with_webhook():
         db.insert("tpf_pool", {"pool": "p"}, {"utilization": 0.95})
         changed = ev.evaluate_once()
         assert len(changed) == 1 and changed[0].state == "firing"
-        assert "pool-hot" in ev.active
+        assert "pool-hot" in ev.active_names()
         # duplicate evaluation: no re-fire
         assert ev.evaluate_once() == []
 
@@ -362,9 +362,52 @@ def test_grouped_alert_rule_fires_per_tag_combination():
     db.insert("m", {"ns": "b"}, {"v": 95.0}, ts=t0 + 70)
     changed = ev.evaluate_once(now=t0 + 75)
     assert [(a.rule, a.state) for a in changed] == [("hot[a]", "resolved")]
-    assert set(ev.active) == {"hot[b]"}
+    assert ev.active_names() == {"hot[b]"}
 
     # a group that vanishes from the window entirely also resolves
     changed = ev.evaluate_once(now=t0 + 500)
     assert [(a.rule, a.state) for a in changed] == [("hot[b]", "resolved")]
     assert not ev.active
+
+
+def test_alert_ownership_is_structural_not_name_prefix():
+    """A grouped rule 'hot' must never claim/resolve alerts of a distinct
+    rule whose literal name happens to start with 'hot[' — ownership is
+    tracked by (rule, group) keys, not by parsing rendered names."""
+    from tensorfusion_tpu.alert import AlertEvaluator, AlertRule
+
+    db = TSDB()
+    ev = AlertEvaluator(db, rules=[
+        AlertRule(name="hot", measurement="m", metric_field="v",
+                  agg="last", op=">", threshold=50.0, window_s=60.0,
+                  group_by=["ns"]),
+        AlertRule(name="hot[b]", measurement="other", metric_field="v",
+                  agg="last", op=">", threshold=0.0, window_s=60.0),
+    ])
+    t0 = time.time()
+    db.insert("other", {}, {"v": 1.0}, ts=t0)      # flat rule breaches
+    changed = ev.evaluate_once(now=t0 + 1)
+    assert [(a.rule, a.state) for a in changed] == [("hot[b]", "firing")]
+    # grouped rule 'hot' has no breaching groups; before the fix its
+    # resolution pass would string-match and resolve the flat alert
+    changed = ev.evaluate_once(now=t0 + 2)
+    assert changed == []
+    assert ev.active_names() == {"hot[b]"}
+
+
+def test_flat_rule_honors_evaluation_time():
+    """The flat-rule path windows on the caller's `now`, consistent with
+    the group_by path (not wall-clock time.time())."""
+    from tensorfusion_tpu.alert import AlertEvaluator, AlertRule
+
+    db = TSDB()
+    ev = AlertEvaluator(db, rules=[AlertRule(
+        name="old-hot", measurement="m", metric_field="v", agg="max",
+        op=">", threshold=50.0, window_s=60.0)])
+    t0 = time.time() - 600          # well outside the real-time window
+    db.insert("m", {}, {"v": 90.0}, ts=t0)
+    changed = ev.evaluate_once(now=t0 + 10)
+    assert [(a.rule, a.state) for a in changed] == [("old-hot", "firing")]
+    # and outside the simulated window it does not fire
+    ev2 = AlertEvaluator(db, rules=ev.rules)
+    assert ev2.evaluate_once(now=t0 + 500) == []
