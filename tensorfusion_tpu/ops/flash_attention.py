@@ -24,12 +24,15 @@ tensors through HBM: the same TPU-first trade the chunked path makes
 ``flash_attention`` dispatches:
 - real TPU           -> compiled Pallas kernels (fwd + custom bwd);
 - tests / CPU        -> the same kernels under ``interpret=True``;
-- fallback           -> plain jnp reference (identical semantics).
+- ragged T           -> chunked blockwise path (pads internally; warns
+                        once — still O(block²) memory, never dense);
+- backend="ref"      -> plain jnp reference (identical semantics).
 """
 
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Optional
 
 import jax
@@ -38,10 +41,16 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+log = logging.getLogger("tpf.ops.flash")
+
 NEG_INF = -1e30
 
 BLOCK_Q = 128
 BLOCK_K = 128
+
+#: warn-once latch for the ragged-T reroute (a training loop calls the
+#: dispatcher every step; one log line is signal, thousands are noise)
+_warned_ragged = False
 
 
 def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
@@ -326,20 +335,36 @@ def flash_attention(q, k, v, causal: bool = True,
     input layout.  backend: None (auto) | "pallas" | "interpret" | "ref"."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    if backend is None:
+        platform = jax.devices()[0].platform
+        backend = "pallas" if platform == "tpu" else "ref"
+    # The kernel needs t to tile evenly into equal q/k blocks; other
+    # lengths route to the chunked path (ops/chunked_attention.py) at the
+    # same 128-row block size, which pads internally and keeps flash
+    # memory behavior — NEVER silently to the dense reference, which
+    # would materialize [T, T] in HBM.
+    t = q.shape[-2]
+    if backend in ("pallas", "interpret") and t % min(BLOCK_Q, t) != 0:
+        global _warned_ragged
+        if not _warned_ragged:
+            _warned_ragged = True
+            log.warning(
+                "flash_attention: T=%d does not tile into %d-row blocks; "
+                "routing to the chunked blockwise path (pads internally). "
+                "Pad sequences to a multiple of %d to use the Pallas "
+                "kernels directly.", t, min(BLOCK_Q, t), BLOCK_Q)
+        from .chunked_attention import chunked_attention
+        if q.ndim == 4:
+            return chunked_attention(q, k, v, causal=causal, scale=scale,
+                                     block=BLOCK_Q)
+        return chunked_attention(q[:, None], k[:, None], v[:, None],
+                                 causal=causal, scale=scale,
+                                 block=BLOCK_Q)[:, 0]
+
     squeeze = q.ndim == 4
     if squeeze:
         b, h, t, d = q.shape
         q, k, v = (x.reshape(b * h, t, d) for x in (q, k, v))
-
-    if backend is None:
-        platform = jax.devices()[0].platform
-        backend = "pallas" if platform == "tpu" else "ref"
-    # The kernel needs t to tile evenly into equal q/k blocks; for other
-    # lengths use the jnp reference (identical semantics) instead of
-    # failing — documented fallback behavior.
-    t = q.shape[1]
-    if backend in ("pallas", "interpret") and t % min(BLOCK_Q, t) != 0:
-        backend = "ref"
     if backend in ("pallas", "interpret"):
         # differentiable: the custom VJP runs the Pallas backward
         out = _flash_core(q, k, v, scale, causal, backend == "interpret")

@@ -70,16 +70,42 @@ def test_ring_bf16_at_scale_tracks_f32_reference():
 
 
 @pytest.mark.parametrize("t", [130, 192])
-def test_flash_ragged_sequence_falls_back(t):
-    """Sequence lengths that don't tile into the 128 block must silently use
-    the jnp reference (identical semantics), not fail."""
+def test_flash_ragged_sequence_routes_to_chunked(t):
+    """Sequence lengths that don't tile into the 128 block route to the
+    chunked blockwise path (identical values, still O(block^2) memory) —
+    never silently to the dense reference — and warn exactly once."""
+    import logging
+
+    import importlib
+
+    # the package re-exports the function under the same name, shadowing
+    # the submodule attribute — resolve the actual module
+    fa_mod = importlib.import_module("tensorfusion_tpu.ops.flash_attention")
+
     key = jax.random.PRNGKey(2)
     q, k, v = (jax.random.normal(kk, (2, t, 32), jnp.float32)
                for kk in jax.random.split(key, 3))
     ref = flash_attention(q, k, v, backend="ref")
-    out = flash_attention(q, k, v, backend="interpret")
+    fa_mod._warned_ragged = False
+    records = []
+    handler = logging.Handler()
+    handler.emit = records.append
+    fa_mod.log.addHandler(handler)
+    try:
+        out = flash_attention(q, k, v, backend="interpret")
+        flash_attention(q, k, v, backend="interpret")   # no second warning
+    finally:
+        fa_mod.log.removeHandler(handler)
+    assert len(records) == 1 and "chunked" in records[0].getMessage()
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-5)
+    # the reroute stays differentiable (chunked custom VJP)
+    g = jax.grad(lambda q: flash_attention(q, k, v,
+                                           backend="interpret").sum())(q)
+    gref = jax.grad(lambda q: flash_attention(q, k, v,
+                                              backend="ref").sum())(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gref),
+                               rtol=2e-4, atol=2e-4)
 
 
 # -- chunked attention (ops/chunked_attention.py) ---------------------------
