@@ -346,6 +346,28 @@ bool attach_limiter() {
 
 const PJRT_Api* load_real() {
   const char* path = getenv("TPF_REAL_PJRT_PLUGIN");
+  char remote_path[4096];
+  if ((path == nullptr || path[0] == '\0') &&
+      getenv("TPF_REMOTE_WORKER_URL") != nullptr) {
+    /* Remote backend: with no local vendor plugin but a worker URL set,
+     * delegate to libtpf_pjrt_remote.so (same directory as this .so) —
+     * the metering interposers then charge remote launches against the
+     * local shm token bucket exactly like local ones. */
+    Dl_info info;
+    if (dladdr((void*)&load_real, &info) != 0 &&
+        info.dli_fname != nullptr) {
+      strncpy(remote_path, info.dli_fname, sizeof(remote_path) - 1);
+      remote_path[sizeof(remote_path) - 1] = '\0';
+      char* slash = strrchr(remote_path, '/');
+      if (slash != nullptr) {
+        snprintf(slash + 1,
+                 sizeof(remote_path) - (slash + 1 - remote_path),
+                 "libtpf_pjrt_remote.so");
+        path = remote_path;
+        logmsg("delegating to the remote-vTPU backend");
+      }
+    }
+  }
   if (path == nullptr || path[0] == '\0') {
     fprintf(stderr, "[tpf_pjrt_proxy] TPF_REAL_PJRT_PLUGIN is not set\n");
     return nullptr;

@@ -1,0 +1,45 @@
+"""Standalone remote-vTPU worker daemon.
+
+Runs on the TPU host (the role of the reference's remote-worker image,
+``vendors.go:118-130``); serves COMPILE/COMPILE_MLIR/EXECUTE over TCP for
+both the cooperative client (``remoting/client.py``) and the transparent
+PJRT plugin (``native/pjrt_remote/pjrt_remote.cc``).
+
+    python -m tensorfusion_tpu.remoting --port 7707 [--token SECRET]
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import threading
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(
+        description="tpu-fusion remote-vTPU worker")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=7707)
+    parser.add_argument("--token", default=None,
+                        help="auth token (default: $TPF_REMOTING_TOKEN)")
+    parser.add_argument("--max-resident-gb", type=float, default=0.0,
+                        help="resident-buffer budget (0 = unlimited)")
+    args = parser.parse_args()
+
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s")
+    from .worker import RemoteVTPUWorker
+
+    worker = RemoteVTPUWorker(
+        host=args.host, port=args.port, token=args.token,
+        max_resident_bytes=int(args.max_resident_gb * (1 << 30)))
+    worker.start()
+    print(f"tpf remote worker ready on {worker.url}", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        worker.stop()
+
+
+if __name__ == "__main__":
+    main()

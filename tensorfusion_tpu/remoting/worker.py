@@ -64,9 +64,16 @@ class RemoteVTPUWorker:
         self._exe_cache: Dict[str, object] = {}
         self._exe_blobs: Dict[str, bytes] = {}   # for snapshot persistence
         self._exe_costs: Dict[str, int] = {}
+        #: raw-StableHLO executables (the transparent PJRT-plugin path:
+        #: libtpf_pjrt_remote.so forwards PJRT_Client_Compile's MLIR here,
+        #: bypassing jax.export entirely) — exe_id -> LoadedExecutable
+        self._mlir_exes: Dict[str, object] = {}
+        #: exe_id -> [([dims...], dtype_name), ...] flat result signature
+        self._exe_sigs: Dict[str, list] = {}
         self._buffers: Dict[str, object] = {}    # device-resident arrays
         self._buf_seq = 0
         self._lock = threading.Lock()
+        self._compile_lock = threading.Lock()
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -284,11 +291,80 @@ class RemoteVTPUWorker:
                           "rb") as f:
                     blob = f.read()
                 self._exe_blobs[exe_id] = blob
-                self._exe_cache[exe_id] = jax.jit(
-                    jax.export.deserialize(bytearray(blob)).call)
-                self._exe_costs[exe_id] = int(info.get("mflops", 1))
+                if exe_id.startswith("m-"):    # raw-StableHLO (PJRT path)
+                    exe, sig, mflops = self._compile_mlir(blob)
+                    self._mlir_exes[exe_id] = exe
+                    self._exe_sigs[exe_id] = sig
+                    self._exe_costs[exe_id] = int(info.get("mflops",
+                                                           mflops))
+                else:
+                    self._exe_cache[exe_id] = jax.jit(
+                        jax.export.deserialize(bytearray(blob)).call)
+                    self._exe_costs[exe_id] = int(info.get("mflops", 1))
         return {"buffers": len(manifest["buffers"]),
                 "executables": len(manifest["executables"])}
+
+    # -- raw-StableHLO compilation (transparent PJRT path) --------------
+
+    @staticmethod
+    def _mlir_result_signature(blob: bytes) -> list:
+        """Flat [@main result] signature as [([dims], wire_dtype), ...].
+
+        The PJRT client sizes its per-device output lists from
+        NumOutputs/OutputElementTypes *before* executing, so the worker
+        must answer from the module signature alone."""
+        from jax._src.interpreters import mlir as jmlir
+        from jax._src.lib.mlir import ir
+
+        etypes = {"f32": "float32", "f64": "float64", "f16": "float16",
+                  "bf16": "bfloat16", "i1": "bool", "i8": "int8",
+                  "i16": "int16", "i32": "int32", "i64": "int64",
+                  "ui8": "uint8", "ui16": "uint16", "ui32": "uint32",
+                  "ui64": "uint64"}
+        with jmlir.make_ir_context() as ctx:
+            if blob[:4] == b"ML\xefR" and b"StableHLO" in blob[:32]:
+                # PJRT clients ship *versioned* StableHLO (a VHLO
+                # portable artifact whose ops are vhlo.func_v1 etc.);
+                # upgrade to plain stablehlo/func before walking it
+                from jaxlib.mlir.dialects import stablehlo
+                mod = stablehlo.deserialize_portable_artifact(ctx, blob)
+            else:
+                mod = ir.Module.parse(blob)
+            for op in mod.body.operations:
+                if op.operation.name != "func.func":
+                    continue
+                if ir.StringAttr(op.attributes["sym_name"]).value != "main":
+                    continue
+                ftype = ir.FunctionType(
+                    ir.TypeAttr(op.attributes["function_type"]).value)
+                sig = []
+                for r in ftype.results:
+                    rt = ir.RankedTensorType(r)
+                    et = str(rt.element_type)
+                    if et not in etypes:
+                        raise ValueError(
+                            f"unsupported result element type {et}")
+                    sig.append((list(rt.shape), etypes[et]))
+                return sig
+        raise ValueError("module has no @main function")
+
+    def _compile_mlir(self, blob: bytes):
+        """Compile raw StableHLO for this worker's chip; returns
+        (LoadedExecutable, signature, mflops)."""
+        import jax
+        from jax._src.lib import _jax
+
+        sig = self._mlir_result_signature(blob)
+        backend = jax.devices()[0].client
+        exe = backend.compile_and_load(
+            blob, _jax.DeviceList((jax.devices()[0],)),
+            _jax.CompileOptions())
+        try:
+            mflops = max(int((exe.cost_analysis() or {})
+                             .get("flops", 0) / 1e6), 1)
+        except Exception:  # noqa: BLE001 - cost is advisory
+            mflops = 1
+        return exe, sig, mflops
 
     # ------------------------------------------------------------------
 
@@ -301,8 +377,39 @@ class RemoteVTPUWorker:
                 "platform": dev.platform,
                 "device_kind": getattr(dev, "device_kind", ""),
                 "n_devices": len(jax.devices()),
-                "cached_executables": len(self._exe_cache),
+                "cached_executables": len(self._exe_cache)
+                                      + len(self._mlir_exes),
                 "resident_bytes": self.resident_bytes}, [])
+        elif kind == "COMPILE_MLIR":
+            # Transparent-PJRT path: the client ships its jit lowering's
+            # raw StableHLO (text or bytecode) exactly as PJRT_Client_
+            # Compile received it — no jax.export framing, no client-side
+            # cooperation beyond pointing plugin discovery at
+            # libtpf_pjrt_remote.so.  The reply carries the flat result
+            # signature (parsed from @main) because the PJRT caller sizes
+            # its output-buffer lists before any execution.
+            blob = buffers[0].tobytes() if buffers else b""
+            exe_id = "m-" + hashlib.sha256(blob).hexdigest()[:30]
+            # single-flight per module: the compile itself runs outside
+            # self._lock (seconds of XLA work must not stall EXECUTEs on
+            # other connections) but under _compile_lock so two clients
+            # shipping the same module don't both pay for it
+            with self._compile_lock:
+                with self._lock:
+                    sig = self._exe_sigs.get(exe_id)
+                    mflops = self._exe_costs.get(exe_id, 1)
+                if sig is None:
+                    exe, sig, mflops = self._compile_mlir(blob)
+                    with self._lock:
+                        self._mlir_exes[exe_id] = exe
+                        self._exe_blobs[exe_id] = blob
+                        self._exe_costs[exe_id] = mflops
+                        self._exe_sigs[exe_id] = sig
+            reply("COMPILE_OK", {"exe_id": exe_id,
+                                 "num_outputs": len(sig),
+                                 "out_shapes": [s for s, _ in sig],
+                                 "out_dtypes": [d for _, d in sig],
+                                 "mflops": mflops}, [])
         elif kind == "COMPILE":
             blob = buffers[0].tobytes() if buffers else b""
             exe_id = hashlib.sha256(blob).hexdigest()[:32]
@@ -349,8 +456,9 @@ class RemoteVTPUWorker:
             exe_id = meta["exe_id"]
             with self._lock:
                 exported = self._exe_cache.get(exe_id)
+                mlir_exe = self._mlir_exes.get(exe_id)
                 mflops = self._exe_costs.get(exe_id, 1)
-            if exported is None:
+            if exported is None and mlir_exe is None:
                 reply("ERROR", {"error": f"unknown executable {exe_id}",
                                 "code": "needs_compile"}, [])
                 return
@@ -376,8 +484,17 @@ class RemoteVTPUWorker:
                                       [])
                                 return
                             args.append(arr)
-            out = exported(*args)
-            leaves = jax.tree_util.tree_leaves(out)
+            if mlir_exe is not None:
+                # PJRT path: flat positional buffers in, flat buffers out
+                dev = jax.devices()[0]
+                dev_args = [a if hasattr(a, "devices")
+                            else dev.client.buffer_from_pyval(
+                                np.ascontiguousarray(a), dev)
+                            for a in args]
+                leaves = mlir_exe.execute(dev_args)
+            else:
+                out = exported(*args)
+                leaves = jax.tree_util.tree_leaves(out)
             self.executions += 1
             if meta.get("keep_results"):
                 # park results device-side, hand back references

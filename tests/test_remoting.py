@@ -182,8 +182,14 @@ def test_preauth_framing_is_bounded():
                   struct.pack("<II", protocol.VERSION,
                               protocol.MAX_HEADER_BYTES + 1))
         s.sendall(b"x" * 64)
-        s.shutdown(socket.SHUT_WR)
-        assert s.recv(1) == b""   # peer closed, no reply
+        try:
+            s.shutdown(socket.SHUT_WR)
+        except OSError:
+            pass   # worker already RST us — dropping fast is the point
+        try:
+            assert s.recv(1) == b""   # peer closed, no reply
+        except ConnectionResetError:
+            pass
         s.close()
 
         # zlib bomb: tiny wire bytes declaring a huge raw size is capped
@@ -204,8 +210,14 @@ def test_preauth_framing_is_bounded():
             s.sendall(protocol.MAGIC +
                       struct.pack("<II", protocol.VERSION, len(blob)) +
                       blob + bomb)
-            s.shutdown(socket.SHUT_WR)
-            assert s.recv(1) == b""
+            try:
+                s.shutdown(socket.SHUT_WR)
+            except OSError:
+                pass
+            try:
+                assert s.recv(1) == b""
+            except ConnectionResetError:
+                pass
             s.close()
 
         # sender-side cap: an oversized tensor fails fast with a clear
@@ -327,3 +339,137 @@ def test_remote_resident_buffers(worker):
     with pytest.raises(RemoteExecutionError, match="unknown buffer"):
         remote(w_ref, x)
     dev.close()
+
+
+# -- transparent remote vTPU at the PJRT boundary ------------------------
+#
+# The reference capability these cover: GPU-over-IP that is invisible to
+# the client app (closed worker/client images, providerconfig_types.go:
+# 117-130).  libtpf_pjrt_remote.so implements the PJRT C API over the
+# remoting protocol, so an UNMODIFIED jax process — env vars only, no
+# code changes — computes on the remote worker.
+
+TRANSPARENT_PROG = """
+import json
+import jax, jax.numpy as jnp
+
+def loss_fn(p, x, t):
+    h = jnp.tanh(x @ p['w1'])
+    return (((h @ p['w2']) - t) ** 2).mean()
+
+@jax.jit
+def step(p, x, t):
+    l, g = jax.value_and_grad(loss_fn)(p, x, t)
+    return l, jax.tree.map(lambda w, gw: w - 0.1 * gw, p, g)
+
+key = jax.random.PRNGKey(0)
+k1, k2 = jax.random.split(key)
+p = {'w1': jax.random.normal(k1, (16, 32)) * 0.1,
+     'w2': jax.random.normal(k2, (32, 4)) * 0.1}
+x = jax.random.normal(key, (64, 16))
+t = jax.random.normal(key, (64, 4))
+losses = []
+for _ in range(5):
+    l, p = step(p, x, t)
+    losses.append(float(l))
+dev = jax.devices()[0]
+print("JSON" + json.dumps({
+    "losses": losses, "platform": dev.platform,
+    "n_devices": len(jax.devices())}))
+"""
+
+
+def _run_client(env_overrides, prog=TRANSPARENT_PROG, timeout=240):
+    import json
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("XLA_FLAGS", None)          # no 8-device CPU mesh in clients
+    env.update(env_overrides)
+    r = subprocess.run([sys.executable, "-c", prog], env=env,
+                       capture_output=True, text=True, timeout=timeout)
+    lines = [ln for ln in r.stdout.splitlines() if ln.startswith("JSON")]
+    assert lines, f"client failed:\n{r.stdout}\n{r.stderr[-3000:]}"
+    return json.loads(lines[0][4:])
+
+
+def _plugin_path(name):
+    import pathlib
+
+    so = (pathlib.Path(__file__).resolve().parent.parent / "native"
+          / "build" / name)
+    if not so.exists():
+        pytest.skip(f"{name} not built (PJRT headers unavailable)")
+    return str(so)
+
+
+def test_transparent_pjrt_plugin_runs_unmodified_jax(worker):
+    """An unmodified jax program (env vars only) trains a 2-layer MLP on
+    the remote worker through libtpf_pjrt_remote.so, and its 5-step loss
+    trajectory matches the same program run locally."""
+    so = _plugin_path("libtpf_pjrt_remote.so")
+    local = _run_client({"JAX_PLATFORMS": "cpu"})
+    remote = _run_client({
+        "JAX_PLATFORMS": "tpfr",
+        "PJRT_NAMES_AND_LIBRARY_PATHS": f"tpfr:{so}",
+        "TPF_REMOTE_WORKER_URL": f"tcp://127.0.0.1:{worker.port}",
+    })
+    assert remote["platform"] == "tpfr" and remote["n_devices"] == 1
+    np.testing.assert_allclose(local["losses"], remote["losses"],
+                               rtol=1e-5)
+    assert worker.executions >= 5
+
+
+def test_transparent_pjrt_proxy_stacks_on_remote(worker):
+    """The metering proxy auto-loads the remote backend when
+    TPF_REMOTE_WORKER_URL is set with no local vendor plugin — the full
+    interception chain (client -> proxy -> remote worker) still computes
+    correctly (pass-through: no shm attached here)."""
+    _plugin_path("libtpf_pjrt_remote.so")
+    so_proxy = _plugin_path("libtpf_pjrt_proxy.so")
+    local = _run_client({"JAX_PLATFORMS": "cpu"})
+    remote = _run_client({
+        "JAX_PLATFORMS": "tpfr",
+        "PJRT_NAMES_AND_LIBRARY_PATHS": f"tpfr:{so_proxy}",
+        "TPF_REMOTE_WORKER_URL": f"tcp://127.0.0.1:{worker.port}",
+    })
+    np.testing.assert_allclose(local["losses"], remote["losses"],
+                               rtol=1e-5)
+
+
+def test_transparent_pjrt_requires_token_when_worker_is_authed():
+    """The PJRT path rides the HELLO auth handshake: a client without the
+    worker's token is refused at client creation."""
+    so = _plugin_path("libtpf_pjrt_remote.so")
+    import subprocess
+    import sys
+    import os
+
+    target = RemoteVTPUWorker(token="sesame")
+    target.start()
+    try:
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.update({
+            "JAX_PLATFORMS": "tpfr",
+            "PJRT_NAMES_AND_LIBRARY_PATHS": f"tpfr:{so}",
+            "TPF_REMOTE_WORKER_URL": f"tcp://127.0.0.1:{target.port}",
+            "TPF_REMOTING_TOKEN": "wrong",
+        })
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            env=env, capture_output=True, text=True, timeout=240)
+        assert r.returncode != 0
+        assert "bad token" in (r.stdout + r.stderr)
+        # with the right token the same client comes up
+        env["TPF_REMOTING_TOKEN"] = "sesame"
+        r2 = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print('NDEV', len(jax.devices()))"],
+            env=env, capture_output=True, text=True, timeout=240)
+        assert "NDEV 1" in r2.stdout, r2.stderr[-2000:]
+    finally:
+        target.stop()
