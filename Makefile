@@ -9,7 +9,8 @@ PY := env -u PALLAS_AXON_POOL_IPS python
 
 .PHONY: all native test test-native verify-all check-coverage asan \
 	tsan bench bench-tpu test-tpu-live sched-bench webhook-bench remoting-bench \
-	multitenant-bench multitenant-bench-tpu serving-bench-tpu dryrun clean
+	multitenant-bench multitenant-bench-tpu serving-bench-tpu \
+	refresh-tpu-artifacts dryrun clean
 
 all: native
 
@@ -85,6 +86,24 @@ webhook-bench:
 remoting-bench:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 		python benchmarks/remoting_bench.py
+
+# One-shot hardware revalidation (VERDICT r4 #2): the moment the TPU
+# tunnel is alive, re-run every chip benchmark + the live test suite and
+# re-stamp the commit into every artifact, so benchmarks/results/*_tpu
+# records are always at-HEAD evidence rather than stale captures.
+# Order: live tests first (a broken kernel should fail fast, before an
+# hour of benching), then the three hardware benches.
+refresh-tpu-artifacts: native
+	TPF_TPU_LIVE=1 python -m pytest tests/test_tpu_live.py -x -q
+	python bench.py
+	python benchmarks/serving_tpu.py
+	python benchmarks/multitenant_tpu.py
+	@echo "--- artifact commits (want: all at $$(git rev-parse --short HEAD)) ---"
+	@for f in benchmarks/results/bench_tpu.json \
+		benchmarks/results/serving_tpu.json \
+		benchmarks/results/multitenant_tpu.json; do \
+		echo "$$f: $$(python3 -c "import json;print(json.load(open('$$f')).get('commit','?'))" 2>/dev/null)"; \
+	done
 
 dryrun:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \

@@ -35,18 +35,31 @@ def _spawn_worker():
     """Worker subprocess on an OS-assigned port; returns (proc, port).
     Parsing the SERVING line (instead of hardcoding a port) means a
     stale worker or parallel bench can never collide, and a failed bind
-    surfaces the child's stderr instead of an opaque assert."""
+    surfaces the child's stderr instead of an opaque assert.
+
+    stderr is drained continuously by a daemon thread (keeping only a
+    tail for diagnostics): a PIPE nobody reads would fill the OS buffer
+    and block the worker mid-request once it logs enough."""
+    import collections
     import subprocess
+    import threading
 
     proc = subprocess.Popen(
         [sys.executable, __file__, "--serve", "0"],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    err_tail = collections.deque(maxlen=64)
+
+    def _drain():
+        for line in proc.stderr:
+            err_tail.append(line)
+
+    threading.Thread(target=_drain, daemon=True).start()
     line = proc.stdout.readline()
     if not line.startswith("SERVING"):
-        err = proc.stderr.read()
         proc.terminate()
+        proc.wait(timeout=10)
         raise RuntimeError(f"bench worker failed to start: {line!r}\n"
-                           f"{err[-2000:]}")
+                           + "".join(err_tail)[-2000:])
     return proc, int(line.split()[1])
 
 

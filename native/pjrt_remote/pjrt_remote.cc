@@ -563,9 +563,19 @@ struct TpfBuffer {
   std::vector<int64_t> dims;
   const DtypeInfo* dtype;
   bool deleted = false;
-  /* dense row-major strides, built lazily for GetMemoryLayout (the
-   * returned pointers must live as long as the buffer) */
+  /* dense row-major strides for GetMemoryLayout — built ONCE at
+   * creation (returned pointers must live as long as the buffer, and
+   * PJRT entry points run on arbitrary threads, so no lazy mutation) */
   std::vector<int64_t> strides_cache;
+
+  void finalize_strides() {
+    strides_cache.assign(dims.size(), 0);
+    int64_t acc = (int64_t)dtype->itemsize;
+    for (size_t i = dims.size(); i-- > 0;) {
+      strides_cache[i] = acc;
+      acc *= dims[i];
+    }
+  }
 
   size_t nbytes() const {
     size_t n = dtype->itemsize;
@@ -1193,6 +1203,7 @@ PJRT_Error* tpf_LoadedExecutable_Execute(
       /* dtype strings come from jax arrays worker-side ("bfloat16",
        * "float32", ...) and match the wire names */
       buf->dtype = info != nullptr ? info : exe->out_dtypes[o];
+      buf->finalize_strides();
       args->output_lists[0][o] = reinterpret_cast<PJRT_Buffer*>(buf);
     }
   }
@@ -1256,6 +1267,7 @@ PJRT_Error* tpf_Client_BufferFromHostBuffer(
   buf->buf_id = rmeta.at("buf_id").str;
   buf->dims.assign(args->dims, args->dims + args->num_dims);
   buf->dtype = info;
+  buf->finalize_strides();
   args->buffer = reinterpret_cast<PJRT_Buffer*>(buf);
   args->done_with_host_buffer = make_ready_event();
   return nullptr;
@@ -1377,14 +1389,6 @@ PJRT_Error* tpf_Buffer_GetMemoryLayout(
   memset(&args->layout, 0, sizeof(args->layout));
   args->layout.struct_size = PJRT_Buffer_MemoryLayout_STRUCT_SIZE;
   args->layout.type = PJRT_Buffer_MemoryLayout_Type_Strides;
-  if (buf->strides_cache.size() != buf->dims.size()) {
-    buf->strides_cache.assign(buf->dims.size(), 0);
-    int64_t acc = (int64_t)buf->dtype->itemsize;
-    for (size_t i = buf->dims.size(); i-- > 0;) {
-      buf->strides_cache[i] = acc;
-      acc *= buf->dims[i];
-    }
-  }
   args->layout.strides.byte_strides = buf->strides_cache.data();
   args->layout.strides.num_byte_strides = buf->strides_cache.size();
   return nullptr;

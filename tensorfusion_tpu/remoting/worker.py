@@ -73,7 +73,8 @@ class RemoteVTPUWorker:
         self._buffers: Dict[str, object] = {}    # device-resident arrays
         self._buf_seq = 0
         self._lock = threading.Lock()
-        self._compile_lock = threading.Lock()
+        #: per-exe_id in-flight compile locks (COMPILE_MLIR single-flight)
+        self._compile_flights: Dict[str, threading.Lock] = {}
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -390,21 +391,31 @@ class RemoteVTPUWorker:
             # its output-buffer lists before any execution.
             blob = buffers[0].tobytes() if buffers else b""
             exe_id = "m-" + hashlib.sha256(blob).hexdigest()[:30]
-            # single-flight per module: the compile itself runs outside
+            # single-flight PER MODULE: the compile runs outside
             # self._lock (seconds of XLA work must not stall EXECUTEs on
-            # other connections) but under _compile_lock so two clients
-            # shipping the same module don't both pay for it
-            with self._compile_lock:
+            # other connections) under a per-exe_id flight lock, so two
+            # clients shipping the same module don't both pay for it —
+            # and a cache hit (or a different module) never waits behind
+            # an unrelated compile
+            with self._lock:
+                sig = self._exe_sigs.get(exe_id)
+                mflops = self._exe_costs.get(exe_id, 1)
+            if sig is None:
                 with self._lock:
-                    sig = self._exe_sigs.get(exe_id)
-                    mflops = self._exe_costs.get(exe_id, 1)
-                if sig is None:
-                    exe, sig, mflops = self._compile_mlir(blob)
+                    flight = self._compile_flights.setdefault(
+                        exe_id, threading.Lock())
+                with flight:
                     with self._lock:
-                        self._mlir_exes[exe_id] = exe
-                        self._exe_blobs[exe_id] = blob
-                        self._exe_costs[exe_id] = mflops
-                        self._exe_sigs[exe_id] = sig
+                        sig = self._exe_sigs.get(exe_id)
+                        mflops = self._exe_costs.get(exe_id, 1)
+                    if sig is None:
+                        exe, sig, mflops = self._compile_mlir(blob)
+                        with self._lock:
+                            self._mlir_exes[exe_id] = exe
+                            self._exe_blobs[exe_id] = blob
+                            self._exe_costs[exe_id] = mflops
+                            self._exe_sigs[exe_id] = sig
+                            self._compile_flights.pop(exe_id, None)
             reply("COMPILE_OK", {"exe_id": exe_id,
                                  "num_outputs": len(sig),
                                  "out_shapes": [s for s, _ in sig],
