@@ -53,11 +53,13 @@ def _spawn_worker():
         for line in proc.stderr:
             err_tail.append(line)
 
-    threading.Thread(target=_drain, daemon=True).start()
+    drain = threading.Thread(target=_drain, daemon=True)
+    drain.start()
     line = proc.stdout.readline()
     if not line.startswith("SERVING"):
         proc.terminate()
         proc.wait(timeout=10)
+        drain.join(timeout=2)       # let the traceback land in err_tail
         raise RuntimeError(f"bench worker failed to start: {line!r}\n"
                            + "".join(err_tail)[-2000:])
     return proc, int(line.split()[1])

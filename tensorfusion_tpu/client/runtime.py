@@ -146,17 +146,19 @@ class VTPUClient:
             ns = os.environ.get(constants.ENV_POD_NAMESPACE, "default")
             pod = os.environ.get(constants.ENV_POD_NAME, "")
             try:
-                with urllib.request.urlopen(
+                from ..utils.tlsutil import hypervisor_urlopen
+
+                with hypervisor_urlopen(
                         f"{self.hypervisor_url}/limiter?namespace={ns}"
-                        f"&pod={pod}", timeout=5) as r:
+                        f"&pod={pod}", timeout_s=5) as r:
                     info = json.loads(r.read())
                 self.shm_path = info.get("shm_path") or None
                 if register_pid:
-                    req = urllib.request.Request(
+                    hypervisor_urlopen(
                         f"{self.hypervisor_url}/process", method="POST",
                         data=json.dumps({"namespace": ns, "pod": pod,
-                                         "pid": os.getpid()}).encode())
-                    urllib.request.urlopen(req, timeout=5)
+                                         "pid": os.getpid()}).encode(),
+                        timeout_s=5)
             except Exception:
                 log.warning("hypervisor bootstrap failed; running unmetered",
                             exc_info=True)

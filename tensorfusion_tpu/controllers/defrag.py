@@ -470,8 +470,10 @@ class LiveMigrator:
 
     def _post(self, url: str) -> bool:
         try:
-            req = urllib.request.Request(url, method="POST", data=b"{}")
-            urllib.request.urlopen(req, timeout=10)
+            from ..utils.tlsutil import hypervisor_urlopen
+
+            hypervisor_urlopen(url, method="POST", data=b"{}",
+                               timeout_s=10)
             return True
         except Exception as e:  # noqa: BLE001
             log.warning("migration hook %s failed: %s", url, e)

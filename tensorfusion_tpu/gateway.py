@@ -37,10 +37,18 @@ Endpoints (mounted under the operator API, or standalone):
   volumes).  Metrics are lossy-tolerant: a drainer that falls behind the
   ring gets ``dropped > 0`` and simply continues from the oldest line.
 
-Auth: optional shared token (``X-TPF-Token`` header, constant-time
-compare) — chip inventory and pod placement are cluster control state, so
-cross-host deployments should set one (mirrors the webhook/apiserver TLS
-trust the reference inherits from Kubernetes).
+Auth: optional tokens (``X-TPF-Token`` header, constant-time compare) —
+chip inventory and pod placement are cluster control state, so
+cross-host deployments should set them.  Two modes:
+
+- single shared ``token``: full access (back-compat / small clusters);
+- per-role ``tokens`` dict (the RBAC split the reference gets from
+  Kubernetes service accounts): ``admin`` (operators: everything),
+  ``node`` (hypervisors: read/watch anything, write only node-scoped
+  kinds — Node/TPUNode/TPUChip/Pod/Lease — and push metrics), and
+  ``client`` (workload clients: read/watch only).  A client token can
+  therefore never write chips; wrong method for a role is 403, missing
+  or unknown token is 401.
 """
 
 from __future__ import annotations
@@ -64,6 +72,11 @@ KIND_BY_NAME: Dict[str, Type[Resource]] = {c.KIND: c for c in ALL_KINDS}
 #: cap on one long-poll wait; clients re-issue (keeps worker threads from
 #: pinning forever on dead connections)
 MAX_WATCH_WAIT_S = 30.0
+
+#: kinds a ``node``-role token may write: what a hypervisor legitimately
+#: registers/updates about its own host (everything else is operator
+#: state — quotas, pools, workloads — and needs ``admin``)
+NODE_WRITABLE_KINDS = {"Node", "TPUNode", "TPUChip", "Pod", "Lease"}
 
 
 class MetricsBuffer:
@@ -135,9 +148,14 @@ class StoreGateway:
     """
 
     def __init__(self, store: ObjectStore, token: str = "",
-                 metrics_sink: Optional[Callable[[List[str]], None]] = None):
+                 metrics_sink: Optional[Callable[[List[str]], None]] = None,
+                 tokens: Optional[Dict[str, str]] = None):
         self.store = store
         self.token = token
+        #: role -> token ("admin" | "node" | "client"); the shared
+        #: ``token`` doubles as the admin token when both are given
+        self.tokens: Dict[str, str] = {
+            role: t for role, t in (tokens or {}).items() if t}
         #: hypervisor-pushed influx lines; drained by the leader operator
         self.metrics = MetricsBuffer()
         #: optional same-process consumer (the operator's TSDB) — called
@@ -150,11 +168,41 @@ class StoreGateway:
 
     # -- helpers -----------------------------------------------------------
 
-    def authorized(self, headers) -> bool:
-        if not self.token:
-            return True
+    def role_of(self, headers) -> Optional[str]:
+        """The role the offered token grants: 'admin'/'node'/'client',
+        'admin' when auth is off entirely, None when unauthorized."""
+        if not self.token and not self.tokens:
+            return "admin"
         offered = headers.get("X-TPF-Token", "")
-        return hmac.compare_digest(offered, self.token)
+        if self.token and hmac.compare_digest(offered, self.token):
+            return "admin"
+        for role in ("admin", "node", "client"):   # fixed probe order
+            t = self.tokens.get(role, "")
+            if t and hmac.compare_digest(offered, t):
+                return role
+        return None
+
+    @staticmethod
+    def _allowed(role: str, method: str, sub: str,
+                 qs: Dict[str, list], body: dict) -> bool:
+        """Role/route policy (see module docstring)."""
+        if role == "admin":
+            return True
+        if sub in ("objects", "list", "watch") and method == "GET":
+            return True
+        if sub == "metrics":
+            # push is a node-agent duty; the drain feeds the leader
+            # operator's TSDB (admin)
+            return method == "POST" and role == "node"
+        if role == "node" and sub == "objects":
+            if method in ("POST", "PUT"):
+                kind = (body.get("obj") or {}).get("kind", "")
+            elif method == "DELETE":
+                kind = qs.get("kind", [""])[0]
+            else:
+                return False
+            return kind in NODE_WRITABLE_KINDS
+        return False
 
     @staticmethod
     def _cls(kind: str) -> Optional[Type[Resource]]:
@@ -177,9 +225,13 @@ class StoreGateway:
         paths this gateway does not own."""
         if not path.startswith("/api/v1/store/"):
             return None
-        if not self.authorized(headers):
+        role = self.role_of(headers)
+        if role is None:
             return 401, {"error": "missing or bad X-TPF-Token"}
         sub = path[len("/api/v1/store/"):]
+        if not self._allowed(role, method, sub, qs, body):
+            return 403, {"error": f"role {role!r} may not {method} "
+                                  f"/store/{sub}"}
         try:
             if sub == "objects":
                 if method == "GET":

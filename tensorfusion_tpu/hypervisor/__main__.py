@@ -70,6 +70,15 @@ def build_parser() -> argparse.ArgumentParser:
                     default=os.environ.get(constants.ENV_POOL_NAME, ""))
     ap.add_argument("--store-token",
                     default=os.environ.get(constants.ENV_STORE_TOKEN, ""))
+    ap.add_argument("--api-token",
+                    default=os.environ.get("TPF_HYPERVISOR_TOKEN", ""),
+                    help="require this X-TPF-Token on the hypervisor's "
+                         "own HTTP API (freeze/resume/snapshot mutate "
+                         "worker state)")
+    ap.add_argument("--tls-cert",
+                    default=os.environ.get("TPF_TLS_CERT", ""))
+    ap.add_argument("--tls-key",
+                    default=os.environ.get("TPF_TLS_KEY", ""))
     ap.add_argument("--port-file", default="",
                     help="write the bound API port here (for --port 0)")
     ap.add_argument("--advertise-url", default="",
@@ -107,7 +116,10 @@ class HypervisorDaemon:
         # can carry a live hypervisor URL
         self.server = HypervisorServer(self.devices, self.workers,
                                        snapshot_dir=args.snapshot_dir,
-                                       host=args.host, port=args.port)
+                                       host=args.host, port=args.port,
+                                       token=args.api_token,
+                                       tls_cert=args.tls_cert,
+                                       tls_key=args.tls_key)
         push = None
         if args.operator_url:
             from ..remote_store import RemoteStore

@@ -44,15 +44,22 @@ def _to_jsonable(obj):
 
 class HypervisorServer:
     def __init__(self, devices, workers, backend=None, snapshot_dir="/tmp",
-                 provider=None, host: str = "127.0.0.1", port: int = 0):
+                 provider=None, host: str = "127.0.0.1", port: int = 0,
+                 token: str = "", tls_cert: str = "", tls_key: str = ""):
         self.devices = devices
         self.workers = workers
         self.backend = backend
         self.snapshot_dir = snapshot_dir
         self.provider = provider
+        #: optional shared token — freeze/resume/snapshot mutate worker
+        #: state, so a non-loopback bind should set one
+        self.token = token
+        self.tls = bool(tls_cert)
         outer = self
 
-        class Handler(BaseHTTPRequestHandler):
+        from ..utils.tlsutil import TlsHandshakeMixin
+
+        class Handler(TlsHandshakeMixin, BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):  # quiet
                 log.debug("%s " + fmt, self.client_address[0], *args)
 
@@ -70,34 +77,56 @@ class HypervisorServer:
                     return {}
                 return json.loads(self.rfile.read(length))
 
+            def _authed(self) -> bool:
+                # /healthz stays open: liveness probes and
+                # RemoteStore.ping() are tokenless by design
+                if not outer.token or \
+                        urlparse(self.path).path == "/healthz":
+                    return True
+                import hmac as _hmac
+
+                offered = self.headers.get("X-TPF-Token", "")
+                if _hmac.compare_digest(offered, outer.token):
+                    return True
+                self._send(401, {"error": "missing or bad X-TPF-Token"})
+                return False
+
             def do_GET(self):
                 try:
-                    outer._get(self)
+                    if self._authed():
+                        outer._get(self)
                 except Exception as e:  # noqa: BLE001
                     log.exception("GET %s failed", self.path)
                     self._send(500, {"error": str(e)})
 
             def do_POST(self):
                 try:
-                    outer._post(self)
+                    if self._authed():
+                        outer._post(self)
                 except Exception as e:  # noqa: BLE001
                     log.exception("POST %s failed", self.path)
                     self._send(500, {"error": str(e)})
 
             def do_DELETE(self):
                 try:
-                    outer._delete(self)
+                    if self._authed():
+                        outer._delete(self)
                 except Exception as e:  # noqa: BLE001
                     log.exception("DELETE %s failed", self.path)
                     self._send(500, {"error": str(e)})
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
+        if tls_cert:
+            from ..utils.tlsutil import wrap_http_server
+
+            wrap_http_server(self._httpd, tls_cert, tls_key)
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
     @property
     def url(self) -> str:
-        return f"http://127.0.0.1:{self.port}"
+        scheme = "https" if self.tls else "http"
+        return f"{scheme}://127.0.0.1:{self.port}"
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._httpd.serve_forever,

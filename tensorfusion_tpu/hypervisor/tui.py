@@ -489,7 +489,9 @@ def _clamp(idx: int, n: int) -> int:
 
 
 def _fetch(url: str, path: str):
-    with urllib.request.urlopen(url + path, timeout=5) as r:
+    from ..utils.tlsutil import hypervisor_urlopen
+
+    with hypervisor_urlopen(url + path, timeout_s=5) as r:
         return json.loads(r.read())
 
 

@@ -474,6 +474,17 @@ def main(argv=None, stop_event: Optional[threading.Event] = None) -> int:
                     default=os.environ.get(constants.ENV_STORE_TOKEN, ""),
                     help="shared token remote hypervisors must present "
                          "to the store gateway")
+    ap.add_argument("--node-token",
+                    default=os.environ.get("TPF_STORE_TOKEN_NODE", ""),
+                    help="node-agent-role gateway token (write node-"
+                         "scoped kinds + push metrics only)")
+    ap.add_argument("--client-token",
+                    default=os.environ.get("TPF_STORE_TOKEN_CLIENT", ""),
+                    help="client-role gateway token (read/watch only)")
+    ap.add_argument("--tls-cert",
+                    default=os.environ.get("TPF_TLS_CERT", ""))
+    ap.add_argument("--tls-key",
+                    default=os.environ.get("TPF_TLS_KEY", ""))
     ap.add_argument("--port-file", default="",
                     help="write the bound API port here (for --port 0)")
     ap.add_argument("-v", "--verbose", action="store_true")
@@ -529,7 +540,10 @@ def main(argv=None, stop_event: Optional[threading.Event] = None) -> int:
         except Exception:
             pass
     server = OperatorServer(op, host=args.host, port=args.port,
-                            store_token=args.store_token)
+                            store_token=args.store_token,
+                            store_tokens={"node": args.node_token,
+                                          "client": args.client_token},
+                            tls_cert=args.tls_cert, tls_key=args.tls_key)
     if args.store_url:
         # HA replica: campaign for the store lease; only the winner runs
         # controllers + scheduler, losers serve redirects until promoted

@@ -164,6 +164,14 @@ class RemoteStore:
         self.base_url = base_url.rstrip("/")
         self.token = token
         self.timeout_s = timeout_s
+        #: TLS context for https:// stores — trust anchors from
+        #: TPF_TLS_CA (the statestore's self-signed cert works as its
+        #: own anchor); None for plain http
+        self._ssl_ctx = None
+        if self.base_url.startswith("https://"):
+            from .utils.tlsutil import client_context
+
+            self._ssl_ctx = client_context()
 
     # -- transport ---------------------------------------------------------
 
@@ -180,8 +188,8 @@ class RemoteStore:
             if self.token:
                 req.add_header("X-TPF-Token", self.token)
             try:
-                with urllib.request.urlopen(req,
-                                            timeout=self.timeout_s) as r:
+                with urllib.request.urlopen(req, timeout=self.timeout_s,
+                                            context=self._ssl_ctx) as r:
                     return json.loads(r.read() or b"{}")
             except urllib.error.HTTPError as e:
                 payload = {}
@@ -322,7 +330,8 @@ class RemoteStore:
     def ping(self, timeout_s: float = 5.0) -> bool:
         try:
             with urllib.request.urlopen(self.base_url + "/healthz",
-                                        timeout=timeout_s) as r:
+                                        timeout=timeout_s,
+                                        context=self._ssl_ctx) as r:
                 return r.status == 200
         except Exception:  # noqa: BLE001
             return False

@@ -24,6 +24,11 @@ def main() -> None:
                         help="auth token (default: $TPF_REMOTING_TOKEN)")
     parser.add_argument("--max-resident-gb", type=float, default=0.0,
                         help="resident-buffer budget (0 = unlimited)")
+    parser.add_argument("--insecure", action="store_true",
+                        help="serve without a token on a non-loopback "
+                             "bind (the worker executes caller-supplied "
+                             "StableHLO — do not do this on open "
+                             "networks)")
     args = parser.parse_args()
 
     logging.basicConfig(level=logging.INFO,
@@ -32,7 +37,8 @@ def main() -> None:
 
     worker = RemoteVTPUWorker(
         host=args.host, port=args.port, token=args.token,
-        max_resident_bytes=int(args.max_resident_gb * (1 << 30)))
+        max_resident_bytes=int(args.max_resident_gb * (1 << 30)),
+        insecure=args.insecure or None)
     worker.start()
     print(f"tpf remote worker ready on {worker.url}", flush=True)
     try:

@@ -49,10 +49,25 @@ class RemoteVTPUWorker:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  meter_client=None, token: Optional[str] = None,
                  max_resident_bytes: int = 0,
-                 compress: Optional[bool] = None):
+                 compress: Optional[bool] = None,
+                 insecure: Optional[bool] = None):
         self.meter_client = meter_client    # optional VTPUClient
         self.token = token if token is not None else \
             os.environ.get("TPF_REMOTING_TOKEN", "")
+        # This socket compiles and executes caller-supplied StableHLO:
+        # an unauthenticated non-loopback bind is an RCE-adjacent
+        # footgun, so it must be an explicit opt-in (--insecure /
+        # TPF_REMOTING_INSECURE=1).  Loopback binds stay open for
+        # local dev and tests.
+        if insecure is None:
+            insecure = os.environ.get("TPF_REMOTING_INSECURE", "") == "1"
+        if not self.token and not insecure and \
+                host not in ("127.0.0.1", "localhost", "::1"):
+            raise ValueError(
+                f"refusing to serve remote-vTPU on {host} without a "
+                f"token: set TPF_REMOTING_TOKEN (or pass token=), or "
+                f"opt in explicitly with insecure=True / "
+                f"TPF_REMOTING_INSECURE=1")
         #: wire compression pays for itself across DCN, not loopback/rack
         #: links where zlib costs more than the bytes saved — off unless
         #: asked (TPF_REMOTING_COMPRESS=1)
@@ -404,18 +419,23 @@ class RemoteVTPUWorker:
                 with self._lock:
                     flight = self._compile_flights.setdefault(
                         exe_id, threading.Lock())
-                with flight:
-                    with self._lock:
-                        sig = self._exe_sigs.get(exe_id)
-                        mflops = self._exe_costs.get(exe_id, 1)
-                    if sig is None:
-                        exe, sig, mflops = self._compile_mlir(blob)
+                try:
+                    with flight:
                         with self._lock:
-                            self._mlir_exes[exe_id] = exe
-                            self._exe_blobs[exe_id] = blob
-                            self._exe_costs[exe_id] = mflops
-                            self._exe_sigs[exe_id] = sig
-                            self._compile_flights.pop(exe_id, None)
+                            sig = self._exe_sigs.get(exe_id)
+                            mflops = self._exe_costs.get(exe_id, 1)
+                        if sig is None:
+                            exe, sig, mflops = self._compile_mlir(blob)
+                            with self._lock:
+                                self._mlir_exes[exe_id] = exe
+                                self._exe_blobs[exe_id] = blob
+                                self._exe_costs[exe_id] = mflops
+                                self._exe_sigs[exe_id] = sig
+                finally:
+                    # always evict the flight entry — a module that
+                    # fails to compile must not leak a lock per blob
+                    with self._lock:
+                        self._compile_flights.pop(exe_id, None)
             reply("COMPILE_OK", {"exe_id": exe_id,
                                  "num_outputs": len(sig),
                                  "out_shapes": [s for s, _ in sig],

@@ -55,8 +55,10 @@ def _jsonable(obj):
 
 class OperatorServer:
     def __init__(self, operator, host: str = "127.0.0.1", port: int = 0,
-                 store_token: str = ""):
+                 store_token: str = "", store_tokens=None,
+                 tls_cert: str = "", tls_key: str = ""):
         self.operator = operator
+        self.tls = bool(tls_cert)
         # the gateway serves only when this process owns the
         # authoritative store; HA replicas run against a RemoteStore and
         # point hypervisors at the standalone state store instead.
@@ -64,12 +66,14 @@ class OperatorServer:
         # (single-process topology; the HA topology drains them from the
         # state store's ring instead — operator._drain_remote_metrics)
         self.gateway = StoreGateway(
-            operator.store, token=store_token,
+            operator.store, token=store_token, tokens=store_tokens,
             metrics_sink=operator.ingest_metrics_lines) \
             if isinstance(operator.store, ObjectStore) else None
         outer = self
 
-        class Handler(BaseHTTPRequestHandler):
+        from ..utils.tlsutil import TlsHandshakeMixin
+
+        class Handler(TlsHandshakeMixin, BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):
                 log.debug(fmt, *args)
 
@@ -160,12 +164,17 @@ class OperatorServer:
                     self._send(500, {"error": str(e)})
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
+        if tls_cert:
+            from ..utils.tlsutil import wrap_http_server
+
+            wrap_http_server(self._httpd, tls_cert, tls_key)
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
     @property
     def url(self) -> str:
-        return f"http://127.0.0.1:{self.port}"
+        scheme = "https" if self.tls else "http"
+        return f"{scheme}://127.0.0.1:{self.port}"
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._httpd.serve_forever,

@@ -34,12 +34,17 @@ class StateStoreServer:
     """Thin HTTP host for a StoreGateway (healthz + store routes only)."""
 
     def __init__(self, store: ObjectStore, host: str = "127.0.0.1",
-                 port: int = 0, token: str = ""):
+                 port: int = 0, token: str = "",
+                 tokens: Optional[dict] = None,
+                 tls_cert: str = "", tls_key: str = ""):
         self.store = store
-        self.gateway = StoreGateway(store, token=token)
+        self.gateway = StoreGateway(store, token=token, tokens=tokens)
+        self.tls = bool(tls_cert)
         outer = self
 
-        class Handler(BaseHTTPRequestHandler):
+        from .utils.tlsutil import TlsHandshakeMixin
+
+        class Handler(TlsHandshakeMixin, BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):
                 log.debug(fmt, *args)
 
@@ -91,12 +96,17 @@ class StateStoreServer:
                         pass
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
+        if tls_cert:
+            from .utils.tlsutil import wrap_http_server
+
+            wrap_http_server(self._httpd, tls_cert, tls_key)
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
     @property
     def url(self) -> str:
-        return f"http://127.0.0.1:{self.port}"
+        scheme = "https" if self.tls else "http"
+        return f"{scheme}://127.0.0.1:{self.port}"
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._httpd.serve_forever,
@@ -125,6 +135,20 @@ def main(argv=None, stop_event: Optional[threading.Event] = None) -> int:
     ap.add_argument("--persist-dir", default="")
     ap.add_argument("--token",
                     default=os.environ.get(constants.ENV_STORE_TOKEN, ""))
+    ap.add_argument("--node-token",
+                    default=os.environ.get("TPF_STORE_TOKEN_NODE", ""),
+                    help="token granting the node-agent role (write "
+                         "Node/TPUNode/TPUChip/Pod/Lease + push metrics)")
+    ap.add_argument("--client-token",
+                    default=os.environ.get("TPF_STORE_TOKEN_CLIENT", ""),
+                    help="token granting read/watch only")
+    ap.add_argument("--tls-cert",
+                    default=os.environ.get("TPF_TLS_CERT", ""))
+    ap.add_argument("--tls-key",
+                    default=os.environ.get("TPF_TLS_KEY", ""))
+    ap.add_argument("--tls-self-signed", action="store_true",
+                    help="generate a self-signed cert/key pair under "
+                         "--persist-dir (or cwd) and serve TLS with it")
     ap.add_argument("--port-file", default="")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
@@ -138,8 +162,27 @@ def main(argv=None, stop_event: Optional[threading.Event] = None) -> int:
         n = store.load(ALL_KINDS)
         if n:
             log.info("loaded %d persisted objects", n)
-    server = StateStoreServer(store, host=args.host, port=args.port,
-                              token=args.token)
+    if args.tls_self_signed and not args.tls_cert:
+        from .utils.tlsutil import generate_self_signed
+
+        base = args.persist_dir or "."
+        args.tls_cert = os.path.join(base, "statestore-cert.pem")
+        args.tls_key = os.path.join(base, "statestore-key.pem")
+        # reuse an existing pair: regenerating on every restart would
+        # invalidate the trust anchor remote clients already copied
+        if not (os.path.exists(args.tls_cert)
+                and os.path.exists(args.tls_key)):
+            generate_self_signed(
+                args.tls_cert, args.tls_key,
+                hosts=("localhost", "127.0.0.1", args.host)
+                if args.host not in ("0.0.0.0", "")
+                else ("localhost", "127.0.0.1"))
+        log.info("self-signed TLS cert at %s (clients: TPF_TLS_CA=%s)",
+                 args.tls_cert, args.tls_cert)
+    server = StateStoreServer(
+        store, host=args.host, port=args.port, token=args.token,
+        tokens={"node": args.node_token, "client": args.client_token},
+        tls_cert=args.tls_cert, tls_key=args.tls_key)
     server.start()
     if args.port_file:
         with open(args.port_file, "w") as f:
