@@ -82,6 +82,11 @@ erl-tune:
 webhook-bench:
 	$(PY) benchmarks/webhook_bench.py --pods 5000
 
+# BASELINE #5 composed scenario: bursty trace -> autoscale-to-zero,
+# wake-from-zero latency, hot live-migration with token exactness.
+burst-serving-bench:
+	$(PY) benchmarks/burst_serving.py
+
 # Remote-vTPU serving overhead vs the reference's <4% GPU-over-IP claim.
 remoting-bench:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \

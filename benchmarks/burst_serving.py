@@ -1,0 +1,377 @@
+"""BASELINE #5 as a composed scenario: bursty serving with
+autoscale-to-zero, wake-from-zero latency, and one HOT live migration
+under load with a token-exactness check.
+
+The reference exposes this as per-QoS auto-freeze/resume + dynamic
+replica knobs (``schedulingconfigtemplate_types.go:221-231``,
+``workload dynamic_replicas``); the pieces exist and are unit-tested
+separately here — this bench proves they compose under a bursty
+ShareGPT-shaped trace:
+
+- a dynamic-replica ``TPUWorkload`` (connections-per-worker=1, scale-to-
+  zero grace) on an in-process operator with a mock v5e host;
+- a bench-side *node runtime* playing kubelet: when the workload
+  controller spawns a worker pod (port allocated by the control plane),
+  it boots a real ``RemoteVTPUWorker`` process-alike on that port and
+  patches the pod's host_ip — requests then flow over real TCP;
+- a trace of request bursts separated by idle gaps longer than the
+  grace period, so every burst wakes the workload from zero.  Each
+  request greedy-decodes N tokens of a tiny deterministic LM through
+  ``remote_jit`` (weights device-resident; per-step wire traffic is a
+  context window);
+- during the final burst one serving worker is HOT-MIGRATED:
+  snapshot -> restore on a fresh worker -> client retarget.  Blackout is
+  the service gap the migrating request observes; token-exactness
+  requires its full output to equal an uninterrupted reference decode.
+
+Prints ONE JSON line and persists ``benchmarks/results/burst_serving``:
+    {"metric": "burst_serving_slo_hit_rate", "value": .., "unit": "%",
+     "wake_from_zero_ms": {...}, "migration_blackout_ms": ..,
+     "tokens_exact": true, ...}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+try:
+    from benchmarks._artifact import write_artifact
+except ImportError:
+    from _artifact import write_artifact
+
+CTX = 32           # context window ints shipped per decode step
+VOCAB = 257
+DIM = 64
+
+
+def _toy_lm_params(rng):
+    """Deterministic tiny LM: logits = onehot(ctx) @ emb @ out."""
+    emb = rng.standard_normal((VOCAB, DIM)).astype(np.float32) * 0.3
+    out = rng.standard_normal((DIM, VOCAB)).astype(np.float32) * 0.3
+    return emb, out
+
+
+def _decode_fn(emb, out, ctx):
+    import jax.numpy as jnp
+
+    h = emb[ctx].mean(axis=0) + emb[ctx[-1]] * 2.0
+    logits = h @ out
+    return jnp.argmax(logits).astype(jnp.int32)
+
+
+class NodeRuntime:
+    """The kubelet role for this bench: realize worker pods as live
+    RemoteVTPUWorker servers on their control-plane-assigned ports."""
+
+    def __init__(self, op):
+        self.op = op
+        self.workers = {}          # pod name -> RemoteVTPUWorker
+        self.live_ports = set()    # ports with a live server
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="bench-node-runtime")
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+        for w in self.workers.values():
+            w.stop()
+
+    def _loop(self):
+        from tensorfusion_tpu import constants
+        from tensorfusion_tpu.api.types import Pod
+        from tensorfusion_tpu.remoting import RemoteVTPUWorker
+
+        while not self._stop.wait(0.05):
+            pods = {p.metadata.name: p
+                    for p in self.op.store.list(Pod, namespace="default")
+                    if p.metadata.labels.get(constants.LABEL_COMPONENT)
+                    == constants.COMPONENT_WORKER}
+            for name, pod in pods.items():
+                if name in self.workers or \
+                        pod.status.phase != constants.PHASE_RUNNING:
+                    continue
+                port = int(pod.metadata.annotations.get(
+                    constants.ANN_PORT_NUMBER, "0"))
+                if not port:
+                    continue
+                w = RemoteVTPUWorker(host="127.0.0.1", port=port)
+                w.start()
+                self.workers[name] = w
+                self.live_ports.add(port)
+            for name in list(self.workers):
+                if name not in pods:
+                    w = self.workers.pop(name)
+                    self.live_ports.discard(w.port)
+                    w.stop()
+
+
+def _serve_request(url, emb, out, prompt, steps, migrate_at=None):
+    """Greedy-decode ``steps`` tokens against the worker at ``url``.
+    Returns (tokens, per_token_gaps_s, migration_info|None)."""
+    from tensorfusion_tpu.remoting import RemoteDevice
+
+    dev = RemoteDevice(url)
+    emb_ref, out_ref = dev.put(emb), dev.put(out)
+    step = dev.remote_jit(_decode_fn)
+    ctx = list(prompt)
+    tokens, gaps = [], []
+    migration = None
+    t_prev = time.perf_counter()
+    for i in range(steps):
+        if migrate_at is not None and i == migrate_at:
+            migration = _hot_migrate(dev, emb_ref, out_ref)
+            dev.close()
+            dev = migration["device"]
+            emb_ref.device = dev
+            out_ref.device = dev
+            step = dev.remote_jit(_decode_fn)
+        window = np.asarray(ctx[-CTX:], np.int32)
+        nxt = int(np.asarray(step(emb_ref, out_ref, window)).item())
+        now = time.perf_counter()
+        gaps.append(now - t_prev)
+        t_prev = now
+        tokens.append(nxt)
+        ctx.append(nxt)
+    dev.close()
+    return tokens, gaps, migration
+
+
+def _hot_migrate(dev, *refs):
+    """Snapshot the serving worker, restore onto a fresh one, return the
+    new device + blackout timing.  The resident buffer ids survive the
+    move (remoting/worker.py snapshot/restore), so the client's refs
+    keep working."""
+    import tempfile
+
+    from tensorfusion_tpu.remoting import RemoteDevice, RemoteVTPUWorker
+
+    state_dir = tempfile.mkdtemp(prefix="tpf-migrate-")
+    t0 = time.perf_counter()
+    dev.snapshot(state_dir)
+    target = RemoteVTPUWorker(host="127.0.0.1", port=0)
+    target.start()
+    new_dev = RemoteDevice(target.url)
+    new_dev.restore(state_dir)
+    blackout_s = time.perf_counter() - t0
+    return {"device": new_dev, "target": target,
+            "blackout_ms": round(blackout_s * 1e3, 1)}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bursts", type=int, default=3)
+    ap.add_argument("--requests-per-burst", type=int, default=4)
+    ap.add_argument("--tokens", type=int, default=24)
+    ap.add_argument("--grace-s", type=float, default=1.0)
+    ap.add_argument("--idle-s", type=float, default=2.5,
+                    help="gap between bursts (> grace: forces re-wake)")
+    args = ap.parse_args()
+
+    import jax  # noqa: F401 - fail fast if jax is broken
+
+    from tensorfusion_tpu import constants
+    from tensorfusion_tpu.api import ResourceAmount
+    from tensorfusion_tpu.api.types import (ChipModelInfo, Pod,
+                                            ProviderConfig, TPUConnection,
+                                            TPUNodeClaim, TPUPool,
+                                            TPUWorkload)
+    from tensorfusion_tpu.operator import Operator
+
+    op = Operator(enable_expander=True)
+    pool = TPUPool.new("pool-a")
+    pool.spec.name = "pool-a"
+    op.store.create(pool)
+    cfg = ProviderConfig.new("mock-tpu")
+    cfg.spec.chip_models = [ChipModelInfo(
+        generation="v5e", cores=1, hbm_bytes=16 * 2**30,
+        bf16_tflops=197.0)]
+    op.store.create(cfg)
+    claim = TPUNodeClaim.new("host-0")
+    claim.spec.pool = "pool-a"
+    claim.spec.generation = "v5e"
+    claim.spec.chip_count = 8
+    op.store.create(claim)
+    op.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and len(op.allocator.chips()) < 8:
+        time.sleep(0.05)
+    assert len(op.allocator.chips()) >= 8, "host never provisioned"
+
+    wl = TPUWorkload.new("burst-serve", namespace="default")
+    wl.spec.pool = "pool-a"
+    wl.spec.replicas = args.requests_per_burst       # max scale
+    wl.spec.dynamic_replicas = True
+    wl.spec.auto_scaling.scale_to_zero_grace_seconds = args.grace_s
+    wl.spec.auto_scaling.connections_per_worker = 1
+    wl.spec.resources.requests = ResourceAmount(tflops=10.0,
+                                                hbm_bytes=2**30)
+    wl.spec.resources.limits = ResourceAmount(tflops=20.0,
+                                              hbm_bytes=2**30)
+    op.store.create(wl)
+
+    runtime = NodeRuntime(op)
+    runtime.start()
+
+    rng = np.random.default_rng(0)
+    emb, out = _toy_lm_params(rng)
+
+    def worker_count():
+        return len([p for p in op.store.list(Pod, namespace="default")
+                    if p.metadata.annotations.get(constants.ANN_WORKLOAD)
+                    == "burst-serve"
+                    and p.metadata.labels.get(constants.LABEL_COMPONENT)
+                    == constants.COMPONENT_WORKER])
+
+    def wait_zero(timeout=30.0):
+        end = time.time() + timeout
+        while time.time() < end:
+            if worker_count() == 0:
+                return True
+            time.sleep(0.05)
+        return False
+
+    assert wait_zero(), "workload never scaled to zero at boot"
+
+    results = []
+    wake_ms = []
+    migration_result = {}
+    reference_tokens = {}
+
+    for burst in range(args.bursts):
+        if burst:
+            time.sleep(args.idle_s)
+            if not wait_zero():
+                results.append({"error": "no scale-to-zero between bursts"})
+                break
+        t_burst0 = time.perf_counter()
+        conns = []
+        for i in range(args.requests_per_burst):
+            conn = TPUConnection.new(f"b{burst}-c{i}", namespace="default")
+            conn.spec.workload = "burst-serve"
+            op.store.create(conn)
+            conns.append(conn.metadata.name)
+
+        # wake-from-zero: first connection of the burst gets a live URL.
+        # The control plane's URL names the (simulated) node; resolving
+        # node -> IP is deployment wiring, and this bench's node runtime
+        # serves every worker port on loopback — so remap host, keep the
+        # control-plane-assigned port, and require the server to be UP.
+        def url_of(cname, timeout=30.0):
+            end = time.time() + timeout
+            while time.time() < end:
+                c = op.store.try_get(TPUConnection, cname, "default")
+                if c is not None and c.status.worker_url:
+                    port = int(c.status.worker_url.rsplit(":", 1)[1])
+                    if port and port in runtime.live_ports:
+                        return f"tcp://127.0.0.1:{port}"
+                time.sleep(0.01)
+            raise TimeoutError(f"{cname} never got a live worker URL")
+
+        first_url = url_of(conns[0])
+        wake_ms.append(round((time.perf_counter() - t_burst0) * 1e3, 1))
+
+        last_burst = burst == args.bursts - 1
+        req_threads, req_out = [], {}
+
+        def run_req(cname, migrate):
+            url = url_of(cname)
+            prompt = [(hash(cname) % (VOCAB - 1)) + 1] * 4
+            t0 = time.perf_counter()
+            tokens, gaps, mig = _serve_request(
+                url, emb, out, prompt, args.tokens,
+                migrate_at=args.tokens // 2 if migrate else None)
+            req_out[cname] = {
+                "latency_s": time.perf_counter() - t0,
+                "tokens": tokens, "gaps": gaps, "migration": mig,
+                "prompt": prompt}
+
+        for i, cname in enumerate(conns):
+            migrate = last_burst and i == 0
+            th = threading.Thread(target=run_req, args=(cname, migrate))
+            th.start()
+            req_threads.append(th)
+        for th in req_threads:
+            th.join(timeout=180)
+        for cname in conns:
+            info = req_out.get(cname)
+            if info is None:
+                results.append({"req": cname, "error": "timed out"})
+                continue
+            entry = {"req": cname, "burst": burst,
+                     "latency_ms": round(info["latency_s"] * 1e3, 1),
+                     "tokens": len(info["tokens"])}
+            if info["migration"]:
+                entry["migration_blackout_ms"] = \
+                    info["migration"]["blackout_ms"]
+                migration_result = {
+                    "blackout_ms": info["migration"]["blackout_ms"],
+                    "request": cname}
+                reference_tokens[cname] = (info["prompt"],
+                                           info["tokens"])
+                info["migration"]["target"].stop()
+                info["migration"]["device"].close()
+            results.append(entry)
+            op.store.delete(TPUConnection, cname, "default")
+
+    # token-exactness: replay the migrated request on one fresh,
+    # uninterrupted worker — outputs must be identical
+    tokens_exact = None
+    if reference_tokens:
+        from tensorfusion_tpu.remoting import RemoteVTPUWorker
+
+        ref_worker = RemoteVTPUWorker(host="127.0.0.1", port=0)
+        ref_worker.start()
+        (cname, (prompt, migrated_tokens)), = reference_tokens.items()
+        ref_toks, _, _ = _serve_request(ref_worker.url, emb, out, prompt,
+                                        args.tokens)
+        ref_worker.stop()
+        tokens_exact = ref_toks == migrated_tokens
+
+    drained = wait_zero(timeout=args.grace_s + 20)
+    runtime.stop()
+    op.stop()
+
+    ok = [r for r in results if "error" not in r]
+    latencies = sorted(r["latency_ms"] for r in ok)
+    # SLO: within 3x the median non-migrating request (wake latency is
+    # reported separately; the migrating request must still meet SLO —
+    # that is what makes the migration "hot")
+    slo_ms = 3.0 * latencies[len(latencies) // 2] if latencies else 0.0
+    hit = [r for r in ok if r["latency_ms"] <= slo_ms]
+    slo_rate = round(100.0 * len(hit) / max(len(results), 1), 1)
+
+    result = {
+        "metric": "burst_serving_slo_hit_rate",
+        "value": slo_rate,
+        "unit": "%",
+        "vs_baseline": round(slo_rate / 100.0, 3),
+        "slo_ms": round(slo_ms, 1),
+        "wake_from_zero_ms": {"per_burst": wake_ms,
+                              "max": max(wake_ms) if wake_ms else None},
+        "migration_blackout_ms": migration_result.get("blackout_ms"),
+        "tokens_exact": tokens_exact,
+        "scaled_to_zero_after": drained,
+        "requests": results,
+        "bursts": args.bursts,
+        "requests_per_burst": args.requests_per_burst,
+        "tokens_per_request": args.tokens,
+    }
+    write_artifact("burst_serving", result)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -78,6 +78,11 @@ MAX_WATCH_WAIT_S = 30.0
 #: state — quotas, pools, workloads — and needs ``admin``)
 NODE_WRITABLE_KINDS = {"Node", "TPUNode", "TPUChip", "Pod", "Lease"}
 
+#: lease names a ``node`` token may NOT touch: the HA leader-election
+#: lease is control-plane state — a node token must not be able to
+#: steal/expire the operator leadership (control-plane DoS)
+PROTECTED_LEASES = {"operator-leader"}
+
 
 class MetricsBuffer:
     """Bounded ring of influx lines with monotone sequence numbers.
@@ -196,10 +201,15 @@ class StoreGateway:
             return method == "POST" and role == "node"
         if role == "node" and sub == "objects":
             if method in ("POST", "PUT"):
-                kind = (body.get("obj") or {}).get("kind", "")
+                obj = body.get("obj") or {}
+                kind = obj.get("kind", "")
+                name = (obj.get("metadata") or {}).get("name", "")
             elif method == "DELETE":
                 kind = qs.get("kind", [""])[0]
+                name = qs.get("name", [""])[0]
             else:
+                return False
+            if kind == "Lease" and name in PROTECTED_LEASES:
                 return False
             return kind in NODE_WRITABLE_KINDS
         return False

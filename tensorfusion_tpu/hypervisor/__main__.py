@@ -73,8 +73,9 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--api-token",
                     default=os.environ.get("TPF_HYPERVISOR_TOKEN", ""),
                     help="require this X-TPF-Token on the hypervisor's "
-                         "own HTTP API (freeze/resume/snapshot mutate "
-                         "worker state)")
+                         "HTTP API except /healthz and the workload-pod "
+                         "bootstrap routes (/limiter, /process) — "
+                         "freeze/resume/snapshot and inventory need it")
     ap.add_argument("--tls-cert",
                     default=os.environ.get("TPF_TLS_CERT", ""))
     ap.add_argument("--tls-key",

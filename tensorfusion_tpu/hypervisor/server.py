@@ -77,11 +77,17 @@ class HypervisorServer:
                     return {}
                 return json.loads(self.rfile.read(length))
 
+            #: tokenless routes: /healthz for liveness probes, and the
+            #: workload-pod bootstrap endpoints (/limiter, /process) —
+            #: pods discover their shm segment and register pids here,
+            #: and handing every tenant pod the admin token (which can
+            #: freeze/snapshot OTHER tenants' workers) would be worse
+            #: than leaving node-local discovery open
+            PUBLIC_PATHS = {"/healthz", "/limiter", "/process"}
+
             def _authed(self) -> bool:
-                # /healthz stays open: liveness probes and
-                # RemoteStore.ping() are tokenless by design
                 if not outer.token or \
-                        urlparse(self.path).path == "/healthz":
+                        urlparse(self.path).path in self.PUBLIC_PATHS:
                     return True
                 import hmac as _hmac
 

@@ -27,6 +27,7 @@ from __future__ import annotations
 import json
 import logging
 import queue
+import ssl
 import threading
 import time
 import urllib.error
@@ -199,6 +200,15 @@ class RemoteStore:
                     pass
                 self._raise_api_error(e.code, payload)
             except (urllib.error.URLError, OSError, TimeoutError) as e:
+                # a certificate mismatch never heals by retrying — fail
+                # fast instead of burning the whole backoff schedule
+                cause = getattr(e, "reason", e)
+                if isinstance(cause, ssl.SSLCertVerificationError) or \
+                        isinstance(e, ssl.SSLCertVerificationError):
+                    raise RemoteStoreError(
+                        f"{method} {url}: TLS verification failed "
+                        f"(set TPF_TLS_CA to the server cert): "
+                        f"{cause}") from e
                 if tries >= max_tries:
                     raise RemoteStoreError(
                         f"{method} {url}: {e}") from e

@@ -172,11 +172,10 @@ def main(argv=None, stop_event: Optional[threading.Event] = None) -> int:
         # invalidate the trust anchor remote clients already copied
         if not (os.path.exists(args.tls_cert)
                 and os.path.exists(args.tls_key)):
-            generate_self_signed(
-                args.tls_cert, args.tls_key,
-                hosts=("localhost", "127.0.0.1", args.host)
-                if args.host not in ("0.0.0.0", "")
-                else ("localhost", "127.0.0.1"))
+            from .utils.tlsutil import default_san_hosts
+
+            generate_self_signed(args.tls_cert, args.tls_key,
+                                 hosts=default_san_hosts(args.host))
         log.info("self-signed TLS cert at %s (clients: TPF_TLS_CA=%s)",
                  args.tls_cert, args.tls_cert)
     server = StateStoreServer(

@@ -95,10 +95,15 @@ def wrap_http_server(httpd, cert_path: str, key_path: str) -> None:
 class TlsHandshakeMixin:
     """Handler mixin completing the TLS handshake per connection, with a
     deadline, in the handler's own thread.  List it BEFORE the HTTP
-    handler base class."""
+    handler base class.
+
+    A failed handshake (plaintext probe, port scan, slowloris) is a
+    routine event on an exposed port: it logs ONE debug line and closes
+    the connection instead of dumping a traceback per probe."""
 
     #: a peer must complete the handshake within this budget
     handshake_timeout_s = 10.0
+    _tls_ok = True
 
     def setup(self):  # noqa: D102 - socketserver hook
         if isinstance(self.request, ssl.SSLSocket):
@@ -106,9 +111,61 @@ class TlsHandshakeMixin:
             self.request.settimeout(self.handshake_timeout_s)
             try:
                 self.request.do_handshake()
+            except (ssl.SSLError, OSError) as e:
+                log.debug("TLS handshake from %s failed: %s",
+                          self.client_address, e)
+                self._tls_ok = False
             finally:
-                self.request.settimeout(timeout)
+                try:
+                    self.request.settimeout(timeout)
+                except OSError:
+                    pass
         super().setup()
+
+    def handle(self):  # noqa: D102
+        if self._tls_ok:
+            super().handle()
+
+    def finish(self):  # noqa: D102
+        if self._tls_ok:
+            super().finish()
+        else:
+            try:
+                self.request.close()
+            except OSError:
+                pass
+
+
+def default_san_hosts(bind_host: str = "") -> tuple:
+    """SAN entries for a self-signed server cert: loopback plus this
+    machine's reachable names/IPs, so TPF_TLS_CA verification works for
+    REMOTE clients of a 0.0.0.0 bind (a cert naming only localhost
+    would force them to TPF_TLS_INSECURE=1)."""
+    import socket
+
+    hosts = ["localhost", "127.0.0.1"]
+    if bind_host and bind_host not in ("0.0.0.0", "::", ""):
+        hosts.append(bind_host)
+    try:
+        hosts.append(socket.gethostname())
+    except OSError:
+        pass
+    try:
+        # the UDP-connect trick: no packets sent, just routing lookup
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("10.255.255.255", 1))
+            hosts.append(s.getsockname()[0])
+        finally:
+            s.close()
+    except OSError:
+        pass
+    seen, out = set(), []
+    for h in hosts:
+        if h and h not in seen:
+            seen.add(h)
+            out.append(h)
+    return tuple(out)
 
 
 def hypervisor_urlopen(url: str, method: str = "GET",

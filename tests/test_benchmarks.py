@@ -76,3 +76,28 @@ def test_pjrt_proxy_launch_overhead(native_build, tmp_path):
     result = json.loads(out.stdout.strip().splitlines()[-1])
     # < 10us per launch = < 1% of even a 1ms training step
     assert 0 <= result["value"] < 10_000
+
+
+def test_burst_serving_scenario_fast():
+    """BASELINE #5 composed scenario, compressed trace: every burst
+    wakes the workload from zero, the hot migration's blackout is
+    bounded, its token stream is EXACT vs an uninterrupted decode, and
+    the workload drains back to zero."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               TPF_BENCH_RESULTS_DIR="/tmp/tpf-smoke-results")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    out = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "benchmarks" /
+                             "burst_serving.py"),
+         "--bursts", "2", "--requests-per-burst", "2", "--tokens", "8"],
+        capture_output=True, text=True, env=env, cwd=str(REPO_ROOT),
+        timeout=400)
+    assert out.returncode == 0, out.stdout + out.stderr
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    assert result["tokens_exact"] is True
+    assert result["scaled_to_zero_after"] is True
+    assert result["migration_blackout_ms"] is not None
+    assert result["migration_blackout_ms"] < 5000
+    assert all(w is not None for w in
+               result["wake_from_zero_ms"]["per_burst"])
+    assert result["value"] >= 50.0          # SLO hit rate, noisy CI box

@@ -90,6 +90,54 @@ def test_node_token_writes_node_kinds_only():
     assert code == 403
 
 
+def test_node_token_cannot_touch_leader_lease():
+    """Leadership is control-plane state: a node token stealing or
+    expiring the operator-leader Lease would be a control-plane DoS."""
+    from tensorfusion_tpu.api.types import Lease
+
+    gw = _gw()
+    leader = Lease.new("operator-leader")
+    code, _ = gw.handle("PUT", "/api/v1/store/objects", {},
+                        {"obj": leader.to_dict(), "upsert": True},
+                        _hdr("node-secret"))
+    assert code == 403
+    code, _ = gw.handle("DELETE", "/api/v1/store/objects",
+                        {"kind": ["Lease"], "name": ["operator-leader"]},
+                        {}, _hdr("node-secret"))
+    assert code == 403
+    # a node's own heartbeat lease is fine
+    mine = Lease.new("node-n0-heartbeat")
+    code, _ = gw.handle("POST", "/api/v1/store/objects", {},
+                        {"obj": mine.to_dict()}, _hdr("node-secret"))
+    assert code == 201
+
+
+def test_hypervisor_bootstrap_routes_stay_tokenless():
+    """Workload pods must bootstrap (/limiter, /process) without the
+    admin token — handing tenants a token that can freeze/snapshot other
+    tenants' workers would be worse than open node-local discovery."""
+    from tensorfusion_tpu.hypervisor.server import HypervisorServer
+
+    server = HypervisorServer(devices=None, workers=None,
+                              token="hv-secret")
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        # tokenless bootstrap GET reaches the handler (404/500 family,
+        # never 401 — this bare server has no worker controller wired)
+        try:
+            urllib.request.urlopen(f"{base}/limiter?namespace=d&pod=p",
+                                   timeout=10)
+        except urllib.error.HTTPError as e:
+            assert e.code != 401, "bootstrap route must not need a token"
+        # privileged inventory still requires the token
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/api/v1/devices", timeout=10)
+        assert ei.value.code == 401
+    finally:
+        server.stop()
+
+
 def test_admin_and_missing_tokens():
     gw = _gw()
     code, _ = gw.handle("POST", "/api/v1/store/objects", {},
