@@ -89,32 +89,42 @@ class NodeRuntime:
             w.stop()
 
     def _loop(self):
+        while not self._stop.wait(0.05):
+            try:
+                self._tick()
+            except Exception as e:  # noqa: BLE001 - the kubelet role
+                # must survive transient failures (port collisions with
+                # the ephemeral range, racing pod updates) — a dead node
+                # runtime strands every later burst with no diagnosis
+                print(f"node runtime tick failed (retrying): {e}",
+                      file=sys.stderr)
+
+    def _tick(self):
         from tensorfusion_tpu import constants
         from tensorfusion_tpu.api.types import Pod
         from tensorfusion_tpu.remoting import RemoteVTPUWorker
 
-        while not self._stop.wait(0.05):
-            pods = {p.metadata.name: p
-                    for p in self.op.store.list(Pod, namespace="default")
-                    if p.metadata.labels.get(constants.LABEL_COMPONENT)
-                    == constants.COMPONENT_WORKER}
-            for name, pod in pods.items():
-                if name in self.workers or \
-                        pod.status.phase != constants.PHASE_RUNNING:
-                    continue
-                port = int(pod.metadata.annotations.get(
-                    constants.ANN_PORT_NUMBER, "0"))
-                if not port:
-                    continue
-                w = RemoteVTPUWorker(host="127.0.0.1", port=port)
-                w.start()
-                self.workers[name] = w
-                self.live_ports.add(port)
-            for name in list(self.workers):
-                if name not in pods:
-                    w = self.workers.pop(name)
-                    self.live_ports.discard(w.port)
-                    w.stop()
+        pods = {p.metadata.name: p
+                for p in self.op.store.list(Pod, namespace="default")
+                if p.metadata.labels.get(constants.LABEL_COMPONENT)
+                == constants.COMPONENT_WORKER}
+        for name, pod in pods.items():
+            if name in self.workers or \
+                    pod.status.phase != constants.PHASE_RUNNING:
+                continue
+            port = int(pod.metadata.annotations.get(
+                constants.ANN_PORT_NUMBER, "0"))
+            if not port:
+                continue
+            w = RemoteVTPUWorker(host="127.0.0.1", port=port)
+            w.start()
+            self.workers[name] = w
+            self.live_ports.add(port)
+        for name in list(self.workers):
+            if name not in pods:
+                w = self.workers.pop(name)
+                self.live_ports.discard(w.port)
+                w.stop()
 
 
 def _serve_request(url, emb, out, prompt, steps, migrate_at=None):
@@ -299,7 +309,10 @@ def main() -> int:
 
         for i, cname in enumerate(conns):
             migrate = last_burst and i == 0
-            th = threading.Thread(target=run_req, args=(cname, migrate))
+            # daemon: a wedged worker must not hang interpreter exit
+            # after its request is already recorded as timed out
+            th = threading.Thread(target=run_req, args=(cname, migrate),
+                                  daemon=True)
             th.start()
             req_threads.append(th)
         for th in req_threads:

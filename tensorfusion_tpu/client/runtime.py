@@ -98,6 +98,24 @@ class VTPUClient:
                 target=self._live_hbm_loop, args=(live_hbm_interval_s,),
                 name="tpf-live-hbm", daemon=True)
             self._reporter.start()
+        # HBM host-spill contract: a pool with explicit hbm_expand_*
+        # percents admits placements beyond physical HBM, and the
+        # hypervisor stamps the over-physical portion into this env var
+        # (hypervisor/allocation.py).  The CLIENT must keep at least
+        # that many bytes host-resident — host_offload()/offload_for_
+        # spill() are the mechanism (JAX memory kinds).
+        try:
+            self.host_spill_bytes = int(os.environ.get(
+                constants.ENV_HBM_HOST_SPILL, "0") or 0)
+        except ValueError:
+            self.host_spill_bytes = 0
+        self.host_offloaded_bytes = 0
+        if self.host_spill_bytes > 0:
+            log.warning(
+                "placement spills %d bytes past physical HBM: offload at "
+                "least that much with client.offload_for_spill(params) "
+                "or the workload WILL OOM on hardware",
+                self.host_spill_bytes)
 
     # -- live HBM sampling -------------------------------------------------
 
@@ -121,6 +139,10 @@ class VTPUClient:
                 if platform != "cpu" and devs and \
                         all(d.platform == "cpu" for d in devs):
                     continue    # host staging buffer, not HBM
+                kind = getattr(getattr(arr, "sharding", None),
+                               "memory_kind", None)
+                if kind in ("pinned_host", "unpinned_host"):
+                    continue    # host-offloaded (spill contract), not HBM
                 total += int(getattr(arr, "nbytes", 0) or 0)
         except Exception:  # noqa: BLE001 - sampling must never kill
             log.debug("live-array walk failed", exc_info=True)
@@ -138,6 +160,111 @@ class VTPUClient:
     def _live_hbm_loop(self, interval_s: float) -> None:
         while not self._stop_reporter.wait(interval_s):
             self.sample_live_hbm()
+
+    # -- HBM host-spill offload (memory kinds) -------------------------
+
+    _HOST_KINDS = ("pinned_host", "unpinned_host")
+
+    @staticmethod
+    def _rekinded_sharding(arr, kind: str):
+        """The leaf's own sharding with only the memory kind changed —
+        multi-device layouts (NamedSharding across a mesh) are preserved
+        through offload/reload instead of being gathered onto one
+        device."""
+        import jax
+        from jax.sharding import SingleDeviceSharding
+
+        sharding = getattr(arr, "sharding", None)
+        if sharding is not None and hasattr(sharding, "with_memory_kind"):
+            return sharding.with_memory_kind(kind)
+        return SingleDeviceSharding(jax.devices()[0], memory_kind=kind)
+
+    @classmethod
+    def _leaf_kind(cls, leaf):
+        return getattr(getattr(leaf, "sharding", None), "memory_kind",
+                       None)
+
+    def host_offload(self, tree):
+        """Move every device-resident array leaf to host memory
+        (``pinned_host`` memory kind): jitted code consumes it through
+        :meth:`stream_in`, and it no longer occupies HBM.  Leaves that
+        are already host-resident are left (and not double-counted)."""
+        import jax
+
+        def move(leaf):
+            if not hasattr(leaf, "nbytes") or \
+                    self._leaf_kind(leaf) in self._HOST_KINDS:
+                return leaf
+            moved = jax.device_put(
+                leaf, self._rekinded_sharding(leaf, "pinned_host"))
+            self.host_offloaded_bytes += int(leaf.nbytes)
+            return moved
+
+        return jax.tree_util.tree_map(move, tree)
+
+    def device_load(self, tree):
+        """Inverse of :meth:`host_offload`; leaves already on device are
+        left (and the offload accounting untouched)."""
+        import jax
+
+        def move(leaf):
+            if not hasattr(leaf, "nbytes") or \
+                    self._leaf_kind(leaf) not in self._HOST_KINDS:
+                return leaf
+            moved = jax.device_put(
+                leaf, self._rekinded_sharding(leaf, "device"))
+            self.host_offloaded_bytes = max(
+                0, self.host_offloaded_bytes - int(leaf.nbytes))
+            return moved
+
+        return jax.tree_util.tree_map(move, tree)
+
+    def offload_for_spill(self, tree):
+        """Offload the LARGEST leaves of ``tree`` (typically optimizer
+        state or cold params) until the placement's host-spill budget
+        (``TPF_HBM_HOST_SPILL``) is covered; returns the new tree.
+        Idempotent once satisfied."""
+        import jax
+
+        needed = self.host_spill_bytes - self.host_offloaded_bytes
+        if needed <= 0:
+            return tree
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        order = sorted(range(len(leaves)),
+                       key=lambda i: -int(getattr(leaves[i], "nbytes", 0)))
+        moved = 0
+        for i in order:
+            if moved >= needed:
+                break
+            leaf = leaves[i]
+            nbytes = int(getattr(leaf, "nbytes", 0) or 0)
+            # already-host leaves must not re-count: that would satisfy
+            # the budget on paper while HBM stays over physical
+            if nbytes == 0 or self._leaf_kind(leaf) in self._HOST_KINDS:
+                continue
+            leaves[i] = jax.device_put(
+                leaf, self._rekinded_sharding(leaf, "pinned_host"))
+            self.host_offloaded_bytes += nbytes
+            moved += nbytes
+        if moved < needed:
+            log.warning("offload_for_spill covered only %d of %d bytes "
+                        "(tree too small)", moved, needed)
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def spill_satisfied(self) -> bool:
+        """True when at least the placement's over-physical HBM bytes
+        are host-resident."""
+        return self.host_offloaded_bytes >= self.host_spill_bytes
+
+    @staticmethod
+    def stream_in(leaf):
+        """Use INSIDE a jitted function to consume a host-offloaded
+        leaf: inserts an explicit host->device transfer (XLA overlaps it
+        with compute), because memory spaces are part of the array type
+        and ops refuse mixed-space operands."""
+        import jax
+
+        return jax.device_put(leaf, jax.memory.Space.Device)
 
     # -- bootstrap (legacy client endpoints analog) ------------------------
 

@@ -229,5 +229,12 @@ CLUSTER_PORT_RANGE = (42000, 62000)
 # --------------------------------------------------------------------------
 
 DEFAULT_TFLOPS_OVERSELL_PERCENT = 500     # 5x MXU-time oversubscription
-DEFAULT_HBM_EXPAND_HOST_MEM_PERCENT = 50  # spill 50% of host RAM
-DEFAULT_HBM_EXPAND_HOST_DISK_PERCENT = 70 # spill 70% of host disk
+# HBM expansion is OPT-IN, defaulting to no expansion: admitting
+# placements beyond physical HBM is only honest when the client holds up
+# the spill contract (offload TPF_HBM_HOST_SPILL bytes to host memory
+# kinds — client/runtime.py offload_for_spill); a pool that sets these
+# percents explicitly is declaring its workloads do.  (The reference
+# defaults to expansion with an unimplemented vram_trap — we refuse by
+# default instead of silently OOMing, docs/annotations.md.)
+DEFAULT_HBM_EXPAND_HOST_MEM_PERCENT = 0
+DEFAULT_HBM_EXPAND_HOST_DISK_PERCENT = 0

@@ -281,9 +281,20 @@ def test_e2e_expander_scales_from_capacity_miss(op):
     pod = make_client_pod("big-1", tflops="150", hbm="14Gi",
                           extra={constants.ANN_CHIP_COUNT: "8",
                                  constants.ANN_CHIP_GENERATION: "v5e"})
+    # HBM expansion is opt-in now (spill contract): enable it on the
+    # pool so the filler below can overfill host-0 past physical HBM
+    pool = op.store.get(TPUPool, "pool-a")
+    pool.spec.capacity_config.hbm_expand_to_host_mem_percent = 50
+    pool.spec.capacity_config.hbm_expand_to_host_disk_percent = 70
+    op.store.update(pool)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if any(s.hbm_expand_ratio > 1.0 for s in op.allocator.chips()):
+            break
+        time.sleep(0.05)
     # 8 chips x 14 GiB: fits on an 8-chip host only when mostly empty;
     # first fill the current host past even its host-EXPANDED HBM budget
-    # (16 GiB * 2.2 default expansion = 35.2 GiB/chip) so it can't fit
+    # (16 GiB * 2.2 expansion = 35.2 GiB/chip) so it can't fit
     filler = make_client_pod("filler", tflops="100", hbm="25Gi")
     op.submit_pod(filler)
     assert op.wait_for_binding("filler")
