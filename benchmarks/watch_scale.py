@@ -1,0 +1,188 @@
+"""Watch + metrics-ring fan-out scale microbench.
+
+The reference gets apiserver scalability for free; tpu-fusion's store
+gateway serves the long-poll watches and the hypervisor metrics ring
+itself, so this bench pins the cost curve (VERDICT r4 #7): write
+throughput and event-delivery lag as the number of concurrent watchers
+grows, while a fleet of simulated hypervisors pushes metrics.
+
+Per watcher-count step:
+- ``watchers`` threads long-poll ``GET /api/v1/store/watch`` over real
+  HTTP against a StateStoreServer;
+- 50 simulated hypervisors POST influx lines (10 lines every 100 ms —
+  a real node's cadence);
+- a writer hammers Pod updates (the scheduling-churn shape) for a fixed
+  window; we record writes/s, p95 watcher lag (write -> event seen), and
+  metrics push p95.
+
+Prints ONE JSON line with the watchers-vs-throughput curve and persists
+``benchmarks/results/watch_scale.json``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+sys.path.insert(0, ".")
+
+try:
+    from benchmarks._artifact import write_artifact
+except ImportError:
+    from _artifact import write_artifact
+
+
+def run_step(server_url: str, watchers: int, pushers: int,
+             window_s: float, store):
+    """One point on the curve; returns the metrics dict."""
+    import urllib.request
+
+    from tensorfusion_tpu.api.types import Pod
+    from tensorfusion_tpu.metrics.encoder import encode_line
+    from tensorfusion_tpu.remote_store import RemoteStore
+
+    stop = threading.Event()
+    lags = []
+    lag_lock = threading.Lock()
+
+    def watcher_loop():
+        # raw long-poll loop (the RemoteStore informer's wire shape)
+        rv = 0
+        primed = 0
+        while not stop.is_set():
+            url = (f"{server_url}/api/v1/store/watch?since_rv={rv}"
+                   f"&kinds=Pod&wait_s=1.0&primed={primed}&replay=0")
+            try:
+                with urllib.request.urlopen(url, timeout=10) as r:
+                    payload = json.loads(r.read())
+            except Exception:  # noqa: BLE001 - shutdown race
+                continue
+            primed = 1
+            rv = int(payload.get("rv", rv))
+            now = time.perf_counter()
+            for ev in payload.get("events", []):
+                stamp = (ev.get("obj") or {}).get(
+                    "metadata", {}).get("annotations", {}).get("t0")
+                if stamp:
+                    with lag_lock:
+                        lags.append(now - float(stamp))
+
+    def pusher_loop(idx: int):
+        rs = RemoteStore(server_url, timeout_s=10)
+        push_times = []
+        while not stop.is_set():
+            lines = [encode_line(
+                "tpf_chip", {"node": f"n{idx}", "chip": f"c{j}"},
+                {"duty_cycle_pct": 50.0}) for j in range(10)]
+            t0 = time.perf_counter()
+            try:
+                rs.push_metrics(lines)
+                push_times.append(time.perf_counter() - t0)
+            except Exception:  # noqa: BLE001 - shutdown race
+                pass
+            stop.wait(0.1)
+        push_samples.extend(push_times)
+
+    push_samples: list = []
+    threads = [threading.Thread(target=watcher_loop, daemon=True)
+               for _ in range(watchers)]
+    threads += [threading.Thread(target=pusher_loop, args=(i,),
+                                 daemon=True)
+                for i in range(pushers)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)                       # let watchers park
+
+    # writer: pod churn through the in-process store (the gateway's
+    # event fan-out cost is identical either way; HTTP writes would
+    # bottleneck on the single writer's socket, not the fan-out)
+    pod = Pod.new("churn", namespace="default")
+    store.create(pod)
+    writes = 0
+    t_end = time.perf_counter() + window_s
+    while time.perf_counter() < t_end:
+        pod.metadata.annotations["t0"] = repr(time.perf_counter())
+        pod = store.update(pod)
+        writes += 1
+    writes_per_s = writes / window_s
+    time.sleep(1.2)                       # drain last long-polls
+    stop.set()
+    for t in threads:
+        t.join(timeout=3)
+
+    def pct(xs, q):
+        if not xs:
+            return None
+        xs = sorted(xs)
+        return round(xs[min(int(q * len(xs)), len(xs) - 1)] * 1e3, 2)
+
+    store.delete(Pod, "churn", "default")
+    return {"watchers": watchers,
+            "writes_per_s": round(writes_per_s, 1),
+            "events_delivered": len(lags),
+            "watch_lag_p50_ms": pct(lags, 0.50),
+            "watch_lag_p95_ms": pct(lags, 0.95),
+            "metrics_push_p95_ms": pct(push_samples, 0.95),
+            "metrics_pushes": len(push_samples)}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--watcher-steps", default="0,10,50,100,200")
+    ap.add_argument("--pushers", type=int, default=50)
+    ap.add_argument("--window-s", type=float, default=3.0)
+    args = ap.parse_args()
+
+    from tensorfusion_tpu.statestore import StateStoreServer
+    from tensorfusion_tpu.store import ObjectStore
+
+    store = ObjectStore()
+    server = StateStoreServer(store)
+    server.start()
+    curve = []
+    try:
+        for n in (int(x) for x in args.watcher_steps.split(",")):
+            curve.append(run_step(server.url, n, args.pushers,
+                                  args.window_s, store))
+            print(f"# {curve[-1]}", file=sys.stderr)
+    finally:
+        server.stop()
+
+    # scaling verdict: writes/s at max watchers vs the best point on the
+    # curve (single measurements on a shared box are noisy — the max is
+    # the stable reference; a superlinear fan-out would crater this)
+    base = max(c["writes_per_s"] for c in curve)
+    worst = curve[-1]
+    retention = round(worst["writes_per_s"] / max(base, 1e-9) * 100.0, 1)
+    # the superlinearity check: how writes/s scales across the upper
+    # half of the watcher range (a superlinear fan-out would crater
+    # this; serialize-once keeps it near flat — the plateau is the
+    # evidence, the idle->first-step drop is just the GIL share)
+    upper = [c for c in curve if c["watchers"] > 0]
+    plateau = None
+    if len(upper) >= 2:
+        # last vs FIRST non-zero step: the watcher count multiplies
+        # several-fold across the range, so a superlinear fan-out would
+        # collapse this ratio; near-flat is the serialize-once signature
+        plateau = round(upper[-1]["writes_per_s"]
+                        / max(upper[0]["writes_per_s"], 1e-9) * 100.0, 1)
+    result = {
+        "metric": "watch_scale_write_retention_pct",
+        "value": retention,
+        "unit": "%",
+        "vs_baseline": round(retention / 100.0, 3),
+        "plateau_upper_half_pct": plateau,
+        "curve": curve,
+        "pushers": args.pushers,
+        "window_s": args.window_s,
+    }
+    write_artifact("watch_scale", result)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

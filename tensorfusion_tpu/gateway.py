@@ -73,6 +73,12 @@ KIND_BY_NAME: Dict[str, Type[Resource]] = {c.KIND: c for c in ALL_KINDS}
 #: pinning forever on dead connections)
 MAX_WATCH_WAIT_S = 30.0
 
+
+class RawJson(str):
+    """A payload that is ALREADY serialized JSON: HTTP hosts must send it
+    verbatim instead of json.dumps-ing it again.  Carries the watch
+    fan-out's serialize-once optimization through to the wire."""
+
 #: kinds a ``node``-role token may write: what a hypervisor legitimately
 #: registers/updates about its own host (everything else is operator
 #: state — quotas, pools, workloads — and needs ``admin``)
@@ -347,12 +353,13 @@ class StoreGateway:
                          "events": [{"type": etype, "kind": kind,
                                      "obj": obj}
                                     for etype, kind, obj in snapshot]}
-        rv, events, reset = self.store.events_since(since_rv, kinds,
-                                                    wait_s=wait_s)
-        return 200, {"rv": rv, "reset": reset,
-                     "events": [{"type": etype, "kind": kind, "rv": erv,
-                                 "obj": obj}
-                                for etype, kind, erv, obj in events]}
+        rv, frags, reset = self.store.events_since(since_rv, kinds,
+                                                   wait_s=wait_s,
+                                                   serialized=True)
+        reset_s = "true" if reset else "false"
+        return 200, RawJson(
+            '{"rv":%d,"reset":%s,"events":[%s]}'
+            % (rv, reset_s, ",".join(frags)))
 
     # -- metrics shipping --------------------------------------------------
 

@@ -29,7 +29,7 @@ from urllib.parse import parse_qs, urlparse
 
 from ..api.meta import from_dict
 from ..api.types import Pod, TPUConnection
-from ..gateway import StoreGateway
+from ..gateway import RawJson, StoreGateway
 from ..scheduler.tpuresources import compose_alloc_request
 from ..store import ObjectStore
 from ..webhook.parser import ParseError
@@ -78,7 +78,8 @@ class OperatorServer:
                 log.debug(fmt, *args)
 
             def _send(self, code, payload):
-                body = json.dumps(_jsonable(payload)).encode()
+                body = payload.encode() if isinstance(payload, RawJson) \
+                    else json.dumps(_jsonable(payload)).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))

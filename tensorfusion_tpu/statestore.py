@@ -24,7 +24,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
-from .gateway import StoreGateway
+from .gateway import RawJson, StoreGateway
 from .store import ObjectStore
 
 log = logging.getLogger("tpf.statestore")
@@ -49,7 +49,8 @@ class StateStoreServer:
                 log.debug(fmt, *args)
 
             def _send(self, code, payload):
-                body = json.dumps(payload).encode()
+                body = payload.encode() if isinstance(payload, RawJson) \
+                    else json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))

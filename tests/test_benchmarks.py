@@ -101,3 +101,27 @@ def test_burst_serving_scenario_fast():
     assert all(w is not None for w in
                result["wake_from_zero_ms"]["per_burst"])
     assert result["value"] >= 50.0          # SLO hit rate, noisy CI box
+
+
+def test_watch_scale_fast():
+    """Watch fan-out scale (compressed): many long-poll watchers + metric
+    pushers against the gateway while a writer churns pods — events
+    deliver, writes keep flowing, and the upper-half scaling stays far
+    from superlinear collapse."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               TPF_BENCH_RESULTS_DIR="/tmp/tpf-smoke-results")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    out = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "benchmarks" / "watch_scale.py"),
+         "--watcher-steps", "0,8,24", "--pushers", "10",
+         "--window-s", "1.5"],
+        capture_output=True, text=True, env=env, cwd=str(REPO_ROOT),
+        timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    by_n = {c["watchers"]: c for c in result["curve"]}
+    assert by_n[24]["events_delivered"] > 0
+    assert by_n[24]["writes_per_s"] > 0
+    # 3x the watchers must cost far less than 3x the throughput
+    # (superlinear fan-out would); generous floor for a noisy CI box
+    assert result["plateau_upper_half_pct"] >= 25.0
