@@ -42,11 +42,18 @@ import time
 REPO = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
-DIM = 4096
-NMM = 4                                  # matmuls per chunk
+# env overrides exist for hardware-free smoke runs of these code paths
+DIM = int(os.environ.get("TPF_MT_DIM", "4096"))
+# Matmuls per chunk: each chunk is ONE wire dispatch through the tunnel
+# relay, and the relay caps dispatches/s — r3 measured the 4-matmul
+# chunk ceiling at 63.9% of datasheet because ~230 dispatches/s
+# saturated the relay before the MXU did.  16 matmuls per dispatch
+# (2.2 TFLOP/chunk) needs ~4x fewer wire messages for the same FLOPs;
+# the --probe mode below measures both sides of that tradeoff.
+NMM = int(os.environ.get("TPF_MT_NMM", "16"))
 CHUNK_MFLOP = NMM * 2 * DIM**3 // 10**6  # analytic cost of one chunk
 DEPTH = 32                               # dispatch-ahead bound (chunks)
-SYNC_EVERY = 16                          # consumer fetches every Nth scalar
+SYNC_EVERY = 64                          # consumer fetches every Nth scalar
 CONTRACT_DUTY_BP = 4000                  # 40% of ceiling per tenant
 TENANTS = [("t-low", "low"), ("t-med", "medium"),
            ("t-high", "high"), ("t-crit", "critical")]
@@ -181,6 +188,68 @@ def _spawn_tenant(out, ready, start, run_s, shm_path="", limiter_lib="",
     return subprocess.Popen(cmd, cwd=str(REPO))
 
 
+def probe_main(args) -> int:
+    """Relay-vs-device breakdown (VERDICT r4 #5: prove what caps the
+    ceiling).  Measures, in one tunnel session:
+
+    - dispatch_rate_per_s: async launches/s of a TRIVIAL program (pure
+      wire/dispatch cost — the relay's ceiling on chunks/s);
+    - chunk_ms: device time per full-size chunk (deep-pipelined);
+
+    predicted ceiling = min(dispatch_rate * CHUNK_MFLOP,
+    CHUNK_MFLOP / chunk_ms) — whichever side binds."""
+    import jax
+    import jax.numpy as jnp
+
+    tiny = jax.jit(lambda x: x * jnp.bfloat16(1.0))
+    xt = jnp.zeros((8, 128), jnp.bfloat16)
+    jax.block_until_ready(tiny(xt))
+    n = 0
+    t0 = time.monotonic()
+    last = None
+    while time.monotonic() - t0 < 3.0:
+        last = tiny(xt)
+        n += 1
+    jax.block_until_ready(last)
+    dispatch_rate = n / (time.monotonic() - t0)
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (DIM, DIM),
+                          dtype=jnp.bfloat16)
+
+    def chunk(v):
+        y = v
+        for _ in range(NMM):
+            y = (y @ y) * jnp.bfloat16(1.0 / DIM)
+        return jnp.sum(y)
+
+    fn = jax.jit(chunk)
+    float(fn(x))
+    n = 0
+    t0 = time.monotonic()
+    pending = []
+    while time.monotonic() - t0 < 5.0:
+        pending.append(fn(x))
+        n += 1
+        if len(pending) >= DEPTH:
+            float(pending.pop(0))
+    for s in pending:
+        float(s)
+    elapsed = time.monotonic() - t0
+    chunk_ms = elapsed / n * 1e3
+    relay_cap = dispatch_rate * CHUNK_MFLOP / 1e6
+    device_cap = CHUNK_MFLOP / 1e6 / (chunk_ms / 1e3)
+    out = {"dispatch_rate_per_s": round(dispatch_rate, 1),
+           "chunk_ms": round(chunk_ms, 2),
+           "relay_cap_tflops": round(relay_cap, 1),
+           "device_cap_tflops": round(device_cap, 1),
+           "binding_side": "relay" if relay_cap < device_cap
+           else "device"}
+    with open(args.out, "w") as f:
+        json.dump(out, f)
+    print(json.dumps(out), file=sys.stderr)
+    return 0
+
+
 def _measure_ceiling(workdir: str) -> float:
     """MFLOP/s one unmetered tenant achieves (the honest 100%)."""
     out = os.path.join(workdir, "ceiling.json")
@@ -207,6 +276,7 @@ def _wait_file(path, timeout_s, proc=None):
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tenant", action="store_true")
+    ap.add_argument("--probe", action="store_true")
     ap.add_argument("--unmetered", action="store_true")
     ap.add_argument("--out")
     ap.add_argument("--ready-file")
@@ -217,6 +287,8 @@ def main() -> int:
     args = ap.parse_args()
     if args.tenant:
         return tenant_main(args)
+    if args.probe:
+        return probe_main(args)
 
     from tensorfusion_tpu.config.chip_info import CHIP_INFO_DB
     from tensorfusion_tpu.hypervisor import DeviceQuota, Limiter, ShmView
@@ -227,6 +299,21 @@ def main() -> int:
     limiter_lib = str(build / "libtpf_limiter.so")
     workdir = tempfile.mkdtemp(prefix="tpf_mt_tpu_")
     shm_base = os.path.join(workdir, "shm")
+
+    print("probing relay-vs-device breakdown...", file=sys.stderr)
+    probe_out = os.path.join(workdir, "probe.json")
+    breakdown = {}
+    pp = subprocess.Popen([sys.executable, os.path.abspath(__file__),
+                           "--probe", "--out", probe_out], cwd=str(REPO))
+    try:
+        pp.wait(timeout=300)
+        with open(probe_out) as f:
+            breakdown = json.load(f)
+    except Exception as e:  # noqa: BLE001 - the breakdown is advisory;
+        # a hung/truncated probe must not abort the whole hardware bench
+        pp.kill()
+        print(f"breakdown probe failed (continuing): {e}",
+              file=sys.stderr)
 
     print("measuring single-tenant ceiling...", file=sys.stderr)
     ceiling_mflops_s = _measure_ceiling(workdir)
@@ -342,6 +429,9 @@ def main() -> int:
         "ceiling_tflops": round(ceiling_mflops_s / 1e6, 2),
         "ceiling_vs_datasheet_pct": round(
             ceiling_mflops_s / datasheet_mflops_s * 100, 1),
+        "breakdown": breakdown,
+        "chunk_mflop": CHUNK_MFLOP,
+        "sync_every": SYNC_EVERY,
         "aggregate_vs_datasheet_pct": round(
             min(agg_a, agg_b) * ceiling_mflops_s / datasheet_mflops_s, 2),
         "phase_a_all_hungry": {"aggregate_duty_pct": round(agg_a, 2),
