@@ -244,8 +244,9 @@ def probe_main(args) -> int:
            "device_cap_tflops": round(device_cap, 1),
            "binding_side": "relay" if relay_cap < device_cap
            else "device"}
-    with open(args.out, "w") as f:
-        json.dump(out, f)
+    if args.out:                     # standalone --probe runs may omit it
+        with open(args.out, "w") as f:
+            json.dump(out, f)
     print(json.dumps(out), file=sys.stderr)
     return 0
 

@@ -55,11 +55,14 @@
 #include <zlib.h>
 
 #include <atomic>
+#include <condition_variable>
 #include <map>
 #include <memory>
 #include <utility>
 #include <mutex>
+#include <set>
 #include <string>
+#include <thread>
 #include <vector>
 
 #include "xla/pjrt/c/pjrt_c_api.h"
@@ -316,13 +319,96 @@ struct WireBuffer {
   std::vector<uint8_t> data;
 };
 
+/* Pipelined connection: a dedicated reader thread matches replies to
+ * requests by seq, so callers can either wait for their reply (rpc) or
+ * fire-and-forget (send_async — used by Execute/FREE: requests on one
+ * connection run in order on the worker, so a client-assigned result id
+ * is referenceable the moment the EXECUTE bytes are on the wire; the
+ * dispatch path never pays a round trip).  An ERROR reply to an async
+ * request is remembered and surfaced by the next synchronous call. */
 class Conn {
  public:
   int fd = -1;
-  std::mutex mu;
+  std::mutex send_mu;                /* serializes writers */
+  std::mutex state_mu;               /* seq/replies/async bookkeeping */
+  std::condition_variable cv;
   uint64_t seq = 0;
 
-  ~Conn() { if (fd >= 0) close(fd); }
+  struct Reply {
+    std::string kind;
+    JVal meta;
+    std::vector<WireBuffer> bufs;
+  };
+  std::map<uint64_t, Reply> replies; /* sync seqs awaiting pickup */
+  std::set<uint64_t> async_seqs;     /* fire-and-forget seqs in flight */
+  std::string async_error;           /* first async ERROR, sticky */
+  bool dead = false;
+  std::string dead_reason;
+  std::thread reader;
+
+  ~Conn() {
+    {
+      std::lock_guard<std::mutex> l(state_mu);
+      dead = true;
+      if (dead_reason.empty()) dead_reason = "connection closed";
+    }
+    if (fd >= 0) shutdown(fd, SHUT_RDWR);
+    cv.notify_all();
+    if (reader.joinable()) reader.join();
+    if (fd >= 0) close(fd);
+  }
+
+  void start_reader() {
+    reader = std::thread([this] { this->read_loop(); });
+  }
+
+  void mark_dead(const std::string& why) {
+    std::lock_guard<std::mutex> l(state_mu);
+    if (!dead) {
+      dead = true;
+      dead_reason = why;
+    }
+    cv.notify_all();
+  }
+
+  void read_loop() {
+    while (true) {
+      std::string kind, err;
+      JVal meta;
+      std::vector<WireBuffer> bufs;
+      if (!recv_one(&kind, &meta, &bufs, &err)) {
+        mark_dead("tpf remote transport: " + err);
+        return;
+      }
+      uint64_t s = (uint64_t)meta.at("seq").as_int();
+      std::lock_guard<std::mutex> l(state_mu);
+      /* quiet executes never get a success reply; the worker processes
+       * requests in order, so any reply with seq >= s retires every
+       * pending async seq <= s (keeps the set bounded) */
+      bool was_async = async_seqs.count(s) != 0;
+      async_seqs.erase(async_seqs.begin(), async_seqs.upper_bound(s));
+      if (was_async) {
+        if (kind == "ERROR" && async_error.empty())
+          async_error = meta.at("error").str;
+        continue;                    /* fire-and-forget: reply dropped */
+      }
+      Reply r;
+      r.kind = std::move(kind);
+      r.meta = std::move(meta);
+      r.bufs = std::move(bufs);
+      replies.emplace(s, std::move(r));
+      cv.notify_all();
+    }
+  }
+
+  /* sticky async failure, surfaced at the next sync boundary */
+  bool take_async_error(std::string* out) {
+    std::lock_guard<std::mutex> l(state_mu);
+    if (async_error.empty()) return false;
+    *out = async_error;
+    async_error.clear();
+    return true;
+  }
 
   bool connect_to(const std::string& host, int port, std::string* err) {
     struct addrinfo hints;
@@ -376,17 +462,26 @@ class Conn {
     return true;
   }
 
-  /* One synchronous RPC.  meta_json: the inner fields of the meta object
-   * ("k":v,... without braces, may be empty).  Caller holds no lock. */
-  bool rpc(const std::string& kind, const std::string& meta_json,
-           const std::vector<std::pair<const WireBuffer*, const void*>>&
-               send_bufs,
-           std::string* rkind, JVal* rmeta,
-           std::vector<WireBuffer>* rbufs, std::string* err) {
-    std::lock_guard<std::mutex> lock(mu);
-    ++seq;
-    /* header */
-    std::string meta = "{\"seq\":" + std::to_string(seq);
+  /* Write one frame; returns its seq via *out_seq.  ``async_fire``
+   * registers the seq as fire-and-forget BEFORE the bytes go out, so
+   * the reader can never see the reply unregistered. */
+  bool send_msg(const std::string& kind, const std::string& meta_json,
+                const std::vector<std::pair<const WireBuffer*,
+                                            const void*>>& send_bufs,
+                bool async_fire, uint64_t* out_seq, std::string* err) {
+    std::lock_guard<std::mutex> lock(send_mu);
+    uint64_t s;
+    {
+      std::lock_guard<std::mutex> l2(state_mu);
+      if (dead) {
+        *err = dead_reason;
+        return false;
+      }
+      s = ++seq;
+      if (async_fire) async_seqs.insert(s);
+    }
+    *out_seq = s;
+    std::string meta = "{\"seq\":" + std::to_string(s);
     if (!meta_json.empty()) meta += "," + meta_json;
     meta += "}";
     std::string bufdesc = "[";
@@ -414,13 +509,47 @@ class Conn {
     uint32_t ver = 2, hlen = (uint32_t)header.size();
     memcpy(head + 4, &ver, 4);          /* little-endian hosts only */
     memcpy(head + 8, &hlen, 4);
-    if (!send_all(head, 12, err)) return false;
-    if (!send_all(header.data(), header.size(), err)) return false;
-    for (const auto& sb : send_bufs) {
+    bool ok = send_all(head, 12, err) &&
+              send_all(header.data(), header.size(), err);
+    for (size_t i = 0; ok && i < send_bufs.size(); ++i) {
+      const auto& sb = send_bufs[i];
       const void* data = sb.second ? sb.second : sb.first->data.data();
-      if (!send_all(data, sb.first->data.size(), err)) return false;
+      ok = send_all(data, sb.first->data.size(), err);
     }
-    return recv_one(rkind, rmeta, rbufs, err);
+    if (!ok) mark_dead("tpf remote transport: " + *err);
+    return ok;
+  }
+
+  /* One synchronous RPC (send, then wait for this seq's reply). */
+  bool rpc(const std::string& kind, const std::string& meta_json,
+           const std::vector<std::pair<const WireBuffer*, const void*>>&
+               send_bufs,
+           std::string* rkind, JVal* rmeta,
+           std::vector<WireBuffer>* rbufs, std::string* err) {
+    uint64_t s = 0;
+    if (!send_msg(kind, meta_json, send_bufs, false, &s, err))
+      return false;
+    std::unique_lock<std::mutex> l(state_mu);
+    cv.wait(l, [&] { return dead || replies.count(s) != 0; });
+    auto it = replies.find(s);
+    if (it == replies.end()) {
+      *err = dead_reason;
+      return false;
+    }
+    *rkind = std::move(it->second.kind);
+    *rmeta = std::move(it->second.meta);
+    *rbufs = std::move(it->second.bufs);
+    replies.erase(it);
+    return true;
+  }
+
+  /* Fire-and-forget (Execute/FREE): no round trip on the caller. */
+  bool send_async(const std::string& kind, const std::string& meta_json,
+                  const std::vector<std::pair<const WireBuffer*,
+                                              const void*>>& send_bufs,
+                  std::string* err) {
+    uint64_t s = 0;
+    return send_msg(kind, meta_json, send_bufs, true, &s, err);
   }
 
   bool recv_one(std::string* rkind, JVal* rmeta,
@@ -504,6 +633,7 @@ struct TpfClient {
   std::string platform_version = "tpf-remote-1";
   std::vector<TpfDevice*> devices;   /* exactly one in v1 */
   std::vector<TpfMemory*> memories;
+  std::atomic<uint64_t> result_ctr{0};   /* client-minted result ids */
 
   ~TpfClient() {
     for (auto* d : devices) delete d;
@@ -599,6 +729,11 @@ PJRT_Error* do_rpc(TpfClient* c, const std::string& kind,
                    const std::vector<std::pair<const WireBuffer*,
                                                const void*>>& send_bufs,
                    JVal* rmeta, std::vector<WireBuffer>* rbufs) {
+  /* a failed pipelined Execute/FREE surfaces at the next sync
+   * boundary, attributed as such */
+  std::string aerr;
+  if (c->conn.take_async_error(&aerr))
+    return make_error("tpf remote worker (pipelined request): " + aerr);
   std::string rkind, err;
   if (!c->conn.rpc(kind, meta_json, send_bufs, &rkind, rmeta, rbufs,
                    &err))
@@ -697,6 +832,7 @@ PJRT_Error* tpf_Client_Create(PJRT_Client_Create_Args* args) {
     delete c;
     return make_error("tpf remote: " + err, PJRT_Error_Code_UNAVAILABLE);
   }
+  c->conn.start_reader();
   /* HELLO handshake (always sent; worker no-ops it when auth is off) */
   const char* token = getenv("TPF_REMOTING_TOKEN");
   std::string hello_meta = "\"token\":";
@@ -1168,41 +1304,48 @@ PJRT_Error* tpf_LoadedExecutable_Execute(
                       "got " + std::to_string(args->num_devices),
                       PJRT_Error_Code_UNIMPLEMENTED);
 
+  /* surface any earlier pipelined failure before queueing more work */
+  std::string aerr;
+  if (c->conn.take_async_error(&aerr))
+    return make_error("tpf remote worker (pipelined request): " + aerr);
+
+  /* PIPELINED execute: result ids are minted client-side and the
+   * request is fire-and-forget — the worker processes requests on this
+   * connection in order, so the next Execute/FETCH referencing these
+   * ids is correct without ever waiting for a round trip.  Output
+   * shapes/dtypes come from the executable's compile-time signature. */
+  uint64_t ctr = c->result_ctr.fetch_add(1) + 1;
   std::string meta = "\"exe_id\":";
   json_escape(exe->exe_id, &meta);
-  meta += ",\"keep_results\":true,\"arg_refs\":[";
+  meta += ",\"keep_results\":true,\"quiet\":true,\"arg_refs\":[";
   for (size_t i = 0; i < args->num_args; ++i) {
     auto* buf = AS_BUF(args->argument_lists[0][i]);
     if (i) meta += ",";
     json_escape(buf->buf_id, &meta);
   }
+  meta += "],\"result_ids\":[";
+  std::vector<std::string> ids;
+  ids.reserve(exe->num_outputs);
+  for (size_t o = 0; o < exe->num_outputs; ++o) {
+    ids.push_back("c-" + std::to_string(ctr) + "-" + std::to_string(o));
+    if (o) meta += ",";
+    json_escape(ids.back(), &meta);
+  }
   meta += "]";
 
-  JVal rmeta;
-  std::vector<WireBuffer> rbufs;
-  PJRT_Error* err = do_rpc(c, "EXECUTE", meta, {}, &rmeta, &rbufs);
-  if (err != nullptr) return err;
+  std::string err;
+  if (!c->conn.send_async("EXECUTE", meta, {}, &err))
+    return make_error("tpf remote transport: " + err,
+                      PJRT_Error_Code_UNAVAILABLE);
 
-  const JVal& refs = rmeta.at("result_refs");
-  const JVal& shapes = rmeta.at("shapes");
-  const JVal& dtypes = rmeta.at("dtypes");
-  if (refs.arr.size() != exe->num_outputs)
-    return make_error("worker returned " +
-                      std::to_string(refs.arr.size()) + " results, "
-                      "executable declares " +
-                      std::to_string(exe->num_outputs));
   if (args->output_lists != nullptr) {
-    for (size_t o = 0; o < refs.arr.size(); ++o) {
+    for (size_t o = 0; o < exe->num_outputs; ++o) {
       auto* buf = new TpfBuffer();
       buf->client = c;
       buf->device = c->devices[0];
-      buf->buf_id = refs.arr[o].str;
-      for (const JVal& d : shapes.arr[o].arr)
-        buf->dims.push_back(d.as_int());
-      const DtypeInfo* info = dtype_by_wire(dtypes.arr[o].str);
-      /* dtype strings come from jax arrays worker-side ("bfloat16",
-       * "float32", ...) and match the wire names */
-      buf->dtype = info != nullptr ? info : exe->out_dtypes[o];
+      buf->buf_id = ids[o];
+      buf->dims = exe->out_dims[o];
+      buf->dtype = exe->out_dtypes[o];
       buf->finalize_strides();
       args->output_lists[0][o] = reinterpret_cast<PJRT_Buffer*>(buf);
     }
@@ -1273,23 +1416,21 @@ PJRT_Error* tpf_Client_BufferFromHostBuffer(
   return nullptr;
 }
 
+void free_remote_buffer(TpfBuffer* buf) {
+  /* fire-and-forget: deletion failure is benign (worker state dies
+   * with the connection) and must never cost the caller a round trip */
+  std::string meta = "\"buf_ids\":[";
+  json_escape(buf->buf_id, &meta);
+  meta += "]";
+  std::string err;
+  buf->client->conn.send_async("FREE", meta, {}, &err);
+}
+
 PJRT_Error* tpf_Buffer_Destroy(PJRT_Buffer_Destroy_Args* args) {
   TPF_TRACE();
   auto* buf = AS_BUF(args->buffer);
-  if (!buf->deleted && g_client == buf->client) {
-    std::string meta = "\"buf_ids\":[";
-    json_escape(buf->buf_id, &meta);
-    meta += "]";
-    JVal rmeta;
-    std::vector<WireBuffer> rbufs;
-    PJRT_Error* err = do_rpc(buf->client, "FREE", meta, {}, &rmeta,
-                             &rbufs);
-    if (err != nullptr) {
-      /* free-after-close is benign: the worker's state died with the
-       * connection */
-      delete reinterpret_cast<TpfError*>(err);
-    }
-  }
+  if (!buf->deleted && g_client == buf->client)
+    free_remote_buffer(buf);
   delete buf;
   return nullptr;
 }
@@ -1299,14 +1440,7 @@ PJRT_Error* tpf_Buffer_Delete(PJRT_Buffer_Delete_Args* args) {
   auto* buf = AS_BUF(args->buffer);
   if (!buf->deleted) {
     buf->deleted = true;
-    std::string meta = "\"buf_ids\":[";
-    json_escape(buf->buf_id, &meta);
-    meta += "]";
-    JVal rmeta;
-    std::vector<WireBuffer> rbufs;
-    PJRT_Error* err = do_rpc(buf->client, "FREE", meta, {}, &rmeta,
-                             &rbufs);
-    if (err != nullptr) delete reinterpret_cast<TpfError*>(err);
+    if (g_client == buf->client) free_remote_buffer(buf);
   }
   return nullptr;
 }

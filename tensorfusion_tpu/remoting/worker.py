@@ -87,6 +87,7 @@ class RemoteVTPUWorker:
         self._exe_sigs: Dict[str, list] = {}
         self._buffers: Dict[str, object] = {}    # device-resident arrays
         self._buf_seq = 0
+        self._conn_seq = 0            # per-connection id namespaces
         self._lock = threading.Lock()
         #: per-exe_id in-flight compile locks (COMPILE_MLIR single-flight)
         self._compile_flights: Dict[str, threading.Lock] = {}
@@ -110,6 +111,28 @@ class RemoteVTPUWorker:
                         return
                 except (ConnectionError, OSError, ValueError):
                     return
+                # Client-minted buffer ids ("c-..." — the transparent
+                # plugin's pipelining) live in a PER-CONNECTION namespace:
+                # two clients both minting "c-1-0" must never collide in
+                # the worker-global buffer table, so every "c-" id in a
+                # request is rewritten to "cn<conn>:<id>" before dispatch.
+                with outer._lock:
+                    outer._conn_seq += 1
+                    conn_ns = f"cn{outer._conn_seq}:"
+
+                def xid(i):
+                    return conn_ns + i if isinstance(i, str) and \
+                        i.startswith("c-") else i
+
+                def remap_ids(meta):
+                    for key in ("buf_id",):
+                        if key in meta:
+                            meta[key] = xid(meta[key])
+                    for key in ("buf_ids", "arg_refs", "result_ids"):
+                        if meta.get(key) is not None:
+                            meta[key] = [xid(v) for v in meta[key]]
+                    meta["_conn_ns"] = conn_ns
+                    return meta
                 # Read-ahead: decode the next pipelined request while the
                 # current one computes, so inbound wire time overlaps
                 # device time.  (A symmetric write-behind thread was tried
@@ -161,7 +184,8 @@ class RemoteVTPUWorker:
                             continue
                         deferred = None
                         try:
-                            deferred = outer._dispatch(reply, kind, meta,
+                            deferred = outer._dispatch(reply, kind,
+                                                       remap_ids(meta),
                                                        buffers)
                         except Exception as e:  # noqa: BLE001
                             log.exception("remote %s failed", kind)
@@ -528,7 +552,28 @@ class RemoteVTPUWorker:
                 leaves = jax.tree_util.tree_leaves(out)
             self.executions += 1
             if meta.get("keep_results"):
-                # park results device-side, hand back references
+                # park results device-side, hand back references.  A
+                # client may pre-assign result ids ("c-..." namespace, the
+                # transparent plugin's pipelining: it mints buffer handles
+                # WITHOUT waiting for this reply, because requests on one
+                # connection execute in order) — ids it chose can be
+                # referenced by its very next EXECUTE already.
+                want_ids = meta.get("result_ids")
+                if want_ids is not None:
+                    if len(want_ids) != len(leaves):
+                        reply("ERROR", {"error": f"result_ids count "
+                                                 f"{len(want_ids)} != "
+                                                 f"{len(leaves)} results"},
+                              [])
+                        return
+                    ns = meta.get("_conn_ns", "")
+                    if not all(str(i).startswith(ns) for i in want_ids):
+                        # only ids the connection-namespace remap produced
+                        # are accepted — a raw id could clobber another
+                        # client's (or worker-minted) buffer
+                        reply("ERROR", {"error": "result_ids must be "
+                                                 "c-namespace ids"}, [])
+                        return
                 with self._lock:
                     total = sum(self._leaf_nbytes(l) for l in leaves)
                     err = self._admit_resident(total)
@@ -536,13 +581,21 @@ class RemoteVTPUWorker:
                         reply("ERROR", {"error": err}, [])
                         return
                     ids, shapes, dtypes = [], [], []
-                    for leaf in leaves:
-                        self._buf_seq += 1
-                        buf_id = f"buf-{self._buf_seq}"
+                    for j, leaf in enumerate(leaves):
+                        if want_ids is not None:
+                            buf_id = str(want_ids[j])
+                        else:
+                            self._buf_seq += 1
+                            buf_id = f"buf-{self._buf_seq}"
                         self._buffers[buf_id] = leaf
                         ids.append(buf_id)
                         shapes.append(list(leaf.shape))
                         dtypes.append(str(leaf.dtype))
+                if meta.get("quiet"):
+                    # pipelined client: it minted the ids itself and
+                    # discards success replies unread — skip the frame
+                    # entirely (errors above still reply)
+                    return
                 reply("EXECUTE_OK", {"result_refs": ids, "shapes": shapes,
                                      "dtypes": dtypes}, [])
             else:

@@ -473,3 +473,44 @@ def test_transparent_pjrt_requires_token_when_worker_is_authed():
         assert "NDEV 1" in r2.stdout, r2.stderr[-2000:]
     finally:
         target.stop()
+
+
+def test_transparent_pjrt_pipelined_errors_surface():
+    """Execute is fire-and-forget (client-minted result ids; requests on
+    one connection run in order), so a failed pipelined EXECUTE must
+    surface at the next synchronous boundary instead of vanishing."""
+    so = _plugin_path("libtpf_pjrt_remote.so")
+    import os
+    import subprocess
+    import sys
+
+    # a worker whose resident budget can hold the uploaded operand
+    # (256 B) but not also an execute result -> the pipelined EXECUTE
+    # is refused server-side
+    target = RemoteVTPUWorker(max_resident_bytes=300)
+    target.start()
+    try:
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.update({
+            "JAX_PLATFORMS": "tpfr",
+            "PJRT_NAMES_AND_LIBRARY_PATHS": f"tpfr:{so}",
+            "TPF_REMOTE_WORKER_URL": f"tcp://127.0.0.1:{target.port}",
+        })
+        prog = (
+            "import jax, jax.numpy as jnp, numpy as np\n"
+            "x = jnp.ones((8, 8))\n"          # 256B: uploads fit
+            "y = jax.jit(lambda a: a @ a)(x)\n"
+            "try:\n"
+            "    np.asarray(y)\n"
+            "    print('NO-ERROR')\n"
+            "except Exception as e:\n"
+            "    print('GOT:', type(e).__name__, str(e)[:160])\n")
+        r = subprocess.run([sys.executable, "-c", prog], env=env,
+                           capture_output=True, text=True, timeout=240)
+        out = r.stdout + r.stderr
+        assert "NO-ERROR" not in out, out
+        assert "pipelined" in out or "budget" in out or "unknown" in out, \
+            out[-1500:]
+    finally:
+        target.stop()
