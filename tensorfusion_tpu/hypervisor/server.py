@@ -60,6 +60,9 @@ class HypervisorServer:
         from ..utils.tlsutil import TlsHandshakeMixin
 
         class Handler(TlsHandshakeMixin, BaseHTTPRequestHandler):
+            # HTTP/1.1 keep-alive (see statestore.py Handler)
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, fmt, *args):  # quiet
                 log.debug("%s " + fmt, self.client_address[0], *args)
 
@@ -71,11 +74,17 @@ class HypervisorServer:
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _body(self) -> dict:
+            def _drain_body(self) -> None:
+                """Read the full request body BEFORE any response can be
+                written: on an HTTP/1.1 keep-alive connection, unread
+                body bytes would be parsed as the next request line."""
                 length = int(self.headers.get("Content-Length", 0))
-                if length == 0:
+                self._raw_body = self.rfile.read(length) if length else b""
+
+            def _body(self) -> dict:
+                if not getattr(self, "_raw_body", b""):
                     return {}
-                return json.loads(self.rfile.read(length))
+                return json.loads(self._raw_body)
 
             #: tokenless routes: /healthz for liveness probes, and the
             #: workload-pod bootstrap endpoints (/limiter, /process) —
@@ -99,6 +108,7 @@ class HypervisorServer:
 
             def do_GET(self):
                 try:
+                    self._drain_body()
                     if self._authed():
                         outer._get(self)
                 except Exception as e:  # noqa: BLE001
@@ -107,6 +117,7 @@ class HypervisorServer:
 
             def do_POST(self):
                 try:
+                    self._drain_body()
                     if self._authed():
                         outer._post(self)
                 except Exception as e:  # noqa: BLE001
@@ -115,6 +126,7 @@ class HypervisorServer:
 
             def do_DELETE(self):
                 try:
+                    self._drain_body()
                     if self._authed():
                         outer._delete(self)
                 except Exception as e:  # noqa: BLE001

@@ -24,6 +24,7 @@ Wire-level notes:
 
 from __future__ import annotations
 
+import http.client
 import json
 import logging
 import queue
@@ -173,33 +174,81 @@ class RemoteStore:
             from .utils.tlsutil import client_context
 
             self._ssl_ctx = client_context()
+        parsed = urllib.parse.urlsplit(self.base_url)
+        self._host = parsed.hostname or "127.0.0.1"
+        self._port = parsed.port or (443 if parsed.scheme == "https"
+                                     else 80)
+        self._https = parsed.scheme == "https"
+        #: per-thread persistent connection (HTTP/1.1 keep-alive): the
+        #: informer, controllers, and metrics pusher each hold one open
+        #: socket instead of a TCP(+TLS) handshake per request
+        self._tlocal = threading.local()
+
+    def _conn(self):
+        c = getattr(self._tlocal, "conn", None)
+        if c is None:
+            if self._https:
+                c = http.client.HTTPSConnection(
+                    self._host, self._port, timeout=self.timeout_s,
+                    context=self._ssl_ctx)
+            else:
+                c = http.client.HTTPConnection(
+                    self._host, self._port, timeout=self.timeout_s)
+            self._tlocal.conn = c
+        return c
+
+    def _drop_conn(self):
+        c = getattr(self._tlocal, "conn", None)
+        if c is not None:
+            self._tlocal.conn = None
+            try:
+                c.close()
+            except OSError:
+                pass
 
     # -- transport ---------------------------------------------------------
 
     def _request(self, method: str, path: str, query: Optional[dict] = None,
                  body: Optional[dict] = None, max_tries: int = 0) -> dict:
-        url = self.base_url + path
+        target = path
         if query:
-            url += "?" + urllib.parse.urlencode(query)
+            target += "?" + urllib.parse.urlencode(query)
+        url = self.base_url + target
         data = json.dumps(body).encode() if body is not None else None
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["X-TPF-Token"] = self.token
         tries = 0
         while True:
-            req = urllib.request.Request(url, data=data, method=method)
-            req.add_header("Content-Type", "application/json")
-            if self.token:
-                req.add_header("X-TPF-Token", self.token)
+            api_err = None
             try:
-                with urllib.request.urlopen(req, timeout=self.timeout_s,
-                                            context=self._ssl_ctx) as r:
-                    return json.loads(r.read() or b"{}")
-            except urllib.error.HTTPError as e:
-                payload = {}
-                try:
-                    payload = json.loads(e.read() or b"{}")
-                except Exception:  # noqa: BLE001
-                    pass
-                self._raise_api_error(e.code, payload)
-            except (urllib.error.URLError, OSError, TimeoutError) as e:
+                c = self._conn()
+                c.request(method, target, body=data, headers=headers)
+                r = c.getresponse()
+                raw = r.read()
+                if r.will_close:
+                    self._drop_conn()
+                if 300 <= r.status < 400:
+                    # http.client follows no redirects; silently treating
+                    # a 307 (follower leader-redirect) as success would
+                    # hand the caller an empty dict
+                    raise RemoteStoreError(
+                        f"{method} {url}: unexpected redirect "
+                        f"{r.status} to {r.getheader('Location')}")
+                if r.status >= 400:
+                    payload = {}
+                    try:
+                        payload = json.loads(raw or b"{}")
+                    except Exception:  # noqa: BLE001
+                        pass
+                    api_err = (r.status, payload)
+                else:
+                    return json.loads(raw or b"{}")
+            except (http.client.HTTPException, OSError,
+                    TimeoutError) as e:
+                # a dead keep-alive socket (server restart, idle close)
+                # is routine: drop it so the retry dials fresh
+                self._drop_conn()
                 # a certificate mismatch never heals by retrying — fail
                 # fast instead of burning the whole backoff schedule
                 cause = getattr(e, "reason", e)
@@ -216,6 +265,11 @@ class RemoteStore:
                                             len(RETRY_BACKOFF_S) - 1)]
                 tries += 1
                 time.sleep(delay)
+                continue
+            # raised OUTSIDE the try: several API errors are OSError
+            # subclasses (PermissionError) and must not hit the
+            # transport-retry clause
+            self._raise_api_error(*api_err)
 
     @staticmethod
     def _raise_api_error(code: int, payload: dict):

@@ -74,6 +74,9 @@ class OperatorServer:
         from ..utils.tlsutil import TlsHandshakeMixin
 
         class Handler(TlsHandshakeMixin, BaseHTTPRequestHandler):
+            # HTTP/1.1 keep-alive (see statestore.py Handler)
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, fmt, *args):
                 log.debug(fmt, *args)
 
@@ -86,9 +89,16 @@ class OperatorServer:
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _body(self):
+            def _drain_body(self):
+                """Read the body up front: on a keep-alive connection a
+                response sent with the body unread (401/307/404 paths)
+                would leave its bytes to be parsed as the next request."""
                 n = int(self.headers.get("Content-Length", 0))
-                return json.loads(self.rfile.read(n)) if n else {}
+                self._raw_body = self.rfile.read(n) if n else b""
+
+            def _body(self):
+                raw = getattr(self, "_raw_body", b"")
+                return json.loads(raw) if raw else {}
 
             def _gateway(self, method):
                 """Store-gateway paths short-circuit here; returns True
@@ -108,6 +118,7 @@ class OperatorServer:
 
             def do_GET(self):
                 try:
+                    self._drain_body()
                     if self._gateway("GET"):
                         return
                     outer._get(self)
@@ -117,6 +128,7 @@ class OperatorServer:
 
             def do_POST(self):
                 try:
+                    self._drain_body()
                     if self._gateway("POST"):
                         return
                     if self._follower_redirect():
@@ -150,6 +162,7 @@ class OperatorServer:
 
             def do_PUT(self):
                 try:
+                    self._drain_body()
                     if not self._gateway("PUT"):
                         self._send(404, {"error": "not found"})
                 except Exception as e:  # noqa: BLE001
@@ -158,6 +171,7 @@ class OperatorServer:
 
             def do_DELETE(self):
                 try:
+                    self._drain_body()
                     if not self._gateway("DELETE"):
                         self._send(404, {"error": "not found"})
                 except Exception as e:  # noqa: BLE001

@@ -45,6 +45,11 @@ class StateStoreServer:
         from .utils.tlsutil import TlsHandshakeMixin
 
         class Handler(TlsHandshakeMixin, BaseHTTPRequestHandler):
+            # HTTP/1.1: responses always carry Content-Length, so
+            # clients can keep connections alive (RemoteStore reuses
+            # one per thread instead of a TCP+TLS handshake per call)
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, fmt, *args):
                 log.debug(fmt, *args)
 
@@ -58,14 +63,17 @@ class StateStoreServer:
                 self.wfile.write(body)
 
             def _handle(self, method):
+                # drain the body FIRST, whatever the route does: unread
+                # bytes would desync this HTTP/1.1 keep-alive connection
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n) if n else b""
                 url = urlparse(self.path)
                 if url.path == "/healthz":
                     self._send(200, {"ok": True})
                     return
                 body = {}
                 if method in ("POST", "PUT"):
-                    n = int(self.headers.get("Content-Length", 0))
-                    body = json.loads(self.rfile.read(n)) if n else {}
+                    body = json.loads(raw) if raw else {}
                 result = outer.gateway.handle(method, url.path,
                                               parse_qs(url.query), body,
                                               self.headers)
