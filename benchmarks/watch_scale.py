@@ -36,7 +36,7 @@ except ImportError:
 
 
 def run_step(server_url: str, watchers: int, pushers: int,
-             window_s: float, store):
+             window_s: float, store, conflate: bool = False):
     """One point on the curve; returns the metrics dict."""
     import urllib.request
 
@@ -54,7 +54,8 @@ def run_step(server_url: str, watchers: int, pushers: int,
         primed = 0
         while not stop.is_set():
             url = (f"{server_url}/api/v1/store/watch?since_rv={rv}"
-                   f"&kinds=Pod&wait_s=1.0&primed={primed}&replay=0")
+                   f"&kinds=Pod&wait_s=1.0&primed={primed}&replay=0"
+                   f"&conflate={1 if conflate else 0}")
             try:
                 with urllib.request.urlopen(url, timeout=10) as r:
                     payload = json.loads(r.read())
@@ -125,6 +126,7 @@ def run_step(server_url: str, watchers: int, pushers: int,
 
     store.delete(Pod, "churn", "default")
     return {"watchers": watchers,
+            "conflate": conflate,
             "writes_per_s": round(writes_per_s, 1),
             "events_delivered": len(lags),
             "watch_lag_p50_ms": pct(lags, 0.50),
@@ -147,11 +149,19 @@ def main() -> int:
     server = StateStoreServer(store)
     server.start()
     curve = []
+    conflated_point = None
     try:
-        for n in (int(x) for x in args.watcher_steps.split(",")):
+        steps = [int(x) for x in args.watcher_steps.split(",")]
+        for n in steps:
             curve.append(run_step(server.url, n, args.pushers,
                                   args.window_s, store))
             print(f"# {curve[-1]}", file=sys.stderr)
+        # same max-watcher load with CONFLATED watches (reconcile-style
+        # consumers): one event per object per poll — the lag and
+        # bandwidth of a churn burst collapse by the burst factor
+        conflated_point = run_step(server.url, steps[-1], args.pushers,
+                                   args.window_s, store, conflate=True)
+        print(f"# conflated: {conflated_point}", file=sys.stderr)
     finally:
         server.stop()
 
@@ -178,6 +188,7 @@ def main() -> int:
         "unit": "%",
         "vs_baseline": round(retention / 100.0, 3),
         "scaling_span_pct": scaling_span,
+        "conflated_at_max_watchers": conflated_point,
         "curve": curve,
         "pushers": args.pushers,
         "window_s": args.window_s,

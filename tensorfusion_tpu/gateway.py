@@ -353,9 +353,11 @@ class StoreGateway:
                          "events": [{"type": etype, "kind": kind,
                                      "obj": obj}
                                     for etype, kind, obj in snapshot]}
+        conflate = qs.get("conflate", ["0"])[0] in ("1", "true")
         rv, frags, reset = self.store.events_since(since_rv, kinds,
                                                    wait_s=wait_s,
-                                                   serialized=True)
+                                                   serialized=True,
+                                                   conflate=conflate)
         reset_s = "true" if reset else "false"
         return 200, RawJson(
             '{"rv":%d,"reset":%s,"events":[%s]}'

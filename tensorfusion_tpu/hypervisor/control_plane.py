@@ -73,7 +73,11 @@ class ControlPlaneBackend(Backend):
         self._stop.clear()
         self.register_node()
         self.publish_chips()
-        self._watch = self.store.watch("Pod")
+        # conflated: _handle_pod reconciles latest state per pod (only
+        # DELETED vs current-state matters), so intermediate events in a
+        # churn burst are pure wire/serialize cost — the gateway
+        # collapses them (a no-op for the in-process store)
+        self._watch = self.store.watch("Pod", conflate=True)
         self._thread = threading.Thread(target=self._pod_loop,
                                         name="tpf-cp-backend", daemon=True)
         self._thread.start()

@@ -31,6 +31,10 @@ from .framework import WorkerSpec
 
 log = logging.getLogger("tpf.hypervisor.server")
 
+#: pre-auth drain bound: an unauthenticated peer must not be able to
+#: make the server buffer an arbitrary Content-Length into memory
+MAX_REQUEST_BODY_BYTES = 32 << 20
+
 
 def _to_jsonable(obj):
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
@@ -74,12 +78,21 @@ class HypervisorServer:
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _drain_body(self) -> None:
+            def _drain_body(self) -> bool:
                 """Read the full request body BEFORE any response can be
                 written: on an HTTP/1.1 keep-alive connection, unread
-                body bytes would be parsed as the next request line."""
+                body bytes would be parsed as the next request line.
+                Oversized bodies are refused WITHOUT reading (close the
+                connection instead — draining would buffer an
+                attacker-chosen size pre-auth)."""
                 length = int(self.headers.get("Content-Length", 0))
+                if length > MAX_REQUEST_BODY_BYTES:
+                    self.close_connection = True
+                    self._raw_body = b""
+                    self._send(413, {"error": "request body too large"})
+                    return False
                 self._raw_body = self.rfile.read(length) if length else b""
+                return True
 
             def _body(self) -> dict:
                 if not getattr(self, "_raw_body", b""):
@@ -108,8 +121,7 @@ class HypervisorServer:
 
             def do_GET(self):
                 try:
-                    self._drain_body()
-                    if self._authed():
+                    if self._drain_body() and self._authed():
                         outer._get(self)
                 except Exception as e:  # noqa: BLE001
                     log.exception("GET %s failed", self.path)
@@ -117,8 +129,7 @@ class HypervisorServer:
 
             def do_POST(self):
                 try:
-                    self._drain_body()
-                    if self._authed():
+                    if self._drain_body() and self._authed():
                         outer._post(self)
                 except Exception as e:  # noqa: BLE001
                     log.exception("POST %s failed", self.path)
@@ -126,8 +137,7 @@ class HypervisorServer:
 
             def do_DELETE(self):
                 try:
-                    self._drain_body()
-                    if self._authed():
+                    if self._drain_body() and self._authed():
                         outer._delete(self)
                 except Exception as e:  # noqa: BLE001
                     log.exception("DELETE %s failed", self.path)

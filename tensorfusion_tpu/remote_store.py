@@ -58,9 +58,10 @@ class RemoteWatch:
     """Watch-compatible event stream fed by a long-poll thread."""
 
     def __init__(self, store: "RemoteStore", kinds: Iterable[str],
-                 replay: bool = True):
+                 replay: bool = True, conflate: bool = False):
         self._store = store
         self.kinds = set(kinds)
+        self._conflate = conflate
         self.queue: "queue.Queue[Optional[Event]]" = queue.Queue()
         self._closed = threading.Event()
         self._rv = 0
@@ -106,6 +107,7 @@ class RemoteWatch:
                            "kinds": ",".join(sorted(self.kinds)),
                            "replay": "1" if self._replay else "0",
                            "primed": "1" if self._primed else "0",
+                           "conflate": "1" if self._conflate else "0",
                            "wait_s": str(WATCH_POLL_S)},
                     # one retry inside _request; sustained failure handled
                     # by this loop's own backoff so stop() stays prompt
@@ -219,8 +221,10 @@ class RemoteStore:
         if self.token:
             headers["X-TPF-Token"] = self.token
         tries = 0
+        free_redial = True
         while True:
             api_err = None
+            reused = getattr(self._tlocal, "conn", None) is not None
             try:
                 c = self._conn()
                 c.request(method, target, body=data, headers=headers)
@@ -249,6 +253,16 @@ class RemoteStore:
                 # a dead keep-alive socket (server restart, idle close)
                 # is routine: drop it so the retry dials fresh
                 self._drop_conn()
+                # one FREE redial when a REUSED connection died before
+                # returning anything: the server never processed the
+                # request, so even no-retry callers (create,
+                # push_metrics — no-double-delivery invariant) can
+                # safely redial once instead of failing spuriously
+                if free_redial and reused and isinstance(
+                        e, (http.client.RemoteDisconnected,
+                            ConnectionResetError, BrokenPipeError)):
+                    free_redial = False
+                    continue
                 # a certificate mismatch never heals by retrying — fail
                 # fast instead of burning the whole backoff schedule
                 cause = getattr(e, "reason", e)
@@ -352,8 +366,13 @@ class RemoteStore:
             items = [o for o in items if selector(o)]
         return items
 
-    def watch(self, *kinds: str, replay: bool = True) -> RemoteWatch:
-        return RemoteWatch(self, kinds, replay=replay)
+    def watch(self, *kinds: str, replay: bool = True,
+              conflate: bool = False) -> RemoteWatch:
+        """``conflate=True`` asks the gateway for only the newest event
+        per object per poll — safe for reconcile-style consumers (all of
+        tpu-fusion's controllers/backends), and it cuts wire+serialize
+        cost by the churn factor under bursts."""
+        return RemoteWatch(self, kinds, replay=replay, conflate=conflate)
 
     # -- metrics shipping --------------------------------------------------
 

@@ -36,6 +36,9 @@ from ..webhook.parser import ParseError
 
 log = logging.getLogger("tpf.server")
 
+#: pre-auth drain bound (see hypervisor/server.py)
+MAX_REQUEST_BODY_BYTES = 32 << 20
+
 #: client-API paths only the leader may serve (followers answer with a
 #: 307 to the leaseholder — the reference forwards assign-host-port /
 #: assign-index to the leader IP from the leader-info ConfigMap)
@@ -89,12 +92,19 @@ class OperatorServer:
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _drain_body(self):
+            def _drain_body(self) -> bool:
                 """Read the body up front: on a keep-alive connection a
                 response sent with the body unread (401/307/404 paths)
-                would leave its bytes to be parsed as the next request."""
+                would leave its bytes to be parsed as the next request.
+                Oversized bodies are refused WITHOUT buffering."""
                 n = int(self.headers.get("Content-Length", 0))
+                if n > MAX_REQUEST_BODY_BYTES:
+                    self.close_connection = True
+                    self._raw_body = b""
+                    self._send(413, {"error": "request body too large"})
+                    return False
                 self._raw_body = self.rfile.read(n) if n else b""
+                return True
 
             def _body(self):
                 raw = getattr(self, "_raw_body", b"")
@@ -118,7 +128,8 @@ class OperatorServer:
 
             def do_GET(self):
                 try:
-                    self._drain_body()
+                    if not self._drain_body():
+                        return
                     if self._gateway("GET"):
                         return
                     outer._get(self)
@@ -128,7 +139,8 @@ class OperatorServer:
 
             def do_POST(self):
                 try:
-                    self._drain_body()
+                    if not self._drain_body():
+                        return
                     if self._gateway("POST"):
                         return
                     if self._follower_redirect():
@@ -162,7 +174,8 @@ class OperatorServer:
 
             def do_PUT(self):
                 try:
-                    self._drain_body()
+                    if not self._drain_body():
+                        return
                     if not self._gateway("PUT"):
                         self._send(404, {"error": "not found"})
                 except Exception as e:  # noqa: BLE001
@@ -171,7 +184,8 @@ class OperatorServer:
 
             def do_DELETE(self):
                 try:
-                    self._drain_body()
+                    if not self._drain_body():
+                        return
                     if not self._gateway("DELETE"):
                         self._send(404, {"error": "not found"})
                 except Exception as e:  # noqa: BLE001

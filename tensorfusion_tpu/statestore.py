@@ -29,6 +29,9 @@ from .store import ObjectStore
 
 log = logging.getLogger("tpf.statestore")
 
+#: pre-auth drain bound (see hypervisor/server.py)
+MAX_REQUEST_BODY_BYTES = 32 << 20
+
 
 class StateStoreServer:
     """Thin HTTP host for a StoreGateway (healthz + store routes only)."""
@@ -65,8 +68,14 @@ class StateStoreServer:
             def _handle(self, method):
                 # drain the body FIRST, whatever the route does: unread
                 # bytes would desync this HTTP/1.1 keep-alive connection
+                # (oversized bodies are refused WITHOUT buffering)
                 n = int(self.headers.get("Content-Length", 0))
+                if n > MAX_REQUEST_BODY_BYTES:
+                    self.close_connection = True
+                    self._send(413, {"error": "request body too large"})
+                    return
                 raw = self.rfile.read(n) if n else b""
+
                 url = urlparse(self.path)
                 if url.path == "/healthz":
                     self._send(200, {"ok": True})

@@ -293,7 +293,11 @@ class ObjectStore:
 
     # -- watch ------------------------------------------------------------
 
-    def watch(self, *kinds: str, replay: bool = True) -> Watch:
+    def watch(self, *kinds: str, replay: bool = True,
+              conflate: bool = False) -> Watch:
+        # ``conflate`` is accepted for interface parity with
+        # RemoteStore.watch and ignored: in-process watches have no wire
+        # or serialization to save, and consumers must not care.
         """Subscribe to events for the given kinds (all kinds if empty).
         With replay=True, current objects are delivered first as ADDED."""
         with self._lock:
@@ -337,7 +341,8 @@ class ObjectStore:
             return self._rv, out
 
     def events_since(self, since_rv: int, kinds: Iterable[str] = (),
-                     wait_s: float = 0.0, serialized: bool = False
+                     wait_s: float = 0.0, serialized: bool = False,
+                     conflate: bool = False
                      ) -> Tuple[int, List, bool]:
         """Events with rv > since_rv for the given kinds, blocking up to
         ``wait_s`` when none are pending (long-poll).  Returns
@@ -346,7 +351,15 @@ class ObjectStore:
         410 Gone semantics).  Events are ``(etype, kind, rv, obj_dict)``
         tuples, or — with ``serialized=True`` (the gateway's fan-out
         path) — ready JSON fragments cached once per event so N watchers
-        don't pay N serializations."""
+        don't pay N serializations.
+
+        ``conflate=True`` keeps only the NEWEST event per object in the
+        window — correct for reconcile-style consumers (every controller
+        and informer here applies latest state per key; none replays
+        histories), and it shrinks both the serialization and wire cost
+        of a churn burst by the burst factor.  Event types still arrive
+        faithfully for the surviving event (a delete is never masked by
+        an earlier modify: the delete IS the newest)."""
         kinds = set(kinds)
         import time as _time
         deadline = _time.monotonic() + max(0.0, wait_s)
@@ -367,6 +380,7 @@ class ObjectStore:
                 # rv-ordered deque: walk the new suffix from the tail
                 # instead of rescanning all of history on every wakeup
                 matched = []
+                seen_keys = set() if conflate else None
                 for entry in reversed(self._event_log):
                     rv, etype, kind, obj = entry[0], entry[1], \
                         entry[2], entry[3]
@@ -374,6 +388,15 @@ class ObjectStore:
                         break
                     if kinds and kind not in kinds:
                         continue
+                    if seen_keys is not None:
+                        # newest-first walk: the first event seen for an
+                        # object is its latest; earlier ones conflate away
+                        md = obj.get("metadata", {})
+                        okey = (kind, md.get("namespace", ""),
+                                md.get("name", ""))
+                        if okey in seen_keys:
+                            continue
+                        seen_keys.add(okey)
                     if serialized:
                         frag = entry[4]
                         if frag is None:

@@ -107,3 +107,89 @@ def test_alloc_request_defaults():
     assert req.chip_count == 1
     assert req.isolation == "soft"
     assert req.key() == "default/p1"
+
+
+def test_watch_conflation_keeps_only_newest_per_object():
+    """conflate=True collapses a churn burst to the newest event per
+    object — reconcile-style consumers (every controller here) get the
+    same final state for a fraction of the serialize+wire cost, and a
+    trailing delete is never masked."""
+    from tensorfusion_tpu.api.types import Pod
+    from tensorfusion_tpu.store import ObjectStore
+
+    store = ObjectStore()
+    store.enable_event_log()
+    rv0 = store.current_rv
+    a = Pod.new("a", namespace="d")
+    b = Pod.new("b", namespace="d")
+    store.create(a)
+    store.create(b)
+    for i in range(20):
+        a.metadata.annotations["i"] = str(i)
+        a = store.update(a)
+    b.metadata.annotations["final"] = "1"
+    b = store.update(b)
+    store.delete(Pod, "b", "d")
+
+    # unconflated: every event in the window
+    _, events, reset = store.events_since(rv0, ["Pod"])
+    assert not reset and len(events) == 24
+
+    # conflated: one event per object — a's LAST modify, b's delete
+    _, conflated, reset = store.events_since(rv0, ["Pod"],
+                                             conflate=True)
+    assert not reset
+    by_name = {e[3]["metadata"]["name"]: e for e in conflated}
+    assert set(by_name) == {"a", "b"}
+    assert by_name["a"][0] == "MODIFIED"
+    assert by_name["a"][3]["metadata"]["annotations"]["i"] == "19"
+    assert by_name["b"][0] == "DELETED"
+
+    # serialized path conflates identically
+    _, frags, _ = store.events_since(rv0, ["Pod"], conflate=True,
+                                     serialized=True)
+    assert len(frags) == 2
+
+
+def test_remote_watch_conflation_over_http():
+    """End to end: a conflated RemoteStore watch sees the final state of
+    a churn burst (fewer events, same outcome)."""
+    import time as _time
+
+    from tensorfusion_tpu.api.types import Pod
+    from tensorfusion_tpu.remote_store import RemoteStore
+    from tensorfusion_tpu.statestore import StateStoreServer
+    from tensorfusion_tpu.store import ObjectStore
+
+    store = ObjectStore()
+    server = StateStoreServer(store)
+    server.start()
+    try:
+        rs = RemoteStore(server.url, timeout_s=10)
+        w = rs.watch("Pod", conflate=True)
+        try:
+            pod = Pod.new("churny", namespace="d")
+            store.create(pod)
+            for i in range(30):
+                pod.metadata.annotations["i"] = str(i)
+                pod = store.update(pod)
+            deadline = _time.time() + 10
+            latest = None
+            n = 0
+            while _time.time() < deadline:
+                ev = w.get(timeout=0.5)
+                if ev is None:
+                    if latest is not None and \
+                            latest.metadata.annotations.get("i") == "29":
+                        break
+                    continue
+                n += 1
+                latest = ev.obj
+            assert latest is not None
+            assert latest.metadata.annotations["i"] == "29"
+            # far fewer deliveries than the 31 raw events
+            assert n < 31, f"conflation delivered all {n} events"
+        finally:
+            w.stop()
+    finally:
+        server.stop()
