@@ -61,11 +61,10 @@ class HypervisorServer:
         self.tls = bool(tls_cert)
         outer = self
 
-        from ..utils.tlsutil import TlsHandshakeMixin
+        from ..utils.tlsutil import KeepAliveHandlerMixin, TlsHandshakeMixin
 
-        class Handler(TlsHandshakeMixin, BaseHTTPRequestHandler):
-            # HTTP/1.1 keep-alive (see statestore.py Handler)
-            protocol_version = "HTTP/1.1"
+        class Handler(KeepAliveHandlerMixin, TlsHandshakeMixin,
+                      BaseHTTPRequestHandler):
 
             def log_message(self, fmt, *args):  # quiet
                 log.debug("%s " + fmt, self.client_address[0], *args)

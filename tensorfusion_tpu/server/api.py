@@ -74,11 +74,10 @@ class OperatorServer:
             if isinstance(operator.store, ObjectStore) else None
         outer = self
 
-        from ..utils.tlsutil import TlsHandshakeMixin
+        from ..utils.tlsutil import KeepAliveHandlerMixin, TlsHandshakeMixin
 
-        class Handler(TlsHandshakeMixin, BaseHTTPRequestHandler):
-            # HTTP/1.1 keep-alive (see statestore.py Handler)
-            protocol_version = "HTTP/1.1"
+        class Handler(KeepAliveHandlerMixin, TlsHandshakeMixin,
+                      BaseHTTPRequestHandler):
 
             def log_message(self, fmt, *args):
                 log.debug(fmt, *args)
@@ -225,7 +224,10 @@ class OperatorServer:
         elif url.path == "/connection":
             name = qs.get("name", [""])[0]
             ns = qs.get("namespace", ["default"])[0]
-            wait_s = float(qs.get("wait_s", ["0"])[0])
+            # capped like the gateway's watch wait: an uncapped client
+            # value would pin this handler thread in a sleep loop the
+            # socket idle-timeout can never interrupt
+            wait_s = min(float(qs.get("wait_s", ["0"])[0]), 30.0)
             deadline = time.time() + wait_s
             while True:
                 conn = op.store.try_get(TPUConnection, name, ns)

@@ -45,13 +45,10 @@ class StateStoreServer:
         self.tls = bool(tls_cert)
         outer = self
 
-        from .utils.tlsutil import TlsHandshakeMixin
+        from .utils.tlsutil import KeepAliveHandlerMixin, TlsHandshakeMixin
 
-        class Handler(TlsHandshakeMixin, BaseHTTPRequestHandler):
-            # HTTP/1.1: responses always carry Content-Length, so
-            # clients can keep connections alive (RemoteStore reuses
-            # one per thread instead of a TCP+TLS handshake per call)
-            protocol_version = "HTTP/1.1"
+        class Handler(KeepAliveHandlerMixin, TlsHandshakeMixin,
+                      BaseHTTPRequestHandler):
 
             def log_message(self, fmt, *args):
                 log.debug(fmt, *args)

@@ -92,6 +92,19 @@ def wrap_http_server(httpd, cert_path: str, key_path: str) -> None:
                                    do_handshake_on_connect=False)
 
 
+class KeepAliveHandlerMixin:
+    """Shared HTTP/1.1 policy for the control-plane servers: responses
+    always carry Content-Length so clients keep connections alive
+    (RemoteStore holds one per thread instead of a TCP+TLS handshake per
+    call), and idle connections time out so an abandoned client cannot
+    pin a handler thread forever (300s comfortably exceeds every
+    long-poll cap, which request handlers must enforce themselves —
+    sleep loops never touch the socket)."""
+
+    protocol_version = "HTTP/1.1"
+    timeout = 300
+
+
 class TlsHandshakeMixin:
     """Handler mixin completing the TLS handshake per connection, with a
     deadline, in the handler's own thread.  List it BEFORE the HTTP
