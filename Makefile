@@ -7,7 +7,8 @@
 
 PY := env -u PALLAS_AXON_POOL_IPS python
 
-.PHONY: all native test test-native verify-all check-coverage asan \
+.PHONY: all native test test-native verify-all verify-repeat \
+	check-coverage asan \
 	tsan bench bench-tpu test-tpu-live sched-bench webhook-bench remoting-bench \
 	multitenant-bench multitenant-bench-tpu serving-bench-tpu \
 	refresh-tpu-artifacts dryrun clean
@@ -27,6 +28,20 @@ test: native
 # under -j, colliding on TCP ports).
 verify-all: test-native check-coverage
 	@echo "verify-all: OK"
+
+# Deflake gate: the tier-1 python suite 5x sequentially.  Timing-
+# dependent tests must survive a loaded box repeatedly, not just one
+# lucky run in isolation — this is the proof for every wait_until-style
+# fix (tests/helpers.py).  Stops at the first failing round.
+verify-repeat: native
+	@for i in 1 2 3 4 5; do \
+		echo "=== verify-repeat round $$i/5 ==="; \
+		env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+			python -m pytest tests/ -q -m 'not slow' \
+			-p no:cacheprovider -p no:xdist -p no:randomly \
+			|| exit 1; \
+	done
+	@echo "verify-repeat: OK (5/5 rounds green)"
 
 test-native:
 	$(MAKE) -C native test

@@ -12,16 +12,34 @@ The reference claims < 4% performance loss for its GPU-over-IP remoting
 Prints ONE JSON line:
     {"metric": "remote_vtpu_overhead_pct", "value": .., "unit": "%",
      "vs_baseline": ..}   (vs_baseline = value / 4.0; < 1.0 beats it)
+
+Also emits a **device-scaling cell** (1/2/4/8 virtual devices on the
+CPU mesh): per-device-count step time and scaling efficiency for the
+protocol-v3 sharded path, weak-scaled (fixed batch per device).  The
+cell is sized latency-bound — per-step wall time is dominated by the
+fixed per-request cost, not compute, because the virtual CPU devices
+share one core and would serialize any real compute; on TPU hardware
+the same path gets the compute parallelism on top.  The win condition
+vs the old single-device remoting: aggregate throughput grows
+near-linearly with devices that were previously idle.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
 sys.path.insert(0, ".")
+
+# the scaling cell needs the virtual 8-device CPU mesh; must be set
+# before jax initializes its backend
+if "--xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
 
 import numpy as np
 
@@ -110,6 +128,15 @@ def main() -> int:
     p.add_argument("--runs", type=int, default=1,
                    help="independent measurements; the artifact records "
                         "each so '<4%% across N runs' is checkable")
+    p.add_argument("--no-scaling", action="store_true",
+                   help="skip the 1/2/4/8-device scaling cell")
+    p.add_argument("--scaling-batch", type=int, default=128,
+                   help="rows per device in the scaling cell")
+    p.add_argument("--scaling-dim", type=int, default=256)
+    p.add_argument("--scaling-steps", type=int, default=60)
+    p.add_argument("--scaling-dcn-rtt-ms", type=float, default=2.0,
+                   help="emulated round-trip latency for the sync "
+                        "scaling cell (typical same-DC pod-to-pod)")
     args = p.parse_args()
 
     import jax
@@ -210,9 +237,179 @@ def main() -> int:
     transparent = measure_transparent(args)
     if transparent is not None:
         result["transparent"] = transparent
+    if not args.no_scaling:
+        scaling = measure_device_scaling(args)
+        if scaling is not None:
+            result["device_scaling"] = scaling
     write_artifact("remoting", result)
     print(json.dumps(result))
     return 0
+
+
+class _LatencyProxy:
+    """TCP forwarder that delays every chunk by ``one_way_s`` in both
+    directions — emulated DCN latency for the sync scaling cell (sleeps
+    release the GIL/core, so it adds *latency*, not service time)."""
+
+    def __init__(self, target_port: int, one_way_s: float):
+        import socket
+        import threading
+
+        self.delay = one_way_s
+        self.target_port = target_port
+        self._listen = socket.socket()
+        self._listen.bind(("127.0.0.1", 0))
+        self._listen.listen(8)
+        self.port = self._listen.getsockname()[1]
+        self._alive = True
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _accept_loop(self):
+        import socket
+        import threading
+
+        while self._alive:
+            try:
+                cli, _ = self._listen.accept()
+            except OSError:
+                return
+            srv = socket.create_connection(("127.0.0.1",
+                                            self.target_port))
+            for a, b in ((cli, srv), (srv, cli)):
+                a.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                threading.Thread(target=self._pump, args=(a, b),
+                                 daemon=True).start()
+
+    def _pump(self, src, dst):
+        while True:
+            try:
+                chunk = src.recv(1 << 16)
+            except OSError:
+                chunk = b""
+            if not chunk:
+                try:
+                    dst.shutdown(2)
+                except OSError:
+                    pass
+                return
+            time.sleep(self.delay)
+            try:
+                dst.sendall(chunk)
+            except OSError:
+                return
+
+    def close(self):
+        self._alive = False
+        self._listen.close()
+
+
+def measure_device_scaling(args):
+    """Sharded remoting over 1/2/4/8 virtual devices, weak-scaled.
+
+    The measured pattern is device-resident chained serving (the T3
+    shape): the sharded state lives scattered across the worker mesh,
+    every step is one pipelined EXECUTE whose wire payload is buffer
+    ids, and results stay device-resident (``remote.step_resident``).
+    Fixed rows-per-device, so with n devices each step advances n× the
+    rows — near-constant step time means the aggregate row rate grows
+    ~n×, which is exactly the capacity the single-device remoting path
+    left idle.  Run against a fresh worker subprocess (same 8-device
+    virtual CPU mesh)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from tensorfusion_tpu.remoting import RemoteDevice
+
+    if len(jax.devices()) < 8:
+        return None
+    B, D = args.scaling_batch, args.scaling_dim
+    steps = args.scaling_steps
+    rng = np.random.default_rng(0)
+
+    one_way_s = args.scaling_dcn_rtt_ms / 2e3
+
+    def run_cells(dev, sync: bool):
+        cells = []
+        for n in (1, 2, 4, 8):
+            if n == 1:
+                fn = jax.jit(lambda x: jnp.tanh(x * 1.01))
+            else:
+                mesh = Mesh(np.array(jax.devices()[:n]), ("b",))
+                sh = NamedSharding(mesh, P("b"))
+                fn = jax.jit(lambda x: jnp.tanh(x * 1.01),
+                             in_shardings=(sh,), out_shardings=sh)
+            remote = dev.remote_jit(fn)
+            x = rng.standard_normal((n * B, D)).astype(np.float32)
+            state = remote.upload_arg(0, x, x)   # resident (sharded)
+            # warm: compile + one full chain round trip
+            state = remote.step_resident(state)
+            state.fetch()
+            n_steps = max(steps // 2, 20) if sync else steps
+            best = None
+            for _ in range(3):                   # min-of-3 (noise)
+                t0 = time.perf_counter()
+                cur = state
+                for _ in range(n_steps):
+                    cur = remote.step_resident(
+                        cur, free=(cur,) if cur is not state else (),
+                        wait=sync)
+                    # free the pre-round state exactly once
+                cur.fetch()                      # barrier: chain done
+                dt = (time.perf_counter() - t0) / n_steps
+                best = dt if best is None else min(best, dt)
+                state = cur
+            state.free()
+            cells.append({
+                "devices": n,
+                "step_ms": round(best * 1e3, 3),
+                "rows_per_s": round(n * B / best, 1),
+                "resident_state_kb": round(n * B * D * 4 / 1024, 1)})
+        base = cells[0]["rows_per_s"]
+        for c in cells:
+            c["aggregate_vs_1dev"] = round(c["rows_per_s"] / base, 2)
+            c["scaling_efficiency"] = round(
+                c["rows_per_s"] / base / c["devices"], 3)
+        return cells
+
+    proc, port = _spawn_worker()
+    proxy = None
+    try:
+        # pipelined chaining on the raw loopback: service-rate scaling
+        dev = RemoteDevice(f"tcp://127.0.0.1:{port}")
+        pipelined = run_cells(dev, sync=False)
+        dev.close()
+        # synchronous stepping under emulated DCN RTT: the deployment
+        # the remoting path targets — per step, one round trip drives
+        # all n devices, so rows/step grows n× at ~constant latency
+        proxy = _LatencyProxy(port, one_way_s)
+        dev = RemoteDevice(f"tcp://127.0.0.1:{proxy.port}")
+        sync_cells = run_cells(dev, sync=True)
+        dev.close()
+    finally:
+        if proxy is not None:
+            proxy.close()
+        proc.terminate()
+        proc.wait(timeout=10)
+
+    return {
+        "mode": "weak scaling (fixed rows per device), device-resident "
+                "sharded state chained via step_resident EXECUTEs over "
+                "one connection",
+        "batch_per_device": B, "dim": D, "steps": steps,
+        "note": "virtual CPU devices share one core, so compute "
+                "serializes and the cells measure the protocol + "
+                "dispatch path; compute parallelism is additive on "
+                "real chips.  sync_dcn = one round trip per step under "
+                f"{args.scaling_dcn_rtt_ms}ms emulated RTT (socket "
+                "proxy), the latency regime GPU/TPU-over-IP actually "
+                "runs in; pipelined_loopback = fire-and-forget chain, "
+                "RTT fully hidden, bounded by per-step service time",
+        "pipelined_loopback": pipelined,
+        "sync_dcn": sync_cells,
+        # headline table (acceptance: >=3x aggregate at 4 devices)
+        "cells": sync_cells,
+    }
 
 
 #: the unmodified-client program both paths run (timing inside the
@@ -281,17 +478,24 @@ def measure_transparent(args):
                                    f"{r.stderr[-1500:]}")
             return json.loads(line[0][4:])
 
-        local = run_client({"JAX_PLATFORMS": "cpu"})
-        remote = run_client({
+        remote_env = {
             "JAX_PLATFORMS": "tpfr",
             "PJRT_NAMES_AND_LIBRARY_PATHS": f"tpfr:{so}",
-            "TPF_REMOTE_WORKER_URL": f"tcp://127.0.0.1:{port}"})
-        assert remote["platform"] == "tpfr"
-        overhead = (remote["step_s"] - local["step_s"]) \
-            / local["step_s"] * 100.0
+            "TPF_REMOTE_WORKER_URL": f"tcp://127.0.0.1:{port}"}
+        # interleave local/remote client processes and take each path's
+        # min: machine-load drift between two single measurements
+        # otherwise swamps a percent-level comparison
+        local_s, remote_s = [], []
+        for _ in range(2):
+            local_s.append(run_client({"JAX_PLATFORMS": "cpu"})["step_s"])
+            r = run_client(remote_env)
+            assert r["platform"] == "tpfr"
+            remote_s.append(r["step_s"])
+        t_local, t_remote = min(local_s), min(remote_s)
+        overhead = (t_remote - t_local) / t_local * 100.0
         return {"overhead_pct": round(overhead, 2),
-                "local_step_ms": round(local["step_s"] * 1e3, 3),
-                "remote_step_ms": round(remote["step_s"] * 1e3, 3),
+                "local_step_ms": round(t_local * 1e3, 3),
+                "remote_step_ms": round(t_remote * 1e3, 3),
                 "client": "unmodified jax via libtpf_pjrt_remote.so"}
     finally:
         proc.terminate()

@@ -35,10 +35,14 @@
  * and let the proxy auto-load it) and remote launches are charged
  * against the local worker's shm token bucket like local ones.
  *
- * Scope (v1): single remote device, synchronous execute (the wire RTT
- * is the latency floor; result buffers are refs so the payload cost is
- * only paid at explicit fetches).  Multi-device meshes remain the
- * cooperative remoting client's job (remoting/client.py).
+ * Scope: executes on one device per executable (result buffers are
+ * refs so the payload cost is only paid at explicit fetches).
+ * TPF_REMOTE_DEVICE_COUNT=n advertises n PJRT devices backed by the
+ * worker's mesh (capped at its inventory): single-device programs can
+ * target any of them (PUT carries the device id), but *sharded*
+ * execute across several remains the cooperative remoting client's
+ * job (remoting/client.py remote_jit) and returns a structured
+ * UNIMPLEMENTED here.  Full slot audit: docs/pjrt-remote-coverage.md.
  */
 
 #include <arpa/inet.h>
@@ -560,7 +564,8 @@ class Conn {
     uint32_t ver, hlen;
     memcpy(&ver, head + 4, 4);
     memcpy(&hlen, head + 8, 4);
-    if (ver != 2) { *err = "bad protocol version"; return false; }
+    /* v3 is additive JSON over the same framing; accept both */
+    if (ver != 2 && ver != 3) { *err = "bad protocol version"; return false; }
     if (hlen > (4u << 20)) { *err = "oversized header"; return false; }
     std::string header(hlen, '\0');
     if (!recv_all(&header[0], hlen, err)) return false;
@@ -837,6 +842,9 @@ PJRT_Error* tpf_Client_Create(PJRT_Client_Create_Args* args) {
   const char* token = getenv("TPF_REMOTING_TOKEN");
   std::string hello_meta = "\"token\":";
   json_escape(token ? token : "", &hello_meta);
+  /* negotiate v3 so PUTs can target specific mesh devices; a v2 worker
+   * replies version 2 and everything degrades to single-device */
+  hello_meta += ",\"max_version\":3";
   JVal rmeta;
   std::vector<WireBuffer> rbufs;
   PJRT_Error* perr = do_rpc(c, "HELLO", hello_meta, {}, &rmeta, &rbufs);
@@ -845,19 +853,43 @@ PJRT_Error* tpf_Client_Create(PJRT_Client_Create_Args* args) {
   perr = do_rpc(c, "INFO", "", {}, &rmeta, &rbufs);
   if (perr != nullptr) { delete c; return perr; }
 
-  auto* dev = new TpfDevice();
-  dev->client = c;
-  dev->id = 0;
-  dev->kind = rmeta.at("device_kind").str;
-  if (dev->kind.empty()) dev->kind = rmeta.at("platform").str;
-  if (dev->kind.empty()) dev->kind = "remote";
-  dev->debug = "TpfRemoteDevice(id=0, worker=" + std::string(url) +
-               ", kind=" + dev->kind + ")";
-  auto* mem = new TpfMemory();
-  mem->client = c;
-  dev->memory = mem;
-  c->devices.push_back(dev);
-  c->memories.push_back(mem);
+  /* Multi-device advertisement (v3 worker mesh): TPF_REMOTE_DEVICE_COUNT
+   * asks for n local PJRT devices, capped at the worker's inventory.
+   * Single-device execution works on any of them (PUT carries the
+   * device id); sharded execute across several is still the cooperative
+   * client's job and returns a structured UNIMPLEMENTED. */
+  int want_devices = 1;
+  const char* wd = getenv("TPF_REMOTE_DEVICE_COUNT");
+  if (wd != nullptr && wd[0] != '\0') want_devices = atoi(wd);
+  if (want_devices < 1) want_devices = 1;
+  int worker_devices = rmeta.has("n_devices")
+                           ? (int)rmeta.at("n_devices").as_int()
+                           : 1;
+  if (want_devices > worker_devices) {
+    fprintf(stderr,
+            "[tpf_remote] TPF_REMOTE_DEVICE_COUNT=%d capped at the "
+            "worker's %d devices\n",
+            want_devices, worker_devices);
+    want_devices = worker_devices;
+  }
+
+  std::string kind = rmeta.at("device_kind").str;
+  if (kind.empty()) kind = rmeta.at("platform").str;
+  if (kind.empty()) kind = "remote";
+  for (int i = 0; i < want_devices; ++i) {
+    auto* dev = new TpfDevice();
+    dev->client = c;
+    dev->id = i;
+    dev->kind = kind;
+    dev->debug = "TpfRemoteDevice(id=" + std::to_string(i) +
+                 ", worker=" + std::string(url) + ", kind=" + kind + ")";
+    auto* mem = new TpfMemory();
+    mem->client = c;
+    mem->id = i;
+    dev->memory = mem;
+    c->devices.push_back(dev);
+    c->memories.push_back(mem);
+  }
   g_client = c;
   args->client = reinterpret_cast<PJRT_Client*>(c);
   return nullptr;
@@ -955,7 +987,12 @@ PJRT_Error* tpf_Client_DefaultDeviceAssignment(
   if (args->default_assignment_size < want)
     return make_error("default assignment buffer too small",
                       PJRT_Error_Code_INVALID_ARGUMENT);
-  for (size_t i = 0; i < want; ++i) args->default_assignment[i] = 0;
+  /* round-robin across the advertised devices (all 0 when only one is
+   * advertised — the v1 behavior) */
+  auto* c = AS_CLIENT(args->client);
+  size_t ndev = c->devices.empty() ? 1 : c->devices.size();
+  for (size_t i = 0; i < want; ++i)
+    args->default_assignment[i] = (int)(i % ndev);
   return nullptr;
 }
 
@@ -1254,9 +1291,15 @@ PJRT_Error* tpf_LoadedExecutable_AddressableDevices(
     PJRT_LoadedExecutable_AddressableDevices_Args* args) {
   TPF_TRACE();
   auto* exe = AS_EXE(args->executable);
+  /* ONE device, not the whole advertised mesh: the runtime sizes its
+   * per-device argument/output lists from this — advertising n devices
+   * here makes it treat every executable as n-way sharded and fail
+   * ("expected args to have n shards").  v1 executables are compiled
+   * for (worker) device 0. */
   args->addressable_devices = reinterpret_cast<PJRT_Device* const*>(
       exe->client->devices.data());
-  args->num_addressable_devices = exe->client->devices.size();
+  args->num_addressable_devices =
+      exe->client->devices.empty() ? 0 : 1;
   return nullptr;
 }
 
@@ -1274,6 +1317,10 @@ PJRT_Error* tpf_LoadedExecutable_IsDeleted(
   return nullptr;
 }
 
+/* PJRT_LoadedExecutable_GetDeviceAssignment only exists from PJRT C API
+ * 0.76 — older vendored headers (e.g. tensorflow's 0.72) have neither
+ * the slot nor its args struct, so the whole handler is conditional. */
+#if defined(PJRT_API_MINOR) && PJRT_API_MINOR >= 76
 PJRT_Error* tpf_LoadedExecutable_GetDeviceAssignment(
     PJRT_LoadedExecutable_GetDeviceAssignment_Args* args) {
   TPF_TRACE();
@@ -1293,6 +1340,7 @@ PJRT_Error* tpf_LoadedExecutable_GetDeviceAssignment(
       [](PJRT_DeviceAssignmentSerialized*) {};
   return nullptr;
 }
+#endif  /* PJRT_API_MINOR >= 76 */
 
 PJRT_Error* tpf_LoadedExecutable_Execute(
     PJRT_LoadedExecutable_Execute_Args* args) {
@@ -1300,9 +1348,13 @@ PJRT_Error* tpf_LoadedExecutable_Execute(
   auto* exe = AS_EXE(args->executable);
   auto* c = exe->client;
   if (args->num_devices != 1)
-    return make_error("tpf remote plugin executes on exactly 1 device, "
-                      "got " + std::to_string(args->num_devices),
-                      PJRT_Error_Code_UNIMPLEMENTED);
+    return make_error(
+        "UNIMPLEMENTED(PJRT_LoadedExecutable_Execute): sharded execute "
+        "across " + std::to_string(args->num_devices) + " devices is "
+        "not implemented in the transparent plugin yet — use the "
+        "cooperative client (remoting/client.py remote_jit), which "
+        "drives the worker mesh over protocol v3",
+        PJRT_Error_Code_UNIMPLEMENTED);
 
   /* surface any earlier pipelined failure before queueing more work */
   std::string aerr;
@@ -1397,16 +1449,28 @@ PJRT_Error* tpf_Client_BufferFromHostBuffer(
   wb.data.resize(n);
   if (n) memcpy(wb.data.data(), args->data, n);
 
+  /* modern runtimes pass the target as a memory, older ones as a
+   * device; memory ids are 1:1 with device ids here */
+  TpfDevice* target = c->devices[0];
+  if (args->device != nullptr) {
+    target = AS_DEVICE(args->device);
+  } else if (args->memory != nullptr) {
+    auto* mem = AS_MEMORY(args->memory);
+    if (mem->id >= 0 && (size_t)mem->id < c->devices.size())
+      target = c->devices[mem->id];
+  }
+  /* target the worker-mesh device matching this PJRT device (v3; a v2
+   * worker ignores the field and uses its device 0) */
+  std::string put_meta = "\"device_id\":" + std::to_string(target->id);
   JVal rmeta;
   std::vector<WireBuffer> rbufs;
-  PJRT_Error* err = do_rpc(c, "PUT", "", {{&wb, nullptr}}, &rmeta,
+  PJRT_Error* err = do_rpc(c, "PUT", put_meta, {{&wb, nullptr}}, &rmeta,
                            &rbufs);
   if (err != nullptr) return err;
 
   auto* buf = new TpfBuffer();
   buf->client = c;
-  buf->device = args->device != nullptr ? AS_DEVICE(args->device)
-                                        : c->devices[0];
+  buf->device = target;
   buf->buf_id = rmeta.at("buf_id").str;
   buf->dims.assign(args->dims, args->dims + args->num_dims);
   buf->dtype = info;
@@ -1686,8 +1750,10 @@ const PJRT_Api* GetPjrtApi(void) {
   g_api.PJRT_LoadedExecutable_Delete = tpf_LoadedExecutable_Delete;
   g_api.PJRT_LoadedExecutable_IsDeleted = tpf_LoadedExecutable_IsDeleted;
   g_api.PJRT_LoadedExecutable_Execute = tpf_LoadedExecutable_Execute;
+#if defined(PJRT_API_MINOR) && PJRT_API_MINOR >= 76
   g_api.PJRT_LoadedExecutable_GetDeviceAssignment =
       tpf_LoadedExecutable_GetDeviceAssignment;
+#endif
 
   g_api.PJRT_Buffer_Destroy = tpf_Buffer_Destroy;
   g_api.PJRT_Buffer_ElementType = tpf_Buffer_ElementType;

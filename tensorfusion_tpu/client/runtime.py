@@ -141,8 +141,13 @@ class VTPUClient:
                     continue    # host staging buffer, not HBM
                 kind = getattr(getattr(arr, "sharding", None),
                                "memory_kind", None)
-                if kind in ("pinned_host", "unpinned_host"):
-                    continue    # host-offloaded (spill contract), not HBM
+                if platform != "cpu" and \
+                        kind in ("pinned_host", "unpinned_host"):
+                    # host-offloaded (spill contract), not HBM.  On a
+                    # cpu backend host memory IS the device memory (its
+                    # default memory kind is unpinned_host), so the
+                    # exclusion only applies on accelerator backends.
+                    continue
                 total += int(getattr(arr, "nbytes", 0) or 0)
         except Exception:  # noqa: BLE001 - sampling must never kill
             log.debug("live-array walk failed", exc_info=True)
@@ -184,6 +189,21 @@ class VTPUClient:
         return getattr(getattr(leaf, "sharding", None), "memory_kind",
                        None)
 
+    @classmethod
+    def _already_host(cls, leaf) -> bool:
+        """True when the leaf is host-OFFLOADED (must not re-count
+        toward the spill budget).  On a cpu backend the DEFAULT memory
+        kind is ``unpinned_host`` — that is device memory there, not an
+        offload, so only an explicit ``pinned_host`` placement counts."""
+        kind = cls._leaf_kind(leaf)
+        if kind == "pinned_host":
+            return True
+        if kind not in cls._HOST_KINDS:
+            return False
+        import jax
+
+        return jax.default_backend() != "cpu"
+
     def host_offload(self, tree):
         """Move every device-resident array leaf to host memory
         (``pinned_host`` memory kind): jitted code consumes it through
@@ -192,8 +212,7 @@ class VTPUClient:
         import jax
 
         def move(leaf):
-            if not hasattr(leaf, "nbytes") or \
-                    self._leaf_kind(leaf) in self._HOST_KINDS:
+            if not hasattr(leaf, "nbytes") or self._already_host(leaf):
                 return leaf
             moved = jax.device_put(
                 leaf, self._rekinded_sharding(leaf, "pinned_host"))
@@ -209,7 +228,7 @@ class VTPUClient:
 
         def move(leaf):
             if not hasattr(leaf, "nbytes") or \
-                    self._leaf_kind(leaf) not in self._HOST_KINDS:
+                    not self._already_host(leaf):
                 return leaf
             moved = jax.device_put(
                 leaf, self._rekinded_sharding(leaf, "device"))
@@ -240,7 +259,7 @@ class VTPUClient:
             nbytes = int(getattr(leaf, "nbytes", 0) or 0)
             # already-host leaves must not re-count: that would satisfy
             # the budget on paper while HBM stays over physical
-            if nbytes == 0 or self._leaf_kind(leaf) in self._HOST_KINDS:
+            if nbytes == 0 or self._already_host(leaf):
                 continue
             leaves[i] = jax.device_put(
                 leaf, self._rekinded_sharding(leaf, "pinned_host"))

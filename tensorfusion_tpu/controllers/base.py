@@ -69,7 +69,13 @@ class ControllerManager:
             c.on_start()
         except Exception:
             log.exception("controller %s on_start failed", c.name)
-        watch = self.store.watch(*c.kinds)
+        # conflate=1: reconcile() is level-triggered (it re-reads the
+        # object), so only the NEWEST event per object matters.  Against
+        # a RemoteStore this rides the gateway's conflated long-poll
+        # path, which keeps watch lag flat under churn where the
+        # unconflated path degrades to multi-second p95 at scale;
+        # in-process stores accept and ignore the flag.
+        watch = self.store.watch(*c.kinds, conflate=True)
         last_resync = time.monotonic()
         try:
             while not stop.is_set():

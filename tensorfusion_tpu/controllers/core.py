@@ -34,6 +34,28 @@ from .base import Controller
 
 log = logging.getLogger("tpf.controller")
 
+#: templates already warned about setting the not-yet-consumed
+#: ``rebalancer_enabled`` flag (warn once per template, not per resync)
+_rebalancer_warned: set = set()
+
+
+def warn_unconsumed_rebalancer(tmpl) -> bool:
+    """``SchedulingConfigTemplate.spec.rebalancer_enabled`` has no
+    consuming controller yet — a silent no-op config is worse than an
+    absent one, so the first pool reconcile that reads such a template
+    says so out loud.  Returns True when the warning fired."""
+    if not getattr(tmpl.spec, "rebalancer_enabled", False):
+        return False
+    if tmpl.metadata.name in _rebalancer_warned:
+        return False
+    _rebalancer_warned.add(tmpl.metadata.name)
+    log.warning(
+        "SchedulingConfigTemplate %s sets rebalancer_enabled=true, but "
+        "no rebalancer controller exists yet — the flag is currently a "
+        "no-op and chip allocations will NOT be rebalanced",
+        tmpl.metadata.name)
+    return True
+
 
 class ClusterController(Controller):
     """TPUCluster -> ensure its pools exist (tensorfusioncluster_controller)."""
@@ -99,6 +121,7 @@ class PoolController(Controller):
                                           pool.spec.scheduling_config_template)
                 if tmpl is not None:
                     placement = tmpl.spec.placement_mode
+                    warn_unconsumed_rebalancer(tmpl)
             self.allocator.set_pool_strategy(pool.name, placement)
             members = by_pool.get(pool.name, [])
             cap = pool.status.capacity

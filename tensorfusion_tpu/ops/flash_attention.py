@@ -41,6 +41,10 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax >= 0.6 renamed TPUCompilerParams -> CompilerParams
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    pltpu.TPUCompilerParams
+
 log = logging.getLogger("tpf.ops.flash")
 
 NEG_INF = -1e30
@@ -145,7 +149,7 @@ def _flash_fwd_pallas(q, k, v, scale: float, causal: bool,
             pltpu.VMEM((block, 1), jnp.float32),    # running denominator
             pltpu.VMEM((block, d), jnp.float32),    # output accumulator
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary")),
         interpret=interpret,
     )(q, k, v)
@@ -252,7 +256,7 @@ def _flash_bwd_pallas(q, k, v, do, lse, delta, scale: float, causal: bool,
     qkv_spec_j = pl.BlockSpec((1, block, d), lambda b, i, j: (b, j, 0))
     row_spec_i = pl.BlockSpec((1, block), lambda b, i, j: (b, i))
     row_spec_j = pl.BlockSpec((1, block), lambda b, i, j: (b, j))
-    params = pltpu.CompilerParams(
+    params = _CompilerParams(
         dimension_semantics=("parallel", "arbitrary", "arbitrary"))
 
     dq = pl.pallas_call(

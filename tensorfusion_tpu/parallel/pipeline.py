@@ -21,7 +21,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:                      # jax < 0.5 keeps it experimental
+    from jax.experimental.shard_map import shard_map
 
 
 def pipeline_stages(stage_fn: Callable, params, x, axis_name: str = "pp"):

@@ -27,7 +27,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:                      # jax < 0.5 keeps it experimental
+    from jax.experimental.shard_map import shard_map
 
 NEG_INF = -1e30
 

@@ -1,4 +1,5 @@
 """Remote-vTPU: StableHLO-level remoting over Ethernet/DCN."""
 
-from .client import RemoteBuffer, RemoteDevice, RemoteExecutionError
+from .client import (RemoteBuffer, RemoteDevice, RemoteExecutionError,
+                     ShardedRemoteBuffer)
 from .worker import RemoteVTPUWorker

@@ -15,11 +15,23 @@ and requests are *pipelined* — a reader thread matches responses to
 requests by sequence number, so ``wrapped.submit(...)`` can keep many
 executions in flight on one connection and hide DCN round-trip latency
 (the <4%-overhead serving pattern, README.md:56).
+
+Multi-device (protocol v3): ``remote_jit`` detects sharded ``jax.jit``
+functions — in/out shardings survive ``jax.export`` — and drives the
+worker's whole mesh over the same single connection.  Host arrays are
+split into per-device shards against the layout the worker returned at
+COMPILE; big shards are uploaded as pipelined fire-and-forget PUTs (the
+wire transfer of shard k+1 overlaps the worker's scatter/execution of
+shard k) while small shards ride inline in the EXECUTE frame; sharded
+weights can be made device-resident once with ``wrapped.upload_arg``.
+The HELLO handshake negotiates the version, so a v3 client degrades to
+plain single-device v2 against an old worker and vice versa.
 """
 
 from __future__ import annotations
 
 import functools
+import itertools
 import json
 import logging
 import os
@@ -27,13 +39,20 @@ import socket
 import threading
 import urllib.request
 from concurrent.futures import Future
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from . import protocol
 from .protocol import recv_message, send_message
 
 log = logging.getLogger("tpf.remoting.client")
+
+#: shards at or above this size are uploaded as separate pipelined PUT
+#: frames (transfer overlaps the worker's scatter of earlier shards);
+#: smaller shards ride inline in the EXECUTE frame, where one header
+#: covers all of them (per-frame overhead beats overlap at this size)
+SHARD_PUT_MIN_BYTES = 256 << 10
 
 
 class RemoteExecutionError(RuntimeError):
@@ -44,11 +63,13 @@ class RemoteBuffer:
     """Handle to a device-resident array on the worker (upload once with
     RemoteDevice.put, reference in remote_jit calls)."""
 
-    def __init__(self, device: "RemoteDevice", buf_id: str, shape, dtype):
+    def __init__(self, device: "RemoteDevice", buf_id: str, shape, dtype,
+                 device_id: int = 0):
         self.device = device
         self.buf_id = buf_id
         self.shape = tuple(shape)
         self.dtype = np.dtype(dtype) if dtype != "bfloat16" else dtype
+        self.device_id = device_id
 
     def fetch(self) -> np.ndarray:
         _, _, bufs = self.device._rpc("FETCH", {"buf_id": self.buf_id}, [])
@@ -58,9 +79,36 @@ class RemoteBuffer:
         self.device._rpc("FREE", {"buf_ids": [self.buf_id]}, [])
 
 
+class ShardedRemoteBuffer:
+    """Handle to an array resident on the worker as per-device shards
+    (one buffer per mesh device, uploaded by ``remote.upload_arg``).
+    Usable as the corresponding argument of the sharded function that
+    produced its layout; per-call wire traffic then skips it entirely."""
+
+    def __init__(self, device: "RemoteDevice", shard_ids: List[str],
+                 layout: List[dict], shape, dtype):
+        self.device = device
+        self.shard_ids = list(shard_ids)
+        self.layout = layout
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype) if dtype != "bfloat16" else dtype
+
+    def fetch(self) -> np.ndarray:
+        """Reassemble the full host array from its resident shards."""
+        out = np.empty(self.shape, self.dtype)
+        for sid, ent in zip(self.shard_ids, self.layout):
+            _, _, bufs = self.device._rpc("FETCH", {"buf_id": sid}, [])
+            out[tuple(slice(lo, hi) for lo, hi in ent["slices"])] = bufs[0]
+        return out
+
+    def free(self) -> None:
+        self.device._rpc("FREE", {"buf_ids": list(self.shard_ids)}, [])
+
+
 class RemoteDevice:
     def __init__(self, url: str, token: Optional[str] = None,
-                 timeout_s: float = 300.0):
+                 timeout_s: float = 300.0,
+                 protocol_version: int = protocol.VERSION):
         # url: "tcp://host:port"
         if url.startswith("tcp://"):
             url = url[len("tcp://"):]
@@ -69,11 +117,20 @@ class RemoteDevice:
         self.token = token if token is not None else \
             os.environ.get("TPF_REMOTING_TOKEN", "")
         self.timeout_s = timeout_s
+        #: highest wire version this client will speak; pinning to 2
+        #: makes it frame-faithful to a v2 build (mixed-version tests)
+        self.protocol_version = protocol_version
+        #: negotiated per connection by the HELLO exchange
+        self._wire_version = 2
         self._sock: Optional[socket.socket] = None
         self._send_lock = threading.Lock()
         self._state_lock = threading.Lock()
         self._pending: Dict[int, Future] = {}
         self._seq = 0
+        self._mint = itertools.count(1)   # client-minted shard buf ids
+        #: frame versions this client build decodes
+        self._accept = tuple(v for v in protocol.SUPPORTED_VERSIONS
+                             if v <= self.protocol_version)
 
     @staticmethod
     def from_connection(operator_url: str, name: str,
@@ -96,12 +153,20 @@ class RemoteDevice:
         sock = socket.create_connection((self.host, self.port), timeout=60)
         # pipelined small headers must not Nagle-stall behind buffers
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        send_message(sock, "HELLO", {"token": self.token}, [])
-        kind, meta, _ = recv_message(sock)
+        # HELLO is always framed at v2 so any worker can read it; the
+        # version the connection actually runs at comes back in HELLO_OK
+        hello = {"token": self.token}
+        if self.protocol_version > 2:
+            hello["max_version"] = self.protocol_version
+        send_message(sock, "HELLO", hello, [],
+                     version=protocol.HELLO_VERSION)
+        kind, meta, _ = recv_message(sock, accept=self._accept)
         if kind != "HELLO_OK":
             sock.close()
             raise RemoteExecutionError(
                 meta.get("error", "remoting handshake failed"))
+        self._wire_version = max(2, min(self.protocol_version,
+                                        int(meta.get("version", 2))))
         # per-request deadlines are enforced via Future.result(timeout_s);
         # a socket timeout here would kill every pipelined request the
         # moment one response gap exceeds it
@@ -113,7 +178,7 @@ class RemoteDevice:
     def _read_loop(self, sock: socket.socket) -> None:
         try:
             while True:
-                kind, meta, bufs = recv_message(sock)
+                kind, meta, bufs = recv_message(sock, accept=self._accept)
                 with self._state_lock:
                     fut = self._pending.pop(meta.get("seq"), None)
                 if fut is not None:
@@ -149,26 +214,36 @@ class RemoteDevice:
                     fut.set_exception(ConnectionError("device closed"))
 
     def _submit(self, kind: str, meta: Dict[str, Any], buffers,
-                compress: bool = True) -> Future:
+                compress: bool = True,
+                want_reply: bool = True) -> Optional[Future]:
         """Send one request without waiting; the returned Future resolves
-        to (kind, meta, buffers) when its response arrives."""
+        to (kind, meta, buffers) when its response arrives.  With
+        ``want_reply=False`` the request carries no seq and returns None
+        (fire-and-forget — quiet shard PUTs whose failures surface at
+        the EXECUTE that references them)."""
         with self._send_lock:
             if self._sock is None:
                 self._connect_locked()
-            self._seq += 1
-            seq = self._seq
-            wire_meta = dict(meta, seq=seq)
-            fut: Future = Future()
-            with self._state_lock:
-                self._pending[seq] = fut
+            fut: Optional[Future] = None
+            if want_reply:
+                self._seq += 1
+                seq = self._seq
+                wire_meta = dict(meta, seq=seq)
+                fut = Future()
+                with self._state_lock:
+                    self._pending[seq] = fut
+            else:
+                wire_meta = dict(meta)
             try:
                 send_message(self._sock, kind, wire_meta, buffers,
-                             compress=compress)
+                             compress=compress,
+                             version=self._wire_version)
             except (ConnectionError, OSError):
                 # one reconnect attempt (worker restarts, idle timeouts);
                 # every other in-flight request died with the old socket
                 with self._state_lock:
-                    self._pending.pop(seq, None)
+                    if want_reply:
+                        self._pending.pop(seq, None)
                     dead, self._pending = self._pending, {}
                 for f in dead.values():
                     if not f.done():
@@ -177,10 +252,12 @@ class RemoteDevice:
                     self._sock.close()
                     self._sock = None
                 self._connect_locked()
-                with self._state_lock:
-                    self._pending[seq] = fut
+                if want_reply:
+                    with self._state_lock:
+                        self._pending[seq] = fut
                 send_message(self._sock, kind, wire_meta, buffers,
-                             compress=compress)
+                             compress=compress,
+                             version=self._wire_version)
             return fut
 
     def _result(self, fut: Future) -> Tuple:
@@ -204,11 +281,27 @@ class RemoteDevice:
         _, meta, _ = self._rpc("INFO", {}, [])
         return meta
 
-    def put(self, array) -> RemoteBuffer:
+    def put(self, array, device_id: int = 0) -> RemoteBuffer:
         arr = np.asarray(array)
-        _, meta, _ = self._rpc("PUT", {}, [arr])
-        return RemoteBuffer(self, meta["buf_id"], arr.shape,
-                            arr.dtype.name)
+        meta: Dict[str, Any] = {}
+        if device_id and self._ensure_v3(
+                f"PUT to device {device_id}"):
+            meta["device_id"] = device_id
+        _, rmeta, _ = self._rpc("PUT", meta, [arr])
+        return RemoteBuffer(self, rmeta["buf_id"], arr.shape,
+                            arr.dtype.name,
+                            device_id=rmeta.get("device_id", 0))
+
+    def _ensure_v3(self, what: str) -> bool:
+        """True when the (established) connection speaks v3; raises with
+        a useful message otherwise."""
+        if self._sock is None:
+            self.info()     # dials + negotiates
+        if self._wire_version < 3:
+            raise RemoteExecutionError(
+                f"{what} needs protocol v3 but the worker only "
+                f"speaks v{self._wire_version}")
+        return True
 
     def snapshot(self, state_dir: str) -> Dict[str, Any]:
         _, meta, _ = self._rpc("SNAPSHOT", {"state_dir": state_dir}, [])
@@ -224,19 +317,34 @@ class RemoteDevice:
         """Wrap ``fn`` so calls execute on the remote worker.  Functions
         must take/return array pytrees; tracing happens locally.  The
         wrapper also exposes ``.submit(*args) -> Future`` for pipelined
-        calls (many in flight on one connection)."""
-        import jax
+        calls (many in flight on one connection).
 
-        exe_ids: Dict[Any, Tuple[str, Any]] = {}
+        ``fn`` may be an already-jitted function with in/out shardings
+        (``jax.jit(f, in_shardings=..., out_shardings=...)``): the
+        shardings survive ``jax.export``, the worker compiles against
+        its own mesh, and calls run sharded across all its devices —
+        host arrays are split into per-device shards client-side and
+        their uploads pipelined on the one connection.  ``.upload_arg``
+        parks a sharded argument device-resident (per-device shards) so
+        per-call wire traffic skips it."""
+        import jax
+        import jax.export    # explicit: jax lazy-loads the submodule
+
+        #: sig -> (exe_id, out_tree, arg_layouts|None, out_sigs)
+        exe_ids: Dict[Any, Tuple[str, Any, Optional[list], list]] = {}
         device = self
+        is_ref = (RemoteBuffer, ShardedRemoteBuffer)
+        # respect a caller-provided jit (its shardings ARE the mesh
+        # contract); only bare functions get wrapped here
+        jitted = fn if isinstance(fn, jax.stages.Wrapped) else jax.jit(fn)
 
         def leaf_sig(l):
-            if isinstance(l, RemoteBuffer):
+            if isinstance(l, is_ref):
                 return (l.shape, str(l.dtype))
             return (tuple(np.shape(l)), np.asarray(l).dtype.name)
 
         def spec_of(l):
-            if isinstance(l, RemoteBuffer):
+            if isinstance(l, is_ref):
                 dt = l.dtype
                 if dt == "bfloat16":
                     import ml_dtypes
@@ -247,50 +355,129 @@ class RemoteDevice:
 
         def prepare(args):
             leaves, treedef = jax.tree_util.tree_flatten(
-                args, is_leaf=lambda x: isinstance(x, RemoteBuffer))
+                args, is_leaf=lambda x: isinstance(x, is_ref))
             sig = (tuple(leaf_sig(l) for l in leaves), treedef)
             entry = exe_ids.get(sig)
             if entry is None:
                 specs = jax.tree_util.tree_unflatten(
                     treedef, [spec_of(l) for l in leaves])
-                jitted = jax.jit(fn)
                 exported = jax.export.export(jitted)(*specs)
                 blob = exported.serialize()
                 try:
                     analysis = jitted.lower(*specs).compile() \
                         .cost_analysis() or {}
+                    # jax 0.4.x returns [per-partition dict], >=0.5 a
+                    # single dict
+                    if isinstance(analysis, (list, tuple)):
+                        analysis = analysis[0] if analysis else {}
                     mflops = max(int(analysis.get("flops", 0) / 1e6), 1)
                 except Exception:  # noqa: BLE001
                     mflops = 1
                 _, meta, _ = device._rpc(
                     "COMPILE", {"mflops_hint": mflops},
                     [np.frombuffer(blob, dtype=np.uint8)])
-                out_tree = jax.tree_util.tree_structure(
-                    jax.eval_shape(fn, *specs))
-                entry = (meta["exe_id"], out_tree)
+                out_shapes = jax.eval_shape(jitted, *specs)
+                out_tree = jax.tree_util.tree_structure(out_shapes)
+                out_sigs = [(tuple(l.shape), l.dtype.name)
+                            for l in jax.tree_util.tree_leaves(out_shapes)]
+                layouts = meta.get("arg_layouts")
+                if exported.nr_devices > 1 and layouts is None:
+                    raise RemoteExecutionError(
+                        f"function is sharded over "
+                        f"{exported.nr_devices} devices but the worker "
+                        f"did not return shard layouts (protocol "
+                        f"v{device._wire_version}; sharded execution "
+                        f"needs a v3 worker)")
+                entry = (meta["exe_id"], out_tree, layouts, out_sigs)
                 exe_ids[sig] = entry
-            exe_id, out_tree = entry
-            arg_refs = [l.buf_id if isinstance(l, RemoteBuffer) else None
-                        for l in leaves]
-            buffers = [np.asarray(l) for l in leaves
-                       if not isinstance(l, RemoteBuffer)]
-            return exe_id, out_tree, arg_refs, buffers
+            return entry, leaves
+
+        def send_execute(entry, leaves, extra_meta=None,
+                         want_reply=True) -> Optional[Future]:
+            """Build + fire the (possibly sharded) EXECUTE; returns the
+            raw transport future (None for fire-and-forget)."""
+            exe_id, out_tree, layouts, _ = entry
+            extra = extra_meta or {}
+            arg_refs: list = []
+            buffers: list = []
+            if layouts is None:
+                # single-device path: wire-identical to protocol v2
+                for leaf in leaves:
+                    if isinstance(leaf, RemoteBuffer):
+                        arg_refs.append(leaf.buf_id)
+                    else:
+                        arg_refs.append(None)
+                        buffers.append(np.asarray(leaf))
+                return device._submit(
+                    "EXECUTE", dict(extra, exe_id=exe_id,
+                                    arg_refs=arg_refs),
+                    buffers, want_reply=want_reply)
+            # sharded path: split host leaves per the worker's layout;
+            # big shards go out as pipelined quiet PUTs so their wire
+            # transfer overlaps the worker's scatter of earlier shards,
+            # small ones ride the EXECUTE frame itself
+            arg_shards: list = []
+            for i, leaf in enumerate(leaves):
+                lay = layouts[i]
+                if isinstance(leaf, ShardedRemoteBuffer):
+                    arg_refs.append(None)
+                    arg_shards.append(list(leaf.shard_ids))
+                elif isinstance(leaf, RemoteBuffer):
+                    arg_refs.append(leaf.buf_id)
+                    arg_shards.append(None)
+                elif lay is None:
+                    arg_refs.append(None)
+                    arg_shards.append(None)
+                    buffers.append(np.asarray(leaf))
+                else:
+                    host = np.ascontiguousarray(np.asarray(leaf))
+                    ctr = next(device._mint)
+                    ids: list = []
+                    for k, ent in enumerate(lay):
+                        view = np.ascontiguousarray(host[tuple(
+                            slice(lo, hi) for lo, hi in ent["slices"])])
+                        if view.nbytes >= SHARD_PUT_MIN_BYTES:
+                            sid = f"c-a{ctr}-{k}"
+                            device._submit(
+                                "PUT",
+                                {"buf_id": sid,
+                                 "device_id": ent["device"],
+                                 "ephemeral": True, "quiet": True},
+                                [view], want_reply=False)
+                            ids.append(sid)
+                        else:
+                            ids.append(None)     # inline in EXECUTE
+                            buffers.append(view)
+                    arg_refs.append(None)
+                    arg_shards.append(ids)
+            return device._submit(
+                "EXECUTE", dict(extra, exe_id=exe_id, arg_refs=arg_refs,
+                                arg_shards=arg_shards), buffers,
+                want_reply=want_reply)
 
         @functools.wraps(fn)
         def remote(*args):
-            exe_id, out_tree, arg_refs, buffers = prepare(args)
-            _, rmeta, results = device._rpc(
-                "EXECUTE", {"exe_id": exe_id, "arg_refs": arg_refs},
-                buffers)
-            return jax.tree_util.tree_unflatten(out_tree, results)
+            entry, leaves = prepare(args)
+            for attempt in (0, 1):
+                fut = send_execute(entry, leaves)
+                try:
+                    _, rmeta, results = device._result(fut)
+                    return jax.tree_util.tree_unflatten(entry[1],
+                                                        results)
+                except ConnectionError:
+                    # one reconnect attempt, like _rpc: send_execute
+                    # re-fires any shard PUTs on the fresh connection
+                    if attempt:
+                        raise
+                    device.close()
+            raise RemoteExecutionError("unreachable")
 
         def submit(*args) -> Future:
             """Pipelined call: returns a Future resolving to the result
             pytree without blocking for the round trip."""
-            exe_id, out_tree, arg_refs, buffers = prepare(args)
-            raw = device._submit(
-                "EXECUTE", {"exe_id": exe_id, "arg_refs": arg_refs},
-                buffers)
+            entry, leaves = prepare(args)
+            raw = send_execute(entry, leaves)
+            out_tree = entry[1]
             out: Future = Future()
 
             def _chain(f: Future):
@@ -307,6 +494,89 @@ class RemoteDevice:
             raw.add_done_callback(_chain)
             return out
 
+        def compile_for(*args):
+            """Compile for this argument signature without executing
+            (arrays or ShapeDtypeStructs both work as examples)."""
+            return prepare(args)[0]
+
+        def step_resident(*args, free: Tuple = (), wait: bool = False):
+            """Execute with results kept device-resident (sharded
+            results stay scattered across the mesh) and return handles
+            WITHOUT waiting for any round trip: result ids are
+            client-minted and the request is fire-and-forget, so a
+            chain ``state = remote.step_resident(state)`` streams at
+            the worker's service rate — the T3 pattern, wire traffic
+            per step is just buffer ids.  ``free=`` fire-and-forgets
+            FREEs of no-longer-needed handles (e.g. the previous
+            state) in the same breath.  Errors surface at the next
+            synchronous boundary (a fetch of these handles).
+            ``wait=True`` turns the step into one round trip (the
+            worker acks after the results are parked) — for control
+            loops that must observe completion before proceeding."""
+            device._ensure_v3("step_resident (client-minted result ids)")
+            entry, leaves = prepare(args)
+            _, out_tree, _, out_sigs = entry
+            ctr = next(device._mint)
+            ids = [f"c-r{ctr}-{j}" for j in range(len(out_sigs))]
+            fut = send_execute(
+                entry, leaves,
+                extra_meta={"keep_results": True, "result_ids": ids,
+                            **({} if wait else {"quiet": True})},
+                want_reply=wait)
+            if free:
+                dead = []
+                for h in (free if isinstance(free, (tuple, list))
+                          else (free,)):
+                    dead.extend(getattr(h, "shard_ids", None)
+                                or [h.buf_id])
+                device._submit("FREE", {"buf_ids": dead, "quiet": True},
+                               [], want_reply=False)
+            if wait:
+                device._result(fut)
+            handles = [RemoteBuffer(device, i, shape, dtype)
+                       for i, (shape, dtype) in zip(ids, out_sigs)]
+            return jax.tree_util.tree_unflatten(out_tree, handles)
+
+        def upload_arg(index: int, array, *example_args
+                       ) -> "ShardedRemoteBuffer | RemoteBuffer":
+            """Park argument ``index`` device-resident ahead of calls.
+            For sharded arguments the array is split per the layout and
+            each shard PUT to its device (pipelined); replicated/plain
+            arguments become an ordinary resident buffer."""
+            if example_args:
+                entry = prepare(example_args)[0]
+            elif exe_ids:
+                # no example signature given: use the most recent one
+                entry = next(reversed(exe_ids.values()))
+            else:
+                raise RemoteExecutionError(
+                    "upload_arg needs the call signature: pass example "
+                    "args (upload_arg(i, array, *example_args)) or call "
+                    "the function once first")
+            _, _, layouts, _ = entry
+            lay = layouts[index] if layouts is not None else None
+            host = np.ascontiguousarray(np.asarray(array))
+            if lay is None:
+                return device.put(host)
+            ctr = next(device._mint)
+            futs, ids, wire_lay = [], [], []
+            for k, ent in enumerate(lay):
+                sid = f"c-w{ctr}-{k}"
+                view = np.ascontiguousarray(host[tuple(
+                    slice(lo, hi) for lo, hi in ent["slices"])])
+                futs.append(device._submit(
+                    "PUT", {"buf_id": sid, "device_id": ent["device"]},
+                    [view]))
+                ids.append(sid)
+                wire_lay.append(ent)
+            for f in futs:      # surface upload errors before first use
+                device._result(f)
+            return ShardedRemoteBuffer(device, ids, wire_lay,
+                                       host.shape, host.dtype.name)
+
         remote._tpf_remote = True  # noqa: SLF001
         remote.submit = submit
+        remote.compile_for = compile_for
+        remote.upload_arg = upload_arg
+        remote.step_resident = step_resident
         return remote

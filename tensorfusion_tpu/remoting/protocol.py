@@ -14,15 +14,32 @@ ships StableHLO once and then only argument/result buffers:
 - EXECUTE: executable id + flat arg arrays -> flat result arrays.
 - INFO:    worker platform/device inventory for placement decisions.
 
-Framing (version 2): one JSON header line (length-prefixed) +
-concatenated buffers described by the header.  Each buffer is raw
-little-endian or zlib-compressed (``enc`` per buffer — large buffers are
-compressed when it actually shrinks them, which is what makes the
-protocol usable across DCN latencies/bandwidth).  Requests carry a
-``seq`` the responder echoes, so a client may pipeline many requests on
-one connection.  No pickle anywhere on the wire (workers must not
-execute attacker-controlled bytecode; StableHLO is data, not
-code-with-authority).
+Framing (version 3, wire-compatible with 2): one JSON header line
+(length-prefixed) + concatenated buffers described by the header.  Each
+buffer is raw little-endian or zlib-compressed (``enc`` per buffer —
+large buffers are compressed when it actually shrinks them, which is
+what makes the protocol usable across DCN latencies/bandwidth).
+Requests carry a ``seq`` the responder echoes, so a client may pipeline
+many requests on one connection.  No pickle anywhere on the wire
+(workers must not execute attacker-controlled bytecode; StableHLO is
+data, not code-with-authority).
+
+Version 3 adds multi-device fields, all additive JSON meta (the frame
+layout is unchanged — the version number exists so a v2 peer can refuse
+frames whose semantics it cannot honor):
+
+- PUT: optional ``device_id`` (target device), client-minted ``buf_id``
+  (``c-`` namespace), ``ephemeral`` (freed when first consumed by an
+  EXECUTE), ``quiet`` (no success reply — errors still reply).
+- EXECUTE: optional ``arg_shards`` — per flat argument, either null
+  (single buffer, exactly v2) or a list of resident shard buf_ids in
+  the executable's shard-layout order.
+- FETCH: optional ``shard_index`` to fetch one device's shard of a
+  sharded resident array.
+- HELLO: clients send ``max_version``; the responder's HELLO_OK
+  ``version`` is the negotiated wire version for the connection.  The
+  HELLO frame itself is always encoded at version 2 so a v2 peer can
+  read it — negotiation must happen *below* the feature gate.
 """
 
 from __future__ import annotations
@@ -36,7 +53,11 @@ from typing import Any, Dict, List, Tuple
 import numpy as np
 
 MAGIC = b"TPFR"
-VERSION = 2
+VERSION = 3
+#: frame versions this build can decode (v3 is additive over v2)
+SUPPORTED_VERSIONS = (2, 3)
+#: version every HELLO is framed at, so any peer can read it
+HELLO_VERSION = 2
 
 #: buffers at or above this size are candidates for compression
 COMPRESS_MIN_BYTES = 16 << 10
@@ -77,7 +98,8 @@ def _np_dtype(name: str):
 
 def encode_message_parts(kind: str, meta: Dict[str, Any],
                          buffers: List[np.ndarray],
-                         compress: bool = False) -> List:
+                         compress: bool = False,
+                         version: int = VERSION) -> List:
     """Wire pieces for one message: [head_bytes, buf_view, ...].
 
     Buffer payloads stay as zero-copy memoryviews over the (contiguous)
@@ -109,17 +131,19 @@ def encode_message_parts(kind: str, meta: Dict[str, Any],
         views.append(wire)
     header = json.dumps({"kind": kind, "meta": meta,
                          "buffers": descs}).encode()
-    head = MAGIC + struct.pack("<II", VERSION, len(header)) + header
+    head = MAGIC + struct.pack("<II", version, len(header)) + header
     return [head] + views
 
 
 def encode_message(kind: str, meta: Dict[str, Any],
                    buffers: List[np.ndarray],
-                   compress: bool = False) -> bytes:
+                   compress: bool = False,
+                   version: int = VERSION) -> bytes:
     return b"".join(bytes(p) if not isinstance(p, (bytes, bytearray))
                     else p
                     for p in encode_message_parts(kind, meta, buffers,
-                                                  compress=compress))
+                                                  compress=compress,
+                                                  version=version))
 
 
 def _read_exact(sock: socket.socket, n: int) -> bytearray:
@@ -137,24 +161,26 @@ def _read_exact(sock: socket.socket, n: int) -> bytearray:
 
 
 def send_message(sock: socket.socket, kind: str, meta: Dict[str, Any],
-                 buffers: List[np.ndarray], compress: bool = False) -> None:
+                 buffers: List[np.ndarray], compress: bool = False,
+                 version: int = VERSION) -> None:
     # scatter-gather: header and each (possibly multi-MB) buffer go out
     # as separate sendalls straight from their memoryviews — no payload
     # concatenation.  TCP_NODELAY (set at connect) keeps the small
     # header from Nagle-stalling behind the previous buffer.
     for part in encode_message_parts(kind, meta, buffers,
-                                     compress=compress):
+                                     compress=compress, version=version):
         sock.sendall(part)
 
 
-def recv_message(sock: socket.socket
+def recv_message(sock: socket.socket,
+                 accept: Tuple[int, ...] = SUPPORTED_VERSIONS
                  ) -> Tuple[str, Dict[str, Any], List[np.ndarray]]:
     head = _read_exact(sock, len(MAGIC) + 8)
     if head[:4] != MAGIC:
         raise ValueError("bad magic")
     version, hlen = struct.unpack("<II", head[4:])
-    if version != VERSION:
-        raise ValueError(f"protocol version {version} != {VERSION}")
+    if version not in accept:
+        raise ValueError(f"protocol version {version} not in {accept}")
     if hlen > MAX_HEADER_BYTES:
         raise ValueError(f"header of {hlen} bytes exceeds cap")
     header = json.loads(_read_exact(sock, hlen))

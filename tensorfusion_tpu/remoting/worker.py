@@ -1,10 +1,23 @@
-"""Remote-vTPU worker: serves a TPU chip over TCP.
+"""Remote-vTPU worker: serves a TPU host's devices over TCP.
 
 The role of the reference's closed-source remote worker image
 (``ProviderImages.remoteWorker``): runs on the TPU host (optionally
 *under* the vTPU client runtime so remote tenants are metered like local
 ones), accepts COMPILE/EXECUTE/INFO messages, and keeps an executable
 cache keyed by content hash so repeated clients share compilations.
+
+Multi-device (protocol v3): the worker serves **all local devices as a
+mesh** behind one connection.  A client-exported sharded ``jax.jit``
+(``exported.nr_devices > 1``) compiles against a worker-local mesh; the
+COMPILE reply carries the per-argument shard layout so the client can
+split host arrays itself.  At EXECUTE, input shards are scattered to
+their devices concurrently (thread pool over ``jax.device_put``) —
+either from per-device resident buffers PUT ahead of the call (their
+transfer overlapped execution of the previous step) or from inline wire
+buffers — assembled with ``jax.make_array_from_single_device_arrays``,
+and results stay device-resident until fetched when ``keep_results`` is
+set (lazy gather).  PUT/FETCH/FREE take ``device_id`` fields; INFO
+advertises the device inventory with mesh coords.
 
 Hardening (beyond the round-1 prototype):
 
@@ -36,10 +49,12 @@ import logging
 import os
 import socketserver
 import threading
-from typing import Dict, Optional
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Dict, List, Optional
 
 import numpy as np
 
+from . import protocol
 from .protocol import recv_message, send_message
 
 log = logging.getLogger("tpf.remoting.worker")
@@ -50,8 +65,12 @@ class RemoteVTPUWorker:
                  meter_client=None, token: Optional[str] = None,
                  max_resident_bytes: int = 0,
                  compress: Optional[bool] = None,
-                 insecure: Optional[bool] = None):
+                 insecure: Optional[bool] = None,
+                 protocol_version: int = protocol.VERSION):
         self.meter_client = meter_client    # optional VTPUClient
+        #: highest wire version this worker speaks; pinning it to 2 makes
+        #: the worker byte-faithful to a v2 build (mixed-version tests)
+        self.protocol_version = protocol_version
         self.token = token if token is not None else \
             os.environ.get("TPF_REMOTING_TOKEN", "")
         # This socket compiles and executes caller-supplied StableHLO:
@@ -85,10 +104,23 @@ class RemoteVTPUWorker:
         self._mlir_exes: Dict[str, object] = {}
         #: exe_id -> [([dims...], dtype_name), ...] flat result signature
         self._exe_sigs: Dict[str, list] = {}
+        #: exe_id -> sharded-executable record (jitted flat call +
+        #: shardings + wire layouts) for multi-device exports
+        self._exe_sharded: Dict[str, dict] = {}
         self._buffers: Dict[str, object] = {}    # device-resident arrays
+        #: buf_id -> device id the buffer was PUT to (single-device
+        #: buffers; sharded results span devices and are not listed)
+        self._buf_device: Dict[str, int] = {}
+        #: buf_ids freed automatically when first consumed by an EXECUTE
+        #: (per-call input shards — the client fires them ahead of the
+        #: EXECUTE and never references them again)
+        self._ephemeral: set = set()
         self._buf_seq = 0
         self._conn_seq = 0            # per-connection id namespaces
         self._lock = threading.Lock()
+        #: scatter pool: concurrent jax.device_put of input shards (and
+        #: async PUTs) so H2D transfer of shard k+1 overlaps shard k
+        self._scatter_pool: Optional[ThreadPoolExecutor] = None
         #: per-exe_id in-flight compile locks (COMPILE_MLIR single-flight)
         self._compile_flights: Dict[str, threading.Lock] = {}
         outer = self
@@ -99,6 +131,23 @@ class RemoteVTPUWorker:
 
                 self.request.setsockopt(_socket.IPPROTO_TCP,
                                         _socket.TCP_NODELAY, 1)
+                # wire version for this connection: starts at 2 (every
+                # peer reads v2 frames) and is raised by the HELLO
+                # negotiation when both ends speak v3
+                self.wire_version = 2
+                # frame versions this worker build decodes
+                self.accept = tuple(
+                    v for v in protocol.SUPPORTED_VERSIONS
+                    if v <= outer.protocol_version)
+
+            def negotiate(self, meta) -> int:
+                try:
+                    want = int(meta.get("max_version", 2) or 2)
+                except (TypeError, ValueError):
+                    want = 2
+                self.wire_version = max(2, min(outer.protocol_version,
+                                               want))
+                return self.wire_version
 
             def handle(self):
                 # The HELLO exchange runs synchronously *before* the
@@ -131,7 +180,13 @@ class RemoteVTPUWorker:
                     for key in ("buf_ids", "arg_refs", "result_ids"):
                         if meta.get(key) is not None:
                             meta[key] = [xid(v) for v in meta[key]]
+                    if meta.get("arg_shards") is not None:
+                        meta["arg_shards"] = [
+                            [xid(v) for v in grp] if grp is not None
+                            else None
+                            for grp in meta["arg_shards"]]
                     meta["_conn_ns"] = conn_ns
+                    meta["_wire_version"] = self.wire_version
                     return meta
                 # Read-ahead: decode the next pipelined request while the
                 # current one computes, so inbound wire time overlaps
@@ -145,7 +200,8 @@ class RemoteVTPUWorker:
                 def _reader():
                     try:
                         while True:
-                            inbox.put(recv_message(self.request))
+                            inbox.put(recv_message(self.request,
+                                                   accept=self.accept))
                     except (ConnectionError, OSError, ValueError):
                         inbox.put(None)
 
@@ -175,12 +231,16 @@ class RemoteVTPUWorker:
                             if _seq is not None:
                                 rmeta = dict(rmeta, seq=_seq)
                             send_message(self.request, rkind, rmeta, rbufs,
-                                         compress=compress)
+                                         compress=compress,
+                                         version=self.wire_version)
 
                         if kind == "HELLO":
                             # repeated HELLO on an authed connection is a
-                            # no-op ack (clients retry it on reconnect)
-                            reply("HELLO_OK", {"version": 2}, [])
+                            # no-op ack (clients retry it on reconnect);
+                            # unauthenticated connections negotiate the
+                            # wire version here
+                            reply("HELLO_OK",
+                                  {"version": self.negotiate(meta)}, [])
                             continue
                         deferred = None
                         try:
@@ -202,13 +262,15 @@ class RemoteVTPUWorker:
 
             def _hello(self) -> bool:
                 """First frame must be a HELLO with the right token."""
-                kind, meta, _ = recv_message(self.request)
+                kind, meta, _ = recv_message(self.request,
+                                             accept=self.accept)
                 seq = meta.get("seq")
 
                 def reply(rkind, rmeta):
                     if seq is not None:
                         rmeta = dict(rmeta, seq=seq)
-                    send_message(self.request, rkind, rmeta, [])
+                    send_message(self.request, rkind, rmeta, [],
+                                 version=self.wire_version)
 
                 if kind != "HELLO":
                     reply("ERROR", {"error": "authentication required"})
@@ -217,7 +279,10 @@ class RemoteVTPUWorker:
                                            outer.token):
                     reply("ERROR", {"error": "bad token"})
                     return False
-                reply("HELLO_OK", {"version": 2})
+                # negotiate before replying so HELLO_OK itself is framed
+                # at the agreed version (both ends accept it: v3 clients
+                # read v2 and v3, v2 clients only ever negotiate 2)
+                reply("HELLO_OK", {"version": self.negotiate(meta)})
                 return True
 
         class Server(socketserver.ThreadingTCPServer):
@@ -275,6 +340,165 @@ class RemoteVTPUWorker:
         if self.meter_client is not None:
             self.meter_client.charge_hbm(-nbytes)
 
+    # -- multi-device helpers -------------------------------------------
+
+    def _pool(self) -> ThreadPoolExecutor:
+        """Scatter pool, created on first use (worker may be constructed
+        before jax initializes its backend)."""
+        with self._lock:
+            if self._scatter_pool is None:
+                import jax
+
+                self._scatter_pool = ThreadPoolExecutor(
+                    max_workers=max(4, min(16, len(jax.devices()))),
+                    thread_name_prefix="tpf-remote-scatter")
+            return self._scatter_pool
+
+    @staticmethod
+    def _resolve(arr):
+        """Materialize a buffer-table entry: async PUTs park a Future of
+        the device array; everything else is the array itself."""
+        return arr.result() if isinstance(arr, Future) else arr
+
+    def _take_shard(self, buf_id: str):
+        """Look up one input shard; ephemeral shards (per-call uploads)
+        are consumed — freed from the table and their resident bytes
+        released — because the client never references them again."""
+        with self._lock:
+            arr = self._buffers.get(buf_id)
+            ephemeral = buf_id in self._ephemeral
+        if arr is None:
+            raise KeyError(f"unknown buffer {buf_id}")
+        arr = self._resolve(arr)
+        if ephemeral:
+            with self._lock:
+                if self._buffers.pop(buf_id, None) is not None:
+                    self._ephemeral.discard(buf_id)
+                    self._buf_device.pop(buf_id, None)
+                    self._release_resident(arr)
+        return arr
+
+    @staticmethod
+    def _wire_layout(sharding, shape) -> Optional[List[dict]]:
+        """Serializable shard layout for one aval: a list (in the order
+        the worker will reassemble shards) of ``{"device": id, "slices":
+        [[lo, hi], ...]}``, or None when the argument is replicated (or
+        uses an exotic index layout) and should travel whole."""
+        if sharding.is_fully_replicated:
+            return None
+        entries = []
+        for dev, idx in sharding.devices_indices_map(tuple(shape)).items():
+            slices = []
+            for sl, dim in zip(idx, shape):
+                if sl.step not in (None, 1):
+                    return None     # strided shard: let jit scatter it
+                slices.append([int(sl.start or 0),
+                               int(dim if sl.stop is None else sl.stop)])
+            entries.append({"device": int(dev.id), "slices": slices})
+        return entries
+
+    def _build_sharded(self, exported) -> dict:
+        """Compile a multi-device export against a worker-local mesh and
+        precompute the wire shard layouts the client slices against."""
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        n = exported.nr_devices
+        devs = jax.devices()
+        if len(devs) < n:
+            raise ValueError(
+                f"executable is sharded over {n} devices but this worker "
+                f"has {len(devs)}")
+        mesh = Mesh(np.array(devs[:n]), ("_tpf_flat",))
+        replicated = NamedSharding(mesh, PartitionSpec())
+        in_sh = [s if s is not None else replicated
+                 for s in exported.in_shardings_jax(mesh)]
+        out_sh = [s if s is not None else replicated
+                  for s in exported.out_shardings_jax(mesh)]
+
+        def flat_call(*flat):
+            args, kwargs = jax.tree_util.tree_unflatten(
+                exported.in_tree, flat)
+            return jax.tree_util.tree_leaves(
+                exported.call(*args, **kwargs))
+
+        in_shapes = [tuple(a.shape) for a in exported.in_avals]
+        out_shapes = [tuple(a.shape) for a in exported.out_avals]
+        return {
+            "fn": jax.jit(flat_call, in_shardings=in_sh,
+                          out_shardings=out_sh),
+            "nr_devices": n,
+            "in_shapes": in_shapes,
+            "in_shardings": in_sh,
+            "arg_layouts": [self._wire_layout(s, shp)
+                            for s, shp in zip(in_sh, in_shapes)],
+            "out_layouts": [self._wire_layout(s, shp)
+                            for s, shp in zip(out_sh, out_shapes)],
+        }
+
+    def _gather_sharded_args(self, sharded: dict, arg_refs, arg_shards,
+                             inline_it) -> list:
+        """Assemble the flat argument list for a sharded executable.
+
+        Per argument: a shard group (resident buf_ids and/or inline wire
+        buffers, in layout order) becomes a global ``jax.Array`` via a
+        concurrent scatter + ``make_array_from_single_device_arrays``; a
+        plain resident ref or inline buffer is handed to jit as a host
+        array and scattered by XLA itself (replicated args, v2 callers).
+        """
+        import jax
+
+        devices = jax.devices()
+        n_args = len(sharded["in_shapes"])
+        args: list = []
+        for i in range(n_args):
+            group = arg_shards[i] if arg_shards is not None \
+                and i < len(arg_shards) else None
+            ref = arg_refs[i] if arg_refs is not None \
+                and i < len(arg_refs) else None
+            if group is not None:
+                layout = sharded["arg_layouts"][i]
+                if layout is None or len(group) != len(layout):
+                    raise KeyError(
+                        f"argument {i}: shard group of {len(group)} does "
+                        f"not match the executable's layout")
+                futs = []
+                for ent, sid in zip(layout, group):
+                    if sid is None:
+                        # inline shard: scatter from the wire buffer on
+                        # the pool so shard k+1's decode overlaps k's H2D
+                        host = np.asarray(next(inline_it))
+                        futs.append(self._pool().submit(
+                            jax.device_put, host,
+                            devices[ent["device"]]))
+                    else:
+                        futs.append(self._take_shard(sid))
+                parts = [f.result() if isinstance(f, Future) else f
+                         for f in futs]
+                args.append(jax.make_array_from_single_device_arrays(
+                    tuple(sharded["in_shapes"][i]),
+                    sharded["in_shardings"][i], parts))
+            elif ref is not None:
+                with self._lock:
+                    arr = self._buffers.get(ref)
+                if arr is None:
+                    raise KeyError(f"unknown buffer {ref}")
+                arr = self._resolve(arr)
+                sh = getattr(arr, "sharding", None)
+                if sh is not None and sh.is_equivalent_to(
+                        sharded["in_shardings"][i], np.ndim(arr)):
+                    # already sharded the way the executable wants it —
+                    # the device-resident chaining hot path (kept
+                    # results fed straight back in: zero re-scatter)
+                    args.append(arr)
+                else:
+                    # resident but laid out differently: re-scatter
+                    # from host (jit handles numpy inputs)
+                    args.append(np.asarray(arr))
+            else:
+                args.append(np.asarray(next(inline_it)))
+        return args
+
     # -- snapshot / restore (live-migration buffer half) ----------------
 
     def snapshot_to(self, state_dir: str) -> Dict[str, int]:
@@ -288,7 +512,9 @@ class RemoteVTPUWorker:
             buf_seq = self._buf_seq
         manifest = {"buf_seq": buf_seq, "buffers": {}, "executables": {}}
         for buf_id, arr in buffers.items():
-            arr = np.asarray(arr)
+            # async PUTs and sharded results materialize here (sharded
+            # arrays gather; they restore as single-device buffers)
+            arr = np.asarray(self._resolve(arr))
             path = os.path.join(state_dir, f"{buf_id}.npy")
             # bfloat16 has no npy representation: persist raw + dtype
             manifest["buffers"][buf_id] = {
@@ -309,6 +535,7 @@ class RemoteVTPUWorker:
         """Re-materialize a snapshot: device_put every buffer, re-compile
         every cached executable."""
         import jax
+        import jax.export    # explicit: jax lazy-loads the submodule
 
         from .protocol import _np_dtype
 
@@ -338,8 +565,12 @@ class RemoteVTPUWorker:
                     self._exe_costs[exe_id] = int(info.get("mflops",
                                                            mflops))
                 else:
-                    self._exe_cache[exe_id] = jax.jit(
-                        jax.export.deserialize(bytearray(blob)).call)
+                    exported = jax.export.deserialize(bytearray(blob))
+                    if exported.nr_devices > 1:
+                        self._exe_sharded[exe_id] = \
+                            self._build_sharded(exported)
+                    else:
+                        self._exe_cache[exe_id] = jax.jit(exported.call)
                     self._exe_costs[exe_id] = int(info.get("mflops", 1))
         return {"buffers": len(manifest["buffers"]),
                 "executables": len(manifest["executables"])}
@@ -392,13 +623,21 @@ class RemoteVTPUWorker:
         """Compile raw StableHLO for this worker's chip; returns
         (LoadedExecutable, signature, mflops)."""
         import jax
-        from jax._src.lib import _jax
 
         sig = self._mlir_result_signature(blob)
         backend = jax.devices()[0].client
-        exe = backend.compile_and_load(
-            blob, _jax.DeviceList((jax.devices()[0],)),
-            _jax.CompileOptions())
+        try:
+            # jax >= 0.5: explicit device list + load split out
+            from jax._src.lib import _jax
+
+            exe = backend.compile_and_load(
+                blob, _jax.DeviceList((jax.devices()[0],)),
+                _jax.CompileOptions())
+        except ImportError:
+            # jax 0.4.x: Client.compile compiles AND loads
+            from jax._src.lib import xla_client as xc
+
+            exe = backend.compile(blob, xc.CompileOptions())
         try:
             mflops = max(int((exe.cost_analysis() or {})
                              .get("flops", 0) / 1e6), 1)
@@ -412,13 +651,50 @@ class RemoteVTPUWorker:
         import jax
 
         if kind == "INFO":
-            dev = jax.devices()[0]
+            devices = jax.devices()
+            dev = devices[0]
+            # per-device resident footprint, computed by walking the
+            # table (INFO is rare; bookkeeping on the hot path is not
+            # worth it).  Sharded arrays contribute each shard to its
+            # own device.
+            per_device: Dict[int, int] = {d.id: 0 for d in devices}
+            with self._lock:
+                snapshot = dict(self._buffers)
+                buf_device = dict(self._buf_device)
+            for buf_id, arr in snapshot.items():
+                try:
+                    arr = self._resolve(arr)
+                except Exception:  # noqa: BLE001 - failed async PUT
+                    continue       # surfaces at the EXECUTE that uses it
+                shards = getattr(arr, "addressable_shards", None)
+                if shards and len(shards) > 1:
+                    for s in shards:
+                        per_device[s.device.id] = \
+                            per_device.get(s.device.id, 0) + s.data.nbytes
+                else:
+                    d = buf_device.get(buf_id, 0)
+                    per_device[d] = per_device.get(d, 0) + \
+                        self._leaf_nbytes(arr)
             reply("INFO_OK", {
                 "platform": dev.platform,
                 "device_kind": getattr(dev, "device_kind", ""),
-                "n_devices": len(jax.devices()),
+                "n_devices": len(devices),
+                "protocol_version": self.protocol_version,
+                # full inventory for placement: id + mesh coords (TPUs
+                # expose .coords; CPU/GPU devices report their index)
+                "devices": [
+                    {"id": int(d.id),
+                     "platform": d.platform,
+                     "device_kind": getattr(d, "device_kind", ""),
+                     "process_index": int(getattr(d, "process_index", 0)),
+                     "coords": [int(c) for c in
+                                getattr(d, "coords", None) or (d.id,)]}
+                    for d in devices],
+                "resident_bytes_per_device": {
+                    str(k): v for k, v in per_device.items()},
                 "cached_executables": len(self._exe_cache)
-                                      + len(self._mlir_exes),
+                                      + len(self._mlir_exes)
+                                      + len(self._exe_sharded),
                 "resident_bytes": self.resident_bytes}, [])
         elif kind == "COMPILE_MLIR":
             # Transparent-PJRT path: the client ships its jit lowering's
@@ -466,87 +742,208 @@ class RemoteVTPUWorker:
                                  "out_dtypes": [d for _, d in sig],
                                  "mflops": mflops}, [])
         elif kind == "COMPILE":
+            import jax.export
+
             blob = buffers[0].tobytes() if buffers else b""
             exe_id = hashlib.sha256(blob).hexdigest()[:32]
             with self._lock:
-                if exe_id not in self._exe_cache:
-                    exported = jax.export.deserialize(bytearray(blob))
-                    # jit the call once: Exported.call re-dispatches per
-                    # invocation, which dominates small-step serving
-                    self._exe_cache[exe_id] = jax.jit(exported.call)
-                    self._exe_blobs[exe_id] = blob
-                    # charge-model: flops of the exported computation
-                    self._exe_costs[exe_id] = int(
-                        meta.get("mflops_hint", 1))
-            reply("COMPILE_OK", {"exe_id": exe_id}, [])
+                known = exe_id in self._exe_cache or \
+                    exe_id in self._exe_sharded
+            if not known:
+                exported = jax.export.deserialize(bytearray(blob))
+                if exported.nr_devices > 1:
+                    # multi-device export: compile against the local
+                    # mesh; the client needs the shard layouts, so this
+                    # is gated on a v3 connection (a v2 peer could not
+                    # upload shards and would fail at EXECUTE anyway)
+                    if meta.get("_wire_version", 2) < 3:
+                        reply("ERROR", {
+                            "error": f"executable is sharded over "
+                                     f"{exported.nr_devices} devices, "
+                                     f"which needs protocol >= 3 (this "
+                                     f"connection negotiated v2)"}, [])
+                        return
+                    entry = self._build_sharded(exported)
+                    with self._lock:
+                        self._exe_sharded.setdefault(exe_id, entry)
+                        self._exe_blobs[exe_id] = blob
+                        self._exe_costs[exe_id] = int(
+                            meta.get("mflops_hint", 1))
+                else:
+                    with self._lock:
+                        if exe_id not in self._exe_cache:
+                            # jit the call once: Exported.call
+                            # re-dispatches per invocation, which
+                            # dominates small-step serving
+                            self._exe_cache[exe_id] = jax.jit(
+                                exported.call)
+                            self._exe_blobs[exe_id] = blob
+                            # charge-model: exported computation flops
+                            self._exe_costs[exe_id] = int(
+                                meta.get("mflops_hint", 1))
+            rmeta = {"exe_id": exe_id}
+            with self._lock:
+                sharded = self._exe_sharded.get(exe_id)
+            if sharded is not None:
+                rmeta.update(nr_devices=sharded["nr_devices"],
+                             arg_layouts=sharded["arg_layouts"],
+                             out_layouts=sharded["out_layouts"])
+            reply("COMPILE_OK", rmeta, [])
         elif kind == "PUT":
-            # device-resident buffer: upload once, reference many times
+            # device-resident buffer: upload once, reference many times.
+            # v3 additions: device_id targets a specific mesh device,
+            # client-minted buf_id ("c-" namespace) + quiet lets shard
+            # uploads pipeline without waiting for replies, ephemeral
+            # frees the buffer when an EXECUTE first consumes it.
             host = np.asarray(buffers[0])
+            v3 = meta.get("_wire_version", 2) >= 3
+            device_id = int(meta.get("device_id", 0)) if v3 else 0
+            devices = jax.devices()
+            if not 0 <= device_id < len(devices):
+                reply("ERROR", {"error": f"no device {device_id} "
+                                         f"(worker has {len(devices)})"},
+                      [])
+                return
+            want_id = meta.get("buf_id") if v3 else None
+            if want_id is not None and \
+                    not str(want_id).startswith(meta.get("_conn_ns", "")):
+                # only connection-namespaced ids are accepted — a raw id
+                # could clobber another client's buffer
+                reply("ERROR", {"error": "client-minted buf_id must be "
+                                         "a c-namespace id"}, [])
+                return
             with self._lock:
                 err = self._admit_resident(int(host.nbytes))
                 if err:
                     reply("ERROR", {"error": err}, [])
                     return
-                self._buf_seq += 1
-                buf_id = f"buf-{self._buf_seq}"
-            try:
-                arr = jax.device_put(host)
-            except Exception:
-                # device OOM etc.: release the charge taken above, or
-                # failed uploads would ratchet the budget shut
-                with self._lock:
-                    self._release_resident(host)
-                raise
+                if want_id is not None:
+                    buf_id = str(want_id)
+                else:
+                    self._buf_seq += 1
+                    buf_id = f"buf-{self._buf_seq}"
+            if want_id is not None:
+                # pipelined shard upload: hand the H2D copy to the
+                # scatter pool and return to decoding the next frame —
+                # transfer of shard k+1 overlaps the device_put of
+                # shard k.  The Future is resolved at first use.
+                arr = self._pool().submit(jax.device_put, host,
+                                          devices[device_id])
+            else:
+                # worker-minted ids keep the v2 contract: PUT_OK means
+                # the buffer is resident (and upload failures release
+                # the budget charge instead of ratcheting it shut)
+                try:
+                    arr = jax.device_put(host, devices[device_id])
+                except Exception:
+                    with self._lock:
+                        self._release_resident(host)
+                    raise
             with self._lock:
                 self._buffers[buf_id] = arr
-            reply("PUT_OK", {"buf_id": buf_id}, [])
+                self._buf_device[buf_id] = device_id
+                if v3 and meta.get("ephemeral"):
+                    self._ephemeral.add(buf_id)
+            if v3 and meta.get("quiet"):
+                return      # pipelined client discards success replies
+            reply("PUT_OK", {"buf_id": buf_id, "device_id": device_id},
+                  [])
         elif kind == "FREE":
-            with self._lock:
-                for buf_id in meta.get("buf_ids", []):
+            ids = list(meta.get("buf_ids", []))
+            if meta.get("_wire_version", 2) >= 3 and \
+                    meta.get("device_id") is not None:
+                # mesh maintenance: free every buffer resident on one
+                # device (the per-device namespace makes this a single
+                # message instead of a client-tracked id list)
+                want = int(meta["device_id"])
+                with self._lock:
+                    ids.extend(b for b, d in self._buf_device.items()
+                               if d == want and b not in ids)
+            freed = 0
+            for buf_id in ids:
+                with self._lock:
                     arr = self._buffers.pop(buf_id, None)
-                    if arr is not None:
+                    self._buf_device.pop(buf_id, None)
+                    self._ephemeral.discard(buf_id)
+                if arr is not None:
+                    arr = self._resolve(arr)    # async PUT still in flight
+                    with self._lock:
                         self._release_resident(arr)
-            reply("FREE_OK", {}, [])
+                    freed += 1
+            if meta.get("quiet") and meta.get("_wire_version", 2) >= 3:
+                # fire-and-forget frees from a pipelined chain: the
+                # client never reads the ack, so skip the frame
+                return
+            reply("FREE_OK", {"freed": freed}, [])
         elif kind == "EXECUTE":
             exe_id = meta["exe_id"]
             with self._lock:
                 exported = self._exe_cache.get(exe_id)
                 mlir_exe = self._mlir_exes.get(exe_id)
+                sharded = self._exe_sharded.get(exe_id)
                 mflops = self._exe_costs.get(exe_id, 1)
-            if exported is None and mlir_exe is None:
+            if exported is None and mlir_exe is None and sharded is None:
                 reply("ERROR", {"error": f"unknown executable {exe_id}",
                                 "code": "needs_compile"}, [])
                 return
             if self.meter_client is not None:
                 self.meter_client.charge_launch(mflops)
             # arg_refs: per-argument, a buf_id string for resident buffers
-            # or null meaning "next inline wire buffer"
+            # or null meaning "next inline wire buffer".  v3 adds
+            # arg_shards: per-argument, null (plain v2 semantics) or a
+            # list of per-device shard entries in the executable's
+            # layout order — each a resident buf_id or null meaning
+            # "next inline wire buffer" (small shards ride the EXECUTE
+            # frame itself; big ones were PUT ahead, pipelined).
             arg_refs = meta.get("arg_refs")
-            if arg_refs is None:
-                args = [np.asarray(b) for b in buffers]
-            else:
-                args = []
-                it = iter(buffers)
-                with self._lock:
-                    for ref in arg_refs:
-                        if ref is None:
-                            args.append(np.asarray(next(it)))
-                        else:
-                            arr = self._buffers.get(ref)
-                            if arr is None:
-                                reply("ERROR",
-                                      {"error": f"unknown buffer {ref}"},
-                                      [])
-                                return
-                            args.append(arr)
-            if mlir_exe is not None:
-                # PJRT path: flat positional buffers in, flat buffers out
+            arg_shards = meta.get("arg_shards") \
+                if meta.get("_wire_version", 2) >= 3 else None
+            it = iter(buffers)
+            try:
+                if sharded is not None:
+                    args = self._gather_sharded_args(
+                        sharded, arg_refs, arg_shards, it)
+                elif arg_refs is None:
+                    args = [np.asarray(b) for b in buffers]
+                else:
+                    args = []
+                    with self._lock:
+                        for ref in arg_refs:
+                            if ref is None:
+                                args.append(np.asarray(next(it)))
+                            else:
+                                arr = self._buffers.get(ref)
+                                if arr is None:
+                                    raise KeyError(
+                                        f"unknown buffer {ref}")
+                                args.append(arr)
+                    # async v3 PUTs park Futures in the table; resolve
+                    # outside the lock (the pool thread needs nothing
+                    # from us, but other connections need the lock)
+                    args = [self._resolve(a) for a in args]
+            except KeyError as e:
+                reply("ERROR", {"error": str(e.args[0])}, [])
+                return
+            if sharded is not None:
+                leaves = sharded["fn"](*args)
+            elif mlir_exe is not None:
+                # PJRT path: flat positional buffers in, flat buffers
+                # out.  Resident buffers PUT to another mesh device are
+                # moved to the executable's device (the transparent
+                # plugin compiles on device 0 in v1).
                 dev = jax.devices()[0]
-                dev_args = [a if hasattr(a, "devices")
-                            else dev.client.buffer_from_pyval(
-                                np.ascontiguousarray(a), dev)
-                            for a in args]
-                leaves = mlir_exe.execute(dev_args)
+
+                def _on_exe_device(a):
+                    devs = getattr(a, "devices", None)
+                    if devs is None:
+                        return dev.client.buffer_from_pyval(
+                            np.ascontiguousarray(a), dev)
+                    if devs() != {dev}:
+                        return jax.device_put(a, dev)
+                    return a
+
+                leaves = mlir_exe.execute([_on_exe_device(a)
+                                           for a in args])
             else:
                 out = exported(*args)
                 leaves = jax.tree_util.tree_leaves(out)
@@ -588,6 +985,11 @@ class RemoteVTPUWorker:
                             self._buf_seq += 1
                             buf_id = f"buf-{self._buf_seq}"
                         self._buffers[buf_id] = leaf
+                        devs = getattr(leaf, "devices", None)
+                        devs = devs() if callable(devs) else devs
+                        if devs is not None and len(devs) == 1:
+                            self._buf_device[buf_id] = \
+                                int(next(iter(devs)).id)
                         ids.append(buf_id)
                         shapes.append(list(leaf.shape))
                         dtypes.append(str(leaf.dtype))
@@ -622,6 +1024,36 @@ class RemoteVTPUWorker:
             if arr is None:
                 reply("ERROR",
                       {"error": f"unknown buffer {meta['buf_id']}"}, [])
+                return
+            arr = self._resolve(arr)
+            if meta.get("_wire_version", 2) >= 3 and (
+                    meta.get("device_id") is not None
+                    or meta.get("shard_index") is not None):
+                # fetch ONE device's shard of a sharded resident array —
+                # the lazy-gather half of sharded keep_results (a client
+                # that only needs part of a result never pays the full
+                # gather + wire cost)
+                shards = list(getattr(arr, "addressable_shards", []))
+                picked = None
+                if meta.get("device_id") is not None:
+                    want = int(meta["device_id"])
+                    for s in shards:
+                        if int(s.device.id) == want:
+                            picked = s
+                            break
+                else:
+                    si = int(meta["shard_index"])
+                    if 0 <= si < len(shards):
+                        picked = shards[si]
+                if picked is None:
+                    reply("ERROR", {
+                        "error": f"buffer {meta['buf_id']} has no shard "
+                                 f"on the requested device/index"}, [])
+                    return
+                reply("FETCH_OK",
+                      {"device_id": int(picked.device.id),
+                       "n_shards": len(shards)},
+                      [np.asarray(picked.data)], compress=self.compress)
                 return
             reply("FETCH_OK", {}, [np.asarray(arr)],
                   compress=self.compress)

@@ -1,7 +1,32 @@
 """Shared test fixtures/builders."""
 
+import time
+
 from tensorfusion_tpu import constants
 from tensorfusion_tpu.api import ResourceAmount, TPUChip
+
+
+def wait_until(predicate, timeout=15.0, interval=0.05, desc=None):
+    """Deadline-poll ``predicate`` until it returns a truthy value and
+    return that value; fail the test with a descriptive message at the
+    deadline.  This is the replacement for fixed-sleep loops: on a
+    loaded single-core CI box a controller round can take seconds, so
+    tests must encode "eventually, within a generous deadline" rather
+    than "after this many 100ms naps" — a passing run still exits on
+    the first poll that succeeds."""
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        last = predicate()
+        if last:
+            return last
+        time.sleep(interval)
+    last = predicate()      # one post-deadline re-check (paused box)
+    if last:
+        return last
+    raise AssertionError(
+        f"condition not met within {timeout}s"
+        + (f": {desc}" if desc else ""))
 
 V5E_TFLOPS = 197.0
 V5E_HBM = 16 * 2**30

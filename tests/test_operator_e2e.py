@@ -23,6 +23,8 @@ from tensorfusion_tpu.api.types import (ChipModelInfo, Container, Pod,
 from tensorfusion_tpu.operator import Operator
 from tensorfusion_tpu.server import OperatorServer
 
+from helpers import wait_until
+
 
 @pytest.fixture()
 def op():
@@ -277,21 +279,27 @@ def test_e2e_dynamic_replicas_scale_to_zero_and_burst(op):
 
 def test_e2e_expander_scales_from_capacity_miss(op):
     """A pod that cannot fit triggers a TPUNodeClaim; the mock provider
-    provisions a host; the pod then schedules (expander/handler.go flow)."""
+    provisions a host; the pod then schedules (expander/handler.go flow).
+
+    Every wait here is a wait_until with a generous deadline and an
+    asserted outcome — the earlier fixed-sleep version raced the pool
+    controller on a loaded single-core box (passed in isolation, failed
+    one full-suite run)."""
     pod = make_client_pod("big-1", tflops="150", hbm="14Gi",
                           extra={constants.ANN_CHIP_COUNT: "8",
                                  constants.ANN_CHIP_GENERATION: "v5e"})
     # HBM expansion is opt-in now (spill contract): enable it on the
-    # pool so the filler below can overfill host-0 past physical HBM
+    # pool so the filler below can overfill host-0 past physical HBM.
+    # The expansion MUST be visible in the allocator before the filler
+    # is submitted (the old version broke out of this poll without
+    # checking, and a slow pool reconcile made the filler unschedulable)
     pool = op.store.get(TPUPool, "pool-a")
     pool.spec.capacity_config.hbm_expand_to_host_mem_percent = 50
     pool.spec.capacity_config.hbm_expand_to_host_disk_percent = 70
     op.store.update(pool)
-    deadline = time.time() + 5
-    while time.time() < deadline:
-        if any(s.hbm_expand_ratio > 1.0 for s in op.allocator.chips()):
-            break
-        time.sleep(0.05)
+    wait_until(
+        lambda: any(s.hbm_expand_ratio > 1.0 for s in op.allocator.chips()),
+        timeout=20, desc="pool HBM expansion reached the allocator")
     # 8 chips x 14 GiB: fits on an 8-chip host only when mostly empty;
     # first fill the current host past even its host-EXPANDED HBM budget
     # (16 GiB * 2.2 expansion = 35.2 GiB/chip) so it can't fit
@@ -300,21 +308,50 @@ def test_e2e_expander_scales_from_capacity_miss(op):
     assert op.wait_for_binding("filler")
 
     op.submit_pod(pod)
-    deadline = time.time() + 10
-    bound = None
-    while time.time() < deadline:
-        bound = op.store.try_get(Pod, "big-1", "default")
-        if bound is not None and bound.spec.node_name:
-            break
+
+    def _bound():
+        # keep nudging the scheduler: the capacity-miss -> claim ->
+        # provision -> retry loop needs scheduling passes to progress
         op.scheduler.activate()
-        time.sleep(0.1)
-    claims = op.store.list(TPUNodeClaim)
-    expansion = [c for c in claims
-                 if c.metadata.labels.get(constants.LABEL_EXPANSION_SOURCE)]
-    assert expansion, "no expansion claim was created"
-    assert bound is not None and bound.spec.node_name, \
-        "pod not scheduled after expansion"
+        b = op.store.try_get(Pod, "big-1", "default")
+        return b if b is not None and b.spec.node_name else None
+
+    bound = wait_until(_bound, timeout=30,
+                       desc="big-1 scheduled after node expansion")
+    wait_until(
+        lambda: [c for c in op.store.list(TPUNodeClaim)
+                 if c.metadata.labels.get(constants.LABEL_EXPANSION_SOURCE)],
+        timeout=20, desc="expansion TPUNodeClaim created")
     assert bound.spec.node_name != "host-0-node"
+
+
+def test_rebalancer_enabled_flag_warns_loudly(op, caplog):
+    """`rebalancer_enabled` has no consuming controller yet: setting it
+    must log a one-time warning instead of silently no-opping (silent
+    no-op config is worse than absent config)."""
+    import logging
+
+    from tensorfusion_tpu.api.types import SchedulingConfigTemplate
+    from tensorfusion_tpu.controllers import core as ctrl_core
+
+    ctrl_core._rebalancer_warned.clear()
+    tmpl = SchedulingConfigTemplate.new("rebal-tmpl")
+    tmpl.spec.rebalancer_enabled = True
+    op.store.create(tmpl)
+    pool = op.store.get(TPUPool, "pool-a")
+    pool.spec.scheduling_config_template = "rebal-tmpl"
+    with caplog.at_level(logging.WARNING, logger="tpf.controller"):
+        op.store.update(pool)
+        wait_until(
+            lambda: any("rebalancer_enabled" in r.message
+                        and "no-op" in r.message
+                        for r in caplog.records),
+            timeout=20, desc="rebalancer_enabled warning logged")
+    # one-time: further reconciles of the same template stay quiet
+    assert not ctrl_core.warn_unconsumed_rebalancer(tmpl)
+    # a template without the flag never warns
+    quiet = SchedulingConfigTemplate.new("quiet-tmpl")
+    assert not ctrl_core.warn_unconsumed_rebalancer(quiet)
 
 
 def test_operator_http_api(op):

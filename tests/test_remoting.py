@@ -341,6 +341,186 @@ def test_remote_resident_buffers(worker):
     dev.close()
 
 
+# -- multi-device: the worker serves all local devices as a mesh ---------
+#
+# Protocol v3 (ISSUE 1 tentpole): a sharded jax.jit's in/out shardings
+# survive jax.export; the worker compiles against its own mesh, the
+# client splits host arrays per the worker-returned layout and pipelines
+# the shard uploads on the one seq-numbered connection.
+
+
+def _sharded_fn(n_devices, in_spec=("b", None)):
+    """jit(tanh(x @ w)) with x batch-sharded over n devices."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:n_devices]), ("b",))
+    sh = NamedSharding(mesh, P("b"))
+    return jax.jit(lambda w, x: jnp.tanh(x @ w),
+                   in_shardings=(None, sh), out_shardings=sh)
+
+
+@pytest.mark.parametrize("n_devices", [2, 4])
+def test_sharded_remote_jit_matches_local(worker, n_devices):
+    """A sharded jax.jit (2+ devices) executes remotely via remote_jit
+    with results matching local execution (acceptance criterion)."""
+    if len(jax.devices()) < n_devices:
+        pytest.skip("needs the virtual 8-device CPU mesh")
+    dev = RemoteDevice(worker.url)
+    fn = _sharded_fn(n_devices)
+    remote = dev.remote_jit(fn)
+    rng = np.random.default_rng(7)
+    w = rng.standard_normal((64, 64)).astype(np.float32)
+    x = rng.standard_normal((8 * n_devices, 64)).astype(np.float32)
+    got = remote(w, x)
+    want = fn(jnp.asarray(w), jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    # pipelined submits work on the sharded path too
+    futs = [remote.submit(w, x * i) for i in range(4)]
+    for i, fut in enumerate(futs):
+        np.testing.assert_allclose(
+            np.asarray(fut.result(timeout=60)),
+            np.asarray(fn(jnp.asarray(w), jnp.asarray(x * i))),
+            rtol=1e-5, atol=1e-4)
+    dev.close()
+
+
+def test_sharded_resident_weights_and_shard_fetch(worker):
+    """upload_arg parks a sharded argument as per-device resident
+    buffers; per-call traffic then skips it, the shards can be fetched
+    per device, and free releases every shard's bytes."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("m",))
+    col = NamedSharding(mesh, P(None, "m"))
+    fn = jax.jit(lambda w, x: x @ w, in_shardings=(col, None),
+                 out_shardings=col)
+    dev = RemoteDevice(worker.url)
+    remote = dev.remote_jit(fn)
+    rng = np.random.default_rng(9)
+    w = rng.standard_normal((32, 64)).astype(np.float32)
+    x = rng.standard_normal((8, 32)).astype(np.float32)
+    w_ref = remote.upload_arg(0, w, w, x)     # column-sharded resident
+    assert len(w_ref.shard_ids) == 4
+    got = remote(w_ref, x)
+    np.testing.assert_allclose(np.asarray(got), x @ w, rtol=1e-5,
+                               atol=1e-5)
+    # per-device shard fetch via the FETCH device_id field
+    ent = w_ref.layout[1]
+    _, fmeta, fbufs = dev._rpc(
+        "FETCH", {"buf_id": w_ref.shard_ids[1],
+                  "device_id": ent["device"]}, [])
+    np.testing.assert_allclose(
+        fbufs[0],
+        w[tuple(slice(lo, hi) for lo, hi in ent["slices"])])
+    # whole-array reassembly + free
+    np.testing.assert_allclose(w_ref.fetch(), w)
+    w_ref.free()
+    assert dev.info()["resident_bytes"] == 0
+    dev.close()
+
+
+def test_sharded_ephemeral_shards_are_freed(worker):
+    """Per-call input shards above the PUT threshold ride pipelined
+    ephemeral PUTs and are consumed by the EXECUTE — nothing leaks into
+    the resident set across calls."""
+    from tensorfusion_tpu.remoting import client as client_mod
+
+    fn = _sharded_fn(4)
+    dev = RemoteDevice(worker.url)
+    remote = dev.remote_jit(fn)
+    w = np.ones((64, 64), np.float32)
+    x = np.ones((1024 * 4, 64), np.float32)    # 256KB/shard >= threshold
+    assert (x.nbytes // 4) >= client_mod.SHARD_PUT_MIN_BYTES
+    for _ in range(3):
+        remote(w, x)
+    assert dev.info()["resident_bytes"] == 0
+    per_dev = dev.info()["resident_bytes_per_device"]
+    assert all(v == 0 for v in per_dev.values())
+    dev.close()
+
+
+def test_info_advertises_mesh(worker):
+    """INFO carries the device inventory (id + coords) and the worker's
+    protocol version — the client's placement inputs."""
+    dev = RemoteDevice(worker.url)
+    info = dev.info()
+    assert info["protocol_version"] >= 3
+    assert len(info["devices"]) == info["n_devices"]
+    ids = [d["id"] for d in info["devices"]]
+    assert ids == sorted(set(ids))
+    assert all("coords" in d for d in info["devices"])
+    dev.close()
+
+
+# -- mixed-version interop: no flag-day for existing clients -------------
+
+
+def test_interop_v2_client_against_v3_worker(worker):
+    """A v2 client (old build, pinned wire version) completes
+    single-device PUT/EXECUTE/FETCH against a v3 worker unchanged."""
+    v2 = RemoteDevice(worker.url, protocol_version=2)
+    assert v2.info()["platform"] == "cpu"
+    assert v2._wire_version == 2
+    ref = v2.put(np.arange(16, dtype=np.float32))
+    remote = v2.remote_jit(lambda a: a * 2.0 + 1.0)
+    out = remote(ref)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.arange(16) * 2.0 + 1.0)
+    np.testing.assert_allclose(ref.fetch(), np.arange(16))
+    ref.free()
+    v2.close()
+
+
+def test_interop_v3_client_against_v2_worker():
+    """A v3 client degrades to the v2 wire against an old worker:
+    single-device PUT/EXECUTE/FETCH unchanged, and sharded functions
+    fail with an explicit version error instead of garbage."""
+    old = RemoteVTPUWorker(protocol_version=2)
+    old.start()
+    try:
+        dev = RemoteDevice(old.url)
+        assert dev.info()["platform"] == "cpu"
+        assert dev._wire_version == 2
+        ref = dev.put(np.ones(8, np.float32))
+        remote = dev.remote_jit(lambda a, b: a + b)
+        out = remote(ref, np.full(8, 2.0, np.float32))
+        np.testing.assert_allclose(np.asarray(out), 3.0)
+        np.testing.assert_allclose(ref.fetch(), 1.0)
+        if len(jax.devices()) >= 2:
+            with pytest.raises(RemoteExecutionError,
+                               match="protocol"):
+                dev.remote_jit(_sharded_fn(2))(
+                    np.ones((8, 8), np.float32),
+                    np.ones((4, 8), np.float32))
+        dev.close()
+    finally:
+        old.stop()
+
+
+def test_interop_v2_worker_rejects_v3_frames():
+    """A worker pinned to v2 refuses v3-framed traffic at the framing
+    layer (the negotiation is what keeps a well-behaved v3 client from
+    ever sending it)."""
+    import socket as _socket
+
+    from tensorfusion_tpu.remoting import protocol
+
+    old = RemoteVTPUWorker(protocol_version=2)
+    old.start()
+    try:
+        s = _socket.create_connection(("127.0.0.1", old.port),
+                                      timeout=10)
+        protocol.send_message(s, "INFO", {"seq": 1}, [], version=3)
+        try:
+            assert s.recv(1) == b""      # dropped, no reply
+        except ConnectionResetError:
+            pass
+        s.close()
+    finally:
+        old.stop()
+
+
 # -- transparent remote vTPU at the PJRT boundary ------------------------
 #
 # The reference capability these cover: GPU-over-IP that is invisible to
@@ -473,6 +653,44 @@ def test_transparent_pjrt_requires_token_when_worker_is_authed():
         assert "NDEV 1" in r2.stdout, r2.stderr[-2000:]
     finally:
         target.stop()
+
+
+def test_transparent_pjrt_advertises_multiple_devices(worker):
+    """TPF_REMOTE_DEVICE_COUNT=n advertises n PJRT devices backed by the
+    worker mesh; single-device compute still works, device-targeted
+    placement works, and the count is capped at the worker inventory."""
+    so = _plugin_path("libtpf_pjrt_remote.so")
+    prog = """
+import json
+import jax, jax.numpy as jnp, numpy as np
+out = float(jax.jit(lambda a: (a @ a).sum())(jnp.ones((8, 8))))
+d = jax.devices()[-1]
+# host -> device put targets the worker-mesh device (device-to-device
+# copies are still out of the transparent plugin's v1 scope)
+y = jax.device_put(np.arange(4.0), d)
+print("JSON" + json.dumps({
+    "n_devices": len(jax.devices()),
+    "ids": [dev.id for dev in jax.devices()],
+    "val": out, "placed_sum": float(y.sum()),
+    "platform": jax.devices()[0].platform}))
+"""
+    r = _run_client({
+        "JAX_PLATFORMS": "tpfr",
+        "PJRT_NAMES_AND_LIBRARY_PATHS": f"tpfr:{so}",
+        "TPF_REMOTE_WORKER_URL": f"tcp://127.0.0.1:{worker.port}",
+        "TPF_REMOTE_DEVICE_COUNT": "4",
+    }, prog=prog)
+    assert r["platform"] == "tpfr" and r["n_devices"] == 4
+    assert r["ids"] == [0, 1, 2, 3]
+    assert r["val"] == 512.0 and r["placed_sum"] == 6.0
+    # capped at the worker's inventory (8 CPU devices here)
+    r2 = _run_client({
+        "JAX_PLATFORMS": "tpfr",
+        "PJRT_NAMES_AND_LIBRARY_PATHS": f"tpfr:{so}",
+        "TPF_REMOTE_WORKER_URL": f"tcp://127.0.0.1:{worker.port}",
+        "TPF_REMOTE_DEVICE_COUNT": "64",
+    }, prog=prog)
+    assert r2["n_devices"] == 8
 
 
 def test_transparent_pjrt_pipelined_errors_surface():

@@ -166,6 +166,10 @@ def test_admin_and_missing_tokens():
 
 
 def test_statestore_tls_end_to_end(tmp_path, monkeypatch):
+    pytest.importorskip(
+        "cryptography",
+        reason="self-signed cert generation needs the cryptography "
+               "package (absent in the hermetic CI image)")
     """Full networked loop over TLS: self-signed cert, RemoteStore client
     verifying against it, create + read + role enforcement — and a
     client that doesn't trust the cert is rejected."""
@@ -212,6 +216,10 @@ def test_statestore_tls_end_to_end(tmp_path, monkeypatch):
 
 
 def test_hypervisor_api_token_and_tls(tmp_path):
+    pytest.importorskip(
+        "cryptography",
+        reason="self-signed cert generation needs the cryptography "
+               "package (absent in the hermetic CI image)")
     """The hypervisor's own HTTP API enforces its token and serves TLS."""
     import ssl
 

@@ -60,6 +60,20 @@ def main() -> int:
     args = parser.parse_args()
     pytest_args = args.pytest_args or ["tests/", "-q", "-x"]
 
+    if not hasattr(sys, "monitoring"):
+        # sys.monitoring is 3.12+; older interpreters cannot run the
+        # gate at all.  Fail OPEN with a loud notice rather than
+        # failing verify-all on an environment constraint the code
+        # under test has no say in — the gate still bites wherever
+        # CI runs 3.12.
+        print(f"pycov: coverage gate SKIPPED — python "
+              f"{sys.version_info.major}.{sys.version_info.minor} has "
+              f"no sys.monitoring (needs >= 3.12); run the suite "
+              f"plainly instead", file=sys.stderr)
+        import pytest
+
+        return pytest.main(pytest_args)
+
     mon = sys.monitoring
     tool = mon.COVERAGE_ID
     mon.use_tool_id(tool, "pycov")
