@@ -8,7 +8,7 @@
 PY := env -u PALLAS_AXON_POOL_IPS python
 
 .PHONY: all native test test-native verify-all verify-repeat \
-	check-coverage asan \
+	verify-stress check-coverage asan \
 	tsan bench bench-tpu test-tpu-live sched-bench webhook-bench remoting-bench \
 	multitenant-bench multitenant-bench-tpu serving-bench-tpu \
 	refresh-tpu-artifacts dryrun clean
@@ -42,6 +42,26 @@ verify-repeat: native
 			|| exit 1; \
 	done
 	@echo "verify-repeat: OK (5/5 rounds green)"
+
+# Concurrency-stress gate: the dedicated race suites 5x — allocator/
+# recommender races, the remote worker's shared dispatch queue under
+# concurrent mixed-version tenants, and the historically raciest e2e
+# (the expander capacity-miss flow, whose pool-spec-clobber race hid
+# behind "passed in isolation" for three rounds).  Cheaper than
+# verify-repeat (minutes, not an hour), meant to run on every change
+# to locking/queueing code.
+verify-stress:
+	@for i in 1 2 3 4 5; do \
+		echo "=== verify-stress round $$i/5 ==="; \
+		env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+			python -m pytest tests/test_races.py \
+			tests/test_remoting_dispatch.py \
+			"tests/test_operator_e2e.py::test_e2e_expander_scales_from_capacity_miss" \
+			"tests/test_operator_e2e.py::test_pool_rollup_never_clobbers_concurrent_spec_update" \
+			-q -p no:cacheprovider -p no:xdist -p no:randomly \
+			|| exit 1; \
+	done
+	@echo "verify-stress: OK (5/5 rounds green)"
 
 test-native:
 	$(MAKE) -C native test

@@ -55,7 +55,8 @@ import time
 REPO = pathlib.Path(__file__).resolve().parent
 sys.path.insert(0, str(REPO))
 
-from driver_guard import backend_alive, run_with_deadline, scrubbed_cpu_env
+from driver_guard import probe_backend, run_with_deadline, \
+    scrubbed_cpu_env
 
 STEPS = 28   # 7 interleaved rounds of 4: medians shrug off load spikes
 
@@ -88,15 +89,26 @@ def main() -> int:
     attempts = []
     fallback = None
     if _ambient_wants_tpu():
-        # retry the tunnel probe across the budget: the relay flaps, and
-        # a revived chip mid-bench should still produce a TPU number
+        # Retry the tunnel probe across the budget (the relay flaps, and
+        # a revived chip mid-bench should still produce a TPU number) —
+        # but fail FAST on a hard connection refusal: an actively
+        # refused dial means the relay host is down now, and sleeping
+        # 60s to re-ask wastes most of the bench budget.  Every probe's
+        # timing lands in the fallback record so a slow fallback is
+        # diagnosable from the artifact alone.
         import driver_guard
 
         alive = False
+        probes = []
         for i in range(_TPU_PROBES):
             driver_guard._probe_cache = None    # re-probe, don't memoize
-            if backend_alive():
+            probe = probe_backend()
+            probes.append({k: probe[k] for k in
+                           ("alive", "rc", "duration_s", "hard_refusal")})
+            if probe["alive"]:
                 alive = True
+                break
+            if probe["hard_refusal"]:
                 break
             if i < _TPU_PROBES - 1:
                 time.sleep(_PROBE_GAP_S)
@@ -108,13 +120,26 @@ def main() -> int:
             fallback = {
                 "reason": "tpu attempt failed after a successful "
                           "liveness probe (relay flapped mid-bench)",
-                "probes": i + 1,
+                "probes": len(probes),
+                "probe_results": probes,
+                "wanted_platform": "tpu"}
+        elif probes and probes[-1]["hard_refusal"]:
+            fallback = {
+                "reason": "tpu tunnel refused the connection (relay "
+                          "down): failing fast after "
+                          f"{len(probes)} probe(s) instead of burning "
+                          f"the budget on re-probes",
+                "probes": len(probes),
+                "probe_results": probes,
                 "wanted_platform": "tpu"}
         else:
             fallback = {
-                "reason": f"tpu tunnel dead: {_TPU_PROBES} liveness "
-                          f"probes hung/failed (90s deadline each)",
-                "probes": _TPU_PROBES,
+                "reason": f"tpu tunnel dead: {len(probes)} liveness "
+                          f"probes hung/failed "
+                          f"({driver_guard.PROBE_TIMEOUT:g}s deadline "
+                          f"each; TPF_BENCH_PROBE_DEADLINE_S tunes it)",
+                "probes": len(probes),
+                "probe_results": probes,
                 "wanted_platform": "tpu"}
     else:
         fallback = {"reason": "no TPU backend in ambient environment",

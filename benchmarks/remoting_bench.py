@@ -49,11 +49,14 @@ except ImportError:
     from _artifact import write_artifact
 
 
-def _spawn_worker():
+def _spawn_worker(env=None):
     """Worker subprocess on an OS-assigned port; returns (proc, port).
     Parsing the SERVING line (instead of hardcoding a port) means a
     stale worker or parallel bench can never collide, and a failed bind
     surfaces the child's stderr instead of an opaque assert.
+
+    ``env`` overlays the inherited environment (e.g.
+    TPF_REMOTING_DISPATCH to pin the worker's dispatch mode).
 
     stderr is drained continuously by a daemon thread (keeping only a
     tail for diagnostics): a PIPE nobody reads would fill the OS buffer
@@ -62,9 +65,13 @@ def _spawn_worker():
     import subprocess
     import threading
 
+    child_env = dict(os.environ)
+    if env:
+        child_env.update(env)
     proc = subprocess.Popen(
         [sys.executable, __file__, "--serve", "0"],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=child_env)
     err_tail = collections.deque(maxlen=64)
 
     def _drain():
@@ -137,6 +144,18 @@ def main() -> int:
     p.add_argument("--scaling-dcn-rtt-ms", type=float, default=2.0,
                    help="emulated round-trip latency for the sync "
                         "scaling cell (typical same-DC pod-to-pod)")
+    p.add_argument("--no-qos", action="store_true",
+                   help="skip the multi-tenant QoS dispatch cell")
+    p.add_argument("--qos-seconds", type=float, default=6.0,
+                   help="measurement window per QoS share cell")
+    p.add_argument("--qos-depth", type=int, default=16,
+                   help="pipelined requests in flight per tenant "
+                        "(4 tenants x this = oversubscription)")
+    p.add_argument("--qos-dim", type=int, default=256)
+    p.add_argument("--qos-batch", type=int, default=64)
+    p.add_argument("--qos-burst", type=int, default=24,
+                   help="same-executable requests per tenant in the "
+                        "micro-batch cell")
     args = p.parse_args()
 
     import jax
@@ -241,6 +260,9 @@ def main() -> int:
         scaling = measure_device_scaling(args)
         if scaling is not None:
             result["device_scaling"] = scaling
+    if not args.no_qos:
+        result["multitenant_dispatch"] = measure_multitenant_dispatch(
+            args)
     write_artifact("remoting", result)
     print(json.dumps(result))
     return 0
@@ -409,6 +431,163 @@ def measure_device_scaling(args):
         "sync_dcn": sync_cells,
         # headline table (acceptance: >=3x aggregate at 4 devices)
         "cells": sync_cells,
+    }
+
+
+def measure_multitenant_dispatch(args):
+    """Multi-client QoS cell: 4 tenants (critical/high/medium/low —
+    weights 8/4/2/1) pipelining the serving pattern at oversubscribed
+    depth against ONE worker.
+
+    Three sub-cells:
+
+    - ``fifo``: the single-shared-queue baseline (arrival order, no
+      weighting) — aggregate throughput reference;
+    - ``wfq``: weighted fair queueing — per-tenant throughput shares
+      must track the configured weights (the acceptance criterion:
+      max share error <= 10%) at >= the fifo aggregate, with queue-wait
+      p50/p99 recorded per QoS class;
+    - ``microbatch``: all tenants bursting the SAME opted-in
+      executable — device launches must come out well below request
+      count (cross-connection fusion).
+
+    Tenants use *distinct* executables in the share cells (a per-tenant
+    scale constant) so micro-batch fusion cannot equalize their
+    service; the fusion cell shares one executable on purpose."""
+    import threading
+
+    from tensorfusion_tpu.remoting import RemoteDevice
+
+    import jax.numpy as jnp
+
+    QOS = [("critical", 8.0), ("high", 4.0), ("medium", 2.0),
+           ("low", 1.0)]
+    dim, batch = args.qos_dim, args.qos_batch
+    rng = np.random.default_rng(0)
+    W = rng.standard_normal((dim, dim)).astype(np.float32)
+    x = rng.standard_normal((batch, dim)).astype(np.float32)
+
+    def run_share_cell(mode):
+        proc, port = _spawn_worker(env={"TPF_REMOTING_DISPATCH": mode})
+        counts = {}
+        errors = []
+        try:
+            ready = threading.Barrier(len(QOS) + 1)
+            go = threading.Event()
+            t_stop = {}
+
+            def tenant(qos, scale):
+                try:
+                    dev = RemoteDevice(f"tcp://127.0.0.1:{port}",
+                                       qos=qos)
+                    remote = dev.remote_jit(
+                        lambda w, x, s=scale: jnp.tanh(x @ w) * s)
+                    remote(W, x)            # compile before the window
+                    ready.wait(timeout=120)
+                    go.wait(timeout=120)    # window start is set below
+                    n = 0
+                    inflight = []
+                    while time.monotonic() < t_stop["t"]:
+                        inflight.append(remote.submit(W, x))
+                        if len(inflight) >= args.qos_depth:
+                            inflight.pop(0).result(timeout=120)
+                            n += 1
+                    for f in inflight:      # drain, uncounted: the
+                        f.result(timeout=120)   # window is the measure
+                    counts[qos] = n
+                    dev.close()
+                except Exception as e:  # noqa: BLE001
+                    errors.append(f"{qos}: {e!r}")
+
+            threads = [threading.Thread(target=tenant,
+                                        args=(q, 1.0 + i * 0.25))
+                       for i, (q, _) in enumerate(QOS)]
+            for t in threads:
+                t.start()
+            ready.wait(timeout=300)         # all tenants compiled
+            t_stop["t"] = time.monotonic() + args.qos_seconds
+            go.set()
+            for t in threads:
+                t.join(timeout=300)
+            if errors:
+                raise RuntimeError("; ".join(errors))
+            probe = RemoteDevice(f"tcp://127.0.0.1:{port}")
+            dispatch = probe.info()["dispatch"]
+            probe.close()
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+        total = sum(counts.values())
+        wsum = sum(w for _, w in QOS)
+        cell = {"mode": mode,
+                "aggregate_req_per_s": round(total / args.qos_seconds,
+                                             1),
+                "tenants": {}}
+        share_errors = []
+        for qos, weight in QOS:
+            share = counts.get(qos, 0) / total if total else 0.0
+            target = weight / wsum
+            err = abs(share - target) / target if target else 0.0
+            share_errors.append(err)
+            q = dispatch["per_qos"].get(qos, {})
+            cell["tenants"][qos] = {
+                "weight": weight,
+                "completed": counts.get(qos, 0),
+                "share": round(share, 4),
+                "target_share": round(target, 4),
+                "share_error_pct": round(err * 100.0, 2),
+                "queue_wait_p50_ms": q.get("p50_ms"),
+                "queue_wait_p99_ms": q.get("p99_ms")}
+        cell["max_share_error_pct"] = round(max(share_errors) * 100.0,
+                                            2)
+        cell["queue_wait_p50_ms"] = dispatch["queue_wait"]["p50_ms"]
+        cell["queue_wait_p99_ms"] = dispatch["queue_wait"]["p99_ms"]
+        return cell
+
+    def run_microbatch_cell():
+        proc, port = _spawn_worker(
+            env={"TPF_REMOTING_DISPATCH": "wfq"})
+        try:
+            devs = [RemoteDevice(f"tcp://127.0.0.1:{port}", qos=q)
+                    for q, _ in QOS]
+            remotes = [d.remote_jit(lambda w, x: jnp.tanh(x @ w),
+                                    microbatch=True) for d in devs]
+            for r in remotes:
+                r(W, x)                     # one shared executable
+            base = devs[0].info()["dispatch"]
+            futs = [r.submit(W, x)
+                    for _ in range(args.qos_burst) for r in remotes]
+            for f in futs:
+                f.result(timeout=120)
+            d = devs[0].info()["dispatch"]
+            for dev in devs:
+                dev.close()
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+        executed = d["executed"] - base["executed"]
+        launches = d["launches"] - base["launches"]
+        return {"requests": executed,
+                "launches": launches,
+                "launch_reduction_pct": round(
+                    (1.0 - launches / executed) * 100.0, 1)
+                if executed else 0.0,
+                "microbatched_requests": d["microbatched_requests"]}
+
+    fifo = run_share_cell("fifo")
+    wfq = run_share_cell("wfq")
+    return {
+        "tenants": len(QOS),
+        "pipeline_depth": args.qos_depth,
+        "window_s": args.qos_seconds,
+        "dim": dim, "batch": batch,
+        "fifo_baseline": fifo,
+        "wfq": wfq,
+        "aggregate_vs_fifo": round(
+            wfq["aggregate_req_per_s"]
+            / max(fifo["aggregate_req_per_s"], 1e-9), 3),
+        "share_error_ok": wfq["max_share_error_pct"] <= 10.0,
+        "microbatch": run_microbatch_cell(),
     }
 
 

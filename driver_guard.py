@@ -21,11 +21,49 @@ import os
 import signal
 import subprocess
 import sys
+import time
 from typing import Dict, List, Optional, Tuple
 
-PROBE_TIMEOUT = 90
+#: per-probe deadline for backend liveness checks; configurable because
+#: 3 x 90s of hung probes is most of a bench budget when the tunnel
+#: relay is simply down (TPF_BENCH_PROBE_DEADLINE_S)
+PROBE_TIMEOUT = float(os.environ.get("TPF_BENCH_PROBE_DEADLINE_S", "")
+                      or 90)
+
+#: child-output markers of a HARD connection refusal: the relay host
+#: actively rejected the dial, so it is down *now* and retrying the
+#: probe on a timer only burns the budget (a hang/timeout, by contrast,
+#: may be a relay that is slow to accept and can revive)
+_HARD_REFUSAL_MARKERS = ("ConnectionRefusedError", "Connection refused",
+                         "ECONNREFUSED")
 
 _probe_cache: Optional[bool] = None
+
+
+def probe_backend(timeout: Optional[float] = None) -> Dict[str, object]:
+    """One uncached backend-liveness probe in a child process.
+
+    Returns a machine-readable record for the bench fallback trail:
+    ``{"alive", "rc", "duration_s", "hard_refusal", "detail"}`` —
+    ``hard_refusal`` means the dial was actively rejected (fail fast;
+    no point sleeping and re-probing), rc 124 means the probe hung to
+    its deadline."""
+    timeout = PROBE_TIMEOUT if timeout is None else timeout
+    t0 = time.monotonic()
+    rc, out = run_with_deadline(
+        [sys.executable, "-c",
+         "import jax; print('PLATFORM=' + jax.devices()[0].platform)"],
+        dict(os.environ), timeout)
+    alive = rc == 0 and "PLATFORM=" in out
+    return {
+        "alive": alive,
+        "rc": rc,
+        "duration_s": round(time.monotonic() - t0, 2),
+        "hard_refusal": (not alive
+                         and any(m in out
+                                 for m in _HARD_REFUSAL_MARKERS)),
+        "detail": "" if alive else out.strip()[-300:],
+    }
 
 
 def scrubbed_cpu_env(n_devices: Optional[int] = None) -> Dict[str, str]:
@@ -63,17 +101,13 @@ def run_with_deadline(argv: List[str], env: Dict[str, str],
     return proc.returncode, out
 
 
-def backend_alive(timeout: float = PROBE_TIMEOUT) -> bool:
+def backend_alive(timeout: Optional[float] = None) -> bool:
     """Can the ambient JAX backend initialise?  Probed in a child process
     so a hang inside backend init cannot leak into the caller; the result
     is cached for this process."""
     global _probe_cache
     if _probe_cache is None:
-        rc, out = run_with_deadline(
-            [sys.executable, "-c",
-             "import jax; print('PLATFORM=' + jax.devices()[0].platform)"],
-            dict(os.environ), timeout)
-        _probe_cache = rc == 0 and "PLATFORM=" in out
+        _probe_cache = bool(probe_backend(timeout)["alive"])
     return _probe_cache
 
 

@@ -141,6 +141,20 @@ QOS_CRITICAL = "critical"
 QOS_LEVELS = (QOS_LOW, QOS_MEDIUM, QOS_HIGH, QOS_CRITICAL)
 DEFAULT_QOS = QOS_MEDIUM
 
+#: relative service shares per QoS class — ONE ladder for every
+#: fair-sharing mechanism in the platform: the ERL redistribution
+#: coefficients for local tenants (hypervisor/erl.py) and the remote
+#: worker's weighted-fair dispatch queue (remoting/dispatch.py) both
+#: resolve the ``tpu-fusion.ai/qos`` annotation tiers through this map,
+#: so a "high" tenant gets the same 2x-over-"medium" promise whether it
+#: shares a chip locally or over the wire.
+QOS_DISPATCH_WEIGHTS = {
+    QOS_LOW: 1.0,
+    QOS_MEDIUM: 2.0,
+    QOS_HIGH: 4.0,
+    QOS_CRITICAL: 8.0,
+}
+
 ISOLATION_SHARED = "shared"            # no enforcement, best effort
 ISOLATION_SOFT = "soft"                # shm token buckets + ERL (~1% overhead)
 ISOLATION_HARD = "hard"                # one-shot provider hard caps
@@ -214,6 +228,8 @@ ENV_SHM_BASE = "TPF_SHM_BASE"
 ENV_POOL_NAME = "TPF_POOL"                     # pool the node agent joins
 ENV_STORE_TOKEN = "TPF_STORE_TOKEN"            # store-gateway shared token
 ENV_GO_TESTING = "TPF_TESTING"                 # test-mode toggles
+ENV_REMOTING_QOS = "TPF_REMOTING_QOS"          # remote tenant's QoS class
+ENV_REMOTING_DISPATCH = "TPF_REMOTING_DISPATCH"  # worker policy: wfq|fifo
 
 DEFAULT_SHM_BASE = "/run/tpu-fusion/shm"
 DEFAULT_HYPERVISOR_PORT = 8000

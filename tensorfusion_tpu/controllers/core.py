@@ -28,7 +28,8 @@ from ..api import set_condition
 from ..api.types import (Container, Node, Pod, TPUChip, TPUCluster,
                          TPUConnection, TPUNode, TPUNodeClaim, TPUPool,
                          TPUResourceQuota, TPUWorkload)
-from ..store import ADDED, DELETED, MODIFIED, Event, NotFoundError, ObjectStore
+from ..store import (ADDED, DELETED, MODIFIED, ConflictError, Event,
+                     NotFoundError, ObjectStore)
 from ..webhook.parser import _truthy
 from .base import Controller
 
@@ -142,8 +143,18 @@ class PoolController(Controller):
             pool.status.phase = (constants.PHASE_RUNNING if members
                                  else constants.PHASE_PENDING)
             try:
-                self.store.update(pool)
-            except NotFoundError:
+                # Status-only write onto a FRESH read, version-checked:
+                # writing back the pool we listed at the top would
+                # last-writer-wins CLOBBER any spec change (e.g. a user
+                # enabling HBM expansion) that landed while this rollup
+                # ran — the spec edit would vanish and, having emitted
+                # its only MODIFIED event, never reach the allocator.
+                # On conflict we simply skip: the competing write's own
+                # event re-triggers this reconcile with the new spec.
+                fresh = self.store.get(TPUPool, pool.name)
+                fresh.status = pool.status
+                self.store.update(fresh, check_version=True)
+            except (NotFoundError, ConflictError):
                 pass
 
 

@@ -20,7 +20,7 @@ from typing import Dict, List
 
 from .. import constants
 from ..api.types import Pod, TPUPool
-from ..store import NotFoundError
+from ..store import ConflictError, NotFoundError
 from .base import Controller
 
 log = logging.getLogger("tpf.controller.rollout")
@@ -60,11 +60,8 @@ class RolloutController(Controller):
                 if pod.metadata.labels.get(
                     constants.LABEL_POD_TEMPLATE_HASH) != target]
             if not outdated:
-                pool.status.component_status["worker"] = f"Ready@{target}"
-                try:
-                    self.store.update(pool)
-                except NotFoundError:
-                    pass
+                self._set_component_status(pool.name,
+                                           f"Ready@{target}")
                 continue
             # batch recycle
             now = time.time()
@@ -85,9 +82,21 @@ class RolloutController(Controller):
                                       pod.metadata.namespace)
                 except NotFoundError:
                     pass
-            pool.status.component_status["worker"] = (
+            self._set_component_status(
+                pool.name,
                 f"Updating {len(outdated) - len(batch)} remaining")
-            try:
-                self.store.update(pool)
-            except NotFoundError:
-                pass
+
+    def _set_component_status(self, pool_name: str, status: str) -> None:
+        """Status write onto a FRESH, version-checked read: writing back
+        the pool listed at the top of reconcile would last-writer-wins
+        clobber any spec change (e.g. a user enabling HBM expansion)
+        that landed mid-reconcile — this controller resyncs every 2s,
+        so the unchecked write was a standing lost-update hazard for
+        every pool spec editor.  On conflict, skip: the competing
+        write's event re-triggers reconcile."""
+        try:
+            fresh = self.store.get(TPUPool, pool_name)
+            fresh.status.component_status["worker"] = status
+            self.store.update(fresh, check_version=True)
+        except (NotFoundError, ConflictError):
+            pass

@@ -31,8 +31,8 @@ from typing import Dict, List, Optional, Tuple
 from ..api.types import ERLParameters
 from .. import constants
 
-DEFAULT_QOS_COEFFS = {constants.QOS_LOW: 1.0, constants.QOS_MEDIUM: 2.0,
-                      constants.QOS_HIGH: 4.0, constants.QOS_CRITICAL: 8.0}
+# the platform-wide QoS share ladder (also the remote dispatch weights)
+DEFAULT_QOS_COEFFS = dict(constants.QOS_DISPATCH_WEIGHTS)
 
 
 @dataclass

@@ -31,6 +31,39 @@ from ..metrics.encoder import encode_line
 
 log = logging.getLogger("tpf.hypervisor.metrics")
 
+
+def remote_dispatch_lines(remote_worker, node_name: str,
+                          ts: int) -> List[str]:
+    """Influx lines for one RemoteVTPUWorker's dispatch scheduler:
+    ``tpf_remote_dispatch`` (queue saturation + launch counters) and
+    per-QoS ``tpf_remote_qos`` (share + queue wait per class).  Shared
+    by the node-agent recorder here and the operator-side
+    MetricsRecorder so both topologies emit identical series."""
+    snap = remote_worker.dispatcher.snapshot()
+    tags = {"node": node_name, "mode": snap["mode"]}
+    lines = [encode_line(
+        "tpf_remote_dispatch", tags,
+        {"depth": snap["depth"],
+         "executed_total": snap["executed"],
+         "launches_total": snap["launches"],
+         "microbatched_total": snap["microbatched_requests"],
+         "busy_rejected_total": snap["busy_rejected"],
+         "deadline_exceeded_total": snap["deadline_exceeded"],
+         "queue_wait_p50_ms": snap["queue_wait"]["p50_ms"],
+         "queue_wait_p99_ms": snap["queue_wait"]["p99_ms"],
+         "queue_wait_mean_ms": snap["queue_wait"]["mean_ms"],
+         "service_p50_ms": snap["service"]["p50_ms"],
+         "service_p99_ms": snap["service"]["p99_ms"],
+         "service_mean_ms": snap["service"]["mean_ms"],
+         "tenants": len(snap["tenants"])}, ts)]
+    for qos, q in snap["per_qos"].items():
+        lines.append(encode_line(
+            "tpf_remote_qos", dict(tags, qos=qos),
+            {"served_total": q["served"],
+             "queue_wait_p50_ms": q["p50_ms"],
+             "queue_wait_p99_ms": q["p99_ms"]}, ts))
+    return lines
+
 #: max influx lines buffered while the operator is unreachable (at 5s
 #: intervals and ~10 lines/tick this is ~an hour of partition)
 PUSH_BACKLOG_LINES = 8192
@@ -45,13 +78,21 @@ PUSH_CHUNK_LINES = 512
 class HypervisorMetricsRecorder:
     def __init__(self, devices, workers, path: str = "",
                  interval_s: float = 5.0, node_name: str = "local",
-                 push: Optional[Callable[[List[str]], object]] = None):
+                 push: Optional[Callable[[List[str]], object]] = None,
+                 remote_workers=()):
         self.devices = devices
         self.workers = workers
         self.path = path
         self.interval_s = interval_s
         self.node_name = node_name
         self.push = push
+        #: RemoteVTPUWorker instances co-hosted on this node: their
+        #: dispatch-queue saturation (queue wait / service time /
+        #: backpressure counters) ships as ``tpf_remote_dispatch`` +
+        #: per-QoS ``tpf_remote_qos`` lines over the same push path,
+        #: so the operator TSDB sees remote-serving saturation exactly
+        #: like local chip duty
+        self.remote_workers = list(remote_workers)
         self._backlog: deque = deque(maxlen=PUSH_BACKLOG_LINES)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -107,6 +148,8 @@ class HypervisorMetricsRecorder:
                  "ici_tx_bytes": int(m.ici_tx_bytes),
                  "ici_rx_bytes": int(m.ici_rx_bytes),
                  "partitions": len(e.partitions)}, ts))
+        for rw in self.remote_workers:
+            lines.extend(remote_dispatch_lines(rw, self.node_name, ts))
         for w in self.workers.list():
             tags = {"node": self.node_name, "namespace": w.spec.namespace,
                     "worker": w.spec.name, "qos": w.spec.qos,

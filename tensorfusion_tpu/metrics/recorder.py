@@ -27,15 +27,26 @@ log = logging.getLogger("tpf.metrics.recorder")
 
 class MetricsRecorder:
     def __init__(self, operator, tsdb: Optional[TSDB] = None,
-                 path: str = "", interval_s: float = 5.0):
+                 path: str = "", interval_s: float = 5.0,
+                 remote_workers=()):
         self.operator = operator
         self.tsdb = tsdb or TSDB()
         self.path = path
         self.interval_s = interval_s
+        #: RemoteVTPUWorker instances embedded in this process (the
+        #: single-node / bench topology — multi-host nodes ship the
+        #: same series through HypervisorMetricsRecorder's push path):
+        #: their dispatch saturation lands in the TSDB as
+        #: ``tpf_remote_dispatch`` / ``tpf_remote_qos``
+        self.remote_workers = list(remote_workers)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         if path:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    def register_remote_worker(self, worker) -> None:
+        """Start shipping a remote-vTPU worker's dispatch metrics."""
+        self.remote_workers.append(worker)
 
     def start(self) -> None:
         self._stop.clear()
@@ -158,6 +169,19 @@ class MetricsRecorder:
                         "waiting_pods": len(op.scheduler.waiting_pods())}
         lines.append(encode_line("tpf_scheduler", {}, sched_fields, ts))
         self.tsdb.insert("tpf_scheduler", {}, sched_fields, now)
+
+        # remote-vTPU dispatch saturation (embedded workers): the same
+        # tpf_remote_dispatch/tpf_remote_qos series multi-host nodes
+        # push through the hypervisor recorder + store gateway
+        if self.remote_workers:
+            from ..hypervisor.metrics import remote_dispatch_lines
+            from .encoder import parse_line
+
+            for rw in self.remote_workers:
+                for line in remote_dispatch_lines(rw, "operator", ts):
+                    lines.append(line)
+                    measurement, tags, fields, _ = parse_line(line)
+                    self.tsdb.insert(measurement, tags, fields, now)
 
         if self.path and lines:
             with open(self.path, "a") as f:

@@ -1,5 +1,6 @@
 """Remote-vTPU: StableHLO-level remoting over Ethernet/DCN."""
 
-from .client import (RemoteBuffer, RemoteDevice, RemoteExecutionError,
+from .client import (RemoteBuffer, RemoteBusyError, RemoteDeadlineError,
+                     RemoteDevice, RemoteExecutionError,
                      ShardedRemoteBuffer)
 from .worker import RemoteVTPUWorker

@@ -26,6 +26,17 @@ shard k) while small shards ride inline in the EXECUTE frame; sharded
 weights can be made device-resident once with ``wrapped.upload_arg``.
 The HELLO handshake negotiates the version, so a v3 client degrades to
 plain single-device v2 against an old worker and vice versa.
+
+QoS-aware dispatch (protocol v4): the HELLO carries the tenant's QoS
+class (``qos=`` or ``TPF_REMOTING_QOS``), which sets this connection's
+weight in the worker's fair dispatch queue.  Per-request ``deadline_ms``
+bounds queue wait; a saturated worker answers structured ``BUSY``
+(surfaced as :class:`RemoteBusyError` carrying ``retry_after_ms``) —
+the synchronous wrapper retries with jittered backoff automatically,
+pipelined ``submit()`` callers see the exception and apply their own
+flow control.  ``remote_jit(fn, microbatch=True)`` declares the
+executable safe for the worker to fuse compatible concurrent requests
+into one device launch.
 """
 
 from __future__ import annotations
@@ -35,14 +46,17 @@ import itertools
 import json
 import logging
 import os
+import random
 import socket
 import threading
+import time
 import urllib.request
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .. import constants
 from . import protocol
 from .protocol import recv_message, send_message
 
@@ -54,9 +68,50 @@ log = logging.getLogger("tpf.remoting.client")
 #: covers all of them (per-frame overhead beats overlap at this size)
 SHARD_PUT_MIN_BYTES = 256 << 10
 
+#: how many BUSY rejections the synchronous wrapper absorbs (with
+#: jittered backoff) before giving up — a saturated-but-moving worker
+#: drains well inside this; a wedged one should fail loudly
+MAX_BUSY_RETRIES = 32
+
 
 class RemoteExecutionError(RuntimeError):
     pass
+
+
+class RemoteBusyError(RemoteExecutionError):
+    """The worker's dispatch queue rejected the request (bounded
+    backpressure).  ``retry_after_ms`` is the worker's drain estimate —
+    retry after sleeping about that long, with jitter, so a thundering
+    herd doesn't re-arrive in lockstep."""
+
+    def __init__(self, msg: str, retry_after_ms: int = 50):
+        super().__init__(msg)
+        self.retry_after_ms = max(int(retry_after_ms), 1)
+
+    def backoff_s(self, attempt: int = 1) -> float:
+        """Jittered, gently exponential sleep for retry ``attempt``."""
+        base = self.retry_after_ms / 1e3 * min(2 ** (attempt - 1), 8)
+        return min(base, 2.0) * (0.5 + random.random())
+
+
+class RemoteDeadlineError(RemoteExecutionError):
+    """The request's ``deadline_ms`` elapsed in the worker's queue; it
+    was never executed."""
+
+    def __init__(self, msg: str, queue_wait_ms: int = 0):
+        super().__init__(msg)
+        self.queue_wait_ms = int(queue_wait_ms)
+
+
+def _raise_reply_error(rmeta: Dict[str, Any]) -> None:
+    """Map a structured ERROR reply onto the typed exceptions."""
+    code = rmeta.get("code")
+    msg = rmeta.get("error", "remote error")
+    if code == "BUSY":
+        raise RemoteBusyError(msg, rmeta.get("retry_after_ms", 50))
+    if code == "DEADLINE_EXCEEDED":
+        raise RemoteDeadlineError(msg, rmeta.get("queue_wait_ms", 0))
+    raise RemoteExecutionError(msg)
 
 
 class RemoteBuffer:
@@ -108,7 +163,8 @@ class ShardedRemoteBuffer:
 class RemoteDevice:
     def __init__(self, url: str, token: Optional[str] = None,
                  timeout_s: float = 300.0,
-                 protocol_version: int = protocol.VERSION):
+                 protocol_version: int = protocol.VERSION,
+                 qos: Optional[str] = None):
         # url: "tcp://host:port"
         if url.startswith("tcp://"):
             url = url[len("tcp://"):]
@@ -117,6 +173,12 @@ class RemoteDevice:
         self.token = token if token is not None else \
             os.environ.get("TPF_REMOTING_TOKEN", "")
         self.timeout_s = timeout_s
+        #: QoS class this tenant claims at HELLO — its weight in the
+        #: worker's fair dispatch queue (v4 workers; older ones ignore)
+        self.qos = qos or os.environ.get(constants.ENV_REMOTING_QOS,
+                                         "") or None
+        #: the worker-resolved dispatch weight (HELLO_OK, v4 workers)
+        self.qos_weight: Optional[float] = None
         #: highest wire version this client will speak; pinning to 2
         #: makes it frame-faithful to a v2 build (mixed-version tests)
         self.protocol_version = protocol_version
@@ -158,6 +220,8 @@ class RemoteDevice:
         hello = {"token": self.token}
         if self.protocol_version > 2:
             hello["max_version"] = self.protocol_version
+        if self.qos is not None and self.protocol_version >= 4:
+            hello["qos"] = self.qos
         send_message(sock, "HELLO", hello, [],
                      version=protocol.HELLO_VERSION)
         kind, meta, _ = recv_message(sock, accept=self._accept)
@@ -167,6 +231,8 @@ class RemoteDevice:
                 meta.get("error", "remoting handshake failed"))
         self._wire_version = max(2, min(self.protocol_version,
                                         int(meta.get("version", 2))))
+        if meta.get("qos_weight") is not None:
+            self.qos_weight = float(meta["qos_weight"])
         # per-request deadlines are enforced via Future.result(timeout_s);
         # a socket timeout here would kill every pipelined request the
         # moment one response gap exceeds it
@@ -263,7 +329,7 @@ class RemoteDevice:
     def _result(self, fut: Future) -> Tuple:
         rkind, rmeta, rbufs = fut.result(timeout=self.timeout_s)
         if rkind == "ERROR":
-            raise RemoteExecutionError(rmeta.get("error", "remote error"))
+            _raise_reply_error(rmeta)
         return rkind, rmeta, rbufs
 
     def _rpc(self, kind: str, meta: Dict[str, Any], buffers) -> Tuple:
@@ -313,11 +379,20 @@ class RemoteDevice:
 
     # ------------------------------------------------------------------
 
-    def remote_jit(self, fn: Callable) -> Callable:
+    def remote_jit(self, fn: Callable,
+                   microbatch: bool = False) -> Callable:
         """Wrap ``fn`` so calls execute on the remote worker.  Functions
         must take/return array pytrees; tracing happens locally.  The
         wrapper also exposes ``.submit(*args) -> Future`` for pipelined
         calls (many in flight on one connection).
+
+        ``microbatch=True`` declares the executable fusable: a v4
+        worker may stack compatible concurrent requests (same
+        executable, from this or other connections) into one device
+        launch.  Results are identical — fusion packs the requests'
+        batch work side by side in a single XLA program — so the only
+        reason it is opt-in is the one-time compile cost of each fused
+        batch-size variant on the worker.
 
         ``fn`` may be an already-jitted function with in/out shardings
         (``jax.jit(f, in_shardings=..., out_shardings=...)``): the
@@ -373,8 +448,11 @@ class RemoteDevice:
                     mflops = max(int(analysis.get("flops", 0) / 1e6), 1)
                 except Exception:  # noqa: BLE001
                     mflops = 1
+                cmeta: Dict[str, Any] = {"mflops_hint": mflops}
+                if microbatch:
+                    cmeta["microbatch"] = True
                 _, meta, _ = device._rpc(
-                    "COMPILE", {"mflops_hint": mflops},
+                    "COMPILE", cmeta,
                     [np.frombuffer(blob, dtype=np.uint8)])
                 out_shapes = jax.eval_shape(jitted, *specs)
                 out_tree = jax.tree_util.tree_structure(out_shapes)
@@ -455,28 +533,56 @@ class RemoteDevice:
                                 arg_shards=arg_shards), buffers,
                 want_reply=want_reply)
 
+        def _deadline_meta(deadline_ms):
+            """deadline_ms rides the EXECUTE only on a v4 connection —
+            an older worker would ignore it silently, which is worse
+            than the client knowing it has no deadline support."""
+            if deadline_ms is None:
+                return None
+            if device._wire_version < 4:
+                raise RemoteExecutionError(
+                    f"deadline_ms needs protocol v4 but the worker "
+                    f"only speaks v{device._wire_version}")
+            return {"deadline_ms": int(deadline_ms)}
+
         @functools.wraps(fn)
-        def remote(*args):
+        def remote(*args, deadline_ms: Optional[int] = None):
             entry, leaves = prepare(args)
-            for attempt in (0, 1):
-                fut = send_execute(entry, leaves)
+            reconnects = busy = 0
+            while True:
+                fut = send_execute(entry, leaves,
+                                   extra_meta=_deadline_meta(deadline_ms))
                 try:
                     _, rmeta, results = device._result(fut)
                     return jax.tree_util.tree_unflatten(entry[1],
                                                         results)
+                except RemoteBusyError as e:
+                    # bounded backpressure: sleep the worker's drain
+                    # estimate with jitter so a herd of retries does
+                    # not re-arrive in lockstep
+                    busy += 1
+                    if busy > MAX_BUSY_RETRIES:
+                        raise
+                    time.sleep(e.backoff_s(busy))
                 except ConnectionError:
                     # one reconnect attempt, like _rpc: send_execute
                     # re-fires any shard PUTs on the fresh connection
-                    if attempt:
+                    reconnects += 1
+                    if reconnects > 1:
                         raise
                     device.close()
-            raise RemoteExecutionError("unreachable")
 
-        def submit(*args) -> Future:
+        def submit(*args, deadline_ms: Optional[int] = None) -> Future:
             """Pipelined call: returns a Future resolving to the result
-            pytree without blocking for the round trip."""
+            pytree without blocking for the round trip.  BUSY
+            backpressure is NOT retried here — a pipelined caller is
+            exactly the load source the worker is pushing back on, so
+            the Future fails with RemoteBusyError and the caller
+            applies its own flow control (e.g. drain some in-flight
+            futures, sleep ``retry_after_ms`` with jitter)."""
             entry, leaves = prepare(args)
-            raw = send_execute(entry, leaves)
+            raw = send_execute(entry, leaves,
+                               extra_meta=_deadline_meta(deadline_ms))
             out_tree = entry[1]
             out: Future = Future()
 
@@ -484,8 +590,7 @@ class RemoteDevice:
                 try:
                     rkind, rmeta, results = f.result()
                     if rkind == "ERROR":
-                        raise RemoteExecutionError(
-                            rmeta.get("error", "remote error"))
+                        _raise_reply_error(rmeta)
                     out.set_result(jax.tree_util.tree_unflatten(
                         out_tree, results))
                 except BaseException as e:  # noqa: BLE001

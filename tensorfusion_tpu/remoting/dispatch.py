@@ -1,0 +1,498 @@
+"""QoS-weighted fair dispatch for the remote-vTPU worker.
+
+The worker used to be per-connection greedy: every connection handler
+thread executed its own EXECUTEs straight onto the devices, so a single
+tenant pipelining deeply could monopolize the accelerator while other
+connections starved behind it.  This module centralizes the serving
+path: connection handlers *enqueue* parsed EXECUTE work items (one FIFO
+per tenant, preserving each connection's ``seq`` order) and a single
+dispatcher thread drains the queues onto the devices under
+**start-time fair queueing** (SFQ — Goyal et al.; the packet-scheduling
+classic adapted to device launches):
+
+- every item carries a cost (the executable's MFLOP estimate, the same
+  charge model the meter uses) and is tagged on arrival with a virtual
+  start/finish time: ``S = max(V, tenant.last_finish)``,
+  ``F = S + cost / weight``;
+- the dispatcher always serves the queue-head item with the smallest
+  finish tag, and advances the global virtual time ``V`` to the served
+  item's start tag.
+
+Over any backlogged interval each tenant therefore receives device time
+proportional to its weight — the remote analog of the ERL layer's
+QoS-proportional duty redistribution for local tenants, resolved from
+the same ``constants.QOS_DISPATCH_WEIGHTS`` ladder.
+
+The dispatcher also owns:
+
+- **adaptive backpressure**: bounded per-tenant and global queue depths.
+  Connections that negotiated protocol v4 get a structured ``BUSY``
+  reply (with a ``retry_after_ms`` estimated from the recent service
+  rate) so they can retry with jitter instead of piling on; older (v2 /
+  v3) connections block in their handler thread instead, which
+  backpressures through TCP exactly like the old in-line execution did
+  — no behavior change for old clients.
+- **deadlines**: items whose ``deadline_ms`` elapsed while queued are
+  answered with ``DEADLINE_EXCEEDED`` instead of burning device time on
+  a result the client already gave up on.
+- **micro-batch collection**: when the winning item's executable is
+  batchable (client opt-in at COMPILE), queue heads across *all*
+  tenants holding compatible items (same executable — hence identical
+  arg signature — same wire options) are collected into one work batch
+  the worker fuses into a single device launch.  Per-tenant FIFO order
+  is preserved because only consecutive head items are taken.
+- **observability**: queue-wait and service-time histograms plus
+  reject/deadline/launch counters, snapshotted by the worker's INFO
+  reply and shipped as ``tpf_remote_dispatch`` influx lines by the
+  metrics recorders.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from .. import constants
+
+#: queue-depth defaults — deep enough for DCN-latency pipelining
+#: (clients run depths of 8-32), shallow enough that queue wait stays
+#: bounded: a saturated worker should push back, not buffer minutes of
+#: work it cannot serve
+DEFAULT_MAX_QUEUE_PER_TENANT = 64
+DEFAULT_MAX_QUEUE_GLOBAL = 256
+#: ceiling on how many compatible requests fuse into one device launch
+#: (each distinct batch size compiles its own stacked variant once, so
+#: the cap also bounds the variant cache per executable)
+DEFAULT_MAX_MICROBATCH = 8
+
+
+def qos_weight(qos: Optional[str]) -> float:
+    """Dispatch weight for a QoS class; unknown/absent -> the default
+    tier, never a rejection (an old client simply doesn't send one)."""
+    return float(constants.QOS_DISPATCH_WEIGHTS.get(
+        qos or constants.DEFAULT_QOS,
+        constants.QOS_DISPATCH_WEIGHTS[constants.DEFAULT_QOS]))
+
+
+class LatencyRecorder:
+    """Bounded reservoir + counters for one latency series.
+
+    Keeps the most recent ``maxlen`` samples (seconds) in a ring; p50 /
+    p99 are computed on snapshot.  Recent-window quantiles are the
+    right shape for saturation alerting — a day-old histogram bucket
+    would mask a queue that went bad five minutes ago."""
+
+    def __init__(self, maxlen: int = 2048):
+        self._samples: deque = deque(maxlen=maxlen)
+        self.count = 0
+        self.total_s = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, seconds: float) -> None:
+        with self._lock:
+            self._samples.append(seconds)
+            self.count += 1
+            self.total_s += seconds
+
+    def mean_s(self) -> float:
+        with self._lock:
+            if not self._samples:
+                return 0.0
+            return sum(self._samples) / len(self._samples)
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            samples = sorted(self._samples)
+            count, total = self.count, self.total_s
+        if not samples:
+            return {"count": count, "p50_ms": 0.0, "p99_ms": 0.0,
+                    "mean_ms": 0.0}
+        def q(p):
+            return samples[min(int(p * (len(samples) - 1)),
+                               len(samples) - 1)]
+        return {"count": count,
+                "p50_ms": round(q(0.50) * 1e3, 3),
+                "p99_ms": round(q(0.99) * 1e3, 3),
+                "mean_ms": round(sum(samples) / len(samples) * 1e3, 3)}
+
+
+class WorkItem:
+    """One parsed EXECUTE waiting for device time."""
+
+    __slots__ = ("kind", "meta", "buffers", "reply", "tenant", "cost",
+                 "exe_id", "batch_key", "enqueue_t", "deadline_t",
+                 "start_tag", "finish_tag", "dispatch_t")
+
+    def __init__(self, kind: str, meta: dict, buffers: list,
+                 reply: Callable, cost: float, exe_id: str,
+                 batch_key: Optional[str], deadline_t: Optional[float]):
+        self.kind = kind
+        self.meta = meta
+        self.buffers = buffers
+        self.reply = reply
+        self.tenant: Optional["Tenant"] = None
+        self.cost = max(cost, 1e-9)
+        self.exe_id = exe_id
+        #: items sharing a non-None batch_key may fuse into one launch
+        self.batch_key = batch_key
+        self.enqueue_t = time.monotonic()
+        self.deadline_t = deadline_t
+        self.start_tag = 0.0
+        self.finish_tag = 0.0
+        self.dispatch_t = 0.0
+
+
+class Tenant:
+    """Per-connection dispatch state: a FIFO of pending items plus the
+    SFQ finish tag and completion accounting for barriers."""
+
+    def __init__(self, conn_id: str, qos: str, weight: float):
+        self.conn_id = conn_id
+        self.qos = qos
+        self.weight = max(weight, 1e-6)
+        self.queue: deque = deque()
+        self.last_finish = 0.0
+        #: items dispatched but not yet fully completed (replied/flushed)
+        self.inflight = 0
+        self.submitted = 0
+        self.completed = 0
+        self.closed = False
+
+
+class BusyError(Exception):
+    """submit() rejection for a v4 connection: queue bounds exceeded."""
+
+    def __init__(self, scope: str, depth: int, retry_after_ms: int):
+        super().__init__(f"{scope} dispatch queue full ({depth} deep)")
+        self.scope = scope
+        self.depth = depth
+        self.retry_after_ms = retry_after_ms
+
+
+class DeviceDispatcher:
+    """Central device dispatch scheduler (one per worker).
+
+    ``execute_batch(items, peek_next)`` is the worker-supplied launch
+    function: it must reply to every item (success or error) and may
+    call ``peek_next()`` after launching to start the next item's
+    host->device transfers while the devices are busy.  It may return a
+    callable *flush* to defer the blocking result materialization; the
+    dispatcher runs the flush after launching the following batch so
+    result serialization of launch k overlaps device compute of k+1 —
+    the same deferred-reply overlap the per-connection loop used to do,
+    now across connections."""
+
+    def __init__(self, execute_batch: Callable,
+                 mode: str = "wfq",
+                 max_queue_per_tenant: int = DEFAULT_MAX_QUEUE_PER_TENANT,
+                 max_queue_global: int = DEFAULT_MAX_QUEUE_GLOBAL,
+                 max_microbatch: int = DEFAULT_MAX_MICROBATCH):
+        if mode not in ("wfq", "fifo"):
+            raise ValueError(f"unknown dispatch mode {mode!r}")
+        self.execute_batch = execute_batch
+        self.mode = mode
+        self.max_queue_per_tenant = max_queue_per_tenant
+        self.max_queue_global = max_queue_global
+        self.max_microbatch = max(1, max_microbatch)
+        self._cv = threading.Condition()
+        self._tenants: Dict[str, Tenant] = {}
+        self._vtime = 0.0
+        self._depth = 0
+        self._fifo_seq = 0
+        self._stopping = False
+        self._thread: Optional[threading.Thread] = None
+        # -- observability ------------------------------------------------
+        self.queue_wait = LatencyRecorder()
+        self.service = LatencyRecorder()
+        self.per_qos_wait: Dict[str, LatencyRecorder] = {}
+        self.per_qos_served: Dict[str, int] = {}
+        self.executed = 0          # requests served
+        self.launches = 0          # device launches (batches fuse many)
+        self.microbatched = 0      # requests that rode a fused launch
+        self.busy_rejected = 0
+        self.deadline_exceeded = 0
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop,
+                                        name="tpf-remote-dispatch",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopping = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # -- tenant registry --------------------------------------------------
+
+    def register_tenant(self, conn_id: str,
+                        qos: str = constants.DEFAULT_QOS) -> Tenant:
+        tenant = Tenant(conn_id, qos, qos_weight(qos))
+        with self._cv:
+            self._tenants[conn_id] = tenant
+        return tenant
+
+    def set_qos(self, tenant: Tenant, qos: str) -> float:
+        """Re-weight a tenant (HELLO negotiation may arrive after the
+        connection registered with the default class)."""
+        with self._cv:
+            tenant.qos = qos
+            tenant.weight = qos_weight(qos)
+        return tenant.weight
+
+    def unregister(self, tenant: Tenant) -> None:
+        """Connection closed: drop anything still queued (their replies
+        have no socket to land on) and remove the tenant."""
+        with self._cv:
+            tenant.closed = True
+            self._depth -= len(tenant.queue)
+            tenant.queue.clear()
+            self._tenants.pop(tenant.conn_id, None)
+            self._cv.notify_all()
+
+    # -- enqueue ----------------------------------------------------------
+
+    def _retry_after_ms(self) -> int:
+        """Backpressure hint: how long the current backlog needs to
+        drain at the recent service rate (bounded to something a client
+        can reasonably sleep)."""
+        per_item = self.service.mean_s() or 0.005
+        est = self._depth * per_item * 1e3
+        return int(min(max(est, 5.0), 5000.0))
+
+    def submit(self, tenant: Tenant, item: WorkItem,
+               block: bool) -> None:
+        """Enqueue one item in the tenant's FIFO.
+
+        ``block=False`` (v4 connections): raises :class:`BusyError` when
+        either depth bound is hit, carrying the retry hint.
+        ``block=True`` (v2/v3 connections): waits for space, which
+        stalls the connection's reader exactly like the old in-line
+        execution — the wire-level backpressure old clients already
+        understand."""
+        with self._cv:
+            while True:
+                if tenant.closed or self._stopping:
+                    raise ConnectionError("tenant closed")
+                over_tenant = len(tenant.queue) >= self.max_queue_per_tenant
+                over_global = self._depth >= self.max_queue_global
+                if not over_tenant and not over_global:
+                    break
+                if not block:
+                    self.busy_rejected += 1
+                    scope = "per-tenant" if over_tenant else "global"
+                    depth = len(tenant.queue) if over_tenant else self._depth
+                    raise BusyError(scope, depth, self._retry_after_ms())
+                self._cv.wait(timeout=0.5)
+            item.tenant = tenant
+            if self.mode == "wfq":
+                item.start_tag = max(self._vtime, tenant.last_finish)
+                item.finish_tag = item.start_tag + \
+                    item.cost / tenant.weight
+                tenant.last_finish = item.finish_tag
+            else:
+                # fifo baseline: global arrival order, no weighting
+                self._fifo_seq += 1
+                item.start_tag = item.finish_tag = float(self._fifo_seq)
+            tenant.queue.append(item)
+            tenant.submitted += 1
+            self._depth += 1
+            self._cv.notify_all()
+
+    # -- barriers ---------------------------------------------------------
+
+    def barrier(self, tenant: Tenant, timeout: float = 300.0) -> None:
+        """Block until every item this tenant has submitted so far is
+        fully complete (replied).  Connection handlers call this before
+        serving requests that observe execution effects (FETCH / FREE /
+        SNAPSHOT / RESTORE) so per-connection request ordering is
+        preserved across the shared queue."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while tenant.queue or tenant.inflight:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"dispatch barrier timed out for {tenant.conn_id}")
+                self._cv.wait(timeout=min(remaining, 0.5))
+
+    def _complete(self, items: List[WorkItem]) -> None:
+        with self._cv:
+            for item in items:
+                if item.tenant is not None:
+                    item.tenant.inflight -= 1
+                    item.tenant.completed += 1
+            self._cv.notify_all()
+
+    # -- dispatch loop ----------------------------------------------------
+
+    def _pick_locked(self) -> Optional[List[WorkItem]]:
+        """Choose the next work batch (caller holds the lock): the head
+        item with the minimum finish tag, plus — when it is batchable —
+        compatible head-run items from every queue, smallest tags
+        first."""
+        best: Optional[Tenant] = None
+        for tenant in self._tenants.values():
+            if not tenant.queue:
+                continue
+            if best is None or \
+                    tenant.queue[0].finish_tag < best.queue[0].finish_tag:
+                best = tenant
+        if best is None:
+            return None
+        head = best.queue.popleft()
+        self._depth -= 1
+        self._vtime = max(self._vtime, head.start_tag)
+        batch = [head]
+        head.tenant.inflight += 1
+        if head.batch_key is not None:
+            # collect same-key items: first the winner's own consecutive
+            # run (FIFO safe), then other tenants' head runs in tag order
+            donors = sorted(
+                (t for t in self._tenants.values() if t.queue),
+                key=lambda t: t.queue[0].finish_tag)
+            for tenant in [best] + [t for t in donors if t is not best]:
+                while (len(batch) < self.max_microbatch and tenant.queue
+                       and tenant.queue[0].batch_key == head.batch_key):
+                    nxt = tenant.queue.popleft()
+                    self._depth -= 1
+                    nxt.tenant.inflight += 1
+                    batch.append(nxt)
+                if len(batch) >= self.max_microbatch:
+                    break
+        self._cv.notify_all()
+        return batch
+
+    def peek_next(self) -> Optional[WorkItem]:
+        """The item the dispatcher will most likely serve next (used by
+        the worker to overlap its host->device transfers with the launch
+        in progress).  Only the dispatcher thread mutates items, so the
+        worker may stash transfer futures on the returned item."""
+        with self._cv:
+            best = None
+            for tenant in self._tenants.values():
+                if tenant.queue and (
+                        best is None
+                        or tenant.queue[0].finish_tag < best.finish_tag):
+                    best = tenant.queue[0]
+            return best
+
+    def _expire_locked(self, item: WorkItem) -> bool:
+        return item.deadline_t is not None and \
+            time.monotonic() > item.deadline_t
+
+    def _loop(self) -> None:
+        pending_flush: Optional[Callable] = None
+        pending_items: List[WorkItem] = []
+        while True:
+            with self._cv:
+                batch = None if self._stopping else self._pick_locked()
+                if batch is None and pending_flush is None:
+                    if self._stopping:
+                        return
+                    self._cv.wait(timeout=0.5)
+                    continue
+            if batch is None:
+                # queue drained: run the deferred flush now
+                pending_flush()
+                self._complete(pending_items)
+                pending_flush, pending_items = None, []
+                continue
+            now = time.monotonic()
+            expired = [i for i in batch if self._expire_locked(i)]
+            batch = [i for i in batch if i not in expired]
+            for item in expired:
+                self.deadline_exceeded += 1
+                waited_ms = int((now - item.enqueue_t) * 1e3)
+                try:
+                    item.reply("ERROR", {
+                        "error": f"deadline exceeded after {waited_ms}ms "
+                                 f"in queue",
+                        "code": "DEADLINE_EXCEEDED",
+                        "queue_wait_ms": waited_ms}, [])
+                except (ConnectionError, OSError):
+                    pass
+            if expired:
+                self._complete(expired)
+            if not batch:
+                continue
+            for item in batch:
+                item.dispatch_t = now
+                wait = now - item.enqueue_t
+                self.queue_wait.observe(wait)
+                qos = item.tenant.qos if item.tenant else \
+                    constants.DEFAULT_QOS
+                self.per_qos_wait.setdefault(
+                    qos, LatencyRecorder()).observe(wait)
+            t0 = time.perf_counter()
+            try:
+                flush = self.execute_batch(batch, self.peek_next)
+            except Exception as e:  # noqa: BLE001 - reply, keep serving
+                flush = None
+                for item in batch:
+                    try:
+                        item.reply("ERROR", {"error": str(e)}, [])
+                    except (ConnectionError, OSError):
+                        pass
+            # run the PREVIOUS batch's deferred flush after this batch
+            # launched: reply serialization overlaps device compute
+            if pending_flush is not None:
+                pending_flush()
+                self._complete(pending_items)
+                pending_flush, pending_items = None, []
+            dt = time.perf_counter() - t0
+            self.launches += 1
+            self.executed += len(batch)
+            if len(batch) > 1:
+                self.microbatched += len(batch)
+            for item in batch:
+                self.service.observe(dt)
+                qos = item.tenant.qos if item.tenant else \
+                    constants.DEFAULT_QOS
+                self.per_qos_served[qos] = \
+                    self.per_qos_served.get(qos, 0) + 1
+            if flush is not None:
+                pending_flush, pending_items = flush, batch
+            else:
+                self._complete(batch)
+
+    # -- observability ----------------------------------------------------
+
+    def depth(self) -> int:
+        with self._cv:
+            return self._depth
+
+    def snapshot(self) -> dict:
+        """Stats for INFO replies and the metrics recorders."""
+        with self._cv:
+            per_tenant = {
+                t.conn_id: {"qos": t.qos, "weight": t.weight,
+                            "queued": len(t.queue),
+                            "submitted": t.submitted,
+                            "completed": t.completed}
+                for t in self._tenants.values()}
+            depth = self._depth
+        return {
+            "mode": self.mode,
+            "depth": depth,
+            "max_queue_per_tenant": self.max_queue_per_tenant,
+            "max_queue_global": self.max_queue_global,
+            "executed": self.executed,
+            "launches": self.launches,
+            "microbatched_requests": self.microbatched,
+            "busy_rejected": self.busy_rejected,
+            "deadline_exceeded": self.deadline_exceeded,
+            "queue_wait": self.queue_wait.snapshot(),
+            "service": self.service.snapshot(),
+            "per_qos": {
+                qos: dict(self.per_qos_wait[qos].snapshot(),
+                          served=self.per_qos_served.get(qos, 0))
+                for qos in self.per_qos_wait},
+            "tenants": per_tenant,
+        }

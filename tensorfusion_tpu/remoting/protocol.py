@@ -40,6 +40,27 @@ frames whose semantics it cannot honor):
   ``version`` is the negotiated wire version for the connection.  The
   HELLO frame itself is always encoded at version 2 so a v2 peer can
   read it — negotiation must happen *below* the feature gate.
+
+Version 4 adds QoS-aware dispatch fields, again all additive JSON meta
+(frame layout unchanged):
+
+- HELLO: optional ``qos`` (the tenant's ``tpu-fusion.ai/qos`` class);
+  HELLO_OK echoes the worker-resolved ``qos_weight`` so the client can
+  see the share it negotiated.
+- EXECUTE: optional ``deadline_ms`` — maximum queue wait before the
+  worker answers ``DEADLINE_EXCEEDED`` instead of executing.
+- ERROR: optional structured ``code`` (``BUSY`` with ``retry_after_ms``
+  when the worker's dispatch queue rejected the request;
+  ``DEADLINE_EXCEEDED`` with ``queue_wait_ms``) so clients can retry
+  with jitter instead of treating saturation as a hard failure.
+- Wire compression is adaptive **per frame**: each buffer is
+  compressed only when deflate actually shrinks it (the per-buffer
+  ``enc`` field has carried this since v2, so the adaptivity is
+  wire-compatible all the way back).  The worker additionally decides
+  per *connection* whether to try at all — loopback peers ship raw
+  (zlib costs more CPU than same-host bytes are worth), remote peers
+  get the adaptive path; ``TPF_REMOTING_COMPRESS=1``/``0`` forces
+  either everywhere.
 """
 
 from __future__ import annotations
@@ -48,14 +69,14 @@ import json
 import socket
 import struct
 import zlib
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 MAGIC = b"TPFR"
-VERSION = 3
-#: frame versions this build can decode (v3 is additive over v2)
-SUPPORTED_VERSIONS = (2, 3)
+VERSION = 4
+#: frame versions this build can decode (v3/v4 are additive over v2)
+SUPPORTED_VERSIONS = (2, 3, 4)
 #: version every HELLO is framed at, so any peer can read it
 HELLO_VERSION = 2
 
@@ -99,12 +120,20 @@ def _np_dtype(name: str):
 def encode_message_parts(kind: str, meta: Dict[str, Any],
                          buffers: List[np.ndarray],
                          compress: bool = False,
-                         version: int = VERSION) -> List:
+                         version: int = VERSION,
+                         stats: Optional[Dict[str, int]] = None) -> List:
     """Wire pieces for one message: [head_bytes, buf_view, ...].
 
     Buffer payloads stay as zero-copy memoryviews over the (contiguous)
     arrays — the hot serving path moves megabytes per EXECUTE, and
-    concatenating them into one bytes object doubled its memory traffic."""
+    concatenating them into one bytes object doubled its memory traffic.
+
+    ``compress=True`` is *adaptive per buffer*: a cheap prefix probe
+    decides whether deflating is worth it, and the buffer ships raw
+    (flagged in its ``enc`` header field) whenever compression would
+    not actually shrink it.  ``stats``, when given, accumulates
+    ``raw_bytes`` / ``wire_bytes`` / ``buffers_zlib`` / ``buffers_raw``
+    across calls so the sender can report its realized ratio."""
     descs = []
     views: List = []
     for arr in buffers:
@@ -129,6 +158,11 @@ def encode_message_parts(kind: str, meta: Dict[str, Any],
                       "nbytes": len(wire), "raw_nbytes": raw_nbytes,
                       "enc": enc})
         views.append(wire)
+        if stats is not None:
+            stats["raw_bytes"] = stats.get("raw_bytes", 0) + raw_nbytes
+            stats["wire_bytes"] = stats.get("wire_bytes", 0) + len(wire)
+            key = "buffers_zlib" if enc == "zlib" else "buffers_raw"
+            stats[key] = stats.get(key, 0) + 1
     header = json.dumps({"kind": kind, "meta": meta,
                          "buffers": descs}).encode()
     head = MAGIC + struct.pack("<II", version, len(header)) + header
@@ -162,13 +196,15 @@ def _read_exact(sock: socket.socket, n: int) -> bytearray:
 
 def send_message(sock: socket.socket, kind: str, meta: Dict[str, Any],
                  buffers: List[np.ndarray], compress: bool = False,
-                 version: int = VERSION) -> None:
+                 version: int = VERSION,
+                 stats: Optional[Dict[str, int]] = None) -> None:
     # scatter-gather: header and each (possibly multi-MB) buffer go out
     # as separate sendalls straight from their memoryviews — no payload
     # concatenation.  TCP_NODELAY (set at connect) keeps the small
     # header from Nagle-stalling behind the previous buffer.
     for part in encode_message_parts(kind, meta, buffers,
-                                     compress=compress, version=version):
+                                     compress=compress, version=version,
+                                     stats=stats):
         sock.sendall(part)
 
 

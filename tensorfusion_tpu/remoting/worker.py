@@ -33,6 +33,15 @@ Hardening (beyond the round-1 prototype):
 - **pipelining**: requests carry a ``seq`` echoed in the response, so a
   client may keep many EXECUTEs in flight on one connection (the worker
   processes them in order; the overlap hides DCN latency).
+- **QoS-aware dispatch** (protocol v4): connection handlers no longer
+  execute greedily — parsed EXECUTEs flow through a central
+  :class:`~.dispatch.DeviceDispatcher` (weighted fair queueing over the
+  HELLO-negotiated QoS class, per-connection FIFO preserved) with
+  bounded queue depths (structured ``BUSY`` backpressure for v4
+  clients, TCP backpressure for older ones), optional per-request
+  deadlines, cross-connection micro-batching of compatible requests
+  into single device launches, and queue-wait / service-time
+  histograms surfaced via INFO and the metrics recorders.
 - **snapshot/restore**: resident buffers + the executable cache persist
   to a state dir and re-materialize on another worker — the buffer-level
   half of live migration that the provider ABI's device-level
@@ -49,15 +58,24 @@ import logging
 import os
 import socketserver
 import threading
+import time
 from concurrent.futures import Future, ThreadPoolExecutor
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .. import constants
 from . import protocol
+from .dispatch import BusyError, DeviceDispatcher, WorkItem, qos_weight
 from .protocol import recv_message, send_message
 
 log = logging.getLogger("tpf.remoting.worker")
+
+#: request kinds that observe execution effects (results, resident-set
+#: mutations) and therefore wait for the connection's queued EXECUTEs
+#: to finish before running — per-connection ordering across the shared
+#: dispatch queue
+_BARRIER_KINDS = ("FETCH", "FREE", "SNAPSHOT", "RESTORE")
 
 
 class RemoteVTPUWorker:
@@ -66,7 +84,11 @@ class RemoteVTPUWorker:
                  max_resident_bytes: int = 0,
                  compress: Optional[bool] = None,
                  insecure: Optional[bool] = None,
-                 protocol_version: int = protocol.VERSION):
+                 protocol_version: int = protocol.VERSION,
+                 dispatch_mode: Optional[str] = None,
+                 max_queue_per_tenant: Optional[int] = None,
+                 max_queue_global: Optional[int] = None,
+                 max_microbatch: Optional[int] = None):
         self.meter_client = meter_client    # optional VTPUClient
         #: highest wire version this worker speaks; pinning it to 2 makes
         #: the worker byte-faithful to a v2 build (mixed-version tests)
@@ -87,11 +109,22 @@ class RemoteVTPUWorker:
                 f"token: set TPF_REMOTING_TOKEN (or pass token=), or "
                 f"opt in explicitly with insecure=True / "
                 f"TPF_REMOTING_INSECURE=1")
-        #: wire compression pays for itself across DCN, not loopback/rack
-        #: links where zlib costs more than the bytes saved — off unless
-        #: asked (TPF_REMOTING_COMPRESS=1)
-        self.compress = compress if compress is not None else \
-            os.environ.get("TPF_REMOTING_COMPRESS", "") == "1"
+        #: wire compression policy.  Per-frame it is always adaptive —
+        #: each buffer is probe-tested and ships deflated only when that
+        #: actually shrinks it (protocol.encode_message_parts) — but
+        #: whether to even try is decided per CONNECTION: loopback
+        #: peers skip it entirely (zlib on a same-host link costs more
+        #: CPU than the bytes are worth — measured +25% on the serving
+        #: bench for saturating tanh outputs), remote peers get the
+        #: adaptive path (the DCN links the protocol exists for).
+        #: TPF_REMOTING_COMPRESS=1 forces it on everywhere, =0 off
+        #: everywhere; constructor arg wins over env.
+        if compress is None:
+            env = os.environ.get("TPF_REMOTING_COMPRESS", "")
+            compress = {"1": True, "0": False}.get(env)
+        self.compress: Optional[bool] = compress   # None = auto
+        #: realized compression accounting (reported by INFO)
+        self._wire_stats: Dict[str, int] = {}
         #: resident-buffer budget; 0 = unlimited
         self.max_resident_bytes = max_resident_bytes
         self.resident_bytes = 0
@@ -107,6 +140,15 @@ class RemoteVTPUWorker:
         #: exe_id -> sharded-executable record (jitted flat call +
         #: shardings + wire layouts) for multi-device exports
         self._exe_sharded: Dict[str, dict] = {}
+        #: exe_ids whose client opted into micro-batching at COMPILE
+        self._exe_microbatch: set = set()
+        #: exe_id -> deserialized Exported (kept only for micro-batch
+        #: opt-ins: stacked variants re-trace through exported.call)
+        self._exe_exported: Dict[str, object] = {}
+        #: exe_id -> flat result count (splitting fused launch outputs)
+        self._exe_nout: Dict[str, int] = {}
+        #: (exe_id, k) -> jitted k-request fused launch
+        self._exe_stacked: Dict[Tuple[str, int], Callable] = {}
         self._buffers: Dict[str, object] = {}    # device-resident arrays
         #: buf_id -> device id the buffer was PUT to (single-device
         #: buffers; sharded results span devices and are not listed)
@@ -123,6 +165,19 @@ class RemoteVTPUWorker:
         self._scatter_pool: Optional[ThreadPoolExecutor] = None
         #: per-exe_id in-flight compile locks (COMPILE_MLIR single-flight)
         self._compile_flights: Dict[str, threading.Lock] = {}
+        #: central QoS-weighted device dispatch (the serving path):
+        #: handlers enqueue, one dispatcher thread drains onto devices
+        mode = dispatch_mode or os.environ.get(
+            constants.ENV_REMOTING_DISPATCH, "") or "wfq"
+        kwargs = {}
+        if max_queue_per_tenant is not None:
+            kwargs["max_queue_per_tenant"] = max_queue_per_tenant
+        if max_queue_global is not None:
+            kwargs["max_queue_global"] = max_queue_global
+        if max_microbatch is not None:
+            kwargs["max_microbatch"] = max_microbatch
+        self.dispatcher = DeviceDispatcher(self._execute_batch,
+                                           mode=mode, **kwargs)
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -139,6 +194,14 @@ class RemoteVTPUWorker:
                 self.accept = tuple(
                     v for v in protocol.SUPPORTED_VERSIONS
                     if v <= outer.protocol_version)
+                # per-connection compression decision (worker.compress
+                # None = auto: adaptive for remote peers, raw for
+                # loopback where zlib CPU outweighs the bytes)
+                peer = self.client_address[0] \
+                    if isinstance(self.client_address, tuple) else ""
+                self.compress_on = outer.compress if \
+                    outer.compress is not None else \
+                    peer not in ("127.0.0.1", "::1", "localhost")
 
             def negotiate(self, meta) -> int:
                 try:
@@ -155,6 +218,7 @@ class RemoteVTPUWorker:
                 # gets pipelined frame decoding (protocol.py additionally
                 # caps header/buffer sizes so even the single pre-auth
                 # frame is bounded).
+                self.qos = constants.DEFAULT_QOS
                 try:
                     if outer.token and not self._hello():
                         return
@@ -168,6 +232,14 @@ class RemoteVTPUWorker:
                 with outer._lock:
                     outer._conn_seq += 1
                     conn_ns = f"cn{outer._conn_seq}:"
+                # the connection is one dispatch tenant: its QoS class
+                # (HELLO-negotiated) sets its fair-queue weight
+                tenant = outer.dispatcher.register_tenant(conn_ns,
+                                                          qos=self.qos)
+                # EXECUTE replies come from the dispatcher thread while
+                # this thread answers PUT/INFO/...: one write lock keeps
+                # reply frames from interleaving on the socket
+                wlock = threading.Lock()
 
                 def xid(i):
                     return conn_ns + i if isinstance(i, str) and \
@@ -207,19 +279,8 @@ class RemoteVTPUWorker:
 
                 threading.Thread(target=_reader, daemon=True,
                                  name="tpf-remote-readahead").start()
-                # Deferred-reply pipelining: an EXECUTE's result is
-                # materialized (np.asarray blocks on the async jax
-                # dispatch) only after the NEXT pipelined request has
-                # been launched, so XLA compute of k+1 overlaps
-                # serialization of k — one thread, no GIL handoff, and
-                # the client matches responses by seq so ordering is
-                # free to shift.
-                pending = None
                 try:
                     while True:
-                        if pending is not None and inbox.empty():
-                            pending()
-                            pending = None
                         item = inbox.get()
                         if item is None:
                             break
@@ -230,35 +291,51 @@ class RemoteVTPUWorker:
                                   _seq=seq):
                             if _seq is not None:
                                 rmeta = dict(rmeta, seq=_seq)
-                            send_message(self.request, rkind, rmeta, rbufs,
-                                         compress=compress,
-                                         version=self.wire_version)
+                            st: Dict[str, int] = {}
+                            with wlock:
+                                send_message(self.request, rkind, rmeta,
+                                             rbufs,
+                                             compress=compress
+                                             and self.compress_on,
+                                             version=self.wire_version,
+                                             stats=st)
+                            outer._merge_wire_stats(st)
 
                         if kind == "HELLO":
                             # repeated HELLO on an authed connection is a
                             # no-op ack (clients retry it on reconnect);
                             # unauthenticated connections negotiate the
-                            # wire version here
+                            # wire version and their QoS class here
+                            qos = meta.get("qos") or self.qos
+                            if qos != tenant.qos:
+                                outer.dispatcher.set_qos(tenant, qos)
                             reply("HELLO_OK",
-                                  {"version": self.negotiate(meta)}, [])
+                                  {"version": self.negotiate(meta),
+                                   "qos_weight": qos_weight(qos)}, [])
                             continue
-                        deferred = None
                         try:
-                            deferred = outer._dispatch(reply, kind,
-                                                       remap_ids(meta),
-                                                       buffers)
+                            if kind == "EXECUTE":
+                                # serving path: enqueue for the central
+                                # dispatcher and go straight back to
+                                # decoding the next pipelined frame
+                                outer._enqueue_execute(
+                                    reply, remap_ids(meta), buffers,
+                                    tenant)
+                                continue
+                            if kind in _BARRIER_KINDS:
+                                # these observe execution effects: wait
+                                # for this connection's queued EXECUTEs
+                                # so per-connection ordering holds
+                                outer.dispatcher.barrier(tenant)
+                            outer._dispatch(reply, kind, remap_ids(meta),
+                                            buffers)
                         except Exception as e:  # noqa: BLE001
                             log.exception("remote %s failed", kind)
                             reply("ERROR", {"error": str(e)}, [])
-                        if pending is not None:
-                            pending()
-                            pending = None
-                        if deferred is not None:
-                            pending = deferred
-                    if pending is not None:
-                        pending()
                 except (ConnectionError, OSError):
                     pass
+                finally:
+                    outer.dispatcher.unregister(tenant)
 
             def _hello(self) -> bool:
                 """First frame must be a HELLO with the right token."""
@@ -279,10 +356,14 @@ class RemoteVTPUWorker:
                                            outer.token):
                     reply("ERROR", {"error": "bad token"})
                     return False
+                # the tenant's QoS class rides the HELLO; it becomes the
+                # connection's dispatch weight once the tenant registers
+                self.qos = meta.get("qos") or self.qos
                 # negotiate before replying so HELLO_OK itself is framed
                 # at the agreed version (both ends accept it: v3 clients
                 # read v2 and v3, v2 clients only ever negotiate 2)
-                reply("HELLO_OK", {"version": self.negotiate(meta)})
+                reply("HELLO_OK", {"version": self.negotiate(meta),
+                                   "qos_weight": qos_weight(self.qos)})
                 return True
 
         class Server(socketserver.ThreadingTCPServer):
@@ -299,16 +380,20 @@ class RemoteVTPUWorker:
         return f"tcp://127.0.0.1:{self.port}"
 
     def start(self) -> None:
+        self.dispatcher.start()
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         name="tpf-remote-worker",
                                         daemon=True)
         self._thread.start()
-        log.info("remote-vTPU worker serving on %s%s", self.url,
-                 " (token auth)" if self.token else " (OPEN — no token)")
+        log.info("remote-vTPU worker serving on %s%s (dispatch=%s)",
+                 self.url,
+                 " (token auth)" if self.token else " (OPEN — no token)",
+                 self.dispatcher.mode)
 
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        self.dispatcher.stop()
 
     # -- resident-buffer accounting ------------------------------------
 
@@ -645,6 +730,360 @@ class RemoteVTPUWorker:
             mflops = 1
         return exe, sig, mflops
 
+    # -- central QoS dispatch: enqueue + device-side execution ----------
+
+    def _merge_wire_stats(self, st: Dict[str, int]) -> None:
+        if not st:
+            return
+        with self._lock:
+            for k, v in st.items():
+                self._wire_stats[k] = self._wire_stats.get(k, 0) + v
+
+    def _enqueue_execute(self, reply, meta, buffers, tenant) -> None:
+        """Connection handler side of EXECUTE: validate, wrap into a
+        WorkItem and hand it to the fair-queue dispatcher.  v4
+        connections get structured BUSY rejections; older ones block
+        here (TCP backpressure, the contract they already have)."""
+        exe_id = meta["exe_id"]
+        with self._lock:
+            known = exe_id in self._exe_cache or \
+                exe_id in self._mlir_exes or exe_id in self._exe_sharded
+            mflops = self._exe_costs.get(exe_id, 1)
+            batchable = exe_id in self._exe_microbatch and \
+                exe_id in self._exe_cache
+        if not known:
+            reply("ERROR", {"error": f"unknown executable {exe_id}",
+                            "code": "needs_compile"}, [])
+            return
+        v4 = meta.get("_wire_version", 2) >= 4
+        deadline_t = None
+        if v4 and meta.get("deadline_ms") is not None:
+            try:
+                deadline_t = time.monotonic() + \
+                    float(meta["deadline_ms"]) / 1e3
+            except (TypeError, ValueError):
+                deadline_t = None
+        # fusable: plain single-device requests that want their results
+        # on the wire (keep_results parks device handles per request;
+        # sharded/mlir paths launch differently)
+        batch_key = exe_id if batchable and not meta.get("keep_results") \
+            and meta.get("arg_shards") is None else None
+        item = WorkItem("EXECUTE", meta, buffers, reply, float(mflops),
+                        exe_id, batch_key, deadline_t)
+        # BUSY rejection only makes sense where the client can cleanly
+        # retry: pre-v4 connections, fire-and-forget chains (quiet /
+        # keep_results step chains mint ids they immediately depend on)
+        # and sharded calls (their ephemeral shard PUTs are already
+        # resident — rejecting the EXECUTE would orphan them) block
+        # here instead — TCP backpressure, the old contract
+        block = not v4 or bool(meta.get("quiet")) or \
+            bool(meta.get("keep_results")) or \
+            meta.get("arg_shards") is not None
+        try:
+            self.dispatcher.submit(tenant, item, block=block)
+        except BusyError as e:
+            reply("ERROR", {"error": str(e), "code": "BUSY",
+                            "retry_after_ms": e.retry_after_ms}, [])
+
+    def _inline_args(self, item: WorkItem) -> list:
+        """All-inline argument list, consuming any device transfers the
+        prefetch overlap already started for this item."""
+        devf = item.meta.pop("_dev_args", None)
+        if devf is not None:
+            return [f.result() for f in devf]
+        return [np.asarray(b) for b in item.buffers]
+
+    def _item_args(self, item: WorkItem) -> list:
+        """Resolve one item's flat argument list (resident refs and/or
+        inline wire buffers) — single-device paths only."""
+        arg_refs = item.meta.get("arg_refs")
+        if arg_refs is None:
+            return self._inline_args(item)
+        it = iter(item.buffers)
+        args = []
+        with self._lock:
+            for ref in arg_refs:
+                if ref is None:
+                    args.append(np.asarray(next(it)))
+                else:
+                    arr = self._buffers.get(ref)
+                    if arr is None:
+                        raise KeyError(f"unknown buffer {ref}")
+                    args.append(arr)
+        # async v3 PUTs park Futures in the table; resolve outside the
+        # lock (other connections need it more than we do)
+        return [self._resolve(a) for a in args]
+
+    def _prefetch_next(self, peek_next) -> None:
+        """Transfer/compute overlap: while the launch just issued runs
+        on the devices, start the *next* queued item's host->device
+        uploads on the scatter pool, so its arguments are resident by
+        the time the dispatcher reaches it."""
+        if peek_next is None:
+            return
+        nxt = peek_next()
+        if nxt is None or not nxt.buffers or \
+                nxt.meta.get("_dev_args") is not None or \
+                nxt.meta.get("arg_refs") is not None or \
+                nxt.meta.get("arg_shards") is not None:
+            return
+        with self._lock:
+            plain = nxt.exe_id in self._exe_cache
+        if not plain:
+            return
+        import jax
+
+        try:
+            pool = self._pool()
+            nxt.meta["_dev_args"] = [
+                pool.submit(jax.device_put, np.asarray(b))
+                for b in nxt.buffers]
+        except Exception:  # noqa: BLE001 - overlap is advisory
+            nxt.meta.pop("_dev_args", None)
+
+    def _stacked_fn(self, exe_id: str, k: int):
+        """Fused k-request launch for a micro-batch-enabled executable:
+        the k calls re-trace through ``exported.call`` into ONE jitted
+        XLA program (one device launch), stacking the requests' batch
+        work side by side.  Exactly semantics-preserving — each request
+        keeps its own inputs/outputs — and signature-safe by
+        construction (same exe_id = same content hash = identical arg
+        shapes/dtypes).  Each distinct k compiles once and is cached;
+        the dispatcher's max_microbatch bounds the variants."""
+        key = (exe_id, k)
+        with self._lock:
+            fn = self._exe_stacked.get(key)
+            exported = self._exe_exported.get(exe_id)
+        if fn is not None:
+            return fn
+        import jax
+
+        n_in = len(exported.in_avals)
+
+        def stacked(*flat):
+            outs = []
+            for i in range(k):
+                res = exported.call(*flat[i * n_in:(i + 1) * n_in])
+                outs.extend(jax.tree_util.tree_leaves(res))
+            return outs
+
+        fn = jax.jit(stacked)
+        with self._lock:
+            self._exe_stacked[key] = fn
+        return fn
+
+    def _execute_batch(self, items: List[WorkItem], peek_next):
+        """Dispatcher callback: launch one work batch onto the devices.
+        Returns a deferred flush (blocking result materialization +
+        reply) when there is one, so the dispatcher can overlap it with
+        the next launch."""
+        if len(items) == 1:
+            return self._execute_one(items[0], peek_next)
+        return self._execute_fused(items, peek_next)
+
+    def _execute_fused(self, items: List[WorkItem], peek_next):
+        """Micro-batched launch: k compatible requests, one device
+        launch, results split back per request."""
+        exe_id = items[0].exe_id
+        k = len(items)
+        with self._lock:
+            mflops = self._exe_costs.get(exe_id, 1)
+            n_out = self._exe_nout.get(exe_id, 1)
+        argsets = []
+        for item in items:
+            try:
+                argsets.append((item, self._item_args(item)))
+            except KeyError as e:
+                self._safe_reply(item, "ERROR",
+                                 {"error": str(e.args[0])}, [])
+        try:
+            if len(argsets) != k:
+                raise ValueError("partial batch")
+            fn = self._stacked_fn(exe_id, len(argsets))
+            flat = [a for _, args in argsets for a in args]
+            leaves = fn(*flat)
+        except Exception:  # noqa: BLE001 - degrade, don't fail the batch
+            # a bad item (or a failed stacked compile) must not take the
+            # innocent requests with it: run the survivors one by one
+            log.exception("fused launch of %d x %s degraded to "
+                          "individual dispatch", k, exe_id)
+            for item, _ in argsets:
+                item.meta.pop("_dev_args", None)
+                flush = self._execute_one(item, None)
+                if flush is not None:
+                    flush()
+            return None
+        self.executions += k
+        if self.meter_client is not None:
+            # each fused request is charged like an individual launch
+            # (the fusion saves dispatch overhead, not billed compute)
+            self.meter_client.charge_launch(mflops * k)
+        self._prefetch_next(peek_next)
+
+        def flush():
+            for i, (item, _) in enumerate(argsets):
+                sub = leaves[i * n_out:(i + 1) * n_out]
+                try:
+                    results = [np.asarray(leaf) for leaf in sub]
+                    self._safe_reply(
+                        item, "EXECUTE_OK",
+                        {"n_results": len(results), "microbatched": k},
+                        results, compress=True)
+                except Exception as e:  # noqa: BLE001 - exec error
+                    log.exception("fused flush failed")
+                    self._safe_reply(item, "ERROR", {"error": str(e)}, [])
+
+        return flush
+
+    @staticmethod
+    def _safe_reply(item: WorkItem, rkind, rmeta, rbufs,
+                    compress: bool = False) -> None:
+        """Reply without letting one tenant's dead socket poison the
+        dispatcher (other tenants' items share the thread)."""
+        try:
+            item.reply(rkind, rmeta, rbufs, compress=compress)
+        except (ConnectionError, OSError):
+            pass
+
+    def _execute_one(self, item: WorkItem, peek_next):
+        """Single-request launch — the v2/v3-era EXECUTE semantics,
+        relocated from the connection handler into the dispatcher."""
+        import jax
+
+        meta, buffers, reply = item.meta, item.buffers, item.reply
+        exe_id = meta["exe_id"]
+        with self._lock:
+            exported = self._exe_cache.get(exe_id)
+            mlir_exe = self._mlir_exes.get(exe_id)
+            sharded = self._exe_sharded.get(exe_id)
+            mflops = self._exe_costs.get(exe_id, 1)
+        if exported is None and mlir_exe is None and sharded is None:
+            self._safe_reply(item, "ERROR",
+                             {"error": f"unknown executable {exe_id}",
+                              "code": "needs_compile"}, [])
+            return None
+        if self.meter_client is not None:
+            self.meter_client.charge_launch(mflops)
+        # arg_refs: per-argument, a buf_id string for resident buffers
+        # or null meaning "next inline wire buffer".  v3 adds
+        # arg_shards: per-argument, null (plain v2 semantics) or a
+        # list of per-device shard entries in the executable's
+        # layout order — each a resident buf_id or null meaning
+        # "next inline wire buffer" (small shards ride the EXECUTE
+        # frame itself; big ones were PUT ahead, pipelined).
+        arg_refs = meta.get("arg_refs")
+        arg_shards = meta.get("arg_shards") \
+            if meta.get("_wire_version", 2) >= 3 else None
+        it = iter(buffers)
+        try:
+            if sharded is not None:
+                args = self._gather_sharded_args(
+                    sharded, arg_refs, arg_shards, it)
+            elif arg_refs is None:
+                args = self._inline_args(item)
+            else:
+                args = self._item_args(item)
+        except KeyError as e:
+            self._safe_reply(item, "ERROR",
+                             {"error": str(e.args[0])}, [])
+            return None
+        if sharded is not None:
+            leaves = sharded["fn"](*args)
+        elif mlir_exe is not None:
+            # PJRT path: flat positional buffers in, flat buffers
+            # out.  Resident buffers PUT to another mesh device are
+            # moved to the executable's device (the transparent
+            # plugin compiles on device 0 in v1).
+            dev = jax.devices()[0]
+
+            def _on_exe_device(a):
+                devs = getattr(a, "devices", None)
+                if devs is None:
+                    return dev.client.buffer_from_pyval(
+                        np.ascontiguousarray(a), dev)
+                if devs() != {dev}:
+                    return jax.device_put(a, dev)
+                return a
+
+            leaves = mlir_exe.execute([_on_exe_device(a)
+                                       for a in args])
+        else:
+            out = exported(*args)
+            leaves = jax.tree_util.tree_leaves(out)
+        self.executions += 1
+        # overlap: while this launch runs, pre-transfer the next item
+        self._prefetch_next(peek_next)
+        if meta.get("keep_results"):
+            # park results device-side, hand back references.  A
+            # client may pre-assign result ids ("c-..." namespace, the
+            # transparent plugin's pipelining: it mints buffer handles
+            # WITHOUT waiting for this reply, because requests on one
+            # connection execute in order) — ids it chose can be
+            # referenced by its very next EXECUTE already.
+            want_ids = meta.get("result_ids")
+            if want_ids is not None:
+                if len(want_ids) != len(leaves):
+                    self._safe_reply(
+                        item, "ERROR",
+                        {"error": f"result_ids count {len(want_ids)} "
+                                  f"!= {len(leaves)} results"}, [])
+                    return None
+                ns = meta.get("_conn_ns", "")
+                if not all(str(i).startswith(ns) for i in want_ids):
+                    # only ids the connection-namespace remap produced
+                    # are accepted — a raw id could clobber another
+                    # client's (or worker-minted) buffer
+                    self._safe_reply(item, "ERROR",
+                                     {"error": "result_ids must be "
+                                               "c-namespace ids"}, [])
+                    return None
+            with self._lock:
+                total = sum(self._leaf_nbytes(l) for l in leaves)
+                err = self._admit_resident(total)
+                if err:
+                    self._safe_reply(item, "ERROR", {"error": err}, [])
+                    return None
+                ids, shapes, dtypes = [], [], []
+                for j, leaf in enumerate(leaves):
+                    if want_ids is not None:
+                        buf_id = str(want_ids[j])
+                    else:
+                        self._buf_seq += 1
+                        buf_id = f"buf-{self._buf_seq}"
+                    self._buffers[buf_id] = leaf
+                    devs = getattr(leaf, "devices", None)
+                    devs = devs() if callable(devs) else devs
+                    if devs is not None and len(devs) == 1:
+                        self._buf_device[buf_id] = \
+                            int(next(iter(devs)).id)
+                    ids.append(buf_id)
+                    shapes.append(list(leaf.shape))
+                    dtypes.append(str(leaf.dtype))
+            if meta.get("quiet"):
+                # pipelined client: it minted the ids itself and
+                # discards success replies unread — skip the frame
+                # entirely (errors above still reply)
+                return None
+            self._safe_reply(item, "EXECUTE_OK",
+                             {"result_refs": ids, "shapes": shapes,
+                              "dtypes": dtypes}, [])
+            return None
+        # defer materialization: jax dispatch is async, so the
+        # dispatcher launches the next batch before this flush blocks
+        # in np.asarray (GIL released) — reply serialization of launch
+        # k overlaps device compute of k+1
+        def flush(_leaves=leaves, _item=item):
+            try:
+                results = [np.asarray(leaf) for leaf in _leaves]
+                self._safe_reply(_item, "EXECUTE_OK",
+                                 {"n_results": len(results)}, results,
+                                 compress=True)
+            except Exception as e:  # noqa: BLE001 - exec error
+                log.exception("deferred EXECUTE flush failed")
+                self._safe_reply(_item, "ERROR", {"error": str(e)}, [])
+
+        return flush
+
     # ------------------------------------------------------------------
 
     def _dispatch(self, reply, kind, meta, buffers) -> None:
@@ -675,11 +1114,21 @@ class RemoteVTPUWorker:
                     d = buf_device.get(buf_id, 0)
                     per_device[d] = per_device.get(d, 0) + \
                         self._leaf_nbytes(arr)
+            with self._lock:
+                wire = dict(self._wire_stats)
+            if wire.get("raw_bytes"):
+                # realized adaptive-compression ratio: wire bytes
+                # actually sent / raw bytes they encode (1.0 = nothing
+                # shrank; the per-buffer probe kept everything raw)
+                wire["realized_ratio"] = round(
+                    wire.get("wire_bytes", 0) / wire["raw_bytes"], 4)
             reply("INFO_OK", {
                 "platform": dev.platform,
                 "device_kind": getattr(dev, "device_kind", ""),
                 "n_devices": len(devices),
                 "protocol_version": self.protocol_version,
+                "dispatch": self.dispatcher.snapshot(),
+                "wire_compression": wire,
                 # full inventory for placement: id + mesh coords (TPUs
                 # expose .coords; CPU/GPU devices report their index)
                 "devices": [
@@ -749,8 +1198,18 @@ class RemoteVTPUWorker:
             with self._lock:
                 known = exe_id in self._exe_cache or \
                     exe_id in self._exe_sharded
-            if not known:
+                # a later client may opt a known executable into
+                # micro-batching: that needs the Exported re-parsed once
+                want_mb = bool(meta.get("microbatch")) and \
+                    exe_id not in self._exe_microbatch
+            if not known or want_mb:
                 exported = jax.export.deserialize(bytearray(blob))
+                if want_mb and exported.nr_devices == 1:
+                    with self._lock:
+                        self._exe_microbatch.add(exe_id)
+                        self._exe_exported[exe_id] = exported
+                        self._exe_nout[exe_id] = len(exported.out_avals)
+            if not known:
                 if exported.nr_devices > 1:
                     # multi-device export: compile against the local
                     # mesh; the client needs the shard layouts, so this
@@ -875,149 +1334,6 @@ class RemoteVTPUWorker:
                 # client never reads the ack, so skip the frame
                 return
             reply("FREE_OK", {"freed": freed}, [])
-        elif kind == "EXECUTE":
-            exe_id = meta["exe_id"]
-            with self._lock:
-                exported = self._exe_cache.get(exe_id)
-                mlir_exe = self._mlir_exes.get(exe_id)
-                sharded = self._exe_sharded.get(exe_id)
-                mflops = self._exe_costs.get(exe_id, 1)
-            if exported is None and mlir_exe is None and sharded is None:
-                reply("ERROR", {"error": f"unknown executable {exe_id}",
-                                "code": "needs_compile"}, [])
-                return
-            if self.meter_client is not None:
-                self.meter_client.charge_launch(mflops)
-            # arg_refs: per-argument, a buf_id string for resident buffers
-            # or null meaning "next inline wire buffer".  v3 adds
-            # arg_shards: per-argument, null (plain v2 semantics) or a
-            # list of per-device shard entries in the executable's
-            # layout order — each a resident buf_id or null meaning
-            # "next inline wire buffer" (small shards ride the EXECUTE
-            # frame itself; big ones were PUT ahead, pipelined).
-            arg_refs = meta.get("arg_refs")
-            arg_shards = meta.get("arg_shards") \
-                if meta.get("_wire_version", 2) >= 3 else None
-            it = iter(buffers)
-            try:
-                if sharded is not None:
-                    args = self._gather_sharded_args(
-                        sharded, arg_refs, arg_shards, it)
-                elif arg_refs is None:
-                    args = [np.asarray(b) for b in buffers]
-                else:
-                    args = []
-                    with self._lock:
-                        for ref in arg_refs:
-                            if ref is None:
-                                args.append(np.asarray(next(it)))
-                            else:
-                                arr = self._buffers.get(ref)
-                                if arr is None:
-                                    raise KeyError(
-                                        f"unknown buffer {ref}")
-                                args.append(arr)
-                    # async v3 PUTs park Futures in the table; resolve
-                    # outside the lock (the pool thread needs nothing
-                    # from us, but other connections need the lock)
-                    args = [self._resolve(a) for a in args]
-            except KeyError as e:
-                reply("ERROR", {"error": str(e.args[0])}, [])
-                return
-            if sharded is not None:
-                leaves = sharded["fn"](*args)
-            elif mlir_exe is not None:
-                # PJRT path: flat positional buffers in, flat buffers
-                # out.  Resident buffers PUT to another mesh device are
-                # moved to the executable's device (the transparent
-                # plugin compiles on device 0 in v1).
-                dev = jax.devices()[0]
-
-                def _on_exe_device(a):
-                    devs = getattr(a, "devices", None)
-                    if devs is None:
-                        return dev.client.buffer_from_pyval(
-                            np.ascontiguousarray(a), dev)
-                    if devs() != {dev}:
-                        return jax.device_put(a, dev)
-                    return a
-
-                leaves = mlir_exe.execute([_on_exe_device(a)
-                                           for a in args])
-            else:
-                out = exported(*args)
-                leaves = jax.tree_util.tree_leaves(out)
-            self.executions += 1
-            if meta.get("keep_results"):
-                # park results device-side, hand back references.  A
-                # client may pre-assign result ids ("c-..." namespace, the
-                # transparent plugin's pipelining: it mints buffer handles
-                # WITHOUT waiting for this reply, because requests on one
-                # connection execute in order) — ids it chose can be
-                # referenced by its very next EXECUTE already.
-                want_ids = meta.get("result_ids")
-                if want_ids is not None:
-                    if len(want_ids) != len(leaves):
-                        reply("ERROR", {"error": f"result_ids count "
-                                                 f"{len(want_ids)} != "
-                                                 f"{len(leaves)} results"},
-                              [])
-                        return
-                    ns = meta.get("_conn_ns", "")
-                    if not all(str(i).startswith(ns) for i in want_ids):
-                        # only ids the connection-namespace remap produced
-                        # are accepted — a raw id could clobber another
-                        # client's (or worker-minted) buffer
-                        reply("ERROR", {"error": "result_ids must be "
-                                                 "c-namespace ids"}, [])
-                        return
-                with self._lock:
-                    total = sum(self._leaf_nbytes(l) for l in leaves)
-                    err = self._admit_resident(total)
-                    if err:
-                        reply("ERROR", {"error": err}, [])
-                        return
-                    ids, shapes, dtypes = [], [], []
-                    for j, leaf in enumerate(leaves):
-                        if want_ids is not None:
-                            buf_id = str(want_ids[j])
-                        else:
-                            self._buf_seq += 1
-                            buf_id = f"buf-{self._buf_seq}"
-                        self._buffers[buf_id] = leaf
-                        devs = getattr(leaf, "devices", None)
-                        devs = devs() if callable(devs) else devs
-                        if devs is not None and len(devs) == 1:
-                            self._buf_device[buf_id] = \
-                                int(next(iter(devs)).id)
-                        ids.append(buf_id)
-                        shapes.append(list(leaf.shape))
-                        dtypes.append(str(leaf.dtype))
-                if meta.get("quiet"):
-                    # pipelined client: it minted the ids itself and
-                    # discards success replies unread — skip the frame
-                    # entirely (errors above still reply)
-                    return
-                reply("EXECUTE_OK", {"result_refs": ids, "shapes": shapes,
-                                     "dtypes": dtypes}, [])
-            else:
-                # defer materialization: jax dispatch is async, so the
-                # handler loop launches the next pipelined EXECUTE before
-                # this flush blocks in np.asarray (GIL released) — see
-                # the deferred-reply comment in Handler.handle
-                def flush(_leaves=leaves, _reply=reply):
-                    try:
-                        results = [np.asarray(leaf) for leaf in _leaves]
-                        _reply("EXECUTE_OK",
-                               {"n_results": len(results)}, results,
-                               compress=self.compress)
-                    except (ConnectionError, OSError):
-                        raise
-                    except Exception as e:  # noqa: BLE001 - exec error
-                        log.exception("deferred EXECUTE flush failed")
-                        _reply("ERROR", {"error": str(e)}, [])
-
-                return flush
         elif kind == "FETCH":
             with self._lock:
                 arr = self._buffers.get(meta["buf_id"])
@@ -1053,10 +1369,10 @@ class RemoteVTPUWorker:
                 reply("FETCH_OK",
                       {"device_id": int(picked.device.id),
                        "n_shards": len(shards)},
-                      [np.asarray(picked.data)], compress=self.compress)
+                      [np.asarray(picked.data)], compress=True)
                 return
             reply("FETCH_OK", {}, [np.asarray(arr)],
-                  compress=self.compress)
+                  compress=True)
         elif kind == "SNAPSHOT":
             stats = self.snapshot_to(meta["state_dir"])
             reply("SNAPSHOT_OK", stats, [])

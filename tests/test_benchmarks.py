@@ -13,6 +13,36 @@ import pytest
 from conftest import REPO_ROOT
 
 
+def test_bench_probe_records_timing_and_deadline():
+    """bench.py's TPU-tunnel probe: per-probe timing/verdict records
+    for the fallback trail, TPF_BENCH_PROBE_DEADLINE_S honored, and a
+    hard connection refusal classified for fail-fast (no 3 x 90s burn
+    when the relay is simply down)."""
+    sys.path.insert(0, str(REPO_ROOT))
+    import driver_guard
+
+    # a live CPU probe: alive, timed, not a refusal
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    old = dict(os.environ)
+    os.environ.clear()
+    os.environ.update(env)
+    try:
+        probe = driver_guard.probe_backend(timeout=120)
+    finally:
+        os.environ.clear()
+        os.environ.update(old)
+    assert probe["alive"] and probe["duration_s"] > 0
+    assert not probe["hard_refusal"]
+
+    # refusal classification is marker-driven on the child output
+    assert any(m in "ConnectionRefusedError: [Errno 111]"
+               for m in driver_guard._HARD_REFUSAL_MARKERS)
+    # deadline env knob parses (module default already resolved it)
+    assert driver_guard.PROBE_TIMEOUT > 0
+
+
 def test_multitenant_oversubscription_fast(native_build):
     """4 tenants at 160% oversubscription on one chip: >=90% aggregate
     duty in both phases and QoS-proportional redistribution when two

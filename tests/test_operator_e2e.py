@@ -325,6 +325,48 @@ def test_e2e_expander_scales_from_capacity_miss(op):
     assert bound.spec.node_name != "host-0-node"
 
 
+def test_pool_rollup_never_clobbers_concurrent_spec_update():
+    """Root cause of the expander e2e flake: PoolController's status
+    rollup wrote back the pool object it had listed *before* the test's
+    spec update landed, silently reverting the HBM-expansion enable
+    (last-writer-wins read-modify-write).  The rollup must write status
+    onto a fresh, version-checked read so a racing spec edit survives.
+    This reproduces the race deterministically by injecting the spec
+    update between the rollup's list and its write-back."""
+    from tensorfusion_tpu.allocator import TPUAllocator
+    from tensorfusion_tpu.controllers.core import PoolController
+    from tensorfusion_tpu.store import ObjectStore
+
+    store = ObjectStore()
+    pool = TPUPool.new("pool-a")
+    pool.spec.name = "pool-a"
+    store.create(pool)
+    ctrl = PoolController(store, TPUAllocator())
+
+    real_list = store.list
+    raced = {}
+
+    def racy_list(cls, *a, **k):
+        out = real_list(cls, *a, **k)
+        if cls is TPUPool and not raced:
+            raced["done"] = True
+            # a user enables expansion while the rollup is mid-flight
+            p = store.get(TPUPool, "pool-a")
+            p.spec.capacity_config.hbm_expand_to_host_mem_percent = 50
+            store.update(p)
+        return out
+
+    store.list = racy_list
+    ctrl.reconcile(None)
+    got = store.get(TPUPool, "pool-a")
+    assert got.spec.capacity_config.hbm_expand_to_host_mem_percent == 50, \
+        "status rollup clobbered the concurrent spec update"
+    # the next reconcile (driven by the spec edit's MODIFIED event)
+    # applies the surviving spec to the allocator
+    ctrl.reconcile(None)
+    assert ctrl.allocator._pool_hbm_expand.get("pool-a", 1.0) > 1.0
+
+
 def test_rebalancer_enabled_flag_warns_loudly(op, caplog):
     """`rebalancer_enabled` has no consuming controller yet: setting it
     must log a one-time warning instead of silently no-opping (silent

@@ -1,0 +1,585 @@
+"""QoS-aware concurrent dispatch for the remote-vTPU worker.
+
+Covers the central device dispatch scheduler (remoting/dispatch.py +
+worker integration): weighted-fair sharing, per-connection seq ordering
+across the shared queue, cross-connection micro-batching, adaptive
+backpressure (BUSY / DEADLINE_EXCEEDED), mixed-version concurrent load
+(v2+v3+v4 clients on one v4 worker), and the dispatch-metrics flow into
+the operator TSDB.
+"""
+
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorfusion_tpu import constants
+from tensorfusion_tpu.remoting import (RemoteBusyError,
+                                       RemoteDeadlineError, RemoteDevice,
+                                       RemoteVTPUWorker)
+from tensorfusion_tpu.remoting import protocol
+from tensorfusion_tpu.remoting.dispatch import (BusyError,
+                                                DeviceDispatcher,
+                                                WorkItem, qos_weight)
+
+
+def _item(cost=1.0, exe="e", batch_key=None, deadline_t=None, reply=None):
+    return WorkItem("EXECUTE", {}, [], reply or (lambda *a, **k: None),
+                    cost, exe, batch_key, deadline_t)
+
+
+# -- scheduler unit tests (no sockets, no jax: deterministic) ------------
+
+
+def test_wfq_serves_in_weight_proportion():
+    """With two fully backlogged tenants at weights 4:1 and equal
+    per-item cost, start-time fair queueing serves them 4:1 — checked
+    deterministically on the virtual-time order, not wall time."""
+    served = []
+
+    def executor(items, peek):
+        served.extend(i.tenant.conn_id for i in items)
+        return None
+
+    disp = DeviceDispatcher(executor)
+    a = disp.register_tenant("A", qos=constants.QOS_HIGH)      # weight 4
+    b = disp.register_tenant("B", qos=constants.QOS_LOW)       # weight 1
+    # full backlog BEFORE the dispatcher starts: the served order is
+    # then exactly the finish-tag order
+    for _ in range(50):
+        disp.submit(a, _item(), block=True)
+        disp.submit(b, _item(), block=True)
+    disp.start()
+    deadline = time.monotonic() + 20
+    while len(served) < 100 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    disp.stop()
+    assert len(served) == 100
+    head = served[:40]
+    n_a = head.count("A")
+    # exact SFQ prediction is 32 of the first 40; allow tie-break slack
+    assert 30 <= n_a <= 34, f"high-QoS share off: {n_a}/40"
+    # per-tenant FIFO survives: each tenant's items appear in order
+    # (items are indistinguishable here, so assert on counts per prefix:
+    # monotone non-decreasing by construction of a deque pop)
+
+
+def test_fifo_mode_ignores_weights():
+    served = []
+    disp = DeviceDispatcher(lambda items, peek: served.extend(
+        i.tenant.conn_id for i in items), mode="fifo")
+    a = disp.register_tenant("A", qos=constants.QOS_CRITICAL)
+    b = disp.register_tenant("B", qos=constants.QOS_LOW)
+    for _ in range(20):
+        disp.submit(a, _item(), block=True)
+        disp.submit(b, _item(), block=True)
+    disp.start()
+    deadline = time.monotonic() + 20
+    while len(served) < 40 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    disp.stop()
+    # strict arrival interleave: A,B,A,B,...
+    assert served == ["A", "B"] * 20
+
+
+def test_microbatch_collects_across_tenants_in_fifo_order():
+    batches = []
+
+    def executor(items, peek):
+        batches.append([i.exe_id for i in items])
+        return None
+
+    disp = DeviceDispatcher(executor, max_microbatch=4)
+    a = disp.register_tenant("A")
+    b = disp.register_tenant("B")
+    # same batch key on both queues' heads, a non-batchable tail
+    for t in (a, b):
+        disp.submit(t, _item(exe="m", batch_key="m"), block=True)
+        disp.submit(t, _item(exe="m", batch_key="m"), block=True)
+        disp.submit(t, _item(exe="solo"), block=True)
+    disp.start()
+    deadline = time.monotonic() + 20
+    while sum(len(b_) for b_ in batches) < 6 and \
+            time.monotonic() < deadline:
+        time.sleep(0.01)
+    disp.stop()
+    fused = [b_ for b_ in batches if len(b_) > 1]
+    assert fused and all(set(b_) == {"m"} for b_ in fused)
+    assert max(len(b_) for b_ in fused) <= 4
+    # the solo items never fused
+    assert all(b_ == ["solo"] for b_ in batches if "solo" in b_)
+
+
+def test_busy_bounds_and_blocking_submit():
+    started = threading.Event()
+    release = threading.Event()
+
+    def executor(items, peek):
+        started.set()
+        release.wait(10)
+        return None
+
+    disp = DeviceDispatcher(executor, max_queue_per_tenant=4,
+                            max_queue_global=100)
+    t = disp.register_tenant("A")
+    disp.start()
+    disp.submit(t, _item(), block=False)
+    assert started.wait(10)      # first item is in the executor...
+    for _ in range(4):           # ...and the queue holds exactly 4 more
+        disp.submit(t, _item(), block=False)
+    with pytest.raises(BusyError) as ei:
+        disp.submit(t, _item(), block=False)
+    assert ei.value.retry_after_ms >= 1
+    assert disp.busy_rejected == 1
+    # a blocking submit parks until the executor drains
+    done = []
+
+    def blocked():
+        disp.submit(t, _item(), block=True)
+        done.append(1)
+
+    th = threading.Thread(target=blocked, daemon=True)
+    th.start()
+    time.sleep(0.2)
+    assert not done
+    release.set()
+    th.join(timeout=10)
+    assert done
+    disp.stop()
+
+
+def test_deadline_expires_in_queue():
+    replies = []
+    release = threading.Event()
+
+    def executor(items, peek):
+        release.wait(10)
+        return None
+
+    disp = DeviceDispatcher(executor)
+    t = disp.register_tenant("A")
+    disp.start()
+    disp.submit(t, _item(), block=True)          # occupies the executor
+    time.sleep(0.05)
+
+    def reply(kind, meta, bufs):
+        replies.append((kind, meta))
+
+    dead = _item(deadline_t=time.monotonic() + 0.05, reply=reply)
+    disp.submit(t, dead, block=True)
+    time.sleep(0.3)                              # deadline passes queued
+    release.set()
+    deadline = time.monotonic() + 10
+    while not replies and time.monotonic() < deadline:
+        time.sleep(0.01)
+    disp.stop()
+    assert replies and replies[0][0] == "ERROR"
+    assert replies[0][1]["code"] == "DEADLINE_EXCEEDED"
+    assert disp.deadline_exceeded == 1
+
+
+def test_barrier_waits_for_tenant_completion():
+    started = threading.Event()
+    release = threading.Event()
+
+    def executor(items, peek):
+        started.set()
+        release.wait(10)
+        return None
+
+    disp = DeviceDispatcher(executor)
+    t = disp.register_tenant("A")
+    disp.start()
+    disp.submit(t, _item(), block=True)
+    started.wait(5)
+    state = {}
+
+    def barrier():
+        disp.barrier(t)
+        state["done"] = True
+
+    th = threading.Thread(target=barrier, daemon=True)
+    th.start()
+    time.sleep(0.2)
+    assert "done" not in state     # item still inflight
+    release.set()
+    th.join(timeout=10)
+    assert state.get("done")
+    disp.stop()
+
+
+def test_qos_weight_ladder_matches_constants():
+    for qos, w in constants.QOS_DISPATCH_WEIGHTS.items():
+        assert qos_weight(qos) == w
+    assert qos_weight(None) == \
+        constants.QOS_DISPATCH_WEIGHTS[constants.DEFAULT_QOS]
+    assert qos_weight("nonsense") == \
+        constants.QOS_DISPATCH_WEIGHTS[constants.DEFAULT_QOS]
+
+
+# -- worker integration ---------------------------------------------------
+
+
+@pytest.fixture()
+def worker():
+    w = RemoteVTPUWorker()
+    w.start()
+    yield w
+    w.stop()
+
+
+def test_hello_negotiates_qos_weight(worker):
+    dev = RemoteDevice(worker.url, qos=constants.QOS_CRITICAL)
+    info = dev.info()
+    assert dev._wire_version == 4
+    assert dev.qos_weight == constants.QOS_DISPATCH_WEIGHTS["critical"]
+    assert info["dispatch"]["mode"] == "wfq"
+    # the connection shows up as a tenant with its class
+    assert any(t["qos"] == "critical"
+               for t in info["dispatch"]["tenants"].values())
+    dev.close()
+
+
+def test_microbatch_fuses_same_executable_burst(worker):
+    """Two tenants bursting the SAME opted-in executable: the worker
+    fuses compatible requests into single launches (launch count <
+    request count), with per-request results intact.  A heavy "plug"
+    request occupies the dispatcher first so the burst demonstrably
+    queues up behind it — fusion needs a backlog, and without the plug
+    a fast worker could drain the burst one by one."""
+    devs = [RemoteDevice(worker.url, qos=q) for q in ("high", "low")]
+    remotes = [d.remote_jit(lambda w, x: jnp.tanh(x @ w),
+                            microbatch=True) for d in devs]
+    plug_fn = devs[0].remote_jit(lambda a: (a @ a) @ a)
+    plug_arg = np.ones((768, 768), np.float32) * 1e-3
+    rng = np.random.default_rng(0)
+    W = rng.standard_normal((256, 256)).astype(np.float32)
+    xs = [rng.standard_normal((32, 256)).astype(np.float32)
+          for _ in range(8)]
+    for r in remotes:
+        r(W, xs[0])               # compile once (same content hash)
+    plug_fn(plug_arg)             # compile the plug too
+    for attempt in range(5):      # scheduling is load-dependent; the
+        # plug makes fusion overwhelmingly likely per attempt
+        base = devs[0].info()["dispatch"]
+        plug = plug_fn.submit(plug_arg)
+        futs = [(r.submit(W, x), x) for x in xs for r in remotes]
+        for fut, x in futs:
+            np.testing.assert_allclose(
+                np.asarray(fut.result(timeout=60)), np.tanh(x @ W),
+                rtol=1e-4, atol=1e-4)
+        plug.result(timeout=60)
+        d = devs[0].info()["dispatch"]
+        executed = d["executed"] - base["executed"]
+        launches = d["launches"] - base["launches"]
+        assert executed == len(futs) + 1
+        if launches < executed:
+            break
+    assert launches < executed, (launches, executed)
+    assert d["microbatched_requests"] > 0
+    for dev in devs:
+        dev.close()
+
+
+def test_busy_backpressure_surfaces_and_sync_path_retries():
+    w = RemoteVTPUWorker(max_queue_per_tenant=2, max_queue_global=4)
+    w.start()
+    try:
+        dev = RemoteDevice(w.url)
+        remote = dev.remote_jit(lambda x: x @ x)
+        x = np.ones((128, 128), np.float32)
+        remote(x)                 # compile
+        futs = [remote.submit(x) for _ in range(32)]
+        busy = ok = 0
+        for f in futs:
+            try:
+                f.result(timeout=60)
+                ok += 1
+            except RemoteBusyError as e:
+                assert e.retry_after_ms >= 1
+                busy += 1
+        assert busy > 0 and ok > 0
+        # the synchronous wrapper retries BUSY internally: hammer it
+        # from threads against the tiny queue — every call completes
+        results = []
+
+        def pound():
+            results.append(np.asarray(remote(x)).sum())
+
+        threads = [threading.Thread(target=pound) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert len(results) == 6
+        assert dev.info()["dispatch"]["busy_rejected"] >= busy
+        dev.close()
+    finally:
+        w.stop()
+
+
+def test_deadline_ms_rejected_when_exceeded(worker):
+    dev = RemoteDevice(worker.url)
+    remote = dev.remote_jit(lambda x: x * 2.0)
+    x = np.ones((64, 64), np.float32)
+    remote(x)                     # compile
+    # clog the queue so the deadline item genuinely waits behind work
+    futs = [remote.submit(x) for _ in range(16)]
+    with pytest.raises(RemoteDeadlineError):
+        # deadline 0: expired by the time the dispatcher reaches it
+        remote(x, deadline_ms=0)
+    for f in futs:
+        f.result(timeout=60)
+    assert dev.info()["dispatch"]["deadline_exceeded"] >= 1
+    dev.close()
+
+
+def test_mixed_version_concurrent_load(worker):
+    """Satellite: v2, v3 and v4 clients pipelining EXECUTEs against one
+    v4 worker *simultaneously*.  Per-connection seq ordering must
+    survive the shared dispatch queue, results must never leak across
+    connections (each client's chained/burst values check out), and
+    client-minted ids stay connection-namespaced."""
+    errors = []
+    rounds = 24
+
+    def v2_raw_client():
+        # a pinned v2 build: raw socket, pipelined seqs, replies must
+        # come back in seq order (per-connection FIFO execution) —
+        # RemoteDevice would mask reordering by matching on seq, so
+        # this client reads the wire directly
+        import socket as _socket
+        try:
+            s = _socket.create_connection(("127.0.0.1", worker.port),
+                                          timeout=30)
+            s.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+            protocol.send_message(s, "HELLO", {"seq": 0}, [],
+                                  version=2)
+            kind, meta, _ = protocol.recv_message(s)
+            assert kind == "HELLO_OK"
+            # compile on this connection to learn the exe_id
+            import jax
+            import jax.export
+            exported = jax.export.export(jax.jit(lambda a: a * 3.0))(
+                jax.ShapeDtypeStruct((4,), np.float32))
+            blob = exported.serialize()
+            protocol.send_message(
+                s, "COMPILE", {"seq": 1},
+                [np.frombuffer(blob, dtype=np.uint8)], version=2)
+            kind, meta, _ = protocol.recv_message(s)
+            assert kind == "COMPILE_OK", meta
+            exe_id = meta["exe_id"]
+            for i in range(rounds):
+                protocol.send_message(
+                    s, "EXECUTE", {"seq": 10 + i, "exe_id": exe_id},
+                    [np.full(4, float(i), np.float32)], version=2)
+            seqs = []
+            for i in range(rounds):
+                kind, meta, bufs = protocol.recv_message(s)
+                assert kind == "EXECUTE_OK", meta
+                seqs.append(meta["seq"])
+                np.testing.assert_allclose(
+                    bufs[0], np.full(4, 3.0 * (meta["seq"] - 10)))
+            assert seqs == sorted(seqs), f"v2 replies reordered: {seqs}"
+            s.close()
+        except Exception as e:  # noqa: BLE001
+            errors.append(("v2", e))
+
+    def v3_client():
+        # old v3 build: resident chaining via step_resident (each step
+        # consumes the previous step's client-minted result ids — any
+        # cross-connection id leak or reorder corrupts the value)
+        try:
+            dev = RemoteDevice(worker.url, protocol_version=3)
+            remote = dev.remote_jit(lambda x: x + 1.0)
+            state = remote.step_resident(np.zeros(8, np.float32))
+            for _ in range(rounds - 1):
+                prev = state
+                state = remote.step_resident(state, free=(prev,))
+            np.testing.assert_allclose(state.fetch(),
+                                       np.full(8, float(rounds)))
+            assert dev._wire_version == 3
+            dev.close()
+        except Exception as e:  # noqa: BLE001
+            errors.append(("v3", e))
+
+    def v4_client(qos):
+        try:
+            dev = RemoteDevice(worker.url, qos=qos)
+            remote = dev.remote_jit(lambda x: x * 2.0 + 1.0)
+            remote(np.zeros(6, np.float32))
+            futs = [remote.submit(np.full(6, float(i), np.float32))
+                    for i in range(rounds)]
+            for i, f in enumerate(futs):
+                np.testing.assert_allclose(
+                    np.asarray(f.result(timeout=60)),
+                    np.full(6, 2.0 * i + 1.0))
+            assert dev._wire_version == 4
+            dev.close()
+        except Exception as e:  # noqa: BLE001
+            errors.append(("v4", e))
+
+    threads = [threading.Thread(target=v2_raw_client),
+               threading.Thread(target=v3_client),
+               threading.Thread(target=v4_client, args=("high",)),
+               threading.Thread(target=v4_client, args=("low",))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not any(t.is_alive() for t in threads), "client hung"
+    assert not errors, errors
+
+
+def test_dispatch_metrics_reach_operator_tsdb(worker):
+    """Queue-wait/service histograms flow worker -> recorder -> TSDB
+    (the single-process topology; multi-host rides the hypervisor
+    recorder's push path which emits the same lines)."""
+    from tensorfusion_tpu.metrics.recorder import MetricsRecorder
+    from tensorfusion_tpu.operator import Operator
+
+    dev = RemoteDevice(worker.url, qos="high")
+    remote = dev.remote_jit(lambda x: x * 2.0)
+    for i in range(4):
+        remote(np.full(8, float(i), np.float32))
+    op = Operator()
+    rec = MetricsRecorder(op, remote_workers=[worker])
+    rec.record_once()
+    got = rec.tsdb.query("tpf_remote_dispatch", "executed_total")
+    assert got and got[-1][1][-1].value >= 4
+    waits = rec.tsdb.query("tpf_remote_dispatch", "queue_wait_p99_ms")
+    assert waits, "queue-wait histogram missing from TSDB"
+    qos = rec.tsdb.query("tpf_remote_qos", "served_total",
+                         tags={"qos": "high"})
+    assert qos and qos[-1][1][-1].value >= 4
+    dev.close()
+
+
+def test_hypervisor_recorder_ships_dispatch_lines(worker, tmp_path):
+    """The node-agent path: HypervisorMetricsRecorder emits
+    tpf_remote_dispatch lines for co-hosted remote workers through the
+    same push callable the store gateway consumes."""
+    from tensorfusion_tpu.hypervisor.metrics import (
+        HypervisorMetricsRecorder, remote_dispatch_lines)
+
+    dev = RemoteDevice(worker.url)
+    remote = dev.remote_jit(lambda x: x + 1.0)
+    remote(np.zeros(4, np.float32))
+    dev.close()
+
+    lines = remote_dispatch_lines(worker, "node-x", 0)
+    assert any(line.startswith("tpf_remote_dispatch") for line in lines)
+
+    class _Devices:
+        def refresh_metrics(self):
+            pass
+
+        def devices(self):
+            return []
+
+        def get(self, _):
+            return None
+
+    class _Workers:
+        def list(self):
+            return []
+
+    pushed = []
+    rec = HypervisorMetricsRecorder(
+        _Devices(), _Workers(), node_name="node-x",
+        push=lambda batch: pushed.extend(batch),
+        remote_workers=[worker])
+    rec.record_once()
+    assert any(line.startswith("tpf_remote_dispatch") for line in pushed)
+    assert any(line.startswith("tpf_remote_qos") for line in pushed)
+
+
+def test_adaptive_compression_reports_realized_ratio():
+    """Wire compression decides per frame: compressible payloads ship
+    deflated, incompressible dense noise ships raw — both visible in
+    INFO's realized ratio.  (compress=True forces the adaptive path on
+    this loopback connection; the auto default skips loopback peers
+    entirely because zlib CPU outweighs same-host bytes.)"""
+    w = RemoteVTPUWorker(compress=True)
+    w.start()
+    dev = RemoteDevice(w.url)
+    # compressible: big zero block (>= COMPRESS_MIN_BYTES)
+    ref = dev.put(np.zeros(1 << 16, np.float32))
+    np.testing.assert_allclose(ref.fetch(), 0.0)      # worker->client
+    info = dev.info()
+    wc = info["wire_compression"]
+    assert wc.get("buffers_zlib", 0) >= 1, wc
+    assert wc["realized_ratio"] < 1.0
+    # incompressible: dense random floats keep raw on the wire
+    before_raw = wc.get("buffers_raw", 0)
+    noise = np.random.default_rng(0).standard_normal(1 << 16) \
+        .astype(np.float32)
+    ref2 = dev.put(noise)
+    np.testing.assert_allclose(ref2.fetch(), noise)
+    wc2 = dev.info()["wire_compression"]
+    assert wc2.get("buffers_raw", 0) > before_raw
+    ref.free()
+    ref2.free()
+    dev.close()
+    w.stop()
+
+    # the auto default keeps loopback replies raw end to end
+    w2 = RemoteVTPUWorker()
+    w2.start()
+    try:
+        dev2 = RemoteDevice(w2.url)
+        ref3 = dev2.put(np.zeros(1 << 16, np.float32))
+        np.testing.assert_allclose(ref3.fetch(), 0.0)
+        assert dev2.info()["wire_compression"].get("buffers_zlib",
+                                                   0) == 0
+        dev2.close()
+    finally:
+        w2.stop()
+
+
+def test_dispatch_stress_mixed_ops(worker):
+    """Stress cell for make verify-stress: concurrent tenants mixing
+    EXECUTE bursts, resident PUT/FETCH/FREE and INFO against one
+    worker; every operation must stay correct and the worker must end
+    drained (no leaked queue depth, no stuck inflight)."""
+    errors = []
+
+    def tenant(qos, seed):
+        try:
+            dev = RemoteDevice(worker.url, qos=qos)
+            remote = dev.remote_jit(lambda w, x: jnp.tanh(x @ w),
+                                    microbatch=True)
+            rng = np.random.default_rng(seed)
+            W = rng.standard_normal((64, 64)).astype(np.float32)
+            w_ref = dev.put(W)
+            x = rng.standard_normal((8, 64)).astype(np.float32)
+            want = np.tanh(x @ W)
+            remote(w_ref, x)
+            for round_ in range(6):
+                futs = [remote.submit(w_ref, x) for _ in range(8)]
+                np.testing.assert_allclose(w_ref.fetch(), W, rtol=1e-6)
+                for f in futs:
+                    np.testing.assert_allclose(
+                        np.asarray(f.result(timeout=60)), want,
+                        rtol=1e-4, atol=1e-4)
+            w_ref.free()
+            dev.close()
+        except Exception as e:  # noqa: BLE001
+            errors.append((qos, seed, e))
+
+    threads = [threading.Thread(target=tenant, args=(q, i))
+               for i, q in enumerate(("critical", "high", "medium",
+                                      "low"))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    assert not any(t.is_alive() for t in threads), "tenant hung"
+    assert not errors, errors
+    # drained: no queued depth, no phantom inflight tenants
+    deadline = time.monotonic() + 10
+    while worker.dispatcher.depth() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert worker.dispatcher.depth() == 0
