@@ -8,7 +8,7 @@
 PY := env -u PALLAS_AXON_POOL_IPS python
 
 .PHONY: all native test test-native verify-all verify-repeat \
-	verify-stress check-coverage asan \
+	verify-stress verify-native-sanitized check-coverage lint asan \
 	tsan bench bench-tpu test-tpu-live sched-bench webhook-bench remoting-bench \
 	multitenant-bench multitenant-bench-tpu serving-bench-tpu \
 	refresh-tpu-artifacts dryrun clean
@@ -21,13 +21,22 @@ native:
 test: native
 	$(PY) -m pytest tests/ -x -q
 
-# Everything CI cares about, one entry point: native selftests +
-# conformance (mock AND real provider over the fake PJRT plugin) plus
-# the python suite under the coverage gate (check-coverage already runs
-# the full suite — listing `test` too would run it twice, concurrently
-# under -j, colliding on TCP ports).
-verify-all: test-native check-coverage
+# Everything CI cares about, one entry point: the project-invariant
+# static analysis gate (cheapest, runs first — a lost-update race or a
+# half-landed protocol opcode fails in seconds without running a test),
+# native selftests + conformance (mock AND real provider over the fake
+# PJRT plugin) plus the python suite under the coverage gate
+# (check-coverage already runs the full suite — listing `test` too
+# would run it twice, concurrently under -j, colliding on TCP ports).
+verify-all: lint test-native check-coverage
 	@echo "verify-all: OK"
+
+# Project-invariant static analysis (docs/static-analysis.md): the
+# stale-write-back / blocking-under-lock / guarded-field / protocol-
+# exhaustive / metrics-schema checkers, ratcheted by
+# tools/tpflint/baseline.json (currently EMPTY — keep it that way).
+lint:
+	$(PY) -m tools.tpflint tensorfusion_tpu
 
 # Deflake gate: the tier-1 python suite 5x sequentially.  Timing-
 # dependent tests must survive a loaded box repeatedly, not just one
@@ -76,6 +85,15 @@ asan:
 
 tsan:
 	$(MAKE) -C native tsan
+
+# Sanitizer gate for the native layer: the full selftest battery under
+# ASAN, then TSAN.  Not part of verify-all (the sanitizer rebuild+run
+# costs minutes) — REQUIRED on any change under native/
+# (docs/test-matrix.md "verification entry points").
+verify-native-sanitized:
+	$(MAKE) -C native asan
+	$(MAKE) -C native tsan
+	@echo "verify-native-sanitized: OK (asan + tsan clean)"
 
 # Headline benchmark (vTPU overhead). `bench` runs CPU-only (tunnel
 # bypassed); `bench-tpu` keeps the ambient env to run on the real chip.

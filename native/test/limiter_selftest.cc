@@ -48,7 +48,7 @@ int main() {
   CHECK(r.wait_hint_us >= 100 && r.wait_hint_us <= 1000000);
 
   // After waiting ~wait_hint the refill must admit the program.
-  usleep(r.wait_hint_us + 20000);
+  usleep(static_cast<useconds_t>(r.wait_hint_us + 20000));
   CHECK(tfl_charge_compute(0, 400, &r) == TPF_OK && r.allowed);
 
   // HBM budget: 1 MiB limit.

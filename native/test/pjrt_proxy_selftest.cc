@@ -48,7 +48,8 @@ typedef struct {
 static double now_s() {
   struct timespec ts;
   clock_gettime(CLOCK_MONOTONIC, &ts);
-  return ts.tv_sec + ts.tv_nsec / 1e9;
+  return static_cast<double>(ts.tv_sec)
+      + static_cast<double>(ts.tv_nsec) / 1e9;
 }
 
 int main(int argc, char** argv) {

@@ -35,7 +35,7 @@ from typing import Callable, Dict, Iterable, List, Optional, Tuple
 from .. import constants
 from ..api.resources import AdjustRequest, AllocRequest, ResourceAmount
 from ..api.types import Pod, TPUChip
-from ..store import NotFoundError, ObjectStore
+from ..store import ConflictError, NotFoundError, ObjectStore
 from .partition_planner import (PartitionPlanRegistry, Placement,
                                 TemplateSpec)
 from .filters import (Filter, FilterResult, NodeAffinityFilter,
@@ -948,7 +948,16 @@ class TPUAllocator:
                 continue
             obj.status.available = avail
             obj.status.running_apps = holders
-            self.store.update(obj)
+            try:
+                # version-checked: a concurrent chip write (node agent
+                # status, live-migration phase) must not be clobbered by
+                # this availability rollup.  On conflict the chip goes
+                # back on the dirty list; the next sync pass re-reads.
+                self.store.update(obj, check_version=True)
+            except ConflictError:
+                with self._lock:
+                    self._dirty.add(name)
+                continue
             n += 1
         self.quota.sync_to_store()
         return n

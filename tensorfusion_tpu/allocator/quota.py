@@ -18,7 +18,7 @@ from typing import Dict, List, Optional
 
 from ..api.resources import AllocRequest, QuotaAmounts, ResourceAmount
 from ..api.types import TPUResourceQuota
-from ..store import ObjectStore
+from ..store import ConflictError, ObjectStore
 
 
 class QuotaExceededError(Exception):
@@ -256,4 +256,9 @@ class QuotaStore:
             obj.status.used_requests = u.committed_requests
             obj.status.used_limits = u.committed_limits
             obj.status.used_workers = u.committed_workers
-            self.store.update(obj)
+            try:
+                # version-checked status patch: a concurrent quota spec
+                # edit must win; the next periodic sync rewrites usage
+                self.store.update(obj, check_version=True)
+            except ConflictError:
+                continue

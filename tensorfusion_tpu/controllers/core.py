@@ -212,8 +212,16 @@ class NodeController(Controller):
                         if st.hypervisor_ready or members
                         else constants.PHASE_PENDING)
             try:
-                self.store.update(tnode)
-            except NotFoundError:
+                # Status-only write onto a fresh version-checked read —
+                # same lost-update defence as PoolController: writing
+                # back the listed node would clobber concurrent spec /
+                # label updates (hypervisor URL registration races this
+                # rollup).  On conflict, skip: the competing write's
+                # event (or the 10s resync) re-runs the rollup.
+                fresh = self.store.get(TPUNode, tnode.name)
+                fresh.status = st
+                self.store.update(fresh, check_version=True)
+            except (NotFoundError, ConflictError):
                 pass
 
 
@@ -364,8 +372,17 @@ class WorkloadController(Controller):
                 g.phase = "Scheduled" if running >= g.required_members \
                     else "Pending"
             try:
-                self.store.update(wl)
-            except NotFoundError:
+                # Fresh version-checked status patch: the workload held
+                # across the pod scale-up/down above is stale by the time
+                # the rollup lands, and a user spec edit (replica change,
+                # autoscaling knobs) meanwhile must not be clobbered.
+                # Conflict -> skip; the spec edit's own event re-runs
+                # this reconcile (and the 5s resync backstops it).
+                fresh = self.store.get(TPUWorkload, wl.metadata.name,
+                                       wl.metadata.namespace)
+                fresh.status = wl.status
+                self.store.update(fresh, check_version=True)
+            except (NotFoundError, ConflictError):
                 pass
         # drop grace bookkeeping for deleted/no-longer-dynamic workloads
         # (a recreated workload must not inherit a stale zero-timestamp)
@@ -435,6 +452,19 @@ class ConnectionController(Controller):
     def __init__(self, store: ObjectStore):
         self.store = store
 
+    def _patch_status(self, conn: TPUConnection) -> None:
+        """Version-checked status write onto a fresh read: this rollup
+        must never clobber a concurrent spec change (e.g. the client
+        retargeting the connection's workload).  Conflict -> skip; the
+        competing write's event or the 2s resync re-runs reconcile."""
+        try:
+            fresh = self.store.get(TPUConnection, conn.metadata.name,
+                                   conn.metadata.namespace)
+            fresh.status = conn.status
+            self.store.update(fresh, check_version=True)
+        except (NotFoundError, ConflictError):
+            pass
+
     def reconcile(self, event):
         for conn in self.store.list(TPUConnection):
             if conn.status.phase == constants.PHASE_RUNNING and \
@@ -457,7 +487,7 @@ class ConnectionController(Controller):
                     == constants.COMPONENT_WORKER
                     and p.status.phase == constants.PHASE_RUNNING))
             if not workers:
-                self.store.update(conn)
+                self._patch_status(conn)
                 continue
             # least-loaded worker: fewest existing connections
             counts: Dict[str, int] = {}
@@ -474,7 +504,7 @@ class ConnectionController(Controller):
             conn.status.worker_name = chosen.metadata.name
             conn.status.worker_url = f"tcp://{host}:{port}"
             conn.status.phase = constants.PHASE_RUNNING
-            self.store.update(conn)
+            self._patch_status(conn)
 
 
 class PodController(Controller):

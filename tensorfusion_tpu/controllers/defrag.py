@@ -31,7 +31,7 @@ from ..api.types import (Node, Pod, TPUChip, TPUNode, TPUNodeClaim,
 from ..autoscaler.recommender import cron_matches
 from ..scheduler.gang import gang_info_from_pod
 from ..scheduler.tpuresources import compose_alloc_request
-from ..store import NotFoundError
+from ..store import ConflictError, NotFoundError, mutate
 from .base import Controller
 
 
@@ -279,14 +279,18 @@ class CompactionController(Controller):
             self._evict_for_defrag(pod, node, now)
             evicted += 1
         if evicted:
-            tnode = self.store.try_get(TPUNode, node)
-            if tnode is not None:
-                tnode.metadata.labels[constants.LABEL_DEFRAG_SOURCE] = "true"
+            def stamp_source(tnode):
+                tnode.metadata.labels[constants.LABEL_DEFRAG_SOURCE] = \
+                    "true"
                 tnode.metadata.annotations[
                     constants.ANN_DEFRAG_SOURCE_SINCE] = now
                 tnode.metadata.annotations[
                     constants.ANN_DEFRAG_SOURCE_POOL] = pool_name
-                self.store.update(tnode)
+
+            try:
+                mutate(self.store, TPUNode, node, stamp_source)
+            except ConflictError:
+                pass    # bookkeeping label; next defrag cycle re-stamps
         return evicted
 
     @staticmethod
@@ -296,13 +300,17 @@ class CompactionController(Controller):
 
     def _mark_skip(self, node: str, reason: str, now: str) -> None:
         """Defrag-evict-skip bookkeeping on the node object."""
-        tnode = self.store.try_get(TPUNode, node)
-        if tnode is None:
-            return
-        tnode.metadata.labels[constants.LABEL_DEFRAG_SKIP] = "true"
-        tnode.metadata.annotations[constants.ANN_DEFRAG_SKIP_REASON] = reason
-        tnode.metadata.annotations[constants.ANN_DEFRAG_SKIP_SINCE] = now
-        self.store.update(tnode)
+        def stamp_skip(tnode):
+            tnode.metadata.labels[constants.LABEL_DEFRAG_SKIP] = "true"
+            tnode.metadata.annotations[constants.ANN_DEFRAG_SKIP_REASON] \
+                = reason
+            tnode.metadata.annotations[constants.ANN_DEFRAG_SKIP_SINCE] \
+                = now
+
+        try:
+            mutate(self.store, TPUNode, node, stamp_skip)
+        except ConflictError:
+            pass        # bookkeeping; the next cycle re-marks
 
     def _drain_gang(self, group_key: str, node: str, now: str) -> int:
         """Atomically drain one gang off `node`: all members cluster-wide
@@ -348,16 +356,23 @@ class CompactionController(Controller):
             # rebind onto the node being drained (cleared after the TTL)
             wl_name = pod.metadata.annotations.get(constants.ANN_WORKLOAD)
             if wl_name:
-                wl = self.store.try_get(TPUWorkload, wl_name,
-                                        pod.metadata.namespace)
-                if wl is not None and node not in wl.spec.excluded_nodes:
+                def exclude_node(wl):
+                    if node in wl.spec.excluded_nodes:
+                        return False    # already stamped: don't rewrite
                     wl.spec.excluded_nodes.append(node)
                     wl.metadata.annotations[
                         constants.ANN_DEFRAG_EVICTED_SINCE] = now
-                    wl.metadata.annotations[constants.ANN_DEFRAG_EXCLUDED] = \
+                    wl.metadata.annotations[
+                        constants.ANN_DEFRAG_EXCLUDED] = \
                         _merge_exclusions(wl.metadata.annotations.get(
                             constants.ANN_DEFRAG_EXCLUDED, ""), node)
-                    self.store.update(wl)
+
+                # retried on conflict, NOT skipped: losing this write
+                # would let the replacement worker rebind onto the node
+                # being drained (ConflictError after repeated losses
+                # propagates — that loud failure beats a silent rebind)
+                mutate(self.store, TPUWorkload, wl_name, exclude_node,
+                       namespace=pod.metadata.namespace)
         else:
             # standalone pod: clone it with the node excluded so the
             # scheduler rebinds elsewhere (workers are recreated by their
@@ -451,22 +466,27 @@ class LiveMigrator:
     # path must restore Migrating -> Running or the status loop reports
     # the chip as migrating forever (control_plane never stomps it)
     def _mark_migrating(self, chip_ids) -> List[str]:
+        def set_migrating(chip):
+            chip.status.phase = constants.PHASE_MIGRATING
+
         marked = []
         for chip_name in chip_ids:
-            chip = self.store.try_get(TPUChip, chip_name)
-            if chip is not None:
-                chip.status.phase = constants.PHASE_MIGRATING
-                self.store.update(chip)
+            # version-checked retry: the chip status rollup (allocator
+            # sync) writes concurrently; losing this race either way
+            # would strand the phase bookkeeping
+            if mutate(self.store, TPUChip, chip_name,
+                      set_migrating) is not None:
                 marked.append(chip_name)
         return marked
 
     def _restore_running(self, chip_names) -> None:
+        def set_running(chip):
+            if chip.status.phase != constants.PHASE_MIGRATING:
+                return False    # someone else already moved it on
+            chip.status.phase = constants.PHASE_RUNNING
+
         for chip_name in chip_names:
-            chip = self.store.try_get(TPUChip, chip_name)
-            if chip is not None and \
-                    chip.status.phase == constants.PHASE_MIGRATING:
-                chip.status.phase = constants.PHASE_RUNNING
-                self.store.update(chip)
+            mutate(self.store, TPUChip, chip_name, set_running)
 
     def _post(self, url: str) -> bool:
         try:

@@ -78,6 +78,7 @@ class AllocationController:
         self.mount_policy = mount_policy or DeviceMountPolicy(
             DeviceMountPolicy.default_rules())
         self._lock = threading.RLock()
+        # guarded by: _lock
         self._allocations: Dict[str, WorkerAllocation] = {}
 
     # -- binding ----------------------------------------------------------

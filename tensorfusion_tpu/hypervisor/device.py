@@ -44,7 +44,9 @@ class DeviceController:
         self.provider = provider
         self.discovery_interval_s = discovery_interval_s
         self._lock = threading.RLock()
+        # guarded by: _lock
         self._devices: Dict[str, DeviceEntry] = {}
+        # guarded by: _lock
         self._topology: Optional[Topology] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None

@@ -36,8 +36,16 @@ class SingleNodeBackend(Backend):
         self.spawn = spawn                  # False = track-only (tests)
         os.makedirs(state_dir, exist_ok=True)
         self._lock = threading.RLock()
+        #: serializes the spawn check-fork-store sequence so concurrent
+        #: submit/reconcile paths cannot double-spawn one worker, while
+        #: _lock (which readers like resolve_process contend on) stays
+        #: free during the fork itself
+        self._spawn_lock = threading.Lock()
+        # guarded by: _lock
         self._procs: Dict[str, subprocess.Popen] = {}
+        # guarded by: _lock
         self._restarts: Dict[str, int] = {}
+        # guarded by: _lock
         self._env: Dict[str, Dict[str, str]] = {}
         self._on_added: Optional[Callable[[WorkerSpec], None]] = None
         self._on_removed: Optional[Callable[[str], None]] = None
@@ -88,7 +96,7 @@ class SingleNodeBackend(Backend):
                       env: Optional[Dict[str, str]] = None) -> None:
         self._persist(spec)
         if env:
-            self._env[spec.key] = env
+            self.set_worker_env(spec.key, env)
         if self._on_added:
             self._on_added(spec)
         self._maybe_spawn(spec)
@@ -149,19 +157,25 @@ class SingleNodeBackend(Backend):
     def _maybe_spawn(self, spec: WorkerSpec) -> None:
         if not self.spawn or not spec.command:
             return
-        with self._lock:
-            existing = self._procs.get(spec.key)
-            if existing is not None and existing.poll() is None:
-                return
-            env = dict(os.environ)
-            env.update(spec.env)
-            env.update(self._env.get(spec.key, {}))
+        with self._spawn_lock:
+            with self._lock:
+                existing = self._procs.get(spec.key)
+                if existing is not None and existing.poll() is None:
+                    return
+                env = dict(os.environ)
+                env.update(spec.env)
+                env.update(self._env.get(spec.key, {}))
             env[constants.ENV_POD_NAMESPACE] = spec.namespace
             env[constants.ENV_POD_NAME] = spec.name
+            # the fork happens under _spawn_lock only: its sole job is
+            # serializing this check-fork-store sequence, and nothing
+            # latency-sensitive ever contends on it
+            # tpflint: disable=blocking-under-lock
             proc = subprocess.Popen(spec.command, env=env,
                                     stdout=subprocess.DEVNULL,
                                     stderr=subprocess.DEVNULL)
-            self._procs[spec.key] = proc
+            with self._lock:
+                self._procs[spec.key] = proc
             log.info("spawned worker %s pid=%d", spec.key, proc.pid)
 
     def _loop(self) -> None:

@@ -59,6 +59,7 @@ class WorkerController:
         self.auto_freeze_rules = {r.qos: r for r in (auto_freeze_rules or [])}
         self.tick_interval_s = tick_interval_s
         self._lock = threading.RLock()
+        # guarded by: _lock
         self._workers: Dict[str, TrackedWorker] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None

@@ -1,0 +1,85 @@
+"""Registry of every influx measurement tpu-fusion emits.
+
+The single source of truth tpflint's `metrics-schema` checker verifies
+emit sites (``encode_line`` / ``tsdb.insert``) and consumers
+(``tsdb.query`` / ``AlertRule``) against — the reference platform keeps
+the equivalent contract implicit between ``metrics.go`` and its Grafana
+dashboards, which is exactly how series drift silently.  Adding a
+measurement, tag or field anywhere without declaring it here (and
+documenting it in docs/metrics-schema.md) fails ``make lint``.
+
+Conventions:
+
+- ``tags``:     required on every emitted line of the measurement.
+- ``opt_tags``: legitimately conditional (e.g. ``generation`` rides
+  ``tpf_worker`` only when the worker has a bound device).
+- ``fields``:   the full field set; emit sites may write a subset when
+  the source data is conditional, but never an undeclared key.
+
+This module is data, importable by dashboards/tests; keep it literal —
+the checker reads it via ``ast``, not import, so computed entries would
+be invisible to the gate.
+"""
+
+METRICS_SCHEMA = {
+    # node-agent hypervisor recorder (hypervisor/metrics.py)
+    "tpf_chip": {
+        "tags": ("node", "chip", "generation"),
+        "fields": ("duty_cycle_pct", "hbm_used_bytes", "hbm_bw_util_pct",
+                   "power_watts", "temp_celsius", "ici_tx_bytes",
+                   "ici_rx_bytes", "partitions"),
+    },
+    "tpf_worker": {
+        "tags": ("node", "namespace", "worker", "qos", "isolation"),
+        "opt_tags": ("generation",),
+        "fields": ("duty_cycle_pct", "hbm_used_bytes", "frozen", "pids"),
+    },
+    # remote-vTPU dispatch saturation (shared emit helper, shipped by
+    # both the node-agent and operator-side recorders)
+    "tpf_remote_dispatch": {
+        "tags": ("node", "mode"),
+        "fields": ("depth", "executed_total", "launches_total",
+                   "microbatched_total", "busy_rejected_total",
+                   "deadline_exceeded_total", "queue_wait_p50_ms",
+                   "queue_wait_p99_ms", "queue_wait_mean_ms",
+                   "service_p50_ms", "service_p99_ms", "service_mean_ms",
+                   "tenants"),
+    },
+    "tpf_remote_qos": {
+        "tags": ("node", "mode", "qos"),
+        "fields": ("served_total", "queue_wait_p50_ms",
+                   "queue_wait_p99_ms"),
+    },
+    # operator-side recorder (metrics/recorder.py)
+    "tpf_chip_alloc": {
+        "tags": ("chip", "node", "pool", "generation"),
+        "fields": ("allocated_tflops", "allocated_hbm_bytes",
+                   "capacity_tflops", "capacity_hbm_bytes",
+                   "hbm_spill_bytes", "workers"),
+    },
+    "tpf_pool": {
+        "tags": ("pool",),
+        "fields": ("allocated_tflops", "capacity_tflops",
+                   "allocated_hbm_bytes", "capacity_hbm_bytes",
+                   "workers", "utilization"),
+    },
+    "tpf_billing": {
+        "tags": ("namespace", "workload", "qos", "pool"),
+        "fields": ("hourly_cost", "tflops_requested", "hbm_requested"),
+    },
+    "tpf_workload": {
+        "tags": ("namespace", "workload"),
+        "fields": ("replicas", "ready_replicas"),
+    },
+    # per-namespace quota pressure (allocator/quota.py pressure())
+    "tpf_quota": {
+        "tags": ("namespace",),
+        "fields": ("tflops_used_pct", "hbm_bytes_used_pct",
+                   "workers_used_pct", "pressure_pct", "threshold_pct",
+                   "over_threshold"),
+    },
+    "tpf_scheduler": {
+        "tags": (),
+        "fields": ("scheduled_total", "failed_total", "waiting_pods"),
+    },
+}

@@ -34,7 +34,7 @@ from .controllers.defrag import CompactionController, LiveMigrator
 from .controllers.rollout import RolloutController
 from .scheduler import GangManager, ICITopologyPlugin, Scheduler, TPUResourcesFit
 from .scheduler.expander import NodeExpander
-from .store import NotFoundError, ObjectStore
+from .store import ConflictError, NotFoundError, ObjectStore
 from .webhook.mutator import PodMutator
 from .webhook.parser import WorkloadParser
 
@@ -386,13 +386,23 @@ class Operator:
                 if n.status.phase == constants.PHASE_RUNNING]
 
     def _bind_pod(self, pod: Pod, node: str) -> None:
-        current = self.store.get(Pod, pod.metadata.name,
-                                 pod.metadata.namespace)
-        current.spec.node_name = node
-        current.metadata.annotations.update(pod.metadata.annotations)
-        current.status.phase = constants.PHASE_RUNNING
-        current.status.host_ip = node
-        self.store.update(current)
+        # Version-checked retry loop: the bind MUST stick (a clobbered
+        # bind strands the pod Pending with its allocation committed),
+        # and it must equally not clobber concurrent annotation writes.
+        # NotFoundError propagates like the plain get() always did.
+        for attempt in (0, 1, 2, 3, 4):
+            current = self.store.get(Pod, pod.metadata.name,
+                                     pod.metadata.namespace)
+            current.spec.node_name = node
+            current.metadata.annotations.update(pod.metadata.annotations)
+            current.status.phase = constants.PHASE_RUNNING
+            current.status.host_ip = node
+            try:
+                self.store.update(current, check_version=True)
+                return
+            except ConflictError:
+                if attempt == 4:
+                    raise
 
     def _pods_on_node(self, node: str) -> List[Pod]:
         return self.store.list(Pod,

@@ -301,6 +301,11 @@ class RemoteDevice:
             else:
                 wire_meta = dict(meta)
             try:
+                # _send_lock exists precisely to serialize frame writes
+                # on the shared socket (interleaved sendalls would tear
+                # frames); replies arrive on the reader thread, so the
+                # send is the only thing ever under it
+                # tpflint: disable=blocking-under-lock
                 send_message(self._sock, kind, wire_meta, buffers,
                              compress=compress,
                              version=self._wire_version)
@@ -321,6 +326,8 @@ class RemoteDevice:
                 if want_reply:
                     with self._state_lock:
                         self._pending[seq] = fut
+                # retry after reconnect: same frame-serialization story
+                # tpflint: disable=blocking-under-lock
                 send_message(self._sock, kind, wire_meta, buffers,
                              compress=compress,
                              version=self._wire_version)

@@ -197,21 +197,35 @@ class DeviceDispatcher:
         self.max_queue_global = max_queue_global
         self.max_microbatch = max(1, max_microbatch)
         self._cv = threading.Condition()
+        # guarded by: _cv
         self._tenants: Dict[str, Tenant] = {}
+        # guarded by: _cv
         self._vtime = 0.0
+        # guarded by: _cv
         self._depth = 0
+        # guarded by: _cv
         self._fifo_seq = 0
+        # guarded by: _cv
         self._stopping = False
         self._thread: Optional[threading.Thread] = None
         # -- observability ------------------------------------------------
+        # (the LatencyRecorders are internally locked; the bare counters
+        # and registries below share _cv with the queue state)
         self.queue_wait = LatencyRecorder()
         self.service = LatencyRecorder()
+        # guarded by: _cv
         self.per_qos_wait: Dict[str, LatencyRecorder] = {}
+        # guarded by: _cv
         self.per_qos_served: Dict[str, int] = {}
+        # guarded by: _cv
         self.executed = 0          # requests served
+        # guarded by: _cv
         self.launches = 0          # device launches (batches fuse many)
+        # guarded by: _cv
         self.microbatched = 0      # requests that rode a fused launch
+        # guarded by: _cv
         self.busy_rejected = 0
+        # guarded by: _cv
         self.deadline_exceeded = 0
 
     # -- lifecycle --------------------------------------------------------
@@ -258,7 +272,7 @@ class DeviceDispatcher:
 
     # -- enqueue ----------------------------------------------------------
 
-    def _retry_after_ms(self) -> int:
+    def _retry_after_ms(self) -> int:   # tpflint: holds=_cv
         """Backpressure hint: how long the current backlog needs to
         drain at the recent service rate (bounded to something a client
         can reasonably sleep)."""
@@ -407,8 +421,10 @@ class DeviceDispatcher:
             now = time.monotonic()
             expired = [i for i in batch if self._expire_locked(i)]
             batch = [i for i in batch if i not in expired]
+            if expired:
+                with self._cv:
+                    self.deadline_exceeded += len(expired)
             for item in expired:
-                self.deadline_exceeded += 1
                 waited_ms = int((now - item.enqueue_t) * 1e3)
                 try:
                     item.reply("ERROR", {
@@ -428,8 +444,10 @@ class DeviceDispatcher:
                 self.queue_wait.observe(wait)
                 qos = item.tenant.qos if item.tenant else \
                     constants.DEFAULT_QOS
-                self.per_qos_wait.setdefault(
-                    qos, LatencyRecorder()).observe(wait)
+                with self._cv:
+                    rec = self.per_qos_wait.setdefault(
+                        qos, LatencyRecorder())
+                rec.observe(wait)
             t0 = time.perf_counter()
             try:
                 flush = self.execute_batch(batch, self.peek_next)
@@ -447,16 +465,18 @@ class DeviceDispatcher:
                 self._complete(pending_items)
                 pending_flush, pending_items = None, []
             dt = time.perf_counter() - t0
-            self.launches += 1
-            self.executed += len(batch)
-            if len(batch) > 1:
-                self.microbatched += len(batch)
-            for item in batch:
+            with self._cv:
+                self.launches += 1
+                self.executed += len(batch)
+                if len(batch) > 1:
+                    self.microbatched += len(batch)
+                for item in batch:
+                    qos = item.tenant.qos if item.tenant else \
+                        constants.DEFAULT_QOS
+                    self.per_qos_served[qos] = \
+                        self.per_qos_served.get(qos, 0) + 1
+            for _ in batch:
                 self.service.observe(dt)
-                qos = item.tenant.qos if item.tenant else \
-                    constants.DEFAULT_QOS
-                self.per_qos_served[qos] = \
-                    self.per_qos_served.get(qos, 0) + 1
             if flush is not None:
                 pending_flush, pending_items = flush, batch
             else:
@@ -478,21 +498,22 @@ class DeviceDispatcher:
                             "completed": t.completed}
                 for t in self._tenants.values()}
             depth = self._depth
-        return {
+            counters = {"executed": self.executed,
+                        "launches": self.launches,
+                        "microbatched_requests": self.microbatched,
+                        "busy_rejected": self.busy_rejected,
+                        "deadline_exceeded": self.deadline_exceeded}
+            per_qos = {qos: (rec, self.per_qos_served.get(qos, 0))
+                       for qos, rec in self.per_qos_wait.items()}
+        return dict(counters, **{
             "mode": self.mode,
             "depth": depth,
             "max_queue_per_tenant": self.max_queue_per_tenant,
             "max_queue_global": self.max_queue_global,
-            "executed": self.executed,
-            "launches": self.launches,
-            "microbatched_requests": self.microbatched,
-            "busy_rejected": self.busy_rejected,
-            "deadline_exceeded": self.deadline_exceeded,
             "queue_wait": self.queue_wait.snapshot(),
             "service": self.service.snapshot(),
             "per_qos": {
-                qos: dict(self.per_qos_wait[qos].snapshot(),
-                          served=self.per_qos_served.get(qos, 0))
-                for qos in self.per_qos_wait},
+                qos: dict(rec.snapshot(), served=served)
+                for qos, (rec, served) in per_qos.items()},
             "tenants": per_tenant,
-        }
+        })

@@ -80,6 +80,28 @@ SUPPORTED_VERSIONS = (2, 3, 4)
 #: version every HELLO is framed at, so any peer can read it
 HELLO_VERSION = 2
 
+# -- opcode / reply / error-code registry ---------------------------------
+#
+# The single source of truth tpflint's `protocol-exhaustive` checker
+# verifies worker.py and client.py against: a kind added here without a
+# worker dispatch arm (or a client send site) fails `make lint`, and a
+# literal wired into worker/client without being registered here fails
+# too — a protocol v5 opcode can no longer half-land the way v3's
+# UNIMPLEMENTED slots had to be hand-audited (docs/pjrt-remote-coverage).
+
+#: client -> worker request kinds
+REQUEST_KINDS = ("HELLO", "INFO", "COMPILE", "COMPILE_MLIR", "PUT",
+                 "FREE", "FETCH", "EXECUTE", "SNAPSHOT", "RESTORE")
+#: request kinds the python client never sends (COMPILE_MLIR is the
+#: transparent PJRT plugin's path — libtpf_pjrt_remote.cc is the client)
+CLIENT_OPTIONAL_KINDS = ("COMPILE_MLIR",)
+#: worker -> client reply kinds
+REPLY_KINDS = ("HELLO_OK", "INFO_OK", "COMPILE_OK", "PUT_OK", "FREE_OK",
+               "FETCH_OK", "EXECUTE_OK", "SNAPSHOT_OK", "RESTORE_OK",
+               "ERROR")
+#: structured ERROR ``code`` values (v4; older clients see plain ERROR)
+ERROR_CODES = ("BUSY", "DEADLINE_EXCEEDED", "needs_compile")
+
 #: buffers at or above this size are candidates for compression
 COMPRESS_MIN_BYTES = 16 << 10
 #: compression must shrink the buffer to below this fraction to be used

@@ -124,46 +124,65 @@ class RemoteVTPUWorker:
             compress = {"1": True, "0": False}.get(env)
         self.compress: Optional[bool] = compress   # None = auto
         #: realized compression accounting (reported by INFO)
+        # guarded by: _lock
         self._wire_stats: Dict[str, int] = {}
         #: resident-buffer budget; 0 = unlimited
         self.max_resident_bytes = max_resident_bytes
+        # guarded by: _lock
         self.resident_bytes = 0
+        # guarded by: _lock
         self._exe_cache: Dict[str, object] = {}
+        # guarded by: _lock
         self._exe_blobs: Dict[str, bytes] = {}   # for snapshot persistence
+        # guarded by: _lock
         self._exe_costs: Dict[str, int] = {}
         #: raw-StableHLO executables (the transparent PJRT-plugin path:
         #: libtpf_pjrt_remote.so forwards PJRT_Client_Compile's MLIR here,
         #: bypassing jax.export entirely) — exe_id -> LoadedExecutable
+        # guarded by: _lock
         self._mlir_exes: Dict[str, object] = {}
         #: exe_id -> [([dims...], dtype_name), ...] flat result signature
+        # guarded by: _lock
         self._exe_sigs: Dict[str, list] = {}
         #: exe_id -> sharded-executable record (jitted flat call +
         #: shardings + wire layouts) for multi-device exports
+        # guarded by: _lock
         self._exe_sharded: Dict[str, dict] = {}
         #: exe_ids whose client opted into micro-batching at COMPILE
+        # guarded by: _lock
         self._exe_microbatch: set = set()
         #: exe_id -> deserialized Exported (kept only for micro-batch
         #: opt-ins: stacked variants re-trace through exported.call)
+        # guarded by: _lock
         self._exe_exported: Dict[str, object] = {}
         #: exe_id -> flat result count (splitting fused launch outputs)
+        # guarded by: _lock
         self._exe_nout: Dict[str, int] = {}
         #: (exe_id, k) -> jitted k-request fused launch
+        # guarded by: _lock
         self._exe_stacked: Dict[Tuple[str, int], Callable] = {}
+        # guarded by: _lock
         self._buffers: Dict[str, object] = {}    # device-resident arrays
         #: buf_id -> device id the buffer was PUT to (single-device
         #: buffers; sharded results span devices and are not listed)
+        # guarded by: _lock
         self._buf_device: Dict[str, int] = {}
         #: buf_ids freed automatically when first consumed by an EXECUTE
         #: (per-call input shards — the client fires them ahead of the
         #: EXECUTE and never references them again)
+        # guarded by: _lock
         self._ephemeral: set = set()
+        # guarded by: _lock
         self._buf_seq = 0
+        # guarded by: _lock
         self._conn_seq = 0            # per-connection id namespaces
         self._lock = threading.Lock()
         #: scatter pool: concurrent jax.device_put of input shards (and
         #: async PUTs) so H2D transfer of shard k+1 overlaps shard k
+        # guarded by: _lock
         self._scatter_pool: Optional[ThreadPoolExecutor] = None
         #: per-exe_id in-flight compile locks (COMPILE_MLIR single-flight)
+        # guarded by: _lock
         self._compile_flights: Dict[str, threading.Lock] = {}
         #: central QoS-weighted device dispatch (the serving path):
         #: handlers enqueue, one dispatcher thread drains onto devices
@@ -293,6 +312,11 @@ class RemoteVTPUWorker:
                                 rmeta = dict(rmeta, seq=_seq)
                             st: Dict[str, int] = {}
                             with wlock:
+                                # wlock is this connection's frame-write
+                                # serializer (dispatcher thread replies
+                                # race the handler thread's); the send
+                                # IS the critical section
+                                # tpflint: disable=blocking-under-lock
                                 send_message(self.request, rkind, rmeta,
                                              rbufs,
                                              compress=compress
@@ -397,7 +421,7 @@ class RemoteVTPUWorker:
 
     # -- resident-buffer accounting ------------------------------------
 
-    def _admit_resident(self, nbytes: int) -> Optional[str]:
+    def _admit_resident(self, nbytes: int) -> Optional[str]:  # tpflint: holds=_lock
         """Charge `nbytes` of resident HBM; returns an error string when
         the budget rejects it (caller holds the lock)."""
         if self.max_resident_bytes and \
@@ -419,7 +443,7 @@ class RemoteVTPUWorker:
             nbytes = np.asarray(arr).nbytes
         return int(nbytes)
 
-    def _release_resident(self, arr) -> None:
+    def _release_resident(self, arr) -> None:   # tpflint: holds=_lock
         nbytes = self._leaf_nbytes(arr)
         self.resident_bytes = max(0, self.resident_bytes - nbytes)
         if self.meter_client is not None:
@@ -1116,6 +1140,10 @@ class RemoteVTPUWorker:
                         self._leaf_nbytes(arr)
             with self._lock:
                 wire = dict(self._wire_stats)
+                cached_executables = (len(self._exe_cache)
+                                      + len(self._mlir_exes)
+                                      + len(self._exe_sharded))
+                resident_bytes = self.resident_bytes
             if wire.get("raw_bytes"):
                 # realized adaptive-compression ratio: wire bytes
                 # actually sent / raw bytes they encode (1.0 = nothing
@@ -1141,10 +1169,8 @@ class RemoteVTPUWorker:
                     for d in devices],
                 "resident_bytes_per_device": {
                     str(k): v for k, v in per_device.items()},
-                "cached_executables": len(self._exe_cache)
-                                      + len(self._mlir_exes)
-                                      + len(self._exe_sharded),
-                "resident_bytes": self.resident_bytes}, [])
+                "cached_executables": cached_executables,
+                "resident_bytes": resident_bytes}, [])
         elif kind == "COMPILE_MLIR":
             # Transparent-PJRT path: the client ships its jit lowering's
             # raw StableHLO (text or bytecode) exactly as PJRT_Client_

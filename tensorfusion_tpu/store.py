@@ -87,12 +87,50 @@ class Watch:
             return None
 
 
+def mutate(store, cls: Type["Resource"], name: str, mutate_fn,
+           namespace: str = "", attempts: int = 5) -> Optional["Resource"]:
+    """Optimistic-concurrency read-modify-write against any store
+    (ObjectStore or RemoteStore — same interface).
+
+    Re-reads the object fresh, applies ``mutate_fn(obj)``, and writes it
+    back with ``check_version=True``; on :class:`ConflictError` the
+    competing write wins the version and the loop re-reads and re-applies
+    — nothing is ever clobbered (the PR-2 lost-update fix, as a reusable
+    primitive instead of a per-controller pattern).
+
+    Returns the updated object; ``None`` when the object does not exist
+    (deleted concurrently — callers treat that as "nothing to patch").
+    ``mutate_fn`` may return ``False`` to abort without writing (e.g. a
+    phase transition whose precondition no longer holds).  After
+    ``attempts`` straight conflicts the ConflictError propagates: that
+    many lost races means a fight the caller must know about.
+    """
+    last: Optional[ConflictError] = None
+    for _ in range(attempts):
+        obj = store.try_get(cls, name, namespace)
+        if obj is None:
+            return None
+        if mutate_fn(obj) is False:
+            return obj
+        try:
+            return store.update(obj, check_version=True)
+        except ConflictError as e:
+            last = e
+    raise last if last is not None else ConflictError(
+        f"{cls.KIND} {name}: mutate() made no attempt")
+
+
 class ObjectStore:
     def __init__(self, persist_dir: Optional[str] = None):
         self._lock = threading.RLock()
+        # _cond wraps the SAME underlying lock: holding either guards
+        # the fields below (tpflint's guarded-by syntax lists both)
         self._cond = threading.Condition(self._lock)
+        # guarded by: _lock, _cond
         self._objects: Dict[str, Dict[str, Resource]] = {}   # kind -> key -> obj
+        # guarded by: _lock, _cond
         self._watches: List[Watch] = []
+        # guarded by: _lock, _cond
         self._rv = 0
         # [rv, etype, kind, obj_dict, cached_json] ring for remote
         # long-poll watches (the resourceVersion-windowed watch the k8s
@@ -101,21 +139,26 @@ class ObjectStore:
         # json.dumps per event, not N (the apiserver's cached-
         # serialization trick; measured 2.4x write throughput at 50
         # watchers in benchmarks/watch_scale.py)
+        # guarded by: _lock, _cond
         self._event_log: "collections.deque[list]" = \
             collections.deque(maxlen=EVENT_LOG_SIZE)
+        # guarded by: _lock, _cond
         self._log_enabled = False
         self._persist_dir = persist_dir
         # kind -> (open append handle, journal line count)
+        # guarded by: _lock, _cond
         self._journals: Dict[str, object] = {}
+        # guarded by: _lock, _cond
         self._journal_lines: Dict[str, int] = {}
         if persist_dir:
             os.makedirs(persist_dir, exist_ok=True)
 
     # -- internal ---------------------------------------------------------
 
-    def _bucket(self, kind: str) -> Dict[str, Resource]:
+    def _bucket(self, kind: str) -> Dict[str, Resource]:  # tpflint: holds=_lock
         return self._objects.setdefault(kind, {})
 
+    # tpflint: holds=_lock
     def _emit(self, etype: str, obj: Resource, rv: Optional[int] = None
               ) -> None:
         for w in list(self._watches):
@@ -151,6 +194,7 @@ class ObjectStore:
     def _journal_path(self, kind: str) -> str:
         return os.path.join(self._persist_dir, f"{kind}.jsonl")
 
+    # tpflint: holds=_lock
     def _persist(self, kind: str, op: str = "put",
                  obj: Optional[Resource] = None) -> None:
         """Append one journal entry (caller holds the lock); compact when
@@ -179,7 +223,7 @@ class ObjectStore:
         f.flush()   # ~3us: page-cache write, not fsync
         self._journal_lines[kind] = lines + 1
 
-    def _compact(self, kind: str) -> None:
+    def _compact(self, kind: str) -> None:  # tpflint: holds=_lock
         """Rewrite the kind's journal as a snapshot of live objects."""
         f = self._journals.pop(kind, None)
         if f is not None:

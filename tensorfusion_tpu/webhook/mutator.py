@@ -24,7 +24,7 @@ from typing import Optional
 
 from .. import constants
 from ..api.types import Pod, TPUWorkload
-from ..store import ObjectStore
+from ..store import ObjectStore, mutate
 from .auto_migration import (native_chip_request,
                              progressive_migration_enabled,
                              should_auto_migrate)
@@ -158,15 +158,20 @@ class PodMutator:
     def _ensure_workload(self, pod: Pod, spec) -> TPUWorkload:
         name = pod.metadata.annotations.get(constants.ANN_WORKLOAD) or \
             pod.metadata.name
-        existing = self.store.try_get(TPUWorkload, name,
-                                      pod.metadata.namespace)
-        if existing is not None:
+        def refresh_profile(existing):
             # admission must not clobber replica management: keep the
             # workload's scaling fields, refresh the resource profile
             spec.replicas = existing.spec.replicas
             spec.dynamic_replicas = existing.spec.dynamic_replicas
             existing.spec = spec
-            return self.store.update(existing)
+
+        # version-checked read-modify-write: a workload-controller status
+        # rollup landing between our read and write must not be lost
+        # (nor may it clobber this admission's resource refresh)
+        updated = mutate(self.store, TPUWorkload, name, refresh_profile,
+                         namespace=pod.metadata.namespace)
+        if updated is not None:
+            return updated
         wl = TPUWorkload.new(name, namespace=pod.metadata.namespace)
         wl.spec = spec
         wl.metadata.labels[constants.LABEL_MANAGED_BY] = "tpu-fusion"

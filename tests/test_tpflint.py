@@ -1,0 +1,481 @@
+"""tpflint's own test corpus: per-checker known-bad / known-good
+fixtures, the disable-comment escape hatch, and the baseline ratchet.
+
+Runs in tier-1 (no marks): the linter gates CI, so the linter itself is
+gated by the suite — and tools/pycov.py counts these tests' coverage of
+tools/tpflint/ toward the >=45% gate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from tools.tpflint.checkers import (ALL_CHECKS, blocking_under_lock,
+                                    guarded_fields, metrics_schema,
+                                    protocol_exhaustive, stale_write_back)
+from tools.tpflint.core import (Finding, SourceFile, apply_baseline,
+                                load_baseline, run_paths, save_baseline)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def sf(code: str, relpath: str = "pkg/mod.py") -> SourceFile:
+    return SourceFile(relpath, relpath, textwrap.dedent(code))
+
+
+def checks_of(findings):
+    return [f.check for f in findings]
+
+
+# -- stale-write-back ------------------------------------------------------
+
+BAD_GET_WRITEBACK = """
+    class C:
+        def reconcile(self):
+            obj = self.store.get(Pool, "a")
+            obj.status.phase = "Running"
+            self.store.update(obj)
+"""
+
+BAD_LIST_WRITEBACK = """
+    class C:
+        def reconcile(self):
+            for item in self.store.list(Pool):
+                item.status.n += 1
+                self.store.update(item)
+"""
+
+GOOD_CHECKED_WRITEBACK = """
+    class C:
+        def reconcile(self):
+            obj = self.store.get(Pool, "a")
+            obj.status.phase = "Running"
+            self.store.update(obj, check_version=True)
+"""
+
+GOOD_EVENT_OBJECT = """
+    class C:
+        def reconcile(self, event):
+            obj = event.obj
+            obj.status.phase = "Running"
+            self.store.update(obj)
+"""
+
+GOOD_DICT_UPDATE = """
+    def f(self):
+        tags = self.store.list(Pool)
+        meta = {}
+        meta.update({"a": 1})
+"""
+
+
+def test_stale_write_back_flags_get_then_update():
+    findings = stale_write_back.run_file(sf(BAD_GET_WRITEBACK))
+    assert len(findings) == 1
+    assert findings[0].symbol == "C.reconcile"
+    assert "check_version" in findings[0].message
+
+
+def test_stale_write_back_flags_list_iteration():
+    assert len(stale_write_back.run_file(sf(BAD_LIST_WRITEBACK))) == 1
+
+
+def test_stale_write_back_passes_checked_and_unrelated():
+    for good in (GOOD_CHECKED_WRITEBACK, GOOD_EVENT_OBJECT,
+                 GOOD_DICT_UPDATE):
+        assert stale_write_back.run_file(sf(good)) == []
+
+
+def test_stale_write_back_reassignment_clears_taint():
+    code = """
+        def f(self):
+            obj = self.store.get(Pool, "a")
+            obj = make_fresh()
+            self.store.update(obj)
+    """
+    assert stale_write_back.run_file(sf(code)) == []
+
+
+def test_stale_write_back_taint_propagates_through_alias():
+    code = """
+        def f(self):
+            obj = self.store.get(Pool, "a")
+            alias = obj
+            self.store.update(alias)
+    """
+    assert len(stale_write_back.run_file(sf(code))) == 1
+
+
+# -- blocking-under-lock ---------------------------------------------------
+
+BAD_SLEEP = """
+    import time
+    class C:
+        def f(self):
+            with self._lock:
+                time.sleep(1)
+"""
+
+BAD_SUBPROCESS = """
+    import subprocess
+    class C:
+        def f(self):
+            with self._lock:
+                subprocess.Popen(["ls"])
+"""
+
+BAD_QUEUE_GET = """
+    class C:
+        def f(self):
+            with self._state_lock:
+                item = self.q.get()
+"""
+
+BAD_STORE_RPC = """
+    class C:
+        def f(self):
+            with self._lock:
+                self.store.update(self.obj)
+"""
+
+GOOD_OUTSIDE = """
+    import time
+    class C:
+        def f(self):
+            with self._lock:
+                snapshot = dict(self._data)
+            time.sleep(1)
+"""
+
+GOOD_DICT_GET = """
+    class C:
+        def f(self):
+            with self._lock:
+                v = self._data.get("key")
+                w = self.q.get(timeout=0.5)
+"""
+
+GOOD_NESTED_DEF = """
+    class C:
+        def f(self):
+            with self._lock:
+                def later():
+                    time.sleep(1)
+                self._cb = later
+"""
+
+
+@pytest.mark.parametrize("code,token", [
+    (BAD_SLEEP, "sleep"), (BAD_SUBPROCESS, "Popen"),
+    (BAD_QUEUE_GET, "get"), (BAD_STORE_RPC, "update")])
+def test_blocking_under_lock_flags(code, token):
+    findings = blocking_under_lock.run_file(sf(code))
+    assert len(findings) == 1
+    assert findings[0].key == token
+
+
+@pytest.mark.parametrize("code", [GOOD_OUTSIDE, GOOD_DICT_GET,
+                                  GOOD_NESTED_DEF])
+def test_blocking_under_lock_passes(code):
+    assert blocking_under_lock.run_file(sf(code)) == []
+
+
+# -- guarded-field ---------------------------------------------------------
+
+BAD_UNGUARDED = """
+    import threading
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            # guarded by: _lock
+            self._items = {}
+
+        def poke(self):
+            self._items["a"] = 1
+"""
+
+GOOD_GUARDED = """
+    import threading
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            # guarded by: _lock
+            self._items = {}
+
+        def poke(self):
+            with self._lock:
+                self._items["a"] = 1
+
+        def _drain_locked(self):
+            return list(self._items)
+
+        def helper(self):   # tpflint: holds=_lock
+            return self._items.get("a")
+"""
+
+GOOD_CONDITION_ALIAS = """
+    import threading
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cond = threading.Condition(self._lock)
+            # guarded by: _lock, _cond
+            self._items = {}
+
+        def wait_drain(self):
+            with self._cond:
+                return list(self._items)
+"""
+
+
+def test_guarded_field_flags_unlocked_access():
+    findings = guarded_fields.run_file(sf(BAD_UNGUARDED))
+    assert len(findings) == 1
+    assert findings[0].key == "_items"
+    assert findings[0].symbol == "C.poke"
+
+
+def test_guarded_field_accepts_lock_holders_and_aliases():
+    assert guarded_fields.run_file(sf(GOOD_GUARDED)) == []
+    assert guarded_fields.run_file(sf(GOOD_CONDITION_ALIAS)) == []
+
+
+def test_guarded_field_init_exempt():
+    # __init__ itself writes without the lock: construction precedes
+    # publication, never flagged
+    assert guarded_fields.run_file(sf(BAD_UNGUARDED.replace(
+        "def poke", "def unused"))) != []  # sanity: still one finding
+
+
+# -- protocol-exhaustive ---------------------------------------------------
+
+PROTO_OK = """
+    REQUEST_KINDS = ("HELLO", "PING")
+    CLIENT_OPTIONAL_KINDS = ()
+    REPLY_KINDS = ("HELLO_OK", "PING_OK", "ERROR")
+    ERROR_CODES = ("BUSY",)
+"""
+
+WORKER_OK = """
+    def handle(self, kind, reply):
+        if kind == "HELLO":
+            reply("HELLO_OK", {})
+        elif kind == "PING":
+            reply("PING_OK", {})
+        else:
+            reply("ERROR", {"error": "x", "code": "BUSY"})
+"""
+
+CLIENT_OK = """
+    def call(self):
+        kind, meta, _ = self._rpc("HELLO", {}, [])
+        if kind == "ERROR":
+            code = meta.get("code")
+            if code == "BUSY":
+                raise RuntimeError
+        self._rpc("PING", {}, [])
+"""
+
+
+def proto_files(proto=PROTO_OK, worker=WORKER_OK, client=CLIENT_OK):
+    files = {}
+    for rel, code in (("x/remoting/protocol.py", proto),
+                      ("x/remoting/worker.py", worker),
+                      ("x/remoting/client.py", client)):
+        files[rel] = sf(code, rel)
+    return files
+
+
+def test_protocol_clean_set_passes():
+    assert protocol_exhaustive.run_project(proto_files(), REPO) == []
+
+
+def test_protocol_declared_but_unhandled_opcode_fails():
+    bad = PROTO_OK.replace('"HELLO", "PING"', '"HELLO", "PING", "MIGRATE"')
+    findings = protocol_exhaustive.run_project(proto_files(proto=bad), REPO)
+    assert any("MIGRATE" in f.message and "never dispatched" in f.message
+               for f in findings)
+    assert any("MIGRATE" in f.message and "never sends" in f.message
+               for f in findings)
+
+
+def test_protocol_undeclared_handled_opcode_fails():
+    bad_worker = WORKER_OK + """
+    def extra(self, kind, reply):
+        if kind == "SNEAKY":
+            reply("HELLO_OK", {})
+    """
+    findings = protocol_exhaustive.run_project(
+        proto_files(worker=bad_worker), REPO)
+    assert any(f.key == "SNEAKY" for f in findings)
+
+
+def test_protocol_undeclared_error_code_fails():
+    bad_worker = WORKER_OK.replace('"code": "BUSY"', '"code": "NEW_CODE"')
+    findings = protocol_exhaustive.run_project(
+        proto_files(worker=bad_worker), REPO)
+    keys = {f.key for f in findings}
+    assert "NEW_CODE" in keys       # emitted but undeclared
+    assert "BUSY" in keys           # declared but no longer emitted
+
+
+def test_protocol_real_tree_is_exhaustive():
+    files = {}
+    base = os.path.join(REPO, "tensorfusion_tpu", "remoting")
+    for name in ("protocol.py", "worker.py", "client.py", "dispatch.py"):
+        files[f"tensorfusion_tpu/remoting/{name}"] = SourceFile.load(
+            os.path.join(base, name), REPO)
+    assert protocol_exhaustive.run_project(files, REPO) == []
+
+
+# -- metrics-schema --------------------------------------------------------
+
+SCHEMA_OK = """
+    METRICS_SCHEMA = {
+        "tpf_demo": {
+            "tags": ("node",),
+            "opt_tags": ("generation",),
+            "fields": ("duty_pct", "hbm_bytes"),
+        },
+    }
+"""
+
+EMIT_OK = """
+    def record(self, ts):
+        tags = {"node": self.node}
+        if self.generation:
+            tags["generation"] = self.generation
+        encode_line("tpf_demo", tags, {"duty_pct": 1.0}, ts)
+        self.tsdb.insert("tpf_demo", dict(tags), {"hbm_bytes": 2}, ts)
+"""
+
+
+def metrics_files(schema=SCHEMA_OK, emit=EMIT_OK, tmp_path=None):
+    files = {}
+    for rel, code in (("x/metrics/schema.py", schema),
+                      ("x/metrics/rec.py", emit)):
+        files[rel] = sf(code, rel)
+    return files
+
+
+@pytest.fixture
+def docs_root(tmp_path):
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "metrics-schema.md").write_text("tpf_demo\n")
+    return str(tmp_path)
+
+
+def test_metrics_schema_clean_passes(docs_root):
+    assert metrics_schema.run_project(metrics_files(), docs_root) == []
+
+
+def test_metrics_schema_undeclared_field_fails(docs_root):
+    bad = EMIT_OK.replace('{"duty_pct": 1.0}', '{"duty_pctt": 1.0}')
+    findings = metrics_schema.run_project(metrics_files(emit=bad),
+                                          docs_root)
+    assert any(f.key == "tpf_demo.duty_pctt" for f in findings)
+
+
+def test_metrics_schema_missing_required_tag_fails(docs_root):
+    bad = EMIT_OK.replace('tags = {"node": self.node}', 'tags = {}')
+    findings = metrics_schema.run_project(metrics_files(emit=bad),
+                                          docs_root)
+    assert any("missing required tag" in f.message for f in findings)
+
+
+def test_metrics_schema_undeclared_measurement_fails(docs_root):
+    bad = EMIT_OK + """
+    def record2(self, ts):
+        encode_line("tpf_rogue", {}, {"x": 1}, ts)
+"""
+    findings = metrics_schema.run_project(metrics_files(emit=bad),
+                                          docs_root)
+    assert any(f.key == "tpf_rogue" for f in findings)
+
+
+def test_metrics_schema_bad_consumer_field_fails(docs_root):
+    bad = EMIT_OK + """
+    def read(self):
+        return self.tsdb.query("tpf_demo", "dutty_pct", {}, 60)
+"""
+    findings = metrics_schema.run_project(metrics_files(emit=bad),
+                                          docs_root)
+    assert any(f.key == "tpf_demo.dutty_pct" for f in findings)
+
+
+def test_metrics_schema_undocumented_measurement_fails(tmp_path):
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "metrics-schema.md").write_text("nothing here\n")
+    findings = metrics_schema.run_project(metrics_files(), str(tmp_path))
+    assert any(f.key == "docs:tpf_demo" for f in findings)
+
+
+# -- disable comments + runner + baseline ----------------------------------
+
+def test_disable_comment_suppresses(tmp_path):
+    code = textwrap.dedent("""
+        class C:
+            def f(self):
+                obj = self.store.get(Pool, "a")
+                # racy on purpose in this fixture
+                # tpflint: disable=stale-write-back
+                self.store.update(obj)
+    """)
+    (tmp_path / "mod.py").write_text(code)
+    findings = run_paths([str(tmp_path / "mod.py")], str(tmp_path))
+    assert checks_of(findings) == []
+    # same code without the comment fires
+    (tmp_path / "mod.py").write_text(code.replace(
+        "# tpflint: disable=stale-write-back", ""))
+    findings = run_paths([str(tmp_path / "mod.py")], str(tmp_path))
+    assert checks_of(findings) == ["stale-write-back"]
+
+
+def test_disable_file_suppresses_whole_file(tmp_path):
+    code = textwrap.dedent("""
+        # tpflint: disable-file=stale-write-back
+        class C:
+            def f(self):
+                obj = self.store.get(Pool, "a")
+                self.store.update(obj)
+    """)
+    (tmp_path / "mod.py").write_text(code)
+    assert run_paths([str(tmp_path / "mod.py")], str(tmp_path)) == []
+
+
+def test_baseline_ratchet_roundtrip(tmp_path):
+    f1 = Finding("stale-write-back", "a.py", 3, "C.f", "msg", key="obj")
+    f2 = Finding("guarded-field", "b.py", 9, "D.g", "msg", key="_x")
+    path = str(tmp_path / "baseline.json")
+    save_baseline(path, [f1, f2])
+    baseline = load_baseline(path)
+    # unchanged set: nothing new, nothing stale
+    new, stale = apply_baseline([f1, f2], baseline)
+    assert new == [] and stale == []
+    # a third finding is new even with the baseline present
+    f3 = Finding("stale-write-back", "a.py", 30, "C.h", "msg", key="other")
+    new, stale = apply_baseline([f1, f2, f3], baseline)
+    assert new == [f3]
+    # fixing one leaves a stale entry that must be removed
+    new, stale = apply_baseline([f1], baseline)
+    assert new == [] and stale == [f2.fingerprint]
+
+
+def test_repo_lints_clean_with_committed_baseline():
+    """The acceptance invariant: `make lint` passes at HEAD."""
+    findings = run_paths(["tensorfusion_tpu"], REPO)
+    baseline = load_baseline(os.path.join(REPO, "tools", "tpflint",
+                                          "baseline.json"))
+    new, stale = apply_baseline(findings, baseline)
+    assert new == [], [f.render() for f in new]
+    assert stale == []
+
+
+def test_all_five_checkers_registered():
+    assert set(ALL_CHECKS) == {
+        "stale-write-back", "blocking-under-lock", "guarded-field",
+        "protocol-exhaustive", "metrics-schema"}

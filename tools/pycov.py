@@ -4,10 +4,13 @@
 The reference CI enforces >=45% coverage (``Makefile:81-90``
 ``check-coverage``); this image has neither pytest-cov nor coverage.py, so
 the gate is built on ``sys.monitoring`` (PEP 669, Python 3.12): LINE
-events record executed lines for files under ``tensorfusion_tpu/``
-(events are DISABLEd per code object everywhere else, keeping overhead
-low), executable lines come from compiled code objects' ``co_lines``, and
-the process exits non-zero below the threshold.
+events record executed lines for files under ``tensorfusion_tpu/`` and
+``tools/tpflint/`` (the lint suite gates CI, so its code is gated like
+product code; its tests already run inside this very invocation, so
+nothing runs twice — events are DISABLEd per code object everywhere
+else, keeping overhead low), executable lines come from compiled code
+objects' ``co_lines``, and the process exits non-zero below the
+threshold.
 
 Usage:  python tools/pycov.py [--min 45] [pytest args...]
 """
@@ -21,14 +24,16 @@ import types
 from typing import Dict, Set
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PKG = os.path.join(REPO, "tensorfusion_tpu")
+#: measured roots: the product package plus the lint suite that gates it
+ROOTS = (os.path.join(REPO, "tensorfusion_tpu"),
+         os.path.join(REPO, "tools", "tpflint"))
 
 executed: Dict[str, Set[int]] = {}
 
 
 def _on_line(code, lineno):
     fn = code.co_filename
-    if fn.startswith(PKG):
+    if fn.startswith(ROOTS):
         executed.setdefault(fn, set()).add(lineno)
         return None
     return sys.monitoring.DISABLE
@@ -91,19 +96,20 @@ def main() -> int:
 
     total_exec = total_hit = 0
     per_file = []
-    for dirpath, _, files in os.walk(PKG):
-        for name in sorted(files):
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, name)
-            lines = _executable_lines(path)
-            if not lines:
-                continue
-            hit = executed.get(path, set()) & lines
-            total_exec += len(lines)
-            total_hit += len(hit)
-            per_file.append((os.path.relpath(path, REPO),
-                             len(hit), len(lines)))
+    for root in ROOTS:
+        for dirpath, _, files in os.walk(root):
+            for name in sorted(files):
+                if not name.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, name)
+                lines = _executable_lines(path)
+                if not lines:
+                    continue
+                hit = executed.get(path, set()) & lines
+                total_exec += len(lines)
+                total_hit += len(hit)
+                per_file.append((os.path.relpath(path, REPO),
+                                 len(hit), len(lines)))
 
     pct = 100.0 * total_hit / max(total_exec, 1)
     per_file.sort(key=lambda t: t[1] / max(t[2], 1))

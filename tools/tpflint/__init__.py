@@ -1,0 +1,31 @@
+"""tpflint: project-invariant static analysis for tpu-fusion.
+
+A dependency-free ``ast``-based linter whose checkers encode the
+correctness invariants this codebase has actually been burned by (the
+PR-2 lost-update races, hand-audited protocol slots, silently drifting
+metrics names) rather than generic style rules.  ``go vet`` for the
+control plane, in spirit.
+
+Checkers (see docs/static-analysis.md for the catalog):
+
+- ``stale-write-back``      store.update() of an object read earlier in
+                            the same function without check_version=True
+- ``blocking-under-lock``   socket/sleep/subprocess/queue.get()/store
+                            RPCs lexically inside a ``with ..lock:`` body
+- ``guarded-field``         fields declared ``# guarded by: _lock`` only
+                            touched under that lock
+- ``protocol-exhaustive``   every declared remoting opcode / reply kind /
+                            error code is wired through worker + client
+- ``metrics-schema``        emitted influx measurements/tags/fields agree
+                            with metrics/schema.py and the docs
+
+Run as ``make lint`` (= ``python -m tools.tpflint tensorfusion_tpu``).
+Pre-existing findings are ratcheted via tools/tpflint/baseline.json:
+new findings fail, baseline entries that no longer fire must be removed
+(``--update-baseline`` rewrites the file).  Per-line escape hatch:
+``# tpflint: disable=<check>[,<check>] -- justification``.
+"""
+
+from .core import Finding, SourceFile, run_paths  # noqa: F401
+
+__all__ = ["Finding", "SourceFile", "run_paths"]

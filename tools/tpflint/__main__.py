@@ -1,0 +1,97 @@
+"""CLI: ``python -m tools.tpflint [paths...]`` from the repo root.
+
+Exit codes: 0 clean (baseline may still hold tolerated debt), 1 new
+findings or stale baseline entries, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .checkers import ALL_CHECKS
+from .core import (apply_baseline, load_baseline, run_paths,
+                   save_baseline)
+
+DEFAULT_PATHS = ["tensorfusion_tpu"]
+DEFAULT_BASELINE = os.path.join("tools", "tpflint", "baseline.json")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tpflint",
+        description="tpu-fusion project-invariant static analysis")
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="files/directories to lint "
+                             "(default: tensorfusion_tpu)")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE,
+                        help="ratchet file (default: %(default)s)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report every finding, ignore the ratchet")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline to the current "
+                             "finding set (shrink-only by policy: "
+                             "review the diff)")
+    parser.add_argument("--check", action="append", default=None,
+                        metavar="NAME", choices=ALL_CHECKS,
+                        help="run only the named checker(s)")
+    parser.add_argument("--list-checks", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_checks:
+        for c in ALL_CHECKS:
+            print(c)
+        return 0
+
+    repo_root = os.getcwd()
+    paths = args.paths or DEFAULT_PATHS
+    for p in paths:
+        if not os.path.exists(os.path.join(repo_root, p)) and \
+                not os.path.exists(p):
+            print(f"tpflint: path not found: {p}", file=sys.stderr)
+            return 2
+
+    checks = set(args.check) if args.check else None
+    findings = run_paths(paths, repo_root, checks=checks)
+
+    if args.update_baseline:
+        save_baseline(args.baseline, findings)
+        print(f"tpflint: baseline rewritten with {len(findings)} "
+              f"finding(s) -> {args.baseline}")
+        return 0
+
+    if args.no_baseline:
+        for f in findings:
+            print(f.render())
+        print(f"tpflint: {len(findings)} finding(s)")
+        return 1 if findings else 0
+
+    baseline = load_baseline(args.baseline)
+    new, stale = apply_baseline(findings, baseline)
+    for f in new:
+        print(f.render())
+    for fp in stale:
+        print(f"tpflint: stale baseline entry no longer fires: {fp}")
+    tolerated = len(findings) - len(new)
+    if new or stale:
+        if new:
+            print(f"tpflint: FAIL — {len(new)} new finding(s)"
+                  + (f" ({tolerated} baselined)" if tolerated else ""))
+        if stale:
+            print(f"tpflint: FAIL — {len(stale)} stale baseline "
+                  f"entr{'y' if len(stale) == 1 else 'ies'}: the debt "
+                  f"shrank, lock it in (python -m tools.tpflint "
+                  f"--update-baseline)")
+        return 1
+    print(f"tpflint: PASS ({len(findings)} baselined finding(s), "
+          f"{len(ALL_CHECKS) if checks is None else len(checks)} "
+          f"checkers)" if findings else
+          f"tpflint: PASS (clean, "
+          f"{len(ALL_CHECKS) if checks is None else len(checks)} "
+          f"checkers)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

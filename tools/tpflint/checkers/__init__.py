@@ -1,0 +1,16 @@
+"""Checker registry.
+
+Adding a checker: create a module exposing ``CHECK`` (kebab-case name)
+and either ``run_file(sf) -> [Finding]`` (per-file) or
+``run_project(files, repo_root) -> [Finding]`` (cross-file), then list
+it below.  docs/static-analysis.md documents the contract.
+"""
+
+from . import (blocking_under_lock, guarded_fields, metrics_schema,
+               protocol_exhaustive, stale_write_back)
+
+FILE_CHECKERS = (stale_write_back, blocking_under_lock, guarded_fields)
+PROJECT_CHECKERS = (protocol_exhaustive, metrics_schema)
+
+ALL_CHECKS = tuple(sorted(
+    c.CHECK for c in FILE_CHECKERS + PROJECT_CHECKERS))

@@ -1,0 +1,131 @@
+"""blocking-under-lock: slow calls lexically inside ``with <lock>:``.
+
+The hypervisor tick, the remoting dispatcher and every store reader
+share locks with hot paths; one ``subprocess.Popen`` or blocking socket
+send under such a lock turns an unrelated slow syscall into a
+control-plane stall (single_node._maybe_spawn held its registry lock
+across Popen until this checker flagged it).
+
+A with-statement is lock-ish when its context expression's final
+component matches ``*lock`` / ``*mutex`` / ``mu`` (``self._lock``,
+``wlock``, ``self._send_lock``...).  Condition variables are exempt by
+naming convention (``_cv`` / ``_cond``): ``Condition.wait`` *releases*
+the lock, which is the whole point.
+
+Flagged inside a lock body (nested defs excluded — they run later):
+
+- ``time.sleep(...)``
+- ``subprocess.*`` / ``os.system``
+- socket ops: ``.sendall`` / ``.recv`` / ``.recv_into`` / ``.accept``,
+  and the protocol helpers ``send_message`` / ``recv_message``
+- unbounded queue get: ``.get()`` with no positional args and no finite
+  timeout (``dict.get(key)`` always has a positional arg, so it never
+  matches)
+- store RPCs: ``<store>.get/list/update/create/delete/...`` — on a
+  networked control plane these are HTTP round trips
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from ..core import Finding, SourceFile, dotted_tail, iter_functions
+from .stale_write_back import _is_store
+
+CHECK = "blocking-under-lock"
+
+_LOCK_RE = re.compile(r"(lock|mutex)$|(^|_)mu$", re.IGNORECASE)
+
+SOCKET_METHODS = {"sendall", "recv", "recv_into", "accept"}
+PROTOCOL_HELPERS = {"send_message", "recv_message"}
+SUBPROCESS_ATTRS = {"Popen", "run", "call", "check_call", "check_output"}
+STORE_RPC_METHODS = {"get", "try_get", "list", "update", "create",
+                     "delete", "update_or_create", "watch",
+                     "events_since", "snapshot_events", "push_metrics"}
+
+
+def _is_lockish(expr: ast.AST) -> bool:
+    return bool(_LOCK_RE.search(dotted_tail(expr)))
+
+
+def _blocking_reason(call: ast.Call) -> str:
+    func = call.func
+    tail = dotted_tail(func)
+    if tail in PROTOCOL_HELPERS:
+        return f"{tail}() does wire I/O"
+    if isinstance(func, ast.Attribute):
+        recv = func.value
+        if tail == "sleep" and dotted_tail(recv) == "time":
+            return "time.sleep() parks the thread"
+        if tail in SUBPROCESS_ATTRS and dotted_tail(recv) == "subprocess":
+            return f"subprocess.{tail}() forks/execs (milliseconds " \
+                   f"to seconds)"
+        if tail == "system" and dotted_tail(recv) == "os":
+            return "os.system() runs a shell"
+        if tail in SOCKET_METHODS:
+            return f".{tail}() blocks on the peer"
+        if tail == "get" and not call.args:
+            for kw in call.keywords:
+                if kw.arg == "timeout":
+                    if isinstance(kw.value, ast.Constant) and \
+                            kw.value.value is None:
+                        return "queue.get(timeout=None) blocks forever"
+                    return ""       # bounded wait: allowed
+            return "queue.get() with no timeout blocks forever"
+        if tail in STORE_RPC_METHODS and _is_store(recv):
+            return f"store.{tail}() is an RPC on a networked " \
+                   f"control plane"
+    return ""
+
+
+def _scan_body(sf: SourceFile, symbol: str, body, lock_name: str,
+               findings: List[Finding]) -> None:
+    for stmt in body:
+        _scan_stmt(sf, symbol, stmt, lock_name, findings)
+
+
+def _scan_stmt(sf: SourceFile, symbol: str, stmt, lock_name: str,
+               findings: List[Finding]) -> None:
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.Lambda)):
+        return      # deferred execution: not under the lock at call time
+    for child in ast.iter_child_nodes(stmt):
+        _scan_stmt(sf, symbol, child, lock_name, findings)
+    if isinstance(stmt, ast.Call):
+        reason = _blocking_reason(stmt)
+        if reason:
+            findings.append(Finding(
+                check=CHECK, path=sf.relpath, line=stmt.lineno,
+                symbol=symbol, key=dotted_tail(stmt.func),
+                message=(f"blocking call under `with {lock_name}:` — "
+                         f"{reason}; every thread contending on "
+                         f"{lock_name} stalls behind it (move the slow "
+                         f"work outside the critical section)")))
+
+
+def run_file(sf: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    for symbol, fn in iter_functions(sf.tree):
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fn:
+                continue
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            for item in node.items:
+                if _is_lockish(item.context_expr):
+                    lock_name = ast.unparse(item.context_expr)
+                    _scan_body(sf, symbol, node.body, lock_name, findings)
+                    break
+    # deduplicate: nested locks / nested withs can visit a call twice
+    seen = set()
+    out = []
+    for f in findings:
+        marker = (f.path, f.line, f.key)
+        if marker in seen:
+            continue
+        seen.add(marker)
+        out.append(f)
+    return out

@@ -1,0 +1,175 @@
+"""guarded-field: declared lock disciplines, mechanically enforced.
+
+Python has no ``GUARDED_BY`` annotation, so shared-state discipline in
+this codebase lived in comments and code review — until a field written
+outside its lock slips through (single_node's ``_env`` was written
+lock-free on one of three paths).  This checker turns the comment into a
+contract:
+
+    self._procs: Dict[str, Popen] = {}   # guarded by: _lock
+
+declares that every ``self._procs`` access in the class must be
+lexically inside ``with self._lock:``.  Forms accepted (trailing or on
+the preceding comment line; alternatives for Condition aliases sharing
+the underlying lock):
+
+    # guarded by: _lock
+    # guarded by: _lock, _cond
+
+Accesses are exempt when they occur in:
+
+- ``__init__`` (construction happens-before publication),
+- methods whose name ends in ``_locked`` (the project convention for
+  "caller holds the lock"),
+- methods annotated ``# tpflint: holds=_lock`` on their ``def`` line.
+
+The check is lexical — a closure defined under the lock but executed
+later is not caught, and an access passed through an alias is invisible.
+It still catches the failure mode that actually bites: a maintainer
+adding a code path that touches the field directly without the lock.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from ..core import Finding, SourceFile, dotted_tail
+
+CHECK = "guarded-field"
+
+_GUARD_RE = re.compile(r"#.*guarded by:\s*([\w, |]+)")
+_HOLDS_RE = re.compile(r"#\s*tpflint:\s*holds=([\w, |]+)")
+
+
+def _split_names(raw: str) -> Set[str]:
+    return {n.strip() for n in re.split(r"[|,]| or ", raw) if n.strip()}
+
+
+def _guard_names(sf: SourceFile, lineno: int) -> Optional[Set[str]]:
+    """Guard declaration on the statement's line or the comment line(s)
+    directly above it."""
+    m = _GUARD_RE.search(sf.lines[lineno - 1])
+    if m:
+        return _split_names(m.group(1))
+    i = lineno - 2
+    while i >= 0 and sf.lines[i].lstrip().startswith("#"):
+        m = _GUARD_RE.search(sf.lines[i])
+        if m:
+            return _split_names(m.group(1))
+        i -= 1
+    return None
+
+
+def _self_attr(node: ast.AST) -> str:
+    """'x' for a `self.x` attribute node, else ''."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return ""
+
+
+class _ClassScan:
+    def __init__(self, sf: SourceFile, cls: ast.ClassDef):
+        self.sf = sf
+        self.cls = cls
+        #: field -> set of lock attribute names allowed to guard it
+        self.guards: Dict[str, Set[str]] = {}
+        self.findings: List[Finding] = []
+
+    def collect_guards(self) -> None:
+        for method in self.cls.body:
+            if not isinstance(method, ast.FunctionDef):
+                continue
+            for stmt in ast.walk(method):
+                targets = []
+                if isinstance(stmt, ast.Assign):
+                    targets = stmt.targets
+                elif isinstance(stmt, ast.AnnAssign):
+                    targets = [stmt.target]
+                for t in targets:
+                    field = _self_attr(t)
+                    if not field:
+                        continue
+                    names = _guard_names(self.sf, stmt.lineno)
+                    if names:
+                        self.guards.setdefault(field, set()).update(names)
+
+    def _method_holds(self, method: ast.FunctionDef) -> Set[str]:
+        held: Set[str] = set()
+        if method.name.endswith("_locked"):
+            held.add("*")
+        # the def line itself, or comment lines directly above it
+        candidates = [self.sf.lines[method.lineno - 1]]
+        i = method.lineno - 2
+        while i >= 0 and self.sf.lines[i].lstrip().startswith("#"):
+            candidates.append(self.sf.lines[i])
+            i -= 1
+        for line in candidates:
+            m = _HOLDS_RE.search(line)
+            if m:
+                held |= _split_names(m.group(1))
+        return held
+
+    def check(self) -> None:
+        if not self.guards:
+            return
+        for method in self.cls.body:
+            if not isinstance(method, ast.FunctionDef) or \
+                    method.name == "__init__":
+                continue
+            held = self._method_holds(method)
+            for stmt in method.body:
+                self._walk(method.name, stmt, held)
+
+    def _walk(self, mname: str, node: ast.AST, held: Set[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return      # closures run later; lexical locks don't apply
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired = {dotted_tail(item.context_expr)
+                        for item in node.items
+                        if _self_attr(item.context_expr)
+                        or isinstance(item.context_expr, ast.Name)}
+            inner = held | {a for a in acquired if a}
+            for item in node.items:
+                self._visit_expr(mname, item.context_expr, held)
+            for stmt in node.body:
+                self._walk(mname, stmt, inner)
+            return
+        self._visit_expr(mname, node, held, recurse=False)
+        for child in ast.iter_child_nodes(node):
+            self._walk(mname, child, held)
+
+    def _visit_expr(self, mname: str, node: ast.AST, held: Set[str],
+                    recurse: bool = True) -> None:
+        nodes = ast.walk(node) if recurse else [node]
+        for n in nodes:
+            field = _self_attr(n)
+            if not field or field not in self.guards:
+                continue
+            allowed = self.guards[field]
+            if "*" in held or held & allowed:
+                continue
+            self.findings.append(Finding(
+                check=CHECK, path=self.sf.relpath, line=n.lineno,
+                symbol=f"{self.cls.name}.{mname}", key=field,
+                message=(f"self.{field} is declared `guarded by: "
+                         f"{'/'.join(sorted(allowed))}` but is accessed "
+                         f"outside it (wrap in `with self."
+                         f"{sorted(allowed)[0]}:`, or annotate the "
+                         f"method `# tpflint: holds={sorted(allowed)[0]}`"
+                         f" if the caller holds it)")))
+
+
+def run_file(sf: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        scan = _ClassScan(sf, node)
+        scan.collect_guards()
+        scan.check()
+        findings.extend(scan.findings)
+    return findings

@@ -1,0 +1,340 @@
+"""metrics-schema: influx line names/tags/fields cannot drift.
+
+The ``tpf_*`` series are emitted from two recorders (operator-side
+``metrics/recorder.py``, node-agent ``hypervisor/metrics.py``), queried
+by the autoscaler and matched by alert rules — four places that only
+agree by convention.  ``tensorfusion_tpu/metrics/schema.py`` makes the
+convention a registry; this checker verifies every site against it:
+
+- every ``encode_line(...)`` / ``tsdb.insert(...)`` with a literal
+  measurement name must use a declared measurement, and every tag/field
+  key it emits (resolvable statically: dict literals, ``dict(base,
+  k=...)``, variables assigned a dict literal earlier in the function,
+  conditional ``tags["k"] = ...`` adds) must be declared;
+- when the emit site resolves completely, all *required* tags must be
+  present (optional tags live in ``opt_tags``);
+- every ``tsdb.query(measurement, field, ...)`` and every
+  ``AlertRule(measurement=..., metric_field=...)`` with literals must
+  name a declared measurement and field;
+- declared measurements that no analyzed file emits are dead schema.
+
+Sites whose measurement name is not a literal (e.g. the recorder
+re-ingesting parsed lines) are skipped — the emitting site was already
+checked.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Finding, SourceFile, dotted_tail, iter_functions
+
+CHECK = "metrics-schema"
+
+SCHEMA_SUFFIX = "metrics/schema.py"
+DOCS_PATH = os.path.join("docs", "metrics-schema.md")
+
+
+# -- schema parsing --------------------------------------------------------
+
+def _const_str_tuple(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    if isinstance(node, (ast.Tuple, ast.List)) and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, str)
+            for e in node.elts):
+        return tuple(e.value for e in node.elts)
+    return None
+
+
+def parse_schema(sf: SourceFile) -> Optional[Dict[str, Dict[str, tuple]]]:
+    for node in sf.tree.body:
+        if not isinstance(node, ast.Assign) or not node.targets:
+            continue
+        t = node.targets[0]
+        if not isinstance(t, ast.Name) or t.id != "METRICS_SCHEMA":
+            continue
+        if not isinstance(node.value, ast.Dict):
+            return None
+        schema: Dict[str, Dict[str, tuple]] = {}
+        for k, v in zip(node.value.keys, node.value.values):
+            if not (isinstance(k, ast.Constant) and isinstance(v, ast.Dict)):
+                return None
+            entry: Dict[str, tuple] = {}
+            for ek, ev in zip(v.keys, v.values):
+                if isinstance(ek, ast.Constant):
+                    vals = _const_str_tuple(ev)
+                    if vals is not None:
+                        entry[ek.value] = vals
+            schema[k.value] = entry
+        return schema
+    return None
+
+
+# -- emit-site key resolution ---------------------------------------------
+
+class _Resolver:
+    """Static tag/field-dict key resolution within one function."""
+
+    def __init__(self, fn: ast.AST):
+        #: name -> [(lineno, value-node-or-None)], lineno-sorted
+        self.bindings: Dict[str, List[Tuple[int, Optional[ast.AST]]]] = {}
+        #: name -> [(lineno, key)] for name["key"] = ... adds
+        self.sub_adds: Dict[str, List[Tuple[int, str]]] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    self._bind_target(t, node.value, node.lineno)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                self._bind_target(node.target, None, node.lineno)
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if item.optional_vars is not None:
+                        self._bind_target(item.optional_vars, None,
+                                          node.lineno)
+        for name in self.bindings:
+            self.bindings[name].sort()
+
+    def _bind_target(self, target: ast.AST, value: Optional[ast.AST],
+                     lineno: int) -> None:
+        if isinstance(target, ast.Name):
+            self.bindings.setdefault(target.id, []).append((lineno, value))
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._bind_target(e, None, lineno)
+        elif isinstance(target, ast.Subscript) and \
+                isinstance(target.value, ast.Name) and \
+                isinstance(target.slice, ast.Constant) and \
+                isinstance(target.slice.value, str):
+            self.sub_adds.setdefault(target.value.id, []).append(
+                (lineno, target.slice.value))
+
+    def keys_of(self, node: ast.AST, at_line: int, depth: int = 0
+                ) -> Tuple[Set[str], Set[str], bool]:
+        """(static_keys, conditional_keys, complete) for a tags/fields
+        argument expression."""
+        if depth > 4:
+            return set(), set(), False
+        if isinstance(node, ast.Dict):
+            static: Set[str] = set()
+            complete = True
+            for k in node.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    static.add(k.value)
+                else:
+                    complete = False    # **spread or computed key
+            return static, set(), complete
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id == "dict":
+            static = {kw.arg for kw in node.keywords if kw.arg}
+            complete = not any(kw.arg is None for kw in node.keywords)
+            cond: Set[str] = set()
+            if node.args:
+                if len(node.args) == 1:
+                    s, c, ok = self.keys_of(node.args[0], at_line,
+                                            depth + 1)
+                    static |= s
+                    cond |= c
+                    complete = complete and ok
+                else:
+                    complete = False
+            return static, cond, complete
+        if isinstance(node, ast.Name):
+            chosen: Tuple[int, Optional[ast.AST]] = (0, None)
+            found = False
+            for lineno, value in self.bindings.get(node.id, ()):
+                if lineno <= at_line and lineno >= chosen[0]:
+                    chosen = (lineno, value)
+                    found = True
+            if not found or chosen[1] is None:
+                return set(), set(), False
+            if isinstance(chosen[1], ast.Name) and \
+                    chosen[1].id == node.id:
+                return set(), set(), False      # self-referential rebind
+            static, cond, complete = self.keys_of(chosen[1], chosen[0],
+                                                  depth + 1)
+            cond |= {k for lineno, k in self.sub_adds.get(node.id, ())
+                     if chosen[0] <= lineno <= at_line}
+            return static, cond, complete
+        return set(), set(), False
+
+
+# -- checker ---------------------------------------------------------------
+
+def _emit_sites(sf: SourceFile):
+    """Yield (call, measurement, tags_node, fields_node, symbol, fn).
+
+    Innermost functions are scanned first so each call is attributed to
+    (and resolved within) its tightest enclosing scope; the module tree
+    comes last as the catch-all."""
+    contexts = list(iter_functions(sf.tree))[::-1]
+    contexts.append(("<module>", sf.tree))
+    seen_calls = set()
+    for symbol, fn in contexts:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and id(node) not in seen_calls:
+                fname = dotted_tail(node.func)
+                is_insert = (fname == "insert"
+                             and isinstance(node.func, ast.Attribute)
+                             and dotted_tail(node.func.value) == "tsdb")
+                if fname != "encode_line" and not is_insert:
+                    continue
+                if len(node.args) < 3:
+                    continue
+                m = node.args[0]
+                if not (isinstance(m, ast.Constant)
+                        and isinstance(m.value, str)):
+                    continue
+                seen_calls.add(id(node))
+                yield (node, m.value, node.args[1], node.args[2],
+                       symbol, fn)
+
+
+def _consumer_sites(sf: SourceFile):
+    """(node, measurement, field) for tsdb.query(...) and
+    AlertRule(measurement=..., metric_field=...) literals."""
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = dotted_tail(node.func)
+        if fname == "query" and isinstance(node.func, ast.Attribute) \
+                and dotted_tail(node.func.value) == "tsdb" \
+                and len(node.args) >= 2:
+            m, f = node.args[0], node.args[1]
+            if isinstance(m, ast.Constant) and isinstance(f, ast.Constant):
+                yield node, m.value, f.value
+        elif fname == "AlertRule":
+            kws = {kw.arg: kw.value for kw in node.keywords}
+            m, f = kws.get("measurement"), kws.get("metric_field")
+            if isinstance(m, ast.Constant) and isinstance(f, ast.Constant):
+                yield node, m.value, f.value
+
+
+def run_project(files: Dict[str, SourceFile], repo_root: str
+                ) -> List[Finding]:
+    schema_sf = None
+    for rel, sf in files.items():
+        if rel.endswith(SCHEMA_SUFFIX):
+            schema_sf = sf
+            break
+    if schema_sf is None:
+        return []
+    schema = parse_schema(schema_sf)
+    findings: List[Finding] = []
+    if schema is None:
+        findings.append(Finding(
+            check=CHECK, path=schema_sf.relpath, line=1,
+            symbol="<module>", key="METRICS_SCHEMA",
+            message="metrics/schema.py must define METRICS_SCHEMA as a "
+                    "literal dict of {measurement: {'tags': (...), "
+                    "'opt_tags': (...), 'fields': (...)}}"))
+        return findings
+
+    emitted_by_measurement: Dict[str, bool] = {}    # name -> all complete
+    seen_measurements: Set[str] = set()
+
+    def check_keys(sf, node, measurement, kind, static, cond, complete,
+                   symbol):
+        entry = schema[measurement]
+        declared = set(entry.get(kind, ())) | \
+            set(entry.get(f"opt_{kind}", ()))
+        for key in sorted((static | cond) - declared):
+            findings.append(Finding(
+                check=CHECK, path=sf.relpath, line=node.lineno,
+                symbol=symbol, key=f"{measurement}.{key}",
+                message=(f"{measurement} emits {kind[:-1]} {key!r} not "
+                         f"declared in METRICS_SCHEMA — add it to the "
+                         f"schema (and docs/metrics-schema.md) or drop "
+                         f"the emit")))
+        if complete and kind == "tags":
+            required = set(entry.get("tags", ()))
+            for key in sorted(required - static):
+                findings.append(Finding(
+                    check=CHECK, path=sf.relpath, line=node.lineno,
+                    symbol=symbol, key=f"{measurement}.{key}",
+                    message=(f"{measurement} is missing required tag "
+                             f"{key!r} declared in METRICS_SCHEMA "
+                             f"(move it to opt_tags if legitimately "
+                             f"conditional)")))
+
+    for sf in files.values():
+        resolvers: Dict[int, _Resolver] = {}
+        for node, measurement, tags_node, fields_node, symbol, fn in \
+                _emit_sites(sf):
+            seen_measurements.add(measurement)
+            if measurement not in schema:
+                findings.append(Finding(
+                    check=CHECK, path=sf.relpath, line=node.lineno,
+                    symbol=symbol, key=measurement,
+                    message=(f"measurement {measurement!r} is not "
+                             f"declared in metrics/schema.py "
+                             f"METRICS_SCHEMA")))
+                continue
+            resolver = resolvers.get(id(fn))
+            if resolver is None:
+                resolver = resolvers[id(fn)] = _Resolver(fn)
+            all_complete = True
+            for kind, arg in (("tags", tags_node), ("fields", fields_node)):
+                static, cond, complete = resolver.keys_of(arg, node.lineno)
+                all_complete = all_complete and complete
+                check_keys(sf, node, measurement, kind, static, cond,
+                           complete, symbol)
+            emitted_by_measurement[measurement] = \
+                emitted_by_measurement.get(measurement, True) and \
+                all_complete
+
+        for node, measurement, fieldname in _consumer_sites(sf):
+            if measurement not in schema:
+                findings.append(Finding(
+                    check=CHECK, path=sf.relpath, line=node.lineno,
+                    symbol="<consumer>", key=measurement,
+                    message=(f"query/alert references measurement "
+                             f"{measurement!r} not declared in "
+                             f"METRICS_SCHEMA")))
+            elif fieldname not in schema[measurement].get("fields", ()) \
+                    and fieldname not in \
+                    schema[measurement].get("opt_fields", ()):
+                findings.append(Finding(
+                    check=CHECK, path=sf.relpath, line=node.lineno,
+                    symbol="<consumer>", key=f"{measurement}.{fieldname}",
+                    message=(f"query/alert reads field {fieldname!r} of "
+                             f"{measurement!r} which METRICS_SCHEMA does "
+                             f"not declare — the series would be "
+                             f"silently empty")))
+
+    for measurement in sorted(set(schema) - seen_measurements):
+        findings.append(Finding(
+            check=CHECK, path=schema_sf.relpath,
+            line=_schema_line(schema_sf, measurement),
+            symbol="METRICS_SCHEMA", key=measurement,
+            message=(f"measurement {measurement!r} is declared in "
+                     f"METRICS_SCHEMA but no analyzed file emits it — "
+                     f"dead schema entry")))
+
+    docs = os.path.join(repo_root, DOCS_PATH)
+    if os.path.exists(docs):
+        with open(docs, encoding="utf-8") as f:
+            doc_text = f.read()
+        for measurement in sorted(schema):
+            if measurement not in doc_text:
+                findings.append(Finding(
+                    check=CHECK, path=schema_sf.relpath,
+                    line=_schema_line(schema_sf, measurement),
+                    symbol="METRICS_SCHEMA", key=f"docs:{measurement}",
+                    message=(f"measurement {measurement!r} is not "
+                             f"documented in docs/metrics-schema.md")))
+    else:
+        findings.append(Finding(
+            check=CHECK, path=schema_sf.relpath, line=1,
+            symbol="METRICS_SCHEMA", key="docs-missing",
+            message=f"{DOCS_PATH} is missing — the schema registry must "
+                    f"be documented (one row per measurement)"))
+    return findings
+
+
+def _schema_line(sf: SourceFile, measurement: str) -> int:
+    needle = f'"{measurement}"'
+    for i, line in enumerate(sf.lines, start=1):
+        if needle in line:
+            return i
+    return 1

@@ -1,0 +1,262 @@
+"""protocol-exhaustive: the remoting wire protocol cannot half-land.
+
+Protocol v3 shipped with UNIMPLEMENTED slots that had to be hand-audited
+(docs/pjrt-remote-coverage.md); v4 added error codes that only exist if
+three files agree.  This checker makes the registry in
+``remoting/protocol.py`` (``REQUEST_KINDS`` / ``REPLY_KINDS`` /
+``ERROR_CODES`` / ``CLIENT_OPTIONAL_KINDS``) the single source of truth
+and verifies, purely statically:
+
+- every declared request kind is dispatched in ``remoting/worker.py``
+  (a ``kind == "X"`` / ``kind in (...)`` comparison) and sent by
+  ``remoting/client.py`` (``_rpc``/``_submit``/``send_message`` literal)
+  unless listed in ``CLIENT_OPTIONAL_KINDS`` (native-client-only kinds);
+- every kind the worker compares against is declared (a new opcode
+  cannot be wired in without registering it);
+- every reply kind the worker emits (``reply(...)``/``_safe_reply``)
+  is declared, every declared reply kind is emitted, and every reply
+  kind the client matches on is declared;
+- every structured error ``code`` emitted (worker + dispatch) is
+  declared, every declared code is emitted somewhere, and every code
+  the client matches on is declared.
+
+Fixture trees satisfy the same contract by carrying files whose paths
+end in ``remoting/protocol.py`` etc.; when no protocol module is in the
+analyzed set the checker is silent (linting an unrelated subtree).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Finding, SourceFile
+
+CHECK = "protocol-exhaustive"
+
+PROTOCOL_SUFFIX = "remoting/protocol.py"
+WORKER_SUFFIX = "remoting/worker.py"
+CLIENT_SUFFIX = "remoting/client.py"
+DISPATCH_SUFFIX = "remoting/dispatch.py"
+
+_KIND_VARS = {"kind", "rkind"}
+_SEND_METHODS = {"_rpc", "_submit"}
+
+
+def _find(files: Dict[str, SourceFile], suffix: str
+          ) -> Optional[SourceFile]:
+    for rel, sf in files.items():
+        if rel.endswith(suffix):
+            return sf
+    return None
+
+
+def _module_tuples(tree: ast.AST) -> Dict[str, Tuple[str, ...]]:
+    """Module-level ``NAME = ("A", "B", ...)`` string tuples."""
+    out: Dict[str, Tuple[str, ...]] = {}
+    for node in tree.body:           # type: ignore[attr-defined]
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        if not isinstance(target, ast.Name):
+            continue
+        if isinstance(node.value, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in node.value.elts):
+            out[target.id] = tuple(e.value for e in node.value.elts)
+    return out
+
+
+def _registry_line(sf: SourceFile, name: str) -> int:
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign) and node.targets and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == name:
+            return node.lineno
+    return 1
+
+
+def _compared_kinds(sf: SourceFile,
+                    module_tuples: Dict[str, Tuple[str, ...]]
+                    ) -> Set[str]:
+    """String constants compared against a ``kind``/``rkind`` variable."""
+    out: Set[str] = set()
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        sides = [node.left] + list(node.comparators)
+        if not any(isinstance(s, ast.Name) and s.id in _KIND_VARS
+                   for s in sides):
+            continue
+        for s in sides:
+            if isinstance(s, ast.Constant) and isinstance(s.value, str):
+                out.add(s.value)
+            elif isinstance(s, (ast.Tuple, ast.List, ast.Set)):
+                out.update(e.value for e in s.elts
+                           if isinstance(e, ast.Constant)
+                           and isinstance(e.value, str))
+            elif isinstance(s, ast.Name) and s.id in module_tuples:
+                out.update(module_tuples[s.id])
+    return out
+
+
+def _emitted_replies(sf: SourceFile) -> Set[str]:
+    """First string arg of reply(...)/_safe_reply(item, ...) calls."""
+    out: Set[str] = set()
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = node.func.attr if isinstance(node.func, ast.Attribute) \
+            else node.func.id if isinstance(node.func, ast.Name) else ""
+        if fname == "reply" and node.args:
+            arg = node.args[0]
+        elif fname == "_safe_reply" and len(node.args) >= 2:
+            arg = node.args[1]
+        else:
+            continue
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            out.add(arg.value)
+    return out
+
+
+def _sent_kinds(sf: SourceFile) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = node.func.attr if isinstance(node.func, ast.Attribute) \
+            else node.func.id if isinstance(node.func, ast.Name) else ""
+        arg = None
+        if fname in _SEND_METHODS and node.args:
+            arg = node.args[0]
+        elif fname == "send_message" and len(node.args) >= 2:
+            arg = node.args[1]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            out.add(arg.value)
+    return out
+
+
+def _emitted_codes(sf: SourceFile) -> Set[str]:
+    """Values of ``"code": <const>`` entries in dict literals."""
+    out: Set[str] = set()
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        for k, v in zip(node.keys, node.values):
+            if isinstance(k, ast.Constant) and k.value == "code" and \
+                    isinstance(v, ast.Constant) and \
+                    isinstance(v.value, str):
+                out.add(v.value)
+    return out
+
+
+def _compared_codes(sf: SourceFile) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        sides = [node.left] + list(node.comparators)
+        if not any(isinstance(s, ast.Name) and s.id == "code"
+                   for s in sides):
+            continue
+        out.update(s.value for s in sides
+                   if isinstance(s, ast.Constant)
+                   and isinstance(s.value, str))
+    return out
+
+
+def run_project(files: Dict[str, SourceFile], repo_root: str
+                ) -> List[Finding]:
+    proto = _find(files, PROTOCOL_SUFFIX)
+    if proto is None:
+        return []
+    worker = _find(files, WORKER_SUFFIX)
+    client = _find(files, CLIENT_SUFFIX)
+    dispatch = _find(files, DISPATCH_SUFFIX)
+
+    tuples = _module_tuples(proto.tree)
+    findings: List[Finding] = []
+
+    def missing_registry(name: str) -> None:
+        findings.append(Finding(
+            check=CHECK, path=proto.relpath, line=1,
+            symbol="<module>", key=name,
+            message=(f"remoting/protocol.py must declare {name} as a "
+                     f"module-level tuple of string literals — it is "
+                     f"the registry this checker verifies worker/client "
+                     f"coverage against")))
+
+    for reg in ("REQUEST_KINDS", "REPLY_KINDS", "ERROR_CODES"):
+        if reg not in tuples:
+            missing_registry(reg)
+    if findings:
+        return findings
+
+    requests = set(tuples["REQUEST_KINDS"])
+    replies = set(tuples["REPLY_KINDS"])
+    codes = set(tuples["ERROR_CODES"])
+    client_optional = set(tuples.get("CLIENT_OPTIONAL_KINDS", ()))
+
+    def fnd(sf: SourceFile, reg: str, key: str, msg: str) -> None:
+        findings.append(Finding(
+            check=CHECK, path=sf.relpath, line=_registry_line(sf, reg),
+            symbol=reg, key=key, message=msg))
+
+    if worker is not None:
+        worker_tuples = _module_tuples(worker.tree)
+        handled = _compared_kinds(worker, worker_tuples)
+        for kind in sorted(requests - handled):
+            fnd(proto, "REQUEST_KINDS", kind,
+                f"request kind {kind!r} is declared in REQUEST_KINDS but "
+                f"never dispatched in remoting/worker.py (no `kind == "
+                f"{kind!r}` comparison) — the opcode half-landed")
+        for kind in sorted(handled - requests - replies):
+            fnd(proto, "REQUEST_KINDS", kind,
+                f"remoting/worker.py dispatches on kind {kind!r} which "
+                f"is not declared in protocol.REQUEST_KINDS — register "
+                f"it so client coverage is enforced")
+        emitted = _emitted_replies(worker)
+        for kind in sorted(emitted - replies):
+            fnd(proto, "REPLY_KINDS", kind,
+                f"remoting/worker.py emits reply kind {kind!r} which is "
+                f"not declared in protocol.REPLY_KINDS")
+        for kind in sorted(replies - emitted):
+            fnd(proto, "REPLY_KINDS", kind,
+                f"reply kind {kind!r} is declared in REPLY_KINDS but "
+                f"remoting/worker.py never emits it — dead registry "
+                f"entry or missing handler")
+
+    if client is not None:
+        sent = _sent_kinds(client)
+        for kind in sorted(requests - sent - client_optional):
+            fnd(proto, "REQUEST_KINDS", kind,
+                f"request kind {kind!r} is declared in REQUEST_KINDS but "
+                f"remoting/client.py never sends it (add it to "
+                f"CLIENT_OPTIONAL_KINDS if only native clients use it)")
+        client_tuples = _module_tuples(client.tree)
+        for kind in sorted(_compared_kinds(client, client_tuples)
+                           - replies):
+            fnd(proto, "REPLY_KINDS", kind,
+                f"remoting/client.py matches reply kind {kind!r} which "
+                f"is not declared in protocol.REPLY_KINDS")
+        for code in sorted(_compared_codes(client) - codes):
+            fnd(proto, "ERROR_CODES", code,
+                f"remoting/client.py handles error code {code!r} which "
+                f"is not declared in protocol.ERROR_CODES")
+
+    emitted_codes: Set[str] = set()
+    for sf in (worker, dispatch):
+        if sf is not None:
+            emitted_codes |= _emitted_codes(sf)
+    if worker is not None or dispatch is not None:
+        for code in sorted(emitted_codes - codes):
+            fnd(proto, "ERROR_CODES", code,
+                f"worker/dispatch emit error code {code!r} which is not "
+                f"declared in protocol.ERROR_CODES — clients cannot "
+                f"know to handle it")
+        for code in sorted(codes - emitted_codes):
+            fnd(proto, "ERROR_CODES", code,
+                f"error code {code!r} is declared in ERROR_CODES but "
+                f"never emitted by worker/dispatch — dead registry "
+                f"entry or missing emit site")
+    return findings

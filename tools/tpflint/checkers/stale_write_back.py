@@ -1,0 +1,191 @@
+"""stale-write-back: the PR-2 lost-update pattern, generalized.
+
+The two worst bugs shipped so far were controllers writing a pool object
+back via ``store.update(obj)`` after holding it across other store reads
+— last-writer-wins clobbering any concurrent spec update (the expander
+e2e flake that hid for three rounds).  The mechanical invariant: an
+object obtained from a store **read** in the same function must only be
+written back with ``check_version=True`` (optimistic concurrency), so a
+concurrent writer surfaces as ``ConflictError`` instead of silent loss.
+
+Tracked taint, per function, in statement order:
+
+- ``x = <store>.get(...)`` / ``try_get(...)``      -> x is store-read
+- ``xs = <store>.list(...)``; ``for x in xs:``     -> x is store-read
+  (also ``for x in <store>.list(...)`` and ``sorted/list/reversed(xs)``)
+- ``y = x`` propagates; any other reassignment clears.
+
+Flagged: ``<store>.update(x)`` / ``<store>.update(x, ...)`` without a
+``check_version=True`` keyword, where x is store-read.  A receiver is
+store-ish when its final component is ``store``/``_store``/``statestore``
+— ``dict.update`` and friends never match.  ``update_or_create`` is
+exempt (upsert semantics carry no stale version to check).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from ..core import Finding, SourceFile, dotted_tail, iter_functions
+
+CHECK = "stale-write-back"
+
+STORE_NAMES = {"store", "_store", "statestore", "remote_store"}
+READ_METHODS = {"get", "try_get"}
+LIST_METHODS = {"list"}
+ITER_WRAPPERS = {"sorted", "list", "reversed", "tuple"}
+
+
+def _is_store(node: ast.AST) -> bool:
+    return dotted_tail(node).lower() in STORE_NAMES
+
+
+def _store_call(node: ast.AST, methods: Set[str]) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in methods
+            and _is_store(node.func.value))
+
+
+def _has_check_version(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "check_version":
+            # any non-False value counts as checked (a variable means the
+            # author thought about it; only a literal False is a lie)
+            return not (isinstance(kw.value, ast.Constant)
+                        and kw.value.value is False)
+    return False
+
+
+class _FunctionScan:
+    """Order-sensitive walk of one function body."""
+
+    def __init__(self, sf: SourceFile, symbol: str):
+        self.sf = sf
+        self.symbol = symbol
+        self.tainted: Dict[str, int] = {}       # name -> read line
+        self.collections: Dict[str, int] = {}   # name -> list() line
+        self.findings: List[Finding] = []
+
+    # -- taint bookkeeping -------------------------------------------------
+
+    def _clear(self, name: str) -> None:
+        self.tainted.pop(name, None)
+        self.collections.pop(name, None)
+
+    def _assign(self, target: ast.AST, value: ast.AST) -> None:
+        if not isinstance(target, ast.Name):
+            return
+        name = target.id
+        if _store_call(value, READ_METHODS):
+            self._clear(name)
+            self.tainted[name] = value.lineno
+        elif _store_call(value, LIST_METHODS):
+            self._clear(name)
+            self.collections[name] = value.lineno
+        elif isinstance(value, ast.Name) and value.id in self.tainted:
+            self.tainted[name] = self.tainted[value.id]
+        elif (isinstance(value, ast.Subscript)
+              and isinstance(value.value, ast.Name)
+              and value.value.id in self.collections):
+            # chosen = workers[0]
+            self.tainted[name] = self.collections[value.value.id]
+        else:
+            self._clear(name)
+
+    def _iter_source_is_collection(self, it: ast.AST) -> bool:
+        if _store_call(it, LIST_METHODS):
+            return True
+        if isinstance(it, ast.Name) and it.id in self.collections:
+            return True
+        if (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id in ITER_WRAPPERS and it.args):
+            return self._iter_source_is_collection(it.args[0])
+        # sorted(xs, key=...)[n:] style slicing
+        if isinstance(it, ast.Subscript):
+            return self._iter_source_is_collection(it.value)
+        return False
+
+    # -- statement walk ----------------------------------------------------
+
+    def run(self, body: List[ast.stmt]) -> None:
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return      # separate scope, scanned separately
+        if isinstance(stmt, ast.Assign):
+            self._check_expr(stmt.value)
+            for t in stmt.targets:
+                self._assign(t, stmt.value)
+            return
+        if isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            if stmt.value is not None:
+                self._check_expr(stmt.value)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._check_expr(stmt.iter)
+            if isinstance(stmt.target, ast.Name) and \
+                    self._iter_source_is_collection(stmt.iter):
+                self.tainted[stmt.target.id] = stmt.lineno
+            for s in stmt.body + stmt.orelse:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.Expr):
+            self._check_expr(stmt.value)
+            return
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            self._check_expr(stmt.value)
+            return
+        # recurse into compound statements in source order
+        for field_name in ("test",):
+            val = getattr(stmt, field_name, None)
+            if isinstance(val, ast.expr):
+                self._check_expr(val)
+        for field_name in ("body", "orelse", "finalbody", "handlers"):
+            for s in getattr(stmt, field_name, ()):
+                if isinstance(s, ast.ExceptHandler):
+                    for inner in s.body:
+                        self._stmt(inner)
+                elif isinstance(s, ast.stmt):
+                    self._stmt(s)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._check_expr(item.context_expr)
+
+    def _check_expr(self, expr: ast.expr) -> None:
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            if not isinstance(node.func, ast.Attribute) or \
+                    node.func.attr != "update" or \
+                    not _is_store(node.func.value):
+                continue
+            if not node.args or not isinstance(node.args[0], ast.Name):
+                continue
+            name = node.args[0].id
+            if name not in self.tainted or _has_check_version(node):
+                continue
+            self.findings.append(Finding(
+                check=CHECK, path=self.sf.relpath, line=node.lineno,
+                symbol=self.symbol, key=name,
+                message=(f"store.update({name}) writes back an object "
+                         f"read from the store at line "
+                         f"{self.tainted[name]} without "
+                         f"check_version=True — a concurrent writer is "
+                         f"silently clobbered (the PR-2 lost-update "
+                         f"race); status-patch a fresh read with "
+                         f"check_version=True and handle "
+                         f"ConflictError")))
+
+
+def run_file(sf: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    for symbol, fn in iter_functions(sf.tree):
+        scan = _FunctionScan(sf, symbol)
+        scan.run(fn.body)
+        findings.extend(scan.findings)
+    return findings

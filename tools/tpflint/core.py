@@ -1,0 +1,221 @@
+"""tpflint runner: file model, suppressions, baseline ratchet.
+
+The moving parts every checker shares:
+
+- :class:`SourceFile` — parsed AST + the ``# tpflint: disable=`` map.
+- :class:`Finding` — one defect, with a line-insensitive fingerprint
+  (path + check + enclosing symbol + detail key) so the baseline file
+  survives unrelated edits above a finding.
+- :func:`run_paths` — collect files, run per-file and project checkers,
+  apply suppressions.
+- :func:`apply_baseline` — the ratchet: findings not in the baseline
+  fail; baseline entries that no longer fire fail too (they must be
+  deleted, keeping the debt list honest as it shrinks).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+#: ``# tpflint: disable=check-a,check-b`` (optionally followed by a
+#: justification after ``--``); ``disable-file=`` suppresses the whole file
+_DISABLE_RE = re.compile(
+    r"#\s*tpflint:\s*(disable|disable-file)=([\w*,-]+)")
+
+
+@dataclass
+class Finding:
+    check: str
+    path: str          # repo-relative, forward slashes
+    line: int
+    symbol: str        # "Class.method", "function", or "<module>"
+    message: str
+    key: str = ""      # stable detail token (variable/field/opcode name)
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.path}::{self.check}::{self.symbol}::{self.key}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.check}] {self.message}"
+                f"  ({self.symbol})")
+
+
+class SourceFile:
+    """One parsed python file plus its suppression map."""
+
+    def __init__(self, path: str, relpath: str, text: str):
+        self.path = path
+        self.relpath = relpath
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        #: line -> set of disabled check names ("*" = all)
+        self.disabled: Dict[int, Set[str]] = {}
+        self.file_disabled: Set[str] = set()
+        self._scan_disables()
+
+    @classmethod
+    def load(cls, path: str, repo_root: str) -> "SourceFile":
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        rel = os.path.relpath(path, repo_root).replace(os.sep, "/")
+        return cls(path, rel, text)
+
+    def _scan_disables(self) -> None:
+        for i, line in enumerate(self.lines, start=1):
+            m = _DISABLE_RE.search(line)
+            if not m:
+                continue
+            checks = {c.strip() for c in m.group(2).split(",") if c.strip()}
+            if m.group(1) == "disable-file":
+                self.file_disabled |= checks
+                continue
+            self.disabled.setdefault(i, set()).update(checks)
+            # a comment-only line applies to the next line too (the
+            # pylint convention for statements too long to share a line)
+            if line.lstrip().startswith("#"):
+                self.disabled.setdefault(i + 1, set()).update(checks)
+
+    def is_suppressed(self, finding: Finding) -> bool:
+        if finding.check in self.file_disabled or "*" in self.file_disabled:
+            return True
+        checks = self.disabled.get(finding.line, ())
+        return finding.check in checks or "*" in checks
+
+
+def qualname(stack: List[str]) -> str:
+    return ".".join(stack) if stack else "<module>"
+
+
+def iter_functions(tree: ast.AST):
+    """Yield (qualname, FunctionDef) for every function/method, with
+    class nesting reflected in the name."""
+    def walk(node, stack):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                yield from walk(child, stack + [child.name])
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield qualname(stack + [child.name]), child
+                yield from walk(child, stack + [child.name])
+            else:
+                yield from walk(child, stack)
+    yield from walk(tree, [])
+
+
+def dotted_tail(node: ast.AST) -> str:
+    """Last component of a Name / dotted Attribute ('' otherwise)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+# -- runner ----------------------------------------------------------------
+
+def collect_files(paths: Iterable[str], repo_root: str) -> List[SourceFile]:
+    out: List[SourceFile] = []
+    seen = set()
+    for p in paths:
+        p = os.path.join(repo_root, p) if not os.path.isabs(p) else p
+        if os.path.isfile(p) and p.endswith(".py"):
+            candidates = [p]
+        else:
+            candidates = []
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__"
+                               and not d.startswith(".")]
+                candidates.extend(os.path.join(dirpath, f)
+                                  for f in sorted(filenames)
+                                  if f.endswith(".py"))
+        for c in candidates:
+            if c in seen:
+                continue
+            seen.add(c)
+            out.append(SourceFile.load(c, repo_root))
+    return out
+
+
+def run_paths(paths: Iterable[str], repo_root: str,
+              checks: Optional[Set[str]] = None) -> List[Finding]:
+    """Run every registered checker over ``paths``; suppressions applied,
+    baseline NOT applied (that is the caller's policy step)."""
+    from .checkers import FILE_CHECKERS, PROJECT_CHECKERS
+
+    files = collect_files(paths, repo_root)
+    by_rel = {sf.relpath: sf for sf in files}
+    findings: List[Finding] = []
+    for sf in files:
+        for checker in FILE_CHECKERS:
+            if checks and checker.CHECK not in checks:
+                continue
+            findings.extend(checker.run_file(sf))
+    for checker in PROJECT_CHECKERS:
+        if checks and checker.CHECK not in checks:
+            continue
+        findings.extend(checker.run_project(by_rel, repo_root))
+    kept = []
+    for f in findings:
+        sf = by_rel.get(f.path)
+        if sf is not None and sf.is_suppressed(f):
+            continue
+        kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.check))
+    return kept
+
+
+# -- baseline ratchet ------------------------------------------------------
+
+def load_baseline(path: str) -> Dict[str, int]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return {str(k): int(v) for k, v in data.get("findings", {}).items()}
+
+
+def save_baseline(path: str, findings: List[Finding]) -> None:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.fingerprint] = counts.get(f.fingerprint, 0) + 1
+    payload = {
+        "_comment": [
+            "tpflint ratchet baseline: pre-existing findings tolerated by",
+            "`make lint`.  New findings FAIL; entries here that stop",
+            "firing FAIL too until removed (python -m tools.tpflint",
+            "--update-baseline).  The goal is an empty file: fix the",
+            "finding or move it to an inline justified",
+            "`# tpflint: disable=` instead of parking it here.",
+        ],
+        "findings": dict(sorted(counts.items())),
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+
+
+def apply_baseline(findings: List[Finding], baseline: Dict[str, int]
+                   ) -> Tuple[List[Finding], List[str]]:
+    """Split current findings into (new, stale-baseline-entries).
+
+    A fingerprint firing more often than its baselined count is new (the
+    excess occurrences are reported); one firing less often — or not at
+    all — leaves a stale entry the baseline must shed."""
+    current: Dict[str, List[Finding]] = {}
+    for f in findings:
+        current.setdefault(f.fingerprint, []).append(f)
+    new: List[Finding] = []
+    for fp, fs in current.items():
+        allowed = baseline.get(fp, 0)
+        if len(fs) > allowed:
+            new.extend(fs[allowed:])
+    stale = [fp for fp, n in sorted(baseline.items())
+             if len(current.get(fp, ())) < n]
+    return new, stale
