@@ -54,17 +54,20 @@ verify-repeat: native
 
 # Concurrency-stress gate: the dedicated race suites 5x — allocator/
 # recommender races, the remote worker's shared dispatch queue under
-# concurrent mixed-version tenants, and the historically raciest e2e
+# concurrent mixed-version tenants, the historically raciest e2e
 # (the expander capacity-miss flow, whose pool-spec-clobber race hid
-# behind "passed in isolation" for three rounds).  Cheaper than
-# verify-repeat (minutes, not an hour), meant to run on every change
-# to locking/queueing code.
+# behind "passed in isolation" for three rounds), and the watch-scale +
+# scheduler-cache smoke cell (shared-ring fan-out retention floor at
+# small N, cache/store coherence after multi-threaded churn — the PR-4
+# control-plane hot path).  Cheaper than verify-repeat (minutes, not an
+# hour), meant to run on every change to locking/queueing code.
 verify-stress:
 	@for i in 1 2 3 4 5; do \
 		echo "=== verify-stress round $$i/5 ==="; \
 		env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 			python -m pytest tests/test_races.py \
 			tests/test_remoting_dispatch.py \
+			tests/test_watch_semantics.py \
 			"tests/test_operator_e2e.py::test_e2e_expander_scales_from_capacity_miss" \
 			"tests/test_operator_e2e.py::test_pool_rollup_never_clobbers_concurrent_spec_update" \
 			-q -p no:cacheprovider -p no:xdist -p no:randomly \

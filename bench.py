@@ -63,6 +63,10 @@ STEPS = 28   # 7 interleaved rounds of 4: medians shrug off load spikes
 _CHILD_TIMEOUT = 420       # one benchmark attempt (incl. ~40s compile)
 _TPU_PROBES = 3            # tunnel liveness attempts spread over ~5 min
 _PROBE_GAP_S = 60.0
+#: probe deadline AFTER one probe already hung to its full deadline: a
+#: black-holed relay answers a 10s probe exactly as informatively as a
+#: 90s one, and 3 x 90s of hung probes was most of a bench budget
+_PROBE_RETRY_FAST_S = 10.0
 
 
 # -- parent: environment selection + deadlines ------------------------------
@@ -100,9 +104,10 @@ def main() -> int:
 
         alive = False
         probes = []
+        probe_timeout = None    # None = driver_guard's full deadline
         for i in range(_TPU_PROBES):
             driver_guard._probe_cache = None    # re-probe, don't memoize
-            probe = probe_backend()
+            probe = probe_backend(probe_timeout)
             probes.append({k: probe[k] for k in
                            ("alive", "rc", "duration_s", "hard_refusal")})
             if probe["alive"]:
@@ -110,6 +115,14 @@ def main() -> int:
                 break
             if probe["hard_refusal"]:
                 break
+            if probe["rc"] == 124:
+                # the probe HUNG to its full deadline (black-holed dial,
+                # not a slow accept): burning two more 90s deadlines
+                # cannot revive it within this run — re-ask on a short
+                # leash instead, so a relay that flaps back mid-run is
+                # still caught but a dead one costs seconds, not minutes
+                # (BENCH fallback.reason showed 3 x 90s spent here)
+                probe_timeout = _PROBE_RETRY_FAST_S
             if i < _TPU_PROBES - 1:
                 time.sleep(_PROBE_GAP_S)
         if alive:
@@ -136,8 +149,9 @@ def main() -> int:
             fallback = {
                 "reason": f"tpu tunnel dead: {len(probes)} liveness "
                           f"probes hung/failed "
-                          f"({driver_guard.PROBE_TIMEOUT:g}s deadline "
-                          f"each; TPF_BENCH_PROBE_DEADLINE_S tunes it)",
+                          f"({driver_guard.PROBE_TIMEOUT:g}s first "
+                          f"deadline, {_PROBE_RETRY_FAST_S:g}s after a "
+                          f"hang; TPF_BENCH_PROBE_DEADLINE_S tunes it)",
                 "probes": len(probes),
                 "probe_results": probes,
                 "wanted_platform": "tpu"}

@@ -11,6 +11,23 @@ import platform
 import subprocess
 
 
+def previous_artifact(name: str) -> dict:
+    """The currently checked-in record for ``name`` (before this run
+    overwrites it) — benchmarks embed it under ``previous`` so every
+    artifact carries its own before/after comparison."""
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    out_dir = pathlib.Path(os.environ.get("TPF_BENCH_RESULTS_DIR", "")
+                           or repo / "benchmarks" / "results")
+    path = out_dir / f"{name}.json"
+    try:
+        with open(path) as f:
+            prev = json.load(f)
+    except Exception:  # noqa: BLE001 - no/old record
+        return {}
+    prev.pop("previous", None)    # one level: don't chain histories
+    return prev
+
+
 def write_artifact(name: str, result: dict) -> pathlib.Path:
     repo = pathlib.Path(__file__).resolve().parent.parent
     # CI smoke variants must not clobber the checked-in full-run

@@ -112,6 +112,10 @@ def main() -> int:
     dt_persist = run_cycle(sched3, pods3, store=store)
     store.close()
 
+    try:
+        from benchmarks._artifact import previous_artifact, write_artifact
+    except ImportError:
+        from _artifact import previous_artifact, write_artifact
     result = {
         "benchmark": "scheduler_full_cycle",
         "nodes": args.nodes,
@@ -125,11 +129,13 @@ def main() -> int:
         "persist_delta_pct": round((dt_persist - dt_mem) / dt_mem * 100,
                                    1),
         "reference_pods_per_second": "400-500 (tensor-fusion, envtest, M4 Pro)",
+        # which control-plane machinery produced these numbers (the
+        # before/after under `previous` is meaningless without them)
+        "flags": {"batch_filter_score": True, "lazy_node_scores": True,
+                  "cached_lister": True, "cow_store": True,
+                  "journal_group_commit": True},
+        "previous": previous_artifact("sched"),
     }
-    try:
-        from benchmarks._artifact import write_artifact
-    except ImportError:
-        from _artifact import write_artifact
     write_artifact("sched", result)
     print(json.dumps(result))
     return 0

@@ -946,6 +946,7 @@ class TPUAllocator:
             obj = self.store.try_get(TPUChip, name)
             if obj is None:
                 continue
+            obj = obj.thaw()
             obj.status.available = avail
             obj.status.running_apps = holders
             try:

@@ -253,6 +253,7 @@ class QuotaStore:
                                      u.quota.metadata.name, ns)
             if obj is None:
                 continue
+            obj = obj.thaw()
             obj.status.used_requests = u.committed_requests
             obj.status.used_limits = u.committed_limits
             obj.status.used_workers = u.committed_workers

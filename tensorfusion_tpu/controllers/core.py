@@ -70,7 +70,7 @@ class ClusterController(Controller):
     def reconcile(self, event):
         if event is None or event.type == DELETED:
             return
-        cluster: TPUCluster = event.obj
+        cluster: TPUCluster = event.obj.thaw()
         ready = 0
         for i, pool_spec in enumerate(cluster.spec.pools):
             name = pool_spec.name or f"{cluster.name}-pool-{i}"
@@ -109,6 +109,7 @@ class PoolController(Controller):
         for chip in chips:
             by_pool.setdefault(chip.status.pool, []).append(chip)
         for pool in pools:
+            pool = pool.thaw()   # private copy: the rollup mutates status
             self.allocator.set_pool_oversell(
                 pool.name, pool.spec.capacity_config.tflops_oversell_percent)
             self.allocator.set_pool_hbm_expansion(
@@ -151,7 +152,7 @@ class PoolController(Controller):
                 # its only MODIFIED event, never reach the allocator.
                 # On conflict we simply skip: the competing write's own
                 # event re-triggers this reconcile with the new spec.
-                fresh = self.store.get(TPUPool, pool.name)
+                fresh = self.store.get(TPUPool, pool.name).thaw()
                 fresh.status = pool.status
                 self.store.update(fresh, check_version=True)
             except (NotFoundError, ConflictError):
@@ -195,6 +196,7 @@ class NodeController(Controller):
         for c in chips:
             by_node.setdefault(c.status.node_name, []).append(c)
         for tnode in self.store.list(TPUNode):
+            tnode = tnode.thaw()   # private copy: the rollup mutates status
             members = by_node.get(tnode.name, [])
             st = tnode.status
             st.total_chips = len(members)
@@ -218,7 +220,7 @@ class NodeController(Controller):
                 # label updates (hypervisor URL registration races this
                 # rollup).  On conflict, skip: the competing write's
                 # event (or the 10s resync) re-runs the rollup.
-                fresh = self.store.get(TPUNode, tnode.name)
+                fresh = self.store.get(TPUNode, tnode.name).thaw()
                 fresh.status = st
                 self.store.update(fresh, check_version=True)
             except (NotFoundError, ConflictError):
@@ -318,6 +320,7 @@ class WorkloadController(Controller):
             conn_counts[k] = conn_counts.get(k, 0) + 1
         dynamic_keys = set()
         for wl in self.store.list(TPUWorkload):
+            wl = wl.thaw()   # private copy: the rollup mutates status
             if wl.spec.is_local_tpu or wl.spec.embedded_worker:
                 continue  # client pod runs on the TPU node itself
             pods = self.store.list(
@@ -379,7 +382,7 @@ class WorkloadController(Controller):
                 # Conflict -> skip; the spec edit's own event re-runs
                 # this reconcile (and the 5s resync backstops it).
                 fresh = self.store.get(TPUWorkload, wl.metadata.name,
-                                       wl.metadata.namespace)
+                                       wl.metadata.namespace).thaw()
                 fresh.status = wl.status
                 self.store.update(fresh, check_version=True)
             except (NotFoundError, ConflictError):
@@ -459,7 +462,7 @@ class ConnectionController(Controller):
         competing write's event or the 2s resync re-runs reconcile."""
         try:
             fresh = self.store.get(TPUConnection, conn.metadata.name,
-                                   conn.metadata.namespace)
+                                   conn.metadata.namespace).thaw()
             fresh.status = conn.status
             self.store.update(fresh, check_version=True)
         except (NotFoundError, ConflictError):
@@ -467,6 +470,7 @@ class ConnectionController(Controller):
 
     def reconcile(self, event):
         for conn in self.store.list(TPUConnection):
+            conn = conn.thaw()   # private copy: reconcile mutates status
             if conn.status.phase == constants.PHASE_RUNNING and \
                     conn.status.worker_url:
                 # verify the worker still exists
@@ -544,7 +548,9 @@ class PodController(Controller):
         if event.type == ADDED and \
                 pod.spec.scheduler_name == constants.SCHEDULER_NAME and \
                 not pod.spec.node_name and self.scheduler is not None:
-            self.scheduler.enqueue(pod)
+            # the scheduling cycle mutates the pod (bind stamps
+            # annotations/spec) — hand it a private thawed copy
+            self.scheduler.enqueue(pod.thaw())
         # client pods that want a remote worker get a TPUConnection
         if event.type == ADDED and pod.metadata.annotations.get(
                 constants.ANN_WORKLOAD) and \
@@ -581,7 +587,7 @@ class NodeClaimController(Controller):
     def reconcile(self, event):
         if event is None or event.type == DELETED:
             return
-        claim: TPUNodeClaim = event.obj
+        claim: TPUNodeClaim = event.obj.thaw()
         if claim.status.phase in (constants.PHASE_RUNNING,
                                   constants.PHASE_FAILED):
             return

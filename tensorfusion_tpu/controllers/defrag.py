@@ -45,7 +45,8 @@ def _merge_exclusions(existing: str, node: str) -> str:
 def _clone_pod_spec(spec):
     """Replacement pods must keep every scheduling-relevant field of the
     original spec except the binding itself."""
-    return spec.__class__(
+    cls = getattr(type(spec), "_TPF_BASE", type(spec))
+    return cls(
         containers=spec.containers,
         init_containers=spec.init_containers,
         node_selector=dict(spec.node_selector),
@@ -126,6 +127,7 @@ class CompactionController(Controller):
             obj = self.store.try_get(kind, name, namespace or "")
             if obj is None:
                 return
+            obj = obj.thaw()
             if not mutate(obj):
                 return      # nothing to change on the fresh copy
             try:
@@ -204,15 +206,15 @@ class CompactionController(Controller):
             return changed
 
         for wl in self.store.list(TPUWorkload):
-            if clear_workload(wl):
+            if clear_workload(wl.thaw()):
                 self._update_fresh(TPUWorkload, wl.metadata.name,
                                    wl.metadata.namespace, clear_workload)
         for pod in self.store.list(Pod):
-            if clear_pod(pod):
+            if clear_pod(pod.thaw()):
                 self._update_fresh(Pod, pod.metadata.name,
                                    pod.metadata.namespace, clear_pod)
         for tnode in self.store.list(TPUNode):
-            if clear_node(tnode):
+            if clear_node(tnode.thaw()):
                 self._update_fresh(TPUNode, tnode.metadata.name,
                                    tnode.metadata.namespace, clear_node)
 

@@ -95,7 +95,7 @@ class RolloutController(Controller):
         every pool spec editor.  On conflict, skip: the competing
         write's event re-triggers reconcile."""
         try:
-            fresh = self.store.get(TPUPool, pool_name)
+            fresh = self.store.get(TPUPool, pool_name).thaw()
             fresh.status.component_status["worker"] = status
             self.store.update(fresh, check_version=True)
         except (NotFoundError, ConflictError):

@@ -114,6 +114,8 @@ class ControlPlaneBackend(Backend):
         tnode = self.store.try_get(TPUNode, self.node_name)
         if tnode is None:
             tnode = TPUNode.new(self.node_name)
+        else:
+            tnode = tnode.thaw()
         tnode.spec.pool = self.pool
         tnode.status.phase = constants.PHASE_RUNNING
         tnode.status.hypervisor_ready = True
@@ -157,6 +159,8 @@ class ControlPlaneBackend(Backend):
         created = chip is None
         if created:
             chip = TPUChip.new(info.chip_id)
+        else:
+            chip = chip.thaw()
         st = chip.status
         cap = ResourceAmount(tflops=info.peak_bf16_tflops,
                              duty_percent=100.0,

@@ -36,7 +36,7 @@ import urllib.parse
 import urllib.request
 from typing import Callable, Iterable, List, Optional, Type
 
-from .api.meta import Resource, from_dict
+from .api.meta import Resource, freeze_copy, from_dict
 from .gateway import KIND_BY_NAME
 from .store import (AlreadyExistsError, ConflictError, DELETED, Event,
                     NotFoundError)
@@ -143,7 +143,10 @@ class RemoteWatch:
                     continue
                 data = dict(ev["obj"])
                 data.pop("kind", None)
-                decoded.append((ev["type"], from_dict(cls, data)))
+                # frozen like in-process watch events: every consumer
+                # sees the same immutable-snapshot contract either way
+                decoded.append((ev["type"],
+                                freeze_copy(from_dict(cls, data))))
             if is_replay:
                 snapshot_keys = {(o.KIND, o.key()) for _, o in decoded}
                 for kind, bucket in self._known.items():
@@ -306,7 +309,9 @@ class RemoteStore:
             raise ValueError(f"unknown kind {kind!r} from gateway")
         d = dict(data)
         d.pop("kind", None)
-        return from_dict(cls, d)
+        # frozen for contract parity with ObjectStore: reads hand out
+        # immutable snapshots; writers thaw (docs/control-plane-scale.md)
+        return freeze_copy(from_dict(cls, d))
 
     # -- ObjectStore surface ----------------------------------------------
 

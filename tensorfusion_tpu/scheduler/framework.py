@@ -71,6 +71,15 @@ class FilterPlugin(Plugin):
     def filter(self, state: CycleState, pod: Pod, node: str) -> Status:
         return OK
 
+    def filter_batch(self, state: CycleState, pod: Pod,
+                     nodes) -> Optional[list]:
+        """Optional vectorized filter: return the feasible subset of
+        ``nodes`` (order preserved), or None to fall back to per-node
+        ``filter()`` calls.  The scheduler takes the batch path only
+        when EVERY registered FilterPlugin answers it — a plugin that
+        needs per-node context just returns None."""
+        return None
+
 
 class PostFilterPlugin(Plugin):
     def post_filter(self, state: CycleState, pod: Pod,
@@ -82,6 +91,13 @@ class PostFilterPlugin(Plugin):
 class ScorePlugin(Plugin):
     def score(self, state: CycleState, pod: Pod, node: str) -> float:
         return 0.0
+
+    def score_batch(self, state: CycleState, pod: Pod, nodes):
+        """Optional vectorized scoring: return a sequence of per-node
+        scores aligned with ``nodes``, the scalar 0.0 meaning "this
+        plugin contributes nothing this cycle" (saves building a zero
+        vector), or None to fall back to per-node ``score()`` calls."""
+        return None
 
 
 class ReservePlugin(Plugin):
@@ -294,32 +310,49 @@ class Scheduler:
             if not st.ok:
                 return self._post_filter_or_unsched(pod, state, st, {})
 
-        # Filter over all nodes (narrowed by PreFilterResult when provided).
-        # Like kube-scheduler's numFeasibleNodesToFind, stop once enough
-        # feasible nodes are found on large clusters.
+        # Filter over all nodes (narrowed by PreFilterResult when
+        # provided).  Two paths: when every FilterPlugin answers
+        # filter_batch, the whole set is narrowed in a few vectorized/
+        # set passes (no per-node plugin calls, no Status allocations —
+        # the 1000-node hot path); otherwise the per-node loop with the
+        # kube-style adaptive feasible cap.
         narrowed = state.get(STATE_PREFILTER_NODES)
-        nodes = list(narrowed) if narrowed is not None else self.nodes_fn()
+        if narrowed is None:
+            nodes = self.nodes_fn()
+        elif isinstance(narrowed, (list, tuple)):
+            nodes = narrowed    # identity preserved for batch alignment
+        else:
+            nodes = list(narrowed)
         # evaluate a preemptor's nominated node before everything else so
         # the adaptive feasible cap can never skip it (kube semantics)
         nominated = pod.status.nominated_node_name
         if nominated and nominated in nodes:
-            nodes.remove(nominated)
-            nodes.insert(0, nominated)
-        enough = self._num_feasible_to_find(len(nodes))
+            nodes = [nominated] + [n for n in nodes if n != nominated]
         statuses: Dict[str, Status] = {}
-        feasible: List[str] = []
         filter_plugins = self._of(FilterPlugin)
-        for node in nodes:
-            node_st = OK
-            for p in filter_plugins:
-                node_st = p.filter(state, pod, node)
-                if not node_st.ok:
-                    break
-            statuses[node] = node_st
-            if node_st.ok:
-                feasible.append(node)
-                if len(feasible) >= enough:
-                    break
+        feasible = nodes
+        for p in filter_plugins:
+            sub = p.filter_batch(state, pod, feasible)
+            if sub is None:
+                feasible = None
+                break
+            feasible = sub
+        if feasible is None:
+            # per-node fallback: stop once enough feasible nodes are
+            # found on large clusters (numFeasibleNodesToFind)
+            enough = self._num_feasible_to_find(len(nodes))
+            feasible = []
+            for node in nodes:
+                node_st = OK
+                for p in filter_plugins:
+                    node_st = p.filter(state, pod, node)
+                    if not node_st.ok:
+                        break
+                statuses[node] = node_st
+                if node_st.ok:
+                    feasible.append(node)
+                    if len(feasible) >= enough:
+                        break
 
         # PostFilter (preemption) when nothing fits
         if not feasible:
@@ -336,15 +369,7 @@ class Scheduler:
         if nominated and nominated in feasible:
             best = nominated
         else:
-            # Score
-            best, best_score = feasible[0], float("-inf")
-            score_plugins = self._of(ScorePlugin)
-            for node in feasible:
-                total = 0.0
-                for p in score_plugins:
-                    total += p.score(state, pod, node)
-                if total > best_score:
-                    best, best_score = node, total
+            best = self._pick_best(state, pod, feasible)
 
         # Reserve
         reserved: List[ReservePlugin] = []
@@ -387,6 +412,44 @@ class Scheduler:
             return Status(Code.WAIT)
 
         return self._bind(pod, state, best)
+
+    def _pick_best(self, state: CycleState, pod: Pod,
+                   feasible) -> str:
+        """Highest-scoring feasible node (first wins ties, matching the
+        legacy strictly-greater loop).  Batch when every ScorePlugin
+        answers score_batch; per-node otherwise."""
+        if len(feasible) == 1:
+            return feasible[0]
+        score_plugins = self._of(ScorePlugin)
+        totals = None
+        batched = True
+        for p in score_plugins:
+            vals = p.score_batch(state, pod, feasible)
+            if vals is None:
+                batched = False
+                break
+            if isinstance(vals, float) and vals == 0.0:
+                continue        # contributes nothing this cycle
+            if totals is None:
+                totals = vals
+            else:
+                totals = [a + b for a, b in zip(totals, vals)]
+        if batched:
+            if totals is None:
+                return feasible[0]      # all plugins abstained: any tie
+            argmax = getattr(totals, "argmax", None)
+            if argmax is not None:      # numpy: first max in C
+                return feasible[int(argmax())]
+            return feasible[max(range(len(feasible)),
+                                key=totals.__getitem__)]
+        best, best_score = feasible[0], float("-inf")
+        for node in feasible:
+            total = 0.0
+            for p in score_plugins:
+                total += p.score(state, pod, node)
+            if total > best_score:
+                best, best_score = node, total
+        return best
 
     # -- permit resolution ------------------------------------------------
 

@@ -282,6 +282,19 @@ class ICITopologyPlugin(PreFilterPlugin, ScorePlugin):
         base = plan.score if plan is not None else 0.0
         return base + self._slice_affinity(state, pod, node)
 
+    def score_batch(self, state: CycleState, pod: Pod, nodes):
+        """Zero-contribution fast path for the dominant single-chip /
+        no-gang cycle: no topology plans and no gang affinity means
+        every node scores 0.0 — skip the per-node calls entirely."""
+        plans = state.get(STATE_TOPO_PLANS)
+        gangish = (self.gang_slices is not None
+                   and self.node_slices is not None
+                   and pod.metadata.annotations.get(
+                       constants.ANN_GANG_GROUP_KEY, ""))
+        if not plans and not gangish:
+            return 0.0
+        return [self.score(state, pod, n) for n in nodes]
+
     def _slice_affinity(self, state: CycleState, pod: Pod,
                         node: str) -> float:
         """Multi-host gang members prefer nodes inside the ICI slice

@@ -182,7 +182,11 @@ class TPUResourcesFit(PreEnqueuePlugin, PreFilterPlugin, FilterPlugin,
             return Status(Code.UNSCHEDULABLE, str(e))
         state[STATE_CANDIDATES] = by_node
         state[STATE_NODE_SCORES] = self.allocator.score_nodes(req, by_node)
-        state[STATE_PREFILTER_NODES] = set(by_node)
+        # the CandidateMap's cached tuple keeps identity with the batch
+        # score path (NodeScores.aligned) — no per-cycle set build
+        state[STATE_PREFILTER_NODES] = (
+            by_node.eligible_nodes() if hasattr(by_node, "eligible_nodes")
+            else set(by_node))
         if not by_node:
             if not rejections:
                 # vectorized path carries no reasons; re-run explained
@@ -209,6 +213,31 @@ class TPUResourcesFit(PreEnqueuePlugin, PreFilterPlugin, FilterPlugin,
             return Status(Code.UNSCHEDULABLE,
                           f"no topology plan for {node}")
         return self._check_nominations(pod, req, node)
+
+    def filter_batch(self, state: CycleState, pod: Pod, nodes):
+        """Vectorized Filter: candidate-map membership + topology-plan
+        membership in one pass, no per-node Status objects.  Falls back
+        to per-node filter() (None) while preemption nominations are
+        outstanding — those need the per-node virtual-hold dry run."""
+        if self._nominations:
+            return None     # rare: preemption window
+        req = state.get(STATE_ALLOC_REQUEST)
+        if req is None:
+            return list(nodes) if not isinstance(nodes, (list, tuple)) \
+                else nodes
+        by_node = state.get(STATE_CANDIDATES, {})
+        plans = state.get(STATE_TOPO_PLANS)
+        need_plan = plans is not None and req.chip_count > 1
+        eligible = getattr(by_node, "eligible_nodes", None)
+        if eligible is not None and nodes is eligible():
+            # nodes IS this cycle's eligible tuple (the common case):
+            # membership is a given, only the plan check remains
+            if not need_plan:
+                return nodes
+            return [n for n in nodes if n in plans]
+        if need_plan:
+            return [n for n in nodes if n in by_node and n in plans]
+        return [n for n in nodes if n in by_node]
 
     def _check_nominations(self, pod: Pod, req: AllocRequest,
                            node: str) -> Status:
@@ -323,6 +352,17 @@ class TPUResourcesFit(PreEnqueuePlugin, PreFilterPlugin, FilterPlugin,
     def score(self, state: CycleState, pod: Pod, node: str) -> float:
         scores = state.get(STATE_NODE_SCORES) or {}
         return scores.get(node, 0.0)
+
+    def score_batch(self, state: CycleState, pod: Pod, nodes):
+        scores = state.get(STATE_NODE_SCORES)
+        if not scores:
+            return 0.0
+        aligned = getattr(scores, "aligned", None)
+        if aligned is not None:
+            dense = aligned(nodes)
+            if dense is not None:   # zero-copy: nodes is the eligible tuple
+                return dense
+        return [scores.get(n, 0.0) for n in nodes]
 
     # -- Reserve ----------------------------------------------------------
 

@@ -7,37 +7,78 @@ self-hosted, so a thread-safe store with optimistic concurrency and watch
 queues provides the same contract: create/get/update/delete/list + ADDED/
 MODIFIED/DELETED events that drive reconcile loops).
 
+Copy-on-write snapshots (docs/control-plane-scale.md): every write builds
+ONE deeply frozen copy of the object; ``get``/``list``/watch events all
+share that snapshot at zero cost instead of deep-copying per consumer.
+Mutating a snapshot raises
+:class:`~tensorfusion_tpu.api.meta.FrozenResourceError` — writers take a
+private copy with ``.thaw()`` or go through :func:`mutate`.  The
+``frozen-view-mutation`` tpflint checker enforces the discipline
+statically.
+
+Event fan-out is a shared sequenced ring: a write appends one immutable
+record and notifies; each :class:`Watch` is a *cursor* over the ring that
+pulls events in its consumer's own thread (delivery happens outside the
+store lock).  A slow watcher's backlog is conflated to the newest event
+per object (bounded delivery), and one that falls off the ring resyncs
+informer-style (synthetic DELETED for vanished objects + ADDED replay).
+The same ring backs remote long-poll watches with per-event cached
+serialization (the apiserver's cached-serialization trick).
+
 Optionally persists every kind to a JSON-lines file so a restarted
 control plane can rebuild (restart recovery is then exercised the same
 way the reference rebuilds allocator state from annotations,
 gpuallocator.go:2592).  Persistence is an **append-only journal with
-periodic compaction**: each write appends one ``{"op": "put"|"del",
-"obj": ...}`` line; once the journal grows past a few times the live
-object count, it is rewritten as a plain snapshot.  A flat
-rewrite-the-kind-on-every-update scheme measured O(objects) write
-amplification per bind at the 10k-pod scheduler-bench scale.
+periodic compaction and group commit**: writes buffer journal entries
+under the lock, and a burst is encoded + flushed in one batch off the
+critical section (one ``write()``+``flush()`` per burst instead of per
+write).  The loss window on a crash is bounded by
+``JOURNAL_GROUP_LATENCY_S`` (the journal was never fsync-durable — a
+torn tail was always tolerated at load).
 """
 
 from __future__ import annotations
 
 import collections
 import json
+import logging
 import os
-import queue
 import threading
-from dataclasses import dataclass
+from time import monotonic as _monotonic
+from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterable, List, Optional, Tuple, Type
 
-from .api.meta import Resource, from_dict
+from .api.meta import (FrozenResourceError, Resource, freeze_copy,
+                       from_dict, is_frozen, sparse_dict)
 
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
 
-#: bounded history backing remote long-poll watches; at control-plane
-#: event rates (binds, status writebacks) this covers hours of history —
-#: a client further behind than this gets a ``reset`` and re-lists
+log = logging.getLogger("tpf.store")
+
+#: bounded history backing both in-process watch cursors and remote
+#: long-poll watches; at control-plane event rates (binds, status
+#: writebacks) this covers hours of history — a consumer further behind
+#: than this resyncs (in-process) or gets ``reset`` and re-lists (remote)
 EVENT_LOG_SIZE = 65536
+#: ring trim granularity (amortizes the list slice-delete)
+_RING_TRIM = 4096
+#: a watcher with more than this many pending events gets its backlog
+#: conflated to the newest event per object even without ``conflate=True``
+#: (bounded slow-watcher delivery; reconcile-style consumers only ever
+#: need latest state per key)
+WATCH_CONFLATE_BACKLOG = 4096
+#: max ring records examined per Watch.get() fill (keeps one get() call
+#: from stalling on a giant backlog; conflation uses the full backlog)
+_WATCH_FILL_BATCH = 2048
+
+#: journal group-commit: a kind's pending entries are flushed by the
+#: writer once this many accumulate ...
+JOURNAL_GROUP_LINES = 128
+#: ... and by the background flusher at this cadence otherwise (this is
+#: also the crash loss window — see module docstring)
+JOURNAL_GROUP_LATENCY_S = 0.05
 
 
 class ConflictError(Exception):
@@ -56,35 +97,263 @@ class AlreadyExistsError(Exception):
 class Event:
     type: str
     obj: Resource
+    #: store resource version of this event (0 for replay/resync events)
+    rv: int = 0
+
+
+class _EventRecord:
+    """One ring entry: the frozen object plus lazily cached wire forms
+    (``to_dict`` once per event for remote windows, JSON fragment once
+    per event for the gateway's serialized fan-out)."""
+
+    __slots__ = ("rv", "etype", "kind", "obj", "dict", "json")
+
+    def __init__(self, rv: int, etype: str, obj: Resource):
+        self.rv = rv
+        self.etype = etype
+        self.kind = obj.KIND
+        self.obj = obj
+        self.dict: Optional[dict] = None
+        self.json: Optional[str] = None
+
+    def obj_dict(self) -> dict:
+        d = self.dict
+        if d is None:
+            d = self.dict = self.obj.to_dict()
+        return d
 
 
 class Watch:
-    """One subscriber's event stream (closeable iterator)."""
+    """One subscriber's event stream: a cursor over the store's shared
+    event ring (closeable iterator).
 
-    def __init__(self, store: "ObjectStore", kinds: Iterable[str]):
+    Events are pulled in the consumer's thread — the writer never does
+    per-watcher work.  All objects delivered are frozen shared snapshots.
+    A watcher that falls behind conflates its backlog (newest event per
+    object); one that falls off the bounded ring resyncs: synthetic
+    DELETED events for objects that vanished while it lagged, then the
+    current state as ADDED events (``resyncs`` counts these — the same
+    re-list contract RemoteWatch applies on 410-Gone resets).
+    """
+
+    def __init__(self, store: "ObjectStore", kinds: Iterable[str],
+                 conflate: bool = False):
         self._store = store
         self.kinds = set(kinds)
-        self.queue: "queue.Queue[Optional[Event]]" = queue.Queue()
+        self._conflate = conflate
         self._closed = False
+        #: absolute ring sequence of the next record to consider
+        self._pos = 0
+        #: ready-to-deliver events (replay/resync/conflated fills land here)
+        self._out: "collections.deque[Event]" = collections.deque()
+        #: (kind, key) -> last delivered snapshot (resync diff base)
+        self._known: Dict[tuple, Resource] = {}
+        #: times this watch fell off the ring and re-listed
+        self.resyncs = 0
 
     def stop(self) -> None:
-        if not self._closed:
+        with self._store._cond:
+            if self._closed:
+                return
             self._closed = True
-            self._store._remove_watch(self)
-            self.queue.put(None)
+            try:
+                self._store._watches.remove(self)
+            except ValueError:
+                pass
+            self._store._cond.notify_all()
 
     def __iter__(self):
         while True:
-            ev = self.queue.get()
+            ev = self.get()
             if ev is None:
                 return
             yield ev
 
     def get(self, timeout: Optional[float] = None) -> Optional[Event]:
-        try:
-            return self.queue.get(timeout=timeout)
-        except queue.Empty:
-            return None
+        """Next event; None on timeout or after stop().  Buffered events
+        are drained even after stop() (matching the old queue contract);
+        un-pulled ring history is dropped at stop."""
+        import time as _time
+        deadline = None if timeout is None \
+            else _time.monotonic() + max(0.0, timeout)
+        with self._store._cond:
+            while True:
+                if self._out:
+                    return self._out.popleft()
+                if self._closed:
+                    return None
+                self._fill_locked()
+                if self._out:
+                    return self._out.popleft()
+                if deadline is None:
+                    self._store._cond.wait(1.0)
+                else:
+                    remaining = deadline - _time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    self._store._cond.wait(min(remaining, 1.0))
+
+    # -- internal (store._cond held) ---------------------------------------
+
+    def _note(self, etype: str, obj: Resource) -> None:
+        k = (obj.KIND, obj.key())
+        if etype == DELETED:
+            self._known.pop(k, None)
+        else:
+            self._known[k] = obj
+
+    def _prime_locked(self, replay: bool) -> None:
+        store = self._store
+        self._pos = store._ring_base + len(store._ring)
+        if not replay:
+            return
+        for kind, bucket in store._objects.items():
+            if self.kinds and kind not in self.kinds:
+                continue
+            for obj in bucket.values():
+                self._known[(kind, obj.key())] = obj
+                self._out.append(Event(ADDED, obj))
+
+    def _fill_locked(self) -> None:
+        store = self._store
+        base = store._ring_base
+        if self._pos < base:
+            self._resync_locked()
+            return
+        ring = store._ring
+        i = self._pos - base
+        n = len(ring)
+        if i >= n:
+            return
+        if self._conflate or (n - i) > WATCH_CONFLATE_BACKLOG:
+            # a watcher further behind than the live object count is
+            # served cheaper by DIFFING STATE than by scanning its
+            # backlog: O(live + known) instead of O(backlog), and the
+            # shared frozen snapshots make change detection an identity
+            # check.  Same net-transition semantics as the scan below.
+            live = 0
+            if self.kinds:
+                for kind in self.kinds:
+                    live += len(store._objects.get(kind, ()))
+            else:
+                for bucket in store._objects.values():
+                    live += len(bucket)
+            if (n - i) > max(live, 8):
+                self._state_diff_locked()
+                return
+            # Conflate the backlog to NET transitions per object, judged
+            # against what this watch has already delivered (_known).
+            # Plain newest-per-key would be lossy for edge-triggered
+            # consumers: a delete+recreate under the same key would drop
+            # the DELETED (PodController would never dealloc), and a
+            # create+modify would drop the ADDED (the pod would never be
+            # enqueued).  Net semantics instead:
+            #   unknown -> newest non-DELETED   = ADDED (type coerced)
+            #   known   -> newest MODIFIED      = MODIFIED
+            #   known   -> deleted + recreated  = DELETED then ADDED
+            #   known   -> newest DELETED       = DELETED
+            #   unknown -> created + deleted    = nothing (net no-op)
+            newest: Dict[tuple, int] = {}
+            had_delete: set = set()
+            for j in range(i, n):
+                rec = ring[j]
+                if self.kinds and rec.kind not in self.kinds:
+                    continue
+                md = rec.obj.metadata
+                k = (rec.kind, md.namespace, md.name)
+                newest[k] = j
+                if rec.etype == DELETED:
+                    had_delete.add(k)
+            for j in sorted(newest.values()):
+                rec = ring[j]
+                md = rec.obj.metadata
+                k = (rec.kind, md.namespace, md.name)
+                kk = (rec.kind, rec.obj.key())
+                known = kk in self._known
+                if rec.etype == DELETED:
+                    if known:
+                        self._note(DELETED, rec.obj)
+                        self._out.append(Event(DELETED, rec.obj, rec.rv))
+                    continue
+                if known and k in had_delete:
+                    old = self._known[kk]
+                    self._note(DELETED, old)
+                    self._out.append(Event(DELETED, old, rec.rv))
+                    self._note(ADDED, rec.obj)
+                    self._out.append(Event(ADDED, rec.obj, rec.rv))
+                    continue
+                etype = MODIFIED if known else ADDED
+                self._note(etype, rec.obj)
+                self._out.append(Event(etype, rec.obj, rec.rv))
+            self._pos = base + n
+            return
+        end = min(n, i + _WATCH_FILL_BATCH)
+        while i < end:
+            rec = ring[i]
+            i += 1
+            if self.kinds and rec.kind not in self.kinds:
+                continue
+            self._note(rec.etype, rec.obj)
+            self._out.append(Event(rec.etype, rec.obj, rec.rv))
+        self._pos = base + i
+
+    def _state_diff_locked(self) -> None:
+        """Net-transition delivery by diffing current store state against
+        what this watch has delivered (_known).  Because every snapshot
+        is shared and frozen, ``old is not obj`` IS the modification
+        test, and a uid change under one key is a delete+recreate.
+        Cursor jumps to the ring head — the backlog is subsumed."""
+        store = self._store
+        self._pos = store._ring_base + len(store._ring)
+        current: Dict[tuple, Resource] = {}
+        for kind, bucket in store._objects.items():
+            if self.kinds and kind not in self.kinds:
+                continue
+            for obj in bucket.values():
+                current[(kind, obj.key())] = obj
+        for k, old in list(self._known.items()):
+            if k not in current:
+                del self._known[k]
+                self._out.append(Event(DELETED, old,
+                                       old.metadata.resource_version))
+        for k, obj in current.items():
+            old = self._known.get(k)
+            if old is obj:
+                continue                      # unchanged: same snapshot
+            rv = obj.metadata.resource_version
+            if old is None:
+                self._known[k] = obj
+                self._out.append(Event(ADDED, obj, rv))
+            elif old.metadata.uid and obj.metadata.uid and \
+                    old.metadata.uid != obj.metadata.uid:
+                self._known[k] = obj          # deleted + recreated
+                self._out.append(Event(DELETED, old, rv))
+                self._out.append(Event(ADDED, obj, rv))
+            else:
+                self._known[k] = obj
+                self._out.append(Event(MODIFIED, obj, rv))
+
+    def _resync_locked(self) -> None:
+        """Fell off the bounded ring: informer-style re-list.  Synthetic
+        DELETED for every object this watch knew that no longer exists,
+        then the current state as ADDED (duplicate ADDEDs for survivors —
+        the same contract replay watches and RemoteWatch resets have)."""
+        store = self._store
+        self._pos = store._ring_base + len(store._ring)
+        self.resyncs += 1
+        current: Dict[tuple, Resource] = {}
+        for kind, bucket in store._objects.items():
+            if self.kinds and kind not in self.kinds:
+                continue
+            for obj in bucket.values():
+                current[(kind, obj.key())] = obj
+        for k, obj in list(self._known.items()):
+            if k not in current:
+                del self._known[k]
+                self._out.append(Event(DELETED, obj))
+        for k, obj in current.items():
+            self._known[k] = obj
+            self._out.append(Event(ADDED, obj))
 
 
 def mutate(store, cls: Type["Resource"], name: str, mutate_fn,
@@ -92,10 +361,11 @@ def mutate(store, cls: Type["Resource"], name: str, mutate_fn,
     """Optimistic-concurrency read-modify-write against any store
     (ObjectStore or RemoteStore — same interface).
 
-    Re-reads the object fresh, applies ``mutate_fn(obj)``, and writes it
-    back with ``check_version=True``; on :class:`ConflictError` the
-    competing write wins the version and the loop re-reads and re-applies
-    — nothing is ever clobbered (the PR-2 lost-update fix, as a reusable
+    Re-reads the object fresh, thaws it into a private mutable copy,
+    applies ``mutate_fn(obj)``, and writes it back with
+    ``check_version=True``; on :class:`ConflictError` the competing
+    write wins the version and the loop re-reads and re-applies —
+    nothing is ever clobbered (the PR-2 lost-update fix, as a reusable
     primitive instead of a per-controller pattern).
 
     Returns the updated object; ``None`` when the object does not exist
@@ -110,6 +380,7 @@ def mutate(store, cls: Type["Resource"], name: str, mutate_fn,
         obj = store.try_get(cls, name, namespace)
         if obj is None:
             return None
+        obj = obj.thaw()     # store reads are frozen shared snapshots
         if mutate_fn(obj) is False:
             return obj
         try:
@@ -127,29 +398,50 @@ class ObjectStore:
         # the fields below (tpflint's guarded-by syntax lists both)
         self._cond = threading.Condition(self._lock)
         # guarded by: _lock, _cond
-        self._objects: Dict[str, Dict[str, Resource]] = {}   # kind -> key -> obj
+        self._objects: Dict[str, Dict[str, Resource]] = {}   # kind -> key -> frozen obj
         # guarded by: _lock, _cond
         self._watches: List[Watch] = []
         # guarded by: _lock, _cond
         self._rv = 0
-        # [rv, etype, kind, obj_dict, cached_json] ring for remote
-        # long-poll watches (the resourceVersion-windowed watch the k8s
-        # apiserver gives the reference's informers).  The 5th slot
-        # caches the serialized event fragment so N watchers cost ONE
-        # json.dumps per event, not N (the apiserver's cached-
-        # serialization trick; measured 2.4x write throughput at 50
-        # watchers in benchmarks/watch_scale.py)
+        # Shared event ring (one immutable _EventRecord per write): the
+        # single fan-out backbone for in-process watch cursors, remote
+        # long-poll windows (lazy to_dict per event) and the gateway's
+        # serialize-once fragments.  A plain list + base sequence so
+        # cursors index in O(1); trimmed in _RING_TRIM chunks.
         # guarded by: _lock, _cond
-        self._event_log: "collections.deque[list]" = \
-            collections.deque(maxlen=EVENT_LOG_SIZE)
+        self._ring: List[_EventRecord] = []
         # guarded by: _lock, _cond
-        self._log_enabled = False
+        self._ring_base = 0
+        # synchronous cache listeners (StoreCache): events queue under
+        # the lock and drain OUTSIDE it, in order, via a combiner
+        # guarded by: _lock, _cond
+        self._listeners: List[Callable[[Event], None]] = []
+        # guarded by: _lock, _cond
+        self._listener_pending: "collections.deque[Event]" = \
+            collections.deque()
+        # guarded by: _lock, _cond
+        self._listener_draining = False
         self._persist_dir = persist_dir
-        # kind -> (open append handle, journal line count)
+        # journal group-commit state.  pending entries are buffered under
+        # _lock and flushed in batches by whichever writer crosses
+        # JOURNAL_GROUP_LINES (outside _lock) or by the background
+        # flusher at JOURNAL_GROUP_LATENCY_S.  _journal_drain_lock
+        # serializes flushers (ordering); _journals/_journal_lines are
+        # only touched while holding it.
         # guarded by: _lock, _cond
+        self._journal_pending: Dict[str, list] = {}   # kind -> [(op, obj)]
+        # guarded by: _lock, _cond
+        self._journal_hot = False
+        # guarded by: _lock, _cond
+        self._journal_dirty = False
+        self._journal_last_flush = 0.0
+        self._journal_drain_lock = threading.Lock()
+        # kind -> open append handle / journal line count
+        # (flusher-only; serialized by _journal_drain_lock)
         self._journals: Dict[str, object] = {}
-        # guarded by: _lock, _cond
         self._journal_lines: Dict[str, int] = {}
+        self._journal_stop = threading.Event()
+        self._journal_thread: Optional[threading.Thread] = None
         if persist_dir:
             os.makedirs(persist_dir, exist_ok=True)
 
@@ -161,21 +453,87 @@ class ObjectStore:
     # tpflint: holds=_lock
     def _emit(self, etype: str, obj: Resource, rv: Optional[int] = None
               ) -> None:
-        for w in list(self._watches):
-            if not w.kinds or obj.KIND in w.kinds:
-                w.queue.put(Event(etype, obj.deepcopy()))
-        # the event log only costs anything once a remote consumer exists
-        # (gateway attach / first events_since); single-process
-        # deployments skip the per-write to_dict + ring append entirely
-        if self._log_enabled:
-            self._event_log.append([self._rv if rv is None else rv, etype,
-                                    obj.KIND, obj.to_dict(), None])
-            self._cond.notify_all()
+        """Append ONE immutable event record; all fan-out (in-process
+        cursors, cache listeners, remote windows) shares it.  O(1) —
+        no per-watcher copies, no eager serialization."""
+        rv = self._rv if rv is None else rv
+        self._ring.append(_EventRecord(rv, etype, obj))
+        if len(self._ring) >= EVENT_LOG_SIZE + _RING_TRIM:
+            drop = len(self._ring) - EVENT_LOG_SIZE
+            del self._ring[:drop]
+            self._ring_base += drop
+        if self._listeners:
+            self._listener_pending.append(Event(etype, obj, rv))
+        self._cond.notify_all()
 
     def _remove_watch(self, w: Watch) -> None:
         with self._lock:
             if w in self._watches:
                 self._watches.remove(w)
+
+    def _post_write(self) -> None:
+        """Write-path side effects that must not run under _lock:
+        ordered cache-listener delivery and journal group-commit."""
+        with self._lock:
+            notify = bool(self._listener_pending) or self._listener_draining
+            # an isolated write flushes immediately (durable before the
+            # caller returns, like the old per-write flush); writes
+            # inside a burst batch until JOURNAL_GROUP_LINES or the
+            # next latency tick — that's the group commit
+            flush = self._journal_hot or (
+                self._journal_dirty
+                and _monotonic() - self._journal_last_flush
+                >= JOURNAL_GROUP_LATENCY_S)
+            if flush:
+                self._journal_hot = False
+        if notify:
+            self._drain_listeners()
+        if flush:
+            self._flush_journal()
+
+    def _drain_listeners(self) -> None:
+        """Combiner: exactly one thread delivers pending listener events
+        at a time, in order, outside _lock.  A writer that finds another
+        thread draining returns immediately — the active drainer loops
+        until the queue is empty, so no event is stranded."""
+        while True:
+            with self._lock:
+                if self._listener_draining or not self._listener_pending:
+                    return
+                self._listener_draining = True
+                batch = list(self._listener_pending)
+                self._listener_pending.clear()
+                listeners = list(self._listeners)
+            try:
+                for ev in batch:
+                    for fn in listeners:
+                        try:
+                            fn(ev)
+                        except Exception:  # noqa: BLE001 - a cache bug
+                            # must not poison the write path
+                            log.exception("store listener failed")
+            finally:
+                with self._lock:
+                    self._listener_draining = False
+
+    def attach_listener(self, fn: Callable[[Event], None]
+                        ) -> List[Resource]:
+        """Register a synchronous event listener and return an atomic
+        snapshot of all current objects (frozen).  The listener sees
+        every event after the snapshot cut, in order, delivered in
+        writer threads outside the store lock (StoreCache's feed)."""
+        with self._lock:
+            snap = [obj for bucket in self._objects.values()
+                    for obj in bucket.values()]
+            self._listeners.append(fn)
+            return snap
+
+    def detach_listener(self, fn: Callable[[Event], None]) -> None:
+        with self._lock:
+            try:
+                self._listeners.remove(fn)
+            except ValueError:
+                pass
 
     @staticmethod
     def _content_equal(a: Resource, b: Resource) -> bool:
@@ -185,6 +543,19 @@ class ObjectStore:
             meta.pop("resource_version", None)
             meta.pop("generation", None)
         return da == db
+
+    def _stored_copy(self, obj: Resource, rv: int, generation: int
+                     ) -> Resource:
+        """Stamp version metadata and build the single frozen snapshot
+        this write shares with every reader."""
+        if is_frozen(obj):
+            # rare: a snapshot passed straight back (mutate() thaws, so
+            # this is a caller skipping the discipline with identical
+            # content) — thaw to stamp, then freeze
+            obj = obj.thaw()
+        obj.metadata.resource_version = rv
+        obj.metadata.generation = generation
+        return freeze_copy(obj)
 
     #: compaction threshold: journal may grow to this many times the live
     #: object count (floor of JOURNAL_MIN lines) before being rewritten
@@ -197,48 +568,126 @@ class ObjectStore:
     # tpflint: holds=_lock
     def _persist(self, kind: str, op: str = "put",
                  obj: Optional[Resource] = None) -> None:
-        """Append one journal entry (caller holds the lock); compact when
-        the journal has outgrown the live set."""
+        """Buffer one journal entry (group commit: encode + IO happen in
+        _flush_journal, off the critical section)."""
         if not self._persist_dir:
             return
-        live = len(self._objects.get(kind, {}))
-        lines = self._journal_lines.get(kind, 0)
-        if lines + 1 > max(self.JOURNAL_SLACK * live, self.JOURNAL_MIN):
-            # _compact snapshots the already-updated live set, so the
-            # entry that triggered it is folded in, not appended
-            self._compact(kind)
-            return
+        pend = self._journal_pending.get(kind)
+        if pend is None:
+            pend = self._journal_pending[kind] = []
+        pend.append((op, obj))
+        self._journal_dirty = True
+        if len(pend) >= JOURNAL_GROUP_LINES:
+            self._journal_hot = True
+        if self._journal_thread is None:
+            t = threading.Thread(target=self._journal_loop,
+                                 name="tpf-store-journal", daemon=True)
+            self._journal_thread = t
+            t.start()
+
+    def _journal_loop(self) -> None:
+        while not self._journal_stop.wait(JOURNAL_GROUP_LATENCY_S):
+            try:
+                self._flush_journal()
+            except Exception:  # noqa: BLE001 - keep flushing
+                log.exception("journal flush failed")
+
+    def _journal_handle(self, kind: str):
+        """Open (resuming) journal handle + line count.  Flusher-only."""
         f = self._journals.get(kind)
         if f is None:
-            f = open(self._journal_path(kind), "a")
+            path = self._journal_path(kind)
+            f = open(path, "a")
             self._journals[kind] = f
-            # resuming an existing journal: count its lines once
-            if lines == 0 and f.tell() > 0:
-                with open(self._journal_path(kind)) as rf:
-                    lines = sum(1 for _ in rf)
-        entry = {"op": op}
-        if obj is not None:
-            entry["obj"] = obj.to_dict()
-        f.write(json.dumps(entry) + "\n")
-        f.flush()   # ~3us: page-cache write, not fsync
-        self._journal_lines[kind] = lines + 1
+            if self._journal_lines.get(kind, 0) == 0 and f.tell() > 0:
+                with open(path) as rf:
+                    self._journal_lines[kind] = sum(1 for _ in rf)
+        return f
 
-    def _compact(self, kind: str) -> None:  # tpflint: holds=_lock
-        """Rewrite the kind's journal as a snapshot of live objects."""
+    def flush_journal(self) -> None:
+        """Flush all buffered journal entries now (tests / shutdown)."""
+        self._flush_journal()
+
+    def _flush_journal(self) -> None:
+        if not self._persist_dir:
+            return
+        self._journal_last_flush = _monotonic()
+        with self._journal_drain_lock:
+            while True:
+                with self._lock:
+                    kinds = [k for k, v in self._journal_pending.items()
+                             if v]
+                    if not kinds:
+                        self._journal_dirty = False
+                        return
+                for kind in kinds:
+                    self._flush_kind(kind)
+
+    def _flush_kind(self, kind: str) -> None:
+        """Group-commit one kind's pending entries (caller holds
+        _journal_drain_lock).  Compaction folds the batch into a fresh
+        snapshot instead of appending it."""
+        f = self._journal_handle(kind)
+        lines = self._journal_lines.get(kind, 0)
+        # drain + compact decision under ONE lock acquisition: entries
+        # appended after this cut are strictly post-snapshot, so replay
+        # order can never regress an object
+        with self._lock:
+            entries = self._journal_pending.get(kind) or []
+            if not entries:
+                return
+            self._journal_pending[kind] = []
+            live = self._objects.get(kind, {})
+            compact = lines + len(entries) > max(
+                self.JOURNAL_SLACK * len(live), self.JOURNAL_MIN)
+            snapshot = list(live.values()) if compact else None
+        if compact:
+            self._compact_write(kind, snapshot)
+            return
+        buf = []
+        for op, obj in entries:
+            entry = {"op": op}
+            if obj is not None:
+                # sparse serde: default-valued fields are omitted and
+                # reconstructed by load()'s from_dict — roughly halves
+                # encode time + bytes on default-heavy objects
+                entry["obj"] = sparse_dict(obj)
+            buf.append(json.dumps(entry))
+        f.write("\n".join(buf) + "\n")
+        f.flush()   # one page-cache write per burst, not per write
+        self._journal_lines[kind] = lines + len(entries)
+
+    def _compact_write(self, kind: str, objs: List[Resource]) -> None:
+        """Rewrite the kind's journal as a snapshot (caller holds
+        _journal_drain_lock; file IO runs outside the store lock —
+        the objects are frozen, so serializing them lock-free is safe)."""
         f = self._journals.pop(kind, None)
         if f is not None:
             f.close()
         path = self._journal_path(kind)
         tmp = path + ".tmp"
         with open(tmp, "w") as out:
-            for obj in self._objects.get(kind, {}).values():
+            for obj in objs:
                 out.write(json.dumps({"op": "put",
-                                      "obj": obj.to_dict()}) + "\n")
+                                      "obj": sparse_dict(obj)}) + "\n")
         os.replace(tmp, path)
-        self._journal_lines[kind] = len(self._objects.get(kind, {}))
+        self._journal_lines[kind] = len(objs)
+
+    def _compact(self, kind: str) -> None:
+        """Compact one kind now (load()'s torn-tail repair path)."""
+        with self._journal_drain_lock:
+            with self._lock:
+                self._journal_pending.pop(kind, None)
+                snapshot = list(self._objects.get(kind, {}).values())
+            self._compact_write(kind, snapshot)
 
     def close(self) -> None:
-        with self._lock:
+        self._journal_stop.set()
+        t = self._journal_thread
+        if t is not None:
+            t.join(timeout=2)
+        self._flush_journal()
+        with self._journal_drain_lock:
             for f in self._journals.values():
                 f.close()
             self._journals.clear()
@@ -252,13 +701,12 @@ class ObjectStore:
             if key in bucket:
                 raise AlreadyExistsError(f"{obj.KIND} {key} already exists")
             self._rv += 1
-            obj.metadata.resource_version = self._rv
-            obj.metadata.generation = 1
-            stored = obj.deepcopy()
+            stored = self._stored_copy(obj, self._rv, 1)
             bucket[key] = stored
             self._emit(ADDED, stored)
             self._persist(obj.KIND, "put", stored)
-            return stored.deepcopy()
+        self._post_write()
+        return stored
 
     def get(self, cls: Type[Resource], name: str,
             namespace: str = "") -> Resource:
@@ -267,7 +715,7 @@ class ObjectStore:
             bucket = self._bucket(cls.KIND)
             if key not in bucket:
                 raise NotFoundError(f"{cls.KIND} {key} not found")
-            return bucket[key].deepcopy()
+            return bucket[key]
 
     def try_get(self, cls: Type[Resource], name: str,
                 namespace: str = "") -> Optional[Resource]:
@@ -292,21 +740,24 @@ class ObjectStore:
             # otherwise controllers that update the kinds they watch would
             # feed themselves a self-sustaining event loop.
             if self._content_equal(obj, current):
-                return current.deepcopy()
+                return current
             self._rv += 1
-            obj.metadata.resource_version = self._rv
-            obj.metadata.generation = current.metadata.generation + 1
-            stored = obj.deepcopy()
+            stored = self._stored_copy(obj, self._rv,
+                                       current.metadata.generation + 1)
             bucket[key] = stored
             self._emit(MODIFIED, stored)
             self._persist(obj.KIND, "put", stored)
-            return stored.deepcopy()
+        self._post_write()
+        return stored
 
     def update_or_create(self, obj: Resource) -> Resource:
-        with self._lock:
-            if obj.key() in self._bucket(obj.KIND):
+        try:
+            return self.update(obj)
+        except NotFoundError:
+            try:
+                return self.create(obj)
+            except AlreadyExistsError:
                 return self.update(obj)
-            return self.create(obj)
 
     def delete(self, cls: Type[Resource], name: str,
                namespace: str = "") -> None:
@@ -321,37 +772,38 @@ class ObjectStore:
             self._rv += 1
             self._emit(DELETED, obj)
             self._persist(cls.KIND, "del", obj)
+        self._post_write()
 
     def list(self, cls: Type[Resource], namespace: Optional[str] = None,
              selector: Optional[Callable[[Resource], bool]] = None
              ) -> List[Resource]:
+        """Frozen shared snapshots — zero copies.  Mutating an element
+        raises; ``.thaw()`` one for a private mutable copy."""
         with self._lock:
+            bucket = self._bucket(cls.KIND)
+            if namespace is None and selector is None:
+                return list(bucket.values())
             out = []
-            for obj in self._bucket(cls.KIND).values():
+            for obj in bucket.values():
                 if namespace is not None and obj.metadata.namespace != namespace:
                     continue
                 if selector is not None and not selector(obj):
                     continue
-                out.append(obj.deepcopy())
+                out.append(obj)
             return out
 
     # -- watch ------------------------------------------------------------
 
     def watch(self, *kinds: str, replay: bool = True,
               conflate: bool = False) -> Watch:
-        # ``conflate`` is accepted for interface parity with
-        # RemoteStore.watch and ignored: in-process watches have no wire
-        # or serialization to save, and consumers must not care.
         """Subscribe to events for the given kinds (all kinds if empty).
-        With replay=True, current objects are delivered first as ADDED."""
+        With replay=True, current objects are delivered first as ADDED.
+        ``conflate=True`` delivers only the newest pending event per
+        object (reconcile-style consumers; slow watchers conflate
+        automatically past WATCH_CONFLATE_BACKLOG)."""
         with self._lock:
-            w = Watch(self, kinds)
-            if replay:
-                for kind, bucket in self._objects.items():
-                    if kinds and kind not in kinds:
-                        continue
-                    for obj in bucket.values():
-                        w.queue.put(Event(ADDED, obj.deepcopy()))
+            w = Watch(self, kinds, conflate=conflate)
+            w._prime_locked(replay)
             self._watches.append(w)
             return w
 
@@ -363,11 +815,8 @@ class ObjectStore:
             return self._rv
 
     def enable_event_log(self) -> None:
-        """Start recording events for remote watchers (gateway attach).
-        Events before this point are not in the log, so a watcher asking
-        for an older window gets reset=True and re-lists."""
-        with self._lock:
-            self._log_enabled = True
+        """Compat no-op: the shared ring now always records events (the
+        per-write cost is one O(1) append; serialization is lazy)."""
 
     def snapshot_events(self, kinds: Iterable[str] = ()
                         ) -> Tuple[int, List[Tuple[str, str, dict]]]:
@@ -375,7 +824,6 @@ class ObjectStore:
         given kinds) — the replay a fresh remote watcher starts from."""
         kinds = set(kinds)
         with self._lock:
-            self._log_enabled = True   # a remote watcher just appeared
             out = []
             for kind, bucket in self._objects.items():
                 if kinds and kind not in kinds:
@@ -391,7 +839,7 @@ class ObjectStore:
         """Events with rv > since_rv for the given kinds, blocking up to
         ``wait_s`` when none are pending (long-poll).  Returns
         (current_rv, events, reset): ``reset`` is True when ``since_rv``
-        pre-dates the bounded event log — the caller must re-list (HTTP
+        pre-dates the bounded event ring — the caller must re-list (HTTP
         410 Gone semantics).  Events are ``(etype, kind, rv, obj_dict)``
         tuples, or — with ``serialized=True`` (the gateway's fan-out
         path) — ready JSON fragments cached once per event so N watchers
@@ -408,49 +856,66 @@ class ObjectStore:
         import time as _time
         deadline = _time.monotonic() + max(0.0, wait_s)
         with self._cond:
-            self._log_enabled = True
             while True:
                 if since_rv > self._rv:
                     # the watcher is ahead of us: this store restarted
                     # with older state — the client must re-list, not be
                     # silently clamped into missing the gap
                     return self._rv, [], True
+                ring = self._ring
                 # every rv bump is logged, so the window is complete iff
                 # it starts at/after the oldest logged event minus one
-                oldest = self._event_log[0][0] if self._event_log \
-                    else self._rv + 1
+                oldest = ring[0].rv if ring else self._rv + 1
                 if since_rv < oldest - 1:
                     return self._rv, [], True
-                # rv-ordered deque: walk the new suffix from the tail
-                # instead of rescanning all of history on every wakeup
+                # rv-ordered ring: binary-search the window start instead
+                # of rescanning history on every wakeup
+                lo, hi = 0, len(ring)
+                while lo < hi:
+                    mid = (lo + hi) // 2
+                    if ring[mid].rv <= since_rv:
+                        lo = mid + 1
+                    else:
+                        hi = mid
                 matched = []
-                seen_keys = set() if conflate else None
-                for entry in reversed(self._event_log):
-                    rv, etype, kind, obj = entry[0], entry[1], \
-                        entry[2], entry[3]
-                    if rv <= since_rv:
-                        break
-                    if kinds and kind not in kinds:
+                # conflation state: key -> True once its newest event is
+                # kept; a later (older) DELETED is ALSO kept when the
+                # surviving newest is a recreate — dropping it would mask
+                # the identity change from delete+recreate under one key
+                # (the consumer would never release the old object)
+                seen_keys: Optional[dict] = {} if conflate else None
+                for idx in range(len(ring) - 1, lo - 1, -1):
+                    rec = ring[idx]
+                    if kinds and rec.kind not in kinds:
                         continue
                     if seen_keys is not None:
                         # newest-first walk: the first event seen for an
-                        # object is its latest; earlier ones conflate away
-                        md = obj.get("metadata", {})
-                        okey = (kind, md.get("namespace", ""),
-                                md.get("name", ""))
-                        if okey in seen_keys:
+                        # object is its latest; earlier ones conflate
+                        # away, EXCEPT one DELETED preceding a recreate
+                        md = rec.obj.metadata
+                        okey = (rec.kind, md.namespace, md.name)
+                        state = seen_keys.get(okey)
+                        if state == "done":
                             continue
-                        seen_keys.add(okey)
+                        if state is None:
+                            seen_keys[okey] = "done" \
+                                if rec.etype == DELETED else "want-delete"
+                        else:  # "want-delete": newest kept, non-DELETED
+                            if rec.etype != DELETED:
+                                continue
+                            seen_keys[okey] = "done"
                     if serialized:
-                        frag = entry[4]
+                        frag = rec.json
                         if frag is None:
                             frag = json.dumps(
-                                {"type": etype, "kind": kind, "rv": rv,
-                                 "obj": obj}, separators=(",", ":"))
-                            entry[4] = frag
+                                {"type": rec.etype, "kind": rec.kind,
+                                 "rv": rec.rv, "obj": rec.obj_dict()},
+                                separators=(",", ":"))
+                            rec.json = frag
                         matched.append(frag)
                     else:
-                        matched.append((etype, kind, rv, obj))
+                        matched.append((rec.etype, rec.kind, rec.rv,
+                                        rec.obj_dict()))
                 if matched:
                     matched.reverse()
                     return self._rv, matched, False
@@ -469,6 +934,7 @@ class ObjectStore:
         if not self._persist_dir:
             return 0
         n = 0
+        torn_kinds: List[str] = []
         with self._lock:
             for cls in kind_classes:
                 path = self._journal_path(cls.KIND)
@@ -488,8 +954,7 @@ class ObjectStore:
                             # line; dropping it loses at most one entry
                             # (re-derived from annotations) — refusing
                             # to boot would lose everything
-                            import logging
-                            logging.getLogger("tpf.store").warning(
+                            log.warning(
                                 "dropping torn trailing journal line "
                                 "in %s", path)
                             torn = True
@@ -505,7 +970,7 @@ class ObjectStore:
                     if op == "del":
                         bucket.pop(obj.key(), None)
                     else:
-                        bucket[obj.key()] = obj
+                        bucket[obj.key()] = freeze_copy(obj)
                     self._rv = max(self._rv,
                                    obj.metadata.resource_version)
                 self._journal_lines[cls.KIND] = lines
@@ -513,7 +978,10 @@ class ObjectStore:
                     # rewrite the journal without the torn tail: a later
                     # append has no trailing newline to land after and
                     # would otherwise concatenate onto the partial line,
-                    # corrupting a then-valid entry
-                    self._compact(cls.KIND)
+                    # corrupting a then-valid entry (compacted below,
+                    # outside _lock — lock order is drain_lock -> _lock)
+                    torn_kinds.append(cls.KIND)
                 n += len(bucket)
+        for kind in torn_kinds:
+            self._compact(kind)
         return n

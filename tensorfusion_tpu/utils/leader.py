@@ -267,6 +267,7 @@ class StoreLeaderElector:
                     pass          # reclaim our own lease (restart)
                 elif age <= self.lease_duration_s:
                     return False  # healthy holder
+                lease = lease.thaw()
                 self._fill(lease, now, lease.spec.fencing_token + 1)
                 lease.spec.transitions += 1
                 self.store.update(lease, check_version=True)
@@ -292,6 +293,7 @@ class StoreLeaderElector:
             lease = self.store.get(Lease, self.LEASE_NAME)
             if lease.spec.holder != self.identity:
                 return False      # usurped
+            lease = lease.thaw()
             lease.spec.renew_time = time.time()
             self.store.update(lease, check_version=True)
             return True
@@ -321,6 +323,7 @@ class StoreLeaderElector:
         try:
             lease = self.store.try_get(Lease, self.LEASE_NAME)
             if lease is not None and lease.spec.holder == self.identity:
+                lease = lease.thaw()
                 lease.spec.renew_time = 0.0
                 self.store.update(lease, check_version=True)
         except Exception:  # noqa: BLE001 - best effort

@@ -16,6 +16,7 @@ import logging
 from typing import Dict, Optional
 
 from .. import constants
+from ..api.meta import thaw_copy
 from ..api.resources import GangConfig, ResourceAmount, Resources, parse_quantity
 from ..api.types import (ChipModelInfo, Pod, TPUWorkloadSpec, WorkloadProfile,
                          WorkloadProfileSpec, native_chip_counts)
@@ -69,8 +70,11 @@ class WorkloadParser:
             if profile is None:
                 raise ParseError(f"workload profile {profile_name!r} "
                                  f"not found in {pod.metadata.namespace}")
+            # the profile is a frozen store snapshot: copy THAWED
+            # values — the override/normalization steps below mutate them
+            src = thaw_copy(profile.spec)
             for f in dataclasses.fields(WorkloadProfileSpec):
-                setattr(spec, f.name, getattr(profile.spec, f.name))
+                setattr(spec, f.name, getattr(src, f.name))
 
         # 2. inline annotation overrides
         spec.pool = ann.get(constants.ANN_POOL, spec.pool or

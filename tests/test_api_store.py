@@ -43,11 +43,11 @@ def test_store_crud_and_conflict():
     with pytest.raises(AlreadyExistsError):
         store.create(TPUPool.new("pool-a"))
 
-    got = store.get(TPUPool, "pool-a")
+    got = store.get(TPUPool, "pool-a").thaw()
     got.status.total_chips = 8
     store.update(got, check_version=True)
 
-    stale = created  # old resource_version
+    stale = created.thaw()  # old resource_version
     stale.status.total_chips = 99
     with pytest.raises(ConflictError):
         store.update(stale, check_version=True)
@@ -74,7 +74,7 @@ def test_store_namespaced_list_and_watch():
     ev = w.get(timeout=1)
     assert ev.type == ADDED and ev.obj.metadata.namespace == "team-b"
 
-    got = store.get(TPUWorkload, "wl1", "team-a")
+    got = store.get(TPUWorkload, "wl1", "team-a").thaw()
     got.spec.replicas = 3
     store.update(got)
     ev = w.get(timeout=1)
@@ -126,7 +126,7 @@ def test_watch_conflation_keeps_only_newest_per_object():
     store.create(b)
     for i in range(20):
         a.metadata.annotations["i"] = str(i)
-        a = store.update(a)
+        a = store.update(a).thaw()
     b.metadata.annotations["final"] = "1"
     b = store.update(b)
     store.delete(Pod, "b", "d")
@@ -172,7 +172,7 @@ def test_remote_watch_conflation_over_http():
             store.create(pod)
             for i in range(30):
                 pod.metadata.annotations["i"] = str(i)
-                pod = store.update(pod)
+                pod = store.update(pod).thaw()
             deadline = _time.time() + 10
             latest = None
             n = 0

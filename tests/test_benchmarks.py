@@ -155,3 +155,9 @@ def test_watch_scale_fast():
     # 3x the watchers must cost far less than 3x the throughput
     # (superlinear fan-out would); generous floor for a noisy CI box
     assert result["scaling_span_pct"] >= 25.0
+    # the in-process shared-ring cell reports reconcile-mode retention
+    # and records the machinery flags for the before/after comparison
+    inproc = result["inproc"]
+    assert inproc["writes_per_s_idle"] > 0
+    assert list(inproc["retention_pct_reconcile_mode"].values())[0] > 0
+    assert result["flags"]["shared_ring_fanout"] is True

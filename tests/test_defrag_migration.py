@@ -71,7 +71,7 @@ def test_defrag_migrates_pods_off_low_util_node():
         assert node2 != node1
 
         # drop the placement-forcing exclusion so node1 is a legal target
-        lonely = op.store.get(Pod, "lonely", "default")
+        lonely = op.store.get(Pod, "lonely", "default").thaw()
         del lonely.metadata.annotations[constants.ANN_EXCLUDED_NODES]
         op.store.update(lonely)
 
@@ -231,7 +231,7 @@ def test_drain_marks_expire_after_ttl():
     targets again."""
     op = make_operator(hosts=2)
     try:
-        pool = op.store.get(TPUPool, "pool-a")
+        pool = op.store.get(TPUPool, "pool-a").thaw()
         pool.spec.compaction.enabled = True
         pool.spec.compaction.defrag_eviction_ttl_seconds = 0.5
         op.store.update(pool)
@@ -249,7 +249,7 @@ def test_drain_marks_expire_after_ttl():
         op.submit_pod(pod)
         bound = op.wait_for_binding("roamer")
         node2 = bound.spec.node_name
-        roamer = op.store.get(Pod, "roamer", "default")
+        roamer = op.store.get(Pod, "roamer", "default").thaw()
         del roamer.metadata.annotations[constants.ANN_EXCLUDED_NODES]
         op.store.update(roamer)
 
@@ -274,11 +274,11 @@ def test_drain_marks_expire_after_ttl():
         # (instead of sleeping past a real TTL) and drive reconcile()
         # directly, so the check is independent of wall-clock timing,
         # tracing overhead, and resync cadence.
-        cur = op.store.get(Pod, "roamer", "default")
+        cur = op.store.get(Pod, "roamer", "default").thaw()
         cur.metadata.annotations[constants.ANN_DEFRAG_EVICTED_SINCE] = \
             str(time.time() - 3600)
         op.store.update(cur)
-        tnode = op.store.get(TPUNode, node2)
+        tnode = op.store.get(TPUNode, node2).thaw()
         tnode.metadata.annotations[constants.ANN_DEFRAG_SOURCE_SINCE] = \
             str(time.time() - 3600)
         op.store.update(tnode)
@@ -288,7 +288,7 @@ def test_drain_marks_expire_after_ttl():
         # fresh drain marks — the very marks this test is waiting to see
         # expire (observed as a rare CI flake).  Freeze further defrag
         # churn, then expire.
-        pool = op.store.get(TPUPool, "pool-a")
+        pool = op.store.get(TPUPool, "pool-a").thaw()
         pool.spec.compaction.enabled = False
         op.store.update(pool)
         deadline = time.time() + 20

@@ -198,7 +198,7 @@ def test_rollout_recycles_outdated_workers():
         old_uids = {p.metadata.uid for p in workers}
 
         # bump the worker image -> new hash -> batch recycle
-        pool2 = op.store.get(TPUPool, "pool-a")
+        pool2 = op.store.get(TPUPool, "pool-a").thaw()
         pool2.spec.components.worker_image = "tpufusion/worker:v2"
         op.store.update(pool2)
         new_hash = component_hash(pool2.spec.components)

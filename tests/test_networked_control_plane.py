@@ -59,6 +59,7 @@ def test_remote_store_crud_roundtrip(op_server):
     with pytest.raises(AlreadyExistsError):
         rs.create(pod)
 
+    got = got.thaw()    # remote reads are frozen snapshots too
     got.metadata.annotations["a"] = "2"
     updated = rs.update(got)
     assert updated.metadata.generation == 2
@@ -72,6 +73,7 @@ def test_remote_store_crud_roundtrip(op_server):
     # upsert both paths
     up = rs.update_or_create(Pod.new("p2", namespace="ns1"))
     assert up.metadata.resource_version > 0
+    up = up.thaw()
     up.metadata.labels["x"] = "y"
     rs.update_or_create(up)
 
@@ -133,15 +135,17 @@ def test_watch_reset_after_log_compaction():
     """A watcher further behind than the bounded event log gets
     reset=True (410-Gone) and must re-list; events_since proves window
     completeness via the log's oldest rv."""
-    import collections
-
     store = ObjectStore()
     store.enable_event_log()
-    store._event_log = collections.deque(maxlen=4)
     first = store.create(Pod.new("a", namespace="d"))
     base_rv = first.metadata.resource_version
     for i in range(8):
         store.create(Pod.new(f"p{i}", namespace="d"))
+    # simulate the bounded ring aging out all but the last 4 records
+    with store._lock:
+        drop = len(store._ring) - 4
+        del store._ring[:drop]
+        store._ring_base += drop
     rv, events, reset = store.events_since(base_rv, ["Pod"])
     assert reset is True and events == []
     # a fresh window from within the log works
@@ -219,17 +223,22 @@ def test_store_journal_append_compact_and_replay(tmp_path):
     store = ObjectStore(persist_dir=d)
     pods = [store.create(Pod.new(f"p{i}", namespace="ns"))
             for i in range(20)]
+    # group commit buffers a burst; flush before inspecting the file
+    store.flush_journal()
     path = tmp_path / "persist" / "Pod.jsonl"
     base_lines = len(path.read_text().splitlines())
     assert base_lines == 20
 
     # one update = exactly one appended line, not a 20-line rewrite
-    pods[0].metadata.labels["x"] = "1"
-    store.update(pods[0])
+    p0 = pods[0].thaw()
+    p0.metadata.labels["x"] = "1"
+    store.update(p0)
+    store.flush_journal()
     assert len(path.read_text().splitlines()) == base_lines + 1
 
     # deletion journals a del entry
     store.delete(Pod, "p1", "ns")
+    store.flush_journal()
     lines = path.read_text().splitlines()
     assert json.loads(lines[-1])["op"] == "del"
 
@@ -245,7 +254,7 @@ def test_store_journal_append_compact_and_replay(tmp_path):
     fresh.JOURNAL_SLACK = 2
     fresh.JOURNAL_MIN = 8
     for _ in range(90):
-        p = fresh.get(Pod, "p2", "ns")
+        p = fresh.get(Pod, "p2", "ns").thaw()
         p.metadata.labels["n"] = str(time.time())
         fresh.update(p)
     assert len(path.read_text().splitlines()) <= 2 * 19 + 1

@@ -293,7 +293,7 @@ def test_e2e_expander_scales_from_capacity_miss(op):
     # The expansion MUST be visible in the allocator before the filler
     # is submitted (the old version broke out of this poll without
     # checking, and a slow pool reconcile made the filler unschedulable)
-    pool = op.store.get(TPUPool, "pool-a")
+    pool = op.store.get(TPUPool, "pool-a").thaw()
     pool.spec.capacity_config.hbm_expand_to_host_mem_percent = 50
     pool.spec.capacity_config.hbm_expand_to_host_disk_percent = 70
     op.store.update(pool)
@@ -351,7 +351,7 @@ def test_pool_rollup_never_clobbers_concurrent_spec_update():
         if cls is TPUPool and not raced:
             raced["done"] = True
             # a user enables expansion while the rollup is mid-flight
-            p = store.get(TPUPool, "pool-a")
+            p = store.get(TPUPool, "pool-a").thaw()
             p.spec.capacity_config.hbm_expand_to_host_mem_percent = 50
             store.update(p)
         return out
@@ -380,7 +380,7 @@ def test_rebalancer_enabled_flag_warns_loudly(op, caplog):
     tmpl = SchedulingConfigTemplate.new("rebal-tmpl")
     tmpl.spec.rebalancer_enabled = True
     op.store.create(tmpl)
-    pool = op.store.get(TPUPool, "pool-a")
+    pool = op.store.get(TPUPool, "pool-a").thaw()
     pool.spec.scheduling_config_template = "rebal-tmpl"
     with caplog.at_level(logging.WARNING, logger="tpf.controller"):
         op.store.update(pool)

@@ -15,8 +15,9 @@ import textwrap
 import pytest
 
 from tools.tpflint.checkers import (ALL_CHECKS, blocking_under_lock,
-                                    guarded_fields, metrics_schema,
-                                    protocol_exhaustive, stale_write_back)
+                                    frozen_view_mutation, guarded_fields,
+                                    metrics_schema, protocol_exhaustive,
+                                    stale_write_back)
 from tools.tpflint.core import (Finding, SourceFile, apply_baseline,
                                 load_baseline, run_paths, save_baseline)
 
@@ -108,6 +109,114 @@ def test_stale_write_back_taint_propagates_through_alias():
             self.store.update(alias)
     """
     assert len(stale_write_back.run_file(sf(code))) == 1
+
+
+# -- frozen-view-mutation ---------------------------------------------------
+
+FVM_BAD_GET_MUTATE = """
+    class C:
+        def reconcile(self):
+            obj = self.store.get(Pool, "a")
+            obj.status.phase = "Running"
+"""
+
+FVM_BAD_LIST_LOOP_MUTATE = """
+    class C:
+        def reconcile(self):
+            for pool in self.store.list(Pool):
+                pool.status.total_chips = 3
+"""
+
+FVM_BAD_EVENT_OBJ_DIRECT = """
+    class C:
+        def reconcile(self, event):
+            event.obj.metadata.labels["x"] = "1"
+"""
+
+FVM_BAD_EVENT_OBJ_ALIAS_CONTAINER = """
+    class C:
+        def reconcile(self, event):
+            wl = event.obj
+            wl.spec.excluded_nodes.append("n1")
+"""
+
+FVM_BAD_CACHE_INDEX_DEL = """
+    class C:
+        def f(self):
+            pods = self.cache.by_index(Pod, "node", "n1")
+            victim = pods[0]
+            del victim.metadata.annotations["k"]
+"""
+
+FVM_GOOD_THAW_BEFORE_MUTATE = """
+    class C:
+        def reconcile(self, event):
+            obj = event.obj.thaw()
+            obj.status.phase = "Running"
+            for pool in self.store.list(Pool):
+                pool = pool.thaw()
+                pool.status.total_chips = 3
+"""
+
+FVM_GOOD_READS_AND_FRESH_OBJECTS = """
+    class C:
+        def reconcile(self):
+            obj = self.store.get(Pool, "a")
+            phase = obj.status.phase
+            names = [c.name for c in self.store.list(Chip)]
+            fresh = Pool.new("x")
+            fresh.status.phase = "Running"
+            probe = compose_alloc_request(obj)
+            probe.excluded_nodes.append("n1")
+"""
+
+FVM_GOOD_MUTATE_CLOSURE = """
+    class C:
+        def f(self):
+            def stamp(tnode):
+                tnode.metadata.labels["x"] = "1"
+            mutate(self.store, Node, "n", stamp)
+"""
+
+
+def test_frozen_view_flags_get_then_mutate():
+    findings = frozen_view_mutation.run_file(sf(FVM_BAD_GET_MUTATE))
+    assert len(findings) == 1
+    assert "thaw" in findings[0].message
+    assert findings[0].symbol == "C.reconcile"
+
+
+def test_frozen_view_flags_list_loop_and_event_obj():
+    assert len(frozen_view_mutation.run_file(
+        sf(FVM_BAD_LIST_LOOP_MUTATE))) == 1
+    assert len(frozen_view_mutation.run_file(
+        sf(FVM_BAD_EVENT_OBJ_DIRECT))) == 1
+    assert len(frozen_view_mutation.run_file(
+        sf(FVM_BAD_EVENT_OBJ_ALIAS_CONTAINER))) == 1
+
+
+def test_frozen_view_flags_cache_read_del():
+    findings = frozen_view_mutation.run_file(sf(FVM_BAD_CACHE_INDEX_DEL))
+    assert len(findings) == 1 and "del" in findings[0].message
+
+
+def test_frozen_view_passes_thawed_and_fresh():
+    for good in (FVM_GOOD_THAW_BEFORE_MUTATE,
+                 FVM_GOOD_READS_AND_FRESH_OBJECTS,
+                 FVM_GOOD_MUTATE_CLOSURE):
+        assert frozen_view_mutation.run_file(sf(good)) == [], good
+
+
+def test_frozen_view_disable_comment_honored():
+    code = """
+        def f(self):
+            obj = self.store.get(Pool, "a")
+            obj.status.phase = "x"  # tpflint: disable=frozen-view-mutation
+    """
+    f = sf(code)
+    findings = [x for x in frozen_view_mutation.run_file(f)
+                if not f.is_suppressed(x)]
+    assert findings == []
 
 
 # -- blocking-under-lock ---------------------------------------------------
@@ -475,7 +584,7 @@ def test_repo_lints_clean_with_committed_baseline():
     assert stale == []
 
 
-def test_all_five_checkers_registered():
+def test_all_six_checkers_registered():
     assert set(ALL_CHECKS) == {
-        "stale-write-back", "blocking-under-lock", "guarded-field",
-        "protocol-exhaustive", "metrics-schema"}
+        "stale-write-back", "frozen-view-mutation", "blocking-under-lock",
+        "guarded-field", "protocol-exhaustive", "metrics-schema"}

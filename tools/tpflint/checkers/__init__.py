@@ -6,10 +6,11 @@ and either ``run_file(sf) -> [Finding]`` (per-file) or
 it below.  docs/static-analysis.md documents the contract.
 """
 
-from . import (blocking_under_lock, guarded_fields, metrics_schema,
-               protocol_exhaustive, stale_write_back)
+from . import (blocking_under_lock, frozen_view_mutation, guarded_fields,
+               metrics_schema, protocol_exhaustive, stale_write_back)
 
-FILE_CHECKERS = (stale_write_back, blocking_under_lock, guarded_fields)
+FILE_CHECKERS = (stale_write_back, frozen_view_mutation,
+                 blocking_under_lock, guarded_fields)
 PROJECT_CHECKERS = (protocol_exhaustive, metrics_schema)
 
 ALL_CHECKS = tuple(sorted(

@@ -1,0 +1,278 @@
+"""frozen-view-mutation: mutating a shared store snapshot.
+
+Since the copy-on-write store landed (docs/control-plane-scale.md),
+``store.get``/``try_get``/``list``, watch events and ``StoreCache``
+reads all return the SAME deeply frozen snapshot instead of private
+deepcopies.  Mutating one raises ``FrozenResourceError`` at runtime —
+but only on the code path that actually runs.  This checker finds the
+pattern statically: any attribute/container mutation reached through an
+object obtained from a store read, without an intervening ``.thaw()``
+(or ``.deepcopy()``) producing a private copy.
+
+Tracked taint, per function, in statement order:
+
+- ``x = <store>.get/try_get(...)``                 -> x is a snapshot
+- ``xs = <store>.list(...)``; ``for x in xs:``     -> x is a snapshot
+  (also ``<cache>.list/by_index`` and ``xs[i]`` subscripts)
+- ``x = event.obj`` / ``x = ev.obj``               -> x is a snapshot
+- ``y = x`` propagates; ``y = x.thaw()`` / ``x = x.thaw()`` /
+  ``y = x.deepcopy()`` / ``y = copy.deepcopy(x)`` clear; any other
+  reassignment clears.
+
+Flagged, when the chain's root is tainted (or is ``event.obj`` /
+``ev.obj`` directly):
+
+- ``x.a.b = v`` / ``x.a += v``  (attribute assignment at any depth)
+- ``del x.a`` / ``del x.a["k"]``
+- ``x.a["k"] = v``              (container item assignment)
+- ``x.a.append/update/pop/...`` (mutating container-method calls)
+
+A receiver is store-ish when its final component is ``store``/
+``_store``/``statestore``/``remote_store`` or ``cache``/``_cache``/
+``storecache`` (StoreCache reads are snapshots too).  ``mutate()``
+closures are exempt by construction: their argument is a parameter, not
+a store read — ``store.mutate`` hands the closure an already-thawed
+private copy.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from ..core import Finding, SourceFile, dotted_tail, iter_functions
+
+CHECK = "frozen-view-mutation"
+
+STORE_NAMES = {"store", "_store", "statestore", "remote_store",
+               "cache", "_cache", "storecache"}
+READ_METHODS = {"get", "try_get"}
+LIST_METHODS = {"list", "by_index"}
+EVENT_NAMES = {"event", "ev"}
+ITER_WRAPPERS = {"sorted", "list", "reversed", "tuple"}
+#: container-method calls that mutate their receiver in place
+MUTATORS = {"append", "extend", "insert", "remove", "pop", "popitem",
+            "clear", "update", "setdefault", "sort", "reverse",
+            "add", "discard"}
+#: calls that produce a private mutable copy (clear taint)
+THAWERS = {"thaw", "deepcopy"}
+
+
+def _is_store(node: ast.AST) -> bool:
+    return dotted_tail(node).lower() in STORE_NAMES
+
+
+def _store_call(node: ast.AST, methods) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in methods
+            and _is_store(node.func.value))
+
+
+def _root_name(node: ast.AST) -> Optional[ast.AST]:
+    """Innermost Name/Attribute base of an attribute/subscript chain,
+    plus whether the chain passes through at least one attribute."""
+    depth = 0
+    while True:
+        if isinstance(node, ast.Attribute):
+            depth += 1
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        elif isinstance(node, ast.Call):
+            return None     # a call in the chain makes a fresh object
+        else:
+            return node if depth > 0 else None
+
+
+def _is_event_obj(node: ast.AST) -> bool:
+    """``event.obj`` / ``ev.obj`` (a watch event's snapshot)."""
+    return (isinstance(node, ast.Attribute) and node.attr == "obj"
+            and isinstance(node.value, ast.Name)
+            and node.value.id in EVENT_NAMES)
+
+
+def _chain_has_event_obj(node: ast.AST) -> bool:
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        if _is_event_obj(node):
+            return True
+        node = node.value
+    return False
+
+
+class _FunctionScan:
+    def __init__(self, sf: SourceFile, symbol: str):
+        self.sf = sf
+        self.symbol = symbol
+        self.tainted: Dict[str, int] = {}       # name -> read line
+        self.collections: Dict[str, int] = {}   # name -> list() line
+        self.findings: List[Finding] = []
+
+    # -- taint bookkeeping -------------------------------------------------
+
+    def _clear(self, name: str) -> None:
+        self.tainted.pop(name, None)
+        self.collections.pop(name, None)
+
+    def _is_thawed(self, value: ast.AST) -> bool:
+        """x.thaw() / x.deepcopy() / copy.deepcopy(x) / thaw_copy(x)."""
+        if not isinstance(value, ast.Call):
+            return False
+        fn = value.func
+        if isinstance(fn, ast.Attribute) and fn.attr in THAWERS:
+            return True
+        return dotted_tail(fn) in ("deepcopy", "thaw_copy")
+
+    def _assign(self, target: ast.AST, value: ast.AST) -> None:
+        if not isinstance(target, ast.Name):
+            return
+        name = target.id
+        if _store_call(value, READ_METHODS):
+            self._clear(name)
+            self.tainted[name] = value.lineno
+        elif _store_call(value, LIST_METHODS):
+            self._clear(name)
+            self.collections[name] = value.lineno
+        elif _is_event_obj(value):
+            self._clear(name)
+            self.tainted[name] = value.lineno
+        elif self._is_thawed(value):
+            self._clear(name)
+        elif isinstance(value, ast.Name) and value.id in self.tainted:
+            self.tainted[name] = self.tainted[value.id]
+        elif (isinstance(value, ast.Subscript)
+              and isinstance(value.value, ast.Name)
+              and value.value.id in self.collections):
+            self.tainted[name] = self.collections[value.value.id]
+        else:
+            self._clear(name)
+
+    def _iter_source_is_collection(self, it: ast.AST) -> bool:
+        if _store_call(it, LIST_METHODS):
+            return True
+        if isinstance(it, ast.Name) and it.id in self.collections:
+            return True
+        if (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id in ITER_WRAPPERS and it.args):
+            return self._iter_source_is_collection(it.args[0])
+        if isinstance(it, ast.Subscript):
+            return self._iter_source_is_collection(it.value)
+        return False
+
+    # -- sinks -------------------------------------------------------------
+
+    def _flag(self, node: ast.AST, root: str, read_line, verb: str) -> None:
+        where = f"read from the store at line {read_line}" \
+            if read_line else "a watch event snapshot"
+        self.findings.append(Finding(
+            check=CHECK, path=self.sf.relpath, line=node.lineno,
+            symbol=self.symbol, key=root,
+            message=(f"{verb} mutates `{root}`, {where} — store reads "
+                     f"and watch events are frozen shared snapshots "
+                     f"(FrozenResourceError at runtime); take a private "
+                     f"copy with `.thaw()` or use store.mutate()")))
+
+    def _check_mutation_target(self, node: ast.AST, verb: str) -> None:
+        """``node`` is written/deleted: flag if its chain roots in a
+        tainted variable (or passes through event.obj)."""
+        if _chain_has_event_obj(node):
+            self._flag(node, "event.obj", None, verb)
+            return
+        root = _root_name(node)
+        if isinstance(root, ast.Name) and root.id in self.tainted:
+            self._flag(node, root.id, self.tainted[root.id], verb)
+
+    def _check_expr(self, expr: ast.expr) -> None:
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call) or \
+                    not isinstance(node.func, ast.Attribute):
+                continue
+            if node.func.attr not in MUTATORS:
+                continue
+            recv = node.func.value
+            # dict.get-style reads share names with mutators nowhere;
+            # every MUTATORS hit on a tainted chain is a mutation
+            if _chain_has_event_obj(recv):
+                self._flag(node, "event.obj", None,
+                           f".{node.func.attr}()")
+                continue
+            root = _root_name(recv)
+            if root is None and isinstance(recv, ast.Name):
+                continue    # bare variable method: x.update() on the
+                # resource itself doesn't exist; containers are reached
+                # through attributes
+            if isinstance(root, ast.Name) and root.id in self.tainted:
+                self._flag(node, root.id, self.tainted[root.id],
+                           f".{node.func.attr}()")
+
+    # -- statement walk ----------------------------------------------------
+
+    def run(self, body: List[ast.stmt]) -> None:
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return      # separate scope, scanned separately
+        if isinstance(stmt, ast.Assign):
+            self._check_expr(stmt.value)
+            for t in stmt.targets:
+                if isinstance(t, (ast.Attribute, ast.Subscript)):
+                    self._check_mutation_target(t, "assignment")
+            for t in stmt.targets:
+                self._assign(t, stmt.value)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._check_expr(stmt.value)
+            if isinstance(stmt.target, (ast.Attribute, ast.Subscript)):
+                self._check_mutation_target(stmt.target,
+                                            "augmented assignment")
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._check_expr(stmt.value)
+                self._assign(stmt.target, stmt.value)
+            return
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                if isinstance(t, (ast.Attribute, ast.Subscript)):
+                    self._check_mutation_target(t, "del")
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._check_expr(stmt.iter)
+            if isinstance(stmt.target, ast.Name) and \
+                    self._iter_source_is_collection(stmt.iter):
+                self.tainted[stmt.target.id] = stmt.lineno
+            for s in stmt.body + stmt.orelse:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.Expr):
+            self._check_expr(stmt.value)
+            return
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            self._check_expr(stmt.value)
+            return
+        for field_name in ("test",):
+            val = getattr(stmt, field_name, None)
+            if isinstance(val, ast.expr):
+                self._check_expr(val)
+        for field_name in ("body", "orelse", "finalbody", "handlers"):
+            for s in getattr(stmt, field_name, ()):
+                if isinstance(s, ast.ExceptHandler):
+                    for inner in s.body:
+                        self._stmt(inner)
+                elif isinstance(s, ast.stmt):
+                    self._stmt(s)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._check_expr(item.context_expr)
+
+
+def run_file(sf: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    for symbol, fn in iter_functions(sf.tree):
+        scan = _FunctionScan(sf, symbol)
+        scan.run(fn.body)
+        findings.extend(scan.findings)
+    return findings
