@@ -8,7 +8,8 @@
 PY := env -u PALLAS_AXON_POOL_IPS python
 
 .PHONY: all native test test-native verify-all verify-repeat \
-	verify-stress verify-native-sanitized check-coverage lint asan \
+	verify-stress verify-native-sanitized check-coverage lint \
+	lint-drill asan \
 	tsan bench bench-tpu test-tpu-live sched-bench webhook-bench remoting-bench \
 	multitenant-bench multitenant-bench-tpu serving-bench-tpu \
 	refresh-tpu-artifacts dryrun clean
@@ -32,11 +33,24 @@ verify-all: lint test-native check-coverage
 	@echo "verify-all: OK"
 
 # Project-invariant static analysis (docs/static-analysis.md): the
-# stale-write-back / blocking-under-lock / guarded-field / protocol-
-# exhaustive / metrics-schema checkers, ratcheted by
+# lexical checkers (stale-write-back / blocking-under-lock /
+# guarded-field / frozen-view-mutation / protocol-exhaustive /
+# metrics-schema) plus the tpfgraph interprocedural layer (lock-order-
+# inversion / transitive-blocking-under-lock / swallowed-error /
+# unjoined-thread / leaked-resource), ratcheted by
 # tools/tpflint/baseline.json (currently EMPTY — keep it that way).
+# tools/ is linted too: the linter lints itself.  Per-file analysis is
+# cached in .tpflint-cache.json (mtime-keyed; TPF_LINT_NO_CACHE=1 or
+# --no-cache bypasses, --verbose prints hit/miss counters).
 lint:
-	$(PY) -m tools.tpflint tensorfusion_tpu
+	$(PY) -m tools.tpflint tensorfusion_tpu tools
+
+# Checker liveness drills: re-introduce one known-bad pattern per graph
+# checker (a lock-order inversion in store.py among them) into a
+# DISPOSABLE copy of the tree and assert lint fails with the expected
+# witness.  Run on any change to tools/tpflint/.
+lint-drill:
+	$(PY) -m tools.tpflint.drill
 
 # Deflake gate: the tier-1 python suite 5x sequentially.  Timing-
 # dependent tests must survive a loaded box repeatedly, not just one

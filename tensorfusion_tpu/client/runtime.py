@@ -134,6 +134,9 @@ class VTPUClient:
                 try:
                     devs = getattr(arr, "sharding", None)
                     devs = devs.device_set if devs is not None else set()
+                # per-array probe in the sampling hot loop: a backend
+                # without device_set is normal, logging it would spam
+                # tpflint: disable=swallowed-error
                 except Exception:  # noqa: BLE001
                     devs = set()
                 if platform != "cpu" and devs and \
@@ -330,7 +333,8 @@ class VTPUClient:
             try:
                 self.limiter.detach()
             except Exception:
-                pass
+                log.debug("limiter detach failed during close",
+                          exc_info=True)
             self.attached = False
 
     # -- charging ----------------------------------------------------------
@@ -409,6 +413,8 @@ class VTPUClient:
                     hbm = int(getattr(mem, "output_size_in_bytes", 0)
                               + getattr(mem, "temp_size_in_bytes", 0))
                 except Exception:
+                    log.debug("memory_analysis unavailable; skipping "
+                              "HBM pre-charge", exc_info=True)
                     hbm = 0
                 # live sampling supersedes the compile-time estimate —
                 # the outputs are live arrays it will count itself
@@ -440,6 +446,9 @@ class VTPUClient:
             if total:
                 try:
                     client.charge_hbm(-total)
+                # weakref.finalize may run at interpreter shutdown,
+                # after logging/limiter teardown — nothing to tell
+                # tpflint: disable=swallowed-error
                 except Exception:
                     pass
 

@@ -273,6 +273,8 @@ class CompactionController(Controller):
                 by_node, _ = self.allocator.check_quota_and_filter(
                     probe, skip_quota=True)
             except Exception:  # noqa: BLE001
+                log.debug("defrag placement probe failed for %s",
+                          pod.key(), exc_info=True)
                 by_node = {}
             if not by_node:
                 self._mark_skip(node, f"{pod.key()} has no alternative "
@@ -534,6 +536,8 @@ class LiveMigrator:
                 by_node, _ = self.allocator.check_quota_and_filter(
                     probe, skip_quota=True)
             except Exception:  # noqa: BLE001
+                log.debug("migration placement probe failed for %s",
+                          key, exc_info=True)
                 by_node = {}
             if not by_node:
                 log.warning("migration of %s aborted: no alternative "

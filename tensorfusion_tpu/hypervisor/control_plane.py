@@ -131,6 +131,8 @@ class ControlPlaneBackend(Backend):
         try:
             stats = self.devices.proc_stats()
         except Exception:  # noqa: BLE001 - provider probe must not kill
+            log.debug("proc_stats probe failed; skipping external-chip "
+                      "detection this tick", exc_info=True)
             return set()
         known = self.known_pids()
         return {s.chip_id for s in stats

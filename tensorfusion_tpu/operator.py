@@ -34,7 +34,8 @@ from .controllers.defrag import CompactionController, LiveMigrator
 from .controllers.rollout import RolloutController
 from .scheduler import GangManager, ICITopologyPlugin, Scheduler, TPUResourcesFit
 from .scheduler.expander import NodeExpander
-from .store import ConflictError, NotFoundError, ObjectStore
+from .store import (AlreadyExistsError, ConflictError, NotFoundError,
+                    ObjectStore)
 from .storecache import StoreCache
 from .webhook.mutator import PodMutator
 from .webhook.parser import WorkloadParser
@@ -464,8 +465,8 @@ class Operator:
         node.status.phase = constants.PHASE_RUNNING
         try:
             self.store.create(node)
-        except Exception:
-            pass
+        except AlreadyExistsError:
+            pass    # re-registration of a known host is routine
         for chip in chips:
             chip.status.node_name = node_name
             self.store.update_or_create(chip)
@@ -584,8 +585,8 @@ def main(argv=None, stop_event: Optional[threading.Event] = None) -> int:
         claim.spec.chip_count = int(chips or 8)
         try:
             store.create(claim)
-        except Exception:
-            pass
+        except AlreadyExistsError:
+            pass    # a concurrent replica bootstrapped it first
     server = OperatorServer(op, host=args.host, port=args.port,
                             store_token=args.store_token,
                             store_tokens={"node": args.node_token,

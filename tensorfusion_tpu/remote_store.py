@@ -246,8 +246,8 @@ class RemoteStore:
                     payload = {}
                     try:
                         payload = json.loads(raw or b"{}")
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except ValueError:
+                        pass    # non-JSON error body: keep the status
                     api_err = (r.status, payload)
                 else:
                     return json.loads(raw or b"{}")
@@ -422,4 +422,6 @@ class RemoteStore:
                                         context=self._ssl_ctx) as r:
                 return r.status == 200
         except Exception:  # noqa: BLE001
+            log.debug("healthz ping to %s failed", self.base_url,
+                      exc_info=True)
             return False

@@ -289,6 +289,10 @@ class RemoteDevice:
         the EXECUTE that references them)."""
         with self._send_lock:
             if self._sock is None:
+                # connect is deliberately serialized under the send
+                # lock: a racing sender must wait for the socket, not
+                # dial a second one
+                # tpflint: disable=transitive-blocking-under-lock
                 self._connect_locked()
             fut: Optional[Future] = None
             if want_reply:
@@ -305,7 +309,7 @@ class RemoteDevice:
                 # on the shared socket (interleaved sendalls would tear
                 # frames); replies arrive on the reader thread, so the
                 # send is the only thing ever under it
-                # tpflint: disable=blocking-under-lock
+                # tpflint: disable=blocking-under-lock,transitive-blocking-under-lock
                 send_message(self._sock, kind, wire_meta, buffers,
                              compress=compress,
                              version=self._wire_version)
@@ -322,12 +326,14 @@ class RemoteDevice:
                 if self._sock is not None:
                     self._sock.close()
                     self._sock = None
+                # same story as above: reconnect under the serializer
+                # tpflint: disable=transitive-blocking-under-lock
                 self._connect_locked()
                 if want_reply:
                     with self._state_lock:
                         self._pending[seq] = fut
                 # retry after reconnect: same frame-serialization story
-                # tpflint: disable=blocking-under-lock
+                # tpflint: disable=blocking-under-lock,transitive-blocking-under-lock
                 send_message(self._sock, kind, wire_meta, buffers,
                              compress=compress,
                              version=self._wire_version)
@@ -454,6 +460,9 @@ class RemoteDevice:
                         analysis = analysis[0] if analysis else {}
                     mflops = max(int(analysis.get("flops", 0) / 1e6), 1)
                 except Exception:  # noqa: BLE001
+                    log.debug("cost analysis failed; flat-rate QoS "
+                              "charge for this executable",
+                              exc_info=True)
                     mflops = 1
                 cmeta: Dict[str, Any] = {"mflops_hint": mflops}
                 if microbatch:

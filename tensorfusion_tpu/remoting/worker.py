@@ -316,7 +316,7 @@ class RemoteVTPUWorker:
                                 # serializer (dispatcher thread replies
                                 # race the handler thread's); the send
                                 # IS the critical section
-                                # tpflint: disable=blocking-under-lock
+                                # tpflint: disable=blocking-under-lock,transitive-blocking-under-lock
                                 send_message(self.request, rkind, rmeta,
                                              rbufs,
                                              compress=compress
@@ -751,6 +751,8 @@ class RemoteVTPUWorker:
             mflops = max(int((exe.cost_analysis() or {})
                              .get("flops", 0) / 1e6), 1)
         except Exception:  # noqa: BLE001 - cost is advisory
+            log.debug("cost analysis failed; flat-rate dispatch cost",
+                      exc_info=True)
             mflops = 1
         return exe, sig, mflops
 
@@ -863,6 +865,8 @@ class RemoteVTPUWorker:
                 pool.submit(jax.device_put, np.asarray(b))
                 for b in nxt.buffers]
         except Exception:  # noqa: BLE001 - overlap is advisory
+            log.debug("prefetch overlap failed; EXECUTE will transfer "
+                      "inline", exc_info=True)
             nxt.meta.pop("_dev_args", None)
 
     def _stacked_fn(self, exe_id: str, k: int):
@@ -1127,8 +1131,11 @@ class RemoteVTPUWorker:
             for buf_id, arr in snapshot.items():
                 try:
                     arr = self._resolve(arr)
+                # a failed async PUT surfaces at the EXECUTE that uses
+                # the buffer; the INFO stats loop just skips it
+                # tpflint: disable=swallowed-error
                 except Exception:  # noqa: BLE001 - failed async PUT
-                    continue       # surfaces at the EXECUTE that uses it
+                    continue
                 shards = getattr(arr, "addressable_shards", None)
                 if shards and len(shards) > 1:
                     for s in shards:

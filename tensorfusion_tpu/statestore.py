@@ -107,6 +107,9 @@ class StateStoreServer:
                     log.exception("%s %s", method, self.path)
                     try:
                         self._send(500, {"error": str(e)})
+                    # the failure above is already logged; the peer
+                    # hanging up before reading the 500 adds nothing
+                    # tpflint: disable=swallowed-error
                     except Exception:  # noqa: BLE001 - peer gone
                         pass
 

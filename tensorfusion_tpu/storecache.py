@@ -161,6 +161,10 @@ class StoreCache:
             try:
                 value = key_fn(obj)
             except Exception:  # noqa: BLE001
+                # a broken indexer silently empties its index — the
+                # scheduler would see zero pods on every node
+                log.exception("indexer %s/%s failed on %s",
+                              kind, index_name, key)
                 continue
             if value is None:
                 continue
@@ -172,6 +176,8 @@ class StoreCache:
             try:
                 value = key_fn(obj)
             except Exception:  # noqa: BLE001
+                log.exception("indexer %s/%s failed unindexing %s",
+                              kind, index_name, key)
                 continue
             if value is None:
                 continue

@@ -217,6 +217,8 @@ class StoreLeaderElector:
         try:
             lease = self.store.try_get(Lease, self.LEASE_NAME)
         except Exception:  # noqa: BLE001 - transport error = unknown
+            log.debug("lease read failed; leader unknown",
+                      exc_info=True)
             return None
         if lease is None:
             return None
@@ -254,6 +256,8 @@ class StoreLeaderElector:
         try:
             lease = self.store.try_get(Lease, self.LEASE_NAME)
         except Exception:  # noqa: BLE001 - store unreachable
+            log.debug("lease read failed; not campaigning this tick",
+                      exc_info=True)
             return False
         now = time.time()
         try:
@@ -274,6 +278,8 @@ class StoreLeaderElector:
         except (ConflictError, AlreadyExistsError):
             return False          # a concurrent challenger won
         except Exception:  # noqa: BLE001
+            log.debug("lease acquire failed; retrying next tick",
+                      exc_info=True)
             return False
         self.fencing_token = lease.spec.fencing_token
         return True
@@ -302,6 +308,7 @@ class StoreLeaderElector:
         except Exception:  # noqa: BLE001 - store unreachable: fail safe
             # and drop leadership rather than risk split-brain past the
             # lease duration
+            log.debug("lease renew failed; demoting", exc_info=True)
             return False
 
     def _demote(self) -> None:
@@ -327,4 +334,5 @@ class StoreLeaderElector:
                 lease.spec.renew_time = 0.0
                 self.store.update(lease, check_version=True)
         except Exception:  # noqa: BLE001 - best effort
-            pass
+            log.debug("graceful lease handoff failed; successor waits "
+                      "out the TTL", exc_info=True)

@@ -584,7 +584,9 @@ def test_repo_lints_clean_with_committed_baseline():
     assert stale == []
 
 
-def test_all_six_checkers_registered():
-    assert set(ALL_CHECKS) == {
-        "stale-write-back", "frozen-view-mutation", "blocking-under-lock",
-        "guarded-field", "protocol-exhaustive", "metrics-schema"}
+def test_lexical_checkers_still_registered():
+    # the full 11-checker registry is asserted in test_tpflint_graph.py;
+    # here: the PR 3 lexical six can never silently drop out
+    assert {"stale-write-back", "frozen-view-mutation",
+            "blocking-under-lock", "guarded-field",
+            "protocol-exhaustive", "metrics-schema"} <= set(ALL_CHECKS)

@@ -1,0 +1,671 @@
+"""tpfgraph test corpus: symbol resolution, the four interprocedural
+checkers, the mtime-keyed facts cache, and the JSON output mode.
+
+Mirrors the PR 3 shape (tests/test_tpflint.py): known-bad fixtures
+fire, known-good fixtures stay silent, disable comments are honored,
+and the repo itself is clean at HEAD under every new checker.  Runs in
+tier-1; tools/pycov.py counts this suite's coverage of tools/tpflint/
+toward the gate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from tools.tpflint.checkers import (ALL_CHECKS, leaked_resource,
+                                    lock_order, swallowed_error,
+                                    transitive_blocking, unjoined_thread)
+from tools.tpflint.core import (SourceFile, apply_baseline,
+                                load_baseline, run_paths)
+from tools.tpflint.graph import (FactsCache, ProjectGraph, chain_of,
+                                 module_name)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def graph_of(files: dict) -> ProjectGraph:
+    srcs = {rel: SourceFile(rel, rel, textwrap.dedent(code))
+            for rel, code in files.items()}
+    return ProjectGraph(srcs, "/nonexistent", FactsCache(None))
+
+
+# -- symbol table + resolution ---------------------------------------------
+
+RESOLVE_TREE = {
+    "pkg/base.py": """
+        class Base:
+            def ping(self):
+                return 1
+    """,
+    "pkg/util.py": """
+        def helper():
+            return 2
+
+        class Util:
+            def poke(self):
+                return 3
+    """,
+    "pkg/mod.py": """
+        import pkg.util
+        import pkg.util as u
+        from .util import helper as h
+        from .base import Base
+
+        def top():
+            h()
+            pkg.util.helper()
+            u.helper()
+
+        class C(Base):
+            def a(self):
+                self.b()
+                self.ping()
+
+            def b(self):
+                return top()
+    """,
+}
+
+
+def test_module_name_mapping():
+    assert module_name("pkg/mod.py") == "pkg.mod"
+    assert module_name("pkg/__init__.py") == "pkg"
+    assert module_name("tensorfusion_tpu/api/meta.py") == \
+        "tensorfusion_tpu.api.meta"
+
+
+def test_resolution_self_module_and_aliased_imports():
+    g = graph_of(RESOLVE_TREE)
+    top = g.funcs["pkg.mod.top"]
+    a = g.funcs["pkg.mod.C.a"]
+    b = g.funcs["pkg.mod.C.b"]
+    # aliased from-import, dotted module path, aliased module import
+    assert g.resolve_call(top, "h") == "pkg.util.helper"
+    assert g.resolve_call(top, "pkg.util.helper") == "pkg.util.helper"
+    assert g.resolve_call(top, "u.helper") == "pkg.util.helper"
+    # self.method in the same class; inherited through the base class
+    assert g.resolve_call(a, "self.b") == "pkg.mod.C.b"
+    assert g.resolve_call(a, "self.ping") == "pkg.base.Base.ping"
+    # bare call to a module-level function
+    assert g.resolve_call(b, "top") == "pkg.mod.top"
+    # unknown receivers resolve to nothing (no guessing)
+    assert g.resolve_call(a, "self.store.update") is None
+    assert g.resolve_call(a, "mystery") is None
+
+
+def test_condition_variable_aliases_to_wrapped_lock():
+    g = graph_of({"pkg/s.py": """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self._cond = threading.Condition(self._lock)
+                self._cv = threading.Condition()
+
+            def f(self):
+                with self._cond:
+                    pass
+    """})
+    f = g.funcs["pkg.s.S.f"]
+    # Condition(self._lock) IS self._lock for ordering purposes
+    assert g.canonical_lock(f, "self._cond") == \
+        g.canonical_lock(f, "self._lock")
+    # a bare Condition owns its own lock -> its own vertex
+    assert g.canonical_lock(f, "self._cv")[0] != \
+        g.canonical_lock(f, "self._lock")[0]
+
+
+# -- lock-order-inversion ---------------------------------------------------
+
+LOCK_CYCLE_DIRECT = {
+    "pkg/m.py": """
+        import threading
+
+        class M:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def fwd(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        return 1
+
+            def rev(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        return 2
+    """,
+}
+
+LOCK_CYCLE_INTERPROCEDURAL = {
+    "pkg/store.py": """
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def locked_op(self):
+                with self._lock:
+                    return 1
+    """,
+    "pkg/ctrl.py": """
+        import threading
+        from .store import Store
+
+        class Ctrl:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.store = Store()
+
+            def uses_store(self):
+                with self._lock:
+                    return self._indirect()
+
+            def _indirect(self):
+                return self.store.locked_op()
+    """,
+    "pkg/rev.py": """
+        from .ctrl import Ctrl
+        from .store import Store
+
+        class Rev:
+            def __init__(self, store: Store, ctrl: Ctrl):
+                self.store = store
+                self.ctrl = ctrl
+
+            def reverse(self):
+                with self.store._lock:
+                    with self.ctrl._lock:
+                        return 3
+    """,
+}
+
+
+def test_lock_order_direct_inversion_with_witness_paths():
+    findings = lock_order.run_graph(graph_of(LOCK_CYCLE_DIRECT))
+    assert len(findings) == 1
+    f = findings[0]
+    assert "deadlock" in f.message
+    assert "_a_lock" in f.key and "_b_lock" in f.key
+    # both acquisition paths named, each with file:line frames
+    assert len(f.witness) == 2
+    assert any("M.fwd" in w for w in f.witness)
+    assert any("M.rev" in w for w in f.witness)
+    assert all("pkg/m.py:" in w for w in f.witness)
+
+
+def test_lock_order_consistent_order_is_clean():
+    consistent = {"pkg/m.py": LOCK_CYCLE_DIRECT["pkg/m.py"].replace(
+        "with self._b_lock:\n                    with self._a_lock:",
+        "with self._a_lock:\n                    with self._b_lock:")}
+    assert lock_order.run_graph(graph_of(consistent)) == []
+
+
+def test_lock_order_cycle_through_call_graph():
+    """Ctrl._lock -> Store._lock via a 2-deep call chain, inverted by
+    a third module taking them the other way round."""
+    findings = lock_order.run_graph(graph_of(LOCK_CYCLE_INTERPROCEDURAL))
+    assert len(findings) == 1
+    msg = findings[0].message
+    assert "Ctrl._lock" in msg and "Store._lock" in msg
+    # the interprocedural edge carries the call chain as the witness
+    assert "calls self._indirect" in msg
+
+
+def test_lock_order_rlock_reentry_is_not_a_cycle():
+    g = graph_of({"pkg/r.py": """
+        import threading
+
+        class R:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def outer(self):
+                with self._lock:
+                    return self.inner()
+
+            def inner(self):
+                with self._lock:
+                    return 1
+    """})
+    assert lock_order.run_graph(g) == []
+
+
+# -- transitive-blocking-under-lock ----------------------------------------
+
+BLOCKING_TWO_DEEP = {
+    "pkg/w.py": """
+        import threading
+        import time
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def tick(self):
+                with self._lock:
+                    self._level1()
+
+            def _level1(self):
+                return self._level2()
+
+            def _level2(self):
+                time.sleep(0.5)
+    """,
+}
+
+
+def test_transitive_blocking_through_two_call_levels():
+    findings = transitive_blocking.run_graph(graph_of(BLOCKING_TWO_DEEP))
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.symbol == "W.tick"
+    assert "time.sleep() parks the thread" in f.message
+    # witness chain walks every frame down to the sleep
+    assert len(f.witness) == 2
+    assert "_level1" in f.witness[0] and "_level2" in f.witness[1]
+
+
+def test_transitive_blocking_condvar_context_is_exempt():
+    cv = {"pkg/w.py": BLOCKING_TWO_DEEP["pkg/w.py"].replace(
+        "self._lock = threading.Lock()",
+        "self._cv = threading.Condition()").replace(
+        "with self._lock:", "with self._cv:")}
+    assert transitive_blocking.run_graph(graph_of(cv)) == []
+
+
+def test_transitive_blocking_thread_target_edge_is_async():
+    g = graph_of({"pkg/w.py": """
+        import threading
+        import time
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def kick(self):
+                with self._lock:
+                    threading.Thread(target=self._slow,
+                                     daemon=True).start()
+
+            def _slow(self):
+                time.sleep(1)
+    """})
+    # the target runs on its own thread: no blocking under kick's lock
+    assert transitive_blocking.run_graph(g) == []
+
+
+def test_transitive_blocking_direct_sleep_left_to_lexical_checker():
+    g = graph_of({"pkg/w.py": """
+        import threading
+        import time
+
+        class W:
+            def f(self):
+                with self._lock:
+                    time.sleep(1)
+    """})
+    # the PR 3 checker owns the lexical case; no double report
+    assert transitive_blocking.run_graph(g) == []
+
+
+# -- swallowed-error --------------------------------------------------------
+
+SWALLOW_BAD = """
+    class C:
+        def run(self):
+            try:
+                self.step()
+            except Exception:
+                pass
+"""
+
+SWALLOW_GOOD_VARIANTS = [
+    # logs via the project logger
+    """
+    import logging
+    log = logging.getLogger("tpf.x")
+
+    class C:
+        def run(self):
+            try:
+                self.step()
+            except Exception:
+                log.exception("step failed")
+    """,
+    # re-raises
+    """
+    class C:
+        def run(self):
+            try:
+                self.step()
+            except Exception:
+                raise RuntimeError("wrapped")
+    """,
+    # inspects the bound exception (recorded/classified by a human)
+    """
+    class C:
+        def run(self):
+            try:
+                self.step()
+            except Exception as e:
+                self.last_error = str(e)
+    """,
+    # narrow except is out of scope
+    """
+    class C:
+        def run(self):
+            try:
+                self.step()
+            except ValueError:
+                pass
+    """,
+]
+
+
+def test_swallowed_error_flags_silent_broad_handler():
+    findings = swallowed_error.run_graph(graph_of({"pkg/c.py":
+                                                   SWALLOW_BAD}))
+    assert len(findings) == 1
+    assert findings[0].symbol == "C.run"
+    assert "swallows" in findings[0].message
+
+
+def test_swallowed_error_bare_except_flagged():
+    bare = SWALLOW_BAD.replace("except Exception:", "except:")
+    findings = swallowed_error.run_graph(graph_of({"pkg/c.py": bare}))
+    assert len(findings) == 1
+    assert "bare except:" in findings[0].message
+
+
+@pytest.mark.parametrize("code", SWALLOW_GOOD_VARIANTS)
+def test_swallowed_error_good_variants_pass(code):
+    assert swallowed_error.run_graph(graph_of({"pkg/c.py": code})) == []
+
+
+def test_swallowed_error_callee_that_logs_counts_as_handled():
+    g = graph_of({"pkg/c.py": """
+        import logging
+        log = logging.getLogger("tpf.x")
+
+        def _record_failure():
+            log.warning("degraded")
+
+        class C:
+            def run(self):
+                try:
+                    self.step()
+                except Exception:
+                    _record_failure()
+    """})
+    assert swallowed_error.run_graph(g) == []
+
+
+def test_swallowed_error_disable_comment_honored(tmp_path):
+    code = textwrap.dedent("""
+        class C:
+            def run(self):
+                try:
+                    self.step()
+                # probe path: silence is the design here
+                # tpflint: disable=swallowed-error
+                except Exception:
+                    pass
+    """)
+    (tmp_path / "mod.py").write_text(code)
+    findings = run_paths([str(tmp_path / "mod.py")], str(tmp_path),
+                         checks={"swallowed-error"}, use_cache=False)
+    assert findings == []
+
+
+# -- unjoined-thread --------------------------------------------------------
+
+THREAD_BAD_SELF_ATTR = """
+    import threading
+
+    class C:
+        def start(self):
+            self._thread = threading.Thread(target=self._loop)
+            self._thread.start()
+"""
+
+THREAD_GOOD_JOINED_IN_STOP = THREAD_BAD_SELF_ATTR + """
+        def stop(self):
+            self._thread.join(timeout=2)
+"""
+
+THREAD_GOOD_JOINED_VIA_ALIAS = THREAD_BAD_SELF_ATTR + """
+        def stop(self):
+            t = self._thread
+            t.join(timeout=2)
+"""
+
+
+def test_unjoined_thread_flags_never_joined_attr():
+    findings = unjoined_thread.run_graph(
+        graph_of({"pkg/c.py": THREAD_BAD_SELF_ATTR}))
+    assert len(findings) == 1
+    assert findings[0].key == "self._thread"
+    assert "join-or-daemon" in findings[0].message
+
+
+def test_unjoined_thread_join_in_any_method_passes():
+    for good in (THREAD_GOOD_JOINED_IN_STOP,
+                 THREAD_GOOD_JOINED_VIA_ALIAS):
+        assert unjoined_thread.run_graph(
+            graph_of({"pkg/c.py": good})) == [], good
+
+
+def test_unjoined_thread_daemon_and_handoff_pass():
+    g = graph_of({"pkg/c.py": """
+        import threading
+
+        class C:
+            def a(self):
+                self._t = threading.Thread(target=self._loop,
+                                           daemon=True)
+                self._t.start()
+
+            def b(self):
+                t = threading.Thread(target=self._loop)
+                t.daemon = True
+                t.start()
+
+            def c(self):
+                t = threading.Thread(target=self._loop)
+                t.start()
+                self._threads.append(t)
+
+            def d(self):
+                t = threading.Thread(target=self._loop)
+                t.start()
+                t.join()
+    """})
+    assert unjoined_thread.run_graph(g) == []
+
+
+def test_unjoined_thread_inline_fire_and_forget_flagged():
+    g = graph_of({"pkg/c.py": """
+        import threading
+
+        def kick(fn):
+            threading.Thread(target=fn).start()
+    """})
+    findings = unjoined_thread.run_graph(g)
+    assert len(findings) == 1
+    assert findings[0].key == "<inline>"
+
+
+# -- leaked-resource --------------------------------------------------------
+
+def test_leaked_resource_socket_never_closed_flagged():
+    g = graph_of({"pkg/n.py": """
+        import socket
+
+        def probe(host):
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            s.connect((host, 1))
+            return s.getsockname()[0]
+    """})
+    findings = leaked_resource.run_graph(g)
+    assert len(findings) == 1
+    assert findings[0].key == "s"
+
+
+def test_leaked_resource_managed_variants_pass():
+    g = graph_of({"pkg/n.py": """
+        import socket
+
+        def closed(host):
+            s = socket.socket()
+            try:
+                s.connect((host, 1))
+                return s.getsockname()[0]
+            finally:
+                s.close()
+
+        def handed_off(host):
+            s = socket.create_connection((host, 80))
+            return wrap(s)
+
+        def returned(host):
+            s = socket.create_connection((host, 80))
+            return s
+
+        def stored(self, host):
+            s = socket.create_connection((host, 80))
+            self._sock = s
+    """})
+    assert leaked_resource.run_graph(g) == []
+
+
+# -- facts cache ------------------------------------------------------------
+
+CACHED_TREE = {
+    "pkg/a.py": """
+        def fa():
+            return 1
+    """,
+    "pkg/b.py": """
+        def fb():
+            return 2
+    """,
+}
+
+
+def _write_tree(root, tree=None):
+    for rel, code in (tree or CACHED_TREE).items():
+        path = os.path.join(str(root), rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(textwrap.dedent(code))
+
+
+def test_cache_hit_on_second_run_and_invalidation(tmp_path):
+    _write_tree(tmp_path)
+    stats: dict = {}
+    run_paths(["pkg"], str(tmp_path), stats=stats)
+    assert stats == {"cache_hits": 0, "cache_misses": 2}
+    assert os.path.exists(str(tmp_path / ".tpflint-cache.json"))
+    # warm: everything served from the cache
+    stats = {}
+    run_paths(["pkg"], str(tmp_path), stats=stats)
+    assert stats == {"cache_hits": 2, "cache_misses": 0}
+    # edit ONE file (content + mtime): only it is re-analyzed
+    edited = tmp_path / "pkg" / "a.py"
+    edited.write_text("def fa():\n    return 99\n")
+    os.utime(str(edited), (1e9, 1e9))
+    stats = {}
+    run_paths(["pkg"], str(tmp_path), stats=stats)
+    assert stats == {"cache_hits": 1, "cache_misses": 1}
+
+
+def test_cache_escape_hatches(tmp_path, monkeypatch):
+    _write_tree(tmp_path)
+    stats: dict = {}
+    run_paths(["pkg"], str(tmp_path), stats=stats)
+    # TPF_LINT_NO_CACHE=1: re-extract everything, cache untouched
+    monkeypatch.setenv("TPF_LINT_NO_CACHE", "1")
+    stats = {}
+    run_paths(["pkg"], str(tmp_path), stats=stats)
+    assert stats == {"cache_hits": 0, "cache_misses": 2}
+    monkeypatch.delenv("TPF_LINT_NO_CACHE")
+    # use_cache=False does the same programmatically
+    stats = {}
+    run_paths(["pkg"], str(tmp_path), use_cache=False, stats=stats)
+    assert stats == {"cache_hits": 0, "cache_misses": 2}
+
+
+def test_corrupt_cache_is_rebuilt_not_fatal(tmp_path):
+    _write_tree(tmp_path)
+    (tmp_path / ".tpflint-cache.json").write_text("{not json")
+    stats: dict = {}
+    run_paths(["pkg"], str(tmp_path), stats=stats)
+    assert stats == {"cache_hits": 0, "cache_misses": 2}
+
+
+# -- JSON output ------------------------------------------------------------
+
+def test_json_format_carries_findings_and_witness(tmp_path, monkeypatch,
+                                                  capsys):
+    _write_tree(tmp_path, {"pkg/w.py": BLOCKING_TWO_DEEP["pkg/w.py"]})
+    monkeypatch.chdir(str(tmp_path))
+    from tools.tpflint.__main__ import main
+    rc = main(["pkg", "--no-baseline", "--format=json", "--no-cache"])
+    assert rc == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["counts"]["total"] == 1
+    (finding,) = report["findings"]
+    assert finding["check"] == "transitive-blocking-under-lock"
+    assert finding["fingerprint"].startswith("pkg/w.py::")
+    assert len(finding["witness"]) == 2
+    # --no-cache still counts extraction work; it just never persists
+    assert report["cache"] == {"hits": 0, "misses": 1}
+
+
+def test_json_format_clean_tree_ok(tmp_path, monkeypatch, capsys):
+    _write_tree(tmp_path)
+    monkeypatch.chdir(str(tmp_path))
+    from tools.tpflint.__main__ import main
+    rc = main(["pkg", "--format=json", "--no-cache",
+               "--baseline", "does-not-exist.json"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["ok"] is True and report["findings"] == []
+
+
+# -- the repo itself --------------------------------------------------------
+
+@pytest.mark.parametrize("check", [
+    "lock-order-inversion", "transitive-blocking-under-lock",
+    "swallowed-error", "unjoined-thread", "leaked-resource"])
+def test_repo_is_clean_at_head_per_graph_checker(check):
+    findings = run_paths(["tensorfusion_tpu", "tools"], REPO,
+                         checks={check}, use_cache=False)
+    baseline = load_baseline(os.path.join(REPO, "tools", "tpflint",
+                                          "baseline.json"))
+    new, stale = apply_baseline(findings, baseline)
+    assert new == [], [f.render() for f in new]
+
+
+def test_all_eleven_checkers_registered():
+    assert set(ALL_CHECKS) == {
+        "stale-write-back", "frozen-view-mutation", "blocking-under-lock",
+        "guarded-field", "protocol-exhaustive", "metrics-schema",
+        "lock-order-inversion", "transitive-blocking-under-lock",
+        "swallowed-error", "unjoined-thread", "leaked-resource"}
+
+
+def test_chain_of_shapes():
+    import ast
+    mod = ast.parse("self.a.b(x)\nfoo()\n(lambda: 0)()")
+    calls = [n for n in ast.walk(mod) if isinstance(n, ast.Call)]
+    chains = sorted(chain_of(c.func) for c in calls)
+    assert chains == ["", "foo", "self.a.b"]

@@ -2,11 +2,18 @@
 
 Exit codes: 0 clean (baseline may still hold tolerated debt), 1 new
 findings or stale baseline entries, 2 usage error.
+
+``--format=json`` emits a machine-readable report (findings with
+fingerprints and interprocedural witness chains, baseline verdict,
+cache counters) so CI and tooling consume results without scraping
+text.  ``--verbose`` prints the graph layer's cache hit/miss counters;
+``--no-cache`` (or ``TPF_LINT_NO_CACHE=1``) forces full re-extraction.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
@@ -14,7 +21,7 @@ from .checkers import ALL_CHECKS
 from .core import (apply_baseline, load_baseline, run_paths,
                    save_baseline)
 
-DEFAULT_PATHS = ["tensorfusion_tpu"]
+DEFAULT_PATHS = ["tensorfusion_tpu", "tools"]
 DEFAULT_BASELINE = os.path.join("tools", "tpflint", "baseline.json")
 
 
@@ -24,7 +31,7 @@ def main(argv=None) -> int:
         description="tpu-fusion project-invariant static analysis")
     parser.add_argument("paths", nargs="*", default=None,
                         help="files/directories to lint "
-                             "(default: tensorfusion_tpu)")
+                             "(default: tensorfusion_tpu tools)")
     parser.add_argument("--baseline", default=DEFAULT_BASELINE,
                         help="ratchet file (default: %(default)s)")
     parser.add_argument("--no-baseline", action="store_true",
@@ -36,6 +43,14 @@ def main(argv=None) -> int:
     parser.add_argument("--check", action="append", default=None,
                         metavar="NAME", choices=ALL_CHECKS,
                         help="run only the named checker(s)")
+    parser.add_argument("--format", default="text",
+                        choices=("text", "json"),
+                        help="output format (default: %(default)s)")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="bypass the graph facts cache "
+                             "(TPF_LINT_NO_CACHE=1 does the same)")
+    parser.add_argument("-v", "--verbose", action="store_true",
+                        help="print cache hit/miss counters")
     parser.add_argument("--list-checks", action="store_true")
     args = parser.parse_args(argv)
 
@@ -53,7 +68,14 @@ def main(argv=None) -> int:
             return 2
 
     checks = set(args.check) if args.check else None
-    findings = run_paths(paths, repo_root, checks=checks)
+    stats: dict = {}
+    findings = run_paths(paths, repo_root, checks=checks,
+                         use_cache=not args.no_cache, stats=stats)
+
+    if args.verbose and stats:
+        print(f"tpflint: graph cache: {stats.get('cache_hits', 0)} "
+              f"hit(s), {stats.get('cache_misses', 0)} miss(es)",
+              file=sys.stderr)
 
     if args.update_baseline:
         save_baseline(args.baseline, findings)
@@ -62,13 +84,21 @@ def main(argv=None) -> int:
         return 0
 
     if args.no_baseline:
-        for f in findings:
-            print(f.render())
-        print(f"tpflint: {len(findings)} finding(s)")
+        if args.format == "json":
+            print(json.dumps(_report(findings, [], [], stats),
+                             indent=2))
+        else:
+            for f in findings:
+                print(f.render())
+            print(f"tpflint: {len(findings)} finding(s)")
         return 1 if findings else 0
 
     baseline = load_baseline(args.baseline)
     new, stale = apply_baseline(findings, baseline)
+    if args.format == "json":
+        print(json.dumps(_report(findings, new, stale, stats),
+                         indent=2))
+        return 1 if (new or stale) else 0
     for f in new:
         print(f.render())
     for fp in stale:
@@ -91,6 +121,22 @@ def main(argv=None) -> int:
           f"{len(ALL_CHECKS) if checks is None else len(checks)} "
           f"checkers)")
     return 0
+
+
+def _report(findings, new, stale, stats) -> dict:
+    """The --format=json payload: everything the text mode prints,
+    structured."""
+    return {
+        "version": 1,
+        "findings": [f.to_dict() for f in findings],
+        "new": [f.fingerprint for f in new],
+        "stale": list(stale),
+        "counts": {"total": len(findings), "new": len(new),
+                   "stale": len(stale)},
+        "cache": {"hits": stats.get("cache_hits", 0),
+                  "misses": stats.get("cache_misses", 0)},
+        "ok": not new and not stale,
+    }
 
 
 if __name__ == "__main__":

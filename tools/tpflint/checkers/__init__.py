@@ -1,17 +1,23 @@
 """Checker registry.
 
 Adding a checker: create a module exposing ``CHECK`` (kebab-case name)
-and either ``run_file(sf) -> [Finding]`` (per-file) or
-``run_project(files, repo_root) -> [Finding]`` (cross-file), then list
-it below.  docs/static-analysis.md documents the contract.
+and one of ``run_file(sf) -> [Finding]`` (per-file),
+``run_project(files, repo_root) -> [Finding]`` (cross-file, raw ASTs)
+or ``run_graph(graph) -> [Finding]`` (interprocedural, over the cached
+:class:`tools.tpflint.graph.ProjectGraph`), then list it below.
+docs/static-analysis.md documents the contract.
 """
 
 from . import (blocking_under_lock, frozen_view_mutation, guarded_fields,
-               metrics_schema, protocol_exhaustive, stale_write_back)
+               leaked_resource, lock_order, metrics_schema,
+               protocol_exhaustive, stale_write_back, swallowed_error,
+               transitive_blocking, unjoined_thread)
 
 FILE_CHECKERS = (stale_write_back, frozen_view_mutation,
                  blocking_under_lock, guarded_fields)
 PROJECT_CHECKERS = (protocol_exhaustive, metrics_schema)
+GRAPH_CHECKERS = (lock_order, transitive_blocking, swallowed_error,
+                  unjoined_thread, leaked_resource)
 
 ALL_CHECKS = tuple(sorted(
-    c.CHECK for c in FILE_CHECKERS + PROJECT_CHECKERS))
+    c.CHECK for c in FILE_CHECKERS + PROJECT_CHECKERS + GRAPH_CHECKERS))

@@ -1,0 +1,51 @@
+"""leaked-resource: OS handles acquired without close on any path.
+
+Sockets (and anything else in :data:`tools.tpflint.graph.
+SOCKET_ACQUIRERS` — the registry is the extension point for device
+buffers and similar closeable acquisitions) hold file descriptors;
+a leaked one per reconnect attempt is an fd-exhaustion outage on a
+long-lived control plane.
+
+Flagged: a raw acquisition (``socket.socket(...)``,
+``socket.create_connection(...)``) assigned to a local variable that
+is then neither
+
+- closed (``.close()`` / ``.detach()`` / ``.shutdown()`` /
+  ``.makefile()`` — ownership moves into the file object), nor
+- managed by a ``with`` block, nor
+- handed off: passed as an argument, returned, or stored on ``self``
+  (the receiver owns it now — local data flow only, by design; the
+  graph layer's job here is knowing where ownership *left*, not
+  following it).
+
+The fix is a ``with``-block or a ``try/finally: close()``; if the
+handle intentionally outlives the function through some path the
+tracker cannot see, suppress inline with the justification.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..core import Finding
+from ..graph import ProjectGraph
+
+CHECK = "leaked-resource"
+
+
+def run_graph(graph: ProjectGraph) -> List[Finding]:
+    findings: List[Finding] = []
+    for full in sorted(graph.funcs):
+        func = graph.funcs[full]
+        for sock in func.facts["sockets"]:
+            if sock["closed"] or sock["escapes"]:
+                continue
+            findings.append(Finding(
+                check=CHECK, path=func.relpath, line=sock["line"],
+                symbol=func.symbol, key=sock["var"],
+                message=(f"socket {sock['var']} is acquired but never "
+                         f"closed, managed by `with`, or handed off on "
+                         f"any path — each call leaks a file "
+                         f"descriptor until the process hits its "
+                         f"rlimit.  Use `with` or try/finally close")))
+    return findings

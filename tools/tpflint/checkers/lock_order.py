@@ -1,0 +1,204 @@
+"""lock-order-inversion: global lock-acquisition ordering, whole program.
+
+Per-function lock hygiene cannot see a deadlock: thread 1 takes A then
+(three calls deep) B, thread 2 takes B then A, and every individual
+function looks fine.  With ~29 lock sites across store / remoting /
+hypervisor one inversion is the next race-class bug waiting to ship
+green.  This checker propagates per-function acquisition sets over the
+project call graph into one global lock-order graph:
+
+- ``with A: ... with B:`` adds edge A -> B (direct nesting);
+- a call made while holding A to a function that transitively acquires
+  B adds edge A -> B, remembering the full call chain as the witness;
+- ``# tpflint: holds=_lock`` annotations count as held context (the
+  caller takes the lock, the body's acquisitions order after it).
+
+Any cycle is a potential deadlock and is reported with the complete
+witness path for every edge — which function held what, where, and the
+chain through which the second lock is reached.
+
+Lock identity is **class-level** (``ObjectStore._lock`` is one vertex
+regardless of instance): ordering is a protocol between code paths, not
+between objects.  Consequences kept deliberate:
+
+- self-edges (A -> A) are skipped — same-lock reentry is the RLock /
+  guarded-field domain, and two *instances* of one class nesting their
+  own locks (a parent/child hierarchy) cannot be told apart statically;
+- condition variables canonicalize to the lock they wrap
+  (``Condition(self._lock)`` and ``self._lock`` are ONE vertex), and a
+  bare ``Condition()`` is its own vertex — acquiring it orders like any
+  lock even though its ``wait`` is exempt from the blocking checkers;
+- function-local locks can never appear in a cross-function cycle and
+  are excluded.
+
+One finding per strongly-connected component: fix (or justify) the
+reported cycle and re-run — nested inversions surface as the graph
+untangles.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from ..core import Finding
+from ..graph import ProjectGraph, Witness
+
+CHECK = "lock-order-inversion"
+
+
+def _short(lock_id: str) -> str:
+    """Readable lock name: drop the shared package prefix."""
+    parts = lock_id.split(".")
+    return ".".join(parts[-2:]) if len(parts) > 2 else lock_id
+
+
+class _Edge:
+    __slots__ = ("a", "b", "witness")
+
+    def __init__(self, a: str, b: str, witness: List[Witness]):
+        self.a = a
+        self.b = b
+        self.witness = witness
+
+    def render(self) -> str:
+        chain = " -> ".join(w.render() for w in self.witness)
+        return f"{_short(self.a)} -> {_short(self.b)}: {chain}"
+
+
+def _collect_edges(graph: ProjectGraph) -> Dict[Tuple[str, str], _Edge]:
+    edges: Dict[Tuple[str, str], _Edge] = {}
+
+    def add(a: str, b: str, witness: List[Witness]) -> None:
+        if a == b:
+            return
+        key = (a, b)
+        if key not in edges or len(witness) < len(edges[key].witness):
+            edges[key] = _Edge(a, b, witness)
+
+    for full in sorted(graph.funcs):
+        func = graph.funcs[full]
+        for acq in func.facts["acquires"]:
+            b_id, _ = graph.canonical_lock(func, acq["raw"])
+            site = Witness(func.relpath, acq["line"], func.symbol,
+                           note=f"with {acq['raw']}")
+            for held in acq["held"]:
+                a_id, a_kind = graph.canonical_lock(func, held)
+                if a_kind == "local":
+                    continue
+                add(a_id, b_id, [site])
+        for call, callee in graph.sync_callees(func):
+            locks = call["locks"]
+            if not locks:
+                continue
+            acquired = graph.acquired_locks(callee.full)
+            if not acquired:
+                continue
+            site = Witness(func.relpath, call["line"], func.symbol,
+                           note=f"calls {call['chain']}")
+            for held in locks:
+                a_id, a_kind = graph.canonical_lock(func, held)
+                if a_kind == "local":
+                    continue
+                for b_id, chain in acquired.items():
+                    add(a_id, b_id, [site] + chain)
+    return edges
+
+
+def _sccs(adj: Dict[str, List[str]]) -> List[List[str]]:
+    """Tarjan, iterative (the lock graph is small but recursion limits
+    are not a failure mode a linter should have)."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Dict[str, bool] = {}
+    stack: List[str] = []
+    out: List[List[str]] = []
+    counter = [0]
+
+    for root in sorted(adj):
+        if root in index:
+            continue
+        work = [(root, 0)]
+        while work:
+            node, pi = work[-1]
+            if pi == 0:
+                index[node] = low[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on_stack[node] = True
+            advanced = False
+            succs = adj.get(node, [])
+            for i in range(pi, len(succs)):
+                nxt = succs[i]
+                if nxt not in index:
+                    work[-1] = (node, i + 1)
+                    work.append((nxt, 0))
+                    advanced = True
+                    break
+                if on_stack.get(nxt):
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    v = stack.pop()
+                    on_stack[v] = False
+                    comp.append(v)
+                    if v == node:
+                        break
+                out.append(sorted(comp))
+    return out
+
+
+def _cycle_in(comp: List[str], adj: Dict[str, List[str]]
+              ) -> List[Tuple[str, str]]:
+    """A deterministic simple cycle inside one SCC, as edge pairs."""
+    comp_set = set(comp)
+    start = comp[0]
+    # BFS for the shortest path start -> ... -> start within the SCC
+    frontier: List[Tuple[str, List[Tuple[str, str]]]] = [(start, [])]
+    seen = {start}
+    while frontier:
+        nxt_frontier: List[Tuple[str, List[Tuple[str, str]]]] = []
+        for node, path in frontier:
+            for succ in adj.get(node, []):
+                if succ == start:
+                    return path + [(node, succ)]
+                if succ in comp_set and succ not in seen:
+                    seen.add(succ)
+                    nxt_frontier.append((succ, path + [(node, succ)]))
+        frontier = nxt_frontier
+    return []
+
+
+def run_graph(graph: ProjectGraph) -> List[Finding]:
+    edges = _collect_edges(graph)
+    adj: Dict[str, List[str]] = {}
+    for (a, b) in sorted(edges):
+        adj.setdefault(a, []).append(b)
+    findings: List[Finding] = []
+    for comp in _sccs(adj):
+        if len(comp) < 2:
+            continue
+        cycle = _cycle_in(comp, adj)
+        if not cycle:
+            continue
+        cycle_ids = [a for a, _ in cycle] + [cycle[0][0]]
+        label = " -> ".join(_short(x) for x in cycle_ids)
+        details = "; ".join(edges[e].render() for e in cycle)
+        first = edges[cycle[0]]
+        site = first.witness[0]
+        findings.append(Finding(
+            check=CHECK, path=site.path, line=site.line,
+            symbol=site.symbol, key=label,
+            message=(f"lock-order inversion: {label} — two threads "
+                     f"taking these locks in opposite order deadlock; "
+                     f"witness paths: {details}.  Pick one global "
+                     f"order (document it) or drop to one lock before "
+                     f"calling across the boundary"),
+            witness=[edges[e].render() for e in cycle]))
+    return findings

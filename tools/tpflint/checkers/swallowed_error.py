@@ -1,0 +1,68 @@
+"""swallowed-error: broad excepts that eat failures silently.
+
+A controller reconcile, a dispatch loop or a worker handler wrapped in
+``except Exception: pass`` turns every future bug into a silent outage:
+the thread keeps running, the metric keeps flatlining, and nothing ever
+reaches a log line.  The reference platform leans on Go's explicit
+``if err != nil`` discipline; our Python port's equivalent invariant is
+**no broad handler may drop the exception on the floor**.
+
+A handler is flagged when it catches broadly (``except Exception``,
+``except BaseException``, or bare ``except:``) and its body
+
+- never re-raises (no ``raise``),
+- never logs via the project logger (``log.*`` / ``logger.*`` /
+  ``logging.*`` / ``self.log.*``), directly **or** through a resolved
+  project call that itself logs (one level — enough for the
+  ``self._record_failure(...)`` pattern),
+- and never even *reads* the bound exception (``except Exception as
+  e`` where ``e`` is used is treated as handled: the error is being
+  recorded, returned or classified, which is a judgement call a human
+  already made).
+
+The fix is one line — ``log.exception(...)`` (or ``log.debug`` on
+genuinely chatty best-effort paths) — or narrowing the except to the
+errors actually expected.  Where silence *is* the design (probe-and-
+fall-back paths), suppress inline with a justification.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..core import Finding
+from ..graph import ProjectGraph
+
+CHECK = "swallowed-error"
+
+
+def run_graph(graph: ProjectGraph) -> List[Finding]:
+    findings: List[Finding] = []
+    for full in sorted(graph.funcs):
+        func = graph.funcs[full]
+        counter = 0
+        for exc in func.facts["excepts"]:
+            counter += 1
+            if exc["raises"] or exc["logs"] or exc["uses"]:
+                continue
+            handled = False
+            for chain in exc["calls"]:
+                target = graph.resolve_call(func, chain)
+                if target is not None and \
+                        graph.funcs[target].facts["logs"]:
+                    handled = True
+                    break
+            if handled:
+                continue
+            what = "bare except:" if exc["kind"] == "bare" else \
+                f"except {exc['kind']}:"
+            findings.append(Finding(
+                check=CHECK, path=func.relpath, line=exc["line"],
+                symbol=func.symbol, key=f"handler#{counter}",
+                message=(f"{what} swallows the failure — no re-raise, "
+                         f"no project-logger call, exception never "
+                         f"inspected; a bug in {func.symbol} vanishes "
+                         f"silently.  log.exception(...) it, narrow "
+                         f"the except, or suppress with a "
+                         f"justification if silence is the design")))
+    return findings

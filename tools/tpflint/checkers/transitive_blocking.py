@@ -1,0 +1,61 @@
+"""transitive-blocking-under-lock: the PR 3 checker, through calls.
+
+``blocking-under-lock`` sees ``time.sleep`` *lexically* inside a
+``with <lock>:`` body.  The moment the sleep moves into a helper —
+``self._backoff()`` — the hazard is invisible to a per-function pass
+while every thread contending on the lock still stalls behind it.  This
+checker follows resolved project calls: a function invoked while a lock
+is held that *transitively* sleeps, forks, does socket I/O, waits on an
+unbounded queue, or issues store RPCs fires, with the full call chain
+in the message.
+
+Scope mirrors the lexical checker: strictly-lockish context only
+(``*lock`` / ``*mutex`` / ``mu``; condition variables are exempt — their
+``wait`` releases the lock), and the blocking registry is literally the
+PR 3 one, so the two layers can never disagree about what "blocking"
+means.  Asynchronous callback edges (``Thread(target=...)``,
+``attach_listener``) never inherit the caller's lock context — the
+callee runs on another thread.  ``# tpflint: holds=_lock`` annotations
+count as held context, exactly as they do for lock ordering.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..core import Finding
+from ..graph import STRICT_LOCK_RE, ProjectGraph
+
+CHECK = "transitive-blocking-under-lock"
+
+
+def run_graph(graph: ProjectGraph) -> List[Finding]:
+    findings: List[Finding] = []
+    seen = set()
+    for full in sorted(graph.funcs):
+        func = graph.funcs[full]
+        for call, callee in graph.sync_callees(func):
+            strict = [h for h in call["locks"]
+                      if STRICT_LOCK_RE.search(h.rsplit(".", 1)[-1])]
+            if not strict:
+                continue
+            blocked = graph.blocks(callee.full)
+            if blocked is None:
+                continue
+            reason, chain = blocked
+            marker = (func.relpath, call["line"], call["chain"])
+            if marker in seen:
+                continue
+            seen.add(marker)
+            rendered = " -> ".join(w.render() for w in chain)
+            findings.append(Finding(
+                check=CHECK, path=func.relpath, line=call["line"],
+                symbol=func.symbol,
+                key=call["chain"].rsplit(".", 1)[-1],
+                message=(f"{call['chain']}() called under "
+                         f"`with {strict[-1]}:` transitively blocks — "
+                         f"{reason}; chain: {rendered}.  Every thread "
+                         f"contending on {strict[-1]} stalls behind "
+                         f"it: snapshot under the lock, call outside"),
+                witness=[w.render() for w in chain]))
+    return findings

@@ -1,0 +1,83 @@
+"""unjoined-thread: join-or-daemon discipline for spawned threads.
+
+A non-daemon thread nobody joins outlives its owner: it blocks
+interpreter shutdown, keeps reconciling against a store the test
+already tore down, and is exactly how the HA demote path once ran two
+concurrent reconcile loops for one controller.  The discipline the
+whole codebase follows — and this checker enforces — is:
+
+- ``daemon=True`` at construction (or ``t.daemon = True`` before
+  start) for fire-and-forget loops whose lifecycle a stop event
+  manages, **or**
+- a ``join()`` on every non-daemon thread: in the same function for
+  locals, in *any* method of the same class for ``self._thread``-style
+  attributes (``stop()`` joining what ``start()`` spawned is the
+  canonical shape — the checker resolves local aliases like
+  ``t = self._thread; t.join()``).
+
+A local thread that escapes the function (appended to a container,
+passed to a call, returned, stored on ``self`` via an alias) transfers
+ownership and is exempt — the receiver is accountable, and class-level
+join tracking picks up the stored form.  An inline
+``threading.Thread(...).start()`` with no daemon flag is always flagged:
+nothing can ever join it.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Set, Tuple
+
+from ..core import Finding
+from ..graph import ProjectGraph
+
+CHECK = "unjoined-thread"
+
+
+def run_graph(graph: ProjectGraph) -> List[Finding]:
+    # class-wide join / daemon-set chains: stop() joins start()'s thread
+    class_joins: Dict[Tuple[str, str], Set[str]] = {}
+    class_daemons: Dict[Tuple[str, str], Set[str]] = {}
+    for full, func in graph.funcs.items():
+        if func.cls is None:
+            continue
+        key = (func.module, func.cls)
+        class_joins.setdefault(key, set()).update(
+            j for j in func.facts["joins"] if j.startswith("self."))
+        class_daemons.setdefault(key, set()).update(
+            d for d in func.facts["daemon_sets"] if d.startswith("self."))
+
+    findings: List[Finding] = []
+    for full in sorted(graph.funcs):
+        func = graph.funcs[full]
+        facts = func.facts
+        for th in facts["threads"]:
+            if th["daemon"] is True:
+                continue
+            assigned = th["assigned"]
+            if assigned and assigned.startswith("self."):
+                key = (func.module, func.cls or "")
+                if assigned in class_joins.get(key, set()) or \
+                        assigned in class_daemons.get(key, set()):
+                    continue
+                where = f"{assigned} is never joined by any method " \
+                        f"of {func.cls}"
+            elif assigned:
+                if assigned in facts["joins"] or \
+                        assigned in facts["daemon_sets"] or \
+                        assigned in facts["escapes"]:
+                    continue
+                where = f"local {assigned} is never joined, stored " \
+                        f"or handed off in {func.symbol}"
+            else:
+                where = "inline Thread(...).start() can never be joined"
+            target = f" (target={th['target']})" if th["target"] else ""
+            findings.append(Finding(
+                check=CHECK, path=func.relpath, line=th["line"],
+                symbol=func.symbol, key=assigned or "<inline>",
+                message=(f"non-daemon thread{target} without "
+                         f"join-or-daemon discipline: {where} — it "
+                         f"outlives its owner, blocks shutdown and "
+                         f"keeps running against torn-down state.  "
+                         f"Pass daemon=True for stop-event-managed "
+                         f"loops, or join it where the owner stops")))
+    return findings

@@ -36,6 +36,9 @@ class Finding:
     symbol: str        # "Class.method", "function", or "<module>"
     message: str
     key: str = ""      # stable detail token (variable/field/opcode name)
+    #: interprocedural witness chain (rendered frames), when the
+    #: finding crosses functions — machine-readable via --format=json
+    witness: List[str] = field(default_factory=list)
 
     @property
     def fingerprint(self) -> str:
@@ -44,6 +47,13 @@ class Finding:
     def render(self) -> str:
         return (f"{self.path}:{self.line}: [{self.check}] {self.message}"
                 f"  ({self.symbol})")
+
+    def to_dict(self) -> dict:
+        return {"check": self.check, "path": self.path,
+                "line": self.line, "symbol": self.symbol,
+                "key": self.key, "message": self.message,
+                "fingerprint": self.fingerprint,
+                "witness": list(self.witness)}
 
 
 class SourceFile:
@@ -144,10 +154,19 @@ def collect_files(paths: Iterable[str], repo_root: str) -> List[SourceFile]:
 
 
 def run_paths(paths: Iterable[str], repo_root: str,
-              checks: Optional[Set[str]] = None) -> List[Finding]:
+              checks: Optional[Set[str]] = None,
+              use_cache: bool = True,
+              cache_path: Optional[str] = None,
+              stats: Optional[Dict[str, int]] = None) -> List[Finding]:
     """Run every registered checker over ``paths``; suppressions applied,
-    baseline NOT applied (that is the caller's policy step)."""
-    from .checkers import FILE_CHECKERS, PROJECT_CHECKERS
+    baseline NOT applied (that is the caller's policy step).
+
+    ``stats``, when given, receives the graph layer's cache counters
+    (``cache_hits`` / ``cache_misses``).  ``use_cache=False`` (or the
+    ``TPF_LINT_NO_CACHE=1`` environment variable) forces a full
+    re-extraction."""
+    from .checkers import (FILE_CHECKERS, GRAPH_CHECKERS,
+                           PROJECT_CHECKERS)
 
     files = collect_files(paths, repo_root)
     by_rel = {sf.relpath: sf for sf in files}
@@ -161,6 +180,18 @@ def run_paths(paths: Iterable[str], repo_root: str,
         if checks and checker.CHECK not in checks:
             continue
         findings.extend(checker.run_project(by_rel, repo_root))
+    graph_checkers = [c for c in GRAPH_CHECKERS
+                      if not checks or c.CHECK in checks]
+    if graph_checkers:
+        from .graph import ProjectGraph
+        graph = ProjectGraph.build(by_rel, repo_root,
+                                   use_cache=use_cache,
+                                   cache_path=cache_path)
+        for checker in graph_checkers:
+            findings.extend(checker.run_graph(graph))
+        if stats is not None:
+            stats["cache_hits"] = graph.cache.hits
+            stats["cache_misses"] = graph.cache.misses
     kept = []
     for f in findings:
         sf = by_rel.get(f.path)

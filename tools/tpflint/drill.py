@@ -1,0 +1,170 @@
+"""Lint drills: prove every graph checker still fires on live code.
+
+A checker that silently stopped firing is worse than no checker — CI
+stays green while the invariant rots.  ``make lint-drill`` re-introduces
+one known-bad pattern per checker into a **disposable copy** of
+``tensorfusion_tpu/`` (the working tree is never touched) and asserts
+the linter fails with the expected finding:
+
+- **lock-order-inversion**: a method taking ``ObjectStore._lock`` then
+  ``_journal_drain_lock`` — the exact inversion of the journal
+  flusher's established ``drain-lock -> _lock`` order — must produce a
+  witness cycle naming both acquisition paths;
+- **transitive-blocking-under-lock**: a sleep moved one call deep under
+  the store lock must be found through the call graph;
+- **swallowed-error** / **unjoined-thread** / **leaked-resource**: the
+  canonical bad shapes, dropped into a controller.
+
+Run: ``python -m tools.tpflint.drill`` from the repo root (exit 0 =
+every drill failed lint the way it should).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+import tempfile
+
+from .core import run_paths
+
+#: (name, checker, target file, anchor, insertion, expected substrings)
+#: — the insertion lands immediately BEFORE the anchor line, inheriting
+#: its indentation context (all anchors are method ``def`` lines)
+DRILLS = [
+    (
+        "lock-order-inversion",
+        "lock-order-inversion",
+        "tensorfusion_tpu/store.py",
+        "    def close(self) -> None:",
+        (
+            "    def _drill_inverted(self) -> int:\n"
+            "        with self._lock:\n"
+            "            with self._journal_drain_lock:\n"
+            "                return len(self._journal_lines)\n"
+            "\n"
+        ),
+        ["ObjectStore._lock", "_journal_drain_lock", "deadlock"],
+    ),
+    (
+        "transitive-blocking-under-lock",
+        "transitive-blocking-under-lock",
+        "tensorfusion_tpu/store.py",
+        "    def close(self) -> None:",
+        (
+            "    def _drill_backoff(self) -> None:\n"
+            "        import time\n"
+            "        time.sleep(0.01)\n"
+            "\n"
+            "    def _drill_blocking(self) -> None:\n"
+            "        with self._lock:\n"
+            "            self._drill_backoff()\n"
+            "\n"
+        ),
+        ["_drill_backoff", "transitively blocks", "time.sleep"],
+    ),
+    (
+        "swallowed-error",
+        "swallowed-error",
+        "tensorfusion_tpu/controllers/core.py",
+        "    def reconcile(self, event):",
+        (
+            "    def _drill_swallow(self):\n"
+            "        try:\n"
+            "            self._poke()\n"
+            "        except Exception:\n"
+            "            pass\n"
+            "\n"
+        ),
+        ["swallows the failure"],
+    ),
+    (
+        "unjoined-thread",
+        "unjoined-thread",
+        "tensorfusion_tpu/controllers/core.py",
+        "    def reconcile(self, event):",
+        (
+            "    def _drill_thread(self):\n"
+            "        t = threading.Thread(target=self._poke)\n"
+            "        t.start()\n"
+            "\n"
+        ),
+        ["join-or-daemon"],
+    ),
+    (
+        "leaked-resource",
+        "leaked-resource",
+        "tensorfusion_tpu/controllers/core.py",
+        "    def reconcile(self, event):",
+        (
+            "    def _drill_leak(self):\n"
+            "        import socket\n"
+            "        s = socket.socket()\n"
+            "        return s.fileno()\n"
+            "\n"
+        ),
+        ["never", "closed"],
+    ),
+]
+
+
+def run_drill(tmp_root: str, name: str, check: str, target: str,
+              anchor: str, insertion: str, expected: list) -> bool:
+    path = os.path.join(tmp_root, target)
+    with open(path, encoding="utf-8") as f:
+        original = f.read()
+    if anchor not in original:
+        print(f"drill {name}: FAIL — anchor not found in {target} "
+              f"(update tools/tpflint/drill.py)")
+        return False
+    # first occurrence only: one well-placed bad method
+    mutated = original.replace(anchor, insertion + anchor, 1)
+    try:
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(mutated)
+        findings = run_paths(["tensorfusion_tpu"], tmp_root,
+                             checks={check}, use_cache=False)
+        hits = [fi for fi in findings if fi.check == check]
+        missing = [s for s in expected
+                   if not any(s in fi.message for fi in hits)]
+        if not hits:
+            print(f"drill {name}: FAIL — known-bad pattern produced "
+                  f"no {check} finding")
+            return False
+        if missing:
+            print(f"drill {name}: FAIL — finding fired but message "
+                  f"lacks {missing}: {hits[0].render()}")
+            return False
+        print(f"drill {name}: ok — {hits[0].render()[:110]}...")
+        return True
+    finally:
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(original)
+
+
+def main() -> int:
+    repo_root = os.getcwd()
+    src = os.path.join(repo_root, "tensorfusion_tpu")
+    if not os.path.isdir(src):
+        print("drill: run from the repo root", file=sys.stderr)
+        return 2
+    tmp_root = tempfile.mkdtemp(prefix="tpflint-drill-")
+    try:
+        shutil.copytree(src, os.path.join(tmp_root, "tensorfusion_tpu"))
+        ok = True
+        for name, check, target, anchor, insertion, expected in DRILLS:
+            ok &= run_drill(tmp_root, name, check, target, anchor,
+                            insertion, expected)
+        if ok:
+            print(f"lint-drill: OK ({len(DRILLS)}/{len(DRILLS)} "
+                  f"known-bad patterns fail lint)")
+            return 0
+        print("lint-drill: FAIL — a checker no longer catches its "
+              "known-bad pattern")
+        return 1
+    finally:
+        shutil.rmtree(tmp_root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

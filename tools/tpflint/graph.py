@@ -1,0 +1,953 @@
+"""tpfgraph: project-wide symbol table + call graph for tpflint.
+
+PR 3's checkers are lexical — one function at a time.  That stops at
+the first level of indirection: a helper that sleeps, or takes a second
+lock, is invisible the moment it is *called* rather than inlined.  This
+module turns per-function facts into whole-program summaries so the
+interprocedural checkers (lock-order-inversion,
+transitive-blocking-under-lock, swallowed-error, unjoined-thread) can
+reason across call chains and report the full witness path.
+
+Layering:
+
+- **Extraction** (cached): one AST pass per file produces a
+  JSON-serializable *facts* dict — defined symbols, call sites with the
+  lock context they run under, lock acquisitions with the locks already
+  held, blocking operations, broad ``except`` handlers, thread
+  creation/join/daemon discipline, socket acquisitions.  Facts are
+  cached on disk keyed by ``(mtime, size)`` so a warm ``make lint``
+  re-extracts only edited files (``TPF_LINT_NO_CACHE=1`` bypasses).
+- **Resolution** (cheap, every run): imports (absolute, relative,
+  aliased), ``self.method`` through base classes, module-qualified
+  calls, and *known-callback* edges — ``threading.Thread(target=f)``
+  and ``store.attach_listener(f)`` are asynchronous edges (the callee
+  runs on another thread, so it does NOT inherit the caller's locks),
+  ``mutate(store, Kind, name, fn)`` is synchronous (the closure runs
+  inline).
+- **Summaries** (memoized): transitively-acquired lock sets and
+  transitive blocking reasons, each carrying a witness chain of
+  ``(path, line, symbol)`` frames for the finding message.
+
+Resolution is deliberately conservative: a call that cannot be resolved
+to a project symbol produces no edge (no guessing by method name).
+Unresolvable receivers are the blocking checker's lexical domain; the
+graph layer's job is the part indirection hides.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import SourceFile
+
+#: bump when extraction output changes shape — stale caches self-evict
+CACHE_VERSION = 4
+DEFAULT_CACHE_NAME = ".tpflint-cache.json"
+
+#: names that participate in lock-ORDER tracking: real locks plus
+#: condition variables (acquiring a Condition acquires its lock, so cv
+#: acquisitions order against everything else even though cv *bodies*
+#: are exempt from the blocking checkers)
+ORDER_LOCK_RE = re.compile(
+    r"(lock|mutex|cv|cond)$|(^|_)mu$", re.IGNORECASE)
+#: strictly-lockish names (the PR 3 blocking-under-lock scope): holding
+#: a cv is exempt because its wait() releases the lock
+STRICT_LOCK_RE = re.compile(r"(lock|mutex)$|(^|_)mu$", re.IGNORECASE)
+
+LOG_BASES = {"log", "logger", "logging"}
+#: ``# tpflint: holds=_lock`` — the caller holds the named lock(s), so
+#: everything this function does is ordered after them
+_HOLDS_RE = re.compile(r"#\s*tpflint:\s*holds=([\w,]+)")
+
+#: callback registries: callable-name -> (keyword, positional index).
+#: async callbacks run on another thread/later — they get call-graph
+#: edges but never inherit the registering frame's lock context.
+SYNC_CALLBACKS = {"mutate": ("mutate_fn", 3)}
+ASYNC_CALLBACKS = {"Thread": ("target", None),
+                   "attach_listener": (None, 0)}
+
+SOCKET_ACQUIRERS = {"socket.socket", "socket.create_connection",
+                    "socket.socketpair"}
+
+
+def chain_of(node: ast.AST) -> str:
+    """Dotted chain for Name / Attribute trees ('' for anything whose
+    base is a call, subscript, literal...)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def module_name(relpath: str) -> str:
+    """'tensorfusion_tpu/api/meta.py' -> 'tensorfusion_tpu.api.meta';
+    packages collapse ('pkg/__init__.py' -> 'pkg')."""
+    parts = relpath[:-3].split("/")
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _lock_ctor(value: ast.AST) -> Optional[Tuple[str, Optional[str]]]:
+    """(kind, wrapped-attr) for ``threading.Lock()`` / ``RLock()`` /
+    ``Condition(self._lock)`` ctor calls, else None."""
+    if not isinstance(value, ast.Call):
+        return None
+    tail = chain_of(value.func).rsplit(".", 1)[-1]
+    if tail == "Lock":
+        return ("lock", None)
+    if tail == "RLock":
+        return ("rlock", None)
+    if tail in ("Condition",):
+        wraps = None
+        if value.args:
+            wrapped = chain_of(value.args[0])
+            if wrapped.startswith("self."):
+                wraps = wrapped.split(".")[1]
+        return ("condition", wraps)
+    if tail == "Semaphore" or tail == "BoundedSemaphore":
+        return ("semaphore", None)
+    return None
+
+
+# -- extraction ------------------------------------------------------------
+
+class _FunctionExtractor:
+    """One pass over a single function body, tracking the with-lock
+    stack.  Nested defs/lambdas are skipped (they run later, under
+    whatever locks their *caller* holds — they are extracted as their
+    own functions)."""
+
+    def __init__(self, fn: ast.AST, holds: Tuple[str, ...]):
+        # the PR 3 blocking registry, late-imported once (graph <->
+        # checkers would otherwise be a cycle at module load)
+        from .checkers.blocking_under_lock import _blocking_reason
+        self._blocking_reason = _blocking_reason
+        self.fn = fn
+        #: virtual context from a ``# tpflint: holds=`` annotation:
+        #: 'self.<attr>' entries prepended to every held tuple
+        self.holds = holds
+        self.calls: List[dict] = []
+        self.acquires: List[dict] = []
+        self.blocking: List[dict] = []
+        self.excepts: List[dict] = []
+        self.threads: List[dict] = []
+        self.joins: Set[str] = set()
+        self.starts: Set[str] = set()
+        self.daemon_sets: Set[str] = set()
+        self.escapes: Set[str] = set()     # locals passed/stored/returned
+        self.logs = False
+        self._aliases: Dict[str, str] = {}   # local -> self.attr chain
+        self._handlers: List[dict] = []      # open except-handler stack
+        #: interned held-lock lists (most calls share the same — empty
+        #: — context; one list per distinct tuple keeps the facts small)
+        self._held: Dict[Tuple[str, ...], List[str]] = {}
+
+    def _held_list(self, held: Tuple[str, ...]) -> List[str]:
+        lst = self._held.get(held)
+        if lst is None:
+            lst = self._held[held] = list(held)
+        return lst
+
+    def run(self) -> None:
+        for stmt in self.fn.body:
+            self._stmt(stmt, self.holds)
+
+    # -- statement walk, lock-context aware --------------------------------
+
+    def _stmt(self, node: ast.AST, held: Tuple[str, ...]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = held
+            for item in node.items:
+                self._expr(item.context_expr, held)
+                raw = chain_of(item.context_expr)
+                tail = raw.rsplit(".", 1)[-1]
+                if raw and ORDER_LOCK_RE.search(tail):
+                    self.acquires.append(
+                        {"raw": raw, "line": item.context_expr.lineno,
+                         "held": list(inner)})
+                    inner = inner + (raw,)
+            for s in node.body:
+                self._stmt(s, inner)
+            return
+        if isinstance(node, ast.ExceptHandler):
+            self._handler(node, held)
+            return
+        if isinstance(node, ast.Raise):
+            for h in self._handlers:
+                h["raises"] = True
+        if isinstance(node, ast.Assign):
+            self._assign(node, held)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child, held)
+            elif isinstance(child, ast.stmt) or \
+                    isinstance(child, ast.ExceptHandler):
+                self._stmt(child, held)
+
+    def _handler(self, node: ast.ExceptHandler,
+                 held: Tuple[str, ...]) -> None:
+        """Open a broad-except record while walking the handler body;
+        calls/raises/name-loads inside mark it handled."""
+        kind = None
+        if node.type is None:
+            kind = "bare"
+        else:
+            t = chain_of(node.type).rsplit(".", 1)[-1]
+            if t in ("Exception", "BaseException"):
+                kind = t
+        rec = None
+        if kind is not None:
+            rec = {"line": node.lineno, "kind": kind,
+                   "bound": node.name, "raises": False, "logs": False,
+                   "uses": False, "calls": []}
+            self.excepts.append(rec)
+            self._handlers.append(rec)
+        for s in node.body:
+            self._stmt(s, held)
+        if rec is not None:
+            self._handlers.pop()
+
+    def _assign(self, node: ast.Assign, held: Tuple[str, ...]) -> None:
+        value = node.value
+        for t in node.targets:
+            chain = chain_of(t)
+            if not chain:
+                # subscript / tuple target: locals stored into
+                # containers escape local ownership tracking
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        self.escapes.add(n.id)
+                continue
+            if chain.endswith(".daemon") and \
+                    isinstance(value, ast.Constant) and value.value:
+                self.daemon_sets.add(chain.rsplit(".", 1)[0])
+            vchain = chain_of(value)
+            if "." not in chain:
+                # `t = self._journal_thread` -> t.join() joins the attr
+                if vchain.startswith("self."):
+                    self._aliases[chain] = vchain
+                else:
+                    self._aliases.pop(chain, None)
+        # thread creation: record the assignment target
+        if isinstance(value, ast.Call) and self._is_thread_ctor(value):
+            target = chain_of(node.targets[0]) or None
+            self._record_thread(value, assigned=target, started=False)
+
+    def _is_thread_ctor(self, call: ast.Call) -> bool:
+        tail = chain_of(call.func).rsplit(".", 1)[-1]
+        return tail == "Thread"
+
+    def _record_thread(self, call: ast.Call, assigned: Optional[str],
+                       started: bool) -> None:
+        target = daemon = None
+        for kw in call.keywords:
+            if kw.arg == "target":
+                target = chain_of(kw.value) or None
+            elif kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                daemon = bool(kw.value.value)
+        self.threads.append({"line": call.lineno, "target": target,
+                             "daemon": daemon, "assigned": assigned,
+                             "started": started})
+
+    # -- expression walk ----------------------------------------------------
+
+    def _expr(self, node: ast.AST, held: Tuple[str, ...]) -> None:
+        if isinstance(node, (ast.Lambda, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            return
+        if isinstance(node, ast.Name) and self._handlers and \
+                isinstance(node.ctx, ast.Load):
+            for h in self._handlers:
+                if h["bound"] and node.id == h["bound"]:
+                    h["uses"] = True
+        for child in ast.iter_child_nodes(node):
+            self._expr(child, held)
+        if not isinstance(node, ast.Call):
+            return
+        reason = self._blocking_reason(node)
+        if reason:
+            self.blocking.append(
+                {"line": node.lineno, "reason": reason,
+                 "key": chain_of(node.func).rsplit(".", 1)[-1],
+                 "locks": self._held_list(held)})
+        chain = chain_of(node.func)
+        tail = chain.rsplit(".", 1)[-1] if chain else ""
+        if chain:
+            base = chain.split(".", 1)[0]
+            logs = base in LOG_BASES or chain.startswith("self.log.")
+            if logs:
+                self.logs = True
+            self.calls.append({"line": node.lineno, "chain": chain,
+                               "locks": self._held_list(held)})
+            for h in self._handlers:
+                h["calls"].append(chain)
+                if logs:
+                    h["logs"] = True
+            if tail == "join" and "." in chain:
+                owner = chain.rsplit(".", 1)[0]
+                self.joins.add(self._aliases.get(owner, owner))
+            if tail == "start" and "." in chain:
+                owner = chain.rsplit(".", 1)[0]
+                self.starts.add(self._aliases.get(owner, owner))
+        # a local passed as an argument escapes ownership tracking
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Name):
+                self.escapes.add(arg.id)
+        # inline-started thread: threading.Thread(...).start()
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "start" and \
+                isinstance(node.func.value, ast.Call) and \
+                self._is_thread_ctor(node.func.value):
+            self._record_thread(node.func.value, assigned=None,
+                                started=True)
+        # callback edges (sync: runs inline; async: runs elsewhere)
+        for registry, sync in ((SYNC_CALLBACKS, True),
+                               (ASYNC_CALLBACKS, False)):
+            spec = registry.get(tail)
+            if spec is None:
+                continue
+            kw_name, pos = spec
+            cb = None
+            for kw in node.keywords:
+                if kw_name is not None and kw.arg == kw_name:
+                    cb = kw.value
+            if cb is None and pos is not None and len(node.args) > pos:
+                cb = node.args[pos]
+            cb_chain = chain_of(cb) if cb is not None else ""
+            if cb_chain:
+                self.calls.append(
+                    {"line": node.lineno, "chain": cb_chain,
+                     "locks": self._held_list(held) if sync else [],
+                     "async": not sync})
+
+
+def _own_nodes(fn: ast.AST):
+    """Every AST node lexically in ``fn``'s body, nested function /
+    class / lambda bodies excluded (they execute as their own scope)."""
+    def visit(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            yield child
+            yield from visit(child)
+    yield from visit(fn)
+
+
+def _scan_sockets(fn: ast.AST) -> List[dict]:
+    """Raw socket acquisitions assigned to a local: closed / managed /
+    escaping on some path?  (Local data flow only — a socket handed to
+    another function, stored on self, or returned transfers
+    ownership.)"""
+    acquired: Dict[str, dict] = {}
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call) and \
+                chain_of(node.value.func) in SOCKET_ACQUIRERS and \
+                len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            var = node.targets[0].id
+            acquired[var] = {"line": node.value.lineno, "var": var,
+                             "closed": False, "escapes": False}
+    if not acquired:
+        return []
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.Call):
+            chain = chain_of(node.func)
+            if "." in chain:
+                base, tail = chain.rsplit(".", 1)
+                if base in acquired and tail in ("close", "detach",
+                                                 "shutdown", "makefile"):
+                    acquired[base]["closed"] = True
+            for arg in list(node.args) + [kw.value for kw in
+                                          node.keywords]:
+                if isinstance(arg, ast.Name) and arg.id in acquired:
+                    acquired[arg.id]["escapes"] = True
+        elif isinstance(node, ast.Return) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id in acquired:
+            acquired[node.value.id]["escapes"] = True
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if chain_of(t).startswith("self.") and \
+                        isinstance(node.value, ast.Name) and \
+                        node.value.id in acquired:
+                    acquired[node.value.id]["escapes"] = True
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                c = chain_of(item.context_expr)
+                if c in acquired:
+                    acquired[c]["closed"] = True
+    return [a for _, a in sorted(acquired.items())]
+
+
+def _holds_for(fn: ast.AST, lines: List[str]) -> Tuple[str, ...]:
+    """``# tpflint: holds=_lock`` on/above the def: the caller holds
+    those locks, so treat them as held for the whole body."""
+    found: List[str] = []
+    for lineno in (fn.lineno, fn.lineno - 1):
+        if 1 <= lineno <= len(lines):
+            m = _HOLDS_RE.search(lines[lineno - 1])
+            if m:
+                found.extend("self." + a.strip().lstrip(".")
+                             for a in m.group(1).split(",") if a.strip())
+    return tuple(found)
+
+
+def extract_facts(sf: SourceFile) -> dict:
+    """The cached per-file product: everything the graph checkers need,
+    JSON-serializable, independent of other files."""
+    mod = module_name(sf.relpath)
+    imports: Dict[str, List[Optional[str]]] = {}
+    import_modules: Dict[str, str] = {}
+    pkg_parts = mod.split(".")
+    if not sf.relpath.endswith("__init__.py"):
+        pkg_parts = pkg_parts[:-1]
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                local = a.asname or a.name.split(".")[0]
+                import_modules[local] = a.name if a.asname else \
+                    a.name.split(".")[0]
+                if a.asname is None:
+                    # `import a.b` binds `a`, but the full path is
+                    # addressable: remember it for prefix matching
+                    import_modules.setdefault(a.name, a.name)
+        elif isinstance(node, ast.ImportFrom):
+            base = list(pkg_parts)
+            if node.level:
+                base = base[:len(base) - (node.level - 1)] if \
+                    node.level > 1 else base
+                src = ".".join(base + ([node.module] if node.module
+                                       else []))
+            else:
+                src = node.module or ""
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                imports[a.asname or a.name] = [src, a.name]
+
+    classes: Dict[str, dict] = {}
+    mod_locks: Dict[str, List[Optional[str]]] = {}
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            ctor = _lock_ctor(node.value)
+            if ctor:
+                mod_locks[node.targets[0].id] = list(ctor)
+
+    def scan_class(cnode: ast.ClassDef, prefix: str) -> None:
+        cpath = (prefix + "." if prefix else "") + cnode.name
+        info = {"bases": [chain_of(b) for b in cnode.bases
+                          if chain_of(b)],
+                "methods": [], "locks": {}, "attrs": {}}
+        classes[cpath] = info
+        for child in cnode.body:
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info["methods"].append(child.name)
+                # parameter annotations type `self.x = param` assigns
+                anns = {}
+                args = child.args
+                for a in args.args + args.kwonlyargs:
+                    if a.annotation is not None:
+                        ann = chain_of(a.annotation)
+                        if ann:
+                            anns[a.arg] = ann
+                for n in ast.walk(child):
+                    if isinstance(n, ast.Assign) and \
+                            len(n.targets) == 1:
+                        tchain = chain_of(n.targets[0])
+                        if tchain.startswith("self.") and \
+                                tchain.count(".") == 1:
+                            attr = tchain.split(".")[1]
+                            ctor = _lock_ctor(n.value)
+                            if ctor:
+                                info["locks"][attr] = list(ctor)
+                            elif isinstance(n.value, ast.Call):
+                                # `self.store = ObjectStore(...)`:
+                                # the ctor chain types the attribute
+                                c = chain_of(n.value.func)
+                                if c and c[:1].isupper() or \
+                                        (c and c.rsplit(".", 1)[-1]
+                                         [:1].isupper()):
+                                    info["attrs"].setdefault(attr, c)
+                            elif isinstance(n.value, ast.Name) and \
+                                    n.value.id in anns:
+                                # `self.store = store` with an
+                                # annotated parameter
+                                info["attrs"].setdefault(
+                                    attr, anns[n.value.id])
+            elif isinstance(child, ast.ClassDef):
+                scan_class(child, cpath)
+
+    for node in sf.tree.body:
+        if isinstance(node, ast.ClassDef):
+            scan_class(node, "")
+
+    functions: List[dict] = []
+    has_sockets = "socket" in sf.text
+
+    def scan_fn(fn: ast.AST, stack: List[str], cls: Optional[str]) -> None:
+        qual = ".".join(stack + [fn.name])
+        holds = _holds_for(fn, sf.lines)
+        ex = _FunctionExtractor(fn, holds)
+        ex.run()
+        functions.append({
+            "qual": qual, "cls": cls, "name": fn.name,
+            "line": fn.lineno,
+            "calls": ex.calls, "acquires": ex.acquires,
+            "blocking": ex.blocking,
+            "excepts": ex.excepts,
+            "threads": ex.threads,
+            "joins": sorted(ex.joins), "starts": sorted(ex.starts),
+            "daemon_sets": sorted(ex.daemon_sets),
+            "escapes": sorted(ex.escapes),
+            "logs": ex.logs,
+            "sockets": _scan_sockets(fn) if has_sockets else [],
+        })
+
+    def walk(node: ast.AST, stack: List[str], cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk(child, stack + [child.name],
+                     (cls + "." if cls else "") + child.name)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                scan_fn(child, stack, cls)
+                walk(child, stack + [child.name], cls)
+            else:
+                walk(child, stack, cls)
+
+    walk(sf.tree, [], None)
+
+    return {"module": mod, "imports": imports,
+            "import_modules": import_modules, "classes": classes,
+            "module_locks": mod_locks, "functions": functions}
+
+
+# -- cache -----------------------------------------------------------------
+
+class FactsCache:
+    """mtime+size-keyed persistent store of per-file facts."""
+
+    def __init__(self, path: Optional[str]):
+        self.path = path
+        self.hits = 0
+        self.misses = 0
+        self._entries: Dict[str, dict] = {}
+        self._dirty = False
+        if path and os.path.exists(path):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    data = json.load(f)
+                if data.get("version") == CACHE_VERSION:
+                    self._entries = data.get("files", {})
+            except (OSError, ValueError):
+                self._entries = {}
+
+    def facts_for(self, sf: SourceFile) -> dict:
+        try:
+            st = os.stat(sf.path)
+            stamp = [st.st_mtime, st.st_size]
+        except OSError:
+            stamp = None     # in-memory fixture: never cacheable
+        ent = self._entries.get(sf.relpath)
+        if stamp is not None and ent is not None and \
+                ent.get("stamp") == stamp:
+            self.hits += 1
+            return ent["facts"]
+        self.misses += 1
+        facts = extract_facts(sf)
+        if stamp is not None:
+            self._entries[sf.relpath] = {"stamp": stamp, "facts": facts}
+            self._dirty = True
+        return facts
+
+    def save(self) -> None:
+        if not self.path or not self._dirty:
+            return
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"version": CACHE_VERSION,
+                           "files": self._entries}, f,
+                          separators=(",", ":"))
+            os.replace(tmp, self.path)
+        except OSError:
+            pass     # cache is an optimization, never a failure
+
+
+# -- the graph -------------------------------------------------------------
+
+@dataclass
+class FuncNode:
+    module: str
+    relpath: str
+    facts: dict
+    full: str = ""           # module-qualified name
+    symbol: str = ""         # Finding-style symbol ("Class.method")
+
+    @property
+    def cls(self) -> Optional[str]:
+        return self.facts["cls"]
+
+    @property
+    def line(self) -> int:
+        return self.facts["line"]
+
+
+@dataclass
+class Witness:
+    """One frame of an interprocedural witness chain."""
+    path: str
+    line: int
+    symbol: str
+    note: str = ""
+
+    def render(self) -> str:
+        tag = f" ({self.note})" if self.note else ""
+        return f"{self.symbol} [{self.path}:{self.line}]{tag}"
+
+
+class ProjectGraph:
+    """Symbol table + call graph + memoized interprocedural summaries."""
+
+    def __init__(self, files: Dict[str, SourceFile], repo_root: str,
+                 cache: Optional[FactsCache] = None):
+        self.files = files
+        self.repo_root = repo_root
+        self.cache = cache or FactsCache(None)
+        self.facts: Dict[str, dict] = {}          # relpath -> facts
+        self.funcs: Dict[str, FuncNode] = {}      # full qual -> node
+        self.modules: Dict[str, str] = {}         # module -> relpath
+        self._resolve_memo: Dict[Tuple[str, str], Optional[str]] = {}
+        self._acquired_memo: Dict[str, Dict[str, List[Witness]]] = {}
+        self._blocks_memo: Dict[str, Optional[Tuple[str, List[Witness]]]] \
+            = {}
+        for rel in sorted(files):
+            facts = self.cache.facts_for(files[rel])
+            self.facts[rel] = facts
+            self.modules[facts["module"]] = rel
+            for ffacts in facts["functions"]:
+                node = FuncNode(module=facts["module"], relpath=rel,
+                                facts=ffacts)
+                node.full = f"{facts['module']}.{ffacts['qual']}"
+                node.symbol = ffacts["qual"]
+                self.funcs[node.full] = node
+        self.cache.save()
+
+    @classmethod
+    def build(cls, files: Dict[str, SourceFile], repo_root: str,
+              use_cache: bool = True,
+              cache_path: Optional[str] = None) -> "ProjectGraph":
+        if use_cache and os.environ.get("TPF_LINT_NO_CACHE") == "1":
+            use_cache = False
+        path = None
+        if use_cache:
+            path = cache_path or os.path.join(repo_root,
+                                              DEFAULT_CACHE_NAME)
+        return cls(files, repo_root, FactsCache(path))
+
+    # -- symbol resolution --------------------------------------------------
+
+    def _module_facts(self, module: str) -> Optional[dict]:
+        rel = self.modules.get(module)
+        return self.facts.get(rel) if rel else None
+
+    def _class_info(self, module: str, cpath: str) -> Optional[dict]:
+        facts = self._module_facts(module)
+        if facts:
+            return facts["classes"].get(cpath)
+        return None
+
+    def _resolve_class_ref(self, module: str, chain: str
+                           ) -> Optional[Tuple[str, str]]:
+        """Resolve a base-class reference ('Base', 'mod.Base',
+        'pkg.mod.Base') from ``module``'s namespace to
+        (defining_module, class_path)."""
+        facts = self._module_facts(module)
+        if facts is None:
+            return None
+        if "." not in chain:
+            if chain in facts["classes"]:
+                return (module, chain)
+            imp = facts["imports"].get(chain)
+            if imp:
+                src, sym = imp
+                tgt = self._module_facts(src)
+                if tgt and sym in tgt["classes"]:
+                    return (src, sym)
+            return None
+        base, attr = chain.rsplit(".", 1)
+        mod = self._resolve_module_alias(module, base)
+        if mod:
+            tgt = self._module_facts(mod)
+            if tgt and attr in tgt["classes"]:
+                return (mod, attr)
+        return None
+
+    def _resolve_module_alias(self, module: str, chain: str
+                              ) -> Optional[str]:
+        """Map a (possibly dotted) local name to a project module."""
+        facts = self._module_facts(module)
+        if facts is None:
+            return None
+        im = facts["import_modules"]
+        # longest matching prefix of the alias chain
+        parts = chain.split(".")
+        for cut in range(len(parts), 0, -1):
+            local = ".".join(parts[:cut])
+            if local in im:
+                full = im[local] + ("." + ".".join(parts[cut:])
+                                    if cut < len(parts) else "")
+                if full in self.modules:
+                    return full
+        imp = facts["imports"].get(parts[0])
+        if imp:
+            src, sym = imp
+            cand = f"{src}.{sym}" if src else sym
+            rest = parts[1:]
+            full = ".".join([cand] + rest) if rest else cand
+            if full in self.modules:
+                return full
+        return None
+
+    def _attr_type(self, module: str, cpath: str, attr: str,
+                   depth: int = 0) -> Optional[Tuple[str, str]]:
+        """Project class an instance attribute is typed as — via a
+        constructor assignment (``self.x = Store()``) or an annotated
+        ``__init__`` parameter (``store: ObjectStore`` ...
+        ``self.store = store``) — walking base classes."""
+        if depth > 8:
+            return None
+        info = self._class_info(module, cpath)
+        if info is None:
+            return None
+        chain = info["attrs"].get(attr)
+        if chain:
+            ref = self._resolve_class_ref(module, chain)
+            if ref:
+                return ref
+        for bchain in info["bases"]:
+            ref = self._resolve_class_ref(module, bchain)
+            if ref:
+                hit = self._attr_type(ref[0], ref[1], attr, depth + 1)
+                if hit:
+                    return hit
+        return None
+
+    def _find_method(self, module: str, cpath: str, name: str,
+                     depth: int = 0) -> Optional[str]:
+        if depth > 8:
+            return None
+        info = self._class_info(module, cpath)
+        if info is None:
+            return None
+        if name in info["methods"]:
+            return f"{module}.{cpath}.{name}"
+        for bchain in info["bases"]:
+            ref = self._resolve_class_ref(module, bchain)
+            if ref:
+                hit = self._find_method(ref[0], ref[1], name, depth + 1)
+                if hit:
+                    return hit
+        return None
+
+    def resolve_call(self, func: FuncNode, chain: str) -> Optional[str]:
+        """Project-function qualname a call chain resolves to, or None.
+        Conservative: unknown receivers resolve to nothing."""
+        memo_key = (func.full, chain)
+        if memo_key in self._resolve_memo:
+            return self._resolve_memo[memo_key]
+        out = self._resolve_uncached(func, chain)
+        self._resolve_memo[memo_key] = out
+        return out
+
+    def _resolve_uncached(self, func: FuncNode, chain: str
+                          ) -> Optional[str]:
+        parts = chain.split(".")
+        module = func.module
+        facts = self._module_facts(module)
+        if facts is None:
+            return None
+        if parts[0] == "self" and func.cls:
+            if len(parts) == 2:
+                return self._find_method(module, func.cls, parts[1])
+            if len(parts) == 3:
+                # `self.store.update(...)` through a typed attribute
+                ref = self._attr_type(module, func.cls, parts[1])
+                if ref:
+                    return self._find_method(ref[0], ref[1], parts[2])
+            return None
+        if len(parts) == 1:
+            name = parts[0]
+            # module-level function in the same module?
+            cand = f"{module}.{name}"
+            if cand in self.funcs and self.funcs[cand].cls is None:
+                return cand
+            imp = facts["imports"].get(name)
+            if imp:
+                src, sym = imp
+                cand = f"{src}.{sym}"
+                if cand in self.funcs and self.funcs[cand].cls is None:
+                    return cand
+                # imported class: constructing it runs __init__
+                tgt = self._module_facts(src)
+                if tgt and sym in tgt["classes"]:
+                    init = f"{src}.{sym}.__init__"
+                    return init if init in self.funcs else None
+            if name in facts["classes"]:
+                init = f"{module}.{name}.__init__"
+                return init if init in self.funcs else None
+            return None
+        # dotted: module-qualified function or Class.method
+        base, attr = ".".join(parts[:-1]), parts[-1]
+        mod = self._resolve_module_alias(module, base)
+        if mod:
+            cand = f"{mod}.{attr}"
+            if cand in self.funcs and self.funcs[cand].cls is None:
+                return cand
+            tgt = self._module_facts(mod)
+            if tgt and attr in tgt["classes"]:
+                init = f"{mod}.{attr}.__init__"
+                return init if init in self.funcs else None
+        # Class.method on a class in scope (staticmethod-style call)
+        ref = self._resolve_class_ref(module, base)
+        if ref:
+            return self._find_method(ref[0], ref[1], attr)
+        return None
+
+    # -- lock identity ------------------------------------------------------
+
+    def canonical_lock(self, func: FuncNode, raw: str
+                       ) -> Tuple[str, str]:
+        """(lock_id, kind) for a raw acquisition expression.  Same
+        class attribute -> same id (instance-insensitive by design:
+        ordering is a *class-level* protocol).  Condition variables
+        canonicalize to the lock they wrap."""
+        parts = raw.split(".")
+        if parts[0] == "self" and len(parts) == 2 and func.cls:
+            return self._class_lock(func.module, func.cls, parts[1],
+                                    set())
+        if parts[0] == "self" and len(parts) == 3 and func.cls:
+            # `with self.store._lock:` — the attribute's class owns it
+            ref = self._attr_type(func.module, func.cls, parts[1])
+            if ref:
+                return self._class_lock(ref[0], ref[1], parts[2], set())
+            return (f"{func.module}:{raw}", "unknown")
+        if len(parts) == 1:
+            facts = self._module_facts(func.module)
+            if facts and raw in facts["module_locks"]:
+                kind = facts["module_locks"][raw][0]
+                return (f"{func.module}.{raw}", kind)
+            # function-local lock object: unique per function, can
+            # never participate in a cross-function cycle
+            return (f"{func.full}:{raw}", "local")
+        return (f"{func.module}:{raw}", "unknown")
+
+    def _class_lock(self, module: str, cpath: str, attr: str,
+                    seen: Set[str]) -> Tuple[str, str]:
+        key = f"{module}.{cpath}.{attr}"
+        if key in seen:
+            return (key, "unknown")
+        seen.add(key)
+        info = self._class_info(module, cpath)
+        if info is not None:
+            ent = info["locks"].get(attr)
+            if ent is not None:
+                kind, wraps = ent
+                if kind == "condition" and wraps:
+                    # cv wrapping a lock: one underlying lock, one id
+                    return self._class_lock(module, cpath, wraps, seen)
+                return (key, kind)
+            # declared in a base class?
+            for bchain in info["bases"]:
+                ref = self._resolve_class_ref(module, bchain)
+                if ref:
+                    binfo = self._class_info(ref[0], ref[1])
+                    if binfo is not None and attr in binfo["locks"]:
+                        return self._class_lock(ref[0], ref[1], attr,
+                                                seen)
+        return (key, "unknown")
+
+    # -- interprocedural summaries -------------------------------------
+
+    def sync_callees(self, func: FuncNode):
+        """(call-record, callee FuncNode) for resolved synchronous
+        calls — the edges lock context flows across."""
+        for call in func.facts["calls"]:
+            if call.get("async"):
+                continue
+            target = self.resolve_call(func, call["chain"])
+            if target is not None and target != func.full:
+                yield call, self.funcs[target]
+
+    def acquired_locks(self, full: str, _stack: Optional[Set[str]] = None
+                       ) -> Dict[str, List[Witness]]:
+        """lock_id -> witness chain for every lock ``full`` may acquire
+        (directly or through synchronous project calls).  Recursive
+        cycles contribute what was discovered before the back-edge."""
+        if full in self._acquired_memo:
+            return self._acquired_memo[full]
+        stack = _stack or set()
+        if full in stack:
+            return {}
+        stack.add(full)
+        func = self.funcs[full]
+        out: Dict[str, List[Witness]] = {}
+        for acq in func.facts["acquires"]:
+            lock_id, _kind = self.canonical_lock(func, acq["raw"])
+            out.setdefault(lock_id, [Witness(
+                func.relpath, acq["line"], func.symbol,
+                note=f"with {acq['raw']}")])
+        for call, callee in self.sync_callees(func):
+            for lock_id, chain in self.acquired_locks(
+                    callee.full, stack).items():
+                out.setdefault(lock_id, [Witness(
+                    func.relpath, call["line"], func.symbol,
+                    note=f"calls {call['chain']}")] + chain)
+        stack.discard(full)
+        self._acquired_memo[full] = out
+        return out
+
+    def blocks(self, full: str, _stack: Optional[Set[str]] = None
+               ) -> Optional[Tuple[str, List[Witness]]]:
+        """(reason, witness chain) if ``full`` may block — directly or
+        through synchronous project calls — else None."""
+        if full in self._blocks_memo:
+            return self._blocks_memo[full]
+        stack = _stack or set()
+        if full in stack:
+            return None
+        stack.add(full)
+        func = self.funcs[full]
+        result: Optional[Tuple[str, List[Witness]]] = None
+        for b in func.facts["blocking"]:
+            result = (b["reason"], [Witness(
+                func.relpath, b["line"], func.symbol,
+                note=b["reason"])])
+            break
+        if result is None:
+            for call, callee in self.sync_callees(func):
+                sub = self.blocks(callee.full, stack)
+                if sub is not None:
+                    result = (sub[0], [Witness(
+                        func.relpath, call["line"], func.symbol,
+                        note=f"calls {call['chain']}")] + sub[1])
+                    break
+        stack.discard(full)
+        self._blocks_memo[full] = result
+        return result
