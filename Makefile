@@ -8,7 +8,7 @@
 PY := env -u PALLAS_AXON_POOL_IPS python
 
 .PHONY: all native test test-native verify-all verify-repeat \
-	verify-stress verify-native-sanitized check-coverage lint \
+	verify-stress verify-sim verify-native-sanitized check-coverage lint \
 	lint-drill asan \
 	tsan bench bench-tpu test-tpu-live sched-bench webhook-bench remoting-bench \
 	multitenant-bench multitenant-bench-tpu serving-bench-tpu \
@@ -75,7 +75,7 @@ verify-repeat: native
 # small N, cache/store coherence after multi-threaded churn — the PR-4
 # control-plane hot path).  Cheaper than verify-repeat (minutes, not an
 # hour), meant to run on every change to locking/queueing code.
-verify-stress:
+verify-stress: verify-sim
 	@for i in 1 2 3 4 5; do \
 		echo "=== verify-stress round $$i/5 ==="; \
 		env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -88,6 +88,19 @@ verify-stress:
 			|| exit 1; \
 	done
 	@echo "verify-stress: OK (5/5 rounds green)"
+
+# Digital-twin gate (docs/simulation.md): every named fault scenario
+# (rolling node failure, thundering-herd rescale, partition-heal
+# reconvergence, slow-watcher storm, leader flap, skew-lease storm)
+# against the REAL control plane in simulated time — headless, tier-1
+# scale, each scenario run twice and the event-log digests compared
+# (any nondeterminism fails), invariants (no lost pods, no double
+# bind, no leaked allocations, convergence) enforced.  Artifact:
+# benchmarks/results/sim.json.  Seconds of wall time for minutes of
+# simulated failure story — run on any control-plane change.
+verify-sim:
+	$(PY) benchmarks/sim_scenarios.py --scale small --seed 42
+	@echo "verify-sim: OK"
 
 test-native:
 	$(MAKE) -C native test

@@ -1,0 +1,77 @@
+"""Digital-twin fault-scenario suite (benchmarks/sim_*).
+
+Replays the named fault scenarios (tensorfusion_tpu/sim/scenarios.py)
+against the REAL control plane in simulated time and records a
+per-scenario artifact in benchmarks/results/sim.json: seed, event
+counts, invariant verdicts, the deterministic log digest, and the
+sim-seconds/wall-seconds speedup (the whole point of the twin — a
+90-sim-second partition story costs well under a wall second).
+
+    python benchmarks/sim_scenarios.py [--scale small|medium|large]
+        [--seed N] [--scenario NAME ...]
+
+``make verify-sim`` runs this headless at tier-1 scale and fails on
+any invariant violation or determinism break (each scenario is run
+twice and the log digests must match).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+sys.path.insert(0, ".")  # repo root (benchmarks/ is not a package)
+
+from benchmarks._artifact import previous_artifact, write_artifact  # noqa: E402
+from tensorfusion_tpu.sim.scenarios import SCENARIOS, run_scenario  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="sim_scenarios")
+    ap.add_argument("--scale", default="medium",
+                    choices=("small", "medium", "large"))
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--scenario", action="append", default=None,
+                    choices=sorted(SCENARIOS),
+                    help="run only the named scenario(s)")
+    ap.add_argument("--no-determinism-check", action="store_true",
+                    help="skip the second (digest-compare) run")
+    args = ap.parse_args(argv)
+
+    names = args.scenario or sorted(SCENARIOS)
+    cells = []
+    ok = True
+    for name in names:
+        r = run_scenario(name, seed=args.seed, scale=args.scale)
+        if not args.no_determinism_check:
+            r2 = run_scenario(name, seed=args.seed, scale=args.scale)
+            r["deterministic"] = r2["log_digest"] == r["log_digest"]
+            if not r["deterministic"]:
+                r["ok"] = False
+        speedup = (r["sim_seconds"] / r["wall_seconds"]
+                   if r["wall_seconds"] else float("inf"))
+        r["sim_speedup_x"] = round(speedup, 1)
+        ok &= r["ok"]
+        cells.append(r)
+        bad = {k: v for k, v in r["invariants"].items() if v}
+        print(f"{name:32s} {'ok' if r['ok'] else 'FAIL':4s} "
+              f"sim={r['sim_seconds']:.0f}s wall={r['wall_seconds']}s "
+              f"({r['sim_speedup_x']}x) events={r['store_events']}"
+              + (f"  {json.dumps(bad)[:200]}" if bad else ""))
+
+    result = {
+        "benchmark": "sim_scenarios",
+        "scale": args.scale,
+        "seed": args.seed,
+        "ok": ok,
+        "scenarios": cells,
+        "previous": previous_artifact("sim"),
+    }
+    path = write_artifact("sim", result)
+    print(f"{'OK' if ok else 'FAIL'} -> {path}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,11 +11,11 @@ from __future__ import annotations
 import json
 import logging
 import threading
-import time
 import urllib.request
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
+from ..clock import Clock, default_clock
 from ..metrics.tsdb import TSDB, aggregate_values
 
 log = logging.getLogger("tpf.alert")
@@ -81,8 +81,10 @@ def default_rules() -> List[AlertRule]:
 
 class AlertEvaluator:
     def __init__(self, tsdb: TSDB, rules: Optional[List[AlertRule]] = None,
-                 webhook_url: str = "", interval_s: float = 15.0):
+                 webhook_url: str = "", interval_s: float = 15.0,
+                 clock: Optional[Clock] = None):
         self.tsdb = tsdb
+        self.clock = clock or default_clock()
         self.rules = rules or []
         self.webhook_url = webhook_url
         self.interval_s = interval_s
@@ -153,7 +155,7 @@ class AlertEvaluator:
         return out
 
     def evaluate_once(self, now: Optional[float] = None) -> List[Alert]:
-        now = now if now is not None else time.time()
+        now = now if now is not None else self.clock.now()
         changed: List[Alert] = []
         for rule in self.rules:
             keyed_values = self._rule_values(rule, now)

@@ -28,11 +28,11 @@ from __future__ import annotations
 import json
 import logging
 import threading
-import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from .. import constants
+from ..clock import Clock, default_clock
 from ..api.resources import AdjustRequest, AllocRequest, ResourceAmount
 from ..api.types import Pod, TPUChip
 from ..store import ConflictError, NotFoundError, ObjectStore
@@ -67,7 +67,8 @@ class AllocRecord:
     request: AllocRequest
     chip_ids: List[str]
     assumed: bool = True
-    assumed_at: float = field(default_factory=time.time)
+    #: wall timestamp stamped by the allocator's clock at allocation
+    assumed_at: float = 0.0
     partitions: Dict[str, str] = field(default_factory=dict)  # chip -> part id
 
     @property
@@ -224,8 +225,10 @@ class TPUAllocator:
     def __init__(self, store: Optional[ObjectStore] = None,
                  quota_store: Optional[QuotaStore] = None,
                  node_labels: Optional[Callable[[str], Dict[str, str]]] = None,
-                 assume_ttl_s: float = DEFAULT_ASSUME_TTL_S):
+                 assume_ttl_s: float = DEFAULT_ASSUME_TTL_S,
+                 clock: Optional[Clock] = None):
         self.store = store
+        self.clock = clock or default_clock()
         self.quota = quota_store or QuotaStore(store)
         self.assume_ttl_s = assume_ttl_s
         self._lock = threading.RLock()
@@ -650,7 +653,8 @@ class TPUAllocator:
                 raise AllocationConflictError(f"{key} already allocated")
             self.quota.assume(req)
             record = AllocRecord(request=req,
-                                 chip_ids=[c.chip.name for c in chips])
+                                 chip_ids=[c.chip.name for c in chips],
+                                 assumed_at=self.clock.now())
             per_chip = ResourceAmount(tflops=req.request.tflops,
                                       duty_percent=req.request.duty_percent,
                                       hbm_bytes=req.request.hbm_bytes)
@@ -792,7 +796,7 @@ class TPUAllocator:
     # -- assumed-allocation TTL sweep (gpuallocator.go:1348) ---------------
 
     def sweep_assumed(self, now: Optional[float] = None) -> List[str]:
-        now = now or time.time()
+        now = now or self.clock.now()
         swept = []
         with self._lock:
             for record in list(self._allocations.values()):
@@ -872,7 +876,8 @@ class TPUAllocator:
                               constants.DEFAULT_ISOLATION),
             partition_template=ann.get(constants.ANN_PARTITION_NAME, ""))
         record = AllocRecord(request=req, chip_ids=chip_ids.split(","),
-                             assumed=False)
+                             assumed=False,
+                             assumed_at=default_clock().now())
         parts = ann.get(constants.ANN_PARTITION_IDS, "")
         if parts:
             record.partitions = json.loads(parts)

@@ -19,10 +19,11 @@ from __future__ import annotations
 
 import copy
 import dataclasses
-import time
 import typing
 import uuid as uuid_mod
 from dataclasses import dataclass, field
+
+from ..clock import default_clock
 
 
 class FrozenResourceError(TypeError):
@@ -345,12 +346,12 @@ def set_condition(conditions: typing.List[Condition], ctype: str, status: str,
     for c in conditions:
         if c.type == ctype:
             if c.status != status:
-                c.last_transition_time = time.time()
+                c.last_transition_time = default_clock().now()
             c.status, c.reason, c.message = status, reason, message
             return
     conditions.append(Condition(type=ctype, status=status, reason=reason,
                                 message=message,
-                                last_transition_time=time.time()))
+                                last_transition_time=default_clock().now()))
 
 
 @dataclass
@@ -404,5 +405,5 @@ class Resource:
         obj.metadata.name = name
         obj.metadata.namespace = namespace if cls.NAMESPACED else ""
         obj.metadata.uid = uuid_mod.uuid4().hex
-        obj.metadata.creation_timestamp = time.time()
+        obj.metadata.creation_timestamp = default_clock().now()
         return obj

@@ -12,10 +12,10 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 from typing import Dict, Optional
 
 from ..api.resources import AdjustRequest, ResourceAmount
+from ..clock import Clock, default_clock
 from ..api.types import TPUWorkload
 from ..metrics.tsdb import TSDB
 from .recommender import (CronRecommender, ExternalRecommender,
@@ -26,12 +26,14 @@ log = logging.getLogger("tpf.autoscaler")
 
 class AutoScaler:
     def __init__(self, operator, tsdb: TSDB, interval_s: float = 30.0,
-                 min_change_fraction: float = 0.1):
+                 min_change_fraction: float = 0.1,
+                 clock: Optional[Clock] = None):
         self.operator = operator
         self.tsdb = tsdb
         self.interval_s = interval_s
         self.min_change_fraction = min_change_fraction
-        self.percentile = PercentileRecommender()
+        self.clock = clock or default_clock()
+        self.percentile = PercentileRecommender(clock=self.clock)
         self.cron = CronRecommender()
         self.external = ExternalRecommender()
         self._stop = threading.Event()

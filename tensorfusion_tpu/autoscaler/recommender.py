@@ -17,12 +17,12 @@ from __future__ import annotations
 import json
 import logging
 import math
-import time
 import urllib.request
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from ..api.resources import ResourceAmount
+from ..clock import Clock, default_clock
 
 log = logging.getLogger("tpf.autoscaler.recommender")
 
@@ -39,12 +39,14 @@ class DecayingHistogram:
     ``half_life_s``; buckets grow by ``growth`` from ``first_bucket``."""
 
     def __init__(self, first_bucket: float = 0.01, growth: float = 1.05,
-                 n_buckets: int = 400, half_life_s: float = 1800.0):
+                 n_buckets: int = 400, half_life_s: float = 1800.0,
+                 clock: Optional[Clock] = None):
         self.first = first_bucket
         self.growth = growth
         self.weights = [0.0] * n_buckets
         self.half_life_s = half_life_s
-        self._ref_ts = time.time()
+        self.clock = clock or default_clock()
+        self._ref_ts = self.clock.now()
         self.total = 0.0
 
     def _bucket(self, value: float) -> int:
@@ -58,7 +60,7 @@ class DecayingHistogram:
 
     def add(self, value: float, ts: Optional[float] = None,
             weight: float = 1.0) -> None:
-        ts = ts if ts is not None else time.time()
+        ts = ts if ts is not None else self.clock.now()
         # decay is implemented by up-weighting newer samples relative to
         # the reference timestamp (equivalent, numerically stabler)
         w = weight * (2.0 ** ((ts - self._ref_ts) / self.half_life_s))
@@ -91,19 +93,23 @@ class PercentileRecommender:
 
     def __init__(self, percentile: float = 90.0,
                  margin_fraction: float = 0.15,
-                 half_life_s: float = 1800.0):
+                 half_life_s: float = 1800.0,
+                 clock: Optional[Clock] = None):
         self.percentile = percentile
         self.margin = margin_fraction
         self.half_life_s = half_life_s
+        self.clock = clock or default_clock()
         self._hists: Dict[str, Dict[str, DecayingHistogram]] = {}
 
     def observe(self, workload_key: str, tflops: float,
                 hbm_bytes: float, ts: Optional[float] = None) -> None:
         hists = self._hists.setdefault(workload_key, {
             "tflops": DecayingHistogram(first_bucket=0.1,
-                                        half_life_s=self.half_life_s),
+                                        half_life_s=self.half_life_s,
+                                        clock=self.clock),
             "hbm": DecayingHistogram(first_bucket=1e6,
-                                     half_life_s=self.half_life_s),
+                                     half_life_s=self.half_life_s,
+                                     clock=self.clock),
         })
         if tflops > 0:
             hists["tflops"].add(tflops, ts)
@@ -155,7 +161,10 @@ def _cron_field_matches(expr: str, value: int, lo: int, hi: int) -> bool:
 
 
 def cron_matches(schedule: str, when: Optional[float] = None) -> bool:
-    t = time.localtime(when if when is not None else time.time())
+    import time as _time   # localtime converts, it does not read a clock
+
+    t = _time.localtime(when if when is not None
+                        else default_clock().now())
     parts = schedule.split()
     if len(parts) != 5:
         raise ValueError(f"bad cron spec {schedule!r}")

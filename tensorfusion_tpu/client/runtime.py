@@ -34,12 +34,12 @@ import json
 import logging
 import os
 import threading
-import time
 import urllib.request
 import weakref
 from typing import Any, Callable, Dict, Optional
 
 from .. import constants
+from ..clock import default_clock
 from ..hypervisor.limiter_binding import Limiter
 
 log = logging.getLogger("tpf.client")
@@ -352,7 +352,7 @@ class VTPUClient:
                 return
             wait = max(r.wait_hint_us, 100) / 1e6
             self.blocked_time_s += wait
-            time.sleep(wait)
+            default_clock().sleep(wait)
 
     def charge_hbm(self, delta_bytes: int) -> bool:
         if not self.attached or delta_bytes == 0:

@@ -26,9 +26,9 @@ from __future__ import annotations
 
 import itertools
 import logging
-import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..clock import default_clock
 from ..api.types import TPUNodeClaim
 from ..store import ObjectStore
 from .mock import (InstanceType, TPU_INSTANCE_TYPES, materialize_tpu_host)
@@ -142,9 +142,10 @@ class TPUVMProvider:
                    f"{self._loc_path()}/queuedResources?"
                    f"queued_resource_id={qr_id}", body)
 
-        deadline = time.time() + self.poll_timeout_s
+        clock = default_clock()
+        deadline = clock.monotonic() + self.poll_timeout_s
         state = "CREATING"
-        while time.time() < deadline:
+        while clock.monotonic() < deadline:
             got = self._call("GET",
                              f"{self._loc_path()}/queuedResources/{qr_id}")
             raw = got.get("state", "")
@@ -154,7 +155,7 @@ class TPUVMProvider:
             if state in ("FAILED", "SUSPENDED"):
                 raise TPUVMError(
                     f"queued resource {qr_id} entered {state}")
-            time.sleep(self.poll_interval_s)
+            clock.sleep(self.poll_interval_s)
         if state != "ACTIVE":
             raise TPUVMError(
                 f"queued resource {qr_id} not ACTIVE within "

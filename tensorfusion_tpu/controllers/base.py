@@ -11,9 +11,9 @@ from __future__ import annotations
 import logging
 import queue
 import threading
-import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..clock import Clock, default_clock
 from ..store import DELETED, Event, ObjectStore
 
 log = logging.getLogger("tpf.controller")
@@ -35,8 +35,9 @@ class Controller:
 
 
 class ControllerManager:
-    def __init__(self, store: ObjectStore):
+    def __init__(self, store: ObjectStore, clock: Optional[Clock] = None):
         self.store = store
+        self.clock = clock or default_clock()
         self._controllers: List[Controller] = []
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
@@ -76,7 +77,7 @@ class ControllerManager:
         # unconflated path degrades to multi-second p95 at scale;
         # in-process stores accept and ignore the flag.
         watch = self.store.watch(*c.kinds, conflate=True)
-        last_resync = time.monotonic()
+        last_resync = self.clock.monotonic()
         try:
             while not stop.is_set():
                 timeout = 0.2
@@ -87,9 +88,9 @@ class ControllerManager:
                     if ev is not None:
                         c.reconcile(ev)
                     elif c.resync_interval_s > 0 and \
-                            time.monotonic() - last_resync >= \
+                            self.clock.monotonic() - last_resync >= \
                             c.resync_interval_s:
-                        last_resync = time.monotonic()
+                        last_resync = self.clock.monotonic()
                         c.reconcile(None)
                 except Exception:
                     log.exception("controller %s reconcile failed", c.name)

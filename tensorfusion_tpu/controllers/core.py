@@ -19,11 +19,11 @@ SURVEY.md §2.2 row "Controllers"):
 from __future__ import annotations
 
 import logging
-import time
 from typing import Callable, Dict, List, Optional
 
 from .. import constants
 from ..allocator.core import TPUAllocator
+from ..clock import Clock, default_clock
 from ..api import set_condition
 from ..api.types import (Container, Node, Pod, TPUChip, TPUCluster,
                          TPUConnection, TPUNode, TPUNodeClaim, TPUPool,
@@ -181,16 +181,41 @@ class ChipController(Controller):
 
 
 class NodeController(Controller):
-    """TPUNode rollup from its chips (gpunode_controller)."""
+    """TPUNode rollup from its chips (gpunode_controller), plus node
+    lifecycle: pods bound to a Node that leaves ``Running`` are evicted
+    after a grace period so their owners reschedule them onto live
+    capacity (the kube node-lifecycle pod GC analog).
+
+    The eviction path exists because the cluster digital twin's
+    ``rolling-node-failure`` scenario (seed 7, ``tests/test_sim.py::
+    test_dead_node_pods_are_evicted_and_rescheduled``) proved the
+    pre-round-11 control plane stranded every pod on a crashed node
+    forever: the scheduler stopped *placing* onto dead nodes, but
+    nothing ever *moved* the pods already there — connections kept
+    routing to workers whose host was gone."""
 
     name = "node"
-    kinds = ("TPUNode", "TPUChip")
+    kinds = ("TPUNode", "TPUChip", "Node")
     resync_interval_s = 10.0
+    #: a node must stay un-Running this long before its pods are
+    #: evicted (rides out flaps/reboots; Kubernetes' default is 5m,
+    #: scaled to this control plane's seconds-scale reconcile cadence)
+    node_eviction_grace_s = 10.0
 
-    def __init__(self, store: ObjectStore):
+    def __init__(self, store: ObjectStore,
+                 clock: Optional[Clock] = None,
+                 node_eviction_grace_s: Optional[float] = None):
         self.store = store
+        self.clock = clock or default_clock()
+        if node_eviction_grace_s is not None:
+            self.node_eviction_grace_s = node_eviction_grace_s
+        #: node name -> when it was first observed not-Running
+        self._failed_since: Dict[str, float] = {}
+        #: pod keys evicted off dead nodes (observability/tests)
+        self.evicted_from_dead: List[str] = []
 
     def reconcile(self, event):
+        self._evict_dead_nodes()
         chips = self.store.list(TPUChip)
         by_node: Dict[str, List[TPUChip]] = {}
         for c in chips:
@@ -225,6 +250,59 @@ class NodeController(Controller):
                 self.store.update(fresh, check_version=True)
             except (NotFoundError, ConflictError):
                 pass
+
+    def _evict_dead_nodes(self) -> None:
+        """Evict pods bound to nodes that have been out of ``Running``
+        past the grace period.  Worker pods are simply deleted (their
+        workload controller recreates them; the scheduler only places
+        on live nodes); standalone pods managed by our scheduler are
+        recreated as rebindable clones with the dead node excluded."""
+        now = self.clock.now()
+        live: set = set()
+        due: List[str] = []
+        for node in self.store.list(Node):
+            if node.status.phase == constants.PHASE_RUNNING:
+                live.add(node.name)
+                self._failed_since.pop(node.name, None)
+                continue
+            since = self._failed_since.setdefault(node.name, now)
+            if now - since >= self.node_eviction_grace_s:
+                due.append(node.name)
+        # drop bookkeeping for nodes deleted outright (compaction) —
+        # their pods are handled the same way, keyed by the pod's
+        # node_name below
+        for name in list(self._failed_since):
+            if name not in live and name not in due and \
+                    self.store.try_get(Node, name) is None:
+                del self._failed_since[name]
+        if not due:
+            return
+        dead = set(due)
+        for pod in self.store.list(
+                Pod, selector=lambda p: p.spec.node_name in dead):
+            self._evict_pod(pod)
+
+    def _evict_pod(self, pod: Pod) -> None:
+        from .defrag import _make_replacement
+
+        is_worker = pod.metadata.labels.get(
+            constants.LABEL_COMPONENT) == constants.COMPONENT_WORKER
+        ours = pod.spec.scheduler_name == constants.SCHEDULER_NAME
+        if not (is_worker or ours):
+            return      # not managed by this control plane
+        node = pod.spec.node_name
+        log.warning("node %s dead past grace: evicting %s", node,
+                    pod.key())
+        replacement = None if is_worker else \
+            _make_replacement(pod, node)
+        try:
+            self.store.delete(Pod, pod.metadata.name,
+                              pod.metadata.namespace)
+        except NotFoundError:
+            return      # owner got there first
+        self.evicted_from_dead.append(pod.key())
+        if replacement is not None:
+            self.store.create(replacement)
 
 
 class QuotaController(Controller):
@@ -283,9 +361,11 @@ class WorkloadController(Controller):
     resync_interval_s = 5.0
 
     def __init__(self, store: ObjectStore,
-                 worker_image: str = "tpufusion/worker:latest"):
+                 worker_image: str = "tpufusion/worker:latest",
+                 clock: Optional[Clock] = None):
         self.store = store
         self.worker_image = worker_image
+        self.clock = clock or default_clock()
         #: workload key -> when its connection count last went to zero
         self._zero_since: Dict[str, float] = {}
 
@@ -306,12 +386,13 @@ class WorkloadController(Controller):
         if not has_workers and key not in self._zero_since:
             return 0      # never active: don't spawn a warm worker
         grace = wl.spec.auto_scaling.scale_to_zero_grace_seconds
-        since = self._zero_since.setdefault(key, time.monotonic())
-        if time.monotonic() - since >= grace:
+        since = self._zero_since.setdefault(key, self.clock.monotonic())
+        if self.clock.monotonic() - since >= grace:
             return 0                            # autoscale-to-zero
         return min(1, cap)                      # keep one warm in grace
 
     def reconcile(self, event):
+        self._collect_orphans()
         # one pass over connections, bucketed by workload (O(W x C) per
         # event otherwise — every TPUConnection event reconciles here)
         conn_counts: Dict[tuple, int] = {}
@@ -391,6 +472,36 @@ class WorkloadController(Controller):
         # (a recreated workload must not inherit a stale zero-timestamp)
         self._zero_since = {k: v for k, v in self._zero_since.items()
                             if k in dynamic_keys}
+
+    def _collect_orphans(self) -> None:
+        """Owner GC: worker pods whose owning TPUWorkload is gone are
+        deleted (freeing their allocations through the PodController
+        delete path).  Worker pods have carried
+        ``owner_references = ["TPUWorkload/ns/name"]`` since round 1,
+        but nothing ever consumed them — deleting a workload orphaned
+        its workers forever, still bound and holding chip capacity
+        (round-11 bug #3, found by the digital twin's churn trace:
+        ``tests/test_sim.py::test_deleted_workload_workers_are_
+        garbage_collected``).  Level-triggered here (rather than only
+        on the DELETED event) so a missed event heals at the next
+        resync."""
+        live = {f"TPUWorkload/{w.metadata.namespace}/{w.metadata.name}"
+                for w in self.store.list(TPUWorkload)}
+        for pod in self.store.list(Pod):
+            if pod.metadata.labels.get(constants.LABEL_COMPONENT) != \
+                    constants.COMPONENT_WORKER:
+                continue
+            owners = [ref for ref in pod.metadata.owner_references
+                      if ref.startswith("TPUWorkload/")]
+            if not owners or any(ref in live for ref in owners):
+                continue
+            log.info("GC: deleting orphaned worker %s (owner %s gone)",
+                     pod.key(), owners[0])
+            try:
+                self.store.delete(Pod, pod.metadata.name,
+                                  pod.metadata.namespace)
+            except NotFoundError:
+                pass
 
     def _worker_pod(self, wl: TPUWorkload, name: str) -> Pod:
         from .rollout import component_hash

@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 import urllib.request
 from typing import Dict, List, Optional
 
@@ -29,6 +28,7 @@ from .. import constants
 from ..api.types import (Node, Pod, TPUChip, TPUNode, TPUNodeClaim,
                          TPUWorkload)
 from ..autoscaler.recommender import cron_matches
+from ..clock import Clock, default_clock
 from ..scheduler.gang import gang_info_from_pod
 from ..scheduler.tpuresources import compose_alloc_request
 from ..store import ConflictError, NotFoundError, mutate
@@ -73,7 +73,7 @@ def _make_replacement(pod: Pod, exclude_node: str,
         ann.get(constants.ANN_EXCLUDED_NODES, ""), exclude_node)
     ann[constants.ANN_DEFRAG_EXCLUDED] = _merge_exclusions(
         ann.get(constants.ANN_DEFRAG_EXCLUDED, ""), exclude_node)
-    ann[constants.ANN_DEFRAG_EVICTED_SINCE] = str(time.time())
+    ann[constants.ANN_DEFRAG_EVICTED_SINCE] = str(default_clock().now())
     replacement.metadata.annotations = ann
     replacement.spec = _clone_pod_spec(pod.spec)
     return replacement
@@ -87,10 +87,12 @@ class CompactionController(Controller):
     resync_interval_s = 2.0
 
     def __init__(self, store, allocator, scheduler=None,
-                 empty_grace_s: Optional[float] = None):
+                 empty_grace_s: Optional[float] = None,
+                 clock: Optional[Clock] = None):
         self.store = store
         self.allocator = allocator
         self.scheduler = scheduler
+        self.clock = clock or default_clock()
         self.empty_grace_override = empty_grace_s
         self._empty_since: Dict[str, float] = {}
         self._last_defrag: Dict[str, float] = {}
@@ -144,7 +146,7 @@ class CompactionController(Controller):
         """Clear drain bookkeeping (workload/pod exclusions, defrag-source
         and defrag-skip node marks) once the owning pool's eviction TTL
         lapses (gpupool_defrag TTL bookkeeping analog)."""
-        now = time.time()
+        now = self.clock.now()
 
         def ttl_for(pool: str) -> float:
             return ttls.get(pool, self.DEFAULT_EVICTION_TTL_S)
@@ -224,12 +226,12 @@ class CompactionController(Controller):
         if not cfg.defrag_cron:
             return False
         last = self._last_defrag.get(pool, 0.0)
-        if time.time() - last < 60.0:
+        if self.clock.now() - last < 60.0:
             return False  # one shot per cron minute
-        return cron_matches(cfg.defrag_cron)
+        return cron_matches(cfg.defrag_cron, when=self.clock.now())
 
     def _defrag_pool(self, pool, cfg) -> None:
-        self._last_defrag[pool.name] = time.time()
+        self._last_defrag[pool.name] = self.clock.now()
         nodes = self._node_utilization(pool.name)
         for node, util in nodes.items():
             if util >= cfg.defrag_util_threshold_percent / 100.0 or \
@@ -250,7 +252,7 @@ class CompactionController(Controller):
         pods = self.store.list(
             Pod, selector=lambda p: p.spec.node_name == node)
         evicted = 0
-        now = str(time.time())
+        now = str(self.clock.now())
         gangs_seen: set = set()
         for pod in pods:
             probe = compose_alloc_request(pod)
@@ -397,7 +399,7 @@ class CompactionController(Controller):
         grace = self.empty_grace_override \
             if self.empty_grace_override is not None \
             else cfg.period_seconds
-        now = time.time()
+        now = self.clock.now()
         for node, util in self._node_utilization(pool.name).items():
             if util > 0.0:
                 self._empty_since.pop(node, None)
@@ -458,9 +460,10 @@ class LiveMigrator:
     """Hot vTPU migration: snapshot on the source hypervisor, rebind the
     pod elsewhere, restore on the target (SURVEY §5 checkpoint/resume)."""
 
-    def __init__(self, store, allocator):
+    def __init__(self, store, allocator, clock: Optional[Clock] = None):
         self.store = store
         self.allocator = allocator
+        self.clock = clock or default_clock()
 
     def _hypervisor_url(self, node: str) -> str:
         tnode = self.store.try_get(TPUNode, node)
@@ -566,15 +569,15 @@ class LiveMigrator:
         self.store.create(replacement)
 
         # 3. wait for the rebind (chips restored to Running either way)
-        deadline = time.time() + wait_rebind_s
+        deadline = self.clock.now() + wait_rebind_s
         new_node = None
-        while time.time() < deadline:
+        while self.clock.now() < deadline:
             cur = self.store.try_get(Pod, pod_name, namespace)
             if cur is not None and cur.spec.node_name and \
                     cur.spec.node_name != source:
                 new_node = cur.spec.node_name
                 break
-            time.sleep(0.05)
+            self.clock.sleep(0.05)
         self._restore_running(marked)
 
         # 4. restore + thaw on the target
@@ -663,9 +666,9 @@ class LiveMigrator:
             return None
 
         # 3. wait for every evicted member to rebind off the drained node
-        deadline = time.time() + wait_rebind_s
+        deadline = self.clock.now() + wait_rebind_s
         placed: Dict[str, str] = {}
-        while time.time() < deadline and len(placed) < len(evicted):
+        while self.clock.now() < deadline and len(placed) < len(evicted):
             for p in evicted:
                 if p.key() in placed:
                     continue
@@ -674,7 +677,7 @@ class LiveMigrator:
                 if cur is not None and cur.spec.node_name and \
                         cur.spec.node_name != source:
                     placed[p.key()] = cur.spec.node_name
-            time.sleep(0.05)
+            self.clock.sleep(0.05)
         self._restore_running(marked)
 
         # 4. restore on targets (deferred for stragglers; the criterion
@@ -704,8 +707,8 @@ class LiveMigrator:
 
     def _deferred_resume(self, namespace: str, pod_name: str,
                          source: str, deadline_s: float = 120.0) -> None:
-        deadline = time.time() + deadline_s
-        while time.time() < deadline:
+        deadline = self.clock.now() + deadline_s
+        while self.clock.now() < deadline:
             cur = self.store.try_get(Pod, pod_name, namespace)
             if cur is None:
                 return
@@ -714,6 +717,6 @@ class LiveMigrator:
                 log.info("deferred migration restore of %s/%s on %s",
                          namespace, pod_name, cur.spec.node_name)
                 return
-            time.sleep(0.5)
+            self.clock.sleep(0.5)
         log.error("migration of %s/%s never rebound within %ss; snapshot "
                   "left on disk", namespace, pod_name, deadline_s)

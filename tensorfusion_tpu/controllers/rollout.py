@@ -14,12 +14,12 @@ from __future__ import annotations
 import hashlib
 import json
 import logging
-import time
 from dataclasses import asdict
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from .. import constants
 from ..api.types import Pod, TPUPool
+from ..clock import Clock, default_clock
 from ..store import ConflictError, NotFoundError
 from .base import Controller
 
@@ -36,8 +36,9 @@ class RolloutController(Controller):
     kinds = ("TPUPool", "Pod")
     resync_interval_s = 2.0
 
-    def __init__(self, store):
+    def __init__(self, store, clock: Optional[Clock] = None):
         self.store = store
+        self.clock = clock or default_clock()
         self._last_batch: Dict[str, float] = {}
         self.recycled: List[str] = []
 
@@ -64,7 +65,7 @@ class RolloutController(Controller):
                                            f"Ready@{target}")
                 continue
             # batch recycle
-            now = time.time()
+            now = self.clock.now()
             last = self._last_batch.get(pool.name, 0.0)
             if now - last < cfg.batch_interval_seconds:
                 continue

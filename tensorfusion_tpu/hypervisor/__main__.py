@@ -29,8 +29,8 @@ import logging
 import os
 import signal
 import sys
-import time
 
+from ..clock import WALL
 from .. import constants
 from .allocation import AllocationController
 from .device import DeviceController
@@ -205,7 +205,7 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGINT, _sig)
     try:
         while not stop:
-            time.sleep(0.5)
+            WALL.sleep(0.5)
     finally:
         daemon.stop()
     return 0

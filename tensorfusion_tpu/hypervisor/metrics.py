@@ -23,10 +23,10 @@ import itertools
 import logging
 import os
 import threading
-import time
 from collections import deque
 from typing import Callable, List, Optional
 
+from ..clock import default_clock
 from ..metrics.encoder import encode_line
 
 log = logging.getLogger("tpf.hypervisor.metrics")
@@ -130,7 +130,7 @@ class HypervisorMetricsRecorder:
 
     def record_once(self) -> None:
         lines = []
-        ts = time.time_ns()
+        ts = default_clock().now_ns()
         self.devices.refresh_metrics()
         for e in self.devices.devices():
             m = e.metrics

@@ -26,10 +26,10 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-import time
 import urllib.request
 from typing import Dict, List, Optional, Tuple
 
+from ..clock import WALL
 from .. import constants
 from .limiter_binding import ShmView, list_worker_segments
 
@@ -373,7 +373,7 @@ class TuiState:
     def update(self, devices: List[dict], workers: List[dict]) -> None:
         self.devices, self.workers = devices, workers
         self.error = None
-        self.last_update = time.time()
+        self.last_update = WALL.now()
         self.sel_device = min(self.sel_device, max(len(devices) - 1, 0))
         self.sel_worker = min(self.sel_worker, max(len(workers) - 1, 0))
         for d in devices:
@@ -471,8 +471,8 @@ class TuiState:
 
     def header(self) -> str:
         stale = ""
-        if self.last_update and time.time() - self.last_update > 5:
-            stale = f"  (stale {time.time() - self.last_update:.0f}s)"
+        if self.last_update and WALL.now() - self.last_update > 5:
+            stale = f"  (stale {WALL.now() - self.last_update:.0f}s)"
         return ("tpu-fusion hypervisor  [d]evices [w]orkers [m]etrics "
                 "[s]hm  j/k+enter detail  esc back  [q]uit" + stale)
 
@@ -530,7 +530,7 @@ def run_curses(url: str, shm_base: str, refresh_s: float = 1.0) -> None:
         last_fetch = 0.0
         dirty = True
         while True:
-            now = time.time()
+            now = WALL.now()
             if now - last_fetch >= refresh_s:
                 last_fetch = now
                 try:

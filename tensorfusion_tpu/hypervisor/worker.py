@@ -14,11 +14,11 @@ from __future__ import annotations
 import logging
 import os
 import threading
-import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from .. import constants
+from ..clock import Clock, default_clock
 from ..api.types import AutoFreezeRule, ERLParameters
 from .allocation import AllocationController, WorkerAllocation
 from .device import DeviceController
@@ -38,7 +38,7 @@ class TrackedWorker:
     shm_path: str = ""
     view: Optional[ShmView] = None
     last_blocked: Dict[int, int] = field(default_factory=dict)
-    last_active_ts: float = field(default_factory=time.time)
+    last_active_ts: float = 0.0    # stamped by WorkerController's clock
     auto_frozen: bool = False
 
 
@@ -50,7 +50,9 @@ class WorkerController:
                  erl_params: Optional[ERLParameters] = None,
                  qos_coeffs: Optional[Dict[str, float]] = None,
                  auto_freeze_rules: Optional[List[AutoFreezeRule]] = None,
-                 tick_interval_s: float = 0.1):
+                 tick_interval_s: float = 0.1,
+                 clock: Optional[Clock] = None):
+        self.clock = clock or default_clock()
         self.devices = devices
         self.allocator = allocator
         self.limiter = limiter
@@ -63,7 +65,7 @@ class WorkerController:
         self._workers: Dict[str, TrackedWorker] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._last_tick = time.monotonic()
+        self._last_tick = self.clock.monotonic()
         self.limiter.init(shm_base)
 
     # -- lifecycle --------------------------------------------------------
@@ -93,7 +95,8 @@ class WorkerController:
         # is visible to the sync loop's orphan cleanup *before* the segment
         # exists.
         tracked = TrackedWorker(spec=spec,
-                                allocation=WorkerAllocation(spec=spec))
+                                allocation=WorkerAllocation(spec=spec),
+                                last_active_ts=self.clock.now())
         tracked.shm_path = (
             os.path.join(self.shm_base, spec.namespace, spec.name)
             if spec.isolation == constants.ISOLATION_SOFT else "")
@@ -110,7 +113,7 @@ class WorkerController:
                 b.chip_id: b.grant.partition_id
                 for b in allocation.bindings if b.grant is not None}
             tracked.status.env = allocation.env
-            tracked.status.started_at = time.time()
+            tracked.status.started_at = self.clock.now()
             if spec.isolation == constants.ISOLATION_SOFT:
                 self._ensure_soft_shm(tracked)
         except Exception:
@@ -209,7 +212,7 @@ class WorkerController:
     # -- hot loop ---------------------------------------------------------
 
     def tick(self) -> None:
-        now = time.monotonic()
+        now = self.clock.monotonic()
         dt = max(now - self._last_tick, 1e-3)
         self._last_tick = now
 
@@ -232,7 +235,7 @@ class WorkerController:
             hbm_by_pid_chip[(s.pid, s.chip_id)] = s.hbm_used_bytes
 
         observations: List[Observation] = []
-        ts = int(time.time())
+        ts = int(self.clock.now())
         for w in workers:
             ns, pod = w.spec.namespace, w.spec.name
             shm_state = None
@@ -281,7 +284,7 @@ class WorkerController:
             w.status.duty_cycle_pct = total_duty
             w.status.hbm_used_bytes = total_hbm
             if total_duty > 0.5:
-                w.last_active_ts = time.time()
+                w.last_active_ts = self.clock.now()
 
             if w.spec.isolation == constants.ISOLATION_SOFT:
                 try:
@@ -311,7 +314,7 @@ class WorkerController:
             return
         if w.spec.isolation != constants.ISOLATION_SOFT:
             return
-        idle = time.time() - w.last_active_ts
+        idle = self.clock.now() - w.last_active_ts
         ns, pod = w.spec.namespace, w.spec.name
         if not w.auto_frozen and idle > rule.freeze_to_mem_ttl_seconds:
             try:
@@ -335,7 +338,7 @@ class WorkerController:
             pass
         w.auto_frozen = False
         w.status.frozen = False
-        w.last_active_ts = time.time()
+        w.last_active_ts = self.clock.now()
 
     def freeze_worker(self, worker_key: str) -> None:
         ns, pod = worker_key.split("/", 1)

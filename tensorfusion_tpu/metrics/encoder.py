@@ -8,8 +8,9 @@ tpu-fusion ships into its in-process TSDB, metrics/tsdb.py).
 
 from __future__ import annotations
 
-import time
 from typing import Dict, Optional, Union
+
+from ..clock import default_clock
 
 Value = Union[int, float, str, bool]
 
@@ -42,7 +43,7 @@ def encode_line(measurement: str, tags: Dict[str, str],
     body = ",".join(f"{_escape_tag(k)}={_field_value(v)}"
                     for k, v in sorted(fields.items()))
     if ts_ns is None:
-        ts_ns = time.time_ns()
+        ts_ns = default_clock().now_ns()
     return f"{head} {body} {ts_ns}"
 
 
@@ -79,7 +80,7 @@ def parse_line(line: str):
     if last_space >= 0 and rest[last_space + 1:].lstrip("-").isdigit():
         fieldstr, ts_ns = rest[:last_space], int(rest[last_space + 1:])
     else:
-        fieldstr, ts_ns = rest, time.time_ns()
+        fieldstr, ts_ns = rest, default_clock().now_ns()
 
     def unescape(s: str) -> str:
         out, esc = [], False

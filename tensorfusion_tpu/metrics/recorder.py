@@ -13,11 +13,11 @@ from __future__ import annotations
 import logging
 import os
 import threading
-import time
 from typing import Dict, Optional
 
 from .. import constants
 from ..api.types import Pod, TPUPool, TPUWorkload
+from ..clock import Clock, default_clock
 from ..cloudprovider.pricing import hourly_cost
 from .encoder import encode_line
 from .tsdb import TSDB
@@ -28,9 +28,10 @@ log = logging.getLogger("tpf.metrics.recorder")
 class MetricsRecorder:
     def __init__(self, operator, tsdb: Optional[TSDB] = None,
                  path: str = "", interval_s: float = 5.0,
-                 remote_workers=()):
+                 remote_workers=(), clock: Optional[Clock] = None):
         self.operator = operator
-        self.tsdb = tsdb or TSDB()
+        self.clock = clock or default_clock()
+        self.tsdb = tsdb or TSDB(clock=self.clock)
         self.path = path
         self.interval_s = interval_s
         #: RemoteVTPUWorker instances embedded in this process (the
@@ -71,8 +72,8 @@ class MetricsRecorder:
     def record_once(self) -> int:
         op = self.operator
         lines = []
-        ts = time.time_ns()
-        now = time.time()
+        ts = self.clock.now_ns()
+        now = self.clock.now()
 
         pool_totals: Dict[str, Dict[str, float]] = {}
         for state in op.allocator.chips():

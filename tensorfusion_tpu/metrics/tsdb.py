@@ -12,11 +12,11 @@ from __future__ import annotations
 
 import bisect
 import threading
-import time
 from collections import deque
 from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Tuple
 
+from ..clock import Clock, default_clock
 from .encoder import parse_line
 
 
@@ -35,9 +35,11 @@ class Point:
 
 class TSDB:
     def __init__(self, retention_s: float = 3600.0,
-                 max_points_per_series: int = 10000):
+                 max_points_per_series: int = 10000,
+                 clock: Optional[Clock] = None):
         self.retention_s = retention_s
         self.max_points = max_points_per_series
+        self.clock = clock or default_clock()
         self._lock = threading.RLock()
         self._series: Dict[SeriesKey, deque] = {}
 
@@ -45,7 +47,7 @@ class TSDB:
 
     def insert(self, measurement: str, tags: Dict[str, str],
                fields: Dict[str, float], ts: Optional[float] = None) -> None:
-        ts = ts if ts is not None else time.time()
+        ts = ts if ts is not None else self.clock.now()
         tag_key = tuple(sorted(tags.items()))
         with self._lock:
             for field, value in fields.items():
@@ -103,7 +105,7 @@ class TSDB:
               since: Optional[float] = None,
               until: Optional[float] = None) -> List[Tuple[dict, List[Point]]]:
         """Returns [(tags, points)] for every matching series."""
-        now = time.time()
+        now = self.clock.now()
         since = since if since is not None else now - self.retention_s
         until = until if until is not None else now
         with self._lock:
@@ -122,7 +124,7 @@ class TSDB:
         """Aggregate over all matching points in the trailing window.
         agg: mean | max | min | sum | count | p50 | p90 | p95 | p99 | last"""
         series = self.query(measurement, field, tags,
-                            since=time.time() - window_s)
+                            since=self.clock.now() - window_s)
         if agg == "last":
             latest = max(((pts[-1].ts, pts[-1].value)
                           for _, pts in series), default=None)
@@ -131,7 +133,7 @@ class TSDB:
         return aggregate_values(values, agg)
 
     def gc(self) -> None:
-        cutoff = time.time() - self.retention_s
+        cutoff = self.clock.now() - self.retention_s
         with self._lock:
             for key, dq in list(self._series.items()):
                 while dq and dq[0].ts < cutoff:

@@ -17,12 +17,12 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 from typing import Dict, List, Optional
 
 from . import constants
 from .allocator import IndexAllocator, PortAllocator, TPUAllocator
 from .api.types import Node, Pod, TPUChip
+from .clock import Clock, default_clock
 from .cloudprovider import MockCloudProvider
 from .controllers.base import ControllerManager
 from .controllers.core import (ChipController, ClusterController,
@@ -52,16 +52,19 @@ class Operator:
                  alert_rules=None, alert_webhook: str = "",
                  sync_interval_s: float = 2.0,
                  config_path: str = "",
-                 leader_lock: str = ""):
+                 leader_lock: str = "",
+                 clock: Optional[Clock] = None):
+        self.clock = clock or default_clock()
         self.store = store or ObjectStore()
-        self.allocator = TPUAllocator(store=self.store)
+        self.allocator = TPUAllocator(store=self.store, clock=self.clock)
         self.ports = PortAllocator()
         self.indices = IndexAllocator()
         self.parser = WorkloadParser(self.store)
         self.mutator = PodMutator(self.store, self.parser)
-        self.gang = GangManager()
+        self.gang = GangManager(clock=self.clock)
         self.cloud = MockCloudProvider(self.store)
-        self.expander = NodeExpander(self.store, enabled=enable_expander)
+        self.expander = NodeExpander(self.store, enabled=enable_expander,
+                                     clock=self.clock)
         self.sync_interval_s = sync_interval_s
 
         # Informer-style cached lister (docs/control-plane-scale.md):
@@ -81,10 +84,11 @@ class Operator:
         self.fit = TPUResourcesFit(
             self.allocator, gang=self.gang, ports=self.ports,
             indices=self.indices, pods_on_node=self._pods_on_node,
-            evict=self._evict_pod)
+            evict=self._evict_pod, clock=self.clock)
         self.scheduler = Scheduler(nodes_fn=self._node_names,
                                    bind_fn=self._bind_pod,
-                                   failure_handler=self._on_sched_failure)
+                                   failure_handler=self._on_sched_failure,
+                                   clock=self.clock)
         self.gang.bind_scheduler(self.scheduler)
         self.scheduler.register(self.fit)
         self.scheduler.register(ICITopologyPlugin(
@@ -92,13 +96,15 @@ class Operator:
             node_slices=self.allocator.node_slice_ids))
         self.allocator.set_gang_waiting_probe(self.gang.is_waiting)
 
-        self.manager = ControllerManager(self.store)
+        self.manager = ControllerManager(self.store, clock=self.clock)
         self.providerconfig_ctrl = ProviderConfigController(
             self.allocator, self.parser)
         self.compaction = CompactionController(self.store, self.allocator,
-                                               self.scheduler)
-        self.migrator = LiveMigrator(self.store, self.allocator)
-        self.rollout = RolloutController(self.store)
+                                               self.scheduler,
+                                               clock=self.clock)
+        self.migrator = LiveMigrator(self.store, self.allocator,
+                                     clock=self.clock)
+        self.rollout = RolloutController(self.store, clock=self.clock)
         for ctrl in (
                 self.compaction,
                 self.rollout,
@@ -106,10 +112,10 @@ class Operator:
                 PoolController(self.store, self.allocator),
                 ChipController(self.allocator,
                                on_change=self.scheduler.activate),
-                NodeController(self.store),
+                NodeController(self.store, clock=self.clock),
                 QuotaController(self.allocator),
                 self.providerconfig_ctrl,
-                WorkloadController(self.store),
+                WorkloadController(self.store, clock=self.clock),
                 ConnectionController(self.store),
                 PodController(self.store, self.allocator, self.scheduler,
                               self.ports, self.indices, self.gang),
@@ -124,15 +130,16 @@ class Operator:
         from .metrics.recorder import MetricsRecorder
         from .metrics.tsdb import TSDB
 
-        self.tsdb = TSDB()
+        self.tsdb = TSDB(clock=self.clock)
         # alerts (and the default tpf_quota/tpf_pool rules) are fed by
         # the recorder — enabling alerting without it would evaluate
         # against permanent silence
         want_alerts = alert_rules is not None or bool(alert_webhook)
         self.metrics = MetricsRecorder(self, tsdb=self.tsdb,
-                                       path=metrics_path) \
+                                       path=metrics_path,
+                                       clock=self.clock) \
             if enable_metrics or metrics_path or want_alerts else None
-        self.autoscaler = AutoScaler(self, self.tsdb) \
+        self.autoscaler = AutoScaler(self, self.tsdb, clock=self.clock) \
             if enable_autoscaler else None
         if want_alerts:
             from .alert.evaluator import default_rules
@@ -141,7 +148,7 @@ class Operator:
                 self.tsdb,
                 rules=(list(alert_rules) if alert_rules is not None
                        else default_rules()),
-                webhook_url=alert_webhook)
+                webhook_url=alert_webhook, clock=self.clock)
         else:
             self.alerts = None
         #: hypervisor metrics files to tail into the TSDB (single-host /
@@ -229,10 +236,37 @@ class Operator:
         # informer cache up FIRST: everything below reads through it
         self.cache.start()
         self.cache.wait_synced(10.0)
+        self._recover_state()
+        self.manager.start()
+        self.scheduler.start()
+        self._sync_thread = threading.Thread(target=self._sync_loop,
+                                             args=(self._stop,),
+                                             name="tpf-operator-sync",
+                                             daemon=True)
+        self._sync_thread.start()
+        if self.metrics is not None:
+            self.metrics.start()
+        if self.autoscaler is not None:
+            self.autoscaler.start()
+        if self.alerts is not None:
+            self.alerts.start()
+        # mark components live BEFORE the boot-time config apply: a
+        # GlobalConfig that carries alert rules may construct the alert
+        # evaluator, and _apply_global_config only starts it when
+        # _components_started is already set
+        self._components_started = True
+        if self.config_watcher is not None:
+            self._apply_global_config(self.config_watcher.config)
+            self.config_watcher.start()
+        log.info("operator components started")
+
+    def _recover_state(self) -> None:
+        """Restart recovery before serving: chips first (the watch
+        replay is async), then rebuild allocator + quota state from
+        persisted pods (reconcileAllocationState analog).  Shared by
+        the threaded start path and the digital twin's cooperative
+        start (:mod:`tensorfusion_tpu.sim`)."""
         self._nodes_memo = None
-        # restart recovery before serving: chips first (the watch replay is
-        # async), then rebuild allocator + quota state from persisted pods
-        # (reconcileAllocationState analog)
         for chip in self.store.list(TPUChip):
             self.allocator.upsert_chip(chip)
         pods = self.store.list(Pod)
@@ -257,28 +291,17 @@ class Operator:
                 self.ports.reconcile(port_assignments)
             if index_assignments:
                 self.indices.reconcile(index_assignments)
-        self.manager.start()
-        self.scheduler.start()
-        self._sync_thread = threading.Thread(target=self._sync_loop,
-                                             args=(self._stop,),
-                                             name="tpf-operator-sync",
-                                             daemon=True)
-        self._sync_thread.start()
-        if self.metrics is not None:
-            self.metrics.start()
-        if self.autoscaler is not None:
-            self.autoscaler.start()
-        if self.alerts is not None:
-            self.alerts.start()
-        # mark components live BEFORE the boot-time config apply: a
-        # GlobalConfig that carries alert rules may construct the alert
-        # evaluator, and _apply_global_config only starts it when
-        # _components_started is already set
-        self._components_started = True
-        if self.config_watcher is not None:
-            self._apply_global_config(self.config_watcher.config)
-            self.config_watcher.start()
-        log.info("operator components started")
+
+    def sync_once(self) -> None:
+        """One maintenance pass (the _sync_loop body): dirty chip flush,
+        assumed-TTL sweep, metrics drains.  The twin drives it from a
+        simulated-time timer instead of the background thread."""
+        self.allocator.sync_to_store()
+        self.allocator.sweep_assumed()
+        for path in self.worker_metrics_paths:
+            self._metrics_offsets[path] = self.tsdb.ingest_file(
+                path, self._metrics_offsets.get(path, 0))
+        self._drain_remote_metrics()
 
     def stop(self) -> None:
         self._stop.set()
@@ -329,12 +352,7 @@ class Operator:
         its generation's stop event so a stale thread can't be revived."""
         while not stop.wait(self.sync_interval_s):
             try:
-                self.allocator.sync_to_store()
-                self.allocator.sweep_assumed()
-                for path in self.worker_metrics_paths:
-                    self._metrics_offsets[path] = self.tsdb.ingest_file(
-                        path, self._metrics_offsets.get(path, 0))
-                self._drain_remote_metrics()
+                self.sync_once()
             except Exception:
                 log.exception("operator sync pass failed")
 
@@ -393,12 +411,12 @@ class Operator:
 
     def wait_for_binding(self, name: str, namespace: str = "default",
                          timeout: float = 10.0) -> Optional[Pod]:
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        deadline = self.clock.monotonic() + timeout
+        while self.clock.monotonic() < deadline:
             pod = self.store.try_get(Pod, name, namespace)
             if pod is not None and pod.spec.node_name:
                 return pod
-            time.sleep(0.02)
+            self.clock.sleep(0.02)
         return None
 
     # -- scheduler wiring ---------------------------------------------------
@@ -406,6 +424,14 @@ class Operator:
     def _on_cache_event(self, ev) -> None:
         if ev.obj.KIND == "Node":
             self._nodes_memo = None
+            # a node ENTERING Running is returning capacity (heal after
+            # a crash, fresh registration): requeue unschedulable pods
+            # now instead of waiting for an unrelated chip event (the
+            # allocator-sync side channel the digital twin's
+            # rolling-node-failure scenario exposed)
+            if ev.type != "DELETED" and \
+                    ev.obj.status.phase == constants.PHASE_RUNNING:
+                self.scheduler.activate()
 
     @property
     def _cache_live(self) -> bool:
@@ -561,8 +587,9 @@ def main(argv=None, stop_event: Optional[threading.Event] = None) -> int:
     # bootstrap the pool: ride out a state store that is still coming up
     # (transport errors retry; a concurrent replica winning the create is
     # success, not failure)
+    from .clock import WALL
     from .store import AlreadyExistsError, ConflictError
-    deadline = time.time() + 60
+    deadline = WALL.monotonic() + 60
     while True:
         try:
             if store.try_get(TPUPool, args.pool) is None:
@@ -573,10 +600,10 @@ def main(argv=None, stop_event: Optional[threading.Event] = None) -> int:
         except (AlreadyExistsError, ConflictError):
             break
         except Exception as e:  # noqa: BLE001 - transport error
-            if time.time() > deadline:
+            if WALL.monotonic() > deadline:
                 raise
             log.warning("pool bootstrap retrying: %s", e)
-            time.sleep(1.0)
+            WALL.sleep(1.0)
     if args.bootstrap_host:
         gen, _, chips = args.bootstrap_host.partition(":")
         claim = TPUNodeClaim.new(f"bootstrap-{gen}")

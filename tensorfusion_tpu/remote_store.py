@@ -30,12 +30,12 @@ import logging
 import queue
 import ssl
 import threading
-import time
 import urllib.error
 import urllib.parse
 import urllib.request
 from typing import Callable, Iterable, List, Optional, Type
 
+from .clock import default_clock
 from .api.meta import Resource, freeze_copy, from_dict
 from .gateway import KIND_BY_NAME
 from .store import (AlreadyExistsError, ConflictError, DELETED, Event,
@@ -281,7 +281,7 @@ class RemoteStore:
                 delay = RETRY_BACKOFF_S[min(tries,
                                             len(RETRY_BACKOFF_S) - 1)]
                 tries += 1
-                time.sleep(delay)
+                default_clock().sleep(delay)
                 continue
             # raised OUTSIDE the try: several API errors are OSError
             # subclasses (PermissionError) and must not hit the

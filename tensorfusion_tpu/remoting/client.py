@@ -49,7 +49,6 @@ import os
 import random
 import socket
 import threading
-import time
 import urllib.request
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -58,6 +57,7 @@ import numpy as np
 
 from .. import constants
 from . import protocol
+from ..clock import default_clock
 from .protocol import recv_message, send_message
 
 log = logging.getLogger("tpf.remoting.client")
@@ -579,7 +579,7 @@ class RemoteDevice:
                     busy += 1
                     if busy > MAX_BUSY_RETRIES:
                         raise
-                    time.sleep(e.backoff_s(busy))
+                    default_clock().sleep(e.backoff_s(busy))
                 except ConnectionError:
                     # one reconnect attempt, like _rpc: send_execute
                     # re-fires any shard PUTs on the fresh connection

@@ -11,11 +11,11 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 from typing import Dict, Optional
 
 from .. import constants
 from ..api.types import Pod, TPUNodeClaim
+from ..clock import Clock, default_clock
 from ..cloudprovider.mock import TPU_INSTANCE_TYPES
 from ..store import AlreadyExistsError, ObjectStore
 from .tpuresources import compose_alloc_request
@@ -28,12 +28,15 @@ _CAPACITY_MARKERS = ("insufficient", "no eligible chips",
 
 class NodeExpander:
     def __init__(self, store: ObjectStore, enabled: bool = True,
-                 inflight_ttl_s: float = 120.0):
+                 inflight_ttl_s: float = 120.0,
+                 clock: Optional[Clock] = None):
         self.store = store
         self.enabled = enabled
         self.inflight_ttl_s = inflight_ttl_s
+        self.clock = clock or default_clock()
         self._lock = threading.Lock()
         self._inflight: Dict[str, float] = {}   # pool/generation -> ts
+        self._seq = 0                           # claim-name uniquifier
 
     def handle_failure(self, pod: Pod, reason: str) -> Optional[str]:
         """Scheduler failure-handler hook.  Returns the claim name when an
@@ -47,7 +50,7 @@ class NodeExpander:
             return None
         generation = req.generation or "v5e"
         key = f"{req.pool}/{generation}"
-        now = time.time()
+        now = self.clock.now()
         with self._lock:
             ts = self._inflight.get(key, 0.0)
             if now - ts < self.inflight_ttl_s:
@@ -65,8 +68,19 @@ class NodeExpander:
                         pod.key(), req.chip_count, req.request.hbm_bytes)
             return None
         it = candidates[0]
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        # the sequence number makes the name unique across expansions
+        # within the same wall second: before round 11, two capacity
+        # misses in one second collided on the timestamp-only name, and
+        # the AlreadyExistsError below then stranded the freshly-written
+        # in-flight stamp with NO claim to clear it — every further
+        # expansion for that shape was refused for the full TTL while
+        # the cluster stayed full (found chasing the churn-soak flake;
+        # regression: tests/test_sim.py::test_expander_same_second_*)
         claim_name = f"expand-{req.pool or 'default'}-{generation}-" \
-                     f"{int(now) % 100000}"
+                     f"{int(now) % 100000}-{seq}"
         claim = TPUNodeClaim.new(claim_name)
         claim.spec.pool = req.pool
         claim.spec.generation = generation
@@ -76,6 +90,14 @@ class NodeExpander:
         try:
             self.store.create(claim)
         except AlreadyExistsError:
+            # never a live race (the in-flight stamp serializes those):
+            # a stale same-named claim object.  Roll the stamp back so
+            # the next miss is free to expand instead of being refused
+            # until the TTL lapses.
+            with self._lock:
+                self._inflight.pop(key, None)
+            log.warning("expansion claim %s already exists; rolled back "
+                        "the in-flight stamp", claim_name)
             return None
         log.info("capacity expansion: claim %s (%s) for pod %s",
                  claim_name, it.name, pod.key())

@@ -14,13 +14,13 @@ from __future__ import annotations
 import logging
 import queue
 import threading
-import time
 from dataclasses import dataclass, field
 from enum import Enum
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import constants
 from ..api.types import Pod
+from ..clock import Clock, default_clock
 
 log = logging.getLogger("tpf.scheduler")
 
@@ -155,10 +155,12 @@ class Scheduler:
 
     def __init__(self, nodes_fn: Callable[[], List[str]],
                  bind_fn: Callable[[Pod, str], None],
-                 failure_handler: Optional[Callable[[Pod, str], None]] = None):
+                 failure_handler: Optional[Callable[[Pod, str], None]] = None,
+                 clock: Optional[Clock] = None):
         self.nodes_fn = nodes_fn
         self.bind_fn = bind_fn
         self.failure_handler = failure_handler
+        self.clock = clock or default_clock()
         self.plugins: List[Plugin] = []
         self._of_cache: Dict[type, List[Plugin]] = {}
         self._active: "queue.PriorityQueue[_QueuedPod]" = queue.PriorityQueue()
@@ -227,8 +229,8 @@ class Scheduler:
             self._in_queue[key] = gen
             self._unschedulable.pop(key, None)
             self._gated.pop(key, None)
-        self._active.put(_QueuedPod(pod.spec.priority, time.monotonic(),
-                                    pod, gen))
+        self._active.put(_QueuedPod(pod.spec.priority,
+                                    self.clock.monotonic(), pod, gen))
 
     def activate(self) -> None:
         """Requeue unschedulable + gated pods (event-driven wakeup — the
@@ -278,22 +280,42 @@ class Scheduler:
                 item = self._active.get(timeout=0.2)
             except queue.Empty:
                 continue
-            key = item.pod.key()
+            self._process(item)
+
+    def _process(self, item: _QueuedPod) -> bool:
+        """Run one dequeued entry's scheduling cycle (dropping stale /
+        tombstoned entries).  Returns True when a cycle actually ran."""
+        key = item.pod.key()
+        with self._lock:
+            if self._in_queue.get(key) != item.gen:
+                return False   # superseded by a newer entry for this key
+            del self._in_queue[key]
+            if key in self._forgotten:
+                self._forgotten.discard(key)   # deleted while queued
+                return False
+            self._inflight.add(key)
+        try:
+            self.schedule_one(item.pod)
+        except Exception:
+            log.exception("scheduling cycle for %s crashed", key)
+        finally:
             with self._lock:
-                if self._in_queue.get(key) != item.gen:
-                    continue   # superseded by a newer entry for this key
-                del self._in_queue[key]
-                if key in self._forgotten:
-                    self._forgotten.discard(key)   # deleted while queued
-                    continue
-                self._inflight.add(key)
+                self._inflight.discard(key)
+        return True
+
+    def run_until_idle(self, max_cycles: int = 100000) -> int:
+        """Cooperative stepping (the digital twin's drive mode — no
+        scheduler thread): drain the active queue synchronously.
+        Returns the number of scheduling cycles run."""
+        ran = 0
+        while ran < max_cycles:
             try:
-                self.schedule_one(item.pod)
-            except Exception:
-                log.exception("scheduling cycle for %s crashed", key)
-            finally:
-                with self._lock:
-                    self._inflight.discard(key)
+                item = self._active.get_nowait()
+            except queue.Empty:
+                return ran
+            if self._process(item):
+                ran += 1
+        return ran
 
     # -- the scheduling cycle (SURVEY.md §3.3) ----------------------------
 
@@ -393,8 +415,8 @@ class Scheduler:
                 self._unreserve_all(state, pod, best)
                 return self._unsched(pod, state, st)
         if wait:
-            deadline = time.monotonic() + (max_wait if max_wait > 0
-                                           else 3600.0)
+            deadline = self.clock.monotonic() + (max_wait if max_wait > 0
+                                                 else 3600.0)
             with self._lock:
                 if key in self._forgotten:
                     # deleted mid-cycle: don't park a ghost holding its
@@ -494,15 +516,21 @@ class Scheduler:
 
     def _permit_timeout_loop(self) -> None:
         while not self._stop.wait(0.1):
-            now = time.monotonic()
-            expired = []
-            with self._lock:
-                for key, w in list(self._waiting.items()):
-                    if now >= w.deadline:
-                        expired.append(key)
-            for key in expired:
-                log.warning("pod %s timed out in Permit", key)
-                self.reject_waiting(key, "permit timeout")
+            self.check_permit_timeouts()
+
+    def check_permit_timeouts(self) -> None:
+        """One pass over the Permit parking lot, rejecting pods past
+        their deadline (the timer thread's body; the twin calls it
+        directly after advancing simulated time)."""
+        now = self.clock.monotonic()
+        expired = []
+        with self._lock:
+            for key, w in list(self._waiting.items()):
+                if now >= w.deadline:
+                    expired.append(key)
+        for key in expired:
+            log.warning("pod %s timed out in Permit", key)
+            self.reject_waiting(key, "permit timeout")
 
     # -- bind -------------------------------------------------------------
 

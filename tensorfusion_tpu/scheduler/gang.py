@@ -15,12 +15,12 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from .. import constants
 from ..api.types import Pod
+from ..clock import Clock, default_clock
 from .framework import Code, OK, Status
 
 log = logging.getLogger("tpf.scheduler.gang")
@@ -45,7 +45,7 @@ class GangGroup:
     scheduled: Set[str] = field(default_factory=set)     # bound
     rejected_until: float = 0.0                          # group backoff
     reject_count: int = 0                                # consecutive rejects
-    created_at: float = field(default_factory=time.time)
+    created_at: float = 0.0                              # stamped by observe()
 
 
 def gang_info_from_pod(pod: Pod) -> Optional[Tuple[str, int, int, float, bool]]:
@@ -65,18 +65,21 @@ def gang_info_from_pod(pod: Pod) -> Optional[Tuple[str, int, int, float, bool]]:
 
 
 class GangManager:
-    def __init__(self):
+    def __init__(self, clock: Optional[Clock] = None):
+        self.clock = clock or default_clock()
         self._lock = threading.RLock()
         self._groups: Dict[str, GangGroup] = {}
         self._pod_group: Dict[str, str] = {}
         # wired to the scheduler after construction
         self.allow_fn: Callable[[str], bool] = lambda key: False
         self.reject_fn: Callable[[str, str], bool] = lambda key, r: False
+        self.activate_fn: Callable[[], None] = lambda: None
         self.status_sink: Optional[Callable[[GangGroup], None]] = None
 
     def bind_scheduler(self, scheduler) -> None:
         self.allow_fn = scheduler.allow_waiting
         self.reject_fn = scheduler.reject_waiting
+        self.activate_fn = scheduler.activate
         # Keep gang waiting-sets honest when the scheduler rejects or times
         # out a parked pod for any reason.
         scheduler.permit_reject_listeners.append(self.on_permit_rejected)
@@ -88,12 +91,14 @@ class GangManager:
         if info is None:
             return None
         group_key, desired, required, timeout, strict = info
+        quorum_reached = False
         with self._lock:
             g = self._groups.get(group_key)
             if g is None:
                 g = GangGroup(key=group_key, desired=desired,
                               required=required, timeout_s=timeout,
-                              strict=strict)
+                              strict=strict,
+                              created_at=self.clock.now())
                 self._groups[group_key] = g
             else:
                 g.desired = max(g.desired, desired)
@@ -104,8 +109,21 @@ class GangManager:
                 # restart the backoff escalation from its base too
                 g.rejected_until = 0.0
                 g.reject_count = 0
+                # the member that COMPLETES the quorum must requeue its
+                # siblings: they were gated by pre_enqueue before quorum
+                # existed, and without this wake-up the whole gang
+                # live-locks until an unrelated event (historically the
+                # allocator sync's chip write-backs — a 2s side channel
+                # that vanishes on a quiet cluster; found by the twin's
+                # thundering-herd scenario, tests/test_sim.py::
+                # test_gang_quorum_completion_requeues_gated_members)
+                quorum_reached = (g.required > 0
+                                  and len(g.members) >= g.required)
             self._pod_group[pod.key()] = group_key
-            return g
+        if quorum_reached:
+            self.activate_fn()      # outside _lock: re-enters enqueue
+        with self._lock:
+            return self._groups.get(group_key, g)
 
     def group_of(self, pod_key: str) -> Optional[GangGroup]:
         with self._lock:
@@ -120,7 +138,7 @@ class GangManager:
         g = self.observe(pod)
         if g is None:
             return OK
-        now = time.time()
+        now = self.clock.now()
         if now < g.rejected_until:
             return Status(Code.UNSCHEDULABLE,
                           f"gang {g.key} backing off after reject")
@@ -162,15 +180,14 @@ class GangManager:
                 g.reject_count = 0      # gang formed; forget the backoff
             self._emit(g)
 
-    @staticmethod
-    def _backoff(g: GangGroup) -> None:
+    def _backoff(self, g: GangGroup) -> None:
         """Exponential group backoff (caller holds the lock): repeated
         rejects of the same gang wait longer each time instead of
         hammering the queue every fixed interval."""
         g.reject_count += 1
         delay = min(GANG_BACKOFF_BASE_S * (2 ** (g.reject_count - 1)),
                     GANG_BACKOFF_MAX_S)
-        g.rejected_until = time.time() + delay
+        g.rejected_until = self.clock.now() + delay
 
     def on_unschedulable(self, pod: Pod, reason: str) -> None:
         """Strict gangs: one member failing rejects the whole group

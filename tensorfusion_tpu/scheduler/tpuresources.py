@@ -23,7 +23,6 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import constants
@@ -35,6 +34,7 @@ from ..allocator.quota import QuotaExceededError
 from ..api.resources import (AllocRequest, GangConfig, ResourceAmount,
                              parse_quantity)
 from ..api.types import Pod, native_chip_request
+from ..clock import Clock, default_clock
 from .framework import (Code, CycleState, FilterPlugin, OK, PermitPlugin, STATE_PREFILTER_NODES,
                         PostBindPlugin, PostFilterPlugin, PreBindPlugin,
                         PreEnqueuePlugin, PreFilterPlugin, ReservePlugin,
@@ -145,9 +145,11 @@ class TPUResourcesFit(PreEnqueuePlugin, PreFilterPlugin, FilterPlugin,
                  ports: Optional[PortAllocator] = None,
                  indices: Optional[IndexAllocator] = None,
                  pods_on_node: Optional[Callable[[str], List[Pod]]] = None,
-                 evict: Optional[Callable[[Pod], None]] = None):
+                 evict: Optional[Callable[[Pod], None]] = None,
+                 clock: Optional[Clock] = None):
         self.allocator = allocator
         self.gang = gang
+        self.clock = clock or default_clock()
         self.ports = ports
         self.indices = indices
         self.pods_on_node = pods_on_node or (lambda node: [])
@@ -247,7 +249,7 @@ class TPUResourcesFit(PreEnqueuePlugin, PreFilterPlugin, FilterPlugin,
         placed first*."""
         if not self._nominations:
             return OK   # hot path: preemption is rare, Filter is not
-        now = time.monotonic()
+        now = self.clock.monotonic()
         with self._nominations_lock:
             for k in [k for k, v in self._nominations.items()
                       if v[3] <= now]:
@@ -302,7 +304,7 @@ class TPUResourcesFit(PreEnqueuePlugin, PreFilterPlugin, FilterPlugin,
         with self._nominations_lock:
             self._nominations[pod.key()] = (
                 best_node, pod.spec.priority, req,
-                time.monotonic() + NOMINATION_TTL_S)
+                self.clock.monotonic() + NOMINATION_TTL_S)
         return best_node
 
     def _victims_on_node(self, req: AllocRequest, pod: Pod,
@@ -402,7 +404,7 @@ class TPUResourcesFit(PreEnqueuePlugin, PreFilterPlugin, FilterPlugin,
             self.allocator.unassume(req.key())
             state.pop(STATE_ASSUMED, None)
         nom = state.pop(STATE_NOMINATION, None)
-        if nom is not None and nom[3] > time.monotonic():
+        if nom is not None and nom[3] > self.clock.monotonic():
             with self._nominations_lock:
                 self._nominations[pod.key()] = nom
 

@@ -22,7 +22,6 @@ import dataclasses
 import json
 import logging
 import threading
-import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
@@ -228,7 +227,7 @@ class OperatorServer:
             # value would pin this handler thread in a sleep loop the
             # socket idle-timeout can never interrupt
             wait_s = min(float(qs.get("wait_s", ["0"])[0]), 30.0)
-            deadline = time.time() + wait_s
+            deadline = op.clock.monotonic() + wait_s
             while True:
                 conn = op.store.try_get(TPUConnection, name, ns)
                 if conn is not None and conn.status.worker_url:
@@ -236,9 +235,9 @@ class OperatorServer:
                                   "worker_name": conn.status.worker_name,
                                   "worker_url": conn.status.worker_url})
                     return
-                if time.time() >= deadline:
+                if op.clock.monotonic() >= deadline:
                     break
-                time.sleep(0.05)
+                op.clock.sleep(0.05)
             if conn is None:
                 h._send(404, {"error": f"connection {ns}/{name} not found"})
             else:
@@ -287,7 +286,7 @@ class OperatorServer:
             if not pod.metadata.uid:
                 import uuid
                 pod.metadata.uid = uuid.uuid4().hex
-                pod.metadata.creation_timestamp = time.time()
+                pod.metadata.creation_timestamp = op.clock.now()
             created = op.submit_pod(pod)
             h._send(201, created.to_dict())
         elif url.path == "/api/simulate-schedule":

@@ -1,0 +1,345 @@
+"""SimHarness: the cluster digital twin's event loop.
+
+Runs the REAL control plane — the same :class:`~tensorfusion_tpu.
+operator.Operator` wiring production uses (store, cache, allocator,
+scheduler, gang manager, all controllers) — against simulated time:
+
+- no controller/scheduler/sync **threads**: the harness owns one
+  conflated watch per controller and *steps* them cooperatively
+  (``pump``), exactly the event-driven delivery the threaded runtime
+  provides, minus the nondeterministic interleaving;
+- periodic behavior (controller resyncs, the allocator sync pass,
+  metrics passes, leader-elector ticks) becomes :class:`SimClock`
+  timers;
+- every store event is appended to a deterministic **event log**
+  (``(sim_time, etype, kind, key, node)`` tuples) — two runs from the
+  same seed produce identical logs (``log_digest()``), which is the
+  contract the determinism tests assert;
+- **fault injection** (:mod:`tensorfusion_tpu.sim.faults`) schedules
+  seed-reproducible failures against the same timeline;
+- **invariant checks** (no lost pods, no over-allocation, no leaked
+  allocations, convergence) read the real store/allocator state.
+
+See docs/simulation.md for the who-steps-whom contract and how to add
+a scenario.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import random
+from typing import Dict, List, Optional, Tuple
+
+from .. import constants
+from ..api.types import Node, Pod, TPUChip, TPUWorkload
+from ..clock import set_default_clock
+from ..operator import Operator
+from ..store import ObjectStore
+from .clock import SimClock
+
+log = logging.getLogger("tpf.sim")
+
+#: pump gives up after this many event-cascade rounds without quiescing
+#: (a controller feeding itself events forever is itself a bug worth
+#: loud failure, not an infinite sim)
+PUMP_MAX_ROUNDS = 500
+
+
+class SimHarness:
+    def __init__(self, seed: int = 0, sync_interval_s: float = 2.0,
+                 metrics_interval_s: float = 0.0,
+                 operator_kwargs: Optional[dict] = None):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.clock = SimClock()
+        # module-level stampers (Resource.new, set_condition) must see
+        # sim time too; restored in stop()
+        self._restore_clock = set_default_clock(self.clock)
+        self.store = ObjectStore()
+        kwargs = dict(enable_expander=False)
+        kwargs.update(operator_kwargs or {})
+        self.op = Operator(store=self.store, clock=self.clock,
+                           sync_interval_s=sync_interval_s, **kwargs)
+        self.metrics_interval_s = metrics_interval_s
+        #: deterministic event log: (t, etype, kind, key, node)
+        self.events: List[Tuple] = []
+        #: controller names whose watch delivery is stalled (WatchStall)
+        self.paused: set = set()
+        #: operator<->store partition: nothing on the operator side runs
+        self.partitioned = False
+        self._watches: List[tuple] = []
+        self._timers: List = []
+        self._pumping = False
+        self._started = False
+        self._stopped = False
+        self.pump_exhausted = 0
+        self.clock.on_sleep = self._cooperative_step
+
+    # -- lifecycle --------------------------------------------------------
+
+    def __enter__(self) -> "SimHarness":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def start(self) -> None:
+        if self._started:
+            return
+        op = self.op
+        self.store.attach_listener(self._record_event)
+        op.cache.start()           # in-process: synchronous listener
+        op._recover_state()
+        for c in op.manager._controllers:
+            watch = self.store.watch(*c.kinds, conflate=True)
+            self._watches.append((c, watch))
+            try:
+                c.on_start()
+            except Exception:
+                log.exception("sim: controller %s on_start failed",
+                              c.name)
+            if c.resync_interval_s > 0:
+                self._arm_resync(c)
+        self._timers.append(
+            self.clock.call_later(op.sync_interval_s, self._sync_tick))
+        if self.metrics_interval_s > 0 and op.metrics is not None:
+            self._timers.append(self.clock.call_later(
+                self.metrics_interval_s, self._metrics_tick))
+        self._started = True
+        self.pump()
+
+    def stop(self) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+        for t in self._timers:
+            t.cancel()
+        for _, watch in self._watches:
+            watch.stop()
+        self.op.cache.stop()
+        self.store.detach_listener(self._record_event)
+        self.clock.on_sleep = None
+        set_default_clock(self._restore_clock)
+
+    # -- event log --------------------------------------------------------
+
+    def _record_event(self, ev) -> None:
+        node = getattr(ev.obj.spec, "node_name", "") \
+            if ev.obj.KIND == "Pod" else ""
+        self.events.append((round(self.clock.monotonic(), 9), ev.type,
+                            ev.obj.KIND, ev.obj.key(), node))
+
+    def log_note(self, *entry) -> None:
+        """Scenario/fault annotations join the same deterministic log."""
+        self.events.append((round(self.clock.monotonic(), 9), *entry))
+
+    def log_digest(self) -> str:
+        """Stable digest of the full event log — the determinism
+        fingerprint two same-seed runs must agree on."""
+        h = hashlib.sha256()
+        for entry in self.events:
+            h.update(repr(entry).encode())
+        return h.hexdigest()
+
+    # -- timers -----------------------------------------------------------
+
+    def at(self, t_sim: float, fn) -> None:
+        """Schedule a scenario action at absolute sim time ``t_sim``."""
+        self._timers.append(self.clock.call_at(t_sim, fn))
+
+    def every(self, interval_s: float, fn, jitter_s: float = 0.0) -> None:
+        """Recurring scenario action (seeded jitter keeps arrivals from
+        lockstepping while staying reproducible)."""
+        def fire():
+            if self._stopped:
+                return
+            fn()
+            delay = interval_s
+            if jitter_s:
+                delay += self.rng.uniform(0.0, jitter_s)
+            self._timers.append(self.clock.call_later(delay, fire))
+        self._timers.append(self.clock.call_later(interval_s, fire))
+
+    def _arm_resync(self, c) -> None:
+        def fire():
+            if self._stopped:
+                return
+            if not self.partitioned and c.name not in self.paused:
+                self._reconcile(c, None)
+            self._arm_resync(c)
+        self._timers.append(
+            self.clock.call_later(c.resync_interval_s, fire))
+
+    def _sync_tick(self) -> None:
+        if self._stopped:
+            return
+        if not self.partitioned:
+            try:
+                self.op.sync_once()
+            except Exception:
+                log.exception("sim: sync pass failed")
+        self._timers.append(
+            self.clock.call_later(self.op.sync_interval_s,
+                                  self._sync_tick))
+
+    def _metrics_tick(self) -> None:
+        if self._stopped:
+            return
+        if not self.partitioned and self.op.metrics is not None:
+            try:
+                self.op.metrics.record_once()
+            except Exception:
+                log.exception("sim: metrics pass failed")
+        self._timers.append(
+            self.clock.call_later(self.metrics_interval_s,
+                                  self._metrics_tick))
+
+    # -- stepping ---------------------------------------------------------
+
+    def _reconcile(self, c, ev) -> None:
+        try:
+            c.reconcile(ev)
+        except Exception:
+            log.exception("sim: controller %s reconcile failed", c.name)
+
+    def _cooperative_step(self) -> None:
+        """SimClock.on_sleep hook: when an actor poll-sleeps (e.g.
+        LiveMigrator waiting for a rebind), the rest of the control
+        plane runs during the sleep."""
+        self.pump()
+
+    def pump(self, max_rounds: int = PUMP_MAX_ROUNDS) -> int:
+        """Deliver pending watch events + run the scheduler until the
+        control plane quiesces.  Returns the number of rounds run."""
+        if self._pumping or not self._started or self._stopped:
+            return 0
+        self._pumping = True
+        try:
+            rounds = 0
+            while rounds < max_rounds:
+                rounds += 1
+                progress = False
+                if self.partitioned:
+                    break
+                self.op.scheduler.check_permit_timeouts()
+                for c, watch in self._watches:
+                    if c.name in self.paused:
+                        continue
+                    while True:
+                        ev = watch.get(timeout=0)
+                        if ev is None:
+                            break
+                        self._reconcile(c, ev)
+                        progress = True
+                if self.op.scheduler.run_until_idle():
+                    progress = True
+                if not progress:
+                    break
+            else:
+                self.pump_exhausted += 1
+                log.warning("sim: pump did not quiesce within %d rounds",
+                            max_rounds)
+            return rounds
+        finally:
+            self._pumping = False
+
+    def run_for(self, sim_seconds: float) -> None:
+        """Advance the simulation ``sim_seconds`` of virtual time,
+        firing timers and stepping the control plane at each event."""
+        end = self.clock.monotonic() + sim_seconds
+        self.pump()
+        while True:
+            due = self.clock.next_timer()
+            if due is None or due > end:
+                break
+            self.clock.advance_to(due)
+            self.pump()
+        self.clock.advance_to(end)
+        self.pump()
+
+    # -- invariants -------------------------------------------------------
+
+    def live_nodes(self) -> set:
+        return {n.name for n in self.store.list(Node)
+                if n.status.phase == constants.PHASE_RUNNING}
+
+    def check_no_lost_pods(self) -> List[str]:
+        """Every (non-dynamic) workload must have its desired replica
+        count of worker pods, each bound to a live node.  A pod bound
+        to a dead node, or a missing replica, is a lost pod."""
+        violations = []
+        live = self.live_nodes()
+        for wl in self.store.list(TPUWorkload):
+            if wl.spec.dynamic_replicas:
+                continue
+            desired = max(wl.spec.replicas, 0)
+            pods = self.store.list(
+                Pod, namespace=wl.metadata.namespace,
+                selector=lambda p: (
+                    p.metadata.annotations.get(constants.ANN_WORKLOAD)
+                    == wl.metadata.name
+                    and p.metadata.labels.get(constants.LABEL_COMPONENT)
+                    == constants.COMPONENT_WORKER))
+            bound = [p for p in pods if p.spec.node_name]
+            if len(pods) < desired:
+                violations.append(
+                    f"{wl.key()}: {len(pods)}/{desired} replicas exist")
+            for p in bound:
+                if p.spec.node_name not in live:
+                    violations.append(
+                        f"{p.key()}: bound to dead node "
+                        f"{p.spec.node_name}")
+        return violations
+
+    def check_no_double_bind(self) -> List[str]:
+        """No chip may be allocated beyond its virtual capacity, and no
+        pod key may hold more than one allocation record."""
+        violations = []
+        for state in self.op.allocator.chips():
+            avail = state.available()
+            if avail.tflops < -1e-6 or avail.hbm_bytes < -1e-6:
+                violations.append(
+                    f"chip {state.chip.name}: over-allocated "
+                    f"({avail.tflops:.1f} tflops, "
+                    f"{avail.hbm_bytes:.0f} HBM available)")
+        seen: Dict[str, int] = {}
+        for record in self.op.allocator.allocations():
+            seen[record.key] = seen.get(record.key, 0) + 1
+        for key, n in seen.items():
+            if n > 1:
+                violations.append(f"{key}: {n} allocation records")
+        return violations
+
+    def check_no_leaked_allocations(self) -> List[str]:
+        """Every committed allocation must belong to a live pod (a
+        record whose pod is gone leaks chip capacity forever)."""
+        violations = []
+        live_keys = {p.key() for p in self.store.list(Pod)}
+        for record in self.op.allocator.allocations():
+            if record.assumed:
+                continue           # in-flight: the TTL sweep owns these
+            if record.key not in live_keys:
+                violations.append(
+                    f"allocation {record.key} has no live pod")
+        return violations
+
+    def check_converged(self) -> List[str]:
+        """Steady state: every schedulable pod is bound, nothing is
+        stuck in the queue, every non-dynamic workload is at strength."""
+        violations = []
+        for p in self.store.list(Pod):
+            if p.spec.scheduler_name == constants.SCHEDULER_NAME \
+                    and not p.spec.node_name:
+                violations.append(f"pod {p.key()} still unbound")
+        violations.extend(self.check_no_lost_pods())
+        return violations
+
+    def check_all(self) -> Dict[str, List[str]]:
+        return {
+            "no_lost_pods": self.check_no_lost_pods(),
+            "no_double_bind": self.check_no_double_bind(),
+            "no_leaked_allocations": self.check_no_leaked_allocations(),
+            "converged": self.check_converged(),
+        }

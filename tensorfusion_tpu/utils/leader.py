@@ -20,8 +20,9 @@ import json
 import logging
 import os
 import threading
-import time
 from typing import Callable, Optional
+
+from ..clock import Clock, default_clock
 
 log = logging.getLogger("tpf.leader")
 
@@ -31,7 +32,9 @@ class LeaderElector:
                  endpoint: str = "",
                  on_started_leading: Optional[Callable[[], None]] = None,
                  on_stopped_leading: Optional[Callable[[], None]] = None,
-                 retry_interval_s: float = 1.0):
+                 retry_interval_s: float = 1.0,
+                 clock: Optional[Clock] = None):
+        self.clock = clock or default_clock()
         self.lock_path = lock_path
         self.identity = identity
         self.endpoint = endpoint
@@ -63,13 +66,13 @@ class LeaderElector:
         self._resign()
 
     def wait_for_leadership(self, timeout_s: float = 30.0) -> bool:
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
+        deadline = self.clock.monotonic() + timeout_s
+        while self.clock.monotonic() < deadline:
             if self.is_leader:
                 return True
             if self._stop.is_set():
                 return False
-            time.sleep(0.02)
+            self.clock.sleep(0.02)
         return self.is_leader
 
     # -- internals ------------------------------------------------------
@@ -103,7 +106,7 @@ class LeaderElector:
         with open(self.info_path, "w") as f:
             json.dump({"identity": self.identity, "pid": os.getpid(),
                        "endpoint": self.endpoint,
-                       "acquired_at": time.time()}, f)
+                       "acquired_at": self.clock.now()}, f)
         return True
 
     def _resign(self) -> None:
@@ -173,7 +176,9 @@ class StoreLeaderElector:
                  lease_duration_s: float = 10.0,
                  renew_interval_s: float = 2.0,
                  on_started_leading: Optional[Callable[[], None]] = None,
-                 on_stopped_leading: Optional[Callable[[], None]] = None):
+                 on_stopped_leading: Optional[Callable[[], None]] = None,
+                 clock: Optional[Clock] = None):
+        self.clock = clock or default_clock()
         self.store = store
         self.identity = identity
         self.endpoint = endpoint
@@ -203,11 +208,12 @@ class StoreLeaderElector:
             self._resign()
 
     def wait_for_leadership(self, timeout_s: float = 30.0) -> bool:
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline and not self._stop.is_set():
+        deadline = self.clock.monotonic() + timeout_s
+        while self.clock.monotonic() < deadline and \
+                not self._stop.is_set():
             if self.is_leader:
                 return True
-            time.sleep(0.02)
+            self.clock.sleep(0.02)
         return self.is_leader
 
     def leader_info(self) -> Optional[dict]:
@@ -231,23 +237,28 @@ class StoreLeaderElector:
 
     def _campaign(self) -> None:
         while not self._stop.is_set():
-            try:
-                if self.is_leader:
-                    if not self._renew():
-                        self._demote()
-                else:
-                    if self._try_acquire():
-                        self.is_leader = True
-                        log.info("%s acquired store lease (token %d)",
-                                 self.identity, self.fencing_token)
-                        try:
-                            self.on_started_leading()
-                        except Exception:
-                            log.exception("on_started_leading failed")
-            except Exception:  # noqa: BLE001 - keep campaigning through
-                log.exception("leader campaign tick failed")
+            self.campaign_tick()
+            self.clock.wait(self._stop, self.renew_interval_s)
 
-            self._stop.wait(self.renew_interval_s)
+    def campaign_tick(self) -> None:
+        """One renew-or-challenge pass.  The campaign thread runs it
+        every ``renew_interval_s``; the digital twin drives it directly
+        from a simulated-time timer (no thread)."""
+        try:
+            if self.is_leader:
+                if not self._renew():
+                    self._demote()
+            else:
+                if self._try_acquire():
+                    self.is_leader = True
+                    log.info("%s acquired store lease (token %d)",
+                             self.identity, self.fencing_token)
+                    try:
+                        self.on_started_leading()
+                    except Exception:
+                        log.exception("on_started_leading failed")
+        except Exception:  # noqa: BLE001 - keep campaigning through
+            log.exception("leader campaign tick failed")
 
     def _try_acquire(self) -> bool:
         from ..api.types import Lease
@@ -259,7 +270,7 @@ class StoreLeaderElector:
             log.debug("lease read failed; not campaigning this tick",
                       exc_info=True)
             return False
-        now = time.time()
+        now = self.clock.now()
         try:
             if lease is None:
                 lease = Lease.new(self.LEASE_NAME)
@@ -300,7 +311,7 @@ class StoreLeaderElector:
             if lease.spec.holder != self.identity:
                 return False      # usurped
             lease = lease.thaw()
-            lease.spec.renew_time = time.time()
+            lease.spec.renew_time = self.clock.now()
             self.store.update(lease, check_version=True)
             return True
         except (ConflictError, NotFoundError):

@@ -49,6 +49,10 @@ def generate_self_signed(cert_path: str, key_path: str,
             alt_names.append(x509.IPAddress(ipaddress.ip_address(h)))
         except ValueError:
             alt_names.append(x509.DNSName(h))
+    # certificate validity must embed REAL wall time — a peer's TLS
+    # stack checks it against its own clock, so simulated time would
+    # mint certs that are invalid outside the twin
+    # tpflint: disable=wall-clock-direct -- X.509 notBefore/notAfter
     now = datetime.datetime.now(datetime.timezone.utc)
     cert = (x509.CertificateBuilder()
             .subject_name(name).issuer_name(name)

@@ -37,6 +37,16 @@ import pytest
 REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
 NATIVE_BUILD = REPO_ROOT / "native" / "build"
 
+
+def pytest_configure(config):
+    # tier-1 runs `pytest -m 'not slow'`: anything marked slow is
+    # excluded from that budget.  `-m sim` selects the digital-twin
+    # suite alone (docs/simulation.md).
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 `-m 'not slow'` run")
+    config.addinivalue_line(
+        "markers", "sim: digital-twin suite (tests/test_sim.py)")
+
 sys.path.insert(0, str(REPO_ROOT))
 
 

@@ -23,6 +23,8 @@ from conftest import REPO_ROOT
 from tensorfusion_tpu import constants
 from tensorfusion_tpu.api.types import Container, Lease, Pod
 from tensorfusion_tpu.remote_store import RemoteStore
+from tensorfusion_tpu.clock import SkewedClock
+from tensorfusion_tpu.sim import SimClock
 from tensorfusion_tpu.store import ObjectStore
 from tensorfusion_tpu.utils.leader import StoreLeaderElector
 
@@ -39,56 +41,106 @@ def _wait(fn, timeout=60, interval=0.05, desc="condition"):
 
 def test_store_elector_single_winner_and_handoff():
     """Two electors on one store: exactly one leads; graceful stop hands
-    the lease to the other with a strictly increasing fencing token."""
+    the lease to the other with a strictly increasing fencing token.
+    Tick-driven on the injectable clock (round 11): the protocol is
+    judged in simulated time — no campaign threads, no real sleeps
+    (the full threaded/process topology keeps its own capstone below)."""
+    sim = SimClock()
     store = ObjectStore()
     events = []
     a = StoreLeaderElector(store, "a", endpoint="http://a",
                            lease_duration_s=2.0, renew_interval_s=0.1,
-                           on_started_leading=lambda: events.append("a+"))
+                           on_started_leading=lambda: events.append("a+"),
+                           clock=sim)
     b = StoreLeaderElector(store, "b", endpoint="http://b",
                            lease_duration_s=2.0, renew_interval_s=0.1,
-                           on_started_leading=lambda: events.append("b+"))
-    a.start()
-    _wait(lambda: a.is_leader, desc="a leads")
-    b.start()
-    time.sleep(0.5)
-    assert not b.is_leader          # healthy lease is not stealable
+                           on_started_leading=lambda: events.append("b+"),
+                           clock=sim)
+    a.campaign_tick()
+    assert a.is_leader
+    for _ in range(5):              # healthy lease is not stealable
+        sim.advance(0.1)
+        a.campaign_tick()
+        b.campaign_tick()
+    assert not b.is_leader
     token_a = a.fencing_token
     assert a.leader_info()["identity"] == "a"
     assert b.leader_info()["endpoint"] == "http://a"
 
     a.stop()                        # graceful resign zeroes renew_time
-    _wait(lambda: b.is_leader, timeout=10, desc="b takes over")
+    b.campaign_tick()
+    assert b.is_leader
     assert b.fencing_token > token_a
     lease = store.get(Lease, StoreLeaderElector.LEASE_NAME)
     assert lease.spec.holder == "b"
     assert lease.spec.transitions >= 1
+    assert events == ["a+", "b+"]
     b.stop()
 
 
 def test_store_elector_crash_takeover_after_ttl():
     """A holder that stops renewing (crash) is deposed only after the
-    lease duration; a usurped holder demotes itself."""
+    lease duration; a usurped holder demotes itself.  Sim-time: the
+    TTL wait is virtual (was ~1s of real sleeping)."""
+    sim = SimClock()
     store = ObjectStore()
     a = StoreLeaderElector(store, "a", lease_duration_s=0.6,
-                           renew_interval_s=0.1)
-    a.start()
-    _wait(lambda: a.is_leader, desc="a leads")
-    # simulate crash: kill a's campaign thread without resigning
-    a._stop.set()
-    a._thread.join(timeout=5)
+                           renew_interval_s=0.1, clock=sim)
+    a.campaign_tick()
+    assert a.is_leader              # then a "crashes": no more ticks
 
-    demoted = []
     b = StoreLeaderElector(store, "b", lease_duration_s=0.6,
-                           renew_interval_s=0.1,
-                           on_stopped_leading=lambda: demoted.append(1))
-    t0 = time.monotonic()
-    b.start()
-    _wait(lambda: b.is_leader, timeout=10, desc="b deposes a")
-    assert time.monotonic() - t0 >= 0.4   # waited out the TTL
+                           renew_interval_s=0.1, clock=sim)
+    b.campaign_tick()
+    assert not b.is_leader          # lease still within its TTL
+    sim.advance(0.5)
+    b.campaign_tick()
+    assert not b.is_leader          # 0.5 < 0.6: still healthy
+    sim.advance(0.2)
+    b.campaign_tick()
+    assert b.is_leader              # TTL lapsed in sim time
     # a's next renew attempt must fail (fencing: the lease moved on)
     assert a._renew() is False
-    b.stop()
+
+
+def test_lease_expiry_across_clock_skew_sim_time():
+    """Round-11 satellite: leader.py reads time only through Clock, so
+    lease staleness under CLOCK SKEW is testable deterministically.
+    A challenger whose wall clock runs ahead by more than the TTL sees
+    every healthy lease as expired and steals it prematurely — the
+    documented skew hazard — but fencing contains the damage: the
+    deposed holder's next version-checked renew conflicts and demotes
+    it, so no split brain survives a renew interval.  A challenger
+    skewed BEHIND never usurps a healthy holder."""
+    sim = SimClock()
+    store = ObjectStore()
+    a = StoreLeaderElector(store, "a", lease_duration_s=10.0,
+                           renew_interval_s=2.0, clock=sim)
+    a.campaign_tick()
+    assert a.is_leader
+
+    # behind-skew challenger: lease ages look NEGATIVE — never steals,
+    # even once the lease is genuinely stale by true sim time
+    behind = StoreLeaderElector(store, "slow",
+                                lease_duration_s=10.0,
+                                renew_interval_s=2.0,
+                                clock=SkewedClock(sim, skew_s=-30.0))
+    sim.advance(11.0)               # a silent past the TTL
+    behind.campaign_tick()
+    assert not behind.is_leader     # its skewed view: lease is fresh
+    a.campaign_tick()               # a recovers and renews
+    assert a.is_leader
+
+    # ahead-skew challenger: a HEALTHY lease looks 30s stale — steals
+    ahead = StoreLeaderElector(store, "fast", lease_duration_s=10.0,
+                               renew_interval_s=2.0,
+                               clock=SkewedClock(sim, skew_s=30.0))
+    token_before = a.fencing_token
+    ahead.campaign_tick()
+    assert ahead.is_leader          # premature takeover (skew hazard)
+    assert ahead.fencing_token > token_before   # but the token moved on
+    a.campaign_tick()               # a's renew hits the version check
+    assert not a.is_leader          # ...and demotes: no split brain
 
 
 def test_operator_demote_then_repromote_components_work():

@@ -1,0 +1,407 @@
+"""Digital-twin test suite (round 11).
+
+Covers the simulation subsystem's own contract — SimClock timers and
+skew semantics, harness determinism (same seed, identical event log),
+fault primitives firing AND healing, invariant checks tripping on a
+seeded known-bad mutation — plus deterministic regression tests for
+the two real control-plane bugs the twin found:
+
+- **dead-node stranding** (seed 7): pods bound to a node that left
+  ``Running`` were never evicted; NodeController now evicts them after
+  the grace period and their owners reschedule onto live capacity.
+- **gang quorum live-lock** (seed 3): the quorum-completing member of
+  a strict gang never requeued its PreEnqueue-gated siblings, so fresh
+  gangs only formed when the allocator-sync chip write-back side
+  channel happened to fire; GangManager.observe now activates the
+  scheduler when membership reaches quorum.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from tensorfusion_tpu import constants
+from tensorfusion_tpu.api.types import Node, Pod
+from tensorfusion_tpu.clock import (SkewedClock, WallClock, default_clock,
+                                    use_clock)
+from tensorfusion_tpu.sim import SimClock, SimHarness
+from tensorfusion_tpu.sim.faults import (ClockSkew, NodeCrash, NodeFlap,
+                                         Partition, StoreLatency,
+                                         WatchStall)
+from tensorfusion_tpu.sim.scenarios import SCENARIOS, run_scenario
+from tensorfusion_tpu.sim.trace import TraceGenerator
+
+pytestmark = pytest.mark.sim      # `pytest -m sim` = the twin's suite
+
+
+# -- SimClock ---------------------------------------------------------------
+
+def test_simclock_sleep_advances_virtual_time_only():
+    c = SimClock()
+    t0 = c.now()
+    c.sleep(30.0)
+    assert c.monotonic() == pytest.approx(30.0)
+    assert c.now() - t0 == pytest.approx(30.0)
+
+
+def test_simclock_timers_fire_in_time_then_seq_order():
+    c = SimClock()
+    fired = []
+    c.call_later(2.0, lambda: fired.append("b"))
+    c.call_later(1.0, lambda: fired.append("a"))
+    c.call_later(2.0, lambda: fired.append("c"))   # same due as "b"
+    h = c.call_later(1.5, lambda: fired.append("x"))
+    h.cancel()
+    c.advance(3.0)
+    assert fired == ["a", "b", "c"]
+    assert c.next_timer() is None
+
+
+def test_simclock_timer_cascade_fires_within_one_advance():
+    c = SimClock()
+    fired = []
+
+    def first():
+        fired.append(("first", c.monotonic()))
+        c.call_later(1.0, lambda: fired.append(("second",
+                                                c.monotonic())))
+    c.call_later(1.0, first)
+    c.advance(5.0)
+    assert fired == [("first", 1.0), ("second", 2.0)]
+    assert c.monotonic() == 5.0
+
+
+def test_simclock_wait_honors_event_and_rejects_unbounded():
+    c = SimClock()
+    ev = threading.Event()
+    assert c.wait(ev, timeout=1.0) is False
+    assert c.monotonic() == pytest.approx(1.0)
+    ev.set()
+    assert c.wait(ev, timeout=1.0) is True
+    assert c.monotonic() == pytest.approx(1.0)   # no advance when set
+    with pytest.raises(RuntimeError):
+        c.wait(threading.Event())
+
+
+def test_simclock_monotonic_never_regresses_under_skew():
+    """Clock-skew contract: now() may jump either way, monotonic() may
+    not move backward — deadlines survive an NTC step."""
+    c = SimClock()
+    samples = []
+    for skew in (0.0, 120.0, -300.0, 45.0, 0.0):
+        c.set_skew(skew)
+        c.advance(1.0)
+        samples.append(c.monotonic())
+    assert samples == sorted(samples)
+    c.set_skew(-1e6)
+    assert c.monotonic() == samples[-1]          # unaffected by skew
+    assert c.now() < 0 + 1_700_000_000.0         # wall DID jump
+
+
+def test_skewed_clock_shifts_wall_not_monotonic():
+    base = SimClock()
+    skewed = SkewedClock(base, skew_s=90.0)
+    assert skewed.now() - base.now() == pytest.approx(90.0)
+    assert skewed.monotonic() == base.monotonic()
+
+
+def test_default_clock_swap_is_scoped():
+    wall = default_clock()
+    sim = SimClock()
+    with use_clock(sim):
+        assert default_clock() is sim
+    assert default_clock() is wall
+    assert isinstance(wall, WallClock) or wall is not sim
+
+
+# -- determinism ------------------------------------------------------------
+
+def _small_run(seed):
+    with SimHarness(seed=seed) as h:
+        tg = TraceGenerator(h)
+        tg.build_cluster(4, 4)
+        tg.seeded_churn(duration_s=10.0, workloads=6, max_replicas=3)
+        NodeCrash(at=6.0, duration_s=5.0,
+                  node=tg.node_names[0]).schedule(h)
+        h.run_for(30.0)
+        return h.log_digest(), len(h.events)
+
+
+def test_same_seed_identical_event_log_twice():
+    d1, n1 = _small_run(seed=1234)
+    d2, n2 = _small_run(seed=1234)
+    assert (d1, n1) == (d2, n2)
+    d3, _ = _small_run(seed=1235)
+    assert d3 != d1
+
+
+# -- fault primitives fire and heal ----------------------------------------
+
+@pytest.fixture()
+def loaded_harness():
+    with SimHarness(seed=11) as h:
+        tg = TraceGenerator(h)
+        tg.build_cluster(4, 4)
+        for i in range(3):
+            tg.submit_workload(tg.make_workload(f"wl-{i}", 2))
+        h.run_for(3.0)
+        yield h, tg
+
+
+def test_node_crash_fires_and_heals(loaded_harness):
+    h, tg = loaded_harness
+    node = tg.node_names[0]
+    NodeCrash(at=5.0, duration_s=10.0, node=node).schedule(h)
+    h.run_for(4.0)          # t=7: crashed
+    assert h.store.get(Node, node).status.phase == \
+        constants.PHASE_FAILED
+    assert node not in h.live_nodes()
+    h.run_for(12.0)         # t=19: healed
+    assert h.store.get(Node, node).status.phase == \
+        constants.PHASE_RUNNING
+    notes = [e for e in h.events if e[1] == "fault"]
+    assert [n[3] for n in notes] == ["inject", "heal"]
+
+
+def test_node_flap_schedules_repeated_cycles(loaded_harness):
+    h, tg = loaded_harness
+    NodeFlap(at=4.0, period_s=4.0, count=3,
+             node=tg.node_names[1]).schedule(h)
+    h.run_for(20.0)
+    notes = [e[3] for e in h.events
+             if e[1] == "fault" and "node-crash" in e[2]]
+    assert notes.count("inject") == 3 and notes.count("heal") == 3
+
+
+def test_watch_stall_pauses_then_drains(loaded_harness):
+    h, tg = loaded_harness
+    WatchStall(at=4.0, duration_s=8.0,
+               controllers=["workload"]).schedule(h)
+    h.run_for(2.0)
+    tg.submit_workload(tg.make_workload("late-wl", 2))
+    h.run_for(4.0)          # t=9: stalled — no workers expanded
+    assert "workload" in h.paused
+    pods = h.store.list(
+        Pod, selector=lambda p: p.metadata.annotations.get(
+            constants.ANN_WORKLOAD) == "late-wl")
+    assert pods == []
+    h.run_for(8.0)          # t=17: healed — backlog drained
+    assert "workload" not in h.paused
+    pods = h.store.list(
+        Pod, selector=lambda p: p.metadata.annotations.get(
+            constants.ANN_WORKLOAD) == "late-wl")
+    assert len(pods) == 2 and all(p.spec.node_name for p in pods)
+
+
+def test_partition_freezes_operator_and_heals(loaded_harness):
+    h, tg = loaded_harness
+    Partition(at=4.0, duration_s=10.0).schedule(h)
+    h.run_for(2.0)
+    tg.submit_workload(tg.make_workload("during-part", 2))
+    h.run_for(4.0)          # t=10: partitioned — nothing reconciles
+    assert h.partitioned
+    assert h.store.list(
+        Pod, selector=lambda p: p.metadata.annotations.get(
+            constants.ANN_WORKLOAD) == "during-part") == []
+    h.run_for(20.0)         # healed: reconverges from the backlog
+    assert not h.partitioned
+    assert h.check_converged() == []
+
+
+def test_store_latency_slows_writes_in_sim_time(loaded_harness):
+    h, tg = loaded_harness
+    StoreLatency(at=4.0, duration_s=5.0, latency_s=0.5).schedule(h)
+    h.run_for(2.0)          # t=5: latency active
+    t0 = h.clock.monotonic()
+    tg.submit_workload(tg.make_workload("slow-wl", 1))
+    assert h.clock.monotonic() - t0 >= 0.5
+    h.run_for(10.0)         # healed
+    t0 = h.clock.monotonic()
+    tg.submit_workload(tg.make_workload("fast-wl", 1))
+    assert h.clock.monotonic() == t0
+
+
+def test_clock_skew_fault_steps_wall_and_heals(loaded_harness):
+    h, _ = loaded_harness                    # fixture ends at t=3
+    ClockSkew(at=6.0, duration_s=6.0, delta_s=3600.0).schedule(h)
+    h.run_for(2.0)          # t=5: not yet skewed
+    wall_before = h.clock.now()
+    h.run_for(2.0)          # t=7: skewed (+3600 on 2s of sim time)
+    assert h.clock.now() - wall_before > 3600.0
+    h.run_for(6.0)          # t=13: healed
+    assert h.clock.skew_s == 0.0
+
+
+# -- invariants trip on a seeded known-bad mutation ------------------------
+
+def test_invariants_trip_on_seeded_bad_bind():
+    """Sabotage the real bind path (a deliberately broken operator
+    build: every bind lands on a dead node) and assert the scenario
+    invariants actually catch it — the twin must be able to FAIL."""
+    with SimHarness(seed=21) as h:
+        tg = TraceGenerator(h)
+        tg.build_cluster(3, 4)
+        dead = "dead-node-x"
+        original = h.op._bind_pod
+
+        def bad_bind(pod, node):
+            original(pod, dead)      # bind... to a node that isn't live
+        h.op._bind_pod = bad_bind
+        h.op.scheduler.bind_fn = bad_bind
+        tg.submit_workload(tg.make_workload("bad-wl", 2))
+        h.run_for(5.0)
+        lost = h.check_no_lost_pods()
+        assert any("dead node" in v or "bound to dead" in v
+                   for v in lost), lost
+
+
+def test_invariants_trip_on_leaked_allocation():
+    with SimHarness(seed=22) as h:
+        tg = TraceGenerator(h)
+        tg.build_cluster(2, 2)
+        tg.submit_workload(tg.make_workload("leak-wl", 1))
+        h.run_for(3.0)
+        # sever the dealloc path, then delete the workload: the
+        # allocation record outlives its pod
+        h.op.allocator.dealloc = lambda key: None
+        tg.delete_workload("leak-wl")
+        h.run_for(5.0)
+        assert h.check_no_leaked_allocations() != []
+
+
+# -- regression: the real bugs the twin found ------------------------------
+
+def test_dead_node_pods_are_evicted_and_rescheduled():
+    """Round-11 bug #1 (discovering seed 7): a node leaving Running
+    stranded every pod bound to it forever — no control-plane path
+    evicted them, so connections kept routing to dead workers.
+    NodeController._evict_dead_nodes now clears them after the grace
+    period and the workload controller + scheduler re-place them on
+    live nodes."""
+    with SimHarness(seed=7) as h:
+        tg = TraceGenerator(h)
+        tg.build_cluster(6, 4)
+        for i in range(4):
+            tg.submit_workload(tg.make_workload(f"wl-{i}", 3))
+        h.run_for(5.0)
+        bound_nodes = {p.spec.node_name for p in h.store.list(Pod)}
+        victim = sorted(bound_nodes)[0]
+        NodeCrash(at=8.0, duration_s=None, node=victim).schedule(h)
+        h.run_for(60.0)
+        stranded = [p.key() for p in h.store.list(Pod)
+                    if p.spec.node_name == victim]
+        assert stranded == []
+        assert h.check_no_lost_pods() == []
+        assert h.check_converged() == []
+        node_ctrl = next(c for c in h.op.manager._controllers
+                         if c.name == "node")
+        assert node_ctrl.evicted_from_dead   # the new path did the work
+
+
+def test_deleted_workload_workers_are_garbage_collected():
+    """Round-11 bug #3 (discovering seed 22): worker pods have carried
+    ``owner_references = ["TPUWorkload/ns/name"]`` since round 1, but
+    nothing consumed them — deleting a TPUWorkload orphaned its
+    workers forever: still bound, still holding chip capacity, still
+    routable.  WorkloadController._collect_orphans now GCs them and
+    the PodController delete path frees their allocations."""
+    with SimHarness(seed=22) as h:
+        tg = TraceGenerator(h)
+        tg.build_cluster(2, 2)
+        tg.submit_workload(tg.make_workload("gc-wl", 2))
+        h.run_for(3.0)
+        assert len(h.store.list(Pod)) == 2
+        assert len(list(h.op.allocator.allocations())) == 2
+        tg.delete_workload("gc-wl")
+        h.run_for(10.0)
+        assert h.store.list(Pod) == []
+        assert list(h.op.allocator.allocations()) == []
+        assert h.check_no_leaked_allocations() == []
+
+
+def test_expander_same_second_expansions_do_not_wedge():
+    """Round-11 bug #4 (found chasing the churn-soak flake, which the
+    twin's determinism discipline made diagnosable): the expansion
+    claim name had 1-second granularity, so two capacity misses in the
+    same wall second collided on AlreadyExistsError — and the collision
+    path left the freshly-written in-flight dedup stamp behind with NO
+    claim to clear it, refusing every further expansion for that shape
+    for the full 120 s TTL while the cluster stayed full.  Sim time
+    makes the collision deterministic: now() is bit-identical across
+    the two calls."""
+    from tensorfusion_tpu.api.types import Container, TPUNodeClaim
+    from tensorfusion_tpu.scheduler.expander import NodeExpander
+    from tensorfusion_tpu.store import ObjectStore
+
+    def miss_pod(name):
+        pod = Pod.new(name, namespace="default")
+        ann = pod.metadata.annotations
+        ann[constants.ANN_POOL] = "pool-a"
+        ann[constants.ANN_TFLOPS_REQUEST] = "10"
+        ann[constants.ANN_HBM_REQUEST] = str(2**28)
+        ann[constants.ANN_IS_LOCAL_TPU] = "true"
+        pod.spec.containers = [Container(name="main")]
+        return pod
+
+    sim = SimClock()
+    store = ObjectStore()
+    ex = NodeExpander(store, clock=sim)
+    reason = "no eligible chips on any node (insufficient HBM)"
+
+    first = ex.handle_failure(miss_pod("p1"), reason)
+    assert first is not None
+    # the claim provisions fast (mock provider): inflight cleared in
+    # the same second
+    ex.clear_inflight("pool-a", "v5e")
+    second = ex.handle_failure(miss_pod("p2"), reason)
+    assert second is not None and second != first    # no name collision
+    assert store.try_get(TPUNodeClaim, second) is not None
+
+    # and the AlreadyExistsError path must roll back its stamp: even a
+    # forced collision no longer wedges the shape until the TTL
+    ex.clear_inflight("pool-a", "v5e")
+    clash = TPUNodeClaim.new(f"expand-pool-a-v5e-{int(sim.now())%100000}"
+                             f"-{ex._seq + 1}")
+    store.create(clash)
+    assert ex.handle_failure(miss_pod("p3"), reason) is None  # collided
+    third = ex.handle_failure(miss_pod("p4"), reason)
+    assert third is not None                 # NOT refused-until-TTL
+
+
+def test_gang_quorum_completion_requeues_gated_members():
+    """Round-11 bug #2 (discovering seed 3): the quorum-completing
+    member of a fresh strict gang parked in Permit while its siblings
+    stayed gated in PreEnqueue — nothing ever requeued them, so the
+    gang only formed if an unrelated event (the 2s allocator-sync chip
+    write-back) happened to call scheduler.activate().  With the sync
+    loop pushed to 1h the live-lock was total.  GangManager.observe
+    now activates the scheduler when membership reaches quorum."""
+    with SimHarness(seed=3, sync_interval_s=3600.0) as h:
+        tg = TraceGenerator(h)
+        tg.build_cluster(4, 4)
+        h.run_for(1.0)
+        tg.submit_workload(tg.make_workload("gang-wl", 4, gang=True,
+                                            strict=True))
+        h.run_for(10.0)     # event-driven only: no sync side channel
+        pods = h.store.list(Pod)
+        assert len(pods) == 4
+        assert all(p.spec.node_name for p in pods), \
+            [(p.key(), p.spec.node_name) for p in pods]
+        assert h.op.scheduler.scheduled_count == 4
+
+
+# -- scenario suite (tier-1 smoke at small scale) --------------------------
+
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_scenario_passes_at_small_scale(name):
+    r = run_scenario(name, seed=42, scale="small")
+    assert r["ok"], r["invariants"]
+    assert r["pump_exhausted"] == 0
+
+
+def test_scenario_registry_has_the_named_five():
+    assert {"rolling-node-failure", "thundering-herd-rescale",
+            "partition-heal-reconvergence", "slow-watcher-storm",
+            "leader-flap"} <= set(SCENARIOS)

@@ -17,7 +17,7 @@ import pytest
 from tools.tpflint.checkers import (ALL_CHECKS, blocking_under_lock,
                                     frozen_view_mutation, guarded_fields,
                                     metrics_schema, protocol_exhaustive,
-                                    stale_write_back)
+                                    stale_write_back, wall_clock)
 from tools.tpflint.core import (Finding, SourceFile, apply_baseline,
                                 load_baseline, run_paths, save_baseline)
 
@@ -590,3 +590,92 @@ def test_lexical_checkers_still_registered():
     assert {"stale-write-back", "frozen-view-mutation",
             "blocking-under-lock", "guarded-field",
             "protocol-exhaustive", "metrics-schema"} <= set(ALL_CHECKS)
+
+
+# -- wall-clock-direct (round 11: the digital twin's clock discipline) -----
+
+WC_BAD_TIME_TIME = """
+    class C:
+        def reconcile(self):
+            now = time.time()
+            return now
+"""
+
+WC_BAD_SLEEP = """
+    def poll():
+        time.sleep(0.5)
+"""
+
+WC_BAD_DATETIME = """
+    def stamp():
+        return datetime.now()
+"""
+
+WC_BAD_MODULE_LEVEL = """
+    import time
+    BOOTED_AT = time.time()
+"""
+
+WC_GOOD_CLOCKED = """
+    class C:
+        def reconcile(self):
+            now = self.clock.now()
+            self.clock.sleep(0.1)
+            return now
+"""
+
+WC_GOOD_MONOTONIC = """
+    def interval():
+        return time.monotonic() + time.perf_counter()
+"""
+
+
+@pytest.mark.parametrize("code,key", [
+    (WC_BAD_TIME_TIME, "time.time"),
+    (WC_BAD_SLEEP, "time.sleep"),
+    (WC_BAD_DATETIME, "datetime.now"),
+    (WC_BAD_MODULE_LEVEL, "time.time"),
+])
+def test_wall_clock_flags(code, key):
+    findings = wall_clock.run_file(
+        sf(code, relpath="tensorfusion_tpu/mod.py"))
+    assert checks_of(findings) == ["wall-clock-direct"]
+    assert key in findings[0].key
+
+
+@pytest.mark.parametrize("code", [WC_GOOD_CLOCKED, WC_GOOD_MONOTONIC])
+def test_wall_clock_passes_clock_routed(code):
+    assert wall_clock.run_file(
+        sf(code, relpath="tensorfusion_tpu/mod.py")) == []
+
+
+def test_wall_clock_scope_and_exemptions():
+    # outside tensorfusion_tpu/ (tests, benchmarks, tools) is exempt...
+    assert wall_clock.run_file(sf(WC_BAD_TIME_TIME,
+                                  relpath="tests/test_x.py")) == []
+    assert wall_clock.run_file(sf(WC_BAD_TIME_TIME,
+                                  relpath="benchmarks/b.py")) == []
+    # ...and so is the Clock seam itself
+    assert wall_clock.run_file(sf(
+        WC_BAD_TIME_TIME, relpath="tensorfusion_tpu/clock.py")) == []
+
+
+def test_wall_clock_disable_comment_honored():
+    code = """
+    def stamp():
+        # tpflint: disable=wall-clock-direct -- X.509 validity
+        return datetime.now()
+    """
+    f = sf(code, relpath="tensorfusion_tpu/mod.py")
+    findings = [x for x in wall_clock.run_file(f)
+                if not f.is_suppressed(x)]
+    assert findings == []
+
+
+def test_wall_clock_baseline_empty_at_head():
+    """The refactor is DONE: every direct wall-clock site in
+    tensorfusion_tpu/ is either routed through Clock or carries a
+    justified inline disable — the checker's baseline debt is zero."""
+    findings = run_paths(["tensorfusion_tpu"], REPO,
+                         checks={"wall-clock-direct"})
+    assert findings == [], [f.render() for f in findings]

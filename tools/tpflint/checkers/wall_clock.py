@@ -1,0 +1,97 @@
+"""wall-clock-direct: direct wall-time reads/sleeps in tensorfusion_tpu/.
+
+The control plane runs inside the cluster digital twin
+(``tensorfusion_tpu/sim``) under simulated time.  Any component that
+calls ``time.time()`` / ``time.sleep()`` / ``datetime.now()`` directly
+is welded to the wall clock: it silently desyncs from the twin (lease
+math, TTL sweeps, backoffs all misbehave under virtual time) and its
+tests can only pass by really sleeping.  All time flows through the
+:class:`tensorfusion_tpu.clock.Clock` seam instead — ``clock.now()``,
+``clock.monotonic()``, ``clock.sleep()``, ``clock.wait(event, t)``.
+
+Flagged (inside ``tensorfusion_tpu/`` only):
+
+- ``time.time()`` / ``time.time_ns()``
+- ``time.sleep(...)``
+- ``datetime.now()`` / ``datetime.utcnow()`` (module- or class-dotted)
+
+Exempt: ``tensorfusion_tpu/clock.py`` (the seam itself — the ONLY
+legal wall-time reader) and ``tensorfusion_tpu/testing.py`` (test
+scaffolding).  ``time.monotonic``/``perf_counter`` are not flagged:
+interval math against a local timebase is harmless until it feeds a
+cross-component deadline, and the Clock refactor routes those through
+``clock.monotonic()`` where it matters.  Genuinely wall-bound code
+(e.g. X.509 validity in tlsutil) carries a justified
+``# tpflint: disable=wall-clock-direct``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import Finding, SourceFile, dotted_tail, iter_functions
+
+CHECK = "wall-clock-direct"
+
+#: files allowed to touch wall time directly
+EXEMPT = {
+    "tensorfusion_tpu/clock.py",      # the Clock seam itself
+    "tensorfusion_tpu/testing.py",    # test scaffolding
+}
+
+_TIME_ATTRS = {"time": "clock.now()", "time_ns": "clock.now_ns()",
+               "sleep": "clock.sleep()"}
+_DATETIME_ATTRS = {"now": "clock.now()", "utcnow": "clock.now()"}
+
+
+def _flag(call: ast.Call) -> str:
+    """Replacement hint when ``call`` is a direct wall-clock call,
+    else ''."""
+    func = call.func
+    if not isinstance(func, ast.Attribute):
+        return ""
+    base = dotted_tail(func.value)
+    if base == "time" and func.attr in _TIME_ATTRS:
+        return _TIME_ATTRS[func.attr]
+    if base == "datetime" and func.attr in _DATETIME_ATTRS:
+        # matches both datetime.now() (from datetime import datetime)
+        # and datetime.datetime.now() (dotted module access)
+        return _DATETIME_ATTRS[func.attr]
+    return ""
+
+
+def run_file(sf: SourceFile) -> List[Finding]:
+    if not sf.relpath.startswith("tensorfusion_tpu/") \
+            or sf.relpath in EXEMPT:
+        return []
+    findings: List[Finding] = []
+    covered = set()
+    for symbol, fn in iter_functions(sf.tree):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                hint = _flag(node)
+                if hint and id(node) not in covered:
+                    covered.add(id(node))
+                    findings.append(_finding(sf, symbol, node, hint))
+    # module level (field defaults, constants)
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call) and id(node) not in covered:
+            hint = _flag(node)
+            if hint:
+                covered.add(id(node))
+                findings.append(_finding(sf, "<module>", node, hint))
+    findings.sort(key=lambda f: f.line)
+    return findings
+
+
+def _finding(sf: SourceFile, symbol: str, call: ast.Call,
+             hint: str) -> Finding:
+    name = ast.unparse(call.func)
+    return Finding(
+        check=CHECK, path=sf.relpath, line=call.lineno, symbol=symbol,
+        key=name,
+        message=(f"direct wall-clock call {name}() — route through the "
+                 f"injectable Clock ({hint}) so the digital twin can "
+                 f"virtualize time (docs/simulation.md); wall-bound "
+                 f"code needs a justified disable"))

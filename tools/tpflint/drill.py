@@ -79,6 +79,19 @@ DRILLS = [
         ["swallows the failure"],
     ),
     (
+        "wall-clock-direct",
+        "wall-clock-direct",
+        "tensorfusion_tpu/controllers/core.py",
+        "    def reconcile(self, event):",
+        (
+            "    def _drill_wall_clock(self):\n"
+            "        import time\n"
+            "        return time.time()\n"
+            "\n"
+        ),
+        ["time.time", "injectable Clock"],
+    ),
+    (
         "unjoined-thread",
         "unjoined-thread",
         "tensorfusion_tpu/controllers/core.py",
