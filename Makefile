@@ -8,7 +8,8 @@
 PY := env -u PALLAS_AXON_POOL_IPS python
 
 .PHONY: all native test test-native verify-all verify-repeat \
-	verify-stress verify-sim verify-native-sanitized check-coverage lint \
+	verify-stress verify-sim verify-trace verify-native-sanitized \
+	check-coverage lint \
 	lint-drill asan \
 	tsan bench bench-tpu test-tpu-live sched-bench webhook-bench remoting-bench \
 	multitenant-bench multitenant-bench-tpu serving-bench-tpu \
@@ -75,7 +76,7 @@ verify-repeat: native
 # small N, cache/store coherence after multi-threaded churn — the PR-4
 # control-plane hot path).  Cheaper than verify-repeat (minutes, not an
 # hour), meant to run on every change to locking/queueing code.
-verify-stress: verify-sim
+verify-stress: verify-sim verify-trace
 	@for i in 1 2 3 4 5; do \
 		echo "=== verify-stress round $$i/5 ==="; \
 		env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -101,6 +102,23 @@ verify-stress: verify-sim
 verify-sim:
 	$(PY) benchmarks/sim_scenarios.py --scale small --seed 42
 	@echo "verify-sim: OK"
+
+# Tracing gate (docs/tracing.md): the tpftrace test suite (span
+# propagation, v4<->v5 interop, SimClock determinism, exemplar->TSDB
+# linkage, burn-rate alerts), then one sim scenario exported as a
+# virtual-time trace — run TWICE internally with log+trace digest
+# compare, like verify-sim — and the artifact validated against the
+# span registry by the CLI.  Run on any change to tracing/, remoting
+# meta fields, or the span-emitting control-plane paths.
+verify-trace:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		python -m pytest tests/test_tracing.py -q \
+		-p no:cacheprovider -p no:xdist -p no:randomly
+	$(PY) benchmarks/sim_scenarios.py --scale small --seed 11 \
+		--scenario rolling-node-failure \
+		--export-trace /tmp/tpftrace_verify.json
+	$(PY) -m tools.tpftrace check /tmp/tpftrace_verify.json
+	@echo "verify-trace: OK"
 
 test-native:
 	$(MAKE) -C native test

@@ -24,6 +24,7 @@ import sys
 sys.path.insert(0, ".")  # repo root (benchmarks/ is not a package)
 
 from benchmarks._artifact import previous_artifact, write_artifact  # noqa: E402
+from tensorfusion_tpu.sim import scenarios as _scenarios  # noqa: E402
 from tensorfusion_tpu.sim.scenarios import SCENARIOS, run_scenario  # noqa: E402
 
 
@@ -34,9 +35,14 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=42)
     ap.add_argument("--scenario", action="append", default=None,
                     choices=sorted(SCENARIOS),
-                    help="run only the named scenario(s)")
+                    help="run only the named scenario(s); the sim.json "
+                         "artifact is NOT rewritten for a subset run")
     ap.add_argument("--no-determinism-check", action="store_true",
                     help="skip the second (digest-compare) run")
+    ap.add_argument("--export-trace", default="",
+                    help="write the LAST scenario's virtual-time trace "
+                         "as Chrome/Perfetto JSON here "
+                         "(tools/tpftrace.py reads it)")
     args = ap.parse_args(argv)
 
     names = args.scenario or sorted(SCENARIOS)
@@ -46,7 +52,12 @@ def main(argv=None) -> int:
         r = run_scenario(name, seed=args.seed, scale=args.scale)
         if not args.no_determinism_check:
             r2 = run_scenario(name, seed=args.seed, scale=args.scale)
-            r["deterministic"] = r2["log_digest"] == r["log_digest"]
+            # BOTH fingerprints must agree: the store-event log and the
+            # exported virtual-time trace (a nondeterministic span
+            # breaks trace diffing across runs just as badly)
+            r["deterministic"] = (
+                r2["log_digest"] == r["log_digest"]
+                and r2["trace_digest"] == r["trace_digest"])
             if not r["deterministic"]:
                 r["ok"] = False
         speedup = (r["sim_seconds"] / r["wall_seconds"]
@@ -57,8 +68,17 @@ def main(argv=None) -> int:
         bad = {k: v for k, v in r["invariants"].items() if v}
         print(f"{name:32s} {'ok' if r['ok'] else 'FAIL':4s} "
               f"sim={r['sim_seconds']:.0f}s wall={r['wall_seconds']}s "
-              f"({r['sim_speedup_x']}x) events={r['store_events']}"
+              f"({r['sim_speedup_x']}x) events={r['store_events']} "
+              f"spans={r['trace_spans']}"
               + (f"  {json.dumps(bad)[:200]}" if bad else ""))
+
+    if args.export_trace:
+        from tensorfusion_tpu.tracing import write_trace
+
+        path = write_trace(args.export_trace,
+                           _scenarios.LAST_TRACE.get("spans", []),
+                           meta=_scenarios.LAST_TRACE.get("meta"))
+        print(f"trace -> {path}")
 
     result = {
         "benchmark": "sim_scenarios",
@@ -68,6 +88,11 @@ def main(argv=None) -> int:
         "scenarios": cells,
         "previous": previous_artifact("sim"),
     }
+    if args.scenario:
+        # subset run (verify-trace, one-off repros): keep the full-run
+        # artifact intact
+        print(f"{'OK' if ok else 'FAIL'} (subset run; sim.json kept)")
+        return 0 if ok else 1
     path = write_artifact("sim", result)
     print(f"{'OK' if ok else 'FAIL'} -> {path}")
     return 0 if ok else 1

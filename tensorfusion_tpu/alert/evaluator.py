@@ -4,6 +4,17 @@ Analog of the reference's ``internal/alert/`` AlertEvaluator (rules from a
 ConfigMap evaluated against GreptimeDB, firing to Alertmanager,
 ``cmd/main.go:151-161``): declarative threshold rules over TSDB
 aggregations with firing/resolved state tracking and webhook delivery.
+
+Two rule shapes:
+
+- :class:`AlertRule` — the classic threshold over one aggregated field.
+- :class:`BurnRateRule` — multi-window SLO burn-rate alerting (the SRE
+  workbook pattern) over good/total counter pairs such as the
+  dispatcher's per-tenant queue-wait rollup (``tpf_trace_slo``): the
+  error-budget burn rate must exceed its threshold in EVERY window
+  (short window = responsive, long window = flap-proof) to fire.
+  Firing alerts link trace-id **exemplars** from the TSDB so "which
+  requests burned the budget" has an answer (docs/tracing.md).
 """
 
 from __future__ import annotations
@@ -13,7 +24,7 @@ import logging
 import threading
 import urllib.request
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..clock import Clock, default_clock
 from ..metrics.tsdb import TSDB, aggregate_values
@@ -42,6 +53,34 @@ class AlertRule:
 
 
 @dataclass
+class BurnRateRule:
+    """Multi-window error-budget burn-rate rule over a good/total
+    counter pair.  ``objective`` is the SLO target fraction (0.99 =
+    99% of requests within SLO); burn rate 1.0 means the error budget
+    drains exactly over its nominal period, 14.4 means a 30-day budget
+    gone in 2 days.  Fires only when EVERY window's burn exceeds its
+    threshold — the standard (5m, 14.4) + (1h, 6) pairing pages fast
+    on hard breaches without flapping on blips."""
+
+    name: str
+    measurement: str
+    good_field: str
+    total_field: str
+    objective: float = 0.99
+    #: ((window_s, burn_threshold), ...) — ALL must breach to fire
+    windows: Tuple[Tuple[float, float], ...] = ((300.0, 14.4),
+                                                (3600.0, 6.0))
+    tags: Dict[str, str] = field(default_factory=dict)
+    severity: str = "critical"
+    summary: str = ""
+    #: evaluate per distinct combination of these tag values (one
+    #: alert per tenant/namespace/...), like AlertRule.group_by
+    group_by: List[str] = field(default_factory=list)
+    #: how many exemplar trace ids to attach to a firing alert
+    max_exemplars: int = 3
+
+
+@dataclass
 class Alert:
     rule: str
     severity: str
@@ -50,6 +89,9 @@ class Alert:
     state: str = "firing"             # firing | resolved
     since: float = 0.0
     summary: str = ""
+    #: example trace ids linked from the breached series' TSDB
+    #: exemplars — the alert -> trace jump (docs/tracing.md)
+    exemplars: List[str] = field(default_factory=list)
 
 
 _OPS: Dict[str, Callable[[float, float], bool]] = {
@@ -76,6 +118,16 @@ def default_rules() -> List[AlertRule]:
                   threshold=0.95, window_s=60.0, group_by=["pool"],
                   severity="warning",
                   summary="pool allocation above 95% of capacity"),
+        # per-tenant queue-wait SLO burn (remote-vTPU dispatch): pages
+        # when the error budget burns fast in BOTH the 5m and 1h
+        # windows; firing alerts carry exemplar trace ids
+        BurnRateRule(name="queue-wait-slo-burn",
+                     measurement="tpf_trace_slo",
+                     good_field="good_total", total_field="total",
+                     objective=0.99, group_by=["tenant"],
+                     severity="critical",
+                     summary="tenant queue-wait SLO error budget "
+                             "burning fast (multi-window burn rate)"),
     ]
 
 
@@ -154,10 +206,131 @@ class AlertEvaluator:
                 out.append(((rule.name, key), name, value))
         return out
 
+    @staticmethod
+    def _escape_group(key: tuple) -> str:
+        return ",".join(v.replace("\\", "\\\\").replace(",", "\\,")
+                        for v in key)
+
+    def _burn_values(self, rule: BurnRateRule, now: float):
+        """[(state_key, alert_name, burns, group_tags)] — one entry per
+        group whose total counter moved in every window.  ``burns`` is
+        the per-window burn-rate list, ordered like rule.windows."""
+        max_w = max(w for w, _ in rule.windows)
+        # query the whole retention so each window has a baseline
+        # sample before its start (counters need last-before-window,
+        # else a window with one point reads as zero delta)
+        good = self.tsdb.query(rule.measurement, rule.good_field,
+                               tags=rule.tags or None,
+                               since=now - max(self.tsdb.retention_s,
+                                               max_w * 2), until=now)
+        total = self.tsdb.query(rule.measurement, rule.total_field,
+                                tags=rule.tags or None,
+                                since=now - max(self.tsdb.retention_s,
+                                                max_w * 2), until=now)
+
+        def group(series):
+            g: Dict[tuple, list] = {}
+            for tags, pts in series:
+                key = tuple(tags.get(k, "") for k in rule.group_by)
+                g.setdefault(key, []).append((tags, pts))
+            return g
+
+        def delta(pts, since):
+            """Counter increase across the window: last sample minus
+            the last sample at-or-before the window start (falling
+            back to the first in-window sample; reset-safe clamp)."""
+            if not pts:
+                return 0.0
+            last = pts[-1]
+            if last.ts < since:
+                return 0.0
+            baseline = None
+            for p in pts:
+                if p.ts <= since:
+                    baseline = p.value
+                else:
+                    break
+            if baseline is None:
+                baseline = pts[0].value
+            return max(0.0, last.value - baseline)
+
+        ggood, gtotal = group(good), group(total)
+        out = []
+        for key in sorted(set(ggood) | set(gtotal)):
+            burns = []
+            for window_s, _ in rule.windows:
+                since = now - window_s
+                dg = sum(delta(pts, since)
+                         for _, pts in ggood.get(key, ()))
+                dt = sum(delta(pts, since)
+                         for _, pts in gtotal.get(key, ()))
+                if dt <= 0:
+                    burns = None
+                    break
+                bad_rate = min(max(1.0 - dg / dt, 0.0), 1.0)
+                burns.append(bad_rate / max(1.0 - rule.objective, 1e-9))
+            if burns is None:
+                continue
+            name = rule.name if not key else \
+                f"{rule.name}[{self._escape_group(key)}]"
+            group_tags = dict(rule.tags or {},
+                              **dict(zip(rule.group_by, key)))
+            out.append(((rule.name, key), name, burns, group_tags))
+        return out
+
+    def _evaluate_burn_rule(self, rule: BurnRateRule,
+                            now: float) -> List[Alert]:
+        changed: List[Alert] = []
+        keyed = self._burn_values(rule, now)
+        breached_keys = set()
+        for key, name, burns, group_tags in keyed:
+            if not all(b > thr for b, (_, thr)
+                       in zip(burns, rule.windows)):
+                continue
+            breached_keys.add(key)
+            if key in self.active:
+                continue
+            exemplars = self.tsdb.exemplars(
+                rule.measurement, tags=group_tags or None,
+                since=now - max(w for w, _ in rule.windows),
+                limit=rule.max_exemplars)
+            alert = Alert(rule=name, severity=rule.severity,
+                          value=round(burns[0], 3),
+                          threshold=rule.windows[0][1],
+                          state="firing", since=now,
+                          summary=rule.summary or name,
+                          exemplars=exemplars)
+            self.active[key] = alert
+            self.history.append(alert)
+            changed.append(alert)
+            log.warning("ALERT firing: %s (burn %.1fx budget; "
+                        "exemplar traces: %s)", name, burns[0],
+                        ", ".join(exemplars) or "none")
+        values_by_key = {key: burns[0] for key, _, burns, _ in keyed}
+        for key in list(self.active):
+            if key[0] != rule.name or key in breached_keys:
+                continue
+            alert = self.active.pop(key)
+            value = values_by_key.get(key)
+            resolved = Alert(rule=alert.rule, severity=alert.severity,
+                             value=value if value is not None
+                             else alert.value,
+                             threshold=alert.threshold,
+                             state="resolved", since=alert.since,
+                             summary=alert.summary,
+                             exemplars=alert.exemplars)
+            self.history.append(resolved)
+            changed.append(resolved)
+            log.info("alert resolved: %s", alert.rule)
+        return changed
+
     def evaluate_once(self, now: Optional[float] = None) -> List[Alert]:
         now = now if now is not None else self.clock.now()
         changed: List[Alert] = []
         for rule in self.rules:
+            if isinstance(rule, BurnRateRule):
+                changed.extend(self._evaluate_burn_rule(rule, now))
+                continue
             keyed_values = self._rule_values(rule, now)
             breached_keys = set()
             for key, name, value in keyed_values:

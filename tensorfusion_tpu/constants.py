@@ -109,6 +109,10 @@ ANN_POD_COUNTER_KEY = f"{DOMAIN}/pod-counter-key"
 ANN_POD_COUNT = f"{DOMAIN}/tpf-pod-count"
 ANN_VIRT_CAPABILITIES = f"{DOMAIN}/virtualization-capabilities"
 ANN_PROVIDER_CONFIG_HASH = f"{DOMAIN}/provider-config-hash"
+#: pod-lifecycle trace propagation: ``trace_id:span_id`` stamped by the
+#: admission webhook, parented under by scheduler/bind spans
+#: (tensorfusion_tpu/tracing, docs/tracing.md)
+ANN_TRACE_CONTEXT = f"{DOMAIN}/trace"
 
 # Gang scheduling (see scheduler/gang.py)
 ANN_GANG_ENABLED = f"{DOMAIN}/gang-enabled"
@@ -230,6 +234,18 @@ ENV_STORE_TOKEN = "TPF_STORE_TOKEN"            # store-gateway shared token
 ENV_GO_TESTING = "TPF_TESTING"                 # test-mode toggles
 ENV_REMOTING_QOS = "TPF_REMOTING_QOS"          # remote tenant's QoS class
 ENV_REMOTING_DISPATCH = "TPF_REMOTING_DISPATCH"  # worker policy: wfq|fifo
+ENV_TRACE_SAMPLE = "TPF_TRACE_SAMPLE"          # head-based trace sampling
+
+#: queue-wait SLO per QoS class (ms): the per-tenant good/total rollup
+#: the dispatcher maintains (``tpf_trace_slo``) judges each request's
+#: queue wait against its tenant's class — the thresholds the
+#: burn-rate alert rules page on (docs/tracing.md)
+QOS_QUEUE_WAIT_SLO_MS = {
+    QOS_LOW: 1000.0,
+    QOS_MEDIUM: 500.0,
+    QOS_HIGH: 200.0,
+    QOS_CRITICAL: 100.0,
+}
 
 DEFAULT_SHM_BASE = "/run/tpu-fusion/shm"
 DEFAULT_HYPERVISOR_PORT = 8000

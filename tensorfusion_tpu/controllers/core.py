@@ -362,10 +362,13 @@ class WorkloadController(Controller):
 
     def __init__(self, store: ObjectStore,
                  worker_image: str = "tpufusion/worker:latest",
-                 clock: Optional[Clock] = None):
+                 clock: Optional[Clock] = None, tracer=None):
         self.store = store
         self.worker_image = worker_image
         self.clock = clock or default_clock()
+        #: optional tracing.Tracer — worker-pod creation records a
+        #: workload.spawn span on the pod's lifecycle trace
+        self.tracer = tracer
         #: workload key -> when its connection count last went to zero
         self._zero_since: Dict[str, float] = {}
 
@@ -426,7 +429,18 @@ class WorkloadController(Controller):
                 name = f"{wl.metadata.name}-worker-{i}"
                 if name in existing:
                     continue
-                self.store.create(self._worker_pod(wl, name))
+                pod = self._worker_pod(wl, name)
+                if self.tracer is not None:
+                    from ..tracing import pod_trace_context
+
+                    with self.tracer.span(
+                            "workload.spawn",
+                            parent=pod_trace_context(pod),
+                            attrs={"workload": wl.metadata.name,
+                                   "pod": pod.key()}):
+                        self.store.create(pod)
+                else:
+                    self.store.create(pod)
             # scale down extras (numeric replica order, not lexicographic)
             def replica_index(p):
                 tail = p.metadata.name.rsplit("-", 1)[-1]

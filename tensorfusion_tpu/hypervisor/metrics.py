@@ -33,13 +33,18 @@ log = logging.getLogger("tpf.hypervisor.metrics")
 
 
 def remote_dispatch_lines(remote_worker, node_name: str,
-                          ts: int) -> List[str]:
+                          ts: int, snap=None) -> List[str]:
     """Influx lines for one RemoteVTPUWorker's dispatch scheduler:
-    ``tpf_remote_dispatch`` (queue saturation + launch counters) and
-    per-QoS ``tpf_remote_qos`` (share + queue wait per class).  Shared
-    by the node-agent recorder here and the operator-side
-    MetricsRecorder so both topologies emit identical series."""
-    snap = remote_worker.dispatcher.snapshot()
+    ``tpf_remote_dispatch`` (queue saturation + launch counters),
+    per-QoS ``tpf_remote_qos`` (share + queue wait per class) and
+    per-tenant ``tpf_trace_slo`` (queue-wait SLO good/total rollups —
+    the counters the burn-rate alert rules consume, docs/tracing.md).
+    Shared by the node-agent recorder here and the operator-side
+    MetricsRecorder so both topologies emit identical series; pass
+    ``snap`` to reuse an already-taken dispatcher snapshot (the
+    operator recorder also reads its exemplar trace ids from it)."""
+    if snap is None:
+        snap = remote_worker.dispatcher.snapshot()
     tags = {"node": node_name, "mode": snap["mode"]}
     lines = [encode_line(
         "tpf_remote_dispatch", tags,
@@ -62,6 +67,17 @@ def remote_dispatch_lines(remote_worker, node_name: str,
             {"served_total": q["served"],
              "queue_wait_p50_ms": q["p50_ms"],
              "queue_wait_p99_ms": q["p99_ms"]}, ts))
+    for conn_id, t in snap["tenants"].items():
+        if not t.get("slo_total"):
+            continue        # tenant never had a request dispatched
+        lines.append(encode_line(
+            "tpf_trace_slo",
+            dict(tags, tenant=conn_id, qos=t["qos"]),
+            {"good_total": t["slo_good"],
+             "total": t["slo_total"],
+             "slo_ms": t["slo_ms"],
+             "good_ratio": round(t["slo_good"] / t["slo_total"], 6)},
+            ts))
     return lines
 
 #: max influx lines buffered while the operator is unreachable (at 5s

@@ -4,6 +4,9 @@ Analog of the reference's gin server (``pkg/hypervisor/server/``, port 8000):
 
 - ``GET  /api/v1/devices``            device inventory + metrics
 - ``GET  /api/v1/workers``            tracked workers + status
+- ``GET  /api/v1/dispatch``           remote-vTPU dispatch snapshots
+  (per-tenant queue-wait quantiles, SLO rollups, last trace ids — the
+  TUI's dispatch pane reads this)
 - ``POST /api/v1/workers``            submit a worker (single-node backend)
 - ``DELETE /api/v1/workers/<ns>/<name>``
 - ``POST /api/v1/workers/<ns>/<name>/snapshot|resume|freeze``  live-migration hooks
@@ -49,12 +52,16 @@ def _to_jsonable(obj):
 class HypervisorServer:
     def __init__(self, devices, workers, backend=None, snapshot_dir="/tmp",
                  provider=None, host: str = "127.0.0.1", port: int = 0,
-                 token: str = "", tls_cert: str = "", tls_key: str = ""):
+                 token: str = "", tls_cert: str = "", tls_key: str = "",
+                 remote_workers=()):
         self.devices = devices
         self.workers = workers
         self.backend = backend
         self.snapshot_dir = snapshot_dir
         self.provider = provider
+        #: co-hosted RemoteVTPUWorker instances whose dispatch snapshot
+        #: /api/v1/dispatch serves (the TUI dispatch pane's feed)
+        self.remote_workers = list(remote_workers)
         #: optional shared token — freeze/resume/snapshot mutate worker
         #: state, so a non-loopback bind should set one
         self.token = token
@@ -155,6 +162,11 @@ class HypervisorServer:
         scheme = "https" if self.tls else "http"
         return f"{scheme}://127.0.0.1:{self.port}"
 
+    def register_remote_worker(self, worker) -> None:
+        """Expose a remote-vTPU worker's dispatch snapshot via
+        /api/v1/dispatch (workers may start after the server)."""
+        self.remote_workers.append(worker)
+
     def start(self) -> None:
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name="tpf-hypervisor-http",
@@ -192,6 +204,9 @@ class HypervisorServer:
                     "status": _to_jsonable(w.status)}
                    for w in self.workers.list()]
             h._send(200, out)
+        elif url.path == "/api/v1/dispatch":
+            h._send(200, [rw.dispatcher.snapshot()
+                          for rw in self.remote_workers])
         elif url.path == "/api/v1/allocations":
             # Pod-resources-proxy analog (pod_resources_proxy.go:87-318):
             # the per-pod device-assignment view monitoring agents

@@ -15,8 +15,11 @@ other UIs are:
   tick, feeds ``TuiState`` and blits the rendered screen.
 
 Keys (reference model.go key map): d=devices w=workers m=metrics
-s=shm-inspector, j/k or arrows move the selection, enter opens the
-detail view for the selected row, esc goes back, q quits.
+s=shm-inspector r=remote-dispatch, j/k or arrows move the selection,
+enter opens the detail view for the selected row, esc goes back,
+q quits.  The dispatch pane shows the co-hosted remote-vTPU workers'
+fair-queue state per tenant — queue-wait p50/p99, SLO good ratio and
+the last trace id (docs/tracing.md) — fed by /api/v1/dispatch.
 
     python -m tensorfusion_tpu.hypervisor.tui --url http://127.0.0.1:8000
 """
@@ -302,6 +305,54 @@ def render_metrics(devices: List[dict], workers: List[dict]) -> str:
     return "\n".join(lines)
 
 
+def render_dispatch(snapshots: List[dict]) -> str:
+    """Remote-vTPU dispatch pane: per-tenant queue-wait quantiles, SLO
+    rollup and last-trace summary from each worker's dispatcher
+    snapshot (the PR-2 dispatch metrics, finally on screen)."""
+    if not snapshots:
+        return "(no remote-vTPU workers registered on this node)"
+    lines: List[str] = []
+    for i, snap in enumerate(snapshots):
+        qw, sv = snap.get("queue_wait", {}), snap.get("service", {})
+        lines.append(
+            f"== remote worker {i} [{snap.get('mode','?')}] "
+            f"depth={snap.get('depth', 0)} "
+            f"executed={snap.get('executed', 0)} "
+            f"launches={snap.get('launches', 0)} "
+            f"busy={snap.get('busy_rejected', 0)} "
+            f"deadline={snap.get('deadline_exceeded', 0)} ==")
+        lines.append(
+            f"queue-wait p50={qw.get('p50_ms', 0):.2f}ms "
+            f"p99={qw.get('p99_ms', 0):.2f}ms   "
+            f"service p50={sv.get('p50_ms', 0):.2f}ms "
+            f"p99={sv.get('p99_ms', 0):.2f}ms")
+        last = snap.get("last_trace_id", "")
+        if last:
+            lines.append(f"last trace: {last}")
+        tenants = snap.get("tenants", {})
+        if tenants:
+            lines.append("  TENANT          QOS       W    QUEUED "
+                         "DONE   WAIT p50/p99 ms   SLO ok     "
+                         "LAST TRACE")
+            for conn_id in sorted(tenants):
+                t = tenants[conn_id]
+                tq = t.get("queue_wait", {})
+                total = t.get("slo_total", 0)
+                good = t.get("slo_good", 0)
+                ratio = f"{good / total * 100.0:5.1f}%" if total \
+                    else "    -"
+                lines.append(
+                    f"  {conn_id:<15} {t.get('qos',''):<8} "
+                    f"{t.get('weight', 0):4.0f} "
+                    f"{t.get('queued', 0):6d} "
+                    f"{t.get('completed', 0):5d} "
+                    f"{tq.get('p50_ms', 0):8.2f}/{tq.get('p99_ms', 0):<8.2f} "
+                    f"{ratio:<9} "
+                    f"{t.get('last_trace_id', '') or '-'}")
+        lines.append("")
+    return "\n".join(lines).rstrip()
+
+
 def render_shm(shm_base: str, selected: int = -1) -> str:
     """The shm inspector dialog (shm_dialog.go analog): raw token-bucket
     state of every worker segment."""
@@ -342,6 +393,7 @@ VIEW_DEVICES = "devices"
 VIEW_WORKERS = "workers"
 VIEW_METRICS = "metrics"
 VIEW_SHM = "shm"
+VIEW_DISPATCH = "dispatch"
 VIEW_DEVICE_DETAIL = "device_detail"
 VIEW_WORKER_DETAIL = "worker_detail"
 
@@ -363,12 +415,19 @@ class TuiState:
         self.sel_shm = 0
         self.devices: List[dict] = []
         self.workers: List[dict] = []
+        self.dispatch: List[dict] = []
         self.device_history: Dict[str, _EntityHistory] = {}
         self.worker_history: Dict[str, _EntityHistory] = {}
         self.last_update = 0.0
         self.error: Optional[str] = None
 
     # -- data ingestion ---------------------------------------------------
+
+    def update_dispatch(self, snapshots: List[dict]) -> None:
+        """Ingest /api/v1/dispatch (fetched separately from devices/
+        workers so hypervisors without remote workers — or old servers
+        without the endpoint — degrade to an empty pane)."""
+        self.dispatch = snapshots or []
 
     def update(self, devices: List[dict], workers: List[dict]) -> None:
         self.devices, self.workers = devices, workers
@@ -399,9 +458,10 @@ class TuiState:
         """Process one key; returns False to quit."""
         if ch == "q":
             return False
-        if ch in ("d", "w", "m", "s"):
+        if ch in ("d", "w", "m", "s", "r"):
             self.view = {"d": VIEW_DEVICES, "w": VIEW_WORKERS,
-                         "m": VIEW_METRICS, "s": VIEW_SHM}[ch]
+                         "m": VIEW_METRICS, "s": VIEW_SHM,
+                         "r": VIEW_DISPATCH}[ch]
             return True
         if ch == "esc":
             if self.view == VIEW_DEVICE_DETAIL:
@@ -453,6 +513,8 @@ class TuiState:
             return render_metrics(self.devices, self.workers)
         if self.view == VIEW_SHM:
             return render_shm(self.shm_base, self.sel_shm)
+        if self.view == VIEW_DISPATCH:
+            return render_dispatch(self.dispatch)
         if self.view == VIEW_DEVICE_DETAIL:
             d = self._selected_device()
             if d is None:
@@ -474,7 +536,8 @@ class TuiState:
         if self.last_update and WALL.now() - self.last_update > 5:
             stale = f"  (stale {WALL.now() - self.last_update:.0f}s)"
         return ("tpu-fusion hypervisor  [d]evices [w]orkers [m]etrics "
-                "[s]hm  j/k+enter detail  esc back  [q]uit" + stale)
+                "[s]hm [r]emote-dispatch  j/k+enter detail  esc back  "
+                "[q]uit" + stale)
 
 
 def _clamp(idx: int, n: int) -> int:
@@ -506,6 +569,16 @@ def snapshot(url: str, shm_base: str = "") -> str:
         out.append(render_workers(workers))
         out.append("")
         out.append(render_metrics(devices, workers))
+        # an older hypervisor without the endpoint = no dispatch pane;
+        # silence is the design (the main fetch above already surfaced
+        # reachability)
+        try:
+            dispatch = _fetch(url, "/api/v1/dispatch")
+        # tpflint: disable=swallowed-error -- absent endpoint, by design
+        except Exception:  # noqa: BLE001 - older server: no endpoint
+            dispatch = []
+        if dispatch:
+            out += ["", render_dispatch(dispatch)]
     except Exception as e:  # noqa: BLE001
         out.append(f"(hypervisor unreachable at {url}: {e})")
     if shm_base:
@@ -536,6 +609,15 @@ def run_curses(url: str, shm_base: str, refresh_s: float = 1.0) -> None:
                 try:
                     state.update(_fetch(url, "/api/v1/devices"),
                                  _fetch(url, "/api/v1/workers"))
+                    # older server without /api/v1/dispatch: empty
+                    # pane, by design (devices/workers fetch above
+                    # owns the reachability error)
+                    try:
+                        state.update_dispatch(
+                            _fetch(url, "/api/v1/dispatch"))
+                    # tpflint: disable=swallowed-error -- by design
+                    except Exception:  # noqa: BLE001 - old server
+                        state.update_dispatch([])
                 except Exception as e:  # noqa: BLE001
                     state.error = f"hypervisor unreachable at {url}: {e}"
                 dirty = True

@@ -28,7 +28,8 @@ log = logging.getLogger("tpf.metrics.recorder")
 class MetricsRecorder:
     def __init__(self, operator, tsdb: Optional[TSDB] = None,
                  path: str = "", interval_s: float = 5.0,
-                 remote_workers=(), clock: Optional[Clock] = None):
+                 remote_workers=(), clock: Optional[Clock] = None,
+                 tracers=()):
         self.operator = operator
         self.clock = clock or default_clock()
         self.tsdb = tsdb or TSDB(clock=self.clock)
@@ -38,8 +39,15 @@ class MetricsRecorder:
         #: single-node / bench topology — multi-host nodes ship the
         #: same series through HypervisorMetricsRecorder's push path):
         #: their dispatch saturation lands in the TSDB as
-        #: ``tpf_remote_dispatch`` / ``tpf_remote_qos``
+        #: ``tpf_remote_dispatch`` / ``tpf_remote_qos`` /
+        #: ``tpf_trace_slo`` (with trace-id exemplars)
         self.remote_workers = list(remote_workers)
+        #: tracing.Tracer instances drained (cursor-based, never
+        #: clearing the ring) into per-span ``tpf_trace_span``
+        #: aggregates each pass; the operator registers its
+        #: control-plane tracer, embedded workers contribute theirs
+        self.tracers = list(tracers)
+        self._trace_cursors: Dict[int, int] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         if path:
@@ -48,6 +56,9 @@ class MetricsRecorder:
     def register_remote_worker(self, worker) -> None:
         """Start shipping a remote-vTPU worker's dispatch metrics."""
         self.remote_workers.append(worker)
+        tracer = getattr(worker, "tracer", None)
+        if tracer is not None and tracer not in self.tracers:
+            self.tracers.append(tracer)
 
     def start(self) -> None:
         self._stop.clear()
@@ -172,20 +183,69 @@ class MetricsRecorder:
         self.tsdb.insert("tpf_scheduler", {}, sched_fields, now)
 
         # remote-vTPU dispatch saturation (embedded workers): the same
-        # tpf_remote_dispatch/tpf_remote_qos series multi-host nodes
-        # push through the hypervisor recorder + store gateway
+        # tpf_remote_dispatch/tpf_remote_qos/tpf_trace_slo series
+        # multi-host nodes push through the hypervisor recorder + store
+        # gateway.  The in-process path additionally attaches trace-id
+        # EXEMPLARS from the dispatcher snapshot, so the queue-wait /
+        # SLO series link back to example traces (docs/tracing.md).
         if self.remote_workers:
             from ..hypervisor.metrics import remote_dispatch_lines
             from .encoder import parse_line
 
             for rw in self.remote_workers:
-                for line in remote_dispatch_lines(rw, "operator", ts):
+                snap = rw.dispatcher.snapshot()
+                ex_by_tenant = {
+                    conn: t.get("last_trace_id", "")
+                    for conn, t in snap["tenants"].items()}
+                last_trace = snap.get("last_trace_id", "")
+                for line in remote_dispatch_lines(rw, "operator", ts,
+                                                  snap=snap):
                     lines.append(line)
                     measurement, tags, fields, _ = parse_line(line)
-                    self.tsdb.insert(measurement, tags, fields, now)
+                    if measurement == "tpf_trace_slo":
+                        exemplar = ex_by_tenant.get(tags.get("tenant"))
+                    else:
+                        exemplar = last_trace
+                    self.tsdb.insert(measurement, tags, fields, now,
+                                     exemplar=exemplar or None)
+
+        lines.extend(self._trace_span_lines(ts, now))
 
         if self.path and lines:
             with open(self.path, "a") as f:
                 f.write("\n".join(lines) + "\n")
         self.tsdb.gc()
         return len(lines)
+
+    def _trace_span_lines(self, ts: int, now: float) -> list:
+        """Drain newly-finished spans from every registered tracer into
+        per-(service, span-name) ``tpf_trace_span`` aggregates.  The
+        cursor-based drain never clears a tracer's ring, so the sim /
+        CLI exporters keep seeing full traces."""
+        agg: Dict[tuple, list] = {}
+        exemplars: Dict[tuple, str] = {}
+        for tracer in self.tracers:
+            cursor = self._trace_cursors.get(id(tracer), 0)
+            cursor, spans = tracer.finished_since(cursor)
+            self._trace_cursors[id(tracer)] = cursor
+            for d in spans:
+                key = (d.get("service", ""), d.get("name", ""))
+                agg.setdefault(key, []).append(
+                    d.get("dur_us", 0) / 1e3)
+                exemplars[key] = d.get("trace_id", "")
+        lines = []
+        for (component, span), durs in sorted(agg.items()):
+            durs.sort()
+            tags = {"component": component, "span": span}
+            fields = {"count": len(durs),
+                      "duration_ms_mean": round(sum(durs) / len(durs),
+                                                3),
+                      "duration_ms_p95": round(
+                          durs[min(int(0.95 * (len(durs) - 1)),
+                                   len(durs) - 1)], 3),
+                      "duration_ms_max": round(durs[-1], 3)}
+            lines.append(encode_line("tpf_trace_span", tags, fields, ts))
+            self.tsdb.insert("tpf_trace_span", tags, fields, now,
+                             exemplar=exemplars.get((component, span))
+                             or None)
+        return lines

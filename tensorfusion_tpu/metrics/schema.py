@@ -50,6 +50,21 @@ METRICS_SCHEMA = {
         "fields": ("served_total", "queue_wait_p50_ms",
                    "queue_wait_p99_ms"),
     },
+    # tpftrace rollups (tensorfusion_tpu/tracing, docs/tracing.md):
+    # per-span-name duration aggregates drained from the tracers each
+    # recorder pass, and the per-tenant queue-wait SLO counters the
+    # multi-window burn-rate alert rules consume.  Both series carry
+    # trace-id exemplars in the TSDB (tsdb.exemplars) so an alert links
+    # to example traces.
+    "tpf_trace_span": {
+        "tags": ("component", "span"),
+        "fields": ("count", "duration_ms_mean", "duration_ms_p95",
+                   "duration_ms_max"),
+    },
+    "tpf_trace_slo": {
+        "tags": ("node", "mode", "tenant", "qos"),
+        "fields": ("good_total", "total", "slo_ms", "good_ratio"),
+    },
     # operator-side recorder (metrics/recorder.py)
     "tpf_chip_alloc": {
         "tags": ("chip", "node", "pool", "generation"),

@@ -36,17 +36,26 @@ class Point:
 class TSDB:
     def __init__(self, retention_s: float = 3600.0,
                  max_points_per_series: int = 10000,
-                 clock: Optional[Clock] = None):
+                 clock: Optional[Clock] = None,
+                 max_exemplars_per_series: int = 16):
         self.retention_s = retention_s
         self.max_points = max_points_per_series
+        self.max_exemplars = max_exemplars_per_series
         self.clock = clock or default_clock()
         self._lock = threading.RLock()
         self._series: Dict[SeriesKey, deque] = {}
+        #: trace-id exemplars: (measurement, tag_key) -> deque of
+        #: (ts, trace_id) — the link from a metric/alert back to
+        #: example traces (docs/tracing.md).  Keyed per tagged series,
+        #: not per field: one request exemplifies every field its line
+        #: carried.
+        self._exemplars: Dict[tuple, deque] = {}
 
     # -- ingestion --------------------------------------------------------
 
     def insert(self, measurement: str, tags: Dict[str, str],
-               fields: Dict[str, float], ts: Optional[float] = None) -> None:
+               fields: Dict[str, float], ts: Optional[float] = None,
+               exemplar: Optional[str] = None) -> None:
         ts = ts if ts is not None else self.clock.now()
         tag_key = tuple(sorted(tags.items()))
         with self._lock:
@@ -61,6 +70,14 @@ class TSDB:
                     dq = deque(maxlen=self.max_points)
                     self._series[key] = dq
                 dq.append(Point(ts, float(value)))
+            if exemplar:
+                ekey = (measurement, tag_key)
+                edq = self._exemplars.get(ekey)
+                if edq is None:
+                    edq = deque(maxlen=self.max_exemplars)
+                    self._exemplars[ekey] = edq
+                if not edq or edq[-1][1] != exemplar:
+                    edq.append((ts, str(exemplar)))
 
     def ingest_line(self, line: str) -> None:
         measurement, tags, fields, ts_ns = parse_line(line)
@@ -132,6 +149,33 @@ class TSDB:
         values = [p.value for _, pts in series for p in pts]
         return aggregate_values(values, agg)
 
+    def exemplars(self, measurement: str,
+                  tags: Optional[Dict[str, str]] = None,
+                  since: Optional[float] = None,
+                  limit: int = 5) -> List[str]:
+        """Most-recent-first trace ids attached to matching series —
+        what a firing alert links so "which request was that" has an
+        answer (docs/tracing.md)."""
+        now = self.clock.now()
+        since = since if since is not None else now - self.retention_s
+        found: List[Tuple[float, str]] = []
+        with self._lock:
+            for (m, tag_key), dq in self._exemplars.items():
+                if m != measurement:
+                    continue
+                if tags:
+                    kt = dict(tag_key)
+                    if any(kt.get(k) != v for k, v in tags.items()):
+                        continue
+                found.extend((ts, tid) for ts, tid in dq if ts >= since)
+        out: List[str] = []
+        for _, tid in sorted(found, reverse=True):
+            if tid not in out:
+                out.append(tid)
+            if len(out) >= limit:
+                break
+        return out
+
     def gc(self) -> None:
         cutoff = self.clock.now() - self.retention_s
         with self._lock:
@@ -140,6 +184,11 @@ class TSDB:
                     dq.popleft()
                 if not dq:
                     del self._series[key]
+            for ekey, edq in list(self._exemplars.items()):
+                while edq and edq[0][0] < cutoff:
+                    edq.popleft()
+                if not edq:
+                    del self._exemplars[ekey]
 
 
 def aggregate_values(values, agg: str) -> Optional[float]:

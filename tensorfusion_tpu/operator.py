@@ -56,11 +56,19 @@ class Operator:
                  clock: Optional[Clock] = None):
         self.clock = clock or default_clock()
         self.store = store or ObjectStore()
+        # one tracer for the whole control plane: admission, scheduling
+        # and bind spans join per-pod lifecycle traces (docs/tracing.md);
+        # under the digital twin the clock is the SimClock, so sim
+        # scenarios export deterministic virtual-time traces
+        from .tracing import Tracer
+
+        self.tracer = Tracer(service="control-plane", clock=self.clock)
         self.allocator = TPUAllocator(store=self.store, clock=self.clock)
         self.ports = PortAllocator()
         self.indices = IndexAllocator()
         self.parser = WorkloadParser(self.store)
-        self.mutator = PodMutator(self.store, self.parser)
+        self.mutator = PodMutator(self.store, self.parser,
+                                  tracer=self.tracer)
         self.gang = GangManager(clock=self.clock)
         self.cloud = MockCloudProvider(self.store)
         self.expander = NodeExpander(self.store, enabled=enable_expander,
@@ -88,7 +96,8 @@ class Operator:
         self.scheduler = Scheduler(nodes_fn=self._node_names,
                                    bind_fn=self._bind_pod,
                                    failure_handler=self._on_sched_failure,
-                                   clock=self.clock)
+                                   clock=self.clock,
+                                   tracer=self.tracer)
         self.gang.bind_scheduler(self.scheduler)
         self.scheduler.register(self.fit)
         self.scheduler.register(ICITopologyPlugin(
@@ -115,7 +124,8 @@ class Operator:
                 NodeController(self.store, clock=self.clock),
                 QuotaController(self.allocator),
                 self.providerconfig_ctrl,
-                WorkloadController(self.store, clock=self.clock),
+                WorkloadController(self.store, clock=self.clock,
+                                   tracer=self.tracer),
                 ConnectionController(self.store),
                 PodController(self.store, self.allocator, self.scheduler,
                               self.ports, self.indices, self.gang),
@@ -137,7 +147,8 @@ class Operator:
         want_alerts = alert_rules is not None or bool(alert_webhook)
         self.metrics = MetricsRecorder(self, tsdb=self.tsdb,
                                        path=metrics_path,
-                                       clock=self.clock) \
+                                       clock=self.clock,
+                                       tracers=[self.tracer]) \
             if enable_metrics or metrics_path or want_alerts else None
         self.autoscaler = AutoScaler(self, self.tsdb, clock=self.clock) \
             if enable_autoscaler else None
@@ -452,19 +463,27 @@ class Operator:
         # bind strands the pod Pending with its allocation committed),
         # and it must equally not clobber concurrent annotation writes.
         # NotFoundError propagates like the plain get() always did.
-        for attempt in (0, 1, 2, 3, 4):
-            current = self.store.get(Pod, pod.metadata.name,
-                                     pod.metadata.namespace).thaw()
-            current.spec.node_name = node
-            current.metadata.annotations.update(pod.metadata.annotations)
-            current.status.phase = constants.PHASE_RUNNING
-            current.status.host_ip = node
-            try:
-                self.store.update(current, check_version=True)
-                return
-            except ConflictError:
-                if attempt == 4:
-                    raise
+        from .tracing import pod_trace_context
+
+        with self.tracer.span("scheduler.bind",
+                              parent=pod_trace_context(pod),
+                              attrs={"pod": pod.key(),
+                                     "node": node}) as span:
+            for attempt in (0, 1, 2, 3, 4):
+                current = self.store.get(Pod, pod.metadata.name,
+                                         pod.metadata.namespace).thaw()
+                current.spec.node_name = node
+                current.metadata.annotations.update(
+                    pod.metadata.annotations)
+                current.status.phase = constants.PHASE_RUNNING
+                current.status.host_ip = node
+                try:
+                    self.store.update(current, check_version=True)
+                    span.set_attr("attempts", attempt + 1)
+                    return
+                except ConflictError:
+                    if attempt == 4:
+                        raise
 
     def _pods_on_node(self, node: str) -> List[Pod]:
         if self._cache_live:

@@ -37,6 +37,17 @@ pipelined ``submit()`` callers see the exception and apply their own
 flow control.  ``remote_jit(fn, microbatch=True)`` declares the
 executable safe for the worker to fuse compatible concurrent requests
 into one device launch.
+
+Distributed tracing (protocol v5): construct the device with a
+:class:`~tensorfusion_tpu.tracing.Tracer` and every (sampled) call
+records a ``client.remote_jit`` root span with ``client.serialize`` /
+``client.wire`` children; the wire span's context rides the EXECUTE's
+``trace`` meta, the worker's span tree (queue wait, launch, upload,
+flush) comes back in ``trace_spans`` and is adopted into the client
+tracer — one assembled end-to-end timeline per request, exportable as
+Chrome/Perfetto JSON via ``tools/tpftrace.py`` (docs/tracing.md).
+Pre-v5 workers never see the field; sampling is head-based at the
+root (``TPF_TRACE_SAMPLE``).
 """
 
 from __future__ import annotations
@@ -164,7 +175,8 @@ class RemoteDevice:
     def __init__(self, url: str, token: Optional[str] = None,
                  timeout_s: float = 300.0,
                  protocol_version: int = protocol.VERSION,
-                 qos: Optional[str] = None):
+                 qos: Optional[str] = None,
+                 tracer=None):
         # url: "tcp://host:port"
         if url.startswith("tcp://"):
             url = url[len("tcp://"):]
@@ -179,6 +191,10 @@ class RemoteDevice:
                                          "") or None
         #: the worker-resolved dispatch weight (HELLO_OK, v4 workers)
         self.qos_weight: Optional[float] = None
+        #: optional span recorder (tensorfusion_tpu.tracing.Tracer);
+        #: None disables client-side tracing entirely — remote_jit
+        #: wrappers check it per call
+        self.tracer = tracer
         #: highest wire version this client will speak; pinning to 2
         #: makes it frame-faithful to a v2 build (mixed-version tests)
         self.protocol_version = protocol_version
@@ -561,32 +577,85 @@ class RemoteDevice:
                     f"only speaks v{device._wire_version}")
             return {"deadline_ms": int(deadline_ms)}
 
+        fn_name = getattr(fn, "__name__", "") or type(fn).__name__
+
+        def _root_span():
+            """client.remote_jit root span, or None (tracing off)."""
+            if device.tracer is None:
+                return None
+            return device.tracer.start_span("client.remote_jit",
+                                            attrs={"fn": fn_name})
+
+        def _wire_span(root, exe_id):
+            """client.wire child span + the v5 ``trace`` meta carrying
+            its context, or (None, None).  Only sampled traces ride the
+            wire, and only v5 workers ever see the field — an older
+            peer's frames are byte-identical to an untraced call."""
+            if root is None or not root.sampled:
+                return None, None
+            wire = device.tracer.start_span("client.wire", parent=root,
+                                            attrs={"exe_id": exe_id})
+            if device._wire_version >= 5:
+                return wire, wire.ctx()
+            return wire, None
+
+        def _wire_done(wire, rmeta):
+            """Adopt the server-side span tree and close the wire span."""
+            if wire is None:
+                return
+            device.tracer.adopt(rmeta.get("trace_spans") or ())
+            wire.finish(n_results=rmeta.get("n_results", 0),
+                        microbatched=rmeta.get("microbatched", 0))
+
         @functools.wraps(fn)
         def remote(*args, deadline_ms: Optional[int] = None):
-            entry, leaves = prepare(args)
-            reconnects = busy = 0
-            while True:
-                fut = send_execute(entry, leaves,
-                                   extra_meta=_deadline_meta(deadline_ms))
-                try:
-                    _, rmeta, results = device._result(fut)
-                    return jax.tree_util.tree_unflatten(entry[1],
-                                                        results)
-                except RemoteBusyError as e:
-                    # bounded backpressure: sleep the worker's drain
-                    # estimate with jitter so a herd of retries does
-                    # not re-arrive in lockstep
-                    busy += 1
-                    if busy > MAX_BUSY_RETRIES:
-                        raise
-                    default_clock().sleep(e.backoff_s(busy))
-                except ConnectionError:
-                    # one reconnect attempt, like _rpc: send_execute
-                    # re-fires any shard PUTs on the fresh connection
-                    reconnects += 1
-                    if reconnects > 1:
-                        raise
-                    device.close()
+            root = _root_span()
+            try:
+                ser = device.tracer.start_span(
+                    "client.serialize", parent=root,
+                    attrs={"cached": bool(exe_ids)}) \
+                    if root is not None else None
+                entry, leaves = prepare(args)
+                if ser is not None:
+                    ser.finish(exe_id=entry[0])
+                reconnects = busy = 0
+                while True:
+                    wire, trace_meta = _wire_span(root, entry[0])
+                    extra = _deadline_meta(deadline_ms)
+                    if trace_meta is not None:
+                        extra = dict(extra or {}, trace=trace_meta)
+                    fut = send_execute(entry, leaves, extra_meta=extra)
+                    try:
+                        _, rmeta, results = device._result(fut)
+                        _wire_done(wire, rmeta)
+                        if root is not None:
+                            root.finish(busy_retries=busy,
+                                        reconnects=reconnects)
+                        return jax.tree_util.tree_unflatten(entry[1],
+                                                            results)
+                    except RemoteBusyError as e:
+                        # bounded backpressure: sleep the worker's drain
+                        # estimate with jitter so a herd of retries does
+                        # not re-arrive in lockstep
+                        if wire is not None:
+                            wire.finish(error="BUSY")
+                        busy += 1
+                        if busy > MAX_BUSY_RETRIES:
+                            raise
+                        default_clock().sleep(e.backoff_s(busy))
+                    except ConnectionError:
+                        # one reconnect attempt, like _rpc: send_execute
+                        # re-fires any shard PUTs on the fresh connection
+                        if wire is not None:
+                            wire.finish(error="ConnectionError")
+                        reconnects += 1
+                        if reconnects > 1:
+                            raise
+                        device.close()
+            except BaseException as e:
+                if root is not None and root.end_s is None:
+                    root.finish(error=f"{type(e).__name__}: {e}"[:200])
+                raise
 
         def submit(*args, deadline_ms: Optional[int] = None) -> Future:
             """Pipelined call: returns a Future resolving to the result
@@ -596,9 +665,13 @@ class RemoteDevice:
             the Future fails with RemoteBusyError and the caller
             applies its own flow control (e.g. drain some in-flight
             futures, sleep ``retry_after_ms`` with jitter)."""
+            root = _root_span()
             entry, leaves = prepare(args)
-            raw = send_execute(entry, leaves,
-                               extra_meta=_deadline_meta(deadline_ms))
+            wire, trace_meta = _wire_span(root, entry[0])
+            extra = _deadline_meta(deadline_ms)
+            if trace_meta is not None:
+                extra = dict(extra or {}, trace=trace_meta)
+            raw = send_execute(entry, leaves, extra_meta=extra)
             out_tree = entry[1]
             out: Future = Future()
 
@@ -606,10 +679,20 @@ class RemoteDevice:
                 try:
                     rkind, rmeta, results = f.result()
                     if rkind == "ERROR":
+                        if wire is not None:
+                            device.tracer.adopt(
+                                rmeta.get("trace_spans") or ())
+                            wire.finish(error=rmeta.get("code")
+                                        or "error")
                         _raise_reply_error(rmeta)
+                    _wire_done(wire, rmeta)
+                    if root is not None:
+                        root.finish()
                     out.set_result(jax.tree_util.tree_unflatten(
                         out_tree, results))
                 except BaseException as e:  # noqa: BLE001
+                    if root is not None and root.end_s is None:
+                        root.finish(error=f"{type(e).__name__}")
                     out.set_exception(e)
 
             raw.add_done_callback(_chain)

@@ -55,6 +55,7 @@ from collections import deque
 from typing import Callable, Dict, List, Optional
 
 from .. import constants
+from ..tracing.core import Tracer
 
 #: queue-depth defaults — deep enough for DCN-latency pipelining
 #: (clients run depths of 8-32), shallow enough that queue wait stays
@@ -123,11 +124,13 @@ class WorkItem:
 
     __slots__ = ("kind", "meta", "buffers", "reply", "tenant", "cost",
                  "exe_id", "batch_key", "enqueue_t", "deadline_t",
-                 "start_tag", "finish_tag", "dispatch_t")
+                 "start_tag", "finish_tag", "dispatch_t",
+                 "trace", "trace_spans")
 
     def __init__(self, kind: str, meta: dict, buffers: list,
                  reply: Callable, cost: float, exe_id: str,
-                 batch_key: Optional[str], deadline_t: Optional[float]):
+                 batch_key: Optional[str], deadline_t: Optional[float],
+                 trace: Optional[dict] = None):
         self.kind = kind
         self.meta = meta
         self.buffers = buffers
@@ -142,6 +145,11 @@ class WorkItem:
         self.start_tag = 0.0
         self.finish_tag = 0.0
         self.dispatch_t = 0.0
+        #: propagated v5 span context ({"trace_id","span_id","sampled"})
+        #: or None; server-side spans accumulate in trace_spans and ride
+        #: the reply back for client-side trace assembly
+        self.trace = trace
+        self.trace_spans: List[dict] = []
 
 
 class Tenant:
@@ -159,6 +167,20 @@ class Tenant:
         self.submitted = 0
         self.completed = 0
         self.closed = False
+        #: per-tenant queue-wait quantiles (the hypervisor TUI's
+        #: dispatch pane reads these; per-QoS recorders aggregate
+        #: coarser).  Internally locked, like the global recorders.
+        self.wait = LatencyRecorder(maxlen=512)
+        #: queue-wait SLO rollup vs this tenant's QoS threshold
+        #: (constants.QOS_QUEUE_WAIT_SLO_MS) -> tpf_trace_slo series
+        # guarded by: _cv
+        self.slo_good = 0
+        # guarded by: _cv
+        self.slo_total = 0
+        #: most recent sampled trace id dispatched for this tenant —
+        #: the exemplar the TSDB attaches to its histogram series
+        # guarded by: _cv
+        self.last_trace_id = ""
 
 
 class BusyError(Exception):
@@ -188,11 +210,15 @@ class DeviceDispatcher:
                  mode: str = "wfq",
                  max_queue_per_tenant: int = DEFAULT_MAX_QUEUE_PER_TENANT,
                  max_queue_global: int = DEFAULT_MAX_QUEUE_GLOBAL,
-                 max_microbatch: int = DEFAULT_MAX_MICROBATCH):
+                 max_microbatch: int = DEFAULT_MAX_MICROBATCH,
+                 tracer: Optional[Tracer] = None):
         if mode not in ("wfq", "fifo"):
             raise ValueError(f"unknown dispatch mode {mode!r}")
         self.execute_batch = execute_batch
         self.mode = mode
+        #: records dispatcher.queue / device.launch spans for traced
+        #: items (protocol v5); None disables span recording entirely
+        self.tracer = tracer
         self.max_queue_per_tenant = max_queue_per_tenant
         self.max_queue_global = max_queue_global
         self.max_microbatch = max(1, max_microbatch)
@@ -227,6 +253,10 @@ class DeviceDispatcher:
         self.busy_rejected = 0
         # guarded by: _cv
         self.deadline_exceeded = 0
+        #: most recently dispatched sampled trace id (any tenant) — the
+        #: exemplar attached to the dispatcher-level histogram series
+        # guarded by: _cv
+        self._last_trace_id = ""
 
     # -- lifecycle --------------------------------------------------------
 
@@ -401,6 +431,41 @@ class DeviceDispatcher:
         return item.deadline_t is not None and \
             time.monotonic() > item.deadline_t
 
+    # -- span recording (protocol v5 traced items) ------------------------
+
+    def _queue_span(self, item: WorkItem, wait_s: float,
+                    qos: str) -> None:
+        """dispatcher.queue span: exactly the wait the histogram
+        observed for this item, so per-trace attribution and the
+        aggregate metric always agree."""
+        if self.tracer is None or not item.trace:
+            return
+        end = self.tracer.clock.now()
+        d = self.tracer.record_span(
+            "dispatcher.queue", end - wait_s, end, parent=item.trace,
+            attrs={"qos": qos,
+                   "tenant": item.tenant.conn_id if item.tenant else "",
+                   "wait_ms": round(wait_s * 1e3, 3)})
+        if d is not None:
+            item.trace_spans.append(d)
+
+    def _launch_spans(self, batch: List[WorkItem],
+                      launch_s: float) -> None:
+        """device.launch span per traced item (a fused batch shares one
+        launch, so its members share the timing)."""
+        if self.tracer is None:
+            return
+        end = self.tracer.clock.now()
+        for item in batch:
+            if not item.trace:
+                continue
+            d = self.tracer.record_span(
+                "device.launch", end - launch_s, end, parent=item.trace,
+                attrs={"exe_id": item.exe_id, "batch": len(batch),
+                       "mflops": int(item.cost)})
+            if d is not None:
+                item.trace_spans.append(d)
+
     def _loop(self) -> None:
         pending_flush: Optional[Callable] = None
         pending_items: List[WorkItem] = []
@@ -425,13 +490,25 @@ class DeviceDispatcher:
                 with self._cv:
                     self.deadline_exceeded += len(expired)
             for item in expired:
-                waited_ms = int((now - item.enqueue_t) * 1e3)
+                wait = now - item.enqueue_t
+                waited_ms = int(wait * 1e3)
+                qos = item.tenant.qos if item.tenant else \
+                    constants.DEFAULT_QOS
+                # an expired request still spent its whole life queued:
+                # it counts against the tenant's queue-wait SLO
+                with self._cv:
+                    if item.tenant is not None:
+                        item.tenant.slo_total += 1
+                self._queue_span(item, wait, qos)
+                emeta = {
+                    "error": f"deadline exceeded after {waited_ms}ms "
+                             f"in queue",
+                    "code": "DEADLINE_EXCEEDED",
+                    "queue_wait_ms": waited_ms}
+                if item.trace_spans:
+                    emeta["trace_spans"] = item.trace_spans
                 try:
-                    item.reply("ERROR", {
-                        "error": f"deadline exceeded after {waited_ms}ms "
-                                 f"in queue",
-                        "code": "DEADLINE_EXCEEDED",
-                        "queue_wait_ms": waited_ms}, [])
+                    item.reply("ERROR", emeta, [])
                 except (ConnectionError, OSError):
                     pass
             if expired:
@@ -442,22 +519,42 @@ class DeviceDispatcher:
                 item.dispatch_t = now
                 wait = now - item.enqueue_t
                 self.queue_wait.observe(wait)
-                qos = item.tenant.qos if item.tenant else \
-                    constants.DEFAULT_QOS
+                tenant = item.tenant
+                qos = tenant.qos if tenant else constants.DEFAULT_QOS
+                slo_ms = constants.QOS_QUEUE_WAIT_SLO_MS.get(qos, 500.0)
                 with self._cv:
                     rec = self.per_qos_wait.setdefault(
                         qos, LatencyRecorder())
+                    if tenant is not None:
+                        tenant.slo_total += 1
+                        if wait * 1e3 <= slo_ms:
+                            tenant.slo_good += 1
+                        if item.trace:
+                            tenant.last_trace_id = str(
+                                item.trace.get("trace_id", ""))
+                            self._last_trace_id = tenant.last_trace_id
                 rec.observe(wait)
+                if tenant is not None:
+                    tenant.wait.observe(wait)
+                self._queue_span(item, wait, qos)
             t0 = time.perf_counter()
             try:
                 flush = self.execute_batch(batch, self.peek_next)
             except Exception as e:  # noqa: BLE001 - reply, keep serving
                 flush = None
                 for item in batch:
+                    emeta = {"error": str(e)}
+                    if item.trace_spans:
+                        emeta["trace_spans"] = item.trace_spans
                     try:
-                        item.reply("ERROR", {"error": str(e)}, [])
+                        item.reply("ERROR", emeta, [])
                     except (ConnectionError, OSError):
                         pass
+            else:
+                # launch duration measured before the deferred-flush
+                # overlap below runs (service includes it; the span
+                # should not)
+                self._launch_spans(batch, time.perf_counter() - t0)
             # run the PREVIOUS batch's deferred flush after this batch
             # launched: reply serialization overlaps device compute
             if pending_flush is not None:
@@ -495,8 +592,15 @@ class DeviceDispatcher:
                 t.conn_id: {"qos": t.qos, "weight": t.weight,
                             "queued": len(t.queue),
                             "submitted": t.submitted,
-                            "completed": t.completed}
+                            "completed": t.completed,
+                            "queue_wait": t.wait.snapshot(),
+                            "slo_good": t.slo_good,
+                            "slo_total": t.slo_total,
+                            "slo_ms": constants.QOS_QUEUE_WAIT_SLO_MS
+                            .get(t.qos, 500.0),
+                            "last_trace_id": t.last_trace_id}
                 for t in self._tenants.values()}
+            last_trace = self._last_trace_id
             depth = self._depth
             counters = {"executed": self.executed,
                         "launches": self.launches,
@@ -507,6 +611,7 @@ class DeviceDispatcher:
                        for qos, rec in self.per_qos_wait.items()}
         return dict(counters, **{
             "mode": self.mode,
+            "last_trace_id": last_trace,
             "depth": depth,
             "max_queue_per_tenant": self.max_queue_per_tenant,
             "max_queue_global": self.max_queue_global,

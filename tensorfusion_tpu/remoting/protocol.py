@@ -61,6 +61,20 @@ Version 4 adds QoS-aware dispatch fields, again all additive JSON meta
   (zlib costs more CPU than same-host bytes are worth), remote peers
   get the adaptive path; ``TPF_REMOTING_COMPRESS=1``/``0`` forces
   either everywhere.
+
+Version 5 adds distributed-tracing fields (tensorfusion_tpu/tracing,
+docs/tracing.md), again all additive JSON meta — frame layout
+unchanged, negotiated via HELLO exactly like v3/v4 so v2-v4 peers
+interop untouched:
+
+- EXECUTE: optional ``trace`` — the client's propagated span context
+  ``{"trace_id", "span_id", "sampled"}``.  Only sampled traces ride
+  the wire (head-based sampling at the client root); pre-v5 peers
+  never see the field.
+- EXECUTE_OK / ERROR: optional ``trace_spans`` — the server-side span
+  tree (dispatcher queue wait, device launch, host->device upload,
+  reply flush) as a list of span dicts, carried back so the client
+  assembles one end-to-end trace per request.
 """
 
 from __future__ import annotations
@@ -74,9 +88,9 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 MAGIC = b"TPFR"
-VERSION = 4
-#: frame versions this build can decode (v3/v4 are additive over v2)
-SUPPORTED_VERSIONS = (2, 3, 4)
+VERSION = 5
+#: frame versions this build can decode (v3/v4/v5 are additive over v2)
+SUPPORTED_VERSIONS = (2, 3, 4, 5)
 #: version every HELLO is framed at, so any peer can read it
 HELLO_VERSION = 2
 

@@ -42,6 +42,12 @@ Hardening (beyond the round-1 prototype):
   deadlines, cross-connection micro-batching of compatible requests
   into single device launches, and queue-wait / service-time
   histograms surfaced via INFO and the metrics recorders.
+- **distributed tracing** (protocol v5): EXECUTEs carrying a sampled
+  ``trace`` context get server-side spans — dispatcher queue wait,
+  device launch, host->device upload, reply flush — recorded against
+  the worker's :class:`~tensorfusion_tpu.tracing.Tracer` and shipped
+  back in the reply's ``trace_spans`` for client-side trace assembly
+  (docs/tracing.md).  Untraced requests pay nothing.
 - **snapshot/restore**: resident buffers + the executable cache persist
   to a state dir and re-materialize on another worker — the buffer-level
   half of live migration that the provider ABI's device-level
@@ -66,6 +72,7 @@ import numpy as np
 
 from .. import constants
 from . import protocol
+from ..tracing.core import Tracer
 from .dispatch import BusyError, DeviceDispatcher, WorkItem, qos_weight
 from .protocol import recv_message, send_message
 
@@ -88,7 +95,8 @@ class RemoteVTPUWorker:
                  dispatch_mode: Optional[str] = None,
                  max_queue_per_tenant: Optional[int] = None,
                  max_queue_global: Optional[int] = None,
-                 max_microbatch: Optional[int] = None):
+                 max_microbatch: Optional[int] = None,
+                 tracer: Optional[Tracer] = None):
         self.meter_client = meter_client    # optional VTPUClient
         #: highest wire version this worker speaks; pinning it to 2 makes
         #: the worker byte-faithful to a v2 build (mixed-version tests)
@@ -195,8 +203,13 @@ class RemoteVTPUWorker:
             kwargs["max_queue_global"] = max_queue_global
         if max_microbatch is not None:
             kwargs["max_microbatch"] = max_microbatch
+        #: server-side span recorder (protocol v5).  Spans are only
+        #: created for requests that CARRY a sampled trace context, so
+        #: untraced serving pays nothing.
+        self.tracer = tracer or Tracer(service="remote-worker")
         self.dispatcher = DeviceDispatcher(self._execute_batch,
-                                           mode=mode, **kwargs)
+                                           mode=mode,
+                                           tracer=self.tracer, **kwargs)
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -795,7 +808,8 @@ class RemoteVTPUWorker:
         batch_key = exe_id if batchable and not meta.get("keep_results") \
             and meta.get("arg_shards") is None else None
         item = WorkItem("EXECUTE", meta, buffers, reply, float(mflops),
-                        exe_id, batch_key, deadline_t)
+                        exe_id, batch_key, deadline_t,
+                        trace=self._parse_trace(meta))
         # BUSY rejection only makes sense where the client can cleanly
         # retry: pre-v4 connections, fire-and-forget chains (quiet /
         # keep_results step chains mint ids they immediately depend on)
@@ -810,6 +824,30 @@ class RemoteVTPUWorker:
         except BusyError as e:
             reply("ERROR", {"error": str(e), "code": "BUSY",
                             "retry_after_ms": e.retry_after_ms}, [])
+
+    @staticmethod
+    def _parse_trace(meta) -> Optional[dict]:
+        """Propagated span context from a v5 EXECUTE, or None.  Pre-v5
+        connections never carry the field; a malformed or unsampled
+        context disables tracing for the request rather than failing
+        it (tracing must never break serving)."""
+        if meta.get("_wire_version", 2) < 5:
+            return None
+        trace = meta.get("trace")
+        if not isinstance(trace, dict) or not trace.get("trace_id") \
+                or not trace.get("sampled", True):
+            return None
+        return {"trace_id": str(trace["trace_id"]),
+                "span_id": str(trace.get("span_id", "") or ""),
+                "sampled": True}
+
+    @staticmethod
+    def _traced_meta(item: WorkItem, rmeta: dict) -> dict:
+        """Reply meta with the server-side span tree attached (v5
+        traced requests only)."""
+        if item.trace and item.trace_spans:
+            rmeta = dict(rmeta, trace_spans=list(item.trace_spans))
+        return rmeta
 
     def _inline_args(self, item: WorkItem) -> list:
         """All-inline argument list, consuming any device transfers the
@@ -920,7 +958,10 @@ class RemoteVTPUWorker:
         argsets = []
         for item in items:
             try:
-                argsets.append((item, self._item_args(item)))
+                up0 = self.tracer.clock.now() if item.trace else 0.0
+                args = self._item_args(item)
+                self._upload_span(item, up0, len(args))
+                argsets.append((item, args))
             except KeyError as e:
                 self._safe_reply(item, "ERROR",
                                  {"error": str(e.args[0])}, [])
@@ -952,16 +993,45 @@ class RemoteVTPUWorker:
             for i, (item, _) in enumerate(argsets):
                 sub = leaves[i * n_out:(i + 1) * n_out]
                 try:
+                    f0 = self.tracer.clock.now() if item.trace else 0.0
                     results = [np.asarray(leaf) for leaf in sub]
+                    self._flush_span(item, f0, len(results))
                     self._safe_reply(
                         item, "EXECUTE_OK",
-                        {"n_results": len(results), "microbatched": k},
+                        self._traced_meta(item, {"n_results": len(results),
+                                                 "microbatched": k}),
                         results, compress=True)
                 except Exception as e:  # noqa: BLE001 - exec error
                     log.exception("fused flush failed")
                     self._safe_reply(item, "ERROR", {"error": str(e)}, [])
 
         return flush
+
+    def _upload_span(self, item: WorkItem, start_s: float,
+                     n_args: int) -> None:
+        """worker.upload span: argument resolution + host->device
+        transfer time for one traced item."""
+        if not item.trace:
+            return
+        d = self.tracer.record_span(
+            "worker.upload", start_s, self.tracer.clock.now(),
+            parent=item.trace,
+            attrs={"exe_id": item.exe_id, "args": n_args})
+        if d is not None:
+            item.trace_spans.append(d)
+
+    def _flush_span(self, item: WorkItem, start_s: float,
+                    n_results: int) -> None:
+        """worker.flush span: blocking device->host materialization of
+        one traced item's results (overlapped with the next launch)."""
+        if not item.trace:
+            return
+        d = self.tracer.record_span(
+            "worker.flush", start_s, self.tracer.clock.now(),
+            parent=item.trace,
+            attrs={"exe_id": item.exe_id, "results": n_results})
+        if d is not None:
+            item.trace_spans.append(d)
 
     @staticmethod
     def _safe_reply(item: WorkItem, rkind, rmeta, rbufs,
@@ -1003,6 +1073,7 @@ class RemoteVTPUWorker:
         arg_shards = meta.get("arg_shards") \
             if meta.get("_wire_version", 2) >= 3 else None
         it = iter(buffers)
+        up0 = self.tracer.clock.now() if item.trace else 0.0
         try:
             if sharded is not None:
                 args = self._gather_sharded_args(
@@ -1015,6 +1086,7 @@ class RemoteVTPUWorker:
             self._safe_reply(item, "ERROR",
                              {"error": str(e.args[0])}, [])
             return None
+        self._upload_span(item, up0, len(args))
         if sharded is not None:
             leaves = sharded["fn"](*args)
         elif mlir_exe is not None:
@@ -1093,8 +1165,10 @@ class RemoteVTPUWorker:
                 # entirely (errors above still reply)
                 return None
             self._safe_reply(item, "EXECUTE_OK",
-                             {"result_refs": ids, "shapes": shapes,
-                              "dtypes": dtypes}, [])
+                             self._traced_meta(item,
+                                               {"result_refs": ids,
+                                                "shapes": shapes,
+                                                "dtypes": dtypes}), [])
             return None
         # defer materialization: jax dispatch is async, so the
         # dispatcher launches the next batch before this flush blocks
@@ -1102,10 +1176,14 @@ class RemoteVTPUWorker:
         # k overlaps device compute of k+1
         def flush(_leaves=leaves, _item=item):
             try:
+                f0 = self.tracer.clock.now() if _item.trace else 0.0
                 results = [np.asarray(leaf) for leaf in _leaves]
+                self._flush_span(_item, f0, len(results))
                 self._safe_reply(_item, "EXECUTE_OK",
-                                 {"n_results": len(results)}, results,
-                                 compress=True)
+                                 self._traced_meta(
+                                     _item,
+                                     {"n_results": len(results)}),
+                                 results, compress=True)
             except Exception as e:  # noqa: BLE001 - exec error
                 log.exception("deferred EXECUTE flush failed")
                 self._safe_reply(_item, "ERROR", {"error": str(e)}, [])

@@ -156,11 +156,16 @@ class Scheduler:
     def __init__(self, nodes_fn: Callable[[], List[str]],
                  bind_fn: Callable[[Pod, str], None],
                  failure_handler: Optional[Callable[[Pod, str], None]] = None,
-                 clock: Optional[Clock] = None):
+                 clock: Optional[Clock] = None,
+                 tracer=None):
         self.nodes_fn = nodes_fn
         self.bind_fn = bind_fn
         self.failure_handler = failure_handler
         self.clock = clock or default_clock()
+        #: optional tracing.Tracer: each scheduling cycle records a
+        #: scheduler.schedule span on the pod's lifecycle trace
+        #: (docs/tracing.md) — None disables span recording
+        self.tracer = tracer
         self.plugins: List[Plugin] = []
         self._of_cache: Dict[type, List[Plugin]] = {}
         self._active: "queue.PriorityQueue[_QueuedPod]" = queue.PriorityQueue()
@@ -320,6 +325,23 @@ class Scheduler:
     # -- the scheduling cycle (SURVEY.md §3.3) ----------------------------
 
     def schedule_one(self, pod: Pod) -> Status:
+        """One scheduling cycle, recorded as a ``scheduler.schedule``
+        span on the pod's lifecycle trace when a tracer is wired."""
+        if self.tracer is None:
+            return self._schedule_cycle(pod)
+        from ..tracing import pod_trace_context
+
+        with self.tracer.span("scheduler.schedule",
+                              parent=pod_trace_context(pod),
+                              attrs={"pod": pod.key()}) as span:
+            st = self._schedule_cycle(pod)
+            span.set_attr("code", st.code.name)
+            node = pod.status.nominated_node_name or pod.spec.node_name
+            if node:
+                span.set_attr("node", node)
+            return st
+
+    def _schedule_cycle(self, pod: Pod) -> Status:
         state = CycleState()
         key = pod.key()
 

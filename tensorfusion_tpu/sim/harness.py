@@ -143,6 +143,32 @@ class SimHarness:
             h.update(repr(entry).encode())
         return h.hexdigest()
 
+    # -- virtual-time traces ----------------------------------------------
+
+    def trace_spans(self) -> list:
+        """Every span the control plane recorded this run (admission,
+        scheduling, bind, workload spawn — all stamped in VIRTUAL time
+        via the operator tracer's SimClock)."""
+        return self.op.tracer.finished()
+
+    def trace_digest(self) -> str:
+        """Canonical digest of the exported virtual-time trace — the
+        second determinism fingerprint (same seed => byte-identical
+        trace file, the ``make verify-trace`` contract)."""
+        from ..tracing import trace_digest
+
+        return trace_digest(self.trace_spans())
+
+    def export_trace(self, path: str) -> str:
+        """Write this run's spans as Chrome/Perfetto trace-event JSON
+        (view in ui.perfetto.dev; validate/dump via tools/tpftrace.py)."""
+        from ..tracing import write_trace
+
+        return write_trace(path, self.trace_spans(),
+                           meta={"seed": self.seed,
+                                 "sim_seconds": round(
+                                     self.clock.monotonic(), 3)})
+
     # -- timers -----------------------------------------------------------
 
     def at(self, t_sim: float, fn) -> None:

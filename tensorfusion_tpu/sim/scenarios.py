@@ -25,6 +25,13 @@ from .trace import TraceGenerator
 #: scenario registry: name -> fn(seed, scale) -> result dict
 SCENARIOS: Dict[str, Callable] = {}
 
+#: spans + meta of the most recent scenario run (captured by _result
+#: while the harness is still alive) — ``sim_scenarios.py
+#: --export-trace`` writes them as a Chrome/Perfetto file after the
+#: run; a module global because scenario fns share the (seed, scale)
+#: signature and results must stay JSON-small
+LAST_TRACE: Dict[str, object] = {}
+
 SCALES = {
     # tier-1 / verify-sim: seconds of wall time
     "small": dict(nodes=8, chips=4, workloads=6, replicas=3, churn=10),
@@ -58,11 +65,17 @@ def _result(h: SimHarness, name: str, seed: int, scale: str,
         "wall_seconds": round(_wall_time.perf_counter() - t_wall0, 3),
         "store_events": len(h.events),
         "log_digest": h.log_digest(),
+        "trace_spans": len(h.trace_spans()),
+        "trace_digest": h.trace_digest(),
         "pods_scheduled": h.op.scheduler.scheduled_count,
         "sched_failures": h.op.scheduler.failed_count,
         "pump_exhausted": h.pump_exhausted,
         "invariants": {k: v[:10] for k, v in checks.items()},
     }
+    LAST_TRACE["spans"] = h.trace_spans()
+    LAST_TRACE["meta"] = {"scenario": name, "seed": seed,
+                          "scale": scale,
+                          "sim_seconds": out["sim_seconds"]}
     if extra:
         out.update(extra)
     return out

@@ -1,0 +1,18 @@
+"""tpftrace: end-to-end distributed tracing (docs/tracing.md).
+
+- :mod:`.core` — Span/Tracer, context propagation, head-based sampling.
+- :mod:`.registry` — SPAN_SCHEMA, the declared span catalog tpflint's
+  ``trace-schema`` checker enforces.
+- :mod:`.export` — Chrome/Perfetto trace-event JSON, canonical digests,
+  validation against the registry (``tools/tpftrace.py`` is the CLI).
+"""
+
+from .core import (ENV_TRACE_SAMPLE, Span, Tracer,  # noqa: F401
+                   pod_trace_context)
+from .export import (load_trace, to_chrome, trace_digest,  # noqa: F401
+                     validate, write_trace)
+from .registry import SPAN_SCHEMA  # noqa: F401
+
+__all__ = ["Span", "Tracer", "SPAN_SCHEMA", "ENV_TRACE_SAMPLE",
+           "pod_trace_context", "to_chrome", "write_trace", "load_trace",
+           "trace_digest", "validate"]

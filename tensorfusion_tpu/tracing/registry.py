@@ -1,0 +1,53 @@
+"""Registry of every span name tpu-fusion records.
+
+The single source of truth tpflint's `trace-schema` checker verifies
+``start_span`` / ``record_span`` / ``tracer.span`` sites against —
+exactly the discipline ``metrics/schema.py`` applies to influx series.
+A span name (or attribute key) used anywhere without being declared
+here (and documented in docs/tracing.md's span catalog) fails
+``make lint``; a declared name no site records is dead schema.
+
+Keep this literal — the checker reads it via ``ast``, not import.
+
+Attribute conventions: ``attrs`` lists the keys a site may stamp;
+``error`` is implicitly allowed on every span (the ``with
+tracer.span(...)`` form stamps it on exceptions).
+"""
+
+SPAN_SCHEMA = {
+    # -- remote-vTPU serving path (client -> wire -> dispatcher -> device)
+    "client.remote_jit": {
+        "attrs": ("fn", "busy_retries", "reconnects"),
+    },
+    "client.serialize": {
+        "attrs": ("exe_id", "cached"),
+    },
+    "client.wire": {
+        "attrs": ("exe_id", "deadline_ms", "n_results", "microbatched"),
+    },
+    "dispatcher.queue": {
+        "attrs": ("qos", "tenant", "wait_ms"),
+    },
+    "device.launch": {
+        "attrs": ("exe_id", "batch", "mflops"),
+    },
+    "worker.upload": {
+        "attrs": ("exe_id", "args"),
+    },
+    "worker.flush": {
+        "attrs": ("exe_id", "results"),
+    },
+    # -- control-plane pod lifecycle (admission -> schedule -> bind)
+    "webhook.admit": {
+        "attrs": ("pod", "pool", "qos", "workload"),
+    },
+    "scheduler.schedule": {
+        "attrs": ("pod", "code", "node"),
+    },
+    "scheduler.bind": {
+        "attrs": ("pod", "node", "attempts"),
+    },
+    "workload.spawn": {
+        "attrs": ("workload", "pod"),
+    },
+}

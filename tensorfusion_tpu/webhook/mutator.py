@@ -35,11 +35,16 @@ log = logging.getLogger("tpf.webhook")
 
 class PodMutator:
     def __init__(self, store: ObjectStore, parser: WorkloadParser,
-                 operator_url: str = ""):
+                 operator_url: str = "", tracer=None):
         self.store = store
         self.parser = parser
         self.operator_url = operator_url
         self.mutated_count = 0
+        #: optional tracing.Tracer: admission is the ROOT of a pod's
+        #: lifecycle trace — the webhook.admit span's context is
+        #: stamped onto the pod (ANN_TRACE_CONTEXT) so the scheduler
+        #: and bind spans parent under it (docs/tracing.md)
+        self.tracer = tracer
         #: hot-reloaded GlobalConfig.auto_migration section
         self.auto_migration: dict = {}
         self._counters: dict = {}
@@ -99,6 +104,16 @@ class PodMutator:
                          pod.key(), enabled)
                 return pod
 
+        # pod-lifecycle trace root: the admission span's context rides
+        # the pod annotation so every later stage (scheduler cycle,
+        # bind) joins the same trace
+        span = self.tracer.start_span(
+            "webhook.admit", attrs={"pod": pod.key()}) \
+            if self.tracer is not None else None
+        if span is not None and span.sampled:
+            ann[constants.ANN_TRACE_CONTEXT] = \
+                f"{span.trace_id}:{span.span_id}"
+
         workload = self._ensure_workload(pod, spec)
 
         # canonical annotation contract (scheduler reads these)
@@ -150,6 +165,9 @@ class PodMutator:
                 env.setdefault(constants.ENV_OPERATOR_URL, self.operator_url)
             env.setdefault(constants.ENV_ISOLATION, spec.isolation)
 
+        if span is not None:
+            span.finish(pool=spec.pool, qos=spec.qos,
+                        workload=workload.metadata.name)
         self.mutated_count += 1
         return pod
 

@@ -233,7 +233,7 @@ def worker():
 def test_hello_negotiates_qos_weight(worker):
     dev = RemoteDevice(worker.url, qos=constants.QOS_CRITICAL)
     info = dev.info()
-    assert dev._wire_version == 4
+    assert dev._wire_version == protocol.VERSION   # v5 since tpftrace
     assert dev.qos_weight == constants.QOS_DISPATCH_WEIGHTS["critical"]
     assert info["dispatch"]["mode"] == "wfq"
     # the connection shows up as a tenant with its class
@@ -406,8 +406,11 @@ def test_mixed_version_concurrent_load(worker):
             errors.append(("v3", e))
 
     def v4_client(qos):
+        # pinned to wire v4: a pre-tracing build must keep working
+        # against the v5 worker exactly as before
         try:
-            dev = RemoteDevice(worker.url, qos=qos)
+            dev = RemoteDevice(worker.url, qos=qos,
+                               protocol_version=4)
             remote = dev.remote_jit(lambda x: x * 2.0 + 1.0)
             remote(np.zeros(6, np.float32))
             futs = [remote.submit(np.full(6, float(i), np.float32))

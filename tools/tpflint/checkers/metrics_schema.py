@@ -208,6 +208,15 @@ def _consumer_sites(sf: SourceFile):
             m, f = kws.get("measurement"), kws.get("metric_field")
             if isinstance(m, ast.Constant) and isinstance(f, ast.Constant):
                 yield node, m.value, f.value
+        elif fname == "BurnRateRule":
+            # burn-rate rules consume a good/total counter pair
+            kws = {kw.arg: kw.value for kw in node.keywords}
+            m = kws.get("measurement")
+            for fkey in ("good_field", "total_field"):
+                fv = kws.get(fkey)
+                if isinstance(m, ast.Constant) and \
+                        isinstance(fv, ast.Constant):
+                    yield node, m.value, fv.value
 
 
 def run_project(files: Dict[str, SourceFile], repo_root: str

@@ -1,0 +1,316 @@
+"""trace-schema: span names/attributes cannot drift, spans cannot leak.
+
+The tracing layer (``tensorfusion_tpu/tracing``) is only useful when
+every producer and every consumer agree on span names and attribute
+keys — the same implicit-contract failure mode ``metrics-schema``
+closes for influx series.  ``tracing/registry.py`` SPAN_SCHEMA is the
+registry; this checker verifies, statically:
+
+- every ``tracer.start_span("name", ...)`` / ``tracer.span("name",
+  ...)`` / ``tracer.record_span("name", ...)`` with a literal name
+  uses a declared span, and literal ``attrs={...}`` keys (plus literal
+  keyword args to ``Span.finish(...)`` / ``set_attr("k", ...)`` on the
+  started span) are declared for it (``error`` is implicitly allowed —
+  the context-manager form stamps it on exceptions);
+- declared span names no analyzed file starts are dead schema;
+- every declared span is documented in docs/tracing.md's catalog;
+- **unfinished-span detection**: ``x = tracer.start_span(...)`` whose
+  variable is never ``.finish()``-ed, returned, stored, or passed on
+  within the function leaks the span on every exit path — exactly the
+  bug that silently truncates traces.  (The ``with tracer.span(...)``
+  form is finish-safe by construction; prefer it.)
+
+Fixture trees satisfy the contract by carrying a file whose path ends
+in ``tracing/registry.py``; with no registry in the analyzed set the
+checker is silent.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Finding, SourceFile, iter_functions
+
+CHECK = "trace-schema"
+
+REGISTRY_SUFFIX = "tracing/registry.py"
+DOCS_PATH = os.path.join("docs", "tracing.md")
+
+#: tracer methods that open/record a span; first positional arg is the
+#: span name
+_START_METHODS = {"start_span", "span", "record_span"}
+#: attribute keys implicitly allowed on every span
+_IMPLICIT_ATTRS = {"error"}
+
+
+def parse_schema(sf: SourceFile) -> Optional[Dict[str, Set[str]]]:
+    """{span_name: allowed_attr_keys} from the SPAN_SCHEMA literal."""
+    for node in sf.tree.body:
+        if not isinstance(node, ast.Assign) or not node.targets:
+            continue
+        t = node.targets[0]
+        if not isinstance(t, ast.Name) or t.id != "SPAN_SCHEMA":
+            continue
+        if not isinstance(node.value, ast.Dict):
+            return None
+        schema: Dict[str, Set[str]] = {}
+        for k, v in zip(node.value.keys, node.value.values):
+            if not (isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                    and isinstance(v, ast.Dict)):
+                return None
+            attrs: Set[str] = set()
+            for ek, ev in zip(v.keys, v.values):
+                if isinstance(ek, ast.Constant) and ek.value == "attrs" \
+                        and isinstance(ev, (ast.Tuple, ast.List)):
+                    attrs = {e.value for e in ev.elts
+                             if isinstance(e, ast.Constant)
+                             and isinstance(e.value, str)}
+            schema[k.value] = attrs | _IMPLICIT_ATTRS
+        return schema
+    return None
+
+
+def _schema_line(sf: SourceFile, name: str) -> int:
+    needle = f'"{name}"'
+    for i, line in enumerate(sf.lines, start=1):
+        if needle in line:
+            return i
+    return 1
+
+
+def _span_calls(node: ast.AST):
+    """Yield every ``<x>.start_span/span/record_span(...)`` Call under
+    ``node``, looking through ternaries/boolean operators (the
+    ``s = tracer.start_span(...) if tracer else None`` idiom)."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and \
+                isinstance(n.func, ast.Attribute) and \
+                n.func.attr in _START_METHODS:
+            yield n
+
+
+def _literal_name(call: ast.Call) -> Optional[str]:
+    if call.args and isinstance(call.args[0], ast.Constant) and \
+            isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None
+
+
+def _attr_keys(call: ast.Call) -> Set[str]:
+    """Literal keys of an ``attrs={...}`` / ``attrs=dict(k=...)`` kw."""
+    out: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg != "attrs":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Dict):
+            out |= {k.value for k in v.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+        elif isinstance(v, ast.Call) and \
+                isinstance(v.func, ast.Name) and v.func.id == "dict":
+            out |= {k.arg for k in v.keywords if k.arg}
+    return out
+
+
+def _finish_attr_keys(fn: ast.AST, var_names: Set[str],
+                      span_vars: Dict[str, str]) -> List[Tuple[str, str,
+                                                               int]]:
+    """(span_name, attr_key, line) for ``v.finish(k=...)`` /
+    ``v.set_attr("k", ...)`` calls on known span variables."""
+    out = []
+    for n in ast.walk(fn):
+        if not (isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and isinstance(n.func.value, ast.Name)
+                and n.func.value.id in var_names):
+            continue
+        name = span_vars.get(n.func.value.id, "")
+        if not name:
+            continue
+        if n.func.attr == "finish":
+            for kw in n.keywords:
+                if kw.arg:
+                    out.append((name, kw.arg, n.lineno))
+        elif n.func.attr == "set_attr" and n.args and \
+                isinstance(n.args[0], ast.Constant) and \
+                isinstance(n.args[0].value, str):
+            out.append((name, n.args[0].value, n.lineno))
+    return out
+
+
+def _assigned_spans(fn: ast.AST):
+    """Yield (var_name, call, assign_node) for
+    ``x = <t>.start_span(...)`` assignments (incl. ternary values).
+    Only ``start_span`` — ``span`` is a context manager and
+    ``record_span`` returns an already-closed dict."""
+    for n in ast.walk(fn):
+        if not isinstance(n, ast.Assign) or len(n.targets) != 1:
+            continue
+        target = n.targets[0]
+        if not isinstance(target, ast.Name):
+            continue
+        for call in _span_calls(n.value):
+            if call.func.attr == "start_span":  # type: ignore[union-attr]
+                yield target.id, call, n
+                break
+
+
+def _escapes(fn: ast.AST, var: str, assign_node: ast.AST) -> bool:
+    """True when the span variable is finished, returned, stored on an
+    object, or passed to another call — any of which hands off the
+    finish responsibility."""
+    for n in ast.walk(fn):
+        if n is assign_node:
+            continue
+        # v.finish(...)
+        if isinstance(n, ast.Call) and \
+                isinstance(n.func, ast.Attribute) and \
+                n.func.attr == "finish" and \
+                isinstance(n.func.value, ast.Name) and \
+                n.func.value.id == var:
+            return True
+        # return v / yield v
+        if isinstance(n, (ast.Return, ast.Yield)) and n.value is not None:
+            if any(isinstance(x, ast.Name) and x.id == var
+                   for x in ast.walk(n.value)):
+                return True
+        # self.x = v  (ownership handoff)
+        if isinstance(n, ast.Assign) and \
+                isinstance(n.value, ast.Name) and n.value.id == var:
+            if any(isinstance(t, (ast.Attribute, ast.Subscript))
+                   for t in n.targets):
+                return True
+        # f(v) / obj.m(v): passed on (e.g. used as parent=, collected)
+        if isinstance(n, ast.Call):
+            for arg in list(n.args) + [kw.value for kw in n.keywords]:
+                for x in ast.walk(arg):
+                    if isinstance(x, ast.Name) and x.id == var:
+                        # ...but not the defining call itself
+                        if n is not assign_node:
+                            return True
+    return False
+
+
+def run_project(files: Dict[str, SourceFile], repo_root: str
+                ) -> List[Finding]:
+    registry_sf = None
+    for rel, sf in files.items():
+        if rel.endswith(REGISTRY_SUFFIX):
+            registry_sf = sf
+            break
+    if registry_sf is None:
+        return []
+    schema = parse_schema(registry_sf)
+    findings: List[Finding] = []
+    if schema is None:
+        findings.append(Finding(
+            check=CHECK, path=registry_sf.relpath, line=1,
+            symbol="<module>", key="SPAN_SCHEMA",
+            message="tracing/registry.py must define SPAN_SCHEMA as a "
+                    "literal dict of {span_name: {'attrs': (...)}}"))
+        return findings
+
+    started: Set[str] = set()
+
+    for sf in files.values():
+        if sf is registry_sf:
+            continue
+        contexts = list(iter_functions(sf.tree))[::-1]
+        contexts.append(("<module>", sf.tree))
+        seen: Set[int] = set()
+        seen_assigns: Set[int] = set()
+        for symbol, fn in contexts:
+            span_vars: Dict[str, str] = {}
+            var_names: Set[str] = set()
+            for call in _span_calls(fn):
+                if id(call) in seen:
+                    continue
+                seen.add(id(call))
+                name = _literal_name(call)
+                if name is None:
+                    continue        # dynamic name: skip (rare)
+                started.add(name)
+                if name not in schema:
+                    findings.append(Finding(
+                        check=CHECK, path=sf.relpath, line=call.lineno,
+                        symbol=symbol, key=name,
+                        message=(f"span name {name!r} is not declared "
+                                 f"in tracing/registry.py SPAN_SCHEMA "
+                                 f"— register it (and document it in "
+                                 f"docs/tracing.md) or fix the name")))
+                    continue
+                for key in sorted(_attr_keys(call) - schema[name]):
+                    findings.append(Finding(
+                        check=CHECK, path=sf.relpath, line=call.lineno,
+                        symbol=symbol, key=f"{name}.{key}",
+                        message=(f"span {name!r} stamps attribute "
+                                 f"{key!r} not declared in SPAN_SCHEMA "
+                                 f"— add it to the registry or drop "
+                                 f"the attr")))
+            # attrs stamped later via finish()/set_attr on assigned vars
+            for var, call, assign in _assigned_spans(fn):
+                name = _literal_name(call)
+                if name and name in schema:
+                    span_vars[var] = name
+                    var_names.add(var)
+            for name, key, lineno in _finish_attr_keys(fn, var_names,
+                                                       span_vars):
+                if key not in schema[name]:
+                    findings.append(Finding(
+                        check=CHECK, path=sf.relpath, line=lineno,
+                        symbol=symbol, key=f"{name}.{key}",
+                        message=(f"span {name!r} stamps attribute "
+                                 f"{key!r} (finish/set_attr) not "
+                                 f"declared in SPAN_SCHEMA")))
+            # unfinished spans: started, assigned, never handed off
+            # (innermost context first, so a closure's span is judged
+            # within its own scope and skipped in the enclosing one)
+            for var, call, assign in _assigned_spans(fn):
+                if id(assign) in seen_assigns:
+                    continue
+                seen_assigns.add(id(assign))
+                if not _escapes(fn, var, assign):
+                    name = _literal_name(call) or "<dynamic>"
+                    findings.append(Finding(
+                        check=CHECK, path=sf.relpath,
+                        line=assign.lineno, symbol=symbol,
+                        key=f"unfinished:{var}",
+                        message=(f"span {name!r} assigned to {var!r} "
+                                 f"is never finished on any exit path "
+                                 f"(no .finish()/return/handoff) — "
+                                 f"the span is lost; use `with "
+                                 f"tracer.span(...)` or finish it")))
+
+    for name in sorted(set(schema) - started - _IMPLICIT_ATTRS):
+        if name in started:
+            continue
+        findings.append(Finding(
+            check=CHECK, path=registry_sf.relpath,
+            line=_schema_line(registry_sf, name),
+            symbol="SPAN_SCHEMA", key=name,
+            message=(f"span {name!r} is declared in SPAN_SCHEMA but no "
+                     f"analyzed file records it — dead schema entry")))
+
+    docs = os.path.join(repo_root, DOCS_PATH)
+    if os.path.exists(docs):
+        with open(docs, encoding="utf-8") as f:
+            doc_text = f.read()
+        for name in sorted(schema):
+            if name not in doc_text:
+                findings.append(Finding(
+                    check=CHECK, path=registry_sf.relpath,
+                    line=_schema_line(registry_sf, name),
+                    symbol="SPAN_SCHEMA", key=f"docs:{name}",
+                    message=(f"span {name!r} is not documented in "
+                             f"docs/tracing.md (span catalog)")))
+    else:
+        findings.append(Finding(
+            check=CHECK, path=registry_sf.relpath, line=1,
+            symbol="SPAN_SCHEMA", key="docs-missing",
+            message=f"{DOCS_PATH} is missing — the span registry must "
+                    f"be documented (catalog table, one row per span)"))
+    return findings

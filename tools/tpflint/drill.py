@@ -92,6 +92,19 @@ DRILLS = [
         ["time.time", "injectable Clock"],
     ),
     (
+        "trace-schema",
+        "trace-schema",
+        "tensorfusion_tpu/controllers/core.py",
+        "    def reconcile(self, event):",
+        (
+            "    def _drill_unfinished_span(self, tracer):\n"
+            "        s = tracer.start_span(\"scheduler.schedule\")\n"
+            "        return 1\n"
+            "\n"
+        ),
+        ["never finished", "tracer.span"],
+    ),
+    (
         "unjoined-thread",
         "unjoined-thread",
         "tensorfusion_tpu/controllers/core.py",

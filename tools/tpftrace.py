@@ -1,0 +1,120 @@
+"""tpftrace CLI: dump / filter / diff / validate exported traces.
+
+Works on the Chrome/Perfetto trace-event JSON files the platform
+exports (client-assembled remoting traces, sim virtual-time traces,
+``benchmarks/sim_scenarios.py --export-trace``):
+
+    python -m tools.tpftrace dump TRACE.json [--name N] [--trace ID]
+    python -m tools.tpftrace diff A.json B.json
+    python -m tools.tpftrace check TRACE.json
+    python tools/tpftrace.py --check TRACE.json     # alias
+
+``check`` validates every span name/attribute against the declared
+registry (``tensorfusion_tpu/tracing/registry.py`` SPAN_SCHEMA) and
+the trace's structural integrity — the same contract tpflint's
+``trace-schema`` checker holds source code to, applied to the runtime
+artifact.  Exit 0 = valid.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tensorfusion_tpu.tracing import load_trace, validate  # noqa: E402
+from tensorfusion_tpu.tracing.export import (diff_by_name,  # noqa: E402
+                                             spans_of, trace_digest,
+                                             tree_lines)
+
+
+def _load_spans(path: str, name: str = "", trace: str = ""):
+    doc = load_trace(path)
+    spans = spans_of(doc)
+    if name:
+        spans = [s for s in spans if s.get("name") == name]
+    if trace:
+        spans = [s for s in spans if s.get("trace_id") == trace]
+    return doc, spans
+
+
+def cmd_dump(args) -> int:
+    _, spans = _load_spans(args.file, args.name, args.trace)
+    if args.json:
+        print(json.dumps(spans, indent=1, sort_keys=True))
+    else:
+        for line in tree_lines(spans):
+            print(line)
+        services = sorted({s.get("service", "") for s in spans})
+        print(f"-- {len(spans)} spans, "
+              f"{len({s.get('trace_id') for s in spans})} traces, "
+              f"services: {', '.join(services)}, "
+              f"digest {trace_digest(spans)[:16]}")
+    return 0
+
+
+def cmd_diff(args) -> int:
+    _, a = _load_spans(args.file_a)
+    _, b = _load_spans(args.file_b)
+    rows = diff_by_name(a, b)
+    print(f"{'SPAN':<26}{'N(a)':>6}{'N(b)':>6}{'mean(a)ms':>12}"
+          f"{'mean(b)ms':>12}{'delta ms':>10}")
+    for r in rows:
+        print(f"{r['name']:<26}{r['count_a']:>6}{r['count_b']:>6}"
+              f"{r['mean_ms_a']:>12.3f}{r['mean_ms_b']:>12.3f}"
+              f"{r['delta_ms']:>+10.3f}")
+    return 0
+
+
+def cmd_check(args) -> int:
+    doc, spans = _load_spans(args.file)
+    errors = validate(doc)
+    if errors:
+        for e in errors:
+            print(f"tpftrace check: {e}", file=sys.stderr)
+        print(f"tpftrace check: FAIL ({len(errors)} errors in "
+              f"{args.file})", file=sys.stderr)
+        return 1
+    print(f"tpftrace check: OK ({len(spans)} spans, "
+          f"{len({s.get('trace_id') for s in spans})} traces, "
+          f"digest {trace_digest(spans)[:16]})")
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # `tools/tpftrace.py --check FILE` alias for the subcommand form
+    if argv and argv[0] == "--check":
+        argv = ["check"] + argv[1:]
+    ap = argparse.ArgumentParser(prog="tpftrace", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("dump", help="print a trace as a per-trace tree")
+    p.add_argument("file")
+    p.add_argument("--name", default="", help="only this span name")
+    p.add_argument("--trace", default="", help="only this trace id")
+    p.add_argument("--json", action="store_true",
+                   help="raw span dicts instead of the tree")
+    p.set_defaults(fn=cmd_dump)
+
+    p = sub.add_parser("diff",
+                       help="per-span-name duration comparison")
+    p.add_argument("file_a")
+    p.add_argument("file_b")
+    p.set_defaults(fn=cmd_diff)
+
+    p = sub.add_parser("check",
+                       help="validate a trace against SPAN_SCHEMA")
+    p.add_argument("file")
+    p.set_defaults(fn=cmd_check)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
