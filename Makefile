@@ -8,7 +8,8 @@
 PY := env -u PALLAS_AXON_POOL_IPS python
 
 .PHONY: all native test test-native verify-all verify-repeat \
-	verify-stress verify-sim verify-trace verify-native-sanitized \
+	verify-stress verify-sim verify-trace verify-serving \
+	verify-native-sanitized \
 	check-coverage lint \
 	lint-drill asan \
 	tsan bench bench-tpu test-tpu-live sched-bench webhook-bench remoting-bench \
@@ -76,7 +77,7 @@ verify-repeat: native
 # small N, cache/store coherence after multi-threaded churn — the PR-4
 # control-plane hot path).  Cheaper than verify-repeat (minutes, not an
 # hour), meant to run on every change to locking/queueing code.
-verify-stress: verify-sim verify-trace
+verify-stress: verify-sim verify-trace verify-serving
 	@for i in 1 2 3 4 5; do \
 		echo "=== verify-stress round $$i/5 ==="; \
 		env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -92,8 +93,8 @@ verify-stress: verify-sim verify-trace
 
 # Digital-twin gate (docs/simulation.md): every named fault scenario
 # (rolling node failure, thundering-herd rescale, partition-heal
-# reconvergence, slow-watcher storm, leader flap, skew-lease storm)
-# against the REAL control plane in simulated time — headless, tier-1
+# reconvergence, slow-watcher storm, leader flap, skew-lease storm,
+# serving burst storm) against the REAL control plane in simulated time — headless, tier-1
 # scale, each scenario run twice and the event-log digests compared
 # (any nondeterminism fails), invariants (no lost pods, no double
 # bind, no leaked allocations, convergence) enforced.  Artifact:
@@ -119,6 +120,25 @@ verify-trace:
 		--export-trace /tmp/tpftrace_verify.json
 	$(PY) -m tools.tpftrace check /tmp/tpftrace_verify.json
 	@echo "verify-trace: OK"
+
+# Serving gate (docs/serving.md): the tpfserve suite (paged-attention
+# numerics vs the contiguous cache, engine scheduling/preemption,
+# GENERATE streaming over TCP, metrics/schema conformance), then the
+# engine bench cells headless (continuous-vs-fixed speedup + burst
+# storm; artifact to a temp dir so the checked-in record survives) with
+# a traced GENERATE exported and validated against the span registry.
+# Run on any change to serving/, the GENERATE wire path, or the paged
+# attention math.
+verify-serving:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		python -m pytest tests/test_serving.py -q \
+		-p no:cacheprovider -p no:xdist -p no:randomly
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		TPF_BENCH_RESULTS_DIR=/tmp/tpfserve_verify_results \
+		python benchmarks/burst_serving.py --engine-only --quick \
+		--export-trace /tmp/tpfserve_verify.json
+	$(PY) -m tools.tpftrace check /tmp/tpfserve_verify.json
+	@echo "verify-serving: OK"
 
 test-native:
 	$(MAKE) -C native test

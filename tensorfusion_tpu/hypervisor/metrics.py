@@ -80,6 +80,59 @@ def remote_dispatch_lines(remote_worker, node_name: str,
             ts))
     return lines
 
+def serving_engine_lines(engine, node_name: str, ts: int,
+                         snap=None) -> List[str]:
+    """Influx lines for one tpfserve continuous-batching engine
+    (docs/serving.md): aggregate ``tpf_serving_engine`` (throughput,
+    TTFT quantiles, batch occupancy, KV-block pool utilization and
+    evictions) plus per-tenant ``tpf_serving_tenant`` (tokens, TTFT,
+    admission-wait SLO rollup vs the tenant's QoS tier).  Shared by
+    both recorders like ``remote_dispatch_lines``; pass ``snap`` to
+    reuse an already-taken engine snapshot (the operator recorder also
+    reads exemplar trace ids from it)."""
+    if snap is None:
+        snap = engine.snapshot()
+    tags = {"node": node_name, "engine": snap["name"]}
+    kv = snap["kv"]
+    lines = [encode_line(
+        "tpf_serving_engine", tags,
+        {"tokens_total": snap["tokens"],
+         "tokens_per_s": snap["tokens_per_s"],
+         "steps_total": snap["steps"],
+         "decode_steps_total": snap["decode_steps"],
+         "prefill_chunks_total": snap["prefill_chunks"],
+         "admitted_total": snap["admitted"],
+         "retired_total": snap["retired"],
+         "shed_total": snap["shed"],
+         "busy_rejected_total": snap["busy_rejected"],
+         "preempted_total": snap["preempted"],
+         "waiting": snap["waiting"],
+         "active": snap["active"],
+         "ttft_p50_ms": snap["ttft"]["p50_ms"],
+         "ttft_p99_ms": snap["ttft"]["p99_ms"],
+         "batch_occupancy_pct": snap["batch_occupancy_pct"],
+         "kv_blocks_total": kv["usable"],
+         "kv_blocks_used": kv["used"],
+         "kv_util_pct": kv["utilization_pct"],
+         "kv_evictions_total": kv["evicted_total"]}, ts)]
+    for tenant, t in snap["tenants"].items():
+        if not t["slo_total"] and not t["tokens"]:
+            continue        # tenant never reached admission
+        good_ratio = round(t["slo_good"] / t["slo_total"], 6) \
+            if t["slo_total"] else 1.0
+        lines.append(encode_line(
+            "tpf_serving_tenant",
+            dict(tags, tenant=tenant, qos=t["qos"]),
+            {"tokens_total": t["tokens"],
+             "ttft_p50_ms": t["ttft"]["p50_ms"],
+             "ttft_p99_ms": t["ttft"]["p99_ms"],
+             "slo_good": t["slo_good"],
+             "slo_total": t["slo_total"],
+             "slo_ms": t["slo_ms"],
+             "good_ratio": good_ratio}, ts))
+    return lines
+
+
 #: max influx lines buffered while the operator is unreachable (at 5s
 #: intervals and ~10 lines/tick this is ~an hour of partition)
 PUSH_BACKLOG_LINES = 8192
@@ -166,6 +219,9 @@ class HypervisorMetricsRecorder:
                  "partitions": len(e.partitions)}, ts))
         for rw in self.remote_workers:
             lines.extend(remote_dispatch_lines(rw, self.node_name, ts))
+            if getattr(rw, "engine", None) is not None:
+                lines.extend(serving_engine_lines(rw.engine,
+                                                  self.node_name, ts))
         for w in self.workers.list():
             tags = {"node": self.node_name, "namespace": w.spec.namespace,
                     "worker": w.spec.name, "qos": w.spec.qos,

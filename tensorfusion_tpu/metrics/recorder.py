@@ -189,7 +189,8 @@ class MetricsRecorder:
         # EXEMPLARS from the dispatcher snapshot, so the queue-wait /
         # SLO series link back to example traces (docs/tracing.md).
         if self.remote_workers:
-            from ..hypervisor.metrics import remote_dispatch_lines
+            from ..hypervisor.metrics import (remote_dispatch_lines,
+                                              serving_engine_lines)
             from .encoder import parse_line
 
             for rw in self.remote_workers:
@@ -206,6 +207,26 @@ class MetricsRecorder:
                         exemplar = ex_by_tenant.get(tags.get("tenant"))
                     else:
                         exemplar = last_trace
+                    self.tsdb.insert(measurement, tags, fields, now,
+                                     exemplar=exemplar or None)
+                # tpfserve engine series (docs/serving.md), with
+                # trace-id exemplars linking TTFT/SLO rollups back to
+                # example serving traces — same contract as the
+                # dispatch series above
+                eng = getattr(rw, "engine", None)
+                if eng is None:
+                    continue
+                esnap = eng.snapshot()
+                eng_ex = {tenant: t.get("last_trace_id", "")
+                          for tenant, t in esnap["tenants"].items()}
+                for line in serving_engine_lines(eng, "operator", ts,
+                                                 snap=esnap):
+                    lines.append(line)
+                    measurement, tags, fields, _ = parse_line(line)
+                    if measurement == "tpf_serving_tenant":
+                        exemplar = eng_ex.get(tags.get("tenant"))
+                    else:
+                        exemplar = esnap.get("last_trace_id", "")
                     self.tsdb.insert(measurement, tags, fields, now,
                                      exemplar=exemplar or None)
 

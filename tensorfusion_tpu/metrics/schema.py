@@ -65,6 +65,27 @@ METRICS_SCHEMA = {
         "tags": ("node", "mode", "tenant", "qos"),
         "fields": ("good_total", "total", "slo_ms", "good_ratio"),
     },
+    # tpfserve continuous-batching engine (tensorfusion_tpu/serving,
+    # docs/serving.md): aggregate throughput/latency/occupancy plus
+    # per-tenant TTFT and admission-wait SLO rollups, emitted by
+    # hypervisor/metrics.py serving_engine_lines (both recorders; the
+    # operator-side path attaches trace-id exemplars)
+    "tpf_serving_engine": {
+        "tags": ("node", "engine"),
+        "fields": ("tokens_total", "tokens_per_s", "steps_total",
+                   "decode_steps_total", "prefill_chunks_total",
+                   "admitted_total", "retired_total", "shed_total",
+                   "busy_rejected_total", "preempted_total",
+                   "waiting", "active", "ttft_p50_ms", "ttft_p99_ms",
+                   "batch_occupancy_pct", "kv_blocks_total",
+                   "kv_blocks_used", "kv_util_pct",
+                   "kv_evictions_total"),
+    },
+    "tpf_serving_tenant": {
+        "tags": ("node", "engine", "tenant", "qos"),
+        "fields": ("tokens_total", "ttft_p50_ms", "ttft_p99_ms",
+                   "slo_good", "slo_total", "slo_ms", "good_ratio"),
+    },
     # operator-side recorder (metrics/recorder.py)
     "tpf_chip_alloc": {
         "tags": ("chip", "node", "pool", "generation"),

@@ -48,6 +48,12 @@ tracer — one assembled end-to-end timeline per request, exportable as
 Chrome/Perfetto JSON via ``tools/tpftrace.py`` (docs/tracing.md).
 Pre-v5 workers never see the field; sampling is head-based at the
 root (``TPF_TRACE_SAMPLE``).
+
+Serving (protocol v5, docs/serving.md): :meth:`RemoteDevice.generate`
+drives the worker's continuous-batching engine — one GENERATE request,
+a stream of GENERATE_OK frames (tokens as they land, then the final
+stats frame), BUSY/DEADLINE_EXCEEDED semantics identical to the
+dispatcher path.
 """
 
 from __future__ import annotations
@@ -204,6 +210,11 @@ class RemoteDevice:
         self._send_lock = threading.Lock()
         self._state_lock = threading.Lock()
         self._pending: Dict[int, Future] = {}
+        #: seq -> Queue for STREAMING requests (GENERATE): every frame
+        #: echoing the seq lands on the queue; the entry is dropped on
+        #: the final frame (``done``/ERROR) or on connection loss
+        # guarded by: _state_lock
+        self._streams: Dict[int, object] = {}
         self._seq = 0
         self._mint = itertools.count(1)   # client-minted shard buf ids
         #: frame versions this client build decodes
@@ -261,9 +272,20 @@ class RemoteDevice:
         try:
             while True:
                 kind, meta, bufs = recv_message(sock, accept=self._accept)
+                seq = meta.get("seq")
                 with self._state_lock:
-                    fut = self._pending.pop(meta.get("seq"), None)
-                if fut is not None:
+                    stream = self._streams.get(seq)
+                    if stream is not None:
+                        # streaming request: every frame lands on its
+                        # queue; the final frame retires the entry
+                        if kind == "ERROR" or meta.get("done"):
+                            self._streams.pop(seq, None)
+                        fut = None
+                    else:
+                        fut = self._pending.pop(seq, None)
+                if stream is not None:
+                    stream.put((kind, meta, bufs))
+                elif fut is not None:
                     fut.set_result((kind, meta, bufs))
         except Exception as e:  # noqa: BLE001 - fail this socket's calls
             with self._state_lock:
@@ -272,9 +294,13 @@ class RemoteDevice:
                     # connection's pending map is not ours to fail
                     return
                 pending, self._pending = self._pending, {}
+                streams, self._streams = self._streams, {}
             for fut in pending.values():
                 if not fut.done():
                     fut.set_exception(ConnectionError(str(e)))
+            for q in streams.values():
+                q.put(("ERROR", {"error": str(e),
+                                 "_connection_lost": True}, []))
 
     def close(self) -> None:
         with self._send_lock:
@@ -291,18 +317,26 @@ class RemoteDevice:
             # full timeout_s instead of seeing a prompt ConnectionError.
             with self._state_lock:
                 pending, self._pending = self._pending, {}
+                streams, self._streams = self._streams, {}
             for fut in pending.values():
                 if not fut.done():
                     fut.set_exception(ConnectionError("device closed"))
+            for q in streams.values():
+                q.put(("ERROR", {"error": "device closed",
+                                 "_connection_lost": True}, []))
 
     def _submit(self, kind: str, meta: Dict[str, Any], buffers,
                 compress: bool = True,
-                want_reply: bool = True) -> Optional[Future]:
+                want_reply: bool = True,
+                stream=None) -> Optional[Future]:
         """Send one request without waiting; the returned Future resolves
         to (kind, meta, buffers) when its response arrives.  With
         ``want_reply=False`` the request carries no seq and returns None
         (fire-and-forget — quiet shard PUTs whose failures surface at
-        the EXECUTE that references them)."""
+        the EXECUTE that references them).  With ``stream=`` (a Queue)
+        the request is STREAMING: every reply frame echoing its seq is
+        put on the queue instead of resolving a Future (GENERATE's
+        multi-frame contract); returns None."""
         with self._send_lock:
             if self._sock is None:
                 # connect is deliberately serialized under the send
@@ -311,7 +345,13 @@ class RemoteDevice:
                 # tpflint: disable=transitive-blocking-under-lock
                 self._connect_locked()
             fut: Optional[Future] = None
-            if want_reply:
+            if stream is not None:
+                self._seq += 1
+                seq = self._seq
+                wire_meta = dict(meta, seq=seq)
+                with self._state_lock:
+                    self._streams[seq] = stream
+            elif want_reply:
                 self._seq += 1
                 seq = self._seq
                 wire_meta = dict(meta, seq=seq)
@@ -333,19 +373,28 @@ class RemoteDevice:
                 # one reconnect attempt (worker restarts, idle timeouts);
                 # every other in-flight request died with the old socket
                 with self._state_lock:
-                    if want_reply:
+                    if stream is not None:
+                        self._streams.pop(seq, None)
+                    elif want_reply:
                         self._pending.pop(seq, None)
                     dead, self._pending = self._pending, {}
+                    dead_streams, self._streams = self._streams, {}
                 for f in dead.values():
                     if not f.done():
                         f.set_exception(ConnectionError("connection lost"))
+                for q in dead_streams.values():
+                    q.put(("ERROR", {"error": "connection lost",
+                                     "_connection_lost": True}, []))
                 if self._sock is not None:
                     self._sock.close()
                     self._sock = None
                 # same story as above: reconnect under the serializer
                 # tpflint: disable=transitive-blocking-under-lock
                 self._connect_locked()
-                if want_reply:
+                if stream is not None:
+                    with self._state_lock:
+                        self._streams[seq] = stream
+                elif want_reply:
                     with self._state_lock:
                         self._pending[seq] = fut
                 # retry after reconnect: same frame-serialization story
@@ -387,16 +436,99 @@ class RemoteDevice:
                             arr.dtype.name,
                             device_id=rmeta.get("device_id", 0))
 
-    def _ensure_v3(self, what: str) -> bool:
-        """True when the (established) connection speaks v3; raises with
-        a useful message otherwise."""
+    def _ensure_version(self, need: int, what: str) -> bool:
+        """True when the (established) connection speaks at least
+        ``need``; raises with a useful message otherwise."""
         if self._sock is None:
             self.info()     # dials + negotiates
-        if self._wire_version < 3:
+        if self._wire_version < need:
             raise RemoteExecutionError(
-                f"{what} needs protocol v3 but the worker only "
+                f"{what} needs protocol v{need} but the worker only "
                 f"speaks v{self._wire_version}")
         return True
+
+    def _ensure_v3(self, what: str) -> bool:
+        return self._ensure_version(3, what)
+
+    def generate(self, prompt, max_tokens: int,
+                 eos_id: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 stream: bool = True,
+                 on_token: Optional[Callable[[int], None]] = None
+                 ) -> Dict[str, Any]:
+        """Generate through the worker's continuous-batching engine
+        (tpfserve, docs/serving.md): sends one GENERATE and consumes
+        its GENERATE_OK stream until the final frame.  ``on_token`` is
+        called per token as frames arrive (the streaming TTFT path);
+        the return dict carries the full ``tokens`` list plus the
+        engine's stats (``ttft_ms``, ``finish_reason``, ``n_tokens``).
+
+        Backpressure mirrors the EXECUTE path: a saturated engine's
+        ``BUSY`` is retried with jittered backoff (bounded), a missed
+        admission deadline surfaces as :class:`RemoteDeadlineError`.
+        Needs a protocol-v5 worker with an engine attached."""
+        import queue as _queue
+
+        self._ensure_version(5, "GENERATE (serving engine)")
+        meta: Dict[str, Any] = {
+            "prompt": [int(t) for t in prompt],
+            "max_tokens": int(max_tokens),
+            "stream": bool(stream)}
+        if eos_id is not None:
+            meta["eos_id"] = int(eos_id)
+        if deadline_ms is not None:
+            meta["deadline_ms"] = float(deadline_ms)
+        gspan = None
+        if self.tracer is not None:
+            gspan = self.tracer.start_span(
+                "client.generate", attrs={"tokens": int(max_tokens)})
+            if gspan.sampled:
+                meta["trace"] = gspan.ctx()
+        busy = 0
+        try:
+            while True:
+                q: "_queue.Queue" = _queue.Queue()
+                self._submit("GENERATE", meta, [], stream=q)
+                tokens: List[int] = []
+                try:
+                    while True:
+                        kind, rmeta, _ = q.get(timeout=self.timeout_s)
+                        if kind == "ERROR":
+                            if rmeta.get("_connection_lost"):
+                                raise ConnectionError(
+                                    rmeta.get("error", "connection lost"))
+                            if self.tracer is not None:
+                                self.tracer.adopt(
+                                    rmeta.get("trace_spans") or ())
+                            _raise_reply_error(rmeta)
+                        for t in rmeta.get("tokens") or ():
+                            tokens.append(int(t))
+                            if on_token is not None:
+                                on_token(int(t))
+                        if rmeta.get("done"):
+                            if self.tracer is not None:
+                                self.tracer.adopt(
+                                    rmeta.get("trace_spans") or ())
+                            if gspan is not None:
+                                gspan.finish(
+                                    ttft_ms=rmeta.get("ttft_ms") or 0,
+                                    busy_retries=busy)
+                            return {"tokens": tokens,
+                                    "n_tokens": rmeta.get("n_tokens",
+                                                          len(tokens)),
+                                    "ttft_ms": rmeta.get("ttft_ms"),
+                                    "finish_reason":
+                                        rmeta.get("finish_reason", ""),
+                                    "busy_retries": busy}
+                except RemoteBusyError as e:
+                    busy += 1
+                    if busy > MAX_BUSY_RETRIES:
+                        raise
+                    default_clock().sleep(e.backoff_s(busy))
+        except BaseException as e:
+            if gspan is not None and gspan.end_s is None:
+                gspan.finish(error=f"{type(e).__name__}: {e}"[:200])
+            raise
 
     def snapshot(self, state_dir: str) -> Dict[str, Any]:
         _, meta, _ = self._rpc("SNAPSHOT", {"state_dir": state_dir}, [])

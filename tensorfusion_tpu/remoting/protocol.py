@@ -75,6 +75,21 @@ interop untouched:
   tree (dispatcher queue wait, device launch, host->device upload,
   reply flush) as a list of span dicts, carried back so the client
   assembles one end-to-end trace per request.
+
+Version 5 also carries the serving-engine opcode (tpfserve,
+docs/serving.md) — the first *streaming* request kind:
+
+- GENERATE: ``prompt`` (token ids), ``max_tokens``, optional
+  ``eos_id`` / ``deadline_ms`` (admission deadline — the engine sheds
+  the request with ``DEADLINE_EXCEEDED`` if it cannot start by then) /
+  ``stream`` (default true) / ``trace``.  The worker's continuous-
+  batching engine answers with a SEQUENCE of GENERATE_OK frames, all
+  echoing the request's ``seq``: ``{"tokens": [...], "done": false}``
+  as tokens materialize, then a final ``{"done": true, "n_tokens",
+  "ttft_ms", "finish_reason"}`` (plus ``trace_spans`` for traced
+  requests).  A saturated engine answers ``BUSY`` exactly like the
+  dispatcher path.  Only v5 clients send GENERATE, so pre-v5 peers
+  never see a multi-reply seq.
 """
 
 from __future__ import annotations
@@ -105,14 +120,15 @@ HELLO_VERSION = 2
 
 #: client -> worker request kinds
 REQUEST_KINDS = ("HELLO", "INFO", "COMPILE", "COMPILE_MLIR", "PUT",
-                 "FREE", "FETCH", "EXECUTE", "SNAPSHOT", "RESTORE")
+                 "FREE", "FETCH", "EXECUTE", "GENERATE", "SNAPSHOT",
+                 "RESTORE")
 #: request kinds the python client never sends (COMPILE_MLIR is the
 #: transparent PJRT plugin's path — libtpf_pjrt_remote.cc is the client)
 CLIENT_OPTIONAL_KINDS = ("COMPILE_MLIR",)
 #: worker -> client reply kinds
 REPLY_KINDS = ("HELLO_OK", "INFO_OK", "COMPILE_OK", "PUT_OK", "FREE_OK",
-               "FETCH_OK", "EXECUTE_OK", "SNAPSHOT_OK", "RESTORE_OK",
-               "ERROR")
+               "FETCH_OK", "EXECUTE_OK", "GENERATE_OK", "SNAPSHOT_OK",
+               "RESTORE_OK", "ERROR")
 #: structured ERROR ``code`` values (v4; older clients see plain ERROR)
 ERROR_CODES = ("BUSY", "DEADLINE_EXCEEDED", "needs_compile")
 

@@ -96,7 +96,8 @@ class RemoteVTPUWorker:
                  max_queue_per_tenant: Optional[int] = None,
                  max_queue_global: Optional[int] = None,
                  max_microbatch: Optional[int] = None,
-                 tracer: Optional[Tracer] = None):
+                 tracer: Optional[Tracer] = None,
+                 engine=None):
         self.meter_client = meter_client    # optional VTPUClient
         #: highest wire version this worker speaks; pinning it to 2 makes
         #: the worker byte-faithful to a v2 build (mixed-version tests)
@@ -210,6 +211,20 @@ class RemoteVTPUWorker:
         self.dispatcher = DeviceDispatcher(self._execute_batch,
                                            mode=mode,
                                            tracer=self.tracer, **kwargs)
+        #: optional continuous-batching serving engine
+        #: (tensorfusion_tpu/serving, docs/serving.md): GENERATE
+        #: requests stream through it; its stepper thread starts and
+        #: stops with the worker.  The engine shares the worker's
+        #: tracer unless it brought its own, so serving spans land in
+        #: the same ring the recorders drain.
+        self.engine = engine
+        if engine is not None and getattr(engine, "tracer", None) is None:
+            engine.tracer = self.tracer
+        #: the paged KV pool's fixed physical footprint, charged against
+        #: the resident-HBM budget/meter at start() like any resident
+        #: buffer (released at stop) — the hypervisor's memory metering
+        #: sees the pool exactly like tenant uploads
+        self._engine_pool_bytes = 0
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -359,6 +374,13 @@ class RemoteVTPUWorker:
                                     reply, remap_ids(meta), buffers,
                                     tenant)
                                 continue
+                            if kind == "GENERATE":
+                                # continuous-batching engine: admission
+                                # now, GENERATE_OK frames stream from
+                                # the engine thread as tokens land
+                                outer._handle_generate(
+                                    reply, remap_ids(meta), tenant)
+                                continue
                             if kind in _BARRIER_KINDS:
                                 # these observe execution effects: wait
                                 # for this connection's queued EXECUTEs
@@ -418,6 +440,19 @@ class RemoteVTPUWorker:
 
     def start(self) -> None:
         self.dispatcher.start()
+        if self.engine is not None:
+            pool_bytes = int(getattr(self.engine.runner, "nbytes", 0)
+                             or 0)
+            if pool_bytes:
+                with self._lock:
+                    err = self._admit_resident(pool_bytes)
+                if err:
+                    self.dispatcher.stop()
+                    raise RuntimeError(
+                        f"serving KV pool does not fit the resident-HBM "
+                        f"budget: {err}")
+                self._engine_pool_bytes = pool_bytes
+            self.engine.start()
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         name="tpf-remote-worker",
                                         daemon=True)
@@ -431,6 +466,16 @@ class RemoteVTPUWorker:
         self._server.shutdown()
         self._server.server_close()
         self.dispatcher.stop()
+        if self.engine is not None:
+            self.engine.stop()
+            if self._engine_pool_bytes:
+                with self._lock:
+                    self.resident_bytes = max(
+                        0, self.resident_bytes - self._engine_pool_bytes)
+                    if self.meter_client is not None:
+                        self.meter_client.charge_hbm(
+                            -self._engine_pool_bytes)
+                self._engine_pool_bytes = 0
 
     # -- resident-buffer accounting ------------------------------------
 
@@ -824,6 +869,81 @@ class RemoteVTPUWorker:
         except BusyError as e:
             reply("ERROR", {"error": str(e), "code": "BUSY",
                             "retry_after_ms": e.retry_after_ms}, [])
+
+    def _handle_generate(self, reply, meta, tenant) -> None:
+        """Connection handler side of GENERATE: validate, submit to the
+        continuous-batching engine with a streaming emit callback.  The
+        tenant's HELLO-negotiated QoS class (the webhook's
+        ``tpu-fusion.ai/qos`` annotation, via TPF_REMOTING_QOS) is its
+        admission priority AND its queue-wait SLO tier — the same
+        ladder the dispatcher path uses."""
+        if self.engine is None:
+            reply("ERROR", {"error": "no serving engine attached to "
+                                     "this worker"}, [])
+            return
+        try:
+            prompt = [int(t) for t in meta.get("prompt") or []]
+            max_tokens = int(meta.get("max_tokens", 1) or 1)
+            eos_id = meta.get("eos_id")
+            eos_id = int(eos_id) if eos_id is not None else None
+            deadline_ms = meta.get("deadline_ms")
+            if deadline_ms is not None:
+                deadline_ms = float(deadline_ms)
+        except (TypeError, ValueError) as e:
+            reply("ERROR", {"error": f"bad GENERATE request: {e}"}, [])
+            return
+        stream = bool(meta.get("stream", True))
+        acc: List[int] = []
+
+        def emit(seq, new_tokens, done, info):
+            # engine thread; the reply closure serializes on the
+            # connection's write lock like dispatcher replies do
+            try:
+                if not done:
+                    if stream and new_tokens:
+                        reply("GENERATE_OK",
+                              {"tokens": [int(t) for t in new_tokens],
+                               "done": False}, [])
+                    else:
+                        acc.extend(int(t) for t in new_tokens)
+                    return
+                code = info.get("code")
+                if code:
+                    emeta = {"error": info.get("error",
+                                               "generation failed"),
+                             "code": code,
+                             "queue_wait_ms": info.get("queue_wait_ms",
+                                                       0)}
+                    if seq.trace_spans:
+                        emeta["trace_spans"] = list(seq.trace_spans)
+                    reply("ERROR", emeta, [])
+                    return
+                tokens = [int(t) for t in new_tokens] if stream \
+                    else acc + [int(t) for t in new_tokens]
+                final = {"tokens": tokens, "done": True,
+                         "n_tokens": len(seq.tokens),
+                         "ttft_ms": seq.ttft_ms,
+                         "finish_reason": info.get("finish_reason", "")}
+                if seq.trace_spans:
+                    final["trace_spans"] = list(seq.trace_spans)
+                reply("GENERATE_OK", final, [])
+            except (ConnectionError, OSError):
+                # dead client socket: the engine keeps serving other
+                # tenants; this sequence's remaining tokens are dropped
+                # on the floor at each emit
+                pass
+
+        try:
+            self.engine.submit(prompt, max_tokens,
+                               tenant=tenant.conn_id, qos=tenant.qos,
+                               eos_id=eos_id, deadline_ms=deadline_ms,
+                               emit=emit,
+                               trace=self._parse_trace(meta))
+        except BusyError as e:
+            reply("ERROR", {"error": str(e), "code": "BUSY",
+                            "retry_after_ms": e.retry_after_ms}, [])
+        except ValueError as e:
+            reply("ERROR", {"error": str(e)}, [])
 
     @staticmethod
     def _parse_trace(meta) -> Optional[dict]:
@@ -1241,6 +1361,8 @@ class RemoteVTPUWorker:
                 "n_devices": len(devices),
                 "protocol_version": self.protocol_version,
                 "dispatch": self.dispatcher.snapshot(),
+                "serving": self.engine.snapshot()
+                if self.engine is not None else None,
                 "wire_compression": wire,
                 # full inventory for placement: id + mesh coords (TPUs
                 # expose .coords; CPU/GPU devices report their index)

@@ -1,0 +1,26 @@
+"""tpfserve — continuous-batching serving engine over a paged KV pool.
+
+- :mod:`.kvpool` — block accounting + paged attention (the paged
+  variant of ``llama._attention_decode`` / chunked prefill).
+- :mod:`.engine` — decode-step-granularity continuous batching with
+  QoS admission, deadline shedding and pool preemption.
+- :mod:`.runner` — the device contract: :class:`~.runner.LlamaRunner`
+  (real jax) and :class:`~.runner.FakeRunner` (deterministic, for the
+  digital twin and unit tests).
+
+Architecture and knobs: docs/serving.md.
+"""
+
+from .engine import (DEFAULT_MAX_BATCH, DEFAULT_MAX_WAITING,  # noqa: F401
+                     DEFAULT_PREFILL_CHUNK, Sequence, ServingEngine)
+from .kvpool import (BlockAccount, contiguous_to_paged,  # noqa: F401
+                     init_paged_cache, paged_cache_nbytes,
+                     paged_decode_step, paged_prefill_chunk, pow2_bucket)
+from .runner import FakeRunner, LlamaRunner  # noqa: F401
+
+__all__ = ["ServingEngine", "Sequence", "BlockAccount", "LlamaRunner",
+           "FakeRunner", "init_paged_cache", "paged_decode_step",
+           "paged_prefill_chunk", "contiguous_to_paged",
+           "paged_cache_nbytes", "pow2_bucket",
+           "DEFAULT_MAX_BATCH", "DEFAULT_MAX_WAITING",
+           "DEFAULT_PREFILL_CHUNK"]

@@ -1,0 +1,612 @@
+"""tpfserve: decode-step-granularity continuous batching.
+
+The fused serving loop ROADMAP item 4 asks for: sequences join and
+leave the decode batch at EVERY step (admission on arrival, retirement
+on EOS/max-tokens), prompt prefill is chunked and interleaved with
+decode steps, and every sequence's KV lives in the shared paged pool
+(``serving/kvpool.py``) instead of a private contiguous cache — so one
+device serves many intermittent tenants at a batch occupancy no
+fixed-batch layout can reach.
+
+Scheduling policy, deliberately aligned with the PR-2 dispatcher so a
+tenant's QoS class means the same thing on both paths:
+
+- **admission**: waiting sequences are admitted highest QoS weight
+  first (``constants.QOS_DISPATCH_WEIGHTS``), FIFO within a class,
+  while the pool can hold their prompt and a batch slot is free.  The
+  admission wait is judged against ``constants.QOS_QUEUE_WAIT_SLO_MS``
+  — the same ladder the dispatcher's ``tpf_trace_slo`` rollup uses.
+- **backpressure**: a full waiting queue raises the dispatcher's
+  :class:`~..remoting.dispatch.BusyError` (same ``retry_after_ms``
+  drain estimate), which the worker maps onto the protocol-v4 ``BUSY``
+  code; a request whose ``deadline_ms`` elapses before its prefill
+  starts is shed with ``DEADLINE_EXCEEDED`` — the PR-2 codes, reused.
+- **preemption**: when the pool cannot grow a decoding sequence, the
+  lowest-weight most-recent active sequence is evicted back to the
+  waiting queue (its blocks reclaimed — ``kv_evictions_total``), and
+  recomputes its prefix on re-admission.  Greedy decode is position-
+  deterministic, so the regenerated suffix is identical.
+
+Threading: ``submit()`` is thread-safe (connection handlers call it);
+everything else runs on ONE stepper — either the engine thread
+(:meth:`start`) or an external driver calling :meth:`step` (the
+digital twin's ``serving-burst-storm`` scenario steps the engine under
+``SimClock`` with a :class:`~.runner.FakeRunner`; same-seed runs are
+bit-identical).  Token/done callbacks fire outside every lock.
+
+Observability: ``serving.admit`` / ``serving.prefill_chunk`` /
+``serving.step`` spans for traced sequences (SPAN_SCHEMA,
+docs/tracing.md), and a :meth:`snapshot` the worker's INFO reply and
+the ``tpf_serving_*`` metrics lines are built from
+(``hypervisor/metrics.py:serving_engine_lines``, docs/metrics-schema).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Callable, Dict, List, Optional
+
+from .. import constants
+from ..clock import Clock, default_clock
+from ..remoting.dispatch import BusyError, LatencyRecorder, qos_weight
+from .kvpool import BlockAccount
+
+#: how many sequences may wait for admission before submit() pushes
+#: back with BUSY — deep enough for a burst, shallow enough that queue
+#: wait stays bounded (same philosophy as the dispatcher's queue caps)
+DEFAULT_MAX_WAITING = 64
+#: fused decode batch capacity (power-of-two bucketed by the runner)
+DEFAULT_MAX_BATCH = 8
+#: prompt tokens prefILLED per engine step, across sequences — the
+#: knob that bounds how long a long prompt can stall the decode batch
+DEFAULT_PREFILL_CHUNK = 64
+
+#: sequence states
+WAITING = "waiting"
+PREFILL = "prefill"
+ACTIVE = "active"
+DONE = "done"
+
+#: finish reasons
+FINISH_EOS = "eos"
+FINISH_LENGTH = "length"
+FINISH_SHED = "shed"
+
+
+class Sequence:
+    """One generation request inside the engine."""
+
+    __slots__ = ("sid", "tenant", "qos", "weight", "prompt",
+                 "max_new_tokens", "eos_id", "emit", "trace",
+                 "trace_spans", "arrival_m", "deadline_m", "admitted_m",
+                 "ttft_ms", "state", "prefill_pos", "tokens", "emitted",
+                 "finish_reason", "preemptions")
+
+    def __init__(self, sid: int, tenant: str, qos: str,
+                 prompt: List[int], max_new_tokens: int,
+                 eos_id: Optional[int], emit: Optional[Callable],
+                 trace: Optional[dict], arrival_m: float,
+                 deadline_m: Optional[float]):
+        self.sid = sid
+        self.tenant = tenant
+        self.qos = qos
+        self.weight = qos_weight(qos)
+        self.prompt = list(prompt)
+        self.max_new_tokens = max_new_tokens
+        self.eos_id = eos_id
+        #: emit(seq, new_tokens, done, info) — called OUTSIDE engine
+        #: locks, on the stepper thread
+        self.emit = emit
+        #: propagated trace context ({"trace_id","span_id","sampled"})
+        self.trace = trace
+        #: server-side span dicts, carried back on the final reply
+        self.trace_spans: List[dict] = []
+        self.arrival_m = arrival_m
+        self.deadline_m = deadline_m
+        self.admitted_m: Optional[float] = None
+        self.ttft_ms: Optional[float] = None
+        self.state = WAITING
+        #: prompt tokens already prefilled (over prompt + generated —
+        #: a preempted sequence re-prefills its whole prefix)
+        self.prefill_pos = 0
+        #: generated tokens (greedy), grows one per decode step
+        self.tokens: List[int] = []
+        #: how many of ``tokens`` the emit callback has seen
+        self.emitted = 0
+        self.finish_reason = ""
+        self.preemptions = 0
+
+    def context(self) -> List[int]:
+        """The full prefix to (re)prefill: prompt + generated so far."""
+        return self.prompt + self.tokens
+
+    def context_len(self) -> int:
+        return len(self.prompt) + len(self.tokens)
+
+
+class _TenantStats:
+    __slots__ = ("qos", "tokens", "ttft", "slo_good", "slo_total",
+                 "last_trace_id")
+
+    def __init__(self, qos: str):
+        self.qos = qos
+        self.tokens = 0
+        self.ttft = LatencyRecorder(maxlen=512)
+        self.slo_good = 0
+        self.slo_total = 0
+        self.last_trace_id = ""
+
+
+class ServingEngine:
+    def __init__(self, runner, clock: Optional[Clock] = None,
+                 tracer=None, name: str = "engine0",
+                 max_batch: int = DEFAULT_MAX_BATCH,
+                 prefill_chunk_tokens: int = DEFAULT_PREFILL_CHUNK,
+                 max_waiting: int = DEFAULT_MAX_WAITING):
+        self.runner = runner
+        self.clock = clock or default_clock()
+        #: span recorder (None disables tracing; only sequences that
+        #: CARRY a sampled context record spans, so untraced serving
+        #: pays nothing — same contract as the dispatcher)
+        self.tracer = tracer
+        self.name = name
+        self.max_batch = max(1, max_batch)
+        self.prefill_chunk_tokens = max(1, prefill_chunk_tokens)
+        self.max_waiting = max(1, max_waiting)
+        self.account = BlockAccount(runner.num_blocks, runner.block_size)
+        self._cv = threading.Condition()
+        # guarded by: _cv
+        self._waiting: List[Sequence] = []
+        #: stepper-thread only (never touched by submit)
+        self._running: List[Sequence] = []
+        self._sids = itertools.count(1)
+        self._thread: Optional[threading.Thread] = None
+        # guarded by: _cv
+        self._stopping = False
+        self._start_m = self.clock.monotonic()
+        # -- counters (guarded by: _cv — snapshot() reads them from
+        # other threads; the stepper writes them once per step) --------
+        # guarded by: _cv
+        self.submitted = 0
+        # guarded by: _cv
+        self.admitted = 0
+        # guarded by: _cv
+        self.retired = 0
+        # guarded by: _cv
+        self.shed = 0
+        # guarded by: _cv
+        self.busy_rejected = 0
+        # guarded by: _cv
+        self.preempted = 0
+        # guarded by: _cv
+        self.tokens_generated = 0
+        # guarded by: _cv
+        self.steps = 0
+        # guarded by: _cv
+        self.decode_steps = 0
+        # guarded by: _cv
+        self.prefill_chunks = 0
+        # guarded by: _cv
+        self._occupancy_sum = 0.0
+        # guarded by: _cv
+        self._tenants: Dict[str, _TenantStats] = {}
+        # guarded by: _cv
+        self._last_trace_id = ""
+        #: step-duration reservoir -> the retry_after_ms drain estimate
+        self.step_time = LatencyRecorder(maxlen=512)
+        self.ttft = LatencyRecorder(maxlen=2048)
+
+    # -- submission (any thread) ---------------------------------------
+
+    def submit(self, prompt: List[int], max_new_tokens: int,
+               tenant: str = "local", qos: Optional[str] = None,
+               eos_id: Optional[int] = None,
+               deadline_ms: Optional[float] = None,
+               emit: Optional[Callable] = None,
+               trace: Optional[dict] = None) -> Sequence:
+        """Enqueue one generation request.  Raises
+        :class:`~..remoting.dispatch.BusyError` when the waiting queue
+        is full (the worker maps it to the protocol ``BUSY`` code) and
+        ``ValueError`` for requests that could never fit the pool."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        max_new_tokens = max(1, int(max_new_tokens))
+        total = len(prompt) + max_new_tokens
+        if total > self.account.seq_capacity_tokens():
+            raise ValueError(
+                f"request of {total} tokens exceeds the pool's "
+                f"{self.account.seq_capacity_tokens()}-token sequence "
+                f"capacity")
+        now = self.clock.monotonic()
+        deadline_m = None
+        if deadline_ms is not None:
+            deadline_m = now + float(deadline_ms) / 1e3
+        seq = Sequence(next(self._sids), tenant,
+                       qos or constants.DEFAULT_QOS, prompt,
+                       max_new_tokens, eos_id, emit, trace, now,
+                       deadline_m)
+        with self._cv:
+            if self._stopping:
+                raise ConnectionError("engine stopping")
+            if len(self._waiting) >= self.max_waiting:
+                self.busy_rejected += 1
+                raise BusyError("serving", len(self._waiting),
+                                self._retry_after_ms_locked())
+            self.submitted += 1
+            self._waiting.append(seq)
+            self._cv.notify_all()
+        return seq
+
+    def _retry_after_ms_locked(self) -> int:   # tpflint: holds=_cv
+        """Drain estimate for BUSY replies: backlog x recent step time
+        (same shape as the dispatcher's hint)."""
+        per_step = self.step_time.mean_s() or 0.01
+        backlog = len(self._waiting) + len(self._running)
+        return int(min(max(backlog * per_step * 1e3, 5.0), 5000.0))
+
+    def retry_after_ms(self) -> int:
+        with self._cv:
+            return self._retry_after_ms_locked()
+
+    # -- engine thread --------------------------------------------------
+
+    def start(self) -> None:
+        """Run the stepper on a dedicated thread (worker topology).  A
+        sim/bench driver calls :meth:`step` directly instead."""
+        if self._thread is not None:
+            return
+        with self._cv:
+            self._stopping = False
+        self._thread = threading.Thread(target=self._loop,
+                                        name="tpf-serving-engine",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopping = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                if self._stopping:
+                    return
+            if not self.step():
+                with self._cv:
+                    if self._stopping:
+                        return
+                    self._cv.wait(timeout=0.05)
+
+    # -- the step --------------------------------------------------------
+
+    def step(self) -> bool:
+        """One scheduling round: shed expired, admit, prefill chunks,
+        one fused decode step, retire.  Returns False when there was
+        nothing to do.  Single-stepper only."""
+        now = self.clock.monotonic()
+        events: List[tuple] = []       # (seq, new_tokens, done, info)
+        shed, admitted_seqs = self._admit_locked_phase(now, events)
+        did = bool(shed or admitted_seqs)
+
+        # -- prefill chunks (interleaved with decode) ---------------------
+        budget = self.prefill_chunk_tokens
+        chunks = 0
+        for seq in list(self._running):
+            if budget <= 0:
+                break
+            if seq.state != PREFILL:
+                continue
+            chunks += 1
+            budget -= self._prefill_chunk(seq, events)
+        did = did or chunks > 0
+
+        # -- one fused decode step ----------------------------------------
+        batch = [s for s in self._running if s.state == ACTIVE]
+        decoded = 0
+        if batch:
+            did = True
+            batch = self._grow_or_preempt(batch, events)
+        if batch:
+            t0 = self.clock.monotonic()
+            tokens = [s.tokens[-1] for s in batch]
+            positions = [s.context_len() - 1 for s in batch]
+            tables = [self.account.table(s.sid) for s in batch]
+            nxt = self.runner.decode(tokens, positions, tables)
+            self._step_span(batch, t0)
+            decoded = len(batch)
+            for seq, tok in zip(batch, nxt):
+                seq.tokens.append(int(tok))
+                self._maybe_finish(seq, events)
+
+        # -- book-keeping under the lock ----------------------------------
+        retired = [s for s, _, done, info in events
+                   if done and info.get("finish_reason")]
+        with self._cv:
+            self.steps += 1
+            self.prefill_chunks += chunks
+            if decoded:
+                self.decode_steps += 1
+                self._occupancy_sum += decoded / self.max_batch
+            for seq, toks, done, info in events:
+                # every generated token appears in exactly one event
+                # (incl. the prefill-produced first token), so this is
+                # the engine-level tokens_total
+                self.tokens_generated += len(toks)
+                st = self._tenants.setdefault(seq.tenant,
+                                              _TenantStats(seq.qos))
+                st.tokens += len(toks)
+                if seq.trace:
+                    st.last_trace_id = str(
+                        seq.trace.get("trace_id", ""))
+                    self._last_trace_id = st.last_trace_id
+            self.retired += sum(
+                1 for s in retired if s.finish_reason != FINISH_SHED)
+            self._cv.notify_all()
+        if did:
+            self.step_time.observe(self.clock.monotonic() - now)
+
+        # -- callbacks, outside every lock --------------------------------
+        for seq, toks, done, info in events:
+            if seq.emit is not None:
+                seq.emit(seq, toks, done, info)
+        return did
+
+    def drain(self, timeout_s: float = 60.0) -> bool:
+        """Block until nothing is waiting or running (callers that
+        submitted with callbacks use this as their quiescence barrier).
+        Only meaningful while the engine thread runs."""
+        deadline = self.clock.monotonic() + timeout_s
+        with self._cv:
+            while self._waiting or self._running:
+                if self.clock.monotonic() >= deadline:
+                    return False
+                self._cv.wait(timeout=0.1)
+        return True
+
+    # -- step phases ------------------------------------------------------
+
+    def _admit_locked_phase(self, now: float, events: List[tuple]):
+        """Shed expired waiters, then admit by QoS weight while the
+        pool and the batch have room."""
+        with self._cv:
+            shed = [s for s in self._waiting
+                    if s.deadline_m is not None and now > s.deadline_m]
+            for seq in shed:
+                self._waiting.remove(seq)
+                self.shed += 1
+            # highest weight first, FIFO (arrival, sid) within a class
+            self._waiting.sort(key=lambda s: (-s.weight, s.arrival_m,
+                                              s.sid))
+            admitted: List[Sequence] = []
+            for seq in list(self._waiting):
+                if len(self._running) + len(admitted) >= self.max_batch:
+                    break
+                # room for the whole prompt plus the first generated
+                # token; growth past that is preemption's problem
+                if not self.account.can_fit(seq.context_len() + 1):
+                    continue
+                self.account.ensure(seq.sid, seq.context_len() + 1)
+                self._waiting.remove(seq)
+                admitted.append(seq)
+            for seq in admitted:
+                self.admitted += 1
+                st = self._tenants.setdefault(seq.tenant,
+                                              _TenantStats(seq.qos))
+                wait_ms = (now - seq.arrival_m) * 1e3
+                slo_ms = constants.QOS_QUEUE_WAIT_SLO_MS.get(seq.qos,
+                                                             500.0)
+                st.slo_total += 1
+                if wait_ms <= slo_ms:
+                    st.slo_good += 1
+            for seq in shed:
+                st = self._tenants.setdefault(seq.tenant,
+                                              _TenantStats(seq.qos))
+                st.slo_total += 1
+        for seq in shed:
+            seq.state = DONE
+            seq.finish_reason = FINISH_SHED
+            waited = int((now - seq.arrival_m) * 1e3)
+            events.append((seq, [], True, {
+                "code": "DEADLINE_EXCEEDED",
+                "error": f"deadline exceeded after {waited}ms waiting "
+                         f"for admission",
+                "queue_wait_ms": waited,
+                "finish_reason": FINISH_SHED}))
+        for seq in admitted:
+            seq.state = PREFILL
+            seq.admitted_m = now
+            self._running.append(seq)
+            self._admit_span(seq, now)
+        return shed, admitted
+
+    def _prefill_chunk(self, seq: Sequence, events: List[tuple]) -> int:
+        """Advance one sequence's prefill by one chunk; on completion
+        the first generated token appears (TTFT)."""
+        ctx = seq.context()
+        chunk = min(self.prefill_chunk_tokens,
+                    len(ctx) - seq.prefill_pos)
+        last = seq.prefill_pos + chunk >= len(ctx)
+        t0 = self.clock.monotonic()
+        nxt = self.runner.prefill(
+            ctx[seq.prefill_pos:seq.prefill_pos + chunk],
+            self.account.table(seq.sid), seq.prefill_pos, last=last)
+        self._prefill_span(seq, t0, chunk)
+        seq.prefill_pos += chunk
+        if last:
+            seq.state = ACTIVE
+            if not seq.tokens:
+                # first generation for this sequence: TTFT
+                ttft_s = self.clock.monotonic() - seq.arrival_m
+                seq.ttft_ms = round(ttft_s * 1e3, 3)
+                self.ttft.observe(ttft_s)
+                with self._cv:
+                    st = self._tenants.setdefault(
+                        seq.tenant, _TenantStats(seq.qos))
+                st.ttft.observe(ttft_s)
+                seq.tokens.append(int(nxt))
+                self._maybe_finish(seq, events)
+            # a re-prefilled (preempted) sequence already holds its
+            # generated tokens; the recomputed pages end exactly where
+            # decode left off, so nxt is the token decode would emit —
+            # but it is NOT appended here: the next fused decode step
+            # regenerates it (position-deterministic), keeping the
+            # emit stream strictly ordered
+        return chunk
+
+    def _grow_or_preempt(self, batch: List[Sequence],
+                         events: List[tuple]) -> List[Sequence]:
+        """Every batch member needs pages for its next token; when the
+        pool is exhausted, the lowest-weight most-recent member is
+        evicted back to the waiting queue until the rest fit.  Members
+        are secured highest weight first, so victims always come from
+        the lower tiers — the QoS promise under memory pressure."""
+        kept: List[Sequence] = []
+        for seq in sorted(batch, key=lambda s: (-s.weight, s.arrival_m,
+                                                s.sid)):
+            if seq.state != ACTIVE:
+                continue            # already evicted as a victim below
+            while seq.state == ACTIVE and not self.account.ensure(
+                    seq.sid, seq.context_len() + 1):
+                victims = [s for s in batch
+                           if s is not seq and s.state == ACTIVE
+                           and s not in kept]
+                if not victims:
+                    # nothing left to evict but higher-priority kept
+                    # members: this sequence yields its own pages and
+                    # re-admits when the pool breathes (submit()
+                    # guaranteed it fits an empty pool)
+                    self._preempt(seq)
+                    break
+                self._preempt(min(victims,
+                                  key=lambda s: (s.weight, -s.arrival_m,
+                                                 -s.sid)))
+            if seq.state == ACTIVE:
+                kept.append(seq)
+        # original batch order keeps the fused step deterministic
+        return [s for s in batch if s in kept]
+
+    def _preempt(self, victim: Sequence) -> None:
+        self.account.release(victim.sid, evicted=True)
+        victim.state = WAITING
+        victim.prefill_pos = 0
+        victim.preemptions += 1
+        if victim in self._running:
+            self._running.remove(victim)
+        with self._cv:
+            self.preempted += 1
+            self._waiting.append(victim)
+
+    def _maybe_finish(self, seq: Sequence, events: List[tuple]) -> None:
+        new = seq.tokens[seq.emitted:]
+        done = False
+        if seq.eos_id is not None and seq.tokens and \
+                seq.tokens[-1] == seq.eos_id:
+            done, seq.finish_reason = True, FINISH_EOS
+        elif len(seq.tokens) >= seq.max_new_tokens:
+            done, seq.finish_reason = True, FINISH_LENGTH
+        seq.emitted = len(seq.tokens)
+        if done:
+            seq.state = DONE
+            self._running.remove(seq)
+            self.account.release(seq.sid)
+            events.append((seq, new, True,
+                           {"finish_reason": seq.finish_reason}))
+        elif new:
+            events.append((seq, new, False, {}))
+
+    # -- spans ------------------------------------------------------------
+
+    def _admit_span(self, seq: Sequence, now: float) -> None:
+        """serving.admit: exactly the admission wait the SLO rollup
+        judged, so per-trace attribution and the metric agree."""
+        if self.tracer is None or not seq.trace:
+            return
+        end = self.tracer.clock.now()
+        wait_s = now - seq.arrival_m
+        d = self.tracer.record_span(
+            "serving.admit", end - wait_s, end, parent=seq.trace,
+            attrs={"tenant": seq.tenant, "qos": seq.qos,
+                   "wait_ms": round(wait_s * 1e3, 3),
+                   "prompt_tokens": len(seq.prompt)})
+        if d is not None:
+            seq.trace_spans.append(d)
+
+    def _prefill_span(self, seq: Sequence, t0: float,
+                      tokens: int) -> None:
+        if self.tracer is None or not seq.trace:
+            return
+        end = self.tracer.clock.now()
+        d = self.tracer.record_span(
+            "serving.prefill_chunk",
+            end - (self.clock.monotonic() - t0), end, parent=seq.trace,
+            attrs={"tenant": seq.tenant, "tokens": tokens,
+                   "pos": seq.prefill_pos})
+        if d is not None:
+            seq.trace_spans.append(d)
+
+    def _step_span(self, batch: List[Sequence], t0: float) -> None:
+        """serving.step: one fused decode launch, recorded against
+        every traced member (they share the timing, like a fused
+        dispatcher launch)."""
+        if self.tracer is None:
+            return
+        end = self.tracer.clock.now()
+        dur = self.clock.monotonic() - t0
+        for seq in batch:
+            if not seq.trace:
+                continue
+            d = self.tracer.record_span(
+                "serving.step", end - dur, end, parent=seq.trace,
+                attrs={"batch": len(batch),
+                       "tokens": len(seq.tokens) + 1})
+            if d is not None:
+                seq.trace_spans.append(d)
+
+    # -- observability ----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Stats for INFO replies and the tpf_serving_* metrics lines."""
+        acct = self.account.snapshot()
+        elapsed = max(self.clock.monotonic() - self._start_m, 1e-9)
+        with self._cv:
+            occupancy = (100.0 * self._occupancy_sum / self.decode_steps
+                         if self.decode_steps else 0.0)
+            tenants = {
+                name: {"qos": st.qos, "tokens": st.tokens,
+                       "ttft": st.ttft.snapshot(),
+                       "slo_good": st.slo_good,
+                       "slo_total": st.slo_total,
+                       "slo_ms": constants.QOS_QUEUE_WAIT_SLO_MS.get(
+                           st.qos, 500.0),
+                       "last_trace_id": st.last_trace_id}
+                for name, st in self._tenants.items()}
+            return {
+                "name": self.name,
+                "max_batch": self.max_batch,
+                "prefill_chunk_tokens": self.prefill_chunk_tokens,
+                "waiting": len(self._waiting),
+                "active": len(self._running),
+                "submitted": self.submitted,
+                "admitted": self.admitted,
+                "retired": self.retired,
+                "shed": self.shed,
+                "busy_rejected": self.busy_rejected,
+                "preempted": self.preempted,
+                "tokens": self.tokens_generated,
+                "tokens_per_s": round(self.tokens_generated / elapsed,
+                                      3),
+                "steps": self.steps,
+                "decode_steps": self.decode_steps,
+                "prefill_chunks": self.prefill_chunks,
+                "batch_occupancy_pct": round(occupancy, 3),
+                "ttft": self.ttft.snapshot(),
+                "kv": acct,
+                "last_trace_id": self._last_trace_id,
+                "tenants": tenants,
+            }

@@ -1,0 +1,388 @@
+"""Paged KV-cache block pool for the continuous-batching engine.
+
+The flagship's contiguous cache (``models/llama.py:init_kv_cache``)
+preallocates ``[B, n_kv, max_len, D]`` per tenant: HBM is committed for
+the *worst case* of every sequence, fragments across tenants, and a
+batch can only ever hold the sequences it was allocated for.  This
+module carves ONE physical cache into fixed-size blocks shared by every
+sequence on the device (the vLLM PagedAttention layout, re-derived for
+the grouped-query decode path in ``_attention_decode``):
+
+- :class:`BlockAccount` — the python-side allocator: a free list of
+  block ids, per-owner block tables, occupancy/high-water counters.
+  It is deliberately storage-free so the engine's admission logic and
+  the sim/unit tests run without touching jax.
+- :func:`init_paged_cache` — the device-side storage: per layer,
+  ``[num_blocks, n_kv, block_size, D]`` for K and V.  Block 0 is
+  RESERVED as scratch: padded batch rows (the engine buckets decode
+  batch sizes for compile caching) write their garbage there, and a
+  real sequence's block table never contains it.
+- :func:`paged_decode_step` — the paged variant of
+  ``llama._attention_decode``: one token per sequence, per-sequence
+  positions (ragged — unlike the contiguous path's single scalar
+  ``pos``), K/V gathered through each sequence's block table.  Numerics
+  are bounded against the contiguous path by tests/test_serving.py.
+- :func:`paged_prefill_chunk` — chunked prefill for ONE sequence:
+  processes ``C`` prompt tokens against the pages written so far plus
+  the chunk itself (causal within the chunk), so long prompts
+  interleave with decode steps instead of stalling the fused batch.
+
+Accounting flows into the hypervisor's memory metering exactly like
+the worker's resident buffers: :meth:`BlockAccount.nbytes` is the
+pool's fixed physical footprint, charged once at attach
+(``hypervisor/metrics.py:serving_engine_lines`` reports utilization of
+that committed budget per pass).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+#: block ids below this are scratch (padded batch rows scatter here);
+#: never handed to a sequence
+RESERVED_BLOCKS = 1
+
+
+class BlockAccount:
+    """Free-list allocator + per-owner block tables for the paged pool.
+
+    Storage-free bookkeeping: the engine asks *admission* questions
+    (``can_fit``), grows tables token-by-token (``ensure``), and
+    releases whole owners at retirement (``release``).  All-or-nothing
+    grants — a partially grown table is never left behind by an
+    exhausted pool.  Single-stepper discipline: only the engine thread
+    mutates an account (the engine snapshots counters under its own
+    lock), so there is no lock here.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int,
+                 reserved: int = RESERVED_BLOCKS):
+        if num_blocks <= reserved:
+            raise ValueError(
+                f"pool of {num_blocks} blocks leaves nothing usable "
+                f"past the {reserved} reserved scratch block(s)")
+        if block_size < 1:
+            raise ValueError("block_size must be >= 1")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.reserved = reserved
+        #: lowest-id-first free list: allocation order is deterministic,
+        #: which the sim digest and block-reuse tests rely on
+        self._free: List[int] = sorted(range(reserved, num_blocks),
+                                       reverse=True)
+        self._owned: Dict[object, List[int]] = {}
+        self.peak_used = 0
+        self.total_allocated = 0
+        self.total_released = 0
+        #: blocks reclaimed by engine preemption (a victim sequence
+        #: evicted back to the waiting queue to unblock a higher-QoS
+        #: one) — the ``kv_evictions_total`` metric
+        self.evicted = 0
+
+    # -- capacity ---------------------------------------------------------
+
+    @property
+    def usable_blocks(self) -> int:
+        return self.num_blocks - self.reserved
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.usable_blocks - len(self._free)
+
+    def blocks_for(self, n_tokens: int) -> int:
+        return max(0, math.ceil(n_tokens / self.block_size))
+
+    def seq_capacity_tokens(self) -> int:
+        """Most tokens a single sequence could ever hold."""
+        return self.usable_blocks * self.block_size
+
+    def can_fit(self, n_tokens: int) -> bool:
+        return self.blocks_for(n_tokens) <= len(self._free)
+
+    def nbytes(self, per_block_bytes: int) -> int:
+        return self.num_blocks * per_block_bytes
+
+    # -- allocation -------------------------------------------------------
+
+    def ensure(self, owner: object, n_tokens: int) -> bool:
+        """Grow ``owner``'s table to cover ``n_tokens``; False (and no
+        partial grab) when the pool cannot supply the growth."""
+        table = self._owned.setdefault(owner, [])
+        need = self.blocks_for(n_tokens) - len(table)
+        if need <= 0:
+            return True
+        if need > len(self._free):
+            return False
+        for _ in range(need):
+            table.append(self._free.pop())
+        self.total_allocated += need
+        self.peak_used = max(self.peak_used, self.used_blocks)
+        return True
+
+    def table(self, owner: object) -> List[int]:
+        return list(self._owned.get(owner, ()))
+
+    def release(self, owner: object, evicted: bool = False) -> int:
+        """Return all of ``owner``'s blocks to the pool (retirement or
+        preemption); returns the count reclaimed."""
+        table = self._owned.pop(owner, None)
+        if not table:
+            return 0
+        self._free.extend(table)
+        # keep the lowest-id-first discipline across reuse
+        self._free.sort(reverse=True)
+        self.total_released += len(table)
+        if evicted:
+            self.evicted += len(table)
+        return len(table)
+
+    def utilization_pct(self) -> float:
+        if not self.usable_blocks:
+            return 0.0
+        return round(100.0 * self.used_blocks / self.usable_blocks, 3)
+
+    def snapshot(self) -> Dict[str, float]:
+        return {"num_blocks": self.num_blocks,
+                "block_size": self.block_size,
+                "usable": self.usable_blocks,
+                "used": self.used_blocks,
+                "free": self.free_blocks,
+                "peak_used": self.peak_used,
+                "owners": len(self._owned),
+                "allocated_total": self.total_allocated,
+                "released_total": self.total_released,
+                "evicted_total": self.evicted,
+                "utilization_pct": self.utilization_pct()}
+
+
+# -- device-side storage + paged attention ---------------------------------
+#
+# jax imports stay inside the functions: BlockAccount (and the engine
+# with a FakeRunner) must be importable without initializing a backend.
+
+
+def init_paged_cache(config, num_blocks: int, block_size: int) -> Dict:
+    """Paged KV storage: per layer ``[num_blocks, n_kv, block_size, D]``
+    for K and V.  One physical pool serves every sequence; block 0 is
+    scratch (see module docstring).  ``config.kv_quant`` is not paged
+    yet — the int8 cache's per-(token, head) scales need a third pool
+    per layer, deferred until a bench motivates it."""
+    import jax.numpy as jnp
+
+    if getattr(config, "kv_quant", False):
+        raise ValueError("paged KV cache does not support kv_quant yet "
+                         "(use the contiguous int8 cache)")
+    shape = (num_blocks, config.n_kv_heads, block_size, config.head_dim)
+    return {
+        "k": [jnp.zeros(shape, config.dtype)
+              for _ in range(config.n_layers)],
+        "v": [jnp.zeros(shape, config.dtype)
+              for _ in range(config.n_layers)],
+    }
+
+
+def paged_cache_nbytes(config, num_blocks: int, block_size: int) -> int:
+    """Physical footprint of the pool without materializing it."""
+    import jax.numpy as jnp
+
+    itemsize = jnp.dtype(config.dtype).itemsize
+    per_block = config.n_kv_heads * block_size * config.head_dim * itemsize
+    return 2 * config.n_layers * num_blocks * per_block
+
+
+def _rope_at(x, theta: float, pos):
+    """Rotary embedding at explicit per-row positions.
+
+    ``x``: ``[..., H, D]`` where the leading axes carry one position
+    each; ``pos``: int array matching those leading axes.  The
+    pair-interleave convention matches ``llama._rope`` exactly (the
+    numerics tests depend on it)."""
+    import jax.numpy as jnp
+
+    d = x.shape[-1]
+    pos = jnp.asarray(pos, jnp.float32)
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = pos[..., None, None] * freqs          # [..., 1, D/2]
+    cos = jnp.cos(angles).astype(x.dtype)
+    sin = jnp.sin(angles).astype(x.dtype)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    return jnp.stack([x1 * cos - x2 * sin,
+                      x1 * sin + x2 * cos], axis=-1).reshape(x.shape)
+
+
+def paged_decode_step(params: Dict, token, cache: Dict, block_tables,
+                      pos, config):
+    """One decode step for ``B`` sequences sharing the paged pool.
+
+    ``token``: ``[B]`` int32 — each sequence's latest token (not yet in
+    the cache); ``block_tables``: ``[B, M]`` int32 rows of pool block
+    ids (pad with 0 — masked out because padded positions exceed
+    ``pos``); ``pos``: ``[B]`` int32 — the cache index each token is
+    written at (== tokens already cached), per sequence, RAGGED.
+    Returns ``(logits [B, vocab] f32, updated cache)``.
+
+    The math is ``llama._attention_decode`` with the contiguous
+    ``[B, n_kv, T, D]`` slab replaced by a gather of each sequence's
+    blocks: GQA stays grouped (no rep-times cache copy), softmax in
+    f32, per-sequence causal mask ``index <= pos``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import llama as _llama
+
+    b = token.shape[0]
+    m = block_tables.shape[1]
+    bs = cache["k"][0].shape[2]
+    hd = config.head_dim
+    n_kv = config.n_kv_heads
+    rep = config.n_heads // n_kv
+    scale = hd ** -0.5
+
+    pos = pos.astype(jnp.int32)
+    block_tables = block_tables.astype(jnp.int32)
+    rows = jnp.arange(b)
+    blk = block_tables[rows, pos // bs]            # [B]
+    slot = pos % bs                                # [B]
+    # key index k of the gathered [M * bs] axis maps to cache position k
+    key_mask = jnp.arange(m * bs)[None, :] <= pos[:, None]
+
+    x = params["tok_emb"][token]                   # [B, dim]
+    new_cache: Dict[str, list] = {"k": [], "v": []}
+    for i, layer in enumerate(params["layers"]):
+        p = layer["attn"]
+        h = _llama._rms_norm(x, layer["attn_norm"], config.norm_eps)
+        q = _llama._mm(h, p["wq"]).reshape(b, config.n_heads, hd)
+        k = _llama._mm(h, p["wk"]).reshape(b, n_kv, hd)
+        v = _llama._mm(h, p["wv"]).reshape(b, n_kv, hd)
+        q = _rope_at(q, config.rope_theta, pos)
+        k = _rope_at(k, config.rope_theta, pos)
+        # scatter this step's K/V into each sequence's current block
+        # (two advanced indices around the head slice put the batch
+        # axis first: the set value is [B, n_kv, D])
+        ck = cache["k"][i].at[blk, :, slot, :].set(
+            k.astype(cache["k"][i].dtype))
+        cv = cache["v"][i].at[blk, :, slot, :].set(
+            v.astype(cache["v"][i].dtype))
+        # gather each sequence's pages: [B, M, n_kv, bs, D] ->
+        # [B, n_kv, M*bs, D]
+        kk = ck[block_tables].transpose(0, 2, 1, 3, 4) \
+            .reshape(b, n_kv, m * bs, hd)
+        vv = cv[block_tables].transpose(0, 2, 1, 3, 4) \
+            .reshape(b, n_kv, m * bs, hd)
+        qg = q.reshape(b, n_kv, rep, hd)
+        scores = jnp.einsum("bgrd,bgkd->bgrk", qg, kk) * scale
+        scores = jnp.where(key_mask[:, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        out = jnp.einsum("bgrk,bgkd->bgrd", probs.astype(vv.dtype), vv)
+        x = x + _llama._mm(out.reshape(b, config.n_heads * hd), p["wo"])
+        x = x + _llama._mlp(
+            layer["mlp"],
+            _llama._rms_norm(x, layer["mlp_norm"], config.norm_eps))
+        new_cache["k"].append(ck)
+        new_cache["v"].append(cv)
+    x = _llama._rms_norm(x, params["final_norm"], config.norm_eps)
+    logits = _llama._mm(x, params["lm_head"]).astype(jnp.float32)
+    return logits, new_cache
+
+
+def paged_prefill_chunk(params: Dict, tokens, cache: Dict, block_table,
+                        start_pos, config):
+    """Prefill ``C`` prompt tokens of ONE sequence into its pages.
+
+    ``tokens``: ``[C]`` int32; ``block_table``: ``[M]`` int32 (the
+    sequence's pages, padded with 0); ``start_pos``: scalar int32 —
+    tokens already cached (0 for the first chunk; traced, so chunk
+    position does not recompile).  Attends causally over the pages
+    written so far plus the chunk itself.  Returns ``(last-position
+    logits [vocab] f32, updated cache)`` — the logits only matter on
+    the final chunk of the prompt.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import llama as _llama
+
+    c = tokens.shape[0]
+    m = block_table.shape[0]
+    bs = cache["k"][0].shape[2]
+    hd = config.head_dim
+    n_kv = config.n_kv_heads
+    rep = config.n_heads // n_kv
+    scale = hd ** -0.5
+
+    start_pos = jnp.asarray(start_pos, jnp.int32)
+    block_table = block_table.astype(jnp.int32)
+    positions = start_pos + jnp.arange(c, dtype=jnp.int32)   # [C]
+    blk = block_table[positions // bs]
+    slot = positions % bs
+    # causal over history + chunk: key index k visible to query c when
+    # k <= start_pos + c (key indices enumerate the gathered pages)
+    key_mask = jnp.arange(m * bs)[None, :] <= positions[:, None]
+
+    x = params["tok_emb"][tokens]                  # [C, dim]
+    new_cache: Dict[str, list] = {"k": [], "v": []}
+    for i, layer in enumerate(params["layers"]):
+        p = layer["attn"]
+        h = _llama._rms_norm(x, layer["attn_norm"], config.norm_eps)
+        q = _llama._mm(h, p["wq"]).reshape(c, config.n_heads, hd)
+        k = _llama._mm(h, p["wk"]).reshape(c, n_kv, hd)
+        v = _llama._mm(h, p["wv"]).reshape(c, n_kv, hd)
+        q = _rope_at(q, config.rope_theta, positions)
+        k = _rope_at(k, config.rope_theta, positions)
+        ck = cache["k"][i].at[blk, :, slot, :].set(
+            k.astype(cache["k"][i].dtype))
+        cv = cache["v"][i].at[blk, :, slot, :].set(
+            v.astype(cache["v"][i].dtype))
+        kk = ck[block_table].transpose(1, 0, 2, 3).reshape(n_kv, m * bs,
+                                                           hd)
+        vv = cv[block_table].transpose(1, 0, 2, 3).reshape(n_kv, m * bs,
+                                                           hd)
+        qg = q.reshape(c, n_kv, rep, hd)
+        scores = jnp.einsum("cgrd,gkd->cgrk", qg, kk) * scale
+        scores = jnp.where(key_mask[:, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        out = jnp.einsum("cgrk,gkd->cgrd", probs.astype(vv.dtype), vv)
+        x = x + _llama._mm(out.reshape(c, config.n_heads * hd), p["wo"])
+        x = x + _llama._mlp(
+            layer["mlp"],
+            _llama._rms_norm(x, layer["mlp_norm"], config.norm_eps))
+        new_cache["k"].append(ck)
+        new_cache["v"].append(cv)
+    x = _llama._rms_norm(x[-1], params["final_norm"], config.norm_eps)
+    logits = _llama._mm(x, params["lm_head"]).astype(jnp.float32)
+    return logits, new_cache
+
+
+def pow2_bucket(n: int, lo: int = 1) -> int:
+    """Smallest power of two >= max(n, lo) — the compile-cache bucket
+    for decode batch sizes and block-table widths."""
+    b = max(lo, 1)
+    while b < n:
+        b <<= 1
+    return b
+
+
+def contiguous_to_paged(cache: Dict, paged: Dict, table: List[int],
+                        n_tokens: int, block_size: int) -> Dict:
+    """Copy a contiguous cache's first ``n_tokens`` into pool pages
+    (migration of a legacy fixed-batch tenant onto the pool; also the
+    cross-check the numerics tests use).  ``cache``: one sequence's
+    contiguous view ``[1, n_kv, T, D]`` per layer."""
+    for i in range(len(paged["k"])):
+        for j, blk in enumerate(table):
+            lo = j * block_size
+            hi = min(lo + block_size, n_tokens)
+            if lo >= hi:
+                break
+            span = hi - lo
+            paged["k"][i] = paged["k"][i].at[blk, :, :span, :].set(
+                cache["k"][i][0, :, lo:hi, :])
+            paged["v"][i] = paged["v"][i].at[blk, :, :span, :].set(
+                cache["v"][i][0, :, lo:hi, :])
+    return paged

@@ -1,0 +1,180 @@
+"""Model runners: the engine's device-side contract.
+
+The engine (``serving/engine.py``) schedules *tokens*; a runner turns
+scheduled work into next tokens against the paged pool it owns:
+
+- ``block_size`` / ``num_blocks`` — the pool geometry the engine's
+  :class:`~.kvpool.BlockAccount` mirrors;
+- ``prefill(tokens, table, start_pos, last)`` — one prompt chunk of
+  ONE sequence into its pages; returns the first generated token when
+  ``last`` (greedy argmax over the final position's logits);
+- ``decode(tokens, positions, tables)`` — one fused decode step for
+  the whole batch; returns each sequence's next token.
+
+:class:`LlamaRunner` is the real thing (jax, ``kvpool`` paged
+attention, compile-cache bucketing); :class:`FakeRunner` is a
+dependency-free deterministic stepper — the digital twin's
+``serving-burst-storm`` scenario and the engine unit tests drive the
+REAL engine through it in virtual time without a jax backend, the same
+real-code-fake-edges discipline ``sim/harness.py`` applies to the
+control plane.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from . import kvpool
+
+
+class LlamaRunner:
+    """Paged-cache serving runner for the llama flagship.
+
+    Owns the device pool and a compile cache of jitted step programs:
+    decode compiles once per ``(batch-bucket, table-width-bucket)``
+    (both power-of-two padded — pad rows scatter into the reserved
+    scratch block and their outputs are dropped), prefill once per
+    ``(chunk-len, table-width-bucket)``.  Greedy argmax runs inside the
+    jit so only int32 tokens cross the host boundary per step.
+    """
+
+    def __init__(self, params: Dict, config, num_blocks: int = 64,
+                 block_size: int = 8):
+        import jax  # noqa: F401 - fail fast if jax is broken
+
+        self.params = params
+        self.config = config
+        self.block_size = block_size
+        self.num_blocks = num_blocks
+        self.cache = kvpool.init_paged_cache(config, num_blocks,
+                                             block_size)
+        self.nbytes = kvpool.paged_cache_nbytes(config, num_blocks,
+                                                block_size)
+        self._decode_fns: Dict[Tuple[int, int], object] = {}
+        self._prefill_fns: Dict[Tuple[int, int], object] = {}
+        #: the engine is a single stepper, but warmup() may race the
+        #: engine thread on the compile-cache dicts
+        self._lock = threading.Lock()
+
+    # -- jitted programs -------------------------------------------------
+
+    def _decode_fn(self, b: int, m: int):
+        with self._lock:
+            fn = self._decode_fns.get((b, m))
+        if fn is not None:
+            return fn
+        import jax
+
+        def greedy(params, token, cache, tables, pos,
+                   config=self.config):
+            import jax.numpy as jnp
+
+            logits, cache = kvpool.paged_decode_step(
+                params, token, cache, tables, pos, config)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+        fn = jax.jit(greedy)
+        with self._lock:
+            self._decode_fns[(b, m)] = fn
+        return fn
+
+    def _prefill_fn(self, c: int, m: int):
+        with self._lock:
+            fn = self._prefill_fns.get((c, m))
+        if fn is not None:
+            return fn
+        import jax
+
+        def greedy(params, tokens, cache, table, start_pos,
+                   config=self.config):
+            import jax.numpy as jnp
+
+            logits, cache = kvpool.paged_prefill_chunk(
+                params, tokens, cache, table, start_pos, config)
+            return jnp.argmax(logits).astype(jnp.int32), cache
+
+        fn = jax.jit(greedy)
+        with self._lock:
+            self._prefill_fns[(c, m)] = fn
+        return fn
+
+    # -- engine contract -------------------------------------------------
+
+    def prefill(self, tokens: List[int], table: List[int],
+                start_pos: int, last: bool = True) -> Optional[int]:
+        import numpy as np
+
+        c = len(tokens)
+        m = kvpool.pow2_bucket(len(table), lo=4)
+        tab = np.zeros((m,), np.int32)
+        tab[:len(table)] = table
+        fn = self._prefill_fn(c, m)
+        nxt, self.cache = fn(self.params, np.asarray(tokens, np.int32),
+                             self.cache, tab, np.int32(start_pos))
+        return int(nxt) if last else None
+
+    def decode(self, tokens: List[int], positions: List[int],
+               tables: List[List[int]]) -> List[int]:
+        import numpy as np
+
+        b = len(tokens)
+        bp = kvpool.pow2_bucket(b)
+        m = kvpool.pow2_bucket(max(len(t) for t in tables), lo=4)
+        tab = np.zeros((bp, m), np.int32)
+        for i, t in enumerate(tables):
+            tab[i, :len(t)] = t
+        tok = np.zeros((bp,), np.int32)
+        tok[:b] = tokens
+        pos = np.zeros((bp,), np.int32)
+        pos[:b] = positions
+        fn = self._decode_fn(bp, m)
+        nxt, self.cache = fn(self.params, tok, self.cache, tab, pos)
+        return [int(x) for x in np.asarray(nxt)[:b]]
+
+    def warmup(self, max_batch: int, prompt_len: int,
+               chunk: int) -> None:
+        """Pre-compile the buckets a serving shape will hit, so the
+        first tenant's TTFT is not an XLA compile."""
+        blocks = self.num_blocks - kvpool.RESERVED_BLOCKS
+        m = min(blocks, kvpool.pow2_bucket(
+            (prompt_len + chunk) // self.block_size + 1))
+        for c in {min(chunk, prompt_len), prompt_len % chunk or chunk}:
+            if c > 0:
+                self._prefill_fn(c, kvpool.pow2_bucket(m, lo=4))
+        bp = 1
+        while bp <= kvpool.pow2_bucket(max_batch):
+            self._decode_fn(bp, kvpool.pow2_bucket(m, lo=4))
+            bp <<= 1
+
+
+class FakeRunner:
+    """Deterministic arithmetic stepper (no jax): the next token is a
+    pure function of (previous token, position), so a preempted and
+    re-prefilled sequence reproduces its exact suffix — the property
+    the engine's no-lost-sequences invariant leans on."""
+
+    def __init__(self, num_blocks: int = 64, block_size: int = 4,
+                 vocab: int = 251):
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.vocab = vocab
+        self.nbytes = 0
+        self.prefill_calls = 0
+        self.decode_calls = 0
+
+    def _next(self, token: int, pos: int) -> int:
+        return (token * 31 + pos * 7 + 3) % self.vocab
+
+    def prefill(self, tokens: List[int], table: List[int],
+                start_pos: int, last: bool = True) -> Optional[int]:
+        self.prefill_calls += 1
+        if not last:
+            return None
+        return self._next(tokens[-1], start_pos + len(tokens) - 1)
+
+    def decode(self, tokens: List[int], positions: List[int],
+               tables: List[List[int]]) -> List[int]:
+        self.decode_calls += 1
+        return [self._next(t, p) for t, p in zip(tokens, positions)]

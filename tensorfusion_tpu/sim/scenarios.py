@@ -43,6 +43,20 @@ SCALES = {
                   churn=4000),
 }
 
+#: serving-burst-storm shapes (the engine scenario has its own axes:
+#: intermittent tenants, per-tenant burst size, pool geometry)
+SERVING_SCALES = {
+    # deliberately under-provisioned pools/queues: the storm must
+    # exercise BUSY rejection, deadline shedding and block-pool
+    # preemption, not just the happy path
+    "small": dict(tenants=48, reqs=2, prompt=8, tokens=6, batch=8,
+                  blocks=25, chunk=8, waiting=12, window_s=0.8),
+    "medium": dict(tenants=300, reqs=2, prompt=12, tokens=8, batch=16,
+                   blocks=65, chunk=16, waiting=24, window_s=5.0),
+    "large": dict(tenants=2000, reqs=3, prompt=16, tokens=12, batch=32,
+                  blocks=129, chunk=32, waiting=48, window_s=20.0),
+}
+
 
 def scenario(name: str):
     def register(fn):
@@ -291,6 +305,140 @@ def leader_flap(seed: int = 0, scale: str = "small") -> dict:
         result["invariants"]["leader"] = violations
         result["ok"] = result["ok"] and not violations
         return result
+
+
+@scenario("serving-burst-storm")
+def serving_burst_storm(seed: int = 0, scale: str = "small") -> dict:
+    """Hundreds of intermittent tenants burst GENERATE requests at the
+    REAL continuous-batching engine (tensorfusion_tpu/serving) under
+    SimClock — the twin analog of benchmarks/burst_serving.py's
+    wake-from-zero shape, at a tenant count wall-clock benches cannot
+    touch.  The engine is stepped cooperatively with a deterministic
+    FakeRunner (one decode step costs 1 sim-ms); arrivals, QoS mix,
+    prompt/token lengths all flow from the seed.  Invariants: NO LOST
+    SEQUENCES (every submission is retired, shed with a deadline code,
+    or BUSY-rejected at submit — nothing vanishes) and the KV block
+    pool fully reclaimed at quiescence."""
+    import hashlib
+    import json as _json
+    import random as _random
+
+    from ..remoting.dispatch import BusyError
+    from ..serving.engine import ServingEngine
+    from ..serving.runner import FakeRunner
+    from ..tracing import Tracer
+    from ..tracing.export import trace_digest
+    from .clock import SimClock
+
+    p = SERVING_SCALES[scale]
+    t0 = _wall_time.perf_counter()
+    clock = SimClock()
+    tracer = Tracer(service="serving-sim", clock=clock, id_prefix="sb")
+    rng = _random.Random(seed)
+    runner = FakeRunner(num_blocks=p["blocks"], block_size=4)
+    eng = ServingEngine(runner, clock=clock, tracer=tracer,
+                        name="sim-engine", max_batch=p["batch"],
+                        prefill_chunk_tokens=p["chunk"],
+                        max_waiting=p["waiting"])
+    events: list = []
+    outcomes = {"done": 0, "shed": 0, "busy": 0}
+
+    def emit(seq, toks, done, info):
+        if done:
+            key = "shed" if info.get("code") else "done"
+            outcomes[key] += 1
+            events.append((round(clock.monotonic(), 6), key,
+                           seq.tenant, info.get("finish_reason")
+                           or info.get("code"), len(seq.tokens)))
+
+    # seeded burst schedule: each tenant wakes at a random instant and
+    # fires a short burst of requests (intermittent, mostly idle)
+    arrivals = []
+    for i in range(p["tenants"]):
+        tenant = f"tenant-{i:04d}"
+        qos = ("low", "medium", "high", "critical")[rng.randrange(4)]
+        t_wake = rng.random() * p["window_s"]
+        for j in range(p["reqs"]):
+            prompt = [rng.randrange(1, 97)
+                      for _ in range(4 + rng.randrange(p["prompt"]))]
+            arrivals.append((round(t_wake + j * 0.02, 6), tenant, qos,
+                             prompt, 1 + rng.randrange(p["tokens"]),
+                             120.0 + rng.random() * 600.0))
+    arrivals.sort(key=lambda a: (a[0], a[1]))
+
+    submitted = 0
+    i = 0
+    while True:
+        now = clock.monotonic()
+        while i < len(arrivals) and arrivals[i][0] <= now:
+            _, tenant, qos, prompt, max_new, dl = arrivals[i]
+            i += 1
+            submitted += 1
+            trace = {"trace_id": f"sb-{submitted:05d}", "span_id": "",
+                     "sampled": True}
+            try:
+                eng.submit(prompt, max_new, tenant=tenant, qos=qos,
+                           deadline_ms=dl, emit=emit, trace=trace)
+                events.append((round(now, 6), "submit", tenant, qos,
+                               len(prompt)))
+            except BusyError:
+                outcomes["busy"] += 1
+                events.append((round(now, 6), "busy", tenant, qos,
+                               len(prompt)))
+        did = eng.step()
+        if did:
+            clock.sleep(0.01)           # one engine step = 10 sim-ms
+        elif i < len(arrivals):
+            clock.advance_to(arrivals[i][0])   # idle: jump to next burst
+        else:
+            break
+
+    snap = eng.snapshot()
+    violations = {"lost_sequences": [], "kv_reclaimed": []}
+    accounted = outcomes["done"] + outcomes["shed"] + outcomes["busy"]
+    if accounted != len(arrivals):
+        violations["lost_sequences"].append(
+            f"{len(arrivals)} submitted but only {accounted} accounted "
+            f"(done={outcomes['done']} shed={outcomes['shed']} "
+            f"busy={outcomes['busy']})")
+    if snap["kv"]["used"] != 0 or snap["kv"]["owners"] != 0:
+        violations["kv_reclaimed"].append(
+            f"{snap['kv']['used']} blocks / {snap['kv']['owners']} "
+            f"owners still held at quiescence")
+    log_digest = hashlib.sha256(
+        _json.dumps(events, sort_keys=True).encode()).hexdigest()
+    spans = tracer.finished()
+    ok = not any(violations.values())
+    out = {
+        "scenario": "serving-burst-storm",
+        "seed": seed,
+        "scale": scale,
+        "ok": ok,
+        "sim_seconds": round(clock.monotonic(), 3),
+        "wall_seconds": round(_wall_time.perf_counter() - t0, 3),
+        "store_events": len(events),
+        "log_digest": log_digest,
+        "trace_spans": len(spans),
+        "trace_digest": trace_digest(spans),
+        "pods_scheduled": 0,
+        "sched_failures": 0,
+        "pump_exhausted": 0,
+        "invariants": {k: v[:10] for k, v in violations.items()},
+        "tenants": p["tenants"],
+        "requests": len(arrivals),
+        "outcomes": outcomes,
+        "tokens": snap["tokens"],
+        "preempted": snap["preempted"],
+        "kv_evictions": snap["kv"]["evicted_total"],
+        "kv_peak_used": snap["kv"]["peak_used"],
+        "batch_occupancy_pct": snap["batch_occupancy_pct"],
+        "ttft_p99_ms": snap["ttft"]["p99_ms"],
+    }
+    LAST_TRACE["spans"] = spans
+    LAST_TRACE["meta"] = {"scenario": "serving-burst-storm",
+                          "seed": seed, "scale": scale,
+                          "sim_seconds": out["sim_seconds"]}
+    return out
 
 
 @scenario("skew-lease-storm")

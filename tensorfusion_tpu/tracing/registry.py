@@ -37,6 +37,19 @@ SPAN_SCHEMA = {
     "worker.flush": {
         "attrs": ("exe_id", "results"),
     },
+    # -- serving engine (tpfserve: continuous batching, docs/serving.md)
+    "client.generate": {
+        "attrs": ("tokens", "ttft_ms", "busy_retries"),
+    },
+    "serving.admit": {
+        "attrs": ("tenant", "qos", "wait_ms", "prompt_tokens"),
+    },
+    "serving.prefill_chunk": {
+        "attrs": ("tenant", "tokens", "pos"),
+    },
+    "serving.step": {
+        "attrs": ("batch", "tokens"),
+    },
     # -- control-plane pod lifecycle (admission -> schedule -> bind)
     "webhook.admit": {
         "attrs": ("pod", "pool", "qos", "workload"),

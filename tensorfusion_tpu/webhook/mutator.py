@@ -164,6 +164,13 @@ class PodMutator:
             if self.operator_url:
                 env.setdefault(constants.ENV_OPERATOR_URL, self.operator_url)
             env.setdefault(constants.ENV_ISOLATION, spec.isolation)
+            # the tenant's QoS class rides into the remoting client
+            # (RemoteDevice reads TPF_REMOTING_QOS -> HELLO qos), so the
+            # worker's dispatcher weight AND the serving engine's
+            # admission priority/SLO tier (docs/serving.md) both resolve
+            # from the same tpu-fusion.ai/qos annotation this webhook
+            # stamped above
+            env.setdefault(constants.ENV_REMOTING_QOS, spec.qos)
 
         if span is not None:
             span.finish(pool=spec.pool, qos=spec.qos,

@@ -1,0 +1,753 @@
+"""tpfserve: paged KV pool + continuous-batching engine + GENERATE wire.
+
+Layers, bottom-up:
+
+- paged-attention NUMERICS: ``paged_decode_step`` /
+  ``paged_prefill_chunk`` against the contiguous flagship path
+  (``llama.decode_step`` / ``llama.generate``) across block sizes,
+  ragged per-sequence positions, and block-table reuse after
+  retirement — logits bounded, greedy tokens exact.
+- :class:`BlockAccount` allocation/reclaim discipline.
+- engine scheduling against the deterministic :class:`FakeRunner`:
+  QoS admission order, BUSY backpressure, deadline shedding,
+  EOS/length retirement, preemption + identical regenerated suffix,
+  full pool reclaim at quiescence.
+- engine + :class:`LlamaRunner` end-to-end greedy parity with
+  ``llama.generate`` under continuous join/leave.
+- the protocol-v5 GENERATE streaming path over real TCP (worker +
+  client), spans, and the ``tpf_serving_*`` metrics lines vs
+  METRICS_SCHEMA.
+
+All CPU (``JAX_PLATFORMS=cpu``), tier-1.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from tensorfusion_tpu import constants  # noqa: E402
+from tensorfusion_tpu.models import llama  # noqa: E402
+from tensorfusion_tpu.remoting.dispatch import BusyError  # noqa: E402
+from tensorfusion_tpu.serving import (BlockAccount,  # noqa: E402
+                                      FakeRunner, LlamaRunner,
+                                      ServingEngine, init_paged_cache,
+                                      paged_decode_step,
+                                      paged_prefill_chunk)
+from tensorfusion_tpu.serving.kvpool import pow2_bucket  # noqa: E402
+
+CFG = llama.LlamaConfig.tiny()
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _pad_table(table, m):
+    return jnp.asarray(table + [0] * (m - len(table)), jnp.int32)
+
+
+def _paged_prefill_seq(params, prompt, cache, table, chunk):
+    """Prefill one sequence in ``chunk``-token pieces; returns (first
+    greedy token, cache)."""
+    logits = None
+    for lo in range(0, len(prompt), chunk):
+        piece = jnp.asarray(prompt[lo:lo + chunk], jnp.int32)
+        logits, cache = paged_prefill_chunk(params, piece, cache, table,
+                                            jnp.int32(lo), CFG)
+    return logits, cache
+
+
+# -- paged-attention numerics ----------------------------------------------
+
+
+@pytest.mark.parametrize("block_size", [3, 4, 8])
+def test_paged_decode_matches_contiguous(params, block_size):
+    """Same prompt, same positions: the paged gather path's logits
+    track the contiguous cache within float tolerance and agree on the
+    greedy token, across block sizes that do and do not divide the
+    sequence length."""
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(1, 255, (1, 7)).astype(np.int32)
+    steps = 6
+    # contiguous reference: prefill + decode_step chain
+    ref_logits, ref_cache = llama.prefill(params, jnp.asarray(prompt),
+                                          CFG, cache_len=7 + steps)
+    acct = BlockAccount(32, block_size)
+    cache = init_paged_cache(CFG, 32, block_size)
+    acct.ensure("s", 7 + steps)
+    table = _pad_table(acct.table("s"), pow2_bucket(len(acct.table("s"))))
+    logits, cache = _paged_prefill_seq(params, list(prompt[0]), cache,
+                                       table, chunk=4)
+    np.testing.assert_allclose(np.asarray(logits),
+                               np.asarray(ref_logits)[0], atol=2e-4,
+                               rtol=2e-4)
+    tok = int(jnp.argmax(logits))
+    assert tok == int(jnp.argmax(ref_logits[0]))
+    pos = 7
+    for _ in range(steps):
+        ref_logits, ref_cache = llama.decode_step(
+            params, jnp.asarray([tok], jnp.int32), ref_cache,
+            jnp.int32(pos), CFG)
+        logits, cache = paged_decode_step(
+            params, jnp.asarray([tok], jnp.int32), cache, table[None, :],
+            jnp.asarray([pos], jnp.int32), CFG)
+        np.testing.assert_allclose(np.asarray(logits)[0],
+                                   np.asarray(ref_logits)[0], atol=2e-4,
+                                   rtol=2e-4)
+        assert int(jnp.argmax(logits[0])) == \
+            int(jnp.argmax(ref_logits[0]))
+        tok = int(jnp.argmax(logits[0]))
+        pos += 1
+
+
+def test_paged_decode_ragged_positions_fused(params):
+    """Sequences at DIFFERENT positions decode in ONE fused step and
+    each matches its own contiguous single-sequence run."""
+    rng = np.random.default_rng(1)
+    lens = [3, 6, 9]
+    prompts = [list(rng.integers(1, 255, n).astype(int)) for n in lens]
+    steps = 5
+    refs = [np.asarray(llama.generate(
+        params, jnp.asarray([p], jnp.int32), steps, CFG))[0]
+        for p in prompts]
+    acct = BlockAccount(48, 4)
+    cache = init_paged_cache(CFG, 48, 4)
+    toks, tables, pos = [], [], []
+    for i, p in enumerate(prompts):
+        acct.ensure(i, len(p) + steps)
+        t = acct.table(i)
+        logits, cache = _paged_prefill_seq(params, p, cache,
+                                           _pad_table(t, 8), chunk=4)
+        toks.append(int(jnp.argmax(logits)))
+        tables.append(t)
+        pos.append(len(p))
+    out = [[t] for t in toks]
+    for _ in range(steps - 1):
+        m = max(len(t) for t in tables)
+        tab = jnp.asarray([t + [0] * (m - len(t)) for t in tables],
+                          jnp.int32)
+        logits, cache = paged_decode_step(
+            params, jnp.asarray(toks, jnp.int32), cache, tab,
+            jnp.asarray(pos, jnp.int32), CFG)
+        toks = [int(x) for x in jnp.argmax(logits, axis=-1)]
+        for i in range(3):
+            out[i].append(toks[i])
+            pos[i] += 1
+    for i in range(3):
+        assert out[i] == [int(x) for x in refs[i]], i
+
+
+def test_block_table_reuse_after_retirement(params):
+    """Blocks released by a retired sequence and handed to a NEW one
+    must behave like a fresh pool — stale KV in reused pages must be
+    fully overwritten/masked."""
+    rng = np.random.default_rng(2)
+    p1 = list(rng.integers(1, 255, 8).astype(int))
+    p2 = list(rng.integers(1, 255, 5).astype(int))
+    acct = BlockAccount(9, 4)     # 8 usable: seq1 takes most of it
+    cache = init_paged_cache(CFG, 9, 4)
+    acct.ensure("a", 12)
+    ta = acct.table("a")
+    logits, cache = _paged_prefill_seq(params, p1, cache,
+                                       _pad_table(ta, 4), chunk=8)
+    tok, pos = int(jnp.argmax(logits)), 8
+    for _ in range(3):
+        lg, cache = paged_decode_step(
+            params, jnp.asarray([tok], jnp.int32), cache,
+            _pad_table(ta, 4)[None, :], jnp.asarray([pos], jnp.int32),
+            CFG)
+        tok, pos = int(jnp.argmax(lg[0])), pos + 1
+    freed = acct.release("a")
+    assert freed == 3
+    # second sequence reuses the same physical blocks
+    acct.ensure("b", 10)
+    tb = acct.table("b")
+    assert set(tb) & set(ta), "expected block reuse"
+    ref = np.asarray(llama.generate(params,
+                                    jnp.asarray([p2], jnp.int32), 5,
+                                    CFG))[0]
+    logits, cache = _paged_prefill_seq(params, p2, cache,
+                                       _pad_table(tb, 4), chunk=4)
+    out = [int(jnp.argmax(logits))]
+    pos = 5
+    for _ in range(4):
+        lg, cache = paged_decode_step(
+            params, jnp.asarray([out[-1]], jnp.int32), cache,
+            _pad_table(tb, 4)[None, :], jnp.asarray([pos], jnp.int32),
+            CFG)
+        out.append(int(jnp.argmax(lg[0])))
+        pos += 1
+    assert out == [int(x) for x in ref]
+
+
+def test_paged_cache_rejects_kv_quant():
+    import dataclasses
+
+    qcfg = dataclasses.replace(CFG, kv_quant=True)
+    with pytest.raises(ValueError, match="kv_quant"):
+        init_paged_cache(qcfg, 8, 4)
+
+
+# -- BlockAccount ----------------------------------------------------------
+
+
+def test_block_account_alloc_release_discipline():
+    a = BlockAccount(9, 4)        # block 0 reserved -> 8 usable
+    assert a.usable_blocks == 8
+    assert a.blocks_for(0) == 0 and a.blocks_for(1) == 1
+    assert a.blocks_for(4) == 1 and a.blocks_for(5) == 2
+    assert a.ensure("x", 9)       # 3 blocks
+    assert a.used_blocks == 3 and a.table("x") == [1, 2, 3]
+    assert a.ensure("x", 9)       # idempotent
+    assert a.used_blocks == 3
+    # all-or-nothing: asking for more than free leaves nothing behind
+    assert a.ensure("y", 20)      # 5 blocks -> exactly exhausts
+    assert not a.ensure("z", 5)   # 2 blocks > 0 free
+    assert a.free_blocks == 0 and a.table("z") == []
+    assert a.release("x") == 3
+    assert a.release("x") == 0    # idempotent
+    assert a.ensure("z", 5)
+    assert a.table("z") == [1, 2]     # lowest ids reused first
+    assert a.peak_used == 8
+    snap = a.snapshot()
+    assert snap["evicted_total"] == 0
+    a.release("z", evicted=True)
+    assert a.snapshot()["evicted_total"] == 2
+
+
+def test_block_account_rejects_degenerate_pools():
+    with pytest.raises(ValueError):
+        BlockAccount(1, 4)        # nothing usable past scratch
+    with pytest.raises(ValueError):
+        BlockAccount(8, 0)
+
+
+# -- engine scheduling (FakeRunner: no jax, deterministic) -----------------
+
+
+def _collect():
+    done = {}
+
+    def emit(seq, toks, d, info):
+        if d:
+            done[seq.sid] = (list(seq.tokens), dict(info))
+    return done, emit
+
+
+def test_engine_generates_and_reclaims_pool():
+    eng = ServingEngine(FakeRunner(num_blocks=33, block_size=4),
+                        max_batch=4, prefill_chunk_tokens=8)
+    done, emit = _collect()
+    seqs = [eng.submit([5, 7, 11], 6, tenant=f"t{i}", emit=emit)
+            for i in range(6)]
+    for _ in range(200):
+        if len(done) == 6:
+            break
+        eng.step()
+    assert len(done) == 6
+    # position-deterministic fake: identical prompts -> identical output
+    outs = {tuple(done[s.sid][0]) for s in seqs}
+    assert len(outs) == 1 and len(next(iter(outs))) == 6
+    snap = eng.snapshot()
+    assert snap["kv"]["used"] == 0 and snap["kv"]["owners"] == 0
+    assert snap["retired"] == 6 and snap["tokens"] == 36
+    assert not eng.step()          # quiescent engine reports idle
+
+
+def test_engine_eos_retires_early():
+    fr = FakeRunner(num_blocks=17, block_size=4)
+    first = fr.prefill([5, 7, 11], [], 0)     # what prefill will emit
+    nxt = fr._next(first, 3)
+    eng = ServingEngine(FakeRunner(num_blocks=17, block_size=4),
+                        max_batch=2, prefill_chunk_tokens=8)
+    done, emit = _collect()
+    eng.submit([5, 7, 11], 10, eos_id=nxt, emit=emit)
+    for _ in range(50):
+        if done:
+            break
+        eng.step()
+    (tokens, info), = done.values()
+    assert info["finish_reason"] == "eos"
+    assert tokens[-1] == nxt and len(tokens) == 2
+
+
+def test_engine_busy_backpressure():
+    eng = ServingEngine(FakeRunner(), max_batch=1, max_waiting=2)
+    done, emit = _collect()
+    eng.submit([1, 2], 4, emit=emit)
+    eng.submit([1, 2], 4, emit=emit)
+    with pytest.raises(BusyError) as ei:
+        eng.submit([1, 2], 4, emit=emit)
+    assert ei.value.retry_after_ms >= 1
+    assert eng.snapshot()["busy_rejected"] == 1
+
+
+def test_engine_oversized_request_rejected():
+    eng = ServingEngine(FakeRunner(num_blocks=5, block_size=2))
+    with pytest.raises(ValueError, match="capacity"):
+        eng.submit([1] * 6, 4)    # 10 tokens > 4 blocks * 2
+
+
+def test_engine_deadline_sheds_waiting_sequence():
+    """A sequence whose admission deadline passes while the batch is
+    full is shed with the dispatcher's DEADLINE_EXCEEDED code."""
+    eng = ServingEngine(FakeRunner(), max_batch=1,
+                        prefill_chunk_tokens=8)
+    done, emit = _collect()
+    eng.submit([1, 2, 3], 50, tenant="hog", emit=emit)    # occupies slot
+    eng.step()                                            # admit the hog
+    eng.submit([4, 5], 4, tenant="late", deadline_ms=0.0, emit=emit)
+    for _ in range(5):
+        eng.step()
+    shed = [info for _, info in done.values()
+            if info.get("code") == "DEADLINE_EXCEEDED"]
+    assert shed and shed[0]["finish_reason"] == "shed"
+    assert eng.snapshot()["shed"] == 1
+    # the hog keeps decoding, unaffected
+    assert eng.snapshot()["active"] == 1
+
+
+def test_engine_admission_prefers_higher_qos():
+    """With one slot free and two waiters, the critical-class tenant is
+    admitted before the earlier-arriving low-class one."""
+    eng = ServingEngine(FakeRunner(), max_batch=1,
+                        prefill_chunk_tokens=16)
+    done, emit = _collect()
+    eng.submit([1, 2], 2, tenant="bg", qos=constants.QOS_LOW, emit=emit)
+    eng.submit([1, 2], 2, tenant="rt", qos=constants.QOS_CRITICAL,
+               emit=emit)
+    eng.step()     # admits exactly one: the critical tenant
+    snap = eng.snapshot()
+    assert snap["waiting"] == 1
+    assert "rt" in snap["tenants"] and snap["tenants"]["rt"]["slo_total"] == 1
+    for _ in range(50):
+        if len(done) == 2:
+            break
+        eng.step()
+    assert len(done) == 2
+
+
+def test_engine_preemption_regenerates_identical_suffix():
+    """Pool exhaustion mid-decode evicts the low-QoS victim; after
+    re-admission its final token stream equals an uninterrupted run
+    (greedy decode is position-deterministic)."""
+    # uninterrupted reference on an ample pool
+    ref_eng = ServingEngine(FakeRunner(num_blocks=65, block_size=2),
+                            max_batch=4, prefill_chunk_tokens=16)
+    rdone, remit = _collect()
+    ref = ref_eng.submit([9, 9, 9], 8, emit=remit)
+    while ref.sid not in rdone:
+        ref_eng.step()
+    # tight pool: 3 sequences of up to 11 tokens in 10 blocks * 2
+    eng = ServingEngine(FakeRunner(num_blocks=11, block_size=2),
+                        max_batch=4, prefill_chunk_tokens=16)
+    done, emit = _collect()
+    seqs = [eng.submit([9, 9, 9], 8, tenant=f"t{i}",
+                       qos=constants.QOS_LOW if i else
+                       constants.QOS_CRITICAL, emit=emit)
+            for i in range(3)]
+    for _ in range(500):
+        if len(done) == 3:
+            break
+        eng.step()
+    assert len(done) == 3
+    snap = eng.snapshot()
+    assert snap["preempted"] > 0, "pool pressure never preempted"
+    assert snap["kv"]["evicted_total"] > 0
+    assert snap["kv"]["used"] == 0
+    for s in seqs:
+        assert done[s.sid][0] == rdone[ref.sid][0]
+    # the critical tenant is never the victim
+    assert seqs[0].preemptions == 0
+
+
+def test_engine_continuous_join_leave(params):
+    """Real runner: sequences submitted at different times join the
+    fused batch mid-flight and each matches llama.generate exactly."""
+    rng = np.random.default_rng(3)
+    prompts = [list(rng.integers(1, 255, n).astype(int))
+               for n in (4, 6, 5, 7)]
+    steps = [6, 3, 8, 4]
+    refs = [np.asarray(llama.generate(
+        params, jnp.asarray([p], jnp.int32), s, CFG))[0]
+        for p, s in zip(prompts, steps)]
+    eng = ServingEngine(LlamaRunner(params, CFG, num_blocks=64,
+                                    block_size=4),
+                        max_batch=3, prefill_chunk_tokens=4)
+    done, emit = _collect()
+    seqs = []
+    for i, (p, s) in enumerate(zip(prompts, steps)):
+        seqs.append(eng.submit(p, s, tenant=f"t{i}", emit=emit))
+        eng.step()     # later submissions join a batch already decoding
+    for _ in range(100):
+        if len(done) == 4:
+            break
+        eng.step()
+    assert len(done) == 4
+    for i, s in enumerate(seqs):
+        assert done[s.sid][0] == [int(x) for x in refs[i]], i
+    snap = eng.snapshot()
+    assert snap["kv"]["used"] == 0
+    assert snap["batch_occupancy_pct"] > 0
+
+
+# -- GENERATE over the wire ------------------------------------------------
+
+
+@pytest.fixture()
+def serving_worker(params):
+    from tensorfusion_tpu.remoting import RemoteVTPUWorker
+
+    eng = ServingEngine(LlamaRunner(params, CFG, num_blocks=64,
+                                    block_size=4),
+                        max_batch=4, prefill_chunk_tokens=8)
+    w = RemoteVTPUWorker(engine=eng)
+    w.start()
+    yield w
+    w.stop()
+
+
+def test_generate_streams_tokens_over_tcp(serving_worker, params):
+    from tensorfusion_tpu.remoting import RemoteDevice
+
+    prompt = [3, 1, 4, 1, 5, 9]
+    ref = np.asarray(llama.generate(params,
+                                    jnp.asarray([prompt], jnp.int32), 7,
+                                    CFG))[0]
+    dev = RemoteDevice(serving_worker.url)
+    streamed = []
+    r = dev.generate(prompt, 7, on_token=streamed.append)
+    dev.close()
+    assert r["tokens"] == [int(x) for x in ref]
+    assert streamed == r["tokens"]
+    assert r["finish_reason"] == "length"
+    assert r["ttft_ms"] is not None and r["ttft_ms"] >= 0
+    assert r["n_tokens"] == 7
+
+
+def test_generate_concurrent_tenants_share_the_batch(serving_worker,
+                                                     params):
+    from tensorfusion_tpu.remoting import RemoteDevice
+
+    prompt = [2, 7, 1, 8]
+    ref = [int(x) for x in np.asarray(llama.generate(
+        params, jnp.asarray([prompt], jnp.int32), 6, CFG))[0]]
+    devs = [RemoteDevice(serving_worker.url, qos=q)
+            for q in ("low", "medium", "high", "critical")]
+    out = {}
+
+    def run(i, d):
+        out[i] = d.generate(prompt, 6)["tokens"]
+
+    threads = [threading.Thread(target=run, args=(i, d))
+               for i, d in enumerate(devs)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    for d in devs:
+        d.close()
+    assert all(out[i] == ref for i in range(4)), out
+    snap = serving_worker.engine.snapshot()
+    assert snap["retired"] >= 4
+    # each connection is its own serving tenant, with its HELLO QoS
+    qos_seen = {t["qos"] for t in snap["tenants"].values()}
+    assert {"low", "medium", "high", "critical"} <= qos_seen
+
+
+def test_generate_non_streaming_single_frame(serving_worker, params):
+    from tensorfusion_tpu.remoting import RemoteDevice
+
+    prompt = [1, 2, 3]
+    ref = [int(x) for x in np.asarray(llama.generate(
+        params, jnp.asarray([prompt], jnp.int32), 5, CFG))[0]]
+    dev = RemoteDevice(serving_worker.url)
+    seen = []
+    r = dev.generate(prompt, 5, stream=False, on_token=seen.append)
+    dev.close()
+    assert r["tokens"] == ref
+    # non-streaming: every token arrives with the final frame
+    assert seen == ref
+
+
+def test_generate_busy_and_deadline_codes(params):
+    """A saturated engine answers BUSY (client retries, bounded) and a
+    0ms admission deadline surfaces as RemoteDeadlineError."""
+    from tensorfusion_tpu.remoting import RemoteDevice, RemoteVTPUWorker
+    from tensorfusion_tpu.remoting.client import RemoteDeadlineError
+
+    eng = ServingEngine(LlamaRunner(params, CFG, num_blocks=64,
+                                    block_size=4),
+                        max_batch=1, prefill_chunk_tokens=4,
+                        max_waiting=1)
+    w = RemoteVTPUWorker(engine=eng)
+    w.start()
+    try:
+        hog = RemoteDevice(w.url)
+        slow = threading.Thread(
+            target=lambda: hog.generate([1, 2, 3, 4], 40))
+        slow.start()
+        late = RemoteDevice(w.url)
+        deadline_errors = busy_outcomes = 0
+        for _ in range(6):
+            try:
+                late.generate([5, 6], 3, deadline_ms=0.0)
+            except RemoteDeadlineError:
+                deadline_errors += 1
+            except Exception:  # noqa: BLE001 - BUSY exhausts retries
+                busy_outcomes += 1
+        assert deadline_errors > 0
+        slow.join(timeout=60)
+        hog.close()
+        late.close()
+    finally:
+        w.stop()
+    assert eng.snapshot()["shed"] >= deadline_errors
+
+
+def test_generate_without_engine_errors():
+    from tensorfusion_tpu.remoting import (RemoteDevice,
+                                           RemoteExecutionError,
+                                           RemoteVTPUWorker)
+
+    w = RemoteVTPUWorker()
+    w.start()
+    try:
+        dev = RemoteDevice(w.url)
+        with pytest.raises(RemoteExecutionError, match="no serving"):
+            dev.generate([1, 2], 3)
+        dev.close()
+    finally:
+        w.stop()
+
+
+def test_generate_requires_v5():
+    from tensorfusion_tpu.remoting import (RemoteDevice,
+                                           RemoteExecutionError,
+                                           RemoteVTPUWorker)
+
+    w = RemoteVTPUWorker(protocol_version=4)
+    w.start()
+    try:
+        dev = RemoteDevice(w.url)
+        with pytest.raises(RemoteExecutionError, match="protocol v5"):
+            dev.generate([1, 2], 3)
+        dev.close()
+    finally:
+        w.stop()
+
+
+# -- tracing ---------------------------------------------------------------
+
+
+def test_generate_assembles_serving_trace(serving_worker, params):
+    from tensorfusion_tpu.remoting import RemoteDevice
+    from tensorfusion_tpu.tracing import Tracer
+    from tensorfusion_tpu.tracing.export import to_chrome, validate
+
+    tr = Tracer(service="client")
+    dev = RemoteDevice(serving_worker.url, tracer=tr)
+    r = dev.generate([1, 2, 3, 4], 5)
+    dev.close()
+    assert len(r["tokens"]) == 5
+    spans = tr.finished()
+    names = {d["name"] for d in spans}
+    assert {"client.generate", "serving.admit",
+            "serving.prefill_chunk", "serving.step"} <= names
+    roots = [d for d in spans if d["name"] == "client.generate"]
+    assert len(roots) == 1
+    trace_id = roots[0]["trace_id"]
+    # every serving span joined the client's trace
+    for d in spans:
+        if d["name"].startswith("serving."):
+            assert d["trace_id"] == trace_id
+    assert validate(to_chrome(spans)) == []
+    admits = [d for d in spans if d["name"] == "serving.admit"]
+    assert admits[0]["attrs"]["prompt_tokens"] == 4
+
+
+def test_generate_unsampled_creates_no_server_spans(params):
+    from tensorfusion_tpu.remoting import RemoteDevice, RemoteVTPUWorker
+    from tensorfusion_tpu.tracing import Tracer
+
+    eng = ServingEngine(LlamaRunner(params, CFG, num_blocks=32,
+                                    block_size=4), max_batch=2)
+    w = RemoteVTPUWorker(engine=eng)
+    w.start()
+    try:
+        tr = Tracer(service="client", sample=0.0)
+        dev = RemoteDevice(w.url, tracer=tr)
+        r = dev.generate([1, 2, 3], 4)
+        dev.close()
+        assert len(r["tokens"]) == 4
+        assert tr.finished() == []
+        assert w.tracer.finished() == []
+    finally:
+        w.stop()
+
+
+# -- metrics ---------------------------------------------------------------
+
+
+def test_serving_engine_lines_match_schema():
+    from tensorfusion_tpu.hypervisor.metrics import serving_engine_lines
+    from tensorfusion_tpu.metrics.encoder import parse_line
+    from tensorfusion_tpu.metrics.schema import METRICS_SCHEMA
+
+    eng = ServingEngine(FakeRunner(), max_batch=2, name="unit")
+    done, emit = _collect()
+    eng.submit([1, 2, 3], 4, tenant="alice", qos="high", emit=emit,
+               trace={"trace_id": "tr-1", "span_id": "", "sampled":
+                      True})
+    for _ in range(40):
+        if done:
+            break
+        eng.step()
+    lines = serving_engine_lines(eng, "node-x", 123456789)
+    assert len(lines) == 2
+    seen = set()
+    for line in lines:
+        measurement, tags, fields, _ = parse_line(line)
+        seen.add(measurement)
+        schema = METRICS_SCHEMA[measurement]
+        assert set(tags) == set(schema["tags"])
+        assert set(fields) <= set(schema["fields"])
+    assert seen == {"tpf_serving_engine", "tpf_serving_tenant"}
+    _, tags, fields, _ = parse_line(lines[1])
+    assert tags["tenant"] == "alice" and tags["qos"] == "high"
+    assert fields["tokens_total"] == 4 and fields["slo_total"] == 1
+    _, _, efields, _ = parse_line(lines[0])
+    assert efields["tokens_total"] == 4
+    assert efields["kv_blocks_used"] == 0
+
+
+def test_recorder_inserts_serving_series_with_exemplars(params):
+    """The operator-side MetricsRecorder ships tpf_serving_* into the
+    TSDB with trace-id exemplars from the engine snapshot."""
+    from tensorfusion_tpu.metrics.recorder import MetricsRecorder
+    from tensorfusion_tpu.operator import Operator
+    from tensorfusion_tpu.remoting import RemoteVTPUWorker
+
+    eng = ServingEngine(FakeRunner(), max_batch=2, name="rec")
+    done, emit = _collect()
+    eng.submit([1, 2], 3, tenant="bob", qos="medium", emit=emit,
+               trace={"trace_id": "tr-xyz", "span_id": "",
+                      "sampled": True})
+    for _ in range(40):
+        if done:
+            break
+        eng.step()
+    w = RemoteVTPUWorker(engine=eng)
+    op = Operator()
+    try:
+        rec = MetricsRecorder(op, remote_workers=[w])
+        rec.record_once()
+        series = rec.tsdb.query("tpf_serving_engine", "tokens_total")
+        assert series and series[0][1][-1].value == 3
+        assert "tr-xyz" in rec.tsdb.exemplars("tpf_serving_tenant")
+    finally:
+        op.stop()
+
+
+# -- sim scenario ----------------------------------------------------------
+
+
+@pytest.mark.sim
+def test_serving_burst_storm_deterministic():
+    from tensorfusion_tpu.sim.scenarios import run_scenario
+
+    r1 = run_scenario("serving-burst-storm", seed=42, scale="small")
+    r2 = run_scenario("serving-burst-storm", seed=42, scale="small")
+    assert r1["ok"], r1["invariants"]
+    assert r1["log_digest"] == r2["log_digest"]
+    assert r1["trace_digest"] == r2["trace_digest"]
+    r3 = run_scenario("serving-burst-storm", seed=7, scale="small")
+    assert r3["log_digest"] != r1["log_digest"]
+    # the storm actually stressed the pool at small scale
+    assert r1["preempted"] > 0 and r1["kv_evictions"] > 0
+
+
+@pytest.mark.sim
+def test_serving_burst_storm_invariants_trip_on_leak():
+    """The scenario's kv-reclaimed invariant CAN fail: a sequence
+    retired without releasing its blocks is caught."""
+    from tensorfusion_tpu.sim.scenarios import run_scenario
+    from tensorfusion_tpu.serving import engine as engine_mod
+
+    original = engine_mod.ServingEngine._maybe_finish
+
+    def leaky(self, seq, events):
+        # sabotage: swallow the release for one victim
+        release, self.account.release = (self.account.release,
+                                         lambda *a, **k: 0)
+        try:
+            return original(self, seq, events)
+        finally:
+            self.account.release = release
+
+    engine_mod.ServingEngine._maybe_finish = leaky
+    try:
+        r = run_scenario("serving-burst-storm", seed=42, scale="small")
+    finally:
+        engine_mod.ServingEngine._maybe_finish = original
+    assert not r["ok"]
+    assert r["invariants"]["kv_reclaimed"]
+
+
+def test_kv_pool_charges_resident_hbm_budget(params):
+    """The paged pool's fixed footprint flows through the worker's
+    resident-HBM accounting (hypervisor memory metering path): charged
+    at start, visible in INFO, released at stop, and a pool bigger
+    than the budget refuses to start."""
+    from tensorfusion_tpu.remoting import RemoteDevice, RemoteVTPUWorker
+
+    runner = LlamaRunner(params, CFG, num_blocks=32, block_size=4)
+    assert runner.nbytes > 0
+    eng = ServingEngine(runner, max_batch=2)
+    w = RemoteVTPUWorker(engine=eng,
+                         max_resident_bytes=runner.nbytes + (1 << 20))
+    w.start()
+    try:
+        dev = RemoteDevice(w.url)
+        assert dev.info()["resident_bytes"] >= runner.nbytes
+        dev.close()
+    finally:
+        w.stop()
+    assert w.resident_bytes == 0
+
+    eng2 = ServingEngine(LlamaRunner(params, CFG, num_blocks=32,
+                                     block_size=4), max_batch=2)
+    w2 = RemoteVTPUWorker(engine=eng2, max_resident_bytes=1024)
+    with pytest.raises(RuntimeError, match="resident-HBM"):
+        w2.start()
+    w2._server.server_close()
+
+
+# -- webhook tie-in --------------------------------------------------------
+
+
+def test_webhook_injects_remoting_qos_env():
+    """The admission webhook's QoS annotation reaches the remoting
+    client env, so HELLO carries the same class the engine admits on."""
+    from tensorfusion_tpu.api.types import Container, Pod
+    from tensorfusion_tpu.store import ObjectStore
+    from tensorfusion_tpu.webhook import PodMutator, WorkloadParser
+
+    store = ObjectStore()
+    mutator = PodMutator(store, WorkloadParser())
+    pod = Pod.new("serve-0", namespace="default")
+    pod.metadata.labels[constants.LABEL_ENABLED] = "true"
+    pod.metadata.annotations[constants.ANN_QOS] = constants.QOS_HIGH
+    pod.metadata.annotations[constants.ANN_TFLOPS_REQUEST] = "1"
+    pod.metadata.annotations[constants.ANN_HBM_REQUEST] = "1073741824"
+    pod.spec.containers = [Container(name="main")]
+    mutator.handle(pod)
+    assert pod.spec.containers[0].env[constants.ENV_REMOTING_QOS] == \
+        constants.QOS_HIGH
