@@ -8,7 +8,7 @@
 PY := env -u PALLAS_AXON_POOL_IPS python
 
 .PHONY: all native test test-native verify-all verify-repeat \
-	verify-stress verify-sim verify-trace verify-serving \
+	verify-stress verify-sim verify-trace verify-serving verify-wire \
 	verify-native-sanitized \
 	check-coverage lint \
 	lint-drill asan \
@@ -77,7 +77,7 @@ verify-repeat: native
 # small N, cache/store coherence after multi-threaded churn — the PR-4
 # control-plane hot path).  Cheaper than verify-repeat (minutes, not an
 # hour), meant to run on every change to locking/queueing code.
-verify-stress: verify-sim verify-trace verify-serving
+verify-stress: verify-sim verify-trace verify-serving verify-wire
 	@for i in 1 2 3 4 5; do \
 		echo "=== verify-stress round $$i/5 ==="; \
 		env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -139,6 +139,19 @@ verify-serving:
 		--export-trace /tmp/tpfserve_verify.json
 	$(PY) -m tools.tpftrace check /tmp/tpfserve_verify.json
 	@echo "verify-serving: OK"
+
+# Wire-format gate (docs/wire-format.md): the fast q8 on/off cell of
+# remoting_bench — shard-upload traffic through the double-buffered PUT
+# stream, exact raw vs quantized wire.  The cell exits nonzero unless
+# q8 cuts wire bytes >= 2x AND the raw path is bit-exact with the q8
+# path inside the per-element quantization bound.  Artifact goes to a
+# temp dir so the checked-in full-run record survives.  Run on any
+# change to remoting/protocol.py or the upload paths.
+verify-wire:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		TPF_BENCH_RESULTS_DIR=/tmp/tpfwire_verify_results \
+		python benchmarks/remoting_bench.py --quick
+	@echo "verify-wire: OK"
 
 test-native:
 	$(MAKE) -C native test

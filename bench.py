@@ -178,6 +178,7 @@ def main() -> int:
     print(json.dumps({"metric": "vtpu_soft_isolation_overhead_pct",
                       "value": None, "unit": "%", "vs_baseline": None,
                       "fallback": fallback,
+                      "backend_evidence": "cpu-fallback",
                       "error": "all benchmark attempts failed"}))
     return 1
 
@@ -386,6 +387,8 @@ def child_main() -> int:
     # Paired per-round differences + an IQR noise band qualify the point
     # estimate: |value| < noise_band_pct means "parity within noise".
     overhead_pct, noise_band = _paired_overhead(n_times, m_times)
+    from benchmarks._artifact import backend_evidence
+
     result = {
         "metric": "vtpu_soft_isolation_overhead_pct",
         "value": round(overhead_pct, 3),
@@ -393,6 +396,10 @@ def child_main() -> int:
         "vs_baseline": round(overhead_pct / 1.0, 3),
         "noise_band_pct": round(noise_band, 3),
         "platform": platform,
+        # provenance: fallback records have claimed CPU evidence since
+        # round 3 (dead TPU tunnel) — stamp it machine-readably so
+        # real-chip revalidation is findable from the record alone
+        "backend_evidence": backend_evidence(platform),
         "device_kind": getattr(device, "device_kind", ""),
         "native_step_ms": round(t_native * 1e3, 3),
         "metered_step_ms": round(t_metered * 1e3, 3),

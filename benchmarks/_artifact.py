@@ -28,6 +28,17 @@ def previous_artifact(name: str) -> dict:
     return prev
 
 
+def backend_evidence(platform_name) -> str:
+    """Provenance class of a perf record: ``"tpu"`` only when the
+    numbers were measured on a real chip, ``"cpu-fallback"`` otherwise.
+    The TPU tunnel has been dead since round 3, so at-HEAD perf
+    evidence is CPU-fallback — stamping it machine-readably into every
+    artifact makes real-chip revalidation mechanically findable
+    (``grep -l cpu-fallback benchmarks/results``)."""
+    return "tpu" if str(platform_name or "").lower().startswith("tpu") \
+        else "cpu-fallback"
+
+
 def write_artifact(name: str, result: dict) -> pathlib.Path:
     repo = pathlib.Path(__file__).resolve().parent.parent
     # CI smoke variants must not clobber the checked-in full-run
@@ -41,8 +52,19 @@ def write_artifact(name: str, result: dict) -> pathlib.Path:
             capture_output=True, text=True, timeout=10).stdout.strip()
     except Exception:  # noqa: BLE001
         commit = ""
+    evidence = result.get("backend_evidence") or \
+        backend_evidence(result.get("platform"))
     record = dict(result, host=platform.node(), commit=commit,
-                  cpu_cores=os.cpu_count())
+                  cpu_cores=os.cpu_count(), backend_evidence=evidence)
+    # surface the evidence transition in the before/after diff every
+    # artifact carries: a tpu->cpu-fallback flip (or a still-unstamped
+    # previous record) is visible without opening the old file
+    prev = previous_artifact(name)
+    if prev:
+        record["backend_evidence_diff"] = {
+            "previous": prev.get("backend_evidence",
+                                 "unknown (pre-provenance record)"),
+            "current": evidence}
     path = out_dir / f"{name}.json"
     with open(path, "w") as f:
         json.dump(record, f, indent=1)

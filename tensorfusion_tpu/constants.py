@@ -234,6 +234,9 @@ ENV_STORE_TOKEN = "TPF_STORE_TOKEN"            # store-gateway shared token
 ENV_GO_TESTING = "TPF_TESTING"                 # test-mode toggles
 ENV_REMOTING_QOS = "TPF_REMOTING_QOS"          # remote tenant's QoS class
 ENV_REMOTING_DISPATCH = "TPF_REMOTING_DISPATCH"  # worker policy: wfq|fifo
+ENV_REMOTING_QUANT = "TPF_REMOTING_QUANT"      # q8 wire encoding: 1 on, 0 off
+ENV_REMOTING_UPLOAD_DEPTH = "TPF_REMOTING_UPLOAD_DEPTH"  # shard PUTs in flight
+ENV_REMOTING_PREFETCH_DEPTH = "TPF_REMOTING_PREFETCH_DEPTH"  # worker H2D overlap
 ENV_TRACE_SAMPLE = "TPF_TRACE_SAMPLE"          # head-based trace sampling
 
 #: queue-wait SLO per QoS class (ms): the per-tenant good/total rollup

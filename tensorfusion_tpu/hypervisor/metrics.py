@@ -45,6 +45,11 @@ def remote_dispatch_lines(remote_worker, node_name: str,
     operator recorder also reads its exemplar trace ids from it)."""
     if snap is None:
         snap = remote_worker.dispatcher.snapshot()
+    # upload-stream depth accounting (v6 transfer/compute overlap,
+    # docs/wire-format.md): how deep the worker's host->device
+    # prefetch actually ran, alongside the queue it drains
+    upload = remote_worker.upload_stats() \
+        if hasattr(remote_worker, "upload_stats") else {}
     tags = {"node": node_name, "mode": snap["mode"]}
     lines = [encode_line(
         "tpf_remote_dispatch", tags,
@@ -60,6 +65,10 @@ def remote_dispatch_lines(remote_worker, node_name: str,
          "service_p50_ms": snap["service"]["p50_ms"],
          "service_p99_ms": snap["service"]["p99_ms"],
          "service_mean_ms": snap["service"]["mean_ms"],
+         "upload_prefetched_total": upload.get("prefetched_total", 0),
+         "upload_inflight": upload.get("inflight", 0),
+         "upload_overlap_high_water": upload.get("high_water", 0),
+         "upload_depth": upload.get("depth", 1),
          "tenants": len(snap["tenants"])}, ts)]
     for qos, q in snap["per_qos"].items():
         lines.append(encode_line(

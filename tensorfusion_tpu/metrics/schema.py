@@ -43,6 +43,8 @@ METRICS_SCHEMA = {
                    "deadline_exceeded_total", "queue_wait_p50_ms",
                    "queue_wait_p99_ms", "queue_wait_mean_ms",
                    "service_p50_ms", "service_p99_ms", "service_mean_ms",
+                   "upload_prefetched_total", "upload_inflight",
+                   "upload_overlap_high_water", "upload_depth",
                    "tenants"),
     },
     "tpf_remote_qos": {

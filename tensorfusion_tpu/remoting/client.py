@@ -54,6 +54,24 @@ drives the worker's continuous-batching engine — one GENERATE request,
 a stream of GENERATE_OK frames (tokens as they land, then the final
 stats frame), BUSY/DEADLINE_EXCEEDED semantics identical to the
 dispatcher path.
+
+Quantized wire + double-buffered uploads (protocol v6,
+docs/wire-format.md): ``quantize=True`` (or ``TPF_REMOTING_QUANT=1``)
+opts the connection into the lossy ``q8`` wire encoding — eligible
+float buffers ship int8-with-block-scales (~4x fewer bytes for f32,
+~2x for bf16), quantized straight into a per-connection
+:class:`~.protocol.BufferPool` and sent as one vectored ``sendmsg``;
+integer/bool/f64 buffers always stay exact, and the HELLO ``quant``
+flag asks the worker to encode its replies the same way.  Sharded
+per-call uploads now ride a *double-buffered upload stream*: shard
+PUTs are staged onto a bounded background sender
+(``TPF_REMOTING_UPLOAD_DEPTH``, default 2 in flight) so slicing and
+quantizing shard k+1 overlaps the wire transfer of shard k — which
+itself overlaps the worker's scatter — and the stream drains before
+the EXECUTE frame so per-connection ordering is untouched.  Wire
+accounting (bytes, per-encoding counts, overlap depth) accumulates in
+``RemoteDevice.wire_stats`` and rides the ``client.wire`` span's
+``enc`` / ``wire_bytes`` / ``overlap_depth`` attrs.
 """
 
 from __future__ import annotations
@@ -89,6 +107,94 @@ SHARD_PUT_MIN_BYTES = 256 << 10
 #: jittered backoff) before giving up — a saturated-but-moving worker
 #: drains well inside this; a wedged one should fail loudly
 MAX_BUSY_RETRIES = 32
+
+#: shard PUT frames the upload stream keeps in flight ahead of the
+#: sender (double-buffered by default: stage one while one sends)
+DEFAULT_UPLOAD_DEPTH = 2
+
+
+class _UploadStream:
+    """Bounded background sender for per-call shard PUTs — the client
+    half of the transfer/compute overlap (the T3 discipline): while the
+    stream thread quantizes + sends shard k (and the worker scatters
+    shard k-1), the caller is already slicing shard k+1.  ``drain()``
+    is the ordering barrier every EXECUTE takes before its own frame,
+    so the worker still sees PUTs strictly before the EXECUTE that
+    consumes them; errors stashed by the stream thread re-raise there,
+    exactly where the old inline send raised."""
+
+    _SENTINEL = object()
+
+    def __init__(self, device: "RemoteDevice", depth: int):
+        import queue as _queue
+
+        self.device = device
+        self.depth = max(1, int(depth))
+        self._q: "_queue.Queue" = _queue.Queue(maxsize=self.depth)
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        # guarded by: _lock
+        self._err: Optional[BaseException] = None
+        #: lifetime accounting (surfaced via device.wire_stats)
+        self.puts = 0
+        self.high_water = 0
+
+    def submit(self, meta: Dict[str, Any], view,
+               stats: Optional[Dict[str, int]] = None) -> None:
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True,
+                    name="tpf-remote-upload")
+                self._thread.start()
+        self._q.put((meta, view, stats))
+        depth_now = max(1, self._q.qsize())
+        self.high_water = max(self.high_water, depth_now)
+        if stats is not None:
+            stats["overlap_depth"] = max(stats.get("overlap_depth", 0),
+                                         depth_now)
+        with self.device._state_lock:
+            ws = self.device.wire_stats
+            ws["upload_puts"] = ws.get("upload_puts", 0) + 1
+            ws["upload_overlap_high_water"] = max(
+                ws.get("upload_overlap_high_water", 0), depth_now)
+
+    def _loop(self) -> None:
+        while True:
+            job = self._q.get()
+            if job is self._SENTINEL:
+                self._q.task_done()
+                return
+            meta, view, stats = job
+            try:
+                with self._lock:
+                    broken = self._err is not None
+                if not broken:
+                    self.device._submit("PUT", meta, [view],
+                                        want_reply=False, stats=stats)
+                    self.puts += 1
+            except BaseException as e:  # noqa: BLE001 - re-raised at drain
+                with self._lock:
+                    if self._err is None:
+                        self._err = e
+            finally:
+                self._q.task_done()
+
+    def drain(self) -> None:
+        """Barrier: every submitted PUT is on the wire (or failed).
+        Re-raises the first stream error, clearing it so a reconnect
+        retry starts clean."""
+        self._q.join()
+        with self._lock:
+            err, self._err = self._err, None
+        if err is not None:
+            raise err
+
+    def stop(self) -> None:
+        with self._lock:
+            alive = self._thread is not None and self._thread.is_alive()
+        if alive:
+            self._q.put(self._SENTINEL)
 
 
 class RemoteExecutionError(RuntimeError):
@@ -182,7 +288,9 @@ class RemoteDevice:
                  timeout_s: float = 300.0,
                  protocol_version: int = protocol.VERSION,
                  qos: Optional[str] = None,
-                 tracer=None):
+                 tracer=None,
+                 quantize: Optional[bool] = None,
+                 upload_depth: Optional[int] = None):
         # url: "tcp://host:port"
         if url.startswith("tcp://"):
             url = url[len("tcp://"):]
@@ -195,6 +303,32 @@ class RemoteDevice:
         #: worker's fair dispatch queue (v4 workers; older ones ignore)
         self.qos = qos or os.environ.get(constants.ENV_REMOTING_QOS,
                                          "") or None
+        #: lossy q8 wire encoding — STRICTLY opt-in (ctor arg wins,
+        #: else TPF_REMOTING_QUANT=1/0): quantization changes result
+        #: numerics, so it is never a silent default.  Takes effect
+        #: only once the connection negotiates v6; the HELLO ``quant``
+        #: flag additionally asks the worker to q8-encode its replies.
+        if quantize is None:
+            quantize = os.environ.get(constants.ENV_REMOTING_QUANT,
+                                      "") == "1"
+        self.quantize = bool(quantize)
+        #: shard PUT frames the upload stream keeps in flight
+        if upload_depth is None:
+            try:
+                upload_depth = int(os.environ.get(
+                    constants.ENV_REMOTING_UPLOAD_DEPTH, "") or
+                    DEFAULT_UPLOAD_DEPTH)
+            except ValueError:
+                upload_depth = DEFAULT_UPLOAD_DEPTH
+        self.upload_depth = max(1, upload_depth)
+        #: per-connection q8 scratch (reset per message; the send
+        #: serializer below is the lifetime guard, docs/wire-format.md)
+        # guarded by: _send_lock
+        self._pool = protocol.BufferPool()
+        #: cumulative outbound wire accounting (raw/wire bytes, per-enc
+        #: buffer counts, upload-stream depth high-water)
+        # guarded by: _state_lock
+        self.wire_stats: Dict[str, int] = {}
         #: the worker-resolved dispatch weight (HELLO_OK, v4 workers)
         self.qos_weight: Optional[float] = None
         #: optional span recorder (tensorfusion_tpu.tracing.Tracer);
@@ -217,6 +351,9 @@ class RemoteDevice:
         self._streams: Dict[int, object] = {}
         self._seq = 0
         self._mint = itertools.count(1)   # client-minted shard buf ids
+        #: double-buffered shard-upload pipeline (created on first
+        #: sharded call; drained before every EXECUTE that used it)
+        self._upload_stream: Optional[_UploadStream] = None
         #: frame versions this client build decodes
         self._accept = tuple(v for v in protocol.SUPPORTED_VERSIONS
                              if v <= self.protocol_version)
@@ -249,6 +386,11 @@ class RemoteDevice:
             hello["max_version"] = self.protocol_version
         if self.qos is not None and self.protocol_version >= 4:
             hello["qos"] = self.qos
+        if self.quantize and self.protocol_version >= 6:
+            # ask for q8-encoded replies too; a pre-v6 worker ignores
+            # the key, and the version gate below keeps this client
+            # from ever *sending* q8 to one
+            hello["quant"] = True
         send_message(sock, "HELLO", hello, [],
                      version=protocol.HELLO_VERSION)
         kind, meta, _ = recv_message(sock, accept=self._accept)
@@ -303,6 +445,8 @@ class RemoteDevice:
                                  "_connection_lost": True}, []))
 
     def close(self) -> None:
+        if self._upload_stream is not None:
+            self._upload_stream.stop()
         with self._send_lock:
             if self._sock is not None:
                 try:
@@ -325,10 +469,29 @@ class RemoteDevice:
                 q.put(("ERROR", {"error": "device closed",
                                  "_connection_lost": True}, []))
 
+    def _quant_on(self) -> bool:
+        """q8 is live for this connection: opted in AND negotiated v6
+        (the encoder additionally version-gates, so a stale call before
+        negotiation can never leak a q8 frame)."""
+        return self.quantize and self._wire_version >= 6
+
+    def _merge_stats(self, st: Dict[str, int],
+                     extra: Optional[Dict[str, int]]) -> None:
+        """Fold one send's wire accounting into the device total and
+        the caller's per-call dict (span attribution)."""
+        with self._state_lock:
+            for k, v in st.items():
+                self.wire_stats[k] = self.wire_stats.get(k, 0) + v
+        if extra is not None:
+            for k, v in st.items():
+                extra[k] = extra.get(k, 0) + v
+
     def _submit(self, kind: str, meta: Dict[str, Any], buffers,
                 compress: bool = True,
                 want_reply: bool = True,
-                stream=None) -> Optional[Future]:
+                stream=None,
+                stats: Optional[Dict[str, int]] = None
+                ) -> Optional[Future]:
         """Send one request without waiting; the returned Future resolves
         to (kind, meta, buffers) when its response arrives.  With
         ``want_reply=False`` the request carries no seq and returns None
@@ -336,7 +499,10 @@ class RemoteDevice:
         the EXECUTE that references them).  With ``stream=`` (a Queue)
         the request is STREAMING: every reply frame echoing its seq is
         put on the queue instead of resolving a Future (GENERATE's
-        multi-frame contract); returns None."""
+        multi-frame contract); returns None.  ``stats`` additionally
+        receives this send's wire accounting (always folded into
+        ``self.wire_stats``)."""
+        st: Dict[str, int] = {}
         with self._send_lock:
             if self._sock is None:
                 # connect is deliberately serialized under the send
@@ -368,7 +534,9 @@ class RemoteDevice:
                 # tpflint: disable=blocking-under-lock,transitive-blocking-under-lock
                 send_message(self._sock, kind, wire_meta, buffers,
                              compress=compress,
-                             version=self._wire_version)
+                             version=self._wire_version,
+                             quantize=self._quant_on(),
+                             pool=self._pool, stats=st)
             except (ConnectionError, OSError):
                 # one reconnect attempt (worker restarts, idle timeouts);
                 # every other in-flight request died with the old socket
@@ -401,8 +569,11 @@ class RemoteDevice:
                 # tpflint: disable=blocking-under-lock,transitive-blocking-under-lock
                 send_message(self._sock, kind, wire_meta, buffers,
                              compress=compress,
-                             version=self._wire_version)
-            return fut
+                             version=self._wire_version,
+                             quantize=self._quant_on(),
+                             pool=self._pool, stats=st)
+        self._merge_stats(st, stats)
+        return fut
 
     def _result(self, fut: Future) -> Tuple:
         rkind, rmeta, rbufs = fut.result(timeout=self.timeout_s)
@@ -635,7 +806,7 @@ class RemoteDevice:
             return entry, leaves
 
         def send_execute(entry, leaves, extra_meta=None,
-                         want_reply=True) -> Optional[Future]:
+                         want_reply=True, stats=None) -> Optional[Future]:
             """Build + fire the (possibly sharded) EXECUTE; returns the
             raw transport future (None for fire-and-forget)."""
             exe_id, out_tree, layouts, _ = entry
@@ -653,12 +824,14 @@ class RemoteDevice:
                 return device._submit(
                     "EXECUTE", dict(extra, exe_id=exe_id,
                                     arg_refs=arg_refs),
-                    buffers, want_reply=want_reply)
+                    buffers, want_reply=want_reply, stats=stats)
             # sharded path: split host leaves per the worker's layout;
-            # big shards go out as pipelined quiet PUTs so their wire
-            # transfer overlaps the worker's scatter of earlier shards,
+            # big shards ride the double-buffered upload stream (their
+            # wire transfer overlaps both this thread's slicing of the
+            # next shard and the worker's scatter of earlier ones),
             # small ones ride the EXECUTE frame itself
             arg_shards: list = []
+            streamed = False
             for i, leaf in enumerate(leaves):
                 lay = layouts[i]
                 if isinstance(leaf, ShardedRemoteBuffer):
@@ -680,22 +853,29 @@ class RemoteDevice:
                             slice(lo, hi) for lo, hi in ent["slices"])])
                         if view.nbytes >= SHARD_PUT_MIN_BYTES:
                             sid = f"c-a{ctr}-{k}"
-                            device._submit(
-                                "PUT",
+                            if device._upload_stream is None:
+                                device._upload_stream = _UploadStream(
+                                    device, device.upload_depth)
+                            device._upload_stream.submit(
                                 {"buf_id": sid,
                                  "device_id": ent["device"],
                                  "ephemeral": True, "quiet": True},
-                                [view], want_reply=False)
+                                view, stats=stats)
+                            streamed = True
                             ids.append(sid)
                         else:
                             ids.append(None)     # inline in EXECUTE
                             buffers.append(view)
                     arg_refs.append(None)
                     arg_shards.append(ids)
+            if streamed:
+                # ordering barrier: every shard PUT is on the wire
+                # before the EXECUTE frame that consumes it
+                device._upload_stream.drain()
             return device._submit(
                 "EXECUTE", dict(extra, exe_id=exe_id, arg_refs=arg_refs,
                                 arg_shards=arg_shards), buffers,
-                want_reply=want_reply)
+                want_reply=want_reply, stats=stats)
 
         def _deadline_meta(deadline_ms):
             """deadline_ms rides the EXECUTE only on a v4 connection —
@@ -731,13 +911,31 @@ class RemoteDevice:
                 return wire, wire.ctx()
             return wire, None
 
-        def _wire_done(wire, rmeta):
+        def _call_stats(wire):
+            """Per-call wire accounting dict, or None (tracing off —
+            the device-level totals still accumulate in _submit)."""
+            return {} if wire is not None else None
+
+        def _stats_enc(stats):
+            """Dominant encoding of one call's outbound buffers."""
+            if not stats:
+                return "raw"
+            for enc in ("q8", "zlib"):
+                if stats.get(f"buffers_{enc}"):
+                    return enc
+            return "raw"
+
+        def _wire_done(wire, rmeta, stats=None):
             """Adopt the server-side span tree and close the wire span."""
             if wire is None:
                 return
             device.tracer.adopt(rmeta.get("trace_spans") or ())
             wire.finish(n_results=rmeta.get("n_results", 0),
-                        microbatched=rmeta.get("microbatched", 0))
+                        microbatched=rmeta.get("microbatched", 0),
+                        enc=_stats_enc(stats),
+                        wire_bytes=(stats or {}).get("wire_bytes", 0),
+                        overlap_depth=(stats or {}).get("overlap_depth",
+                                                        0))
 
         @functools.wraps(fn)
         def remote(*args, deadline_ms: Optional[int] = None):
@@ -756,10 +954,12 @@ class RemoteDevice:
                     extra = _deadline_meta(deadline_ms)
                     if trace_meta is not None:
                         extra = dict(extra or {}, trace=trace_meta)
-                    fut = send_execute(entry, leaves, extra_meta=extra)
+                    stats = _call_stats(wire)
+                    fut = send_execute(entry, leaves, extra_meta=extra,
+                                       stats=stats)
                     try:
                         _, rmeta, results = device._result(fut)
-                        _wire_done(wire, rmeta)
+                        _wire_done(wire, rmeta, stats)
                         if root is not None:
                             root.finish(busy_retries=busy,
                                         reconnects=reconnects)
@@ -803,7 +1003,9 @@ class RemoteDevice:
             extra = _deadline_meta(deadline_ms)
             if trace_meta is not None:
                 extra = dict(extra or {}, trace=trace_meta)
-            raw = send_execute(entry, leaves, extra_meta=extra)
+            stats = _call_stats(wire)
+            raw = send_execute(entry, leaves, extra_meta=extra,
+                               stats=stats)
             out_tree = entry[1]
             out: Future = Future()
 
@@ -817,7 +1019,7 @@ class RemoteDevice:
                             wire.finish(error=rmeta.get("code")
                                         or "error")
                         _raise_reply_error(rmeta)
-                    _wire_done(wire, rmeta)
+                    _wire_done(wire, rmeta, stats)
                     if root is not None:
                         root.finish()
                     out.set_result(jax.tree_util.tree_unflatten(

@@ -427,6 +427,23 @@ class DeviceDispatcher:
                     best = tenant.queue[0]
             return best
 
+    def peek_next_n(self, n: int) -> List[WorkItem]:
+        """The next up-to-``n`` items in approximate service order
+        (smallest finish tags across every tenant's queue head run) —
+        the worker's N-deep transfer/compute overlap window.  Same
+        contract as :meth:`peek_next`: only the dispatcher thread
+        mutates items, so the caller may stash transfer futures on
+        them; the order is advisory (a new arrival can still win the
+        next pick)."""
+        n = max(1, int(n))
+        with self._cv:
+            heads: List[WorkItem] = []
+            for tenant in self._tenants.values():
+                for item in list(tenant.queue)[:n]:
+                    heads.append(item)
+            heads.sort(key=lambda i: i.finish_tag)
+            return heads[:n]
+
     def _expire_locked(self, item: WorkItem) -> bool:
         return item.deadline_t is not None and \
             time.monotonic() > item.deadline_t

@@ -90,6 +90,37 @@ docs/serving.md) — the first *streaming* request kind:
   requests).  A saturated engine answers ``BUSY`` exactly like the
   dispatcher path.  Only v5 clients send GENERATE, so pre-v5 peers
   never see a multi-reply seq.
+
+Version 6 adds the quantized wire encoding (docs/wire-format.md),
+negotiated via HELLO exactly like v3-v5 so v2-v5 peers interop
+untouched — the frame layout is unchanged, only the per-buffer ``enc``
+vocabulary grows:
+
+- ``enc="q8"``: the buffer payload is ``[f32 per-block scales]
+  [int8 values]`` — bf16/f32/f16 arrays quantized symmetrically per
+  ``q8_block``-element block (``s = max|block| / 127``, ``q =
+  round(x / s)``), the EQuARX trick applied to shard traffic instead
+  of collectives.  LOSSY (round-trip error <= scale/2 per element),
+  therefore strictly opt-in: a buffer ships q8 only when the sender's
+  quantization policy is on (client ctor / HELLO ``quant`` flag /
+  ``TPF_REMOTING_QUANT``), the connection negotiated v6, AND the dtype
+  is a quantizable float — integer/bool/f64 buffers always take the
+  exact raw/zlib path.  Chosen adaptively per buffer alongside the
+  zlib probe: whichever encoding actually ships fewer bytes wins
+  (q8 is ~4x for f32, ~2x for bf16; zlib still wins on e.g. runs of
+  zeros, and stays lossless).
+- the encoder quantizes straight into a reusable per-connection
+  :class:`BufferPool` scratch (no intermediate ``tobytes()``), and
+  ``send_message`` ships every frame as ONE vectored
+  ``socket.sendmsg`` scatter-gather straight from the part
+  memoryviews.
+- ``WIRE_ENCODINGS`` below is the registry tpflint's
+  `protocol-exhaustive` checker verifies the encoder/decoder against —
+  a half-landed encoding (declared but not decoded, or wired without
+  being declared) fails ``make lint``.
+- HELLO: optional ``quant`` (bool) — the client's declaration that it
+  wants q8 replies (FETCH / EXECUTE_OK results) where eligible; the
+  worker never quantizes a reply the client did not ask for.
 """
 
 from __future__ import annotations
@@ -103,11 +134,13 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 MAGIC = b"TPFR"
-VERSION = 5
-#: frame versions this build can decode (v3/v4/v5 are additive over v2)
-SUPPORTED_VERSIONS = (2, 3, 4, 5)
+VERSION = 6
+#: frame versions this build can decode (v3-v6 are additive over v2)
+SUPPORTED_VERSIONS = (2, 3, 4, 5, 6)
 #: version every HELLO is framed at, so any peer can read it
 HELLO_VERSION = 2
+#: lowest wire version whose frames may carry ``enc="q8"`` buffers
+Q8_MIN_VERSION = 6
 
 # -- opcode / reply / error-code registry ---------------------------------
 #
@@ -131,6 +164,25 @@ REPLY_KINDS = ("HELLO_OK", "INFO_OK", "COMPILE_OK", "PUT_OK", "FREE_OK",
                "RESTORE_OK", "ERROR")
 #: structured ERROR ``code`` values (v4; older clients see plain ERROR)
 ERROR_CODES = ("BUSY", "DEADLINE_EXCEEDED", "needs_compile")
+#: per-buffer wire encodings, in the order they were introduced; the
+#: first entry is the wire default (a buffer desc without ``enc`` is
+#: raw).  tpflint's `protocol-exhaustive` checker verifies every
+#: non-default entry has BOTH an encoder arm (an ``enc = "<name>"``
+#: assignment) and a decoder arm (an ``enc == "<name>"`` comparison)
+#: in this module, and that no enc literal is wired without being
+#: registered here — a v6 encoding cannot half-land.
+WIRE_ENCODINGS = ("raw", "zlib", "q8")
+
+#: elements per q8 scale block — small enough that one outlier only
+#: poisons its own block's precision, big enough that the f32 scale
+#: overhead stays under 1% of the int8 payload
+Q8_BLOCK = 512
+#: buffers below this size ship exact — the quantize pass plus the
+#: per-buffer desc overhead beats the saved bytes on small payloads
+Q8_MIN_BYTES = 16 << 10
+#: dtypes eligible for q8 (lossy) encoding; ints/bools/f64 are the
+#: exact-path opt-out — they never quantize, whatever the policy says
+Q8_DTYPES = frozenset(("float32", "float16", "bfloat16"))
 
 #: buffers at or above this size are candidates for compression
 COMPRESS_MIN_BYTES = 16 << 10
@@ -169,11 +221,148 @@ def _np_dtype(name: str):
     return np.dtype(name)
 
 
+class BufferPool:
+    """Reusable per-connection scratch for q8 wire payloads.
+
+    Lifetime rule (docs/wire-format.md): views carved by :meth:`take`
+    stay valid until :meth:`reset` is next called, and ``reset`` is
+    called once per *message* by the encoder — callers must hold their
+    connection's send serializer (the client's ``_send_lock``, the
+    worker's per-connection write lock) across encode+send, which every
+    send path already does.  The pool never shrinks; a connection's
+    scratch converges to its largest message."""
+
+    def __init__(self):
+        self._buf = bytearray()
+        self._cursor = 0
+        #: accounting surfaced in wire stats: takes / regrows
+        self.takes = 0
+        self.grown = 0
+
+    def reset(self) -> None:
+        self._cursor = 0
+
+    def take(self, nbytes: int) -> memoryview:
+        if self._cursor + nbytes > len(self._buf):
+            # replace, never resize: earlier views from this message
+            # keep the old bytearray alive and stay valid
+            grow = max(nbytes, 2 * len(self._buf), 64 << 10)
+            self._buf = bytearray(grow)
+            self._cursor = 0
+            self.grown += 1
+        view = memoryview(self._buf)[self._cursor:self._cursor + nbytes]
+        self._cursor += nbytes
+        self.takes += 1
+        return view
+
+
+class Q8Array:
+    """A received q8 buffer kept in its quantized form (``dequant_q8=
+    False`` consumers — e.g. a quant-aware kernel that wants the int8
+    payload and block scales directly instead of paying the dequant)."""
+
+    __slots__ = ("q", "scales", "block", "dtype", "shape")
+
+    def __init__(self, q: np.ndarray, scales: np.ndarray, block: int,
+                 dtype: str, shape):
+        self.q = q                  # int8 [n]
+        self.scales = scales        # f32 [ceil(n/block)]
+        self.block = block
+        self.dtype = dtype          # wire dtype name to dequantize to
+        self.shape = tuple(shape)
+
+    def dequantize(self) -> np.ndarray:
+        out = self.q.astype(np.float32) * \
+            np.repeat(self.scales, self.block)[:self.q.size]
+        return out.astype(_np_dtype(self.dtype)).reshape(self.shape)
+
+
+def _q8_wire_nbytes(n: int, block: int = Q8_BLOCK) -> int:
+    nb = -(-n // block)     # ceil
+    return nb * 4 + n
+
+
+def q8_encode(arr: np.ndarray, pool: Optional[BufferPool] = None
+              ) -> Optional[memoryview]:
+    """Quantize one contiguous float array into the q8 wire layout
+    ``[f32 scales][int8 values]``, written straight into the pool's
+    scratch (no intermediate ``tobytes()``).  Returns None when the
+    array holds non-finite values (inf/nan poison the block scale —
+    the buffer must ship exact instead)."""
+    flat = np.asarray(arr, dtype=np.float32).reshape(-1)
+    n = flat.size
+    nb = -(-n // Q8_BLOCK)
+    pad = nb * Q8_BLOCK - n
+    absf = np.abs(flat)
+    if pad:
+        bm = np.empty(nb, np.float32)
+        if nb > 1:
+            bm[:-1] = absf[:(nb - 1) * Q8_BLOCK] \
+                .reshape(nb - 1, Q8_BLOCK).max(axis=1)
+        bm[-1] = absf[(nb - 1) * Q8_BLOCK:].max()
+    else:
+        bm = absf.reshape(nb, Q8_BLOCK).max(axis=1)
+    if not np.isfinite(bm).all():
+        return None
+    wire_len = _q8_wire_nbytes(n)
+    out = pool.take(wire_len) if pool is not None else \
+        memoryview(bytearray(wire_len))
+    scales = np.frombuffer(out, dtype="<f4", count=nb)
+    np.divide(np.maximum(bm, 1e-12), 127.0, out=scales)
+    q = np.frombuffer(out, dtype=np.int8, count=n, offset=nb * 4)
+    per_elem = np.repeat(scales, Q8_BLOCK)[:n]
+    tmp = flat / per_elem
+    np.rint(tmp, out=tmp)
+    np.clip(tmp, -127, 127, out=tmp)
+    q[:] = tmp.astype(np.int8)
+    return out
+
+
+def q8_decode(raw, desc: Dict[str, Any], dequant: bool = True):
+    """Decode one q8 wire payload against its (untrusted) buffer desc.
+
+    Every allocation here is bounded by the DECLARED shape/dtype before
+    any decode work happens — the q8 analog of the zlib-bomb defence:
+    the dequantized output can never exceed ``MAX_BUFFER_BYTES`` nor
+    disagree with ``raw_nbytes``, and the payload length must be
+    exactly what the shape implies (a malformed frame fails loudly
+    instead of desyncing the connection)."""
+    dtype = desc["dtype"]
+    if dtype not in Q8_DTYPES:
+        raise ValueError(f"q8 buffer with non-quantizable dtype {dtype}")
+    block = int(desc.get("q8_block") or 0)
+    if block <= 0:
+        raise ValueError("q8 buffer without a positive q8_block")
+    shape = desc["shape"]
+    n = 1
+    for dim in shape:
+        if int(dim) < 0:
+            raise ValueError("q8 buffer with negative dimension")
+        n *= int(dim)
+    out_nbytes = n * _np_dtype(dtype).itemsize
+    if out_nbytes > MAX_BUFFER_BYTES:
+        raise ValueError("q8 dequantized size exceeds cap")
+    if desc.get("raw_nbytes") != out_nbytes:
+        raise ValueError("q8 raw_nbytes disagrees with declared shape")
+    nb = -(-n // block)
+    if len(raw) != nb * 4 + n:
+        raise ValueError("q8 payload length disagrees with declared "
+                         "shape")
+    scales = np.frombuffer(raw, dtype="<f4", count=nb)
+    q = np.frombuffer(raw, dtype=np.int8, count=n, offset=nb * 4)
+    if not dequant:
+        return Q8Array(q, scales, block, dtype, shape)
+    out = q.astype(np.float32) * np.repeat(scales, block)[:n]
+    return out.astype(_np_dtype(dtype)).reshape(shape)
+
+
 def encode_message_parts(kind: str, meta: Dict[str, Any],
                          buffers: List[np.ndarray],
                          compress: bool = False,
                          version: int = VERSION,
-                         stats: Optional[Dict[str, int]] = None) -> List:
+                         stats: Optional[Dict[str, int]] = None,
+                         quantize: bool = False,
+                         pool: Optional[BufferPool] = None) -> List:
     """Wire pieces for one message: [head_bytes, buf_view, ...].
 
     Buffer payloads stay as zero-copy memoryviews over the (contiguous)
@@ -183,11 +372,21 @@ def encode_message_parts(kind: str, meta: Dict[str, Any],
     ``compress=True`` is *adaptive per buffer*: a cheap prefix probe
     decides whether deflating is worth it, and the buffer ships raw
     (flagged in its ``enc`` header field) whenever compression would
-    not actually shrink it.  ``stats``, when given, accumulates
-    ``raw_bytes`` / ``wire_bytes`` / ``buffers_zlib`` / ``buffers_raw``
-    across calls so the sender can report its realized ratio."""
+    not actually shrink it.  ``quantize=True`` (v6 connections whose
+    peer opted in) additionally offers the lossy q8 encoding to
+    eligible float buffers — per buffer, whichever candidate ships the
+    fewest bytes wins (zlib stays lossless and still wins on highly
+    compressible data).  q8 payloads are quantized straight into
+    ``pool`` (per-connection scratch; the encoder resets it, so one
+    message's views never alias an earlier message's).  ``stats``,
+    when given, accumulates ``raw_bytes`` / ``wire_bytes`` /
+    ``buffers_zlib`` / ``buffers_q8`` / ``buffers_raw`` across calls
+    so the sender can report its realized ratio."""
     descs = []
     views: List = []
+    if pool is not None:
+        pool.reset()
+    quantize = quantize and version >= Q8_MIN_VERSION
     for arr in buffers:
         arr = np.ascontiguousarray(arr)
         raw_nbytes = arr.nbytes
@@ -197,23 +396,37 @@ def encode_message_parts(kind: str, meta: Dict[str, Any],
             raise ValueError(
                 f"buffer of {raw_nbytes} bytes exceeds the "
                 f"{MAX_BUFFER_BYTES}-byte wire cap")
+        dtype = _dtype_of(arr)
         enc = "raw"
         wire = arr.reshape(-1).view(np.uint8).data   # zero-copy view
+        zbytes = None
         if compress and raw_nbytes >= COMPRESS_MIN_BYTES:
             raw = arr.tobytes()
             probe = zlib.compress(raw[:COMPRESS_PROBE_BYTES], 1)
             if len(probe) < COMPRESS_PROBE_BYTES * COMPRESS_GAIN:
                 z = zlib.compress(raw, 1)
                 if len(z) < len(raw) * COMPRESS_GAIN:
-                    enc, wire = "zlib", z
-        descs.append({"shape": list(arr.shape), "dtype": _dtype_of(arr),
-                      "nbytes": len(wire), "raw_nbytes": raw_nbytes,
-                      "enc": enc})
+                    enc, wire, zbytes = "zlib", z, len(z)
+        if quantize and dtype in Q8_DTYPES and \
+                raw_nbytes >= Q8_MIN_BYTES:
+            # adaptive vs the zlib candidate: q8's size is known up
+            # front, so only quantize when it would actually win
+            q8_len = _q8_wire_nbytes(arr.size)
+            if q8_len < (zbytes if zbytes is not None else raw_nbytes):
+                qwire = q8_encode(arr, pool)
+                if qwire is not None:       # non-finite values ship exact
+                    enc, wire = "q8", qwire
+        desc = {"shape": list(arr.shape), "dtype": dtype,
+                "nbytes": len(wire), "raw_nbytes": raw_nbytes,
+                "enc": enc}
+        if enc == "q8":
+            desc["q8_block"] = Q8_BLOCK
+        descs.append(desc)
         views.append(wire)
         if stats is not None:
             stats["raw_bytes"] = stats.get("raw_bytes", 0) + raw_nbytes
             stats["wire_bytes"] = stats.get("wire_bytes", 0) + len(wire)
-            key = "buffers_zlib" if enc == "zlib" else "buffers_raw"
+            key = f"buffers_{enc}"
             stats[key] = stats.get(key, 0) + 1
     header = json.dumps({"kind": kind, "meta": meta,
                          "buffers": descs}).encode()
@@ -224,12 +437,14 @@ def encode_message_parts(kind: str, meta: Dict[str, Any],
 def encode_message(kind: str, meta: Dict[str, Any],
                    buffers: List[np.ndarray],
                    compress: bool = False,
-                   version: int = VERSION) -> bytes:
+                   version: int = VERSION,
+                   quantize: bool = False) -> bytes:
     return b"".join(bytes(p) if not isinstance(p, (bytes, bytearray))
                     else p
                     for p in encode_message_parts(kind, meta, buffers,
                                                   compress=compress,
-                                                  version=version))
+                                                  version=version,
+                                                  quantize=quantize))
 
 
 def _read_exact(sock: socket.socket, n: int) -> bytearray:
@@ -246,23 +461,67 @@ def _read_exact(sock: socket.socket, n: int) -> bytearray:
     return buf
 
 
+#: sendmsg iovec ceiling per call — POSIX IOV_MAX is >= 1024 everywhere
+#: this runs; our frames are [header + one view per buffer], so a
+#: single call covers any realistic message
+_IOV_MAX = 512
+_HAS_SENDMSG = hasattr(socket.socket, "sendmsg")
+
+
+def _send_parts(sock: socket.socket, parts: List) -> None:
+    """One vectored ``sendmsg`` scatter-gather per frame, straight from
+    the part memoryviews — no per-part syscall train, no payload joins.
+    Partial sends (big frames vs the socket buffer) advance the iovec
+    and retry; platforms without ``sendmsg`` fall back to per-part
+    ``sendall``."""
+    views = [memoryview(p).cast("B") if not isinstance(p, memoryview)
+             else p.cast("B") for p in parts]
+    if not _HAS_SENDMSG:
+        for v in views:
+            sock.sendall(v)
+        return
+    while views:
+        sent = sock.sendmsg(views[:_IOV_MAX])
+        while sent > 0 and views:
+            if sent >= len(views[0]):
+                sent -= len(views[0])
+                views.pop(0)
+            else:
+                views[0] = views[0][sent:]
+                sent = 0
+
+
 def send_message(sock: socket.socket, kind: str, meta: Dict[str, Any],
                  buffers: List[np.ndarray], compress: bool = False,
                  version: int = VERSION,
-                 stats: Optional[Dict[str, int]] = None) -> None:
-    # scatter-gather: header and each (possibly multi-MB) buffer go out
-    # as separate sendalls straight from their memoryviews — no payload
-    # concatenation.  TCP_NODELAY (set at connect) keeps the small
-    # header from Nagle-stalling behind the previous buffer.
-    for part in encode_message_parts(kind, meta, buffers,
-                                     compress=compress, version=version,
-                                     stats=stats):
-        sock.sendall(part)
+                 stats: Optional[Dict[str, int]] = None,
+                 quantize: bool = False,
+                 pool: Optional[BufferPool] = None) -> None:
+    # vectored scatter-gather: the header and each (possibly multi-MB)
+    # buffer ship as ONE sendmsg iovec straight from their memoryviews —
+    # no payload concatenation and no per-part syscall round trips.
+    # TCP_NODELAY (set at connect) keeps the small header from
+    # Nagle-stalling behind the previous frame.
+    _send_parts(sock, encode_message_parts(kind, meta, buffers,
+                                           compress=compress,
+                                           version=version,
+                                           stats=stats,
+                                           quantize=quantize,
+                                           pool=pool))
 
 
 def recv_message(sock: socket.socket,
-                 accept: Tuple[int, ...] = SUPPORTED_VERSIONS
+                 accept: Tuple[int, ...] = SUPPORTED_VERSIONS,
+                 stats: Optional[Dict[str, int]] = None,
+                 dequant_q8: bool = True
                  ) -> Tuple[str, Dict[str, Any], List[np.ndarray]]:
+    """Read one frame.  ``stats``, when given, accumulates the same
+    ``raw_bytes`` / ``wire_bytes`` / per-enc buffer counters the send
+    side keeps, so a receiver can attribute inbound wire traffic (the
+    worker stamps them onto its upload spans).  ``dequant_q8=False``
+    hands q8 buffers back as :class:`Q8Array` (quantized payload +
+    block scales) instead of paying the dequantize — for quant-aware
+    consumers; every bounds check still runs."""
     head = _read_exact(sock, len(MAGIC) + 8)
     if head[:4] != MAGIC:
         raise ValueError("bad magic")
@@ -278,7 +537,24 @@ def recv_message(sock: socket.socket,
         if nbytes > MAX_BUFFER_BYTES or (raw_nbytes or 0) > MAX_BUFFER_BYTES:
             raise ValueError("buffer exceeds size cap")
         raw = _read_exact(sock, nbytes)
-        if desc.get("enc") == "zlib":
+        enc = desc.get("enc", "raw")
+        if stats is not None:
+            stats["raw_bytes"] = stats.get("raw_bytes", 0) + \
+                int(raw_nbytes or nbytes)
+            stats["wire_bytes"] = stats.get("wire_bytes", 0) + nbytes
+            key = f"buffers_{enc}"
+            stats[key] = stats.get(key, 0) + 1
+        if enc == "q8":
+            # like the frame-version gate above, enforced below the
+            # feature gate: a pre-v6 frame must never smuggle a q8
+            # buffer past a peer that did not negotiate it
+            if version < Q8_MIN_VERSION:
+                raise ValueError(
+                    f"q8 buffer in a v{version} frame (q8 needs "
+                    f"protocol >= {Q8_MIN_VERSION})")
+            buffers.append(q8_decode(raw, desc, dequant=dequant_q8))
+            continue
+        if enc == "zlib":
             # raw_nbytes must be a positive bound: zlib's max_length=0
             # means *unlimited*, so 0 (or a missing/negative value) would
             # turn the bounded decompression below into a bomb vector

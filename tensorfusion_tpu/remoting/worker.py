@@ -53,6 +53,17 @@ Hardening (beyond the round-1 prototype):
   half of live migration that the provider ABI's device-level
   ``tpf_snapshot`` delegates to the buffer owner (accelerator.h:364-390
   analog).
+- **quantized wire + deeper transfer/compute overlap** (protocol v6,
+  docs/wire-format.md): connections whose client opted in (HELLO
+  ``quant`` flag, or ``TPF_REMOTING_QUANT=1`` forcing it worker-side)
+  get q8-encoded reply buffers — int8 with per-block scales, quantized
+  into a per-connection buffer pool, vectored ``sendmsg`` sends —
+  while integer/bool/f64 results always ship exact.  The host->device
+  prefetch overlap now runs ``TPF_REMOTING_PREFETCH_DEPTH`` (default
+  2) queued items deep instead of one, with the per-stream depth
+  accounting surfaced via INFO and the ``tpf_remote_dispatch``
+  metrics, and inbound wire accounting stamped on ``worker.upload``
+  spans.
 """
 
 from __future__ import annotations
@@ -90,6 +101,7 @@ class RemoteVTPUWorker:
                  meter_client=None, token: Optional[str] = None,
                  max_resident_bytes: int = 0,
                  compress: Optional[bool] = None,
+                 quantize: Optional[bool] = None,
                  insecure: Optional[bool] = None,
                  protocol_version: int = protocol.VERSION,
                  dispatch_mode: Optional[str] = None,
@@ -132,6 +144,29 @@ class RemoteVTPUWorker:
             env = os.environ.get("TPF_REMOTING_COMPRESS", "")
             compress = {"1": True, "0": False}.get(env)
         self.compress: Optional[bool] = compress   # None = auto
+        #: reply quantization policy (protocol v6, lossy q8 — see
+        #: docs/wire-format.md).  None = honor the client's HELLO
+        #: ``quant`` flag (the worker never quantizes a reply the
+        #: client did not ask for); True/False (constructor or
+        #: TPF_REMOTING_QUANT=1/0) force it for every v6 connection /
+        #: never.  Either way pre-v6 connections are untouched.
+        if quantize is None:
+            env = os.environ.get(constants.ENV_REMOTING_QUANT, "")
+            quantize = {"1": True, "0": False}.get(env)
+        self.quantize: Optional[bool] = quantize   # None = client opt-in
+        #: host->device prefetch overlap depth (queued items whose
+        #: uploads start while the current launch runs)
+        try:
+            self.prefetch_depth = max(1, int(os.environ.get(
+                constants.ENV_REMOTING_PREFETCH_DEPTH, "") or 2))
+        except ValueError:
+            self.prefetch_depth = 2
+        #: upload-overlap accounting (prefetched items, in-flight
+        #: transfer count + high-water) — surfaced via INFO and the
+        #: tpf_remote_dispatch metric lines
+        # guarded by: _lock
+        self._upload_stats: Dict[str, int] = {
+            "prefetched_total": 0, "inflight": 0, "high_water": 0}
         #: realized compression accounting (reported by INFO)
         # guarded by: _lock
         self._wire_stats: Dict[str, int] = {}
@@ -249,6 +284,23 @@ class RemoteVTPUWorker:
                 self.compress_on = outer.compress if \
                     outer.compress is not None else \
                     peer not in ("127.0.0.1", "::1", "localhost")
+                # q8 replies: off until the HELLO negotiation lands a
+                # v6 connection whose client asked (or policy forces)
+                self.client_quant = False
+                self.quant_on = False
+                #: per-connection q8 scratch for reply frames (reset
+                #: per message under the write lock — the lifetime
+                #: rule in docs/wire-format.md)
+                self.pool = protocol.BufferPool()
+
+            def requant(self) -> None:
+                """Recompute the reply-quantization decision after a
+                HELLO (needs both the negotiated version and the
+                client's ``quant`` flag)."""
+                want = outer.quantize if outer.quantize is not None \
+                    else self.client_quant
+                self.quant_on = bool(want) and \
+                    self.wire_version >= protocol.Q8_MIN_VERSION
 
             def negotiate(self, meta) -> int:
                 try:
@@ -306,6 +358,7 @@ class RemoteVTPUWorker:
                             for grp in meta["arg_shards"]]
                     meta["_conn_ns"] = conn_ns
                     meta["_wire_version"] = self.wire_version
+                    meta["_quant_on"] = self.quant_on
                     return meta
                 # Read-ahead: decode the next pipelined request while the
                 # current one computes, so inbound wire time overlaps
@@ -319,8 +372,16 @@ class RemoteVTPUWorker:
                 def _reader():
                     try:
                         while True:
-                            inbox.put(recv_message(self.request,
-                                                   accept=self.accept))
+                            rx: Dict[str, int] = {}
+                            kind, meta, buffers = recv_message(
+                                self.request, accept=self.accept,
+                                stats=rx)
+                            # inbound wire accounting rides the meta so
+                            # worker.upload spans can attribute enc +
+                            # bytes per request (underscore keys never
+                            # echo into replies)
+                            meta["_rx_wire"] = rx
+                            inbox.put((kind, meta, buffers))
                     except (ConnectionError, OSError, ValueError):
                         inbox.put(None)
 
@@ -343,15 +404,26 @@ class RemoteVTPUWorker:
                                 # wlock is this connection's frame-write
                                 # serializer (dispatcher thread replies
                                 # race the handler thread's); the send
-                                # IS the critical section
+                                # IS the critical section.  ``compress``
+                                # marks result-carrying replies, so it
+                                # also gates the (client-opted) q8 path.
+                                # Encode (filling st) and merge BEFORE
+                                # the bytes hit the wire, so a client
+                                # reading INFO right after this reply
+                                # always sees it accounted.
+                                parts = protocol.encode_message_parts(
+                                    rkind, rmeta, rbufs,
+                                    compress=compress
+                                    and self.compress_on,
+                                    version=self.wire_version,
+                                    quantize=compress
+                                    and self.quant_on,
+                                    pool=self.pool,
+                                    stats=st)
+                                outer._merge_wire_stats(st)
                                 # tpflint: disable=blocking-under-lock,transitive-blocking-under-lock
-                                send_message(self.request, rkind, rmeta,
-                                             rbufs,
-                                             compress=compress
-                                             and self.compress_on,
-                                             version=self.wire_version,
-                                             stats=st)
-                            outer._merge_wire_stats(st)
+                                protocol._send_parts(self.request,
+                                                     parts)
 
                         if kind == "HELLO":
                             # repeated HELLO on an authed connection is a
@@ -361,9 +433,11 @@ class RemoteVTPUWorker:
                             qos = meta.get("qos") or self.qos
                             if qos != tenant.qos:
                                 outer.dispatcher.set_qos(tenant, qos)
+                            self.client_quant = bool(meta.get("quant"))
                             reply("HELLO_OK",
                                   {"version": self.negotiate(meta),
                                    "qos_weight": qos_weight(qos)}, [])
+                            self.requant()
                             continue
                         try:
                             if kind == "EXECUTE":
@@ -418,11 +492,13 @@ class RemoteVTPUWorker:
                 # the tenant's QoS class rides the HELLO; it becomes the
                 # connection's dispatch weight once the tenant registers
                 self.qos = meta.get("qos") or self.qos
+                self.client_quant = bool(meta.get("quant"))
                 # negotiate before replying so HELLO_OK itself is framed
                 # at the agreed version (both ends accept it: v3 clients
                 # read v2 and v3, v2 clients only ever negotiate 2)
                 reply("HELLO_OK", {"version": self.negotiate(meta),
                                    "qos_weight": qos_weight(self.qos)})
+                self.requant()
                 return True
 
         class Server(socketserver.ThreadingTCPServer):
@@ -974,6 +1050,9 @@ class RemoteVTPUWorker:
         prefetch overlap already started for this item."""
         devf = item.meta.pop("_dev_args", None)
         if devf is not None:
+            with self._lock:
+                self._upload_stats["inflight"] = max(
+                    0, self._upload_stats["inflight"] - 1)
             return [f.result() for f in devf]
         return [np.asarray(b) for b in item.buffers]
 
@@ -998,34 +1077,52 @@ class RemoteVTPUWorker:
         # lock (other connections need it more than we do)
         return [self._resolve(a) for a in args]
 
+    def upload_stats(self) -> Dict[str, int]:
+        """Upload-stream depth accounting (INFO + tpf_remote_dispatch):
+        how many queued items had their host->device transfers started
+        ahead of dispatch, how many are in flight now, and the
+        high-water overlap depth."""
+        with self._lock:
+            return dict(self._upload_stats, depth=self.prefetch_depth)
+
     def _prefetch_next(self, peek_next) -> None:
         """Transfer/compute overlap: while the launch just issued runs
-        on the devices, start the *next* queued item's host->device
-        uploads on the scatter pool, so its arguments are resident by
-        the time the dispatcher reaches it."""
+        on the devices, start the next ``prefetch_depth`` queued items'
+        host->device uploads on the scatter pool, so their arguments
+        are resident by the time the dispatcher reaches them (the T3
+        discipline, one step beyond the old single-item prefetch)."""
         if peek_next is None:
             return
-        nxt = peek_next()
-        if nxt is None or not nxt.buffers or \
-                nxt.meta.get("_dev_args") is not None or \
-                nxt.meta.get("arg_refs") is not None or \
-                nxt.meta.get("arg_shards") is not None:
-            return
-        with self._lock:
-            plain = nxt.exe_id in self._exe_cache
-        if not plain:
-            return
-        import jax
+        upcoming = self.dispatcher.peek_next_n(self.prefetch_depth)
+        started = 0
+        for nxt in upcoming:
+            if nxt is None or not nxt.buffers or \
+                    nxt.meta.get("_dev_args") is not None or \
+                    nxt.meta.get("arg_refs") is not None or \
+                    nxt.meta.get("arg_shards") is not None:
+                continue
+            with self._lock:
+                plain = nxt.exe_id in self._exe_cache
+            if not plain:
+                continue
+            import jax
 
-        try:
-            pool = self._pool()
-            nxt.meta["_dev_args"] = [
-                pool.submit(jax.device_put, np.asarray(b))
-                for b in nxt.buffers]
-        except Exception:  # noqa: BLE001 - overlap is advisory
-            log.debug("prefetch overlap failed; EXECUTE will transfer "
-                      "inline", exc_info=True)
-            nxt.meta.pop("_dev_args", None)
+            try:
+                pool = self._pool()
+                nxt.meta["_dev_args"] = [
+                    pool.submit(jax.device_put, np.asarray(b))
+                    for b in nxt.buffers]
+                started += 1
+            except Exception:  # noqa: BLE001 - overlap is advisory
+                log.debug("prefetch overlap failed; EXECUTE will "
+                          "transfer inline", exc_info=True)
+                nxt.meta.pop("_dev_args", None)
+        if started:
+            with self._lock:
+                st = self._upload_stats
+                st["prefetched_total"] += started
+                st["inflight"] += started
+                st["high_water"] = max(st["high_water"], st["inflight"])
 
     def _stacked_fn(self, exe_id: str, k: int):
         """Fused k-request launch for a micro-batch-enabled executable:
@@ -1127,16 +1224,31 @@ class RemoteVTPUWorker:
 
         return flush
 
+    @staticmethod
+    def _rx_enc(rx: Dict[str, int]) -> str:
+        """Dominant inbound wire encoding of one request's buffers."""
+        for enc in ("q8", "zlib"):
+            if rx.get(f"buffers_{enc}"):
+                return enc
+        return "raw"
+
     def _upload_span(self, item: WorkItem, start_s: float,
                      n_args: int) -> None:
         """worker.upload span: argument resolution + host->device
-        transfer time for one traced item."""
+        transfer time for one traced item, stamped with the request's
+        inbound wire accounting and the overlap depth in flight."""
         if not item.trace:
             return
+        rx = item.meta.get("_rx_wire") or {}
+        with self._lock:
+            depth = self._upload_stats["inflight"]
         d = self.tracer.record_span(
             "worker.upload", start_s, self.tracer.clock.now(),
             parent=item.trace,
-            attrs={"exe_id": item.exe_id, "args": n_args})
+            attrs={"exe_id": item.exe_id, "args": n_args,
+                   "enc": self._rx_enc(rx),
+                   "wire_bytes": rx.get("wire_bytes", 0),
+                   "overlap_depth": depth})
         if d is not None:
             item.trace_spans.append(d)
 
@@ -1360,6 +1472,8 @@ class RemoteVTPUWorker:
                 "device_kind": getattr(dev, "device_kind", ""),
                 "n_devices": len(devices),
                 "protocol_version": self.protocol_version,
+                "quant_on": bool(meta.get("_quant_on")),
+                "upload_overlap": self.upload_stats(),
                 "dispatch": self.dispatcher.snapshot(),
                 "serving": self.engine.snapshot()
                 if self.engine is not None else None,

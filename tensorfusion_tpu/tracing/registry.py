@@ -23,7 +23,8 @@ SPAN_SCHEMA = {
         "attrs": ("exe_id", "cached"),
     },
     "client.wire": {
-        "attrs": ("exe_id", "deadline_ms", "n_results", "microbatched"),
+        "attrs": ("exe_id", "deadline_ms", "n_results", "microbatched",
+                  "enc", "wire_bytes", "overlap_depth"),
     },
     "dispatcher.queue": {
         "attrs": ("qos", "tenant", "wait_ms"),
@@ -32,7 +33,8 @@ SPAN_SCHEMA = {
         "attrs": ("exe_id", "batch", "mflops"),
     },
     "worker.upload": {
-        "attrs": ("exe_id", "args"),
+        "attrs": ("exe_id", "args", "enc", "wire_bytes",
+                  "overlap_depth"),
     },
     "worker.flush": {
         "attrs": ("exe_id", "results"),

@@ -190,3 +190,38 @@ def test_watch_scale_fast():
     assert inproc["writes_per_s_idle"] > 0
     assert list(inproc["retention_pct_reconcile_mode"].values())[0] > 0
     assert result["flags"]["shared_ring_fanout"] is True
+
+
+def test_artifact_stamps_backend_evidence_and_diff(tmp_path):
+    """Provenance fix (ISSUE 9): every artifact write_artifact produces
+    carries `backend_evidence` (tpu | cpu-fallback, derived from the
+    measured platform), and a rewrite surfaces the previous record's
+    evidence in `backend_evidence_diff` — so real-chip revalidation is
+    mechanically findable from the artifact alone."""
+    sys.path.insert(0, str(REPO_ROOT))
+    from benchmarks._artifact import backend_evidence, write_artifact
+
+    assert backend_evidence("tpu") == "tpu"
+    assert backend_evidence("TPU v5e") == "tpu"
+    assert backend_evidence("cpu") == "cpu-fallback"
+    assert backend_evidence(None) == "cpu-fallback"
+
+    old = os.environ.get("TPF_BENCH_RESULTS_DIR")
+    os.environ["TPF_BENCH_RESULTS_DIR"] = str(tmp_path)
+    try:
+        p = write_artifact("provenance_smoke",
+                           {"metric": "m", "platform": "cpu"})
+        first = json.loads(p.read_text())
+        assert first["backend_evidence"] == "cpu-fallback"
+        assert "backend_evidence_diff" not in first  # nothing before it
+        p = write_artifact("provenance_smoke",
+                           {"metric": "m", "platform": "tpu"})
+        second = json.loads(p.read_text())
+        assert second["backend_evidence"] == "tpu"
+        assert second["backend_evidence_diff"] == {
+            "previous": "cpu-fallback", "current": "tpu"}
+    finally:
+        if old is None:
+            os.environ.pop("TPF_BENCH_RESULTS_DIR", None)
+        else:
+            os.environ["TPF_BENCH_RESULTS_DIR"] = old

@@ -732,3 +732,361 @@ def test_transparent_pjrt_pipelined_errors_surface():
             out[-1500:]
     finally:
         target.stop()
+
+
+# -- protocol v6: quantized wire shards, vectored sends, upload stream ---
+#
+# ISSUE 9 tentpole (docs/wire-format.md): the lossy q8 per-buffer
+# encoding (int8 + per-block f32 scales), strictly opt-in and
+# HELLO-negotiated like v3-v5; the double-buffered shard-upload stream;
+# and the q8 arm of the framing layer's allocation caps.
+
+
+def _socket_roundtrip(buffers, quantize=True, compress=False,
+                      version=None, dequant_q8=True):
+    """send_message -> recv_message over a socketpair; returns
+    (received buffers, sender stats)."""
+    import socket as _socket
+
+    from tensorfusion_tpu.remoting import protocol as P
+
+    a, b = _socket.socketpair()
+    stats, out = {}, {}
+
+    def _send():
+        P.send_message(a, "PUT", {}, buffers, compress=compress,
+                       version=version or P.VERSION,
+                       quantize=quantize, pool=P.BufferPool(),
+                       stats=stats)
+
+    t = threading.Thread(target=_send)
+    t.start()
+    try:
+        out["msg"] = P.recv_message(b, dequant_q8=dequant_q8)
+    finally:
+        t.join(timeout=30)
+        a.close()
+        b.close()
+    return out["msg"][2], stats
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float16", "bfloat16"])
+@pytest.mark.parametrize("shape", [(100_000,), (257, 129), (3, 512, 9)])
+def test_q8_roundtrip_error_bounded_per_block(dtype, shape):
+    """Numerics guardrail (property-style): a q8 round trip never moves
+    any element by more than half its block's scale (s = max|block| /
+    127), across float dtypes and non-block-aligned shapes."""
+    from tensorfusion_tpu.remoting import protocol as P
+
+    if dtype == "bfloat16":
+        import ml_dtypes
+
+        np_dtype = ml_dtypes.bfloat16
+    else:
+        np_dtype = np.dtype(dtype)
+    rng = np.random.default_rng(42)
+    x = (rng.standard_normal(shape) * rng.uniform(0.1, 30)) \
+        .astype(np_dtype)
+    got, stats = _socket_roundtrip([x])
+    assert stats["buffers_q8"] == 1, stats
+    assert stats["wire_bytes"] < stats["raw_bytes"], stats
+    y = got[0]
+    assert y.shape == x.shape and y.dtype == x.dtype
+    xf = np.asarray(x, np.float32).reshape(-1)
+    yf = np.asarray(y, np.float32).reshape(-1)
+    # the dequantized value re-rounds into the wire dtype: allow one
+    # ulp of the output on top of the quantization bound
+    ulp = {"float32": 2.0 ** -20, "float16": 2.0 ** -10,
+           "bfloat16": 2.0 ** -7}[dtype]
+    n = xf.size
+    for blk in range(-(-n // P.Q8_BLOCK)):
+        seg = slice(blk * P.Q8_BLOCK, min((blk + 1) * P.Q8_BLOCK, n))
+        scale = max(float(np.abs(xf[seg]).max()), 1e-12) / 127.0
+        bound = scale / 2 * 1.001 + float(np.abs(xf[seg]).max()) * ulp
+        err = float(np.abs(xf[seg] - yf[seg]).max())
+        assert err <= bound, (dtype, shape, blk, err, bound)
+
+
+def test_q8_exact_path_for_integer_bool_f64_dtypes():
+    """The exact-path opt-out: integer/bool/f64 buffers never quantize,
+    whatever the sender's policy says — bit-exact round trips."""
+    for arr in (np.arange(100_000, dtype=np.int32),
+                np.arange(50_000, dtype=np.int8),
+                (np.arange(100_000) % 3 == 0),
+                np.linspace(0, 1, 50_000)):          # float64
+        got, stats = _socket_roundtrip([arr])
+        assert stats.get("buffers_q8") is None, (arr.dtype, stats)
+        np.testing.assert_array_equal(got[0], arr)
+
+
+def test_q8_small_and_nonfinite_buffers_ship_exact():
+    """Buffers under Q8_MIN_BYTES and buffers holding inf/nan (which
+    would poison a block scale) fall back to the exact raw path."""
+    from tensorfusion_tpu.remoting import protocol as P
+
+    small = np.ones(16, np.float32)
+    assert small.nbytes < P.Q8_MIN_BYTES
+    got, stats = _socket_roundtrip([small])
+    assert stats.get("buffers_q8") is None
+    np.testing.assert_array_equal(got[0], small)
+
+    bad = np.ones(100_000, np.float32)
+    bad[12345] = np.inf
+    bad[54321] = np.nan
+    got, stats = _socket_roundtrip([bad])
+    assert stats.get("buffers_q8") is None, stats
+    np.testing.assert_array_equal(got[0], bad)
+
+
+def test_q8_keep_quantized_for_quant_aware_consumers():
+    """``dequant_q8=False`` hands back the Q8Array (int8 payload +
+    block scales) — every bounds check still runs, and dequantize()
+    matches what the dequant path would have produced."""
+    from tensorfusion_tpu.remoting import protocol as P
+
+    x = np.random.default_rng(3).standard_normal(70_000) \
+        .astype(np.float32)
+    kept, _ = _socket_roundtrip([x], dequant_q8=False)
+    q8 = kept[0]
+    assert isinstance(q8, P.Q8Array)
+    assert q8.q.dtype == np.int8 and q8.q.size == x.size
+    assert q8.scales.size == -(-x.size // P.Q8_BLOCK)
+    deq, _ = _socket_roundtrip([x], dequant_q8=True)
+    np.testing.assert_array_equal(q8.dequantize(), deq[0])
+
+
+def _q8_frame(desc_overrides=None, payload=None, version=None,
+              shape=(100_000,)):
+    """Hand-craft one q8-encoded PUT frame (possibly malformed)."""
+    import json as _json
+    import struct as _struct
+
+    from tensorfusion_tpu.remoting import protocol as P
+
+    x = np.zeros(shape, np.float32)
+    wire = bytes(P.q8_encode(x))
+    desc = {"shape": list(shape), "dtype": "float32",
+            "nbytes": len(wire), "raw_nbytes": x.nbytes,
+            "enc": "q8", "q8_block": P.Q8_BLOCK}
+    desc.update(desc_overrides or {})
+    if payload is not None:
+        wire = payload
+        desc["nbytes"] = len(wire)
+    header = _json.dumps({"kind": "PUT", "meta": {},
+                          "buffers": [desc]}).encode()
+    return (P.MAGIC
+            + _struct.pack("<II", version or P.VERSION, len(header))
+            + header + wire)
+
+
+def _recv_raw_frame(frame):
+    import socket as _socket
+
+    from tensorfusion_tpu.remoting import protocol as P
+
+    a, b = _socket.socketpair()
+    try:
+        a.sendall(frame)
+        return P.recv_message(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_q8_malformed_frames_rejected():
+    """The framing layer's allocation caps bound the q8 dequant output
+    exactly like the zlib-bomb defence: a frame whose declared shape,
+    raw_nbytes, or payload length disagree fails loudly instead of
+    allocating or desyncing."""
+    # well-formed baseline decodes
+    kind, _, bufs = _recv_raw_frame(_q8_frame())
+    assert kind == "PUT" and bufs[0].shape == (100_000,)
+    # declared shape would dequantize past the wire cap (tiny payload,
+    # huge declared alloc — the bomb shape)
+    with pytest.raises(ValueError, match="cap|exceeds"):
+        _recv_raw_frame(_q8_frame(
+            {"shape": [1 << 20, 1 << 12], "raw_nbytes": 1 << 34}))
+    # raw_nbytes disagreeing with the declared shape
+    with pytest.raises(ValueError, match="raw_nbytes"):
+        _recv_raw_frame(_q8_frame({"raw_nbytes": 4 * 100_000 + 4}))
+    # truncated payload vs the declared shape
+    with pytest.raises(ValueError, match="length"):
+        _recv_raw_frame(_q8_frame(payload=b"\x00" * 1000))
+    # missing/garbage block size
+    with pytest.raises(ValueError, match="q8_block"):
+        _recv_raw_frame(_q8_frame({"q8_block": 0}))
+    # q8 must not ride a pre-v6 frame (the feature-gate backstop)
+    with pytest.raises(ValueError, match="q8.*v5|protocol"):
+        _recv_raw_frame(_q8_frame(version=5))
+    # non-quantizable dtype claimed quantized
+    with pytest.raises(ValueError, match="dtype"):
+        _recv_raw_frame(_q8_frame({"dtype": "int32"}))
+
+
+def test_vectored_send_multibuffer_roundtrip():
+    """One vectored sendmsg per frame survives partial sends: a frame
+    much larger than any socket buffer, spread over several buffers,
+    arrives bit-exact."""
+    rng = np.random.default_rng(0)
+    bufs = [rng.integers(0, 255, 2_000_003, dtype=np.uint8),
+            rng.standard_normal(1_000_001).astype(np.float64),
+            np.arange(7, dtype=np.int16),
+            rng.integers(-9, 9, (513, 1027), dtype=np.int64)]
+    got, _ = _socket_roundtrip(bufs, quantize=False)
+    for want, have in zip(bufs, got):
+        np.testing.assert_array_equal(want, have)
+
+
+def test_e2e_q8_execute_wire_bytes_halved(worker):
+    """Opted-in client against a v6 worker: eligible float traffic
+    ships q8 in BOTH directions (>= 2x fewer wire bytes — the
+    shard-upload acceptance floor; ~4x for f32), error stays inside
+    the per-element bound, and a non-opted client on the same worker
+    still round-trips bit-exact."""
+    dev = RemoteDevice(worker.url, quantize=True)
+    rng = np.random.default_rng(5)
+    a = rng.standard_normal((512, 256)).astype(np.float32)
+    remote = dev.remote_jit(lambda v: v * 2.0 + 1.0)
+    got = np.asarray(remote(a))
+    want = a * 2.0 + 1.0
+    # in-quant error doubled by the fn, plus out-quant error
+    bound = (np.abs(a).max() / 127.0) + (np.abs(want).max() / 127.0 / 2)
+    assert np.abs(got - want).max() <= bound * 1.05
+    st = dict(dev.wire_stats)
+    assert st["buffers_q8"] >= 1
+    assert st["raw_bytes"] >= 2 * st["wire_bytes"], st
+    info = dev.info()
+    assert info["quant_on"] is True
+    tx = info["wire_compression"]
+    assert tx.get("buffers_q8", 0) >= 1, tx   # reply side quantized too
+    dev.close()
+
+    exact = RemoteDevice(worker.url)           # no opt-in: exact wire
+    ref = exact.put(a)
+    np.testing.assert_array_equal(ref.fetch(), a)
+    assert exact.info()["quant_on"] is False
+    assert "buffers_q8" not in exact.wire_stats
+    ref.free()
+    exact.close()
+
+
+@pytest.mark.parametrize("old_version", [4, 5])
+def test_interop_v6_client_never_sends_q8_to_old_worker(old_version):
+    """Mixed-version interop: an opted-in v6 client against a v4/v5
+    worker negotiates down and NEVER emits a q8 frame — results stay
+    bit-exact, exactly as an old client expects."""
+    old = RemoteVTPUWorker(protocol_version=old_version)
+    old.start()
+    try:
+        dev = RemoteDevice(old.url, quantize=True)
+        x = np.random.default_rng(1).standard_normal((256, 256)) \
+            .astype(np.float32)
+        ref = dev.put(x)
+        np.testing.assert_array_equal(ref.fetch(), x)
+        remote = dev.remote_jit(lambda a: a + 1.0)
+        np.testing.assert_allclose(np.asarray(remote(x)), x + 1.0,
+                                   rtol=1e-6)
+        assert dev._wire_version == old_version
+        assert "buffers_q8" not in dev.wire_stats, dev.wire_stats
+        ref.free()
+        dev.close()
+    finally:
+        old.stop()
+
+
+def test_interop_v5_client_against_v6_worker_stays_exact(worker):
+    """The reverse direction: a v5-pinned client (old build) against a
+    v6 worker — the worker must never quantize replies the client
+    cannot decode."""
+    dev = RemoteDevice(worker.url, protocol_version=5)
+    x = np.random.default_rng(2).standard_normal((256, 256)) \
+        .astype(np.float32)
+    ref = dev.put(x)
+    np.testing.assert_array_equal(ref.fetch(), x)
+    assert dev._wire_version == 5
+    info = dev.info()
+    assert info["quant_on"] is False
+    ref.free()
+    dev.close()
+
+
+def test_upload_stream_sharded_q8_and_exact(worker):
+    """Sharded per-call uploads ride the double-buffered upload stream:
+    ordering holds (PUTs land before the EXECUTE), results match, the
+    stream's depth accounting registers overlap, and ephemeral shards
+    still never leak.  Unquantized, the sharded path stays exact."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the virtual 8-device CPU mesh")
+    fn = _sharded_fn(4)
+    rng = np.random.default_rng(11)
+    # random data on purpose: constant arrays would (correctly) lose
+    # the adaptive race to lossless zlib, and this test is about q8
+    w = (rng.standard_normal((64, 64)) * 0.01).astype(np.float32)
+    x = rng.standard_normal((1024 * 4, 64)).astype(np.float32)
+    # 256KB/shard >= SHARD_PUT_MIN_BYTES: the upload-stream PUT path
+
+    exact_dev = RemoteDevice(worker.url)       # quantize off
+    remote = exact_dev.remote_jit(fn)
+    want = np.asarray(fn(jnp.asarray(w), jnp.asarray(x)))
+    np.testing.assert_allclose(np.asarray(remote(w, x)), want,
+                               rtol=1e-5, atol=1e-5)
+    assert exact_dev._upload_stream is not None
+    assert exact_dev._upload_stream.puts >= 4
+    exact_dev.close()
+
+    q8_dev = RemoteDevice(worker.url, quantize=True)
+    remote = q8_dev.remote_jit(fn)
+    for _ in range(2):                          # stream reuse
+        got = np.asarray(remote(w, x))
+        assert np.abs(got - want).max() < 0.05
+    st = dict(q8_dev.wire_stats)
+    assert st["buffers_q8"] >= 4               # the shard PUTs
+    assert st["raw_bytes"] >= 2 * st["wire_bytes"], st
+    assert st["upload_puts"] >= 8              # 4 shards x 2 calls
+    assert st["upload_overlap_high_water"] >= 1   # frames in flight
+    assert q8_dev.info()["resident_bytes"] == 0   # ephemerals consumed
+    q8_dev.close()
+
+
+def test_worker_prefetch_depth_accounting(worker):
+    """The worker's transfer/compute overlap runs N queued items deep:
+    prefetched items get _dev_args stamped, the depth accounting
+    tracks in-flight transfers, and consumption drains it back to
+    zero."""
+    from tensorfusion_tpu.remoting.dispatch import WorkItem
+
+    # deterministic unit drive: hand _prefetch_next a crafted backlog
+    exe_id = None
+    dev = RemoteDevice(worker.url)
+    remote = dev.remote_jit(lambda a: a * 3.0)
+    x = np.ones((8, 8), np.float32)
+    remote(x)                                   # compile + cache
+    with worker._lock:
+        exe_id = next(iter(worker._exe_cache))
+    items = [WorkItem("EXECUTE", {"exe_id": exe_id}, [x + i],
+                      lambda *a, **k: None, 1.0, exe_id, None, None)
+             for i in range(3)]
+    worker.dispatcher.peek_next_n = lambda n: items[:n]
+    try:
+        worker._prefetch_next(lambda: items[0])
+        stamped = [i for i in items if i.meta.get("_dev_args")]
+        assert len(stamped) == min(worker.prefetch_depth, len(items))
+        stats = worker.upload_stats()
+        assert stats["prefetched_total"] >= len(stamped)
+        assert stats["inflight"] == len(stamped)
+        assert stats["high_water"] >= len(stamped)
+        assert stats["depth"] == worker.prefetch_depth
+        for item in stamped:                    # consume
+            worker._inline_args(item)
+        assert worker.upload_stats()["inflight"] == 0
+    finally:
+        del worker.dispatcher.peek_next_n       # restore class method
+    # the accounting also rides INFO and the metrics lines
+    info = dev.info()
+    assert info["upload_overlap"]["depth"] == worker.prefetch_depth
+    from tensorfusion_tpu.hypervisor.metrics import remote_dispatch_lines
+
+    lines = remote_dispatch_lines(worker, "n1", 123)
+    assert any("upload_overlap_high_water" in ln for ln in lines
+               if ln.startswith("tpf_remote_dispatch"))
+    dev.close()

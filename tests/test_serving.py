@@ -751,3 +751,26 @@ def test_webhook_injects_remoting_qos_env():
     mutator.handle(pod)
     assert pod.spec.containers[0].env[constants.ENV_REMOTING_QOS] == \
         constants.QOS_HIGH
+
+
+def test_generate_token_parity_q8_vs_raw(serving_worker, params):
+    """Numerics guardrail (ISSUE 9): a remote GENERATE through a
+    q8-opted v6 connection produces byte-identical greedy tokens to a
+    raw connection — token frames carry no float buffers, so the
+    quantized wire must not perturb serving output at all."""
+    from tensorfusion_tpu.remoting import RemoteDevice
+
+    prompt = [3, 1, 4, 1, 5]
+    raw_dev = RemoteDevice(serving_worker.url)
+    want = raw_dev.generate(prompt, 6)["tokens"]
+    raw_dev.close()
+    q8_dev = RemoteDevice(serving_worker.url, quantize=True)
+    got = q8_dev.generate(prompt, 6)
+    assert q8_dev._wire_version >= 6
+    assert got["tokens"] == want
+    assert got["finish_reason"] == "length"
+    # and the greedy reference agrees end to end
+    ref = [int(x) for x in np.asarray(llama.generate(
+        params, jnp.asarray([prompt], jnp.int32), 6, CFG))[0]]
+    assert got["tokens"] == ref
+    q8_dev.close()

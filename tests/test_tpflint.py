@@ -679,3 +679,57 @@ def test_wall_clock_baseline_empty_at_head():
     findings = run_paths(["tensorfusion_tpu"], REPO,
                          checks={"wall-clock-direct"})
     assert findings == [], [f.render() for f in findings]
+
+
+# -- protocol-exhaustive: WIRE_ENCODINGS (v6) ------------------------------
+
+PROTO_ENC_OK = PROTO_OK + """
+    WIRE_ENCODINGS = ("raw", "zlib", "q8")
+
+    def encode(arr, compress, quantize):
+        enc = "raw"
+        if compress:
+            enc, wire = "zlib", deflate(arr)
+        if quantize:
+            enc, wire = "q8", quant(arr)
+        return enc
+
+    def decode(desc, raw):
+        enc = desc.get("enc", "raw")
+        if enc == "q8":
+            return dq(raw)
+        if enc == "zlib":
+            return inflate(raw)
+        return raw
+"""
+
+
+def test_wire_encodings_clean_set_passes():
+    assert protocol_exhaustive.run_project(
+        proto_files(proto=PROTO_ENC_OK), REPO) == []
+
+
+def test_wire_encoding_declared_but_not_decoded_fails():
+    bad = PROTO_ENC_OK.replace('        if enc == "q8":\n'
+                               '            return dq(raw)\n', '')
+    findings = protocol_exhaustive.run_project(
+        proto_files(proto=bad), REPO)
+    assert any(f.key == "q8" and "never decodes" in f.message
+               for f in findings), findings
+
+
+def test_wire_encoding_wired_but_undeclared_fails():
+    bad = PROTO_ENC_OK.replace('("raw", "zlib", "q8")',
+                               '("raw", "zlib")')
+    findings = protocol_exhaustive.run_project(
+        proto_files(proto=bad), REPO)
+    assert any(f.key == "q8" and "not declared" in f.message
+               for f in findings), findings
+
+
+def test_wire_encoding_literals_without_registry_fail():
+    bad = PROTO_ENC_OK.replace(
+        '    WIRE_ENCODINGS = ("raw", "zlib", "q8")\n', '')
+    findings = protocol_exhaustive.run_project(
+        proto_files(proto=bad), REPO)
+    assert any(f.key == "WIRE_ENCODINGS" for f in findings), findings

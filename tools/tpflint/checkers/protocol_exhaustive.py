@@ -18,7 +18,13 @@ and verifies, purely statically:
   kind the client matches on is declared;
 - every structured error ``code`` emitted (worker + dispatch) is
   declared, every declared code is emitted somewhere, and every code
-  the client matches on is declared.
+  the client matches on is declared;
+- every per-buffer wire encoding declared in ``WIRE_ENCODINGS`` (v6)
+  except the first (the wire default, ``raw``) has BOTH an encoder arm
+  (an ``enc = "<name>"`` assignment) and a decoder arm (an ``enc ==
+  "<name>"`` comparison) in ``remoting/protocol.py``, and no ``enc``
+  literal is assigned/compared there without being declared — a wire
+  encoding cannot half-land either.
 
 Fixture trees satisfy the same contract by carrying files whose paths
 end in ``remoting/protocol.py`` etc.; when no protocol module is in the
@@ -165,6 +171,51 @@ def _compared_codes(sf: SourceFile) -> Set[str]:
     return out
 
 
+def _enc_assigned(sf: SourceFile) -> Set[str]:
+    """String literals assigned to a variable named ``enc`` — the
+    encoder arms (handles both ``enc = "raw"`` and the tuple form
+    ``enc, wire = "q8", view``)."""
+    out: Set[str] = set()
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target, value = node.targets[0], node.value
+        if isinstance(target, ast.Name) and target.id == "enc":
+            if isinstance(value, ast.Constant) and \
+                    isinstance(value.value, str):
+                out.add(value.value)
+        elif isinstance(target, ast.Tuple) and \
+                isinstance(value, ast.Tuple) and \
+                len(target.elts) == len(value.elts):
+            for t, v in zip(target.elts, value.elts):
+                if isinstance(t, ast.Name) and t.id == "enc" and \
+                        isinstance(v, ast.Constant) and \
+                        isinstance(v.value, str):
+                    out.add(v.value)
+    return out
+
+
+def _enc_compared(sf: SourceFile) -> Set[str]:
+    """String literals compared against a variable named ``enc`` — the
+    decoder arms."""
+    out: Set[str] = set()
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        sides = [node.left] + list(node.comparators)
+        if not any(isinstance(s, ast.Name) and s.id == "enc"
+                   for s in sides):
+            continue
+        for s in sides:
+            if isinstance(s, ast.Constant) and isinstance(s.value, str):
+                out.add(s.value)
+            elif isinstance(s, (ast.Tuple, ast.List, ast.Set)):
+                out.update(e.value for e in s.elts
+                           if isinstance(e, ast.Constant)
+                           and isinstance(e.value, str))
+    return out
+
+
 def run_project(files: Dict[str, SourceFile], repo_root: str
                 ) -> List[Finding]:
     proto = _find(files, PROTOCOL_SUFFIX)
@@ -243,6 +294,40 @@ def run_project(files: Dict[str, SourceFile], repo_root: str
             fnd(proto, "ERROR_CODES", code,
                 f"remoting/client.py handles error code {code!r} which "
                 f"is not declared in protocol.ERROR_CODES")
+
+    # -- wire encodings: the framing layer's own registry ---------------
+    enc_assigned = _enc_assigned(proto)
+    enc_compared = _enc_compared(proto)
+    declared_encs = tuples.get("WIRE_ENCODINGS")
+    if declared_encs is None:
+        if enc_assigned | enc_compared:
+            missing_registry("WIRE_ENCODINGS")
+    else:
+        default_enc = declared_encs[0] if declared_encs else ""
+        for enc in declared_encs[1:]:
+            if enc not in enc_assigned:
+                fnd(proto, "WIRE_ENCODINGS", enc,
+                    f"wire encoding {enc!r} is declared in "
+                    f"WIRE_ENCODINGS but remoting/protocol.py never "
+                    f"encodes it (no `enc = {enc!r}` assignment) — the "
+                    f"encoding half-landed")
+            if enc not in enc_compared:
+                fnd(proto, "WIRE_ENCODINGS", enc,
+                    f"wire encoding {enc!r} is declared in "
+                    f"WIRE_ENCODINGS but remoting/protocol.py never "
+                    f"decodes it (no `enc == {enc!r}` comparison) — a "
+                    f"peer's frames would fall through to the raw path")
+        for enc in sorted((enc_assigned | enc_compared)
+                          - set(declared_encs)):
+            fnd(proto, "WIRE_ENCODINGS", enc,
+                f"remoting/protocol.py wires encoding {enc!r} which is "
+                f"not declared in protocol.WIRE_ENCODINGS — register "
+                f"it so the encoder/decoder arms are enforced")
+        if default_enc and default_enc not in enc_assigned:
+            fnd(proto, "WIRE_ENCODINGS", default_enc,
+                f"the default wire encoding {default_enc!r} is never "
+                f"assigned in remoting/protocol.py — the registry's "
+                f"first entry must be the encoder's fallback")
 
     emitted_codes: Set[str] = set()
     for sf in (worker, dispatch):
