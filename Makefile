@@ -9,6 +9,7 @@ PY := env -u PALLAS_AXON_POOL_IPS python
 
 .PHONY: all native test test-native verify-all verify-repeat \
 	verify-stress verify-sim verify-trace verify-serving verify-wire \
+	verify-prof bench-diff bench-provenance \
 	verify-native-sanitized \
 	check-coverage lint \
 	lint-drill asan \
@@ -77,7 +78,8 @@ verify-repeat: native
 # small N, cache/store coherence after multi-threaded churn — the PR-4
 # control-plane hot path).  Cheaper than verify-repeat (minutes, not an
 # hour), meant to run on every change to locking/queueing code.
-verify-stress: verify-sim verify-trace verify-serving verify-wire
+verify-stress: verify-sim verify-trace verify-serving verify-wire \
+	verify-prof bench-diff
 	@for i in 1 2 3 4 5; do \
 		echo "=== verify-stress round $$i/5 ==="; \
 		env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -152,6 +154,44 @@ verify-wire:
 		TPF_BENCH_RESULTS_DIR=/tmp/tpfwire_verify_results \
 		python benchmarks/remoting_bench.py --quick
 	@echo "verify-wire: OK"
+
+# tpfprof gate (docs/profiling.md): the profiling suite (attribution
+# math, flight-recorder determinism incl. byte-identical same-seed
+# bundles, schema conformance, CLI exit codes), then a headless
+# profile of the serving burst cell exported as a tpfprof-v1 artifact
+# + virtual-time trace, both validated against their registries
+# (METRICS_SCHEMA via `tpfprof check`, SPAN_SCHEMA via `tpftrace
+# check`).  Run on any change to profiling/, the attribution hooks in
+# remoting/serving, or the metrics schema.
+verify-prof:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		python -m pytest tests/test_profiling.py -q \
+		-p no:cacheprovider -p no:xdist -p no:randomly
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		TPF_BENCH_RESULTS_DIR=/tmp/tpfprof_verify_results \
+		python benchmarks/sim_scenarios.py --scale small --seed 7 \
+		--scenario serving-burst-storm \
+		--export-profile /tmp/tpfprof_verify.json \
+		--export-trace /tmp/tpfprof_verify_trace.json
+	$(PY) -m tools.tpfprof check /tmp/tpfprof_verify.json
+	$(PY) -m tools.tpftrace check /tmp/tpfprof_verify_trace.json
+	@echo "verify-prof: OK"
+
+# Perf-regression comparator (docs/test-matrix.md): every checked-in
+# benchmarks/results/*.json artifact vs the `previous` record it
+# embeds, judged cell-by-cell against per-cell noise bands.  Cells
+# whose backend_evidence changed (tpu <-> cpu-fallback) are never
+# compared — a real-chip number vs a CPU fallback is provenance, not
+# regression.  Exit nonzero on any out-of-band regression.
+bench-diff:
+	$(PY) tools/bench_diff.py
+	@echo "bench-diff: OK"
+
+# Hardware-revalidation worklist (ROADMAP "Net" note): every artifact
+# cell still carrying cpu-fallback backend_evidence, so the next TPU
+# window's re-run list is mechanical instead of tribal knowledge.
+bench-provenance:
+	$(PY) tools/bench_diff.py provenance
 
 test-native:
 	$(MAKE) -C native test

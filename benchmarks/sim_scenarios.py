@@ -43,6 +43,10 @@ def main(argv=None) -> int:
                     help="write the LAST scenario's virtual-time trace "
                          "as Chrome/Perfetto JSON here "
                          "(tools/tpftrace.py reads it)")
+    ap.add_argument("--export-profile", default="",
+                    help="write the LAST scenario's virtual-time "
+                         "tpfprof artifact here (tools/tpfprof.py "
+                         "reads it)")
     args = ap.parse_args(argv)
 
     names = args.scenario or sorted(SCENARIOS)
@@ -52,12 +56,16 @@ def main(argv=None) -> int:
         r = run_scenario(name, seed=args.seed, scale=args.scale)
         if not args.no_determinism_check:
             r2 = run_scenario(name, seed=args.seed, scale=args.scale)
-            # BOTH fingerprints must agree: the store-event log and the
-            # exported virtual-time trace (a nondeterministic span
-            # breaks trace diffing across runs just as badly)
+            # ALL fingerprints must agree: the store-event log, the
+            # exported virtual-time trace, the tpfprof attribution
+            # profile, and — when an invariant tripped — the
+            # postmortem bundle (a nondeterministic postmortem is a
+            # postmortem you cannot trust)
             r["deterministic"] = (
                 r2["log_digest"] == r["log_digest"]
-                and r2["trace_digest"] == r["trace_digest"])
+                and r2["trace_digest"] == r["trace_digest"]
+                and r2.get("profile_digest") == r.get("profile_digest")
+                and r2.get("bundle_digest") == r.get("bundle_digest"))
             if not r["deterministic"]:
                 r["ok"] = False
         speedup = (r["sim_seconds"] / r["wall_seconds"]
@@ -79,6 +87,16 @@ def main(argv=None) -> int:
                            _scenarios.LAST_TRACE.get("spans", []),
                            meta=_scenarios.LAST_TRACE.get("meta"))
         print(f"trace -> {path}")
+
+    if args.export_profile:
+        from tensorfusion_tpu.profiling import write_profile
+
+        path = write_profile(
+            args.export_profile,
+            _scenarios.LAST_PROFILE.get("snapshots", []),
+            meta=_scenarios.LAST_PROFILE.get("meta"),
+            node_name="sim")
+        print(f"profile -> {path}")
 
     result = {
         "benchmark": "sim_scenarios",

@@ -134,12 +134,18 @@ def default_rules() -> List[AlertRule]:
 class AlertEvaluator:
     def __init__(self, tsdb: TSDB, rules: Optional[List[AlertRule]] = None,
                  webhook_url: str = "", interval_s: float = 15.0,
-                 clock: Optional[Clock] = None):
+                 clock: Optional[Clock] = None, recorder=None):
         self.tsdb = tsdb
         self.clock = clock or default_clock()
         self.rules = rules or []
         self.webhook_url = webhook_url
         self.interval_s = interval_s
+        #: tpfprof flight recorder (docs/profiling.md): every alert
+        #: transition lands in the "alerts" ring, and a FIRING alert
+        #: auto-captures a postmortem bundle (rings + TSDB tail) when a
+        #: bundle dir is configured — the black box for "what was the
+        #: system doing when this paged"
+        self.recorder = recorder
         # both keyed structurally by (rule.name, group_tuple) — never by
         # the rendered alert name, so a rule named "X" can never claim or
         # resolve alerts of a different rule named "X[..." (and group tag
@@ -371,6 +377,18 @@ class AlertEvaluator:
             for key in list(self._pending_since):
                 if key[0] == rule.name and key not in breached_keys:
                     self._pending_since.pop(key, None)
+        if changed and self.recorder is not None:
+            for alert in changed:
+                self.recorder.note("alerts", alert.state,
+                                   rule=alert.rule,
+                                   severity=alert.severity,
+                                   value=alert.value,
+                                   threshold=alert.threshold,
+                                   exemplars=list(alert.exemplars))
+            for alert in changed:
+                if alert.state == "firing":
+                    self.recorder.auto_bundle(f"alert-{alert.rule}",
+                                              tsdb=self.tsdb)
         if changed and self.webhook_url:
             self._post(changed)
         return changed

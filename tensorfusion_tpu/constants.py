@@ -238,6 +238,9 @@ ENV_REMOTING_QUANT = "TPF_REMOTING_QUANT"      # q8 wire encoding: 1 on, 0 off
 ENV_REMOTING_UPLOAD_DEPTH = "TPF_REMOTING_UPLOAD_DEPTH"  # shard PUTs in flight
 ENV_REMOTING_PREFETCH_DEPTH = "TPF_REMOTING_PREFETCH_DEPTH"  # worker H2D overlap
 ENV_TRACE_SAMPLE = "TPF_TRACE_SAMPLE"          # head-based trace sampling
+ENV_PROF = "TPF_PROF"                          # tpfprof attribution: 0 disables
+ENV_PROF_BIN_S = "TPF_PROF_BIN_S"              # attribution bin width (s)
+ENV_PROF_BUNDLE_DIR = "TPF_PROF_BUNDLE_DIR"    # auto postmortem bundle dir
 
 #: queue-wait SLO per QoS class (ms): the per-tenant good/total rollup
 #: the dispatcher maintains (``tpf_trace_slo``) judges each request's

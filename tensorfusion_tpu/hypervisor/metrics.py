@@ -28,6 +28,7 @@ from typing import Callable, List, Optional
 
 from ..clock import default_clock
 from ..metrics.encoder import encode_line
+from ..profiling.export import profile_lines
 
 log = logging.getLogger("tpf.hypervisor.metrics")
 
@@ -228,6 +229,12 @@ class HypervisorMetricsRecorder:
                  "partitions": len(e.partitions)}, ts))
         for rw in self.remote_workers:
             lines.extend(remote_dispatch_lines(rw, self.node_name, ts))
+            # tpfprof attribution series (docs/profiling.md): the
+            # worker's per-tenant device-time ledger ships next to the
+            # dispatch saturation it explains
+            if getattr(rw, "profiler", None) is not None:
+                lines.extend(profile_lines(rw.profiler.snapshot(),
+                                           self.node_name, ts))
             if getattr(rw, "engine", None) is not None:
                 lines.extend(serving_engine_lines(rw.engine,
                                                   self.node_name, ts))

@@ -207,6 +207,14 @@ class HypervisorServer:
         elif url.path == "/api/v1/dispatch":
             h._send(200, [rw.dispatcher.snapshot()
                           for rw in self.remote_workers])
+        elif url.path == "/api/v1/profile":
+            # tpfprof attribution view (docs/profiling.md): per-tenant
+            # device-time shares, overlap efficiency and the recent
+            # time bins of every co-hosted worker's profiler — the
+            # TUI's [p]rofile pane and tools/tpfprof.py read this
+            h._send(200, [rw.profiler.snapshot()
+                          for rw in self.remote_workers
+                          if getattr(rw, "profiler", None) is not None])
         elif url.path == "/api/v1/allocations":
             # Pod-resources-proxy analog (pod_resources_proxy.go:87-318):
             # the per-pod device-assignment view monitoring agents

@@ -15,11 +15,15 @@ other UIs are:
   tick, feeds ``TuiState`` and blits the rendered screen.
 
 Keys (reference model.go key map): d=devices w=workers m=metrics
-s=shm-inspector r=remote-dispatch, j/k or arrows move the selection,
-enter opens the detail view for the selected row, esc goes back,
-q quits.  The dispatch pane shows the co-hosted remote-vTPU workers'
-fair-queue state per tenant — queue-wait p50/p99, SLO good ratio and
-the last trace id (docs/tracing.md) — fed by /api/v1/dispatch.
+s=shm-inspector r=remote-dispatch p=profile, j/k or arrows move the
+selection, enter opens the detail view for the selected row, esc goes
+back, q quits.  The dispatch pane shows the co-hosted remote-vTPU
+workers' fair-queue state per tenant — queue-wait p50/p99, SLO good
+ratio and the last trace id (docs/tracing.md) — fed by
+/api/v1/dispatch.  The profile pane shows tpfprof's per-tenant
+device-time attribution — share of device time, transfer/queue
+seconds, overlap efficiency, recent utilization bins
+(docs/profiling.md) — fed by /api/v1/profile.
 
     python -m tensorfusion_tpu.hypervisor.tui --url http://127.0.0.1:8000
 """
@@ -353,6 +357,53 @@ def render_dispatch(snapshots: List[dict]) -> str:
     return "\n".join(lines).rstrip()
 
 
+def render_profile(snapshots: List[dict]) -> str:
+    """tpfprof pane (docs/profiling.md): per-device utilization and
+    overlap efficiency, the per-tenant device-time share table, and a
+    recent-bin utilization strip — the attribution ledger on screen."""
+    if not snapshots:
+        return "(no profiled workers registered on this node)"
+    lines: List[str] = []
+    for snap in snapshots:
+        tot = snap.get("totals", {})
+        overlap = snap.get("overlap", {})
+        lines.append(
+            f"== {snap.get('name', '?')} "
+            f"util={snap.get('utilization_pct', 0.0):5.1f}% "
+            f"compute={tot.get('compute_s', 0.0):.3f}s "
+            f"transfer={tot.get('transfer_s', 0.0):.3f}s "
+            f"queue={tot.get('queue_s', 0.0):.3f}s "
+            f"overlap-eff={overlap.get('efficiency_pct', 0.0):5.1f}% ==")
+        tenants = snap.get("tenants", {})
+        if tenants:
+            lines.append("  TENANT          QOS       SHARE   "
+                         "COMPUTE s  TRANSFER s   QUEUE s  LAUNCH  "
+                         "HBM")
+            ordered = sorted(
+                tenants.items(),
+                key=lambda kv: -kv[1].get("device_share_pct", 0.0))
+            for tenant, t in ordered:
+                lines.append(
+                    f"  {tenant:<15} {t.get('qos', '') or '-':<8} "
+                    f"{t.get('device_share_pct', 0.0):6.2f}% "
+                    f"{t.get('compute_s', 0.0):10.3f} "
+                    f"{t.get('transfer_s', 0.0):11.3f} "
+                    f"{t.get('queue_s', 0.0):9.3f} "
+                    f"{t.get('launches', 0):7d} "
+                    f"{_fmt_bytes(t.get('hbm_bytes', 0))}")
+        bins = snap.get("bins", [])
+        if bins:
+            recent = bins[-30:]
+            strip = "".join(
+                " .:-=+*#%@"[min(int(b.get("util_pct", 0.0) / 10.01),
+                                 9)]
+                for b in recent)
+            lines.append(f"  util/bin ({snap.get('bin_s', 1.0)}s): "
+                         f"|{strip}|  (oldest -> newest)")
+        lines.append("")
+    return "\n".join(lines).rstrip()
+
+
 def render_shm(shm_base: str, selected: int = -1) -> str:
     """The shm inspector dialog (shm_dialog.go analog): raw token-bucket
     state of every worker segment."""
@@ -394,6 +445,7 @@ VIEW_WORKERS = "workers"
 VIEW_METRICS = "metrics"
 VIEW_SHM = "shm"
 VIEW_DISPATCH = "dispatch"
+VIEW_PROFILE = "profile"
 VIEW_DEVICE_DETAIL = "device_detail"
 VIEW_WORKER_DETAIL = "worker_detail"
 
@@ -416,6 +468,7 @@ class TuiState:
         self.devices: List[dict] = []
         self.workers: List[dict] = []
         self.dispatch: List[dict] = []
+        self.profile: List[dict] = []
         self.device_history: Dict[str, _EntityHistory] = {}
         self.worker_history: Dict[str, _EntityHistory] = {}
         self.last_update = 0.0
@@ -428,6 +481,11 @@ class TuiState:
         workers so hypervisors without remote workers — or old servers
         without the endpoint — degrade to an empty pane)."""
         self.dispatch = snapshots or []
+
+    def update_profile(self, snapshots: List[dict]) -> None:
+        """Ingest /api/v1/profile (same degrade-to-empty contract as
+        the dispatch pane for servers without the endpoint)."""
+        self.profile = snapshots or []
 
     def update(self, devices: List[dict], workers: List[dict]) -> None:
         self.devices, self.workers = devices, workers
@@ -458,10 +516,10 @@ class TuiState:
         """Process one key; returns False to quit."""
         if ch == "q":
             return False
-        if ch in ("d", "w", "m", "s", "r"):
+        if ch in ("d", "w", "m", "s", "r", "p"):
             self.view = {"d": VIEW_DEVICES, "w": VIEW_WORKERS,
                          "m": VIEW_METRICS, "s": VIEW_SHM,
-                         "r": VIEW_DISPATCH}[ch]
+                         "r": VIEW_DISPATCH, "p": VIEW_PROFILE}[ch]
             return True
         if ch == "esc":
             if self.view == VIEW_DEVICE_DETAIL:
@@ -515,6 +573,8 @@ class TuiState:
             return render_shm(self.shm_base, self.sel_shm)
         if self.view == VIEW_DISPATCH:
             return render_dispatch(self.dispatch)
+        if self.view == VIEW_PROFILE:
+            return render_profile(self.profile)
         if self.view == VIEW_DEVICE_DETAIL:
             d = self._selected_device()
             if d is None:
@@ -536,8 +596,8 @@ class TuiState:
         if self.last_update and WALL.now() - self.last_update > 5:
             stale = f"  (stale {WALL.now() - self.last_update:.0f}s)"
         return ("tpu-fusion hypervisor  [d]evices [w]orkers [m]etrics "
-                "[s]hm [r]emote-dispatch  j/k+enter detail  esc back  "
-                "[q]uit" + stale)
+                "[s]hm [r]emote-dispatch [p]rofile  j/k+enter detail  "
+                "esc back  [q]uit" + stale)
 
 
 def _clamp(idx: int, n: int) -> int:
@@ -579,6 +639,13 @@ def snapshot(url: str, shm_base: str = "") -> str:
             dispatch = []
         if dispatch:
             out += ["", render_dispatch(dispatch)]
+        try:
+            profile = _fetch(url, "/api/v1/profile")
+        # tpflint: disable=swallowed-error -- absent endpoint, by design
+        except Exception:  # noqa: BLE001 - older server: no endpoint
+            profile = []
+        if profile:
+            out += ["", render_profile(profile)]
     except Exception as e:  # noqa: BLE001
         out.append(f"(hypervisor unreachable at {url}: {e})")
     if shm_base:
@@ -618,6 +685,12 @@ def run_curses(url: str, shm_base: str, refresh_s: float = 1.0) -> None:
                     # tpflint: disable=swallowed-error -- by design
                     except Exception:  # noqa: BLE001 - old server
                         state.update_dispatch([])
+                    try:
+                        state.update_profile(
+                            _fetch(url, "/api/v1/profile"))
+                    # tpflint: disable=swallowed-error -- by design
+                    except Exception:  # noqa: BLE001 - old server
+                        state.update_profile([])
                 except Exception as e:  # noqa: BLE001
                     state.error = f"hypervisor unreachable at {url}: {e}"
                 dirty = True

@@ -230,6 +230,21 @@ class MetricsRecorder:
                     self.tsdb.insert(measurement, tags, fields, now,
                                      exemplar=exemplar or None)
 
+            # tpfprof attribution series (docs/profiling.md): embedded
+            # workers' per-tenant device-time ledgers, same series the
+            # node-agent recorder ships for multi-host nodes
+            from ..profiling.export import profile_lines
+
+            for rw in self.remote_workers:
+                prof = getattr(rw, "profiler", None)
+                if prof is None:
+                    continue
+                for line in profile_lines(prof.snapshot(), "operator",
+                                          ts):
+                    lines.append(line)
+                    measurement, tags, fields, _ = parse_line(line)
+                    self.tsdb.insert(measurement, tags, fields, now)
+
         lines.extend(self._trace_span_lines(ts, now))
 
         if self.path and lines:

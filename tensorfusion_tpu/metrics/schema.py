@@ -88,6 +88,26 @@ METRICS_SCHEMA = {
         "fields": ("tokens_total", "ttft_p50_ms", "ttft_p99_ms",
                    "slo_good", "slo_total", "slo_ms", "good_ratio"),
     },
+    # tpfprof device-time attribution (tensorfusion_tpu/profiling,
+    # docs/profiling.md): per-device utilization + attributed seconds
+    # by kind with transfer/compute overlap efficiency, and per-tenant
+    # device-time shares + HBM-resident gauges.  Emitted by
+    # profiling/export.py:profile_lines via BOTH recorders; tools/
+    # tpfprof.py `check` validates runtime artifacts against these rows
+    "tpf_prof_device": {
+        "tags": ("node", "device"),
+        "fields": ("utilization_pct", "compute_s_total",
+                   "transfer_s_total", "queue_s_total",
+                   "hidden_transfer_s_total", "overlap_efficiency_pct",
+                   "launches_total", "transfers_total", "elapsed_s",
+                   "tenants"),
+    },
+    "tpf_prof_tenant": {
+        "tags": ("node", "device", "tenant", "qos"),
+        "fields": ("device_share_pct", "compute_s_total",
+                   "transfer_s_total", "queue_s_total",
+                   "launches_total", "hbm_resident_bytes"),
+    },
     # operator-side recorder (metrics/recorder.py)
     "tpf_chip_alloc": {
         "tags": ("chip", "node", "pool", "generation"),

@@ -176,6 +176,32 @@ class TSDB:
                 break
         return out
 
+    def dump_tail(self, window_s: Optional[float] = None,
+                  max_points_per_series: int = 256) -> List[dict]:
+        """Canonical recent-window dump for postmortem bundles
+        (tensorfusion_tpu/profiling, docs/profiling.md): every series'
+        trailing points as sorted, JSON-ready rows.  Deterministic for
+        a deterministic clock — the bundle-digest contract."""
+        now = self.clock.now()
+        since = now - (window_s if window_s is not None
+                       else self.retention_s)
+        rows: List[dict] = []
+        with self._lock:
+            for key in sorted(self._series,
+                              key=lambda k: (k.measurement, k.tags,
+                                             k.field)):
+                pts = [p for p in self._series[key] if p.ts >= since]
+                if not pts:
+                    continue
+                rows.append({
+                    "measurement": key.measurement,
+                    "tags": dict(key.tags),
+                    "field": key.field,
+                    "points": [[round(p.ts, 9), p.value]
+                               for p in pts[-max_points_per_series:]],
+                })
+        return rows
+
     def gc(self) -> None:
         cutoff = self.clock.now() - self.retention_s
         with self._lock:

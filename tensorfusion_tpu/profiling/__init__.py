@@ -1,0 +1,29 @@
+"""tpfprof: per-tenant device-time attribution + always-on flight
+recorder (docs/profiling.md).
+
+The reference platform's device arbitration is exactly the accounting
+its closed-source limiter keeps private: *where did device time go, per
+tenant, per interval*.  This package is that ledger, plus the black box
+that survives an incident:
+
+- :class:`~.profiler.Profiler` — fixed-width time-binned attribution of
+  device compute, host->device transfer (with overlap accounting: how
+  much transfer hid behind compute), and queue wait, per tenant;
+- :class:`~.recorder.FlightRecorder` — bounded in-memory event rings
+  per component with deterministic postmortem *bundles*
+  (:meth:`~.recorder.FlightRecorder.dump_bundle`);
+- :mod:`~.export` — the canonical ``tpfprof-v1`` artifact format, the
+  ``tpf_prof_*`` influx line builder, and the registry validation the
+  ``tools/tpfprof.py check`` command exit-codes on.
+
+Everything reads time through the injectable Clock seam, so the whole
+subsystem is bit-deterministic under the digital twin's ``SimClock``
+(same seed => identical profile and bundle digests — the
+``make verify-prof`` / ``verify-sim`` contract).
+"""
+
+from .profiler import Profiler                                # noqa: F401
+from .recorder import FlightRecorder                          # noqa: F401
+from .export import (load_profile, profile_digest,            # noqa: F401
+                     profile_lines, validate_profile,
+                     write_profile)

@@ -1,0 +1,150 @@
+"""tpfprof artifact format + the ``tpf_prof_*`` influx line builder.
+
+One exported profile is a self-describing artifact:
+
+- ``snapshots``: the raw :meth:`~.profiler.Profiler.snapshot` dicts
+  (one per profiled device/component) — what ``tpfprof top/timeline/
+  diff`` read;
+- ``lines``: the same data as ``tpf_prof_device`` / ``tpf_prof_tenant``
+  influx lines (exactly what the metrics recorders ship), so
+  ``tpfprof check`` can validate the runtime artifact against
+  ``METRICS_SCHEMA`` — the same registry discipline ``tpftrace check``
+  applies to SPAN_SCHEMA.
+
+Export is canonical (sorted keys, fixed separators) so same-seed sim
+profiles are byte-identical and ``profile_digest`` equality is a
+meaningful determinism check.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, Dict, Iterable, List, Optional
+
+from ..metrics.encoder import encode_line
+
+FORMAT = "tpfprof-v1"
+
+
+def profile_lines(snap: dict, node_name: str, ts: int) -> List[str]:
+    """Influx lines for one profiler snapshot: device-level
+    ``tpf_prof_device`` (utilization, attributed seconds by kind,
+    overlap efficiency) plus per-tenant ``tpf_prof_tenant``
+    (device-time share, attributed seconds, HBM gauge).  Shared by the
+    node-agent and operator recorders so both topologies emit
+    identical series (docs/metrics-schema.md)."""
+    tags = {"node": node_name, "device": snap["name"]}
+    tot = snap["totals"]
+    overlap = snap["overlap"]
+    lines = [encode_line(
+        "tpf_prof_device", tags,
+        {"utilization_pct": snap["utilization_pct"],
+         "compute_s_total": tot["compute_s"],
+         "transfer_s_total": tot["transfer_s"],
+         "queue_s_total": tot["queue_s"],
+         "hidden_transfer_s_total": tot["hidden_transfer_s"],
+         "overlap_efficiency_pct": overlap["efficiency_pct"],
+         "launches_total": tot["launches"],
+         "transfers_total": tot["transfers"],
+         "elapsed_s": snap["elapsed_s"],
+         "tenants": len(snap["tenants"])}, ts)]
+    for tenant, t in sorted(snap["tenants"].items()):
+        lines.append(encode_line(
+            "tpf_prof_tenant",
+            dict(tags, tenant=tenant, qos=t["qos"] or "unknown"),
+            {"device_share_pct": t["device_share_pct"],
+             "compute_s_total": t["compute_s"],
+             "transfer_s_total": t["transfer_s"],
+             "queue_s_total": t["queue_s"],
+             "launches_total": t["launches"],
+             "hbm_resident_bytes": t["hbm_bytes"]}, ts))
+    return lines
+
+
+def to_doc(snapshots: Iterable[dict],
+           meta: Optional[Dict[str, Any]] = None,
+           node_name: str = "local", ts: int = 0) -> Dict[str, Any]:
+    snapshots = list(snapshots)
+    doc = {
+        "format": FORMAT,
+        "snapshots": snapshots,
+        "lines": [ln for snap in snapshots
+                  for ln in profile_lines(snap, node_name, ts)],
+    }
+    if meta:
+        doc["meta"] = dict(meta)
+    return doc
+
+
+def dumps(doc: Dict[str, Any]) -> str:
+    return json.dumps(doc, sort_keys=True, separators=(",", ":")) + "\n"
+
+
+def write_profile(path: str, snapshots: Iterable[dict],
+                  meta: Optional[Dict[str, Any]] = None,
+                  node_name: str = "local", ts: int = 0) -> str:
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(dumps(to_doc(snapshots, meta=meta,
+                             node_name=node_name, ts=ts)))
+    return path
+
+
+def load_profile(path: str) -> Dict[str, Any]:
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or doc.get("format") != FORMAT:
+        raise ValueError(f"{path}: not a {FORMAT} profile artifact")
+    return doc
+
+
+def profile_digest(snapshots: Iterable[dict]) -> str:
+    """Digest of the canonical export (meta excluded — seeds/scales are
+    inputs, not observations) — the fingerprint two same-seed sim runs
+    must agree on."""
+    return hashlib.sha256(
+        dumps(to_doc(snapshots)).encode()).hexdigest()
+
+
+def validate_profile(doc: Dict[str, Any],
+                     schema: Optional[dict] = None) -> List[str]:
+    """Errors for a profile artifact vs METRICS_SCHEMA: every embedded
+    influx line must parse, name a declared ``tpf_prof_*`` measurement,
+    carry every required tag and no undeclared tag/field — the runtime
+    mirror of tpflint's ``metrics-schema`` gate.  Empty list = valid."""
+    from ..metrics.encoder import parse_line
+
+    if schema is None:
+        from ..metrics.schema import METRICS_SCHEMA
+        schema = METRICS_SCHEMA
+    errors: List[str] = []
+    if not isinstance(doc.get("snapshots"), list):
+        errors.append("artifact carries no snapshots list")
+    for i, line in enumerate(doc.get("lines") or ()):
+        try:
+            measurement, tags, fields, _ = parse_line(line)
+        except ValueError as e:
+            errors.append(f"line {i}: unparseable influx line ({e})")
+            continue
+        entry = schema.get(measurement)
+        if entry is None:
+            errors.append(f"line {i}: measurement {measurement!r} is "
+                          f"not declared in METRICS_SCHEMA")
+            continue
+        required = set(entry.get("tags", ()))
+        allowed_tags = required | set(entry.get("opt_tags", ()))
+        for tag in sorted(set(tags) - allowed_tags):
+            errors.append(f"line {i}: {measurement} carries undeclared "
+                          f"tag {tag!r}")
+        for tag in sorted(required - set(tags)):
+            errors.append(f"line {i}: {measurement} is missing required "
+                          f"tag {tag!r}")
+        declared_fields = set(entry.get("fields", ()))
+        for field in sorted(set(fields) - declared_fields):
+            errors.append(f"line {i}: {measurement} carries undeclared "
+                          f"field {field!r}")
+    for i, snap in enumerate(doc.get("snapshots") or ()):
+        for key in ("name", "totals", "tenants", "bins", "overlap"):
+            if key not in snap:
+                errors.append(f"snapshot {i}: missing {key!r}")
+    return sorted(set(errors))

@@ -211,7 +211,8 @@ class DeviceDispatcher:
                  max_queue_per_tenant: int = DEFAULT_MAX_QUEUE_PER_TENANT,
                  max_queue_global: int = DEFAULT_MAX_QUEUE_GLOBAL,
                  max_microbatch: int = DEFAULT_MAX_MICROBATCH,
-                 tracer: Optional[Tracer] = None):
+                 tracer: Optional[Tracer] = None,
+                 profiler=None, recorder=None):
         if mode not in ("wfq", "fifo"):
             raise ValueError(f"unknown dispatch mode {mode!r}")
         self.execute_batch = execute_batch
@@ -219,6 +220,13 @@ class DeviceDispatcher:
         #: records dispatcher.queue / device.launch spans for traced
         #: items (protocol v5); None disables span recording entirely
         self.tracer = tracer
+        #: tpfprof attribution ledger (docs/profiling.md): queue wait
+        #: and device launch time charged per tenant, for EVERY item —
+        #: unlike spans, attribution is always-on (None disables)
+        self.profiler = profiler
+        #: flight-recorder rings: one "dispatch" event per launch /
+        #: crash so a postmortem bundle shows the last decisions
+        self.recorder = recorder
         self.max_queue_per_tenant = max_queue_per_tenant
         self.max_queue_global = max_queue_global
         self.max_microbatch = max(1, max_microbatch)
@@ -483,6 +491,21 @@ class DeviceDispatcher:
             if d is not None:
                 item.trace_spans.append(d)
 
+    def _attr_compute(self, batch: List[WorkItem],
+                      dur_s: float) -> None:
+        """tpfprof device-time attribution for one batch, split
+        cost-weighted across its members (a fused launch shares one
+        device pass)."""
+        if self.profiler is None or dur_s <= 0.0 or not batch:
+            return
+        total_cost = sum(i.cost for i in batch)
+        for item in batch:
+            if item.tenant is None:
+                continue
+            self.profiler.attribute(item.tenant.conn_id, "compute",
+                                    dur_s * item.cost / total_cost,
+                                    qos=item.tenant.qos)
+
     def _loop(self) -> None:
         pending_flush: Optional[Callable] = None
         pending_items: List[WorkItem] = []
@@ -517,6 +540,9 @@ class DeviceDispatcher:
                     if item.tenant is not None:
                         item.tenant.slo_total += 1
                 self._queue_span(item, wait, qos)
+                if self.profiler is not None and item.tenant is not None:
+                    self.profiler.attribute(item.tenant.conn_id,
+                                            "queue", wait, qos=qos)
                 emeta = {
                     "error": f"deadline exceeded after {waited_ms}ms "
                              f"in queue",
@@ -554,6 +580,9 @@ class DeviceDispatcher:
                 if tenant is not None:
                     tenant.wait.observe(wait)
                 self._queue_span(item, wait, qos)
+                if self.profiler is not None and tenant is not None:
+                    self.profiler.attribute(tenant.conn_id, "queue",
+                                            wait, qos=qos)
             t0 = time.perf_counter()
             try:
                 flush = self.execute_batch(batch, self.peek_next)
@@ -567,13 +596,44 @@ class DeviceDispatcher:
                         item.reply("ERROR", emeta, [])
                     except (ConnectionError, OSError):
                         pass
+                # worker crash path: freeze the last decisions into a
+                # postmortem bundle (budgeted no-op without a
+                # configured bundle dir)
+                if self.recorder is not None:
+                    self.recorder.note(
+                        "dispatch", "crash",
+                        exe=batch[0].exe_id, batch=len(batch),
+                        error=f"{type(e).__name__}: {e}"[:200])
+                    self.recorder.auto_bundle(
+                        "dispatch-crash",
+                        tracers=(self.tracer,) if self.tracer else ())
             else:
                 # launch duration measured before the deferred-flush
                 # overlap below runs (service includes it; the span
                 # should not)
-                self._launch_spans(batch, time.perf_counter() - t0)
+                launch_dt = time.perf_counter() - t0
+                self._launch_spans(batch, launch_dt)
+                # tpfprof: the launch window minus the worker-measured
+                # argument-resolution (transfer) time — transfer was
+                # already attributed by the worker, so compute is
+                # never double-counted.  The rest of the batch's
+                # device time surfaces at its deferred flush (the
+                # blocking materialization), attributed below.
+                xfer = sum(i.meta.get("_xfer_exposed_s", 0.0)
+                           for i in batch)
+                self._attr_compute(batch,
+                                   max(launch_dt - xfer, 0.0))
+                if self.recorder is not None:
+                    self.recorder.note(
+                        "dispatch", "launch",
+                        exe=batch[0].exe_id, batch=len(batch),
+                        tenants=sorted({i.tenant.conn_id for i in batch
+                                        if i.tenant is not None}),
+                        launch_ms=round(launch_dt * 1e3, 3))
             # run the PREVIOUS batch's deferred flush after this batch
             # launched: reply serialization overlaps device compute
+            # (the flush closure attributes its own materialization
+            # wait — the batch's remaining device time — to its items)
             if pending_flush is not None:
                 pending_flush()
                 self._complete(pending_items)

@@ -83,6 +83,8 @@ import numpy as np
 
 from .. import constants
 from . import protocol
+from ..profiling.profiler import Profiler
+from ..profiling.recorder import FlightRecorder
 from ..tracing.core import Tracer
 from .dispatch import BusyError, DeviceDispatcher, WorkItem, qos_weight
 from .protocol import recv_message, send_message
@@ -109,7 +111,9 @@ class RemoteVTPUWorker:
                  max_queue_global: Optional[int] = None,
                  max_microbatch: Optional[int] = None,
                  tracer: Optional[Tracer] = None,
-                 engine=None):
+                 engine=None,
+                 profiler: Optional[Profiler] = None,
+                 recorder: Optional[FlightRecorder] = None):
         self.meter_client = meter_client    # optional VTPUClient
         #: highest wire version this worker speaks; pinning it to 2 makes
         #: the worker byte-faithful to a v2 build (mixed-version tests)
@@ -243,9 +247,45 @@ class RemoteVTPUWorker:
         #: created for requests that CARRY a sampled trace context, so
         #: untraced serving pays nothing.
         self.tracer = tracer or Tracer(service="remote-worker")
+        #: tpfprof attribution ledger (docs/profiling.md): device
+        #: launch / transfer / queue time per tenant, always-on
+        #: (TPF_PROF=0 disables; overhead budget <3% at the serving
+        #: shape, measured by remoting_bench's `profiler` cell)
+        if profiler is None and \
+                os.environ.get(constants.ENV_PROF, "") != "0":
+            try:
+                bin_s = float(os.environ.get(constants.ENV_PROF_BIN_S,
+                                             "") or 1.0)
+            except ValueError:
+                bin_s = 1.0
+            profiler = Profiler(name="worker0", bin_s=bin_s)
+        self.profiler = profiler
+        #: always-on flight recorder: dispatch/engine/worker rings for
+        #: postmortem bundles (auto-captured on crash paths when
+        #: TPF_PROF_BUNDLE_DIR is set)
+        self.recorder = recorder or FlightRecorder(config={
+            "component": "remote-worker",
+            "dispatch_mode": mode,
+            "prefetch_depth": self.prefetch_depth,
+            "protocol_version": self.protocol_version})
+        #: per-buffer async-transfer durations (buf_id -> seconds the
+        #: scatter-pool device_put actually took) — consumed by
+        #: _take_shard to split transfer time into hidden vs exposed
+        # guarded by: _lock
+        self._scatter_durs: Dict[str, float] = {}
+        #: hidden-transfer accumulator for the item currently resolving
+        #: its args — dispatcher-thread only, reset per item
+        self._hidden_acc = 0.0
+        #: last result-materialization completion time — the anchor of
+        #: the inter-completion-gap device-time attribution
+        #: (_attr_flush_compute); dispatcher-thread only
+        self._last_completion_m = time.monotonic()
         self.dispatcher = DeviceDispatcher(self._execute_batch,
                                            mode=mode,
-                                           tracer=self.tracer, **kwargs)
+                                           tracer=self.tracer,
+                                           profiler=self.profiler,
+                                           recorder=self.recorder,
+                                           **kwargs)
         #: optional continuous-batching serving engine
         #: (tensorfusion_tpu/serving, docs/serving.md): GENERATE
         #: requests stream through it; its stepper thread starts and
@@ -255,6 +295,15 @@ class RemoteVTPUWorker:
         self.engine = engine
         if engine is not None and getattr(engine, "tracer", None) is None:
             engine.tracer = self.tracer
+        # the engine shares the worker's attribution ledger + flight
+        # recorder (unless it brought its own): serving and dispatch
+        # tenants land in ONE per-device profile
+        if engine is not None and \
+                getattr(engine, "profiler", None) is None:
+            engine.profiler = self.profiler
+        if engine is not None and \
+                getattr(engine, "recorder", None) is None:
+            engine.recorder = self.recorder
         #: the paged KV pool's fixed physical footprint, charged against
         #: the resident-HBM budget/meter at start() like any resident
         #: buffer (released at stop) — the hypervisor's memory metering
@@ -464,6 +513,10 @@ class RemoteVTPUWorker:
                                             buffers)
                         except Exception as e:  # noqa: BLE001
                             log.exception("remote %s failed", kind)
+                            outer.recorder.note(
+                                "worker", "error", request=kind,
+                                tenant=conn_ns,
+                                error=f"{type(e).__name__}: {e}"[:200])
                             reply("ERROR", {"error": str(e)}, [])
                 except (ConnectionError, OSError):
                     pass
@@ -603,6 +656,23 @@ class RemoteVTPUWorker:
         the device array; everything else is the array itself."""
         return arr.result() if isinstance(arr, Future) else arr
 
+    def _timed_put(self, buf_id: str, host, device):
+        """Scatter-pool H2D copy with its duration recorded so the
+        consuming EXECUTE can split its transfer attribution into
+        hidden (ran behind compute/decode) vs exposed (waited on the
+        critical path)."""
+        import jax
+
+        t0 = time.monotonic()
+        arr = jax.device_put(host, device)
+        with self._lock:
+            self._scatter_durs[buf_id] = time.monotonic() - t0
+            # bounded: entries are popped at first use; a client that
+            # PUTs and never EXECUTEs must not grow this forever
+            if len(self._scatter_durs) > 4096:
+                self._scatter_durs.pop(next(iter(self._scatter_durs)))
+        return arr
+
     def _take_shard(self, buf_id: str):
         """Look up one input shard; ephemeral shards (per-call uploads)
         are consumed — freed from the table and their resident bytes
@@ -610,9 +680,17 @@ class RemoteVTPUWorker:
         with self._lock:
             arr = self._buffers.get(buf_id)
             ephemeral = buf_id in self._ephemeral
+            scatter_dur = self._scatter_durs.pop(buf_id, 0.0)
         if arr is None:
             raise KeyError(f"unknown buffer {buf_id}")
+        w0 = time.monotonic()
         arr = self._resolve(arr)
+        if scatter_dur:
+            # the part of the async copy this EXECUTE did NOT wait for
+            # ran hidden behind earlier work — overlap the profiler
+            # credits (dispatcher thread only; _hidden_acc is its own)
+            self._hidden_acc += max(
+                scatter_dur - (time.monotonic() - w0), 0.0)
         if ephemeral:
             with self._lock:
                 if self._buffers.pop(buf_id, None) is not None:
@@ -1053,7 +1131,16 @@ class RemoteVTPUWorker:
             with self._lock:
                 self._upload_stats["inflight"] = max(
                     0, self._upload_stats["inflight"] - 1)
-            return [f.result() for f in devf]
+            args = []
+            for f in devf:
+                w0 = time.monotonic()
+                arr, dur = f.result()
+                # copy time the prefetch already paid while the prior
+                # launch ran = hidden transfer (dispatcher thread only)
+                self._hidden_acc += max(
+                    dur - (time.monotonic() - w0), 0.0)
+                args.append(arr)
+            return args
         return [np.asarray(b) for b in item.buffers]
 
     def _item_args(self, item: WorkItem) -> list:
@@ -1109,8 +1196,14 @@ class RemoteVTPUWorker:
 
             try:
                 pool = self._pool()
+
+                def _timed_dev_put(b):
+                    t0 = time.monotonic()
+                    arr = jax.device_put(np.asarray(b))
+                    return arr, time.monotonic() - t0
+
                 nxt.meta["_dev_args"] = [
-                    pool.submit(jax.device_put, np.asarray(b))
+                    pool.submit(_timed_dev_put, b)
                     for b in nxt.buffers]
                 started += 1
             except Exception:  # noqa: BLE001 - overlap is advisory
@@ -1176,7 +1269,12 @@ class RemoteVTPUWorker:
         for item in items:
             try:
                 up0 = self.tracer.clock.now() if item.trace else 0.0
+                self._hidden_acc = 0.0
+                up_m0 = time.monotonic()
                 args = self._item_args(item)
+                self._attr_transfer(item,
+                                    time.monotonic() - up_m0,
+                                    self._hidden_acc)
                 self._upload_span(item, up0, len(args))
                 argsets.append((item, args))
             except KeyError as e:
@@ -1187,6 +1285,9 @@ class RemoteVTPUWorker:
                 raise ValueError("partial batch")
             fn = self._stacked_fn(exe_id, len(argsets))
             flat = [a for _, args in argsets for a in args]
+            enq_m = time.monotonic()
+            for item, _ in argsets:
+                item.meta["_enq_m"] = enq_m
             leaves = fn(*flat)
         except Exception:  # noqa: BLE001 - degrade, don't fail the batch
             # a bad item (or a failed stacked compile) must not take the
@@ -1207,20 +1308,33 @@ class RemoteVTPUWorker:
         self._prefetch_next(peek_next)
 
         def flush():
+            f0 = self.tracer.clock.now() \
+                if any(item.trace for item, _ in argsets) else 0.0
+            materialized = []
             for i, (item, _) in enumerate(argsets):
                 sub = leaves[i * n_out:(i + 1) * n_out]
                 try:
-                    f0 = self.tracer.clock.now() if item.trace else 0.0
                     results = [np.asarray(leaf) for leaf in sub]
-                    self._flush_span(item, f0, len(results))
-                    self._safe_reply(
-                        item, "EXECUTE_OK",
-                        self._traced_meta(item, {"n_results": len(results),
-                                                 "microbatched": k}),
-                        results, compress=True)
                 except Exception as e:  # noqa: BLE001 - exec error
                     log.exception("fused flush failed")
-                    self._safe_reply(item, "ERROR", {"error": str(e)}, [])
+                    self._safe_reply(item, "ERROR", {"error": str(e)},
+                                     [])
+                    results = None
+                materialized.append((item, results))
+            # one fused launch = one device interval: attribute the
+            # inter-completion gap across the batch cost-weighted
+            self._attr_flush_compute(
+                [item for item, r in materialized if r is not None],
+                time.monotonic())
+            for item, results in materialized:
+                if results is None:
+                    continue
+                self._flush_span(item, f0, len(results))
+                self._safe_reply(
+                    item, "EXECUTE_OK",
+                    self._traced_meta(item, {"n_results": len(results),
+                                             "microbatched": k}),
+                    results, compress=True)
 
         return flush
 
@@ -1231,6 +1345,61 @@ class RemoteVTPUWorker:
             if rx.get(f"buffers_{enc}"):
                 return enc
         return "raw"
+
+    def _attr_flush_compute(self, items: List[WorkItem],
+                            done_m: float) -> None:
+        """tpfprof device-time attribution at result materialization.
+
+        An async launch's device time is NOT the flush's blocking wait
+        (that wait absorbs whatever backlog was ahead of the item —
+        cross-charging other tenants' compute).  On a backlogged FIFO
+        device the honest per-launch device time is the
+        **inter-completion gap**: ``completion_k - max(completion_{k-1},
+        enqueue_k)`` — gaps telescope, so flush lag cancels and each
+        launch is charged exactly the device interval it occupied.  A
+        fused batch shares one gap, split cost-weighted.  Reply
+        serialization and socket sends happen after ``done_m`` and are
+        wire cost, deliberately excluded.  Runs on the dispatcher
+        thread only (flushes execute in launch order)."""
+        if self.profiler is None:
+            return
+        start = self._last_completion_m
+        for item in items:
+            enq = item.meta.get("_enq_m")
+            if enq is not None:
+                start = max(start, enq)
+                break               # FIFO: the first item bounds all
+        dur = max(done_m - start, 0.0)
+        self._last_completion_m = done_m
+        total_cost = sum(i.cost for i in items) or 1.0
+        for item in items:
+            if item.tenant is None:
+                continue
+            # count=False: the dispatcher already counted this item's
+            # launch; this is the same launch's device-time slice
+            self.profiler.attribute(item.tenant.conn_id, "compute",
+                                    dur * item.cost / total_cost,
+                                    qos=item.tenant.qos, count=False)
+
+    def _attr_transfer(self, item: WorkItem, exposed_s: float,
+                       hidden_s: float) -> None:
+        """tpfprof transfer attribution for one item: exposed = the
+        argument-resolution time on the launch critical path, hidden =
+        async copy time that ran behind earlier work (prefetch /
+        PUT-stream scatter).  ``overlap efficiency = hidden / total``
+        is the number that validates the PR-9 double buffering."""
+        if self.profiler is None or item.tenant is None:
+            return
+        exposed_s = max(exposed_s, 0.0)
+        # the dispatcher subtracts the exposed portion from its launch
+        # window so transfer time is never double-counted as compute
+        # (and the prefetch's tenant-asymmetric hiding cannot skew the
+        # attributed device shares)
+        item.meta["_xfer_exposed_s"] = exposed_s
+        self.profiler.attribute(item.tenant.conn_id, "transfer",
+                                exposed_s + hidden_s,
+                                qos=item.tenant.qos,
+                                hidden_s=hidden_s)
 
     def _upload_span(self, item: WorkItem, start_s: float,
                      n_args: int) -> None:
@@ -1306,6 +1475,8 @@ class RemoteVTPUWorker:
             if meta.get("_wire_version", 2) >= 3 else None
         it = iter(buffers)
         up0 = self.tracer.clock.now() if item.trace else 0.0
+        self._hidden_acc = 0.0
+        up_m0 = time.monotonic()
         try:
             if sharded is not None:
                 args = self._gather_sharded_args(
@@ -1318,7 +1489,12 @@ class RemoteVTPUWorker:
             self._safe_reply(item, "ERROR",
                              {"error": str(e.args[0])}, [])
             return None
+        self._attr_transfer(item, time.monotonic() - up_m0,
+                            self._hidden_acc)
         self._upload_span(item, up0, len(args))
+        # device-enqueue timestamp: the lower bound of this item's
+        # inter-completion-gap device-time attribution
+        item.meta["_enq_m"] = time.monotonic()
         if sharded is not None:
             leaves = sharded["fn"](*args)
         elif mlir_exe is not None:
@@ -1410,6 +1586,7 @@ class RemoteVTPUWorker:
             try:
                 f0 = self.tracer.clock.now() if _item.trace else 0.0
                 results = [np.asarray(leaf) for leaf in _leaves]
+                self._attr_flush_compute([_item], time.monotonic())
                 self._flush_span(_item, f0, len(results))
                 self._safe_reply(_item, "EXECUTE_OK",
                                  self._traced_meta(
@@ -1475,6 +1652,8 @@ class RemoteVTPUWorker:
                 "quant_on": bool(meta.get("_quant_on")),
                 "upload_overlap": self.upload_stats(),
                 "dispatch": self.dispatcher.snapshot(),
+                "profile": self.profiler.snapshot()
+                if self.profiler is not None else None,
                 "serving": self.engine.snapshot()
                 if self.engine is not None else None,
                 "wire_compression": wire,
@@ -1632,9 +1811,12 @@ class RemoteVTPUWorker:
                 # pipelined shard upload: hand the H2D copy to the
                 # scatter pool and return to decoding the next frame —
                 # transfer of shard k+1 overlaps the device_put of
-                # shard k.  The Future is resolved at first use.
-                arr = self._pool().submit(jax.device_put, host,
-                                          devices[device_id])
+                # shard k.  The Future is resolved at first use.  The
+                # copy is timed so the EXECUTE that consumes it can
+                # attribute the hidden (overlapped) portion of its
+                # transfer time (docs/profiling.md).
+                arr = self._pool().submit(self._timed_put, buf_id,
+                                          host, devices[device_id])
             else:
                 # worker-minted ids keep the v2 contract: PUT_OK means
                 # the buffer is resident (and upload failures release

@@ -143,13 +143,27 @@ class ServingEngine:
                  tracer=None, name: str = "engine0",
                  max_batch: int = DEFAULT_MAX_BATCH,
                  prefill_chunk_tokens: int = DEFAULT_PREFILL_CHUNK,
-                 max_waiting: int = DEFAULT_MAX_WAITING):
+                 max_waiting: int = DEFAULT_MAX_WAITING,
+                 profiler=None, recorder=None):
         self.runner = runner
         self.clock = clock or default_clock()
         #: span recorder (None disables tracing; only sequences that
         #: CARRY a sampled context record spans, so untraced serving
         #: pays nothing — same contract as the dispatcher)
         self.tracer = tracer
+        #: tpfprof attribution (docs/profiling.md): decode/prefill
+        #: device time + admission waits charged per tenant, paged-KV
+        #: footprint stamped as the per-tenant HBM gauge — always-on,
+        #: every sequence (None disables)
+        self.profiler = profiler
+        #: flight-recorder ring: one "engine" step summary per active
+        #: step, the serving half of a postmortem bundle
+        self.recorder = recorder
+        #: per-block device bytes for the HBM gauge (0 when the runner
+        #: has no physical pool, e.g. the twin's FakeRunner)
+        self._block_nbytes = int(getattr(runner, "nbytes", 0)
+                                 or 0) // max(int(getattr(
+                                     runner, "num_blocks", 1)), 1)
         self.name = name
         self.max_batch = max(1, max_batch)
         self.prefill_chunk_tokens = max(1, prefill_chunk_tokens)
@@ -319,6 +333,14 @@ class ServingEngine:
             tables = [self.account.table(s.sid) for s in batch]
             nxt = self.runner.decode(tokens, positions, tables)
             self._step_span(batch, t0)
+            if self.profiler is not None:
+                # one fused launch: its device time splits evenly
+                # across the batch members (identical per-row cost)
+                dur = self.clock.monotonic() - t0
+                for s in batch:
+                    self.profiler.attribute(s.tenant, "compute",
+                                            dur / len(batch),
+                                            qos=s.qos)
             decoded = len(batch)
             for seq, tok in zip(batch, nxt):
                 seq.tokens.append(int(tok))
@@ -347,7 +369,26 @@ class ServingEngine:
                     self._last_trace_id = st.last_trace_id
             self.retired += sum(
                 1 for s in retired if s.finish_reason != FINISH_SHED)
+            waiting_n = len(self._waiting)
+            steps_n = self.steps
             self._cv.notify_all()
+        if did and self.profiler is not None:
+            # per-tenant paged-KV footprint gauge: the sum of each
+            # tenant's live block tables, in device bytes (0 under the
+            # twin's storage-free FakeRunner — counts still attribute)
+            hbm: Dict[str, int] = {}
+            for s in self._running:
+                hbm[s.tenant] = hbm.get(s.tenant, 0) + \
+                    len(self.account.table(s.sid)) * self._block_nbytes
+            for tenant, nbytes in sorted(hbm.items()):
+                self.profiler.set_hbm(tenant, nbytes)
+        if did and self.recorder is not None:
+            self.recorder.note(
+                "engine", "step", step=steps_n,
+                admitted=len(admitted_seqs), shed=len(shed),
+                prefill_chunks=chunks, decoded=decoded,
+                retired=len(retired), waiting=waiting_n,
+                active=len(self._running))
         if did:
             self.step_time.observe(self.clock.monotonic() - now)
 
@@ -423,6 +464,17 @@ class ServingEngine:
             seq.admitted_m = now
             self._running.append(seq)
             self._admit_span(seq, now)
+            if self.profiler is not None:
+                self.profiler.attribute(seq.tenant, "queue",
+                                        now - seq.arrival_m,
+                                        qos=seq.qos, end_m=now)
+        if self.profiler is not None:
+            for seq in shed:
+                # a shed sequence spent its whole life waiting: that
+                # wait is queue time it was charged for, never served
+                self.profiler.attribute(seq.tenant, "queue",
+                                        now - seq.arrival_m,
+                                        qos=seq.qos, end_m=now)
         return shed, admitted
 
     def _prefill_chunk(self, seq: Sequence, events: List[tuple]) -> int:
@@ -437,6 +489,10 @@ class ServingEngine:
             ctx[seq.prefill_pos:seq.prefill_pos + chunk],
             self.account.table(seq.sid), seq.prefill_pos, last=last)
         self._prefill_span(seq, t0, chunk)
+        if self.profiler is not None:
+            self.profiler.attribute(seq.tenant, "compute",
+                                    self.clock.monotonic() - t0,
+                                    qos=seq.qos)
         seq.prefill_pos += chunk
         if last:
             seq.state = ACTIVE

@@ -35,6 +35,8 @@ from .. import constants
 from ..api.types import Node, Pod, TPUChip, TPUWorkload
 from ..clock import set_default_clock
 from ..operator import Operator
+from ..profiling.profiler import Profiler
+from ..profiling.recorder import FlightRecorder
 from ..store import ObjectStore
 from .clock import SimClock
 
@@ -62,6 +64,21 @@ class SimHarness:
         self.op = Operator(store=self.store, clock=self.clock,
                            sync_interval_s=sync_interval_s, **kwargs)
         self.metrics_interval_s = metrics_interval_s
+        #: tpfprof attribution in VIRTUAL time (docs/profiling.md):
+        #: reconcile/scheduler activity charged per component.  Under
+        #: SimClock reconcile durations are zero-width, so the digest
+        #: fingerprints *which components ran, when, how often* — the
+        #: third determinism fingerprint next to log/trace digests.
+        self.profiler = Profiler(name="control-plane",
+                                 clock=self.clock, bin_s=1.0)
+        #: always-on flight recorder: recent store events + invariant
+        #: trips, frozen into a deterministic postmortem bundle when a
+        #: scenario fails (scenarios.py / sim_scenarios.py)
+        self.recorder = FlightRecorder(
+            clock=self.clock,
+            config={"component": "sim-harness", "seed": seed,
+                    "sync_interval_s": sync_interval_s,
+                    "metrics_interval_s": metrics_interval_s})
         #: deterministic event log: (t, etype, kind, key, node)
         self.events: List[Tuple] = []
         #: controller names whose watch delivery is stalled (WatchStall)
@@ -130,6 +147,8 @@ class SimHarness:
             if ev.obj.KIND == "Pod" else ""
         self.events.append((round(self.clock.monotonic(), 9), ev.type,
                             ev.obj.KIND, ev.obj.key(), node))
+        self.recorder.note("store", ev.type, obj_kind=ev.obj.KIND,
+                           key=ev.obj.key(), node=node)
 
     def log_note(self, *entry) -> None:
         """Scenario/fault annotations join the same deterministic log."""
@@ -142,6 +161,34 @@ class SimHarness:
         for entry in self.events:
             h.update(repr(entry).encode())
         return h.hexdigest()
+
+    # -- tpfprof: profile + postmortem bundles -----------------------------
+
+    def profile_digest(self) -> str:
+        """Canonical digest of the virtual-time attribution profile —
+        the third determinism fingerprint (same seed => identical
+        profile, alongside log_digest/trace_digest)."""
+        return self.profiler.digest()
+
+    def build_bundle(self, reason: str):
+        """In-memory postmortem bundle ({filename: bytes}, digest):
+        flight-recorder rings + the run's traces + invariant verdicts
+        + the profile snapshot — digestable without touching disk, so
+        the double-run determinism check covers bundles too."""
+        return self.recorder.build_bundle(
+            reason, tracers=(self.op.tracer,),
+            extra={"profile": self.profiler.snapshot(bins=10 ** 9),
+                   "invariants": self.check_all(),
+                   "sim_seconds": round(self.clock.monotonic(), 9)})
+
+    def dump_bundle(self, out_dir: str, reason: str):
+        """Write the postmortem bundle directory; returns (path,
+        digest).  Wired to invariant failures by scenarios.py."""
+        return self.recorder.dump_bundle(
+            out_dir, reason, tracers=(self.op.tracer,),
+            extra={"profile": self.profiler.snapshot(bins=10 ** 9),
+                   "invariants": self.check_all(),
+                   "sim_seconds": round(self.clock.monotonic(), 9)})
 
     # -- virtual-time traces ----------------------------------------------
 
@@ -225,10 +272,15 @@ class SimHarness:
     # -- stepping ---------------------------------------------------------
 
     def _reconcile(self, c, ev) -> None:
+        t0 = self.clock.monotonic()
         try:
             c.reconcile(ev)
         except Exception:
             log.exception("sim: controller %s reconcile failed", c.name)
+        # virtual-time attribution: reconciles are zero-width under
+        # SimClock, so this fingerprints which controller ran when
+        self.profiler.attribute(c.name, "compute",
+                                self.clock.monotonic() - t0)
 
     def _cooperative_step(self) -> None:
         """SimClock.on_sleep hook: when an actor poll-sleeps (e.g.
@@ -261,6 +313,7 @@ class SimHarness:
                         progress = True
                 if self.op.scheduler.run_until_idle():
                     progress = True
+                    self.profiler.attribute("scheduler", "compute", 0.0)
                 if not progress:
                     break
             else:

@@ -32,6 +32,12 @@ SCENARIOS: Dict[str, Callable] = {}
 #: signature and results must stay JSON-small
 LAST_TRACE: Dict[str, object] = {}
 
+#: profiler snapshots + meta of the most recent scenario run —
+#: ``sim_scenarios.py --export-profile`` writes them as a tpfprof-v1
+#: artifact (tools/tpfprof.py reads it); same lifetime contract as
+#: LAST_TRACE
+LAST_PROFILE: Dict[str, object] = {}
+
 SCALES = {
     # tier-1 / verify-sim: seconds of wall time
     "small": dict(nodes=8, chips=4, workloads=6, replicas=3, churn=10),
@@ -68,6 +74,8 @@ def scenario(name: str):
 
 def _result(h: SimHarness, name: str, seed: int, scale: str,
             t_wall0: float, extra: Optional[dict] = None) -> dict:
+    import os as _os
+
     checks = h.check_all()
     ok = not any(checks.values()) and h.pump_exhausted == 0
     out = {
@@ -81,15 +89,30 @@ def _result(h: SimHarness, name: str, seed: int, scale: str,
         "log_digest": h.log_digest(),
         "trace_spans": len(h.trace_spans()),
         "trace_digest": h.trace_digest(),
+        "profile_digest": h.profile_digest(),
         "pods_scheduled": h.op.scheduler.scheduled_count,
         "sched_failures": h.op.scheduler.failed_count,
         "pump_exhausted": h.pump_exhausted,
         "invariants": {k: v[:10] for k, v in checks.items()},
     }
+    if not ok:
+        # invariant trip: freeze the black box.  The digest always
+        # lands in the result (the double run must reproduce the SAME
+        # postmortem); the directory is only written when a bundle dir
+        # is configured (TPF_PROF_BUNDLE_DIR / TPF_SIM_BUNDLE_DIR).
+        _, bundle_digest = h.build_bundle(f"invariant-{name}")
+        out["bundle_digest"] = bundle_digest
+        bundle_dir = _os.environ.get("TPF_SIM_BUNDLE_DIR", "") or \
+            h.recorder.bundle_dir
+        if bundle_dir:
+            path, _ = h.dump_bundle(bundle_dir, f"invariant-{name}")
+            out["bundle_path"] = path
     LAST_TRACE["spans"] = h.trace_spans()
     LAST_TRACE["meta"] = {"scenario": name, "seed": seed,
                           "scale": scale,
                           "sim_seconds": out["sim_seconds"]}
+    LAST_PROFILE["snapshots"] = [h.profiler.snapshot(bins=10 ** 9)]
+    LAST_PROFILE["meta"] = dict(LAST_TRACE["meta"])
     if extra:
         out.update(extra)
     return out
@@ -323,6 +346,8 @@ def serving_burst_storm(seed: int = 0, scale: str = "small") -> dict:
     import json as _json
     import random as _random
 
+    from ..profiling.profiler import Profiler
+    from ..profiling.recorder import FlightRecorder
     from ..remoting.dispatch import BusyError
     from ..serving.engine import ServingEngine
     from ..serving.runner import FakeRunner
@@ -334,12 +359,17 @@ def serving_burst_storm(seed: int = 0, scale: str = "small") -> dict:
     t0 = _wall_time.perf_counter()
     clock = SimClock()
     tracer = Tracer(service="serving-sim", clock=clock, id_prefix="sb")
+    profiler = Profiler(name="sim-engine", clock=clock, bin_s=0.1)
+    recorder = FlightRecorder(clock=clock,
+                              config={"component": "serving-sim",
+                                      "seed": seed, "scale": scale})
     rng = _random.Random(seed)
     runner = FakeRunner(num_blocks=p["blocks"], block_size=4)
     eng = ServingEngine(runner, clock=clock, tracer=tracer,
                         name="sim-engine", max_batch=p["batch"],
                         prefill_chunk_tokens=p["chunk"],
-                        max_waiting=p["waiting"])
+                        max_waiting=p["waiting"],
+                        profiler=profiler, recorder=recorder)
     events: list = []
     outcomes = {"done": 0, "shed": 0, "busy": 0}
 
@@ -420,6 +450,7 @@ def serving_burst_storm(seed: int = 0, scale: str = "small") -> dict:
         "log_digest": log_digest,
         "trace_spans": len(spans),
         "trace_digest": trace_digest(spans),
+        "profile_digest": profiler.digest(),
         "pods_scheduled": 0,
         "sched_failures": 0,
         "pump_exhausted": 0,
@@ -434,10 +465,18 @@ def serving_burst_storm(seed: int = 0, scale: str = "small") -> dict:
         "batch_occupancy_pct": snap["batch_occupancy_pct"],
         "ttft_p99_ms": snap["ttft"]["p99_ms"],
     }
+    if not ok:
+        _, bd = recorder.build_bundle(
+            "invariant-serving-burst-storm", tracers=(tracer,),
+            extra={"profile": profiler.snapshot(bins=10 ** 9),
+                   "invariants": violations})
+        out["bundle_digest"] = bd
     LAST_TRACE["spans"] = spans
     LAST_TRACE["meta"] = {"scenario": "serving-burst-storm",
                           "seed": seed, "scale": scale,
                           "sim_seconds": out["sim_seconds"]}
+    LAST_PROFILE["snapshots"] = [profiler.snapshot(bins=10 ** 9)]
+    LAST_PROFILE["meta"] = dict(LAST_TRACE["meta"])
     return out
 
 

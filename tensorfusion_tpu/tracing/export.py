@@ -201,7 +201,10 @@ def tree_lines(spans: Iterable[Dict[str, Any]]) -> List[str]:
 def diff_by_name(a: Iterable[Dict[str, Any]],
                  b: Iterable[Dict[str, Any]]) -> List[dict]:
     """Per-span-name duration comparison between two traces (the
-    ``tpftrace diff`` view): count and mean duration each side, delta."""
+    ``tpftrace diff`` view): count and mean duration each side, delta,
+    and a ``status`` marking spans present in only one trace
+    (``added`` = only in b, ``removed`` = only in a) — a span that
+    vanished between two runs is usually the finding, not noise."""
     def agg(spans):
         out: Dict[str, List[int]] = {}
         for d in spans:
@@ -215,9 +218,12 @@ def diff_by_name(a: Iterable[Dict[str, Any]],
         da, db = aa.get(name, []), bb.get(name, [])
         mean_a = sum(da) / len(da) / 1e3 if da else 0.0
         mean_b = sum(db) / len(db) / 1e3 if db else 0.0
+        status = "common" if da and db else \
+            ("added" if db else "removed")
         rows.append({"name": name, "count_a": len(da),
                      "count_b": len(db),
                      "mean_ms_a": round(mean_a, 3),
                      "mean_ms_b": round(mean_b, 3),
-                     "delta_ms": round(mean_b - mean_a, 3)})
+                     "delta_ms": round(mean_b - mean_a, 3),
+                     "status": status})
     return rows

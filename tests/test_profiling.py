@@ -1,0 +1,703 @@
+"""tpfprof test battery (tensorfusion_tpu/profiling, docs/profiling.md):
+
+- attribution math: time-binned splits, per-tenant shares, overlap
+  efficiency, HBM gauges, bounded bin retention;
+- determinism: same-op-sequence profiles digest identically under
+  SimClock; same-seed sim runs produce byte-identical flight-recorder
+  bundles; a seeded invariant failure auto-attaches a bundle whose
+  digest is stable across the double run;
+- flight recorder: bounded rings conflate oldest-first with drop
+  accounting, bundle manifests verify, auto-bundle budgets hold;
+- wiring: the serving engine and device dispatcher attribute for every
+  request (not just traced ones), the alert evaluator records
+  transitions and captures a bundle on firing, the remote worker's
+  INFO carries the profile;
+- schema conformance: tpf_prof_* lines match METRICS_SCHEMA, the
+  tpfprof CLI's `check`/`diff` exit codes, bench_diff's noise-band /
+  provenance-mismatch semantics, and tpftrace diff's added/removed
+  span reporting (--strict).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from tensorfusion_tpu.metrics.encoder import parse_line
+from tensorfusion_tpu.metrics.schema import METRICS_SCHEMA
+from tensorfusion_tpu.metrics.tsdb import TSDB
+from tensorfusion_tpu.profiling import (FlightRecorder, Profiler,
+                                        load_profile, profile_digest,
+                                        profile_lines,
+                                        validate_profile,
+                                        write_profile)
+from tensorfusion_tpu.profiling.profiler import merge_snapshots
+from tensorfusion_tpu.profiling.recorder import (bundle_digest,
+                                                 verify_bundle)
+from tensorfusion_tpu.sim.clock import SimClock
+
+
+# -- attribution math ------------------------------------------------------
+
+def test_attribute_splits_across_bins():
+    c = SimClock()
+    p = Profiler(name="d", clock=c, bin_s=0.5)
+    c.sleep(1.0)
+    p.attribute("a", "compute", 0.8, qos="high")   # spans [0.2, 1.0)
+    snap = p.snapshot()
+    by_t = {b["t_s"]: b for b in snap["bins"]}
+    assert by_t[0.0]["compute_s"] == pytest.approx(0.3)
+    assert by_t[0.5]["compute_s"] == pytest.approx(0.5)
+    assert by_t[0.5]["util_pct"] == pytest.approx(100.0)
+    assert by_t[0.0]["tenants"]["a"] == pytest.approx(0.3)
+    assert snap["utilization_pct"] == pytest.approx(80.0)
+
+
+def test_shares_and_overlap_efficiency():
+    c = SimClock()
+    p = Profiler(clock=c, bin_s=1.0)
+    c.sleep(2.0)
+    p.attribute("hi", "compute", 1.5, qos="high")
+    p.attribute("lo", "compute", 0.5, qos="low")
+    p.attribute("hi", "transfer", 0.4, qos="high", hidden_s=0.3)
+    p.attribute("lo", "queue", 0.2, qos="low")
+    snap = p.snapshot()
+    assert snap["tenants"]["hi"]["device_share_pct"] == pytest.approx(75.0)
+    assert snap["tenants"]["lo"]["device_share_pct"] == pytest.approx(25.0)
+    assert snap["overlap"]["efficiency_pct"] == pytest.approx(75.0)
+    assert p.shares_by_qos() == pytest.approx({"high": 0.75,
+                                               "low": 0.25})
+
+
+def test_hbm_gauge_and_qos_update():
+    p = Profiler(clock=SimClock())
+    p.set_hbm("t", 4096, qos="low")
+    p.attribute("t", "compute", 0.0, qos="high")   # later qos wins
+    snap = p.snapshot()
+    assert snap["tenants"]["t"]["hbm_bytes"] == 4096
+    assert snap["tenants"]["t"]["qos"] == "high"
+
+
+def test_unknown_kind_rejected():
+    with pytest.raises(ValueError):
+        Profiler(clock=SimClock()).attribute("t", "banana", 1.0)
+
+
+def test_bin_retention_bounded():
+    c = SimClock()
+    p = Profiler(clock=c, bin_s=1.0, max_bins=10)
+    for _ in range(50):
+        c.sleep(1.0)
+        p.attribute("t", "compute", 0.5)
+    snap = p.snapshot(bins=10 ** 9)
+    assert len(snap["bins"]) <= 10
+    # the retained window is the most recent one
+    assert snap["bins"][-1]["t_s"] >= 40.0
+
+
+def test_profile_digest_deterministic_and_sensitive():
+    def run():
+        c = SimClock()
+        p = Profiler(clock=c, bin_s=0.5)
+        for i in range(20):
+            c.sleep(0.3)
+            p.attribute(f"t{i % 3}", "compute", 0.1,
+                        qos=("low", "high")[i % 2])
+            p.attribute(f"t{i % 3}", "queue", 0.05)
+        return p
+    a, b = run(), run()
+    assert a.digest() == b.digest()
+    b.attribute("t0", "compute", 1e-9)
+    assert a.digest() != b.digest()
+
+
+def test_merge_snapshots_recomputes_shares():
+    c = SimClock()
+    p1, p2 = Profiler(name="d0", clock=c), Profiler(name="d1", clock=c)
+    c.sleep(1.0)
+    p1.attribute("a", "compute", 0.6, qos="high")
+    p2.attribute("b", "compute", 0.2, qos="low")
+    merged = merge_snapshots([p1.snapshot(), p2.snapshot()])
+    assert merged["tenants"]["a"]["device_share_pct"] == pytest.approx(75.0)
+    assert merged["tenants"]["b"]["device_share_pct"] == pytest.approx(25.0)
+
+
+# -- profile lines / artifact ---------------------------------------------
+
+def _sample_profiler() -> Profiler:
+    c = SimClock()
+    p = Profiler(name="dev0", clock=c, bin_s=0.5)
+    c.sleep(1.0)
+    p.attribute("alice", "compute", 0.5, qos="high")
+    p.attribute("alice", "transfer", 0.2, qos="high", hidden_s=0.1)
+    p.attribute("bob", "queue", 0.3, qos="low")
+    p.set_hbm("alice", 8192)
+    return p
+
+
+def test_profile_lines_match_schema():
+    lines = profile_lines(_sample_profiler().snapshot(), "node-x", 123)
+    seen = set()
+    for line in lines:
+        measurement, tags, fields, _ = parse_line(line)
+        seen.add(measurement)
+        schema = METRICS_SCHEMA[measurement]
+        assert set(tags) == set(schema["tags"]), line
+        assert set(fields) <= set(schema["fields"]), line
+    assert seen == {"tpf_prof_device", "tpf_prof_tenant"}
+
+
+def test_write_load_validate_roundtrip(tmp_path):
+    snap = _sample_profiler().snapshot()
+    path = write_profile(str(tmp_path / "p.json"), [snap],
+                         meta={"seed": 7})
+    doc = load_profile(path)
+    assert validate_profile(doc) == []
+    assert profile_digest([snap]) == profile_digest(
+        doc["snapshots"])
+    with open(tmp_path / "bogus.json", "w") as f:
+        json.dump({"format": "nope"}, f)
+    with pytest.raises(ValueError):
+        load_profile(str(tmp_path / "bogus.json"))
+
+
+def test_validate_profile_rejects_undeclared_field(tmp_path):
+    snap = _sample_profiler().snapshot()
+    path = write_profile(str(tmp_path / "p.json"), [snap])
+    doc = load_profile(path)
+    doc["lines"][0] = doc["lines"][0].replace("utilization_pct=",
+                                              "made_up_field=")
+    errors = validate_profile(doc)
+    assert any("made_up_field" in e for e in errors)
+
+
+# -- flight recorder -------------------------------------------------------
+
+def test_ring_conflates_oldest_first_and_counts_drops():
+    r = FlightRecorder(clock=SimClock(), ring_len=3)
+    for i in range(7):
+        r.note("store", "ADDED", key=f"k{i}")
+    ring = r.ring("store")
+    assert [e["key"] for e in ring] == ["k4", "k5", "k6"]
+    snap = r.snapshot()["store"]
+    assert snap["dropped"] == 4 and snap["appended"] == 7
+    assert snap["capacity"] == 3
+    # seq strictly increasing (counter-minted, not wall time)
+    seqs = [e["seq"] for e in ring]
+    assert seqs == sorted(seqs)
+
+
+def test_bundle_deterministic_across_identical_runs():
+    def run():
+        c = SimClock()
+        r = FlightRecorder(clock=c, ring_len=8, config={"seed": 3})
+        for i in range(12):
+            c.sleep(0.1)
+            r.note("dispatch", "launch", exe=f"e{i % 2}", batch=1)
+        return r.build_bundle("unit")
+    (files_a, dig_a), (files_b, dig_b) = run(), run()
+    assert dig_a == dig_b
+    assert files_a == files_b          # byte-identical, file by file
+
+
+def test_dump_and_verify_bundle(tmp_path):
+    r = FlightRecorder(clock=SimClock(), config={"x": 1})
+    r.note("alerts", "firing", rule="r1")
+    tsdb = TSDB(clock=SimClock())
+    tsdb.insert("tpf_pool", {"pool": "p"}, {"utilization": 0.5}, 1.0)
+    path, digest = r.dump_bundle(str(tmp_path), "alert-r1", tsdb=tsdb)
+    assert os.path.basename(path).startswith("bundle-0001-alert-r1")
+    assert verify_bundle(path) == []
+    manifest = json.load(open(os.path.join(path, "MANIFEST.json")))
+    assert manifest["bundle_digest"] == digest
+    assert "tsdb.json" in manifest["files"]
+    # tamper -> verification fails
+    with open(os.path.join(path, "rings.json"), "a") as f:
+        f.write(" ")
+    assert any("rings.json" in e for e in verify_bundle(path))
+
+
+def test_auto_bundle_budget_and_noop_without_dir(tmp_path):
+    r = FlightRecorder(clock=SimClock(), bundle_dir="",
+                       max_auto_bundles=2)
+    assert r.auto_bundle("x") is None          # no dir: no-op
+    r2 = FlightRecorder(clock=SimClock(), bundle_dir=str(tmp_path),
+                        max_auto_bundles=2)
+    assert r2.auto_bundle("a") is not None
+    assert r2.auto_bundle("b") is not None
+    assert r2.auto_bundle("c") is None         # budget spent
+    assert len(os.listdir(tmp_path)) == 2
+
+
+def test_tsdb_dump_tail_windowed_and_sorted():
+    c = SimClock()
+    tsdb = TSDB(clock=c)
+    t0 = c.now()
+    tsdb.insert("tpf_pool", {"pool": "b"}, {"utilization": 0.1},
+                t0 + 1.0)
+    tsdb.insert("tpf_pool", {"pool": "a"}, {"utilization": 0.2},
+                t0 + 2.0)
+    c.sleep(10.0)
+    rows = tsdb.dump_tail()
+    assert [r["tags"]["pool"] for r in rows] == ["a", "b"]
+    assert rows[0]["points"] == [[round(t0 + 2.0, 9), 0.2]]
+    assert tsdb.dump_tail(window_s=3.0) == []   # both points aged out
+
+
+# -- engine / dispatcher wiring -------------------------------------------
+
+def test_engine_attributes_per_tenant_and_records_steps():
+    from tensorfusion_tpu.serving import FakeRunner, ServingEngine
+
+    c = SimClock()
+    prof = Profiler(name="eng", clock=c, bin_s=0.1)
+    rec = FlightRecorder(clock=c)
+    eng = ServingEngine(FakeRunner(num_blocks=17, block_size=4),
+                        clock=c, max_batch=2, profiler=prof,
+                        recorder=rec)
+    done = []
+    eng.submit([1, 2, 3], 3, tenant="alice", qos="high",
+               emit=lambda s, t, d, i: done.append(s) if d else None)
+    eng.submit([4, 5], 2, tenant="bob", qos="low",
+               emit=lambda s, t, d, i: done.append(s) if d else None)
+    for _ in range(40):
+        if len(done) == 2:
+            break
+        eng.step()
+        c.sleep(0.01)
+    assert len(done) == 2
+    snap = prof.snapshot()
+    assert set(snap["tenants"]) == {"alice", "bob"}
+    assert snap["tenants"]["alice"]["qos"] == "high"
+    # every sequence was admitted (queue) and decoded (compute counts)
+    assert snap["tenants"]["alice"]["queued"] == 1
+    assert snap["tenants"]["alice"]["launches"] >= 1
+    steps = [e for e in rec.ring("engine") if e["kind"] == "step"]
+    assert steps and steps[0]["admitted"] == 2
+
+
+def test_engine_shed_sequence_charged_queue_time():
+    from tensorfusion_tpu.serving import FakeRunner, ServingEngine
+
+    c = SimClock()
+    prof = Profiler(clock=c)
+    eng = ServingEngine(FakeRunner(), clock=c, max_batch=1,
+                        profiler=prof)
+    outcomes = []
+    eng.submit([1], 1, tenant="late", qos="low", deadline_ms=50.0,
+               emit=lambda s, t, d, i: outcomes.append(i))
+    c.sleep(0.2)                   # past the 50ms admission deadline
+    eng.step()
+    assert outcomes and outcomes[0]["code"] == "DEADLINE_EXCEEDED"
+    snap = prof.snapshot()
+    assert snap["tenants"]["late"]["queue_s"] == pytest.approx(0.2)
+    assert snap["tenants"]["late"]["launches"] == 0
+
+
+def test_dispatcher_attributes_queue_and_compute():
+    import time as _t
+
+    from tensorfusion_tpu.remoting.dispatch import (DeviceDispatcher,
+                                                    WorkItem)
+
+    prof = Profiler(name="disp")
+    rec = FlightRecorder()
+    replies = []
+
+    def execute_batch(items, peek_next):
+        _t.sleep(0.005)
+        for item in items:
+            item.reply("EXECUTE_OK", {}, [])
+        return None
+
+    d = DeviceDispatcher(execute_batch, profiler=prof, recorder=rec)
+    t_hi = d.register_tenant("hi", qos="high")
+    t_lo = d.register_tenant("lo", qos="low")
+    d.start()
+    try:
+        for tenant in (t_hi, t_lo):
+            for _ in range(3):
+                item = WorkItem("EXECUTE", {}, [],
+                                lambda k, m, b: replies.append(k),
+                                cost=100.0, exe_id="e1",
+                                batch_key=None, deadline_t=None)
+                d.submit(tenant, item, block=True)
+        deadline = _t.monotonic() + 10
+        while len(replies) < 6 and _t.monotonic() < deadline:
+            _t.sleep(0.01)
+    finally:
+        d.stop()
+    assert len(replies) == 6
+    snap = prof.snapshot()
+    assert snap["tenants"]["hi"]["launches"] == 3
+    assert snap["tenants"]["lo"]["queued"] == 3
+    assert snap["tenants"]["hi"]["compute_s"] > 0
+    launches = [e for e in rec.ring("dispatch")
+                if e["kind"] == "launch"]
+    assert len(launches) == 6 and launches[0]["exe"] == "e1"
+
+
+def test_dispatcher_crash_path_notes_ring():
+    import time as _t
+
+    from tensorfusion_tpu.remoting.dispatch import (DeviceDispatcher,
+                                                    WorkItem)
+
+    rec = FlightRecorder()
+
+    def explode(items, peek_next):
+        raise RuntimeError("device on fire")
+
+    d = DeviceDispatcher(explode, recorder=rec)
+    tenant = d.register_tenant("t", qos="low")
+    replies = []
+    d.start()
+    try:
+        d.submit(tenant, WorkItem(
+            "EXECUTE", {}, [], lambda k, m, b: replies.append((k, m)),
+            cost=1.0, exe_id="boom", batch_key=None, deadline_t=None),
+            block=True)
+        deadline = _t.monotonic() + 10
+        while not replies and _t.monotonic() < deadline:
+            _t.sleep(0.01)
+    finally:
+        d.stop()
+    assert replies and replies[0][0] == "ERROR"
+    crashes = [e for e in rec.ring("dispatch")
+               if e["kind"] == "crash"]
+    assert crashes and "device on fire" in crashes[0]["error"]
+
+
+def test_alert_evaluator_records_transitions_and_bundles(tmp_path):
+    from tensorfusion_tpu.alert.evaluator import (AlertEvaluator,
+                                                  AlertRule)
+
+    c = SimClock()
+    tsdb = TSDB(clock=c)
+    rec = FlightRecorder(clock=c, bundle_dir=str(tmp_path))
+    ev = AlertEvaluator(tsdb, rules=[AlertRule(
+        name="hot", measurement="tpf_pool",
+        metric_field="utilization", agg="last", op=">",
+        threshold=0.9, window_s=60.0)], clock=c, recorder=rec)
+    c.sleep(5.0)
+    tsdb.insert("tpf_pool", {"pool": "p"}, {"utilization": 0.99})
+    changed = ev.evaluate_once()
+    assert [a.state for a in changed] == ["firing"]
+    ring = rec.ring("alerts")
+    assert ring and ring[0]["kind"] == "firing" \
+        and ring[0]["rule"] == "hot"
+    bundles = [d for d in os.listdir(tmp_path)
+               if d.startswith("bundle-")]
+    assert len(bundles) == 1 and "alert-hot" in bundles[0]
+    assert verify_bundle(str(tmp_path / bundles[0])) == []
+    # resolution lands in the ring, but never captures a new bundle
+    c.sleep(120.0)
+    tsdb.insert("tpf_pool", {"pool": "p"}, {"utilization": 0.1})
+    ev.evaluate_once()
+    assert [e["kind"] for e in rec.ring("alerts")] == ["firing",
+                                                       "resolved"]
+    assert len(os.listdir(tmp_path)) == 1
+
+
+# -- sim determinism -------------------------------------------------------
+
+@pytest.mark.sim
+def test_serving_scenario_profile_digest_deterministic():
+    from tensorfusion_tpu.sim.scenarios import run_scenario
+
+    a = run_scenario("serving-burst-storm", seed=5, scale="small")
+    b = run_scenario("serving-burst-storm", seed=5, scale="small")
+    assert a["profile_digest"] == b["profile_digest"]
+    assert "profile_digest" in a and a["ok"]
+    c = run_scenario("serving-burst-storm", seed=6, scale="small")
+    assert c["profile_digest"] != a["profile_digest"]
+
+
+@pytest.mark.sim
+def test_harness_scenario_carries_profile_digest():
+    from tensorfusion_tpu.sim.scenarios import run_scenario
+
+    a = run_scenario("thundering-herd-rescale", seed=9, scale="small")
+    b = run_scenario("thundering-herd-rescale", seed=9, scale="small")
+    assert a["profile_digest"] == b["profile_digest"]
+    assert a["ok"] and "bundle_digest" not in a
+
+
+@pytest.mark.sim
+def test_seeded_invariant_failure_attaches_stable_bundle():
+    """The flight-recorder determinism contract: a deliberately broken
+    operator build (every bind lands on a dead node) trips the lost-
+    pods invariant, the scenario result auto-attaches a postmortem
+    bundle digest, and the digest is IDENTICAL across the double run —
+    same-seed postmortems are byte-for-byte reproducible."""
+    from tensorfusion_tpu.sim.harness import SimHarness
+    from tensorfusion_tpu.sim.scenarios import _result
+    from tensorfusion_tpu.sim.trace import TraceGenerator
+
+    def broken_run():
+        import time as _wall
+
+        with SimHarness(seed=21) as h:
+            tg = TraceGenerator(h)
+            tg.build_cluster(3, 4)
+            original = h.op._bind_pod
+
+            def bad_bind(pod, node):
+                original(pod, "dead-node-x")
+            h.op._bind_pod = bad_bind
+            h.op.scheduler.bind_fn = bad_bind
+            tg.submit_workload(tg.make_workload("bad-wl", 2))
+            h.run_for(5.0)
+            return _result(h, "unit-broken", 21, "small",
+                           _wall.perf_counter())
+    a, b = broken_run(), broken_run()
+    assert not a["ok"]
+    assert a["bundle_digest"] == b["bundle_digest"]
+    assert a["profile_digest"] == b["profile_digest"]
+
+
+@pytest.mark.sim
+def test_invariant_bundle_written_when_dir_configured(tmp_path,
+                                                      monkeypatch):
+    from tensorfusion_tpu.sim.harness import SimHarness
+    from tensorfusion_tpu.sim.scenarios import _result
+    from tensorfusion_tpu.sim.trace import TraceGenerator
+    import time as _wall
+
+    monkeypatch.setenv("TPF_SIM_BUNDLE_DIR", str(tmp_path))
+    with SimHarness(seed=4) as h:
+        tg = TraceGenerator(h)
+        tg.build_cluster(2, 2)
+        tg.submit_workload(tg.make_workload("leak-wl", 1))
+        h.run_for(3.0)
+        h.op.allocator.dealloc = lambda key: None
+        tg.delete_workload("leak-wl")
+        h.run_for(5.0)
+        r = _result(h, "unit-leak", 4, "small", _wall.perf_counter())
+    assert not r["ok"]
+    assert "bundle_path" in r
+    assert verify_bundle(r["bundle_path"]) == []
+    extra = json.load(open(os.path.join(r["bundle_path"],
+                                        "extra.json")))
+    assert extra["invariants"]["no_leaked_allocations"]
+
+
+# -- remote worker INFO ----------------------------------------------------
+
+def test_worker_info_carries_profile():
+    import numpy as np
+    import jax.numpy as jnp
+
+    from tensorfusion_tpu.remoting import RemoteDevice, RemoteVTPUWorker
+
+    w = RemoteVTPUWorker(port=0)
+    w.start()
+    try:
+        dev = RemoteDevice(f"tcp://127.0.0.1:{w.port}", qos="high")
+        remote = dev.remote_jit(lambda a: jnp.tanh(a * 1.5))
+        x = np.ones((8, 8), dtype=np.float32)
+        for _ in range(3):
+            remote(x)
+        prof = dev.info()["profile"]
+        dev.close()
+    finally:
+        w.stop()
+    assert prof["totals"]["launches"] == 3
+    tenants = list(prof["tenants"].values())
+    assert tenants and tenants[0]["qos"] == "high"
+    assert tenants[0]["compute_s"] > 0
+    lines = profile_lines(prof, "unit", 1)
+    for line in lines:
+        measurement, tags, fields, _ = parse_line(line)
+        schema = METRICS_SCHEMA[measurement]
+        assert set(tags) == set(schema["tags"])
+        assert set(fields) <= set(schema["fields"])
+
+
+def test_worker_profiler_disabled_by_env(monkeypatch):
+    from tensorfusion_tpu.remoting import RemoteVTPUWorker
+
+    monkeypatch.setenv("TPF_PROF", "0")
+    w = RemoteVTPUWorker(port=0)
+    try:
+        assert w.profiler is None
+        assert w.dispatcher.profiler is None
+    finally:
+        w._server.server_close()
+
+
+# -- CLI exit codes --------------------------------------------------------
+
+def test_tpfprof_cli_check_top_timeline_diff(tmp_path, capsys):
+    from tools import tpfprof
+
+    snap = _sample_profiler().snapshot()
+    good = str(tmp_path / "good.json")
+    write_profile(good, [snap], meta={"seed": 1})
+    assert tpfprof.main(["check", good]) == 0
+    assert tpfprof.main(["top", good]) == 0
+    assert tpfprof.main(["timeline", good, "--bins", "4"]) == 0
+    out = capsys.readouterr().out
+    assert "alice" in out and "overlap-eff" in out
+
+    # corrupt: undeclared field -> exit 1
+    doc = load_profile(good)
+    doc["lines"][0] = doc["lines"][0].replace("utilization_pct=",
+                                              "bogus_field=")
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as f:
+        json.dump(doc, f)
+    assert tpfprof.main(["check", bad]) == 1
+
+    # diff: identical -> 0; shifted shares beyond tolerance -> 1
+    assert tpfprof.main(["diff", good, good,
+                         "--tolerance-pct", "1"]) == 0
+    c = SimClock()
+    p2 = Profiler(name="dev0", clock=c, bin_s=0.5)
+    c.sleep(1.0)
+    p2.attribute("alice", "compute", 0.1, qos="high")
+    p2.attribute("bob", "compute", 0.9, qos="low")
+    other = str(tmp_path / "other.json")
+    write_profile(other, [p2.snapshot()])
+    assert tpfprof.main(["diff", good, other,
+                         "--tolerance-pct", "5"]) == 1
+
+
+def test_bench_diff_bands_and_provenance(tmp_path, monkeypatch,
+                                         capsys):
+    from tools import bench_diff
+
+    monkeypatch.setenv("TPF_BENCH_RESULTS_DIR", str(tmp_path))
+
+    def write(name, doc):
+        with open(tmp_path / f"{name}.json", "w") as f:
+            json.dump(doc, f)
+
+    # in-band move: ok
+    write("sched", {"pods_per_second": 900.0,
+                    "backend_evidence": "cpu-fallback",
+                    "previous": {"pods_per_second": 1000.0,
+                                 "backend_evidence": "cpu-fallback"}})
+    assert bench_diff.main(["--artifact", "sched"]) == 0
+    # out-of-band regression: exit 1
+    write("sched", {"pods_per_second": 100.0,
+                    "backend_evidence": "cpu-fallback",
+                    "previous": {"pods_per_second": 1000.0,
+                                 "backend_evidence": "cpu-fallback"}})
+    assert bench_diff.main(["--artifact", "sched"]) == 1
+    # provenance mismatch: never compared, exit 0
+    write("sched", {"pods_per_second": 100.0,
+                    "backend_evidence": "cpu-fallback",
+                    "previous": {"pods_per_second": 1000.0,
+                                 "backend_evidence": "tpu"}})
+    assert bench_diff.main(["--artifact", "sched"]) == 0
+    out = capsys.readouterr().out
+    assert "backend_evidence mismatch" in out
+    # provenance worklist lists the cpu-fallback artifact
+    assert bench_diff.main(["provenance"]) == 0
+    out = capsys.readouterr().out
+    assert "sched.json" in out and "cpu-fallback" in out
+
+
+def test_tpftrace_diff_reports_added_removed_and_strict(tmp_path,
+                                                        capsys):
+    """Regression: spans present in only one trace used to fold into
+    zero-mean rows with no marker; now they are reported as
+    added/removed and --strict exit-codes on them."""
+    from tensorfusion_tpu.tracing.export import (diff_by_name,
+                                                 write_trace)
+    from tools import tpftrace
+
+    span = {"name": "scheduler.schedule", "service": "op",
+            "trace_id": "t1", "span_id": "s1", "parent_id": "",
+            "start_us": 0, "dur_us": 100, "attrs": {}}
+    extra = dict(span, name="scheduler.bind", span_id="s2")
+    a = str(tmp_path / "a.json")
+    b = str(tmp_path / "b.json")
+    write_trace(a, [span])
+    write_trace(b, [span, extra])
+    rows = {r["name"]: r["status"] for r in diff_by_name(
+        [span], [span, extra])}
+    assert rows == {"scheduler.schedule": "common",
+                    "scheduler.bind": "added"}
+    assert tpftrace.main(["diff", a, b]) == 0
+    out = capsys.readouterr().out
+    assert "1 span name(s) added" in out \
+        and "scheduler.bind" in out
+    assert tpftrace.main(["diff", a, b, "--strict"]) == 1
+    assert tpftrace.main(["diff", b, a, "--strict"]) == 1   # removed
+    assert tpftrace.main(["diff", a, a, "--strict"]) == 0
+
+
+# -- tpflint extension fixtures -------------------------------------------
+
+def _lint_fixture(tmp_path, rel: str, source: str, extra=()):
+    """Run tpflint's project checkers over a tiny fixture tree that
+    carries the real registries (so schema context exists)."""
+    import shutil
+
+    from tools.tpflint.core import run_paths
+
+    root = tmp_path / "fixture"
+    (root / "pkg" / "metrics").mkdir(parents=True, exist_ok=True)
+    (root / "pkg" / "tracing").mkdir(parents=True, exist_ok=True)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    shutil.copy(os.path.join(repo, "tensorfusion_tpu/metrics/schema.py"),
+                root / "pkg" / "metrics" / "schema.py")
+    shutil.copy(os.path.join(repo,
+                             "tensorfusion_tpu/tracing/registry.py"),
+                root / "pkg" / "tracing" / "registry.py")
+    # docs so the docs-coverage rules stay quiet
+    (root / "docs").mkdir(exist_ok=True)
+    for doc in ("metrics-schema.md", "tracing.md"):
+        shutil.copy(os.path.join(repo, "docs", doc),
+                    root / "docs" / doc)
+    target = root / rel
+    target.parent.mkdir(parents=True, exist_ok=True)
+    target.write_text(source)
+    for extra_rel, extra_src in extra:
+        p = root / extra_rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(extra_src)
+    return run_paths(["pkg"], str(root),
+                     checks={"metrics-schema", "trace-schema"},
+                     use_cache=False)
+
+
+def test_lint_flags_undeclared_metrics_registry_subscript(tmp_path):
+    findings = _lint_fixture(
+        tmp_path, "pkg/consumer.py",
+        "from .metrics.schema import METRICS_SCHEMA\n"
+        "def shape():\n"
+        "    return METRICS_SCHEMA[\"tpf_prof_bogus\"]\n")
+    assert any(f.check == "metrics-schema"
+               and "tpf_prof_bogus" in f.message for f in findings)
+
+
+def test_lint_accepts_declared_metrics_registry_subscript(tmp_path):
+    findings = _lint_fixture(
+        tmp_path, "pkg/consumer.py",
+        "from .metrics.schema import METRICS_SCHEMA\n"
+        "def shape():\n"
+        "    return METRICS_SCHEMA[\"tpf_prof_device\"]\n")
+    assert not any("tpf_prof_device" in f.message
+                   and "not declared" in f.message for f in findings)
+
+
+def test_lint_flags_undeclared_span_registry_subscript(tmp_path):
+    findings = _lint_fixture(
+        tmp_path, "pkg/consumer.py",
+        "from .tracing.registry import SPAN_SCHEMA\n"
+        "def attrs():\n"
+        "    return SPAN_SCHEMA[\"tpfprof.bogus\"]\n")
+    assert any(f.check == "trace-schema"
+               and "tpfprof.bogus" in f.message for f in findings)
+    ok = _lint_fixture(
+        tmp_path, "pkg/consumer2.py",
+        "from .tracing.registry import SPAN_SCHEMA\n"
+        "def attrs():\n"
+        "    return SPAN_SCHEMA[\"scheduler.bind\"]\n")
+    assert not any("scheduler.bind" in f.message
+                   and "registry subscript" in f.message for f in ok)

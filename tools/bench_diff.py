@@ -1,0 +1,235 @@
+"""bench_diff: exit-coded perf-regression comparator + provenance report.
+
+Every benchmark artifact under ``benchmarks/results/`` embeds the
+record it replaced (``previous``, via ``benchmarks/_artifact.py``) and
+a ``backend_evidence`` provenance stamp (``tpu`` | ``cpu-fallback``).
+This tool turns that into a CI gate and a hardware worklist:
+
+    python tools/bench_diff.py [--artifact NAME ...] [--max-cells N]
+    python tools/bench_diff.py provenance
+
+**diff (default)**: for each artifact, compare the headline cells
+declared in :data:`CELLS` against the embedded ``previous``, judged by
+per-cell noise bands (relative % for throughput-style numbers,
+absolute points for percent-style ones — a 1.2%-overhead cell cannot
+be judged relatively).  Exit 1 on any regression beyond its band.
+Cells are SKIPPED (reported, never compared) when:
+
+- the artifact embeds no ``previous`` (first record);
+- ``backend_evidence`` differs between the runs (or either side
+  pre-dates provenance stamping) — a real-chip number vs a CPU
+  fallback is a provenance change, not a regression;
+- either side lacks the cell (new cell / old artifact).
+
+**provenance**: list every artifact still carrying ``cpu-fallback``
+(or pre-provenance, i.e. unknown) evidence with its commit — the
+mechanical revalidation list for the next hardware window
+(``make bench-provenance``, ROADMAP "Net" note).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+from typing import List, Optional, Tuple
+
+RESULTS_DIR = pathlib.Path(__file__).resolve().parent.parent \
+    / "benchmarks" / "results"
+
+#: per-artifact headline cells: (dotted path, direction, band, kind[,
+#: guard]).  direction: which way is good.  kind "rel" = band is max
+#: allowed regression in percent of the previous value; kind "abs" =
+#: band is max allowed regression in the metric's own units (for
+#: percent-like metrics where relative deltas are meaningless near
+#: zero).  An optional 5th element names a *guard* path whose value
+#: must be EQUAL on both sides (a cell's shape knob, e.g. the share
+#: cell's dim) — a shape change is a new baseline, not a regression.
+#: Bands are deliberately wide — the CI box is 1-core and noisy; this
+#: gate catches step-change regressions, not 5% drift.
+CELLS = {
+    "remoting": [
+        ("value", "lower", 6.0, "abs"),              # overhead pct
+        ("multitenant_dispatch.wfq.aggregate_req_per_s",
+         "higher", 40.0, "rel", "multitenant_dispatch.dim"),
+        ("multitenant_dispatch.wfq.max_share_error_pct",
+         "lower", 5.0, "abs", "multitenant_dispatch.dim"),
+        ("multitenant_dispatch.wfq.prof_max_share_error_pct",
+         "lower", 4.0, "abs", "multitenant_dispatch.dim"),
+        ("wire_encoding.bytes_ratio_vs_raw", "higher", 15.0, "rel",
+         "wire_encoding.dim"),
+        ("tracing.overhead_pct", "lower", 4.0, "abs"),
+        ("profiler.overhead_pct", "lower", 4.0, "abs"),
+    ],
+    "sched": [
+        ("pods_per_second", "higher", 40.0, "rel"),
+    ],
+    "watch_scale": [
+        ("value", "lower", 20.0, "abs"),             # retention pct
+    ],
+    "webhook": [
+        ("mutations_per_second", "higher", 40.0, "rel"),
+    ],
+    "multitenant": [
+        ("value", "lower", 10.0, "abs"),             # aggregate duty pct
+    ],
+    "burst_serving": [
+        ("engine.fixed_vs_continuous.speedup_x", "higher", 30.0, "rel"),
+        ("engine.burst_storm.aggregate_tokens_per_s",
+         "higher", 40.0, "rel"),
+        ("wake_from_zero_ms", "lower", 100.0, "rel"),
+    ],
+    # sim.json: determinism is verify-sim's job; wall-seconds of a
+    # virtual-time suite are not a perf contract.  TPU-only artifacts
+    # (bench_tpu/serving_tpu/multitenant_tpu) regenerate only on real
+    # hardware — refresh-tpu-artifacts owns those.
+}
+
+
+def _get_raw(doc: dict, dotted: str):
+    cur = doc
+    for part in dotted.split("."):
+        if isinstance(cur, list):
+            try:
+                cur = cur[int(part)]
+            except (ValueError, IndexError):
+                return None
+        elif isinstance(cur, dict):
+            cur = cur.get(part)
+        else:
+            return None
+        if cur is None:
+            return None
+    return cur
+
+
+def _get(doc: dict, dotted: str):
+    cur = _get_raw(doc, dotted)
+    return cur if isinstance(cur, (int, float)) else None
+
+
+def _evidence(doc: dict) -> str:
+    return str(doc.get("backend_evidence")
+               or "unknown (pre-provenance record)")
+
+
+def diff_artifact(name: str, doc: dict) -> Tuple[List[str], List[str]]:
+    """(regressions, skipped-notes) for one artifact."""
+    prev = doc.get("previous") or {}
+    regressions: List[str] = []
+    notes: List[str] = []
+    if not prev:
+        notes.append(f"{name}: no embedded previous record — skipped")
+        return regressions, notes
+    cur_ev, prev_ev = _evidence(doc), _evidence(prev)
+    if cur_ev != prev_ev or "unknown" in cur_ev or "unknown" in prev_ev:
+        notes.append(f"{name}: backend_evidence mismatch "
+                     f"({prev_ev} -> {cur_ev}) — never compared")
+        return regressions, notes
+    for spec in CELLS.get(name, ()):
+        path, direction, band, kind = spec[:4]
+        guard = spec[4] if len(spec) > 4 else None
+        if guard is not None:
+            g_cur, g_old = _get_raw(doc, guard), _get_raw(prev, guard)
+            if g_cur != g_old:
+                notes.append(f"{name}.{path}: shape guard {guard} "
+                             f"changed ({g_old!r} -> {g_cur!r}) — new "
+                             f"baseline, not compared")
+                continue
+        cur, old = _get(doc, path), _get(prev, path)
+        if cur is None or old is None:
+            notes.append(f"{name}.{path}: absent on one side — skipped")
+            continue
+        if direction == "higher":
+            delta = old - cur          # positive = regression
+        else:
+            delta = cur - old
+        if kind == "rel":
+            scale = abs(old) if old else 1.0
+            regress_pct = 100.0 * delta / scale
+            verdict = regress_pct > band
+            detail = (f"{old:g} -> {cur:g} "
+                      f"({regress_pct:+.1f}% vs band {band}%)")
+        else:
+            verdict = delta > band
+            detail = (f"{old:g} -> {cur:g} "
+                      f"({delta:+.3g} vs band {band})")
+        line = f"{name}.{path} [{direction} is better]: {detail}"
+        if verdict:
+            regressions.append(line)
+        else:
+            notes.append(f"ok  {line}")
+    return regressions, notes
+
+
+def cmd_diff(args) -> int:
+    results_dir = pathlib.Path(os.environ.get("TPF_BENCH_RESULTS_DIR",
+                                              "") or RESULTS_DIR)
+    names = args.artifact or sorted(CELLS)
+    all_regressions: List[str] = []
+    for name in names:
+        path = results_dir / f"{name}.json"
+        if not path.exists():
+            print(f"bench-diff: {name}: no artifact at {path} — skipped")
+            continue
+        with open(path) as f:
+            doc = json.load(f)
+        regressions, notes = diff_artifact(name, doc)
+        for note in notes:
+            print(f"bench-diff: {note}")
+        for r in regressions:
+            print(f"bench-diff: REGRESSION {r}", file=sys.stderr)
+        all_regressions.extend(regressions)
+    if all_regressions:
+        print(f"bench-diff: FAIL ({len(all_regressions)} cells "
+              f"regressed beyond their noise bands)", file=sys.stderr)
+        return 1
+    print("bench-diff: OK (no out-of-band regressions)")
+    return 0
+
+
+def cmd_provenance(args) -> int:
+    """Every artifact whose evidence is not real-chip: the mechanical
+    revalidation list for the next hardware window."""
+    results_dir = pathlib.Path(os.environ.get("TPF_BENCH_RESULTS_DIR",
+                                              "") or RESULTS_DIR)
+    rows = []
+    for path in sorted(results_dir.glob("*.json")):
+        with open(path) as f:
+            try:
+                doc = json.load(f)
+            except ValueError:
+                rows.append((path.name, "unreadable", "?"))
+                continue
+        ev = _evidence(doc)
+        if ev != "tpu":
+            rows.append((path.name, ev, doc.get("commit") or "?"))
+    if not rows:
+        print("bench-provenance: every artifact carries real-chip "
+              "evidence")
+        return 0
+    print(f"{'ARTIFACT':<24}{'EVIDENCE':<34}{'COMMIT':<12}")
+    for name, ev, commit in rows:
+        print(f"{name:<24}{ev:<34}{commit:<12}")
+    print(f"-- {len(rows)} artifact(s) need real-chip revalidation "
+          f"(run `make refresh-tpu-artifacts` at the next hardware "
+          f"window)")
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "provenance":
+        ap = argparse.ArgumentParser(prog="bench_diff provenance")
+        return cmd_provenance(ap.parse_args(argv[1:]))
+    ap = argparse.ArgumentParser(prog="bench_diff", description=__doc__)
+    ap.add_argument("--artifact", action="append", default=None,
+                    choices=sorted(CELLS),
+                    help="only these artifacts (default: all declared)")
+    return cmd_diff(ap.parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

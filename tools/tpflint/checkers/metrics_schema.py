@@ -191,9 +191,20 @@ def _emit_sites(sf: SourceFile):
 
 
 def _consumer_sites(sf: SourceFile):
-    """(node, measurement, field) for tsdb.query(...) and
-    AlertRule(measurement=..., metric_field=...) literals."""
+    """(node, measurement, field) for tsdb.query(...),
+    AlertRule(measurement=..., metric_field=...) literals, and literal
+    ``METRICS_SCHEMA["name"]`` registry subscripts (the tpfprof-style
+    runtime consumer: tools that read a measurement's declared shape
+    must name a declared measurement, or the renamed series leaves a
+    silently-dead checker behind).  ``field`` is None for
+    measurement-only sites."""
     for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Subscript) and \
+                dotted_tail(node.value) == "METRICS_SCHEMA" and \
+                isinstance(node.slice, ast.Constant) and \
+                isinstance(node.slice.value, str):
+            yield node, node.slice.value, None
+            continue
         if not isinstance(node, ast.Call):
             continue
         fname = dotted_tail(node.func)
@@ -300,6 +311,8 @@ def run_project(files: Dict[str, SourceFile], repo_root: str
                     message=(f"query/alert references measurement "
                              f"{measurement!r} not declared in "
                              f"METRICS_SCHEMA")))
+            elif fieldname is None:
+                pass        # registry subscript: measurement-only site
             elif fieldname not in schema[measurement].get("fields", ()) \
                     and fieldname not in \
                     schema[measurement].get("opt_fields", ()):

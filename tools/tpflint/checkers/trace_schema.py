@@ -31,7 +31,7 @@ import ast
 import os
 from typing import Dict, List, Optional, Set, Tuple
 
-from ..core import Finding, SourceFile, iter_functions
+from ..core import Finding, SourceFile, dotted_tail, iter_functions
 
 CHECK = "trace-schema"
 
@@ -219,6 +219,21 @@ def run_project(files: Dict[str, SourceFile], repo_root: str
     for sf in files.values():
         if sf is registry_sf:
             continue
+        # literal SPAN_SCHEMA["name"] registry subscripts (runtime
+        # consumers reading a span's declared shape, the tpfprof-style
+        # site): a renamed span must not leave a stale consumer behind
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Subscript) and \
+                    dotted_tail(node.value) == "SPAN_SCHEMA" and \
+                    isinstance(node.slice, ast.Constant) and \
+                    isinstance(node.slice.value, str) and \
+                    node.slice.value not in schema:
+                findings.append(Finding(
+                    check=CHECK, path=sf.relpath, line=node.lineno,
+                    symbol="<consumer>", key=node.slice.value,
+                    message=(f"registry subscript references span "
+                             f"{node.slice.value!r} not declared in "
+                             f"SPAN_SCHEMA")))
         contexts = list(iter_functions(sf.tree))[::-1]
         contexts.append(("<module>", sf.tree))
         seen: Set[int] = set()

@@ -105,6 +105,34 @@ DRILLS = [
         ["never finished", "tracer.span"],
     ),
     (
+        "metrics-schema-registry-consumer",
+        "metrics-schema",
+        "tensorfusion_tpu/profiling/export.py",
+        "def to_doc(snapshots: Iterable[dict],",
+        (
+            "def _drill_prof_consumer():\n"
+            "    from ..metrics.schema import METRICS_SCHEMA\n"
+            "    return METRICS_SCHEMA[\"tpf_prof_bogus\"]\n"
+            "\n"
+            "\n"
+        ),
+        ["tpf_prof_bogus", "not declared"],
+    ),
+    (
+        "trace-schema-registry-consumer",
+        "trace-schema",
+        "tensorfusion_tpu/profiling/export.py",
+        "def to_doc(snapshots: Iterable[dict],",
+        (
+            "def _drill_span_consumer():\n"
+            "    from ..tracing.registry import SPAN_SCHEMA\n"
+            "    return SPAN_SCHEMA[\"tpfprof.bogus\"]\n"
+            "\n"
+            "\n"
+        ),
+        ["tpfprof.bogus", "not declared in", "SPAN_SCHEMA"],
+    ),
+    (
         "unjoined-thread",
         "unjoined-thread",
         "tensorfusion_tpu/controllers/core.py",

@@ -1,0 +1,195 @@
+"""tpfprof CLI: inspect / compare / validate tpfprof profile artifacts.
+
+Works on the ``tpfprof-v1`` JSON artifacts the platform exports
+(``benchmarks/sim_scenarios.py --export-profile``, the remoting bench
+cells, anything built from ``Profiler.snapshot()`` via
+``tensorfusion_tpu.profiling.write_profile``):
+
+    python -m tools.tpfprof top PROFILE.json
+    python -m tools.tpfprof timeline PROFILE.json [--bins N]
+    python -m tools.tpfprof diff A.json B.json [--tolerance-pct P]
+    python -m tools.tpfprof check PROFILE.json
+
+``top`` is the per-tenant device-time table (share of attributed
+device time, transfer/queue seconds, overlap, HBM gauge) merged across
+the artifact's devices.  ``timeline`` renders per-bin utilization.
+``diff`` compares per-tenant device-time shares between two artifacts
+and exits nonzero when any share moved more than ``--tolerance-pct``
+percentage points.  ``check`` validates the artifact's embedded
+``tpf_prof_*`` influx lines against METRICS_SCHEMA and its snapshots
+structurally — the same registry gate tpflint's ``metrics-schema``
+checker applies to source, applied to the runtime artifact, and what
+``make verify-prof`` exit-codes on.  Exit 0 = valid.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tensorfusion_tpu.profiling import (load_profile,  # noqa: E402
+                                        profile_digest,
+                                        validate_profile)
+from tensorfusion_tpu.profiling.profiler import merge_snapshots  # noqa: E402
+
+
+def _merged(doc) -> dict:
+    return merge_snapshots(doc.get("snapshots") or [])
+
+
+def cmd_top(args) -> int:
+    doc = load_profile(args.file)
+    snap = _merged(doc)
+    tot = snap["totals"]
+    print(f"devices: {len(doc.get('snapshots') or [])}  "
+          f"elapsed: {snap['elapsed_s']:.3f}s  "
+          f"utilization: {snap['utilization_pct']:.2f}%  "
+          f"overlap-eff: {snap['overlap']['efficiency_pct']:.1f}%")
+    print(f"attributed: compute {tot['compute_s']:.3f}s  "
+          f"transfer {tot['transfer_s']:.3f}s "
+          f"(hidden {tot['hidden_transfer_s']:.3f}s)  "
+          f"queue {tot['queue_s']:.3f}s")
+    print(f"{'TENANT':<22}{'QOS':<10}{'SHARE':>8}{'COMPUTE s':>11}"
+          f"{'TRANSFER s':>12}{'QUEUE s':>9}{'LAUNCHES':>9}"
+          f"{'HBM':>12}")
+    ordered = sorted(snap["tenants"].items(),
+                     key=lambda kv: -kv[1]["device_share_pct"])
+    for tenant, t in ordered:
+        print(f"{tenant:<22}{t['qos'] or '-':<10}"
+              f"{t['device_share_pct']:>7.2f}%"
+              f"{t['compute_s']:>11.3f}{t['transfer_s']:>12.3f}"
+              f"{t['queue_s']:>9.3f}{t['launches']:>9}"
+              f"{t['hbm_bytes']:>12}")
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    doc = load_profile(args.file)
+    for snap in doc.get("snapshots") or []:
+        bins = snap.get("bins", [])[-args.bins:]
+        print(f"== {snap.get('name', '?')} "
+              f"(bin {snap.get('bin_s', 1.0)}s, "
+              f"{len(bins)} bins shown) ==")
+        for b in bins:
+            util = b.get("util_pct", 0.0)
+            bar = "#" * min(int(util / 2.5), 40)
+            busiest = max(b.get("tenants", {}).items(),
+                          key=lambda kv: kv[1], default=None)
+            who = f"  top={busiest[0]}" if busiest and busiest[1] > 0 \
+                else ""
+            print(f"  t={b.get('t_s', 0.0):9.3f}s "
+                  f"{util:6.1f}% |{bar:<40}|"
+                  f" xfer={b.get('transfer_s', 0.0):.3f}s"
+                  f" queue={b.get('queue_s', 0.0):.3f}s{who}")
+    return 0
+
+
+def cmd_diff(args) -> int:
+    a = _merged(load_profile(args.file_a))
+    b = _merged(load_profile(args.file_b))
+    names = sorted(set(a["tenants"]) | set(b["tenants"]))
+    print(f"{'TENANT':<22}{'SHARE(a)':>10}{'SHARE(b)':>10}"
+          f"{'DELTA pp':>10}{'COMPUTE(a)s':>13}{'COMPUTE(b)s':>13}")
+    worst = 0.0
+    for name in names:
+        ta = a["tenants"].get(name, {})
+        tb = b["tenants"].get(name, {})
+        sa = ta.get("device_share_pct", 0.0)
+        sb = tb.get("device_share_pct", 0.0)
+        worst = max(worst, abs(sb - sa))
+        print(f"{name:<22}{sa:>9.2f}%{sb:>9.2f}%{sb - sa:>+10.2f}"
+              f"{ta.get('compute_s', 0.0):>13.3f}"
+              f"{tb.get('compute_s', 0.0):>13.3f}")
+    print(f"-- worst share delta: {worst:.2f}pp "
+          f"(tolerance {args.tolerance_pct}pp)")
+    if args.tolerance_pct is not None and worst > args.tolerance_pct:
+        print(f"tpfprof diff: FAIL — share moved more than "
+              f"{args.tolerance_pct}pp", file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_check(args) -> int:
+    from tensorfusion_tpu.metrics.encoder import parse_line
+    from tensorfusion_tpu.metrics.schema import METRICS_SCHEMA
+
+    doc = load_profile(args.file)
+    errors = validate_profile(doc)
+    # dead-field cross-check: every field METRICS_SCHEMA declares for
+    # the device series must appear in at least one artifact line — a
+    # field the emitter silently dropped is dead schema at runtime
+    # (tpflint's metrics-schema checker verifies this subscript names
+    # a declared measurement)
+    declared = set(METRICS_SCHEMA["tpf_prof_device"]["fields"])
+    emitted: set = set()
+    for line in doc.get("lines") or ():
+        try:
+            measurement, _, fields, _ = parse_line(line)
+        except ValueError:
+            continue            # validate_profile already reported it
+        if measurement == "tpf_prof_device":
+            emitted |= set(fields)
+    if emitted:
+        for field in sorted(declared - emitted):
+            errors.append(f"declared tpf_prof_device field {field!r} "
+                          f"missing from every line in the artifact")
+    if errors:
+        for e in errors:
+            print(f"tpfprof check: {e}", file=sys.stderr)
+        print(f"tpfprof check: FAIL ({len(errors)} errors in "
+              f"{args.file})", file=sys.stderr)
+        return 1
+    snaps = doc.get("snapshots") or []
+    n_tenants = sum(len(s.get("tenants", {})) for s in snaps)
+    print(f"tpfprof check: OK ({len(snaps)} snapshots, "
+          f"{n_tenants} tenants, {len(doc.get('lines') or ())} lines, "
+          f"digest {profile_digest(snaps)[:16]})")
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # `tools/tpfprof.py --check FILE` alias, mirroring tpftrace
+    if argv and argv[0] == "--check":
+        argv = ["check"] + argv[1:]
+    ap = argparse.ArgumentParser(prog="tpfprof", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("top", help="per-tenant device-time table")
+    p.add_argument("file")
+    p.set_defaults(fn=cmd_top)
+
+    p = sub.add_parser("timeline",
+                       help="per-bin utilization timeline")
+    p.add_argument("file")
+    p.add_argument("--bins", type=int, default=40,
+                   help="most recent bins to show per device")
+    p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("diff",
+                       help="per-tenant share comparison, exit-coded")
+    p.add_argument("file_a")
+    p.add_argument("file_b")
+    p.add_argument("--tolerance-pct", type=float, default=None,
+                   help="exit nonzero when any tenant's device-time "
+                        "share moves more than this many percentage "
+                        "points")
+    p.set_defaults(fn=cmd_diff)
+
+    p = sub.add_parser("check",
+                       help="validate an artifact against "
+                            "METRICS_SCHEMA (exit-coded)")
+    p.add_argument("file")
+    p.set_defaults(fn=cmd_check)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

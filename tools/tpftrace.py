@@ -62,11 +62,24 @@ def cmd_diff(args) -> int:
     _, b = _load_spans(args.file_b)
     rows = diff_by_name(a, b)
     print(f"{'SPAN':<26}{'N(a)':>6}{'N(b)':>6}{'mean(a)ms':>12}"
-          f"{'mean(b)ms':>12}{'delta ms':>10}")
+          f"{'mean(b)ms':>12}{'delta ms':>10}  STATUS")
     for r in rows:
         print(f"{r['name']:<26}{r['count_a']:>6}{r['count_b']:>6}"
               f"{r['mean_ms_a']:>12.3f}{r['mean_ms_b']:>12.3f}"
-              f"{r['delta_ms']:>+10.3f}")
+              f"{r['delta_ms']:>+10.3f}  {r['status']}")
+    added = [r["name"] for r in rows if r["status"] == "added"]
+    removed = [r["name"] for r in rows if r["status"] == "removed"]
+    if added or removed:
+        # a span present in only one trace is usually the finding —
+        # never silently fold it into a zero-mean row
+        print(f"-- {len(added)} span name(s) added"
+              + (f" ({', '.join(added)})" if added else "")
+              + f", {len(removed)} removed"
+              + (f" ({', '.join(removed)})" if removed else ""))
+        if args.strict:
+            print("tpftrace diff: FAIL — span set changed and "
+                  "--strict was given", file=sys.stderr)
+            return 1
     return 0
 
 
@@ -105,6 +118,9 @@ def main(argv=None) -> int:
                        help="per-span-name duration comparison")
     p.add_argument("file_a")
     p.add_argument("file_b")
+    p.add_argument("--strict", action="store_true",
+                   help="exit nonzero when a span name exists in only "
+                        "one of the traces (added/removed)")
     p.set_defaults(fn=cmd_diff)
 
     p = sub.add_parser("check",
