@@ -104,6 +104,8 @@ def serving_engine_lines(engine, node_name: str, ts: int,
         snap = engine.snapshot()
     tags = {"node": node_name, "engine": snap["name"]}
     kv = snap["kv"]
+    spec = snap.get("spec") or {}
+    ship = snap.get("kv_ship") or {}
     lines = [encode_line(
         "tpf_serving_engine", tags,
         {"tokens_total": snap["tokens"],
@@ -124,7 +126,16 @@ def serving_engine_lines(engine, node_name: str, ts: int,
          "kv_blocks_total": kv["usable"],
          "kv_blocks_used": kv["used"],
          "kv_util_pct": kv["utilization_pct"],
-         "kv_evictions_total": kv["evicted_total"]}, ts)]
+         "kv_evictions_total": kv["evicted_total"],
+         "kv_shared_blocks": kv.get("shared_blocks", 0),
+         "kv_cow_copies_total": kv.get("cow_copies_total", 0),
+         "kv_prefix_hit_tokens_total":
+             kv.get("prefix_hit_tokens_total", 0),
+         "kv_ship_bytes_total": ship.get("bytes", 0),
+         "kv_ship_blocks_total": ship.get("blocks", 0),
+         "kv_ship_dedup_blocks_total": ship.get("dedup_blocks", 0),
+         "spec_accept_rate": spec.get("accept_rate", 0.0),
+         "spec_steps_total": spec.get("steps", 0)}, ts)]
     for tenant, t in snap["tenants"].items():
         if not t["slo_total"] and not t["tokens"]:
             continue        # tenant never reached admission
@@ -139,7 +150,9 @@ def serving_engine_lines(engine, node_name: str, ts: int,
              "slo_good": t["slo_good"],
              "slo_total": t["slo_total"],
              "slo_ms": t["slo_ms"],
-             "good_ratio": good_ratio}, ts))
+             "good_ratio": good_ratio,
+             "prefix_hit_tokens_total": t.get("prefix_hit_tokens", 0),
+             "spec_accept_rate": t.get("spec_accept_rate", 0.0)}, ts))
     return lines
 
 

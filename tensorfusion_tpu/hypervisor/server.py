@@ -7,6 +7,9 @@ Analog of the reference's gin server (``pkg/hypervisor/server/``, port 8000):
 - ``GET  /api/v1/dispatch``           remote-vTPU dispatch snapshots
   (per-tenant queue-wait quantiles, SLO rollups, last trace ids — the
   TUI's dispatch pane reads this)
+- ``GET  /api/v1/serving``            tpfserve engine snapshots
+  (throughput/TTFT, KV pool + prefix-sharing/CoW, KV_SHIP ingest,
+  spec-decode accept rates — the TUI's serving pane reads this)
 - ``POST /api/v1/workers``            submit a worker (single-node backend)
 - ``DELETE /api/v1/workers/<ns>/<name>``
 - ``POST /api/v1/workers/<ns>/<name>/snapshot|resume|freeze``  live-migration hooks
@@ -215,6 +218,15 @@ class HypervisorServer:
             h._send(200, [rw.profiler.snapshot()
                           for rw in self.remote_workers
                           if getattr(rw, "profiler", None) is not None])
+        elif url.path == "/api/v1/serving":
+            # tpfserve engine view (docs/serving.md): throughput/TTFT,
+            # KV pool incl. prefix-sharing dedup + CoW counters,
+            # KV_SHIP ingest volume and spec-decode accept rates of
+            # every co-hosted worker's engine — the TUI's [s]erving
+            # pane reads this
+            h._send(200, [rw.engine.snapshot()
+                          for rw in self.remote_workers
+                          if getattr(rw, "engine", None) is not None])
         elif url.path == "/api/v1/allocations":
             # Pod-resources-proxy analog (pod_resources_proxy.go:87-318):
             # the per-pod device-assignment view monitoring agents

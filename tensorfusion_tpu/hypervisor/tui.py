@@ -15,15 +15,19 @@ other UIs are:
   tick, feeds ``TuiState`` and blits the rendered screen.
 
 Keys (reference model.go key map): d=devices w=workers m=metrics
-s=shm-inspector r=remote-dispatch p=profile, j/k or arrows move the
-selection, enter opens the detail view for the selected row, esc goes
-back, q quits.  The dispatch pane shows the co-hosted remote-vTPU
-workers' fair-queue state per tenant — queue-wait p50/p99, SLO good
-ratio and the last trace id (docs/tracing.md) — fed by
+s=shm-inspector r=remote-dispatch p=profile v=serving, j/k or arrows
+move the selection, enter opens the detail view for the selected row,
+esc goes back, q quits.  The dispatch pane shows the co-hosted
+remote-vTPU workers' fair-queue state per tenant — queue-wait p50/p99,
+SLO good ratio and the last trace id (docs/tracing.md) — fed by
 /api/v1/dispatch.  The profile pane shows tpfprof's per-tenant
 device-time attribution — share of device time, transfer/queue
 seconds, overlap efficiency, recent utilization bins
-(docs/profiling.md) — fed by /api/v1/profile.
+(docs/profiling.md) — fed by /api/v1/profile.  The serving pane shows
+each co-hosted tpfserve engine — throughput/TTFT, the paged-KV pool
+with prefix-sharing/CoW counters, KV_SHIP ingest volume and
+speculative-decode accept rates (docs/serving.md) — fed by
+/api/v1/serving.
 
     python -m tensorfusion_tpu.hypervisor.tui --url http://127.0.0.1:8000
 """
@@ -357,6 +361,72 @@ def render_dispatch(snapshots: List[dict]) -> str:
     return "\n".join(lines).rstrip()
 
 
+def render_serving(snapshots: List[dict]) -> str:
+    """tpfserve pane (docs/serving.md): per-engine throughput/TTFT,
+    the paged-KV pool with its prefix-sharing dedup + copy-on-write
+    counters, KV_SHIP ingest volume (disaggregated prefill) and
+    speculative-decode accept rates, plus the per-tenant table."""
+    if not snapshots:
+        return "(no serving engines registered on this node)"
+    lines: List[str] = []
+    for snap in snapshots:
+        kv = snap.get("kv", {})
+        ttft = snap.get("ttft", {})
+        lines.append(
+            f"== {snap.get('name', '?')} "
+            f"tok/s={snap.get('tokens_per_s', 0.0):8.1f} "
+            f"active={snap.get('active', 0)} "
+            f"waiting={snap.get('waiting', 0)} "
+            f"occupancy={snap.get('batch_occupancy_pct', 0.0):5.1f}% "
+            f"ttft p50={ttft.get('p50_ms', 0):.2f}ms "
+            f"p99={ttft.get('p99_ms', 0):.2f}ms ==")
+        lines.append(
+            f"kv: {kv.get('used', 0)}/{kv.get('usable', 0)} blocks "
+            f"({kv.get('utilization_pct', 0.0):.1f}%) "
+            f"shared={kv.get('shared_blocks', 0)} "
+            f"logical={kv.get('logical_blocks', 0)} "
+            f"cow={kv.get('cow_copies_total', 0)} "
+            f"prefix-hit-tokens={kv.get('prefix_hit_tokens_total', 0)} "
+            f"evicted={kv.get('evicted_total', 0)}")
+        spec = snap.get("spec", {})
+        ship = snap.get("kv_ship", {})
+        if spec.get("k"):
+            lines.append(
+                f"spec: k={spec.get('k', 0)} "
+                f"accept={spec.get('accept_rate', 0.0) * 100:5.1f}% "
+                f"({spec.get('accepted', 0)}/{spec.get('proposed', 0)} "
+                f"over {spec.get('steps', 0)} verify steps)")
+        if ship.get("ships"):
+            lines.append(
+                f"kv-ship: {ship.get('ships', 0)} ships "
+                f"{ship.get('blocks', 0)} blocks written "
+                f"{ship.get('dedup_blocks', 0)} deduped "
+                f"{_fmt_bytes(ship.get('bytes', 0))} shipped")
+        tenants = snap.get("tenants", {})
+        if tenants:
+            lines.append("  TENANT          QOS      TOKENS  "
+                         "TTFT p50/p99 ms   SLO ok   PREFIX-HIT  "
+                         "SPEC ok")
+            for name in sorted(tenants):
+                t = tenants[name]
+                tq = t.get("ttft", {})
+                total = t.get("slo_total", 0)
+                ratio = (f"{t.get('slo_good', 0) / total * 100:5.1f}%"
+                         if total else "    -")
+                spr = t.get("spec_proposed", 0)
+                spec_ok = (f"{t.get('spec_accept_rate', 0.0) * 100:5.1f}%"
+                           if spr else "    -")
+                lines.append(
+                    f"  {name:<15} {t.get('qos', '') or '-':<8} "
+                    f"{t.get('tokens', 0):7d} "
+                    f"{tq.get('p50_ms', 0):8.2f}/{tq.get('p99_ms', 0):<8.2f} "
+                    f"{ratio:<8} "
+                    f"{t.get('prefix_hit_tokens', 0):10d}  "
+                    f"{spec_ok}")
+        lines.append("")
+    return "\n".join(lines).rstrip()
+
+
 def render_profile(snapshots: List[dict]) -> str:
     """tpfprof pane (docs/profiling.md): per-device utilization and
     overlap efficiency, the per-tenant device-time share table, and a
@@ -446,6 +516,7 @@ VIEW_METRICS = "metrics"
 VIEW_SHM = "shm"
 VIEW_DISPATCH = "dispatch"
 VIEW_PROFILE = "profile"
+VIEW_SERVING = "serving"
 VIEW_DEVICE_DETAIL = "device_detail"
 VIEW_WORKER_DETAIL = "worker_detail"
 
@@ -469,6 +540,7 @@ class TuiState:
         self.workers: List[dict] = []
         self.dispatch: List[dict] = []
         self.profile: List[dict] = []
+        self.serving: List[dict] = []
         self.device_history: Dict[str, _EntityHistory] = {}
         self.worker_history: Dict[str, _EntityHistory] = {}
         self.last_update = 0.0
@@ -486,6 +558,11 @@ class TuiState:
         """Ingest /api/v1/profile (same degrade-to-empty contract as
         the dispatch pane for servers without the endpoint)."""
         self.profile = snapshots or []
+
+    def update_serving(self, snapshots: List[dict]) -> None:
+        """Ingest /api/v1/serving (same degrade-to-empty contract as
+        the dispatch pane for servers without the endpoint)."""
+        self.serving = snapshots or []
 
     def update(self, devices: List[dict], workers: List[dict]) -> None:
         self.devices, self.workers = devices, workers
@@ -516,10 +593,11 @@ class TuiState:
         """Process one key; returns False to quit."""
         if ch == "q":
             return False
-        if ch in ("d", "w", "m", "s", "r", "p"):
+        if ch in ("d", "w", "m", "s", "r", "p", "v"):
             self.view = {"d": VIEW_DEVICES, "w": VIEW_WORKERS,
                          "m": VIEW_METRICS, "s": VIEW_SHM,
-                         "r": VIEW_DISPATCH, "p": VIEW_PROFILE}[ch]
+                         "r": VIEW_DISPATCH, "p": VIEW_PROFILE,
+                         "v": VIEW_SERVING}[ch]
             return True
         if ch == "esc":
             if self.view == VIEW_DEVICE_DETAIL:
@@ -575,6 +653,8 @@ class TuiState:
             return render_dispatch(self.dispatch)
         if self.view == VIEW_PROFILE:
             return render_profile(self.profile)
+        if self.view == VIEW_SERVING:
+            return render_serving(self.serving)
         if self.view == VIEW_DEVICE_DETAIL:
             d = self._selected_device()
             if d is None:
@@ -596,8 +676,8 @@ class TuiState:
         if self.last_update and WALL.now() - self.last_update > 5:
             stale = f"  (stale {WALL.now() - self.last_update:.0f}s)"
         return ("tpu-fusion hypervisor  [d]evices [w]orkers [m]etrics "
-                "[s]hm [r]emote-dispatch [p]rofile  j/k+enter detail  "
-                "esc back  [q]uit" + stale)
+                "[s]hm [r]emote-dispatch [p]rofile [v]serving  "
+                "j/k+enter detail  esc back  [q]uit" + stale)
 
 
 def _clamp(idx: int, n: int) -> int:
@@ -646,6 +726,13 @@ def snapshot(url: str, shm_base: str = "") -> str:
             profile = []
         if profile:
             out += ["", render_profile(profile)]
+        try:
+            serving = _fetch(url, "/api/v1/serving")
+        # tpflint: disable=swallowed-error -- absent endpoint, by design
+        except Exception:  # noqa: BLE001 - older server: no endpoint
+            serving = []
+        if serving:
+            out += ["", render_serving(serving)]
     except Exception as e:  # noqa: BLE001
         out.append(f"(hypervisor unreachable at {url}: {e})")
     if shm_base:
@@ -691,6 +778,12 @@ def run_curses(url: str, shm_base: str, refresh_s: float = 1.0) -> None:
                     # tpflint: disable=swallowed-error -- by design
                     except Exception:  # noqa: BLE001 - old server
                         state.update_profile([])
+                    try:
+                        state.update_serving(
+                            _fetch(url, "/api/v1/serving"))
+                    # tpflint: disable=swallowed-error -- by design
+                    except Exception:  # noqa: BLE001 - old server
+                        state.update_serving([])
                 except Exception as e:  # noqa: BLE001
                     state.error = f"hypervisor unreachable at {url}: {e}"
                 dirty = True

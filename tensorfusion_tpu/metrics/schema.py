@@ -81,12 +81,17 @@ METRICS_SCHEMA = {
                    "waiting", "active", "ttft_p50_ms", "ttft_p99_ms",
                    "batch_occupancy_pct", "kv_blocks_total",
                    "kv_blocks_used", "kv_util_pct",
-                   "kv_evictions_total"),
+                   "kv_evictions_total", "kv_shared_blocks",
+                   "kv_cow_copies_total", "kv_prefix_hit_tokens_total",
+                   "kv_ship_bytes_total", "kv_ship_blocks_total",
+                   "kv_ship_dedup_blocks_total", "spec_accept_rate",
+                   "spec_steps_total"),
     },
     "tpf_serving_tenant": {
         "tags": ("node", "engine", "tenant", "qos"),
         "fields": ("tokens_total", "ttft_p50_ms", "ttft_p99_ms",
-                   "slo_good", "slo_total", "slo_ms", "good_ratio"),
+                   "slo_good", "slo_total", "slo_ms", "good_ratio",
+                   "prefix_hit_tokens_total", "spec_accept_rate"),
     },
     # tpfprof device-time attribution (tensorfusion_tpu/profiling,
     # docs/profiling.md): per-device utilization + attributed seconds

@@ -701,6 +701,129 @@ class RemoteDevice:
                 gspan.finish(error=f"{type(e).__name__}: {e}"[:200])
             raise
 
+    def ship_kv(self, prompt, max_tokens: int, keys, k, v,
+                first_token: Optional[int], n_tokens: int,
+                eos_id: Optional[int] = None,
+                deadline_ms: Optional[float] = None,
+                stream: bool = True,
+                on_token: Optional[Callable[[int], None]] = None
+                ) -> Dict[str, Any]:
+        """Ship a prompt's prefilled KV pages to the worker's decode
+        engine (protocol-v6 ``KV_SHIP``, docs/serving.md) and consume
+        the resulting generation stream — the wire half of
+        disaggregated prefill/decode.  ``keys``: per-block content
+        chain keys (:func:`~..serving.kvpool.prompt_block_keys`);
+        ``k``/``v``: ``[L, n_blocks, n_kv, bs, D]`` host arrays (None
+        degrades to a metadata-only ship for storage-free runners).
+        Large pages travel as quiet ephemeral PUTs through the
+        double-buffered upload stream — quantized per block when q8 is
+        negotiated — with the KV_SHIP frame sent after the drain
+        barrier, exactly like sharded EXECUTE uploads.
+
+        Return dict and backpressure semantics match
+        :meth:`generate`; the receipt's ``blocks`` count is included.
+        Needs a protocol-v6 worker with an engine attached — a pre-v6
+        connection raises before anything hits the wire."""
+        import queue as _queue
+
+        self._ensure_version(6, "KV_SHIP (disaggregated prefill)")
+        base_meta: Dict[str, Any] = {
+            "prompt": [int(t) for t in prompt],
+            "max_tokens": int(max_tokens),
+            "keys": [int(x) for x in keys],
+            "n_tokens": int(n_tokens),
+            "stream": bool(stream)}
+        if first_token is not None:
+            base_meta["first_token"] = int(first_token)
+        if eos_id is not None:
+            base_meta["eos_id"] = int(eos_id)
+        if deadline_ms is not None:
+            base_meta["deadline_ms"] = float(deadline_ms)
+        pages = None
+        if k is not None:
+            pages = (np.ascontiguousarray(np.asarray(k)),
+                     np.ascontiguousarray(np.asarray(v)))
+        gspan = None
+        if self.tracer is not None:
+            gspan = self.tracer.start_span(
+                "client.generate", attrs={"tokens": int(max_tokens)})
+            if gspan.sampled:
+                base_meta["trace"] = gspan.ctx()
+        busy = 0
+        try:
+            while True:
+                meta = dict(base_meta)
+                buffers: List = []
+                if pages is not None and \
+                        pages[0].nbytes >= SHARD_PUT_MIN_BYTES:
+                    # big pages: quiet ephemeral PUTs through the
+                    # upload stream (ordering barrier before the ship
+                    # frame; a BUSY retry re-ships — the worker
+                    # consumed the ephemerals with the rejection)
+                    ctr = next(self._mint)
+                    ids = [f"c-kv{ctr}-k", f"c-kv{ctr}-v"]
+                    if self._upload_stream is None:
+                        self._upload_stream = _UploadStream(
+                            self, self.upload_depth)
+                    for sid, arr in zip(ids, pages):
+                        self._upload_stream.submit(
+                            {"buf_id": sid, "ephemeral": True,
+                             "quiet": True}, arr)
+                    self._upload_stream.drain()
+                    meta["kv_bufs"] = ids
+                elif pages is not None:
+                    buffers = [pages[0], pages[1]]
+                q: "_queue.Queue" = _queue.Queue()
+                self._submit("KV_SHIP", meta, buffers, stream=q)
+                tokens: List[int] = []
+                receipt: Dict[str, Any] = {}
+                try:
+                    while True:
+                        kind, rmeta, _ = q.get(timeout=self.timeout_s)
+                        if kind == "ERROR":
+                            if rmeta.get("_connection_lost"):
+                                raise ConnectionError(
+                                    rmeta.get("error",
+                                              "connection lost"))
+                            if self.tracer is not None:
+                                self.tracer.adopt(
+                                    rmeta.get("trace_spans") or ())
+                            _raise_reply_error(rmeta)
+                        if kind == "KV_SHIP_OK":
+                            receipt = {"blocks": rmeta.get("blocks"),
+                                       "n_tokens":
+                                           rmeta.get("n_tokens")}
+                            continue
+                        for t in rmeta.get("tokens") or ():
+                            tokens.append(int(t))
+                            if on_token is not None:
+                                on_token(int(t))
+                        if rmeta.get("done"):
+                            if self.tracer is not None:
+                                self.tracer.adopt(
+                                    rmeta.get("trace_spans") or ())
+                            if gspan is not None:
+                                gspan.finish(
+                                    ttft_ms=rmeta.get("ttft_ms") or 0,
+                                    busy_retries=busy)
+                            return {"tokens": tokens,
+                                    "n_tokens": rmeta.get(
+                                        "n_tokens", len(tokens)),
+                                    "ttft_ms": rmeta.get("ttft_ms"),
+                                    "finish_reason":
+                                        rmeta.get("finish_reason", ""),
+                                    "busy_retries": busy,
+                                    "ship": receipt}
+                except RemoteBusyError as e:
+                    busy += 1
+                    if busy > MAX_BUSY_RETRIES:
+                        raise
+                    default_clock().sleep(e.backoff_s(busy))
+        except BaseException as e:
+            if gspan is not None and gspan.end_s is None:
+                gspan.finish(error=f"{type(e).__name__}: {e}"[:200])
+            raise
+
     def snapshot(self, state_dir: str) -> Dict[str, Any]:
         _, meta, _ = self._rpc("SNAPSHOT", {"state_dir": state_dir}, [])
         return meta

@@ -121,6 +121,32 @@ vocabulary grows:
 - HELLO: optional ``quant`` (bool) — the client's declaration that it
   wants q8 replies (FETCH / EXECUTE_OK results) where eligible; the
   worker never quantizes a reply the client did not ask for.
+
+Version 6 also carries the disaggregated-prefill opcode
+(docs/serving.md, docs/wire-format.md) — negotiated like everything
+since v3, so pre-v6 peers NEVER see it (the client refuses to send it
+on a < v6 connection and the worker refuses to honor it from one):
+
+- KV_SHIP: a prefill-tier worker ships a prompt's finished paged-KV
+  pages to the decode worker's engine: ``prompt`` / ``max_tokens`` /
+  optional ``eos_id`` / ``deadline_ms`` / ``stream`` / ``trace``
+  exactly like GENERATE, plus ``keys`` (per-block content chain keys —
+  the decode side dedupes blocks already in its prefix registry and
+  stores the shared prefix ONCE), ``first_token`` (the prefill tier's
+  last-position greedy token), ``n_tokens``, and the pages either
+  inline (two ``[L, n_blocks, n_kv, bs, D]`` buffers — K then V,
+  eligible for the q8 per-block encoding like any frame buffer) or as
+  ``kv_bufs`` referencing ephemeral quiet PUTs the client pipelined
+  through its ``_UploadStream`` sender beforehand (big pages overlap
+  the previous frame's scatter exactly like shard uploads).
+- KV_SHIP_OK: the admission receipt — ``blocks`` / ``n_tokens``
+  accepted, echoing the request ``seq``; generation then streams as
+  GENERATE_OK frames on the same seq (final-frame contract identical
+  to GENERATE).  Ingest/dedup counters surface in the engine snapshot
+  (``kv_ship`` — INFO "serving" and ``tpf_serving_engine``), not in
+  the receipt, because ingest runs on the engine stepper.  A saturated
+  engine answers ``BUSY``; the shipped pages are dropped with the
+  rejection, so a retry re-ships.
 """
 
 from __future__ import annotations
@@ -141,6 +167,10 @@ SUPPORTED_VERSIONS = (2, 3, 4, 5, 6)
 HELLO_VERSION = 2
 #: lowest wire version whose frames may carry ``enc="q8"`` buffers
 Q8_MIN_VERSION = 6
+#: lowest wire version that may carry the disaggregated-prefill
+#: KV_SHIP opcode (client refuses to send below it, worker refuses to
+#: honor it below it — pre-v6 peers never see the kind)
+KV_SHIP_MIN_VERSION = 6
 
 # -- opcode / reply / error-code registry ---------------------------------
 #
@@ -153,15 +183,15 @@ Q8_MIN_VERSION = 6
 
 #: client -> worker request kinds
 REQUEST_KINDS = ("HELLO", "INFO", "COMPILE", "COMPILE_MLIR", "PUT",
-                 "FREE", "FETCH", "EXECUTE", "GENERATE", "SNAPSHOT",
-                 "RESTORE")
+                 "FREE", "FETCH", "EXECUTE", "GENERATE", "KV_SHIP",
+                 "SNAPSHOT", "RESTORE")
 #: request kinds the python client never sends (COMPILE_MLIR is the
 #: transparent PJRT plugin's path — libtpf_pjrt_remote.cc is the client)
 CLIENT_OPTIONAL_KINDS = ("COMPILE_MLIR",)
 #: worker -> client reply kinds
 REPLY_KINDS = ("HELLO_OK", "INFO_OK", "COMPILE_OK", "PUT_OK", "FREE_OK",
-               "FETCH_OK", "EXECUTE_OK", "GENERATE_OK", "SNAPSHOT_OK",
-               "RESTORE_OK", "ERROR")
+               "FETCH_OK", "EXECUTE_OK", "GENERATE_OK", "KV_SHIP_OK",
+               "SNAPSHOT_OK", "RESTORE_OK", "ERROR")
 #: structured ERROR ``code`` values (v4; older clients see plain ERROR)
 ERROR_CODES = ("BUSY", "DEADLINE_EXCEEDED", "needs_compile")
 #: per-buffer wire encodings, in the order they were introduced; the

@@ -397,7 +397,8 @@ class RemoteVTPUWorker:
                     for key in ("buf_id",):
                         if key in meta:
                             meta[key] = xid(meta[key])
-                    for key in ("buf_ids", "arg_refs", "result_ids"):
+                    for key in ("buf_ids", "arg_refs", "result_ids",
+                                "kv_bufs"):
                         if meta.get(key) is not None:
                             meta[key] = [xid(v) for v in meta[key]]
                     if meta.get("arg_shards") is not None:
@@ -503,6 +504,14 @@ class RemoteVTPUWorker:
                                 # the engine thread as tokens land
                                 outer._handle_generate(
                                     reply, remap_ids(meta), tenant)
+                                continue
+                            if kind == "KV_SHIP":
+                                # disaggregated prefill: ingest shipped
+                                # KV pages, then stream GENERATE_OK
+                                # frames exactly like GENERATE
+                                outer._handle_kv_ship(
+                                    reply, remap_ids(meta), buffers,
+                                    tenant)
                                 continue
                             if kind in _BARRIER_KINDS:
                                 # these observe execution effects: wait
@@ -1047,6 +1056,25 @@ class RemoteVTPUWorker:
             reply("ERROR", {"error": f"bad GENERATE request: {e}"}, [])
             return
         stream = bool(meta.get("stream", True))
+        emit = self._generate_emit(reply, stream)
+
+        try:
+            self.engine.submit(prompt, max_tokens,
+                               tenant=tenant.conn_id, qos=tenant.qos,
+                               eos_id=eos_id, deadline_ms=deadline_ms,
+                               emit=emit,
+                               trace=self._parse_trace(meta))
+        except BusyError as e:
+            reply("ERROR", {"error": str(e), "code": "BUSY",
+                            "retry_after_ms": e.retry_after_ms}, [])
+        except ValueError as e:
+            reply("ERROR", {"error": str(e)}, [])
+
+    @staticmethod
+    def _generate_emit(reply, stream: bool):
+        """The engine emit callback both GENERATE and KV_SHIP stream
+        through: token frames as they land, one final frame with the
+        stats, engine shed/BUSY codes as structured ERROR."""
         acc: List[int] = []
 
         def emit(seq, new_tokens, done, info):
@@ -1087,17 +1115,81 @@ class RemoteVTPUWorker:
                 # on the floor at each emit
                 pass
 
+        return emit
+
+    def _handle_kv_ship(self, reply, meta, buffers, tenant) -> None:
+        """Connection handler side of KV_SHIP (protocol v6,
+        docs/wire-format.md): ingest a prefill tier's finished KV pages
+        into the engine's paged pool — deduped per block against the
+        prefix registry — then stream the generation exactly like
+        GENERATE.  The pages arrive inline (two [L, n, n_kv, bs, D]
+        frame buffers) or as ``kv_bufs`` naming ephemeral quiet PUTs
+        the client pipelined through its upload stream."""
+        import numpy as np
+
+        if self.engine is None:
+            reply("ERROR", {"error": "no serving engine attached to "
+                                     "this worker"}, [])
+            return
+        if meta.get("_wire_version", 2) < protocol.KV_SHIP_MIN_VERSION:
+            # like the q8 frame gate: the feature must be negotiated,
+            # never smuggled to a peer that did not ask for v6
+            reply("ERROR", {"error": "KV_SHIP needs protocol >= "
+                                     f"{protocol.KV_SHIP_MIN_VERSION} "
+                                     "(negotiate v6 at HELLO)"}, [])
+            return
         try:
-            self.engine.submit(prompt, max_tokens,
-                               tenant=tenant.conn_id, qos=tenant.qos,
-                               eos_id=eos_id, deadline_ms=deadline_ms,
-                               emit=emit,
-                               trace=self._parse_trace(meta))
+            prompt = [int(t) for t in meta.get("prompt") or []]
+            max_tokens = int(meta.get("max_tokens", 1) or 1)
+            eos_id = meta.get("eos_id")
+            eos_id = int(eos_id) if eos_id is not None else None
+            deadline_ms = meta.get("deadline_ms")
+            if deadline_ms is not None:
+                deadline_ms = float(deadline_ms)
+            keys = [int(x) for x in meta.get("keys") or []]
+            n_tokens = int(meta.get("n_tokens", len(prompt)))
+            if meta.get("first_token") is None:
+                # the prefill tier's last chunk always yields one; a
+                # shipped sequence with no seed token could never
+                # decode
+                raise ValueError("KV_SHIP without first_token")
+            first = int(meta["first_token"])
+            kv_bufs = meta.get("kv_bufs")
+            if kv_bufs is not None:
+                k = np.asarray(self._take_shard(str(kv_bufs[0])))
+                v = np.asarray(self._take_shard(str(kv_bufs[1])))
+            elif len(buffers) >= 2:
+                k, v = np.asarray(buffers[0]), np.asarray(buffers[1])
+            else:
+                k = v = None        # metadata-only ship (dedup probe)
+            if k is not None and (k.ndim != 5 or k.shape != v.shape or
+                                  k.shape[1] != len(keys)):
+                raise ValueError(
+                    f"KV pages {getattr(k, 'shape', None)} disagree "
+                    f"with {len(keys)} shipped keys")
+        except (TypeError, ValueError, KeyError) as e:
+            reply("ERROR", {"error": f"bad KV_SHIP request: {e}"}, [])
+            return
+        stream = bool(meta.get("stream", True))
+        emit = self._generate_emit(reply, stream)
+        payload = {"keys": keys, "k": k, "v": v,
+                   "first_token": first, "n_tokens": n_tokens,
+                   "bytes": int(k.nbytes + v.nbytes)
+                   if k is not None else 0}
+        try:
+            self.engine.submit_shipped(
+                prompt, max_tokens, payload, tenant=tenant.conn_id,
+                qos=tenant.qos, eos_id=eos_id, deadline_ms=deadline_ms,
+                emit=emit, trace=self._parse_trace(meta))
         except BusyError as e:
             reply("ERROR", {"error": str(e), "code": "BUSY",
                             "retry_after_ms": e.retry_after_ms}, [])
+            return
         except ValueError as e:
             reply("ERROR", {"error": str(e)}, [])
+            return
+        reply("KV_SHIP_OK", {"blocks": len(keys),
+                             "n_tokens": n_tokens}, [])
 
     @staticmethod
     def _parse_trace(meta) -> Optional[dict]:

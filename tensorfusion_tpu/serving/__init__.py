@@ -1,26 +1,41 @@
 """tpfserve — continuous-batching serving engine over a paged KV pool.
 
-- :mod:`.kvpool` — block accounting + paged attention (the paged
-  variant of ``llama._attention_decode`` / chunked prefill).
+- :mod:`.kvpool` — refcounted block accounting with copy-on-write
+  prefix sharing + paged attention (the paged variant of
+  ``llama._attention_decode``, chunked prefill, and the fused
+  speculative-verify step).
 - :mod:`.engine` — decode-step-granularity continuous batching with
-  QoS admission, deadline shedding and pool preemption.
+  QoS admission, deadline shedding, pool preemption, prefix-shared KV
+  and speculative decoding.
 - :mod:`.runner` — the device contract: :class:`~.runner.LlamaRunner`
   (real jax) and :class:`~.runner.FakeRunner` (deterministic, for the
   digital twin and unit tests).
+- :mod:`.spec` — draft models for speculative decoding (prompt-lookup
+  n-gram, dialable arithmetic, small llama).
+- :mod:`.disagg` — the disaggregated prefill pool; finished KV pages
+  ship to the decode engine locally or over the v6 ``KV_SHIP`` wire.
 
 Architecture and knobs: docs/serving.md.
 """
 
+from .disagg import PrefillPool  # noqa: F401
 from .engine import (DEFAULT_MAX_BATCH, DEFAULT_MAX_WAITING,  # noqa: F401
                      DEFAULT_PREFILL_CHUNK, Sequence, ServingEngine)
-from .kvpool import (BlockAccount, contiguous_to_paged,  # noqa: F401
-                     init_paged_cache, paged_cache_nbytes,
-                     paged_decode_step, paged_prefill_chunk, pow2_bucket)
+from .kvpool import (BlockAccount, chain_key,  # noqa: F401
+                     contiguous_to_paged, init_paged_cache,
+                     paged_cache_nbytes, paged_decode_step,
+                     paged_prefill_chunk, paged_verify_step,
+                     pow2_bucket, prompt_block_keys)
 from .runner import FakeRunner, LlamaRunner  # noqa: F401
+from .spec import (ArithmeticDraft, LlamaDraft,  # noqa: F401
+                   NGramDraft, make_draft)
 
 __all__ = ["ServingEngine", "Sequence", "BlockAccount", "LlamaRunner",
-           "FakeRunner", "init_paged_cache", "paged_decode_step",
-           "paged_prefill_chunk", "contiguous_to_paged",
-           "paged_cache_nbytes", "pow2_bucket",
+           "FakeRunner", "PrefillPool", "NGramDraft",
+           "ArithmeticDraft", "LlamaDraft", "make_draft",
+           "init_paged_cache", "paged_decode_step",
+           "paged_prefill_chunk", "paged_verify_step",
+           "contiguous_to_paged", "paged_cache_nbytes", "pow2_bucket",
+           "chain_key", "prompt_block_keys",
            "DEFAULT_MAX_BATCH", "DEFAULT_MAX_WAITING",
            "DEFAULT_PREFILL_CHUNK"]

@@ -34,8 +34,32 @@ digital twin's ``serving-burst-storm`` scenario steps the engine under
 ``SimClock`` with a :class:`~.runner.FakeRunner`; same-seed runs are
 bit-identical).  Token/done callbacks fire outside every lock.
 
+Three throughput multipliers ride the same step loop (ROADMAP item 4,
+docs/serving.md):
+
+- **copy-on-write prefix sharing** — prompts are content-hashed per KV
+  block (:func:`~.kvpool.prompt_block_keys`); admission adopts the
+  longest registered chain so tenants sharing a system prompt map
+  their block tables onto ONE physical copy, and every write goes
+  through :meth:`~.kvpool.BlockAccount.writable`, which copies shared
+  blocks on write.  ``prefix_sharing=False`` restores private tables
+  (the bench baseline).
+- **disaggregated prefill/decode** — with a ``prefill_pool``
+  (:class:`~.disagg.PrefillPool`), admitted prompts are chunk-
+  prefilled on the pool's designated workers instead of stealing this
+  engine's step budget; finished pages ship back (locally, or over
+  the protocol-v6 ``KV_SHIP`` opcode) and are deduped against the
+  decode-side hash registry at ingest.
+- **speculative decoding** — a ``draft`` model proposes up to
+  ``spec_k`` tokens per sequence, verified in ONE fused target step
+  (:meth:`runner.verify`) with greedy-exact accept/reject: the target
+  token at the first mismatch replaces the rejected draft, so output
+  tokens are identical to non-speculative decode; rejected positions
+  roll the block table back via :meth:`~.kvpool.BlockAccount.truncate`.
+
 Observability: ``serving.admit`` / ``serving.prefill_chunk`` /
-``serving.step`` spans for traced sequences (SPAN_SCHEMA,
+``serving.step`` / ``serving.prefix_match`` / ``serving.kv_ship`` /
+``serving.spec_verify`` spans for traced sequences (SPAN_SCHEMA,
 docs/tracing.md), and a :meth:`snapshot` the worker's INFO reply and
 the ``tpf_serving_*`` metrics lines are built from
 (``hypervisor/metrics.py:serving_engine_lines``, docs/metrics-schema).
@@ -45,12 +69,12 @@ from __future__ import annotations
 
 import itertools
 import threading
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import constants
 from ..clock import Clock, default_clock
 from ..remoting.dispatch import BusyError, LatencyRecorder, qos_weight
-from .kvpool import BlockAccount
+from .kvpool import BlockAccount, prompt_block_keys
 
 #: how many sequences may wait for admission before submit() pushes
 #: back with BUSY — deep enough for a burst, shallow enough that queue
@@ -81,7 +105,8 @@ class Sequence:
                  "max_new_tokens", "eos_id", "emit", "trace",
                  "trace_spans", "arrival_m", "deadline_m", "admitted_m",
                  "ttft_ms", "state", "prefill_pos", "tokens", "emitted",
-                 "finish_reason", "preemptions")
+                 "finish_reason", "preemptions", "block_keys",
+                 "prefix_matched", "disagg", "shipped", "spec_skip")
 
     def __init__(self, sid: int, tenant: str, qos: str,
                  prompt: List[int], max_new_tokens: int,
@@ -116,6 +141,18 @@ class Sequence:
         self.emitted = 0
         self.finish_reason = ""
         self.preemptions = 0
+        #: per-block content keys of the prompt (lazy, prefix sharing)
+        self.block_keys: Optional[List[Tuple[int, int]]] = None
+        #: prompt tokens the block registry served at last admission
+        self.prefix_matched = 0
+        #: prefill runs on the disaggregated pool, not the step budget
+        self.disagg = False
+        #: pre-prefilled KV payload awaiting ingest (KV_SHIP / pool)
+        self.shipped: Optional[dict] = None
+        #: draft cooldown: after a round where EVERY proposal was
+        #: rejected, skip speculating this sequence for one round (the
+        #: draft is out of phase — don't burn verify width on it)
+        self.spec_skip = False
 
     def context(self) -> List[int]:
         """The full prefix to (re)prefill: prompt + generated so far."""
@@ -127,7 +164,8 @@ class Sequence:
 
 class _TenantStats:
     __slots__ = ("qos", "tokens", "ttft", "slo_good", "slo_total",
-                 "last_trace_id")
+                 "last_trace_id", "prefix_hit_tokens", "spec_proposed",
+                 "spec_accepted")
 
     def __init__(self, qos: str):
         self.qos = qos
@@ -136,6 +174,9 @@ class _TenantStats:
         self.slo_good = 0
         self.slo_total = 0
         self.last_trace_id = ""
+        self.prefix_hit_tokens = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
 
 
 class ServingEngine:
@@ -144,9 +185,31 @@ class ServingEngine:
                  max_batch: int = DEFAULT_MAX_BATCH,
                  prefill_chunk_tokens: int = DEFAULT_PREFILL_CHUNK,
                  max_waiting: int = DEFAULT_MAX_WAITING,
-                 profiler=None, recorder=None):
+                 profiler=None, recorder=None,
+                 prefix_sharing: bool = True,
+                 draft=None, spec_k: int = 0,
+                 prefill_pool=None,
+                 disagg_min_tokens: int = 0):
         self.runner = runner
         self.clock = clock or default_clock()
+        #: copy-on-write prefix sharing over the paged pool (the
+        #: no-sharing baseline cells pass False)
+        self.prefix_sharing = bool(prefix_sharing)
+        #: speculative decoding: ``draft.propose(context, k)`` proposes
+        #: up to ``spec_k`` tokens per sequence per step, verified in
+        #: one fused target step with greedy-exact accept/reject
+        self.draft = draft
+        self.spec_k = max(0, int(spec_k)) if draft is not None else 0
+        #: disaggregated prefill pool (serving/disagg.py): admitted
+        #: prompts prefill on designated workers and ship pages back
+        self.prefill_pool = prefill_pool
+        #: prompts below this length prefill inline even with a pool —
+        #: a short prompt costs less than a ship, and routing it to the
+        #: pool would queue it behind the very long-prompt storms the
+        #: pool exists to absorb (docs/serving-tuning.md)
+        self.disagg_min_tokens = max(0, int(disagg_min_tokens))
+        if prefill_pool is not None:
+            prefill_pool.attach(self._on_pool_ready)
         #: span recorder (None disables tracing; only sequences that
         #: CARRY a sampled context record spans, so untraced serving
         #: pays nothing — same contract as the dispatcher)
@@ -207,6 +270,26 @@ class ServingEngine:
         self._tenants: Dict[str, _TenantStats] = {}
         # guarded by: _cv
         self._last_trace_id = ""
+        #: pre-prefilled sequences awaiting KV ingest on the stepper
+        #: (pool completions land here from the pool thread)
+        # guarded by: _cv
+        self._shipped_ready: List[Sequence] = []
+        # -- spec-decode counters (stepper writes, snapshot reads) ------
+        # guarded by: _cv
+        self.spec_steps = 0
+        # guarded by: _cv
+        self.spec_proposed = 0
+        # guarded by: _cv
+        self.spec_accepted = 0
+        # -- KV_SHIP ingest counters ------------------------------------
+        # guarded by: _cv
+        self.kv_ships = 0
+        # guarded by: _cv
+        self.kv_ship_blocks = 0
+        # guarded by: _cv
+        self.kv_ship_dedup_blocks = 0
+        # guarded by: _cv
+        self.kv_ship_bytes = 0
         #: step-duration reservoir -> the retry_after_ms drain estimate
         self.step_time = LatencyRecorder(maxlen=512)
         self.ttft = LatencyRecorder(maxlen=2048)
@@ -218,7 +301,8 @@ class ServingEngine:
                eos_id: Optional[int] = None,
                deadline_ms: Optional[float] = None,
                emit: Optional[Callable] = None,
-               trace: Optional[dict] = None) -> Sequence:
+               trace: Optional[dict] = None,
+               _shipped: Optional[dict] = None) -> Sequence:
         """Enqueue one generation request.  Raises
         :class:`~..remoting.dispatch.BusyError` when the waiting queue
         is full (the worker maps it to the protocol ``BUSY`` code) and
@@ -241,6 +325,8 @@ class ServingEngine:
                        qos or constants.DEFAULT_QOS, prompt,
                        max_new_tokens, eos_id, emit, trace, now,
                        deadline_m)
+        if _shipped is not None:
+            seq.shipped = dict(_shipped)
         with self._cv:
             if self._stopping:
                 raise ConnectionError("engine stopping")
@@ -263,6 +349,130 @@ class ServingEngine:
     def retry_after_ms(self) -> int:
         with self._cv:
             return self._retry_after_ms_locked()
+
+    def submit_shipped(self, prompt: List[int], max_new_tokens: int,
+                       payload: dict, tenant: str = "local",
+                       qos: Optional[str] = None,
+                       eos_id: Optional[int] = None,
+                       deadline_ms: Optional[float] = None,
+                       emit: Optional[Callable] = None,
+                       trace: Optional[dict] = None) -> Sequence:
+        """Enqueue a PRE-PREFILLED generation: the prompt's KV pages
+        arrived with the request (the protocol-v6 ``KV_SHIP`` path —
+        a prefill-tier worker computed them, docs/serving.md).
+        ``payload``: ``{"keys": [per-block content keys], "k"/"v":
+        [L, n, n_kv, bs, D] host arrays or None, "first_token",
+        "n_tokens", "bytes"}``.  Admission (QoS ladder, BUSY
+        backpressure, deadline shedding) is exactly :meth:`submit`'s;
+        the pages are ingested — deduped against the prefix registry —
+        instead of prefilled."""
+        return self.submit(prompt, max_new_tokens, tenant=tenant,
+                           qos=qos, eos_id=eos_id,
+                           deadline_ms=deadline_ms, emit=emit,
+                           trace=trace, _shipped=payload)
+
+    def _on_pool_ready(self, seq: Sequence,
+                       payload: Optional[dict]) -> None:
+        """Prefill-pool completion (pool thread or inline pump): park
+        the payload for the stepper to ingest.  ``payload=None`` means
+        the pool could not hold the prompt — the sequence falls back
+        to inline prefill on this engine's chunk budget."""
+        seq.shipped = dict(payload) if payload is not None \
+            else {"failed": True}
+        with self._cv:
+            self._shipped_ready.append(seq)
+            self._cv.notify_all()
+
+    def _ingest_shipped(self, events: List[tuple],
+                        now: float) -> bool:
+        """Write ONE parked shipped payload into the decode pool per
+        step: blocks whose content key is already registered are
+        ADOPTED (the shared prefix is counted once — the dedup the ≥5x
+        prefix cell asserts), the rest allocate fresh blocks and take
+        the shipped pages.  One ingest per step bounds how much page-
+        writing a storm of simultaneous ships can inject between two
+        decode steps — the decode-p99-stays-flat half of the disagg
+        contract."""
+        seq = None
+        with self._cv:
+            if self._shipped_ready:
+                seq = self._shipped_ready.pop(0)
+        if seq is None:
+            return False
+        if seq.state != PREFILL or seq not in self._running:
+            return True         # preempted/retired while shipping
+        self._activate_shipped(seq, events, now)
+        return True
+
+    def _activate_shipped(self, seq: Sequence, events: List[tuple],
+                          now: float) -> bool:
+        payload = seq.shipped
+        if payload.get("failed"):
+            # pool could not hold the prompt: fall back to this
+            # engine's inline chunked prefill (allocate its table the
+            # way admission would have)
+            seq.shipped = None
+            seq.disagg = False
+            seq.prefill_pos = 0
+            if not self.account.ensure(seq.sid,
+                                       seq.context_len() + 1):
+                self._preempt(seq)
+            return True
+        keys = payload.get("keys") or []
+        n_tokens = int(payload["n_tokens"])
+        write_ids: List[int] = []
+        write_idx: List[int] = []
+        dedup = 0
+        ok = True
+        for i, key in enumerate(keys):
+            blk = (self.account.adopt_block(seq.sid, key)
+                   if self.prefix_sharing and key else None)
+            if blk is not None:
+                dedup += 1
+                continue
+            blk = self.account.append_block(seq.sid)
+            if blk is None:
+                ok = False
+                break
+            write_ids.append(blk)
+            write_idx.append(i)
+        if not ok:
+            # pool exhausted mid-ingest: put the sequence back in the
+            # waiting queue with its payload intact and retry when the
+            # pool breathes (all-or-nothing, like ensure)
+            self._preempt(seq)
+            return False
+        if write_ids and payload.get("k") is not None:
+            self.runner.write_blocks(
+                write_ids,
+                payload["k"][:, write_idx],
+                payload["v"][:, write_idx])
+        if self.prefix_sharing:
+            for i, key in enumerate(keys):
+                if key:
+                    self.account.publish(seq.sid, i, key)
+        seq.prefill_pos = n_tokens
+        seq.shipped = None
+        seq.state = ACTIVE
+        nbytes = int(payload.get("bytes") or 0)
+        with self._cv:
+            self.kv_ships += 1
+            self.kv_ship_blocks += len(write_ids)
+            self.kv_ship_dedup_blocks += dedup
+            self.kv_ship_bytes += nbytes
+        self._ship_span(seq, now, len(write_ids), dedup, nbytes)
+        first = payload.get("first_token")
+        if not seq.tokens and first is not None:
+            ttft_s = self.clock.monotonic() - seq.arrival_m
+            seq.ttft_ms = round(ttft_s * 1e3, 3)
+            self.ttft.observe(ttft_s)
+            with self._cv:
+                st = self._tenants.setdefault(seq.tenant,
+                                              _TenantStats(seq.qos))
+            st.ttft.observe(ttft_s)
+            seq.tokens.append(int(first))
+            self._maybe_finish(seq, events)
+        return True
 
     # -- engine thread --------------------------------------------------
 
@@ -308,13 +518,21 @@ class ServingEngine:
         shed, admitted_seqs = self._admit_locked_phase(now, events)
         did = bool(shed or admitted_seqs)
 
-        # -- prefill chunks (interleaved with decode) ---------------------
+        # -- disaggregated prefill: pump the pool (inline pools advance
+        # one chunk per engine step — deterministic under SimClock) and
+        # ingest finished KV payloads into the decode pool ----------------
+        if self.prefill_pool is not None:
+            did = self.prefill_pool.pump() or did
+        did = self._ingest_shipped(events, now) or did
+
+        # -- prefill chunks (interleaved with decode; disaggregated
+        # sequences prefill on the pool, never against this budget) -------
         budget = self.prefill_chunk_tokens
         chunks = 0
         for seq in list(self._running):
             if budget <= 0:
                 break
-            if seq.state != PREFILL:
+            if seq.state != PREFILL or seq.disagg:
                 continue
             chunks += 1
             budget -= self._prefill_chunk(seq, events)
@@ -323,10 +541,14 @@ class ServingEngine:
         # -- one fused decode step ----------------------------------------
         batch = [s for s in self._running if s.state == ACTIVE]
         decoded = 0
+        spec = self.draft is not None and self.spec_k > 0
         if batch:
             did = True
-            batch = self._grow_or_preempt(batch, events)
-        if batch:
+            batch = self._grow_or_preempt(
+                batch, events, extra=self.spec_k if spec else 0)
+        if batch and spec:
+            decoded = self._spec_decode(batch, events)
+        elif batch:
             t0 = self.clock.monotonic()
             tokens = [s.tokens[-1] for s in batch]
             positions = [s.context_len() - 1 for s in batch]
@@ -428,11 +650,36 @@ class ServingEngine:
             for seq in list(self._waiting):
                 if len(self._running) + len(admitted) >= self.max_batch:
                     break
-                # room for the whole prompt plus the first generated
-                # token; growth past that is preemption's problem
-                if not self.account.can_fit(seq.context_len() + 1):
-                    continue
-                self.account.ensure(seq.sid, seq.context_len() + 1)
+                if seq.shipped is not None or (
+                        self.prefill_pool is not None and
+                        seq.context_len() >= self.disagg_min_tokens):
+                    # disaggregated path: blocks materialize at KV
+                    # ingest (deduped against the registry there), so
+                    # admission only soft-checks headroom
+                    if not self.account.can_fit(seq.context_len() + 1):
+                        continue
+                    seq.disagg = True
+                    seq.prefix_matched = 0
+                else:
+                    # room for the whole prompt plus the first
+                    # generated token, minus whatever the prefix
+                    # registry already holds; growth past that is
+                    # preemption's problem
+                    mblocks, mtokens = (
+                        self.account.peek_match(self._seq_keys(seq))
+                        if self.prefix_sharing else (0, 0))
+                    need = self.account.blocks_for(
+                        seq.context_len() + 1) - mblocks
+                    if need > self.account.free_blocks:
+                        continue
+                    if mblocks:
+                        mtokens = self.account.adopt(
+                            seq.sid, self._seq_keys(seq))
+                    seq.prefix_matched = mtokens
+                    # a preempted disagg/shipped sequence re-prefills
+                    # inline when no pool serves this engine
+                    seq.disagg = False
+                    self.account.ensure(seq.sid, seq.context_len() + 1)
                 self._waiting.remove(seq)
                 admitted.append(seq)
             for seq in admitted:
@@ -445,6 +692,7 @@ class ServingEngine:
                 st.slo_total += 1
                 if wait_ms <= slo_ms:
                     st.slo_good += 1
+                st.prefix_hit_tokens += seq.prefix_matched
             for seq in shed:
                 st = self._tenants.setdefault(seq.tenant,
                                               _TenantStats(seq.qos))
@@ -462,8 +710,23 @@ class ServingEngine:
         for seq in admitted:
             seq.state = PREFILL
             seq.admitted_m = now
+            # a full-prompt registry hit still recomputes the last
+            # position (its logits seed the first token); the rewrite
+            # CoWs the shared tail block
+            seq.prefill_pos = min(seq.prefix_matched,
+                                  seq.context_len() - 1)
             self._running.append(seq)
             self._admit_span(seq, now)
+            if seq.prefix_matched:
+                self._prefix_span(seq, now)
+            if seq.disagg:
+                if seq.shipped is not None:
+                    # KV arrived with the request (wire KV_SHIP):
+                    # ingest on this very step
+                    with self._cv:
+                        self._shipped_ready.append(seq)
+                else:
+                    self.prefill_pool.submit(seq, seq.context())
             if self.profiler is not None:
                 self.profiler.attribute(seq.tenant, "queue",
                                         now - seq.arrival_m,
@@ -477,17 +740,65 @@ class ServingEngine:
                                         qos=seq.qos, end_m=now)
         return shed, admitted
 
+    def _seq_keys(self, seq: Sequence) -> List[Tuple[int, int]]:
+        """Per-block content keys of the sequence's PROMPT (generated
+        tokens are never matched at admission — the registry serves
+        shared system prompts, not shared continuations)."""
+        if seq.block_keys is None:
+            seq.block_keys = prompt_block_keys(seq.prompt,
+                                               self.account.block_size)
+        return seq.block_keys
+
+    def _secure_writes(self, seq: Sequence, lo_pos: int,
+                       hi_pos: int) -> Optional[List[Tuple[int, int]]]:
+        """Make every block covering positions ``[lo_pos, hi_pos]``
+        writable for ``seq`` (copy-on-write where shared).  Returns the
+        ``(src, dst)`` page copies the runner must perform before the
+        write, or None when a CoW copy could not be allocated."""
+        bs = self.account.block_size
+        pairs: List[Tuple[int, int]] = []
+        for bi in range(lo_pos // bs, hi_pos // bs + 1):
+            w = self.account.writable(seq.sid, bi)
+            if w is None:
+                return None
+            blk, src = w
+            if src is not None:
+                pairs.append((src, blk))
+        return pairs
+
+    def _publish_prompt_blocks(self, seq: Sequence,
+                               new_pos: int) -> None:
+        """Register every prompt block whose content is now fully
+        prefilled (first-come wins; adopted/CoW-source blocks are
+        already registered and no-op)."""
+        if not self.prefix_sharing:
+            return
+        for bi, (key, covered) in enumerate(self._seq_keys(seq)):
+            if covered > new_pos:
+                break
+            self.account.publish(seq.sid, bi, key)
+
     def _prefill_chunk(self, seq: Sequence, events: List[tuple]) -> int:
         """Advance one sequence's prefill by one chunk; on completion
         the first generated token appears (TTFT)."""
         ctx = seq.context()
         chunk = min(self.prefill_chunk_tokens,
                     len(ctx) - seq.prefill_pos)
+        pairs = self._secure_writes(seq, seq.prefill_pos,
+                                    seq.prefill_pos + chunk - 1)
+        if pairs is None:
+            # the CoW copy this chunk needs cannot be allocated: yield
+            # this sequence's pages and retry when the pool breathes
+            self._preempt(seq)
+            return 0
+        if pairs:
+            self.runner.copy_blocks(pairs)
         last = seq.prefill_pos + chunk >= len(ctx)
         t0 = self.clock.monotonic()
         nxt = self.runner.prefill(
             ctx[seq.prefill_pos:seq.prefill_pos + chunk],
             self.account.table(seq.sid), seq.prefill_pos, last=last)
+        self._publish_prompt_blocks(seq, seq.prefill_pos + chunk)
         self._prefill_span(seq, t0, chunk)
         if self.profiler is not None:
             self.profiler.attribute(seq.tenant, "compute",
@@ -515,20 +826,121 @@ class ServingEngine:
             # emit stream strictly ordered
         return chunk
 
+    def _spec_decode(self, batch: List[Sequence],
+                     events: List[tuple]) -> int:
+        """One speculative round: the draft proposes up to ``spec_k``
+        tokens per sequence, ONE fused target verify step scores every
+        proposal, and greedy-exact accept/reject appends the longest
+        agreeing prefix plus the target's own token at the first
+        mismatch — so the emitted stream is identical to plain greedy
+        decode whatever the draft does.  Rejected positions roll the
+        block table back (:meth:`~.kvpool.BlockAccount.truncate`);
+        their stale KV is overwritten by the step that next reaches
+        those positions, and the ``index <= pos`` mask hides it until
+        then."""
+        k = self.spec_k
+        td = self.clock.monotonic()
+        proposals = []
+        for s in batch:
+            if s.spec_skip:
+                s.spec_skip = False
+                proposals.append([])
+                continue
+            proposals.append(
+                [int(t) for t in (self.draft.propose(s.context(), k)
+                                  or ())][:k])
+        draft_dur = self.clock.monotonic() - td
+        if self.profiler is not None:
+            # draft compute belongs to the tenant being served — there
+            # is no phantom "draft" tenant in the attribution ledger
+            for s in batch:
+                self.profiler.attribute(s.tenant, "compute",
+                                        draft_dur / len(batch),
+                                        qos=s.qos)
+        width = max(len(p) for p in proposals) + 1
+        t0 = self.clock.monotonic()
+        if width == 1:
+            # draft had nothing anywhere this round: plain fused decode
+            outs = [[int(t)] for t in self.runner.decode(
+                [s.tokens[-1] for s in batch],
+                [s.context_len() - 1 for s in batch],
+                [self.account.table(s.sid) for s in batch])]
+        else:
+            # ONE fused verify launch for the whole batch; rows with
+            # fewer (or cooled-down) proposals pad to the width — a
+            # verify row costs barely more than a decode row, so one
+            # launch beats splitting the batch across two
+            rows = [[s.tokens[-1]] + p + [0] * (width - 1 - len(p))
+                    for s, p in zip(batch, proposals)]
+            outs = self.runner.verify(
+                rows, [s.context_len() - 1 for s in batch],
+                [self.account.table(s.sid) for s in batch])
+        dur = self.clock.monotonic() - t0
+        if self.profiler is not None:
+            for s in batch:
+                self.profiler.attribute(s.tenant, "compute",
+                                        dur / len(batch), qos=s.qos)
+        proposed_round = 0
+        accepted_round = 0
+        for seq, prop, out in zip(batch, proposals, outs):
+            j = 0
+            while j < len(prop) and out[j] == prop[j]:
+                j += 1
+            seq.spec_skip = bool(prop) and j == 0
+            acc = [int(t) for t in out[:j + 1]]
+            # plain greedy would have stopped at EOS / max_new_tokens:
+            # trim the speculative surplus so the stream stays EXACT
+            if seq.eos_id is not None and seq.eos_id in acc:
+                acc = acc[:acc.index(seq.eos_id) + 1]
+            acc = acc[:seq.max_new_tokens - len(seq.tokens)]
+            seq.tokens.extend(acc)
+            self._spec_span(seq, t0, dur, len(prop), j, len(batch))
+            proposed_round += len(prop)
+            accepted_round += j
+            with self._cv:
+                st = self._tenants.setdefault(seq.tenant,
+                                              _TenantStats(seq.qos))
+                st.spec_proposed += len(prop)
+                st.spec_accepted += j
+            # rejected speculative positions: roll the block-table
+            # high-water mark back to the accepted context
+            self.account.truncate(seq.sid, seq.context_len())
+            self._maybe_finish(seq, events)
+        with self._cv:
+            self.spec_steps += 1
+            self.spec_proposed += proposed_round
+            self.spec_accepted += accepted_round
+        return len(batch)
+
     def _grow_or_preempt(self, batch: List[Sequence],
-                         events: List[tuple]) -> List[Sequence]:
-        """Every batch member needs pages for its next token; when the
+                         events: List[tuple],
+                         extra: int = 0) -> List[Sequence]:
+        """Every batch member needs pages for its next token (plus
+        ``extra`` speculative positions) AND write access to the blocks
+        those positions land in (copy-on-write when shared); when the
         pool is exhausted, the lowest-weight most-recent member is
         evicted back to the waiting queue until the rest fit.  Members
         are secured highest weight first, so victims always come from
         the lower tiers — the QoS promise under memory pressure."""
         kept: List[Sequence] = []
+        cow: List[Tuple[int, int]] = []
         for seq in sorted(batch, key=lambda s: (-s.weight, s.arrival_m,
                                                 s.sid)):
             if seq.state != ACTIVE:
                 continue            # already evicted as a victim below
-            while seq.state == ACTIVE and not self.account.ensure(
-                    seq.sid, seq.context_len() + 1):
+            while seq.state == ACTIVE:
+                need = seq.context_len() + (extra if extra
+                                            else 1)
+                pairs = None
+                if self.account.ensure(seq.sid, need):
+                    # writes land at context-1 .. context-1+extra
+                    pairs = self._secure_writes(
+                        seq, seq.context_len() - 1,
+                        seq.context_len() - 1 + extra)
+                if pairs is not None:
+                    cow.extend(pairs)
+                    kept.append(seq)
+                    break
                 victims = [s for s in batch
                            if s is not seq and s.state == ACTIVE
                            and s not in kept]
@@ -542,8 +954,8 @@ class ServingEngine:
                 self._preempt(min(victims,
                                   key=lambda s: (s.weight, -s.arrival_m,
                                                  -s.sid)))
-            if seq.state == ACTIVE:
-                kept.append(seq)
+        if cow:
+            self.runner.copy_blocks(cow)
         # original batch order keeps the fused step deterministic
         return [s for s in batch if s in kept]
 
@@ -624,6 +1036,49 @@ class ServingEngine:
             if d is not None:
                 seq.trace_spans.append(d)
 
+    def _prefix_span(self, seq: Sequence, now: float) -> None:
+        """serving.prefix_match: prompt tokens the block registry
+        served at admission (zero-cost prefill)."""
+        if self.tracer is None or not seq.trace:
+            return
+        end = self.tracer.clock.now()
+        d = self.tracer.record_span(
+            "serving.prefix_match", end, end, parent=seq.trace,
+            attrs={"tenant": seq.tenant,
+                   "matched_tokens": seq.prefix_matched,
+                   "prompt_tokens": len(seq.prompt)})
+        if d is not None:
+            seq.trace_spans.append(d)
+
+    def _ship_span(self, seq: Sequence, t0: float, blocks: int,
+                   shared: int, nbytes: int) -> None:
+        """serving.kv_ship: one shipped-KV ingest — fresh pages written
+        vs blocks deduped onto the registry."""
+        if self.tracer is None or not seq.trace:
+            return
+        end = self.tracer.clock.now()
+        dur = self.clock.monotonic() - t0
+        d = self.tracer.record_span(
+            "serving.kv_ship", end - dur, end, parent=seq.trace,
+            attrs={"tenant": seq.tenant, "blocks": blocks,
+                   "shared": shared, "bytes": nbytes})
+        if d is not None:
+            seq.trace_spans.append(d)
+
+    def _spec_span(self, seq: Sequence, t0: float, dur: float,
+                   proposed: int, accepted: int, batch: int) -> None:
+        """serving.spec_verify: one fused verify launch, recorded
+        against every traced member like serving.step."""
+        if self.tracer is None or not seq.trace:
+            return
+        end = self.tracer.clock.now()
+        d = self.tracer.record_span(
+            "serving.spec_verify", end - dur, end, parent=seq.trace,
+            attrs={"batch": batch, "k": proposed,
+                   "accepted": accepted})
+        if d is not None:
+            seq.trace_spans.append(d)
+
     # -- observability ----------------------------------------------------
 
     def snapshot(self) -> dict:
@@ -640,8 +1095,29 @@ class ServingEngine:
                        "slo_total": st.slo_total,
                        "slo_ms": constants.QOS_QUEUE_WAIT_SLO_MS.get(
                            st.qos, 500.0),
+                       "prefix_hit_tokens": st.prefix_hit_tokens,
+                       "spec_proposed": st.spec_proposed,
+                       "spec_accepted": st.spec_accepted,
+                       "spec_accept_rate": round(
+                           st.spec_accepted / st.spec_proposed, 6)
+                       if st.spec_proposed else 0.0,
                        "last_trace_id": st.last_trace_id}
                 for name, st in self._tenants.items()}
+            spec = {
+                "k": self.spec_k,
+                "steps": self.spec_steps,
+                "proposed": self.spec_proposed,
+                "accepted": self.spec_accepted,
+                "accept_rate": round(
+                    self.spec_accepted / self.spec_proposed, 6)
+                if self.spec_proposed else 0.0,
+            }
+            ship = {
+                "ships": self.kv_ships,
+                "blocks": self.kv_ship_blocks,
+                "dedup_blocks": self.kv_ship_dedup_blocks,
+                "bytes": self.kv_ship_bytes,
+            }
             return {
                 "name": self.name,
                 "max_batch": self.max_batch,
@@ -663,6 +1139,10 @@ class ServingEngine:
                 "batch_occupancy_pct": round(occupancy, 3),
                 "ttft": self.ttft.snapshot(),
                 "kv": acct,
+                "prefix_sharing": self.prefix_sharing,
+                "spec": spec,
+                "kv_ship": ship,
+                "disagg": self.prefill_pool is not None,
                 "last_trace_id": self._last_trace_id,
                 "tenants": tenants,
             }

@@ -36,16 +36,52 @@ that committed budget per pass).
 
 from __future__ import annotations
 
+import hashlib
 import math
-from typing import Dict, List, Optional
+import struct
+from typing import Dict, List, Optional, Sequence, Tuple
 
 #: block ids below this are scratch (padded batch rows scatter here);
 #: never handed to a sequence
 RESERVED_BLOCKS = 1
 
+#: chain-hash root: the content key of "no blocks yet"
+ROOT_KEY = 0
+
+
+def chain_key(parent: int, tokens: Sequence[int]) -> int:
+    """Content identity of one KV block: the chain hash of its parent
+    block's key and the token ids cached in it.  Two sequences produce
+    the same key for block ``i`` iff their token prefixes agree through
+    that block — and greedy KV is a pure function of (params, token
+    prefix, positions), so equal keys mean byte-equal pages.  Stable
+    across processes/runs (blake2b, not ``hash()``): the sim digest and
+    the KV_SHIP wire both carry these keys."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(struct.pack("<Q", parent))
+    h.update(b"".join(struct.pack("<i", int(t)) for t in tokens))
+    return int.from_bytes(h.digest(), "little") or 1
+
+
+def prompt_block_keys(prompt: Sequence[int], block_size: int
+                      ) -> List[Tuple[int, int]]:
+    """The shareable content keys of a prompt: one per FULL block plus,
+    when the prompt does not end on a block boundary, one for the
+    partial tail (its key covers exactly the prompt tokens in it).
+    Returns ``[(key, n_tokens_covered_through_this_block), ...]``."""
+    keys: List[Tuple[int, int]] = []
+    parent = ROOT_KEY
+    n = len(prompt)
+    for lo in range(0, n, block_size):
+        hi = min(lo + block_size, n)
+        parent = chain_key(parent, prompt[lo:hi])
+        keys.append((parent, hi))
+    return keys
+
 
 class BlockAccount:
-    """Free-list allocator + per-owner block tables for the paged pool.
+    """Refcounted free-list allocator + per-owner block tables for the
+    paged pool, with copy-on-write prefix sharing.
 
     Storage-free bookkeeping: the engine asks *admission* questions
     (``can_fit``), grows tables token-by-token (``ensure``), and
@@ -54,6 +90,21 @@ class BlockAccount:
     exhausted pool.  Single-stepper discipline: only the engine thread
     mutates an account (the engine snapshots counters under its own
     lock), so there is no lock here.
+
+    Prefix sharing (docs/serving.md): a block's *content key* is the
+    chain hash of the token ids cached in it (:func:`chain_key`).  The
+    engine ``publish``\\ es prompt blocks as it prefills them and
+    ``adopt``\\ s registered blocks for later arrivals whose prompt
+    prefix matches (``peek_match`` answers the can-fit question first),
+    so N tenants sharing a system prompt hold ONE physical copy with
+    refcount N.  Every write goes through :meth:`writable`: a write
+    into a block with refcount > 1 triggers copy-on-write to a fresh
+    block (the caller copies the device page), and a write into a
+    refcount-1 block that is still registered unregisters it first —
+    registered content is immutable.  Registry entries hold no
+    reference of their own: a block lives exactly as long as sequences
+    reference it, so eviction/preemption only ever reclaims blocks
+    whose refcount hits zero and quiescence reclaims the whole pool.
     """
 
     def __init__(self, num_blocks: int, block_size: int,
@@ -72,6 +123,14 @@ class BlockAccount:
         self._free: List[int] = sorted(range(reserved, num_blocks),
                                        reverse=True)
         self._owned: Dict[object, List[int]] = {}
+        #: refcount per allocated block == how many owner tables hold
+        #: it (the registry holds no reference; content entries die
+        #: with their last referencing sequence)
+        self._refs: Dict[int, int] = {}
+        #: content-key registry: chain key -> physical block
+        self._by_key: Dict[int, int] = {}
+        #: reverse map for unregistering on write/reclaim
+        self._key_of: Dict[int, int] = {}
         self.peak_used = 0
         self.total_allocated = 0
         self.total_released = 0
@@ -79,6 +138,10 @@ class BlockAccount:
         #: evicted back to the waiting queue to unblock a higher-QoS
         #: one) — the ``kv_evictions_total`` metric
         self.evicted = 0
+        #: prefix-sharing counters (tpf_serving_engine fields)
+        self.prefix_hits = 0            # blocks adopted via the registry
+        self.prefix_hit_tokens = 0      # prompt tokens served from it
+        self.cow_copies = 0             # copy-on-write block copies
 
     # -- capacity ---------------------------------------------------------
 
@@ -119,7 +182,9 @@ class BlockAccount:
         if need > len(self._free):
             return False
         for _ in range(need):
-            table.append(self._free.pop())
+            blk = self._free.pop()
+            self._refs[blk] = 1
+            table.append(blk)
         self.total_allocated += need
         self.peak_used = max(self.peak_used, self.used_blocks)
         return True
@@ -127,19 +192,179 @@ class BlockAccount:
     def table(self, owner: object) -> List[int]:
         return list(self._owned.get(owner, ()))
 
+    def _reclaim(self, blk: int, evicted: bool) -> None:
+        """Drop one reference; free the block at refcount zero (raises
+        on double-free — a negative refcount means table/refs drifted,
+        which eviction bugs would otherwise silently corrupt)."""
+        refs = self._refs.get(blk, 0)
+        if refs <= 0:
+            raise RuntimeError(f"double free of KV block {blk}")
+        if refs > 1:
+            self._refs[blk] = refs - 1
+            return
+        del self._refs[blk]
+        key = self._key_of.pop(blk, None)
+        if key is not None:
+            self._by_key.pop(key, None)
+        self._free.append(blk)
+        self.total_released += 1
+        if evicted:
+            self.evicted += 1
+
     def release(self, owner: object, evicted: bool = False) -> int:
-        """Return all of ``owner``'s blocks to the pool (retirement or
-        preemption); returns the count reclaimed."""
+        """Drop ``owner``'s references (retirement or preemption);
+        returns the count of blocks physically reclaimed — shared
+        blocks stay resident for their other holders and only return
+        to the pool when the last reference goes."""
         table = self._owned.pop(owner, None)
         if not table:
             return 0
-        self._free.extend(table)
+        freed_before = len(self._free)
+        for blk in table:
+            self._reclaim(blk, evicted)
         # keep the lowest-id-first discipline across reuse
         self._free.sort(reverse=True)
-        self.total_released += len(table)
-        if evicted:
-            self.evicted += len(table)
-        return len(table)
+        return len(self._free) - freed_before
+
+    def truncate(self, owner: object, n_tokens: int) -> int:
+        """Shrink ``owner``'s table to exactly cover ``n_tokens`` —
+        the speculative-decode rollback: blocks grown for rejected
+        draft positions go back to the pool (refcount rules as in
+        :meth:`release`).  Returns blocks physically reclaimed."""
+        table = self._owned.get(owner)
+        if table is None:
+            return 0
+        keep = self.blocks_for(n_tokens)
+        if keep >= len(table):
+            return 0
+        freed_before = len(self._free)
+        while len(table) > keep:
+            self._reclaim(table.pop(), evicted=False)
+        self._free.sort(reverse=True)
+        return len(self._free) - freed_before
+
+    # -- prefix sharing ---------------------------------------------------
+
+    def refcount(self, blk: int) -> int:
+        return self._refs.get(blk, 0)
+
+    def lookup(self, key: int) -> Optional[int]:
+        return self._by_key.get(key)
+
+    def peek_match(self, keys: Sequence[Tuple[int, int]]
+                   ) -> Tuple[int, int]:
+        """Longest registered chain prefix of ``keys`` (as produced by
+        :func:`prompt_block_keys`) WITHOUT adopting: returns
+        ``(blocks, tokens)`` the registry could serve."""
+        blocks = tokens = 0
+        for key, covered in keys:
+            if key not in self._by_key:
+                break
+            blocks += 1
+            tokens = covered
+        return blocks, tokens
+
+    def adopt(self, owner: object, keys: Sequence[Tuple[int, int]]
+              ) -> int:
+        """Map ``owner``'s table onto the longest registered chain
+        prefix of ``keys`` (refcount++ per adopted block).  Only legal
+        while the table is empty (admission / re-admission).  Returns
+        prompt tokens covered by the adopted blocks."""
+        table = self._owned.setdefault(owner, [])
+        if table:
+            raise RuntimeError("adopt() on a non-empty block table")
+        tokens = 0
+        for key, covered in keys:
+            blk = self._by_key.get(key)
+            if blk is None:
+                break
+            table.append(blk)
+            self._refs[blk] += 1
+            tokens = covered
+            self.prefix_hits += 1
+        self.prefix_hit_tokens += tokens
+        self.peak_used = max(self.peak_used, self.used_blocks)
+        return tokens
+
+    def adopt_block(self, owner: object, key: int) -> Optional[int]:
+        """Append the registered block for ``key`` to ``owner``'s table
+        (refcount++), or None on a registry miss — the per-block dedup
+        the KV_SHIP ingest runs (a chain key encodes its whole prefix,
+        so a hit at any index implies content-identical ancestry)."""
+        blk = self._by_key.get(key)
+        if blk is None:
+            return None
+        self._owned.setdefault(owner, []).append(blk)
+        self._refs[blk] += 1
+        self.prefix_hits += 1
+        self.peak_used = max(self.peak_used, self.used_blocks)
+        return blk
+
+    def append_block(self, owner: object) -> Optional[int]:
+        """Grow ``owner``'s table by ONE fresh block (KV_SHIP ingest
+        writes shipped pages into it); None when the pool is out."""
+        if not self._free:
+            return None
+        blk = self._free.pop()
+        self._refs[blk] = 1
+        self._owned.setdefault(owner, []).append(blk)
+        self.total_allocated += 1
+        self.peak_used = max(self.peak_used, self.used_blocks)
+        return blk
+
+    def publish(self, owner: object, index: int, key: int) -> bool:
+        """Register ``owner``'s block at table ``index`` under ``key``
+        (first-come wins; re-publishing an already-registered key is a
+        no-op).  Registered content must stay immutable — later writes
+        go through :meth:`writable`, which unregisters or CoWs."""
+        if key in self._by_key:
+            return False
+        blk = self._owned[owner][index]
+        if blk in self._key_of:      # block already carries other content
+            return False
+        self._by_key[key] = blk
+        self._key_of[blk] = key
+        return True
+
+    def writable(self, owner: object, index: int
+                 ) -> Optional[Tuple[int, Optional[int]]]:
+        """Secure ``owner``'s block at table ``index`` for a write.
+        Returns ``(block, cow_src)``: ``cow_src`` is None for an
+        in-place write, else the shared source block whose page the
+        caller must copy into ``block`` BEFORE writing (copy-on-write —
+        the table already points at the fresh copy).  Returns None when
+        a needed CoW copy cannot be allocated (pool exhausted — the
+        engine preempts and retries)."""
+        table = self._owned[owner]
+        blk = table[index]
+        if self._refs[blk] > 1:
+            if not self._free:
+                return None
+            new = self._free.pop()
+            self._refs[new] = 1
+            self._refs[blk] -= 1
+            table[index] = new
+            self.cow_copies += 1
+            self.total_allocated += 1
+            self.peak_used = max(self.peak_used, self.used_blocks)
+            return new, blk
+        key = self._key_of.pop(blk, None)
+        if key is not None:
+            # sole holder writing into registered content: the entry
+            # no longer describes the block, so it leaves the registry
+            self._by_key.pop(key, None)
+        return blk, None
+
+    @property
+    def shared_blocks(self) -> int:
+        """Physical blocks currently mapped by more than one table."""
+        return sum(1 for r in self._refs.values() if r > 1)
+
+    @property
+    def logical_blocks(self) -> int:
+        """Sum of table lengths — what ``used_blocks`` would be with no
+        sharing; the gap to ``used_blocks`` is the dedup win."""
+        return sum(self._refs.values())
 
     def utilization_pct(self) -> float:
         if not self.usable_blocks:
@@ -157,6 +382,12 @@ class BlockAccount:
                 "allocated_total": self.total_allocated,
                 "released_total": self.total_released,
                 "evicted_total": self.evicted,
+                "shared_blocks": self.shared_blocks,
+                "logical_blocks": self.logical_blocks,
+                "prefix_hits_total": self.prefix_hits,
+                "prefix_hit_tokens_total": self.prefix_hit_tokens,
+                "cow_copies_total": self.cow_copies,
+                "registered_keys": len(self._by_key),
                 "utilization_pct": self.utilization_pct()}
 
 
@@ -281,6 +512,88 @@ def paged_decode_step(params: Dict, token, cache: Dict, block_tables,
         probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
         out = jnp.einsum("bgrk,bgkd->bgrd", probs.astype(vv.dtype), vv)
         x = x + _llama._mm(out.reshape(b, config.n_heads * hd), p["wo"])
+        x = x + _llama._mlp(
+            layer["mlp"],
+            _llama._rms_norm(x, layer["mlp_norm"], config.norm_eps))
+        new_cache["k"].append(ck)
+        new_cache["v"].append(cv)
+    x = _llama._rms_norm(x, params["final_norm"], config.norm_eps)
+    logits = _llama._mm(x, params["lm_head"]).astype(jnp.float32)
+    return logits, new_cache
+
+
+def paged_verify_step(params: Dict, tokens, cache: Dict, block_tables,
+                      pos, config):
+    """One fused speculative-verify step: ``S`` tokens per sequence for
+    ``B`` sequences, all in ONE launch (docs/serving.md).
+
+    ``tokens``: ``[B, S]`` int32 — per sequence, the latest real token
+    followed by ``S-1`` draft proposals; ``block_tables``: ``[B, M]``;
+    ``pos``: ``[B]`` int32 — the cache index the FIRST token of each
+    row is written at (ragged).  Token ``[b, s]`` lands at cache
+    position ``pos[b] + s``; K/V for every position is written (the
+    accept logic overwrites rejected positions on later steps, and the
+    ``index <= position`` mask keeps them invisible until then).
+    Returns ``(logits [B, S, vocab] f32, updated cache)`` — the greedy
+    argmax of row ``s`` is the target's next token after consuming the
+    row prefix through ``s``, which is exactly what accept/reject
+    compares draft proposals against.
+
+    With ``S == 1`` this is :func:`paged_decode_step` with an extra
+    axis; the math (grouped GQA gather, f32 softmax, per-position
+    causal mask) is kept structurally identical so the greedy tokens
+    agree exactly — the speculative path's correctness contract.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import llama as _llama
+
+    b, s = tokens.shape
+    m = block_tables.shape[1]
+    bs = cache["k"][0].shape[2]
+    hd = config.head_dim
+    n_kv = config.n_kv_heads
+    rep = config.n_heads // n_kv
+    scale = hd ** -0.5
+
+    pos = pos.astype(jnp.int32)
+    block_tables = block_tables.astype(jnp.int32)
+    rows = jnp.arange(b)
+    pos_grid = pos[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+    blk = block_tables[rows[:, None], pos_grid // bs]     # [B, S]
+    slot = pos_grid % bs                                  # [B, S]
+    key_mask = jnp.arange(m * bs)[None, None, :] <= \
+        pos_grid[:, :, None]                              # [B, S, K]
+
+    x = params["tok_emb"][tokens]                  # [B, S, dim]
+    new_cache: Dict[str, list] = {"k": [], "v": []}
+    for i, layer in enumerate(params["layers"]):
+        p = layer["attn"]
+        h = _llama._rms_norm(x, layer["attn_norm"], config.norm_eps)
+        q = _llama._mm(h, p["wq"]).reshape(b, s, config.n_heads, hd)
+        k = _llama._mm(h, p["wk"]).reshape(b, s, n_kv, hd)
+        v = _llama._mm(h, p["wv"]).reshape(b, s, n_kv, hd)
+        q = _rope_at(q, config.rope_theta, pos_grid)
+        k = _rope_at(k, config.rope_theta, pos_grid)
+        # scatter all S positions of every sequence: advanced indices
+        # [B, S] around the head slice put (B, S) first — the set
+        # value is [B, S, n_kv, D]
+        ck = cache["k"][i].at[blk, :, slot, :].set(
+            k.astype(cache["k"][i].dtype))
+        cv = cache["v"][i].at[blk, :, slot, :].set(
+            v.astype(cache["v"][i].dtype))
+        kk = ck[block_tables].transpose(0, 2, 1, 3, 4) \
+            .reshape(b, n_kv, m * bs, hd)
+        vv = cv[block_tables].transpose(0, 2, 1, 3, 4) \
+            .reshape(b, n_kv, m * bs, hd)
+        qg = q.reshape(b, s, n_kv, rep, hd)
+        scores = jnp.einsum("bsgrd,bgkd->bsgrk", qg, kk) * scale
+        scores = jnp.where(key_mask[:, :, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        out = jnp.einsum("bsgrk,bgkd->bsgrd", probs.astype(vv.dtype), vv)
+        x = x + _llama._mm(out.reshape(b, s, config.n_heads * hd),
+                           p["wo"])
         x = x + _llama._mlp(
             layer["mlp"],
             _llama._rms_norm(x, layer["mlp_norm"], config.norm_eps))

@@ -9,7 +9,16 @@ scheduled work into next tokens against the paged pool it owns:
   ONE sequence into its pages; returns the first generated token when
   ``last`` (greedy argmax over the final position's logits);
 - ``decode(tokens, positions, tables)`` — one fused decode step for
-  the whole batch; returns each sequence's next token.
+  the whole batch; returns each sequence's next token;
+- ``verify(tokens, positions, tables)`` — one fused speculative-verify
+  step: ``S`` tokens per sequence, returns the ``[B][S]`` greedy
+  targets the engine accepts draft proposals against;
+- ``copy_blocks(pairs)`` — device-side page copies for the account's
+  copy-on-write prefix sharing (``(src, dst)`` per pair);
+- ``read_blocks(ids)`` / ``write_blocks(ids, k, v)`` — extract /
+  inject whole pages, the disaggregated-prefill KV_SHIP path
+  (``serving/disagg.py``; storage-free runners return ``(None,
+  None)`` and the ship degrades to metadata-only).
 
 :class:`LlamaRunner` is the real thing (jax, ``kvpool`` paged
 attention, compile-cache bucketing); :class:`FakeRunner` is a
@@ -54,6 +63,8 @@ class LlamaRunner:
                                                 block_size)
         self._decode_fns: Dict[Tuple[int, int], object] = {}
         self._prefill_fns: Dict[Tuple[int, int], object] = {}
+        self._verify_fns: Dict[Tuple[int, int, int], object] = {}
+        self._copy_fn = None
         #: the engine is a single stepper, but warmup() may race the
         #: engine thread on the compile-cache dicts
         self._lock = threading.Lock()
@@ -100,6 +111,26 @@ class LlamaRunner:
             self._prefill_fns[(c, m)] = fn
         return fn
 
+    def _verify_fn(self, b: int, s: int, m: int):
+        with self._lock:
+            fn = self._verify_fns.get((b, s, m))
+        if fn is not None:
+            return fn
+        import jax
+
+        def greedy(params, tokens, cache, tables, pos,
+                   config=self.config):
+            import jax.numpy as jnp
+
+            logits, cache = kvpool.paged_verify_step(
+                params, tokens, cache, tables, pos, config)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+        fn = jax.jit(greedy)
+        with self._lock:
+            self._verify_fns[(b, s, m)] = fn
+        return fn
+
     # -- engine contract -------------------------------------------------
 
     def prefill(self, tokens: List[int], table: List[int],
@@ -133,6 +164,75 @@ class LlamaRunner:
         nxt, self.cache = fn(self.params, tok, self.cache, tab, pos)
         return [int(x) for x in np.asarray(nxt)[:b]]
 
+    def verify(self, tokens: List[List[int]], positions: List[int],
+               tables: List[List[int]]) -> List[List[int]]:
+        """One fused verify step: ``tokens[i]`` is sequence i's latest
+        real token followed by its draft proposals (all rows the same
+        length S); returns the ``[B][S]`` greedy target tokens."""
+        import numpy as np
+
+        b = len(tokens)
+        s = len(tokens[0])
+        bp = kvpool.pow2_bucket(b)
+        m = kvpool.pow2_bucket(max(len(t) for t in tables), lo=4)
+        tab = np.zeros((bp, m), np.int32)
+        for i, t in enumerate(tables):
+            tab[i, :len(t)] = t
+        tok = np.zeros((bp, s), np.int32)
+        tok[:b] = tokens
+        pos = np.zeros((bp,), np.int32)
+        pos[:b] = positions
+        fn = self._verify_fn(bp, s, m)
+        nxt, self.cache = fn(self.params, tok, self.cache, tab, pos)
+        return [[int(x) for x in row] for row in np.asarray(nxt)[:b]]
+
+    def copy_blocks(self, pairs: List[Tuple[int, int]]) -> None:
+        """Copy whole K/V pages ``src -> dst`` across every layer (the
+        copy-on-write path).  One jitted gather/scatter per call."""
+        if not pairs:
+            return
+        import numpy as np
+
+        if self._copy_fn is None:
+            import jax
+
+            def copy(cache, src, dst):
+                out = {"k": [], "v": []}
+                for kind in ("k", "v"):
+                    for layer in cache[kind]:
+                        out[kind].append(
+                            layer.at[dst].set(layer[src]))
+                return out
+
+            self._copy_fn = jax.jit(copy)
+        src = np.asarray([p[0] for p in pairs], np.int32)
+        dst = np.asarray([p[1] for p in pairs], np.int32)
+        self.cache = self._copy_fn(self.cache, src, dst)
+
+    def read_blocks(self, ids: List[int]):
+        """Extract pages as host arrays ``(k, v)``, each
+        ``[L, n, n_kv, bs, D]`` — the KV_SHIP extract side."""
+        import numpy as np
+
+        idx = np.asarray(ids, np.int32)
+        k = np.stack([np.asarray(layer[idx])
+                      for layer in self.cache["k"]])
+        v = np.stack([np.asarray(layer[idx])
+                      for layer in self.cache["v"]])
+        return k, v
+
+    def write_blocks(self, ids: List[int], k, v) -> None:
+        """Inject shipped pages into this pool's blocks (KV_SHIP
+        ingest).  ``k``/``v``: ``[L, n, n_kv, bs, D]`` host arrays."""
+        import numpy as np
+
+        idx = np.asarray(ids, np.int32)
+        for i in range(len(self.cache["k"])):
+            self.cache["k"][i] = self.cache["k"][i].at[idx].set(
+                np.asarray(k[i], self.cache["k"][i].dtype))
+            self.cache["v"][i] = self.cache["v"][i].at[idx].set(
+                np.asarray(v[i], self.cache["v"][i].dtype))
+
     def warmup(self, max_batch: int, prompt_len: int,
                chunk: int) -> None:
         """Pre-compile the buckets a serving shape will hit, so the
@@ -163,6 +263,8 @@ class FakeRunner:
         self.nbytes = 0
         self.prefill_calls = 0
         self.decode_calls = 0
+        self.verify_calls = 0
+        self.copied_blocks = 0
 
     def _next(self, token: int, pos: int) -> int:
         return (token * 31 + pos * 7 + 3) % self.vocab
@@ -178,3 +280,26 @@ class FakeRunner:
                tables: List[List[int]]) -> List[int]:
         self.decode_calls += 1
         return [self._next(t, p) for t, p in zip(tokens, positions)]
+
+    def verify(self, tokens: List[List[int]], positions: List[int],
+               tables: List[List[int]]) -> List[List[int]]:
+        """Spec-verify against the arithmetic stepper: row ``s``'s
+        target is a pure function of (row token ``s``, position) — the
+        same function decode applies, so greedy-exactness of the
+        accept/reject loop is provable in unit tests and the sim."""
+        self.verify_calls += 1
+        out = []
+        for row, pos in zip(tokens, positions):
+            out.append([self._next(t, pos + i)
+                        for i, t in enumerate(row)])
+        return out
+
+    def copy_blocks(self, pairs: List[Tuple[int, int]]) -> None:
+        self.copied_blocks += len(pairs)
+
+    def read_blocks(self, ids: List[int]):
+        # storage-free: the ship path degrades to metadata-only
+        return None, None
+
+    def write_blocks(self, ids: List[int], k, v) -> None:
+        return None

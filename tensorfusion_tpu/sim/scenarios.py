@@ -50,17 +50,27 @@ SCALES = {
 }
 
 #: serving-burst-storm shapes (the engine scenario has its own axes:
-#: intermittent tenants, per-tenant burst size, pool geometry)
+#: intermittent tenants, per-tenant burst size, pool geometry).
+#: ``sysprompts``/``sys_len``: tenants draw from a small set of shared
+#: system prompts (block-aligned at block_size=4 so the full-prefix
+#: copy-on-write path fires), exercising prefix sharing under churn;
+#: ``spec_k``/``draft_acc``: the speculative-decode stepper — an
+#: ArithmeticDraft at the given per-token hit rate against the
+#: FakeRunner target, verified greedy-exact by invariant.
 SERVING_SCALES = {
     # deliberately under-provisioned pools/queues: the storm must
-    # exercise BUSY rejection, deadline shedding and block-pool
-    # preemption, not just the happy path
+    # exercise BUSY rejection, deadline shedding, block-pool
+    # preemption, CoW on shared tails and spec rollback, not just the
+    # happy path
     "small": dict(tenants=48, reqs=2, prompt=8, tokens=6, batch=8,
-                  blocks=25, chunk=8, waiting=12, window_s=0.8),
+                  blocks=25, chunk=8, waiting=12, window_s=0.8,
+                  sysprompts=3, sys_len=8, spec_k=3, draft_acc=0.7),
     "medium": dict(tenants=300, reqs=2, prompt=12, tokens=8, batch=16,
-                   blocks=65, chunk=16, waiting=24, window_s=5.0),
+                   blocks=65, chunk=16, waiting=24, window_s=5.0,
+                   sysprompts=4, sys_len=12, spec_k=3, draft_acc=0.7),
     "large": dict(tenants=2000, reqs=3, prompt=16, tokens=12, batch=32,
-                  blocks=129, chunk=32, waiting=48, window_s=20.0),
+                  blocks=129, chunk=32, waiting=48, window_s=20.0,
+                  sysprompts=6, sys_len=16, spec_k=4, draft_acc=0.7),
 }
 
 
@@ -338,10 +348,15 @@ def serving_burst_storm(seed: int = 0, scale: str = "small") -> dict:
     wake-from-zero shape, at a tenant count wall-clock benches cannot
     touch.  The engine is stepped cooperatively with a deterministic
     FakeRunner (one decode step costs 1 sim-ms); arrivals, QoS mix,
-    prompt/token lengths all flow from the seed.  Invariants: NO LOST
-    SEQUENCES (every submission is retired, shed with a deadline code,
-    or BUSY-rejected at submit — nothing vanishes) and the KV block
-    pool fully reclaimed at quiescence."""
+    prompt/token lengths all flow from the seed.  Tenants draw their
+    prompts from a small set of SHARED SYSTEM PROMPTS (prefix sharing
+    + copy-on-write under churn) and decode SPECULATIVELY through an
+    ArithmeticDraft.  Invariants: NO LOST SEQUENCES (every submission
+    is retired, shed with a deadline code, or BUSY-rejected at submit
+    — nothing vanishes), the refcounted KV block pool FULLY RECLAIMED
+    at quiescence (no block, owner, or registry entry survives), and
+    SPECULATIVE TOKENS EXACT — every completed sequence's stream
+    equals the closed-form non-speculative greedy chain."""
     import hashlib
     import json as _json
     import random as _random
@@ -351,6 +366,7 @@ def serving_burst_storm(seed: int = 0, scale: str = "small") -> dict:
     from ..remoting.dispatch import BusyError
     from ..serving.engine import ServingEngine
     from ..serving.runner import FakeRunner
+    from ..serving.spec import ArithmeticDraft
     from ..tracing import Tracer
     from ..tracing.export import trace_digest
     from .clock import SimClock
@@ -369,28 +385,43 @@ def serving_burst_storm(seed: int = 0, scale: str = "small") -> dict:
                         name="sim-engine", max_batch=p["batch"],
                         prefill_chunk_tokens=p["chunk"],
                         max_waiting=p["waiting"],
-                        profiler=profiler, recorder=recorder)
+                        profiler=profiler, recorder=recorder,
+                        prefix_sharing=True,
+                        draft=ArithmeticDraft(runner,
+                                              accuracy=p["draft_acc"],
+                                              seed=seed),
+                        spec_k=p["spec_k"])
     events: list = []
     outcomes = {"done": 0, "shed": 0, "busy": 0}
+    finished: list = []
 
     def emit(seq, toks, done, info):
         if done:
             key = "shed" if info.get("code") else "done"
             outcomes[key] += 1
+            if key == "done":
+                finished.append(seq)
             events.append((round(clock.monotonic(), 6), key,
                            seq.tenant, info.get("finish_reason")
                            or info.get("code"), len(seq.tokens)))
 
     # seeded burst schedule: each tenant wakes at a random instant and
-    # fires a short burst of requests (intermittent, mostly idle)
+    # fires a short burst of requests (intermittent, mostly idle);
+    # prompts share system prefixes drawn from a small pool
+    sys_prompts = [[rng.randrange(1, 97) for _ in range(p["sys_len"])]
+                   for _ in range(p["sysprompts"])]
     arrivals = []
     for i in range(p["tenants"]):
         tenant = f"tenant-{i:04d}"
         qos = ("low", "medium", "high", "critical")[rng.randrange(4)]
         t_wake = rng.random() * p["window_s"]
         for j in range(p["reqs"]):
-            prompt = [rng.randrange(1, 97)
-                      for _ in range(4 + rng.randrange(p["prompt"]))]
+            prompt = list(sys_prompts[rng.randrange(p["sysprompts"])])
+            # some requests ARE the bare system prompt (the
+            # block-aligned full-prefix match that forces CoW)
+            if rng.randrange(4):
+                prompt += [rng.randrange(1, 97)
+                           for _ in range(rng.randrange(p["prompt"]))]
             arrivals.append((round(t_wake + j * 0.02, 6), tenant, qos,
                              prompt, 1 + rng.randrange(p["tokens"]),
                              120.0 + rng.random() * 600.0))
@@ -424,17 +455,34 @@ def serving_burst_storm(seed: int = 0, scale: str = "small") -> dict:
             break
 
     snap = eng.snapshot()
-    violations = {"lost_sequences": [], "kv_reclaimed": []}
+    violations = {"lost_sequences": [], "kv_reclaimed": [],
+                  "spec_greedy_exact": []}
     accounted = outcomes["done"] + outcomes["shed"] + outcomes["busy"]
     if accounted != len(arrivals):
         violations["lost_sequences"].append(
             f"{len(arrivals)} submitted but only {accounted} accounted "
             f"(done={outcomes['done']} shed={outcomes['shed']} "
             f"busy={outcomes['busy']})")
-    if snap["kv"]["used"] != 0 or snap["kv"]["owners"] != 0:
+    if snap["kv"]["used"] != 0 or snap["kv"]["owners"] != 0 or \
+            snap["kv"]["registered_keys"] != 0:
         violations["kv_reclaimed"].append(
             f"{snap['kv']['used']} blocks / {snap['kv']['owners']} "
-            f"owners still held at quiescence")
+            f"owners / {snap['kv']['registered_keys']} registry "
+            f"entries still held at quiescence")
+    # speculative decode must be token-EXACT vs the closed-form greedy
+    # chain (FakeRunner's next token is a pure function of (token,
+    # position), so the non-speculative stream is computable directly)
+    for seq in finished:
+        expect, tok = [], seq.prompt[-1]
+        pos = len(seq.prompt) - 1
+        while len(expect) < seq.max_new_tokens:
+            tok = runner._next(tok, pos)
+            expect.append(tok)
+            pos += 1
+        if seq.tokens != expect:
+            violations["spec_greedy_exact"].append(
+                f"seq {seq.sid} ({seq.tenant}): spec stream "
+                f"{seq.tokens} != greedy {expect}")
     log_digest = hashlib.sha256(
         _json.dumps(events, sort_keys=True).encode()).hexdigest()
     spans = tracer.finished()
@@ -462,6 +510,11 @@ def serving_burst_storm(seed: int = 0, scale: str = "small") -> dict:
         "preempted": snap["preempted"],
         "kv_evictions": snap["kv"]["evicted_total"],
         "kv_peak_used": snap["kv"]["peak_used"],
+        "kv_prefix_hits": snap["kv"]["prefix_hits_total"],
+        "kv_prefix_hit_tokens": snap["kv"]["prefix_hit_tokens_total"],
+        "kv_cow_copies": snap["kv"]["cow_copies_total"],
+        "spec_accept_rate": snap["spec"]["accept_rate"],
+        "spec_steps": snap["spec"]["steps"],
         "batch_occupancy_pct": snap["batch_occupancy_pct"],
         "ttft_p99_ms": snap["ttft"]["p99_ms"],
     }

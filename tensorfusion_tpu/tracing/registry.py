@@ -52,6 +52,15 @@ SPAN_SCHEMA = {
     "serving.step": {
         "attrs": ("batch", "tokens"),
     },
+    "serving.prefix_match": {
+        "attrs": ("tenant", "matched_tokens", "prompt_tokens"),
+    },
+    "serving.kv_ship": {
+        "attrs": ("tenant", "blocks", "shared", "bytes"),
+    },
+    "serving.spec_verify": {
+        "attrs": ("batch", "k", "accepted"),
+    },
     # -- control-plane pod lifecycle (admission -> schedule -> bind)
     "webhook.admit": {
         "attrs": ("pod", "pool", "qos", "workload"),

@@ -24,6 +24,7 @@ All CPU (``JAX_PLATFORMS=cpu``), tier-1.
 from __future__ import annotations
 
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -774,3 +775,660 @@ def test_generate_token_parity_q8_vs_raw(serving_worker, params):
         params, jnp.asarray([prompt], jnp.int32), 6, CFG))[0]]
     assert got["tokens"] == ref
     q8_dev.close()
+
+
+# -- refcounted prefix sharing (ISSUE 11) ----------------------------------
+
+
+def test_block_account_refcount_double_free_raises():
+    """Hardening: releasing past refcount zero fails loudly instead of
+    silently corrupting the free list."""
+    from tensorfusion_tpu.serving import prompt_block_keys
+
+    a = BlockAccount(9, 4)
+    a.ensure("pub", 8)
+    keys = prompt_block_keys([1, 2, 3, 4, 5, 6, 7, 8], 4)
+    for i, (key, _) in enumerate(keys):
+        a.publish("pub", i, key)
+    assert a.adopt("fan", keys) == 8
+    blk = a.table("pub")[0]
+    assert a.refcount(blk) == 2
+    a.release("pub")
+    a.release("fan")
+    assert a.free_blocks == a.usable_blocks
+    # sabotage: a stale table re-released after the blocks went back
+    a._owned["ghost"] = [blk]
+    with pytest.raises(RuntimeError, match="double free"):
+        a.release("ghost")
+
+
+def test_block_account_shared_eviction_order_deterministic():
+    """Shared-block release keeps the lowest-id-first free-list
+    discipline: whatever the interleaving of sharers, the pool hands
+    out the lowest ids on reuse."""
+    from tensorfusion_tpu.serving import prompt_block_keys
+
+    a = BlockAccount(17, 4)
+    prompt = list(range(1, 13))               # 3 blocks, aligned
+    keys = prompt_block_keys(prompt, 4)
+    a.ensure("pub", 12)
+    for i, (key, _) in enumerate(keys):
+        a.publish("pub", i, key)
+    for fan in ("f1", "f2", "f3"):
+        assert a.adopt(fan, keys) == 12
+    a.ensure("solo", 8)                       # private blocks 4, 5
+    # release interleaved: shared blocks only free at the LAST ref
+    a.release("f2")
+    a.release("pub")
+    a.release("solo", evicted=True)
+    assert a.snapshot()["evicted_total"] == 2
+    assert a.used_blocks == 3                 # f1+f3 still share
+    a.release("f1")
+    a.release("f3")
+    assert a.free_blocks == a.usable_blocks
+    assert a.snapshot()["registered_keys"] == 0
+    # deterministic reuse: lowest ids first, whatever freed last
+    a.ensure("next", 16)
+    assert a.table("next") == [1, 2, 3, 4]
+
+
+def test_block_account_consistent_under_sharing_churn():
+    """Occupancy/high-water/refcount invariants hold under seeded
+    adopt/publish/CoW/truncate/release churn: physical used ==
+    usable - free == live refs, and the sum of refcounts equals the
+    total table length across owners."""
+    import random
+
+    from tensorfusion_tpu.serving import prompt_block_keys
+
+    rng = random.Random(13)
+    a = BlockAccount(33, 4)
+    prompts = [[p] * 8 for p in (1, 2, 3)]
+    live = {}
+    for step in range(400):
+        op = rng.randrange(4)
+        if op == 0 and len(live) < 6:
+            owner = f"o{step}"
+            prompt = prompts[rng.randrange(3)]
+            keys = prompt_block_keys(prompt, 4)
+            if a.adopt(owner, keys) < len(prompt):
+                if not a.ensure(owner, len(prompt)):
+                    a.release(owner)
+                    continue
+            for i, (key, _) in enumerate(keys):
+                a.publish(owner, i, key)
+            live[owner] = len(prompt)
+        elif op == 1 and live:
+            owner = rng.choice(sorted(live))
+            n = live[owner]
+            if a.ensure(owner, n + 4):
+                live[owner] = n + 4
+                bi = (n + 3) // 4
+                w = a.writable(owner, bi)
+                assert w is not None
+        elif op == 2 and live:
+            owner = rng.choice(sorted(live))
+            keep = max(4, live[owner] - 8)
+            a.truncate(owner, keep)
+            live[owner] = keep
+        elif op == 3 and live:
+            owner = rng.choice(sorted(live))
+            a.release(owner, evicted=bool(rng.randrange(2)))
+            del live[owner]
+        # the invariants under test
+        assert a.used_blocks == a.usable_blocks - a.free_blocks
+        assert a.used_blocks == len(a._refs)
+        assert sum(a._refs.values()) == \
+            sum(len(t) for t in a._owned.values())
+        assert a.peak_used >= a.used_blocks
+        for blk, key in a._key_of.items():
+            assert a._by_key[key] == blk
+    for owner in sorted(live):
+        a.release(owner)
+    assert a.free_blocks == a.usable_blocks
+    assert a.snapshot()["registered_keys"] == 0
+
+
+def _drain(eng, done, want, rounds=2000):
+    for _ in range(rounds):
+        if len(done) >= want:
+            break
+        eng.step()
+    return done
+
+
+def test_prefix_sharing_dedups_physical_blocks():
+    """Tenants sharing a block-aligned system prompt map their tables
+    onto ONE physical copy; tokens identical to the no-sharing run;
+    the pool reclaims fully at quiescence."""
+    sysp = list(range(1, 21))                 # 5 full blocks at bs=4
+    reqs = [("warm", sysp + [99], 30)] + \
+        [(f"f{i}", sysp + [50 + i], 6) for i in range(5)] + \
+        [("same", list(sysp), 5), ("same2", list(sysp), 5)]
+
+    def run(share):
+        eng = ServingEngine(FakeRunner(num_blocks=128), max_batch=8,
+                            prefix_sharing=share)
+        done, emit = _collect()
+        outs = {}
+
+        def wrap(seq, toks, d, info):
+            emit(seq, toks, d, info)
+            if d:
+                outs[seq.tenant] = list(seq.tokens)
+        first = True
+        for tenant, prompt, steps in reqs:
+            eng.submit(prompt, steps, tenant=tenant, emit=wrap)
+            if first:
+                eng.step()        # warm publishes the prefix
+                first = False
+        _drain(eng, done, len(reqs))
+        return outs, eng
+
+    base, _ = run(False)
+    shared, eng = run(True)
+    assert shared == base
+    kv = eng.snapshot()["kv"]
+    assert kv["prefix_hits_total"] > 0
+    assert kv["prefix_hit_tokens_total"] >= 7 * 20
+    # identical full-prompt arrivals rewrote their (shared) tail
+    # block: copy-on-write fired
+    assert kv["cow_copies_total"] > 0
+    assert kv["used"] == 0 and kv["owners"] == 0
+    assert kv["registered_keys"] == 0
+
+
+def test_prefix_sharing_peak_blocks_counted_once():
+    """With N sharers live simultaneously, the physical pool holds the
+    shared prefix once: logical - physical >= (N-1) * prefix blocks."""
+    sysp = list(range(1, 21))                 # 5 blocks at bs=4
+    eng = ServingEngine(FakeRunner(num_blocks=128), max_batch=9,
+                        prefix_sharing=True)
+    done, emit = _collect()
+    eng.submit(sysp + [99], 40, tenant="warm", emit=emit)
+    eng.step()
+    for i in range(8):
+        eng.submit(sysp + [60 + i], 20, tenant=f"f{i}", emit=emit)
+    for _ in range(4):
+        eng.step()
+    acct = eng.account
+    assert acct.logical_blocks - acct.used_blocks >= 8 * 5
+    assert acct.shared_blocks >= 5
+    _drain(eng, done, 9)
+    assert eng.snapshot()["kv"]["used"] == 0
+
+
+def test_preempt_readmit_sharing_tenant_exact():
+    """A sharing tenant preempted under pool pressure regenerates an
+    IDENTICAL suffix on re-admission (greedy determinism survives
+    adoption + CoW + release + re-adoption)."""
+    sysp = list(range(1, 21))
+    # tiny pool: the second wave must preempt the low-QoS sharer
+    eng = ServingEngine(FakeRunner(num_blocks=17, block_size=4),
+                        max_batch=4, prefix_sharing=True,
+                        max_waiting=32)
+    base = ServingEngine(FakeRunner(num_blocks=65, block_size=4),
+                         max_batch=4, prefix_sharing=False,
+                         max_waiting=32)
+    reqs = [("victim", "low", sysp + [99], 20),
+            ("pusher1", "critical", sysp + [1], 20),
+            ("pusher2", "critical", list(range(30, 44)), 20)]
+    outs = {}
+
+    def run(engine):
+        outs.clear()
+        done, emit = _collect()
+
+        def wrap(seq, toks, d, info):
+            emit(seq, toks, d, info)
+            if d:
+                outs[seq.tenant] = list(seq.tokens)
+        for tenant, qos, prompt, steps in reqs:
+            engine.submit(prompt, steps, tenant=tenant, qos=qos,
+                          emit=wrap)
+            engine.step()
+        _drain(engine, done, len(reqs))
+        return dict(outs)
+
+    want = run(base)
+    got = run(eng)
+    assert got == want
+    assert eng.snapshot()["preempted"] > 0      # pressure really hit
+    kv = eng.snapshot()["kv"]
+    assert kv["used"] == 0 and kv["registered_keys"] == 0
+
+
+def test_prefix_sharing_llama_numerics_exact(params):
+    """Real paged attention: sharers adopt the warm tenant's physical
+    pages and still emit exactly the greedy reference tokens."""
+    runner = LlamaRunner(params, CFG, num_blocks=64, block_size=4)
+    eng = ServingEngine(runner, max_batch=4, prefix_sharing=True)
+    sysp = [3, 1, 4, 1, 5, 9, 2, 6]           # 2 full blocks
+    done, emit = _collect()
+    outs = {}
+
+    def wrap(seq, toks, d, info):
+        emit(seq, toks, d, info)
+        if d:
+            outs[seq.tenant] = list(seq.tokens)
+    eng.submit(sysp + [8, 1], 5, tenant="warm", emit=wrap)
+    eng.step()
+    eng.submit(sysp + [7, 2], 5, tenant="fan", emit=wrap)
+    _drain(eng, done, 2)
+    assert eng.snapshot()["kv"]["prefix_hits_total"] >= 2
+    for tenant, suffix in (("warm", [8, 1]), ("fan", [7, 2])):
+        ref = [int(x) for x in np.asarray(llama.generate(
+            params, jnp.asarray([sysp + suffix], jnp.int32), 5,
+            CFG))[0]]
+        assert outs[tenant] == ref
+
+
+# -- speculative decoding (ISSUE 11) ---------------------------------------
+
+
+def _spec_reqs():
+    rng = np.random.default_rng(9)
+    return [(f"t{i}", list(map(int, rng.integers(1, 200, 10))), 12)
+            for i in range(5)]
+
+
+def _run_fake(engine, reqs):
+    done, emit = _collect()
+    outs = {}
+
+    def wrap(seq, toks, d, info):
+        emit(seq, toks, d, info)
+        if d:
+            outs[seq.tenant] = list(seq.tokens)
+    for tenant, prompt, steps in reqs:
+        engine.submit(prompt, steps, tenant=tenant, emit=wrap)
+    _drain(engine, done, len(reqs))
+    return outs
+
+
+@pytest.mark.parametrize("accuracy,expect_rate",
+                         [(0.0, 0.0), (1.0, 1.0), (0.6, None)])
+def test_spec_decode_greedy_exact_regimes(accuracy, expect_rate):
+    """Forced-0%, forced-100% and natural accept: the emitted stream
+    is identical to non-speculative greedy decode, and the accept-rate
+    counter lands where the regime forces it."""
+    from tensorfusion_tpu.serving import ArithmeticDraft
+
+    reqs = _spec_reqs()
+    base = _run_fake(ServingEngine(FakeRunner(num_blocks=128),
+                                   max_batch=8), reqs)
+    runner = FakeRunner(num_blocks=128)
+    eng = ServingEngine(runner, max_batch=8,
+                        draft=ArithmeticDraft(runner,
+                                              accuracy=accuracy),
+                        spec_k=3)
+    got = _run_fake(eng, reqs)
+    assert got == base
+    spec = eng.snapshot()["spec"]
+    assert spec["steps"] > 0 and spec["proposed"] > 0
+    if expect_rate is not None:
+        assert spec["accept_rate"] == expect_rate
+    else:
+        assert 0.0 < spec["accept_rate"] < 1.0
+    kv = eng.snapshot()["kv"]
+    assert kv["used"] == 0 and kv["owners"] == 0
+
+
+def test_spec_decode_eos_and_length_trims_exact():
+    """Speculative over-acceptance past EOS or max_new_tokens is
+    trimmed so finish semantics match plain decode."""
+    from tensorfusion_tpu.serving import ArithmeticDraft
+
+    fr = FakeRunner(num_blocks=64)
+    first = fr.prefill([5, 7, 11], [], 0)
+    second = fr._next(first, 3)
+    for eos, max_new in ((second, 10), (None, 2)):
+        base_eng = ServingEngine(FakeRunner(num_blocks=64),
+                                 max_batch=2)
+        done, emit = _collect()
+        base_eng.submit([5, 7, 11], max_new, eos_id=eos, emit=emit)
+        _drain(base_eng, done, 1)
+        (want, winfo), = done.values()
+        runner = FakeRunner(num_blocks=64)
+        eng = ServingEngine(runner, max_batch=2,
+                            draft=ArithmeticDraft(runner, accuracy=1.0),
+                            spec_k=4)
+        done2, emit2 = _collect()
+        eng.submit([5, 7, 11], max_new, eos_id=eos, emit=emit2)
+        _drain(eng, done2, 1)
+        (got, ginfo), = done2.values()
+        assert got == want
+        assert ginfo["finish_reason"] == winfo["finish_reason"]
+
+
+def test_spec_decode_rollback_reclaims_blocks():
+    """Forced-0%: every draft rejected, every speculative block grant
+    rolled back — no leak, no high-water runaway."""
+    from tensorfusion_tpu.serving import ArithmeticDraft
+
+    runner = FakeRunner(num_blocks=33, block_size=4)
+    eng = ServingEngine(runner, max_batch=2,
+                        draft=ArithmeticDraft(runner, accuracy=0.0),
+                        spec_k=4)
+    done, emit = _collect()
+    eng.submit([1, 2, 3], 8, tenant="a", emit=emit)
+    eng.submit([4, 5, 6], 8, tenant="b", emit=emit)
+    _drain(eng, done, 2)
+    snap = eng.snapshot()
+    assert snap["spec"]["accept_rate"] == 0.0
+    kv = snap["kv"]
+    assert kv["used"] == 0 and kv["owners"] == 0
+    # rollback actually fired: more blocks were granted than the
+    # accepted context ever kept
+    assert kv["allocated_total"] > kv["peak_used"]
+
+
+def test_spec_decode_llama_ngram_exact(params):
+    """Real model + prompt-lookup draft: greedy tokens exactly match
+    the non-speculative engine run."""
+    from tensorfusion_tpu.serving import NGramDraft
+
+    runner = LlamaRunner(params, CFG, num_blocks=64, block_size=4)
+    reqs = [("a", [3, 1, 4, 1, 5, 9], 10), ("b", [2, 7, 1, 8], 10)]
+    base = _run_fake(ServingEngine(
+        LlamaRunner(params, CFG, num_blocks=64, block_size=4),
+        max_batch=2), reqs)
+    eng = ServingEngine(runner, max_batch=2, draft=NGramDraft(n=2),
+                        spec_k=3)
+    got = _run_fake(eng, reqs)
+    assert got == base
+    assert eng.snapshot()["spec"]["steps"] > 0
+
+
+def test_spec_verify_span_and_counters():
+    """Traced speculative sequences record serving.spec_verify with
+    the accepted count; tenant stats carry per-tenant accept rates."""
+    from tensorfusion_tpu.serving import ArithmeticDraft
+    from tensorfusion_tpu.tracing import Tracer
+
+    tracer = Tracer(service="unit")
+    runner = FakeRunner(num_blocks=64)
+    eng = ServingEngine(runner, max_batch=2, tracer=tracer,
+                        draft=ArithmeticDraft(runner, accuracy=1.0),
+                        spec_k=2)
+    done, emit = _collect()
+    eng.submit([1, 2, 3], 6, tenant="al", emit=emit,
+               trace={"trace_id": "tr-s", "span_id": "",
+                      "sampled": True})
+    _drain(eng, done, 1)
+    spans = [s for s in tracer.finished()
+             if s["name"] == "serving.spec_verify"]
+    assert spans and all(s["attrs"]["accepted"] >= 0 for s in spans)
+    assert any(s["attrs"]["accepted"] > 0 for s in spans)
+    t = eng.snapshot()["tenants"]["al"]
+    assert t["spec_proposed"] > 0
+    assert t["spec_accept_rate"] == 1.0
+
+
+def test_profiler_attributes_draft_to_owning_tenant():
+    """tpfprof: draft-model compute lands on the tenant being served —
+    no phantom draft tenant appears in the ledger."""
+    from tensorfusion_tpu.profiling.profiler import Profiler
+    from tensorfusion_tpu.serving import ArithmeticDraft
+
+    prof = Profiler(name="unit")
+    runner = FakeRunner(num_blocks=64)
+    eng = ServingEngine(runner, max_batch=2, profiler=prof,
+                        draft=ArithmeticDraft(runner, accuracy=1.0),
+                        spec_k=2)
+    done, emit = _collect()
+    eng.submit([1, 2, 3], 6, tenant="alice", emit=emit)
+    eng.submit([4, 5, 6], 6, tenant="bob", emit=emit)
+    _drain(eng, done, 2)
+    snap = prof.snapshot()
+    assert set(snap["tenants"]) == {"alice", "bob"}
+
+
+# -- disaggregated prefill/decode + KV_SHIP (ISSUE 11) ---------------------
+
+
+def test_local_prefill_pool_ships_and_activates():
+    """Inline pool: prompts prefill on designated workers, pages ship
+    into the decode account (deduped), tokens identical to fused."""
+    from tensorfusion_tpu.serving import PrefillPool
+
+    sysp = list(range(1, 17))
+    reqs = [(f"t{i}", sysp + [40 + i], 6) for i in range(6)]
+    base = _run_fake(ServingEngine(FakeRunner(num_blocks=128),
+                                   max_batch=8), reqs)
+    pool = PrefillPool([FakeRunner(num_blocks=128),
+                        FakeRunner(num_blocks=128)],
+                       inline=True, chunk_tokens=8)
+    eng = ServingEngine(FakeRunner(num_blocks=128), max_batch=8,
+                        prefill_pool=pool)
+    got = _run_fake(eng, reqs)
+    assert got == base
+    snap = eng.snapshot()
+    assert snap["kv_ship"]["ships"] == len(reqs)
+    # pool-side prefix cache + decode-side ingest dedup both fired
+    assert snap["kv_ship"]["dedup_blocks"] > 0
+    assert pool.snapshot()["prefix_hits"] > 0
+    assert snap["kv"]["used"] == 0 and snap["kv"]["owners"] == 0
+
+
+def test_prefill_pool_oversized_prompt_falls_back_inline():
+    """A prompt the pool cannot hold falls back to the decode engine's
+    inline chunked prefill instead of failing."""
+    from tensorfusion_tpu.serving import PrefillPool
+
+    pool = PrefillPool([FakeRunner(num_blocks=5, block_size=2)],
+                       inline=True, chunk_tokens=4)
+    eng = ServingEngine(FakeRunner(num_blocks=128, block_size=4),
+                        max_batch=2, prefill_pool=pool)
+    done, emit = _collect()
+    eng.submit(list(range(1, 30)), 4, tenant="big", emit=emit)
+    _drain(eng, done, 1)
+    (tokens, info), = done.values()
+    assert info["finish_reason"] == "length" and len(tokens) == 4
+    assert pool.snapshot()["failed_jobs"] == 1
+    assert eng.snapshot()["kv"]["used"] == 0
+
+
+def test_disagg_min_tokens_routes_short_prompts_inline():
+    from tensorfusion_tpu.serving import PrefillPool
+
+    pool = PrefillPool([FakeRunner(num_blocks=128)], inline=True)
+    eng = ServingEngine(FakeRunner(num_blocks=128), max_batch=4,
+                        prefill_pool=pool, disagg_min_tokens=16)
+    done, emit = _collect()
+    eng.submit([1, 2, 3], 4, tenant="short", emit=emit)
+    eng.submit(list(range(1, 21)), 4, tenant="long", emit=emit)
+    _drain(eng, done, 2)
+    assert eng.snapshot()["kv_ship"]["ships"] == 1
+    assert pool.snapshot()["shipped_jobs"] == 1
+
+
+def test_kv_ship_over_tcp_token_exact(serving_worker, params):
+    """The protocol-v6 KV_SHIP path: prefill on a local prefill-tier
+    runner, ship the pages over TCP, decode on the worker — tokens
+    identical to a plain GENERATE of the same prompt."""
+    from tensorfusion_tpu.remoting import RemoteDevice
+    from tensorfusion_tpu.serving import PrefillPool
+    from tensorfusion_tpu.serving.disagg import _Job
+
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5]
+    dev = RemoteDevice(serving_worker.url)
+    ref = dev.generate(prompt, 8)
+    pool = PrefillPool([LlamaRunner(params, CFG, num_blocks=64,
+                                    block_size=4)], inline=True)
+    w = pool.workers[0]
+    job = _Job(None, prompt, 1)
+    st = w.advance(job)
+    while st is False:
+        st = w.advance(job)
+    payload = w.payload(job)
+    out = dev.ship_kv(prompt, 8, payload["keys"], payload["k"],
+                      payload["v"], payload["first_token"],
+                      payload["n_tokens"])
+    dev.close()
+    assert out["tokens"] == ref["tokens"]
+    assert out["ship"]["blocks"] == len(payload["keys"])
+    snap = serving_worker.engine.snapshot()
+    assert snap["kv_ship"]["ships"] == 1
+    assert snap["kv_ship"]["bytes"] > 0
+
+
+def test_kv_ship_requires_protocol_v6(serving_worker, params):
+    """Pre-v6 peers never see KV_SHIP: a v5-pinned client refuses to
+    send it, and the worker refuses a forged one from a pre-v6
+    connection."""
+    from tensorfusion_tpu.remoting import RemoteDevice, protocol
+    from tensorfusion_tpu.remoting.client import RemoteExecutionError
+
+    dev5 = RemoteDevice(serving_worker.url, protocol_version=5)
+    with pytest.raises(RemoteExecutionError, match="protocol v6"):
+        dev5.ship_kv([1, 2, 3], 4, [1], None, None, 1, 3)
+    # forged: push the kind down the v5 wire directly — the worker's
+    # version gate must reject it (not crash the connection handler)
+    import queue as _queue
+
+    q = _queue.Queue()
+    dev5._submit("KV_SHIP", {"prompt": [1, 2, 3], "max_tokens": 2,
+                             "keys": [1], "first_token": 1,
+                             "n_tokens": 3}, [], stream=q)
+    kind, meta, _ = q.get(timeout=10)
+    assert kind == "ERROR" and "protocol >= 6" in meta["error"]
+    dev5.close()
+    assert protocol.KV_SHIP_MIN_VERSION == 6
+
+
+def test_kv_ship_dedupes_against_decode_registry(serving_worker,
+                                                 params):
+    """Two ships sharing a prompt prefix: the second ingest adopts the
+    registered blocks instead of writing new pages."""
+    from tensorfusion_tpu.remoting import RemoteDevice
+    from tensorfusion_tpu.serving import PrefillPool
+    from tensorfusion_tpu.serving.disagg import _Job
+
+    sysp = [3, 1, 4, 1, 5, 9, 2, 6]
+    pool = PrefillPool([LlamaRunner(params, CFG, num_blocks=64,
+                                    block_size=4)], inline=True)
+    dev = RemoteDevice(serving_worker.url)
+
+    import itertools
+
+    ids = itertools.count(1)
+
+    def ship(prompt, steps):
+        w = pool.workers[0]
+        job = _Job(None, prompt, next(ids))
+        st = w.advance(job)
+        while st is False:
+            st = w.advance(job)
+        payload = w.payload(job)
+        return dev.ship_kv(prompt, steps, payload["keys"],
+                           payload["k"], payload["v"],
+                           payload["first_token"],
+                           payload["n_tokens"])
+
+    # long-lived first tenant keeps its blocks registered while the
+    # second ships the same system prompt
+    import threading
+
+    first = {}
+    t = threading.Thread(target=lambda: first.update(
+        ship(sysp + [7, 3], 24)))
+    t.start()
+    deadline = time.time() + 20
+    while time.time() < deadline and \
+            serving_worker.engine.snapshot()["kv_ship"]["ships"] < 1:
+        time.sleep(0.01)
+    out2 = ship(sysp + [8, 4], 4)
+    t.join(timeout=30)
+    dev.close()
+    snap = serving_worker.engine.snapshot()
+    assert snap["kv_ship"]["ships"] == 2
+    assert snap["kv_ship"]["dedup_blocks"] >= 2
+    # both streams match the plain greedy reference
+    for prompt, out in ((sysp + [7, 3], first), (sysp + [8, 4], out2)):
+        ref = [int(x) for x in np.asarray(llama.generate(
+            params, jnp.asarray([prompt], jnp.int32),
+            len(out["tokens"]), CFG))[0]]
+        assert out["tokens"] == ref
+
+
+def test_kv_ship_span_recorded(serving_worker, params):
+    """A traced KV_SHIP carries serving.kv_ship (and the prefix-match
+    span when the registry hits) back to the client tracer."""
+    from tensorfusion_tpu.remoting import RemoteDevice
+    from tensorfusion_tpu.serving import PrefillPool
+    from tensorfusion_tpu.serving.disagg import _Job
+    from tensorfusion_tpu.tracing import Tracer
+
+    tracer = Tracer(service="unit-client")
+    dev = RemoteDevice(serving_worker.url, tracer=tracer)
+    prompt = [2, 7, 1, 8, 2, 8]
+    pool = PrefillPool([LlamaRunner(params, CFG, num_blocks=64,
+                                    block_size=4)], inline=True)
+    w = pool.workers[0]
+    job = _Job(None, prompt, 1)
+    st = w.advance(job)
+    while st is False:
+        st = w.advance(job)
+    payload = w.payload(job)
+    dev.ship_kv(prompt, 4, payload["keys"], payload["k"],
+                payload["v"], payload["first_token"],
+                payload["n_tokens"])
+    dev.close()
+    names = {s["name"] for s in tracer.finished()}
+    assert "serving.kv_ship" in names
+
+
+def test_serving_engine_lines_carry_new_counters():
+    """The ISSUE-11 counters ride tpf_serving_engine/tenant lines."""
+    from tensorfusion_tpu.hypervisor.metrics import serving_engine_lines
+    from tensorfusion_tpu.metrics.encoder import parse_line
+    from tensorfusion_tpu.serving import ArithmeticDraft
+
+    runner = FakeRunner(num_blocks=64)
+    eng = ServingEngine(runner, max_batch=2, name="unit",
+                        draft=ArithmeticDraft(runner, accuracy=1.0),
+                        spec_k=2)
+    done, emit = _collect()
+    eng.submit([1, 2, 3, 4], 6, tenant="al", qos="high", emit=emit)
+    _drain(eng, done, 1)
+    lines = serving_engine_lines(eng, "node-x", 42)
+    _, _, efields, _ = parse_line(lines[0])
+    for key in ("kv_shared_blocks", "kv_cow_copies_total",
+                "kv_prefix_hit_tokens_total", "kv_ship_bytes_total",
+                "spec_accept_rate", "spec_steps_total"):
+        assert key in efields
+    assert efields["spec_accept_rate"] == 1.0
+    _, _, tfields, _ = parse_line(lines[1])
+    assert tfields["spec_accept_rate"] == 1.0
+    assert "prefix_hit_tokens_total" in tfields
+
+
+def test_serving_api_endpoint_and_tui_pane():
+    """GET /api/v1/serving serves engine snapshots; the TUI serving
+    pane renders the new counters."""
+    import json as _json
+    import urllib.request
+
+    from tensorfusion_tpu.hypervisor.server import HypervisorServer
+    from tensorfusion_tpu.hypervisor.tui import render_serving
+    from tensorfusion_tpu.remoting import RemoteVTPUWorker
+
+    eng = ServingEngine(FakeRunner(), max_batch=2, name="api-eng")
+    done, emit = _collect()
+    eng.submit([1, 2, 3], 4, tenant="al", emit=emit)
+    _drain(eng, done, 1)
+    rw = RemoteVTPUWorker(engine=eng)
+    srv = HypervisorServer(devices=None, workers=None,
+                           remote_workers=[rw])
+    srv.start()
+    try:
+        with urllib.request.urlopen(
+                f"{srv.url}/api/v1/serving", timeout=5) as r:
+            snaps = _json.loads(r.read())
+        assert len(snaps) == 1 and snaps[0]["name"] == "api-eng"
+        assert "kv_ship" in snaps[0] and "spec" in snaps[0]
+        pane = render_serving(snaps)
+        assert "api-eng" in pane and "kv:" in pane
+    finally:
+        srv.stop()

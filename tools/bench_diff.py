@@ -80,6 +80,23 @@ CELLS = {
         ("engine.burst_storm.aggregate_tokens_per_s",
          "higher", 40.0, "rel"),
         ("wake_from_zero_ms", "lower", 100.0, "rel"),
+        # copy-on-write prefix sharing: effective prefill throughput
+        # at 90% overlap vs the no-sharing baseline (acceptance >=5x;
+        # a timing cell on a 1-core box, so the band is wide)
+        ("engine.prefix_sharing.effective_prefill_speedup_x",
+         "higher", 60.0, "rel", "engine.prefix_sharing.tenants"),
+        # disaggregated prefill: decode p99 TTFT under a long-prompt
+        # storm relative to the storm-free baseline (lower = flatter;
+        # absolute band — near-1 ratios make relative deltas noise)
+        ("engine.disagg_storm.p99_ratio_disagg_vs_quiet",
+         "lower", 20.0, "abs", "engine.disagg_storm.short_requests"),
+        # speculative decoding: natural-accept tokens/s gain and the
+        # forced-100 verify-path ceiling on the real model
+        ("engine.spec_decode.natural.tokens_per_s_gain_x",
+         "higher", 40.0, "rel", "engine.spec_decode.spec_k"),
+        ("engine.spec_decode.forced_100_real_model"
+         ".tokens_per_s_ceiling_gain_x",
+         "higher", 40.0, "rel", "engine.spec_decode.spec_k"),
     ],
     # sim.json: determinism is verify-sim's job; wall-seconds of a
     # virtual-time suite are not a perf contract.  TPU-only artifacts
