@@ -9,7 +9,7 @@ PY := env -u PALLAS_AXON_POOL_IPS python
 
 .PHONY: all native test test-native verify-all verify-repeat \
 	verify-stress verify-sim verify-trace verify-serving verify-wire \
-	verify-prof bench-diff bench-provenance \
+	verify-prof verify-campaign bench-diff bench-provenance \
 	verify-native-sanitized \
 	check-coverage lint \
 	lint-drill asan \
@@ -78,8 +78,8 @@ verify-repeat: native
 # small N, cache/store coherence after multi-threaded churn — the PR-4
 # control-plane hot path).  Cheaper than verify-repeat (minutes, not an
 # hour), meant to run on every change to locking/queueing code.
-verify-stress: verify-sim verify-trace verify-serving verify-wire \
-	verify-prof bench-diff
+verify-stress: verify-sim verify-campaign verify-trace verify-serving \
+	verify-wire verify-prof bench-diff
 	@for i in 1 2 3 4 5; do \
 		echo "=== verify-stress round $$i/5 ==="; \
 		env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -105,6 +105,25 @@ verify-stress: verify-sim verify-trace verify-serving verify-wire \
 verify-sim:
 	$(PY) benchmarks/sim_scenarios.py --scale small --seed 42
 	@echo "verify-sim: OK"
+
+# Policy-regression gate (docs/policy.md): every named campaign —
+# burst-overload, noisy-neighbor, admission-storm — against the REAL
+# control plane with its full observability loop (metrics recorder,
+# alert evaluator, policy engine) on virtual-time timers: the policy
+# run must BEAT the no-op baseline by the campaign's criteria (SLO
+# attainment, bounded action counts), every actuated decision must
+# carry complete provenance (trigger + exemplar trace ids + profiler
+# digest), and the policy run is executed TWICE with log + decision-
+# ledger digests compared (any nondeterminism fails).  The exported
+# tpfpolicy-v1 decision log is then validated by the CLI.  Artifact:
+# benchmarks/results/sim_campaign.json.  Run on any change to policy/,
+# the alert evaluator, the actuator surfaces (autoscaler / defrag /
+# webhook admission) or the metrics schema.
+verify-campaign:
+	$(PY) benchmarks/sim_campaign.py --scale small --seed 42 \
+		--export-policy-log /tmp/tpfpolicy_verify.json
+	$(PY) -m tools.tpfpolicy check /tmp/tpfpolicy_verify.json
+	@echo "verify-campaign: OK"
 
 # Tracing gate (docs/tracing.md): the tpftrace test suite (span
 # propagation, v4<->v5 interop, SimClock determinism, exemplar->TSDB

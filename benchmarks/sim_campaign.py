@@ -1,0 +1,123 @@
+"""Policy-regression campaign suite (the tpfpolicy gate).
+
+Replays the named campaigns (tensorfusion_tpu/sim/campaign.py) against
+the REAL control plane in simulated time, TWICE per campaign shape:
+policies OFF (the no-op baseline — alerts fire, nothing acts) and
+policies ON (the closed loop actuating through node claims, the
+LiveMigrator, webhook admission control).  Each campaign's policy run
+must BEAT its baseline by the campaign's criteria — SLO attainment,
+bounded action counts — and reproduce byte-identical fingerprints
+(store-event log digest + decision-ledger digest) across a double run.
+
+    python benchmarks/sim_campaign.py [--scale small|medium|large]
+        [--seed N] [--campaign NAME ...]
+        [--export-policy-log PATH]
+
+``make verify-campaign`` runs this headless at tier-1 scale and fails
+on any criteria violation, invariant violation, provenance gap (a
+decision whose evidence chain is incomplete) or determinism break.
+Artifact: benchmarks/results/sim_campaign.json (cells registered in
+tools/bench_diff.py noise bands).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+sys.path.insert(0, ".")  # repo root (benchmarks/ is not a package)
+
+from benchmarks._artifact import previous_artifact, write_artifact  # noqa: E402
+from tensorfusion_tpu.sim import campaign as _campaign  # noqa: E402
+from tensorfusion_tpu.sim.campaign import (CAMPAIGNS,  # noqa: E402
+                                           CRITERIA, run_campaign)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="sim_campaign")
+    ap.add_argument("--scale", default="small",
+                    choices=("small", "medium", "large"))
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--campaign", action="append", default=None,
+                    choices=sorted(CAMPAIGNS),
+                    help="run only the named campaign(s); the "
+                         "sim_campaign.json artifact is NOT rewritten "
+                         "for a subset run")
+    ap.add_argument("--no-determinism-check", action="store_true",
+                    help="skip the second (digest-compare) policy run")
+    ap.add_argument("--export-policy-log", default="",
+                    help="write the LAST campaign's tpfpolicy-v1 "
+                         "decision log here (tools/tpfpolicy.py "
+                         "reads it)")
+    args = ap.parse_args(argv)
+
+    names = args.campaign or sorted(CAMPAIGNS)
+    cells = {}
+    ok = True
+    for name in names:
+        base = run_campaign(name, seed=args.seed, scale=args.scale,
+                            policies=False)
+        pol = run_campaign(name, seed=args.seed, scale=args.scale,
+                           policies=True)
+        deterministic = True
+        if not args.no_determinism_check:
+            pol2 = run_campaign(name, seed=args.seed,
+                                scale=args.scale, policies=True)
+            # BOTH fingerprints: the control-plane story and the
+            # decision history (a nondeterministic ledger is a ledger
+            # you cannot explain from the seed)
+            deterministic = (
+                pol2["log_digest"] == pol["log_digest"]
+                and pol2["ledger_digest"] == pol["ledger_digest"])
+        violations = CRITERIA[name](pol, base)
+        cell_ok = pol["ok"] and base["ok"] and deterministic \
+            and not violations
+        ok &= cell_ok
+        adv = round(pol["score"]["slo_attainment_pct"]
+                    - base["score"]["slo_attainment_pct"], 2)
+        cells[name] = {
+            "ok": cell_ok,
+            "deterministic": deterministic,
+            "baseline": base,
+            "policy": pol,
+            "advantage": {"slo_attainment_pct": adv},
+            "criteria_violations": violations,
+        }
+        print(f"{name:24s} {'ok' if cell_ok else 'FAIL':4s} "
+              f"slo {base['score']['slo_attainment_pct']:6.2f}% -> "
+              f"{pol['score']['slo_attainment_pct']:6.2f}% "
+              f"(+{adv:.2f}pp) decisions={pol['decisions']} "
+              f"migr={pol['score']['migrations']} "
+              f"nodes+={pol['score']['nodes_added']} "
+              f"sheds={pol['score']['admission_sheds']} "
+              f"events={pol['store_events']} "
+              f"wall={pol['wall_seconds']}s"
+              + (f"  {violations[:2]}" if violations else ""))
+
+    if args.export_policy_log:
+        with open(args.export_policy_log, "w") as f:
+            json.dump(_campaign.LAST_POLICY_LOG, f, sort_keys=True,
+                      separators=(",", ":"), default=str)
+            f.write("\n")
+        print(f"policy log -> {args.export_policy_log}")
+
+    result = {
+        "benchmark": "sim_campaign",
+        "scale": args.scale,
+        "seed": args.seed,
+        "ok": ok,
+        "campaigns": cells,
+        "previous": previous_artifact("sim_campaign"),
+    }
+    if args.campaign:
+        print(f"{'OK' if ok else 'FAIL'} (subset run; "
+              f"sim_campaign.json kept)")
+        return 0 if ok else 1
+    path = write_artifact("sim_campaign", result)
+    print(f"{'OK' if ok else 'FAIL'} -> {path}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

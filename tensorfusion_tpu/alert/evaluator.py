@@ -242,23 +242,29 @@ class AlertEvaluator:
             return g
 
         def delta(pts, since):
-            """Counter increase across the window: last sample minus
-            the last sample at-or-before the window start (falling
-            back to the first in-window sample; reset-safe clamp)."""
+            """Counter increase across the window: positive per-step
+            increments summed, RESET-AWARE — a step down (worker
+            restart zeroing its counters) restarts accumulation from
+            the new value, like Prometheus increase().  The previous
+            last-minus-baseline clamp went deaf after a reset: the
+            pre-reset baseline dominated until it aged out of
+            retention, silencing a genuine post-restart burn for up
+            to an hour (found by the policy-loop edge-case battery)."""
             if not pts:
                 return 0.0
-            last = pts[-1]
-            if last.ts < since:
+            if pts[-1].ts < since:
                 return 0.0
-            baseline = None
+            inc = 0.0
+            prev = None
             for p in pts:
                 if p.ts <= since:
-                    baseline = p.value
-                else:
-                    break
-            if baseline is None:
-                baseline = pts[0].value
-            return max(0.0, last.value - baseline)
+                    prev = p.value
+                    continue
+                if prev is not None:
+                    inc += (p.value - prev if p.value >= prev
+                            else p.value)   # reset: growth from zero
+                prev = p.value
+            return inc
 
         ggood, gtotal = group(good), group(total)
         out = []

@@ -10,6 +10,10 @@ Analog of the reference's gin server (``pkg/hypervisor/server/``, port 8000):
 - ``GET  /api/v1/serving``            tpfserve engine snapshots
   (throughput/TTFT, KV pool + prefix-sharing/CoW, KV_SHIP ingest,
   spec-decode accept rates — the TUI's serving pane reads this)
+- ``GET  /api/v1/policy``             tpfpolicy decision ledgers
+  (per-rule counters + every decision's provenance: triggering alert,
+  exemplar trace ids, profiler digest, actuation, outcome — the TUI's
+  policy pane and tools/tpfpolicy.py read this)
 - ``POST /api/v1/workers``            submit a worker (single-node backend)
 - ``DELETE /api/v1/workers/<ns>/<name>``
 - ``POST /api/v1/workers/<ns>/<name>/snapshot|resume|freeze``  live-migration hooks
@@ -56,7 +60,7 @@ class HypervisorServer:
     def __init__(self, devices, workers, backend=None, snapshot_dir="/tmp",
                  provider=None, host: str = "127.0.0.1", port: int = 0,
                  token: str = "", tls_cert: str = "", tls_key: str = "",
-                 remote_workers=()):
+                 remote_workers=(), policy_engines=()):
         self.devices = devices
         self.workers = workers
         self.backend = backend
@@ -65,6 +69,10 @@ class HypervisorServer:
         #: co-hosted RemoteVTPUWorker instances whose dispatch snapshot
         #: /api/v1/dispatch serves (the TUI dispatch pane's feed)
         self.remote_workers = list(remote_workers)
+        #: co-hosted tpfpolicy engines (single-node topology runs the
+        #: operator in-process): /api/v1/policy serves their decision
+        #: ledgers + counters (the TUI policy pane's feed)
+        self.policy_engines = list(policy_engines)
         #: optional shared token — freeze/resume/snapshot mutate worker
         #: state, so a non-loopback bind should set one
         self.token = token
@@ -170,6 +178,11 @@ class HypervisorServer:
         /api/v1/dispatch (workers may start after the server)."""
         self.remote_workers.append(worker)
 
+    def register_policy_engine(self, engine) -> None:
+        """Expose a policy engine's decision ledger via
+        /api/v1/policy (engines may start after the server)."""
+        self.policy_engines.append(engine)
+
     def start(self) -> None:
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name="tpf-hypervisor-http",
@@ -227,6 +240,13 @@ class HypervisorServer:
             h._send(200, [rw.engine.snapshot()
                           for rw in self.remote_workers
                           if getattr(rw, "engine", None) is not None])
+        elif url.path == "/api/v1/policy":
+            # tpfpolicy view (docs/policy.md): decision ledgers with
+            # full provenance (triggering alert, exemplar trace ids,
+            # profiler digest, actuation, outcome) plus per-rule
+            # counters — the TUI's p[o]licy pane and tools/tpfpolicy.py
+            # read this
+            h._send(200, [pe.snapshot() for pe in self.policy_engines])
         elif url.path == "/api/v1/allocations":
             # Pod-resources-proxy analog (pod_resources_proxy.go:87-318):
             # the per-pod device-assignment view monitoring agents

@@ -15,9 +15,9 @@ other UIs are:
   tick, feeds ``TuiState`` and blits the rendered screen.
 
 Keys (reference model.go key map): d=devices w=workers m=metrics
-s=shm-inspector r=remote-dispatch p=profile v=serving, j/k or arrows
-move the selection, enter opens the detail view for the selected row,
-esc goes back, q quits.  The dispatch pane shows the co-hosted
+s=shm-inspector r=remote-dispatch p=profile v=serving o=policy, j/k or
+arrows move the selection, enter opens the detail view for the
+selected row, esc goes back, q quits.  The dispatch pane shows the co-hosted
 remote-vTPU workers' fair-queue state per tenant — queue-wait p50/p99,
 SLO good ratio and the last trace id (docs/tracing.md) — fed by
 /api/v1/dispatch.  The profile pane shows tpfprof's per-tenant
@@ -27,7 +27,10 @@ seconds, overlap efficiency, recent utilization bins
 each co-hosted tpfserve engine — throughput/TTFT, the paged-KV pool
 with prefix-sharing/CoW counters, KV_SHIP ingest volume and
 speculative-decode accept rates (docs/serving.md) — fed by
-/api/v1/serving.
+/api/v1/serving.  The policy pane shows the tpfpolicy closed loop —
+per-rule fired/actuated/resolved counters and the decision-ledger
+tail with triggers, exemplar trace ids and outcomes (docs/policy.md)
+— fed by /api/v1/policy.
 
     python -m tensorfusion_tpu.hypervisor.tui --url http://127.0.0.1:8000
 """
@@ -474,6 +477,57 @@ def render_profile(snapshots: List[dict]) -> str:
     return "\n".join(lines).rstrip()
 
 
+def render_policy(snapshots: List[dict]) -> str:
+    """tpfpolicy pane (docs/policy.md): the closed loop on screen —
+    per-rule fired/actuated/resolved counters and the tail of the
+    decision ledger with each decision's trigger, exemplar trace ids
+    and outcome (`tpfpolicy explain <id>` renders the full record)."""
+    if not snapshots:
+        return "(no policy engines registered on this node)"
+    lines: List[str] = []
+    for snap in snapshots:
+        c = snap.get("counters", {})
+        lines.append(
+            f"== policy@{snap.get('node', '?')} "
+            f"decisions={c.get('decisions_total', 0)} "
+            f"actuated={c.get('actuations_total', 0)} "
+            f"failed={c.get('actuation_failures_total', 0)} "
+            f"resolved={c.get('resolved_total', 0)} "
+            f"pending={c.get('pending', 0)} "
+            f"suppressed={c.get('suppressed_total', 0)} ==")
+        per_rule = snap.get("per_rule", {})
+        if per_rule:
+            lines.append("  RULE                    ACTION          "
+                         "FIRED  ACT  FAIL  RESOLVED  LAST")
+            for name in sorted(per_rule):
+                st = per_rule[name]
+                lines.append(
+                    f"  {name:<23} {str(st.get('action', '-')):<15} "
+                    f"{st.get('fired', 0):5.0f} "
+                    f"{st.get('actuated', 0):4.0f} "
+                    f"{st.get('failed', 0):5.0f} "
+                    f"{st.get('resolved', 0):9.0f} "
+                    f"{st.get('last_value', 0.0):8.2f}")
+        ledger = (snap.get("ledger") or {}).get("decisions", [])
+        if ledger:
+            lines.append("  ID  T          RULE                 "
+                         "TRIGGER                        OUTCOME   "
+                         "EXEMPLARS")
+            for d in ledger[-8:]:
+                ev = d.get("evidence", {})
+                ex = ",".join(ev.get("exemplars", [])[:2]) or "-"
+                out = (d.get("outcome") or {}).get("state", "?")
+                ok = (d.get("actuation") or {}).get("ok")
+                mark = "" if ok else " !"
+                lines.append(
+                    f"  {d.get('id', 0):<3} {d.get('t', 0.0):<10.1f} "
+                    f"{d.get('rule', '?'):<20} "
+                    f"{str(d.get('trigger', '?'))[:30]:<30} "
+                    f"{out:<9}{mark} {ex[:40]}")
+        lines.append("")
+    return "\n".join(lines).rstrip()
+
+
 def render_shm(shm_base: str, selected: int = -1) -> str:
     """The shm inspector dialog (shm_dialog.go analog): raw token-bucket
     state of every worker segment."""
@@ -517,6 +571,7 @@ VIEW_SHM = "shm"
 VIEW_DISPATCH = "dispatch"
 VIEW_PROFILE = "profile"
 VIEW_SERVING = "serving"
+VIEW_POLICY = "policy"
 VIEW_DEVICE_DETAIL = "device_detail"
 VIEW_WORKER_DETAIL = "worker_detail"
 
@@ -541,6 +596,7 @@ class TuiState:
         self.dispatch: List[dict] = []
         self.profile: List[dict] = []
         self.serving: List[dict] = []
+        self.policy: List[dict] = []
         self.device_history: Dict[str, _EntityHistory] = {}
         self.worker_history: Dict[str, _EntityHistory] = {}
         self.last_update = 0.0
@@ -563,6 +619,11 @@ class TuiState:
         """Ingest /api/v1/serving (same degrade-to-empty contract as
         the dispatch pane for servers without the endpoint)."""
         self.serving = snapshots or []
+
+    def update_policy(self, snapshots: List[dict]) -> None:
+        """Ingest /api/v1/policy (same degrade-to-empty contract as
+        the dispatch pane for servers without the endpoint)."""
+        self.policy = snapshots or []
 
     def update(self, devices: List[dict], workers: List[dict]) -> None:
         self.devices, self.workers = devices, workers
@@ -593,11 +654,11 @@ class TuiState:
         """Process one key; returns False to quit."""
         if ch == "q":
             return False
-        if ch in ("d", "w", "m", "s", "r", "p", "v"):
+        if ch in ("d", "w", "m", "s", "r", "p", "v", "o"):
             self.view = {"d": VIEW_DEVICES, "w": VIEW_WORKERS,
                          "m": VIEW_METRICS, "s": VIEW_SHM,
                          "r": VIEW_DISPATCH, "p": VIEW_PROFILE,
-                         "v": VIEW_SERVING}[ch]
+                         "v": VIEW_SERVING, "o": VIEW_POLICY}[ch]
             return True
         if ch == "esc":
             if self.view == VIEW_DEVICE_DETAIL:
@@ -655,6 +716,8 @@ class TuiState:
             return render_profile(self.profile)
         if self.view == VIEW_SERVING:
             return render_serving(self.serving)
+        if self.view == VIEW_POLICY:
+            return render_policy(self.policy)
         if self.view == VIEW_DEVICE_DETAIL:
             d = self._selected_device()
             if d is None:
@@ -676,8 +739,8 @@ class TuiState:
         if self.last_update and WALL.now() - self.last_update > 5:
             stale = f"  (stale {WALL.now() - self.last_update:.0f}s)"
         return ("tpu-fusion hypervisor  [d]evices [w]orkers [m]etrics "
-                "[s]hm [r]emote-dispatch [p]rofile [v]serving  "
-                "j/k+enter detail  esc back  [q]uit" + stale)
+                "[s]hm [r]emote-dispatch [p]rofile [v]serving "
+                "p[o]licy  j/k+enter detail  esc back  [q]uit" + stale)
 
 
 def _clamp(idx: int, n: int) -> int:
@@ -733,6 +796,13 @@ def snapshot(url: str, shm_base: str = "") -> str:
             serving = []
         if serving:
             out += ["", render_serving(serving)]
+        try:
+            policy = _fetch(url, "/api/v1/policy")
+        # tpflint: disable=swallowed-error -- absent endpoint, by design
+        except Exception:  # noqa: BLE001 - older server: no endpoint
+            policy = []
+        if policy:
+            out += ["", render_policy(policy)]
     except Exception as e:  # noqa: BLE001
         out.append(f"(hypervisor unreachable at {url}: {e})")
     if shm_base:
@@ -784,6 +854,12 @@ def run_curses(url: str, shm_base: str, refresh_s: float = 1.0) -> None:
                     # tpflint: disable=swallowed-error -- by design
                     except Exception:  # noqa: BLE001 - old server
                         state.update_serving([])
+                    try:
+                        state.update_policy(
+                            _fetch(url, "/api/v1/policy"))
+                    # tpflint: disable=swallowed-error -- by design
+                    except Exception:  # noqa: BLE001 - old server
+                        state.update_policy([])
                 except Exception as e:  # noqa: BLE001
                     state.error = f"hypervisor unreachable at {url}: {e}"
                 dirty = True

@@ -29,7 +29,7 @@ class MetricsRecorder:
     def __init__(self, operator, tsdb: Optional[TSDB] = None,
                  path: str = "", interval_s: float = 5.0,
                  remote_workers=(), clock: Optional[Clock] = None,
-                 tracers=()):
+                 tracers=(), profilers=()):
         self.operator = operator
         self.clock = clock or default_clock()
         self.tsdb = tsdb or TSDB(clock=self.clock)
@@ -47,6 +47,12 @@ class MetricsRecorder:
         #: aggregates each pass; the operator registers its
         #: control-plane tracer, embedded workers contribute theirs
         self.tracers = list(tracers)
+        #: standalone tpfprof Profiler instances (no owning worker —
+        #: e.g. the campaign twin's per-tenant attribution ledger):
+        #: their ``tpf_prof_*`` series ship each pass exactly like an
+        #: embedded worker's, so profiler-driven alert rules (the
+        #: tenant-skew policy trigger) see them in the TSDB
+        self.profilers = list(profilers)
         self._trace_cursors: Dict[int, int] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -59,6 +65,11 @@ class MetricsRecorder:
         tracer = getattr(worker, "tracer", None)
         if tracer is not None and tracer not in self.tracers:
             self.tracers.append(tracer)
+
+    def register_profiler(self, profiler) -> None:
+        """Start shipping a standalone profiler's attribution series."""
+        if profiler not in self.profilers:
+            self.profilers.append(profiler)
 
     def start(self) -> None:
         self._stop.clear()
@@ -175,10 +186,20 @@ class MetricsRecorder:
             lines.append(encode_line("tpf_quota", tags, fields, ts))
             self.tsdb.insert("tpf_quota", tags, fields, now)
 
-        # scheduler counters
+        # scheduler counters.  waiting_pods is the momentary queue
+        # length; pending_pods is the store-level truth — every pod
+        # routed to our scheduler and still unbound, INCLUDING pods
+        # parked after a capacity miss (the queue is empty for those,
+        # which is exactly why the pods-pending alert keys on this
+        # gauge, docs/policy.md)
+        pending = sum(1 for p in op.store.list(Pod)
+                      if p.spec.scheduler_name ==
+                      constants.SCHEDULER_NAME
+                      and not p.spec.node_name)
         sched_fields = {"scheduled_total": op.scheduler.scheduled_count,
                         "failed_total": op.scheduler.failed_count,
-                        "waiting_pods": len(op.scheduler.waiting_pods())}
+                        "waiting_pods": len(op.scheduler.waiting_pods()),
+                        "pending_pods": pending}
         lines.append(encode_line("tpf_scheduler", {}, sched_fields, ts))
         self.tsdb.insert("tpf_scheduler", {}, sched_fields, now)
 
@@ -217,18 +238,29 @@ class MetricsRecorder:
                 if eng is None:
                     continue
                 esnap = eng.snapshot()
-                eng_ex = {tenant: t.get("last_trace_id", "")
-                          for tenant, t in esnap["tenants"].items()}
                 for line in serving_engine_lines(eng, "operator", ts,
                                                  snap=esnap):
                     lines.append(line)
                     measurement, tags, fields, _ = parse_line(line)
+                    field_ex = None
                     if measurement == "tpf_serving_tenant":
-                        exemplar = eng_ex.get(tags.get("tenant"))
+                        t = esnap["tenants"].get(
+                            tags.get("tenant"), {})
+                        exemplar = t.get("last_trace_id", "")
+                        # the prefix-hit / spec counters link the
+                        # trace that actually took that path, not the
+                        # last-admitted request (docs/tracing.md)
+                        field_ex = {
+                            "prefix_hit_tokens_total":
+                                t.get("last_prefix_trace_id", ""),
+                            "spec_accept_rate":
+                                t.get("last_spec_trace_id", ""),
+                        }
                     else:
                         exemplar = esnap.get("last_trace_id", "")
                     self.tsdb.insert(measurement, tags, fields, now,
-                                     exemplar=exemplar or None)
+                                     exemplar=exemplar or None,
+                                     field_exemplars=field_ex)
 
             # tpfprof attribution series (docs/profiling.md): embedded
             # workers' per-tenant device-time ledgers, same series the
@@ -244,6 +276,33 @@ class MetricsRecorder:
                     lines.append(line)
                     measurement, tags, fields, _ = parse_line(line)
                     self.tsdb.insert(measurement, tags, fields, now)
+
+        # standalone profilers (campaign twin / single-process rigs):
+        # same tpf_prof_* series as embedded workers', so the
+        # tenant-skew alert rule (and the migrate-on-skew policy) can
+        # read attribution from the TSDB wherever it was measured
+        if self.profilers:
+            from ..profiling.export import profile_lines
+            from .encoder import parse_line
+
+            for prof in self.profilers:
+                for line in profile_lines(prof.snapshot(), "operator",
+                                          ts):
+                    lines.append(line)
+                    measurement, tags, fields, _ = parse_line(line)
+                    self.tsdb.insert(measurement, tags, fields, now)
+
+        # tpfpolicy closed-loop counters (docs/policy.md): the policy
+        # engine's own activity ships as tpf_policy_* so dashboards
+        # and alert rules can watch the watcher
+        if getattr(op, "policy", None) is not None:
+            from ..policy.export import policy_lines
+            from .encoder import parse_line
+
+            for line in policy_lines(op.policy, "operator", ts):
+                lines.append(line)
+                measurement, tags, fields, _ = parse_line(line)
+                self.tsdb.insert(measurement, tags, fields, now)
 
         lines.extend(self._trace_span_lines(ts, now))
 

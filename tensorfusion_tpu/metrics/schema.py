@@ -113,6 +113,24 @@ METRICS_SCHEMA = {
                    "transfer_s_total", "queue_s_total",
                    "launches_total", "hbm_resident_bytes"),
     },
+    # tpfpolicy closed-loop engine (tensorfusion_tpu/policy,
+    # docs/policy.md): decision/actuation/outcome counters plus the
+    # per-rule fired/actuated/failed/resolved table, emitted by
+    # policy/export.py:policy_lines via the operator recorder so the
+    # loop's own activity is as queryable as the telemetry driving it.
+    # tools/tpfpolicy.py `check` validates artifacts against these rows
+    "tpf_policy_engine": {
+        "tags": ("node",),
+        "fields": ("decisions_total", "actuations_total",
+                   "actuation_failures_total", "resolved_total",
+                   "suppressed_total", "pending", "rules",
+                   "ledger_dropped"),
+    },
+    "tpf_policy_rule": {
+        "tags": ("node", "rule", "action"),
+        "fields": ("fired_total", "actuated_total", "failed_total",
+                   "resolved_total", "suppressed_total", "last_value"),
+    },
     # operator-side recorder (metrics/recorder.py)
     "tpf_chip_alloc": {
         "tags": ("chip", "node", "pool", "generation"),
@@ -143,6 +161,7 @@ METRICS_SCHEMA = {
     },
     "tpf_scheduler": {
         "tags": (),
-        "fields": ("scheduled_total", "failed_total", "waiting_pods"),
+        "fields": ("scheduled_total", "failed_total", "waiting_pods",
+                   "pending_pods"),
     },
 }

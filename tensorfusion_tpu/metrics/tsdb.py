@@ -50,12 +50,21 @@ class TSDB:
         #: not per field: one request exemplifies every field its line
         #: carried.
         self._exemplars: Dict[tuple, deque] = {}
+        #: FIELD-scoped exemplars: (measurement, tag_key, field) ->
+        #: deque of (ts, trace_id), for counters whose example trace
+        #: is NOT the line's last-admitted request — e.g. the serving
+        #: tenant's prefix-hit and spec-accept counters link the trace
+        #: that actually hit the prefix / took the speculative path,
+        #: so a policy over those SLOs cites the right request
+        self._field_exemplars: Dict[tuple, deque] = {}
 
     # -- ingestion --------------------------------------------------------
 
     def insert(self, measurement: str, tags: Dict[str, str],
                fields: Dict[str, float], ts: Optional[float] = None,
-               exemplar: Optional[str] = None) -> None:
+               exemplar: Optional[str] = None,
+               field_exemplars: Optional[Dict[str, str]] = None
+               ) -> None:
         ts = ts if ts is not None else self.clock.now()
         tag_key = tuple(sorted(tags.items()))
         with self._lock:
@@ -78,6 +87,16 @@ class TSDB:
                     self._exemplars[ekey] = edq
                 if not edq or edq[-1][1] != exemplar:
                     edq.append((ts, str(exemplar)))
+            for field, tid in (field_exemplars or {}).items():
+                if not tid:
+                    continue
+                fkey = (measurement, tag_key, field)
+                fdq = self._field_exemplars.get(fkey)
+                if fdq is None:
+                    fdq = deque(maxlen=self.max_exemplars)
+                    self._field_exemplars[fkey] = fdq
+                if not fdq or fdq[-1][1] != tid:
+                    fdq.append((ts, str(tid)))
 
     def ingest_line(self, line: str) -> None:
         measurement, tags, fields, ts_ns = parse_line(line)
@@ -152,22 +171,41 @@ class TSDB:
     def exemplars(self, measurement: str,
                   tags: Optional[Dict[str, str]] = None,
                   since: Optional[float] = None,
-                  limit: int = 5) -> List[str]:
+                  limit: int = 5,
+                  field: Optional[str] = None) -> List[str]:
         """Most-recent-first trace ids attached to matching series —
         what a firing alert links so "which request was that" has an
-        answer (docs/tracing.md)."""
+        answer (docs/tracing.md).  Pass ``field`` to read a
+        field-scoped exemplar stream (e.g. the prefix-hit counter's
+        own traces) — falls back to the series-level exemplars when
+        the field carries none."""
         now = self.clock.now()
         since = since if since is not None else now - self.retention_s
         found: List[Tuple[float, str]] = []
         with self._lock:
-            for (m, tag_key), dq in self._exemplars.items():
-                if m != measurement:
-                    continue
-                if tags:
-                    kt = dict(tag_key)
-                    if any(kt.get(k) != v for k, v in tags.items()):
+            if field is not None:
+                for (m, tag_key, f), dq in \
+                        self._field_exemplars.items():
+                    if m != measurement or f != field:
                         continue
-                found.extend((ts, tid) for ts, tid in dq if ts >= since)
+                    if tags:
+                        kt = dict(tag_key)
+                        if any(kt.get(k) != v
+                               for k, v in tags.items()):
+                            continue
+                    found.extend((ts, tid) for ts, tid in dq
+                                 if ts >= since)
+            if not found:
+                for (m, tag_key), dq in self._exemplars.items():
+                    if m != measurement:
+                        continue
+                    if tags:
+                        kt = dict(tag_key)
+                        if any(kt.get(k) != v
+                               for k, v in tags.items()):
+                            continue
+                    found.extend((ts, tid) for ts, tid in dq
+                                 if ts >= since)
         out: List[str] = []
         for _, tid in sorted(found, reverse=True):
             if tid not in out:
@@ -215,6 +253,11 @@ class TSDB:
                     edq.popleft()
                 if not edq:
                     del self._exemplars[ekey]
+            for fkey, fdq in list(self._field_exemplars.items()):
+                while fdq and fdq[0][0] < cutoff:
+                    fdq.popleft()
+                if not fdq:
+                    del self._field_exemplars[fkey]
 
 
 def aggregate_values(values, agg: str) -> Optional[float]:

@@ -48,6 +48,8 @@ class Operator:
                  enable_expander: bool = True,
                  enable_metrics: bool = False,
                  enable_autoscaler: bool = False,
+                 enable_policy: bool = False,
+                 policy_rules=None,
                  metrics_path: str = "",
                  alert_rules=None, alert_webhook: str = "",
                  sync_interval_s: float = 2.0,
@@ -68,7 +70,7 @@ class Operator:
         self.indices = IndexAllocator()
         self.parser = WorkloadParser(self.store)
         self.mutator = PodMutator(self.store, self.parser,
-                                  tracer=self.tracer)
+                                  tracer=self.tracer, clock=self.clock)
         self.gang = GangManager(clock=self.clock)
         self.cloud = MockCloudProvider(self.store)
         self.expander = NodeExpander(self.store, enabled=enable_expander,
@@ -143,8 +145,11 @@ class Operator:
         self.tsdb = TSDB(clock=self.clock)
         # alerts (and the default tpf_quota/tpf_pool rules) are fed by
         # the recorder — enabling alerting without it would evaluate
-        # against permanent silence
-        want_alerts = alert_rules is not None or bool(alert_webhook)
+        # against permanent silence; the policy engine in turn rides on
+        # the alert evaluator, so enabling it pulls both in
+        want_policy = enable_policy or policy_rules is not None
+        want_alerts = alert_rules is not None or bool(alert_webhook) \
+            or want_policy
         self.metrics = MetricsRecorder(self, tsdb=self.tsdb,
                                        path=metrics_path,
                                        clock=self.clock,
@@ -155,13 +160,40 @@ class Operator:
         if want_alerts:
             from .alert.evaluator import default_rules
 
+            rules = list(alert_rules) if alert_rules is not None \
+                else default_rules()
+            if want_policy:
+                # the default policy catalog triggers on two alert
+                # rules beyond the evaluator defaults (pods-pending,
+                # tenant-skew); add any not already configured
+                from .policy import alert_rules_for_policies
+
+                have = {r.name for r in rules}
+                rules += [r for r in alert_rules_for_policies()
+                          if r.name not in have]
             self.alerts = AlertEvaluator(
-                self.tsdb,
-                rules=(list(alert_rules) if alert_rules is not None
-                       else default_rules()),
+                self.tsdb, rules=rules,
                 webhook_url=alert_webhook, clock=self.clock)
         else:
             self.alerts = None
+        if want_policy:
+            from .policy import (PolicyEngine, default_actuators,
+                                 default_exemplar_source,
+                                 default_policies)
+            from .profiling.recorder import FlightRecorder
+
+            self.policy = PolicyEngine(
+                self.tsdb, alerts=self.alerts,
+                rules=(list(policy_rules) if policy_rules is not None
+                       else default_policies()),
+                actuators=default_actuators(self),
+                clock=self.clock, tracer=self.tracer,
+                recorder=FlightRecorder(
+                    clock=self.clock,
+                    config={"component": "policy-engine"}),
+                exemplar_source=default_exemplar_source(self))
+        else:
+            self.policy = None
         #: hypervisor metrics files to tail into the TSDB (single-host /
         #: test convenience; the production path is hypervisors PUSHING
         #: lines through the store gateway's metrics ring — see
@@ -261,6 +293,8 @@ class Operator:
             self.autoscaler.start()
         if self.alerts is not None:
             self.alerts.start()
+        if self.policy is not None:
+            self.policy.start()
         # mark components live BEFORE the boot-time config apply: a
         # GlobalConfig that carries alert rules may construct the alert
         # evaluator, and _apply_global_config only starts it when
@@ -330,7 +364,8 @@ class Operator:
         self._stop.set()
         if self.config_watcher is not None:
             self.config_watcher.stop()
-        for component in (self.alerts, self.autoscaler, self.metrics):
+        for component in (self.policy, self.alerts, self.autoscaler,
+                          self.metrics):
             if component is not None:
                 component.stop()
         self.scheduler.stop()
@@ -558,6 +593,10 @@ def main(argv=None, stop_event: Optional[threading.Event] = None) -> int:
     ap.add_argument("--alert-webhook", default="",
                     help="POST firing/resolved alerts here (enables the "
                          "alert evaluator; rules come from --config)")
+    ap.add_argument("--enable-policy", action="store_true",
+                    help="run the tpfpolicy closed-loop engine "
+                         "(default rule catalog; pulls in the metrics "
+                         "recorder + alert evaluator — docs/policy.md)")
     ap.add_argument("--config", default="",
                     help="hot-reloaded GlobalConfig JSON file")
     ap.add_argument("--bootstrap-host", default="",
@@ -602,6 +641,7 @@ def main(argv=None, stop_event: Optional[threading.Event] = None) -> int:
     op = Operator(store=store, metrics_path=args.metrics_path,
                   config_path=args.config,
                   enable_autoscaler=args.enable_autoscaler,
+                  enable_policy=args.enable_policy,
                   alert_webhook=args.alert_webhook)
     # bootstrap the pool: ride out a state store that is still coming up
     # (transport errors retry; a concurrent replica winning the create is

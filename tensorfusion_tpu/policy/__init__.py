@@ -1,0 +1,27 @@
+"""tpfpolicy: the telemetry-driven policy engine (docs/policy.md).
+
+Closes the observability loop: alerts + tpfprof attribution + SLO
+counters drive the actuators that already exist (pool scaling, defrag
+migration, webhook admission control), every decision lands in a
+deterministic provenance ledger, and policies are regression-gated by
+seeded digital-twin campaigns (``make verify-campaign``) before they
+ever touch a real pool.
+"""
+
+from .actions import default_actuators, default_exemplar_source
+from .engine import (ActuationError, PolicyEngine,
+                     alert_rules_for_policies)
+from .export import (load_policy_log, policy_digest, policy_lines,
+                     validate_policy_log, write_policy_log)
+from .ledger import (FAILED, PENDING, RESOLVED, Decision,
+                     DecisionLedger)
+from .rules import AlertPolicyRule, MetricPolicyRule, default_policies
+
+__all__ = [
+    "ActuationError", "AlertPolicyRule", "Decision", "DecisionLedger",
+    "FAILED", "MetricPolicyRule", "PENDING", "PolicyEngine",
+    "RESOLVED", "alert_rules_for_policies", "default_actuators",
+    "default_exemplar_source", "default_policies", "load_policy_log",
+    "policy_digest", "policy_lines", "validate_policy_log",
+    "write_policy_log",
+]

@@ -1,0 +1,155 @@
+"""Default actuator registry: the machinery that already exists,
+exposed to the policy engine as name -> callable.
+
+Nothing here is new capacity-management code — each actuator is a thin,
+provenance-friendly shim over an existing subsystem:
+
+- ``scale_pool``     -> TPUNodeClaim objects the NodeClaimController
+  provisions through the cloud provider (the same path the node
+  expander's capacity-miss flow takes);
+- ``migrate_tenant`` -> :class:`~tensorfusion_tpu.controllers.defrag.
+  LiveMigrator` (snapshot, rebind off the node, restore);
+- ``defrag_node``    -> :meth:`CompactionController.defrag_node`;
+- ``admit_control``  -> the webhook's admission block list
+  (:meth:`~tensorfusion_tpu.webhook.mutator.PodMutator.
+  set_admission_block`);
+- ``autoscale``      -> one immediate VPA autoscaler pass (SLO burn
+  should not wait out the periodic interval).
+
+Actuators either return a JSON-able result dict (recorded in the
+decision ledger) or raise — :class:`~.engine.ActuationError` for
+"ran but could not take effect" (no placement, conflict-exhausted
+store write), anything else for a genuine crash.  Both failure shapes
+auto-capture a FlightRecorder postmortem bundle.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+from typing import Callable, Dict
+
+from .engine import ActuationError
+
+log = logging.getLogger("tpf.policy.actions")
+
+
+def default_exemplar_source(operator) -> Callable:
+    """Evidence fallback: trace ids from pod lifecycle-trace
+    annotations (the webhook stamps ``tpu-fusion.ai/trace`` at
+    admission, docs/tracing.md).  Given a firing group's tags, return
+    the trace ids of the pods that group is about — newest first, so
+    the decision links the requests that were burning when it fired:
+
+    - ``namespace`` tag: that namespace's pods;
+    - ``tenant`` tag shaped ``ns/pod``: that very pod;
+    - no group tags: the currently-unbound pods (the ones waiting)."""
+    from .. import constants
+    from ..api.types import Pod
+
+    def exemplars(group_tags: dict) -> list:
+        store = operator.store
+        pods = []
+        tenant = group_tags.get("tenant", "")
+        if "/" in tenant:
+            ns, name = tenant.split("/", 1)
+            pod = store.try_get(Pod, name, ns)
+            pods = [pod] if pod is not None else []
+        elif group_tags.get("namespace"):
+            pods = store.list(Pod, namespace=group_tags["namespace"])
+        else:
+            pods = [p for p in store.list(Pod)
+                    if not p.spec.node_name]
+        out = []
+        for pod in sorted(pods,
+                          key=lambda p: (-p.metadata.creation_timestamp,
+                                         p.key())):
+            raw = pod.metadata.annotations.get(
+                constants.ANN_TRACE_CONTEXT, "")
+            trace_id = raw.split(":", 1)[0] if raw else ""
+            if trace_id and trace_id not in out:
+                out.append(trace_id)
+            if len(out) >= 3:
+                break
+        return out
+    return exemplars
+
+
+def default_actuators(operator) -> Dict[str, Callable]:
+    """Wire an Operator's existing machinery into the registry."""
+    from ..api.types import TPUNodeClaim, TPUPool
+
+    claim_seq = itertools.count(1)
+
+    def scale_pool(pool: str = "", nodes: int = 1,
+                   generation: str = "v5e", chip_count: int = 4,
+                   **_ignored):
+        """Expand a pool by ``nodes`` node claims; the
+        NodeClaimController provisions them through the cloud
+        provider (chips register via the ChipController watch)."""
+        if not pool:
+            pools = sorted(p.name for p in operator.store.list(TPUPool))
+            if not pools:
+                raise ActuationError("no pool to scale")
+            pool = pools[0]
+        created = []
+        for _ in range(max(int(nodes), 1)):
+            claim = TPUNodeClaim.new(
+                f"policy-scale-{pool}-{next(claim_seq):04d}")
+            claim.spec.pool = pool
+            claim.spec.generation = generation or "v5e"
+            claim.spec.chip_count = int(chip_count)
+            operator.store.create(claim)
+            created.append(claim.name)
+        return {"pool": pool, "claims": created}
+
+    def migrate_tenant(tenant: str = "", namespace: str = "",
+                       pod: str = "", wait_rebind_s: float = 5.0,
+                       **_ignored):
+        """Move the noisy tenant off its node via the LiveMigrator
+        (placement-probed; snapshot/restore when hypervisors exist)."""
+        if tenant and not pod:
+            if "/" not in tenant:
+                raise ActuationError(
+                    f"tenant {tenant!r} is not an ns/pod key")
+            namespace, pod = tenant.split("/", 1)
+        if not pod:
+            raise ActuationError("migrate_tenant needs tenant= or "
+                                 "namespace=/pod=")
+        new_node = operator.migrator.migrate(
+            namespace, pod, wait_rebind_s=wait_rebind_s)
+        if new_node is None:
+            raise ActuationError(
+                f"migration of {namespace}/{pod} did not rebind "
+                f"(no alternative placement, or rebind still pending)")
+        return {"pod": f"{namespace}/{pod}", "new_node": new_node}
+
+    def defrag_node(pool: str = "", node: str = "", **_ignored):
+        """Drain every migratable workload off one node (the defrag
+        controller's evict path, policy-triggered instead of cron)."""
+        if not node:
+            raise ActuationError("defrag_node needs node=")
+        evicted = operator.compaction.defrag_node(pool or "default",
+                                                  node)
+        return {"node": node, "evicted": evicted}
+
+    def admit_control(namespace: str = "", ttl_s: float = 60.0,
+                      **_ignored):
+        """Shed the namespace's new pods at the webhook for a TTL."""
+        if not namespace:
+            raise ActuationError("admit_control needs namespace=")
+        until = operator.mutator.set_admission_block(namespace,
+                                                     ttl_s=ttl_s)
+        return {"namespace": namespace, "until": round(until, 3)}
+
+    def autoscale(**_ignored):
+        """One immediate VPA pass (instead of its periodic interval)."""
+        if operator.autoscaler is None:
+            raise ActuationError("autoscaler not enabled")
+        return {"adjusted": operator.autoscaler.run_once()}
+
+    return {"scale_pool": scale_pool,
+            "migrate_tenant": migrate_tenant,
+            "defrag_node": defrag_node,
+            "admit_control": admit_control,
+            "autoscale": autoscale}

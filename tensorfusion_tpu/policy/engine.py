@@ -1,0 +1,458 @@
+"""The closed-loop policy engine: telemetry in, actuator calls out,
+every decision observable.
+
+The missing half of the observability stack (ROADMAP item 5): the
+platform measures everything — burn-rate alerts, per-tenant device-time
+attribution, trace exemplars — but a human still turned those signals
+into actions.  :class:`PolicyEngine` closes the loop:
+
+- **inputs**: the :class:`~tensorfusion_tpu.alert.evaluator.
+  AlertEvaluator`'s active alerts, tpfprof
+  :class:`~tensorfusion_tpu.profiling.profiler.Profiler` snapshots, and
+  raw TSDB counters (dispatcher/serving SLO series);
+- **rules** (:mod:`.rules`): declarative condition -> action bindings
+  with per-group cooldowns;
+- **actuators**: the machinery that already exists — pool scaling
+  (node claims the NodeClaimController provisions), the defrag
+  controller / LiveMigrator, webhook admission control — injected as a
+  name -> callable registry (:mod:`.actions` wires an Operator's);
+- **provenance**: every actuation lands in the
+  :class:`~.ledger.DecisionLedger` with the triggering alert, <=3
+  exemplar trace ids, and the tpfprof digest at decision time; a
+  ``policy.decide``/``policy.actuate`` span pair joins the control
+  plane's traces; ``tpf_policy_*`` series ship through the metrics
+  recorders; actuation failures auto-capture a FlightRecorder
+  postmortem bundle (docs/profiling.md).
+
+Everything is clock-seamed: under the digital twin the engine steps on
+SimClock timers and same-seed campaigns produce byte-identical ledgers
+(``make verify-campaign``, docs/policy.md).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..alert.evaluator import _OPS, AlertRule
+from ..clock import Clock, default_clock
+from ..metrics.tsdb import aggregate_values
+from .ledger import PENDING, RESOLVED, DecisionLedger
+from .rules import AlertPolicyRule, MetricPolicyRule
+
+log = logging.getLogger("tpf.policy")
+
+
+class ActuationError(Exception):
+    """Raised by actuators that ran but could not take effect (e.g. a
+    migration that found no alternative placement, a store
+    read-modify-write that exhausted its conflict retries).  The
+    engine records the failure in the ledger and captures a postmortem
+    bundle exactly as for an unexpected raise — the distinction is for
+    readers of the ledger, not for control flow."""
+
+
+def alert_rules_for_policies() -> List[AlertRule]:
+    """Alert rules the default policy catalog triggers on, beyond the
+    evaluator's own defaults: sustained unschedulable-pod pressure and
+    per-tenant attributed device-time skew.  Appended to the
+    evaluator's rule set when the policy engine is enabled (the rules
+    are harmless without it — they just page)."""
+    return [
+        AlertRule(name="pods-pending", measurement="tpf_scheduler",
+                  metric_field="pending_pods", agg="last", op=">",
+                  threshold=0.0, window_s=60.0, for_s=4.0,
+                  severity="warning",
+                  summary="pods waiting unschedulable (capacity or "
+                          "constraints)"),
+        AlertRule(name="tenant-skew", measurement="tpf_prof_tenant",
+                  metric_field="device_share_pct", agg="last", op=">",
+                  threshold=40.0, window_s=60.0, for_s=2.0,
+                  group_by=["tenant"], severity="warning",
+                  summary="tenant's attributed device-time share "
+                          "crossed the skew threshold"),
+    ]
+
+
+class PolicyEngine:
+    def __init__(self, tsdb, alerts=None, rules: Optional[list] = None,
+                 actuators: Optional[Dict[str, Callable]] = None,
+                 profilers=(), clock: Optional[Clock] = None,
+                 tracer=None, recorder=None,
+                 exemplar_source: Optional[Callable] = None,
+                 interval_s: float = 15.0,
+                 ledger_len: int = 512,
+                 node_name: str = "operator"):
+        self.tsdb = tsdb
+        self.alerts = alerts
+        self.rules = list(rules or [])
+        self.actuators: Dict[str, Callable] = dict(actuators or {})
+        #: tpfprof Profiler instances whose digest is frozen into every
+        #: decision's evidence (the "what was the attribution picture
+        #: when we acted" link, docs/profiling.md)
+        self.profilers = list(profilers)
+        self.clock = clock or default_clock()
+        self.tracer = tracer
+        #: FlightRecorder: decision/actuation events land in the
+        #: "policy" ring, and actuation FAILURES auto-capture a
+        #: postmortem bundle — not just alert firings and crashes
+        self.recorder = recorder
+        #: fallback evidence source when the trigger carries no
+        #: exemplars of its own: callable(group_tags) -> [trace_id, ..]
+        #: (the Operator wiring reads pod lifecycle-trace annotations)
+        self.exemplar_source = exemplar_source
+        self.interval_s = interval_s
+        self.node_name = node_name
+        self.ledger = DecisionLedger(clock=self.clock,
+                                     maxlen=ledger_len)
+        # per-(rule, group) last actuation time (cooldown bookkeeping)
+        self._last_actuation: Dict[tuple, float] = {}
+        # decision id -> (rule_name, group) for the outcome pass
+        self._open: Dict[int, tuple] = {}
+        # -- counters (read by policy_lines/snapshot) ---------------------
+        self.decisions_total = 0
+        self.actuations_total = 0
+        self.actuation_failures_total = 0
+        self.resolved_total = 0
+        self.suppressed_total = 0
+        self._per_rule: Dict[str, Dict[str, float]] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="tpf-policy", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.evaluate_once()
+            except Exception:
+                log.exception("policy evaluation failed")
+
+    # -- trigger evaluation -----------------------------------------------
+
+    def _alert_rule_of(self, name: str):
+        """The evaluator rule object backing an AlertPolicyRule (its
+        group_by names the tags the group tuple carries)."""
+        if self.alerts is None:
+            return None
+        for rule in self.alerts.rules:
+            if rule.name == name:
+                return rule
+        return None
+
+    def _firing_groups(self, rule: AlertPolicyRule
+                       ) -> List[Tuple[tuple, dict, dict, float]]:
+        """[(group, group_tags, trigger_evidence, value)] for every
+        active alert of the named evaluator rule.  The alert's own
+        exemplar trace ids ride in the evidence."""
+        if self.alerts is None:
+            return []
+        src = self._alert_rule_of(rule.alert_rule)
+        group_by = list(getattr(src, "group_by", []) or []) \
+            if src is not None else []
+        out = []
+        for key in sorted(self.alerts.active):
+            if key[0] != rule.alert_rule:
+                continue
+            alert = self.alerts.active[key]
+            group = key[1]
+            group_tags = dict(zip(group_by, group))
+            evidence = {"alert": alert.rule,
+                        "severity": alert.severity,
+                        "value": alert.value,
+                        "threshold": alert.threshold,
+                        "since": alert.since,
+                        "summary": alert.summary,
+                        "exemplars": list(alert.exemplars)}
+            out.append((group, group_tags, evidence, alert.value))
+        return out
+
+    @staticmethod
+    def _metric_delta(pts, since: float) -> float:
+        """Counter increase over the window: positive per-step
+        increments summed, reset-aware (same contract as the burn-rate
+        evaluator's delta — a counter reset restarts accumulation from
+        the new value instead of silencing the window)."""
+        if not pts:
+            return 0.0
+        if pts[-1].ts < since:
+            return 0.0
+        inc = 0.0
+        prev = None
+        for p in pts:
+            if p.ts <= since:
+                prev = p.value
+                continue
+            if prev is not None:
+                inc += (p.value - prev if p.value >= prev
+                        else p.value)       # reset: growth from zero
+            prev = p.value
+        return inc
+
+    def _metric_groups(self, rule: MetricPolicyRule, now: float
+                       ) -> List[Tuple[tuple, dict, dict, float]]:
+        since = now - rule.window_s
+        # counters need the last-before-window baseline, so the query
+        # spans retention; plain aggregates only read the window
+        q_since = now - max(self.tsdb.retention_s, rule.window_s * 2) \
+            if rule.counter_delta else since
+        series = self.tsdb.query(rule.measurement, rule.metric_field,
+                                 tags=rule.tags or None,
+                                 since=q_since, until=now)
+        groups: Dict[tuple, list] = {}
+        for tags, pts in series:
+            key = tuple(tags.get(g, "") for g in rule.group_by)
+            groups.setdefault(key, []).append((tags, pts))
+        out = []
+        for key in sorted(groups):
+            if rule.counter_delta:
+                value: Optional[float] = sum(
+                    self._metric_delta(pts, since)
+                    for _, pts in groups[key])
+            else:
+                values = [p.value for _, pts in groups[key]
+                          for p in pts if p.ts >= since]
+                value = aggregate_values(values, rule.agg) \
+                    if values else None
+            if value is None:
+                continue
+            if not _OPS.get(rule.op, _OPS[">"])(value, rule.threshold):
+                continue
+            group_tags = dict(zip(rule.group_by, key))
+            evidence = {"measurement": rule.measurement,
+                        "field": rule.metric_field,
+                        "agg": ("delta" if rule.counter_delta
+                                else rule.agg),
+                        "op": rule.op,
+                        "value": round(value, 6),
+                        "threshold": rule.threshold,
+                        "window_s": rule.window_s}
+            out.append((key, group_tags, evidence, value))
+        return out
+
+    def _triggered(self, rule, now: float):
+        if isinstance(rule, AlertPolicyRule):
+            return self._firing_groups(rule)
+        return self._metric_groups(rule, now)
+
+    def _trigger_measurement(self, rule) -> str:
+        """The TSDB measurement whose exemplars justify this rule."""
+        if isinstance(rule, MetricPolicyRule):
+            return rule.measurement
+        src = self._alert_rule_of(rule.alert_rule)
+        return getattr(src, "measurement", "") if src is not None else ""
+
+    def _gather_exemplars(self, rule, group_tags: dict,
+                          evidence: dict) -> List[str]:
+        """<=3 example trace ids: the firing alert's own exemplars,
+        else the trigger series' TSDB exemplars, else the injected
+        fallback source (pod lifecycle-trace annotations)."""
+        own = evidence.get("exemplars")
+        if own:
+            return list(own)[:3]
+        measurement = self._trigger_measurement(rule)
+        if measurement:
+            found = self.tsdb.exemplars(measurement,
+                                        tags=group_tags or None,
+                                        limit=3)
+            if found:
+                return found
+        if self.exemplar_source is not None:
+            try:
+                return list(self.exemplar_source(group_tags) or [])[:3]
+            except Exception:  # noqa: BLE001 - evidence is best-effort
+                log.debug("exemplar source failed", exc_info=True)
+        return []
+
+    def _profile_evidence(self) -> List[dict]:
+        digests = []
+        for prof in self.profilers:
+            try:
+                digests.append({"profiler": prof.name,
+                                "digest": prof.digest()})
+            except Exception:  # noqa: BLE001 - evidence is best-effort
+                log.debug("profiler digest failed", exc_info=True)
+        return digests
+
+    # -- the loop body ----------------------------------------------------
+
+    def evaluate_once(self, now: Optional[float] = None) -> list:
+        """One policy pass: trigger -> decide -> actuate -> ledger,
+        then settle pending decisions whose trigger cleared.  Returns
+        the Decision records created this pass."""
+        now = now if now is not None else self.clock.now()
+        made = []
+        for rule in self.rules:
+            stats = self._per_rule.setdefault(
+                rule.name, {"action": rule.action, "fired": 0,
+                            "actuated": 0, "failed": 0, "resolved": 0,
+                            "suppressed": 0, "last_value": 0.0})
+            for group, group_tags, evidence, value in \
+                    self._triggered(rule, now):
+                stats["fired"] += 1
+                stats["last_value"] = round(float(value), 6)
+                last = self._last_actuation.get((rule.name,
+                                                 tuple(group)))
+                if last is not None and now - last < rule.cooldown_s:
+                    stats["suppressed"] += 1
+                    self.suppressed_total += 1
+                    continue
+                made.append(self._decide_and_actuate(
+                    rule, group, group_tags, evidence, now, stats))
+        self._settle_outcomes(now)
+        return made
+
+    def _decide_and_actuate(self, rule, group, group_tags, evidence,
+                            now, stats):
+        trigger = evidence.get("alert") or (
+            f"{evidence.get('measurement')}.{evidence.get('field')} "
+            f"{evidence.get('op')} {evidence.get('threshold')}")
+        exemplars = self._gather_exemplars(rule, group_tags, evidence)
+        full_evidence = {
+            "trigger": {k: v for k, v in evidence.items()
+                        if k != "exemplars"},
+            "exemplars": exemplars,
+            "profile": self._profile_evidence(),
+        }
+        decide_ctx = None
+        if self.tracer is not None:
+            with self.tracer.span(
+                    "policy.decide",
+                    attrs={"rule": rule.name, "action": rule.action,
+                           "trigger": str(trigger),
+                           "value": evidence.get("value")}) as span:
+                decide_ctx = span.ctx()
+        decision = self.ledger.record(rule.name, rule.action,
+                                      str(trigger), group=group,
+                                      evidence=full_evidence)
+        self.decisions_total += 1
+        # actuator kwargs: group tags mapped through arg_tags (identity
+        # over all group tags when unset), plus the rule's static args
+        args = dict(rule.static_args)
+        mapping = rule.arg_tags or {k: k for k in group_tags}
+        for tag, kwarg in mapping.items():
+            if tag in group_tags:
+                args[kwarg] = group_tags[tag]
+        self._actuate(rule, decision, args, decide_ctx, stats)
+        self._last_actuation[(rule.name, tuple(group))] = now
+        self._open[decision.id] = (rule.name, tuple(group))
+        if self.recorder is not None:
+            self.recorder.note("policy", "decide", rule=rule.name,
+                               action=rule.action,
+                               decision=decision.id,
+                               trigger=str(trigger),
+                               group=list(group))
+        return decision
+
+    def _actuate(self, rule, decision, args, decide_ctx, stats) -> None:
+        def call():
+            fn = self.actuators.get(rule.action)
+            if fn is None:
+                raise ActuationError(
+                    f"no actuator registered for {rule.action!r}")
+            return fn(**args)
+
+        ok, result, error = False, None, ""
+        try:
+            if self.tracer is not None:
+                with self.tracer.span(
+                        "policy.actuate", parent=decide_ctx,
+                        attrs={"rule": rule.name,
+                               "action": rule.action,
+                               "decision": decision.id}):
+                    result = call()
+            else:
+                result = call()
+            ok = True
+        except Exception as e:  # noqa: BLE001 - failure IS the record
+            error = f"{type(e).__name__}: {e}"
+            log.warning("policy %s: actuator %s failed: %s",
+                        rule.name, rule.action, error)
+        self.actuations_total += 1
+        stats["actuated"] += 1
+        if ok:
+            log.info("policy %s: %s(%s) -> %s [decision %d]",
+                     rule.name, rule.action,
+                     ", ".join(f"{k}={v}" for k, v in sorted(
+                         args.items())), result, decision.id)
+        else:
+            self.actuation_failures_total += 1
+            stats["failed"] += 1
+        self.ledger.actuated(decision.id, rule.action, args, ok,
+                             result=result, error=error)
+        if not ok and self.recorder is not None:
+            # postmortem on actuation failure (an actuator raise or a
+            # store read-modify-write that exhausted its conflict
+            # retries), same black-box contract as alert firings and
+            # crashes: freeze the rings + TSDB tail + the decision
+            self.recorder.note("policy", "actuate-failed",
+                               rule=rule.name, action=rule.action,
+                               decision=decision.id, error=error)
+            self.recorder.auto_bundle(
+                f"policy-actuate-{rule.name}", tsdb=self.tsdb,
+                extra={"decision": self.ledger.to_dict(decision)})
+
+    def _settle_outcomes(self, now: float) -> None:
+        """Mark pending decisions resolved once their trigger is no
+        longer firing (the observed-outcome half of the ledger)."""
+        still_firing = set()
+        for rule in self.rules:
+            for group, *_ in self._triggered(rule, now):
+                still_firing.add((rule.name, tuple(group)))
+        for did in sorted(self._open):
+            d = self.ledger.get(did)
+            if d is None or d.outcome.get("state") != PENDING:
+                self._open.pop(did, None)
+                continue
+            key = self._open[did]
+            if key in still_firing:
+                continue
+            self.ledger.settle(did, RESOLVED,
+                               detail="trigger no longer firing")
+            self.resolved_total += 1
+            stats = self._per_rule.get(d.rule)
+            if stats is not None:
+                stats["resolved"] += 1
+            if self.recorder is not None:
+                self.recorder.note("policy", "resolved", decision=did,
+                                   rule=d.rule)
+            self._open.pop(did, None)
+
+    # -- reading ----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The /api/v1/policy + TUI + tpfpolicy view: counters, the
+        per-rule table, and the full decision ledger."""
+        return {
+            "node": self.node_name,
+            "interval_s": self.interval_s,
+            "rules": [{"name": r.name, "action": r.action,
+                       "kind": type(r).__name__,
+                       "cooldown_s": r.cooldown_s,
+                       "summary": r.summary} for r in self.rules],
+            "counters": {
+                "decisions_total": self.decisions_total,
+                "actuations_total": self.actuations_total,
+                "actuation_failures_total":
+                    self.actuation_failures_total,
+                "resolved_total": self.resolved_total,
+                "suppressed_total": self.suppressed_total,
+                "pending": len(self.ledger.pending()),
+            },
+            "per_rule": {name: dict(st)
+                         for name, st in sorted(
+                             self._per_rule.items())},
+            "ledger": self.ledger.snapshot(),
+        }

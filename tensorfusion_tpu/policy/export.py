@@ -1,0 +1,137 @@
+"""tpfpolicy artifact format + the ``tpf_policy_*`` influx line builder.
+
+One exported policy log is a self-describing artifact (mirroring the
+tpfprof-v1 discipline):
+
+- ``snapshot``: the raw :meth:`~.engine.PolicyEngine.snapshot` dict —
+  counters, per-rule table, and the full decision ledger with
+  provenance (what ``tpfpolicy log/explain`` read);
+- ``lines``: the same counters as ``tpf_policy_engine`` /
+  ``tpf_policy_rule`` influx lines (exactly what the metrics recorder
+  ships), so ``tpfpolicy check`` validates the runtime artifact
+  against ``METRICS_SCHEMA``;
+- ``digest``: sha256 of the canonical snapshot — equality across
+  same-seed campaign runs is the determinism contract
+  (``make verify-campaign``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, Dict, List, Optional
+
+from ..metrics.encoder import encode_line
+
+FORMAT = "tpfpolicy-v1"
+
+
+def policy_lines(engine, node_name: str, ts: int) -> List[str]:
+    """Influx lines for one policy engine: aggregate
+    ``tpf_policy_engine`` (decision/actuation/outcome counters, ledger
+    accounting) plus per-rule ``tpf_policy_rule`` (fired / actuated /
+    failed / resolved / cooldown-suppressed counters and the last
+    trigger value).  Shipped by the operator-side MetricsRecorder so
+    the loop's own activity is as queryable as the telemetry that
+    drives it (docs/metrics-schema.md)."""
+    snap = engine.snapshot()
+    c = snap["counters"]
+    tags = {"node": node_name}
+    lines = [encode_line(
+        "tpf_policy_engine", tags,
+        {"decisions_total": c["decisions_total"],
+         "actuations_total": c["actuations_total"],
+         "actuation_failures_total": c["actuation_failures_total"],
+         "resolved_total": c["resolved_total"],
+         "suppressed_total": c["suppressed_total"],
+         "pending": c["pending"],
+         "rules": len(snap["rules"]),
+         "ledger_dropped": snap["ledger"]["dropped"]}, ts)]
+    for name, st in sorted(snap["per_rule"].items()):
+        lines.append(encode_line(
+            "tpf_policy_rule",
+            dict(tags, rule=name, action=str(st.get("action", ""))),
+            {"fired_total": st["fired"],
+             "actuated_total": st["actuated"],
+             "failed_total": st["failed"],
+             "resolved_total": st["resolved"],
+             "suppressed_total": st["suppressed"],
+             "last_value": st["last_value"]}, ts))
+    return lines
+
+
+def to_doc(engine, node_name: str = "operator",
+           meta: Optional[dict] = None) -> Dict[str, Any]:
+    snap = engine.snapshot()
+    doc = {
+        "format": FORMAT,
+        "meta": dict(meta or {}),
+        "node": node_name,
+        "snapshot": snap,
+        "lines": policy_lines(engine, node_name, 0),
+        "digest": policy_digest(snap),
+    }
+    return doc
+
+
+def dumps(doc: Dict[str, Any]) -> str:
+    return json.dumps(doc, sort_keys=True, separators=(",", ":"),
+                      default=str) + "\n"
+
+
+def write_policy_log(path: str, engine, node_name: str = "operator",
+                     meta: Optional[dict] = None) -> str:
+    with open(path, "w") as f:
+        f.write(dumps(to_doc(engine, node_name=node_name, meta=meta)))
+    return path
+
+
+def load_policy_log(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        return json.load(f)
+
+
+def policy_digest(snapshot: dict) -> str:
+    doc = json.dumps(snapshot, sort_keys=True,
+                     separators=(",", ":"), default=str)
+    return hashlib.sha256(doc.encode()).hexdigest()
+
+
+def validate_policy_log(doc: Dict[str, Any]) -> List[str]:
+    """Structural errors in an exported policy log: format, ledger
+    shape, and — the provenance contract — every ACTUATED decision
+    must resolve to its trigger, an exemplar list, and profiler
+    evidence fields (``tpfpolicy check`` exit-codes on these)."""
+    errors: List[str] = []
+    if doc.get("format") != FORMAT:
+        errors.append(f"format is {doc.get('format')!r}, "
+                      f"expected {FORMAT!r}")
+        return errors
+    snap = doc.get("snapshot")
+    if not isinstance(snap, dict):
+        errors.append("snapshot missing")
+        return errors
+    ledger = snap.get("ledger") or {}
+    for d in ledger.get("decisions", ()):
+        did = d.get("id", "?")
+        if not d.get("rule") or not d.get("action"):
+            errors.append(f"decision {did}: missing rule/action")
+        if not d.get("trigger"):
+            errors.append(f"decision {did}: missing trigger")
+        ev = d.get("evidence")
+        if not isinstance(ev, dict) or "trigger" not in ev:
+            errors.append(f"decision {did}: missing trigger evidence")
+            continue
+        if "exemplars" not in ev:
+            errors.append(f"decision {did}: missing exemplar list")
+        if "profile" not in ev:
+            errors.append(f"decision {did}: missing profiler evidence")
+        act = d.get("actuation")
+        if not isinstance(act, dict) or "actuator" not in act:
+            errors.append(f"decision {did}: missing actuation record")
+        out = d.get("outcome")
+        if not isinstance(out, dict) or "state" not in out:
+            errors.append(f"decision {did}: missing outcome")
+    if doc.get("digest") and doc["digest"] != policy_digest(snap):
+        errors.append("digest mismatch (snapshot was edited?)")
+    return errors

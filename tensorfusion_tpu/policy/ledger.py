@@ -1,0 +1,164 @@
+"""Decision ledger: the bounded, deterministic record of every policy
+action the engine ever took.
+
+FlightRecorder-style ring (oldest-first conflation with a dropped
+counter), but each entry is a full **provenance record** rather than a
+free-form event: the rule that fired, the evidence that justified it
+(the triggering alert or metric condition, up to 3 exemplar trace ids,
+the tpfprof attribution digest at decision time), the actuator call
+made (name, args, ok/error), and the observed outcome (resolved /
+failed / still pending).  ``tools/tpfpolicy.py explain <id>`` renders
+one record end to end — the "why did the platform do that" answer the
+reference leaves in operator chat logs.
+
+Determinism contract (the ``verify-campaign`` battery): ids come from a
+counter, timestamps from the injectable Clock, and :meth:`digest` is a
+sha256 over the canonical JSON snapshot — two same-seed campaign runs
+must produce byte-identical ledgers.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..clock import Clock, default_clock
+
+#: default ledger capacity — decisions are rare (cooldown-bounded), so
+#: this is hours of policy history, not seconds
+DEFAULT_LEDGER_LEN = 512
+
+#: outcome states a decision moves through
+PENDING = "pending"        # actuated, condition not yet re-checked clear
+RESOLVED = "resolved"      # the triggering condition cleared afterwards
+FAILED = "failed"          # the actuator raised / reported failure
+
+
+@dataclass
+class Decision:
+    """One closed-loop action with its full provenance."""
+
+    id: int
+    t: float                           # clock.now() at decision time
+    rule: str                          # policy rule name
+    action: str                        # actuator registry key
+    #: what fired: the rendered alert name or the metric condition
+    trigger: str
+    #: group key the rule fired for (e.g. ("storm",) per-namespace)
+    group: List[str] = field(default_factory=list)
+    #: evidence: triggering alert dict (or metric condition dict),
+    #: exemplar trace ids (<=3) and the tpfprof digest at decision time
+    evidence: Dict[str, object] = field(default_factory=dict)
+    #: actuator call record: {"actuator", "args", "ok", "error",
+    #: "result"}
+    actuation: Dict[str, object] = field(default_factory=dict)
+    #: {"state": pending|resolved|failed, "t": float, "detail": str}
+    outcome: Dict[str, object] = field(default_factory=dict)
+
+
+class DecisionLedger:
+    def __init__(self, clock: Optional[Clock] = None,
+                 maxlen: int = DEFAULT_LEDGER_LEN):
+        self.clock = clock or default_clock()
+        self.maxlen = max(int(maxlen), 1)
+        self._lock = threading.Lock()
+        # guarded by: _lock
+        self._decisions: "OrderedDict[int, Decision]" = OrderedDict()
+        # guarded by: _lock
+        self._seq = 0
+        # guarded by: _lock
+        self.dropped = 0
+
+    # -- recording --------------------------------------------------------
+
+    def record(self, rule: str, action: str, trigger: str,
+               group=(), evidence: Optional[dict] = None) -> Decision:
+        """Open a new decision record; the engine fills ``actuation``
+        and ``outcome`` via :meth:`actuated` / :meth:`settle`."""
+        with self._lock:
+            self._seq += 1
+            d = Decision(id=self._seq, t=round(self.clock.now(), 9),
+                         rule=rule, action=action, trigger=trigger,
+                         group=list(group),
+                         evidence=dict(evidence or {}),
+                         outcome={"state": PENDING, "t": 0.0,
+                                  "detail": ""})
+            self._decisions[d.id] = d
+            while len(self._decisions) > self.maxlen:
+                self._decisions.popitem(last=False)
+                self.dropped += 1
+            return d
+
+    def actuated(self, decision_id: int, actuator: str, args: dict,
+                 ok: bool, result=None, error: str = "") -> None:
+        with self._lock:
+            d = self._decisions.get(decision_id)
+            if d is None:
+                return
+            d.actuation = {"actuator": actuator,
+                           "args": {k: args[k] for k in sorted(args)},
+                           "ok": bool(ok),
+                           "result": result,
+                           "error": error}
+            if not ok:
+                d.outcome = {"state": FAILED,
+                             "t": round(self.clock.now(), 9),
+                             "detail": error or "actuation failed"}
+
+    def settle(self, decision_id: int, state: str,
+               detail: str = "") -> None:
+        """Stamp the observed outcome of a pending decision."""
+        with self._lock:
+            d = self._decisions.get(decision_id)
+            if d is None or d.outcome.get("state") != PENDING:
+                return
+            d.outcome = {"state": state,
+                         "t": round(self.clock.now(), 9),
+                         "detail": detail}
+
+    # -- reading ----------------------------------------------------------
+
+    def get(self, decision_id: int) -> Optional[Decision]:
+        with self._lock:
+            return self._decisions.get(decision_id)
+
+    def decisions(self) -> List[Decision]:
+        """Oldest-first list (bounded by maxlen)."""
+        with self._lock:
+            return list(self._decisions.values())
+
+    def pending(self) -> List[Decision]:
+        with self._lock:
+            return [d for d in self._decisions.values()
+                    if d.outcome.get("state") == PENDING]
+
+    def snapshot(self) -> dict:
+        """Canonical JSON-ready view (the /api/v1/policy + tpfpolicy
+        feed): every decision as a plain dict, plus drop accounting."""
+        with self._lock:
+            return {
+                "decisions": [self.to_dict(d)
+                              for d in self._decisions.values()],
+                "dropped": self.dropped,
+                "capacity": self.maxlen,
+                "total_recorded": self._seq,
+            }
+
+    @staticmethod
+    def to_dict(d: Decision) -> dict:
+        return {"id": d.id, "t": d.t, "rule": d.rule,
+                "action": d.action, "trigger": d.trigger,
+                "group": list(d.group),
+                "evidence": d.evidence, "actuation": d.actuation,
+                "outcome": d.outcome}
+
+    def digest(self) -> str:
+        """sha256 of the canonical snapshot — the campaign determinism
+        fingerprint (same seed => identical decision history)."""
+        doc = json.dumps(self.snapshot(), sort_keys=True,
+                         separators=(",", ":"), default=str)
+        return hashlib.sha256(doc.encode()).hexdigest()

@@ -1,0 +1,129 @@
+"""Declarative policy rules: telemetry condition -> actuator call.
+
+Two trigger shapes, mirroring the alert evaluator's two rule shapes
+(docs/policy.md has the full catalog and how-to-add guide):
+
+- :class:`AlertPolicyRule` — fires when a named
+  :class:`~tensorfusion_tpu.alert.evaluator.AlertRule` /
+  ``BurnRateRule`` is actively firing.  The alert IS the evidence: the
+  decision ledger records the alert's value/threshold/severity and its
+  exemplar trace ids.  This is the preferred shape — thresholds,
+  windows and hysteresis live in ONE place (the alert rule), and
+  anything a human would be paged for can drive an action.
+- :class:`MetricPolicyRule` — a direct TSDB condition for counters no
+  alert rule covers (e.g. repeated BUSY sheds on the serving engine):
+  aggregate (or counter-delta) over a trailing window vs a threshold,
+  optionally grouped by tags.  tpflint's ``metrics-schema`` checker
+  verifies the literal ``measurement``/``metric_field`` pair against
+  METRICS_SCHEMA exactly like it does for ``AlertRule`` — a policy
+  over a renamed series fails ``make lint``, not silently in prod.
+
+Both map the trigger's group tags into actuator kwargs via
+``arg_tags`` (e.g. ``{"namespace": "namespace"}`` passes the firing
+group's namespace to ``admit_control``) and merge ``static_args``.
+``cooldown_s`` bounds actuation frequency per (rule, group) — the
+anti-flapping contract the evaluator's multi-window burn rules give
+alerts, applied to actions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+
+@dataclass
+class AlertPolicyRule:
+    name: str
+    #: structural name of the AlertRule/BurnRateRule this rides on
+    #: (state keys in AlertEvaluator.active are (rule_name, group))
+    alert_rule: str
+    #: actuator registry key (docs/policy.md actuator table)
+    action: str
+    #: alert group tag -> actuator kwarg (identity mapping by default:
+    #: {"namespace": "namespace"})
+    arg_tags: Dict[str, str] = field(default_factory=dict)
+    #: fixed kwargs merged into every actuation of this rule
+    static_args: Dict[str, object] = field(default_factory=dict)
+    #: min seconds between actuations per (rule, group)
+    cooldown_s: float = 60.0
+    #: outcome check: how long after actuating before a still-firing
+    #: trigger may re-actuate is cooldown_s; how long before a cleared
+    #: trigger marks the decision resolved is the next evaluation
+    summary: str = ""
+
+
+@dataclass
+class MetricPolicyRule:
+    name: str
+    measurement: str
+    metric_field: str
+    agg: str = "mean"                 # mean|max|min|sum|count|pNN|last
+    op: str = ">"                     # > | >= | < | <= | ==
+    threshold: float = 0.0
+    window_s: float = 300.0
+    #: True: evaluate the counter INCREASE over window_s (reset-safe,
+    #: like the burn-rate delta) instead of aggregating raw samples —
+    #: the shape for _total counters such as busy_rejected_total
+    counter_delta: bool = False
+    tags: Dict[str, str] = field(default_factory=dict)
+    group_by: List[str] = field(default_factory=list)
+    action: str = ""
+    arg_tags: Dict[str, str] = field(default_factory=dict)
+    static_args: Dict[str, object] = field(default_factory=dict)
+    cooldown_s: float = 60.0
+    summary: str = ""
+
+
+def default_policies() -> list:
+    """The shipped closed-loop rule catalog (docs/policy.md):
+
+    - **scale-on-burn**: sustained unschedulable-pod pressure (the
+      ``pods-pending`` alert over ``tpf_scheduler.waiting_pods``, or
+      any SLO burn wired to it) scales the pool by one node claim per
+      cooldown window until the alert resolves.
+    - **migrate-on-skew**: a tenant's attributed device-time share
+      crossing the ``tenant-skew`` alert threshold (``tpf_prof_tenant.
+      device_share_pct``) migrates that tenant off its node — the
+      defrag controller's evict-and-reschedule driven by tpfprof
+      attribution instead of a cron.
+    - **admit-control-on-shed**: repeated BUSY sheds on the serving
+      engine (counter delta over 60s) or a namespace's quota-pressure
+      alert admission-blocks the offending tenant/namespace at the
+      webhook for a TTL — backpressure moved to the cheapest point.
+    """
+    return [
+        AlertPolicyRule(
+            name="scale-on-burn", alert_rule="pods-pending",
+            action="scale_pool",
+            static_args={"nodes": 1},
+            cooldown_s=10.0,
+            summary="unschedulable-pod pressure: expand the pool by "
+                    "one node claim per cooldown window"),
+        AlertPolicyRule(
+            name="migrate-on-skew", alert_rule="tenant-skew",
+            action="migrate_tenant",
+            arg_tags={"tenant": "tenant"},
+            cooldown_s=30.0,
+            summary="attributed device-time share skew: migrate the "
+                    "noisy tenant off its node"),
+        AlertPolicyRule(
+            name="admit-control-on-shed", alert_rule="quota-pressure",
+            action="admit_control",
+            arg_tags={"namespace": "namespace"},
+            static_args={"ttl_s": 30.0},
+            cooldown_s=30.0,
+            summary="namespace burning through its quota threshold: "
+                    "shed its new pods at admission for a TTL"),
+        MetricPolicyRule(
+            name="admit-control-on-busy",
+            measurement="tpf_serving_engine",
+            metric_field="busy_rejected_total",
+            counter_delta=True, op=">", threshold=16.0,
+            window_s=60.0, group_by=["node"],
+            action="admit_control",
+            static_args={"namespace": "", "ttl_s": 30.0},
+            cooldown_s=60.0,
+            summary="serving engine shedding BUSY repeatedly: "
+                    "admission-control new load for a TTL"),
+    ]

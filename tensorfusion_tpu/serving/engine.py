@@ -165,7 +165,8 @@ class Sequence:
 class _TenantStats:
     __slots__ = ("qos", "tokens", "ttft", "slo_good", "slo_total",
                  "last_trace_id", "prefix_hit_tokens", "spec_proposed",
-                 "spec_accepted")
+                 "spec_accepted", "last_prefix_trace_id",
+                 "last_spec_trace_id")
 
     def __init__(self, qos: str):
         self.qos = qos
@@ -177,6 +178,14 @@ class _TenantStats:
         self.prefix_hit_tokens = 0
         self.spec_proposed = 0
         self.spec_accepted = 0
+        #: trace of the last request that ADOPTED a shared prefix /
+        #: decoded speculatively — the field-scoped TSDB exemplars for
+        #: prefix_hit_tokens_total and spec_accept_rate (the metrics
+        #: recorder attaches them, docs/tracing.md), so policies over
+        #: serving SLOs cite the request that took that path rather
+        #: than whichever admission happened last
+        self.last_prefix_trace_id = ""
+        self.last_spec_trace_id = ""
 
 
 class ServingEngine:
@@ -693,6 +702,9 @@ class ServingEngine:
                 if wait_ms <= slo_ms:
                     st.slo_good += 1
                 st.prefix_hit_tokens += seq.prefix_matched
+                if seq.prefix_matched and seq.trace:
+                    st.last_prefix_trace_id = str(
+                        seq.trace.get("trace_id", ""))
             for seq in shed:
                 st = self._tenants.setdefault(seq.tenant,
                                               _TenantStats(seq.qos))
@@ -902,6 +914,9 @@ class ServingEngine:
                                               _TenantStats(seq.qos))
                 st.spec_proposed += len(prop)
                 st.spec_accepted += j
+                if seq.trace:
+                    st.last_spec_trace_id = str(
+                        seq.trace.get("trace_id", ""))
             # rejected speculative positions: roll the block-table
             # high-water mark back to the accepted context
             self.account.truncate(seq.sid, seq.context_len())
@@ -1101,7 +1116,9 @@ class ServingEngine:
                        "spec_accept_rate": round(
                            st.spec_accepted / st.spec_proposed, 6)
                        if st.spec_proposed else 0.0,
-                       "last_trace_id": st.last_trace_id}
+                       "last_trace_id": st.last_trace_id,
+                       "last_prefix_trace_id": st.last_prefix_trace_id,
+                       "last_spec_trace_id": st.last_spec_trace_id}
                 for name, st in self._tenants.items()}
             spec = {
                 "k": self.spec_k,

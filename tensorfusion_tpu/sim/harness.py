@@ -124,6 +124,16 @@ class SimHarness:
         if self.metrics_interval_s > 0 and op.metrics is not None:
             self._timers.append(self.clock.call_later(
                 self.metrics_interval_s, self._metrics_tick))
+        # the rest of the observability loop runs on virtual-time
+        # timers too: alert evaluation and — when the operator carries
+        # a policy engine — the closed-loop policy pass, each at its
+        # own production interval (docs/policy.md campaign contract)
+        if self.op.alerts is not None:
+            self._timers.append(self.clock.call_later(
+                self.op.alerts.interval_s, self._alerts_tick))
+        if getattr(self.op, "policy", None) is not None:
+            self._timers.append(self.clock.call_later(
+                self.op.policy.interval_s, self._policy_tick))
         self._started = True
         self.pump()
 
@@ -269,6 +279,34 @@ class SimHarness:
             self.clock.call_later(self.metrics_interval_s,
                                   self._metrics_tick))
 
+    def _alerts_tick(self) -> None:
+        if self._stopped:
+            return
+        if not self.partitioned and self.op.alerts is not None:
+            try:
+                self.op.alerts.evaluate_once()
+            except Exception:
+                log.exception("sim: alert pass failed")
+        self._timers.append(
+            self.clock.call_later(self.op.alerts.interval_s,
+                                  self._alerts_tick))
+
+    def _policy_tick(self) -> None:
+        if self._stopped:
+            return
+        policy = getattr(self.op, "policy", None)
+        if not self.partitioned and policy is not None:
+            try:
+                decisions = policy.evaluate_once()
+                for d in decisions:
+                    self.log_note("policy", d.rule, d.action,
+                                  ",".join(d.group))
+            except Exception:
+                log.exception("sim: policy pass failed")
+        self._timers.append(
+            self.clock.call_later(self.op.policy.interval_s,
+                                  self._policy_tick))
+
     # -- stepping ---------------------------------------------------------
 
     def _reconcile(self, c, ev) -> None:
@@ -352,6 +390,11 @@ class SimHarness:
         live = self.live_nodes()
         for wl in self.store.list(TPUWorkload):
             if wl.spec.dynamic_replicas:
+                continue
+            if wl.spec.is_local_tpu or wl.spec.embedded_worker:
+                # client-pod profile records (webhook-admitted
+                # standalone pods): no worker replicas are ever spawned
+                # for these, same skip the WorkloadController applies
                 continue
             desired = max(wl.spec.replicas, 0)
             pods = self.store.list(

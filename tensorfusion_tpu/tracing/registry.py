@@ -61,6 +61,15 @@ SPAN_SCHEMA = {
     "serving.spec_verify": {
         "attrs": ("batch", "k", "accepted"),
     },
+    # -- policy engine (tpfpolicy closed loop, docs/policy.md): one
+    # decide/actuate pair per ledger decision, linked to the decision
+    # id so `tpfpolicy explain` and the trace agree
+    "policy.decide": {
+        "attrs": ("rule", "action", "trigger", "value"),
+    },
+    "policy.actuate": {
+        "attrs": ("rule", "action", "decision"),
+    },
     # -- control-plane pod lifecycle (admission -> schedule -> bind)
     "webhook.admit": {
         "attrs": ("pod", "pool", "qos", "workload"),

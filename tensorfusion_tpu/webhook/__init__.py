@@ -1,4 +1,4 @@
 """Admission webhook: annotation parsing + pod mutation."""
 
-from .mutator import PodMutator
+from .mutator import AdmissionShedError, PodMutator
 from .parser import ParseError, WorkloadParser

@@ -24,6 +24,7 @@ from typing import Optional
 
 from .. import constants
 from ..api.types import Pod, TPUWorkload
+from ..clock import Clock, default_clock
 from ..store import ObjectStore, mutate
 from .auto_migration import (native_chip_request,
                              progressive_migration_enabled,
@@ -33,12 +34,28 @@ from .parser import ParseError, WorkloadParser
 log = logging.getLogger("tpf.webhook")
 
 
+class AdmissionShedError(Exception):
+    """The namespace is under policy-driven admission control: the pod
+    is shed at the webhook instead of entering the scheduler queue
+    (the cheapest point to apply backpressure — the admission analog
+    of the dispatcher's BUSY + retry_after_ms, docs/policy.md)."""
+
+    def __init__(self, namespace: str, retry_after_s: float):
+        super().__init__(
+            f"namespace {namespace!r} is admission-controlled; "
+            f"retry after {retry_after_s:.1f}s")
+        self.namespace = namespace
+        self.retry_after_s = retry_after_s
+
+
 class PodMutator:
     def __init__(self, store: ObjectStore, parser: WorkloadParser,
-                 operator_url: str = "", tracer=None):
+                 operator_url: str = "", tracer=None,
+                 clock: Optional[Clock] = None):
         self.store = store
         self.parser = parser
         self.operator_url = operator_url
+        self.clock = clock or default_clock()
         self.mutated_count = 0
         #: optional tracing.Tracer: admission is the ROOT of a pod's
         #: lifecycle trace — the webhook.admit span's context is
@@ -49,6 +66,63 @@ class PodMutator:
         self.auto_migration: dict = {}
         self._counters: dict = {}
         self._counter_lock = threading.Lock()
+        #: policy-driven admission control (tpfpolicy admit_control
+        #: actuator): namespace -> block-expiry clock.now() timestamp
+        # guarded by: _counter_lock
+        self._admission_blocks: dict = {}
+        #: pods shed by admission control, total and per namespace
+        # guarded by: _counter_lock
+        self.admission_shed_total = 0
+        # guarded by: _counter_lock
+        self.admission_sheds: dict = {}
+
+    # -- policy-driven admission control --------------------------------
+
+    def set_admission_block(self, namespace: str,
+                            ttl_s: float = 60.0) -> float:
+        """Shed new tpu-fusion pods of ``namespace`` until now+ttl.
+        Returns the expiry timestamp (re-arming extends, never
+        shortens, so overlapping policy actuations compose)."""
+        until = self.clock.now() + max(float(ttl_s), 0.0)
+        with self._counter_lock:
+            until = max(until, self._admission_blocks.get(namespace,
+                                                          0.0))
+            self._admission_blocks[namespace] = until
+        log.warning("admission control: shedding new pods of %r "
+                    "for %.1fs", namespace, ttl_s)
+        return until
+
+    def clear_admission_block(self, namespace: str) -> None:
+        with self._counter_lock:
+            self._admission_blocks.pop(namespace, None)
+
+    def admission_blocked(self, namespace: str) -> float:
+        """Seconds of block remaining (0 = not blocked); expired
+        entries are reaped on read."""
+        now = self.clock.now()
+        with self._counter_lock:
+            until = self._admission_blocks.get(namespace, 0.0)
+            if until and until <= now:
+                del self._admission_blocks[namespace]
+                return 0.0
+            return max(until - now, 0.0)
+
+    def admission_control_snapshot(self) -> dict:
+        with self._counter_lock:
+            return {"blocked": dict(self._admission_blocks),
+                    "shed_total": self.admission_shed_total,
+                    "sheds": dict(self.admission_sheds)}
+
+    def _shed_if_blocked(self, pod: Pod) -> None:
+        ns = pod.metadata.namespace
+        remaining = self.admission_blocked(ns)
+        if remaining <= 0.0:
+            return
+        with self._counter_lock:
+            self.admission_shed_total += 1
+            self.admission_sheds[ns] = \
+                self.admission_sheds.get(ns, 0) + 1
+        raise AdmissionShedError(ns, remaining)
 
     def handle(self, pod: Pod) -> Pod:
         """Mutate a pod on admission; raises ParseError on bad requests."""
@@ -91,6 +165,11 @@ class PodMutator:
             # matching the reference (admission.Errored on parse failure,
             # pod_webhook.go:144-147)
             raise
+        # policy-driven admission control: a namespace under active
+        # admit-control sheds HERE, before any workload/annotation
+        # state is created for the pod (AdmissionShedError carries
+        # retry_after, mirroring the dispatcher's BUSY contract)
+        self._shed_if_blocked(pod)
         ann = pod.metadata.annotations
 
         # grey release: only mutate the first N replicas of a counter key

@@ -411,3 +411,117 @@ def test_flat_rule_honors_evaluation_time():
     # and outside the simulated window it does not fire
     ev2 = AlertEvaluator(db, rules=ev.rules)
     assert ev2.evaluate_once(now=t0 + 500) == []
+
+
+# -- alert edge cases the policy loop depends on (docs/policy.md) ----------
+
+
+def test_burn_rate_counter_reset_mid_window_does_not_fire():
+    """A worker restart resets its good/total counters mid-window.
+    The reset-aware delta must neither fire on the negative step (the
+    old clamp was safe there) nor GO DEAF afterwards: before this
+    round the pre-reset baseline dominated last-minus-baseline until
+    it aged out of retention, silencing any genuine post-restart burn
+    — a policy riding this rule would have sat on its hands."""
+    from tensorfusion_tpu.alert import AlertEvaluator
+    from tensorfusion_tpu.alert.evaluator import BurnRateRule
+
+    db = TSDB()
+    ev = AlertEvaluator(db, rules=[BurnRateRule(
+        name="burn", measurement="m", good_field="good",
+        total_field="total", objective=0.99,
+        windows=((300.0, 14.4),))])
+    now = time.time()
+    tags = {"tenant": "a"}
+    # healthy history, then a restart: counters drop, traffic healthy
+    db.insert("m", tags, {"good": 990.0, "total": 1000.0}, now - 400)
+    db.insert("m", tags, {"good": 6.0, "total": 6.0}, now - 50)
+    assert ev.evaluate_once(now=now) == []
+    # post-restart traffic resumes INSIDE the window and genuinely
+    # burns: it must fire even though the pre-reset baseline is still
+    # in retention (reset-awareness, not just clamping)
+    db.insert("m", tags, {"good": 10.0, "total": 106.0}, now - 1)
+    changed = ev.evaluate_once(now=now)
+    assert [(a.rule, a.state) for a in changed] == [("burn", "firing")]
+
+
+def test_burn_exactly_at_threshold_does_not_fire():
+    """The multi-window burn comparison is strictly greater-than: a
+    burn landing exactly ON the threshold holds fire (the SRE-workbook
+    pairing pages on breach, not on touch) — and one epsilon past it
+    pages."""
+    from tensorfusion_tpu.alert import AlertEvaluator
+    from tensorfusion_tpu.alert.evaluator import BurnRateRule
+
+    db = TSDB()
+    rule = BurnRateRule(name="edge", measurement="m",
+                        good_field="good", total_field="total",
+                        objective=0.99, windows=((300.0, 14.4),))
+    now = time.time()
+    tags = {"tenant": "a"}
+    # bad rate exactly 0.144 -> burn exactly 14.4x the 1% budget
+    db.insert("m", tags, {"good": 0.0, "total": 0.0}, now - 299)
+    db.insert("m", tags, {"good": 8560.0, "total": 10000.0}, now - 1)
+    ev = AlertEvaluator(db, rules=[rule])
+    assert ev.evaluate_once(now=now) == []
+    # one more bad request tips strictly past the threshold
+    db.insert("m", tags, {"good": 8560.0, "total": 10001.0}, now)
+    changed = ev.evaluate_once(now=now)
+    assert [(a.rule, a.state) for a in changed] == [("edge", "firing")]
+
+
+def test_alert_resolve_then_refire_cycles_cleanly():
+    """Breach -> fire -> recover -> resolve -> breach again -> a FRESH
+    firing alert (same structural key, new history entry).  The state
+    machine must not wedge after a resolve, and for_s hysteresis must
+    re-apply on the second cycle."""
+    from tensorfusion_tpu.alert import AlertEvaluator, AlertRule
+
+    db = TSDB()
+    ev = AlertEvaluator(db, rules=[AlertRule(
+        name="cyc", measurement="m", metric_field="v", agg="last",
+        op=">", threshold=10.0, window_s=120.0, for_s=5.0)])
+    t0 = time.time()
+    db.insert("m", {}, {"v": 50.0}, t0)
+    assert ev.evaluate_once(now=t0 + 1) == []       # for_s gating
+    changed = ev.evaluate_once(now=t0 + 7)
+    assert [(a.rule, a.state) for a in changed] == [("cyc", "firing")]
+    db.insert("m", {}, {"v": 1.0}, t0 + 10)
+    changed = ev.evaluate_once(now=t0 + 11)
+    assert [(a.rule, a.state) for a in changed] == [("cyc", "resolved")]
+    # refire: hysteresis applies again (no instant flap on one sample)
+    db.insert("m", {}, {"v": 60.0}, t0 + 20)
+    assert ev.evaluate_once(now=t0 + 21) == []
+    changed = ev.evaluate_once(now=t0 + 27)
+    assert [(a.rule, a.state) for a in changed] == [("cyc", "firing")]
+    assert [a.state for a in ev.history] == ["firing", "resolved",
+                                             "firing"]
+
+
+def test_resolve_refire_does_not_flap_policy_actuator():
+    """The loop contract on a flapping trigger: each firing cycle may
+    actuate at most once per cooldown window, however many times the
+    alert resolves and refires inside it."""
+    from tensorfusion_tpu.alert import AlertEvaluator, AlertRule
+    from tensorfusion_tpu.policy import AlertPolicyRule, PolicyEngine
+
+    db = TSDB()
+    ev = AlertEvaluator(db, rules=[AlertRule(
+        name="flap", measurement="m", metric_field="v", agg="last",
+        op=">", threshold=10.0, window_s=600.0)])
+    calls = []
+    eng = PolicyEngine(db, alerts=ev,
+                       rules=[AlertPolicyRule(
+                           name="act-on-flap", alert_rule="flap",
+                           action="a", cooldown_s=100.0)],
+                       actuators={"a": lambda **kw: calls.append(1)})
+    t0 = time.time()
+    for k in range(4):                    # 4 fire/resolve cycles
+        db.insert("m", {}, {"v": 99.0}, t0 + 20 * k + 1)
+        ev.evaluate_once(now=t0 + 20 * k + 2)
+        eng.evaluate_once(now=t0 + 20 * k + 2)
+        db.insert("m", {}, {"v": 0.0}, t0 + 20 * k + 10)
+        ev.evaluate_once(now=t0 + 20 * k + 11)
+        eng.evaluate_once(now=t0 + 20 * k + 11)
+    assert len(calls) == 1                # cooldown held across flaps
+    assert eng.suppressed_total == 3

@@ -1,0 +1,548 @@
+"""tpfpolicy: the telemetry-driven policy engine + decision provenance.
+
+Layers, bottom-up:
+
+- :class:`DecisionLedger` ring discipline (bounded, conflating,
+  digest-stable);
+- :class:`PolicyEngine` trigger shapes (alert-backed, metric
+  counter-delta), cooldown suppression, outcome settling, spans,
+  ``tpf_policy_*`` schema conformance;
+- actuation-failure postmortems: an actuator raise or a
+  conflict-exhausted store write auto-captures a FlightRecorder
+  bundle (not just alert firings and crashes);
+- the webhook admission-control gate the ``admit_control`` actuator
+  drives;
+- Operator wiring (``enable_policy=True``), the hypervisor
+  ``/api/v1/policy`` surface + TUI pane, and the tpfpolicy CLI;
+- the three named campaigns: each policy demonstrably beats the no-op
+  baseline with deterministic digests and complete provenance
+  (``make verify-campaign`` runs the same suite headless).
+
+All CPU, tier-1.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from tensorfusion_tpu.alert.evaluator import AlertEvaluator, AlertRule
+from tensorfusion_tpu.clock import Clock
+from tensorfusion_tpu.metrics.tsdb import TSDB
+from tensorfusion_tpu.policy import (ActuationError, AlertPolicyRule,
+                                     DecisionLedger, MetricPolicyRule,
+                                     PolicyEngine, default_policies,
+                                     load_policy_log, policy_lines,
+                                     validate_policy_log,
+                                     write_policy_log)
+from tensorfusion_tpu.profiling.recorder import (FlightRecorder,
+                                                 verify_bundle)
+from tensorfusion_tpu.tracing import Tracer
+
+
+class FakeClock(Clock):
+    """Settable clock for cooldown/TTL arithmetic."""
+
+    def __init__(self, t0: float = 1000.0):
+        self.t = t0
+
+    def now(self) -> float:
+        return self.t
+
+    def now_ns(self) -> int:
+        return int(self.t * 1e9)
+
+    def monotonic(self) -> float:
+        return self.t
+
+    def sleep(self, seconds: float) -> None:
+        self.t += seconds
+
+    def wait(self, event, timeout=None):
+        return event.wait(0)
+
+
+def _pending_rule(**kw):
+    defaults = dict(name="pods-pending", measurement="tpf_scheduler",
+                    metric_field="pending_pods", agg="last", op=">",
+                    threshold=0.0, window_s=60.0, for_s=0.0)
+    defaults.update(kw)
+    return AlertRule(**defaults)
+
+
+def _engine(tsdb, alerts, rules, actuators, **kw):
+    return PolicyEngine(tsdb, alerts=alerts, rules=rules,
+                        actuators=actuators, **kw)
+
+
+# -- ledger ----------------------------------------------------------------
+
+
+def test_ledger_bounded_ring_conflates_oldest_and_digests():
+    clock = FakeClock()
+    led = DecisionLedger(clock=clock, maxlen=4)
+    for i in range(7):
+        d = led.record(f"r{i}", "a", "t")
+        led.actuated(d.id, "a", {}, ok=True)
+    snap = led.snapshot()
+    assert [d["id"] for d in snap["decisions"]] == [4, 5, 6, 7]
+    assert snap["dropped"] == 3
+    assert snap["total_recorded"] == 7
+    # digest is canonical: identical content => identical digest
+    led2 = DecisionLedger(clock=FakeClock(), maxlen=4)
+    for i in range(7):
+        d = led2.record(f"r{i}", "a", "t")
+        led2.actuated(d.id, "a", {}, ok=True)
+    assert led.digest() == led2.digest()
+
+
+def test_ledger_settle_only_moves_pending():
+    led = DecisionLedger(clock=FakeClock())
+    d = led.record("r", "a", "t")
+    led.actuated(d.id, "a", {}, ok=False, error="boom")
+    assert led.get(d.id).outcome["state"] == "failed"
+    led.settle(d.id, "resolved")          # failed stays failed
+    assert led.get(d.id).outcome["state"] == "failed"
+
+
+# -- trigger shapes + cooldown ---------------------------------------------
+
+
+def test_alert_rule_fires_actuates_and_settles():
+    clock = FakeClock()
+    tsdb = TSDB(clock=clock)
+    tsdb.insert("tpf_scheduler", {}, {"pending_pods": 7}, clock.now())
+    ev = AlertEvaluator(tsdb, rules=[_pending_rule()], clock=clock)
+    ev.evaluate_once()
+    calls = []
+    eng = _engine(tsdb, ev,
+                  [AlertPolicyRule(name="scale-on-burn",
+                                   alert_rule="pods-pending",
+                                   action="scale_pool",
+                                   static_args={"nodes": 2},
+                                   cooldown_s=30.0)],
+                  {"scale_pool": lambda **kw: calls.append(kw) or
+                   {"ok": True}},
+                  clock=clock)
+    made = eng.evaluate_once()
+    assert len(made) == 1 and calls == [{"nodes": 2}]
+    d = made[0]
+    assert d.trigger == "pods-pending"
+    assert d.evidence["trigger"]["value"] == 7
+    assert d.actuation["ok"] is True
+    assert d.outcome["state"] == "pending"
+    # cooldown suppresses while the alert keeps firing
+    clock.t += 10
+    assert eng.evaluate_once() == []
+    assert eng.suppressed_total == 1
+    # recovery: alert resolves -> outcome settles resolved
+    tsdb.insert("tpf_scheduler", {}, {"pending_pods": 0}, clock.now())
+    ev.evaluate_once()
+    eng.evaluate_once()
+    assert eng.ledger.get(d.id).outcome["state"] == "resolved"
+    assert eng.resolved_total == 1
+
+
+def test_alert_refire_after_cooldown_actuates_again():
+    clock = FakeClock()
+    tsdb = TSDB(clock=clock)
+    tsdb.insert("tpf_scheduler", {}, {"pending_pods": 3}, clock.now())
+    ev = AlertEvaluator(tsdb, rules=[_pending_rule()], clock=clock)
+    ev.evaluate_once()
+    calls = []
+    eng = _engine(tsdb, ev,
+                  [AlertPolicyRule(name="scale-on-burn",
+                                   alert_rule="pods-pending",
+                                   action="a", cooldown_s=20.0)],
+                  {"a": lambda **kw: calls.append(1)}, clock=clock)
+    eng.evaluate_once()
+    clock.t += 21
+    tsdb.insert("tpf_scheduler", {}, {"pending_pods": 4}, clock.now())
+    ev.evaluate_once(now=clock.now())
+    eng.evaluate_once()
+    assert len(calls) == 2          # still firing past cooldown: act
+
+
+def test_metric_rule_counter_delta_reset_safe():
+    """The counter-delta trigger (repeated BUSY sheds) fires on the
+    windowed increase, not the raw value — and a counter reset
+    mid-window (worker restart) clamps to zero instead of firing on
+    garbage."""
+    clock = FakeClock()
+    tsdb = TSDB(clock=clock)
+    rule = MetricPolicyRule(
+        name="admit-control-on-busy",
+        measurement="tpf_serving_engine",
+        metric_field="busy_rejected_total", counter_delta=True,
+        op=">", threshold=10.0, window_s=60.0, group_by=["node"],
+        action="admit", static_args={"namespace": "storm"},
+        cooldown_s=1.0)
+    calls = []
+    eng = _engine(tsdb, None, [rule],
+                  {"admit": lambda **kw: calls.append(kw)},
+                  clock=clock)
+    tags = {"node": "n1", "engine": "e"}
+    # steady counter: delta 5 over the window -> below threshold
+    tsdb.insert("tpf_serving_engine", tags,
+                {"busy_rejected_total": 100}, clock.now() - 70)
+    tsdb.insert("tpf_serving_engine", tags,
+                {"busy_rejected_total": 105}, clock.now())
+    assert eng.evaluate_once() == []
+    # burst: +30 inside the window -> fires, args carry the group tag
+    tsdb.insert("tpf_serving_engine", tags,
+                {"busy_rejected_total": 140}, clock.now())
+    made = eng.evaluate_once()
+    assert len(made) == 1
+    assert calls[-1]["namespace"] == "storm"
+    # counter RESET (worker restart): past the window the restarted
+    # counter's small value must read as ~zero increase, not as
+    # garbage vs the stale baseline...
+    clock.t += 70
+    tsdb.insert("tpf_serving_engine", tags,
+                {"busy_rejected_total": 2}, clock.now())
+    assert eng.evaluate_once() == []
+    # ...and a genuine post-reset burst still fires (reset-awareness
+    # is not deafness: increments resume from the new value)
+    tsdb.insert("tpf_serving_engine", tags,
+                {"busy_rejected_total": 30}, clock.now())
+    assert len(eng.evaluate_once()) == 1
+
+
+# -- actuation failure postmortems (satellite: FlightRecorder) -------------
+
+
+def test_actuator_raise_records_failure_and_bundles(tmp_path):
+    """An actuator that raises marks the decision FAILED and
+    auto-captures a postmortem bundle — actuation failures are
+    black-box events like alert firings and crashes."""
+    clock = FakeClock()
+    tsdb = TSDB(clock=clock)
+    tsdb.insert("tpf_scheduler", {}, {"pending_pods": 1}, clock.now())
+    ev = AlertEvaluator(tsdb, rules=[_pending_rule()], clock=clock)
+    ev.evaluate_once()
+    rec = FlightRecorder(clock=clock, bundle_dir=str(tmp_path))
+
+    def broken(**kw):
+        raise ActuationError("no placement anywhere")
+
+    eng = _engine(tsdb, ev,
+                  [AlertPolicyRule(name="r", alert_rule="pods-pending",
+                                   action="x", cooldown_s=0.0)],
+                  {"x": broken}, clock=clock, recorder=rec)
+    made = eng.evaluate_once()
+    d = made[0]
+    assert d.actuation["ok"] is False
+    assert "no placement" in d.actuation["error"]
+    assert d.outcome["state"] == "failed"
+    assert eng.actuation_failures_total == 1
+    bundles = sorted(tmp_path.glob("bundle-*"))
+    assert len(bundles) == 1 and "policy-actuate-r" in bundles[0].name
+    assert verify_bundle(str(bundles[0])) == []
+    extra = json.loads((bundles[0] / "extra.json").read_text())
+    assert extra["decision"]["id"] == d.id
+    kinds = [e["kind"] for e in
+             json.loads((bundles[0] / "rings.json").read_text())
+             ["policy"]["events"]]
+    assert "actuate-failed" in kinds
+
+
+def test_conflict_exhausted_store_write_bundles(tmp_path):
+    """A conflict-exhausted read-modify-write inside an actuator (the
+    mutate() retry loop giving up) surfaces exactly like a raise: a
+    FAILED decision plus a postmortem bundle."""
+    from tensorfusion_tpu.store import ConflictError
+
+    clock = FakeClock()
+    tsdb = TSDB(clock=clock)
+    tsdb.insert("tpf_scheduler", {}, {"pending_pods": 1}, clock.now())
+    ev = AlertEvaluator(tsdb, rules=[_pending_rule()], clock=clock)
+    ev.evaluate_once()
+    rec = FlightRecorder(clock=clock, bundle_dir=str(tmp_path))
+
+    def conflicted(**kw):
+        raise ConflictError("version 4 != 7 after 4 retries")
+
+    eng = _engine(tsdb, ev,
+                  [AlertPolicyRule(name="r", alert_rule="pods-pending",
+                                   action="x", cooldown_s=0.0)],
+                  {"x": conflicted}, clock=clock, recorder=rec)
+    d = eng.evaluate_once()[0]
+    assert d.outcome["state"] == "failed"
+    assert "ConflictError" in d.actuation["error"]
+    assert len(list(tmp_path.glob("bundle-*"))) == 1
+
+
+def test_missing_actuator_is_a_failure_not_a_crash():
+    clock = FakeClock()
+    tsdb = TSDB(clock=clock)
+    tsdb.insert("tpf_scheduler", {}, {"pending_pods": 1}, clock.now())
+    ev = AlertEvaluator(tsdb, rules=[_pending_rule()], clock=clock)
+    ev.evaluate_once()
+    eng = _engine(tsdb, ev,
+                  [AlertPolicyRule(name="r", alert_rule="pods-pending",
+                                   action="nope", cooldown_s=0.0)],
+                  {}, clock=clock)
+    d = eng.evaluate_once()[0]
+    assert d.actuation["ok"] is False
+    assert "no actuator registered" in d.actuation["error"]
+
+
+# -- spans + metrics schema ------------------------------------------------
+
+
+def test_policy_spans_decide_actuate_pair():
+    clock = FakeClock()
+    tsdb = TSDB(clock=clock)
+    tsdb.insert("tpf_scheduler", {}, {"pending_pods": 1}, clock.now())
+    ev = AlertEvaluator(tsdb, rules=[_pending_rule()], clock=clock)
+    ev.evaluate_once()
+    tracer = Tracer(service="policy-test", clock=clock, sample=1.0)
+    eng = _engine(tsdb, ev,
+                  [AlertPolicyRule(name="r", alert_rule="pods-pending",
+                                   action="a", cooldown_s=0.0)],
+                  {"a": lambda **kw: None}, clock=clock, tracer=tracer)
+    eng.evaluate_once()
+    spans = {s["name"]: s for s in tracer.finished()}
+    assert {"policy.decide", "policy.actuate"} <= set(spans)
+    # the actuate span parents under its decide span's trace
+    assert spans["policy.actuate"]["trace_id"] == \
+        spans["policy.decide"]["trace_id"]
+    assert spans["policy.decide"]["attrs"]["rule"] == "r"
+    assert spans["policy.actuate"]["attrs"]["decision"] == 1
+
+
+def test_policy_lines_conform_to_metrics_schema():
+    from tensorfusion_tpu.metrics.encoder import parse_line
+    from tensorfusion_tpu.metrics.schema import METRICS_SCHEMA
+
+    clock = FakeClock()
+    tsdb = TSDB(clock=clock)
+    tsdb.insert("tpf_scheduler", {}, {"pending_pods": 1}, clock.now())
+    ev = AlertEvaluator(tsdb, rules=[_pending_rule()], clock=clock)
+    ev.evaluate_once()
+    eng = _engine(tsdb, ev,
+                  [AlertPolicyRule(name="r", alert_rule="pods-pending",
+                                   action="a", cooldown_s=0.0)],
+                  {"a": lambda **kw: None}, clock=clock)
+    eng.evaluate_once()
+    lines = policy_lines(eng, "node-x", 123)
+    assert len(lines) == 2          # engine + one rule line
+    for line in lines:
+        measurement, tags, fields, _ = parse_line(line)
+        entry = METRICS_SCHEMA[measurement]
+        assert set(tags) == set(entry["tags"])
+        assert set(fields) <= set(entry["fields"])
+    m, _, fields, _ = parse_line(lines[0])
+    assert m == "tpf_policy_engine"
+    assert fields["decisions_total"] == 1
+
+
+def test_default_policies_reference_declared_series():
+    """Every MetricPolicyRule in the shipped catalog names a declared
+    measurement/field (the tpflint metrics-schema gate statically, and
+    here at runtime for belt-and-braces)."""
+    from tensorfusion_tpu.metrics.schema import METRICS_SCHEMA
+
+    for rule in default_policies():
+        if isinstance(rule, MetricPolicyRule):
+            assert rule.measurement in METRICS_SCHEMA
+            assert rule.metric_field in \
+                METRICS_SCHEMA[rule.measurement]["fields"]
+
+
+# -- webhook admission control ---------------------------------------------
+
+
+def test_webhook_admission_block_sheds_then_expires():
+    from tensorfusion_tpu.api.types import Container, Pod
+    from tensorfusion_tpu.store import ObjectStore
+    from tensorfusion_tpu.webhook import (AdmissionShedError,
+                                          PodMutator, WorkloadParser)
+    from tensorfusion_tpu import constants
+
+    clock = FakeClock()
+    store = ObjectStore()
+    mutator = PodMutator(store, WorkloadParser(store), clock=clock)
+
+    def pod(name):
+        p = Pod.new(name, namespace="storm")
+        p.metadata.annotations[constants.ANN_POOL] = "pool-a"
+        p.metadata.annotations[constants.ANN_TFLOPS_REQUEST] = "10"
+        p.metadata.annotations[constants.ANN_IS_LOCAL_TPU] = "true"
+        p.spec.containers = [Container(name="main")]
+        return p
+
+    mutator.handle(pod("ok-before"))      # no block: admits
+    until = mutator.set_admission_block("storm", ttl_s=30.0)
+    assert until == pytest.approx(clock.now() + 30.0)
+    with pytest.raises(AdmissionShedError) as ei:
+        mutator.handle(pod("shed-1"))
+    assert ei.value.namespace == "storm"
+    assert 0 < ei.value.retry_after_s <= 30.0
+    snap = mutator.admission_control_snapshot()
+    assert snap["shed_total"] == 1 and snap["sheds"]["storm"] == 1
+    # re-arming extends, never shortens
+    mutator.set_admission_block("storm", ttl_s=5.0)
+    assert mutator.admission_blocked("storm") == pytest.approx(30.0)
+    # other namespaces unaffected; expiry reaps the block
+    p2 = pod("other")
+    p2.metadata.namespace = "default"
+    mutator.handle(p2)
+    clock.t += 31.0
+    mutator.handle(pod("ok-after"))
+    assert mutator.admission_blocked("storm") == 0.0
+
+
+# -- operator wiring + surfaces --------------------------------------------
+
+
+def test_operator_enable_policy_wires_engine_alerts_actuators():
+    from tensorfusion_tpu.operator import Operator
+
+    op = Operator(enable_policy=True)
+    try:
+        assert op.policy is not None and op.alerts is not None \
+            and op.metrics is not None
+        rule_names = {r.name for r in op.alerts.rules}
+        # the policy trigger rules joined the evaluator defaults
+        assert {"pods-pending", "tenant-skew",
+                "quota-pressure"} <= rule_names
+        assert {"scale_pool", "migrate_tenant", "admit_control",
+                "defrag_node", "autoscale"} <= set(
+                    op.policy.actuators)
+        assert {r.name for r in op.policy.rules} == {
+            r.name for r in default_policies()}
+    finally:
+        op.stop()
+
+
+def test_hypervisor_policy_endpoint_and_tui_pane():
+    import urllib.request
+
+    from tensorfusion_tpu.hypervisor.server import HypervisorServer
+    from tensorfusion_tpu.hypervisor.tui import TuiState, render_policy
+
+    clock = FakeClock()
+    tsdb = TSDB(clock=clock)
+    tsdb.insert("tpf_scheduler", {}, {"pending_pods": 1}, clock.now())
+    ev = AlertEvaluator(tsdb, rules=[_pending_rule()], clock=clock)
+    ev.evaluate_once()
+    eng = _engine(tsdb, ev,
+                  [AlertPolicyRule(name="scale-on-burn",
+                                   alert_rule="pods-pending",
+                                   action="a", cooldown_s=0.0)],
+                  {"a": lambda **kw: {"claims": ["c1"]}}, clock=clock)
+    eng.evaluate_once()
+    srv = HypervisorServer(devices=None, workers=None, port=0,
+                           policy_engines=[eng])
+    srv.start()
+    try:
+        with urllib.request.urlopen(
+                f"{srv.url}/api/v1/policy", timeout=5) as r:
+            snaps = json.loads(r.read())
+        assert len(snaps) == 1
+        assert snaps[0]["counters"]["decisions_total"] == 1
+        assert snaps[0]["ledger"]["decisions"][0]["rule"] == \
+            "scale-on-burn"
+        pane = render_policy(snaps)
+        assert "scale-on-burn" in pane and "decisions=1" in pane
+        state = TuiState()
+        state.update_policy(snaps)
+        assert state.key("o") and state.view == "policy"
+        assert "scale-on-burn" in state.render()
+    finally:
+        srv.stop()
+
+
+def test_tpfpolicy_cli_log_explain_check(tmp_path, capsys):
+    import tools.tpfpolicy as cli
+
+    clock = FakeClock()
+    tsdb = TSDB(clock=clock)
+    tsdb.insert("tpf_scheduler", {}, {"pending_pods": 2}, clock.now())
+    ev = AlertEvaluator(tsdb, rules=[_pending_rule()], clock=clock)
+    ev.evaluate_once()
+    eng = _engine(tsdb, ev,
+                  [AlertPolicyRule(name="scale-on-burn",
+                                   alert_rule="pods-pending",
+                                   action="a", cooldown_s=0.0)],
+                  {"a": lambda **kw: {"claims": ["c"]}}, clock=clock,
+                  profilers=[])
+    eng.evaluate_once()
+    path = str(tmp_path / "policy.json")
+    write_policy_log(path, eng, meta={"test": True})
+    doc = load_policy_log(path)
+    assert validate_policy_log(doc) == []
+    assert cli.main(["log", path]) == 0
+    assert cli.main(["explain", path, "1"]) == 0
+    out = capsys.readouterr().out
+    assert "scale-on-burn" in out and "pods-pending" in out
+    assert cli.main(["check", path]) == 0
+    # unknown decision id exit-codes
+    assert cli.main(["explain", path, "99"]) == 1
+    # a tampered artifact fails check
+    doc["snapshot"]["counters"]["decisions_total"] = 42
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    assert cli.main(["check", path]) == 1
+
+
+# -- campaigns (the regression gate, in-suite) -----------------------------
+
+
+@pytest.mark.sim
+@pytest.mark.parametrize("name", ["burst-overload", "noisy-neighbor",
+                                  "admission-storm"])
+def test_campaign_policy_beats_baseline(name):
+    from tensorfusion_tpu.sim.campaign import CRITERIA, run_campaign
+
+    base = run_campaign(name, seed=42, scale="small", policies=False)
+    pol = run_campaign(name, seed=42, scale="small", policies=True)
+    assert base["ok"], base["invariants"]
+    assert pol["ok"], (pol["invariants"], pol["provenance"])
+    assert CRITERIA[name](pol, base) == []
+    assert pol["decisions"] >= 1
+    # full provenance on every decision (the acceptance contract)
+    assert pol["provenance"]["ok"], pol["provenance"]["missing"]
+
+
+@pytest.mark.sim
+def test_campaign_deterministic_double_run():
+    from tensorfusion_tpu.sim.campaign import run_campaign
+
+    r1 = run_campaign("burst-overload", seed=42, scale="small",
+                      policies=True)
+    r2 = run_campaign("burst-overload", seed=42, scale="small",
+                      policies=True)
+    assert r1["log_digest"] == r2["log_digest"]
+    assert r1["ledger_digest"] == r2["ledger_digest"]
+    r3 = run_campaign("burst-overload", seed=7, scale="small",
+                      policies=True)
+    assert r3["log_digest"] != r1["log_digest"]
+
+
+@pytest.mark.sim
+def test_campaign_ledger_decisions_resolve_via_cli(tmp_path, capsys):
+    """End to end: campaign -> exported tpfpolicy log -> every
+    actuated decision explains to its alert, exemplar trace ids and
+    profiler evidence, exit-coded."""
+    import tools.tpfpolicy as cli
+    from tensorfusion_tpu.sim import campaign as campaign_mod
+    from tensorfusion_tpu.sim.campaign import run_campaign
+
+    run_campaign("noisy-neighbor", seed=42, scale="small",
+                 policies=True)
+    path = str(tmp_path / "campaign-policy.json")
+    with open(path, "w") as f:
+        json.dump(campaign_mod.LAST_POLICY_LOG, f, default=str)
+    assert cli.main(["check", path]) == 0
+    doc = load_policy_log(path)
+    decisions = doc["snapshot"]["ledger"]["decisions"]
+    assert decisions
+    for d in decisions:
+        assert cli.main(["explain", path, str(d["id"])]) == 0
+        out = capsys.readouterr().out
+        assert d["rule"] in out
+        assert d["evidence"]["exemplars"]      # real trace ids
+        assert d["evidence"]["profile"]        # tpfprof digests

@@ -1432,3 +1432,63 @@ def test_serving_api_endpoint_and_tui_pane():
         assert "api-eng" in pane and "kv:" in pane
     finally:
         srv.stop()
+
+
+def test_recorder_field_scoped_exemplars_prefix_and_spec(params):
+    """The PR-11 per-tenant counters carry their OWN exemplars: the
+    trace linked on prefix_hit_tokens_total is the request that
+    adopted a shared prefix, and on spec_accept_rate the one that
+    decoded speculatively — not whichever admission happened last
+    (the policy loop cites these when acting on serving SLOs)."""
+    from tensorfusion_tpu.metrics.recorder import MetricsRecorder
+    from tensorfusion_tpu.operator import Operator
+    from tensorfusion_tpu.remoting import RemoteVTPUWorker
+    from tensorfusion_tpu.serving.spec import ArithmeticDraft
+
+    runner = FakeRunner(num_blocks=33, block_size=4)
+    eng = ServingEngine(runner, max_batch=4, name="fx",
+                        prefix_sharing=True,
+                        draft=ArithmeticDraft(runner, accuracy=1.0),
+                        spec_k=2)
+    done, emit = _collect()
+    # A: long-lived (still active when B arrives), decodes
+    # speculatively (trace tr-spec)
+    eng.submit([1, 2, 3, 4, 5, 6, 7, 8], 48, tenant="bob",
+               qos="medium", emit=emit,
+               trace={"trace_id": "tr-spec", "span_id": "",
+                      "sampled": True})
+    for _ in range(3):
+        eng.step()                       # prefill A + spec rounds
+    # B: same prompt while A is live -> adopts A's published prefix
+    # blocks (trace tr-prefix); max_new=1 so B itself never decodes
+    seq_b = eng.submit([1, 2, 3, 4, 5, 6, 7, 8], 1, tenant="bob",
+                       qos="medium", emit=emit,
+                       trace={"trace_id": "tr-prefix", "span_id": "",
+                              "sampled": True})
+    for _ in range(40):
+        if len(done) >= 2:
+            break
+        eng.step()
+    assert seq_b.prefix_matched > 0      # the share actually happened
+    snap = eng.snapshot()
+    assert snap["tenants"]["bob"]["last_prefix_trace_id"] == \
+        "tr-prefix"
+    assert snap["tenants"]["bob"]["last_spec_trace_id"] == "tr-spec"
+
+    w = RemoteVTPUWorker(engine=eng)
+    op = Operator()
+    try:
+        rec = MetricsRecorder(op, remote_workers=[w])
+        rec.record_once()
+        tags = {"tenant": "bob"}
+        assert rec.tsdb.exemplars(
+            "tpf_serving_tenant", tags=tags,
+            field="prefix_hit_tokens_total") == ["tr-prefix"]
+        assert rec.tsdb.exemplars(
+            "tpf_serving_tenant", tags=tags,
+            field="spec_accept_rate") == ["tr-spec"]
+        # a field with no scoped stream falls back to the series level
+        assert rec.tsdb.exemplars("tpf_serving_tenant", tags=tags,
+                                  field="tokens_total") != []
+    finally:
+        op.stop()

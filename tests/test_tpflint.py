@@ -523,6 +523,39 @@ def test_metrics_schema_undocumented_measurement_fails(tmp_path):
     assert any(f.key == "docs:tpf_demo" for f in findings)
 
 
+def test_metrics_schema_policy_rule_consumer_checked(docs_root):
+    """MetricPolicyRule (the tpfpolicy closed-loop trigger) is a
+    consumer site like AlertRule: a rule over an undeclared
+    measurement or field fails lint — a policy must not act on a
+    renamed (silently empty) series."""
+    bad = EMIT_OK + """
+    def rules(self):
+        return [MetricPolicyRule(name="r", measurement="tpf_demo",
+                                 metric_field="dutty_pct",
+                                 action="a")]
+"""
+    findings = metrics_schema.run_project(metrics_files(emit=bad),
+                                          docs_root)
+    assert any(f.key == "tpf_demo.dutty_pct" for f in findings)
+    rogue = EMIT_OK + """
+    def rules(self):
+        return [MetricPolicyRule(name="r", measurement="tpf_gone",
+                                 metric_field="duty_pct",
+                                 action="a")]
+"""
+    findings = metrics_schema.run_project(metrics_files(emit=rogue),
+                                          docs_root)
+    assert any(f.key == "tpf_gone" for f in findings)
+    good = EMIT_OK + """
+    def rules(self):
+        return [MetricPolicyRule(name="r", measurement="tpf_demo",
+                                 metric_field="duty_pct",
+                                 action="a")]
+"""
+    assert metrics_schema.run_project(metrics_files(emit=good),
+                                      docs_root) == []
+
+
 # -- disable comments + runner + baseline ----------------------------------
 
 def test_disable_comment_suppresses(tmp_path):

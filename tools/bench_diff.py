@@ -62,6 +62,7 @@ CELLS = {
          "wire_encoding.dim"),
         ("tracing.overhead_pct", "lower", 4.0, "abs"),
         ("profiler.overhead_pct", "lower", 4.0, "abs"),
+        ("policy.overhead_pct", "lower", 4.0, "abs"),
     ],
     "sched": [
         ("pods_per_second", "higher", 40.0, "rel"),
@@ -97,6 +98,27 @@ CELLS = {
         ("engine.spec_decode.forced_100_real_model"
          ".tokens_per_s_ceiling_gain_x",
          "higher", 40.0, "rel", "engine.spec_decode.spec_k"),
+    ],
+    # tpfpolicy campaign scores (docs/policy.md): the policy run's SLO
+    # attainment and its advantage over the no-op baseline per named
+    # campaign.  Virtual-time scores are noise-free in principle, but
+    # placement/threshold interactions shift a few samples across the
+    # SLO edge — hence small absolute bands, not zero.  Action-count
+    # cells guard against flapping regressions (a policy that starts
+    # migrating 10x as often "wins" SLO while thrashing the fleet).
+    "sim_campaign": [
+        ("campaigns.burst-overload.policy.score.slo_attainment_pct",
+         "higher", 5.0, "abs", "scale"),
+        ("campaigns.burst-overload.advantage.slo_attainment_pct",
+         "higher", 10.0, "abs", "scale"),
+        ("campaigns.noisy-neighbor.policy.score.slo_attainment_pct",
+         "higher", 5.0, "abs", "scale"),
+        ("campaigns.noisy-neighbor.policy.score.migrations",
+         "lower", 2.0, "abs", "scale"),
+        ("campaigns.admission-storm.policy.score.slo_attainment_pct",
+         "higher", 5.0, "abs", "scale"),
+        ("campaigns.admission-storm.advantage.slo_attainment_pct",
+         "higher", 10.0, "abs", "scale"),
     ],
     # sim.json: determinism is verify-sim's job; wall-seconds of a
     # virtual-time suite are not a perf contract.  TPU-only artifacts

@@ -13,9 +13,13 @@ convention a registry; this checker verifies every site against it:
   conditional ``tags["k"] = ...`` adds) must be declared;
 - when the emit site resolves completely, all *required* tags must be
   present (optional tags live in ``opt_tags``);
-- every ``tsdb.query(measurement, field, ...)`` and every
-  ``AlertRule(measurement=..., metric_field=...)`` with literals must
-  name a declared measurement and field;
+- every ``tsdb.query(measurement, field, ...)``, every
+  ``AlertRule(measurement=..., metric_field=...)`` /
+  ``BurnRateRule(measurement=..., good_field=..., total_field=...)``
+  and every policy-engine ``MetricPolicyRule(measurement=...,
+  metric_field=...)`` with literals must name a declared measurement
+  and field — a closed-loop policy over a renamed series must fail
+  lint, not act on permanent silence;
 - declared measurements that no analyzed file emits are dead schema.
 
 Sites whose measurement name is not a literal (e.g. the recorder
@@ -214,7 +218,10 @@ def _consumer_sites(sf: SourceFile):
             m, f = node.args[0], node.args[1]
             if isinstance(m, ast.Constant) and isinstance(f, ast.Constant):
                 yield node, m.value, f.value
-        elif fname == "AlertRule":
+        elif fname in ("AlertRule", "MetricPolicyRule"):
+            # MetricPolicyRule (tensorfusion_tpu/policy/rules.py) is
+            # the closed-loop analog of AlertRule: same literal
+            # measurement/metric_field consumption contract
             kws = {kw.arg: kw.value for kw in node.keywords}
             m, f = kws.get("measurement"), kws.get("metric_field")
             if isinstance(m, ast.Constant) and isinstance(f, ast.Constant):

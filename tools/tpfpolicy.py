@@ -1,0 +1,212 @@
+"""tpfpolicy CLI: inspect / explain / validate policy decision logs.
+
+Works on the ``tpfpolicy-v1`` JSON artifacts the platform exports
+(``benchmarks/sim_campaign.py`` writes one per campaign run, anything
+built from ``tensorfusion_tpu.policy.write_policy_log``):
+
+    python -m tools.tpfpolicy log POLICY.json
+    python -m tools.tpfpolicy explain POLICY.json <decision-id>
+    python -m tools.tpfpolicy check POLICY.json
+
+``log`` is the decision table (rule, trigger, actuation, outcome).
+``explain`` renders ONE decision's full provenance chain — the rule
+that fired, the triggering alert/metric evidence, the exemplar trace
+ids, the tpfprof digest at decision time, the exact actuator call and
+the observed outcome — and exits nonzero when any provenance link is
+missing (the acceptance contract: every actuated decision resolves to
+its evidence).  ``check`` validates the artifact structurally AND its
+embedded ``tpf_policy_*`` influx lines against METRICS_SCHEMA — the
+same registry gate tpflint applies to source, applied to the runtime
+artifact; ``make verify-campaign`` exit-codes on it.  Exit 0 = valid.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tensorfusion_tpu.policy import (load_policy_log,  # noqa: E402
+                                     policy_digest,
+                                     validate_policy_log)
+
+
+def _decisions(doc) -> list:
+    return ((doc.get("snapshot") or {}).get("ledger") or {}) \
+        .get("decisions", [])
+
+
+def cmd_log(args) -> int:
+    doc = load_policy_log(args.file)
+    snap = doc.get("snapshot") or {}
+    c = snap.get("counters", {})
+    print(f"policy@{doc.get('node', '?')}  "
+          f"decisions={c.get('decisions_total', 0)} "
+          f"actuated={c.get('actuations_total', 0)} "
+          f"failed={c.get('actuation_failures_total', 0)} "
+          f"resolved={c.get('resolved_total', 0)} "
+          f"suppressed={c.get('suppressed_total', 0)}  "
+          f"digest {policy_digest(snap)[:16]}")
+    rows = _decisions(doc)
+    if not rows:
+        print("(ledger empty)")
+        return 0
+    print(f"{'ID':<4}{'T':<12}{'RULE':<24}{'ACTION':<16}"
+          f"{'TRIGGER':<34}{'OK':<4}{'OUTCOME':<10}{'EXEMPLARS'}")
+    for d in rows:
+        act = d.get("actuation") or {}
+        out = d.get("outcome") or {}
+        ev = d.get("evidence") or {}
+        ex = ",".join(ev.get("exemplars", [])[:2]) or "-"
+        print(f"{d.get('id', 0):<4}{d.get('t', 0.0):<12.2f}"
+              f"{d.get('rule', '?'):<24}{d.get('action', '?'):<16}"
+              f"{str(d.get('trigger', '?'))[:32]:<34}"
+              f"{'y' if act.get('ok') else 'N':<4}"
+              f"{out.get('state', '?'):<10}{ex}")
+    return 0
+
+
+def cmd_explain(args) -> int:
+    doc = load_policy_log(args.file)
+    wanted = int(args.decision_id)
+    d = next((row for row in _decisions(doc)
+              if row.get("id") == wanted), None)
+    if d is None:
+        print(f"tpfpolicy explain: no decision {wanted} in "
+              f"{args.file}", file=sys.stderr)
+        return 1
+    ev = d.get("evidence") or {}
+    act = d.get("actuation") or {}
+    out = d.get("outcome") or {}
+    trig = ev.get("trigger") or {}
+    print(f"decision {d['id']} @ t={d.get('t', 0.0):.3f}  "
+          f"rule={d.get('rule')}  action={d.get('action')}")
+    print(f"  group:    {d.get('group') or ['(flat)']}")
+    print(f"  trigger:  {d.get('trigger')}")
+    for k in sorted(trig):
+        print(f"            {k} = {trig[k]}")
+    exemplars = ev.get("exemplars", [])
+    print(f"  exemplar traces ({len(exemplars)}):")
+    for tid in exemplars:
+        print(f"            {tid}")
+    profile = ev.get("profile", [])
+    print(f"  profiler evidence ({len(profile)}):")
+    for p in profile:
+        print(f"            {p.get('profiler')}: "
+              f"digest {str(p.get('digest'))[:16]}")
+    print(f"  actuated: {act.get('actuator')}({act.get('args')}) "
+          f"ok={act.get('ok')}"
+          + (f" error={act.get('error')}" if act.get("error") else ""))
+    if act.get("result") is not None:
+        print(f"            result = {act.get('result')}")
+    print(f"  outcome:  {out.get('state')} @ t={out.get('t')}  "
+          f"{out.get('detail', '')}")
+    # the provenance contract: an actuated decision must link back to
+    # its trigger evidence, exemplar traces and profiler digest
+    missing = []
+    if not trig:
+        missing.append("trigger evidence")
+    if "exemplars" not in ev:
+        missing.append("exemplar list")
+    if "profile" not in ev:
+        missing.append("profiler evidence")
+    if not act.get("actuator"):
+        missing.append("actuation record")
+    if missing:
+        print(f"tpfpolicy explain: decision {wanted} is missing "
+              f"provenance: {', '.join(missing)}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_check(args) -> int:
+    from tensorfusion_tpu.metrics.encoder import parse_line
+    from tensorfusion_tpu.metrics.schema import METRICS_SCHEMA
+
+    doc = load_policy_log(args.file)
+    errors = validate_policy_log(doc)
+    # the embedded influx lines must conform to the registry — and
+    # every field the schema declares for the engine series must
+    # appear in the artifact (a silently-dropped field is dead schema
+    # at runtime; same cross-check tpfprof applies to its series)
+    declared_engine = set(METRICS_SCHEMA["tpf_policy_engine"]["fields"])
+    declared_rule = set(METRICS_SCHEMA["tpf_policy_rule"]["fields"])
+    emitted_engine: set = set()
+    emitted_rule: set = set()
+    for line in doc.get("lines") or ():
+        try:
+            measurement, tags, fields, _ = parse_line(line)
+        except ValueError as e:
+            errors.append(f"unparseable line {line!r}: {e}")
+            continue
+        if measurement not in METRICS_SCHEMA:
+            errors.append(f"line measurement {measurement!r} not in "
+                          f"METRICS_SCHEMA")
+            continue
+        entry = METRICS_SCHEMA[measurement]
+        allowed = set(entry.get("fields", ())) \
+            | set(entry.get("opt_fields", ()))
+        for f in fields:
+            if f not in allowed:
+                errors.append(f"{measurement} line carries undeclared "
+                              f"field {f!r}")
+        if measurement == "tpf_policy_engine":
+            emitted_engine |= set(fields)
+        elif measurement == "tpf_policy_rule":
+            emitted_rule |= set(fields)
+    if emitted_engine:
+        for f in sorted(declared_engine - emitted_engine):
+            errors.append(f"declared tpf_policy_engine field {f!r} "
+                          f"missing from every line in the artifact")
+    if emitted_rule:
+        for f in sorted(declared_rule - emitted_rule):
+            errors.append(f"declared tpf_policy_rule field {f!r} "
+                          f"missing from every line in the artifact")
+    if errors:
+        for e in errors:
+            print(f"tpfpolicy check: {e}", file=sys.stderr)
+        print(f"tpfpolicy check: FAIL ({len(errors)} errors in "
+              f"{args.file})", file=sys.stderr)
+        return 1
+    rows = _decisions(doc)
+    print(f"tpfpolicy check: OK ({len(rows)} decisions, "
+          f"{len(doc.get('lines') or ())} lines, digest "
+          f"{policy_digest(doc.get('snapshot') or {})[:16]})")
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # `tools/tpfpolicy.py --check FILE` alias, mirroring tpfprof
+    if argv and argv[0] == "--check":
+        argv = ["check"] + argv[1:]
+    ap = argparse.ArgumentParser(prog="tpfpolicy", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("log", help="decision-ledger table")
+    p.add_argument("file")
+    p.set_defaults(fn=cmd_log)
+
+    p = sub.add_parser("explain",
+                       help="one decision's full provenance chain, "
+                            "exit-coded on missing evidence links")
+    p.add_argument("file")
+    p.add_argument("decision_id")
+    p.set_defaults(fn=cmd_explain)
+
+    p = sub.add_parser("check",
+                       help="validate an artifact + its tpf_policy_* "
+                            "lines against METRICS_SCHEMA "
+                            "(exit-coded)")
+    p.add_argument("file")
+    p.set_defaults(fn=cmd_check)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
