@@ -9,7 +9,8 @@ PY := env -u PALLAS_AXON_POOL_IPS python
 
 .PHONY: all native test test-native verify-all verify-repeat \
 	verify-stress verify-sim verify-trace verify-serving verify-wire \
-	verify-prof verify-campaign bench-diff bench-provenance \
+	verify-prof verify-campaign verify-federation \
+	bench-diff bench-provenance \
 	verify-native-sanitized \
 	check-coverage lint \
 	lint-drill asan \
@@ -79,7 +80,7 @@ verify-repeat: native
 # control-plane hot path).  Cheaper than verify-repeat (minutes, not an
 # hour), meant to run on every change to locking/queueing code.
 verify-stress: verify-sim verify-campaign verify-trace verify-serving \
-	verify-wire verify-prof bench-diff
+	verify-wire verify-federation verify-prof bench-diff
 	@for i in 1 2 3 4 5; do \
 		echo "=== verify-stress round $$i/5 ==="; \
 		env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -173,6 +174,23 @@ verify-wire:
 		TPF_BENCH_RESULTS_DIR=/tmp/tpfwire_verify_results \
 		python benchmarks/remoting_bench.py --quick
 	@echo "verify-wire: OK"
+
+# Federation gate (docs/federation.md): the federated multi-worker
+# test battery (mesh composition + collectives, v7 opcode double
+# gates, q8 collective numerics bounds, the mixed-version raw-socket
+# taps proving v2-v6 peers see zero new-opcode frames), then the
+# quick 1-vs-2-worker federation bench cell — worker processes behind
+# emulated-DCN proxies — exit-coded on the >=1.6x aggregate-throughput
+# and q8 >=2x collective-byte gates with numerics bounded.  Run on
+# any change to remoting/ (protocol, client, worker, dispatch,
+# federation) or the collective paths.
+verify-federation:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		python -m pytest tests/test_federation.py -q \
+		-p no:cacheprovider -p no:xdist -p no:randomly
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		python benchmarks/remoting_bench.py --fed-quick
+	@echo "verify-federation: OK"
 
 # tpfprof gate (docs/profiling.md): the profiling suite (attribution
 # math, flight-recorder determinism incl. byte-identical same-seed

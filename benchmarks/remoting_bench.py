@@ -187,7 +187,33 @@ def main() -> int:
                         "(q8 on/off bytes + checksum), exit nonzero "
                         "when the >=2x bytes criterion or the numerics "
                         "bound fails")
+    p.add_argument("--no-federation", action="store_true",
+                   help="skip the multi-worker federation cells")
+    p.add_argument("--fed-rows", type=int, default=192,
+                   help="microbatch rows per WORKER in the federation "
+                        "cells (weak scaling)")
+    p.add_argument("--fed-dim", type=int, default=256)
+    p.add_argument("--fed-steps", type=int, default=30)
+    p.add_argument("--fed-rtt-ms", type=float, default=2.0,
+                   help="emulated DCN round-trip per worker link")
+    p.add_argument("--fed-quick", action="store_true",
+                   help="CI gate mode (make verify-federation): run "
+                        "ONLY the 1-vs-2-worker federation cell + its "
+                        "q8 leg, exit nonzero unless aggregate >= "
+                        "1.6x at 2 workers, q8 collective bytes >= 2x "
+                        "down vs raw, and numerics hold")
     args = p.parse_args()
+
+    if args.fed_quick:
+        args.fed_steps = min(args.fed_steps, 12)
+        cell = measure_federation(args, quick=True)
+        print(json.dumps({"metric": "remoting_fed_aggregate_vs_1worker",
+                          "value": cell["aggregate_vs_1worker_at_max"],
+                          "unit": "x", "cell": cell}))
+        ok = cell["aggregate_vs_1worker_at_max"] >= 1.6 and \
+            cell["q8"]["bytes_ratio_vs_raw"] >= 2.0 and \
+            cell["numerics_ok"]
+        return 0 if ok else 1
 
     if args.quick:
         args.wire_rows = min(args.wire_rows, 1024)
@@ -312,6 +338,8 @@ def main() -> int:
         result["policy"] = measure_policy_overhead(args)
     if not args.no_wire:
         result["wire_encoding"] = measure_wire_encoding(args)
+    if not args.no_federation:
+        result["federation"] = measure_federation(args)
     # every artifact carries its own before/after: the checked-in
     # record this run replaces rides along under `previous`
     result["previous"] = previous_artifact("remoting")
@@ -799,6 +827,190 @@ def measure_wire_encoding(args):
                 "reported for honesty, wire bytes is the criterion; "
                 "on DCN the 4x byte cut is the latency win",
     }
+
+
+def measure_federation(args, quick: bool = False):
+    """Federated multi-worker mesh cells (ISSUE 13, docs/federation.md):
+    one logical vTPU across N worker processes, each behind its own
+    emulated-DCN link.
+
+    The measured pattern is the data-parallel training shape: per
+    worker, a resident weight and a fixed per-worker microbatch; every
+    step fires one fire-and-forget resident launch per worker (the
+    partial "gradient" stays device-resident) and the cross-worker
+    AllReduce of the PREVIOUS step's partials runs while the current
+    step computes — client-coordinated over the v7 ALLREDUCE_SHIP
+    opcode, q8-quantized when opted in.  Weak-scaled: fixed rows per
+    worker, so with n workers each step advances n× the rows —
+    near-constant step time means aggregate throughput grows ~n×,
+    which is exactly what single-worker remoting could never reach (a
+    tenant was bounded by one worker).  Workers are separate processes
+    behind per-worker latency proxies: the cells measure the protocol
+    + collective + overlap path in the latency regime DCN federations
+    actually run in; per-worker compute parallelism is additive on
+    real multi-host hardware (the cells' one-core CPU workers
+    serialize compute, same caveat as the device-scaling cell)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorfusion_tpu.remoting import FederatedDevice
+
+    B, D = args.fed_rows, args.fed_dim
+    steps = args.fed_steps
+    rng = np.random.default_rng(0)
+    W0 = (rng.standard_normal((D, D)) * 0.05).astype(np.float32)
+
+    def grad_fn(w, x):
+        return x.T @ jnp.tanh(x @ w)
+
+    def run_cell(n_workers: int, quantize: bool):
+        procs, proxies = [], []
+        urls = []
+        try:
+            for _ in range(n_workers):
+                # the q8 leg quantizes exactly the COLLECTIVE path:
+                # the worker-side policy force (TPF_REMOTING_QUANT=1)
+                # q8-encodes its replies — the partials crossing the
+                # DCN — while the client keeps its uploads exact, so
+                # the numerics bound isolates the reduce path (the
+                # EQuARX compression point), not input round-trips
+                proc, port = _spawn_worker(
+                    env={"TPF_REMOTING_QUANT": "1"} if quantize
+                    else None)
+                procs.append(proc)
+                proxy = _LatencyProxy(port, args.fed_rtt_ms / 2e3)
+                proxies.append(proxy)
+                urls.append(f"tcp://127.0.0.1:{proxy.port}")
+            fed = FederatedDevice(urls, quantize=False)
+            ffn = fed.federated_jit(grad_fn, in_axes=(None, 0),
+                                    out_modes="sum")
+            # per-cell seed keyed by worker count ONLY: the raw and q8
+            # legs at the same n see the identical batch, so their
+            # results are directly comparable
+            x = np.random.default_rng(100 + n_workers) \
+                .standard_normal((n_workers * B, D)).astype(np.float32)
+            wh = ffn.upload_arg(0, W0, W0, x)
+            xh = ffn.upload_arg(1, x, W0, x)
+            # warm: per-worker compile + one full step + collective
+            step = ffn.step_resident(wh, xh)
+            out = fed.all_reduce(step.handles, free_src=True,
+                                 overlap_with=step)
+            snap0 = fed.fed_snapshot()
+            # min-of-rounds, the repo-wide discipline on this noisy
+            # 1-core box: co-resident load only ever ADDS latency, so
+            # the fastest round is the cleanest estimate of each
+            # worker count's true step cost
+            rounds = 3
+            dt = None
+            for _ in range(rounds):
+                t0 = time.perf_counter()
+                prev = None
+                for _ in range(steps):
+                    step = ffn.step_resident(wh, xh)
+                    if prev is not None:
+                        # the T3 shape: reduce microbatch m while
+                        # every worker computes microbatch m+1
+                        out = fed.all_reduce(prev.handles,
+                                             free_src=True,
+                                             overlap_with=step)
+                    prev = step
+                out = fed.all_reduce(prev.handles, free_src=True)
+                round_dt = (time.perf_counter() - t0) / steps
+                dt = round_dt if dt is None else min(dt, round_dt)
+            snap1 = fed.fed_snapshot()
+            n_colls = steps * rounds
+            coll_raw = (snap1["collective_raw_bytes"]
+                        - snap0["collective_raw_bytes"]) \
+                * steps // n_colls
+            coll_wire = (snap1["collective_wire_bytes"]
+                         - snap0["collective_wire_bytes"]) \
+                * steps // n_colls
+            hidden = snap1["hidden_s"] - snap0["hidden_s"]
+            exposed = snap1["exposed_s"] - snap0["exposed_s"]
+            total_xfer = hidden + exposed
+            value = np.asarray(out["value"], np.float32)
+            fed.close()
+            return {
+                "workers": n_workers,
+                "quantize": bool(quantize),
+                "step_ms": round(dt * 1e3, 3),
+                "rows_per_s": round(n_workers * B / dt, 1),
+                "collective_raw_bytes_per_step": coll_raw // steps,
+                "collective_wire_bytes_per_step": coll_wire // steps,
+                "overlap_efficiency_pct": round(
+                    100.0 * hidden / total_xfer, 2)
+                if total_xfer > 0 else 0.0,
+            }, value, x
+        finally:
+            for proxy in proxies:
+                proxy.close()
+            for proc in procs:
+                proc.terminate()
+                proc.wait(timeout=10)
+
+    worker_counts = (1, 2) if quick else (1, 2, 4)
+    cells = []
+    values = {}
+    for n in worker_counts:
+        cell, value, x = run_cell(n, quantize=False)
+        cells.append(cell)
+        values[n] = (value, x)
+    base = cells[0]["rows_per_s"]
+    for c in cells:
+        c["aggregate_vs_1worker"] = round(c["rows_per_s"] / base, 2)
+        c["scaling_efficiency"] = round(
+            c["rows_per_s"] / base / c["workers"], 3)
+
+    # numerics guardrail, raw: the federated reduce must match the
+    # local full-batch reference to float-sum tolerance
+    n_max = worker_counts[-1]
+    value, x = values[n_max]
+    want = np.asarray(jax.jit(grad_fn)(jnp.asarray(W0),
+                                       jnp.asarray(x)), np.float32)
+    scale = max(float(np.abs(want).max()), 1e-9)
+    raw_rel_err = float(np.abs(value - want).max()) / scale
+    raw_ok = raw_rel_err < 1e-4
+
+    # q8 leg at the largest worker count: collective bytes must halve
+    # (f32 lands ~4x) with numerics inside the quantization bound
+    q8_cell, q8_value, _ = run_cell(n_max, quantize=True)
+    raw_cell = cells[-1]
+    ratio = raw_cell["collective_wire_bytes_per_step"] / \
+        max(q8_cell["collective_wire_bytes_per_step"], 1)
+    # per-worker partial quantized once on reply: bound by the worst
+    # partial's block scale, summed over workers
+    q8_bound = n_max * scale / 127.0 * 1.2
+    q8_err = float(np.abs(q8_value - want).max())
+    q8_ok = q8_err <= q8_bound
+
+    result = {
+        "mode": "weak scaling (fixed rows per worker), data-parallel "
+                "resident microbatch steps + client-coordinated "
+                "ALLREDUCE_SHIP of the previous step's partials "
+                "overlapped with the current step's compute; one "
+                "worker PROCESS per member behind its own "
+                f"{args.fed_rtt_ms}ms-RTT proxy",
+        "rows_per_worker": B, "dim": D, "steps": steps,
+        "rtt_ms": args.fed_rtt_ms,
+        "cells": cells,
+        "q8": dict(q8_cell, bytes_ratio_vs_raw=round(ratio, 2),
+                   max_abs_err=round(q8_err, 6),
+                   err_bound=round(q8_bound, 6)),
+        "aggregate_vs_1worker_at_max":
+            cells[-1]["aggregate_vs_1worker"],
+        "overlap_efficiency_pct":
+            raw_cell["overlap_efficiency_pct"],
+        "raw_rel_err": round(raw_rel_err, 9),
+        "numerics_ok": bool(raw_ok and q8_ok),
+        "note": "single-core CI box: the member workers serialize "
+                "compute, so the cells are latency/protocol-bound by "
+                "construction (same discipline as device_scaling); on "
+                "real multi-host chips per-worker compute parallelism "
+                "is additive.  The win condition vs single-worker "
+                "remoting: one tenant's aggregate row rate grows with "
+                "workers that were previously unreachable.",
+    }
+    return result
 
 
 def measure_tracing_overhead(args):

@@ -90,6 +90,33 @@ def remote_dispatch_lines(remote_worker, node_name: str,
             ts))
     return lines
 
+def federation_lines(fed, node_name: str, ts: int,
+                     snap=None) -> List[str]:
+    """Influx lines for one :class:`~...remoting.federation.
+    FederatedDevice` (docs/federation.md): cross-worker collective
+    counts, payload bytes raw vs on the (q8-eligible) wire, and the
+    hidden-vs-exposed transfer split that feeds the overlap ledger —
+    the ``tpf_fed_collective`` series.  Pass ``snap`` to reuse an
+    already-taken ``fed_snapshot()``."""
+    if snap is None:
+        snap = fed.fed_snapshot()
+    tags = {"node": node_name,
+            "federation": getattr(fed, "tenant", "fed0")}
+    return [encode_line(
+        "tpf_fed_collective", tags,
+        {"workers": snap["workers"],
+         "allreduce_total": snap["allreduce_total"],
+         "allgather_total": snap["allgather_total"],
+         "shard_execs_total": snap["shard_execs_total"],
+         "fallback_calls_total": snap["fallback_calls_total"],
+         "collective_raw_bytes_total": snap["collective_raw_bytes"],
+         "collective_wire_bytes_total": snap["collective_wire_bytes"],
+         "hidden_transfer_s_total": round(snap["hidden_s"], 6),
+         "exposed_transfer_s_total": round(snap["exposed_s"], 6),
+         "overlap_efficiency_pct": snap["overlap_efficiency_pct"]},
+        ts)]
+
+
 def serving_engine_lines(engine, node_name: str, ts: int,
                          snap=None) -> List[str]:
     """Influx lines for one tpfserve continuous-batching engine
@@ -131,6 +158,9 @@ def serving_engine_lines(engine, node_name: str, ts: int,
          "kv_cow_copies_total": kv.get("cow_copies_total", 0),
          "kv_prefix_hit_tokens_total":
              kv.get("prefix_hit_tokens_total", 0),
+         "kv_prefix_cache_evictions_total":
+             kv.get("prefix_cache_evictions_total", 0),
+         "kv_prefix_cache_blocks": kv.get("cache_held_blocks", 0),
          "kv_ship_bytes_total": ship.get("bytes", 0),
          "kv_ship_blocks_total": ship.get("blocks", 0),
          "kv_ship_dedup_blocks_total": ship.get("dedup_blocks", 0),

@@ -83,6 +83,8 @@ METRICS_SCHEMA = {
                    "kv_blocks_used", "kv_util_pct",
                    "kv_evictions_total", "kv_shared_blocks",
                    "kv_cow_copies_total", "kv_prefix_hit_tokens_total",
+                   "kv_prefix_cache_evictions_total",
+                   "kv_prefix_cache_blocks",
                    "kv_ship_bytes_total", "kv_ship_blocks_total",
                    "kv_ship_dedup_blocks_total", "spec_accept_rate",
                    "spec_steps_total"),
@@ -92,6 +94,21 @@ METRICS_SCHEMA = {
         "fields": ("tokens_total", "ttft_p50_ms", "ttft_p99_ms",
                    "slo_good", "slo_total", "slo_ms", "good_ratio",
                    "prefix_hit_tokens_total", "spec_accept_rate"),
+    },
+    # federated multi-worker collectives (remoting/federation.py,
+    # docs/federation.md): one line per FederatedDevice per pass —
+    # cross-worker AllReduce/AllGather counts, payload bytes raw vs on
+    # the (q8-eligible) wire, and the hidden-vs-exposed transfer split
+    # feeding the overlap ledger.  Emitted by hypervisor/metrics.py
+    # federation_lines via either recorder.
+    "tpf_fed_collective": {
+        "tags": ("node", "federation"),
+        "fields": ("workers", "allreduce_total", "allgather_total",
+                   "shard_execs_total", "fallback_calls_total",
+                   "collective_raw_bytes_total",
+                   "collective_wire_bytes_total",
+                   "hidden_transfer_s_total", "exposed_transfer_s_total",
+                   "overlap_efficiency_pct"),
     },
     # tpfprof device-time attribution (tensorfusion_tpu/profiling,
     # docs/profiling.md): per-device utilization + attributed seconds

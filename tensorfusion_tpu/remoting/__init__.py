@@ -3,4 +3,5 @@
 from .client import (RemoteBuffer, RemoteBusyError, RemoteDeadlineError,
                      RemoteDevice, RemoteExecutionError,
                      ShardedRemoteBuffer)
+from .federation import FederatedDevice, FederatedFunction, FedStep
 from .worker import RemoteVTPUWorker

@@ -72,6 +72,17 @@ the EXECUTE frame so per-connection ordering is untouched.  Wire
 accounting (bytes, per-encoding counts, overlap depth) accumulates in
 ``RemoteDevice.wire_stats`` and rides the ``client.wire`` span's
 ``enc`` / ``wire_bytes`` / ``overlap_depth`` attrs.
+
+Federated collectives (protocol v7, docs/federation.md):
+:meth:`RemoteDevice.allreduce_ship` / :meth:`RemoteDevice.
+allgather_ship` are the per-connection legs a
+:class:`~.federation.FederatedDevice` composes into cross-worker
+AllReduce/AllGather — worker-local partials reduced worker-side, the
+running accumulator riding the upload stream as q8-eligible quiet
+PUTs, replies q8-encoded when negotiated, and the re-scatter leg
+installing the reduced result resident for the next step.  Both
+refuse to send on a < v7 connection (the worker refuses to honor them
+from one), so pre-v7 peers never see the kinds.
 """
 
 from __future__ import annotations
@@ -329,6 +340,12 @@ class RemoteDevice:
         #: buffer counts, upload-stream depth high-water)
         # guarded by: _state_lock
         self.wire_stats: Dict[str, int] = {}
+        #: cumulative INBOUND wire accounting (reply buffers: raw/wire
+        #: bytes + per-enc counts) — written only by the reader thread;
+        #: snapshot with dict().  Per-reply accounting additionally
+        #: rides each reply's ``_rx_wire`` meta so collective callers
+        #: can attribute exactly their own frames (docs/federation.md)
+        self.rx_stats: Dict[str, int] = {}
         #: the worker-resolved dispatch weight (HELLO_OK, v4 workers)
         self.qos_weight: Optional[float] = None
         #: optional span recorder (tensorfusion_tpu.tracing.Tracer);
@@ -413,7 +430,15 @@ class RemoteDevice:
     def _read_loop(self, sock: socket.socket) -> None:
         try:
             while True:
-                kind, meta, bufs = recv_message(sock, accept=self._accept)
+                rx: Dict[str, int] = {}
+                kind, meta, bufs = recv_message(sock, accept=self._accept,
+                                                stats=rx)
+                # per-reply inbound accounting (underscore keys never
+                # leave the client); totals accumulate reader-thread-
+                # only in rx_stats
+                meta["_rx_wire"] = rx
+                for k, v in rx.items():
+                    self.rx_stats[k] = self.rx_stats.get(k, 0) + v
                 seq = meta.get("seq")
                 with self._state_lock:
                     stream = self._streams.get(seq)
@@ -824,6 +849,111 @@ class RemoteDevice:
                 gspan.finish(error=f"{type(e).__name__}: {e}"[:200])
             raise
 
+    # -- federated collectives (protocol v7, docs/federation.md) -------
+
+    def _stage_upload(self, buf_id: str, arr: np.ndarray,
+                      stats: Optional[Dict[str, int]] = None) -> None:
+        """Stage one quiet ephemeral PUT on the double-buffered upload
+        stream (q8-eligible) and take the ordering barrier — the frame
+        that references ``buf_id`` may be sent right after."""
+        if self._upload_stream is None:
+            self._upload_stream = _UploadStream(self, self.upload_depth)
+        self._upload_stream.submit(
+            {"buf_id": buf_id, "ephemeral": True, "quiet": True}, arr,
+            stats=stats)
+        self._upload_stream.drain()
+
+    def allreduce_ship(self, buf_ids, acc=None,
+                       result_id: Optional[str] = None,
+                       receipt_only: bool = False,
+                       free_src: bool = False,
+                       quiet: bool = False,
+                       wait: bool = True,
+                       stats: Optional[Dict[str, int]] = None,
+                       op: str = "sum"):
+        """One worker's leg of a federated AllReduce (protocol-v7
+        ``ALLREDUCE_SHIP``, docs/federation.md): the worker sums the
+        resident partials named by ``buf_ids`` (locally, so one slice
+        rides the reply) plus the shipped accumulator ``acc``, then
+        ships the result back — q8-encoded when this connection
+        negotiated quantized replies — and/or installs it resident
+        under ``result_id`` (the re-scatter leg).  Large accumulators
+        ride the double-buffered ``_UploadStream`` as q8-eligible
+        quiet ephemeral PUTs, the SHIP frame following the ``drain()``
+        barrier.  ``free_src`` retires the partials with the reduce.
+
+        ``wait=False`` returns the transport Future (resolve it with
+        :meth:`finish_collective`) so a federated client can keep one
+        collect in flight per worker; ``quiet`` (with
+        ``receipt_only``) makes an install fire-and-forget, ordered
+        before later EXECUTEs by the worker's per-connection FIFO.
+        Needs a protocol-v7 worker — a pre-v7 connection raises before
+        anything hits the wire."""
+        self._ensure_version(protocol.FED_MIN_VERSION,
+                             "ALLREDUCE_SHIP (federated collectives)")
+        meta: Dict[str, Any] = {"op": op,
+                                "buf_ids": [str(b) for b in buf_ids]}
+        if result_id is not None:
+            meta["result_id"] = str(result_id)
+        if receipt_only:
+            meta["receipt_only"] = True
+        if free_src:
+            meta["free_src"] = True
+        buffers: List = []
+        if acc is not None:
+            acc = np.ascontiguousarray(np.asarray(acc))
+            if acc.nbytes >= SHARD_PUT_MIN_BYTES:
+                aid = f"c-ar{next(self._mint)}"
+                self._stage_upload(aid, acc, stats=stats)
+                meta["acc_bufs"] = [aid]
+            else:
+                buffers = [acc]
+        if quiet and receipt_only:
+            meta["quiet"] = True
+            self._submit("ALLREDUCE_SHIP", meta, buffers,
+                         want_reply=False, stats=stats)
+            return None
+        fut = self._submit("ALLREDUCE_SHIP", meta, buffers, stats=stats)
+        if not wait:
+            return fut
+        return self.finish_collective(fut)
+
+    def allgather_ship(self, buf_ids, axis: int = 0,
+                       free_src: bool = False,
+                       wait: bool = True,
+                       stats: Optional[Dict[str, int]] = None):
+        """One worker's leg of a federated AllGather (protocol-v7
+        ``ALLGATHER_SHIP``): the worker concatenates its local pieces
+        along ``axis`` (one frame leaves however many fed it) and
+        ships the slice; the federated client concatenates slices
+        across workers in mesh order.  Same ``wait``/``free_src``
+        contract as :meth:`allreduce_ship`."""
+        self._ensure_version(protocol.FED_MIN_VERSION,
+                             "ALLGATHER_SHIP (federated collectives)")
+        meta: Dict[str, Any] = {"buf_ids": [str(b) for b in buf_ids],
+                                "axis": int(axis)}
+        if free_src:
+            meta["free_src"] = True
+        fut = self._submit("ALLGATHER_SHIP", meta, [], stats=stats)
+        if not wait:
+            return fut
+        return self.finish_collective(fut)
+
+    def finish_collective(self, fut: Future
+                          ) -> Tuple[Dict[str, Any],
+                                     Optional[np.ndarray]]:
+        """Resolve one in-flight collective leg: ``(receipt meta,
+        payload array or None)``.  The receipt's ``_rx_wire`` carries
+        this reply's exact inbound wire accounting (raw vs wire bytes,
+        per-enc counts) for the federation's collective ledger."""
+        _, rmeta, rbufs = self._result(fut)
+        return rmeta, (rbufs[0] if rbufs else None)
+
+    def mint_buf_id(self, tag: str = "r") -> str:
+        """A fresh client-minted c-namespace buffer id (install targets
+        for the federated re-scatter leg)."""
+        return f"c-f{next(self._mint)}-{tag}"
+
     def snapshot(self, state_dir: str) -> Dict[str, Any]:
         _, meta, _ = self._rpc("SNAPSHOT", {"state_dir": state_dir}, [])
         return meta
@@ -1160,7 +1290,8 @@ class RemoteDevice:
             (arrays or ShapeDtypeStructs both work as examples)."""
             return prepare(args)[0]
 
-        def step_resident(*args, free: Tuple = (), wait: bool = False):
+        def step_resident(*args, free: Tuple = (), wait: bool = False,
+                          acked: bool = False):
             """Execute with results kept device-resident (sharded
             results stay scattered across the mesh) and return handles
             WITHOUT waiting for any round trip: result ids are
@@ -1173,17 +1304,23 @@ class RemoteDevice:
             synchronous boundary (a fetch of these handles).
             ``wait=True`` turns the step into one round trip (the
             worker acks after the results are parked) — for control
-            loops that must observe completion before proceeding."""
+            loops that must observe completion before proceeding.
+            ``acked=True`` keeps the step non-blocking but asks for
+            the completion ack anyway, returning ``(handles,
+            Future)`` — the federated overlap ledger uses the ack
+            time to judge how much collective transfer ran hidden
+            behind the step's compute (docs/federation.md)."""
             device._ensure_v3("step_resident (client-minted result ids)")
             entry, leaves = prepare(args)
             _, out_tree, _, out_sigs = entry
             ctr = next(device._mint)
             ids = [f"c-r{ctr}-{j}" for j in range(len(out_sigs))]
+            want_ack = wait or acked
             fut = send_execute(
                 entry, leaves,
                 extra_meta={"keep_results": True, "result_ids": ids,
-                            **({} if wait else {"quiet": True})},
-                want_reply=wait)
+                            **({} if want_ack else {"quiet": True})},
+                want_reply=want_ack)
             if free:
                 dead = []
                 for h in (free if isinstance(free, (tuple, list))
@@ -1196,7 +1333,10 @@ class RemoteDevice:
                 device._result(fut)
             handles = [RemoteBuffer(device, i, shape, dtype)
                        for i, (shape, dtype) in zip(ids, out_sigs)]
-            return jax.tree_util.tree_unflatten(out_tree, handles)
+            out = jax.tree_util.tree_unflatten(out_tree, handles)
+            if acked and not wait:
+                return out, fut
+            return out
 
         def upload_arg(index: int, array, *example_args
                        ) -> "ShardedRemoteBuffer | RemoteBuffer":

@@ -181,6 +181,15 @@ class Tenant:
         #: the exemplar the TSDB attaches to its histogram series
         # guarded by: _cv
         self.last_trace_id = ""
+        #: federated-collective accounting (protocol v7, docs/
+        #: federation.md): ops served and payload bytes moved for THIS
+        #: tenant's ALLREDUCE_SHIP / ALLGATHER_SHIP items — collective
+        #: traffic is attributed to the owning tenant exactly like its
+        #: device time (tpfprof keeps the time half; these keep bytes)
+        # guarded by: _cv
+        self.collective_ops = 0
+        # guarded by: _cv
+        self.collective_bytes = 0
 
 
 class BusyError(Exception):
@@ -265,6 +274,11 @@ class DeviceDispatcher:
         #: exemplar attached to the dispatcher-level histogram series
         # guarded by: _cv
         self._last_trace_id = ""
+        # -- federated-collective totals (protocol v7) --------------------
+        # guarded by: _cv
+        self.collective_ops = 0
+        # guarded by: _cv
+        self.collective_bytes = 0
 
     # -- lifecycle --------------------------------------------------------
 
@@ -373,6 +387,24 @@ class DeviceDispatcher:
                     raise TimeoutError(
                         f"dispatch barrier timed out for {tenant.conn_id}")
                 self._cv.wait(timeout=min(remaining, 0.5))
+
+    def note_collective(self, conn_id: str, op: str,
+                        nbytes: int) -> None:
+        """Record one served federated collective (ALLREDUCE_SHIP /
+        ALLGATHER_SHIP, protocol v7) against its owning tenant: the
+        byte half of per-tenant collective attribution (tpfprof keeps
+        the transfer-time half).  ``op`` rides the flight-recorder
+        note so a postmortem bundle shows collective cadence."""
+        with self._cv:
+            self.collective_ops += 1
+            self.collective_bytes += int(nbytes)
+            tenant = self._tenants.get(conn_id)
+            if tenant is not None:
+                tenant.collective_ops += 1
+                tenant.collective_bytes += int(nbytes)
+        if self.recorder is not None:
+            self.recorder.note("dispatch", "collective", op=op,
+                               tenant=conn_id, nbytes=int(nbytes))
 
     def _complete(self, items: List[WorkItem]) -> None:
         with self._cv:
@@ -675,7 +707,9 @@ class DeviceDispatcher:
                             "slo_total": t.slo_total,
                             "slo_ms": constants.QOS_QUEUE_WAIT_SLO_MS
                             .get(t.qos, 500.0),
-                            "last_trace_id": t.last_trace_id}
+                            "last_trace_id": t.last_trace_id,
+                            "collective_ops": t.collective_ops,
+                            "collective_bytes": t.collective_bytes}
                 for t in self._tenants.values()}
             last_trace = self._last_trace_id
             depth = self._depth
@@ -683,7 +717,9 @@ class DeviceDispatcher:
                         "launches": self.launches,
                         "microbatched_requests": self.microbatched,
                         "busy_rejected": self.busy_rejected,
-                        "deadline_exceeded": self.deadline_exceeded}
+                        "deadline_exceeded": self.deadline_exceeded,
+                        "collective_ops": self.collective_ops,
+                        "collective_bytes": self.collective_bytes}
             per_qos = {qos: (rec, self.per_qos_served.get(qos, 0))
                        for qos, rec in self.per_qos_wait.items()}
         return dict(counters, **{

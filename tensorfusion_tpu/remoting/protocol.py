@@ -147,6 +147,43 @@ on a < v6 connection and the worker refuses to honor it from one):
   the receipt, because ingest runs on the engine stepper.  A saturated
   engine answers ``BUSY``; the shipped pages are dropped with the
   rejection, so a retry re-ships.
+
+Version 7 carries the federated-collective opcodes
+(remoting/federation.py, docs/federation.md) — the wire half of one
+logical vTPU spanning N workers.  HELLO-negotiated exactly like
+v3-v6, with the double version gate every opcode since v6 uses: the
+client refuses to send the kinds on a < v7 connection AND the worker
+refuses to honor them from one, so v2-v6 single-worker peers never
+see them (a :class:`~.federation.FederatedDevice` over old workers
+falls back to single-worker execution with zero new-opcode frames):
+
+- ALLREDUCE_SHIP: "sum the named worker-resident buffers plus the
+  shipped accumulator, then ship/install the result".  ``buf_ids``
+  names the worker's local partials (per-worker microbatch results,
+  summed worker-side so at most ONE slice rides the reply);
+  ``acc_bufs`` / one inline frame buffer carries the client's running
+  accumulator (large accumulators ride the ``_UploadStream`` sender
+  as q8-eligible quiet ephemeral PUTs, the SHIP frame following the
+  ``drain()`` barrier — the EQuARX compression point applied to the
+  inter-worker reduce path); ``free_src`` consumes the partials with
+  the reduce (no separate FREE round trip per step); ``result_id``
+  additionally installs the result device-resident under a
+  client-minted c-namespace id (the re-scatter leg), and
+  ``receipt_only`` skips the payload for pure installs.  The request
+  flows through the central QoS dispatcher as a work item whose heavy
+  half (materialize + reduce + reply) runs as a deferred flush — the
+  dispatcher launches the connection's NEXT queued EXECUTE first, so
+  collective transfer overlaps the next microbatch's compute
+  (the T3 discipline, server side).
+- ALLREDUCE_SHIP_OK: ``op`` / ``n_src`` / ``shape`` / ``dtype`` (+
+  ``installed`` when a result_id was parked) and, unless
+  ``receipt_only``, the reduced array as the single reply buffer —
+  q8-encoded when the connection negotiated quantized replies.
+- ALLGATHER_SHIP: ship one worker's slice of a federated array —
+  ``buf_ids`` (locally concatenated along ``axis`` so one frame
+  leaves the worker) + ``free_src``; the client concatenates slices
+  across workers in mesh order.
+- ALLGATHER_SHIP_OK: ``n_src`` / ``shape`` / ``dtype`` + the slice.
 """
 
 from __future__ import annotations
@@ -160,9 +197,9 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 MAGIC = b"TPFR"
-VERSION = 6
-#: frame versions this build can decode (v3-v6 are additive over v2)
-SUPPORTED_VERSIONS = (2, 3, 4, 5, 6)
+VERSION = 7
+#: frame versions this build can decode (v3-v7 are additive over v2)
+SUPPORTED_VERSIONS = (2, 3, 4, 5, 6, 7)
 #: version every HELLO is framed at, so any peer can read it
 HELLO_VERSION = 2
 #: lowest wire version whose frames may carry ``enc="q8"`` buffers
@@ -171,6 +208,11 @@ Q8_MIN_VERSION = 6
 #: KV_SHIP opcode (client refuses to send below it, worker refuses to
 #: honor it below it — pre-v6 peers never see the kind)
 KV_SHIP_MIN_VERSION = 6
+#: lowest wire version that may carry the federated-collective opcodes
+#: (ALLREDUCE_SHIP / ALLGATHER_SHIP).  Double-gated like KV_SHIP: the
+#: client refuses to send below it and the worker refuses to honor it
+#: below it, so v2-v6 single-worker peers never see the kinds
+FED_MIN_VERSION = 7
 
 # -- opcode / reply / error-code registry ---------------------------------
 #
@@ -184,6 +226,7 @@ KV_SHIP_MIN_VERSION = 6
 #: client -> worker request kinds
 REQUEST_KINDS = ("HELLO", "INFO", "COMPILE", "COMPILE_MLIR", "PUT",
                  "FREE", "FETCH", "EXECUTE", "GENERATE", "KV_SHIP",
+                 "ALLREDUCE_SHIP", "ALLGATHER_SHIP",
                  "SNAPSHOT", "RESTORE")
 #: request kinds the python client never sends (COMPILE_MLIR is the
 #: transparent PJRT plugin's path — libtpf_pjrt_remote.cc is the client)
@@ -191,6 +234,7 @@ CLIENT_OPTIONAL_KINDS = ("COMPILE_MLIR",)
 #: worker -> client reply kinds
 REPLY_KINDS = ("HELLO_OK", "INFO_OK", "COMPILE_OK", "PUT_OK", "FREE_OK",
                "FETCH_OK", "EXECUTE_OK", "GENERATE_OK", "KV_SHIP_OK",
+               "ALLREDUCE_SHIP_OK", "ALLGATHER_SHIP_OK",
                "SNAPSHOT_OK", "RESTORE_OK", "ERROR")
 #: structured ERROR ``code`` values (v4; older clients see plain ERROR)
 ERROR_CODES = ("BUSY", "DEADLINE_EXCEEDED", "needs_compile")

@@ -64,6 +64,17 @@ Hardening (beyond the round-1 prototype):
   accounting surfaced via INFO and the ``tpf_remote_dispatch``
   metrics, and inbound wire accounting stamped on ``worker.upload``
   spans.
+- **federated collectives** (protocol v7, docs/federation.md): the
+  worker serves ALLREDUCE_SHIP / ALLGATHER_SHIP for clients composing
+  N workers into one logical mesh.  Both ride the QoS dispatcher as
+  work items whose heavy half (materialize partials, reduce, encode
+  the q8-eligible reply) runs as a *deferred flush* — the dispatcher
+  launches the connection's next queued EXECUTE first, so collective
+  transfer overlaps the following microbatch's compute.  Per-tenant
+  collective bytes land on the dispatcher tenant counters, the
+  reduce/ship time on the tpfprof transfer ledger.  Double version
+  gate: the handler refuses the kinds below v7, so v2-v6 peers never
+  see them honored.
 """
 
 from __future__ import annotations
@@ -394,11 +405,11 @@ class RemoteVTPUWorker:
                         i.startswith("c-") else i
 
                 def remap_ids(meta):
-                    for key in ("buf_id",):
+                    for key in ("buf_id", "result_id"):
                         if key in meta:
                             meta[key] = xid(meta[key])
                     for key in ("buf_ids", "arg_refs", "result_ids",
-                                "kv_bufs"):
+                                "kv_bufs", "acc_bufs"):
                         if meta.get(key) is not None:
                             meta[key] = [xid(v) for v in meta[key]]
                     if meta.get("arg_shards") is not None:
@@ -512,6 +523,18 @@ class RemoteVTPUWorker:
                                 outer._handle_kv_ship(
                                     reply, remap_ids(meta), buffers,
                                     tenant)
+                                continue
+                            if kind in ("ALLREDUCE_SHIP",
+                                        "ALLGATHER_SHIP"):
+                                # federated collectives (protocol v7):
+                                # ride the QoS dispatcher as work items
+                                # whose heavy half is a deferred flush
+                                # — per-connection FIFO orders them
+                                # between the EXECUTEs that produce and
+                                # consume their buffers, no barrier
+                                outer._enqueue_collective(
+                                    reply, kind, remap_ids(meta),
+                                    buffers, tenant)
                                 continue
                             if kind in _BARRIER_KINDS:
                                 # these observe execution effects: wait
@@ -1340,11 +1363,203 @@ class RemoteVTPUWorker:
             self._exe_stacked[key] = fn
         return fn
 
+    # -- federated collectives (protocol v7, docs/federation.md) --------
+
+    def _enqueue_collective(self, reply, kind: str, meta, buffers,
+                            tenant) -> None:
+        """Connection handler side of ALLREDUCE_SHIP / ALLGATHER_SHIP:
+        double version gate (the client already refuses to send below
+        v7; a smuggled frame from a hand-rolled peer dies here), then
+        enqueue for the central dispatcher.  Collectives consume
+        resident partials already parked on this worker — rejecting
+        them with BUSY would orphan those buffers — so they block (TCP
+        backpressure) like sharded EXECUTEs."""
+        if meta.get("_wire_version", 2) < protocol.FED_MIN_VERSION:
+            reply("ERROR",
+                  {"error": f"{kind} needs protocol >= "
+                            f"{protocol.FED_MIN_VERSION} (negotiate "
+                            f"v7 at HELLO)"}, [])
+            return
+        item = WorkItem(kind, meta, buffers, reply, 1.0,
+                        f"<{kind.lower()}>", None, None,
+                        trace=self._parse_trace(meta))
+        self.dispatcher.submit(tenant, item, block=True)
+
+    def _collective_sources(self, ids, free_src: bool) -> List:
+        """Materialize the named resident buffers; ``free_src``
+        consumes them (the per-step partials a reduce retires — no
+        separate FREE round trip)."""
+        parts = []
+        for sid in ids:
+            sid = str(sid)
+            with self._lock:
+                arr = self._buffers.get(sid)
+            if arr is None:
+                raise KeyError(f"unknown buffer {sid}")
+            arr = self._resolve(arr)
+            parts.append(np.asarray(arr))
+            if free_src:
+                with self._lock:
+                    if self._buffers.pop(sid, None) is not None:
+                        self._buf_device.pop(sid, None)
+                        self._ephemeral.discard(sid)
+                        self._release_resident(arr)
+        return parts
+
+    def _launch_collective(self, item: WorkItem):
+        """Dispatcher arm for one collective item.  The launch phase is
+        deliberately empty: everything heavy — materializing the source
+        partials (which waits on the producing launch), reducing, and
+        encoding/shipping the reply — returns as the deferred flush, so
+        the dispatcher launches the connection's NEXT queued EXECUTE
+        first and the collective's transfer overlaps the following
+        microbatch's compute (the T3 discipline, server side)."""
+        def flush(_item=item):
+            try:
+                if _item.kind == "ALLREDUCE_SHIP":
+                    self._flush_allreduce(_item)
+                else:
+                    self._flush_allgather(_item)
+            except KeyError as e:
+                self._safe_reply(_item, "ERROR",
+                                 {"error": str(e.args[0])}, [])
+            except Exception as e:  # noqa: BLE001 - reply, keep serving
+                log.exception("%s failed", _item.kind)
+                self._safe_reply(_item, "ERROR", {"error": str(e)}, [])
+
+        return flush
+
+    @staticmethod
+    def _accumulate(terms: List[np.ndarray]) -> np.ndarray:
+        """Sum in f32 when the wire dtype is a half-float: the wire may
+        be 8-bit (q8), the reduction must not lose precision to the
+        accumulator (the EQuARX discipline)."""
+        out_dtype = terms[0].dtype
+        acc_dtype = np.float32 \
+            if out_dtype.name in ("float16", "bfloat16") else out_dtype
+        total = terms[0].astype(acc_dtype, copy=len(terms) > 1)
+        for t in terms[1:]:
+            total = total + t.astype(acc_dtype, copy=False)
+        return total.astype(out_dtype, copy=False)
+
+    def _install_resident(self, rid: str, total: np.ndarray,
+                          conn_ns: str) -> str:
+        """Park a reduced result device-resident under a client-minted
+        id (the re-scatter leg).  Re-installing over an existing id
+        releases the old buffer first so the budget never ratchets."""
+        import jax
+
+        if not rid.startswith(conn_ns):
+            # only ids the connection-namespace remap produced are
+            # accepted — a raw id could clobber another client's buffer
+            raise ValueError("result_id must be a c-namespace id")
+        with self._lock:
+            old = self._buffers.pop(rid, None)
+        if old is not None:
+            old = self._resolve(old)
+            with self._lock:
+                self._release_resident(old)
+        with self._lock:
+            err = self._admit_resident(int(total.nbytes))
+            if err:
+                raise RuntimeError(err)
+        arr = jax.device_put(total)
+        with self._lock:
+            self._buffers[rid] = arr
+            self._buf_device[rid] = 0
+        return rid
+
+    def _attr_collective(self, item: WorkItem, op: str, nbytes: int,
+                         ship_s: float) -> None:
+        """Per-tenant collective TIME attribution: the reduce+ship tail
+        onto the tpfprof transfer ledger (the materialize wait is the
+        producing launch's compute, already attributed via
+        inter-completion gaps).  The BYTE half (note_collective) is
+        recorded before the reply frame ships — same discipline as the
+        reply encoder's stats merge — so a client reading INFO right
+        after its receipt always sees the collective accounted."""
+        if self.profiler is not None and item.tenant is not None:
+            self.profiler.attribute(item.tenant.conn_id, "transfer",
+                                    max(ship_s, 0.0),
+                                    qos=item.tenant.qos)
+        # completion anchor: collective ship time must not be charged
+        # to the NEXT launch's inter-completion gap
+        self._last_completion_m = time.monotonic()
+
+    def _flush_allreduce(self, item: WorkItem) -> None:
+        meta, buffers = item.meta, item.buffers
+        op = meta.get("op", "sum")
+        if op != "sum":
+            raise ValueError(f"unsupported collective op {op!r}")
+        parts = self._collective_sources(meta.get("buf_ids") or [],
+                                         bool(meta.get("free_src")))
+        acc = None
+        acc_bufs = meta.get("acc_bufs")
+        if acc_bufs:
+            # the client's running accumulator rode the upload stream
+            # as a quiet ephemeral PUT (q8-eligible); consume it
+            acc = np.asarray(self._take_shard(str(acc_bufs[0])))
+        elif buffers:
+            acc = np.asarray(buffers[0])
+        terms = parts + ([acc] if acc is not None else [])
+        if not terms:
+            raise ValueError("ALLREDUCE_SHIP with nothing to reduce")
+        m1 = time.monotonic()
+        total = self._accumulate(terms)
+        installed = None
+        rid = meta.get("result_id")
+        if rid is not None:
+            installed = self._install_resident(
+                str(rid), total, meta.get("_conn_ns", ""))
+        rmeta = {"op": op, "n_src": len(parts),
+                 "shape": list(total.shape), "dtype": total.dtype.name}
+        if installed is not None:
+            rmeta["installed"] = installed
+        nbytes = sum(int(p.nbytes) for p in parts) + \
+            (int(acc.nbytes) if acc is not None else 0)
+        self.dispatcher.note_collective(meta.get("_conn_ns", ""),
+                                        "allreduce", nbytes)
+        if not (meta.get("quiet") and meta.get("receipt_only")):
+            # fire-and-forget installs skip the frame (errors above
+            # still reply); everything else ships the receipt — plus
+            # the reduced array unless receipt_only
+            rbufs = [] if meta.get("receipt_only") else [total]
+            self._safe_reply(item, "ALLREDUCE_SHIP_OK",
+                             self._traced_meta(item, rmeta), rbufs,
+                             compress=True)
+        self._attr_collective(item, "allreduce", nbytes,
+                              time.monotonic() - m1)
+
+    def _flush_allgather(self, item: WorkItem) -> None:
+        meta = item.meta
+        axis = int(meta.get("axis", 0) or 0)
+        parts = self._collective_sources(meta.get("buf_ids") or [],
+                                         bool(meta.get("free_src")))
+        if not parts:
+            raise ValueError("ALLGATHER_SHIP with no source buffers")
+        m1 = time.monotonic()
+        # local gather: one frame leaves the worker however many local
+        # pieces fed it
+        piece = parts[0] if len(parts) == 1 \
+            else np.concatenate(parts, axis=axis)
+        rmeta = {"n_src": len(parts), "shape": list(piece.shape),
+                 "dtype": piece.dtype.name}
+        nbytes = sum(int(p.nbytes) for p in parts)
+        self.dispatcher.note_collective(meta.get("_conn_ns", ""),
+                                        "allgather", nbytes)
+        self._safe_reply(item, "ALLGATHER_SHIP_OK",
+                         self._traced_meta(item, rmeta), [piece],
+                         compress=True)
+        self._attr_collective(item, "allgather", nbytes,
+                              time.monotonic() - m1)
+
     def _execute_batch(self, items: List[WorkItem], peek_next):
         """Dispatcher callback: launch one work batch onto the devices.
         Returns a deferred flush (blocking result materialization +
         reply) when there is one, so the dispatcher can overlap it with
         the next launch."""
+        if len(items) == 1 and items[0].kind != "EXECUTE":
+            return self._launch_collective(items[0])
         if len(items) == 1:
             return self._execute_one(items[0], peek_next)
         return self._execute_fused(items, peek_next)

@@ -196,6 +196,7 @@ class ServingEngine:
                  max_waiting: int = DEFAULT_MAX_WAITING,
                  profiler=None, recorder=None,
                  prefix_sharing: bool = True,
+                 persistent_prefix: bool = False,
                  draft=None, spec_k: int = 0,
                  prefill_pool=None,
                  disagg_min_tokens: int = 0):
@@ -204,6 +205,13 @@ class ServingEngine:
         #: copy-on-write prefix sharing over the paged pool (the
         #: no-sharing baseline cells pass False)
         self.prefix_sharing = bool(prefix_sharing)
+        #: persistent prefix cache (ROADMAP 4a, docs/serving.md): the
+        #: registry holds its own refcount on published blocks, so a
+        #: shared system prompt survives quiescent gaps; cache blocks
+        #: are evicted lowest-id first under pool pressure
+        #: (kv_prefix_cache_evictions_total)
+        self.persistent_prefix = bool(persistent_prefix) and \
+            self.prefix_sharing
         #: speculative decoding: ``draft.propose(context, k)`` proposes
         #: up to ``spec_k`` tokens per sequence per step, verified in
         #: one fused target step with greedy-exact accept/reject
@@ -240,7 +248,9 @@ class ServingEngine:
         self.max_batch = max(1, max_batch)
         self.prefill_chunk_tokens = max(1, prefill_chunk_tokens)
         self.max_waiting = max(1, max_waiting)
-        self.account = BlockAccount(runner.num_blocks, runner.block_size)
+        self.account = BlockAccount(
+            runner.num_blocks, runner.block_size,
+            persistent_prefix=self.persistent_prefix)
         self._cv = threading.Condition()
         # guarded by: _cv
         self._waiting: List[Sequence] = []

@@ -105,10 +105,25 @@ class BlockAccount:
     reference of their own: a block lives exactly as long as sequences
     reference it, so eviction/preemption only ever reclaims blocks
     whose refcount hits zero and quiescence reclaims the whole pool.
+
+    **Persistent prefix cache** (``persistent_prefix=True``,
+    ROADMAP 4a, docs/serving.md): the registry takes a reference of
+    its OWN on every block it registers, so a shared system prompt
+    survives quiescent gaps — sharing no longer requires the prefix's
+    sequences to be concurrently live.  The cache yields under
+    pressure: whenever an allocation would fail, cache-only blocks
+    (refcount 1, held by the registry alone) are evicted lowest-id
+    first until the allocation fits (``prefix_cache_evictions_total``;
+    ``kv_prefix_cache_evictions_total`` on the metrics line), and
+    ``can_fit`` counts those evictable blocks as available so
+    admission never stalls on cache-held capacity.  Default OFF: the
+    historical reclaim-at-quiescence contract (and the sim invariant
+    built on it) is unchanged unless the engine opts in.
     """
 
     def __init__(self, num_blocks: int, block_size: int,
-                 reserved: int = RESERVED_BLOCKS):
+                 reserved: int = RESERVED_BLOCKS,
+                 persistent_prefix: bool = False):
         if num_blocks <= reserved:
             raise ValueError(
                 f"pool of {num_blocks} blocks leaves nothing usable "
@@ -142,6 +157,15 @@ class BlockAccount:
         self.prefix_hits = 0            # blocks adopted via the registry
         self.prefix_hit_tokens = 0      # prompt tokens served from it
         self.cow_copies = 0             # copy-on-write block copies
+        #: persistent prefix cache (ROADMAP 4a): when on, publish()
+        #: takes a cache-owned reference so registered content
+        #: outlives its sequences; pressure evicts lowest-id first
+        self.persistent_prefix = bool(persistent_prefix)
+        #: blocks the registry itself holds a reference on
+        self._cache_held: set = set()
+        #: cache blocks evicted under allocation pressure —
+        #: kv_prefix_cache_evictions_total
+        self.prefix_cache_evictions = 0
 
     # -- capacity ---------------------------------------------------------
 
@@ -164,8 +188,42 @@ class BlockAccount:
         """Most tokens a single sequence could ever hold."""
         return self.usable_blocks * self.block_size
 
+    @property
+    def evictable_blocks(self) -> int:
+        """Cache-only blocks (registry is the sole holder) the
+        pressure path could reclaim right now."""
+        if not self._cache_held:
+            return 0
+        return sum(1 for b in self._cache_held
+                   if self._refs.get(b) == 1)
+
     def can_fit(self, n_tokens: int) -> bool:
-        return self.blocks_for(n_tokens) <= len(self._free)
+        # cache-held capacity counts as available: the persistent
+        # prefix cache always yields to a real allocation
+        return self.blocks_for(n_tokens) <= \
+            len(self._free) + self.evictable_blocks
+
+    def _evict_cache_for(self, need: int) -> None:
+        """Pressure-driven eviction: free ``need`` blocks from the
+        cache-only holdings, lowest id first (the same determinism
+        discipline as the free list), unregistering their content."""
+        if need <= 0 or not self._cache_held:
+            return
+        for blk in sorted(self._cache_held):
+            if need <= 0:
+                break
+            if self._refs.get(blk) != 1:
+                continue        # a live sequence still shares it
+            self._cache_held.discard(blk)
+            del self._refs[blk]
+            key = self._key_of.pop(blk, None)
+            if key is not None:
+                self._by_key.pop(key, None)
+            self._free.append(blk)
+            self.total_released += 1
+            self.prefix_cache_evictions += 1
+            need -= 1
+        self._free.sort(reverse=True)
 
     def nbytes(self, per_block_bytes: int) -> int:
         return self.num_blocks * per_block_bytes
@@ -179,6 +237,8 @@ class BlockAccount:
         need = self.blocks_for(n_tokens) - len(table)
         if need <= 0:
             return True
+        if need > len(self._free):
+            self._evict_cache_for(need - len(self._free))
         if need > len(self._free):
             return False
         for _ in range(need):
@@ -304,6 +364,8 @@ class BlockAccount:
         """Grow ``owner``'s table by ONE fresh block (KV_SHIP ingest
         writes shipped pages into it); None when the pool is out."""
         if not self._free:
+            self._evict_cache_for(1)
+        if not self._free:
             return None
         blk = self._free.pop()
         self._refs[blk] = 1
@@ -324,7 +386,35 @@ class BlockAccount:
             return False
         self._by_key[key] = blk
         self._key_of[blk] = key
+        if self.persistent_prefix and blk not in self._cache_held:
+            # cache-owned reference: the content outlives its
+            # sequences, reclaimed only by pressure eviction (or
+            # drop_prefix_cache)
+            self._cache_held.add(blk)
+            self._refs[blk] += 1
         return True
+
+    def drop_prefix_cache(self) -> int:
+        """Release every cache-owned reference (engine shutdown /
+        explicit flush).  Blocks still shared by live sequences stay
+        resident for them; cache-only blocks return to the pool.
+        Returns blocks physically reclaimed."""
+        freed = 0
+        for blk in sorted(self._cache_held):
+            refs = self._refs.get(blk, 0)
+            if refs <= 1:
+                self._refs.pop(blk, None)
+                key = self._key_of.pop(blk, None)
+                if key is not None:
+                    self._by_key.pop(key, None)
+                self._free.append(blk)
+                self.total_released += 1
+                freed += 1
+            else:
+                self._refs[blk] = refs - 1
+        self._cache_held.clear()
+        self._free.sort(reverse=True)
+        return freed
 
     def writable(self, owner: object, index: int
                  ) -> Optional[Tuple[int, Optional[int]]]:
@@ -338,6 +428,8 @@ class BlockAccount:
         table = self._owned[owner]
         blk = table[index]
         if self._refs[blk] > 1:
+            if not self._free:
+                self._evict_cache_for(1)
             if not self._free:
                 return None
             new = self._free.pop()
@@ -388,6 +480,10 @@ class BlockAccount:
                 "prefix_hit_tokens_total": self.prefix_hit_tokens,
                 "cow_copies_total": self.cow_copies,
                 "registered_keys": len(self._by_key),
+                "persistent_prefix": int(self.persistent_prefix),
+                "cache_held_blocks": len(self._cache_held),
+                "prefix_cache_evictions_total":
+                    self.prefix_cache_evictions,
                 "utilization_pct": self.utilization_pct()}
 
 

@@ -39,6 +39,16 @@ SPAN_SCHEMA = {
     "worker.flush": {
         "attrs": ("exe_id", "results"),
     },
+    # -- federated multi-worker meshes (remoting/federation.py,
+    # docs/federation.md): one cross-worker collective (flat or ring)
+    # and one per-worker shard launch of a federated call/step
+    "fed.collective": {
+        "attrs": ("op", "workers", "ring", "raw_bytes", "wire_bytes",
+                  "hidden_ms"),
+    },
+    "fed.shard_exec": {
+        "attrs": ("worker", "fn", "mode"),
+    },
     # -- serving engine (tpfserve: continuous batching, docs/serving.md)
     "client.generate": {
         "attrs": ("tokens", "ttft_ms", "busy_retries"),

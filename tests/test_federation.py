@@ -1,0 +1,484 @@
+"""Federated multi-worker meshes (ISSUE 13, docs/federation.md):
+mesh composition over N workers, the protocol-v7 collective opcodes,
+q8 collective numerics bounds, the mixed-version interop battery
+(v2-v6 peers must never see the new kinds — raw-socket frame-kind
+assertions both directions), and the observability surfaces."""
+
+import json
+import socket
+import struct
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorfusion_tpu.remoting import (FederatedDevice, RemoteDevice,
+                                       RemoteExecutionError,
+                                       RemoteVTPUWorker)
+from tensorfusion_tpu.remoting import protocol as P
+
+FED_KINDS = ("ALLREDUCE_SHIP", "ALLGATHER_SHIP",
+             "ALLREDUCE_SHIP_OK", "ALLGATHER_SHIP_OK")
+
+
+@pytest.fixture()
+def workers2():
+    ws = [RemoteVTPUWorker(), RemoteVTPUWorker()]
+    for w in ws:
+        w.start()
+    yield ws
+    for w in ws:
+        w.stop()
+
+
+@pytest.fixture()
+def workers3():
+    ws = [RemoteVTPUWorker() for _ in range(3)]
+    for w in ws:
+        w.start()
+    yield ws
+    for w in ws:
+        w.stop()
+
+
+class FrameTap:
+    """TCP forwarder that decodes the frame KIND of every message in
+    both directions (client->worker and worker->client) while
+    forwarding the exact bytes — the raw-socket assertion layer the
+    mixed-version battery uses to prove a federation over old workers
+    puts ZERO new-opcode frames on the wire."""
+
+    def __init__(self, target_port: int):
+        self.target_port = target_port
+        self.kinds_up = []       # client -> worker
+        self.kinds_down = []     # worker -> client
+        self._listen = socket.socket()
+        self._listen.bind(("127.0.0.1", 0))
+        self._listen.listen(8)
+        self.port = self._listen.getsockname()[1]
+        self._alive = True
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        while self._alive:
+            try:
+                cli, _ = self._listen.accept()
+            except OSError:
+                return
+            srv = socket.create_connection(("127.0.0.1",
+                                            self.target_port))
+            threading.Thread(target=self._pump,
+                             args=(cli, srv, self.kinds_up),
+                             daemon=True).start()
+            threading.Thread(target=self._pump,
+                             args=(srv, cli, self.kinds_down),
+                             daemon=True).start()
+
+    @staticmethod
+    def _read_exact(sock, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("closed")
+            buf += chunk
+        return buf
+
+    def _pump(self, src, dst, kinds):
+        try:
+            while True:
+                head = self._read_exact(src, 12)
+                _, hlen = struct.unpack("<II", head[4:])
+                header = self._read_exact(src, hlen)
+                parsed = json.loads(header)
+                kinds.append(parsed["kind"])
+                body = b"".join(
+                    self._read_exact(src, d["nbytes"])
+                    for d in parsed["buffers"])
+                dst.sendall(head + header + body)
+        except (OSError, ConnectionError, ValueError):
+            try:
+                dst.shutdown(2)
+            except OSError:
+                pass
+
+    def close(self):
+        self._alive = False
+        self._listen.close()
+
+
+def _fn(w, x):
+    return jnp.tanh(x * 1.01) @ w
+
+
+def _grad_fn(w, x):
+    return x.T @ jnp.tanh(x @ w)
+
+
+# -- mesh composition + numerics guardrails --------------------------------
+
+
+def test_federated_concat_bit_exact_vs_single_worker(workers2):
+    """2-worker federated forward pass, raw wire: bit-compared against
+    the single-worker baseline (elementwise row-independent math, so
+    the split cannot move a single bit)."""
+    fed = FederatedDevice([w.url for w in workers2])
+    single = RemoteDevice(workers2[0].url)
+    fn = jax.jit(lambda x: jnp.tanh(x * 1.01))
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((17, 32)).astype(np.float32)  # uneven split
+    got = fed.federated_jit(fn, in_axes=0)(x)
+    want = np.asarray(single.remote_jit(fn)(x))
+    np.testing.assert_array_equal(np.asarray(got), want)
+    assert fed.fed_supported()
+    fed.close()
+    single.close()
+
+
+def test_federated_sum_and_first_modes(workers2):
+    """out_modes: "sum" reduces per-worker partials client-side (the
+    no-resident path), "first" takes the replicated member."""
+    fed = FederatedDevice([w.url for w in workers2])
+    rng = np.random.default_rng(4)
+    W = rng.standard_normal((16, 16)).astype(np.float32)
+    x = rng.standard_normal((12, 16)).astype(np.float32)
+    ffn = fed.federated_jit(_grad_fn, in_axes=(None, 0),
+                            out_modes="sum")
+    got = np.asarray(ffn(W, x))
+    want = np.asarray(jax.jit(_grad_fn)(jnp.asarray(W),
+                                        jnp.asarray(x)))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    rep = fed.federated_jit(jax.jit(lambda w: w * 2.0), in_axes=None,
+                            out_modes="first")
+    np.testing.assert_array_equal(np.asarray(rep(W)), W * 2.0)
+    fed.close()
+
+
+def test_resident_step_allreduce_install_and_free(workers2):
+    """The training-shape pipeline: fire-and-forget resident steps,
+    ALLREDUCE_SHIP collect with free_src (partials retired with the
+    reduce), install re-scattering the total as fresh residents."""
+    fed = FederatedDevice([w.url for w in workers2])
+    rng = np.random.default_rng(5)
+    W = rng.standard_normal((16, 16)).astype(np.float32)
+    x = rng.standard_normal((10, 16)).astype(np.float32)
+    ffn = fed.federated_jit(_grad_fn, in_axes=(None, 0),
+                            out_modes="sum")
+    wh = ffn.upload_arg(0, W, W, x)
+    step = ffn.step_resident(wh, x)
+    out = fed.all_reduce(step.handles, free_src=True,
+                         overlap_with=step, install=True)
+    want = np.asarray(jax.jit(_grad_fn)(jnp.asarray(W),
+                                        jnp.asarray(x)))
+    np.testing.assert_allclose(out["value"], want, rtol=1e-5,
+                               atol=1e-5)
+    # install parked one resident copy per worker
+    assert out["handles"] is not None and len(out["handles"]) == 2
+    for h in out["handles"]:
+        np.testing.assert_allclose(h.fetch(), out["value"],
+                                   rtol=1e-6, atol=1e-6)
+    # free_src consumed the partials: fetching one must fail
+    with pytest.raises(RemoteExecutionError):
+        step.handles[0].fetch()
+    for h in out["handles"]:
+        h.free()
+    snap = fed.fed_snapshot()
+    assert snap["allreduce_total"] == 1
+    assert snap["collective_raw_bytes"] > 0
+    fed.close()
+
+
+def test_ring_reduce_three_workers(workers3):
+    """``ring=True`` at N >= 3 runs the client-relayed ring: the
+    accumulator visits each worker once (summed worker-side); the
+    result matches the full-batch reference."""
+    fed = FederatedDevice([w.url for w in workers3], ring=True)
+    assert fed.n_workers == 3
+    rng = np.random.default_rng(6)
+    W = rng.standard_normal((8, 8)).astype(np.float32)
+    x = rng.standard_normal((9, 8)).astype(np.float32)
+    ffn = fed.federated_jit(_grad_fn, in_axes=(None, 0),
+                            out_modes="sum")
+    wh = ffn.upload_arg(0, W, W, x)
+    step = ffn.step_resident(wh, x)
+    out = fed.all_reduce(step.handles, free_src=True)
+    want = np.asarray(jax.jit(_grad_fn)(jnp.asarray(W),
+                                        jnp.asarray(x)))
+    np.testing.assert_allclose(out["value"], want, rtol=1e-4,
+                               atol=1e-4)
+    fed.close()
+
+
+def test_all_gather_concatenates_in_mesh_order(workers2):
+    fed = FederatedDevice([w.url for w in workers2])
+    devs = fed.workers
+    parts = [np.full((2, 3), i, np.float32) for i in range(2)]
+    handles = [dev.put(p) for dev, p in zip(devs, parts)]
+    got = fed.all_gather(handles, axis=0, free_src=True)
+    np.testing.assert_array_equal(got, np.concatenate(parts, axis=0))
+    with pytest.raises(RemoteExecutionError):
+        handles[0].fetch()
+    assert fed.fed_snapshot()["allgather_total"] == 1
+    fed.close()
+
+
+def test_allreduce_int_data_exact_path(workers2):
+    """Exact-path opt-out: integer partials never quantize whatever
+    the policy says — a q8-opted federation still reduces ints
+    bit-exactly."""
+    fed = FederatedDevice([w.url for w in workers2], quantize=True)
+    devs = fed.workers
+    rng = np.random.default_rng(7)
+    parts = [rng.integers(-1000, 1000, (64, 64)).astype(np.int32)
+             for _ in range(2)]
+    handles = [dev.put(p) for dev, p in zip(devs, parts)]
+    out = fed.all_reduce(handles, free_src=True)
+    np.testing.assert_array_equal(out["value"], parts[0] + parts[1])
+    fed.close()
+
+
+@pytest.mark.parametrize("dtype,shape", [
+    ("float32", (300, 41)),          # non-aligned vs Q8_BLOCK
+    ("float16", (4097,)),
+    ("bfloat16", (123, 35)),
+])
+def test_q8_collective_roundtrip_error_bounded_per_hop(dtype, shape):
+    """EQuARX block math over the federated reduce path: each wire hop
+    quantizes per 512-element block with s = max|block|/127, so R hops
+    accumulate at most R * s_max/2 per element (plus the dtype's own
+    resolution for half floats).  Checked across dtypes and shard
+    shapes that do NOT align with the block size."""
+    if dtype == "bfloat16":
+        import ml_dtypes
+
+        np_dtype = np.dtype(ml_dtypes.bfloat16)
+    else:
+        np_dtype = np.dtype(dtype)
+    rng = np.random.default_rng(8)
+    x = (rng.standard_normal(shape) * 3.0).astype(np_dtype)
+    hops = 3
+    cur = np.asarray(x, np.float32)
+    worst_scale = 0.0
+    for _ in range(hops):
+        arr = cur.astype(np_dtype)
+        wire = P.q8_encode(np.ascontiguousarray(arr))
+        desc = {"shape": list(arr.shape), "dtype": dtype,
+                "nbytes": len(wire), "raw_nbytes": arr.nbytes,
+                "enc": "q8", "q8_block": P.Q8_BLOCK}
+        out = P.q8_decode(bytes(wire), desc)
+        worst_scale = max(worst_scale,
+                          float(np.abs(cur).max()) / 127.0)
+        cur = np.asarray(out, np.float32)
+    err = np.abs(cur - np.asarray(x, np.float32)).max()
+    # per-hop q8 error <= scale/2; half-float casts add their own ulp
+    half_eps = {"float32": 0.0, "float16": 2e-3,
+                "bfloat16": 1.6e-2}[dtype]
+    bound = hops * (worst_scale / 2 + half_eps *
+                    max(float(np.abs(x).max()), 1.0)) * 1.2
+    assert err <= bound, (err, bound)
+
+
+def test_q8_federated_forward_bounded_and_fewer_wire_bytes(workers2):
+    """2-worker federated pass with q8 opted in: numerics inside the
+    quantization bound vs the raw-mode result, and the collective
+    ships >= 2x fewer wire bytes than raw."""
+    rng = np.random.default_rng(9)
+    W = rng.standard_normal((256, 256)).astype(np.float32) * 0.05
+    x = rng.standard_normal((512, 256)).astype(np.float32)
+
+    results = {}
+    for mode, quant in (("raw", False), ("q8", True)):
+        fed = FederatedDevice([w.url for w in workers2],
+                              quantize=quant)
+        ffn = fed.federated_jit(_grad_fn, in_axes=(None, 0),
+                                out_modes="sum")
+        wh = ffn.upload_arg(0, W, W, x)
+        step = ffn.step_resident(wh, x)
+        out = fed.all_reduce(step.handles, free_src=True,
+                             overlap_with=step)
+        results[mode] = out
+        fed.close()
+    raw_v, q8_v = results["raw"]["value"], results["q8"]["value"]
+    # per-element reply quantization bound on each worker's partial
+    s = max(float(np.abs(raw_v).max()), 1e-9) / 127.0
+    assert np.abs(q8_v - raw_v).max() <= 2 * s * 1.5
+    assert results["raw"]["wire_bytes"] >= \
+        2 * results["q8"]["wire_bytes"], results
+    assert results["q8"]["raw_bytes"] >= \
+        2 * results["q8"]["wire_bytes"]
+
+
+# -- mixed-version interop battery (satellite 2) ---------------------------
+
+
+@pytest.mark.parametrize("old_version", [2, 3, 4, 5, 6])
+def test_fed_falls_back_on_old_workers_zero_new_frames(old_version):
+    """A FederatedDevice over pre-v7 workers degrades to single-worker
+    execution on member 0 — and the raw-socket frame taps prove ZERO
+    new-opcode frames crossed the wire in EITHER direction."""
+    ws = [RemoteVTPUWorker(protocol_version=old_version)
+          for _ in range(2)]
+    for w in ws:
+        w.start()
+    taps = [FrameTap(w.port) for w in ws]
+    try:
+        fed = FederatedDevice([f"tcp://127.0.0.1:{t.port}"
+                               for t in taps])
+        assert not fed.fed_supported()
+        fn = jax.jit(lambda x: x * 2.0 + 1.0)
+        rng = np.random.default_rng(10)
+        x = rng.standard_normal((8, 8)).astype(np.float32)
+        got = np.asarray(fed.federated_jit(fn, in_axes=0)(x))
+        np.testing.assert_allclose(got, x * 2.0 + 1.0, rtol=1e-6)
+        # the resident-step + reduce path degrades too
+        ffn = fed.federated_jit(fn, in_axes=0, out_modes="sum")
+        if old_version >= 3:         # step_resident needs v3 ids
+            step = ffn.step_resident(x)
+            out = fed.all_reduce(step.handles, free_src=True)
+            np.testing.assert_allclose(out["value"], x * 2.0 + 1.0,
+                                       rtol=1e-6)
+        snap = fed.fed_snapshot()
+        assert snap["fallback_calls_total"] >= 1
+        assert snap["allreduce_total"] == 0
+        fed.close()
+        seen = set(taps[0].kinds_up + taps[0].kinds_down
+                   + taps[1].kinds_up + taps[1].kinds_down)
+        assert not (seen & set(FED_KINDS)), seen
+        # the fallback really ran on member 0 only: member 1 saw at
+        # most the HELLO/INFO probe, never an EXECUTE
+        assert "EXECUTE" not in taps[1].kinds_up
+    finally:
+        for t in taps:
+            t.close()
+        for w in ws:
+            w.stop()
+
+
+def test_fed_v7_opcodes_actually_on_wire(workers2):
+    """The positive control for the tap battery: over v7 workers the
+    collective kinds DO cross the wire, both directions."""
+    taps = [FrameTap(w.port) for w in workers2]
+    try:
+        fed = FederatedDevice([f"tcp://127.0.0.1:{t.port}"
+                               for t in taps])
+        devs = fed.workers
+        parts = [np.ones((8, 8), np.float32) * (i + 1)
+                 for i in range(2)]
+        handles = [dev.put(p) for dev, p in zip(devs, parts)]
+        out = fed.all_reduce(handles, free_src=True)
+        np.testing.assert_allclose(out["value"], parts[0] + parts[1])
+        fed.close()
+        for tap in taps:
+            assert "ALLREDUCE_SHIP" in tap.kinds_up, tap.kinds_up
+            assert "ALLREDUCE_SHIP_OK" in tap.kinds_down, \
+                tap.kinds_down
+    finally:
+        for t in taps:
+            t.close()
+
+
+def test_client_gate_pinned_v6_client_refuses(workers2):
+    """A v6-pinned client build refuses to emit the kinds before
+    anything hits the wire."""
+    dev = RemoteDevice(workers2[0].url, protocol_version=6)
+    ref = dev.put(np.ones((4, 4), np.float32))
+    with pytest.raises(RemoteExecutionError, match="protocol v7"):
+        dev.allreduce_ship([ref.buf_id])
+    with pytest.raises(RemoteExecutionError, match="protocol v7"):
+        dev.allgather_ship([ref.buf_id])
+    ref.free()
+    dev.close()
+
+
+def test_worker_gate_rejects_smuggled_frame_below_v7(workers2):
+    """Double gate, worker half: a hand-rolled peer that negotiated v6
+    but smuggles an ALLREDUCE_SHIP frame anyway gets a structured
+    ERROR, not service."""
+    w = workers2[0]
+    s = socket.create_connection(("127.0.0.1", w.port))
+    try:
+        P.send_message(s, "HELLO", {"max_version": 6, "seq": 1}, [],
+                       version=P.HELLO_VERSION)
+        kind, meta, _ = P.recv_message(s)
+        assert kind == "HELLO_OK" and meta["version"] == 6
+        P.send_message(s, "ALLREDUCE_SHIP",
+                       {"buf_ids": [], "seq": 2}, [], version=6)
+        kind, meta, _ = P.recv_message(s)
+        assert kind == "ERROR"
+        assert "protocol >= 7" in meta["error"]
+    finally:
+        s.close()
+
+
+# -- observability surfaces -------------------------------------------------
+
+
+def test_collective_bytes_attributed_to_owning_tenant(workers2):
+    """Dispatcher tenant counters carry per-tenant collective ops and
+    bytes (INFO "dispatch"), and the worker profiler ledgers transfer
+    time for the collective's reduce+ship tail."""
+    w = workers2[0]
+    dev = RemoteDevice(w.url)
+    part = np.ones((128, 128), np.float32)
+    ref = dev.put(part)
+    rmeta, total = dev.allreduce_ship([ref.buf_id], free_src=True)
+    np.testing.assert_array_equal(total, part)
+    info = dev.info()
+    d = info["dispatch"]
+    assert d["collective_ops"] == 1
+    assert d["collective_bytes"] == part.nbytes
+    per_tenant = list(d["tenants"].values())
+    assert any(t["collective_ops"] == 1 and
+               t["collective_bytes"] == part.nbytes
+               for t in per_tenant), per_tenant
+    dev.close()
+
+
+def test_fed_metrics_lines_conform_to_schema(workers2):
+    """federation_lines emits tpf_fed_collective exactly per
+    METRICS_SCHEMA (tags + declared fields only)."""
+    from tensorfusion_tpu.hypervisor.metrics import federation_lines
+    from tensorfusion_tpu.metrics.schema import METRICS_SCHEMA
+
+    fed = FederatedDevice([w.url for w in workers2])
+    devs = fed.workers
+    handles = [dev.put(np.ones((4, 4), np.float32)) for dev in devs]
+    fed.all_reduce(handles, free_src=True)
+    lines = federation_lines(fed, "n1", 123)
+    assert len(lines) == 1 and lines[0].startswith(
+        "tpf_fed_collective,")
+    schema = METRICS_SCHEMA["tpf_fed_collective"]
+    head, fields, _ = lines[0].split(" ")
+    tags = dict(kv.split("=") for kv in head.split(",")[1:])
+    assert set(tags) == set(schema["tags"])
+    keys = {kv.split("=")[0] for kv in fields.split(",")}
+    assert keys <= set(schema["fields"])
+    assert "allreduce_total" in keys
+    fed.close()
+
+
+def test_fed_spans_recorded_and_overlap_ledger_fed(workers2):
+    """fed.collective / fed.shard_exec spans land in the client
+    tracer, and the federation profiler ledgers collective transfer
+    with a hidden share (the overlap ledger's numerator)."""
+    from tensorfusion_tpu.profiling.profiler import Profiler
+    from tensorfusion_tpu.tracing import Tracer
+
+    tracer = Tracer(service="fed-test", sample=1.0)
+    prof = Profiler(name="fed-test")
+    fed = FederatedDevice([w.url for w in workers2], tracer=tracer,
+                          profiler=prof, tenant="fedA")
+    ffn = fed.federated_jit(jax.jit(lambda x: x + 1.0), in_axes=0,
+                            out_modes="sum")
+    x = np.ones((8, 4), np.float32)
+    step = ffn.step_resident(x)
+    fed.all_reduce(step.handles, free_src=True, overlap_with=step)
+    names = {s["name"] for s in tracer.finished()}
+    assert "fed.collective" in names and "fed.shard_exec" in names
+    snap = prof.snapshot()
+    t = snap["tenants"].get("fedA")
+    assert t is not None and t["transfer_s"] > 0
+    fed.close()

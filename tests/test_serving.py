@@ -1492,3 +1492,144 @@ def test_recorder_field_scoped_exemplars_prefix_and_spec(params):
                                   field="tokens_total") != []
     finally:
         op.stop()
+
+
+# -- persistent prefix cache (ISSUE 13 satellite, ROADMAP 4a) --------------
+
+
+def test_persistent_prefix_cache_survives_quiescent_gap():
+    """With cache-owned refcounts the registry outlives its sequences:
+    a system prompt prefilled once is adopted by a later arrival even
+    though NO sequence kept it alive in between (the exact gap the
+    registry's no-reference-of-its-own design left open)."""
+    prompt = list(range(1, 17))              # 4 full blocks
+    results = {}
+    for persist in (False, True):
+        eng = ServingEngine(FakeRunner(num_blocks=64, block_size=4),
+                            max_batch=2, prefix_sharing=True,
+                            persistent_prefix=persist)
+        done, emit = _collect()
+        eng.submit(prompt, 3, tenant="first", emit=emit)
+        for _ in range(60):
+            if done:
+                break
+            eng.step()
+        assert done                          # fully retired: quiescent
+        kv = eng.snapshot()["kv"]
+        assert kv["owners"] == 0
+        # second arrival after the gap
+        done2, emit2 = _collect()
+        eng.submit(prompt, 3, tenant="second", emit=emit2)
+        for _ in range(60):
+            if done2:
+                break
+            eng.step()
+        results[persist] = eng.snapshot()["kv"]
+    assert results[False]["prefix_hit_tokens_total"] == 0
+    assert results[True]["prefix_hit_tokens_total"] >= 16
+    assert results[True]["cache_held_blocks"] >= 4
+    # default-off keeps the reclaim-at-quiescence contract
+    assert results[False]["used"] == 0
+
+
+def test_persistent_prefix_cache_pressure_evicts_lowest_id():
+    """Allocation pressure reclaims cache-only blocks lowest-id first,
+    counted by prefix_cache_evictions_total; blocks still shared by a
+    live sequence are never evicted."""
+    from tensorfusion_tpu.serving import prompt_block_keys
+
+    a = BlockAccount(10, 4, persistent_prefix=True)
+    keys = prompt_block_keys(list(range(12)), 4)     # 3 blocks
+    assert a.ensure("s1", 12)
+    for i, (k, _) in enumerate(keys):
+        assert a.publish("s1", i, k)
+    assert a.release("s1") == 0          # cache holds everything
+    assert a.used_blocks == 3 and a.evictable_blocks == 3
+    # a live holder pins its blocks against eviction
+    assert a.adopt("live", keys[:1]) == 4
+    assert a.evictable_blocks == 2
+    # demand everything: can_fit counts evictable, ensure evicts
+    assert a.can_fit(4 * 8)
+    assert a.ensure("big", 4 * 8)
+    assert a.prefix_cache_evictions == 2
+    snap = a.snapshot()
+    assert snap["prefix_cache_evictions_total"] == 2
+    assert snap["cache_held_blocks"] == 1
+    # the pinned block survived: its holder still maps it
+    assert a.refcount(a.table("live")[0]) == 2
+    a.release("big")
+    a.release("live")
+    assert a.drop_prefix_cache() == 1
+    assert a.used_blocks == 0 and len(a._by_key) == 0
+
+
+def test_persistent_prefix_cache_churn_regression():
+    """Churn regression (the satellite's named test): hundreds of
+    admit/retire rounds over a small shared-prompt set on a tight pool
+    with the persistent cache on — refcount/table/free-list invariants
+    hold every round, the cache yields under pressure instead of
+    wedging admission, and an explicit drop + full release reclaims
+    the pool completely."""
+    import random
+
+    from tensorfusion_tpu.serving import prompt_block_keys
+
+    rng = random.Random(42)
+    a = BlockAccount(24, 4, persistent_prefix=True)
+    prompts = [[p] * 12 for p in (1, 2, 3, 4, 5, 6, 7, 8)]
+    live = {}
+    for round_no in range(400):
+        # admit
+        if len(live) < 4 and rng.random() < 0.7:
+            owner = f"seq{round_no}"
+            prompt = rng.choice(prompts)
+            keys = prompt_block_keys(prompt, 4)
+            if a.can_fit(len(prompt) + 4):
+                matched = a.adopt(owner, keys)
+                if not a.ensure(owner, len(prompt) + 4):
+                    a.release(owner)
+                else:
+                    live[owner] = keys
+                    if matched == 0:
+                        for i, (k, _) in enumerate(keys):
+                            a.publish(owner, i, k)
+        # retire
+        if live and rng.random() < 0.5:
+            owner = rng.choice(sorted(live))
+            del live[owner]
+            a.release(owner)
+        # invariants every round
+        assert a.used_blocks == a.usable_blocks - a.free_blocks
+        assert a.logical_blocks == sum(a._refs.values())
+        assert len(set(a._free)) == len(a._free)
+        for blk in a._cache_held:
+            assert a.refcount(blk) >= 1
+        for key, blk in a._by_key.items():
+            assert a._key_of[blk] == key
+    assert a.prefix_cache_evictions > 0      # pressure actually fired
+    for owner in list(live):
+        a.release(owner)
+    a.drop_prefix_cache()
+    assert a.used_blocks == 0
+    assert a.snapshot()["owners"] == 0
+
+
+def test_persistent_prefix_metrics_line():
+    """kv_prefix_cache_evictions_total + kv_prefix_cache_blocks ride
+    the tpf_serving_engine line (METRICS_SCHEMA rows)."""
+    from tensorfusion_tpu.hypervisor.metrics import serving_engine_lines
+
+    eng = ServingEngine(FakeRunner(num_blocks=32, block_size=4),
+                        max_batch=2, prefix_sharing=True,
+                        persistent_prefix=True)
+    done, emit = _collect()
+    eng.submit(list(range(8)), 2, emit=emit)
+    for _ in range(40):
+        if done:
+            break
+        eng.step()
+    lines = serving_engine_lines(eng, "n1", 123)
+    engine_line = [ln for ln in lines
+                   if ln.startswith("tpf_serving_engine")][0]
+    assert "kv_prefix_cache_evictions_total=" in engine_line
+    assert "kv_prefix_cache_blocks=" in engine_line

@@ -60,6 +60,18 @@ CELLS = {
          "lower", 4.0, "abs", "multitenant_dispatch.dim"),
         ("wire_encoding.bytes_ratio_vs_raw", "higher", 15.0, "rel",
          "wire_encoding.dim"),
+        # federated multi-worker mesh (docs/federation.md): aggregate
+        # throughput of one tenant across the max worker count vs one
+        # worker (acceptance >=1.6x at 4), the q8 collective byte cut
+        # (acceptance >=2x; f32 lands ~4x), and the overlap ledger's
+        # hidden-transfer share (a timing cell on a noisy 1-core box —
+        # wide absolute band)
+        ("federation.aggregate_vs_1worker_at_max", "higher", 25.0,
+         "rel", "federation.rows_per_worker"),
+        ("federation.q8.bytes_ratio_vs_raw", "higher", 15.0, "rel",
+         "federation.dim"),
+        ("federation.overlap_efficiency_pct", "higher", 35.0, "abs",
+         "federation.rows_per_worker"),
         ("tracing.overhead_pct", "lower", 4.0, "abs"),
         ("profiler.overhead_pct", "lower", 4.0, "abs"),
         ("policy.overhead_pct", "lower", 4.0, "abs"),
