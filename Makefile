@@ -9,7 +9,7 @@ PY := env -u PALLAS_AXON_POOL_IPS python
 
 .PHONY: all native test test-native verify-all verify-repeat \
 	verify-stress verify-sim verify-trace verify-serving verify-wire \
-	verify-prof verify-campaign verify-federation \
+	verify-prof verify-campaign verify-federation verify-shard \
 	bench-diff bench-provenance \
 	verify-native-sanitized \
 	check-coverage lint \
@@ -39,7 +39,7 @@ verify-all: lint test-native check-coverage
 # Project-invariant static analysis (docs/static-analysis.md): the
 # lexical checkers (stale-write-back / blocking-under-lock /
 # guarded-field / frozen-view-mutation / protocol-exhaustive /
-# metrics-schema) plus the tpfgraph interprocedural layer (lock-order-
+# metrics-schema / shard-routing) plus the tpfgraph interprocedural layer (lock-order-
 # inversion / transitive-blocking-under-lock / swallowed-error /
 # unjoined-thread / leaked-resource), ratcheted by
 # tools/tpflint/baseline.json (currently EMPTY — keep it that way).
@@ -80,7 +80,7 @@ verify-repeat: native
 # control-plane hot path).  Cheaper than verify-repeat (minutes, not an
 # hour), meant to run on every change to locking/queueing code.
 verify-stress: verify-sim verify-campaign verify-trace verify-serving \
-	verify-wire verify-federation verify-prof bench-diff
+	verify-wire verify-federation verify-prof verify-shard bench-diff
 	@for i in 1 2 3 4 5; do \
 		echo "=== verify-stress round $$i/5 ==="; \
 		env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -97,7 +97,8 @@ verify-stress: verify-sim verify-campaign verify-trace verify-serving \
 # Digital-twin gate (docs/simulation.md): every named fault scenario
 # (rolling node failure, thundering-herd rescale, partition-heal
 # reconvergence, slow-watcher storm, leader flap, skew-lease storm,
-# serving burst storm) against the REAL control plane in simulated time — headless, tier-1
+# serving burst storm, shard-owner failover) against the REAL control
+# plane in simulated time — headless, tier-1
 # scale, each scenario run twice and the event-log digests compared
 # (any nondeterminism fails), invariants (no lost pods, no double
 # bind, no leaked allocations, convergence) enforced.  Artifact:
@@ -213,6 +214,25 @@ verify-prof:
 	$(PY) -m tools.tpfprof check /tmp/tpfprof_verify.json
 	$(PY) -m tools.tpftrace check /tmp/tpfprof_verify_trace.json
 	@echo "verify-prof: OK"
+
+# Sharded-control-plane gate (docs/control-plane-scale.md): the
+# shard-owner-failover twin scenario — one shard owner killed
+# mid-churn, the successor replays the shard journal, resyncs every
+# cross-shard consumer and takes the ownership lease with a higher
+# fencing token — run TWICE with log/trace/profile digests compared
+# (any nondeterminism fails), then a quick 4-shard sched_bench cell
+# exit-coded on beating the same-run single-shard baseline (artifact
+# to a temp dir so the checked-in full-scale record survives).  Run on
+# any change to store/shardedstore/storecache/leader or the operator
+# wiring.
+verify-shard:
+	$(PY) benchmarks/sim_scenarios.py --scale small --seed 42 \
+		--scenario shard-owner-failover
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		TPF_BENCH_RESULTS_DIR=/tmp/tpfshard_verify_results \
+		python benchmarks/sched_bench.py --shards 4 \
+		--nodes 4000 --chips 2 --pods 8000 --gate-speedup 1.3
+	@echo "verify-shard: OK"
 
 # Perf-regression comparator (docs/test-matrix.md): every checked-in
 # benchmarks/results/*.json artifact vs the `previous` record it

@@ -56,8 +56,8 @@ def build(nodes: int, chips_per_node: int):
     return alloc, sched
 
 
-def make_pod(i: int) -> Pod:
-    pod = Pod.new(f"bench-{i}", namespace="bench")
+def make_pod(i: int, namespace: str = "bench") -> Pod:
+    pod = Pod.new(f"bench-{i}", namespace=namespace)
     ann = pod.metadata.annotations
     ann[constants.ANN_POOL] = "pool-a"
     ann[constants.ANN_TFLOPS_REQUEST] = "30"
@@ -82,12 +82,118 @@ def run_cycle(sched, pods, store=None) -> float:
     return dt
 
 
+def run_shard_cell(nodes: int, chips: int, pods: int,
+                   shards: int) -> dict:
+    """Sharded control-plane cell (docs/control-plane-scale.md): the
+    node fleet and pod stream partition into ``shards`` cells, each
+    with its own allocator+scheduler stack, its own store and its own
+    journal — the shape N lease-owning operators run in production.
+    Shards execute sequentially on this box, so the aggregate is the
+    honest single-core number: the win is algorithmic (every
+    scheduling decision scans nodes/shards instead of all nodes, every
+    journal burst hits a per-shard file), not thread parallelism."""
+    import os
+    import shutil
+    import tempfile
+
+    from tensorfusion_tpu.store import ObjectStore
+
+    per_shard = []
+    total_dt = 0.0
+    root = tempfile.mkdtemp(prefix="tpf_sched_shards_")
+    try:
+        for s in range(max(shards, 1)):
+            n_s = nodes // shards
+            p_s = pods // shards
+            alloc, sched = build(n_s, chips)
+            shard_pods = [make_pod(i, namespace=f"bench-s{s}")
+                          for i in range(p_s)]
+            store = ObjectStore(persist_dir=os.path.join(
+                root, f"shard-{s:02d}"))
+            dt = run_cycle(sched, shard_pods, store=store)
+            store.close()
+            total_dt += dt
+            per_shard.append({
+                "shard": s, "nodes": n_s, "pods": p_s,
+                "seconds": round(dt, 3),
+                "pods_per_second": round(p_s / dt, 1)})
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return {
+        "shards": shards,
+        "nodes": nodes,
+        "chips": nodes * chips,
+        "pods": pods,
+        "aggregate_seconds": round(total_dt, 3),
+        "aggregate_pods_per_second": round(pods / total_dt, 1),
+        "per_shard": per_shard,
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=1000)
     ap.add_argument("--chips", type=int, default=4)
     ap.add_argument("--pods", type=int, default=10000)
+    ap.add_argument("--shards", type=int, default=1,
+                    help=">1: run the partitioned control-plane cell "
+                         "(per-shard stores + journals) and write the "
+                         "sched_shards artifact instead of sched")
+    ap.add_argument("--shard-sweep", default="",
+                    help="comma list of shard counts (e.g. 4,8): run "
+                         "one cell per count so the per-shard scaling "
+                         "curve is recorded; headline = last entry")
+    ap.add_argument("--gate-speedup", type=float, default=0.0,
+                    help="exit 1 unless the sharded aggregate beats "
+                         "the measured single-shard baseline by this "
+                         "factor (make verify-shard)")
     args = ap.parse_args()
+
+    try:
+        from benchmarks._artifact import previous_artifact, write_artifact
+    except ImportError:
+        from _artifact import previous_artifact, write_artifact
+
+    if args.shards > 1 or args.shard_sweep:
+        sweep = [int(x) for x in args.shard_sweep.split(",") if x] \
+            if args.shard_sweep else [args.shards]
+        cells = [run_shard_cell(args.nodes, args.chips, args.pods, s)
+                 for s in sweep]
+        # the honest denominator: ONE shard at the same total scale,
+        # same store-backed bind path, same box, same run
+        single = run_shard_cell(args.nodes, args.chips, args.pods, 1)
+        headline = cells[-1]
+        single_pps = single["aggregate_pods_per_second"]
+        result = dict(headline)
+        result.update({
+            "benchmark": "scheduler_sharded_cell",
+            "single_shard_pods_per_second": single_pps,
+            "single_shard_seconds": single["aggregate_seconds"],
+            "speedup_vs_single_shard_x": round(
+                headline["aggregate_pods_per_second"]
+                / max(single_pps, 1e-9), 2),
+            "sweep": [
+                dict(c, speedup_vs_single_shard_x=round(
+                    c["aggregate_pods_per_second"]
+                    / max(single_pps, 1e-9), 2))
+                for c in cells],
+            "flags": {"per_shard_journals": True,
+                      "batch_filter_score": True,
+                      "lazy_node_scores": True, "cow_store": True,
+                      "journal_group_commit": True},
+            "previous": previous_artifact("sched_shards"),
+        })
+        write_artifact("sched_shards", result)
+        print(json.dumps(result))
+        if args.gate_speedup:
+            speedup = result["speedup_vs_single_shard_x"]
+            if speedup < args.gate_speedup:
+                print(f"sched_bench: FAIL sharded speedup {speedup}x "
+                      f"< gate {args.gate_speedup}x", file=sys.stderr)
+                return 1
+            print(f"sched_bench: sharded gate OK ({speedup}x >= "
+                  f"{args.gate_speedup}x)")
+        return 0
 
     alloc, sched = build(args.nodes, args.chips)
     pods = [make_pod(i) for i in range(args.pods)]
@@ -112,12 +218,9 @@ def main() -> int:
     dt_persist = run_cycle(sched3, pods3, store=store)
     store.close()
 
-    try:
-        from benchmarks._artifact import previous_artifact, write_artifact
-    except ImportError:
-        from _artifact import previous_artifact, write_artifact
     result = {
         "benchmark": "scheduler_full_cycle",
+        "shards": 1,
         "nodes": args.nodes,
         "chips": args.nodes * args.chips,
         "pods": args.pods,

@@ -107,6 +107,99 @@ def run_inproc_step(watchers: int, window_s: float,
             "watch_lag_p95_ms": pct(lags, 0.95)}
 
 
+def run_sharded_step(watchers: int, shards: int, window_s: float):
+    """Sharded in-process fan-out (docs/control-plane-scale.md): the
+    writer round-robins pod churn across N shard partitions while
+    ``watchers`` reconcile-mode consumers split across the shards'
+    rings (each shard owner's controllers watch only their shard).  A
+    write wakes at most its own shard's parked watchers — combined
+    with the store's wake-once parking this is what keeps retention
+    flat at watcher counts that melted the single-ring fan-out.
+    Returns the cell with per-shard delivery/lag breakdown."""
+    from tensorfusion_tpu.api.types import Pod
+    from tensorfusion_tpu.shardedstore import ShardedStore
+
+    def measure(with_watchers: bool):
+        router = ShardedStore(n_shards=shards)
+        stop = threading.Event()
+        per_shard = [{"events": 0, "lags": []} for _ in range(shards)]
+        lag_lock = threading.Lock()
+
+        def watcher_loop(shard: int):
+            w = router.shard_store(shard).watch(
+                "Pod", replay=False, conflate=True)
+            local = []
+            n = 0
+            while not stop.is_set():
+                ev = w.get(timeout=0.2)
+                if ev is None:
+                    continue
+                n += 1
+                stamp = ev.obj.metadata.annotations.get("t0")
+                if stamp:
+                    local.append(time.perf_counter() - float(stamp))
+            w.stop()
+            with lag_lock:
+                per_shard[shard]["events"] += n
+                per_shard[shard]["lags"].extend(local)
+
+        threads = []
+        if with_watchers:
+            threads = [threading.Thread(target=watcher_loop,
+                                        args=(i % shards,),
+                                        daemon=True)
+                       for i in range(watchers)]
+            for t in threads:
+                t.start()
+            time.sleep(0.2)               # let watchers park
+        pods = []
+        for s in range(shards):
+            pod = Pod.new("churn", namespace=f"ns-s{s}")
+            router.shard_store(s).create(pod)
+            pods.append(pod)
+        writes = 0
+        t_end = time.perf_counter() + window_s
+        while time.perf_counter() < t_end:
+            s = writes % shards
+            pod = pods[s]
+            pod.metadata.annotations["t0"] = repr(time.perf_counter())
+            cur = router.shard_store(s).update(pod)
+            pod.metadata.resource_version = \
+                cur.metadata.resource_version
+            writes += 1
+        if with_watchers:
+            time.sleep(0.5)               # drain tails
+        stop.set()
+        for t in threads:
+            t.join(timeout=3)
+        return writes / window_s, per_shard
+
+    def pct(xs, q):
+        if not xs:
+            return None
+        xs = sorted(xs)
+        return round(xs[min(int(q * len(xs)), len(xs) - 1)] * 1e3, 2)
+
+    idle_wps, _ = measure(with_watchers=False)
+    wps, per_shard = measure(with_watchers=True)
+    return {
+        "shards": shards,
+        "watchers": watchers,
+        "conflate": True,
+        "writes_per_s_idle": round(idle_wps, 1),
+        "writes_per_s": round(wps, 1),
+        "retention_pct": round(wps / max(idle_wps, 1e-9) * 100.0, 1),
+        "per_shard": [
+            {"shard": i,
+             "watchers": sum(1 for j in range(watchers)
+                             if j % shards == i),
+             "events_delivered": ps["events"],
+             "watch_lag_p50_ms": pct(ps["lags"], 0.50),
+             "watch_lag_p95_ms": pct(ps["lags"], 0.95)}
+            for i, ps in enumerate(per_shard)],
+    }
+
+
 def run_step(server_url: str, watchers: int, pushers: int,
              window_s: float, store, conflate: bool = False):
     """One point on the curve; returns the metrics dict."""
@@ -215,6 +308,12 @@ def main() -> int:
     ap.add_argument("--watcher-steps", default="0,10,50,100,200")
     ap.add_argument("--pushers", type=int, default=50)
     ap.add_argument("--window-s", type=float, default=3.0)
+    ap.add_argument("--shards", type=int, default=4,
+                    help="shard count for the sharded fan-out cell "
+                         "(0 disables the cell)")
+    ap.add_argument("--sharded-watchers", type=int, default=500,
+                    help="reconcile-mode watchers split across the "
+                         "shards in the sharded cell")
     args = ap.parse_args()
 
     from tensorfusion_tpu.statestore import StateStoreServer
@@ -243,6 +342,13 @@ def main() -> int:
     print(f"# inproc conflated: {inproc_conflated}", file=sys.stderr)
     retention_ip = round(inproc_conflated["writes_per_s"]
                          / max(base_ip, 1e-9) * 100.0, 1)
+
+    # -- sharded fan-out cell (docs/control-plane-scale.md) ---------------
+    sharded_cell = None
+    if args.shards > 0:
+        sharded_cell = run_sharded_step(args.sharded_watchers,
+                                        args.shards, args.window_s)
+        print(f"# sharded {sharded_cell}", file=sys.stderr)
 
     # -- HTTP long-poll + metrics-ring cell -------------------------------
     store = ObjectStore()
@@ -300,13 +406,16 @@ def main() -> int:
         "scaling_span_pct": scaling_span,
         "conflated_at_max_watchers": conflated_point,
         "curve": curve,
+        "sharded": sharded_cell,
         "pushers": args.pushers,
         "window_s": args.window_s,
         # which store-side machinery produced these numbers — the
         # before/after comparison below is meaningless without them
         "flags": {"cow_snapshots": True, "shared_ring_fanout": True,
                   "cached_serialization": True,
-                  "journal_group_commit": True},
+                  "journal_group_commit": True,
+                  "parked_wake_once": True,
+                  "sharded_rings": bool(sharded_cell)},
         "previous": previous_artifact("watch_scale"),
     }
     write_artifact("watch_scale", result)

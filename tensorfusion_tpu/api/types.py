@@ -511,6 +511,11 @@ class TPUConnectionSpec:
 class TPUConnectionStatus:
     phase: str = constants.PHASE_PENDING
     worker_name: str = ""
+    #: uid of the bound worker POD, not just its name: a worker that is
+    #: killed and recreated under the same name is a DIFFERENT peer
+    #: (fresh process, possibly a fresh port) — the binding must be
+    #: re-picked, which a name-only health check cannot see
+    worker_uid: str = ""
     worker_url: str = ""
 
 

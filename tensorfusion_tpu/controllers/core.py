@@ -598,14 +598,22 @@ class ConnectionController(Controller):
             conn = conn.thaw()   # private copy: reconcile mutates status
             if conn.status.phase == constants.PHASE_RUNNING and \
                     conn.status.worker_url:
-                # verify the worker still exists
+                # verify the worker still exists — by IDENTITY, not
+                # name: a worker killed and recreated under the same
+                # name between two reconciles is a different peer (the
+                # level-triggered check would otherwise keep a stale
+                # binding alive forever)
                 worker = self.store.try_get(Pod, conn.status.worker_name,
                                             conn.metadata.namespace)
                 if worker is not None and \
-                        worker.status.phase == constants.PHASE_RUNNING:
+                        worker.status.phase == constants.PHASE_RUNNING \
+                        and (not conn.status.worker_uid
+                             or worker.metadata.uid
+                             == conn.status.worker_uid):
                     continue
                 conn.status.phase = constants.PHASE_PENDING
                 conn.status.worker_name = ""
+                conn.status.worker_uid = ""
                 conn.status.worker_url = ""
             workers = self.store.list(
                 Pod, namespace=conn.metadata.namespace,
@@ -631,6 +639,7 @@ class ConnectionController(Controller):
                 constants.ANN_PORT_NUMBER, "0")
             host = chosen.status.host_ip or chosen.spec.node_name or "0.0.0.0"
             conn.status.worker_name = chosen.metadata.name
+            conn.status.worker_uid = chosen.metadata.uid
             conn.status.worker_url = f"tcp://{host}:{port}"
             conn.status.phase = constants.PHASE_RUNNING
             self._patch_status(conn)

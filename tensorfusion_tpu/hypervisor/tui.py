@@ -440,9 +440,11 @@ def render_profile(snapshots: List[dict]) -> str:
     for snap in snapshots:
         tot = snap.get("totals", {})
         overlap = snap.get("overlap", {})
+        shard = snap.get("shard", "")
         lines.append(
             f"== {snap.get('name', '?')} "
-            f"util={snap.get('utilization_pct', 0.0):5.1f}% "
+            + (f"shard={shard} " if shard else "")
+            + f"util={snap.get('utilization_pct', 0.0):5.1f}% "
             f"compute={tot.get('compute_s', 0.0):.3f}s "
             f"transfer={tot.get('transfer_s', 0.0):.3f}s "
             f"queue={tot.get('queue_s', 0.0):.3f}s "

@@ -116,8 +116,13 @@ METRICS_SCHEMA = {
     # device-time shares + HBM-resident gauges.  Emitted by
     # profiling/export.py:profile_lines via BOTH recorders; tools/
     # tpfprof.py `check` validates runtime artifacts against these rows
+    # ``shard`` rides both series when the attribution came from a
+    # sharded control plane's per-shard ledger (docs/control-plane-
+    # scale.md) — a hot shard is then one `tpfprof top` / TSDB group_by
+    # away instead of being smeared into one aggregate
     "tpf_prof_device": {
         "tags": ("node", "device"),
+        "opt_tags": ("shard",),
         "fields": ("utilization_pct", "compute_s_total",
                    "transfer_s_total", "queue_s_total",
                    "hidden_transfer_s_total", "overlap_efficiency_pct",
@@ -126,6 +131,7 @@ METRICS_SCHEMA = {
     },
     "tpf_prof_tenant": {
         "tags": ("node", "device", "tenant", "qos"),
+        "opt_tags": ("shard",),
         "fields": ("device_share_pct", "compute_s_total",
                    "transfer_s_total", "queue_s_total",
                    "launches_total", "hbm_resident_bytes"),

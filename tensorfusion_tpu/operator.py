@@ -55,8 +55,15 @@ class Operator:
                  sync_interval_s: float = 2.0,
                  config_path: str = "",
                  leader_lock: str = "",
-                 clock: Optional[Clock] = None):
+                 clock: Optional[Clock] = None,
+                 shard: Optional[int] = None):
         self.clock = clock or default_clock()
+        #: which control-plane shard this operator owns (None = the
+        #: single-shard default).  A shard owner's ``store`` is its own
+        #: partition; cross-shard reads go through a StoreCache replica
+        #: fed by the ShardedStore router (docs/control-plane-scale.md)
+        self.shard = shard
+        # tpflint: disable=shard-routing -- the documented single-shard default: the bare in-process store IS shard 0 of a 1-shard map
         self.store = store or ObjectStore()
         # one tracer for the whole control plane: admission, scheduling
         # and bind spans join per-pod lifecycle traces (docs/tracing.md);
@@ -582,6 +589,16 @@ def main(argv=None, stop_event: Optional[threading.Event] = None) -> int:
     ap.add_argument("--identity", default="",
                     help="replica identity for leader election "
                          "(default hostname-pid)")
+    ap.add_argument("--shard", type=int, default=None,
+                    help="sharded control plane: campaign for THIS "
+                         "shard's ownership lease (shard-NN-owner in "
+                         "the shard's own store) instead of the "
+                         "singleton operator lease; point --store-url "
+                         "at the shard's state store "
+                         "(docs/control-plane-scale.md)")
+    ap.add_argument("--shards", type=int, default=1,
+                    help="total shard count of the cell (recorded for "
+                         "operators of a sharded deployment)")
     ap.add_argument("--lease-duration-s", type=float, default=10.0)
     ap.add_argument("--renew-interval-s", type=float, default=2.0)
     ap.add_argument("--pool", default="pool-a")
@@ -631,6 +648,7 @@ def main(argv=None, stop_event: Optional[threading.Event] = None) -> int:
 
         store = RemoteStore(args.store_url, token=args.store_token)
     else:
+        # tpflint: disable=shard-routing -- daemon entrypoint for the single-shard default deployment
         store = ObjectStore(persist_dir=args.persist_dir or None)
         if args.persist_dir:
             from .api.types import ALL_KINDS
@@ -642,7 +660,8 @@ def main(argv=None, stop_event: Optional[threading.Event] = None) -> int:
                   config_path=args.config,
                   enable_autoscaler=args.enable_autoscaler,
                   enable_policy=args.enable_policy,
-                  alert_webhook=args.alert_webhook)
+                  alert_webhook=args.alert_webhook,
+                  shard=args.shard)
     # bootstrap the pool: ride out a state store that is still coming up
     # (transport errors retry; a concurrent replica winning the create is
     # success, not failure)
@@ -680,18 +699,30 @@ def main(argv=None, stop_event: Optional[threading.Event] = None) -> int:
                             tls_cert=args.tls_cert, tls_key=args.tls_key)
     if args.store_url:
         # HA replica: campaign for the store lease; only the winner runs
-        # controllers + scheduler, losers serve redirects until promoted
-        from .utils.leader import StoreLeaderElector
+        # controllers + scheduler, losers serve redirects until promoted.
+        # With --shard the campaign targets THAT shard's ownership lease
+        # (one owner per shard; N replicas per shard for failover)
+        from .utils.leader import ShardLeaseElector, StoreLeaderElector
 
-        op.elector = StoreLeaderElector(
-            store,
-            identity=args.identity
-            or f"{os.uname().nodename}-{os.getpid()}",
-            endpoint=server.url,
-            lease_duration_s=args.lease_duration_s,
-            renew_interval_s=args.renew_interval_s,
-            on_started_leading=op._start_components,
-            on_stopped_leading=op._stop_components)
+        identity = args.identity \
+            or f"{os.uname().nodename}-{os.getpid()}"
+        if args.shard is not None:
+            op.elector = ShardLeaseElector(
+                store, args.shard, identity,
+                endpoint=server.url,
+                lease_duration_s=args.lease_duration_s,
+                renew_interval_s=args.renew_interval_s,
+                on_started_leading=op._start_components,
+                on_stopped_leading=op._stop_components)
+        else:
+            op.elector = StoreLeaderElector(
+                store,
+                identity=identity,
+                endpoint=server.url,
+                lease_duration_s=args.lease_duration_s,
+                renew_interval_s=args.renew_interval_s,
+                on_started_leading=op._start_components,
+                on_stopped_leading=op._stop_components)
     op.start()
     server.start()
     if args.port_file:

@@ -35,6 +35,10 @@ def profile_lines(snap: dict, node_name: str, ts: int) -> List[str]:
     node-agent and operator recorders so both topologies emit
     identical series (docs/metrics-schema.md)."""
     tags = {"node": node_name, "device": snap["name"]}
+    if snap.get("shard"):
+        # sharded control plane: per-shard attribution stays queryable
+        # as its own series (opt tag — single-shard lines are unchanged)
+        tags["shard"] = snap["shard"]
     tot = snap["totals"]
     overlap = snap["overlap"]
     lines = [encode_line(

@@ -71,8 +71,13 @@ class Profiler:
     def __init__(self, name: str = "device0",
                  clock: Optional[Clock] = None,
                  bin_s: float = DEFAULT_BIN_S,
-                 max_bins: int = DEFAULT_MAX_BINS):
+                 max_bins: int = DEFAULT_MAX_BINS,
+                 shard: str = ""):
         self.name = name
+        #: control-plane shard this ledger attributes for ("" = not a
+        #: sharded deployment); rides every snapshot + tpf_prof_* line
+        #: so a hot shard shows up in `tpfprof top` / the TUI pane
+        self.shard = str(shard)
         self.clock = clock or default_clock()
         self.bin_s = max(float(bin_s), 1e-3)
         self.max_bins = max(int(max_bins), 1)
@@ -209,6 +214,7 @@ class Profiler:
                            if tot.transfer_s > 0 else 0.0)
             return {
                 "name": self.name,
+                "shard": self.shard,
                 "bin_s": self.bin_s,
                 "elapsed_s": round(elapsed, 9),
                 "utilization_pct": round(

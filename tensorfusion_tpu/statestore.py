@@ -175,6 +175,7 @@ def main(argv=None, stop_event: Optional[threading.Event] = None) -> int:
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(levelname).1s %(name)s %(message)s")
 
+    # tpflint: disable=shard-routing -- the statestore daemon hosts exactly one shard partition (run N daemons for N shards)
     store = ObjectStore(persist_dir=args.persist_dir or None)
     if args.persist_dir:
         n = store.load(ALL_KINDS)

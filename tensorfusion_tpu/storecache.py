@@ -26,6 +26,16 @@ Events can arrive slightly out of order across writer threads; the
 cache applies an event only when its object's resource_version is newer
 than the cached one (per-key monotonicity), which also makes duplicate
 replay ADDEDs idempotent.
+
+Against a :class:`~tensorfusion_tpu.shardedstore.ShardedStore` the same
+attach path feeds the cache from EVERY shard's ring: events arrive
+tagged with their feeding shard, keys are shard-exclusive (the shard
+map routes each object to exactly one partition), so per-key
+monotonicity IS per-shard rv monotonicity — the cache never compares
+resource versions across shards.  ``shard_feed_rvs`` exposes the
+per-shard apply high-water marks; a shard failover (``replace_shard``)
+resyncs the cache informer-style through synthetic DELETED + ADDED
+replay on the same feed.
 """
 
 from __future__ import annotations
@@ -56,6 +66,12 @@ class StoreCache:
         self._indexes: Dict[str, Dict[str, Dict[str, Dict[str, Resource]]]] = {}
         # guarded by: _lock  — kind -> key -> rv of the cached snapshot
         self._rvs: Dict[str, Dict[str, int]] = {}
+        # guarded by: _lock  — feeding shard -> highest event rv applied
+        # (sharded feeds only; each shard's rv sequence is independent)
+        self._shard_rvs: Dict[int, int] = {}
+        # guarded by: _lock  — stale/duplicate events dropped by the
+        # per-key rv-monotonic apply (resync replays land here)
+        self.stale_drops = 0
         self._listeners: List[Callable[[Event], None]] = []
         self._synced = threading.Event()
         self._watch = None
@@ -126,7 +142,13 @@ class StoreCache:
         if self.kinds and ev.obj.KIND not in self.kinds:
             return
         with self._lock:
+            shard = getattr(ev, "shard", -1)
+            if shard >= 0 and ev.rv:
+                prev = self._shard_rvs.get(shard, 0)
+                self._shard_rvs[shard] = max(prev, ev.rv)
             applied = self._apply_locked(ev.type, ev.obj)
+            if not applied and ev.type != DELETED:
+                self.stale_drops += 1
         if applied:
             for fn in self._listeners:
                 try:
@@ -216,3 +238,11 @@ class StoreCache:
     def count(self, cls: Type[Resource]) -> int:
         with self._lock:
             return len(self._by_kind.get(cls.KIND, {}))
+
+    def shard_feed_rvs(self) -> Dict[int, int]:
+        """Per-feeding-shard apply high-water marks (empty for plain
+        single-store feeds) — the sharded-feed regression battery
+        asserts these only ever grow, per shard, never compared
+        across shards."""
+        with self._lock:
+            return dict(self._shard_rvs)

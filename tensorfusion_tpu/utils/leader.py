@@ -177,9 +177,14 @@ class StoreLeaderElector:
                  renew_interval_s: float = 2.0,
                  on_started_leading: Optional[Callable[[], None]] = None,
                  on_stopped_leading: Optional[Callable[[], None]] = None,
-                 clock: Optional[Clock] = None):
+                 clock: Optional[Clock] = None,
+                 lease_name: str = ""):
         self.clock = clock or default_clock()
         self.store = store
+        #: which lease this elector campaigns for — the default is the
+        #: singleton operator lease; sharded control planes run one
+        #: campaign per shard under per-shard names (shard_lease_name)
+        self.lease_name = lease_name or self.LEASE_NAME
         self.identity = identity
         self.endpoint = endpoint
         self.lease_duration_s = lease_duration_s
@@ -221,7 +226,7 @@ class StoreLeaderElector:
         from ..api.types import Lease
 
         try:
-            lease = self.store.try_get(Lease, self.LEASE_NAME)
+            lease = self.store.try_get(Lease, self.lease_name)
         except Exception:  # noqa: BLE001 - transport error = unknown
             log.debug("lease read failed; leader unknown",
                       exc_info=True)
@@ -265,7 +270,7 @@ class StoreLeaderElector:
         from ..store import AlreadyExistsError, ConflictError
 
         try:
-            lease = self.store.try_get(Lease, self.LEASE_NAME)
+            lease = self.store.try_get(Lease, self.lease_name)
         except Exception:  # noqa: BLE001 - store unreachable
             log.debug("lease read failed; not campaigning this tick",
                       exc_info=True)
@@ -273,7 +278,7 @@ class StoreLeaderElector:
         now = self.clock.now()
         try:
             if lease is None:
-                lease = Lease.new(self.LEASE_NAME)
+                lease = Lease.new(self.lease_name)
                 self._fill(lease, now, lease.spec.fencing_token + 1)
                 self.store.create(lease)
             else:
@@ -307,7 +312,7 @@ class StoreLeaderElector:
         from ..store import ConflictError, NotFoundError
 
         try:
-            lease = self.store.get(Lease, self.LEASE_NAME)
+            lease = self.store.get(Lease, self.lease_name)
             if lease.spec.holder != self.identity:
                 return False      # usurped
             lease = lease.thaw()
@@ -339,7 +344,7 @@ class StoreLeaderElector:
 
         self._demote()
         try:
-            lease = self.store.try_get(Lease, self.LEASE_NAME)
+            lease = self.store.try_get(Lease, self.lease_name)
             if lease is not None and lease.spec.holder == self.identity:
                 lease = lease.thaw()
                 lease.spec.renew_time = 0.0
@@ -347,3 +352,25 @@ class StoreLeaderElector:
         except Exception:  # noqa: BLE001 - best effort
             log.debug("graceful lease handoff failed; successor waits "
                       "out the TTL", exc_info=True)
+
+
+def shard_lease_name(shard: int) -> str:
+    """Canonical per-shard ownership lease name (stored IN the shard it
+    governs, so fencing tokens ride the shard's own journal and survive
+    an owner crash + journal replay)."""
+    return f"shard-{int(shard):02d}-owner"
+
+
+class ShardLeaseElector(StoreLeaderElector):
+    """One lease-owning campaign per store shard: the StoreLeaderElector
+    protocol (version-checked renew/challenge, monotonic fencing
+    tokens, skew tolerance — all sim-tested under the twin) pointed at
+    a per-shard Lease.  N of these across N shards generalize "one
+    leader" to "one owner per shard": each winner runs the full
+    controller stack against its shard only
+    (docs/control-plane-scale.md)."""
+
+    def __init__(self, store, shard: int, identity: str, **kwargs):
+        kwargs.setdefault("lease_name", shard_lease_name(shard))
+        super().__init__(store, identity, **kwargs)
+        self.shard = int(shard)

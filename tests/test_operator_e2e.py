@@ -507,3 +507,51 @@ def test_e2e_proxied_native_pod_accounted(op, monkeypatch):
         assert op.allocator.get_chip(cid).exclusive_keys == {
             "default/native-proxy"}
     op.delete_pod("native-proxy")
+
+
+def test_connection_repicks_when_worker_recreated_under_same_name():
+    """Regression (found by PR-19's wake-coalescing widening the
+    reconcile window): a worker killed and recreated under the SAME
+    name between two reconciles is a different peer — the controller's
+    health check must compare pod identity (uid), not just name, or
+    the connection keeps a stale binding to the dead process forever."""
+    from tensorfusion_tpu.controllers.core import ConnectionController
+    from tensorfusion_tpu.store import ObjectStore
+
+    store = ObjectStore()
+    ctrl = ConnectionController(store)
+
+    def worker(name):
+        p = Pod.new(name, namespace="default")
+        p.metadata.annotations[constants.ANN_WORKLOAD] = "wl"
+        p.metadata.labels[constants.LABEL_COMPONENT] = \
+            constants.COMPONENT_WORKER
+        p.metadata.annotations[constants.ANN_PORT_NUMBER] = "4100"
+        p.status.phase = constants.PHASE_RUNNING
+        p.status.host_ip = "node-a"
+        return store.create(p)
+
+    worker("wl-worker-0")
+    worker("wl-worker-1")
+    conn = TPUConnection.new("c1", namespace="default")
+    conn.spec.workload = "wl"
+    store.create(conn)
+    ctrl.reconcile(None)
+    bound = store.get(TPUConnection, "c1", "default")
+    first_name = bound.status.worker_name
+    first_uid = bound.status.worker_uid
+    assert first_name and first_uid
+
+    # kill + recreate the bound worker under the same name BEFORE the
+    # controller gets to reconcile (the conflated-delivery window)
+    store.delete(Pod, first_name, "default")
+    recreated = worker(first_name)
+    assert recreated.metadata.uid != first_uid
+
+    ctrl.reconcile(None)
+    after = store.get(TPUConnection, "c1", "default")
+    assert after.status.phase == constants.PHASE_RUNNING
+    # the stale binding was dropped: either a different worker or the
+    # recreated pod's NEW identity — never the dead pod's uid
+    assert after.status.worker_uid != first_uid
+    assert after.status.worker_uid

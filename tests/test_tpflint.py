@@ -766,3 +766,101 @@ def test_wire_encoding_literals_without_registry_fail():
     findings = protocol_exhaustive.run_project(
         proto_files(proto=bad), REPO)
     assert any(f.key == "WIRE_ENCODINGS" for f in findings), findings
+
+
+# -- shard-routing (round 19: the partitioned control plane) ----------------
+
+from tools.tpflint.checkers import shard_routing  # noqa: E402
+
+SR_BAD_CONSTRUCTION = """
+    class C:
+        def reconcile(self):
+            store = ObjectStore()
+            return store
+"""
+
+SR_BAD_MODULE_LEVEL = """
+    from .store import ObjectStore
+    GLOBAL_STORE = ObjectStore(persist_dir="/tmp/x")
+"""
+
+SR_BAD_CROSS_SHARD_WRITE = """
+    class C:
+        def reconcile(self, router, obj):
+            router.shards[2].update(obj, check_version=True)
+            self.plane.shards[0].delete(Pod, "x")
+"""
+
+SR_GOOD_ROUTED = """
+    class C:
+        def reconcile(self, obj):
+            self.store.update(obj, check_version=True)
+            router = ShardedStore(n_shards=4)
+            router.create(obj)
+            # reads through a shard are fine (thin cross-shard path)
+            return router.shards[1].list(Pod)
+"""
+
+
+def test_shard_routing_flags_construction():
+    findings = shard_routing.run_file(
+        sf(SR_BAD_CONSTRUCTION, relpath="tensorfusion_tpu/mod.py"))
+    assert checks_of(findings) == ["shard-routing"]
+    assert "ShardedStore" in findings[0].message
+
+
+def test_shard_routing_flags_module_level_construction():
+    findings = shard_routing.run_file(
+        sf(SR_BAD_MODULE_LEVEL, relpath="tensorfusion_tpu/mod.py"))
+    assert checks_of(findings) == ["shard-routing"]
+    assert findings[0].symbol == "<module>"
+
+
+def test_shard_routing_flags_cross_shard_writes():
+    findings = shard_routing.run_file(
+        sf(SR_BAD_CROSS_SHARD_WRITE, relpath="tensorfusion_tpu/mod.py"))
+    assert checks_of(findings) == ["shard-routing", "shard-routing"]
+    assert {f.key for f in findings} == \
+        {"shards[].update", "shards[].delete"}
+
+
+def test_shard_routing_passes_router_usage_and_reads():
+    assert shard_routing.run_file(
+        sf(SR_GOOD_ROUTED, relpath="tensorfusion_tpu/mod.py")) == []
+
+
+def test_shard_routing_scope_and_exemptions():
+    # tests/benchmarks/tools are out of scope; the router itself is
+    # the legal construction site
+    assert shard_routing.run_file(sf(
+        SR_BAD_CONSTRUCTION, relpath="tests/test_x.py")) == []
+    assert shard_routing.run_file(sf(
+        SR_BAD_CONSTRUCTION, relpath="benchmarks/b.py")) == []
+    assert shard_routing.run_file(sf(
+        SR_BAD_CONSTRUCTION,
+        relpath="tensorfusion_tpu/shardedstore.py")) == []
+
+
+def test_shard_routing_disable_comment_honored():
+    code = """
+    def boot():
+        # tpflint: disable=shard-routing -- single-shard daemon
+        return ObjectStore()
+    """
+    f = sf(code, relpath="tensorfusion_tpu/mod.py")
+    findings = [x for x in shard_routing.run_file(f)
+                if not f.is_suppressed(x)]
+    assert findings == []
+
+
+def test_shard_routing_baseline_empty_at_head():
+    """Every ObjectStore construction site in tensorfusion_tpu/ is
+    either the router or carries a justified inline disable; no code
+    writes through another shard's partition."""
+    findings = run_paths(["tensorfusion_tpu"], REPO,
+                         checks={"shard-routing"}, use_cache=False)
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_shard_routing_registered():
+    assert "shard-routing" in ALL_CHECKS

@@ -655,13 +655,14 @@ def test_repo_is_clean_at_head_per_graph_checker(check):
     assert new == [], [f.render() for f in new]
 
 
-def test_all_thirteen_checkers_registered():
+def test_all_fourteen_checkers_registered():
     assert set(ALL_CHECKS) == {
         "stale-write-back", "frozen-view-mutation", "blocking-under-lock",
         "guarded-field", "protocol-exhaustive", "metrics-schema",
         "trace-schema", "lock-order-inversion",
         "transitive-blocking-under-lock", "swallowed-error",
-        "unjoined-thread", "leaked-resource", "wall-clock-direct"}
+        "unjoined-thread", "leaked-resource", "wall-clock-direct",
+        "shard-routing"}
 
 
 def test_chain_of_shapes():

@@ -77,16 +77,32 @@ CELLS = {
         ("policy.overhead_pct", "lower", 4.0, "abs"),
     ],
     "sched": [
-        ("pods_per_second", "higher", 40.0, "rel"),
+        # a changed shard count is a cell-shape change, not a perf
+        # delta — the guard makes it a new baseline (sharded cells
+        # live in sched_shards; the default artifact stays shards=1)
+        ("pods_per_second", "higher", 40.0, "rel", "shards"),
+    ],
+    # partitioned control plane (docs/control-plane-scale.md): the
+    # sharded scheduler cell — aggregate pods/s across the headline
+    # shard count and its speedup over the same-run single-shard
+    # baseline.  Shard count is a shape GUARD on every cell.
+    "sched_shards": [
+        ("aggregate_pods_per_second", "higher", 40.0, "rel", "shards"),
+        ("speedup_vs_single_shard_x", "higher", 30.0, "rel", "shards"),
     ],
     "watch_scale": [
-        ("value", "lower", 20.0, "abs"),             # retention pct
+        # retention: HIGHER is better (the pre-PR-19 entry had the
+        # direction inverted, silently passing retention collapses)
+        ("value", "higher", 20.0, "abs"),            # retention pct
+        ("sharded.retention_pct", "higher", 25.0, "abs",
+         "sharded.shards"),
     ],
     "webhook": [
         ("mutations_per_second", "higher", 40.0, "rel"),
     ],
     "multitenant": [
-        ("value", "lower", 10.0, "abs"),             # aggregate duty pct
+        # aggregate duty: higher is better (same inversion fix)
+        ("value", "higher", 10.0, "abs"),            # aggregate duty pct
     ],
     "burst_serving": [
         ("engine.fixed_vs_continuous.speedup_x", "higher", 30.0, "rel"),

@@ -1,0 +1,108 @@
+"""shard-routing: store-partition discipline for the sharded control
+plane (docs/control-plane-scale.md).
+
+The control-plane store is partitioned: N ``ObjectStore`` partitions
+behind the ``ShardedStore`` router, each owned by exactly one
+lease-holding operator.  Two patterns silently break that contract:
+
+- **bare construction**: ``ObjectStore(...)`` anywhere in
+  ``tensorfusion_tpu/`` creates a partition the router does not know —
+  its objects are invisible to the shard map's placement, its writes
+  bypass the per-shard journal/ring discipline, and a second store for
+  the same data is the split-brain the ownership leases exist to
+  prevent.  New code routes through ``ShardedStore`` (or receives a
+  store, like every controller does);
+- **cross-shard writes**: reaching through ``router.shards[i]`` to
+  ``create``/``update``/``update_or_create``/``delete`` another
+  shard's partition dodges the owner's fencing — only the shard owner
+  (which holds the shard store directly) writes its shard.
+
+Legal construction sites carry a justified inline disable:
+``shardedstore.py`` itself is exempt (the router IS the construction
+site); ``operator.py`` (single-shard default wiring), ``statestore.py``
+(the daemon hosts exactly one shard) and the digital twin's partition
+setup/failover-replay sites are disabled with justification.  The
+baseline stays EMPTY.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import Finding, SourceFile, dotted_tail, iter_functions
+
+CHECK = "shard-routing"
+
+#: the router is the one legal unannotated construction site
+EXEMPT = {
+    "tensorfusion_tpu/shardedstore.py",
+}
+
+#: store mutations that must stay inside the owning shard's context
+WRITE_METHODS = {"create", "update", "update_or_create", "delete"}
+
+
+def _construction(call: ast.Call) -> bool:
+    return dotted_tail(call.func) == "ObjectStore"
+
+
+def _cross_shard_write(call: ast.Call) -> str:
+    """Method name when ``call`` writes through ``<x>.shards[i]``,
+    else ''."""
+    func = call.func
+    if not isinstance(func, ast.Attribute) \
+            or func.attr not in WRITE_METHODS:
+        return ""
+    target = func.value
+    if isinstance(target, ast.Subscript) \
+            and dotted_tail(target.value) == "shards":
+        return func.attr
+    return ""
+
+
+def run_file(sf: SourceFile) -> List[Finding]:
+    if not sf.relpath.startswith("tensorfusion_tpu/") \
+            or sf.relpath in EXEMPT:
+        return []
+    findings: List[Finding] = []
+    covered = set()
+
+    def scan(symbol: str, root: ast.AST) -> None:
+        for node in ast.walk(root):
+            if not isinstance(node, ast.Call) or id(node) in covered:
+                continue
+            if _construction(node):
+                covered.add(id(node))
+                findings.append(Finding(
+                    check=CHECK, path=sf.relpath, line=node.lineno,
+                    symbol=symbol, key="ObjectStore",
+                    message=(
+                        "direct ObjectStore(...) construction — a "
+                        "partition the ShardedStore router cannot "
+                        "route to; go through the router (or take a "
+                        "store as a dependency) so the shard map and "
+                        "ownership leases stay authoritative (docs/"
+                        "control-plane-scale.md); legal construction "
+                        "sites carry a justified disable")))
+                continue
+            method = _cross_shard_write(node)
+            if method:
+                covered.add(id(node))
+                findings.append(Finding(
+                    check=CHECK, path=sf.relpath, line=node.lineno,
+                    symbol=symbol, key=f"shards[].{method}",
+                    message=(
+                        f"cross-shard store.{method} through "
+                        f"`.shards[...]` outside the ShardedStore "
+                        f"router / shard-owner context — only the "
+                        f"shard's lease-holding owner writes its "
+                        f"partition (fencing cannot protect a write "
+                        f"that dodges it; docs/control-plane-"
+                        f"scale.md)")))
+
+    for symbol, fn in iter_functions(sf.tree):
+        scan(symbol, fn)
+    scan("<module>", sf.tree)
+    findings.sort(key=lambda f: f.line)
+    return findings

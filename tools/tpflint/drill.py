@@ -133,6 +133,31 @@ DRILLS = [
         ["tpfprof.bogus", "not declared in", "SPAN_SCHEMA"],
     ),
     (
+        "shard-routing",
+        "shard-routing",
+        "tensorfusion_tpu/controllers/core.py",
+        "    def reconcile(self, event):",
+        (
+            "    def _drill_rogue_store(self):\n"
+            "        from ..store import ObjectStore\n"
+            "        return ObjectStore()\n"
+            "\n"
+        ),
+        ["ObjectStore", "ShardedStore"],
+    ),
+    (
+        "shard-routing-cross-shard-write",
+        "shard-routing",
+        "tensorfusion_tpu/controllers/core.py",
+        "    def reconcile(self, event):",
+        (
+            "    def _drill_cross_shard(self, router, obj):\n"
+            "        return router.shards[0].update(obj)\n"
+            "\n"
+        ),
+        ["cross-shard", "shards[...]", "fencing"],
+    ),
+    (
         "unjoined-thread",
         "unjoined-thread",
         "tensorfusion_tpu/controllers/core.py",

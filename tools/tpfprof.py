@@ -50,6 +50,20 @@ def cmd_top(args) -> int:
           f"elapsed: {snap['elapsed_s']:.3f}s  "
           f"utilization: {snap['utilization_pct']:.2f}%  "
           f"overlap-eff: {snap['overlap']['efficiency_pct']:.1f}%")
+    sharded = [s for s in (doc.get("snapshots") or [])
+               if s.get("shard")]
+    if sharded:
+        # sharded control plane: the hot shard is the headline —
+        # per-shard attributed compute, hottest first
+        print(f"{'SHARD':<8}{'LEDGER':<22}{'UTIL':>8}{'COMPUTE s':>11}"
+              f"{'QUEUE s':>9}{'LAUNCHES':>9}")
+        for s in sorted(sharded,
+                        key=lambda s: -s["totals"]["compute_s"]):
+            st = s["totals"]
+            print(f"{s['shard']:<8}{s.get('name', '?'):<22}"
+                  f"{s.get('utilization_pct', 0.0):>7.2f}%"
+                  f"{st['compute_s']:>11.3f}{st['queue_s']:>9.3f}"
+                  f"{st['launches']:>9}")
     print(f"attributed: compute {tot['compute_s']:.3f}s  "
           f"transfer {tot['transfer_s']:.3f}s "
           f"(hidden {tot['hidden_transfer_s']:.3f}s)  "
