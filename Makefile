@@ -10,7 +10,7 @@ PY := env -u PALLAS_AXON_POOL_IPS python
 .PHONY: all native test test-native verify-all verify-repeat \
 	verify-stress verify-sim verify-trace verify-serving verify-wire \
 	verify-prof verify-campaign verify-federation verify-shard \
-	bench-diff bench-provenance \
+	verify-migrate bench-diff bench-provenance \
 	verify-native-sanitized \
 	check-coverage lint \
 	lint-drill asan \
@@ -80,7 +80,8 @@ verify-repeat: native
 # control-plane hot path).  Cheaper than verify-repeat (minutes, not an
 # hour), meant to run on every change to locking/queueing code.
 verify-stress: verify-sim verify-campaign verify-trace verify-serving \
-	verify-wire verify-federation verify-prof verify-shard bench-diff
+	verify-wire verify-federation verify-prof verify-shard \
+	verify-migrate bench-diff
 	@for i in 1 2 3 4 5; do \
 		echo "=== verify-stress round $$i/5 ==="; \
 		env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -233,6 +234,28 @@ verify-shard:
 		python benchmarks/sched_bench.py --shards 4 \
 		--nodes 4000 --chips 2 --pods 8000 --gate-speedup 1.3
 	@echo "verify-shard: OK"
+
+# Streaming-live-migration gate (protocol v8, docs/migration.md): the
+# migration edge battery (wire end-to-end, dirty-gen tracking, freeze
+# semantics, abort/target-death recovery, strict-gang refusal,
+# double-migration conflict-skip, v2-v7 frame-tap interop), the
+# rolling-pool-upgrade twin scenario run TWICE with digests compared,
+# then the pause-time bench cell exit-coded on the <=10%%-of-
+# stop-and-copy acceptance (smoke shape; artifact to a temp dir so
+# the checked-in full-shape record survives).  Run on any change to
+# remoting/ (protocol, worker, client), controllers/defrag.py, the
+# serving engine/kvpool migration hooks, or the hypervisor endpoints.
+verify-migrate:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		python -m pytest tests/test_migration_streaming.py -q \
+		-p no:cacheprovider -p no:xdist -p no:randomly
+	$(PY) benchmarks/sim_scenarios.py --scale small --seed 42 \
+		--scenario rolling-pool-upgrade
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		TPF_BENCH_RESULTS_DIR=/tmp/tpfmigrate_verify_results \
+		python benchmarks/migration_bench.py --smoke \
+		--gate-ratio 0.10
+	@echo "verify-migrate: OK"
 
 # Perf-regression comparator (docs/test-matrix.md): every checked-in
 # benchmarks/results/*.json artifact vs the `previous` record it

@@ -1,0 +1,203 @@
+"""Streaming live-migration benchmark (protocol v8, docs/migration.md).
+
+Measures the TENANT-VISIBLE pause of migrating one worker's
+device-resident state to another, same shape both ways:
+
+- **stop-and-copy** (the pre-v8 contract): SNAPSHOT on the source +
+  RESTORE on the target — the tenant is dark for the whole window
+  (that is exactly what ``LiveMigrator.migrate`` brackets with the
+  evict/rebind).
+- **streaming** (iterative pre-copy): live SNAPSHOT_DELTA rounds while
+  a tenant keeps dirtying state with EXECUTE traffic, then
+  MIGRATE_FREEZE + MIGRATE_COMMIT — only the frozen final round is
+  dark, and the ``pause_ms`` the commit reports is the realized
+  tenant-dark window.
+
+Acceptance (ROADMAP 2): streaming pause <= 10%% of the same-shape
+stop-and-copy pause (``--gate-ratio`` exit-codes the criterion for
+``make verify-migrate``).  A second streaming run with the lossy q8
+session (``quant``) records the delta-byte cut for tolerance-declared
+tenants.  The artifact embeds ``previous`` + ``backend_evidence`` like
+every perf record.
+
+    python benchmarks/migration_bench.py [--buffers N] [--mb-per-buffer F]
+        [--smoke] [--gate-ratio R]
+"""
+
+from __future__ import annotations
+
+import argparse
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, ".")  # repo root (benchmarks/ is not a package)
+
+import numpy as np  # noqa: E402
+
+from benchmarks._artifact import (backend_evidence,  # noqa: E402
+                                  previous_artifact, write_artifact)
+
+
+def _seed_state(dev, n_buffers: int, mb: float, rng):
+    """Resident shape: n_buffers float32 buffers of ``mb`` MiB each,
+    plus one compiled executable (the restore side must recompile it
+    on the stop-and-copy path)."""
+    import jax.numpy as jnp
+
+    n = int(mb * (1 << 20) / 4)
+    bufs = [dev.put(rng.random(n).astype(np.float32))
+            for _ in range(n_buffers)]
+    fn = dev.remote_jit(lambda x: jnp.tanh(x) * 1.01)
+    out = fn(np.ones(4096, dtype=np.float32))     # compile + cache
+    return bufs, fn, out
+
+
+def measure_stop_copy(n_buffers: int, mb: float, seed: int = 0) -> dict:
+    """Tenant-dark window of the classic path: SNAPSHOT wall time +
+    RESTORE wall time (the evict/rebind between them is control-plane
+    time on top — this is the floor)."""
+    from tensorfusion_tpu.remoting import RemoteDevice, RemoteVTPUWorker
+
+    src, tgt = RemoteVTPUWorker(), RemoteVTPUWorker()
+    src.start()
+    tgt.start()
+    state_dir = tempfile.mkdtemp(prefix="tpf-mig-bench-")
+    try:
+        dev = RemoteDevice(src.url)
+        _seed_state(dev, n_buffers, mb, np.random.default_rng(seed))
+        orch = RemoteDevice(src.url)
+        t0 = time.perf_counter()
+        snap = orch.snapshot(state_dir)
+        t1 = time.perf_counter()
+        tdev = RemoteDevice(tgt.url)
+        t2 = time.perf_counter()
+        tdev.restore(state_dir)
+        t3 = time.perf_counter()
+        return {"pause_ms": round(((t1 - t0) + (t3 - t2)) * 1e3, 3),
+                "snapshot_ms": round((t1 - t0) * 1e3, 3),
+                "restore_ms": round((t3 - t2) * 1e3, 3),
+                "buffers": snap.get("buffers", n_buffers)}
+    finally:
+        src.stop()
+        tgt.stop()
+        shutil.rmtree(state_dir, ignore_errors=True)
+
+
+def measure_streaming(n_buffers: int, mb: float, seed: int = 0,
+                      quant: bool = False) -> dict:
+    """Streaming pause on the same shape, with a live tenant dirtying
+    one buffer between rounds (the convergence policy's raison
+    d'etre)."""
+    from tensorfusion_tpu.remoting import RemoteDevice, RemoteVTPUWorker
+
+    src, tgt = RemoteVTPUWorker(), RemoteVTPUWorker()
+    src.start()
+    tgt.start()
+    try:
+        rng = np.random.default_rng(seed)
+        dev = RemoteDevice(src.url)
+        bufs, fn, out1 = _seed_state(dev, n_buffers, mb, rng)
+        orch = RemoteDevice(src.url)
+        rounds = []
+        r = orch.snapshot_delta(tgt.url, quant=quant)
+        rounds.append(r)
+        # live tenant keeps executing + dirties a slice of its state
+        # between rounds — the second round ships only the delta
+        n = int(mb * (1 << 20) / 4)
+        dev.put(rng.random(n).astype(np.float32))
+        out_live = fn(np.ones(4096, dtype=np.float32))
+        r = orch.snapshot_delta(tgt.url, quant=quant)
+        rounds.append(r)
+        fr = orch.migrate_freeze()
+        cm = orch.migrate_commit()
+        # correctness spot-check: the migrated executable reproduces
+        # the pre-migration result on the target
+        tdev = RemoteDevice(tgt.url)
+        import jax.numpy as jnp
+
+        fn2 = tdev.remote_jit(lambda x: jnp.tanh(x) * 1.01)
+        out2 = fn2(np.ones(4096, dtype=np.float32))
+        assert np.allclose(np.asarray(out1), np.asarray(out2)), \
+            "migrated executable diverged"
+        assert out_live is not None
+        return {"pause_ms": float(cm["pause_ms"]),
+                "rounds": int(cm["rounds"]),
+                "raw_bytes": int(cm["raw_bytes"]),
+                "wire_bytes": int(cm["wire_bytes"]),
+                "frozen_dirty_buffers": int(fr.get("dirty_buffers",
+                                                   0)),
+                "round_receipts": [
+                    {k: rr.get(k) for k in ("round", "buffers",
+                                            "raw_bytes", "wire_bytes",
+                                            "elapsed_ms",
+                                            "dirty_left")}
+                    for rr in rounds]}
+    finally:
+        src.stop()
+        tgt.stop()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="migration_bench")
+    ap.add_argument("--buffers", type=int, default=16)
+    ap.add_argument("--mb-per-buffer", type=float, default=4.0)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shape for CI (artifact still written "
+                         "when TPF_BENCH_RESULTS_DIR points elsewhere)")
+    ap.add_argument("--gate-ratio", type=float, default=None,
+                    help="exit non-zero unless streaming pause <= "
+                         "RATIO x stop-and-copy pause")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.buffers, args.mb_per_buffer = 6, 1.0
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    stop_copy = measure_stop_copy(args.buffers, args.mb_per_buffer,
+                                  seed=args.seed)
+    streaming = measure_streaming(args.buffers, args.mb_per_buffer,
+                                  seed=args.seed)
+    streaming_q8 = measure_streaming(args.buffers, args.mb_per_buffer,
+                                     seed=args.seed, quant=True)
+    ratio = streaming["pause_ms"] / max(stop_copy["pause_ms"], 1e-9)
+    result = {
+        "benchmark": "migration",
+        "platform": platform,
+        "backend_evidence": backend_evidence(platform),
+        "resident_mb": round(args.buffers * args.mb_per_buffer, 3),
+        "buffers": args.buffers,
+        "stop_copy": stop_copy,
+        "streaming": streaming,
+        "streaming_q8": streaming_q8,
+        "pause_stop_copy_ms": stop_copy["pause_ms"],
+        "pause_streaming_ms": streaming["pause_ms"],
+        "pause_ratio": round(ratio, 6),
+        "q8_delta_bytes_ratio": round(
+            streaming_q8["raw_bytes"] /
+            max(streaming_q8["wire_bytes"], 1), 3),
+        "previous": previous_artifact("migration"),
+    }
+    write_artifact("migration", result)
+    print(f"stop-and-copy pause: {stop_copy['pause_ms']:.1f}ms "
+          f"(snapshot {stop_copy['snapshot_ms']:.1f} + restore "
+          f"{stop_copy['restore_ms']:.1f})")
+    print(f"streaming pause:     {streaming['pause_ms']:.1f}ms over "
+          f"{streaming['rounds']} rounds "
+          f"({streaming['wire_bytes']} wire bytes)")
+    print(f"pause ratio:         {ratio:.4f}")
+    print(f"q8 delta byte cut:   "
+          f"{result['q8_delta_bytes_ratio']:.2f}x")
+    if args.gate_ratio is not None and ratio > args.gate_ratio:
+        print(f"migration_bench: FAIL — streaming pause is "
+              f"{ratio:.3f}x stop-and-copy (gate {args.gate_ratio})")
+        return 1
+    print("migration_bench: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -232,6 +232,10 @@ class CompactionConfig:
     defrag_cron: str = ""             # "m h dom mon dow"; empty disables
     defrag_util_threshold_percent: float = 30.0
     defrag_eviction_ttl_seconds: float = 600.0
+    #: defrag drains pre-copy tenants via LiveMigrator.migrate_streaming
+    #: (docs/migration.md) instead of blind eviction — per-tenant pause
+    #: budgets from the QoS ladder, low-QoS tenants drained first
+    streaming_migration: bool = False
 
 
 @dataclass

@@ -159,6 +159,18 @@ QOS_DISPATCH_WEIGHTS = {
     QOS_CRITICAL: 8.0,
 }
 
+#: tenant-visible pause budget per QoS class for STREAMING live
+#: migration (docs/migration.md): the deadline-aware defrag ladder —
+#: critical tenants get the smallest final-pause window (their
+#: ``deadline_ms`` headroom is smallest), low-QoS tenants tolerate
+#: more and migrate first when a drain empties a node.
+QOS_MIGRATION_PAUSE_BUDGET_MS = {
+    QOS_LOW: 2000.0,
+    QOS_MEDIUM: 500.0,
+    QOS_HIGH: 150.0,
+    QOS_CRITICAL: 50.0,
+}
+
 ISOLATION_SHARED = "shared"            # no enforcement, best effort
 ISOLATION_SOFT = "soft"                # shm token buckets + ERL (~1% overhead)
 ISOLATION_HARD = "hard"                # one-shot provider hard caps

@@ -15,11 +15,22 @@ Analogs of the reference's heaviest lifecycle machinery:
   ``server.go:114-115``, GPU phase ``Migrating``): freeze + snapshot via
   the node hypervisor, rebind the pod off the node, restore + thaw on the
   target — the controlled-counterpart of defrag's evict-and-reschedule.
+- **Streaming live migration** (protocol v8, docs/migration.md):
+  :meth:`LiveMigrator.migrate_streaming` replaces the stop-the-world
+  SNAPSHOT/evict/RESTORE window with iterative pre-copy — delta rounds
+  ship device-resident state worker-to-worker while the tenant keeps
+  executing, a convergence policy (:class:`StreamingConvergence`)
+  decides when the predicted next delta fits the tenant's QoS pause
+  budget (``constants.QOS_MIGRATION_PAUSE_BUDGET_MS``), and only then
+  is the tenant frozen for one bounded final round before the binding
+  flips.  Hot tenants that never converge fall back to stop-and-copy.
 """
 
 from __future__ import annotations
 
+import json
 import logging
+import random
 import threading
 import urllib.request
 from typing import Dict, List, Optional
@@ -56,10 +67,14 @@ def _clone_pod_spec(spec):
 
 
 def _make_replacement(pod: Pod, exclude_node: str,
-                      mark_defrag_label: bool = False) -> Pod:
+                      mark_defrag_label: bool = False,
+                      also_exclude=()) -> Pod:
     """The eviction contract in one place: a rebindable clone of ``pod``
     with binding artifacts stripped and ``exclude_node`` stamped into the
-    drain exclusions (TTL-cleared later)."""
+    drain exclusions (TTL-cleared later).  ``also_exclude`` extends the
+    exclusion set — streaming migration pins the rebind onto its
+    pre-copied target by excluding every OTHER candidate (same TTL
+    bookkeeping, so the pin expires like any drain mark)."""
     replacement = Pod.new(pod.metadata.name,
                           namespace=pod.metadata.namespace)
     replacement.metadata.labels = dict(pod.metadata.labels)
@@ -69,16 +84,150 @@ def _make_replacement(pod: Pod, exclude_node: str,
     for k in (constants.ANN_CHIP_IDS, constants.ANN_PARTITION_IDS,
               constants.ANN_POD_INDEX, constants.ANN_PORT_NUMBER):
         ann.pop(k, None)
-    ann[constants.ANN_EXCLUDED_NODES] = _merge_exclusions(
-        ann.get(constants.ANN_EXCLUDED_NODES, ""), exclude_node)
-    ann[constants.ANN_DEFRAG_EXCLUDED] = _merge_exclusions(
-        ann.get(constants.ANN_DEFRAG_EXCLUDED, ""), exclude_node)
+    for node in [exclude_node] + [n for n in also_exclude
+                                  if n and n != exclude_node]:
+        ann[constants.ANN_EXCLUDED_NODES] = _merge_exclusions(
+            ann.get(constants.ANN_EXCLUDED_NODES, ""), node)
+        ann[constants.ANN_DEFRAG_EXCLUDED] = _merge_exclusions(
+            ann.get(constants.ANN_DEFRAG_EXCLUDED, ""), node)
     ann[constants.ANN_DEFRAG_EVICTED_SINCE] = str(default_clock().now())
     replacement.metadata.annotations = ann
     replacement.spec = _clone_pod_spec(pod.spec)
     return replacement
 
 log = logging.getLogger("tpf.controller.defrag")
+
+
+def _pod_qos(pod: Pod) -> str:
+    """The tenant's QoS class (webhook-stamped annotation), defaulted
+    like every other consumer of the ladder."""
+    qos = pod.metadata.annotations.get(constants.ANN_QOS, "")
+    return qos if qos in constants.QOS_LEVELS else constants.DEFAULT_QOS
+
+
+def migration_pause_budget_ms(qos: str) -> float:
+    """Tenant-visible pause budget for a streaming migration — the
+    deadline_ms/QOS ladder applied to the final freeze window."""
+    return float(constants.QOS_MIGRATION_PAUSE_BUDGET_MS.get(
+        qos, constants.QOS_MIGRATION_PAUSE_BUDGET_MS[
+            constants.DEFAULT_QOS]))
+
+
+class StreamingConvergence:
+    """Round-by-round convergence policy for iterative pre-copy.
+
+    After each SNAPSHOT_DELTA round the source reports how many buffers
+    were dirtied *while the round shipped* (``dirty_left``) and the
+    realized bandwidth; the policy predicts the next (frozen) round's
+    pause and decides:
+
+    - ``"freeze"``  — predicted pause fits the tenant's budget: pay it;
+    - ``"continue"``— still converging: run another live round;
+    - ``"fallback"``— the dirty rate beats the copy bandwidth (a hot
+      tenant re-dirties faster than rounds drain) or the round cap is
+      hit: stop-and-copy is cheaper than iterating forever.
+    """
+
+    #: fixed per-freeze overhead (quiesce + commit round trip) added to
+    #: the predicted copy time
+    FREEZE_OVERHEAD_MS = 20.0
+
+    def __init__(self, pause_budget_ms: float, max_rounds: int = 8):
+        self.pause_budget_ms = float(pause_budget_ms)
+        self.max_rounds = max(1, int(max_rounds))
+
+    def predicted_pause_ms(self, stats: Dict) -> float:
+        buffers = max(int(stats.get("buffers", 0)), 1)
+        avg_bytes = float(stats.get("raw_bytes", 0)) / buffers
+        dirty_left = int(stats.get("dirty_left", 0))
+        bw = float(stats.get("bandwidth_bps", 0)) or 1e9
+        return self.FREEZE_OVERHEAD_MS + \
+            dirty_left * avg_bytes / bw * 1e3
+
+    def decide(self, stats: Dict) -> str:
+        if self.predicted_pause_ms(stats) <= self.pause_budget_ms:
+            return "freeze"
+        if int(stats.get("round", 0)) >= self.max_rounds:
+            return "fallback"
+        if int(stats.get("round", 0)) >= 2 and \
+                int(stats.get("dirty_left", 0)) >= \
+                int(stats.get("buffers", 0)):
+            # not converging: this round re-dirtied at least as much as
+            # it shipped — more rounds only burn bandwidth
+            return "fallback"
+        return "continue"
+
+
+class HypervisorMigrationTransport:
+    """Default ``migrate_streaming`` transport: drives the migration
+    opcodes through the source node's hypervisor HTTP endpoints
+    (``/api/v1/workers/<ns>/<name>/migrate_delta|migrate_freeze|
+    migrate_commit``), which forward to the co-hosted remote worker
+    over the v8 wire.  Tests (and the twin) inject fakes with the same
+    four-method surface."""
+
+    def __init__(self, migrator: "LiveMigrator"):
+        self.migrator = migrator
+
+    def _post_json(self, url: str, body: Dict) -> Optional[Dict]:
+        from ..utils.tlsutil import hypervisor_urlopen
+
+        try:
+            with hypervisor_urlopen(url, method="POST",
+                                    data=json.dumps(body).encode(),
+                                    timeout_s=30) as r:
+                return json.loads(r.read() or b"{}")
+        except Exception as e:  # noqa: BLE001 - caller falls back
+            log.warning("migration transport POST %s failed: %s",
+                        url, e)
+            return None
+
+    def target_worker_url(self, target_node: str) -> Optional[str]:
+        """The target hypervisor's co-hosted worker URL — where the
+        source worker ships its deltas (worker-to-worker, never
+        through this controller)."""
+        from ..utils.tlsutil import hypervisor_urlopen
+
+        hv = self.migrator._hypervisor_url(target_node)
+        if not hv:
+            return None
+        try:
+            with hypervisor_urlopen(f"{hv}/api/v1/migrate_target",
+                                    timeout_s=10) as r:
+                return json.loads(r.read() or b"{}").get(
+                    "worker_url") or None
+        except Exception as e:  # noqa: BLE001 - caller falls back
+            log.warning("migrate_target probe of %s failed: %s", hv, e)
+            return None
+
+    def _worker_url(self, source: str, namespace: str,
+                    pod: str) -> str:
+        hv = self.migrator._hypervisor_url(source)
+        return f"{hv}/api/v1/workers/{namespace}/{pod}" if hv else ""
+
+    def delta(self, namespace: str, pod: str, source: str,
+              target_url: str, final: bool = False) -> Optional[Dict]:
+        base = self._worker_url(source, namespace, pod)
+        if not base:
+            return None
+        return self._post_json(f"{base}/migrate_delta",
+                               {"target_url": target_url,
+                                "final": bool(final)})
+
+    def freeze(self, namespace: str, pod: str,
+               source: str) -> Optional[Dict]:
+        base = self._worker_url(source, namespace, pod)
+        if not base:
+            return None
+        return self._post_json(f"{base}/migrate_freeze", {})
+
+    def commit(self, namespace: str, pod: str, source: str,
+               abort: bool = False) -> Optional[Dict]:
+        base = self._worker_url(source, namespace, pod)
+        if not base:
+            return None
+        return self._post_json(f"{base}/migrate_commit",
+                               {"abort": bool(abort)})
 
 
 class CompactionController(Controller):
@@ -88,16 +237,22 @@ class CompactionController(Controller):
 
     def __init__(self, store, allocator, scheduler=None,
                  empty_grace_s: Optional[float] = None,
-                 clock: Optional[Clock] = None):
+                 clock: Optional[Clock] = None, migrator=None):
         self.store = store
         self.allocator = allocator
         self.scheduler = scheduler
         self.clock = clock or default_clock()
         self.empty_grace_override = empty_grace_s
+        #: LiveMigrator for streaming drains (docs/migration.md): when
+        #: the pool opts in (``compaction.streaming_migration``), a
+        #: defrag drain pre-copies each tenant instead of blind
+        #: eviction — per-tenant pause budgets from the QoS ladder
+        self.migrator = migrator
         self._empty_since: Dict[str, float] = {}
         self._last_defrag: Dict[str, float] = {}
         self.evicted_for_defrag: List[str] = []
         self.compacted_nodes: List[str] = []
+        self.streamed_for_defrag: List[str] = []
 
     DEFAULT_EVICTION_TTL_S = 600.0
 
@@ -251,6 +406,15 @@ class CompactionController(Controller):
         """
         pods = self.store.list(
             Pod, selector=lambda p: p.spec.node_name == node)
+        # deadline-aware drain order: LOW-QoS tenants migrate first —
+        # they tolerate the largest pause budgets, so the node empties
+        # from the cheap end while critical tenants keep running until
+        # the drain has proven itself (ties broken by key for
+        # determinism)
+        pods.sort(key=lambda p: (constants.QOS_DISPATCH_WEIGHTS.get(
+            _pod_qos(p), 2.0), p.key()))
+        streaming = bool(getattr(cfg, "streaming_migration", False)) \
+            and self.migrator is not None
         evicted = 0
         now = str(self.clock.now())
         gangs_seen: set = set()
@@ -267,6 +431,20 @@ class CompactionController(Controller):
                 continue
             if self._protected(pod):
                 continue
+            if streaming:
+                # pre-copy drain: the tenant keeps executing while its
+                # state streams to the chosen target; pause budget
+                # from its QoS class.  migrate_streaming falls back to
+                # stop-and-copy itself for hot tenants; None (no
+                # placement / conflict) falls through to the classic
+                # evict probe below, which stamps the skip marks
+                result = self.migrator.migrate_streaming(
+                    pod.metadata.namespace, pod.metadata.name)
+                if result is not None:
+                    self.streamed_for_defrag.append(pod.key())
+                    self.evicted_for_defrag.append(pod.key())
+                    evicted += 1
+                    continue
             # capacity-only dry-run (the pod's own quota is still
             # committed, so a quota check would double-count it)
             probe.pod_name += "-defrag-probe"
@@ -460,10 +638,78 @@ class LiveMigrator:
     """Hot vTPU migration: snapshot on the source hypervisor, rebind the
     pod elsewhere, restore on the target (SURVEY §5 checkpoint/resume)."""
 
+    #: migration-hook POST attempts (bounded jittered retry: a
+    #: transient hypervisor hiccup must not silently skip SNAPSHOT
+    #: before eviction)
+    POST_ATTEMPTS = 2
+
     def __init__(self, store, allocator, clock: Optional[Clock] = None):
         self.store = store
         self.allocator = allocator
         self.clock = clock or default_clock()
+        #: deterministic retry jitter (seeded: tests and the twin get
+        #: reproducible retry timing)
+        self._rng = random.Random(0x519)
+        #: pods with a migration in flight — a second migrate of the
+        #: same pod conflict-skips instead of double-snapshotting
+        # guarded by: _state_lock
+        self._inflight: set = set()
+        #: deferred-resume watchers, joined by close() so a resume
+        #: landing after controller stop cannot touch a dead store
+        # guarded by: _state_lock
+        self._resume_threads: List[threading.Thread] = []
+        self._state_lock = threading.Lock()
+        self._stopped = threading.Event()
+        # -- streaming-migration counters (metrics + tests) ---------------
+        self.streaming_committed = 0
+        self.streaming_fallback = 0
+        self.streaming_aborted = 0
+        self.streaming_rounds_total = 0
+        self.streaming_delta_bytes_total = 0
+        #: realized tenant-dark windows, newest last (bounded)
+        self.pause_ms_history: List[float] = []
+
+    def close(self) -> None:
+        """Shutdown: stop and join deferred-resume watchers.  After
+        close() no background thread of this migrator touches the
+        store (controller-stop ordering contract)."""
+        self._stopped.set()
+        with self._state_lock:
+            threads = list(self._resume_threads)
+        for t in threads:
+            t.join(timeout=5)
+
+    def reopen(self) -> None:
+        """Re-arm after a demote/close cycle (leader re-promotion):
+        new deferred-resume watchers may run again."""
+        if self._stopped.is_set():
+            self._stopped = threading.Event()
+
+    def _spawn_deferred_resume(self, namespace: str, pod_name: str,
+                               source: str) -> None:
+        t = threading.Thread(
+            target=self._deferred_resume,
+            args=(namespace, pod_name, source), daemon=True,
+            name=f"tpf-migrate-{pod_name}")
+        with self._state_lock:
+            # prune finished watchers so the registry stays bounded
+            self._resume_threads = [x for x in self._resume_threads
+                                    if x.is_alive()]
+            self._resume_threads.append(t)
+        t.start()
+
+    def _claim(self, key: str) -> bool:
+        with self._state_lock:
+            if key in self._inflight:
+                log.warning("migration of %s already in flight; "
+                            "conflict-skipping", key)
+                return False
+            self._inflight.add(key)
+            return True
+
+    def _unclaim(self, key: str) -> None:
+        with self._state_lock:
+            self._inflight.discard(key)
 
     def _hypervisor_url(self, node: str) -> str:
         tnode = self.store.try_get(TPUNode, node)
@@ -496,15 +742,30 @@ class LiveMigrator:
             mutate(self.store, TPUChip, chip_name, set_running)
 
     def _post(self, url: str) -> bool:
-        try:
-            from ..utils.tlsutil import hypervisor_urlopen
+        """Fire one migration hook, with a bounded jittered retry: the
+        first attempt may hit a transient hypervisor hiccup (restart,
+        listener backlog), and silently skipping SNAPSHOT before an
+        eviction would migrate a tenant without its state.  Exactly
+        :attr:`POST_ATTEMPTS` tries; the jitter is drawn from the
+        migrator's seeded RNG so the schedule is deterministic under
+        test clocks."""
+        from ..utils.tlsutil import hypervisor_urlopen
 
-            hypervisor_urlopen(url, method="POST", data=b"{}",
-                               timeout_s=10)
-            return True
-        except Exception as e:  # noqa: BLE001
-            log.warning("migration hook %s failed: %s", url, e)
-            return False
+        last: Optional[Exception] = None
+        for attempt in range(self.POST_ATTEMPTS):
+            try:
+                hypervisor_urlopen(url, method="POST", data=b"{}",
+                                   timeout_s=10)
+                return True
+            except Exception as e:  # noqa: BLE001 - retried, then warned
+                last = e
+                if attempt + 1 < self.POST_ATTEMPTS:
+                    self.clock.sleep(
+                        0.05 * (attempt + 1) *
+                        (1.0 + self._rng.random()))
+        log.warning("migration hook %s failed after %d attempts: %s",
+                    url, self.POST_ATTEMPTS, last)
+        return False
 
     def migrate(self, namespace: str, pod_name: str,
                 wait_rebind_s: float = 10.0) -> Optional[str]:
@@ -514,7 +775,17 @@ class LiveMigrator:
         evicts capacity its quorum depends on and live-locks the group —
         use ``migrate_gang`` (all members, atomically probed) instead
         (same all-or-nothing argument as CompactionController._drain_gang).
-        """
+        A pod with a migration already in flight conflict-skips."""
+        if not self._claim(f"{namespace}/{pod_name}"):
+            return None
+        try:
+            return self._migrate_stop_copy(namespace, pod_name,
+                                           wait_rebind_s)
+        finally:
+            self._unclaim(f"{namespace}/{pod_name}")
+
+    def _migrate_stop_copy(self, namespace: str, pod_name: str,
+                           wait_rebind_s: float = 10.0) -> Optional[str]:
         pod = self.store.try_get(Pod, pod_name, namespace)
         if pod is None or not pod.spec.node_name:
             return None
@@ -590,12 +861,202 @@ class LiveMigrator:
             # once the pod lands (the caller sees None = "not yet bound")
             log.warning("migration of %s: rebind pending past %ss; "
                         "deferring restore", key, wait_rebind_s)
-            t = threading.Thread(
-                target=self._deferred_resume,
-                args=(namespace, pod_name, source), daemon=True,
-                name=f"tpf-migrate-{pod_name}")
-            t.start()
+            self._spawn_deferred_resume(namespace, pod_name, source)
         return new_node
+
+    def migrate_streaming(self, namespace: str, pod_name: str,
+                          pause_budget_ms: Optional[float] = None,
+                          max_rounds: int = 8,
+                          wait_rebind_s: float = 10.0,
+                          transport=None) -> Optional[Dict]:
+        """Iterative pre-copy live migration (ROADMAP 2, protocol v8,
+        docs/migration.md): stream delta rounds of the source worker's
+        device-resident state to a pre-selected target while the
+        tenant keeps executing; freeze only when the convergence
+        policy predicts the final round fits the tenant's QoS pause
+        budget; then flip the binding and resume on the target.
+
+        Returns ``{"pod", "new_node", "target", "mode", "rounds",
+        "pause_ms", ...}`` — ``mode`` is ``"streaming"`` or
+        ``"stop-and-copy"`` when a hot tenant forced the fallback —
+        or None (no placement, conflict-skip, strict-gang member, or
+        an abort that left the source intact).  Strict-gang members
+        are refused exactly like :meth:`migrate`; a pod already
+        migrating conflict-skips."""
+        key = f"{namespace}/{pod_name}"
+        if not self._claim(key):
+            return None
+        try:
+            return self._migrate_streaming_inner(
+                namespace, pod_name, pause_budget_ms, max_rounds,
+                wait_rebind_s, transport)
+        finally:
+            self._unclaim(key)
+
+    def _migrate_streaming_inner(self, namespace: str, pod_name: str,
+                                 pause_budget_ms: Optional[float],
+                                 max_rounds: int,
+                                 wait_rebind_s: float,
+                                 transport) -> Optional[Dict]:
+        pod = self.store.try_get(Pod, pod_name, namespace)
+        if pod is None or not pod.spec.node_name:
+            return None
+        info = gang_info_from_pod(pod)
+        if info is not None and info[4]:
+            # strict gangs only (same argument as migrate()): losing
+            # one member breaks the quorum
+            log.warning("refusing streaming migration of strict-gang "
+                        "member %s/%s; use migrate_gang", namespace,
+                        pod_name)
+            return None
+        source = pod.spec.node_name
+        key = f"{namespace}/{pod_name}"
+        if pause_budget_ms is None:
+            pause_budget_ms = migration_pause_budget_ms(_pod_qos(pod))
+
+        # 0. placement dry-run doubles as target selection: pre-copy
+        #    needs the destination BEFORE the rebind (deltas must land
+        #    where the scheduler will), so the best candidate is chosen
+        #    now and the eventual replacement pod is pinned onto it by
+        #    excluding every other candidate
+        probe = compose_alloc_request(pod)
+        candidates: List[str] = []
+        if probe is not None:
+            probe.pod_name += "-migrate-probe"
+            probe.excluded_nodes = list(set(probe.excluded_nodes)
+                                        | {source})
+            try:
+                by_node, _ = self.allocator.check_quota_and_filter(
+                    probe, skip_quota=True)
+            except Exception:  # noqa: BLE001
+                log.debug("streaming migration probe failed for %s",
+                          key, exc_info=True)
+                by_node = {}
+            if not by_node:
+                log.warning("streaming migration of %s aborted: no "
+                            "alternative placement", key)
+                return None
+            candidates = sorted(by_node)
+        rounds_done = 0
+
+        def fallback(reason: str) -> Optional[Dict]:
+            log.warning("streaming migration of %s: stop-and-copy "
+                        "fallback (%s)", key, reason)
+            if transport is not None:
+                transport.commit(namespace, pod_name, source,
+                                 abort=True)     # best-effort cleanup
+            self.streaming_fallback += 1
+            node = self._migrate_stop_copy(namespace, pod_name,
+                                           wait_rebind_s)
+            if node is None:
+                return None
+            return {"pod": key, "new_node": node, "target": node,
+                    "mode": "stop-and-copy", "rounds": rounds_done,
+                    "pause_ms": None}
+
+        if not candidates:
+            # no composable probe (no TPU request): nothing device-
+            # resident to pre-copy — the classic path handles it
+            return fallback("no pre-copy target candidates")
+        target = candidates[0]
+        if transport is None:
+            transport = HypervisorMigrationTransport(self)
+        target_url = transport.target_worker_url(target)
+        if not target_url:
+            return fallback(f"target {target} has no worker endpoint")
+        policy = StreamingConvergence(pause_budget_ms,
+                                      max_rounds=max_rounds)
+
+        # 1. live pre-copy rounds (tenant keeps executing; the rounds
+        #    ride the source worker's WFQ ladder as low-QoS items)
+        while True:
+            cur = self.store.try_get(Pod, pod_name, namespace)
+            if cur is None or cur.spec.node_name != source:
+                log.warning("streaming migration of %s aborted: pod "
+                            "deleted or rebound mid-round", key)
+                transport.commit(namespace, pod_name, source,
+                                 abort=True)
+                self.streaming_aborted += 1
+                return None
+            stats = transport.delta(namespace, pod_name, source,
+                                    target_url)
+            if not stats or stats.get("error"):
+                return fallback("delta round failed (worker "
+                                "unreachable or target dead)")
+            rounds_done = int(stats.get("round", rounds_done + 1))
+            self.streaming_rounds_total += 1
+            self.streaming_delta_bytes_total += \
+                int(stats.get("wire_bytes", 0))
+            verdict = policy.decide(stats)
+            if verdict == "continue":
+                continue
+            if verdict == "fallback":
+                return fallback(
+                    f"no convergence after {rounds_done} rounds "
+                    f"(predicted pause "
+                    f"{policy.predicted_pause_ms(stats):.0f}ms > "
+                    f"budget {pause_budget_ms:.0f}ms)")
+            break
+
+        # 2. bounded final pause: freeze, ship the remainder, flip
+        record = self.allocator.allocation(key)
+        marked = self._mark_migrating(record.chip_ids) \
+            if record is not None else []
+        fr = transport.freeze(namespace, pod_name, source)
+        if not fr or fr.get("error"):
+            self._restore_running(marked)
+            return fallback("freeze failed")
+        cm = transport.commit(namespace, pod_name, source)
+        if not cm or cm.get("error"):
+            # commit failed: the source thawed with its state intact —
+            # the tenant was dark only for the attempt
+            transport.commit(namespace, pod_name, source, abort=True)
+            self._restore_running(marked)
+            self.streaming_aborted += 1
+            log.warning("streaming migration of %s: commit failed; "
+                        "source state intact", key)
+            return None
+        pause_ms = float(cm.get("pause_ms") or 0.0)
+
+        # 3. rebind the pod onto the pre-copied target (every other
+        #    candidate excluded, TTL-cleared like any drain mark)
+        replacement = _make_replacement(
+            pod, source,
+            also_exclude=[n for n in candidates if n != target])
+        try:
+            self.store.delete(Pod, pod_name, namespace)
+        except NotFoundError:
+            self._restore_running(marked)
+            self.streaming_aborted += 1
+            return None
+        self.store.create(replacement)
+        deadline = self.clock.now() + wait_rebind_s
+        new_node = None
+        while self.clock.now() < deadline:
+            cur = self.store.try_get(Pod, pod_name, namespace)
+            if cur is not None and cur.spec.node_name and \
+                    cur.spec.node_name != source:
+                new_node = cur.spec.node_name
+                break
+            self.clock.sleep(0.05)
+        self._restore_running(marked)
+        if new_node:
+            # state is already resident on the target worker; the
+            # resume hook just thaws (suffix-identical serving
+            # regeneration, the preemption re-admission contract)
+            self._resume_on(new_node, namespace, pod_name)
+        else:
+            self._spawn_deferred_resume(namespace, pod_name, source)
+        self.streaming_committed += 1
+        self.pause_ms_history.append(pause_ms)
+        del self.pause_ms_history[:-256]
+        log.info("streaming-migrated %s: %s -> %s in %d rounds, "
+                 "pause %.1fms", key, source, new_node or "(pending)",
+                 rounds_done, pause_ms)
+        return {"pod": key, "new_node": new_node, "target": target,
+                "mode": "streaming", "rounds": rounds_done,
+                "pause_ms": pause_ms,
+                "wire_bytes": int(cm.get("wire_bytes") or 0)}
 
     def migrate_gang(self, namespace: str, pod_name: str,
                      wait_rebind_s: float = 10.0) -> Optional[Dict[str, str]]:
@@ -688,11 +1149,8 @@ class LiveMigrator:
                 self._resume_on(new_node, p.metadata.namespace,
                                 p.metadata.name)
             else:
-                threading.Thread(
-                    target=self._deferred_resume,
-                    args=(p.metadata.namespace, p.metadata.name, source),
-                    daemon=True,
-                    name=f"tpf-migrate-{p.metadata.name}").start()
+                self._spawn_deferred_resume(p.metadata.namespace,
+                                            p.metadata.name, source)
         if len(placed) == len(evicted):
             log.info("migrated gang %s off %s: %s", group_key, source,
                      placed)
@@ -709,6 +1167,12 @@ class LiveMigrator:
                          source: str, deadline_s: float = 120.0) -> None:
         deadline = self.clock.now() + deadline_s
         while self.clock.now() < deadline:
+            if self._stopped.is_set():
+                # controller shutdown: the store may already be torn
+                # down — exit without touching it (close() joins us)
+                log.info("deferred restore of %s/%s abandoned: "
+                         "migrator stopped", namespace, pod_name)
+                return
             cur = self.store.try_get(Pod, pod_name, namespace)
             if cur is None:
                 return

@@ -339,6 +339,26 @@ class StoreGateway:
         since_rv = int(qs.get("since_rv", ["0"])[0])
         kinds = [k for k in qs.get("kinds", [""])[0].split(",") if k]
         wait_s = min(float(qs.get("wait_s", ["0"])[0]), MAX_WATCH_WAIT_S)
+        # sharded cells (docs/control-plane-scale.md): there is no
+        # global rv order across partitions, so the watch window is a
+        # PER-SHARD surface — a shard-less first request is answered
+        # with the shard count (window discovery) and the client opens
+        # one long-poll per shard (`shard=i`), each backed by
+        # ``shard_store(i).snapshot_events/events_since``
+        n_shards = int(getattr(self.store, "n_shards", 1) or 1)
+        shard = qs.get("shard", [None])[0]
+        store = self.store
+        if n_shards > 1:
+            if shard is None:
+                return 200, {"rv": 0, "reset": False, "events": [],
+                             "shards": n_shards}
+            idx = int(shard)
+            if not 0 <= idx < n_shards:
+                return 400, {"error": f"shard {idx} out of range "
+                                      f"(cell has {n_shards})"}
+            store = self.store.shard_store(idx)
+        elif shard is not None and int(shard) != 0:
+            return 400, {"error": "store is not sharded"}
         # a client's *first* request (primed=0) establishes its window:
         # with replay it gets the current state as ADDED events, without
         # it just the current rv — either way it then long-polls with
@@ -346,18 +366,18 @@ class StoreGateway:
         # "events since rv 0", which matter apart when the store is empty)
         if qs.get("primed", ["0"])[0] not in ("1", "true"):
             if qs.get("replay", ["1"])[0] in ("0", "false"):
-                return 200, {"rv": self.store.current_rv, "reset": False,
-                             "events": []}
-            rv, snapshot = self.store.snapshot_events(kinds)
-            return 200, {"rv": rv, "reset": False,
+                return 200, {"rv": store.current_rv, "reset": False,
+                             "events": [], "shards": n_shards}
+            rv, snapshot = store.snapshot_events(kinds)
+            return 200, {"rv": rv, "reset": False, "shards": n_shards,
                          "events": [{"type": etype, "kind": kind,
                                      "obj": obj}
                                     for etype, kind, obj in snapshot]}
         conflate = qs.get("conflate", ["0"])[0] in ("1", "true")
-        rv, frags, reset = self.store.events_since(since_rv, kinds,
-                                                   wait_s=wait_s,
-                                                   serialized=True,
-                                                   conflate=conflate)
+        rv, frags, reset = store.events_since(since_rv, kinds,
+                                              wait_s=wait_s,
+                                              serialized=True,
+                                              conflate=conflate)
         reset_s = "true" if reset else "false"
         return 200, RawJson(
             '{"rv":%d,"reset":%s,"events":[%s]}'

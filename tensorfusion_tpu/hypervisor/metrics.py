@@ -117,6 +117,33 @@ def federation_lines(fed, node_name: str, ts: int,
         ts)]
 
 
+def migration_lines(remote_worker, node_name: str, ts: int,
+                    snap=None) -> List[str]:
+    """Influx lines for one worker's streaming-migration state
+    (protocol v8, docs/migration.md): pre-copy round/byte totals,
+    realized tenant-dark pauses, and the live session's staging depth
+    — the ``tpf_migration`` series.  Pass ``snap`` to reuse an
+    already-taken ``migration_stats()``."""
+    if snap is None:
+        snap = remote_worker.migration_stats()
+    sess = snap.get("session") or {}
+    return [encode_line(
+        "tpf_migration", {"node": node_name},
+        {"rounds_total": int(snap["rounds_total"]),
+         "delta_buffers_total": int(snap["delta_buffers_total"]),
+         "delta_raw_bytes_total": int(snap["delta_raw_bytes_total"]),
+         "delta_wire_bytes_total": int(snap["delta_wire_bytes_total"]),
+         "streaming_total": int(snap["streaming_total"]),
+         "aborted_total": int(snap["aborted_total"]),
+         "installed_total": int(snap["installed_total"]),
+         "pause_ms_last": float(snap["pause_ms_last"]),
+         "pause_ms_max": float(snap["pause_ms_max"]),
+         "frozen": int(bool(snap["frozen"])),
+         "session_round": int(sess.get("round", 0)),
+         "session_staged_buffers": int(sess.get("staged_buffers", 0))},
+        ts)]
+
+
 def serving_engine_lines(engine, node_name: str, ts: int,
                          snap=None) -> List[str]:
     """Influx lines for one tpfserve continuous-batching engine
@@ -272,6 +299,8 @@ class HypervisorMetricsRecorder:
                  "partitions": len(e.partitions)}, ts))
         for rw in self.remote_workers:
             lines.extend(remote_dispatch_lines(rw, self.node_name, ts))
+            if hasattr(rw, "migration_stats"):
+                lines.extend(migration_lines(rw, self.node_name, ts))
             # tpfprof attribution series (docs/profiling.md): the
             # worker's per-tenant device-time ledger ships next to the
             # dispatch saturation it explains

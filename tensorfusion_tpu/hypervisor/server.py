@@ -76,6 +76,10 @@ class HypervisorServer:
         #: optional shared token — freeze/resume/snapshot mutate worker
         #: state, so a non-loopback bind should set one
         self.token = token
+        #: cached loopback client to the co-hosted remote worker for
+        #: the streaming-migration endpoints (protocol v8,
+        #: docs/migration.md) — created on first use
+        self._mig_dev = None
         self.tls = bool(tls_cert)
         outer = self
 
@@ -196,7 +200,9 @@ class HypervisorServer:
     # -- routing ----------------------------------------------------------
 
     _WORKER_RE = re.compile(
-        r"^/api/v1/workers/([^/]+)/([^/]+)(?:/(snapshot|resume|freeze))?$")
+        r"^/api/v1/workers/([^/]+)/([^/]+)"
+        r"(?:/(snapshot|resume|freeze|migrate_delta|migrate_freeze"
+        r"|migrate_commit))?$")
 
     def _get(self, h) -> None:
         url = urlparse(h.path)
@@ -223,6 +229,17 @@ class HypervisorServer:
         elif url.path == "/api/v1/dispatch":
             h._send(200, [rw.dispatcher.snapshot()
                           for rw in self.remote_workers])
+        elif url.path == "/api/v1/migrate_target":
+            # streaming-migration target discovery: the URL a SOURCE
+            # worker ships its pre-copy deltas to (worker-to-worker,
+            # never through the controller) — the co-hosted remote
+            # worker's wire endpoint
+            if not self.remote_workers:
+                h._send(409, {"error": "no co-hosted remote worker"})
+                return
+            rw = self.remote_workers[0]
+            h._send(200, {"worker_url": rw.url,
+                          "protocol_version": rw.protocol_version})
         elif url.path == "/api/v1/profile":
             # tpfprof attribution view (docs/profiling.md): per-tenant
             # device-time shares, overlap efficiency and the recent
@@ -326,6 +343,13 @@ class HypervisorServer:
             key = f"{m.group(1)}/{m.group(2)}"
             self.workers.freeze_worker(key)
             h._send(200, {"frozen": key})
+        elif m and m.group(3) == "migrate_delta":
+            self._migrate_delta(h)
+        elif m and m.group(3) == "migrate_freeze":
+            key = f"{m.group(1)}/{m.group(2)}"
+            self._migrate_freeze(key, h)
+        elif m and m.group(3) == "migrate_commit":
+            self._migrate_commit(h)
         else:
             h._send(404, {"error": "not found"})
 
@@ -341,6 +365,71 @@ class HypervisorServer:
             h._send(200, {"deleted": key})
         else:
             h._send(404, {"error": "not found"})
+
+    # -- streaming migration (protocol v8, docs/migration.md) -------------
+
+    def _migration_device(self):
+        """Loopback client to the co-hosted remote worker — the
+        hypervisor drives the v8 migration opcodes over the real wire
+        (same gates, same accounting) rather than poking worker
+        internals."""
+        if not self.remote_workers:
+            return None
+        if self._mig_dev is None:
+            from ..remoting.client import RemoteDevice
+
+            rw = self.remote_workers[0]
+            self._mig_dev = RemoteDevice(rw.url, token=rw.token or "")
+        return self._mig_dev
+
+    def _migrate_delta(self, h) -> None:
+        dev = self._migration_device()
+        if dev is None:
+            h._send(409, {"error": "no co-hosted remote worker"})
+            return
+        body = h._body()
+        target_url = body.get("target_url", "")
+        if not target_url:
+            h._send(400, {"error": "migrate_delta without target_url"})
+            return
+        try:
+            stats = dev.snapshot_delta(
+                target_url,
+                target_token=body.get("target_token"),
+                final=bool(body.get("final")),
+                quant=bool(body.get("quant")))
+        except Exception as e:  # noqa: BLE001 - surface, don't crash
+            h._send(502, {"error": f"migrate_delta failed: {e}"})
+            return
+        h._send(200, stats)
+
+    def _migrate_freeze(self, key: str, h) -> None:
+        dev = self._migration_device()
+        if dev is None:
+            h._send(409, {"error": "no co-hosted remote worker"})
+            return
+        try:
+            stats = dev.migrate_freeze()
+        except Exception as e:  # noqa: BLE001
+            h._send(502, {"error": f"migrate_freeze failed: {e}"})
+            return
+        # process-level pause rides along: the workload's pids freeze
+        # exactly like the stop-and-copy snapshot path
+        self.workers.freeze_worker(key)
+        h._send(200, stats)
+
+    def _migrate_commit(self, h) -> None:
+        dev = self._migration_device()
+        if dev is None:
+            h._send(409, {"error": "no co-hosted remote worker"})
+            return
+        body = h._body()
+        try:
+            stats = dev.migrate_commit(abort=bool(body.get("abort")))
+        except Exception as e:  # noqa: BLE001
+            h._send(502, {"error": f"migrate_commit failed: {e}"})
+            return
+        h._send(200, stats)
 
     # -- snapshot / resume (live-migration hooks, server.go:114-115) ------
 
@@ -362,6 +451,13 @@ class HypervisorServer:
             return
         prov = self.provider or self.devices.provider
         for chip_id in w.status.chip_ids:
-            prov.restore(self.snapshot_dir, chip_id=chip_id)
+            try:
+                prov.restore(self.snapshot_dir, chip_id=chip_id)
+            except Exception:  # noqa: BLE001 - streaming migrations
+                # arrive with their state already worker-resident (no
+                # disk snapshot); a missing manifest must not block
+                # the thaw
+                log.debug("provider restore skipped for %s", chip_id,
+                          exc_info=True)
         self.workers.resume_worker(key)
         h._send(200, {"resumed": key})

@@ -210,11 +210,20 @@ class MetricsRecorder:
         # EXEMPLARS from the dispatcher snapshot, so the queue-wait /
         # SLO series link back to example traces (docs/tracing.md).
         if self.remote_workers:
-            from ..hypervisor.metrics import (remote_dispatch_lines,
+            from ..hypervisor.metrics import (migration_lines,
+                                              remote_dispatch_lines,
                                               serving_engine_lines)
             from .encoder import parse_line
 
             for rw in self.remote_workers:
+                if hasattr(rw, "migration_stats"):
+                    # streaming-migration rounds/pauses (protocol v8,
+                    # docs/migration.md) next to the dispatch series
+                    for line in migration_lines(rw, "operator", ts):
+                        lines.append(line)
+                        measurement, tags, fields, _ = parse_line(line)
+                        self.tsdb.insert(measurement, tags, fields,
+                                         now)
                 snap = rw.dispatcher.snapshot()
                 ex_by_tenant = {
                     conn: t.get("last_trace_id", "")

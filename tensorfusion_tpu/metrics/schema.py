@@ -52,6 +52,18 @@ METRICS_SCHEMA = {
         "fields": ("served_total", "queue_wait_p50_ms",
                    "queue_wait_p99_ms"),
     },
+    # streaming live migration (protocol v8, docs/migration.md):
+    # per-worker pre-copy round/byte totals, realized tenant-dark
+    # pauses, and the live session's staging depth
+    "tpf_migration": {
+        "tags": ("node",),
+        "fields": ("rounds_total", "delta_buffers_total",
+                   "delta_raw_bytes_total", "delta_wire_bytes_total",
+                   "streaming_total", "aborted_total",
+                   "installed_total", "pause_ms_last", "pause_ms_max",
+                   "frozen", "session_round",
+                   "session_staged_buffers"),
+    },
     # tpftrace rollups (tensorfusion_tpu/tracing, docs/tracing.md):
     # per-span-name duration aggregates drained from the tracers each
     # recorder pass, and the per-tenant queue-wait SLO counters the

@@ -117,11 +117,12 @@ class Operator:
         self.manager = ControllerManager(self.store, clock=self.clock)
         self.providerconfig_ctrl = ProviderConfigController(
             self.allocator, self.parser)
-        self.compaction = CompactionController(self.store, self.allocator,
-                                               self.scheduler,
-                                               clock=self.clock)
         self.migrator = LiveMigrator(self.store, self.allocator,
                                      clock=self.clock)
+        self.compaction = CompactionController(self.store, self.allocator,
+                                               self.scheduler,
+                                               clock=self.clock,
+                                               migrator=self.migrator)
         self.rollout = RolloutController(self.store, clock=self.clock)
         for ctrl in (
                 self.compaction,
@@ -283,6 +284,9 @@ class Operator:
         # new generation event (not clear()): a sync thread that
         # outlived a demote's join timeout must not be revived
         self._stop = threading.Event()
+        # re-promotion after a demote re-arms the migrator's deferred-
+        # resume machinery (close() is final only at real shutdown)
+        self.migrator.reopen()
         # informer cache up FIRST: everything below reads through it
         self.cache.start()
         self.cache.wait_synced(10.0)
@@ -377,6 +381,9 @@ class Operator:
                 component.stop()
         self.scheduler.stop()
         self.manager.stop()
+        # deferred-resume watchers must not outlive the stack they
+        # read from (a late resume on a dead store)
+        self.migrator.close()
         if self._sync_thread:
             self._sync_thread.join(timeout=2)
         self.cache.stop()

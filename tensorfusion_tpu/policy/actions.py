@@ -105,9 +105,17 @@ def default_actuators(operator) -> Dict[str, Callable]:
 
     def migrate_tenant(tenant: str = "", namespace: str = "",
                        pod: str = "", wait_rebind_s: float = 5.0,
+                       streaming: bool = True,
+                       pause_budget_ms=None,
                        **_ignored):
-        """Move the noisy tenant off its node via the LiveMigrator
-        (placement-probed; snapshot/restore when hypervisors exist)."""
+        """Move the noisy tenant off its node via the LiveMigrator.
+        ``streaming=True`` (default) takes the iterative pre-copy path
+        (docs/migration.md): delta rounds while the tenant keeps
+        executing, a bounded final pause from its QoS budget (or
+        ``pause_budget_ms``), and an automatic stop-and-copy fallback
+        for hot tenants / nodes without worker endpoints — so the
+        actuator degrades to exactly the old behavior where streaming
+        cannot run."""
         if tenant and not pod:
             if "/" not in tenant:
                 raise ActuationError(
@@ -116,13 +124,32 @@ def default_actuators(operator) -> Dict[str, Callable]:
         if not pod:
             raise ActuationError("migrate_tenant needs tenant= or "
                                  "namespace=/pod=")
+        if streaming:
+            result = operator.migrator.migrate_streaming(
+                namespace, pod, pause_budget_ms=pause_budget_ms,
+                wait_rebind_s=wait_rebind_s)
+            if result is not None and result.get("new_node"):
+                return {"pod": f"{namespace}/{pod}",
+                        "new_node": result["new_node"],
+                        "mode": result.get("mode", "streaming"),
+                        "rounds": result.get("rounds", 0),
+                        "pause_ms": result.get("pause_ms")}
+            if result is not None:
+                raise ActuationError(
+                    f"streaming migration of {namespace}/{pod} "
+                    f"committed but the rebind is still pending")
+            raise ActuationError(
+                f"migration of {namespace}/{pod} did not run "
+                f"(no alternative placement, conflict-skip, or "
+                f"strict-gang member)")
         new_node = operator.migrator.migrate(
             namespace, pod, wait_rebind_s=wait_rebind_s)
         if new_node is None:
             raise ActuationError(
                 f"migration of {namespace}/{pod} did not rebind "
                 f"(no alternative placement, or rebind still pending)")
-        return {"pod": f"{namespace}/{pod}", "new_node": new_node}
+        return {"pod": f"{namespace}/{pod}", "new_node": new_node,
+                "mode": "stop-and-copy"}
 
     def defrag_node(pool: str = "", node: str = "", **_ignored):
         """Drain every migratable workload off one node (the defrag

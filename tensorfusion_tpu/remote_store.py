@@ -55,7 +55,17 @@ class RemoteStoreError(Exception):
 
 
 class RemoteWatch:
-    """Watch-compatible event stream fed by a long-poll thread."""
+    """Watch-compatible event stream fed by long-poll thread(s).
+
+    Against a single-store gateway this is exactly the historical
+    one-thread window.  Against a SHARDED cell (docs/control-plane-
+    scale.md) there is no global rv order — the gateway's shard-less
+    first response carries the shard count, and this watch fans out
+    into **one long-poll window per shard** (each following its own
+    shard's rv sequence, with per-shard reset/re-replay semantics)
+    behind this single iterator — the remote analog of
+    :class:`~.shardedstore.MergedWatch`.  Cross-shard event order is
+    arbitrary, exactly like the in-process merged watch."""
 
     def __init__(self, store: "RemoteStore", kinds: Iterable[str],
                  replay: bool = True, conflate: bool = False):
@@ -64,16 +74,12 @@ class RemoteWatch:
         self._conflate = conflate
         self.queue: "queue.Queue[Optional[Event]]" = queue.Queue()
         self._closed = threading.Event()
-        self._rv = 0
         self._replay = replay
-        self._primed = False
-        # kind -> key -> last seen object; lets a reset re-replay emit
-        # synthetic DELETED events for objects removed while this watcher
-        # was partitioned (the informer re-list diff)
-        self._known: dict = {}
-        self._thread = threading.Thread(
-            target=self._loop, name="tpf-remote-watch", daemon=True)
-        self._thread.start()
+        #: shard windows discovered (1 until the gateway says otherwise)
+        self.shards = 1
+        self._threads_lock = threading.Lock()
+        self._threads: list = []
+        self._spawn(None)
 
     # Watch interface ------------------------------------------------------
 
@@ -97,18 +103,37 @@ class RemoteWatch:
 
     # polling --------------------------------------------------------------
 
-    def _loop(self) -> None:
+    def _spawn(self, shard: Optional[int]) -> None:
+        name = "tpf-remote-watch" if shard is None \
+            else f"tpf-remote-watch-s{shard}"
+        t = threading.Thread(target=self._loop, args=(shard,),
+                             name=name, daemon=True)
+        with self._threads_lock:
+            self._threads.append(t)
+        t.start()
+
+    def _loop(self, shard: Optional[int] = None) -> None:
         backoff = 0
+        rv = 0
+        primed = False
+        replay = self._replay
+        # kind -> key -> last seen object; lets a reset re-replay emit
+        # synthetic DELETED events for objects removed while this watcher
+        # was partitioned (the informer re-list diff).  Per WINDOW: each
+        # shard diffs only the objects it owns.
+        known: dict = {}
         while not self._closed.is_set():
             try:
+                query = {"since_rv": str(rv),
+                         "kinds": ",".join(sorted(self.kinds)),
+                         "replay": "1" if replay else "0",
+                         "primed": "1" if primed else "0",
+                         "conflate": "1" if self._conflate else "0",
+                         "wait_s": str(WATCH_POLL_S)}
+                if shard is not None:
+                    query["shard"] = str(shard)
                 payload = self._store._request(
-                    "GET", "/api/v1/store/watch",
-                    query={"since_rv": str(self._rv),
-                           "kinds": ",".join(sorted(self.kinds)),
-                           "replay": "1" if self._replay else "0",
-                           "primed": "1" if self._primed else "0",
-                           "conflate": "1" if self._conflate else "0",
-                           "wait_s": str(WATCH_POLL_S)},
+                    "GET", "/api/v1/store/watch", query=query,
                     # one retry inside _request; sustained failure handled
                     # by this loop's own backoff so stop() stays prompt
                     max_tries=1)
@@ -124,18 +149,28 @@ class RemoteWatch:
                 continue
             if self._closed.is_set():
                 return
+            n_shards = int(payload.get("shards", 1) or 1)
+            if shard is None and n_shards > 1:
+                # sharded cell: the shard-less first response is window
+                # discovery (no events) — fan out one long-poll window
+                # per shard and continue THIS loop as shard 0's
+                self.shards = n_shards
+                for i in range(1, n_shards):
+                    self._spawn(i)
+                shard = 0
+                continue
             if payload.get("reset"):
                 # fell behind the bounded event log: re-replay current
                 # state (informer 410-Gone re-list).  Consumers see
                 # duplicate ADDEDs for objects they already know — the
                 # same contract in-process replay watches have — plus
                 # synthetic DELETEDs for objects that vanished meanwhile
-                # (diffed against self._known below).
-                self._rv = 0
-                self._replay = True
-                self._primed = False
+                # (diffed against this window's ``known`` below).
+                rv = 0
+                replay = True
+                primed = False
                 continue
-            is_replay = not self._primed and self._replay
+            is_replay = not primed and replay
             decoded = []
             for ev in payload.get("events", []):
                 cls = KIND_BY_NAME.get(ev.get("kind", ""))
@@ -149,20 +184,20 @@ class RemoteWatch:
                                 freeze_copy(from_dict(cls, data))))
             if is_replay:
                 snapshot_keys = {(o.KIND, o.key()) for _, o in decoded}
-                for kind, bucket in self._known.items():
+                for kind, bucket in known.items():
                     for key, obj in list(bucket.items()):
                         if (kind, key) not in snapshot_keys:
                             del bucket[key]
                             self.queue.put(Event(DELETED, obj))
             for etype, obj in decoded:
-                bucket = self._known.setdefault(obj.KIND, {})
+                bucket = known.setdefault(obj.KIND, {})
                 if etype == DELETED:
                     bucket.pop(obj.key(), None)
                 else:
                     bucket[obj.key()] = obj
                 self.queue.put(Event(etype, obj))
-            self._rv = int(payload.get("rv", self._rv))
-            self._primed = True
+            rv = int(payload.get("rv", rv))
+            primed = True
 
 
 class RemoteStore:

@@ -962,6 +962,66 @@ class RemoteDevice:
         _, meta, _ = self._rpc("RESTORE", {"state_dir": state_dir}, [])
         return meta
 
+    # -- streaming live migration (protocol v8, docs/migration.md) -----
+
+    def snapshot_delta(self, target_url: str,
+                       target_token: Optional[str] = None,
+                       final: bool = False,
+                       quant: bool = False) -> Dict[str, Any]:
+        """One pre-copy round of a streaming live migration: the source
+        worker ships every resident buffer dirtied since the session's
+        previous round straight to ``target_url`` — worker-to-worker
+        quiet PUTs through the source's own double-buffered upload
+        stream (q8-eligible), never through this client — and answers
+        with the round receipt (``buffers`` / ``raw_bytes`` /
+        ``wire_bytes`` / ``elapsed_ms`` / ``dirty_left`` /
+        ``bandwidth_bps``) the orchestrator's convergence policy feeds
+        on.  The round rides the source's QoS dispatcher as a
+        LOW-weight work item, so serving traffic keeps its shares.
+        Deltas ship EXACT (raw/zlib-adaptive) by default; ``quant=
+        True`` opts the session into the lossy q8 encoding (~4x fewer
+        delta bytes, round-trip error bounded by the block scale) for
+        tenants whose numerics tolerate it.  Needs a protocol-v8
+        worker — a pre-v8 connection raises before anything hits the
+        wire."""
+        self._ensure_version(protocol.MIGRATE_MIN_VERSION,
+                             "SNAPSHOT_DELTA (streaming migration)")
+        meta: Dict[str, Any] = {"target_url": str(target_url)}
+        if target_token is not None:
+            meta["target_token"] = str(target_token)
+        if final:
+            meta["final"] = True
+        if quant:
+            meta["quant"] = True
+        _, rmeta, _ = self._rpc("SNAPSHOT_DELTA", meta, [])
+        return rmeta
+
+    def migrate_freeze(self) -> Dict[str, Any]:
+        """Freeze the source worker for the final migration round:
+        mutating requests block at the connection handlers, the
+        serving engine pauses, and the reply reports the remaining
+        ``dirty_buffers`` / ``dirty_bytes`` so the caller can verify
+        the predicted pause before paying it.  Undone by
+        ``migrate_commit()`` (state moves) or ``migrate_commit(
+        abort=True)`` (state stays)."""
+        self._ensure_version(protocol.MIGRATE_MIN_VERSION,
+                             "MIGRATE_FREEZE (streaming migration)")
+        _, rmeta, _ = self._rpc("MIGRATE_FREEZE", {}, [])
+        return rmeta
+
+    def migrate_commit(self, abort: bool = False) -> Dict[str, Any]:
+        """Terminate the streaming migration session on the source:
+        ship the final (frozen) delta, flip the staged buffers live on
+        the target, drop the migrated state locally and thaw —
+        returning the realized ``pause_ms`` / ``rounds`` / byte
+        totals.  ``abort=True`` instead discards the session: staged
+        state on the target is freed and the source thaws intact."""
+        self._ensure_version(protocol.MIGRATE_MIN_VERSION,
+                             "MIGRATE_COMMIT (streaming migration)")
+        meta: Dict[str, Any] = {"abort": True} if abort else {}
+        _, rmeta, _ = self._rpc("MIGRATE_COMMIT", meta, [])
+        return rmeta
+
     # ------------------------------------------------------------------
 
     def remote_jit(self, fn: Callable,

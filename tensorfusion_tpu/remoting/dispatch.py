@@ -388,6 +388,21 @@ class DeviceDispatcher:
                         f"dispatch barrier timed out for {tenant.conn_id}")
                 self._cv.wait(timeout=min(remaining, 0.5))
 
+    def quiesce(self, timeout: float = 30.0) -> None:
+        """Global barrier (MIGRATE_FREEZE, docs/migration.md): block
+        until EVERY tenant's queued and in-flight items are fully
+        complete.  Unlike :meth:`barrier` this spans all connections —
+        the freeze must not certify a dirty set while another tenant's
+        launch is still about to mutate the resident table."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while any(t.queue or t.inflight
+                      for t in self._tenants.values()):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError("dispatch quiesce timed out")
+                self._cv.wait(timeout=min(remaining, 0.5))
+
     def note_collective(self, conn_id: str, op: str,
                         nbytes: int) -> None:
         """Record one served federated collective (ALLREDUCE_SHIP /

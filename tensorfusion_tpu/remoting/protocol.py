@@ -184,6 +184,52 @@ falls back to single-worker execution with zero new-opcode frames):
   leaves the worker) + ``free_src``; the client concatenates slices
   across workers in mesh order.
 - ALLGATHER_SHIP_OK: ``n_src`` / ``shape`` / ``dtype`` + the slice.
+
+Version 8 carries the streaming live-migration opcodes
+(docs/migration.md) — iterative pre-copy of a worker's device-resident
+state to a target worker while the tenant keeps executing, then a
+bounded final pause.  HELLO-negotiated exactly like v3-v7, with the
+same double version gate: the client refuses to send the kinds on a
+< v8 connection AND the worker refuses to honor them from one, so
+v2-v7 peers never see them:
+
+- SNAPSHOT_DELTA: one pre-copy round.  The *source* worker tracks a
+  write generation per resident buffer (bumped by PUTs, EXECUTE
+  ``keep_results`` installs, collective installs and restores) and
+  ships only the buffers dirtied since the session's previous round —
+  worker-to-worker as quiet client-minted PUTs through its own
+  ``_UploadStream`` to ``target_url`` (q8-eligible, exactly the
+  KV_SHIP quiet-ephemeral-PUT machinery), never through the
+  controller.  ``target_url`` / optional ``target_token`` name the
+  session (one live session per source worker); ``final`` marks the
+  frozen last round.  The round rides the source's QoS dispatcher as
+  a LOW-weight work item so migration traffic cannot starve serving.
+- SNAPSHOT_DELTA_OK: round receipt — ``round`` / ``buffers`` /
+  ``raw_bytes`` / ``wire_bytes`` / ``elapsed_ms`` / ``dirty_left``
+  (buffers dirtied *while* this round shipped) / ``resident_total`` /
+  ``bandwidth_bps``, the inputs of the orchestrator's convergence
+  policy (LiveMigrator.migrate_streaming).
+- MIGRATE_FREEZE: freeze the source for the final round — mutating
+  kinds (EXECUTE / PUT / FREE / GENERATE / KV_SHIP / collectives)
+  block at the connection handler until commit or abort, the serving
+  engine pauses, and the reply reports the remaining ``dirty_buffers``
+  / ``dirty_bytes`` so the orchestrator can verify the predicted
+  pause before paying it.
+- MIGRATE_FREEZE_OK: ``frozen`` + the dirty remainder.
+- MIGRATE_COMMIT: dual-role terminator.  Orchestrator -> source
+  (no ``manifest``): ship the final delta (must be frozen unless
+  ``abort``), forward the commit manifest to the target over the
+  session connection, drop the migrated state locally, thaw, reply
+  with the realized pause.  Source -> target (``manifest``: real
+  buf_id -> staged id, plus executable blobs as frame buffers and
+  the source's ``buf_seq``): atomically publish the staged buffers
+  under their real ids and re-compile the executables — the
+  buffer-level binding flip.  ``abort: true`` (orchestrator ->
+  source) instead discards the session: staged buffers on the target
+  are freed, the source thaws with its state intact.
+- MIGRATE_COMMIT_OK: source role — ``pause_ms`` / ``rounds`` /
+  ``buffers`` / ``raw_bytes`` / ``wire_bytes``; target role —
+  ``installed`` / ``executables``.
 """
 
 from __future__ import annotations
@@ -197,9 +243,9 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 MAGIC = b"TPFR"
-VERSION = 7
-#: frame versions this build can decode (v3-v7 are additive over v2)
-SUPPORTED_VERSIONS = (2, 3, 4, 5, 6, 7)
+VERSION = 8
+#: frame versions this build can decode (v3-v8 are additive over v2)
+SUPPORTED_VERSIONS = (2, 3, 4, 5, 6, 7, 8)
 #: version every HELLO is framed at, so any peer can read it
 HELLO_VERSION = 2
 #: lowest wire version whose frames may carry ``enc="q8"`` buffers
@@ -213,6 +259,12 @@ KV_SHIP_MIN_VERSION = 6
 #: client refuses to send below it and the worker refuses to honor it
 #: below it, so v2-v6 single-worker peers never see the kinds
 FED_MIN_VERSION = 7
+#: lowest wire version that may carry the streaming-live-migration
+#: opcodes (SNAPSHOT_DELTA / MIGRATE_FREEZE / MIGRATE_COMMIT).
+#: Double-gated like KV_SHIP and the federated kinds: the client
+#: refuses to send below it and the worker refuses to honor it below
+#: it, so v2-v7 peers never see the kinds
+MIGRATE_MIN_VERSION = 8
 
 # -- opcode / reply / error-code registry ---------------------------------
 #
@@ -227,7 +279,8 @@ FED_MIN_VERSION = 7
 REQUEST_KINDS = ("HELLO", "INFO", "COMPILE", "COMPILE_MLIR", "PUT",
                  "FREE", "FETCH", "EXECUTE", "GENERATE", "KV_SHIP",
                  "ALLREDUCE_SHIP", "ALLGATHER_SHIP",
-                 "SNAPSHOT", "RESTORE")
+                 "SNAPSHOT", "RESTORE",
+                 "SNAPSHOT_DELTA", "MIGRATE_FREEZE", "MIGRATE_COMMIT")
 #: request kinds the python client never sends (COMPILE_MLIR is the
 #: transparent PJRT plugin's path — libtpf_pjrt_remote.cc is the client)
 CLIENT_OPTIONAL_KINDS = ("COMPILE_MLIR",)
@@ -235,7 +288,9 @@ CLIENT_OPTIONAL_KINDS = ("COMPILE_MLIR",)
 REPLY_KINDS = ("HELLO_OK", "INFO_OK", "COMPILE_OK", "PUT_OK", "FREE_OK",
                "FETCH_OK", "EXECUTE_OK", "GENERATE_OK", "KV_SHIP_OK",
                "ALLREDUCE_SHIP_OK", "ALLGATHER_SHIP_OK",
-               "SNAPSHOT_OK", "RESTORE_OK", "ERROR")
+               "SNAPSHOT_OK", "RESTORE_OK",
+               "SNAPSHOT_DELTA_OK", "MIGRATE_FREEZE_OK",
+               "MIGRATE_COMMIT_OK", "ERROR")
 #: structured ERROR ``code`` values (v4; older clients see plain ERROR)
 ERROR_CODES = ("BUSY", "DEADLINE_EXCEEDED", "needs_compile")
 #: per-buffer wire encodings, in the order they were introduced; the

@@ -81,6 +81,7 @@ from __future__ import annotations
 
 import hashlib
 import hmac
+import itertools
 import json
 import logging
 import os
@@ -107,6 +108,86 @@ log = logging.getLogger("tpf.remoting.worker")
 #: to finish before running — per-connection ordering across the shared
 #: dispatch queue
 _BARRIER_KINDS = ("FETCH", "FREE", "SNAPSHOT", "RESTORE")
+
+#: request kinds that mutate device-resident state (or generate) and
+#: therefore block at the connection handler while a MIGRATE_FREEZE
+#: holds the worker dark (protocol v8, docs/migration.md)
+_MUTATING_KINDS = ("EXECUTE", "GENERATE", "KV_SHIP", "ALLREDUCE_SHIP",
+                   "ALLGATHER_SHIP", "PUT", "FREE")
+
+#: ceiling on how long a frozen worker holds mutating requests: a dead
+#: orchestrator must not wedge tenant connections forever — past this
+#: the handler proceeds (the migration, if still live, falls back to
+#: stop-and-copy semantics at the controller)
+MIGRATE_FREEZE_MAX_S = 30.0
+
+
+class _MigrationSession:
+    """Source-side state of ONE streaming pre-copy (protocol v8,
+    docs/migration.md): a client connection to the target worker, the
+    real-id -> staged-id manifest accumulated across rounds, and the
+    high-water write generation fully shipped so far.  Deltas ride the
+    target connection as quiet client-minted PUTs through the
+    double-buffered ``_UploadStream`` (q8-eligible) — exactly the
+    KV_SHIP quiet-ephemeral-PUT machinery, minus the ephemeral flag
+    (staged buffers must survive until MIGRATE_COMMIT publishes
+    them)."""
+
+    def __init__(self, target_url: str, token: str = "",
+                 quantize: bool = False):
+        from .. import constants as _c
+        from .client import RemoteDevice
+
+        self.target_url = target_url
+        #: migration is background traffic on the target too: HELLO as
+        #: the lowest-weight QoS class.  ``quantize`` rides the q8
+        #: wire path for the deltas (~4x fewer bytes) but is LOSSY —
+        #: strictly opt-in per migration (SNAPSHOT_DELTA ``quant``),
+        #: because migrated state must round-trip exactly by default
+        #: (stop-and-copy SNAPSHOT/RESTORE is exact; streaming must
+        #: not silently be worse)
+        self.device = RemoteDevice(target_url, token=token,
+                                   qos=_c.QOS_LOW, quantize=quantize)
+        #: real buf_id -> staged c- id (latest round's copy)
+        self.staged: Dict[str, str] = {}
+        #: exe_id -> staged c- id carrying the serialized blob
+        self.staged_exes: Dict[str, str] = {}
+        #: staged ids obsoleted by re-dirty re-ships; freed at commit
+        self.drops: List[str] = []
+        self.round = 0
+        #: write generation fully shipped (dirty = gen > shipped_gen)
+        self.shipped_gen = 0
+        self.raw_bytes = 0
+        self.wire_bytes = 0
+        self.started_m = time.monotonic()
+        #: set by MIGRATE_FREEZE — the start of the tenant-dark window
+        self.freeze_m: Optional[float] = None
+        self._mint = itertools.count(1)
+
+    def mint(self, tag: str) -> str:
+        return f"c-mig{next(self._mint)}-{tag}"
+
+    def stage(self, staged_id: str, host,
+              stats: Optional[Dict[str, int]] = None) -> None:
+        """Queue one staged buffer on the upload stream (quiet PUT,
+        NOT ephemeral); the caller drains once per round."""
+        from .client import _UploadStream
+
+        dev = self.device
+        if dev._upload_stream is None:
+            dev._upload_stream = _UploadStream(dev, dev.upload_depth)
+        dev._upload_stream.submit({"buf_id": staged_id, "quiet": True},
+                                  host, stats=stats)
+
+    def drain(self) -> None:
+        if self.device._upload_stream is not None:
+            self.device._upload_stream.drain()
+
+    def close(self) -> None:
+        try:
+            self.device.close()
+        except Exception:  # noqa: BLE001 - teardown best effort
+            log.debug("migration session close failed", exc_info=True)
 
 
 class RemoteVTPUWorker:
@@ -222,6 +303,36 @@ class RemoteVTPUWorker:
         self._exe_stacked: Dict[Tuple[str, int], Callable] = {}
         # guarded by: _lock
         self._buffers: Dict[str, object] = {}    # device-resident arrays
+        #: streaming live migration (protocol v8, docs/migration.md):
+        #: write generation per resident buffer — bumped whenever a
+        #: buffer is installed/overwritten (PUT, keep_results,
+        #: collective installs, restore/commit) so SNAPSHOT_DELTA
+        #: rounds ship only what changed since the last round
+        # guarded by: _lock
+        self._buf_gen: Dict[str, int] = {}
+        # guarded by: _lock
+        self._write_gen = 0
+        #: the one live pre-copy session (None between migrations)
+        # guarded by: _lock
+        self._mig_session: Optional[_MigrationSession] = None
+        #: SET = thawed.  MIGRATE_FREEZE clears it; mutating kinds
+        #: block at the connection handler until commit/abort (bounded
+        #: by MIGRATE_FREEZE_MAX_S)
+        self._mig_thaw = threading.Event()
+        self._mig_thaw.set()
+        #: dispatch tenant SNAPSHOT_DELTA rounds ride the WFQ ladder
+        #: as — lowest weight, so pre-copy traffic never starves
+        #: serving (created on first round)
+        self._mig_tenant = None
+        #: lifetime migration counters (INFO "migration" +
+        #: tpf_migration metrics lines)
+        # guarded by: _lock
+        self._mig_stats: Dict[str, float] = {
+            "rounds_total": 0, "delta_buffers_total": 0,
+            "delta_raw_bytes_total": 0, "delta_wire_bytes_total": 0,
+            "streaming_total": 0, "aborted_total": 0,
+            "installed_total": 0, "pause_ms_last": 0.0,
+            "pause_ms_max": 0.0}
         #: buf_id -> device id the buffer was PUT to (single-device
         #: buffers; sharded results span devices and are not listed)
         # guarded by: _lock
@@ -455,6 +566,14 @@ class RemoteVTPUWorker:
                             break
                         kind, meta, buffers = item
                         seq = meta.get("seq")
+                        if kind in _MUTATING_KINDS and \
+                                not outer._mig_thaw.is_set():
+                            # MIGRATE_FREEZE: the tenant-dark window.
+                            # Mutating requests wait here (bounded)
+                            # until commit/abort thaws the worker —
+                            # reads (INFO/FETCH/COMPILE) keep flowing
+                            outer._mig_thaw.wait(
+                                timeout=MIGRATE_FREEZE_MAX_S)
 
                         def reply(rkind, rmeta, rbufs, compress=False,
                                   _seq=seq):
@@ -535,6 +654,22 @@ class RemoteVTPUWorker:
                                 outer._enqueue_collective(
                                     reply, kind, remap_ids(meta),
                                     buffers, tenant)
+                                continue
+                            if kind == "SNAPSHOT_DELTA":
+                                # streaming migration (protocol v8):
+                                # one pre-copy round, fair-queued as a
+                                # low-QoS work item so it cannot
+                                # starve serving
+                                outer._enqueue_snapshot_delta(
+                                    reply, remap_ids(meta))
+                                continue
+                            if kind == "MIGRATE_FREEZE":
+                                outer._handle_migrate_freeze(
+                                    reply, remap_ids(meta))
+                                continue
+                            if kind == "MIGRATE_COMMIT":
+                                outer._handle_migrate_commit(
+                                    reply, remap_ids(meta), buffers)
                                 continue
                             if kind in _BARRIER_KINDS:
                                 # these observe execution effects: wait
@@ -624,6 +759,13 @@ class RemoteVTPUWorker:
                  self.dispatcher.mode)
 
     def stop(self) -> None:
+        # thaw first: connection handlers parked behind a freeze must
+        # observe the shutdown instead of blocking their full timeout
+        self._mig_thaw.set()
+        with self._lock:
+            sess, self._mig_session = self._mig_session, None
+        if sess is not None:
+            sess.close()
         self._server.shutdown()
         self._server.server_close()
         self.dispatcher.stop()
@@ -667,6 +809,17 @@ class RemoteVTPUWorker:
         self.resident_bytes = max(0, self.resident_bytes - nbytes)
         if self.meter_client is not None:
             self.meter_client.charge_hbm(-nbytes)
+
+    def _touch_buf(self, buf_id: str) -> None:   # tpflint: holds=_lock
+        """Bump ``buf_id``'s write generation (streaming-migration
+        dirty tracking, docs/migration.md): every install/overwrite of
+        a resident buffer lands here so SNAPSHOT_DELTA rounds ship
+        exactly what changed since the previous round."""
+        self._write_gen += 1
+        self._buf_gen[buf_id] = self._write_gen
+
+    def _drop_buf_gen(self, buf_id: str) -> None:  # tpflint: holds=_lock
+        self._buf_gen.pop(buf_id, None)
 
     # -- multi-device helpers -------------------------------------------
 
@@ -728,6 +881,7 @@ class RemoteVTPUWorker:
                 if self._buffers.pop(buf_id, None) is not None:
                     self._ephemeral.discard(buf_id)
                     self._buf_device.pop(buf_id, None)
+                    self._drop_buf_gen(buf_id)
                     self._release_resident(arr)
         return arr
 
@@ -906,6 +1060,7 @@ class RemoteVTPUWorker:
                 if err:
                     raise RuntimeError(f"restore rejected: {err}")
                 self._buffers[buf_id] = jax.device_put(arr)
+                self._touch_buf(buf_id)
             for exe_id, info in manifest["executables"].items():
                 with open(os.path.join(state_dir, f"{exe_id}.stablehlo"),
                           "rb") as f:
@@ -1403,6 +1558,7 @@ class RemoteVTPUWorker:
                     if self._buffers.pop(sid, None) is not None:
                         self._buf_device.pop(sid, None)
                         self._ephemeral.discard(sid)
+                        self._drop_buf_gen(sid)
                         self._release_resident(arr)
         return parts
 
@@ -1467,6 +1623,7 @@ class RemoteVTPUWorker:
         with self._lock:
             self._buffers[rid] = arr
             self._buf_device[rid] = 0
+            self._touch_buf(rid)
         return rid
 
     def _attr_collective(self, item: WorkItem, op: str, nbytes: int,
@@ -1553,11 +1710,443 @@ class RemoteVTPUWorker:
         self._attr_collective(item, "allgather", nbytes,
                               time.monotonic() - m1)
 
+    # -- streaming live migration (protocol v8, docs/migration.md) ------
+
+    def _mig_gate(self, reply, meta, kind: str) -> bool:
+        """Double version gate, worker half: the client already refuses
+        to send the migration kinds below v8; a smuggled frame from a
+        hand-rolled peer dies here."""
+        if meta.get("_wire_version", 2) < protocol.MIGRATE_MIN_VERSION:
+            reply("ERROR",
+                  {"error": f"{kind} needs protocol >= "
+                            f"{protocol.MIGRATE_MIN_VERSION} "
+                            f"(negotiate v8 at HELLO)"}, [])
+            return False
+        return True
+
+    def _enqueue_snapshot_delta(self, reply, meta) -> None:
+        """Connection handler side of SNAPSHOT_DELTA: validate, then
+        fair-queue the round as a work item of the dedicated
+        lowest-weight ``migration`` tenant — pre-copy traffic shares
+        the device/wire through the same WFQ ladder serving rides, so
+        a migration can never starve tenants (it yields exactly its
+        low-QoS share)."""
+        if not self._mig_gate(reply, meta, "SNAPSHOT_DELTA"):
+            return
+        if not meta.get("target_url"):
+            reply("ERROR",
+                  {"error": "SNAPSHOT_DELTA without target_url"}, [])
+            return
+        if self._mig_tenant is None:
+            self._mig_tenant = self.dispatcher.register_tenant(
+                "migration", qos=constants.QOS_LOW)
+        item = WorkItem("SNAPSHOT_DELTA", meta, [], reply, 1.0,
+                        "<snapshot_delta>", None, None,
+                        trace=self._parse_trace(meta))
+        self.dispatcher.submit(self._mig_tenant, item, block=True)
+
+    def _launch_migration(self, item: WorkItem):
+        """Dispatcher arm for one SNAPSHOT_DELTA item: like the
+        collectives, the launch phase is empty and the heavy half
+        (materialize dirty buffers, quantize, ship) runs as the
+        deferred flush so the dispatcher launches the next queued
+        EXECUTE first — delta transfer overlaps serving compute."""
+        def flush(_item=item):
+            try:
+                self._flush_snapshot_delta(_item)
+            except (ConnectionError, OSError) as e:
+                # target died mid-round: the session survives — the
+                # orchestrator decides (retry, abort, stop-and-copy)
+                self._safe_reply(_item, "ERROR",
+                                 {"error": f"delta ship failed: {e}"},
+                                 [])
+            except Exception as e:  # noqa: BLE001 - reply, keep serving
+                log.exception("SNAPSHOT_DELTA failed")
+                self._safe_reply(_item, "ERROR", {"error": str(e)}, [])
+
+        return flush
+
+    def _mig_ensure_session(self, meta) -> _MigrationSession:
+        """The (single) live pre-copy session for this source worker;
+        re-targeting closes the old session first."""
+        target = str(meta["target_url"])
+        with self._lock:
+            sess = self._mig_session
+            old = None
+            if sess is not None and sess.target_url != target:
+                old, self._mig_session, sess = sess, None, None
+        if old is not None:
+            old.close()
+        if sess is None:
+            token = meta.get("target_token")
+            sess = _MigrationSession(
+                target,
+                token=str(token) if token is not None else self.token,
+                quantize=bool(meta.get("quant")))
+            with self._lock:
+                self._mig_session = sess
+        return sess
+
+    def _mig_ship_round(self, sess: _MigrationSession,
+                        final: bool) -> Dict[str, float]:
+        """One pre-copy round: ship every buffer dirtied since the
+        session's shipped generation (plus any not-yet-shipped
+        executable blobs) to the target as staged quiet PUTs through
+        the session's upload stream, then advance the high-water
+        generation.  Returns the round receipt."""
+        t0 = time.monotonic()
+        with self._lock:
+            gen_now = self._write_gen
+            dirty_ids = sorted(
+                bid for bid, g in self._buf_gen.items()
+                if g > sess.shipped_gen and bid in self._buffers)
+            dirty = [(bid, self._buffers[bid]) for bid in dirty_ids]
+            blobs = {eid: blob for eid, blob in self._exe_blobs.items()
+                     if eid not in sess.staged_exes}
+            resident_total = len(self._buffers)
+        st: Dict[str, int] = {}
+        raw = 0
+        for bid, arr in dirty:
+            host = np.asarray(self._resolve(arr))
+            sid = sess.mint("b")
+            old = sess.staged.pop(bid, None)
+            if old is not None:
+                # re-dirtied since an earlier round: the stale staged
+                # copy is superseded; freed on the target at commit
+                sess.drops.append(old)
+            sess.staged[bid] = sid
+            sess.stage(sid, host, stats=st)
+            raw += int(host.nbytes)
+        new_exes: Dict[str, str] = {}
+        for eid in sorted(blobs):
+            sid = sess.mint("x")
+            sess.staged_exes[eid] = sid
+            new_exes[eid] = sid
+            sess.stage(sid, np.frombuffer(blobs[eid], dtype=np.uint8),
+                       stats=st)
+            raw += len(blobs[eid])
+        sess.drain()
+        if new_exes:
+            # prepare-install executables NOW, during the live round:
+            # XLA compilation is the expensive half of a restore and
+            # must never land inside the frozen commit window (blobs
+            # are immutable, so early compilation is always safe)
+            sess.device._rpc(
+                "MIGRATE_COMMIT",
+                {"manifest": {}, "exes": new_exes, "drops": [],
+                 "buf_seq": 0, "prepare": True}, [])
+        sess.shipped_gen = gen_now
+        sess.round += 1
+        elapsed = max(time.monotonic() - t0, 1e-9)
+        wire = int(st.get("wire_bytes", 0))
+        sess.raw_bytes += raw
+        sess.wire_bytes += wire
+        with self._lock:
+            dirty_left = sum(1 for bid, g in self._buf_gen.items()
+                             if g > gen_now and bid in self._buffers)
+            ms = self._mig_stats
+            ms["rounds_total"] += 1
+            ms["delta_buffers_total"] += len(dirty)
+            ms["delta_raw_bytes_total"] += raw
+            ms["delta_wire_bytes_total"] += wire
+        if self.profiler is not None:
+            # tpfprof: delta shipping is transfer time of the
+            # "migration" pseudo-tenant — visible next to serving
+            # tenants in the same per-device profile
+            self.profiler.attribute("migration", "transfer", elapsed,
+                                    qos=constants.QOS_LOW)
+        return {"round": sess.round, "buffers": len(dirty),
+                "executables": len(blobs), "raw_bytes": raw,
+                "wire_bytes": wire,
+                "elapsed_ms": round(elapsed * 1e3, 3),
+                "dirty_left": dirty_left,
+                "resident_total": resident_total,
+                "bandwidth_bps": int(wire / elapsed),
+                "final": bool(final)}
+
+    def _flush_snapshot_delta(self, item: WorkItem) -> None:
+        meta = item.meta
+        final = bool(meta.get("final"))
+        sess = self._mig_ensure_session(meta)
+        s0 = self.tracer.clock.now() if item.trace else 0.0
+        rmeta = self._mig_ship_round(sess, final)
+        if item.trace:
+            d = self.tracer.record_span(
+                "migrate.delta", s0, self.tracer.clock.now(),
+                parent=item.trace,
+                attrs={"round": rmeta["round"],
+                       "buffers": rmeta["buffers"],
+                       "raw_bytes": rmeta["raw_bytes"],
+                       "wire_bytes": rmeta["wire_bytes"],
+                       "final": final})
+            if d is not None:
+                item.trace_spans.append(d)
+        self._safe_reply(item, "SNAPSHOT_DELTA_OK",
+                         self._traced_meta(item, rmeta), [])
+        # delta ship time must not be charged to the next launch's
+        # inter-completion gap (same anchor discipline as collectives)
+        self._last_completion_m = time.monotonic()
+
+    def _handle_migrate_freeze(self, reply, meta) -> None:
+        """Freeze the worker for the final round: stop new mutations at
+        the connection handlers, drain the dispatcher globally, pause
+        the serving engine, and report the remaining dirty set so the
+        orchestrator can verify the predicted pause before paying it."""
+        if not self._mig_gate(reply, meta, "MIGRATE_FREEZE"):
+            return
+        self._mig_thaw.clear()
+        try:
+            self.dispatcher.quiesce(timeout=MIGRATE_FREEZE_MAX_S)
+        except TimeoutError as e:
+            self._mig_thaw.set()
+            reply("ERROR", {"error": str(e)}, [])
+            return
+        if self.engine is not None:
+            self.engine.freeze()
+        with self._lock:
+            sess = self._mig_session
+            if sess is not None and sess.freeze_m is None:
+                sess.freeze_m = time.monotonic()
+            shipped = sess.shipped_gen if sess is not None else 0
+            dirty = [self._buffers[bid]
+                     for bid, g in self._buf_gen.items()
+                     if g > shipped and bid in self._buffers]
+        dirty_bytes = sum(self._leaf_nbytes(self._resolve(a))
+                          for a in dirty)
+        reply("MIGRATE_FREEZE_OK",
+              {"frozen": True, "dirty_buffers": len(dirty),
+               "dirty_bytes": dirty_bytes}, [])
+
+    def _mig_thaw_now(self) -> None:
+        if self.engine is not None:
+            self.engine.thaw()
+        self._mig_thaw.set()
+
+    def _handle_migrate_commit(self, reply, meta, buffers) -> None:
+        """Dual-role MIGRATE_COMMIT (see protocol.py): with a
+        ``manifest`` this worker is the TARGET publishing staged state
+        live; without one it is the SOURCE terminating its session —
+        ``abort`` discards, otherwise ship the final frozen delta,
+        flip the binding on the target, drop local state and thaw."""
+        if not self._mig_gate(reply, meta, "MIGRATE_COMMIT"):
+            return
+        if meta.get("manifest") is not None:
+            self._migrate_install(reply, meta)
+            return
+        with self._lock:
+            sess, self._mig_session = self._mig_session, None
+        if meta.get("abort"):
+            if sess is not None:
+                staged = list(sess.staged.values()) + \
+                    list(sess.staged_exes.values()) + list(sess.drops)
+                try:
+                    if staged:
+                        sess.device._submit(
+                            "FREE", {"buf_ids": staged, "quiet": True},
+                            [], want_reply=False)
+                except (ConnectionError, OSError):
+                    pass    # target gone: nothing left to clean there
+                sess.close()
+            with self._lock:
+                self._mig_stats["aborted_total"] += 1
+            self._mig_thaw_now()
+            reply("MIGRATE_COMMIT_OK", {"aborted": True}, [])
+            return
+        if sess is None:
+            reply("ERROR",
+                  {"error": "MIGRATE_COMMIT without a live migration "
+                            "session (send SNAPSHOT_DELTA first)"}, [])
+            return
+        if self._mig_thaw.is_set():
+            with self._lock:
+                self._mig_session = sess    # still live: not consumed
+            reply("ERROR",
+                  {"error": "MIGRATE_COMMIT on a thawed worker "
+                            "(send MIGRATE_FREEZE first)"}, [])
+            return
+        try:
+            # belt-and-braces: a mutation that raced past the freeze
+            # check is drained here, then the frozen final round ships
+            # everything it dirtied
+            self.dispatcher.quiesce(timeout=MIGRATE_FREEZE_MAX_S)
+            final = self._mig_ship_round(sess, final=True)
+            with self._lock:
+                manifest = {rid: sid for rid, sid in sess.staged.items()
+                            if rid in self._buffers}
+                drops = sess.drops + [
+                    sid for rid, sid in sess.staged.items()
+                    if rid not in manifest]
+                buf_seq = self._buf_seq
+            # executables were prepare-installed during the rounds
+            # (including this final one), so the frozen commit only
+            # flips buffers live — no compilation inside the pause
+            rmeta = sess.device._rpc(
+                "MIGRATE_COMMIT",
+                {"manifest": manifest, "exes": {},
+                 "drops": drops, "buf_seq": buf_seq}, [])[1]
+        except (ConnectionError, OSError, RuntimeError) as e:
+            # target died at the flip: the source keeps its state and
+            # thaws — the tenant was dark only for the attempt
+            with self._lock:
+                self._mig_session = sess
+            self._mig_thaw_now()
+            reply("ERROR", {"error": f"migrate commit failed: {e}"}, [])
+            return
+        # binding flipped: the migrated state now lives on the target;
+        # drop it here (the pod is about to rebind away from this
+        # worker — a reconnecting client must not see stale buffers)
+        with self._lock:
+            dropped, self._buffers = self._buffers, {}
+            self._buf_gen.clear()
+            self._buf_device.clear()
+            self._ephemeral.clear()
+        for arr in dropped.values():
+            try:
+                arr = self._resolve(arr)
+            # a failed async PUT holds no resident bytes to release;
+            # its error already surfaced (or will) at its consumer
+            # tpflint: disable=swallowed-error
+            except Exception:  # noqa: BLE001 - failed async PUT
+                continue
+            with self._lock:
+                self._release_resident(arr)
+        pause_ms = 0.0
+        if sess.freeze_m is not None:
+            pause_ms = round((time.monotonic() - sess.freeze_m) * 1e3,
+                             3)
+        with self._lock:
+            ms = self._mig_stats
+            ms["streaming_total"] += 1
+            ms["pause_ms_last"] = pause_ms
+            ms["pause_ms_max"] = max(ms["pause_ms_max"], pause_ms)
+        out = {"pause_ms": pause_ms, "rounds": sess.round,
+               "buffers": int(rmeta.get("installed", 0)),
+               "executables": len(sess.staged_exes),
+               "raw_bytes": sess.raw_bytes,
+               "wire_bytes": sess.wire_bytes,
+               "final_round": final}
+        sess.close()
+        self._mig_thaw_now()
+        reply("MIGRATE_COMMIT_OK", out, [])
+
+    def _migrate_install(self, reply, meta) -> None:
+        """Target side of MIGRATE_COMMIT: atomically publish the staged
+        buffers under their real ids (rename — the bytes were admitted
+        at PUT time), re-compile the shipped executable blobs, and
+        advance buf_seq past the source's so future worker-minted ids
+        cannot collide with migrated ones."""
+        import jax
+        import jax.export    # explicit: jax lazy-loads the submodule
+
+        conn_ns = meta.get("_conn_ns", "")
+
+        def skey(sid: str) -> str:
+            sid = str(sid)
+            return conn_ns + sid if sid.startswith("c-") else sid
+
+        manifest = meta.get("manifest") or {}
+        exes = meta.get("exes") or {}
+        drops = meta.get("drops") or []
+        installed = 0
+        missing = []
+        for rid, sid in sorted(manifest.items()):
+            with self._lock:
+                arr = self._buffers.pop(skey(sid), None)
+                dev = self._buf_device.pop(skey(sid), 0)
+            if arr is None:
+                missing.append(rid)
+                continue
+            arr = self._resolve(arr)    # surface upload failures NOW
+            with self._lock:
+                old = self._buffers.get(rid)
+                if old is not None:
+                    # same contract as RESTORE onto a non-empty worker:
+                    # the migrated id wins; the old buffer is released
+                    self._release_resident(self._resolve(old))
+                self._buffers[rid] = arr
+                self._buf_device[rid] = dev
+                self._touch_buf(rid)
+            installed += 1
+        compiled = 0
+        for eid, sid in sorted(exes.items()):
+            with self._lock:
+                arr = self._buffers.pop(skey(sid), None)
+                self._buf_device.pop(skey(sid), None)
+                known = eid in self._exe_cache or \
+                    eid in self._mlir_exes or eid in self._exe_sharded
+            if arr is None:
+                missing.append(eid)
+                continue
+            blob = bytes(np.asarray(self._resolve(arr)))
+            with self._lock:
+                self._release_resident(blob)
+            if known:
+                continue        # shared content hash: already compiled
+            if eid.startswith("m-"):    # raw-StableHLO (PJRT path)
+                exe, sig, mflops = self._compile_mlir(blob)
+                with self._lock:
+                    self._mlir_exes[eid] = exe
+                    self._exe_sigs[eid] = sig
+                    self._exe_blobs[eid] = blob
+                    self._exe_costs[eid] = mflops
+            else:
+                exported = jax.export.deserialize(bytearray(blob))
+                if exported.nr_devices > 1:
+                    entry = self._build_sharded(exported)
+                    with self._lock:
+                        self._exe_sharded.setdefault(eid, entry)
+                        self._exe_blobs[eid] = blob
+                        self._exe_costs.setdefault(eid, 1)
+                else:
+                    with self._lock:
+                        self._exe_cache[eid] = jax.jit(exported.call)
+                        self._exe_blobs[eid] = blob
+                        self._exe_costs.setdefault(eid, 1)
+            compiled += 1
+        for sid in drops:
+            with self._lock:
+                arr = self._buffers.pop(skey(sid), None)
+                self._buf_device.pop(skey(sid), None)
+            if arr is not None:
+                arr = self._resolve(arr)
+                with self._lock:
+                    self._release_resident(arr)
+        with self._lock:
+            self._buf_seq = max(self._buf_seq,
+                                int(meta.get("buf_seq", 0) or 0))
+            self._mig_stats["installed_total"] += installed
+        if missing:
+            reply("ERROR",
+                  {"error": f"migrate install missing staged state "
+                            f"for {missing[:5]} "
+                            f"({len(missing)} total)"}, [])
+            return
+        reply("MIGRATE_COMMIT_OK", {"installed": installed,
+                                    "executables": compiled}, [])
+
+    def migration_stats(self) -> Dict[str, object]:
+        """Migration view for INFO and the tpf_migration metrics lines
+        (docs/metrics-schema.md)."""
+        with self._lock:
+            out: Dict[str, object] = dict(self._mig_stats)
+            sess = self._mig_session
+            out["frozen"] = not self._mig_thaw.is_set()
+            out["session"] = {
+                "target_url": sess.target_url, "round": sess.round,
+                "staged_buffers": len(sess.staged),
+                "staged_executables": len(sess.staged_exes),
+                "raw_bytes": sess.raw_bytes,
+                "wire_bytes": sess.wire_bytes,
+            } if sess is not None else None
+        return out
+
     def _execute_batch(self, items: List[WorkItem], peek_next):
         """Dispatcher callback: launch one work batch onto the devices.
         Returns a deferred flush (blocking result materialization +
         reply) when there is one, so the dispatcher can overlap it with
         the next launch."""
+        if len(items) == 1 and items[0].kind == "SNAPSHOT_DELTA":
+            return self._launch_migration(items[0])
         if len(items) == 1 and items[0].kind != "EXECUTE":
             return self._launch_collective(items[0])
         if len(items) == 1:
@@ -1866,6 +2455,7 @@ class RemoteVTPUWorker:
                         self._buf_seq += 1
                         buf_id = f"buf-{self._buf_seq}"
                     self._buffers[buf_id] = leaf
+                    self._touch_buf(buf_id)
                     devs = getattr(leaf, "devices", None)
                     devs = devs() if callable(devs) else devs
                     if devs is not None and len(devs) == 1:
@@ -1963,6 +2553,7 @@ class RemoteVTPUWorker:
                 if self.profiler is not None else None,
                 "serving": self.engine.snapshot()
                 if self.engine is not None else None,
+                "migration": self.migration_stats(),
                 "wire_compression": wire,
                 # full inventory for placement: id + mesh coords (TPUs
                 # expose .coords; CPU/GPU devices report their index)
@@ -2137,6 +2728,7 @@ class RemoteVTPUWorker:
             with self._lock:
                 self._buffers[buf_id] = arr
                 self._buf_device[buf_id] = device_id
+                self._touch_buf(buf_id)
                 if v3 and meta.get("ephemeral"):
                     self._ephemeral.add(buf_id)
             if v3 and meta.get("quiet"):
@@ -2160,6 +2752,7 @@ class RemoteVTPUWorker:
                     arr = self._buffers.pop(buf_id, None)
                     self._buf_device.pop(buf_id, None)
                     self._ephemeral.discard(buf_id)
+                    self._drop_buf_gen(buf_id)
                 if arr is not None:
                     arr = self._resolve(arr)    # async PUT still in flight
                     with self._lock:

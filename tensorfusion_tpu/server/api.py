@@ -30,6 +30,7 @@ from ..api.meta import from_dict
 from ..api.types import Pod, TPUConnection
 from ..gateway import RawJson, StoreGateway
 from ..scheduler.tpuresources import compose_alloc_request
+from ..shardedstore import ShardedStore
 from ..store import ObjectStore
 from ..webhook.parser import ParseError
 
@@ -67,10 +68,14 @@ class OperatorServer:
         # Hypervisor-pushed metrics land straight in the operator's TSDB
         # (single-process topology; the HA topology drains them from the
         # state store's ring instead — operator._drain_remote_metrics)
+        # a sharded cell is fronted too (ROADMAP 1a): CRUD/list route
+        # through the ShardedStore router, and the watch window fans
+        # out per shard (gateway `shard=` + RemoteStore multi-window)
         self.gateway = StoreGateway(
             operator.store, token=store_token, tokens=store_tokens,
             metrics_sink=operator.ingest_metrics_lines) \
-            if isinstance(operator.store, ObjectStore) else None
+            if isinstance(operator.store, (ObjectStore, ShardedStore)) \
+            else None
         outer = self
 
         from ..utils.tlsutil import KeepAliveHandlerMixin, TlsHandshakeMixin

@@ -260,6 +260,18 @@ class ServingEngine:
         self._thread: Optional[threading.Thread] = None
         # guarded by: _cv
         self._stopping = False
+        #: MIGRATE_FREEZE hook (docs/migration.md): while frozen the
+        #: stepper idles (step() is a no-op) so the pool's dirty set
+        #: stays stable for the final migration round; submissions
+        #: still queue and are served after thaw()
+        # guarded by: _cv
+        self._frozen = False
+        #: sequences adopted from / handed to another engine by a
+        #: streaming migration (snapshot counters)
+        # guarded by: _cv
+        self.migrated_in = 0
+        # guarded by: _cv
+        self.migrated_out = 0
         self._start_m = self.clock.monotonic()
         # -- counters (guarded by: _cv — snapshot() reads them from
         # other threads; the stepper writes them once per step) --------
@@ -532,6 +544,9 @@ class ServingEngine:
         """One scheduling round: shed expired, admit, prefill chunks,
         one fused decode step, retire.  Returns False when there was
         nothing to do.  Single-stepper only."""
+        with self._cv:
+            if self._frozen:
+                return False    # MIGRATE_FREEZE: the tenant is dark
         now = self.clock.monotonic()
         events: List[tuple] = []       # (seq, new_tokens, done, info)
         shed, admitted_seqs = self._admit_locked_phase(now, events)
@@ -638,6 +653,76 @@ class ServingEngine:
             if seq.emit is not None:
                 seq.emit(seq, toks, done, info)
         return did
+
+    # -- streaming migration (docs/migration.md) --------------------------
+
+    def freeze(self) -> None:
+        """Pause the stepper (MIGRATE_FREEZE): step() becomes a no-op,
+        so no decode write can dirty the pool while the final
+        migration round ships.  Submissions still queue — the pause is
+        bounded by the final delta, not by arrivals."""
+        with self._cv:
+            self._frozen = True
+            self._cv.notify_all()
+
+    def thaw(self) -> None:
+        with self._cv:
+            self._frozen = False
+            self._cv.notify_all()
+
+    @property
+    def frozen(self) -> bool:
+        with self._cv:
+            return self._frozen
+
+    def export_sequences(self) -> List[Sequence]:
+        """Drain every live sequence for migration to another engine:
+        running sequences give their blocks back to the pool (their
+        generated prefix stays on the Sequence — re-prefill covers
+        prompt + generated, the preemption re-admission discipline),
+        then the untouched waiting queue follows.  The engine is left
+        empty; callers :meth:`freeze` first so no step races the
+        export.  Runs on the stepper's thread (or any thread while
+        frozen)."""
+        moved: List[Sequence] = []
+        for seq in list(self._running):
+            self.account.release(seq.sid)
+            seq.state = WAITING
+            seq.prefill_pos = 0
+            # shipped payloads / disagg routing are source-engine
+            # state; the target re-prefills inline
+            seq.shipped = None
+            seq.disagg = False
+            self._running.remove(seq)
+            moved.append(seq)
+        with self._cv:
+            waiting, self._waiting = self._waiting, []
+            out = moved + waiting
+            self.migrated_out += len(out)
+            self._cv.notify_all()
+        return out
+
+    def import_sequences(self, seqs: List[Sequence]) -> int:
+        """Adopt migrated sequences: each re-enters this engine's
+        waiting queue under a FRESH sid (block-table owner keys are
+        per-engine) and re-prefills its full prefix on admission —
+        greedy decode is position-deterministic, so the regenerated
+        suffix is token-identical to an unmigrated run.  The queue cap
+        deliberately does not apply: a migration must never drop a
+        live request (``max_waiting`` bounds *new* admissions only)."""
+        n = 0
+        with self._cv:
+            for seq in seqs:
+                seq.sid = next(self._sids)
+                seq.state = WAITING
+                seq.prefill_pos = 0
+                seq.prefix_matched = 0
+                self._waiting.append(seq)
+                self.submitted += 1
+                self.migrated_in += 1
+                n += 1
+            self._cv.notify_all()
+        return n
 
     def drain(self, timeout_s: float = 60.0) -> bool:
         """Block until nothing is waiting or running (callers that
@@ -1157,6 +1242,9 @@ class ServingEngine:
                 "shed": self.shed,
                 "busy_rejected": self.busy_rejected,
                 "preempted": self.preempted,
+                "frozen": int(self._frozen),
+                "migrated_in": self.migrated_in,
+                "migrated_out": self.migrated_out,
                 "tokens": self.tokens_generated,
                 "tokens_per_s": round(self.tokens_generated / elapsed,
                                       3),

@@ -166,6 +166,15 @@ class BlockAccount:
         #: cache blocks evicted under allocation pressure —
         #: kv_prefix_cache_evictions_total
         self.prefix_cache_evictions = 0
+        #: streaming-migration dirty tracking (docs/migration.md): a
+        #: write generation per live physical block, bumped whenever
+        #: page content can change (allocation, CoW target, in-place
+        #: write, shipped-KV ingest) — ``dirty_since`` answers "which
+        #: pages changed since pre-copy round N" for the migration
+        #: convergence predictor, exactly the worker's per-buffer
+        #: ``_buf_gen`` discipline applied to the paged pool
+        self.write_gen = 0
+        self._block_gen: Dict[int, int] = {}
 
     # -- capacity ---------------------------------------------------------
 
@@ -216,6 +225,7 @@ class BlockAccount:
                 continue        # a live sequence still shares it
             self._cache_held.discard(blk)
             del self._refs[blk]
+            self._block_gen.pop(blk, None)
             key = self._key_of.pop(blk, None)
             if key is not None:
                 self._by_key.pop(key, None)
@@ -227,6 +237,18 @@ class BlockAccount:
 
     def nbytes(self, per_block_bytes: int) -> int:
         return self.num_blocks * per_block_bytes
+
+    # -- dirty tracking (streaming migration, docs/migration.md) ----------
+
+    def _touch(self, blk: int) -> None:
+        self.write_gen += 1
+        self._block_gen[blk] = self.write_gen
+
+    def dirty_since(self, gen: int) -> List[int]:
+        """Live physical blocks whose pages changed after generation
+        ``gen`` — one pre-copy round ships exactly these.  Pair with
+        the current :attr:`write_gen` as the next round's floor."""
+        return sorted(b for b, g in self._block_gen.items() if g > gen)
 
     # -- allocation -------------------------------------------------------
 
@@ -245,6 +267,7 @@ class BlockAccount:
             blk = self._free.pop()
             self._refs[blk] = 1
             table.append(blk)
+            self._touch(blk)
         self.total_allocated += need
         self.peak_used = max(self.peak_used, self.used_blocks)
         return True
@@ -263,6 +286,7 @@ class BlockAccount:
             self._refs[blk] = refs - 1
             return
         del self._refs[blk]
+        self._block_gen.pop(blk, None)
         key = self._key_of.pop(blk, None)
         if key is not None:
             self._by_key.pop(key, None)
@@ -370,6 +394,7 @@ class BlockAccount:
         blk = self._free.pop()
         self._refs[blk] = 1
         self._owned.setdefault(owner, []).append(blk)
+        self._touch(blk)
         self.total_allocated += 1
         self.peak_used = max(self.peak_used, self.used_blocks)
         return blk
@@ -404,6 +429,7 @@ class BlockAccount:
             refs = self._refs.get(blk, 0)
             if refs <= 1:
                 self._refs.pop(blk, None)
+                self._block_gen.pop(blk, None)
                 key = self._key_of.pop(blk, None)
                 if key is not None:
                     self._by_key.pop(key, None)
@@ -436,6 +462,7 @@ class BlockAccount:
             self._refs[new] = 1
             self._refs[blk] -= 1
             table[index] = new
+            self._touch(new)
             self.cow_copies += 1
             self.total_allocated += 1
             self.peak_used = max(self.peak_used, self.used_blocks)
@@ -445,6 +472,7 @@ class BlockAccount:
             # sole holder writing into registered content: the entry
             # no longer describes the block, so it leaves the registry
             self._by_key.pop(key, None)
+        self._touch(blk)
         return blk, None
 
     @property
@@ -484,6 +512,7 @@ class BlockAccount:
                 "cache_held_blocks": len(self._cache_held),
                 "prefix_cache_evictions_total":
                     self.prefix_cache_evictions,
+                "write_gen": self.write_gen,
                 "utilization_pct": self.utilization_pct()}
 
 

@@ -74,6 +74,28 @@ SERVING_SCALES = {
 }
 
 
+#: rolling-pool-upgrade shapes (docs/migration.md): a pool of serving
+#: workers is streaming-migrated one at a time under sustained
+#: mixed-QoS traffic.  ``block_bytes``/``bandwidth`` set the sim-time
+#: cost of shipping one dirty KV page (the pre-copy rounds' clock);
+#: ``ttft_p99_bound_ms`` is the scenario's bounded-latency criterion
+#: in sim milliseconds.
+MIGRATION_SCALES = {
+    "small": dict(workers=3, tenants=24, reqs=2, prompt=8, tokens=40,
+                  batch=8, blocks=161, chunk=16, waiting=64,
+                  window_s=1.2, block_bytes=4096,
+                  bandwidth=4 << 20, ttft_p99_bound_ms=600.0),
+    "medium": dict(workers=4, tenants=120, reqs=2, prompt=12,
+                   tokens=48, batch=16, blocks=321, chunk=24,
+                   waiting=256, window_s=4.0, block_bytes=4096,
+                   bandwidth=4 << 20, ttft_p99_bound_ms=800.0),
+    "large": dict(workers=8, tenants=600, reqs=3, prompt=16,
+                  tokens=64, batch=32, blocks=641, chunk=32,
+                  waiting=1024, window_s=12.0, block_bytes=4096,
+                  bandwidth=8 << 20, ttft_p99_bound_ms=1200.0),
+}
+
+
 #: shard-owner-failover shapes: a sharded cell (docs/control-plane-
 #: scale.md) — per-shard node counts, per-shard workload churn, and the
 #: ownership-lease timing the failover window is judged against
@@ -778,3 +800,298 @@ def shard_owner_failover(seed: int = 0, scale: str = "small") -> dict:
             return result
     finally:
         shutil.rmtree(persist_root, ignore_errors=True)
+
+
+@scenario("rolling-pool-upgrade")
+def rolling_pool_upgrade(seed: int = 0, scale: str = "small") -> dict:
+    """Streaming-migrate EVERY worker of a serving pool, one at a
+    time, under sustained mixed-QoS traffic (docs/migration.md) — the
+    twin proof of the ROADMAP-2 acceptance: zero failed requests,
+    bounded p99 TTFT, double-run digest-determinism.
+
+    Each slot runs a REAL continuous-batching engine (FakeRunner,
+    SimClock); its upgrade is driven by the REAL controller logic:
+    :class:`~...controllers.defrag.StreamingConvergence` decides from
+    the paged pool's dirty-page hooks (``BlockAccount.dirty_since``)
+    when the predicted final round fits the slot's pause budget — the
+    strictest budget among its live tenants' QoS classes
+    (``migration_pause_budget_ms``).  Pre-copy rounds advance the sim
+    clock by shipped-bytes/bandwidth while the engine KEEPS DECODING
+    (that is the point); only the frozen final round is tenant-dark.
+    The drained sequences move with their generated prefix and finish
+    on the upgraded engine suffix-identically (the preemption
+    re-admission proof, applied across engines).
+
+    Invariants: NO FAILED REQUESTS (every submission retires with a
+    finish reason — nothing shed, BUSY-rejected, or lost across any
+    migration), GREEDY-EXACT TOKENS across the migration (each
+    finished stream equals the closed-form chain), KV RECLAIMED on
+    every engine generation (retired and live), every slot UPGRADED
+    within the window with its realized pause <= its budget, and p99
+    TTFT bounded."""
+    import hashlib
+    import json as _json
+    import random as _random
+
+    from ..controllers.defrag import (StreamingConvergence,
+                                      migration_pause_budget_ms)
+    from ..profiling.profiler import Profiler
+    from ..profiling.recorder import FlightRecorder
+    from ..serving.engine import ServingEngine
+    from ..serving.runner import FakeRunner
+    from ..tracing import Tracer
+    from ..tracing.export import trace_digest
+    from .clock import SimClock
+
+    p = MIGRATION_SCALES[scale]
+    t0 = _wall_time.perf_counter()
+    clock = SimClock()
+    tracer = Tracer(service="migration-sim", clock=clock,
+                    id_prefix="ru")
+    profiler = Profiler(name="sim-pool", clock=clock, bin_s=0.1)
+    recorder = FlightRecorder(clock=clock,
+                              config={"component": "migration-sim",
+                                      "seed": seed, "scale": scale})
+    rng = _random.Random(seed)
+    events: list = []
+    outcomes = {"done": 0, "shed": 0, "busy": 0}
+    finished: list = []
+
+    def emit(seq, toks, done, info):
+        if done:
+            key = "shed" if info.get("code") else "done"
+            outcomes[key] += 1
+            if key == "done":
+                finished.append(seq)
+            events.append((round(clock.monotonic(), 6), key,
+                           seq.tenant, info.get("finish_reason")
+                           or info.get("code"), len(seq.tokens)))
+
+    gen_counter = [0]
+
+    def make_engine(slot: int) -> ServingEngine:
+        gen_counter[0] += 1
+        return ServingEngine(
+            FakeRunner(num_blocks=p["blocks"], block_size=4),
+            clock=clock, tracer=tracer,
+            name=f"w{slot}g{gen_counter[0]}", max_batch=p["batch"],
+            prefill_chunk_tokens=p["chunk"],
+            max_waiting=p["waiting"], profiler=profiler,
+            recorder=recorder, prefix_sharing=True)
+
+    slots = [make_engine(i) for i in range(p["workers"])]
+    retired_engines: list = []
+    slot_qos: Dict[int, set] = {i: set() for i in range(p["workers"])}
+
+    # seeded mixed-QoS arrival schedule, tenants pinned round-robin to
+    # pool slots; no deadlines — the zero-failed criterion means
+    # nothing may legitimately shed
+    arrivals = []
+    for i in range(p["tenants"]):
+        tenant = f"tenant-{i:04d}"
+        slot = i % p["workers"]
+        qos = ("low", "medium", "high", "critical")[rng.randrange(4)]
+        t_wake = rng.random() * p["window_s"]
+        for j in range(p["reqs"]):
+            prompt = [rng.randrange(1, 97)
+                      for _ in range(2 + rng.randrange(p["prompt"]))]
+            arrivals.append((round(t_wake + j * 0.03, 6), slot, tenant,
+                             qos, prompt,
+                             1 + rng.randrange(p["tokens"])))
+    arrivals.sort(key=lambda a: (a[0], a[2]))
+
+    # rolling-upgrade schedule: one slot at a time, spread across the
+    # window so migrations overlap live traffic
+    upgrade_at = [round((k + 0.5) * p["window_s"] / p["workers"], 6)
+                  for k in range(p["workers"])]
+    upgraded: list = []
+    violations = {"lost_requests": [], "greedy_exact": [],
+                  "kv_reclaimed": [], "pause_budget": [],
+                  "rolled_all": []}
+
+    def step_pool() -> bool:
+        did = False
+        for eng in slots:
+            did = eng.step() or did
+        return did
+
+    def ship_time_s(blocks: int) -> float:
+        return blocks * p["block_bytes"] / p["bandwidth"]
+
+    def migrate_slot(slot: int) -> None:
+        src = slots[slot]
+        budget_ms = min([migration_pause_budget_ms(q)
+                         for q in slot_qos[slot]] or
+                        [migration_pause_budget_ms("medium")])
+        policy = StreamingConvergence(budget_ms, max_rounds=8)
+        shipped_gen = 0
+        rounds = 0
+        while True:
+            dirty = src.account.dirty_since(shipped_gen)
+            gen_now = src.account.write_gen
+            # the copy runs CONCURRENTLY with serving: step the pool
+            # through the ship window instead of going dark
+            t_end = clock.monotonic() + max(ship_time_s(len(dirty)),
+                                            1e-4)
+            while clock.monotonic() < t_end:
+                if not step_pool():
+                    clock.sleep(0.001)
+                else:
+                    clock.sleep(0.001)
+            rounds += 1
+            shipped_gen = gen_now
+            left = src.account.dirty_since(shipped_gen)
+            stats = {"round": rounds, "buffers": len(dirty),
+                     "raw_bytes": len(dirty) * p["block_bytes"],
+                     "dirty_left": len(left),
+                     "bandwidth_bps": p["bandwidth"]}
+            verdict = policy.decide(stats)
+            if verdict == "continue":
+                continue
+            # "fallback" degenerates to an immediate freeze here: a
+            # stop-and-copy of the whole pool state, same mechanics
+            # with a full final round — the pause then reflects it
+            break
+        # bounded final pause: freeze, ship the remainder dark, flip
+        src.freeze()
+        final_dirty = src.account.dirty_since(shipped_gen)
+        pause_s = ship_time_s(len(final_dirty)) + \
+            StreamingConvergence.FREEZE_OVERHEAD_MS / 1e3
+        clock.sleep(pause_s)          # tenant-dark window
+        moved = src.export_sequences()
+        standby = make_engine(slot)
+        standby.import_sequences(moved)
+        retired_engines.append(src)
+        slots[slot] = standby
+        upgraded.append({"slot": slot, "rounds": rounds,
+                         "pause_ms": round(pause_s * 1e3, 3),
+                         "budget_ms": budget_ms,
+                         "moved": len(moved),
+                         "final_blocks": len(final_dirty)})
+        events.append((round(clock.monotonic(), 6), "upgrade",
+                       f"w{slot}", rounds, len(moved)))
+
+    submitted = 0
+    i = 0
+    next_upgrade = 0
+    while True:
+        now = clock.monotonic()
+        if next_upgrade < len(upgrade_at) and \
+                now >= upgrade_at[next_upgrade]:
+            migrate_slot(next_upgrade)
+            next_upgrade += 1
+        while i < len(arrivals) and arrivals[i][0] <= now:
+            _, slot, tenant, qos, prompt, max_new = arrivals[i]
+            i += 1
+            submitted += 1
+            slot_qos[slot].add(qos)
+            trace = {"trace_id": f"ru-{submitted:05d}", "span_id": "",
+                     "sampled": True}
+            try:
+                slots[slot].submit(prompt, max_new, tenant=tenant,
+                                   qos=qos, emit=emit, trace=trace)
+                events.append((round(now, 6), "submit", tenant, qos,
+                               len(prompt)))
+            except Exception as e:  # noqa: BLE001 - counted as failure
+                outcomes["busy"] += 1
+                events.append((round(now, 6), "busy", tenant, qos,
+                               str(e)[:60]))
+        did = step_pool()
+        if did:
+            clock.sleep(0.01)
+        elif i < len(arrivals):
+            clock.advance_to(arrivals[i][0])
+        elif next_upgrade < len(upgrade_at):
+            clock.advance_to(upgrade_at[next_upgrade])
+        else:
+            break
+
+    # -- judgment ----------------------------------------------------------
+    if outcomes["done"] != submitted or outcomes["shed"] or \
+            outcomes["busy"]:
+        violations["lost_requests"].append(
+            f"{submitted} submitted but done={outcomes['done']} "
+            f"shed={outcomes['shed']} busy={outcomes['busy']}")
+    runner0 = slots[0].runner
+    for seq in finished:
+        expect, tok, pos = [], seq.prompt[-1], len(seq.prompt) - 1
+        while len(expect) < seq.max_new_tokens:
+            tok = runner0._next(tok, pos)
+            expect.append(tok)
+            pos += 1
+        if seq.tokens != expect:
+            violations["greedy_exact"].append(
+                f"{seq.tenant} sid={seq.sid}: {seq.tokens} != "
+                f"{expect}")
+    for eng in retired_engines + slots:
+        snap = eng.account.snapshot()
+        if snap["used"] != 0 or snap["owners"] != 0:
+            violations["kv_reclaimed"].append(
+                f"{eng.name}: {snap['used']} blocks / "
+                f"{snap['owners']} owners still held")
+    for up in upgraded:
+        if up["pause_ms"] > up["budget_ms"] + \
+                StreamingConvergence.FREEZE_OVERHEAD_MS:
+            violations["pause_budget"].append(
+                f"slot {up['slot']}: pause {up['pause_ms']}ms > "
+                f"budget {up['budget_ms']}ms")
+    if len(upgraded) != p["workers"]:
+        violations["rolled_all"].append(
+            f"only {len(upgraded)}/{p['workers']} slots upgraded")
+    if not sum(u["moved"] for u in upgraded):
+        # the whole point is migrating LIVE sequences: a roll that
+        # only ever moved idle engines proved nothing
+        violations["rolled_all"].append(
+            "no live sequence ever rode a migration (pool idle at "
+            "every upgrade — scenario shape too sparse)")
+    ttfts = sorted(s.ttft_ms for s in finished
+                   if s.ttft_ms is not None)
+    p99 = ttfts[min(len(ttfts) - 1,
+                    int(0.99 * len(ttfts)))] if ttfts else 0.0
+    if p99 > p["ttft_p99_bound_ms"]:
+        violations["lost_requests"].append(
+            f"p99 TTFT {p99}ms > bound {p['ttft_p99_bound_ms']}ms")
+
+    log_digest = hashlib.sha256(
+        _json.dumps(events, sort_keys=True).encode()).hexdigest()
+    spans = tracer.finished()
+    ok = not any(violations.values())
+    out = {
+        "scenario": "rolling-pool-upgrade",
+        "seed": seed,
+        "scale": scale,
+        "ok": ok,
+        "sim_seconds": round(clock.monotonic(), 3),
+        "wall_seconds": round(_wall_time.perf_counter() - t0, 3),
+        "store_events": len(events),
+        "log_digest": log_digest,
+        "trace_spans": len(spans),
+        "trace_digest": trace_digest(spans),
+        "profile_digest": profiler.digest(),
+        "pods_scheduled": 0,
+        "sched_failures": 0,
+        "pump_exhausted": 0,
+        "invariants": {k: v[:10] for k, v in violations.items()},
+        "workers": p["workers"],
+        "tenants": p["tenants"],
+        "requests": submitted,
+        "outcomes": outcomes,
+        "upgrades": upgraded,
+        "migrated_sequences": sum(u["moved"] for u in upgraded),
+        "rounds_total": sum(u["rounds"] for u in upgraded),
+        "pause_ms_max": max((u["pause_ms"] for u in upgraded),
+                            default=0.0),
+        "ttft_p99_ms": p99,
+    }
+    if not ok:
+        _, bd = recorder.build_bundle(
+            "invariant-rolling-pool-upgrade", tracers=(tracer,),
+            extra={"invariants": violations, "upgrades": upgraded})
+        out["bundle_digest"] = bd
+    LAST_TRACE["spans"] = spans
+    LAST_TRACE["meta"] = {"scenario": "rolling-pool-upgrade",
+                          "seed": seed, "scale": scale,
+                          "sim_seconds": out["sim_seconds"]}
+    LAST_PROFILE["snapshots"] = [profiler.snapshot(bins=10 ** 9)]
+    LAST_PROFILE["meta"] = dict(LAST_TRACE["meta"])
+    return out

@@ -49,6 +49,13 @@ SPAN_SCHEMA = {
     "fed.shard_exec": {
         "attrs": ("worker", "fn", "mode"),
     },
+    # -- streaming live migration (protocol v8, docs/migration.md):
+    # one pre-copy delta round on the source worker (traced
+    # SNAPSHOT_DELTA requests only)
+    "migrate.delta": {
+        "attrs": ("round", "buffers", "raw_bytes", "wire_bytes",
+                  "final"),
+    },
     # -- serving engine (tpfserve: continuous batching, docs/serving.md)
     "client.generate": {
         "attrs": ("tokens", "ttft_ms", "busy_retries"),

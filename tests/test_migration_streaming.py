@@ -1,0 +1,727 @@
+"""Streaming live migration (ISSUE 15, protocol v8, docs/migration.md):
+the iterative pre-copy wire path end-to-end, per-buffer dirty-gen
+tracking, MIGRATE_FREEZE semantics, abort/target-death recovery, the
+controller convergence policy + edge battery (pod deleted mid-round,
+target death, strict-gang refusal, double-migration conflict-skip),
+the v2-v7 frame-tap interop gate, the engine sequence-migration /
+KV-pool dirty hooks, and the `_post` retry + deferred-resume-shutdown
+satellites."""
+
+import json
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tensorfusion_tpu import constants
+from tensorfusion_tpu.api.types import Container, Pod, TPUChip, TPUNodeClaim, TPUPool
+from tensorfusion_tpu.controllers.defrag import (LiveMigrator,
+                                                 StreamingConvergence,
+                                                 migration_pause_budget_ms)
+from tensorfusion_tpu.operator import Operator
+from tensorfusion_tpu.remoting import (RemoteDevice, RemoteExecutionError,
+                                       RemoteVTPUWorker)
+from tensorfusion_tpu.remoting import protocol as P
+from tensorfusion_tpu.remoting.client import RemoteBuffer
+from tensorfusion_tpu.serving.engine import ServingEngine
+from tensorfusion_tpu.serving.kvpool import BlockAccount
+from tensorfusion_tpu.serving.runner import FakeRunner
+
+MIG_KINDS = ("SNAPSHOT_DELTA", "MIGRATE_FREEZE", "MIGRATE_COMMIT",
+             "SNAPSHOT_DELTA_OK", "MIGRATE_FREEZE_OK",
+             "MIGRATE_COMMIT_OK")
+
+
+@pytest.fixture()
+def pair():
+    src, tgt = RemoteVTPUWorker(), RemoteVTPUWorker()
+    src.start()
+    tgt.start()
+    yield src, tgt
+    src.stop()
+    tgt.stop()
+
+
+# -- wire path end-to-end ---------------------------------------------------
+
+
+def test_streaming_migration_end_to_end(pair):
+    """Rounds ship only the dirty set; freeze leaves nothing dirty;
+    commit flips the state live on the target EXACTLY (no q8 loss by
+    default) and drops it on the source."""
+    import jax.numpy as jnp
+
+    src, tgt = pair
+    ten = RemoteDevice(src.url)
+    a = ten.put(np.arange(4096, dtype=np.float32))
+    fn = ten.remote_jit(lambda x: jnp.tanh(x) * 2.0)
+    out1 = fn(np.ones(2048, dtype=np.float32))
+
+    orch = RemoteDevice(src.url)
+    r1 = orch.snapshot_delta(tgt.url)
+    assert r1["round"] == 1 and r1["buffers"] == 1
+    assert r1["executables"] == 1
+    # dirty one more buffer between rounds: round 2 ships ONLY it
+    b = ten.put(np.full(1024, 7.0, dtype=np.float32))
+    r2 = orch.snapshot_delta(tgt.url)
+    assert r2["round"] == 2 and r2["buffers"] == 1
+
+    fr = orch.migrate_freeze()
+    assert fr["frozen"] is True and fr["dirty_buffers"] == 0
+    cm = orch.migrate_commit()
+    assert cm["buffers"] == 2 and cm["executables"] == 1
+    assert cm["pause_ms"] < 5000  # bounded, not stop-the-world scale
+
+    # target: byte-exact buffers under their original ids + a warm
+    # executable cache (the suffix-identical contract)
+    t = RemoteDevice(tgt.url)
+    got = RemoteBuffer(t, a.buf_id, a.shape, "float32").fetch()
+    assert np.array_equal(got, np.arange(4096, dtype=np.float32))
+    got_b = RemoteBuffer(t, b.buf_id, b.shape, "float32").fetch()
+    assert np.array_equal(got_b, np.full(1024, 7.0, dtype=np.float32))
+    fn2 = t.remote_jit(lambda x: jnp.tanh(x) * 2.0)
+    assert np.allclose(np.asarray(out1),
+                       np.asarray(fn2(np.ones(2048, dtype=np.float32))))
+    # source dropped the migrated state (the binding flipped)
+    with pytest.raises(RemoteExecutionError):
+        a.fetch()
+    stats = src.migration_stats()
+    assert stats["streaming_total"] == 1 and stats["session"] is None
+    assert tgt.migration_stats()["installed_total"] == 2
+
+
+def test_dirty_generation_tracks_every_install_path(pair):
+    """PUT, keep_results installs and FREE all keep the dirty ledger
+    honest: a round ships exactly the still-resident dirtied set."""
+    src, tgt = pair
+    ten = RemoteDevice(src.url)
+    a = ten.put(np.ones(512, dtype=np.float32))
+    orch = RemoteDevice(src.url)
+    assert orch.snapshot_delta(tgt.url)["buffers"] == 1
+    # freeing the only buffer then re-putting: next round ships the
+    # new buffer only, and commit must not resurrect the freed id
+    a.free()
+    c = ten.put(np.full(256, 3.0, dtype=np.float32))
+    r = orch.snapshot_delta(tgt.url)
+    assert r["buffers"] == 1
+    orch.migrate_freeze()
+    cm = orch.migrate_commit()
+    assert cm["buffers"] == 1
+    t = RemoteDevice(tgt.url)
+    assert np.array_equal(
+        RemoteBuffer(t, c.buf_id, c.shape, "float32").fetch(),
+        np.full(256, 3.0, dtype=np.float32))
+    with pytest.raises(RemoteExecutionError):
+        RemoteBuffer(t, a.buf_id, a.shape, "float32").fetch()
+
+
+def test_freeze_blocks_mutations_until_commit(pair):
+    """MIGRATE_FREEZE holds mutating requests at the handler: a PUT
+    issued while frozen completes only after the commit thaws."""
+    src, tgt = pair
+    ten = RemoteDevice(src.url)
+    ten.put(np.ones(128, dtype=np.float32))
+    orch = RemoteDevice(src.url)
+    orch.snapshot_delta(tgt.url)
+    orch.migrate_freeze()
+    done_at = {}
+
+    def late_put():
+        ten.put(np.zeros(64, dtype=np.float32))
+        done_at["t"] = time.monotonic()
+
+    t = threading.Thread(target=late_put, daemon=True)
+    t.start()
+    time.sleep(0.3)
+    assert "t" not in done_at, "PUT completed during the freeze window"
+    commit_done = time.monotonic()
+    orch.migrate_commit()
+    t.join(timeout=10)
+    assert done_at["t"] >= commit_done
+
+
+def test_abort_leaves_source_intact(pair):
+    src, tgt = pair
+    ten = RemoteDevice(src.url)
+    a = ten.put(np.arange(128, dtype=np.float32))
+    orch = RemoteDevice(src.url)
+    orch.snapshot_delta(tgt.url)
+    orch.migrate_freeze()
+    ab = orch.migrate_commit(abort=True)
+    assert ab["aborted"] is True
+    # source thawed with state intact; staged bytes on the target are
+    # freed (quiet FREE — poll briefly)
+    assert np.array_equal(a.fetch(), np.arange(128, dtype=np.float32))
+    deadline = time.time() + 5
+    while time.time() < deadline and tgt.resident_bytes:
+        time.sleep(0.05)
+    assert tgt.resident_bytes == 0
+    assert src.migration_stats()["aborted_total"] == 1
+
+
+def test_target_death_mid_session_keeps_source_serving(pair):
+    """The target link dies between rounds: the next delta fails
+    loudly (a new exe blob forces a prepare round-trip through the
+    dead link), the source stays thawed and serving, and abort cleans
+    the session up."""
+    import jax.numpy as jnp
+
+    src, tgt = pair
+    link = FrameTap(tgt.port)
+    ten = RemoteDevice(src.url)
+    a = ten.put(np.ones(2048, dtype=np.float32))
+    orch = RemoteDevice(src.url)
+    orch.snapshot_delta(f"tcp://127.0.0.1:{link.port}")
+    link.close()        # target unreachable from here on
+    ten.put(np.zeros(512, dtype=np.float32))
+    fn = ten.remote_jit(lambda x: x * 3.0)
+    assert np.allclose(np.asarray(fn(np.ones(8, dtype=np.float32))),
+                       3.0)
+    with pytest.raises(RemoteExecutionError):
+        orch.snapshot_delta(f"tcp://127.0.0.1:{link.port}")
+    # the failed round left the source thawed and serving
+    assert np.array_equal(a.fetch(), np.ones(2048, dtype=np.float32))
+    ab = orch.migrate_commit(abort=True)
+    assert ab["aborted"] is True
+    assert jnp is not None
+
+
+def test_migration_rides_low_qos_dispatch_tenant(pair):
+    """Delta rounds are fair-queued as the dedicated lowest-weight
+    'migration' tenant — visible in the dispatcher snapshot."""
+    src, tgt = pair
+    ten = RemoteDevice(src.url)
+    ten.put(np.ones(1024, dtype=np.float32))
+    orch = RemoteDevice(src.url)
+    orch.snapshot_delta(tgt.url)
+    snap = src.dispatcher.snapshot()
+    mig = snap["tenants"].get("migration")
+    assert mig is not None and mig["qos"] == constants.QOS_LOW
+    orch.migrate_commit(abort=True)
+
+
+# -- interop: v2-v7 peers must never see the v8 kinds ----------------------
+
+
+class FrameTap:
+    """TCP forwarder decoding every frame kind both directions (the
+    raw-socket assertion layer, same as the federation battery)."""
+
+    def __init__(self, target_port: int):
+        self.target_port = target_port
+        self.kinds_up = []
+        self.kinds_down = []
+        self._listen = socket.socket()
+        self._listen.bind(("127.0.0.1", 0))
+        self._listen.listen(8)
+        self.port = self._listen.getsockname()[1]
+        self._alive = True
+        self._socks = []
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        while self._alive:
+            try:
+                cli, _ = self._listen.accept()
+            except OSError:
+                return
+            if not self._alive:
+                cli.close()
+                return
+            srv = socket.create_connection(("127.0.0.1",
+                                            self.target_port))
+            self._socks += [cli, srv]
+            threading.Thread(target=self._pump,
+                             args=(cli, srv, self.kinds_up),
+                             daemon=True).start()
+            threading.Thread(target=self._pump,
+                             args=(srv, cli, self.kinds_down),
+                             daemon=True).start()
+
+    @staticmethod
+    def _read_exact(sock, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("closed")
+            buf += chunk
+        return buf
+
+    def _pump(self, src, dst, kinds):
+        try:
+            while True:
+                head = self._read_exact(src, 12)
+                _, hlen = struct.unpack("<II", head[4:])
+                header = self._read_exact(src, hlen)
+                parsed = json.loads(header)
+                kinds.append(parsed["kind"])
+                body = b"".join(
+                    self._read_exact(src, d["nbytes"])
+                    for d in parsed["buffers"])
+                dst.sendall(head + header + body)
+        except (OSError, ConnectionError, ValueError):
+            try:
+                dst.shutdown(2)
+            except OSError:
+                pass
+
+    def close(self):
+        """Sever the link: stop accepting AND kill live connections
+        (a worker's stop() leaves established handler threads running,
+        so only a broken link models a truly dead peer)."""
+        self._alive = False
+        try:
+            # close() alone leaves the kernel listener alive while the
+            # accept thread blocks on it; shutdown severs it for real
+            self._listen.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._listen.close()
+        for s in self._socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+@pytest.mark.parametrize("old_version", [2, 5, 7])
+def test_pinned_old_client_refuses_v8_kinds(pair, old_version):
+    """Client half of the double gate: a pre-v8 client build raises
+    before anything hits the wire — the tap sees ZERO v8 frames."""
+    src, tgt = pair
+    tap = FrameTap(src.port)
+    try:
+        dev = RemoteDevice(f"tcp://127.0.0.1:{tap.port}",
+                           protocol_version=old_version)
+        dev.put(np.ones(64, dtype=np.float32))
+        for call in (lambda: dev.snapshot_delta(tgt.url),
+                     dev.migrate_freeze, dev.migrate_commit):
+            with pytest.raises(RemoteExecutionError,
+                               match="protocol v8"):
+                call()
+        seen = set(tap.kinds_up) | set(tap.kinds_down)
+        assert not (seen & set(MIG_KINDS)), seen
+        dev.close()
+    finally:
+        tap.close()
+
+
+def test_worker_gate_rejects_smuggled_v8_frame(pair):
+    """Worker half: a hand-rolled peer that negotiated v7 but sends
+    SNAPSHOT_DELTA anyway gets a structured refusal, not service."""
+    src, tgt = pair
+    sock = socket.create_connection(("127.0.0.1", src.port))
+    try:
+        P.send_message(sock, "HELLO", {"max_version": 7, "seq": 1}, [],
+                       version=P.HELLO_VERSION)
+        kind, meta, _ = P.recv_message(sock)
+        assert kind == "HELLO_OK" and meta["version"] == 7
+        P.send_message(sock, "SNAPSHOT_DELTA",
+                       {"target_url": tgt.url, "seq": 2}, [],
+                       version=7)
+        kind, meta, _ = P.recv_message(sock)
+        assert kind == "ERROR"
+        assert "protocol >= 8" in meta["error"]
+    finally:
+        sock.close()
+
+
+def test_taps_see_v8_kinds_and_worker_to_worker_deltas(pair):
+    """Positive control: over v8 the orchestrator tap carries the v8
+    kinds, and the TARGET tap shows the deltas arriving as quiet PUTs
+    + MIGRATE_COMMIT straight from the source worker — worker-to-
+    worker, never through the orchestrator connection."""
+    src, tgt = pair
+    orch_tap = FrameTap(src.port)
+    tgt_tap = FrameTap(tgt.port)
+    try:
+        ten = RemoteDevice(src.url)
+        ten.put(np.ones(1024, dtype=np.float32))
+        orch = RemoteDevice(f"tcp://127.0.0.1:{orch_tap.port}")
+        orch.snapshot_delta(f"tcp://127.0.0.1:{tgt_tap.port}")
+        orch.migrate_freeze()
+        orch.migrate_commit()
+        assert "SNAPSHOT_DELTA" in orch_tap.kinds_up
+        assert "SNAPSHOT_DELTA_OK" in orch_tap.kinds_down
+        assert "MIGRATE_COMMIT" in orch_tap.kinds_up
+        # the orchestrator connection carried NO buffer payloads —
+        # deltas rode the source->target connection
+        assert "PUT" not in orch_tap.kinds_up
+        assert "PUT" in tgt_tap.kinds_up
+        assert "MIGRATE_COMMIT" in tgt_tap.kinds_up
+        assert "MIGRATE_COMMIT_OK" in tgt_tap.kinds_down
+    finally:
+        orch_tap.close()
+        tgt_tap.close()
+
+
+# -- controller: convergence policy + edge battery --------------------------
+
+
+def test_convergence_policy_decisions():
+    pol = StreamingConvergence(pause_budget_ms=100.0, max_rounds=4)
+    fits = {"round": 1, "buffers": 10, "raw_bytes": 10 * 4096,
+            "dirty_left": 1, "bandwidth_bps": 10 << 20}
+    assert pol.decide(fits) == "freeze"
+    hot = {"round": 2, "buffers": 4, "raw_bytes": 4 << 20,
+           "dirty_left": 2000, "bandwidth_bps": 1 << 20}
+    assert pol.decide(hot) == "continue" or pol.decide(hot) == \
+        "fallback"  # round 2 with dirty_left >= buffers -> fallback
+    assert pol.decide(dict(hot, round=2)) == "fallback"
+    capped = dict(hot, round=4, dirty_left=1)
+    assert pol.decide(capped) == "fallback"
+    assert migration_pause_budget_ms("critical") < \
+        migration_pause_budget_ms("low")
+
+
+def make_operator(hosts=2):
+    op = Operator()
+    pool = TPUPool.new("pool-a")
+    pool.spec.name = "pool-a"
+    op.store.create(pool)
+    for i in range(hosts):
+        claim = TPUNodeClaim.new(f"host-{i}")
+        claim.spec.pool = "pool-a"
+        claim.spec.generation = "v5e"
+        claim.spec.chip_count = 4
+        op.store.create(claim)
+    op.start()
+    deadline = time.time() + 5
+    while len(op.allocator.chips()) < hosts * 4 and \
+            time.time() < deadline:
+        time.sleep(0.02)
+    return op
+
+
+def submit(op, name, tflops=50.0, qos=None):
+    pod = Pod.new(name, namespace="default")
+    ann = pod.metadata.annotations
+    ann[constants.ANN_POOL] = "pool-a"
+    ann[constants.ANN_TFLOPS_REQUEST] = str(tflops)
+    ann[constants.ANN_HBM_REQUEST] = str(2 * 2 ** 30)
+    ann[constants.ANN_IS_LOCAL_TPU] = "true"
+    if qos:
+        ann[constants.ANN_QOS] = qos
+    pod.spec.containers = [Container(name="main")]
+    op.submit_pod(pod)
+    bound = op.wait_for_binding(name)
+    assert bound is not None
+    return bound
+
+
+class FakeTransport:
+    """Scripted migrate_streaming transport: per-round stats, plus
+    hooks to kill the target or delete the pod mid-round."""
+
+    def __init__(self, rounds, commit=None, freeze=None,
+                 on_delta=None):
+        self.rounds = list(rounds)
+        self.commit_reply = commit if commit is not None else \
+            {"pause_ms": 7.5, "rounds": len(rounds), "buffers": 3,
+             "raw_bytes": 3 << 20, "wire_bytes": 1 << 20}
+        self.freeze_reply = freeze if freeze is not None else \
+            {"frozen": True, "dirty_buffers": 0, "dirty_bytes": 0}
+        self.on_delta = on_delta
+        self.calls = []
+
+    def target_worker_url(self, node):
+        return f"tcp://fake-{node}:1"
+
+    def delta(self, ns, pod, source, target_url, final=False):
+        self.calls.append(("delta", final))
+        if self.on_delta is not None:
+            self.on_delta(len([c for c in self.calls
+                               if c[0] == "delta"]))
+        if not self.rounds:
+            return None
+        return self.rounds.pop(0)
+
+    def freeze(self, ns, pod, source):
+        self.calls.append(("freeze",))
+        return self.freeze_reply
+
+    def commit(self, ns, pod, source, abort=False):
+        self.calls.append(("commit", abort))
+        return {"aborted": True} if abort else self.commit_reply
+
+
+def _chip_phases(op):
+    return {c.name: c.status.phase for c in op.store.list(TPUChip)}
+
+
+def test_migrate_streaming_commits_and_rebinds():
+    op = make_operator(hosts=2)
+    try:
+        bound = submit(op, "hot", qos="high")
+        source = bound.spec.node_name
+        tr = FakeTransport(rounds=[
+            {"round": 1, "buffers": 8, "raw_bytes": 8 << 20,
+             "dirty_left": 4, "bandwidth_bps": 64 << 20,
+             "wire_bytes": 2 << 20},
+            {"round": 2, "buffers": 4, "raw_bytes": 1 << 20,
+             "dirty_left": 0, "bandwidth_bps": 64 << 20,
+             "wire_bytes": 1 << 20},
+        ])
+        result = op.migrator.migrate_streaming(
+            "default", "hot", transport=tr)
+        assert result is not None and result["mode"] == "streaming"
+        assert result["new_node"] and result["new_node"] != source
+        assert result["pause_ms"] == 7.5
+        assert ("freeze",) in tr.calls and ("commit", False) in tr.calls
+        # chips restored to Running
+        assert set(_chip_phases(op).values()) == {"Running"}
+        assert op.migrator.streaming_committed == 1
+    finally:
+        op.stop()
+
+
+def test_migrate_streaming_falls_back_for_hot_tenant():
+    """A tenant whose dirty rate beats bandwidth never converges: the
+    controller gives up and stop-and-copies (migration still lands)."""
+    op = make_operator(hosts=2)
+    try:
+        bound = submit(op, "hot")
+        source = bound.spec.node_name
+        hot = {"buffers": 4, "raw_bytes": 4 << 20, "dirty_left": 500,
+               "bandwidth_bps": 1 << 20, "wire_bytes": 1 << 20}
+        tr = FakeTransport(rounds=[dict(hot, round=1),
+                                   dict(hot, round=2)])
+        result = op.migrator.migrate_streaming(
+            "default", "hot", transport=tr)
+        assert result is not None and result["mode"] == "stop-and-copy"
+        assert result["new_node"] != source
+        assert ("commit", True) in tr.calls       # session aborted
+        assert op.migrator.streaming_fallback == 1
+        assert set(_chip_phases(op).values()) == {"Running"}
+    finally:
+        op.stop()
+
+
+def test_migrate_streaming_target_dies_between_rounds():
+    """Transport failure mid-round (target dead): fallback to
+    stop-and-copy, deltas discarded via abort, chips Running."""
+    op = make_operator(hosts=2)
+    try:
+        submit(op, "hot")
+        tr = FakeTransport(rounds=[
+            {"round": 1, "buffers": 8, "raw_bytes": 8 << 20,
+             "dirty_left": 100, "bandwidth_bps": 1 << 20,
+             "wire_bytes": 2 << 20}])   # second delta returns None
+        result = op.migrator.migrate_streaming(
+            "default", "hot", transport=tr)
+        assert result is not None and result["mode"] == "stop-and-copy"
+        assert ("commit", True) in tr.calls
+        assert set(_chip_phases(op).values()) == {"Running"}
+    finally:
+        op.stop()
+
+
+def test_migrate_streaming_pod_deleted_mid_round_aborts():
+    op = make_operator(hosts=2)
+    try:
+        submit(op, "hot")
+
+        def kill_pod(n_deltas):
+            if n_deltas == 1:
+                op.store.delete(Pod, "hot", "default")
+
+        slow = {"buffers": 8, "raw_bytes": 8 << 20, "dirty_left": 100,
+                "bandwidth_bps": 1 << 20, "wire_bytes": 2 << 20}
+        tr = FakeTransport(rounds=[dict(slow, round=1),
+                                   dict(slow, round=2),
+                                   dict(slow, round=3)],
+                           on_delta=kill_pod)
+        result = op.migrator.migrate_streaming(
+            "default", "hot", transport=tr)
+        assert result is None
+        assert ("commit", True) in tr.calls       # deltas discarded
+        assert op.migrator.streaming_aborted == 1
+        assert set(_chip_phases(op).values()) == {"Running"}
+    finally:
+        op.stop()
+
+
+def test_migrate_streaming_refuses_strict_gang_member():
+    op = make_operator(hosts=2)
+    try:
+        names = ["g0", "g1"]
+        for name in names:
+            pod = Pod.new(name, namespace="default")
+            ann = pod.metadata.annotations
+            ann[constants.ANN_POOL] = "pool-a"
+            ann[constants.ANN_TFLOPS_REQUEST] = "30"
+            ann[constants.ANN_HBM_REQUEST] = str(2 ** 30)
+            ann[constants.ANN_IS_LOCAL_TPU] = "true"
+            ann[constants.ANN_WORKLOAD] = "gangwl"
+            ann[constants.ANN_GANG_ENABLED] = "true"
+            ann[constants.ANN_GANG_DESIRED_MEMBERS] = "2"
+            ann[constants.ANN_GANG_MIN_MEMBERS] = "2"
+            ann[constants.ANN_GANG_REQUIRED_MEMBERS] = "2"
+            ann[constants.ANN_GANG_TIMEOUT] = "30"
+            pod.spec.containers = [Container(name="main")]
+            op.submit_pod(pod)
+        for name in names:
+            assert op.wait_for_binding(name) is not None
+        tr = FakeTransport(rounds=[])
+        assert op.migrator.migrate_streaming("default", "g0",
+                                             transport=tr) is None
+        assert not tr.calls     # refused before any transport traffic
+    finally:
+        op.stop()
+
+
+def test_double_migration_conflict_skips():
+    op = make_operator(hosts=2)
+    try:
+        submit(op, "hot")
+        with op.migrator._state_lock:
+            op.migrator._inflight.add("default/hot")
+        assert op.migrator.migrate_streaming("default", "hot") is None
+        assert op.migrator.migrate("default", "hot") is None
+        with op.migrator._state_lock:
+            op.migrator._inflight.discard("default/hot")
+    finally:
+        op.stop()
+
+
+# -- satellites: _post retry + deferred-resume shutdown ---------------------
+
+
+def test_post_retries_transient_hypervisor_hiccup(monkeypatch):
+    calls = []
+
+    def flaky(url, method="GET", data=None, timeout_s=10.0):
+        calls.append(url)
+        if len(calls) == 1:
+            raise OSError("connection refused")
+        return None
+
+    monkeypatch.setattr(
+        "tensorfusion_tpu.utils.tlsutil.hypervisor_urlopen", flaky)
+    m = LiveMigrator(store=None, allocator=None)
+    assert m._post("http://hv/api/v1/workers/ns/p/snapshot") is True
+    assert len(calls) == 2       # one transient failure, one success
+
+
+def test_post_gives_up_after_bounded_attempts(monkeypatch):
+    calls = []
+
+    def dead(url, method="GET", data=None, timeout_s=10.0):
+        calls.append(url)
+        raise OSError("connection refused")
+
+    monkeypatch.setattr(
+        "tensorfusion_tpu.utils.tlsutil.hypervisor_urlopen", dead)
+    m = LiveMigrator(store=None, allocator=None)
+    assert m._post("http://hv/x") is False
+    assert len(calls) == LiveMigrator.POST_ATTEMPTS
+
+
+def test_deferred_resume_exits_on_close_without_touching_store():
+    """A resume landing after controller stop must not touch a dead
+    store: close() stops + joins the watcher, after which the store
+    can die safely."""
+
+    class Store:
+        def __init__(self):
+            self.dead = False
+            self.lock = threading.Lock()
+
+        def try_get(self, cls, name, namespace=""):
+            with self.lock:
+                assert not self.dead, "deferred resume touched a " \
+                                      "dead store"
+            pod = Pod.new(name, namespace=namespace)
+            pod.spec.node_name = "src-node"    # never rebinds
+            return pod
+
+    store = Store()
+    m = LiveMigrator(store=store, allocator=None)
+    m._spawn_deferred_resume("default", "pod-x", "src-node")
+    time.sleep(0.2)
+    m.close()
+    with m._state_lock:
+        threads = list(m._resume_threads)
+    assert all(not t.is_alive() for t in threads)
+    with store.lock:
+        store.dead = True
+    time.sleep(0.3)     # would assert inside try_get if still polling
+
+
+# -- serving engine + KV pool migration hooks -------------------------------
+
+
+def test_engine_freeze_export_import_suffix_identical():
+    src_r = FakeRunner(num_blocks=32, block_size=4)
+    tgt_r = FakeRunner(num_blocks=32, block_size=4)
+    src = ServingEngine(src_r, name="src", max_batch=4)
+    tgt = ServingEngine(tgt_r, name="tgt", max_batch=4)
+    done = {}
+
+    def emit(seq, toks, d, info):
+        done.setdefault(seq.tenant, []).extend(toks)
+
+    seqs = [src.submit([5 + i, 9, 11], 8, tenant=f"t{i}", emit=emit)
+            for i in range(3)]
+    for _ in range(4):
+        src.step()
+    assert any(s.tokens for s in seqs)    # mid-generation
+    src.freeze()
+    assert src.step() is False            # frozen stepper idles
+    moved = src.export_sequences()
+    assert len(moved) == 3
+    assert src.account.snapshot()["used"] == 0
+    tgt.import_sequences(moved)
+    for _ in range(80):
+        if not tgt.step():
+            break
+    for s in seqs:
+        expect, tok, pos = [], s.prompt[-1], len(s.prompt) - 1
+        while len(expect) < s.max_new_tokens:
+            tok = tgt_r._next(tok, pos)
+            expect.append(tok)
+            pos += 1
+        assert s.tokens == expect
+        assert done[s.tenant] == expect   # emitted exactly once each
+    assert tgt.account.snapshot()["used"] == 0
+    assert src.snapshot()["migrated_out"] == 3
+    assert tgt.snapshot()["migrated_in"] == 3
+
+
+def test_kvpool_dirty_since_tracks_writes():
+    acct = BlockAccount(num_blocks=16, block_size=4)
+    gen0 = acct.write_gen
+    assert acct.ensure("s1", 8)           # 2 fresh blocks: both dirty
+    dirty = acct.dirty_since(gen0)
+    assert len(dirty) == 2
+    gen1 = acct.write_gen
+    assert acct.dirty_since(gen1) == []
+    # in-place write dirties exactly its block
+    blk, cow = acct.writable("s1", 0)
+    assert cow is None
+    assert acct.dirty_since(gen1) == [blk]
+    # CoW on a shared block dirties the COPY, not the shared source
+    acct.publish("s1", 1, key=1234)
+    assert acct.adopt_block("s2", 1234) is not None
+    gen2 = acct.write_gen
+    new, src_blk = acct.writable("s2", 0)
+    assert src_blk is not None
+    assert acct.dirty_since(gen2) == [new]
+    # release clears the ledger for reclaimed blocks
+    acct.release("s1")
+    acct.release("s2")
+    assert acct.dirty_since(0) == []
+    assert acct.snapshot()["used"] == 0
+
+
+def test_info_reports_migration_state(pair):
+    src, tgt = pair
+    dev = RemoteDevice(src.url)
+    info = dev.info()
+    assert info["migration"]["frozen"] is False
+    assert info["migration"]["session"] is None
+    assert info["protocol_version"] == 8

@@ -659,3 +659,63 @@ def test_operator_main_in_process(tmp_path):
         th2.join(timeout=15)
         ss.stop()
     assert rc2 == [0]
+
+
+# -- sharded-cell multi-window watch (ROADMAP 1a, docs/migration PR) -------
+
+
+def test_remote_store_multi_window_watch_on_4_shard_cell():
+    """A RemoteStore client of a SHARDED cell opens one long-poll per
+    shard behind a single watch-like iterator (gateway `shard=` window
+    discovery + per-shard windows): replay, live events and deletes
+    from every partition merge into one stream."""
+    import time as _time
+
+    from tensorfusion_tpu.api.types import TPUPool
+    from tensorfusion_tpu.shardedstore import ShardedStore
+
+    shards = [ObjectStore() for _ in range(4)]
+    router = ShardedStore(shards=shards)
+    # pre-existing state replays from every shard
+    for i in range(4):
+        router.create(TPUPool.new(f"seed-{i}"))
+    op = Operator(store=router)
+    server = OperatorServer(op)
+    server.start()
+    try:
+        rs = RemoteStore(server.url)
+        w = rs.watch("TPUPool", replay=True)
+        seen = {}
+        deadline = _time.time() + 15
+        while len(seen) < 4 and _time.time() < deadline:
+            ev = w.get(timeout=1.0)
+            if ev is not None:
+                seen[ev.obj.metadata.name] = ev.type
+        assert set(seen) == {f"seed-{i}" for i in range(4)}, seen
+        assert w.shards == 4
+        # live events from every partition land on the one stream
+        for i in range(8):
+            router.create(TPUPool.new(f"live-{i}"))
+        per_shard = {router.shard_for(TPUPool, f"live-{i}")
+                     for i in range(8)}
+        assert len(per_shard) > 1, "test shape degenerate: all live " \
+                                   "writes hashed to one shard"
+        got = set()
+        deadline = _time.time() + 15
+        while len(got) < 8 and _time.time() < deadline:
+            ev = w.get(timeout=1.0)
+            if ev is not None and ev.type == "ADDED" and \
+                    ev.obj.metadata.name.startswith("live-"):
+                got.add(ev.obj.metadata.name)
+        assert got == {f"live-{i}" for i in range(8)}
+        router.delete(TPUPool, "live-3")
+        got_del = False
+        deadline = _time.time() + 15
+        while not got_del and _time.time() < deadline:
+            ev = w.get(timeout=1.0)
+            got_del = ev is not None and ev.type == "DELETED" and \
+                ev.obj.metadata.name == "live-3"
+        assert got_del
+        w.stop()
+    finally:
+        server.stop()

@@ -100,6 +100,19 @@ CELLS = {
     "webhook": [
         ("mutations_per_second", "higher", 40.0, "rel"),
     ],
+    # streaming live migration (docs/migration.md): the realized
+    # tenant-dark pause for streaming relative to same-shape
+    # stop-and-copy (acceptance <=10% — the absolute band keeps the
+    # ratchet near that criterion), the raw streaming pause itself
+    # (timing cell, noisy 1-core box -> wide relative band), and the
+    # q8 session's delta-byte cut.  Resident footprint is the shape
+    # guard on every cell.
+    "migration": [
+        ("pause_ratio", "lower", 0.08, "abs", "resident_mb"),
+        ("pause_streaming_ms", "lower", 150.0, "rel", "resident_mb"),
+        ("q8_delta_bytes_ratio", "higher", 15.0, "rel",
+         "resident_mb"),
+    ],
     "multitenant": [
         # aggregate duty: higher is better (same inversion fix)
         ("value", "higher", 10.0, "abs"),            # aggregate duty pct
