@@ -12,7 +12,7 @@ PY := env -u PALLAS_AXON_POOL_IPS python
 	verify-prof verify-campaign verify-federation verify-shard \
 	verify-migrate bench-diff bench-provenance \
 	verify-native-sanitized \
-	check-coverage lint \
+	check-coverage lint lint-cold \
 	lint-drill asan \
 	tsan bench bench-tpu test-tpu-live sched-bench webhook-bench remoting-bench \
 	multitenant-bench multitenant-bench-tpu serving-bench-tpu \
@@ -41,13 +41,21 @@ verify-all: lint test-native check-coverage
 # guarded-field / frozen-view-mutation / protocol-exhaustive /
 # metrics-schema / shard-routing) plus the tpfgraph interprocedural layer (lock-order-
 # inversion / transitive-blocking-under-lock / swallowed-error /
-# unjoined-thread / leaked-resource), ratcheted by
-# tools/tpflint/baseline.json (currently EMPTY — keep it that way).
-# tools/ is linted too: the linter lints itself.  Per-file analysis is
-# cached in .tpflint-cache.json (mtime-keyed; TPF_LINT_NO_CACHE=1 or
-# --no-cache bypasses, --verbose prints hit/miss counters).
+# unjoined-thread / leaked-resource) plus the tpfflow dataflow layer
+# (untrusted-wire-input / protocol-session / sim-nondeterminism),
+# ratcheted by tools/tpflint/baseline.json (currently EMPTY — keep it
+# that way).  tools/ is linted too: the linter lints itself.  Per-file
+# analysis is cached in .tpflint-cache.json (content-keyed blake2b;
+# TPF_LINT_NO_CACHE=1 or --no-cache bypasses, --verbose prints
+# hit/miss counters).  --max-seconds is the wall-time budget: 4s warm
+# (the edit loop), 8s cold via `make lint-cold` (CI from scratch) —
+# blowing it fails the target even when findings are clean.
 lint:
-	$(PY) -m tools.tpflint tensorfusion_tpu tools
+	$(PY) -m tools.tpflint tensorfusion_tpu tools --max-seconds 4
+
+lint-cold:
+	rm -f .tpflint-cache.json
+	$(PY) -m tools.tpflint tensorfusion_tpu tools --max-seconds 8
 
 # Checker liveness drills: re-introduce one known-bad pattern per graph
 # checker (a lock-order inversion in store.py among them) into a

@@ -333,6 +333,135 @@ _DTYPES = {"float32", "float64", "float16", "bfloat16", "int8", "int16",
 #: zlib bomb would otherwise allocate arbitrary memory pre-auth)
 MAX_HEADER_BYTES = 4 << 20
 MAX_BUFFER_BYTES = 8 << 30
+#: ceiling on a q8 desc's ``q8_block``: the decoder materializes one
+#: f32 scale per block *and* ``np.repeat`` expands scales by ``block``
+#: elements, so an unbounded block is an allocation bomb even when the
+#: dequantized output itself passes the MAX_BUFFER_BYTES check
+Q8_MAX_BLOCK = 1 << 20
+
+# -- trust boundary (enforced by tpflint's untrusted-wire-input) -----------
+#
+# Declared next to REQUEST_KINDS for the same reason the opcodes are:
+# the wire format and the code that must distrust it live in one
+# place.  tpflint's dataflow layer (tools/tpflint/flow.py) taints every
+# value originating here and fails lint when one reaches an allocation
+# size, a ``range()`` bound, a ``struct`` format, or a shard/ring/table
+# subscript without first passing a declared sanitizer (a bound check
+# against a MAX_*-class constant, membership in a registry, or a
+# TAINT_SANITIZERS helper).  Extensible exactly like WIRE_ENCODINGS: a
+# new source or sanitizer is registered here, not special-cased in the
+# linter.
+
+#: call tails whose return value is wire-controlled
+TAINT_SOURCES = (
+    "recv_message",      # decoded (kind, meta, buffers) from a peer
+    "_read_exact",       # raw bytes straight off the socket
+    "parse_qs",          # gateway HTTP query strings
+)
+#: (function-qualname regex, parameter name): the parameter carries
+#: wire data that reached it through a hop static dataflow cannot
+#: follow (the worker's reader thread -> inbox queue, the decode
+#: helpers called on already-received frames)
+TAINT_PARAM_SOURCES = (
+    (r"\.q8_decode$", "raw"),
+    (r"\.q8_decode$", "desc"),
+    (r"Worker\._handle_[a-z0-9_]+$", "meta"),
+    (r"Gateway\._watch$", "qs"),
+)
+#: call tails that fully validate their arguments (none needed yet:
+#: the in-tree sanitizers are inline bound checks, which the flow
+#: layer recognizes structurally)
+TAINT_SANITIZERS = ()
+
+# -- session-oriented opcode families (enforced by tpflint's ---------------
+# protocol-session)
+#
+# Some opcodes are not independent requests but legs of a *session*:
+# streaming migration is SNAPSHOT_DELTA rounds, then MIGRATE_FREEZE,
+# then exactly one MIGRATE_COMMIT (commit or abort).  The state
+# machine below is declared next to REQUEST_KINDS so the protocol's
+# sequencing contract is as visible — and as lintable — as its opcode
+# set.  tpflint's `protocol-session` checker verifies each machine
+# (every state reachable from "none", terminal states have no
+# outgoing transitions) and, for families that declare ``attr`` +
+# ``slot``, statically walks the named handler functions: state
+# writes must match a declared transition for that handler's opcode,
+# handlers of opcodes that require an existing session must guard on
+# the session's ``.state`` against a declared from-state, opcodes
+# with a terminal transition must clear the session slot (anything
+# else leaks the session), and the slot is only (re)assigned in
+# ``creators``/``restores`` members.  Families without ``attr`` are
+# declaration + handler-existence only: the machine documents the
+# stream shape (GENERATE/KV_SHIP legs, federation SHIP legs) and
+# reserves the name for when they grow explicit session objects.
+
+SESSION_PROTOCOLS = {
+    "migration": {
+        "module": "remoting/worker.py",
+        "session": "_MigrationSession",
+        "slot": "_mig_session",
+        "attr": "state",
+        "states": ("none", "live", "frozen", "committed", "aborted"),
+        "transitions": (
+            ("none", "SNAPSHOT_DELTA", "live"),
+            ("live", "SNAPSHOT_DELTA", "live"),
+            ("live", "MIGRATE_FREEZE", "frozen"),
+            ("live", "MIGRATE_COMMIT", "aborted"),
+            ("frozen", "MIGRATE_COMMIT", "aborted"),
+            ("frozen", "MIGRATE_COMMIT", "committed"),
+        ),
+        "terminal": ("committed", "aborted"),
+        "handlers": {
+            "SNAPSHOT_DELTA": ("_enqueue_snapshot_delta",
+                               "_flush_snapshot_delta"),
+            "MIGRATE_FREEZE": ("_handle_migrate_freeze",),
+            "MIGRATE_COMMIT": ("_handle_migrate_commit",),
+        },
+        "creators": ("_mig_ensure_session",),
+        "restores": ("_handle_migrate_commit",),
+    },
+    # decode-side token stream: each GENERATE leg continues (or ends)
+    # one decoding session keyed by the shipped KV cache
+    "generate_stream": {
+        "module": "remoting/worker.py",
+        "states": ("none", "streaming", "done"),
+        "transitions": (
+            ("none", "GENERATE", "streaming"),
+            ("streaming", "GENERATE", "streaming"),
+            ("streaming", "GENERATE", "done"),
+        ),
+        "terminal": ("done",),
+        "handlers": {"GENERATE": ("_handle_generate",)},
+    },
+    # prefill -> decode KV handoff: quiet ephemeral PUT legs then the
+    # KV_SHIP that binds them
+    "kv_ship": {
+        "module": "remoting/worker.py",
+        "states": ("none", "shipping", "bound"),
+        "transitions": (
+            ("none", "KV_SHIP", "shipping"),
+            ("shipping", "KV_SHIP", "shipping"),
+            ("shipping", "KV_SHIP", "bound"),
+        ),
+        "terminal": ("bound",),
+        "handlers": {"KV_SHIP": ("_handle_kv_ship",)},
+    },
+    # federated collectives: partial-shipping legs, then the reducing
+    # leg that consumes the parked partials
+    "federation_ship": {
+        "module": "remoting/worker.py",
+        "states": ("none", "collecting", "reduced"),
+        "transitions": (
+            ("none", "ALLREDUCE_SHIP", "collecting"),
+            ("none", "ALLGATHER_SHIP", "collecting"),
+            ("collecting", "ALLREDUCE_SHIP", "reduced"),
+            ("collecting", "ALLGATHER_SHIP", "reduced"),
+        ),
+        "terminal": ("reduced",),
+        "handlers": {"ALLREDUCE_SHIP": ("_enqueue_collective",),
+                     "ALLGATHER_SHIP": ("_enqueue_collective",)},
+    },
+}
 
 
 def _dtype_of(arr: np.ndarray) -> str:
@@ -460,8 +589,12 @@ def q8_decode(raw, desc: Dict[str, Any], dequant: bool = True):
     if dtype not in Q8_DTYPES:
         raise ValueError(f"q8 buffer with non-quantizable dtype {dtype}")
     block = int(desc.get("q8_block") or 0)
-    if block <= 0:
-        raise ValueError("q8 buffer without a positive q8_block")
+    if block <= 0 or block > Q8_MAX_BLOCK:
+        # the upper bound matters as much as the lower one: the scale
+        # array is np.repeat-expanded by `block`, so a huge declared
+        # block would allocate ~block extra floats per scale even when
+        # the dequantized output itself is within MAX_BUFFER_BYTES
+        raise ValueError("q8 buffer q8_block outside (0, Q8_MAX_BLOCK]")
     shape = desc["shape"]
     n = 1
     for dim in shape:

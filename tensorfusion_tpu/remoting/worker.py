@@ -162,6 +162,12 @@ class _MigrationSession:
         self.started_m = time.monotonic()
         #: set by MIGRATE_FREEZE — the start of the tenant-dark window
         self.freeze_m: Optional[float] = None
+        #: protocol.SESSION_PROTOCOLS["migration"] state — a session
+        #: exists only in "live"/"frozen"; the terminal writes
+        #: ("committed"/"aborted") happen as MIGRATE_COMMIT clears the
+        #: worker's slot (tpflint's protocol-session walks the
+        #: handlers against the declared machine)
+        self.state = "live"
         self._mint = itertools.count(1)
 
     def mint(self, tag: str) -> str:
@@ -1905,7 +1911,10 @@ class RemoteVTPUWorker:
             self.engine.freeze()
         with self._lock:
             sess = self._mig_session
-            if sess is not None and sess.freeze_m is None:
+            if sess is not None and sess.state == "live":
+                # live -> frozen; a repeated FREEZE is tolerated but
+                # must not restart the pause clock
+                sess.state = "frozen"
                 sess.freeze_m = time.monotonic()
             shipped = sess.shipped_gen if sess is not None else 0
             dirty = [self._buffers[bid]
@@ -1946,6 +1955,7 @@ class RemoteVTPUWorker:
                             [], want_reply=False)
                 except (ConnectionError, OSError):
                     pass    # target gone: nothing left to clean there
+                sess.state = "aborted"
                 sess.close()
             with self._lock:
                 self._mig_stats["aborted_total"] += 1
@@ -1957,7 +1967,7 @@ class RemoteVTPUWorker:
                   {"error": "MIGRATE_COMMIT without a live migration "
                             "session (send SNAPSHOT_DELTA first)"}, [])
             return
-        if self._mig_thaw.is_set():
+        if sess.state != "frozen" or self._mig_thaw.is_set():
             with self._lock:
                 self._mig_session = sess    # still live: not consumed
             reply("ERROR",
@@ -2025,6 +2035,7 @@ class RemoteVTPUWorker:
                "raw_bytes": sess.raw_bytes,
                "wire_bytes": sess.wire_bytes,
                "final_round": final}
+        sess.state = "committed"
         sess.close()
         self._mig_thaw_now()
         reply("MIGRATE_COMMIT_OK", out, [])
@@ -2090,6 +2101,9 @@ class RemoteVTPUWorker:
                     self._exe_blobs[eid] = blob
                     self._exe_costs[eid] = mflops
             else:
+                # bytearray(blob) copies an already-admitted buffer —
+                # its length was bounded at PUT time, not amplifiable
+                # tpflint: disable=untrusted-wire-input
                 exported = jax.export.deserialize(bytearray(blob))
                 if exported.nr_devices > 1:
                     entry = self._build_sharded(exported)

@@ -47,6 +47,19 @@ log = logging.getLogger("tpf.sim")
 #: loud failure, not an infinite sim)
 PUMP_MAX_ROUNDS = 500
 
+#: Determinism roots for tpflint's sim-nondeterminism checker: fnmatch
+#: patterns over module-qualified names.  Everything the call graph can
+#: reach from these must be seed-deterministic — log/trace/profile
+#: digests replay byte-for-byte from a seed, so unseeded randomness,
+#: wall-clock reads into recorded state, and set-iteration order leaks
+#: anywhere downstream of these entry points are lint failures, not
+#: style nits.  Extending the sim surface?  Add the new entry point
+#: here so the checker walks it.
+SIM_ENTRY_POINTS = (
+    "tensorfusion_tpu.sim.harness.SimHarness.*",
+    "tensorfusion_tpu.sim.scenarios.*",
+)
+
 
 class SimHarness:
     def __init__(self, seed: int = 0, sync_interval_s: float = 2.0,

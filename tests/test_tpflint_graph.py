@@ -645,7 +645,8 @@ def test_json_format_clean_tree_ok(tmp_path, monkeypatch, capsys):
 
 @pytest.mark.parametrize("check", [
     "lock-order-inversion", "transitive-blocking-under-lock",
-    "swallowed-error", "unjoined-thread", "leaked-resource"])
+    "swallowed-error", "unjoined-thread", "leaked-resource",
+    "untrusted-wire-input", "protocol-session", "sim-nondeterminism"])
 def test_repo_is_clean_at_head_per_graph_checker(check):
     findings = run_paths(["tensorfusion_tpu", "tools"], REPO,
                          checks={check}, use_cache=False)
@@ -655,14 +656,15 @@ def test_repo_is_clean_at_head_per_graph_checker(check):
     assert new == [], [f.render() for f in new]
 
 
-def test_all_fourteen_checkers_registered():
+def test_all_seventeen_checkers_registered():
     assert set(ALL_CHECKS) == {
         "stale-write-back", "frozen-view-mutation", "blocking-under-lock",
         "guarded-field", "protocol-exhaustive", "metrics-schema",
         "trace-schema", "lock-order-inversion",
         "transitive-blocking-under-lock", "swallowed-error",
         "unjoined-thread", "leaked-resource", "wall-clock-direct",
-        "shard-routing"}
+        "shard-routing", "untrusted-wire-input", "protocol-session",
+        "sim-nondeterminism"}
 
 
 def test_chain_of_shapes():

@@ -270,9 +270,26 @@ def cmd_diff(args) -> int:
     return 0
 
 
+def _git_head() -> str:
+    """Short sha of HEAD, or '?' outside a repo / without git — the
+    provenance listing is advisory, never a failure."""
+    import subprocess
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=5,
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+        return out.stdout.strip() or "?"
+    except (OSError, subprocess.TimeoutExpired):
+        return "?"
+
+
 def cmd_provenance(args) -> int:
     """Every artifact whose evidence is not real-chip: the mechanical
-    revalidation list for the next hardware window."""
+    revalidation list for the next hardware window.  The report is
+    stamped with the working tree's HEAD so 'which commit was this
+    list generated against' survives a copy-paste into an issue."""
     results_dir = pathlib.Path(os.environ.get("TPF_BENCH_RESULTS_DIR",
                                               "") or RESULTS_DIR)
     rows = []
@@ -286,10 +303,12 @@ def cmd_provenance(args) -> int:
         ev = _evidence(doc)
         if ev != "tpu":
             rows.append((path.name, ev, doc.get("commit") or "?"))
+    head = _git_head()
     if not rows:
-        print("bench-provenance: every artifact carries real-chip "
-              "evidence")
+        print(f"bench-provenance: every artifact carries real-chip "
+              f"evidence (HEAD {head})")
         return 0
+    print(f"bench-provenance @ HEAD {head}")
     print(f"{'ARTIFACT':<24}{'EVIDENCE':<34}{'COMMIT':<12}")
     for name, ev, commit in rows:
         print(f"{name:<24}{ev:<34}{commit:<12}")

@@ -8,6 +8,13 @@ fingerprints and interprocedural witness chains, baseline verdict,
 cache counters) so CI and tooling consume results without scraping
 text.  ``--verbose`` prints the graph layer's cache hit/miss counters;
 ``--no-cache`` (or ``TPF_LINT_NO_CACHE=1``) forces full re-extraction.
+
+``--max-seconds S`` is the perf budget gate: the run fails (exit 1)
+if the lint itself took longer than S wall seconds, even when the
+findings are clean — the JSON payload records ``seconds`` /
+``max_seconds`` either way.  ``make lint`` pins the budget (8s cold,
+4s warm) so checker-suite growth that would make lint unaffordable
+fails CI instead of quietly eroding the edit loop.
 """
 
 from __future__ import annotations
@@ -16,6 +23,7 @@ import argparse
 import json
 import os
 import sys
+import time
 
 from .checkers import ALL_CHECKS
 from .core import (apply_baseline, load_baseline, run_paths,
@@ -49,6 +57,12 @@ def main(argv=None) -> int:
     parser.add_argument("--no-cache", action="store_true",
                         help="bypass the graph facts cache "
                              "(TPF_LINT_NO_CACHE=1 does the same)")
+    parser.add_argument("--max-seconds", type=float, default=None,
+                        metavar="S",
+                        help="wall-time budget: exit 1 if the run takes "
+                             "longer than S seconds, even when clean "
+                             "(keeps `make lint` honest as the suite "
+                             "grows)")
     parser.add_argument("-v", "--verbose", action="store_true",
                         help="print cache hit/miss counters")
     parser.add_argument("--list-checks", action="store_true")
@@ -69,8 +83,10 @@ def main(argv=None) -> int:
 
     checks = set(args.check) if args.check else None
     stats: dict = {}
+    t0 = time.monotonic()
     findings = run_paths(paths, repo_root, checks=checks,
                          use_cache=not args.no_cache, stats=stats)
+    stats["seconds"] = round(time.monotonic() - t0, 3)
 
     if args.verbose and stats:
         print(f"tpflint: graph cache: {stats.get('cache_hits', 0)} "
@@ -85,20 +101,24 @@ def main(argv=None) -> int:
 
     if args.no_baseline:
         if args.format == "json":
-            print(json.dumps(_report(findings, [], [], stats),
-                             indent=2))
-        else:
-            for f in findings:
-                print(f.render())
-            print(f"tpflint: {len(findings)} finding(s)")
-        return 1 if findings else 0
+            print(json.dumps(
+                _report(findings, [], [], stats, args.max_seconds),
+                indent=2))
+            return 1 if (findings or
+                         _over_budget(args, stats, quiet=True)) else 0
+        for f in findings:
+            print(f.render())
+        print(f"tpflint: {len(findings)} finding(s)")
+        return 1 if (findings or _over_budget(args, stats)) else 0
 
     baseline = load_baseline(args.baseline)
     new, stale = apply_baseline(findings, baseline)
     if args.format == "json":
-        print(json.dumps(_report(findings, new, stale, stats),
-                         indent=2))
-        return 1 if (new or stale) else 0
+        print(json.dumps(
+            _report(findings, new, stale, stats, args.max_seconds),
+            indent=2))
+        return 1 if (new or stale or
+                     _over_budget(args, stats, quiet=True)) else 0
     for f in new:
         print(f.render())
     for fp in stale:
@@ -114,6 +134,8 @@ def main(argv=None) -> int:
                   f"shrank, lock it in (python -m tools.tpflint "
                   f"--update-baseline)")
         return 1
+    if _over_budget(args, stats):
+        return 1
     print(f"tpflint: PASS ({len(findings)} baselined finding(s), "
           f"{len(ALL_CHECKS) if checks is None else len(checks)} "
           f"checkers)" if findings else
@@ -123,9 +145,27 @@ def main(argv=None) -> int:
     return 0
 
 
-def _report(findings, new, stale, stats) -> dict:
+def _over_budget(args, stats, quiet: bool = False) -> bool:
+    """True when --max-seconds was given and the run blew it.  The
+    budget failure is loud even on an otherwise-clean run: a lint
+    suite nobody can afford to run stops being run."""
+    if args.max_seconds is None:
+        return False
+    took = stats.get("seconds", 0.0)
+    if took <= args.max_seconds:
+        return False
+    if not quiet:
+        print(f"tpflint: FAIL — run took {took:.2f}s, over the "
+              f"--max-seconds {args.max_seconds:g}s budget (profile "
+              f"the checkers or raise the budget deliberately)")
+    return True
+
+
+def _report(findings, new, stale, stats, max_seconds=None) -> dict:
     """The --format=json payload: everything the text mode prints,
     structured."""
+    seconds = stats.get("seconds", 0.0)
+    over = max_seconds is not None and seconds > max_seconds
     return {
         "version": 1,
         "findings": [f.to_dict() for f in findings],
@@ -135,7 +175,9 @@ def _report(findings, new, stale, stats) -> dict:
                    "stale": len(stale)},
         "cache": {"hits": stats.get("cache_hits", 0),
                   "misses": stats.get("cache_misses", 0)},
-        "ok": not new and not stale,
+        "seconds": seconds,
+        "max_seconds": max_seconds,
+        "ok": not new and not stale and not over,
     }
 
 

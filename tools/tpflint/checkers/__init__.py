@@ -10,16 +10,19 @@ docs/static-analysis.md documents the contract.
 
 from . import (blocking_under_lock, frozen_view_mutation, guarded_fields,
                leaked_resource, lock_order, metrics_schema,
-               protocol_exhaustive, shard_routing, stale_write_back,
-               swallowed_error, trace_schema, transitive_blocking,
-               unjoined_thread, wall_clock)
+               protocol_exhaustive, protocol_session, shard_routing,
+               sim_determinism, stale_write_back, swallowed_error,
+               trace_schema, transitive_blocking, unjoined_thread,
+               untrusted_wire, wall_clock)
 
 FILE_CHECKERS = (stale_write_back, frozen_view_mutation,
                  blocking_under_lock, guarded_fields, wall_clock,
                  shard_routing)
-PROJECT_CHECKERS = (protocol_exhaustive, metrics_schema, trace_schema)
+PROJECT_CHECKERS = (protocol_exhaustive, metrics_schema, trace_schema,
+                    protocol_session)
 GRAPH_CHECKERS = (lock_order, transitive_blocking, swallowed_error,
-                  unjoined_thread, leaked_resource)
+                  unjoined_thread, leaked_resource, untrusted_wire,
+                  sim_determinism)
 
 ALL_CHECKS = tuple(sorted(
     c.CHECK for c in FILE_CHECKERS + PROJECT_CHECKERS + GRAPH_CHECKERS))

@@ -31,7 +31,7 @@ import ast
 import re
 from typing import List
 
-from ..core import Finding, SourceFile, dotted_tail, iter_functions
+from ..core import Finding, SourceFile, dotted_tail
 from .stale_write_back import _is_store
 
 CHECK = "blocking-under-lock"
@@ -107,13 +107,8 @@ def _scan_stmt(sf: SourceFile, symbol: str, stmt, lock_name: str,
 
 def run_file(sf: SourceFile) -> List[Finding]:
     findings: List[Finding] = []
-    for symbol, fn in iter_functions(sf.tree):
-        for node in ast.walk(fn):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                    and node is not fn:
-                continue
-            if not isinstance(node, (ast.With, ast.AsyncWith)):
-                continue
+    for symbol, fn in sf.functions():
+        for node in sf.typed_in((ast.With, ast.AsyncWith), fn):
             for item in node.items:
                 if _is_lockish(item.context_expr):
                     lock_name = ast.unparse(item.context_expr)

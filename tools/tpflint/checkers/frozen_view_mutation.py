@@ -40,7 +40,7 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional
 
-from ..core import Finding, SourceFile, dotted_tail, iter_functions
+from ..core import Finding, SourceFile, dotted_tail
 
 CHECK = "frozen-view-mutation"
 
@@ -271,7 +271,7 @@ class _FunctionScan:
 
 def run_file(sf: SourceFile) -> List[Finding]:
     findings: List[Finding] = []
-    for symbol, fn in iter_functions(sf.tree):
+    for symbol, fn in sf.functions():
         scan = _FunctionScan(sf, symbol)
         scan.run(fn.body)
         findings.extend(scan.findings)

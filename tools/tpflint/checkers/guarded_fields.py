@@ -82,11 +82,11 @@ class _ClassScan:
         for method in self.cls.body:
             if not isinstance(method, ast.FunctionDef):
                 continue
-            for stmt in ast.walk(method):
-                targets = []
+            for stmt in self.sf.typed_in((ast.Assign, ast.AnnAssign),
+                                         method):
                 if isinstance(stmt, ast.Assign):
                     targets = stmt.targets
-                elif isinstance(stmt, ast.AnnAssign):
+                else:
                     targets = [stmt.target]
                 for t in targets:
                     field = _self_attr(t)
@@ -165,9 +165,7 @@ class _ClassScan:
 
 def run_file(sf: SourceFile) -> List[Finding]:
     findings: List[Finding] = []
-    for node in ast.walk(sf.tree):
-        if not isinstance(node, ast.ClassDef):
-            continue
+    for node in sf.typed(ast.ClassDef):
         scan = _ClassScan(sf, node)
         scan.collect_guards()
         scan.check()

@@ -33,7 +33,7 @@ import ast
 import os
 from typing import Dict, List, Optional, Set, Tuple
 
-from ..core import Finding, SourceFile, dotted_tail, iter_functions
+from ..core import Finding, SourceFile, dotted_tail
 
 CHECK = "metrics-schema"
 
@@ -80,12 +80,12 @@ def parse_schema(sf: SourceFile) -> Optional[Dict[str, Dict[str, tuple]]]:
 class _Resolver:
     """Static tag/field-dict key resolution within one function."""
 
-    def __init__(self, fn: ast.AST):
+    def __init__(self, fn_nodes):
         #: name -> [(lineno, value-node-or-None)], lineno-sorted
         self.bindings: Dict[str, List[Tuple[int, Optional[ast.AST]]]] = {}
         #: name -> [(lineno, key)] for name["key"] = ... adds
         self.sub_adds: Dict[str, List[Tuple[int, str]]] = {}
-        for node in ast.walk(fn):
+        for node in fn_nodes:
             if isinstance(node, ast.Assign):
                 for t in node.targets:
                     self._bind_target(t, node.value, node.lineno)
@@ -171,12 +171,12 @@ def _emit_sites(sf: SourceFile):
     Innermost functions are scanned first so each call is attributed to
     (and resolved within) its tightest enclosing scope; the module tree
     comes last as the catch-all."""
-    contexts = list(iter_functions(sf.tree))[::-1]
+    contexts = list(sf.functions())[::-1]
     contexts.append(("<module>", sf.tree))
     seen_calls = set()
     for symbol, fn in contexts:
-        for node in ast.walk(fn):
-            if isinstance(node, ast.Call) and id(node) not in seen_calls:
+        for node in sf.typed_in(ast.Call, fn):
+            if id(node) not in seen_calls:
                 fname = dotted_tail(node.func)
                 is_insert = (fname == "insert"
                              and isinstance(node.func, ast.Attribute)
@@ -202,7 +202,7 @@ def _consumer_sites(sf: SourceFile):
     must name a declared measurement, or the renamed series leaves a
     silently-dead checker behind).  ``field`` is None for
     measurement-only sites."""
-    for node in ast.walk(sf.tree):
+    for node in sf.typed((ast.Subscript, ast.Call)):
         if isinstance(node, ast.Subscript) and \
                 dotted_tail(node.value) == "METRICS_SCHEMA" and \
                 isinstance(node.slice, ast.Constant) and \
@@ -299,7 +299,7 @@ def run_project(files: Dict[str, SourceFile], repo_root: str
                 continue
             resolver = resolvers.get(id(fn))
             if resolver is None:
-                resolver = resolvers[id(fn)] = _Resolver(fn)
+                resolver = resolvers[id(fn)] = _Resolver(sf.fn_nodes(fn))
             all_complete = True
             for kind, arg in (("tags", tags_node), ("fields", fields_node)):
                 static, cond, complete = resolver.keys_of(arg, node.lineno)

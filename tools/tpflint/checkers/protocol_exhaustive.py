@@ -87,7 +87,7 @@ def _compared_kinds(sf: SourceFile,
                     ) -> Set[str]:
     """String constants compared against a ``kind``/``rkind`` variable."""
     out: Set[str] = set()
-    for node in ast.walk(sf.tree):
+    for node in sf.nodes:
         if not isinstance(node, ast.Compare):
             continue
         sides = [node.left] + list(node.comparators)
@@ -109,7 +109,7 @@ def _compared_kinds(sf: SourceFile,
 def _emitted_replies(sf: SourceFile) -> Set[str]:
     """First string arg of reply(...)/_safe_reply(item, ...) calls."""
     out: Set[str] = set()
-    for node in ast.walk(sf.tree):
+    for node in sf.nodes:
         if not isinstance(node, ast.Call):
             continue
         fname = node.func.attr if isinstance(node.func, ast.Attribute) \
@@ -127,7 +127,7 @@ def _emitted_replies(sf: SourceFile) -> Set[str]:
 
 def _sent_kinds(sf: SourceFile) -> Set[str]:
     out: Set[str] = set()
-    for node in ast.walk(sf.tree):
+    for node in sf.nodes:
         if not isinstance(node, ast.Call):
             continue
         fname = node.func.attr if isinstance(node.func, ast.Attribute) \
@@ -145,7 +145,7 @@ def _sent_kinds(sf: SourceFile) -> Set[str]:
 def _emitted_codes(sf: SourceFile) -> Set[str]:
     """Values of ``"code": <const>`` entries in dict literals."""
     out: Set[str] = set()
-    for node in ast.walk(sf.tree):
+    for node in sf.nodes:
         if not isinstance(node, ast.Dict):
             continue
         for k, v in zip(node.keys, node.values):
@@ -158,7 +158,7 @@ def _emitted_codes(sf: SourceFile) -> Set[str]:
 
 def _compared_codes(sf: SourceFile) -> Set[str]:
     out: Set[str] = set()
-    for node in ast.walk(sf.tree):
+    for node in sf.nodes:
         if not isinstance(node, ast.Compare):
             continue
         sides = [node.left] + list(node.comparators)
@@ -176,7 +176,7 @@ def _enc_assigned(sf: SourceFile) -> Set[str]:
     encoder arms (handles both ``enc = "raw"`` and the tuple form
     ``enc, wire = "q8", view``)."""
     out: Set[str] = set()
-    for node in ast.walk(sf.tree):
+    for node in sf.nodes:
         if not isinstance(node, ast.Assign) or len(node.targets) != 1:
             continue
         target, value = node.targets[0], node.value
@@ -199,7 +199,7 @@ def _enc_compared(sf: SourceFile) -> Set[str]:
     """String literals compared against a variable named ``enc`` — the
     decoder arms."""
     out: Set[str] = set()
-    for node in ast.walk(sf.tree):
+    for node in sf.nodes:
         if not isinstance(node, ast.Compare):
             continue
         sides = [node.left] + list(node.comparators)

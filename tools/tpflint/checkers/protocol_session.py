@@ -1,0 +1,340 @@
+"""protocol-session: session-oriented opcode families follow their
+declared state machine.
+
+Streaming migration is not three independent opcodes — it is a
+*session*: SNAPSHOT_DELTA rounds create and advance it, MIGRATE_FREEZE
+moves it to "frozen", exactly one MIGRATE_COMMIT consumes it
+(committed or aborted), and the error arms must put it *back* instead
+of dropping it.  protocol-exhaustive proves each opcode is wired;
+nothing proved the *sequencing* until this checker: the machine is
+declared in ``SESSION_PROTOCOLS`` (remoting/protocol.py, next to
+REQUEST_KINDS) and verified statically:
+
+- **machine sanity** (every family): transition endpoints are declared
+  states, every state is reachable from "none", and no transition
+  leaves a terminal state (terminal re-entry is a declaration bug);
+- **handler existence**: every opcode's declared handler functions
+  exist in the family's module;
+- **handler walk** (families declaring ``attr`` + ``slot``): each
+  ``<sess>.state = "<to>"`` write inside a handler must match a
+  declared transition for that handler's opcode; a handler for an
+  opcode with no from-"none" transition (it *requires* a session in a
+  specific state) must guard on ``.state`` against a declared
+  from-state — deleting the ``sess.state == "live"`` check in
+  MIGRATE_FREEZE fails lint with a witness naming the handler, the
+  write and the machine; an opcode with a terminal transition must
+  clear the session slot somewhere in its handler (a terminal exit
+  that keeps the slot leaks the session); and the slot is assigned a
+  non-None value only in ``creators``/``restores`` members.
+
+Families without ``attr`` (the GENERATE/KV_SHIP stream legs, the
+federation SHIP legs) get declaration + handler-existence checks: the
+machine documents the stream shape and reserves the name for when
+they grow explicit session objects.
+
+Fixture trees satisfy the contract with files whose paths end in
+``remoting/protocol.py`` / the declared module suffix; with no
+protocol module in the analyzed set the checker is silent.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Finding, SourceFile
+
+CHECK = "protocol-session"
+
+PROTOCOL_SUFFIX = "remoting/protocol.py"
+REGISTRY = "SESSION_PROTOCOLS"
+
+
+def _find(files: Dict[str, SourceFile], suffix: str
+          ) -> Optional[SourceFile]:
+    for rel, sf in files.items():
+        if rel.endswith(suffix):
+            return sf
+    return None
+
+
+def _registry(sf: SourceFile) -> Tuple[Optional[dict], int]:
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == REGISTRY:
+            try:
+                return ast.literal_eval(node.value), node.lineno
+            except ValueError:
+                return None, node.lineno
+    return None, 1
+
+
+def _fn_index(sf: SourceFile) -> Dict[str, Tuple[str, ast.AST]]:
+    """method-name -> (qualified symbol, def node); last wins, which
+    is fine — handler names are unique per module."""
+    out: Dict[str, Tuple[str, ast.AST]] = {}
+    for symbol, fn in sf.functions():
+        out[fn.name] = (symbol, fn)
+    return out
+
+
+def _state_writes(sf: SourceFile, fn: ast.AST, attr: str
+                  ) -> List[Tuple[int, str]]:
+    """(line, value) for every ``<x>.<attr> = "const"`` in the
+    handler."""
+    out: List[Tuple[int, str]] = []
+    for node in sf.typed_in(ast.Assign, fn):
+        for t in node.targets:
+            if isinstance(t, ast.Attribute) and t.attr == attr and \
+                    isinstance(node.value, ast.Constant) and \
+                    isinstance(node.value.value, str):
+                out.append((node.lineno, node.value.value))
+    return out
+
+
+def _state_guards(sf: SourceFile, fn: ast.AST, attr: str) -> Set[str]:
+    """State constants a handler compares ``.<attr>`` against
+    (``==``/``!=``/``in``)."""
+    out: Set[str] = set()
+    for node in sf.typed_in(ast.Compare, fn):
+        sides = [node.left] + list(node.comparators)
+        if not any(isinstance(s, ast.Attribute) and s.attr == attr
+                   for s in sides):
+            continue
+        for s in sides:
+            if isinstance(s, ast.Constant) and isinstance(s.value, str):
+                out.add(s.value)
+            elif isinstance(s, (ast.Tuple, ast.List, ast.Set)):
+                out.update(e.value for e in s.elts
+                           if isinstance(e, ast.Constant)
+                           and isinstance(e.value, str))
+    return out
+
+
+def _slot_assigns(sf: SourceFile, fn: ast.AST, slot: str
+                  ) -> List[Tuple[int, bool]]:
+    """(line, assigns_none) for every write to ``self.<slot>`` —
+    including the tuple-swap ``sess, self._mig_session = ..., None``
+    consume idiom."""
+    out: List[Tuple[int, bool]] = []
+    for node in sf.typed_in(ast.Assign, fn):
+        targets = node.targets
+        if len(targets) == 1 and isinstance(targets[0], ast.Tuple) and \
+                isinstance(node.value, ast.Tuple) and \
+                len(targets[0].elts) == len(node.value.elts):
+            pairs = list(zip(targets[0].elts, node.value.elts))
+        else:
+            pairs = [(t, node.value) for t in targets]
+        for t, v in pairs:
+            if isinstance(t, ast.Attribute) and t.attr == slot and \
+                    isinstance(t.value, ast.Name) and \
+                    t.value.id == "self":
+                is_none = isinstance(v, ast.Constant) and v.value is None
+                out.append((node.lineno, is_none))
+    return out
+
+
+def _check_machine(name: str, fam: dict, sf: SourceFile, line: int,
+                   findings: List[Finding]) -> bool:
+    """Declaration-level sanity; returns False when the shape is too
+    broken to walk handlers against."""
+    states = fam.get("states")
+    transitions = fam.get("transitions")
+    if not isinstance(states, (tuple, list)) or \
+            not isinstance(transitions, (tuple, list)):
+        findings.append(Finding(
+            check=CHECK, path=sf.relpath, line=line, symbol=REGISTRY,
+            key=f"{name}:shape",
+            message=(f"SESSION_PROTOCOLS[{name!r}] needs literal "
+                     f"`states` and `transitions` tuples (docs/"
+                     f"static-analysis.md)")))
+        return False
+    declared = set(states)
+    terminal = set(fam.get("terminal", ()))
+    ok = True
+    for t in transitions:
+        if not (isinstance(t, (tuple, list)) and len(t) == 3):
+            ok = False
+            continue
+        frm, op, to = t
+        for s in (frm, to):
+            if s not in declared:
+                ok = False
+                findings.append(Finding(
+                    check=CHECK, path=sf.relpath, line=line,
+                    symbol=REGISTRY, key=f"{name}:undeclared:{s}",
+                    message=(f"session family {name!r}: transition "
+                             f"({frm!r}, {op!r}, {to!r}) uses state "
+                             f"{s!r} not in `states` — declare it or "
+                             f"fix the transition")))
+        if frm in terminal:
+            findings.append(Finding(
+                check=CHECK, path=sf.relpath, line=line,
+                symbol=REGISTRY, key=f"{name}:terminal-exit:{frm}",
+                message=(f"session family {name!r}: transition out of "
+                         f"terminal state {frm!r} ({frm!r} --{op}--> "
+                         f"{to!r}) — terminal means the session is "
+                         f"consumed; re-entry needs a fresh session "
+                         f"from \"none\"")))
+    for s in sorted(terminal - declared):
+        findings.append(Finding(
+            check=CHECK, path=sf.relpath, line=line, symbol=REGISTRY,
+            key=f"{name}:undeclared:{s}",
+            message=(f"session family {name!r}: terminal state {s!r} "
+                     f"is not in `states`")))
+    # reachability from "none"
+    reach = {"none"}
+    grew = True
+    while grew:
+        grew = False
+        for t in transitions:
+            if isinstance(t, (tuple, list)) and len(t) == 3 and \
+                    t[0] in reach and t[2] not in reach:
+                reach.add(t[2])
+                grew = True
+    for s in sorted(declared - reach):
+        findings.append(Finding(
+            check=CHECK, path=sf.relpath, line=line, symbol=REGISTRY,
+            key=f"{name}:unreachable:{s}",
+            message=(f"session family {name!r}: state {s!r} is "
+                     f"unreachable from \"none\" — dead state or "
+                     f"missing transition")))
+    return ok
+
+
+def _check_handlers(name: str, fam: dict, proto_sf: SourceFile,
+                    reg_line: int, files: Dict[str, SourceFile],
+                    findings: List[Finding]) -> None:
+    module = fam.get("module")
+    handlers = fam.get("handlers")
+    if not module or not isinstance(handlers, dict):
+        return
+    sf = _find(files, module)
+    if sf is None:
+        return      # fixture run without the family's module
+    fns = _fn_index(sf)
+    attr = fam.get("attr")
+    slot = fam.get("slot")
+    transitions = [t for t in fam.get("transitions", ())
+                   if isinstance(t, (tuple, list)) and len(t) == 3]
+    terminal = set(fam.get("terminal", ()))
+    allowed_assign = set(fam.get("creators", ())) | \
+        set(fam.get("restores", ()))
+
+    for op, fn_names in sorted(handlers.items()):
+        froms = {t[0] for t in transitions if t[1] == op}
+        tos = {t[2] for t in transitions if t[1] == op}
+        needs_guard = attr is not None and froms and "none" not in froms
+        guard_states: Set[str] = set()
+        clears_slot = False
+        present = []
+        for fname in fn_names:
+            ent = fns.get(fname)
+            if ent is None:
+                findings.append(Finding(
+                    check=CHECK, path=proto_sf.relpath, line=reg_line,
+                    symbol=REGISTRY, key=f"{name}:{op}:missing:{fname}",
+                    message=(f"session family {name!r}: declared "
+                             f"handler {fname}() for {op} does not "
+                             f"exist in {module} — the machine and "
+                             f"the code disagree")))
+                continue
+            present.append(ent)
+            symbol, fn = ent
+            if attr:
+                for line, value in _state_writes(sf, fn, attr):
+                    if value not in tos:
+                        findings.append(Finding(
+                            check=CHECK, path=sf.relpath, line=line,
+                            symbol=symbol,
+                            key=f"{name}:{op}:bad-write:{value}",
+                            message=(
+                                f"{symbol} writes session .{attr} = "
+                                f"{value!r} but SESSION_PROTOCOLS"
+                                f"[{name!r}] declares no transition "
+                                f"(*, {op}, {value!r}) — add the "
+                                f"transition or fix the handler"),
+                            witness=[
+                                f"{symbol} [{sf.relpath}:{fn.lineno}]"
+                                f" (handles {op})",
+                                f"{symbol} [{sf.relpath}:{line}] "
+                                f"(.{attr} = {value!r})",
+                                f"{REGISTRY}[{name!r}] "
+                                f"[{proto_sf.relpath}:{reg_line}] "
+                                f"(declares {op}: "
+                                f"{sorted(froms)} -> {sorted(tos)})"]))
+                guard_states |= _state_guards(sf, fn, attr)
+            if slot:
+                for line, is_none in _slot_assigns(sf, fn, slot):
+                    if is_none:
+                        clears_slot = True
+                    elif fname not in allowed_assign:
+                        findings.append(Finding(
+                            check=CHECK, path=sf.relpath, line=line,
+                            symbol=symbol,
+                            key=f"{name}:{op}:rogue-assign",
+                            message=(
+                                f"{symbol} installs a session into "
+                                f"self.{slot} but is not declared in "
+                                f"SESSION_PROTOCOLS[{name!r}] "
+                                f"creators/restores — sessions are "
+                                f"created by the from-\"none\" "
+                                f"transition and restored only by "
+                                f"declared error arms")))
+        if not present:
+            continue
+        if needs_guard and not (guard_states & froms):
+            symbol, fn = present[0]
+            findings.append(Finding(
+                check=CHECK, path=sf.relpath, line=fn.lineno,
+                symbol=symbol, key=f"{name}:{op}:unguarded",
+                message=(
+                    f"{symbol} handles {op}, which "
+                    f"SESSION_PROTOCOLS[{name!r}] only allows from "
+                    f"state(s) {sorted(froms)}, but never compares "
+                    f"the session's .{attr} against them — a "
+                    f"repeated/out-of-order {op} would run its "
+                    f"transition twice (guard with `.{attr} == "
+                    f"{sorted(froms)[0]!r}` before acting)"),
+                witness=[
+                    f"{symbol} [{sf.relpath}:{fn.lineno}] (handles "
+                    f"{op}; no .{attr} guard found)",
+                    f"{REGISTRY}[{name!r}] "
+                    f"[{proto_sf.relpath}:{reg_line}] (declares "
+                    f"{op} from {sorted(froms)})"]))
+        if slot and attr and (tos & terminal) and not clears_slot:
+            symbol, fn = present[0]
+            findings.append(Finding(
+                check=CHECK, path=sf.relpath, line=fn.lineno,
+                symbol=symbol, key=f"{name}:{op}:leak",
+                message=(
+                    f"{symbol} handles {op}, whose transitions reach "
+                    f"terminal state(s) {sorted(tos & terminal)}, but "
+                    f"never clears self.{slot} — a consumed session "
+                    f"left in the slot leaks it and wedges the next "
+                    f"session's from-\"none\" creation"),
+                witness=[
+                    f"{symbol} [{sf.relpath}:{fn.lineno}] (handles "
+                    f"{op}; no `self.{slot} = None` on any path)",
+                    f"{REGISTRY}[{name!r}] "
+                    f"[{proto_sf.relpath}:{reg_line}] (declares "
+                    f"terminal {sorted(terminal)})"]))
+
+
+def run_project(files: Dict[str, SourceFile], repo_root: str
+                ) -> List[Finding]:
+    proto = _find(files, PROTOCOL_SUFFIX)
+    if proto is None:
+        return []
+    registry, line = _registry(proto)
+    if registry is None:
+        return []
+    findings: List[Finding] = []
+    for name in sorted(registry):
+        fam = registry[name]
+        if not isinstance(fam, dict):
+            continue
+        if _check_machine(name, fam, proto, line, findings):
+            _check_handlers(name, fam, proto, line, files, findings)
+    return findings

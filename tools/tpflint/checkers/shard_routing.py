@@ -30,7 +30,7 @@ from __future__ import annotations
 import ast
 from typing import List
 
-from ..core import Finding, SourceFile, dotted_tail, iter_functions
+from ..core import Finding, SourceFile, dotted_tail
 
 CHECK = "shard-routing"
 
@@ -68,9 +68,9 @@ def run_file(sf: SourceFile) -> List[Finding]:
     findings: List[Finding] = []
     covered = set()
 
-    def scan(symbol: str, root: ast.AST) -> None:
-        for node in ast.walk(root):
-            if not isinstance(node, ast.Call) or id(node) in covered:
+    def scan(symbol: str, call_nodes) -> None:
+        for node in call_nodes:
+            if id(node) in covered:
                 continue
             if _construction(node):
                 covered.add(id(node))
@@ -101,8 +101,8 @@ def run_file(sf: SourceFile) -> List[Finding]:
                         f"that dodges it; docs/control-plane-"
                         f"scale.md)")))
 
-    for symbol, fn in iter_functions(sf.tree):
-        scan(symbol, fn)
-    scan("<module>", sf.tree)
+    for symbol, fn in sf.functions():
+        scan(symbol, sf.typed_in(ast.Call, fn))
+    scan("<module>", sf.typed(ast.Call))
     findings.sort(key=lambda f: f.line)
     return findings

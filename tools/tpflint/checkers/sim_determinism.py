@@ -1,0 +1,291 @@
+"""sim-nondeterminism: nondeterminism reachable from the digital twin.
+
+The sim (``tensorfusion_tpu/sim``) is a *deterministic replay* harness:
+``log_digest()`` / ``trace_digest()`` / ``profile_digest()`` fingerprint
+a run, and CI replays scenarios byte-for-byte from a seed.  Any
+nondeterminism in code the harness can reach silently breaks that
+contract — the digest flaps, the flake gets blamed on the scenario, and
+the one property the twin exists to provide (same seed, same run) is
+gone.
+
+The checker walks the call graph from the entry points declared in
+``SIM_ENTRY_POINTS`` (``tensorfusion_tpu/sim/harness.py``, fnmatch
+patterns over module-qualified names) and, in every reachable function,
+flags the four nondeterminism shapes that have actually bitten twin
+harnesses:
+
+- **unseeded-random** — module-level ``random.*`` calls (global RNG
+  state; seeded per-instance ``random.Random(seed)`` is the sanctioned
+  route and is not flagged, nor is ``SystemRandom`` which is explicit
+  about being nondeterministic).
+- **wall-monotonic** — ``time.monotonic()`` / ``perf_counter()`` read
+  into recorded state (an assignment, a return value, or an argument
+  of an ordered sink).  Interval math against the wall clock is
+  harmless until the value lands in a digest; under ``SimClock`` all
+  recorded time must come from ``clock.monotonic()``.  Complements the
+  ``wall-clock-direct`` file checker, which deliberately leaves
+  monotonic/perf_counter alone outside sim-reachable code.
+- **id-order** — ``sort(key=id)`` / ``sorted(..., key=id)``: CPython
+  heap addresses vary run to run.
+- **set-order** — iterating a set-origin iterable (``set()`` /
+  ``frozenset()`` / set literal / set comprehension, directly or via a
+  local assigned from one) into an *ordered sink* (``append``,
+  ``write``, ``log_note``, ...) without ``sorted()``.  Set iteration
+  order is hash-seed dependent; folding it into an ordered record is
+  the classic digest flake.
+
+Findings carry a reachability witness — the call chain from the entry
+point — so "why does the sim care about this function" is answered in
+the finding itself.  If the registry is absent (fixture projects) the
+checker is silent.
+"""
+
+from __future__ import annotations
+
+import ast
+from fnmatch import fnmatchcase
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Finding
+from ..graph import ProjectGraph, Witness
+
+CHECK = "sim-nondeterminism"
+
+HARNESS_SUFFIX = "sim/harness.py"
+REGISTRY = "SIM_ENTRY_POINTS"
+
+#: method tails that impose an order on what they receive — feeding
+#: set-iteration or wall time into one of these records the
+#: nondeterminism instead of just computing with it
+ORDERED_SINKS = frozenset({
+    "append", "appendleft", "write", "emit", "record", "log_note",
+    "insert", "put", "send", "extend", "update",
+})
+
+#: ``random.<attr>`` calls that are fine: explicit per-instance RNG
+#: construction (callers seed it) and the explicitly-nondeterministic
+#: system RNG
+_SEEDED_CTORS = frozenset({"Random", "SystemRandom", "seed"})
+
+_MONO_ATTRS = frozenset({"monotonic", "monotonic_ns",
+                         "perf_counter", "perf_counter_ns"})
+
+
+def _entry_patterns(graph: ProjectGraph) -> Optional[List[str]]:
+    for rel in graph.files:
+        if rel.endswith(HARNESS_SUFFIX):
+            break
+    else:
+        return None
+    for node in graph.files[rel].typed(ast.Assign):
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name) and tgt.id == REGISTRY:
+                try:
+                    val = ast.literal_eval(node.value)
+                except ValueError:
+                    return None
+                return [str(p) for p in val]
+    return None
+
+
+def _reachable(graph: ProjectGraph, patterns: List[str]
+               ) -> Dict[str, Optional[Tuple[str, int]]]:
+    """full-qualname -> (caller full-qualname, call line) — None for
+    entry points.  BFS over resolved call edges, async callback edges
+    included (a timer callback runs inside the sim too)."""
+    parent: Dict[str, Optional[Tuple[str, int]]] = {}
+    queue: List[str] = []
+    for full in sorted(graph.funcs):
+        if any(fnmatchcase(full, p) for p in patterns):
+            parent[full] = None
+            queue.append(full)
+    while queue:
+        full = queue.pop(0)
+        func = graph.funcs[full]
+        for call in func.facts["calls"]:
+            target = graph.resolve_call(func, call["chain"])
+            if target is not None and target not in parent:
+                parent[target] = (full, call["line"])
+                queue.append(target)
+    return parent
+
+
+def _witness(graph: ProjectGraph,
+             parent: Dict[str, Optional[Tuple[str, int]]],
+             full: str, limit: int = 8) -> List[Witness]:
+    frames: List[Witness] = []
+    cur: Optional[str] = full
+    line = graph.funcs[full].line
+    while cur is not None and len(frames) < limit:
+        func = graph.funcs[cur]
+        edge = parent.get(cur)
+        note = "sim entry point" if edge is None else ""
+        frames.append(Witness(func.relpath, line, func.symbol, note))
+        if edge is None:
+            break
+        cur, line = edge
+    frames.reverse()
+    return frames
+
+
+def _module_locals(graph: ProjectGraph, rel: str, module: str
+                   ) -> Set[str]:
+    """Local names in ``rel`` bound to ``module`` (import / alias)."""
+    im = graph.facts[rel]["import_modules"]
+    return {local for local, mod in im.items() if mod == module}
+
+
+def _is_set_expr(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in ("set", "frozenset"))
+
+
+class _FnScan:
+    """One reachable function: collect the four nondeterminism shapes."""
+
+    def __init__(self, sf, fn: ast.AST, rand_locals: Set[str],
+                 time_locals: Set[str]):
+        self.sf = sf
+        self.fn = fn
+        self.rand = rand_locals
+        self.time = time_locals
+        # (kind, line, detail)
+        self.hits: List[Tuple[str, int, str]] = []
+        self._set_names: Set[str] = set()
+        self._mono_lines: Dict[int, str] = {}
+        self._scan()
+
+    def _mono_call(self, node: ast.AST) -> Optional[str]:
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MONO_ATTRS
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in self.time):
+            return f"{node.func.value.id}.{node.func.attr}()"
+        return None
+
+    def _has_mono(self, node: ast.AST) -> Optional[Tuple[int, str]]:
+        for sub in ast.walk(node):
+            what = self._mono_call(sub)
+            if what is not None:
+                return sub.lineno, what
+        return None
+
+    def _scan(self) -> None:
+        fn_nodes = list(self.sf.fn_nodes(self.fn))
+        # pass 1: local set-origin names (straight-line approximation:
+        # a name ever assigned from a set expr is set-origin)
+        for node in fn_nodes:
+            if isinstance(node, ast.Assign) and _is_set_expr(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self._set_names.add(tgt.id)
+        for node in fn_nodes:
+            if isinstance(node, ast.Call):
+                self._call(node)
+            elif isinstance(node, (ast.Assign, ast.AugAssign,
+                                   ast.AnnAssign, ast.Return)):
+                val = getattr(node, "value", None)
+                if val is not None:
+                    hit = self._has_mono(val)
+                    if hit is not None:
+                        self.hits.append(("wall-monotonic", hit[0],
+                                          hit[1]))
+            elif isinstance(node, ast.For):
+                self._for(node)
+
+    def _call(self, node: ast.Call) -> None:
+        func = node.func
+        if (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id in self.rand
+                and func.attr not in _SEEDED_CTORS):
+            self.hits.append(("unseeded-random", node.lineno,
+                              f"{func.value.id}.{func.attr}()"))
+        # sort(key=id) / sorted(..., key=id)
+        is_sort = ((isinstance(func, ast.Attribute)
+                    and func.attr == "sort")
+                   or (isinstance(func, ast.Name)
+                       and func.id == "sorted"))
+        if is_sort:
+            for kw in node.keywords:
+                if (kw.arg == "key" and isinstance(kw.value, ast.Name)
+                        and kw.value.id == "id"):
+                    self.hits.append(("id-order", node.lineno,
+                                      "key=id"))
+        # wall time handed straight to an ordered sink
+        if (isinstance(func, ast.Attribute)
+                and func.attr in ORDERED_SINKS):
+            for arg in node.args:
+                hit = self._has_mono(arg)
+                if hit is not None:
+                    self.hits.append(("wall-monotonic", hit[0],
+                                      f"{hit[1]} -> .{func.attr}()"))
+
+    def _for(self, node: ast.For) -> None:
+        it = node.iter
+        # sorted(...) imposes an order — fine whatever is inside
+        if (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "sorted"):
+            return
+        set_origin = _is_set_expr(it) or (
+            isinstance(it, ast.Name) and it.id in self._set_names)
+        if not set_origin:
+            return
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in ORDERED_SINKS):
+                self.hits.append((
+                    "set-order", node.lineno,
+                    f"set iteration -> .{sub.func.attr}() "
+                    f"[line {sub.lineno}]"))
+                return
+
+
+_ADVICE = {
+    "unseeded-random": ("route randomness through the harness RNG "
+                        "(random.Random(seed) plumbed from the "
+                        "scenario seed)"),
+    "wall-monotonic": ("recorded time must come from clock.monotonic() "
+                       "(the SimClock seam), not the wall clock"),
+    "id-order": ("id() is a heap address — order by a stable key "
+                 "(name, index, creation counter) instead"),
+    "set-order": ("wrap the iterable in sorted(...) before folding it "
+                  "into an ordered record"),
+}
+
+
+def run_graph(graph: ProjectGraph) -> List[Finding]:
+    patterns = _entry_patterns(graph)
+    if not patterns:
+        return []
+    parent = _reachable(graph, patterns)
+    findings: List[Finding] = []
+    for full in sorted(parent):
+        func = graph.funcs[full]
+        sf = graph.files[func.relpath]
+        fn = None
+        for symbol, node in sf.functions():
+            if symbol == func.symbol:
+                fn = node
+                break
+        if fn is None:
+            continue
+        rand_locals = _module_locals(graph, func.relpath, "random")
+        time_locals = _module_locals(graph, func.relpath, "time")
+        scan = _FnScan(sf, fn, rand_locals, time_locals)
+        reach = [w.render() for w in _witness(graph, parent, full)]
+        for kind, line, detail in scan.hits:
+            findings.append(Finding(
+                check=CHECK, path=func.relpath, line=line,
+                symbol=func.symbol, key=f"{kind}:{line}",
+                message=(f"{kind} in sim-reachable code: {detail} — "
+                         f"the twin's digests must be "
+                         f"seed-deterministic; {_ADVICE[kind]}"),
+                witness=reach + [f"{kind}: {detail} "
+                                 f"[{func.relpath}:{line}]"]))
+    return findings

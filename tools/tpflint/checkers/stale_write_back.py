@@ -27,7 +27,7 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Set
 
-from ..core import Finding, SourceFile, dotted_tail, iter_functions
+from ..core import Finding, SourceFile, dotted_tail
 
 CHECK = "stale-write-back"
 
@@ -184,7 +184,7 @@ class _FunctionScan:
 
 def run_file(sf: SourceFile) -> List[Finding]:
     findings: List[Finding] = []
-    for symbol, fn in iter_functions(sf.tree):
+    for symbol, fn in sf.functions():
         scan = _FunctionScan(sf, symbol)
         scan.run(fn.body)
         findings.extend(scan.findings)

@@ -31,7 +31,7 @@ import ast
 import os
 from typing import Dict, List, Optional, Set, Tuple
 
-from ..core import Finding, SourceFile, dotted_tail, iter_functions
+from ..core import Finding, SourceFile, dotted_tail
 
 CHECK = "trace-schema"
 
@@ -81,11 +81,11 @@ def _schema_line(sf: SourceFile, name: str) -> int:
     return 1
 
 
-def _span_calls(node: ast.AST):
-    """Yield every ``<x>.start_span/span/record_span(...)`` Call under
-    ``node``, looking through ternaries/boolean operators (the
+def _span_calls(nodes):
+    """Yield every ``<x>.start_span/span/record_span(...)`` Call in the
+    node iterable, looking through ternaries/boolean operators (the
     ``s = tracer.start_span(...) if tracer else None`` idiom)."""
-    for n in ast.walk(node):
+    for n in nodes:
         if isinstance(n, ast.Call) and \
                 isinstance(n.func, ast.Attribute) and \
                 n.func.attr in _START_METHODS:
@@ -116,13 +116,13 @@ def _attr_keys(call: ast.Call) -> Set[str]:
     return out
 
 
-def _finish_attr_keys(fn: ast.AST, var_names: Set[str],
+def _finish_attr_keys(fn_nodes, var_names: Set[str],
                       span_vars: Dict[str, str]) -> List[Tuple[str, str,
                                                                int]]:
     """(span_name, attr_key, line) for ``v.finish(k=...)`` /
     ``v.set_attr("k", ...)`` calls on known span variables."""
     out = []
-    for n in ast.walk(fn):
+    for n in fn_nodes:
         if not (isinstance(n, ast.Call)
                 and isinstance(n.func, ast.Attribute)
                 and isinstance(n.func.value, ast.Name)
@@ -142,28 +142,28 @@ def _finish_attr_keys(fn: ast.AST, var_names: Set[str],
     return out
 
 
-def _assigned_spans(fn: ast.AST):
+def _assigned_spans(fn_nodes):
     """Yield (var_name, call, assign_node) for
     ``x = <t>.start_span(...)`` assignments (incl. ternary values).
     Only ``start_span`` — ``span`` is a context manager and
     ``record_span`` returns an already-closed dict."""
-    for n in ast.walk(fn):
+    for n in fn_nodes:
         if not isinstance(n, ast.Assign) or len(n.targets) != 1:
             continue
         target = n.targets[0]
         if not isinstance(target, ast.Name):
             continue
-        for call in _span_calls(n.value):
+        for call in _span_calls(ast.walk(n.value)):
             if call.func.attr == "start_span":  # type: ignore[union-attr]
                 yield target.id, call, n
                 break
 
 
-def _escapes(fn: ast.AST, var: str, assign_node: ast.AST) -> bool:
+def _escapes(fn_nodes, var: str, assign_node: ast.AST) -> bool:
     """True when the span variable is finished, returned, stored on an
     object, or passed to another call — any of which hands off the
     finish responsibility."""
-    for n in ast.walk(fn):
+    for n in fn_nodes:
         if n is assign_node:
             continue
         # v.finish(...)
@@ -222,9 +222,8 @@ def run_project(files: Dict[str, SourceFile], repo_root: str
         # literal SPAN_SCHEMA["name"] registry subscripts (runtime
         # consumers reading a span's declared shape, the tpfprof-style
         # site): a renamed span must not leave a stale consumer behind
-        for node in ast.walk(sf.tree):
-            if isinstance(node, ast.Subscript) and \
-                    dotted_tail(node.value) == "SPAN_SCHEMA" and \
+        for node in sf.typed(ast.Subscript):
+            if dotted_tail(node.value) == "SPAN_SCHEMA" and \
                     isinstance(node.slice, ast.Constant) and \
                     isinstance(node.slice.value, str) and \
                     node.slice.value not in schema:
@@ -234,14 +233,16 @@ def run_project(files: Dict[str, SourceFile], repo_root: str
                     message=(f"registry subscript references span "
                              f"{node.slice.value!r} not declared in "
                              f"SPAN_SCHEMA")))
-        contexts = list(iter_functions(sf.tree))[::-1]
+        contexts = list(sf.functions())[::-1]
         contexts.append(("<module>", sf.tree))
         seen: Set[int] = set()
         seen_assigns: Set[int] = set()
         for symbol, fn in contexts:
+            fn_calls = sf.typed_in(ast.Call, fn)
+            fn_assigns = sf.typed_in(ast.Assign, fn)
             span_vars: Dict[str, str] = {}
             var_names: Set[str] = set()
-            for call in _span_calls(fn):
+            for call in _span_calls(fn_calls):
                 if id(call) in seen:
                     continue
                 seen.add(id(call))
@@ -267,12 +268,12 @@ def run_project(files: Dict[str, SourceFile], repo_root: str
                                  f"— add it to the registry or drop "
                                  f"the attr")))
             # attrs stamped later via finish()/set_attr on assigned vars
-            for var, call, assign in _assigned_spans(fn):
+            for var, call, assign in _assigned_spans(fn_assigns):
                 name = _literal_name(call)
                 if name and name in schema:
                     span_vars[var] = name
                     var_names.add(var)
-            for name, key, lineno in _finish_attr_keys(fn, var_names,
+            for name, key, lineno in _finish_attr_keys(fn_calls, var_names,
                                                        span_vars):
                 if key not in schema[name]:
                     findings.append(Finding(
@@ -284,11 +285,13 @@ def run_project(files: Dict[str, SourceFile], repo_root: str
             # unfinished spans: started, assigned, never handed off
             # (innermost context first, so a closure's span is judged
             # within its own scope and skipped in the enclosing one)
-            for var, call, assign in _assigned_spans(fn):
+            for var, call, assign in _assigned_spans(fn_assigns):
                 if id(assign) in seen_assigns:
                     continue
                 seen_assigns.add(id(assign))
-                if not _escapes(fn, var, assign):
+                if not _escapes(
+                        sf.typed_in((ast.Call, ast.Return, ast.Yield,
+                                     ast.Assign), fn), var, assign):
                     name = _literal_name(call) or "<dynamic>"
                     findings.append(Finding(
                         check=CHECK, path=sf.relpath,

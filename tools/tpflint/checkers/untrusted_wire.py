@@ -1,0 +1,85 @@
+"""untrusted-wire-input: wire-controlled values must pass a declared
+bound before sizing anything.
+
+The framing layer's bomb defence (docs/wire-format.md) is a set of
+inline checks: ``hlen`` against MAX_HEADER_BYTES, ``nbytes`` /
+``raw_nbytes`` against MAX_BUFFER_BYTES, the q8 desc's shape·dtype
+against its ``raw_nbytes``.  Those checks are load-bearing and
+invisible to every other checker — deleting one changes no API, no
+schema, no lock, and ships an allocation bomb.  This checker makes
+them structural: values originating from the ``TAINT_SOURCES`` /
+``TAINT_PARAM_SOURCES`` registries (protocol.py, next to
+REQUEST_KINDS) are *tainted* until sanitized — an upper-bound
+comparison against an untainted value in guard polarity, an equality
+or membership test against untainted data, a ``min()`` clamp, or a
+``TAINT_SANITIZERS`` call.  A tainted value reaching
+
+- an allocation size (``bytearray(n)``, ``np.empty/zeros/ones/full``,
+  ``np.frombuffer(count=n)``, ``np.repeat(x, n)``, ``b"..." * n``),
+- a ``range()`` bound,
+- a non-literal ``struct`` format string, or
+- a shard/ring/table subscript
+
+fails lint with a witness chain naming both ends: the source call or
+seeded parameter, each assignment that carried the taint, and the
+sink.  Interprocedural: a helper whose parameter reaches a sink
+reports at the call site that feeds it tainted data
+(``_read_exact``'s ``bytearray(n)`` is safe exactly because every
+caller bounds ``n`` first — and stays provably so).
+
+The analysis lives in tools/tpflint/flow.py; this module is the
+policy: read the registries, run the solver, format findings.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..core import Finding
+from ..flow import FlowAnalysis, FlowConfig
+
+CHECK = "untrusted-wire-input"
+
+_ADVICE = {
+    "alloc": "bound it against a MAX_*-class constant (or min()-clamp "
+             "it) before it sizes an allocation",
+    "range": "bound it before it drives an iteration count",
+    "struct": "never interpolate wire data into a struct format — "
+              "build the format from validated integers",
+    "index": "range-check it against the container's length before "
+             "routing on it",
+}
+
+
+def run_graph(graph) -> List[Finding]:
+    config = FlowConfig.from_graph(graph)
+    if config is None:
+        return []      # no registry in scope (fixture runs)
+    analysis = FlowAnalysis(graph, config)
+    findings: List[Finding] = []
+    for full in sorted(graph.funcs):
+        node = graph.funcs[full]
+        rep = analysis.report_for(full)
+        if rep is None:
+            continue
+        for f in rep.findings:
+            lbl = f["label"]
+            if lbl[0] == "param":
+                src = f"wire-seeded parameter `{lbl[1]}`"
+            elif lbl[0] == "src":
+                src = f"taint source {lbl[1]}() [line {lbl[2]}]"
+            else:
+                src = f"wire-tainted return of {lbl[1].rsplit('.', 1)[-1]}()"
+            findings.append(Finding(
+                check=CHECK, path=node.relpath, line=f["line"],
+                symbol=node.symbol,
+                key=f"{f['kind']}:{f['detail']}",
+                message=(f"untrusted wire value reaches {f['kind']} "
+                         f"sink {f['detail']} — tainted by {src} with "
+                         f"no declared bound on the path; "
+                         f"{_ADVICE[f['kind']]} (registries: "
+                         f"TAINT_SOURCES/TAINT_SANITIZERS in "
+                         f"remoting/protocol.py; docs/"
+                         f"static-analysis.md)"),
+                witness=[w.render() for w in f["frames"]]))
+    return findings

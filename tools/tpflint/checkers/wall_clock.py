@@ -30,7 +30,7 @@ from __future__ import annotations
 import ast
 from typing import List
 
-from ..core import Finding, SourceFile, dotted_tail, iter_functions
+from ..core import Finding, SourceFile, dotted_tail
 
 CHECK = "wall-clock-direct"
 
@@ -67,16 +67,15 @@ def run_file(sf: SourceFile) -> List[Finding]:
         return []
     findings: List[Finding] = []
     covered = set()
-    for symbol, fn in iter_functions(sf.tree):
-        for node in ast.walk(fn):
-            if isinstance(node, ast.Call):
-                hint = _flag(node)
-                if hint and id(node) not in covered:
-                    covered.add(id(node))
-                    findings.append(_finding(sf, symbol, node, hint))
+    for symbol, fn in sf.functions():
+        for node in sf.typed_in(ast.Call, fn):
+            hint = _flag(node)
+            if hint and id(node) not in covered:
+                covered.add(id(node))
+                findings.append(_finding(sf, symbol, node, hint))
     # module level (field defaults, constants)
-    for node in ast.walk(sf.tree):
-        if isinstance(node, ast.Call) and id(node) not in covered:
+    for node in sf.typed(ast.Call):
+        if id(node) not in covered:
             hint = _flag(node)
             if hint:
                 covered.add(id(node))

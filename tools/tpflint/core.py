@@ -16,6 +16,7 @@ The moving parts every checker shares:
 from __future__ import annotations
 
 import ast
+import bisect
 import json
 import os
 import re
@@ -57,7 +58,14 @@ class Finding:
 
 
 class SourceFile:
-    """One parsed python file plus its suppression map."""
+    """One parsed python file plus its suppression map.
+
+    Also the shared traversal cache: with 17 checkers each re-walking
+    every AST, traversal dominated ``make lint`` wall time.  The tree
+    is flattened ONCE into a preorder list with per-node subtree spans,
+    so whole-file scans (:attr:`nodes`), per-function scans
+    (:meth:`fn_nodes` — a list slice, no re-walk) and the function
+    table (:meth:`functions`) are all amortized across checkers."""
 
     def __init__(self, path: str, relpath: str, text: str):
         self.path = path
@@ -69,6 +77,89 @@ class SourceFile:
         self.disabled: Dict[int, Set[str]] = {}
         self.file_disabled: Set[str] = set()
         self._scan_disables()
+        self._nodes: Optional[List[ast.AST]] = None
+        self._spans: Dict[int, Tuple[int, int]] = {}
+        self._functions: Optional[List[Tuple[str, ast.AST]]] = None
+        self._typed: Dict[object, List[Tuple[int, ast.AST]]] = {}
+
+    @property
+    def nodes(self) -> "List[ast.AST]":
+        """Every AST node, depth-first preorder (one walk, cached).
+        Checker scans that used ``ast.walk(sf.tree)`` iterate this —
+        same node set, document order, no repeated traversal."""
+        if self._nodes is None:
+            nodes: List[ast.AST] = []
+            functions: List[Tuple[str, ast.AST]] = []
+            spans = self._spans
+            # iterative preorder DFS recording each node's subtree span
+            # (so fn_nodes() is a slice, not a re-walk) and the function
+            # table (iter_functions semantics) in the same pass
+            fndef = (ast.FunctionDef, ast.AsyncFunctionDef)
+            stack: List = [(self.tree, False, ())]
+            starts: List[int] = []
+            while stack:
+                node, done, names = stack.pop()
+                if done:
+                    spans[id(node)] = (starts.pop(), len(nodes))
+                    continue
+                starts.append(len(nodes))
+                nodes.append(node)
+                stack.append((node, True, names))
+                if isinstance(node, ast.ClassDef):
+                    names = names + (node.name,)
+                elif isinstance(node, fndef):
+                    names = names + (node.name,)
+                    functions.append((".".join(names), node))
+                for child in reversed(list(ast.iter_child_nodes(node))):
+                    stack.append((child, False, names))
+            self._nodes = nodes
+            self._functions = functions
+        return self._nodes
+
+    def fn_span(self, fn: ast.AST) -> Optional[Tuple[int, int]]:
+        """Preorder [start, end) span of ``fn``'s subtree, or None when
+        the node is not from this tree."""
+        self.nodes
+        return self._spans.get(id(fn))
+
+    def fn_nodes(self, fn: ast.AST) -> "List[ast.AST]":
+        """The subtree under ``fn`` (inclusive) — the cached-slice
+        equivalent of ``list(ast.walk(fn))`` (preorder, nested defs
+        included, exactly the lexical-scan semantics)."""
+        nodes = self.nodes
+        span = self._spans.get(id(fn))
+        if span is None:       # node not from this tree (fixture expr)
+            return list(ast.walk(fn))
+        return nodes[span[0]:span[1]]
+
+    def functions(self) -> "List[Tuple[str, ast.AST]]":
+        """(qualname, fn) for every function/method, preorder — the
+        cached equivalent of ``list(iter_functions(self.tree))``."""
+        self.nodes
+        return self._functions  # type: ignore[return-value]
+
+    def typed(self, tp) -> "List[ast.AST]":
+        """All nodes of AST type(s) ``tp``, document order (cached)."""
+        return [n for _, n in self._typed_index(tp)]
+
+    def typed_in(self, tp, fn: ast.AST) -> "List[ast.AST]":
+        """Nodes of type(s) ``tp`` within ``fn``'s subtree — the cheap
+        form of ``[n for n in ast.walk(fn) if isinstance(n, tp)]``."""
+        span = self.fn_span(fn)
+        if span is None:
+            return [n for n in ast.walk(fn) if isinstance(n, tp)]
+        idx = self._typed_index(tp)
+        lo = bisect.bisect_left(idx, (span[0],))
+        hi = bisect.bisect_left(idx, (span[1],))
+        return [n for _, n in idx[lo:hi]]
+
+    def _typed_index(self, tp) -> "List[Tuple[int, ast.AST]]":
+        got = self._typed.get(tp)
+        if got is None:
+            got = self._typed[tp] = [
+                (i, n) for i, n in enumerate(self.nodes)
+                if isinstance(n, tp)]
+        return got
 
     @classmethod
     def load(cls, path: str, repo_root: str) -> "SourceFile":

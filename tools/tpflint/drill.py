@@ -13,7 +13,21 @@ the linter fails with the expected finding:
 - **transitive-blocking-under-lock**: a sleep moved one call deep under
   the store lock must be found through the call graph;
 - **swallowed-error** / **unjoined-thread** / **leaked-resource**: the
-  canonical bad shapes, dropped into a controller.
+  canonical bad shapes, dropped into a controller;
+- **untrusted-wire-input**: the q8 dequantized-size bounds check is
+  *deleted* from ``q8_decode`` — the taint layer must rediscover that a
+  wire-declared shape then reaches ``np.frombuffer(count=...)``
+  unbounded;
+- **protocol-session**: the ``sess.state == "live"`` guard is deleted
+  from MIGRATE_FREEZE — the session checker must notice the handler no
+  longer checks the machine's only declared from-state;
+- **sim-nondeterminism**: a set literal folded into the harness event
+  log — the determinism walk must flag the unordered iteration.
+
+Two mutation modes: ``insert`` (the payload lands immediately BEFORE
+the anchor line — all insert anchors are ``def`` lines) and
+``replace`` (the anchor text is REPLACED by the payload — used to
+*delete* guards, which is how these bugs actually arrive).
 
 Run: ``python -m tools.tpflint.drill`` from the repo root (exit 0 =
 every drill failed lint the way it should).
@@ -28,9 +42,8 @@ import tempfile
 
 from .core import run_paths
 
-#: (name, checker, target file, anchor, insertion, expected substrings)
-#: — the insertion lands immediately BEFORE the anchor line, inheriting
-#: its indentation context (all anchors are method ``def`` lines)
+#: (name, checker, target file, anchor, payload, expected substrings
+#: [, mode]) — mode defaults to "insert"
 DRILLS = [
     (
         "lock-order-inversion",
@@ -184,11 +197,50 @@ DRILLS = [
         ),
         ["never", "closed"],
     ),
+    (
+        "untrusted-wire-q8-bounds-deleted",
+        "untrusted-wire-input",
+        "tensorfusion_tpu/remoting/protocol.py",
+        (
+            "    if out_nbytes > MAX_BUFFER_BYTES:\n"
+            "        raise ValueError(\"q8 dequantized size exceeds "
+            "cap\")\n"
+            "    if desc.get(\"raw_nbytes\") != out_nbytes:\n"
+        ),
+        (
+            "    if desc.get(\"raw_nbytes\") != out_nbytes:\n"
+        ),
+        ["untrusted wire value", "frombuffer", "wire-seeded parameter"],
+        "replace",
+    ),
+    (
+        "protocol-session-freeze-guard-deleted",
+        "protocol-session",
+        "tensorfusion_tpu/remoting/worker.py",
+        "            if sess is not None and sess.state == \"live\":\n",
+        "            if sess is not None:\n",
+        ["MIGRATE_FREEZE", "never compares", ".state"],
+        "replace",
+    ),
+    (
+        "sim-nondeterminism-set-fold",
+        "sim-nondeterminism",
+        "tensorfusion_tpu/sim/harness.py",
+        "    def log_note(self, *entry) -> None:",
+        (
+            "    def _drill_set_fold(self) -> None:\n"
+            "        for tag in {\"a\", \"b\", \"c\"}:\n"
+            "            self.events.append((\"drill\", tag))\n"
+            "\n"
+        ),
+        ["set-order", "sim-reachable", "sorted("],
+    ),
 ]
 
 
 def run_drill(tmp_root: str, name: str, check: str, target: str,
-              anchor: str, insertion: str, expected: list) -> bool:
+              anchor: str, payload: str, expected: list,
+              mode: str = "insert") -> bool:
     path = os.path.join(tmp_root, target)
     with open(path, encoding="utf-8") as f:
         original = f.read()
@@ -196,8 +248,9 @@ def run_drill(tmp_root: str, name: str, check: str, target: str,
         print(f"drill {name}: FAIL — anchor not found in {target} "
               f"(update tools/tpflint/drill.py)")
         return False
-    # first occurrence only: one well-placed bad method
-    mutated = original.replace(anchor, insertion + anchor, 1)
+    # first occurrence only: one well-placed mutation
+    replacement = payload if mode == "replace" else payload + anchor
+    mutated = original.replace(anchor, replacement, 1)
     try:
         with open(path, "w", encoding="utf-8") as f:
             f.write(mutated)
@@ -231,9 +284,11 @@ def main() -> int:
     try:
         shutil.copytree(src, os.path.join(tmp_root, "tensorfusion_tpu"))
         ok = True
-        for name, check, target, anchor, insertion, expected in DRILLS:
+        for name, check, target, anchor, payload, expected, *rest \
+                in DRILLS:
             ok &= run_drill(tmp_root, name, check, target, anchor,
-                            insertion, expected)
+                            payload, expected,
+                            rest[0] if rest else "insert")
         if ok:
             print(f"lint-drill: OK ({len(DRILLS)}/{len(DRILLS)} "
                   f"known-bad patterns fail lint)")

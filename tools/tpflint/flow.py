@@ -1,0 +1,815 @@
+"""tpfflow: per-function dataflow extraction + interprocedural taint.
+
+The graph layer (tools/tpflint/graph.py) answers "who calls whom";
+this module answers "what flows where".  It has two halves:
+
+- **Extraction** (cached with the rest of the per-file facts): one
+  pass over each function body produces a JSON-serializable list of
+  *flow events* — assignments with their dependency chains, call
+  sites with per-argument dependencies, sanitizing comparisons in
+  their guard polarity, and size-like sinks (allocations, ``range``,
+  ``struct`` format strings, shard/ring/table subscripts).  Chains
+  are dotted names with constant subscripts folded in
+  (``desc[nbytes]``), so dict-carried protocol metadata tracks like
+  an attribute.
+- **Analysis** (every run, memoized per function): a flow-insensitive
+  label-propagation fixpoint.  Taint labels enter from registered
+  *sources* (``TAINT_SOURCES`` call tails — ``recv_message`` and
+  friends) and *seeded parameters* (``TAINT_PARAM_SOURCES`` — wire
+  metadata that reaches a handler through a queue hop static analysis
+  cannot follow).  Labels propagate through assignments, arbitrary
+  un-resolved calls (``int(x)`` of tainted stays tainted — so does
+  ``len()``: the length of attacker bytes is attacker-chosen), and
+  *resolved* project calls via per-callee summaries (which parameters
+  reach which sinks, whether the return value is tainted).  A label
+  dies when its chain passes a **sanitizer**:
+
+  - an ordered comparison that upper-bounds it against an untainted
+    value, in guard polarity (``if n > MAX_BUFFER_BYTES: raise``
+    bounds ``n`` on the fall-through path; ``if block <= 0: raise``
+    only *lower*-bounds ``block`` and sanitizes nothing — that
+    asymmetry is what keeps a real unbounded-allocation bug visible),
+  - an equality test against a fully-untainted value,
+  - membership in an untainted container (``dtype in Q8_DTYPES``),
+  - a call registered in ``TAINT_SANITIZERS``, or ``min()`` with two
+    or more arguments (a clamp).
+
+  Sanitization is transitive through the definition chain: checking
+  ``out_nbytes`` (``= n * itemsize``) against a cap also clears ``n``
+  — bounds compose monotonically for the size arithmetic this lint
+  cares about.
+
+Every finding carries a witness chain from the taint's origin (source
+call or seeded parameter), through the assignments that carried it,
+to the sink — rendered exactly like lock-order-inversion's frames so
+``--format=json`` consumers see one shape.
+
+Deliberate limits: flow-insensitive (a check anywhere in the function
+sanitizes for the whole function), no container-element tracking
+beyond constant keys, no taint through object attributes across
+methods (``self.x`` set tainted in one method is clean in another —
+seed the reader via ``TAINT_PARAM_SOURCES`` if that matters).  The
+goal is the protocol-boundary failure mode that bites: a
+wire-controlled count sizing an allocation with no declared bound
+between them.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+#: receiver tails whose non-constant subscripts are routing sinks
+_INDEX_RE = re.compile(r"(shards?|ring|tables?|buckets?)$")
+
+#: numpy allocation constructors: first argument is an element count /
+#: shape
+_NP_ALLOC = {"empty", "zeros", "ones", "full"}
+
+_CMP_INVERT = {ast.Lt: ast.GtE, ast.LtE: ast.Gt, ast.Gt: ast.LtE,
+               ast.GtE: ast.Lt, ast.Eq: ast.NotEq, ast.NotEq: ast.Eq,
+               ast.In: ast.NotIn, ast.NotIn: ast.In}
+
+_SCOPE_BARRIER = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                  ast.ClassDef)
+
+
+def chain_str(node: ast.AST) -> str:
+    """Dotted chain with constant subscripts folded in:
+    ``desc["nbytes"]`` -> ``desc[nbytes]``, ``self.a.b`` ->
+    ``self.a.b``; '' when the base is not a plain name."""
+    parts: List[str] = []
+    while True:
+        if isinstance(node, ast.Attribute):
+            parts.append("." + node.attr)
+            node = node.value
+        elif isinstance(node, ast.Subscript) and \
+                isinstance(node.slice, ast.Constant):
+            parts.append("[%s]" % (node.slice.value,))
+            node = node.value
+        else:
+            break
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return "".join(reversed(parts))
+    return ""
+
+
+def chain_prefixes(chain: str) -> List[str]:
+    """['desc', 'desc[nbytes]'] for 'desc[nbytes]' — every cut at a
+    '.' or '[' boundary, shortest first, including the full chain."""
+    out = []
+    for i, ch in enumerate(chain):
+        if ch in ".[":
+            out.append(chain[:i])
+    out.append(chain)
+    return out
+
+
+def chain_tail(chain: str) -> str:
+    """Final attribute segment of a call chain ('get' for
+    'desc.get')."""
+    return chain.rsplit(".", 1)[-1]
+
+
+# -- extraction ------------------------------------------------------------
+
+class _FlowExtractor:
+    """One pass over a single function body (nested defs excluded —
+    they are extracted as their own functions).  Produces the JSON
+    event list; see extract_flow for the vocabulary."""
+
+    def __init__(self, fn: ast.AST):
+        self.fn = fn
+        self.events: List[list] = []
+
+    def run(self) -> List[list]:
+        for stmt in self.fn.body:
+            self._stmt(stmt)
+        return self.events
+
+    # -- expressions: dependency collection --------------------------------
+
+    def _deps(self, node: Optional[ast.AST], out: List) -> None:
+        if node is None or isinstance(node, (ast.Constant,)):
+            return
+        if isinstance(node, _SCOPE_BARRIER):
+            return
+        if isinstance(node, ast.Call):
+            out.append(["c", self._call(node)])
+            return
+        c = chain_str(node)
+        if c:
+            if isinstance(node, ast.Subscript):
+                # constant subscript: chain covers it
+                pass
+            out.append(c)
+            return
+        if isinstance(node, ast.Subscript):
+            self._subscript_sink(node)
+            self._deps(node.value, out)
+            self._deps(node.slice, out)
+            return
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+            # b"\x00" * n builds an n-byte buffer
+            for const, var in ((node.left, node.right),
+                               (node.right, node.left)):
+                if isinstance(const, ast.Constant) and \
+                        isinstance(const.value, (bytes, str)):
+                    deps: List = []
+                    self._deps(var, deps)
+                    if deps:
+                        self.events.append(
+                            ["sink", node.lineno, "alloc",
+                             "bytes-literal * n", deps])
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.expr, ast.comprehension)):
+                self._deps(child, out)
+            elif isinstance(child, ast.Slice):
+                self._deps(child.lower, out)
+                self._deps(child.upper, out)
+                self._deps(child.step, out)
+
+    def _subscript_sink(self, node: ast.Subscript) -> None:
+        if isinstance(node.slice, (ast.Constant, ast.Slice)):
+            return
+        recv = chain_str(node.value)
+        if not recv or not _INDEX_RE.search(chain_tail(recv)):
+            return
+        deps: List = []
+        self._deps(node.slice, deps)
+        if deps:
+            self.events.append(["sink", node.lineno, "index",
+                                f"{recv}[...]", deps])
+
+    # -- calls: events + sink patterns --------------------------------------
+
+    def _call(self, node: ast.Call) -> int:
+        chain = chain_str(node.func)
+        recv_deps: List = []
+        if not chain:
+            # receiver is itself a call / subscript expression
+            self._deps(node.func, recv_deps)
+        arg_deps: List[List] = []
+        for a in node.args:
+            d: List = []
+            if isinstance(a, ast.Starred):
+                self._deps(a.value, d)
+            else:
+                self._deps(a, d)
+            arg_deps.append(d)
+        kw_deps: Dict[str, List] = {}
+        for kw in node.keywords:
+            d = []
+            self._deps(kw.value, d)
+            if kw.arg:
+                kw_deps[kw.arg] = d
+            elif d:
+                kw_deps.setdefault("**", []).extend(d)
+        idx = len(self.events)
+        self.events.append(["call", node.lineno, chain, recv_deps,
+                            arg_deps, kw_deps])
+        self._call_sinks(node, chain, arg_deps, kw_deps)
+        return idx
+
+    def _call_sinks(self, node: ast.Call, chain: str,
+                    arg_deps: List[List], kw_deps: Dict[str, List]
+                    ) -> None:
+        tail = chain_tail(chain)
+        base = chain.rsplit(".", 1)[0] if "." in chain else ""
+        line = node.lineno
+
+        def sink(kind: str, detail: str, deps: List) -> None:
+            if deps:
+                self.events.append(["sink", line, kind, detail, deps])
+
+        if chain == "bytearray" and arg_deps:
+            sink("alloc", "bytearray(n)", arg_deps[0])
+        elif tail in _NP_ALLOC and base in ("np", "numpy") and arg_deps:
+            sink("alloc", f"{chain}(shape)", arg_deps[0])
+        elif tail == "repeat" and base in ("np", "numpy") \
+                and len(arg_deps) >= 2:
+            # np.repeat(x, k) materializes len(x)*k elements
+            sink("alloc", "np.repeat(x, n)", arg_deps[1])
+        elif tail in ("frombuffer", "fromstring"):
+            deps = kw_deps.get("count", [])
+            if not deps and len(arg_deps) >= 3:
+                deps = arg_deps[2]
+            sink("alloc", f"{tail}(count=n)", deps)
+        elif chain == "range":
+            deps = [d for args in arg_deps for d in args]
+            sink("range", "range(n)", deps)
+        elif base == "struct" and arg_deps and \
+                not isinstance(node.args[0], ast.Constant):
+            sink("struct", f"{chain}(fmt)", arg_deps[0])
+
+    # -- conditions: sanitizer events ---------------------------------------
+
+    def _test(self, node: ast.AST, pos: bool) -> None:
+        """Record sanitizing comparisons from a condition whose
+        *retained-path* truth value is ``pos`` (True: the condition
+        holds where execution continues; False: its negation does —
+        the ``if bad: raise`` guard shape)."""
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+            self._test(node.operand, not pos)
+            return
+        if isinstance(node, ast.BoolOp):
+            #  pos+And: every operand holds; neg(Or): every negated
+            #  operand holds.  The mixed shapes guarantee nothing.
+            sound = isinstance(node.op, ast.And) if pos \
+                else isinstance(node.op, ast.Or)
+            for v in node.values:
+                if sound:
+                    self._test(v, pos)
+                else:
+                    self._deps(v, [])   # still record calls/sinks
+            return
+        if not isinstance(node, ast.Compare):
+            self._deps(node, [])
+            return
+        left = node.left
+        for op, right in zip(node.ops, node.comparators):
+            ldeps: List = []
+            rdeps: List = []
+            self._deps(left, ldeps)
+            self._deps(right, rdeps)
+            kind = type(op)
+            if not pos:
+                kind = _CMP_INVERT.get(kind, None)
+            self._san(node.lineno, kind, ldeps, rdeps)
+            left = right
+
+    def _san(self, line: int, kind, ldeps: List, rdeps: List) -> None:
+        def chains(deps: List) -> List[str]:
+            return [d for d in deps if isinstance(d, str)]
+
+        if kind in (ast.Lt, ast.LtE):
+            # small <= large: the small side is bounded above
+            self.events.append(["san", line, "ord", chains(ldeps), rdeps])
+        elif kind in (ast.Gt, ast.GtE):
+            self.events.append(["san", line, "ord", chains(rdeps), ldeps])
+        elif kind is ast.Eq:
+            self.events.append(["san", line, "eq", chains(ldeps), rdeps])
+            self.events.append(["san", line, "eq", chains(rdeps), ldeps])
+        elif kind is ast.In:
+            self.events.append(["san", line, "in", chains(ldeps), rdeps])
+
+    # -- statements ---------------------------------------------------------
+
+    def _assign_target(self, t: ast.AST, deps: List, line: int) -> None:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                self._assign_target(el, deps, line)
+            return
+        if isinstance(t, ast.Starred):
+            self._assign_target(t.value, deps, line)
+            return
+        c = chain_str(t)
+        if c:
+            self.events.append(["as", line, c, deps])
+            return
+        if isinstance(t, ast.Subscript):
+            self._subscript_sink(t)
+            sdeps: List = []
+            self._deps(t.slice, sdeps)
+            base = chain_str(t.value)
+            if base:
+                # weak update: m[i] = v taints m without clearing it
+                self.events.append(["as", line, base,
+                                    deps + [base] + sdeps])
+        elif isinstance(t, ast.Attribute):
+            self._deps(t.value, [])
+
+    def _stmt(self, node: ast.AST) -> None:
+        if isinstance(node, _SCOPE_BARRIER):
+            return
+        if isinstance(node, ast.Assign):
+            deps: List = []
+            self._deps(node.value, deps)
+            if len(node.targets) == 1 and \
+                    isinstance(node.targets[0], (ast.Tuple, ast.List)) and \
+                    isinstance(node.value, (ast.Tuple, ast.List)) and \
+                    len(node.targets[0].elts) == len(node.value.elts):
+                for el, val in zip(node.targets[0].elts, node.value.elts):
+                    d: List = []
+                    self._deps(val, d)
+                    self._assign_target(el, d, node.lineno)
+                return
+            for t in node.targets:
+                self._assign_target(t, deps, node.lineno)
+            return
+        if isinstance(node, ast.AugAssign):
+            deps = []
+            self._deps(node.value, deps)
+            c = chain_str(node.target)
+            if c:
+                self.events.append(["as", node.lineno, c, deps + [c]])
+            return
+        if isinstance(node, ast.AnnAssign):
+            if node.value is not None:
+                deps = []
+                self._deps(node.value, deps)
+                self._assign_target(node.target, deps, node.lineno)
+            return
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            deps = []
+            self._deps(node.iter, deps)
+            self._assign_target(node.target, deps, node.lineno)
+            for s in node.body + node.orelse:
+                self._stmt(s)
+            return
+        if isinstance(node, ast.While):
+            self._test(node.test, pos=True)
+            for s in node.body + node.orelse:
+                self._stmt(s)
+            return
+        if isinstance(node, ast.If):
+            exits = any(isinstance(n, ast.Raise)
+                        for s in node.body for n in ast.walk(s)) \
+                or (bool(node.body) and
+                    isinstance(node.body[-1], (ast.Return, ast.Continue)))
+            self._test(node.test, pos=not exits)
+            for s in node.body + node.orelse:
+                self._stmt(s)
+            return
+        if isinstance(node, ast.Assert):
+            self._test(node.test, pos=True)
+            return
+        if isinstance(node, ast.Return):
+            deps = []
+            self._deps(node.value, deps)
+            self.events.append(["ret", node.lineno, deps])
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                deps = []
+                self._deps(item.context_expr, deps)
+                if item.optional_vars is not None:
+                    self._assign_target(item.optional_vars, deps,
+                                        item.context_expr.lineno)
+            for s in node.body:
+                self._stmt(s)
+            return
+        if isinstance(node, ast.Try):
+            for s in node.body + node.orelse + node.finalbody:
+                self._stmt(s)
+            for h in node.handlers:
+                for s in h.body:
+                    self._stmt(s)
+            return
+        # Expr, Raise, Delete, ... — record calls/sinks, keep no deps
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._deps(child, [])
+            elif isinstance(child, ast.stmt):
+                self._stmt(child)
+
+
+def extract_flow(fn: ast.AST) -> List[list]:
+    """JSON flow events for one function body.  Vocabulary (all
+    lists; ``dep`` is a chain string or ``["c", i]`` referencing the
+    call event at index ``i`` — inner calls precede outer, so refs
+    always point backwards):
+
+    - ``["as", line, target_chain, [deps]]``
+    - ``["call", line, chain, [recv_deps], [[arg0_deps], ...],
+      {kw: [deps]}]``
+    - ``["san", line, kind, [bounded_chains], [bounding_deps]]`` with
+      kind ``ord``/``eq``/``in``, already normalized to guard
+      polarity
+    - ``["sink", line, kind, detail, [deps]]`` with kind ``alloc``/
+      ``range``/``struct``/``index``
+    - ``["ret", line, [deps]]``
+    """
+    return _FlowExtractor(fn).run()
+
+
+# -- analysis --------------------------------------------------------------
+
+class FlowConfig:
+    """Taint registries, read from the protocol module's AST (the
+    registry lives next to REQUEST_KINDS so the wire format and its
+    trust boundary are declared in one place)."""
+
+    def __init__(self, sources=(), param_sources=(), sanitizers=()):
+        self.sources = frozenset(sources)
+        self.param_sources = [(re.compile(rx), p)
+                              for rx, p in param_sources]
+        self.sanitizers = frozenset(sanitizers)
+
+    @classmethod
+    def from_graph(cls, graph) -> Optional["FlowConfig"]:
+        for rel, sf in graph.files.items():
+            if rel.endswith("protocol.py"):
+                cfg = cls.from_tree(sf.tree)
+                if cfg is not None:
+                    return cfg
+        return None
+
+    @classmethod
+    def from_tree(cls, tree: ast.AST) -> Optional["FlowConfig"]:
+        found = {}
+        for node in tree.body:
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            t = node.targets[0]
+            if not isinstance(t, ast.Name):
+                continue
+            if t.id in ("TAINT_SOURCES", "TAINT_SANITIZERS",
+                        "TAINT_PARAM_SOURCES"):
+                try:
+                    found[t.id] = ast.literal_eval(node.value)
+                except ValueError:
+                    pass
+        if "TAINT_SOURCES" not in found:
+            return None
+        return cls(sources=found.get("TAINT_SOURCES", ()),
+                   param_sources=found.get("TAINT_PARAM_SOURCES", ()),
+                   sanitizers=found.get("TAINT_SANITIZERS", ()))
+
+    def real_params(self, full: str, params: List[str]) -> Set[str]:
+        out = set()
+        for rx, p in self.param_sources:
+            if p in params and rx.search(full):
+                out.add(p)
+        return out
+
+
+class FnReport:
+    """Per-function analysis result: real findings plus the summary
+    callers link against."""
+
+    def __init__(self):
+        #: [{"line", "kind", "detail", "frames": [Witness, ...]}]
+        self.findings: List[dict] = []
+        #: param -> [{"line", "kind", "detail", "frames"}] for params
+        #: NOT real-seeded (real-seeded params report in place)
+        self.param_sinks: Dict[str, List[dict]] = {}
+        #: params whose taint reaches the return value
+        self.ret_params: Set[str] = set()
+        #: real taint reaches the return value
+        self.ret_real = False
+        self.ret_frames: List = []
+
+
+class FlowAnalysis:
+    """Interprocedural driver: memoized per-function reports over the
+    project graph, cycle-guarded (a recursive back-edge contributes
+    no summary, like acquired_locks)."""
+
+    MAX_PASSES = 8
+
+    def __init__(self, graph, config: FlowConfig,
+                 check: str = "untrusted-wire-input"):
+        self.graph = graph
+        self.config = config
+        self.check = check
+        self._memo: Dict[str, FnReport] = {}
+        self._active: Set[str] = set()
+
+    def _sink_disabled(self, node, line: int) -> bool:
+        """A ``# tpflint: disable=`` on the sink line suppresses the
+        sink at its origin — including the interprocedural summary
+        entry, so call sites feeding it stay quiet too."""
+        sf = self.graph.files.get(node.relpath)
+        if sf is None:
+            return False
+        checks = getattr(sf, "disabled", {}).get(line, ())
+        return self.check in checks or "*" in checks
+
+    def report_for(self, full: str) -> Optional[FnReport]:
+        if full in self._memo:
+            return self._memo[full]
+        if full in self._active:
+            return None
+        node = self.graph.funcs.get(full)
+        if node is None:
+            return None
+        self._active.add(full)
+        try:
+            rep = self._solve(node)
+        finally:
+            self._active.discard(full)
+        self._memo[full] = rep
+        return rep
+
+    # -- the per-function solver -------------------------------------------
+
+    def _solve(self, node) -> FnReport:
+        from .graph import Witness
+
+        events = node.facts.get("flow") or []
+        params = node.facts.get("params") or []
+        rep = FnReport()
+        if not events:
+            return rep
+        real = self.config.real_params(node.full, params)
+        seeds = {p: {("param", p)} for p in params
+                 if p not in ("self", "cls")}
+
+        defs: Dict[str, Set[str]] = {}
+        for ev in events:
+            if ev[0] == "as":
+                defs.setdefault(ev[2], set()).update(
+                    d for d in ev[3] if isinstance(d, str))
+
+        sanitized: Set[str] = set()
+        for _ in range(4):
+            T, steps, origin = self._taint_pass(node, events, seeds,
+                                                sanitized)
+            grown = set(sanitized)
+            for ev in events:
+                if ev[0] != "san":
+                    continue
+                _, line, kind, bounded, bounding = ev
+                if any(self._dep_labels(node, d, T, sanitized, events,
+                                        origin)
+                       for d in bounding):
+                    continue
+                for c in bounded:
+                    self._sanitize(c, defs, grown)
+            if grown == sanitized:
+                break
+            sanitized = grown
+
+        T, steps, origin = self._taint_pass(node, events, seeds,
+                                            sanitized)
+        self._collect(node, events, T, steps, origin, sanitized,
+                      real, rep)
+        return rep
+
+    def _sanitize(self, chain: str, defs: Dict[str, Set[str]],
+                  out: Set[str], depth: int = 0) -> None:
+        if chain in out or depth > 16:
+            return
+        out.add(chain)
+        for d in defs.get(chain, ()):
+            self._sanitize(d, defs, out, depth + 1)
+
+    def _taint_pass(self, node, events, seeds, sanitized):
+        T: Dict[str, Set[tuple]] = {c: set(ls) for c, ls in seeds.items()}
+        steps: Dict[tuple, tuple] = {}
+        origin: Dict[tuple, list] = {}
+        for _ in range(self.MAX_PASSES):
+            changed = False
+            for ev in events:
+                if ev[0] != "as":
+                    continue
+                _, line, tgt, deps = ev
+                have = T.setdefault(tgt, set())
+                for d in deps:
+                    for lbl in self._dep_labels(node, d, T, sanitized,
+                                                events, origin):
+                        if lbl in have:
+                            continue
+                        have.add(lbl)
+                        changed = True
+                        rep = d if isinstance(d, str) \
+                            else (events[d[1]][2] or "<call>") + "()"
+                        steps.setdefault((tgt, lbl), (rep, line))
+            if not changed:
+                break
+        return T, steps, origin
+
+    def _chain_labels(self, chain, T, sanitized) -> Set[tuple]:
+        if chain in sanitized:
+            return set()
+        out: Set[tuple] = set()
+        for p in chain_prefixes(chain):
+            if p in sanitized:
+                continue
+            out |= T.get(p, set())
+        return out
+
+    def _dep_labels(self, node, dep, T, sanitized, events, origin,
+                    depth: int = 0) -> Set[tuple]:
+        from .graph import Witness
+
+        if isinstance(dep, str):
+            return self._chain_labels(dep, T, sanitized)
+        if depth > 12:
+            return set()
+        _, line, chain, recv_deps, arg_deps, kw_deps = events[dep[1]]
+        tail = chain_tail(chain) if chain else ""
+        if chain in self.config.sanitizers or \
+                tail in self.config.sanitizers:
+            return set()
+        if tail == "min" and len(arg_deps) >= 2:
+            return set()
+        if chain in self.config.sources or tail in self.config.sources:
+            lbl = ("src", chain, line)
+            origin.setdefault(lbl, [Witness(
+                node.relpath, line, node.symbol,
+                note=f"{chain}() is a declared taint source")])
+            return {lbl}
+        out: Set[tuple] = set()
+        if "." in chain:
+            out |= self._chain_labels(chain.rsplit(".", 1)[0], T,
+                                      sanitized)
+        for d in recv_deps:
+            out |= self._dep_labels(node, d, T, sanitized, events,
+                                    origin, depth + 1)
+        resolved = self.graph.resolve_call(node, chain) if chain else None
+        sub = self.report_for(resolved) if resolved else None
+        if sub is not None:
+            callee = self.graph.funcs[resolved]
+            if sub.ret_real:
+                lbl = ("ret", resolved, line)
+                origin.setdefault(lbl, [Witness(
+                    node.relpath, line, node.symbol,
+                    note=f"calls {chain}() which returns wire-tainted "
+                         f"data")] + sub.ret_frames)
+                out.add(lbl)
+            if sub.ret_params:
+                for pname, deps in self._bind_args(
+                        callee, chain, arg_deps, kw_deps):
+                    if pname in sub.ret_params:
+                        for d in deps:
+                            out |= self._dep_labels(
+                                node, d, T, sanitized, events, origin,
+                                depth + 1)
+        else:
+            # unresolved (builtin / stdlib / foreign): taint in, taint
+            # out
+            for deps in arg_deps:
+                for d in deps:
+                    out |= self._dep_labels(node, d, T, sanitized,
+                                            events, origin, depth + 1)
+            for deps in kw_deps.values():
+                for d in deps:
+                    out |= self._dep_labels(node, d, T, sanitized,
+                                            events, origin, depth + 1)
+        return out
+
+    @staticmethod
+    def _bind_args(callee, chain: str, arg_deps, kw_deps):
+        """(param_name, deps) pairs for a call site, accounting for
+        the bound ``self`` of method-style calls."""
+        params = callee.facts.get("params") or []
+        offset = 1 if params and params[0] in ("self", "cls") \
+            and "." in chain else 0
+        for i, deps in enumerate(arg_deps):
+            pi = i + offset
+            if pi < len(params):
+                yield params[pi], deps
+        for kw, deps in kw_deps.items():
+            if kw in params:
+                yield kw, deps
+
+    # -- findings + summary -------------------------------------------------
+
+    def _trace(self, node, steps, origin, chain, lbl) -> List:
+        from .graph import Witness
+
+        pre: List = list(origin.get(lbl, ()))
+        if not pre and lbl[0] == "param":
+            pre = [Witness(node.relpath, node.line, node.symbol,
+                           note=f"parameter `{lbl[1]}` carries "
+                                f"wire-controlled data")]
+        path: List = []
+        cur = chain
+        seen: Set[str] = set()
+        while cur and cur not in seen and len(path) < 10:
+            seen.add(cur)
+            hit = None
+            for c in reversed(chain_prefixes(cur)):
+                if (c, lbl) in steps:
+                    hit = (c,) + steps[(c, lbl)]
+                    break
+            if hit is None:
+                break
+            c, src, line = hit
+            path.append(Witness(node.relpath, line, node.symbol,
+                                note=f"{c} <- {src}"))
+            if src.endswith("()"):
+                break
+            cur = src
+        return pre + path[::-1]
+
+    def _collect(self, node, events, T, steps, origin, sanitized,
+                 real, rep: FnReport) -> None:
+        from .graph import Witness
+
+        def is_real(lbl) -> bool:
+            if lbl[0] == "param":
+                return lbl[1] in real
+            return True
+
+        seen_findings: Set[tuple] = set()
+
+        def record(line, kind, detail, lbl, frames) -> None:
+            if is_real(lbl):
+                key = (line, kind, detail, lbl[:2])
+                if key not in seen_findings:
+                    seen_findings.add(key)
+                    rep.findings.append({"line": line, "kind": kind,
+                                         "detail": detail, "label": lbl,
+                                         "frames": frames})
+            elif lbl[1] not in real:
+                rep.param_sinks.setdefault(lbl[1], []).append(
+                    {"line": line, "kind": kind, "detail": detail,
+                     "frames": frames})
+
+        for ev in events:
+            if ev[0] == "sink":
+                _, line, kind, detail, deps = ev
+                if self._sink_disabled(node, line):
+                    continue
+                for d in deps:
+                    for lbl in self._dep_labels(node, d, T, sanitized,
+                                                events, origin):
+                        start = d if isinstance(d, str) else ""
+                        frames = self._trace(node, steps, origin,
+                                             start, lbl)
+                        frames = frames + [Witness(
+                            node.relpath, line, node.symbol,
+                            note=f"{kind} sink: {detail}")]
+                        record(line, kind, detail, lbl, frames)
+            elif ev[0] == "ret":
+                _, line, deps = ev
+                for d in deps:
+                    for lbl in self._dep_labels(node, d, T, sanitized,
+                                                events, origin):
+                        if lbl[0] == "param":
+                            rep.ret_params.add(lbl[1])
+                            if lbl[1] in real and not rep.ret_real:
+                                rep.ret_real = True
+                                rep.ret_frames = self._trace(
+                                    node, steps, origin,
+                                    d if isinstance(d, str) else "",
+                                    lbl)
+                        elif not rep.ret_real:
+                            rep.ret_real = True
+                            rep.ret_frames = self._trace(
+                                node, steps, origin,
+                                d if isinstance(d, str) else "", lbl)
+            elif ev[0] == "call":
+                _, line, chain, recv_deps, arg_deps, kw_deps = ev
+                resolved = self.graph.resolve_call(node, chain) \
+                    if chain else None
+                sub = self.report_for(resolved) if resolved else None
+                if sub is None or not sub.param_sinks:
+                    continue
+                callee = self.graph.funcs[resolved]
+                for pname, deps in self._bind_args(callee, chain,
+                                                   arg_deps, kw_deps):
+                    sinks = sub.param_sinks.get(pname)
+                    if not sinks:
+                        continue
+                    for d in deps:
+                        for lbl in self._dep_labels(
+                                node, d, T, sanitized, events, origin):
+                            caller_frames = self._trace(
+                                node, steps, origin,
+                                d if isinstance(d, str) else "", lbl)
+                            link = Witness(
+                                node.relpath, line, node.symbol,
+                                note=f"passes tainted `{pname}` to "
+                                     f"{chain}()")
+                            for s in sinks:
+                                record(line, s["kind"],
+                                       f"{chain}() -> {s['detail']}",
+                                       lbl,
+                                       caller_frames + [link]
+                                       + list(s["frames"]))

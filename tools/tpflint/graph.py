@@ -14,9 +14,11 @@ Layering:
   JSON-serializable *facts* dict — defined symbols, call sites with the
   lock context they run under, lock acquisitions with the locks already
   held, blocking operations, broad ``except`` handlers, thread
-  creation/join/daemon discipline, socket acquisitions.  Facts are
-  cached on disk keyed by ``(mtime, size)`` so a warm ``make lint``
-  re-extracts only edited files (``TPF_LINT_NO_CACHE=1`` bypasses).
+  creation/join/daemon discipline, socket acquisitions, and the flow
+  layer's per-function dataflow events (tools/tpflint/flow.py).  Facts
+  are cached on disk keyed by a blake2b digest of the file content so
+  a warm ``make lint`` re-extracts only edited files
+  (``TPF_LINT_NO_CACHE=1`` bypasses).
 - **Resolution** (cheap, every run): imports (absolute, relative,
   aliased), ``self.method`` through base classes, module-qualified
   calls, and *known-callback* edges — ``threading.Thread(target=f)``
@@ -37,6 +39,7 @@ graph layer's job is the part indirection hides.
 from __future__ import annotations
 
 import ast
+import hashlib
 import json
 import os
 import re
@@ -46,7 +49,7 @@ from typing import Dict, List, Optional, Set, Tuple
 from .core import SourceFile
 
 #: bump when extraction output changes shape — stale caches self-evict
-CACHE_VERSION = 4
+CACHE_VERSION = 5
 DEFAULT_CACHE_NAME = ".tpflint-cache.json"
 
 #: names that participate in lock-ORDER tracking: real locks plus
@@ -416,7 +419,7 @@ def extract_facts(sf: SourceFile) -> dict:
     pkg_parts = mod.split(".")
     if not sf.relpath.endswith("__init__.py"):
         pkg_parts = pkg_parts[:-1]
-    for node in ast.walk(sf.tree):
+    for node in sf.typed((ast.Import, ast.ImportFrom)):
         if isinstance(node, ast.Import):
             for a in node.names:
                 local = a.asname or a.name.split(".")[0]
@@ -466,9 +469,8 @@ def extract_facts(sf: SourceFile) -> dict:
                         ann = chain_of(a.annotation)
                         if ann:
                             anns[a.arg] = ann
-                for n in ast.walk(child):
-                    if isinstance(n, ast.Assign) and \
-                            len(n.targets) == 1:
+                for n in sf.typed_in(ast.Assign, child):
+                    if len(n.targets) == 1:
                         tchain = chain_of(n.targets[0])
                         if tchain.startswith("self.") and \
                                 tchain.count(".") == 1:
@@ -501,13 +503,18 @@ def extract_facts(sf: SourceFile) -> dict:
     has_sockets = "socket" in sf.text
 
     def scan_fn(fn: ast.AST, stack: List[str], cls: Optional[str]) -> None:
+        from .flow import extract_flow
         qual = ".".join(stack + [fn.name])
         holds = _holds_for(fn, sf.lines)
         ex = _FunctionExtractor(fn, holds)
         ex.run()
+        args = fn.args
+        params = [a.arg for a in
+                  args.posonlyargs + args.args + args.kwonlyargs]
         functions.append({
             "qual": qual, "cls": cls, "name": fn.name,
             "line": fn.lineno,
+            "params": params,
             "calls": ex.calls, "acquires": ex.acquires,
             "blocking": ex.blocking,
             "excepts": ex.excepts,
@@ -517,6 +524,7 @@ def extract_facts(sf: SourceFile) -> dict:
             "escapes": sorted(ex.escapes),
             "logs": ex.logs,
             "sockets": _scan_sockets(fn) if has_sockets else [],
+            "flow": extract_flow(fn),
         })
 
     def walk(node: ast.AST, stack: List[str], cls: Optional[str]) -> None:
@@ -541,7 +549,13 @@ def extract_facts(sf: SourceFile) -> dict:
 # -- cache -----------------------------------------------------------------
 
 class FactsCache:
-    """mtime+size-keyed persistent store of per-file facts."""
+    """Content-hash-keyed persistent store of per-file facts.
+
+    The key is a blake2b digest of the file *text* — not ``(mtime,
+    size)``: fast CI checkouts can restore a same-size edit with an
+    equal (coarse-grained) mtime, silently serving stale facts.  The
+    hash is computed from the already-loaded source, so a warm run
+    costs one digest per file and zero extra I/O."""
 
     def __init__(self, path: Optional[str]):
         self.path = path
@@ -558,22 +572,21 @@ class FactsCache:
             except (OSError, ValueError):
                 self._entries = {}
 
+    @staticmethod
+    def stamp_of(text: str) -> str:
+        return hashlib.blake2b(text.encode("utf-8"),
+                               digest_size=16).hexdigest()
+
     def facts_for(self, sf: SourceFile) -> dict:
-        try:
-            st = os.stat(sf.path)
-            stamp = [st.st_mtime, st.st_size]
-        except OSError:
-            stamp = None     # in-memory fixture: never cacheable
+        stamp = self.stamp_of(sf.text)
         ent = self._entries.get(sf.relpath)
-        if stamp is not None and ent is not None and \
-                ent.get("stamp") == stamp:
+        if ent is not None and ent.get("stamp") == stamp:
             self.hits += 1
             return ent["facts"]
         self.misses += 1
         facts = extract_facts(sf)
-        if stamp is not None:
-            self._entries[sf.relpath] = {"stamp": stamp, "facts": facts}
-            self._dirty = True
+        self._entries[sf.relpath] = {"stamp": stamp, "facts": facts}
+        self._dirty = True
         return facts
 
     def save(self) -> None:
