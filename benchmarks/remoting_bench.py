@@ -202,7 +202,43 @@ def main() -> int:
                         "q8 leg, exit nonzero unless aggregate >= "
                         "1.6x at 2 workers, q8 collective bytes >= 2x "
                         "down vs raw, and numerics hold")
+    p.add_argument("--no-fabric", action="store_true",
+                   help="skip the peer-fabric ring cells")
+    p.add_argument("--fabric-rows", type=int, default=64,
+                   help="microbatch rows per WORKER in the fabric "
+                        "cells (weak scaling); sized so the 1-core "
+                        "box's serialized member compute does not "
+                        "drown the protocol signal")
+    p.add_argument("--fabric-dim", type=int, default=256)
+    p.add_argument("--fabric-steps", type=int, default=24)
+    p.add_argument("--fabric-client-mbps", type=float, default=6.0,
+                   help="shared client-uplink bandwidth budget (MB/s) "
+                        "every client<->worker byte serializes "
+                        "through — the WAN-class remote-user NIC "
+                        "(~48Mbps) the fabric ring bypasses")
+    p.add_argument("--fabric-peer-rtt-ms", type=float, default=0.4,
+                   help="emulated round-trip per worker<->worker peer "
+                        "link (fat intra-DC DCN)")
+    p.add_argument("--fabric-quick", action="store_true",
+                   help="CI gate mode (make verify-fabric): run ONLY "
+                        "the 1-vs-4-worker fabric ring cell, exit "
+                        "nonzero unless collective bytes through the "
+                        "client == 0, aggregate > 3.15x one worker "
+                        "(PR 13's client-coordinated ceiling), and "
+                        "raw numerics match the local reference")
     args = p.parse_args()
+
+    if args.fabric_quick:
+        args.fabric_steps = min(args.fabric_steps, 10)
+        cell = measure_fabric(args, quick=True)
+        print(json.dumps({
+            "metric": "remoting_fabric_aggregate_vs_1worker",
+            "value": cell["aggregate_vs_1worker_at_max"],
+            "unit": "x", "cell": cell}))
+        ok = cell["client_relay_bytes_at_max"] == 0 and \
+            cell["aggregate_vs_1worker_at_max"] > 3.15 and \
+            cell["numerics_ok"]
+        return 0 if ok else 1
 
     if args.fed_quick:
         args.fed_steps = min(args.fed_steps, 12)
@@ -340,6 +376,8 @@ def main() -> int:
         result["wire_encoding"] = measure_wire_encoding(args)
     if not args.no_federation:
         result["federation"] = measure_federation(args)
+    if not args.no_fabric:
+        result["fabric"] = measure_fabric(args)
     # every artifact carries its own before/after: the checked-in
     # record this run replaces rides along under `previous`
     result["previous"] = previous_artifact("remoting")
@@ -403,6 +441,53 @@ class _LatencyProxy:
     def close(self):
         self._alive = False
         self._listen.close()
+
+
+class _SharedUplink:
+    """One client NIC shared by every client<->worker connection of a
+    fabric cell: a global bandwidth budget all `_SharedUplinkProxy`
+    pumps serialize through.  This is the asymmetric topology the
+    peer fabric exists for — the remote client rides one thin uplink
+    while workers see each other over fat DCN links — so every
+    collective byte a client-coordinated path relays costs shared
+    serialized time, and the ring's receipts cost ~nothing."""
+
+    def __init__(self, bytes_per_s: float):
+        import threading
+
+        self.bytes_per_s = float(bytes_per_s)
+        self.lock = threading.Lock()
+
+
+class _SharedUplinkProxy(_LatencyProxy):
+    """TCP forwarder whose transfer time is bandwidth-proportional
+    through ONE shared `_SharedUplink` budget (chunk_bytes / uplink
+    bytes_per_s, serialized across every connection of the cell) —
+    unlike `_LatencyProxy`'s fixed per-chunk latency, small control
+    frames are ~free and big payloads contend for the same pipe."""
+
+    def __init__(self, target_port: int, uplink: _SharedUplink):
+        self.uplink = uplink
+        super().__init__(target_port, 0.0)
+
+    def _pump(self, src, dst):
+        while True:
+            try:
+                chunk = src.recv(1 << 16)
+            except OSError:
+                chunk = b""
+            if not chunk:
+                try:
+                    dst.shutdown(2)
+                except OSError:
+                    pass
+                return
+            with self.uplink.lock:
+                time.sleep(len(chunk) / self.uplink.bytes_per_s)
+            try:
+                dst.sendall(chunk)
+            except OSError:
+                return
 
 
 def measure_device_scaling(args):
@@ -1010,6 +1095,211 @@ def measure_federation(args, quick: bool = False):
                 "remoting: one tenant's aggregate row rate grows with "
                 "workers that were previously unreachable.",
     }
+    return result
+
+
+def measure_fabric(args, quick: bool = False):
+    """Peer-fabric ring AllReduce cells (ISSUE 19, the peer-fabric
+    section of docs/federation.md): the same weak-scaled data-parallel
+    training shape as measure_federation, but the collective rides the
+    protocol-v9 ZERO-RELAY ring — worker→worker reduce/install hops
+    over direct peer links — measured in the asymmetric topology the
+    fabric exists for.  Every client↔worker byte crosses ONE shared
+    bandwidth-budgeted uplink (`_SharedUplink`, the remote user's
+    NIC); workers dial each other over fat low-latency per-pair links
+    (`peer_url` on each RemoteDevice points past the uplink proxy).
+    Client-coordinated collectives pay O(n · partial) of serialized
+    uplink time per step; the fabric ring pays receipts only — the
+    federation's `client_relay_bytes` ledger must stay EXACTLY 0
+    across the timed window, and weak-scaled aggregate at the top
+    worker count must beat PR 13's client-coordinated 3.15x on this
+    cell.  The full run also records (a) the flat client-coordinated
+    path at the same shape — what the relay actually costs here — and
+    (b) a per-leg q8 ring (uploads stay exact: the borrowed devices
+    never opt in, only the fabric hop legs quantize)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorfusion_tpu.remoting import FederatedDevice, RemoteDevice
+
+    B, D = args.fabric_rows, args.fabric_dim
+    steps = args.fabric_steps
+    rounds = 2 if quick else 3
+    rng = np.random.default_rng(0)
+    W0 = (rng.standard_normal((D, D)) * 0.05).astype(np.float32)
+
+    def grad_fn(w, x):
+        return x.T @ jnp.tanh(x @ w)
+
+    def run_cell(n_workers: int, quantize: bool = False,
+                 use_fabric: bool = True):
+        procs, proxies, devs = [], [], []
+        uplink = _SharedUplink(args.fabric_client_mbps * 1e6)
+        try:
+            for _ in range(n_workers):
+                proc, port = _spawn_worker()
+                procs.append(proc)
+                peer = _LatencyProxy(port,
+                                     args.fabric_peer_rtt_ms / 2e3)
+                cli = _SharedUplinkProxy(port, uplink)
+                proxies += [peer, cli]
+                devs.append(RemoteDevice(
+                    f"tcp://127.0.0.1:{cli.port}",
+                    peer_url=f"tcp://127.0.0.1:{peer.port}"))
+            # devices are borrowed (and stay exact): only the fabric
+            # hop legs quantize, via the federation-level flag
+            fed = FederatedDevice(devs, quantize=quantize)
+            ffn = fed.federated_jit(grad_fn, in_axes=(None, 0),
+                                    out_modes="sum")
+            # per-cell seed keyed by worker count ONLY, same
+            # discipline as the federation cells
+            x = np.random.default_rng(100 + n_workers) \
+                .standard_normal((n_workers * B, D)).astype(np.float32)
+            wh = ffn.upload_arg(0, W0, W0, x)
+            xh = ffn.upload_arg(1, x, W0, x)
+            # warm: per-worker compile + one full step + collective
+            step = ffn.step_resident(wh, xh)
+            fed.all_reduce(step.handles, free_src=True,
+                           overlap_with=step, fetch_value=False,
+                           prefer_fabric=use_fabric)
+            snap0 = fed.fed_snapshot()
+            dt = None
+            for _ in range(rounds):
+                t0 = time.perf_counter()
+                prev = None
+                for _ in range(steps):
+                    step = ffn.step_resident(wh, xh)
+                    if prev is not None:
+                        # the T3 shape: reduce microbatch m while
+                        # every worker computes microbatch m+1; the
+                        # receipt-only regime — reduced grads stay
+                        # resident-equivalent, nothing is pulled back
+                        fed.all_reduce(prev.handles, free_src=True,
+                                       overlap_with=step,
+                                       fetch_value=False,
+                                       prefer_fabric=use_fabric)
+                    prev = step
+                fed.all_reduce(prev.handles, free_src=True,
+                               fetch_value=False,
+                               prefer_fabric=use_fabric)
+                round_dt = (time.perf_counter() - t0) / steps
+                dt = round_dt if dt is None else min(dt, round_dt)
+            snap1 = fed.fed_snapshot()
+            n_colls = steps * rounds
+            # numerics leg OUTSIDE the timed/ledger window: one more
+            # reduce with the value pulled back over the uplink
+            step = ffn.step_resident(wh, xh)
+            out = fed.all_reduce(step.handles, free_src=True,
+                                 prefer_fabric=use_fabric)
+            value = np.asarray(out["value"], np.float32)
+            cell = {
+                "workers": n_workers,
+                "quantize": bool(quantize),
+                "fabric": bool(use_fabric and fed.fabric_supported()),
+                "step_ms": round(dt * 1e3, 3),
+                "rows_per_s": round(n_workers * B / dt, 1),
+                "client_relay_bytes_per_step":
+                    int(snap1["client_relay_bytes"]
+                        - snap0["client_relay_bytes"]) // n_colls,
+                "collective_raw_bytes_per_step":
+                    int(snap1["collective_raw_bytes"]
+                        - snap0["collective_raw_bytes"]) // n_colls,
+                "collective_wire_bytes_per_step":
+                    int(snap1["collective_wire_bytes"]
+                        - snap0["collective_wire_bytes"]) // n_colls,
+                "fabric_rings": int(snap1["fabric_rings_total"]
+                                    - snap0["fabric_rings_total"]),
+            }
+            for dev in devs:
+                dev.close()
+            devs = []
+            return cell, value, x
+        finally:
+            for dev in devs:
+                dev.close()
+            for proxy in proxies:
+                proxy.close()
+            for proc in procs:
+                proc.terminate()
+                proc.wait(timeout=10)
+
+    worker_counts = (1, 4) if quick else (1, 2, 4)
+    cells = []
+    values = {}
+    for n in worker_counts:
+        cell, value, x = run_cell(n)
+        cells.append(cell)
+        values[n] = (value, x)
+    base = cells[0]["rows_per_s"]
+    for c in cells:
+        c["aggregate_vs_1worker"] = round(c["rows_per_s"] / base, 2)
+        c["scaling_efficiency"] = round(
+            c["rows_per_s"] / base / c["workers"], 3)
+
+    # numerics guardrail, raw ring: must match the local full-batch
+    # reference to float-sum tolerance
+    n_max = worker_counts[-1]
+    value, x = values[n_max]
+    want = np.asarray(jax.jit(grad_fn)(jnp.asarray(W0),
+                                       jnp.asarray(x)), np.float32)
+    scale = max(float(np.abs(want).max()), 1e-9)
+    raw_rel_err = float(np.abs(value - want).max()) / scale
+    numerics_ok = raw_rel_err < 1e-4
+
+    result = {
+        "mode": "weak scaling (fixed rows per worker), data-parallel "
+                "resident microbatch steps + zero-relay fabric ring "
+                "AllReduce of the previous step's partials overlapped "
+                "with the current step's compute; every client<->"
+                "worker byte through ONE shared "
+                f"{args.fabric_client_mbps}MB/s uplink, worker<->"
+                "worker hops over per-pair "
+                f"{args.fabric_peer_rtt_ms}ms-RTT peer links",
+        "rows_per_worker": B, "dim": D, "steps": steps,
+        "client_uplink_mbps": args.fabric_client_mbps,
+        "peer_rtt_ms": args.fabric_peer_rtt_ms,
+        "cells": cells,
+        "workers_at_max": n_max,
+        "aggregate_vs_1worker_at_max":
+            cells[-1]["aggregate_vs_1worker"],
+        "client_relay_bytes_at_max":
+            cells[-1]["client_relay_bytes_per_step"],
+        "raw_rel_err": round(raw_rel_err, 9),
+        "numerics_ok": bool(numerics_ok),
+        "note": "single-core CI box: member workers serialize "
+                "compute, so the cells are latency/protocol-bound by "
+                "construction (same discipline as the federation "
+                "cells); the 1-worker baseline pays the SAME loop "
+                "shape (its one partial crosses the uplink per "
+                "step).  On real multi-host chips per-worker compute "
+                "parallelism is additive.",
+    }
+
+    if not quick:
+        # what the client relay actually costs on this topology: the
+        # flat client-coordinated path (PR 13's recorded winner) at
+        # the same shape — every partial serializes down the shared
+        # uplink
+        relay_cell, _, _ = run_cell(n_max, use_fabric=False)
+        relay_cell["aggregate_vs_1worker"] = round(
+            relay_cell["rows_per_s"] / base, 2)
+        result["client_relay_flat"] = relay_cell
+
+        # per-leg q8 ring: hop bytes must land >=2x under raw with
+        # numerics inside a loose per-leg accumulation bound ((n-1)
+        # quantized reduce hops + a quantized install hop, block
+        # scales make the realized error far tighter)
+        q8_cell, q8_value, _ = run_cell(n_max, quantize=True)
+        ratio = cells[-1]["collective_wire_bytes_per_step"] / \
+            max(q8_cell["collective_wire_bytes_per_step"], 1)
+        q8_bound = 2.0 * n_max * scale / 127.0 * 1.2
+        q8_err = float(np.abs(q8_value - want).max())
+        result["q8"] = dict(q8_cell,
+                            bytes_ratio_vs_raw=round(ratio, 2),
+                            max_abs_err=round(q8_err, 6),
+                            err_bound=round(q8_bound, 6))
+        result["numerics_ok"] = bool(numerics_ok
+                                     and q8_err <= q8_bound)
     return result
 
 

@@ -24,6 +24,13 @@ Analogs of the reference's heaviest lifecycle machinery:
   budget (``constants.QOS_MIGRATION_PAUSE_BUDGET_MS``), and only then
   is the tenant frozen for one bounded final round before the binding
   flips.  Hot tenants that never converge fall back to stop-and-copy.
+  Since protocol v9 the source worker's delta rounds ride a POOLED
+  peer-fabric link to the target (``remoting/fabric.py``,
+  docs/federation.md "peer fabric") — the same worker↔worker
+  transport the collective ring hops and KV ships use, so successive
+  rounds of one migration (and successive migrations to the same
+  target) reuse the dialed session, with a stale-uid re-dial when the
+  target restarted between rounds.
 """
 
 from __future__ import annotations

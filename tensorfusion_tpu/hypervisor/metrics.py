@@ -107,6 +107,8 @@ def federation_lines(fed, node_name: str, ts: int,
         {"workers": snap["workers"],
          "allreduce_total": snap["allreduce_total"],
          "allgather_total": snap["allgather_total"],
+         "fabric_rings_total": snap.get("fabric_rings_total", 0),
+         "client_relay_bytes_total": snap.get("client_relay_bytes", 0),
          "shard_execs_total": snap["shard_execs_total"],
          "fallback_calls_total": snap["fallback_calls_total"],
          "collective_raw_bytes_total": snap["collective_raw_bytes"],

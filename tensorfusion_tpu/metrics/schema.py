@@ -116,6 +116,7 @@ METRICS_SCHEMA = {
     "tpf_fed_collective": {
         "tags": ("node", "federation"),
         "fields": ("workers", "allreduce_total", "allgather_total",
+                   "fabric_rings_total", "client_relay_bytes_total",
                    "shard_execs_total", "fallback_calls_total",
                    "collective_raw_bytes_total",
                    "collective_wire_bytes_total",

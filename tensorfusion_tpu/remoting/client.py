@@ -301,12 +301,21 @@ class RemoteDevice:
                  qos: Optional[str] = None,
                  tracer=None,
                  quantize: Optional[bool] = None,
-                 upload_depth: Optional[int] = None):
+                 upload_depth: Optional[int] = None,
+                 peer_url: Optional[str] = None):
         # url: "tcp://host:port"
         if url.startswith("tcp://"):
             url = url[len("tcp://"):]
         host, _, port = url.rpartition(":")
         self.host, self.port = host or "127.0.0.1", int(port)
+        #: canonical dial url (fabric ring rosters quote it verbatim)
+        self.url = f"tcp://{self.host}:{self.port}"
+        #: the address OTHER workers dial for peer-fabric legs — equals
+        #: ``url`` unless the topology is asymmetric (the client rides
+        #: a thin shared uplink / a proxy while workers see each other
+        #: over the fat DCN directly; the fabric bench models exactly
+        #: that split)
+        self.peer_url = str(peer_url) if peer_url else self.url
         self.token = token if token is not None else \
             os.environ.get("TPF_REMOTING_TOKEN", "")
         self.timeout_s = timeout_s
@@ -357,6 +366,8 @@ class RemoteDevice:
         self.protocol_version = protocol_version
         #: negotiated per connection by the HELLO exchange
         self._wire_version = 2
+        #: target's process-unique id, learned at HELLO (v9+, else None)
+        self.worker_uid: Optional[str] = None
         self._sock: Optional[socket.socket] = None
         self._send_lock = threading.Lock()
         self._state_lock = threading.Lock()
@@ -417,6 +428,9 @@ class RemoteDevice:
                 meta.get("error", "remoting handshake failed"))
         self._wire_version = max(2, min(self.protocol_version,
                                         int(meta.get("version", 2))))
+        # fresh per worker process (v9+); the peer-fabric pool's
+        # staleness oracle — absent from pre-v9 workers
+        self.worker_uid = meta.get("worker_uid")
         if meta.get("qos_weight") is not None:
             self.qos_weight = float(meta["qos_weight"])
         # per-request deadlines are enforced via Future.result(timeout_s);
@@ -953,6 +967,58 @@ class RemoteDevice:
         """A fresh client-minted c-namespace buffer id (install targets
         for the federated re-scatter leg)."""
         return f"c-f{next(self._mint)}-{tag}"
+
+    # -- peer fabric (protocol v9, docs/federation.md) -----------------
+
+    def fabric_open(self, cid: str) -> Dict[str, Any]:
+        """Rendezvous one worker into fabric collective ``cid``: the
+        worker parks a peer-fabric session keyed by ``cid`` so the
+        PEER_REDUCE / PEER_INSTALL hops its ring neighbours dial in
+        can never race the FABRIC_ALLREDUCE leg that consumes them.
+        The orchestrator opens EVERY ring member before launching any
+        leg.  Needs a protocol-v9 worker — a pre-v9 connection raises
+        before anything hits the wire (the client half of the double
+        gate)."""
+        self._ensure_version(protocol.FABRIC_MIN_VERSION,
+                             "FABRIC_OPEN (peer fabric)")
+        _, meta, _ = self._rpc("FABRIC_OPEN", {"cid": str(cid)}, [])
+        return meta
+
+    def fabric_allreduce(self, cid: str, buf_ids, ring, index: int,
+                         result_id: str, op: str = "sum",
+                         free_src: bool = False, quant: bool = False,
+                         wait: bool = False,
+                         stats: Optional[Dict[str, int]] = None):
+        """Launch this worker's leg of a zero-relay ring AllReduce
+        (protocol-v9 ``FABRIC_ALLREDUCE``, docs/federation.md "peer
+        fabric"): the worker pre-reduces its resident partials
+        ``buf_ids`` locally, then runs its slot in the accumulator
+        ring described by ``ring`` (ordered ``[{"url": ...}, ...]``,
+        this worker at ``index``) — reduce hops ride worker→worker
+        PEER_REDUCE legs (q8 per leg when ``quant``), the total fans
+        back down-ring as PEER_INSTALL hops and lands resident under
+        ``result_id`` on every member.  The reply is a RECEIPT (shape
+        / dtype / per-leg byte ledger): zero collective payload bytes
+        ride through this client.  Defaults to ``wait=False`` because
+        every member's leg must be in flight at once — resolve the
+        futures with :meth:`finish_collective`."""
+        self._ensure_version(protocol.FABRIC_MIN_VERSION,
+                             "FABRIC_ALLREDUCE (peer fabric)")
+        meta: Dict[str, Any] = {
+            "cid": str(cid),
+            "buf_ids": [str(b) for b in buf_ids],
+            "ring": [{"url": str(m.get("url", ""))} for m in ring],
+            "index": int(index),
+            "result_id": str(result_id),
+            "op": str(op)}
+        if free_src:
+            meta["free_src"] = True
+        if quant:
+            meta["quant"] = True
+        fut = self._submit("FABRIC_ALLREDUCE", meta, [], stats=stats)
+        if not wait:
+            return fut
+        return self.finish_collective(fut)
 
     def snapshot(self, state_dir: str) -> Dict[str, Any]:
         _, meta, _ = self._rpc("SNAPSHOT", {"state_dir": state_dir}, [])

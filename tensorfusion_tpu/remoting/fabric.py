@@ -1,0 +1,282 @@
+"""Worker↔worker peer-session transport (protocol v9).
+
+The unified data fabric (docs/federation.md "peer fabric"): every byte
+path between two workers — streaming-migration delta rounds, KV_SHIP
+between serving engines, and the zero-relay collective reduce/install
+hops — rides one :class:`PeerLink`, which is one pooled
+:class:`~.client.RemoteDevice` session framed by the SAME wire
+protocol the python client speaks.  That buys each path, for free:
+
+- the q8/zlib adaptive encoder (per-leg quantization — the EQuARX
+  compression point applied to worker↔worker traffic);
+- the ``_UploadStream`` double-buffered sender for staged quiet
+  ephemeral PUTs (while the stream thread ships buffer k the caller
+  is already slicing k+1);
+- the target worker's WFQ dispatcher tenancy (a peer dials in as a
+  first-class connection, so peer traffic is weighed, attributed and
+  flight-recorded like any tenant — the PR 15 ``migration`` tenant
+  generalized);
+- HELLO version negotiation, so a fabric hop can never smuggle a v9
+  opcode to a pre-v9 peer (the double gate lives in client.py and
+  worker.py; the link just inherits it).
+
+Links are pooled per ``(target_url, token, quantize)`` with an idle
+TTL (:data:`PEER_LINK_IDLE_TTL_S`) instead of dialed per session.
+HELLO_OK's ``worker_uid`` (fresh per worker process) is the staleness
+oracle: a pooled link re-verified on lease whose target restarted
+reports a changed uid and is replaced by a fresh dial — pooled
+transport must never imply staged state survived the peer's restart.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import constants
+from . import protocol
+from .client import RemoteDevice, _UploadStream
+
+log = logging.getLogger("tensorfusion_tpu.remoting.fabric")
+
+#: seconds a pooled peer link may sit idle before the sweep closes it
+PEER_LINK_IDLE_TTL_S = float(os.environ.get("TPF_FABRIC_IDLE_TTL_S",
+                                            "60.0"))
+
+#: a link used within this window skips the worker_uid round-trip on
+#: lease: a target restart inside the window necessarily severed the
+#: TCP session, so the next frame errors loudly instead of silently
+#: landing on the impostor — the uid oracle protects STAGED state
+#: across idle gaps, not mid-burst hops.  Without the window a hot
+#: ring pays one INFO RTT per hop leg.
+PEER_LINK_VERIFY_FRESH_S = float(os.environ.get(
+    "TPF_FABRIC_VERIFY_FRESH_S", "1.0"))
+
+
+class PeerLink:
+    """One worker→worker session: a :class:`RemoteDevice` plus the
+    lazily-created double-buffered upload stream for staged PUTs.
+
+    The link is a transport, not a session: migration sessions, ring
+    legs and KV handoffs lease a link, ride it, and release it back
+    to the :class:`PeerLinkPool` — resident/staged state they minted
+    on the target belongs to THEM (tracked by their own ids), while
+    the link only carries bytes.  ``generation`` increments every time
+    the pool had to re-dial the same key (target restart), so a
+    holder that cached target-side state can detect it went stale.
+    """
+
+    def __init__(self, url: str, token: str = "",
+                 qos: str = constants.DEFAULT_QOS,
+                 quantize: bool = False) -> None:
+        self.url = url
+        self.token = token
+        self.qos = qos
+        self.quantize = bool(quantize)
+        self.device = RemoteDevice(url, token=token, qos=qos,
+                                   quantize=quantize)
+        self._stream: Optional[_UploadStream] = None
+        self.worker_uid: Optional[str] = None
+        self.generation = 0
+        self.raw_bytes = 0
+        self.wire_bytes = 0
+        self.last_used_m = time.monotonic()
+
+    # -- staged uploads (the migration / KV page path) ----------------
+
+    def stage(self, buf_id: str, host: np.ndarray,
+              stats: Optional[Dict[str, int]] = None) -> None:
+        """Stage one quiet client-minted PUT on the double-buffered
+        upload stream (q8-eligible when the link negotiated quant).
+        ``ephemeral`` is deliberately NOT set — staged migration / KV
+        state survives until its owner binds or frees it."""
+        if self._stream is None:
+            self._stream = _UploadStream(self.device,
+                                         self.device.upload_depth)
+        self._stream.submit({"buf_id": buf_id, "quiet": True}, host,
+                            stats=stats)
+
+    def drain(self) -> None:
+        """Ordering barrier: every staged PUT is on the wire before
+        the frame that references it is sent."""
+        if self._stream is not None:
+            self._stream.drain()
+
+    # -- framed peer hops (the collective ring path) ------------------
+
+    def ship_reduce(self, cid: str, step: int, payload: np.ndarray,
+                    op: str = "sum") -> Dict[str, Any]:
+        """One PEER_REDUCE hop: ship the running sum to the next ring
+        member and block on its ack (the ring's backpressure).  The
+        payload rides as the single frame buffer, q8-eligible when
+        this link negotiated quantized uploads."""
+        self.device._ensure_version(protocol.FABRIC_MIN_VERSION,
+                                    "PEER_REDUCE (peer fabric)")
+        arr = np.ascontiguousarray(np.asarray(payload))
+        st: Dict[str, int] = {}
+        fut = self.device._submit(
+            "PEER_REDUCE", {"cid": str(cid), "step": int(step),
+                            "op": str(op)}, [arr], stats=st)
+        _, rmeta, _ = self.device._result(fut)
+        self.raw_bytes += int(st.get("raw_bytes", 0))
+        self.wire_bytes += int(st.get("wire_bytes", 0))
+        self.touch()
+        return rmeta
+
+    def ship_install(self, cid: str, step: int,
+                     payload: np.ndarray) -> Dict[str, Any]:
+        """One PEER_INSTALL hop: fan the reduced total down-ring."""
+        self.device._ensure_version(protocol.FABRIC_MIN_VERSION,
+                                    "PEER_INSTALL (peer fabric)")
+        arr = np.ascontiguousarray(np.asarray(payload))
+        st: Dict[str, int] = {}
+        fut = self.device._submit(
+            "PEER_INSTALL", {"cid": str(cid), "step": int(step)},
+            [arr], stats=st)
+        _, rmeta, _ = self.device._result(fut)
+        self.raw_bytes += int(st.get("raw_bytes", 0))
+        self.wire_bytes += int(st.get("wire_bytes", 0))
+        self.touch()
+        return rmeta
+
+    # -- lifecycle ----------------------------------------------------
+
+    def verify(self) -> bool:
+        """Re-verify a pooled link on lease: dial (or transparently
+        reconnect) and compare the target's ``worker_uid`` against the
+        one this link last saw.  False means the target restarted —
+        the pool replaces the link so no holder trusts staged state
+        that died with the old process."""
+        try:
+            self.device.info()
+        except Exception as e:
+            log.debug("peer link %s verify failed: %s", self.url, e)
+            return False
+        uid = getattr(self.device, "worker_uid", None)
+        if self.worker_uid is None:
+            self.worker_uid = uid
+            return True
+        return uid is None or uid == self.worker_uid
+
+    def touch(self) -> None:
+        self.last_used_m = time.monotonic()
+
+    def close(self) -> None:
+        try:
+            self.device.close()
+        except Exception as e:  # best-effort teardown
+            log.debug("peer link close failed: %s", e)
+
+
+class PeerLinkPool:
+    """Pool of idle :class:`PeerLink` sessions keyed by
+    ``(target_url, token, quantize)``.
+
+    ``lease()`` pops a pooled link for the key (re-verifying the
+    target's ``worker_uid`` and re-dialing when the target restarted;
+    links used within ``verify_fresh_s`` skip the uid round-trip —
+    see :data:`PEER_LINK_VERIFY_FRESH_S`) or dials fresh;
+    ``release()`` parks the link for reuse and sweeps links idle past
+    the TTL.  Leased links are NOT tracked — exactly
+    one holder owns a link at a time, so two concurrent migrations /
+    ring legs to the same target get two links instead of interleaved
+    frames.
+    """
+
+    def __init__(self, idle_ttl_s: float = PEER_LINK_IDLE_TTL_S,
+                 verify_fresh_s: float = PEER_LINK_VERIFY_FRESH_S
+                 ) -> None:
+        self.idle_ttl_s = float(idle_ttl_s)
+        self.verify_fresh_s = float(verify_fresh_s)
+        self._idle: Dict[Tuple[str, str, bool], List[PeerLink]] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+        self.stats = {"leases": 0, "hits": 0, "dials": 0,
+                      "redials": 0, "expired": 0}
+
+    def lease(self, url: str, token: str = "",
+              qos: str = constants.DEFAULT_QOS,
+              quantize: bool = False) -> PeerLink:
+        key = (str(url), str(token), bool(quantize))
+        pooled: Optional[PeerLink] = None
+        with self._lock:
+            self.stats["leases"] += 1
+            bucket = self._idle.get(key)
+            if bucket:
+                pooled = bucket.pop()
+                if not bucket:
+                    del self._idle[key]
+        if pooled is not None:
+            fresh = (time.monotonic() - pooled.last_used_m
+                     <= self.verify_fresh_s)
+            if fresh or pooled.verify():
+                self.stats["hits"] += 1
+                pooled.touch()
+                return pooled
+            # target restarted (or the link died): replace it, bumping
+            # the generation so holders know staged state is gone
+            gen = pooled.generation + 1
+            pooled.close()
+            with self._lock:
+                self.stats["redials"] += 1
+            fresh = PeerLink(url, token=token, qos=qos,
+                             quantize=quantize)
+            fresh.generation = gen
+            return fresh
+        with self._lock:
+            self.stats["dials"] += 1
+        return PeerLink(url, token=token, qos=qos, quantize=quantize)
+
+    def release(self, link: PeerLink) -> None:
+        """Park a link for reuse (and opportunistically sweep expired
+        idles).  After the pool closed, released links are closed
+        instead of parked."""
+        link.touch()
+        if link.worker_uid is None:
+            # bind the uid the link actually spoke to, so the next
+            # lease's verify() can detect a restart in between
+            link.worker_uid = getattr(link.device, "worker_uid", None)
+        key = (link.url, link.token, link.quantize)
+        with self._lock:
+            if self._closed:
+                closing = [link]
+            else:
+                self._idle.setdefault(key, []).append(link)
+                closing = self._sweep_locked()
+        for stale in closing:
+            stale.close()
+
+    def _sweep_locked(self) -> List[PeerLink]:
+        now = time.monotonic()
+        expired: List[PeerLink] = []
+        for key in list(self._idle):
+            bucket = self._idle[key]
+            keep = [ln for ln in bucket
+                    if now - ln.last_used_m <= self.idle_ttl_s]
+            dead = [ln for ln in bucket if ln not in keep]
+            if dead:
+                expired.extend(dead)
+                self.stats["expired"] += len(dead)
+            if keep:
+                self._idle[key] = keep
+            else:
+                del self._idle[key]
+        return expired
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            open_links = sum(len(b) for b in self._idle.values())
+            return dict(self.stats, idle_links=open_links)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            links = [ln for b in self._idle.values() for ln in b]
+            self._idle.clear()
+        for ln in links:
+            ln.close()

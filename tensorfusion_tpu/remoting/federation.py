@@ -25,6 +25,23 @@ than one worker.  :class:`FederatedDevice` composes N
   client-coordinated: flat (concurrent collect legs, client sums) by
   default, or — ``ring=True``, N > 2 — a client-relayed ring through
   the workers that bounds client memory to one partial.
+- **zero-relay fabric ring** (protocol v9, docs/federation.md "peer
+  fabric") — when every member speaks v9, ``ring=True`` routes to the
+  TRUE ring instead: the client only orchestrates (FABRIC_OPEN
+  rendezvous + one FABRIC_ALLREDUCE leg per member, receipt replies),
+  while the reduce and install hops ride worker→worker
+  :class:`~.fabric.PeerLink` sessions with per-leg q8 — ZERO
+  collective payload bytes cross the client NIC (the
+  ``client_relay_bytes`` ledger entry stays 0), and the result lands
+  resident on every member.  The legacy client-relayed ring is
+  DEPRECATED and kept only for v7/v8 peers (bit-compatible,
+  regression-pinned).
+- **cross-worker model parallelism** (:meth:`FederatedDevice.
+  model_parallel_jit`) — one tenant's layers span workers: the XLA
+  program splits around the cross-worker ``psum`` (stage1 computes a
+  partial from each worker's weight shard, the fabric ring reduces,
+  stage2 continues from the reduced activation every member holds
+  resident).
 - **compute/transfer overlap** (the T3 discipline) — per-worker
   microbatch steps are fire-and-forget resident chains
   (``step_resident(acked=True)``); the collective for microbatch *m*
@@ -50,6 +67,7 @@ it replaces (mixed-version tested, docs/federation.md).
 
 from __future__ import annotations
 
+import itertools
 import logging
 import os
 import threading
@@ -162,13 +180,20 @@ class FederatedDevice:
         self.ring = bool(ring)
         self.ring_min_workers = max(2, int(ring_min_workers))
         self._fed_ok: Optional[bool] = None
+        self._fab_ok: Optional[bool] = None
+        #: fabric collective ids — unique per federation instance
+        self._fab_mint = itertools.count()
         self._lock = threading.Lock()
-        #: collective ledger (fed_snapshot / tpf_fed_collective lines)
+        #: collective ledger (fed_snapshot / tpf_fed_collective lines);
+        #: client_relay_bytes counts every collective payload byte that
+        #: crossed THIS client's NIC — the fabric ring keeps it at 0
         # guarded by: _lock
         self._stats: Dict[str, float] = {
             "allreduce_total": 0, "allgather_total": 0,
+            "fabric_rings_total": 0,
             "fallback_calls_total": 0, "shard_execs_total": 0,
             "collective_raw_bytes": 0, "collective_wire_bytes": 0,
+            "client_relay_bytes": 0,
             "hidden_s": 0.0, "exposed_s": 0.0}
 
     # -- mesh composition ----------------------------------------------
@@ -196,6 +221,27 @@ class FederatedDevice:
                     "a member negotiated < v%d",
                     protocol.FED_MIN_VERSION)
         return self._fed_ok
+
+    def fabric_supported(self) -> bool:
+        """True when the zero-relay peer fabric is live: at least two
+        workers and EVERY member negotiated >= v9 (the fabric kinds
+        plus HELLO_OK's ``worker_uid``).  Cached after first probe;
+        anything less keeps ``ring=True`` on the DEPRECATED
+        client-relayed ring — bit-compatible with PR 13, zero v9
+        frames on the wire (docs/federation.md)."""
+        if self._fab_ok is None:
+            ok = self.fed_supported() and len(self.workers) > 1
+            for dev in self.workers:
+                if dev._wire_version < protocol.FABRIC_MIN_VERSION:
+                    ok = False
+            self._fab_ok = ok
+            if not ok and self.ring and len(self.workers) > 1:
+                log.warning(
+                    "fabric ring unavailable (a member negotiated "
+                    "< v%d): ring=True stays on the deprecated "
+                    "client-relayed ring",
+                    protocol.FABRIC_MIN_VERSION)
+        return self._fab_ok
 
     def info(self) -> Dict[str, Any]:
         """Aggregate mesh inventory: per-worker INFO plus the logical
@@ -296,34 +342,45 @@ class FederatedDevice:
 
     def all_reduce(self, handles: Sequence, op: str = "sum",
                    install: bool = False, free_src: bool = False,
-                   overlap_with: Optional[FedStep] = None
+                   overlap_with: Optional[FedStep] = None,
+                   fetch_value: bool = True,
+                   prefer_fabric: Optional[bool] = None
                    ) -> Dict[str, Any]:
         """Cross-worker AllReduce of per-worker resident partials.
 
         ``handles``: one handle (or id list) per worker, mesh order.
         Flat mode (default): every worker's collect leg is in flight
         concurrently, the client sums slices in mesh order — the
-        latency-bound DCN winner.  Ring mode (``ring=True`` and N >=
-        ring_min_workers): the running accumulator is relayed through
-        the workers — each hop sums worker-side and the accumulator
-        rides the upload stream as q8-eligible quiet PUTs, so the
-        client never holds more than one partial and the reduce
-        compute stays on the workers (N sequential hops).
+        latency-bound DCN winner.  ``ring=True`` routes through the
+        ZERO-RELAY fabric ring whenever every member speaks v9
+        (:meth:`fabric_supported`): reduce and install hops ride
+        worker→worker PeerLinks with per-leg q8, the client only
+        collects receipts, and the result lands resident on every
+        member.  For v7/v8 members the DEPRECATED client-relayed ring
+        (N >= ring_min_workers) is kept bit-compatible: the running
+        accumulator is relayed through the workers, each hop summed
+        worker-side, so the client never holds more than one partial.
 
-        ``install=True`` re-scatters the reduced array back to every
-        worker as a resident buffer (fire-and-forget install legs,
-        ordered before later EXECUTEs by each connection's FIFO) and
-        returns the per-worker :class:`RemoteBuffer` handles.
-        ``free_src`` retires the partials with the reduce.
-        ``overlap_with`` (a :class:`FedStep`) feeds the overlap
-        ledger: collective wall time spent while that step's compute
-        was still in flight counts as hidden transfer.
+        ``install=True`` returns per-worker :class:`RemoteBuffer`
+        handles of the reduced array resident on every worker (the
+        fabric ring installs inherently; the client-coordinated paths
+        re-scatter with fire-and-forget install legs).  ``free_src``
+        retires the partials with the reduce.  ``overlap_with`` (a
+        :class:`FedStep`) feeds the overlap ledger: collective wall
+        time spent while that step's compute was still in flight
+        counts as hidden transfer.  ``fetch_value=False`` skips
+        pulling the reduced array back over the fabric ring (the
+        receipt-only regime the zero-relay gate measures);
+        ``prefer_fabric`` overrides the ``ring`` ctor flag for this
+        call.
 
-        Returns ``{"value": np.ndarray, "handles": [...] | None,
-        "raw_bytes", "wire_bytes", "hidden_s", "dur_s"}``.
+        Returns ``{"value": np.ndarray | None, "handles": [...] |
+        None, "raw_bytes", "wire_bytes", "hidden_s", "dur_s"}``.
         """
         if not self.fed_supported():
             return self._fallback_reduce(handles, free_src=free_src)
+        fabric = (self.ring if prefer_fabric is None
+                  else bool(prefer_fabric)) and self.fabric_supported()
         span = None
         if self.tracer is not None:
             span = self.tracer.start_span(
@@ -332,9 +389,17 @@ class FederatedDevice:
         t0 = time.monotonic()
         raw = wire = 0
         try:
-            ring = self.ring and \
+            ring = (not fabric) and self.ring and \
                 len(self.workers) >= self.ring_min_workers
-            if ring:
+            if fabric:
+                total, out_handles, raw, wire = \
+                    self._fabric_ring_reduce(
+                        handles, op=op, install=install,
+                        free_src=free_src, fetch_value=fetch_value)
+            elif ring:
+                # DEPRECATED client-relayed ring, kept bit-compatible
+                # for v7/v8 members (regression-pinned): every
+                # accumulator byte crosses the client NIC twice
                 total = None
                 for dev, h in zip(self.workers, handles):
                     stats: Dict[str, int] = {}
@@ -358,20 +423,23 @@ class FederatedDevice:
                     raw += r
                     wire += w
                     total = part if total is None else total + part
-            out_handles = None
-            if install:
-                out_handles = self._install(total)
-                raw += int(total.nbytes) * len(self.workers)
-                # install wire bytes accumulate via the per-device
-                # wire_stats; count the q8-or-raw frames we staged
-                wire += self._last_install_wire
+            if not fabric:
+                out_handles = None
+                if install:
+                    out_handles = self._install(total)
+                    raw += int(total.nbytes) * len(self.workers)
+                    # install wire bytes accumulate via the per-device
+                    # wire_stats; count the q8-or-raw frames we staged
+                    wire += self._last_install_wire
+                # every client-coordinated collective byte is relay
+                self._note(client_relay_bytes=raw)
             t1 = time.monotonic()
             hidden = self._hidden_until(t0, t1, overlap_with)
             self._attr_collective(t1 - t0, hidden, raw, wire,
                                   "allreduce")
             if span is not None:
                 span.finish(raw_bytes=raw, wire_bytes=wire,
-                            ring=int(ring),
+                            ring=int(ring), fabric=int(fabric),
                             hidden_ms=round(hidden * 1e3, 3))
             return {"value": total, "handles": out_handles,
                     "raw_bytes": raw, "wire_bytes": wire,
@@ -380,6 +448,49 @@ class FederatedDevice:
             if span is not None and span.end_s is None:
                 span.finish(error=f"{type(e).__name__}: {e}"[:200])
             raise
+
+    def _fabric_ring_reduce(self, handles: Sequence, op: str = "sum",
+                            install: bool = False,
+                            free_src: bool = False,
+                            fetch_value: bool = True) -> tuple:
+        """One zero-relay ring AllReduce over the peer fabric
+        (protocol v9): FABRIC_OPEN rendezvous on EVERY member first
+        (so no peer hop can race its session), then every member's
+        FABRIC_ALLREDUCE leg in flight at once — the legs deadlock if
+        launched sequentially, since member j blocks on member j-1's
+        reduce hop.  The client relays ZERO collective payload bytes;
+        the per-leg byte ledger comes back in the receipts.
+
+        Returns ``(value | None, handles | None, raw, wire)`` where
+        raw/wire count the worker→worker hop bytes."""
+        cid = f"fab{next(self._fab_mint)}"
+        roster = [{"url": dev.peer_url} for dev in self.workers]
+        for dev in self.workers:
+            dev.fabric_open(cid)
+        rids = [dev.mint_buf_id("fab") for dev in self.workers]
+        futs = []
+        for i, (dev, h) in enumerate(zip(self.workers, handles)):
+            futs.append((dev, dev.fabric_allreduce(
+                cid, self._handle_ids(h), roster, i, rids[i], op=op,
+                free_src=free_src, quant=bool(self.quantize))))
+        raw = wire = 0
+        shape: tuple = ()
+        dtype = "float32"
+        for dev, fut in futs:
+            rmeta, _ = dev.finish_collective(fut)
+            raw += int(rmeta.get("peer_raw_bytes", 0))
+            wire += int(rmeta.get("peer_wire_bytes", 0))
+            shape = tuple(rmeta.get("shape") or shape)
+            dtype = rmeta.get("dtype") or dtype
+        self._note(fabric_rings_total=1)
+        out = [RemoteBuffer(dev, rid, shape, dtype)
+               for dev, rid in zip(self.workers, rids)]
+        value = out[0].fetch() if fetch_value else None
+        if install:
+            return value, out, raw, wire
+        for h in out:
+            h.free()
+        return value, None, raw, wire
 
     #: wire bytes the most recent install leg staged (written by
     #: _install, read by all_reduce right after — same thread)
@@ -452,6 +563,7 @@ class FederatedDevice:
                 pieces.append(piece)
             out = pieces[0] if len(pieces) == 1 \
                 else np.concatenate(pieces, axis=axis)
+            self._note(client_relay_bytes=raw)
             t1 = time.monotonic()
             hidden = self._hidden_until(t0, t1, overlap_with)
             self._attr_collective(t1 - t0, hidden, raw, wire,
@@ -484,6 +596,24 @@ class FederatedDevice:
         ``"first"`` (replicated outputs, take member 0).  One string
         broadcasts to all outputs."""
         return FederatedFunction(self, fn, in_axes, out_modes)
+
+    def model_parallel_jit(self, stage1: Callable, stage2: Callable,
+                           stage1_in_axes=0
+                           ) -> "ModelParallelFunction":
+        """Cross-worker model parallelism: one tenant's layers span
+        workers.  The XLA program is split around the cross-worker
+        ``psum``: ``stage1`` computes each worker's PARTIAL (one
+        array) from its shard of the weights (``stage1_in_axes``
+        names the axis each argument splits across workers — the
+        contraction axis of the sharded matmul, NOT the batch axis),
+        the partials AllReduce across the fabric ring (zero collective
+        bytes through this client when every member speaks v9), and
+        ``stage2`` continues from the reduced activation every member
+        now holds resident — the layering data parallelism could
+        never host, because no single worker ever materializes the
+        full contraction."""
+        return ModelParallelFunction(self, stage1, stage2,
+                                     stage1_in_axes)
 
 
 class FederatedFunction:
@@ -697,3 +827,53 @@ class FederatedFunction:
             handles.append(out)
             futs.append(fut)
         return FedStep(handles, futs)
+
+
+class ModelParallelFunction:
+    """The callable :meth:`FederatedDevice.model_parallel_jit`
+    returns: ``stage2(psum(stage1(args)))`` with the ``psum`` crossing
+    workers.
+
+    The forward is three beats — (1) every worker's stage1 slice
+    launches fire-and-forget resident (``step_resident``), (2) the
+    partials AllReduce over the fabric ring (receipt-only: the
+    reduced activation lands resident on every member, nothing rides
+    back here), (3) stage2 runs from the installed activation handles
+    and replicated outputs gather ``"first"``.  The ring's hops hide
+    under beat 1's compute via the overlap ledger.  Degraded
+    federations (any member < v7) compose both stages on worker 0 —
+    a psum over one member is the identity."""
+
+    def __init__(self, fed: FederatedDevice, stage1: Callable,
+                 stage2: Callable, stage1_in_axes=0):
+        self.fed = fed
+        self.stage1 = stage1
+        self.stage2 = stage2
+        self._s1 = fed.federated_jit(stage1, in_axes=stage1_in_axes,
+                                     out_modes="sum")
+        self._s2 = fed.federated_jit(stage2, in_axes=None,
+                                     out_modes="first")
+        self._fb: Optional[tuple] = None
+
+    def _fallback(self) -> tuple:
+        if self._fb is None:
+            dev = self.fed.workers[0]
+            self._fb = (dev.remote_jit(self.stage1),
+                        dev.remote_jit(self.stage2))
+        return self._fb
+
+    def __call__(self, *args):
+        fed = self.fed
+        if not fed.fed_supported():
+            fed._note(fallback_calls_total=1)
+            s1, s2 = self._fallback()
+            return s2(s1(*args))
+        step = self._s1.step_resident(*args)
+        red = fed.all_reduce(step.handles, install=True,
+                             free_src=True, overlap_with=step,
+                             fetch_value=False, prefer_fabric=True)
+        out = self._s2(red["handles"])
+        if red["handles"] is not None:
+            for h in red["handles"]:
+                h.free()
+        return out

@@ -230,6 +230,53 @@ v2-v7 peers never see them:
 - MIGRATE_COMMIT_OK: source role — ``pause_ms`` / ``rounds`` /
   ``buffers`` / ``raw_bytes`` / ``wire_bytes``; target role —
   ``installed`` / ``executables``.
+
+Version 9 carries the peer-fabric opcodes (remoting/fabric.py,
+docs/federation.md "peer fabric" section) — worker↔worker data-plane
+sessions over the SAME framed protocol, so every byte path between
+workers (migration delta rounds, KV_SHIP between engines, collective
+reduce legs) rides one transport with one q8/zlib encoder, one
+``_UploadStream`` double-buffering discipline, and one WFQ tenant
+model.  HELLO-negotiated exactly like v3-v8 with the double version
+gate: the client refuses to send FABRIC kinds on a < v9 connection
+AND the worker refuses to honor any v9 kind from one, so v2-v8 peers
+never see them.  HELLO_OK additionally carries the worker's
+``worker_uid`` (fresh per process) so pooled peer links detect a
+restarted target and re-dial instead of trusting stale residency:
+
+- FABRIC_OPEN: client -> worker rendezvous for one collective —
+  ``cid`` names the ring instance.  Replaces any session the worker
+  still holds (a previous ring that wedged and timed out); replied
+  immediately (not dispatched) so the orchestrator can open ALL
+  members before any reduce leg flies — the rendezvous barrier that
+  makes the ring race-free.
+- FABRIC_OPEN_OK: ``cid`` echo + ``worker_uid``.
+- FABRIC_ALLREDUCE: one worker's leg of a zero-relay ring AllReduce.
+  ``cid`` / ``buf_ids`` (local partials, pre-reduced worker-side
+  exactly like ALLREDUCE_SHIP) / ``ring`` (ordered member list of
+  ``{"url"}`` — tokens never ride the wire; peers dial with their own
+  configured token, same trust domain) / ``index`` (this worker's
+  ring position) / ``result_id`` (client-minted install target) /
+  ``op`` / ``free_src`` / ``quant``.  Rides the owning connection's
+  tenant through the QoS dispatcher with the deferred-flush
+  discipline, so the peer transfer overlaps the connection's next
+  queued EXECUTE on both ends (T3, now applied to worker↔worker
+  legs).  Worker ``index`` waits for its predecessor's PEER_REDUCE
+  deposit, adds it, ships the running sum to ``index+1`` over a
+  pooled peer link (q8-eligible per leg), and the last member fans
+  the total back down the ring as PEER_INSTALL hops — ZERO collective
+  payload bytes ever transit the client.
+- FABRIC_ALLREDUCE_OK: per-member receipt — ``cid`` / ``index`` /
+  ``shape`` / ``dtype`` / ``installed`` / ``peer_raw_bytes`` /
+  ``peer_wire_bytes`` / ``hidden_ms``.  Receipt only, never payload.
+- PEER_REDUCE: worker -> worker reduce hop (``cid`` / ``step`` + the
+  running sum as the single frame buffer, q8-eligible).  The receiver
+  deposits the payload for its own FABRIC_ALLREDUCE flush and acks
+  PEER_REDUCE_OK — the ack is the ring's backpressure.
+- PEER_INSTALL: worker -> worker total fan-down hop (``cid`` /
+  ``step`` + the total).  Forwarded down-ring BEFORE the local
+  install so the pipeline drains in one direction; ack
+  PEER_INSTALL_OK.
 """
 
 from __future__ import annotations
@@ -243,9 +290,9 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 MAGIC = b"TPFR"
-VERSION = 8
-#: frame versions this build can decode (v3-v8 are additive over v2)
-SUPPORTED_VERSIONS = (2, 3, 4, 5, 6, 7, 8)
+VERSION = 9
+#: frame versions this build can decode (v3-v9 are additive over v2)
+SUPPORTED_VERSIONS = (2, 3, 4, 5, 6, 7, 8, 9)
 #: version every HELLO is framed at, so any peer can read it
 HELLO_VERSION = 2
 #: lowest wire version whose frames may carry ``enc="q8"`` buffers
@@ -265,6 +312,17 @@ FED_MIN_VERSION = 7
 #: refuses to send below it and the worker refuses to honor it below
 #: it, so v2-v7 peers never see the kinds
 MIGRATE_MIN_VERSION = 8
+#: lowest wire version that may carry the peer-fabric opcodes
+#: (FABRIC_OPEN / FABRIC_ALLREDUCE and the worker↔worker PEER_REDUCE /
+#: PEER_INSTALL hops).  Double-gated like every opcode since v6: the
+#: client refuses to send the FABRIC kinds below it and the worker
+#: refuses to honor ANY v9 kind from a below-v9 connection, so v2-v8
+#: peers never see them in either direction
+FABRIC_MIN_VERSION = 9
+#: hard ceiling on a FABRIC_ALLREDUCE ``ring`` member list — the ring
+#: and ``index`` arrive off the wire and subscript the member table,
+#: so both are bounded here before any hop is dialed
+MAX_FABRIC_RING = 64
 
 # -- opcode / reply / error-code registry ---------------------------------
 #
@@ -280,17 +338,23 @@ REQUEST_KINDS = ("HELLO", "INFO", "COMPILE", "COMPILE_MLIR", "PUT",
                  "FREE", "FETCH", "EXECUTE", "GENERATE", "KV_SHIP",
                  "ALLREDUCE_SHIP", "ALLGATHER_SHIP",
                  "SNAPSHOT", "RESTORE",
-                 "SNAPSHOT_DELTA", "MIGRATE_FREEZE", "MIGRATE_COMMIT")
+                 "SNAPSHOT_DELTA", "MIGRATE_FREEZE", "MIGRATE_COMMIT",
+                 "FABRIC_OPEN", "FABRIC_ALLREDUCE",
+                 "PEER_REDUCE", "PEER_INSTALL")
 #: request kinds the python client never sends (COMPILE_MLIR is the
-#: transparent PJRT plugin's path — libtpf_pjrt_remote.cc is the client)
-CLIENT_OPTIONAL_KINDS = ("COMPILE_MLIR",)
+#: transparent PJRT plugin's path — libtpf_pjrt_remote.cc is the
+#: client; PEER_REDUCE / PEER_INSTALL are worker↔worker fabric hops —
+#: remoting/fabric.py's PeerLink is the sender)
+CLIENT_OPTIONAL_KINDS = ("COMPILE_MLIR", "PEER_REDUCE", "PEER_INSTALL")
 #: worker -> client reply kinds
 REPLY_KINDS = ("HELLO_OK", "INFO_OK", "COMPILE_OK", "PUT_OK", "FREE_OK",
                "FETCH_OK", "EXECUTE_OK", "GENERATE_OK", "KV_SHIP_OK",
                "ALLREDUCE_SHIP_OK", "ALLGATHER_SHIP_OK",
                "SNAPSHOT_OK", "RESTORE_OK",
                "SNAPSHOT_DELTA_OK", "MIGRATE_FREEZE_OK",
-               "MIGRATE_COMMIT_OK", "ERROR")
+               "MIGRATE_COMMIT_OK",
+               "FABRIC_OPEN_OK", "FABRIC_ALLREDUCE_OK",
+               "PEER_REDUCE_OK", "PEER_INSTALL_OK", "ERROR")
 #: structured ERROR ``code`` values (v4; older clients see plain ERROR)
 ERROR_CODES = ("BUSY", "DEADLINE_EXCEEDED", "needs_compile")
 #: per-buffer wire encodings, in the order they were introduced; the
@@ -366,6 +430,11 @@ TAINT_PARAM_SOURCES = (
     (r"\.q8_decode$", "raw"),
     (r"\.q8_decode$", "desc"),
     (r"Worker\._handle_[a-z0-9_]+$", "meta"),
+    # fabric reduce flush: the work item's meta carries the wire-sent
+    # ring member list + index, which subscript the ring table — the
+    # dispatcher hop (inbox -> WorkItem -> deferred flush) is exactly
+    # the kind of indirection static dataflow cannot follow
+    (r"Worker\._flush_fabric_allreduce$", "item"),
     (r"Gateway\._watch$", "qs"),
 )
 #: call tails that fully validate their arguments (none needed yet:
@@ -445,6 +514,45 @@ SESSION_PROTOCOLS = {
         ),
         "terminal": ("bound",),
         "handlers": {"KV_SHIP": ("_handle_kv_ship",)},
+    },
+    # peer-fabric collective (protocol v9): the client's FABRIC_OPEN
+    # rendezvous creates the session, the member's FABRIC_ALLREDUCE
+    # flush drives it through "reducing" to a terminal "done" (or
+    # "aborted" on a wedged/failed ring) and clears the slot; the
+    # worker↔worker PEER_REDUCE / PEER_INSTALL hops only deposit
+    # payloads into the open session (state unchanged) after guarding
+    # that one exists and is accepting.  A re-open from any non-
+    # terminal state replaces a wedged predecessor — its abandoned
+    # flush times out and aborts against its own (orphaned) session
+    # object, never the new one.
+    "peer_fabric": {
+        "module": "remoting/worker.py",
+        "session": "_FabricCollective",
+        "slot": "_fab_session",
+        "attr": "state",
+        "states": ("none", "open", "reducing", "done", "aborted"),
+        "transitions": (
+            ("none", "FABRIC_OPEN", "open"),
+            ("open", "FABRIC_OPEN", "open"),
+            ("reducing", "FABRIC_OPEN", "open"),
+            ("open", "FABRIC_ALLREDUCE", "reducing"),
+            ("reducing", "FABRIC_ALLREDUCE", "done"),
+            ("reducing", "FABRIC_ALLREDUCE", "aborted"),
+            ("open", "PEER_REDUCE", "open"),
+            ("reducing", "PEER_REDUCE", "reducing"),
+            ("open", "PEER_INSTALL", "open"),
+            ("reducing", "PEER_INSTALL", "reducing"),
+        ),
+        "terminal": ("done", "aborted"),
+        "handlers": {
+            "FABRIC_OPEN": ("_handle_fabric_open",),
+            "FABRIC_ALLREDUCE": ("_enqueue_fabric_allreduce",
+                                 "_launch_fabric_allreduce",
+                                 "_flush_fabric_allreduce"),
+            "PEER_REDUCE": ("_handle_peer_reduce",),
+            "PEER_INSTALL": ("_handle_peer_install",),
+        },
+        "creators": ("_handle_fabric_open",),
     },
     # federated collectives: partial-shipping legs, then the reducing
     # leg that consumes the parked partials

@@ -113,13 +113,20 @@ _BARRIER_KINDS = ("FETCH", "FREE", "SNAPSHOT", "RESTORE")
 #: therefore block at the connection handler while a MIGRATE_FREEZE
 #: holds the worker dark (protocol v8, docs/migration.md)
 _MUTATING_KINDS = ("EXECUTE", "GENERATE", "KV_SHIP", "ALLREDUCE_SHIP",
-                   "ALLGATHER_SHIP", "PUT", "FREE")
+                   "ALLGATHER_SHIP", "FABRIC_ALLREDUCE", "PUT", "FREE")
 
 #: ceiling on how long a frozen worker holds mutating requests: a dead
 #: orchestrator must not wedge tenant connections forever — past this
 #: the handler proceeds (the migration, if still live, falls back to
 #: stop-and-copy semantics at the controller)
 MIGRATE_FREEZE_MAX_S = 30.0
+
+#: ceiling on how long one fabric ring member waits for its peer's
+#: PEER_REDUCE / PEER_INSTALL deposit (protocol v9): a wedged ring must
+#: abort — freeing the dispatcher thread and erroring the client's leg
+#: — strictly before MIGRATE_FREEZE's quiesce gives up, so a dead ring
+#: member cannot wedge an unrelated migration freeze
+FABRIC_HOP_TIMEOUT_S = 20.0
 
 
 class _MigrationSession:
@@ -128,15 +135,18 @@ class _MigrationSession:
     real-id -> staged-id manifest accumulated across rounds, and the
     high-water write generation fully shipped so far.  Deltas ride the
     target connection as quiet client-minted PUTs through the
-    double-buffered ``_UploadStream`` (q8-eligible) — exactly the
-    KV_SHIP quiet-ephemeral-PUT machinery, minus the ephemeral flag
-    (staged buffers must survive until MIGRATE_COMMIT publishes
-    them)."""
+    double-buffered ``_UploadStream`` (q8-eligible) — the peer-fabric
+    transport (remoting/fabric.py), minus the ephemeral flag (staged
+    buffers must survive until MIGRATE_COMMIT publishes them).  Since
+    protocol v9 the target connection is a pooled
+    :class:`~.fabric.PeerLink` leased per session instead of a fresh
+    dial — the pool's ``worker_uid`` verification guarantees a link
+    reused across sessions still talks to the same target process
+    (staged state does not survive a target restart)."""
 
-    def __init__(self, target_url: str, token: str = "",
+    def __init__(self, pool, target_url: str, token: str = "",
                  quantize: bool = False):
         from .. import constants as _c
-        from .client import RemoteDevice
 
         self.target_url = target_url
         #: migration is background traffic on the target too: HELLO as
@@ -146,8 +156,10 @@ class _MigrationSession:
         #: because migrated state must round-trip exactly by default
         #: (stop-and-copy SNAPSHOT/RESTORE is exact; streaming must
         #: not silently be worse)
-        self.device = RemoteDevice(target_url, token=token,
-                                   qos=_c.QOS_LOW, quantize=quantize)
+        self._pool = pool
+        self.link = pool.lease(target_url, token=token,
+                               qos=_c.QOS_LOW, quantize=quantize)
+        self.device = self.link.device
         #: real buf_id -> staged c- id (latest round's copy)
         self.staged: Dict[str, str] = {}
         #: exe_id -> staged c- id carrying the serialized blob
@@ -175,25 +187,80 @@ class _MigrationSession:
 
     def stage(self, staged_id: str, host,
               stats: Optional[Dict[str, int]] = None) -> None:
-        """Queue one staged buffer on the upload stream (quiet PUT,
-        NOT ephemeral); the caller drains once per round."""
-        from .client import _UploadStream
-
-        dev = self.device
-        if dev._upload_stream is None:
-            dev._upload_stream = _UploadStream(dev, dev.upload_depth)
-        dev._upload_stream.submit({"buf_id": staged_id, "quiet": True},
-                                  host, stats=stats)
+        """Queue one staged buffer on the link's upload stream (quiet
+        PUT, NOT ephemeral); the caller drains once per round."""
+        self.link.stage(staged_id, host, stats=stats)
 
     def drain(self) -> None:
-        if self.device._upload_stream is not None:
-            self.device._upload_stream.drain()
+        self.link.drain()
 
     def close(self) -> None:
+        """Release the peer link back to the pool (the session is
+        done; the transport is reusable by the next session or by a
+        fabric collective to the same target)."""
         try:
-            self.device.close()
+            self._pool.release(self.link)
         except Exception:  # noqa: BLE001 - teardown best effort
             log.debug("migration session close failed", exc_info=True)
+
+
+class _FabricCollective:
+    """One open peer-fabric collective on this worker (protocol v9,
+    ``SESSION_PROTOCOLS["peer_fabric"]``).
+
+    Created by the client's FABRIC_OPEN rendezvous (all ring members
+    are opened before any reduce leg flies — the barrier that makes
+    the ring race-free), consumed by this worker's own
+    FABRIC_ALLREDUCE flush.  Peer deposits arrive on connection-
+    handler threads (the up-ring member's PEER_REDUCE, the down-ring
+    member's PEER_INSTALL) and park here; the flush waits on the
+    condition, bounded by :data:`FABRIC_HOP_TIMEOUT_S` so a dead peer
+    aborts the leg instead of wedging the dispatcher."""
+
+    def __init__(self, cid: str):
+        self.cid = cid
+        #: protocol.SESSION_PROTOCOLS["peer_fabric"] state — a session
+        #: exists only in "open"/"reducing"; the terminal writes
+        #: ("done"/"aborted") happen as the FABRIC_ALLREDUCE flush (or
+        #: its error arm) clears the worker's slot
+        self.state = "open"
+        self._cv = threading.Condition()
+        #: step -> running sum deposited by the up-ring PEER_REDUCE
+        self._reduces: Dict[int, np.ndarray] = {}
+        #: step -> reduced total deposited by the down-ring PEER_INSTALL
+        self._installs: Dict[int, np.ndarray] = {}
+        self._error: Optional[str] = None
+
+    def deposit(self, table: str, step: int, payload) -> None:
+        with self._cv:
+            tbl = self._reduces if table == "reduce" else self._installs
+            tbl[step] = payload
+            self._cv.notify_all()
+
+    def take(self, table: str, step: int, timeout: float):
+        """Block until the peer's ``step`` deposit lands (or the hop
+        times out / the session aborts)."""
+        deadline = time.monotonic() + timeout
+        tbl = self._reduces if table == "reduce" else self._installs
+        with self._cv:
+            while step not in tbl:
+                if self._error is not None:
+                    raise RuntimeError(self._error)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"fabric {table} hop {step} timed out after "
+                        f"{timeout:.0f}s (cid={self.cid})")
+                self._cv.wait(timeout=min(remaining, 0.5))
+            return tbl.pop(step)
+
+    def abort(self, error: str) -> None:
+        """Wake every parked waiter with the failure (a replaced or
+        errored session must not strand its flush for the full hop
+        timeout)."""
+        with self._cv:
+            self._error = error
+            self._cv.notify_all()
 
 
 class RemoteVTPUWorker:
@@ -216,6 +283,11 @@ class RemoteVTPUWorker:
         #: highest wire version this worker speaks; pinning it to 2 makes
         #: the worker byte-faithful to a v2 build (mixed-version tests)
         self.protocol_version = protocol_version
+        #: fresh per process, carried in HELLO_OK (protocol v9): the
+        #: staleness oracle pooled peer links verify on lease — a
+        #: restarted worker has a new uid, so a reused link can never
+        #: imply staged/resident state survived the restart
+        self.worker_uid = f"w-{os.urandom(6).hex()}"
         self.token = token if token is not None else \
             os.environ.get("TPF_REMOTING_TOKEN", "")
         # This socket compiles and executes caller-supplied StableHLO:
@@ -321,6 +393,23 @@ class RemoteVTPUWorker:
         #: the one live pre-copy session (None between migrations)
         # guarded by: _lock
         self._mig_session: Optional[_MigrationSession] = None
+        #: the one open peer-fabric collective (protocol v9; None
+        #: between rings).  FABRIC_OPEN replaces it wholesale — a
+        #: wedged predecessor is aborted and abandoned, its flush
+        #: erroring against its own orphaned session object
+        # guarded by: _lock
+        self._fab_session: Optional[_FabricCollective] = None
+        #: pooled worker->worker peer links (remoting/fabric.py):
+        #: migration sessions and fabric ring legs lease from here
+        #: instead of dialing fresh RemoteDevices
+        from .fabric import PeerLinkPool
+        self._peer_pool = PeerLinkPool()
+        #: lifetime fabric counters (INFO "fabric" + metrics lines)
+        # guarded by: _lock
+        self._fab_stats: Dict[str, float] = {
+            "rings_total": 0, "reduce_hops_total": 0,
+            "install_hops_total": 0, "aborted_total": 0,
+            "peer_raw_bytes_total": 0, "peer_wire_bytes_total": 0}
         #: SET = thawed.  MIGRATE_FREEZE clears it; mutating kinds
         #: block at the connection handler until commit/abort (bounded
         #: by MIGRATE_FREEZE_MAX_S)
@@ -622,7 +711,8 @@ class RemoteVTPUWorker:
                             self.client_quant = bool(meta.get("quant"))
                             reply("HELLO_OK",
                                   {"version": self.negotiate(meta),
-                                   "qos_weight": qos_weight(qos)}, [])
+                                   "qos_weight": qos_weight(qos),
+                                   "worker_uid": outer.worker_uid}, [])
                             self.requant()
                             continue
                         try:
@@ -677,6 +767,34 @@ class RemoteVTPUWorker:
                                 outer._handle_migrate_commit(
                                     reply, remap_ids(meta), buffers)
                                 continue
+                            if kind == "FABRIC_OPEN":
+                                # peer fabric (protocol v9): the
+                                # client's rendezvous barrier — replied
+                                # immediately so every ring member is
+                                # open before any reduce leg flies
+                                outer._handle_fabric_open(
+                                    reply, remap_ids(meta))
+                                continue
+                            if kind == "FABRIC_ALLREDUCE":
+                                # one zero-relay ring leg: rides this
+                                # connection's tenant with the deferred
+                                # flush, so the peer hops overlap the
+                                # next queued EXECUTE
+                                outer._enqueue_fabric_allreduce(
+                                    reply, remap_ids(meta), buffers,
+                                    tenant)
+                                continue
+                            if kind == "PEER_REDUCE":
+                                # worker->worker reduce hop: deposit
+                                # into the open fabric session and ack
+                                # (the ack is the ring's backpressure)
+                                outer._handle_peer_reduce(
+                                    reply, remap_ids(meta), buffers)
+                                continue
+                            if kind == "PEER_INSTALL":
+                                outer._handle_peer_install(
+                                    reply, remap_ids(meta), buffers)
+                                continue
                             if kind in _BARRIER_KINDS:
                                 # these observe execution effects: wait
                                 # for this connection's queued EXECUTEs
@@ -723,7 +841,8 @@ class RemoteVTPUWorker:
                 # at the agreed version (both ends accept it: v3 clients
                 # read v2 and v3, v2 clients only ever negotiate 2)
                 reply("HELLO_OK", {"version": self.negotiate(meta),
-                                   "qos_weight": qos_weight(self.qos)})
+                                   "qos_weight": qos_weight(self.qos),
+                                   "worker_uid": outer.worker_uid})
                 self.requant()
                 return True
 
@@ -770,8 +889,12 @@ class RemoteVTPUWorker:
         self._mig_thaw.set()
         with self._lock:
             sess, self._mig_session = self._mig_session, None
+            fab, self._fab_session = self._fab_session, None
         if sess is not None:
             sess.close()
+        if fab is not None:
+            fab.abort("worker stopping")
+        self._peer_pool.close()
         self._server.shutdown()
         self._server.server_close()
         self.dispatcher.stop()
@@ -1716,6 +1839,301 @@ class RemoteVTPUWorker:
         self._attr_collective(item, "allgather", nbytes,
                               time.monotonic() - m1)
 
+    # -- peer fabric (protocol v9, docs/federation.md) -------------------
+
+    def _fab_gate(self, reply, meta, kind: str) -> bool:
+        """Double version gate, worker half: the client already refuses
+        to send the fabric kinds below v9; a smuggled frame from a
+        hand-rolled (or mixed-version) peer dies here."""
+        if meta.get("_wire_version", 2) < protocol.FABRIC_MIN_VERSION:
+            reply("ERROR",
+                  {"error": f"{kind} needs protocol >= "
+                            f"{protocol.FABRIC_MIN_VERSION} "
+                            f"(negotiate v9 at HELLO)"}, [])
+            return False
+        return True
+
+    def _handle_fabric_open(self, reply, meta) -> None:
+        """The client's rendezvous barrier for one fabric collective:
+        create (or replace) this worker's peer-fabric session under
+        ``cid`` and ack immediately — the orchestrator opens EVERY
+        ring member before any FABRIC_ALLREDUCE leg flies, so a
+        PEER_REDUCE hop can never race the session it deposits into.
+        Replacement aborts a wedged predecessor: its abandoned flush
+        errors against its own orphaned session object, never the new
+        one."""
+        if not self._fab_gate(reply, meta, "FABRIC_OPEN"):
+            return
+        cid = str(meta.get("cid") or "")
+        if not cid:
+            reply("ERROR", {"error": "FABRIC_OPEN without cid"}, [])
+            return
+        sess = _FabricCollective(cid)
+        with self._lock:
+            old, self._fab_session = self._fab_session, sess
+        if old is not None:
+            old.abort(f"fabric session replaced by {cid!r}")
+        reply("FABRIC_OPEN_OK",
+              {"cid": cid, "worker_uid": self.worker_uid}, [])
+
+    def _enqueue_fabric_allreduce(self, reply, meta, buffers,
+                                  tenant) -> None:
+        """Connection handler side of FABRIC_ALLREDUCE: double version
+        gate, then fair-queue the leg on the OWNING connection's
+        tenant (not a side channel) — the deferred-flush discipline
+        overlaps the ring hops with the connection's next queued
+        EXECUTE, and the collective bytes are attributed to the tenant
+        that asked for them.  Like ALLREDUCE_SHIP, the leg consumes
+        resident partials already parked here, so it blocks (TCP
+        backpressure) instead of answering BUSY."""
+        if not self._fab_gate(reply, meta, "FABRIC_ALLREDUCE"):
+            return
+        item = WorkItem("FABRIC_ALLREDUCE", meta, buffers, reply, 1.0,
+                        "<fabric_allreduce>", None, None,
+                        trace=self._parse_trace(meta))
+        self.dispatcher.submit(tenant, item, block=True)
+
+    def _launch_fabric_allreduce(self, item: WorkItem):
+        """Dispatcher arm for one fabric ring leg.  The launch phase
+        is empty (the T3 discipline: the dispatcher launches the
+        connection's next queued EXECUTE first); the flush runs the
+        ring hops.  The error arm aborts the session — waking the
+        peers parked on it — and clears the slot, but only when the
+        slot still holds THIS leg's session (a newer FABRIC_OPEN must
+        not lose its fresh session to a stale leg's failure)."""
+        def flush(_item=item):
+            try:
+                self._flush_fabric_allreduce(_item)
+            except KeyError as e:
+                self._abort_fabric(_item, str(e.args[0]))
+            except Exception as e:  # noqa: BLE001 - reply, keep serving
+                log.exception("FABRIC_ALLREDUCE failed")
+                self._abort_fabric(_item, str(e))
+
+        return flush
+
+    def _abort_fabric(self, item: WorkItem, error: str) -> None:
+        """Error arm of one fabric leg: terminal "aborted" write, slot
+        clear (cid-matched), peer wakeup, structured ERROR reply."""
+        cid = str(item.meta.get("cid") or "")
+        with self._lock:
+            sess = self._fab_session
+            if sess is not None and sess.cid == cid:
+                self._fab_session = None
+            else:
+                sess = None
+            self._fab_stats["aborted_total"] += 1
+        if sess is not None:
+            sess.state = "aborted"
+            sess.abort(error)
+        self._safe_reply(item, "ERROR", {"error": error}, [])
+
+    def _flush_fabric_allreduce(self, item: WorkItem) -> None:
+        """One zero-relay ring AllReduce leg (protocol v9).
+
+        Accumulator-relay ring: member 0 ships its locally pre-reduced
+        partial to member 1; each member adds its own partial to the
+        running sum and relays up-ring (PEER_REDUCE, q8-eligible per
+        leg); the last member holds the total and fans it back
+        down-ring (PEER_INSTALL hops, forwarded BEFORE the local
+        install so the pipeline drains in one direction).  Every
+        member installs the total resident under the client-minted
+        ``result_id`` and replies a receipt — the client orchestrates
+        and collects receipts but relays ZERO collective payload
+        bytes.  The ``ring`` member list and ``index`` arrive off the
+        wire, so both are bounded (MAX_FABRIC_RING) before they
+        subscript anything."""
+        meta = item.meta
+        cid = str(meta.get("cid") or "")
+        with self._lock:
+            sess = self._fab_session
+        if sess is None or sess.cid != cid:
+            raise ValueError(
+                f"FABRIC_ALLREDUCE without an open fabric session "
+                f"(cid={cid!r}) — send FABRIC_OPEN first")
+        if sess.state != "open":
+            raise ValueError(
+                f"fabric session {cid!r} is {sess.state!r}, not open")
+        op = str(meta.get("op", "sum") or "sum")
+        if op != "sum":
+            raise ValueError(f"unsupported collective op {op!r}")
+        ring = meta.get("ring") or []
+        n = len(ring)
+        if n < 2 or n > protocol.MAX_FABRIC_RING:
+            raise ValueError(
+                f"fabric ring size {n} outside "
+                f"[2, {protocol.MAX_FABRIC_RING}]")
+        index = int(meta.get("index", -1))
+        if index < 0 or index >= n:
+            raise ValueError(
+                f"fabric ring index {index} outside [0, {n})")
+        sess.state = "reducing"
+        quant = bool(meta.get("quant"))
+        parts = self._collective_sources(meta.get("buf_ids") or [],
+                                         bool(meta.get("free_src")))
+        if not parts:
+            raise ValueError("FABRIC_ALLREDUCE with nothing to reduce")
+        m0 = time.monotonic()
+        # worker-local pre-reduction: however many partials this
+        # member holds, exactly one payload rides each ring hop
+        running = self._accumulate(parts)
+        if index > 0:
+            upstream = np.asarray(
+                sess.take("reduce", index, FABRIC_HOP_TIMEOUT_S))
+            running = self._accumulate([running, upstream])
+        hops = 0
+        link_raw = link_wire = 0
+        if index < n - 1:
+            nxt = str((ring[index + 1] or {}).get("url") or "")
+            link = self._peer_pool.lease(nxt, token=self.token,
+                                         quantize=quant)
+            try:
+                # pooled links carry lifetime counters — ledger the
+                # DELTA this hop moved, not the link's history
+                w0 = link.wire_bytes
+                link.ship_reduce(cid, index + 1, running, op=op)
+                hops += 1
+                link_raw += int(running.nbytes)
+                link_wire += link.wire_bytes - w0
+            finally:
+                self._peer_pool.release(link)
+            total = np.asarray(
+                sess.take("install", index, FABRIC_HOP_TIMEOUT_S))
+        else:
+            total = running
+        if index > 0:
+            # forward the total down-ring BEFORE installing locally,
+            # so the fan-down pipeline drains in one direction
+            prv = str((ring[index - 1] or {}).get("url") or "")
+            link = self._peer_pool.lease(prv, token=self.token,
+                                         quantize=quant)
+            try:
+                w0 = link.wire_bytes
+                link.ship_install(cid, index - 1, total)
+                hops += 1
+                link_raw += int(total.nbytes)
+                link_wire += link.wire_bytes - w0
+            finally:
+                self._peer_pool.release(link)
+        rid = meta.get("result_id")
+        installed = None
+        if rid is not None:
+            installed = self._install_resident(
+                str(rid), np.asarray(total), meta.get("_conn_ns", ""))
+        elapsed = time.monotonic() - m0
+        nbytes = sum(int(p.nbytes) for p in parts)
+        # the BYTE half of per-tenant attribution: this leg's local
+        # partials, against the owning connection (the client-visible
+        # collective), plus the lifetime fabric counters
+        self.dispatcher.note_collective(meta.get("_conn_ns", ""),
+                                        "allreduce", nbytes)
+        with self._lock:
+            if index == 0:
+                self._fab_stats["rings_total"] += 1
+            self._fab_stats["peer_raw_bytes_total"] += link_raw
+            self._fab_stats["peer_wire_bytes_total"] += link_wire
+        rmeta = {"cid": cid, "index": index, "hops": hops,
+                 "op": op, "n_src": len(parts),
+                 "shape": list(total.shape),
+                 "dtype": np.asarray(total).dtype.name,
+                 "peer_raw_bytes": link_raw,
+                 "peer_wire_bytes": link_wire,
+                 "elapsed_ms": round(elapsed * 1e3, 3)}
+        if installed is not None:
+            rmeta["installed"] = installed
+        if item.trace:
+            d = self.tracer.record_span(
+                "fabric.ring", m0, self.tracer.clock.now(),
+                parent=item.trace,
+                attrs={"cid": cid, "index": index, "workers": n,
+                       "hops": hops, "raw_bytes": link_raw,
+                       "wire_bytes": link_wire})
+            if d is not None:
+                item.trace_spans.append(d)
+        with self._lock:
+            if self._fab_session is sess:
+                self._fab_session = None
+        sess.state = "done"
+        # receipt only — the total never rides back to the client
+        self._safe_reply(item, "FABRIC_ALLREDUCE_OK",
+                         self._traced_meta(item, rmeta), [])
+        self._attr_collective(item, "allreduce", nbytes, elapsed)
+
+    def _handle_peer_reduce(self, reply, meta, buffers) -> None:
+        """Up-ring reduce hop (worker -> worker): deposit the
+        predecessor's running sum for this worker's own
+        FABRIC_ALLREDUCE flush and ack — the ack is the ring's
+        backpressure (the sender's dispatcher thread waits on it
+        before retiring the leg)."""
+        if not self._fab_gate(reply, meta, "PEER_REDUCE"):
+            return
+        cid = str(meta.get("cid") or "")
+        step = int(meta.get("step", -1))
+        if step < 0 or step >= protocol.MAX_FABRIC_RING:
+            reply("ERROR",
+                  {"error": f"peer step {step} outside "
+                            f"[0, {protocol.MAX_FABRIC_RING})"}, [])
+            return
+        if not buffers:
+            reply("ERROR", {"error": "PEER_REDUCE without payload"}, [])
+            return
+        with self._lock:
+            sess = self._fab_session
+        if sess is None or sess.cid != cid or \
+                sess.state not in ("open", "reducing"):
+            reply("ERROR",
+                  {"error": f"no open fabric session for cid {cid!r} "
+                            f"(send FABRIC_OPEN to every ring member "
+                            f"first)"}, [])
+            return
+        sess.deposit("reduce", step, np.asarray(buffers[0]))
+        with self._lock:
+            self._fab_stats["reduce_hops_total"] += 1
+        reply("PEER_REDUCE_OK", {"cid": cid, "step": step}, [])
+
+    def _handle_peer_install(self, reply, meta, buffers) -> None:
+        """Down-ring install hop (worker -> worker): deposit the
+        reduced total for this worker's flush, which forwards it
+        further down-ring and installs it resident."""
+        if not self._fab_gate(reply, meta, "PEER_INSTALL"):
+            return
+        cid = str(meta.get("cid") or "")
+        step = int(meta.get("step", -1))
+        if step < 0 or step >= protocol.MAX_FABRIC_RING:
+            reply("ERROR",
+                  {"error": f"peer step {step} outside "
+                            f"[0, {protocol.MAX_FABRIC_RING})"}, [])
+            return
+        if not buffers:
+            reply("ERROR",
+                  {"error": "PEER_INSTALL without payload"}, [])
+            return
+        with self._lock:
+            sess = self._fab_session
+        if sess is None or sess.cid != cid or \
+                sess.state not in ("open", "reducing"):
+            reply("ERROR",
+                  {"error": f"no open fabric session for cid {cid!r} "
+                            f"(send FABRIC_OPEN to every ring member "
+                            f"first)"}, [])
+            return
+        sess.deposit("install", step, np.asarray(buffers[0]))
+        with self._lock:
+            self._fab_stats["install_hops_total"] += 1
+        reply("PEER_INSTALL_OK", {"cid": cid, "step": step}, [])
+
+    def fabric_stats(self) -> Dict[str, object]:
+        """Fabric view for INFO and the metrics lines: lifetime ring /
+        hop / byte counters plus the peer-link pool's lease
+        accounting."""
+        with self._lock:
+            out: Dict[str, object] = dict(self._fab_stats)
+            sess = self._fab_session
+            out["session"] = {"cid": sess.cid, "state": sess.state} \
+                if sess is not None else None
+        out["pool"] = self._peer_pool.snapshot()
+        return out
+
     # -- streaming live migration (protocol v8, docs/migration.md) ------
 
     def _mig_gate(self, reply, meta, kind: str) -> bool:
@@ -1786,7 +2204,7 @@ class RemoteVTPUWorker:
         if sess is None:
             token = meta.get("target_token")
             sess = _MigrationSession(
-                target,
+                self._peer_pool, target,
                 token=str(token) if token is not None else self.token,
                 quantize=bool(meta.get("quant")))
             with self._lock:
@@ -2161,6 +2579,8 @@ class RemoteVTPUWorker:
         the next launch."""
         if len(items) == 1 and items[0].kind == "SNAPSHOT_DELTA":
             return self._launch_migration(items[0])
+        if len(items) == 1 and items[0].kind == "FABRIC_ALLREDUCE":
+            return self._launch_fabric_allreduce(items[0])
         if len(items) == 1 and items[0].kind != "EXECUTE":
             return self._launch_collective(items[0])
         if len(items) == 1:
@@ -2568,6 +2988,8 @@ class RemoteVTPUWorker:
                 "serving": self.engine.snapshot()
                 if self.engine is not None else None,
                 "migration": self.migration_stats(),
+                "fabric": self.fabric_stats(),
+                "worker_uid": self.worker_uid,
                 "wire_compression": wire,
                 # full inventory for placement: id + mesh coords (TPUs
                 # expose .coords; CPU/GPU devices report their index)

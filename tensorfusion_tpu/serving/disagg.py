@@ -20,9 +20,13 @@ DESIGNATED workers:
   prompt is physically stored once on the decode pool no matter how
   many prefill workers computed it.
 - the same payload rides the wire as the protocol-v6 ``KV_SHIP``
-  opcode (docs/wire-format.md): a remote prefill tier calls
-  :meth:`RemoteDevice.ship_kv`, whose pages travel as quiet q8 PUTs
-  through the double-buffered ``_UploadStream`` sender.
+  opcode (docs/wire-format.md): a remote prefill tier ships via
+  :class:`RemoteKVShipper`, whose pages travel to the decode worker
+  over a pooled peer-fabric link (``remoting/fabric.py`` — the SAME
+  worker↔worker transport migration deltas and collective ring hops
+  ride, docs/federation.md "peer fabric"): double-buffered quiet q8
+  PUTs, link reuse per (url, token), stale-uid re-dial on target
+  restart.
 
 Two stepping modes: ``inline=True`` advances ONE chunk per
 :meth:`pump` call on the engine's stepper (deterministic — the sim and
@@ -293,3 +297,62 @@ class PrefillPool:
                 "retained_jobs": sum(len(w.retained)
                                      for w in self.workers),
             }
+
+
+class RemoteKVShipper:
+    """Remote prefill tier → decode worker, over the peer fabric.
+
+    Ships a finished :meth:`_PrefillWorker.payload` to a remote decode
+    worker's engine as a protocol-v6 ``KV_SHIP`` frame riding a pooled
+    :class:`~..remoting.fabric.PeerLink` — the SAME worker↔worker
+    transport migration deltas and collective ring hops use, so the
+    pages get the double-buffered upload stream, per-block q8 when the
+    link negotiated it, connection reuse per ``(url, token)`` and the
+    stale-uid re-dial on decode-worker restart for free.  Pass a
+    shared :class:`~..remoting.fabric.PeerLinkPool` (a worker-hosted
+    tier shares its worker's pool); without one the shipper owns a
+    private pool and closes it."""
+
+    def __init__(self, target_url: str, pool=None, token: str = "",
+                 quantize: bool = False):
+        from ..remoting.fabric import PeerLinkPool
+        self.target_url = str(target_url)
+        self.token = token
+        self.quantize = bool(quantize)
+        self._owns_pool = pool is None
+        self.pool = PeerLinkPool() if pool is None else pool
+        self.shipped_jobs = 0
+        self.shipped_bytes = 0
+
+    def ship(self, prompt: List[int], payload: Optional[dict],
+             max_tokens: int = 1, eos_id: Optional[int] = None,
+             on_token: Optional[Callable[[int], None]] = None
+             ) -> Optional[dict]:
+        """Ship one finished prefill payload and consume the decode
+        stream; None passes through (the pool could not hold the
+        prompt — the caller falls back to inline prefill)."""
+        if payload is None:
+            return None
+        link = self.pool.lease(self.target_url, token=self.token,
+                               quantize=self.quantize)
+        try:
+            out = link.device.ship_kv(
+                prompt, max_tokens, payload["keys"], payload["k"],
+                payload["v"], payload["first_token"],
+                payload["n_tokens"], eos_id=eos_id,
+                on_token=on_token)
+        finally:
+            self.pool.release(link)
+        self.shipped_jobs += 1
+        self.shipped_bytes += int(payload.get("bytes") or 0)
+        return out
+
+    def snapshot(self) -> dict:
+        return {"target": self.target_url,
+                "shipped_jobs": self.shipped_jobs,
+                "shipped_bytes": self.shipped_bytes,
+                "pool": self.pool.snapshot()}
+
+    def close(self) -> None:
+        if self._owns_pool:
+            self.pool.close()

@@ -43,11 +43,19 @@ SPAN_SCHEMA = {
     # docs/federation.md): one cross-worker collective (flat or ring)
     # and one per-worker shard launch of a federated call/step
     "fed.collective": {
-        "attrs": ("op", "workers", "ring", "raw_bytes", "wire_bytes",
-                  "hidden_ms"),
+        "attrs": ("op", "workers", "ring", "fabric", "raw_bytes",
+                  "wire_bytes", "hidden_ms"),
     },
     "fed.shard_exec": {
         "attrs": ("worker", "fn", "mode"),
+    },
+    # -- peer fabric (protocol v9, docs/federation.md "peer fabric"):
+    # one worker's leg of a zero-relay ring AllReduce — reduce /
+    # install hops ride worker-to-worker PeerLinks, the client only
+    # sees receipts
+    "fabric.ring": {
+        "attrs": ("cid", "index", "workers", "hops", "raw_bytes",
+                  "wire_bytes"),
     },
     # -- streaming live migration (protocol v8, docs/migration.md):
     # one pre-copy delta round on the source worker (traced

@@ -1,0 +1,623 @@
+"""Unified worker↔worker data fabric (ISSUE 19, protocol v9,
+docs/federation.md "peer fabric"): the zero-relay ring AllReduce
+(collective payload bytes through the client == 0, proven by raw-
+socket payload taps), the deprecated-but-bit-compatible client-relayed
+ring for v7/v8 peers, the PeerLink pool (reuse, idle-TTL expiry,
+worker_uid staleness re-dial), the mixed-version battery (pre-v9 peers
+never see a v9 opcode in either direction; smuggled frames die with a
+structured ERROR at both gate halves), cross-worker model parallelism
+numerics, and the fabric observability surfaces."""
+
+import json
+import logging
+import socket
+import struct
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorfusion_tpu.remoting import (FederatedDevice, RemoteDevice,
+                                       RemoteExecutionError,
+                                       RemoteVTPUWorker)
+from tensorfusion_tpu.remoting import protocol as P
+from tensorfusion_tpu.remoting.fabric import PeerLinkPool
+
+#: every protocol-v9 opcode, both directions — the battery's contraband
+V9_KINDS = ("FABRIC_OPEN", "FABRIC_ALLREDUCE",
+            "PEER_REDUCE", "PEER_INSTALL",
+            "FABRIC_OPEN_OK", "FABRIC_ALLREDUCE_OK",
+            "PEER_REDUCE_OK", "PEER_INSTALL_OK")
+
+#: the four client->worker request kinds the worker gate must refuse
+#: on a pre-v9 negotiated connection
+V9_REQUEST_KINDS = ("FABRIC_OPEN", "FABRIC_ALLREDUCE",
+                    "PEER_REDUCE", "PEER_INSTALL")
+
+
+@pytest.fixture()
+def worker():
+    w = RemoteVTPUWorker()
+    w.start()
+    yield w
+    w.stop()
+
+
+@pytest.fixture()
+def workers2():
+    ws = [RemoteVTPUWorker(), RemoteVTPUWorker()]
+    for w in ws:
+        w.start()
+    yield ws
+    for w in ws:
+        w.stop()
+
+
+@pytest.fixture()
+def workers3():
+    ws = [RemoteVTPUWorker() for _ in range(3)]
+    for w in ws:
+        w.start()
+    yield ws
+    for w in ws:
+        w.stop()
+
+
+class FrameTap:
+    """TCP forwarder that decodes the KIND and the payload byte count
+    of every frame in both directions while forwarding the exact
+    bytes.  Same raw-socket assertion layer as the federation
+    battery's, plus payload accounting — the zero-relay proof needs
+    "the client saw the fabric CONTROL frames but zero collective
+    PAYLOAD bytes", not just "no new kinds"."""
+
+    def __init__(self, target_port: int):
+        self.target_port = target_port
+        self.frames_up = []      # (kind, payload_nbytes) client->worker
+        self.frames_down = []    # (kind, payload_nbytes) worker->client
+        self._listen = socket.socket()
+        self._listen.bind(("127.0.0.1", 0))
+        self._listen.listen(8)
+        self.port = self._listen.getsockname()[1]
+        self._alive = True
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    @property
+    def kinds_up(self):
+        return [k for k, _ in self.frames_up]
+
+    @property
+    def kinds_down(self):
+        return [k for k, _ in self.frames_down]
+
+    def _accept(self):
+        while self._alive:
+            try:
+                cli, _ = self._listen.accept()
+            except OSError:
+                return
+            srv = socket.create_connection(("127.0.0.1",
+                                            self.target_port))
+            threading.Thread(target=self._pump,
+                             args=(cli, srv, self.frames_up),
+                             daemon=True).start()
+            threading.Thread(target=self._pump,
+                             args=(srv, cli, self.frames_down),
+                             daemon=True).start()
+
+    @staticmethod
+    def _read_exact(sock, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("closed")
+            buf += chunk
+        return buf
+
+    def _pump(self, src, dst, frames):
+        try:
+            while True:
+                head = self._read_exact(src, 12)
+                _, hlen = struct.unpack("<II", head[4:])
+                header = self._read_exact(src, hlen)
+                parsed = json.loads(header)
+                body = b"".join(
+                    self._read_exact(src, d["nbytes"])
+                    for d in parsed["buffers"])
+                frames.append((parsed["kind"], len(body)))
+                dst.sendall(head + header + body)
+        except (OSError, ConnectionError, ValueError):
+            try:
+                dst.shutdown(2)
+            except OSError:
+                pass
+
+    def close(self):
+        self._alive = False
+        self._listen.close()
+
+
+def _ring_sum(parts):
+    """The accumulator ring's float32 summation order: ((p0+p1)+p2)…
+    — the bit-compat reference both ring flavours are pinned to."""
+    total = np.asarray(parts[0], np.float32).copy()
+    for p in parts[1:]:
+        total = total + np.asarray(p, np.float32)
+    return total
+
+
+# -- zero-relay ring (the tentpole's acceptance invariant) ------------------
+
+
+def test_fabric_ring_zero_client_relay_bytes(workers3):
+    """3-worker fabric ring: the result matches the reference on every
+    member, the client wires carry the FABRIC control/receipt frames
+    with ZERO payload bytes, no PEER_* frame ever crosses a client
+    wire, and the peer wires carry the actual reduce/install payload
+    — the zero-relay invariant, proven at the byte level."""
+    client_taps = [FrameTap(w.port) for w in workers3]
+    peer_taps = [FrameTap(w.port) for w in workers3]
+    devs = [RemoteDevice(f"tcp://127.0.0.1:{ct.port}",
+                         peer_url=f"tcp://127.0.0.1:{pt.port}")
+            for ct, pt in zip(client_taps, peer_taps)]
+    try:
+        fed = FederatedDevice(devs, ring=True)
+        assert fed.fabric_supported()
+        rng = np.random.default_rng(19)
+        parts = [rng.standard_normal((64, 48)).astype(np.float32)
+                 for _ in range(3)]
+        handles = [dev.put(p) for dev, p in zip(devs, parts)]
+        out = fed.all_reduce(handles, free_src=True, install=True,
+                             fetch_value=False)
+        # receipt-only regime: nothing rode back to the client
+        assert out["value"] is None
+        assert out["handles"] is not None and len(out["handles"]) == 3
+        want = _ring_sum(parts)
+        for h in out["handles"]:
+            np.testing.assert_allclose(h.fetch(), want, rtol=1e-6,
+                                       atol=1e-6)
+        snap = fed.fed_snapshot()
+        assert snap["fabric_rings_total"] == 1
+        assert snap["client_relay_bytes"] == 0
+        assert out["raw_bytes"] > 0        # the peer hops DID move bytes
+        for h in out["handles"]:
+            h.free()
+
+        for tap in client_taps:
+            # rendezvous + leg launch crossed every client wire...
+            assert "FABRIC_OPEN" in tap.kinds_up
+            assert "FABRIC_ALLREDUCE" in tap.kinds_up
+            assert "FABRIC_ALLREDUCE_OK" in tap.kinds_down
+            # ...but no peer hop ever did, in either direction,
+            peer_kinds = {"PEER_REDUCE", "PEER_INSTALL",
+                          "PEER_REDUCE_OK", "PEER_INSTALL_OK"}
+            assert not (set(tap.kinds_up + tap.kinds_down)
+                        & peer_kinds)
+            # ...and every v9 frame the client saw was payload-free
+            v9_payload = sum(n for k, n in tap.frames_up
+                             + tap.frames_down if k in V9_KINDS)
+            assert v9_payload == 0
+        # positive control: the collective payload rode worker→worker
+        reduce_payload = sum(n for t in peer_taps
+                             for k, n in t.frames_up
+                             if k == "PEER_REDUCE")
+        install_payload = sum(n for t in peer_taps
+                              for k, n in t.frames_up
+                              if k == "PEER_INSTALL")
+        assert reduce_payload > 0 and install_payload > 0
+    finally:
+        for dev in devs:
+            dev.close()
+        for t in client_taps + peer_taps:
+            t.close()
+
+
+# -- deprecated client-relayed ring (satellite 1) ---------------------------
+
+
+def test_legacy_ring_relays_through_client(workers3):
+    """Positive control for the relay ledger: forcing the deprecated
+    client-relayed ring counts every accumulator byte as client relay,
+    and its math stays bit-identical to the sequential ring sum."""
+    fed = FederatedDevice([w.url for w in workers3], ring=True)
+    devs = fed.workers
+    rng = np.random.default_rng(20)
+    parts = [rng.standard_normal((32, 32)).astype(np.float32)
+             for _ in range(3)]
+    handles = [dev.put(p) for dev, p in zip(devs, parts)]
+    out = fed.all_reduce(handles, free_src=True, prefer_fabric=False)
+    np.testing.assert_array_equal(out["value"], _ring_sum(parts))
+    snap = fed.fed_snapshot()
+    assert snap["allreduce_total"] == 1
+    assert snap["fabric_rings_total"] == 0
+    assert snap["client_relay_bytes"] > 0
+    fed.close()
+
+
+def test_pinned_v8_federation_uses_legacy_ring_bit_compat(caplog):
+    """A ring=True federation over v8-pinned workers silently stays on
+    the deprecated client-relayed ring (with a deprecation warning in
+    the log), and its result is pinned bit-exact to the sequential
+    ring sum — the v7/v8 compatibility contract."""
+    ws = [RemoteVTPUWorker(protocol_version=8) for _ in range(3)]
+    for w in ws:
+        w.start()
+    try:
+        fed = FederatedDevice([w.url for w in ws], ring=True)
+        with caplog.at_level(
+                logging.WARNING,
+                logger="tensorfusion_tpu.remoting.federation"):
+            assert not fed.fabric_supported()
+        assert "deprecated" in caplog.text
+        assert fed.fed_supported()
+        devs = fed.workers
+        rng = np.random.default_rng(21)
+        parts = [rng.standard_normal((48, 16)).astype(np.float32)
+                 for _ in range(3)]
+        handles = [dev.put(p) for dev, p in zip(devs, parts)]
+        out = fed.all_reduce(handles, free_src=True)
+        np.testing.assert_array_equal(out["value"], _ring_sum(parts))
+        snap = fed.fed_snapshot()
+        assert snap["fabric_rings_total"] == 0
+        assert snap["client_relay_bytes"] > 0
+        fed.close()
+    finally:
+        for w in ws:
+            w.stop()
+
+
+# -- mixed-version battery (satellite 3) ------------------------------------
+
+
+@pytest.mark.parametrize("old_version", [2, 3, 4, 5, 6, 7, 8])
+def test_pinned_old_peers_never_see_v9_opcodes(old_version):
+    """Federated traffic over a pre-v9 mesh — degraded execution for
+    v2–v6, real v7/v8 collectives for the rest — puts ZERO v9 frames
+    on the wire in EITHER direction (raw-socket taps on every
+    worker)."""
+    ws = [RemoteVTPUWorker(protocol_version=old_version)
+          for _ in range(2)]
+    for w in ws:
+        w.start()
+    taps = [FrameTap(w.port) for w in ws]
+    try:
+        fed = FederatedDevice([f"tcp://127.0.0.1:{t.port}"
+                               for t in taps], ring=True)
+        assert not fed.fabric_supported()
+        rng = np.random.default_rng(22)
+        if old_version >= P.FED_MIN_VERSION:
+            parts = [rng.standard_normal((16, 16)).astype(np.float32)
+                     for _ in range(2)]
+            handles = [dev.put(p)
+                       for dev, p in zip(fed.workers, parts)]
+            out = fed.all_reduce(handles, free_src=True)
+            np.testing.assert_allclose(out["value"],
+                                       parts[0] + parts[1],
+                                       rtol=1e-6)
+        else:
+            x = rng.standard_normal((8, 8)).astype(np.float32)
+            got = fed.federated_jit(jax.jit(lambda a: a * 2.0),
+                                    in_axes=0)(x)
+            np.testing.assert_allclose(np.asarray(got), x * 2.0,
+                                       rtol=1e-6)
+        fed.close()
+        seen = set()
+        for t in taps:
+            seen |= set(t.kinds_up + t.kinds_down)
+        assert not (seen & set(V9_KINDS)), seen
+    finally:
+        for t in taps:
+            t.close()
+        for w in ws:
+            w.stop()
+
+
+@pytest.mark.parametrize("kind", V9_REQUEST_KINDS)
+def test_worker_gate_rejects_each_smuggled_v9_kind(worker, kind):
+    """Double gate, worker half: a hand-rolled peer that negotiated v8
+    but smuggles each fabric kind anyway gets a structured ERROR
+    naming the version floor — before any session state is touched."""
+    s = socket.create_connection(("127.0.0.1", worker.port))
+    try:
+        P.send_message(s, "HELLO", {"max_version": 8, "seq": 1}, [],
+                       version=P.HELLO_VERSION)
+        k, meta, _ = P.recv_message(s)
+        assert k == "HELLO_OK" and meta["version"] == 8
+        P.send_message(s, kind, {"cid": "z", "step": 0, "seq": 2},
+                       [], version=8)
+        k, meta, _ = P.recv_message(s)
+        assert k == "ERROR"
+        assert "protocol >= 9" in meta["error"]
+    finally:
+        s.close()
+
+
+def test_pinned_client_refuses_fabric_kinds(worker):
+    """Double gate, client half: a v8-pinned client build raises
+    before anything hits the wire."""
+    dev = RemoteDevice(worker.url, protocol_version=8)
+    with pytest.raises(RemoteExecutionError, match="protocol v9"):
+        dev.fabric_open("c0")
+    with pytest.raises(RemoteExecutionError, match="protocol v9"):
+        dev.fabric_allreduce("c0", [], [{"url": dev.url}], 0, "c-r0")
+    dev.close()
+
+
+# -- PeerLink pool (satellite 2) --------------------------------------------
+
+
+def test_peer_link_pool_reuses_and_expires(worker):
+    """lease/release round-trips reuse the SAME link (one dial), and a
+    link idle past the TTL is swept closed instead of reused."""
+    pool = PeerLinkPool(idle_ttl_s=0.25)
+    try:
+        l1 = pool.lease(worker.url)
+        l1.device.info()
+        pool.release(l1)
+        l2 = pool.lease(worker.url)
+        assert l2 is l1
+        snap = pool.snapshot()
+        assert snap["dials"] == 1 and snap["hits"] == 1
+        pool.release(l2)
+        time.sleep(0.4)
+        # a release on ANY key sweeps the idle shelf; use a distinct
+        # (quantize) key so the expired link stays parked until then
+        other = pool.lease(worker.url, quantize=True)
+        pool.release(other)
+        snap = pool.snapshot()
+        assert snap["expired"] == 1
+        l3 = pool.lease(worker.url)
+        assert l3 is not l1
+        assert pool.snapshot()["dials"] == 3
+        pool.release(l3)
+    finally:
+        pool.close()
+
+
+def test_stale_uid_redials_after_target_restart():
+    """The staleness oracle: a pooled link whose target restarted (new
+    worker process, same port) fails its worker_uid re-verification on
+    lease and is replaced by a fresh dial with a bumped generation —
+    holders can never trust staged state across a peer restart."""
+    w = RemoteVTPUWorker()
+    w.start()
+    port = w.port
+    url = w.url
+    # verify_fresh_s=0: always run the uid round-trip (the production
+    # freshness window only skips it for links used moments ago)
+    pool = PeerLinkPool(verify_fresh_s=0.0)
+    w2 = None
+    try:
+        l1 = pool.lease(url)
+        l1.device.info()
+        uid1 = l1.device.worker_uid
+        assert uid1 and uid1.startswith("w-")
+        pool.release(l1)
+        w.stop()
+        # an in-process stop() leaves established handler threads
+        # serving the old socket; sever the link's TCP so the re-dial
+        # lands on the replacement process, as a real worker death
+        # would force
+        l1.device.close()
+        w2 = RemoteVTPUWorker(port=port)
+        w2.start()
+        l2 = pool.lease(url)
+        assert l2 is not l1
+        assert l2.generation == 1
+        l2.device.info()
+        assert l2.device.worker_uid != uid1
+        assert pool.snapshot()["redials"] == 1
+        pool.release(l2)
+    finally:
+        pool.close()
+        if w2 is not None:
+            w2.stop()
+        else:
+            w.stop()
+
+
+def test_migration_rounds_reuse_pooled_link(workers2):
+    """Two back-to-back streaming migrations to the same target lease
+    the SAME pooled peer link on the source worker: one dial, one pool
+    hit (INFO "fabric".pool is the ledger)."""
+    src, tgt = workers2
+    ten = RemoteDevice(src.url)
+    orch = RemoteDevice(src.url)
+    try:
+        ten.put(np.arange(2048, dtype=np.float32))
+        orch.snapshot_delta(tgt.url)
+        orch.migrate_freeze()
+        orch.migrate_commit()
+        pool = orch.info()["fabric"]["pool"]
+        assert pool["dials"] == 1 and pool["leases"] == 1
+
+        ten.put(np.full(1024, 3.0, dtype=np.float32))
+        orch.snapshot_delta(tgt.url)
+        pool = orch.info()["fabric"]["pool"]
+        assert pool["dials"] == 1
+        assert pool["leases"] == 2 and pool["hits"] == 1
+        orch.migrate_commit(abort=True)
+    finally:
+        ten.close()
+        orch.close()
+
+
+# -- cross-worker model parallelism (tentpole acceptance) -------------------
+
+
+def _stage1(w, x):
+    # each worker holds a contraction-axis shard of W (rows) and x
+    # (cols): the matmul partial psums to the full x @ W
+    return x @ w
+
+
+def _stage2(a):
+    return jnp.tanh(a) + 1.0
+
+
+def test_model_parallel_matches_single_worker(workers2):
+    """2-worker model-parallel forward on the raw wire matches the
+    single-worker reference: stage-1 partials fabric-psum into
+    per-worker residents (zero client relay), stage 2 continues from
+    the installed activation."""
+    fed = FederatedDevice([w.url for w in workers2])
+    mp = fed.model_parallel_jit(_stage1, _stage2,
+                                stage1_in_axes=(0, 1))
+    rng = np.random.default_rng(23)
+    W = rng.standard_normal((33, 24)).astype(np.float32) * 0.05
+    x = rng.standard_normal((16, 33)).astype(np.float32)
+    got = np.asarray(mp(W, x))
+    want = np.tanh(x.astype(np.float64) @ W.astype(np.float64)) + 1.0
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    snap = fed.fed_snapshot()
+    assert snap["fabric_rings_total"] == 1
+    assert snap["client_relay_bytes"] == 0
+    assert snap["shard_execs_total"] >= 2
+    fed.close()
+
+
+def test_model_parallel_q8_bounded(workers2):
+    """Same forward with q8 opted in (uploads AND peer hops quantize):
+    error stays under the explicit worst-case linear bound built from
+    the block scales — uploads propagate through the contraction, the
+    two ring hops add their own per-element scale, tanh is
+    1-Lipschitz."""
+    fed = FederatedDevice([w.url for w in workers2], quantize=True)
+    mp = fed.model_parallel_jit(_stage1, _stage2,
+                                stage1_in_axes=(0, 1))
+    rng = np.random.default_rng(24)
+    W = rng.standard_normal((33, 24)).astype(np.float32) * 0.05
+    x = rng.standard_normal((16, 33)).astype(np.float32)
+    got = np.asarray(mp(W, x))
+    pre = x @ W
+    want = np.tanh(pre) + 1.0
+    K = W.shape[0]
+    s_x = float(np.abs(x).max()) / 127.0
+    s_w = float(np.abs(W).max()) / 127.0
+    s_pre = float(np.abs(pre).max()) / 127.0
+    s_out = float(np.abs(want).max()) / 127.0
+    bound = (K * (s_x / 2) * float(np.abs(W).max())
+             + K * (s_w / 2) * float(np.abs(x).max())
+             + 2 * (s_pre / 2)          # reduce + install ring hops
+             + s_out / 2                # quantized reply fetch
+             ) * 2.0
+    err = float(np.abs(got - want).max())
+    assert err <= bound, (err, bound)
+    assert bound < 1.0                  # the bound is a real check
+    snap = fed.fed_snapshot()
+    assert snap["fabric_rings_total"] == 1
+    assert snap["client_relay_bytes"] == 0
+    fed.close()
+
+
+def test_model_parallel_falls_back_without_fabric():
+    """Degradations stay correct: v8 members run the psum over the
+    client-coordinated collective (relay bytes > 0, zero rings); v6
+    members compose both stages on worker 0 (a psum over one member is
+    the identity)."""
+    rng = np.random.default_rng(25)
+    W = rng.standard_normal((32, 16)).astype(np.float32) * 0.05
+    x = rng.standard_normal((8, 32)).astype(np.float32)
+    want = np.tanh(x @ W) + 1.0
+
+    ws = [RemoteVTPUWorker(protocol_version=8) for _ in range(2)]
+    for w in ws:
+        w.start()
+    try:
+        fed = FederatedDevice([w.url for w in ws])
+        mp = fed.model_parallel_jit(_stage1, _stage2,
+                                    stage1_in_axes=(0, 1))
+        np.testing.assert_allclose(np.asarray(mp(W, x)), want,
+                                   rtol=1e-4, atol=1e-5)
+        snap = fed.fed_snapshot()
+        assert snap["allreduce_total"] == 1
+        assert snap["fabric_rings_total"] == 0
+        assert snap["client_relay_bytes"] > 0
+        fed.close()
+    finally:
+        for w in ws:
+            w.stop()
+
+    ws = [RemoteVTPUWorker(protocol_version=6) for _ in range(2)]
+    for w in ws:
+        w.start()
+    try:
+        fed = FederatedDevice([w.url for w in ws])
+        mp = fed.model_parallel_jit(_stage1, _stage2,
+                                    stage1_in_axes=(0, 1))
+        np.testing.assert_allclose(np.asarray(mp(W, x)), want,
+                                   rtol=1e-4, atol=1e-5)
+        snap = fed.fed_snapshot()
+        assert snap["fallback_calls_total"] >= 1
+        assert snap["allreduce_total"] == 0
+        fed.close()
+    finally:
+        for w in ws:
+            w.stop()
+
+
+# -- observability surfaces (satellite 4/5) ---------------------------------
+
+
+def test_fabric_metrics_and_info(workers3):
+    """After one fabric ring: tpf_fed_collective conforms to the
+    schema and carries the fabric fields; every worker's INFO exposes
+    the "fabric" counters (hop totals summing to 2(n-1)), the pool
+    ledger and its process worker_uid; the fed.collective span is
+    tagged fabric=1; and the fabric.ring span is a declared catalog
+    entry."""
+    from tensorfusion_tpu.hypervisor.metrics import federation_lines
+    from tensorfusion_tpu.metrics.schema import METRICS_SCHEMA
+    from tensorfusion_tpu.tracing import Tracer
+    from tensorfusion_tpu.tracing.registry import SPAN_SCHEMA
+
+    tracer = Tracer(service="fab-test", sample=1.0)
+    fed = FederatedDevice([w.url for w in workers3], ring=True,
+                          tracer=tracer)
+    devs = fed.workers
+    rng = np.random.default_rng(26)
+    parts = [rng.standard_normal((16, 16)).astype(np.float32)
+             for _ in range(3)]
+    handles = [dev.put(p) for dev, p in zip(devs, parts)]
+    out = fed.all_reduce(handles, free_src=True)
+    np.testing.assert_allclose(out["value"], _ring_sum(parts),
+                               rtol=1e-6)
+
+    lines = federation_lines(fed, "n1", 123)
+    assert len(lines) == 1 and lines[0].startswith(
+        "tpf_fed_collective,")
+    schema = METRICS_SCHEMA["tpf_fed_collective"]
+    head, fields, _ = lines[0].split(" ")
+    tags = dict(kv.split("=") for kv in head.split(",")[1:])
+    assert set(tags) == set(schema["tags"])
+    fvals = dict(kv.split("=") for kv in fields.split(","))
+    assert set(fvals) <= set(schema["fields"])
+    assert fvals["fabric_rings_total"].rstrip("i") == "1"
+    assert fvals["client_relay_bytes_total"].rstrip("i") == "0"
+
+    rings = reduce_hops = install_hops = 0
+    for dev in devs:
+        info = dev.info()
+        fab = info["fabric"]
+        assert fab["session"] is None           # collective retired
+        assert fab["pool"]["leases"] >= 1       # legs rode the pool
+        assert info["worker_uid"].startswith("w-")
+        rings += fab["rings_total"]
+        reduce_hops += fab["reduce_hops_total"]
+        install_hops += fab["install_hops_total"]
+    # one ring counted once fleet-wide (member 0 owns the count), and
+    # 2(n-1) hops of each flavour landed across the mesh
+    assert rings == 1
+    assert reduce_hops == 2 and install_hops == 2
+
+    spans = [s for s in tracer.finished()
+             if s["name"] == "fed.collective"]
+    assert spans and spans[-1]["attrs"].get("fabric") == 1
+    assert spans[-1]["attrs"].get("ring") == 0  # ring var = legacy ring
+    assert "fabric.ring" in SPAN_SCHEMA
+    assert "hops" in SPAN_SCHEMA["fabric.ring"]["attrs"]
+    fed.close()

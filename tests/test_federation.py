@@ -192,11 +192,13 @@ def test_resident_step_allreduce_install_and_free(workers2):
 
 
 def test_ring_reduce_three_workers(workers3):
-    """``ring=True`` at N >= 3 runs the client-relayed ring: the
-    accumulator visits each worker once (summed worker-side); the
-    result matches the full-batch reference."""
+    """``ring=True`` over an all-v9 mesh routes through the zero-relay
+    FABRIC ring (the client-relayed ring is deprecated, kept only for
+    v7/v8 peers — tests/test_fabric.py pins its math): reduce hops
+    worker→worker, result matches the full-batch reference."""
     fed = FederatedDevice([w.url for w in workers3], ring=True)
     assert fed.n_workers == 3
+    assert fed.fabric_supported()
     rng = np.random.default_rng(6)
     W = rng.standard_normal((8, 8)).astype(np.float32)
     x = rng.standard_normal((9, 8)).astype(np.float32)
@@ -209,6 +211,9 @@ def test_ring_reduce_three_workers(workers3):
                                         jnp.asarray(x)))
     np.testing.assert_allclose(out["value"], want, rtol=1e-4,
                                atol=1e-4)
+    snap = fed.fed_snapshot()
+    assert snap["fabric_rings_total"] == 1
+    assert snap["client_relay_bytes"] == 0
     fed.close()
 
 

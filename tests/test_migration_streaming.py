@@ -724,4 +724,6 @@ def test_info_reports_migration_state(pair):
     info = dev.info()
     assert info["migration"]["frozen"] is False
     assert info["migration"]["session"] is None
-    assert info["protocol_version"] == 8
+    assert info["protocol_version"] == 9
+    assert info["fabric"]["session"] is None
+    assert info["worker_uid"].startswith("w-")

@@ -72,6 +72,20 @@ CELLS = {
          "federation.dim"),
         ("federation.overlap_efficiency_pct", "higher", 35.0, "abs",
          "federation.rows_per_worker"),
+        # peer-fabric zero-relay ring (protocol v9, the peer-fabric
+        # section of docs/federation.md): aggregate at the top worker
+        # count (acceptance > 3.15x — PR 13's client-coordinated
+        # ceiling), the zero-relay invariant itself (band 0: ANY
+        # collective byte through the client is a regression, never
+        # noise), and the per-leg q8 hop-byte cut.  Worker count is
+        # the shape guard — comparing a 4-ring against a 2-ring is a
+        # shape change, not a perf delta.
+        ("fabric.aggregate_vs_1worker_at_max", "higher", 25.0, "rel",
+         "fabric.workers_at_max"),
+        ("fabric.client_relay_bytes_at_max", "lower", 0.0, "abs",
+         "fabric.workers_at_max"),
+        ("fabric.q8.bytes_ratio_vs_raw", "higher", 15.0, "rel",
+         "fabric.workers_at_max"),
         ("tracing.overhead_pct", "lower", 4.0, "abs"),
         ("profiler.overhead_pct", "lower", 4.0, "abs"),
         ("policy.overhead_pct", "lower", 4.0, "abs"),

@@ -20,7 +20,10 @@ the linter fails with the expected finding:
   unbounded;
 - **protocol-session**: the ``sess.state == "live"`` guard is deleted
   from MIGRATE_FREEZE — the session checker must notice the handler no
-  longer checks the machine's only declared from-state;
+  longer checks the machine's only declared from-state; likewise the
+  ``sess.state not in ("open", "reducing")`` guard is deleted from the
+  peer-fabric PEER_REDUCE handler (protocol v9) — a reduce hop
+  depositing into a done/aborted collective must not go unlinted;
 - **sim-nondeterminism**: a set literal folded into the harness event
   log — the determinism walk must flag the unordered iteration.
 
@@ -220,6 +223,19 @@ DRILLS = [
         "            if sess is not None and sess.state == \"live\":\n",
         "            if sess is not None:\n",
         ["MIGRATE_FREEZE", "never compares", ".state"],
+        "replace",
+    ),
+    (
+        "protocol-session-peer-guard-deleted",
+        "protocol-session",
+        "tensorfusion_tpu/remoting/worker.py",
+        (
+            "        if sess is None or sess.cid != cid or \\\n"
+            "                sess.state not in (\"open\", "
+            "\"reducing\"):\n"
+        ),
+        "        if sess is None or sess.cid != cid:\n",
+        ["PEER_REDUCE", "never compares", ".state"],
         "replace",
     ),
     (
