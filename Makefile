@@ -11,7 +11,7 @@ PY := env -u PALLAS_AXON_POOL_IPS python
 	verify-stress verify-sim verify-trace verify-serving verify-wire \
 	verify-prof verify-campaign verify-federation verify-fabric \
 	verify-shard \
-	verify-migrate bench-diff bench-provenance \
+	verify-migrate verify-model bench-diff bench-provenance \
 	verify-native-sanitized \
 	check-coverage lint lint-cold \
 	lint-drill asan \
@@ -43,22 +43,30 @@ verify-all: lint test-native check-coverage
 # metrics-schema / shard-routing) plus the tpfgraph interprocedural layer (lock-order-
 # inversion / transitive-blocking-under-lock / swallowed-error /
 # unjoined-thread / leaked-resource) plus the tpfflow dataflow layer
-# (untrusted-wire-input / protocol-session / sim-nondeterminism),
+# (untrusted-wire-input / protocol-session / sim-nondeterminism) and
+# the tpfmodel conformance slice (protocol-model: gate dominance,
+# declaration<->code conformance, a bounded 2-ring exploration),
 # ratcheted by tools/tpflint/baseline.json (currently EMPTY — keep it
 # that way).  tools/ is linted too: the linter lints itself.  Per-file
-# analysis is cached in .tpflint-cache.json (content-keyed blake2b;
-# TPF_LINT_NO_CACHE=1 or --no-cache bypasses, --verbose prints
-# hit/miss counters).  --max-seconds is the wall-time budget: 6s warm
-# (the edit loop; raised from 4s when the peer-fabric layer grew the
-# analyzed tree past the old budget's flake point), 12s cold via
-# `make lint-cold` (CI from scratch) — blowing it fails the target
-# even when findings are clean.
+# analysis is cached in .tpflint-cache.json (content-keyed blake2b,
+# generation-keyed by the registered checker set + checker source
+# hashes so a new/changed checker self-evicts it; TPF_LINT_NO_CACHE=1
+# or --no-cache bypasses, --verbose prints hit/miss counters).
+# --max-seconds is the wall-time budget: 6s warm (the edit loop;
+# raised from 4s when the peer-fabric layer grew the analyzed tree
+# past the old budget's flake point), 12s cold via `make lint-cold`
+# (CI from scratch) — blowing it fails the target even when findings
+# are clean.  Under CI=1 the linter emits GitHub ::error annotations
+# alongside the text report.
+LINT_FORMAT := $(if $(CI),--format=github,)
 lint:
-	$(PY) -m tools.tpflint tensorfusion_tpu tools --max-seconds 6
+	$(PY) -m tools.tpflint tensorfusion_tpu tools --max-seconds 6 \
+		$(LINT_FORMAT)
 
 lint-cold:
 	rm -f .tpflint-cache.json
-	$(PY) -m tools.tpflint tensorfusion_tpu tools --max-seconds 12
+	$(PY) -m tools.tpflint tensorfusion_tpu tools --max-seconds 12 \
+		$(LINT_FORMAT)
 
 # Checker liveness drills: re-introduce one known-bad pattern per graph
 # checker (a lock-order inversion in store.py among them) into a
@@ -93,7 +101,7 @@ verify-repeat: native
 verify-stress: verify-sim verify-campaign verify-trace verify-serving \
 	verify-wire verify-federation verify-fabric verify-prof \
 	verify-shard \
-	verify-migrate bench-diff
+	verify-migrate verify-model bench-diff
 	@for i in 1 2 3 4 5; do \
 		echo "=== verify-stress round $$i/5 ==="; \
 		env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -224,6 +232,23 @@ verify-fabric:
 		TPF_BENCH_RESULTS_DIR=/tmp/tpffabric_verify_results \
 		python benchmarks/remoting_bench.py --fabric-quick
 	@echo "verify-fabric: OK"
+
+# Protocol model checking (tools/tpfmodel.py, docs/static-analysis.md
+# "model layer"): extract the session machines / version gates /
+# dispatch arms / rendezvous ordering from the code and exhaustively
+# explore the full topology matrix — mixed version vectors, a
+# version-floor rogue peer injecting every fenced opcode, peer
+# restarts mid-ring, concurrent migration x fabric — proving
+# no-opcode-leak, gate-dominance, session soundness (every declared
+# state reached, no stuck state) and generation/fencing monotonicity
+# on EVERY interleaving, with counterexamples rendered as frame
+# sequences.  The cheap 2-ring slice of this runs in `make lint`
+# (checker #18, protocol-model); this target is the exhaustive pass.
+# Run on any change to SESSION_PROTOCOLS, the version gates, or the
+# fabric/migration orchestration.
+verify-model:
+	$(PY) -m tools.tpfmodel
+	@echo "verify-model: OK"
 
 # tpfprof gate (docs/profiling.md): the profiling suite (attribution
 # math, flight-recorder determinism incl. byte-identical same-seed

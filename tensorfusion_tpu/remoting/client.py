@@ -765,7 +765,8 @@ class RemoteDevice:
         connection raises before anything hits the wire."""
         import queue as _queue
 
-        self._ensure_version(6, "KV_SHIP (disaggregated prefill)")
+        self._ensure_version(protocol.KV_SHIP_MIN_VERSION,
+                             "KV_SHIP (disaggregated prefill)")
         base_meta: Dict[str, Any] = {
             "prompt": [int(t) for t in prompt],
             "max_tokens": int(max_tokens),

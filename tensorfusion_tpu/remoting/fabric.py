@@ -33,12 +33,12 @@ from __future__ import annotations
 import logging
 import os
 import threading
-import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from .. import constants
+from ..clock import Clock, default_clock
 from . import protocol
 from .client import RemoteDevice, _UploadStream
 
@@ -73,7 +73,8 @@ class PeerLink:
 
     def __init__(self, url: str, token: str = "",
                  qos: str = constants.DEFAULT_QOS,
-                 quantize: bool = False) -> None:
+                 quantize: bool = False,
+                 clock: Optional[Clock] = None) -> None:
         self.url = url
         self.token = token
         self.qos = qos
@@ -85,7 +86,11 @@ class PeerLink:
         self.generation = 0
         self.raw_bytes = 0
         self.wire_bytes = 0
-        self.last_used_m = time.monotonic()
+        # idle/freshness bookkeeping rides the injectable clock so the
+        # TTL reap and verify-fresh window are explorable under
+        # SimClock instead of only at wall-clock speed
+        self._clock = clock or default_clock()
+        self.last_used_m = self._clock.monotonic()
 
     # -- staged uploads (the migration / KV page path) ----------------
 
@@ -164,7 +169,7 @@ class PeerLink:
         return uid is None or uid == self.worker_uid
 
     def touch(self) -> None:
-        self.last_used_m = time.monotonic()
+        self.last_used_m = self._clock.monotonic()
 
     def close(self) -> None:
         try:
@@ -189,10 +194,11 @@ class PeerLinkPool:
     """
 
     def __init__(self, idle_ttl_s: float = PEER_LINK_IDLE_TTL_S,
-                 verify_fresh_s: float = PEER_LINK_VERIFY_FRESH_S
-                 ) -> None:
+                 verify_fresh_s: float = PEER_LINK_VERIFY_FRESH_S,
+                 clock: Optional[Clock] = None) -> None:
         self.idle_ttl_s = float(idle_ttl_s)
         self.verify_fresh_s = float(verify_fresh_s)
+        self._clock = clock or default_clock()
         self._idle: Dict[Tuple[str, str, bool], List[PeerLink]] = {}
         self._lock = threading.Lock()
         self._closed = False
@@ -212,7 +218,7 @@ class PeerLinkPool:
                 if not bucket:
                     del self._idle[key]
         if pooled is not None:
-            fresh = (time.monotonic() - pooled.last_used_m
+            fresh = (self._clock.monotonic() - pooled.last_used_m
                      <= self.verify_fresh_s)
             if fresh or pooled.verify():
                 self.stats["hits"] += 1
@@ -225,12 +231,13 @@ class PeerLinkPool:
             with self._lock:
                 self.stats["redials"] += 1
             fresh = PeerLink(url, token=token, qos=qos,
-                             quantize=quantize)
+                             quantize=quantize, clock=self._clock)
             fresh.generation = gen
             return fresh
         with self._lock:
             self.stats["dials"] += 1
-        return PeerLink(url, token=token, qos=qos, quantize=quantize)
+        return PeerLink(url, token=token, qos=qos, quantize=quantize,
+                        clock=self._clock)
 
     def release(self, link: PeerLink) -> None:
         """Park a link for reuse (and opportunistically sweep expired
@@ -252,7 +259,7 @@ class PeerLinkPool:
             stale.close()
 
     def _sweep_locked(self) -> List[PeerLink]:
-        now = time.monotonic()
+        now = self._clock.monotonic()
         expired: List[PeerLink] = []
         for key in list(self._idle):
             bucket = self._idle[key]

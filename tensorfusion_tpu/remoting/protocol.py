@@ -459,10 +459,15 @@ TAINT_SANITIZERS = ()
 # the session's ``.state`` against a declared from-state, opcodes
 # with a terminal transition must clear the session slot (anything
 # else leaks the session), and the slot is only (re)assigned in
-# ``creators``/``restores`` members.  Families without ``attr`` are
-# declaration + handler-existence only: the machine documents the
-# stream shape (GENERATE/KV_SHIP legs, federation SHIP legs) and
+# ``creators``/``restores`` members.  Families with ``attr`` but no
+# ``slot`` (the per-request GENERATE / KV_SHIP streams, which are
+# concurrent per tenant and so never occupy a worker-level slot) get
+# the state-write checks but skip the slot-lifecycle ones.  Families
+# without ``attr`` are declaration + handler-existence only: the
+# machine documents the stream shape (federation SHIP legs) and
 # reserves the name for when they grow explicit session objects.
+# tools/tpflint/model.py additionally model-checks these machines
+# against exhaustively explored mesh topologies (make verify-model).
 
 SESSION_PROTOCOLS = {
     "migration": {
@@ -490,9 +495,15 @@ SESSION_PROTOCOLS = {
         "restores": ("_handle_migrate_commit",),
     },
     # decode-side token stream: each GENERATE leg continues (or ends)
-    # one decoding session keyed by the shipped KV cache
+    # one decoding session keyed by the shipped KV cache.  The session
+    # object (``_GenerateStream``) is per-request — streams are
+    # concurrent per tenant — so there is no worker-level ``slot``;
+    # the emit callback carries the object and lands every exit path
+    # (final frame, structured error, admission error) in "done".
     "generate_stream": {
         "module": "remoting/worker.py",
+        "session": "_GenerateStream",
+        "attr": "state",
         "states": ("none", "streaming", "done"),
         "transitions": (
             ("none", "GENERATE", "streaming"),
@@ -500,12 +511,19 @@ SESSION_PROTOCOLS = {
             ("streaming", "GENERATE", "done"),
         ),
         "terminal": ("done",),
-        "handlers": {"GENERATE": ("_handle_generate",)},
+        "handlers": {"GENERATE": ("_handle_generate",
+                                  "_generate_emit")},
     },
     # prefill -> decode KV handoff: quiet ephemeral PUT legs then the
-    # KV_SHIP that binds them
+    # KV_SHIP that binds them.  ``_KvShipSession`` is likewise
+    # per-request (no slot): "shipping" across validation/admission,
+    # terminal "bound" at the KV_SHIP_OK receipt; error arms never
+    # bind, and the chained decode stream is its own
+    # ``_GenerateStream``.
     "kv_ship": {
         "module": "remoting/worker.py",
+        "session": "_KvShipSession",
+        "attr": "state",
         "states": ("none", "shipping", "bound"),
         "transitions": (
             ("none", "KV_SHIP", "shipping"),
@@ -548,7 +566,8 @@ SESSION_PROTOCOLS = {
             "FABRIC_OPEN": ("_handle_fabric_open",),
             "FABRIC_ALLREDUCE": ("_enqueue_fabric_allreduce",
                                  "_launch_fabric_allreduce",
-                                 "_flush_fabric_allreduce"),
+                                 "_flush_fabric_allreduce",
+                                 "_abort_fabric"),
             "PEER_REDUCE": ("_handle_peer_reduce",),
             "PEER_INSTALL": ("_handle_peer_install",),
         },

@@ -263,6 +263,41 @@ class _FabricCollective:
             self._cv.notify_all()
 
 
+class _GenerateStream:
+    """One decode-side token stream
+    (``SESSION_PROTOCOLS["generate_stream"]``): created "streaming" at
+    GENERATE / KV_SHIP admission, driven to the terminal "done" by the
+    engine's emit callback — the final frame, a structured-error
+    frame, or the admission error arms.  Streams are per-request and
+    concurrent per tenant, so there is no worker-level slot; the
+    object exists so the session checkers (protocol-session,
+    protocol-model) can hold the stream to its declared machine."""
+
+    __slots__ = ("state", "frames", "tokens_out")
+
+    def __init__(self):
+        self.state = "streaming"
+        self.frames = 0
+        self.tokens_out = 0
+
+
+class _KvShipSession:
+    """One prefill->decode KV handoff
+    (``SESSION_PROTOCOLS["kv_ship"]``): "shipping" while the shipped
+    pages are validated and admitted, terminal "bound" once the engine
+    owns them (the KV_SHIP_OK receipt).  Error arms leave the session
+    in "shipping" — the pages were never bound, and the object dies
+    with the request.  The decode stream the handoff chains into is
+    its own :class:`_GenerateStream`."""
+
+    __slots__ = ("state", "blocks", "n_tokens")
+
+    def __init__(self):
+        self.state = "shipping"
+        self.blocks = 0
+        self.n_tokens = 0
+
+
 class RemoteVTPUWorker:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  meter_client=None, token: Optional[str] = None,
@@ -1363,7 +1398,8 @@ class RemoteVTPUWorker:
             reply("ERROR", {"error": f"bad GENERATE request: {e}"}, [])
             return
         stream = bool(meta.get("stream", True))
-        emit = self._generate_emit(reply, stream)
+        sess = _GenerateStream()
+        emit = self._generate_emit(reply, stream, sess)
 
         try:
             self.engine.submit(prompt, max_tokens,
@@ -1372,16 +1408,21 @@ class RemoteVTPUWorker:
                                emit=emit,
                                trace=self._parse_trace(meta))
         except BusyError as e:
+            sess.state = "done"
             reply("ERROR", {"error": str(e), "code": "BUSY",
                             "retry_after_ms": e.retry_after_ms}, [])
         except ValueError as e:
+            sess.state = "done"
             reply("ERROR", {"error": str(e)}, [])
 
     @staticmethod
-    def _generate_emit(reply, stream: bool):
+    def _generate_emit(reply, stream: bool, sess=None):
         """The engine emit callback both GENERATE and KV_SHIP stream
         through: token frames as they land, one final frame with the
-        stats, engine shed/BUSY codes as structured ERROR."""
+        stats, engine shed/BUSY codes as structured ERROR.  ``sess``
+        is the request's :class:`_GenerateStream` — every exit path
+        (final frame, structured error) lands it in the terminal
+        "done" the declared machine requires."""
         acc: List[int] = []
 
         def emit(seq, new_tokens, done, info):
@@ -1390,6 +1431,9 @@ class RemoteVTPUWorker:
             try:
                 if not done:
                     if stream and new_tokens:
+                        if sess is not None:
+                            sess.frames += 1
+                            sess.tokens_out += len(new_tokens)
                         reply("GENERATE_OK",
                               {"tokens": [int(t) for t in new_tokens],
                                "done": False}, [])
@@ -1405,6 +1449,8 @@ class RemoteVTPUWorker:
                                                        0)}
                     if seq.trace_spans:
                         emeta["trace_spans"] = list(seq.trace_spans)
+                    if sess is not None:
+                        sess.state = "done"
                     reply("ERROR", emeta, [])
                     return
                 tokens = [int(t) for t in new_tokens] if stream \
@@ -1415,6 +1461,10 @@ class RemoteVTPUWorker:
                          "finish_reason": info.get("finish_reason", "")}
                 if seq.trace_spans:
                     final["trace_spans"] = list(seq.trace_spans)
+                if sess is not None:
+                    sess.frames += 1
+                    sess.tokens_out += len(tokens)
+                    sess.state = "done"
                 reply("GENERATE_OK", final, [])
             except (ConnectionError, OSError):
                 # dead client socket: the engine keeps serving other
@@ -1478,7 +1528,9 @@ class RemoteVTPUWorker:
             reply("ERROR", {"error": f"bad KV_SHIP request: {e}"}, [])
             return
         stream = bool(meta.get("stream", True))
-        emit = self._generate_emit(reply, stream)
+        sess = _KvShipSession()
+        sess.blocks, sess.n_tokens = len(keys), n_tokens
+        emit = self._generate_emit(reply, stream, _GenerateStream())
         payload = {"keys": keys, "k": k, "v": v,
                    "first_token": first, "n_tokens": n_tokens,
                    "bytes": int(k.nbytes + v.nbytes)
@@ -1495,6 +1547,7 @@ class RemoteVTPUWorker:
         except ValueError as e:
             reply("ERROR", {"error": str(e)}, [])
             return
+        sess.state = "bound"
         reply("KV_SHIP_OK", {"blocks": len(keys),
                              "n_tokens": n_tokens}, [])
 

@@ -420,6 +420,61 @@ def test_stale_uid_redials_after_target_restart():
             w.stop()
 
 
+def test_peer_link_pool_idle_ttl_reaps_under_sim_clock(worker):
+    """The idle-TTL reap on the injectable clock seam: no wall
+    sleeping — advance virtual time past the TTL and the next sweep
+    closes the stale link, while a link inside the TTL survives."""
+    from tensorfusion_tpu.sim.clock import SimClock
+
+    clk = SimClock()
+    pool = PeerLinkPool(idle_ttl_s=60.0, clock=clk)
+    try:
+        l1 = pool.lease(worker.url)
+        l1.device.info()
+        pool.release(l1)                       # parked at t=0
+        clk.advance(59.0)
+        other = pool.lease(worker.url, quantize=True)
+        pool.release(other)                    # sweep: l1 idle 59s <= TTL
+        assert pool.snapshot()["expired"] == 0
+        clk.advance(2.0)                       # l1 now idle 61s > TTL
+        other = pool.lease(worker.url, quantize=True)
+        pool.release(other)                    # sweep reaps l1 only
+        assert pool.snapshot()["expired"] == 1
+        l3 = pool.lease(worker.url)
+        assert l3 is not l1
+        pool.release(l3)
+    finally:
+        pool.close()
+
+
+def test_peer_link_pool_verify_fresh_window_under_sim_clock(worker):
+    """A link re-leased within verify_fresh_s skips the worker_uid
+    round-trip; past the window the uid re-verification runs — both
+    proven deterministically under SimClock."""
+    from tensorfusion_tpu.sim.clock import SimClock
+
+    clk = SimClock()
+    pool = PeerLinkPool(idle_ttl_s=3600.0, verify_fresh_s=5.0,
+                        clock=clk)
+    try:
+        l1 = pool.lease(worker.url)
+        l1.device.info()
+        pool.release(l1)                       # last used t=0
+        calls = []
+        orig_verify = l1.verify
+        l1.verify = lambda: (calls.append(1) or orig_verify())
+        clk.advance(4.0)                       # inside the window
+        l2 = pool.lease(worker.url)
+        assert l2 is l1 and calls == []
+        pool.release(l2)                       # last used t=4
+        clk.advance(6.0)                       # 6s idle > 5s window
+        l3 = pool.lease(worker.url)
+        assert l3 is l1 and len(calls) == 1
+        pool.release(l3)
+    finally:
+        pool.close()
+
+
 def test_migration_rounds_reuse_pooled_link(workers2):
     """Two back-to-back streaming migrations to the same target lease
     the SAME pooled peer link on the source worker: one dial, one pool

@@ -611,6 +611,36 @@ def test_corrupt_cache_is_rebuilt_not_fatal(tmp_path):
     assert stats == {"cache_hits": 0, "cache_misses": 2}
 
 
+def test_cache_key_derived_from_checker_registry(tmp_path, monkeypatch):
+    """Adding a checker must self-evict the facts cache: the cache
+    generation is derived from the registered checker set (names +
+    source digests), so a previously-warm cache misses without anyone
+    remembering to hand-bump CACHE_VERSION."""
+    import types
+
+    from tools.tpflint import checkers, graph
+
+    _write_tree(tmp_path)
+    run_paths(["pkg"], str(tmp_path))
+    stats: dict = {}
+    run_paths(["pkg"], str(tmp_path), stats=stats)
+    assert stats == {"cache_hits": 2, "cache_misses": 0}
+    before = graph.cache_key()
+    # register a brand-new (no-op) checker and drop the key memo, as a
+    # fresh process with one more checker module would compute it
+    fake = types.ModuleType("tools.tpflint.checkers.fake_checker")
+    fake.CHECK = "fake-checker"
+    fake.run_file = lambda sf: []
+    monkeypatch.setattr(checkers, "FILE_CHECKERS",
+                        checkers.FILE_CHECKERS + (fake,))
+    monkeypatch.setattr(graph, "_cache_key_memo", None)
+    assert graph.cache_key() != before
+    # the warm cache is now a different generation: full re-extraction
+    stats = {}
+    run_paths(["pkg"], str(tmp_path), stats=stats)
+    assert stats == {"cache_hits": 0, "cache_misses": 2}
+
+
 # -- JSON output ------------------------------------------------------------
 
 def test_json_format_carries_findings_and_witness(tmp_path, monkeypatch,
@@ -641,12 +671,33 @@ def test_json_format_clean_tree_ok(tmp_path, monkeypatch, capsys):
     assert report["ok"] is True and report["findings"] == []
 
 
+def test_github_format_emits_error_annotations(tmp_path, monkeypatch,
+                                               capsys):
+    """--format=github: one ``::error file=…,line=…`` workflow-command
+    line per actionable finding (the CI=1 `make lint` mode), with the
+    message escaped to stay on one line."""
+    _write_tree(tmp_path, {"pkg/w.py": BLOCKING_TWO_DEEP["pkg/w.py"]})
+    monkeypatch.chdir(str(tmp_path))
+    from tools.tpflint.__main__ import main
+    rc = main(["pkg", "--no-baseline", "--format=github", "--no-cache"])
+    assert rc == 1
+    out = capsys.readouterr().out
+    anns = [ln for ln in out.splitlines()
+            if ln.startswith("::error ")]
+    assert len(anns) == 1
+    assert anns[0].startswith("::error file=pkg/w.py,line=")
+    assert "title=tpflint transitive-blocking-under-lock::" in anns[0]
+    # the plain rendering still follows, for humans reading the CI log
+    assert "pkg/w.py:" in out.replace(anns[0], "")
+
+
 # -- the repo itself --------------------------------------------------------
 
 @pytest.mark.parametrize("check", [
     "lock-order-inversion", "transitive-blocking-under-lock",
     "swallowed-error", "unjoined-thread", "leaked-resource",
-    "untrusted-wire-input", "protocol-session", "sim-nondeterminism"])
+    "untrusted-wire-input", "protocol-session", "sim-nondeterminism",
+    "protocol-model"])
 def test_repo_is_clean_at_head_per_graph_checker(check):
     findings = run_paths(["tensorfusion_tpu", "tools"], REPO,
                          checks={check}, use_cache=False)
@@ -656,7 +707,7 @@ def test_repo_is_clean_at_head_per_graph_checker(check):
     assert new == [], [f.render() for f in new]
 
 
-def test_all_seventeen_checkers_registered():
+def test_all_eighteen_checkers_registered():
     assert set(ALL_CHECKS) == {
         "stale-write-back", "frozen-view-mutation", "blocking-under-lock",
         "guarded-field", "protocol-exhaustive", "metrics-schema",
@@ -664,7 +715,7 @@ def test_all_seventeen_checkers_registered():
         "transitive-blocking-under-lock", "swallowed-error",
         "unjoined-thread", "leaked-resource", "wall-clock-direct",
         "shard-routing", "untrusted-wire-input", "protocol-session",
-        "sim-nondeterminism"}
+        "sim-nondeterminism", "protocol-model"}
 
 
 def test_chain_of_shapes():

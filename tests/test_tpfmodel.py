@@ -1,0 +1,180 @@
+"""tpfmodel / tools.tpflint.model: protocol-model extraction, the
+bounded explorer, and the conformance checker.
+
+The extraction half is asserted against the REAL tree (the model the
+checker and ``make verify-model`` actually prove things about), the
+explorer half against sabotaged copies of that model — flipping one
+extracted fact (rendezvous ordering, a worker gate) must produce the
+matching counterexample with a frame trace, which is exactly what the
+two lint-drill sabotages exercise end-to-end on mutated sources.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import pytest
+
+from tools.tpflint import model as M
+from tools.tpflint.checkers import model_conformance
+from tools.tpflint.core import collect_files, run_paths
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def files():
+    return {sf.relpath: sf
+            for sf in collect_files(["tensorfusion_tpu"], REPO)}
+
+
+@pytest.fixture(scope="module")
+def model(files):
+    m = M.extract(files)
+    assert m is not None
+    return m
+
+
+# -- extraction against the real tree ---------------------------------------
+
+def test_extracts_head_version_and_floor(model):
+    assert model.version == 9
+    assert model.floor == 2
+    # HELLO negotiation: max(floor, min(worker, want))
+    assert model.negotiate(9, 9) == 9
+    assert model.negotiate(8, 9) == 8
+    assert model.negotiate(9, 2) == 2
+    assert model.negotiate(1, 1) == 2
+
+
+def test_fenced_kinds_name_their_min_version_constants(model):
+    fenced = model.fenced_kinds()
+    # the v9 fabric family rides FABRIC_MIN_VERSION on the client half
+    for kind in ("FABRIC_OPEN", "FABRIC_ALLREDUCE",
+                 "PEER_REDUCE", "PEER_INSTALL"):
+        assert kind in fenced, kind
+        assert fenced[kind].version == 9
+        assert fenced[kind].const == "FABRIC_MIN_VERSION"
+    # migration (v8) and KV_SHIP (v6, named constant since this PR)
+    assert fenced["MIGRATE_FREEZE"].const == "MIGRATE_MIN_VERSION"
+    assert fenced["KV_SHIP"].version == 6
+    assert fenced["KV_SHIP"].const == "KV_SHIP_MIN_VERSION"
+    # GENERATE's literal-5 client gate is single-gated by design:
+    # gated on the client, but NOT in the fenced (double-gate) set
+    assert "GENERATE" in model.client_gates
+    assert "GENERATE" not in fenced
+
+
+def test_every_fenced_kind_has_dominating_worker_gate(model):
+    for kind, cg in model.fenced_kinds().items():
+        assert kind in model.worker_entries, kind
+        wg = model.worker_gates.get(kind)
+        assert wg is not None and wg.version is not None, kind
+        assert wg.version >= cg.version, kind
+        assert wg.pre_effect is None, (kind, wg.pre_effect)
+
+
+def test_rendezvous_ordering_and_session_initials(model):
+    # federation opens every ring member BEFORE launching legs
+    assert model.rendezvous_before_legs is True
+    # the attr-bearing session families' constructor initial states
+    assert model.initial_states["generate_stream"] == "streaming"
+    assert model.initial_states["kv_ship"] == "shipping"
+    assert model.initial_states["peer_fabric"] is not None
+    assert model.restart_bumps_generation is True
+
+
+def test_static_conformance_clean_at_head(model, files):
+    assert M.static_issues(model, files) == []
+
+
+# -- the explorer -----------------------------------------------------------
+
+def test_ring2_explores_clean(model):
+    ring2 = M.mini_topologies(model)[0]
+    res = M.explore(model, ring2)
+    assert res.states > 0 and res.transitions > 0
+    assert res.violations == []
+    assert not res.truncated
+
+
+def test_rogue_peer_is_rejected_not_leaked(model):
+    rogue = M.mini_topologies(model)[1]
+    assert rogue.smuggle  # every fenced opcode, at the version floor
+    res = M.explore(model, rogue)
+    assert res.violations == []
+    assert res.gated_deliveries > 0
+    assert res.rejections > 0  # the worker half provably refused
+
+
+def test_reordered_rendezvous_produces_deadlock_counterexample(model):
+    """Flip the one extracted ordering fact (fabric_open after the
+    allreduce legs) and the explorer must find the wedge: a member's
+    flush aborts / a deposit never lands, with the frame trace."""
+    bad = dataclasses.replace(model, rendezvous_before_legs=False)
+    ring2 = M.mini_topologies(bad)[0]
+    res = M.explore(bad, ring2)
+    dead = [v for v in res.violations if v["property"] == "deadlock"]
+    assert dead, res.violations
+    joined = " ".join(dead[0]["trace"]) + " " + dead[0]["message"]
+    assert "FABRIC_OPEN" in joined or "FABRIC_ALLREDUCE" in joined
+
+
+def test_deleted_worker_gate_produces_leak_counterexample(model):
+    """Remove PEER_REDUCE's worker-half gate and the rogue topology
+    must catch the opcode leaking below its negotiated version."""
+    gates = dict(model.worker_gates)
+    gates["PEER_REDUCE"] = dataclasses.replace(
+        gates["PEER_REDUCE"], version=None, line=None)
+    bad = dataclasses.replace(model, worker_gates=gates)
+    rogue = M.mini_topologies(bad)[1]
+    res = M.explore(bad, rogue)
+    leaks = [v for v in res.violations
+             if v["property"] == "opcode-leak"]
+    assert leaks, res.violations
+    assert any("PEER_REDUCE" in v["message"] for v in leaks)
+
+
+def test_monotonicity_ranks_from_declared_transitions(model):
+    ranks = model.ranks("peer_fabric")
+    assert ranks["none"] == 0
+    # every declared state gets a rank; terminal states rank deepest
+    spec = model.families["peer_fabric"]
+    for s in spec["states"]:
+        assert s in ranks
+
+
+# -- the lint checker + CLI -------------------------------------------------
+
+def test_checker_silent_without_remoting_modules():
+    assert model_conformance.run_project({}, "/nonexistent") == []
+
+
+def test_checker_clean_on_real_tree():
+    findings = run_paths(["tensorfusion_tpu"], REPO,
+                         checks={"protocol-model"}, use_cache=False)
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_cli_single_topology_smoke(capsys):
+    from tools.tpfmodel import main
+    rc = main(["--repo", REPO, "--topology", "ring2"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "verify-model: OK (1 topologies)" in out
+    assert "no-opcode-leak" in out and "PROVED" in out
+
+
+def test_cli_list_topologies(capsys):
+    from tools.tpfmodel import main
+    assert main(["--repo", REPO, "--list"]) == 0
+    out = capsys.readouterr().out
+    for name in ("ring2", "ring2-rogue", "ring2-mixed", "migrate",
+                 "migrate-x-fabric", "serving"):
+        assert name in out, name
+
+
+def test_cli_unknown_topology_is_usage_error(capsys):
+    from tools.tpfmodel import main
+    assert main(["--repo", REPO, "--topology", "nope"]) == 2

@@ -6,8 +6,13 @@ findings or stale baseline entries, 2 usage error.
 ``--format=json`` emits a machine-readable report (findings with
 fingerprints and interprocedural witness chains, baseline verdict,
 cache counters) so CI and tooling consume results without scraping
-text.  ``--verbose`` prints the graph layer's cache hit/miss counters;
-``--no-cache`` (or ``TPF_LINT_NO_CACHE=1``) forces full re-extraction.
+text.  ``--format=github`` emits GitHub workflow annotations
+(``::error file=…,line=…``) for actionable findings — new ones under
+the baseline, all of them with ``--no-baseline`` — followed by the
+usual text summary (runners ignore non-``::`` lines); ``make lint``
+selects it when ``CI=1``.  ``--verbose`` prints the graph layer's
+cache hit/miss counters; ``--no-cache`` (or ``TPF_LINT_NO_CACHE=1``)
+forces full re-extraction.
 
 ``--max-seconds S`` is the perf budget gate: the run fails (exit 1)
 if the lint itself took longer than S wall seconds, even when the
@@ -52,8 +57,10 @@ def main(argv=None) -> int:
                         metavar="NAME", choices=ALL_CHECKS,
                         help="run only the named checker(s)")
     parser.add_argument("--format", default="text",
-                        choices=("text", "json"),
-                        help="output format (default: %(default)s)")
+                        choices=("text", "json", "github"),
+                        help="output format (default: %(default)s); "
+                             "github emits ::error workflow "
+                             "annotations for actionable findings")
     parser.add_argument("--no-cache", action="store_true",
                         help="bypass the graph facts cache "
                              "(TPF_LINT_NO_CACHE=1 does the same)")
@@ -107,6 +114,8 @@ def main(argv=None) -> int:
             return 1 if (findings or
                          _over_budget(args, stats, quiet=True)) else 0
         for f in findings:
+            if args.format == "github":
+                print(_annotation(f))
             print(f.render())
         print(f"tpflint: {len(findings)} finding(s)")
         return 1 if (findings or _over_budget(args, stats)) else 0
@@ -120,8 +129,13 @@ def main(argv=None) -> int:
         return 1 if (new or stale or
                      _over_budget(args, stats, quiet=True)) else 0
     for f in new:
+        if args.format == "github":
+            print(_annotation(f))
         print(f.render())
     for fp in stale:
+        if args.format == "github":
+            print(f"::warning title=tpflint stale baseline::"
+                  f"{_esc(f'baseline entry no longer fires: {fp}')}")
         print(f"tpflint: stale baseline entry no longer fires: {fp}")
     tolerated = len(findings) - len(new)
     if new or stale:
@@ -143,6 +157,21 @@ def main(argv=None) -> int:
           f"{len(ALL_CHECKS) if checks is None else len(checks)} "
           f"checkers)")
     return 0
+
+
+def _esc(text: str) -> str:
+    """GitHub annotation message escaping (percent-encoding of the
+    three characters the workflow-command grammar reserves)."""
+    return (text.replace("%", "%25").replace("\r", "%0D")
+            .replace("\n", "%0A"))
+
+
+def _annotation(f) -> str:
+    """One ``::error`` workflow-command line per actionable finding —
+    GitHub renders these inline on the PR diff."""
+    return (f"::error file={f.path},line={f.line},"
+            f"title=tpflint {f.check}::"
+            f"{_esc(f'{f.message}  ({f.symbol})')}")
 
 
 def _over_budget(args, stats, quiet: bool = False) -> bool:

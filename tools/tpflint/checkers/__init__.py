@@ -10,16 +10,16 @@ docs/static-analysis.md documents the contract.
 
 from . import (blocking_under_lock, frozen_view_mutation, guarded_fields,
                leaked_resource, lock_order, metrics_schema,
-               protocol_exhaustive, protocol_session, shard_routing,
-               sim_determinism, stale_write_back, swallowed_error,
-               trace_schema, transitive_blocking, unjoined_thread,
-               untrusted_wire, wall_clock)
+               model_conformance, protocol_exhaustive, protocol_session,
+               shard_routing, sim_determinism, stale_write_back,
+               swallowed_error, trace_schema, transitive_blocking,
+               unjoined_thread, untrusted_wire, wall_clock)
 
 FILE_CHECKERS = (stale_write_back, frozen_view_mutation,
                  blocking_under_lock, guarded_fields, wall_clock,
                  shard_routing)
 PROJECT_CHECKERS = (protocol_exhaustive, metrics_schema, trace_schema,
-                    protocol_session)
+                    protocol_session, model_conformance)
 GRAPH_CHECKERS = (lock_order, transitive_blocking, swallowed_error,
                   unjoined_thread, leaked_resource, untrusted_wire,
                   sim_determinism)

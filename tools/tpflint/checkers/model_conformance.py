@@ -1,0 +1,61 @@
+"""protocol-model: the model-checking layer's fast conformance half.
+
+Extracts the protocol model (session machines, send/receive version
+gates, dispatch arms, the fabric rendezvous ordering — see
+``tools/tpflint/model.py``) and proves at lint time:
+
+- every version-fenced opcode (client gate naming a ``*_MIN_VERSION``
+  constant) has a dispatch arm whose entry handler is DOMINATED by a
+  worker-half ``_wire_version`` gate at least as strong — no effect
+  (submit / deposit / ``.state`` write / non-ERROR reply) runs before
+  the gate on any path;
+- two-way declaration<->code conformance for ``attr``-bearing
+  families: every declared transition's *to* state is realized by a
+  declared handler write, the session constructor, or a self-loop
+  (the reverse direction ``protocol-session`` does not check);
+- a bounded exploration of two mini topologies (a head-version 2-ring
+  and the same ring with a version-floor rogue peer injecting every
+  fenced opcode): no deadlock, no opcode-leak, no session/generation
+  monotonicity regression on ANY interleaving.  Violations carry the
+  counterexample as a frame sequence in the message and the full
+  trace in the witness.
+
+``make verify-model`` (tools/tpfmodel.py) runs the full topology
+matrix; this checker keeps the cheap always-on slice inside the lint
+budget.  Silent when the remoting modules are not in the analyzed
+tree (fixture runs).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..core import Finding, SourceFile
+from .. import model as M
+
+CHECK = "protocol-model"
+
+
+def _finding(issue: dict) -> Finding:
+    return Finding(check=CHECK, path=issue["path"], line=issue["line"],
+                   symbol=issue["symbol"], message=issue["message"],
+                   key=issue.get("key", ""),
+                   witness=list(issue.get("witness", ())))
+
+
+def run_project(files: Dict[str, SourceFile],
+                repo_root: str) -> List[Finding]:
+    model = M.extract(files)
+    if model is None:
+        return []
+    findings = [_finding(i) for i in M.static_issues(model, files)]
+    for topo in M.mini_topologies(model):
+        res = M.explore(model, topo)
+        for v in res.violations:
+            findings.append(Finding(
+                check=CHECK, path=model.worker_rel, line=1,
+                symbol="<model>",
+                key=f"{topo.name}:{v['property']}",
+                message=f"[{topo.name}] {v['message']}",
+                witness=list(v["trace"])[-24:]))
+    return findings

@@ -25,7 +25,13 @@ the linter fails with the expected finding:
   peer-fabric PEER_REDUCE handler (protocol v9) — a reduce hop
   depositing into a done/aborted collective must not go unlinted;
 - **sim-nondeterminism**: a set literal folded into the harness event
-  log — the determinism walk must flag the unordered iteration.
+  log — the determinism walk must flag the unordered iteration;
+- **protocol-model** (two drills): the federation's FABRIC_OPEN
+  rendezvous loop is reordered after the leg launches — the model
+  checker's bounded exploration must produce a deadlock counterexample
+  naming the frame sequence; and the ``_fab_gate`` guard is deleted
+  from the PEER_REDUCE handler — it must produce both the static
+  undominated-arm finding and a reachable opcode-leak trace.
 
 Two mutation modes: ``insert`` (the payload lands immediately BEFORE
 the anchor line — all insert anchors are ``def`` lines) and
@@ -250,6 +256,66 @@ DRILLS = [
             "\n"
         ),
         ["set-order", "sim-reachable", "sorted("],
+    ),
+    # model checker, counterexample class 1: the FABRIC_OPEN
+    # rendezvous loop reordered AFTER the leg launches — the explorer
+    # must find an interleaving where a leg's flush (or a PEER_REDUCE
+    # deposit into a not-yet-open session) wedges the ring, and name
+    # the frame sequence
+    (
+        "protocol-model-rendezvous-reordered",
+        "protocol-model",
+        "tensorfusion_tpu/remoting/federation.py",
+        (
+            "        for dev in self.workers:\n"
+            "            dev.fabric_open(cid)\n"
+            "        rids = [dev.mint_buf_id(\"fab\") for dev in "
+            "self.workers]\n"
+            "        futs = []\n"
+            "        for i, (dev, h) in enumerate(zip(self.workers, "
+            "handles)):\n"
+            "            futs.append((dev, dev.fabric_allreduce(\n"
+            "                cid, self._handle_ids(h), roster, i, "
+            "rids[i], op=op,\n"
+            "                free_src=free_src, "
+            "quant=bool(self.quantize))))\n"
+        ),
+        (
+            "        rids = [dev.mint_buf_id(\"fab\") for dev in "
+            "self.workers]\n"
+            "        futs = []\n"
+            "        for i, (dev, h) in enumerate(zip(self.workers, "
+            "handles)):\n"
+            "            futs.append((dev, dev.fabric_allreduce(\n"
+            "                cid, self._handle_ids(h), roster, i, "
+            "rids[i], op=op,\n"
+            "                free_src=free_src, "
+            "quant=bool(self.quantize))))\n"
+            "        for dev in self.workers:\n"
+            "            dev.fabric_open(cid)\n"
+        ),
+        ["deadlock", "FABRIC_OPEN", "PEER_REDUCE", "frames:"],
+        "replace",
+    ),
+    # model checker, counterexample class 2: the _fab_gate guard
+    # deleted from the PEER_REDUCE handler — the static half must
+    # report the undominated arm and the explorer must exhibit a
+    # reachable opcode-leak (a v2-negotiated connection's frame
+    # executing the v9 arm)
+    (
+        "protocol-model-peer-gate-deleted",
+        "protocol-model",
+        "tensorfusion_tpu/remoting/worker.py",
+        (
+            "        if not self._fab_gate(reply, meta, "
+            "\"PEER_REDUCE\"):\n"
+            "            return\n"
+            "        cid = str(meta.get(\"cid\") or \"\")"
+        ),
+        "        cid = str(meta.get(\"cid\") or \"\")",
+        ["opcode-leak", "PEER_REDUCE", "negotiated v2",
+         "not dominated"],
+        "replace",
     ),
 ]
 

@@ -48,9 +48,49 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from .core import SourceFile
 
-#: bump when extraction output changes shape — stale caches self-evict
+#: bump when extraction output changes shape in a way the derived key
+#: below cannot see (it already folds in every registered checker's
+#: CHECK name and source bytes plus this module's own source, so
+#: adding/editing a checker or the extraction layer self-evicts the
+#: cache without a hand bump)
 CACHE_VERSION = 5
 DEFAULT_CACHE_NAME = ".tpflint-cache.json"
+
+_cache_key_memo: Optional[str] = None
+
+
+def cache_key() -> str:
+    """The cache generation: CACHE_VERSION + the registered checker
+    set + a digest of every checker/extraction module's source.
+
+    A hand-bumped integer alone lets a forgotten bump serve stale
+    per-file facts to a new or changed checker; deriving the key from
+    the registry means the cache misses exactly when the analysis
+    could have changed."""
+    global _cache_key_memo
+    if _cache_key_memo is not None:
+        return _cache_key_memo
+    from . import checkers as _checkers      # deferred: checkers import us
+    h = hashlib.blake2b(digest_size=16)
+    h.update(str(CACHE_VERSION).encode())
+    mods = list(_checkers.FILE_CHECKERS + _checkers.PROJECT_CHECKERS
+                + _checkers.GRAPH_CHECKERS)
+    for mod in sorted(mods, key=lambda m: m.CHECK):
+        h.update(mod.CHECK.encode())
+        src = getattr(mod, "__file__", None)
+        if src and os.path.exists(src):
+            with open(src, "rb") as f:
+                h.update(hashlib.blake2b(f.read(),
+                                         digest_size=16).digest())
+    for extra in ("graph.py", "flow.py", "model.py", "core.py"):
+        p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         extra)
+        if os.path.exists(p):
+            with open(p, "rb") as f:
+                h.update(hashlib.blake2b(f.read(),
+                                         digest_size=16).digest())
+    _cache_key_memo = h.hexdigest()
+    return _cache_key_memo
 
 #: names that participate in lock-ORDER tracking: real locks plus
 #: condition variables (acquiring a Condition acquires its lock, so cv
@@ -567,7 +607,7 @@ class FactsCache:
             try:
                 with open(path, encoding="utf-8") as f:
                     data = json.load(f)
-                if data.get("version") == CACHE_VERSION:
+                if data.get("version") == cache_key():
                     self._entries = data.get("files", {})
             except (OSError, ValueError):
                 self._entries = {}
@@ -595,7 +635,7 @@ class FactsCache:
         tmp = self.path + ".tmp"
         try:
             with open(tmp, "w", encoding="utf-8") as f:
-                json.dump({"version": CACHE_VERSION,
+                json.dump({"version": cache_key(),
                            "files": self._entries}, f,
                           separators=(",", ":"))
             os.replace(tmp, self.path)

@@ -1,0 +1,1242 @@
+"""tpfmodel core: explicit-state bounded model checking of the wire
+protocol's session machines, over models EXTRACTED from the code.
+
+Nothing here is hand-written protocol knowledge.  The model is read
+out of the tree the same way the other tpflint layers read their
+facts (docs/static-analysis.md "model layer"):
+
+- **session machines** from the ``SESSION_PROTOCOLS`` registry
+  (remoting/protocol.py) — states, transitions, terminals, session
+  classes and their constructor initial states;
+- **opcode send gates** from every ``_ensure_version(V, "KIND ...")``
+  call in remoting/client.py and remoting/fabric.py (the what-string
+  leads with the opcode — the convention the double gate already
+  follows), with ``protocol.X_MIN_VERSION`` operands resolved against
+  the protocol module's constants;
+- **worker receive gates** from the dispatch arms of the reader loop
+  (``if kind == "...": outer._handle_x(...)``) in remoting/worker.py,
+  each entry handler scanned for the inline
+  ``meta.get("_wire_version", 2) < V`` guard or an
+  ``if not self._gate(...)`` call into a gate helper;
+- **orchestration ordering** from remoting/federation.py's
+  ``_fabric_ring_reduce`` — whether the FABRIC_OPEN rendezvous loop
+  precedes the FABRIC_ALLREDUCE leg launches in statement order.
+
+The explorer then enumerates EVERY interleaving of small configured
+topologies (2–4 peers x negotiated version vector x message delivery
+order, peer restarts, concurrent migration x fabric) and checks four
+property families:
+
+1. **no-opcode-leak** — an opcode whose client gate names a
+   ``*_MIN_VERSION`` constant, delivered on a connection that
+   negotiated below it, must be rejected by the worker half with no
+   state change (GENERATE's literal-``5`` client gate is single-gated
+   by design and exempt);
+2. **gate-dominance** — every such dispatch arm is dominated by its
+   worker gate before any effect (static, plus the exploration
+   re-proves each rejection);
+3. **session soundness** — every declared state of every
+   ``attr``-bearing family is visited somewhere in the topology
+   matrix, no reachable state is stuck (no enabled action while the
+   program / a session is non-terminal), and declarations map onto
+   real code both ways;
+4. **monotonicity** — worker restart generations only grow, and
+   within one session epoch the state's rank (BFS depth from the
+   creation state in the DECLARED machine) never regresses —
+   migration fencing can't slide back from "frozen" to "live".
+
+Abstractions (deliberate, documented): peer-hop acks are folded into
+the deposit (a rejected hop aborts the sender's leg, which is the
+observable effect); staged migration PUT traffic rides below the
+opcode layer and is not modeled; hop timeouts exist only in restart
+topologies (``allow_timeout``) — in a restart-free ring a blocked
+deposit IS the bug, and is reported as a deadlock with the frame
+trace that wedged it.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import SourceFile
+
+PROTOCOL_SUFFIX = "remoting/protocol.py"
+WORKER_SUFFIX = "remoting/worker.py"
+CLIENT_SUFFIX = "remoting/client.py"
+FABRIC_SUFFIX = "remoting/fabric.py"
+FEDERATION_SUFFIX = "remoting/federation.py"
+REGISTRY = "SESSION_PROTOCOLS"
+
+#: calls that constitute an "effect" for gate dominance: once one of
+#: these runs, the frame acted — a gate after it is a leak
+_EFFECT_CALLS = ("submit", "submit_shipped", "deposit")
+
+
+def _find(files: Dict[str, SourceFile], suffix: str
+          ) -> Optional[SourceFile]:
+    for rel, sf in files.items():
+        if rel.endswith(suffix):
+            return sf
+    return None
+
+
+# -- extraction ------------------------------------------------------------
+
+def _module_constants(sf: SourceFile) -> Dict[str, Any]:
+    """Module-level literal assigns (VERSION, *_MIN_VERSION,
+    REQUEST_KINDS, SESSION_PROTOCOLS, ...)."""
+    out: Dict[str, Any] = {}
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            try:
+                out[node.targets[0].id] = ast.literal_eval(node.value)
+            except (ValueError, SyntaxError):
+                continue
+    return out
+
+
+def _version_of(node: ast.AST, consts: Dict[str, Any]
+                ) -> Tuple[Optional[int], Optional[str]]:
+    """Resolve a version operand: an int literal, or a (possibly
+    dotted) ``*_MIN_VERSION`` name looked up in the protocol
+    constants.  Returns (version, constant_name|None)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return int(node.value), None
+    name = ""
+    if isinstance(node, ast.Attribute):
+        name = node.attr
+    elif isinstance(node, ast.Name):
+        name = node.id
+    if name.endswith("_MIN_VERSION") and isinstance(consts.get(name), int):
+        return int(consts[name]), name
+    return None, None
+
+
+@dataclass
+class ClientGate:
+    version: int
+    const: Optional[str]      # "FABRIC_MIN_VERSION" | None for literals
+    path: str
+    line: int
+
+
+def _client_gates(sf: SourceFile, consts: Dict[str, Any],
+                  kinds: Iterable[str]) -> Dict[str, ClientGate]:
+    """kind -> send gate, from ``_ensure_version(V, "KIND ...")``."""
+    kinds = set(kinds)
+    out: Dict[str, ClientGate] = {}
+    for node in sf.typed(ast.Call):
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute) and
+                fn.attr == "_ensure_version" and len(node.args) >= 2):
+            continue
+        what = node.args[1]
+        if not (isinstance(what, ast.Constant) and
+                isinstance(what.value, str)):
+            continue
+        token = what.value.split()[0] if what.value.split() else ""
+        if token not in kinds:
+            continue
+        ver, const = _version_of(node.args[0], consts)
+        if ver is not None and token not in out:
+            out[token] = ClientGate(ver, const, sf.relpath, node.lineno)
+    return out
+
+
+def _dispatch_arms(sf: SourceFile) -> Dict[str, Tuple[str, int]]:
+    """kind -> (entry handler method, line) from the reader loop's
+    literal arms.  Only the arm's own body is scanned (not elif
+    chains riding in ``orelse``)."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for node in sf.typed(ast.If):
+        t = node.test
+        if not (isinstance(t, ast.Compare) and
+                isinstance(t.left, ast.Name) and t.left.id == "kind" and
+                len(t.ops) == 1):
+            continue
+        comp = t.comparators[0]
+        kinds: List[str] = []
+        if isinstance(t.ops[0], ast.Eq) and isinstance(comp, ast.Constant) \
+                and isinstance(comp.value, str):
+            kinds = [comp.value]
+        elif isinstance(t.ops[0], ast.In) and \
+                isinstance(comp, (ast.Tuple, ast.List)):
+            kinds = [e.value for e in comp.elts
+                     if isinstance(e, ast.Constant) and
+                     isinstance(e.value, str)]
+        if not kinds:
+            continue
+        handler = None
+        for stmt in node.body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call) and \
+                        isinstance(sub.func, ast.Attribute) and \
+                        (sub.func.attr.startswith("_handle_") or
+                         sub.func.attr.startswith("_enqueue_")):
+                    handler = sub.func.attr
+                    break
+            if handler:
+                break
+        if handler is None:
+            continue
+        for k in kinds:
+            out.setdefault(k, (handler, node.lineno))
+    return out
+
+
+def _wire_version_test(test: ast.AST, consts: Dict[str, Any]
+                       ) -> Tuple[Optional[int], Optional[str]]:
+    """``meta.get("_wire_version", 2) < V`` -> (V, const name)."""
+    if not (isinstance(test, ast.Compare) and len(test.ops) == 1 and
+            isinstance(test.ops[0], ast.Lt)):
+        return None, None
+    left = test.left
+    if not (isinstance(left, ast.Call) and
+            isinstance(left.func, ast.Attribute) and
+            left.func.attr == "get" and left.args and
+            isinstance(left.args[0], ast.Constant) and
+            left.args[0].value == "_wire_version"):
+        return None, None
+    return _version_of(test.comparators[0], consts)
+
+
+def _returns_in_body(stmt: ast.If) -> bool:
+    return any(isinstance(sub, ast.Return)
+               for s in stmt.body for sub in ast.walk(s))
+
+
+def _gate_helpers(sf: SourceFile, consts: Dict[str, Any]
+                  ) -> Dict[str, int]:
+    """method name -> refused-below version for worker-half gate
+    helpers: any function whose top-level ``if <wire test>:`` body
+    returns (``_fab_gate`` / ``_mig_gate`` shape)."""
+    out: Dict[str, int] = {}
+    for _sym, fn in sf.functions():
+        for stmt in fn.body:
+            if isinstance(stmt, ast.If) and _returns_in_body(stmt):
+                ver, _ = _wire_version_test(stmt.test, consts)
+                if ver is not None:
+                    out[fn.name] = ver
+    return out
+
+
+def _stmt_effect(stmt: ast.AST) -> Optional[Tuple[int, str]]:
+    """First 'effect' in a statement subtree: an engine/dispatcher
+    submit, a session deposit, a session ``.state`` write, or a
+    non-ERROR reply."""
+    for sub in ast.walk(stmt):
+        if isinstance(sub, ast.Call):
+            fn = sub.func
+            if isinstance(fn, ast.Attribute) and fn.attr in _EFFECT_CALLS:
+                return sub.lineno, f"{fn.attr}()"
+            if isinstance(fn, ast.Name) and fn.id == "reply" and \
+                    sub.args and isinstance(sub.args[0], ast.Constant) \
+                    and sub.args[0].value != "ERROR":
+                return sub.lineno, f"reply {sub.args[0].value}"
+        if isinstance(sub, ast.Assign):
+            for t in sub.targets:
+                if isinstance(t, ast.Attribute) and t.attr == "state":
+                    return sub.lineno, ".state write"
+    return None
+
+
+@dataclass
+class WorkerGate:
+    version: Optional[int]            # None: the arm has no gate
+    line: Optional[int]
+    pre_effect: Optional[Tuple[int, str]]  # effect BEFORE the gate
+    handler: str
+    handler_line: int
+    path: str
+
+
+def _handler_gate(sf: SourceFile, fn: ast.AST,
+                  helpers: Dict[str, int], consts: Dict[str, Any]
+                  ) -> Tuple[Optional[int], Optional[int],
+                             Optional[Tuple[int, str]]]:
+    """Scan the handler's top-level statements in order: the first
+    inline wire test (with a returning body) or ``if not
+    self._gate(...)`` establishes the gate; any effect seen before it
+    is a dominance break."""
+    gate_ver = gate_line = None
+    pre_effect = None
+    for stmt in fn.body:
+        if gate_ver is None and isinstance(stmt, ast.If):
+            ver, _ = _wire_version_test(stmt.test, consts)
+            if ver is not None and _returns_in_body(stmt):
+                gate_ver, gate_line = ver, stmt.lineno
+                continue
+            t = stmt.test
+            if isinstance(t, ast.UnaryOp) and isinstance(t.op, ast.Not) \
+                    and isinstance(t.operand, ast.Call) and \
+                    isinstance(t.operand.func, ast.Attribute) and \
+                    t.operand.func.attr in helpers:
+                gate_ver = helpers[t.operand.func.attr]
+                gate_line = stmt.lineno
+                continue
+        if gate_ver is None and pre_effect is None:
+            pre_effect = _stmt_effect(stmt)
+    return gate_ver, gate_line, pre_effect
+
+
+def _fabric_ordering(sf: SourceFile
+                     ) -> Optional[Tuple[int, int]]:
+    """(first fabric_open call line, first fabric_allreduce call
+    line) inside ``_fabric_ring_reduce`` — statement order IS the
+    rendezvous contract."""
+    for _sym, fn in sf.functions():
+        if fn.name != "_fabric_ring_reduce":
+            continue
+        opens = [n.lineno for n in sf.typed_in(ast.Call, fn)
+                 if isinstance(n.func, ast.Attribute) and
+                 n.func.attr == "fabric_open"]
+        legs = [n.lineno for n in sf.typed_in(ast.Call, fn)
+                if isinstance(n.func, ast.Attribute) and
+                n.func.attr == "fabric_allreduce"]
+        if opens and legs:
+            return min(opens), min(legs)
+    return None
+
+
+def _class_initial_state(sf: SourceFile, cls_name: str,
+                         attr: str) -> Optional[str]:
+    """The constant a session class ctor assigns ``self.<attr>``."""
+    for node in sf.typed(ast.ClassDef):
+        if node.name != cls_name:
+            continue
+        for item in node.body:
+            if isinstance(item, ast.FunctionDef) and \
+                    item.name == "__init__":
+                for sub in ast.walk(item):
+                    if isinstance(sub, ast.Assign):
+                        for t in sub.targets:
+                            if isinstance(t, ast.Attribute) and \
+                                    t.attr == attr and \
+                                    isinstance(sub.value, ast.Constant) \
+                                    and isinstance(sub.value.value, str):
+                                return sub.value.value
+    return None
+
+
+def _state_writes(sf: SourceFile, fn: ast.AST, attr: str) -> Set[str]:
+    out: Set[str] = set()
+    for node in sf.typed_in(ast.Assign, fn):
+        for t in node.targets:
+            if isinstance(t, ast.Attribute) and t.attr == attr and \
+                    isinstance(node.value, ast.Constant) and \
+                    isinstance(node.value.value, str):
+                out.add(node.value.value)
+    return out
+
+
+@dataclass
+class Model:
+    consts: Dict[str, Any]
+    families: Dict[str, dict]
+    request_kinds: Tuple[str, ...]
+    client_gates: Dict[str, ClientGate]
+    worker_entries: Dict[str, Tuple[str, int]]
+    worker_gates: Dict[str, WorkerGate]
+    rendezvous_before_legs: Optional[bool]
+    ordering_lines: Optional[Tuple[int, int]]
+    initial_states: Dict[str, Optional[str]]
+    restart_bumps_generation: bool
+    protocol_rel: str
+    worker_rel: str
+    federation_rel: Optional[str]
+
+    @property
+    def version(self) -> int:
+        return int(self.consts.get("VERSION", 2))
+
+    @property
+    def floor(self) -> int:
+        sup = self.consts.get("SUPPORTED_VERSIONS") or (2,)
+        return int(min(sup))
+
+    def negotiate(self, worker_build: int, client_want: int) -> int:
+        """HELLO's ``max(floor, min(worker, want))`` (worker.py
+        ``negotiate``)."""
+        return max(self.floor, min(int(worker_build), int(client_want)))
+
+    def fenced_kinds(self) -> Dict[str, ClientGate]:
+        """Kinds whose client gate names a ``*_MIN_VERSION`` constant
+        — the double-gated families the leak/dominance properties
+        cover.  Literal-gated kinds (GENERATE's ``5``) are
+        single-gated by design."""
+        return {k: g for k, g in self.client_gates.items()
+                if g.const is not None}
+
+    def ranks(self, fam: str) -> Dict[str, int]:
+        """BFS depth of each declared state from "none" — the partial
+        order monotonicity holds sessions to within one epoch."""
+        spec = self.families.get(fam) or {}
+        transitions = [t for t in spec.get("transitions", ())
+                       if isinstance(t, (tuple, list)) and len(t) == 3]
+        rank = {"none": 0}
+        frontier = ["none"]
+        depth = 0
+        while frontier:
+            depth += 1
+            nxt = []
+            for frm, _op, to in transitions:
+                if frm in rank and to not in rank:
+                    rank[to] = depth
+                    nxt.append(to)
+            frontier = nxt
+        for s in spec.get("states", ()):
+            rank.setdefault(s, depth + 1)
+        return rank
+
+
+def extract(files: Dict[str, SourceFile]) -> Optional[Model]:
+    """Build the model from a parsed file set, or None when the
+    protocol / worker modules are not in the analyzed tree (fixture
+    runs)."""
+    proto = _find(files, PROTOCOL_SUFFIX)
+    worker = _find(files, WORKER_SUFFIX)
+    if proto is None or worker is None:
+        return None
+    consts = _module_constants(proto)
+    families = consts.get(REGISTRY)
+    kinds = tuple(consts.get("REQUEST_KINDS") or ())
+    if not isinstance(families, dict) or not kinds:
+        return None
+
+    gates: Dict[str, ClientGate] = {}
+    for suffix in (CLIENT_SUFFIX, FABRIC_SUFFIX):
+        sf = _find(files, suffix)
+        if sf is not None:
+            for k, g in _client_gates(sf, consts, kinds).items():
+                gates.setdefault(k, g)
+
+    arms = _dispatch_arms(worker)
+    helpers = _gate_helpers(worker, consts)
+    fns = {fn.name: (sym, fn) for sym, fn in worker.functions()}
+    wgates: Dict[str, WorkerGate] = {}
+    for kind, (handler, _line) in arms.items():
+        ent = fns.get(handler)
+        if ent is None:
+            continue
+        sym, fn = ent
+        ver, gline, pre = _handler_gate(worker, fn, helpers, consts)
+        wgates[kind] = WorkerGate(ver, gline, pre, sym, fn.lineno,
+                                  worker.relpath)
+
+    fed = _find(files, FEDERATION_SUFFIX)
+    ordering = _fabric_ordering(fed) if fed is not None else None
+    before = ordering[0] < ordering[1] if ordering else None
+
+    initials: Dict[str, Optional[str]] = {}
+    for name, spec in families.items():
+        if not isinstance(spec, dict):
+            continue
+        cls, attr = spec.get("session"), spec.get("attr")
+        initials[name] = _class_initial_state(worker, cls, attr) \
+            if cls and attr else None
+
+    fab = _find(files, FABRIC_SUFFIX)
+    bumps = False
+    if fab is not None:
+        for node in fab.typed(ast.BinOp):
+            if isinstance(node.op, ast.Add) and \
+                    isinstance(node.left, ast.Attribute) and \
+                    node.left.attr == "generation" and \
+                    isinstance(node.right, ast.Constant) and \
+                    node.right.value == 1:
+                bumps = True
+    return Model(
+        consts=consts, families=families, request_kinds=kinds,
+        client_gates=gates, worker_entries=arms, worker_gates=wgates,
+        rendezvous_before_legs=before, ordering_lines=ordering,
+        initial_states=initials, restart_bumps_generation=bumps,
+        protocol_rel=proto.relpath, worker_rel=worker.relpath,
+        federation_rel=fed.relpath if fed is not None else None)
+
+
+# -- static conformance ----------------------------------------------------
+
+def static_issues(model: Model,
+                  files: Dict[str, SourceFile]) -> List[dict]:
+    """Extraction-level proofs that need no exploration: arm
+    existence, gate dominance, and two-way declaration<->code
+    conformance (the reverse direction protocol-session does not
+    cover: every declared *to* state is realized somewhere)."""
+    issues: List[dict] = []
+    worker = _find(files, WORKER_SUFFIX)
+    fenced = model.fenced_kinds()
+
+    for kind in sorted(fenced):
+        gate = fenced[kind]
+        ent = model.worker_entries.get(kind)
+        if ent is None:
+            issues.append(dict(
+                path=model.worker_rel, line=1, symbol="<dispatch>",
+                key=f"arm:{kind}",
+                message=(f"model: no dispatch arm found for {kind} — "
+                         f"the client gate ({gate.const}) fences an "
+                         f"opcode the worker never dispatches"),
+                witness=[]))
+            continue
+        wg = model.worker_gates.get(kind)
+        if wg is None:
+            continue
+        frames = [f"HELLO max_version={model.floor} -> negotiated "
+                  f"v{model.floor}",
+                  f"{kind} (client half refuses below v{gate.version} "
+                  f"at {gate.path}:{gate.line})",
+                  f"{wg.handler} [{wg.path}:{wg.handler_line}] "
+                  f"executes the arm"]
+        if wg.version is None:
+            issues.append(dict(
+                path=wg.path, line=wg.handler_line, symbol=wg.handler,
+                key=f"gate:{kind}",
+                message=(f"model: worker arm for {kind} is not "
+                         f"dominated by a _wire_version gate — the "
+                         f"client half refuses below v{gate.version} "
+                         f"({gate.const}), but a smuggled frame on a "
+                         f"connection that negotiated v{model.floor} "
+                         f"reaches {wg.handler}() ungated; frames: "
+                         + " -> ".join(frames)),
+                witness=frames))
+        elif wg.version < gate.version:
+            issues.append(dict(
+                path=wg.path, line=wg.line or wg.handler_line,
+                symbol=wg.handler, key=f"gate-weak:{kind}",
+                message=(f"model: worker gate for {kind} refuses below "
+                         f"v{wg.version} but the client half fences "
+                         f"v{gate.version} ({gate.const}) — versions "
+                         f"v{wg.version}..v{gate.version - 1} leak "
+                         f"through the worker half"),
+                witness=frames))
+        elif wg.pre_effect is not None:
+            line, what = wg.pre_effect
+            issues.append(dict(
+                path=wg.path, line=line, symbol=wg.handler,
+                key=f"gate-late:{kind}",
+                message=(f"model: {wg.handler}() runs {what} at "
+                         f"{wg.path}:{line} BEFORE its v{wg.version} "
+                         f"gate — the gate must dominate every "
+                         f"effect on every path"),
+                witness=frames))
+
+    # reverse conformance: every declared transition's *to* state is
+    # realized by a handler write, the session ctor, or a self-loop
+    if worker is not None:
+        fns = {fn.name: (sym, fn) for sym, fn in worker.functions()}
+        for name in sorted(model.families):
+            spec = model.families[name]
+            if not isinstance(spec, dict) or not spec.get("attr"):
+                continue
+            attr = spec["attr"]
+            writes_by_op: Dict[str, Set[str]] = {}
+            for op, fn_names in (spec.get("handlers") or {}).items():
+                got: Set[str] = set()
+                for fname in fn_names:
+                    ent = fns.get(fname)
+                    if ent is not None:
+                        got |= _state_writes(worker, ent[1], attr)
+                writes_by_op[op] = got
+            initial = model.initial_states.get(name)
+            for t in spec.get("transitions", ()):
+                if not (isinstance(t, (tuple, list)) and len(t) == 3):
+                    continue
+                frm, op, to = t
+                if frm == to or to == initial or \
+                        to in writes_by_op.get(op, ()):
+                    continue
+                issues.append(dict(
+                    path=model.protocol_rel, line=1, symbol=REGISTRY,
+                    key=f"unrealized:{name}:{frm}:{op}:{to}",
+                    message=(f"model: SESSION_PROTOCOLS[{name!r}] "
+                             f"declares ({frm!r}, {op}, {to!r}) but no "
+                             f"declared handler of {op} writes "
+                             f".{attr} = {to!r} and the session ctor "
+                             f"starts at {initial!r} — dead "
+                             f"declaration or missing code"),
+                    witness=[]))
+            cls = spec.get("session")
+            if cls and initial is None:
+                issues.append(dict(
+                    path=model.protocol_rel, line=1, symbol=REGISTRY,
+                    key=f"ctor:{name}",
+                    message=(f"model: SESSION_PROTOCOLS[{name!r}] "
+                             f"names session class {cls} but its "
+                             f"__init__ sets no literal .{attr} — "
+                             f"the machine's creation state is "
+                             f"unverifiable"),
+                    witness=[]))
+    return issues
+
+
+# -- the explorer ----------------------------------------------------------
+
+@dataclass(frozen=True)
+class Topology:
+    """One bounded configuration: worker build versions, the
+    orchestration program(s), optional rogue-peer injections and
+    restart budget."""
+    name: str
+    workers: Tuple[int, ...]
+    program: str                    # fabric|migrate|migrate_abort|
+    #                                 migrate_early_commit|serving|
+    #                                 migrate_fabric
+    smuggle: Tuple[str, ...] = ()
+    smuggle_version: int = 2
+    smuggle_target: int = 0
+    restarts: int = 0
+    allow_timeout: bool = False     # hop-timeout abort for blocked takes
+    max_states: int = 200_000
+
+
+@dataclass
+class ExploreResult:
+    topology: str
+    states: int = 0
+    transitions: int = 0
+    gated_deliveries: int = 0       # deliveries checked against a gate
+    rejections: int = 0             # worker-half refusals proven
+    client_refused: int = 0         # client-half refusals proven
+    mono_checked: int = 0           # session/generation rank checks
+    visited: Set[Tuple[str, str]] = field(default_factory=set)
+    violations: List[dict] = field(default_factory=list)
+    truncated: bool = False
+
+    def violation(self, prop: str, message: str,
+                  trace: List[str]) -> None:
+        """Record a counterexample; at most 3 distinct traces per
+        property per topology (the first is the BFS-shallowest — the
+        extra two keep variants like 'the deadlock where PEER_REDUCE
+        did fly' without flooding the report)."""
+        same = [v for v in self.violations if v["property"] == prop]
+        if len(same) >= 3 or any(v["message"] == message
+                                 for v in same):
+            return
+        self.violations.append(dict(property=prop, message=message,
+                                    trace=trace))
+
+
+def _fabric_ops(i: int, n: int) -> Tuple[Tuple, ...]:
+    """The flush micro-program for ring member i of n (the statement
+    order of ``_flush_fabric_allreduce``): take the up-ring deposit,
+    relay, take the down-ring total, forward, finish."""
+    ops: List[Tuple] = [("begin",)]
+    if i > 0:
+        ops.append(("take", "reduce"))
+    if i < n - 1:
+        ops.append(("send", "reduce", i + 1))
+        ops.append(("take", "install"))
+    if i > 0:
+        ops.append(("send", "install", i - 1))
+    ops.append(("finish",))
+    return tuple(ops)
+
+
+class _Setup:
+    """Precomputed per-topology model data + the successor function."""
+
+    def __init__(self, model: Model, topo: Topology):
+        self.m = model
+        self.t = topo
+        n = len(topo.workers)
+        self.n = n
+        self.conn = [model.negotiate(v, model.version)
+                     for v in topo.workers]
+        self.peer = [[model.negotiate(min(a, b), model.version)
+                      for b in topo.workers] for a in topo.workers]
+        self.rogue = [model.negotiate(v, topo.smuggle_version)
+                      for v in topo.workers]
+        self.fenced = {k: g.version
+                       for k, g in model.fenced_kinds().items()}
+        self.wgate = {k: wg.version
+                      for k, wg in model.worker_gates.items()}
+        self.climit = {k: g.version
+                       for k, g in model.client_gates.items()}
+        self.ops = [_fabric_ops(i, n) for i in range(n)]
+        self.ranks = {f: model.ranks(f)
+                      for f, spec in model.families.items()
+                      if isinstance(spec, dict) and spec.get("attr")}
+        self.progs = self._programs()
+        self.n_legs = sum(1 for prog in self.progs for s in prog
+                          if s[0] == "async" and s[2] == "FABRIC_ALLREDUCE")
+
+    def _fabric_prog(self) -> Tuple[Tuple, ...]:
+        opens = [("rpc", i, "FABRIC_OPEN", None) for i in range(self.n)]
+        legs = [("async", i, "FABRIC_ALLREDUCE", None)
+                for i in range(self.n)]
+        before = self.m.rendezvous_before_legs
+        seq = (opens + legs) if before in (True, None) else (legs + opens)
+        return tuple(seq + [("await_receipts", self.n)])
+
+    def _programs(self) -> Tuple[Tuple[Tuple, ...], ...]:
+        p = self.t.program
+        mig = lambda *steps: tuple(  # noqa: E731 - local shorthand
+            ("rpc", 0, k, v) for k, v in steps)
+        if p == "fabric":
+            return (self._fabric_prog(),)
+        if p == "migrate":
+            return (mig(("SNAPSHOT_DELTA", None), ("SNAPSHOT_DELTA", None),
+                        ("MIGRATE_FREEZE", None), ("MIGRATE_COMMIT", None)),)
+        if p == "migrate_abort":
+            return (mig(("SNAPSHOT_DELTA", None), ("MIGRATE_FREEZE", None),
+                        ("MIGRATE_COMMIT", "abort")),)
+        if p == "migrate_early_commit":
+            return (mig(("SNAPSHOT_DELTA", None), ("MIGRATE_COMMIT", None),
+                        ("MIGRATE_FREEZE", None), ("MIGRATE_COMMIT", None)),)
+        if p == "serving":
+            return (mig(("GENERATE", None), ("KV_SHIP", None)),)
+        if p == "migrate_fabric":
+            return (mig(("SNAPSHOT_DELTA", None), ("MIGRATE_FREEZE", None),
+                        ("MIGRATE_COMMIT", None)),
+                    self._fabric_prog())
+        raise ValueError(f"unknown program {p!r}")
+
+    # -- state shape ------------------------------------------------------
+    # state = (pcs, waits, channels, workers, receipts, restarts_left)
+    # worker = (gen, fab, flush, mig, gs, kv); fab = (epoch, state,
+    # dep_reduce, dep_install); mig/gs/kv = (epoch, state); channels =
+    # sorted tuple of ((src, dst), (msg, ...)); msg = (kind, variant,
+    # reply_to, sender)
+
+    def initial(self) -> tuple:
+        chans: Dict[Tuple, Tuple] = {}
+        if self.t.smuggle:
+            w = self.t.smuggle_target
+            chans[("R", w)] = tuple(
+                (k, None, None, None) for k in self.t.smuggle)
+        workers = tuple((0, None, None, None, None, None)
+                        for _ in range(self.n))
+        return (tuple(0 for _ in self.progs),
+                tuple(None for _ in self.progs),
+                self._chan_tuple(chans), workers, frozenset(),
+                self.t.restarts)
+
+    @staticmethod
+    def _chan_tuple(chans: Dict[Tuple, Tuple]) -> tuple:
+        # endpoint names mix ints (workers) and strings (clients /
+        # the rogue peer) — sort on a stringized key
+        return tuple(sorted(((k, v) for k, v in chans.items() if v),
+                            key=lambda kv: (str(kv[0][0]),
+                                            str(kv[0][1]))))
+
+    def complete(self, st: tuple) -> bool:
+        pcs, waits, channels, workers, _receipts, _r = st
+        return (all(pc >= len(self.progs[t])
+                    for t, pc in enumerate(pcs)) and
+                all(w is None for w in waits) and not channels and
+                all(w[2] is None for w in workers))
+
+    # -- successor generation --------------------------------------------
+
+    def successors(self, st: tuple, res: ExploreResult,
+                   trace) -> List[Tuple[str, tuple]]:
+        out: List[Tuple[str, tuple]] = []
+        pcs, waits, channels, workers, receipts, restarts = st
+        chans = dict(channels)
+
+        for t, pc in enumerate(pcs):
+            if waits[t] is not None or pc >= len(self.progs[t]):
+                continue
+            out.extend(self._step(st, t, res, trace))
+
+        for key in chans:
+            out.append(self._deliver(st, key, res, trace))
+
+        for w in range(self.n):
+            flush = workers[w][2]
+            if flush is None:
+                continue
+            got = self._flush_step(st, w, res, trace)
+            if got is not None:
+                out.append(got)
+            elif self.t.allow_timeout and \
+                    self.ops[w][flush][0] == "take":
+                out.append(self._flush_abort(
+                    st, w, f"w{w}: fabric hop timeout at "
+                           f"take({self.ops[w][flush][1]}) — leg "
+                           f"aborts", res))
+
+        for w in range(self.n):
+            sess = workers[w][4]
+            if sess is not None and sess[1] == "streaming":
+                out.append(self._stream_finish(st, w, "gs", res))
+            sess = workers[w][5]
+            if sess is not None and sess[1] == "shipping":
+                out.append(self._stream_finish(st, w, "kv", res))
+
+        if restarts > 0 and not self.complete(st):
+            for w in range(self.n):
+                out.append(self._restart(st, w, res))
+        return [s for s in out if s is not None]
+
+    # mutation helpers: all take the packed state and return
+    # (label, new_state)
+
+    def _emit(self, chans: Dict, src, dst, msg) -> None:
+        chans[(src, dst)] = chans.get((src, dst), ()) + (msg,)
+
+    def _visit(self, res: ExploreResult, fam: str, state: str) -> None:
+        res.visited.add((fam, state))
+
+    def _mono(self, res: ExploreResult, fam: str, old, new,
+              st, label, trace) -> None:
+        """Within one epoch, rank may not regress (declared-machine
+        BFS depth); a fresh epoch resets the clock."""
+        res.mono_checked += 1
+        if old is None or new is None or old[0] != new[0]:
+            return
+        rank = self.ranks.get(fam) or {}
+        if rank.get(new[1], 0) < rank.get(old[1], 0):
+            res.violation(
+                "monotonicity",
+                f"model: session family {fam!r} regressed "
+                f"{old[1]!r} -> {new[1]!r} within epoch {old[0]} "
+                f"(declared rank {rank.get(old[1])} -> "
+                f"{rank.get(new[1])})",
+                trace(st) + [label])
+
+    def _step(self, st, t, res, trace) -> List[Tuple[str, tuple]]:
+        pcs, waits, channels, workers, receipts, restarts = st
+        step = self.progs[t][pcs[t]]
+        if step[0] == "await_receipts":
+            if len(receipts) < step[1]:
+                return []
+            err = any(not ok for _w, ok in receipts)
+            pcs2 = list(pcs)
+            pcs2[t] = len(self.progs[t]) if err else pcs[t] + 1
+            return [(f"C{t}: collected {len(receipts)} leg receipt(s)"
+                     + (" — ring aborted" if err else ""),
+                     (tuple(pcs2), waits, channels, workers, receipts,
+                      restarts))]
+        _kind0, w, kind, variant = step
+        need = self.climit.get(kind)
+        if need is not None and self.conn[w] < need:
+            res.client_refused += 1
+            pcs2 = list(pcs)
+            pcs2[t] = len(self.progs[t])
+            return [(f"C{t}: client refuses {kind} to w{w} (conn "
+                     f"v{self.conn[w]} < v{need}) — program falls "
+                     f"back", (tuple(pcs2), waits, channels, workers,
+                               receipts, restarts))]
+        chans = dict(channels)
+        self._emit(chans, f"C{t}", w, (kind, variant, f"C{t}", None))
+        pcs2, waits2 = list(pcs), list(waits)
+        pcs2[t] = pcs[t] + 1
+        if step[0] == "rpc":
+            waits2[t] = (w, kind)
+        return [(f"C{t} queues {kind}"
+                 + (f" [{variant}]" if variant else "")
+                 + f" -> w{w} (conn v{self.conn[w]})",
+                 (tuple(pcs2), tuple(waits2), self._chan_tuple(chans),
+                  workers, receipts, restarts))]
+
+    def _conn_version(self, src, dst: int) -> int:
+        if isinstance(src, str) and src.startswith("C"):
+            return self.conn[dst]
+        if src == "R":
+            return self.rogue[dst]
+        return self.peer[src][dst]
+
+    def _deliver(self, st, key, res, trace) -> Tuple[str, tuple]:
+        pcs, waits, channels, workers, receipts, restarts = st
+        chans = dict(channels)
+        src, dst = key
+        msg, rest = chans[key][0], chans[key][1:]
+        if rest:
+            chans[key] = rest
+        else:
+            del chans[key]
+        kind, variant, reply_to, sender = msg
+
+        if isinstance(dst, str):            # a reply / receipt landing
+            return self._deliver_client(
+                st, chans, src, dst, msg)
+
+        ver = self._conn_version(src, dst)
+        src_s = f"w{src}" if isinstance(src, int) else src
+        label = f"{src_s} -> w{dst}: {kind} (conn v{ver})"
+        ws = list(workers)
+
+        gate = self.wgate.get(kind)
+        fenced = self.fenced.get(kind)
+        if fenced is not None:
+            res.gated_deliveries += 1
+        if gate is not None and ver < gate:
+            res.rejections += 1
+            label += f" — REJECTED by the worker v{gate} gate"
+            if reply_to is not None:
+                self._emit(chans, dst, reply_to,
+                           ("#REPLY", (kind, False), None, None))
+            if sender is not None:
+                return self._sender_abort(
+                    (pcs, waits, self._chan_tuple(chans), tuple(ws),
+                     receipts, restarts), sender, label, res)
+            return (label, (pcs, waits, self._chan_tuple(chans),
+                            tuple(ws), receipts, restarts))
+        if fenced is not None and ver < fenced:
+            # the client half would never send this; it arrived (rogue
+            # peer / deleted gate) and the worker half let it through
+            res.violation(
+                "opcode-leak",
+                f"model: opcode-leak — {kind} requires v{fenced} "
+                f"({self.m.fenced_kinds()[kind].const}) but a frame "
+                f"on a connection that negotiated v{ver} executed "
+                f"its dispatch arm ungated; frames: "
+                + "; ".join(trace(st)[-4:] + [label]),
+                trace(st) + [label])
+
+        new_st = self._apply(kind, variant, ws, chans, dst, reply_to,
+                             sender, st, label, res, trace)
+        return (label, new_st)
+
+    def _deliver_client(self, st, chans, src, dst, msg):
+        pcs, waits, channels, workers, receipts, restarts = st
+        kind, payload, _rt, _snd = msg
+        pcs2, waits2 = list(pcs), list(waits)
+        receipts2 = receipts
+        if kind == "#RECEIPT":
+            receipts2 = receipts | {(src, bool(payload))}
+            label = (f"w{src} -> {dst}: FABRIC_ALLREDUCE receipt "
+                     f"({'ok' if payload else 'error'})")
+        else:
+            req, ok = payload
+            label = f"w{src} -> {dst}: {req} {'OK' if ok else 'ERROR'}"
+            t = int(dst[1:])
+            if waits2[t] == (src, req):
+                waits2[t] = None
+                if not ok:
+                    pcs2[t] = len(self.progs[t])
+                    label += " — orchestrator raises"
+        return (label, (tuple(pcs2), tuple(waits2),
+                        self._chan_tuple(chans), workers, receipts2,
+                        restarts))
+
+    def _apply(self, kind, variant, ws, chans, w, reply_to, sender,
+               st, label, res, trace) -> tuple:
+        pcs, waits, _channels, _workers, receipts, restarts = st
+        gen, fab, flush, mig, gs, kv = ws[w]
+
+        def reply(ok: bool) -> None:
+            if reply_to is not None:
+                self._emit(chans, w, reply_to,
+                           ("#REPLY", (kind, ok), None, None))
+
+        if kind == "FABRIC_OPEN":
+            if fab is not None:
+                self._visit(res, "peer_fabric", "aborted")
+            epoch = (fab[0] if fab else 0) + 1
+            new = (epoch, "open", False, False)
+            self._mono(res, "peer_fabric", fab, new, st, label, trace)
+            fab = new
+            self._visit(res, "peer_fabric", "open")
+            reply(True)
+        elif kind == "FABRIC_ALLREDUCE":
+            if flush is None:
+                flush = 0           # leg enqueued; flush runs async
+            else:
+                reply(False)
+        elif kind in ("PEER_REDUCE", "PEER_INSTALL"):
+            if fab is None or fab[1] not in ("open", "reducing"):
+                res.rejections += 1
+                label += " — no open session, deposit refused"
+                ws[w] = (gen, fab, flush, mig, gs, kv)
+                if sender is not None:
+                    return self._sender_abort(
+                        (pcs, waits, self._chan_tuple(chans),
+                         tuple(ws), receipts, restarts),
+                        sender, label, res)[1]
+                return (pcs, waits, self._chan_tuple(chans), tuple(ws),
+                        receipts, restarts)
+            which = 2 if kind == "PEER_REDUCE" else 3
+            fab = fab[:which] + (True,) + fab[which + 1:]
+        elif kind == "SNAPSHOT_DELTA":
+            if mig is None:
+                mig = ((0, "live"))
+                mig = (1, "live")
+                self._visit(res, "migration", "live")
+                reply(True)
+            elif mig[1] == "live":
+                self._mono(res, "migration", mig, mig, st, label, trace)
+                reply(True)
+            else:
+                reply(False)
+        elif kind == "MIGRATE_FREEZE":
+            if mig is not None and mig[1] == "live":
+                new = (mig[0], "frozen")
+                self._mono(res, "migration", mig, new, st, label, trace)
+                mig = new
+                self._visit(res, "migration", "frozen")
+            reply(True)
+        elif kind == "MIGRATE_COMMIT":
+            if mig is None:
+                reply(False)
+            elif variant == "abort":
+                self._visit(res, "migration", "aborted")
+                mig = None
+                reply(True)
+            elif mig[1] != "frozen":
+                reply(False)        # session restored untouched
+            else:
+                self._visit(res, "migration", "committed")
+                mig = None
+                reply(True)
+        elif kind == "GENERATE":
+            gs = ((gs[0] if gs else 0) + 1, "streaming")
+            self._visit(res, "generate_stream", "streaming")
+        elif kind == "KV_SHIP":
+            kv = ((kv[0] if kv else 0) + 1, "shipping")
+            self._visit(res, "kv_ship", "shipping")
+        else:
+            reply(True)             # barrier/admin kinds: no session
+        ws[w] = (gen, fab, flush, mig, gs, kv)
+        return (pcs, waits, self._chan_tuple(chans), tuple(ws),
+                receipts, restarts)
+
+    def _sender_abort(self, st, sender: int, label: str,
+                      res: ExploreResult) -> Tuple[str, tuple]:
+        """A rejected peer hop errors the SENDING member's blocking
+        ship call: its leg aborts (``_abort_fabric``)."""
+        got = self._flush_abort(
+            st, sender, label + f"; w{sender}'s leg aborts", res)
+        return got if got is not None else (label, st)
+
+    def _flush_abort(self, st, w: int, label: str,
+                     res: ExploreResult) -> Optional[Tuple[str, tuple]]:
+        pcs, waits, channels, workers, receipts, restarts = st
+        gen, fab, flush, mig, gs, kv = workers[w]
+        if flush is None and fab is None:
+            return (label, st)
+        chans = dict(channels)
+        if fab is not None:
+            self._visit(res, "peer_fabric", "aborted")
+        if flush is not None:
+            self._emit(chans, w, "C0" if len(self.progs) == 1 else "C1",
+                       ("#RECEIPT", False, None, None))
+        ws = list(workers)
+        ws[w] = (gen, None, None, mig, gs, kv)
+        return (label, (pcs, waits, self._chan_tuple(chans), tuple(ws),
+                        receipts, restarts))
+
+    def _flush_step(self, st, w, res, trace
+                    ) -> Optional[Tuple[str, tuple]]:
+        pcs, waits, channels, workers, receipts, restarts = st
+        gen, fab, flush, mig, gs, kv = workers[w]
+        op = self.ops[w][flush]
+        chans = dict(channels)
+        ws = list(workers)
+        if op[0] == "begin":
+            if fab is None or fab[1] != "open":
+                return self._flush_abort(
+                    st, w, f"w{w}: FABRIC_ALLREDUCE flush starts with "
+                           f"no open session (FABRIC_OPEN never "
+                           f"arrived first) — leg aborts", res)
+            new = (fab[0], "reducing", fab[2], fab[3])
+            self._mono(res, "peer_fabric", fab, new, st,
+                       f"w{w}: flush begins", trace)
+            ws[w] = (gen, new, flush + 1, mig, gs, kv)
+            self._visit(res, "peer_fabric", "reducing")
+            return (f"w{w}: flush begins (session open -> reducing)",
+                    (pcs, waits, channels, tuple(ws), receipts,
+                     restarts))
+        if op[0] == "take":
+            which = 2 if op[1] == "reduce" else 3
+            if fab is None or not fab[which]:
+                return None         # blocked on the deposit
+            fab = fab[:which] + (False,) + fab[which + 1:]
+            ws[w] = (gen, fab, flush + 1, mig, gs, kv)
+            return (f"w{w}: flush takes the {op[1]} deposit",
+                    (pcs, waits, channels, tuple(ws), receipts,
+                     restarts))
+        if op[0] == "send":
+            kind = "PEER_REDUCE" if op[1] == "reduce" else "PEER_INSTALL"
+            j = op[2]
+            need = self.climit.get(kind)
+            if need is not None and self.peer[w][j] < need:
+                res.client_refused += 1
+                return self._flush_abort(
+                    st, w, f"w{w}: peer link refuses {kind} to w{j} "
+                           f"(peer conn v{self.peer[w][j]} < "
+                           f"v{need}) — leg aborts", res)
+            self._emit(chans, w, j, (kind, None, None, w))
+            ws[w] = (gen, fab, flush + 1, mig, gs, kv)
+            return (f"w{w} -> w{j}: {kind} (peer conn "
+                    f"v{self.peer[w][j]})",
+                    (pcs, waits, self._chan_tuple(chans), tuple(ws),
+                     receipts, restarts))
+        # finish: terminal "done", slot cleared, ok receipt
+        self._visit(res, "peer_fabric", "done")
+        self._emit(chans, w, "C0" if len(self.progs) == 1 else "C1",
+                   ("#RECEIPT", True, None, None))
+        ws[w] = (gen, None, None, mig, gs, kv)
+        return (f"w{w}: flush finishes (session reducing -> done, "
+                f"receipt ok)",
+                (pcs, waits, self._chan_tuple(chans), tuple(ws),
+                 receipts, restarts))
+
+    def _stream_finish(self, st, w, slot, res) -> Tuple[str, tuple]:
+        pcs, waits, channels, workers, receipts, restarts = st
+        gen, fab, flush, mig, gs, kv = workers[w]
+        chans = dict(channels)
+        if slot == "gs":
+            gs = (gs[0], "done")
+            self._visit(res, "generate_stream", "done")
+            kind, label = "GENERATE", "final GENERATE_OK frame"
+        else:
+            kv = (kv[0], "bound")
+            self._visit(res, "kv_ship", "bound")
+            kind, label = "KV_SHIP", "KV_SHIP_OK receipt"
+        self._emit(chans, w, "C0", ("#REPLY", (kind, True), None, None))
+        ws = list(workers)
+        ws[w] = (gen, fab, flush, mig, gs, kv)
+        return (f"w{w}: {label} (stream -> terminal)",
+                (pcs, waits, self._chan_tuple(chans), tuple(ws),
+                 receipts, restarts))
+
+    def _restart(self, st, w, res) -> Tuple[str, tuple]:
+        """Peer process death: generation bumps, sessions die with the
+        process, in-flight frames TO the worker are severed, pending
+        RPC waits error out."""
+        pcs, waits, channels, workers, receipts, restarts = st
+        gen, fab, flush, mig, gs, kv = workers[w]
+        ws = list(workers)
+        chans: Dict[Tuple, Tuple] = {}
+        errored: Set[str] = set()
+        for k, v in dict(channels).items():
+            if k[1] != w:
+                chans[k] = v
+                continue
+            # the TCP reset errors every request in flight on the
+            # severed connections: leg futures become error receipts,
+            # RPC futures become ERROR replies, and a peer hop errors
+            # the SENDING member's blocking ship call (its leg aborts)
+            for kind, _variant, reply_to, sender in v:
+                if kind == "FABRIC_ALLREDUCE":
+                    self._emit(chans, w, reply_to or "C0",
+                               ("#RECEIPT", False, None, None))
+                elif reply_to is not None:
+                    errored.add(reply_to)
+                    self._emit(chans, w, reply_to,
+                               ("#REPLY", (kind, False), None, None))
+                elif sender is not None and sender != w:
+                    sgen, sfab, sflush, smig, sgs, skv = ws[sender]
+                    if sfab is not None:
+                        self._visit(res, "peer_fabric", "aborted")
+                    if sflush is not None:
+                        self._emit(chans, sender,
+                                   "C0" if len(self.progs) == 1
+                                   else "C1",
+                                   ("#RECEIPT", False, None, None))
+                    ws[sender] = (sgen, None, None, smig, sgs, skv)
+        if flush is not None:
+            self._emit(chans, w, "C0" if len(self.progs) == 1 else "C1",
+                       ("#RECEIPT", False, None, None))
+        waits2 = list(waits)
+        for t, wt in enumerate(waits):
+            if wt is not None and wt[0] == w and f"C{t}" not in errored:
+                self._emit(chans, w, f"C{t}",
+                           ("#REPLY", (wt[1], False), None, None))
+        new_gen = gen + (1 if self.m.restart_bumps_generation else 1)
+        res.mono_checked += 1
+        ws[w] = (new_gen, None, None, None, None, None)
+        return (f"restart w{w} (generation {gen} -> {new_gen}; "
+                f"sessions die with the process)",
+                (pcs, tuple(waits2), self._chan_tuple(chans),
+                 tuple(ws), receipts, restarts - 1))
+
+
+def explore(model: Model, topo: Topology) -> ExploreResult:
+    """Breadth-first enumeration of every reachable state of the
+    topology; properties are checked on each transition, deadlocks on
+    each expansion."""
+    setup = _Setup(model, topo)
+    res = ExploreResult(topo.name)
+    init = setup.initial()
+    parent: Dict[tuple, Optional[Tuple[tuple, str]]] = {init: None}
+    order = deque([init])
+
+    def trace(st: tuple) -> List[str]:
+        labels: List[str] = []
+        cur = st
+        while parent.get(cur) is not None:
+            cur, label = parent[cur]
+            labels.append(label)
+        return list(reversed(labels))
+
+    for fam, spec in model.families.items():
+        if isinstance(spec, dict) and spec.get("attr"):
+            res.visited.add((fam, "none"))
+
+    while order:
+        st = order.popleft()
+        succ = setup.successors(st, res, trace)
+        if not succ and not setup.complete(st):
+            tail = trace(st)
+            res.violation(
+                "deadlock",
+                "model: deadlock — no enabled action while the "
+                "program / a fabric leg is non-terminal; frames: "
+                + "; ".join(tail) if tail else "model: deadlock at "
+                                               "the initial state",
+                tail)
+        for label, ns in succ:
+            res.transitions += 1
+            if ns in parent:
+                continue
+            if len(parent) >= topo.max_states:
+                res.truncated = True
+                continue
+            parent[ns] = (st, label)
+            order.append(ns)
+    res.states = len(parent)
+    return res
+
+
+# -- topology catalogs -----------------------------------------------------
+
+def mini_topologies(model: Model) -> List[Topology]:
+    """The two cheap configurations the lint-time conformance checker
+    explores: a 2-ring at head version (deadlock + soundness), and the
+    same ring with a v-floor rogue peer injecting every fenced opcode
+    (leak + rejection proofs)."""
+    v = model.version
+    smuggle = tuple(sorted(model.fenced_kinds()))
+    return [
+        Topology("ring2", (v, v), "fabric"),
+        Topology("ring2-rogue", (v, v), "fabric", smuggle=smuggle,
+                 smuggle_version=model.floor),
+    ]
+
+
+def default_topologies(model: Model) -> List[Topology]:
+    """The ``make verify-model`` matrix: mixed version vectors,
+    restarts, concurrent sessions.  Every declared state of every
+    attr-bearing family must be visited across the union."""
+    v = model.version
+    smuggle = tuple(sorted(model.fenced_kinds()))
+    mig_min = int(model.consts.get("MIGRATE_MIN_VERSION", 8))
+    return [
+        Topology("ring2", (v, v), "fabric"),
+        Topology("ring3", (v, v, v), "fabric"),
+        Topology("ring2-rogue", (v, v), "fabric", smuggle=smuggle,
+                 smuggle_version=model.floor),
+        Topology("ring2-mixed", (v, v - 1), "fabric",
+                 smuggle=smuggle, smuggle_version=model.floor,
+                 smuggle_target=1),
+        Topology("ring2-restart", (v, v), "fabric", restarts=1,
+                 allow_timeout=True),
+        Topology("migrate", (v, v), "migrate"),
+        Topology("migrate-abort", (v, v), "migrate_abort"),
+        Topology("migrate-early-commit", (v, v),
+                 "migrate_early_commit"),
+        Topology("migrate-mixed", (v, mig_min - 1), "migrate",
+                 smuggle=("SNAPSHOT_DELTA", "MIGRATE_FREEZE",
+                          "MIGRATE_COMMIT"),
+                 smuggle_version=model.floor),
+        Topology("migrate-x-fabric", (v, v), "migrate_fabric"),
+        Topology("serving", (v,), "serving",
+                 smuggle=("KV_SHIP",), smuggle_version=model.floor),
+    ]
